@@ -1,0 +1,47 @@
+"""elasticsearch_tpu — a TPU-native distributed search engine.
+
+A brand-new framework with the capabilities of Elasticsearch (reference:
+Elasticsearch 8.0.0-SNAPSHOT / Lucene 8.6.0), designed idiomatically for
+JAX/XLA/Pallas/pjit on TPU rather than ported from the JVM design.
+
+Layer map (mirrors the reference's layer map, SURVEY.md §1):
+
+- ``common/``   — settings registry, errors, xcontent (JSON) helpers
+                  (ref: server common/settings, libs/x-content)
+- ``utils/``    — accounted array pools + circuit breakers
+                  (ref: common/util/BigArrays.java, common/breaker)
+- ``analysis/`` — analyzer chains: char filters → tokenizer → token filters
+                  (ref: index/analysis/AnalysisRegistry.java)
+- ``index/``    — mapping, TPU-oriented segment format, engine, translog
+                  (ref: index/mapper, index/engine/InternalEngine.java,
+                  index/translog/Translog.java; Lucene's role is replaced by
+                  a columnar, padded-block postings format designed for
+                  device consumption)
+- ``ops/``      — JAX/XLA/Pallas scoring kernels: batched BM25 over postings
+                  blocks, dense-vector matmul kNN, on-device top-k
+                  (ref: the Lucene BulkScorer hot loop,
+                  search/internal/ContextIndexSearcher.java:210-213)
+- ``models/``   — scoring models composed from ops (BM25 similarity,
+                  vector similarity, hybrid RRF)
+- ``search/``   — query DSL, query/fetch phases, search service, rank_eval
+                  (ref: index/query, search/query/QueryPhase.java,
+                  action/search/TransportSearchAction.java)
+- ``parallel/`` — device mesh, sharded search execution, collective top-k
+                  merges over ICI (ref: the scatter-gather protocol,
+                  action/search/SearchPhaseController.java)
+- ``rest/``     — HTTP REST API surface (ref: rest/RestController.java)
+- ``cluster/``  — cluster state, coordination (Zen2-equivalent; grows in
+                  later rounds) (ref: cluster/coordination/Coordinator.java)
+- ``native/``   — C++ host-side components (postings codec, tokenizer)
+                  loaded via ctypes (ref integrates native code via JNA/
+                  ml-cpp; here the host runtime around the TPU compute path)
+"""
+
+__version__ = "0.1.0"
+
+from elasticsearch_tpu.common.errors import (  # noqa: F401
+    ElasticsearchTpuException,
+    IndexNotFoundException,
+    ResourceAlreadyExistsException,
+    VersionConflictEngineException,
+)

@@ -1,0 +1,144 @@
+"""Exception hierarchy.
+
+Mirrors the reference's ElasticsearchException tree (ref:
+server/src/main/java/org/elasticsearch/ElasticsearchException.java) — every
+exception carries an HTTP status so the REST layer can map failures to
+responses the way RestController does.
+"""
+
+from __future__ import annotations
+
+
+class ElasticsearchTpuException(Exception):
+    """Base exception; carries an HTTP status code for the REST layer."""
+
+    status = 500
+
+    def __init__(self, message: str = "", **metadata):
+        super().__init__(message)
+        self.message = message
+        self.metadata = metadata
+
+    @property
+    def reason(self) -> str:
+        return self.message
+
+    def to_xcontent(self) -> dict:
+        out = {"type": self.error_type(), "reason": self.message}
+        out.update(self.metadata)
+        return out
+
+    @classmethod
+    def error_type(cls) -> str:
+        # CamelCase -> snake_case, drop trailing "Exception"
+        name = cls.__name__
+        if name.endswith("Exception"):
+            name = name[: -len("Exception")]
+        out = []
+        for i, ch in enumerate(name):
+            if ch.isupper() and i > 0:
+                out.append("_")
+            out.append(ch.lower())
+        return "".join(out) + "_exception"
+
+
+class IndexNotFoundException(ElasticsearchTpuException):
+    status = 404
+
+    def __init__(self, index: str):
+        super().__init__(f"no such index [{index}]", index=index)
+        self.index = index
+
+
+class ResourceAlreadyExistsException(ElasticsearchTpuException):
+    status = 400
+
+    def __init__(self, resource: str):
+        super().__init__(f"resource [{resource}] already exists", resource=resource)
+
+
+class ShardNotFoundException(ElasticsearchTpuException):
+    status = 404
+
+
+class DocumentMissingException(ElasticsearchTpuException):
+    status = 404
+
+    def __init__(self, index: str, doc_id: str):
+        super().__init__(f"[{doc_id}]: document missing", index=index)
+
+
+class VersionConflictEngineException(ElasticsearchTpuException):
+    """Optimistic-concurrency failure (ref: InternalEngine versioned plans,
+    index/engine/InternalEngine.java:831-910)."""
+
+    status = 409
+
+    def __init__(self, doc_id: str, message: str):
+        super().__init__(f"[{doc_id}]: version conflict, {message}")
+
+
+class MapperParsingException(ElasticsearchTpuException):
+    status = 400
+
+
+class StrictDynamicMappingException(MapperParsingException):
+    status = 400
+
+
+class QueryShardException(ElasticsearchTpuException):
+    status = 400
+
+
+class ParsingException(ElasticsearchTpuException):
+    status = 400
+
+
+class IllegalArgumentException(ElasticsearchTpuException):
+    status = 400
+
+
+class SearchContextMissingException(ElasticsearchTpuException):
+    status = 404
+
+    def __init__(self, context_id):
+        super().__init__(f"No search context found for id [{context_id}]")
+
+
+class CircuitBreakingException(ElasticsearchTpuException):
+    """Ref: common/breaker/CircuitBreaker.java — too-many-requests status."""
+
+    status = 429
+
+    def __init__(self, message: str, bytes_wanted: int = 0, bytes_limit: int = 0):
+        super().__init__(message, bytes_wanted=bytes_wanted, bytes_limit=bytes_limit)
+        self.bytes_wanted = bytes_wanted
+        self.bytes_limit = bytes_limit
+
+
+class EsRejectedExecutionException(ElasticsearchTpuException):
+    status = 429
+
+
+class TaskCancelledException(ElasticsearchTpuException):
+    status = 400
+
+
+class SettingsException(ElasticsearchTpuException):
+    status = 400
+
+
+class TranslogCorruptedException(ElasticsearchTpuException):
+    status = 500
+
+
+class EngineClosedException(ElasticsearchTpuException):
+    status = 500
+
+
+class NodeNotConnectedException(ElasticsearchTpuException):
+    status = 500
+
+
+class ScriptException(ElasticsearchTpuException):
+    status = 400

@@ -1,0 +1,358 @@
+"""Typed settings registry.
+
+Mirrors the reference's Setting<T> system (ref: common/settings/Setting.java,
+ClusterSettings.java, IndexScopedSettings.java): typed settings with scopes
+(node vs index), dynamic updatability, defaults that may depend on other
+settings, validators, and a flat-key Settings bag parsed from dicts / YAML-ish
+sources with `a.b.c` dotted keys.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, Iterable, Optional
+
+from elasticsearch_tpu.common.errors import SettingsException
+
+
+class Property:
+    NODE_SCOPE = "node_scope"
+    INDEX_SCOPE = "index_scope"
+    DYNAMIC = "dynamic"
+    FINAL = "final"
+    DEPRECATED = "deprecated"
+
+
+_TIME_RE = re.compile(r"^(-?\d+(?:\.\d+)?)(nanos|micros|ms|s|m|h|d)$")
+_BYTES_RE = re.compile(r"^(-?\d+(?:\.\d+)?)(b|kb|mb|gb|tb|pb)?$", re.IGNORECASE)
+
+_TIME_FACTORS = {
+    "nanos": 1e-9, "micros": 1e-6, "ms": 1e-3, "s": 1.0, "m": 60.0,
+    "h": 3600.0, "d": 86400.0,
+}
+_BYTE_FACTORS = {
+    None: 1, "b": 1, "kb": 1024, "mb": 1024 ** 2, "gb": 1024 ** 3,
+    "tb": 1024 ** 4, "pb": 1024 ** 5,
+}
+
+
+def parse_time_value(value: Any, key: str = "") -> float:
+    """'30s' / '500ms' / '1m' -> seconds (float). -1 passes through."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    if str(value).strip() == "-1":
+        return -1.0
+    m = _TIME_RE.match(str(value).strip())
+    if not m or float(m.group(1)) < 0:
+        raise SettingsException(
+            f"failed to parse setting [{key}] with value [{value}] as a time value"
+        )
+    return float(m.group(1)) * _TIME_FACTORS[m.group(2)]
+
+
+def parse_byte_size(value: Any, key: str = "") -> int:
+    """'512mb' / '1gb' / '100b' -> bytes (int). -1 passes through."""
+    if isinstance(value, (int, float)):
+        return int(value)
+    if str(value).strip() == "-1":
+        return -1
+    m = _BYTES_RE.match(str(value).strip())
+    if not m or float(m.group(1)) < 0:
+        raise SettingsException(
+            f"failed to parse setting [{key}] with value [{value}] as a byte size"
+        )
+    unit = m.group(2).lower() if m.group(2) else None
+    return int(float(m.group(1)) * _BYTE_FACTORS[unit])
+
+
+class Setting:
+    """A typed setting with a default, parser, scope and properties."""
+
+    def __init__(
+        self,
+        key: str,
+        default: Any,
+        parser: Callable[[Any], Any] = lambda x: x,
+        validator: Optional[Callable[[Any], None]] = None,
+        properties: Iterable[str] = (Property.NODE_SCOPE,),
+    ):
+        self.key = key
+        self._default = default
+        self.parser = parser
+        self.validator = validator
+        self.properties = frozenset(properties)
+
+    # -- constructors mirroring Setting.intSetting / boolSetting / etc. --
+    @classmethod
+    def int_setting(cls, key, default, min_value=None, max_value=None, properties=(Property.NODE_SCOPE,)):
+        def validate(v):
+            if min_value is not None and v < min_value:
+                raise SettingsException(f"failed to parse value [{v}] for setting [{key}] must be >= {min_value}")
+            if max_value is not None and v > max_value:
+                raise SettingsException(f"failed to parse value [{v}] for setting [{key}] must be <= {max_value}")
+        return cls(key, default, parser=int, validator=validate, properties=properties)
+
+    @classmethod
+    def float_setting(cls, key, default, min_value=None, properties=(Property.NODE_SCOPE,)):
+        def validate(v):
+            if min_value is not None and v < min_value:
+                raise SettingsException(f"failed to parse value [{v}] for setting [{key}] must be >= {min_value}")
+        return cls(key, default, parser=float, validator=validate, properties=properties)
+
+    @classmethod
+    def bool_setting(cls, key, default, properties=(Property.NODE_SCOPE,)):
+        def parse(v):
+            if isinstance(v, bool):
+                return v
+            s = str(v).lower()
+            if s in ("true", "1"):
+                return True
+            if s in ("false", "0"):
+                return False
+            raise SettingsException(f"Failed to parse value [{v}] as only [true] or [false] are allowed.")
+        return cls(key, default, parser=parse, properties=properties)
+
+    @classmethod
+    def str_setting(cls, key, default, properties=(Property.NODE_SCOPE,)):
+        return cls(key, default, parser=str, properties=properties)
+
+    @classmethod
+    def time_setting(cls, key, default, properties=(Property.NODE_SCOPE,)):
+        return cls(key, default, parser=lambda v: parse_time_value(v, key), properties=properties)
+
+    @classmethod
+    def byte_size_setting(cls, key, default, properties=(Property.NODE_SCOPE,)):
+        return cls(key, default, parser=lambda v: parse_byte_size(v, key), properties=properties)
+
+    @classmethod
+    def list_setting(cls, key, default=(), properties=(Property.NODE_SCOPE,)):
+        def parse(v):
+            if isinstance(v, str):
+                return [s.strip() for s in v.split(",") if s.strip()]
+            return list(v)
+        return cls(key, list(default), parser=parse, properties=properties)
+
+    def default(self, settings: "Settings") -> Any:
+        raw = self._default(settings) if callable(self._default) else self._default
+        # defaults go through the same parse path as explicit values so that
+        # e.g. time_setting('t', '30s') yields 30.0 whether set or defaulted
+        if raw is None:
+            return None
+        return self._parse(raw)
+
+    def _parse(self, raw: Any) -> Any:
+        try:
+            value = self.parser(raw)
+        except SettingsException:
+            raise
+        except (ValueError, TypeError) as e:
+            raise SettingsException(
+                f"failed to parse setting [{self.key}] with value [{raw}]: {e}")
+        if self.validator:
+            self.validator(value)
+        return value
+
+    def get(self, settings: "Settings") -> Any:
+        raw = settings.get(self.key)
+        if raw is None:
+            return self.default(settings)
+        return self._parse(raw)
+
+    def exists(self, settings: "Settings") -> bool:
+        return settings.get(self.key) is not None
+
+    @property
+    def is_dynamic(self) -> bool:
+        return Property.DYNAMIC in self.properties
+
+    @property
+    def is_final(self) -> bool:
+        return Property.FINAL in self.properties
+
+
+def _flatten(prefix: str, obj: Any, out: Dict[str, Any]):
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _flatten(f"{prefix}.{k}" if prefix else str(k), v, out)
+    else:
+        out[prefix] = obj
+
+
+class Settings:
+    """Immutable flat-key settings bag (ref: common/settings/Settings.java)."""
+
+    EMPTY: "Settings"
+
+    def __init__(self, flat: Optional[Dict[str, Any]] = None):
+        self._flat: Dict[str, Any] = dict(flat or {})
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Settings":
+        """Accepts nested or dotted-key dicts (or a mix)."""
+        flat: Dict[str, Any] = {}
+        _flatten("", d, flat)
+        return cls(flat)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._flat.get(key, default)
+
+    def keys(self):
+        return self._flat.keys()
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self._flat)
+
+    def as_nested_dict(self) -> Dict[str, Any]:
+        root: Dict[str, Any] = {}
+        for key in sorted(self._flat):
+            parts = key.split(".")
+            node = root
+            ok = True
+            for p in parts[:-1]:
+                nxt = node.setdefault(p, {})
+                if not isinstance(nxt, dict):
+                    ok = False
+                    break
+                node = nxt
+            if ok and isinstance(node, dict):
+                node[parts[-1]] = self._flat[key]
+            else:
+                root[key] = self._flat[key]
+        return root
+
+    def by_prefix(self, prefix: str) -> "Settings":
+        if prefix and not prefix.endswith("."):
+            prefix += "."
+        return Settings({
+            k[len(prefix):]: v for k, v in self._flat.items() if k.startswith(prefix)
+        })
+
+    def groups(self, prefix: str) -> Dict[str, "Settings"]:
+        """settings under `prefix` grouped by the next key path element
+        (ref: Settings.getGroups — used by analysis registry)."""
+        if prefix and not prefix.endswith("."):
+            prefix += "."
+        out: Dict[str, Dict[str, Any]] = {}
+        for k, v in self._flat.items():
+            if not k.startswith(prefix):
+                continue
+            rest = k[len(prefix):]
+            name, _, sub = rest.partition(".")
+            out.setdefault(name, {})[sub or name] = v
+        return {name: Settings(flat) for name, flat in out.items()}
+
+    def merge(self, other: "Settings") -> "Settings":
+        flat = dict(self._flat)
+        flat.update(other._flat)
+        return Settings(flat)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._flat
+
+    def __len__(self):
+        return len(self._flat)
+
+    def __repr__(self):
+        return f"Settings({self._flat!r})"
+
+
+Settings.EMPTY = Settings()
+
+
+class AbstractScopedSettings:
+    """Registry of known settings for one scope + dynamic-update application
+    (ref: common/settings/AbstractScopedSettings.java)."""
+
+    def __init__(self, settings: Settings, registered: Iterable[Setting], scope: str):
+        self.scope = scope
+        self.settings = settings
+        self._registered: Dict[str, Setting] = {}
+        self._update_listeners: Dict[str, list] = {}
+        for s in registered:
+            self.register(s)
+
+    def register(self, setting: Setting):
+        if setting.key in self._registered:
+            raise SettingsException(f"duplicate setting [{setting.key}]")
+        self._registered[setting.key] = setting
+
+    def get_setting(self, key: str) -> Optional[Setting]:
+        return self._registered.get(key)
+
+    def get(self, setting: Setting):
+        return setting.get(self.settings)
+
+    def validate(self, settings: Settings, ignore_unknown: bool = False):
+        for key in settings.keys():
+            reg = self._registered.get(key)
+            if reg is None:
+                if not ignore_unknown:
+                    raise SettingsException(f"unknown setting [{key}]")
+                continue
+            reg.get(settings)  # parse+validate
+
+    def add_settings_update_consumer(self, setting: Setting, consumer: Callable[[Any], None]):
+        if not setting.is_dynamic:
+            raise SettingsException(f"setting [{setting.key}] is not dynamic")
+        self._update_listeners.setdefault(setting.key, []).append(consumer)
+
+    def apply_settings(self, updates: Settings) -> Settings:
+        """Apply dynamic updates; returns new effective settings.
+
+        Parse + validate everything before merging or notifying, so a bad
+        value can't corrupt the effective settings or half-fire listeners
+        (ref: AbstractScopedSettings validates before applying).
+        """
+        parsed = {}
+        for key in updates.keys():
+            reg = self._registered.get(key)
+            if reg is None:
+                raise SettingsException(f"unknown setting [{key}]")
+            if not reg.is_dynamic:
+                raise SettingsException(f"final {self.scope} setting [{key}], not updateable")
+            parsed[key] = reg.get(updates)
+        self.settings = self.settings.merge(updates)
+        for key, value in parsed.items():
+            for listener in self._update_listeners.get(key, []):
+                listener(value)
+        return self.settings
+
+
+class ClusterSettings(AbstractScopedSettings):
+    def __init__(self, settings: Settings, registered: Iterable[Setting]):
+        super().__init__(settings, registered, scope="cluster")
+
+
+class IndexScopedSettings(AbstractScopedSettings):
+    def __init__(self, settings: Settings, registered: Iterable[Setting]):
+        super().__init__(settings, registered, scope="index")
+
+
+# ---------------------------------------------------------------------------
+# Built-in index-scoped settings (ref: IndexMetadata / IndexSettings constants)
+# ---------------------------------------------------------------------------
+
+INDEX_NUMBER_OF_SHARDS = Setting.int_setting(
+    "index.number_of_shards", 1, min_value=1, max_value=1024,
+    properties=(Property.INDEX_SCOPE, Property.FINAL))
+INDEX_NUMBER_OF_REPLICAS = Setting.int_setting(
+    "index.number_of_replicas", 1, min_value=0,
+    properties=(Property.INDEX_SCOPE, Property.DYNAMIC))
+INDEX_REFRESH_INTERVAL = Setting.time_setting(
+    "index.refresh_interval", 1.0, properties=(Property.INDEX_SCOPE, Property.DYNAMIC))
+INDEX_MAX_RESULT_WINDOW = Setting.int_setting(
+    "index.max_result_window", 10000, min_value=1,
+    properties=(Property.INDEX_SCOPE, Property.DYNAMIC))
+INDEX_BM25_K1 = Setting.float_setting(
+    "index.similarity.default.k1", 1.2, properties=(Property.INDEX_SCOPE,))
+INDEX_BM25_B = Setting.float_setting(
+    "index.similarity.default.b", 0.75, properties=(Property.INDEX_SCOPE,))
+
+BUILT_IN_INDEX_SETTINGS = [
+    INDEX_NUMBER_OF_SHARDS,
+    INDEX_NUMBER_OF_REPLICAS,
+    INDEX_REFRESH_INTERVAL,
+    INDEX_MAX_RESULT_WINDOW,
+    INDEX_BM25_K1,
+    INDEX_BM25_B,
+]

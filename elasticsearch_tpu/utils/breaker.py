@@ -1,0 +1,141 @@
+"""Circuit breakers: hierarchical memory accounting.
+
+Mirrors the reference's hierarchical circuit-breaker service (ref:
+indices/breaker/HierarchyCircuitBreakerService.java, common/breaker/
+ChildMemoryCircuitBreaker.java): child breakers (request, fielddata,
+in_flight_requests) each with their own limit, plus a parent limit over the
+sum. On TPU the accounted resource is host staging memory headed for HBM.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from elasticsearch_tpu.common.errors import CircuitBreakingException
+
+
+def _human_size(n: int) -> str:
+    for unit in ("b", "kb", "mb", "gb", "tb"):
+        if abs(n) < 1024 or unit == "tb":
+            return f"{n:.1f}{unit}" if unit != "b" else f"{n}b"
+        n /= 1024
+    return f"{n}b"
+
+
+class CircuitBreaker:
+    PARENT = "parent"
+    REQUEST = "request"
+    FIELDDATA = "fielddata"
+    IN_FLIGHT_REQUESTS = "in_flight_requests"
+
+    def __init__(self, name: str, limit_bytes: int, overhead: float = 1.0,
+                 parent: "HierarchyCircuitBreakerService" = None):
+        self.name = name
+        self.limit = limit_bytes
+        self.overhead = overhead
+        self._used = 0
+        self._trip_count = 0
+        self._lock = threading.Lock()
+        self._parent = parent
+
+    @property
+    def used(self) -> int:
+        return self._used
+
+    @property
+    def trip_count(self) -> int:
+        return self._trip_count
+
+    def add_estimate_bytes_and_maybe_break(self, bytes_: int, label: str = "") -> int:
+        with self._lock:
+            new_used = self._used + bytes_
+            if self.limit >= 0 and new_used * self.overhead > self.limit:
+                self._trip_count += 1
+                raise CircuitBreakingException(
+                    f"[{self.name}] Data too large, data for [{label}] would be "
+                    f"[{_human_size(new_used)}/{new_used}b], which is larger than "
+                    f"the limit of [{_human_size(self.limit)}/{self.limit}b]",
+                    bytes_wanted=new_used, bytes_limit=self.limit)
+            self._used = new_used
+        if self._parent is not None:
+            try:
+                self._parent.check_parent_limit(label)
+            except CircuitBreakingException:
+                with self._lock:
+                    self._used -= bytes_
+                raise
+        return self._used
+
+    def add_without_breaking(self, bytes_: int) -> int:
+        with self._lock:
+            self._used += bytes_
+            return self._used
+
+    def release(self, bytes_: int):
+        self.add_without_breaking(-bytes_)
+
+
+class NoneCircuitBreaker(CircuitBreaker):
+    """Never breaks (ref: common/breaker/NoopCircuitBreaker.java)."""
+
+    def __init__(self, name: str = "noop"):
+        super().__init__(name, limit_bytes=-1)
+
+
+class HierarchyCircuitBreakerService:
+    """Parent limit across child breakers (ref:
+    indices/breaker/HierarchyCircuitBreakerService.java)."""
+
+    def __init__(self, total_limit_bytes: int = 4 * 1024 ** 3,
+                 request_limit_bytes: int = None,
+                 fielddata_limit_bytes: int = None):
+        self.total_limit = total_limit_bytes
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._parent_trip_count = 0
+        if request_limit_bytes is None:
+            request_limit_bytes = int(total_limit_bytes * 0.6)
+        if fielddata_limit_bytes is None:
+            fielddata_limit_bytes = int(total_limit_bytes * 0.4)
+        for name, limit in [
+            (CircuitBreaker.REQUEST, request_limit_bytes),
+            (CircuitBreaker.FIELDDATA, fielddata_limit_bytes),
+            (CircuitBreaker.IN_FLIGHT_REQUESTS, total_limit_bytes),
+        ]:
+            self._breakers[name] = CircuitBreaker(name, limit, parent=self)
+
+    def get_breaker(self, name: str) -> CircuitBreaker:
+        return self._breakers[name]
+
+    def check_parent_limit(self, label: str):
+        total = sum(b.used for b in self._breakers.values())
+        if total > self.total_limit:
+            self._parent_trip_count += 1
+            raise CircuitBreakingException(
+                f"[parent] Data too large, data for [{label}] would be [{total}b], "
+                f"which is larger than the limit of [{self.total_limit}b]",
+                bytes_wanted=total, bytes_limit=self.total_limit)
+
+    def stats(self) -> dict:
+        return {
+            "parent": {"limit_size_in_bytes": self.total_limit,
+                       "estimated_size_in_bytes": sum(b.used for b in self._breakers.values()),
+                       "tripped": self._parent_trip_count},
+            **{name: {"limit_size_in_bytes": b.limit,
+                      "estimated_size_in_bytes": b.used,
+                      "tripped": b.trip_count}
+               for name, b in self._breakers.items()},
+        }
+
+
+class NoneCircuitBreakerService(HierarchyCircuitBreakerService):
+    def __init__(self):
+        super().__init__(total_limit_bytes=-1)
+        self._breakers = {
+            name: NoneCircuitBreaker(name)
+            for name in (CircuitBreaker.REQUEST, CircuitBreaker.FIELDDATA,
+                         CircuitBreaker.IN_FLIGHT_REQUESTS)
+        }
+
+    def check_parent_limit(self, label: str):
+        pass

@@ -1,0 +1,25 @@
+"""Test harness configuration.
+
+Tests run JAX on a virtual 8-device CPU mesh (mirrors the reference's
+InternalTestCluster strategy of booting multiple nodes in one JVM, ref:
+test/framework/.../InternalTestCluster.java): sharding/collective code is
+exercised without TPU hardware. Must set env vars before jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

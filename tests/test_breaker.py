@@ -1,0 +1,51 @@
+"""Circuit breaker + BigArrays accounting tests (model: the reference's
+MockBigArrays assert-all-released discipline, SURVEY.md §5.2)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import CircuitBreakingException
+from elasticsearch_tpu.utils.bigarrays import BigArrays
+from elasticsearch_tpu.utils.breaker import (
+    CircuitBreaker,
+    HierarchyCircuitBreakerService,
+)
+
+
+def test_child_breaker_trips():
+    svc = HierarchyCircuitBreakerService(total_limit_bytes=1000, request_limit_bytes=100)
+    br = svc.get_breaker(CircuitBreaker.REQUEST)
+    br.add_estimate_bytes_and_maybe_break(80, "a")
+    with pytest.raises(CircuitBreakingException):
+        br.add_estimate_bytes_and_maybe_break(50, "b")
+    # failed reservation must not leak accounting
+    assert br.used == 80
+    assert br.trip_count == 1
+
+
+def test_parent_breaker_trips_across_children():
+    svc = HierarchyCircuitBreakerService(
+        total_limit_bytes=150, request_limit_bytes=100, fielddata_limit_bytes=100)
+    svc.get_breaker(CircuitBreaker.REQUEST).add_estimate_bytes_and_maybe_break(90, "r")
+    with pytest.raises(CircuitBreakingException):
+        svc.get_breaker(CircuitBreaker.FIELDDATA).add_estimate_bytes_and_maybe_break(90, "f")
+    # the child that tripped the parent must roll back its reservation
+    assert svc.get_breaker(CircuitBreaker.FIELDDATA).used == 0
+
+
+def test_bigarrays_accounts_and_releases():
+    svc = HierarchyCircuitBreakerService(total_limit_bytes=10_000, request_limit_bytes=5000)
+    ba = BigArrays(svc)
+    br = svc.get_breaker(CircuitBreaker.REQUEST)
+    with ba.new_array((10, 10), np.float32, "scores") as acc:
+        assert acc.array.shape == (10, 10)
+        assert br.used == 400
+    assert br.used == 0
+
+
+def test_bigarrays_breaks_on_huge_alloc():
+    svc = HierarchyCircuitBreakerService(total_limit_bytes=1000, request_limit_bytes=500)
+    ba = BigArrays(svc)
+    with pytest.raises(CircuitBreakingException):
+        ba.new_array((1000,), np.float64, "huge")
+    assert svc.get_breaker(CircuitBreaker.REQUEST).used == 0

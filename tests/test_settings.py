@@ -1,0 +1,85 @@
+"""Settings registry tests (model: the reference's SettingTests/SettingsTests)."""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import SettingsException
+from elasticsearch_tpu.common.settings import (
+    ClusterSettings,
+    Property,
+    Setting,
+    Settings,
+    parse_byte_size,
+    parse_time_value,
+)
+
+
+def test_flatten_nested():
+    s = Settings.from_dict({"index": {"number_of_shards": 3, "refresh_interval": "5s"}})
+    assert s.get("index.number_of_shards") == 3
+    assert s.get("index.refresh_interval") == "5s"
+
+
+def test_nested_roundtrip():
+    s = Settings.from_dict({"a": {"b": 1, "c": {"d": "x"}}})
+    assert s.as_nested_dict() == {"a": {"b": 1, "c": {"d": "x"}}}
+
+
+def test_typed_settings():
+    s = Settings.from_dict({"n": "5", "f": "1.5", "b": "true", "t": "30s", "sz": "2kb"})
+    assert Setting.int_setting("n", 1).get(s) == 5
+    assert Setting.float_setting("f", 0.0).get(s) == 1.5
+    assert Setting.bool_setting("b", False).get(s) is True
+    assert Setting.time_setting("t", 0.0).get(s) == 30.0
+    assert Setting.byte_size_setting("sz", 0).get(s) == 2048
+
+
+def test_defaults_and_callable_default():
+    s = Settings.EMPTY
+    assert Setting.int_setting("x", 7).get(s) == 7
+    base = Setting.int_setting("base", 4)
+    derived = Setting("derived", lambda st: base.get(st) * 2, parser=int)
+    assert derived.get(Settings.EMPTY) == 8
+    assert derived.get(Settings.from_dict({"base": 10})) == 20
+
+
+def test_validation_bounds():
+    s = Settings.from_dict({"x": "0"})
+    with pytest.raises(SettingsException):
+        Setting.int_setting("x", 1, min_value=1).get(s)
+
+
+def test_time_and_bytes_parsing():
+    assert parse_time_value("500ms") == 0.5
+    assert parse_time_value("2m") == 120.0
+    assert parse_time_value(-1) == -1
+    assert parse_byte_size("1gb") == 1024 ** 3
+    assert parse_byte_size("100") == 100
+    with pytest.raises(SettingsException):
+        parse_time_value("5 parsecs")
+
+
+def test_dynamic_update_listener():
+    dyn = Setting.int_setting("i.dyn", 1, properties=(Property.NODE_SCOPE, Property.DYNAMIC))
+    fin = Setting.int_setting("i.fin", 1)
+    cs = ClusterSettings(Settings.EMPTY, [dyn, fin])
+    seen = []
+    cs.add_settings_update_consumer(dyn, seen.append)
+    cs.apply_settings(Settings.from_dict({"i.dyn": 9}))
+    assert seen == [9]
+    assert cs.get(dyn) == 9
+    with pytest.raises(SettingsException):
+        cs.apply_settings(Settings.from_dict({"i.fin": 2}))
+    with pytest.raises(SettingsException):
+        cs.apply_settings(Settings.from_dict({"unknown.key": 2}))
+
+
+def test_groups():
+    s = Settings.from_dict({
+        "analysis.analyzer.my.type": "custom",
+        "analysis.analyzer.my.tokenizer": "standard",
+        "analysis.analyzer.other.type": "standard",
+    })
+    groups = s.groups("analysis.analyzer")
+    assert set(groups) == {"my", "other"}
+    assert groups["my"].get("type") == "custom"
+    assert groups["my"].get("tokenizer") == "standard"
