@@ -1,0 +1,6 @@
+from elasticsearch_tpu.analysis.analyzers import (  # noqa: F401
+    Analyzer,
+    AnalysisRegistry,
+    CustomAnalyzer,
+    Token,
+)

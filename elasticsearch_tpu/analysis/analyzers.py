@@ -1,0 +1,224 @@
+"""Analyzers and the analysis registry.
+
+Mirrors the reference's AnalysisRegistry (ref: index/analysis/
+AnalysisRegistry.java:57,179): per-index analyzer chains built from settings
+— char filters → tokenizer → token filters — with a set of prebuilt analyzers
+(standard, simple, whitespace, stop, keyword, english) matching the
+reference's defaults.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from elasticsearch_tpu.analysis.filters import (
+    AsciiFoldingFilter,
+    CharFilter,
+    EdgeNGramFilter,
+    HtmlStripCharFilter,
+    LengthFilter,
+    LowercaseFilter,
+    MappingCharFilter,
+    PatternReplaceCharFilter,
+    PorterStemFilter,
+    ReverseFilter,
+    ShingleFilter,
+    StopFilter,
+    TokenFilter,
+    TrimFilter,
+    TruncateFilter,
+    UniqueFilter,
+    UppercaseFilter,
+)
+from elasticsearch_tpu.analysis.tokenizers import (
+    EdgeNGramTokenizer,
+    KeywordTokenizer,
+    LetterTokenizer,
+    NGramTokenizer,
+    PatternTokenizer,
+    StandardTokenizer,
+    Token,
+    Tokenizer,
+    WhitespaceTokenizer,
+)
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.common.settings import Settings
+
+
+class Analyzer:
+    name = "?"
+
+    def analyze(self, text: str) -> List[Token]:
+        raise NotImplementedError
+
+    def terms(self, text: str) -> List[str]:
+        return [t.term for t in self.analyze(text)]
+
+
+class CustomAnalyzer(Analyzer):
+    def __init__(self, name: str, tokenizer: Tokenizer,
+                 token_filters: Optional[List[TokenFilter]] = None,
+                 char_filters: Optional[List[CharFilter]] = None):
+        self.name = name
+        self.tokenizer = tokenizer
+        self.token_filters = token_filters or []
+        self.char_filters = char_filters or []
+
+    def analyze(self, text: str) -> List[Token]:
+        for cf in self.char_filters:
+            text = cf.apply(text)
+        tokens = self.tokenizer.tokenize(text)
+        for tf in self.token_filters:
+            tokens = tf.filter(tokens)
+        return tokens
+
+
+def _prebuilt_analyzers() -> Dict[str, Analyzer]:
+    return {
+        # ref: Lucene StandardAnalyzer — ES default has NO stopwords
+        "standard": CustomAnalyzer("standard", StandardTokenizer(), [LowercaseFilter()]),
+        "simple": CustomAnalyzer("simple", LetterTokenizer(), [LowercaseFilter()]),
+        "whitespace": CustomAnalyzer("whitespace", WhitespaceTokenizer()),
+        "stop": CustomAnalyzer("stop", LetterTokenizer(), [LowercaseFilter(), StopFilter()]),
+        "keyword": CustomAnalyzer("keyword", KeywordTokenizer()),
+        # ref: EnglishAnalyzer (stop + porter; possessive stripping folded into
+        # the standard tokenizer's handling here)
+        "english": CustomAnalyzer("english", StandardTokenizer(),
+                                  [LowercaseFilter(), StopFilter(), PorterStemFilter()]),
+    }
+
+
+def _parse_stopwords(value):
+    """None/'_english_' -> default list; str -> comma-split; list -> set."""
+    if value in (None, "_english_"):
+        return None
+    if isinstance(value, str):
+        return {w.strip() for w in value.split(",") if w.strip()}
+    return set(value)
+
+
+_TOKENIZERS = {
+    "standard": lambda s: StandardTokenizer(int(s.get("max_token_length", 255))),
+    "whitespace": lambda s: WhitespaceTokenizer(),
+    "keyword": lambda s: KeywordTokenizer(),
+    "letter": lambda s: LetterTokenizer(),
+    "pattern": lambda s: PatternTokenizer(s.get("pattern", r"\W+")),
+    "ngram": lambda s: NGramTokenizer(int(s.get("min_gram", 1)), int(s.get("max_gram", 2))),
+    "edge_ngram": lambda s: EdgeNGramTokenizer(int(s.get("min_gram", 1)), int(s.get("max_gram", 2))),
+}
+
+_TOKEN_FILTERS = {
+    "lowercase": lambda s: LowercaseFilter(),
+    "uppercase": lambda s: UppercaseFilter(),
+    "stop": lambda s: StopFilter(_parse_stopwords(s.get("stopwords"))),
+    "asciifolding": lambda s: AsciiFoldingFilter(),
+    "length": lambda s: LengthFilter(int(s.get("min", 0)), int(s.get("max", 2 ** 31 - 1))),
+    "trim": lambda s: TrimFilter(),
+    "truncate": lambda s: TruncateFilter(int(s.get("length", 10))),
+    "unique": lambda s: UniqueFilter(),
+    "reverse": lambda s: ReverseFilter(),
+    "edge_ngram": lambda s: EdgeNGramFilter(int(s.get("min_gram", 1)), int(s.get("max_gram", 2))),
+    "shingle": lambda s: ShingleFilter(
+        int(s.get("min_shingle_size", 2)), int(s.get("max_shingle_size", 2)),
+        s.get("output_unigrams", True) in (True, "true")),
+    "porter_stem": lambda s: PorterStemFilter(),
+    "stemmer": lambda s: PorterStemFilter(),  # `english` language default
+}
+
+_CHAR_FILTERS = {
+    "html_strip": lambda s: HtmlStripCharFilter(),
+    "mapping": lambda s: MappingCharFilter(
+        {src.strip(): dst.strip()
+         for src, _, dst in (m.partition("=>") for m in (s.get("mappings") or []))}),
+    "pattern_replace": lambda s: PatternReplaceCharFilter(
+        s.get("pattern", ""), s.get("replacement", "")),
+}
+
+
+class AnalysisRegistry:
+    """Builds per-index analyzers from index settings.
+
+    Settings shape mirrors the reference, e.g.::
+
+        index.analysis.analyzer.my_analyzer.type: custom
+        index.analysis.analyzer.my_analyzer.tokenizer: standard
+        index.analysis.analyzer.my_analyzer.filter: [lowercase, stop]
+        index.analysis.filter.my_stop.type: stop
+        index.analysis.filter.my_stop.stopwords: [foo, bar]
+    """
+
+    def __init__(self, index_settings: Settings = Settings.EMPTY):
+        self._analyzers: Dict[str, Analyzer] = _prebuilt_analyzers()
+        self._build_custom(index_settings)
+
+    def _named_components(self, settings: Settings, group: str, registry: dict):
+        out = {}
+        for name, conf in settings.groups(f"index.analysis.{group}").items():
+            type_ = conf.get("type", name)
+            factory = registry.get(type_)
+            if factory is None:
+                raise IllegalArgumentException(
+                    f"Unknown {group} type [{type_}] for [{name}]")
+            out[name] = factory(conf)
+        return out
+
+    def _build_custom(self, settings: Settings):
+        custom_tokenizers = self._named_components(settings, "tokenizer", _TOKENIZERS)
+        custom_filters = self._named_components(settings, "filter", _TOKEN_FILTERS)
+        custom_char_filters = self._named_components(settings, "char_filter", _CHAR_FILTERS)
+
+        for name, conf in settings.groups("index.analysis.analyzer").items():
+            type_ = conf.get("type", "custom")
+            if type_ != "custom":
+                if type_ not in self._analyzers:
+                    raise IllegalArgumentException(f"Unknown analyzer type [{type_}] for [{name}]")
+                self._analyzers[name] = self._analyzers[type_]
+                continue
+            tok_name = conf.get("tokenizer", "standard")
+            tokenizer = custom_tokenizers.get(tok_name)
+            if tokenizer is None:
+                factory = _TOKENIZERS.get(tok_name)
+                if factory is None:
+                    raise IllegalArgumentException(
+                        f"analyzer [{name}] must specify a known tokenizer, got [{tok_name}]")
+                tokenizer = factory(Settings.EMPTY)
+            filters = []
+            filter_names = conf.get("filter", [])
+            if isinstance(filter_names, str):
+                filter_names = [f.strip() for f in filter_names.split(",")]
+            for fname in filter_names:
+                f = custom_filters.get(fname)
+                if f is None:
+                    factory = _TOKEN_FILTERS.get(fname)
+                    if factory is None:
+                        raise IllegalArgumentException(
+                            f"analyzer [{name}]: unknown token filter [{fname}]")
+                    f = factory(Settings.EMPTY)
+                filters.append(f)
+            char_filters = []
+            cf_names = conf.get("char_filter", [])
+            if isinstance(cf_names, str):
+                cf_names = [f.strip() for f in cf_names.split(",")]
+            for cname in cf_names:
+                cf = custom_char_filters.get(cname)
+                if cf is None:
+                    factory = _CHAR_FILTERS.get(cname)
+                    if factory is None:
+                        raise IllegalArgumentException(
+                            f"analyzer [{name}]: unknown char filter [{cname}]")
+                    cf = factory(Settings.EMPTY)
+                char_filters.append(cf)
+            self._analyzers[name] = CustomAnalyzer(name, tokenizer, filters, char_filters)
+
+    def get(self, name: str) -> Analyzer:
+        analyzer = self._analyzers.get(name)
+        if analyzer is None:
+            raise IllegalArgumentException(f"failed to find analyzer [{name}]")
+        return analyzer
+
+    def has(self, name: str) -> bool:
+        return name in self._analyzers
+
+    @property
+    def default(self) -> Analyzer:
+        return self._analyzers.get("default", self._analyzers["standard"])

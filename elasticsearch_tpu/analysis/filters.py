@@ -1,0 +1,339 @@
+"""Token filters and char filters.
+
+Mirrors the reference's analysis-common filter set (ref:
+modules/analysis-common/.../CommonAnalysisPlugin.java). Filters transform a
+token stream; char filters transform raw text before tokenization.
+"""
+
+from __future__ import annotations
+
+import re
+import unicodedata
+from typing import List, Optional, Set
+
+from elasticsearch_tpu.analysis.tokenizers import Token
+
+# Lucene's EnglishAnalyzer.ENGLISH_STOP_WORDS_SET — the `_english_` stopword
+# list the reference's `stop` filter defaults to.
+ENGLISH_STOP_WORDS: Set[str] = {
+    "a", "an", "and", "are", "as", "at", "be", "but", "by", "for", "if", "in",
+    "into", "is", "it", "no", "not", "of", "on", "or", "such", "that", "the",
+    "their", "then", "there", "these", "they", "this", "to", "was", "will",
+    "with",
+}
+
+
+class TokenFilter:
+    name = "?"
+
+    def filter(self, tokens: List[Token]) -> List[Token]:
+        raise NotImplementedError
+
+
+class LowercaseFilter(TokenFilter):
+    name = "lowercase"
+
+    def filter(self, tokens):
+        return [Token(t.term.lower(), t.position, t.start_offset, t.end_offset)
+                for t in tokens]
+
+
+class UppercaseFilter(TokenFilter):
+    name = "uppercase"
+
+    def filter(self, tokens):
+        return [Token(t.term.upper(), t.position, t.start_offset, t.end_offset)
+                for t in tokens]
+
+
+class StopFilter(TokenFilter):
+    """Removes stopwords; preserves position increments (gaps stay in the
+    position numbering, as Lucene's StopFilter does by default)."""
+
+    name = "stop"
+
+    def __init__(self, stopwords: Optional[Set[str]] = None):
+        self.stopwords = ENGLISH_STOP_WORDS if stopwords is None else set(stopwords)
+
+    def filter(self, tokens):
+        return [t for t in tokens if t.term not in self.stopwords]
+
+
+class AsciiFoldingFilter(TokenFilter):
+    name = "asciifolding"
+
+    def filter(self, tokens):
+        out = []
+        for t in tokens:
+            folded = unicodedata.normalize("NFKD", t.term)
+            folded = "".join(c for c in folded if not unicodedata.combining(c))
+            out.append(Token(folded, t.position, t.start_offset, t.end_offset))
+        return out
+
+
+class LengthFilter(TokenFilter):
+    name = "length"
+
+    def __init__(self, min_length: int = 0, max_length: int = 2 ** 31 - 1):
+        self.min = min_length
+        self.max = max_length
+
+    def filter(self, tokens):
+        return [t for t in tokens if self.min <= len(t.term) <= self.max]
+
+
+class TrimFilter(TokenFilter):
+    name = "trim"
+
+    def filter(self, tokens):
+        return [Token(t.term.strip(), t.position, t.start_offset, t.end_offset)
+                for t in tokens]
+
+
+class TruncateFilter(TokenFilter):
+    name = "truncate"
+
+    def __init__(self, length: int = 10):
+        self.length = length
+
+    def filter(self, tokens):
+        return [Token(t.term[: self.length], t.position, t.start_offset, t.end_offset)
+                for t in tokens]
+
+
+class UniqueFilter(TokenFilter):
+    name = "unique"
+
+    def filter(self, tokens):
+        seen = set()
+        out = []
+        for t in tokens:
+            if t.term not in seen:
+                seen.add(t.term)
+                out.append(t)
+        return out
+
+
+class ReverseFilter(TokenFilter):
+    name = "reverse"
+
+    def filter(self, tokens):
+        return [Token(t.term[::-1], t.position, t.start_offset, t.end_offset)
+                for t in tokens]
+
+
+class EdgeNGramFilter(TokenFilter):
+    name = "edge_ngram"
+
+    def __init__(self, min_gram: int = 1, max_gram: int = 2):
+        self.min_gram = min_gram
+        self.max_gram = max_gram
+
+    def filter(self, tokens):
+        out = []
+        for t in tokens:
+            for size in range(self.min_gram, self.max_gram + 1):
+                if size > len(t.term):
+                    break
+                out.append(Token(t.term[:size], t.position, t.start_offset, t.end_offset))
+        return out
+
+
+class ShingleFilter(TokenFilter):
+    """Word n-grams (ref: ShingleTokenFilterFactory; used by phrase suggester)."""
+
+    name = "shingle"
+
+    def __init__(self, min_shingle_size: int = 2, max_shingle_size: int = 2,
+                 output_unigrams: bool = True, token_separator: str = " "):
+        self.min_size = min_shingle_size
+        self.max_size = max_shingle_size
+        self.output_unigrams = output_unigrams
+        self.sep = token_separator
+
+    def filter(self, tokens):
+        out = []
+        for i, t in enumerate(tokens):
+            if self.output_unigrams:
+                out.append(t)
+            for size in range(self.min_size, self.max_size + 1):
+                if i + size > len(tokens):
+                    break
+                window = tokens[i : i + size]
+                out.append(Token(self.sep.join(w.term for w in window),
+                                 t.position, t.start_offset, window[-1].end_offset))
+        return out
+
+
+class PorterStemFilter(TokenFilter):
+    """Porter stemming algorithm (ref: Lucene PorterStemFilter, the `stemmer`
+    filter's default `english` language). Classic Porter (1980) rules."""
+
+    name = "porter_stem"
+
+    _VOWELS = "aeiou"
+
+    def _cons(self, w: str, i: int) -> bool:
+        ch = w[i]
+        if ch in self._VOWELS:
+            return False
+        if ch == "y":
+            return i == 0 or not self._cons(w, i - 1)
+        return True
+
+    def _measure(self, stem: str) -> int:
+        """Number of VC sequences."""
+        m = 0
+        prev_vowel = False
+        for i in range(len(stem)):
+            is_v = not self._cons(stem, i)
+            if prev_vowel and not is_v:
+                m += 1
+            prev_vowel = is_v
+        return m
+
+    def _has_vowel(self, stem: str) -> bool:
+        return any(not self._cons(stem, i) for i in range(len(stem)))
+
+    def _ends_double_cons(self, w: str) -> bool:
+        return len(w) >= 2 and w[-1] == w[-2] and self._cons(w, len(w) - 1)
+
+    def _cvc(self, w: str) -> bool:
+        if len(w) < 3:
+            return False
+        return (self._cons(w, len(w) - 3) and not self._cons(w, len(w) - 2)
+                and self._cons(w, len(w) - 1) and w[-1] not in "wxy")
+
+    def _stem(self, w: str) -> str:
+        if len(w) <= 2:
+            return w
+        # step 1a
+        if w.endswith("sses"):
+            w = w[:-2]
+        elif w.endswith("ies"):
+            w = w[:-2]
+        elif w.endswith("ss"):
+            pass
+        elif w.endswith("s"):
+            w = w[:-1]
+        # step 1b
+        if w.endswith("eed"):
+            if self._measure(w[:-3]) > 0:
+                w = w[:-1]
+        elif w.endswith("ed") and self._has_vowel(w[:-2]):
+            w = w[:-2]
+            w = self._step1b_fix(w)
+        elif w.endswith("ing") and self._has_vowel(w[:-3]):
+            w = w[:-3]
+            w = self._step1b_fix(w)
+        # step 1c
+        if w.endswith("y") and self._has_vowel(w[:-1]):
+            w = w[:-1] + "i"
+        # step 2
+        for suf, rep in [("ational", "ate"), ("tional", "tion"), ("enci", "ence"),
+                         ("anci", "ance"), ("izer", "ize"), ("bli", "ble"),
+                         ("alli", "al"), ("entli", "ent"), ("eli", "e"),
+                         ("ousli", "ous"), ("ization", "ize"), ("ation", "ate"),
+                         ("ator", "ate"), ("alism", "al"), ("iveness", "ive"),
+                         ("fulness", "ful"), ("ousness", "ous"), ("aliti", "al"),
+                         ("iviti", "ive"), ("biliti", "ble"), ("logi", "log")]:
+            if w.endswith(suf):
+                if self._measure(w[: -len(suf)]) > 0:
+                    w = w[: -len(suf)] + rep
+                break
+        # step 3
+        for suf, rep in [("icate", "ic"), ("ative", ""), ("alize", "al"),
+                         ("iciti", "ic"), ("ical", "ic"), ("ful", ""), ("ness", "")]:
+            if w.endswith(suf):
+                if self._measure(w[: -len(suf)]) > 0:
+                    w = w[: -len(suf)] + rep
+                break
+        # step 4
+        for suf in ["al", "ance", "ence", "er", "ic", "able", "ible", "ant",
+                    "ement", "ment", "ent", "ou", "ism", "ate", "iti", "ous",
+                    "ive", "ize"]:
+            if w.endswith(suf):
+                stem = w[: -len(suf)]
+                if self._measure(stem) > 1:
+                    w = stem
+                break
+            if suf == "ent" and w.endswith("ion"):
+                stem = w[:-3]
+                if stem and stem[-1] in "st" and self._measure(stem) > 1:
+                    w = stem
+                break
+        else:
+            if w.endswith("ion"):
+                stem = w[:-3]
+                if stem and stem[-1] in "st" and self._measure(stem) > 1:
+                    w = stem
+        # step 5a
+        if w.endswith("e"):
+            m = self._measure(w[:-1])
+            if m > 1 or (m == 1 and not self._cvc(w[:-1])):
+                w = w[:-1]
+        # step 5b
+        if self._ends_double_cons(w) and w.endswith("l") and self._measure(w) > 1:
+            w = w[:-1]
+        return w
+
+    def _step1b_fix(self, w: str) -> str:
+        if w.endswith(("at", "bl", "iz")):
+            return w + "e"
+        if self._ends_double_cons(w) and w[-1] not in "lsz":
+            return w[:-1]
+        if self._measure(w) == 1 and self._cvc(w):
+            return w + "e"
+        return w
+
+    def filter(self, tokens):
+        return [Token(self._stem(t.term), t.position, t.start_offset, t.end_offset)
+                for t in tokens]
+
+
+# ---------------------------------------------------------------------------
+# Char filters (run before tokenization)
+# ---------------------------------------------------------------------------
+
+class CharFilter:
+    name = "?"
+
+    def apply(self, text: str) -> str:
+        raise NotImplementedError
+
+
+class HtmlStripCharFilter(CharFilter):
+    name = "html_strip"
+
+    _TAG = re.compile(r"<[^>]*>")
+    _ENTITIES = {"&amp;": "&", "&lt;": "<", "&gt;": ">", "&quot;": '"',
+                 "&apos;": "'", "&nbsp;": " "}
+
+    def apply(self, text: str) -> str:
+        text = self._TAG.sub(" ", text)
+        for ent, rep in self._ENTITIES.items():
+            text = text.replace(ent, rep)
+        return text
+
+
+class MappingCharFilter(CharFilter):
+    name = "mapping"
+
+    def __init__(self, mappings: dict):
+        self.mappings = mappings
+
+    def apply(self, text: str) -> str:
+        for src, dst in self.mappings.items():
+            text = text.replace(src, dst)
+        return text
+
+
+class PatternReplaceCharFilter(CharFilter):
+    name = "pattern_replace"
+
+    def __init__(self, pattern: str, replacement: str = ""):
+        self.pattern = re.compile(pattern)
+        self.replacement = replacement
+
+    def apply(self, text: str) -> str:
+        return self.pattern.sub(self.replacement, text)

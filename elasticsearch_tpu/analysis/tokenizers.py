@@ -1,0 +1,172 @@
+"""Tokenizers: text -> token stream.
+
+Mirrors the reference's tokenizer set (ref: modules/analysis-common/.../
+CommonAnalysisPlugin.java tokenizer registrations; Lucene StandardTokenizer).
+Each tokenizer yields Token(term, position, start_offset, end_offset).
+
+This is the host-side (CPU) part of the pipeline: tokenization happens at
+index/query time on the host; only the resulting term ids and postings ever
+reach the TPU. A C++ fast path for the standard tokenizer lives in
+``native/`` and is used when the shared library is available.
+"""
+
+from __future__ import annotations
+
+import re
+import unicodedata
+from dataclasses import dataclass
+from typing import Iterator, List
+
+
+@dataclass
+class Token:
+    term: str
+    position: int
+    start_offset: int
+    end_offset: int
+
+
+def _is_word_char(ch: str) -> bool:
+    cat = unicodedata.category(ch)
+    # letters, digits, and combining marks continue a token (approximates
+    # Lucene's UAX#29 StandardTokenizer word rules)
+    return cat[0] in ("L", "N") or cat in ("Mn", "Mc")
+
+
+class Tokenizer:
+    name = "?"
+
+    def tokenize(self, text: str) -> List[Token]:
+        raise NotImplementedError
+
+
+class StandardTokenizer(Tokenizer):
+    """UAX#29-approximate word-boundary tokenizer (Lucene StandardTokenizer).
+
+    Splits on non-alphanumerics, keeps interior apostrophes/periods out —
+    close enough to Lucene for English corpora like MS MARCO; exact UAX#29
+    segmentation is a later refinement.
+    """
+
+    name = "standard"
+
+    def __init__(self, max_token_length: int = 255):
+        self.max_token_length = max_token_length
+
+    def tokenize(self, text: str) -> List[Token]:
+        out: List[Token] = []
+        pos = 0
+        i = 0
+        n = len(text)
+        while i < n:
+            if _is_word_char(text[i]):
+                start = i
+                while i < n and _is_word_char(text[i]):
+                    i += 1
+            else:
+                i += 1
+                continue
+            term = text[start:i]
+            if len(term) <= self.max_token_length:
+                out.append(Token(term, pos, start, i))
+                pos += 1
+        return out
+
+
+class WhitespaceTokenizer(Tokenizer):
+    name = "whitespace"
+
+    def tokenize(self, text: str) -> List[Token]:
+        out = []
+        for pos, m in enumerate(re.finditer(r"\S+", text)):
+            out.append(Token(m.group(), pos, m.start(), m.end()))
+        return out
+
+
+class KeywordTokenizer(Tokenizer):
+    """Whole input as a single token (ref: Lucene KeywordTokenizer)."""
+
+    name = "keyword"
+
+    def tokenize(self, text: str) -> List[Token]:
+        if not text:
+            return []
+        return [Token(text, 0, 0, len(text))]
+
+
+class LetterTokenizer(Tokenizer):
+    name = "letter"
+
+    def tokenize(self, text: str) -> List[Token]:
+        out = []
+        pos = 0
+        start = None
+        for i, ch in enumerate(text):
+            if unicodedata.category(ch)[0] == "L":
+                if start is None:
+                    start = i
+            elif start is not None:
+                out.append(Token(text[start:i], pos, start, i))
+                pos += 1
+                start = None
+        if start is not None:
+            out.append(Token(text[start:], pos, start, len(text)))
+        return out
+
+
+class PatternTokenizer(Tokenizer):
+    """Split on a regex (default like ES: \\W+)."""
+
+    name = "pattern"
+
+    def __init__(self, pattern: str = r"\W+"):
+        self.pattern = re.compile(pattern)
+
+    def tokenize(self, text: str) -> List[Token]:
+        out = []
+        pos = 0
+        last = 0
+        for m in self.pattern.finditer(text):
+            if m.start() > last:
+                out.append(Token(text[last:m.start()], pos, last, m.start()))
+                pos += 1
+            last = m.end()
+        if last < len(text):
+            out.append(Token(text[last:], pos, last, len(text)))
+        return out
+
+
+class NGramTokenizer(Tokenizer):
+    name = "ngram"
+
+    def __init__(self, min_gram: int = 1, max_gram: int = 2):
+        self.min_gram = min_gram
+        self.max_gram = max_gram
+
+    def tokenize(self, text: str) -> List[Token]:
+        out = []
+        pos = 0
+        for start in range(len(text)):
+            for size in range(self.min_gram, self.max_gram + 1):
+                end = start + size
+                if end > len(text):
+                    break
+                out.append(Token(text[start:end], pos, start, end))
+                pos += 1
+        return out
+
+
+class EdgeNGramTokenizer(Tokenizer):
+    name = "edge_ngram"
+
+    def __init__(self, min_gram: int = 1, max_gram: int = 2):
+        self.min_gram = min_gram
+        self.max_gram = max_gram
+
+    def tokenize(self, text: str) -> List[Token]:
+        out = []
+        for pos, size in enumerate(range(self.min_gram, self.max_gram + 1)):
+            if size > len(text):
+                break
+            out.append(Token(text[:size], pos, 0, size))
+        return out

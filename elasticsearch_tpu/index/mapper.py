@@ -1,0 +1,466 @@
+"""Mapping: schema definition and document parsing.
+
+Mirrors the reference's mapper layer (ref: index/mapper/MapperService.java,
+DocumentParser.java:46,58, MappedFieldType.java): a MapperService owns the
+DocumentMapper for an index; DocumentParser turns a JSON document into typed
+per-field values (the analogue of LuceneDocument) including dynamic-mapping
+detection; ~15 core field types including dense_vector (ref: x-pack vectors
+DenseVectorFieldMapper.java:44-47 — ≤2048 dims).
+
+TPU orientation: parse output is columnar-friendly — text fields yield token
+lists destined for postings blocks, numeric/date/bool fields yield doc
+values destined for columnar arrays, dense_vector fields yield fixed-dim
+float arrays destined for the [n_docs, dim] HBM slab.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from elasticsearch_tpu.analysis import AnalysisRegistry, Token
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    MapperParsingException,
+    StrictDynamicMappingException,
+)
+from elasticsearch_tpu.common.settings import Settings
+
+
+# ---------------------------------------------------------------------------
+# Field types
+# ---------------------------------------------------------------------------
+
+class MappedFieldType:
+    """A field's type: how values parse, index, and store as doc values."""
+
+    type_name = "?"
+    # which columnar representation this field feeds on device
+    #   "postings"  — inverted text terms -> postings blocks
+    #   "term"      — untokenized keyword terms -> postings blocks + ordinals
+    #   "numeric"   — float64 column
+    #   "vector"    — [dim] float slab row
+    #   "none"      — not indexed
+    docvalue_kind = "none"
+
+    def __init__(self, name: str, params: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.params = params or {}
+        self.index = self.params.get("index", True)
+        self.store = self.params.get("store", False)
+        self.has_doc_values = self.params.get("doc_values", True)
+
+    def parse(self, value: Any) -> Any:
+        """JSON value -> internal typed value."""
+        raise NotImplementedError
+
+    def to_mapping(self) -> Dict[str, Any]:
+        out = {"type": self.type_name}
+        out.update({k: v for k, v in self.params.items()})
+        return out
+
+
+class TextFieldType(MappedFieldType):
+    type_name = "text"
+    docvalue_kind = "postings"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.analyzer_name = self.params.get("analyzer", "standard")
+        self.search_analyzer_name = self.params.get("search_analyzer", self.analyzer_name)
+
+    def parse(self, value):
+        return str(value)
+
+
+class KeywordFieldType(MappedFieldType):
+    type_name = "keyword"
+    docvalue_kind = "term"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.ignore_above = self.params.get("ignore_above", 2 ** 31 - 1)
+
+    def parse(self, value):
+        s = str(value)
+        if len(s) > self.ignore_above:
+            return None
+        return s
+
+
+class _NumericFieldType(MappedFieldType):
+    docvalue_kind = "numeric"
+    _min = None
+    _max = None
+    _cast = float
+
+    def parse(self, value):
+        try:
+            v = self._cast(value)
+        except (ValueError, TypeError):
+            raise MapperParsingException(
+                f"failed to parse field [{self.name}] of type [{self.type_name}]: "
+                f"For input string: \"{value}\"")
+        if self._min is not None and (v < self._min or v > self._max):
+            raise MapperParsingException(
+                f"Value [{value}] is out of range for field [{self.name}] "
+                f"of type [{self.type_name}]")
+        return v
+
+
+class LongFieldType(_NumericFieldType):
+    type_name = "long"
+    _cast = int
+    _min, _max = -(2 ** 63), 2 ** 63 - 1
+
+
+class IntegerFieldType(_NumericFieldType):
+    type_name = "integer"
+    _cast = int
+    _min, _max = -(2 ** 31), 2 ** 31 - 1
+
+
+class ShortFieldType(_NumericFieldType):
+    type_name = "short"
+    _cast = int
+    _min, _max = -(2 ** 15), 2 ** 15 - 1
+
+
+class ByteFieldType(_NumericFieldType):
+    type_name = "byte"
+    _cast = int
+    _min, _max = -(2 ** 7), 2 ** 7 - 1
+
+
+class DoubleFieldType(_NumericFieldType):
+    type_name = "double"
+
+
+class FloatFieldType(_NumericFieldType):
+    type_name = "float"
+
+
+class HalfFloatFieldType(_NumericFieldType):
+    type_name = "half_float"
+
+    def parse(self, value):
+        return float(np.float16(super().parse(value)))
+
+
+class BooleanFieldType(MappedFieldType):
+    type_name = "boolean"
+    docvalue_kind = "numeric"
+
+    def parse(self, value):
+        if isinstance(value, bool):
+            return 1.0 if value else 0.0
+        if value in ("true", "True"):
+            return 1.0
+        if value in ("false", "False", ""):
+            return 0.0
+        raise MapperParsingException(
+            f"failed to parse field [{self.name}] of type [boolean]: [{value}]")
+
+
+_DATE_FORMATS = [
+    "%Y-%m-%dT%H:%M:%S.%f%z", "%Y-%m-%dT%H:%M:%S%z", "%Y-%m-%dT%H:%M:%S.%f",
+    "%Y-%m-%dT%H:%M:%S", "%Y-%m-%d %H:%M:%S", "%Y-%m-%d", "%Y/%m/%d",
+]
+
+
+class DateFieldType(MappedFieldType):
+    """Dates stored as epoch millis float64 (ref: DateFieldMapper's
+    `strict_date_optional_time||epoch_millis` default format)."""
+
+    type_name = "date"
+    docvalue_kind = "numeric"
+
+    def parse(self, value):
+        if isinstance(value, (int, float)):
+            return float(value)
+        s = str(value)
+        if re.fullmatch(r"-?\d+", s):
+            return float(int(s))
+        for fmt in _DATE_FORMATS:
+            try:
+                dt = _dt.datetime.strptime(s, fmt)
+                if dt.tzinfo is None:
+                    dt = dt.replace(tzinfo=_dt.timezone.utc)
+                return dt.timestamp() * 1000.0
+            except ValueError:
+                continue
+        raise MapperParsingException(
+            f"failed to parse date field [{value}] for field [{self.name}]")
+
+
+class IpFieldType(MappedFieldType):
+    """IPv4/v6 stored as a 128-bit integer in a float64-safe pair; for
+    simplicity v1 keeps the numeric form of IPv4 and hashes IPv6."""
+
+    type_name = "ip"
+    docvalue_kind = "numeric"
+
+    def parse(self, value):
+        import ipaddress
+        try:
+            return float(int(ipaddress.ip_address(str(value))))
+        except ValueError:
+            raise MapperParsingException(
+                f"'{value}' is not an IP string literal.")
+
+
+class DenseVectorFieldType(MappedFieldType):
+    """ref: x-pack DenseVectorFieldMapper.java:44-47 — max 2048 dims, float
+    values; here destined for the [n_docs, dim] device slab (bf16 on TPU)."""
+
+    type_name = "dense_vector"
+    docvalue_kind = "vector"
+    MAX_DIMS = 2048
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.dims = int(self.params.get("dims", 0))
+        if not (0 < self.dims <= self.MAX_DIMS):
+            raise MapperParsingException(
+                f"The number of dimensions for field [{name}] should be in "
+                f"the range [1, {self.MAX_DIMS}] but was [{self.dims}]")
+        self.similarity = self.params.get("similarity", "cosine")
+
+    def parse(self, value):
+        arr = np.asarray(value, dtype=np.float32)
+        if arr.ndim != 1 or arr.shape[0] != self.dims:
+            raise MapperParsingException(
+                f"The [dims] of field [{self.name}] is [{self.dims}], "
+                f"doesn't match the number of dimensions in the provided "
+                f"value [{arr.shape}]")
+        return arr
+
+
+FIELD_TYPES = {
+    t.type_name: t for t in [
+        TextFieldType, KeywordFieldType, LongFieldType, IntegerFieldType,
+        ShortFieldType, ByteFieldType, DoubleFieldType, FloatFieldType,
+        HalfFloatFieldType, BooleanFieldType, DateFieldType, IpFieldType,
+        DenseVectorFieldType,
+    ]
+}
+
+
+# ---------------------------------------------------------------------------
+# Parsed document
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ParsedDocument:
+    """The analogue of the reference's ParsedDocument/LuceneDocument: typed,
+    columnar-ready values per field."""
+
+    doc_id: str
+    source: bytes
+    # field -> list of Token (analyzed text)
+    text_tokens: Dict[str, List[Token]] = field(default_factory=dict)
+    # field -> list of untokenized terms
+    keyword_terms: Dict[str, List[str]] = field(default_factory=dict)
+    # field -> list of float64 values
+    numeric_values: Dict[str, List[float]] = field(default_factory=dict)
+    # field -> np.ndarray [dims] float32
+    vectors: Dict[str, np.ndarray] = field(default_factory=dict)
+    # dynamic-mapping update discovered during parse (field -> mapping dict)
+    dynamic_mappings: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+
+    def field_length(self, fld: str) -> int:
+        """Token count — the BM25 norm input (Lucene stores this quantized
+        into a 1-byte norm; we keep the exact count, see SURVEY.md §7
+        'Scoring parity')."""
+        return len(self.text_tokens.get(fld, ()))
+
+
+# ---------------------------------------------------------------------------
+# Document mapper / parser
+# ---------------------------------------------------------------------------
+
+_DYNAMIC_DATE_RE = re.compile(r"\d{4}[-/]\d{2}[-/]\d{2}([T ].*)?$")
+
+
+class DocumentMapper:
+    """Holds the field-type map for one index (ref: DocumentMapper +
+    RootObjectMapper flattened to dotted paths)."""
+
+    def __init__(self, mappings: Optional[Dict[str, Any]] = None,
+                 analysis: Optional[AnalysisRegistry] = None,
+                 dynamic: str = "true"):
+        self.fields: Dict[str, MappedFieldType] = {}
+        self.analysis = analysis or AnalysisRegistry()
+        self.dynamic = dynamic  # "true" | "false" | "strict"
+        if mappings:
+            if "properties" in mappings:
+                props = mappings["properties"]
+            else:
+                # properties-less shorthand: sibling meta keys like
+                # "dynamic" are not field definitions
+                props = {k: v for k, v in mappings.items()
+                         if isinstance(v, dict)}
+            self._add_properties("", props)
+            self.dynamic = str(mappings.get("dynamic", dynamic)).lower()
+
+    def _add_properties(self, prefix: str, props: Dict[str, Any]):
+        for name, conf in props.items():
+            path = f"{prefix}{name}"
+            if "properties" in conf and "type" not in conf:
+                self._add_properties(f"{path}.", conf["properties"])
+                continue
+            type_name = conf.get("type", "object")
+            if type_name == "object":
+                if "properties" in conf:
+                    self._add_properties(f"{path}.", conf["properties"])
+                continue
+            cls = FIELD_TYPES.get(type_name)
+            if cls is None:
+                raise MapperParsingException(
+                    f"No handler for type [{type_name}] declared on field [{name}]")
+            params = {k: v for k, v in conf.items() if k != "type"}
+            self.fields[path] = cls(path, params)
+
+    def to_mapping(self) -> Dict[str, Any]:
+        props: Dict[str, Any] = {}
+        for path, ft in sorted(self.fields.items()):
+            node = props
+            parts = path.split(".")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {}).setdefault("properties", {})
+            node[parts[-1]] = ft.to_mapping()
+        return {"properties": props}
+
+    # -- dynamic mapping (ref: DocumentParser dynamic templates default path)
+    def _infer_type(self, path: str, value: Any) -> Optional[MappedFieldType]:
+        if isinstance(value, bool):
+            return BooleanFieldType(path)
+        if isinstance(value, int):
+            return LongFieldType(path)
+        if isinstance(value, float):
+            return FloatFieldType(path)
+        if isinstance(value, str):
+            if _DYNAMIC_DATE_RE.match(value):
+                try:
+                    DateFieldType(path).parse(value)
+                    return DateFieldType(path)
+                except MapperParsingException:
+                    pass
+            # ref: dynamic strings map to text with a .keyword subfield
+            return TextFieldType(path)
+        return None
+
+    def parse(self, doc_id: str, source: Dict[str, Any]) -> ParsedDocument:
+        parsed = ParsedDocument(
+            doc_id=doc_id,
+            source=json.dumps(source, separators=(",", ":")).encode(),
+        )
+        self._parse_object("", source, parsed)
+        return parsed
+
+    def _parse_object(self, prefix: str, obj: Dict[str, Any], parsed: ParsedDocument):
+        for key, value in obj.items():
+            path = f"{prefix}{key}"
+            if isinstance(value, dict):
+                self._parse_object(f"{path}.", value, parsed)
+                continue
+            ft_known = self.fields.get(path)
+            if ft_known is not None and ft_known.docvalue_kind == "vector":
+                # a dense_vector's JSON array is ONE value, not multi-values
+                values = [value]
+            else:
+                values = value if isinstance(value, list) else [value]
+            # arrays of objects flatten (nested type is a later addition)
+            if values and isinstance(values[0], dict):
+                for v in values:
+                    self._parse_object(f"{path}.", v, parsed)
+                continue
+            ft = self.fields.get(path)
+            if ft is None:
+                if self.dynamic == "strict":
+                    raise StrictDynamicMappingException(
+                        f"mapping set to strict, dynamic introduction of "
+                        f"[{path}] within [_doc] is not allowed")
+                if self.dynamic == "false":
+                    continue
+                sample = next((v for v in values if v is not None), None)
+                if sample is None:
+                    continue
+                ft = self._infer_type(path, sample)
+                if ft is None:
+                    continue
+                self.fields[path] = ft
+                parsed.dynamic_mappings[path] = ft.to_mapping()
+                if isinstance(ft, TextFieldType):
+                    kw = KeywordFieldType(f"{path}.keyword", {"ignore_above": 256})
+                    self.fields[kw.name] = kw
+                    parsed.dynamic_mappings[kw.name] = kw.to_mapping()
+            self._index_values(ft, values, parsed)
+            # dynamic text fields also index into their .keyword subfield
+            kw_ft = self.fields.get(f"{ft.name}.keyword")
+            if kw_ft is not None and isinstance(ft, TextFieldType):
+                self._index_values(kw_ft, values, parsed)
+
+    def _index_values(self, ft: MappedFieldType, values: List[Any],
+                      parsed: ParsedDocument):
+        for value in values:
+            if value is None:
+                continue
+            typed = ft.parse(value)
+            if typed is None:
+                continue
+            if ft.docvalue_kind == "postings":
+                analyzer = self.analysis.get(ft.analyzer_name) if self.analysis.has(
+                    ft.analyzer_name) else self.analysis.default
+                toks = parsed.text_tokens.setdefault(ft.name, [])
+                base = toks[-1].position + 100 if toks else 0  # position gap between values
+                for t in analyzer.analyze(typed):
+                    toks.append(Token(t.term, base + t.position, t.start_offset, t.end_offset))
+            elif ft.docvalue_kind == "term":
+                parsed.keyword_terms.setdefault(ft.name, []).append(typed)
+            elif ft.docvalue_kind == "numeric":
+                parsed.numeric_values.setdefault(ft.name, []).append(float(typed))
+            elif ft.docvalue_kind == "vector":
+                parsed.vectors[ft.name] = typed
+
+
+class MapperService:
+    """Per-index mapping lifecycle: merge updates, expose field types
+    (ref: index/mapper/MapperService.java merge/documentMapper)."""
+
+    def __init__(self, index_settings: Settings = Settings.EMPTY,
+                 mappings: Optional[Dict[str, Any]] = None):
+        self.analysis = AnalysisRegistry(index_settings)
+        self._lock = threading.Lock()
+        self.mapper = DocumentMapper(mappings, self.analysis)
+
+    def field_type(self, name: str) -> Optional[MappedFieldType]:
+        return self.mapper.fields.get(name)
+
+    def field_names(self) -> List[str]:
+        return sorted(self.mapper.fields)
+
+    def merge(self, new_mappings: Dict[str, Any]):
+        """Merge a mapping update; conflicting type changes are rejected
+        (ref: MapperService.merge MergeReason.MAPPING_UPDATE)."""
+        with self._lock:
+            incoming = DocumentMapper(new_mappings, self.analysis)
+            for path, ft in incoming.fields.items():
+                existing = self.mapper.fields.get(path)
+                if existing is not None and existing.type_name != ft.type_name:
+                    raise IllegalArgumentException(
+                        f"mapper [{path}] cannot be changed from type "
+                        f"[{existing.type_name}] to [{ft.type_name}]")
+            self.mapper.fields.update(incoming.fields)
+
+    def parse(self, doc_id: str, source: Dict[str, Any]) -> ParsedDocument:
+        return self.mapper.parse(doc_id, source)
+
+    def to_mapping(self) -> Dict[str, Any]:
+        return self.mapper.to_mapping()

@@ -1,0 +1,648 @@
+"""Immutable TPU-oriented segments — the Lucene replacement.
+
+Design (replaces Lucene's postings/doc-values/stored-fields formats, ref:
+SURVEY.md §7 stage 2; consumed by the kernels in ``ops/``):
+
+- **Postings as padded blocks.** Each text/keyword field's postings are
+  concatenated into fixed-size blocks of ``BLOCK_SIZE`` (128 = TPU lane
+  width): ``block_docids[num_blocks, 128] int32`` and
+  ``block_tfs[num_blocks, 128] float32``. Padding entries carry ``tf = 0``
+  and ``docid = 0`` — a zero term frequency contributes exactly 0 BM25
+  score, so padded lanes scatter harmlessly instead of needing masks.
+  Per-term views are ``term_block_start/term_block_count`` ranges; a term's
+  first/last blocks are padded rather than shared with neighbours, so block
+  gathers by term never mix terms.
+- **Block-max metadata** for WAND-style pruning on device:
+  ``block_max_tf`` and ``block_min_len`` give an upper bound
+  ``idf * max_tf / (max_tf + k1*(1-b+b*min_len/avg_len))`` per block —
+  score is monotonic ↑ in tf and ↓ in doc length, so the bound is exact
+  (ref: Lucene block-max WAND, TopDocsCollectorContext.java:210-217;
+  here blocks are pruned coarsely then scored densely, SURVEY.md §7
+  "hard parts" #1).
+- **Columnar doc values**: float64 column per numeric field + missing mask;
+  ordinal column per keyword field (sorted-term ordinals, the analogue of
+  Lucene SortedSetDocValues) for aggregations/sorting.
+- **Dense vector slab**: ``[n_docs, dims] float32`` per vector field,
+  cast to bf16 at device upload; brute-force kNN is a tiled matmul on MXU.
+- **Stored fields**: `_source` bytes with offsets; `_id` both stored and
+  hash-mapped for realtime get.
+- **Deletes as masks**: ``live[n_docs] bool`` — the device analogue of
+  Lucene liveDocs, applied as a score mask (ref: soft-deletes,
+  index/engine/InternalEngine.java).
+
+Docids are segment-local dense int32. Search-time doc addressing is
+(segment_idx, local_docid), mirroring Lucene's per-leaf docids.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+BLOCK_SIZE = 128  # TPU lane width
+
+
+# ---------------------------------------------------------------------------
+# Per-field structures
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PostingsField:
+    """Inverted index for one field, in padded-block layout."""
+
+    field: str
+    terms: List[str]                      # sorted
+    doc_freq: np.ndarray                  # int32 [num_terms]
+    total_term_freq: np.ndarray           # int64 [num_terms]
+    term_block_start: np.ndarray          # int32 [num_terms]
+    term_block_count: np.ndarray          # int32 [num_terms]
+    block_docids: np.ndarray              # int32 [num_blocks, BLOCK_SIZE]
+    block_tfs: np.ndarray                 # float32 [num_blocks, BLOCK_SIZE]
+    block_max_tf: np.ndarray              # float32 [num_blocks]
+    block_min_len: np.ndarray             # float32 [num_blocks]
+    field_lengths: np.ndarray             # float32 [n_docs] (0 where absent)
+    sum_total_term_freq: int
+    sum_doc_freq: int
+    doc_count: int                        # docs with this field
+
+    _term_index: Optional[Dict[str, int]] = dc_field(default=None, repr=False)
+
+    @property
+    def term_index(self) -> Dict[str, int]:
+        if self._term_index is None:
+            self._term_index = {t: i for i, t in enumerate(self.terms)}
+        return self._term_index
+
+    def term_id(self, term: str) -> int:
+        return self.term_index.get(term, -1)
+
+    @property
+    def num_blocks(self) -> int:
+        return self.block_docids.shape[0]
+
+    @property
+    def avg_field_length(self) -> float:
+        return self.sum_total_term_freq / max(1, self.doc_count)
+
+    def term_blocks(self, term: str) -> Tuple[int, int]:
+        """(start, count) block range for a term; (0, 0) if absent."""
+        tid = self.term_id(term)
+        if tid < 0:
+            return 0, 0
+        return int(self.term_block_start[tid]), int(self.term_block_count[tid])
+
+    def postings(self, term: str) -> Tuple[np.ndarray, np.ndarray]:
+        """(docids, tfs) for one term — host-side scalar access for tests
+        and the fetch path; kernels read the block arrays directly."""
+        start, count = self.term_blocks(term)
+        if count == 0:
+            return np.empty(0, np.int32), np.empty(0, np.float32)
+        docids = self.block_docids[start : start + count].reshape(-1)
+        tfs = self.block_tfs[start : start + count].reshape(-1)
+        mask = tfs > 0
+        return docids[mask], tfs[mask]
+
+
+@dataclass
+class NumericDocValues:
+    field: str
+    values: np.ndarray    # float64 [n_docs] (first value if multi)
+    missing: np.ndarray   # bool [n_docs]
+    # ragged multi-values
+    offsets: np.ndarray   # int64 [n_docs + 1]
+    all_values: np.ndarray  # float64 [total]
+
+    def get(self, docid: int) -> List[float]:
+        return list(self.all_values[self.offsets[docid] : self.offsets[docid + 1]])
+
+
+@dataclass
+class KeywordDocValues:
+    """Sorted-set ordinals (analogue of Lucene SortedSetDocValues)."""
+
+    field: str
+    terms: List[str]        # sorted unique terms
+    ords: np.ndarray        # int32 [n_docs] first ord, -1 = missing
+    offsets: np.ndarray     # int64 [n_docs + 1] into all_ords
+    all_ords: np.ndarray    # int32 [total]
+
+    def get(self, docid: int) -> List[str]:
+        return [self.terms[o] for o in self.all_ords[self.offsets[docid] : self.offsets[docid + 1]]]
+
+
+@dataclass
+class VectorValues:
+    field: str
+    vectors: np.ndarray     # float32 [n_docs, dims]
+    has_value: np.ndarray   # bool [n_docs]
+    dims: int
+    similarity: str = "cosine"
+
+
+@dataclass
+class StoredFields:
+    offsets: np.ndarray     # int64 [n_docs + 1]
+    data: bytes
+    ids: List[str]
+
+    def source(self, docid: int) -> bytes:
+        return self.data[self.offsets[docid] : self.offsets[docid + 1]]
+
+
+# ---------------------------------------------------------------------------
+# Segment
+# ---------------------------------------------------------------------------
+
+class Segment:
+    def __init__(self, name: str, n_docs: int,
+                 postings: Dict[str, PostingsField],
+                 numerics: Dict[str, NumericDocValues],
+                 keywords: Dict[str, KeywordDocValues],
+                 vectors: Dict[str, VectorValues],
+                 stored: StoredFields,
+                 live: Optional[np.ndarray] = None):
+        self.name = name
+        self.n_docs = n_docs
+        self.postings = postings
+        self.numerics = numerics
+        self.keywords = keywords
+        self.vectors = vectors
+        self.stored = stored
+        self.live = live if live is not None else np.ones(n_docs, dtype=bool)
+        self._id_map: Optional[Dict[str, int]] = None
+
+    @property
+    def id_map(self) -> Dict[str, int]:
+        if self._id_map is None:
+            self._id_map = {i: d for d, i in enumerate(self.stored.ids)}
+        return self._id_map
+
+    @property
+    def live_doc_count(self) -> int:
+        return int(self.live.sum())
+
+    def delete(self, docid: int) -> None:
+        """Soft delete — flips the live mask (immutable arrays elsewhere)."""
+        self.live = self.live.copy()
+        self.live[docid] = False
+
+    def docid_for(self, doc_id: str) -> int:
+        d = self.id_map.get(doc_id, -1)
+        if d >= 0 and not self.live[d]:
+            return -1
+        return d
+
+    def ram_bytes(self) -> int:
+        total = self.live.nbytes + self.stored.offsets.nbytes + len(self.stored.data)
+        for pf in self.postings.values():
+            total += (pf.block_docids.nbytes + pf.block_tfs.nbytes +
+                      pf.block_max_tf.nbytes + pf.block_min_len.nbytes +
+                      pf.field_lengths.nbytes + pf.doc_freq.nbytes +
+                      pf.term_block_start.nbytes + pf.term_block_count.nbytes)
+        for nv in self.numerics.values():
+            total += nv.values.nbytes + nv.all_values.nbytes
+        for vv in self.vectors.values():
+            total += vv.vectors.nbytes
+        return total
+
+    # ------------------------------------------------------------------ I/O
+    @staticmethod
+    def _encode_strings(strings: List[str]) -> Tuple[np.ndarray, np.ndarray]:
+        """Arbitrary strings -> (utf-8 blob, offsets); newline-safe."""
+        encoded = [s.encode("utf-8") for s in strings]
+        offsets = np.zeros(len(encoded) + 1, np.int64)
+        np.cumsum([len(e) for e in encoded], out=offsets[1:])
+        blob = np.frombuffer(b"".join(encoded), dtype=np.uint8)
+        return blob, offsets
+
+    @staticmethod
+    def _decode_strings(blob: np.ndarray, offsets: np.ndarray) -> List[str]:
+        raw = blob.tobytes()
+        return [raw[offsets[i]:offsets[i + 1]].decode("utf-8")
+                for i in range(len(offsets) - 1)]
+
+    def save(self, directory: str) -> None:
+        os.makedirs(directory, exist_ok=True)
+        arrays: Dict[str, np.ndarray] = {"live": self.live}
+        meta: Dict[str, Any] = {
+            "name": self.name, "n_docs": self.n_docs,
+            "postings": {}, "numerics": [], "keywords": {}, "vectors": {},
+        }
+        for f, pf in self.postings.items():
+            key = f"p~{f}"
+            arrays[f"{key}~doc_freq"] = pf.doc_freq
+            arrays[f"{key}~ttf"] = pf.total_term_freq
+            arrays[f"{key}~tbs"] = pf.term_block_start
+            arrays[f"{key}~tbc"] = pf.term_block_count
+            arrays[f"{key}~bd"] = pf.block_docids
+            arrays[f"{key}~bt"] = pf.block_tfs
+            arrays[f"{key}~bmt"] = pf.block_max_tf
+            arrays[f"{key}~bml"] = pf.block_min_len
+            arrays[f"{key}~fl"] = pf.field_lengths
+            arrays[f"{key}~terms"], arrays[f"{key}~terms_off"] = \
+                self._encode_strings(pf.terms)
+            meta["postings"][f] = {
+                "sum_total_term_freq": pf.sum_total_term_freq,
+                "sum_doc_freq": pf.sum_doc_freq,
+                "doc_count": pf.doc_count,
+            }
+        for f, nv in self.numerics.items():
+            key = f"n~{f}"
+            arrays[f"{key}~v"] = nv.values
+            arrays[f"{key}~m"] = nv.missing
+            arrays[f"{key}~o"] = nv.offsets
+            arrays[f"{key}~av"] = nv.all_values
+            meta["numerics"].append(f)
+        for f, kv in self.keywords.items():
+            key = f"k~{f}"
+            arrays[f"{key}~ords"] = kv.ords
+            arrays[f"{key}~o"] = kv.offsets
+            arrays[f"{key}~ao"] = kv.all_ords
+            arrays[f"{key}~terms"], arrays[f"{key}~terms_off"] = \
+                self._encode_strings(kv.terms)
+            meta["keywords"][f] = {}
+        for f, vv in self.vectors.items():
+            key = f"v~{f}"
+            arrays[f"{key}~vec"] = vv.vectors
+            arrays[f"{key}~has"] = vv.has_value
+            meta["vectors"][f] = {"dims": vv.dims, "similarity": vv.similarity}
+        arrays["stored~offsets"] = self.stored.offsets
+        arrays["stored~ids"], arrays["stored~ids_off"] = \
+            self._encode_strings(self.stored.ids)
+        np.savez(os.path.join(directory, "arrays.npz"), **arrays)
+        with open(os.path.join(directory, "stored.bin"), "wb") as fh:
+            fh.write(self.stored.data)
+        with open(os.path.join(directory, "meta.json"), "w") as fh:
+            json.dump(meta, fh)
+
+    @classmethod
+    def load(cls, directory: str) -> "Segment":
+        with open(os.path.join(directory, "meta.json")) as fh:
+            meta = json.load(fh)
+        with open(os.path.join(directory, "stored.bin"), "rb") as fh:
+            data = fh.read()
+        z = np.load(os.path.join(directory, "arrays.npz"))
+
+        postings = {}
+        for f, m in meta["postings"].items():
+            key = f"p~{f}"
+            postings[f] = PostingsField(
+                field=f,
+                terms=cls._decode_strings(z[f"{key}~terms"], z[f"{key}~terms_off"]),
+                doc_freq=z[f"{key}~doc_freq"], total_term_freq=z[f"{key}~ttf"],
+                term_block_start=z[f"{key}~tbs"], term_block_count=z[f"{key}~tbc"],
+                block_docids=z[f"{key}~bd"], block_tfs=z[f"{key}~bt"],
+                block_max_tf=z[f"{key}~bmt"], block_min_len=z[f"{key}~bml"],
+                field_lengths=z[f"{key}~fl"],
+                sum_total_term_freq=m["sum_total_term_freq"],
+                sum_doc_freq=m["sum_doc_freq"], doc_count=m["doc_count"])
+        numerics = {}
+        for f in meta["numerics"]:
+            key = f"n~{f}"
+            numerics[f] = NumericDocValues(
+                field=f, values=z[f"{key}~v"], missing=z[f"{key}~m"],
+                offsets=z[f"{key}~o"], all_values=z[f"{key}~av"])
+        keywords = {}
+        for f in meta["keywords"]:
+            key = f"k~{f}"
+            keywords[f] = KeywordDocValues(
+                field=f,
+                terms=cls._decode_strings(z[f"{key}~terms"], z[f"{key}~terms_off"]),
+                ords=z[f"{key}~ords"], offsets=z[f"{key}~o"],
+                all_ords=z[f"{key}~ao"])
+        vectors = {}
+        for f, m in meta["vectors"].items():
+            key = f"v~{f}"
+            vectors[f] = VectorValues(
+                field=f, vectors=z[f"{key}~vec"], has_value=z[f"{key}~has"],
+                dims=m["dims"], similarity=m["similarity"])
+        stored = StoredFields(
+            offsets=z["stored~offsets"], data=data,
+            ids=cls._decode_strings(z["stored~ids"], z["stored~ids_off"]))
+        return cls(meta["name"], meta["n_docs"], postings, numerics, keywords,
+                   vectors, stored, live=z["live"].astype(bool))
+
+
+# ---------------------------------------------------------------------------
+# Segment writer
+# ---------------------------------------------------------------------------
+
+class SegmentWriter:
+    """Accumulates parsed documents, then builds an immutable Segment
+    (the analogue of Lucene's IndexingChain + flush)."""
+
+    def __init__(self):
+        self._docs: List[Any] = []  # ParsedDocument
+
+    def add(self, parsed) -> int:
+        self._docs.append(parsed)
+        return len(self._docs) - 1
+
+    def __len__(self):
+        return len(self._docs)
+
+    @property
+    def docs(self):
+        return self._docs
+
+    def build(self, name: str) -> Segment:
+        docs = self._docs
+        n = len(docs)
+
+        # ---- postings: text fields (tf = within-doc term count) and
+        #      keyword fields (tf = 1, also feeds ordinals)
+        field_term_docs: Dict[str, Dict[str, List[Tuple[int, float]]]] = {}
+        field_lengths: Dict[str, np.ndarray] = {}
+        for docid, d in enumerate(docs):
+            for f, toks in d.text_tokens.items():
+                per = field_term_docs.setdefault(f, {})
+                counts: Dict[str, int] = {}
+                for t in toks:
+                    counts[t.term] = counts.get(t.term, 0) + 1
+                for term, tf in counts.items():
+                    per.setdefault(term, []).append((docid, float(tf)))
+                field_lengths.setdefault(f, np.zeros(n, np.float32))[docid] = len(toks)
+            for f, terms in d.keyword_terms.items():
+                per = field_term_docs.setdefault(f, {})
+                for term in set(terms):
+                    per.setdefault(term, []).append((docid, 1.0))
+                field_lengths.setdefault(f, np.zeros(n, np.float32))[docid] = len(terms)
+
+        postings = {
+            f: _build_postings_field(f, term_docs, field_lengths[f], n)
+            for f, term_docs in field_term_docs.items()
+        }
+
+        # ---- numeric doc values
+        numerics = {}
+        num_fields = {f for d in docs for f in d.numeric_values}
+        for f in num_fields:
+            values = np.full(n, np.nan, np.float64)
+            missing = np.ones(n, bool)
+            offsets = np.zeros(n + 1, np.int64)
+            all_vals: List[float] = []
+            for docid, d in enumerate(docs):
+                vs = d.numeric_values.get(f, [])
+                if vs:
+                    values[docid] = vs[0]
+                    missing[docid] = False
+                    all_vals.extend(sorted(vs))
+                offsets[docid + 1] = len(all_vals)
+            numerics[f] = NumericDocValues(f, values, missing, offsets,
+                                           np.asarray(all_vals, np.float64))
+
+        # ---- keyword ordinals
+        keywords = {}
+        kw_fields = {f for d in docs for f in d.keyword_terms}
+        for f in kw_fields:
+            uniq = sorted({t for d in docs for t in d.keyword_terms.get(f, [])})
+            tindex = {t: i for i, t in enumerate(uniq)}
+            ords = np.full(n, -1, np.int32)
+            offsets = np.zeros(n + 1, np.int64)
+            all_ords: List[int] = []
+            for docid, d in enumerate(docs):
+                terms = sorted(set(d.keyword_terms.get(f, [])))
+                if terms:
+                    ords[docid] = tindex[terms[0]]
+                    all_ords.extend(tindex[t] for t in terms)
+                offsets[docid + 1] = len(all_ords)
+            keywords[f] = KeywordDocValues(f, uniq, ords, offsets,
+                                           np.asarray(all_ords, np.int32))
+
+        # ---- vectors
+        vectors = {}
+        vec_fields = {f for d in docs for f in d.vectors}
+        for f in vec_fields:
+            dims = next(d.vectors[f].shape[0] for d in docs if f in d.vectors)
+            arr = np.zeros((n, dims), np.float32)
+            has = np.zeros(n, bool)
+            for docid, d in enumerate(docs):
+                v = d.vectors.get(f)
+                if v is not None:
+                    arr[docid] = v
+                    has[docid] = True
+            vectors[f] = VectorValues(f, arr, has, dims)
+
+        # ---- stored fields
+        offsets = np.zeros(n + 1, np.int64)
+        chunks = []
+        ids = []
+        total = 0
+        for docid, d in enumerate(docs):
+            chunks.append(d.source)
+            total += len(d.source)
+            offsets[docid + 1] = total
+            ids.append(d.doc_id)
+        stored = StoredFields(offsets, b"".join(chunks), ids)
+
+        return Segment(name, n, postings, numerics, keywords, vectors, stored)
+
+
+def _build_postings_field(field: str,
+                          term_docs: Dict[str, Any],
+                          field_lengths: np.ndarray, n_docs: int) -> PostingsField:
+    """term_docs values are either a list of (docid, tf) tuples (writer path)
+    or a list of (docids_array, tfs_array) chunks (merge path) — both
+    docid-ascending."""
+    terms = sorted(term_docs)
+    num_terms = len(terms)
+    doc_freq = np.zeros(num_terms, np.int32)
+    ttf = np.zeros(num_terms, np.int64)
+    tbs = np.zeros(num_terms, np.int32)
+    tbc = np.zeros(num_terms, np.int32)
+
+    blocks_d: List[np.ndarray] = []
+    blocks_t: List[np.ndarray] = []
+    next_block = 0
+    for tid, term in enumerate(terms):
+        plist = term_docs[term]
+        if plist and isinstance(plist[0], tuple) and np.isscalar(plist[0][0]):
+            docids = np.asarray([p[0] for p in plist], np.int32)
+            tfs = np.asarray([p[1] for p in plist], np.float32)
+        else:
+            docids = np.concatenate([c[0] for c in plist]).astype(np.int32)
+            tfs = np.concatenate([c[1] for c in plist]).astype(np.float32)
+        doc_freq[tid] = len(docids)
+        ttf[tid] = int(tfs.sum())
+        nb = (len(docids) + BLOCK_SIZE - 1) // BLOCK_SIZE
+        tbs[tid] = next_block
+        tbc[tid] = nb
+        next_block += nb
+        pad = nb * BLOCK_SIZE - len(docids)
+        if pad:
+            # tf=0 padding scores exactly 0; docid 0 is a harmless target
+            docids = np.concatenate([docids, np.zeros(pad, np.int32)])
+            tfs = np.concatenate([tfs, np.zeros(pad, np.float32)])
+        blocks_d.append(docids.reshape(nb, BLOCK_SIZE))
+        blocks_t.append(tfs.reshape(nb, BLOCK_SIZE))
+
+    if blocks_d:
+        block_docids = np.concatenate(blocks_d, axis=0)
+        block_tfs = np.concatenate(blocks_t, axis=0)
+    else:
+        block_docids = np.zeros((0, BLOCK_SIZE), np.int32)
+        block_tfs = np.zeros((0, BLOCK_SIZE), np.float32)
+
+    # block-max metadata: tf upper bound and doc-length lower bound
+    block_max_tf = block_tfs.max(axis=1) if len(block_tfs) else np.zeros(0, np.float32)
+    if len(block_docids):
+        lens = field_lengths[block_docids]          # [nb, B]
+        lens = np.where(block_tfs > 0, lens, np.inf)
+        block_min_len = lens.min(axis=1).astype(np.float32)
+        block_min_len[~np.isfinite(block_min_len)] = 0.0
+    else:
+        block_min_len = np.zeros(0, np.float32)
+
+    return PostingsField(
+        field=field, terms=terms, doc_freq=doc_freq, total_term_freq=ttf,
+        term_block_start=tbs, term_block_count=tbc,
+        block_docids=block_docids, block_tfs=block_tfs,
+        block_max_tf=block_max_tf.astype(np.float32),
+        block_min_len=block_min_len,
+        field_lengths=field_lengths,
+        sum_total_term_freq=int(ttf.sum()),
+        sum_doc_freq=int(doc_freq.sum()),
+        doc_count=int((field_lengths > 0).sum()))
+
+
+# ---------------------------------------------------------------------------
+# Merge (the analogue of Lucene segment merging; runs on host CPU)
+# ---------------------------------------------------------------------------
+
+def merge_segments(name: str, segments: List[Segment]) -> Segment:
+    """Merge segments, dropping deleted docs and remapping docids.
+
+    ref: Lucene SegmentMerger / ElasticsearchConcurrentMergeScheduler —
+    here a host-side columnar merge: per-segment docid -> new docid maps,
+    then concatenation of per-term postings in segment order (docids stay
+    ascending because new ids are assigned in segment order).
+    """
+    # docid remap: old (seg, docid) -> new docid, skipping deletes
+    maps: List[np.ndarray] = []
+    new_n = 0
+    for seg in segments:
+        m = np.full(seg.n_docs, -1, np.int64)
+        live_ids = np.nonzero(seg.live)[0]
+        m[live_ids] = np.arange(new_n, new_n + len(live_ids))
+        new_n += len(live_ids)
+        maps.append(m)
+
+    # ---- postings
+    all_fields = sorted({f for s in segments for f in s.postings})
+    postings: Dict[str, PostingsField] = {}
+    for f in all_fields:
+        # term -> list of (docids_array, tfs_array) chunks, appended in
+        # segment order so merged docids stay ascending
+        term_docs: Dict[str, List[Tuple[np.ndarray, np.ndarray]]] = {}
+        field_lengths = np.zeros(new_n, np.float32)
+        for seg, m in zip(segments, maps):
+            pf = seg.postings.get(f)
+            if pf is None:
+                continue
+            live = seg.live
+            new_ids = m[np.arange(seg.n_docs)]
+            keep = new_ids >= 0
+            field_lengths[new_ids[keep]] = pf.field_lengths[keep]
+            for tid, term in enumerate(pf.terms):
+                start, count = int(pf.term_block_start[tid]), int(pf.term_block_count[tid])
+                docids = pf.block_docids[start : start + count].reshape(-1)
+                tfs = pf.block_tfs[start : start + count].reshape(-1)
+                mask = (tfs > 0) & live[docids]
+                if not mask.any():
+                    continue
+                term_docs.setdefault(term, []).append(
+                    (m[docids[mask]].astype(np.int64), tfs[mask]))
+        postings[f] = _build_postings_field(f, term_docs, field_lengths, new_n)
+
+    # ---- numerics
+    numerics: Dict[str, NumericDocValues] = {}
+    for f in sorted({f for s in segments for f in s.numerics}):
+        values = np.full(new_n, np.nan, np.float64)
+        missing = np.ones(new_n, bool)
+        offsets = np.zeros(new_n + 1, np.int64)
+        all_vals: List[np.ndarray] = []
+        total = 0
+        counts = np.zeros(new_n, np.int64)
+        per_doc: Dict[int, np.ndarray] = {}
+        for seg, m in zip(segments, maps):
+            nv = seg.numerics.get(f)
+            if nv is None:
+                continue
+            for old in np.nonzero(seg.live)[0]:
+                new = int(m[old])
+                vs = nv.all_values[nv.offsets[old] : nv.offsets[old + 1]]
+                if len(vs):
+                    values[new] = nv.values[old]
+                    missing[new] = False
+                    per_doc[new] = vs
+        for d in range(new_n):
+            vs = per_doc.get(d)
+            if vs is not None:
+                all_vals.append(vs)
+                total += len(vs)
+            offsets[d + 1] = total
+        numerics[f] = NumericDocValues(
+            f, values, missing, offsets,
+            np.concatenate(all_vals) if all_vals else np.zeros(0, np.float64))
+
+    # ---- keywords
+    keywords: Dict[str, KeywordDocValues] = {}
+    for f in sorted({f for s in segments for f in s.keywords}):
+        per_doc_terms: Dict[int, List[str]] = {}
+        for seg, m in zip(segments, maps):
+            kv = seg.keywords.get(f)
+            if kv is None:
+                continue
+            for old in np.nonzero(seg.live)[0]:
+                ts = kv.get(int(old))
+                if ts:
+                    per_doc_terms[int(m[old])] = ts
+        uniq = sorted({t for ts in per_doc_terms.values() for t in ts})
+        tindex = {t: i for i, t in enumerate(uniq)}
+        ords = np.full(new_n, -1, np.int32)
+        offsets = np.zeros(new_n + 1, np.int64)
+        all_ords: List[int] = []
+        for d in range(new_n):
+            ts = per_doc_terms.get(d, [])
+            if ts:
+                ords[d] = tindex[ts[0]]
+                all_ords.extend(tindex[t] for t in ts)
+            offsets[d + 1] = len(all_ords)
+        keywords[f] = KeywordDocValues(f, uniq, ords, offsets,
+                                       np.asarray(all_ords, np.int32))
+
+    # ---- vectors
+    vectors: Dict[str, VectorValues] = {}
+    for f in sorted({f for s in segments for f in s.vectors}):
+        dims = next(s.vectors[f].dims for s in segments if f in s.vectors)
+        sim = next(s.vectors[f].similarity for s in segments if f in s.vectors)
+        arr = np.zeros((new_n, dims), np.float32)
+        has = np.zeros(new_n, bool)
+        for seg, m in zip(segments, maps):
+            vv = seg.vectors.get(f)
+            if vv is None:
+                continue
+            keep = seg.live
+            arr[m[keep]] = vv.vectors[keep]
+            has[m[keep]] = vv.has_value[keep]
+        vectors[f] = VectorValues(f, arr, has, dims, sim)
+
+    # ---- stored
+    offsets = np.zeros(new_n + 1, np.int64)
+    chunks: List[bytes] = []
+    ids: List[str] = []
+    total = 0
+    for seg, m in zip(segments, maps):
+        for old in np.nonzero(seg.live)[0]:
+            src = seg.stored.source(int(old))
+            chunks.append(src)
+            total += len(src)
+            offsets[int(m[old]) + 1] = total
+            ids.append(seg.stored.ids[int(old)])
+    stored = StoredFields(offsets, b"".join(chunks), ids)
+
+    return Segment(name, new_n, postings, numerics, keywords, vectors, stored)

@@ -1,0 +1,127 @@
+"""Batched BM25 scoring kernels.
+
+The TPU replacement for the Lucene BulkScorer hot loop (ref:
+search/internal/ContextIndexSearcher.java:210-213 — per-segment
+``BulkScorer.score(leafCollector, liveDocs)``). Where Lucene iterates
+postings one docid at a time with skip lists, these kernels score *all*
+selected postings blocks in one launch:
+
+    gather blocks → per-posting BM25 contribution → scatter-add into a
+    dense per-doc score accumulator → (top-k in ops/topk.py)
+
+Padding discipline (set up by index/segment.py): padded lanes carry
+``tf = 0`` so their contribution is exactly 0, and padded *blocks* point at
+a reserved all-zeros block appended at device upload, with weight 0 — no
+masks needed anywhere in the hot path.
+
+The BM25 formula matches Lucene 8's BM25Similarity (ref: Lucene
+BM25Similarity.java — the (k1+1) numerator constant is dropped, which does
+not change ranking):
+
+    idf(t)  = ln(1 + (N - df + 0.5) / (df + 0.5))
+    score   = idf * tf / (tf + k1 * (1 - b + b * dl / avgdl))
+
+Lucene quantizes dl into a 1-byte norm (SmallFloat); we keep exact float
+lengths — rankings agree at matched recall, absolute scores differ slightly
+(SURVEY.md §7 "Scoring parity").
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def idf(doc_freq, doc_count) -> float:
+    """Lucene BM25 idf (BM25Similarity.idf)."""
+    return math.log(1.0 + (doc_count - doc_freq + 0.5) / (doc_freq + 0.5))
+
+
+def bm25_block_scores(block_docids: jax.Array,   # int32 [TB, B] all blocks
+                      block_tfs: jax.Array,      # float32 [TB, B]
+                      sel_blocks: jax.Array,     # int32 [NB] selected block ids
+                      sel_weights: jax.Array,    # float32 [NB] idf of owning term
+                      doc_lens: jax.Array,       # float32 [ND]
+                      avg_len: jax.Array,        # float32 scalar
+                      k1: float, b: float) -> jax.Array:
+    """Dense per-doc BM25 scores [ND] for the selected blocks.
+
+    A doc's score is the sum over query terms of idf·tf/(tf+norm); docs
+    matching no term end at exactly 0.0 (idf > 0 always, so any match
+    scores > 0 — "matched" is recoverable from score > 0).
+    """
+    d = jnp.take(block_docids, sel_blocks, axis=0)        # [NB, B]
+    tf = jnp.take(block_tfs, sel_blocks, axis=0)          # [NB, B]
+    dl = jnp.take(doc_lens, d)                            # [NB, B]
+    norm = k1 * (1.0 - b + b * dl / avg_len)
+    # where() guards the tf=0 padding lanes: with b=1 or k1=0 a padded
+    # lane can hit norm=0 and 0/0 would scatter NaN into doc 0
+    contrib = sel_weights[:, None] * jnp.where(
+        tf > 0.0, tf / (tf + norm), 0.0)
+    scores = jnp.zeros(doc_lens.shape[0], jnp.float32)
+    return scores.at[d.reshape(-1)].add(
+        contrib.reshape(-1), mode="drop", unique_indices=False)
+
+
+def match_mask(block_docids: jax.Array, block_tfs: jax.Array,
+               sel_blocks: jax.Array, n_docs: int) -> jax.Array:
+    """bool [ND]: docs appearing in ANY selected block (term/terms filters —
+    the device analogue of a Lucene TermQuery bitset)."""
+    d = jnp.take(block_docids, sel_blocks, axis=0)
+    tf = jnp.take(block_tfs, sel_blocks, axis=0)
+    mask = jnp.zeros(n_docs, jnp.bool_)
+    return mask.at[d.reshape(-1)].max(tf.reshape(-1) > 0, mode="drop")
+
+
+def match_count(block_docids: jax.Array, block_tfs: jax.Array,
+                sel_blocks: jax.Array, clause_ids: jax.Array,
+                n_clauses: int, n_docs: int) -> jax.Array:
+    """int32 [ND]: number of distinct clauses each doc matches.
+
+    Used for bool `must`/`minimum_should_match` semantics: each selected
+    block carries the id of its owning clause; per-doc presence is computed
+    per clause (scatter-max into a [ND, n_clauses] plane), then summed.
+    n_clauses is static and small.
+    """
+    d = jnp.take(block_docids, sel_blocks, axis=0)        # [NB, B]
+    tf = jnp.take(block_tfs, sel_blocks, axis=0)
+    present = jnp.zeros((n_docs, n_clauses), jnp.bool_)
+    cid = jnp.broadcast_to(clause_ids[:, None], d.shape)  # [NB, B]
+    present = present.at[d.reshape(-1), cid.reshape(-1)].max(
+        tf.reshape(-1) > 0, mode="drop")
+    return present.sum(axis=1, dtype=jnp.int32)
+
+
+def block_max_scores(block_max_tf: jax.Array,   # float32 [TB]
+                     block_min_len: jax.Array,  # float32 [TB]
+                     sel_blocks: jax.Array,     # int32 [NB]
+                     sel_weights: jax.Array,    # float32 [NB]
+                     avg_len: jax.Array, k1: float, b: float) -> jax.Array:
+    """Upper-bound score per selected block — the block-max WAND bound
+    (ref: Lucene block-max impacts, TopDocsCollectorContext.java:210-217).
+    Monotonic ↑ in tf, ↓ in dl ⇒ (max_tf, min_len) gives an exact bound."""
+    mtf = jnp.take(block_max_tf, sel_blocks)
+    mln = jnp.take(block_min_len, sel_blocks)
+    norm = k1 * (1.0 - b + b * mln / avg_len)
+    return sel_weights * (mtf / (mtf + norm))
+
+
+# ---------------------------------------------------------------------------
+# Scalar reference (the "AbstractQueryTestCase" analogue: kernels are
+# property-tested against this, SURVEY.md §4 lesson)
+# ---------------------------------------------------------------------------
+
+def bm25_reference_scores(postings_per_term, idfs, doc_lens, avg_len,
+                          k1: float, b: float) -> np.ndarray:
+    """Pure-numpy scalar BM25: postings_per_term is a list of (docids, tfs)
+    arrays, one per query term, idfs the matching idf list."""
+    scores = np.zeros(len(doc_lens), np.float64)
+    for (docids, tfs), w in zip(postings_per_term, idfs):
+        for d, tf in zip(docids, tfs):
+            dl = doc_lens[d]
+            scores[d] += w * tf / (tf + k1 * (1 - b + b * dl / avg_len))
+    return scores

@@ -1,0 +1,154 @@
+"""Device-resident segment state.
+
+The analogue of Lucene's on-heap/off-heap segment readers, re-homed in TPU
+HBM: a DeviceSegment uploads a segment's postings blocks, norms, live mask
+and vector slabs to the device once; every query then only ships a few
+hundred bytes of block ids and weights (the "JNI→JAX bridge" data plane of
+BASELINE.json, without a process hop).
+
+Shape discipline for XLA caching (everything under jit compiles per shape,
+SURVEY.md §7 "hard parts" #2):
+- doc count pads to a multiple of ``DOC_PAD`` (padded docs are dead in the
+  live mask and have doc_len = avg so no NaN/0-div),
+- one reserved all-zeros postings block sits at index ``num_blocks`` —
+  query block lists pad with it (weight 0) and bucket to powers of two,
+  so NB only takes O(log) distinct values across queries.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.index.segment import BLOCK_SIZE, Segment
+from elasticsearch_tpu.ops.vector import prepare_vectors
+
+DOC_PAD = 1024
+MIN_BLOCK_BUCKET = 8
+
+
+def round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def block_bucket(n: int) -> int:
+    """Round a selected-block count up to the next power-of-two bucket."""
+    b = MIN_BLOCK_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
+class DevicePostings:
+    """One field's postings on device, with the reserved zero block."""
+
+    def __init__(self, pf, n_docs_padded: int, device=None):
+        tb = pf.block_docids.shape[0]
+        docids = np.concatenate(
+            [pf.block_docids, np.zeros((1, BLOCK_SIZE), np.int32)], axis=0)
+        tfs = np.concatenate(
+            [pf.block_tfs, np.zeros((1, BLOCK_SIZE), np.float32)], axis=0)
+        put = partial(jax.device_put, device=device)
+        self.block_docids = put(docids)
+        self.block_tfs = put(tfs)
+        self.block_max_tf = put(np.concatenate([pf.block_max_tf, [0.0]]).astype(np.float32))
+        self.block_min_len = put(np.concatenate([pf.block_min_len, [0.0]]).astype(np.float32))
+        lens = np.zeros(n_docs_padded, np.float32)
+        lens[: len(pf.field_lengths)] = pf.field_lengths
+        avg = pf.avg_field_length
+        lens[len(pf.field_lengths):] = avg  # padded docs: harmless norm
+        self.doc_lens = put(lens)
+        self.zero_block = tb  # index of the reserved all-zeros block
+        self.avg_len = float(avg)
+        # host-side lookup stays on the host (term dict is a CPU structure)
+        self.term_block_start = pf.term_block_start
+        self.term_block_count = pf.term_block_count
+        self.doc_freq = pf.doc_freq
+        self.host = pf
+
+    def select_blocks(self, term_ids, weights) -> Tuple[np.ndarray, np.ndarray]:
+        """Host-side: term ids + per-term weights -> padded (block ids,
+        per-block weights) bucketed to a power of two."""
+        ids = []
+        ws = []
+        for tid, w in zip(term_ids, weights):
+            if tid < 0:
+                continue
+            start = int(self.term_block_start[tid])
+            count = int(self.term_block_count[tid])
+            ids.extend(range(start, start + count))
+            ws.extend([w] * count)
+        n = block_bucket(max(1, len(ids)))
+        pad = n - len(ids)
+        ids.extend([self.zero_block] * pad)
+        ws.extend([0.0] * pad)
+        return np.asarray(ids, np.int32), np.asarray(ws, np.float32)
+
+
+class DeviceVectors:
+    def __init__(self, vv, n_docs_padded: int, dtype=jnp.bfloat16, device=None):
+        prepped, norms = prepare_vectors(vv.vectors, vv.similarity, dtype)
+        nd, d = prepped.shape
+        if n_docs_padded > nd:
+            prepped = np.concatenate(
+                [prepped, np.zeros((n_docs_padded - nd, d), prepped.dtype)], axis=0)
+            norms = np.concatenate([norms, np.zeros(n_docs_padded - nd, np.float32)])
+        put = partial(jax.device_put, device=device)
+        self.vectors = put(prepped)
+        self.norms = put(norms)
+        self.sq_norms = put((norms * norms).astype(np.float32))
+        self.has_value = put(np.concatenate(
+            [vv.has_value, np.zeros(n_docs_padded - nd, bool)]))
+        self.similarity = vv.similarity
+        self.dims = vv.dims
+
+
+class DeviceSegment:
+    """A segment resident in device HBM. Built once per (segment, device);
+    refresh swaps whole DeviceSegments (epoch pointer swap, SURVEY.md §7
+    stage 4)."""
+
+    def __init__(self, segment: Segment, device=None, vector_dtype=jnp.bfloat16):
+        self.segment = segment
+        self.name = segment.name
+        self.n_docs = segment.n_docs
+        self.n_docs_padded = max(DOC_PAD, round_up(segment.n_docs, DOC_PAD))
+        live = np.zeros(self.n_docs_padded, bool)
+        live[: segment.n_docs] = segment.live
+        self.live = jax.device_put(live, device=device)
+        self.postings: Dict[str, DevicePostings] = {
+            f: DevicePostings(pf, self.n_docs_padded, device)
+            for f, pf in segment.postings.items()
+        }
+        self.vectors: Dict[str, DeviceVectors] = {
+            f: DeviceVectors(vv, self.n_docs_padded, vector_dtype, device)
+            for f, vv in segment.vectors.items()
+        }
+        # numeric doc values as dense device columns (range filters, sorts,
+        # script features)
+        put = partial(jax.device_put, device=device)
+        self.numerics: Dict[str, jax.Array] = {}
+        self.numeric_missing: Dict[str, jax.Array] = {}
+        for f, nv in segment.numerics.items():
+            vals = np.zeros(self.n_docs_padded, np.float64)
+            vals[: len(nv.values)] = np.nan_to_num(nv.values, nan=0.0)
+            miss = np.ones(self.n_docs_padded, bool)
+            miss[: len(nv.missing)] = nv.missing
+            self.numerics[f] = put(vals.astype(np.float32))
+            self.numeric_missing[f] = put(miss)
+
+    def hbm_bytes(self) -> int:
+        total = self.live.nbytes
+        for dp in self.postings.values():
+            total += (dp.block_docids.nbytes + dp.block_tfs.nbytes +
+                      dp.block_max_tf.nbytes + dp.block_min_len.nbytes +
+                      dp.doc_lens.nbytes)
+        for dv in self.vectors.values():
+            total += dv.vectors.nbytes + dv.norms.nbytes
+        for arr in self.numerics.values():
+            total += arr.nbytes
+        return total

@@ -1,0 +1,62 @@
+"""On-device top-k selection and merge.
+
+Replaces Lucene's TopScoreDocCollector + the coordinator's TopDocs.merge
+(ref: search/query/TopDocsCollectorContext.java, action/search/
+SearchPhaseController.java:154-218). Exact top-k via lax.top_k; a TPU
+approximate variant via lax.approx_max_k (recall-targeted, MIPS-style
+partial reduction) for latency-critical paths; and a pairwise merge used
+both host-side across segments and inside collectives across shards.
+
+Tie-breaking matches Lucene: equal scores order by ascending docid.
+lax.top_k already returns the smallest index among equals, so per-segment
+results agree with the reference; the merge re-sorts by (-score, docid).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("k",))
+def topk(scores: jax.Array, k: int):
+    """Exact (values, indices) top-k, descending; ties → ascending index."""
+    return jax.lax.top_k(scores, k)
+
+
+@partial(jax.jit, static_argnames=("k", "recall_target"))
+def approx_topk(scores: jax.Array, k: int, recall_target: float = 0.95):
+    """TPU-optimized approximate top-k (lax.approx_max_k): ~constant-factor
+    faster at large n; recall_target trades speed for exactness."""
+    return jax.lax.approx_max_k(scores, k, recall_target=recall_target)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def masked_topk(scores: jax.Array, live: jax.Array, k: int):
+    """Top-k over live, matching docs only: non-matching docs hold score
+    0.0 (see ops/bm25.py), deleted docs are masked — both drop to -inf so
+    they can never enter the result set. Returns (values, indices); a
+    value of -inf means "fewer than k matches"."""
+    masked = jnp.where(live & (scores > 0.0), scores, -jnp.inf)
+    return jax.lax.top_k(masked, k)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def merge_topk(values_a: jax.Array, ids_a: jax.Array,
+               values_b: jax.Array, ids_b: jax.Array, k: int):
+    """Merge two top-k lists into one, re-tie-breaking by ascending id.
+
+    Sort key packs (-score, id) lexicographically via sort over negated
+    score with a stable secondary sort on id (jnp.lexsort semantics).
+    """
+    v = jnp.concatenate([values_a, values_b])
+    i = jnp.concatenate([ids_a, ids_b])
+    # primary: score desc; secondary: id asc. lax.sort is stable, so sort
+    # by id first, then by negated score.
+    order_id = jnp.argsort(i, stable=True)
+    v2, i2 = v[order_id], i[order_id]
+    order_s = jnp.argsort(-v2, stable=True)
+    v3, i3 = v2[order_s], i2[order_s]
+    return v3[:k], i3[:k]

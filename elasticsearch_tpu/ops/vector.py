@@ -1,0 +1,86 @@
+"""Dense-vector scoring kernels — brute-force kNN as MXU matmuls.
+
+The TPU replacement for the reference's script-based brute force (ref:
+x-pack/plugin/vectors/.../query/ScoreScriptUtils.java:112-170 —
+cosineSimilarity/dotProduct/l2norm iterate doc-values bytes per doc; no
+ANN exists at this version, SURVEY.md §2.6 "vectors"). Here the whole
+segment's vectors live in HBM as an [ND, D] slab (bf16 by default) and a
+query batch scores as one [Q, D] @ [D, ND] matmul with f32 accumulation —
+exactly the shape the MXU wants.
+
+Cosine is computed as dot over pre-normalized doc vectors (norms applied
+at upload), matching float32 cosine to ~1e-3; set dtype=float32 for exact
+parity at 2× HBM.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def prepare_vectors(vectors: np.ndarray, similarity: str,
+                    dtype=jnp.bfloat16):
+    """Host-side prep for device upload: returns (prepped [ND, D], norms
+    [ND]). For cosine the slab is pre-normalized (zero vectors stay zero)."""
+    norms = np.linalg.norm(vectors, axis=1)
+    if similarity == "cosine":
+        safe = np.where(norms > 0, norms, 1.0)[:, None]
+        prepped = (vectors / safe).astype(dtype)
+    else:
+        prepped = vectors.astype(dtype)
+    return prepped, norms.astype(np.float32)
+
+
+@jax.jit
+def dot_scores(queries: jax.Array,   # [Q, D] float32
+               vectors: jax.Array    # [ND, D] (bf16 or f32)
+               ) -> jax.Array:       # [Q, ND] float32
+    # HIGHEST keeps f32 slabs exact (parity checks); bf16 slabs are
+    # unaffected — single-pass MXU either way
+    return jnp.einsum("qd,nd->qn", queries.astype(vectors.dtype), vectors,
+                      preferred_element_type=jnp.float32,
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+@jax.jit
+def cosine_scores(queries: jax.Array,  # [Q, D] float32 (un-normalized)
+                  unit_vectors: jax.Array  # [ND, D] pre-normalized slab
+                  ) -> jax.Array:
+    qn = jnp.linalg.norm(queries, axis=1, keepdims=True)
+    q = queries / jnp.where(qn > 0, qn, 1.0)
+    return dot_scores(q, unit_vectors)
+
+
+@jax.jit
+def l2_scores(queries: jax.Array, vectors: jax.Array,
+              doc_sq_norms: jax.Array  # [ND] float32 = ||v||²
+              ) -> jax.Array:
+    """Negated squared L2 distance (higher = closer), via the
+    ||q||² - 2q·v + ||v||² expansion so the matmul still rides the MXU."""
+    dots = dot_scores(queries, vectors)                       # [Q, ND]
+    q_sq = jnp.sum(queries * queries, axis=1, keepdims=True)  # [Q, 1]
+    return -(q_sq - 2.0 * dots + doc_sq_norms[None, :])
+
+
+# ---------------------------------------------------------------------------
+# Scalar references (parity targets for the painless functions in the
+# reference: cosineSimilarity / dotProduct / l2norm)
+# ---------------------------------------------------------------------------
+
+def cosine_reference(query: np.ndarray, vectors: np.ndarray) -> np.ndarray:
+    qn = np.linalg.norm(query)
+    vn = np.linalg.norm(vectors, axis=1)
+    denom = np.where((qn > 0) & (vn > 0), qn * vn, 1.0)
+    return (vectors @ query) / denom
+
+
+def dot_reference(query: np.ndarray, vectors: np.ndarray) -> np.ndarray:
+    return vectors @ query
+
+
+def l2_reference(query: np.ndarray, vectors: np.ndarray) -> np.ndarray:
+    return -np.sum((vectors - query[None, :]) ** 2, axis=1)

@@ -1,0 +1,122 @@
+"""Analysis chain tests (model: the reference's analysis-common tests +
+ESTokenStreamTestCase assertions)."""
+
+import pytest
+
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.analysis.filters import PorterStemFilter
+from elasticsearch_tpu.analysis.tokenizers import StandardTokenizer, Token
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.common.settings import Settings
+
+
+def test_standard_analyzer():
+    reg = AnalysisRegistry()
+    terms = reg.get("standard").terms("The Quick-Brown Fox, jumped over 2 dogs!")
+    assert terms == ["the", "quick", "brown", "fox", "jumped", "over", "2", "dogs"]
+
+
+def test_standard_tokenizer_offsets_positions():
+    toks = StandardTokenizer().tokenize("foo bar")
+    assert toks == [Token("foo", 0, 0, 3), Token("bar", 1, 4, 7)]
+
+
+def test_whitespace_and_keyword():
+    reg = AnalysisRegistry()
+    assert reg.get("whitespace").terms("Foo Bar-Baz") == ["Foo", "Bar-Baz"]
+    assert reg.get("keyword").terms("New York") == ["New York"]
+
+
+def test_stop_analyzer():
+    reg = AnalysisRegistry()
+    assert reg.get("stop").terms("the quick fox") == ["quick", "fox"]
+
+
+def test_english_analyzer_stems():
+    reg = AnalysisRegistry()
+    assert reg.get("english").terms("running quickly") == ["run", "quickli"]
+
+
+@pytest.mark.parametrize("word,stem", [
+    ("caresses", "caress"), ("ponies", "poni"), ("cats", "cat"),
+    ("feed", "feed"), ("agreed", "agre"), ("plastered", "plaster"),
+    ("motoring", "motor"), ("sing", "sing"), ("conflated", "conflat"),
+    ("troubled", "troubl"), ("sized", "size"), ("hopping", "hop"),
+    ("falling", "fall"), ("hissing", "hiss"), ("happy", "happi"),
+    ("relational", "relat"), ("conditional", "condit"),
+    ("vietnamization", "vietnam"), ("predication", "predic"),
+    ("feudalism", "feudal"), ("hopefulness", "hope"),
+    ("formalize", "formal"), ("electricity", "electr"),
+    ("adjustable", "adjust"), ("defensible", "defens"),
+    ("effective", "effect"), ("probate", "probat"), ("rate", "rate"),
+    ("controlling", "control"), ("rolling", "roll"),
+])
+def test_porter_stemmer_vectors(word, stem):
+    # classic vectors from Porter's 1980 paper
+    f = PorterStemFilter()
+    assert f._stem(word) == stem
+
+
+def test_unicode_folding():
+    reg = AnalysisRegistry(Settings.from_dict({
+        "index.analysis.analyzer.folded.type": "custom",
+        "index.analysis.analyzer.folded.tokenizer": "standard",
+        "index.analysis.analyzer.folded.filter": ["lowercase", "asciifolding"],
+    }))
+    assert reg.get("folded").terms("Crème Brûlée") == ["creme", "brulee"]
+
+
+def test_custom_analyzer_from_settings():
+    reg = AnalysisRegistry(Settings.from_dict({
+        "index.analysis.filter.my_stop.type": "stop",
+        "index.analysis.filter.my_stop.stopwords": ["foo"],
+        "index.analysis.analyzer.my.type": "custom",
+        "index.analysis.analyzer.my.tokenizer": "whitespace",
+        "index.analysis.analyzer.my.filter": ["lowercase", "my_stop"],
+    }))
+    assert reg.get("my").terms("Foo BAR baz") == ["bar", "baz"]
+
+
+def test_html_strip_char_filter():
+    reg = AnalysisRegistry(Settings.from_dict({
+        "index.analysis.analyzer.h.type": "custom",
+        "index.analysis.analyzer.h.tokenizer": "standard",
+        "index.analysis.analyzer.h.char_filter": ["html_strip"],
+        "index.analysis.analyzer.h.filter": ["lowercase"],
+    }))
+    assert reg.get("h").terms("<p>Hello &amp; <b>World</b></p>") == ["hello", "world"]
+
+
+def test_unknown_analyzer_raises():
+    reg = AnalysisRegistry()
+    with pytest.raises(IllegalArgumentException):
+        reg.get("nope")
+
+
+def test_unknown_filter_raises():
+    with pytest.raises(IllegalArgumentException):
+        AnalysisRegistry(Settings.from_dict({
+            "index.analysis.analyzer.bad.type": "custom",
+            "index.analysis.analyzer.bad.tokenizer": "standard",
+            "index.analysis.analyzer.bad.filter": ["made_up"],
+        }))
+
+
+def test_shingle_filter():
+    reg = AnalysisRegistry(Settings.from_dict({
+        "index.analysis.analyzer.sh.type": "custom",
+        "index.analysis.analyzer.sh.tokenizer": "whitespace",
+        "index.analysis.analyzer.sh.filter": ["shingle"],
+    }))
+    assert reg.get("sh").terms("a b c") == ["a", "a b", "b", "b c", "c"]
+
+
+def test_ngram_tokenizer():
+    reg = AnalysisRegistry(Settings.from_dict({
+        "index.analysis.tokenizer.ng.type": "ngram",
+        "index.analysis.tokenizer.ng.min_gram": 2,
+        "index.analysis.tokenizer.ng.max_gram": 3,
+        "index.analysis.analyzer.ng.type": "custom",
+        "index.analysis.analyzer.ng.tokenizer": "ng",
+    }))
+    assert reg.get("ng").terms("abcd") == ["ab", "abc", "bc", "bcd", "cd"]

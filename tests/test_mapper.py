@@ -1,0 +1,134 @@
+"""Mapping/document-parser tests (model: the reference's DocumentParserTests,
+DynamicMappingTests, MapperServiceTests)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    MapperParsingException,
+    StrictDynamicMappingException,
+)
+from elasticsearch_tpu.index.mapper import MapperService
+
+
+MAPPINGS = {
+    "properties": {
+        "title": {"type": "text"},
+        "tags": {"type": "keyword"},
+        "views": {"type": "long"},
+        "score": {"type": "float"},
+        "published": {"type": "boolean"},
+        "created": {"type": "date"},
+        "embedding": {"type": "dense_vector", "dims": 4},
+        "author": {"properties": {"name": {"type": "text"}}},
+    }
+}
+
+
+def make_service():
+    return MapperService(mappings=MAPPINGS)
+
+
+def test_parse_typed_fields():
+    svc = make_service()
+    doc = svc.parse("1", {
+        "title": "The quick brown fox",
+        "tags": ["a", "b"],
+        "views": 42,
+        "score": "1.5",
+        "published": True,
+        "created": "2020-06-15",
+        "embedding": [1.0, 0.0, 0.0, 0.0],
+        "author": {"name": "Jane Doe"},
+    })
+    assert [t.term for t in doc.text_tokens["title"]] == ["the", "quick", "brown", "fox"]
+    assert doc.keyword_terms["tags"] == ["a", "b"]
+    assert doc.numeric_values["views"] == [42.0]
+    assert doc.numeric_values["score"] == [1.5]
+    assert doc.numeric_values["published"] == [1.0]
+    assert doc.numeric_values["created"][0] == 1592179200000.0
+    assert np.allclose(doc.vectors["embedding"], [1, 0, 0, 0])
+    assert [t.term for t in doc.text_tokens["author.name"]] == ["jane", "doe"]
+    assert doc.field_length("title") == 4
+
+
+def test_dynamic_mapping_infers_types():
+    svc = MapperService()
+    doc = svc.parse("1", {"name": "hello world", "count": 7, "ratio": 0.5, "flag": False})
+    assert svc.field_type("name").type_name == "text"
+    assert svc.field_type("name.keyword").type_name == "keyword"
+    assert svc.field_type("count").type_name == "long"
+    assert svc.field_type("ratio").type_name == "float"
+    assert svc.field_type("flag").type_name == "boolean"
+    assert "name" in doc.dynamic_mappings
+    # dynamic string got indexed both as text and keyword
+    assert [t.term for t in doc.text_tokens["name"]] == ["hello", "world"]
+    assert doc.keyword_terms["name.keyword"] == ["hello world"]
+
+
+def test_dynamic_date_detection():
+    svc = MapperService()
+    svc.parse("1", {"ts": "2021-03-04T05:06:07"})
+    assert svc.field_type("ts").type_name == "date"
+
+
+def test_strict_dynamic_rejects():
+    svc = MapperService(mappings={"dynamic": "strict", "properties": {"a": {"type": "long"}}})
+    with pytest.raises(StrictDynamicMappingException):
+        svc.parse("1", {"a": 1, "unknown": "x"})
+
+
+def test_dynamic_false_ignores():
+    svc = MapperService(mappings={"dynamic": "false", "properties": {"a": {"type": "long"}}})
+    doc = svc.parse("1", {"a": 1, "unknown": "x"})
+    assert svc.field_type("unknown") is None
+    assert "unknown" not in doc.text_tokens
+
+
+def test_numeric_range_validation():
+    svc = MapperService(mappings={"properties": {"b": {"type": "byte"}}})
+    with pytest.raises(MapperParsingException):
+        svc.parse("1", {"b": 1000})
+
+
+def test_bad_number_raises():
+    svc = MapperService(mappings={"properties": {"n": {"type": "integer"}}})
+    with pytest.raises(MapperParsingException):
+        svc.parse("1", {"n": "not-a-number"})
+
+
+def test_dense_vector_dim_check():
+    svc = MapperService(mappings={"properties": {"v": {"type": "dense_vector", "dims": 3}}})
+    with pytest.raises(MapperParsingException):
+        svc.parse("1", {"v": [1.0, 2.0]})
+    with pytest.raises(MapperParsingException):
+        MapperService(mappings={"properties": {"v": {"type": "dense_vector", "dims": 4096}}})
+
+
+def test_merge_conflicting_type_rejected():
+    svc = make_service()
+    with pytest.raises(IllegalArgumentException):
+        svc.merge({"properties": {"views": {"type": "text"}}})
+
+
+def test_merge_adds_fields():
+    svc = make_service()
+    svc.merge({"properties": {"extra": {"type": "keyword"}}})
+    assert svc.field_type("extra").type_name == "keyword"
+
+
+def test_mapping_roundtrip():
+    svc = make_service()
+    out = svc.to_mapping()
+    assert out["properties"]["title"]["type"] == "text"
+    assert out["properties"]["author"]["properties"]["name"]["type"] == "text"
+    assert out["properties"]["embedding"] == {"type": "dense_vector", "dims": 4}
+
+
+def test_multivalue_text_position_gap():
+    svc = MapperService(mappings={"properties": {"t": {"type": "text"}}})
+    doc = svc.parse("1", {"t": ["foo bar", "baz"]})
+    toks = doc.text_tokens["t"]
+    assert [t.term for t in toks] == ["foo", "bar", "baz"]
+    assert toks[2].position >= toks[1].position + 100  # gap between values

@@ -1,0 +1,166 @@
+"""Segment format tests (model: Lucene index round-trip tests; validates the
+padded-block postings invariants the kernels rely on)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.mapper import MapperService
+from elasticsearch_tpu.index.segment import (
+    BLOCK_SIZE,
+    Segment,
+    SegmentWriter,
+    merge_segments,
+)
+
+MAPPINGS = {
+    "properties": {
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "n": {"type": "long"},
+        "vec": {"type": "dense_vector", "dims": 3},
+    }
+}
+
+
+def build_segment(docs, name="s0"):
+    svc = MapperService(mappings=MAPPINGS)
+    w = SegmentWriter()
+    for i, src in enumerate(docs):
+        w.add(svc.parse(str(i), src))
+    return w.build(name)
+
+
+def test_postings_roundtrip():
+    seg = build_segment([
+        {"body": "the quick brown fox", "tag": "a", "n": 1},
+        {"body": "the lazy dog", "tag": "b", "n": 2},
+        {"body": "quick quick dog", "tag": "a"},
+    ])
+    pf = seg.postings["body"]
+    docids, tfs = pf.postings("quick")
+    assert docids.tolist() == [0, 2]
+    assert tfs.tolist() == [1.0, 2.0]
+    docids, tfs = pf.postings("the")
+    assert docids.tolist() == [0, 1]
+    assert pf.term_id("missing") == -1
+    assert pf.postings("missing")[0].size == 0
+    # stats
+    assert pf.doc_count == 3
+    assert pf.sum_total_term_freq == 4 + 3 + 3
+    assert pf.field_lengths.tolist() == [4.0, 3.0, 3.0]
+
+
+def test_block_padding_invariants():
+    # a term with > BLOCK_SIZE postings spans multiple blocks; padding has tf=0
+    docs = [{"body": "common"} for _ in range(BLOCK_SIZE + 10)]
+    docs.append({"body": "rare"})
+    seg = build_segment(docs)
+    pf = seg.postings["body"]
+    start, count = pf.term_blocks("common")
+    assert count == 2
+    blk = pf.block_tfs[start : start + count]
+    assert (blk.reshape(-1) > 0).sum() == BLOCK_SIZE + 10
+    # rare term's block is its own — never shares with 'common'
+    rstart, rcount = pf.term_blocks("rare")
+    assert rcount == 1
+    assert rstart >= start + count
+    docids, _ = pf.postings("rare")
+    assert docids.tolist() == [BLOCK_SIZE + 10]
+
+
+def test_block_max_metadata_is_valid_bound():
+    rng = np.random.default_rng(7)
+    docs = [{"body": " ".join(rng.choice(["a", "b", "c", "d"], size=rng.integers(1, 30)))}
+            for _ in range(300)]
+    seg = build_segment(docs)
+    pf = seg.postings["body"]
+    k1, b = 1.2, 0.75
+    avg = pf.avg_field_length
+    for blk in range(pf.num_blocks):
+        tfs = pf.block_tfs[blk]
+        dids = pf.block_docids[blk]
+        mask = tfs > 0
+        if not mask.any():
+            continue
+        lens = pf.field_lengths[dids[mask]]
+        actual = tfs[mask] / (tfs[mask] + k1 * (1 - b + b * lens / avg))
+        bound_tf = pf.block_max_tf[blk]
+        bound = bound_tf / (bound_tf + k1 * (1 - b + b * pf.block_min_len[blk] / avg))
+        assert actual.max() <= bound + 1e-6
+
+
+def test_doc_values_and_vectors():
+    seg = build_segment([
+        {"n": 5, "vec": [1.0, 0.0, 0.0], "tag": ["x", "y"]},
+        {"body": "no numeric"},
+        {"n": 7},
+    ])
+    nv = seg.numerics["n"]
+    assert nv.values[0] == 5.0 and nv.values[2] == 7.0
+    assert nv.missing.tolist() == [False, True, False]
+    assert nv.get(0) == [5.0]
+    kv = seg.keywords["tag"]
+    assert kv.get(0) == ["x", "y"]
+    assert kv.get(1) == []
+    vv = seg.vectors["vec"]
+    assert vv.has_value.tolist() == [True, False, False]
+    assert np.allclose(vv.vectors[0], [1, 0, 0])
+
+
+def test_stored_fields_and_ids():
+    seg = build_segment([{"body": "hello"}, {"body": "world", "n": 2}])
+    import json
+    assert json.loads(seg.stored.source(1)) == {"body": "world", "n": 2}
+    assert seg.docid_for("1") == 1
+    assert seg.docid_for("404") == -1
+
+
+def test_save_load_roundtrip(tmp_path):
+    seg = build_segment([
+        {"body": "the quick brown fox", "tag": "a", "n": 1, "vec": [1.0, 2.0, 3.0]},
+        {"body": "lazy dog", "tag": "b", "n": 2},
+    ])
+    seg.delete(1)
+    seg.save(str(tmp_path / "seg"))
+    loaded = Segment.load(str(tmp_path / "seg"))
+    assert loaded.n_docs == 2
+    assert loaded.live.tolist() == [True, False]
+    pf0, pf1 = seg.postings["body"], loaded.postings["body"]
+    assert pf0.terms == pf1.terms
+    np.testing.assert_array_equal(pf0.block_docids, pf1.block_docids)
+    np.testing.assert_array_equal(pf0.block_tfs, pf1.block_tfs)
+    assert loaded.numerics["n"].values.tolist() == [1.0, 2.0]
+    assert np.allclose(loaded.vectors["vec"].vectors[0], [1, 2, 3])
+    assert loaded.stored.ids == ["0", "1"]
+    assert loaded.keywords["tag"].get(0) == ["a"]
+
+
+def test_merge_drops_deletes_and_remaps():
+    seg1 = build_segment([
+        {"body": "alpha beta", "tag": "a", "n": 1},
+        {"body": "beta gamma", "tag": "b", "n": 2},
+    ], "s1")
+    seg2 = build_segment([
+        {"body": "gamma delta", "tag": "a", "n": 3, "vec": [1.0, 0.0, 0.0]},
+    ], "s2")
+    seg1.delete(0)
+    merged = merge_segments("m", [seg1, seg2])
+    assert merged.n_docs == 2
+    pf = merged.postings["body"]
+    assert pf.postings("alpha")[0].size == 0 or "alpha" not in pf.terms
+    docids, _ = pf.postings("gamma")
+    assert docids.tolist() == [0, 1]  # old seg1/doc1 -> 0, seg2/doc0 -> 1
+    assert merged.numerics["n"].values.tolist() == [2.0, 3.0]
+    assert merged.keywords["tag"].get(0) == ["b"]
+    assert merged.vectors["vec"].has_value.tolist() == [False, True]
+    assert merged.stored.ids == ["1", "0"]
+    # stats rebuilt
+    assert pf.doc_count == 2
+
+
+def test_merge_preserves_field_lengths():
+    seg1 = build_segment([{"body": "one two three"}], "s1")
+    seg2 = build_segment([{"body": "four"}], "s2")
+    merged = merge_segments("m", [seg1, seg2])
+    assert merged.postings["body"].field_lengths.tolist() == [3.0, 1.0]
+    assert merged.postings["body"].avg_field_length == 2.0
