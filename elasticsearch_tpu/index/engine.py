@@ -1,0 +1,425 @@
+"""The engine: versioned writes over immutable segments + WAL.
+
+Mirrors the reference's InternalEngine (ref: index/engine/
+InternalEngine.java:831-910 — per-op versioning plan → index into Lucene →
+translog append; LiveVersionMap for realtime GET; refresh publishes
+near-real-time readers; flush = commit + translog roll). TPU re-design:
+
+- The indexing buffer is a list of parsed docs; **refresh** builds an
+  immutable columnar segment (index/segment.py) and atomically swaps the
+  published segment list — the epoch-pointer swap that maps directly to
+  swapping device-resident segment sets in HBM (SURVEY.md §7 stage 4).
+- Updates/deletes of already-refreshed docs flip the target segment's live
+  mask (soft deletes as masks); in-buffer updates tombstone the buffered doc.
+- **flush** persists segments + a commit point, rolls the translog
+  generation, trims old generations. Crash recovery = load commit point,
+  replay newer translog ops.
+- A merge policy folds small segments together (ref:
+  ElasticsearchConcurrentMergeScheduler / TieredMergePolicy, simplified).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import (
+    EngineClosedException,
+    VersionConflictEngineException,
+)
+from elasticsearch_tpu.index.mapper import MapperService, ParsedDocument
+from elasticsearch_tpu.index.segment import Segment, SegmentWriter, merge_segments
+from elasticsearch_tpu.index.seqno import LocalCheckpointTracker, NO_OPS_PERFORMED
+from elasticsearch_tpu.index.translog import Translog, TranslogOp
+
+
+@dataclass
+class VersionValue:
+    """LiveVersionMap entry (ref: index/engine/LiveVersionMap.java)."""
+
+    version: int
+    seq_no: int
+    primary_term: int
+    deleted: bool = False
+    buffer_idx: int = -1  # >= 0 while the doc lives in the indexing buffer
+
+
+@dataclass
+class IndexResult:
+    doc_id: str
+    version: int
+    seq_no: int
+    primary_term: int
+    created: bool
+
+
+@dataclass
+class DeleteResult:
+    doc_id: str
+    version: int
+    seq_no: int
+    primary_term: int
+    found: bool
+
+
+@dataclass
+class GetResult:
+    found: bool
+    doc_id: str
+    source: Optional[Dict[str, Any]] = None
+    version: int = -1
+    seq_no: int = -1
+    primary_term: int = -1
+
+
+class SearcherSnapshot:
+    """Point-in-time view over the published segments (the analogue of an
+    acquired Lucene searcher, ref: IndexShard.java:1215-1230). Holds the
+    segment list + the live-mask versions seen at acquisition."""
+
+    def __init__(self, segments: List[Segment], epoch: int):
+        self.segments = list(segments)
+        self.epoch = epoch
+
+    @property
+    def doc_count(self) -> int:
+        return sum(s.live_doc_count for s in self.segments)
+
+
+class Engine:
+    def __init__(self, shard_path: str, mapper_service: MapperService,
+                 merge_factor: int = 10):
+        self.path = shard_path
+        self.mapper = mapper_service
+        self.merge_factor = merge_factor
+        os.makedirs(shard_path, exist_ok=True)
+        self._lock = threading.RLock()
+        self._closed = False
+        self.primary_term = 1
+        self.tracker = LocalCheckpointTracker()
+        self.version_map: Dict[str, VersionValue] = {}
+        self._buffer: List[Tuple[str, ParsedDocument]] = []
+        self._buffer_dead: set = set()
+        # keyed (segment name, docid) to dedupe repeated tombstones pre-refresh
+        self._pending_tombstones: Dict[Tuple[str, int], Tuple[Segment, int]] = {}
+        self._segments: List[Segment] = []
+        self._dirty_segments: set = set()   # names needing (re)save
+        self._epoch = 0                      # bumps on every refresh/delete
+        self._seg_counter = 0
+        # segment names must be GLOBALLY unique (device caches key on them
+        # across shards/indices), so prefix with a per-engine-instance uuid
+        self._seg_prefix = uuid.uuid4().hex[:12]
+        self.translog = Translog(os.path.join(shard_path, "translog"))
+        self._recover()
+
+    # ------------------------------------------------------------ recovery
+    def _commit_path(self) -> str:
+        return os.path.join(self.path, "segments.json")
+
+    def _recover(self) -> None:
+        commit_gen = 1
+        if os.path.exists(self._commit_path()):
+            with open(self._commit_path()) as fh:
+                commit = json.load(fh)
+            for name in commit["segments"]:
+                seg = Segment.load(os.path.join(self.path, name))
+                self._segments.append(seg)
+            commit_gen = commit["translog_generation"]
+            self.primary_term = commit.get("primary_term", 1)
+            self._seg_counter = commit.get("seg_counter", len(self._segments))
+            max_seq = commit.get("max_seq_no", NO_OPS_PERFORMED)
+            self.tracker = LocalCheckpointTracker(max_seq, max_seq)
+            # rebuild version map from the persisted metadata doc values
+            for seg in self._segments:
+                seq_nv = seg.numerics.get("_seq_no")
+                term_nv = seg.numerics.get("_primary_term")
+                ver_nv = seg.numerics.get("_version")
+                for docid, doc_id in enumerate(seg.stored.ids):
+                    if seg.live[docid]:
+                        self.version_map[doc_id] = VersionValue(
+                            version=int(ver_nv.values[docid]) if ver_nv is not None else 1,
+                            seq_no=int(seq_nv.values[docid]) if seq_nv is not None else NO_OPS_PERFORMED,
+                            primary_term=int(term_nv.values[docid]) if term_nv is not None else self.primary_term)
+        # replay translog ops recorded after the commit point
+        # (ref: InternalEngine.recoverFromTranslog)
+        for op in self.translog.read_ops(commit_gen):
+            if op.op_type == "index":
+                self._index_internal(op.doc_id, op.source, seq_no=op.seq_no,
+                                     primary_term=op.primary_term,
+                                     from_translog=True)
+            elif op.op_type == "delete":
+                self._delete_internal(op.doc_id, seq_no=op.seq_no,
+                                      primary_term=op.primary_term,
+                                      from_translog=True)
+            self.tracker.advance_max_seq_no(op.seq_no)
+            self.tracker.mark_seq_no_as_processed(op.seq_no)
+
+    # ------------------------------------------------------------- writes
+    def _check_open(self):
+        if self._closed:
+            raise EngineClosedException("engine is closed")
+
+    def index(self, doc_id: str, source: Dict[str, Any],
+              seq_no: Optional[int] = None, primary_term: Optional[int] = None,
+              if_seq_no: Optional[int] = None,
+              if_primary_term: Optional[int] = None,
+              op_type: str = "index") -> IndexResult:
+        """ref: InternalEngine.index:831 — plan (version/CAS checks) →
+        index into the buffer → translog append."""
+        with self._lock:
+            self._check_open()
+            existing = self.version_map.get(doc_id)
+            exists = existing is not None and not existing.deleted
+            # CAS (if_seq_no/if_primary_term, ref: compare-and-set on seqno)
+            if if_seq_no is not None or if_primary_term is not None:
+                if not exists:
+                    raise VersionConflictEngineException(
+                        doc_id, "document does not exist")
+                if (existing.seq_no != if_seq_no or
+                        existing.primary_term != if_primary_term):
+                    raise VersionConflictEngineException(
+                        doc_id,
+                        f"required seqNo [{if_seq_no}], primary term "
+                        f"[{if_primary_term}]. current document has seqNo "
+                        f"[{existing.seq_no}] and primary term "
+                        f"[{existing.primary_term}]")
+            if op_type == "create" and exists:
+                raise VersionConflictEngineException(
+                    doc_id, "document already exists")
+            result = self._index_internal(
+                doc_id, source, seq_no=seq_no, primary_term=primary_term)
+            self.translog.add(TranslogOp(
+                "index", result.seq_no, result.primary_term,
+                doc_id=doc_id, source=source, version=result.version))
+            return result
+
+    def _index_internal(self, doc_id, source, seq_no=None, primary_term=None,
+                        from_translog=False) -> IndexResult:
+        if seq_no is None:
+            seq_no = self.tracker.generate_seq_no()
+        else:
+            self.tracker.advance_max_seq_no(seq_no)
+        if primary_term is None:
+            primary_term = self.primary_term
+        existing = self.version_map.get(doc_id)
+        exists = existing is not None and not existing.deleted
+        version = existing.version + 1 if exists else 1
+        created = not exists
+        self._remove_current_doc(doc_id, existing)
+        parsed = self.mapper.parse(doc_id, source)
+        # persist seqno/term/version as metadata doc values so CAS state
+        # survives restart (ref: SeqNoFieldMapper/VersionFieldMapper —
+        # _seq_no and _version are indexed per doc)
+        parsed.numeric_values["_seq_no"] = [float(seq_no)]
+        parsed.numeric_values["_primary_term"] = [float(primary_term)]
+        parsed.numeric_values["_version"] = [float(version)]
+        self._buffer.append((doc_id, parsed))
+        self.version_map[doc_id] = VersionValue(
+            version=version, seq_no=seq_no, primary_term=primary_term,
+            buffer_idx=len(self._buffer) - 1)
+        if not from_translog:
+            self.tracker.mark_seq_no_as_processed(seq_no)
+        return IndexResult(doc_id, version, seq_no, primary_term, created)
+
+    def delete(self, doc_id: str,
+               if_seq_no: Optional[int] = None,
+               if_primary_term: Optional[int] = None) -> DeleteResult:
+        with self._lock:
+            self._check_open()
+            existing = self.version_map.get(doc_id)
+            exists = existing is not None and not existing.deleted
+            if if_seq_no is not None and (
+                    not exists or existing.seq_no != if_seq_no or
+                    existing.primary_term != if_primary_term):
+                raise VersionConflictEngineException(
+                    doc_id, f"required seqNo [{if_seq_no}]")
+            result = self._delete_internal(doc_id)
+            self.translog.add(TranslogOp(
+                "delete", result.seq_no, result.primary_term, doc_id=doc_id))
+            return result
+
+    def _delete_internal(self, doc_id, seq_no=None, primary_term=None,
+                         from_translog=False) -> DeleteResult:
+        if seq_no is None:
+            seq_no = self.tracker.generate_seq_no()
+        else:
+            self.tracker.advance_max_seq_no(seq_no)
+        if primary_term is None:
+            primary_term = self.primary_term
+        existing = self.version_map.get(doc_id)
+        found = existing is not None and not existing.deleted
+        version = existing.version + 1 if existing else 1
+        self._remove_current_doc(doc_id, existing)
+        self.version_map[doc_id] = VersionValue(
+            version=version, seq_no=seq_no, primary_term=primary_term,
+            deleted=True)
+        if not from_translog:
+            self.tracker.mark_seq_no_as_processed(seq_no)
+        return DeleteResult(doc_id, version, seq_no, primary_term, found)
+
+    def _remove_current_doc(self, doc_id: str, existing: Optional[VersionValue]):
+        """Tombstone the current copy of a doc, wherever it lives. Segment
+        tombstones are DEFERRED to the next refresh so the previous version
+        stays searchable until then (ES NRT semantics: neither updates nor
+        deletes are visible to search before a refresh)."""
+        if existing is not None and existing.buffer_idx >= 0:
+            self._buffer_dead.add(existing.buffer_idx)
+            return
+        for seg in self._segments:
+            docid = seg.docid_for(doc_id)
+            if docid >= 0:
+                self._pending_tombstones[(seg.name, docid)] = (seg, docid)
+                return
+
+    # -------------------------------------------------------------- reads
+    def get(self, doc_id: str) -> GetResult:
+        """Realtime GET (ref: LiveVersionMap + translog realtime get,
+        index/shard/IndexShard.java:926): buffered docs are visible before
+        any refresh."""
+        with self._lock:
+            vv = self.version_map.get(doc_id)
+            if vv is None or vv.deleted:
+                return GetResult(False, doc_id)
+            if vv.buffer_idx >= 0:
+                _, parsed = self._buffer[vv.buffer_idx]
+                return GetResult(True, doc_id, json.loads(parsed.source),
+                                 vv.version, vv.seq_no, vv.primary_term)
+            for seg in self._segments:
+                docid = seg.docid_for(doc_id)
+                if docid >= 0:
+                    return GetResult(True, doc_id,
+                                     json.loads(seg.stored.source(docid)),
+                                     vv.version, vv.seq_no, vv.primary_term)
+            return GetResult(False, doc_id)
+
+    def acquire_searcher(self) -> SearcherSnapshot:
+        with self._lock:
+            return SearcherSnapshot(self._segments, self._epoch)
+
+    # ------------------------------------------------------ refresh/flush
+    def refresh(self) -> bool:
+        """Publish buffered docs as a new immutable segment (epoch swap).
+        Returns True if a new segment was published."""
+        with self._lock:
+            self._check_open()
+            changed = False
+            if self._pending_tombstones:
+                for seg, docid in self._pending_tombstones.values():
+                    seg.delete(docid)
+                    self._dirty_segments.add(seg.name)
+                self._pending_tombstones = {}
+                changed = True
+            if not self._buffer:
+                if changed:
+                    self._epoch += 1
+                    self._maybe_merge()
+                return False
+            writer = SegmentWriter()
+            kept_ids = []
+            for idx, (doc_id, parsed) in enumerate(self._buffer):
+                if idx not in self._buffer_dead:
+                    writer.add(parsed)
+                    kept_ids.append(doc_id)
+            published = False
+            if len(writer):
+                name = f"seg_{self._seg_prefix}_{self._seg_counter}"
+                self._seg_counter += 1
+                seg = writer.build(name)
+                self._segments = self._segments + [seg]
+                self._dirty_segments.add(name)
+                published = True
+            for doc_id in kept_ids:
+                vv = self.version_map.get(doc_id)
+                if vv is not None:
+                    vv.buffer_idx = -1
+            self._buffer = []
+            self._buffer_dead = set()
+            self._epoch += 1
+            self._maybe_merge()
+            return published
+
+    def _maybe_merge(self) -> None:
+        """Fold the smallest segments when too many accumulate."""
+        if len(self._segments) <= self.merge_factor:
+            return
+        by_size = sorted(self._segments, key=lambda s: s.live_doc_count)
+        to_merge = by_size[: len(self._segments) - self.merge_factor + 1]
+        self.force_merge_segments(to_merge)
+
+    def force_merge(self, max_num_segments: int = 1) -> None:
+        """ref: forcemerge API."""
+        with self._lock:
+            self.refresh()
+            if len(self._segments) > max_num_segments:
+                self.force_merge_segments(list(self._segments))
+
+    def force_merge_segments(self, to_merge: List[Segment]) -> None:
+        name = f"seg_{self._seg_prefix}_{self._seg_counter}"
+        self._seg_counter += 1
+        merged = merge_segments(name, to_merge)
+        merge_set = {s.name for s in to_merge}
+        self._segments = [s for s in self._segments
+                          if s.name not in merge_set] + [merged]
+        self._dirty_segments -= merge_set
+        self._dirty_segments.add(name)
+        self._epoch += 1
+
+    def flush(self) -> None:
+        """refresh + persist segments + commit point + translog roll
+        (ref: InternalEngine.flush = Lucene commit + translog roll)."""
+        with self._lock:
+            self._check_open()
+            self.refresh()
+            for seg in self._segments:
+                if seg.name in self._dirty_segments:
+                    seg.save(os.path.join(self.path, seg.name))
+            self._dirty_segments = set()
+            self.translog.sync()
+            new_gen = self.translog.roll_generation()
+            commit = {
+                "segments": [s.name for s in self._segments],
+                "translog_generation": new_gen,
+                "max_seq_no": self.tracker.max_seq_no,
+                "local_checkpoint": self.tracker.checkpoint,
+                "primary_term": self.primary_term,
+                "seg_counter": self._seg_counter,
+            }
+            tmp = self._commit_path() + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(commit, fh)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self._commit_path())
+            self.translog.trim_generations(new_gen)
+
+    # -------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "docs": {"count": sum(s.live_doc_count for s in self._segments)
+                         + len(self._buffer) - len(self._buffer_dead)
+                         - len(self._pending_tombstones),
+                         "deleted": sum(s.n_docs - s.live_doc_count
+                                        for s in self._segments)},
+                "segments": {"count": len(self._segments)},
+                "translog": self.translog.stats(),
+                "seq_no": {"max_seq_no": self.tracker.max_seq_no,
+                           "local_checkpoint": self.tracker.checkpoint},
+            }
+
+    @property
+    def segments(self) -> List[Segment]:
+        return self._segments
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self.translog.close()

@@ -271,6 +271,8 @@ class ParsedDocument:
     numeric_values: Dict[str, List[float]] = field(default_factory=dict)
     # field -> np.ndarray [dims] float32
     vectors: Dict[str, np.ndarray] = field(default_factory=dict)
+    # field -> similarity name (cosine | dot_product | l2_norm)
+    vector_similarity: Dict[str, str] = field(default_factory=dict)
     # dynamic-mapping update discovered during parse (field -> mapping dict)
     dynamic_mappings: Dict[str, Dict[str, Any]] = field(default_factory=dict)
 
@@ -428,6 +430,7 @@ class DocumentMapper:
                 parsed.numeric_values.setdefault(ft.name, []).append(float(typed))
             elif ft.docvalue_kind == "vector":
                 parsed.vectors[ft.name] = typed
+                parsed.vector_similarity[ft.name] = ft.similarity
 
 
 class MapperService:

@@ -173,6 +173,7 @@ class Segment:
         self.vectors = vectors
         self.stored = stored
         self.live = live if live is not None else np.ones(n_docs, dtype=bool)
+        self.live_version = 0  # bumps on delete; device caches key on it
         self._id_map: Optional[Dict[str, int]] = None
 
     @property
@@ -189,6 +190,7 @@ class Segment:
         """Soft delete — flips the live mask (immutable arrays elsewhere)."""
         self.live = self.live.copy()
         self.live[docid] = False
+        self.live_version += 1
 
     def docid_for(self, doc_id: str) -> int:
         d = self.id_map.get(doc_id, -1)
@@ -418,6 +420,8 @@ class SegmentWriter:
         vec_fields = {f for d in docs for f in d.vectors}
         for f in vec_fields:
             dims = next(d.vectors[f].shape[0] for d in docs if f in d.vectors)
+            sim = next((d.vector_similarity.get(f, "cosine") for d in docs
+                        if f in d.vectors), "cosine")
             arr = np.zeros((n, dims), np.float32)
             has = np.zeros(n, bool)
             for docid, d in enumerate(docs):
@@ -425,7 +429,7 @@ class SegmentWriter:
                 if v is not None:
                     arr[docid] = v
                     has[docid] = True
-            vectors[f] = VectorValues(f, arr, has, dims)
+            vectors[f] = VectorValues(f, arr, has, dims, sim)
 
         # ---- stored fields
         offsets = np.zeros(n + 1, np.int64)

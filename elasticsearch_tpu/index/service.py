@@ -1,0 +1,256 @@
+"""Index and indices services: the per-index shard group + node registry.
+
+Mirrors the reference's IndexService/IndicesService (ref: index/
+IndexService.java, indices/IndicesService.java; routing ref:
+cluster/routing/OperationRouting.java:42 — docs route to shards by
+murmur3(routing) % num_shards). An index here is N local shard engines
+(the data-parallel partitioning axis that maps onto device meshes in
+``parallel/``); searches fan out over shards and merge, writes route by id.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import struct
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IndexNotFoundException,
+    IllegalArgumentException,
+    ResourceAlreadyExistsException,
+)
+from elasticsearch_tpu.common.settings import (
+    INDEX_BM25_B,
+    INDEX_BM25_K1,
+    INDEX_NUMBER_OF_SHARDS,
+    Settings,
+)
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mapper import MapperService
+from elasticsearch_tpu.search.context import DeviceSegmentCache
+from elasticsearch_tpu.search.searcher import ShardSearcher
+
+
+def murmur3_hash(key: str) -> int:
+    """32-bit murmur3 (x86, seed 0) over the UTF-16LE bytes of the routing
+    key — bit-exact with the reference's Murmur3HashFunction (ref:
+    cluster/routing/Murmur3HashFunction.java hashes char low/high bytes)
+    so doc→shard assignment agrees."""
+    data = key.encode("utf-16-le")
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = 0
+    rounded = len(data) & ~0x3
+    for i in range(0, rounded, 4):
+        (k,) = struct.unpack_from("<i", data, i)
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k = 0
+    tail = len(data) & 0x3
+    if tail >= 3:
+        k ^= data[rounded + 2] << 16
+    if tail >= 2:
+        k ^= data[rounded + 1] << 8
+    if tail >= 1:
+        k ^= data[rounded]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= len(data)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    # to signed 32-bit, matching Java
+    return h - 0x100000000 if h >= 0x80000000 else h
+
+
+class IndexService:
+    """One index: settings + mappings + N shard engines."""
+
+    def __init__(self, name: str, path: str, settings: Settings,
+                 mappings: Optional[Dict[str, Any]] = None,
+                 device_cache: Optional[DeviceSegmentCache] = None):
+        self.name = name
+        self.path = path
+        self.settings = settings
+        self.num_shards = INDEX_NUMBER_OF_SHARDS.get(settings)
+        self.k1 = INDEX_BM25_K1.get(settings)
+        self.b = INDEX_BM25_B.get(settings)
+        self.mapper = MapperService(settings, mappings)
+        self.device_cache = device_cache or DeviceSegmentCache()
+        os.makedirs(path, exist_ok=True)
+        self.shards: List[Engine] = [
+            Engine(os.path.join(path, str(shard_id)), self.mapper)
+            for shard_id in range(self.num_shards)
+        ]
+        self._known_seg_names: set = {
+            seg.name for shard in self.shards for seg in shard.segments}
+        self._persist_meta()
+
+    # ---------------------------------------------------------- metadata
+    def _persist_meta(self):
+        meta = {"settings": self.settings.as_dict(),
+                "mappings": self.mapper.to_mapping()}
+        tmp = os.path.join(self.path, "_meta.json.tmp")
+        with open(tmp, "w") as fh:
+            json.dump(meta, fh)
+        os.replace(tmp, os.path.join(self.path, "_meta.json"))
+
+    def update_mappings(self, mappings: Dict[str, Any]):
+        self.mapper.merge(mappings)
+        self._persist_meta()
+
+    # ------------------------------------------------------------ routing
+    def shard_for(self, doc_id: str, routing: Optional[str] = None) -> int:
+        key = routing if routing is not None else doc_id
+        return abs(murmur3_hash(key)) % self.num_shards
+
+    # ------------------------------------------------------------- writes
+    def index_doc(self, doc_id: str, source: Dict[str, Any],
+                  routing: Optional[str] = None, **kwargs):
+        shard = self.shards[self.shard_for(doc_id, routing)]
+        n_fields = len(self.mapper.mapper.fields)
+        result = shard.index(doc_id, source, **kwargs)
+        if len(self.mapper.mapper.fields) != n_fields:
+            # dynamic mappings grew during parse; keep _meta fresh
+            self._persist_meta()
+        return result
+
+    def delete_doc(self, doc_id: str, routing: Optional[str] = None, **kwargs):
+        return self.shards[self.shard_for(doc_id, routing)].delete(doc_id, **kwargs)
+
+    def get_doc(self, doc_id: str, routing: Optional[str] = None):
+        return self.shards[self.shard_for(doc_id, routing)].get(doc_id)
+
+    def refresh(self):
+        for shard in self.shards:
+            shard.refresh()
+        self._gc_device_cache()
+
+    def flush(self):
+        for shard in self.shards:
+            shard.flush()
+        self._gc_device_cache()
+
+    def force_merge(self, max_num_segments: int = 1):
+        for shard in self.shards:
+            shard.force_merge(max_num_segments)
+        self._gc_device_cache()
+
+    def _gc_device_cache(self):
+        """Evict device copies of segments retired by merges (segment names
+        are globally unique, so eviction can't hit another index)."""
+        current = {seg.name for shard in self.shards for seg in shard.segments}
+        stale = self._known_seg_names - current
+        if stale:
+            self.device_cache.evict(stale)
+        self._known_seg_names = current
+
+    # ------------------------------------------------------------ search
+    def shard_searchers(self) -> List[ShardSearcher]:
+        return [ShardSearcher(shard.acquire_searcher().segments, self.mapper,
+                              self.device_cache, self.k1, self.b)
+                for shard in self.shards]
+
+    def stats(self) -> Dict[str, Any]:
+        docs = 0
+        deleted = 0
+        segments = 0
+        for shard in self.shards:
+            s = shard.stats()
+            docs += s["docs"]["count"]
+            deleted += s["docs"]["deleted"]
+            segments += s["segments"]["count"]
+        return {"docs": {"count": docs, "deleted": deleted},
+                "segments": {"count": segments},
+                "shards": self.num_shards}
+
+    def close(self):
+        for shard in self.shards:
+            shard.close()
+
+
+class IndicesService:
+    """Node-level index registry with disk persistence + reopen."""
+
+    def __init__(self, data_path: str, node_settings: Settings = Settings.EMPTY):
+        self.data_path = data_path
+        self.node_settings = node_settings
+        self.indices: Dict[str, IndexService] = {}
+        self.device_cache = DeviceSegmentCache()
+        os.makedirs(data_path, exist_ok=True)
+        for name in sorted(os.listdir(data_path)):
+            meta_path = os.path.join(data_path, name, "_meta.json")
+            if os.path.exists(meta_path):
+                with open(meta_path) as fh:
+                    meta = json.load(fh)
+                self.indices[name] = IndexService(
+                    name, os.path.join(data_path, name),
+                    Settings(meta["settings"]), meta["mappings"],
+                    self.device_cache)
+
+    def create_index(self, name: str, settings: Optional[Dict[str, Any]] = None,
+                     mappings: Optional[Dict[str, Any]] = None) -> IndexService:
+        if name in self.indices:
+            raise ResourceAlreadyExistsException(f"index [{name}]")
+        if not name or name.startswith(("_", "-")) or name != name.lower():
+            raise IllegalArgumentException(
+                f"Invalid index name [{name}], must be lowercase and not "
+                f"start with '_' or '-'")
+        idx = IndexService(name, os.path.join(self.data_path, name),
+                           Settings.from_dict(settings or {}), mappings,
+                           self.device_cache)
+        self.indices[name] = idx
+        return idx
+
+    def get(self, name: str) -> IndexService:
+        idx = self.indices.get(name)
+        if idx is None:
+            raise IndexNotFoundException(name)
+        return idx
+
+    def has(self, name: str) -> bool:
+        return name in self.indices
+
+    def delete_index(self, name: str):
+        idx = self.get(name)
+        idx.close()
+        self.device_cache.evict(idx._known_seg_names)
+        del self.indices[name]
+        shutil.rmtree(idx.path, ignore_errors=True)
+
+    def resolve(self, expression: str) -> List[str]:
+        """Index name expression: csv, wildcards, _all (ref:
+        IndexNameExpressionResolver)."""
+        if expression in ("_all", "*", ""):
+            return sorted(self.indices)
+        out = []
+        import fnmatch
+        for part in expression.split(","):
+            part = part.strip()
+            if "*" in part or "?" in part:
+                out.extend(n for n in sorted(self.indices)
+                           if fnmatch.fnmatch(n, part))
+            elif part:
+                if part not in self.indices:
+                    raise IndexNotFoundException(part)
+                out.append(part)
+        seen = set()
+        uniq = []
+        for n in out:
+            if n not in seen:
+                seen.add(n)
+                uniq.append(n)
+        return uniq
+
+    def close(self):
+        for idx in self.indices.values():
+            idx.close()

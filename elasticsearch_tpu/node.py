@@ -1,0 +1,55 @@
+"""Node: wires all services together (the reference's Node container,
+ref: node/Node.java:280-686 — constructs and binds every service, manages
+lifecycle start/stop/close). Single-node for now; the cluster layer
+(coordination, replication) attaches here as it lands.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Optional
+
+from elasticsearch_tpu.common.settings import Setting, Settings
+from elasticsearch_tpu.index.service import IndicesService
+from elasticsearch_tpu.rest.api import RestController
+from elasticsearch_tpu.rest.http_server import HttpServer
+from elasticsearch_tpu.search.service import SearchService
+from elasticsearch_tpu.utils.breaker import HierarchyCircuitBreakerService
+
+NODE_NAME_SETTING = Setting.str_setting("node.name", None)
+CLUSTER_NAME_SETTING = Setting.str_setting("cluster.name", "elasticsearch-tpu")
+PATH_DATA_SETTING = Setting.str_setting("path.data", "data")
+HTTP_PORT_SETTING = Setting.int_setting("http.port", 9200)
+
+
+class Node:
+    def __init__(self, settings: Settings = Settings.EMPTY,
+                 data_path: Optional[str] = None):
+        self.settings = settings
+        self.node_id = uuid.uuid4().hex[:20]
+        self.name = NODE_NAME_SETTING.get(settings) or self.node_id[:7]
+        self.cluster_name = CLUSTER_NAME_SETTING.get(settings)
+        self.data_path = data_path or PATH_DATA_SETTING.get(settings)
+        os.makedirs(self.data_path, exist_ok=True)
+        self.breaker_service = HierarchyCircuitBreakerService()
+        self.indices_service = IndicesService(self.data_path, settings)
+        self.search_service = SearchService(self.indices_service)
+        self.rest_controller = RestController(self)
+        self._http: Optional[HttpServer] = None
+
+    def start(self, port: Optional[int] = None) -> int:
+        """Bind HTTP; returns the bound port (0 → ephemeral)."""
+        http_port = port if port is not None else HTTP_PORT_SETTING.get(self.settings)
+        self._http = HttpServer(self.rest_controller, port=http_port)
+        self._http.start()
+        return self._http.port
+
+    def stop(self):
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
+
+    def close(self):
+        self.stop()
+        self.indices_service.close()

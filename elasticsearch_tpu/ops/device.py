@@ -141,6 +141,13 @@ class DeviceSegment:
             self.numerics[f] = put(vals.astype(np.float32))
             self.numeric_missing[f] = put(miss)
 
+    def update_live(self, live: np.ndarray) -> None:
+        """Re-upload only the live mask (deletes don't touch postings)."""
+        padded = np.zeros(self.n_docs_padded, bool)
+        padded[: len(live)] = live
+        self.live = jax.device_put(padded, device=self.live.devices().pop()
+                                   if hasattr(self.live, "devices") else None)
+
     def hbm_bytes(self) -> int:
         total = self.live.nbytes
         for dp in self.postings.values():

@@ -34,12 +34,12 @@ def approx_topk(scores: jax.Array, k: int, recall_target: float = 0.95):
 
 
 @partial(jax.jit, static_argnames=("k",))
-def masked_topk(scores: jax.Array, live: jax.Array, k: int):
-    """Top-k over live, matching docs only: non-matching docs hold score
-    0.0 (see ops/bm25.py), deleted docs are masked — both drop to -inf so
-    they can never enter the result set. Returns (values, indices); a
-    value of -inf means "fewer than k matches"."""
-    masked = jnp.where(live & (scores > 0.0), scores, -jnp.inf)
+def masked_topk(scores: jax.Array, mask: jax.Array, k: int):
+    """Top-k over masked docs only. The caller supplies the full mask
+    (matched & live & not-padding — filter-only queries legitimately score
+    0.0, so matching is NOT inferred from score). Masked-out docs drop to
+    -inf; a returned value of -inf means "fewer than k matches"."""
+    masked = jnp.where(mask, scores, -jnp.inf)
     return jax.lax.top_k(masked, k)
 
 

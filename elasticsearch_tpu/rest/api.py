@@ -1,0 +1,624 @@
+"""REST API: route registry + dispatch.
+
+Mirrors the reference's REST layer (ref: rest/RestController.java:62,146-174
+— trie route dispatch; ~180 handlers under rest/action/; the _cat family
+under rest/action/cat/). The controller is transport-agnostic — the HTTP
+server (rest/http_server.py) adapts sockets to ``dispatch()``, the way
+Netty4HttpServerTransport feeds RestController — so tests can drive the
+full API without sockets (the YAML-rest-test model, SURVEY.md §4 tier 5).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu import __version__
+from elasticsearch_tpu.common.errors import (
+    DocumentMissingException,
+    ElasticsearchTpuException,
+    IllegalArgumentException,
+    ParsingException,
+)
+from elasticsearch_tpu.search.rank_eval import rank_eval
+
+Response = Tuple[int, Dict[str, Any]]
+
+
+class RestController:
+    def __init__(self, node):
+        self.node = node
+        # (method, compiled-regex, param-names, handler)
+        self._routes: List[Tuple[str, Any, List[str], Callable]] = []
+        _register_all(self)
+
+    def register(self, method: str, pattern: str, handler: Callable):
+        """pattern like "/{index}/_doc/{id}" — path params in braces."""
+        names = re.findall(r"{(\w+)}", pattern)
+        # {index} must not swallow _endpoint paths (only _all is a valid
+        # underscore-leading index expression, ref: RestController routing)
+        regex_src = pattern.replace("{index}", "(?P<index>_all|[^_/][^/]*)")
+        regex = re.compile(
+            "^" + re.sub(r"{(\w+)}", r"(?P<\1>[^/]+)", regex_src) + "/?$")
+        self._routes.append((method.upper(), regex, names, handler))
+
+    def dispatch(self, method: str, path: str,
+                 params: Optional[Dict[str, str]] = None,
+                 body: Any = None) -> Response:
+        params = params or {}
+        method = method.upper()
+        path = path.rstrip("/") or "/"
+        matched_path = False
+        for m, regex, names, handler in self._routes:
+            match = regex.match(path)
+            if match is None:
+                continue
+            matched_path = True
+            if m != method and not (m == "GET" and method == "HEAD"):
+                continue
+            try:
+                kwargs = match.groupdict()
+                return handler(self.node, params, body, **kwargs)
+            except ElasticsearchTpuException as e:
+                return e.status, {
+                    "error": {**e.to_xcontent(),
+                              "root_cause": [e.to_xcontent()]},
+                    "status": e.status,
+                }
+        if matched_path:
+            return 405, {"error": f"Incorrect HTTP method for uri [{path}], "
+                                  f"allowed: {self._allowed(path)}", "status": 405}
+        return 400, {"error": {"type": "illegal_argument_exception",
+                               "reason": f"no handler found for uri [{path}] "
+                                         f"and method [{method}]"},
+                     "status": 400}
+
+    def _allowed(self, path: str) -> List[str]:
+        return sorted({m for m, regex, _, _ in self._routes
+                       if regex.match(path)})
+
+
+# ---------------------------------------------------------------------------
+# handlers (ref: the RestHandler classes under rest/action/)
+# ---------------------------------------------------------------------------
+
+def _register_all(c: RestController):
+    c.register("GET", "/", root_info)
+    # cluster/admin
+    c.register("GET", "/_cluster/health", cluster_health)
+    c.register("GET", "/_cluster/stats", cluster_stats)
+    c.register("GET", "/_nodes/stats", nodes_stats)
+    c.register("GET", "/_cat/indices", cat_indices)
+    c.register("GET", "/_cat/health", cat_health)
+    c.register("GET", "/_cat/count", cat_count)
+    c.register("GET", "/_cat/shards", cat_shards)
+    c.register("GET", "/_stats", indices_stats)
+    # search (register before index-level wildcards)
+    c.register("GET", "/_search", search_all)
+    c.register("POST", "/_search", search_all)
+    c.register("POST", "/_search/scroll", scroll)
+    c.register("GET", "/_search/scroll", scroll)
+    c.register("DELETE", "/_search/scroll", clear_scroll)
+    c.register("POST", "/_msearch", msearch)
+    c.register("GET", "/_mget", mget_all)
+    c.register("POST", "/_mget", mget_all)
+    c.register("POST", "/_bulk", bulk)
+    c.register("PUT", "/_bulk", bulk)
+    c.register("GET", "/{index}/_search", search_index)
+    c.register("POST", "/{index}/_search", search_index)
+    c.register("GET", "/{index}/_count", count_index)
+    c.register("POST", "/{index}/_count", count_index)
+    c.register("POST", "/{index}/_msearch", msearch_index)
+    c.register("POST", "/{index}/_rank_eval", rank_eval_handler)
+    c.register("GET", "/{index}/_rank_eval", rank_eval_handler)
+    # documents
+    c.register("PUT", "/{index}/_doc/{id}", index_doc)
+    c.register("POST", "/{index}/_doc/{id}", index_doc)
+    c.register("POST", "/{index}/_doc", index_doc_auto_id)
+    c.register("PUT", "/{index}/_create/{id}", create_doc)
+    c.register("POST", "/{index}/_create/{id}", create_doc)
+    c.register("GET", "/{index}/_doc/{id}", get_doc)
+    c.register("DELETE", "/{index}/_doc/{id}", delete_doc)
+    c.register("GET", "/{index}/_source/{id}", get_source)
+    c.register("POST", "/{index}/_update/{id}", update_doc)
+    c.register("POST", "/{index}/_bulk", bulk_index)
+    c.register("PUT", "/{index}/_bulk", bulk_index)
+    c.register("POST", "/{index}/_mget", mget_index)
+    c.register("GET", "/{index}/_mget", mget_index)
+    # index admin
+    c.register("PUT", "/{index}", create_index)
+    c.register("DELETE", "/{index}", delete_index)
+    c.register("GET", "/{index}", get_index)
+    c.register("GET", "/{index}/_mapping", get_mapping)
+    c.register("PUT", "/{index}/_mapping", put_mapping)
+    c.register("GET", "/{index}/_settings", get_settings)
+    c.register("POST", "/{index}/_refresh", refresh_index)
+    c.register("GET", "/{index}/_refresh", refresh_index)
+    c.register("POST", "/{index}/_flush", flush_index)
+    c.register("POST", "/{index}/_forcemerge", forcemerge_index)
+    c.register("GET", "/{index}/_stats", index_stats)
+    c.register("GET", "/{index}/_analyze", analyze)
+    c.register("POST", "/{index}/_analyze", analyze)
+    c.register("GET", "/_analyze", analyze_no_index)
+    c.register("POST", "/_analyze", analyze_no_index)
+
+
+# -- info / cluster ----------------------------------------------------------
+
+def root_info(node, params, body):
+    return 200, {
+        "name": node.name,
+        "cluster_name": node.cluster_name,
+        "version": {"number": __version__,
+                    "distribution": "elasticsearch_tpu"},
+        "tagline": "You Know, for TPU Search",
+    }
+
+
+def cluster_health(node, params, body):
+    indices = node.indices_service.indices
+    shards = sum(idx.num_shards for idx in indices.values())
+    return 200, {
+        "cluster_name": node.cluster_name,
+        "status": "green",
+        "timed_out": False,
+        "number_of_nodes": 1,
+        "number_of_data_nodes": 1,
+        "active_primary_shards": shards,
+        "active_shards": shards,
+        "relocating_shards": 0, "initializing_shards": 0,
+        "unassigned_shards": 0, "delayed_unassigned_shards": 0,
+        "number_of_pending_tasks": 0, "number_of_in_flight_fetch": 0,
+        "active_shards_percent_as_number": 100.0,
+    }
+
+
+def cluster_stats(node, params, body):
+    indices = node.indices_service.indices
+    docs = sum(idx.stats()["docs"]["count"] for idx in indices.values())
+    return 200, {
+        "cluster_name": node.cluster_name,
+        "indices": {"count": len(indices), "docs": {"count": docs}},
+        "nodes": {"count": {"total": 1, "data": 1, "master": 1}},
+    }
+
+
+def nodes_stats(node, params, body):
+    import resource
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    return 200, {
+        "cluster_name": node.cluster_name,
+        "nodes": {node.node_id: {
+            "name": node.name,
+            "indices": {
+                name: idx.stats() for name, idx in
+                node.indices_service.indices.items()},
+            "process": {"max_rss_bytes": ru.ru_maxrss * 1024},
+            "breakers": node.breaker_service.stats(),
+        }},
+    }
+
+
+def indices_stats(node, params, body):
+    out = {"indices": {name: idx.stats()
+                       for name, idx in node.indices_service.indices.items()}}
+    total_docs = sum(s["docs"]["count"] for s in out["indices"].values())
+    out["_all"] = {"primaries": {"docs": {"count": total_docs}}}
+    return 200, out
+
+
+def cat_indices(node, params, body):
+    lines = []
+    for name in sorted(node.indices_service.indices):
+        idx = node.indices_service.get(name)
+        s = idx.stats()
+        lines.append(f"green open {name} {idx.num_shards} 0 "
+                     f"{s['docs']['count']} {s['docs']['deleted']}")
+    return 200, {"_cat": "\n".join(lines)}
+
+
+def cat_health(node, params, body):
+    return 200, {"_cat": f"{int(time.time())} {node.cluster_name} green 1 1"}
+
+
+def cat_count(node, params, body):
+    docs = sum(idx.stats()["docs"]["count"]
+               for idx in node.indices_service.indices.values())
+    return 200, {"_cat": f"{int(time.time())} {docs}"}
+
+
+def cat_shards(node, params, body):
+    lines = []
+    for name in sorted(node.indices_service.indices):
+        idx = node.indices_service.get(name)
+        for i, shard in enumerate(idx.shards):
+            s = shard.stats()
+            lines.append(f"{name} {i} p STARTED {s['docs']['count']} {node.name}")
+    return 200, {"_cat": "\n".join(lines)}
+
+
+# -- index admin -------------------------------------------------------------
+
+def create_index(node, params, body, index):
+    body = body or {}
+    node.indices_service.create_index(index, body.get("settings"),
+                                      body.get("mappings"))
+    return 200, {"acknowledged": True, "shards_acknowledged": True,
+                 "index": index}
+
+
+def delete_index(node, params, body, index):
+    for name in node.indices_service.resolve(index):
+        node.indices_service.delete_index(name)
+    return 200, {"acknowledged": True}
+
+
+def get_index(node, params, body, index):
+    out = {}
+    for name in node.indices_service.resolve(index):
+        idx = node.indices_service.get(name)
+        out[name] = {"mappings": idx.mapper.to_mapping(),
+                     "settings": {"index": idx.settings.by_prefix("index").as_nested_dict()}}
+    return 200, out
+
+
+def get_mapping(node, params, body, index):
+    return 200, {name: {"mappings": node.indices_service.get(name).mapper.to_mapping()}
+                 for name in node.indices_service.resolve(index)}
+
+
+def put_mapping(node, params, body, index):
+    for name in node.indices_service.resolve(index):
+        node.indices_service.get(name).update_mappings(body or {})
+    return 200, {"acknowledged": True}
+
+
+def get_settings(node, params, body, index):
+    return 200, {name: {"settings": {"index": node.indices_service.get(name)
+                                     .settings.by_prefix("index").as_nested_dict()}}
+                 for name in node.indices_service.resolve(index)}
+
+
+def refresh_index(node, params, body, index):
+    for name in node.indices_service.resolve(index):
+        node.indices_service.get(name).refresh()
+    return 200, {"_shards": {"successful": 1, "failed": 0}}
+
+
+def flush_index(node, params, body, index):
+    for name in node.indices_service.resolve(index):
+        node.indices_service.get(name).flush()
+    return 200, {"_shards": {"successful": 1, "failed": 0}}
+
+
+def forcemerge_index(node, params, body, index):
+    max_seg = int(params.get("max_num_segments", 1))
+    for name in node.indices_service.resolve(index):
+        node.indices_service.get(name).force_merge(max_seg)
+    return 200, {"_shards": {"successful": 1, "failed": 0}}
+
+
+def index_stats(node, params, body, index):
+    return 200, {"indices": {name: node.indices_service.get(name).stats()
+                             for name in node.indices_service.resolve(index)}}
+
+
+def analyze(node, params, body, index):
+    idx = node.indices_service.get(index)
+    return _analyze(idx.mapper.analysis, body or {})
+
+
+def analyze_no_index(node, params, body):
+    from elasticsearch_tpu.analysis import AnalysisRegistry
+    return _analyze(AnalysisRegistry(), body or {})
+
+
+def _analyze(registry, body):
+    text = body.get("text", "")
+    texts = text if isinstance(text, list) else [text]
+    analyzer = registry.get(body.get("analyzer", "standard"))
+    tokens = []
+    for t in texts:
+        for tok in analyzer.analyze(t):
+            tokens.append({"token": tok.term, "start_offset": tok.start_offset,
+                           "end_offset": tok.end_offset,
+                           "position": tok.position, "type": "<ALPHANUM>"})
+    return 200, {"tokens": tokens}
+
+
+# -- documents ---------------------------------------------------------------
+
+def _ensure_index(node, index):
+    if not node.indices_service.has(index):
+        # auto-create on first write (ref: TransportBulkAction auto-create,
+        # action/bulk/TransportBulkAction.java:251-260)
+        node.indices_service.create_index(index)
+    return node.indices_service.get(index)
+
+
+def _write_response(index, result, created_word="created"):
+    return {
+        "_index": index,
+        "_id": result.doc_id,
+        "_version": result.version,
+        "result": created_word,
+        "_shards": {"total": 1, "successful": 1, "failed": 0},
+        "_seq_no": result.seq_no,
+        "_primary_term": result.primary_term,
+    }
+
+
+def index_doc(node, params, body, index, id):
+    idx = _ensure_index(node, index)
+    op_type = params.get("op_type", "index")
+    kwargs = {}
+    if "if_seq_no" in params:
+        kwargs["if_seq_no"] = int(params["if_seq_no"])
+        kwargs["if_primary_term"] = int(params.get("if_primary_term", 1))
+    result = idx.index_doc(id, body or {}, routing=params.get("routing"),
+                           op_type=op_type, **kwargs)
+    if params.get("refresh") in ("true", "wait_for", ""):
+        idx.refresh()
+    status = 201 if result.created else 200
+    return status, _write_response(
+        index, result, "created" if result.created else "updated")
+
+
+def index_doc_auto_id(node, params, body, index):
+    return index_doc(node, params, body, index, uuid.uuid4().hex[:20])
+
+
+def create_doc(node, params, body, index, id):
+    params = dict(params)
+    params["op_type"] = "create"
+    return index_doc(node, params, body, index, id)
+
+
+def get_doc(node, params, body, index, id):
+    idx = node.indices_service.get(index)
+    result = idx.get_doc(id, routing=params.get("routing"))
+    if not result.found:
+        return 404, {"_index": index, "_id": id, "found": False}
+    out = {"_index": index, "_id": id, "_version": result.version,
+           "_seq_no": result.seq_no, "_primary_term": result.primary_term,
+           "found": True, "_source": result.source}
+    return 200, out
+
+
+def get_source(node, params, body, index, id):
+    idx = node.indices_service.get(index)
+    result = idx.get_doc(id, routing=params.get("routing"))
+    if not result.found:
+        raise DocumentMissingException(index, id)
+    return 200, result.source
+
+
+def delete_doc(node, params, body, index, id):
+    idx = node.indices_service.get(index)
+    result = idx.delete_doc(id, routing=params.get("routing"))
+    if params.get("refresh") in ("true", ""):
+        idx.refresh()
+    if not result.found:
+        return 404, _write_response(index, result, "not_found")
+    return 200, _write_response(index, result, "deleted")
+
+
+def update_doc(node, params, body, index, id):
+    """ref: UpdateHelper get-merge-reindex (action/update/)."""
+    idx = node.indices_service.get(index)
+    body = body or {}
+    current = idx.get_doc(id, routing=params.get("routing"))
+    if not current.found:
+        if "upsert" in body:
+            result = idx.index_doc(id, body["upsert"],
+                                   routing=params.get("routing"))
+            return 201, _write_response(index, result, "created")
+        raise DocumentMissingException(index, id)
+    if "doc" in body:
+        merged = _deep_merge(current.source, body["doc"])
+        if merged == current.source and body.get("detect_noop", True):
+            result_shell = type("R", (), {
+                "doc_id": id, "version": current.version,
+                "seq_no": current.seq_no, "primary_term": current.primary_term})
+            return 200, _write_response(index, result_shell, "noop")
+        result = idx.index_doc(id, merged, routing=params.get("routing"))
+        if params.get("refresh") in ("true", ""):
+            idx.refresh()
+        return 200, _write_response(index, result, "updated")
+    raise IllegalArgumentException("update requires [doc] or [upsert]")
+
+
+def _deep_merge(base, update):
+    out = dict(base)
+    for k, v in update.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def mget_index(node, params, body, index):
+    docs = []
+    for spec in (body or {}).get("docs", []):
+        did = spec.get("_id")
+        code, doc = get_doc(node, params, None, spec.get("_index", index), did)
+        docs.append(doc)
+    ids = (body or {}).get("ids")
+    if ids:
+        for did in ids:
+            code, doc = get_doc(node, params, None, index, did)
+            docs.append(doc)
+    return 200, {"docs": docs}
+
+
+def mget_all(node, params, body):
+    docs = []
+    for spec in (body or {}).get("docs", []):
+        code, doc = get_doc(node, params, None, spec["_index"], spec["_id"])
+        docs.append(doc)
+    return 200, {"docs": docs}
+
+
+# -- bulk --------------------------------------------------------------------
+
+def bulk(node, params, body, index=None):
+    """NDJSON bulk (ref: action/bulk/TransportBulkAction.java:100,172 —
+    grouped per shard; here executed item-by-item against local shards)."""
+    if isinstance(body, (bytes, str)):
+        lines = [json.loads(l) for l in
+                 (body.decode() if isinstance(body, bytes) else body).splitlines()
+                 if l.strip()]
+    elif isinstance(body, list):
+        lines = body
+    else:
+        raise IllegalArgumentException("bulk body must be NDJSON")
+    items = []
+    errors = False
+    i = 0
+    start = time.monotonic()
+    touched = set()
+    while i < len(lines):
+        action_line = lines[i]
+        i += 1
+        (action, meta), = action_line.items()
+        target = meta.get("_index", index)
+        doc_id = meta.get("_id") or uuid.uuid4().hex[:20]
+        # consume the source line FIRST so a failing item can never
+        # desynchronize the action/source alternation for later items
+        source = None
+        if action in ("index", "create", "update"):
+            if i >= len(lines):
+                raise IllegalArgumentException(
+                    "Malformed bulk request: missing source for last action")
+            source = lines[i]
+            i += 1
+        try:
+            if target is None:
+                raise IllegalArgumentException("bulk item missing _index")
+            idx = _ensure_index(node, target)
+            touched.add(target)
+            if action in ("index", "create"):
+                result = idx.index_doc(
+                    doc_id, source, routing=meta.get("routing"),
+                    op_type="create" if action == "create" else "index")
+                items.append({action: {
+                    "_index": target, "_id": result.doc_id,
+                    "_version": result.version,
+                    "result": "created" if result.created else "updated",
+                    "_seq_no": result.seq_no, "status": 201 if result.created else 200}})
+            elif action == "delete":
+                result = idx.delete_doc(doc_id, routing=meta.get("routing"))
+                items.append({action: {
+                    "_index": target, "_id": doc_id,
+                    "result": "deleted" if result.found else "not_found",
+                    "status": 200 if result.found else 404}})
+            elif action == "update":
+                code, resp = update_doc(node, dict(params), source, target, doc_id)
+                items.append({action: {**resp, "status": code}})
+            else:
+                raise IllegalArgumentException(f"Malformed action [{action}]")
+        except ElasticsearchTpuException as e:
+            errors = True
+            items.append({action: {"_index": target, "_id": doc_id,
+                                   "status": e.status,
+                                   "error": e.to_xcontent()}})
+    if params.get("refresh") in ("true", "wait_for", ""):
+        for name in touched:
+            node.indices_service.get(name).refresh()
+    return 200, {"took": int((time.monotonic() - start) * 1000),
+                 "errors": errors, "items": items}
+
+
+def bulk_index(node, params, body, index):
+    return bulk(node, params, body, index=index)
+
+
+# -- search ------------------------------------------------------------------
+
+def search_index(node, params, body, index):
+    body = _merge_search_params(body, params)
+    r = node.search_service.search(index, body, scroll=params.get("scroll"))
+    return 200, r
+
+
+def search_all(node, params, body):
+    body = _merge_search_params(body, params)
+    r = node.search_service.search("_all", body, scroll=params.get("scroll"))
+    return 200, r
+
+
+def _merge_search_params(body, params):
+    body = dict(body or {})
+    if "q" in params and "query" not in body:
+        # query_string lite: field:value or bare text on _all fields
+        q = params["q"]
+        if ":" in q:
+            field, _, value = q.partition(":")
+            body["query"] = {"match": {field: value}}
+        else:
+            body["query"] = {"multi_match": {"query": q, "fields": ["*"]}}
+    for key in ("from", "size"):
+        if key in params:
+            body[key] = int(params[key])
+    return body
+
+
+def count_index(node, params, body, index):
+    return 200, node.search_service.count(index, body or {})
+
+
+def scroll(node, params, body):
+    body = body or {}
+    scroll_id = body.get("scroll_id") or params.get("scroll_id")
+    keep = body.get("scroll") or params.get("scroll")
+    return 200, node.search_service.scroll(scroll_id, keep)
+
+
+def clear_scroll(node, params, body):
+    ids = (body or {}).get("scroll_id", ["_all"])
+    if isinstance(ids, str):
+        ids = [ids]
+    freed = node.search_service.clear_scroll(ids)
+    return 200, {"succeeded": True, "num_freed": freed}
+
+
+def msearch(node, params, body, index=None):
+    if isinstance(body, (bytes, str)):
+        lines = [json.loads(l) for l in
+                 (body.decode() if isinstance(body, bytes) else body).splitlines()
+                 if l.strip()]
+    else:
+        lines = body or []
+    responses = []
+    i = 0
+    while i + 1 < len(lines) or (i < len(lines) and index):
+        header = lines[i]
+        i += 1
+        target = header.get("index", index) or "_all"
+        search_body = lines[i] if i < len(lines) else {}
+        i += 1
+        try:
+            responses.append(node.search_service.search(target, search_body))
+        except ElasticsearchTpuException as e:
+            responses.append({"error": e.to_xcontent(), "status": e.status})
+    return 200, {"responses": responses}
+
+
+def msearch_index(node, params, body, index):
+    return msearch(node, params, body, index=index)
+
+
+def rank_eval_handler(node, params, body, index):
+    body = body or {}
+
+    def search_fn(request_body):
+        r = node.search_service.search(index, request_body)
+        return [h["_id"] for h in r["hits"]["hits"]]
+
+    result = rank_eval(search_fn, body.get("requests", []),
+                       body.get("metric", {"recall": {"k": 10}}))
+    return 200, result

@@ -1,0 +1,132 @@
+"""Search execution context: shard-level stats + per-segment device state.
+
+Mirrors the reference's QueryShardContext + ContextIndexSearcher roles (ref:
+index/query/QueryShardContext.java, search/internal/ContextIndexSearcher.java):
+queries compile against shard-level term statistics (Lucene computes IDF from
+IndexSearcher-level stats so scores are segment-independent) and execute
+per segment against HBM-resident DeviceSegments.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.index.mapper import MapperService
+from elasticsearch_tpu.index.segment import Segment
+from elasticsearch_tpu.ops.device import DeviceSegment
+
+
+class ShardStats:
+    """Shard-level (cross-segment) field/term statistics for BM25."""
+
+    def __init__(self, segments: List[Segment]):
+        self.segments = segments
+        self._field_cache: Dict[str, Tuple[int, float]] = {}
+        self._df_cache: Dict[Tuple[str, str], int] = {}
+
+    def field_stats(self, field: str) -> Tuple[int, float]:
+        """(doc_count_with_field, avg_field_length) across the shard."""
+        cached = self._field_cache.get(field)
+        if cached is None:
+            doc_count = 0
+            sum_ttf = 0
+            for seg in self.segments:
+                pf = seg.postings.get(field)
+                if pf is not None:
+                    doc_count += pf.doc_count
+                    sum_ttf += pf.sum_total_term_freq
+            cached = (doc_count, sum_ttf / doc_count if doc_count else 1.0)
+            self._field_cache[field] = cached
+        return cached
+
+    def doc_freq(self, field: str, term: str) -> int:
+        key = (field, term)
+        cached = self._df_cache.get(key)
+        if cached is None:
+            cached = 0
+            for seg in self.segments:
+                pf = seg.postings.get(field)
+                if pf is not None:
+                    tid = pf.term_id(term)
+                    if tid >= 0:
+                        cached += int(pf.doc_freq[tid])
+            self._df_cache[key] = cached
+        return cached
+
+
+class SegmentContext:
+    """One segment's view for query execution."""
+
+    def __init__(self, segment: Segment, device: DeviceSegment,
+                 mapper: MapperService, stats: ShardStats,
+                 k1: float = 1.2, b: float = 0.75):
+        self.segment = segment
+        self.device = device
+        self.mapper = mapper
+        self.stats = stats
+        self.k1 = k1
+        self.b = b
+
+    @property
+    def n_docs_padded(self) -> int:
+        return self.device.n_docs_padded
+
+    @property
+    def live(self):
+        return self.device.live
+
+    def all_true(self):
+        """Mask of all real (non-padding) docs."""
+        m = np.zeros(self.n_docs_padded, bool)
+        m[: self.segment.n_docs] = True
+        return jnp.asarray(m)
+
+    def numeric_column(self, field: str):
+        col = self.device.numerics.get(field)
+        miss = self.device.numeric_missing.get(field)
+        if col is None:
+            col = jnp.zeros(self.n_docs_padded, jnp.float32)
+            miss = jnp.ones(self.n_docs_padded, bool)
+        return col, miss
+
+
+# DeviceSegment cache: segments are immutable except their live mask, so the
+# cache key is (segment name, live_version); a delete only re-uploads live.
+class DeviceSegmentCache:
+    def __init__(self, device=None, vector_dtype=jnp.bfloat16):
+        self._cache: Dict[str, Tuple[int, DeviceSegment]] = {}
+        self._lock = threading.Lock()
+        self._device = device
+        self._vector_dtype = vector_dtype
+
+    def get(self, segment: Segment) -> DeviceSegment:
+        with self._lock:
+            entry = self._cache.get(segment.name)
+            if entry is not None:
+                version, dev = entry
+                if version == segment.live_version:
+                    return dev
+                if dev.segment is segment or dev.n_docs == segment.n_docs:
+                    dev.update_live(segment.live)
+                    self._cache[segment.name] = (segment.live_version, dev)
+                    return dev
+            dev = DeviceSegment(segment, self._device, self._vector_dtype)
+            self._cache[segment.name] = (segment.live_version, dev)
+            return dev
+
+    def evict(self, names) -> None:
+        """Drop device copies of retired segments (called by IndexService
+        after merges/deletes so HBM doesn't grow with dead segments)."""
+        with self._lock:
+            for name in names:
+                self._cache.pop(name, None)
+
+    def evict_except(self, names: set) -> None:
+        with self._lock:
+            for name in list(self._cache):
+                if name not in names:
+                    del self._cache[name]

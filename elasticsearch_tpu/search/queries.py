@@ -1,0 +1,772 @@
+"""Query DSL: JSON query tree → executable device plans.
+
+Mirrors the reference's query layer (ref: index/query/ — 41 registered query
+types, search/SearchModule.java:268; AbstractQueryBuilder parse/rewrite).
+Each QueryBuilder parses from the JSON DSL and executes per segment,
+returning ``(scores, mask)`` device arrays:
+
+- ``scores`` float32 [ND_padded]: relevance (0 where unmatched/filter-only
+  — matching ES, where filter-only bool queries score 0.0)
+- ``mask``  bool  [ND_padded]: which docs matched
+
+Where Lucene builds Weight/Scorer iterator trees walked per doc, these
+builders compose whole-array kernel calls: a bool query is mask algebra +
+score addition over dense arrays; operator-AND and minimum_should_match are
+clause-count scatter kernels (ops/bm25.py match_count).
+
+Implemented: match_all, match_none, match, multi_match, term, terms, range,
+exists, ids, bool, constant_score, dis_max, boosting, script_score, knn,
+function_score(scripts+weight). Positional queries (match_phrase,
+intervals, span) need a positions index — postings positions land in a later
+round (gap tracked in SURVEY parity).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.common.errors import ParsingException, QueryShardException
+from elasticsearch_tpu.index.mapper import (
+    DenseVectorFieldType,
+    KeywordFieldType,
+    TextFieldType,
+)
+from elasticsearch_tpu.ops import bm25 as bm25_ops
+from elasticsearch_tpu.ops import vector as vec_ops
+from elasticsearch_tpu.search.context import SegmentContext
+from elasticsearch_tpu.search.script import ScriptContext, _DocColumn, compile_script
+
+Result = Tuple[jnp.ndarray, jnp.ndarray]  # (scores f32 [ND], mask bool [ND])
+
+
+def parse_minimum_should_match(value, n_clauses: int) -> int:
+    """ES minimum_should_match forms: int, "2", "-1", "75%", "-25%"
+    (ref: common/lucene/search/Queries.calculateMinShouldMatch)."""
+    if value is None:
+        return 0
+    if isinstance(value, int):
+        n = value
+    else:
+        s = str(value).strip()
+        try:
+            if s.endswith("%"):
+                pct = float(s[:-1])
+                n = int(n_clauses * pct / 100.0) if pct >= 0 else \
+                    n_clauses + int(n_clauses * pct / 100.0)
+            else:
+                n = int(s)
+        except ValueError:
+            raise ParsingException(
+                f"could not parse minimum_should_match [{value}]")
+    if n < 0:
+        n = n_clauses + n
+    return max(0, min(n, n_clauses))
+
+
+class QueryBuilder:
+    name = "?"
+
+    def __init__(self):
+        self.boost = 1.0
+
+    def execute(self, ctx: SegmentContext) -> Result:
+        scores, mask = self.do_execute(ctx)
+        if self.boost != 1.0:
+            scores = scores * self.boost
+        return scores, mask
+
+    def do_execute(self, ctx: SegmentContext) -> Result:
+        raise NotImplementedError
+
+    # can_match-style pruning hook (ref: CanMatchPreFilterSearchPhase)
+    def can_match(self, ctx: SegmentContext) -> bool:
+        return True
+
+
+class MatchAllQuery(QueryBuilder):
+    name = "match_all"
+
+    def do_execute(self, ctx):
+        mask = ctx.all_true()
+        return mask.astype(jnp.float32), mask
+
+
+class MatchNoneQuery(QueryBuilder):
+    name = "match_none"
+
+    def do_execute(self, ctx):
+        z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+        return z, z.astype(bool)
+
+    def can_match(self, ctx):
+        return False
+
+
+def _analyze_terms(ctx: SegmentContext, field: str, text: str) -> List[str]:
+    ft = ctx.mapper.field_type(field)
+    if isinstance(ft, TextFieldType):
+        name = ft.search_analyzer_name
+        analyzer = (ctx.mapper.analysis.get(name)
+                    if ctx.mapper.analysis.has(name)
+                    else ctx.mapper.analysis.default)
+        return analyzer.terms(text)
+    # keyword/numeric fields: the term is the literal value
+    return [str(text)]
+
+
+def _bm25_terms(ctx: SegmentContext, field: str, terms: List[str]) -> Result:
+    """Shared scorer: BM25 over the field's postings for the given terms."""
+    dp = ctx.device.postings.get(field)
+    if dp is None:
+        z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+        return z, z.astype(bool)
+    doc_count, avg_len = ctx.stats.field_stats(field)
+    tids, weights = [], []
+    for t in terms:
+        tid = dp.host.term_id(t)
+        df = ctx.stats.doc_freq(field, t)
+        tids.append(tid)
+        weights.append(bm25_ops.idf(df, doc_count) if df > 0 else 0.0)
+    sel, ws = dp.select_blocks(tids, weights)
+    scores = bm25_ops.bm25_block_scores(
+        dp.block_docids, dp.block_tfs, jnp.asarray(sel), jnp.asarray(ws),
+        dp.doc_lens, jnp.float32(avg_len), ctx.k1, ctx.b)
+    return scores, scores > 0.0
+
+
+class MatchQuery(QueryBuilder):
+    """ref: index/query/MatchQueryBuilder.java — analyzed full-text query;
+    multi-term OR/AND with minimum_should_match."""
+
+    name = "match"
+
+    def __init__(self, field: str, query: str, operator: str = "or",
+                 minimum_should_match: Optional[int] = None):
+        super().__init__()
+        self.field = field
+        self.query = query
+        self.operator = operator.lower()
+        self.minimum_should_match = minimum_should_match
+
+    def do_execute(self, ctx):
+        terms = _analyze_terms(ctx, self.field, self.query)
+        if not terms:
+            z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+            return z, z.astype(bool)
+        scores, mask = _bm25_terms(ctx, self.field, terms)
+        required = None
+        if self.operator == "and":
+            required = len(terms)
+        elif self.minimum_should_match:
+            required = parse_minimum_should_match(
+                self.minimum_should_match, len(terms))
+        if required is not None and required > 1:
+            dp = ctx.device.postings.get(self.field)
+            if dp is None:
+                return scores, mask
+            sels, cids = [], []
+            uniq = sorted(set(terms))
+            for ci, t in enumerate(uniq):
+                s, _ = dp.select_blocks([dp.host.term_id(t)], [1.0])
+                sels.append(s)
+                cids.append(np.full(len(s), ci, np.int32))
+            counts = bm25_ops.match_count(
+                dp.block_docids, dp.block_tfs,
+                jnp.asarray(np.concatenate(sels)),
+                jnp.asarray(np.concatenate(cids)),
+                len(uniq), ctx.n_docs_padded)
+            need = len(uniq) if self.operator == "and" else min(required, len(uniq))
+            mask = mask & (counts >= need)
+            scores = jnp.where(mask, scores, 0.0)
+        return scores, mask
+
+
+class MultiMatchQuery(QueryBuilder):
+    """ref: MultiMatchQueryBuilder — best_fields (dis-max over per-field
+    match) and most_fields (sum)."""
+
+    name = "multi_match"
+
+    def __init__(self, fields: List[str], query: str, type_: str = "best_fields",
+                 tie_breaker: float = 0.0):
+        super().__init__()
+        self.fields = fields
+        self.query = query
+        self.type = type_
+        self.tie_breaker = tie_breaker
+
+    def do_execute(self, ctx):
+        fields = self.fields
+        if not fields or fields == ["*"]:
+            # default: all text fields (ref: multi_match default field "*")
+            fields = [name for name, ft in ctx.mapper.mapper.fields.items()
+                      if isinstance(ft, TextFieldType)]
+        if not fields:
+            z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+            return z, z.astype(bool)
+        results = [MatchQuery(f, self.query).execute(ctx) for f in fields]
+        scores = [s for s, _ in results]
+        masks = [m for _, m in results]
+        any_mask = masks[0]
+        for m in masks[1:]:
+            any_mask = any_mask | m
+        if self.type == "most_fields":
+            total = scores[0]
+            for s in scores[1:]:
+                total = total + s
+            return total, any_mask
+        stacked = jnp.stack(scores)
+        best = stacked.max(axis=0)
+        if self.tie_breaker > 0.0:
+            best = best + self.tie_breaker * (stacked.sum(axis=0) - best)
+        return best, any_mask
+
+
+class TermQuery(QueryBuilder):
+    """ref: TermQueryBuilder — exact term; keyword fields score BM25 with
+    tf=1 and norms omitted (Lucene keyword fields have no norms:
+    score = idf·1/(1+k1)); numeric/date/bool terms are constant-score
+    point matches."""
+
+    name = "term"
+
+    def __init__(self, field: str, value: Any):
+        super().__init__()
+        self.field = field
+        self.value = value
+
+    def do_execute(self, ctx):
+        ft = ctx.mapper.field_type(self.field)
+        if ft is None or isinstance(ft, (TextFieldType, KeywordFieldType)):
+            dp = ctx.device.postings.get(self.field)
+            if dp is None:
+                z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+                return z, z.astype(bool)
+            term = str(self.value)
+            tid = dp.host.term_id(term)
+            sel, _ = dp.select_blocks([tid], [1.0])
+            mask = bm25_ops.match_mask(
+                dp.block_docids, dp.block_tfs, jnp.asarray(sel),
+                ctx.n_docs_padded)
+            if isinstance(ft, KeywordFieldType) or ft is None:
+                doc_count, _ = ctx.stats.field_stats(self.field)
+                df = ctx.stats.doc_freq(self.field, term)
+                w = bm25_ops.idf(df, doc_count) if df else 0.0
+                const = w * 1.0 / (1.0 + ctx.k1)   # tf=1, no norms
+                return mask.astype(jnp.float32) * const, mask
+            # text field + term query: unanalyzed exact term, BM25-scored
+            scores, mask2 = _bm25_terms(ctx, self.field, [term])
+            return scores, mask2
+        # numeric/date/boolean: point match, constant score
+        parsed = float(ft.parse(self.value))
+        col, miss = ctx.numeric_column(self.field)
+        mask = (~miss) & (col == parsed) & ctx.all_true()
+        return mask.astype(jnp.float32), mask
+
+
+class TermsQuery(QueryBuilder):
+    """ref: TermsQueryBuilder — constant score 1.0 for any-of."""
+
+    name = "terms"
+
+    def __init__(self, field: str, values: List[Any]):
+        super().__init__()
+        self.field = field
+        self.values = values
+
+    def do_execute(self, ctx):
+        ft = ctx.mapper.field_type(self.field)
+        if ft is None or isinstance(ft, (TextFieldType, KeywordFieldType)):
+            dp = ctx.device.postings.get(self.field)
+            if dp is None:
+                z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+                return z, z.astype(bool)
+            tids = [dp.host.term_id(str(v)) for v in self.values]
+            sel, _ = dp.select_blocks(tids, [1.0] * len(tids))
+            mask = bm25_ops.match_mask(
+                dp.block_docids, dp.block_tfs, jnp.asarray(sel),
+                ctx.n_docs_padded)
+            return mask.astype(jnp.float32), mask
+        col, miss = ctx.numeric_column(self.field)
+        mask = jnp.zeros(ctx.n_docs_padded, bool)
+        for v in self.values:
+            mask = mask | (col == float(ft.parse(v)))
+        mask = mask & (~miss) & ctx.all_true()
+        return mask.astype(jnp.float32), mask
+
+
+class RangeQuery(QueryBuilder):
+    name = "range"
+
+    def __init__(self, field: str, gte=None, gt=None, lte=None, lt=None):
+        super().__init__()
+        self.field = field
+        self.gte, self.gt, self.lte, self.lt = gte, gt, lte, lt
+
+    def do_execute(self, ctx):
+        ft = ctx.mapper.field_type(self.field)
+        if ft is None:
+            z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+            return z, z.astype(bool)
+        parse = lambda v: float(ft.parse(v))  # noqa: E731
+        col, miss = ctx.numeric_column(self.field)
+        mask = (~miss) & ctx.all_true()
+        if self.gte is not None:
+            mask = mask & (col >= parse(self.gte))
+        if self.gt is not None:
+            mask = mask & (col > parse(self.gt))
+        if self.lte is not None:
+            mask = mask & (col <= parse(self.lte))
+        if self.lt is not None:
+            mask = mask & (col < parse(self.lt))
+        return mask.astype(jnp.float32), mask
+
+
+class ExistsQuery(QueryBuilder):
+    name = "exists"
+
+    def __init__(self, field: str):
+        super().__init__()
+        self.field = field
+
+    def do_execute(self, ctx):
+        dev = ctx.device
+        if self.field in dev.postings:
+            lens = dev.postings[self.field].doc_lens
+            mask = (lens > 0) & ctx.all_true()
+        elif self.field in dev.numerics:
+            mask = (~dev.numeric_missing[self.field]) & ctx.all_true()
+        elif self.field in dev.vectors:
+            mask = dev.vectors[self.field].has_value & ctx.all_true()
+        else:
+            mask = jnp.zeros(ctx.n_docs_padded, bool)
+        return mask.astype(jnp.float32), mask
+
+
+class IdsQuery(QueryBuilder):
+    name = "ids"
+
+    def __init__(self, values: List[str]):
+        super().__init__()
+        self.values = values
+
+    def do_execute(self, ctx):
+        m = np.zeros(ctx.n_docs_padded, bool)
+        for doc_id in self.values:
+            docid = ctx.segment.docid_for(str(doc_id))
+            if docid >= 0:
+                m[docid] = True
+        mask = jnp.asarray(m)
+        return mask.astype(jnp.float32), mask
+
+
+class BoolQuery(QueryBuilder):
+    """ref: BoolQueryBuilder — must (scoring, all required), filter
+    (non-scoring, required), should (scoring, optional unless no
+    must/filter), must_not (excluded). Composed as mask algebra over dense
+    arrays instead of Lucene's ConjunctionDISI/disjunction iterators."""
+
+    name = "bool"
+
+    def __init__(self, must=None, filter=None, should=None, must_not=None,
+                 minimum_should_match: Optional[int] = None):
+        super().__init__()
+        self.must = must or []
+        self.filter = filter or []
+        self.should = should or []
+        self.must_not = must_not or []
+        self.minimum_should_match = minimum_should_match
+
+    def do_execute(self, ctx):
+        scores = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+        mask = ctx.all_true()
+        for q in self.must:
+            s, m = q.execute(ctx)
+            scores = scores + s
+            mask = mask & m
+        for q in self.filter:
+            _, m = q.execute(ctx)
+            mask = mask & m
+        for q in self.must_not:
+            _, m = q.execute(ctx)
+            mask = mask & (~m)
+        if self.should:
+            should_results = [q.execute(ctx) for q in self.should]
+            for s, _ in should_results:
+                scores = scores + s
+            if self.minimum_should_match is None:
+                msm = 1 if not (self.must or self.filter) else 0
+            else:
+                msm = parse_minimum_should_match(
+                    self.minimum_should_match, len(self.should))
+            if msm > 0:
+                count = jnp.zeros(ctx.n_docs_padded, jnp.int32)
+                for _, m in should_results:
+                    count = count + m.astype(jnp.int32)
+                mask = mask & (count >= msm)
+        scores = jnp.where(mask, scores, 0.0)
+        return scores, mask
+
+
+class ConstantScoreQuery(QueryBuilder):
+    name = "constant_score"
+
+    def __init__(self, filter_query: QueryBuilder):
+        super().__init__()
+        self.filter_query = filter_query
+
+    def do_execute(self, ctx):
+        _, mask = self.filter_query.execute(ctx)
+        return mask.astype(jnp.float32), mask
+
+
+class DisMaxQuery(QueryBuilder):
+    name = "dis_max"
+
+    def __init__(self, queries: List[QueryBuilder], tie_breaker: float = 0.0):
+        super().__init__()
+        self.queries = queries
+        self.tie_breaker = tie_breaker
+
+    def do_execute(self, ctx):
+        results = [q.execute(ctx) for q in self.queries]
+        stacked = jnp.stack([s for s, _ in results])
+        mask = results[0][1]
+        for _, m in results[1:]:
+            mask = mask | m
+        best = stacked.max(axis=0)
+        if self.tie_breaker > 0.0:
+            best = best + self.tie_breaker * (stacked.sum(axis=0) - best)
+        best = jnp.where(mask, best, 0.0)
+        return best, mask
+
+
+class BoostingQuery(QueryBuilder):
+    """ref: BoostingQueryBuilder — demote (not exclude) negative matches."""
+
+    name = "boosting"
+
+    def __init__(self, positive: QueryBuilder, negative: QueryBuilder,
+                 negative_boost: float):
+        super().__init__()
+        self.positive = positive
+        self.negative = negative
+        self.negative_boost = negative_boost
+
+    def do_execute(self, ctx):
+        s, mask = self.positive.execute(ctx)
+        _, neg = self.negative.execute(ctx)
+        s = jnp.where(neg, s * self.negative_boost, s)
+        return s, mask
+
+
+def _make_vector_fns(ctx: SegmentContext):
+    """cosineSimilarity/dotProduct/l2norm for scripts (parity surface of
+    ScoreScriptUtils.java:112-170), batched over the whole segment."""
+
+    def _get(field):
+        dv = ctx.device.vectors.get(field)
+        if dv is None:
+            raise QueryShardException(f"unknown vector field [{field}]")
+        return dv
+
+    def cosine(query_vector, field):
+        dv = _get(field)
+        q = jnp.asarray(np.asarray(query_vector, np.float32))[None, :]
+        if dv.similarity == "cosine":
+            return vec_ops.cosine_scores(q, dv.vectors)[0]
+        qn = jnp.linalg.norm(q)
+        raw = vec_ops.dot_scores(q, dv.vectors)[0]
+        denom = jnp.where(dv.norms > 0, dv.norms * qn, 1.0)
+        return raw / denom
+
+    def dot(query_vector, field):
+        dv = _get(field)
+        q = jnp.asarray(np.asarray(query_vector, np.float32))[None, :]
+        raw = vec_ops.dot_scores(q, dv.vectors)[0]
+        if dv.similarity == "cosine":   # slab is pre-normalized; undo
+            raw = raw * dv.norms
+        return raw
+
+    def l2norm(query_vector, field):
+        dv = _get(field)
+        q = jnp.asarray(np.asarray(query_vector, np.float32))[None, :]
+        vecs = dv.vectors * dv.norms[:, None] if dv.similarity == "cosine" else dv.vectors
+        return jnp.sqrt(jnp.maximum(
+            0.0, -vec_ops.l2_scores(q, vecs, dv.sq_norms)[0]))
+
+    return {"cosineSimilarity": cosine, "dotProduct": dot, "l2norm": l2norm}
+
+
+class ScriptScoreQuery(QueryBuilder):
+    """ref: ScriptScoreQueryBuilder + ScriptScoreQuery.java:51,91-109 — the
+    subquery filters, the script replaces the score. Script runs once over
+    columns, not per doc."""
+
+    name = "script_score"
+
+    def __init__(self, query: QueryBuilder, source: str,
+                 params: Optional[Dict[str, Any]] = None,
+                 min_score: Optional[float] = None):
+        super().__init__()
+        self.query = query
+        self.source = source
+        self.params = params or {}
+        self.min_score = min_score
+        self._compiled = compile_script(source)
+
+    def do_execute(self, ctx):
+        base_scores, mask = self.query.execute(ctx)
+
+        def doc_columns(field):
+            col, miss = ctx.numeric_column(field)
+            return _DocColumn(col, miss)
+
+        sctx = ScriptContext(doc_columns, self.params, score=base_scores,
+                             vector_fns=_make_vector_fns(ctx))
+        scores = jnp.asarray(self._compiled(sctx), jnp.float32)
+        scores = jnp.broadcast_to(scores, (ctx.n_docs_padded,))
+        scores = jnp.where(mask, scores, 0.0)
+        if self.min_score is not None:
+            mask = mask & (scores >= self.min_score)
+            scores = jnp.where(mask, scores, 0.0)
+        return scores, mask
+
+
+class KnnQuery(QueryBuilder):
+    """Native brute-force kNN — net-new surface (the reference only has
+    script_score brute force; no ANN at this version, SURVEY.md §2.6).
+    Score transforms follow the modern ES kNN conventions:
+    cosine → (1+cos)/2, dot_product → (1+dot)/2, l2_norm → 1/(1+d²)."""
+
+    name = "knn"
+
+    def __init__(self, field: str, query_vector: List[float],
+                 num_candidates: Optional[int] = None,
+                 filter_query: Optional[QueryBuilder] = None):
+        super().__init__()
+        self.field = field
+        self.query_vector = np.asarray(query_vector, np.float32)
+        self.num_candidates = num_candidates
+        self.filter_query = filter_query
+
+    def do_execute(self, ctx):
+        dv = ctx.device.vectors.get(self.field)
+        if dv is None:
+            z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+            return z, z.astype(bool)
+        q = jnp.asarray(self.query_vector)[None, :]
+        if dv.similarity == "cosine":
+            raw = vec_ops.cosine_scores(q, dv.vectors)[0]
+            scores = (1.0 + raw) / 2.0
+        elif dv.similarity == "dot_product":
+            raw = vec_ops.dot_scores(q, dv.vectors)[0]
+            scores = (1.0 + raw) / 2.0
+        else:  # l2_norm
+            neg_sq = vec_ops.l2_scores(q, dv.vectors, dv.sq_norms)[0]
+            scores = 1.0 / (1.0 - neg_sq)
+        mask = dv.has_value & ctx.all_true()
+        if self.filter_query is not None:
+            _, fm = self.filter_query.execute(ctx)
+            mask = mask & fm
+        scores = jnp.where(mask, scores, 0.0)
+        return scores, mask
+
+
+class FunctionScoreQuery(QueryBuilder):
+    """ref: functionscore/FunctionScoreQueryBuilder — subset: script_score
+    function, weight, boost_mode/score_mode multiply|sum|replace."""
+
+    name = "function_score"
+
+    def __init__(self, query: QueryBuilder, functions: List[Dict[str, Any]],
+                 boost_mode: str = "multiply", score_mode: str = "multiply"):
+        super().__init__()
+        self.query = query
+        self.functions = functions
+        self.boost_mode = boost_mode
+        self.score_mode = score_mode
+
+    def do_execute(self, ctx):
+        base, mask = self.query.execute(ctx)
+        fn_scores = []
+        for fn in self.functions:
+            weight = float(fn.get("weight", 1.0))
+            if "script_score" in fn:
+                script = fn["script_score"]["script"]
+                compiled = compile_script(script.get("source", script)
+                                          if isinstance(script, dict) else script)
+
+                def doc_columns(field):
+                    col, miss = ctx.numeric_column(field)
+                    return _DocColumn(col, miss)
+
+                sctx = ScriptContext(
+                    doc_columns,
+                    (script.get("params", {}) if isinstance(script, dict) else {}),
+                    score=base, vector_fns=_make_vector_fns(ctx))
+                val = jnp.broadcast_to(
+                    jnp.asarray(compiled(sctx), jnp.float32),
+                    (ctx.n_docs_padded,))
+                fn_scores.append(val * weight)
+            else:
+                fn_scores.append(jnp.full(ctx.n_docs_padded, weight, jnp.float32))
+        if fn_scores:
+            combined = fn_scores[0]
+            for f in fn_scores[1:]:
+                combined = (combined * f if self.score_mode == "multiply"
+                            else combined + f)
+            if self.boost_mode == "multiply":
+                scores = base * combined
+            elif self.boost_mode == "sum":
+                scores = base + combined
+            else:  # replace
+                scores = combined
+        else:
+            scores = base
+        scores = jnp.where(mask, scores, 0.0)
+        return scores, mask
+
+
+# ---------------------------------------------------------------------------
+# Parsing (ref: AbstractQueryBuilder.parseInnerQueryBuilder via
+# NamedXContentRegistry)
+# ---------------------------------------------------------------------------
+
+def parse_query(body: Dict[str, Any]) -> QueryBuilder:
+    if not isinstance(body, dict) or len(body) != 1:
+        raise ParsingException(
+            f"[query] malformed query, expected a single query type, got "
+            f"{list(body) if isinstance(body, dict) else type(body).__name__}")
+    (qtype, spec), = body.items()
+    parser = _PARSERS.get(qtype)
+    if parser is None:
+        raise ParsingException(f"unknown query [{qtype}]")
+    return parser(spec)
+
+
+def _with_boost(q: QueryBuilder, spec) -> QueryBuilder:
+    if isinstance(spec, dict) and "boost" in spec:
+        q.boost = float(spec["boost"])
+    return q
+
+
+def _parse_match(spec):
+    if not isinstance(spec, dict) or len(spec) != 1:
+        raise ParsingException("[match] query malformed")
+    (field, params), = spec.items()
+    if isinstance(params, dict):
+        q = MatchQuery(field, str(params.get("query", "")),
+                       operator=params.get("operator", "or"),
+                       minimum_should_match=params.get("minimum_should_match"))
+        return _with_boost(q, params)
+    return MatchQuery(field, str(params))
+
+
+def _parse_multi_match(spec):
+    return MultiMatchQuery(list(spec.get("fields", [])),
+                           str(spec.get("query", "")),
+                           type_=spec.get("type", "best_fields"),
+                           tie_breaker=float(spec.get("tie_breaker", 0.0)))
+
+
+def _parse_term(spec):
+    if not isinstance(spec, dict) or len(spec) != 1:
+        raise ParsingException("[term] query malformed")
+    (field, params), = spec.items()
+    if isinstance(params, dict):
+        return _with_boost(TermQuery(field, params.get("value")), params)
+    return TermQuery(field, params)
+
+
+def _parse_terms(spec):
+    fields = {k: v for k, v in spec.items() if k != "boost"}
+    if len(fields) != 1:
+        raise ParsingException("[terms] query requires exactly one field")
+    (field, values), = fields.items()
+    return _with_boost(TermsQuery(field, list(values)), spec)
+
+
+def _parse_range(spec):
+    (field, params), = spec.items()
+    # `from`/`to` legacy aliases
+    gte = params.get("gte", params.get("from"))
+    lte = params.get("lte", params.get("to"))
+    return _with_boost(
+        RangeQuery(field, gte=gte, gt=params.get("gt"),
+                   lte=lte, lt=params.get("lt")), params)
+
+
+def _parse_bool(spec):
+    def parse_clauses(key):
+        v = spec.get(key, [])
+        if isinstance(v, dict):
+            v = [v]
+        return [parse_query(c) for c in v]
+
+    q = BoolQuery(
+        must=parse_clauses("must"), filter=parse_clauses("filter"),
+        should=parse_clauses("should"), must_not=parse_clauses("must_not"),
+        minimum_should_match=spec.get("minimum_should_match"))
+    return _with_boost(q, spec)
+
+
+def _parse_script_score(spec):
+    script = spec["script"]
+    source = script["source"] if isinstance(script, dict) else str(script)
+    params = script.get("params", {}) if isinstance(script, dict) else {}
+    q = ScriptScoreQuery(parse_query(spec["query"]), source, params,
+                         min_score=spec.get("min_score"))
+    return _with_boost(q, spec)
+
+
+def _parse_knn(spec):
+    filt = spec.get("filter")
+    return KnnQuery(spec["field"], spec["query_vector"],
+                    num_candidates=spec.get("num_candidates"),
+                    filter_query=parse_query(filt) if filt else None)
+
+
+def _parse_dis_max(spec):
+    queries = [parse_query(q) for q in spec.get("queries", [])]
+    if not queries:
+        raise ParsingException("[dis_max] requires 'queries' field with at "
+                               "least one clause")
+    return DisMaxQuery(queries, tie_breaker=float(spec.get("tie_breaker", 0.0)))
+
+
+def _parse_function_score(spec):
+    inner = parse_query(spec.get("query", {"match_all": {}}))
+    functions = spec.get("functions", [])
+    if not functions and "script_score" in spec:
+        functions = [{"script_score": spec["script_score"]}]
+    return _with_boost(
+        FunctionScoreQuery(inner, functions,
+                           boost_mode=spec.get("boost_mode", "multiply"),
+                           score_mode=spec.get("score_mode", "multiply")), spec)
+
+
+_PARSERS = {
+    "match_all": lambda spec: _with_boost(MatchAllQuery(), spec),
+    "match_none": lambda spec: MatchNoneQuery(),
+    "match": _parse_match,
+    "multi_match": _parse_multi_match,
+    "term": _parse_term,
+    "terms": _parse_terms,
+    "range": _parse_range,
+    "exists": lambda spec: ExistsQuery(spec["field"]),
+    "ids": lambda spec: IdsQuery(list(spec.get("values", []))),
+    "bool": _parse_bool,
+    "constant_score": lambda spec: _with_boost(
+        ConstantScoreQuery(parse_query(spec["filter"])), spec),
+    "dis_max": lambda spec: _parse_dis_max(spec),
+    "boosting": lambda spec: BoostingQuery(
+        parse_query(spec["positive"]), parse_query(spec["negative"]),
+        float(spec.get("negative_boost", 0.5))),
+    "script_score": _parse_script_score,
+    "knn": _parse_knn,
+    "function_score": _parse_function_score,
+}

@@ -1,0 +1,168 @@
+"""Script engine: a sandboxed expression language compiled to batched jnp ops.
+
+The Painless analogue (ref: modules/lang-painless — ANTLR→AST→bytecode with
+per-context allowlists; and the vector script functions in
+x-pack/plugin/vectors/.../query/ScoreScriptUtils.java:112-170). Where
+Painless compiles to JVM bytecode run per document, this engine parses the
+expression with Python's ``ast`` module against a strict node allowlist and
+evaluates it ONCE over whole device arrays — ``doc['f'].value`` is a
+[n_docs] column, ``cosineSimilarity(...)`` a matmul — so a script_score is
+a fused XLA computation, not a per-doc interpreter loop.
+
+Supported surface (the score-script context):
+- arithmetic / comparisons / boolean ops, parentheses
+- ``doc['field'].value`` — numeric doc values column
+- ``_score`` — the subquery's BM25 score column
+- ``params.name`` / ``params['name']`` — request parameters
+- ``cosineSimilarity(params.qv, 'field')``, ``dotProduct(...)``,
+  ``l2norm(...)`` — dense-vector functions (return per-doc columns)
+- ``Math.log/log10/sqrt/exp/abs/min/max/pow/floor/ceil``, ``saturation``,
+  ``sigmoid``, ``rank_feature``-ish helpers
+
+Compilation is cached per source string (ref: ScriptService compilation
+cache + rate limits, script/ScriptService.java).
+"""
+
+from __future__ import annotations
+
+import ast
+import threading
+from typing import Any, Callable, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.common.errors import ScriptException
+
+_ALLOWED_NODES = (
+    ast.Expression, ast.BinOp, ast.UnaryOp, ast.BoolOp, ast.Compare,
+    ast.Call, ast.Attribute, ast.Subscript, ast.Name, ast.Constant,
+    ast.Load, ast.Add, ast.Sub, ast.Mult, ast.Div, ast.Mod, ast.Pow,
+    ast.FloorDiv, ast.USub, ast.UAdd, ast.Not, ast.And, ast.Or,
+    ast.Eq, ast.NotEq, ast.Lt, ast.LtE, ast.Gt, ast.GtE, ast.IfExp,
+)
+
+
+class _Math:
+    log = staticmethod(jnp.log)
+    log10 = staticmethod(jnp.log10)
+    sqrt = staticmethod(jnp.sqrt)
+    exp = staticmethod(jnp.exp)
+    abs = staticmethod(jnp.abs)
+    min = staticmethod(jnp.minimum)
+    max = staticmethod(jnp.maximum)
+    pow = staticmethod(jnp.power)
+    floor = staticmethod(jnp.floor)
+    ceil = staticmethod(jnp.ceil)
+    E = float(np.e)
+    PI = float(np.pi)
+
+
+class _DocColumn:
+    """`doc['field']` — exposes .value / .size() like the painless doc map."""
+
+    def __init__(self, values, missing):
+        self.value = values
+        self._missing = missing
+
+    def size(self):
+        return jnp.where(self._missing, 0, 1)
+
+    @property
+    def empty(self):
+        return self._missing
+
+
+class _Params:
+    def __init__(self, params: Dict[str, Any]):
+        self._params = params
+
+    def __getattr__(self, name):
+        try:
+            return self._params[name]
+        except KeyError:
+            raise ScriptException(f"missing script parameter [{name}]")
+
+    def __getitem__(self, name):
+        return getattr(self, name)
+
+
+class ScriptContext:
+    """Everything a score script may touch, columnar (built by the query
+    layer per segment)."""
+
+    def __init__(self, doc_columns: Callable[[str], _DocColumn],
+                 params: Dict[str, Any],
+                 score=None,
+                 vector_fns: Dict[str, Callable] = None):
+        self._doc_columns = doc_columns
+        self.params = _Params(params)
+        self.score = score
+        self.vector_fns = vector_fns or {}
+
+
+class _Doc:
+    def __init__(self, ctx: ScriptContext):
+        self._ctx = ctx
+
+    def __getitem__(self, field: str) -> _DocColumn:
+        return self._ctx._doc_columns(field)
+
+
+# the per-context allowlist (ref: painless per-context whitelists)
+_ALLOWED_NAMES = {
+    "doc", "params", "_score", "Math", "saturation", "sigmoid",
+    "cosineSimilarity", "dotProduct", "l2norm", "True", "False",
+}
+
+
+def _validate(tree: ast.AST, source: str):
+    for node in ast.walk(tree):
+        if not isinstance(node, _ALLOWED_NODES):
+            raise ScriptException(
+                f"compile error: [{type(node).__name__}] is not allowed in "
+                f"scripts: [{source}]")
+        if isinstance(node, ast.Attribute) and node.attr.startswith("_"):
+            raise ScriptException(
+                f"compile error: access to [{node.attr}] is not allowed")
+        if isinstance(node, ast.Name) and node.id not in _ALLOWED_NAMES:
+            raise ScriptException(
+                f"compile error: unknown variable [{node.id}]")
+
+
+_cache: Dict[str, Any] = {}
+_cache_lock = threading.Lock()
+
+
+def compile_script(source: str):
+    """Parse + validate; returns a callable(ctx) -> array."""
+    with _cache_lock:
+        code = _cache.get(source)
+    if code is None:
+        try:
+            tree = ast.parse(source, mode="eval")
+        except SyntaxError as e:
+            raise ScriptException(f"compile error: {e}: [{source}]")
+        _validate(tree, source)
+        code = compile(tree, "<script>", "eval")
+        with _cache_lock:
+            _cache[source] = code
+
+    def run(ctx: ScriptContext):
+        namespace = {
+            "doc": _Doc(ctx),
+            "params": ctx.params,
+            "_score": ctx.score,
+            "Math": _Math,
+            "saturation": lambda v, pivot: v / (v + pivot),
+            "sigmoid": lambda v, k, a: v ** a / (k ** a + v ** a),
+        }
+        namespace.update(ctx.vector_fns)
+        try:
+            return eval(code, {"__builtins__": {}}, namespace)  # noqa: S307
+        except ScriptException:
+            raise
+        except Exception as e:
+            raise ScriptException(f"runtime error: {e} in script [{source}]")
+
+    return run

@@ -1,0 +1,459 @@
+"""Shard searcher: the query and fetch phases over one shard.
+
+Mirrors the data-node side of the reference (ref: search/query/
+QueryPhase.java:170-328 — collector chain of post_filter → min_score →
+top-k; search/fetch/FetchPhase.java:75,90 — load _source for winners).
+Execution model: per segment, the compiled query produces dense
+(scores, mask) device arrays; the collector chain is mask algebra; top-k
+runs on device (ops/topk.py); per-segment results merge host-side the way
+SearchPhaseController.mergeTopDocs merges per-shard results — by
+(-score, segment_idx, docid), Lucene's exact tie order.
+
+Sorting: sort keys are columnar doc values, so a sort is top-k over a
+transformed key column. Multi-key sorts use the primary key on device and
+re-sort the k winners by the full key host-side (exact unless >k docs tie
+on the primary key — noted limitation).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.index.mapper import MapperService
+from elasticsearch_tpu.index.segment import Segment
+from elasticsearch_tpu.ops import topk as topk_ops
+from elasticsearch_tpu.search.context import (
+    DeviceSegmentCache,
+    SegmentContext,
+    ShardStats,
+)
+from elasticsearch_tpu.search.queries import QueryBuilder, parse_query
+
+MAX_TOPK = 10000
+
+
+@dataclass
+class DocAddress:
+    segment_idx: int
+    docid: int
+    score: float
+    sort_values: Tuple = ()
+    sort_key: float = 0.0  # the device key used for ordering (score or field)
+
+
+@dataclass
+class QueryResult:
+    """Per-shard query-phase result (ref: QuerySearchResult): doc addresses
+    + scores only — sources are fetched in the fetch phase for winners."""
+
+    docs: List[DocAddress]
+    total_hits: int
+    max_score: Optional[float]
+
+
+class ShardSearcher:
+    def __init__(self, segments: List[Segment], mapper: MapperService,
+                 cache: Optional[DeviceSegmentCache] = None,
+                 k1: float = 1.2, b: float = 0.75):
+        self.segments = segments
+        self.mapper = mapper
+        self.cache = cache or DeviceSegmentCache()
+        self.stats = ShardStats(segments)
+        self.k1 = k1
+        self.b = b
+
+    def _contexts(self) -> List[SegmentContext]:
+        return [SegmentContext(seg, self.cache.get(seg), self.mapper,
+                               self.stats, self.k1, self.b)
+                for seg in self.segments]
+
+    # ------------------------------------------------------------ query
+    def query_phase(self, query: QueryBuilder, size: int,
+                    post_filter: Optional[QueryBuilder] = None,
+                    min_score: Optional[float] = None,
+                    sort: Optional[List[Dict[str, Any]]] = None,
+                    search_after: Optional[List[Any]] = None,
+                    track_total_hits: bool = True,
+                    after_key: Optional[Tuple[float, int, int]] = None
+                    ) -> QueryResult:
+        k = min(max(size, 1), MAX_TOPK)
+        sort_spec = _parse_sort(sort)
+        per_segment: List[Tuple[int, np.ndarray, np.ndarray, np.ndarray]] = []
+        total = 0
+        max_score = None
+
+        for seg_idx, ctx in enumerate(self._contexts()):
+            if ctx.segment.n_docs == 0 or not query.can_match(ctx):
+                continue
+            scores, mask = query.execute(ctx)
+            mask = mask & ctx.live
+            if post_filter is not None:
+                _, pf_mask = post_filter.execute(ctx)
+                mask = mask & pf_mask
+            if min_score is not None:
+                mask = mask & (scores >= min_score)
+            if track_total_hits:
+                total += int(jnp.sum(mask))
+            if _needs_max_score(sort_spec):
+                seg_max = float(jnp.max(jnp.where(mask, scores, -jnp.inf)))
+                if np.isfinite(seg_max):
+                    max_score = seg_max if max_score is None else max(max_score, seg_max)
+
+            key = _primary_sort_key(ctx, scores, sort_spec)
+            if search_after is not None:
+                mask = mask & _search_after_mask(
+                    ctx, scores, sort_spec, search_after)
+            if after_key is not None:
+                # exact scroll continuation: strictly after the last emitted
+                # doc in (key desc, segment asc, docid asc) order (ref:
+                # scroll lastEmittedDoc, QueryPhase.java:182-213)
+                ck, cseg, cdoc = after_key
+                if seg_idx < cseg:
+                    allowed = key < ck
+                elif seg_idx == cseg:
+                    docids = jnp.arange(ctx.n_docs_padded)
+                    allowed = (key < ck) | ((key == ck) & (docids > cdoc))
+                else:
+                    allowed = key <= ck
+                mask = mask & allowed
+            vals, ids = topk_ops.masked_topk(key, mask, min(k, ctx.n_docs_padded))
+            vals, ids = np.asarray(vals), np.asarray(ids)
+            keep = np.isfinite(vals)
+            ids = ids[keep]
+            scores_np = np.asarray(scores)[ids]
+            per_segment.append((seg_idx, vals[keep], ids, scores_np))
+
+        # ---- merge per-segment top-k (ref: SearchPhaseController.sortDocs)
+        if not per_segment:
+            return QueryResult([], total, None)
+        all_keys = np.concatenate([v for _, v, _, _ in per_segment])
+        all_segs = np.concatenate(
+            [np.full(len(i), s, np.int32) for s, _, i, _ in per_segment])
+        all_ids = np.concatenate([i for _, _, i, _ in per_segment])
+        all_scores = np.concatenate([sc for _, _, _, sc in per_segment])
+        order = np.lexsort((all_ids, all_segs, -all_keys))[:k]
+
+        docs = []
+        for idx in order:
+            seg_idx, docid = int(all_segs[idx]), int(all_ids[idx])
+            ctx_seg = self.segments[seg_idx]
+            sv = _sort_values(self, ctx_seg, docid, float(all_scores[idx]), sort_spec)
+            docs.append(DocAddress(seg_idx, docid, float(all_scores[idx]), sv,
+                                   sort_key=float(all_keys[idx])))
+        # multi-key: re-sort winners by the full key host-side
+        if sort_spec is not None and len(sort_spec) > 1:
+            docs.sort(key=lambda d: _host_sort_key(d, sort_spec))
+        return QueryResult(docs, total, max_score)
+
+    # ------------------------------------------------------------ fetch
+    def fetch_phase(self, docs: List[DocAddress],
+                    source_filter: Any = True,
+                    docvalue_fields: Optional[List[str]] = None,
+                    highlight: Optional[Dict[str, Any]] = None,
+                    highlight_query: Optional[QueryBuilder] = None) -> List[Dict[str, Any]]:
+        hits = []
+        for d in docs:
+            seg = self.segments[d.segment_idx]
+            hit: Dict[str, Any] = {
+                "_id": seg.stored.ids[d.docid],
+                "_score": d.score if d.score == d.score else None,
+            }
+            if d.sort_values:
+                hit["sort"] = list(d.sort_values)
+            if source_filter is not False:
+                source = json.loads(seg.stored.source(d.docid))
+                hit["_source"] = _filter_source(source, source_filter)
+            if docvalue_fields:
+                fields = {}
+                for f in docvalue_fields:
+                    nv = seg.numerics.get(f)
+                    if nv is not None:
+                        vs = nv.get(d.docid)
+                        if vs:
+                            fields[f] = vs
+                    kv = seg.keywords.get(f)
+                    if kv is not None:
+                        vs = kv.get(d.docid)
+                        if vs:
+                            fields[f] = vs
+                hit["fields"] = fields
+            if highlight:
+                hit["highlight"] = self._highlight(seg, d.docid, highlight,
+                                                   highlight_query)
+            hits.append(hit)
+        return hits
+
+    def _highlight(self, seg: Segment, docid: int, spec: Dict[str, Any],
+                   query: Optional[QueryBuilder]) -> Dict[str, List[str]]:
+        """Plain-highlighter analogue (ref: search/fetch/subphase/highlight/
+        PlainHighlighter): re-analyzes the stored text and wraps query terms."""
+        pre = spec.get("pre_tags", ["<em>"])[0]
+        post = spec.get("post_tags", ["</em>"])[0]
+        query_terms = _collect_terms(query, self.mapper) if query else {}
+        source = json.loads(seg.stored.source(docid))
+        out: Dict[str, List[str]] = {}
+        for fname in spec.get("fields", {}):
+            value = _get_path(source, fname)
+            if not isinstance(value, str):
+                continue
+            terms = query_terms.get(fname, set())
+            if not terms:
+                continue
+            ft = self.mapper.field_type(fname)
+            analyzer_name = getattr(ft, "analyzer_name", "standard")
+            analyzer = (self.mapper.analysis.get(analyzer_name)
+                        if self.mapper.analysis.has(analyzer_name)
+                        else self.mapper.analysis.default)
+            spans = [(t.start_offset, t.end_offset)
+                     for t in analyzer.analyze(value) if t.term in terms]
+            if not spans:
+                continue
+            frag = []
+            last = 0
+            for s, e in spans:
+                frag.append(value[last:s])
+                frag.append(pre + value[s:e] + post)
+                last = e
+            frag.append(value[last:])
+            out[fname] = ["".join(frag)]
+        return out
+
+
+# ---------------------------------------------------------------------------
+# sort machinery
+# ---------------------------------------------------------------------------
+
+@dataclass
+class SortKey:
+    field: str           # "_score" | "_doc" | field name
+    order: str           # "asc" | "desc"
+    missing: float = 0.0
+
+
+def _parse_sort(sort) -> Optional[List[SortKey]]:
+    if not sort:
+        return None
+    if isinstance(sort, (str, dict)):
+        sort = [sort]
+    keys = []
+    for entry in sort:
+        if isinstance(entry, str):
+            field_name, order = entry, ("asc" if entry not in ("_score",) else "desc")
+        else:
+            (field_name, spec), = entry.items()
+            if isinstance(spec, str):
+                order = spec
+                spec = {}
+            else:
+                order = spec.get("order", "desc" if field_name == "_score" else "asc")
+        keys.append(SortKey(field_name, order))
+    return keys
+
+
+def _needs_max_score(sort_spec) -> bool:
+    return sort_spec is None
+
+
+def _primary_sort_key(ctx: SegmentContext, scores, sort_spec) -> jnp.ndarray:
+    """Device key column for top-k (max-selected): negate for ascending."""
+    if sort_spec is None or sort_spec[0].field == "_score":
+        key = scores
+        if sort_spec and sort_spec[0].order == "asc":
+            key = -key
+        return key
+    sk = sort_spec[0]
+    if sk.field == "_doc":
+        key = -jnp.arange(ctx.n_docs_padded, dtype=jnp.float32)
+        return key if sk.order == "asc" else -key
+    col, miss = ctx.numeric_column(sk.field)
+    missing_val = jnp.float32(np.finfo(np.float32).max if sk.order == "asc"
+                              else np.finfo(np.float32).min)
+    key = jnp.where(miss, missing_val, col)
+    return -key if sk.order == "asc" else key
+
+
+def _sort_values(searcher, seg: Segment, docid: int, score: float,
+                 sort_spec) -> Tuple:
+    if sort_spec is None:
+        return ()
+    out = []
+    for sk in sort_spec:
+        if sk.field == "_score":
+            out.append(score)
+        elif sk.field == "_doc":
+            out.append(docid)
+        else:
+            nv = seg.numerics.get(sk.field)
+            v = None
+            if nv is not None and not nv.missing[docid]:
+                v = float(nv.values[docid])
+            out.append(v)
+    return tuple(out)
+
+
+def _host_sort_key(d: DocAddress, sort_spec):
+    key = []
+    for sk, v in zip(sort_spec, d.sort_values):
+        if v is None:
+            v = float("inf") if sk.order == "asc" else float("-inf")
+        key.append(v if sk.order == "asc" else -v)
+    key.append(d.segment_idx)
+    key.append(d.docid)
+    return tuple(key)
+
+
+def _search_after_mask(ctx: SegmentContext, scores, sort_spec,
+                       after: List[Any]) -> jnp.ndarray:
+    """Docs strictly after the cursor in sort order (ref: searchafter/
+    SearchAfterBuilder). With a single non-unique sort key, docs tied with
+    the cursor are excluded — as in ES, reliable pagination requires a
+    trailing ``_doc`` (or unique field) tiebreaker, which IS applied here
+    when the sort spec's last key is ``_doc`` and ``after`` carries its
+    value."""
+    # strictly-after on the primary key
+    if sort_spec is None or sort_spec[0].field == "_score":
+        after_val = float(after[0])
+        primary = scores
+        strictly = primary < after_val
+        tied = primary == after_val
+    else:
+        sk = sort_spec[0]
+        col, miss = ctx.numeric_column(sk.field)
+        after_val = float(after[0])
+        if sk.order == "asc":
+            strictly = (~miss) & (col > after_val)
+            tied = (~miss) & (col == after_val)
+        else:
+            strictly = (~miss) & (col < after_val)
+            tied = (~miss) & (col == after_val)
+    if (sort_spec is not None and len(sort_spec) >= 2
+            and sort_spec[-1].field == "_doc" and len(after) >= 2):
+        docids = jnp.arange(ctx.n_docs_padded)
+        return strictly | (tied & (docids > int(after[-1])))
+    return strictly
+
+
+# ---------------------------------------------------------------------------
+# fetch helpers
+# ---------------------------------------------------------------------------
+
+def _filter_source(source: Dict[str, Any], source_filter) -> Optional[Dict[str, Any]]:
+    """_source: true | false | "field" | [fields] | {includes, excludes}
+    (ref: search/fetch/subphase/FetchSourcePhase)."""
+    if source_filter is True:
+        return source
+    if source_filter is False:
+        return None
+    includes: List[str] = []
+    excludes: List[str] = []
+    if isinstance(source_filter, str):
+        includes = [source_filter]
+    elif isinstance(source_filter, list):
+        includes = source_filter
+    elif isinstance(source_filter, dict):
+        includes = source_filter.get("includes", source_filter.get("include", []))
+        excludes = source_filter.get("excludes", source_filter.get("exclude", []))
+        if isinstance(includes, str):
+            includes = [includes]
+        if isinstance(excludes, str):
+            excludes = [excludes]
+
+    def match(path: str, patterns: List[str]) -> bool:
+        import fnmatch
+        return any(fnmatch.fnmatch(path, p) or path.startswith(p + ".")
+                   for p in patterns)
+
+    def walk(obj, prefix=""):
+        if not isinstance(obj, dict):
+            return obj
+        out = {}
+        for k, v in obj.items():
+            path = f"{prefix}{k}"
+            if excludes and match(path, excludes):
+                continue
+            if isinstance(v, dict):
+                sub = walk(v, f"{path}.")
+                if sub:
+                    out[k] = sub
+            else:
+                if includes and not match(path, includes):
+                    continue
+                out[k] = v
+        return out
+
+    if includes:
+        # keep parents of included leaves
+        def walk_inc(obj, prefix=""):
+            if not isinstance(obj, dict):
+                return obj
+            out = {}
+            for k, v in obj.items():
+                path = f"{prefix}{k}"
+                if excludes and match(path, excludes):
+                    continue
+                if isinstance(v, dict):
+                    sub = walk_inc(v, f"{path}.")
+                    if sub:
+                        out[k] = sub
+                elif match(path, includes):
+                    out[k] = v
+            return out
+        return walk_inc(source)
+    return walk(source)
+
+
+def _get_path(source: Dict[str, Any], path: str):
+    node = source
+    for part in path.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node
+
+
+def _collect_terms(query: Optional[QueryBuilder],
+                   mapper: MapperService) -> Dict[str, set]:
+    """Query terms per field, for highlighting."""
+    from elasticsearch_tpu.search import queries as q
+
+    out: Dict[str, set] = {}
+
+    def visit(node):
+        if node is None:
+            return
+        if isinstance(node, q.MatchQuery):
+            ft = mapper.field_type(node.field)
+            name = getattr(ft, "search_analyzer_name", "standard")
+            analyzer = (mapper.analysis.get(name) if mapper.analysis.has(name)
+                        else mapper.analysis.default)
+            out.setdefault(node.field, set()).update(analyzer.terms(node.query))
+        elif isinstance(node, q.MultiMatchQuery):
+            for f in node.fields:
+                visit(q.MatchQuery(f, node.query))
+        elif isinstance(node, q.TermQuery):
+            out.setdefault(node.field, set()).add(str(node.value))
+        elif isinstance(node, q.TermsQuery):
+            out.setdefault(node.field, set()).update(str(v) for v in node.values)
+        elif isinstance(node, q.BoolQuery):
+            for clause in node.must + node.should + node.filter:
+                visit(clause)
+        elif isinstance(node, (q.ConstantScoreQuery,)):
+            visit(node.filter_query)
+        elif isinstance(node, q.DisMaxQuery):
+            for sub in node.queries:
+                visit(sub)
+        elif isinstance(node, q.ScriptScoreQuery):
+            visit(node.query)
+        elif isinstance(node, q.BoostingQuery):
+            visit(node.positive)
+        elif isinstance(node, q.FunctionScoreQuery):
+            visit(node.query)
+
+    visit(query)
+    return out

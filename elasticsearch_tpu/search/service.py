@@ -1,0 +1,221 @@
+"""Search service: request lifecycle over one or more indices.
+
+Mirrors both sides of the reference's search stack collapsed into one
+process (ref: action/search/TransportSearchAction.java:216-240 — resolve
+indices, fan out; search/SearchService.java:136,230,293 — context
+lifecycle with keepalive reaper, scroll contexts): parses the request
+body, fans out to every shard searcher, merges per-shard top-k
+(SearchPhaseController-style), runs the fetch phase on winners, and
+manages scroll contexts with expiry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    SearchContextMissingException,
+)
+from elasticsearch_tpu.common.settings import parse_time_value
+from elasticsearch_tpu.index.service import IndexService, IndicesService
+from elasticsearch_tpu.search.queries import MatchAllQuery, parse_query
+from elasticsearch_tpu.search.searcher import (
+    DocAddress,
+    QueryResult,
+    ShardSearcher,
+)
+
+DEFAULT_SIZE = 10
+
+
+@dataclass
+class ScrollContext:
+    """A pinned point-in-time over shard snapshots + continuation cursor
+    (ref: the reference's scroll contexts pin a reader + lastEmittedDoc,
+    SURVEY.md §5.7)."""
+
+    scroll_id: str
+    index_names: List[str]
+    searchers: List[Tuple[str, ShardSearcher]]
+    body: Dict[str, Any]
+    keep_alive: float
+    expires_at: float
+    # per (index, shard) continuation: (last_key, last_seg, last_docid)
+    cursors: Dict[int, Tuple[float, int, int]] = field(default_factory=dict)
+    # total hits from the initial page, reported on every scroll page
+    # (ref: scroll responses carry the full total throughout)
+    total_hits: int = 0
+
+
+class SearchService:
+    def __init__(self, indices_service: IndicesService):
+        self.indices_service = indices_service
+        self._scrolls: Dict[str, ScrollContext] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ public
+    def search(self, index_expression: str, body: Dict[str, Any],
+               scroll: Optional[str] = None) -> Dict[str, Any]:
+        start = time.monotonic()
+        names = self.indices_service.resolve(index_expression)
+        searchers: List[Tuple[str, ShardSearcher]] = []
+        for name in names:
+            idx = self.indices_service.get(name)
+            for s in idx.shard_searchers():
+                searchers.append((name, s))
+
+        scroll_ctx = None
+        if scroll is not None:
+            keep_alive = parse_time_value(scroll, "scroll")
+            scroll_ctx = ScrollContext(
+                scroll_id=uuid.uuid4().hex, index_names=names,
+                searchers=searchers, body=body, keep_alive=keep_alive,
+                expires_at=time.time() + keep_alive)
+            with self._lock:
+                self._scrolls[scroll_ctx.scroll_id] = scroll_ctx
+
+        response = self._execute(searchers, body, scroll_ctx=scroll_ctx)
+        response["took"] = int((time.monotonic() - start) * 1000)
+        if scroll_ctx is not None:
+            response["_scroll_id"] = scroll_ctx.scroll_id
+        return response
+
+    def scroll(self, scroll_id: str, scroll: Optional[str] = None) -> Dict[str, Any]:
+        start = time.monotonic()
+        self._reap()
+        with self._lock:
+            ctx = self._scrolls.get(scroll_id)
+        if ctx is None:
+            raise SearchContextMissingException(scroll_id)
+        if scroll is not None:
+            ctx.keep_alive = parse_time_value(scroll, "scroll")
+        ctx.expires_at = time.time() + ctx.keep_alive
+        response = self._execute(ctx.searchers, ctx.body, scroll_ctx=ctx,
+                                 continuing=True)
+        response["took"] = int((time.monotonic() - start) * 1000)
+        response["_scroll_id"] = scroll_id
+        return response
+
+    def clear_scroll(self, scroll_ids: List[str]) -> int:
+        freed = 0
+        with self._lock:
+            if scroll_ids == ["_all"]:
+                freed = len(self._scrolls)
+                self._scrolls.clear()
+            else:
+                for sid in scroll_ids:
+                    if self._scrolls.pop(sid, None) is not None:
+                        freed += 1
+        return freed
+
+    def open_scroll_count(self) -> int:
+        with self._lock:
+            return len(self._scrolls)
+
+    def _reap(self):
+        now = time.time()
+        with self._lock:
+            for sid in [s for s, c in self._scrolls.items() if c.expires_at < now]:
+                del self._scrolls[sid]
+
+    # ---------------------------------------------------------- internal
+    def _execute(self, searchers: List[Tuple[str, ShardSearcher]],
+                 body: Dict[str, Any], scroll_ctx: Optional[ScrollContext] = None,
+                 continuing: bool = False) -> Dict[str, Any]:
+        body = body or {}
+        query = (parse_query(body["query"]) if body.get("query")
+                 else MatchAllQuery())
+        post_filter = (parse_query(body["post_filter"])
+                       if body.get("post_filter") else None)
+        size = int(body.get("size", DEFAULT_SIZE))
+        from_ = int(body.get("from", 0))
+        if from_ + size > 10000 and scroll_ctx is None:
+            raise IllegalArgumentException(
+                "Result window is too large, from + size must be less than "
+                "or equal to: [10000]. Use the scroll API or search_after")
+        sort = body.get("sort")
+        min_score = body.get("min_score")
+        search_after = body.get("search_after")
+        track_total = body.get("track_total_hits", True)
+        highlight = body.get("highlight")
+
+        k = from_ + size if scroll_ctx is None else size
+
+        # ---- query phase: fan out over shards (ref:
+        # AbstractSearchAsyncAction.run / SearchPhaseController merge)
+        shard_results: List[Tuple[str, ShardSearcher, QueryResult]] = []
+        total = 0
+        max_score = None
+        for shard_idx, (index_name, searcher) in enumerate(searchers):
+            after_key = (scroll_ctx.cursors.get(shard_idx)
+                         if (scroll_ctx is not None and continuing) else None)
+            result = searcher.query_phase(
+                query, k, post_filter=post_filter, min_score=min_score,
+                sort=sort, search_after=search_after,
+                track_total_hits=bool(track_total) and not continuing,
+                after_key=after_key)
+            shard_results.append((index_name, searcher, result))
+            total += result.total_hits
+            if result.max_score is not None:
+                max_score = (result.max_score if max_score is None
+                             else max(max_score, result.max_score))
+
+        # ---- merge (score desc / sort key, then shard order, then docid)
+        merged: List[Tuple[float, int, DocAddress, str, ShardSearcher]] = []
+        for shard_idx, (index_name, searcher, result) in enumerate(shard_results):
+            for d in result.docs:
+                merged.append((d.sort_key, shard_idx, d, index_name, searcher))
+        merged.sort(key=lambda e: (-e[0], e[1], e[2].segment_idx, e[2].docid))
+        page = merged[from_:from_ + size] if scroll_ctx is None else merged[:size]
+
+        # update scroll cursors with the last emitted doc per shard
+        if scroll_ctx is not None:
+            for key, shard_idx, d, _, _ in page:
+                scroll_ctx.cursors[shard_idx] = (key, d.segment_idx, d.docid)
+
+        # ---- fetch phase on winners only (ref: FetchSearchPhase.java:104)
+        hits = []
+        source_filter = body.get("_source", True)
+        docvalue_fields = [f if isinstance(f, str) else f.get("field")
+                           for f in body.get("docvalue_fields", [])]
+        for _, _, d, index_name, searcher in page:
+            fetched = searcher.fetch_phase(
+                [d], source_filter=source_filter,
+                docvalue_fields=docvalue_fields or None,
+                highlight=highlight, highlight_query=query)[0]
+            fetched["_index"] = index_name
+            hits.append(fetched)
+
+        relation = "eq"
+        if scroll_ctx is not None:
+            if continuing:
+                total = scroll_ctx.total_hits
+            else:
+                scroll_ctx.total_hits = total
+        if isinstance(track_total, int) and not isinstance(track_total, bool):
+            if total > track_total:
+                total = track_total
+                relation = "gte"
+        return {
+            "timed_out": False,
+            "_shards": {"total": len(searchers), "successful": len(searchers),
+                        "skipped": 0, "failed": 0},
+            "hits": {
+                "total": {"value": total, "relation": relation},
+                "max_score": max_score,
+                "hits": hits,
+            },
+        }
+
+    def count(self, index_expression: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        body = dict(body or {})
+        body["size"] = 0
+        body.pop("sort", None)
+        response = self.search(index_expression, body)
+        return {"count": response["hits"]["total"]["value"],
+                "_shards": response["_shards"]}

@@ -3,19 +3,26 @@
 Tests run JAX on a virtual 8-device CPU mesh (mirrors the reference's
 InternalTestCluster strategy of booting multiple nodes in one JVM, ref:
 test/framework/.../InternalTestCluster.java): sharding/collective code is
-exercised without TPU hardware. Must set env vars before jax import.
+exercised without TPU hardware.
+
+Note: the harness's axon site hook (PYTHONPATH=/root/.axon_site) re-forces
+JAX_PLATFORMS=axon during jax import, so setting the env var is NOT enough —
+the platform must be pinned via jax.config AFTER import (XLA_FLAGS must
+still be set BEFORE import for the host-device count to apply).
 """
 
 import os
 
-# override, not setdefault: the harness presets JAX_PLATFORMS=axon (TPU)
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -24,3 +31,9 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_cpu_mesh():
+    devices = jax.devices()
+    assert devices[0].platform == "cpu" and len(devices) == 8, devices

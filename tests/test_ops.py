@@ -80,7 +80,7 @@ def test_bm25_topk_ordering_matches_reference(rng):
     scores = bm25_ops.bm25_block_scores(
         dp.block_docids, dp.block_tfs, sel, ws, dp.doc_lens,
         np.float32(dp.avg_len), 1.2, 0.75)
-    vals, ids = topk_ops.masked_topk(scores, dev.live, 10)
+    vals, ids = topk_ops.masked_topk(scores, dev.live & (scores > 0), 10)
     vals, ids = np.asarray(vals), np.asarray(ids)
 
     ref = bm25_ops.bm25_reference_scores(
@@ -102,7 +102,7 @@ def test_masked_topk_excludes_deleted_and_nonmatching(rng):
     scores = bm25_ops.bm25_block_scores(
         dp.block_docids, dp.block_tfs, sel, ws, dp.doc_lens,
         np.float32(dp.avg_len), 1.2, 0.75)
-    vals, ids = topk_ops.masked_topk(scores, dev.live, 3)
+    vals, ids = topk_ops.masked_topk(scores, dev.live & (scores > 0), 3)
     vals = np.asarray(vals)
     assert ids[0] == 1            # doc 0 deleted, doc 2 non-matching
     assert np.isinf(vals[1]) and vals[1] < 0

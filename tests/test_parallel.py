@@ -1,0 +1,147 @@
+"""Sharded-search tests over the 8-device CPU mesh (model: the reference's
+multi-node scatter-gather tests; validates collective merge == single-host
+merge)."""
+
+import jax
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.mapper import MapperService
+from elasticsearch_tpu.index.segment import SegmentWriter
+from elasticsearch_tpu.ops import bm25 as bm25_ops
+from elasticsearch_tpu.parallel.sharded import (
+    ShardedIndex,
+    build_sharded_index,
+    make_mesh,
+    sharded_bm25_topk,
+    sharded_dfs_stats,
+    sharded_knn_topk,
+)
+
+MAPPINGS = {"properties": {"body": {"type": "text"},
+                           "vec": {"type": "dense_vector", "dims": 8,
+                                   "similarity": "dot_product"}}}
+VOCAB = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta"]
+
+
+def build_shards(rng, n_shards=8, docs_per_shard=100, with_vec=True):
+    svc = MapperService(mappings=MAPPINGS)
+    segments = []
+    all_docs = []
+    probs = 1.0 / np.arange(1, len(VOCAB) + 1)
+    probs /= probs.sum()
+    for s in range(n_shards):
+        w = SegmentWriter()
+        for i in range(docs_per_shard):
+            words = rng.choice(VOCAB, size=int(rng.integers(1, 20)), p=probs)
+            doc = {"body": " ".join(words)}
+            if with_vec:
+                doc["vec"] = rng.standard_normal(8).astype(np.float32).tolist()
+            w.add(svc.parse(f"{s}-{i}", doc))
+            all_docs.append((s, i, doc))
+        segments.append(w.build(f"shard{s}"))
+    return segments, all_docs
+
+
+@pytest.fixture(scope="module")
+def sharded(rng=None):
+    rng = np.random.default_rng(7)
+    mesh = make_mesh(n_shards=8)
+    segments, all_docs = build_shards(rng)
+    index, pfs = build_sharded_index(mesh, segments, "body",
+                                     with_vectors="vec")
+    return mesh, segments, all_docs, index, pfs
+
+
+def _select(pfs, index, terms, idfs):
+    """Host-side block selection per shard, padded to a common NB."""
+    per_shard = []
+    for pf in pfs:
+        ids, ws = [], []
+        for t, w in zip(terms, idfs):
+            tid = pf.term_id(t) if pf else -1
+            if tid >= 0:
+                start, cnt = int(pf.term_block_start[tid]), int(pf.term_block_count[tid])
+                ids.extend(range(start, start + cnt))
+                ws.extend([w] * cnt)
+        per_shard.append((ids, ws))
+    nb = max(8, max(len(i) for i, _ in per_shard))
+    zero_block = index.block_docids.shape[1] - 1  # reserved zero pad row
+    sel = np.full((len(pfs), nb), zero_block, np.int32)
+    wsel = np.zeros((len(pfs), nb), np.float32)
+    for s, (ids, ws) in enumerate(per_shard):
+        sel[s, : len(ids)] = ids
+        wsel[s, : len(ids)] = ws
+    return sel, wsel
+
+
+def test_sharded_bm25_matches_global_reference(sharded):
+    mesh, segments, all_docs, index, pfs = sharded
+    terms = ["alpha", "gamma"]
+    # shard-level dfs -> global idf (the DFS phase)
+    n_total = sum(pf.doc_count for pf in pfs)
+    dfs = [sum(int(pf.doc_freq[pf.term_id(t)]) for pf in pfs
+               if pf.term_id(t) >= 0) for t in terms]
+    idfs = [bm25_ops.idf(df, n_total) for df in dfs]
+    avg = index.avg_len
+
+    sel, wsel = _select(pfs, index, terms, idfs)
+    sel = np.broadcast_to(sel[:, None, :], (8, 1, sel.shape[1]))  # Q=1
+    wsel = np.broadcast_to(wsel[:, None, :], (8, 1, wsel.shape[1]))
+    vals, gids = sharded_bm25_topk(index, sel, wsel, k=10)
+    vals, gids = np.asarray(vals)[0], np.asarray(gids)[0]
+
+    # global scalar reference over all shards
+    ref = {}
+    for s, pf in enumerate(pfs):
+        scores = bm25_ops.bm25_reference_scores(
+            [pf.postings(t) for t in terms], idfs,
+            np.maximum(pf.field_lengths, 1.0), avg, 1.2, 0.75)
+        for d, sc in enumerate(scores):
+            if sc > 0:
+                ref[s * index.n_docs_padded + d] = sc
+    expected = sorted(ref.items(), key=lambda kv: (-kv[1], kv[0]))[:10]
+    got = [(int(g), float(v)) for v, g in zip(vals, gids)]
+    assert [g for g, _ in got] == [g for g, _ in expected]
+    np.testing.assert_allclose([v for _, v in got],
+                               [v for _, v in expected], rtol=2e-5)
+
+
+def test_sharded_knn_matches_reference(sharded):
+    mesh, segments, all_docs, index, pfs = sharded
+    rng = np.random.default_rng(3)
+    queries = rng.standard_normal((2, 8)).astype(np.float32)
+    vals, gids = sharded_knn_topk(index, queries, k=5)
+    vals, gids = np.asarray(vals), np.asarray(gids)
+
+    # reference: dot product over every stored vector
+    for qi in range(2):
+        ref = {}
+        for s, seg in enumerate(segments):
+            vv = seg.vectors["vec"]
+            scores = vv.vectors @ queries[qi]
+            for d in range(seg.n_docs):
+                if vv.has_value[d]:
+                    ref[s * index.n_docs_padded + d] = scores[d]
+        expected = sorted(ref.items(), key=lambda kv: (-kv[1], kv[0]))[:5]
+        np.testing.assert_allclose(vals[qi], [v for _, v in expected],
+                                   rtol=1e-4, atol=1e-5)
+        assert gids[qi].tolist() == [g for g, _ in expected]
+
+
+def test_sharded_dfs_psum(sharded):
+    mesh, segments, all_docs, index, pfs = sharded
+    term = "alpha"
+    idf_dummy = [1.0]
+    sel, _ = _select(pfs, index, [term], idf_dummy)
+    dfs = np.asarray(sharded_dfs_stats(index, sel))
+    total_df = sum(int(pf.doc_freq[pf.term_id(term)]) for pf in pfs
+                   if pf.term_id(term) >= 0)
+    assert int(dfs.sum()) == total_df
+
+
+def test_mesh_shapes():
+    mesh = make_mesh(n_shards=4, n_replicas=2)
+    assert mesh.shape == {"replica": 2, "shard": 4}
+    mesh8 = make_mesh()
+    assert mesh8.shape["shard"] == 8

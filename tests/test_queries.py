@@ -1,0 +1,278 @@
+"""Query DSL tests (model: the reference's AbstractQueryTestCase per-type
+coverage + QueryShardContext execution tests)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import ParsingException, ScriptException
+from elasticsearch_tpu.index.mapper import MapperService
+from elasticsearch_tpu.index.segment import SegmentWriter
+from elasticsearch_tpu.ops.device import DeviceSegment
+from elasticsearch_tpu.search.context import SegmentContext, ShardStats
+from elasticsearch_tpu.search.queries import parse_query
+
+MAPPINGS = {
+    "properties": {
+        "title": {"type": "text"},
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "views": {"type": "long"},
+        "price": {"type": "float"},
+        "flag": {"type": "boolean"},
+        "vec": {"type": "dense_vector", "dims": 4, "similarity": "cosine"},
+    }
+}
+
+DOCS = [
+    {"title": "quick brown fox", "body": "jumps over the lazy dog",
+     "tag": "animal", "views": 10, "price": 1.5, "flag": True,
+     "vec": [1.0, 0.0, 0.0, 0.0]},
+    {"title": "quick red fox", "body": "eats the quick rabbit",
+     "tag": "animal", "views": 50, "price": 2.5, "flag": False,
+     "vec": [0.0, 1.0, 0.0, 0.0]},
+    {"title": "slow green turtle", "body": "swims in the sea",
+     "tag": "reptile", "views": 5, "price": 3.5, "flag": True,
+     "vec": [0.9, 0.1, 0.0, 0.0]},
+    {"title": "lazy dog", "body": "sleeps all day",
+     "tag": "animal", "views": 100, "flag": False},
+]
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    svc = MapperService(mappings=MAPPINGS)
+    w = SegmentWriter()
+    for i, d in enumerate(DOCS):
+        w.add(svc.parse(str(i), d))
+    seg = w.build("s0")
+    return SegmentContext(seg, DeviceSegment(seg), svc, ShardStats([seg]))
+
+
+def run(ctx, query_dict):
+    q = parse_query(query_dict)
+    scores, mask = q.execute(ctx)
+    scores = np.asarray(scores)[: ctx.segment.n_docs]
+    mask = np.asarray(mask)[: ctx.segment.n_docs]
+    return scores, mask
+
+
+def matching(ctx, query_dict):
+    _, mask = run(ctx, query_dict)
+    return set(np.nonzero(mask)[0].tolist())
+
+
+def test_match_all(ctx):
+    scores, mask = run(ctx, {"match_all": {}})
+    assert mask.all() and (scores == 1.0).all()
+
+
+def test_match_none(ctx):
+    _, mask = run(ctx, {"match_none": {}})
+    assert not mask.any()
+
+
+def test_match_or_and(ctx):
+    assert matching(ctx, {"match": {"title": "quick fox"}}) == {0, 1}
+    assert matching(ctx, {"match": {"title": {"query": "quick fox dog",
+                                              "operator": "and"}}}) == set()
+    assert matching(ctx, {"match": {"title": {"query": "quick brown",
+                                              "operator": "and"}}}) == {0}
+    assert matching(ctx, {"match": {"title": {"query": "quick brown dog",
+                                              "minimum_should_match": 2}}}) == {0}
+
+
+def test_match_scores_rank_sensibly(ctx):
+    scores, _ = run(ctx, {"match": {"title": "quick brown fox"}})
+    assert scores[0] > scores[1] > 0  # doc0 matches 3 terms, doc1 two
+    assert scores[3] == 0.0
+
+
+def test_term_on_keyword(ctx):
+    scores, mask = run(ctx, {"term": {"tag": "animal"}})
+    assert set(np.nonzero(mask)[0]) == {0, 1, 3}
+    assert scores[0] > 0 and scores[0] == scores[1] == scores[3]
+
+
+def test_term_on_numeric_and_bool(ctx):
+    assert matching(ctx, {"term": {"views": 50}}) == {1}
+    assert matching(ctx, {"term": {"flag": True}}) == {0, 2}
+    scores, _ = run(ctx, {"term": {"views": 50}})
+    assert scores[1] == 1.0  # constant score
+
+
+def test_terms(ctx):
+    assert matching(ctx, {"terms": {"tag": ["reptile", "missing"]}}) == {2}
+    assert matching(ctx, {"terms": {"views": [10, 5]}}) == {0, 2}
+
+
+def test_range(ctx):
+    assert matching(ctx, {"range": {"views": {"gte": 10, "lt": 100}}}) == {0, 1}
+    assert matching(ctx, {"range": {"price": {"gt": 2.0}}}) == {1, 2}
+    # doc 3 has no price -> excluded even by open-ended range
+    assert matching(ctx, {"range": {"price": {"gte": 0}}}) == {0, 1, 2}
+
+
+def test_exists(ctx):
+    assert matching(ctx, {"exists": {"field": "price"}}) == {0, 1, 2}
+    assert matching(ctx, {"exists": {"field": "vec"}}) == {0, 1, 2}
+    assert matching(ctx, {"exists": {"field": "title"}}) == {0, 1, 2, 3}
+    assert matching(ctx, {"exists": {"field": "nope"}}) == set()
+
+
+def test_ids(ctx):
+    assert matching(ctx, {"ids": {"values": ["1", "3", "404"]}}) == {1, 3}
+
+
+def test_bool_combinations(ctx):
+    q = {"bool": {
+        "must": [{"match": {"title": "quick"}}],
+        "filter": [{"term": {"tag": "animal"}}],
+        "must_not": [{"term": {"views": 50}}],
+    }}
+    assert matching(ctx, q) == {0}
+    scores, _ = run(ctx, q)
+    assert scores[0] > 0
+
+
+def test_bool_filter_only_scores_zero(ctx):
+    scores, mask = run(ctx, {"bool": {"filter": [{"term": {"tag": "animal"}}]}})
+    assert set(np.nonzero(mask)[0]) == {0, 1, 3}
+    assert (scores[mask] == 0.0).all()  # ES: filter-only bool scores 0.0
+
+
+def test_bool_should_msm(ctx):
+    q = {"bool": {"should": [
+        {"term": {"views": 10}},
+        {"term": {"views": 50}},
+        {"term": {"tag": "animal"}},
+    ], "minimum_should_match": 2}}
+    assert matching(ctx, q) == {0, 1}
+
+
+def test_bool_should_optional_with_must(ctx):
+    # should is optional when must present, but adds score
+    q_without = {"bool": {"must": [{"term": {"tag": "animal"}}]}}
+    q_with = {"bool": {"must": [{"term": {"tag": "animal"}}],
+                       "should": [{"term": {"views": 10}}]}}
+    assert matching(ctx, q_with) == matching(ctx, q_without) == {0, 1, 3}
+    s_without, _ = run(ctx, q_without)
+    s_with, _ = run(ctx, q_with)
+    assert s_with[0] > s_without[0]
+    assert s_with[1] == s_without[1]
+
+
+def test_constant_score_and_boost(ctx):
+    scores, mask = run(ctx, {"constant_score": {
+        "filter": {"term": {"tag": "animal"}}, "boost": 2.5}})
+    assert (scores[mask] == 2.5).all()
+
+
+def test_dis_max(ctx):
+    q = {"dis_max": {"queries": [
+        {"match": {"title": "quick"}},
+        {"match": {"body": "quick"}},
+    ], "tie_breaker": 0.5}}
+    scores, mask = run(ctx, q)
+    assert set(np.nonzero(mask)[0]) == {0, 1}
+    # doc1 matches in both fields: dis_max + tie_breaker > max alone
+    s_title, _ = run(ctx, {"match": {"title": "quick"}})
+    s_body, _ = run(ctx, {"match": {"body": "quick"}})
+    expected = max(s_title[1], s_body[1]) + 0.5 * min(s_title[1], s_body[1])
+    np.testing.assert_allclose(scores[1], expected, rtol=1e-5)
+
+
+def test_boosting(ctx):
+    q = {"boosting": {
+        "positive": {"term": {"tag": "animal"}},
+        "negative": {"term": {"views": 50}},
+        "negative_boost": 0.1,
+    }}
+    scores, mask = run(ctx, q)
+    assert set(np.nonzero(mask)[0]) == {0, 1, 3}
+    assert scores[1] == pytest.approx(scores[0] * 0.1, rel=1e-5)
+
+
+def test_script_score_doc_values(ctx):
+    q = {"script_score": {
+        "query": {"term": {"tag": "animal"}},
+        "script": {"source": "doc['views'].value * 2 + _score"},
+    }}
+    scores, mask = run(ctx, q)
+    base, _ = run(ctx, {"term": {"tag": "animal"}})
+    np.testing.assert_allclose(scores[0], 20 + base[0], rtol=1e-5)
+    assert scores[2] == 0.0  # not matched by subquery
+
+
+def test_script_score_cosine(ctx):
+    q = {"script_score": {
+        "query": {"match_all": {}},
+        "script": {
+            "source": "cosineSimilarity(params.qv, 'vec') + 1.0",
+            "params": {"qv": [1.0, 0.0, 0.0, 0.0]},
+        },
+    }}
+    scores, _ = run(ctx, q)
+    assert scores[0] == pytest.approx(2.0, abs=1e-2)           # identical dir
+    assert scores[2] == pytest.approx(1.0 + 0.9 / np.sqrt(0.82), abs=1e-2)
+    assert scores[1] == pytest.approx(1.0, abs=1e-2)           # orthogonal
+
+
+def test_knn_query(ctx):
+    scores, mask = run(ctx, {"knn": {
+        "field": "vec", "query_vector": [1.0, 0.0, 0.0, 0.0]}})
+    assert set(np.nonzero(mask)[0]) == {0, 1, 2}  # doc3 has no vector
+    assert scores[0] > scores[2] > scores[1]
+    assert scores[0] == pytest.approx(1.0, abs=1e-2)  # (1+1)/2
+
+
+def test_knn_with_filter(ctx):
+    scores, mask = run(ctx, {"knn": {
+        "field": "vec", "query_vector": [1.0, 0.0, 0.0, 0.0],
+        "filter": {"term": {"tag": "reptile"}}}})
+    assert set(np.nonzero(mask)[0]) == {2}
+
+
+def test_function_score(ctx):
+    q = {"function_score": {
+        "query": {"term": {"tag": "animal"}},
+        "script_score": {"script": {"source": "doc['views'].value"}},
+        "boost_mode": "replace",
+    }}
+    scores, mask = run(ctx, q)
+    assert scores[0] == 10 and scores[1] == 50 and scores[3] == 100
+
+
+def test_multi_match(ctx):
+    q = {"multi_match": {"query": "quick", "fields": ["title", "body"]}}
+    assert matching(ctx, q) == {0, 1}
+    q2 = {"multi_match": {"query": "quick", "fields": ["title", "body"],
+                          "type": "most_fields"}}
+    s_best, _ = run(ctx, q)
+    s_most, _ = run(ctx, q2)
+    assert s_most[1] > s_best[1]  # doc1 matches both fields
+
+
+def test_parse_errors(ctx):
+    with pytest.raises(ParsingException):
+        parse_query({"match": {"a": 1}, "term": {"b": 2}})
+    with pytest.raises(ParsingException):
+        parse_query({"made_up_query": {}})
+
+
+def test_script_sandbox_rejects():
+    with pytest.raises(ScriptException):
+        parse_query({"script_score": {
+            "query": {"match_all": {}},
+            "script": {"source": "__import__('os').system('x')"}}})
+    with pytest.raises(ScriptException):
+        parse_query({"script_score": {
+            "query": {"match_all": {}},
+            "script": {"source": "open('/etc/passwd')"}}})
+
+
+def test_script_missing_param(ctx):
+    q = parse_query({"script_score": {
+        "query": {"match_all": {}},
+        "script": {"source": "params.nope * 2"}}})
+    with pytest.raises(ScriptException):
+        q.execute(ctx)

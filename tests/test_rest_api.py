@@ -1,0 +1,329 @@
+"""REST API tests driving the full dispatch path (model: the reference's
+YAML rest suites — do/match assertions against the API contract,
+rest-api-spec; SURVEY.md §4 tier 5), plus one real-socket smoke test."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(Settings.EMPTY, data_path=str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def do(node, method, path, params=None, body=None, expect=200):
+    status, resp = node.rest_controller.dispatch(method, path, params, body)
+    assert status == expect, f"{method} {path} -> {status}: {resp}"
+    return resp
+
+
+def test_root_info(node):
+    r = do(node, "GET", "/")
+    assert r["version"]["distribution"] == "elasticsearch_tpu"
+
+
+def test_index_crud_lifecycle(node):
+    do(node, "PUT", "/books", body={
+        "settings": {"index": {"number_of_shards": 2}},
+        "mappings": {"properties": {"title": {"type": "text"},
+                                    "year": {"type": "long"}}},
+    })
+    r = do(node, "GET", "/books")
+    assert r["books"]["mappings"]["properties"]["title"]["type"] == "text"
+    assert r["books"]["settings"]["index"]["number_of_shards"] == 2
+
+    r = do(node, "PUT", "/books/_doc/1", body={"title": "Dune", "year": 1965},
+           expect=201)
+    assert r["result"] == "created" and r["_version"] == 1
+    r = do(node, "PUT", "/books/_doc/1", body={"title": "Dune", "year": 1966})
+    assert r["result"] == "updated" and r["_version"] == 2
+
+    r = do(node, "GET", "/books/_doc/1")
+    assert r["found"] and r["_source"]["year"] == 1966
+    r = do(node, "GET", "/books/_source/1")
+    assert r == {"title": "Dune", "year": 1966}
+
+    do(node, "DELETE", "/books/_doc/1")
+    do(node, "GET", "/books/_doc/1", expect=404)
+    do(node, "DELETE", "/books")
+    do(node, "GET", "/books/_doc/1", expect=404)  # index gone -> error body
+
+
+def test_create_conflict_and_missing_index(node):
+    do(node, "PUT", "/idx/_create/1", body={"a": 1}, expect=201)
+    r = do(node, "PUT", "/idx/_create/1", body={"a": 2}, expect=409)
+    assert r["error"]["type"] == "version_conflict_engine_exception"
+    r = do(node, "GET", "/missing/_doc/1", expect=404)
+    assert r["error"]["type"] == "index_not_found_exception"
+
+
+def test_optimistic_concurrency_params(node):
+    r = do(node, "PUT", "/idx/_doc/1", body={"v": 1}, expect=201)
+    do(node, "PUT", "/idx/_doc/1", body={"v": 2},
+       params={"if_seq_no": str(r["_seq_no"]),
+               "if_primary_term": str(r["_primary_term"])})
+    do(node, "PUT", "/idx/_doc/1", body={"v": 3},
+       params={"if_seq_no": str(r["_seq_no"]),
+               "if_primary_term": str(r["_primary_term"])}, expect=409)
+
+
+def test_update_api(node):
+    do(node, "PUT", "/idx/_doc/1", body={"a": 1, "nested": {"x": 1}}, expect=201)
+    r = do(node, "POST", "/idx/_update/1", body={"doc": {"b": 2, "nested": {"y": 2}}})
+    assert r["result"] == "updated"
+    src = do(node, "GET", "/idx/_source/1")
+    assert src == {"a": 1, "b": 2, "nested": {"x": 1, "y": 2}}
+    # noop detection
+    r = do(node, "POST", "/idx/_update/1", body={"doc": {"b": 2}})
+    assert r["result"] == "noop"
+    # upsert
+    r = do(node, "POST", "/idx/_update/9", body={"upsert": {"fresh": True},
+                                                 "doc": {}}, expect=201)
+    assert r["result"] == "created"
+    do(node, "POST", "/idx/_update/404", body={"doc": {}}, expect=404)
+
+
+def test_bulk_ndjson(node):
+    ndjson = "\n".join(json.dumps(l) for l in [
+        {"index": {"_index": "logs", "_id": "1"}},
+        {"msg": "hello", "level": "info"},
+        {"index": {"_index": "logs", "_id": "2"}},
+        {"msg": "boom", "level": "error"},
+        {"create": {"_index": "logs", "_id": "1"}},   # conflict
+        {"msg": "dup"},
+        {"delete": {"_index": "logs", "_id": "2"}},
+    ])
+    r = do(node, "POST", "/_bulk", params={"refresh": "true"}, body=ndjson)
+    assert r["errors"] is True
+    statuses = [list(item.values())[0]["status"] for item in r["items"]]
+    assert statuses == [201, 201, 409, 200]
+    r = do(node, "GET", "/logs/_search", body={})
+    assert r["hits"]["total"]["value"] == 1
+
+
+def test_search_flow(node):
+    for i in range(12):
+        do(node, "PUT", f"/articles/_doc/{i}",
+           body={"title": f"article about {'jax' if i % 2 else 'numpy'} {i}",
+                 "views": i}, expect=201)
+    do(node, "POST", "/articles/_refresh")
+    r = do(node, "POST", "/articles/_search",
+           body={"query": {"match": {"title": "jax"}}, "size": 3})
+    assert r["hits"]["total"]["value"] == 6
+    assert len(r["hits"]["hits"]) == 3
+    assert all("jax" in h["_source"]["title"] for h in r["hits"]["hits"])
+    # q= param
+    r = do(node, "GET", "/articles/_search", params={"q": "title:numpy"})
+    assert r["hits"]["total"]["value"] == 6
+    # sort + from/size via params
+    r = do(node, "GET", "/articles/_search",
+           params={"size": "2", "from": "1"},
+           body={"sort": [{"views": "desc"}]})
+    assert [h["_source"]["views"] for h in r["hits"]["hits"]] == [10, 9]
+    # count
+    r = do(node, "GET", "/articles/_count", body={"query": {"match": {"title": "jax"}}})
+    assert r["count"] == 6
+
+
+def test_scroll_over_rest(node):
+    for i in range(7):
+        do(node, "PUT", f"/s/_doc/{i}", body={"n": i}, expect=201)
+    do(node, "POST", "/s/_refresh")
+    r = do(node, "POST", "/s/_search", params={"scroll": "1m"},
+           body={"size": 3, "sort": [{"n": "asc"}]})
+    seen = [h["_source"]["n"] for h in r["hits"]["hits"]]
+    sid = r["_scroll_id"]
+    while True:
+        r = do(node, "POST", "/_search/scroll", body={"scroll_id": sid, "scroll": "1m"})
+        if not r["hits"]["hits"]:
+            break
+        seen.extend(h["_source"]["n"] for h in r["hits"]["hits"])
+    assert seen == list(range(7))
+    r = do(node, "DELETE", "/_search/scroll", body={"scroll_id": sid})
+    assert r["num_freed"] == 1
+
+
+def test_msearch(node):
+    do(node, "PUT", "/a/_doc/1", body={"x": "alpha"}, expect=201)
+    do(node, "PUT", "/b/_doc/1", body={"x": "beta"}, expect=201)
+    do(node, "POST", "/a/_refresh")
+    do(node, "POST", "/b/_refresh")
+    nd = "\n".join(json.dumps(l) for l in [
+        {"index": "a"}, {"query": {"match_all": {}}},
+        {"index": "b"}, {"query": {"match": {"x": "beta"}}},
+    ])
+    r = do(node, "POST", "/_msearch", body=nd)
+    assert len(r["responses"]) == 2
+    assert r["responses"][0]["hits"]["total"]["value"] == 1
+    assert r["responses"][1]["hits"]["total"]["value"] == 1
+
+
+def test_mget(node):
+    do(node, "PUT", "/m/_doc/1", body={"v": 1}, expect=201)
+    do(node, "PUT", "/m/_doc/2", body={"v": 2}, expect=201)
+    r = do(node, "POST", "/m/_mget", body={"ids": ["1", "2", "404"]})
+    assert [d["found"] for d in r["docs"]] == [True, True, False]
+
+
+def test_analyze_api(node):
+    r = do(node, "POST", "/_analyze",
+           body={"analyzer": "standard", "text": "The Quick Fox"})
+    assert [t["token"] for t in r["tokens"]] == ["the", "quick", "fox"]
+
+
+def test_mapping_updates(node):
+    do(node, "PUT", "/idx", body={"mappings": {"properties": {"a": {"type": "long"}}}})
+    do(node, "PUT", "/idx/_mapping",
+       body={"properties": {"b": {"type": "keyword"}}})
+    r = do(node, "GET", "/idx/_mapping")
+    assert r["idx"]["mappings"]["properties"]["b"]["type"] == "keyword"
+    # conflicting change rejected
+    r = do(node, "PUT", "/idx/_mapping",
+           body={"properties": {"a": {"type": "text"}}}, expect=400)
+
+
+def test_cluster_and_cat(node):
+    do(node, "PUT", "/one/_doc/1", body={"x": 1}, params={"refresh": "true"},
+       expect=201)
+    r = do(node, "GET", "/_cluster/health")
+    assert r["status"] == "green"
+    r = do(node, "GET", "/_cat/indices")
+    assert "one" in r["_cat"]
+    r = do(node, "GET", "/_nodes/stats")
+    node_stats = list(r["nodes"].values())[0]
+    assert node_stats["indices"]["one"]["docs"]["count"] == 1
+
+
+def test_rank_eval_endpoint(node):
+    for i in range(5):
+        do(node, "PUT", f"/r/_doc/{i}", body={"t": "relevant" if i < 2 else "other"},
+           expect=201)
+    do(node, "POST", "/r/_refresh")
+    r = do(node, "POST", "/r/_rank_eval", body={
+        "requests": [{"id": "q", "request": {"query": {"match": {"t": "relevant"}}},
+                      "ratings": [{"_id": "0", "rating": 1},
+                                  {"_id": "1", "rating": 1}]}],
+        "metric": {"recall": {"k": 5}},
+    })
+    assert r["metric_score"] == 1.0
+
+
+def test_auto_create_on_write(node):
+    do(node, "PUT", "/fresh/_doc/1", body={"hello": "world"}, expect=201)
+    r = do(node, "GET", "/fresh/_mapping")
+    assert r["fresh"]["mappings"]["properties"]["hello"]["type"] == "text"
+
+
+def test_unknown_route_and_wrong_method(node):
+    do(node, "GET", "/_made_up_endpoint_zz", expect=400)
+    r = do(node, "DELETE", "/_cluster/health", expect=405)
+
+
+def test_real_http_socket(node):
+    """One end-to-end socket test (the rest drive dispatch directly)."""
+    import urllib.request
+
+    port = node.start(port=0)
+    base = f"http://127.0.0.1:{port}"
+
+    def req(method, path, body=None, headers=None):
+        data = json.dumps(body).encode() if isinstance(body, dict) else body
+        r = urllib.request.Request(base + path, data=data, method=method,
+                                   headers=headers or {"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(r) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    status, r = req("GET", "/")
+    assert status == 200 and "version" in r
+    status, r = req("PUT", "/http/_doc/1", {"msg": "over the wire"})
+    assert status == 201
+    req("POST", "/http/_refresh", b"")
+    status, r = req("POST", "/http/_search",
+                    {"query": {"match": {"msg": "wire"}}})
+    assert status == 200 and r["hits"]["total"]["value"] == 1
+    status, r = req("GET", "/missing/_doc/1")
+    assert status == 404
+
+
+def test_bulk_bad_item_does_not_desync(node):
+    """A failing index/create item must not shift the action/source framing."""
+    ndjson = "\n".join(json.dumps(l) for l in [
+        {"index": {"_index": "BadName", "_id": "x"}},   # invalid (uppercase)
+        {"f": 1},
+        {"index": {"_index": "ok", "_id": "2"}},
+        {"f": 2},
+    ])
+    r = do(node, "POST", "/_bulk", params={"refresh": "true"}, body=ndjson)
+    assert r["errors"] is True
+    statuses = [list(item.values())[0]["status"] for item in r["items"]]
+    assert statuses == [400, 201]
+    assert do(node, "GET", "/ok/_doc/2")["_source"] == {"f": 2}
+
+
+def test_cas_survives_restart(tmp_path):
+    from elasticsearch_tpu.common.settings import Settings
+    n = Node(Settings.EMPTY, data_path=str(tmp_path / "cas"))
+    r = do(n, "PUT", "/c/_doc/1", body={"v": 1}, expect=201)
+    r = do(n, "PUT", "/c/_doc/1", body={"v": 2})  # seq_no 1
+    do(n, "POST", "/c/_flush")
+    n.close()
+    n2 = Node(Settings.EMPTY, data_path=str(tmp_path / "cas"))
+    g = do(n2, "GET", "/c/_doc/1")
+    assert g["_seq_no"] == r["_seq_no"] and g["_version"] == 2
+    do(n2, "PUT", "/c/_doc/1", body={"v": 3},
+       params={"if_seq_no": str(r["_seq_no"]),
+               "if_primary_term": str(r["_primary_term"])})
+    n2.close()
+
+
+def test_msm_string_forms(node):
+    for i, t in enumerate(["a b c", "a b", "a"]):
+        do(node, "PUT", f"/msm/_doc/{i}", body={"t": t}, expect=201)
+    do(node, "POST", "/msm/_refresh")
+    r = do(node, "POST", "/msm/_search", body={
+        "query": {"match": {"t": {"query": "a b c",
+                                  "minimum_should_match": "2"}}}})
+    assert r["hits"]["total"]["value"] == 2
+    r = do(node, "POST", "/msm/_search", body={
+        "query": {"match": {"t": {"query": "a b c",
+                                  "minimum_should_match": "67%"}}}})
+    assert r["hits"]["total"]["value"] == 2
+    # bool-level string msm
+    r = do(node, "POST", "/msm/_search", body={
+        "query": {"bool": {"should": [
+            {"term": {"t": "a"}}, {"term": {"t": "b"}}, {"term": {"t": "c"}}],
+            "minimum_should_match": "2"}}})
+    assert r["hits"]["total"]["value"] == 2
+
+
+def test_empty_multi_match_and_dis_max(node):
+    do(node, "PUT", "/e/_doc/1", body={"t": "hello"}, params={"refresh": "true"},
+       expect=201)
+    # multi_match without fields searches all text fields
+    r = do(node, "POST", "/e/_search",
+           body={"query": {"multi_match": {"query": "hello"}}})
+    assert r["hits"]["total"]["value"] == 1
+    r = do(node, "POST", "/e/_search",
+           body={"query": {"dis_max": {}}}, expect=400)
+    assert "dis_max" in r["error"]["reason"]
+
+
+def test_scroll_reports_total_on_every_page(node):
+    for i in range(9):
+        do(node, "PUT", f"/sc/_doc/{i}", body={"n": i}, expect=201)
+    do(node, "POST", "/sc/_refresh")
+    r = do(node, "POST", "/sc/_search", params={"scroll": "1m"},
+           body={"size": 4, "sort": [{"n": "asc"}]})
+    sid = r["_scroll_id"]
+    assert r["hits"]["total"]["value"] == 9
+    r = do(node, "POST", "/_search/scroll", body={"scroll_id": sid})
+    assert r["hits"]["total"]["value"] == 9  # continuation pages keep total

@@ -1,0 +1,286 @@
+"""Search service tests: query-then-fetch over multi-shard indices, sort,
+pagination, scroll, highlight, rank_eval (model: the reference's
+SearchServiceTests + SearchPhaseControllerTests + rank-eval tests)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ResourceAlreadyExistsException,
+    SearchContextMissingException,
+)
+from elasticsearch_tpu.index.service import IndicesService, murmur3_hash
+from elasticsearch_tpu.search.rank_eval import rank_eval
+from elasticsearch_tpu.search.service import SearchService
+
+MAPPINGS = {
+    "properties": {
+        "title": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "views": {"type": "long"},
+    }
+}
+
+
+@pytest.fixture
+def services(tmp_path):
+    indices = IndicesService(str(tmp_path / "data"))
+    search = SearchService(indices)
+    yield indices, search
+    indices.close()
+
+
+def fill(indices, name="test", num_shards=2, n=20):
+    idx = indices.create_index(name, {"index.number_of_shards": num_shards},
+                               MAPPINGS)
+    for i in range(n):
+        idx.index_doc(str(i), {
+            "title": f"doc number {i} " + ("quick fox " * (i % 3)),
+            "tag": "even" if i % 2 == 0 else "odd",
+            "views": i,
+        })
+    idx.refresh()
+    return idx
+
+
+def _signed(x):
+    return x - 0x100000000 if x >= 0x80000000 else x
+
+
+def test_murmur3_matches_java_reference():
+    # known vectors from the reference's Murmur3HashFunctionTests.java
+    assert murmur3_hash("hell") == _signed(0x5A0CB7C3)
+    assert murmur3_hash("hello") == _signed(0xD7C31989)
+    assert murmur3_hash("hello w") == _signed(0x22AB2984)
+    assert murmur3_hash("hello wo") == _signed(0xDF0CA123)
+    assert murmur3_hash("hello wor") == _signed(0xE7744D61)
+    assert murmur3_hash("The quick brown fox jumps over the lazy dog") == _signed(0xE07DB09C)
+    assert murmur3_hash("The quick brown fox jumps over the lazy cog") == _signed(0x4E63D2AD)
+
+
+def test_basic_search(services):
+    indices, search = services
+    fill(indices)
+    r = search.search("test", {"query": {"match": {"title": "quick fox"}}})
+    assert r["hits"]["total"]["value"] == 13  # i%3 != 0 → 13 of 20
+    assert len(r["hits"]["hits"]) == 10  # default size
+    assert r["hits"]["max_score"] > 0
+    top = r["hits"]["hits"][0]
+    assert top["_index"] == "test"
+    assert "quick fox quick fox" in top["_source"]["title"]
+    assert r["_shards"]["total"] == 2
+
+
+def test_match_all_default(services):
+    indices, search = services
+    fill(indices)
+    r = search.search("test", {})
+    assert r["hits"]["total"]["value"] == 20
+
+
+def test_from_size_pagination_is_stable(services):
+    indices, search = services
+    fill(indices)
+    body = {"query": {"match_all": {}}, "sort": [{"views": "asc"}]}
+    seen = []
+    for frm in range(0, 20, 5):
+        r = search.search("test", {**body, "from": frm, "size": 5})
+        seen.extend(h["_source"]["views"] for h in r["hits"]["hits"])
+    assert seen == list(range(20))
+
+
+def test_sort_desc_and_sort_values(services):
+    indices, search = services
+    fill(indices)
+    r = search.search("test", {"sort": [{"views": {"order": "desc"}}], "size": 3})
+    views = [h["_source"]["views"] for h in r["hits"]["hits"]]
+    assert views == [19, 18, 17]
+    assert r["hits"]["hits"][0]["sort"] == [19.0]
+    assert r["hits"]["max_score"] is None  # no scores when sorting by field
+
+
+def test_search_after(services):
+    indices, search = services
+    fill(indices)
+    body = {"sort": [{"views": "asc"}], "size": 5}
+    r = search.search("test", body)
+    last = r["hits"]["hits"][-1]["sort"]
+    r2 = search.search("test", {**body, "search_after": last})
+    assert [h["_source"]["views"] for h in r2["hits"]["hits"]] == [5, 6, 7, 8, 9]
+
+
+def test_post_filter_and_min_score(services):
+    indices, search = services
+    fill(indices)
+    r = search.search("test", {
+        "query": {"match": {"title": "quick"}},
+        "post_filter": {"term": {"tag": "even"}},
+    })
+    assert all(h["_source"]["tag"] == "even" for h in r["hits"]["hits"])
+    r_all = search.search("test", {"query": {"match": {"title": "quick"}}})
+    r_min = search.search("test", {"query": {"match": {"title": "quick"}},
+                                   "min_score": r_all["hits"]["max_score"] - 1e-6})
+    assert r_min["hits"]["total"]["value"] <= r_all["hits"]["total"]["value"]
+
+
+def test_source_filtering(services):
+    indices, search = services
+    fill(indices)
+    r = search.search("test", {"_source": ["views"], "size": 1})
+    assert set(r["hits"]["hits"][0]["_source"].keys()) == {"views"}
+    r2 = search.search("test", {"_source": False, "size": 1})
+    assert "_source" not in r2["hits"]["hits"][0]
+
+
+def test_docvalue_fields(services):
+    indices, search = services
+    fill(indices)
+    r = search.search("test", {"docvalue_fields": ["views", "tag"], "size": 1,
+                               "sort": [{"views": "asc"}]})
+    fields = r["hits"]["hits"][0]["fields"]
+    assert fields["views"] == [0.0]
+    assert fields["tag"] == ["even"]
+
+
+def test_multi_index_and_wildcards(services):
+    indices, search = services
+    fill(indices, "logs-1", n=5)
+    fill(indices, "logs-2", n=5)
+    fill(indices, "other", n=5)
+    r = search.search("logs-*", {"size": 20})
+    assert r["hits"]["total"]["value"] == 10
+    assert {h["_index"] for h in r["hits"]["hits"]} == {"logs-1", "logs-2"}
+    r_all = search.search("_all", {"size": 30})
+    assert r_all["hits"]["total"]["value"] == 15
+
+
+def test_scroll_pages_through_everything(services):
+    indices, search = services
+    fill(indices, n=17)
+    r = search.search("test", {"sort": [{"views": "asc"}], "size": 5},
+                      scroll="1m")
+    collected = [h["_source"]["views"] for h in r["hits"]["hits"]]
+    sid = r["_scroll_id"]
+    while True:
+        r = search.scroll(sid, scroll="1m")
+        hits = r["hits"]["hits"]
+        if not hits:
+            break
+        collected.extend(h["_source"]["views"] for h in hits)
+    assert collected == list(range(17))
+    assert search.clear_scroll([sid]) == 1
+    with pytest.raises(SearchContextMissingException):
+        search.scroll(sid)
+
+
+def test_scroll_by_score(services):
+    indices, search = services
+    fill(indices, n=12)
+    r = search.search("test", {"query": {"match": {"title": "doc"}}, "size": 4},
+                      scroll="1m")
+    sid = r["_scroll_id"]
+    ids = [h["_id"] for h in r["hits"]["hits"]]
+    while True:
+        r = search.scroll(sid)
+        if not r["hits"]["hits"]:
+            break
+        ids.extend(h["_id"] for h in r["hits"]["hits"])
+    assert len(ids) == 12
+    assert len(set(ids)) == 12  # no dup, no loss across equal scores
+
+
+def test_result_window_guard(services):
+    indices, search = services
+    fill(indices)
+    with pytest.raises(IllegalArgumentException):
+        search.search("test", {"from": 9995, "size": 10})
+
+
+def test_count(services):
+    indices, search = services
+    fill(indices)
+    r = search.count("test", {"query": {"term": {"tag": "even"}}})
+    assert r["count"] == 10
+
+
+def test_highlight(services):
+    indices, search = services
+    fill(indices)
+    r = search.search("test", {
+        "query": {"match": {"title": "quick"}},
+        "highlight": {"fields": {"title": {}}},
+        "size": 1,
+    })
+    frag = r["hits"]["hits"][0]["highlight"]["title"][0]
+    assert "<em>quick</em>" in frag
+
+
+def test_shard_routing_distributes(services):
+    indices, _ = services
+    idx = fill(indices, "dist", num_shards=4, n=100)
+    counts = [s.stats()["docs"]["count"] for s in idx.shards]
+    assert sum(counts) == 100
+    assert all(c > 5 for c in counts)  # roughly balanced
+
+
+def test_index_persistence_reopen(tmp_path):
+    indices = IndicesService(str(tmp_path / "data"))
+    idx = indices.create_index("persist", {}, MAPPINGS)
+    idx.index_doc("1", {"title": "hello world"})
+    idx.flush()
+    indices.close()
+
+    indices2 = IndicesService(str(tmp_path / "data"))
+    search = SearchService(indices2)
+    r = search.search("persist", {"query": {"match": {"title": "hello"}}})
+    assert r["hits"]["total"]["value"] == 1
+    indices2.close()
+
+
+def test_create_duplicate_and_invalid(services):
+    indices, _ = services
+    indices.create_index("a", {}, {})
+    with pytest.raises(ResourceAlreadyExistsException):
+        indices.create_index("a", {}, {})
+    with pytest.raises(IllegalArgumentException):
+        indices.create_index("_bad", {}, {})
+
+
+def test_rank_eval_metrics(services):
+    indices, search = services
+    fill(indices)
+
+    def search_fn(body):
+        r = search.search("test", {**body, "size": 10})
+        return [h["_id"] for h in r["hits"]["hits"]]
+
+    result = rank_eval(
+        search_fn,
+        [{"id": "q1",
+          "request": {"query": {"match": {"title": "quick fox"}}},
+          "ratings": [{"_id": "2", "rating": 1}, {"_id": "5", "rating": 1},
+                      {"_id": "8", "rating": 1}]}],
+        {"recall": {"k": 10}})
+    assert 0.0 <= result["metric_score"] <= 1.0
+    assert result["details"]["q1"]["metric_score"] == result["metric_score"]
+    # all three rated docs match the query (i%3 in {2}), recall should be 1
+    assert result["metric_score"] == 1.0
+
+
+def test_rank_eval_precision_mrr_dcg():
+    hits = ["a", "b", "c", "d"]
+
+    def fn(body):
+        return hits
+
+    reqs = [{"id": "q", "request": {},
+             "ratings": [{"_id": "b", "rating": 3}, {"_id": "d", "rating": 1}]}]
+    assert rank_eval(fn, reqs, {"precision": {"k": 4}})["metric_score"] == 0.5
+    assert rank_eval(fn, reqs, {"mean_reciprocal_rank": {}})["metric_score"] == 0.5
+    import math
+    expected_dcg = 7 / math.log2(3) + 1 / math.log2(5)
+    assert rank_eval(fn, reqs, {"dcg": {"k": 4}})["metric_score"] == pytest.approx(expected_dcg)
+    ndcg = rank_eval(fn, reqs, {"dcg": {"k": 4, "normalize": True}})["metric_score"]
+    ideal = 7 / math.log2(2) + 1 / math.log2(3)
+    assert ndcg == pytest.approx(expected_dcg / ideal)
