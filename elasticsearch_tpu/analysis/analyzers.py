@@ -63,6 +63,16 @@ class CustomAnalyzer(Analyzer):
         self.tokenizer = tokenizer
         self.token_filters = token_filters or []
         self.char_filters = char_filters or []
+        # enable the native pre-lowercasing tokenizer fast path when a
+        # lowercase filter immediately follows (it stays in the chain —
+        # idempotent — so non-ASCII fallback output is still correct).
+        # Use a COPY: named tokenizers are shared across analyzers and
+        # mutating the shared instance would lowercase other analyzers.
+        if (isinstance(tokenizer, StandardTokenizer)
+                and not tokenizer.native_lowercase and self.token_filters
+                and isinstance(self.token_filters[0], LowercaseFilter)):
+            self.tokenizer = StandardTokenizer(
+                tokenizer.max_token_length, native_lowercase=True)
 
     def analyze(self, text: str) -> List[Token]:
         for cf in self.char_filters:

@@ -46,14 +46,30 @@ class StandardTokenizer(Tokenizer):
     Splits on non-alphanumerics, keeps interior apostrophes/periods out —
     close enough to Lucene for English corpora like MS MARCO; exact UAX#29
     segmentation is a later refinement.
+
+    ASCII inputs take the native C++ fast path (native/ — NOTE: the native
+    tokenizer also lowercases, so it's only used when a LowercaseFilter
+    would follow anyway; exactness is covered by parity tests).
     """
 
     name = "standard"
 
-    def __init__(self, max_token_length: int = 255):
+    def __init__(self, max_token_length: int = 255, native_lowercase: bool = False):
         self.max_token_length = max_token_length
+        # when True, emitted terms are pre-lowercased via the native path
+        # (set by CustomAnalyzer when the first filter is lowercase)
+        self.native_lowercase = native_lowercase
 
     def tokenize(self, text: str) -> List[Token]:
+        if self.native_lowercase and text.isascii():
+            from elasticsearch_tpu import native
+            toks = native.tokenize_ascii(text, self.max_token_length)
+            if toks is not None:
+                return [Token(term, pos, s, e)
+                        for pos, (term, s, e) in enumerate(toks)]
+        return self._tokenize_py(text)
+
+    def _tokenize_py(self, text: str) -> List[Token]:
         out: List[Token] = []
         pos = 0
         i = 0

@@ -1,0 +1,145 @@
+"""Native host runtime: ctypes bindings for the C++ components.
+
+Builds lazily with g++ on first import (cached .so); everything degrades
+gracefully to the pure-Python implementations when the toolchain or the
+library is unavailable, so the framework never hard-depends on the native
+layer (ref: the reference treats its native pieces — JNA, ml-cpp — as
+optional accelerators/sidecars too).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "src", "estpu_native.cpp")
+_SO = os.path.join(_HERE, "libestpu_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _build() -> Optional[str]:
+    try:
+        if os.path.exists(_SO) and (
+                not os.path.exists(_SRC)
+                or os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+            return _SO
+        if not os.path.exists(_SRC):
+            return None
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
+            check=True, capture_output=True, timeout=120)
+        return _SO
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        so = _build()
+        if so is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(so)
+        lib.tokenize_ascii.restype = ctypes.c_int
+        lib.tokenize_ascii.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_char_p]
+        lib.varint_delta_encode.restype = ctypes.c_int
+        lib.varint_delta_encode.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8)]
+        lib.varint_delta_decode.restype = ctypes.c_int
+        lib.varint_delta_decode.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+        lib.count_term_freqs.restype = ctypes.c_int
+        lib.count_term_freqs.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def tokenize_ascii(text: str, max_token_length: int = 255
+                   ) -> Optional[List[Tuple[str, int, int]]]:
+    """(term, start, end) triples via the native tokenizer; None if the
+    native library is unavailable (callers fall back to Python)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    raw = text.encode("ascii")
+    n = len(raw)
+    max_tokens = n // 1 + 1
+    offsets = (ctypes.c_int * (2 * max_tokens))()
+    lowered = ctypes.create_string_buffer(n + 1)
+    count = lib.tokenize_ascii(raw, n, max_token_length, offsets,
+                               max_tokens, lowered)
+    if count < 0:
+        return None
+    low = lowered.raw[:n].decode("ascii")
+    return [(low[offsets[2 * i]: offsets[2 * i + 1]],
+             offsets[2 * i], offsets[2 * i + 1]) for i in range(count)]
+
+
+def varint_encode(values: np.ndarray) -> Optional[bytes]:
+    """Delta+LEB128 encode a sorted int32 array."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    values = np.ascontiguousarray(values, dtype=np.int32)
+    out = np.empty(5 * len(values) + 1, np.uint8)
+    n = lib.varint_delta_encode(
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(values),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out[:n].tobytes()
+
+
+def varint_decode(data: bytes, n: int) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, dtype=np.uint8)
+    out = np.empty(n, np.int32)
+    got = lib.varint_delta_decode(
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(buf),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n)
+    if got != n:
+        raise ValueError(f"varint decode: expected {n} values, got {got}")
+    return out
+
+
+def count_term_freqs(term_ids: np.ndarray
+                     ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    term_ids = np.ascontiguousarray(term_ids, dtype=np.int32)
+    max_out = len(term_ids) + 1
+    out_terms = np.empty(max_out, np.int32)
+    out_tfs = np.empty(max_out, np.float32)
+    n = lib.count_term_freqs(
+        term_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(term_ids),
+        out_terms.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out_tfs.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), max_out)
+    if n < 0:
+        return None
+    return out_terms[:n].copy(), out_tfs[:n].copy()

@@ -1,0 +1,120 @@
+// Host-side native runtime components.
+//
+// The reference integrates native code at the host seams (ref: SURVEY.md
+// §2.2 — JNA libc calls at bootstrap, the ml-cpp sidecar processes, Lucene's
+// postings codecs). Here the TPU compute path is JAX/XLA; this library is
+// the native host runtime around it:
+//
+//   - a UTF-8 standard tokenizer fast path (ASCII word rules; the Python
+//     tokenizer remains the full-Unicode fallback) — indexing throughput
+//     is host-bound on analysis, exactly as Lucene's indexing chain is.
+//   - a group-varint-style delta codec for postings blocks — the on-disk
+//     compression seam (ref: Lucene FOR/vint postings encoding).
+//   - term-frequency counting for pre-tokenized docs (the per-doc
+//     "counts" loop of the indexing chain).
+//
+// Build: g++ -O3 -shared -fPIC (see build.py). Loaded via ctypes — no
+// pybind11 dependency by design.
+
+#include <cctype>
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Tokenizer: ASCII word-boundary rules (alnum runs), lowercasing in place.
+// Writes (start, end) byte offsets into `offsets` (2 ints per token) and
+// lowercased token bytes into `lowered` (same length as text).
+// Returns the number of tokens (or -1 if max_tokens exceeded).
+// ---------------------------------------------------------------------------
+int tokenize_ascii(const char* text, int len, int max_token_length,
+                   int* offsets, int max_tokens, char* lowered) {
+    int n = 0;
+    int i = 0;
+    while (i < len) {
+        unsigned char c = (unsigned char)text[i];
+        bool word = (c < 128) && (isalnum(c) != 0);
+        if (!word) {
+            lowered[i] = (char)c;
+            i++;
+            continue;
+        }
+        int start = i;
+        while (i < len) {
+            unsigned char ch = (unsigned char)text[i];
+            if (ch >= 128 || !isalnum(ch)) break;
+            lowered[i] = (ch >= 'A' && ch <= 'Z') ? (char)(ch + 32) : (char)ch;
+            i++;
+        }
+        if (i - start <= max_token_length) {
+            if (n >= max_tokens) return -1;
+            offsets[2 * n] = start;
+            offsets[2 * n + 1] = i;
+            n++;
+        }
+    }
+    return n;
+}
+
+// ---------------------------------------------------------------------------
+// Varint delta codec for sorted int32 arrays (docids). Classic LEB128 on
+// deltas — the vint half of Lucene's postings format.
+// Returns encoded byte count; `out` must hold >= 5*n bytes.
+// ---------------------------------------------------------------------------
+int varint_delta_encode(const int32_t* values, int n, uint8_t* out) {
+    int pos = 0;
+    int32_t prev = 0;
+    for (int i = 0; i < n; i++) {
+        uint32_t delta = (uint32_t)(values[i] - prev);
+        prev = values[i];
+        while (delta >= 0x80) {
+            out[pos++] = (uint8_t)(delta | 0x80);
+            delta >>= 7;
+        }
+        out[pos++] = (uint8_t)delta;
+    }
+    return pos;
+}
+
+// Returns number of values decoded (must equal n).
+int varint_delta_decode(const uint8_t* data, int nbytes, int32_t* out, int n) {
+    int pos = 0;
+    int32_t prev = 0;
+    for (int i = 0; i < n; i++) {
+        uint32_t value = 0;
+        int shift = 0;
+        while (true) {
+            if (pos >= nbytes) return i;  // truncated
+            uint8_t b = data[pos++];
+            value |= (uint32_t)(b & 0x7F) << shift;
+            if (!(b & 0x80)) break;
+            shift += 7;
+        }
+        prev += (int32_t)value;
+        out[i] = prev;
+    }
+    return (pos == nbytes) ? n : -n;  // -n: trailing garbage
+}
+
+// ---------------------------------------------------------------------------
+// Term-frequency counting: given a doc's term ids (int32, one per token),
+// produce (unique term id, tf) pairs. Returns the number of unique terms.
+// ---------------------------------------------------------------------------
+int count_term_freqs(const int32_t* term_ids, int n,
+                     int32_t* out_terms, float* out_tfs, int max_out) {
+    std::unordered_map<int32_t, int32_t> counts;
+    counts.reserve((size_t)n * 2);
+    for (int i = 0; i < n; i++) counts[term_ids[i]]++;
+    if ((int)counts.size() > max_out) return -1;
+    int j = 0;
+    for (const auto& kv : counts) {
+        out_terms[j] = kv.first;
+        out_tfs[j] = (float)kv.second;
+        j++;
+    }
+    return j;
+}
+
+}  // extern "C"

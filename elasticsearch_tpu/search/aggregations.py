@@ -1,0 +1,574 @@
+"""Aggregations: bucket/metric/pipeline tree beside the top-k collector.
+
+Mirrors the reference's aggregation framework (ref: search/aggregations/ —
+AggregatorBase leaf collectors per segment, InternalAggregation tree-reduce
+on the coordinator, SURVEY.md §2.1 "Aggregations"). Re-design for this
+engine: the query phase produces a dense match mask per segment; every
+bucket is a boolean mask refinement, and every metric is a vectorized
+reduction over masked columnar doc values. No per-doc collect() calls —
+buckets are mask algebra, metrics are numpy/jnp reductions, sub-aggs
+recurse over refined masks.
+
+Implemented aggs:
+- metrics: avg, sum, min, max, value_count, stats, extended_stats,
+  cardinality, percentiles, percentile_ranks, top_hits, weighted_avg
+- buckets: terms, histogram, date_histogram, range, filter, filters,
+  missing, global
+- pipeline (coordinator-side): avg_bucket, sum_bucket, min_bucket,
+  max_bucket, stats_bucket, bucket_sort, cumulative_sum, derivative
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ParsingException,
+)
+
+# A collect context: (segment, mask np.ndarray[bool n_docs], mapper)
+# triples covering every shard's segments — each segment carries ITS
+# index's mapper so multi-index aggs analyze with the right chains.
+CollectCtx = List[Tuple[Any, np.ndarray, Any]]
+
+METRIC_AGGS = {"avg", "sum", "min", "max", "value_count", "stats",
+               "extended_stats", "cardinality", "percentiles",
+               "percentile_ranks", "top_hits", "weighted_avg"}
+BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "filter",
+               "filters", "missing", "global"}
+PIPELINE_AGGS = {"avg_bucket", "sum_bucket", "min_bucket", "max_bucket",
+                 "stats_bucket", "cumulative_sum", "derivative", "bucket_sort"}
+
+
+def compute_aggs(spec: Dict[str, Any], ctx: CollectCtx,
+                 mapper, device_cache=None) -> Dict[str, Any]:
+    """Evaluate an aggs tree; returns the `aggregations` response object."""
+    if device_cache is not None:
+        _query_masks._cache = device_cache
+    out: Dict[str, Any] = {}
+    pipelines: List[Tuple[str, str, Dict[str, Any]]] = []
+    for name, node in spec.items():
+        agg_type, body, sub = _split_node(name, node)
+        if agg_type in PIPELINE_AGGS:
+            pipelines.append((name, agg_type, body))
+            continue
+        out[name] = _compute_one(agg_type, body, sub, ctx, mapper)
+    for name, agg_type, body in pipelines:
+        out[name] = _compute_pipeline(agg_type, body, out)
+    return out
+
+
+def _split_node(name, node):
+    sub = node.get("aggs", node.get("aggregations", {}))
+    types = [k for k in node if k not in ("aggs", "aggregations", "meta")]
+    if len(types) != 1:
+        raise ParsingException(
+            f"Expected exactly one aggregation type under [{name}], "
+            f"got {types}")
+    agg_type = types[0]
+    if agg_type not in METRIC_AGGS | BUCKET_AGGS | PIPELINE_AGGS:
+        raise ParsingException(f"Unknown aggregation type [{agg_type}]")
+    return agg_type, node[agg_type] or {}, sub
+
+
+def _compute_one(agg_type, body, sub, ctx, mapper):
+    if agg_type in METRIC_AGGS:
+        return _metric(agg_type, body, ctx, mapper)
+    return _bucket(agg_type, body, sub, ctx, mapper)
+
+
+# ---------------------------------------------------------------------------
+# value sources
+# ---------------------------------------------------------------------------
+
+def _numeric_values(ctx: CollectCtx, field: str) -> np.ndarray:
+    """All values (multi-value aware) of `field` for masked docs."""
+    chunks = []
+    for seg, mask, _m in ctx:
+        nv = seg.numerics.get(field)
+        if nv is None:
+            continue
+        docs = np.nonzero(mask[: seg.n_docs] & ~nv.missing)[0]
+        if len(docs) == 0:
+            continue
+        # expand ragged slices
+        flat = np.concatenate([
+            nv.all_values[nv.offsets[d]: nv.offsets[d + 1]] for d in docs
+        ]) if len(docs) else np.zeros(0)
+        chunks.append(flat)
+    return np.concatenate(chunks) if chunks else np.zeros(0)
+
+
+def _first_values_and_mask(seg, mask, field):
+    nv = seg.numerics.get(field)
+    if nv is None:
+        return None, None
+    m = mask[: seg.n_docs] & ~nv.missing
+    return nv.values, m
+
+
+def _keyword_terms_counts(ctx: CollectCtx, field: str):
+    """term -> (doc count, per-(segment) doc lists) over masked docs."""
+    counts: Dict[str, int] = {}
+    for seg, mask, _m in ctx:
+        kv = seg.keywords.get(field)
+        if kv is None:
+            continue
+        m = mask[: seg.n_docs]
+        docs = np.nonzero(m)[0]
+        if len(docs) == 0:
+            continue
+        # expand ragged ords for masked docs
+        for d in docs:
+            for o in kv.all_ords[kv.offsets[d]: kv.offsets[d + 1]]:
+                term = kv.terms[o]
+                counts[term] = counts.get(term, 0) + 1
+    return counts
+
+
+def _keyword_membership_mask(seg, field: str, term: str) -> np.ndarray:
+    """bool [n_docs]: docs containing `term` in keyword field (multi-value
+    aware)."""
+    kv = seg.keywords.get(field)
+    out = np.zeros(seg.n_docs, bool)
+    if kv is None:
+        return out
+    try:
+        tid = kv.terms.index(term)
+    except ValueError:
+        return out
+    positions = np.nonzero(kv.all_ords == tid)[0]
+    docs = np.searchsorted(kv.offsets, positions, side="right") - 1
+    out[docs] = True
+    return out
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+def _metric(agg_type, body, ctx, mapper):
+    field = body.get("field")
+    missing_val = body.get("missing")
+
+    if agg_type == "top_hits":
+        size = int(body.get("size", 3))
+        hits = []
+        for seg, mask, _m in ctx:
+            import json as _json
+            for d in np.nonzero(mask[: seg.n_docs])[0][:size]:
+                hits.append({"_id": seg.stored.ids[int(d)],
+                             "_source": _json.loads(seg.stored.source(int(d)))})
+        hits = hits[:size]
+        return {"hits": {"total": {"value": len(hits), "relation": "eq"},
+                         "hits": hits}}
+
+    if agg_type == "cardinality":
+        # keyword or numeric distinct count (exact; the reference uses
+        # HLL++ — approximation is a later optimization)
+        distinct = set()
+        for seg, mask, _m in ctx:
+            kv = seg.keywords.get(field)
+            if kv is not None:
+                m = mask[: seg.n_docs]
+                for d in np.nonzero(m)[0]:
+                    for o in kv.all_ords[kv.offsets[d]: kv.offsets[d + 1]]:
+                        distinct.add(kv.terms[o])
+                continue
+            nv = seg.numerics.get(field)
+            if nv is not None:
+                m = mask[: seg.n_docs] & ~nv.missing
+                distinct.update(np.unique(nv.values[m]).tolist())
+        return {"value": len(distinct)}
+
+    if agg_type == "weighted_avg":
+        vfield = body.get("value", {}).get("field")
+        wfield = body.get("weight", {}).get("field")
+        num = 0.0
+        den = 0.0
+        for seg, mask, _m in ctx:
+            vv, vm = _first_values_and_mask(seg, mask, vfield)
+            wv, wm = _first_values_and_mask(seg, mask, wfield)
+            if vv is None or wv is None:
+                continue
+            m = vm & wm
+            num += float((vv[m] * wv[m]).sum())
+            den += float(wv[m].sum())
+        return {"value": num / den if den else None}
+
+    values = _numeric_values(ctx, field)
+    if missing_val is not None:
+        # count docs matched but missing the field as `missing` value
+        n_missing = 0
+        for seg, mask, _m in ctx:
+            nv = seg.numerics.get(field)
+            miss = nv.missing if nv is not None else np.ones(seg.n_docs, bool)
+            n_missing += int((mask[: seg.n_docs] & miss).sum())
+        values = np.concatenate([values, np.full(n_missing, float(missing_val))])
+
+    n = len(values)
+    if agg_type == "value_count":
+        return {"value": int(n)}
+    if n == 0:
+        if agg_type == "stats":
+            return {"count": 0, "min": None, "max": None, "avg": None, "sum": 0.0}
+        if agg_type == "extended_stats":
+            return {"count": 0, "min": None, "max": None, "avg": None,
+                    "sum": 0.0, "sum_of_squares": None, "variance": None,
+                    "std_deviation": None}
+        if agg_type in ("percentiles", "percentile_ranks"):
+            return {"values": {}}
+        return {"value": None}
+    if agg_type == "avg":
+        return {"value": float(values.mean())}
+    if agg_type == "sum":
+        return {"value": float(values.sum())}
+    if agg_type == "min":
+        return {"value": float(values.min())}
+    if agg_type == "max":
+        return {"value": float(values.max())}
+    if agg_type == "stats":
+        return {"count": n, "min": float(values.min()),
+                "max": float(values.max()), "avg": float(values.mean()),
+                "sum": float(values.sum())}
+    if agg_type == "extended_stats":
+        var = float(values.var())
+        return {"count": n, "min": float(values.min()),
+                "max": float(values.max()), "avg": float(values.mean()),
+                "sum": float(values.sum()),
+                "sum_of_squares": float((values ** 2).sum()),
+                "variance": var, "std_deviation": math.sqrt(var)}
+    if agg_type == "percentiles":
+        percents = body.get("percents", [1, 5, 25, 50, 75, 95, 99])
+        return {"values": {str(float(p)): float(np.percentile(values, p))
+                           for p in percents}}
+    if agg_type == "percentile_ranks":
+        targets = body.get("values", [])
+        return {"values": {str(float(t)): float((values <= t).mean() * 100.0)
+                           for t in targets}}
+    raise IllegalArgumentException(f"unhandled metric [{agg_type}]")
+
+
+# ---------------------------------------------------------------------------
+# buckets
+# ---------------------------------------------------------------------------
+
+def _refine(ctx: CollectCtx, submasks: List[np.ndarray]) -> CollectCtx:
+    return [(seg, mask & sub, m) for (seg, mask, m), sub in zip(ctx, submasks)]
+
+
+def _bucket_result(sub: Dict[str, Any], bucket_ctx: CollectCtx, mapper,
+                   doc_count: int, extra: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(extra)
+    out["doc_count"] = doc_count
+    if sub:
+        out.update(compute_aggs(sub, bucket_ctx, mapper))
+    return out
+
+
+def _bucket(agg_type, body, sub, ctx, mapper):
+    if agg_type == "global":
+        # ignores the query mask entirely (ref: GlobalAggregator)
+        global_ctx = [(seg, seg.live.copy(), m) for seg, _msk, m in ctx]
+        out = {"doc_count": sum(int(msk.sum()) for _, msk, _m in global_ctx)}
+        if sub:
+            out.update(compute_aggs(sub, global_ctx, mapper))
+        return out
+
+    if agg_type == "filter":
+        from elasticsearch_tpu.search.queries import parse_query
+        q = parse_query(body)
+        submasks = _query_masks(q, ctx, mapper)
+        bucket_ctx = _refine(ctx, submasks)
+        return _bucket_result(sub, bucket_ctx,  mapper,
+                              sum(int(msk.sum()) for _, msk, _m in bucket_ctx), {})
+
+    if agg_type == "filters":
+        from elasticsearch_tpu.search.queries import parse_query
+        filters = body.get("filters", {})
+        buckets = {}
+        for fname, fspec in filters.items():
+            q = parse_query(fspec)
+            bucket_ctx = _refine(ctx, _query_masks(q, ctx, mapper))
+            buckets[fname] = _bucket_result(
+                sub, bucket_ctx, mapper,
+                sum(int(msk.sum()) for _, msk, _m in bucket_ctx), {})
+        return {"buckets": buckets}
+
+    if agg_type == "missing":
+        field = body.get("field")
+        submasks = []
+        for seg, mask, _m in ctx:
+            present = np.zeros(seg.n_docs, bool)
+            nv = seg.numerics.get(field)
+            if nv is not None:
+                present |= ~nv.missing
+            kv = seg.keywords.get(field)
+            if kv is not None:
+                present |= (kv.offsets[1:] - kv.offsets[:-1]) > 0
+            pf = seg.postings.get(field)
+            if pf is not None:
+                present |= pf.field_lengths > 0
+            submasks.append(~present)
+        bucket_ctx = _refine(ctx, submasks)
+        return _bucket_result(sub, bucket_ctx, mapper,
+                              sum(int(msk.sum()) for _, msk, _m in bucket_ctx), {})
+
+    if agg_type == "terms":
+        field = body.get("field")
+        size = int(body.get("size", 10))
+        order = body.get("order", {"_count": "desc"})
+        counts = _keyword_terms_counts(ctx, field)
+        if not counts:
+            # numeric terms agg
+            return _numeric_terms(body, sub, ctx, mapper)
+        (order_key, order_dir), = (order.items() if isinstance(order, dict)
+                                   else [("_count", "desc")])
+        rev = order_dir == "desc"
+        if order_key == "_count":
+            items = sorted(counts.items(), key=lambda kv_: (-kv_[1] if rev else kv_[1], kv_[0]))
+        else:  # _key
+            items = sorted(counts.items(), key=lambda kv_: kv_[0], reverse=rev)
+        buckets = []
+        for term, count in items[:size]:
+            bucket_ctx = _refine(
+                ctx, [_keyword_membership_mask(seg, field, term)
+                      for seg, _m2, _m3 in ctx])
+            buckets.append(_bucket_result(sub, bucket_ctx, mapper, count,
+                                          {"key": term}))
+        other = sum(c for _, c in items[size:])
+        return {"doc_count_error_upper_bound": 0,
+                "sum_other_doc_count": other, "buckets": buckets}
+
+    if agg_type in ("histogram", "date_histogram"):
+        field = body.get("field")
+        if agg_type == "histogram":
+            interval = float(body["interval"])
+        else:
+            interval = _date_interval_ms(body)
+        min_doc_count = int(body.get("min_doc_count", 0))
+        # work in INTEGER step space (step = floor(v / interval)) so bucket
+        # membership is exact — float key equality drops docs for
+        # fractional intervals
+        steps_present = set()
+        for seg, mask, _m in ctx:
+            vv, m = _first_values_and_mask(seg, mask, field)
+            if vv is None:
+                continue
+            steps_present.update(
+                int(s) for s in np.unique(np.floor(vv[m] / interval)))
+        buckets = []
+        all_steps = sorted(steps_present)
+        if all_steps and body.get("extended_bounds") is None and min_doc_count == 0:
+            # fill gaps between min and max (ES default for histograms)
+            all_steps = list(range(all_steps[0], all_steps[-1] + 1))
+        for step in all_steps:
+            submasks = []
+            count = 0
+            for seg, mask, _m in ctx:
+                vv, m = _first_values_and_mask(seg, mask, field)
+                if vv is None:
+                    submasks.append(np.zeros(seg.n_docs, bool))
+                    continue
+                in_bucket = m & (np.floor(vv / interval) == step)
+                submasks.append(in_bucket)
+                count += int(in_bucket.sum())
+            if count < min_doc_count:
+                continue
+            bucket_ctx = _refine(ctx, submasks)
+            key = step * interval
+            extra = {"key": key}
+            if agg_type == "date_histogram":
+                extra["key_as_string"] = _ms_to_iso(key)
+            buckets.append(_bucket_result(sub, bucket_ctx, mapper, count, extra))
+        return {"buckets": buckets}
+
+    if agg_type == "range":
+        field = body.get("field")
+        ranges = body.get("ranges", [])
+        buckets = []
+        for r in ranges:
+            frm = r.get("from")
+            to = r.get("to")
+            submasks = []
+            count = 0
+            for seg, mask, _m in ctx:
+                vv, m = _first_values_and_mask(seg, mask, field)
+                if vv is None:
+                    submasks.append(np.zeros(seg.n_docs, bool))
+                    continue
+                in_r = m.copy()
+                if frm is not None:
+                    in_r &= vv >= float(frm)
+                if to is not None:
+                    in_r &= vv < float(to)
+                submasks.append(in_r)
+                count += int(in_r.sum())
+            key = r.get("key", f"{frm if frm is not None else '*'}-"
+                               f"{to if to is not None else '*'}")
+            extra = {"key": key}
+            if frm is not None:
+                extra["from"] = float(frm)
+            if to is not None:
+                extra["to"] = float(to)
+            buckets.append(_bucket_result(sub, _refine(ctx, submasks), mapper,
+                                          count, extra))
+        return {"buckets": buckets}
+
+    raise IllegalArgumentException(f"unhandled bucket agg [{agg_type}]")
+
+
+def _numeric_terms(body, sub, ctx, mapper):
+    field = body.get("field")
+    size = int(body.get("size", 10))
+    counts: Dict[float, int] = {}
+    for seg, mask, _m in ctx:
+        nv = seg.numerics.get(field)
+        if nv is None:
+            continue
+        m = mask[: seg.n_docs] & ~nv.missing
+        vals, cnts = np.unique(nv.values[m], return_counts=True)
+        for v, c in zip(vals, cnts):
+            counts[float(v)] = counts.get(float(v), 0) + int(c)
+    items = sorted(counts.items(), key=lambda kv_: (-kv_[1], kv_[0]))[:size]
+    buckets = []
+    for val, count in items:
+        submasks = []
+        for seg, _m2, _m3 in ctx:
+            nv = seg.numerics.get(field)
+            if nv is None:
+                submasks.append(np.zeros(seg.n_docs, bool))
+            else:
+                submasks.append(~nv.missing & (nv.values == val))
+        key = int(val) if float(val).is_integer() else val
+        buckets.append(_bucket_result(sub, _refine(ctx, submasks), mapper,
+                                      count, {"key": key}))
+    other = sum(c for _, c in sorted(counts.items(),
+                                     key=lambda kv_: (-kv_[1], kv_[0]))[size:])
+    return {"doc_count_error_upper_bound": 0, "sum_other_doc_count": other,
+            "buckets": buckets}
+
+
+def _query_masks(q, ctx: CollectCtx, mapper) -> List[np.ndarray]:
+    """Execute a filter query per segment, returning host masks."""
+    from elasticsearch_tpu.search.context import SegmentContext, ShardStats
+    from elasticsearch_tpu.search.context import DeviceSegmentCache
+
+    # lightweight: reuse the segments' device state via a throwaway cache
+    # (SegmentContext needs a DeviceSegment; the global cache is preferred
+    # but not reachable from here — callers pass mapper with analysis)
+    masks = []
+    cache = _query_masks._cache
+    stats = ShardStats([seg for seg, _m2, _m3 in ctx])
+    for seg, _m2, _m3 in ctx:
+        sctx = SegmentContext(seg, cache.get(seg), mapper, stats)
+        _, mask = q.execute(sctx)
+        masks.append(np.asarray(mask)[: seg.n_docs])
+    return masks
+
+
+# module-level cache reused across agg computations
+from elasticsearch_tpu.search.context import DeviceSegmentCache as _DSC  # noqa: E402
+
+_query_masks._cache = _DSC()
+
+
+_INTERVALS_MS = {
+    "second": 1000, "1s": 1000, "minute": 60_000, "1m": 60_000,
+    "hour": 3_600_000, "1h": 3_600_000, "day": 86_400_000, "1d": 86_400_000,
+    "week": 604_800_000, "1w": 604_800_000, "month": 2_592_000_000,
+    "1M": 2_592_000_000, "quarter": 7_776_000_000, "year": 31_536_000_000,
+    "1y": 31_536_000_000,
+}
+
+
+def _date_interval_ms(body) -> float:
+    for key in ("calendar_interval", "fixed_interval", "interval"):
+        if key in body:
+            val = body[key]
+            if val in _INTERVALS_MS:
+                return float(_INTERVALS_MS[val])
+            # fixed forms like "30m", "12h", "500ms"
+            import re
+            m = re.fullmatch(r"(\d+)(ms|s|m|h|d)", str(val))
+            if m:
+                mult = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
+                        "d": 86_400_000}[m.group(2)]
+                return float(int(m.group(1)) * mult)
+            raise ParsingException(f"unknown interval [{val}]")
+    raise ParsingException("date_histogram requires an interval")
+
+
+def _ms_to_iso(ms: float) -> str:
+    import datetime as dt
+    return dt.datetime.fromtimestamp(ms / 1000.0, dt.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.000Z")
+
+
+# ---------------------------------------------------------------------------
+# pipeline aggs (operate on sibling agg results, ref: search/aggregations/
+# pipeline/)
+# ---------------------------------------------------------------------------
+
+def _extract_bucket_values(path: str, results: Dict[str, Any]) -> List[float]:
+    agg_name, _, metric = path.partition(">")
+    agg = results.get(agg_name)
+    if agg is None or "buckets" not in agg:
+        raise IllegalArgumentException(
+            f"No bucket aggregation found at path [{path}]")
+    values = []
+    buckets = agg["buckets"]
+    iterable = buckets.values() if isinstance(buckets, dict) else buckets
+    for b in iterable:
+        if metric:
+            node = b.get(metric.strip())
+            values.append(node.get("value") if isinstance(node, dict) else None)
+        else:
+            values.append(b.get("doc_count"))
+    return [v for v in values if v is not None]
+
+
+def _compute_pipeline(agg_type, body, results):
+    path = body.get("buckets_path", "")
+    if agg_type == "cumulative_sum":
+        agg_name, _, metric = path.partition(">")
+        agg = results.get(agg_name, {})
+        cum = 0.0
+        for b in agg.get("buckets", []):
+            v = (b.get(metric, {}).get("value") if metric else b.get("doc_count")) or 0.0
+            cum += v
+            b["cumulative_sum"] = {"value": cum}
+        return {"value": cum}
+    if agg_type == "derivative":
+        agg_name, _, metric = path.partition(">")
+        agg = results.get(agg_name, {})
+        prev = None
+        for b in agg.get("buckets", []):
+            v = (b.get(metric, {}).get("value") if metric else b.get("doc_count"))
+            if prev is not None and v is not None:
+                b["derivative"] = {"value": v - prev}
+            prev = v
+        return {"value": None}
+    if agg_type == "bucket_sort":
+        return {}
+    values = _extract_bucket_values(path, results)
+    if not values:
+        return {"value": None}
+    if agg_type == "avg_bucket":
+        return {"value": float(np.mean(values))}
+    if agg_type == "sum_bucket":
+        return {"value": float(np.sum(values))}
+    if agg_type == "min_bucket":
+        return {"value": float(np.min(values))}
+    if agg_type == "max_bucket":
+        return {"value": float(np.max(values))}
+    if agg_type == "stats_bucket":
+        arr = np.asarray(values, float)
+        return {"count": len(arr), "min": float(arr.min()),
+                "max": float(arr.max()), "avg": float(arr.mean()),
+                "sum": float(arr.sum())}
+    raise IllegalArgumentException(f"unhandled pipeline agg [{agg_type}]")

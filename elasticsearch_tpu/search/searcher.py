@@ -50,11 +50,15 @@ class DocAddress:
 @dataclass
 class QueryResult:
     """Per-shard query-phase result (ref: QuerySearchResult): doc addresses
-    + scores only — sources are fetched in the fetch phase for winners."""
+    + scores only — sources are fetched in the fetch phase for winners.
+    When requested, also carries per-segment match masks (pre-post_filter,
+    as the reference computes aggs before post_filter applies) for the
+    aggregation phase."""
 
     docs: List[DocAddress]
     total_hits: int
     max_score: Optional[float]
+    agg_masks: Optional[List[Tuple[Segment, np.ndarray]]] = None
 
 
 class ShardSearcher:
@@ -80,24 +84,35 @@ class ShardSearcher:
                     sort: Optional[List[Dict[str, Any]]] = None,
                     search_after: Optional[List[Any]] = None,
                     track_total_hits: bool = True,
-                    after_key: Optional[Tuple[float, int, int]] = None
-                    ) -> QueryResult:
+                    after_key: Optional[Tuple[float, int, int]] = None,
+                    collect_masks: bool = False) -> QueryResult:
         k = min(max(size, 1), MAX_TOPK)
         sort_spec = _parse_sort(sort)
         per_segment: List[Tuple[int, np.ndarray, np.ndarray, np.ndarray]] = []
         total = 0
         max_score = None
+        agg_masks: List[Tuple[Segment, np.ndarray]] = [] if collect_masks else None
 
         for seg_idx, ctx in enumerate(self._contexts()):
             if ctx.segment.n_docs == 0 or not query.can_match(ctx):
+                if collect_masks:
+                    agg_masks.append((ctx.segment,
+                                      np.zeros(ctx.segment.n_docs, bool)))
                 continue
             scores, mask = query.execute(ctx)
             mask = mask & ctx.live
+            if min_score is not None:
+                # min_score wraps ALL collectors incl. aggs (ref:
+                # MinimumScoreCollector in the QueryPhase chain)
+                mask = mask & (scores >= min_score)
+            if collect_masks:
+                # aggs see the query mask BEFORE post_filter (that's the
+                # point of post_filter, ref: QueryPhase collector order)
+                agg_masks.append((ctx.segment,
+                                  np.asarray(mask)[: ctx.segment.n_docs]))
             if post_filter is not None:
                 _, pf_mask = post_filter.execute(ctx)
                 mask = mask & pf_mask
-            if min_score is not None:
-                mask = mask & (scores >= min_score)
             if track_total_hits:
                 total += int(jnp.sum(mask))
             if _needs_max_score(sort_spec):
@@ -131,7 +146,7 @@ class ShardSearcher:
 
         # ---- merge per-segment top-k (ref: SearchPhaseController.sortDocs)
         if not per_segment:
-            return QueryResult([], total, None)
+            return QueryResult([], total, None, agg_masks)
         all_keys = np.concatenate([v for _, v, _, _ in per_segment])
         all_segs = np.concatenate(
             [np.full(len(i), s, np.int32) for s, _, i, _ in per_segment])
@@ -149,7 +164,7 @@ class ShardSearcher:
         # multi-key: re-sort winners by the full key host-side
         if sort_spec is not None and len(sort_spec) > 1:
             docs.sort(key=lambda d: _host_sort_key(d, sort_spec))
-        return QueryResult(docs, total, max_score)
+        return QueryResult(docs, total, max_score, agg_masks)
 
     # ------------------------------------------------------------ fetch
     def fetch_phase(self, docs: List[DocAddress],

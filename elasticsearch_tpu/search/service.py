@@ -143,6 +143,8 @@ class SearchService:
         search_after = body.get("search_after")
         track_total = body.get("track_total_hits", True)
         highlight = body.get("highlight")
+        aggs_spec = body.get("aggs", body.get("aggregations"))
+        collect_masks = bool(aggs_spec) and not continuing
 
         k = from_ + size if scroll_ctx is None else size
 
@@ -158,7 +160,7 @@ class SearchService:
                 query, k, post_filter=post_filter, min_score=min_score,
                 sort=sort, search_after=search_after,
                 track_total_hits=bool(track_total) and not continuing,
-                after_key=after_key)
+                after_key=after_key, collect_masks=collect_masks)
             shard_results.append((index_name, searcher, result))
             total += result.total_hits
             if result.max_score is not None:
@@ -191,6 +193,23 @@ class SearchService:
             fetched["_index"] = index_name
             hits.append(fetched)
 
+        # ---- aggregation phase (ref: AggregationPhase; reduce is trivial
+        # here since all shards are in-process — masks concatenate)
+        aggregations = None
+        if collect_masks and searchers:
+            from elasticsearch_tpu.search.aggregations import compute_aggs
+            # each segment carries its own index's mapper (multi-index aggs)
+            agg_ctx = []
+            for _, searcher, result in shard_results:
+                for seg, mask in (result.agg_masks or []):
+                    agg_ctx.append((seg, mask, searcher.mapper))
+            default_mapper = searchers[0][1].mapper
+            cache = searchers[0][1].cache
+            # empty index still yields empty/null agg results (never a
+            # missing "aggregations" key)
+            aggregations = compute_aggs(aggs_spec, agg_ctx, default_mapper,
+                                        cache)
+
         relation = "eq"
         if scroll_ctx is not None:
             if continuing:
@@ -201,7 +220,7 @@ class SearchService:
             if total > track_total:
                 total = track_total
                 relation = "gte"
-        return {
+        response = {
             "timed_out": False,
             "_shards": {"total": len(searchers), "successful": len(searchers),
                         "skipped": 0, "failed": 0},
@@ -211,6 +230,9 @@ class SearchService:
                 "hits": hits,
             },
         }
+        if aggregations is not None:
+            response["aggregations"] = aggregations
+        return response
 
     def count(self, index_expression: str, body: Dict[str, Any]) -> Dict[str, Any]:
         body = dict(body or {})

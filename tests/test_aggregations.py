@@ -1,0 +1,205 @@
+"""Aggregation tests (model: the reference's InternalAggregationTestCase
+reduce-correctness discipline + per-agg unit tests)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.service import IndicesService
+from elasticsearch_tpu.search.service import SearchService
+
+MAPPINGS = {
+    "properties": {
+        "category": {"type": "keyword"},
+        "price": {"type": "double"},
+        "qty": {"type": "long"},
+        "sold_at": {"type": "date"},
+        "name": {"type": "text"},
+    }
+}
+
+DOCS = [
+    {"category": "fruit", "price": 1.0, "qty": 10, "sold_at": "2021-01-01", "name": "apple"},
+    {"category": "fruit", "price": 2.0, "qty": 20, "sold_at": "2021-01-01", "name": "banana"},
+    {"category": "fruit", "price": 3.0, "qty": 5, "sold_at": "2021-01-02", "name": "cherry"},
+    {"category": "veg", "price": 4.0, "qty": 7, "sold_at": "2021-01-02", "name": "daikon"},
+    {"category": "veg", "price": 5.0, "qty": 2, "sold_at": "2021-01-03", "name": "endive"},
+    {"category": "meat", "price": 10.0, "sold_at": "2021-01-03", "name": "flank steak"},
+]
+
+
+@pytest.fixture(scope="module")
+def search(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("aggs")
+    indices = IndicesService(str(tmp / "data"))
+    idx = indices.create_index("shop", {"index.number_of_shards": 2}, MAPPINGS)
+    for i, d in enumerate(DOCS):
+        idx.index_doc(str(i), d)
+    idx.refresh()
+    svc = SearchService(indices)
+    yield svc
+    indices.close()
+
+
+def agg(search, aggs, query=None, **kw):
+    body = {"size": 0, "aggs": aggs}
+    if query:
+        body["query"] = query
+    body.update(kw)
+    r = search.search("shop", body)
+    return r["aggregations"]
+
+
+def test_metric_aggs(search):
+    a = agg(search, {
+        "avg_price": {"avg": {"field": "price"}},
+        "sum_price": {"sum": {"field": "price"}},
+        "min_price": {"min": {"field": "price"}},
+        "max_price": {"max": {"field": "price"}},
+        "n": {"value_count": {"field": "price"}},
+        "st": {"stats": {"field": "price"}},
+        "est": {"extended_stats": {"field": "price"}},
+    })
+    assert a["avg_price"]["value"] == pytest.approx(25 / 6)
+    assert a["sum_price"]["value"] == 25.0
+    assert a["min_price"]["value"] == 1.0
+    assert a["max_price"]["value"] == 10.0
+    assert a["n"]["value"] == 6
+    assert a["st"] == {"count": 6, "min": 1.0, "max": 10.0,
+                       "avg": pytest.approx(25 / 6), "sum": 25.0}
+    assert a["est"]["variance"] == pytest.approx(np.var([1, 2, 3, 4, 5, 10]))
+    assert a["est"]["std_deviation"] == pytest.approx(
+        math.sqrt(np.var([1, 2, 3, 4, 5, 10])))
+
+
+def test_cardinality_and_percentiles(search):
+    a = agg(search, {
+        "cats": {"cardinality": {"field": "category"}},
+        "pct": {"percentiles": {"field": "price", "percents": [50]}},
+        "ranks": {"percentile_ranks": {"field": "price", "values": [3.0]}},
+    })
+    assert a["cats"]["value"] == 3
+    assert a["pct"]["values"]["50.0"] == pytest.approx(3.5)
+    assert a["ranks"]["values"]["3.0"] == pytest.approx(50.0)
+
+
+def test_terms_agg_with_subaggs(search):
+    a = agg(search, {
+        "by_cat": {
+            "terms": {"field": "category"},
+            "aggs": {"avg_price": {"avg": {"field": "price"}}},
+        },
+    })
+    buckets = a["by_cat"]["buckets"]
+    assert [(b["key"], b["doc_count"]) for b in buckets] == [
+        ("fruit", 3), ("veg", 2), ("meat", 1)]
+    assert buckets[0]["avg_price"]["value"] == pytest.approx(2.0)
+    assert buckets[1]["avg_price"]["value"] == pytest.approx(4.5)
+
+
+def test_terms_agg_respects_query(search):
+    a = agg(search, {"by_cat": {"terms": {"field": "category"}}},
+            query={"range": {"price": {"lte": 3.0}}})
+    assert [(b["key"], b["doc_count"]) for b in a["by_cat"]["buckets"]] == [
+        ("fruit", 3)]
+
+
+def test_terms_numeric(search):
+    a = agg(search, {"by_qty": {"terms": {"field": "qty", "size": 2}}})
+    buckets = a["by_qty"]["buckets"]
+    assert len(buckets) == 2
+    assert all(b["doc_count"] == 1 for b in buckets)
+    assert a["by_qty"]["sum_other_doc_count"] == 3
+
+
+def test_histogram(search):
+    a = agg(search, {"h": {"histogram": {"field": "price", "interval": 5.0}}})
+    buckets = a["h"]["buckets"]
+    # prices 1..5 -> bucket 0.0 (5 docs); 5.0 -> bucket 5.0; 10.0 -> 10.0;
+    # ES fills empty buckets between min and max when min_doc_count=0
+    assert [(b["key"], b["doc_count"]) for b in buckets] == [
+        (0.0, 4), (5.0, 1), (10.0, 1)]
+
+
+def test_date_histogram(search):
+    a = agg(search, {"d": {"date_histogram": {"field": "sold_at",
+                                              "calendar_interval": "day"}}})
+    buckets = a["d"]["buckets"]
+    assert [b["doc_count"] for b in buckets] == [2, 2, 2]
+    assert buckets[0]["key_as_string"].startswith("2021-01-01")
+
+
+def test_range_agg(search):
+    a = agg(search, {"r": {"range": {"field": "price", "ranges": [
+        {"to": 3.0}, {"from": 3.0, "to": 6.0}, {"from": 6.0}]}}})
+    buckets = a["r"]["buckets"]
+    assert [b["doc_count"] for b in buckets] == [2, 3, 1]
+    assert buckets[0]["key"] == "*-3.0"
+
+
+def test_filter_filters_missing_global(search):
+    a = agg(search, {
+        "cheap": {"filter": {"range": {"price": {"lt": 3.0}}},
+                  "aggs": {"avg": {"avg": {"field": "price"}}}},
+        "split": {"filters": {"filters": {
+            "fruity": {"term": {"category": "fruit"}},
+            "veggy": {"term": {"category": "veg"}}}}},
+        "no_qty": {"missing": {"field": "qty"}},
+    }, query={"term": {"category": "fruit"}})
+    assert a["cheap"]["doc_count"] == 2
+    assert a["cheap"]["avg"]["value"] == pytest.approx(1.5)
+    assert a["split"]["buckets"]["fruity"]["doc_count"] == 3
+    assert a["split"]["buckets"]["veggy"]["doc_count"] == 0
+    assert a["no_qty"]["doc_count"] == 0  # all fruit have qty
+    # global ignores the query
+    a2 = agg(search, {"g": {"global": {}, "aggs": {
+        "all_avg": {"avg": {"field": "price"}}}}},
+        query={"term": {"category": "meat"}})
+    assert a2["g"]["doc_count"] == 6
+    assert a2["g"]["all_avg"]["value"] == pytest.approx(25 / 6)
+
+
+def test_top_hits(search):
+    a = agg(search, {"by_cat": {"terms": {"field": "category", "size": 1},
+                                "aggs": {"top": {"top_hits": {"size": 2}}}}})
+    top = a["by_cat"]["buckets"][0]["top"]["hits"]["hits"]
+    assert len(top) == 2
+    assert all(h["_source"]["category"] == "fruit" for h in top)
+
+
+def test_pipeline_aggs(search):
+    a = agg(search, {
+        "by_cat": {"terms": {"field": "category"},
+                   "aggs": {"avg_p": {"avg": {"field": "price"}}}},
+        "avg_of_avgs": {"avg_bucket": {"buckets_path": "by_cat>avg_p"}},
+        "max_count": {"max_bucket": {"buckets_path": "by_cat"}},
+    })
+    assert a["avg_of_avgs"]["value"] == pytest.approx((2.0 + 4.5 + 10.0) / 3)
+    assert a["max_count"]["value"] == 3.0
+
+
+def test_weighted_avg(search):
+    a = agg(search, {"w": {"weighted_avg": {
+        "value": {"field": "price"}, "weight": {"field": "qty"}}}})
+    expected = (1 * 10 + 2 * 20 + 3 * 5 + 4 * 7 + 5 * 2) / (10 + 20 + 5 + 7 + 2)
+    assert a["w"]["value"] == pytest.approx(expected)
+
+
+def test_aggs_with_post_filter(search):
+    """post_filter must NOT affect aggregations."""
+    r = search.search("shop", {
+        "size": 10,
+        "query": {"match_all": {}},
+        "post_filter": {"term": {"category": "veg"}},
+        "aggs": {"by_cat": {"terms": {"field": "category"}}},
+    })
+    assert r["hits"]["total"]["value"] == 2  # post-filtered hits
+    assert sum(b["doc_count"] for b in
+               r["aggregations"]["by_cat"]["buckets"]) == 6  # aggs unfiltered
+
+
+def test_unknown_agg_type(search):
+    from elasticsearch_tpu.common.errors import ParsingException
+    with pytest.raises(ParsingException):
+        agg(search, {"x": {"made_up": {"field": "price"}}})

@@ -1,0 +1,74 @@
+"""Native C++ layer tests: build, parity with Python implementations
+(model: the reference's native-integration seams are tested via their Java
+wrappers; here parity tests are the contract)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu import native
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.analysis.tokenizers import StandardTokenizer
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+
+def test_tokenizer_parity_ascii():
+    py = StandardTokenizer()
+    texts = [
+        "The Quick-Brown Fox, jumped over 2 dogs!",
+        "hello   world",
+        "",
+        "a",
+        "trailing space ",
+        " LEADING",
+        "123 abc456def 789",
+        "x" * 300 + " ok",  # over max_token_length -> dropped
+    ]
+    for text in texts:
+        expected = [(t.term.lower(), t.start_offset, t.end_offset)
+                    for t in py._tokenize_py(text)]
+        got = native.tokenize_ascii(text)
+        assert got == expected, (text, got, expected)
+
+
+def test_analyzer_uses_native_path():
+    reg = AnalysisRegistry()
+    std = reg.get("standard")
+    assert std.tokenizer.native_lowercase is True
+    assert std.terms("Fast ASCII Path") == ["fast", "ascii", "path"]
+    # non-ASCII falls back to the full-Unicode Python path
+    assert std.terms("Crème brûlée") == ["crème", "brûlée"]
+
+
+def test_varint_roundtrip():
+    rng = np.random.default_rng(1)
+    docids = np.sort(rng.choice(1_000_000, size=5000, replace=False)).astype(np.int32)
+    data = native.varint_encode(docids)
+    assert len(data) < docids.nbytes  # actually compresses sorted deltas
+    out = native.varint_decode(data, len(docids))
+    np.testing.assert_array_equal(out, docids)
+
+
+def test_varint_empty_and_single():
+    assert native.varint_decode(native.varint_encode(np.array([], np.int32)), 0).size == 0
+    one = np.array([12345], np.int32)
+    np.testing.assert_array_equal(
+        native.varint_decode(native.varint_encode(one), 1), one)
+
+
+def test_varint_detects_truncation():
+    docids = np.arange(100, dtype=np.int32) * 1000
+    data = native.varint_encode(docids)
+    with pytest.raises(ValueError):
+        native.varint_decode(data[:-2], 100)
+
+
+def test_count_term_freqs_parity():
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, 50, size=1000).astype(np.int32)
+    terms, tfs = native.count_term_freqs(ids)
+    expected_terms, expected_counts = np.unique(ids, return_counts=True)
+    order = np.argsort(terms)
+    np.testing.assert_array_equal(terms[order], expected_terms)
+    np.testing.assert_array_equal(tfs[order].astype(int), expected_counts)
