@@ -1,0 +1,23 @@
+"""Cluster layer: immutable cluster state, Zen2-equivalent coordination,
+routing/allocation, master + applier services (ref: server cluster/)."""
+
+from elasticsearch_tpu.cluster.state import (  # noqa: F401
+    ClusterBlocks,
+    ClusterState,
+    CoordinationMetadata,
+    DiscoveryNodes,
+    IndexMetadata,
+    IndexRoutingTable,
+    IndexShardRoutingTable,
+    Metadata,
+    RoutingTable,
+    ShardRouting,
+    VotingConfiguration,
+)
+from elasticsearch_tpu.cluster.coordination import (  # noqa: F401
+    CoordinationState,
+    CoordinationStateRejectedException,
+    Coordinator,
+    Join,
+    PersistedState,
+)

@@ -1,0 +1,1232 @@
+"""Cluster coordination: elections + two-phase state publication.
+
+The Zen2-equivalent consensus layer (ref: cluster/coordination/
+Coordinator.java:98,218,249,326,379,448-512; CoordinationState.java:42,
+109,170,212; Publication.java:42,72-73,181-190). The safety core
+(`CoordinationState`) is a pure state machine over (term, version)
+ballots — Raft-adjacent:
+
+- a node votes (joins) at most once per term;
+- an election is won by a quorum of joins in the current term;
+- a leader publishes state (term, version) to all nodes and commits only
+  after a quorum of the *voting configuration* accepts;
+- a committed state is never lost: any future leader must win a quorum
+  that intersects every commit quorum, and joins carry the voter's last
+  accepted (term, version) so the winner adopts the newest state.
+
+The liveness shell (`Coordinator`) adds: pre-vote rounds (avoid term
+inflation), randomized election scheduling with linear backoff (ref:
+ElectionSchedulerFactory.java:47-65), peer discovery gossip (ref:
+discovery/PeerFinder.java), leader/follower fault detection (ref:
+FollowersChecker.java / LeaderChecker.java), lag detection (ref:
+LagDetector.java:47), and full-vs-diff publication serialization (ref:
+PublicationTransportHandler.java:64,212).
+
+Everything is event-driven on a `Scheduler` — under the deterministic
+harness the whole multi-node protocol runs single-threaded over virtual
+time and every schedule is replayable from its seed.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from elasticsearch_tpu.cluster.state import (
+    BLOCK_NO_MASTER,
+    BLOCK_STATE_NOT_RECOVERED,
+    ClusterState,
+    CoordinationMetadata,
+    DiscoveryNodes,
+    IncompatibleClusterStateVersionException,
+    VotingConfiguration,
+)
+from elasticsearch_tpu.testing.deterministic import Cancellable, Scheduler
+from elasticsearch_tpu.transport.transport import (
+    DiscoveryNode,
+    ResponseHandler,
+)
+
+# action names (ref: SURVEY.md §3.4 / JoinHelper / PublicationTransportHandler)
+REQUEST_PEERS_ACTION = "internal:discovery/request_peers"
+PRE_VOTE_ACTION = "internal:cluster/coordination/pre_vote"
+START_JOIN_ACTION = "internal:cluster/coordination/start_join"
+JOIN_ACTION = "internal:cluster/coordination/join"
+PUBLISH_STATE_ACTION = "internal:cluster/coordination/publish_state"
+COMMIT_STATE_ACTION = "internal:cluster/coordination/commit_state"
+FOLLOWER_CHECK_ACTION = "internal:coordination/fault_detection/follower_check"
+LEADER_CHECK_ACTION = "internal:coordination/fault_detection/leader_check"
+
+MODE_CANDIDATE = "candidate"
+MODE_LEADER = "leader"
+MODE_FOLLOWER = "follower"
+
+
+class CoordinationStateRejectedException(Exception):
+    """Ref: CoordinationStateRejectedException — a message that violates
+    the ballot invariants (stale term, already voted, ...)."""
+
+
+@dataclass
+class Join:
+    """A vote: source joins target as leader for `term`, reporting the
+    voter's last accepted ballot (ref: coordination/Join.java)."""
+
+    source_node: DiscoveryNode
+    target_node_id: str
+    term: int
+    last_accepted_term: int
+    last_accepted_version: int
+
+    def to_dict(self):
+        return {"source_node": self.source_node.to_dict(),
+                "target_node_id": self.target_node_id, "term": self.term,
+                "last_accepted_term": self.last_accepted_term,
+                "last_accepted_version": self.last_accepted_version}
+
+    @staticmethod
+    def from_dict(d):
+        return Join(DiscoveryNode.from_dict(d["source_node"]),
+                    d["target_node_id"], d["term"],
+                    d["last_accepted_term"], d["last_accepted_version"])
+
+
+class PersistedState:
+    """Durable (term, accepted state) — ref: CoordinationState.PersistedState;
+    production impl backs onto the gateway metadata store."""
+
+    def __init__(self, term: int = 0,
+                 accepted: Optional[ClusterState] = None):
+        self._term = term
+        self._accepted = accepted or ClusterState()
+
+    def current_term(self) -> int:
+        return self._term
+
+    def last_accepted_state(self) -> ClusterState:
+        return self._accepted
+
+    def set_current_term(self, term: int) -> None:
+        self._term = term
+
+    def set_last_accepted_state(self, state: ClusterState) -> None:
+        self._accepted = state
+
+
+class CoordinationState:
+    """The pure safety state machine (ref: CoordinationState.java).
+    No IO, no timers — fully unit-testable."""
+
+    def __init__(self, local_node: DiscoveryNode, persisted: PersistedState):
+        self.local_node = local_node
+        self.persisted = persisted
+        self.join_votes: Dict[str, Join] = {}
+        self.election_won = False
+        self.publish_votes: Set[str] = set()
+        self.last_published_version = self.last_accepted_state().version
+        self.last_published_config = \
+            self.last_accepted_state().metadata.coordination.last_accepted_config
+
+    # -- accessors --------------------------------------------------------
+
+    def current_term(self) -> int:
+        return self.persisted.current_term()
+
+    def last_accepted_state(self) -> ClusterState:
+        return self.persisted.last_accepted_state()
+
+    def last_accepted_term(self) -> int:
+        return self.last_accepted_state().term
+
+    def last_accepted_version(self) -> int:
+        return self.last_accepted_state().version
+
+    def last_committed_config(self) -> VotingConfiguration:
+        return (self.last_accepted_state().metadata.coordination
+                .last_committed_config)
+
+    def last_accepted_config(self) -> VotingConfiguration:
+        return (self.last_accepted_state().metadata.coordination
+                .last_accepted_config)
+
+    # -- bootstrap --------------------------------------------------------
+
+    def set_initial_state(self, state: ClusterState) -> None:
+        """Install the bootstrap state (term 0, version 0 w/ the initial
+        voting configuration) — ref: CoordinationState.setInitialState."""
+        if not self.last_accepted_config().is_empty():
+            raise CoordinationStateRejectedException(
+                "initial state already set")
+        assert state.term == 0
+        self.persisted.set_last_accepted_state(state)
+        self.last_published_config = \
+            state.metadata.coordination.last_accepted_config
+
+    # -- elections --------------------------------------------------------
+
+    def handle_start_join(self, source: DiscoveryNode, term: int) -> Join:
+        """A candidate asked us to join it at `term` (ref:
+        CoordinationState.handleStartJoin:170). Bumps our term —
+        invalidating any older election/publication — and emits our vote."""
+        if term <= self.current_term():
+            raise CoordinationStateRejectedException(
+                f"incoming term {term} <= current term "
+                f"{self.current_term()}")
+        self.persisted.set_current_term(term)
+        self.join_votes = {}
+        self.election_won = False
+        self.publish_votes = set()
+        self.last_published_version = 0
+        return Join(self.local_node, source.node_id, term,
+                    self.last_accepted_term(), self.last_accepted_version())
+
+    def handle_join(self, join: Join) -> bool:
+        """Count a vote for us; returns True when this join wins the
+        election (ref: CoordinationState.handleJoin:212)."""
+        if join.term != self.current_term():
+            raise CoordinationStateRejectedException(
+                f"join term {join.term} != current {self.current_term()}")
+        if join.target_node_id != self.local_node.node_id:
+            raise CoordinationStateRejectedException("join not for us")
+        # the voter must not have accepted anything newer than us
+        if join.last_accepted_term > self.last_accepted_term():
+            raise CoordinationStateRejectedException(
+                "voter has newer accepted term")
+        if (join.last_accepted_term == self.last_accepted_term()
+                and join.last_accepted_version > self.last_accepted_version()):
+            raise CoordinationStateRejectedException(
+                "voter has newer accepted version")
+        if self.last_accepted_config().is_empty():
+            raise CoordinationStateRejectedException(
+                "cannot win election before bootstrap")
+        first = join.source_node.node_id not in self.join_votes
+        self.join_votes[join.source_node.node_id] = join
+        was_won = self.election_won
+        self.election_won = (
+            self.last_accepted_config().has_quorum(self.join_votes)
+            and self.last_committed_config().has_quorum(self.join_votes))
+        if self.election_won and not was_won:
+            self.last_published_version = self.last_accepted_version()
+        return first and self.election_won
+
+    # -- publication ------------------------------------------------------
+
+    def handle_client_value(self, state: ClusterState) -> ClusterState:
+        """Leader starts publishing `state` (ref: handleClientValue)."""
+        if not self.election_won:
+            raise CoordinationStateRejectedException(
+                "election not won")
+        if state.term != self.current_term():
+            raise CoordinationStateRejectedException("term mismatch")
+        if state.version <= self.last_published_version:
+            raise CoordinationStateRejectedException(
+                f"version {state.version} <= last published "
+                f"{self.last_published_version}")
+        # reconfiguration safety: a new voting config may only be proposed
+        # once the previous one is committed
+        config = state.metadata.coordination.last_accepted_config
+        if (config != self.last_committed_config()
+                and self.last_accepted_config() != self.last_committed_config()):
+            raise CoordinationStateRejectedException(
+                "reconfiguration in progress")
+        self.last_published_version = state.version
+        self.last_published_config = config
+        self.publish_votes = set()
+        return state
+
+    def handle_publish_request(self, state: ClusterState) -> Dict[str, Any]:
+        """Accept (persist) a published state (ref:
+        handlePublishRequest)."""
+        if state.term != self.current_term():
+            raise CoordinationStateRejectedException(
+                f"publish term {state.term} != current "
+                f"{self.current_term()}")
+        if (state.term == self.last_accepted_term()
+                and state.version <= self.last_accepted_version()):
+            raise CoordinationStateRejectedException(
+                f"publish version {state.version} <= accepted "
+                f"{self.last_accepted_version()}")
+        self.persisted.set_last_accepted_state(state)
+        return {"term": state.term, "version": state.version}
+
+    def handle_publish_response(self, source_node_id: str,
+                                term: int, version: int) -> bool:
+        """Count an ack; True → commit quorum reached (ref:
+        handlePublishResponse → ApplyCommitRequest)."""
+        if term != self.current_term() or not self.election_won:
+            raise CoordinationStateRejectedException("stale publish response")
+        if version != self.last_published_version:
+            raise CoordinationStateRejectedException(
+                f"response version {version} != published "
+                f"{self.last_published_version}")
+        self.publish_votes.add(source_node_id)
+        return (self.last_committed_config().has_quorum(self.publish_votes)
+                and self.last_published_config.has_quorum(self.publish_votes))
+
+    def handle_commit(self, term: int, version: int) -> ClusterState:
+        """Mark the accepted state committed (ref: handleCommit)."""
+        if term != self.current_term():
+            raise CoordinationStateRejectedException("commit term mismatch")
+        if (term != self.last_accepted_term()
+                or version != self.last_accepted_version()):
+            raise CoordinationStateRejectedException(
+                f"commit ({term},{version}) != accepted "
+                f"({self.last_accepted_term()},"
+                f"{self.last_accepted_version()})")
+        state = self.last_accepted_state()
+        coord = state.metadata.coordination
+        if coord.last_committed_config != coord.last_accepted_config:
+            committed = state.with_(metadata=state.metadata.with_coordination(
+                CoordinationMetadata(
+                    term=coord.term,
+                    last_committed_config=coord.last_accepted_config,
+                    last_accepted_config=coord.last_accepted_config,
+                    voting_config_exclusions=coord.voting_config_exclusions)))
+            self.persisted.set_last_accepted_state(committed)
+            return committed
+        return state
+
+
+# --------------------------------------------------------------- settings
+
+ELECTION_INITIAL_TIMEOUT = 0.1     # ref: cluster.election.initial_timeout 100ms
+ELECTION_BACK_OFF_TIME = 0.1       # ref: cluster.election.back_off_time 100ms
+ELECTION_MAX_TIMEOUT = 10.0        # ref: cluster.election.max_timeout 10s
+ELECTION_DURATION = 0.5            # ref: cluster.election.duration 500ms
+FOLLOWER_CHECK_INTERVAL = 1.0      # ref: 1s
+FOLLOWER_CHECK_RETRIES = 3
+LEADER_CHECK_INTERVAL = 1.0
+LEADER_CHECK_RETRIES = 3
+PUBLISH_TIMEOUT = 30.0             # ref: cluster.publish.timeout 30s
+LAG_TIMEOUT = 90.0                 # ref: cluster.follower_lag.timeout 90s
+PEER_FINDER_INTERVAL = 1.0         # ref: discovery.find_peers_interval 1s
+
+
+class Coordinator:
+    """Liveness shell around CoordinationState (ref: Coordinator.java).
+
+    `transport` — TransportService-shaped (send_request/register handler);
+    `scheduler` — production timer thread or DeterministicTaskQueue;
+    `on_committed_state(state)` — the ClusterApplierService hook;
+    `seed_nodes` — initial peer addresses (static seed-hosts provider);
+    `initial_master_nodes` — auto-bootstrap quorum (names/ids), empty for
+    nodes that must discover an existing cluster.
+    """
+
+    def __init__(self, transport, scheduler: Scheduler,
+                 persisted: Optional[PersistedState] = None,
+                 seed_nodes: Optional[List[DiscoveryNode]] = None,
+                 initial_master_nodes: Optional[List[str]] = None,
+                 on_committed_state: Optional[Callable] = None,
+                 master_service=None,
+                 rng=None):
+        self.transport = transport
+        self.scheduler = scheduler
+        self.local_node: DiscoveryNode = transport.local_node
+        self.coordination_state = CoordinationState(
+            self.local_node, persisted or PersistedState())
+        self.mode = MODE_CANDIDATE
+        self.current_leader: Optional[DiscoveryNode] = None
+        self.seed_nodes = [n for n in (seed_nodes or [])
+                           if n.node_id != self.local_node.node_id]
+        self.initial_master_nodes = list(initial_master_nodes or [])
+        self.on_committed_state = on_committed_state or (lambda s: None)
+        self.master_service = master_service
+        import random as _random
+        self.rng = rng or _random.Random()
+
+        # discovered peers: node_id -> DiscoveryNode (candidates gossip)
+        self.peers: Dict[str, DiscoveryNode] = {
+            n.node_id: n for n in self.seed_nodes}
+        self.applied_state: ClusterState = \
+            self.coordination_state.last_accepted_state()
+        self._applied_versions: Dict[str, int] = {}  # lag detector input
+        self._election_attempts = 0
+        self._election_task: Optional[Cancellable] = None
+        self._peer_task: Optional[Cancellable] = None
+        self._follower_checkers: Dict[str, Cancellable] = {}
+        self._follower_failures: Dict[str, int] = {}
+        self._leader_check_task: Optional[Cancellable] = None
+        self._leader_failures = 0
+        self._publication: Optional[_Publication] = None
+        self._pending_tasks: List[Tuple[str, Callable]] = []
+        self._started = False
+        self._stopped = False
+        # last full state each peer acked, for diff publication (ref:
+        # PublicationTransportHandler serializes diffs per connection)
+        self._peer_known_state: Dict[str, Tuple[str, int]] = {}
+
+        # one mutex serializes every entry point (handlers, timers,
+        # response callbacks): on the production transport these arrive on
+        # executor threads; under simulation the lock is uncontended.
+        # RLock because handler → publish → local-ack re-enters.
+        self._mutex = threading.RLock()
+        for action, handler in [
+            (REQUEST_PEERS_ACTION, self._on_request_peers),
+            (PRE_VOTE_ACTION, self._on_pre_vote),
+            (START_JOIN_ACTION, self._on_start_join),
+            (JOIN_ACTION, self._on_join),
+            (PUBLISH_STATE_ACTION, self._on_publish),
+            (COMMIT_STATE_ACTION, self._on_commit),
+            (FOLLOWER_CHECK_ACTION, self._on_follower_check),
+            (LEADER_CHECK_ACTION, self._on_leader_check),
+        ]:
+            transport.register_request_handler(action, self._locked(handler))
+
+    # -------------------------------------------------------- concurrency
+
+    def _locked(self, fn):
+        def wrapped(*a, **k):
+            with self._mutex:
+                return fn(*a, **k)
+        return wrapped
+
+    def _schedule(self, delay, fn, description=""):
+        return self.scheduler.schedule(delay, self._locked(fn), description)
+
+    def _schedule0(self, fn, description=""):
+        return self._schedule(0.0, fn, description)
+
+    def _handler(self, ok, fail):
+        return ResponseHandler(self._locked(ok), self._locked(fail))
+
+    # ------------------------------------------------------------- control
+
+    def start(self) -> None:
+        self._started = True
+        self.become_candidate("startup")
+
+    def stop(self) -> None:
+        self._stopped = True
+        for c in (self._election_task, self._peer_task,
+                  self._leader_check_task):
+            if c:
+                c.cancel()
+        for c in self._follower_checkers.values():
+            c.cancel()
+
+    # -------------------------------------------------------- mode changes
+
+    def become_candidate(self, reason: str) -> None:
+        self.mode = MODE_CANDIDATE
+        self.current_leader = None
+        self._cancel_follower_checkers()
+        if self._leader_check_task:
+            self._leader_check_task.cancel()
+            self._leader_check_task = None
+        if self._publication is not None:
+            self._publication.fail("became candidate")
+            self._publication = None
+        self._election_attempts = 0
+        self._schedule_election()
+        self._schedule_peer_finding()
+
+    def become_leader(self) -> None:
+        self.mode = MODE_LEADER
+        self.current_leader = self.local_node
+        if self._peer_task:
+            self._peer_task.cancel()
+            self._peer_task = None
+        if self._election_task:
+            self._election_task.cancel()
+            self._election_task = None
+        # first publication: cluster state with ourselves as master and
+        # all voters that joined
+        self._submit_internal(
+            "elected-as-master", self._elected_state_update)
+
+    def become_follower(self, leader: DiscoveryNode) -> None:
+        prev_leader = self.current_leader
+        self.mode = MODE_FOLLOWER
+        self.current_leader = leader
+        if self._peer_task:
+            self._peer_task.cancel()
+            self._peer_task = None
+        if self._election_task:
+            self._election_task.cancel()
+            self._election_task = None
+        self._cancel_follower_checkers()
+        if self._publication is not None:
+            self._publication.fail("became follower")
+            self._publication = None
+        if (self._leader_check_task is None
+                or prev_leader is None
+                or prev_leader.node_id != leader.node_id):
+            self._leader_failures = 0
+            self._start_leader_checker()
+
+    def _cancel_follower_checkers(self) -> None:
+        for c in self._follower_checkers.values():
+            c.cancel()
+        self._follower_checkers.clear()
+        self._follower_failures.clear()
+
+    # ---------------------------------------------------------- discovery
+
+    def _schedule_peer_finding(self) -> None:
+        if self._stopped or self.mode != MODE_CANDIDATE:
+            return
+        self._peer_task = self._schedule(
+            PEER_FINDER_INTERVAL, self._find_peers, "peer-finding")
+        # also fire one round now
+        self._schedule0(self._request_peers_round, "peer-round")
+
+    def _find_peers(self) -> None:
+        if self._stopped or self.mode != MODE_CANDIDATE:
+            return
+        self._request_peers_round()
+        self._schedule_peer_finding()
+
+    def _request_peers_round(self) -> None:
+        for node in list(self.peers.values()):
+            self.transport.send_request(
+                node, REQUEST_PEERS_ACTION,
+                {"source": self.local_node.to_dict()},
+                self._handler(self._on_peers_response, lambda e: None),
+                timeout=5.0)
+
+    def _on_peers_response(self, resp: Dict[str, Any]) -> None:
+        if self._stopped:
+            return
+        for nd in resp.get("peers", []):
+            n = DiscoveryNode.from_dict(nd)
+            if n.node_id != self.local_node.node_id:
+                self.peers.setdefault(n.node_id, n)
+        master = resp.get("master")
+        if master is not None and self.mode == MODE_CANDIDATE:
+            # someone is a live master — join it (ref: a candidate whose
+            # PeerFinder finds an active master sends it a join,
+            # JoinHelper.sendJoinRequest / Coordinator.joinLeaderInTerm)
+            leader = DiscoveryNode.from_dict(master)
+            term = resp.get("term", 0)
+            if leader.node_id != self.local_node.node_id:
+                self.peers.setdefault(leader.node_id, leader)
+                if term > self.current_term():
+                    try:
+                        join = self.coordination_state.handle_start_join(
+                            leader, term)
+                    except CoordinationStateRejectedException:
+                        return
+                    self.transport.send_request(
+                        leader, JOIN_ACTION, {"join": join.to_dict()},
+                        self._handler(lambda r: None, lambda e: None),
+                        timeout=10.0)
+                elif term == self.current_term():
+                    # already at the leader's term (e.g. we were removed
+                    # from the cluster and healed): membership join with
+                    # no ballot vote (ref: JoinHelper sends join requests
+                    # with an empty optional Join at equal terms)
+                    self.transport.send_request(
+                        leader, JOIN_ACTION,
+                        {"node": self.local_node.to_dict()},
+                        self._handler(lambda r: None, lambda e: None),
+                        timeout=10.0)
+
+    def _on_request_peers(self, req, channel, src) -> None:
+        if src is not None and src.node_id != self.local_node.node_id:
+            self.peers.setdefault(src.node_id, src)
+        source = req.get("source")
+        if source:
+            n = DiscoveryNode.from_dict(source)
+            if n.node_id != self.local_node.node_id:
+                self.peers[n.node_id] = n
+        channel.send_response({
+            "peers": [n.to_dict() for n in self.peers.values()],
+            "master": (self.current_leader.to_dict()
+                       if self.mode == MODE_LEADER else None),
+            "term": self.current_term(),
+        })
+
+    # ---------------------------------------------------------- elections
+
+    def current_term(self) -> int:
+        return self.coordination_state.current_term()
+
+    def _schedule_election(self) -> None:
+        """Randomized timeout with linear backoff (ref:
+        ElectionSchedulerFactory.java:47-65 — upper bound grows by
+        back_off_time per attempt, capped)."""
+        if self._stopped:
+            return
+        self._election_attempts += 1
+        upper = min(ELECTION_MAX_TIMEOUT,
+                    ELECTION_INITIAL_TIMEOUT
+                    + ELECTION_BACK_OFF_TIME * self._election_attempts)
+        delay = self.rng.uniform(0.0, upper) + 0.01
+        self._election_task = self._schedule(
+            delay, self._election_round, "election-round")
+
+    def _election_round(self) -> None:
+        if self._stopped or self.mode != MODE_CANDIDATE:
+            return
+        self._schedule_election()  # schedule next attempt up-front
+        if self.coordination_state.last_accepted_config().is_empty():
+            self._maybe_bootstrap()
+            return
+        if not self.local_node.is_master_eligible():
+            return
+        # pre-vote round (ref: PreVoteCollector) — ask a quorum whether
+        # an election could succeed, without inflating terms
+        voting = self.coordination_state.last_committed_config()
+        targets = self._known_nodes(include_self=True)
+        responses: Dict[str, Dict] = {}
+        round_done = {"fired": False}
+
+        def on_resp(node_id):
+            def fn(resp):
+                if round_done["fired"] or self._stopped:
+                    return
+                if resp.get("has_leader") and \
+                        resp.get("term", 0) >= self.current_term():
+                    return  # someone has a live leader; don't disturb
+                responses[node_id] = resp
+                grants = {nid for nid, r in responses.items()
+                          if self._pre_vote_granted(r)}
+                if voting.has_quorum(grants):
+                    round_done["fired"] = True
+                    self._start_election(max(
+                        [r.get("term", 0) for r in responses.values()]
+                        + [self.current_term()]))
+            return fn
+
+        for node in targets:
+            self.transport.send_request(
+                node, PRE_VOTE_ACTION,
+                {"source": self.local_node.to_dict(),
+                 "term": self.current_term()},
+                self._handler(on_resp(node.node_id), lambda e: None),
+                timeout=ELECTION_DURATION)
+
+    def _pre_vote_granted(self, resp: Dict) -> bool:
+        # grant unless the responder has accepted a newer ballot than ours
+        if resp.get("last_accepted_term", 0) > \
+                self.coordination_state.last_accepted_term():
+            return False
+        if (resp.get("last_accepted_term", 0)
+                == self.coordination_state.last_accepted_term()
+                and resp.get("last_accepted_version", 0)
+                > self.coordination_state.last_accepted_version()):
+            return False
+        return True
+
+    def _on_pre_vote(self, req, channel, src) -> None:
+        channel.send_response({
+            "term": self.current_term(),
+            "has_leader": self.mode != MODE_CANDIDATE,
+            "last_accepted_term":
+                self.coordination_state.last_accepted_term(),
+            "last_accepted_version":
+                self.coordination_state.last_accepted_version(),
+        })
+
+    def _start_election(self, max_seen_term: int) -> None:
+        """Broadcast StartJoin at term+1 (ref:
+        Coordinator.startElection → broadcastStartJoinRequest)."""
+        if self._stopped or self.mode != MODE_CANDIDATE:
+            return
+        term = max(max_seen_term, self.current_term()) + 1
+        for node in self._known_nodes(include_self=True):
+            self._send_start_join(node, term)
+
+    def _send_start_join(self, node: DiscoveryNode, term: int) -> None:
+        if node.node_id == self.local_node.node_id:
+            # local path: generate our own join for ourselves
+            try:
+                join = self.coordination_state.handle_start_join(
+                    self.local_node, term)
+            except CoordinationStateRejectedException:
+                return
+            self._process_join(join)
+            return
+        self.transport.send_request(
+            node, START_JOIN_ACTION,
+            {"source": self.local_node.to_dict(), "term": term},
+            self._handler(lambda r: None, lambda e: None), timeout=10.0)
+
+    def _on_start_join(self, req, channel, src) -> None:
+        source = DiscoveryNode.from_dict(req["source"])
+        term = req["term"]
+        try:
+            join = self.coordination_state.handle_start_join(source, term)
+        except CoordinationStateRejectedException as e:
+            channel.send_exception(e)
+            return
+        # term bumped: if we were leader/follower at an older term, step
+        # down (ref: joining another's election makes us candidate)
+        if self.mode != MODE_CANDIDATE:
+            self.become_candidate(f"start-join from {source.name}")
+        channel.send_response({"ok": True})
+        # send our join (vote) to the candidate
+        self.transport.send_request(
+            source, JOIN_ACTION, {"join": join.to_dict()},
+            self._handler(lambda r: None, lambda e: None), timeout=10.0)
+
+    def _on_join(self, req, channel, src) -> None:
+        try:
+            if req.get("join") is not None:
+                self._process_join(Join.from_dict(req["join"]))
+            elif req.get("node") is not None:
+                # membership-only join (no ballot): a healed node rejoins
+                # an established leader at the same term
+                joiner = DiscoveryNode.from_dict(req["node"])
+                if self.mode != MODE_LEADER:
+                    raise CoordinationStateRejectedException(
+                        "not the leader")
+                self.peers.setdefault(joiner.node_id, joiner)
+                self._submit_internal(
+                    f"node-join[{joiner.name}]",
+                    lambda state: self._node_join_update(state, joiner))
+            channel.send_response({"ok": True})
+        except CoordinationStateRejectedException as e:
+            channel.send_exception(e)
+
+    def _process_join(self, join: Join) -> None:
+        won_now = self.coordination_state.handle_join(join)
+        joiner = join.source_node
+        if joiner.node_id != self.local_node.node_id:
+            self.peers.setdefault(joiner.node_id, joiner)
+        if self.mode == MODE_CANDIDATE and won_now:
+            self.become_leader()
+        elif self.mode == MODE_LEADER:
+            # a node joined an established leader: add to cluster state
+            self._submit_internal(
+                f"node-join[{joiner.name}]",
+                lambda state: self._node_join_update(state, joiner))
+
+    # ---------------------------------------------------------- bootstrap
+
+    def _maybe_bootstrap(self) -> None:
+        """Auto-bootstrap once a quorum of initial_master_nodes is
+        discovered (ref: ClusterBootstrapService)."""
+        if not self.initial_master_nodes:
+            return
+        known = {self.local_node.node_id: self.local_node,
+                 **self.peers}
+        by_name = {n.name: n for n in known.values()}
+        resolved = [by_name.get(x) or known.get(x)
+                    for x in self.initial_master_nodes]
+        if any(r is None for r in resolved):
+            return  # not all discovered yet
+        if self.local_node.node_id not in {r.node_id for r in resolved}:
+            return  # only a listed node bootstraps
+        config = VotingConfiguration(frozenset(
+            r.node_id for r in resolved if r.is_master_eligible()))
+        state = ClusterState(
+            cluster_name=self.applied_state.cluster_name,
+            version=0, term=0,
+            state_uuid=uuid.uuid4().hex,
+            nodes=DiscoveryNodes((self.local_node,)),
+            metadata=self.applied_state.metadata.with_coordination(
+                CoordinationMetadata(term=0,
+                                     last_committed_config=config,
+                                     last_accepted_config=config)),
+            blocks=self.applied_state.blocks
+            .with_global_block(BLOCK_STATE_NOT_RECOVERED)
+            .with_global_block(BLOCK_NO_MASTER),
+        )
+        try:
+            self.coordination_state.set_initial_state(state)
+        except CoordinationStateRejectedException:
+            pass
+
+    # ------------------------------------------------------- master tasks
+
+    def _submit_internal(self, source: str,
+                         update: Callable[[ClusterState], ClusterState]) -> None:
+        """Queue a state-update task; one publication in flight at a time
+        (ref: MasterService single-threaded batched queue)."""
+        self._pending_tasks.append((source, update, None))
+        self._drain_tasks()
+
+    def submit_state_update(self, source: str,
+                            update: Callable[[ClusterState], ClusterState],
+                            on_done: Optional[Callable] = None) -> None:
+        """Public API for services (create index, shard started, ...)."""
+        with self._mutex:
+            self._pending_tasks.append((source, update, on_done))
+            self._drain_tasks()
+
+    def _drain_tasks(self) -> None:
+        if (self.mode != MODE_LEADER or self._publication is not None
+                or not self._pending_tasks):
+            return
+        source, update, on_done = self._pending_tasks.pop(0)
+        base = self.coordination_state.last_accepted_state()
+        try:
+            new_state = update(base)
+        except Exception as e:
+            if on_done:
+                on_done(e)
+            self._schedule0(self._drain_tasks, "drain-next")
+            return
+        if new_state is base or new_state is None:
+            if on_done:
+                on_done(None)
+            self._schedule0(self._drain_tasks, "drain-next")
+            return
+        new_state = new_state.with_(
+            term=self.current_term(),
+            version=base.version + 1,
+            state_uuid=uuid.uuid4().hex)
+        self._publish(new_state, on_done)
+
+    def _elected_state_update(self, state: ClusterState) -> ClusterState:
+        nodes = state.nodes
+        # ensure all voters + self are members; set master
+        for j in self.coordination_state.join_votes.values():
+            nodes = nodes.with_node(j.source_node)
+        nodes = nodes.with_node(self.local_node)
+        nodes = nodes.with_master(self.local_node.node_id)
+        blocks = state.blocks.without_global_block(BLOCK_NO_MASTER)
+        return state.with_(nodes=nodes, blocks=blocks)
+
+    def _node_join_update(self, state: ClusterState,
+                          joiner: DiscoveryNode) -> ClusterState:
+        if joiner.node_id in state.nodes and \
+                state.nodes.get(joiner.node_id) == joiner:
+            return state
+        new = state.with_(nodes=state.nodes.with_node(joiner))
+        return self._with_adjusted_config(new)
+
+    def node_left(self, node_id: str, reason: str) -> None:
+        """Remove a node from the cluster (fault detection / disconnect)
+        (ref: NodeRemovalClusterStateTaskExecutor)."""
+        def update(state: ClusterState) -> ClusterState:
+            if node_id not in state.nodes:
+                return state
+            new = state.with_(nodes=state.nodes.without_node(node_id))
+            return self._with_adjusted_config(new)
+        self._submit_internal(f"node-left[{node_id}] {reason}", update)
+
+    def _with_adjusted_config(self, state: ClusterState) -> ClusterState:
+        """Reconfigurator (ref: Reconfigurator.java): voting config tracks
+        live master-eligible members, kept at odd size so quorums stay
+        meaningful; never shrinks below a majority of the current config."""
+        coord = state.metadata.coordination
+        if coord.last_committed_config != coord.last_accepted_config:
+            return state  # previous reconfiguration still uncommitted
+        eligible = [n.node_id for n in state.nodes.master_eligible()
+                    if n.node_id not in coord.voting_config_exclusions]
+        if not eligible:
+            return state
+        # retain current voters that are still members; grow with new
+        # eligible nodes; keep an odd count
+        current = coord.last_committed_config.node_ids
+        keep = [n for n in eligible if n in current]
+        add = [n for n in eligible if n not in current]
+        desired = keep + add
+        if len(desired) % 2 == 0 and len(desired) > 1:
+            # drop one non-current node if possible, else one current
+            desired = desired[:-1]
+        new_config = VotingConfiguration(frozenset(desired))
+        if new_config == coord.last_committed_config:
+            return state
+        # safety: the new config must be reachable — require that current
+        # voters form a quorum of the old config among live members
+        return state.with_(metadata=state.metadata.with_coordination(
+            CoordinationMetadata(
+                term=coord.term,
+                last_committed_config=coord.last_committed_config,
+                last_accepted_config=new_config,
+                voting_config_exclusions=coord.voting_config_exclusions)))
+
+    # ---------------------------------------------------------- publishing
+
+    def _publish(self, state: ClusterState,
+                 on_done: Optional[Callable] = None) -> None:
+        try:
+            self.coordination_state.handle_client_value(state)
+        except CoordinationStateRejectedException as e:
+            if on_done:
+                on_done(e)
+            return
+        pub = _Publication(self, state, on_done)
+        self._publication = pub
+        pub.start()
+
+    def _on_publish(self, req, channel, src) -> None:
+        try:
+            if "diff" in req:
+                diff = req["diff"]
+                try:
+                    state = ClusterState.apply_diff(
+                        self.coordination_state.last_accepted_state(), diff)
+                except IncompatibleClusterStateVersionException as e:
+                    channel.send_exception(e)
+                    return
+            else:
+                state = ClusterState.from_dict(req["state"])
+            # handle term bump piggybacked on publish: a publish at a
+            # higher term acts as an implicit start-join from the master
+            join_dict = None
+            if state.term > self.current_term():
+                join = self.coordination_state.handle_start_join(
+                    state.nodes.master_node or
+                    DiscoveryNode(node_id=state.nodes.master_node_id or ""),
+                    state.term)
+                join_dict = join.to_dict()
+            resp = self.coordination_state.handle_publish_request(state)
+            master = state.nodes.master_node
+            if master is not None and \
+                    master.node_id != self.local_node.node_id:
+                self.become_follower(master)
+            elif master is not None and \
+                    master.node_id == self.local_node.node_id and \
+                    self.mode != MODE_LEADER:
+                pass  # our own publish echoed back
+            if join_dict is not None:
+                resp = dict(resp)
+                resp["join"] = join_dict
+            channel.send_response(resp)
+        except CoordinationStateRejectedException as e:
+            channel.send_exception(e)
+
+    def _on_commit(self, req, channel, src) -> None:
+        try:
+            state = self.coordination_state.handle_commit(
+                req["term"], req["version"])
+        except CoordinationStateRejectedException as e:
+            channel.send_exception(e)
+            return
+        self._apply_committed(state)
+        channel.send_response({"ok": True,
+                               "applied_version": state.version})
+
+    def _apply_committed(self, state: ClusterState) -> None:
+        if state.version <= self.applied_state.version and \
+                state.term <= self.applied_state.term:
+            return
+        self.applied_state = state
+        try:
+            self.on_committed_state(state)
+        except Exception:
+            import traceback
+            traceback.print_exc()
+
+    # ------------------------------------------------------ fault detection
+
+    def _start_follower_checker(self, node: DiscoveryNode) -> None:
+        """Leader pings each follower (ref: FollowersChecker.java:67)."""
+        if node.node_id == self.local_node.node_id or self._stopped:
+            return
+        if node.node_id in self._follower_checkers:
+            return
+        self._follower_failures[node.node_id] = 0
+
+        def check():
+            if self.mode != MODE_LEADER or self._stopped:
+                return
+            self.transport.send_request(
+                node, FOLLOWER_CHECK_ACTION,
+                {"term": self.current_term(),
+                 "source": self.local_node.to_dict()},
+                self._handler(ok, fail), timeout=FOLLOWER_CHECK_INTERVAL * 3)
+
+        def reschedule():
+            if self.mode == MODE_LEADER and not self._stopped and \
+                    node.node_id in self._follower_checkers:
+                self._follower_checkers[node.node_id] = \
+                    self._schedule(FOLLOWER_CHECK_INTERVAL, check,
+                                            f"follower-check {node.name}")
+
+        def ok(resp):
+            self._follower_failures[node.node_id] = 0
+            reschedule()
+
+        def fail(exc):
+            n = self._follower_failures.get(node.node_id, 0) + 1
+            self._follower_failures[node.node_id] = n
+            if n >= FOLLOWER_CHECK_RETRIES:
+                self._follower_checkers.pop(node.node_id, None)
+                self.node_left(node.node_id, "followers check failed")
+            else:
+                reschedule()
+
+        self._follower_checkers[node.node_id] = self._schedule(
+            FOLLOWER_CHECK_INTERVAL, check, f"follower-check {node.name}")
+
+    def _on_follower_check(self, req, channel, src) -> None:
+        """Ref: FollowersChecker.handleFollowerCheck — a check at our term
+        from the leader confirms followership; at a higher term we must
+        become its follower."""
+        term = req["term"]
+        source = DiscoveryNode.from_dict(req["source"])
+        if term < self.current_term():
+            channel.send_exception(CoordinationStateRejectedException(
+                f"check term {term} < {self.current_term()}"))
+            return
+        if self.mode == MODE_LEADER and term == self.current_term() and \
+                source.node_id != self.local_node.node_id:
+            # two leaders at one term is impossible; the term must differ
+            channel.send_exception(CoordinationStateRejectedException(
+                "i am the leader at this term"))
+            return
+        if term > self.current_term():
+            # adopt the checker's term, voting for it (ref: a follower
+            # check at a higher term acts as a join opportunity)
+            try:
+                join = self.coordination_state.handle_start_join(
+                    source, term)
+                self.transport.send_request(
+                    source, JOIN_ACTION, {"join": join.to_dict()},
+                    self._handler(lambda r: None, lambda e: None),
+                    timeout=10.0)
+            except CoordinationStateRejectedException:
+                pass
+        if source.node_id != self.local_node.node_id and \
+                self.mode != MODE_FOLLOWER:
+            # a stuck candidate being checked by a live leader becomes
+            # its follower (ref: FollowersChecker.handleFollowerCheck
+            # calls onFollowerCheckRequest -> becomeFollower)
+            self.become_follower(source)
+        self.peers.setdefault(source.node_id, source)
+        channel.send_response({"ok": True,
+                               "applied_version": self.applied_state.version})
+
+    def _start_leader_checker(self) -> None:
+        """Follower pings the leader (ref: LeaderChecker.java:66)."""
+        if self._leader_check_task:
+            self._leader_check_task.cancel()
+
+        def check():
+            if self.mode != MODE_FOLLOWER or self._stopped:
+                return
+            leader = self.current_leader
+            if leader is None:
+                return
+
+            def ok(resp):
+                self._leader_failures = 0
+                reschedule()
+
+            def fail(exc):
+                self._leader_failures += 1
+                if self._leader_failures >= LEADER_CHECK_RETRIES:
+                    self.become_candidate("leader check failed")
+                else:
+                    reschedule()
+
+            self.transport.send_request(
+                leader, LEADER_CHECK_ACTION,
+                {"source": self.local_node.to_dict()},
+                self._handler(ok, fail),
+                timeout=LEADER_CHECK_INTERVAL * 3)
+
+        def reschedule():
+            if self.mode == MODE_FOLLOWER and not self._stopped:
+                self._leader_check_task = self._schedule(
+                    LEADER_CHECK_INTERVAL, check, "leader-check")
+
+        self._leader_check_task = self._schedule(
+            LEADER_CHECK_INTERVAL, check, "leader-check")
+
+    def _on_leader_check(self, req, channel, src) -> None:
+        if self.mode != MODE_LEADER:
+            channel.send_exception(CoordinationStateRejectedException(
+                "not the leader"))
+        else:
+            channel.send_response({"ok": True})
+
+    # ------------------------------------------------------------- helpers
+
+    def _known_nodes(self, include_self: bool = False) -> List[DiscoveryNode]:
+        nodes: Dict[str, DiscoveryNode] = {}
+        for n in self.coordination_state.last_accepted_state().nodes.nodes:
+            nodes[n.node_id] = n
+        nodes.update(self.peers)
+        nodes.pop(self.local_node.node_id, None)
+        out = list(nodes.values())
+        if include_self:
+            out.append(self.local_node)
+        return out
+
+
+class _Publication:
+    """One two-phase publication (ref: Publication.java:42 — publish to
+    all, commit after quorum ack, finish when all respond or timeout;
+    LagDetector removes nodes that ack but don't apply)."""
+
+    def __init__(self, coordinator: Coordinator, state: ClusterState,
+                 on_done: Optional[Callable]):
+        self.c = coordinator
+        self.state = state
+        self.on_done = on_done
+        self.committed = False
+        self.finished = False
+        self.acked: Set[str] = set()
+        self.failed_nodes: Set[str] = set()
+        self.applied: Set[str] = set()
+        self.targets = list(state.nodes.nodes)
+        if not any(n.node_id == self.c.local_node.node_id
+                   for n in self.targets):
+            self.targets.append(self.c.local_node)
+
+    def start(self) -> None:
+        c = self.c
+        base = c.applied_state
+        self.timeout_task = c._schedule(
+            PUBLISH_TIMEOUT, self._on_timeout, "publish-timeout")
+        # serialize once, share across targets (ref:
+        # PublicationTransportHandler serializes each form once)
+        full_payload = None
+        diff_payload = None
+        for node in self.targets:
+            if node.node_id == c.local_node.node_id:
+                # local accept (ref: Coordinator publishes to self through
+                # the same path, without serialization)
+                try:
+                    resp = c.coordination_state.handle_publish_request(
+                        self.state)
+                    self._on_publish_response(node, resp)
+                except CoordinationStateRejectedException as e:
+                    self._on_publish_fail(node, e)
+                continue
+            known = c._peer_known_state.get(node.node_id)
+            if known is not None and known == (base.state_uuid, base.version):
+                if diff_payload is None:
+                    diff_payload = {"diff": self.state.diff_from(base)}
+                payload = diff_payload
+            else:
+                if full_payload is None:
+                    full_payload = {"state": self.state.to_dict()}
+                payload = full_payload
+            self._send_publish(node, payload, allow_full_retry=True)
+
+    def _send_publish(self, node: DiscoveryNode, payload: Dict,
+                      allow_full_retry: bool) -> None:
+        c = self.c
+
+        def ok(resp):
+            c._peer_known_state[node.node_id] = (
+                self.state.state_uuid, self.state.version)
+            # a publish at a higher term may carry back a join (vote)
+            join_d = resp.get("join") if isinstance(resp, dict) else None
+            if join_d:
+                try:
+                    c._process_join(Join.from_dict(join_d))
+                except CoordinationStateRejectedException:
+                    pass
+            self._on_publish_response(node, resp)
+
+        def fail(exc):
+            if allow_full_retry and "diff" in payload:
+                # incompatible diff → resend full state (ref:
+                # PublicationTransportHandler fallback)
+                self._send_publish(node, {"state": self.state.to_dict()},
+                                   allow_full_retry=False)
+            else:
+                self._on_publish_fail(node, exc)
+
+        c.transport.send_request(node, PUBLISH_STATE_ACTION, payload,
+                                 c._handler(ok, fail),
+                                 timeout=PUBLISH_TIMEOUT)
+
+    def _on_publish_response(self, node: DiscoveryNode, resp: Dict) -> None:
+        c = self.c
+        if self.finished:
+            return
+        try:
+            quorum = c.coordination_state.handle_publish_response(
+                node.node_id, resp["term"], resp["version"])
+        except CoordinationStateRejectedException:
+            return
+        self.acked.add(node.node_id)
+        if quorum and not self.committed:
+            self.committed = True
+            self._send_commits()
+        self._maybe_finish()
+
+    def _on_publish_fail(self, node: DiscoveryNode, exc) -> None:
+        self.failed_nodes.add(node.node_id)
+        if not self.committed:
+            # fail fast once a commit quorum is impossible (ref:
+            # Publication.onPossibleCommitFailure)
+            alive = ({n.node_id for n in self.targets}
+                     - self.failed_nodes)
+            cs = self.c.coordination_state
+            if not (cs.last_committed_config().has_quorum(alive)
+                    and cs.last_published_config.has_quorum(alive)):
+                self._finish(success=False)
+                return
+        self._maybe_finish()
+
+    def _send_commits(self) -> None:
+        c = self.c
+        payload = {"term": self.state.term, "version": self.state.version}
+        for node in self.targets:
+            if node.node_id in self.failed_nodes:
+                continue
+            if node.node_id == c.local_node.node_id:
+                try:
+                    committed = c.coordination_state.handle_commit(
+                        payload["term"], payload["version"])
+                    c._apply_committed(committed)
+                    self.applied.add(node.node_id)
+                except CoordinationStateRejectedException:
+                    pass
+                self._maybe_finish()
+                continue
+
+            def ok(resp, _n=node):
+                self.applied.add(_n.node_id)
+                self._maybe_finish()
+
+            def fail(exc, _n=node):
+                # acked but did not apply: count as failed for completion
+                # purposes; the lag/fault detectors own its removal
+                self.failed_nodes.add(_n.node_id)
+                self._maybe_finish()
+
+            c.transport.send_request(node, COMMIT_STATE_ACTION, payload,
+                                     c._handler(ok, fail),
+                                     timeout=PUBLISH_TIMEOUT)
+
+    def _maybe_finish(self) -> None:
+        done = {n.node_id for n in self.targets
+                if n.node_id in self.failed_nodes
+                or (n.node_id in self.applied)}
+        if self.committed and len(done) == len(self.targets):
+            self._finish(success=True)
+
+    def _on_timeout(self) -> None:
+        if self.finished:
+            return
+        if self.committed:
+            # committed but some nodes lag: finish; lag detector handles
+            # stragglers (ref: Publication.onTimeout + LagDetector)
+            for n in self.targets:
+                if (n.node_id not in self.applied
+                        and n.node_id not in self.failed_nodes):
+                    self.c.node_left(n.node_id, "lagging")
+            self._finish(success=True)
+        else:
+            self._finish(success=False)
+
+    def fail(self, reason: str) -> None:
+        if not self.finished:
+            self.finished = True
+            if self.on_done:
+                self.on_done(RuntimeError(f"publication failed: {reason}"))
+
+    def _finish(self, success: bool) -> None:
+        if self.finished:
+            return
+        self.finished = True
+        self.timeout_task.cancel()
+        c = self.c
+        c._publication = None
+        if success:
+            # leader: start follower checkers for all members
+            if c.mode == MODE_LEADER:
+                for n in self.state.nodes.nodes:
+                    c._start_follower_checker(n)
+            if self.on_done:
+                self.on_done(None)
+        else:
+            if self.on_done:
+                self.on_done(RuntimeError("publication not committed"))
+            if c.mode == MODE_LEADER:
+                c.become_candidate("publication failed")
+        c._schedule0(c._drain_tasks, "drain-after-publish")

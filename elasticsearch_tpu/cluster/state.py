@@ -1,0 +1,504 @@
+"""Immutable cluster state.
+
+Ref: cluster/ClusterState.java — the single versioned snapshot every
+service consumes: nodes, index metadata, routing table, blocks; published
+by the elected master with diff support (ClusterState.Diff,
+PublicationTransportHandler.java:64,212 sends full state on first contact,
+diffs thereafter).
+
+Represented as frozen dataclasses over plain dicts so states serialize to
+JSON for the wire and for persistence. All "mutation" is copy-on-write
+via builders, like the reference.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, FrozenSet, List, Optional, Tuple
+
+from elasticsearch_tpu.transport.transport import DiscoveryNode
+
+
+@dataclass(frozen=True)
+class VotingConfiguration:
+    """The node ids whose quorum decides elections/commits (ref:
+    CoordinationMetadata.VotingConfiguration)."""
+
+    node_ids: FrozenSet[str] = frozenset()
+
+    def has_quorum(self, votes) -> bool:
+        if not self.node_ids:
+            return False
+        have = sum(1 for n in self.node_ids if n in votes)
+        return have * 2 > len(self.node_ids)
+
+    def is_empty(self) -> bool:
+        return not self.node_ids
+
+    def to_dict(self) -> List[str]:
+        return sorted(self.node_ids)
+
+    @staticmethod
+    def from_dict(ids) -> "VotingConfiguration":
+        return VotingConfiguration(frozenset(ids))
+
+
+@dataclass(frozen=True)
+class CoordinationMetadata:
+    """Ref: cluster/coordination/CoordinationMetadata.java — term +
+    voting configurations (last committed / last accepted)."""
+
+    term: int = 0
+    last_committed_config: VotingConfiguration = VotingConfiguration()
+    last_accepted_config: VotingConfiguration = VotingConfiguration()
+    voting_config_exclusions: FrozenSet[str] = frozenset()
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "term": self.term,
+            "last_committed_config": self.last_committed_config.to_dict(),
+            "last_accepted_config": self.last_accepted_config.to_dict(),
+            "voting_config_exclusions": sorted(self.voting_config_exclusions),
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "CoordinationMetadata":
+        return CoordinationMetadata(
+            term=d.get("term", 0),
+            last_committed_config=VotingConfiguration.from_dict(
+                d.get("last_committed_config", [])),
+            last_accepted_config=VotingConfiguration.from_dict(
+                d.get("last_accepted_config", [])),
+            voting_config_exclusions=frozenset(
+                d.get("voting_config_exclusions", [])))
+
+
+@dataclass(frozen=True)
+class DiscoveryNodes:
+    """Node membership view (ref: cluster/node/DiscoveryNodes.java)."""
+
+    nodes: Tuple[DiscoveryNode, ...] = ()
+    master_node_id: Optional[str] = None
+    local_node_id: Optional[str] = None
+
+    def get(self, node_id: str) -> Optional[DiscoveryNode]:
+        for n in self.nodes:
+            if n.node_id == node_id:
+                return n
+        return None
+
+    def __contains__(self, node_id: str) -> bool:
+        return self.get(node_id) is not None
+
+    @property
+    def size(self) -> int:
+        return len(self.nodes)
+
+    @property
+    def master_node(self) -> Optional[DiscoveryNode]:
+        return self.get(self.master_node_id) if self.master_node_id else None
+
+    def master_eligible(self) -> List[DiscoveryNode]:
+        return [n for n in self.nodes if n.is_master_eligible()]
+
+    def data_nodes(self) -> List[DiscoveryNode]:
+        return [n for n in self.nodes if n.is_data_node()]
+
+    def with_node(self, node: DiscoveryNode) -> "DiscoveryNodes":
+        others = tuple(n for n in self.nodes if n.node_id != node.node_id)
+        return replace(self, nodes=others + (node,))
+
+    def without_node(self, node_id: str) -> "DiscoveryNodes":
+        return replace(
+            self,
+            nodes=tuple(n for n in self.nodes if n.node_id != node_id),
+            master_node_id=(None if self.master_node_id == node_id
+                            else self.master_node_id))
+
+    def with_master(self, master_node_id: Optional[str]) -> "DiscoveryNodes":
+        return replace(self, master_node_id=master_node_id)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"nodes": [n.to_dict() for n in self.nodes],
+                "master_node_id": self.master_node_id}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "DiscoveryNodes":
+        return DiscoveryNodes(
+            nodes=tuple(DiscoveryNode.from_dict(x)
+                        for x in d.get("nodes", [])),
+            master_node_id=d.get("master_node_id"))
+
+
+@dataclass(frozen=True)
+class IndexMetadata:
+    """Per-index metadata (ref: cluster/metadata/IndexMetadata.java):
+    settings, mappings, shard/replica counts, in-sync allocation ids."""
+
+    index: str
+    uuid: str
+    number_of_shards: int = 1
+    number_of_replicas: int = 0
+    settings: Dict[str, Any] = field(default_factory=dict)
+    mappings: Dict[str, Any] = field(default_factory=dict)
+    state: str = "open"          # open | close
+    version: int = 1
+    # shard_id -> list of allocation ids that are in-sync (ref:
+    # IndexMetadata.inSyncAllocationIds — the set a primary may be
+    # promoted from)
+    in_sync_allocations: Dict[int, List[str]] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "index": self.index, "uuid": self.uuid,
+            "number_of_shards": self.number_of_shards,
+            "number_of_replicas": self.number_of_replicas,
+            "settings": self.settings, "mappings": self.mappings,
+            "state": self.state, "version": self.version,
+            "in_sync_allocations": {str(k): v for k, v in
+                                    self.in_sync_allocations.items()},
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "IndexMetadata":
+        return IndexMetadata(
+            index=d["index"], uuid=d["uuid"],
+            number_of_shards=d.get("number_of_shards", 1),
+            number_of_replicas=d.get("number_of_replicas", 0),
+            settings=d.get("settings", {}), mappings=d.get("mappings", {}),
+            state=d.get("state", "open"), version=d.get("version", 1),
+            in_sync_allocations={int(k): list(v) for k, v in
+                                 d.get("in_sync_allocations", {}).items()})
+
+
+@dataclass(frozen=True)
+class Metadata:
+    """Cluster-wide metadata (ref: cluster/metadata/Metadata.java)."""
+
+    cluster_uuid: str = "_na_"
+    cluster_uuid_committed: bool = False
+    coordination: CoordinationMetadata = CoordinationMetadata()
+    indices: Dict[str, IndexMetadata] = field(default_factory=dict)
+    persistent_settings: Dict[str, Any] = field(default_factory=dict)
+    version: int = 0
+
+    def index(self, name: str) -> Optional[IndexMetadata]:
+        return self.indices.get(name)
+
+    def with_index(self, imd: IndexMetadata) -> "Metadata":
+        indices = dict(self.indices)
+        indices[imd.index] = imd
+        return replace(self, indices=indices, version=self.version + 1)
+
+    def without_index(self, name: str) -> "Metadata":
+        indices = dict(self.indices)
+        indices.pop(name, None)
+        return replace(self, indices=indices, version=self.version + 1)
+
+    def with_coordination(self, coord: CoordinationMetadata) -> "Metadata":
+        return replace(self, coordination=coord)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "cluster_uuid": self.cluster_uuid,
+            "cluster_uuid_committed": self.cluster_uuid_committed,
+            "coordination": self.coordination.to_dict(),
+            "indices": {k: v.to_dict() for k, v in self.indices.items()},
+            "persistent_settings": self.persistent_settings,
+            "version": self.version,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Metadata":
+        return Metadata(
+            cluster_uuid=d.get("cluster_uuid", "_na_"),
+            cluster_uuid_committed=d.get("cluster_uuid_committed", False),
+            coordination=CoordinationMetadata.from_dict(
+                d.get("coordination", {})),
+            indices={k: IndexMetadata.from_dict(v)
+                     for k, v in d.get("indices", {}).items()},
+            persistent_settings=d.get("persistent_settings", {}),
+            version=d.get("version", 0))
+
+
+# ---------------------------------------------------------------- routing
+
+SHARD_UNASSIGNED = "unassigned"
+SHARD_INITIALIZING = "initializing"
+SHARD_STARTED = "started"
+SHARD_RELOCATING = "relocating"
+
+
+@dataclass(frozen=True)
+class ShardRouting:
+    """One shard copy's placement + lifecycle state (ref:
+    cluster/routing/ShardRouting.java — unassigned → initializing →
+    started → relocating)."""
+
+    index: str
+    shard_id: int
+    primary: bool
+    state: str = SHARD_UNASSIGNED
+    current_node_id: Optional[str] = None
+    relocating_node_id: Optional[str] = None
+    allocation_id: Optional[str] = None
+    unassigned_reason: Optional[str] = None
+
+    @property
+    def active(self) -> bool:
+        return self.state in (SHARD_STARTED, SHARD_RELOCATING)
+
+    @property
+    def assigned(self) -> bool:
+        return self.current_node_id is not None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "index": self.index, "shard_id": self.shard_id,
+            "primary": self.primary, "state": self.state,
+            "current_node_id": self.current_node_id,
+            "relocating_node_id": self.relocating_node_id,
+            "allocation_id": self.allocation_id,
+            "unassigned_reason": self.unassigned_reason,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ShardRouting":
+        return ShardRouting(
+            index=d["index"], shard_id=d["shard_id"], primary=d["primary"],
+            state=d.get("state", SHARD_UNASSIGNED),
+            current_node_id=d.get("current_node_id"),
+            relocating_node_id=d.get("relocating_node_id"),
+            allocation_id=d.get("allocation_id"),
+            unassigned_reason=d.get("unassigned_reason"))
+
+
+@dataclass(frozen=True)
+class IndexShardRoutingTable:
+    """All copies of one shard (ref: IndexShardRoutingTable.java)."""
+
+    index: str
+    shard_id: int
+    shards: Tuple[ShardRouting, ...] = ()
+
+    @property
+    def primary(self) -> Optional[ShardRouting]:
+        for s in self.shards:
+            if s.primary:
+                return s
+        return None
+
+    @property
+    def replicas(self) -> List[ShardRouting]:
+        return [s for s in self.shards if not s.primary]
+
+    def active_shards(self) -> List[ShardRouting]:
+        return [s for s in self.shards if s.active]
+
+    def to_dict(self):
+        return {"index": self.index, "shard_id": self.shard_id,
+                "shards": [s.to_dict() for s in self.shards]}
+
+    @staticmethod
+    def from_dict(d) -> "IndexShardRoutingTable":
+        return IndexShardRoutingTable(
+            d["index"], d["shard_id"],
+            tuple(ShardRouting.from_dict(x) for x in d.get("shards", [])))
+
+
+@dataclass(frozen=True)
+class IndexRoutingTable:
+    index: str
+    shards: Dict[int, IndexShardRoutingTable] = field(default_factory=dict)
+
+    def shard(self, shard_id: int) -> Optional[IndexShardRoutingTable]:
+        return self.shards.get(shard_id)
+
+    def all_shards(self) -> List[ShardRouting]:
+        out: List[ShardRouting] = []
+        for t in self.shards.values():
+            out.extend(t.shards)
+        return out
+
+    def to_dict(self):
+        return {"index": self.index,
+                "shards": {str(k): v.to_dict()
+                           for k, v in self.shards.items()}}
+
+    @staticmethod
+    def from_dict(d) -> "IndexRoutingTable":
+        return IndexRoutingTable(
+            d["index"],
+            {int(k): IndexShardRoutingTable.from_dict(v)
+             for k, v in d.get("shards", {}).items()})
+
+
+@dataclass(frozen=True)
+class RoutingTable:
+    """Ref: cluster/routing/RoutingTable.java."""
+
+    indices: Dict[str, IndexRoutingTable] = field(default_factory=dict)
+    version: int = 0
+
+    def index(self, name: str) -> Optional[IndexRoutingTable]:
+        return self.indices.get(name)
+
+    def all_shards(self) -> List[ShardRouting]:
+        out: List[ShardRouting] = []
+        for t in self.indices.values():
+            out.extend(t.all_shards())
+        return out
+
+    def shards_on_node(self, node_id: str) -> List[ShardRouting]:
+        return [s for s in self.all_shards()
+                if s.current_node_id == node_id]
+
+    def with_index(self, irt: IndexRoutingTable) -> "RoutingTable":
+        indices = dict(self.indices)
+        indices[irt.index] = irt
+        return RoutingTable(indices, self.version + 1)
+
+    def without_index(self, name: str) -> "RoutingTable":
+        indices = dict(self.indices)
+        indices.pop(name, None)
+        return RoutingTable(indices, self.version + 1)
+
+    def to_dict(self):
+        return {"indices": {k: v.to_dict()
+                            for k, v in self.indices.items()},
+                "version": self.version}
+
+    @staticmethod
+    def from_dict(d) -> "RoutingTable":
+        return RoutingTable(
+            {k: IndexRoutingTable.from_dict(v)
+             for k, v in d.get("indices", {}).items()},
+            d.get("version", 0))
+
+
+# ----------------------------------------------------------------- blocks
+
+BLOCK_STATE_NOT_RECOVERED = "state-not-recovered"
+BLOCK_NO_MASTER = "no-master"
+BLOCK_INDEX_READ_ONLY = "index-read-only"
+
+
+@dataclass(frozen=True)
+class ClusterBlocks:
+    """Ref: cluster/block/ClusterBlocks.java — global + per-index blocks
+    gate reads/writes/metadata ops."""
+
+    global_blocks: FrozenSet[str] = frozenset()
+    index_blocks: Dict[str, FrozenSet[str]] = field(default_factory=dict)
+
+    def has_global_block(self, block: str) -> bool:
+        return block in self.global_blocks
+
+    def with_global_block(self, block: str) -> "ClusterBlocks":
+        return replace(self,
+                       global_blocks=self.global_blocks | {block})
+
+    def without_global_block(self, block: str) -> "ClusterBlocks":
+        return replace(self,
+                       global_blocks=self.global_blocks - {block})
+
+    def to_dict(self):
+        return {"global": sorted(self.global_blocks),
+                "indices": {k: sorted(v)
+                            for k, v in self.index_blocks.items()}}
+
+    @staticmethod
+    def from_dict(d) -> "ClusterBlocks":
+        return ClusterBlocks(
+            frozenset(d.get("global", [])),
+            {k: frozenset(v) for k, v in d.get("indices", {}).items()})
+
+
+# ------------------------------------------------------------ ClusterState
+
+@dataclass(frozen=True)
+class ClusterState:
+    """The immutable snapshot (ref: cluster/ClusterState.java). ``term``
+    is the master term under which this state was published."""
+
+    cluster_name: str = "elasticsearch-tpu"
+    version: int = 0
+    term: int = 0
+    state_uuid: str = "_na_"
+    nodes: DiscoveryNodes = DiscoveryNodes()
+    metadata: Metadata = Metadata()
+    routing_table: RoutingTable = RoutingTable()
+    blocks: ClusterBlocks = ClusterBlocks()
+
+    def with_(self, **kwargs) -> "ClusterState":
+        return replace(self, **kwargs)
+
+    def incremented(self, state_uuid: str) -> "ClusterState":
+        return replace(self, version=self.version + 1,
+                       state_uuid=state_uuid)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "cluster_name": self.cluster_name,
+            "version": self.version,
+            "term": self.term,
+            "state_uuid": self.state_uuid,
+            "nodes": self.nodes.to_dict(),
+            "metadata": self.metadata.to_dict(),
+            "routing_table": self.routing_table.to_dict(),
+            "blocks": self.blocks.to_dict(),
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ClusterState":
+        return ClusterState(
+            cluster_name=d.get("cluster_name", "elasticsearch-tpu"),
+            version=d.get("version", 0),
+            term=d.get("term", 0),
+            state_uuid=d.get("state_uuid", "_na_"),
+            nodes=DiscoveryNodes.from_dict(d.get("nodes", {})),
+            metadata=Metadata.from_dict(d.get("metadata", {})),
+            routing_table=RoutingTable.from_dict(d.get("routing_table", {})),
+            blocks=ClusterBlocks.from_dict(d.get("blocks", {})))
+
+    # -- diffs (ref: ClusterState.diff / readDiffFrom) --------------------
+
+    def diff_from(self, previous: "ClusterState") -> Dict[str, Any]:
+        """A publishable diff: sections that changed vs `previous`.
+        Receivers apply with `apply_diff`; mismatched base uuid →
+        IncompatibleClusterStateVersionException-style fallback to full
+        state (handled by the publication layer)."""
+        new, old = self.to_dict(), previous.to_dict()
+        sections = {k: v for k, v in new.items()
+                    if old.get(k) != v and k not in
+                    ("version", "term", "state_uuid")}
+        return {
+            "base_uuid": previous.state_uuid,
+            "base_version": previous.version,
+            "version": self.version,
+            "term": self.term,
+            "state_uuid": self.state_uuid,
+            "sections": sections,
+        }
+
+    @staticmethod
+    def apply_diff(previous: "ClusterState",
+                   diff: Dict[str, Any]) -> "ClusterState":
+        if diff["base_uuid"] != previous.state_uuid:
+            raise IncompatibleClusterStateVersionException(
+                f"diff base {diff['base_uuid']} != local "
+                f"{previous.state_uuid}")
+        d = previous.to_dict()
+        d.update(copy.deepcopy(diff["sections"]))
+        d["version"] = diff["version"]
+        d["term"] = diff["term"]
+        d["state_uuid"] = diff["state_uuid"]
+        return ClusterState.from_dict(d)
+
+    def supersedes(self, other: "ClusterState") -> bool:
+        return (self.term, self.version) > (other.term, other.version)
+
+
+class IncompatibleClusterStateVersionException(Exception):
+    pass

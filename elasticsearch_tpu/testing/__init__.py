@@ -1,0 +1,18 @@
+"""Test framework (ships as a component, like the reference's
+test/framework): deterministic simulation harness, disruptable transport,
+linearizability checker (ref: SURVEY.md §4.3)."""
+
+from elasticsearch_tpu.testing.deterministic import (  # noqa: F401
+    BLACKHOLE,
+    CONNECTED,
+    DISCONNECTED,
+    DeterministicTaskQueue,
+    DisruptableTransport,
+    History,
+    RegisterSpec,
+    Scheduler,
+    SequentialSpec,
+    SimNetwork,
+    ThreadedScheduler,
+    check_linearizable,
+)

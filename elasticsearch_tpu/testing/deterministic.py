@@ -1,0 +1,515 @@
+"""Deterministic distributed-simulation harness.
+
+The framework's equivalent of the reference's crown-jewel test tier (ref:
+SURVEY.md §4.3): `DeterministicTaskQueue` (virtual time + seeded task
+interleaving), `DisruptableMockTransport` (drop/delay/partition messages
+per link), and a `LinearizabilityChecker`. Multi-node coordination logic
+runs single-threaded over virtual time, so every schedule is replayable
+from its seed — the practical race detector for this layer (there is no
+TSAN for distributed protocols).
+
+Design: components that must run both in production and under simulation
+depend only on the `Scheduler` protocol (now / schedule / execute) and a
+transport exposing `send_request` / `register_request_handler` — the
+production `TransportService` and the sim transport here are drop-in
+replacements for each other.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from elasticsearch_tpu.transport.transport import (
+    DiscoveryNode,
+    ResponseHandler,
+    TransportChannel,
+)
+
+
+class Scheduler:
+    """Protocol: what coordination-layer components need from time."""
+
+    def now(self) -> float:
+        raise NotImplementedError
+
+    def schedule(self, delay: float, fn: Callable[[], None],
+                 description: str = "") -> "Cancellable":
+        raise NotImplementedError
+
+    def execute(self, fn: Callable[[], None], description: str = "") -> None:
+        self.schedule(0.0, fn, description)
+
+
+class Cancellable:
+    def __init__(self) -> None:
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
+class ThreadedScheduler(Scheduler):
+    """Production scheduler over a single timer thread."""
+
+    def __init__(self) -> None:
+        import threading
+        self._cond = threading.Condition()
+        self._queue: List[Tuple[float, int, Cancellable, Callable]] = []
+        self._seq = itertools.count()
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="scheduler")
+        self._thread.start()
+
+    def now(self) -> float:
+        import time
+        return time.monotonic()
+
+    def schedule(self, delay: float, fn: Callable[[], None],
+                 description: str = "") -> Cancellable:
+        c = Cancellable()
+        with self._cond:
+            heapq.heappush(self._queue,
+                           (self.now() + delay, next(self._seq), c, fn))
+            self._cond.notify()
+        return c
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+                if not self._queue:
+                    self._cond.wait(0.1)
+                    continue
+                when, _seq, c, fn = self._queue[0]
+                wait = when - self.now()
+                if wait > 0:
+                    self._cond.wait(min(wait, 0.1))
+                    continue
+                heapq.heappop(self._queue)
+            if not c.cancelled:
+                try:
+                    fn()
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
+
+    def close(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify()
+
+
+class DeterministicTaskQueue(Scheduler):
+    """Virtual time + seeded execution order (ref:
+    test/framework/.../DeterministicTaskQueue.java).
+
+    Runnable tasks execute in random (seeded) order; deferred tasks become
+    runnable when virtual time is advanced to their execution time.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.random = random.Random(seed)
+        self._now = 0.0
+        self._runnable: List[Tuple[str, Callable]] = []
+        self._deferred: List[Tuple[float, int, Cancellable, str, Callable]] = []
+        self._seq = itertools.count()
+
+    # -- Scheduler --------------------------------------------------------
+
+    def now(self) -> float:
+        return self._now
+
+    def schedule(self, delay: float, fn: Callable[[], None],
+                 description: str = "") -> Cancellable:
+        c = Cancellable()
+        if delay <= 0:
+            self._runnable.append((description, self._guard(c, fn)))
+        else:
+            heapq.heappush(self._deferred,
+                           (self._now + delay, next(self._seq), c,
+                            description, fn))
+        return c
+
+    def _guard(self, c: Cancellable, fn: Callable) -> Callable:
+        def run():
+            if not c.cancelled:
+                fn()
+        return run
+
+    # -- driving ----------------------------------------------------------
+
+    def has_runnable(self) -> bool:
+        return bool(self._runnable)
+
+    def has_deferred(self) -> bool:
+        return bool(self._deferred)
+
+    def run_random_task(self) -> None:
+        i = self.random.randrange(len(self._runnable))
+        _desc, fn = self._runnable.pop(i)
+        fn()
+
+    def advance_time(self) -> None:
+        """Jump virtual time to the next deferred task's time and make all
+        tasks due at that time runnable."""
+        if not self._deferred:
+            return
+        self._now = max(self._now, self._deferred[0][0])
+        while self._deferred and self._deferred[0][0] <= self._now:
+            _when, _seq, c, desc, fn = heapq.heappop(self._deferred)
+            self._runnable.append((desc, self._guard(c, fn)))
+
+    def run_all_runnable(self) -> int:
+        n = 0
+        while self._runnable:
+            self.run_random_task()
+            n += 1
+        return n
+
+    def run_until_idle(self, max_tasks: int = 100_000) -> None:
+        """Run every task, advancing time as needed, until nothing is
+        scheduled (only safe when the system quiesces, e.g. after
+        stabilisation w/ recurring tasks cancelled)."""
+        n = 0
+        while self._runnable or self._deferred:
+            if not self._runnable:
+                self.advance_time()
+            self.run_random_task()
+            n += 1
+            if n > max_tasks:
+                raise AssertionError("task queue did not quiesce")
+
+    def run_for(self, duration: float, max_tasks: int = 500_000) -> None:
+        """Run tasks (in seeded-random order, advancing virtual time) for
+        `duration` virtual seconds."""
+        deadline = self._now + duration
+        n = 0
+        while True:
+            if self._runnable:
+                self.run_random_task()
+                n += 1
+                if n > max_tasks:
+                    raise AssertionError("too many tasks within window")
+            elif self._deferred and self._deferred[0][0] <= deadline:
+                self.advance_time()
+            else:
+                break
+        self._now = deadline
+
+
+# ---------------------------------------------------------------- network
+
+CONNECTED = "connected"
+DISCONNECTED = "disconnected"   # sends fail fast (connection refused)
+BLACKHOLE = "blackhole"         # sends vanish (partition without error)
+
+
+class DisruptableTransport:
+    """Per-node sim transport delivering through a shared
+    DeterministicTaskQueue, with per-link disruption (ref:
+    test/framework/.../DisruptableMockTransport.java).
+
+    API-compatible subset of TransportService: `send_request`,
+    `register_request_handler`, `local_node`, `connect_to_node`.
+    """
+
+    def __init__(self, local_node: DiscoveryNode, network: "SimNetwork"):
+        self.local_node = local_node
+        self.network = network
+        self._handlers: Dict[str, Callable] = {}
+        network.register(self)
+
+    # -- TransportService surface ----------------------------------------
+
+    def register_request_handler(self, action: str, handler: Callable,
+                                 executor: str = "generic") -> None:
+        self._handlers[action] = handler
+
+    def connect_to_node(self, node: DiscoveryNode,
+                        timeout: float = 5.0) -> None:
+        if self.network.link_state(self.local_node, node) != CONNECTED:
+            raise ConnectionError(f"cannot connect to {node.name}")
+
+    def node_connected(self, node: DiscoveryNode) -> bool:
+        return self.network.link_state(self.local_node, node) == CONNECTED
+
+    def send_request(self, node: DiscoveryNode, action: str, request: Any,
+                     handler: ResponseHandler,
+                     timeout: Optional[float] = None) -> None:
+        self.network.deliver(self, node, action, request, handler, timeout)
+
+    def send_request_sync(self, *a, **k):  # pragma: no cover
+        raise AssertionError("sync sends are forbidden under simulation")
+
+    # -- inbound ----------------------------------------------------------
+
+    def handle(self, source: DiscoveryNode, action: str, request: Any,
+               respond: Callable[[Any, bool], None]) -> None:
+        handler = self._handlers.get(action)
+        channel = TransportChannel(respond, action)
+        if handler is None:
+            channel.send_exception(
+                KeyError(f"No handler for action [{action}]"))
+            return
+        try:
+            handler(request, channel, source)
+        except BaseException as e:  # noqa: BLE001 — sim fault barrier
+            channel.send_exception(e)
+
+
+class SimNetwork:
+    """The shared medium: link states + message delivery as tasks.
+
+    Request and response legs are separately subject to the link state at
+    the moment each leg is delivered — exactly the reference semantics
+    (DisruptableMockTransport delivers or drops each message when its
+    task runs).
+    """
+
+    def __init__(self, queue: DeterministicTaskQueue,
+                 min_delay: float = 0.001, max_delay: float = 0.05):
+        self.queue = queue
+        self.transports: Dict[str, DisruptableTransport] = {}
+        self._links: Dict[Tuple[str, str], str] = {}
+        self.min_delay = min_delay
+        self.max_delay = max_delay
+
+    def register(self, t: DisruptableTransport) -> None:
+        self.transports[t.local_node.node_id] = t
+
+    # -- disruption control ----------------------------------------------
+
+    def set_link(self, a: DiscoveryNode, b: DiscoveryNode,
+                 state: str, bidirectional: bool = True) -> None:
+        self._links[(a.node_id, b.node_id)] = state
+        if bidirectional:
+            self._links[(b.node_id, a.node_id)] = state
+
+    def partition(self, group_a: List[DiscoveryNode],
+                  group_b: List[DiscoveryNode],
+                  mode: str = DISCONNECTED) -> None:
+        for a in group_a:
+            for b in group_b:
+                self.set_link(a, b, mode)
+
+    def isolate(self, node: DiscoveryNode, others: List[DiscoveryNode],
+                mode: str = BLACKHOLE) -> None:
+        self.partition([node],
+                       [o for o in others if o.node_id != node.node_id],
+                       mode)
+
+    def heal(self) -> None:
+        self._links.clear()
+
+    def link_state(self, a: DiscoveryNode, b: DiscoveryNode) -> str:
+        if a.node_id == b.node_id:
+            return CONNECTED
+        return self._links.get((a.node_id, b.node_id), CONNECTED)
+
+    def _delay(self) -> float:
+        return self.queue.random.uniform(self.min_delay, self.max_delay)
+
+    # -- delivery ---------------------------------------------------------
+
+    def deliver(self, sender: DisruptableTransport, dest: DiscoveryNode,
+                action: str, request: Any, handler: ResponseHandler,
+                timeout: Optional[float]) -> None:
+        src = sender.local_node
+        completed = {"done": False}
+
+        def complete_ok(resp):
+            if not completed["done"]:
+                completed["done"] = True
+                handler.on_response(resp)
+
+        def complete_err(exc):
+            if not completed["done"]:
+                completed["done"] = True
+                handler.on_failure(exc)
+
+        if timeout is not None:
+            self.queue.schedule(
+                timeout,
+                lambda: complete_err(
+                    TimeoutError(f"[{dest.name}][{action}] timed out")),
+                f"timeout {action}->{dest.name}")
+
+        def request_leg():
+            state = self.link_state(src, dest)
+            target = self.transports.get(dest.node_id)
+            if state == BLACKHOLE or target is None:
+                return  # vanishes; only the timeout can complete it
+            if state == DISCONNECTED:
+                self.queue.schedule(
+                    0, lambda: complete_err(
+                        ConnectionError(f"[{dest.name}] disconnected")),
+                    f"connect-fail {action}")
+                return
+
+            def respond(payload: Any, is_error: bool) -> None:
+                def response_leg():
+                    # response leg checks the reverse link at its own
+                    # delivery time
+                    if self.link_state(dest, src) != CONNECTED:
+                        return
+                    if is_error:
+                        complete_err(SimRemoteException(str(payload)))
+                    else:
+                        complete_ok(payload)
+                self.queue.schedule(self._delay(), response_leg,
+                                    f"response {action} {dest.name}->{src.name}")
+
+            target.handle(src, action, request, respond)
+
+        self.queue.schedule(self._delay(), request_leg,
+                            f"request {action} {src.name}->{dest.name}")
+
+
+class SimRemoteException(Exception):
+    pass
+
+
+# ------------------------------------------------- linearizability checker
+
+@dataclass
+class HistoryEvent:
+    kind: str          # "invoke" | "response"
+    process: int
+    op_id: int
+    value: Any = None
+
+
+class History:
+    """Record of concurrent invocations/responses (ref:
+    LinearizabilityChecker.History)."""
+
+    def __init__(self) -> None:
+        self.events: List[HistoryEvent] = []
+        self._next_op = itertools.count()
+
+    def invoke(self, process: int, value: Any) -> int:
+        op = next(self._next_op)
+        self.events.append(HistoryEvent("invoke", process, op, value))
+        return op
+
+    def respond(self, process: int, op_id: int, value: Any) -> None:
+        self.events.append(HistoryEvent("response", process, op_id, value))
+
+    def complete_pending(self, infer: Callable[[Any], Any]) -> None:
+        """Close any open invocations with an inferred response (the
+        checker may also simply drop them if None is returned)."""
+        responded = {e.op_id for e in self.events if e.kind == "response"}
+        for e in list(self.events):
+            if e.kind == "invoke" and e.op_id not in responded:
+                self.respond(e.process, e.op_id, infer(e.value))
+
+
+def check_linearizable(sequential_spec: "SequentialSpec",
+                       history: History,
+                       max_states: int = 2_000_000) -> bool:
+    """Wing & Gong / Lowe-style search (ref:
+    LinearizabilityChecker.java:53,230): try all valid permutations of
+    concurrent ops against the sequential spec, memoising visited
+    (linearized-set, state) pairs."""
+    ops: Dict[int, Tuple[Any, Any]] = {}
+    order: List[int] = []
+    responded: Set[int] = set()
+    for e in history.events:
+        if e.kind == "invoke":
+            ops[e.op_id] = (e.value, None)
+            order.append(e.op_id)
+        else:
+            inp = ops[e.op_id][0]
+            ops[e.op_id] = (inp, e.value)
+            responded.add(e.op_id)
+    # drop ops that never responded — a dropped op may or may not have
+    # taken effect; to stay sound the caller should infer responses for
+    # writes that might have been applied (complete_pending)
+    order = [o for o in order if o in responded]
+
+    # intervals: op -> (invoke_index, response_index)
+    inv_i: Dict[int, int] = {}
+    res_i: Dict[int, int] = {}
+    for i, e in enumerate(history.events):
+        if e.op_id not in responded:
+            continue
+        if e.kind == "invoke":
+            inv_i[e.op_id] = i
+        else:
+            res_i[e.op_id] = i
+
+    init = sequential_spec.initial_state()
+    seen: Set[Tuple[FrozenSetLike, Any]] = set()
+    states_explored = 0
+
+    def minimal_response_index(pending: List[int]) -> int:
+        return min(res_i[o] for o in pending) if pending else -1
+
+    def search(linearized: frozenset, state: Any) -> bool:
+        nonlocal states_explored
+        states_explored += 1
+        if states_explored > max_states:
+            raise AssertionError("linearizability search exploded")
+        remaining = [o for o in order if o not in linearized]
+        if not remaining:
+            return True
+        key = (linearized, sequential_spec.fingerprint(state))
+        if key in seen:
+            return False
+        seen.add(key)
+        # an op is a candidate next linearization point iff its invocation
+        # precedes the earliest response among remaining ops (no completed
+        # op may be reordered after one that responded before it started)
+        first_res = minimal_response_index(remaining)
+        for op in remaining:
+            if inv_i[op] > first_res:
+                continue
+            inp, outp = ops[op]
+            legal, nxt = sequential_spec.apply(state, inp, outp)
+            if not legal:
+                continue
+            if search(linearized | {op}, nxt):
+                return True
+        return False
+
+    return search(frozenset(), init)
+
+
+FrozenSetLike = frozenset
+
+
+class SequentialSpec:
+    """Sequential datatype spec for the checker."""
+
+    def initial_state(self) -> Any:
+        raise NotImplementedError
+
+    def apply(self, state: Any, inp: Any, outp: Any) -> Tuple[bool, Any]:
+        """Return (legal, next_state): whether (inp → outp) is a legal
+        transition from `state`, and the state after it."""
+        raise NotImplementedError
+
+    def fingerprint(self, state: Any) -> Any:
+        return state
+
+
+class RegisterSpec(SequentialSpec):
+    """A single read/write register (what the reference checks cluster
+    state against). Ops: ("write", v) → "ok"; ("read", None) → v."""
+
+    def initial_state(self):
+        return None
+
+    def apply(self, state, inp, outp):
+        kind, val = inp
+        if kind == "write":
+            return (outp in ("ok", None, "maybe"), val)
+        if kind == "read":
+            return (outp == state, state)
+        return (False, state)

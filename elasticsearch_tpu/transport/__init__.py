@@ -1,0 +1,31 @@
+"""Distributed communication backend (DCN control plane).
+
+Framed async RPC with action dispatch, QoS lanes, versioned handshakes,
+and task management (ref: server transport/ + tasks/, SURVEY.md §5.8).
+The data plane — sharded scoring + collective top-k merges — rides XLA
+collectives in ``parallel/``; this package moves control messages:
+coordination, replication, query/fetch.
+"""
+
+from elasticsearch_tpu.transport.transport import (  # noqa: F401
+    CURRENT_VERSION,
+    ConnectTransportException,
+    DiscoveryNode,
+    InProcessTransport,
+    NodeNotConnectedException,
+    ReceiveTimeoutTransportException,
+    RemoteTransportException,
+    ResponseHandler,
+    TcpTransport,
+    TransportChannel,
+    TransportService,
+    make_inprocess_cluster_registry,
+    new_node_id,
+)
+from elasticsearch_tpu.transport.tasks import (  # noqa: F401
+    CancellableTask,
+    Task,
+    TaskCancelledException,
+    TaskId,
+    TaskManager,
+)

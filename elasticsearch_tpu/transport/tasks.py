@@ -1,0 +1,208 @@
+"""Task management: every action execution is a registered task.
+
+Ref: tasks/TaskManager.java:76,121,143-163 — every transport action
+registers a Task; tasks form a parent/child tree across nodes; cancellable
+tasks support cooperative cancellation with ban propagation (a cancelled
+parent bans its id so late-arriving children are cancelled on arrival);
+`_tasks` list/cancel APIs sit on top.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class TaskId:
+    node_id: str
+    id: int
+
+    def __str__(self) -> str:
+        return f"{self.node_id}:{self.id}"
+
+    @staticmethod
+    def parse(s: str) -> "TaskId":
+        node, _, num = s.rpartition(":")
+        return TaskId(node, int(num))
+
+
+EMPTY_TASK_ID = TaskId("", -1)
+
+
+class Task:
+    def __init__(self, task_id: int, type_: str, action: str,
+                 description: str = "",
+                 parent_task_id: TaskId = EMPTY_TASK_ID):
+        self.id = task_id
+        self.type = type_
+        self.action = action
+        self.description = description
+        self.parent_task_id = parent_task_id
+        self.start_time = time.time()
+        self.start_nanos = time.monotonic_ns()
+
+    def running_time_nanos(self) -> int:
+        return time.monotonic_ns() - self.start_nanos
+
+    def to_dict(self, node_id: str) -> Dict[str, Any]:
+        d = {
+            "node": node_id,
+            "id": self.id,
+            "type": self.type,
+            "action": self.action,
+            "description": self.description,
+            "start_time_in_millis": int(self.start_time * 1000),
+            "running_time_in_nanos": self.running_time_nanos(),
+            "cancellable": isinstance(self, CancellableTask),
+        }
+        if self.parent_task_id is not EMPTY_TASK_ID and \
+                self.parent_task_id.id != -1:
+            d["parent_task_id"] = str(self.parent_task_id)
+        if isinstance(self, CancellableTask):
+            d["cancelled"] = self.is_cancelled()
+        return d
+
+
+class TaskCancelledException(Exception):
+    pass
+
+
+class CancellableTask(Task):
+    """Cooperative cancellation: long-running work polls
+    ``ensure_not_cancelled()`` (ref: CancellableTask.java)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._cancelled = threading.Event()
+        self._reason: Optional[str] = None
+        self._listeners: List[Callable[[], None]] = []
+        self._lock = threading.Lock()
+
+    def cancel(self, reason: str = "by user request") -> None:
+        with self._lock:
+            if self._cancelled.is_set():
+                return
+            self._reason = reason
+            self._cancelled.set()
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn()
+            except Exception:
+                pass
+
+    def add_cancellation_listener(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            if self._cancelled.is_set():
+                run_now = True
+            else:
+                self._listeners.append(fn)
+                run_now = False
+        if run_now:
+            fn()
+
+    def is_cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def cancellation_reason(self) -> Optional[str]:
+        return self._reason
+
+    def ensure_not_cancelled(self) -> None:
+        if self.is_cancelled():
+            raise TaskCancelledException(
+                f"task cancelled [{self._reason}]")
+
+
+class TaskManager:
+    """Per-node live-task registry + cancellation bans (ref:
+    TaskManager.register / cancelTaskAndDescendants / setBan)."""
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._tasks: Dict[int, Task] = {}
+        # banned parent ids: children arriving after the ban are cancelled
+        # immediately (ref: TaskManager bans + ban propagation RPCs)
+        self._bans: Dict[TaskId, str] = {}
+
+    def register(self, type_: str, action: str, description: str = "",
+                 parent_task_id: TaskId = EMPTY_TASK_ID,
+                 cancellable: bool = False) -> Task:
+        with self._lock:
+            self._seq += 1
+            cls = CancellableTask if cancellable else Task
+            task = cls(self._seq, type_, action, description, parent_task_id)
+            self._tasks[task.id] = task
+            ban_reason = self._bans.get(parent_task_id)
+        if ban_reason is not None and isinstance(task, CancellableTask):
+            task.cancel(f"parent banned [{ban_reason}]")
+        return task
+
+    def unregister(self, task: Task) -> None:
+        with self._lock:
+            self._tasks.pop(task.id, None)
+            # the ban (if any) dies with the task (ref: TaskManager
+            # removes bans when the parent unregisters)
+            self._bans.pop(TaskId(self.node_id, task.id), None)
+
+    def get_task(self, task_id: int) -> Optional[Task]:
+        with self._lock:
+            return self._tasks.get(task_id)
+
+    def list_tasks(self, actions: Optional[str] = None) -> List[Task]:
+        with self._lock:
+            tasks = list(self._tasks.values())
+        if actions:
+            prefix = actions.rstrip("*")
+            tasks = [t for t in tasks if t.action.startswith(prefix)]
+        return tasks
+
+    def cancel(self, task: CancellableTask, reason: str,
+               ban_children: bool = True) -> None:
+        task.cancel(reason)
+        if ban_children:
+            self.set_ban(TaskId(self.node_id, task.id), reason)
+            # cancel already-registered local descendants
+            for child in self._children_of(TaskId(self.node_id, task.id)):
+                if isinstance(child, CancellableTask):
+                    self.cancel(child, reason, ban_children=True)
+
+    def set_ban(self, parent: TaskId, reason: str) -> None:
+        with self._lock:
+            self._bans[parent] = reason
+
+    def remove_ban(self, parent: TaskId) -> None:
+        with self._lock:
+            self._bans.pop(parent, None)
+
+    def _children_of(self, parent: TaskId) -> List[Task]:
+        with self._lock:
+            return [t for t in self._tasks.values()
+                    if t.parent_task_id == parent]
+
+    def task_scope(self, type_: str, action: str, description: str = "",
+                   parent_task_id: TaskId = EMPTY_TASK_ID,
+                   cancellable: bool = False) -> "_TaskScope":
+        return _TaskScope(self, type_, action, description, parent_task_id,
+                          cancellable)
+
+
+class _TaskScope:
+    def __init__(self, manager: TaskManager, type_: str, action: str,
+                 description: str, parent: TaskId, cancellable: bool):
+        self._manager = manager
+        self._args = (type_, action, description, parent, cancellable)
+        self.task: Optional[Task] = None
+
+    def __enter__(self) -> Task:
+        t, a, d, p, c = self._args
+        self.task = self._manager.register(t, a, d, p, c)
+        return self.task
+
+    def __exit__(self, *exc) -> None:
+        if self.task is not None:
+            self._manager.unregister(self.task)

@@ -1,0 +1,159 @@
+"""Binary wire format: vint-based streams.
+
+The framework's `StreamOutput`/`StreamInput` analogue (ref:
+common/io/stream/StreamOutput.java — variable-length ints, length-prefixed
+strings, versioned payloads). Used by the transport frame codec and by
+anything that needs a compact, stable binary encoding (translog already
+has its own record format; cluster-state persistence and RPC payloads use
+this one).
+
+Payloads on the wire are JSON-in-binary by default (`write_obj`) — the
+framework's requests/responses are dict-shaped like the REST layer — but
+the primitive codecs here keep hot structures (docid arrays, checkpoints)
+compact when needed.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Optional
+
+
+class StreamOutput:
+    """Append-only binary buffer with vint/zigzag/string codecs."""
+
+    def __init__(self) -> None:
+        self._parts: list = []
+
+    def bytes(self) -> bytes:
+        return b"".join(self._parts)
+
+    def write_byte(self, b: int) -> None:
+        self._parts.append(struct.pack("B", b & 0xFF))
+
+    def write_bytes(self, data: bytes) -> None:
+        self._parts.append(data)
+
+    def write_vint(self, value: int) -> None:
+        """Unsigned LEB128 (ref: StreamOutput.writeVInt)."""
+        if value < 0:
+            raise ValueError(f"vint must be >= 0, got {value}")
+        out = bytearray()
+        while value >= 0x80:
+            out.append((value & 0x7F) | 0x80)
+            value >>= 7
+        out.append(value)
+        self._parts.append(bytes(out))
+
+    def write_zlong(self, value: int) -> None:
+        """Zigzag-encoded signed long (ref: StreamOutput.writeZLong).
+        Python's arbitrary-precision arithmetic shift makes the classic
+        ``(v << 1) ^ (v >> 63)`` zigzag identity hold for any int."""
+        self.write_vint((value << 1) ^ (value >> 63))
+
+    def write_long(self, value: int) -> None:
+        self._parts.append(struct.pack(">q", value))
+
+    def write_int(self, value: int) -> None:
+        self._parts.append(struct.pack(">i", value))
+
+    def write_double(self, value: float) -> None:
+        self._parts.append(struct.pack(">d", value))
+
+    def write_bool(self, value: bool) -> None:
+        self.write_byte(1 if value else 0)
+
+    def write_string(self, value: str) -> None:
+        data = value.encode("utf-8")
+        self.write_vint(len(data))
+        self._parts.append(data)
+
+    def write_optional_string(self, value: Optional[str]) -> None:
+        if value is None:
+            self.write_bool(False)
+        else:
+            self.write_bool(True)
+            self.write_string(value)
+
+    def write_len_bytes(self, data: bytes) -> None:
+        self.write_vint(len(data))
+        self._parts.append(data)
+
+    def write_obj(self, obj: Any) -> None:
+        """JSON-serializable payload, length-prefixed."""
+        self.write_len_bytes(json.dumps(obj, separators=(",", ":"),
+                                        default=_json_default).encode("utf-8"))
+
+
+def _json_default(o):
+    # numpy scalars/arrays show up in responses; coerce to plain python
+    tolist = getattr(o, "tolist", None)
+    if tolist is not None:
+        return tolist()
+    item = getattr(o, "item", None)
+    if item is not None:
+        return item()
+    raise TypeError(f"not JSON serializable: {type(o)!r}")
+
+
+class StreamInput:
+    """Cursor over a bytes buffer, mirroring StreamOutput."""
+
+    def __init__(self, data: bytes, pos: int = 0) -> None:
+        self._data = data
+        self._pos = pos
+
+    def remaining(self) -> int:
+        return len(self._data) - self._pos
+
+    def read_byte(self) -> int:
+        v = self._data[self._pos]
+        self._pos += 1
+        return v
+
+    def read_bytes(self, n: int) -> bytes:
+        v = self._data[self._pos:self._pos + n]
+        if len(v) != n:
+            raise EOFError(f"need {n} bytes, have {len(v)}")
+        self._pos += n
+        return v
+
+    def read_vint(self) -> int:
+        result = 0
+        shift = 0
+        while True:
+            b = self.read_byte()
+            result |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return result
+            shift += 7
+
+    def read_zlong(self) -> int:
+        v = self.read_vint()
+        return (v >> 1) ^ -(v & 1)
+
+    def read_long(self) -> int:
+        return struct.unpack(">q", self.read_bytes(8))[0]
+
+    def read_int(self) -> int:
+        return struct.unpack(">i", self.read_bytes(4))[0]
+
+    def read_double(self) -> float:
+        return struct.unpack(">d", self.read_bytes(8))[0]
+
+    def read_bool(self) -> bool:
+        return self.read_byte() != 0
+
+    def read_string(self) -> str:
+        n = self.read_vint()
+        return self.read_bytes(n).decode("utf-8")
+
+    def read_optional_string(self) -> Optional[str]:
+        return self.read_string() if self.read_bool() else None
+
+    def read_len_bytes(self) -> bytes:
+        return self.read_bytes(self.read_vint())
+
+    def read_obj(self) -> Any:
+        return json.loads(self.read_len_bytes().decode("utf-8"))

@@ -1,0 +1,317 @@
+"""Cluster coordination: CoordinationState safety unit tests +
+deterministic multi-node simulation with disruptions (ref strategy:
+CoordinationStateTests + AbstractCoordinatorTestCase.runRandomly/
+stabilise over DisruptableMockTransport, SURVEY.md §4.3)."""
+
+import pytest
+
+from elasticsearch_tpu.cluster.coordination import (
+    MODE_CANDIDATE,
+    MODE_FOLLOWER,
+    MODE_LEADER,
+    CoordinationState,
+    CoordinationStateRejectedException,
+    Coordinator,
+    Join,
+    PersistedState,
+)
+from elasticsearch_tpu.cluster.state import (
+    ClusterState,
+    CoordinationMetadata,
+    DiscoveryNodes,
+    Metadata,
+    VotingConfiguration,
+)
+from elasticsearch_tpu.testing.deterministic import (
+    BLACKHOLE,
+    DISCONNECTED,
+    DeterministicTaskQueue,
+    DisruptableTransport,
+    SimNetwork,
+)
+from elasticsearch_tpu.transport.transport import DiscoveryNode
+
+
+def make_node(i):
+    return DiscoveryNode(node_id=f"node-{i}", name=f"n{i}")
+
+
+def bootstrapped_state(nodes, config_ids):
+    config = VotingConfiguration(frozenset(config_ids))
+    return ClusterState(
+        version=0, term=0, state_uuid="init",
+        nodes=DiscoveryNodes(tuple(nodes)),
+        metadata=Metadata(coordination=CoordinationMetadata(
+            term=0, last_committed_config=config,
+            last_accepted_config=config)))
+
+
+# ------------------------------------------------ CoordinationState unit
+
+class TestCoordinationState:
+    def setup_method(self):
+        self.n = [make_node(i) for i in range(3)]
+        init = bootstrapped_state(self.n, [n.node_id for n in self.n])
+        self.states = {
+            n.node_id: CoordinationState(n, PersistedState(0, init))
+            for n in self.n}
+
+    def test_start_join_bumps_term_once(self):
+        s = self.states["node-0"]
+        join = s.handle_start_join(self.n[0], 1)
+        assert s.current_term() == 1
+        assert join.term == 1
+        with pytest.raises(CoordinationStateRejectedException):
+            s.handle_start_join(self.n[0], 1)  # same term again
+
+    def test_election_needs_quorum(self):
+        s0 = self.states["node-0"]
+        j0 = s0.handle_start_join(self.n[0], 1)
+        assert s0.handle_join(j0) is False  # 1/3 votes
+        assert not s0.election_won
+        j1 = self.states["node-1"].handle_start_join(self.n[0], 1)
+        assert s0.handle_join(j1) is True   # 2/3 → won
+        assert s0.election_won
+
+    def test_join_with_newer_accepted_state_rejected(self):
+        # node-1 accepts a state at (term 1, v 5); node-0 stays at v0.
+        s1 = self.states["node-1"]
+        s1.handle_start_join(self.n[1], 1)
+        newer = bootstrapped_state(
+            self.n, [n.node_id for n in self.n]).with_(term=1, version=5)
+        s1.handle_publish_request(newer)
+        # new election at term 2: node-1's join reports (1, 5)
+        s0 = self.states["node-0"]
+        s0.handle_start_join(self.n[0], 2)
+        j1 = s1.handle_start_join(self.n[0], 2)
+        assert (j1.last_accepted_term, j1.last_accepted_version) == (1, 5)
+        with pytest.raises(CoordinationStateRejectedException,
+                           match="newer"):
+            s0.handle_join(j1)
+
+    def _elect(self, s, term):
+        for nid in list(self.states):
+            node = next(n for n in self.n if n.node_id == nid)
+            j = self.states[nid].handle_start_join(s.local_node, term) \
+                if nid != s.local_node.node_id else \
+                s.handle_start_join(s.local_node, term)
+            try:
+                s.handle_join(j)
+            except CoordinationStateRejectedException:
+                pass
+        assert s.election_won
+
+    def test_publish_commit_roundtrip(self):
+        s0 = self.states["node-0"]
+        self._elect(s0, 1)
+        new = s0.last_accepted_state().with_(term=1, version=1,
+                                             state_uuid="v1")
+        s0.handle_client_value(new)
+        # self-accept + one other accept → quorum
+        r0 = s0.handle_publish_request(new)
+        assert s0.handle_publish_response("node-0", **{
+            "term": r0["term"], "version": r0["version"]}) is False
+        r1 = self.states["node-1"].handle_publish_request(new)
+        assert s0.handle_publish_response("node-1", r1["term"],
+                                          r1["version"]) is True
+        committed = self.states["node-1"].handle_commit(1, 1)
+        assert committed.version == 1
+
+    def test_commit_of_wrong_version_rejected(self):
+        s0 = self.states["node-0"]
+        self._elect(s0, 1)
+        new = s0.last_accepted_state().with_(term=1, version=1,
+                                             state_uuid="v1")
+        s0.handle_client_value(new)
+        s0.handle_publish_request(new)
+        with pytest.raises(CoordinationStateRejectedException):
+            s0.handle_commit(1, 2)
+
+    def test_stale_term_publish_rejected(self):
+        s1 = self.states["node-1"]
+        s1.handle_start_join(self.n[1], 5)
+        stale = s1.last_accepted_state().with_(term=3, version=1)
+        with pytest.raises(CoordinationStateRejectedException):
+            s1.handle_publish_request(stale)
+
+    def test_cannot_publish_without_election(self):
+        s0 = self.states["node-0"]
+        s0.handle_start_join(self.n[0], 1)
+        new = s0.last_accepted_state().with_(term=1, version=1)
+        with pytest.raises(CoordinationStateRejectedException):
+            s0.handle_client_value(new)
+
+
+# ----------------------------------------------------- simulated cluster
+
+class SimCluster:
+    """N coordinators over a deterministic network (the
+    AbstractCoordinatorTestCase.Cluster analogue)."""
+
+    def __init__(self, n_nodes, seed=0):
+        self.queue = DeterministicTaskQueue(seed=seed)
+        self.network = SimNetwork(self.queue)
+        self.nodes = [make_node(i) for i in range(n_nodes)]
+        self.coordinators = {}
+        self.applied = {}
+        names = [n.name for n in self.nodes]
+        for node in self.nodes:
+            transport = DisruptableTransport(node, self.network)
+            apply_log = []
+            self.applied[node.node_id] = apply_log
+            coord = Coordinator(
+                transport, self.queue,
+                seed_nodes=self.nodes,
+                initial_master_nodes=names,
+                on_committed_state=(
+                    lambda s, log=apply_log: log.append(s)),
+                rng=self.queue.random)
+            self.coordinators[node.node_id] = coord
+        for c in self.coordinators.values():
+            c.start()
+
+    def run_for(self, seconds):
+        self.queue.run_for(seconds)
+
+    def leaders(self):
+        return [c for c in self.coordinators.values()
+                if c.mode == MODE_LEADER]
+
+    def stabilise(self, seconds=60):
+        self.run_for(seconds)
+        leaders = self.leaders()
+        assert len(leaders) == 1, \
+            f"expected one leader, got {[c.local_node.name for c in leaders]}"
+        return leaders[0]
+
+    def coordinator(self, node):
+        return self.coordinators[node.node_id]
+
+
+def test_single_node_cluster_elects_itself():
+    cluster = SimCluster(1, seed=42)
+    leader = cluster.stabilise(30)
+    assert leader.applied_state.nodes.master_node_id == \
+        leader.local_node.node_id
+
+
+@pytest.mark.parametrize("seed", [0, 1, 7])
+def test_three_node_cluster_elects_leader_and_converges(seed):
+    cluster = SimCluster(3, seed=seed)
+    leader = cluster.stabilise()
+    # all nodes joined the cluster state and agree on the master
+    state = leader.applied_state
+    assert state.nodes.size == 3
+    for c in cluster.coordinators.values():
+        assert c.applied_state.nodes.master_node_id == \
+            leader.local_node.node_id
+        assert c.applied_state.version == state.version
+        assert c.mode in (MODE_LEADER, MODE_FOLLOWER)
+
+
+def test_publication_reaches_all_nodes():
+    cluster = SimCluster(3, seed=3)
+    leader = cluster.stabilise()
+    results = []
+    leader.submit_state_update(
+        "test-update",
+        lambda s: s.with_(metadata=s.metadata.with_index(
+            __import__("elasticsearch_tpu.cluster.state",
+                       fromlist=["IndexMetadata"]).IndexMetadata(
+                index="idx", uuid="u1", number_of_shards=2))),
+        on_done=results.append)
+    cluster.run_for(10)
+    assert results == [None]
+    for c in cluster.coordinators.values():
+        assert c.applied_state.metadata.index("idx") is not None
+
+
+def test_leader_isolation_triggers_failover_and_step_down():
+    cluster = SimCluster(3, seed=11)
+    leader = cluster.stabilise()
+    others = [n for n in cluster.nodes
+              if n.node_id != leader.local_node.node_id]
+    # blackhole the leader from the rest
+    cluster.network.isolate(leader.local_node, cluster.nodes,
+                            mode=BLACKHOLE)
+    cluster.run_for(120)
+    new_leaders = [c for c in cluster.leaders()
+                   if c.local_node.node_id != leader.local_node.node_id]
+    assert len(new_leaders) == 1, "majority side must elect a new leader"
+    new_leader = new_leaders[0]
+    # old leader must have stepped down (lost its followers)
+    assert leader.mode != MODE_LEADER
+    # majority-side nodes agree
+    for n in others:
+        c = cluster.coordinator(n)
+        assert c.applied_state.nodes.master_node_id == \
+            new_leader.local_node.node_id
+    # heal: old leader rejoins as follower
+    cluster.network.heal()
+    cluster.run_for(60)
+    assert leader.mode == MODE_FOLLOWER
+    assert leader.applied_state.nodes.master_node_id == \
+        new_leader.local_node.node_id
+
+
+def test_minority_partition_cannot_elect():
+    cluster = SimCluster(5, seed=5)
+    leader = cluster.stabilise()
+    # partition 2 nodes (minority) away, including the leader
+    minority = [leader.local_node]
+    for n in cluster.nodes:
+        if n.node_id != leader.local_node.node_id:
+            minority.append(n)
+            break
+    majority = [n for n in cluster.nodes if n not in minority]
+    cluster.network.partition(minority, majority, mode=DISCONNECTED)
+    cluster.run_for(120)
+    minority_leaders = [c for c in cluster.leaders()
+                        if c.local_node in minority]
+    majority_leaders = [c for c in cluster.leaders()
+                        if c.local_node in majority]
+    assert len(majority_leaders) == 1
+    assert minority_leaders == []
+
+
+def test_node_disconnect_removed_from_cluster_and_rejoins():
+    cluster = SimCluster(3, seed=9)
+    leader = cluster.stabilise()
+    victim = next(n for n in cluster.nodes
+                  if n.node_id != leader.local_node.node_id)
+    cluster.network.isolate(victim, cluster.nodes, mode=DISCONNECTED)
+    cluster.run_for(60)
+    assert victim.node_id not in leader.applied_state.nodes
+    # still a working cluster of 2
+    assert len(cluster.leaders()) == 1
+    # heal: the removed node must rejoin even though the leader's term
+    # never changed (equal-term membership join path)
+    cluster.network.heal()
+    cluster.run_for(60)
+    assert victim.node_id in leader.applied_state.nodes
+    assert cluster.coordinator(victim).mode == MODE_FOLLOWER
+
+
+def test_run_randomly_then_stabilise():
+    """The reference's runRandomly(): random disruptions + heals, then
+    stabilise and assert convergence (safety under chaos)."""
+    cluster = SimCluster(3, seed=13)
+    cluster.run_for(20)
+    rng = cluster.queue.random
+    for _ in range(6):
+        a = rng.choice(cluster.nodes)
+        mode = rng.choice([BLACKHOLE, DISCONNECTED])
+        cluster.network.isolate(a, cluster.nodes, mode=mode)
+        cluster.run_for(rng.uniform(5, 30))
+        cluster.network.heal()
+        cluster.run_for(rng.uniform(5, 30))
+    cluster.network.heal()
+    leader = cluster.stabilise(240)
+    state = leader.applied_state
+    # convergence: every node that is in the cluster applies the same state
+    for c in cluster.coordinators.values():
+        if c.local_node.node_id in state.nodes:
+            assert c.applied_state.version == state.version, \
+                f"{c.local_node.name} at v{c.applied_state.version} != " \
+                f"v{state.version}"
+            assert c.applied_state.state_uuid == state.state_uuid

@@ -1,0 +1,319 @@
+"""Transport layer: wire format, RPC dispatch, handshake, timeouts,
+QoS lanes, task manager (ref strategy: the reference unit-tests actions
+over CapturingTransport/MockTransportService without sockets, and the
+TCP stack with real loopback sockets — both covered here)."""
+
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.transport import (
+    ConnectTransportException,
+    DiscoveryNode,
+    InProcessTransport,
+    ReceiveTimeoutTransportException,
+    RemoteTransportException,
+    ResponseHandler,
+    TcpTransport,
+    TransportService,
+    make_inprocess_cluster_registry,
+)
+from elasticsearch_tpu.transport.tasks import (
+    CancellableTask,
+    TaskCancelledException,
+    TaskId,
+    TaskManager,
+)
+from elasticsearch_tpu.transport.transport import (
+    LANE_BULK,
+    LANE_RECOVERY,
+    LANE_REG,
+    LANE_STATE,
+    lane_for_action,
+)
+from elasticsearch_tpu.transport.wire import StreamInput, StreamOutput
+
+
+# ---------------------------------------------------------------- wire
+
+def test_wire_roundtrip_primitives():
+    out = StreamOutput()
+    out.write_vint(0)
+    out.write_vint(127)
+    out.write_vint(128)
+    out.write_vint(3_000_000_000)
+    out.write_zlong(-1)
+    out.write_zlong(12345)
+    out.write_zlong(-(2 ** 40))
+    out.write_long(-42)
+    out.write_double(3.5)
+    out.write_bool(True)
+    out.write_string("héllo wörld")
+    out.write_optional_string(None)
+    out.write_optional_string("x")
+    out.write_obj({"a": [1, 2, {"b": None}]})
+    sin = StreamInput(out.bytes())
+    assert sin.read_vint() == 0
+    assert sin.read_vint() == 127
+    assert sin.read_vint() == 128
+    assert sin.read_vint() == 3_000_000_000
+    assert sin.read_zlong() == -1
+    assert sin.read_zlong() == 12345
+    assert sin.read_zlong() == -(2 ** 40)
+    assert sin.read_long() == -42
+    assert sin.read_double() == 3.5
+    assert sin.read_bool() is True
+    assert sin.read_string() == "héllo wörld"
+    assert sin.read_optional_string() is None
+    assert sin.read_optional_string() == "x"
+    assert sin.read_obj() == {"a": [1, 2, {"b": None}]}
+    assert sin.remaining() == 0
+
+
+def test_wire_numpy_coercion():
+    import numpy as np
+    out = StreamOutput()
+    out.write_obj({"v": np.int32(7), "a": np.arange(3)})
+    assert StreamInput(out.bytes()).read_obj() == {"v": 7, "a": [0, 1, 2]}
+
+
+# ------------------------------------------------- in-process transport
+
+@pytest.fixture()
+def pair():
+    registry = make_inprocess_cluster_registry()
+    nodes = []
+    services = []
+    for i in range(2):
+        node = DiscoveryNode(node_id=f"node{i}", name=f"n{i}")
+        svc = TransportService(InProcessTransport(node, registry))
+        nodes.append(node)
+        services.append(svc)
+    yield nodes, services
+    for svc in services:
+        svc.close()
+
+
+def test_request_response_roundtrip(pair):
+    nodes, services = pair
+    services[1].register_request_handler(
+        "test:echo",
+        lambda req, channel, src: channel.send_response(
+            {"echo": req["msg"], "from": src.node_id}))
+    services[0].connect_to_node(nodes[1])
+    resp = services[0].send_request_sync(nodes[1], "test:echo",
+                                         {"msg": "hi"}, timeout=5)
+    assert resp == {"echo": "hi", "from": "node0"}
+
+
+def test_remote_exception_propagates(pair):
+    nodes, services = pair
+
+    def boom(req, channel, src):
+        raise ValueError("kapow")
+
+    services[1].register_request_handler("test:boom", boom)
+    services[0].connect_to_node(nodes[1])
+    with pytest.raises(RemoteTransportException) as ei:
+        services[0].send_request_sync(nodes[1], "test:boom", {}, timeout=5)
+    assert "kapow" in str(ei.value)
+    assert ei.value.remote_type == "ValueError"
+
+
+def test_unknown_action_fails(pair):
+    nodes, services = pair
+    services[0].connect_to_node(nodes[1])
+    with pytest.raises(RemoteTransportException, match="No handler"):
+        services[0].send_request_sync(nodes[1], "test:nope", {}, timeout=5)
+
+
+def test_local_short_circuit(pair):
+    nodes, services = pair
+    services[0].register_request_handler(
+        "test:local", lambda req, ch, src: ch.send_response({"ok": 1}))
+    # no connect needed for self
+    resp = services[0].send_request_sync(nodes[0], "test:local", {},
+                                         timeout=5)
+    assert resp == {"ok": 1}
+
+
+def test_timeout_fires(pair):
+    nodes, services = pair
+    services[1].register_request_handler(
+        "test:blackhole", lambda req, ch, src: None)  # never responds
+    services[0].connect_to_node(nodes[1])
+    with pytest.raises(ReceiveTimeoutTransportException):
+        services[0].send_request_sync(nodes[1], "test:blackhole", {},
+                                      timeout=0.6)
+
+
+def test_handshake_rejects_unknown_node():
+    registry = make_inprocess_cluster_registry()
+    node = DiscoveryNode(node_id="a", name="a")
+    svc = TransportService(InProcessTransport(node, registry))
+    try:
+        ghost = DiscoveryNode(node_id="ghost", name="ghost")
+        with pytest.raises(ConnectTransportException):
+            svc.connect_to_node(ghost)
+    finally:
+        svc.close()
+
+
+def test_connection_listener_events(pair):
+    nodes, services = pair
+    events = []
+    services[0].add_connection_listener(
+        lambda node, ev: events.append((node.node_id, ev)))
+    services[0].connect_to_node(nodes[1])
+    services[0].disconnect_from_node(nodes[1])
+    assert events == [("node1", "connected"), ("node1", "disconnected")]
+
+
+def test_interceptor_wraps_send_and_handle():
+    registry = make_inprocess_cluster_registry()
+    seen = []
+
+    class Recorder:
+        def intercept_sender(self, sender):
+            def wrapped(node, action, request, handler, timeout=None):
+                seen.append(("send", action))
+                return sender(node, action, request, handler, timeout)
+            return wrapped
+
+        def intercept_handler(self, action, handler):
+            def wrapped(req, channel, src):
+                seen.append(("recv", action))
+                return handler(req, channel, src)
+            return wrapped
+
+    nodes = [DiscoveryNode(node_id=f"i{i}", name=f"i{i}") for i in range(2)]
+    services = [TransportService(InProcessTransport(n, registry),
+                                 interceptors=[Recorder()]) for n in nodes]
+    try:
+        services[1].register_request_handler(
+            "test:icpt", lambda r, c, s: c.send_response({}))
+        services[0].connect_to_node(nodes[1])
+        services[0].send_request_sync(nodes[1], "test:icpt", {}, timeout=5)
+        assert ("send", "test:icpt") in seen
+        assert ("recv", "test:icpt") in seen
+    finally:
+        for s in services:
+            s.close()
+
+
+# ------------------------------------------------------- tcp transport
+
+def test_tcp_roundtrip_and_disconnect():
+    a = DiscoveryNode(node_id="tcpa", name="tcpa", host="127.0.0.1")
+    b = DiscoveryNode(node_id="tcpb", name="tcpb", host="127.0.0.1")
+    ta = TcpTransport(a)
+    tb = TcpTransport(b)
+    sa = TransportService(ta)
+    sb = TransportService(tb)
+    try:
+        sb.register_request_handler(
+            "test:tcp-echo",
+            lambda req, ch, src: ch.send_response(
+                {"echo": req["x"], "src": src.node_id if src else None}))
+        bound_b = tb.local_node
+        sa.connect_to_node(bound_b)
+        resp = sa.send_request_sync(bound_b, "test:tcp-echo", {"x": 41},
+                                    timeout=5)
+        assert resp["echo"] == 41
+        assert resp["src"] == "tcpa"
+        # big payload crosses frame/recv boundaries
+        big = "y" * 300_000
+        resp = sa.send_request_sync(bound_b, "test:tcp-echo", {"x": big},
+                                    timeout=10)
+        assert resp["echo"] == big
+    finally:
+        sa.close()
+        sb.close()
+
+
+def test_tcp_pending_fail_on_peer_death():
+    a = DiscoveryNode(node_id="tA", name="tA", host="127.0.0.1")
+    b = DiscoveryNode(node_id="tB", name="tB", host="127.0.0.1")
+    ta, tb = TcpTransport(a), TcpTransport(b)
+    sa, sb = TransportService(ta), TransportService(tb)
+    try:
+        sb.register_request_handler(
+            "test:never", lambda req, ch, src: None)
+        sa.connect_to_node(tb.local_node)
+        failures = []
+        done = threading.Event()
+        sa.send_request(tb.local_node, "test:never", {},
+                        ResponseHandler(lambda r: done.set(),
+                                        lambda e: (failures.append(e),
+                                                   done.set())),
+                        timeout=1.0)
+        # peer dies; timeout sweeper must fail the pending request
+        sb.close()
+        assert done.wait(5)
+        assert failures
+    finally:
+        sa.close()
+
+
+# ------------------------------------------------------------ QoS lanes
+
+def test_lane_routing():
+    assert lane_for_action("internal:index/shard/recovery/start") == LANE_RECOVERY
+    assert lane_for_action("indices:data/write/bulk[s]") == LANE_BULK
+    assert lane_for_action("internal:cluster/coordination/publish_state") == LANE_STATE
+    assert lane_for_action("indices:data/read/search[phase/query]") == LANE_REG
+
+
+# --------------------------------------------------------------- tasks
+
+def test_task_register_list_unregister():
+    tm = TaskManager("nodeX")
+    t = tm.register("transport", "indices:data/read/search", "desc")
+    assert tm.get_task(t.id) is t
+    listed = tm.list_tasks("indices:data/read/*")
+    assert [x.id for x in listed] == [t.id]
+    assert tm.list_tasks("cluster:*") == []
+    d = t.to_dict("nodeX")
+    assert d["action"] == "indices:data/read/search"
+    assert d["cancellable"] is False
+    tm.unregister(t)
+    assert tm.get_task(t.id) is None
+
+
+def test_cancellable_task_cooperative():
+    tm = TaskManager("nodeX")
+    t = tm.register("transport", "a", cancellable=True)
+    assert isinstance(t, CancellableTask)
+    t.ensure_not_cancelled()
+    fired = []
+    t.add_cancellation_listener(lambda: fired.append(1))
+    tm.cancel(t, "test reason")
+    assert fired == [1]
+    with pytest.raises(TaskCancelledException):
+        t.ensure_not_cancelled()
+    # listener added after cancellation fires immediately
+    t.add_cancellation_listener(lambda: fired.append(2))
+    assert fired == [1, 2]
+
+
+def test_ban_propagation_to_late_children():
+    tm = TaskManager("nodeX")
+    parent = tm.register("transport", "parent", cancellable=True)
+    child_before = tm.register(
+        "transport", "child", parent_task_id=TaskId("nodeX", parent.id),
+        cancellable=True)
+    tm.cancel(parent, "going away")
+    assert child_before.is_cancelled()
+    # a child arriving after the ban is cancelled on registration
+    child_after = tm.register(
+        "transport", "child2", parent_task_id=TaskId("nodeX", parent.id),
+        cancellable=True)
+    assert child_after.is_cancelled()
+
+
+def test_task_scope_context_manager():
+    tm = TaskManager("n")
+    with tm.task_scope("transport", "scoped") as t:
+        assert tm.get_task(t.id) is t
+    assert tm.get_task(t.id) is None
