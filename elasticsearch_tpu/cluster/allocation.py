@@ -1,0 +1,430 @@
+"""Shard allocation: deciders + balanced allocator + reroute.
+
+Ref: cluster/routing/allocation/ — `AllocationService.reroute` computes
+shard placement each time the cluster changes: pluggable
+`AllocationDecider`s veto placements (same-shard, filters, throttling,
+disk thresholds, retry limits; ref: decider/ package has 19), then
+`BalancedShardsAllocator` picks the least-loaded allowed node by a
+weight function. Shard lifecycle round-trips (`ShardStateAction`:
+started/failed) feed back in here.
+
+Pure functions over the immutable ClusterState — the master submits the
+result through the coordinator's publication path.
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import replace
+from typing import Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.cluster.state import (
+    SHARD_INITIALIZING,
+    SHARD_STARTED,
+    SHARD_UNASSIGNED,
+    ClusterState,
+    IndexMetadata,
+    IndexRoutingTable,
+    IndexShardRoutingTable,
+    RoutingTable,
+    ShardRouting,
+)
+
+DECISION_YES = "YES"
+DECISION_NO = "NO"
+DECISION_THROTTLE = "THROTTLE"
+
+
+class AllocationDecider:
+    """Ref: decider/AllocationDecider.java — can_allocate(shard, node)."""
+
+    name = "base"
+
+    def can_allocate(self, shard: ShardRouting, node_id: str,
+                     context: "RoutingAllocation") -> str:
+        return DECISION_YES
+
+
+class SameShardAllocationDecider(AllocationDecider):
+    """No two copies of one shard on the same node (ref:
+    SameShardAllocationDecider.java)."""
+
+    name = "same_shard"
+
+    def can_allocate(self, shard, node_id, context) -> str:
+        for other in context.assigned_shards:
+            if (other.index == shard.index
+                    and other.shard_id == shard.shard_id
+                    and other.current_node_id == node_id):
+                return DECISION_NO
+        return DECISION_YES
+
+
+class FilterAllocationDecider(AllocationDecider):
+    """index.routing.allocation.{require,include,exclude}._name (ref:
+    FilterAllocationDecider.java)."""
+
+    name = "filter"
+
+    def can_allocate(self, shard, node_id, context) -> str:
+        imd = context.state.metadata.index(shard.index)
+        if imd is None:
+            return DECISION_YES
+        settings = imd.settings or {}
+        node = context.state.nodes.get(node_id)
+        name = node.name if node else node_id
+        exclude = settings.get("index.routing.allocation.exclude._name")
+        if exclude and name in str(exclude).split(","):
+            return DECISION_NO
+        require = settings.get("index.routing.allocation.require._name")
+        if require and name not in str(require).split(","):
+            return DECISION_NO
+        return DECISION_YES
+
+
+class ThrottlingAllocationDecider(AllocationDecider):
+    """Cap concurrent incoming recoveries per node (ref:
+    ThrottlingAllocationDecider.java, default 2)."""
+
+    name = "throttling"
+
+    def __init__(self, concurrent_recoveries: int = 2):
+        self.concurrent_recoveries = concurrent_recoveries
+
+    def can_allocate(self, shard, node_id, context) -> str:
+        initializing = sum(
+            1 for s in context.assigned_shards
+            if s.current_node_id == node_id
+            and s.state == SHARD_INITIALIZING)
+        if initializing >= self.concurrent_recoveries:
+            return DECISION_THROTTLE
+        return DECISION_YES
+
+
+class MaxRetryAllocationDecider(AllocationDecider):
+    """Stop allocation loops after N failures (ref:
+    MaxRetryAllocationDecider.java, default 5)."""
+
+    name = "max_retry"
+
+    def __init__(self, max_retries: int = 5):
+        self.max_retries = max_retries
+
+    def can_allocate(self, shard, node_id, context) -> str:
+        failures = context.failure_counts.get(
+            (shard.index, shard.shard_id, shard.primary), 0)
+        if failures >= self.max_retries:
+            return DECISION_NO
+        return DECISION_YES
+
+
+class DiskThresholdDecider(AllocationDecider):
+    """Veto nodes above the high disk watermark (ref:
+    DiskThresholdDecider.java; usage supplied by the monitor layer)."""
+
+    name = "disk_threshold"
+
+    def __init__(self, usage_fn: Optional[Callable[[str], float]] = None,
+                 high_watermark: float = 0.90):
+        self.usage_fn = usage_fn
+        self.high_watermark = high_watermark
+
+    def can_allocate(self, shard, node_id, context) -> str:
+        if self.usage_fn is None:
+            return DECISION_YES
+        if self.usage_fn(node_id) >= self.high_watermark:
+            return DECISION_NO
+        return DECISION_YES
+
+
+class RoutingAllocation:
+    """Context handed to deciders during one reroute (ref:
+    RoutingAllocation.java)."""
+
+    def __init__(self, state: ClusterState,
+                 assigned_shards: List[ShardRouting],
+                 failure_counts: Dict[Tuple, int]):
+        self.state = state
+        self.assigned_shards = assigned_shards
+        self.failure_counts = failure_counts
+
+
+def default_deciders() -> List[AllocationDecider]:
+    return [SameShardAllocationDecider(), FilterAllocationDecider(),
+            ThrottlingAllocationDecider(), MaxRetryAllocationDecider(),
+            DiskThresholdDecider()]
+
+
+class AllocationService:
+    """Ref: AllocationService.java — reroute + shard started/failed
+    appliers. Owned by the master; results published as cluster state."""
+
+    def __init__(self, deciders: Optional[List[AllocationDecider]] = None):
+        self.deciders = deciders or default_deciders()
+        # (index, shard, primary) -> consecutive failures
+        self.failure_counts: Dict[Tuple, int] = {}
+
+    # ------------------------------------------------------------ reroute
+
+    def reroute(self, state: ClusterState) -> ClusterState:
+        """Assign unassigned shards to allowed nodes, balancing by shard
+        count (ref: BalancedShardsAllocator weight function — simplified
+        to total-shards + same-index-shards terms)."""
+        data_nodes = [n.node_id for n in state.nodes.data_nodes()]
+        if not data_nodes:
+            return state
+        all_shards = state.routing_table.all_shards()
+        assigned = [s for s in all_shards if s.assigned]
+        # drop assignments to nodes that left
+        live = set(n.node_id for n in state.nodes.nodes)
+        changed = False
+        new_indices: Dict[str, Dict[int, List[ShardRouting]]] = {}
+        for s in all_shards:
+            if s.assigned and s.current_node_id not in live:
+                s = self._failed_copy(s, "node left")
+                changed = True
+            new_indices.setdefault(s.index, {}).setdefault(
+                s.shard_id, []).append(s)
+        assigned = [s for shards in new_indices.values()
+                    for group in shards.values() for s in group
+                    if s.assigned]
+
+        # primaries first (a replica can only initialize once its primary
+        # is active), then replicas
+        def sort_key(item):
+            s = item
+            return (not s.primary, s.index, s.shard_id)
+
+        counts: Dict[str, int] = {n: 0 for n in data_nodes}
+        for s in assigned:
+            counts[s.current_node_id] = counts.get(s.current_node_id, 0) + 1
+
+        # primary failover: if a group lost its primary but has an active
+        # in-sync replica, PROMOTE it (ref: RoutingNodes
+        # promoteActiveReplicaShardToPrimary + failPrimary — never allocate
+        # a fresh empty primary while in-sync data exists elsewhere)
+        for index, shards in new_indices.items():
+            imd = state.metadata.index(index)
+            for shard_id, group in shards.items():
+                if any(s.primary and s.assigned for s in group):
+                    continue
+                in_sync = set(imd.in_sync_allocations.get(shard_id, [])) \
+                    if imd else set()
+                cand = next((i for i, s in enumerate(group)
+                             if not s.primary and s.active
+                             and s.allocation_id in in_sync), None)
+                if cand is None:
+                    continue
+                old = next((i for i, s in enumerate(group)
+                            if s.primary and not s.assigned), None)
+                group[cand] = replace(group[cand], primary=True)
+                if old is not None:
+                    group[old] = replace(group[old], primary=False)
+                changed = True
+
+        ctx = RoutingAllocation(state, assigned, self.failure_counts)
+        for index, shards in new_indices.items():
+            imd = state.metadata.index(index)
+            for shard_id, group in shards.items():
+                primary_active = any(s.primary and s.active for s in group)
+                in_sync = set(imd.in_sync_allocations.get(shard_id, [])) \
+                    if imd else set()
+                for i, s in enumerate(group):
+                    if s.state != SHARD_UNASSIGNED:
+                        continue
+                    if not s.primary and not primary_active:
+                        continue  # wait for the primary
+                    if s.primary and in_sync:
+                        # in-sync data exists (or existed) elsewhere —
+                        # allocating an empty primary would silently lose
+                        # acknowledged writes; stay red until a copy
+                        # returns (ref: PrimaryShardAllocator only
+                        # assigns primaries to nodes holding in-sync data)
+                        continue
+                    node = self._choose_node(s, data_nodes, counts, ctx)
+                    if node is None:
+                        continue
+                    new = replace(s, state=SHARD_INITIALIZING,
+                                  current_node_id=node,
+                                  allocation_id=uuid.uuid4().hex[:16],
+                                  unassigned_reason=None)
+                    group[i] = new
+                    ctx.assigned_shards.append(new)
+                    counts[node] = counts.get(node, 0) + 1
+                    changed = True
+        if not changed:
+            return state
+        return state.with_(routing_table=self._rebuild(
+            state.routing_table, new_indices))
+
+    def _choose_node(self, shard: ShardRouting, data_nodes: List[str],
+                     counts: Dict[str, int],
+                     ctx: RoutingAllocation) -> Optional[str]:
+        best = None
+        best_weight = None
+        for node in data_nodes:
+            decisions = [d.can_allocate(shard, node, ctx)
+                         for d in self.deciders]
+            if DECISION_NO in decisions or DECISION_THROTTLE in decisions:
+                continue
+            same_index = sum(1 for s in ctx.assigned_shards
+                             if s.current_node_id == node
+                             and s.index == shard.index)
+            weight = (counts.get(node, 0), same_index, node)
+            if best_weight is None or weight < best_weight:
+                best, best_weight = node, weight
+        return best
+
+    @staticmethod
+    def _rebuild(table: RoutingTable,
+                 indices: Dict[str, Dict[int, List[ShardRouting]]]
+                 ) -> RoutingTable:
+        out = {}
+        for index, shards in indices.items():
+            out[index] = IndexRoutingTable(index, {
+                sid: IndexShardRoutingTable(index, sid, tuple(group))
+                for sid, group in shards.items()})
+        return RoutingTable(out, table.version + 1)
+
+    @staticmethod
+    def _failed_copy(s: ShardRouting, reason: str) -> ShardRouting:
+        return replace(s, state=SHARD_UNASSIGNED, current_node_id=None,
+                       relocating_node_id=None, allocation_id=None,
+                       unassigned_reason=reason)
+
+    # ----------------------------------------------- lifecycle transitions
+
+    def apply_started_shards(self, state: ClusterState,
+                             started: List[Tuple[str, int, str]]
+                             ) -> ClusterState:
+        """(index, shard_id, allocation_id) initializing → started; adds
+        the allocation id to the in-sync set (ref:
+        IndexMetadataUpdater.applyChanges)."""
+        started_set = set(started)
+        changed = False
+        new_tables: Dict[str, IndexRoutingTable] = {}
+        metadata = state.metadata
+        for index, irt in state.routing_table.indices.items():
+            new_shards = {}
+            for sid, table in irt.shards.items():
+                group = []
+                for s in table.shards:
+                    if ((s.index, s.shard_id, s.allocation_id)
+                            in started_set
+                            and s.state == SHARD_INITIALIZING):
+                        s = replace(s, state=SHARD_STARTED)
+                        changed = True
+                        imd = metadata.index(index)
+                        if imd is not None:
+                            ins = dict(imd.in_sync_allocations)
+                            cur = list(ins.get(sid, []))
+                            if s.allocation_id not in cur:
+                                cur.append(s.allocation_id)
+                            ins[sid] = cur
+                            metadata = metadata.with_index(
+                                replace(imd, in_sync_allocations=ins))
+                    group.append(s)
+                new_shards[sid] = IndexShardRoutingTable(index, sid,
+                                                         tuple(group))
+            new_tables[index] = IndexRoutingTable(index, new_shards)
+        if not changed:
+            return state
+        for key in list(self.failure_counts):
+            if (key[0], key[1]) in {(i, s) for i, s, _a in started}:
+                self.failure_counts.pop(key, None)
+        return self.reroute(state.with_(
+            routing_table=RoutingTable(new_tables,
+                                       state.routing_table.version + 1),
+            metadata=metadata))
+
+    def apply_failed_shards(self, state: ClusterState,
+                            failed: List[Tuple[str, int, str, str]]
+                            ) -> ClusterState:
+        """(index, shard_id, allocation_id, reason) → unassigned; removes
+        from the in-sync set (mark-stale, ref:
+        ReplicationOperation.failShardIfNeeded → ShardStateAction)."""
+        failed_ids = {(i, s, a) for i, s, a, _r in failed}
+        reasons = {(i, s, a): r for i, s, a, r in failed}
+        changed = False
+        new_tables: Dict[str, IndexRoutingTable] = {}
+        metadata = state.metadata
+        for index, irt in state.routing_table.indices.items():
+            new_shards = {}
+            for sid, table in irt.shards.items():
+                group = []
+                for s in table.shards:
+                    key = (s.index, s.shard_id, s.allocation_id)
+                    if key in failed_ids and s.assigned:
+                        self.failure_counts[
+                            (s.index, s.shard_id, s.primary)] = \
+                            self.failure_counts.get(
+                                (s.index, s.shard_id, s.primary), 0) + 1
+                        # mark REPLICAS stale (out of the in-sync set);
+                        # a failed primary's id must stay in-sync — its
+                        # data still counts, and wiping it would let
+                        # reroute allocate a fresh empty primary over
+                        # acknowledged writes
+                        imd = metadata.index(index)
+                        if imd is not None and s.allocation_id \
+                                and not s.primary:
+                            ins = dict(imd.in_sync_allocations)
+                            cur = [a for a in ins.get(sid, [])
+                                   if a != s.allocation_id]
+                            ins[sid] = cur
+                            metadata = metadata.with_index(
+                                replace(imd, in_sync_allocations=ins))
+                        s = self._failed_copy(s, reasons[key])
+                        changed = True
+                    group.append(s)
+                new_shards[sid] = IndexShardRoutingTable(index, sid,
+                                                         tuple(group))
+            new_tables[index] = IndexRoutingTable(index, new_shards)
+        if not changed:
+            return state
+        return self.reroute(state.with_(
+            routing_table=RoutingTable(new_tables,
+                                       state.routing_table.version + 1),
+            metadata=metadata))
+
+
+def create_index_state(state: ClusterState, allocation: AllocationService,
+                       name: str, number_of_shards: int = 1,
+                       number_of_replicas: int = 0,
+                       settings: Optional[Dict] = None,
+                       mappings: Optional[Dict] = None) -> ClusterState:
+    """Master-side create-index task (ref:
+    MetadataCreateIndexService.applyCreateIndexRequest): add metadata +
+    unassigned routing entries, then reroute."""
+    if state.metadata.index(name) is not None:
+        from elasticsearch_tpu.common.errors import (
+            ResourceAlreadyExistsException,
+        )
+        raise ResourceAlreadyExistsException(
+            f"index [{name}] already exists")
+    imd = IndexMetadata(index=name, uuid=uuid.uuid4().hex[:20],
+                        number_of_shards=number_of_shards,
+                        number_of_replicas=number_of_replicas,
+                        settings=settings or {}, mappings=mappings or {})
+    shards = {}
+    for sid in range(number_of_shards):
+        group = [ShardRouting(index=name, shard_id=sid, primary=True,
+                              unassigned_reason="index created")]
+        for _ in range(number_of_replicas):
+            group.append(ShardRouting(index=name, shard_id=sid,
+                                      primary=False,
+                                      unassigned_reason="index created"))
+        shards[sid] = IndexShardRoutingTable(name, sid, tuple(group))
+    new_state = state.with_(
+        metadata=state.metadata.with_index(imd),
+        routing_table=state.routing_table.with_index(
+            IndexRoutingTable(name, shards)))
+    return allocation.reroute(new_state)
+
+
+def delete_index_state(state: ClusterState, name: str) -> ClusterState:
+    if state.metadata.index(name) is None:
+        from elasticsearch_tpu.common.errors import IndexNotFoundException
+        raise IndexNotFoundException(name)
+    return state.with_(
+        metadata=state.metadata.without_index(name),
+        routing_table=state.routing_table.without_index(name))

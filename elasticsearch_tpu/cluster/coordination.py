@@ -412,6 +412,7 @@ class Coordinator:
     def become_candidate(self, reason: str) -> None:
         self.mode = MODE_CANDIDATE
         self.current_leader = None
+        self._fail_pending_tasks(f"became candidate: {reason}")
         self._cancel_follower_checkers()
         if self._leader_check_task:
             self._leader_check_task.cancel()
@@ -438,6 +439,7 @@ class Coordinator:
             "elected-as-master", self._elected_state_update)
 
     def become_follower(self, leader: DiscoveryNode) -> None:
+        self._fail_pending_tasks(f"following {leader.name}")
         prev_leader = self.current_leader
         self.mode = MODE_FOLLOWER
         self.current_leader = leader
@@ -457,6 +459,17 @@ class Coordinator:
             self._leader_failures = 0
             self._start_leader_checker()
 
+    def _fail_pending_tasks(self, reason: str) -> None:
+        """A deposed leader must fail queued tasks, not run them under a
+        later term (ref: MasterService onNoLongerMaster)."""
+        tasks, self._pending_tasks = self._pending_tasks, []
+        for _source, _update, on_done in tasks:
+            if on_done is not None:
+                try:
+                    on_done(RuntimeError(f"no longer master: {reason}"))
+                except Exception:
+                    pass
+
     def _cancel_follower_checkers(self) -> None:
         for c in self._follower_checkers.values():
             c.cancel()
@@ -470,14 +483,16 @@ class Coordinator:
             return
         self._peer_task = self._schedule(
             PEER_FINDER_INTERVAL, self._find_peers, "peer-finding")
-        # also fire one round now
+        # also fire one round now (become_candidate path only; the
+        # periodic path reschedules directly to avoid double rounds)
         self._schedule0(self._request_peers_round, "peer-round")
 
     def _find_peers(self) -> None:
         if self._stopped or self.mode != MODE_CANDIDATE:
             return
         self._request_peers_round()
-        self._schedule_peer_finding()
+        self._peer_task = self._schedule(
+            PEER_FINDER_INTERVAL, self._find_peers, "peer-finding")
 
     def _request_peers_round(self) -> None:
         for node in list(self.peers.values()):
@@ -1111,9 +1126,15 @@ class _Publication:
             self._on_publish_response(node, resp)
 
         def fail(exc):
-            if allow_full_retry and "diff" in payload:
-                # incompatible diff → resend full state (ref:
-                # PublicationTransportHandler fallback)
+            # resend full state ONLY on an incompatible-diff rejection
+            # (ref: PublicationTransportHandler fallback). Retrying on a
+            # timeout would be rejected as a duplicate by a node that
+            # accepted the diff, marking a healthy node failed.
+            incompatible = ("Incompatible" in type(exc).__name__
+                            or "diff base" in str(exc)
+                            or getattr(exc, "remote_type", "")
+                            == "IncompatibleClusterStateVersionException")
+            if allow_full_retry and "diff" in payload and incompatible:
                 self._send_publish(node, {"state": self.state.to_dict()},
                                    allow_full_retry=False)
             else:

@@ -1,0 +1,292 @@
+"""ClusterNode: the full multi-node node container.
+
+The distributed analogue of `node.Node` (ref: node/Node.java:280-686):
+wires transport, coordination, allocation (master side), local shard
+management, replicated writes, and distributed search into one unit. The
+single-process `Node` in elasticsearch_tpu/node.py remains the one-box
+fast path; ClusterNode is how N of them form a cluster.
+
+Master-only services (allocation, index metadata CRUD) are registered on
+every node but execute only while elected — like the reference, where
+TransportMasterNodeAction routes to the master and the master-service
+task queue applies them.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from elasticsearch_tpu.cluster.allocation import (
+    AllocationService,
+    create_index_state,
+    delete_index_state,
+)
+from elasticsearch_tpu.cluster.coordination import (
+    MODE_LEADER,
+    Coordinator,
+    PersistedState,
+)
+from elasticsearch_tpu.cluster.data_node import (
+    SHARD_BULK_PRIMARY,
+    SHARD_FAILED_ACTION,
+    SHARD_STARTED_ACTION,
+    DataNodeService,
+)
+from elasticsearch_tpu.cluster.routing import OperationRouting, ShardId
+from elasticsearch_tpu.cluster.search_action import DistributedSearchService
+from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.transport.transport import (
+    DiscoveryNode,
+    ResponseHandler,
+)
+
+CREATE_INDEX_ACTION = "indices:admin/create"
+DELETE_INDEX_ACTION = "indices:admin/delete"
+REFRESH_ACTION = "indices:admin/refresh[s]"
+
+
+class ClusterNode:
+    """One node of a multi-node cluster (transport + scheduler supplied so
+    the same class runs under the deterministic harness and on real
+    TCP/threads)."""
+
+    def __init__(self, transport, scheduler, data_path: str,
+                 seed_nodes: Optional[List[DiscoveryNode]] = None,
+                 initial_master_nodes: Optional[List[str]] = None,
+                 rng=None):
+        self.transport = transport
+        self.scheduler = scheduler
+        self.local_node: DiscoveryNode = transport.local_node
+        self.data_path = data_path
+        os.makedirs(data_path, exist_ok=True)
+
+        self.allocation = AllocationService()
+        self.routing = OperationRouting()
+        self.data_node = DataNodeService(transport, scheduler, data_path)
+        self.search_service = DistributedSearchService(
+            transport, self.data_node, self.routing)
+        self.coordinator = Coordinator(
+            transport, scheduler,
+            persisted=PersistedState(),
+            seed_nodes=seed_nodes,
+            initial_master_nodes=initial_master_nodes,
+            on_committed_state=self._on_committed_state,
+            rng=rng)
+
+        for action, handler in [
+            (SHARD_STARTED_ACTION, self._on_shard_started),
+            (SHARD_FAILED_ACTION, self._on_shard_failed),
+            (CREATE_INDEX_ACTION, self._on_create_index),
+            (DELETE_INDEX_ACTION, self._on_delete_index),
+            (REFRESH_ACTION, self._on_refresh_shard),
+        ]:
+            transport.register_request_handler(action, handler)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self.coordinator.start()
+
+    def stop(self) -> None:
+        self.coordinator.stop()
+        self.data_node.close()
+
+    @property
+    def state(self) -> ClusterState:
+        return self.coordinator.applied_state
+
+    def is_master(self) -> bool:
+        return self.coordinator.mode == MODE_LEADER
+
+    # -------------------------------------------------------- state applier
+
+    def _on_committed_state(self, state: ClusterState) -> None:
+        """ClusterApplierService analogue: every service sees each
+        committed state (ref: ClusterApplierService.java:463-490)."""
+        self.data_node.apply_cluster_state(state)
+        # master: membership/metadata changes may unlock allocation; the
+        # task no-ops (no publication) when reroute changes nothing
+        if self.coordinator.mode == MODE_LEADER:
+            self.coordinator.submit_state_update(
+                "reroute", self.allocation.reroute)
+
+    # ------------------------------------------------------ master handlers
+
+    def _require_master(self, channel) -> bool:
+        if self.coordinator.mode != MODE_LEADER:
+            channel.send_exception(RuntimeError(
+                f"[{self.local_node.name}] not the elected master"))
+            return False
+        return True
+
+    def _on_shard_started(self, req, channel, src) -> None:
+        if not self._require_master(channel):
+            return
+        self.coordinator.submit_state_update(
+            f"shard-started[{req['index']}][{req['shard_id']}]",
+            lambda s: self.allocation.apply_started_shards(
+                s, [(req["index"], req["shard_id"],
+                     req["allocation_id"])]),
+            on_done=lambda err: self._ack(channel, err))
+
+    def _on_shard_failed(self, req, channel, src) -> None:
+        if not self._require_master(channel):
+            return
+        self.coordinator.submit_state_update(
+            f"shard-failed[{req['index']}][{req['shard_id']}]",
+            lambda s: self.allocation.apply_failed_shards(
+                s, [(req["index"], req["shard_id"], req["allocation_id"],
+                     req.get("reason", ""))]),
+            on_done=lambda err: self._ack(channel, err))
+
+    def _on_create_index(self, req, channel, src) -> None:
+        if not self._require_master(channel):
+            return
+        self.coordinator.submit_state_update(
+            f"create-index[{req['index']}]",
+            lambda s: create_index_state(
+                s, self.allocation, req["index"],
+                number_of_shards=req.get("number_of_shards", 1),
+                number_of_replicas=req.get("number_of_replicas", 0),
+                settings=req.get("settings"),
+                mappings=req.get("mappings")),
+            on_done=lambda err: self._ack(channel, err))
+
+    def _on_delete_index(self, req, channel, src) -> None:
+        if not self._require_master(channel):
+            return
+        self.coordinator.submit_state_update(
+            f"delete-index[{req['index']}]",
+            lambda s: delete_index_state(s, req["index"]),
+            on_done=lambda err: self._ack(channel, err))
+
+    @staticmethod
+    def _ack(channel, err) -> None:
+        if err is None:
+            channel.send_response({"acknowledged": True})
+        else:
+            channel.send_exception(err if isinstance(err, BaseException)
+                                   else RuntimeError(str(err)))
+
+    def _on_refresh_shard(self, req, channel, src) -> None:
+        self.data_node.refresh_all()
+        channel.send_response({"ok": True})
+
+    # -------------------------------------------------------- client API
+    # (async; each takes on_done(result, error))
+
+    def _to_master(self, action: str, payload: Dict,
+                   on_done: Callable) -> None:
+        master = self.state.nodes.master_node
+        if master is None:
+            on_done(None, RuntimeError("no elected master"))
+            return
+        self.transport.send_request(
+            master, action, payload,
+            ResponseHandler(lambda r: on_done(r, None),
+                            lambda e: on_done(None, e)),
+            timeout=60.0)
+
+    def create_index(self, index: str, number_of_shards: int = 1,
+                     number_of_replicas: int = 0,
+                     settings: Optional[Dict] = None,
+                     mappings: Optional[Dict] = None,
+                     on_done: Callable = lambda r, e: None) -> None:
+        self._to_master(CREATE_INDEX_ACTION,
+                        {"index": index,
+                         "number_of_shards": number_of_shards,
+                         "number_of_replicas": number_of_replicas,
+                         "settings": settings, "mappings": mappings},
+                        on_done)
+
+    def delete_index(self, index: str,
+                     on_done: Callable = lambda r, e: None) -> None:
+        self._to_master(DELETE_INDEX_ACTION, {"index": index}, on_done)
+
+    def bulk(self, index: str, items: List[Dict[str, Any]],
+             on_done: Callable = lambda r, e: None) -> None:
+        """Coordinator-side bulk (ref: TransportBulkAction.java:172 —
+        group by shard, dispatch to primaries, merge item results)."""
+        state = self.state
+        imd = state.metadata.index(index)
+        if imd is None:
+            on_done(None, KeyError(f"no such index [{index}]"))
+            return
+        by_shard: Dict[int, List[Dict]] = {}
+        order: Dict[int, List[int]] = {}
+        for i, item in enumerate(items):
+            sid = OperationRouting.shard_id(
+                imd.number_of_shards, item["id"], item.get("routing"))
+            by_shard.setdefault(sid, []).append(item)
+            order.setdefault(sid, []).append(i)
+        results: List[Optional[Dict]] = [None] * len(items)
+        pending = {"n": len(by_shard), "errors": []}
+
+        def shard_done():
+            pending["n"] -= 1
+            if pending["n"] == 0:
+                if pending["errors"]:
+                    on_done({"items": results,
+                             "errors": pending["errors"]}, None)
+                else:
+                    on_done({"items": results, "errors": []}, None)
+
+        for sid, shard_items in by_shard.items():
+            primary = self.routing.primary_shard(
+                state, ShardId(index, sid))
+            if primary is None:
+                for i in order[sid]:
+                    results[i] = {"error": "no active primary",
+                                  "status": 503}
+                pending["errors"].append(f"shard {sid}: no active primary")
+                shard_done()
+                continue
+            node = state.nodes.get(primary.current_node_id)
+            if node is None:
+                for i in order[sid]:
+                    results[i] = {"error": "primary node left the cluster",
+                                  "status": 503}
+                pending["errors"].append(f"shard {sid}: node left")
+                shard_done()
+                continue
+
+            def ok(resp, _sid=sid):
+                for i, item_result in zip(order[_sid], resp["items"]):
+                    results[i] = item_result
+                shard_done()
+
+            def fail(exc, _sid=sid):
+                for i in order[_sid]:
+                    results[i] = {"error": str(exc), "status": 500}
+                pending["errors"].append(f"shard {_sid}: {exc}")
+                shard_done()
+
+            self.transport.send_request(
+                node, SHARD_BULK_PRIMARY,
+                {"index": index, "shard_id": sid, "items": shard_items},
+                ResponseHandler(ok, fail), timeout=60.0)
+
+    def refresh(self, on_done: Callable = lambda r, e: None) -> None:
+        """Broadcast refresh to all data nodes (ref: refresh is a
+        broadcast replication action)."""
+        nodes = self.state.nodes.data_nodes()
+        if not nodes:
+            on_done({"ok": True}, None)
+            return
+        pending = {"n": len(nodes)}
+
+        def one(resp_or_exc):
+            pending["n"] -= 1
+            if pending["n"] == 0:
+                on_done({"ok": True}, None)
+
+        for node in nodes:
+            self.transport.send_request(
+                node, REFRESH_ACTION, {},
+                ResponseHandler(one, one), timeout=30.0)
+
+    def search(self, index: str, body: Dict[str, Any],
+               on_done: Callable = lambda r, e: None) -> None:
+        self.search_service.search(self.state, index, body, on_done)

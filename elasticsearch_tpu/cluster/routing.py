@@ -1,0 +1,132 @@
+"""Operation routing + adaptive replica selection.
+
+Ref: cluster/routing/OperationRouting.java:42 — doc routed to shard by
+hash(_routing) % num_shards; for reads, one copy of each shard is chosen,
+ranked by **adaptive replica selection** (EWMA response time + queue
+depth from ResponseCollectorService, ref: node/ResponseCollectorService
+.java:44,82).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from elasticsearch_tpu.cluster.state import (
+    ClusterState,
+    IndexShardRoutingTable,
+    ShardRouting,
+)
+from elasticsearch_tpu.index.service import murmur3_hash
+
+
+class ResponseCollectorService:
+    """Per-node EWMA of service time / response time / queue size,
+    reported by data nodes with each search response (ref:
+    ResponseCollectorService.ComputedNodeStats)."""
+
+    ALPHA = 0.3
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stats: Dict[str, Dict[str, float]] = {}
+
+    def add_node_statistics(self, node_id: str, queue_size: int,
+                            response_time_ns: float,
+                            service_time_ns: float) -> None:
+        with self._lock:
+            st = self._stats.setdefault(node_id, {
+                "queue": float(queue_size),
+                "response": float(response_time_ns),
+                "service": float(service_time_ns)})
+            a = self.ALPHA
+            st["queue"] = a * queue_size + (1 - a) * st["queue"]
+            st["response"] = a * response_time_ns + (1 - a) * st["response"]
+            st["service"] = a * service_time_ns + (1 - a) * st["service"]
+
+    def rank(self, node_id: str, outstanding: int = 1) -> float:
+        """ES's ARS formula (ref: ResponseCollectorService.rank):
+        R(s) = response + (q_hat^3) * service, q_hat scaled by
+        outstanding requests. Lower is better; unknown nodes rank 0 so
+        they get tried."""
+        with self._lock:
+            st = self._stats.get(node_id)
+            if st is None:
+                return 0.0
+            q_hat = st["queue"] + outstanding
+            return st["response"] + (q_hat ** 3) * st["service"]
+
+
+@dataclass(frozen=True)
+class ShardId:
+    index: str
+    shard: int
+
+    def __str__(self) -> str:
+        return f"[{self.index}][{self.shard}]"
+
+
+class OperationRouting:
+    """Ref: OperationRouting.java."""
+
+    def __init__(self,
+                 collector: Optional[ResponseCollectorService] = None):
+        self.collector = collector or ResponseCollectorService()
+
+    @staticmethod
+    def shard_id(num_shards: int, doc_id: str,
+                 routing: Optional[str] = None) -> int:
+        key = routing if routing is not None else doc_id
+        return abs(murmur3_hash(key)) % num_shards
+
+    def index_shard(self, state: ClusterState, index: str, doc_id: str,
+                    routing: Optional[str] = None) -> ShardId:
+        imd = state.metadata.index(index)
+        if imd is None:
+            raise KeyError(f"no such index [{index}]")
+        return ShardId(index,
+                       self.shard_id(imd.number_of_shards, doc_id, routing))
+
+    def primary_shard(self, state: ClusterState,
+                      shard_id: ShardId) -> Optional[ShardRouting]:
+        irt = state.routing_table.index(shard_id.index)
+        if irt is None:
+            return None
+        table = irt.shard(shard_id.shard)
+        if table is None:
+            return None
+        primary = table.primary
+        if primary is not None and primary.active:
+            return primary
+        return None
+
+    def search_shards(self, state: ClusterState, index: str,
+                      preference: Optional[str] = None
+                      ) -> List[ShardRouting]:
+        """One active copy per shard group, ARS-ranked (ref:
+        OperationRouting.searchShards + GroupShardsIterator)."""
+        irt = state.routing_table.index(index)
+        if irt is None:
+            return []
+        chosen: List[ShardRouting] = []
+        for shard_num in sorted(irt.shards):
+            table: IndexShardRoutingTable = irt.shards[shard_num]
+            active = table.active_shards()
+            if not active:
+                continue
+            if preference == "_primary":
+                pick = table.primary if (table.primary is not None
+                                         and table.primary.active) \
+                    else active[0]
+            else:
+                pick = min(active, key=lambda s: (
+                    self.collector.rank(s.current_node_id or ""),
+                    not s.primary))
+            chosen.append(pick)
+        return chosen
+
+    def all_search_groups(self, state: ClusterState,
+                          index: str) -> List[IndexShardRoutingTable]:
+        irt = state.routing_table.index(index)
+        return [irt.shards[k] for k in sorted(irt.shards)] if irt else []

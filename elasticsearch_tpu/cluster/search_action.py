@@ -1,0 +1,346 @@
+"""Distributed search: scatter-gather query-then-fetch over the
+transport.
+
+The multi-node analogue of the in-process SearchService (ref:
+action/search/TransportSearchAction.java:93,469-523 coordinator side;
+SearchService.executeQueryPhase/executeFetchPhase data-node side;
+SearchPhaseController.java:154-218 top-k merge; FetchSearchPhase
+.java:104-161 fetch-winners-only).
+
+Coordinator (any node): resolve index → ARS-ranked shard copies →
+per-shard query RPC → incremental top-k merge → fetch RPC to the shards
+owning the winners → assemble. Per-shard results carry EWMA queue/service
+stats for adaptive replica selection, like the reference's
+QueryPhase.execute:307-315 → ResponseCollectorService loop.
+
+On-node shard fan-out happens inside one process (all local shards of an
+index are searched in a single handler call), so a host's shards merge
+locally before crossing the wire — the RPC topology matches the TPU
+layout where one host drives many device-resident shard partitions and
+ICI collectives pre-merge them (parallel/sharded.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.cluster.routing import (
+    OperationRouting,
+    ResponseCollectorService,
+)
+from elasticsearch_tpu.cluster.state import ClusterState, ShardRouting
+from elasticsearch_tpu.common.errors import IndexNotFoundException
+from elasticsearch_tpu.search.queries import MatchAllQuery, parse_query
+from elasticsearch_tpu.search.searcher import DocAddress, ShardSearcher
+from elasticsearch_tpu.transport.transport import ResponseHandler
+
+QUERY_PHASE_ACTION = "indices:data/read/search[phase/query]"
+FETCH_PHASE_ACTION = "indices:data/read/search[phase/fetch/id]"
+
+DEFAULT_SIZE = 10
+
+
+class DistributedSearchService:
+    """Both sides of the two-phase protocol (registered on every node)."""
+
+    def __init__(self, transport, data_node,
+                 routing: Optional[OperationRouting] = None):
+        self.transport = transport
+        self.data_node = data_node
+        self.routing = routing or OperationRouting()
+        transport.register_request_handler(QUERY_PHASE_ACTION,
+                                           self._on_query_phase)
+        transport.register_request_handler(FETCH_PHASE_ACTION,
+                                           self._on_fetch_phase)
+
+    # -------------------------------------------------- data-node handlers
+
+    def _searcher_for(self, index: str, shard_id: int
+                      ) -> Optional[ShardSearcher]:
+        shard = self.data_node.shards.get((index, shard_id))
+        if shard is None or shard.state != "started":
+            return None
+        engine = shard.engine
+        snapshot = engine.acquire_searcher()
+        return ShardSearcher(snapshot.segments, engine.mapper,
+                             self.data_node.device_cache)
+
+    def _on_query_phase(self, req, channel, src) -> None:
+        """Run the query phase on the named local shards; serializable
+        per-shard top-k (ref: QuerySearchResult)."""
+        t0 = time.monotonic()
+        body = req.get("body") or {}
+        query = (parse_query(body["query"]) if body.get("query")
+                 else MatchAllQuery())
+        post_filter = (parse_query(body["post_filter"])
+                       if body.get("post_filter") else None)
+        k = int(req["k"])
+        shard_results = []
+        for shard_id in req["shards"]:
+            searcher = self._searcher_for(req["index"], shard_id)
+            if searcher is None:
+                shard_results.append({"shard": shard_id,
+                                      "error": "shard not started here"})
+                continue
+            result = searcher.query_phase(
+                query, k,
+                post_filter=post_filter,
+                min_score=body.get("min_score"),
+                sort=body.get("sort"),
+                search_after=body.get("search_after"),
+                track_total_hits=bool(body.get("track_total_hits", True)))
+            shard_results.append({
+                "shard": shard_id,
+                "total": result.total_hits,
+                "max_score": result.max_score,
+                "docs": [{"seg": searcher.segments[d.segment_idx].name,
+                          "docid": d.docid, "score": d.score,
+                          "sort_key": d.sort_key,
+                          "sort_values": list(d.sort_values)}
+                         for d in result.docs],
+            })
+        took = time.monotonic() - t0
+        channel.send_response({
+            "results": shard_results,
+            # EWMA inputs for adaptive replica selection
+            "service_time_ns": took * 1e9,
+            "queue_size": 0,
+        })
+
+    def _on_fetch_phase(self, req, channel, src) -> None:
+        """Fetch _source/fields for winning docs by (segment name, docid)
+        — segment names are stable across refreshes (immutable segments),
+        so the addresses survive the query→fetch gap."""
+        body = req.get("body") or {}
+        hits_out = []
+        for shard_id, wire_docs in req["docs"].items():
+            shard_id = int(shard_id)
+            searcher = self._searcher_for(req["index"], shard_id)
+            if searcher is None:
+                for wd in wire_docs:
+                    hits_out.append({"_lost": True, "_ord": wd["ord"]})
+                continue
+            seg_idx = {seg.name: i
+                       for i, seg in enumerate(searcher.segments)}
+            query = (parse_query(body["query"]) if body.get("query")
+                     else None)
+            for wd in wire_docs:
+                if wd["seg"] not in seg_idx:
+                    hits_out.append({"_lost": True, "_ord": wd["ord"]})
+                    continue
+                addr = DocAddress(segment_idx=seg_idx[wd["seg"]],
+                                  docid=wd["docid"], score=wd["score"],
+                                  sort_values=tuple(wd["sort_values"]))
+                fetched = searcher.fetch_phase(
+                    [addr],
+                    source_filter=body.get("_source", True),
+                    docvalue_fields=[
+                        f if isinstance(f, str) else f.get("field")
+                        for f in body.get("docvalue_fields", [])] or None,
+                    highlight=body.get("highlight"),
+                    highlight_query=query)[0]
+                fetched["_ord"] = wd["ord"]
+                hits_out.append(fetched)
+        channel.send_response({"hits": hits_out})
+
+    # ----------------------------------------------------- coordinator side
+
+    def search(self, state: ClusterState, index_expression: str,
+               body: Dict[str, Any],
+               on_done: Callable[[Optional[Dict], Optional[Exception]],
+                                 None]) -> None:
+        """Async coordinator (ref: AbstractSearchAsyncAction.run)."""
+        body = body or {}
+        if body.get("aggs") or body.get("aggregations"):
+            on_done(None, NotImplementedError(
+                "aggregations over the distributed path land with the "
+                "partial-reduce milestone; single-node search supports "
+                "them"))
+            return
+        t_start = time.monotonic()
+        try:
+            indices = self._resolve(state, index_expression)
+        except IndexNotFoundException as e:
+            on_done(None, e)
+            return
+        size = int(body.get("size", DEFAULT_SIZE))
+        from_ = int(body.get("from", 0))
+        k = from_ + size
+
+        # group chosen shard copies by node → one RPC per (node, index)
+        # (ref: per-node grouping + throttling in AbstractSearchAsyncAction)
+        by_node: Dict[Tuple[str, str], List[ShardRouting]] = {}
+        n_shards = 0
+        for index in indices:
+            for copy in self.routing.search_shards(state, index):
+                by_node.setdefault((copy.current_node_id, index),
+                                   []).append(copy)
+                n_shards += 1
+        if n_shards == 0:
+            on_done(self._empty_response(), None)
+            return
+
+        merged: List[Dict] = []   # wire docs + (index, shard)
+        totals = {"total": 0, "max_score": None, "failed": 0,
+                  "pending": len(by_node)}
+
+        def one_node_done():
+            totals["pending"] -= 1
+            if totals["pending"] == 0:
+                self._fetch_phase(state, body, merged, totals, from_, size,
+                                  n_shards, t_start, on_done)
+
+        for (node_id, index), copies in by_node.items():
+            node = state.nodes.get(node_id)
+            if node is None:
+                totals["failed"] += len(copies)
+                one_node_done()
+                continue
+            payload = {"index": index,
+                       "shards": [c.shard_id for c in copies],
+                       "k": max(k, 1), "body": body}
+
+            def ok(resp, _index=index, _node_id=node_id):
+                self.routing.collector.add_node_statistics(
+                    _node_id, resp.get("queue_size", 0),
+                    resp.get("service_time_ns", 0.0),
+                    resp.get("service_time_ns", 0.0))
+                for sr in resp["results"]:
+                    if "error" in sr:
+                        totals["failed"] += 1
+                        continue
+                    totals["total"] += sr["total"]
+                    ms = sr["max_score"]
+                    if ms is not None:
+                        totals["max_score"] = (
+                            ms if totals["max_score"] is None
+                            else max(ms, totals["max_score"]))
+                    for d in sr["docs"]:
+                        d2 = dict(d)
+                        d2["_index"] = _index
+                        d2["_shard"] = sr["shard"]
+                        d2["_node"] = _node_id
+                        merged.append(d2)
+                one_node_done()
+
+            def fail(exc, _n=len(copies)):
+                totals["failed"] += _n
+                one_node_done()
+
+            self.transport.send_request(node, QUERY_PHASE_ACTION, payload,
+                                        ResponseHandler(ok, fail),
+                                        timeout=30.0)
+
+    def _fetch_phase(self, state, body, merged, totals, from_, size,
+                     n_shards, t_start, on_done) -> None:
+        """Merge top-k then fetch winners where they live (ref:
+        SearchPhaseController.sortDocs + FetchSearchPhase)."""
+        merged.sort(key=lambda d: (-d["sort_key"], d["_index"],
+                                   d["_shard"], d["docid"]))
+        page = merged[from_:from_ + size]
+        for ord_, d in enumerate(page):
+            d["ord"] = ord_
+        if not page:
+            resp = self._empty_response()
+            resp["took"] = int((time.monotonic() - t_start) * 1000)
+            resp["_shards"] = self._shards_section(n_shards, totals)
+            resp["hits"]["total"]["value"] = totals["total"]
+            resp["hits"]["max_score"] = totals["max_score"]
+            on_done(resp, None)
+            return
+        # group winners by (node, index, shard)
+        by_node: Dict[Tuple[str, str], Dict[int, List[Dict]]] = {}
+        for d in page:
+            by_node.setdefault((d["_node"], d["_index"]), {}).setdefault(
+                d["_shard"], []).append(
+                {"seg": d["seg"], "docid": d["docid"],
+                 "score": d["score"], "sort_values": d["sort_values"],
+                 "ord": d["ord"]})
+        hits: List[Optional[Dict]] = [None] * len(page)
+        pending = {"n": len(by_node)}
+
+        def node_fetched():
+            pending["n"] -= 1
+            if pending["n"] > 0:
+                return
+            final_hits = []
+            for ord_, d in enumerate(page):
+                h = hits[ord_]
+                if h is None or h.get("_lost"):
+                    continue
+                h.pop("_ord", None)
+                h["_index"] = d["_index"]
+                if d["sort_values"]:
+                    h["sort"] = d["sort_values"]
+                final_hits.append(h)
+            track_total = body.get("track_total_hits", True)
+            total = totals["total"]
+            relation = "eq"
+            if isinstance(track_total, int) and \
+                    not isinstance(track_total, bool) and \
+                    total > track_total:
+                total, relation = track_total, "gte"
+            resp = {
+                "took": int((time.monotonic() - t_start) * 1000),
+                "timed_out": False,
+                "_shards": self._shards_section(n_shards, totals),
+                "hits": {"total": {"value": total, "relation": relation},
+                         "max_score": totals["max_score"],
+                         "hits": final_hits},
+            }
+            on_done(resp, None)
+
+        for (node_id, index), docs_by_shard in by_node.items():
+            node = state.nodes.get(node_id)
+            if node is None:
+                node_fetched()
+                continue
+            payload = {"index": index,
+                       "docs": {str(sid): docs
+                                for sid, docs in docs_by_shard.items()},
+                       "body": body}
+
+            def ok(resp):
+                for h in resp["hits"]:
+                    hits[h["_ord"]] = h
+                node_fetched()
+
+            def fail(exc):
+                node_fetched()
+
+            self.transport.send_request(node, FETCH_PHASE_ACTION, payload,
+                                        ResponseHandler(ok, fail),
+                                        timeout=30.0)
+
+    # ------------------------------------------------------------- helpers
+
+    @staticmethod
+    def _resolve(state: ClusterState, expression: str) -> List[str]:
+        names = sorted(state.metadata.indices)
+        if expression in ("_all", "*", ""):
+            return names
+        out = []
+        for part in expression.split(","):
+            if "*" in part:
+                import fnmatch
+                out.extend(n for n in names if fnmatch.fnmatch(n, part))
+            elif part in state.metadata.indices:
+                out.append(part)
+            else:
+                raise IndexNotFoundException(part)
+        return out
+
+    @staticmethod
+    def _shards_section(n_shards: int, totals: Dict) -> Dict:
+        return {"total": n_shards,
+                "successful": n_shards - totals["failed"],
+                "skipped": 0, "failed": totals["failed"]}
+
+    @staticmethod
+    def _empty_response() -> Dict:
+        return {"timed_out": False,
+                "_shards": {"total": 0, "successful": 0, "skipped": 0,
+                            "failed": 0},
+                "hits": {"total": {"value": 0, "relation": "eq"},
+                         "max_score": None, "hits": []}}

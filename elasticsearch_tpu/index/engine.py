@@ -226,8 +226,12 @@ class Engine:
         return IndexResult(doc_id, version, seq_no, primary_term, created)
 
     def delete(self, doc_id: str,
+               seq_no: Optional[int] = None,
+               primary_term: Optional[int] = None,
                if_seq_no: Optional[int] = None,
                if_primary_term: Optional[int] = None) -> DeleteResult:
+        """seq_no/primary_term pre-assigned on the replica/replay path
+        (like index(); ref: IndexShard.applyDeleteOperationOnReplica)."""
         with self._lock:
             self._check_open()
             existing = self.version_map.get(doc_id)
@@ -237,7 +241,8 @@ class Engine:
                     existing.primary_term != if_primary_term):
                 raise VersionConflictEngineException(
                     doc_id, f"required seqNo [{if_seq_no}]")
-            result = self._delete_internal(doc_id)
+            result = self._delete_internal(doc_id, seq_no=seq_no,
+                                           primary_term=primary_term)
             self.translog.add(TranslogOp(
                 "delete", result.seq_no, result.primary_term, doc_id=doc_id))
             return result
