@@ -108,6 +108,29 @@ class PostingsField:
 
 
 @dataclass
+class TokenStreams:
+    """Per-doc positional token-id streams for one text field.
+
+    The positional index that Lucene keeps as per-posting position deltas
+    is re-homed here as a rectangular array: ``tokens[n_docs, max_len]``
+    int32 of term ids (into the field's ``PostingsField.terms``), -1
+    padded. Positional queries (match_phrase, slop) become shifted-equality
+    array ops over candidate rows instead of postings-iterator
+    intersections (ref: Lucene PhraseQuery/ExactPhraseMatcher). Streams
+    longer than ``MAX_STREAM_LEN`` are truncated (position index only —
+    postings/norms still see the full stream), mirroring
+    index.highlight.max_analyzed_offset-style bounded positional work.
+    """
+
+    field: str
+    tokens: np.ndarray    # int32 [n_docs, max_len], -1 pad
+    lengths: np.ndarray   # int32 [n_docs] indexed (possibly truncated) length
+
+
+MAX_STREAM_LEN = 512
+
+
+@dataclass
 class NumericDocValues:
     field: str
     values: np.ndarray    # float64 [n_docs] (first value if multi)
@@ -164,7 +187,8 @@ class Segment:
                  keywords: Dict[str, KeywordDocValues],
                  vectors: Dict[str, VectorValues],
                  stored: StoredFields,
-                 live: Optional[np.ndarray] = None):
+                 live: Optional[np.ndarray] = None,
+                 streams: Optional[Dict[str, TokenStreams]] = None):
         self.name = name
         self.n_docs = n_docs
         self.postings = postings
@@ -172,6 +196,7 @@ class Segment:
         self.keywords = keywords
         self.vectors = vectors
         self.stored = stored
+        self.streams = streams or {}
         self.live = live if live is not None else np.ones(n_docs, dtype=bool)
         self.live_version = 0  # bumps on delete; device caches key on it
         self._id_map: Optional[Dict[str, int]] = None
@@ -272,6 +297,12 @@ class Segment:
             arrays[f"{key}~vec"] = vv.vectors
             arrays[f"{key}~has"] = vv.has_value
             meta["vectors"][f] = {"dims": vv.dims, "similarity": vv.similarity}
+        meta["streams"] = []
+        for f, ts in self.streams.items():
+            key = f"s~{f}"
+            arrays[f"{key}~tok"] = ts.tokens
+            arrays[f"{key}~len"] = ts.lengths
+            meta["streams"].append(f)
         arrays["stored~offsets"] = self.stored.offsets
         arrays["stored~ids"], arrays["stored~ids_off"] = \
             self._encode_strings(self.stored.ids)
@@ -322,11 +353,16 @@ class Segment:
             vectors[f] = VectorValues(
                 field=f, vectors=z[f"{key}~vec"], has_value=z[f"{key}~has"],
                 dims=m["dims"], similarity=m["similarity"])
+        streams = {}
+        for f in meta.get("streams", []):
+            key = f"s~{f}"
+            streams[f] = TokenStreams(f, z[f"{key}~tok"], z[f"{key}~len"])
         stored = StoredFields(
             offsets=z["stored~offsets"], data=data,
             ids=cls._decode_strings(z["stored~ids"], z["stored~ids_off"]))
         return cls(meta["name"], meta["n_docs"], postings, numerics, keywords,
-                   vectors, stored, live=z["live"].astype(bool))
+                   vectors, stored, live=z["live"].astype(bool),
+                   streams=streams)
 
 
 # ---------------------------------------------------------------------------
@@ -378,6 +414,33 @@ class SegmentWriter:
             f: _build_postings_field(f, term_docs, field_lengths[f], n)
             for f, term_docs in field_term_docs.items()
         }
+
+        # ---- positional token streams (text fields only). Tokens land at
+        # their Token.position slot — position gaps from e.g. StopFilter
+        # stay as -1 holes, so phrase adjacency respects increments exactly
+        # as Lucene position deltas do.
+        streams: Dict[str, TokenStreams] = {}
+        text_fields = {f for d in docs for f in d.text_tokens}
+        for f in text_fields:
+            tindex = postings[f].term_index
+            max_len = min(
+                MAX_STREAM_LEN,
+                max((ts[-1].position + 1 for d in docs
+                     if (ts := d.text_tokens.get(f))), default=0))
+            toks = np.full((n, max_len), -1, np.int32)
+            lengths = np.zeros(n, np.int32)
+            for docid, d in enumerate(docs):
+                ts = d.text_tokens.get(f)
+                if not ts:
+                    continue
+                L = 0
+                for t in ts:
+                    if t.position >= max_len:
+                        break
+                    toks[docid, t.position] = tindex[t.term]
+                    L = t.position + 1
+                lengths[docid] = L
+            streams[f] = TokenStreams(f, toks, lengths)
 
         # ---- numeric doc values
         numerics = {}
@@ -443,7 +506,8 @@ class SegmentWriter:
             ids.append(d.doc_id)
         stored = StoredFields(offsets, b"".join(chunks), ids)
 
-        return Segment(name, n, postings, numerics, keywords, vectors, stored)
+        return Segment(name, n, postings, numerics, keywords, vectors, stored,
+                       streams=streams)
 
 
 def _build_postings_field(field: str,
@@ -619,6 +683,33 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
         keywords[f] = KeywordDocValues(f, uniq, ords, offsets,
                                        np.asarray(all_ords, np.int32))
 
+    # ---- token streams (remap old term ids -> merged term ids)
+    streams: Dict[str, TokenStreams] = {}
+    for f in sorted({f for s in segments for f in s.streams}):
+        pf_new = postings.get(f)
+        if pf_new is None:
+            continue
+        max_len = max(s.streams[f].tokens.shape[1]
+                      for s in segments if f in s.streams)
+        toks = np.full((new_n, max_len), -1, np.int32)
+        lengths = np.zeros(new_n, np.int32)
+        new_index = pf_new.term_index
+        for seg, m in zip(segments, maps):
+            ts = seg.streams.get(f)
+            if ts is None:
+                continue
+            old_terms = seg.postings[f].terms
+            # old term id -> new term id (deleted-only terms map to -1)
+            remap = np.fromiter(
+                (new_index.get(t, -1) for t in old_terms),
+                np.int32, count=len(old_terms))
+            remap = np.concatenate([remap, np.asarray([-1], np.int32)])  # -1 pad slot
+            live_ids = np.nonzero(seg.live)[0]
+            L = ts.tokens.shape[1]
+            toks[m[live_ids], :L] = remap[ts.tokens[live_ids]]
+            lengths[m[live_ids]] = ts.lengths[live_ids]
+        streams[f] = TokenStreams(f, toks, lengths)
+
     # ---- vectors
     vectors: Dict[str, VectorValues] = {}
     for f in sorted({f for s in segments for f in s.vectors}):
@@ -649,4 +740,5 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
             ids.append(seg.stored.ids[int(old)])
     stored = StoredFields(offsets, b"".join(chunks), ids)
 
-    return Segment(name, new_n, postings, numerics, keywords, vectors, stored)
+    return Segment(name, new_n, postings, numerics, keywords, vectors, stored,
+                   streams=streams)

@@ -114,6 +114,8 @@ def _register_all(c: RestController):
     c.register("POST", "/{index}/_msearch", msearch_index)
     c.register("POST", "/{index}/_rank_eval", rank_eval_handler)
     c.register("GET", "/{index}/_rank_eval", rank_eval_handler)
+    c.register("GET", "/{index}/_explain/{id}", explain_doc)
+    c.register("POST", "/{index}/_explain/{id}", explain_doc)
     # documents
     c.register("PUT", "/{index}/_doc/{id}", index_doc)
     c.register("POST", "/{index}/_doc/{id}", index_doc)
@@ -569,6 +571,13 @@ def _merge_search_params(body, params):
 
 def count_index(node, params, body, index):
     return 200, node.search_service.count(index, body or {})
+
+
+def explain_doc(node, params, body, index, id):
+    body = body or {}
+    if "q" in params and "query" not in body:
+        body = _merge_search_params(body, params)
+    return 200, node.search_service.explain(index, id, body)
 
 
 def scroll(node, params, body):

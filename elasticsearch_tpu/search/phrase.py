@@ -1,0 +1,109 @@
+"""Positional (phrase) matching over token streams.
+
+The TPU-first split of Lucene's PhraseQuery (ref: Lucene
+ExactPhraseMatcher/SloppyPhraseMatcher, consumed via
+index/search/MatchQuery.java phrase path): the device does the heavy
+filtering — a conjunctive match over the phrase's terms via the postings
+block kernels — and position verification runs vectorized on the host over
+only the few surviving candidates' token-stream rows. This mirrors the
+segment format's block-max design: coarse dense filter first, exact check
+on survivors (SURVEY.md §7 "hard parts" #1).
+
+Scoring matches Lucene: the phrase is scored as a pseudo-term with
+tf = phrase frequency and weight = sum of the member terms' idfs
+(ref: Lucene PhraseWeight — TermStatistics of all terms are summed).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def exact_phrase_freqs(tokens: np.ndarray,      # int32 [C, L] candidate rows
+                       term_ids: Sequence[int]  # phrase term ids, len P >= 1
+                       ) -> np.ndarray:
+    """Phrase occurrence count per candidate row (slop = 0), vectorized:
+    an occurrence at position p is ``all_j tokens[:, p+j] == term_ids[j]``."""
+    C, L = tokens.shape
+    P = len(term_ids)
+    if L < P:
+        return np.zeros(C, np.int64)
+    n_pos = L - P + 1
+    match = np.ones((C, n_pos), bool)
+    for j, tid in enumerate(term_ids):
+        match &= tokens[:, j : j + n_pos] == tid
+    return match.sum(axis=1)
+
+
+def sloppy_phrase_freqs(tokens: np.ndarray, lengths: np.ndarray,
+                        term_ids: Sequence[int], slop: int,
+                        last_alternatives: Optional[Sequence[int]] = None
+                        ) -> np.ndarray:
+    """Sloppy phrase frequency per candidate row.
+
+    Greedy alignment: for each occurrence p0 of the first term, each later
+    term j must appear at an UNUSED position q with ``|q - j - p0| <= slop``
+    (the nearest such q is taken and consumed — repeated terms need
+    distinct positions, as in Lucene's SloppyPhraseMatcher). Covers
+    in-order and moved-within-slop matches without Lucene's full alignment
+    search. ``last_alternatives`` extends the final slot to an any-of set
+    (the match_phrase_prefix expansion).
+    """
+    if slop <= 0 and last_alternatives is None:
+        return exact_phrase_freqs(tokens, term_ids)
+    C = tokens.shape[0]
+    freqs = np.zeros(C, np.int64)
+    n_slots = len(term_ids) + (1 if last_alternatives is not None else 0)
+    for c in range(C):
+        row = tokens[c, : lengths[c]]
+        positions: List[np.ndarray] = [np.nonzero(row == tid)[0]
+                                       for tid in term_ids]
+        if last_alternatives is not None:
+            positions.append(np.nonzero(np.isin(row, last_alternatives))[0])
+        if any(len(p) == 0 for p in positions):
+            continue
+        count = 0
+        for p0 in positions[0]:
+            used = {int(p0)}
+            ok = True
+            for j in range(1, n_slots):
+                target = p0 + j
+                best = None
+                for q in positions[j]:
+                    qi = int(q)
+                    if qi in used or abs(qi - target) > slop:
+                        continue
+                    if best is None or abs(qi - target) < abs(best - target):
+                        best = qi
+                if best is None:
+                    ok = False
+                    break
+                used.add(best)
+            if ok:
+                count += 1
+        freqs[c] = count
+    return freqs
+
+
+def phrase_prefix_freqs(tokens: np.ndarray,
+                        term_ids: Sequence[int],
+                        last_term_ids: Sequence[int]) -> np.ndarray:
+    """match_phrase_prefix: fixed prefix terms followed by ANY of
+    ``last_term_ids`` (the prefix expansions of the final token)."""
+    C, L = tokens.shape
+    P = len(term_ids) + 1
+    if L < P or not last_term_ids:
+        return np.zeros(C, np.int64)
+    n_pos = L - P + 1
+    match = np.ones((C, n_pos), bool)
+    for j, tid in enumerate(term_ids):
+        match &= tokens[:, j : j + n_pos] == tid
+    j = len(term_ids)
+    last = np.zeros((C, n_pos), bool)
+    window = tokens[:, j : j + n_pos]
+    for tid in last_term_ids:
+        last |= window == tid
+    match &= last
+    return match.sum(axis=1)

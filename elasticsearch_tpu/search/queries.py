@@ -16,9 +16,11 @@ clause-count scatter kernels (ops/bm25.py match_count).
 
 Implemented: match_all, match_none, match, multi_match, term, terms, range,
 exists, ids, bool, constant_score, dis_max, boosting, script_score, knn,
-function_score(scripts+weight). Positional queries (match_phrase,
-intervals, span) need a positions index — postings positions land in a later
-round (gap tracked in SURVEY parity).
+function_score(scripts+weight), match_phrase (slop), match_phrase_prefix,
+match_bool_prefix, prefix, wildcard, regexp, fuzzy, more_like_this, pinned,
+distance_feature, query_string, simple_query_string. Positional queries run
+on the segment token streams (index/segment.py TokenStreams +
+search/phrase.py): device conjunction filter, host position verification.
 """
 
 from __future__ import annotations
@@ -84,6 +86,11 @@ class QueryBuilder:
     # can_match-style pruning hook (ref: CanMatchPreFilterSearchPhase)
     def can_match(self, ctx: SegmentContext) -> bool:
         return True
+
+    # shard-level rewrite before execution (ref: QueryBuilder.rewrite /
+    # Rewriteable — more_like_this resolves doc references here)
+    def rewrite(self, searcher) -> "QueryBuilder":
+        return self
 
 
 class MatchAllQuery(QueryBuilder):
@@ -410,6 +417,23 @@ class BoolQuery(QueryBuilder):
         scores = jnp.where(mask, scores, 0.0)
         return scores, mask
 
+    def rewrite(self, searcher):
+        # non-mutating: shards must not see each other's rewrites
+        must = [q.rewrite(searcher) for q in self.must]
+        filt = [q.rewrite(searcher) for q in self.filter]
+        should = [q.rewrite(searcher) for q in self.should]
+        must_not = [q.rewrite(searcher) for q in self.must_not]
+        if (all(a is b for a, b in zip(must, self.must))
+                and all(a is b for a, b in zip(filt, self.filter))
+                and all(a is b for a, b in zip(should, self.should))
+                and all(a is b for a, b in zip(must_not, self.must_not))):
+            return self
+        q = BoolQuery(must=must, filter=filt, should=should,
+                      must_not=must_not,
+                      minimum_should_match=self.minimum_should_match)
+        q.boost = self.boost
+        return q
+
 
 class ConstantScoreQuery(QueryBuilder):
     name = "constant_score"
@@ -421,6 +445,14 @@ class ConstantScoreQuery(QueryBuilder):
     def do_execute(self, ctx):
         _, mask = self.filter_query.execute(ctx)
         return mask.astype(jnp.float32), mask
+
+    def rewrite(self, searcher):
+        inner = self.filter_query.rewrite(searcher)
+        if inner is self.filter_query:
+            return self
+        q = ConstantScoreQuery(inner)
+        q.boost = self.boost
+        return q
 
 
 class DisMaxQuery(QueryBuilder):
@@ -443,6 +475,14 @@ class DisMaxQuery(QueryBuilder):
         best = jnp.where(mask, best, 0.0)
         return best, mask
 
+    def rewrite(self, searcher):
+        queries = [q.rewrite(searcher) for q in self.queries]
+        if all(a is b for a, b in zip(queries, self.queries)):
+            return self
+        q = DisMaxQuery(queries, tie_breaker=self.tie_breaker)
+        q.boost = self.boost
+        return q
+
 
 class BoostingQuery(QueryBuilder):
     """ref: BoostingQueryBuilder — demote (not exclude) negative matches."""
@@ -461,6 +501,15 @@ class BoostingQuery(QueryBuilder):
         _, neg = self.negative.execute(ctx)
         s = jnp.where(neg, s * self.negative_boost, s)
         return s, mask
+
+    def rewrite(self, searcher):
+        pos = self.positive.rewrite(searcher)
+        neg = self.negative.rewrite(searcher)
+        if pos is self.positive and neg is self.negative:
+            return self
+        q = BoostingQuery(pos, neg, self.negative_boost)
+        q.boost = self.boost
+        return q
 
 
 def _make_vector_fns(ctx: SegmentContext):
@@ -535,6 +584,15 @@ class ScriptScoreQuery(QueryBuilder):
             scores = jnp.where(mask, scores, 0.0)
         return scores, mask
 
+    def rewrite(self, searcher):
+        inner = self.query.rewrite(searcher)
+        if inner is self.query:
+            return self
+        q = ScriptScoreQuery(inner, self.source, self.params,
+                             min_score=self.min_score)
+        q.boost = self.boost
+        return q
+
 
 class KnnQuery(QueryBuilder):
     """Native brute-force kNN — net-new surface (the reference only has
@@ -574,6 +632,17 @@ class KnnQuery(QueryBuilder):
             mask = mask & fm
         scores = jnp.where(mask, scores, 0.0)
         return scores, mask
+
+    def rewrite(self, searcher):
+        if self.filter_query is None:
+            return self
+        inner = self.filter_query.rewrite(searcher)
+        if inner is self.filter_query:
+            return self
+        q = KnnQuery(self.field, self.query_vector,
+                     num_candidates=self.num_candidates, filter_query=inner)
+        q.boost = self.boost
+        return q
 
 
 class FunctionScoreQuery(QueryBuilder):
@@ -629,6 +698,811 @@ class FunctionScoreQuery(QueryBuilder):
             scores = base
         scores = jnp.where(mask, scores, 0.0)
         return scores, mask
+
+    def rewrite(self, searcher):
+        inner = self.query.rewrite(searcher)
+        if inner is self.query:
+            return self
+        q = FunctionScoreQuery(inner, self.functions,
+                               boost_mode=self.boost_mode,
+                               score_mode=self.score_mode)
+        q.boost = self.boost
+        return q
+
+
+# ---------------------------------------------------------------------------
+# Positional queries (token-stream based; see search/phrase.py)
+# ---------------------------------------------------------------------------
+
+def _conjunction_mask(ctx: SegmentContext, field: str,
+                      tids: List[int]) -> jnp.ndarray:
+    """Device mask of docs containing ALL the given term ids."""
+    dp = ctx.device.postings.get(field)
+    if dp is None:
+        return jnp.zeros(ctx.n_docs_padded, bool)
+    sels, cids = [], []
+    for ci, tid in enumerate(tids):
+        s, _ = dp.select_blocks([tid], [1.0])
+        sels.append(s)
+        cids.append(np.full(len(s), ci, np.int32))
+    counts = bm25_ops.match_count(
+        dp.block_docids, dp.block_tfs,
+        jnp.asarray(np.concatenate(sels)), jnp.asarray(np.concatenate(cids)),
+        len(tids), ctx.n_docs_padded)
+    return counts >= len(tids)
+
+
+def _phrase_scores_from_freqs(ctx: SegmentContext, field: str,
+                              cand: np.ndarray, freqs: np.ndarray,
+                              idf_weight: float) -> Result:
+    """BM25 with tf = phrase frequency (ref: Lucene PhraseWeight: idf is
+    summed over member terms, norms are the field's)."""
+    pf = ctx.segment.postings[field]
+    keep = freqs > 0
+    cand, freqs = cand[keep], freqs[keep]
+    z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+    if len(cand) == 0:
+        return z, z.astype(bool)
+    _, avg_len = ctx.stats.field_stats(field)
+    dl = pf.field_lengths[cand]
+    tf = freqs.astype(np.float32)
+    norm = ctx.k1 * (1.0 - ctx.b + ctx.b * dl / max(avg_len, 1e-9))
+    s = idf_weight * tf / (tf + norm)
+    scores_np = np.zeros(ctx.n_docs_padded, np.float32)
+    scores_np[cand] = s
+    scores = jnp.asarray(scores_np)
+    return scores, scores > 0.0
+
+
+class MatchPhraseQuery(QueryBuilder):
+    """ref: MatchPhraseQueryBuilder / Lucene PhraseQuery. Device-side
+    conjunctive filter over the phrase's terms, then exact position
+    verification on the host over only the surviving candidates' token
+    streams (search/phrase.py)."""
+
+    name = "match_phrase"
+
+    def __init__(self, field: str, query: str, slop: int = 0):
+        super().__init__()
+        self.field = field
+        self.query = query
+        self.slop = slop
+
+    def do_execute(self, ctx):
+        from elasticsearch_tpu.search.phrase import sloppy_phrase_freqs
+        terms = _analyze_terms(ctx, self.field, self.query)
+        z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+        empty = (z, z.astype(bool))
+        if not terms:
+            return empty
+        if len(terms) == 1:
+            return _bm25_terms(ctx, self.field, terms)
+        seg = ctx.segment
+        pf = seg.postings.get(self.field)
+        ts = seg.streams.get(self.field)
+        if pf is None or ts is None:
+            return empty
+        tids = [pf.term_id(t) for t in terms]
+        if any(t < 0 for t in tids):
+            return empty  # a missing term can't complete the phrase
+        cand_mask = np.asarray(_conjunction_mask(
+            ctx, self.field, sorted(set(tids))))[: seg.n_docs]
+        cand = np.nonzero(cand_mask)[0]
+        if len(cand) == 0:
+            return empty
+        freqs = sloppy_phrase_freqs(ts.tokens[cand], ts.lengths[cand],
+                                    tids, self.slop)
+        doc_count, _ = ctx.stats.field_stats(self.field)
+        w = sum(bm25_ops.idf(ctx.stats.doc_freq(self.field, t), doc_count)
+                for t in set(terms))
+        return _phrase_scores_from_freqs(ctx, self.field, cand, freqs, w)
+
+
+class MatchPhrasePrefixQuery(QueryBuilder):
+    """ref: MatchPhrasePrefixQueryBuilder — phrase whose last token is a
+    prefix, expanded against the segment's term dictionary (capped at
+    max_expansions, default 50)."""
+
+    name = "match_phrase_prefix"
+
+    def __init__(self, field: str, query: str, max_expansions: int = 50,
+                 slop: int = 0):
+        super().__init__()
+        self.field = field
+        self.query = query
+        self.max_expansions = max_expansions
+        self.slop = slop
+
+    def do_execute(self, ctx):
+        from elasticsearch_tpu.search.phrase import (
+            phrase_prefix_freqs,
+            sloppy_phrase_freqs,
+        )
+        terms = _analyze_terms(ctx, self.field, self.query)
+        z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+        empty = (z, z.astype(bool))
+        if not terms:
+            return empty
+        seg = ctx.segment
+        pf = seg.postings.get(self.field)
+        ts = seg.streams.get(self.field)
+        if pf is None or ts is None:
+            return empty
+        *fixed, last = terms
+        exp = _expand_prefix(pf.terms, last, self.max_expansions)
+        if not exp:
+            return empty
+        exp_ids = [pf.term_id(t) for t in exp]
+        if not fixed:
+            # single-token prefix: behaves like a prefix query, scored as a
+            # one-term phrase with union df
+            dp = ctx.device.postings.get(self.field)
+            sel, _ = dp.select_blocks(exp_ids, [1.0] * len(exp_ids))
+            mask = bm25_ops.match_mask(dp.block_docids, dp.block_tfs,
+                                       jnp.asarray(sel), ctx.n_docs_padded)
+            return mask.astype(jnp.float32), mask
+        tids = [pf.term_id(t) for t in fixed]
+        if any(t < 0 for t in tids):
+            return empty
+        cand_mask = np.asarray(_conjunction_mask(
+            ctx, self.field, sorted(set(tids))))[: seg.n_docs]
+        cand = np.nonzero(cand_mask)[0]
+        if len(cand) == 0:
+            return empty
+        if self.slop > 0:
+            freqs = sloppy_phrase_freqs(ts.tokens[cand], ts.lengths[cand],
+                                        tids, self.slop,
+                                        last_alternatives=exp_ids)
+        else:
+            freqs = phrase_prefix_freqs(ts.tokens[cand], tids, exp_ids)
+        doc_count, _ = ctx.stats.field_stats(self.field)
+        w = sum(bm25_ops.idf(ctx.stats.doc_freq(self.field, t), doc_count)
+                for t in set(fixed))
+        # shard-level stats for the expansion slot, matching the fixed
+        # terms' idfs above (segment-local df would skew per-segment scores)
+        df_union = min(doc_count,
+                       sum(ctx.stats.doc_freq(self.field, t) for t in exp))
+        w += bm25_ops.idf(max(df_union, 1), doc_count)
+        return _phrase_scores_from_freqs(ctx, self.field, cand, freqs, w)
+
+
+class MatchBoolPrefixQuery(QueryBuilder):
+    """ref: MatchBoolPrefixQueryBuilder — bool OR of the analyzed terms,
+    with the final term as a prefix."""
+
+    name = "match_bool_prefix"
+
+    def __init__(self, field: str, query: str, max_expansions: int = 50):
+        super().__init__()
+        self.field = field
+        self.query = query
+        self.max_expansions = max_expansions
+
+    def do_execute(self, ctx):
+        terms = _analyze_terms(ctx, self.field, self.query)
+        z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+        if not terms:
+            return z, z.astype(bool)
+        *fixed, last = terms
+        scores, mask = (_bm25_terms(ctx, self.field, fixed) if fixed
+                        else (z, z.astype(bool)))
+        ps, pm = PrefixQuery(self.field, last,
+                             max_expansions=self.max_expansions).execute(ctx)
+        return scores + ps, mask | pm
+
+
+# ---------------------------------------------------------------------------
+# Multi-term queries (term-dictionary expansion, constant-score rewrite —
+# ref: Lucene MultiTermQuery CONSTANT_SCORE_REWRITE)
+# ---------------------------------------------------------------------------
+
+MAX_TERM_EXPANSIONS = 1024  # ref: indices.query.bool.max_clause_count
+
+
+def _expand_prefix(terms: List[str], prefix: str, cap: int) -> List[str]:
+    import bisect
+    lo = bisect.bisect_left(terms, prefix)
+    out = []
+    for i in range(lo, len(terms)):
+        if not terms[i].startswith(prefix):
+            break
+        out.append(terms[i])
+        if len(out) >= cap:
+            break
+    return out
+
+
+def _expand_regex(terms: List[str], pattern, cap: int) -> List[str]:
+    out = []
+    for t in terms:
+        if pattern.fullmatch(t):
+            out.append(t)
+            if len(out) >= cap:
+                break
+    return out
+
+
+def _edit_distance_within(a: str, b: str, k: int) -> int:
+    """Damerau-Levenshtein (optimal string alignment — adjacent
+    transposition counts as ONE edit, matching Lucene fuzzy's default
+    ``transpositions=true``) if <= k else k+1, with early exit."""
+    la, lb = len(a), len(b)
+    if abs(la - lb) > k:
+        return k + 1
+    prev2: Optional[List[int]] = None
+    prev = list(range(lb + 1))
+    for i in range(1, la + 1):
+        cur = [i] + [0] * lb
+        row_min = cur[0]
+        for j in range(1, lb + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            d = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+            if (prev2 is not None and i > 1 and j > 1
+                    and a[i - 1] == b[j - 2] and a[i - 2] == b[j - 1]):
+                d = min(d, prev2[j - 2] + 1)
+            cur[j] = d
+            row_min = min(row_min, d)
+        if row_min > k:
+            return k + 1
+        prev2, prev = prev, cur
+    return prev[lb]
+
+
+def resolve_fuzziness(fuzziness, term: str) -> int:
+    """ES Fuzziness: int, "AUTO", "AUTO:low,high"."""
+    if fuzziness is None or (isinstance(fuzziness, str)
+                             and fuzziness.upper().startswith("AUTO")):
+        low, high = 3, 6
+        if isinstance(fuzziness, str) and ":" in fuzziness:
+            try:
+                low, high = (int(x) for x in fuzziness.split(":")[1].split(","))
+            except ValueError:
+                pass
+        n = len(term)
+        return 0 if n < low else (1 if n < high else 2)
+    return int(fuzziness)
+
+
+class _MultiTermQuery(QueryBuilder):
+    """Shared machinery: expand per segment against the term dictionary,
+    match any expansion, constant score 1.0."""
+
+    def __init__(self, field: str):
+        super().__init__()
+        self.field = field
+
+    def expand(self, terms: List[str]) -> List[str]:
+        raise NotImplementedError
+
+    def do_execute(self, ctx):
+        dp = ctx.device.postings.get(self.field)
+        z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+        if dp is None:
+            return z, z.astype(bool)
+        expanded = self.expand(dp.host.terms)
+        if not expanded:
+            return z, z.astype(bool)
+        tids = [dp.host.term_id(t) for t in expanded]
+        sel, _ = dp.select_blocks(tids, [1.0] * len(tids))
+        mask = bm25_ops.match_mask(dp.block_docids, dp.block_tfs,
+                                   jnp.asarray(sel), ctx.n_docs_padded)
+        return mask.astype(jnp.float32), mask
+
+
+class PrefixQuery(_MultiTermQuery):
+    """ref: PrefixQueryBuilder."""
+
+    name = "prefix"
+
+    def __init__(self, field: str, value: str, max_expansions: int = MAX_TERM_EXPANSIONS):
+        super().__init__(field)
+        self.value = str(value)
+        self.max_expansions = max_expansions
+
+    def expand(self, terms):
+        return _expand_prefix(terms, self.value, self.max_expansions)
+
+
+class WildcardQuery(_MultiTermQuery):
+    """ref: WildcardQueryBuilder — `*` any sequence, `?` any single char."""
+
+    name = "wildcard"
+
+    def __init__(self, field: str, value: str):
+        super().__init__(field)
+        self.value = str(value)
+        import re as _re
+        esc = "".join(
+            ".*" if c == "*" else "." if c == "?" else _re.escape(c)
+            for c in self.value)
+        self._re = _re.compile(esc)
+
+    def expand(self, terms):
+        # literal prefix before the first wildcard narrows the scan
+        import re as _re
+        lit = _re.split(r"[*?]", self.value, maxsplit=1)[0]
+        if lit:
+            cands = _expand_prefix(terms, lit, len(terms))
+            return [t for t in cands if self._re.fullmatch(t)][:MAX_TERM_EXPANSIONS]
+        return _expand_regex(terms, self._re, MAX_TERM_EXPANSIONS)
+
+
+class RegexpQuery(_MultiTermQuery):
+    """ref: RegexpQueryBuilder — anchored regexp over the term dict."""
+
+    name = "regexp"
+
+    def __init__(self, field: str, value: str):
+        super().__init__(field)
+        import re as _re
+        try:
+            self._re = _re.compile(str(value))
+        except _re.error as e:
+            raise ParsingException(f"invalid regexp [{value}]: {e}")
+
+    def expand(self, terms):
+        return _expand_regex(terms, self._re, MAX_TERM_EXPANSIONS)
+
+
+class FuzzyQuery(QueryBuilder):
+    """ref: FuzzyQueryBuilder / Lucene FuzzyQuery with blended rewrite —
+    expansions are scored as down-weighted synonyms in ONE kernel call:
+    weight = idf · (1 - dist/len)."""
+
+    name = "fuzzy"
+
+    def __init__(self, field: str, value: str, fuzziness=None,
+                 prefix_length: int = 0, max_expansions: int = 50):
+        super().__init__()
+        self.field = field
+        self.value = str(value)
+        self.fuzziness = fuzziness
+        self.prefix_length = prefix_length
+        self.max_expansions = max_expansions
+
+    def matching_terms(self, terms: List[str]) -> List[Tuple[str, int]]:
+        k = resolve_fuzziness(self.fuzziness, self.value)
+        pre = self.value[: self.prefix_length]
+        cands = (_expand_prefix(terms, pre, len(terms)) if pre else terms)
+        out = []
+        for t in cands:
+            d = _edit_distance_within(self.value, t, k)
+            if d <= k:
+                out.append((t, d))
+        out.sort(key=lambda td: (td[1], td[0]))
+        return out[: self.max_expansions]
+
+    def do_execute(self, ctx):
+        dp = ctx.device.postings.get(self.field)
+        z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+        if dp is None:
+            return z, z.astype(bool)
+        matches = self.matching_terms(dp.host.terms)
+        if not matches:
+            return z, z.astype(bool)
+        doc_count, avg_len = ctx.stats.field_stats(self.field)
+        tids, weights = [], []
+        L = max(len(self.value), 1)
+        for t, d in matches:
+            df = ctx.stats.doc_freq(self.field, t)
+            w = bm25_ops.idf(df, doc_count) if df > 0 else 0.0
+            tids.append(dp.host.term_id(t))
+            weights.append(w * (1.0 - d / L))
+        sel, ws = dp.select_blocks(tids, weights)
+        scores = bm25_ops.bm25_block_scores(
+            dp.block_docids, dp.block_tfs, jnp.asarray(sel), jnp.asarray(ws),
+            dp.doc_lens, jnp.float32(avg_len), ctx.k1, ctx.b)
+        return scores, scores > 0.0
+
+
+# ---------------------------------------------------------------------------
+# more_like_this / pinned / distance_feature
+# ---------------------------------------------------------------------------
+
+class MoreLikeThisQuery(QueryBuilder):
+    """ref: MoreLikeThisQueryBuilder / Lucene MoreLikeThis — select the
+    like-text's most significant terms by tf·idf (shard statistics), then
+    run them as an OR with minimum_should_match. Doc references are
+    resolved in ``rewrite`` against the shard (the reference fetches
+    termvectors on the shard for the same reason)."""
+
+    name = "more_like_this"
+
+    def __init__(self, fields: Optional[List[str]], like, unlike=None,
+                 max_query_terms: int = 25, min_term_freq: int = 2,
+                 min_doc_freq: int = 5, max_doc_freq: Optional[int] = None,
+                 minimum_should_match: str = "30%", include: bool = False):
+        super().__init__()
+        self.fields = fields
+        self.like = like if isinstance(like, list) else [like]
+        self.unlike = (unlike if isinstance(unlike, list) else [unlike]) if unlike else []
+        self.max_query_terms = max_query_terms
+        self.min_term_freq = min_term_freq
+        self.min_doc_freq = min_doc_freq
+        self.max_doc_freq = max_doc_freq
+        self.minimum_should_match = minimum_should_match
+        self.include = include
+
+    def rewrite(self, searcher) -> QueryBuilder:
+        import json as _json
+        mapper = searcher.mapper
+        fields = self.fields
+        if not fields:
+            fields = [name for name, ft in mapper.mapper.fields.items()
+                      if isinstance(ft, TextFieldType)]
+        like_texts: Dict[str, List[str]] = {f: [] for f in fields}
+        doc_ids: List[str] = []
+        for like in self.like:
+            if isinstance(like, str):
+                for f in fields:
+                    like_texts[f].append(like)
+            elif isinstance(like, dict):
+                did = like.get("_id")
+                doc_ids.append(did)
+                for seg in searcher.segments:
+                    d = seg.docid_for(did)
+                    if d >= 0:
+                        src = _json.loads(seg.stored.source(d))
+                        for f in fields:
+                            v = src.get(f)
+                            if isinstance(v, str):
+                                like_texts[f].append(v)
+                        break
+        unlike_terms: Dict[str, set] = {f: set() for f in fields}
+        for ul in self.unlike:
+            if isinstance(ul, str):
+                for f in fields:
+                    ft = mapper.field_type(f)
+                    name = getattr(ft, "analyzer_name", "standard")
+                    an = (mapper.analysis.get(name) if mapper.analysis.has(name)
+                          else mapper.analysis.default)
+                    unlike_terms[f].update(an.terms(ul))
+
+        scored: List[Tuple[float, str, str]] = []  # (score, field, term)
+        for f in fields:
+            ft = mapper.field_type(f)
+            name = getattr(ft, "analyzer_name", "standard")
+            an = (mapper.analysis.get(name) if mapper.analysis.has(name)
+                  else mapper.analysis.default)
+            counts: Dict[str, int] = {}
+            for text in like_texts[f]:
+                for t in an.terms(text):
+                    counts[t] = counts.get(t, 0) + 1
+            doc_count, _ = searcher.stats.field_stats(f)
+            for t, tf in counts.items():
+                if tf < self.min_term_freq or t in unlike_terms[f]:
+                    continue
+                df = searcher.stats.doc_freq(f, t)
+                if df < self.min_doc_freq:
+                    continue
+                if self.max_doc_freq is not None and df > self.max_doc_freq:
+                    continue
+                scored.append((tf * bm25_ops.idf(df, max(doc_count, 1)), f, t))
+        scored.sort(reverse=True)
+        selected = scored[: self.max_query_terms]
+        if not selected:
+            return MatchNoneQuery()
+        should: List[QueryBuilder] = [TermQuery(f, t) for _, f, t in selected]
+        must_not: List[QueryBuilder] = []
+        if doc_ids and not self.include:
+            must_not.append(IdsQuery([d for d in doc_ids if d]))
+        q = BoolQuery(should=should, must_not=must_not,
+                      minimum_should_match=self.minimum_should_match)
+        q.boost = self.boost
+        return q
+
+    def do_execute(self, ctx):  # pragma: no cover - rewritten before execute
+        raise QueryShardException("more_like_this must be rewritten first")
+
+
+class PinnedQuery(QueryBuilder):
+    """ref: x-pack search-business-rules PinnedQueryBuilder — the given ids
+    rank above all organic results, in list order."""
+
+    name = "pinned"
+    PIN_BASE = 1.0e6  # above any BM25 score; f32-exact spacing of 10
+
+    def __init__(self, ids: List[str], organic: QueryBuilder):
+        super().__init__()
+        self.ids = ids
+        self.organic = organic
+
+    def do_execute(self, ctx):
+        scores, mask = self.organic.execute(ctx)
+        pin_np = np.zeros(ctx.n_docs_padded, np.float32)
+        seg = ctx.segment
+        for rank, did in enumerate(self.ids):
+            d = seg.docid_for(did)
+            if d >= 0:
+                pin_np[d] = self.PIN_BASE - 10.0 * rank
+        pins = jnp.asarray(pin_np)
+        pinned_mask = pins > 0
+        scores = jnp.where(pinned_mask, pins, scores)
+        return scores, mask | pinned_mask
+
+    def rewrite(self, searcher):
+        organic = self.organic.rewrite(searcher)
+        if organic is self.organic:
+            return self
+        q = PinnedQuery(self.ids, organic)
+        q.boost = self.boost
+        return q
+
+
+class DistanceFeatureQuery(QueryBuilder):
+    """ref: DistanceFeatureQueryBuilder — score decays with distance from
+    origin: boost · pivot / (pivot + |value - origin|)."""
+
+    name = "distance_feature"
+
+    def __init__(self, field: str, origin, pivot):
+        super().__init__()
+        self.field = field
+        self.origin = origin
+        self.pivot = pivot
+
+    def do_execute(self, ctx):
+        ft = ctx.mapper.field_type(self.field)
+        origin = float(ft.parse(self.origin)) if ft else float(self.origin)
+        pivot = _parse_duration_or_number(self.pivot, ft)
+        col, miss = ctx.numeric_column(self.field)
+        mask = (~miss) & ctx.all_true()
+        dist = jnp.abs(col - origin)
+        scores = jnp.where(mask, pivot / (pivot + dist), 0.0).astype(jnp.float32)
+        return scores, mask
+
+
+def _parse_duration_or_number(v, ft) -> float:
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip()
+    units = {"ms": 1.0, "s": 1000.0, "m": 60_000.0, "h": 3_600_000.0,
+             "d": 86_400_000.0, "w": 604_800_000.0}
+    for suffix in sorted(units, key=len, reverse=True):
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * units[suffix]
+    return float(s)
+
+
+# ---------------------------------------------------------------------------
+# query_string / simple_query_string (lite grammars)
+# ---------------------------------------------------------------------------
+
+class _QueryStringParser:
+    """Recursive-descent mini-grammar for query_string (ref:
+    modules/lang-expression + Lucene classic QueryParser surface actually
+    used by the REST tests): AND/OR/NOT, parentheses, field:term, quoted
+    phrases, wildcard terms, +/- prefixes."""
+
+    def __init__(self, text: str, default_field: Optional[str],
+                 fields: Optional[List[str]], default_operator: str):
+        self.toks = self._lex(text)
+        self.i = 0
+        self.default_field = default_field
+        self.fields = fields
+        self.default_operator = default_operator.lower()
+
+    @staticmethod
+    def _lex(text: str) -> List[str]:
+        import re as _re
+        # field:"phrase" stays one token; then bare phrases, parens, words
+        pat = _re.compile(r'[+\-]?[^\s:"()]+:"[^"]*"|"[^"]*"|\(|\)|\S+')
+        return pat.findall(text)
+
+    def peek(self) -> Optional[str]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> str:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def parse(self) -> QueryBuilder:
+        q = self.parse_or()
+        if q is None:
+            return MatchNoneQuery()
+        return q
+
+    def parse_or(self):
+        clauses = [self.parse_and()]
+        while True:
+            nxt = self.peek()
+            if nxt in ("OR", "||"):
+                self.next()
+                clauses.append(self.parse_and())
+            elif (nxt is not None and nxt != ")"
+                  and self.default_operator == "or"):
+                # implicit adjacency binds with the default operator
+                clauses.append(self.parse_and())
+            else:
+                break
+        clauses = [c for c in clauses if c]
+        if len(clauses) <= 1:
+            return clauses[0] if clauses else None
+        return BoolQuery(should=clauses, minimum_should_match=1)
+
+    def parse_and(self):
+        musts = [self.parse_unary()]
+        while True:
+            nxt = self.peek()
+            if nxt in ("AND", "&&"):
+                self.next()
+                musts.append(self.parse_unary())
+            elif (nxt is not None and nxt not in ("OR", "||", ")")
+                  and self.default_operator == "and"):
+                musts.append(self.parse_unary())
+            else:
+                break
+        musts = [m for m in musts if m]
+        if len(musts) <= 1:
+            return musts[0] if musts else None
+        return BoolQuery(must=musts)
+
+    def parse_unary(self):
+        t = self.peek()
+        if t is None or t in (")", "OR", "||", "AND", "&&"):
+            return None
+        if t == "NOT" or t.startswith("!"):
+            if t == "NOT":
+                self.next()
+            else:
+                self.toks[self.i] = t[1:]
+            inner = self.parse_unary()
+            return BoolQuery(must_not=[inner] if inner else [])
+        return self.parse_atom()
+
+    def parse_atom(self):
+        t = self.next()
+        if t == "(":
+            q = self.parse_or()
+            if self.peek() == ")":
+                self.next()
+            return q
+        negate = False
+        if t.startswith("-") and len(t) > 1:
+            negate, t = True, t[1:]
+        elif t.startswith("+") and len(t) > 1:
+            t = t[1:]
+        field = None
+        if ":" in t and not t.startswith('"'):
+            field, t = t.split(":", 1)
+        q = self._term_query(field, t)
+        if negate:
+            return BoolQuery(must_not=[q])
+        return q
+
+    def _term_query(self, field: Optional[str], text: str) -> QueryBuilder:
+        targets = ([field] if field
+                   else self.fields if self.fields
+                   else [self.default_field] if self.default_field
+                   else None)
+        if text.startswith('"') and text.endswith('"') and len(text) >= 2:
+            phrase = text[1:-1]
+            if targets and len(targets) == 1:
+                return MatchPhraseQuery(targets[0], phrase)
+            return MultiMatchPhrase(targets, phrase)
+        if "*" in text or "?" in text:
+            if targets and len(targets) == 1:
+                return WildcardQuery(targets[0], text)
+            return BoolQuery(should=[WildcardQuery(f, text) for f in (targets or [])],
+                             minimum_should_match=1)
+        if targets and len(targets) == 1:
+            return MatchQuery(targets[0], text)
+        if targets:
+            return MultiMatchQuery(targets, text)
+        return MultiMatchQuery(["*"], text)
+
+
+class MultiMatchPhrase(QueryBuilder):
+    """Phrase over several fields, dis-max combined."""
+
+    name = "multi_match_phrase"
+
+    def __init__(self, fields: Optional[List[str]], phrase: str):
+        super().__init__()
+        self.fields = fields
+        self.phrase = phrase
+
+    def do_execute(self, ctx):
+        fields = self.fields
+        if not fields:
+            fields = [name for name, ft in ctx.mapper.mapper.fields.items()
+                      if isinstance(ft, TextFieldType)]
+        results = [MatchPhraseQuery(f, self.phrase).execute(ctx)
+                   for f in fields]
+        if not results:
+            z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+            return z, z.astype(bool)
+        scores = jnp.stack([s for s, _ in results]).max(axis=0)
+        mask = results[0][1]
+        for _, m in results[1:]:
+            mask = mask | m
+        return scores, mask
+
+
+class QueryStringQuery(QueryBuilder):
+    name = "query_string"
+
+    def __init__(self, query: str, default_field: Optional[str] = None,
+                 fields: Optional[List[str]] = None,
+                 default_operator: str = "or"):
+        super().__init__()
+        self.parsed = _QueryStringParser(
+            query, default_field, fields, default_operator).parse()
+
+    def do_execute(self, ctx):
+        return self.parsed.execute(ctx)
+
+    def rewrite(self, searcher):
+        parsed = self.parsed.rewrite(searcher)
+        if parsed is self.parsed:
+            return self
+        q = QueryStringQuery.__new__(QueryStringQuery)
+        QueryBuilder.__init__(q)
+        q.boost = self.boost
+        q.parsed = parsed
+        return q
+
+
+class SimpleQueryStringQuery(QueryBuilder):
+    """ref: SimpleQueryStringBuilder — never throws; +,|,-,",* operators."""
+
+    name = "simple_query_string"
+
+    def __init__(self, query: str, fields: Optional[List[str]] = None,
+                 default_operator: str = "or"):
+        super().__init__()
+        self.query = query
+        self.fields = fields
+        self.default_operator = default_operator.lower()
+
+    def do_execute(self, ctx):
+        import re as _re
+        toks = _re.findall(r'"[^"]*"|\S+', self.query)
+        must_not, should = [], []
+        groups = [[]]
+        for t in toks:
+            if t == "|":
+                groups.append([])
+                continue
+            groups[-1].append(t)
+
+        def tok_query(tok: str) -> Optional[QueryBuilder]:
+            if tok.startswith('"') and tok.endswith('"') and len(tok) >= 2:
+                return MultiMatchPhrase(self.fields, tok[1:-1])
+            if tok.endswith("*") and len(tok) > 1:
+                fields = self.fields or ["*"]
+                if fields == ["*"]:
+                    fields = [name for name, ft in ctx.mapper.mapper.fields.items()
+                              if isinstance(ft, TextFieldType)]
+                return BoolQuery(should=[PrefixQuery(f, tok[:-1]) for f in fields],
+                                 minimum_should_match=1)
+            return MultiMatchQuery(self.fields or ["*"], tok)
+
+        for group in groups:
+            gclauses = []
+            for tok in group:
+                if tok.startswith("-") and len(tok) > 1:
+                    q = tok_query(tok[1:])
+                    if q:
+                        must_not.append(q)
+                    continue
+                if tok.startswith("+") and len(tok) > 1:
+                    tok = tok[1:]
+                q = tok_query(tok)
+                if q:
+                    gclauses.append(q)
+            if gclauses:
+                inner = (gclauses[0] if len(gclauses) == 1
+                         else BoolQuery(must=gclauses)
+                         if self.default_operator == "and"
+                         else BoolQuery(should=gclauses, minimum_should_match=1))
+                should.append(inner)
+        if not should and not must_not:
+            return MatchAllQuery().execute(ctx)
+        q = BoolQuery(should=should, must_not=must_not,
+                      minimum_should_match=1 if should else None)
+        return q.execute(ctx)
 
 
 # ---------------------------------------------------------------------------
@@ -749,6 +1623,104 @@ def _parse_function_score(spec):
                            score_mode=spec.get("score_mode", "multiply")), spec)
 
 
+
+
+def _single_field_spec(spec, qname: str):
+    """Exactly-one-field specs like {"field": {...}, "boost": 2} — anything
+    else is a 400 parsing_exception, never a raw unpack error."""
+    if not isinstance(spec, dict):
+        raise ParsingException(f"[{qname}] query malformed")
+    entries = [(k, v) for k, v in spec.items() if k != "boost"]
+    if len(entries) != 1:
+        raise ParsingException(
+            f"[{qname}] query requires exactly one field, got "
+            f"{[k for k, _ in entries]}")
+    return entries[0]
+
+
+def _parse_match_phrase(spec):
+    field, params = _single_field_spec(spec, "match_phrase")
+    if isinstance(params, dict):
+        q = MatchPhraseQuery(field, str(params.get("query", "")),
+                             slop=int(params.get("slop", 0)))
+        return _with_boost(q, params)
+    return MatchPhraseQuery(field, str(params))
+
+
+def _parse_match_phrase_prefix(spec):
+    field, params = _single_field_spec(spec, "match_phrase_prefix")
+    if isinstance(params, dict):
+        q = MatchPhrasePrefixQuery(
+            field, str(params.get("query", "")),
+            max_expansions=int(params.get("max_expansions", 50)),
+            slop=int(params.get("slop", 0)))
+        return _with_boost(q, params)
+    return MatchPhrasePrefixQuery(field, str(params))
+
+
+def _parse_match_bool_prefix(spec):
+    field, params = _single_field_spec(spec, "match_bool_prefix")
+    if isinstance(params, dict):
+        return _with_boost(MatchBoolPrefixQuery(
+            field, str(params.get("query", "")),
+            max_expansions=int(params.get("max_expansions", 50))), params)
+    return MatchBoolPrefixQuery(field, str(params))
+
+
+def _parse_prefix(spec):
+    field, params = _single_field_spec(spec, "prefix")
+    if isinstance(params, dict):
+        return _with_boost(PrefixQuery(field, str(params.get("value", ""))),
+                           params)
+    return PrefixQuery(field, str(params))
+
+
+def _parse_wildcard(spec):
+    field, params = _single_field_spec(spec, "wildcard")
+    if isinstance(params, dict):
+        return _with_boost(
+            WildcardQuery(field, str(params.get("value",
+                                                params.get("wildcard", "")))),
+            params)
+    return WildcardQuery(field, str(params))
+
+
+def _parse_regexp(spec):
+    field, params = _single_field_spec(spec, "regexp")
+    if isinstance(params, dict):
+        return _with_boost(RegexpQuery(field, str(params.get("value", ""))),
+                           params)
+    return RegexpQuery(field, str(params))
+
+
+def _parse_fuzzy(spec):
+    field, params = _single_field_spec(spec, "fuzzy")
+    if isinstance(params, dict):
+        return _with_boost(FuzzyQuery(
+            field, str(params.get("value", "")),
+            fuzziness=params.get("fuzziness"),
+            prefix_length=int(params.get("prefix_length", 0)),
+            max_expansions=int(params.get("max_expansions", 50))), params)
+    return FuzzyQuery(field, str(params))
+
+
+def _parse_more_like_this(spec):
+    return _with_boost(MoreLikeThisQuery(
+        spec.get("fields"), spec.get("like", []), unlike=spec.get("unlike"),
+        max_query_terms=int(spec.get("max_query_terms", 25)),
+        min_term_freq=int(spec.get("min_term_freq", 2)),
+        min_doc_freq=int(spec.get("min_doc_freq", 5)),
+        max_doc_freq=spec.get("max_doc_freq"),
+        minimum_should_match=spec.get("minimum_should_match", "30%"),
+        include=bool(spec.get("include", False))), spec)
+
+
+def _parse_pinned(spec):
+    return _with_boost(PinnedQuery(
+        list(spec.get("ids", [])),
+        parse_query(spec.get("organic", {"match_all": {}}))), spec)
+
+
 _PARSERS = {
     "match_all": lambda spec: _with_boost(MatchAllQuery(), spec),
     "match_none": lambda spec: MatchNoneQuery(),
@@ -769,4 +1741,25 @@ _PARSERS = {
     "script_score": _parse_script_score,
     "knn": _parse_knn,
     "function_score": _parse_function_score,
+    "match_phrase": _parse_match_phrase,
+    "match_phrase_prefix": _parse_match_phrase_prefix,
+    "match_bool_prefix": _parse_match_bool_prefix,
+    "prefix": _parse_prefix,
+    "wildcard": _parse_wildcard,
+    "regexp": _parse_regexp,
+    "fuzzy": _parse_fuzzy,
+    "more_like_this": _parse_more_like_this,
+    "pinned": _parse_pinned,
+    "distance_feature": lambda spec: _with_boost(
+        DistanceFeatureQuery(spec["field"], spec["origin"], spec["pivot"]),
+        spec),
+    "query_string": lambda spec: _with_boost(QueryStringQuery(
+        str(spec["query"]) if isinstance(spec, dict) else str(spec),
+        default_field=spec.get("default_field") if isinstance(spec, dict) else None,
+        fields=spec.get("fields") if isinstance(spec, dict) else None,
+        default_operator=spec.get("default_operator", "or")
+        if isinstance(spec, dict) else "or"), spec),
+    "simple_query_string": lambda spec: _with_boost(SimpleQueryStringQuery(
+        str(spec["query"]), fields=spec.get("fields"),
+        default_operator=spec.get("default_operator", "or")), spec),
 }

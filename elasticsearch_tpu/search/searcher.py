@@ -87,6 +87,9 @@ class ShardSearcher:
                     after_key: Optional[Tuple[float, int, int]] = None,
                     collect_masks: bool = False) -> QueryResult:
         k = min(max(size, 1), MAX_TOPK)
+        query = query.rewrite(self)
+        if post_filter is not None:
+            post_filter = post_filter.rewrite(self)
         sort_spec = _parse_sort(sort)
         per_segment: List[Tuple[int, np.ndarray, np.ndarray, np.ndarray]] = []
         total = 0
@@ -166,12 +169,63 @@ class ShardSearcher:
             docs.sort(key=lambda d: _host_sort_key(d, sort_spec))
         return QueryResult(docs, total, max_score, agg_masks)
 
+    # ---------------------------------------------------------- rescore
+    def rescore(self, docs: List[DocAddress],
+                rescore_specs: List[Dict[str, Any]]) -> List[DocAddress]:
+        """Query rescorer (ref: rescore/QueryRescorer.java, run from
+        QueryPhase.execute:152-153): re-scores the top ``window_size``
+        docs of this shard with a (usually costlier) second query. The
+        rescore query executes dense per segment ONCE; per-doc scores are
+        gathered from the result column."""
+        for spec in rescore_specs:
+            window = int(spec.get("window_size", 10))
+            qspec = spec.get("query", {})
+            rq = parse_query(qspec["rescore_query"]).rewrite(self)
+            qw = float(qspec.get("query_weight", 1.0))
+            rqw = float(qspec.get("rescore_query_weight", 1.0))
+            mode = qspec.get("score_mode", "total")
+            seg_cache: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+            contexts = self._contexts()
+            head, tail = docs[:window], docs[window:]
+            for d in head:
+                if d.segment_idx not in seg_cache:
+                    s, m = rq.execute(contexts[d.segment_idx])
+                    seg_cache[d.segment_idx] = (np.asarray(s), np.asarray(m))
+                scores, mask = seg_cache[d.segment_idx]
+                base = qw * d.score
+                if bool(mask[d.docid]):
+                    rs = rqw * float(scores[d.docid])
+                    if mode == "total":
+                        new = base + rs
+                    elif mode == "multiply":
+                        new = base * rs
+                    elif mode == "avg":
+                        new = (base + rs) / 2.0
+                    elif mode == "max":
+                        new = max(base, rs)
+                    elif mode == "min":
+                        new = min(base, rs)
+                    else:
+                        raise IllegalArgumentException(
+                            f"illegal score_mode [{mode}]")
+                else:
+                    new = base  # non-matching docs keep query_weight·score
+                d.score = new
+                d.sort_key = new
+            head.sort(key=lambda d: (-d.score, d.segment_idx, d.docid))
+            docs = head + tail
+        return docs
+
     # ------------------------------------------------------------ fetch
     def fetch_phase(self, docs: List[DocAddress],
                     source_filter: Any = True,
                     docvalue_fields: Optional[List[str]] = None,
                     highlight: Optional[Dict[str, Any]] = None,
-                    highlight_query: Optional[QueryBuilder] = None) -> List[Dict[str, Any]]:
+                    highlight_query: Optional[QueryBuilder] = None,
+                    script_fields: Optional[Dict[str, Any]] = None,
+                    fields: Optional[List[Any]] = None) -> List[Dict[str, Any]]:
+        script_cols = (self._script_field_columns(script_fields)
+                       if script_fields else None)
         hits = []
         for d in docs:
             seg = self.segments[d.segment_idx]
@@ -181,28 +235,88 @@ class ShardSearcher:
             }
             if d.sort_values:
                 hit["sort"] = list(d.sort_values)
+            parsed_source: Optional[Dict[str, Any]] = None
+
+            def get_source(seg=seg, d=d):
+                nonlocal parsed_source
+                if parsed_source is None:
+                    parsed_source = json.loads(seg.stored.source(d.docid))
+                return parsed_source
+
             if source_filter is not False:
-                source = json.loads(seg.stored.source(d.docid))
-                hit["_source"] = _filter_source(source, source_filter)
+                hit["_source"] = _filter_source(get_source(), source_filter)
             if docvalue_fields:
-                fields = {}
+                out = {}
                 for f in docvalue_fields:
                     nv = seg.numerics.get(f)
                     if nv is not None:
                         vs = nv.get(d.docid)
                         if vs:
-                            fields[f] = vs
+                            out[f] = vs
                     kv = seg.keywords.get(f)
                     if kv is not None:
                         vs = kv.get(d.docid)
                         if vs:
-                            fields[f] = vs
-                hit["fields"] = fields
+                            out[f] = vs
+                hit["fields"] = out
+            if fields:
+                # the "fields" retrieval API (ref: FetchFieldsPhase) —
+                # values come from doc values, falling back to _source
+                out = hit.setdefault("fields", {})
+                for f in fields:
+                    fname = f if isinstance(f, str) else f.get("field")
+                    vs = []
+                    nv = seg.numerics.get(fname)
+                    kv = seg.keywords.get(fname)
+                    if nv is not None:
+                        vs = nv.get(d.docid)
+                    if not vs and kv is not None:
+                        vs = kv.get(d.docid)
+                    if not vs:
+                        v = _get_path(get_source(), fname)
+                        if v is not None:
+                            vs = v if isinstance(v, list) else [v]
+                    if vs:
+                        out[fname] = vs
+            if script_cols:
+                out = hit.setdefault("fields", {})
+                for fname, col in script_cols.items():
+                    out[fname] = [float(col[d.segment_idx][d.docid])]
             if highlight:
                 hit["highlight"] = self._highlight(seg, d.docid, highlight,
                                                    highlight_query)
             hits.append(hit)
         return hits
+
+    def _script_field_columns(self, script_fields: Dict[str, Any]):
+        """Evaluate each script field ONCE per segment as a dense column
+        (ref: search/fetch/subphase/ScriptFieldsPhase — but columnar, not
+        per-doc)."""
+        from elasticsearch_tpu.search.script import (
+            ScriptContext,
+            _DocColumn,
+            compile_script,
+        )
+        cols: Dict[str, List[np.ndarray]] = {}
+        contexts = self._contexts()
+        for fname, spec in script_fields.items():
+            script = spec.get("script", spec) if isinstance(spec, dict) else spec
+            source = (script.get("source") if isinstance(script, dict)
+                      else str(script))
+            params = script.get("params", {}) if isinstance(script, dict) else {}
+            compiled = compile_script(source)
+            per_seg = []
+            for ctx in contexts:
+                def doc_columns(field, ctx=ctx):
+                    col, miss = ctx.numeric_column(field)
+                    return _DocColumn(col, miss)
+                sctx = ScriptContext(doc_columns, params)
+                val = np.broadcast_to(
+                    np.asarray(compiled(sctx), np.float32),
+                    (ctx.n_docs_padded,))
+                per_seg.append(val)
+            cols[fname] = per_seg
+        return cols
 
     def _highlight(self, seg: Segment, docid: int, spec: Dict[str, Any],
                    query: Optional[QueryBuilder]) -> Dict[str, List[str]]:

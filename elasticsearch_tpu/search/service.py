@@ -33,6 +33,36 @@ from elasticsearch_tpu.search.searcher import (
 DEFAULT_SIZE = 10
 
 
+class _CoordinatorRewriteContext:
+    """A searcher-shaped view over every shard, for coordinator rewrites
+    (ref: Rewriteable's coordinator-rewrite stage): ``segments`` spans all
+    shards so doc lookups resolve wherever the doc lives, and stats are
+    index-wide."""
+
+    def __init__(self, shard_searchers: List[ShardSearcher]):
+        from elasticsearch_tpu.search.context import ShardStats
+        self.segments = [seg for s in shard_searchers for seg in s.segments]
+        self.mapper = shard_searchers[0].mapper
+        self.stats = ShardStats(self.segments)
+
+
+def _doc_field_value(searcher: ShardSearcher, d: DocAddress, field: str):
+    """First doc-value for a doc (collapse keys, missing → None)."""
+    seg = searcher.segments[d.segment_idx]
+    kv = seg.keywords.get(field)
+    if kv is None:
+        # dynamic text fields carry their doc values on .keyword
+        kv = seg.keywords.get(f"{field}.keyword")
+    if kv is not None:
+        vs = kv.get(d.docid)
+        if vs:
+            return vs[0]
+    nv = seg.numerics.get(field)
+    if nv is not None and not nv.missing[d.docid]:
+        return float(nv.values[d.docid])
+    return None
+
+
 @dataclass
 class ScrollContext:
     """A pinned point-in-time over shard snapshots + continuation cursor
@@ -130,6 +160,13 @@ class SearchService:
         body = body or {}
         query = (parse_query(body["query"]) if body.get("query")
                  else MatchAllQuery())
+        if searchers:
+            # coordinator-level rewrite: doc-resolving queries (e.g.
+            # more_like_this) see ALL shards' segments, not just one
+            # shard's (ref: the reference resolves like-docs with index
+            # routing before the shard fan-out)
+            query = query.rewrite(_CoordinatorRewriteContext(
+                [s for _, s in searchers]))
         post_filter = (parse_query(body["post_filter"])
                        if body.get("post_filter") else None)
         size = int(body.get("size", DEFAULT_SIZE))
@@ -145,22 +182,59 @@ class SearchService:
         highlight = body.get("highlight")
         aggs_spec = body.get("aggs", body.get("aggregations"))
         collect_masks = bool(aggs_spec) and not continuing
+        rescore_spec = body.get("rescore")
+        if rescore_spec is not None:
+            if sort is not None:
+                raise IllegalArgumentException(
+                    "Cannot use [sort] option in conjunction with [rescore].")
+            if isinstance(rescore_spec, dict):
+                rescore_spec = [rescore_spec]
+        collapse_field = (body.get("collapse") or {}).get("field")
+        profile = bool(body.get("profile"))
+        terminate_after = body.get("terminate_after")
 
         k = from_ + size if scroll_ctx is None else size
+        # rescore windows may exceed the page size (ref: RescorePhase
+        # collects max(window_size) docs per shard)
+        query_k = k
+        if rescore_spec:
+            query_k = max(k, max(int(r.get("window_size", 10))
+                                 for r in rescore_spec))
+        if collapse_field:
+            # over-collect so enough distinct groups survive the collapse
+            query_k = max(query_k, k * 5)
 
         # ---- query phase: fan out over shards (ref:
         # AbstractSearchAsyncAction.run / SearchPhaseController merge)
         shard_results: List[Tuple[str, ShardSearcher, QueryResult]] = []
+        profile_shards: List[Dict[str, Any]] = []
         total = 0
         max_score = None
         for shard_idx, (index_name, searcher) in enumerate(searchers):
             after_key = (scroll_ctx.cursors.get(shard_idx)
                          if (scroll_ctx is not None and continuing) else None)
+            t0 = time.monotonic_ns()
             result = searcher.query_phase(
-                query, k, post_filter=post_filter, min_score=min_score,
+                query, query_k, post_filter=post_filter, min_score=min_score,
                 sort=sort, search_after=search_after,
                 track_total_hits=bool(track_total) and not continuing,
                 after_key=after_key, collect_masks=collect_masks)
+            if terminate_after:
+                # the shard "stops collecting" after terminate_after docs
+                result.docs[:] = result.docs[: int(terminate_after)]
+            if rescore_spec:
+                result.docs[:] = searcher.rescore(result.docs, rescore_spec)
+            if profile:
+                qtype = next(iter(body.get("query") or {"match_all": {}}))
+                profile_shards.append({
+                    "id": f"[{index_name}][{shard_idx}]",
+                    "searches": [{"query": [{
+                        "type": qtype,
+                        "description": str(body.get("query", {})),
+                        "time_in_nanos": time.monotonic_ns() - t0,
+                    }], "rewrite_time": 0, "collector": []}],
+                    "aggregations": [],
+                })
             shard_results.append((index_name, searcher, result))
             total += result.total_hits
             if result.max_score is not None:
@@ -173,6 +247,22 @@ class SearchService:
             for d in result.docs:
                 merged.append((d.sort_key, shard_idx, d, index_name, searcher))
         merged.sort(key=lambda e: (-e[0], e[1], e[2].segment_idx, e[2].docid))
+
+        # ---- field collapsing (ref: collapse/CollapseBuilder + coordinator
+        # keeping the best hit per group): first hit per key wins; docs
+        # missing the key form a single null group
+        if collapse_field:
+            seen_keys = set()
+            collapsed = []
+            for entry in merged:
+                _, _, d, _, searcher = entry
+                key = _doc_field_value(searcher, d, collapse_field)
+                hashable = key if not isinstance(key, list) else tuple(key)
+                if hashable in seen_keys:
+                    continue
+                seen_keys.add(hashable)
+                collapsed.append(entry)
+            merged = collapsed
         page = merged[from_:from_ + size] if scroll_ctx is None else merged[:size]
 
         # update scroll cursors with the last emitted doc per shard
@@ -185,13 +275,32 @@ class SearchService:
         source_filter = body.get("_source", True)
         docvalue_fields = [f if isinstance(f, str) else f.get("field")
                            for f in body.get("docvalue_fields", [])]
-        for _, _, d, index_name, searcher in page:
-            fetched = searcher.fetch_phase(
-                [d], source_filter=source_filter,
+        script_fields = body.get("script_fields")
+        fields_spec = body.get("fields")
+        # group page docs by shard so per-request work (script-field
+        # columns, highlighters) runs once per shard, not once per hit
+        by_shard: Dict[int, List[Tuple[int, DocAddress]]] = {}
+        shard_info: Dict[int, Tuple[str, ShardSearcher]] = {}
+        for pos, (_, shard_idx, d, index_name, searcher) in enumerate(page):
+            by_shard.setdefault(shard_idx, []).append((pos, d))
+            shard_info[shard_idx] = (index_name, searcher)
+        hits_by_pos: Dict[int, Dict[str, Any]] = {}
+        for shard_idx, entries in by_shard.items():
+            index_name, searcher = shard_info[shard_idx]
+            docs = [d for _, d in entries]
+            fetched_list = searcher.fetch_phase(
+                docs, source_filter=source_filter,
                 docvalue_fields=docvalue_fields or None,
-                highlight=highlight, highlight_query=query)[0]
-            fetched["_index"] = index_name
-            hits.append(fetched)
+                highlight=highlight, highlight_query=query,
+                script_fields=script_fields, fields=fields_spec)
+            for (pos, d), fetched in zip(entries, fetched_list):
+                fetched["_index"] = index_name
+                if collapse_field:
+                    key = _doc_field_value(searcher, d, collapse_field)
+                    fetched.setdefault("fields", {})[collapse_field] = (
+                        key if isinstance(key, list) else [key])
+                hits_by_pos[pos] = fetched
+        hits = [hits_by_pos[i] for i in sorted(hits_by_pos)]
 
         # ---- aggregation phase (ref: AggregationPhase; reduce is trivial
         # here since all shards are in-process — masks concatenate)
@@ -210,6 +319,12 @@ class SearchService:
             aggregations = compute_aggs(aggs_spec, agg_ctx, default_mapper,
                                         cache)
 
+        # ---- suggest phase (ref: SuggestPhase, search/suggest/)
+        suggest = None
+        if body.get("suggest"):
+            from elasticsearch_tpu.search.suggest import compute_suggest
+            suggest = compute_suggest(body["suggest"], searchers)
+
         relation = "eq"
         if scroll_ctx is not None:
             if continuing:
@@ -219,6 +334,19 @@ class SearchService:
         if isinstance(track_total, int) and not isinstance(track_total, bool):
             if total > track_total:
                 total = track_total
+                relation = "gte"
+        terminated_early = None
+        if terminate_after:
+            # per-shard early termination semantics (ref: EarlyTerminating-
+            # Collector): each shard reports at most terminate_after docs;
+            # execution here is dense, so only the counts are clamped —
+            # never below the number of hits actually returned
+            ta = int(terminate_after)
+            clamped = sum(min(r.total_hits, ta) for _, _, r in shard_results)
+            terminated_early = any(r.total_hits > ta
+                                   for _, _, r in shard_results)
+            if terminated_early:
+                total = clamped
                 relation = "gte"
         response = {
             "timed_out": False,
@@ -230,9 +358,52 @@ class SearchService:
                 "hits": hits,
             },
         }
+        if terminated_early is not None:
+            response["terminated_early"] = terminated_early
         if aggregations is not None:
             response["aggregations"] = aggregations
+        if suggest is not None:
+            response["suggest"] = suggest
+        if profile:
+            response["profile"] = {"shards": profile_shards}
         return response
+
+    # ------------------------------------------------------------ explain
+    def explain(self, index: str, doc_id: str,
+                body: Dict[str, Any]) -> Dict[str, Any]:
+        """_explain API (ref: action/explain/TransportExplainAction): run
+        the query against the shard holding the doc and report its score."""
+        names = self.indices_service.resolve(index)
+        query = (parse_query(body["query"]) if body.get("query")
+                 else MatchAllQuery())
+        for name in names:
+            idx = self.indices_service.get(name)
+            for searcher in idx.shard_searchers():
+                q = query.rewrite(searcher)
+                for seg_idx, seg in enumerate(searcher.segments):
+                    d = seg.docid_for(doc_id)
+                    if d < 0:
+                        continue
+                    contexts = searcher._contexts()
+                    import numpy as _np
+                    scores, mask = q.execute(contexts[seg_idx])
+                    matched = bool(_np.asarray(mask)[d])
+                    score = float(_np.asarray(scores)[d]) if matched else 0.0
+                    return {
+                        "_index": name, "_id": doc_id, "matched": matched,
+                        "explanation": {
+                            "value": score,
+                            "description": ("sum of BM25 term scores "
+                                            "(TPU dense kernel)" if matched
+                                            else "no matching term"),
+                            "details": [],
+                        },
+                    }
+        return {"_index": names[0] if names else index, "_id": doc_id,
+                "matched": False,
+                "explanation": {"value": 0.0,
+                                "description": "document not found",
+                                "details": []}}
 
     def count(self, index_expression: str, body: Dict[str, Any]) -> Dict[str, Any]:
         body = dict(body or {})
