@@ -94,6 +94,10 @@ class ParsingException(ElasticsearchTpuException):
     status = 400
 
 
+class ResourceNotFoundException(ElasticsearchTpuException):
+    status = 404
+
+
 class IllegalArgumentException(ElasticsearchTpuException):
     status = 400
 

@@ -1,0 +1,7 @@
+from elasticsearch_tpu.ingest.service import (
+    IngestDocument,
+    IngestService,
+    Pipeline,
+)
+
+__all__ = ["IngestDocument", "IngestService", "Pipeline"]

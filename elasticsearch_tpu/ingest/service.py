@@ -1,0 +1,1024 @@
+"""Ingest pipelines: per-document transforms before indexing.
+
+Mirrors the reference's ingest layer (ref: ingest/IngestService.java:81,
+449,508 — pipeline registry + executeBulkRequest detour; ingest/
+Pipeline.java, CompoundProcessor.java — processor chain with on_failure;
+modules/ingest-common — the ~30 built-in processor types, of which the
+core set is implemented here). Pipelines run on the host CPU — this is
+string/JSON work with no batch structure, exactly the part of the stack
+that should NOT be on the TPU.
+
+Supported processors: set, remove, rename, convert, lowercase, uppercase,
+trim, split, join, append, gsub, date, json, fail, drop, script, pipeline,
+dissect (lite), grok (lite — named COMMONAPACHELOG-style patterns are out
+of scope; %{NAME:field} with regex classes works), foreach, dot_expander,
+csv, kv, html_strip, urldecode, bytes, uppercase/lowercase, fingerprint.
+
+Failure handling matches the reference: a processor failure aborts the
+pipeline unless the processor (or pipeline) declares ``on_failure``
+handlers, which then run with the error recorded in ingest metadata
+(ref: CompoundProcessor.executeOnFailure).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import threading
+import time
+from html.parser import HTMLParser
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import unquote
+
+from elasticsearch_tpu.common.errors import (
+    ElasticsearchTpuException,
+    IllegalArgumentException,
+    ResourceNotFoundException,
+)
+
+
+class IngestProcessorException(ElasticsearchTpuException):
+    status = 500
+
+
+class DropException(Exception):
+    """Raised by the drop processor — the document is silently discarded."""
+
+
+class _PipelineCycleError(IngestProcessorException):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Document model
+# ---------------------------------------------------------------------------
+
+class IngestDocument:
+    """Mutable document under transformation (ref: ingest/IngestDocument
+    — dot-path field access over source + metadata + ingest metadata)."""
+
+    def __init__(self, source: Dict[str, Any], index: Optional[str] = None,
+                 doc_id: Optional[str] = None,
+                 routing: Optional[str] = None):
+        self.source = source
+        self.meta: Dict[str, Any] = {"_index": index, "_id": doc_id}
+        if routing is not None:
+            self.meta["_routing"] = routing
+        self.ingest_meta: Dict[str, Any] = {
+            "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime()),
+        }
+
+    # -- dot-path access ----------------------------------------------------
+    def _resolve(self, path: str, create: bool = False
+                 ) -> Tuple[Dict[str, Any], str]:
+        if path.startswith("_ingest."):
+            return self.ingest_meta, path[len("_ingest."):]
+        if path in self.meta or path in ("_index", "_id", "_routing"):
+            return self.meta, path
+        node = self.source
+        parts = path.split(".")
+        for p in parts[:-1]:
+            if not isinstance(node, dict):
+                raise IngestProcessorException(
+                    f"cannot resolve [{path}]: [{p}] is not an object")
+            if p not in node:
+                if not create:
+                    raise IngestProcessorException(
+                        f"field [{path}] not present as part of path [{p}]")
+                node[p] = {}
+            node = node[p]
+        return node, parts[-1]
+
+    def has(self, path: str) -> bool:
+        try:
+            node, leaf = self._resolve(path)
+        except IngestProcessorException:
+            return False
+        return isinstance(node, dict) and leaf in node
+
+    def get(self, path: str, default=None):
+        try:
+            node, leaf = self._resolve(path)
+        except IngestProcessorException:
+            return default
+        if isinstance(node, dict) and leaf in node:
+            return node[leaf]
+        return default
+
+    def set(self, path: str, value: Any) -> None:
+        node, leaf = self._resolve(path, create=True)
+        node[leaf] = value
+
+    def remove(self, path: str) -> None:
+        node, leaf = self._resolve(path)
+        if not isinstance(node, dict) or leaf not in node:
+            raise IngestProcessorException(f"field [{path}] not present")
+        del node[leaf]
+
+    def render(self, template: str) -> str:
+        """Mustache-lite ``{{field}}`` / ``{{{field}}}`` substitution
+        (ref: lang-mustache used by set/fail templates)."""
+        def sub(m):
+            v = self.get(m.group(1).strip())
+            return "" if v is None else str(v)
+        out = re.sub(r"\{\{\{(.+?)\}\}\}", sub, template)
+        return re.sub(r"\{\{(.+?)\}\}", sub, out)
+
+
+# ---------------------------------------------------------------------------
+# Processors
+# ---------------------------------------------------------------------------
+
+Processor = Callable[[IngestDocument], None]
+_PROCESSOR_FACTORIES: Dict[str, Callable[[Dict[str, Any], "IngestService"],
+                                         Processor]] = {}
+
+
+def processor(name: str):
+    def deco(factory):
+        _PROCESSOR_FACTORIES[name] = factory
+        return factory
+    return deco
+
+
+def _if_wraps(cfg: Dict[str, Any], fn: Processor) -> Processor:
+    """Conditional execution (ref: ConditionalProcessor — painless `if`;
+    here the same sandboxed expression engine, evaluated per doc)."""
+    cond = cfg.get("if")
+    if cond is None:
+        return fn
+    compiled = _compile_condition(cond)
+
+    def wrapped(doc: IngestDocument):
+        if compiled(doc):
+            fn(doc)
+    return wrapped
+
+
+@processor("set")
+def _set(cfg, svc):
+    field = cfg["field"]
+    override = cfg.get("override", True)
+    value = cfg.get("value")
+    copy_from = cfg.get("copy_from")
+
+    def fn(doc):
+        if not override and doc.get(field) is not None:
+            return
+        if copy_from is not None:
+            doc.set(field, doc.get(copy_from))
+        elif isinstance(value, str):
+            doc.set(field, doc.render(value))
+        else:
+            doc.set(field, value)
+    return fn
+
+
+@processor("remove")
+def _remove(cfg, svc):
+    fields = cfg["field"]
+    if isinstance(fields, str):
+        fields = [fields]
+    ignore_missing = cfg.get("ignore_missing", False)
+
+    def fn(doc):
+        for f in fields:
+            try:
+                doc.remove(f)
+            except IngestProcessorException:
+                if not ignore_missing:
+                    raise
+    return fn
+
+
+@processor("rename")
+def _rename(cfg, svc):
+    field, target = cfg["field"], cfg["target_field"]
+    ignore_missing = cfg.get("ignore_missing", False)
+
+    def fn(doc):
+        if not doc.has(field):
+            if ignore_missing:
+                return
+            raise IngestProcessorException(f"field [{field}] not present")
+        doc.set(target, doc.get(field))
+        doc.remove(field)
+    return fn
+
+
+@processor("convert")
+def _convert(cfg, svc):
+    field = cfg["field"]
+    target = cfg.get("target_field", field)
+    type_ = cfg["type"]
+    ignore_missing = cfg.get("ignore_missing", False)
+    casts = {
+        "integer": int, "long": int, "float": float, "double": float,
+        "string": str,
+        "boolean": lambda v: (v if isinstance(v, bool)
+                              else str(v).lower() == "true"),
+        "auto": lambda v: _auto_cast(v),
+    }
+    if type_ not in casts:
+        raise IllegalArgumentException(f"type [{type_}] not supported")
+    cast = casts[type_]
+
+    def fn(doc):
+        v = doc.get(field)
+        if v is None:
+            if ignore_missing:
+                return
+            raise IngestProcessorException(f"field [{field}] not present")
+        try:
+            doc.set(target, [cast(x) for x in v] if isinstance(v, list)
+                    else cast(v))
+        except (ValueError, TypeError) as e:
+            raise IngestProcessorException(
+                f"unable to convert [{v}] to {type_}: {e}")
+    return fn
+
+
+def _auto_cast(v):
+    if isinstance(v, (int, float, bool)):
+        return v
+    s = str(v)
+    for cast in (int, float):
+        try:
+            return cast(s)
+        except ValueError:
+            pass
+    if s.lower() in ("true", "false"):
+        return s.lower() == "true"
+    return s
+
+
+def _string_transform(name: str, transform: Callable[[str], str]):
+    @processor(name)
+    def _factory(cfg, svc, _t=transform):
+        field = cfg["field"]
+        target = cfg.get("target_field", field)
+        ignore_missing = cfg.get("ignore_missing", False)
+
+        def fn(doc):
+            v = doc.get(field)
+            if v is None:
+                if ignore_missing:
+                    return
+                raise IngestProcessorException(f"field [{field}] not present")
+            doc.set(target, [_t(x) for x in v] if isinstance(v, list)
+                    else _t(v))
+        return fn
+    return _factory
+
+
+_string_transform("lowercase", str.lower)
+_string_transform("uppercase", str.upper)
+_string_transform("trim", str.strip)
+_string_transform("urldecode", unquote)
+
+
+@processor("split")
+def _split(cfg, svc):
+    field = cfg["field"]
+    target = cfg.get("target_field", field)
+    sep = re.compile(cfg["separator"])
+    preserve = cfg.get("preserve_trailing", False)
+
+    def fn(doc):
+        v = doc.get(field)
+        if v is None:
+            if cfg.get("ignore_missing"):
+                return
+            raise IngestProcessorException(f"field [{field}] not present")
+        parts = sep.split(str(v))
+        if not preserve:
+            while parts and parts[-1] == "":
+                parts.pop()
+        doc.set(target, parts)
+    return fn
+
+
+@processor("join")
+def _join(cfg, svc):
+    field = cfg["field"]
+    target = cfg.get("target_field", field)
+    sep = cfg["separator"]
+
+    def fn(doc):
+        v = doc.get(field)
+        if not isinstance(v, list):
+            raise IngestProcessorException(
+                f"field [{field}] of type [{type(v).__name__}] cannot be "
+                "joined")
+        doc.set(target, sep.join(str(x) for x in v))
+    return fn
+
+
+@processor("append")
+def _append(cfg, svc):
+    field = cfg["field"]
+    value = cfg["value"]
+    allow_dups = cfg.get("allow_duplicates", True)
+
+    def fn(doc):
+        cur = doc.get(field)
+        if cur is None:
+            cur = []
+        elif not isinstance(cur, list):
+            cur = [cur]
+        else:
+            cur = list(cur)
+        add = value if isinstance(value, list) else [value]
+        add = [doc.render(v) if isinstance(v, str) else v for v in add]
+        for v in add:
+            if allow_dups or v not in cur:
+                cur.append(v)
+        doc.set(field, cur)
+    return fn
+
+
+@processor("gsub")
+def _gsub(cfg, svc):
+    field = cfg["field"]
+    target = cfg.get("target_field", field)
+    pat = re.compile(cfg["pattern"])
+    replacement = cfg["replacement"]
+
+    def fn(doc):
+        v = doc.get(field)
+        if v is None:
+            if cfg.get("ignore_missing"):
+                return
+            raise IngestProcessorException(f"field [{field}] not present")
+        doc.set(target, pat.sub(replacement, str(v)))
+    return fn
+
+
+_DATE_FORMATS = {
+    "ISO8601": None,  # handled by fromisoformat-ish parsing
+    "UNIX": "unix", "UNIX_MS": "unix_ms",
+}
+
+
+@processor("date")
+def _date(cfg, svc):
+    field = cfg["field"]
+    target = cfg.get("target_field", "@timestamp")
+    formats = cfg.get("formats", ["ISO8601"])
+
+    def fn(doc):
+        from datetime import datetime, timezone
+        v = doc.get(field)
+        if v is None:
+            raise IngestProcessorException(f"field [{field}] not present")
+        for fmt in formats:
+            try:
+                if fmt == "ISO8601":
+                    s = str(v).replace("Z", "+00:00")
+                    dt = datetime.fromisoformat(s)
+                elif fmt == "UNIX":
+                    dt = datetime.fromtimestamp(float(v), tz=timezone.utc)
+                elif fmt == "UNIX_MS":
+                    dt = datetime.fromtimestamp(float(v) / 1000.0,
+                                                tz=timezone.utc)
+                else:
+                    dt = datetime.strptime(str(v), fmt)
+                if dt.tzinfo is None:
+                    dt = dt.replace(tzinfo=timezone.utc)
+                doc.set(target, dt.isoformat().replace("+00:00", "Z"))
+                return
+            except (ValueError, OverflowError):
+                continue
+        raise IngestProcessorException(
+            f"unable to parse date [{v}] with formats {formats}")
+    return fn
+
+
+@processor("json")
+def _json(cfg, svc):
+    field = cfg["field"]
+    target = cfg.get("target_field", field)
+    add_to_root = cfg.get("add_to_root", False)
+
+    def fn(doc):
+        v = doc.get(field)
+        try:
+            parsed = json.loads(v)
+        except (TypeError, json.JSONDecodeError) as e:
+            raise IngestProcessorException(f"unable to parse JSON: {e}")
+        if add_to_root:
+            if not isinstance(parsed, dict):
+                raise IngestProcessorException(
+                    "cannot add non-object to document root")
+            doc.source.update(parsed)
+        else:
+            doc.set(target, parsed)
+    return fn
+
+
+@processor("fail")
+def _fail(cfg, svc):
+    message = cfg["message"]
+
+    def fn(doc):
+        raise IngestProcessorException(doc.render(message))
+    return fn
+
+
+@processor("drop")
+def _drop(cfg, svc):
+    def fn(doc):
+        raise DropException()
+    return fn
+
+
+@processor("script")
+def _script(cfg, svc):
+    script = cfg.get("script", cfg)
+    source = script.get("source") if isinstance(script, dict) else str(script)
+    params = script.get("params", {}) if isinstance(script, dict) else {}
+    compiled = _compile_ingest_script(source)
+
+    def fn(doc):
+        compiled(doc, params)
+    return fn
+
+
+@processor("pipeline")
+def _pipeline(cfg, svc):
+    name = cfg["name"]
+
+    def fn(doc):
+        svc.run_pipeline(name, doc)
+    return fn
+
+
+@processor("foreach")
+def _foreach(cfg, svc):
+    field = cfg["field"]
+    inner_cfg = cfg["processor"]
+    (ptype, pcfg), = inner_cfg.items()
+    inner = _PROCESSOR_FACTORIES[ptype](pcfg, svc)
+
+    def fn(doc):
+        values = doc.get(field)
+        if not isinstance(values, list):
+            raise IngestProcessorException(
+                f"field [{field}] is not a list")
+        out = []
+        for v in values:
+            sub = IngestDocument({"_value": v})
+            sub.meta = doc.meta
+            inner(sub)
+            out.append(sub.source.get("_value"))
+        doc.set(field, out)
+    return fn
+
+
+@processor("dot_expander")
+def _dot_expander(cfg, svc):
+    field = cfg["field"]
+
+    def fn(doc):
+        if field == "*":
+            keys = [k for k in list(doc.source) if "." in k]
+        else:
+            keys = [field] if field in doc.source else []
+        for k in keys:
+            v = doc.source.pop(k)
+            doc.set(k, v)
+    return fn
+
+
+@processor("csv")
+def _csv(cfg, svc):
+    field = cfg["field"]
+    targets = cfg["target_fields"]
+    sep = cfg.get("separator", ",")
+    quote = cfg.get("quote", '"')
+
+    def fn(doc):
+        import csv as _csv
+        import io
+        v = doc.get(field)
+        if v is None:
+            if cfg.get("ignore_missing"):
+                return
+            raise IngestProcessorException(f"field [{field}] not present")
+        row = next(_csv.reader(io.StringIO(str(v)), delimiter=sep,
+                               quotechar=quote))
+        for t, val in zip(targets, row):
+            doc.set(t, val)
+    return fn
+
+
+@processor("kv")
+def _kv(cfg, svc):
+    field = cfg["field"]
+    field_split = cfg["field_split"]
+    value_split = cfg["value_split"]
+    target = cfg.get("target_field")
+    prefix = cfg.get("prefix", "")
+
+    def fn(doc):
+        v = doc.get(field)
+        if v is None:
+            if cfg.get("ignore_missing"):
+                return
+            raise IngestProcessorException(f"field [{field}] not present")
+        for pair in re.split(field_split, str(v)):
+            if not pair:
+                continue
+            parts = re.split(value_split, pair, maxsplit=1)
+            if len(parts) != 2:
+                continue
+            key = prefix + parts[0]
+            doc.set(f"{target}.{key}" if target else key, parts[1])
+    return fn
+
+
+class _HTMLStripper(HTMLParser):
+    def __init__(self):
+        super().__init__()
+        self.chunks: List[str] = []
+
+    def handle_data(self, data):
+        self.chunks.append(data)
+
+
+@processor("html_strip")
+def _html_strip(cfg, svc):
+    field = cfg["field"]
+    target = cfg.get("target_field", field)
+
+    def fn(doc):
+        v = doc.get(field)
+        if v is None:
+            if cfg.get("ignore_missing"):
+                return
+            raise IngestProcessorException(f"field [{field}] not present")
+        stripper = _HTMLStripper()
+        stripper.feed(str(v))
+        doc.set(target, "".join(stripper.chunks))
+    return fn
+
+
+@processor("bytes")
+def _bytes(cfg, svc):
+    field = cfg["field"]
+    target = cfg.get("target_field", field)
+    units = {"b": 1, "kb": 1024, "mb": 1024**2, "gb": 1024**3,
+             "tb": 1024**4, "pb": 1024**5}
+
+    def fn(doc):
+        v = str(doc.get(field)).strip().lower()
+        m = re.fullmatch(r"([\d.]+)\s*([kmgtp]?b)", v)
+        if not m:
+            raise IngestProcessorException(
+                f"failed to parse setting [{field}] with value [{v}]")
+        doc.set(target, int(float(m.group(1)) * units[m.group(2)]))
+    return fn
+
+
+@processor("fingerprint")
+def _fingerprint(cfg, svc):
+    fields = sorted(cfg["fields"])
+    target = cfg.get("target_field", "fingerprint")
+    method = cfg.get("method", "SHA-1").lower().replace("-", "")
+
+    def fn(doc):
+        h = hashlib.new(method)
+        for f in fields:
+            v = doc.get(f)
+            if v is not None:
+                h.update(f.encode())
+                h.update(json.dumps(v, sort_keys=True, default=str).encode())
+        doc.set(target, h.hexdigest())
+    return fn
+
+
+@processor("dissect")
+def _dissect(cfg, svc):
+    """Lite dissect: %{key} segments split on the literal text between
+    them (ref: ingest-common DissectProcessor)."""
+    field = cfg["field"]
+    pattern = cfg["pattern"]
+    parts = re.split(r"%\{(.*?)\}", pattern)
+    # parts = [lit0, key1, lit1, key2, lit2, ...]
+
+    def fn(doc):
+        v = str(doc.get(field, ""))
+        pos = 0
+        if parts[0]:
+            if not v.startswith(parts[0]):
+                raise IngestProcessorException(
+                    f"dissect pattern did not match [{v}]")
+            pos = len(parts[0])
+        for i in range(1, len(parts), 2):
+            key = parts[i]
+            lit = parts[i + 1] if i + 1 < len(parts) else ""
+            if lit:
+                end = v.find(lit, pos)
+                if end < 0:
+                    raise IngestProcessorException(
+                        f"dissect pattern did not match [{v}]")
+            else:
+                end = len(v)
+            if key and not key.startswith("?"):
+                doc.set(key, v[pos:end])
+            pos = end + len(lit)
+    return fn
+
+
+@processor("grok")
+def _grok(cfg, svc):
+    """Lite grok: %{PATTERN:field} with a small built-in pattern set
+    (ref: ingest-common GrokProcessor; full Oniguruma pattern library out
+    of scope)."""
+    field = cfg["field"]
+    patterns = cfg["patterns"]
+    builtins = {
+        "WORD": r"\w+", "NUMBER": r"[-+]?\d+(?:\.\d+)?", "INT": r"[-+]?\d+",
+        "IP": r"\d{1,3}(?:\.\d{1,3}){3}", "DATA": r".*?", "GREEDYDATA": r".*",
+        "NOTSPACE": r"\S+", "SPACE": r"\s+", "UUID": r"[0-9a-fA-F-]{36}",
+        "LOGLEVEL": r"(?:TRACE|DEBUG|INFO|WARN|ERROR|FATAL)",
+    }
+    compiled = []
+    for p in patterns:
+        def repl(m):
+            pat, _, name = m.group(1).partition(":")
+            base = builtins.get(pat, r".*?")
+            return f"(?P<{name}>{base})" if name else f"(?:{base})"
+        compiled.append(re.compile(re.sub(r"%\{(.*?)\}", repl, p)))
+
+    def fn(doc):
+        v = str(doc.get(field, ""))
+        for rx in compiled:
+            m = rx.search(v)
+            if m:
+                for k, val in m.groupdict().items():
+                    if val is not None:
+                        doc.set(k, val)
+                return
+        raise IngestProcessorException(
+            f"Provided Grok expressions do not match field value: [{v}]")
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# Ingest scripts / conditions (sandboxed per-doc expression evaluation —
+# the scalar sibling of the columnar search script engine)
+# ---------------------------------------------------------------------------
+
+import ast as _ast
+
+_ING_ALLOWED = (
+    _ast.Expression, _ast.Module, _ast.Expr, _ast.Assign, _ast.BinOp,
+    _ast.UnaryOp, _ast.BoolOp, _ast.Compare, _ast.Call, _ast.Attribute,
+    _ast.Subscript, _ast.Name, _ast.Constant, _ast.Load, _ast.Store,
+    _ast.Add, _ast.Sub, _ast.Mult, _ast.Div, _ast.Mod, _ast.Pow,
+    _ast.FloorDiv, _ast.USub, _ast.UAdd, _ast.Not, _ast.And, _ast.Or,
+    _ast.Eq, _ast.NotEq, _ast.Lt, _ast.LtE, _ast.Gt, _ast.GtE,
+    _ast.IfExp, _ast.List, _ast.Dict, _ast.Tuple, _ast.In, _ast.NotIn,
+    _ast.Is, _ast.IsNot,
+)
+
+_SCRIPT_CACHE: Dict[str, Any] = {}
+_SCRIPT_LOCK = threading.Lock()
+
+
+class _AttrDict(dict):
+    """params.name attribute access in ingest scripts."""
+
+    def __getattr__(self, name):
+        return self.get(name)
+
+
+class _CtxView:
+    """`ctx` object for ingest scripts: attribute/key access to source."""
+
+    def __init__(self, doc: IngestDocument):
+        object.__setattr__(self, "_doc", doc)
+
+    def __getattr__(self, name):
+        if name.startswith("_") and name in self._doc.meta:
+            return self._doc.meta[name]
+        return self._doc.source.get(name)
+
+    def __setattr__(self, name, value):
+        self._doc.source[name] = value
+
+    def __getitem__(self, name):
+        return self.__getattr__(name)
+
+    def __setitem__(self, name, value):
+        self._doc.source[name] = value
+
+    def __contains__(self, name):
+        return name in self._doc.source or name in self._doc.meta
+
+
+def _validate_ingest(tree, source: str):
+    for node in _ast.walk(tree):
+        if not isinstance(node, _ING_ALLOWED):
+            raise IllegalArgumentException(
+                f"ingest script: disallowed construct "
+                f"[{type(node).__name__}] in [{source}]")
+        if isinstance(node, _ast.Name) and node.id not in (
+                "ctx", "params", "len", "str", "int", "float", "bool",
+                "True", "False", "None"):
+            raise IllegalArgumentException(
+                f"ingest script: unknown name [{node.id}] in [{source}]")
+
+
+def _compile_ingest_script(source: str):
+    with _SCRIPT_LOCK:
+        cached = _SCRIPT_CACHE.get(("script", source))
+    if cached is not None:
+        return cached
+    # Painless-style `ctx.field = ...; ...` statements
+    py = _painless_to_py(source, statements=True)
+    tree = _ast.parse(py, mode="exec")
+    _validate_ingest(tree, source)
+    code = compile(tree, "<ingest_script>", "exec")
+
+    def run(doc: IngestDocument, params: Dict[str, Any]):
+        env = {"ctx": _CtxView(doc), "params": _AttrDict(params),
+               "len": len, "str": str, "int": int, "float": float,
+               "bool": bool}
+        exec(code, {"__builtins__": {}}, env)
+
+    with _SCRIPT_LOCK:
+        _SCRIPT_CACHE[("script", source)] = run
+    return run
+
+
+def _painless_to_py(source: str, statements: bool = False) -> str:
+    """Translate painless-style operators (&&, ||, !, null; `;` statement
+    separators when ``statements``) to Python, leaving string literals
+    untouched."""
+    parts = re.split(r"('(?:[^'\\]|\\.)*'|\"(?:[^\"\\]|\\.)*\")", source)
+    out = []
+    for i, part in enumerate(parts):
+        if i % 2 == 1:  # a quoted literal
+            out.append(part)
+            continue
+        p = part.replace("!=", "\x00ne\x00").replace("==", "\x00eq\x00")
+        p = p.replace("&&", " and ").replace("||", " or ")
+        p = p.replace("!", " not ")
+        p = p.replace("\x00ne\x00", "!=").replace("\x00eq\x00", "==")
+        p = re.sub(r"\bnull\b", "None", p)
+        if statements:
+            p = p.replace(";", "\n")
+        out.append(p)
+    return "".join(out)
+
+
+def _compile_condition(source: str):
+    with _SCRIPT_LOCK:
+        cached = _SCRIPT_CACHE.get(("cond", source))
+    if cached is not None:
+        return cached
+    py = _painless_to_py(source)
+    tree = _ast.parse(py, mode="eval")
+    _validate_ingest(tree, source)
+    code = compile(tree, "<ingest_condition>", "eval")
+
+    def run(doc: IngestDocument) -> bool:
+        env = {"ctx": _CtxView(doc), "len": len, "str": str, "int": int,
+               "float": float, "bool": bool}
+        try:
+            return bool(eval(code, {"__builtins__": {}}, env))
+        except (TypeError, AttributeError):
+            return False
+
+    with _SCRIPT_LOCK:
+        _SCRIPT_CACHE[("cond", source)] = run
+    return run
+
+
+# ---------------------------------------------------------------------------
+# Pipeline + service
+# ---------------------------------------------------------------------------
+
+class Pipeline:
+    """ref: ingest/Pipeline.java — an ordered CompoundProcessor with
+    optional pipeline-level on_failure."""
+
+    def __init__(self, pipeline_id: str, config: Dict[str, Any],
+                 service: "IngestService"):
+        self.id = pipeline_id
+        self.description = config.get("description", "")
+        self.version = config.get("version")
+        self.config = config
+        self._processors = [self._build(p, service)
+                            for p in config.get("processors", [])]
+        self._on_failure = [self._build(p, service)
+                            for p in config.get("on_failure", [])]
+
+    @staticmethod
+    def _build(spec: Dict[str, Any], service: "IngestService"
+               ) -> Tuple[str, Processor, List[Tuple[str, Processor]], bool]:
+        if not isinstance(spec, dict) or len(spec) != 1:
+            raise IllegalArgumentException(
+                f"processor spec must have exactly one type key, got {spec}")
+        (ptype, cfg), = spec.items()
+        factory = _PROCESSOR_FACTORIES.get(ptype)
+        if factory is None:
+            raise IllegalArgumentException(
+                f"No processor type exists with name [{ptype}]")
+        try:
+            fn = _if_wraps(cfg, factory(cfg, service))
+        except ElasticsearchTpuException:
+            raise
+        except KeyError as e:
+            raise IllegalArgumentException(
+                f"[{ptype}] required property {e} is missing")
+        except (re.error, SyntaxError, ValueError, TypeError) as e:
+            raise IllegalArgumentException(
+                f"[{ptype}] invalid configuration: {e}")
+        on_failure = [Pipeline._build(p, service)
+                      for p in cfg.get("on_failure", [])]
+        return (ptype, fn, on_failure, cfg.get("ignore_failure", False))
+
+    def execute(self, doc: IngestDocument) -> Optional[IngestDocument]:
+        """Returns the transformed doc, or None if dropped."""
+        try:
+            self._run_chain(self._processors, doc)
+        except DropException:
+            return None
+        except IngestProcessorException:
+            if not self._on_failure:
+                raise
+            self._run_chain(self._on_failure, doc)
+        return doc
+
+    def execute_verbose(self, doc: IngestDocument) -> List[Dict[str, Any]]:
+        """Per-processor trace for _simulate?verbose=true (ref:
+        SimulateExecutionService — one result entry per processor)."""
+        trace: List[Dict[str, Any]] = []
+        for ptype, fn, on_failure, ignore_failure in self._processors:
+            entry: Dict[str, Any] = {"processor_type": ptype}
+            try:
+                fn(doc)
+                entry["status"] = "success"
+                entry["doc"] = {
+                    "_index": doc.meta.get("_index"),
+                    "_id": doc.meta.get("_id"),
+                    "_source": json.loads(json.dumps(doc.source)),
+                    "_ingest": dict(doc.ingest_meta),
+                }
+            except DropException:
+                entry["status"] = "dropped"
+                trace.append(entry)
+                break
+            except ElasticsearchTpuException as e:
+                if ignore_failure:
+                    entry["status"] = "error_ignored"
+                    entry["ignored_error"] = {"error": e.to_xcontent()}
+                elif on_failure:
+                    doc.ingest_meta["on_failure_message"] = str(e)
+                    doc.ingest_meta["on_failure_processor_type"] = ptype
+                    self._run_chain(on_failure, doc)
+                    entry["status"] = "error"
+                    entry["error"] = e.to_xcontent()
+                else:
+                    entry["status"] = "error"
+                    entry["error"] = e.to_xcontent()
+                    trace.append(entry)
+                    break
+            trace.append(entry)
+        return trace
+
+    def _run_chain(self, processors, doc: IngestDocument):
+        for ptype, fn, on_failure, ignore_failure in processors:
+            try:
+                fn(doc)
+            except DropException:
+                raise
+            except IngestProcessorException as e:
+                if ignore_failure:
+                    continue
+                if on_failure:
+                    doc.ingest_meta["on_failure_message"] = str(e)
+                    doc.ingest_meta["on_failure_processor_type"] = ptype
+                    self._run_chain(on_failure, doc)
+                    continue
+                raise
+            except ElasticsearchTpuException:
+                raise
+            except Exception as e:  # processor bug → processor exception
+                if ignore_failure:
+                    continue
+                raise IngestProcessorException(f"[{ptype}] {e}")
+
+
+class IngestService:
+    """Pipeline registry + execution (ref: IngestService.java:81 — stored
+    in cluster state there; persisted to the node data path here, same
+    durability from the single-node API's perspective)."""
+
+    def __init__(self, data_path: Optional[str] = None):
+        self._pipelines: Dict[str, Pipeline] = {}
+        self._lock = threading.Lock()
+        self._path = (os.path.join(data_path, "_ingest_pipelines.json")
+                      if data_path else None)
+        self._depth = threading.local()
+        if self._path and os.path.exists(self._path):
+            with open(self._path) as fh:
+                for pid, cfg in json.load(fh).items():
+                    self._pipelines[pid] = Pipeline(pid, cfg, self)
+
+    def put_pipeline(self, pipeline_id: str, config: Dict[str, Any]):
+        pipeline = Pipeline(pipeline_id, config, self)  # validates
+        with self._lock:
+            self._pipelines[pipeline_id] = pipeline
+            self._persist()
+
+    def get_pipeline(self, pipeline_id: str) -> Optional[Pipeline]:
+        return self._pipelines.get(pipeline_id)
+
+    def get_pipelines(self) -> Dict[str, Dict[str, Any]]:
+        return {pid: p.config for pid, p in self._pipelines.items()}
+
+    def delete_pipeline(self, pipeline_id: str):
+        with self._lock:
+            if pipeline_id not in self._pipelines:
+                raise ResourceNotFoundException(
+                    f"pipeline [{pipeline_id}] is missing")
+            del self._pipelines[pipeline_id]
+            self._persist()
+
+    def _persist(self):
+        if self._path:
+            tmp = self._path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump({pid: p.config
+                           for pid, p in self._pipelines.items()}, fh)
+            os.replace(tmp, self._path)
+
+    # -- execution ----------------------------------------------------------
+    def run_pipeline(self, pipeline_id: str,
+                     doc: IngestDocument) -> Optional[IngestDocument]:
+        pipeline = self._pipelines.get(pipeline_id)
+        if pipeline is None:
+            raise ResourceNotFoundException(
+                f"pipeline with id [{pipeline_id}] does not exist")
+        depth = getattr(self._depth, "value", 0)
+        if depth >= 10:
+            raise _PipelineCycleError(
+                f"Max pipeline nesting depth exceeded at [{pipeline_id}]")
+        self._depth.value = depth + 1
+        try:
+            return pipeline.execute(doc)
+        finally:
+            self._depth.value = depth
+
+    def process(self, pipeline_id: str, index: str, doc_id: Optional[str],
+                source: Dict[str, Any],
+                routing: Optional[str] = None) -> Optional[IngestDocument]:
+        """The bulk-path detour (ref: TransportBulkAction.java:172 →
+        IngestService.executeBulkRequest): returns the transformed
+        IngestDocument — pipelines may rewrite ``_index``/``_routing``
+        metadata, which reroutes the doc — or None if dropped."""
+        doc = IngestDocument(source, index=index, doc_id=doc_id,
+                             routing=routing)
+        return self.run_pipeline(pipeline_id, doc)
+
+    def simulate(self, config_or_id, docs: List[Dict[str, Any]],
+                 verbose: bool = False) -> Dict[str, Any]:
+        """_ingest/pipeline/_simulate (ref: SimulatePipelineRequest)."""
+        if isinstance(config_or_id, str):
+            pipeline = self._pipelines.get(config_or_id)
+            if pipeline is None:
+                raise ResourceNotFoundException(
+                    f"pipeline with id [{config_or_id}] does not exist")
+        else:
+            pipeline = Pipeline("_simulate_pipeline", config_or_id, self)
+        results = []
+        for entry in docs:
+            source = entry.get("_source", {})
+            doc = IngestDocument(
+                json.loads(json.dumps(source)),  # deep copy
+                index=entry.get("_index", "_index"),
+                doc_id=entry.get("_id", "_id"))
+            if verbose:
+                results.append(
+                    {"processor_results": pipeline.execute_verbose(doc)})
+                continue
+            try:
+                out = pipeline.execute(doc)
+                if out is None:
+                    results.append({"doc": None})
+                else:
+                    results.append({"doc": {
+                        "_index": out.meta.get("_index"),
+                        "_id": out.meta.get("_id"),
+                        "_source": out.source,
+                        "_ingest": out.ingest_meta,
+                    }})
+            except ElasticsearchTpuException as e:
+                results.append({"error": e.to_xcontent()})
+        return {"docs": results}

@@ -12,6 +12,7 @@ from typing import Optional
 
 from elasticsearch_tpu.common.settings import Setting, Settings
 from elasticsearch_tpu.index.service import IndicesService
+from elasticsearch_tpu.ingest.service import IngestService
 from elasticsearch_tpu.rest.api import RestController
 from elasticsearch_tpu.rest.http_server import HttpServer
 from elasticsearch_tpu.search.service import SearchService
@@ -35,6 +36,7 @@ class Node:
         self.breaker_service = HierarchyCircuitBreakerService()
         self.indices_service = IndicesService(self.data_path, settings)
         self.search_service = SearchService(self.indices_service)
+        self.ingest_service = IngestService(self.data_path)
         self.rest_controller = RestController(self)
         self._http: Optional[HttpServer] = None
 

@@ -116,6 +116,15 @@ def _register_all(c: RestController):
     c.register("GET", "/{index}/_rank_eval", rank_eval_handler)
     c.register("GET", "/{index}/_explain/{id}", explain_doc)
     c.register("POST", "/{index}/_explain/{id}", explain_doc)
+    # ingest (literal _simulate before the {id} wildcard)
+    c.register("POST", "/_ingest/pipeline/_simulate", simulate_pipeline)
+    c.register("GET", "/_ingest/pipeline/_simulate", simulate_pipeline)
+    c.register("POST", "/_ingest/pipeline/{id}/_simulate", simulate_pipeline)
+    c.register("GET", "/_ingest/pipeline/{id}/_simulate", simulate_pipeline)
+    c.register("PUT", "/_ingest/pipeline/{id}", put_pipeline)
+    c.register("GET", "/_ingest/pipeline/{id}", get_pipeline)
+    c.register("GET", "/_ingest/pipeline", get_pipelines)
+    c.register("DELETE", "/_ingest/pipeline/{id}", delete_pipeline)
     # documents
     c.register("PUT", "/{index}/_doc/{id}", index_doc)
     c.register("POST", "/{index}/_doc/{id}", index_doc)
@@ -353,7 +362,35 @@ def _write_response(index, result, created_word="created"):
     }
 
 
+def _run_ingest(node, index, doc_id, params, source, routing=None):
+    """The ingest detour before indexing (ref: TransportBulkAction.java:172
+    → IngestService.executeBulkRequest). Returns (source, index, routing)
+    — pipelines may reroute via ``_index``/``_routing`` metadata — or None
+    if a drop processor discarded the doc."""
+    pipeline_id = params.get("pipeline")
+    if pipeline_id is None and node.indices_service.has(index):
+        idx = node.indices_service.get(index)
+        pipeline_id = idx.settings.get("index.default_pipeline")
+    if pipeline_id in (None, "_none"):
+        return source, index, routing
+    doc = node.ingest_service.process(pipeline_id, index, doc_id, source,
+                                      routing=routing)
+    if doc is None:
+        return None
+    return (doc.source, doc.meta.get("_index") or index,
+            doc.meta.get("_routing", routing))
+
+
 def index_doc(node, params, body, index, id):
+    ingested = _run_ingest(node, index, id, params, body or {},
+                           routing=params.get("routing"))
+    if ingested is None:  # dropped by pipeline
+        return 200, {"_index": index, "_id": id, "result": "noop",
+                     "_shards": {"total": 0, "successful": 0, "failed": 0}}
+    body, index, routing = ingested
+    params = dict(params)
+    if routing is not None:
+        params["routing"] = routing
     idx = _ensure_index(node, index)
     op_type = params.get("op_type", "index")
     kwargs = {}
@@ -501,11 +538,27 @@ def bulk(node, params, body, index=None):
         try:
             if target is None:
                 raise IllegalArgumentException("bulk item missing _index")
+            routing = meta.get("routing")
+            if action in ("index", "create"):
+                # per-item pipeline overrides the URL-level param (ref:
+                # BulkRequest item pipelines)
+                item_params = params
+                if "pipeline" in meta:
+                    item_params = dict(params)
+                    item_params["pipeline"] = meta["pipeline"]
+                ingested = _run_ingest(node, target, doc_id, item_params,
+                                       source, routing=routing)
+                if ingested is None:  # dropped by pipeline
+                    items.append({action: {
+                        "_index": target, "_id": doc_id,
+                        "result": "noop", "status": 200}})
+                    continue
+                source, target, routing = ingested
             idx = _ensure_index(node, target)
             touched.add(target)
             if action in ("index", "create"):
                 result = idx.index_doc(
-                    doc_id, source, routing=meta.get("routing"),
+                    doc_id, source, routing=routing,
                     op_type="create" if action == "create" else "index")
                 items.append({action: {
                     "_index": target, "_id": result.doc_id,
@@ -619,6 +672,39 @@ def msearch(node, params, body, index=None):
 
 def msearch_index(node, params, body, index):
     return msearch(node, params, body, index=index)
+
+
+# -- ingest ------------------------------------------------------------------
+
+def put_pipeline(node, params, body, id):
+    node.ingest_service.put_pipeline(id, body or {})
+    return 200, {"acknowledged": True}
+
+
+def get_pipeline(node, params, body, id=None):
+    pipelines = node.ingest_service.get_pipelines()
+    if id is None or id == "*":
+        return 200, pipelines
+    if id not in pipelines:
+        return 404, {}
+    return 200, {id: pipelines[id]}
+
+
+def get_pipelines(node, params, body):
+    return 200, node.ingest_service.get_pipelines()
+
+
+def delete_pipeline(node, params, body, id):
+    node.ingest_service.delete_pipeline(id)
+    return 200, {"acknowledged": True}
+
+
+def simulate_pipeline(node, params, body, id=None):
+    body = body or {}
+    verbose = params.get("verbose") in ("true", "")
+    target = id if id is not None else body.get("pipeline", {})
+    return 200, node.ingest_service.simulate(
+        target, body.get("docs", []), verbose=verbose)
 
 
 def rank_eval_handler(node, params, body, index):

@@ -1,0 +1,369 @@
+"""Ingest pipeline tests (ref: the reference's IngestServiceTests /
+ingest-common processor tests — each processor exercised with
+hand-checkable transforms, plus failure handling, conditionals,
+simulate, and the bulk-path detour)."""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ResourceNotFoundException,
+)
+from elasticsearch_tpu.ingest import IngestDocument, IngestService
+from elasticsearch_tpu.ingest.service import IngestProcessorException
+
+
+@pytest.fixture()
+def svc():
+    return IngestService()
+
+
+def run(svc, processors, source, **kwargs):
+    svc.put_pipeline("p", {"processors": processors})
+    doc = IngestDocument(source, index="i", doc_id="1", **kwargs)
+    out = svc.run_pipeline("p", doc)
+    return None if out is None else out.source
+
+
+# ------------------------------------------------------------- processors
+
+def test_set_and_templates(svc):
+    out = run(svc, [{"set": {"field": "greeting",
+                             "value": "hello {{name}}"}}], {"name": "bob"})
+    assert out["greeting"] == "hello bob"
+
+
+def test_set_override_false(svc):
+    out = run(svc, [{"set": {"field": "a", "value": "new",
+                             "override": False}}], {"a": "old"})
+    assert out["a"] == "old"
+
+
+def test_set_copy_from(svc):
+    out = run(svc, [{"set": {"field": "b", "copy_from": "a"}}], {"a": 7})
+    assert out["b"] == 7
+
+
+def test_remove_and_rename(svc):
+    out = run(svc, [{"remove": {"field": "tmp"}},
+                    {"rename": {"field": "old", "target_field": "new"}}],
+              {"tmp": 1, "old": "x"})
+    assert out == {"new": "x"}
+
+
+def test_remove_missing_raises_unless_ignored(svc):
+    with pytest.raises(IngestProcessorException):
+        run(svc, [{"remove": {"field": "nope"}}], {})
+    out = run(svc, [{"remove": {"field": "nope", "ignore_missing": True}}],
+              {"a": 1})
+    assert out == {"a": 1}
+
+
+def test_convert(svc):
+    out = run(svc, [{"convert": {"field": "n", "type": "integer"}}],
+              {"n": "42"})
+    assert out["n"] == 42
+    out = run(svc, [{"convert": {"field": "vals", "type": "float"}}],
+              {"vals": ["1.5", "2.5"]})
+    assert out["vals"] == [1.5, 2.5]
+    out = run(svc, [{"convert": {"field": "b", "type": "boolean"}}],
+              {"b": "TRUE"})
+    assert out["b"] is True
+
+
+def test_string_processors(svc):
+    out = run(svc, [
+        {"lowercase": {"field": "a"}},
+        {"uppercase": {"field": "b"}},
+        {"trim": {"field": "c"}},
+        {"gsub": {"field": "d", "pattern": "-", "replacement": "_"}},
+        {"split": {"field": "e", "separator": ","}},
+        {"join": {"field": "f", "separator": "-"}},
+    ], {"a": "ABC", "b": "abc", "c": "  x  ", "d": "a-b-c",
+        "e": "1,2,3", "f": ["x", "y"]})
+    assert out["a"] == "abc" and out["b"] == "ABC" and out["c"] == "x"
+    assert out["d"] == "a_b_c" and out["e"] == ["1", "2", "3"]
+    assert out["f"] == "x-y"
+
+
+def test_append(svc):
+    out = run(svc, [{"append": {"field": "tags", "value": ["c"]}}],
+              {"tags": ["a", "b"]})
+    assert out["tags"] == ["a", "b", "c"]
+    out = run(svc, [{"append": {"field": "tags", "value": "a",
+                                "allow_duplicates": False}}],
+              {"tags": ["a"]})
+    assert out["tags"] == ["a"]
+
+
+def test_date_processor(svc):
+    out = run(svc, [{"date": {"field": "t", "formats": ["UNIX"]}}],
+              {"t": 0})
+    assert out["@timestamp"].startswith("1970-01-01T00:00:00")
+    out = run(svc, [{"date": {"field": "t", "formats": ["ISO8601"],
+                              "target_field": "ts"}}],
+              {"t": "2023-05-01T12:00:00Z"})
+    assert out["ts"].startswith("2023-05-01T12:00:00")
+
+
+def test_json_processor(svc):
+    out = run(svc, [{"json": {"field": "raw"}}], {"raw": '{"a": 1}'})
+    assert out["raw"] == {"a": 1}
+    out = run(svc, [{"json": {"field": "raw", "add_to_root": True}}],
+              {"raw": '{"a": 1}'})
+    assert out["a"] == 1
+
+
+def test_fail_and_drop(svc):
+    with pytest.raises(IngestProcessorException, match="boom bob"):
+        run(svc, [{"fail": {"message": "boom {{name}}"}}], {"name": "bob"})
+    assert run(svc, [{"drop": {}}], {"a": 1}) is None
+
+
+def test_script_processor(svc):
+    out = run(svc, [{"script": {"source":
+                                "ctx.total = ctx.a + ctx.b * params.m",
+                                "params": {"m": 10}}}],
+              {"a": 1, "b": 2})
+    assert out["total"] == 21
+
+
+def test_conditional_if(svc):
+    procs = [{"set": {"field": "flag", "value": "yes",
+                      "if": "ctx.n > 5"}}]
+    assert run(svc, procs, {"n": 10})["flag"] == "yes"
+    assert "flag" not in run(svc, procs, {"n": 3})
+
+
+def test_on_failure_handler(svc):
+    out = run(svc, [{"fail": {"message": "x",
+                              "on_failure": [{"set": {
+                                  "field": "error_handled",
+                                  "value": True}}]}}], {})
+    assert out["error_handled"] is True
+
+
+def test_ignore_failure(svc):
+    out = run(svc, [{"fail": {"message": "x", "ignore_failure": True}},
+                    {"set": {"field": "ok", "value": 1}}], {})
+    assert out["ok"] == 1
+
+
+def test_pipeline_processor_and_cycle_guard(svc):
+    svc.put_pipeline("inner", {"processors": [
+        {"set": {"field": "inner_ran", "value": True}}]})
+    svc.put_pipeline("outer", {"processors": [
+        {"pipeline": {"name": "inner"}}]})
+    doc = IngestDocument({"a": 1})
+    assert svc.run_pipeline("outer", doc).source["inner_ran"] is True
+    svc.put_pipeline("loop", {"processors": [{"pipeline": {"name": "loop"}}]})
+    with pytest.raises(IngestProcessorException):
+        svc.run_pipeline("loop", IngestDocument({}))
+
+
+def test_foreach(svc):
+    out = run(svc, [{"foreach": {"field": "vals", "processor": {
+        "uppercase": {"field": "_value"}}}}], {"vals": ["a", "b"]})
+    assert out["vals"] == ["A", "B"]
+
+
+def test_dot_expander(svc):
+    out = run(svc, [{"dot_expander": {"field": "a.b"}}], {"a.b": 1})
+    assert out == {"a": {"b": 1}}
+
+
+def test_csv_and_kv(svc):
+    out = run(svc, [{"csv": {"field": "row",
+                             "target_fields": ["x", "y", "z"]}}],
+              {"row": "1,2,3"})
+    assert out["x"] == "1" and out["z"] == "3"
+    out = run(svc, [{"kv": {"field": "q", "field_split": "&",
+                            "value_split": "="}}], {"q": "a=1&b=2"})
+    assert out["a"] == "1" and out["b"] == "2"
+
+
+def test_html_strip_and_urldecode_and_bytes(svc):
+    out = run(svc, [{"html_strip": {"field": "h"}},
+                    {"urldecode": {"field": "u"}},
+                    {"bytes": {"field": "sz"}}],
+              {"h": "<b>bold</b> text", "u": "a%20b", "sz": "2kb"})
+    assert out["h"] == "bold text" and out["u"] == "a b"
+    assert out["sz"] == 2048
+
+
+def test_dissect(svc):
+    out = run(svc, [{"dissect": {"field": "msg",
+                                 "pattern": "%{user} logged in from %{ip}"}}],
+              {"msg": "alice logged in from 1.2.3.4"})
+    assert out["user"] == "alice" and out["ip"] == "1.2.3.4"
+
+
+def test_grok(svc):
+    out = run(svc, [{"grok": {"field": "msg", "patterns": [
+        "%{IP:client} %{WORD:method} %{NUMBER:bytes}"]}}],
+              {"msg": "10.0.0.1 GET 1234"})
+    assert out["client"] == "10.0.0.1"
+    assert out["method"] == "GET"
+    assert out["bytes"] == "1234"
+
+
+def test_fingerprint_deterministic(svc):
+    a = run(svc, [{"fingerprint": {"fields": ["x", "y"]}}], {"x": 1, "y": 2})
+    b = run(svc, [{"fingerprint": {"fields": ["y", "x"]}}], {"y": 2, "x": 1})
+    assert a["fingerprint"] == b["fingerprint"]
+
+
+def test_unknown_processor_rejected(svc):
+    with pytest.raises(IllegalArgumentException):
+        svc.put_pipeline("bad", {"processors": [{"nope": {}}]})
+
+
+# --------------------------------------------------------------- registry
+
+def test_registry_and_persistence(tmp_path):
+    svc = IngestService(str(tmp_path))
+    svc.put_pipeline("p1", {"description": "d",
+                            "processors": [{"set": {"field": "a",
+                                                    "value": 1}}]})
+    svc2 = IngestService(str(tmp_path))  # reload from disk
+    assert svc2.get_pipeline("p1") is not None
+    svc2.delete_pipeline("p1")
+    with pytest.raises(ResourceNotFoundException):
+        svc2.delete_pipeline("p1")
+    with pytest.raises(ResourceNotFoundException):
+        svc2.run_pipeline("p1", IngestDocument({}))
+
+
+def test_simulate(svc):
+    r = svc.simulate({"processors": [{"set": {"field": "a", "value": 1}}]},
+                     [{"_source": {"b": 2}}, {"_source": {}}])
+    assert r["docs"][0]["doc"]["_source"] == {"b": 2, "a": 1}
+    r = svc.simulate({"processors": [{"fail": {"message": "X"}}]},
+                     [{"_source": {}}])
+    assert "error" in r["docs"][0]
+
+
+# -------------------------------------------------------------- REST path
+
+def test_rest_pipeline_and_bulk_detour(tmp_path):
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest.api import RestController
+
+    node = Node(data_path=str(tmp_path))
+    c = node.rest_controller
+    status, _ = c.dispatch("PUT", "/_ingest/pipeline/enrich", {}, {
+        "processors": [{"set": {"field": "tagged", "value": True}},
+                       {"drop": {"if": "ctx.skip == True"}}]})
+    assert status == 200
+    # indexing with pipeline applies the transform
+    status, r = c.dispatch("PUT", "/idx/_doc/1", {"pipeline": "enrich"},
+                           {"title": "x"})
+    assert status == 201
+    c.dispatch("POST", "/idx/_refresh", {}, None)
+    _, doc = c.dispatch("GET", "/idx/_doc/1", {}, None)
+    assert doc["_source"]["tagged"] is True
+    # dropped doc is not indexed
+    status, r = c.dispatch("PUT", "/idx/_doc/2", {"pipeline": "enrich"},
+                           {"title": "y", "skip": True})
+    assert r["result"] == "noop"
+    _, doc = c.dispatch("GET", "/idx/_doc/2", {}, None)
+    assert doc["found"] is False
+    # bulk path
+    ndjson = "\n".join([
+        '{"index": {"_index": "idx", "_id": "3"}}',
+        '{"title": "z"}',
+        '{"index": {"_index": "idx", "_id": "4"}}',
+        '{"title": "w", "skip": true}',
+    ])
+    status, r = c.dispatch("POST", "/_bulk", {"pipeline": "enrich",
+                                              "refresh": "true"}, ndjson)
+    assert r["items"][0]["index"]["result"] == "created"
+    assert r["items"][1]["index"]["result"] == "noop"
+    _, doc = c.dispatch("GET", "/idx/_doc/3", {}, None)
+    assert doc["_source"]["tagged"] is True
+    # simulate endpoint
+    status, r = c.dispatch("POST", "/_ingest/pipeline/enrich/_simulate", {},
+                           {"docs": [{"_source": {"a": 1}}]})
+    assert r["docs"][0]["doc"]["_source"]["tagged"] is True
+    # default_pipeline index setting
+    c.dispatch("PUT", "/auto", {}, {"settings": {
+        "index.default_pipeline": "enrich"}})
+    c.dispatch("PUT", "/auto/_doc/1", {}, {"v": 1})
+    c.dispatch("POST", "/auto/_refresh", {}, None)
+    _, doc = c.dispatch("GET", "/auto/_doc/1", {}, None)
+    assert doc["_source"]["tagged"] is True
+    node.close()
+
+
+# ----------------------------------------------- review regression tests
+
+def test_malformed_pipeline_config_is_400(svc):
+    with pytest.raises(IllegalArgumentException):
+        svc.put_pipeline("p", {"processors": [{"set": {}}]})  # missing field
+    with pytest.raises(IllegalArgumentException):
+        svc.put_pipeline("p", {"processors": [
+            {"gsub": {"field": "a", "pattern": "[", "replacement": ""}}]})
+
+
+def test_condition_with_bang_in_string_literal(svc):
+    procs = [{"set": {"field": "hit", "value": 1,
+                      "if": "ctx.msg == 'hi!'"}}]
+    assert run(svc, procs, {"msg": "hi!"})["hit"] == 1
+    assert "hit" not in run(svc, procs, {"msg": "hi not "})
+
+
+def test_condition_null_and_negation(svc):
+    procs = [{"set": {"field": "flag", "value": 1,
+                      "if": "ctx.missing == null && !(ctx.n == 2)"}}]
+    assert run(svc, procs, {"n": 1})["flag"] == 1
+    assert "flag" not in run(svc, procs, {"n": 2})
+
+
+def test_pipeline_reroutes_via_index_metadata(tmp_path):
+    from elasticsearch_tpu.node import Node
+
+    node = Node(data_path=str(tmp_path))
+    c = node.rest_controller
+    c.dispatch("PUT", "/_ingest/pipeline/reroute", {}, {
+        "processors": [{"set": {"field": "_index", "value": "other"}}]})
+    c.dispatch("PUT", "/docs/_doc/1", {"pipeline": "reroute"}, {"a": 1})
+    c.dispatch("POST", "/other/_refresh", {}, None)
+    _, doc = c.dispatch("GET", "/other/_doc/1", {}, None)
+    assert doc["found"] is True
+    assert not node.indices_service.has("docs") or \
+        c.dispatch("GET", "/docs/_doc/1", {}, None)[1]["found"] is False
+    node.close()
+
+
+def test_bulk_per_item_pipeline(tmp_path):
+    from elasticsearch_tpu.node import Node
+
+    node = Node(data_path=str(tmp_path))
+    c = node.rest_controller
+    c.dispatch("PUT", "/_ingest/pipeline/tagit", {}, {
+        "processors": [{"set": {"field": "tagged", "value": True}}]})
+    nd = "\n".join([
+        '{"index": {"_index": "b", "_id": "1", "pipeline": "tagit"}}',
+        '{"v": 1}',
+        '{"index": {"_index": "b", "_id": "2"}}',
+        '{"v": 2}',
+    ])
+    c.dispatch("POST", "/_bulk", {"refresh": "true"}, nd)
+    _, d1 = c.dispatch("GET", "/b/_doc/1", {}, None)
+    _, d2 = c.dispatch("GET", "/b/_doc/2", {}, None)
+    assert d1["_source"].get("tagged") is True
+    assert "tagged" not in d2["_source"]
+    node.close()
+
+
+def test_verbose_simulate(svc):
+    r = svc.simulate({"processors": [
+        {"set": {"field": "a", "value": 1}},
+        {"fail": {"message": "boom"}},
+        {"set": {"field": "never", "value": 2}},
+    ]}, [{"_source": {}}], verbose=True)
+    trace = r["docs"][0]["processor_results"]
+    assert trace[0]["status"] == "success"
+    assert trace[0]["doc"]["_source"] == {"a": 1}
+    assert trace[1]["status"] == "error"
+    assert len(trace) == 2  # aborted after the failure
