@@ -197,16 +197,36 @@ class IndicesService:
                     Settings(meta["settings"]), meta["mappings"],
                     self.device_cache)
 
-    def create_index(self, name: str, settings: Optional[Dict[str, Any]] = None,
-                     mappings: Optional[Dict[str, Any]] = None) -> IndexService:
-        if name in self.indices:
-            raise ResourceAlreadyExistsException(f"index [{name}]")
+    @staticmethod
+    def validate_index_name(name: str) -> None:
         if not name or name.startswith(("_", "-")) or name != name.lower():
             raise IllegalArgumentException(
                 f"Invalid index name [{name}], must be lowercase and not "
                 f"start with '_' or '-'")
+
+    def create_index(self, name: str, settings: Optional[Dict[str, Any]] = None,
+                     mappings: Optional[Dict[str, Any]] = None) -> IndexService:
+        if name in self.indices:
+            raise ResourceAlreadyExistsException(f"index [{name}]")
+        self.validate_index_name(name)
         idx = IndexService(name, os.path.join(self.data_path, name),
                            Settings.from_dict(settings or {}), mappings,
+                           self.device_cache)
+        self.indices[name] = idx
+        return idx
+
+    def open_index(self, name: str) -> IndexService:
+        """Open an index whose files were placed under the data path out of
+        band (snapshot restore, peer-recovery file copy)."""
+        if name in self.indices:
+            raise ResourceAlreadyExistsException(f"index [{name}]")
+        meta_path = os.path.join(self.data_path, name, "_meta.json")
+        if not os.path.exists(meta_path):
+            raise IndexNotFoundException(name)
+        with open(meta_path) as fh:
+            meta = json.load(fh)
+        idx = IndexService(name, os.path.join(self.data_path, name),
+                           Settings(meta["settings"]), meta["mappings"],
                            self.device_cache)
         self.indices[name] = idx
         return idx

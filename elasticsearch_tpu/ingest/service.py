@@ -720,6 +720,9 @@ class _CtxView:
         return name in self._doc.source or name in self._doc.meta
 
 
+_META_ATTRS = {"_index", "_id", "_routing", "_version", "_ingest", "_value"}
+
+
 def _validate_ingest(tree, source: str):
     for node in _ast.walk(tree):
         if not isinstance(node, _ING_ALLOWED):
@@ -731,6 +734,15 @@ def _validate_ingest(tree, source: str):
                 "True", "False", "None"):
             raise IllegalArgumentException(
                 f"ingest script: unknown name [{node.id}] in [{source}]")
+        # sandbox: underscore attributes (except document metadata) are the
+        # escape surface — ''.__class__.__mro__... (same rule as the search
+        # script engine, search/script.py)
+        if (isinstance(node, _ast.Attribute)
+                and node.attr.startswith("_")
+                and node.attr not in _META_ATTRS):
+            raise IllegalArgumentException(
+                f"ingest script: access to [{node.attr}] is not allowed "
+                f"in [{source}]")
 
 
 def _compile_ingest_script(source: str):

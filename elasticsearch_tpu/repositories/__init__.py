@@ -1,0 +1,9 @@
+from elasticsearch_tpu.repositories.blobstore import (
+    BlobStoreRepository,
+    FsBlobContainer,
+    FsBlobStore,
+    RepositoriesService,
+)
+
+__all__ = ["BlobStoreRepository", "FsBlobContainer", "FsBlobStore",
+           "RepositoriesService"]

@@ -1,0 +1,452 @@
+"""Snapshot repositories: content-addressed blob store + snapshot/restore.
+
+Mirrors the reference's snapshot stack (ref: repositories/blobstore/
+BlobStoreRepository.java:154,648,996 — content-addressed blob layout,
+incremental shard snapshots, generation-CAS'd repository metadata;
+snapshots/SnapshotsService.java — create/get/delete/restore orchestration).
+
+Layout under the repository location:
+
+    index-N                  repository data generation N (JSON)
+    index.latest             current generation number
+    snap-{name}.json         per-snapshot metadata (indices, shard files)
+    indices/{index}/{shard}/__{sha256}   content-addressed file blobs
+
+Incrementality falls out of content addressing: a segment file already
+uploaded by an earlier snapshot is referenced, not re-written (the
+reference dedupes per shard generation the same way). Deleting a snapshot
+garbage-collects blobs no longer referenced by any remaining snapshot.
+
+The TPU angle: snapshots copy the *host-side* segment files (the
+rectangular block arrays). Restore rebuilds the on-disk index; device
+(HBM) state re-uploads lazily on first search, exactly like any segment
+load — no device state is ever part of a snapshot.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    ElasticsearchTpuException,
+    IllegalArgumentException,
+    ResourceAlreadyExistsException,
+    ResourceNotFoundException,
+)
+
+
+class RepositoryException(ElasticsearchTpuException):
+    status = 500
+
+
+class SnapshotException(ElasticsearchTpuException):
+    status = 500
+
+
+class SnapshotMissingException(ElasticsearchTpuException):
+    status = 404
+
+
+class ConcurrentSnapshotExecutionException(ElasticsearchTpuException):
+    status = 503
+
+
+# ---------------------------------------------------------------------------
+# Blob store
+# ---------------------------------------------------------------------------
+
+class FsBlobContainer:
+    """ref: common/blobstore/fs/FsBlobContainer — one directory of blobs."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def _ensure(self):
+        os.makedirs(self.path, exist_ok=True)
+
+    def write_blob(self, name: str, data: bytes,
+                   fail_if_exists: bool = False) -> None:
+        self._ensure()
+        target = os.path.join(self.path, name)
+        if fail_if_exists and os.path.exists(target):
+            raise RepositoryException(f"blob [{name}] already exists")
+        tmp = target + f".tmp-{uuid.uuid4().hex[:8]}"
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, target)
+
+    def read_blob(self, name: str) -> bytes:
+        target = os.path.join(self.path, name)
+        if not os.path.exists(target):
+            raise ResourceNotFoundException(f"blob [{name}] not found")
+        with open(target, "rb") as fh:
+            return fh.read()
+
+    def blob_exists(self, name: str) -> bool:
+        return os.path.exists(os.path.join(self.path, name))
+
+    def list_blobs(self) -> List[str]:
+        if not os.path.isdir(self.path):
+            return []
+        return sorted(n for n in os.listdir(self.path)
+                      if not n.endswith(".tmp") and ".tmp-" not in n)
+
+    def delete_blob(self, name: str) -> None:
+        try:
+            os.remove(os.path.join(self.path, name))
+        except FileNotFoundError:
+            pass
+
+
+class FsBlobStore:
+    """ref: FsBlobStore — containers are nested directories."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def container(self, *parts: str) -> FsBlobContainer:
+        return FsBlobContainer(os.path.join(self.root, *parts))
+
+
+# ---------------------------------------------------------------------------
+# Repository
+# ---------------------------------------------------------------------------
+
+SHARD_FILES = ("meta.json", "arrays.npz", "stored.bin")
+
+
+class BlobStoreRepository:
+    """One registered snapshot repository over a blob store."""
+
+    def __init__(self, name: str, location: str, readonly: bool = False):
+        self.name = name
+        self.location = location
+        self.readonly = readonly
+        self.blobstore = FsBlobStore(location)
+        self.root = self.blobstore.container()
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------ repository data
+    def _latest_gen(self) -> int:
+        if self.root.blob_exists("index.latest"):
+            return int(self.root.read_blob("index.latest").decode())
+        return -1
+
+    def load_repository_data(self) -> Dict[str, Any]:
+        gen = self._latest_gen()
+        if gen < 0:
+            return {"gen": -1, "snapshots": {}}
+        data = json.loads(self.root.read_blob(f"index-{gen}").decode())
+        data["gen"] = gen
+        return data
+
+    def _write_repository_data(self, data: Dict[str, Any],
+                               expected_gen: int) -> None:
+        """Generation CAS (ref: BlobStoreRepository.writeIndexGen:996):
+        refuse if another writer bumped the generation underneath us."""
+        current = self._latest_gen()
+        if current != expected_gen:
+            raise ConcurrentSnapshotExecutionException(
+                f"repository [{self.name}] generation [{current}] != "
+                f"expected [{expected_gen}]")
+        new_gen = expected_gen + 1
+        payload = {k: v for k, v in data.items() if k != "gen"}
+        self.root.write_blob(f"index-{new_gen}",
+                             json.dumps(payload).encode(),
+                             fail_if_exists=True)
+        self.root.write_blob("index.latest", str(new_gen).encode())
+        if expected_gen >= 0:
+            self.root.delete_blob(f"index-{expected_gen}")
+
+    # ------------------------------------------------------------ snapshot
+    def snapshot(self, snapshot_name: str, indices,
+                 include_global_state: bool = True,
+                 metadata: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Snapshot the given IndexService list. Each shard is flushed
+        first so its on-disk commit is the snapshot source."""
+        if self.readonly:
+            raise RepositoryException(
+                f"repository [{self.name}] is readonly")
+        with self._lock:
+            repo_data = self.load_repository_data()
+            if snapshot_name in repo_data["snapshots"]:
+                raise ResourceAlreadyExistsException(
+                    f"snapshot [{snapshot_name}] already exists")
+            start_ms = int(time.time() * 1000)
+            snap_uuid = uuid.uuid4().hex[:20]
+            snap_indices: Dict[str, Any] = {}
+            total_files = 0
+            for idx in indices:
+                idx.flush()
+                shards = []
+                for shard_id, engine in enumerate(idx.shards):
+                    container = self.blobstore.container(
+                        "indices", idx.name, str(shard_id))
+                    shard_meta = {"segments": {}, "commit": None}
+                    commit_path = os.path.join(engine.path, "segments.json")
+                    if os.path.exists(commit_path):
+                        with open(commit_path) as fh:
+                            shard_meta["commit"] = json.load(fh)
+                    for seg_name in (shard_meta["commit"] or {}).get(
+                            "segments", []):
+                        seg_dir = os.path.join(engine.path, seg_name)
+                        files = {}
+                        for fname in SHARD_FILES:
+                            fpath = os.path.join(seg_dir, fname)
+                            if not os.path.exists(fpath):
+                                continue
+                            with open(fpath, "rb") as fh:
+                                content = fh.read()
+                            digest = hashlib.sha256(content).hexdigest()
+                            blob = f"__{digest}"
+                            if not container.blob_exists(blob):
+                                container.write_blob(blob, content)
+                                total_files += 1
+                            files[fname] = blob
+                        shard_meta["segments"][seg_name] = files
+                    shards.append(shard_meta)
+                snap_indices[idx.name] = {
+                    "settings": idx.settings.as_dict(),
+                    "mappings": idx.mapper.to_mapping(),
+                    "shards": shards,
+                }
+            info = {
+                "snapshot": snapshot_name,
+                "uuid": snap_uuid,
+                "state": "SUCCESS",
+                "indices": sorted(snap_indices),
+                "include_global_state": include_global_state,
+                "start_time_in_millis": start_ms,
+                "end_time_in_millis": int(time.time() * 1000),
+                "metadata": metadata or {},
+                "shards": {"total": sum(len(v["shards"])
+                                        for v in snap_indices.values()),
+                           "failed": 0,
+                           "successful": sum(len(v["shards"])
+                                             for v in snap_indices.values())},
+            }
+            self.root.write_blob(
+                f"snap-{snapshot_name}.json",
+                json.dumps({"info": info, "indices": snap_indices}).encode())
+            repo_data["snapshots"][snapshot_name] = {
+                "uuid": snap_uuid, "state": "SUCCESS",
+                "indices": info["indices"],
+                "start_time_in_millis": start_ms,
+            }
+            self._write_repository_data(repo_data, repo_data["gen"])
+            return info
+
+    def get_snapshot(self, snapshot_name: str) -> Dict[str, Any]:
+        if not self.root.blob_exists(f"snap-{snapshot_name}.json"):
+            raise SnapshotMissingException(
+                f"[{self.name}:{snapshot_name}] is missing")
+        return json.loads(
+            self.root.read_blob(f"snap-{snapshot_name}.json").decode())
+
+    def list_snapshots(self) -> List[Dict[str, Any]]:
+        data = self.load_repository_data()
+        return [self.get_snapshot(n)["info"]
+                for n in sorted(data["snapshots"])]
+
+    # -------------------------------------------------------------- delete
+    def delete_snapshot(self, snapshot_name: str) -> None:
+        if self.readonly:
+            raise RepositoryException(f"repository [{self.name}] is readonly")
+        with self._lock:
+            repo_data = self.load_repository_data()
+            if snapshot_name not in repo_data["snapshots"]:
+                raise SnapshotMissingException(
+                    f"[{self.name}:{snapshot_name}] is missing")
+            del repo_data["snapshots"][snapshot_name]
+            self._write_repository_data(repo_data, repo_data["gen"])
+            self.root.delete_blob(f"snap-{snapshot_name}.json")
+            self._gc_blobs(repo_data)
+
+    def _gc_blobs(self, repo_data: Dict[str, Any]) -> None:
+        """Remove blobs unreferenced by any remaining snapshot (ref:
+        BlobStoreRepository cleanup of stale shard blobs)."""
+        referenced: Dict[str, set] = {}
+        for snap_name in repo_data["snapshots"]:
+            snap = self.get_snapshot(snap_name)
+            for index_name, idx_meta in snap["indices"].items():
+                for shard_id, shard_meta in enumerate(idx_meta["shards"]):
+                    key = f"{index_name}/{shard_id}"
+                    refs = referenced.setdefault(key, set())
+                    for files in shard_meta["segments"].values():
+                        refs.update(files.values())
+        indices_dir = os.path.join(self.location, "indices")
+        if not os.path.isdir(indices_dir):
+            return
+        for index_name in os.listdir(indices_dir):
+            idx_dir = os.path.join(indices_dir, index_name)
+            for shard_id in (os.listdir(idx_dir)
+                             if os.path.isdir(idx_dir) else []):
+                key = f"{index_name}/{shard_id}"
+                container = self.blobstore.container(
+                    "indices", index_name, shard_id)
+                refs = referenced.get(key, set())
+                for blob in container.list_blobs():
+                    if blob.startswith("__") and blob not in refs:
+                        container.delete_blob(blob)
+            # drop empty dirs
+            if not referenced.get(f"{index_name}/0"):
+                if all(not referenced.get(f"{index_name}/{s}")
+                       for s in os.listdir(idx_dir)):
+                    shutil.rmtree(idx_dir, ignore_errors=True)
+
+    # ------------------------------------------------------------- restore
+    def restore(self, snapshot_name: str, indices_service,
+                indices: Optional[List[str]] = None,
+                rename_pattern: Optional[str] = None,
+                rename_replacement: Optional[str] = None) -> Dict[str, Any]:
+        """ref: snapshots/RestoreService — rebuild index files from blobs,
+        then open the index."""
+        import re
+        snap = self.get_snapshot(snapshot_name)
+        restored = []
+        targets = snap["indices"]
+        if indices:
+            wanted = set(indices)
+            targets = {n: m for n, m in targets.items() if n in wanted}
+            missing = wanted - set(targets)
+            if missing:
+                raise IllegalArgumentException(
+                    f"indices {sorted(missing)} not found in snapshot "
+                    f"[{snapshot_name}]")
+        for index_name, idx_meta in targets.items():
+            target_name = index_name
+            if rename_pattern and rename_replacement is not None:
+                target_name = re.sub(rename_pattern, rename_replacement,
+                                     index_name)
+            if indices_service.has(target_name):
+                raise ResourceAlreadyExistsException(
+                    f"cannot restore index [{target_name}]: already exists")
+            indices_service.validate_index_name(target_name)
+            index_path = os.path.join(indices_service.data_path, target_name)
+            os.makedirs(index_path, exist_ok=True)
+            with open(os.path.join(index_path, "_meta.json"), "w") as fh:
+                json.dump({"settings": idx_meta["settings"],
+                           "mappings": idx_meta["mappings"]}, fh)
+            for shard_id, shard_meta in enumerate(idx_meta["shards"]):
+                shard_path = os.path.join(index_path, str(shard_id))
+                os.makedirs(shard_path, exist_ok=True)
+                container = self.blobstore.container(
+                    "indices", index_name, str(shard_id))
+                # restored segments get FRESH names: segment names key the
+                # node-wide device cache, so restoring beside a live copy
+                # of the source index must not alias its device state
+                restore_prefix = uuid.uuid4().hex[:12]
+                name_map: Dict[str, str] = {}
+                for i, (seg_name, files) in enumerate(
+                        shard_meta["segments"].items()):
+                    new_name = f"{restore_prefix}-r{i}"
+                    name_map[seg_name] = new_name
+                    seg_dir = os.path.join(shard_path, new_name)
+                    os.makedirs(seg_dir, exist_ok=True)
+                    for fname, blob in files.items():
+                        content = container.read_blob(blob)
+                        if fname == "meta.json":
+                            meta = json.loads(content.decode())
+                            meta["name"] = new_name
+                            content = json.dumps(meta).encode()
+                        with open(os.path.join(seg_dir, fname), "wb") as fh:
+                            fh.write(content)
+                if shard_meta["commit"] is not None:
+                    commit = dict(shard_meta["commit"])
+                    commit["segments"] = [name_map[s]
+                                          for s in commit["segments"]]
+                    # the restored shard starts a FRESH translog at gen 1;
+                    # carrying the source's generation would make recovery
+                    # skip post-restore writes (acked-write loss)
+                    commit["translog_generation"] = 1
+                    with open(os.path.join(shard_path, "segments.json"),
+                              "w") as fh:
+                        json.dump(commit, fh)
+            indices_service.open_index(target_name)
+            restored.append(target_name)
+        return {"snapshot": {"snapshot": snapshot_name,
+                             "indices": restored,
+                             "shards": {"total": len(restored),
+                                        "failed": 0,
+                                        "successful": len(restored)}}}
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+class RepositoriesService:
+    """ref: repositories/RepositoriesService — registry, persisted locally
+    (the reference keeps it in cluster state)."""
+
+    def __init__(self, data_path: Optional[str] = None):
+        self._repos: Dict[str, BlobStoreRepository] = {}
+        self._configs: Dict[str, Dict[str, Any]] = {}
+        self._path = (os.path.join(data_path, "_repositories.json")
+                      if data_path else None)
+        if data_path:
+            os.makedirs(data_path, exist_ok=True)
+        if self._path and os.path.exists(self._path):
+            with open(self._path) as fh:
+                for name, cfg in json.load(fh).items():
+                    self._register(name, cfg)
+
+    def _register(self, name: str, config: Dict[str, Any]):
+        rtype = config.get("type")
+        settings = config.get("settings", {})
+        if rtype not in ("fs", "url"):
+            raise RepositoryException(
+                f"repository type [{rtype}] does not exist")
+        location = settings.get("location") or settings.get("url")
+        if not location:
+            raise IllegalArgumentException(
+                "[location] is not set for repository")
+        if location.startswith("file:"):
+            location = location[len("file:"):].lstrip("/")
+            location = "/" + location
+        self._repos[name] = BlobStoreRepository(
+            name, location, readonly=(rtype == "url"
+                                      or settings.get("readonly", False)))
+        self._configs[name] = config
+
+    def put_repository(self, name: str, config: Dict[str, Any]):
+        self._register(name, config)
+        self._persist()
+
+    def get_repository(self, name: str) -> BlobStoreRepository:
+        repo = self._repos.get(name)
+        if repo is None:
+            raise ResourceNotFoundException(
+                f"[{name}] missing")
+        return repo
+
+    def get_configs(self, name: Optional[str] = None) -> Dict[str, Any]:
+        if name is None or name in ("_all", "*"):
+            return dict(self._configs)
+        if name not in self._configs:
+            raise ResourceNotFoundException(f"[{name}] missing")
+        return {name: self._configs[name]}
+
+    def delete_repository(self, name: str):
+        if name not in self._repos:
+            raise ResourceNotFoundException(f"[{name}] missing")
+        del self._repos[name]
+        del self._configs[name]
+        self._persist()
+
+    def _persist(self):
+        if self._path:
+            tmp = self._path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(self._configs, fh)
+            os.replace(tmp, self._path)

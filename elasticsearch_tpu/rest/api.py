@@ -116,6 +116,23 @@ def _register_all(c: RestController):
     c.register("GET", "/{index}/_rank_eval", rank_eval_handler)
     c.register("GET", "/{index}/_explain/{id}", explain_doc)
     c.register("POST", "/{index}/_explain/{id}", explain_doc)
+    # snapshots
+    c.register("PUT", "/_snapshot/{repo}", put_repository)
+    c.register("POST", "/_snapshot/{repo}", put_repository)
+    c.register("GET", "/_snapshot/{repo}", get_repository)
+    c.register("GET", "/_snapshot", get_repository)
+    c.register("DELETE", "/_snapshot/{repo}", delete_repository)
+    c.register("PUT", "/_snapshot/{repo}/{snap}", create_snapshot)
+    c.register("POST", "/_snapshot/{repo}/{snap}", create_snapshot)
+    c.register("GET", "/_snapshot/{repo}/{snap}", get_snapshot)
+    c.register("DELETE", "/_snapshot/{repo}/{snap}", delete_snapshot)
+    c.register("POST", "/_snapshot/{repo}/{snap}/_restore", restore_snapshot)
+    # slm
+    c.register("PUT", "/_slm/policy/{id}", slm_put_policy)
+    c.register("GET", "/_slm/policy/{id}", slm_get_policy)
+    c.register("GET", "/_slm/policy", slm_get_policy)
+    c.register("DELETE", "/_slm/policy/{id}", slm_delete_policy)
+    c.register("POST", "/_slm/policy/{id}/_execute", slm_execute_policy)
     # ingest (literal _simulate before the {id} wildcard)
     c.register("POST", "/_ingest/pipeline/_simulate", simulate_pipeline)
     c.register("GET", "/_ingest/pipeline/_simulate", simulate_pipeline)
@@ -672,6 +689,86 @@ def msearch(node, params, body, index=None):
 
 def msearch_index(node, params, body, index):
     return msearch(node, params, body, index=index)
+
+
+# -- snapshots ---------------------------------------------------------------
+
+def put_repository(node, params, body, repo):
+    node.repositories_service.put_repository(repo, body or {})
+    return 200, {"acknowledged": True}
+
+
+def get_repository(node, params, body, repo=None):
+    return 200, node.repositories_service.get_configs(repo)
+
+
+def delete_repository(node, params, body, repo):
+    node.repositories_service.delete_repository(repo)
+    return 200, {"acknowledged": True}
+
+
+def create_snapshot(node, params, body, repo, snap):
+    body = body or {}
+    r = node.repositories_service.get_repository(repo)
+    index_expr = body.get("indices", "_all")
+    if isinstance(index_expr, list):
+        index_expr = ",".join(index_expr)
+    names = node.indices_service.resolve(index_expr)
+    indices = [node.indices_service.get(n) for n in names]
+    info = r.snapshot(snap, indices,
+                      include_global_state=body.get("include_global_state",
+                                                    True),
+                      metadata=body.get("metadata"))
+    # synchronous execution — wait_for_completion always holds here
+    return 200, {"snapshot": info}
+
+
+def get_snapshot(node, params, body, repo, snap):
+    r = node.repositories_service.get_repository(repo)
+    if snap in ("_all", "*"):
+        return 200, {"snapshots": r.list_snapshots()}
+    infos = []
+    for name in snap.split(","):
+        infos.append(r.get_snapshot(name)["info"])
+    return 200, {"snapshots": infos}
+
+
+def delete_snapshot(node, params, body, repo, snap):
+    r = node.repositories_service.get_repository(repo)
+    for name in snap.split(","):
+        r.delete_snapshot(name)
+    return 200, {"acknowledged": True}
+
+
+def restore_snapshot(node, params, body, repo, snap):
+    body = body or {}
+    r = node.repositories_service.get_repository(repo)
+    indices = body.get("indices")
+    if isinstance(indices, str):
+        indices = indices.split(",")
+    result = r.restore(
+        snap, node.indices_service, indices=indices,
+        rename_pattern=body.get("rename_pattern"),
+        rename_replacement=body.get("rename_replacement"))
+    return 200, result
+
+
+def slm_put_policy(node, params, body, id):
+    node.slm_service.put_policy(id, body or {})
+    return 200, {"acknowledged": True}
+
+
+def slm_get_policy(node, params, body, id=None):
+    return 200, node.slm_service.get_policies(id)
+
+
+def slm_delete_policy(node, params, body, id):
+    node.slm_service.delete_policy(id)
+    return 200, {"acknowledged": True}
+
+
+def slm_execute_policy(node, params, body, id):
+    return 200, node.slm_service.execute_policy(id)
 
 
 # -- ingest ------------------------------------------------------------------

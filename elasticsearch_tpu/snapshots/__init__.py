@@ -1,0 +1,3 @@
+from elasticsearch_tpu.snapshots.slm import SnapshotLifecycleService
+
+__all__ = ["SnapshotLifecycleService"]
