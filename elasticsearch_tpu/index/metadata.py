@@ -1,0 +1,567 @@
+"""Index metadata: aliases, composable templates, data streams, rollover.
+
+Mirrors the reference's cluster-metadata layer (ref: cluster/metadata/
+Metadata.java — aliases in IndexAbstraction resolution,
+MetadataIndexTemplateService for composable + component templates,
+DataStream + MetadataCreateDataStreamService, MetadataRolloverService).
+There it all lives in replicated cluster state; here it persists to the
+node data path with the same observable API semantics.
+
+Resolution order for a name (ref: IndexAbstraction lookup): concrete
+index → data stream (its backing indices) → alias (its member indices) →
+wildcard over all three.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    IndexNotFoundException,
+    ResourceAlreadyExistsException,
+    ResourceNotFoundException,
+)
+
+ROLLOVER_SUFFIX_RE = re.compile(r"^(.*)-(\d{6})$")
+
+
+class MetadataService:
+    def __init__(self, indices_service, data_path: Optional[str] = None):
+        self.indices = indices_service
+        # alias -> {index_name: {"filter": query?, "is_write_index": bool}}
+        self.aliases: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        # composable index templates + component templates
+        self.index_templates: Dict[str, Dict[str, Any]] = {}
+        self.component_templates: Dict[str, Dict[str, Any]] = {}
+        # data stream -> {"timestamp_field": ..., "indices": [...], "generation": N}
+        self.data_streams: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.RLock()
+        self._path = (os.path.join(data_path, "_metadata.json")
+                      if data_path else None)
+        if data_path:
+            os.makedirs(data_path, exist_ok=True)
+        if self._path and os.path.exists(self._path):
+            with open(self._path) as fh:
+                state = json.load(fh)
+            self.aliases = state.get("aliases", {})
+            self.index_templates = state.get("index_templates", {})
+            self.component_templates = state.get("component_templates", {})
+            self.data_streams = state.get("data_streams", {})
+        # hook index-name resolution (search path goes through
+        # IndicesService.resolve), wildcard expansion, and delete cleanup
+        indices_service.name_resolver = self.indices_for
+        indices_service.abstraction_lister = self._abstractions
+        indices_service.delete_listeners.append(self._on_index_deleted)
+
+    def _persist(self):
+        if self._path:
+            tmp = self._path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump({"aliases": self.aliases,
+                           "index_templates": self.index_templates,
+                           "component_templates": self.component_templates,
+                           "data_streams": self.data_streams}, fh)
+            os.replace(tmp, self._path)
+
+    # ------------------------------------------------------------ aliases
+    def update_aliases(self, actions: List[Dict[str, Any]]) -> None:
+        """ref: TransportIndicesAliasesAction — atomic batch of
+        add/remove/remove_index actions."""
+        with self._lock:
+            staged = {a: dict(m) for a, m in self.aliases.items()}
+            for action in actions:
+                (kind, spec), = action.items()
+                if kind == "add":
+                    indices = self._action_indices(spec)
+                    alias = spec.get("alias")
+                    aliases = spec.get("aliases",
+                                       [alias] if alias else [])
+                    if isinstance(aliases, str):
+                        aliases = [aliases]
+                    if not aliases:
+                        raise IllegalArgumentException(
+                            "[add] requires an [alias] to be set")
+                    for a in aliases:
+                        if self.indices.has(a) or a in self.data_streams:
+                            raise IllegalArgumentException(
+                                f"alias [{a}] collides with an existing "
+                                f"index or data stream")
+                        entry = staged.setdefault(a, {})
+                        for idx in indices:
+                            props: Dict[str, Any] = {}
+                            if "filter" in spec:
+                                props["filter"] = spec["filter"]
+                            if spec.get("is_write_index"):
+                                props["is_write_index"] = True
+                            entry[idx] = props
+                elif kind == "remove":
+                    indices = self._action_indices(spec)
+                    alias = spec.get("alias")
+                    aliases = spec.get("aliases", [alias] if alias else [])
+                    if isinstance(aliases, str):
+                        aliases = [aliases]
+                    if not aliases:
+                        raise IllegalArgumentException(
+                            "[remove] requires an [alias] to be set")
+                    removed_any = False
+                    for a in list(staged):
+                        if not any(fnmatch.fnmatch(a, pat)
+                                   for pat in aliases):
+                            continue
+                        for idx in indices:
+                            if idx in staged[a]:
+                                del staged[a][idx]
+                                removed_any = True
+                        if not staged[a]:
+                            del staged[a]
+                    if not removed_any and spec.get("must_exist"):
+                        raise ResourceNotFoundException(
+                            f"aliases {aliases} missing")
+                elif kind == "remove_index":
+                    for idx in self._action_indices(spec):
+                        self.indices.delete_index(idx)
+                        for a in list(staged):
+                            staged[a].pop(idx, None)
+                            if not staged[a]:
+                                del staged[a]
+                else:
+                    raise IllegalArgumentException(
+                        f"unknown alias action [{kind}]")
+            self.aliases = staged
+            self._persist()
+
+    def _action_indices(self, spec: Dict[str, Any]) -> List[str]:
+        index = spec.get("index")
+        indices = spec.get("indices", [index] if index else [])
+        if isinstance(indices, str):
+            indices = [indices]
+        if not indices:
+            raise IllegalArgumentException(
+                "alias action requires an [index] to be set")
+        out = []
+        for pat in indices:
+            if "*" in pat:
+                out.extend(n for n in sorted(self.indices.indices)
+                           if fnmatch.fnmatch(n, pat))
+            else:
+                if not self.indices.has(pat):
+                    raise IndexNotFoundException(pat)
+                out.append(pat)
+        return out
+
+    def get_aliases(self, index: Optional[str] = None,
+                    alias: Optional[str] = None) -> Dict[str, Any]:
+        """GET _alias shape: {index: {"aliases": {alias: props}}}."""
+        out: Dict[str, Any] = {}
+        for a, members in self.aliases.items():
+            if alias and not fnmatch.fnmatch(a, alias):
+                continue
+            for idx, props in members.items():
+                if index and not fnmatch.fnmatch(idx, index):
+                    continue
+                out.setdefault(idx, {"aliases": {}})["aliases"][a] = props
+        if index and not out and index != "*" and "*" not in index:
+            if not self.indices.has(index):
+                raise IndexNotFoundException(index)
+            out[index] = {"aliases": {}}
+        return out
+
+    def alias_filter(self, name: str) -> Optional[Dict[str, Any]]:
+        """The (single) filter if ``name`` is a filtered alias — applied as
+        an extra bool filter by the search layer (ref: AliasFilter)."""
+        members = self.aliases.get(name)
+        if not members:
+            return None
+        filters = [p["filter"] for p in members.values() if "filter" in p]
+        if not filters:
+            return None
+        if len(filters) == 1:
+            return filters[0]
+        return {"bool": {"should": filters, "minimum_should_match": 1}}
+
+    def _abstractions(self) -> Dict[str, List[str]]:
+        out = {a: sorted(m) for a, m in self.aliases.items()}
+        out.update({ds: list(meta["indices"])
+                    for ds, meta in self.data_streams.items()})
+        return out
+
+    def _on_index_deleted(self, name: str) -> None:
+        """Keep aliases/data streams consistent when an index is deleted
+        out from under them (ref: MetadataDeleteIndexService strips the
+        index from every alias and backing list)."""
+        with self._lock:
+            changed = False
+            for a in list(self.aliases):
+                if name in self.aliases[a]:
+                    del self.aliases[a][name]
+                    changed = True
+                    if not self.aliases[a]:
+                        del self.aliases[a]
+            for ds in list(self.data_streams):
+                meta = self.data_streams[ds]
+                if name in meta["indices"]:
+                    meta["indices"].remove(name)
+                    changed = True
+                    if not meta["indices"]:
+                        del self.data_streams[ds]
+            if changed:
+                self._persist()
+
+    # --------------------------------------------------------- resolution
+    def indices_for(self, name: str) -> Optional[List[str]]:
+        """Resolver hook for IndicesService: alias/data-stream names →
+        concrete indices; None → not ours (concrete index or missing)."""
+        if name in self.data_streams:
+            return list(self.data_streams[name]["indices"])
+        if name in self.aliases:
+            return sorted(self.aliases[name])
+        return None
+
+    def write_target(self, name: str) -> str:
+        """Concrete index a write to ``name`` lands in (ref:
+        IndexAbstraction.getWriteIndex)."""
+        if name in self.data_streams:
+            return self.data_streams[name]["indices"][-1]
+        members = self.aliases.get(name)
+        if members:
+            writes = [i for i, p in members.items()
+                      if p.get("is_write_index")]
+            if len(writes) == 1:
+                return writes[0]
+            if len(members) == 1:
+                return next(iter(members))
+            raise IllegalArgumentException(
+                f"no write index is defined for alias [{name}]")
+        return name
+
+    # ---------------------------------------------------------- templates
+    def put_component_template(self, name: str, body: Dict[str, Any]):
+        if "template" not in body:
+            raise IllegalArgumentException(
+                "[template] is required for a component template")
+        with self._lock:
+            self.component_templates[name] = body
+            self._persist()
+
+    def put_index_template(self, name: str, body: Dict[str, Any]):
+        patterns = body.get("index_patterns")
+        if not patterns:
+            raise IllegalArgumentException(
+                "[index_patterns] is required for an index template")
+        for c in body.get("composed_of", []):
+            if c not in self.component_templates:
+                raise IllegalArgumentException(
+                    f"component template [{c}] does not exist")
+        with self._lock:
+            self.index_templates[name] = body
+            self._persist()
+
+    def delete_index_template(self, name: str):
+        if name not in self.index_templates:
+            raise ResourceNotFoundException(
+                f"index template [{name}] does not exist")
+        del self.index_templates[name]
+        self._persist()
+
+    def delete_component_template(self, name: str):
+        if name not in self.component_templates:
+            raise ResourceNotFoundException(
+                f"component template [{name}] does not exist")
+        del self.component_templates[name]
+        self._persist()
+
+    def match_template(self, index_name: str) -> Optional[Dict[str, Any]]:
+        """Highest-priority matching composable template, with its
+        component templates merged in order then the template itself
+        (ref: MetadataIndexTemplateService.resolveTemplate)."""
+        best = None
+        best_prio = -1
+        best_name = None
+        for name, tmpl in self.index_templates.items():
+            pats = tmpl["index_patterns"]
+            if isinstance(pats, str):
+                pats = [pats]
+            if any(fnmatch.fnmatch(index_name, p) for p in pats):
+                prio = int(tmpl.get("priority", 0))
+                if prio > best_prio:
+                    best, best_prio, best_name = tmpl, prio, name
+        if best is None:
+            return None
+        merged: Dict[str, Any] = {"settings": {}, "mappings": {},
+                                  "aliases": {}}
+        for comp in best.get("composed_of", []):
+            self._merge_template(merged,
+                                 self.component_templates[comp]["template"])
+        self._merge_template(merged, best.get("template", {}))
+        merged["_name"] = best_name
+        merged["_data_stream"] = best.get("data_stream")
+        return merged
+
+    @staticmethod
+    def _merge_template(acc: Dict[str, Any], tmpl: Dict[str, Any]):
+        acc["settings"].update(tmpl.get("settings", {}))
+        _deep_update(acc["mappings"], tmpl.get("mappings", {}))
+        acc["aliases"].update(tmpl.get("aliases", {}))
+
+    def create_index_from_template(self, name: str,
+                                   body: Optional[Dict[str, Any]] = None):
+        """Create an index applying any matching template, then the
+        request body on top (request wins)."""
+        body = body or {}
+        if name in self.aliases or name in self.data_streams:
+            raise IllegalArgumentException(
+                f"index name [{name}] collides with an existing alias or "
+                f"data stream")
+        tmpl = self.match_template(name) or {"settings": {}, "mappings": {},
+                                             "aliases": {}}
+        settings = dict(tmpl["settings"])
+        settings.update(body.get("settings", {}))
+        mappings = {}
+        _deep_update(mappings, tmpl["mappings"])
+        _deep_update(mappings, body.get("mappings", {}))
+        idx = self.indices.create_index(name, settings or None,
+                                        mappings or None)
+        alias_actions = []
+        for a, props in {**tmpl["aliases"],
+                         **body.get("aliases", {})}.items():
+            spec = {"index": name, "alias": a}
+            spec.update(props or {})
+            alias_actions.append({"add": spec})
+        if alias_actions:
+            self.update_aliases(alias_actions)
+        return idx
+
+    # -------------------------------------------------------- data streams
+    def create_data_stream(self, name: str) -> None:
+        """ref: MetadataCreateDataStreamService — requires a matching
+        template with a data_stream object."""
+        with self._lock:
+            if name in self.data_streams:
+                raise ResourceAlreadyExistsException(
+                    f"data_stream [{name}] already exists")
+            if self.indices.has(name) or name in self.aliases:
+                raise IllegalArgumentException(
+                    f"data stream name [{name}] collides with an existing "
+                    f"index or alias")
+            tmpl = self.match_template(name)
+            if tmpl is None or tmpl.get("_data_stream") is None:
+                raise IllegalArgumentException(
+                    f"no matching index template with a data_stream "
+                    f"definition for [{name}]")
+            backing = self._backing_name(name, 1)
+            mappings = {"properties": {"@timestamp": {"type": "date"}}}
+            _deep_update(mappings, tmpl["mappings"])
+            self.indices.create_index(backing, tmpl["settings"] or None,
+                                      mappings)
+            self.data_streams[name] = {
+                "timestamp_field": "@timestamp",
+                "indices": [backing],
+                "generation": 1,
+            }
+            self._persist()
+
+    @staticmethod
+    def _backing_name(stream: str, generation: int) -> str:
+        stamp = time.strftime("%Y.%m.%d", time.gmtime())
+        return f".ds-{stream}-{stamp}-{generation:06d}"
+
+    def get_data_streams(self, name: Optional[str] = None) -> List[Dict[str, Any]]:
+        out = []
+        for ds, meta in sorted(self.data_streams.items()):
+            if name and name not in ("*", "_all") and \
+                    not fnmatch.fnmatch(ds, name):
+                continue
+            out.append({
+                "name": ds,
+                "timestamp_field": {"name": meta["timestamp_field"]},
+                "indices": [{"index_name": n} for n in meta["indices"]],
+                "generation": meta["generation"],
+                "status": "GREEN",
+            })
+        return out
+
+    def delete_data_stream(self, name: str) -> None:
+        with self._lock:
+            if name not in self.data_streams:
+                raise ResourceNotFoundException(
+                    f"data_stream [{name}] does not exist")
+            for backing in self.data_streams[name]["indices"]:
+                if self.indices.has(backing):
+                    self.indices.delete_index(backing)
+            del self.data_streams[name]
+            self._persist()
+
+    # ------------------------------------------------------------ rollover
+    def rollover(self, target: str,
+                 body: Optional[Dict[str, Any]] = None,
+                 dry_run: bool = False) -> Dict[str, Any]:
+        """ref: MetadataRolloverService — conditions checked against the
+        current write index; on rollover a successor index is created and
+        the alias/data-stream flips to it."""
+        body = body or {}
+        conditions = body.get("conditions", {})
+        with self._lock:
+            if target in self.data_streams:
+                ds = self.data_streams[target]
+                old_index = ds["indices"][-1]
+                new_gen = ds["generation"] + 1
+                new_index = self._backing_name(target, new_gen)
+                is_stream = True
+            elif target in self.aliases:
+                old_index = self.write_target(target)
+                m = ROLLOVER_SUFFIX_RE.match(old_index)
+                if body.get("new_index"):
+                    new_index = body["new_index"]
+                elif m:
+                    new_index = f"{m.group(1)}-{int(m.group(2)) + 1:06d}"
+                else:
+                    raise IllegalArgumentException(
+                        f"index name [{old_index}] does not match pattern "
+                        f"'^.*-\\d+$' — specify [new_index]")
+                is_stream = False
+            else:
+                raise IllegalArgumentException(
+                    f"rollover target [{target}] is not an alias or data "
+                    f"stream")
+
+            met = self._check_conditions(old_index, conditions)
+            should_roll = (not conditions) or any(met.values())
+            result = {
+                "old_index": old_index, "new_index": new_index,
+                "rolled_over": False, "dry_run": dry_run,
+                "acknowledged": True, "conditions": met,
+            }
+            if dry_run or not should_roll:
+                return result
+            if is_stream:
+                tmpl = self.match_template(target) or {
+                    "settings": {}, "mappings": {}}
+                mappings = {"properties": {"@timestamp": {"type": "date"}}}
+                _deep_update(mappings, tmpl.get("mappings", {}))
+                self.indices.create_index(new_index,
+                                          tmpl.get("settings") or None,
+                                          mappings)
+                ds["indices"].append(new_index)
+                ds["generation"] = new_gen
+            else:
+                self.create_index_from_template(
+                    new_index, {k: v for k, v in body.items()
+                                if k in ("settings", "mappings", "aliases")})
+                members = self.aliases[target]
+                old_props = members.get(old_index, {})
+                if old_props.get("is_write_index"):
+                    # explicit write alias: old index stays as a read
+                    # member (ref: MetadataRolloverService)
+                    members[old_index] = {
+                        k: v for k, v in old_props.items()
+                        if k != "is_write_index"}
+                else:
+                    # implicit single-index alias swaps entirely
+                    members.pop(old_index, None)
+                members[new_index] = {"is_write_index": True}
+            self._persist()
+            result["rolled_over"] = True
+            return result
+
+    def _check_conditions(self, index_name: str,
+                          conditions: Dict[str, Any]) -> Dict[str, bool]:
+        met: Dict[str, bool] = {}
+        if not conditions:
+            return met
+        idx = self.indices.get(index_name)
+        stats = idx.stats()
+        doc_count = stats["docs"]["count"]
+        if "max_docs" in conditions:
+            met[f"[max_docs: {conditions['max_docs']}]"] = (
+                doc_count >= int(conditions["max_docs"]))
+        if "max_age" in conditions:
+            # index creation time from the data dir mtime
+            age_s = time.time() - os.path.getctime(idx.path)
+            met[f"[max_age: {conditions['max_age']}]"] = (
+                age_s * 1000 >= _parse_ms(conditions["max_age"]))
+        if "max_size" in conditions:
+            size = sum(seg.ram_bytes() for sh in idx.shards
+                       for seg in sh.segments)
+            met[f"[max_size: {conditions['max_size']}]"] = (
+                size >= _parse_bytes(conditions["max_size"]))
+        return met
+
+
+# ---------------------------------------------------------------------------
+# shrink / split (host-side columnar reshard)
+# ---------------------------------------------------------------------------
+
+def resize_index(indices_service, source_name: str, target_name: str,
+                 body: Optional[Dict[str, Any]] = None,
+                 mode: str = "shrink"):
+    """ref: action/admin/indices/shrink/ (TransportResizeAction). The
+    reference hard-links Lucene files and re-filters; here the columnar
+    segments are re-partitioned host-side by the same routing hash — an
+    honest equivalent at this segment format, and the device re-uploads
+    lazily per new shard."""
+    body = body or {}
+    src = indices_service.get(source_name)
+    # buffered (unrefreshed) docs must be in the published segments before
+    # the copy, or the resized index silently loses them
+    src.refresh()
+    settings = dict(body.get("settings", {}))
+    n_target = int(settings.get("index.number_of_shards",
+                                1 if mode == "shrink" else src.num_shards * 2))
+    if mode == "shrink" and n_target > src.num_shards:
+        raise IllegalArgumentException(
+            f"the number of target shards [{n_target}] must be less than or "
+            f"equal to the number of source shards [{src.num_shards}]")
+    if mode == "split" and n_target < src.num_shards:
+        raise IllegalArgumentException(
+            f"the number of target shards [{n_target}] must be greater than "
+            f"the number of source shards [{src.num_shards}]")
+    merged_settings = {k: v for k, v in src.settings.as_dict().items()}
+    merged_settings.update(settings)
+    merged_settings["index.number_of_shards"] = n_target
+    target = indices_service.create_index(
+        target_name, merged_settings, src.mapper.to_mapping())
+    for engine in src.shards:
+        for seg in engine.segments:
+            for docid in range(seg.n_docs):
+                if not seg.live[docid]:
+                    continue
+                doc_id = seg.stored.ids[docid]
+                source = json.loads(seg.stored.source(docid))
+                target.index_doc(doc_id, source)
+    target.refresh()
+    target.flush()
+    return target
+
+
+def _deep_update(base: Dict[str, Any], update: Dict[str, Any]):
+    for k, v in update.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            _deep_update(base[k], v)
+        else:
+            base[k] = v
+
+
+def _parse_ms(v) -> float:
+    units = {"ms": 1.0, "s": 1000.0, "m": 60_000.0, "h": 3_600_000.0,
+             "d": 86_400_000.0}
+    s = str(v)
+    for suffix in ("ms", "s", "m", "h", "d"):
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * units[suffix]
+    return float(s)
+
+
+def _parse_bytes(v) -> float:
+    units = {"b": 1, "kb": 1024, "mb": 1024**2, "gb": 1024**3, "tb": 1024**4}
+    s = str(v).lower()
+    for suffix in ("kb", "mb", "gb", "tb", "b"):
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * units[suffix]
+    return float(s)

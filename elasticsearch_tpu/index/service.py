@@ -186,6 +186,13 @@ class IndicesService:
         self.node_settings = node_settings
         self.indices: Dict[str, IndexService] = {}
         self.device_cache = DeviceSegmentCache()
+        # alias/data-stream resolution hooks (set by MetadataService):
+        # name -> list of concrete indices, or None if not an abstraction
+        self.name_resolver = None
+        # () -> {abstraction name: [indices]} for wildcard expansion
+        self.abstraction_lister = None
+        # callbacks fired when an index is deleted (metadata cleanup)
+        self.delete_listeners = []
         os.makedirs(data_path, exist_ok=True)
         for name in sorted(os.listdir(data_path)):
             meta_path = os.path.join(data_path, name, "_meta.json")
@@ -246,6 +253,8 @@ class IndicesService:
         self.device_cache.evict(idx._known_seg_names)
         del self.indices[name]
         shutil.rmtree(idx.path, ignore_errors=True)
+        for listener in self.delete_listeners:
+            listener(name)
 
     def resolve(self, expression: str) -> List[str]:
         """Index name expression: csv, wildcards, _all (ref:
@@ -256,10 +265,24 @@ class IndicesService:
         import fnmatch
         for part in expression.split(","):
             part = part.strip()
+            if not part:
+                continue
+            if self.name_resolver is not None and "*" not in part:
+                resolved = self.name_resolver(part)
+                if resolved is not None:
+                    out.extend(resolved)
+                    continue
             if "*" in part or "?" in part:
-                out.extend(n for n in sorted(self.indices)
-                           if fnmatch.fnmatch(n, part))
-            elif part:
+                matched = {n for n in self.indices
+                           if fnmatch.fnmatch(n, part)}
+                # wildcards also expand over aliases/data streams (ref:
+                # IndexNameExpressionResolver WildcardExpressionResolver)
+                if self.abstraction_lister is not None:
+                    for name, members in self.abstraction_lister().items():
+                        if fnmatch.fnmatch(name, part):
+                            matched.update(members)
+                out.extend(sorted(matched))
+            else:
                 if part not in self.indices:
                     raise IndexNotFoundException(part)
                 out.append(part)

@@ -12,6 +12,7 @@ from typing import Optional
 
 from elasticsearch_tpu.common.settings import Setting, Settings
 from elasticsearch_tpu.index.service import IndicesService
+from elasticsearch_tpu.index.metadata import MetadataService
 from elasticsearch_tpu.ingest.service import IngestService
 from elasticsearch_tpu.repositories.blobstore import RepositoriesService
 from elasticsearch_tpu.snapshots.slm import SnapshotLifecycleService
@@ -39,6 +40,8 @@ class Node:
         self.indices_service = IndicesService(self.data_path, settings)
         self.search_service = SearchService(self.indices_service)
         self.ingest_service = IngestService(self.data_path)
+        self.metadata_service = MetadataService(self.indices_service,
+                                                self.data_path)
         self.repositories_service = RepositoriesService(self.data_path)
         self.slm_service = SnapshotLifecycleService(
             self.repositories_service, self.indices_service, self.data_path)

@@ -22,6 +22,7 @@ from elasticsearch_tpu.common.errors import (
     ElasticsearchTpuException,
     IllegalArgumentException,
     ParsingException,
+    ResourceNotFoundException,
 )
 from elasticsearch_tpu.search.rank_eval import rank_eval
 
@@ -116,6 +117,41 @@ def _register_all(c: RestController):
     c.register("GET", "/{index}/_rank_eval", rank_eval_handler)
     c.register("GET", "/{index}/_explain/{id}", explain_doc)
     c.register("POST", "/{index}/_explain/{id}", explain_doc)
+    # aliases
+    c.register("POST", "/_aliases", update_aliases)
+    c.register("GET", "/_alias", get_alias)
+    c.register("GET", "/_alias/{name}", get_alias)
+    c.register("GET", "/_cat/aliases", cat_aliases)
+    c.register("PUT", "/{index}/_alias/{name}", put_alias)
+    c.register("POST", "/{index}/_alias/{name}", put_alias)
+    c.register("PUT", "/{index}/_aliases/{name}", put_alias)
+    c.register("DELETE", "/{index}/_alias/{name}", delete_alias)
+    c.register("DELETE", "/{index}/_aliases/{name}", delete_alias)
+    c.register("GET", "/{index}/_alias", get_alias)
+    c.register("GET", "/{index}/_alias/{name}", get_alias)
+    # templates
+    c.register("PUT", "/_index_template/{name}", put_index_template)
+    c.register("POST", "/_index_template/{name}", put_index_template)
+    c.register("GET", "/_index_template", get_index_template)
+    c.register("GET", "/_index_template/{name}", get_index_template)
+    c.register("DELETE", "/_index_template/{name}", delete_index_template)
+    c.register("PUT", "/_component_template/{name}", put_component_template)
+    c.register("GET", "/_component_template", get_component_template)
+    c.register("GET", "/_component_template/{name}", get_component_template)
+    c.register("DELETE", "/_component_template/{name}",
+               delete_component_template)
+    # rollover / resize
+    c.register("POST", "/{index}/_rollover", rollover_index)
+    c.register("POST", "/{index}/_rollover/{new_index}", rollover_index)
+    c.register("PUT", "/{index}/_shrink/{target}", shrink_index)
+    c.register("POST", "/{index}/_shrink/{target}", shrink_index)
+    c.register("PUT", "/{index}/_split/{target}", split_index)
+    c.register("POST", "/{index}/_split/{target}", split_index)
+    # data streams
+    c.register("PUT", "/_data_stream/{name}", create_data_stream)
+    c.register("GET", "/_data_stream", get_data_stream)
+    c.register("GET", "/_data_stream/{name}", get_data_stream)
+    c.register("DELETE", "/_data_stream/{name}", delete_data_stream)
     # snapshots
     c.register("PUT", "/_snapshot/{repo}", put_repository)
     c.register("POST", "/_snapshot/{repo}", put_repository)
@@ -272,8 +308,7 @@ def cat_shards(node, params, body):
 
 def create_index(node, params, body, index):
     body = body or {}
-    node.indices_service.create_index(index, body.get("settings"),
-                                      body.get("mappings"))
+    node.metadata_service.create_index_from_template(index, body)
     return 200, {"acknowledged": True, "shards_acknowledged": True,
                  "index": index}
 
@@ -360,10 +395,13 @@ def _analyze(registry, body):
 # -- documents ---------------------------------------------------------------
 
 def _ensure_index(node, index):
+    # aliases/data streams route writes to their write index (ref:
+    # IndexAbstraction.getWriteIndex)
+    index = node.metadata_service.write_target(index)
     if not node.indices_service.has(index):
-        # auto-create on first write (ref: TransportBulkAction auto-create,
-        # action/bulk/TransportBulkAction.java:251-260)
-        node.indices_service.create_index(index)
+        # auto-create on first write, applying matching templates (ref:
+        # TransportBulkAction auto-create, TransportBulkAction.java:251-260)
+        node.metadata_service.create_index_from_template(index)
     return node.indices_service.get(index)
 
 
@@ -434,6 +472,7 @@ def create_doc(node, params, body, index, id):
 
 
 def get_doc(node, params, body, index, id):
+    index = node.metadata_service.write_target(index)
     idx = node.indices_service.get(index)
     result = idx.get_doc(id, routing=params.get("routing"))
     if not result.found:
@@ -445,6 +484,7 @@ def get_doc(node, params, body, index, id):
 
 
 def get_source(node, params, body, index, id):
+    index = node.metadata_service.write_target(index)
     idx = node.indices_service.get(index)
     result = idx.get_doc(id, routing=params.get("routing"))
     if not result.found:
@@ -453,6 +493,7 @@ def get_source(node, params, body, index, id):
 
 
 def delete_doc(node, params, body, index, id):
+    index = node.metadata_service.write_target(index)
     idx = node.indices_service.get(index)
     result = idx.delete_doc(id, routing=params.get("routing"))
     if params.get("refresh") in ("true", ""):
@@ -464,6 +505,7 @@ def delete_doc(node, params, body, index, id):
 
 def update_doc(node, params, body, index, id):
     """ref: UpdateHelper get-merge-reindex (action/update/)."""
+    index = node.metadata_service.write_target(index)
     idx = node.indices_service.get(index)
     body = body or {}
     current = idx.get_doc(id, routing=params.get("routing"))
@@ -611,8 +653,22 @@ def bulk_index(node, params, body, index):
 
 # -- search ------------------------------------------------------------------
 
+def _apply_alias_filter(node, index, body):
+    """Filtered-alias search (ref: AliasFilter applied per shard request):
+    wrap the query with the alias filter when the target is one alias."""
+    filt = node.metadata_service.alias_filter(index)
+    if filt is None:
+        return body
+    body = dict(body or {})
+    query = body.get("query")
+    body["query"] = {"bool": {"must": [query] if query else [],
+                              "filter": [filt]}}
+    return body
+
+
 def search_index(node, params, body, index):
     body = _merge_search_params(body, params)
+    body = _apply_alias_filter(node, index, body)
     r = node.search_service.search(index, body, scroll=params.get("scroll"))
     return 200, r
 
@@ -640,13 +696,15 @@ def _merge_search_params(body, params):
 
 
 def count_index(node, params, body, index):
-    return 200, node.search_service.count(index, body or {})
+    body = _apply_alias_filter(node, index, body or {})
+    return 200, node.search_service.count(index, body)
 
 
 def explain_doc(node, params, body, index, id):
     body = body or {}
     if "q" in params and "query" not in body:
         body = _merge_search_params(body, params)
+    body = _apply_alias_filter(node, index, body)
     return 200, node.search_service.explain(index, id, body)
 
 
@@ -681,6 +739,7 @@ def msearch(node, params, body, index=None):
         search_body = lines[i] if i < len(lines) else {}
         i += 1
         try:
+            search_body = _apply_alias_filter(node, target, search_body)
             responses.append(node.search_service.search(target, search_body))
         except ElasticsearchTpuException as e:
             responses.append({"error": e.to_xcontent(), "status": e.status})
@@ -689,6 +748,118 @@ def msearch(node, params, body, index=None):
 
 def msearch_index(node, params, body, index):
     return msearch(node, params, body, index=index)
+
+
+# -- aliases / templates / data streams / rollover ---------------------------
+
+def update_aliases(node, params, body):
+    node.metadata_service.update_aliases((body or {}).get("actions", []))
+    return 200, {"acknowledged": True}
+
+
+def put_alias(node, params, body, index, name):
+    spec = {"index": index, "alias": name}
+    spec.update(body or {})
+    node.metadata_service.update_aliases([{"add": spec}])
+    return 200, {"acknowledged": True}
+
+
+def delete_alias(node, params, body, index, name):
+    node.metadata_service.update_aliases(
+        [{"remove": {"index": index, "alias": name}}])
+    return 200, {"acknowledged": True}
+
+
+def get_alias(node, params, body, index=None, name=None):
+    out = node.metadata_service.get_aliases(index, name)
+    if name and not out:
+        return 404, {"error": f"alias [{name}] missing", "status": 404}
+    return 200, out
+
+
+def cat_aliases(node, params, body):
+    lines = []
+    for a, members in sorted(node.metadata_service.aliases.items()):
+        for idx in sorted(members):
+            lines.append(f"{a} {idx} - - - -")
+    return 200, {"_cat": "\n".join(lines)}
+
+
+def put_index_template(node, params, body, name):
+    node.metadata_service.put_index_template(name, body or {})
+    return 200, {"acknowledged": True}
+
+
+def get_index_template(node, params, body, name=None):
+    tmpls = node.metadata_service.index_templates
+    if name and name not in tmpls:
+        raise ResourceNotFoundException(
+            f"index template matching [{name}] not found")
+    wanted = [name] if name else sorted(tmpls)
+    return 200, {"index_templates": [
+        {"name": n, "index_template": tmpls[n]} for n in wanted]}
+
+
+def delete_index_template(node, params, body, name):
+    node.metadata_service.delete_index_template(name)
+    return 200, {"acknowledged": True}
+
+
+def put_component_template(node, params, body, name):
+    node.metadata_service.put_component_template(name, body or {})
+    return 200, {"acknowledged": True}
+
+
+def get_component_template(node, params, body, name=None):
+    tmpls = node.metadata_service.component_templates
+    if name and name not in tmpls:
+        raise ResourceNotFoundException(
+            f"component template matching [{name}] not found")
+    wanted = [name] if name else sorted(tmpls)
+    return 200, {"component_templates": [
+        {"name": n, "component_template": tmpls[n]} for n in wanted]}
+
+
+def delete_component_template(node, params, body, name):
+    node.metadata_service.delete_component_template(name)
+    return 200, {"acknowledged": True}
+
+
+def rollover_index(node, params, body, index, new_index=None):
+    if new_index is not None:
+        body = dict(body or {})
+        body["new_index"] = new_index
+    dry_run = params.get("dry_run") in ("true", "")
+    return 200, node.metadata_service.rollover(index, body, dry_run=dry_run)
+
+
+def shrink_index(node, params, body, index, target):
+    from elasticsearch_tpu.index.metadata import resize_index
+    resize_index(node.indices_service, index, target, body, mode="shrink")
+    return 200, {"acknowledged": True, "shards_acknowledged": True,
+                 "index": target}
+
+
+def split_index(node, params, body, index, target):
+    from elasticsearch_tpu.index.metadata import resize_index
+    resize_index(node.indices_service, index, target, body, mode="split")
+    return 200, {"acknowledged": True, "shards_acknowledged": True,
+                 "index": target}
+
+
+def create_data_stream(node, params, body, name):
+    node.metadata_service.create_data_stream(name)
+    return 200, {"acknowledged": True}
+
+
+def get_data_stream(node, params, body, name=None):
+    return 200, {"data_streams":
+                 node.metadata_service.get_data_streams(name)}
+
+
+def delete_data_stream(node, params, body, name):
+    node.metadata_service.delete_data_stream(name)
+    return 200, {"acknowledged": True}
 
 
 # -- snapshots ---------------------------------------------------------------

@@ -1,0 +1,357 @@
+"""Aliases, templates, rollover, shrink/split, data streams (ref:
+cluster/metadata/ — IndexAbstraction resolution, MetadataIndexTemplate-
+Service, MetadataRolloverService, MetadataCreateDataStreamService,
+TransportResizeAction)."""
+
+import pytest
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ResourceAlreadyExistsException,
+)
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(data_path=str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def d(node, method, path, params=None, body=None):
+    return node.rest_controller.dispatch(method, path, params or {}, body)
+
+
+# ---------------------------------------------------------------- aliases
+
+def test_alias_add_search_and_remove(node):
+    d(node, "PUT", "/logs-1/_doc/1", {"refresh": "true"}, {"v": 1})
+    d(node, "PUT", "/logs-2/_doc/2", {"refresh": "true"}, {"v": 2})
+    status, _ = d(node, "POST", "/_aliases", body={"actions": [
+        {"add": {"index": "logs-1", "alias": "logs"}},
+        {"add": {"index": "logs-2", "alias": "logs"}}]})
+    assert status == 200
+    _, r = d(node, "POST", "/logs/_search", body={"size": 10})
+    assert r["hits"]["total"]["value"] == 2
+    # GET shapes
+    _, r = d(node, "GET", "/_alias/logs")
+    assert set(r) == {"logs-1", "logs-2"}
+    # remove one member
+    d(node, "POST", "/_aliases", body={"actions": [
+        {"remove": {"index": "logs-1", "alias": "logs"}}]})
+    _, r = d(node, "POST", "/logs/_search", body={})
+    assert r["hits"]["total"]["value"] == 1
+
+
+def test_alias_write_index_routing(node):
+    d(node, "PUT", "/a-1", body={})
+    d(node, "PUT", "/a-2", body={})
+    d(node, "POST", "/_aliases", body={"actions": [
+        {"add": {"index": "a-1", "alias": "a"}},
+        {"add": {"index": "a-2", "alias": "a", "is_write_index": True}}]})
+    d(node, "PUT", "/a/_doc/1", {"refresh": "true"}, {"v": 1})
+    _, doc = d(node, "GET", "/a-2/_doc/1")
+    assert doc["found"] is True
+
+
+def test_filtered_alias(node):
+    for i, team in enumerate(["red", "blue", "red"]):
+        d(node, "PUT", f"/events/_doc/{i}", {"refresh": "true"},
+          {"team": team, "n": i})
+    d(node, "POST", "/_aliases", body={"actions": [
+        {"add": {"index": "events", "alias": "red_events",
+                 "filter": {"term": {"team.keyword": "red"}}}}]})
+    _, r = d(node, "POST", "/red_events/_search", body={})
+    assert r["hits"]["total"]["value"] == 2
+
+
+def test_alias_per_index_endpoint(node):
+    d(node, "PUT", "/i1", body={})
+    d(node, "PUT", "/i1/_alias/al", body={})
+    _, r = d(node, "GET", "/i1/_alias")
+    assert "al" in r["i1"]["aliases"]
+    d(node, "DELETE", "/i1/_alias/al")
+    _, r = d(node, "GET", "/i1/_alias")
+    assert r["i1"]["aliases"] == {}
+
+
+def test_alias_name_collision_rejected(node):
+    d(node, "PUT", "/real", body={})
+    d(node, "PUT", "/other", body={})
+    with pytest.raises(IllegalArgumentException):
+        node.metadata_service.update_aliases(
+            [{"add": {"index": "other", "alias": "real"}}])
+
+
+# --------------------------------------------------------------- templates
+
+def test_index_template_applied_on_create(node):
+    d(node, "PUT", "/_component_template/base", body={"template": {
+        "settings": {"index.number_of_shards": 2},
+        "mappings": {"properties": {"ts": {"type": "date"}}}}})
+    d(node, "PUT", "/_index_template/logs", body={
+        "index_patterns": ["logs-*"], "composed_of": ["base"],
+        "priority": 10,
+        "template": {"mappings": {
+            "properties": {"level": {"type": "keyword"}}}}})
+    # auto-create via write applies the template
+    d(node, "PUT", "/logs-app/_doc/1", {"refresh": "true"},
+      {"level": "info", "msg": "x"})
+    idx = node.indices_service.get("logs-app")
+    assert idx.num_shards == 2
+    mapping = idx.mapper.to_mapping()["properties"]
+    assert mapping["ts"]["type"] == "date"
+    assert mapping["level"]["type"] == "keyword"
+
+
+def test_template_priority(node):
+    d(node, "PUT", "/_index_template/low", body={
+        "index_patterns": ["x-*"], "priority": 1,
+        "template": {"settings": {"index.number_of_shards": 1}}})
+    d(node, "PUT", "/_index_template/high", body={
+        "index_patterns": ["x-special-*"], "priority": 100,
+        "template": {"settings": {"index.number_of_shards": 3}}})
+    d(node, "PUT", "/x-special-1", body={})
+    assert node.indices_service.get("x-special-1").num_shards == 3
+
+
+def test_request_body_overrides_template(node):
+    d(node, "PUT", "/_index_template/t", body={
+        "index_patterns": ["y-*"],
+        "template": {"settings": {"index.number_of_shards": 4}}})
+    d(node, "PUT", "/y-1", body={"settings": {"index.number_of_shards": 1}})
+    assert node.indices_service.get("y-1").num_shards == 1
+
+
+def test_template_crud(node):
+    d(node, "PUT", "/_index_template/t", body={"index_patterns": ["z-*"]})
+    _, r = d(node, "GET", "/_index_template/t")
+    assert r["index_templates"][0]["name"] == "t"
+    d(node, "DELETE", "/_index_template/t")
+    status, _ = d(node, "GET", "/_index_template")
+    assert status == 200
+
+
+def test_template_with_aliases(node):
+    d(node, "PUT", "/_index_template/t", body={
+        "index_patterns": ["w-*"],
+        "template": {"aliases": {"w_all": {}}}})
+    d(node, "PUT", "/w-1", body={})
+    _, r = d(node, "GET", "/_alias/w_all")
+    assert "w-1" in r
+
+
+# ---------------------------------------------------------------- rollover
+
+def test_rollover_alias(node):
+    d(node, "PUT", "/app-000001", body={})
+    d(node, "POST", "/_aliases", body={"actions": [
+        {"add": {"index": "app-000001", "alias": "app",
+                 "is_write_index": True}}]})
+    for i in range(5):
+        d(node, "PUT", f"/app/_doc/{i}", {"refresh": "true"}, {"v": i})
+    # conditions not met: no rollover
+    _, r = d(node, "POST", "/app/_rollover", body={
+        "conditions": {"max_docs": 100}})
+    assert r["rolled_over"] is False
+    # conditions met
+    _, r = d(node, "POST", "/app/_rollover", body={
+        "conditions": {"max_docs": 3}})
+    assert r["rolled_over"] is True
+    assert r["old_index"] == "app-000001"
+    assert r["new_index"] == "app-000002"
+    # writes now land in the new index
+    d(node, "PUT", "/app/_doc/new", {"refresh": "true"}, {"v": 99})
+    _, doc = d(node, "GET", "/app-000002/_doc/new")
+    assert doc["found"] is True
+    # searches via alias cover both
+    _, r = d(node, "POST", "/app/_search", body={"size": 0})
+    assert r["hits"]["total"]["value"] == 6
+
+
+def test_rollover_requires_counted_name_or_new_index(node):
+    d(node, "PUT", "/plain", body={})
+    d(node, "POST", "/_aliases", body={"actions": [
+        {"add": {"index": "plain", "alias": "p", "is_write_index": True}}]})
+    with pytest.raises(IllegalArgumentException):
+        node.metadata_service.rollover("p", {})
+    _, r = d(node, "POST", "/p/_rollover/plain-next", body={})
+    assert r["new_index"] == "plain-next"
+
+
+# ------------------------------------------------------------ shrink/split
+
+def test_shrink_and_split(node):
+    d(node, "PUT", "/big", body={"settings": {"index.number_of_shards": 4}})
+    for i in range(40):
+        d(node, "PUT", f"/big/_doc/{i}", {}, {"n": i})
+    d(node, "POST", "/big/_refresh")
+    _, r = d(node, "PUT", "/big/_shrink/small", body={
+        "settings": {"index.number_of_shards": 1}})
+    assert r["acknowledged"] is True
+    assert node.indices_service.get("small").num_shards == 1
+    _, r = d(node, "POST", "/small/_search", body={"size": 0})
+    assert r["hits"]["total"]["value"] == 40
+    _, r = d(node, "PUT", "/small/_split/wide", body={
+        "settings": {"index.number_of_shards": 3}})
+    assert node.indices_service.get("wide").num_shards == 3
+    _, r = d(node, "POST", "/wide/_search", body={"size": 0})
+    assert r["hits"]["total"]["value"] == 40
+
+
+def test_shrink_more_shards_rejected(node):
+    d(node, "PUT", "/src2", body={"settings": {"index.number_of_shards": 2}})
+    status, r = d(node, "PUT", "/src2/_shrink/dst2",
+                  body={"settings": {"index.number_of_shards": 4}})
+    assert status == 400
+
+
+# ------------------------------------------------------------ data streams
+
+def test_data_stream_lifecycle(node):
+    d(node, "PUT", "/_index_template/metrics", body={
+        "index_patterns": ["metrics-*"], "data_stream": {},
+        "template": {"mappings": {
+            "properties": {"value": {"type": "double"}}}}})
+    status, _ = d(node, "PUT", "/_data_stream/metrics-cpu")
+    assert status == 200
+    _, r = d(node, "GET", "/_data_stream/metrics-cpu")
+    ds = r["data_streams"][0]
+    assert ds["generation"] == 1
+    backing = ds["indices"][0]["index_name"]
+    assert backing.startswith(".ds-metrics-cpu-")
+    # writes land in the backing index
+    d(node, "PUT", "/metrics-cpu/_doc/1", {"refresh": "true"},
+      {"@timestamp": "2026-01-01T00:00:00Z", "value": 0.5})
+    _, r = d(node, "POST", "/metrics-cpu/_search", body={})
+    assert r["hits"]["total"]["value"] == 1
+    # rollover
+    _, r = d(node, "POST", "/metrics-cpu/_rollover", body={})
+    assert r["rolled_over"] is True
+    _, r = d(node, "GET", "/_data_stream/metrics-cpu")
+    assert r["data_streams"][0]["generation"] == 2
+    assert len(r["data_streams"][0]["indices"]) == 2
+    # search covers all backing indices
+    d(node, "PUT", "/metrics-cpu/_doc/2", {"refresh": "true"},
+      {"@timestamp": "2026-01-02T00:00:00Z", "value": 0.7})
+    _, r = d(node, "POST", "/metrics-cpu/_search", body={"size": 0})
+    assert r["hits"]["total"]["value"] == 2
+    # delete removes backing indices
+    d(node, "DELETE", "/_data_stream/metrics-cpu")
+    assert not node.indices_service.has(backing)
+
+
+def test_data_stream_requires_template(node):
+    with pytest.raises(IllegalArgumentException):
+        node.metadata_service.create_data_stream("unmatched")
+
+
+def test_data_stream_duplicate_rejected(node):
+    d(node, "PUT", "/_index_template/t", body={
+        "index_patterns": ["s-*"], "data_stream": {}})
+    d(node, "PUT", "/_data_stream/s-1")
+    with pytest.raises(ResourceAlreadyExistsException):
+        node.metadata_service.create_data_stream("s-1")
+
+
+# ------------------------------------------------------------- persistence
+
+def test_metadata_persists_across_restart(tmp_path):
+    n1 = Node(data_path=str(tmp_path / "data"))
+    d(n1, "PUT", "/idx", body={})
+    d(n1, "POST", "/_aliases", body={"actions": [
+        {"add": {"index": "idx", "alias": "al"}}]})
+    d(n1, "PUT", "/_index_template/t", body={"index_patterns": ["q-*"]})
+    n1.close()
+    n2 = Node(data_path=str(tmp_path / "data"))
+    assert "al" in n2.metadata_service.aliases
+    assert "t" in n2.metadata_service.index_templates
+    _, r = d(n2, "POST", "/al/_search", body={})
+    assert r["hits"]["total"]["value"] == 0
+    n2.close()
+
+
+# ----------------------------------------------- review regression tests
+
+def test_delete_index_cleans_alias_and_stream_refs(node):
+    d(node, "PUT", "/m-1", body={})
+    d(node, "PUT", "/m-2", body={})
+    d(node, "POST", "/_aliases", body={"actions": [
+        {"add": {"index": "m-1", "alias": "m"}},
+        {"add": {"index": "m-2", "alias": "m"}}]})
+    d(node, "DELETE", "/m-1")
+    _, r = d(node, "POST", "/m/_search", body={})
+    assert r["hits"]["total"]["value"] == 0  # resolves, no 404
+    assert "m-1" not in node.metadata_service.aliases["m"]
+
+
+def test_count_and_msearch_apply_alias_filter(node):
+    for i, team in enumerate(["red", "blue", "red"]):
+        d(node, "PUT", f"/ev/_doc/{i}", {"refresh": "true"}, {"team": team})
+    d(node, "POST", "/_aliases", body={"actions": [
+        {"add": {"index": "ev", "alias": "red_ev",
+                 "filter": {"term": {"team.keyword": "red"}}}}]})
+    _, r = d(node, "GET", "/red_ev/_count")
+    assert r["count"] == 2
+    _, r = d(node, "POST", "/_msearch", body=[
+        {"index": "red_ev"}, {"size": 0}])
+    assert r["responses"][0]["hits"]["total"]["value"] == 2
+
+
+def test_doc_apis_resolve_alias(node):
+    d(node, "PUT", "/w-1", body={})
+    d(node, "POST", "/_aliases", body={"actions": [
+        {"add": {"index": "w-1", "alias": "w", "is_write_index": True}}]})
+    d(node, "PUT", "/w/_doc/1", {"refresh": "true"}, {"v": 1})
+    _, doc = d(node, "GET", "/w/_doc/1")
+    assert doc["found"] is True
+    status, _ = d(node, "POST", "/w/_update/1", body={"doc": {"v": 2}})
+    assert status == 200
+    status, _ = d(node, "DELETE", "/w/_doc/1")
+    assert status == 200
+
+
+def test_create_index_colliding_with_alias_rejected(node):
+    d(node, "PUT", "/backing", body={})
+    d(node, "POST", "/_aliases", body={"actions": [
+        {"add": {"index": "backing", "alias": "taken"}}]})
+    status, _ = d(node, "PUT", "/taken", body={})
+    assert status == 400
+
+
+def test_alias_remove_must_exist(node):
+    d(node, "PUT", "/i9", body={})
+    status, _ = d(node, "POST", "/_aliases", body={"actions": [
+        {"remove": {"index": "i9", "alias": "missing",
+                    "must_exist": True}}]})
+    assert status == 404
+    # without must_exist: silently acknowledged
+    status, _ = d(node, "POST", "/_aliases", body={"actions": [
+        {"remove": {"index": "i9", "alias": "missing"}}]})
+    assert status == 200
+
+
+def test_resize_includes_unrefreshed_docs(node):
+    d(node, "PUT", "/fresh", body={})
+    for i in range(5):
+        d(node, "PUT", f"/fresh/_doc/{i}", {}, {"n": i})  # no refresh
+    d(node, "PUT", "/fresh/_shrink/fresh2", body={})
+    _, r = d(node, "POST", "/fresh2/_search", body={"size": 0})
+    assert r["hits"]["total"]["value"] == 5
+
+
+def test_wildcard_matches_aliases_and_streams(node):
+    d(node, "PUT", "/app-a", body={})
+    d(node, "POST", "/_aliases", body={"actions": [
+        {"add": {"index": "app-a", "alias": "logsalias"}}]})
+    d(node, "PUT", "/app-a/_doc/1", {"refresh": "true"}, {"v": 1})
+    _, r = d(node, "POST", "/logsal*/_search", body={"size": 0})
+    assert r["hits"]["total"]["value"] == 1
+    d(node, "PUT", "/_index_template/t", body={
+        "index_patterns": ["str-*"], "data_stream": {}})
+    d(node, "PUT", "/_data_stream/str-one")
+    d(node, "PUT", "/str-one/_doc/1", {"refresh": "true"},
+      {"@timestamp": "2026-01-01T00:00:00Z"})
+    _, r = d(node, "POST", "/str-*/_search", body={"size": 0})
+    assert r["hits"]["total"]["value"] == 1
