@@ -18,7 +18,9 @@ from elasticsearch_tpu.repositories.blobstore import RepositoriesService
 from elasticsearch_tpu.snapshots.slm import SnapshotLifecycleService
 from elasticsearch_tpu.rest.api import RestController
 from elasticsearch_tpu.rest.http_server import HttpServer
+from elasticsearch_tpu.search.async_search import AsyncSearchService
 from elasticsearch_tpu.search.service import SearchService
+from elasticsearch_tpu.transport.tasks import TaskManager
 from elasticsearch_tpu.utils.breaker import HierarchyCircuitBreakerService
 
 NODE_NAME_SETTING = Setting.str_setting("node.name", None)
@@ -39,6 +41,9 @@ class Node:
         self.breaker_service = HierarchyCircuitBreakerService()
         self.indices_service = IndicesService(self.data_path, settings)
         self.search_service = SearchService(self.indices_service)
+        self.task_manager = TaskManager(self.node_id)
+        self.async_search_service = AsyncSearchService(
+            self.search_service, self.task_manager)
         self.ingest_service = IngestService(self.data_path)
         self.metadata_service = MetadataService(self.indices_service,
                                                 self.data_path)

@@ -25,6 +25,7 @@ from elasticsearch_tpu.common.errors import (
     ResourceNotFoundException,
 )
 from elasticsearch_tpu.search.rank_eval import rank_eval
+from elasticsearch_tpu.transport.tasks import CancellableTask, TaskId
 
 Response = Tuple[int, Dict[str, Any]]
 
@@ -117,6 +118,16 @@ def _register_all(c: RestController):
     c.register("GET", "/{index}/_rank_eval", rank_eval_handler)
     c.register("GET", "/{index}/_explain/{id}", explain_doc)
     c.register("POST", "/{index}/_explain/{id}", explain_doc)
+    # tasks
+    c.register("GET", "/_tasks", list_tasks)
+    c.register("POST", "/_tasks/_cancel", cancel_tasks)
+    c.register("GET", "/_tasks/{task_id}", get_task)
+    c.register("POST", "/_tasks/{task_id}/_cancel", cancel_task)
+    # async search
+    c.register("POST", "/_async_search", submit_async_search)
+    c.register("GET", "/_async_search/{id}", get_async_search)
+    c.register("DELETE", "/_async_search/{id}", delete_async_search)
+    c.register("POST", "/{index}/_async_search", submit_async_search)
     # aliases
     c.register("POST", "/_aliases", update_aliases)
     c.register("GET", "/_alias", get_alias)
@@ -669,13 +680,23 @@ def _apply_alias_filter(node, index, body):
 def search_index(node, params, body, index):
     body = _merge_search_params(body, params)
     body = _apply_alias_filter(node, index, body)
-    r = node.search_service.search(index, body, scroll=params.get("scroll"))
+    with node.task_manager.task_scope(
+            "transport", "indices:data/read/search",
+            description=f"indices[{index}]", cancellable=True) as task:
+        r = node.search_service.search(index, body,
+                                       scroll=params.get("scroll"),
+                                       task=task)
     return 200, r
 
 
 def search_all(node, params, body):
     body = _merge_search_params(body, params)
-    r = node.search_service.search("_all", body, scroll=params.get("scroll"))
+    with node.task_manager.task_scope(
+            "transport", "indices:data/read/search",
+            description="indices[_all]", cancellable=True) as task:
+        r = node.search_service.search("_all", body,
+                                       scroll=params.get("scroll"),
+                                       task=task)
     return 200, r
 
 
@@ -748,6 +769,71 @@ def msearch(node, params, body, index=None):
 
 def msearch_index(node, params, body, index):
     return msearch(node, params, body, index=index)
+
+
+# -- tasks / async search ----------------------------------------------------
+
+def list_tasks(node, params, body):
+    tasks = node.task_manager.list_tasks(actions=params.get("actions"))
+    return 200, {"nodes": {node.node_id: {
+        "name": node.name,
+        "tasks": {f"{node.node_id}:{t.id}": t.to_dict(node.node_id)
+                  for t in tasks},
+    }}}
+
+
+def _local_task(node, task_id):
+    tid = TaskId.parse(task_id)
+    if tid.node_id not in ("", node.node_id):
+        # a task id minted by another node must not alias a local task
+        raise ResourceNotFoundException(f"task [{task_id}] is not found")
+    task = node.task_manager.get_task(tid.id)
+    if task is None:
+        raise ResourceNotFoundException(f"task [{task_id}] isn't running "
+                                        "and hasn't stored its results")
+    return task
+
+
+def get_task(node, params, body, task_id):
+    task = _local_task(node, task_id)
+    return 200, {"completed": False, "task": task.to_dict(node.node_id)}
+
+
+def cancel_task(node, params, body, task_id):
+    task = _local_task(node, task_id)
+    if not isinstance(task, CancellableTask):
+        raise IllegalArgumentException(
+            f"task [{task_id}] is not cancellable")
+    node.task_manager.cancel(task, params.get("reason", "by user request"))
+    return 200, {"nodes": {node.node_id: {
+        "tasks": {task_id: task.to_dict(node.node_id)}}}}
+
+
+def cancel_tasks(node, params, body):
+    cancelled = {}
+    for t in node.task_manager.list_tasks(actions=params.get("actions")):
+        if isinstance(t, CancellableTask):
+            node.task_manager.cancel(t, "by user request")
+            cancelled[f"{node.node_id}:{t.id}"] = t.to_dict(node.node_id)
+    return 200, {"nodes": {node.node_id: {"tasks": cancelled}}}
+
+
+def submit_async_search(node, params, body, index=None):
+    body = _merge_search_params(body, params)
+    target = index or "_all"
+    body = _apply_alias_filter(node, target, body)
+    r = node.async_search_service.submit(target, body, params)
+    return r.pop("_http_status", 200), r
+
+
+def get_async_search(node, params, body, id):
+    r = node.async_search_service.get(id, params)
+    return r.pop("_http_status", 200), r
+
+
+def delete_async_search(node, params, body, id):
+    node.async_search_service.delete(id)
+    return 200, {"acknowledged": True}
 
 
 # -- aliases / templates / data streams / rollover ---------------------------

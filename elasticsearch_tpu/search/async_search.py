@@ -1,0 +1,169 @@
+"""Async search: submit, poll partial results, cancel.
+
+ref: x-pack/plugin/async-search (AsyncSearchTask.java,
+MutableSearchResponse.java, built on SearchProgressActionListener):
+``POST /{index}/_async_search`` starts the search on a background thread
+as a cancellable task; ``GET /_async_search/{id}`` polls; responses carry
+``is_running`` / ``is_partial``. ``wait_for_completion_timeout`` (default
+1s) lets fast searches complete synchronously — slow ones return an id.
+
+TPU note: with scoring as single dense kernel launches, per-shard partial
+results arrive at kernel-completion granularity; the mutable response here
+exposes the same shape (total/completed shards) the reference streams.
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from elasticsearch_tpu.common.errors import (
+    ElasticsearchTpuException,
+    ResourceNotFoundException,
+)
+from elasticsearch_tpu.common.settings import parse_time_value
+from elasticsearch_tpu.transport.tasks import TaskCancelledException
+
+DEFAULT_KEEP_ALIVE = 5 * 24 * 3600.0  # 5d, ref: async-search default
+
+
+class _AsyncSearch:
+    def __init__(self, search_id: str, index_expression: str,
+                 body: Dict[str, Any], keep_alive: float):
+        self.id = search_id
+        self.index_expression = index_expression
+        self.body = body
+        self.start_ms = int(time.time() * 1000)
+        self.expires_at = time.time() + keep_alive
+        self.done = threading.Event()
+        self.response: Optional[Dict[str, Any]] = None
+        self.error: Optional[Dict[str, Any]] = None
+        self.error_status = 500
+        self.completed_ms: Optional[int] = None
+        self.task = None  # CancellableTask once started
+
+
+class AsyncSearchService:
+    def __init__(self, search_service, task_manager):
+        self.search_service = search_service
+        self.task_manager = task_manager
+        self._searches: Dict[str, _AsyncSearch] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- submit
+    def submit(self, index_expression: str, body: Dict[str, Any],
+               params: Dict[str, str]) -> Dict[str, Any]:
+        wait = parse_time_value(
+            params.get("wait_for_completion_timeout", "1s"),
+            "wait_for_completion_timeout")
+        keep_alive = parse_time_value(params.get("keep_alive", "5d"),
+                                      "keep_alive")
+        search_id = base64.urlsafe_b64encode(
+            uuid.uuid4().bytes).decode().rstrip("=")
+        search = _AsyncSearch(search_id, index_expression, body or {},
+                              keep_alive)
+        task = self.task_manager.register(
+            "transport", "indices:data/read/async_search/submit",
+            description=f"async_search indices[{index_expression}]",
+            cancellable=True)
+        search.task = task
+        with self._lock:
+            self._reap_locked()
+            self._searches[search_id] = search
+
+        def run():
+            try:
+                search.response = self.search_service.search(
+                    index_expression, search.body, task=task)
+            except TaskCancelledException:
+                search.error = {"type": "task_cancelled_exception",
+                                "reason": "async search was cancelled"}
+                search.error_status = 400
+            except ElasticsearchTpuException as e:
+                search.error = e.to_xcontent()
+                search.error_status = e.status
+            except Exception as e:  # pragma: no cover - defensive
+                search.error = {"type": "exception", "reason": str(e)}
+            finally:
+                search.completed_ms = int(time.time() * 1000)
+                self.task_manager.unregister(task)
+                search.done.set()
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"async_search-{search_id[:8]}").start()
+        search.done.wait(timeout=wait)
+        return self._render(search)
+
+    # ---------------------------------------------------------------- get
+    def get(self, search_id: str,
+            params: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+        params = params or {}
+        search = self._lookup(search_id)
+        if "keep_alive" in params:
+            search.expires_at = time.time() + parse_time_value(
+                params["keep_alive"], "keep_alive")
+        if "wait_for_completion_timeout" in params:
+            search.done.wait(timeout=parse_time_value(
+                params["wait_for_completion_timeout"],
+                "wait_for_completion_timeout"))
+        return self._render(search)
+
+    def delete(self, search_id: str) -> None:
+        search = self._lookup(search_id)
+        if search.task is not None and not search.done.is_set():
+            self.task_manager.cancel(search.task, "async search deleted")
+        with self._lock:
+            self._searches.pop(search_id, None)
+
+    def _lookup(self, search_id: str) -> _AsyncSearch:
+        with self._lock:
+            self._reap_locked()
+            search = self._searches.get(search_id)
+        if search is None:
+            raise ResourceNotFoundException(search_id)
+        return search
+
+    def _reap_locked(self):
+        """Caller holds the lock. Expired entries are removed; any whose
+        search is still running is cancelled so it cannot burn CPU as an
+        unaddressable orphan."""
+        now = time.time()
+        expired = [a for a in self._searches.values()
+                   if a.expires_at < now]
+        for a in expired:
+            del self._searches[a.id]
+        for a in expired:
+            if a.task is not None and not a.done.is_set():
+                self.task_manager.cancel(a.task, "async search expired")
+
+    # ------------------------------------------------------------- render
+    def _render(self, search: _AsyncSearch) -> Dict[str, Any]:
+        running = not search.done.is_set()
+        out: Dict[str, Any] = {
+            "id": search.id,
+            "is_partial": running or search.error is not None,
+            "is_running": running,
+            "start_time_in_millis": search.start_ms,
+            "expiration_time_in_millis": int(search.expires_at * 1000),
+        }
+        if search.error is not None:
+            out["error"] = search.error
+            # REST handlers surface the stored failure status (ES returns
+            # the failure's own status, not 200)
+            out["_http_status"] = search.error_status
+        elif search.response is not None:
+            out["response"] = search.response
+            out["completion_time_in_millis"] = (
+                search.completed_ms or int(time.time() * 1000))
+        else:
+            # still running: the skeleton partial response
+            out["response"] = {
+                "took": int(time.time() * 1000) - search.start_ms,
+                "timed_out": False,
+                "hits": {"total": {"value": 0, "relation": "gte"},
+                         "max_score": None, "hits": []},
+            }
+        return out

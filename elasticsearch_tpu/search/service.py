@@ -90,7 +90,7 @@ class SearchService:
 
     # ------------------------------------------------------------ public
     def search(self, index_expression: str, body: Dict[str, Any],
-               scroll: Optional[str] = None) -> Dict[str, Any]:
+               scroll: Optional[str] = None, task=None) -> Dict[str, Any]:
         start = time.monotonic()
         names = self.indices_service.resolve(index_expression)
         searchers: List[Tuple[str, ShardSearcher]] = []
@@ -109,7 +109,8 @@ class SearchService:
             with self._lock:
                 self._scrolls[scroll_ctx.scroll_id] = scroll_ctx
 
-        response = self._execute(searchers, body, scroll_ctx=scroll_ctx)
+        response = self._execute(searchers, body, scroll_ctx=scroll_ctx,
+                                 task=task)
         response["took"] = int((time.monotonic() - start) * 1000)
         if scroll_ctx is not None:
             response["_scroll_id"] = scroll_ctx.scroll_id
@@ -156,7 +157,7 @@ class SearchService:
     # ---------------------------------------------------------- internal
     def _execute(self, searchers: List[Tuple[str, ShardSearcher]],
                  body: Dict[str, Any], scroll_ctx: Optional[ScrollContext] = None,
-                 continuing: bool = False) -> Dict[str, Any]:
+                 continuing: bool = False, task=None) -> Dict[str, Any]:
         body = body or {}
         query = (parse_query(body["query"]) if body.get("query")
                  else MatchAllQuery())
@@ -211,6 +212,10 @@ class SearchService:
         total = 0
         max_score = None
         for shard_idx, (index_name, searcher) in enumerate(searchers):
+            if task is not None:
+                # cooperative cancellation between shard executions (ref:
+                # CancellableTask checks in ContextIndexSearcher)
+                task.ensure_not_cancelled()
             after_key = (scroll_ctx.cursors.get(shard_idx)
                          if (scroll_ctx is not None and continuing) else None)
             t0 = time.monotonic_ns()

@@ -25,8 +25,13 @@ class TaskId:
 
     @staticmethod
     def parse(s: str) -> "TaskId":
+        from elasticsearch_tpu.common.errors import IllegalArgumentException
         node, _, num = s.rpartition(":")
-        return TaskId(node, int(num))
+        try:
+            return TaskId(node, int(num))
+        except ValueError:
+            raise IllegalArgumentException(
+                f"malformed task id {s}")
 
 
 EMPTY_TASK_ID = TaskId("", -1)
@@ -66,8 +71,9 @@ class Task:
         return d
 
 
-class TaskCancelledException(Exception):
-    pass
+# re-exported for callers; an ElasticsearchTpuException so the REST layer
+# maps a cancelled request to a 400 instead of a dropped connection
+from elasticsearch_tpu.common.errors import TaskCancelledException  # noqa: E402
 
 
 class CancellableTask(Task):
@@ -157,8 +163,10 @@ class TaskManager:
         with self._lock:
             tasks = list(self._tasks.values())
         if actions:
-            prefix = actions.rstrip("*")
-            tasks = [t for t in tasks if t.action.startswith(prefix)]
+            import fnmatch
+            patterns = [p.strip() for p in actions.split(",") if p.strip()]
+            tasks = [t for t in tasks
+                     if any(fnmatch.fnmatch(t.action, p) for p in patterns)]
         return tasks
 
     def cancel(self, task: CancellableTask, reason: str,

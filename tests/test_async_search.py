@@ -1,0 +1,239 @@
+"""Tasks API + async search (ref: tasks/TaskManager.java APIs surface,
+x-pack/plugin/async-search AsyncSearchTask/MutableSearchResponse)."""
+
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(data_path=str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def d(node, method, path, params=None, body=None):
+    return node.rest_controller.dispatch(method, path, params or {}, body)
+
+
+def _seed(node, n=5):
+    for i in range(n):
+        d(node, "PUT", f"/idx/_doc/{i}", {"refresh": "true"}, {"n": i})
+
+
+# ------------------------------------------------------------------ tasks
+
+def test_tasks_list_shape(node):
+    _seed(node)
+    with node.task_manager.task_scope("transport", "indices:data/read/search",
+                                      cancellable=True):
+        status, r = d(node, "GET", "/_tasks")
+        assert status == 200
+        tasks = r["nodes"][node.node_id]["tasks"]
+        assert any(t["action"] == "indices:data/read/search"
+                   and t["cancellable"] for t in tasks.values())
+    _, r = d(node, "GET", "/_tasks")
+    assert r["nodes"][node.node_id]["tasks"] == {}
+
+
+def test_tasks_actions_filter(node):
+    with node.task_manager.task_scope("transport", "indices:data/read/search"):
+        with node.task_manager.task_scope("transport", "cluster:monitor/stats"):
+            _, r = d(node, "GET", "/_tasks",
+                     {"actions": "indices:data/read/*"})
+            tasks = r["nodes"][node.node_id]["tasks"]
+            assert len(tasks) == 1
+
+
+def test_get_and_cancel_task(node):
+    task = node.task_manager.register("transport", "indices:data/read/search",
+                                      cancellable=True)
+    tid = f"{node.node_id}:{task.id}"
+    _, r = d(node, "GET", f"/_tasks/{tid}")
+    assert r["task"]["action"] == "indices:data/read/search"
+    status, r = d(node, "POST", f"/_tasks/{tid}/_cancel")
+    assert status == 200
+    assert task.is_cancelled()
+    node.task_manager.unregister(task)
+    status, _ = d(node, "GET", f"/_tasks/{tid}")
+    assert status == 404
+
+
+def test_cancelled_search_returns_400(node):
+    _seed(node)
+    task = node.task_manager.register("transport", "test", cancellable=True)
+    node.task_manager.cancel(task, "test cancel")
+    from elasticsearch_tpu.common.errors import TaskCancelledException
+    with pytest.raises(TaskCancelledException):
+        node.search_service.search("idx", {}, task=task)
+
+
+def test_ban_propagates_to_children(node):
+    parent = node.task_manager.register("transport", "parent",
+                                        cancellable=True)
+    node.task_manager.cancel(parent, "stop")
+    from elasticsearch_tpu.transport.tasks import TaskId
+    child = node.task_manager.register(
+        "transport", "child", parent_task_id=TaskId(node.node_id, parent.id),
+        cancellable=True)
+    assert child.is_cancelled()
+
+
+# ----------------------------------------------------------- async search
+
+def test_async_search_fast_completes_inline(node):
+    _seed(node)
+    status, r = d(node, "POST", "/idx/_async_search",
+                  {"wait_for_completion_timeout": "5s"},
+                  {"query": {"match_all": {}}})
+    assert status == 200
+    assert r["is_running"] is False
+    assert r["is_partial"] is False
+    assert r["response"]["hits"]["total"]["value"] == 5
+
+
+def test_async_search_poll_and_delete(node):
+    _seed(node)
+    release = threading.Event()
+    orig = node.search_service.search
+
+    def slow_search(*args, **kwargs):
+        release.wait(timeout=10)
+        return orig(*args, **kwargs)
+
+    node.search_service.search = slow_search
+    try:
+        _, r = d(node, "POST", "/idx/_async_search",
+                 {"wait_for_completion_timeout": "50ms"}, {})
+        assert r["is_running"] is True and r["is_partial"] is True
+        sid = r["id"]
+        _, r2 = d(node, "GET", f"/_async_search/{sid}")
+        assert r2["is_running"] is True
+        release.set()
+        _, r3 = d(node, "GET", f"/_async_search/{sid}",
+                  {"wait_for_completion_timeout": "5s"})
+        assert r3["is_running"] is False
+        assert r3["response"]["hits"]["total"]["value"] == 5
+        d(node, "DELETE", f"/_async_search/{sid}")
+        status, _ = d(node, "GET", f"/_async_search/{sid}")
+        assert status == 404
+    finally:
+        node.search_service.search = orig
+        release.set()
+
+
+def test_async_search_delete_cancels_running(node):
+    _seed(node)
+    started = threading.Event()
+    blocker = threading.Event()
+    orig = node.search_service.search
+
+    def slow_search(index, body, scroll=None, task=None):
+        started.set()
+        blocker.wait(timeout=10)
+        if task is not None:
+            task.ensure_not_cancelled()
+        return orig(index, body, scroll=scroll, task=task)
+
+    node.search_service.search = slow_search
+    try:
+        _, r = d(node, "POST", "/idx/_async_search",
+                 {"wait_for_completion_timeout": "10ms"}, {})
+        sid = r["id"]
+        started.wait(timeout=5)
+        d(node, "DELETE", f"/_async_search/{sid}")
+        blocker.set()
+        status, _ = d(node, "GET", f"/_async_search/{sid}")
+        assert status == 404
+    finally:
+        node.search_service.search = orig
+        blocker.set()
+
+
+def test_async_search_error_reported(node):
+    status, r = d(node, "POST", "/missing_index/_async_search",
+                  {"wait_for_completion_timeout": "5s"}, {})
+    assert status == 404  # the stored failure's own status, not 200
+    assert r["is_partial"] is True
+    assert r["error"]["type"] == "index_not_found_exception"
+
+
+# ----------------------------------------------- review regression tests
+
+def test_malformed_task_id_is_400(node):
+    status, _ = d(node, "GET", "/_tasks/foo")
+    assert status == 400
+    status, _ = d(node, "POST", "/_tasks/foo/_cancel")
+    assert status == 400
+
+
+def test_foreign_node_task_id_404(node):
+    task = node.task_manager.register("transport", "x", cancellable=True)
+    status, _ = d(node, "POST", f"/othernode:{task.id}/_cancel")
+    status, _ = d(node, "POST", f"/_tasks/othernode:{task.id}/_cancel")
+    assert status == 404
+    assert not task.is_cancelled()
+    node.task_manager.unregister(task)
+
+
+def test_actions_filter_comma_and_exact(node):
+    with node.task_manager.task_scope("transport", "indices:data/read/search"):
+        with node.task_manager.task_scope("transport", "cluster:monitor/stats"):
+            tasks = node.task_manager.list_tasks(
+                actions="indices:data/read/*,cluster:monitor/*")
+            assert len(tasks) == 2
+            tasks = node.task_manager.list_tasks(
+                actions="indices:data/read/search")
+            assert len(tasks) == 1
+            assert tasks[0].action == "indices:data/read/search"
+
+
+def test_expired_async_search_cancelled_on_reap(node):
+    _seed(node)
+    import threading as _t
+    blocker = _t.Event()
+    orig = node.search_service.search
+
+    def slow_search(index, body, scroll=None, task=None):
+        blocker.wait(timeout=10)
+        if task is not None:
+            task.ensure_not_cancelled()
+        return orig(index, body, scroll=scroll, task=task)
+
+    node.search_service.search = slow_search
+    try:
+        _, r = d(node, "POST", "/idx/_async_search",
+                 {"wait_for_completion_timeout": "10ms",
+                  "keep_alive": "50ms"}, {})
+        sid = r["id"]
+        time.sleep(0.2)
+        status, _ = d(node, "GET", f"/_async_search/{sid}")  # triggers reap
+        assert status == 404
+        task = node.async_search_service  # the task must have been cancelled
+        blocker.set()
+        time.sleep(0.2)
+        # no orphan task left behind
+        assert all(t.action != "indices:data/read/async_search/submit"
+                   for t in node.task_manager.list_tasks())
+    finally:
+        node.search_service.search = orig
+        blocker.set()
+
+
+def test_completion_time_stable(node):
+    _seed(node)
+    _, r = d(node, "POST", "/idx/_async_search",
+             {"wait_for_completion_timeout": "5s"}, {})
+    t1 = r["completion_time_in_millis"]
+    time.sleep(0.05)
+    _, r2 = d(node, "GET", f"/_async_search/{r['id']}")
+    assert r2["completion_time_in_millis"] == t1
+
+
+def test_async_search_unknown_id_404(node):
+    status, _ = d(node, "GET", "/_async_search/bogus")
+    assert status == 404
