@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import os
 import uuid
+from collections import OrderedDict
 from typing import Optional
 
 from elasticsearch_tpu.common.settings import Setting, Settings
@@ -42,6 +43,9 @@ class Node:
         self.indices_service = IndicesService(self.data_path, settings)
         self.search_service = SearchService(self.indices_service)
         self.task_manager = TaskManager(self.node_id)
+        # completed background-task responses (ref: the .tasks results
+        # index); bounded — oldest entries evicted beyond 256
+        self.task_results: "OrderedDict[int, dict]" = OrderedDict()
         self.async_search_service = AsyncSearchService(
             self.search_service, self.task_manager)
         self.ingest_service = IngestService(self.data_path)

@@ -1,0 +1,16 @@
+"""Reindex module: ``_reindex``, ``_update_by_query``, ``_delete_by_query``.
+
+TPU-native analogue of the reference's reindex module (ref:
+modules/reindex — scroll+bulk worker with throttling, ``conflicts=proceed``,
+slicing, and task management; ``AbstractAsyncBulkByScrollAction``). The
+worker here drives the in-process scroll API in batches, applies an
+optional update script, and bulk-writes to the destination with
+seqno-based optimistic concurrency for conflict detection.
+"""
+
+from elasticsearch_tpu.reindex.worker import (  # noqa: F401
+    BulkByScrollResponse,
+    delete_by_query,
+    reindex,
+    update_by_query,
+)

@@ -1,0 +1,548 @@
+"""Scroll+bulk worker behind _reindex / _update_by_query / _delete_by_query.
+
+ref: modules/reindex/.../AbstractAsyncBulkByScrollAction.java — scroll a
+snapshot of the source, transform each hit (script / dest rewrite), bulk
+into the destination, loop until exhausted; count created/updated/deleted/
+noops/version_conflicts; throttle by requests_per_second; `conflicts:
+proceed` turns version conflicts into counters instead of failures.
+Slicing (ref: ReindexSliceAction / search/slice/SliceBuilder.java)
+partitions the id space by murmur3 hash.
+"""
+
+from __future__ import annotations
+
+import ast
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ScriptException,
+    VersionConflictEngineException,
+)
+
+_SCROLL_KEEPALIVE = "5m"
+_DEFAULT_BATCH = 1000
+
+
+# ---------------------------------------------------------------- update script
+
+_ALLOWED_STMT = (ast.Module, ast.Assign, ast.AugAssign, ast.Expr, ast.If,
+                 ast.Pass)
+_ALLOWED_EXPR = (
+    ast.BinOp, ast.UnaryOp, ast.BoolOp, ast.Compare, ast.Call, ast.IfExp,
+    ast.Attribute, ast.Subscript, ast.Name, ast.Constant, ast.List,
+    ast.Dict, ast.Tuple, ast.Load, ast.Store, ast.Add, ast.Sub, ast.Mult,
+    ast.Div, ast.Mod, ast.Pow, ast.FloorDiv, ast.USub, ast.UAdd, ast.Not,
+    ast.And, ast.Or, ast.Eq, ast.NotEq, ast.Lt, ast.LtE, ast.Gt, ast.GtE,
+    ast.In, ast.NotIn, ast.Is, ast.IsNot,
+)
+
+
+class _SourceProxy:
+    """``ctx._source`` — attribute/item access onto the source dict, so the
+    painless idioms ``ctx._source.counter += 1`` and
+    ``ctx._source['tags'] = [...]`` both work."""
+
+    def __init__(self, source: Dict[str, Any]):
+        object.__setattr__(self, "_data", source)
+
+    def __getattr__(self, name):
+        try:
+            v = self._data[name]
+        except KeyError:
+            return None
+        return _SourceProxy(v) if isinstance(v, dict) else v
+
+    def __setattr__(self, name, value):
+        self._data[name] = value
+
+    def __getitem__(self, name):
+        return getattr(self, name)
+
+    def __setitem__(self, name, value):
+        self._data[name] = value
+
+    def __contains__(self, name):
+        return name in self._data
+
+    def remove(self, name):
+        self._data.pop(name, None)
+
+    def containsKey(self, name):  # painless Map surface
+        return name in self._data
+
+    def get(self, name, default=None):
+        return self._data.get(name, default)
+
+
+class _Ctx:
+    """The update-script ``ctx`` variable (ref: UpdateHelper — exposes
+    _source, _index, _id, _version, and the mutable ``op``)."""
+
+    def __init__(self, source, index, doc_id, version):
+        self._source = _SourceProxy(source)
+        self._index = index
+        self._id = doc_id
+        self._version = version
+        self.op = "index"
+
+
+_SAFE_FUNCS = {
+    "abs": abs, "min": min, "max": max, "round": round, "len": len,
+    "str": str, "int": int, "float": float, "bool": bool,
+}
+
+
+def _painless_to_python(source: str) -> str:
+    """Normalize painless-isms (``;`` statement ends, ``&&``/``||``/``!``)
+    to python, WITHOUT touching quoted string literals."""
+    out = []
+    quote = None
+    i = 0
+    n = len(source)
+    while i < n:
+        c = source[i]
+        if quote is not None:
+            out.append(c)
+            if c == "\\" and i + 1 < n:
+                out.append(source[i + 1])
+                i += 2
+                continue
+            if c == quote:
+                quote = None
+            i += 1
+            continue
+        if c in "'\"":
+            quote = c
+            out.append(c)
+            i += 1
+            continue
+        two = source[i:i + 2]
+        if two == "&&":
+            out.append(" and ")
+            i += 2
+        elif two == "||":
+            out.append(" or ")
+            i += 2
+        elif two == "!=":
+            out.append("!=")
+            i += 2
+        elif c == "!":
+            out.append(" not ")
+            i += 1
+        elif c == ";":
+            out.append("\n")  # statement end; indentation of the next
+            i += 1            # physical line still governs blocks
+            while i < n and source[i] == " ":
+                i += 1
+        else:
+            out.append(c)
+            i += 1
+    lines = [l for l in "".join(out).split("\n") if l.strip()]
+    return "\n".join(lines)
+
+
+class UpdateScript:
+    """Compiled update-context script (the painless analogue for ctx
+    mutation; ref: modules/lang-painless update/reindex script contexts)."""
+
+    def __init__(self, source: str, params: Optional[Dict[str, Any]] = None):
+        self.source = source
+        self.params = params or {}
+        py = _painless_to_python(source)
+        try:
+            tree = ast.parse(py, mode="exec")
+        except SyntaxError as e:
+            raise ScriptException(f"compile error in script [{source}]: {e}")
+        for node in ast.walk(tree):
+            if not isinstance(node, _ALLOWED_STMT + _ALLOWED_EXPR):
+                raise ScriptException(
+                    f"illegal construct [{type(node).__name__}] in script")
+            if isinstance(node, ast.Name) and node.id not in (
+                    "ctx", "params") and node.id not in _SAFE_FUNCS:
+                raise ScriptException(f"unknown variable [{node.id}]")
+            if isinstance(node, ast.Attribute) and node.attr.startswith("__"):
+                raise ScriptException("dunder access is not allowed")
+        self._code = compile(tree, "<update-script>", "exec")
+
+    def run(self, ctx: _Ctx) -> None:
+        scope = dict(_SAFE_FUNCS)
+        scope["ctx"] = ctx
+        scope["params"] = _ScriptParams(self.params)
+        exec(self._code, {"__builtins__": {}}, scope)
+
+
+class _ScriptParams(dict):
+    def __init__(self, d):
+        super().__init__(d)
+
+    def __getattr__(self, name):
+        try:
+            return self[name]
+        except KeyError:
+            raise ScriptException(f"missing script parameter [{name}]")
+
+
+def compile_update_script(spec: Any) -> Optional[UpdateScript]:
+    if spec is None:
+        return None
+    if isinstance(spec, str):
+        return UpdateScript(spec)
+    return UpdateScript(spec.get("source", ""), spec.get("params"))
+
+
+# ----------------------------------------------------------------- the worker
+
+
+@dataclass
+class BulkByScrollResponse:
+    took_millis: int = 0
+    total: int = 0
+    created: int = 0
+    updated: int = 0
+    deleted: int = 0
+    noops: int = 0
+    batches: int = 0
+    version_conflicts: int = 0
+    throttled_millis: int = 0
+    requests_per_second: float = -1.0
+    failures: List[Dict[str, Any]] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "took": self.took_millis, "timed_out": False,
+            "total": self.total, "created": self.created,
+            "updated": self.updated, "deleted": self.deleted,
+            "batches": self.batches, "noops": self.noops,
+            "version_conflicts": self.version_conflicts,
+            "retries": {"bulk": 0, "search": 0},
+            "throttled_millis": self.throttled_millis,
+            "requests_per_second": self.requests_per_second,
+            "throttled_until_millis": 0,
+            "failures": self.failures,
+        }
+
+
+def _scroll_batches(node, index, search_body, batch_size, task=None):
+    """Yield lists of hits from a scroll snapshot of `index`."""
+    body = dict(search_body)
+    body["size"] = batch_size
+    r = node.search_service.search(index, body, scroll=_SCROLL_KEEPALIVE,
+                                   task=task)
+    scroll_id = r.get("_scroll_id")
+    try:
+        hits = r["hits"]["hits"]
+        while hits:
+            yield hits
+            if scroll_id is None:
+                return
+            r = node.search_service.scroll(scroll_id, _SCROLL_KEEPALIVE)
+            scroll_id = r.get("_scroll_id")
+            hits = r["hits"]["hits"]
+    finally:
+        if scroll_id:
+            node.search_service.clear_scroll([scroll_id])
+
+
+def _slice_filter(slices: int, slice_id: int, hit_id: str) -> bool:
+    if slices <= 1:
+        return True
+    from elasticsearch_tpu.index.service import murmur3_hash
+    return abs(murmur3_hash(hit_id)) % slices == slice_id
+
+
+class _Throttle:
+    """requests_per_second pacing (ref: WorkerBulkByScrollTaskState —
+    delay between batches = batch_size / rps, minus time already spent).
+    ``rps`` is read per batch, so _rethrottle can change it mid-flight."""
+
+    def __init__(self, rps: float):
+        self.rps = rps
+        self.throttled_ms = 0
+
+    def pause_after(self, n_ops: int, elapsed_s: float):
+        if self.rps is None or self.rps <= 0:
+            return
+        target = n_ops / self.rps
+        delay = target - elapsed_s
+        if delay > 0:
+            # cap any single pause so tests/tasks stay responsive
+            delay = min(delay, 1.0)
+            time.sleep(delay)
+            self.throttled_ms += int(delay * 1000)
+
+
+def _parse_rps(params: Dict[str, Any]) -> float:
+    raw = params.get("requests_per_second", "-1")
+    if raw in ("-1", -1, "", None, "unlimited"):
+        return -1.0
+    return float(raw)
+
+
+def reindex(node, body: Dict[str, Any], params: Dict[str, Any],
+            task=None) -> BulkByScrollResponse:
+    """POST /_reindex (ref: modules/reindex/.../TransportReindexAction)."""
+    body = body or {}
+    source = body.get("source") or {}
+    dest = body.get("dest") or {}
+    src_index = source.get("index")
+    dest_index = dest.get("index")
+    if not src_index or not dest_index:
+        raise IllegalArgumentException("_reindex requires source.index and dest.index")
+    if isinstance(src_index, list):
+        src_index = ",".join(src_index)
+    conflicts = body.get("conflicts", "abort")
+    max_docs = body.get("max_docs") or body.get("size")
+    op_type = dest.get("op_type", "index")
+    version_type = dest.get("version_type", "internal")
+    pipeline = dest.get("pipeline")
+    script = compile_update_script(body.get("script"))
+    slices = int(params.get("slices", 1) or 1)
+    rps = _parse_rps(params)
+    throttle = _Throttle(rps)
+
+    search_body: Dict[str, Any] = {}
+    if "query" in source:
+        search_body["query"] = source["query"]
+    if "_source" in source:
+        search_body["_source"] = source["_source"]
+    if version_type == "external":
+        search_body["version"] = True
+
+    resp = BulkByScrollResponse(requests_per_second=rps)
+    if task is not None:
+        task.reindex_throttle = throttle  # live handle for _rethrottle
+    start = time.monotonic()
+    batch_size = min(int(source.get("size", _DEFAULT_BATCH) or _DEFAULT_BATCH),
+                     max_docs or 10**9)
+
+    dest_idx = _ensure_dest(node, dest_index)
+    done = False
+    for hits in _scroll_batches(node, src_index, search_body, batch_size,
+                                task=task):
+        if task is not None:
+            task.ensure_not_cancelled()
+        t_batch = time.monotonic()
+        resp.batches += 1
+        n_ops = 0
+        for hit in hits:
+            if not _slice_filter(slices, int(params.get("slice_id", 0)),
+                                 hit["_id"]):
+                continue
+            if max_docs is not None and resp.total >= max_docs:
+                done = True
+                break
+            resp.total += 1
+            n_ops += 1
+            doc_id = hit["_id"]
+            src = dict(hit.get("_source") or {})
+            op = "index"
+            if script is not None:
+                ctx = _Ctx(src, dest_index, doc_id, hit.get("_version", 1))
+                script.run(ctx)
+                op = ctx.op
+                doc_id = ctx._id
+                src = ctx._source._data
+            if op == "noop":
+                resp.noops += 1
+                continue
+            if op == "delete":
+                r = dest_idx.delete_doc(doc_id)
+                if getattr(r, "found", False):
+                    resp.deleted += 1
+                else:
+                    resp.noops += 1
+                continue
+            if pipeline:
+                out = node.ingest_service.process(pipeline, dest_index,
+                                                  doc_id, src)
+                if out is None:  # dropped
+                    resp.noops += 1
+                    continue
+                src = out.source
+            try:
+                kwargs: Dict[str, Any] = {}
+                if op_type == "create":
+                    kwargs["op_type"] = "create"
+                if version_type == "external":
+                    # only-overwrite-when-newer contract (ref: reindex with
+                    # dest.version_type=external): the dest doc's version
+                    # must be below the source's
+                    cur = dest_idx.get_doc(doc_id)
+                    if cur.found and cur.version >= hit.get("_version", 1):
+                        raise VersionConflictEngineException(
+                            doc_id,
+                            f"current version [{cur.version}] is higher or "
+                            f"equal to the one provided "
+                            f"[{hit.get('_version', 1)}]")
+                r = dest_idx.index_doc(doc_id, src, **kwargs)
+                if getattr(r, "created", True):
+                    resp.created += 1
+                else:
+                    resp.updated += 1
+            except VersionConflictEngineException as e:
+                resp.version_conflicts += 1
+                if conflicts != "proceed":
+                    resp.failures.append({"index": dest_index, "id": doc_id,
+                                          "cause": str(e), "status": 409})
+                    done = True
+                    break
+        throttle.pause_after(n_ops, time.monotonic() - t_batch)
+        if done:
+            break
+    if params.get("refresh") in ("true", True, ""):
+        dest_idx.refresh()
+    resp.throttled_millis = throttle.throttled_ms
+    resp.took_millis = int((time.monotonic() - start) * 1000)
+    return resp
+
+
+def _ensure_dest(node, index: str):
+    from elasticsearch_tpu.common.errors import IndexNotFoundException
+    index = node.metadata_service.write_target(index)
+    try:
+        return node.indices_service.get(index)
+    except IndexNotFoundException:
+        return node.metadata_service.create_index_from_template(index)
+
+
+def update_by_query(node, index: str, body: Dict[str, Any],
+                    params: Dict[str, Any], task=None) -> BulkByScrollResponse:
+    """POST /{index}/_update_by_query (ref: reindex module
+    TransportUpdateByQueryAction — snapshot scroll, script each doc, write
+    back with seqno optimistic concurrency)."""
+    body = body or {}
+    conflicts = body.get("conflicts", "abort")
+    max_docs = body.get("max_docs")
+    script = compile_update_script(body.get("script"))
+    rps = _parse_rps(params)
+    throttle = _Throttle(rps)
+    resp = BulkByScrollResponse(requests_per_second=rps)
+    if task is not None:
+        task.reindex_throttle = throttle
+    start = time.monotonic()
+    search_body: Dict[str, Any] = {}
+    if "query" in body:
+        search_body["query"] = body["query"]
+
+    idx_cache: Dict[str, Any] = {}
+
+    def idx_for(name):
+        if name not in idx_cache:
+            idx_cache[name] = node.indices_service.get(name)
+        return idx_cache[name]
+
+    done = False
+    for hits in _scroll_batches(node, index, search_body, _DEFAULT_BATCH,
+                                task=task):
+        if task is not None:
+            task.ensure_not_cancelled()
+        t_batch = time.monotonic()
+        resp.batches += 1
+        n_ops = 0
+        for hit in hits:
+            if max_docs is not None and resp.total >= max_docs:
+                done = True
+                break
+            resp.total += 1
+            n_ops += 1
+            target = hit.get("_index", index)
+            idx = idx_for(target)
+            doc_id = hit["_id"]
+            current = idx.get_doc(doc_id)
+            if not current.found:
+                resp.version_conflicts += 1
+                if conflicts != "proceed":
+                    done = True
+                    break
+                continue
+            src = dict(current.source)
+            op = "index"
+            if script is not None:
+                ctx = _Ctx(src, target, doc_id, current.version)
+                script.run(ctx)
+                op = ctx.op
+                src = ctx._source._data
+            if op == "noop":
+                resp.noops += 1
+                continue
+            if op == "delete":
+                r = idx.delete_doc(doc_id)
+                if getattr(r, "found", False):
+                    resp.deleted += 1
+                continue
+            try:
+                idx.index_doc(doc_id, src, if_seq_no=current.seq_no,
+                              if_primary_term=current.primary_term)
+                resp.updated += 1
+            except VersionConflictEngineException as e:
+                resp.version_conflicts += 1
+                if conflicts != "proceed":
+                    resp.failures.append({"index": target, "id": doc_id,
+                                          "cause": str(e), "status": 409})
+                    done = True
+                    break
+        throttle.pause_after(n_ops, time.monotonic() - t_batch)
+        if done:
+            break
+    for idx in idx_cache.values():
+        if params.get("refresh") in ("true", True, ""):
+            idx.refresh()
+    resp.throttled_millis = throttle.throttled_ms
+    resp.took_millis = int((time.monotonic() - start) * 1000)
+    return resp
+
+
+def delete_by_query(node, index: str, body: Dict[str, Any],
+                    params: Dict[str, Any], task=None) -> BulkByScrollResponse:
+    """POST /{index}/_delete_by_query (ref: reindex module
+    TransportDeleteByQueryAction)."""
+    body = body or {}
+    if "query" not in body:
+        raise IllegalArgumentException("_delete_by_query requires a query")
+    conflicts = body.get("conflicts", "abort")
+    max_docs = body.get("max_docs")
+    rps = _parse_rps(params)
+    throttle = _Throttle(rps)
+    resp = BulkByScrollResponse(requests_per_second=rps)
+    if task is not None:
+        task.reindex_throttle = throttle
+    start = time.monotonic()
+    search_body = {"query": body["query"]}
+    idx_cache: Dict[str, Any] = {}
+    done = False
+    for hits in _scroll_batches(node, index, search_body, _DEFAULT_BATCH,
+                                task=task):
+        if task is not None:
+            task.ensure_not_cancelled()
+        t_batch = time.monotonic()
+        resp.batches += 1
+        n_ops = 0
+        for hit in hits:
+            if max_docs is not None and resp.total >= max_docs:
+                done = True
+                break
+            resp.total += 1
+            n_ops += 1
+            target = hit.get("_index", index)
+            if target not in idx_cache:
+                idx_cache[target] = node.indices_service.get(target)
+            r = idx_cache[target].delete_doc(hit["_id"])
+            if getattr(r, "found", False):
+                resp.deleted += 1
+            else:
+                resp.version_conflicts += 1
+                if conflicts != "proceed":
+                    done = True
+                    break
+        throttle.pause_after(n_ops, time.monotonic() - t_batch)
+        if done:
+            break
+    if params.get("refresh") in ("true", True, ""):
+        for idx in idx_cache.values():
+            idx.refresh()
+    resp.throttled_millis = throttle.throttled_ms
+    resp.took_millis = int((time.monotonic() - start) * 1000)
+    return resp

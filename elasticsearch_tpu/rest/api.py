@@ -118,6 +118,15 @@ def _register_all(c: RestController):
     c.register("GET", "/{index}/_rank_eval", rank_eval_handler)
     c.register("GET", "/{index}/_explain/{id}", explain_doc)
     c.register("POST", "/{index}/_explain/{id}", explain_doc)
+    # reindex family (ref: modules/reindex)
+    c.register("POST", "/_reindex", reindex_handler)
+    c.register("POST", "/{index}/_update_by_query", update_by_query_handler)
+    c.register("POST", "/{index}/_delete_by_query", delete_by_query_handler)
+    c.register("POST", "/_reindex/{task_id}/_rethrottle", rethrottle_handler)
+    c.register("POST", "/_update_by_query/{task_id}/_rethrottle",
+               rethrottle_handler)
+    c.register("POST", "/_delete_by_query/{task_id}/_rethrottle",
+               rethrottle_handler)
     # tasks
     c.register("GET", "/_tasks", list_tasks)
     c.register("POST", "/_tasks/_cancel", cancel_tasks)
@@ -771,6 +780,73 @@ def msearch_index(node, params, body, index):
     return msearch(node, params, body, index=index)
 
 
+# -- reindex family ----------------------------------------------------------
+
+def _bulk_by_scroll(node, params, action_name, run):
+    """Run a reindex-family worker, sync or as a background task
+    (``wait_for_completion=false`` → returns {"task": id}, result stored
+    for GET /_tasks/{id}; ref: reindex tasks store results in .tasks)."""
+    import threading
+    if params.get("wait_for_completion") == "false":
+        task = node.task_manager.register("transport", action_name,
+                                          cancellable=True)
+
+        def runner():
+            try:
+                resp = run(task)
+                _store_task_result(node, task.id, resp.to_dict())
+            except ElasticsearchTpuException as e:
+                _store_task_result(node, task.id, {"error": e.to_xcontent()})
+            except Exception as e:  # never lose a background failure
+                _store_task_result(node, task.id, {"error": {
+                    "type": type(e).__name__, "reason": str(e)}})
+            finally:
+                node.task_manager.unregister(task)
+
+        threading.Thread(target=runner, daemon=True).start()
+        return 200, {"task": f"{node.node_id}:{task.id}"}
+    with node.task_manager.task_scope("transport", action_name,
+                                      cancellable=True) as task:
+        resp = run(task)
+    return 200, resp.to_dict()
+
+
+def _store_task_result(node, task_id, result):
+    node.task_results[task_id] = result
+    while len(node.task_results) > 256:
+        node.task_results.popitem(last=False)
+
+
+def reindex_handler(node, params, body):
+    from elasticsearch_tpu.reindex import reindex
+    return _bulk_by_scroll(node, params, "indices:data/write/reindex",
+                           lambda task: reindex(node, body, params, task=task))
+
+
+def update_by_query_handler(node, params, body, index):
+    from elasticsearch_tpu.reindex import update_by_query
+    return _bulk_by_scroll(
+        node, params, "indices:data/write/update/byquery",
+        lambda task: update_by_query(node, index, body, params, task=task))
+
+
+def delete_by_query_handler(node, params, body, index):
+    from elasticsearch_tpu.reindex import delete_by_query
+    return _bulk_by_scroll(
+        node, params, "indices:data/write/delete/byquery",
+        lambda task: delete_by_query(node, index, body, params, task=task))
+
+
+def rethrottle_handler(node, params, body, task_id):
+    task = _local_task(node, task_id)
+    throttle = getattr(task, "reindex_throttle", None)
+    if throttle is not None and "requests_per_second" in params:
+        raw = params["requests_per_second"]
+        throttle.rps = -1.0 if raw in ("-1", "unlimited") else float(raw)
+    return 200, {"nodes": {node.node_id: {
+        "tasks": {task_id: task.to_dict(node.node_id)}}}}
+
+
 # -- tasks / async search ----------------------------------------------------
 
 def list_tasks(node, params, body):
@@ -795,7 +871,25 @@ def _local_task(node, task_id):
 
 
 def get_task(node, params, body, task_id):
+    tid = TaskId.parse(task_id)
+    stored = node.task_results.get(tid.id)
+    if stored is not None and tid.node_id in ("", node.node_id):
+        return 200, {"completed": True, "response": stored,
+                     "task": {"node": node.node_id, "id": tid.id}}
     task = _local_task(node, task_id)
+    if params.get("wait_for_completion") == "true":
+        deadline = time.monotonic() + float(params.get("timeout_s", 30))
+        while time.monotonic() < deadline:
+            stored = node.task_results.get(tid.id)
+            if stored is not None:
+                return 200, {"completed": True, "response": stored,
+                             "task": {"node": node.node_id, "id": tid.id}}
+            if node.task_manager.get_task(tid.id) is None:
+                # finished without storing a result (e.g. a plain search
+                # task) — completed, nothing to return
+                return 200, {"completed": True,
+                             "task": {"node": node.node_id, "id": tid.id}}
+            time.sleep(0.02)
     return 200, {"completed": False, "task": task.to_dict(node.node_id)}
 
 

@@ -223,7 +223,9 @@ class ShardSearcher:
                     highlight: Optional[Dict[str, Any]] = None,
                     highlight_query: Optional[QueryBuilder] = None,
                     script_fields: Optional[Dict[str, Any]] = None,
-                    fields: Optional[List[Any]] = None) -> List[Dict[str, Any]]:
+                    fields: Optional[List[Any]] = None,
+                    version: bool = False,
+                    seq_no_primary_term: bool = False) -> List[Dict[str, Any]]:
         script_cols = (self._script_field_columns(script_fields)
                        if script_fields else None)
         hits = []
@@ -235,6 +237,20 @@ class ShardSearcher:
             }
             if d.sort_values:
                 hit["sort"] = list(d.sort_values)
+            # metadata doc values (ref: fetch subphases VersionPhase /
+            # SeqNoPrimaryTermPhase — `"version": true` /
+            # `"seq_no_primary_term": true` in the search body)
+            if version:
+                nv = seg.numerics.get("_version")
+                vs = nv.get(d.docid) if nv is not None else None
+                if vs:
+                    hit["_version"] = int(vs[0])
+            if seq_no_primary_term:
+                for meta in ("_seq_no", "_primary_term"):
+                    nv = seg.numerics.get(meta)
+                    vs = nv.get(d.docid) if nv is not None else None
+                    if vs:
+                        hit[meta] = int(vs[0])
             parsed_source: Optional[Dict[str, Any]] = None
 
             def get_source(seg=seg, d=d):

@@ -297,7 +297,9 @@ class SearchService:
                 docs, source_filter=source_filter,
                 docvalue_fields=docvalue_fields or None,
                 highlight=highlight, highlight_query=query,
-                script_fields=script_fields, fields=fields_spec)
+                script_fields=script_fields, fields=fields_spec,
+                version=bool(body.get("version")),
+                seq_no_primary_term=bool(body.get("seq_no_primary_term")))
             for (pos, d), fetched in zip(entries, fetched_list):
                 fetched["_index"] = index_name
                 if collapse_field:

@@ -1,0 +1,189 @@
+"""Reindex family tests (ref: modules/reindex — scroll+bulk worker with
+scripts, conflicts=proceed, max_docs, background tasks)."""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.errors import ScriptException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.reindex.worker import UpdateScript, _Ctx
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(Settings.EMPTY, data_path=str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def do(node, method, path, params=None, body=None, expect=200):
+    status, resp = node.rest_controller.dispatch(method, path, params, body)
+    assert status == expect, f"{method} {path} -> {status}: {resp}"
+    return resp
+
+
+def seed(node, index="src", n=25):
+    for i in range(n):
+        status, _ = node.rest_controller.dispatch(
+            "PUT", f"/{index}/_doc/{i}", None,
+            {"title": f"doc {i}", "n": i,
+             "tag": "even" if i % 2 == 0 else "odd"})
+        assert status in (200, 201)
+    do(node, "POST", f"/{index}/_refresh")
+
+
+def test_reindex_basic(node):
+    seed(node)
+    r = do(node, "POST", "/_reindex", body={
+        "source": {"index": "src"}, "dest": {"index": "dst"}})
+    assert r["total"] == 25 and r["created"] == 25
+    assert r["failures"] == []
+    do(node, "POST", "/dst/_refresh")
+    c = do(node, "GET", "/dst/_count")
+    assert c["count"] == 25
+
+
+def test_reindex_with_query_and_max_docs(node):
+    seed(node)
+    r = do(node, "POST", "/_reindex", body={
+        "source": {"index": "src", "query": {"term": {"tag": "even"}}},
+        "dest": {"index": "dst2"}, "max_docs": 5})
+    assert r["total"] == 5
+
+
+def test_reindex_script_and_noop_delete(node):
+    seed(node, n=10)
+    r = do(node, "POST", "/_reindex", body={
+        "source": {"index": "src"},
+        "dest": {"index": "dst3"},
+        "script": {"source":
+                   "if ctx._source.n > 7:\n    ctx.op = 'noop'\n"
+                   "ctx._source.boosted = ctx._source.n * 2"},
+    })
+    # n in {8,9} -> noop
+    assert r["noops"] == 2 and r["created"] == 8
+    do(node, "POST", "/dst3/_refresh")
+    got = do(node, "GET", "/dst3/_doc/3")
+    assert got["_source"]["boosted"] == 6
+
+
+def test_reindex_op_type_create_conflicts(node):
+    seed(node, n=6)
+    do(node, "POST", "/_reindex", body={
+        "source": {"index": "src"}, "dest": {"index": "dst4"}})
+    # second run with op_type create → all version conflicts, proceed
+    r = do(node, "POST", "/_reindex", body={
+        "conflicts": "proceed",
+        "source": {"index": "src"},
+        "dest": {"index": "dst4", "op_type": "create"}})
+    assert r["version_conflicts"] == 6 and r["created"] == 0
+    # abort mode records a failure
+    r2 = do(node, "POST", "/_reindex", body={
+        "source": {"index": "src"},
+        "dest": {"index": "dst4", "op_type": "create"}})
+    assert r2["version_conflicts"] >= 1 and r2["failures"]
+
+
+def test_update_by_query_script(node):
+    seed(node, n=8)
+    r = do(node, "POST", "/src/_update_by_query",
+           params={"refresh": "true"},
+           body={"query": {"term": {"tag": "odd"}},
+                 "script": {"source": "ctx._source.flagged = True"}})
+    assert r["updated"] == 4
+    got = do(node, "GET", "/src/_doc/1")
+    assert got["_source"]["flagged"] is True
+    got2 = do(node, "GET", "/src/_doc/2")
+    assert "flagged" not in got2["_source"]
+
+
+def test_update_by_query_params_and_increment(node):
+    seed(node, n=4)
+    do(node, "POST", "/src/_update_by_query",
+       params={"refresh": "true"},
+       body={"script": {"source": "ctx._source.n += params.step",
+                        "params": {"step": 100}}})
+    got = do(node, "GET", "/src/_doc/2")
+    assert got["_source"]["n"] == 102
+
+
+def test_delete_by_query(node):
+    seed(node, n=20)
+    r = do(node, "POST", "/src/_delete_by_query",
+           params={"refresh": "true"},
+           body={"query": {"range": {"n": {"gte": 10}}}})
+    assert r["deleted"] == 10
+    c = do(node, "GET", "/src/_count")
+    assert c["count"] == 10
+
+
+def test_script_string_literals_preserved():
+    s = UpdateScript("ctx._source.tag = 'a && b; !c'")
+    ctx = _Ctx({}, "i", "1", 1)
+    s.run(ctx)
+    assert ctx._source._data["tag"] == "a && b; !c"
+
+
+def test_reindex_external_versioning(node):
+    seed(node, n=3)
+    do(node, "POST", "/_reindex", body={
+        "source": {"index": "src"},
+        "dest": {"index": "dstv", "version_type": "external"}})
+    # bump a dest doc so its version outruns the source's
+    do(node, "GET", "/dstv/_doc/1")
+    node.indices_service.get("dstv").index_doc("1", {"n": 999})
+    r = do(node, "POST", "/_reindex", body={
+        "conflicts": "proceed",
+        "source": {"index": "src"},
+        "dest": {"index": "dstv", "version_type": "external"}})
+    assert r["version_conflicts"] >= 1
+
+
+def test_search_version_flag(node):
+    seed(node, n=2)
+    r = do(node, "POST", "/src/_search",
+           body={"version": True, "seq_no_primary_term": True})
+    hit = r["hits"]["hits"][0]
+    assert hit["_version"] == 1
+    assert "_seq_no" in hit and "_primary_term" in hit
+
+
+def test_reindex_background_task(node):
+    seed(node, n=12)
+    r = do(node, "POST", "/_reindex", params={"wait_for_completion": "false"},
+           body={"source": {"index": "src"}, "dest": {"index": "dstbg"}})
+    task_id = r["task"]
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        tr = do(node, "GET", f"/_tasks/{task_id}")
+        if tr.get("completed"):
+            assert tr["response"]["created"] == 12
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("background reindex did not finish")
+
+
+def test_update_script_sandbox():
+    s = UpdateScript("ctx._source.x = 1")
+    ctx = _Ctx({"x": 0}, "i", "1", 1)
+    s.run(ctx)
+    assert ctx._source._data["x"] == 1
+    with pytest.raises(ScriptException):
+        UpdateScript("__import__('os')")
+    with pytest.raises(ScriptException):
+        UpdateScript("open('/etc/passwd')")
+    with pytest.raises(ScriptException):
+        UpdateScript("ctx.__class__")
+
+
+def test_reindex_remove_field_script(node):
+    seed(node, n=3)
+    do(node, "POST", "/_reindex", body={
+        "source": {"index": "src"}, "dest": {"index": "dst5"},
+        "script": {"source": "ctx._source.remove('tag')"}})
+    do(node, "POST", "/dst5/_refresh")
+    got = do(node, "GET", "/dst5/_doc/0")
+    assert "tag" not in got["_source"]
