@@ -20,6 +20,7 @@ from elasticsearch_tpu.snapshots.slm import SnapshotLifecycleService
 from elasticsearch_tpu.rest.api import RestController
 from elasticsearch_tpu.rest.http_server import HttpServer
 from elasticsearch_tpu.search.async_search import AsyncSearchService
+from elasticsearch_tpu.search.script import StoredScripts
 from elasticsearch_tpu.search.service import SearchService
 from elasticsearch_tpu.transport.tasks import TaskManager
 from elasticsearch_tpu.utils.breaker import HierarchyCircuitBreakerService
@@ -49,6 +50,7 @@ class Node:
         self.async_search_service = AsyncSearchService(
             self.search_service, self.task_manager)
         self.ingest_service = IngestService(self.data_path)
+        self.stored_scripts = StoredScripts(self.data_path)
         self.metadata_service = MetadataService(self.indices_service,
                                                 self.data_path)
         self.repositories_service = RepositoriesService(self.data_path)

@@ -118,6 +118,32 @@ def _register_all(c: RestController):
     c.register("GET", "/{index}/_rank_eval", rank_eval_handler)
     c.register("GET", "/{index}/_explain/{id}", explain_doc)
     c.register("POST", "/{index}/_explain/{id}", explain_doc)
+    # search utility APIs
+    c.register("GET", "/_field_caps", field_caps)
+    c.register("POST", "/_field_caps", field_caps)
+    c.register("GET", "/{index}/_field_caps", field_caps)
+    c.register("POST", "/{index}/_field_caps", field_caps)
+    c.register("GET", "/{index}/_validate/query", validate_query)
+    c.register("POST", "/{index}/_validate/query", validate_query)
+    c.register("POST", "/{index}/_terms_enum", terms_enum)
+    c.register("GET", "/{index}/_terms_enum", terms_enum)
+    c.register("GET", "/_resolve/index/{expression}", resolve_index)
+    c.register("POST", "/{index}/_pit", open_pit)
+    c.register("DELETE", "/_pit", close_pit)
+    # stored scripts + search templates
+    c.register("PUT", "/_scripts/{id}", put_stored_script)
+    c.register("POST", "/_scripts/{id}", put_stored_script)
+    c.register("GET", "/_scripts/{id}", get_stored_script)
+    c.register("DELETE", "/_scripts/{id}", delete_stored_script)
+    c.register("POST", "/_render/template", render_search_template)
+    c.register("GET", "/_render/template", render_search_template)
+    c.register("POST", "/_render/template/{id}", render_search_template)
+    c.register("POST", "/_search/template", search_template_all)
+    c.register("GET", "/_search/template", search_template_all)
+    c.register("POST", "/{index}/_search/template", search_template)
+    c.register("GET", "/{index}/_search/template", search_template)
+    c.register("POST", "/_msearch/template", msearch_template)
+    c.register("POST", "/{index}/_msearch/template", msearch_template)
     # reindex family (ref: modules/reindex)
     c.register("POST", "/_reindex", reindex_handler)
     c.register("POST", "/{index}/_update_by_query", update_by_query_handler)
@@ -754,12 +780,7 @@ def clear_scroll(node, params, body):
 
 
 def msearch(node, params, body, index=None):
-    if isinstance(body, (bytes, str)):
-        lines = [json.loads(l) for l in
-                 (body.decode() if isinstance(body, bytes) else body).splitlines()
-                 if l.strip()]
-    else:
-        lines = body or []
+    lines = _ndjson_lines(body)
     responses = []
     i = 0
     while i + 1 < len(lines) or (i < len(lines) and index):
@@ -778,6 +799,225 @@ def msearch(node, params, body, index=None):
 
 def msearch_index(node, params, body, index):
     return msearch(node, params, body, index=index)
+
+
+# -- search utility APIs -----------------------------------------------------
+
+def field_caps(node, params, body, index="_all"):
+    """ref: action/fieldcaps/TransportFieldCapabilitiesAction — merge
+    per-index field capabilities; `indices` listed per cap entry only
+    where types conflict."""
+    import fnmatch
+    patterns = params.get("fields", "*").split(",")
+    if body and "fields" in body:
+        patterns = (body["fields"] if isinstance(body["fields"], list)
+                    else body["fields"].split(","))
+    names = node.indices_service.resolve(index)
+    # field -> type -> {indices: [...], searchable, aggregatable}
+    out: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    for name in names:
+        idx = node.indices_service.get(name)
+        for fname in idx.mapper.field_names():
+            if not any(fnmatch.fnmatch(fname, p.strip()) for p in patterns):
+                continue
+            ft = idx.mapper.field_type(fname)
+            t = ft.type_name
+            caps = out.setdefault(fname, {}).setdefault(t, {
+                "type": t,
+                "metadata_field": fname.startswith("_"),
+                "searchable": getattr(ft, "searchable", True),
+                "aggregatable": t not in ("text",),
+                "_indices": [],
+            })
+            caps["_indices"].append(name)
+    result: Dict[str, Any] = {}
+    for fname, types in out.items():
+        entry = {}
+        for t, caps in types.items():
+            c = dict(caps)
+            idx_list = c.pop("_indices")
+            if len(types) > 1:  # only list indices when types conflict
+                c["indices"] = sorted(idx_list)
+            entry[t] = c
+        result[fname] = entry
+    return 200, {"indices": sorted(names), "fields": result}
+
+
+def validate_query(node, params, body, index):
+    """ref: action/admin/indices/validate/query — parse/rewrite the query,
+    report validity with optional explanation."""
+    from elasticsearch_tpu.search.queries import parse_query
+    body = body or {}
+    q = body.get("query", {"match_all": {}})
+    try:
+        parsed = parse_query(q)
+        explanation = repr(parsed) if params.get("explain") in ("true", "") \
+            else None
+        exp = [{"index": n, "valid": True,
+                **({"explanation": explanation} if explanation else {})}
+               for n in node.indices_service.resolve(index)]
+        return 200, {"valid": True,
+                     "_shards": {"total": 1, "successful": 1, "failed": 0},
+                     "explanations": exp if explanation else []}
+    except ElasticsearchTpuException as e:
+        return 200, {"valid": False, "error": str(e)}
+
+
+def terms_enum(node, params, body, index):
+    """ref: x-pack terms-enum — prefix-complete terms from the index
+    dictionaries (postings terms + keyword doc-value terms)."""
+    body = body or {}
+    field = body.get("field") or params.get("field")
+    if not field:
+        raise IllegalArgumentException("terms_enum requires [field]")
+    prefix = body.get("string", params.get("string", ""))
+    size = int(body.get("size", params.get("size", 10)))
+    case_insensitive = bool(body.get("case_insensitive"))
+    cmp_prefix = prefix.lower() if case_insensitive else prefix
+    found = set()
+    for name in node.indices_service.resolve(index):
+        idx = node.indices_service.get(name)
+        for searcher in idx.shard_searchers():
+            for seg in searcher.segments:
+                pf = seg.postings.get(field)
+                if pf is not None:
+                    for t in pf.terms:
+                        probe = t.lower() if case_insensitive else t
+                        if probe.startswith(cmp_prefix):
+                            found.add(t)
+                kv = seg.keywords.get(field)
+                if kv is not None:
+                    for t in kv.terms:
+                        probe = t.lower() if case_insensitive else t
+                        if probe.startswith(cmp_prefix):
+                            found.add(t)
+    return 200, {"terms": sorted(found)[:size], "complete": True,
+                 "_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+
+def resolve_index(node, params, body, expression):
+    """ref: action/admin/indices/resolve/ResolveIndexAction."""
+    import fnmatch
+    meta = node.metadata_service
+    index_names, alias_names, stream_names = set(), set(), set()
+    for part in expression.split(","):
+        if part == "_all":
+            part = "*"
+        index_names.update(n for n in node.indices_service.indices
+                           if fnmatch.fnmatch(n, part))
+        alias_names.update(a for a in meta.aliases
+                           if fnmatch.fnmatch(a, part))
+        stream_names.update(ds for ds in meta.data_streams
+                            if fnmatch.fnmatch(ds, part))
+    return 200, {
+        "indices": [{"name": n, "attributes": ["open"]}
+                    for n in sorted(index_names)],
+        "aliases": [{"name": a, "indices": sorted(meta.aliases[a])}
+                    for a in sorted(alias_names)],
+        "data_streams": [{"name": ds,
+                          "backing_indices":
+                              meta.data_streams[ds].get("indices", []),
+                          "timestamp_field": "@timestamp"}
+                         for ds in sorted(stream_names)],
+    }
+
+
+def open_pit(node, params, body, index):
+    keep_alive = params.get("keep_alive", "1m")
+    pit_id = node.search_service.open_pit(index, keep_alive)
+    return 200, {"id": pit_id}
+
+
+def close_pit(node, params, body):
+    pit_id = (body or {}).get("id")
+    if not pit_id:
+        raise IllegalArgumentException("close PIT requires [id]")
+    ok = node.search_service.close_pit(pit_id)
+    return (200 if ok else 404), {"succeeded": ok,
+                                  "num_freed": 1 if ok else 0}
+
+
+# -- stored scripts + search templates ---------------------------------------
+
+def put_stored_script(node, params, body, id):
+    node.stored_scripts.put(id, (body or {}).get("script", {}))
+    return 200, {"acknowledged": True}
+
+
+def get_stored_script(node, params, body, id):
+    script = node.stored_scripts.get(id)
+    if script is None:
+        return 404, {"_id": id, "found": False}
+    return 200, {"_id": id, "found": True, "script": script}
+
+
+def delete_stored_script(node, params, body, id):
+    if not node.stored_scripts.delete(id):
+        raise ResourceNotFoundException(f"stored script [{id}] does not exist")
+    return 200, {"acknowledged": True}
+
+
+def _resolve_template(node, body):
+    from elasticsearch_tpu.search.template import render_template
+    body = body or {}
+    source = body.get("source")
+    if source is None and body.get("id"):
+        stored = node.stored_scripts.get(body["id"])
+        if stored is None:
+            raise ResourceNotFoundException(
+                f"stored script [{body['id']}] does not exist")
+        source = stored["source"]
+    if source is None:
+        raise IllegalArgumentException(
+            "search template requires [source] or [id]")
+    return render_template(source, body.get("params"))
+
+
+def render_search_template(node, params, body, id=None):
+    if id is not None:
+        body = dict(body or {})
+        body["id"] = id
+    return 200, {"template_output": _resolve_template(node, body)}
+
+
+def search_template(node, params, body, index):
+    rendered = _resolve_template(node, body)
+    rendered = _apply_alias_filter(node, index, rendered)
+    return 200, node.search_service.search(index, rendered)
+
+
+def search_template_all(node, params, body):
+    return search_template(node, params, body, "_all")
+
+
+def msearch_template(node, params, body, index=None):
+    lines = _ndjson_lines(body)
+    responses = []
+    i = 0
+    while i + 1 < len(lines) or (i < len(lines) and index):
+        header = lines[i]
+        i += 1
+        target = header.get("index", index) or "_all"
+        spec = lines[i] if i < len(lines) else {}
+        i += 1
+        try:
+            rendered = _resolve_template(node, spec)
+            rendered = _apply_alias_filter(node, target, rendered)
+            responses.append(node.search_service.search(target, rendered))
+        except ElasticsearchTpuException as e:
+            responses.append({"error": e.to_xcontent(), "status": e.status})
+    if i < len(lines):
+        raise IllegalArgumentException(
+            "msearch template body has a trailing header with no body line")
+    return 200, {"responses": responses}
+
+
+def _ndjson_lines(body):
+    if isinstance(body, (bytes, str)):
+        return [json.loads(l) for l in
+                (body.decode() if isinstance(body, bytes) else body).splitlines()
+                if l.strip()]
+    return body or []
 
 
 # -- reindex family ----------------------------------------------------------

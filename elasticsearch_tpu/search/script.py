@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import ast
 import threading
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -166,3 +166,49 @@ def compile_script(source: str):
             raise ScriptException(f"runtime error: {e} in script [{source}]")
 
     return run
+
+
+class StoredScripts:
+    """Cluster-stored scripts/templates (ref: PUT /_scripts/{id} →
+    StoredScriptSource kept in cluster state; script/ScriptMetadata).
+    Persisted to a JSON file under the node data path."""
+
+    def __init__(self, data_path: str):
+        import json as _json
+        import os as _os
+        self._path = _os.path.join(data_path, "_scripts.json")
+        self._scripts: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        if _os.path.exists(self._path):
+            with open(self._path) as fh:
+                self._scripts = _json.load(fh)
+
+    def _persist_locked(self):
+        import json as _json
+        import os as _os
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as fh:
+            _json.dump(self._scripts, fh)
+        _os.replace(tmp, self._path)
+
+    def put(self, script_id: str, script: Dict[str, Any]) -> None:
+        if not isinstance(script, dict) or "source" not in script:
+            raise ScriptException("stored script requires [script.source]")
+        with self._lock:
+            self._scripts[script_id] = {
+                "lang": script.get("lang", "painless"),
+                "source": script["source"],
+            }
+            self._persist_locked()
+
+    def get(self, script_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._scripts.get(script_id)
+
+    def delete(self, script_id: str) -> bool:
+        with self._lock:
+            if script_id in self._scripts:
+                del self._scripts[script_id]
+                self._persist_locked()
+                return True
+            return False

@@ -82,22 +82,76 @@ class ScrollContext:
     total_hits: int = 0
 
 
+@dataclass
+class PitContext:
+    """An open point-in-time: pinned shard snapshots, no cursor."""
+
+    id: str
+    index_names: List[str]
+    searchers: List[Tuple[str, ShardSearcher]]
+    keep_alive: float
+    expires_at: float
+
+
 class SearchService:
     def __init__(self, indices_service: IndicesService):
         self.indices_service = indices_service
         self._scrolls: Dict[str, ScrollContext] = {}
+        self._pits: Dict[str, PitContext] = {}
         self._lock = threading.Lock()
 
-    # ------------------------------------------------------------ public
-    def search(self, index_expression: str, body: Dict[str, Any],
-               scroll: Optional[str] = None, task=None) -> Dict[str, Any]:
-        start = time.monotonic()
+    # --------------------------------------------------------------- PIT
+    def open_pit(self, index_expression: str, keep_alive: str) -> str:
         names = self.indices_service.resolve(index_expression)
         searchers: List[Tuple[str, ShardSearcher]] = []
         for name in names:
             idx = self.indices_service.get(name)
             for s in idx.shard_searchers():
                 searchers.append((name, s))
+        ka = parse_time_value(keep_alive, "keep_alive")
+        pit = PitContext(id=uuid.uuid4().hex, index_names=names,
+                         searchers=searchers, keep_alive=ka,
+                         expires_at=time.time() + ka)
+        with self._lock:
+            self._pits[pit.id] = pit
+        return pit.id
+
+    def close_pit(self, pit_id: str) -> bool:
+        with self._lock:
+            return self._pits.pop(pit_id, None) is not None
+
+    def open_pit_count(self) -> int:
+        with self._lock:
+            return len(self._pits)
+
+    # ------------------------------------------------------------ public
+    def search(self, index_expression: str, body: Dict[str, Any],
+               scroll: Optional[str] = None, task=None) -> Dict[str, Any]:
+        start = time.monotonic()
+        pit_spec = (body or {}).get("pit")
+        if pit_spec is not None:
+            if index_expression not in ("_all", "*", ""):
+                raise IllegalArgumentException(
+                    "[indices] cannot be used with point in time")
+            # search against a pinned point-in-time reader set (ref:
+            # x-pack point-in-time / ReaderContext keepalive)
+            self._reap()
+            with self._lock:
+                pit = self._pits.get(pit_spec.get("id"))
+            if pit is None:
+                raise SearchContextMissingException(pit_spec.get("id", "?"))
+            if pit_spec.get("keep_alive"):
+                pit.keep_alive = parse_time_value(pit_spec["keep_alive"],
+                                                  "keep_alive")
+            pit.expires_at = time.time() + pit.keep_alive
+            names, searchers = pit.index_names, pit.searchers
+        else:
+            names = self.indices_service.resolve(index_expression)
+            searchers = []
+            for name in names:
+                idx = self.indices_service.get(name)
+                for s in idx.shard_searchers():
+                    searchers.append((name, s))
 
         scroll_ctx = None
         if scroll is not None:
@@ -153,6 +207,8 @@ class SearchService:
         with self._lock:
             for sid in [s for s, c in self._scrolls.items() if c.expires_at < now]:
                 del self._scrolls[sid]
+            for pid in [p for p, c in self._pits.items() if c.expires_at < now]:
+                del self._pits[pid]
 
     # ---------------------------------------------------------- internal
     def _execute(self, searchers: List[Tuple[str, ShardSearcher]],

@@ -1,0 +1,190 @@
+"""Search utility APIs: _field_caps, _validate/query, _terms_enum,
+_resolve/index, PIT, stored scripts, search templates (ref:
+action/fieldcaps, modules/lang-mustache, x-pack terms-enum)."""
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.search.template import render_template
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(Settings.EMPTY, data_path=str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def do(node, method, path, params=None, body=None, expect=200):
+    status, resp = node.rest_controller.dispatch(method, path, params, body)
+    assert status == expect, f"{method} {path} -> {status}: {resp}"
+    return resp
+
+
+def seed(node):
+    for i in range(10):
+        s, _ = node.rest_controller.dispatch(
+            "PUT", f"/logs/_doc/{i}", None,
+            {"msg": f"error in module {i}", "level": "warn" if i % 2 else "info",
+             "code": i})
+        assert s in (200, 201)
+    do(node, "POST", "/logs/_refresh")
+
+
+# ------------------------------------------------------------- field caps
+
+def test_field_caps(node):
+    seed(node)
+    do(node, "PUT", "/other", body={"mappings": {"properties": {
+        "code": {"type": "keyword"}}}})
+    r = do(node, "GET", "/logs/_field_caps", params={"fields": "code,msg"})
+    assert r["fields"]["code"]["long"]["aggregatable"] is True
+    assert "text" in r["fields"]["msg"]
+    # conflicting types across indices list their indices
+    r2 = do(node, "GET", "/_field_caps", params={"fields": "code"})
+    assert set(r2["fields"]["code"]) == {"long", "keyword"}
+    assert r2["fields"]["code"]["long"]["indices"] == ["logs"]
+
+
+def test_field_caps_wildcard(node):
+    seed(node)
+    r = do(node, "POST", "/logs/_field_caps", body={"fields": ["c*"]})
+    assert "code" in r["fields"]
+
+
+# ------------------------------------------------------------ validate
+
+def test_validate_query(node):
+    seed(node)
+    r = do(node, "GET", "/logs/_validate/query",
+           body={"query": {"match": {"msg": "error"}}})
+    assert r["valid"] is True
+    r2 = do(node, "GET", "/logs/_validate/query",
+            body={"query": {"no_such_query": {}}})
+    assert r2["valid"] is False
+    r3 = do(node, "GET", "/logs/_validate/query", params={"explain": "true"},
+            body={"query": {"term": {"level": "info"}}})
+    assert r3["explanations"][0]["valid"] is True
+
+
+# ------------------------------------------------------------ terms enum
+
+def test_terms_enum(node):
+    seed(node)
+    r = do(node, "POST", "/logs/_terms_enum",
+           body={"field": "level", "string": "wa"})
+    assert r["terms"] == ["warn"]
+    r2 = do(node, "POST", "/logs/_terms_enum",
+            body={"field": "msg", "string": "err"})
+    assert "error" in r2["terms"]
+    r3 = do(node, "POST", "/logs/_terms_enum",
+            body={"field": "level", "string": "WA", "case_insensitive": True})
+    assert r3["terms"] == ["warn"]
+
+
+# ------------------------------------------------------------ resolve
+
+def test_resolve_index(node):
+    seed(node)
+    do(node, "POST", "/_aliases", body={"actions": [
+        {"add": {"index": "logs", "alias": "logs-alias"}}]})
+    r = do(node, "GET", "/_resolve/index/l*")
+    assert any(i["name"] == "logs" for i in r["indices"])
+    assert any(a["name"] == "logs-alias" for a in r["aliases"])
+
+
+# ------------------------------------------------------------ PIT
+
+def test_point_in_time(node):
+    seed(node)
+    r = do(node, "POST", "/logs/_pit", params={"keep_alive": "1m"})
+    pit_id = r["id"]
+    # docs indexed after the PIT are invisible to it
+    node.rest_controller.dispatch("PUT", "/logs/_doc/new", None,
+                                  {"msg": "late", "code": 99})
+    do(node, "POST", "/logs/_refresh")
+    rs = do(node, "POST", "/_search", body={"pit": {"id": pit_id}, "size": 20})
+    assert rs["hits"]["total"]["value"] == 10
+    rs2 = do(node, "GET", "/logs/_search", body={"size": 20})
+    assert rs2["hits"]["total"]["value"] == 11
+    rc = do(node, "DELETE", "/_pit", body={"id": pit_id})
+    assert rc["succeeded"] is True
+    do(node, "POST", "/_search", body={"pit": {"id": pit_id}}, expect=404)
+
+
+# ------------------------------------------------------- stored scripts
+
+def test_stored_scripts_crud(node):
+    do(node, "PUT", "/_scripts/my-tpl", body={"script": {
+        "lang": "mustache",
+        "source": {"query": {"match": {"msg": "{{q}}"}}}}})
+    r = do(node, "GET", "/_scripts/my-tpl")
+    assert r["found"] and r["script"]["lang"] == "mustache"
+    do(node, "DELETE", "/_scripts/my-tpl")
+    do(node, "GET", "/_scripts/my-tpl", expect=404)
+
+
+# ------------------------------------------------------------ templates
+
+def test_render_template_basics():
+    out = render_template({"query": {"match": {"msg": "{{q}}"}},
+                           "size": "{{size}}"},
+                          {"q": "hello", "size": 5})
+    # a quoted placeholder stays a JSON string (the search body parser is
+    # lenient about numeric strings, as in the reference)
+    assert out == {"query": {"match": {"msg": "hello"}}, "size": "5"}
+
+
+def test_render_template_tojson_and_sections():
+    src = ('{"query": {"terms": {"tag": {{#toJson}}tags{{/toJson}} }},'
+           '"size": {{size}}{{^size}}10{{/size}} }')
+    out = render_template(src, {"tags": ["a", "b"]})
+    assert out["query"]["terms"]["tag"] == ["a", "b"]
+    assert out["size"] == 10
+    out2 = render_template(src, {"tags": [], "size": 3})
+    assert out2["size"] == 3
+
+
+def test_render_template_string_escaping():
+    out = render_template('{"q": "{{text}}"}', {"text": 'say "hi"\n'})
+    assert out["q"] == 'say "hi"\n'
+
+
+def test_render_template_section_iteration():
+    src = ('{"filters": [ {{#clauses}}{"term": {"f": "{{.}}"}},{{/clauses}} '
+           '{"match_all": {}} ]}')
+    out = render_template(src, {"clauses": ["x", "y"]})
+    assert out["filters"][0] == {"term": {"f": "x"}}
+    assert out["filters"][2] == {"match_all": {}}
+
+
+def test_search_template_endpoint(node):
+    seed(node)
+    r = do(node, "POST", "/logs/_search/template", body={
+        "source": {"query": {"match": {"level": "{{lvl}}"}}},
+        "params": {"lvl": "info"}})
+    assert r["hits"]["total"]["value"] == 5
+    # stored template by id
+    do(node, "PUT", "/_scripts/lvl-tpl", body={"script": {
+        "lang": "mustache",
+        "source": {"query": {"match": {"level": "{{lvl}}"}}}}})
+    r2 = do(node, "POST", "/logs/_search/template",
+            body={"id": "lvl-tpl", "params": {"lvl": "warn"}})
+    assert r2["hits"]["total"]["value"] == 5
+    r3 = do(node, "POST", "/_render/template", body={
+        "id": "lvl-tpl", "params": {"lvl": "warn"}})
+    assert r3["template_output"]["query"]["match"]["level"] == "warn"
+
+
+def test_msearch_template(node):
+    seed(node)
+    r = do(node, "POST", "/_msearch/template", body=[
+        {"index": "logs"},
+        {"source": {"query": {"match": {"level": "{{l}}"}}},
+         "params": {"l": "info"}},
+        {"index": "logs"},
+        {"source": {"query": {"match_all": {}}}},
+    ])
+    assert r["responses"][0]["hits"]["total"]["value"] == 5
+    assert r["responses"][1]["hits"]["total"]["value"] == 10
