@@ -242,12 +242,64 @@ class DenseVectorFieldType(MappedFieldType):
         return arr
 
 
+class JoinFieldType(MappedFieldType):
+    """Parent/child relations within one index (ref: modules/parent-join
+    ParentJoinFieldMapper — the join field indexes the relation name, and
+    children additionally index the parent id under ``{field}#parent``;
+    parent and children must share a shard via routing)."""
+
+    type_name = "join"
+    docvalue_kind = "join"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        rels = (params or {}).get("relations", {})
+        self.relations = {p: (c if isinstance(c, list) else [c])
+                          for p, c in rels.items()}
+
+    def parent_of(self, child: str) -> Optional[str]:
+        for parent, children in self.relations.items():
+            if child in children:
+                return parent
+        return None
+
+    def children_of(self, parent: str) -> List[str]:
+        return self.relations.get(parent, [])
+
+    def to_mapping(self):
+        return {"type": "join", "relations": {
+            p: (c[0] if len(c) == 1 else c)
+            for p, c in self.relations.items()}}
+
+
+class PercolatorFieldType(MappedFieldType):
+    """Stores a query for reverse search (ref: modules/percolator
+    PercolatorFieldMapper — the query is kept in _source and re-parsed at
+    percolate time against an in-memory index of the candidate docs).
+    Invalid queries are rejected at index time, as in the reference."""
+
+    type_name = "percolator"
+    docvalue_kind = "stored_query"
+
+    def parse(self, value):
+        if not isinstance(value, dict):
+            raise MapperParsingException(
+                f"percolator field [{self.name}] expects a query object")
+        from elasticsearch_tpu.search.queries import parse_query
+        try:
+            parse_query(value)
+        except Exception as e:
+            raise MapperParsingException(
+                f"percolator field [{self.name}]: invalid query: {e}")
+        return value
+
+
 FIELD_TYPES = {
     t.type_name: t for t in [
         TextFieldType, KeywordFieldType, LongFieldType, IntegerFieldType,
         ShortFieldType, ByteFieldType, DoubleFieldType, FloatFieldType,
         HalfFloatFieldType, BooleanFieldType, DateFieldType, IpFieldType,
-        DenseVectorFieldType,
+        DenseVectorFieldType, JoinFieldType, PercolatorFieldType,
     ]
 }
 
@@ -366,9 +418,52 @@ class DocumentMapper:
         self._parse_object("", source, parsed)
         return parsed
 
+    def join_routing_required(self, source: Dict[str, Any]) -> Optional[str]:
+        """Name of the join field if `source` is a child doc (which MUST
+        be routed to its parent's shard; ref: parent-join routing_required
+        — ES rejects unrouted children with routing_missing_exception)."""
+        for path, ft in self.fields.items():
+            if not isinstance(ft, JoinFieldType):
+                continue
+            cur: Any = source
+            for part in path.split("."):
+                if not isinstance(cur, dict) or part not in cur:
+                    cur = None
+                    break
+                cur = cur[part]
+            if isinstance(cur, dict) and cur.get("parent") is not None:
+                return path
+        return None
+
     def _parse_object(self, prefix: str, obj: Dict[str, Any], parsed: ParsedDocument):
         for key, value in obj.items():
             path = f"{prefix}{key}"
+            ft_pre = self.fields.get(path)
+            if ft_pre is not None and isinstance(ft_pre, JoinFieldType):
+                # {"name": rel} / {"name": rel, "parent": id} / "rel"
+                if isinstance(value, str):
+                    rel, parent = value, None
+                elif isinstance(value, dict):
+                    rel, parent = value.get("name"), value.get("parent")
+                else:
+                    raise MapperParsingException(
+                        f"failed to parse join field [{path}]")
+                known = set(ft_pre.relations) | {
+                    c for cs in ft_pre.relations.values() for c in cs}
+                if rel not in known:
+                    raise MapperParsingException(
+                        f"unknown join name [{rel}] for field [{path}]")
+                if parent is None and ft_pre.parent_of(rel) is not None:
+                    raise MapperParsingException(
+                        f"[parent] is missing for join field [{path}]")
+                parsed.keyword_terms.setdefault(path, []).append(rel)
+                if parent is not None:
+                    parsed.keyword_terms.setdefault(
+                        f"{path}#parent", []).append(str(parent))
+                continue
+            if ft_pre is not None and isinstance(ft_pre, PercolatorFieldType):
+                ft_pre.parse(value)  # validate shape; query stays in _source
+                continue
             if isinstance(value, dict):
                 self._parse_object(f"{path}.", value, parsed)
                 continue

@@ -116,6 +116,15 @@ class IndexService:
     # ------------------------------------------------------------- writes
     def index_doc(self, doc_id: str, source: Dict[str, Any],
                   routing: Optional[str] = None, **kwargs):
+        if routing is None:
+            jf = self.mapper.mapper.join_routing_required(source)
+            if jf is not None:
+                from elasticsearch_tpu.common.errors import (
+                    IllegalArgumentException)
+                raise IllegalArgumentException(
+                    f"routing is required for [{self.name}]/[{doc_id}]: a "
+                    f"[{jf}] child document must be routed to its parent's "
+                    f"shard")
         shard = self.shards[self.shard_for(doc_id, routing)]
         n_fields = len(self.mapper.mapper.fields)
         result = shard.index(doc_id, source, **kwargs)

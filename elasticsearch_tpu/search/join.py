@@ -1,0 +1,241 @@
+"""Parent/child join queries (ref: modules/parent-join —
+HasChildQueryBuilder, HasParentQueryBuilder, ParentIdQueryBuilder).
+
+The join is executed shard-locally (parents and children share a shard by
+routing, as in the reference): the inner query runs first over the shard's
+segments, matched ids are joined through the ``{field}#parent`` keyword
+doc values, and the result is rewritten into an id→score lookup query.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.common.errors import QueryShardException
+from elasticsearch_tpu.index.mapper import JoinFieldType
+from elasticsearch_tpu.search.queries import QueryBuilder
+
+
+def _join_field(mapper) -> Optional[JoinFieldType]:
+    for ft in mapper.mapper.fields.values():
+        if isinstance(ft, JoinFieldType):
+            return ft
+    return None
+
+
+def _relation_docs(seg, jf_name: str, relations: List[str]) -> np.ndarray:
+    """Bool mask [n_docs] of docs whose join relation is one of
+    `relations` (host-side ordinal compare)."""
+    kv = seg.keywords.get(jf_name)
+    out = np.zeros(seg.n_docs, bool)
+    if kv is None:
+        return out
+    want = {kv.terms.index(r) for r in relations if r in kv.terms}
+    if not want:
+        return out
+    for o in want:
+        out |= kv.ords[: seg.n_docs] == o
+    return out
+
+
+class _IdScoreQuery(QueryBuilder):
+    """Matches docs whose _id is a key of `scores` (the post-join result
+    set); used as the rewrite target of has_child."""
+
+    name = "_id_scores"
+
+    def __init__(self, scores: Dict[str, float]):
+        super().__init__()
+        self.scores = scores
+
+    def do_execute(self, ctx):
+        m = np.zeros(ctx.n_docs_padded, bool)
+        s = np.zeros(ctx.n_docs_padded, np.float32)
+        for doc_id, score in self.scores.items():
+            d = ctx.segment.docid_for(doc_id)
+            if d >= 0:
+                m[d] = True
+                s[d] = score
+        return jnp.asarray(s), jnp.asarray(m)
+
+
+class _ParentRefScoreQuery(QueryBuilder):
+    """Matches docs whose ``{field}#parent`` value is a key of `scores`
+    and whose relation is in `child_relations`; the rewrite target of
+    has_parent."""
+
+    name = "_parent_ref_scores"
+
+    def __init__(self, jf_name: str, child_relations: List[str],
+                 scores: Dict[str, float]):
+        super().__init__()
+        self.jf_name = jf_name
+        self.child_relations = child_relations
+        self.scores = scores
+
+    def do_execute(self, ctx):
+        seg = ctx.segment
+        rel_mask = _relation_docs(seg, self.jf_name, self.child_relations)
+        kv = seg.keywords.get(f"{self.jf_name}#parent")
+        m = np.zeros(ctx.n_docs_padded, bool)
+        s = np.zeros(ctx.n_docs_padded, np.float32)
+        if kv is not None:
+            for d in np.nonzero(rel_mask)[0]:
+                for pid in kv.get(int(d)):
+                    if pid in self.scores:
+                        m[d] = True
+                        s[d] = self.scores[pid]
+        return jnp.asarray(s), jnp.asarray(m)
+
+
+def _score_reduce(values: List[float], mode: str) -> float:
+    if mode == "none":
+        return 1.0
+    if mode == "sum":
+        return float(sum(values))
+    if mode == "avg":
+        return float(sum(values) / len(values))
+    if mode == "min":
+        return float(min(values))
+    return float(max(values))  # "max" (default for scoring modes)
+
+
+class HasChildQuery(QueryBuilder):
+    """ref: HasChildQueryBuilder — matches parent docs having matching
+    children; score_mode none|max|sum|avg|min; min/max_children bounds."""
+
+    name = "has_child"
+
+    def __init__(self, child_type: str, query: QueryBuilder,
+                 score_mode: str = "none", min_children: int = 1,
+                 max_children: Optional[int] = None,
+                 ignore_unmapped: bool = False):
+        super().__init__()
+        self.child_type = child_type
+        self.query = query
+        self.score_mode = score_mode
+        self.min_children = max(1, int(min_children))
+        self.max_children = max_children
+        self.ignore_unmapped = ignore_unmapped
+
+    def rewrite(self, searcher) -> QueryBuilder:
+        from elasticsearch_tpu.search.queries import MatchNoneQuery
+        if not hasattr(searcher, "_contexts"):
+            return self  # coordinator stage; join is shard-local
+        jf = _join_field(searcher.mapper)
+        if jf is None:
+            if self.ignore_unmapped:
+                return MatchNoneQuery()
+            raise QueryShardException(
+                "[has_child] no join field has been configured")
+        if jf.parent_of(self.child_type) is None:
+            if self.ignore_unmapped:
+                return MatchNoneQuery()
+            raise QueryShardException(
+                f"[has_child] join relation [{self.child_type}] is not a "
+                f"child of any parent")
+        inner = self.query.rewrite(searcher)
+        child_scores: Dict[str, List[float]] = {}
+        for ctx in searcher._contexts():
+            if ctx.segment.n_docs == 0:
+                continue
+            scores, mask = inner.execute(ctx)
+            rel = _relation_docs(ctx.segment, jf.name, [self.child_type])
+            m = np.asarray(mask)[: ctx.segment.n_docs] & rel & \
+                ctx.segment.live[: ctx.segment.n_docs]
+            sc = np.asarray(scores)
+            kv = ctx.segment.keywords.get(f"{jf.name}#parent")
+            if kv is None:
+                continue
+            for d in np.nonzero(m)[0]:
+                for pid in kv.get(int(d)):
+                    child_scores.setdefault(pid, []).append(float(sc[d]))
+        out: Dict[str, float] = {}
+        for pid, vals in child_scores.items():
+            if len(vals) < self.min_children:
+                continue
+            if self.max_children is not None and len(vals) > int(self.max_children):
+                continue
+            out[pid] = _score_reduce(vals, self.score_mode)
+        q = _IdScoreQuery(out)
+        q.boost = self.boost
+        return q
+
+
+class HasParentQuery(QueryBuilder):
+    """ref: HasParentQueryBuilder — matches child docs whose parent
+    matches; `score` propagates the parent's score."""
+
+    name = "has_parent"
+
+    def __init__(self, parent_type: str, query: QueryBuilder,
+                 score: bool = False, ignore_unmapped: bool = False):
+        super().__init__()
+        self.parent_type = parent_type
+        self.query = query
+        self.score = score
+        self.ignore_unmapped = ignore_unmapped
+
+    def rewrite(self, searcher) -> QueryBuilder:
+        from elasticsearch_tpu.search.queries import MatchNoneQuery
+        if not hasattr(searcher, "_contexts"):
+            return self  # coordinator stage; join is shard-local
+        jf = _join_field(searcher.mapper)
+        if jf is None or not jf.children_of(self.parent_type):
+            if self.ignore_unmapped:
+                return MatchNoneQuery()
+            raise QueryShardException(
+                "[has_parent] no join field has been configured"
+                if jf is None else
+                f"[has_parent] join relation [{self.parent_type}] has no "
+                f"children")
+        inner = self.query.rewrite(searcher)
+        parent_scores: Dict[str, float] = {}
+        for ctx in searcher._contexts():
+            if ctx.segment.n_docs == 0:
+                continue
+            scores, mask = inner.execute(ctx)
+            rel = _relation_docs(ctx.segment, jf.name, [self.parent_type])
+            m = np.asarray(mask)[: ctx.segment.n_docs] & rel & \
+                ctx.segment.live[: ctx.segment.n_docs]
+            sc = np.asarray(scores)
+            ids = ctx.segment.stored.ids
+            for d in np.nonzero(m)[0]:
+                score = float(sc[d]) if self.score else 1.0
+                pid = ids[int(d)]
+                parent_scores[pid] = max(parent_scores.get(pid, 0.0), score)
+        q = _ParentRefScoreQuery(jf.name, jf.children_of(self.parent_type),
+                                 parent_scores)
+        q.boost = self.boost
+        return q
+
+
+class ParentIdQuery(QueryBuilder):
+    """ref: ParentIdQueryBuilder — children of one specific parent doc."""
+
+    name = "parent_id"
+
+    def __init__(self, child_type: str, parent_id: str,
+                 ignore_unmapped: bool = False):
+        super().__init__()
+        self.child_type = child_type
+        self.parent_id = str(parent_id)
+        self.ignore_unmapped = ignore_unmapped
+
+    def rewrite(self, searcher) -> QueryBuilder:
+        from elasticsearch_tpu.search.queries import MatchNoneQuery
+        if not hasattr(searcher, "_contexts"):
+            return self  # coordinator stage; join is shard-local
+        jf = _join_field(searcher.mapper)
+        if jf is None:
+            if self.ignore_unmapped:
+                return MatchNoneQuery()
+            raise QueryShardException(
+                "[parent_id] no join field has been configured")
+        q = _ParentRefScoreQuery(jf.name, [self.child_type],
+                                 {self.parent_id: 1.0})
+        q.boost = self.boost
+        return q

@@ -1,0 +1,158 @@
+"""Percolator: reverse search (ref: modules/percolator —
+PercolateQueryBuilder / PercolatorFieldMapper). Queries are indexed as
+documents (a ``percolator``-typed field holds the query DSL in _source);
+the ``percolate`` query takes candidate document(s), builds an in-memory
+one-segment index of them (the MemoryIndex analogue), and matches each
+stored query against it.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    QueryShardException,
+)
+from elasticsearch_tpu.index.mapper import PercolatorFieldType
+from elasticsearch_tpu.index.segment import SegmentWriter
+from elasticsearch_tpu.search.queries import QueryBuilder, parse_query
+
+
+class _SandboxMapperService:
+    """Parse-only MapperService view with its own fields dict: dynamic
+    mappings introduced by candidate docs stay here, never touching the
+    live index mapping."""
+
+    def __init__(self, base):
+        import copy
+        self.analysis = base.analysis
+        self.mapper = copy.copy(base.mapper)
+        self.mapper.fields = dict(base.mapper.fields)
+
+    def field_type(self, name):
+        return self.mapper.fields.get(name)
+
+    def field_names(self):
+        return sorted(self.mapper.fields)
+
+    def parse(self, doc_id, source):
+        return self.mapper.parse(doc_id, source)
+
+
+class PercolateQuery(QueryBuilder):
+    """ref: PercolateQueryBuilder — `field` names the percolator field;
+    `document`/`documents` inline the candidate docs (doc references by
+    index/id are resolved by the search service before parsing)."""
+
+    name = "percolate"
+
+    def __init__(self, field: str,
+                 documents: Optional[List[Dict[str, Any]]] = None):
+        super().__init__()
+        self.field = field
+        self.documents = documents or []
+        # _id of matched stored-query doc -> list of matched doc slots
+        self.matched_slots: Dict[str, List[int]] = {}
+        self._mini = None
+
+    def rewrite(self, searcher) -> "PercolateQuery":
+        if not self.documents:
+            raise IllegalArgumentException(
+                "[percolate] query requires [document] or [documents]")
+        if self._mini is not None:
+            return self  # candidates don't change within a request
+        # the candidate docs are parsed with a SANDBOXED copy of the
+        # percolator index's mappings (ref: percolator parses candidates
+        # against the index mappings via a throwaway MemoryIndex) — a
+        # search must never mutate the live index mapping via dynamic
+        # field introduction
+        from elasticsearch_tpu.search.searcher import ShardSearcher
+        sandbox = _SandboxMapperService(searcher.mapper)
+        writer = SegmentWriter()
+        for slot, doc in enumerate(self.documents):
+            writer.add(sandbox.parse(f"_slot_{slot}", doc))
+        seg = writer.build("_percolate_candidates")
+        self._mini = ShardSearcher([seg], sandbox)
+        return self
+
+    def do_execute(self, ctx):
+        if self._mini is None:
+            raise QueryShardException("[percolate] query was not rewritten")
+        seg = ctx.segment
+        m = np.zeros(ctx.n_docs_padded, bool)
+        n_slots = len(self.documents)
+        for docid in range(seg.n_docs):
+            if not seg.live[docid]:
+                continue
+            source = json.loads(seg.stored.source(docid))
+            spec = _field_path(source, self.field)
+            if not isinstance(spec, dict):
+                continue
+            try:
+                stored_q = parse_query(spec)
+            except Exception:
+                continue
+            result = self._mini.query_phase(stored_q, n_slots,
+                                            track_total_hits=True)
+            if result.total_hits > 0:
+                m[docid] = True
+                slots = sorted(int(d.docid) for d in result.docs)
+                self.matched_slots[seg.stored.ids[docid]] = slots
+        mask = jnp.asarray(m)
+        return mask.astype(jnp.float32), mask
+
+    # hit decoration: _percolator_document_slot (ref: PercolateQuery adds
+    # the slot field to each matched query hit)
+    def add_hit_fields(self, hit: Dict[str, Any]) -> None:
+        slots = self.matched_slots.get(hit.get("_id"))
+        if slots is not None:
+            hit.setdefault("fields", {})["_percolator_document_slot"] = slots
+
+
+def resolve_percolate_refs(query_spec: Any, indices_service) -> Any:
+    """Replace {"percolate": {..., "index": i, "id": d}} document
+    references with the fetched _source (ref: PercolateQueryBuilder's
+    coordinator rewrite fetches the doc via GetRequest)."""
+    if isinstance(query_spec, list):
+        return [resolve_percolate_refs(x, indices_service) for x in query_spec]
+    if not isinstance(query_spec, dict):
+        return query_spec
+    out = {}
+    for k, v in query_spec.items():
+        if k == "percolate" and isinstance(v, dict) and "index" in v and "id" in v:
+            idx = indices_service.get(v["index"])
+            got = idx.get_doc(str(v["id"]), routing=v.get("routing"))
+            if not got.found:
+                raise IllegalArgumentException(
+                    f"percolate document [{v['index']}/{v['id']}] not found")
+            v = {key: val for key, val in v.items()
+                 if key not in ("index", "id", "routing", "preference")}
+            v["document"] = got.source
+            out[k] = v
+        else:
+            out[k] = resolve_percolate_refs(v, indices_service)
+    return out
+
+
+def _field_path(source: Dict[str, Any], path: str) -> Any:
+    cur: Any = source
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def parse_percolate(spec: Dict[str, Any]) -> PercolateQuery:
+    field = spec.get("field")
+    if not field:
+        raise IllegalArgumentException("[percolate] requires [field]")
+    docs = spec.get("documents")
+    if docs is None and spec.get("document") is not None:
+        docs = [spec["document"]]
+    return PercolateQuery(field, docs)

@@ -1721,7 +1721,41 @@ def _parse_pinned(spec):
         parse_query(spec.get("organic", {"match_all": {}}))), spec)
 
 
+def _parse_has_child(spec):
+    from elasticsearch_tpu.search.join import HasChildQuery
+    q = HasChildQuery(spec["type"], parse_query(spec["query"]),
+                      score_mode=spec.get("score_mode", "none"),
+                      min_children=spec.get("min_children", 1),
+                      max_children=spec.get("max_children"),
+                      ignore_unmapped=bool(spec.get("ignore_unmapped")))
+    return _with_boost(q, spec)
+
+
+def _parse_has_parent(spec):
+    from elasticsearch_tpu.search.join import HasParentQuery
+    q = HasParentQuery(spec["parent_type"], parse_query(spec["query"]),
+                       score=bool(spec.get("score")),
+                       ignore_unmapped=bool(spec.get("ignore_unmapped")))
+    return _with_boost(q, spec)
+
+
+def _parse_parent_id(spec):
+    from elasticsearch_tpu.search.join import ParentIdQuery
+    q = ParentIdQuery(spec["type"], spec["id"],
+                      ignore_unmapped=bool(spec.get("ignore_unmapped")))
+    return _with_boost(q, spec)
+
+
+def _parse_percolate(spec):
+    from elasticsearch_tpu.search.percolate import parse_percolate
+    return parse_percolate(spec)
+
+
 _PARSERS = {
+    "has_child": _parse_has_child,
+    "has_parent": _parse_has_parent,
+    "parent_id": _parse_parent_id,
+    "percolate": _parse_percolate,
     "match_all": lambda spec: _with_boost(MatchAllQuery(), spec),
     "match_none": lambda spec: MatchNoneQuery(),
     "match": _parse_match,

@@ -46,6 +46,26 @@ class _CoordinatorRewriteContext:
         self.stats = ShardStats(self.segments)
 
 
+def _collect_decorators(query, out=None, seen=None):
+    """Walk a parsed query tree for queries exposing add_hit_fields."""
+    from elasticsearch_tpu.search.queries import QueryBuilder
+    if out is None:
+        out, seen = [], set()
+    if id(query) in seen:
+        return out
+    seen.add(id(query))
+    if hasattr(query, "add_hit_fields"):
+        out.append(query)
+    for v in vars(query).values():
+        if isinstance(v, QueryBuilder):
+            _collect_decorators(v, out, seen)
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                if isinstance(item, QueryBuilder):
+                    _collect_decorators(item, out, seen)
+    return out
+
+
 def _doc_field_value(searcher: ShardSearcher, d: DocAddress, field: str):
     """First doc-value for a doc (collapse keys, missing → None)."""
     seg = searcher.segments[d.segment_idx]
@@ -215,8 +235,16 @@ class SearchService:
                  body: Dict[str, Any], scroll_ctx: Optional[ScrollContext] = None,
                  continuing: bool = False, task=None) -> Dict[str, Any]:
         body = body or {}
-        query = (parse_query(body["query"]) if body.get("query")
-                 else MatchAllQuery())
+        from elasticsearch_tpu.search.percolate import resolve_percolate_refs
+        query_spec = body.get("query")
+        if query_spec:
+            query_spec = resolve_percolate_refs(query_spec,
+                                                self.indices_service)
+        if body.get("post_filter"):
+            body = dict(body)
+            body["post_filter"] = resolve_percolate_refs(
+                body["post_filter"], self.indices_service)
+        query = parse_query(query_spec) if query_spec else MatchAllQuery()
         if searchers:
             # coordinator-level rewrite: doc-resolving queries (e.g.
             # more_like_this) see ALL shards' segments, not just one
@@ -364,6 +392,12 @@ class SearchService:
                         key if isinstance(key, list) else [key])
                 hits_by_pos[pos] = fetched
         hits = [hits_by_pos[i] for i in sorted(hits_by_pos)]
+        # query-computed hit decorations (percolator document slots) — the
+        # percolate query may be nested inside compounds
+        decorators = _collect_decorators(query)
+        for q in decorators:
+            for hit in hits:
+                q.add_hit_fields(hit)
 
         # ---- aggregation phase (ref: AggregationPhase; reduce is trivial
         # here since all shards are in-process — masks concatenate)

@@ -1,0 +1,202 @@
+"""Parent-join and percolator tests (ref: modules/parent-join,
+modules/percolator)."""
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(Settings.EMPTY, data_path=str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def do(node, method, path, params=None, body=None, expect=200):
+    status, resp = node.rest_controller.dispatch(method, path, params, body)
+    assert status == expect, f"{method} {path} -> {status}: {resp}"
+    return resp
+
+
+@pytest.fixture
+def qa(node):
+    """question/answer join index (the classic parent-join example)."""
+    do(node, "PUT", "/qa", body={"mappings": {"properties": {
+        "text": {"type": "text"},
+        "join": {"type": "join", "relations": {"question": "answer"}},
+    }}, "settings": {"index": {"number_of_shards": 1}}})
+    docs = [
+        ("q1", {"text": "how do I use jax", "join": "question"}),
+        ("q2", {"text": "what is a tpu", "join": "question"}),
+        ("a1", {"text": "with grad and jit",
+                "join": {"name": "answer", "parent": "q1"}}),
+        ("a2", {"text": "jax uses xla", "join": {"name": "answer", "parent": "q1"}}),
+        ("a3", {"text": "a matrix accelerator",
+                "join": {"name": "answer", "parent": "q2"}}),
+    ]
+    for doc_id, src in docs:
+        s, r = node.rest_controller.dispatch("PUT", f"/qa/_doc/{doc_id}",
+                                             {"routing": "r"}, src)
+        assert s in (200, 201), r
+    do(node, "POST", "/qa/_refresh")
+    return node
+
+
+def ids(resp):
+    return sorted(h["_id"] for h in resp["hits"]["hits"])
+
+
+def test_has_child(qa):
+    r = do(qa, "POST", "/qa/_search", body={"query": {"has_child": {
+        "type": "answer", "query": {"match": {"text": "jax"}}}}})
+    assert ids(r) == ["q1"]
+    # both children of q1 and none of q2 match "jax"? a2 has jax, a1 no.
+    r2 = do(qa, "POST", "/qa/_search", body={"query": {"has_child": {
+        "type": "answer", "query": {"match_all": {}}}}})
+    assert ids(r2) == ["q1", "q2"]
+
+
+def test_has_child_min_children(qa):
+    r = do(qa, "POST", "/qa/_search", body={"query": {"has_child": {
+        "type": "answer", "query": {"match_all": {}},
+        "min_children": 2}}})
+    assert ids(r) == ["q1"]
+
+
+def test_has_child_score_mode(qa):
+    r = do(qa, "POST", "/qa/_search", body={"query": {"has_child": {
+        "type": "answer", "query": {"match_all": {}},
+        "score_mode": "sum"}}})
+    by_id = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+    assert by_id["q1"] == 2.0 and by_id["q2"] == 1.0
+
+
+def test_has_parent(qa):
+    r = do(qa, "POST", "/qa/_search", body={"query": {"has_parent": {
+        "parent_type": "question", "query": {"match": {"text": "tpu"}}}}})
+    assert ids(r) == ["a3"]
+
+
+def test_parent_id(qa):
+    r = do(qa, "POST", "/qa/_search", body={"query": {"parent_id": {
+        "type": "answer", "id": "q1"}}})
+    assert ids(r) == ["a1", "a2"]
+
+
+def test_join_in_bool(qa):
+    r = do(qa, "POST", "/qa/_search", body={"query": {"bool": {
+        "must": [{"has_child": {"type": "answer",
+                                "query": {"match": {"text": "xla"}}}}]}}})
+    assert ids(r) == ["q1"]
+
+
+def test_join_mapping_validation(qa):
+    # unknown relation name rejected
+    s, r = qa.rest_controller.dispatch("PUT", "/qa/_doc/bad", None,
+                                       {"join": "nonsense"})
+    assert s == 400, r
+    # child without parent rejected
+    s, r = qa.rest_controller.dispatch("PUT", "/qa/_doc/bad2", None,
+                                       {"join": {"name": "answer"}})
+    assert s == 400, r
+
+
+def test_join_unmapped(node):
+    do(node, "PUT", "/plain", body={})
+    node.rest_controller.dispatch("PUT", "/plain/_doc/1", None, {"x": 1})
+    do(node, "POST", "/plain/_refresh")
+    do(node, "POST", "/plain/_search", body={"query": {"has_child": {
+        "type": "answer", "query": {"match_all": {}},
+        "ignore_unmapped": True}}})
+    s, _ = node.rest_controller.dispatch("POST", "/plain/_search", None,
+                                         {"query": {"has_child": {
+                                             "type": "answer",
+                                             "query": {"match_all": {}}}}})
+    assert s == 400
+
+
+# ----------------------------------------------------------- percolator
+
+@pytest.fixture
+def perco(node):
+    do(node, "PUT", "/alerts", body={"mappings": {"properties": {
+        "query": {"type": "percolator"},
+        "message": {"type": "text"},
+        "level": {"type": "keyword"},
+    }}})
+    rules = [
+        ("r-error", {"query": {"match": {"message": "error"}}}),
+        ("r-crit", {"query": {"bool": {
+            "must": [{"match": {"message": "disk"}},
+                     {"term": {"level": "critical"}}]}}}),
+        ("r-all", {"query": {"match_all": {}}}),
+    ]
+    for doc_id, src in rules:
+        s, r = node.rest_controller.dispatch("PUT", f"/alerts/_doc/{doc_id}",
+                                             None, src)
+        assert s in (200, 201), r
+    do(node, "POST", "/alerts/_refresh")
+    return node
+
+
+def test_percolate_single_doc(perco):
+    r = do(perco, "POST", "/alerts/_search", body={"query": {"percolate": {
+        "field": "query",
+        "document": {"message": "an error occurred", "level": "warn"}}}})
+    assert ids(r) == ["r-all", "r-error"]
+
+
+def test_percolate_bool_rule(perco):
+    r = do(perco, "POST", "/alerts/_search", body={"query": {"percolate": {
+        "field": "query",
+        "document": {"message": "disk full", "level": "critical"}}}})
+    assert ids(r) == ["r-all", "r-crit"]
+
+
+def test_percolate_multiple_docs_slots(perco):
+    r = do(perco, "POST", "/alerts/_search", body={"query": {"percolate": {
+        "field": "query",
+        "documents": [
+            {"message": "all is fine"},
+            {"message": "error one"},
+            {"message": "another error"},
+        ]}}})
+    by_id = {h["_id"]: h for h in r["hits"]["hits"]}
+    assert by_id["r-error"]["fields"]["_percolator_document_slot"] == [1, 2]
+    assert by_id["r-all"]["fields"]["_percolator_document_slot"] == [0, 1, 2]
+
+
+def test_join_child_requires_routing(qa):
+    s, r = qa.rest_controller.dispatch(
+        "PUT", "/qa/_doc/a9", None,
+        {"text": "x", "join": {"name": "answer", "parent": "q1"}})
+    assert s == 400 and "routing" in str(r), r
+
+
+def test_percolator_rejects_invalid_query(perco):
+    s, r = perco.rest_controller.dispatch(
+        "PUT", "/alerts/_doc/bad", None,
+        {"query": {"no_such_query": {}}})
+    assert s == 400, r
+
+
+def test_percolate_does_not_mutate_mappings(perco):
+    before = do(perco, "GET", "/alerts/_mapping")
+    do(perco, "POST", "/alerts/_search", body={"query": {"percolate": {
+        "field": "query",
+        "document": {"message": "error", "brand_new_field": "x"}}}})
+    after = do(perco, "GET", "/alerts/_mapping")
+    assert before == after
+    assert "brand_new_field" not in str(after)
+
+
+def test_percolate_existing_doc_ref(perco):
+    do(perco, "PUT", "/messages", body={})
+    perco.rest_controller.dispatch("PUT", "/messages/_doc/m1", None,
+                                   {"message": "fatal error in system"})
+    do(perco, "POST", "/messages/_refresh")
+    r = do(perco, "POST", "/alerts/_search", body={"query": {"percolate": {
+        "field": "query", "index": "messages", "id": "m1"}}})
+    assert "r-error" in ids(r)
