@@ -272,6 +272,41 @@ class JoinFieldType(MappedFieldType):
             for p, c in self.relations.items()}}
 
 
+class GeoPointFieldType(MappedFieldType):
+    """Latitude/longitude point (ref: server GeoPointFieldMapper; parse
+    formats in common/geo/GeoUtils.parseGeoPoint: object, "lat,lon" string,
+    [lon, lat] array, geohash, WKT POINT).
+
+    Columnar layout: each point lands in two numeric doc-value columns
+    ``{field}.lat`` / ``{field}.lon`` so every geo predicate (distance,
+    bbox, polygon) is elementwise array math on device."""
+
+    type_name = "geo_point"
+    docvalue_kind = "geo"
+
+    def parse(self, value):
+        from elasticsearch_tpu.common.geo import parse_geo_point
+        return parse_geo_point(value)
+
+
+class GeoShapeFieldType(MappedFieldType):
+    """Arbitrary GeoJSON geometry (ref: x-pack spatial GeoShapeWithDocValuesFieldMapper
+    + server AbstractShapeGeometryFieldMapper). Indexed as its bounding box
+    in four numeric columns ``{field}.min_lat/.min_lon/.max_lat/.max_lon``;
+    relation predicates run bbox-level on device, with exact host
+    verification against the _source geometry for polygon relations."""
+
+    type_name = "geo_shape"
+    docvalue_kind = "geoshape"
+
+    def parse(self, value):
+        from elasticsearch_tpu.common.geo import shape_bbox
+        if isinstance(value, str):
+            raise MapperParsingException(
+                f"geo_shape [{self.name}]: WKT input not supported, use GeoJSON")
+        return shape_bbox(value)
+
+
 class PercolatorFieldType(MappedFieldType):
     """Stores a query for reverse search (ref: modules/percolator
     PercolatorFieldMapper — the query is kept in _source and re-parsed at
@@ -300,6 +335,7 @@ FIELD_TYPES = {
         ShortFieldType, ByteFieldType, DoubleFieldType, FloatFieldType,
         HalfFloatFieldType, BooleanFieldType, DateFieldType, IpFieldType,
         DenseVectorFieldType, JoinFieldType, PercolatorFieldType,
+        GeoPointFieldType, GeoShapeFieldType,
     ]
 }
 
@@ -418,13 +454,17 @@ class DocumentMapper:
         self._parse_object("", source, parsed)
         return parsed
 
-    def join_routing_required(self, source: Dict[str, Any]) -> Optional[str]:
-        """Name of the join field if `source` is a child doc (which MUST
-        be routed to its parent's shard; ref: parent-join routing_required
-        — ES rejects unrouted children with routing_missing_exception)."""
-        for path, ft in self.fields.items():
-            if not isinstance(ft, JoinFieldType):
-                continue
+    def join_parent_routing(self, source: Dict[str, Any]) -> Optional[str]:
+        """Parent id if `source` is a child doc. Children MUST live on
+        their parent's shard (ref: parent-join routing_required — ES
+        rejects unrouted children with routing_missing_exception; here the
+        parent id is derived as the routing key instead, which colocates
+        the child with a default-routed parent and keeps internal re-index
+        paths — _update_by_query, _reindex, shrink — working on join
+        indices). O(1) when the mapping has no join field."""
+        if not self._join_fields:
+            return None
+        for path in self._join_fields:
             cur: Any = source
             for part in path.split("."):
                 if not isinstance(cur, dict) or part not in cur:
@@ -432,8 +472,18 @@ class DocumentMapper:
                     break
                 cur = cur[part]
             if isinstance(cur, dict) and cur.get("parent") is not None:
-                return path
+                return str(cur["parent"])
         return None
+
+    @property
+    def _join_fields(self) -> List[str]:
+        cached = self.__dict__.get("_join_fields_cache")
+        if cached is None or cached[0] != len(self.fields):
+            cached = (len(self.fields),
+                      [p for p, ft in self.fields.items()
+                       if isinstance(ft, JoinFieldType)])
+            self.__dict__["_join_fields_cache"] = cached
+        return cached[1]
 
     def _parse_object(self, prefix: str, obj: Dict[str, Any], parsed: ParsedDocument):
         for key, value in obj.items():
@@ -463,6 +513,14 @@ class DocumentMapper:
                 continue
             if ft_pre is not None and isinstance(ft_pre, PercolatorFieldType):
                 ft_pre.parse(value)  # validate shape; query stays in _source
+                continue
+            if ft_pre is not None and ft_pre.docvalue_kind in ("geo", "geoshape"):
+                if ft_pre.docvalue_kind == "geo":
+                    from elasticsearch_tpu.common.geo import is_point_value
+                    values = [value] if is_point_value(value) else list(value)
+                else:
+                    values = [value] if isinstance(value, dict) else list(value)
+                self._index_values(ft_pre, values, parsed)
                 continue
             if isinstance(value, dict):
                 self._parse_object(f"{path}.", value, parsed)
@@ -523,6 +581,16 @@ class DocumentMapper:
                 parsed.keyword_terms.setdefault(ft.name, []).append(typed)
             elif ft.docvalue_kind == "numeric":
                 parsed.numeric_values.setdefault(ft.name, []).append(float(typed))
+            elif ft.docvalue_kind == "geo":
+                lat, lon = typed
+                parsed.numeric_values.setdefault(f"{ft.name}.lat", []).append(lat)
+                parsed.numeric_values.setdefault(f"{ft.name}.lon", []).append(lon)
+            elif ft.docvalue_kind == "geoshape":
+                min_lat, min_lon, max_lat, max_lon = typed
+                for suffix, v in (("min_lat", min_lat), ("min_lon", min_lon),
+                                  ("max_lat", max_lat), ("max_lon", max_lon)):
+                    parsed.numeric_values.setdefault(
+                        f"{ft.name}.{suffix}", []).append(v)
             elif ft.docvalue_kind == "vector":
                 parsed.vectors[ft.name] = typed
                 parsed.vector_similarity[ft.name] = ft.similarity

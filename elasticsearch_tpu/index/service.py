@@ -117,14 +117,9 @@ class IndexService:
     def index_doc(self, doc_id: str, source: Dict[str, Any],
                   routing: Optional[str] = None, **kwargs):
         if routing is None:
-            jf = self.mapper.mapper.join_routing_required(source)
-            if jf is not None:
-                from elasticsearch_tpu.common.errors import (
-                    IllegalArgumentException)
-                raise IllegalArgumentException(
-                    f"routing is required for [{self.name}]/[{doc_id}]: a "
-                    f"[{jf}] child document must be routed to its parent's "
-                    f"shard")
+            # child docs route by parent id so they land on the parent's
+            # shard (see DocumentMapper.join_parent_routing)
+            routing = self.mapper.mapper.join_parent_routing(source)
         shard = self.shards[self.shard_for(doc_id, routing)]
         n_fields = len(self.mapper.mapper.fields)
         result = shard.index(doc_id, source, **kwargs)

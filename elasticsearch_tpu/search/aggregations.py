@@ -37,9 +37,11 @@ CollectCtx = List[Tuple[Any, np.ndarray, Any]]
 
 METRIC_AGGS = {"avg", "sum", "min", "max", "value_count", "stats",
                "extended_stats", "cardinality", "percentiles",
-               "percentile_ranks", "top_hits", "weighted_avg"}
+               "percentile_ranks", "top_hits", "weighted_avg",
+               "geo_bounds", "geo_centroid"}
 BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "filter",
-               "filters", "missing", "global"}
+               "filters", "missing", "global",
+               "geo_distance", "geohash_grid", "geotile_grid"}
 PIPELINE_AGGS = {"avg_bucket", "sum_bucket", "min_bucket", "max_bucket",
                  "stats_bucket", "cumulative_sum", "derivative", "bucket_sort"}
 
@@ -147,6 +149,22 @@ def _keyword_membership_mask(seg, field: str, term: str) -> np.ndarray:
     return out
 
 
+def _geo_points(ctx: CollectCtx, field: str):
+    """(lats, lons) of masked docs' first point values across segments."""
+    lat_chunks, lon_chunks = [], []
+    for seg, mask, _m in ctx:
+        nlat = seg.numerics.get(f"{field}.lat")
+        nlon = seg.numerics.get(f"{field}.lon")
+        if nlat is None or nlon is None:
+            continue
+        m = mask[: seg.n_docs] & ~nlat.missing
+        lat_chunks.append(nlat.values[m])
+        lon_chunks.append(nlon.values[m])
+    if not lat_chunks:
+        return np.zeros(0), np.zeros(0)
+    return np.concatenate(lat_chunks), np.concatenate(lon_chunks)
+
+
 # ---------------------------------------------------------------------------
 # metrics
 # ---------------------------------------------------------------------------
@@ -154,6 +172,25 @@ def _keyword_membership_mask(seg, field: str, term: str) -> np.ndarray:
 def _metric(agg_type, body, ctx, mapper):
     field = body.get("field")
     missing_val = body.get("missing")
+
+    if agg_type == "geo_bounds":
+        # ref: metrics/GeoBoundsAggregator — envelope of all points
+        lats, lons = _geo_points(ctx, field)
+        if len(lats) == 0:
+            return {}
+        return {"bounds": {
+            "top_left": {"lat": float(lats.max()), "lon": float(lons.min())},
+            "bottom_right": {"lat": float(lats.min()), "lon": float(lons.max())},
+        }}
+
+    if agg_type == "geo_centroid":
+        # ref: metrics/GeoCentroidAggregator — arithmetic mean of points
+        lats, lons = _geo_points(ctx, field)
+        if len(lats) == 0:
+            return {"count": 0}
+        return {"location": {"lat": float(lats.mean()),
+                             "lon": float(lons.mean())},
+                "count": int(len(lats))}
 
     if agg_type == "top_hits":
         size = int(body.get("size", 3))
@@ -417,6 +454,83 @@ def _bucket(agg_type, body, sub, ctx, mapper):
                 extra["to"] = float(to)
             buckets.append(_bucket_result(sub, _refine(ctx, submasks), mapper,
                                           count, extra))
+        return {"buckets": buckets}
+
+    if agg_type == "geo_distance":
+        # ref: bucket/range/GeoDistanceAggregationBuilder — range buckets
+        # keyed by haversine distance from an origin
+        from elasticsearch_tpu.common.geo import (
+            haversine_meters, parse_geo_point, _UNITS)
+        field = body.get("field")
+        o_lat, o_lon = parse_geo_point(body.get("origin"))
+        unit = body.get("unit", "m")
+        scale = _UNITS.get(unit)
+        if scale is None:
+            raise IllegalArgumentException(
+                f"unknown distance unit [{unit}] for geo_distance aggregation")
+        buckets = []
+        for r in body.get("ranges", []):
+            frm = r.get("from")
+            to = r.get("to")
+            submasks = []
+            count = 0
+            for seg, mask, _m in ctx:
+                nlat = seg.numerics.get(f"{field}.lat")
+                nlon = seg.numerics.get(f"{field}.lon")
+                if nlat is None or nlon is None:
+                    submasks.append(np.zeros(seg.n_docs, bool))
+                    continue
+                dist = haversine_meters(nlat.values, nlon.values, o_lat, o_lon)
+                in_r = mask[: seg.n_docs] & ~nlat.missing
+                if frm is not None:
+                    in_r &= dist >= float(frm) * scale
+                if to is not None:
+                    in_r &= dist < float(to) * scale
+                submasks.append(in_r)
+                count += int(in_r.sum())
+            key = r.get("key", f"{frm if frm is not None else '*'}-"
+                               f"{to if to is not None else '*'}")
+            extra = {"key": key}
+            if frm is not None:
+                extra["from"] = float(frm)
+            if to is not None:
+                extra["to"] = float(to)
+            buckets.append(_bucket_result(sub, _refine(ctx, submasks), mapper,
+                                          count, extra))
+        return {"buckets": buckets}
+
+    if agg_type in ("geohash_grid", "geotile_grid"):
+        # ref: bucket/geogrid/GeoHashGridAggregator / GeoTileGridAggregator
+        from elasticsearch_tpu.common.geo import geohash_cells, geotile_cells
+        field = body.get("field")
+        default_p = 5 if agg_type == "geohash_grid" else 7
+        precision = int(body.get("precision", default_p))
+        size = int(body.get("size", 10000))
+        cell_fn = geohash_cells if agg_type == "geohash_grid" else geotile_cells
+        counts: Dict[str, int] = {}
+        per_seg_cells = []
+        for seg, mask, _m in ctx:
+            nlat = seg.numerics.get(f"{field}.lat")
+            nlon = seg.numerics.get(f"{field}.lon")
+            if nlat is None or nlon is None:
+                per_seg_cells.append(None)
+                continue
+            m = mask[: seg.n_docs] & ~nlat.missing
+            cells = np.full(seg.n_docs, "", f"U{max(precision, 16)}")
+            if m.any():
+                cells[m] = cell_fn(nlat.values[m], nlon.values[m], precision)
+            per_seg_cells.append(cells)
+            for c, n in zip(*np.unique(cells[m], return_counts=True)):
+                counts[str(c)] = counts.get(str(c), 0) + int(n)
+        top = sorted(counts.items(), key=lambda kv_: (-kv_[1], kv_[0]))[:size]
+        buckets = []
+        for cell, count in top:
+            submasks = [
+                (cells == cell) if cells is not None
+                else np.zeros(seg.n_docs, bool)
+                for (seg, _m2, _m3), cells in zip(ctx, per_seg_cells)]
+            buckets.append(_bucket_result(sub, _refine(ctx, submasks), mapper,
+                                          count, {"key": cell}))
         return {"buckets": buckets}
 
     raise IllegalArgumentException(f"unhandled bucket agg [{agg_type}]")

@@ -1510,6 +1510,175 @@ class SimpleQueryStringQuery(QueryBuilder):
 # NamedXContentRegistry)
 # ---------------------------------------------------------------------------
 
+class GeoDistanceQuery(QueryBuilder):
+    """Docs within `distance` of `origin` (ref: index/query/
+    GeoDistanceQueryBuilder). Haversine over the lat/lon doc-value columns —
+    one fused elementwise kernel, no per-doc iteration."""
+
+    name = "geo_distance"
+
+    def __init__(self, field: str, origin, distance):
+        super().__init__()
+        from elasticsearch_tpu.common.geo import parse_distance, parse_geo_point
+        self.field = field
+        self.lat, self.lon = parse_geo_point(origin)
+        self.meters = parse_distance(distance)
+
+    def do_execute(self, ctx):
+        from elasticsearch_tpu.common.geo import haversine_meters
+        lat, lat_miss = ctx.numeric_column(f"{self.field}.lat")
+        lon, _ = ctx.numeric_column(f"{self.field}.lon")
+        dist = haversine_meters(lat, lon, self.lat, self.lon, xp=jnp)
+        mask = (~lat_miss) & (dist <= self.meters) & ctx.all_true()
+        return mask.astype(jnp.float32), mask
+
+
+class GeoBoundingBoxQuery(QueryBuilder):
+    """ref: index/query/GeoBoundingBoxQueryBuilder; handles dateline-crossing
+    boxes (left > right)."""
+
+    name = "geo_bounding_box"
+
+    def __init__(self, field: str, top: float, left: float, bottom: float,
+                 right: float):
+        super().__init__()
+        self.field = field
+        self.top, self.left, self.bottom, self.right = top, left, bottom, right
+
+    def do_execute(self, ctx):
+        from elasticsearch_tpu.common.geo import bbox_contains
+        lat, lat_miss = ctx.numeric_column(f"{self.field}.lat")
+        lon, _ = ctx.numeric_column(f"{self.field}.lon")
+        mask = bbox_contains(lat, lon, self.top, self.left, self.bottom,
+                             self.right, xp=jnp)
+        mask = mask & (~lat_miss) & ctx.all_true()
+        return mask.astype(jnp.float32), mask
+
+
+class GeoPolygonQuery(QueryBuilder):
+    """Point-in-polygon filter (ref: index/query/GeoPolygonQueryBuilder,
+    deprecated-but-present in 8.0). Even-odd rule as masked elementwise ops
+    over all docs — O(docs x edges) brute force instead of a points tree."""
+
+    name = "geo_polygon"
+
+    def __init__(self, field: str, points):
+        super().__init__()
+        from elasticsearch_tpu.common.geo import parse_geo_point
+        self.field = field
+        pts = [parse_geo_point(p) for p in points]
+        if len(pts) < 3:
+            raise ParsingException(
+                "too few points defined for geo_polygon query")
+        self.poly_lats = [p[0] for p in pts]
+        self.poly_lons = [p[1] for p in pts]
+
+    def do_execute(self, ctx):
+        from elasticsearch_tpu.common.geo import points_in_polygon
+        lat, lat_miss = ctx.numeric_column(f"{self.field}.lat")
+        lon, _ = ctx.numeric_column(f"{self.field}.lon")
+        mask = points_in_polygon(lat, lon, self.poly_lats, self.poly_lons,
+                                 xp=jnp)
+        mask = mask & (~lat_miss) & ctx.all_true()
+        return mask.astype(jnp.float32), mask
+
+
+class GeoShapeQuery(QueryBuilder):
+    """Relation of indexed shapes to a query shape (ref: x-pack spatial
+    GeoShapeQueryBuilder). Runs at bbox precision over the four bbox
+    doc-value columns: exact for point/envelope/bbox-shaped docs, bounding
+    approximation for polygon interiors (documented deviation)."""
+
+    name = "geo_shape"
+
+    def __init__(self, field: str, shape: Dict[str, Any],
+                 relation: str = "intersects"):
+        super().__init__()
+        from elasticsearch_tpu.common.geo import shape_bbox
+        self.field = field
+        self.relation = relation.lower()
+        if self.relation not in ("intersects", "disjoint", "within", "contains"):
+            raise ParsingException(
+                f"invalid geo_shape relation [{relation}]")
+        (self.q_minlat, self.q_minlon,
+         self.q_maxlat, self.q_maxlon) = shape_bbox(shape)
+
+    def do_execute(self, ctx):
+        minlat, miss = ctx.numeric_column(f"{self.field}.min_lat")
+        minlon, _ = ctx.numeric_column(f"{self.field}.min_lon")
+        maxlat, _ = ctx.numeric_column(f"{self.field}.max_lat")
+        maxlon, _ = ctx.numeric_column(f"{self.field}.max_lon")
+        overlaps = ~((maxlat < self.q_minlat) | (minlat > self.q_maxlat)
+                     | (maxlon < self.q_minlon) | (minlon > self.q_maxlon))
+        if self.relation == "intersects":
+            mask = overlaps
+        elif self.relation == "disjoint":
+            mask = ~overlaps
+        elif self.relation == "within":
+            mask = ((minlat >= self.q_minlat) & (maxlat <= self.q_maxlat)
+                    & (minlon >= self.q_minlon) & (maxlon <= self.q_maxlon))
+        else:  # contains
+            mask = ((minlat <= self.q_minlat) & (maxlat >= self.q_maxlat)
+                    & (minlon <= self.q_minlon) & (maxlon >= self.q_maxlon))
+        mask = mask & (~miss) & ctx.all_true()
+        return mask.astype(jnp.float32), mask
+
+
+def _parse_geo_distance(spec):
+    opts = {k: v for k, v in spec.items()
+            if k in ("distance", "distance_type", "validation_method",
+                     "ignore_unmapped", "boost", "_name")}
+    fields = {k: v for k, v in spec.items() if k not in opts}
+    if len(fields) != 1:
+        raise ParsingException(
+            "[geo_distance] requires exactly one point field")
+    (field, origin), = fields.items()
+    return _with_boost(GeoDistanceQuery(field, origin, spec["distance"]), spec)
+
+
+def _parse_geo_bounding_box(spec):
+    from elasticsearch_tpu.common.geo import parse_geo_point
+    fields = {k: v for k, v in spec.items()
+              if k not in ("validation_method", "ignore_unmapped", "boost",
+                           "_name", "type")}
+    if len(fields) != 1:
+        raise ParsingException("[geo_bounding_box] requires one point field")
+    (field, box), = fields.items()
+    if "top_left" in box:
+        top, left = parse_geo_point(box["top_left"])
+        bottom, right = parse_geo_point(box["bottom_right"])
+    elif "wkt" in box:
+        raise ParsingException("[geo_bounding_box] WKT envelope unsupported")
+    else:
+        top, left = float(box["top"]), float(box["left"])
+        bottom, right = float(box["bottom"]), float(box["right"])
+    return _with_boost(GeoBoundingBoxQuery(field, top, left, bottom, right),
+                       spec)
+
+
+def _parse_geo_polygon(spec):
+    fields = {k: v for k, v in spec.items()
+              if k not in ("validation_method", "ignore_unmapped", "boost",
+                           "_name")}
+    if len(fields) != 1:
+        raise ParsingException("[geo_polygon] requires one point field")
+    (field, body), = fields.items()
+    return _with_boost(GeoPolygonQuery(field, body["points"]), spec)
+
+
+def _parse_geo_shape(spec):
+    fields = {k: v for k, v in spec.items()
+              if k not in ("ignore_unmapped", "boost", "_name")}
+    if len(fields) != 1:
+        raise ParsingException("[geo_shape] requires one shape field")
+    (field, body), = fields.items()
+    if "indexed_shape" in body:
+        raise ParsingException("[geo_shape] indexed_shape is unsupported")
+    return _with_boost(
+        GeoShapeQuery(field, body["shape"],
+                      relation=body.get("relation", "intersects")), spec)
+
+
 def parse_query(body: Dict[str, Any]) -> QueryBuilder:
     if not isinstance(body, dict) or len(body) != 1:
         raise ParsingException(
@@ -1775,6 +1944,10 @@ _PARSERS = {
     "script_score": _parse_script_score,
     "knn": _parse_knn,
     "function_score": _parse_function_score,
+    "geo_distance": _parse_geo_distance,
+    "geo_bounding_box": _parse_geo_bounding_box,
+    "geo_polygon": _parse_geo_polygon,
+    "geo_shape": _parse_geo_shape,
     "match_phrase": _parse_match_phrase,
     "match_phrase_prefix": _parse_match_phrase_prefix,
     "match_bool_prefix": _parse_match_bool_prefix,

@@ -376,9 +376,14 @@ class ShardSearcher:
 
 @dataclass
 class SortKey:
-    field: str           # "_score" | "_doc" | field name
+    field: str           # "_score" | "_doc" | "_geo_distance" | field name
     order: str           # "asc" | "desc"
     missing: float = 0.0
+    # _geo_distance extras (ref: search/sort/GeoDistanceSortBuilder)
+    geo_field: str = ""
+    geo_lat: float = 0.0
+    geo_lon: float = 0.0
+    geo_unit: str = "m"
 
 
 def _parse_sort(sort) -> Optional[List[SortKey]]:
@@ -397,6 +402,25 @@ def _parse_sort(sort) -> Optional[List[SortKey]]:
                 spec = {}
             else:
                 order = spec.get("order", "desc" if field_name == "_score" else "asc")
+            if field_name == "_geo_distance":
+                from elasticsearch_tpu.common.geo import parse_geo_point
+                field_entries = [
+                    (k, v) for k, v in spec.items()
+                    if k not in ("order", "unit", "mode", "distance_type",
+                                 "ignore_unmapped")]
+                if len(field_entries) != 1:
+                    from elasticsearch_tpu.common.errors import ParsingException
+                    raise ParsingException(
+                        "[_geo_distance] sort requires exactly one point "
+                        "field with an origin")
+                geo_field, origin = field_entries[0]
+                lat, lon = parse_geo_point(origin)
+                keys.append(SortKey("_geo_distance",
+                                    spec.get("order", "asc"),
+                                    geo_field=geo_field, geo_lat=lat,
+                                    geo_lon=lon,
+                                    geo_unit=spec.get("unit", "m")))
+                continue
         keys.append(SortKey(field_name, order))
     return keys
 
@@ -416,6 +440,15 @@ def _primary_sort_key(ctx: SegmentContext, scores, sort_spec) -> jnp.ndarray:
     if sk.field == "_doc":
         key = -jnp.arange(ctx.n_docs_padded, dtype=jnp.float32)
         return key if sk.order == "asc" else -key
+    if sk.field == "_geo_distance":
+        from elasticsearch_tpu.common.geo import haversine_meters
+        lat, miss = ctx.numeric_column(f"{sk.geo_field}.lat")
+        lon, _ = ctx.numeric_column(f"{sk.geo_field}.lon")
+        dist = haversine_meters(lat, lon, sk.geo_lat, sk.geo_lon, xp=jnp)
+        missing_val = jnp.float32(np.finfo(np.float32).max if sk.order == "asc"
+                                  else np.finfo(np.float32).min)
+        key = jnp.where(miss, missing_val, dist)
+        return -key if sk.order == "asc" else key
     col, miss = ctx.numeric_column(sk.field)
     missing_val = jnp.float32(np.finfo(np.float32).max if sk.order == "asc"
                               else np.finfo(np.float32).min)
@@ -433,6 +466,18 @@ def _sort_values(searcher, seg: Segment, docid: int, score: float,
             out.append(score)
         elif sk.field == "_doc":
             out.append(docid)
+        elif sk.field == "_geo_distance":
+            from elasticsearch_tpu.common.geo import (haversine_meters,
+                                                      meters_to_unit)
+            nlat = seg.numerics.get(f"{sk.geo_field}.lat")
+            nlon = seg.numerics.get(f"{sk.geo_field}.lon")
+            v = None
+            if nlat is not None and not nlat.missing[docid]:
+                meters = float(haversine_meters(
+                    float(nlat.values[docid]), float(nlon.values[docid]),
+                    sk.geo_lat, sk.geo_lon))
+                v = meters_to_unit(meters, sk.geo_unit)
+            out.append(v)
         else:
             nv = seg.numerics.get(sk.field)
             v = None
@@ -469,8 +514,17 @@ def _search_after_mask(ctx: SegmentContext, scores, sort_spec,
         tied = primary == after_val
     else:
         sk = sort_spec[0]
-        col, miss = ctx.numeric_column(sk.field)
-        after_val = float(after[0])
+        if sk.field == "_geo_distance":
+            from elasticsearch_tpu.common.geo import (haversine_meters,
+                                                      meters_to_unit)
+            lat, miss = ctx.numeric_column(f"{sk.geo_field}.lat")
+            lon, _ = ctx.numeric_column(f"{sk.geo_field}.lon")
+            # sort values travel in the requested unit; compare in meters
+            col = haversine_meters(lat, lon, sk.geo_lat, sk.geo_lon, xp=jnp)
+            after_val = float(after[0]) / meters_to_unit(1.0, sk.geo_unit)
+        else:
+            col, miss = ctx.numeric_column(sk.field)
+            after_val = float(after[0])
         if sk.order == "asc":
             strictly = (~miss) & (col > after_val)
             tied = (~miss) & (col == after_val)

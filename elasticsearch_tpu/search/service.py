@@ -395,6 +395,8 @@ class SearchService:
         # query-computed hit decorations (percolator document slots) — the
         # percolate query may be nested inside compounds
         decorators = _collect_decorators(query)
+        if post_filter is not None:
+            decorators = decorators + _collect_decorators(post_filter)
         for q in decorators:
             for hit in hits:
                 q.add_hit_fields(hit)

@@ -1,0 +1,307 @@
+"""Geo layer tests: point parsing, distance/bbox/polygon/shape queries,
+geo aggs, geo sort (model: the reference's GeoDistanceQueryBuilderTests,
+GeoBoundingBoxQueryBuilderTests, GeoHashGridAggregatorTests)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ParsingException,
+)
+from elasticsearch_tpu.common.geo import (
+    bbox_contains,
+    geohash_decode,
+    geohash_encode,
+    haversine_meters,
+    parse_distance,
+    parse_geo_point,
+    points_in_polygon,
+    shape_bbox,
+)
+from elasticsearch_tpu.index.mapper import MapperService
+from elasticsearch_tpu.index.segment import SegmentWriter
+from elasticsearch_tpu.ops.device import DeviceSegment
+from elasticsearch_tpu.search.aggregations import compute_aggs
+from elasticsearch_tpu.search.context import SegmentContext, ShardStats
+from elasticsearch_tpu.search.queries import parse_query
+
+MAPPINGS = {
+    "properties": {
+        "name": {"type": "keyword"},
+        "location": {"type": "geo_point"},
+        "area": {"type": "geo_shape"},
+    }
+}
+
+# real city coordinates make the distance assertions meaningful
+CITIES = [
+    {"name": "london", "location": {"lat": 51.5074, "lon": -0.1278}},
+    {"name": "paris", "location": "48.8566,2.3522"},
+    {"name": "berlin", "location": [13.4050, 52.5200]},        # [lon, lat]
+    {"name": "sf", "location": {"lat": 37.7749, "lon": -122.4194}},
+    {"name": "noloc"},
+    {"name": "poly", "area": {"type": "polygon", "coordinates": [
+        [[0.0, 0.0], [10.0, 0.0], [10.0, 10.0], [0.0, 10.0], [0.0, 0.0]]]}},
+]
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    svc = MapperService(mappings=MAPPINGS)
+    w = SegmentWriter()
+    for i, d in enumerate(CITIES):
+        w.add(svc.parse(str(i), d))
+    seg = w.build("s0")
+    return SegmentContext(seg, DeviceSegment(seg), svc, ShardStats([seg]))
+
+
+def matching(ctx, query_dict):
+    q = parse_query(query_dict)
+    _, mask = q.execute(ctx)
+    return set(np.nonzero(np.asarray(mask)[: ctx.segment.n_docs])[0].tolist())
+
+
+# ---- parsing ----
+
+def test_parse_geo_point_formats():
+    assert parse_geo_point({"lat": 1.0, "lon": 2.0}) == (1.0, 2.0)
+    assert parse_geo_point("1.0,2.0") == (1.0, 2.0)
+    assert parse_geo_point([2.0, 1.0]) == (1.0, 2.0)  # [lon, lat]
+    assert parse_geo_point("POINT (2.0 1.0)") == (1.0, 2.0)
+    lat, lon = parse_geo_point(geohash_encode(1.0, 2.0, 9))
+    assert abs(lat - 1.0) < 1e-3 and abs(lon - 2.0) < 1e-3
+
+
+def test_parse_geo_point_errors():
+    with pytest.raises(IllegalArgumentException):
+        parse_geo_point({"lat": 91.0, "lon": 0.0})
+    with pytest.raises(IllegalArgumentException):
+        parse_geo_point({"lat": 0.0, "lon": 181.0})
+    with pytest.raises(ParsingException):
+        parse_geo_point({"lat": 1.0})
+
+
+def test_parse_distance_units():
+    assert parse_distance("1km") == 1000.0
+    assert parse_distance("1mi") == pytest.approx(1609.344)
+    assert parse_distance(500) == 500.0
+    assert parse_distance("2.5m") == 2.5
+    with pytest.raises(ParsingException):
+        parse_distance("10lightyears")
+
+
+def test_geohash_roundtrip():
+    for lat, lon in [(51.5, -0.12), (-33.86, 151.2), (0.0, 0.0)]:
+        h = geohash_encode(lat, lon, 12)
+        dlat, dlon = geohash_decode(h)
+        assert abs(dlat - lat) < 1e-5
+        assert abs(dlon - lon) < 1e-5
+
+
+def test_haversine_known_distance():
+    # London -> Paris ≈ 344 km
+    d = haversine_meters(51.5074, -0.1278, 48.8566, 2.3522)
+    assert 330_000 < d < 360_000
+
+
+def test_points_in_polygon():
+    lats = np.array([5.0, 15.0, -1.0, 9.9])
+    lons = np.array([5.0, 5.0, 5.0, 9.9])
+    poly_lats = [0.0, 0.0, 10.0, 10.0]
+    poly_lons = [0.0, 10.0, 10.0, 0.0]
+    inside = points_in_polygon(lats, lons, poly_lats, poly_lons)
+    assert inside.tolist() == [True, False, False, True]
+
+
+def test_shape_bbox():
+    assert shape_bbox({"type": "point", "coordinates": [2.0, 1.0]}) == \
+        (1.0, 2.0, 1.0, 2.0)
+    assert shape_bbox({"type": "envelope",
+                       "coordinates": [[-1.0, 5.0], [3.0, -2.0]]}) == \
+        (-2.0, -1.0, 5.0, 3.0)
+    b = shape_bbox({"type": "polygon", "coordinates": [
+        [[0.0, 0.0], [10.0, 0.0], [10.0, 10.0], [0.0, 0.0]]]})
+    assert b == (0.0, 0.0, 10.0, 10.0)
+
+
+# ---- queries ----
+
+def test_geo_distance_query(ctx):
+    # 500 km around London: London + Paris
+    hits = matching(ctx, {"geo_distance": {
+        "distance": "500km", "location": {"lat": 51.5074, "lon": -0.1278}}})
+    assert hits == {0, 1}
+
+
+def test_geo_distance_query_excludes_missing(ctx):
+    hits = matching(ctx, {"geo_distance": {
+        "distance": "25000km", "location": {"lat": 0, "lon": 0}}})
+    assert 4 not in hits          # no location field
+    assert {0, 1, 2, 3} <= hits
+
+
+def test_geo_bounding_box_query(ctx):
+    # box around continental europe
+    hits = matching(ctx, {"geo_bounding_box": {"location": {
+        "top_left": {"lat": 55.0, "lon": 0.0},
+        "bottom_right": {"lat": 45.0, "lon": 15.0}}}})
+    assert hits == {1, 2}
+
+
+def test_geo_bounding_box_dateline(ctx):
+    # box crossing the antimeridian includes SF (lon -122)
+    hits = matching(ctx, {"geo_bounding_box": {"location": {
+        "top": 60.0, "left": 150.0, "bottom": 30.0, "right": -110.0}}})
+    assert hits == {3}
+
+
+def test_geo_polygon_query(ctx):
+    # triangle around Paris
+    hits = matching(ctx, {"geo_polygon": {"location": {"points": [
+        {"lat": 50.0, "lon": 0.0}, {"lat": 50.0, "lon": 5.0},
+        {"lat": 47.0, "lon": 2.0}]}}})
+    assert hits == {1}
+
+
+def test_geo_shape_query_intersects(ctx):
+    hits = matching(ctx, {"geo_shape": {"area": {
+        "shape": {"type": "envelope",
+                  "coordinates": [[5.0, 8.0], [15.0, 2.0]]},
+        "relation": "intersects"}}})
+    assert hits == {5}
+
+
+def test_geo_shape_query_disjoint(ctx):
+    hits = matching(ctx, {"geo_shape": {"area": {
+        "shape": {"type": "envelope",
+                  "coordinates": [[20.0, 30.0], [25.0, 25.0]]},
+        "relation": "disjoint"}}})
+    assert hits == {5}
+
+
+def test_geo_shape_query_within(ctx):
+    hits = matching(ctx, {"geo_shape": {"area": {
+        "shape": {"type": "envelope",
+                  "coordinates": [[-5.0, 15.0], [15.0, -5.0]]},
+        "relation": "within"}}})
+    assert hits == {5}
+
+
+# ---- aggs ----
+
+def _agg_ctx(ctx):
+    seg = ctx.segment
+    mask = np.ones(seg.n_docs, bool)
+    return [(seg, mask, ctx.mapper)]
+
+
+def test_geo_distance_agg(ctx):
+    out = compute_aggs({"rings": {"geo_distance": {
+        "field": "location", "origin": "51.5074,-0.1278", "unit": "km",
+        "ranges": [{"to": 100}, {"from": 100, "to": 1000},
+                   {"from": 1000}]}}}, _agg_ctx(ctx), ctx.mapper)
+    b = out["rings"]["buckets"]
+    assert b[0]["doc_count"] == 1          # london
+    assert b[1]["doc_count"] == 2          # paris, berlin
+    assert b[2]["doc_count"] == 1          # sf
+
+
+def test_geohash_grid_agg(ctx):
+    out = compute_aggs({"cells": {"geohash_grid": {
+        "field": "location", "precision": 3}}}, _agg_ctx(ctx), ctx.mapper)
+    buckets = out["cells"]["buckets"]
+    assert sum(b["doc_count"] for b in buckets) == 4
+    keys = {b["key"] for b in buckets}
+    from elasticsearch_tpu.common.geo import geohash_encode as ge
+    assert ge(51.5074, -0.1278, 3) in keys
+
+
+def test_geotile_grid_agg(ctx):
+    out = compute_aggs({"cells": {"geotile_grid": {
+        "field": "location", "precision": 4}}}, _agg_ctx(ctx), ctx.mapper)
+    buckets = out["cells"]["buckets"]
+    assert sum(b["doc_count"] for b in buckets) == 4
+    assert all(b["key"].startswith("4/") for b in buckets)
+
+
+def test_geo_bounds_agg(ctx):
+    out = compute_aggs({"box": {"geo_bounds": {"field": "location"}}},
+                       _agg_ctx(ctx), ctx.mapper)
+    b = out["box"]["bounds"]
+    assert b["top_left"]["lat"] == pytest.approx(52.52, abs=0.01)
+    assert b["top_left"]["lon"] == pytest.approx(-122.4194, abs=0.01)
+
+
+def test_geo_centroid_agg(ctx):
+    out = compute_aggs({"c": {"geo_centroid": {"field": "location"}}},
+                       _agg_ctx(ctx), ctx.mapper)
+    assert out["c"]["count"] == 4
+    assert -90 <= out["c"]["location"]["lat"] <= 90
+
+
+# ---- sort ----
+
+def test_geo_distance_sort():
+    from elasticsearch_tpu.search.searcher import ShardSearcher
+
+    svc = MapperService(mappings=MAPPINGS)
+    w = SegmentWriter()
+    for i, d in enumerate(CITIES[:4]):
+        w.add(svc.parse(str(i), d))
+    seg = w.build("s0")
+    searcher = ShardSearcher([seg], svc)
+    q = parse_query({"match_all": {}})
+    result = searcher.query_phase(
+        q, size=4,
+        sort=[{"_geo_distance": {"location": {"lat": 51.5, "lon": -0.12},
+                                 "order": "asc", "unit": "km"}}])
+    docs = result.docs
+    ids = [d.docid for d in docs]
+    assert ids == [0, 1, 2, 3]   # london, paris, berlin, sf
+    # sort values are distances in km, ascending
+    dists = [d.sort_values[0] for d in docs]
+    assert dists[0] < 5
+    assert 300 < dists[1] < 400
+    assert dists == sorted(dists)
+
+
+def test_geo_distance_sort_search_after():
+    """search_after pagination with a _geo_distance sort (regression: the
+    cursor column used to resolve to a missing numeric field → zero hits)."""
+    from elasticsearch_tpu.search.searcher import ShardSearcher
+
+    svc = MapperService(mappings=MAPPINGS)
+    w = SegmentWriter()
+    for i, d in enumerate(CITIES[:4]):
+        w.add(svc.parse(str(i), d))
+    seg = w.build("s0")
+    searcher = ShardSearcher([seg], svc)
+    sort = [{"_geo_distance": {"location": "51.5,-0.12", "order": "asc",
+                               "unit": "km"}}]
+    q = parse_query({"match_all": {}})
+    page1 = searcher.query_phase(q, size=2, sort=sort)
+    assert [d.docid for d in page1.docs] == [0, 1]
+    after = list(page1.docs[-1].sort_values)
+    page2 = searcher.query_phase(q, size=2, sort=sort, search_after=after)
+    assert [d.docid for d in page2.docs] == [2, 3]
+
+
+def test_geo_distance_sort_missing_field_is_parse_error():
+    from elasticsearch_tpu.search.searcher import _parse_sort
+    with pytest.raises(ParsingException):
+        _parse_sort([{"_geo_distance": {"order": "asc"}}])
+
+
+def test_geo_distance_agg_unknown_unit(ctx):
+    with pytest.raises(IllegalArgumentException):
+        compute_aggs({"rings": {"geo_distance": {
+            "field": "location", "origin": "0,0", "unit": "lightyears",
+            "ranges": [{"to": 1}]}}}, _agg_ctx(ctx), ctx.mapper)
+
+
+def test_geo_point_multi_value():
+    svc = MapperService(mappings=MAPPINGS)
+    parsed = svc.parse("0", {"location": [[2.0, 1.0], [4.0, 3.0]]})
+    assert parsed.numeric_values["location.lat"] == [1.0, 3.0]
+    assert parsed.numeric_values["location.lon"] == [2.0, 4.0]

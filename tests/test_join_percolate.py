@@ -168,11 +168,16 @@ def test_percolate_multiple_docs_slots(perco):
     assert by_id["r-all"]["fields"]["_percolator_document_slot"] == [0, 1, 2]
 
 
-def test_join_child_requires_routing(qa):
+def test_join_child_routes_to_parent_shard(qa):
+    # unrouted child docs derive routing from the parent id, so they land
+    # on the parent's shard (keeping _update_by_query/_reindex usable on
+    # join indices; ES instead rejects with routing_missing_exception)
     s, r = qa.rest_controller.dispatch(
         "PUT", "/qa/_doc/a9", None,
         {"text": "x", "join": {"name": "answer", "parent": "q1"}})
-    assert s == 400 and "routing" in str(r), r
+    assert s in (200, 201), r
+    idx = qa.indices_service.get("qa")
+    assert idx.shard_for("a9", routing="q1") == idx.shard_for("q1")
 
 
 def test_percolator_rejects_invalid_query(perco):
