@@ -20,7 +20,7 @@ import json
 import re
 import threading
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -307,6 +307,212 @@ class GeoShapeFieldType(MappedFieldType):
         return shape_bbox(value)
 
 
+class _RangeFieldType(MappedFieldType):
+    """Base for range field types (ref: server RangeFieldMapper — stores
+    [lo, hi] intervals queried by relation). Columnar layout: two numeric
+    columns ``{field}.lo`` / ``{field}.hi`` so relation predicates are
+    elementwise interval comparisons."""
+
+    docvalue_kind = "range"
+    value_type: MappedFieldType = None  # set per subclass
+
+    def parse(self, value):
+        if not isinstance(value, dict):
+            raise MapperParsingException(
+                f"error parsing field [{self.name}]: expected an object with "
+                f"gt/gte/lt/lte bounds")
+        vt = self.value_type(self.name)
+        lo, hi = -np.inf, np.inf
+        for k, v in value.items():
+            if k in ("gte", "from"):
+                lo = float(vt.parse(v))
+            elif k == "gt":
+                lo = np.nextafter(float(vt.parse(v)), np.inf)
+            elif k in ("lte", "to"):
+                hi = float(vt.parse(v))
+            elif k == "lt":
+                hi = np.nextafter(float(vt.parse(v)), -np.inf)
+            else:
+                raise MapperParsingException(
+                    f"error parsing field [{self.name}]: unknown bound [{k}]")
+        return (lo, hi)
+
+
+class IntegerRangeFieldType(_RangeFieldType):
+    type_name = "integer_range"
+class LongRangeFieldType(_RangeFieldType):
+    type_name = "long_range"
+class FloatRangeFieldType(_RangeFieldType):
+    type_name = "float_range"
+class DoubleRangeFieldType(_RangeFieldType):
+    type_name = "double_range"
+class DateRangeFieldType(_RangeFieldType):
+    type_name = "date_range"
+class IpRangeFieldType(_RangeFieldType):
+    type_name = "ip_range"
+
+
+class WildcardFieldType(KeywordFieldType):
+    """ref: x-pack wildcard field — keyword-like, optimized for mid-string
+    wildcard matching (the reference accelerates with an ngram index; here
+    the term dictionary scan in the wildcard/regexp queries serves, since
+    term scans are columnar batch ops not per-doc iterations)."""
+
+    type_name = "wildcard"
+
+
+class ConstantKeywordFieldType(MappedFieldType):
+    """ref: x-pack mapper-constant-keyword — one value for every doc of the
+    index; docs may omit it, supplying a different value is rejected.
+    Handled at query time (term/exists match all docs), nothing indexed."""
+
+    type_name = "constant_keyword"
+    docvalue_kind = "constant"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.value = self.params.get("value")
+
+    def parse(self, value):
+        if self.value is None:
+            # first supplied value pins the constant (as in the reference)
+            self.value = str(value)
+            self.params["value"] = self.value
+            return None
+        if str(value) != self.value:
+            raise MapperParsingException(
+                f"[constant_keyword] field [{self.name}] only accepts values "
+                f"that are equal to the value defined in the mappings "
+                f"[{self.value}], but got [{value}]")
+        return None
+
+
+class RankFeatureFieldType(MappedFieldType):
+    """ref: modules/mapper-extras RankFeatureFieldMapper — a positive float
+    feature consumed by the rank_feature query (sat/log/sigmoid score)."""
+
+    type_name = "rank_feature"
+    docvalue_kind = "numeric"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.positive_score_impact = bool(
+            self.params.get("positive_score_impact", True))
+
+    def parse(self, value):
+        v = float(value)
+        if v <= 0:
+            raise MapperParsingException(
+                f"[rank_feature] fields do not support negative or zero "
+                f"values, got [{v}] for field [{self.name}]")
+        return v
+
+
+class RankFeaturesFieldType(MappedFieldType):
+    """ref: RankFeaturesFieldMapper — a sparse map of feature -> positive
+    float; each key lands in its own numeric column ``{field}.{key}``."""
+
+    type_name = "rank_features"
+    docvalue_kind = "rank_features"
+
+    def parse(self, value):
+        if not isinstance(value, dict):
+            raise MapperParsingException(
+                f"[rank_features] field [{self.name}] expects an object")
+        out = {}
+        for k, v in value.items():
+            if float(v) <= 0:
+                raise MapperParsingException(
+                    f"[rank_features] fields do not support negative or "
+                    f"zero values, got [{v}] for feature [{k}]")
+            out[str(k)] = float(v)
+        return out
+
+
+class FlattenedFieldType(MappedFieldType):
+    """ref: x-pack mapper-flattened FlatObjectFieldMapper — a whole JSON
+    object indexed as keyword terms: the root field matches any leaf value,
+    ``{field}.{path}`` matches that key's values."""
+
+    type_name = "flattened"
+    docvalue_kind = "flattened"
+
+    def parse(self, value):
+        if not isinstance(value, dict):
+            raise MapperParsingException(
+                f"[flattened] field [{self.name}] expects an object")
+        leaves: List[Tuple[str, str]] = []
+
+        def walk(obj, prefix=""):
+            for k, v in obj.items():
+                p = f"{prefix}{k}"
+                if isinstance(v, dict):
+                    walk(v, f"{p}.")
+                elif isinstance(v, list):
+                    for item in v:
+                        if isinstance(item, dict):
+                            walk(item, f"{p}.")
+                        else:
+                            leaves.append((p, str(item)))
+                else:
+                    leaves.append((p, str(v)))
+
+        walk(value)
+        return leaves
+
+
+class TokenCountFieldType(MappedFieldType):
+    """ref: modules/mapper-extras TokenCountFieldMapper — indexes the
+    number of analyzed tokens as a numeric column."""
+
+    type_name = "token_count"
+    docvalue_kind = "token_count"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.analyzer_name = self.params.get("analyzer", "standard")
+
+    def parse(self, value):
+        return str(value)
+
+
+class Murmur3FieldType(MappedFieldType):
+    """ref: plugins/mapper-murmur3 — stores the murmur3 hash of the value
+    for cheap cardinality estimation."""
+
+    type_name = "murmur3"
+    docvalue_kind = "numeric"
+
+    def parse(self, value):
+        from elasticsearch_tpu.index.service import murmur3_hash
+        return float(murmur3_hash(str(value)))
+
+
+class SearchAsYouTypeFieldType(TextFieldType):
+    """ref: modules/mapper-extras SearchAsYouTypeFieldMapper — a text field
+    with shingle subfields ``._2gram`` / ``._3gram`` and an
+    ``._index_prefix`` edge-ngram field feeding match_bool_prefix."""
+
+    type_name = "search_as_you_type"
+    docvalue_kind = "postings"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.max_shingle_size = int(self.params.get("max_shingle_size", 3))
+
+
+class ShingleSubFieldType(TextFieldType):
+    """Synthetic ``._Ngram`` subfield of search_as_you_type: queries analyze
+    with the base analyzer then shingle to width N (not user-mappable,
+    excluded from to_mapping)."""
+
+    type_name = "text"
+
+    def __init__(self, name, shingle_size: int, params=None):
+        super().__init__(name, params)
+        self.shingle_size = shingle_size
+
+
 class PercolatorFieldType(MappedFieldType):
     """Stores a query for reverse search (ref: modules/percolator
     PercolatorFieldMapper — the query is kept in _source and re-parsed at
@@ -336,8 +542,20 @@ FIELD_TYPES = {
         HalfFloatFieldType, BooleanFieldType, DateFieldType, IpFieldType,
         DenseVectorFieldType, JoinFieldType, PercolatorFieldType,
         GeoPointFieldType, GeoShapeFieldType,
+        IntegerRangeFieldType, LongRangeFieldType, FloatRangeFieldType,
+        DoubleRangeFieldType, DateRangeFieldType, IpRangeFieldType,
+        WildcardFieldType, ConstantKeywordFieldType, RankFeatureFieldType,
+        RankFeaturesFieldType, TokenCountFieldType, Murmur3FieldType,
+        SearchAsYouTypeFieldType, FlattenedFieldType,
     ]
 }
+
+IntegerRangeFieldType.value_type = IntegerFieldType
+LongRangeFieldType.value_type = LongFieldType
+FloatRangeFieldType.value_type = FloatFieldType
+DoubleRangeFieldType.value_type = DoubleFieldType
+DateRangeFieldType.value_type = DateFieldType
+IpRangeFieldType.value_type = IpFieldType
 
 
 # ---------------------------------------------------------------------------
@@ -415,11 +633,20 @@ class DocumentMapper:
                 raise MapperParsingException(
                     f"No handler for type [{type_name}] declared on field [{name}]")
             params = {k: v for k, v in conf.items() if k != "type"}
-            self.fields[path] = cls(path, params)
+            ft = cls(path, params)
+            self.fields[path] = ft
+            if isinstance(ft, SearchAsYouTypeFieldType):
+                for n in range(2, ft.max_shingle_size + 1):
+                    sub = f"{path}._{n}gram"
+                    self.fields[sub] = ShingleSubFieldType(sub, n)
+                pre = f"{path}._index_prefix"
+                self.fields[pre] = KeywordFieldType(pre)
 
     def to_mapping(self) -> Dict[str, Any]:
         props: Dict[str, Any] = {}
         for path, ft in sorted(self.fields.items()):
+            if isinstance(ft, ShingleSubFieldType) or path.endswith("._index_prefix"):
+                continue  # synthetic search_as_you_type subfields
             node = props
             parts = path.split(".")
             for p in parts[:-1]:
@@ -514,12 +741,15 @@ class DocumentMapper:
             if ft_pre is not None and isinstance(ft_pre, PercolatorFieldType):
                 ft_pre.parse(value)  # validate shape; query stays in _source
                 continue
-            if ft_pre is not None and ft_pre.docvalue_kind in ("geo", "geoshape"):
+            if ft_pre is not None and ft_pre.docvalue_kind in (
+                    "geo", "geoshape", "range", "rank_features", "flattened"):
+                # object-valued field types must not recurse as sub-objects
                 if ft_pre.docvalue_kind == "geo":
                     from elasticsearch_tpu.common.geo import is_point_value
                     values = [value] if is_point_value(value) else list(value)
                 else:
-                    values = [value] if isinstance(value, dict) else list(value)
+                    values = (list(value) if isinstance(value, (list, tuple))
+                              else [value])
                 self._index_values(ft_pre, values, parsed)
                 continue
             if isinstance(value, dict):
@@ -562,6 +792,24 @@ class DocumentMapper:
             if kw_ft is not None and isinstance(ft, TextFieldType):
                 self._index_values(kw_ft, values, parsed)
 
+    def _index_shingles(self, ft: "SearchAsYouTypeFieldType",
+                        toks: List[Token], parsed: ParsedDocument):
+        """Index ``._2gram``/``._3gram`` shingle subfields and the
+        ``._index_prefix`` edge-ngram field (ref: SearchAsYouTypeFieldMapper
+        shingle + prefix subfields feeding match_bool_prefix /
+        multi_match type bool_prefix)."""
+        terms = [t.term for t in toks]
+        for n in range(2, ft.max_shingle_size + 1):
+            sub = f"{ft.name}._{n}gram"
+            out = parsed.text_tokens.setdefault(sub, [])
+            base = out[-1].position + 100 if out else 0
+            for i in range(len(terms) - n + 1):
+                out.append(Token(" ".join(terms[i:i + n]), base + i, -1, -1))
+        prefixes = parsed.keyword_terms.setdefault(f"{ft.name}._index_prefix", [])
+        for term in terms:
+            for plen in range(1, min(len(term), 20) + 1):
+                prefixes.append(term[:plen])
+
     def _index_values(self, ft: MappedFieldType, values: List[Any],
                       parsed: ParsedDocument):
         for value in values:
@@ -575,12 +823,34 @@ class DocumentMapper:
                     ft.analyzer_name) else self.analysis.default
                 toks = parsed.text_tokens.setdefault(ft.name, [])
                 base = toks[-1].position + 100 if toks else 0  # position gap between values
-                for t in analyzer.analyze(typed):
-                    toks.append(Token(t.term, base + t.position, t.start_offset, t.end_offset))
+                new_toks = [Token(t.term, base + t.position, t.start_offset,
+                                  t.end_offset) for t in analyzer.analyze(typed)]
+                toks.extend(new_toks)
+                if isinstance(ft, SearchAsYouTypeFieldType):
+                    self._index_shingles(ft, new_toks, parsed)
             elif ft.docvalue_kind == "term":
                 parsed.keyword_terms.setdefault(ft.name, []).append(typed)
             elif ft.docvalue_kind == "numeric":
                 parsed.numeric_values.setdefault(ft.name, []).append(float(typed))
+            elif ft.docvalue_kind == "range":
+                lo, hi = typed
+                parsed.numeric_values.setdefault(f"{ft.name}.lo", []).append(lo)
+                parsed.numeric_values.setdefault(f"{ft.name}.hi", []).append(hi)
+            elif ft.docvalue_kind == "rank_features":
+                for feat, v in typed.items():
+                    parsed.numeric_values.setdefault(
+                        f"{ft.name}.{feat}", []).append(v)
+            elif ft.docvalue_kind == "flattened":
+                for path, term in typed:
+                    parsed.keyword_terms.setdefault(ft.name, []).append(term)
+                    parsed.keyword_terms.setdefault(
+                        f"{ft.name}.{path}", []).append(term)
+            elif ft.docvalue_kind == "token_count":
+                analyzer = (self.analysis.get(ft.analyzer_name)
+                            if self.analysis.has(ft.analyzer_name)
+                            else self.analysis.default)
+                parsed.numeric_values.setdefault(ft.name, []).append(
+                    float(len(analyzer.analyze(typed))))
             elif ft.docvalue_kind == "geo":
                 lat, lon = typed
                 parsed.numeric_values.setdefault(f"{ft.name}.lat", []).append(lat)

@@ -113,13 +113,19 @@ class MatchNoneQuery(QueryBuilder):
 
 
 def _analyze_terms(ctx: SegmentContext, field: str, text: str) -> List[str]:
+    from elasticsearch_tpu.index.mapper import ShingleSubFieldType
     ft = ctx.mapper.field_type(field)
     if isinstance(ft, TextFieldType):
         name = ft.search_analyzer_name
         analyzer = (ctx.mapper.analysis.get(name)
                     if ctx.mapper.analysis.has(name)
                     else ctx.mapper.analysis.default)
-        return analyzer.terms(text)
+        terms = analyzer.terms(text)
+        if isinstance(ft, ShingleSubFieldType):
+            n = ft.shingle_size
+            return [" ".join(terms[i:i + n])
+                    for i in range(len(terms) - n + 1)]
+        return terms
     # keyword/numeric fields: the term is the literal value
     return [str(text)]
 
@@ -246,8 +252,26 @@ class TermQuery(QueryBuilder):
         self.value = value
 
     def do_execute(self, ctx):
+        from elasticsearch_tpu.index.mapper import (ConstantKeywordFieldType,
+                                                    _RangeFieldType)
         ft = ctx.mapper.field_type(self.field)
-        if ft is None or isinstance(ft, (TextFieldType, KeywordFieldType)):
+        if isinstance(ft, ConstantKeywordFieldType):
+            # matches every doc of the index iff the value equals the constant
+            if ft.value is not None and str(self.value) == ft.value:
+                mask = ctx.all_true()
+            else:
+                mask = jnp.zeros(ctx.n_docs_padded, bool)
+            return mask.astype(jnp.float32), mask
+        if isinstance(ft, _RangeFieldType):
+            # point containment in the stored interval (ref: RangeFieldMapper
+            # term query semantics: ranges containing the value match)
+            v = float(ft.value_type(ft.name).parse(self.value))
+            lo, miss = ctx.numeric_column(f"{self.field}.lo")
+            hi, _ = ctx.numeric_column(f"{self.field}.hi")
+            mask = (~miss) & (lo <= v) & (v <= hi) & ctx.all_true()
+            return mask.astype(jnp.float32), mask
+        if (ft is None or isinstance(ft, (TextFieldType, KeywordFieldType))
+                or ft.docvalue_kind == "flattened"):
             dp = ctx.device.postings.get(self.field)
             if dp is None:
                 z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
@@ -258,7 +282,7 @@ class TermQuery(QueryBuilder):
             mask = bm25_ops.match_mask(
                 dp.block_docids, dp.block_tfs, jnp.asarray(sel),
                 ctx.n_docs_padded)
-            if isinstance(ft, KeywordFieldType) or ft is None:
+            if not isinstance(ft, TextFieldType):
                 doc_count, _ = ctx.stats.field_stats(self.field)
                 df = ctx.stats.doc_freq(self.field, term)
                 w = bm25_ops.idf(df, doc_count) if df else 0.0
@@ -308,16 +332,21 @@ class TermsQuery(QueryBuilder):
 class RangeQuery(QueryBuilder):
     name = "range"
 
-    def __init__(self, field: str, gte=None, gt=None, lte=None, lt=None):
+    def __init__(self, field: str, gte=None, gt=None, lte=None, lt=None,
+                 relation: str = "intersects"):
         super().__init__()
         self.field = field
         self.gte, self.gt, self.lte, self.lt = gte, gt, lte, lt
+        self.relation = relation.lower()
 
     def do_execute(self, ctx):
+        from elasticsearch_tpu.index.mapper import _RangeFieldType
         ft = ctx.mapper.field_type(self.field)
         if ft is None:
             z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
             return z, z.astype(bool)
+        if isinstance(ft, _RangeFieldType):
+            return self._execute_on_range_field(ctx, ft)
         parse = lambda v: float(ft.parse(v))  # noqa: E731
         col, miss = ctx.numeric_column(self.field)
         mask = (~miss) & ctx.all_true()
@@ -331,6 +360,34 @@ class RangeQuery(QueryBuilder):
             mask = mask & (col < parse(self.lt))
         return mask.astype(jnp.float32), mask
 
+    def _execute_on_range_field(self, ctx, ft) -> Result:
+        """Interval relation against range-typed fields (ref:
+        RangeFieldMapper + the range query `relation` param:
+        intersects | within | contains)."""
+        vt = ft.value_type(ft.name)
+        q_lo, q_hi = -np.inf, np.inf
+        if self.gte is not None:
+            q_lo = float(vt.parse(self.gte))
+        if self.gt is not None:
+            q_lo = np.nextafter(float(vt.parse(self.gt)), np.inf)
+        if self.lte is not None:
+            q_hi = float(vt.parse(self.lte))
+        if self.lt is not None:
+            q_hi = np.nextafter(float(vt.parse(self.lt)), -np.inf)
+        lo, miss = ctx.numeric_column(f"{self.field}.lo")
+        hi, _ = ctx.numeric_column(f"{self.field}.hi")
+        if self.relation == "within":
+            mask = (lo >= q_lo) & (hi <= q_hi)
+        elif self.relation == "contains":
+            mask = (lo <= q_lo) & (hi >= q_hi)
+        elif self.relation == "intersects":
+            mask = (lo <= q_hi) & (hi >= q_lo)
+        else:
+            raise ParsingException(
+                f"[range] unknown relation [{self.relation}]")
+        mask = mask & (~miss) & ctx.all_true()
+        return mask.astype(jnp.float32), mask
+
 
 class ExistsQuery(QueryBuilder):
     name = "exists"
@@ -340,8 +397,21 @@ class ExistsQuery(QueryBuilder):
         self.field = field
 
     def do_execute(self, ctx):
+        from elasticsearch_tpu.index.mapper import (ConstantKeywordFieldType,
+                                                    _RangeFieldType)
+        ft = ctx.mapper.field_type(self.field)
         dev = ctx.device
-        if self.field in dev.postings:
+        if isinstance(ft, ConstantKeywordFieldType):
+            # every doc of the index "has" the constant (ref: x-pack
+            # constant-keyword exists semantics)
+            mask = ctx.all_true()
+        elif isinstance(ft, _RangeFieldType):
+            _, miss = ctx.numeric_column(f"{self.field}.lo")
+            mask = (~miss) & ctx.all_true()
+        elif ft is not None and ft.docvalue_kind == "geo":
+            _, miss = ctx.numeric_column(f"{self.field}.lat")
+            mask = (~miss) & ctx.all_true()
+        elif self.field in dev.postings:
             lens = dev.postings[self.field].doc_lens
             mask = (lens > 0) & ctx.all_true()
         elif self.field in dev.numerics:
@@ -1510,6 +1580,57 @@ class SimpleQueryStringQuery(QueryBuilder):
 # NamedXContentRegistry)
 # ---------------------------------------------------------------------------
 
+class RankFeatureQuery(QueryBuilder):
+    """Score by a rank_feature(s) column (ref: modules/mapper-extras
+    RankFeatureQueryBuilder — saturation (default, pivot≈mean),
+    log, sigmoid, linear functions). Pure elementwise math over the
+    feature column; docs missing the feature don't match."""
+
+    name = "rank_feature"
+
+    def __init__(self, field: str, saturation=None, log=None, sigmoid=None,
+                 linear=None):
+        super().__init__()
+        self.field = field
+        self.saturation = saturation
+        self.log = log
+        self.sigmoid = sigmoid
+        self.linear = linear
+
+    def do_execute(self, ctx):
+        from elasticsearch_tpu.index.mapper import RankFeatureFieldType
+        col, miss = ctx.numeric_column(self.field)
+        mask = (~miss) & ctx.all_true()
+        ft = ctx.mapper.field_type(self.field)
+        positive = True
+        if isinstance(ft, RankFeatureFieldType):
+            positive = ft.positive_score_impact
+        feat = jnp.where(mask, col.astype(jnp.float32), 0.0)
+        if not positive:
+            # ref: negative score impact inverts the saturation argument
+            feat = jnp.where(mask, 1.0 / jnp.maximum(feat, 1e-9), 0.0)
+        if self.log is not None:
+            scaling = float(self.log.get("scaling_factor", 1.0))
+            scores = jnp.log(scaling + feat)
+        elif self.sigmoid is not None:
+            pivot = float(self.sigmoid["pivot"])
+            exp = float(self.sigmoid["exponent"])
+            scores = feat ** exp / (feat ** exp + pivot ** exp)
+        elif self.linear is not None:
+            scores = feat
+        else:
+            sat = self.saturation or {}
+            if "pivot" in sat:
+                pivot = float(sat["pivot"])
+            else:
+                # ref: pivot defaults to an approximation of the geometric
+                # mean of the feature over the index
+                vals = np.asarray(col)[np.asarray(~miss)]
+                pivot = float(np.mean(vals)) if len(vals) else 1.0
+            scores = feat / (feat + pivot)
+        return jnp.where(mask, scores, 0.0), mask
+
+
 class GeoDistanceQuery(QueryBuilder):
     """Docs within `distance` of `origin` (ref: index/query/
     GeoDistanceQueryBuilder). Haversine over the lat/lon doc-value columns —
@@ -1740,7 +1861,8 @@ def _parse_range(spec):
     lte = params.get("lte", params.get("to"))
     return _with_boost(
         RangeQuery(field, gte=gte, gt=params.get("gt"),
-                   lte=lte, lt=params.get("lt")), params)
+                   lte=lte, lt=params.get("lt"),
+                   relation=params.get("relation", "intersects")), params)
 
 
 def _parse_bool(spec):
@@ -1944,6 +2066,10 @@ _PARSERS = {
     "script_score": _parse_script_score,
     "knn": _parse_knn,
     "function_score": _parse_function_score,
+    "rank_feature": lambda spec: _with_boost(RankFeatureQuery(
+        spec["field"], saturation=spec.get("saturation"),
+        log=spec.get("log"), sigmoid=spec.get("sigmoid"),
+        linear=spec.get("linear")), spec),
     "geo_distance": _parse_geo_distance,
     "geo_bounding_box": _parse_geo_bounding_box,
     "geo_polygon": _parse_geo_polygon,

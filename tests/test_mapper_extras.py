@@ -1,0 +1,270 @@
+"""Extra field-type tests: range types, wildcard, flattened-era extras,
+constant_keyword, rank_feature(s), search_as_you_type, token_count, murmur3
+(model: the reference's per-mapper test classes under modules/mapper-extras
+and x-pack mapper plugins)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import MapperParsingException
+from elasticsearch_tpu.index.mapper import MapperService
+from elasticsearch_tpu.index.segment import SegmentWriter
+from elasticsearch_tpu.ops.device import DeviceSegment
+from elasticsearch_tpu.search.context import SegmentContext, ShardStats
+from elasticsearch_tpu.search.queries import parse_query
+
+MAPPINGS = {
+    "properties": {
+        "age_range": {"type": "integer_range"},
+        "when": {"type": "date_range"},
+        "code": {"type": "wildcard"},
+        "env": {"type": "constant_keyword", "value": "prod"},
+        "pagerank": {"type": "rank_feature"},
+        "inverse_rank": {"type": "rank_feature",
+                         "positive_score_impact": False},
+        "topics": {"type": "rank_features"},
+        "title": {"type": "search_as_you_type"},
+        "title_len": {"type": "token_count", "analyzer": "standard"},
+        "h": {"type": "murmur3"},
+    }
+}
+
+DOCS = [
+    {"age_range": {"gte": 10, "lte": 20}, "code": "alpha-123",
+     "pagerank": 10.0, "inverse_rank": 1.0, "topics": {"sports": 20.0},
+     "title": "quick brown fox", "title_len": "one two three", "h": "a"},
+    {"age_range": {"gte": 15, "lte": 30}, "code": "beta-456",
+     "pagerank": 2.0, "inverse_rank": 5.0, "topics": {"politics": 3.0},
+     "title": "quick brawl", "title_len": "one two", "h": "b",
+     "env": "prod"},
+    {"age_range": {"gte": 40, "lte": 50}, "code": "alpha-789",
+     "pagerank": 5.0, "topics": {"sports": 1.0, "politics": 8.0},
+     "title": "slow snail", "title_len": "one", "h": "a"},
+]
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    svc = MapperService(mappings=MAPPINGS)
+    w = SegmentWriter()
+    for i, d in enumerate(DOCS):
+        w.add(svc.parse(str(i), d))
+    seg = w.build("s0")
+    return SegmentContext(seg, DeviceSegment(seg), svc, ShardStats([seg]))
+
+
+def run(ctx, query_dict):
+    q = parse_query(query_dict)
+    scores, mask = q.execute(ctx)
+    return (np.asarray(scores)[: ctx.segment.n_docs],
+            np.asarray(mask)[: ctx.segment.n_docs])
+
+
+def matching(ctx, query_dict):
+    _, mask = run(ctx, query_dict)
+    return set(np.nonzero(mask)[0].tolist())
+
+
+# ---- range fields ----
+
+def test_range_field_intersects(ctx):
+    assert matching(ctx, {"range": {"age_range": {
+        "gte": 18, "lte": 25}}}) == {0, 1}
+
+
+def test_range_field_within(ctx):
+    assert matching(ctx, {"range": {"age_range": {
+        "gte": 5, "lte": 35, "relation": "within"}}}) == {0, 1}
+
+
+def test_range_field_contains(ctx):
+    assert matching(ctx, {"range": {"age_range": {
+        "gte": 16, "lte": 18, "relation": "contains"}}}) == {0, 1}
+
+
+def test_range_field_term_containment(ctx):
+    assert matching(ctx, {"term": {"age_range": {"value": 45}}}) == {2}
+    assert matching(ctx, {"term": {"age_range": {"value": 15}}}) == {0, 1}
+
+
+def test_range_field_exists(ctx):
+    assert matching(ctx, {"exists": {"field": "age_range"}}) == {0, 1, 2}
+
+
+def test_range_field_rejects_scalar():
+    svc = MapperService(mappings=MAPPINGS)
+    with pytest.raises(MapperParsingException):
+        svc.parse("x", {"age_range": 12})
+
+
+def test_date_range_parses_dates():
+    svc = MapperService(mappings=MAPPINGS)
+    p = svc.parse("x", {"when": {"gte": "2024-01-01", "lt": "2024-02-01"}})
+    lo = p.numeric_values["when.lo"][0]
+    hi = p.numeric_values["when.hi"][0]
+    assert lo < hi
+
+
+# ---- wildcard field ----
+
+def test_wildcard_field_wildcard_query(ctx):
+    assert matching(ctx, {"wildcard": {"code": {"value": "alpha-*"}}}) == {0, 2}
+    assert matching(ctx, {"wildcard": {"code": {"value": "*-456"}}}) == {1}
+
+
+def test_wildcard_field_term_query(ctx):
+    assert matching(ctx, {"term": {"code": "beta-456"}}) == {1}
+
+
+# ---- constant_keyword ----
+
+def test_constant_keyword_term_matches_all(ctx):
+    assert matching(ctx, {"term": {"env": "prod"}}) == {0, 1, 2}
+    assert matching(ctx, {"term": {"env": "staging"}}) == set()
+
+
+def test_constant_keyword_exists_matches_all(ctx):
+    assert matching(ctx, {"exists": {"field": "env"}}) == {0, 1, 2}
+
+
+def test_constant_keyword_rejects_other_value():
+    svc = MapperService(mappings=MAPPINGS)
+    with pytest.raises(MapperParsingException):
+        svc.parse("x", {"env": "staging"})
+
+
+def test_constant_keyword_pins_first_value():
+    svc = MapperService(mappings={"properties": {
+        "dc": {"type": "constant_keyword"}}})
+    svc.parse("a", {"dc": "us-east"})
+    with pytest.raises(MapperParsingException):
+        svc.parse("b", {"dc": "eu-west"})
+
+
+# ---- rank_feature(s) ----
+
+def test_rank_feature_saturation(ctx):
+    scores, mask = run(ctx, {"rank_feature": {"field": "pagerank",
+                                              "saturation": {"pivot": 5.0}}})
+    assert set(np.nonzero(mask)[0]) == {0, 1, 2}
+    assert scores[0] == pytest.approx(10 / 15)
+    assert scores[1] == pytest.approx(2 / 7)
+    assert scores[0] > scores[2] > scores[1]
+
+
+def test_rank_feature_log(ctx):
+    scores, _ = run(ctx, {"rank_feature": {"field": "pagerank",
+                                           "log": {"scaling_factor": 1.0}}})
+    assert scores[0] == pytest.approx(np.log(11.0), rel=1e-5)
+
+
+def test_rank_feature_sigmoid(ctx):
+    scores, _ = run(ctx, {"rank_feature": {
+        "field": "pagerank", "sigmoid": {"pivot": 5.0, "exponent": 1.0}}})
+    assert scores[2] == pytest.approx(0.5)
+
+
+def test_rank_feature_negative_impact(ctx):
+    scores, mask = run(ctx, {"rank_feature": {
+        "field": "inverse_rank", "saturation": {"pivot": 0.5}}})
+    # lower feature value => higher score
+    assert mask[0] and mask[1] and not mask[2]
+    assert scores[0] > scores[1]
+
+
+def test_rank_features_query(ctx):
+    scores, mask = run(ctx, {"rank_feature": {
+        "field": "topics.sports", "saturation": {"pivot": 1.0}}})
+    assert set(np.nonzero(mask)[0]) == {0, 2}
+    assert scores[0] > scores[2]
+
+
+def test_rank_feature_rejects_nonpositive():
+    svc = MapperService(mappings=MAPPINGS)
+    with pytest.raises(MapperParsingException):
+        svc.parse("x", {"pagerank": -1.0})
+    with pytest.raises(MapperParsingException):
+        svc.parse("x", {"topics": {"a": 0.0}})
+
+
+# ---- search_as_you_type ----
+
+def test_sayt_match_on_root(ctx):
+    assert matching(ctx, {"match": {"title": "quick"}}) == {0, 1}
+
+
+def test_sayt_2gram_shingles(ctx):
+    assert matching(ctx, {"match": {"title._2gram": "quick brown"}}) == {0}
+    assert matching(ctx, {"match": {"title._2gram": "brown fox"}}) == {0}
+    assert matching(ctx, {"match": {"title._2gram": "quick"}}) == set()
+
+
+def test_sayt_3gram_shingles(ctx):
+    assert matching(ctx, {"match": {"title._3gram": "quick brown fox"}}) == {0}
+
+
+def test_sayt_index_prefix(ctx):
+    assert matching(ctx, {"term": {"title._index_prefix": "bra"}}) == {1}
+    assert matching(ctx, {"term": {"title._index_prefix": "qu"}}) == {0, 1}
+
+
+def test_sayt_bool_prefix(ctx):
+    # the search-as-you-type headline use (ref: match_bool_prefix docs)
+    assert matching(ctx, {"match_bool_prefix": {"title": "quick br"}}) == {0, 1}
+
+
+def test_sayt_subfields_hidden_from_mapping():
+    svc = MapperService(mappings=MAPPINGS)
+    props = svc.mapper.to_mapping()["properties"]
+    assert "title" in props
+    assert "_2gram" not in str(props["title"])
+
+
+# ---- token_count / murmur3 ----
+
+def test_token_count(ctx):
+    assert matching(ctx, {"range": {"title_len": {"gte": 3}}}) == {0}
+    assert matching(ctx, {"term": {"title_len": 2}}) == {1}
+
+
+def test_murmur3_same_value_same_hash(ctx):
+    seg = ctx.segment
+    nv = seg.numerics["h"]
+    assert nv.values[0] == nv.values[2]
+    assert nv.values[0] != nv.values[1]
+
+
+# ---- flattened ----
+
+@pytest.fixture(scope="module")
+def flat_ctx():
+    svc = MapperService(mappings={"properties": {
+        "labels": {"type": "flattened"}}})
+    w = SegmentWriter()
+    docs = [
+        {"labels": {"priority": "urgent", "release": ["v1.2", "v1.3"],
+                    "owner": {"team": "infra"}}},
+        {"labels": {"priority": "low", "owner": {"team": "web"}}},
+    ]
+    for i, d in enumerate(docs):
+        w.add(svc.parse(str(i), d))
+    seg = w.build("s0")
+    return SegmentContext(seg, DeviceSegment(seg), svc, ShardStats([seg]))
+
+
+def test_flattened_keyed_term(flat_ctx):
+    assert matching(flat_ctx, {"term": {"labels.priority": "urgent"}}) == {0}
+    assert matching(flat_ctx, {"term": {"labels.owner.team": "web"}}) == {1}
+    assert matching(flat_ctx, {"term": {"labels.release": "v1.3"}}) == {0}
+
+
+def test_flattened_root_matches_any_value(flat_ctx):
+    assert matching(flat_ctx, {"term": {"labels": "urgent"}}) == {0}
+    assert matching(flat_ctx, {"term": {"labels": "infra"}}) == {0}
+
+
+def test_flattened_rejects_scalar():
+    svc = MapperService(mappings={"properties": {
+        "labels": {"type": "flattened"}}})
+    with pytest.raises(MapperParsingException):
+        svc.parse("x", {"labels": "not-an-object"})
