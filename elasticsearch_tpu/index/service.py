@@ -14,6 +14,7 @@ import json
 import os
 import shutil
 import struct
+import time
 from typing import Any, Dict, List, Optional
 
 from elasticsearch_tpu.common.errors import (
@@ -80,6 +81,10 @@ class IndexService:
                  device_cache: Optional[DeviceSegmentCache] = None):
         self.name = name
         self.path = path
+        if settings.get("index.creation_date") is None:
+            flat = settings.as_dict()
+            flat["index.creation_date"] = int(time.time() * 1000)
+            settings = Settings(flat)
         self.settings = settings
         self.num_shards = INDEX_NUMBER_OF_SHARDS.get(settings)
         self.k1 = INDEX_BM25_K1.get(settings)
@@ -106,6 +111,21 @@ class IndexService:
 
     def update_mappings(self, mappings: Dict[str, Any]):
         self.mapper.merge(mappings)
+        self._persist_meta()
+
+    def update_settings(self, updates: Dict[str, Any]):
+        """Merge dynamic setting updates (ref: the update-settings action;
+        static settings like number_of_shards are rejected)."""
+        flat = Settings.from_dict(updates).as_dict()
+        for k in flat:
+            if k in ("index.number_of_shards",):
+                from elasticsearch_tpu.common.errors import (
+                    IllegalArgumentException)
+                raise IllegalArgumentException(
+                    f"final {self.name} setting [{k}], not updateable")
+        merged = self.settings.as_dict()
+        merged.update(flat)
+        self.settings = Settings(merged)
         self._persist_meta()
 
     # ------------------------------------------------------------ routing

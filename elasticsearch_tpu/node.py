@@ -56,6 +56,10 @@ class Node:
         self.repositories_service = RepositoriesService(self.data_path)
         self.slm_service = SnapshotLifecycleService(
             self.repositories_service, self.indices_service, self.data_path)
+        from elasticsearch_tpu.xpack.ilm import IndexLifecycleService
+        self.ilm_service = IndexLifecycleService(
+            self.indices_service, self.metadata_service,
+            self.repositories_service, self.data_path, self.slm_service)
         self.rest_controller = RestController(self)
         self._http: Optional[HttpServer] = None
 

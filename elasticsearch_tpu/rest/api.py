@@ -209,6 +209,18 @@ def _register_all(c: RestController):
     c.register("GET", "/_snapshot/{repo}/{snap}", get_snapshot)
     c.register("DELETE", "/_snapshot/{repo}/{snap}", delete_snapshot)
     c.register("POST", "/_snapshot/{repo}/{snap}/_restore", restore_snapshot)
+    # ilm
+    c.register("PUT", "/_ilm/policy/{id}", ilm_put_policy)
+    c.register("GET", "/_ilm/policy/{id}", ilm_get_policy)
+    c.register("GET", "/_ilm/policy", ilm_get_policy)
+    c.register("DELETE", "/_ilm/policy/{id}", ilm_delete_policy)
+    c.register("GET", "/_ilm/status", ilm_status)
+    c.register("POST", "/_ilm/start", ilm_start)
+    c.register("POST", "/_ilm/stop", ilm_stop)
+    c.register("GET", "/{index}/_ilm/explain", ilm_explain)
+    c.register("POST", "/{index}/_ilm/remove", ilm_remove)
+    c.register("POST", "/{index}/_ilm/retry", ilm_retry)
+    c.register("PUT", "/{index}/_settings", put_settings)
     # slm
     c.register("PUT", "/_slm/policy/{id}", slm_put_policy)
     c.register("GET", "/_slm/policy/{id}", slm_get_policy)
@@ -1342,6 +1354,63 @@ def restore_snapshot(node, params, body, repo, snap):
         rename_pattern=body.get("rename_pattern"),
         rename_replacement=body.get("rename_replacement"))
     return 200, result
+
+
+def ilm_put_policy(node, params, body, id):
+    node.ilm_service.put_policy(id, body or {})
+    return 200, {"acknowledged": True}
+
+
+def ilm_get_policy(node, params, body, id=None):
+    return 200, node.ilm_service.get_policy(id)
+
+
+def ilm_delete_policy(node, params, body, id):
+    node.ilm_service.delete_policy(id)
+    return 200, {"acknowledged": True}
+
+
+def ilm_status(node, params, body):
+    return 200, {"operation_mode": node.ilm_service.status()}
+
+
+def ilm_start(node, params, body):
+    node.ilm_service.start()
+    return 200, {"acknowledged": True}
+
+
+def ilm_stop(node, params, body):
+    node.ilm_service.stop()
+    return 200, {"acknowledged": True}
+
+
+def ilm_explain(node, params, body, index):
+    out = {}
+    for name in node.indices_service.resolve(index):
+        out[name] = node.ilm_service.explain(name)
+    return 200, {"indices": out}
+
+
+def ilm_remove(node, params, body, index):
+    removed = []
+    for name in node.indices_service.resolve(index):
+        if node.ilm_service.remove_policy(name):
+            removed.append(name)
+    return 200, {"has_failures": False, "failed_indexes": [],
+                 "removed": removed}
+
+
+def ilm_retry(node, params, body, index):
+    node.ilm_service.retry(index)
+    return 200, {"acknowledged": True}
+
+
+def put_settings(node, params, body, index):
+    body = body or {}
+    updates = body.get("settings", body)  # both wrapped and flat accepted
+    for name in node.indices_service.resolve(index):
+        node.indices_service.get(name).update_settings(updates)
+    return 200, {"acknowledged": True}
 
 
 def slm_put_policy(node, params, body, id):

@@ -1,0 +1,381 @@
+"""Index lifecycle management (ILM).
+
+ref: x-pack/plugin/ilm — IndexLifecycleService drives a per-index step
+state machine (IndexLifecycleRunner.java:41,326, PolicyStepsRegistry):
+a policy defines phases (hot → warm → cold → delete), each entered after
+``min_age`` and executing its actions as idempotent steps recorded in the
+index's lifecycle execution state.
+
+The reference stores execution state in IndexMetadata customs and advances
+on cluster-state changes + a periodic trigger; here the state lives in the
+index settings (``index.lifecycle.*`` execution keys) and `tick(now)`
+advances every managed index — callable from a scheduler thread in
+production and directly (with an injected clock) in tests, which keeps the
+state machine deterministic the way the reference's
+DeterministicTaskQueue-driven ILM tests are.
+
+Supported actions per phase (the reference's core set minus
+allocate/migrate routing, which are no-ops single-node):
+  hot:    rollover, set_priority, forcemerge
+  warm:   readonly, forcemerge, shrink, set_priority, allocate(no-op)
+  cold:   freeze, searchable_snapshot(stub→snapshot when repo configured),
+          set_priority, allocate(no-op)
+  delete: wait_for_snapshot, delete
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ResourceNotFoundException,
+)
+
+PHASE_ORDER = ["hot", "warm", "cold", "delete"]
+
+_ACTION_ORDER = {
+    # execution order within a phase (ref: the per-phase step order in
+    # TimeseriesLifecycleType.ORDERED_VALID_*_ACTIONS)
+    "hot": ["set_priority", "rollover", "forcemerge"],
+    "warm": ["set_priority", "readonly", "allocate", "shrink", "forcemerge"],
+    "cold": ["set_priority", "allocate", "freeze", "searchable_snapshot"],
+    "delete": ["wait_for_snapshot", "delete"],
+}
+
+_VALID_ACTIONS = {a for acts in _ACTION_ORDER.values() for a in acts}
+
+
+def parse_time_ms(v: Any) -> float:
+    """"30d" / "1h" / "0ms" / 5000 → milliseconds."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    m = re.match(r"^\s*(\d+(?:\.\d+)?)\s*(d|h|m|s|ms|micros|nanos)\s*$", str(v))
+    if not m:
+        raise IllegalArgumentException(f"failed to parse time value [{v}]")
+    n = float(m.group(1))
+    mult = {"d": 86400_000, "h": 3600_000, "m": 60_000, "s": 1000,
+            "ms": 1, "micros": 1e-3, "nanos": 1e-6}[m.group(2)]
+    return n * mult
+
+
+class IndexLifecycleService:
+    """Policy registry + per-index state machine runner."""
+
+    def __init__(self, indices_service, metadata_service,
+                 repositories_service=None, data_path: Optional[str] = None,
+                 slm_service=None):
+        self.indices = indices_service
+        self.metadata = metadata_service
+        self.repositories = repositories_service
+        self.slm = slm_service
+        self.running = True
+        self._policies: Dict[str, Dict[str, Any]] = {}
+        self._path = (os.path.join(data_path, "_ilm_policies.json")
+                      if data_path else None)
+        if self._path and os.path.exists(self._path):
+            with open(self._path) as fh:
+                self._policies = json.load(fh)
+
+    # ------------------------------------------------------------ registry
+    def _persist(self):
+        if self._path:
+            tmp = self._path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(self._policies, fh)
+            os.replace(tmp, self._path)
+
+    def put_policy(self, name: str, body: Dict[str, Any]):
+        policy = body.get("policy", body)
+        phases = policy.get("phases")
+        if not isinstance(phases, dict) or not phases:
+            raise IllegalArgumentException(
+                "policy must define at least one phase")
+        for phase, spec in phases.items():
+            if phase not in PHASE_ORDER:
+                raise IllegalArgumentException(
+                    f"lifecycle type [timeseries] does not support phase "
+                    f"[{phase}]")
+            for action in spec.get("actions", {}):
+                if action not in _VALID_ACTIONS:
+                    raise IllegalArgumentException(
+                        f"invalid action [{action}] defined in phase "
+                        f"[{phase}]")
+                if action not in _ACTION_ORDER[phase]:
+                    raise IllegalArgumentException(
+                        f"invalid action [{action}] defined in phase "
+                        f"[{phase}]")
+        prev = self._policies.get(name)
+        self._policies[name] = {
+            "policy": {"phases": phases},
+            "version": (prev["version"] + 1) if prev else 1,
+            "modified_date": int(time.time() * 1000),
+        }
+        self._persist()
+
+    def get_policy(self, name: Optional[str] = None) -> Dict[str, Any]:
+        if name is None:
+            return dict(self._policies)
+        if name not in self._policies:
+            raise ResourceNotFoundException(f"Lifecycle policy not found: {name}")
+        return {name: self._policies[name]}
+
+    def delete_policy(self, name: str):
+        if name not in self._policies:
+            raise ResourceNotFoundException(f"Lifecycle policy not found: {name}")
+        using = [idx for idx in self.indices.indices.values()
+                 if idx.settings.get("index.lifecycle.name") == name]
+        if using:
+            raise IllegalArgumentException(
+                f"Cannot delete policy [{name}]. It is in use by one or "
+                f"more indices: {[i.name for i in using]}")
+        del self._policies[name]
+        self._persist()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        self.running = True
+
+    def stop(self):
+        self.running = False
+
+    def status(self) -> str:
+        return "RUNNING" if self.running else "STOPPED"
+
+    # ---------------------------------------------------------- state rows
+    def _state(self, idx) -> Dict[str, Any]:
+        s = idx.settings
+        return {
+            "policy": s.get("index.lifecycle.name"),
+            "phase": s.get("index.lifecycle.phase"),
+            "action": s.get("index.lifecycle.action"),
+            "step": s.get("index.lifecycle.step"),
+            "phase_time": s.get("index.lifecycle.phase_time"),
+            "failed_step": s.get("index.lifecycle.failed_step"),
+            "step_info": s.get("index.lifecycle.step_info"),
+        }
+
+    def _set_state(self, idx, **kv):
+        idx.update_settings({f"index.lifecycle.{k}": v
+                             for k, v in kv.items()})
+
+    def remove_policy(self, index_name: str) -> bool:
+        idx = self.indices.get(index_name)
+        had = idx.settings.get("index.lifecycle.name") is not None
+        merged = {k: v for k, v in idx.settings.as_dict().items()
+                  if not k.startswith("index.lifecycle.")}
+        from elasticsearch_tpu.common.settings import Settings
+        idx.settings = Settings(merged)
+        idx._persist_meta()
+        return had
+
+    def retry(self, index_name: str):
+        """Re-run a failed step (ref: TransportRetryAction)."""
+        idx = self.indices.get(index_name)
+        self._set_state(idx, failed_step=None, step_info=None, step="check")
+
+    def explain(self, index_name: str, now: Optional[float] = None) -> Dict[str, Any]:
+        idx = self.indices.get(index_name)
+        st = self._state(idx)
+        managed = st["policy"] is not None
+        out: Dict[str, Any] = {"index": index_name, "managed": managed}
+        if not managed:
+            return out
+        now_ms = (now if now is not None else time.time()) * 1000
+        origin = self._age_origin_ms(idx)
+        out.update({
+            "policy": st["policy"],
+            "phase": st["phase"],
+            "action": st["action"],
+            "step": st["step"] or "complete",
+            "age": f"{max(0.0, (now_ms - origin) / 1000):.2f}s",
+            "lifecycle_date_millis": origin,
+        })
+        if st["failed_step"]:
+            out["failed_step"] = st["failed_step"]
+            out["step_info"] = st["step_info"]
+        return out
+
+    def _age_origin_ms(self, idx) -> float:
+        # age counts from rollover when the index has rolled over, else from
+        # creation (ref: IndexLifecycleExplainResponse.getLifecycleDate)
+        ro = idx.settings.get("index.lifecycle.indexing_complete_date")
+        if ro is not None:
+            return float(ro)
+        return float(idx.settings.get("index.creation_date", 0))
+
+    # ------------------------------------------------------------- runner
+    def tick(self, now: Optional[float] = None):
+        """Advance every managed index one scheduler pass."""
+        if not self.running:
+            return
+        now = now if now is not None else time.time()
+        for name in list(self.indices.indices):
+            idx = self.indices.indices.get(name)
+            if idx is None:
+                continue
+            policy_name = idx.settings.get("index.lifecycle.name")
+            if policy_name is None or policy_name not in self._policies:
+                continue
+            if idx.settings.get("index.lifecycle.failed_step"):
+                continue  # parked until retry
+            try:
+                self._advance(idx, policy_name, now)
+            except Exception as e:  # park the index on its failed step
+                st = self._state(idx)
+                self._set_state(
+                    idx, failed_step=st.get("action") or "unknown",
+                    step_info=json.dumps({"type": type(e).__name__,
+                                          "reason": str(e)}))
+
+    def _advance(self, idx, policy_name: str, now: float):
+        phases = self._policies[policy_name]["policy"]["phases"]
+        st = self._state(idx)
+        phase = st["phase"]
+        now_ms = now * 1000
+
+        if phase is None:
+            # enter the first defined phase whose min_age has passed
+            phase = self._next_phase(None, phases, idx, now_ms)
+            if phase is None:
+                return
+            self._enter_phase(idx, phase, now_ms)
+
+        while True:
+            # execute remaining actions of the current phase
+            actions = phases.get(phase, {}).get("actions", {})
+            for action in _ACTION_ORDER[phase]:
+                if action not in actions:
+                    continue
+                done_key = f"index.lifecycle.done.{phase}.{action}"
+                if idx.settings.get(done_key):
+                    continue
+                finished = self._run_action(idx, phase, action,
+                                            actions[action], now_ms)
+                if not finished:
+                    return  # waiting (e.g. rollover conditions not met)
+                if not self.indices.has(idx.name):
+                    return  # the delete action removed the index
+                idx.update_settings({done_key: True})
+
+            # all actions done → move to the next ripe phase this tick
+            nxt = self._next_phase(phase, phases, idx, now_ms)
+            if nxt is None:
+                return
+            self._enter_phase(idx, nxt, now_ms)
+            phase = nxt
+
+    def _enter_phase(self, idx, phase: str, now_ms: float):
+        self._set_state(idx, phase=phase, phase_time=now_ms, step="check",
+                        action=None)
+
+    def _next_phase(self, current: Optional[str], phases: Dict[str, Any],
+                    idx, now_ms: float) -> Optional[str]:
+        start = 0 if current is None else PHASE_ORDER.index(current) + 1
+        age_ms = now_ms - self._age_origin_ms(idx)
+        for phase in PHASE_ORDER[start:]:
+            if phase not in phases:
+                continue
+            min_age = parse_time_ms(phases[phase].get("min_age", 0))
+            return phase if age_ms >= min_age else None
+        return None
+
+    # ------------------------------------------------------------- actions
+    def _run_action(self, idx, phase: str, action: str,
+                    spec: Dict[str, Any], now_ms: float) -> bool:
+        """Execute one action; returns True when complete (idempotent —
+        each reference action is a sequence of retryable steps)."""
+        self._set_state(idx, action=action)
+        if action == "rollover":
+            return self._action_rollover(idx, spec, now_ms)
+        if action == "set_priority":
+            idx.update_settings({
+                "index.priority": int(spec.get("priority", 1))})
+            return True
+        if action == "readonly":
+            idx.update_settings({"index.blocks.write": True})
+            return True
+        if action == "allocate":
+            # routing is a no-op without multi-node allocation filters here;
+            # number_of_replicas updates apply
+            if "number_of_replicas" in spec:
+                idx.update_settings({"index.number_of_replicas":
+                                     int(spec["number_of_replicas"])})
+            return True
+        if action == "forcemerge":
+            idx.force_merge(int(spec.get("max_num_segments", 1)))
+            return True
+        if action == "shrink":
+            return self._action_shrink(idx, spec)
+        if action == "freeze":
+            idx.update_settings({"index.frozen": True,
+                                 "index.blocks.write": True})
+            return True
+        if action == "searchable_snapshot":
+            repo = spec.get("snapshot_repository")
+            if self.repositories is None or not repo:
+                raise IllegalArgumentException(
+                    "[searchable_snapshot] requires [snapshot_repository]")
+            snap = f"ilm-{idx.name}-{int(now_ms)}"
+            self.repositories.get_repository(repo).snapshot(snap, [idx])
+            idx.update_settings({"index.store.snapshot.repository_name": repo,
+                                 "index.store.snapshot.snapshot_name": snap,
+                                 "index.blocks.write": True})
+            return True
+        if action == "wait_for_snapshot":
+            policy = spec.get("policy")
+            if self.slm is None or policy is None:
+                return True
+            stats = self.slm._stats.get(policy, {})
+            return stats.get("snapshots_taken", 0) > 0
+        if action == "delete":
+            self.indices.delete_index(idx.name)
+            return True
+        raise IllegalArgumentException(f"unknown ILM action [{action}]")
+
+    def _action_rollover(self, idx, spec: Dict[str, Any],
+                         now_ms: float) -> bool:
+        alias = idx.settings.get("index.lifecycle.rollover_alias")
+        if alias is None:
+            raise IllegalArgumentException(
+                f"setting [index.lifecycle.rollover_alias] for index "
+                f"[{idx.name}] is empty or not defined")
+        # only the current write index rolls over
+        if self.metadata.write_target(alias) != idx.name:
+            return True
+        conditions = {k if k.startswith("max_") else f"max_{k}": v
+                      for k, v in spec.items()}
+        result = self.metadata.rollover(alias, {"conditions": conditions})
+        if not result.get("rolled_over"):
+            return False
+        idx.update_settings(
+            {"index.lifecycle.indexing_complete": True,
+             "index.lifecycle.indexing_complete_date": now_ms})
+        return True
+
+    def _action_shrink(self, idx, spec: Dict[str, Any]) -> bool:
+        from elasticsearch_tpu.index.metadata import resize_index
+        target_shards = int(spec.get("number_of_shards", 1))
+        if idx.num_shards <= target_shards:
+            return True  # nothing to shrink
+        target_name = f"shrink-{idx.name}"
+        if self.indices.has(target_name):
+            return True
+        idx.update_settings({"index.blocks.write": True})
+        resize_index(self.indices, idx.name, target_name,
+                     {"settings": {"index.number_of_shards": target_shards}},
+                     mode="shrink")
+        # carry the policy over to the shrunken index, minus the shrink
+        # action's own phase progress (ref: ShrinkAction copies execution
+        # state and swaps aliases)
+        tgt = self.indices.get(target_name)
+        carry = {k: v for k, v in idx.settings.as_dict().items()
+                 if k.startswith("index.lifecycle.")}
+        carry[f"index.lifecycle.done.warm.shrink"] = True
+        tgt.update_settings(carry)
+        self.indices.delete_index(idx.name)
+        return True
