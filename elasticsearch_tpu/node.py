@@ -60,6 +60,15 @@ class Node:
         self.ilm_service = IndexLifecycleService(
             self.indices_service, self.metadata_service,
             self.repositories_service, self.data_path, self.slm_service)
+        from elasticsearch_tpu.xpack.security import SecurityService
+        self.security_service = SecurityService(
+            self.data_path,
+            enabled=bool(settings.get("xpack.security.enabled", False)),
+            bootstrap_password=str(
+                settings.get("bootstrap.password", "changeme")))
+        # per-request thread-local context (authenticated user)
+        import threading
+        self.request_context = threading.local()
         self.rest_controller = RestController(self)
         self._http: Optional[HttpServer] = None
 

@@ -49,10 +49,27 @@ class RestController:
 
     def dispatch(self, method: str, path: str,
                  params: Optional[Dict[str, str]] = None,
-                 body: Any = None) -> Response:
+                 body: Any = None,
+                 headers: Optional[Dict[str, str]] = None) -> Response:
         params = params or {}
         method = method.upper()
         path = path.rstrip("/") or "/"
+        sec = getattr(self.node, "security_service", None)
+        self.node.request_context.user = None
+        if sec is not None and sec.enabled:
+            from elasticsearch_tpu.xpack.security import required_privilege
+            try:
+                user = sec.authenticate(headers)
+                kind, priv, index = required_privilege(method, path)
+                if priv != "none":
+                    sec.authorize(user, kind, priv, index)
+            except ElasticsearchTpuException as e:
+                return e.status, {
+                    "error": {**e.to_xcontent(),
+                              "root_cause": [e.to_xcontent()]},
+                    "status": e.status,
+                }
+            self.node.request_context.user = user
         matched_path = False
         for m, regex, names, handler in self._routes:
             match = regex.match(path)
@@ -209,6 +226,24 @@ def _register_all(c: RestController):
     c.register("GET", "/_snapshot/{repo}/{snap}", get_snapshot)
     c.register("DELETE", "/_snapshot/{repo}/{snap}", delete_snapshot)
     c.register("POST", "/_snapshot/{repo}/{snap}/_restore", restore_snapshot)
+    # security
+    c.register("GET", "/_security/_authenticate", security_authenticate)
+    c.register("PUT", "/_security/user/{name}", security_put_user)
+    c.register("POST", "/_security/user/{name}", security_put_user)
+    c.register("GET", "/_security/user/{name}", security_get_user)
+    c.register("GET", "/_security/user", security_get_user)
+    c.register("DELETE", "/_security/user/{name}", security_delete_user)
+    c.register("PUT", "/_security/user/{name}/_password", security_change_password)
+    c.register("POST", "/_security/user/{name}/_password", security_change_password)
+    c.register("PUT", "/_security/role/{name}", security_put_role)
+    c.register("POST", "/_security/role/{name}", security_put_role)
+    c.register("GET", "/_security/role/{name}", security_get_role)
+    c.register("GET", "/_security/role", security_get_role)
+    c.register("DELETE", "/_security/role/{name}", security_delete_role)
+    c.register("POST", "/_security/api_key", security_create_api_key)
+    c.register("PUT", "/_security/api_key", security_create_api_key)
+    c.register("GET", "/_security/api_key", security_get_api_keys)
+    c.register("DELETE", "/_security/api_key", security_invalidate_api_key)
     # ilm
     c.register("PUT", "/_ilm/policy/{id}", ilm_put_policy)
     c.register("GET", "/_ilm/policy/{id}", ilm_get_policy)
@@ -711,6 +746,49 @@ def bulk_index(node, params, body, index):
 
 # -- search ------------------------------------------------------------------
 
+def _current_user(node):
+    return getattr(node.request_context, "user", None)
+
+
+def _apply_dls(node, index, body):
+    """AND the authenticated user's DLS query into the search (ref:
+    SecurityIndexReaderWrapper — the role query becomes a filter bitset
+    intersected with the scorer; here it joins the query plan and is one
+    more mask intersect on device)."""
+    user = _current_user(node)
+    if user is None or not node.security_service.enabled:
+        return body
+    names = (node.indices_service.resolve(index)
+             if index not in (None, "*", "_all") else
+             list(node.indices_service.indices))
+    queries = [node.security_service.dls_query(user, n) for n in names]
+    queries = [q for q in queries if q is not None]
+    if not queries:
+        return body
+    dls = (queries[0] if len(queries) == 1 else
+           {"bool": {"should": queries, "minimum_should_match": 1}})
+    body = dict(body or {})
+    query = body.get("query")
+    body["query"] = {"bool": {"must": [query] if query else [],
+                              "filter": [dls]}}
+    return body
+
+
+def _apply_fls(node, index, result):
+    """Filter hit sources by the user's field security grants."""
+    user = _current_user(node)
+    if user is None or not node.security_service.enabled:
+        return result
+    sec = node.security_service
+    hits = result.get("hits", {}).get("hits", []) if isinstance(result, dict) \
+        else []
+    for hit in hits:
+        fls = sec.fls_filter(user, hit.get("_index", index))
+        if fls is not None and isinstance(hit.get("_source"), dict):
+            hit["_source"] = sec.filter_source(hit["_source"], fls)
+    return result
+
+
 def _apply_alias_filter(node, index, body):
     """Filtered-alias search (ref: AliasFilter applied per shard request):
     wrap the query with the alias filter when the target is one alias."""
@@ -727,24 +805,26 @@ def _apply_alias_filter(node, index, body):
 def search_index(node, params, body, index):
     body = _merge_search_params(body, params)
     body = _apply_alias_filter(node, index, body)
+    body = _apply_dls(node, index, body)
     with node.task_manager.task_scope(
             "transport", "indices:data/read/search",
             description=f"indices[{index}]", cancellable=True) as task:
         r = node.search_service.search(index, body,
                                        scroll=params.get("scroll"),
                                        task=task)
-    return 200, r
+    return 200, _apply_fls(node, index, r)
 
 
 def search_all(node, params, body):
     body = _merge_search_params(body, params)
+    body = _apply_dls(node, "_all", body)
     with node.task_manager.task_scope(
             "transport", "indices:data/read/search",
             description="indices[_all]", cancellable=True) as task:
         r = node.search_service.search("_all", body,
                                        scroll=params.get("scroll"),
                                        task=task)
-    return 200, r
+    return 200, _apply_fls(node, "_all", r)
 
 
 def _merge_search_params(body, params):
@@ -765,6 +845,7 @@ def _merge_search_params(body, params):
 
 def count_index(node, params, body, index):
     body = _apply_alias_filter(node, index, body or {})
+    body = _apply_dls(node, index, body)
     return 200, node.search_service.count(index, body)
 
 
@@ -1354,6 +1435,69 @@ def restore_snapshot(node, params, body, repo, snap):
         rename_pattern=body.get("rename_pattern"),
         rename_replacement=body.get("rename_replacement"))
     return 200, result
+
+
+def security_authenticate(node, params, body):
+    user = _current_user(node)
+    if user is None:
+        # security disabled: anonymous superuser view (the reference 401s;
+        # with security off there is no authn filter at all)
+        return 200, {"username": "_anonymous", "roles": ["superuser"],
+                     "enabled": True,
+                     "authentication_realm": {"name": "__anonymous",
+                                              "type": "anonymous"}}
+    out = user.to_dict()
+    out["authentication_realm"] = {"name": "default_native", "type": "native"}
+    return 200, out
+
+
+def security_put_user(node, params, body, name):
+    r = node.security_service.put_user(name, body or {})
+    return 200, r
+
+
+def security_get_user(node, params, body, name=None):
+    return 200, node.security_service.get_user(name)
+
+
+def security_delete_user(node, params, body, name):
+    node.security_service.delete_user(name)
+    return 200, {"found": True}
+
+
+def security_change_password(node, params, body, name):
+    node.security_service.change_password(name, (body or {})["password"])
+    return 200, {}
+
+
+def security_put_role(node, params, body, name):
+    return 200, node.security_service.put_role(name, body or {})
+
+
+def security_get_role(node, params, body, name=None):
+    return 200, node.security_service.get_role(name)
+
+
+def security_delete_role(node, params, body, name):
+    node.security_service.delete_role(name)
+    return 200, {"found": True}
+
+
+def security_create_api_key(node, params, body):
+    from elasticsearch_tpu.xpack.security import User
+    user = _current_user(node) or User("_anonymous", ["superuser"])
+    return 200, node.security_service.create_api_key(user, body or {})
+
+
+def security_get_api_keys(node, params, body):
+    return 200, {"api_keys": node.security_service.get_api_keys()}
+
+
+def security_invalidate_api_key(node, params, body):
+    body = body or {}
+    ids = node.security_service.invalidate_api_key(
+        key_id=body.get("id"), name=body.get("name"))
+    return 200, {"invalidated_api_keys": ids, "error_count": 0}
 
 
 def ilm_put_policy(node, params, body, id):

@@ -40,7 +40,8 @@ class _Handler(BaseHTTPRequestHandler):
                         "reason": f"Failed to parse request body: {e}"},
                         "status": 400})
                     return
-        status, payload = self.controller.dispatch(method, url.path, params, body)
+        status, payload = self.controller.dispatch(
+            method, url.path, params, body, headers=dict(self.headers))
         self._send(status, payload, head_only=(method == "HEAD"))
 
     def _send(self, status: int, payload, head_only: bool = False):

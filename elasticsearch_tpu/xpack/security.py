@@ -1,0 +1,529 @@
+"""Security: authentication (native users, API keys), RBAC authorization,
+document- and field-level security.
+
+ref: x-pack/plugin/security — AuthenticationService (realm chain),
+AuthorizationService (role resolution → cluster/index privilege checks),
+ApiKeyService, and the DLS/FLS reader wrappers in x-pack core
+(accesscontrol/DocumentSubsetReader.java, FieldSubsetReader.java,
+SecurityIndexReaderWrapper.java).
+
+TPU orientation: DLS is enforced the way the reference's sparse-bitset
+scoring path works (ContextIndexSearcher.java:219-231 intersects a role
+filter bitset with the query scorer) — the role's DLS query is compiled
+into the query plan as an ANDed filter clause, which on device is one more
+mask tensor intersect fused into the scoring kernel. FLS filters the
+fetched _source columns host-side.
+
+Passwords hash with PBKDF2-HMAC-SHA256 (the reference defaults to bcrypt;
+PBKDF2 is its FIPS-mode hasher, available in the stdlib).
+"""
+
+from __future__ import annotations
+
+import base64
+import fnmatch
+import hashlib
+import json
+import os
+import secrets
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import (
+    ElasticsearchTpuException,
+    IllegalArgumentException,
+    ResourceNotFoundException,
+)
+
+
+class SecurityException(ElasticsearchTpuException):
+    status = 403
+
+
+class AuthenticationException(ElasticsearchTpuException):
+    status = 401
+
+
+# cluster privileges (subset of the reference's ClusterPrivilegeResolver)
+CLUSTER_PRIVILEGES = {
+    "all", "monitor", "manage", "manage_security", "manage_ilm", "manage_slm",
+    "manage_index_templates", "manage_ingest_pipelines", "manage_ml",
+    "manage_transform", "manage_watcher", "manage_ccr", "manage_enrich",
+    "manage_rollup", "read_ccr", "transport_client", "manage_api_key",
+}
+
+# index privileges (ref: IndexPrivilege)
+INDEX_PRIVILEGES = {
+    "all", "read", "write", "index", "create", "delete", "create_index",
+    "delete_index", "manage", "monitor", "view_index_metadata",
+    "read_cross_cluster", "maintenance", "manage_ilm",
+}
+
+# privilege implication map: holding the key implies the values
+_CLUSTER_IMPLIES = {"all": CLUSTER_PRIVILEGES,
+                    "manage": {"monitor", "manage_index_templates",
+                               "manage_ingest_pipelines", "manage_ilm",
+                               "manage_slm", "manage_rollup",
+                               "manage_transform", "manage_enrich",
+                               "manage_watcher"}}
+_INDEX_IMPLIES = {
+    "all": INDEX_PRIVILEGES,
+    "write": {"index", "create", "delete"},
+    "manage": {"create_index", "delete_index", "view_index_metadata",
+               "monitor", "maintenance", "manage_ilm"},
+    "read": set(), "monitor": set(),
+}
+
+
+def _hash_password(password: str, salt: Optional[bytes] = None) -> str:
+    salt = salt or os.urandom(16)
+    dk = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, 10_000)
+    return f"{salt.hex()}${dk.hex()}"
+
+
+def _verify_password(password: str, stored: str) -> bool:
+    try:
+        salt_hex, dk_hex = stored.split("$")
+    except ValueError:
+        return False
+    dk = hashlib.pbkdf2_hmac("sha256", password.encode(),
+                             bytes.fromhex(salt_hex), 10_000)
+    return secrets.compare_digest(dk.hex(), dk_hex)
+
+
+class User:
+    def __init__(self, username: str, roles: List[str],
+                 metadata: Optional[Dict[str, Any]] = None,
+                 full_name: Optional[str] = None,
+                 email: Optional[str] = None,
+                 api_key_roles: Optional[List[Dict[str, Any]]] = None):
+        self.username = username
+        self.roles = list(roles)
+        self.metadata = metadata or {}
+        self.full_name = full_name
+        self.email = email
+        # API-key auth carries inline role descriptors that REPLACE the
+        # owner's roles when non-empty (ref: ApiKeyService role limiting)
+        self.api_key_roles = api_key_roles
+
+    def to_dict(self):
+        return {"username": self.username, "roles": self.roles,
+                "full_name": self.full_name, "email": self.email,
+                "metadata": self.metadata, "enabled": True}
+
+
+_BUILTIN_ROLES: Dict[str, Dict[str, Any]] = {
+    "superuser": {"cluster": ["all"],
+                  "indices": [{"names": ["*"], "privileges": ["all"]}]},
+    "kibana_system": {"cluster": ["monitor"],
+                      "indices": [{"names": [".kibana*"],
+                                   "privileges": ["all"]}]},
+    "monitoring_user": {"cluster": ["monitor"], "indices": []},
+}
+
+
+class SecurityService:
+    """User/role/API-key registry + authn/authz engine."""
+
+    def __init__(self, data_path: Optional[str] = None,
+                 enabled: bool = False,
+                 bootstrap_password: str = "changeme"):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._users: Dict[str, Dict[str, Any]] = {}
+        self._roles: Dict[str, Dict[str, Any]] = {}
+        self._api_keys: Dict[str, Dict[str, Any]] = {}
+        self._path = (os.path.join(data_path, "_security.json")
+                      if data_path else None)
+        self._load()
+        if "elastic" not in self._users:
+            # reserved superuser (ref: ReservedRealm + bootstrap.password)
+            self._users["elastic"] = {
+                "password": _hash_password(bootstrap_password),
+                "roles": ["superuser"], "full_name": None, "email": None,
+                "metadata": {"_reserved": True}, "enabled": True}
+
+    # ------------------------------------------------------------- persist
+    def _load(self):
+        if self._path and os.path.exists(self._path):
+            with open(self._path) as fh:
+                blob = json.load(fh)
+            self._users = blob.get("users", {})
+            self._roles = blob.get("roles", {})
+            self._api_keys = blob.get("api_keys", {})
+
+    def _persist(self):
+        if not self._path:
+            return
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"users": self._users, "roles": self._roles,
+                       "api_keys": self._api_keys}, fh)
+        os.replace(tmp, self._path)
+
+    # --------------------------------------------------------------- users
+    def put_user(self, username: str, body: Dict[str, Any]):
+        with self._lock:
+            existing = self._users.get(username, {})
+            password = body.get("password")
+            if password is None and not existing:
+                raise IllegalArgumentException(
+                    f"password must be specified unless you are updating an "
+                    f"existing user")
+            self._users[username] = {
+                "password": (_hash_password(password) if password
+                             else existing.get("password")),
+                "roles": list(body.get("roles", existing.get("roles", []))),
+                "full_name": body.get("full_name", existing.get("full_name")),
+                "email": body.get("email", existing.get("email")),
+                "metadata": body.get("metadata", existing.get("metadata", {})),
+                "enabled": body.get("enabled", True),
+            }
+            self._persist()
+        return {"created": not existing}
+
+    def get_user(self, username: Optional[str] = None) -> Dict[str, Any]:
+        if username is None:
+            return {u: self._user_obj(u).to_dict() for u in self._users}
+        if username not in self._users:
+            raise ResourceNotFoundException(f"user [{username}] not found")
+        return {username: self._user_obj(username).to_dict()}
+
+    def delete_user(self, username: str):
+        u = self._users.get(username)
+        if u is None:
+            raise ResourceNotFoundException(f"user [{username}] not found")
+        if u.get("metadata", {}).get("_reserved"):
+            raise IllegalArgumentException(
+                f"user [{username}] is reserved and cannot be deleted")
+        with self._lock:
+            del self._users[username]
+            self._persist()
+
+    def change_password(self, username: str, password: str):
+        if username not in self._users:
+            raise ResourceNotFoundException(f"user [{username}] not found")
+        with self._lock:
+            self._users[username]["password"] = _hash_password(password)
+            self._persist()
+
+    def _user_obj(self, username: str) -> User:
+        rec = self._users[username]
+        return User(username, rec.get("roles", []), rec.get("metadata"),
+                    rec.get("full_name"), rec.get("email"))
+
+    # --------------------------------------------------------------- roles
+    def put_role(self, name: str, body: Dict[str, Any]):
+        for cp in body.get("cluster", []):
+            if cp not in CLUSTER_PRIVILEGES:
+                raise IllegalArgumentException(
+                    f"unknown cluster privilege [{cp}]")
+        for grp in body.get("indices", []):
+            for ip in grp.get("privileges", []):
+                if ip not in INDEX_PRIVILEGES:
+                    raise IllegalArgumentException(
+                        f"unknown index privilege [{ip}]")
+        with self._lock:
+            created = name not in self._roles
+            self._roles[name] = {"cluster": list(body.get("cluster", [])),
+                                 "indices": list(body.get("indices", [])),
+                                 "run_as": list(body.get("run_as", [])),
+                                 "metadata": body.get("metadata", {})}
+            self._persist()
+        return {"role": {"created": created}}
+
+    def get_role(self, name: Optional[str] = None) -> Dict[str, Any]:
+        allr = {**_BUILTIN_ROLES, **self._roles}
+        if name is None:
+            return dict(allr)
+        if name not in allr:
+            raise ResourceNotFoundException(f"role [{name}] not found")
+        return {name: allr[name]}
+
+    def delete_role(self, name: str):
+        if name not in self._roles:
+            raise ResourceNotFoundException(f"role [{name}] not found")
+        with self._lock:
+            del self._roles[name]
+            self._persist()
+
+    # ------------------------------------------------------------ API keys
+    def create_api_key(self, user: User, body: Dict[str, Any]) -> Dict[str, Any]:
+        key_id = secrets.token_urlsafe(16)
+        key_secret = secrets.token_urlsafe(24)
+        expiration = body.get("expiration")
+        expires_ms = None
+        if expiration:
+            from elasticsearch_tpu.xpack.ilm import parse_time_ms
+            expires_ms = int(time.time() * 1000 + parse_time_ms(expiration))
+        with self._lock:
+            self._api_keys[key_id] = {
+                "name": body.get("name"),
+                "hash": _hash_password(key_secret),
+                "owner": user.username,
+                "roles": user.roles,
+                "role_descriptors": body.get("role_descriptors") or {},
+                "creation": int(time.time() * 1000),
+                "expiration": expires_ms,
+                "invalidated": False,
+            }
+            self._persist()
+        encoded = base64.b64encode(
+            f"{key_id}:{key_secret}".encode()).decode()
+        return {"id": key_id, "name": body.get("name"),
+                "api_key": key_secret, "encoded": encoded,
+                "expiration": expires_ms}
+
+    def get_api_keys(self) -> List[Dict[str, Any]]:
+        return [{"id": kid, "name": rec.get("name"),
+                 "username": rec.get("owner"),
+                 "creation": rec.get("creation"),
+                 "expiration": rec.get("expiration"),
+                 "invalidated": rec.get("invalidated", False)}
+                for kid, rec in self._api_keys.items()]
+
+    def invalidate_api_key(self, key_id: Optional[str] = None,
+                           name: Optional[str] = None) -> List[str]:
+        out = []
+        with self._lock:
+            for kid, rec in self._api_keys.items():
+                if (key_id and kid == key_id) or (name and rec.get("name") == name):
+                    if not rec["invalidated"]:
+                        rec["invalidated"] = True
+                        out.append(kid)
+            self._persist()
+        return out
+
+    # ---------------------------------------------------------------- authn
+    def authenticate(self, headers: Optional[Dict[str, str]]) -> User:
+        """Authorization header → User (Basic or ApiKey scheme)."""
+        headers = {k.lower(): v for k, v in (headers or {}).items()}
+        auth = headers.get("authorization")
+        if not auth:
+            raise AuthenticationException(
+                "missing authentication credentials for REST request")
+        scheme, _, payload = auth.partition(" ")
+        scheme = scheme.lower()
+        if scheme == "basic":
+            try:
+                username, _, password = base64.b64decode(
+                    payload).decode().partition(":")
+            except Exception:
+                raise AuthenticationException("invalid basic credentials")
+            rec = self._users.get(username)
+            if (rec is None or not rec.get("enabled", True)
+                    or not _verify_password(password, rec["password"])):
+                raise AuthenticationException(
+                    f"unable to authenticate user [{username}] for REST "
+                    f"request")
+            return self._user_obj(username)
+        if scheme == "apikey":
+            try:
+                key_id, _, key_secret = base64.b64decode(
+                    payload).decode().partition(":")
+            except Exception:
+                raise AuthenticationException("invalid ApiKey credentials")
+            rec = self._api_keys.get(key_id)
+            if rec is None or rec.get("invalidated"):
+                raise AuthenticationException("api key has been invalidated")
+            if rec.get("expiration") and rec["expiration"] < time.time() * 1000:
+                raise AuthenticationException("api key is expired")
+            if not _verify_password(key_secret, rec["hash"]):
+                raise AuthenticationException("invalid api key")
+            rd = rec.get("role_descriptors") or {}
+            return User(rec["owner"], rec.get("roles", []),
+                        api_key_roles=list(rd.values()) if rd else None)
+        raise AuthenticationException(
+            f"unsupported authorization scheme [{scheme}]")
+
+    # ---------------------------------------------------------------- authz
+    def _role_defs(self, user: User) -> List[Dict[str, Any]]:
+        if user.api_key_roles is not None:
+            return user.api_key_roles
+        out = []
+        allr = {**_BUILTIN_ROLES, **self._roles}
+        for r in user.roles:
+            if r in allr:
+                out.append(allr[r])
+        return out
+
+    def has_cluster_privilege(self, user: User, privilege: str) -> bool:
+        for role in self._role_defs(user):
+            for held in role.get("cluster", []):
+                if held == privilege or privilege in _CLUSTER_IMPLIES.get(
+                        held, ()):
+                    return True
+        return False
+
+    def has_index_privilege(self, user: User, index: str,
+                            privilege: str) -> bool:
+        for role in self._role_defs(user):
+            for grp in role.get("indices", []):
+                names = grp.get("names", [])
+                if not any(fnmatch.fnmatchcase(index, p) for p in names):
+                    continue
+                for held in grp.get("privileges", []):
+                    if held == privilege or privilege in _INDEX_IMPLIES.get(
+                            held, ()):
+                        return True
+        return False
+
+    def authorize(self, user: User, kind: str, privilege: str,
+                  index: Optional[str] = None):
+        if kind == "cluster":
+            if not self.has_cluster_privilege(user, privilege):
+                raise SecurityException(
+                    f"action [cluster:{privilege}] is unauthorized for user "
+                    f"[{user.username}]")
+        else:
+            if not self.has_index_privilege(user, index or "*", privilege):
+                raise SecurityException(
+                    f"action [indices:{privilege}] is unauthorized for user "
+                    f"[{user.username}], this action is granted by the "
+                    f"index privileges [{privilege},all]")
+
+    # --------------------------------------------------------------- DLS/FLS
+    def dls_query(self, user: User, index: str) -> Optional[Dict[str, Any]]:
+        """The role's DLS filter for `index` (None = unrestricted). Multiple
+        matching role queries OR together (ref: DocumentSubsetReader — a doc
+        is visible if any role's query matches)."""
+        queries = []
+        unrestricted = False
+        for role in self._role_defs(user):
+            for grp in role.get("indices", []):
+                if not any(fnmatch.fnmatchcase(index, p)
+                           for p in grp.get("names", [])):
+                    continue
+                q = grp.get("query")
+                if q is None:
+                    unrestricted = True
+                else:
+                    queries.append(json.loads(q) if isinstance(q, str) else q)
+        if unrestricted or not queries:
+            return None
+        if len(queries) == 1:
+            return queries[0]
+        return {"bool": {"should": queries, "minimum_should_match": 1}}
+
+    def fls_filter(self, user: User, index: str) -> Optional[Tuple[List[str], List[str]]]:
+        """(grant, except) field patterns, or None when unrestricted."""
+        grants: List[str] = []
+        excepts: List[str] = []
+        unrestricted = False
+        for role in self._role_defs(user):
+            for grp in role.get("indices", []):
+                if not any(fnmatch.fnmatchcase(index, p)
+                           for p in grp.get("names", [])):
+                    continue
+                fs = grp.get("field_security")
+                if fs is None:
+                    unrestricted = True
+                else:
+                    grants.extend(fs.get("grant", ["*"]))
+                    excepts.extend(fs.get("except", []))
+        if unrestricted or not grants:
+            return None
+        return grants, excepts
+
+    @staticmethod
+    def filter_source(source: Dict[str, Any],
+                      fls: Optional[Tuple[List[str], List[str]]]) -> Dict[str, Any]:
+        if fls is None:
+            return source
+        grant, excl = fls
+
+        def allowed(path: str) -> bool:
+            if any(fnmatch.fnmatchcase(path, e) for e in excl):
+                return False
+            return any(fnmatch.fnmatchcase(path, g) for g in grant)
+
+        def walk(obj: Dict[str, Any], prefix="") -> Dict[str, Any]:
+            out = {}
+            for k, v in obj.items():
+                p = f"{prefix}{k}"
+                if isinstance(v, dict):
+                    sub = walk(v, f"{p}.")
+                    if sub or allowed(p):
+                        out[k] = sub
+                elif allowed(p):
+                    out[k] = v
+            return out
+
+        return walk(source)
+
+
+# ---------------------------------------------------------------------------
+# route → required privilege (ref: the per-action privilege mapping the
+# reference derives from action names; REST routes map onto it coarsely)
+# ---------------------------------------------------------------------------
+
+_CLUSTER_PREFIXES = {
+    "_cluster": "monitor", "_nodes": "monitor", "_cat": "monitor",
+    "_stats": "monitor", "_remote": "monitor",
+    "_ilm": "manage_ilm", "_slm": "manage_slm", "_snapshot": "manage_slm",
+    "_ingest": "manage_ingest_pipelines",
+    "_template": "manage_index_templates",
+    "_index_template": "manage_index_templates",
+    "_component_template": "manage_index_templates",
+    "_scripts": "manage", "_tasks": "monitor", "_ml": "manage_ml",
+    "_transform": "manage_transform", "_watcher": "manage_watcher",
+    "_ccr": "manage_ccr", "_enrich": "manage_enrich",
+    "_rollup": "manage_rollup", "_migration": "monitor",
+    "_features": "monitor", "_data_stream": "manage_index_templates",
+    "_aliases": "manage_index_templates",
+}
+
+_READ_ENDPOINTS = {
+    "_search", "_count", "_explain", "_mget", "_msearch", "_doc",
+    "_source", "_termvectors", "_rank_eval", "_field_caps", "_validate",
+    "_terms_enum", "_graph", "_eql", "_sql", "_async_search", "_pit",
+    "_knn_search", "_percolate", "_scripts", "_analyze", "_mapping",
+    "_settings", "_alias", "_segments", "_recovery", "_stats", "_ilm",
+}
+
+_WRITE_ENDPOINTS = {"_bulk", "_update", "_create", "_update_by_query",
+                    "_delete_by_query", "_reindex", "_rollover", "_refresh",
+                    "_flush", "_forcemerge", "_freeze", "_unfreeze",
+                    "_open", "_close", "_shrink", "_split", "_clone"}
+
+
+def required_privilege(method: str, path: str) -> Tuple[str, str, Optional[str]]:
+    """(kind, privilege, index) for a REST request."""
+    parts = [p for p in path.split("/") if p]
+    if not parts:
+        return ("cluster", "monitor", None)
+    if parts[0] == "_security":
+        if len(parts) >= 2 and parts[1] == "_authenticate":
+            return ("cluster", "none", None)  # any authenticated user
+        if len(parts) >= 2 and parts[1] == "api_key" and method == "POST":
+            return ("cluster", "manage_api_key", None)
+        return ("cluster", "manage_security", None)
+    if parts[0].startswith("_"):
+        priv = _CLUSTER_PREFIXES.get(parts[0])
+        if priv is None:
+            # bare endpoints like /_search, /_bulk, /_mget run over indices
+            if parts[0] in _READ_ENDPOINTS:
+                return ("index", "read", "*")
+            if parts[0] in _WRITE_ENDPOINTS:
+                return ("index", "write", "*")
+            return ("cluster", "monitor", None)
+        return ("cluster", priv, None)
+    index = parts[0]
+    if len(parts) == 1:
+        if method == "PUT":
+            return ("index", "create_index", index)
+        if method == "DELETE":
+            return ("index", "delete_index", index)
+        return ("index", "view_index_metadata", index)
+    endpoint = next((p for p in parts[1:] if p.startswith("_")), None)
+    if endpoint in ("_doc", "_create", "_update") and method in (
+            "PUT", "POST", "DELETE"):
+        return ("index", "write", index)
+    if endpoint in _WRITE_ENDPOINTS:
+        return ("index", "write", index)
+    if endpoint in _READ_ENDPOINTS:
+        if endpoint in ("_mapping", "_settings") and method in ("PUT", "POST"):
+            return ("index", "manage", index)
+        return ("index", "read", index)
+    return ("index", "manage", index)

@@ -1,0 +1,226 @@
+"""Security tests: authn (basic + API keys), RBAC authz, DLS/FLS (model:
+the reference's AuthenticationServiceTests, AuthorizationServiceTests,
+DocumentSubsetReaderTests, FieldSubsetReaderTests)."""
+
+import base64
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.xpack.security import (
+    AuthenticationException,
+    SecurityException,
+    SecurityService,
+    User,
+    required_privilege,
+)
+
+
+def basic(user, password):
+    return {"Authorization": "Basic " + base64.b64encode(
+        f"{user}:{password}".encode()).decode()}
+
+
+@pytest.fixture()
+def node():
+    n = Node(settings=Settings.from_dict({
+        "xpack.security.enabled": True,
+        "bootstrap.password": "s3cret"}),
+        data_path=tempfile.mkdtemp())
+    yield n
+    n.close()
+
+
+ELASTIC = None  # filled per test via basic("elastic", "s3cret")
+
+
+# ---- unit: service ----
+
+def test_password_auth_roundtrip():
+    svc = SecurityService(enabled=True, bootstrap_password="pw")
+    user = svc.authenticate({"Authorization": "Basic " + base64.b64encode(
+        b"elastic:pw").decode()})
+    assert user.username == "elastic"
+    assert svc.has_cluster_privilege(user, "all")
+    with pytest.raises(AuthenticationException):
+        svc.authenticate({"Authorization": "Basic " + base64.b64encode(
+            b"elastic:wrong").decode()})
+    with pytest.raises(AuthenticationException):
+        svc.authenticate({})
+
+
+def test_rbac_privilege_implication():
+    svc = SecurityService(enabled=True)
+    svc.put_role("writer", {"cluster": ["monitor"], "indices": [
+        {"names": ["logs-*"], "privileges": ["write", "read"]}]})
+    svc.put_user("bob", {"password": "pw12345", "roles": ["writer"]})
+    u = svc.authenticate(
+        {"Authorization": "Basic " + base64.b64encode(b"bob:pw12345").decode()})
+    assert svc.has_index_privilege(u, "logs-2024", "index")   # write implies
+    assert svc.has_index_privilege(u, "logs-2024", "read")
+    assert not svc.has_index_privilege(u, "secrets", "read")  # pattern miss
+    assert not svc.has_cluster_privilege(u, "manage_security")
+    with pytest.raises(SecurityException):
+        svc.authorize(u, "index", "read", "secrets")
+
+
+def test_api_key_lifecycle():
+    svc = SecurityService(enabled=True)
+    svc.put_user("app", {"password": "pw12345", "roles": ["superuser"]})
+    owner = User("app", ["superuser"])
+    created = svc.create_api_key(owner, {"name": "ci"})
+    hdr = {"Authorization": "ApiKey " + created["encoded"]}
+    u = svc.authenticate(hdr)
+    assert u.username == "app"
+    assert svc.has_cluster_privilege(u, "all")
+    svc.invalidate_api_key(key_id=created["id"])
+    with pytest.raises(AuthenticationException):
+        svc.authenticate(hdr)
+
+
+def test_api_key_role_descriptors_limit_privileges():
+    svc = SecurityService(enabled=True)
+    owner = User("app", ["superuser"])
+    created = svc.create_api_key(owner, {"name": "limited",
+        "role_descriptors": {"ro": {"indices": [
+            {"names": ["public-*"], "privileges": ["read"]}]}}})
+    u = svc.authenticate({"Authorization": "ApiKey " + created["encoded"]})
+    assert u.username == "app"
+    assert svc.has_index_privilege(u, "public-1", "read")
+    assert not svc.has_index_privilege(u, "private", "read")
+    assert not svc.has_cluster_privilege(u, "all")
+
+
+def test_required_privilege_mapping():
+    assert required_privilege("POST", "/logs/_search") == ("index", "read", "logs")
+    assert required_privilege("PUT", "/logs/_doc/1") == ("index", "write", "logs")
+    assert required_privilege("PUT", "/logs") == ("index", "create_index", "logs")
+    assert required_privilege("DELETE", "/logs") == ("index", "delete_index", "logs")
+    assert required_privilege("GET", "/_cluster/health")[0] == "cluster"
+    assert required_privilege("PUT", "/_security/role/x") == (
+        "cluster", "manage_security", None)
+    assert required_privilege("POST", "/_bulk") == ("index", "write", "*")
+
+
+# ---- REST integration ----
+
+def test_rest_requires_auth(node):
+    c = node.rest_controller
+    s, r = c.dispatch("GET", "/_cluster/health", None, None)
+    assert s == 401
+    s, r = c.dispatch("GET", "/_cluster/health", None, None,
+                      headers=basic("elastic", "s3cret"))
+    assert s == 200, r
+
+
+def test_rest_user_crud_and_rbac(node):
+    c = node.rest_controller
+    el = basic("elastic", "s3cret")
+    s, r = c.dispatch("PUT", "/_security/role/reader", None, {
+        "cluster": ["monitor"],
+        "indices": [{"names": ["public*"], "privileges": ["read"]}]},
+        headers=el)
+    assert s == 200, r
+    s, r = c.dispatch("PUT", "/_security/user/alice", None,
+                      {"password": "alicepw1", "roles": ["reader"]},
+                      headers=el)
+    assert s == 200 and r["created"]
+    al = basic("alice", "alicepw1")
+    # authorized: read on public*
+    c.dispatch("PUT", "/public1", None, None, headers=el)
+    node.indices_service.get("public1").index_doc("1", {"v": 1})
+    node.indices_service.get("public1").refresh()
+    s, r = c.dispatch("POST", "/public1/_search", None, None, headers=al)
+    assert s == 200 and r["hits"]["total"]["value"] == 1
+    # denied: write
+    s, r = c.dispatch("PUT", "/public1/_doc/2", None, {"v": 2}, headers=al)
+    assert s == 403
+    # denied: other index
+    c.dispatch("PUT", "/private1", None, None, headers=el)
+    s, r = c.dispatch("POST", "/private1/_search", None, None, headers=al)
+    assert s == 403
+    # denied: manage security
+    s, r = c.dispatch("PUT", "/_security/role/evil", None, {}, headers=al)
+    assert s == 403
+    # _authenticate works for any authenticated user
+    s, r = c.dispatch("GET", "/_security/_authenticate", None, None, headers=al)
+    assert s == 200 and r["username"] == "alice"
+
+
+def test_dls_filters_documents(node):
+    c = node.rest_controller
+    el = basic("elastic", "s3cret")
+    c.dispatch("PUT", "/events", None, {"mappings": {"properties": {
+        "team": {"type": "keyword"}, "msg": {"type": "text"}}}}, headers=el)
+    idx = node.indices_service.get("events")
+    idx.index_doc("1", {"team": "red", "msg": "alpha"})
+    idx.index_doc("2", {"team": "blue", "msg": "beta"})
+    idx.index_doc("3", {"team": "red", "msg": "gamma"})
+    idx.refresh()
+    c.dispatch("PUT", "/_security/role/red_only", None, {
+        "indices": [{"names": ["events"], "privileges": ["read"],
+                     "query": {"term": {"team": "red"}}}]}, headers=el)
+    c.dispatch("PUT", "/_security/user/red", None,
+               {"password": "redpass1", "roles": ["red_only"]}, headers=el)
+    s, r = c.dispatch("POST", "/events/_search", None, None,
+                      headers=basic("red", "redpass1"))
+    assert s == 200, r
+    ids = {h["_id"] for h in r["hits"]["hits"]}
+    assert ids == {"1", "3"}
+    # superuser sees everything
+    s, r = c.dispatch("POST", "/events/_search", None, None, headers=el)
+    assert r["hits"]["total"]["value"] == 3
+    # DLS also applies to _count
+    s, r = c.dispatch("POST", "/events/_count", None, None,
+                      headers=basic("red", "redpass1"))
+    assert r["count"] == 2
+
+
+def test_fls_filters_fields(node):
+    c = node.rest_controller
+    el = basic("elastic", "s3cret")
+    c.dispatch("PUT", "/people", None, None, headers=el)
+    idx = node.indices_service.get("people")
+    idx.index_doc("1", {"name": "ann", "ssn": "123-45-6789", "age": 44})
+    idx.refresh()
+    c.dispatch("PUT", "/_security/role/no_pii", None, {
+        "indices": [{"names": ["people"], "privileges": ["read"],
+                     "field_security": {"grant": ["*"],
+                                        "except": ["ssn"]}}]}, headers=el)
+    c.dispatch("PUT", "/_security/user/hr", None,
+               {"password": "hrpass12", "roles": ["no_pii"]}, headers=el)
+    s, r = c.dispatch("POST", "/people/_search", None, None,
+                      headers=basic("hr", "hrpass12"))
+    assert s == 200
+    src = r["hits"]["hits"][0]["_source"]
+    assert "ssn" not in src and src["name"] == "ann" and src["age"] == 44
+    # superuser still sees the field
+    s, r = c.dispatch("POST", "/people/_search", None, None, headers=el)
+    assert "ssn" in r["hits"]["hits"][0]["_source"]
+
+
+def test_security_disabled_no_auth_needed():
+    n = Node(data_path=tempfile.mkdtemp())
+    try:
+        s, r = n.rest_controller.dispatch("GET", "/_cluster/health", None, None)
+        assert s == 200
+    finally:
+        n.close()
+
+
+def test_change_password(node):
+    c = node.rest_controller
+    el = basic("elastic", "s3cret")
+    c.dispatch("PUT", "/_security/user/carol", None,
+               {"password": "first123", "roles": ["superuser"]}, headers=el)
+    s, r = c.dispatch("PUT", "/_security/user/carol/_password", None,
+                      {"password": "second45"}, headers=el)
+    assert s == 200
+    s, _ = c.dispatch("GET", "/_cluster/health", None, None,
+                      headers=basic("carol", "first123"))
+    assert s == 401
+    s, _ = c.dispatch("GET", "/_cluster/health", None, None,
+                      headers=basic("carol", "second45"))
+    assert s == 200
