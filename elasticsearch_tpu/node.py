@@ -60,6 +60,9 @@ class Node:
         self.ilm_service = IndexLifecycleService(
             self.indices_service, self.metadata_service,
             self.repositories_service, self.data_path, self.slm_service)
+        from elasticsearch_tpu.transport.persistent import (
+            PersistentTasksService)
+        self.persistent_tasks = PersistentTasksService(self.data_path)
         from elasticsearch_tpu.xpack.security import SecurityService
         self.security_service = SecurityService(
             self.data_path,
@@ -86,4 +89,5 @@ class Node:
 
     def close(self):
         self.stop()
+        self.persistent_tasks.stop_all()
         self.indices_service.close()

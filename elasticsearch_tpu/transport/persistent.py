@@ -1,0 +1,194 @@
+"""Persistent tasks: durable task assignments that survive restarts.
+
+ref: server persistent/ — PersistentTasksClusterService stores task rows in
+cluster state (PersistentTasksCustomMetadata), the master assigns each to a
+node, PersistentTasksNodeService starts an AllocatedPersistentTask via the
+registered PersistentTasksExecutor; tasks checkpoint state and are
+reassigned after restart. CCR follow tasks, transforms, and ML jobs all
+ride this (ref: node/Node.java:581-592).
+
+Here the registry persists to disk under the node data path (the cluster
+state analogue) and `reassign()` restarts unfinished tasks through their
+executors — called on service construction, so a rebuilt node resumes its
+tasks exactly as the reference's node service does when cluster state
+arrives.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ResourceNotFoundException,
+)
+
+
+class AllocatedPersistentTask:
+    """A running instance handed to executors (ref:
+    AllocatedPersistentTask): carries params + mutable state, exposes
+    checkpointing and completion."""
+
+    def __init__(self, service: "PersistentTasksService", task_id: str,
+                 task_name: str, params: Dict[str, Any],
+                 state: Optional[Dict[str, Any]]):
+        self.service = service
+        self.id = task_id
+        self.task_name = task_name
+        self.params = params
+        self.state = state or {}
+        self.cancelled = threading.Event()
+
+    def update_state(self, state: Dict[str, Any]):
+        """Checkpoint progress (ref: updatePersistentTaskState — CCR/
+        transform store seqno checkpoints here)."""
+        self.state = state
+        self.service._update_state(self.id, state)
+
+    def complete(self):
+        self.service._complete(self.id)
+
+    def fail(self, reason: str):
+        self.service._fail(self.id, reason)
+
+    def is_cancelled(self) -> bool:
+        return self.cancelled.is_set()
+
+
+# executor: called to (re)start a task; returns an object with an optional
+# `stop()` — threads, schedulers, or nothing for poll-driven tasks
+Executor = Callable[[AllocatedPersistentTask], Any]
+
+
+class PersistentTasksService:
+    def __init__(self, data_path: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._executors: Dict[str, Executor] = {}
+        self._rows: Dict[str, Dict[str, Any]] = {}
+        self._live: Dict[str, AllocatedPersistentTask] = {}
+        self._handles: Dict[str, Any] = {}
+        self._path = (os.path.join(data_path, "_persistent_tasks.json")
+                      if data_path else None)
+        if self._path and os.path.exists(self._path):
+            with open(self._path) as fh:
+                self._rows = json.load(fh)
+
+    def _persist(self):
+        if not self._path:
+            return
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self._rows, fh)
+        os.replace(tmp, self._path)
+
+    # ----------------------------------------------------------- registry
+    def register_executor(self, task_name: str, executor: Executor):
+        self._executors[task_name] = executor
+
+    def reassign(self):
+        """(Re)start every unfinished task with a registered executor —
+        the restart-recovery path (ref: PersistentTasksNodeService
+        startTask on cluster-state application)."""
+        for task_id, row in list(self._rows.items()):
+            if row.get("finished") or task_id in self._live:
+                continue
+            ex = self._executors.get(row["task_name"])
+            if ex is None:
+                continue
+            self._start_allocated(task_id, row, ex)
+
+    # ---------------------------------------------------------- lifecycle
+    def start_task(self, task_name: str, params: Dict[str, Any],
+                   task_id: Optional[str] = None) -> str:
+        if task_name not in self._executors:
+            raise IllegalArgumentException(
+                f"unknown persistent task [{task_name}]")
+        task_id = task_id or uuid.uuid4().hex[:16]
+        with self._lock:
+            if task_id in self._rows and not self._rows[task_id].get("finished"):
+                raise IllegalArgumentException(
+                    f"task with id [{task_id}] already exists")
+            row = {"task_name": task_name, "params": params, "state": {},
+                   "allocation_id": 1, "finished": False, "failure": None,
+                   "start_time": int(time.time() * 1000)}
+            self._rows[task_id] = row
+            self._persist()
+        self._start_allocated(task_id, row, self._executors[task_name])
+        return task_id
+
+    def _start_allocated(self, task_id: str, row: Dict[str, Any],
+                         executor: Executor):
+        task = AllocatedPersistentTask(self, task_id, row["task_name"],
+                                       row.get("params", {}),
+                                       row.get("state"))
+        self._live[task_id] = task
+        handle = executor(task)
+        if handle is not None:
+            self._handles[task_id] = handle
+
+    def cancel_task(self, task_id: str):
+        """Remove the task (ref: TransportRemovePersistentTaskAction)."""
+        with self._lock:
+            if task_id not in self._rows:
+                raise ResourceNotFoundException(
+                    f"persistent task [{task_id}] not found")
+            live = self._live.pop(task_id, None)
+            handle = self._handles.pop(task_id, None)
+            del self._rows[task_id]
+            self._persist()
+        if live is not None:
+            live.cancelled.set()
+        if handle is not None and hasattr(handle, "stop"):
+            handle.stop()
+
+    # ------------------------------------------------------- task callbacks
+    def _update_state(self, task_id: str, state: Dict[str, Any]):
+        with self._lock:
+            if task_id in self._rows:
+                self._rows[task_id]["state"] = state
+                self._persist()
+
+    def _complete(self, task_id: str):
+        with self._lock:
+            if task_id in self._rows:
+                self._rows[task_id]["finished"] = True
+                self._persist()
+            self._live.pop(task_id, None)
+            self._handles.pop(task_id, None)
+
+    def _fail(self, task_id: str, reason: str):
+        with self._lock:
+            if task_id in self._rows:
+                self._rows[task_id]["finished"] = True
+                self._rows[task_id]["failure"] = reason
+                self._persist()
+            self._live.pop(task_id, None)
+            self._handles.pop(task_id, None)
+
+    # -------------------------------------------------------------- lookup
+    def get(self, task_id: str) -> Dict[str, Any]:
+        if task_id not in self._rows:
+            raise ResourceNotFoundException(
+                f"persistent task [{task_id}] not found")
+        return {"id": task_id, **self._rows[task_id]}
+
+    def live_task(self, task_id: str) -> Optional[AllocatedPersistentTask]:
+        return self._live.get(task_id)
+
+    def list(self, task_name: Optional[str] = None) -> List[Dict[str, Any]]:
+        return [{"id": tid, **row} for tid, row in self._rows.items()
+                if task_name is None or row["task_name"] == task_name]
+
+    def stop_all(self):
+        for task_id in list(self._live):
+            task = self._live.pop(task_id, None)
+            if task is not None:
+                task.cancelled.set()
+            handle = self._handles.pop(task_id, None)
+            if handle is not None and hasattr(handle, "stop"):
+                handle.stop()

@@ -1,0 +1,112 @@
+"""Persistent-task framework tests (model: the reference's
+PersistentTasksClusterService/NodeService tests: assignment, state
+checkpointing, restart recovery, cancellation)."""
+
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ResourceNotFoundException,
+)
+from elasticsearch_tpu.transport.persistent import PersistentTasksService
+
+
+class RecordingExecutor:
+    """Poll-driven executor: records started tasks; tests drive progress."""
+
+    def __init__(self):
+        self.started = []
+
+    def __call__(self, task):
+        self.started.append(task)
+        return self
+
+
+def test_start_checkpoint_complete():
+    svc = PersistentTasksService()
+    ex = RecordingExecutor()
+    svc.register_executor("test/counter", ex)
+    tid = svc.start_task("test/counter", {"target": 3})
+    assert len(ex.started) == 1
+    task = ex.started[0]
+    assert task.params == {"target": 3}
+    task.update_state({"count": 2})
+    assert svc.get(tid)["state"] == {"count": 2}
+    task.complete()
+    assert svc.get(tid)["finished"] is True
+
+
+def test_unknown_task_name_rejected():
+    svc = PersistentTasksService()
+    with pytest.raises(IllegalArgumentException):
+        svc.start_task("nope", {})
+
+
+def test_restart_reassigns_unfinished_tasks():
+    path = tempfile.mkdtemp()
+    svc1 = PersistentTasksService(path)
+    ex1 = RecordingExecutor()
+    svc1.register_executor("test/follow", ex1)
+    tid = svc1.start_task("test/follow", {"leader": "l1"})
+    ex1.started[0].update_state({"checkpoint": 42})
+
+    # simulate restart: new service over the same data path
+    svc2 = PersistentTasksService(path)
+    ex2 = RecordingExecutor()
+    svc2.register_executor("test/follow", ex2)
+    svc2.reassign()
+    assert len(ex2.started) == 1
+    resumed = ex2.started[0]
+    assert resumed.id == tid
+    assert resumed.state == {"checkpoint": 42}   # resumes from checkpoint
+    assert resumed.params == {"leader": "l1"}
+
+
+def test_finished_tasks_not_reassigned():
+    path = tempfile.mkdtemp()
+    svc1 = PersistentTasksService(path)
+    ex1 = RecordingExecutor()
+    svc1.register_executor("test/x", ex1)
+    svc1.start_task("test/x", {})
+    ex1.started[0].complete()
+
+    svc2 = PersistentTasksService(path)
+    ex2 = RecordingExecutor()
+    svc2.register_executor("test/x", ex2)
+    svc2.reassign()
+    assert ex2.started == []
+
+
+def test_cancel_sets_cancelled_and_removes():
+    svc = PersistentTasksService()
+    ex = RecordingExecutor()
+    svc.register_executor("test/y", ex)
+    tid = svc.start_task("test/y", {})
+    task = ex.started[0]
+    svc.cancel_task(tid)
+    assert task.is_cancelled()
+    with pytest.raises(ResourceNotFoundException):
+        svc.get(tid)
+
+
+def test_fail_records_reason():
+    svc = PersistentTasksService()
+    ex = RecordingExecutor()
+    svc.register_executor("test/z", ex)
+    tid = svc.start_task("test/z", {})
+    ex.started[0].fail("boom")
+    row = svc.get(tid)
+    assert row["finished"] and row["failure"] == "boom"
+
+
+def test_list_filters_by_name():
+    svc = PersistentTasksService()
+    ex = RecordingExecutor()
+    svc.register_executor("a", ex)
+    svc.register_executor("b", ex)
+    svc.start_task("a", {})
+    svc.start_task("b", {})
+    assert len(svc.list()) == 2
+    assert len(svc.list("a")) == 1
