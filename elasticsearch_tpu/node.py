@@ -63,6 +63,10 @@ class Node:
         from elasticsearch_tpu.transport.persistent import (
             PersistentTasksService)
         self.persistent_tasks = PersistentTasksService(self.data_path)
+        from elasticsearch_tpu.xpack.transform import TransformService
+        self.transform_service = TransformService(
+            self.indices_service, self.search_service,
+            self.persistent_tasks, self.data_path)
         from elasticsearch_tpu.xpack.security import SecurityService
         self.security_service = SecurityService(
             self.data_path,

@@ -226,6 +226,16 @@ def _register_all(c: RestController):
     c.register("GET", "/_snapshot/{repo}/{snap}", get_snapshot)
     c.register("DELETE", "/_snapshot/{repo}/{snap}", delete_snapshot)
     c.register("POST", "/_snapshot/{repo}/{snap}/_restore", restore_snapshot)
+    # transform
+    c.register("PUT", "/_transform/{id}", transform_put)
+    c.register("GET", "/_transform/{id}", transform_get)
+    c.register("GET", "/_transform", transform_get)
+    c.register("DELETE", "/_transform/{id}", transform_delete)
+    c.register("POST", "/_transform/_preview", transform_preview)
+    c.register("POST", "/_transform/{id}/_start", transform_start)
+    c.register("POST", "/_transform/{id}/_stop", transform_stop)
+    c.register("GET", "/_transform/{id}/_stats", transform_stats)
+    c.register("POST", "/_transform/{id}/_schedule_now", transform_schedule_now)
     # security
     c.register("GET", "/_security/_authenticate", security_authenticate)
     c.register("PUT", "/_security/user/{name}", security_put_user)
@@ -1435,6 +1445,45 @@ def restore_snapshot(node, params, body, repo, snap):
         rename_pattern=body.get("rename_pattern"),
         rename_replacement=body.get("rename_replacement"))
     return 200, result
+
+
+def transform_put(node, params, body, id):
+    node.transform_service.put_transform(id, body or {})
+    return 200, {"acknowledged": True}
+
+
+def transform_get(node, params, body, id=None):
+    return 200, node.transform_service.get_transform(id)
+
+
+def transform_delete(node, params, body, id):
+    node.transform_service.delete_transform(
+        id, force=params.get("force") == "true")
+    return 200, {"acknowledged": True}
+
+
+def transform_preview(node, params, body):
+    return 200, node.transform_service.preview(body or {})
+
+
+def transform_start(node, params, body, id):
+    node.transform_service.start_transform(id)
+    return 200, {"acknowledged": True}
+
+
+def transform_stop(node, params, body, id):
+    node.transform_service.stop_transform(id)
+    return 200, {"acknowledged": True}
+
+
+def transform_stats(node, params, body, id):
+    return 200, {"count": 1,
+                 "transforms": [node.transform_service.get_stats(id)]}
+
+
+def transform_schedule_now(node, params, body, id):
+    node.transform_service.trigger(id)
+    return 200, {"acknowledged": True}
 
 
 def security_authenticate(node, params, body):

@@ -1,0 +1,291 @@
+"""Transforms: continuous pivot/latest from a source index into a dest.
+
+ref: x-pack/plugin/transform — TransformConfig (source/dest/pivot|latest/
+sync), TransformTask as a persistent task, TransformIndexer runs
+checkpoints: a batch transform processes everything once and completes; a
+continuous transform re-runs on a schedule, checkpointing by the sync
+field so only new data advances it.
+
+Execution maps the pivot to the aggregation tree (group_by → nested
+terms/histogram/date_histogram buckets, aggregations computed per leaf
+bucket) and bulk-writes one dest doc per composite bucket key — i.e. the
+transform is a scatter-gather aggregation job on device, not a per-doc
+scan. Change detection recomputes the full pivot per checkpoint (the
+reference narrows to changed buckets; with columnar segment masks a full
+recompute is a batched kernel pass — noted as the optimization point).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ResourceAlreadyExistsException,
+    ResourceNotFoundException,
+)
+
+TASK_NAME = "data_frame/transforms"
+
+
+class TransformService:
+    def __init__(self, indices_service, search_service, persistent_tasks,
+                 data_path: Optional[str] = None):
+        self.indices = indices_service
+        self.search = search_service
+        self.persistent = persistent_tasks
+        self._lock = threading.Lock()
+        self._configs: Dict[str, Dict[str, Any]] = {}
+        self._stats: Dict[str, Dict[str, Any]] = {}
+        self._path = (os.path.join(data_path, "_transforms.json")
+                      if data_path else None)
+        if self._path and os.path.exists(self._path):
+            with open(self._path) as fh:
+                blob = json.load(fh)
+            self._configs = blob.get("configs", {})
+            self._stats = blob.get("stats", {})
+        persistent_tasks.register_executor(TASK_NAME, self._executor)
+
+    def _persist(self):
+        if not self._path:
+            return
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"configs": self._configs, "stats": self._stats}, fh)
+        os.replace(tmp, self._path)
+
+    # ------------------------------------------------------------ registry
+    def put_transform(self, transform_id: str, config: Dict[str, Any]):
+        if transform_id in self._configs:
+            raise ResourceAlreadyExistsException(
+                f"transform with id [{transform_id}] already exists")
+        self._validate(config)
+        with self._lock:
+            self._configs[transform_id] = dict(
+                config, id=transform_id,
+                create_time=int(time.time() * 1000))
+            self._stats[transform_id] = {
+                "state": "stopped", "checkpoint": 0, "documents_indexed": 0,
+                "documents_processed": 0, "trigger_count": 0}
+            self._persist()
+
+    @staticmethod
+    def _validate(config: Dict[str, Any]):
+        src = config.get("source", {})
+        if not src.get("index"):
+            raise IllegalArgumentException("transform requires [source.index]")
+        if not config.get("dest", {}).get("index"):
+            raise IllegalArgumentException("transform requires [dest.index]")
+        has_pivot = "pivot" in config
+        has_latest = "latest" in config
+        if has_pivot == has_latest:
+            raise IllegalArgumentException(
+                "transform requires exactly one of [pivot] or [latest]")
+        if has_pivot:
+            piv = config["pivot"]
+            if not piv.get("group_by"):
+                raise IllegalArgumentException("pivot requires [group_by]")
+            if not piv.get("aggregations", piv.get("aggs")):
+                raise IllegalArgumentException("pivot requires [aggregations]")
+        else:
+            lat = config["latest"]
+            if not lat.get("unique_key") or not lat.get("sort"):
+                raise IllegalArgumentException(
+                    "latest requires [unique_key] and [sort]")
+
+    def get_transform(self, transform_id: Optional[str] = None):
+        if transform_id is None or transform_id in ("_all", "*"):
+            return {"count": len(self._configs),
+                    "transforms": [self._configs[t]
+                                   for t in sorted(self._configs)]}
+        if transform_id not in self._configs:
+            raise ResourceNotFoundException(
+                f"transform with id [{transform_id}] not found")
+        return {"count": 1, "transforms": [self._configs[transform_id]]}
+
+    def delete_transform(self, transform_id: str, force: bool = False):
+        if transform_id not in self._configs:
+            raise ResourceNotFoundException(
+                f"transform with id [{transform_id}] not found")
+        state = self._stats[transform_id]["state"]
+        if state == "started" and not force:
+            raise IllegalArgumentException(
+                f"cannot delete transform [{transform_id}] as the task is "
+                f"running. Stop the transform first")
+        with self._lock:
+            self._configs.pop(transform_id)
+            self._stats.pop(transform_id)
+            self._persist()
+
+    def get_stats(self, transform_id: str) -> Dict[str, Any]:
+        if transform_id not in self._configs:
+            raise ResourceNotFoundException(
+                f"transform with id [{transform_id}] not found")
+        return {"id": transform_id, **self._stats[transform_id]}
+
+    # ----------------------------------------------------------- lifecycle
+    def start_transform(self, transform_id: str):
+        if transform_id not in self._configs:
+            raise ResourceNotFoundException(
+                f"transform with id [{transform_id}] not found")
+        st = self._stats[transform_id]
+        if st["state"] == "started":
+            raise ResourceAlreadyExistsException(
+                f"transform [{transform_id}] is already started")
+        st["state"] = "started"
+        self._persist()
+        self.persistent.start_task(TASK_NAME, {"transform_id": transform_id},
+                                   task_id=f"transform-{transform_id}")
+
+    def stop_transform(self, transform_id: str):
+        st = self._stats.get(transform_id)
+        if st is None:
+            raise ResourceNotFoundException(
+                f"transform with id [{transform_id}] not found")
+        st["state"] = "stopped"
+        self._persist()
+        try:
+            self.persistent.cancel_task(f"transform-{transform_id}")
+        except ResourceNotFoundException:
+            pass
+
+    def _executor(self, task):
+        """Persistent-task entry: batch transforms run to completion on
+        start; continuous ones wait for trigger()/tick()."""
+        transform_id = task.params["transform_id"]
+        config = self._configs.get(transform_id)
+        if config is None:
+            task.fail(f"transform [{transform_id}] is missing")
+            return None
+        if "sync" not in config:
+            self._run_checkpoint(transform_id, task)
+            self._stats[transform_id]["state"] = "stopped"
+            self._persist()
+            task.complete()
+        return None
+
+    def trigger(self, transform_id: str):
+        """Run one checkpoint of a continuous transform now (the schedule
+        trigger; ref: TransformScheduler)."""
+        task = self.persistent.live_task(f"transform-{transform_id}")
+        self._run_checkpoint(transform_id, task)
+
+    def tick(self):
+        for tid, st in self._stats.items():
+            if st["state"] == "started" and "sync" in self._configs[tid]:
+                self.trigger(tid)
+
+    # ----------------------------------------------------------- execution
+    def preview(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        self._validate(config)
+        docs = self._compute(config)
+        return {"preview": [src for _id, src in docs],
+                "generated_dest_index": {
+                    "mappings": {"_meta": {"_transform": {
+                        "creation_date_in_millis": int(time.time() * 1000)}}}}}
+
+    def _run_checkpoint(self, transform_id: str, task=None):
+        config = self._configs[transform_id]
+        st = self._stats[transform_id]
+        docs = self._compute(config)
+        dest = config["dest"]["index"]
+        if not self.indices.has(dest):
+            self.indices.create_index(dest)
+        dest_idx = self.indices.get(dest)
+        for doc_id, source in docs:
+            dest_idx.index_doc(doc_id, source)
+        dest_idx.refresh()
+        st["checkpoint"] += 1
+        st["trigger_count"] += 1
+        st["documents_indexed"] += len(docs)
+        st["documents_processed"] += len(docs)
+        if task is not None:
+            task.update_state({"checkpoint": st["checkpoint"]})
+        self._persist()
+
+    def _compute(self, config: Dict[str, Any]) -> List[Tuple[str, Dict[str, Any]]]:
+        if "pivot" in config:
+            return self._compute_pivot(config)
+        return self._compute_latest(config)
+
+    # -- pivot: nested bucket aggs walked into flat composite rows
+    def _compute_pivot(self, config) -> List[Tuple[str, Dict[str, Any]]]:
+        src = config["source"]
+        pivot = config["pivot"]
+        group_by: Dict[str, Dict[str, Any]] = pivot["group_by"]
+        aggs = pivot.get("aggregations", pivot.get("aggs", {}))
+        names = list(group_by)
+        # build the nested agg tree innermost-out
+        tree: Dict[str, Any] = dict(aggs)
+        for name in reversed(names):
+            spec = group_by[name]
+            (gtype, gbody), = spec.items()
+            if gtype not in ("terms", "histogram", "date_histogram"):
+                raise IllegalArgumentException(
+                    f"unsupported group_by type [{gtype}]")
+            gbody = dict(gbody)
+            if gtype == "terms":
+                gbody.setdefault("size", 10_000)
+            tree = {name: {gtype: gbody, "aggs": tree}}
+        body = {"size": 0, "query": src.get("query", {"match_all": {}}),
+                "aggs": tree}
+        result = self.search.search(_index_expr(src["index"]), body)
+        rows: List[Tuple[str, Dict[str, Any]]] = []
+
+        def walk(agg_obj, depth: int, key_acc: Dict[str, Any]):
+            name = names[depth]
+            for bucket in agg_obj[name]["buckets"]:
+                acc = dict(key_acc)
+                acc[name] = bucket.get("key_as_string", bucket["key"])
+                if depth + 1 < len(names):
+                    walk(bucket, depth + 1, acc)
+                else:
+                    row = dict(acc)
+                    for agg_name in aggs:
+                        val = bucket.get(agg_name, {})
+                        row[agg_name] = (val.get("value")
+                                         if isinstance(val, dict)
+                                         and "value" in val else val)
+                    doc_id = hashlib.sha1(json.dumps(
+                        acc, sort_keys=True).encode()).hexdigest()[:20]
+                    rows.append((doc_id, row))
+
+        walk(result["aggregations"], 0, {})
+        return rows
+
+    # -- latest: newest doc per unique key
+    def _compute_latest(self, config) -> List[Tuple[str, Dict[str, Any]]]:
+        src = config["source"]
+        latest = config["latest"]
+        unique_key = latest["unique_key"]
+        sort_field = latest["sort"]
+        body = {"size": 10_000, "query": src.get("query", {"match_all": {}}),
+                "sort": [{sort_field: "desc"}]}
+        result = self.search.search(_index_expr(src["index"]), body)
+        seen: Dict[str, Tuple[str, Dict[str, Any]]] = {}
+        for hit in result["hits"]["hits"]:
+            source = hit["_source"]
+            key = tuple(str(_get_path(source, k)) for k in unique_key)
+            if key not in seen:
+                doc_id = hashlib.sha1(
+                    json.dumps(key).encode()).hexdigest()[:20]
+                seen[key] = (doc_id, source)
+        return list(seen.values())
+
+
+def _index_expr(index) -> str:
+    return ",".join(index) if isinstance(index, list) else str(index)
+
+
+def _get_path(source: Dict[str, Any], path: str):
+    cur: Any = source
+    for part in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur

@@ -1,0 +1,166 @@
+"""Transform tests: pivot/latest compute, batch vs continuous checkpoints,
+preview, REST (model: the reference's TransformIndexerTests /
+TransformConfigTests)."""
+
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ResourceNotFoundException,
+)
+from elasticsearch_tpu.node import Node
+
+SALES = [
+    {"store": "berlin", "item": "shirt", "price": 10.0, "ts": "2024-01-01"},
+    {"store": "berlin", "item": "shoes", "price": 50.0, "ts": "2024-01-02"},
+    {"store": "paris", "item": "shirt", "price": 12.0, "ts": "2024-01-02"},
+    {"store": "paris", "item": "hat", "price": 8.0, "ts": "2024-01-03"},
+    {"store": "berlin", "item": "hat", "price": 9.0, "ts": "2024-01-04"},
+]
+
+
+@pytest.fixture()
+def node():
+    n = Node(data_path=tempfile.mkdtemp())
+    idx = n.indices_service.create_index("sales", mappings={"properties": {
+        "store": {"type": "keyword"}, "item": {"type": "keyword"},
+        "price": {"type": "float"}, "ts": {"type": "date"}}})
+    for i, d in enumerate(SALES):
+        idx.index_doc(str(i), d)
+    idx.refresh()
+    yield n
+    n.close()
+
+
+PIVOT_CONFIG = {
+    "source": {"index": "sales"},
+    "dest": {"index": "sales_by_store"},
+    "pivot": {
+        "group_by": {"store": {"terms": {"field": "store"}}},
+        "aggregations": {"revenue": {"sum": {"field": "price"}},
+                         "avg_price": {"avg": {"field": "price"}}}},
+}
+
+
+def search_dest(node, index):
+    r = node.search_service.search(index, {"size": 100})
+    return {h["_source"]["store"]: h["_source"] for h in r["hits"]["hits"]}
+
+
+def test_batch_pivot(node):
+    ts = node.transform_service
+    ts.put_transform("by-store", PIVOT_CONFIG)
+    ts.start_transform("by-store")   # batch: runs to completion
+    by_store = search_dest(node, "sales_by_store")
+    assert by_store["berlin"]["revenue"] == pytest.approx(69.0)
+    assert by_store["paris"]["revenue"] == pytest.approx(20.0)
+    assert by_store["berlin"]["avg_price"] == pytest.approx(23.0)
+    st = ts.get_stats("by-store")
+    assert st["state"] == "stopped"           # batch completes
+    assert st["documents_indexed"] == 2
+    assert st["checkpoint"] == 1
+
+
+def test_multi_group_by(node):
+    ts = node.transform_service
+    cfg = {
+        "source": {"index": "sales"},
+        "dest": {"index": "by_store_item"},
+        "pivot": {"group_by": {
+            "store": {"terms": {"field": "store"}},
+            "item": {"terms": {"field": "item"}}},
+            "aggregations": {"n": {"value_count": {"field": "price"}}}},
+    }
+    ts.put_transform("bsi", cfg)
+    ts.start_transform("bsi")
+    r = node.search_service.search("by_store_item", {"size": 100})
+    rows = {(h["_source"]["store"], h["_source"]["item"]) for h in
+            r["hits"]["hits"]}
+    assert ("berlin", "shirt") in rows and ("paris", "hat") in rows
+    assert len(rows) == 5
+
+
+def test_continuous_transform_checkpoints(node):
+    ts = node.transform_service
+    cfg = dict(PIVOT_CONFIG, sync={"time": {"field": "ts"}},
+               dest={"index": "cont_dest"})
+    ts.put_transform("cont", cfg)
+    ts.start_transform("cont")
+    assert ts.get_stats("cont")["state"] == "started"  # continuous stays up
+    ts.trigger("cont")
+    assert search_dest(node, "cont_dest")["berlin"]["revenue"] == \
+        pytest.approx(69.0)
+    # new data arrives; next trigger updates the bucket doc in place
+    idx = node.indices_service.get("sales")
+    idx.index_doc("5", {"store": "berlin", "item": "coat", "price": 31.0,
+                        "ts": "2024-01-05"})
+    idx.refresh()
+    ts.trigger("cont")
+    assert search_dest(node, "cont_dest")["berlin"]["revenue"] == \
+        pytest.approx(100.0)
+    st = ts.get_stats("cont")
+    assert st["checkpoint"] == 2
+    ts.stop_transform("cont")
+    assert ts.get_stats("cont")["state"] == "stopped"
+
+
+def test_latest_transform(node):
+    ts = node.transform_service
+    cfg = {"source": {"index": "sales"},
+           "dest": {"index": "latest_per_store"},
+           "latest": {"unique_key": ["store"], "sort": "ts"}}
+    ts.put_transform("latest", cfg)
+    ts.start_transform("latest")
+    by_store = search_dest(node, "latest_per_store")
+    assert by_store["berlin"]["item"] == "hat"     # 2024-01-04 newest
+    assert by_store["paris"]["item"] == "hat"      # 2024-01-03 newest
+
+
+def test_preview_does_not_write(node):
+    ts = node.transform_service
+    out = ts.preview(PIVOT_CONFIG)
+    assert len(out["preview"]) == 2
+    assert not node.indices_service.has("sales_by_store")
+
+
+def test_validation(node):
+    ts = node.transform_service
+    with pytest.raises(IllegalArgumentException):
+        ts.put_transform("bad1", {"source": {"index": "s"},
+                                  "dest": {"index": "d"}})
+    with pytest.raises(IllegalArgumentException):
+        ts.put_transform("bad2", {
+            "source": {"index": "s"}, "dest": {"index": "d"},
+            "pivot": {"group_by": {"a": {"terms": {"field": "x"}}}},
+            "latest": {"unique_key": ["k"], "sort": "t"}})
+
+
+def test_delete_running_rejected(node):
+    ts = node.transform_service
+    cfg = dict(PIVOT_CONFIG, sync={"time": {"field": "ts"}},
+               dest={"index": "d2"})
+    ts.put_transform("run", cfg)
+    ts.start_transform("run")
+    with pytest.raises(IllegalArgumentException):
+        ts.delete_transform("run")
+    ts.delete_transform("run", force=True)
+    with pytest.raises(ResourceNotFoundException):
+        ts.get_stats("run")
+
+
+def test_rest_roundtrip(node):
+    c = node.rest_controller
+    s, r = c.dispatch("PUT", "/_transform/t1", None, PIVOT_CONFIG)
+    assert s == 200
+    s, r = c.dispatch("GET", "/_transform/t1", None, None)
+    assert s == 200 and r["transforms"][0]["id"] == "t1"
+    s, r = c.dispatch("POST", "/_transform/_preview", None, PIVOT_CONFIG)
+    assert s == 200 and len(r["preview"]) == 2
+    s, r = c.dispatch("POST", "/_transform/t1/_start", None, None)
+    assert s == 200
+    s, r = c.dispatch("GET", "/_transform/t1/_stats", None, None)
+    assert r["transforms"][0]["documents_indexed"] == 2
+    s, r = c.dispatch("DELETE", "/_transform/t1", None, None)
+    assert s == 200
