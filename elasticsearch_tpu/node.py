@@ -73,6 +73,8 @@ class Node:
             enabled=bool(settings.get("xpack.security.enabled", False)),
             bootstrap_password=str(
                 settings.get("bootstrap.password", "changeme")))
+        from elasticsearch_tpu.xpack.sql import SqlService
+        self.sql_service = SqlService(self)
         # per-request thread-local context (authenticated user)
         import threading
         self.request_context = threading.local()

@@ -227,6 +227,12 @@ def _register_all(c: RestController):
     c.register("DELETE", "/_snapshot/{repo}/{snap}", delete_snapshot)
     c.register("POST", "/_snapshot/{repo}/{snap}/_restore", restore_snapshot)
     # transform
+    # SQL (ref: x-pack/plugin/sql REST layer)
+    c.register("POST", "/_sql", sql_query)
+    c.register("GET", "/_sql", sql_query)
+    c.register("POST", "/_sql/translate", sql_translate)
+    c.register("GET", "/_sql/translate", sql_translate)
+    c.register("POST", "/_sql/close", sql_close)
     c.register("PUT", "/_transform/{id}", transform_put)
     c.register("GET", "/_transform/{id}", transform_get)
     c.register("GET", "/_transform", transform_get)
@@ -1667,3 +1673,67 @@ def rank_eval_handler(node, params, body, index):
     result = rank_eval(search_fn, body.get("requests", []),
                        body.get("metric", {"recall": {"k": 10}}))
     return 200, result
+
+
+# --------------------------------------------------------------------------
+# SQL (ref: x-pack/plugin/sql/.../rest/RestSqlQueryAction.java)
+# --------------------------------------------------------------------------
+
+def _sql_text_formats(result, fmt):
+    cols = result.get("columns", [])
+    rows = result.get("rows", [])
+    names = [c["name"] for c in cols]
+    if fmt in ("csv", "tsv"):
+        sep = "," if fmt == "csv" else "\t"
+        def esc(v):
+            s = "" if v is None else str(v)
+            if fmt == "csv" and (sep in s or '"' in s or "\n" in s):
+                s = '"' + s.replace('"', '""') + '"'
+            return s
+        lines = [sep.join(esc(n) for n in names)] if names else []
+        lines += [sep.join(esc(v) for v in row) for row in rows]
+        return "\n".join(lines)
+    # txt: aligned table like the reference's CLI format; continuation
+    # pages carry no column headers — rows only
+    strs = [[("null" if v is None else str(v)) for v in row]
+            for row in rows]
+    if not names:
+        widths = [max((len(r[j]) for r in strs), default=1)
+                  for j in range(len(strs[0]) if strs else 0)]
+        out = []
+    else:
+        widths = [max([len(n)] + [len(r[j]) for r in strs])
+                  for j, n in enumerate(names)]
+        out = ["|".join(n.ljust(w) for n, w in zip(names, widths)),
+               "+".join("-" * w for w in widths)]
+    out += ["|".join(v.ljust(w) for v, w in zip(row, widths))
+            for row in strs]
+    return "\n".join(out)
+
+
+def sql_query(node, params, body):
+    body = dict(body or {})
+    if "query" in params and "query" not in body:
+        body["query"] = params["query"]
+    with node.task_manager.task_scope(
+            "transport", "indices:data/read/sql",
+            description="sql", cancellable=True):
+        result = node.sql_service.query(body)
+    fmt = params.get("format", "json")
+    if fmt in ("txt", "csv", "tsv"):
+        out = {"_cat": _sql_text_formats(result, fmt)}
+        if "cursor" in result:
+            # text formats return the cursor via the Cursor response
+            # header (ref: RestSqlQueryAction text formats)
+            out["_headers"] = {"Cursor": result["cursor"]}
+        return 200, out
+    return 200, result
+
+
+def sql_translate(node, params, body):
+    return 200, node.sql_service.translate(body or {})
+
+
+def sql_close(node, params, body):
+    found = node.sql_service.close_cursor((body or {}).get("cursor", ""))
+    return 200, {"succeeded": found}

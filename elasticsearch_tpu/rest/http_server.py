@@ -45,6 +45,10 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(status, payload, head_only=(method == "HEAD"))
 
     def _send(self, status: int, payload, head_only: bool = False):
+        extra_headers = {}
+        if isinstance(payload, dict) and "_headers" in payload:
+            payload = dict(payload)
+            extra_headers = payload.pop("_headers")
         if isinstance(payload, dict) and "_cat" in payload and len(payload) == 1:
             data = (payload["_cat"] + "\n").encode()
             ctype = "text/plain; charset=UTF-8"
@@ -52,6 +56,8 @@ class _Handler(BaseHTTPRequestHandler):
             data = json.dumps(payload).encode()
             ctype = "application/json; charset=UTF-8"
         self.send_response(status)
+        for hk, hv in extra_headers.items():
+            self.send_header(hk, hv)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         self.send_header("X-elastic-product", "Elasticsearch")

@@ -40,7 +40,7 @@ METRIC_AGGS = {"avg", "sum", "min", "max", "value_count", "stats",
                "percentile_ranks", "top_hits", "weighted_avg",
                "geo_bounds", "geo_centroid"}
 BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "filter",
-               "filters", "missing", "global",
+               "filters", "missing", "global", "composite",
                "geo_distance", "geohash_grid", "geotile_grid"}
 PIPELINE_AGGS = {"avg_bucket", "sum_bucket", "min_bucket", "max_bucket",
                  "stats_bucket", "cumulative_sum", "derivative", "bucket_sort"}
@@ -307,7 +307,144 @@ def _bucket_result(sub: Dict[str, Any], bucket_ctx: CollectCtx, mapper,
     return out
 
 
+def _composite_source_values(stype, sbody, seg):
+    """Per-doc (first) composite key values for one source over one segment.
+
+    Returns (values, valid) where values is indexable by doc id and valid is
+    a bool mask; (None, None) when the field is absent from the segment.
+    (ref: search/aggregations/bucket/composite/SingleDimensionValuesSource
+    and subclasses — recast as columnar per-segment key extraction.)
+    """
+    field = sbody.get("field")
+    if stype == "terms":
+        kv = seg.keywords.get(field)
+        if kv is not None:
+            vals = [kv.terms[o] if o >= 0 else None for o in kv.ords]
+            return vals, kv.ords >= 0
+        nv = seg.numerics.get(field)
+        if nv is not None:
+            return nv.values, ~nv.missing
+        return None, None
+    nv = seg.numerics.get(field)
+    if nv is None:
+        return None, None
+    if stype == "histogram":
+        interval = float(sbody["interval"])
+        return np.floor(nv.values / interval) * interval, ~nv.missing
+    if stype == "date_histogram":
+        cal_unit = _calendar_unit(sbody)
+        if cal_unit is not None:
+            return _calendar_floor_ms(nv.values, cal_unit), ~nv.missing
+        interval = _date_interval_ms(sbody)
+        return np.floor(nv.values / interval) * interval, ~nv.missing
+    raise ParsingException(f"Unknown composite source type [{stype}]")
+
+
+def _composite_cmp(a, b, orders):
+    """Compare two composite key tuples honoring per-source order; None
+    (missing bucket) sorts first on asc, last on desc (ES missing_order
+    default)."""
+    for x, y, order in zip(a, b, orders):
+        if x == y:
+            continue
+        if x is None:
+            c = -1
+        elif y is None:
+            c = 1
+        else:
+            c = -1 if x < y else 1
+        if order == "desc":
+            c = -c
+        return c
+    return 0
+
+
+def _composite(body, sub, ctx, mapper):
+    """Composite agg: paginable multi-source bucket keys with after-key
+    cursoring (ref: bucket/composite/CompositeAggregator.java — the
+    substrate for SQL GROUP BY and transforms). Keys are extracted
+    columnar per segment, grouped on the coordinator, sorted in composite
+    key order, and paged via `after`."""
+    import functools
+
+    sources = body.get("sources", [])
+    if not sources:
+        raise ParsingException("composite requires [sources]")
+    size = int(body.get("size", 10))
+    after = body.get("after")
+    names, orders, missing_ok = [], [], []
+    for src in sources:
+        (name, spec), = src.items()
+        (stype, sbody), = spec.items()
+        names.append(name)
+        orders.append(sbody.get("order", "asc"))
+        missing_ok.append(bool(sbody.get("missing_bucket", False)))
+    # per segment per source value extraction
+    seg_source_vals = []
+    for seg, _mask, _m in ctx:
+        row = []
+        for src in sources:
+            (name, spec), = src.items()
+            (stype, sbody), = spec.items()
+            row.append(_composite_source_values(stype, sbody, seg))
+        seg_source_vals.append(row)
+    # group masked docs by composite key
+    groups: Dict[tuple, List[List[int]]] = {}
+    counts: Dict[tuple, int] = {}
+    for si, (seg, mask, _m) in enumerate(ctx):
+        docs = np.nonzero(mask[: seg.n_docs])[0]
+        for d in docs:
+            key = []
+            ok = True
+            for j in range(len(sources)):
+                vals, valid = seg_source_vals[si][j]
+                if vals is None or not bool(valid[d]):
+                    if missing_ok[j]:
+                        key.append(None)
+                    else:
+                        ok = False
+                        break
+                else:
+                    v = vals[d]
+                    key.append(float(v) if isinstance(
+                        v, (np.floating, np.integer)) else v)
+            if not ok:
+                continue
+            kt = tuple(key)
+            if kt not in groups:
+                groups[kt] = [[] for _ in ctx]
+                counts[kt] = 0
+            groups[kt][si].append(int(d))
+            counts[kt] += 1
+    keyfn = functools.cmp_to_key(
+        lambda a, b: _composite_cmp(a, b, orders))
+    ordered = sorted(groups, key=keyfn)
+    if after is not None:
+        after_t = tuple(after.get(n) for n in names)
+        ordered = [k for k in ordered
+                   if _composite_cmp(k, after_t, orders) > 0]
+    page = ordered[:size]
+    buckets = []
+    for kt in page:
+        submasks = []
+        for si, (seg, _mask, _m) in enumerate(ctx):
+            sm = np.zeros(seg.n_docs, bool)
+            if groups[kt][si]:
+                sm[groups[kt][si]] = True
+            submasks.append(sm)
+        bucket_ctx = _refine(ctx, submasks)
+        buckets.append(_bucket_result(
+            sub, bucket_ctx, mapper, counts[kt],
+            {"key": dict(zip(names, kt))}))
+    out: Dict[str, Any] = {"buckets": buckets}
+    if buckets:
+        out["after_key"] = buckets[-1]["key"]
+    return out
+
+
 def _bucket(agg_type, body, sub, ctx, mapper):
+    if agg_type == "composite":
+        return _composite(body, sub, ctx, mapper)
     if agg_type == "global":
         # ignores the query mask entirely (ref: GlobalAggregator)
         global_ctx = [(seg, seg.live.copy(), m) for seg, _msk, m in ctx]
@@ -383,26 +520,46 @@ def _bucket(agg_type, body, sub, ctx, mapper):
 
     if agg_type in ("histogram", "date_histogram"):
         field = body.get("field")
+        cal_unit = (_calendar_unit(body) if agg_type == "date_histogram"
+                    else None)
         if agg_type == "histogram":
             interval = float(body["interval"])
-        else:
+        elif cal_unit is None:
             interval = _date_interval_ms(body)
         min_doc_count = int(body.get("min_doc_count", 0))
-        # work in INTEGER step space (step = floor(v / interval)) so bucket
-        # membership is exact — float key equality drops docs for
-        # fractional intervals
+        # work in INTEGER step space so bucket membership is exact — for
+        # fixed intervals step = floor(v / interval); calendar intervals
+        # (year/quarter/month/week) floor to true calendar boundaries
+        if cal_unit is not None:
+            def step_of(vv):
+                return _calendar_floor_ms(vv, cal_unit).astype(np.int64)
+
+            def key_of(step):
+                return float(step)
+        else:
+            def step_of(vv):
+                return np.floor(vv / interval).astype(np.int64)
+
+            def key_of(step):
+                return step * interval
         steps_present = set()
         for seg, mask, _m in ctx:
             vv, m = _first_values_and_mask(seg, mask, field)
             if vv is None:
                 continue
-            steps_present.update(
-                int(s) for s in np.unique(np.floor(vv[m] / interval)))
+            steps_present.update(int(s) for s in np.unique(step_of(vv[m])))
         buckets = []
         all_steps = sorted(steps_present)
         if all_steps and body.get("extended_bounds") is None and min_doc_count == 0:
             # fill gaps between min and max (ES default for histograms)
-            all_steps = list(range(all_steps[0], all_steps[-1] + 1))
+            if cal_unit is not None:
+                filled, cur = [], all_steps[0]
+                while cur <= all_steps[-1]:
+                    filled.append(cur)
+                    cur = _calendar_next_ms(cur, cal_unit)
+                all_steps = filled
+            else:
+                all_steps = list(range(all_steps[0], all_steps[-1] + 1))
         for step in all_steps:
             submasks = []
             count = 0
@@ -411,13 +568,13 @@ def _bucket(agg_type, body, sub, ctx, mapper):
                 if vv is None:
                     submasks.append(np.zeros(seg.n_docs, bool))
                     continue
-                in_bucket = m & (np.floor(vv / interval) == step)
+                in_bucket = m & (step_of(vv) == step)
                 submasks.append(in_bucket)
                 count += int(in_bucket.sum())
             if count < min_doc_count:
                 continue
             bucket_ctx = _refine(ctx, submasks)
-            key = step * interval
+            key = key_of(step)
             extra = {"key": key}
             if agg_type == "date_histogram":
                 extra["key_as_string"] = _ms_to_iso(key)
@@ -589,6 +746,51 @@ def _query_masks(q, ctx: CollectCtx, mapper) -> List[np.ndarray]:
 from elasticsearch_tpu.search.context import DeviceSegmentCache as _DSC  # noqa: E402
 
 _query_masks._cache = _DSC()
+
+
+# calendar units whose bucket length varies — these floor to true calendar
+# boundaries instead of fixed-ms multiples (ref: Rounding.java calendar
+# rounding vs fixed-interval rounding)
+_CALENDAR_UNITS = {"year": "year", "1y": "year", "quarter": "quarter",
+                   "1q": "quarter", "month": "month", "1M": "month",
+                   "week": "week", "1w": "week"}
+
+
+def _calendar_unit(body) -> Optional[str]:
+    v = body.get("calendar_interval")
+    return _CALENDAR_UNITS.get(v) if v is not None else None
+
+
+def _calendar_floor_ms(values, unit: str) -> np.ndarray:
+    """Floor epoch-ms values to calendar bucket starts (UTC)."""
+    ms = np.nan_to_num(np.asarray(values, np.float64)).astype(np.int64)
+    dt = ms.astype("datetime64[ms]")
+    if unit == "year":
+        start = dt.astype("datetime64[Y]")
+    elif unit == "month":
+        start = dt.astype("datetime64[M]")
+    elif unit == "quarter":
+        m = dt.astype("datetime64[M]").astype(np.int64)
+        start = (m - (m % 3)).astype("datetime64[M]")
+    else:  # week: ISO weeks start Monday (epoch 1970-01-01 is a Thursday)
+        days = ms // 86_400_000
+        dow = (days + 3) % 7
+        start = ((days - dow) * 86_400_000).astype("datetime64[ms]")
+    return start.astype("datetime64[ms]").astype(np.int64).astype(np.float64)
+
+
+def _calendar_next_ms(ms: float, unit: str) -> int:
+    """Start of the NEXT calendar bucket after bucket-start `ms`."""
+    d = np.datetime64(int(ms), "ms")
+    if unit == "year":
+        n = d.astype("datetime64[Y]") + 1
+    elif unit == "month":
+        n = d.astype("datetime64[M]") + 1
+    elif unit == "quarter":
+        n = d.astype("datetime64[M]") + 3
+    else:
+        return int(ms) + 604_800_000
+    return int(n.astype("datetime64[ms]").astype(np.int64))
 
 
 _INTERVALS_MS = {

@@ -93,6 +93,22 @@ class SegmentContext:
             miss = jnp.ones(self.n_docs_padded, bool)
         return col, miss
 
+    def keyword_ord_column(self, field: str):
+        """Per-doc first-ordinal sort key for a keyword field, or None.
+
+        Segment term dicts are sorted, so segment-local ordinals order
+        lexicographically WITHIN the segment (the Lucene
+        SortedSetDocValues model); cross-segment merges must compare the
+        term strings (searcher host-side re-sort)."""
+        kv = self.segment.keywords.get(field)
+        if kv is None:
+            return None
+        col = np.zeros(self.n_docs_padded, np.float32)
+        miss = np.ones(self.n_docs_padded, bool)
+        col[: self.segment.n_docs] = np.maximum(kv.ords, 0)
+        miss[: self.segment.n_docs] = kv.ords < 0
+        return jnp.asarray(col), jnp.asarray(miss)
+
 
 # DeviceSegment cache: segments are immutable except their live mask, so the
 # cache key is (segment name, live_version); a delete only re-uploads live.

@@ -131,8 +131,21 @@ class ShardSearcher:
                 # exact scroll continuation: strictly after the last emitted
                 # doc in (key desc, segment asc, docid asc) order (ref:
                 # scroll lastEmittedDoc, QueryPhase.java:182-213)
-                ck, cseg, cdoc = after_key
-                if seg_idx < cseg:
+                ck, cseg, cdoc = after_key[0], after_key[1], after_key[2]
+                if _primary_is_keyword(self, sort_spec):
+                    # keyword sort keys are segment-LOCAL ordinals — compare
+                    # the cursor TERM against this segment's term dict
+                    cval = after_key[3] if len(after_key) > 3 else None
+                    strictly, tied = _keyword_after_masks(
+                        ctx, sort_spec[0].field, cval, sort_spec[0].order)
+                    if seg_idx < cseg:
+                        allowed = strictly
+                    elif seg_idx == cseg:
+                        docids = jnp.arange(ctx.n_docs_padded)
+                        allowed = strictly | (tied & (docids > cdoc))
+                    else:
+                        allowed = strictly | tied
+                elif seg_idx < cseg:
                     allowed = key < ck
                 elif seg_idx == cseg:
                     docids = jnp.arange(ctx.n_docs_padded)
@@ -155,7 +168,12 @@ class ShardSearcher:
             [np.full(len(i), s, np.int32) for s, _, i, _ in per_segment])
         all_ids = np.concatenate([i for _, _, i, _ in per_segment])
         all_scores = np.concatenate([sc for _, _, _, sc in per_segment])
-        order = np.lexsort((all_ids, all_segs, -all_keys))[:k]
+        # keyword primary sorts use segment-LOCAL ordinals as device keys,
+        # so cross-segment truncation must compare the terms themselves:
+        # keep every per-segment winner, re-sort host-side, then cut to k
+        string_primary = _primary_is_keyword(self, sort_spec)
+        order = (np.arange(len(all_keys)) if string_primary
+                 else np.lexsort((all_ids, all_segs, -all_keys))[:k])
 
         docs = []
         for idx in order:
@@ -164,9 +182,13 @@ class ShardSearcher:
             sv = _sort_values(self, ctx_seg, docid, float(all_scores[idx]), sort_spec)
             docs.append(DocAddress(seg_idx, docid, float(all_scores[idx]), sv,
                                    sort_key=float(all_keys[idx])))
-        # multi-key: re-sort winners by the full key host-side
-        if sort_spec is not None and len(sort_spec) > 1:
-            docs.sort(key=lambda d: _host_sort_key(d, sort_spec))
+        # multi-key or string-keyed: re-sort winners by the full key
+        # host-side (ref: SearchPhaseController merge compares real values)
+        if sort_spec is not None and (len(sort_spec) > 1 or string_primary):
+            import functools
+            docs.sort(key=functools.cmp_to_key(
+                lambda a, b: _host_sort_cmp(a, b, sort_spec)))
+            docs = docs[:k]
         return QueryResult(docs, total, max_score, agg_masks)
 
     # ---------------------------------------------------------- rescore
@@ -449,7 +471,13 @@ def _primary_sort_key(ctx: SegmentContext, scores, sort_spec) -> jnp.ndarray:
                                   else np.finfo(np.float32).min)
         key = jnp.where(miss, missing_val, dist)
         return -key if sk.order == "asc" else key
-    col, miss = ctx.numeric_column(sk.field)
+    if (ctx.segment.numerics.get(sk.field) is None
+            and ctx.segment.keywords.get(sk.field) is not None):
+        # keyword sort: segment-local ordinals (lexicographic within the
+        # segment; merge re-sorts winners by term host-side)
+        col, miss = ctx.keyword_ord_column(sk.field)
+    else:
+        col, miss = ctx.numeric_column(sk.field)
     missing_val = jnp.float32(np.finfo(np.float32).max if sk.order == "asc"
                               else np.finfo(np.float32).min)
     key = jnp.where(miss, missing_val, col)
@@ -483,19 +511,71 @@ def _sort_values(searcher, seg: Segment, docid: int, score: float,
             v = None
             if nv is not None and not nv.missing[docid]:
                 v = float(nv.values[docid])
+            elif nv is None:
+                kv = seg.keywords.get(sk.field)
+                if kv is not None:
+                    lo, hi = kv.offsets[docid], kv.offsets[docid + 1]
+                    if hi > lo:
+                        v = kv.terms[kv.all_ords[lo]]
             out.append(v)
     return tuple(out)
 
 
-def _host_sort_key(d: DocAddress, sort_spec):
-    key = []
-    for sk, v in zip(sort_spec, d.sort_values):
-        if v is None:
-            v = float("inf") if sk.order == "asc" else float("-inf")
-        key.append(v if sk.order == "asc" else -v)
-    key.append(d.segment_idx)
-    key.append(d.docid)
-    return tuple(key)
+def _keyword_after_masks(ctx, field: str, term, order: str):
+    """(strictly_after, tied) masks for a string cursor value in THIS
+    segment's ordinal space (keyword sorts; terms are segment-local so the
+    cursor term is re-ranked per segment via binary search). A None cursor
+    term means the cursor doc had no value — missing sorts last, so only
+    later missing docs remain."""
+    import bisect
+
+    real = ctx.all_true()
+    kv = ctx.segment.keywords.get(field)
+    if kv is None:
+        # segment lacks the field entirely: every doc is "missing"
+        if term is None:
+            return jnp.zeros(ctx.n_docs_padded, bool), real
+        return real, jnp.zeros(ctx.n_docs_padded, bool)
+    col, miss = ctx.keyword_ord_column(field)
+    if term is None:
+        return jnp.zeros(ctx.n_docs_padded, bool), real & miss
+    r_left = bisect.bisect_left(kv.terms, term)
+    r_right = bisect.bisect_right(kv.terms, term)
+    if order == "asc":
+        strictly = (real & ~miss & (col >= r_right)) | (real & miss)
+    else:
+        strictly = (real & ~miss & (col < r_left)) | (real & miss)
+    tied = (real & ~miss & (col == r_left)) if r_left < r_right else (
+        jnp.zeros(ctx.n_docs_padded, bool))
+    return strictly, tied
+
+
+def _primary_is_keyword(searcher, sort_spec) -> bool:
+    if sort_spec is None:
+        return False
+    f = sort_spec[0].field
+    if f in ("_score", "_doc", "_geo_distance"):
+        return False
+    return any(seg.numerics.get(f) is None
+               and seg.keywords.get(f) is not None
+               for seg in searcher.segments)
+
+
+def _host_sort_cmp(a: DocAddress, b: DocAddress, sort_spec) -> int:
+    """Full-precision winner comparison (numbers AND strings); missing
+    values sort last regardless of direction, matching the device keys."""
+    for sk, x, y in zip(sort_spec, a.sort_values, b.sort_values):
+        if x == y:
+            continue
+        if x is None:
+            return 1
+        if y is None:
+            return -1
+        c = -1 if x < y else 1
+        return c if sk.order == "asc" else -c
+    if a.segment_idx != b.segment_idx:
+        return -1 if a.segment_idx < b.segment_idx else 1
+    return -1 if a.docid < b.docid else (1 if a.docid > b.docid else 0)
 
 
 def _search_after_mask(ctx: SegmentContext, scores, sort_spec,
@@ -522,15 +602,22 @@ def _search_after_mask(ctx: SegmentContext, scores, sort_spec,
             # sort values travel in the requested unit; compare in meters
             col = haversine_meters(lat, lon, sk.geo_lat, sk.geo_lon, xp=jnp)
             after_val = float(after[0]) / meters_to_unit(1.0, sk.geo_unit)
+        elif (ctx.segment.numerics.get(sk.field) is None
+                and ctx.segment.keywords.get(sk.field) is not None):
+            # keyword search_after: compare the string cursor value
+            strictly, tied = _keyword_after_masks(
+                ctx, sk.field, after[0], sk.order)
+            col = None
         else:
             col, miss = ctx.numeric_column(sk.field)
             after_val = float(after[0])
-        if sk.order == "asc":
-            strictly = (~miss) & (col > after_val)
-            tied = (~miss) & (col == after_val)
-        else:
-            strictly = (~miss) & (col < after_val)
-            tied = (~miss) & (col == after_val)
+        if col is not None:
+            if sk.order == "asc":
+                strictly = (~miss) & (col > after_val)
+                tied = (~miss) & (col == after_val)
+            else:
+                strictly = (~miss) & (col < after_val)
+                tied = (~miss) & (col == after_val)
     if (sort_spec is not None and len(sort_spec) >= 2
             and sort_spec[-1].field == "_doc" and len(after) >= 2):
         docids = jnp.arange(ctx.n_docs_padded)

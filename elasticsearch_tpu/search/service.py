@@ -335,7 +335,27 @@ class SearchService:
         for shard_idx, (index_name, searcher, result) in enumerate(shard_results):
             for d in result.docs:
                 merged.append((d.sort_key, shard_idx, d, index_name, searcher))
-        merged.sort(key=lambda e: (-e[0], e[1], e[2].segment_idx, e[2].docid))
+        from elasticsearch_tpu.search.searcher import (_host_sort_cmp,
+                                                       _parse_sort)
+        sort_spec = _parse_sort(sort)
+        if sort_spec is not None and any(d.sort_values
+                                         for _, _, d, _, _ in merged):
+            # compare real per-doc sort values (strings included) — the
+            # numeric device sort_key is shard-LOCAL for keyword ordinals
+            # (ref: SearchPhaseController.mergeTopDocs compares FieldDoc
+            # values, not shard-internal keys)
+            import functools
+
+            def entry_cmp(a, b):
+                c = _host_sort_cmp(a[2], b[2], sort_spec)
+                if c:
+                    return c
+                return -1 if a[1] < b[1] else (1 if a[1] > b[1] else 0)
+
+            merged.sort(key=functools.cmp_to_key(entry_cmp))
+        else:
+            merged.sort(key=lambda e: (-e[0], e[1], e[2].segment_idx,
+                                       e[2].docid))
 
         # ---- field collapsing (ref: collapse/CollapseBuilder + coordinator
         # keeping the best hit per group): first hit per key wins; docs
@@ -357,7 +377,12 @@ class SearchService:
         # update scroll cursors with the last emitted doc per shard
         if scroll_ctx is not None:
             for key, shard_idx, d, _, _ in page:
-                scroll_ctx.cursors[shard_idx] = (key, d.segment_idx, d.docid)
+                # carry the real primary sort value too: keyword sort keys
+                # are segment-local ordinals, so continuation re-ranks the
+                # cursor TERM per segment (searcher._keyword_after_masks)
+                scroll_ctx.cursors[shard_idx] = (
+                    key, d.segment_idx, d.docid,
+                    d.sort_values[0] if d.sort_values else None)
 
         # ---- fetch phase on winners only (ref: FetchSearchPhase.java:104)
         hits = []

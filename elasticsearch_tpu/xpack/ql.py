@@ -1,0 +1,485 @@
+"""QL: shared query-language core for SQL and EQL.
+
+Mirrors the reference's x-pack `ql` module (ref: x-pack/plugin/ql — the
+shared expression tree, literal/attribute resolution, and DSL translation
+layer that both SQL and EQL planners build on; SURVEY.md §2.6). Re-design
+for this engine: a hand-written tokenizer + expression AST whose leaves
+translate directly to the framework's JSON query DSL (`to_filter`) and
+evaluate row-wise on fetched documents (`evaluate`) for projections and
+HAVING — the compute-heavy filtering/scoring still runs through the TPU
+search path; QL is purely a front-end.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from typing import Any, Callable, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import ParsingException
+
+
+# ---------------------------------------------------------------------------
+# tokenizer
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Token:
+    kind: str      # KEYWORD | IDENT | STRING | NUMBER | OP | EOF
+    value: Any
+    pos: int
+
+
+_OPS = ["<=", ">=", "!=", "<>", "==", "=", "<", ">", "+", "-", "*", "/",
+        "%", "(", ")", ",", ".", ":"]
+
+_KEYWORDS = {
+    "select", "distinct", "from", "where", "group", "by", "having",
+    "order", "asc", "desc", "limit", "offset", "and", "or", "not", "in",
+    "like", "rlike", "between", "is", "null", "true", "false", "as",
+    "show", "tables", "columns", "functions", "describe", "desc",
+    "match", "query", "exists", "any", "of", "join", "until", "sequence",
+    "sample", "with", "maxspan", "untilspan", "runs", "escape", "cast",
+    "nulls", "first", "last", "top",
+}
+
+
+def tokenize(text: str, keywords: Optional[set] = None) -> List[Token]:
+    keywords = keywords if keywords is not None else _KEYWORDS
+    toks: List[Token] = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c.isspace():
+            i += 1
+            continue
+        if c == "-" and text[i:i + 2] == "--":           # line comment
+            while i < n and text[i] != "\n":
+                i += 1
+            continue
+        if c == "/" and text[i:i + 2] == "/*":           # block comment
+            j = text.find("*/", i + 2)
+            i = n if j < 0 else j + 2
+            continue
+        if c == "'":                                      # string literal
+            j = i + 1
+            out = []
+            while j < n:
+                if text[j] == "'" and j + 1 < n and text[j + 1] == "'":
+                    out.append("'")
+                    j += 2
+                    continue
+                if text[j] == "'":
+                    break
+                out.append(text[j])
+                j += 1
+            if j >= n:
+                raise ParsingException(f"Unterminated string at {i}")
+            toks.append(Token("STRING", "".join(out), i))
+            i = j + 1
+            continue
+        if c == '"' or c == "`":                          # quoted identifier
+            close = c
+            j = text.find(close, i + 1)
+            if j < 0:
+                raise ParsingException(f"Unterminated identifier at {i}")
+            toks.append(Token("IDENT", text[i + 1:j], i))
+            i = j + 1
+            continue
+        m = re.match(r"\d+(\.\d+)?([eE][+-]?\d+)?", text[i:])
+        if m:
+            s = m.group(0)
+            toks.append(Token(
+                "NUMBER",
+                float(s) if ("." in s or "e" in s or "E" in s) else int(s),
+                i))
+            i += len(s)
+            continue
+        m = re.match(r"[A-Za-z_][A-Za-z0-9_]*", text[i:])
+        if m:
+            word = m.group(0)
+            kind = "KEYWORD" if word.lower() in keywords else "IDENT"
+            toks.append(Token(
+                kind, word.lower() if kind == "KEYWORD" else word, i))
+            i += len(word)
+            continue
+        for op in _OPS:
+            if text.startswith(op, i):
+                toks.append(Token("OP", op, i))
+                i += len(op)
+                break
+        else:
+            raise ParsingException(f"Unexpected character {c!r} at {i}")
+    toks.append(Token("EOF", None, n))
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# expression AST
+# ---------------------------------------------------------------------------
+
+class Expr:
+    pass
+
+
+@dataclass
+class Literal(Expr):
+    value: Any
+
+
+@dataclass
+class FieldRef(Expr):
+    name: str
+
+
+@dataclass
+class Call(Expr):
+    name: str                       # upper-cased function name
+    args: List[Expr] = field(default_factory=list)
+    distinct: bool = False
+
+
+@dataclass
+class Binary(Expr):
+    op: str                         # = != < <= > >= + - * / % AND OR
+    left: Expr
+    right: Expr
+
+
+@dataclass
+class Unary(Expr):
+    op: str                         # NOT, NEG
+    operand: Expr
+
+
+@dataclass
+class InList(Expr):
+    expr: Expr
+    options: List[Expr]
+    negated: bool = False
+
+
+@dataclass
+class Between(Expr):
+    expr: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+
+@dataclass
+class Like(Expr):
+    expr: Expr
+    pattern: str                    # SQL LIKE pattern (% and _)
+    negated: bool = False
+    regex: bool = False             # RLIKE
+
+
+@dataclass
+class IsNull(Expr):
+    expr: Expr
+    negated: bool = False
+
+
+AGGREGATE_FUNCTIONS = {"COUNT", "SUM", "AVG", "MIN", "MAX", "STDDEV",
+                       "VARIANCE", "PERCENTILE", "CARDINALITY"}
+
+
+def has_aggregate(e: Expr) -> bool:
+    if isinstance(e, Call):
+        if e.name in AGGREGATE_FUNCTIONS:
+            return True
+        return any(has_aggregate(a) for a in e.args)
+    if isinstance(e, Binary):
+        return has_aggregate(e.left) or has_aggregate(e.right)
+    if isinstance(e, Unary):
+        return has_aggregate(e.operand)
+    if isinstance(e, (InList, Between, Like, IsNull)):
+        return has_aggregate(e.expr)
+    return False
+
+
+def field_refs(e: Expr, out: Optional[List[str]] = None) -> List[str]:
+    if out is None:
+        out = []
+    if isinstance(e, FieldRef):
+        out.append(e.name)
+    elif isinstance(e, Call):
+        for a in e.args:
+            field_refs(a, out)
+    elif isinstance(e, Binary):
+        field_refs(e.left, out)
+        field_refs(e.right, out)
+    elif isinstance(e, Unary):
+        field_refs(e.operand, out)
+    elif isinstance(e, (InList, Between, Like, IsNull)):
+        field_refs(e.expr, out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# translation to the JSON query DSL
+# ---------------------------------------------------------------------------
+
+def _literal_value(e: Expr):
+    if isinstance(e, Literal):
+        return e.value
+    if isinstance(e, Unary) and e.op == "NEG" and isinstance(e.operand, Literal):
+        return -e.operand.value
+    raise ParsingException("Expected a literal value")
+
+
+def to_filter(e: Expr) -> Dict[str, Any]:
+    """Translate a boolean expression into the framework's query DSL.
+
+    Field-vs-literal comparisons become term/range queries; AND/OR/NOT
+    become bool queries; MATCH()/QUERY() become full-text queries (ref:
+    x-pack/plugin/ql .../planner/ExpressionTranslators.java)."""
+    if isinstance(e, Binary):
+        if e.op == "AND":
+            return {"bool": {"must": [to_filter(e.left), to_filter(e.right)]}}
+        if e.op == "OR":
+            return {"bool": {"should": [to_filter(e.left),
+                                        to_filter(e.right)],
+                             "minimum_should_match": 1}}
+        if e.op in ("=", "=="):
+            f, v = _field_and_value(e)
+            return {"term": {f: {"value": v}}}
+        if e.op in ("!=", "<>"):
+            f, v = _field_and_value(e)
+            return {"bool": {"must_not": [{"term": {f: {"value": v}}}]}}
+        if e.op in ("<", "<=", ">", ">="):
+            f, v, op = _range_parts(e)
+            key = {"<": "lt", "<=": "lte", ">": "gt", ">=": "gte"}[op]
+            return {"range": {f: {key: v}}}
+        raise ParsingException(f"Cannot translate operator [{e.op}]"
+                               " to a query")
+    if isinstance(e, Unary) and e.op == "NOT":
+        return {"bool": {"must_not": [to_filter(e.operand)]}}
+    if isinstance(e, InList):
+        f = _field_name(e.expr)
+        vals = [_literal_value(o) for o in e.options]
+        q = {"terms": {f: vals}}
+        return {"bool": {"must_not": [q]}} if e.negated else q
+    if isinstance(e, Between):
+        f = _field_name(e.expr)
+        q = {"range": {f: {"gte": _literal_value(e.low),
+                           "lte": _literal_value(e.high)}}}
+        return {"bool": {"must_not": [q]}} if e.negated else q
+    if isinstance(e, Like):
+        f = _field_name(e.expr)
+        if e.regex:
+            q = {"regexp": {f: {"value": e.pattern}}}
+        else:
+            q = {"wildcard": {f: {
+                "value": e.pattern.replace("%", "*").replace("_", "?")}}}
+        return {"bool": {"must_not": [q]}} if e.negated else q
+    if isinstance(e, IsNull):
+        q = {"exists": {"field": _field_name(e.expr)}}
+        if e.negated:                       # IS NOT NULL
+            return q
+        return {"bool": {"must_not": [q]}}
+    if isinstance(e, Call):
+        if e.name == "MATCH":
+            if len(e.args) < 2:
+                raise ParsingException("MATCH requires (field, text)")
+            f = _field_name(e.args[0])
+            return {"match": {f: {"query": _literal_value(e.args[1])}}}
+        if e.name == "QUERY":
+            return {"query_string": {"query": _literal_value(e.args[0])}}
+        if e.name == "EXISTS":
+            return {"exists": {"field": _field_name(e.args[0])}}
+    if isinstance(e, Literal) and e.value is True:
+        return {"match_all": {}}
+    raise ParsingException(
+        f"Cannot translate expression [{type(e).__name__}] to a query")
+
+
+def _field_name(e: Expr) -> str:
+    if isinstance(e, FieldRef):
+        return e.name
+    raise ParsingException("Expected a field reference")
+
+
+def _field_and_value(e: Binary):
+    if isinstance(e.left, FieldRef):
+        return e.left.name, _literal_value(e.right)
+    if isinstance(e.right, FieldRef):
+        return e.right.name, _literal_value(e.left)
+    raise ParsingException("Comparison must involve a field and a literal")
+
+
+def _range_parts(e: Binary):
+    if isinstance(e.left, FieldRef):
+        return e.left.name, _literal_value(e.right), e.op
+    if isinstance(e.right, FieldRef):
+        flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+        return e.right.name, _literal_value(e.left), flip[e.op]
+    raise ParsingException("Comparison must involve a field and a literal")
+
+
+# ---------------------------------------------------------------------------
+# row-wise evaluation (projections, HAVING)
+# ---------------------------------------------------------------------------
+
+def _dt(v) -> datetime:
+    if isinstance(v, (int, float)):
+        return datetime.fromtimestamp(v / 1000.0, tz=timezone.utc)
+    return datetime.fromisoformat(str(v).replace("Z", "+00:00"))
+
+
+_SCALARS: Dict[str, Callable] = {
+    "ABS": lambda x: abs(x),
+    "ROUND": lambda x, n=0: round(x, int(n)),
+    "TRUNCATE": lambda x, n=0: math.trunc(x * 10 ** int(n)) / 10 ** int(n),
+    "FLOOR": lambda x: math.floor(x),
+    "CEIL": lambda x: math.ceil(x),
+    "CEILING": lambda x: math.ceil(x),
+    "SQRT": lambda x: math.sqrt(x),
+    "CBRT": lambda x: x ** (1 / 3) if x >= 0 else -((-x) ** (1 / 3)),
+    "EXP": lambda x: math.exp(x),
+    "LOG": lambda x: math.log(x),
+    "LOG10": lambda x: math.log10(x),
+    "POWER": lambda x, y: x ** y,
+    "MOD": lambda x, y: x % y,
+    "SIGN": lambda x: (x > 0) - (x < 0),
+    "SIN": math.sin, "COS": math.cos, "TAN": math.tan,
+    "ASIN": math.asin, "ACOS": math.acos, "ATAN": math.atan,
+    "PI": lambda: math.pi,
+    "CONCAT": lambda *a: "".join(str(x) for x in a),
+    "LENGTH": lambda s: len(str(s)),
+    "CHAR_LENGTH": lambda s: len(str(s)),
+    "UPPER": lambda s: str(s).upper(),
+    "UCASE": lambda s: str(s).upper(),
+    "LOWER": lambda s: str(s).lower(),
+    "LCASE": lambda s: str(s).lower(),
+    "LTRIM": lambda s: str(s).lstrip(),
+    "RTRIM": lambda s: str(s).rstrip(),
+    "TRIM": lambda s: str(s).strip(),
+    "LEFT": lambda s, n: str(s)[: int(n)],
+    "RIGHT": lambda s, n: str(s)[-int(n):] if int(n) else "",
+    "SUBSTRING": lambda s, start, ln=None: (
+        str(s)[int(start) - 1: int(start) - 1 + int(ln)]
+        if ln is not None else str(s)[int(start) - 1:]),
+    "REPLACE": lambda s, a, b: str(s).replace(str(a), str(b)),
+    "REVERSE": lambda s: str(s)[::-1],
+    "REPEAT": lambda s, n: str(s) * int(n),
+    "LOCATE": lambda sub, s, start=1: (
+        str(s).find(str(sub), int(start) - 1) + 1),
+    "ASCII": lambda s: ord(str(s)[0]) if s else None,
+    "SPACE": lambda n: " " * int(n),
+    "GREATEST": lambda *a: max(a),
+    "LEAST": lambda *a: min(a),
+    "NULLIF": lambda a, b: None if a == b else a,
+    "COALESCE": lambda *a: next((x for x in a if x is not None), None),
+    "IFNULL": lambda a, b: b if a is None else a,
+    "YEAR": lambda v: _dt(v).year,
+    "MONTH": lambda v: _dt(v).month,
+    "DAY": lambda v: _dt(v).day,
+    "DAY_OF_MONTH": lambda v: _dt(v).day,
+    "DAY_OF_WEEK": lambda v: _dt(v).isoweekday() % 7 + 1,
+    "DAY_OF_YEAR": lambda v: _dt(v).timetuple().tm_yday,
+    "HOUR": lambda v: _dt(v).hour,
+    "MINUTE": lambda v: _dt(v).minute,
+    "SECOND": lambda v: _dt(v).second,
+}
+
+
+def evaluate(e: Expr, row: Callable[[str], Any]) -> Any:
+    """Evaluate an expression against one row; `row(field)` supplies
+    document/bucket values (the SQL analogue of Painless's doc access)."""
+    if isinstance(e, Literal):
+        return e.value
+    if isinstance(e, FieldRef):
+        return row(e.name)
+    if isinstance(e, Unary):
+        v = evaluate(e.operand, row)
+        if e.op == "NEG":
+            return None if v is None else -v
+        if e.op == "NOT":
+            return None if v is None else not v
+    if isinstance(e, Binary):
+        if e.op == "AND":
+            return bool(evaluate(e.left, row)) and bool(
+                evaluate(e.right, row))
+        if e.op == "OR":
+            return bool(evaluate(e.left, row)) or bool(
+                evaluate(e.right, row))
+        lv, rv = evaluate(e.left, row), evaluate(e.right, row)
+        if lv is None or rv is None:
+            return None
+        return {
+            "+": lambda: lv + rv, "-": lambda: lv - rv,
+            "*": lambda: lv * rv,
+            "/": lambda: lv / rv if rv else None,
+            "%": lambda: lv % rv if rv else None,
+            "=": lambda: lv == rv, "==": lambda: lv == rv,
+            "!=": lambda: lv != rv, "<>": lambda: lv != rv,
+            "<": lambda: lv < rv, "<=": lambda: lv <= rv,
+            ">": lambda: lv > rv, ">=": lambda: lv >= rv,
+        }[e.op]()
+    if isinstance(e, InList):
+        v = evaluate(e.expr, row)
+        hit = any(v == _literal_value(o) for o in e.options)
+        return (not hit) if e.negated else hit
+    if isinstance(e, Between):
+        v = evaluate(e.expr, row)
+        if v is None:
+            return None
+        hit = _literal_value(e.low) <= v <= _literal_value(e.high)
+        return (not hit) if e.negated else hit
+    if isinstance(e, Like):
+        v = evaluate(e.expr, row)
+        if v is None:
+            return None
+        if e.regex:
+            hit = re.fullmatch(e.pattern, str(v)) is not None
+        else:
+            rx = re.escape(e.pattern).replace("%", ".*").replace("_", ".")
+            hit = re.fullmatch(rx, str(v)) is not None
+        return (not hit) if e.negated else hit
+    if isinstance(e, IsNull):
+        v = evaluate(e.expr, row)
+        return (v is not None) if e.negated else (v is None)
+    if isinstance(e, Call):
+        if e.name in AGGREGATE_FUNCTIONS:
+            # aggregates resolve through the row accessor by their
+            # canonical key (filled from the aggs response)
+            return row(expr_key(e))
+        fn = _SCALARS.get(e.name)
+        if fn is None:
+            raise ParsingException(f"Unknown function [{e.name}]")
+        args = [evaluate(a, row) for a in e.args]
+        if any(a is None for a in args) and e.name not in (
+                "COALESCE", "IFNULL", "NULLIF", "CONCAT"):
+            return None
+        return fn(*args)
+    raise ParsingException(f"Cannot evaluate [{type(e).__name__}]")
+
+
+def expr_key(e: Expr) -> str:
+    """Canonical textual key for an expression (column naming + agg keys)."""
+    if isinstance(e, Literal):
+        return repr(e.value)
+    if isinstance(e, FieldRef):
+        return e.name
+    if isinstance(e, Call):
+        inner = ", ".join(expr_key(a) for a in e.args)
+        if e.distinct:
+            inner = "DISTINCT " + inner
+        return f"{e.name}({inner})"
+    if isinstance(e, Binary):
+        return f"{expr_key(e.left)} {e.op} {expr_key(e.right)}"
+    if isinstance(e, Unary):
+        return ("-" if e.op == "NEG" else "NOT ") + expr_key(e.operand)
+    if isinstance(e, InList):
+        return f"{expr_key(e.expr)} IN (...)"
+    if isinstance(e, Between):
+        return f"{expr_key(e.expr)} BETWEEN"
+    if isinstance(e, Like):
+        return f"{expr_key(e.expr)} LIKE {e.pattern!r}"
+    if isinstance(e, IsNull):
+        return f"{expr_key(e.expr)} IS NULL"
+    return type(e).__name__

@@ -1,0 +1,914 @@
+"""SQL: SQL front-end over the search engine.
+
+Mirrors the reference's x-pack SQL plugin (ref: x-pack/plugin/sql — ANTLR
+parser → logical/physical plan → query DSL + composite aggs under
+`execution/search/`; JDBC/CLI wire formats; SURVEY.md §2.6). Re-design
+for this engine: a recursive-descent parser over the shared QL core
+(xpack/ql.py) producing a logical plan that executes in exactly two
+shapes, both riding the TPU search path:
+
+- **row plan** (no GROUP BY / aggregates): WHERE → query DSL, ORDER BY →
+  sort spec, LIMIT → size; scalar projections evaluated row-wise over
+  `_source` (ref: SQL's QueryContainer + HitExtractors).
+- **agg plan** (GROUP BY and/or aggregate functions): grouping keys →
+  the `composite` aggregation with after-key paging, aggregate functions
+  → metric sub-aggs, HAVING evaluated per bucket on the coordinator
+  (ref: SQL's composite-agg cursoring in execution/search/).
+
+Also: SHOW TABLES / SHOW COLUMNS / DESCRIBE / SHOW FUNCTIONS, cursors
+with fetch_size paging, and a `translate` mode returning the generated
+query DSL (the `/_sql/translate` API).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import uuid
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ParsingException,
+)
+from elasticsearch_tpu.xpack import ql
+from elasticsearch_tpu.xpack.ql import (
+    Between,
+    Binary,
+    Call,
+    Expr,
+    FieldRef,
+    InList,
+    IsNull,
+    Like,
+    Literal,
+    Token,
+    Unary,
+    evaluate,
+    expr_key,
+    has_aggregate,
+    to_filter,
+    tokenize,
+)
+
+DEFAULT_FETCH_SIZE = 1000
+
+
+# ---------------------------------------------------------------------------
+# statements
+# ---------------------------------------------------------------------------
+
+@dataclass
+class SelectItem:
+    expr: Expr
+    alias: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return self.alias or expr_key(self.expr)
+
+
+@dataclass
+class SelectStmt:
+    items: List[SelectItem]
+    table: Optional[str]
+    where: Optional[Expr] = None
+    group_by: List[Expr] = dc_field(default_factory=list)
+    having: Optional[Expr] = None
+    order_by: List[Tuple[Expr, str]] = dc_field(default_factory=list)
+    limit: Optional[int] = None
+    distinct: bool = False
+
+
+@dataclass
+class ShowTables:
+    pattern: Optional[str] = None
+
+
+@dataclass
+class ShowColumns:
+    table: str = ""
+
+
+@dataclass
+class ShowFunctions:
+    pattern: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+class Parser:
+    """Recursive-descent SQL parser (the ANTLR grammar's hand-written
+    equivalent, ref: x-pack/plugin/sql/.../parser/SqlBaseParser)."""
+
+    def __init__(self, text: str):
+        self.toks = tokenize(text)
+        self.i = 0
+
+    # -- token plumbing
+    def peek(self) -> Token:
+        return self.toks[self.i]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept_kw(self, *kws) -> Optional[str]:
+        t = self.peek()
+        if t.kind == "KEYWORD" and t.value in kws:
+            self.i += 1
+            return t.value
+        return None
+
+    def expect_kw(self, kw: str):
+        if not self.accept_kw(kw):
+            raise ParsingException(
+                f"Expected [{kw.upper()}] but got [{self.peek().value}]")
+
+    def accept_op(self, *ops) -> Optional[str]:
+        t = self.peek()
+        if t.kind == "OP" and t.value in ops:
+            self.i += 1
+            return t.value
+        return None
+
+    def expect_op(self, op: str):
+        if not self.accept_op(op):
+            raise ParsingException(
+                f"Expected [{op}] but got [{self.peek().value}]")
+
+    # -- entry
+    def parse(self):
+        if self.accept_kw("show"):
+            if self.accept_kw("tables"):
+                pat = None
+                if self.accept_kw("like"):
+                    pat = self._string()
+                return ShowTables(pat)
+            if self.accept_kw("columns"):
+                self.accept_kw("from")
+                return ShowColumns(self._identifier())
+            if self.accept_kw("functions"):
+                pat = None
+                if self.accept_kw("like"):
+                    pat = self._string()
+                return ShowFunctions(pat)
+            raise ParsingException("Expected TABLES, COLUMNS or FUNCTIONS")
+        if self.accept_kw("describe") or self.accept_kw("desc"):
+            return ShowColumns(self._identifier())
+        self.expect_kw("select")
+        return self._select()
+
+    def _select(self) -> SelectStmt:
+        distinct = bool(self.accept_kw("distinct"))
+        items = [self._select_item()]
+        while self.accept_op(","):
+            items.append(self._select_item())
+        table = None
+        if self.accept_kw("from"):
+            table = self._identifier()
+        where = group_by = having = None
+        group_exprs: List[Expr] = []
+        order: List[Tuple[Expr, str]] = []
+        limit = None
+        if self.accept_kw("where"):
+            where = self._expr()
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            group_exprs.append(self._expr())
+            while self.accept_op(","):
+                group_exprs.append(self._expr())
+        if self.accept_kw("having"):
+            having = self._expr()
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order.append(self._order_item())
+            while self.accept_op(","):
+                order.append(self._order_item())
+        if self.accept_kw("limit"):
+            t = self.next()
+            if t.kind != "NUMBER":
+                raise ParsingException("LIMIT requires a number")
+            limit = int(t.value)
+        if self.peek().kind != "EOF":
+            raise ParsingException(
+                f"Unexpected token [{self.peek().value}]")
+        return SelectStmt(items, table, where, group_exprs, having, order,
+                          limit, distinct)
+
+    def _order_item(self) -> Tuple[Expr, str]:
+        e = self._expr()
+        direction = "asc"
+        if self.accept_kw("asc"):
+            direction = "asc"
+        elif self.accept_kw("desc"):
+            direction = "desc"
+        # NULLS FIRST/LAST accepted and ignored (rows with null sort keys
+        # always sort last, like ES missing:_last default)
+        if self.accept_kw("nulls"):
+            if not (self.accept_kw("first") or self.accept_kw("last")):
+                raise ParsingException("Expected FIRST or LAST")
+        return e, direction
+
+    def _select_item(self) -> SelectItem:
+        if self.accept_op("*"):
+            return SelectItem(FieldRef("*"))
+        e = self._expr()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self._identifier()
+        elif self.peek().kind == "IDENT":
+            alias = self._identifier()
+        return SelectItem(e, alias)
+
+    def _identifier(self) -> str:
+        t = self.next()
+        if t.kind not in ("IDENT", "STRING", "KEYWORD"):
+            raise ParsingException(f"Expected identifier, got [{t.value}]")
+        name = str(t.value)
+        # dotted paths / index patterns (logs-*, logs-2021.01)
+        while True:
+            op = self.accept_op(".", "-", "*", ":")
+            if op is None:
+                break
+            if op == "*":
+                name += "*"
+                continue
+            nxt = self.peek()
+            if nxt.kind in ("IDENT", "KEYWORD", "NUMBER"):
+                self.next()
+                name += op + str(
+                    int(nxt.value) if isinstance(nxt.value, float)
+                    and nxt.value == int(nxt.value) else nxt.value)
+            elif op == "-" or op == ".":
+                name += op
+            else:
+                raise ParsingException("Bad identifier")
+        return name
+
+    def _string(self) -> str:
+        t = self.next()
+        if t.kind != "STRING":
+            raise ParsingException("Expected a string literal")
+        return t.value
+
+    # -- expressions (precedence climbing)
+    def _expr(self) -> Expr:
+        return self._or()
+
+    def _or(self) -> Expr:
+        e = self._and()
+        while self.accept_kw("or"):
+            e = Binary("OR", e, self._and())
+        return e
+
+    def _and(self) -> Expr:
+        e = self._not()
+        while self.accept_kw("and"):
+            e = Binary("AND", e, self._not())
+        return e
+
+    def _not(self) -> Expr:
+        if self.accept_kw("not"):
+            return Unary("NOT", self._not())
+        return self._predicate()
+
+    def _predicate(self) -> Expr:
+        e = self._additive()
+        negated = bool(self.accept_kw("not"))
+        if self.accept_kw("in"):
+            self.expect_op("(")
+            opts = [self._additive()]
+            while self.accept_op(","):
+                opts.append(self._additive())
+            self.expect_op(")")
+            return InList(e, opts, negated)
+        if self.accept_kw("between"):
+            low = self._additive()
+            self.expect_kw("and")
+            return Between(e, low, self._additive(), negated)
+        if self.accept_kw("like"):
+            return Like(e, self._string(), negated)
+        if self.accept_kw("rlike"):
+            return Like(e, self._string(), negated, regex=True)
+        if self.accept_kw("is"):
+            neg = bool(self.accept_kw("not"))
+            self.expect_kw("null")
+            return IsNull(e, neg)
+        if negated:
+            raise ParsingException("Dangling NOT")
+        op = self.accept_op("=", "==", "!=", "<>", "<", "<=", ">", ">=")
+        if op:
+            return Binary(op, e, self._additive())
+        return e
+
+    def _additive(self) -> Expr:
+        e = self._multiplicative()
+        while True:
+            op = self.accept_op("+", "-")
+            if not op:
+                return e
+            e = Binary(op, e, self._multiplicative())
+
+    def _multiplicative(self) -> Expr:
+        e = self._unary()
+        while True:
+            op = self.accept_op("*", "/", "%")
+            if not op:
+                return e
+            e = Binary(op, e, self._unary())
+
+    def _unary(self) -> Expr:
+        if self.accept_op("-"):
+            return Unary("NEG", self._unary())
+        if self.accept_op("+"):
+            return self._unary()
+        return self._primary()
+
+    def _primary(self) -> Expr:
+        t = self.peek()
+        if t.kind == "NUMBER":
+            self.next()
+            return Literal(t.value)
+        if t.kind == "STRING":
+            self.next()
+            return Literal(t.value)
+        if t.kind == "KEYWORD" and t.value in ("true", "false"):
+            self.next()
+            return Literal(t.value == "true")
+        if t.kind == "KEYWORD" and t.value == "null":
+            self.next()
+            return Literal(None)
+        if t.kind == "OP" and t.value == "(":
+            self.next()
+            e = self._expr()
+            self.expect_op(")")
+            return e
+        # MATCH/QUERY/EXISTS are keywords but also functions
+        if t.kind in ("IDENT", "KEYWORD"):
+            name = str(t.value)
+            self.next()
+            if self.peek().kind == "OP" and self.peek().value == "(":
+                self.next()
+                distinct = bool(self.accept_kw("distinct"))
+                args: List[Expr] = []
+                if not (self.peek().kind == "OP"
+                        and self.peek().value == ")"):
+                    if self.peek().kind == "OP" and self.peek().value == "*":
+                        self.next()
+                        args.append(FieldRef("*"))
+                    else:
+                        args.append(self._expr())
+                    while self.accept_op(","):
+                        args.append(self._expr())
+                self.expect_op(")")
+                return Call(name.upper(), args, distinct)
+            # plain field reference (possibly dotted)
+            full = name
+            while self.accept_op("."):
+                nxt = self.next()
+                if nxt.kind not in ("IDENT", "KEYWORD", "NUMBER"):
+                    raise ParsingException("Bad dotted identifier")
+                full += "." + str(nxt.value)
+            return FieldRef(full)
+        raise ParsingException(f"Unexpected token [{t.value}]")
+
+
+# ---------------------------------------------------------------------------
+# type mapping
+# ---------------------------------------------------------------------------
+
+_SQL_TYPES = {
+    "text": "text", "keyword": "keyword", "long": "long",
+    "integer": "integer", "short": "short", "byte": "byte",
+    "double": "double", "float": "float", "half_float": "half_float",
+    "boolean": "boolean", "date": "datetime", "ip": "ip",
+    "dense_vector": "dense_vector",
+}
+
+
+def _sql_type(es_type: str) -> str:
+    return _SQL_TYPES.get(es_type, es_type)
+
+
+def _infer_type(v: Any) -> str:
+    if isinstance(v, bool):
+        return "boolean"
+    if isinstance(v, int):
+        return "long"
+    if isinstance(v, float):
+        return "double"
+    return "keyword"
+
+
+# ---------------------------------------------------------------------------
+# execution
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _Cursor:
+    kind: str                       # rows | composite
+    stmt: Optional[SelectStmt] = None
+    rows: Optional[List[List[Any]]] = None    # buffered rows (rows kind)
+    offset: int = 0
+    index: str = ""
+    after_key: Optional[Dict[str, Any]] = None
+    fetch_size: int = DEFAULT_FETCH_SIZE
+    emitted: int = 0
+    exhausted: bool = False         # no more composite pages; rows buffered
+
+
+class SqlService:
+    """Parses, plans and executes SQL against the node's search service
+    (ref: x-pack/plugin/sql/.../execution/PlanExecutor.java)."""
+
+    def __init__(self, node):
+        self.node = node
+        self._cursors: Dict[str, _Cursor] = {}
+        self._lock = threading.Lock()
+
+    # -- public API -------------------------------------------------------
+    def query(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        cursor = body.get("cursor")
+        fetch_size = int(body.get("fetch_size", DEFAULT_FETCH_SIZE))
+        if cursor:
+            return self._continue(cursor)
+        sql = body.get("query")
+        if not sql:
+            raise IllegalArgumentException("[query] is required")
+        stmt = Parser(sql).parse()
+        if isinstance(stmt, ShowTables):
+            return self._show_tables(stmt)
+        if isinstance(stmt, ShowColumns):
+            return self._show_columns(stmt)
+        if isinstance(stmt, ShowFunctions):
+            return self._show_functions(stmt)
+        return self._run_select(stmt, fetch_size)
+
+    def translate(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        sql = body.get("query")
+        if not sql:
+            raise IllegalArgumentException("[query] is required")
+        stmt = Parser(sql).parse()
+        if not isinstance(stmt, SelectStmt):
+            raise IllegalArgumentException(
+                "Cannot translate a non-SELECT statement")
+        if stmt.group_by or any(has_aggregate(i.expr) for i in stmt.items):
+            return self._agg_search_body(stmt, DEFAULT_FETCH_SIZE, None)
+        return self._row_search_body(stmt, stmt.limit or DEFAULT_FETCH_SIZE)
+
+    def close_cursor(self, cursor_id: str) -> bool:
+        with self._lock:
+            return self._cursors.pop(cursor_id, None) is not None
+
+    # -- SHOW / DESCRIBE --------------------------------------------------
+    def _show_tables(self, stmt: ShowTables) -> Dict[str, Any]:
+        import fnmatch
+        names = sorted(self.node.indices_service.resolve("_all"))
+        if stmt.pattern is not None:
+            pat = stmt.pattern.replace("%", "*").replace("_", "?")
+            names = [n for n in names if fnmatch.fnmatch(n, pat)]
+        return {
+            "columns": [{"name": "name", "type": "keyword"},
+                        {"name": "type", "type": "keyword"},
+                        {"name": "kind", "type": "keyword"}],
+            "rows": [[n, "TABLE", "INDEX"] for n in names],
+        }
+
+    def _show_columns(self, stmt: ShowColumns) -> Dict[str, Any]:
+        names = self.node.indices_service.resolve(stmt.table)
+        cols: Dict[str, str] = {}
+        for name in names:
+            idx = self.node.indices_service.get(name)
+            for fname in idx.mapper.field_names():
+                if fname.startswith("_"):
+                    continue
+                ft = idx.mapper.field_type(fname)
+                cols.setdefault(fname, _sql_type(ft.type_name))
+        return {
+            "columns": [{"name": "column", "type": "keyword"},
+                        {"name": "type", "type": "keyword"},
+                        {"name": "mapping", "type": "keyword"}],
+            "rows": [[c, t, t] for c, t in sorted(cols.items())],
+        }
+
+    def _show_functions(self, stmt: ShowFunctions) -> Dict[str, Any]:
+        import fnmatch
+        names = (sorted(ql.AGGREGATE_FUNCTIONS)
+                 + sorted(ql._SCALARS.keys())
+                 + ["MATCH", "QUERY", "EXISTS"])
+        kinds = (["AGGREGATE"] * len(ql.AGGREGATE_FUNCTIONS)
+                 + ["SCALAR"] * len(ql._SCALARS)
+                 + ["CONDITIONAL"] * 3)
+        rows = list(zip(names, kinds))
+        if stmt.pattern is not None:
+            pat = stmt.pattern.replace("%", "*").replace("_", "?")
+            rows = [r for r in rows if fnmatch.fnmatch(r[0], pat)]
+        return {
+            "columns": [{"name": "name", "type": "keyword"},
+                        {"name": "type", "type": "keyword"}],
+            "rows": [list(r) for r in rows],
+        }
+
+    # -- SELECT planning --------------------------------------------------
+    def _run_select(self, stmt: SelectStmt, fetch_size: int):
+        if stmt.table is None:
+            # constant SELECT (SELECT 1+1)
+            row = [evaluate(i.expr, lambda f: None) for i in stmt.items]
+            return {
+                "columns": [{"name": i.name, "type": _infer_type(v)}
+                            for i, v in zip(stmt.items, row)],
+                "rows": [row],
+            }
+        if stmt.group_by or any(has_aggregate(i.expr) for i in stmt.items):
+            return self._agg_select(stmt, fetch_size)
+        return self._row_select(stmt, fetch_size)
+
+    # .. row plan
+    def _row_search_body(self, stmt: SelectStmt, size: int):
+        body: Dict[str, Any] = {"size": size}
+        if stmt.where is not None:
+            body["query"] = to_filter(stmt.where)
+        else:
+            body["query"] = {"match_all": {}}
+        sort = []
+        for e, direction in stmt.order_by:
+            if isinstance(e, FieldRef):
+                sort.append({e.name: {"order": direction}})
+            elif (isinstance(e, Call) and e.name == "SCORE"
+                  and not e.args):
+                sort.append({"_score": {"order": direction}})
+            else:
+                raise IllegalArgumentException(
+                    "ORDER BY supports fields and SCORE() outside of "
+                    "GROUP BY")
+        if sort:
+            body["sort"] = sort
+        return body
+
+    def _columns_for(self, stmt: SelectStmt, index: str):
+        """Expand * and compute column names/types from the mapping."""
+        names = self.node.indices_service.resolve(index)
+        field_types: Dict[str, str] = {}
+        for name in names:
+            idx = self.node.indices_service.get(name)
+            for fname in idx.mapper.field_names():
+                if fname.startswith("_"):
+                    continue
+                ft = idx.mapper.field_type(fname)
+                field_types.setdefault(fname, _sql_type(ft.type_name))
+        items: List[SelectItem] = []
+        for it in stmt.items:
+            if isinstance(it.expr, FieldRef) and it.expr.name == "*":
+                for fname in sorted(field_types):
+                    items.append(SelectItem(FieldRef(fname)))
+            else:
+                items.append(it)
+        cols = []
+        for it in items:
+            if isinstance(it.expr, FieldRef):
+                t = field_types.get(it.expr.name, "keyword")
+            elif isinstance(it.expr, Call) and it.expr.name == "COUNT":
+                t = "long"
+            else:
+                t = "double" if has_aggregate(it.expr) else "keyword"
+            cols.append({"name": it.name, "type": t})
+        return items, cols
+
+    def _row_select(self, stmt: SelectStmt, fetch_size: int):
+        size = stmt.limit if stmt.limit is not None else 10000
+        body = self._row_search_body(stmt, size)
+        body["_source"] = True
+        r = self.node.search_service.search(stmt.table, body)
+        items, cols = self._columns_for(stmt, stmt.table)
+        rows: List[List[Any]] = []
+        seen = set()
+        for hit in r["hits"]["hits"]:
+            src = hit.get("_source", {}) or {}
+
+            def getter(fname, _src=src, _hit=hit):
+                if fname == "_id":
+                    return _hit.get("_id")
+                v = _src
+                for part in fname.split("."):
+                    if isinstance(v, dict):
+                        v = v.get(part)
+                    else:
+                        return None
+                return v
+
+            row = [evaluate(it.expr, getter) for it in items]
+            if stmt.distinct:
+                key = tuple(json.dumps(v, sort_keys=True, default=str)
+                            for v in row)
+                if key in seen:
+                    continue
+                seen.add(key)
+            rows.append(row)
+        return self._paged_rows(cols, rows, stmt, fetch_size)
+
+    # .. agg plan
+    def _group_sources(self, stmt: SelectStmt):
+        """GROUP BY expressions → composite sources."""
+        sources = []
+        key_exprs: Dict[str, Expr] = {}
+        for ge in stmt.group_by:
+            if isinstance(ge, FieldRef):
+                nm = ge.name
+                sources.append({nm: {"terms": {"field": nm,
+                                               "missing_bucket": True}}})
+                key_exprs[nm] = ge
+            elif isinstance(ge, Call) and ge.name in (
+                    "YEAR", "MONTH", "DAY", "HOUR", "MINUTE", "SECOND",
+                    "HISTOGRAM"):
+                if ge.name == "HISTOGRAM":
+                    fld = ge.args[0]
+                    interval = ql._literal_value(ge.args[1])
+                    nm = expr_key(ge)
+                    sources.append({nm: {"histogram": {
+                        "field": fld.name, "interval": interval,
+                        "missing_bucket": True}}})
+                    key_exprs[nm] = ge
+                else:
+                    # date-part grouping: group on the raw field via a
+                    # calendar interval where it matches
+                    cal = {"YEAR": "year", "MONTH": "month", "DAY": "day",
+                           "HOUR": "hour", "MINUTE": "minute",
+                           "SECOND": "second"}[ge.name]
+                    fld = ge.args[0]
+                    nm = expr_key(ge)
+                    sources.append({nm: {"date_histogram": {
+                        "field": fld.name, "calendar_interval": cal,
+                        "missing_bucket": True}}})
+                    key_exprs[nm] = ge
+            else:
+                raise IllegalArgumentException(
+                    f"Unsupported GROUP BY expression [{expr_key(ge)}]")
+        return sources, key_exprs
+
+    def _agg_exprs(self, stmt: SelectStmt) -> List[Call]:
+        """All aggregate calls appearing in SELECT/HAVING/ORDER BY."""
+        out: Dict[str, Call] = {}
+
+        def walk(e: Expr):
+            if isinstance(e, Call):
+                if e.name in ql.AGGREGATE_FUNCTIONS:
+                    out.setdefault(expr_key(e), e)
+                    return
+                for a in e.args:
+                    walk(a)
+            elif isinstance(e, Binary):
+                walk(e.left)
+                walk(e.right)
+            elif isinstance(e, Unary):
+                walk(e.operand)
+            elif isinstance(e, (InList, Between, Like, IsNull)):
+                walk(e.expr)
+
+        for it in stmt.items:
+            walk(it.expr)
+        if stmt.having is not None:
+            walk(stmt.having)
+        for e, _ in stmt.order_by:
+            walk(e)
+        return list(out.values())
+
+    def _metric_agg_body(self, call: Call) -> Optional[Dict[str, Any]]:
+        if call.name == "COUNT":
+            arg = call.args[0] if call.args else FieldRef("*")
+            if isinstance(arg, FieldRef) and arg.name == "*":
+                return None                     # doc_count
+            if call.distinct:
+                return {"cardinality": {"field": arg.name}}
+            return {"value_count": {"field": arg.name}}
+        fld = call.args[0]
+        if not isinstance(fld, FieldRef):
+            raise IllegalArgumentException(
+                f"{call.name} requires a field argument")
+        m = {"SUM": "sum", "AVG": "avg", "MIN": "min", "MAX": "max",
+             "CARDINALITY": "cardinality"}
+        if call.name in m:
+            return {m[call.name]: {"field": fld.name}}
+        if call.name in ("STDDEV", "VARIANCE"):
+            return {"extended_stats": {"field": fld.name}}
+        if call.name == "PERCENTILE":
+            pct = ql._literal_value(call.args[1])
+            return {"percentiles": {"field": fld.name, "percents": [pct]}}
+        raise IllegalArgumentException(
+            f"Unknown aggregate function [{call.name}]")
+
+    def _agg_search_body(self, stmt: SelectStmt, fetch_size: int,
+                         after: Optional[Dict[str, Any]]):
+        body: Dict[str, Any] = {"size": 0}
+        if stmt.where is not None:
+            body["query"] = to_filter(stmt.where)
+        metric_aggs: Dict[str, Any] = {}
+        for call in self._agg_exprs(stmt):
+            ab = self._metric_agg_body(call)
+            if ab is not None:
+                metric_aggs[expr_key(call)] = ab
+        if stmt.group_by:
+            sources, _ = self._group_sources(stmt)
+            comp: Dict[str, Any] = {"size": fetch_size, "sources": sources}
+            if after is not None:
+                comp["after"] = after
+            node: Dict[str, Any] = {"composite": comp}
+            if metric_aggs:
+                node["aggs"] = metric_aggs
+            body["aggs"] = {"groupby": node}
+        else:
+            body["aggs"] = metric_aggs
+        return body
+
+    @staticmethod
+    def _metric_value(container: Dict[str, Any], call: Call,
+                      doc_count: int):
+        key = expr_key(call)
+        if call.name == "COUNT" and (
+                not call.args or (isinstance(call.args[0], FieldRef)
+                                  and call.args[0].name == "*")):
+            return doc_count
+        v = container.get(key, {})
+        if call.name == "STDDEV":
+            return v.get("std_deviation")
+        if call.name == "VARIANCE":
+            return v.get("variance")
+        if call.name == "PERCENTILE":
+            vals = v.get("values", {})
+            return next(iter(vals.values()), None)
+        return v.get("value")
+
+    def _bucket_rows(self, stmt: SelectStmt, buckets, items, agg_calls,
+                     key_exprs) -> List[List[Any]]:
+        """Composite buckets → projected rows with HAVING applied."""
+        rows: List[List[Any]] = []
+        for b in buckets:
+            values: Dict[str, Any] = {}
+            for nm, ge in key_exprs.items():
+                v = b["key"].get(nm)
+                # date-part group keys come back as bucket-start epoch
+                # ms; convert to the named part (YEAR(ts) → 2021)
+                if (v is not None and isinstance(ge, Call)
+                        and ge.name in ("YEAR", "MONTH", "DAY", "HOUR",
+                                        "MINUTE", "SECOND")):
+                    v = ql._SCALARS[ge.name](v)
+                values[nm] = v
+            for call in agg_calls:
+                values[expr_key(call)] = self._metric_value(
+                    b, call, b["doc_count"])
+
+            def getter(name, _v=values):
+                return _v.get(name)
+
+            def col_value(expr, _g=getter, _v=values):
+                # group keys referenced in SELECT resolve by their
+                # expression key (bare field or YEAR(ts) alike)
+                k = expr_key(expr)
+                if k in _v:
+                    return _v[k]
+                return evaluate(expr, _g)
+
+            if stmt.having is not None and not evaluate(
+                    stmt.having, getter):
+                continue
+            rows.append([col_value(it.expr) for it in items])
+        return rows
+
+    def _sort_grouped_rows(self, stmt: SelectStmt, rows, items):
+        col_index = {it.name: j for j, it in enumerate(items)}
+        for e, direction in reversed(stmt.order_by):
+            key = expr_key(e)
+            j = col_index.get(key)
+            if j is None:
+                # aliases: ORDER BY may reference a select alias
+                for jj, it in enumerate(items):
+                    if expr_key(it.expr) == key or it.name == key:
+                        j = jj
+                        break
+            if j is None:
+                raise IllegalArgumentException(
+                    f"ORDER BY [{key}] must appear in SELECT for "
+                    "grouped queries")
+            rows.sort(key=lambda row, _j=j: (
+                row[_j] is None, row[_j]), reverse=(direction == "desc"))
+
+    def _agg_select(self, stmt: SelectStmt, fetch_size: int,
+                    after: Optional[Dict[str, Any]] = None,
+                    emitted: int = 0,
+                    prefix: Optional[List[List[Any]]] = None,
+                    more: bool = True):
+        agg_calls = self._agg_exprs(stmt)
+        items, cols = self._columns_for(stmt, stmt.table)
+
+        if not stmt.group_by:
+            body = self._agg_search_body(stmt, fetch_size, None)
+            r = self.node.search_service.search(stmt.table, body)
+            aggs = r.get("aggregations", {})
+            values = {expr_key(c): self._metric_value(
+                aggs, c, r["hits"]["total"]["value"]) for c in agg_calls}
+
+            def getter(name, _v=values):
+                return _v.get(name)
+
+            return {"columns": cols,
+                    "rows": [[evaluate(it.expr, getter) for it in items]]}
+
+        _, key_exprs = self._group_sources(stmt)
+
+        def fetch_page(after_k, page_size):
+            body = self._agg_search_body(stmt, page_size, after_k)
+            r = self.node.search_service.search(stmt.table, body)
+            g = r.get("aggregations", {}).get("groupby", {})
+            buckets = g.get("buckets", [])
+            nxt = g.get("after_key") if len(buckets) >= page_size else None
+            return buckets, nxt
+
+        if stmt.order_by:
+            # ordering needs EVERY group before sorting — drain all
+            # composite pages, sort coordinator-side, page with a rows
+            # cursor (ref: SQL's local sorting for ordered GROUP BY)
+            rows: List[List[Any]] = []
+            after_k = None
+            while True:
+                buckets, after_k = fetch_page(
+                    after_k, max(fetch_size, DEFAULT_FETCH_SIZE))
+                rows.extend(self._bucket_rows(stmt, buckets, items,
+                                              agg_calls, key_exprs))
+                if after_k is None:
+                    break
+            self._sort_grouped_rows(stmt, rows, items)
+            if stmt.limit is not None:
+                rows = rows[: stmt.limit]
+            return self._paged_rows(cols, rows, stmt, fetch_size)
+
+        # unordered: stream pages, applying HAVING per page, until the
+        # requested page is filled or groups are exhausted
+        needed = fetch_size
+        if stmt.limit is not None:
+            needed = min(needed, max(0, stmt.limit - emitted))
+        rows = list(prefix or [])
+        after_k = after
+        exhausted = not more
+        while len(rows) < needed and not exhausted:
+            buckets, nxt = fetch_page(after_k, fetch_size)
+            rows.extend(self._bucket_rows(stmt, buckets, items,
+                                          agg_calls, key_exprs))
+            after_k = nxt
+            if after_k is None:
+                exhausted = True
+        extra_rows = rows[needed:]
+        rows = rows[:needed]
+        out: Dict[str, Any] = {"columns": cols, "rows": rows}
+        hit_limit = (stmt.limit is not None
+                     and emitted + len(rows) >= stmt.limit)
+        if not hit_limit and (extra_rows or not exhausted):
+            cur = _Cursor(kind="composite", stmt=stmt, index=stmt.table,
+                          rows=extra_rows or None,
+                          after_key=None if exhausted else after_k,
+                          fetch_size=fetch_size,
+                          emitted=emitted + len(rows))
+            cur.exhausted = exhausted
+            out["cursor"] = self._save(cur)
+        return out
+
+    # -- paging -----------------------------------------------------------
+    def _paged_rows(self, cols, rows, stmt, fetch_size):
+        if len(rows) <= fetch_size:
+            return {"columns": cols, "rows": rows}
+        cur = _Cursor(kind="rows", rows=rows, offset=fetch_size,
+                      fetch_size=fetch_size)
+        return {"columns": cols, "rows": rows[:fetch_size],
+                "cursor": self._save(cur)}
+
+    def _save(self, cur: _Cursor) -> str:
+        cid = base64.urlsafe_b64encode(
+            uuid.uuid4().bytes).decode().rstrip("=")
+        with self._lock:
+            self._cursors[cid] = cur
+        return cid
+
+    def _continue(self, cursor_id: str) -> Dict[str, Any]:
+        with self._lock:
+            cur = self._cursors.pop(cursor_id, None)
+        if cur is None:
+            raise IllegalArgumentException(
+                f"Unknown cursor [{cursor_id}]")
+        if cur.kind == "rows":
+            rows = cur.rows[cur.offset: cur.offset + cur.fetch_size]
+            out: Dict[str, Any] = {"rows": rows}
+            if cur.offset + cur.fetch_size < len(cur.rows):
+                cur.offset += cur.fetch_size
+                out["cursor"] = self._save(cur)
+            return out
+        # composite continuation: emit buffered overflow rows first, then
+        # re-run the agg from the saved after key
+        r = self._agg_select(cur.stmt, cur.fetch_size, after=cur.after_key,
+                             emitted=cur.emitted, prefix=cur.rows,
+                             more=not cur.exhausted)
+        r.pop("columns", None)
+        return r

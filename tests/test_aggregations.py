@@ -203,3 +203,62 @@ def test_unknown_agg_type(search):
     from elasticsearch_tpu.common.errors import ParsingException
     with pytest.raises(ParsingException):
         agg(search, {"x": {"made_up": {"field": "price"}}})
+
+
+def test_composite_basic(search):
+    a = agg(search, {"comp": {"composite": {
+        "size": 10,
+        "sources": [{"cat": {"terms": {"field": "category"}}}],
+    }}})
+    keys = [b["key"]["cat"] for b in a["comp"]["buckets"]]
+    assert keys == ["fruit", "meat", "veg"]
+    counts = {b["key"]["cat"]: b["doc_count"] for b in a["comp"]["buckets"]}
+    assert counts == {"fruit": 3, "veg": 2, "meat": 1}
+    assert a["comp"]["after_key"] == {"cat": "veg"}
+
+
+def test_composite_after_paging(search):
+    a = agg(search, {"comp": {"composite": {
+        "size": 1,
+        "sources": [{"cat": {"terms": {"field": "category"}}}],
+    }}})
+    assert [b["key"]["cat"] for b in a["comp"]["buckets"]] == ["fruit"]
+    a2 = agg(search, {"comp": {"composite": {
+        "size": 2,
+        "sources": [{"cat": {"terms": {"field": "category"}}}],
+        "after": a["comp"]["after_key"],
+    }}})
+    assert [b["key"]["cat"] for b in a2["comp"]["buckets"]] == ["meat", "veg"]
+
+
+def test_composite_multi_source_and_subaggs(search):
+    a = agg(search, {"comp": {
+        "composite": {
+            "size": 10,
+            "sources": [
+                {"cat": {"terms": {"field": "category", "order": "desc"}}},
+                {"day": {"date_histogram": {"field": "sold_at",
+                                            "calendar_interval": "day"}}},
+            ]},
+        "aggs": {"total": {"sum": {"field": "price"}}},
+    }})
+    buckets = a["comp"]["buckets"]
+    assert buckets[0]["key"]["cat"] == "veg"
+    fruit_day1 = [b for b in buckets
+                  if b["key"]["cat"] == "fruit"
+                  and b["key"]["day"] == 1609459200000.0]
+    assert len(fruit_day1) == 1
+    assert fruit_day1[0]["doc_count"] == 2
+    assert fruit_day1[0]["total"]["value"] == pytest.approx(3.0)
+
+
+def test_composite_missing_bucket(search):
+    # "meat" doc has no qty; missing_bucket=True gives it a None key
+    a = agg(search, {"comp": {"composite": {
+        "size": 10,
+        "sources": [{"q": {"histogram": {"field": "qty", "interval": 10,
+                                         "missing_bucket": True}}}],
+    }}})
+    keys = [b["key"]["q"] for b in a["comp"]["buckets"]]
+    assert keys[0] is None
+    assert set(keys[1:]) == {0.0, 10.0, 20.0}

@@ -498,3 +498,53 @@ def test_malformed_single_field_specs_raise_parsing_exception():
             parse_query({qtype: {"a": "x", "b": "y"}})
         with pytest.raises(ParsingException):
             parse_query({qtype: {"boost": 2.0}})
+
+
+def _kw_sort_index(tmp_path_factory, shards=1):
+    from elasticsearch_tpu.index.service import IndicesService
+    from elasticsearch_tpu.search.service import SearchService
+    tmp = tmp_path_factory.mktemp("kwsort")
+    indices = IndicesService(str(tmp / "data"))
+    idx = indices.create_index(
+        "k", {"index.number_of_shards": shards},
+        {"properties": {"name": {"type": "keyword"},
+                        "n": {"type": "long"}}})
+    return indices, idx, SearchService(indices)
+
+
+def test_keyword_sort_scroll_across_segments(tmp_path_factory):
+    # multi-segment shard: scroll with keyword sort must not lose docs
+    # (segment-local ordinals are not comparable across segments)
+    indices, idx, svc = _kw_sort_index(tmp_path_factory)
+    idx.index_doc("1", {"name": "a", "n": 1})
+    idx.index_doc("2", {"name": "b", "n": 2})
+    idx.refresh()                      # segment 0: {a, b}
+    idx.index_doc("3", {"name": "c", "n": 3})
+    idx.refresh()                      # segment 1: {c}
+    r = svc.search("k", {"sort": [{"name": "asc"}], "size": 1},
+                   scroll="1m")
+    got = [h["_source"]["name"] for h in r["hits"]["hits"]]
+    sid = r["_scroll_id"]
+    while True:
+        r = svc.scroll(sid)
+        if not r["hits"]["hits"]:
+            break
+        got += [h["_source"]["name"] for h in r["hits"]["hits"]]
+    assert got == ["a", "b", "c"]
+    indices.close()
+
+
+def test_keyword_search_after(tmp_path_factory):
+    indices, idx, svc = _kw_sort_index(tmp_path_factory, shards=2)
+    for i, nm in enumerate(["delta", "alpha", "echo", "bravo", "charlie"]):
+        idx.index_doc(str(i), {"name": nm, "n": i})
+    idx.refresh()
+    r = svc.search("k", {"sort": [{"name": "asc"}], "size": 2})
+    names = [h["_source"]["name"] for h in r["hits"]["hits"]]
+    assert names == ["alpha", "bravo"]
+    after = r["hits"]["hits"][-1]["sort"]
+    r = svc.search("k", {"sort": [{"name": "asc"}], "size": 10,
+                         "search_after": after})
+    names2 = [h["_source"]["name"] for h in r["hits"]["hits"]]
+    assert names2 == ["charlie", "delta", "echo"]
+    indices.close()

@@ -1,0 +1,238 @@
+"""SQL plugin tests (model: x-pack/plugin/sql test discipline — parser
+round-trips, translation to the query DSL, and end-to-end execution)."""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+MAPPINGS = {
+    "properties": {
+        "emp_no": {"type": "long"},
+        "name": {"type": "keyword"},
+        "bio": {"type": "text"},
+        "salary": {"type": "double"},
+        "dept": {"type": "keyword"},
+        "hired": {"type": "date"},
+    }
+}
+
+DOCS = [
+    {"emp_no": 1, "name": "alice", "bio": "staff engineer tpu kernels",
+     "salary": 180.0, "dept": "eng", "hired": "2019-03-01"},
+    {"emp_no": 2, "name": "bob", "bio": "search infra engineer",
+     "salary": 150.0, "dept": "eng", "hired": "2020-07-15"},
+    {"emp_no": 3, "name": "carol", "bio": "sales lead",
+     "salary": 120.0, "dept": "sales", "hired": "2020-01-10"},
+    {"emp_no": 4, "name": "dave", "bio": "sales associate",
+     "salary": 90.0, "dept": "sales", "hired": "2021-05-20"},
+    {"emp_no": 5, "name": "erin", "bio": "hr generalist",
+     "salary": 100.0, "dept": "hr", "hired": "2021-02-01"},
+]
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("sql")
+    n = Node(data_path=str(tmp / "data"))
+    idx = n.indices_service.create_index(
+        "emp", {"index.number_of_shards": 2}, MAPPINGS)
+    for i, d in enumerate(DOCS):
+        idx.index_doc(str(i), d)
+    idx.refresh()
+    yield n
+    n.close()
+
+
+def q(node, sql, **body):
+    status, r = node.rest_controller.dispatch(
+        "POST", "/_sql", {}, {"query": sql, **body})
+    assert status == 200, r
+    return r
+
+
+def test_select_where_order(node):
+    r = q(node, "SELECT name, salary FROM emp "
+                "WHERE salary >= 100 ORDER BY salary DESC")
+    assert [c["name"] for c in r["columns"]] == ["name", "salary"]
+    assert [row[0] for row in r["rows"]] == ["alice", "bob", "carol", "erin"]
+
+
+def test_select_star_and_limit(node):
+    r = q(node, "SELECT * FROM emp ORDER BY emp_no ASC LIMIT 2")
+    names = [c["name"] for c in r["columns"]]
+    assert names == ["bio", "dept", "emp_no", "hired", "name", "salary"]
+    assert len(r["rows"]) == 2
+    assert r["rows"][0][names.index("name")] == "alice"
+
+
+def test_scalar_projection(node):
+    r = q(node, "SELECT UPPER(name) AS n, salary * 2 AS s2 FROM emp "
+                "WHERE name = 'alice'")
+    assert r["rows"] == [["ALICE", 360.0]]
+
+
+def test_full_text_match(node):
+    r = q(node, "SELECT name FROM emp WHERE MATCH(bio, 'engineer') "
+                "ORDER BY name ASC")
+    assert [row[0] for row in r["rows"]] == ["alice", "bob"]
+
+
+def test_like_and_in_and_between(node):
+    r = q(node, "SELECT name FROM emp WHERE name LIKE 'a%'")
+    assert [row[0] for row in r["rows"]] == ["alice"]
+    r = q(node, "SELECT name FROM emp WHERE dept IN ('hr', 'sales') "
+                "ORDER BY name ASC")
+    assert [row[0] for row in r["rows"]] == ["carol", "dave", "erin"]
+    r = q(node, "SELECT name FROM emp WHERE salary BETWEEN 95 AND 125 "
+                "ORDER BY salary ASC")
+    assert [row[0] for row in r["rows"]] == ["erin", "carol"]
+
+
+def test_group_by_aggregates(node):
+    r = q(node, "SELECT dept, COUNT(*) AS c, AVG(salary) AS avg_sal, "
+                "MAX(salary) AS mx FROM emp GROUP BY dept "
+                "ORDER BY dept ASC")
+    assert r["rows"] == [
+        ["eng", 2, 165.0, 180.0],
+        ["hr", 1, 100.0, 100.0],
+        ["sales", 2, 105.0, 120.0],
+    ]
+
+
+def test_group_by_having(node):
+    r = q(node, "SELECT dept, COUNT(*) AS c FROM emp GROUP BY dept "
+                "HAVING COUNT(*) > 1 ORDER BY dept ASC")
+    assert r["rows"] == [["eng", 2], ["sales", 2]]
+
+
+def test_group_by_year(node):
+    r = q(node, "SELECT YEAR(hired) AS y, COUNT(*) AS c FROM emp "
+                "GROUP BY YEAR(hired) ORDER BY y ASC")
+    assert r["rows"] == [[2019, 1], [2020, 2], [2021, 2]]
+
+
+def test_global_aggregates_no_group(node):
+    r = q(node, "SELECT COUNT(*), SUM(salary), MIN(salary) FROM emp")
+    assert r["rows"] == [[5, 640.0, 90.0]]
+
+
+def test_count_distinct(node):
+    r = q(node, "SELECT COUNT(DISTINCT dept) FROM emp")
+    assert r["rows"] == [[3]]
+
+
+def test_show_tables_and_describe(node):
+    r = q(node, "SHOW TABLES")
+    assert ["emp", "TABLE", "INDEX"] in r["rows"]
+    r = q(node, "DESCRIBE emp")
+    cols = {row[0]: row[1] for row in r["rows"]}
+    assert cols["salary"] == "double"
+    assert cols["hired"] == "datetime"
+    assert cols["bio"] == "text"
+
+
+def test_constant_select(node):
+    r = q(node, "SELECT 1 + 1")
+    assert r["rows"] == [[2]]
+
+
+def test_cursor_paging(node):
+    r = q(node, "SELECT name FROM emp ORDER BY emp_no ASC", fetch_size=2)
+    assert len(r["rows"]) == 2
+    assert "cursor" in r
+    status, r2 = node.rest_controller.dispatch(
+        "POST", "/_sql", {}, {"cursor": r["cursor"]})
+    assert status == 200
+    assert len(r2["rows"]) == 2
+    status, r3 = node.rest_controller.dispatch(
+        "POST", "/_sql", {}, {"cursor": r2["cursor"]})
+    assert r3["rows"] == [["erin"]]
+    assert "cursor" not in r3
+
+
+def test_sql_translate(node):
+    status, r = node.rest_controller.dispatch(
+        "POST", "/_sql/translate", {},
+        {"query": "SELECT name FROM emp WHERE salary > 100 "
+                  "ORDER BY salary DESC"})
+    assert status == 200
+    assert r["query"] == {"range": {"salary": {"gt": 100}}}
+    assert r["sort"] == [{"salary": {"order": "desc"}}]
+
+
+def test_sql_close_cursor(node):
+    r = q(node, "SELECT name FROM emp", fetch_size=1)
+    status, res = node.rest_controller.dispatch(
+        "POST", "/_sql/close", {}, {"cursor": r["cursor"]})
+    assert res["succeeded"] is True
+    status, res = node.rest_controller.dispatch(
+        "POST", "/_sql/close", {}, {"cursor": r["cursor"]})
+    assert res["succeeded"] is False
+
+
+def test_txt_format(node):
+    status, r = node.rest_controller.dispatch(
+        "POST", "/_sql", {"format": "txt"},
+        {"query": "SELECT name FROM emp WHERE dept = 'hr'"})
+    assert "name" in r["_cat"] and "erin" in r["_cat"]
+
+
+def test_csv_format(node):
+    status, r = node.rest_controller.dispatch(
+        "POST", "/_sql", {"format": "csv"},
+        {"query": "SELECT name, salary FROM emp WHERE dept = 'hr'"})
+    assert r["_cat"].splitlines() == ["name,salary", "erin,100.0"]
+
+
+def test_is_null_and_not(node):
+    r = q(node, "SELECT name FROM emp WHERE NOT dept = 'eng' "
+                "AND salary IS NOT NULL ORDER BY name ASC")
+    assert [row[0] for row in r["rows"]] == ["carol", "dave", "erin"]
+
+
+def test_distinct_rows(node):
+    r = q(node, "SELECT DISTINCT dept FROM emp ORDER BY dept ASC")
+    assert [row[0] for row in r["rows"]] == ["eng", "hr", "sales"]
+
+
+def test_show_functions(node):
+    r = q(node, "SHOW FUNCTIONS LIKE 'CO%'")
+    names = [row[0] for row in r["rows"]]
+    assert "COUNT" in names and "CONCAT" in names
+
+
+def test_group_order_by_exceeding_fetch_size(node):
+    # ORDER BY must see ALL groups even when they exceed one composite page
+    r = q(node, "SELECT dept, MAX(salary) AS m FROM emp GROUP BY dept "
+                "ORDER BY m DESC LIMIT 2", fetch_size=1)
+    # paged: first page has 1 row (fetch_size=1) but ordering is global
+    assert r["rows"] == [["eng", 180.0]]
+    status, r2 = node.rest_controller.dispatch(
+        "POST", "/_sql", {}, {"cursor": r["cursor"]})
+    assert r2["rows"] == [["sales", 120.0]]
+
+
+def test_group_having_filters_across_pages(node):
+    # HAVING filtering an entire page must not kill the cursor
+    r = q(node, "SELECT dept, MAX(salary) AS m FROM emp GROUP BY dept "
+                "HAVING MAX(salary) >= 120", fetch_size=1)
+    collected = list(r["rows"])
+    while "cursor" in r:
+        status, r = node.rest_controller.dispatch(
+            "POST", "/_sql", {}, {"cursor": r["cursor"]})
+        collected += r["rows"]
+    assert sorted(collected) == [["eng", 180.0], ["sales", 120.0]]
+
+
+def test_txt_format_carries_cursor(node):
+    status, r = node.rest_controller.dispatch(
+        "POST", "/_sql", {"format": "txt"},
+        {"query": "SELECT name FROM emp ORDER BY emp_no ASC",
+         "fetch_size": 2})
+    assert "_headers" in r and r["_headers"]["Cursor"]
+    status, r2 = node.rest_controller.dispatch(
+        "POST", "/_sql", {"format": "txt"},
+        {"cursor": r["_headers"]["Cursor"]})
+    # continuation page: rows only, no header line
+    assert "name" not in r2["_cat"]
+    assert "carol" in r2["_cat"] or "dave" in r2["_cat"]
