@@ -75,6 +75,8 @@ class Node:
                 settings.get("bootstrap.password", "changeme")))
         from elasticsearch_tpu.xpack.sql import SqlService
         self.sql_service = SqlService(self)
+        from elasticsearch_tpu.xpack.eql import EqlService
+        self.eql_service = EqlService(self)
         # per-request thread-local context (authenticated user)
         import threading
         self.request_context = threading.local()

@@ -227,6 +227,9 @@ def _register_all(c: RestController):
     c.register("DELETE", "/_snapshot/{repo}/{snap}", delete_snapshot)
     c.register("POST", "/_snapshot/{repo}/{snap}/_restore", restore_snapshot)
     # transform
+    # EQL (ref: x-pack/plugin/eql REST layer)
+    c.register("POST", "/{index}/_eql/search", eql_search)
+    c.register("GET", "/{index}/_eql/search", eql_search)
     # SQL (ref: x-pack/plugin/sql REST layer)
     c.register("POST", "/_sql", sql_query)
     c.register("GET", "/_sql", sql_query)
@@ -1737,3 +1740,10 @@ def sql_translate(node, params, body):
 def sql_close(node, params, body):
     found = node.sql_service.close_cursor((body or {}).get("cursor", ""))
     return 200, {"succeeded": found}
+
+
+def eql_search(node, params, body, index):
+    with node.task_manager.task_scope(
+            "transport", "indices:data/read/eql",
+            description=f"indices[{index}]", cancellable=True):
+        return 200, node.eql_service.search(index, body or {})

@@ -38,17 +38,45 @@ CollectCtx = List[Tuple[Any, np.ndarray, Any]]
 METRIC_AGGS = {"avg", "sum", "min", "max", "value_count", "stats",
                "extended_stats", "cardinality", "percentiles",
                "percentile_ranks", "top_hits", "weighted_avg",
-               "geo_bounds", "geo_centroid"}
+               "geo_bounds", "geo_centroid",
+               # x-pack analytics + aggs-matrix-stats parity
+               "boxplot", "top_metrics", "string_stats", "matrix_stats"}
 BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "filter",
                "filters", "missing", "global", "composite",
                "geo_distance", "geohash_grid", "geotile_grid"}
 PIPELINE_AGGS = {"avg_bucket", "sum_bucket", "min_bucket", "max_bucket",
-                 "stats_bucket", "cumulative_sum", "derivative", "bucket_sort"}
+                 "stats_bucket", "cumulative_sum", "derivative",
+                 "bucket_sort", "cumulative_cardinality"}
 
 
 def compute_aggs(spec: Dict[str, Any], ctx: CollectCtx,
                  mapper, device_cache=None) -> Dict[str, Any]:
-    """Evaluate an aggs tree; returns the `aggregations` response object."""
+    """Evaluate an aggs tree; returns the `aggregations` response object.
+
+    Wrapper over _compute_aggs that strips internal carrier keys (e.g.
+    cardinality's exact value set, consumed by cumulative_cardinality)
+    from the finished tree."""
+    out = _compute_aggs(spec, ctx, mapper, device_cache)
+    _strip_internal(out)
+    return out
+
+
+def _strip_internal(node) -> None:
+    if isinstance(node, dict):
+        # only the internal carrier (a Python set) — a user _source field
+        # named "_set" is a JSON value and passes through untouched
+        if isinstance(node.get("_set"), set):
+            del node["_set"]
+        for k, v in node.items():
+            if k != "_source":
+                _strip_internal(v)
+    elif isinstance(node, list):
+        for v in node:
+            _strip_internal(v)
+
+
+def _compute_aggs(spec: Dict[str, Any], ctx: CollectCtx,
+                  mapper, device_cache=None) -> Dict[str, Any]:
     if device_cache is not None:
         _query_masks._cache = device_cache
     out: Dict[str, Any] = {}
@@ -220,7 +248,148 @@ def _metric(agg_type, body, ctx, mapper):
             if nv is not None:
                 m = mask[: seg.n_docs] & ~nv.missing
                 distinct.update(np.unique(nv.values[m]).tolist())
-        return {"value": len(distinct)}
+        # the exact distinct set travels internally for
+        # cumulative_cardinality (stripped from the response)
+        return {"value": len(distinct), "_set": distinct}
+
+    if agg_type == "boxplot":
+        # ref: x-pack/plugin/analytics BoxplotAggregator — five-number
+        # summary + 1.5·IQR whiskers clamped to real data points
+        vals = _numeric_values(ctx, field)
+        if len(vals) == 0:
+            return {"min": None, "max": None, "q1": None, "q2": None,
+                    "q3": None}
+        q1, q2, q3 = (float(np.percentile(vals, p)) for p in (25, 50, 75))
+        iqr = q3 - q1
+        lo, hi = q1 - 1.5 * iqr, q3 + 1.5 * iqr
+        within = vals[(vals >= lo) & (vals <= hi)]
+        return {"min": float(vals.min()), "max": float(vals.max()),
+                "q1": q1, "q2": q2, "q3": q3,
+                "lower": float(within.min()) if len(within) else q1,
+                "upper": float(within.max()) if len(within) else q3}
+
+    if agg_type == "top_metrics":
+        # ref: x-pack/plugin/analytics TopMetricsAggregator — the metric
+        # values of the top-N docs by a sort field
+        metrics = body.get("metrics", [])
+        if isinstance(metrics, dict):
+            metrics = [metrics]
+        sort_spec = body.get("sort", [])
+        if isinstance(sort_spec, (str, dict)):
+            sort_spec = [sort_spec]
+        if not sort_spec:
+            raise IllegalArgumentException("top_metrics requires [sort]")
+        entry = sort_spec[0]
+        if isinstance(entry, str):
+            sfield, order = entry, "asc"
+        else:
+            (sfield, spec), = entry.items()
+            order = spec if isinstance(spec, str) else spec.get("order", "asc")
+        size = int(body.get("size", 1))
+        rows = []          # (sort_value, {metric: value})
+        for seg, mask, _m in ctx:
+            sv, sm = _first_values_and_mask(seg, mask, sfield)
+            if sv is None:
+                continue
+            for d in np.nonzero(sm)[0]:
+                mvals = {}
+                for mspec in metrics:
+                    mf = mspec.get("field")
+                    nv = seg.numerics.get(mf)
+                    mvals[mf] = (float(nv.values[d])
+                                 if nv is not None and not nv.missing[d]
+                                 else None)
+                rows.append((float(sv[d]), mvals))
+        rows.sort(key=lambda r: r[0], reverse=(order == "desc"))
+        return {"top": [{"sort": [s], "metrics": mv}
+                        for s, mv in rows[:size]]}
+
+    if agg_type == "string_stats":
+        # ref: x-pack/plugin/analytics StringStatsAggregator — length
+        # stats + Shannon entropy over the character distribution
+        count = 0
+        min_len = None
+        max_len = None
+        total_len = 0
+        char_counts: Dict[str, int] = {}
+        for seg, mask, _m in ctx:
+            kv = seg.keywords.get(field)
+            if kv is None:
+                continue
+            m = mask[: seg.n_docs]
+            for d in np.nonzero(m)[0]:
+                for o in kv.all_ords[kv.offsets[d]: kv.offsets[d + 1]]:
+                    term = kv.terms[o]
+                    count += 1
+                    ln = len(term)
+                    total_len += ln
+                    min_len = ln if min_len is None else min(min_len, ln)
+                    max_len = ln if max_len is None else max(max_len, ln)
+                    for ch in term:
+                        char_counts[ch] = char_counts.get(ch, 0) + 1
+        if count == 0:
+            return {"count": 0, "min_length": None, "max_length": None,
+                    "avg_length": None, "entropy": 0.0}
+        total_chars = sum(char_counts.values())
+        entropy = -sum((c / total_chars) * math.log2(c / total_chars)
+                       for c in char_counts.values()) if total_chars else 0.0
+        out = {"count": count, "min_length": min_len,
+               "max_length": max_len, "avg_length": total_len / count,
+               "entropy": entropy}
+        if body.get("show_distribution"):
+            out["distribution"] = {
+                ch: c / total_chars
+                for ch, c in sorted(char_counts.items(),
+                                    key=lambda kv_: -kv_[1])}
+        return out
+
+    if agg_type == "matrix_stats":
+        # ref: modules/aggs-matrix-stats MatrixStatsAggregator — per-field
+        # moments + covariance/correlation over docs that carry EVERY
+        # field (pairwise-complete rows)
+        fields = body.get("fields", [])
+        cols = {f: [] for f in fields}
+        for seg, mask, _m in ctx:
+            nvs = [seg.numerics.get(f) for f in fields]
+            if any(nv is None for nv in nvs):
+                continue
+            m = mask[: seg.n_docs].copy()
+            for nv in nvs:
+                m &= ~nv.missing
+            for f, nv in zip(fields, nvs):
+                cols[f].append(nv.values[m])
+        arrs = {f: (np.concatenate(v) if v else np.zeros(0))
+                for f, v in cols.items()}
+        n = min((len(a) for a in arrs.values()), default=0)
+        if n == 0:
+            return {"doc_count": 0, "fields": []}
+        mat = np.stack([arrs[f][:n] for f in fields])     # [F, n]
+        mean = mat.mean(axis=1)
+        centered = mat - mean[:, None]
+        cov = (centered @ centered.T) / (n - 1) if n > 1 else (
+            np.zeros((len(fields), len(fields))))
+        std = np.sqrt(np.diag(cov))
+        out_fields = []
+        for i, f in enumerate(fields):
+            v = mat[i]
+            var = float(cov[i, i])
+            sd = math.sqrt(var) if var > 0 else 0.0
+            skew = (float(np.mean((v - mean[i]) ** 3)) / sd ** 3
+                    if sd else 0.0)
+            kurt = (float(np.mean((v - mean[i]) ** 4)) / sd ** 4
+                    if sd else 0.0)
+            corr = {}
+            for j, g in enumerate(fields):
+                denom = std[i] * std[j]
+                corr[g] = float(cov[i, j] / denom) if denom else 0.0
+            out_fields.append({
+                "name": f, "count": n, "mean": float(mean[i]),
+                "variance": var, "skewness": skew, "kurtosis": kurt,
+                "covariance": {g: float(cov[i, j])
+                               for j, g in enumerate(fields)},
+                "correlation": corr,
+            })
+        return {"doc_count": n, "fields": out_fields}
 
     if agg_type == "weighted_avg":
         vfield = body.get("value", {}).get("field")
@@ -303,7 +472,7 @@ def _bucket_result(sub: Dict[str, Any], bucket_ctx: CollectCtx, mapper,
     out = dict(extra)
     out["doc_count"] = doc_count
     if sub:
-        out.update(compute_aggs(sub, bucket_ctx, mapper))
+        out.update(_compute_aggs(sub, bucket_ctx, mapper))
     return out
 
 
@@ -450,7 +619,7 @@ def _bucket(agg_type, body, sub, ctx, mapper):
         global_ctx = [(seg, seg.live.copy(), m) for seg, _msk, m in ctx]
         out = {"doc_count": sum(int(msk.sum()) for _, msk, _m in global_ctx)}
         if sub:
-            out.update(compute_aggs(sub, global_ctx, mapper))
+            out.update(_compute_aggs(sub, global_ctx, mapper))
         return out
 
     if agg_type == "filter":
@@ -869,6 +1038,19 @@ def _compute_pipeline(agg_type, body, results):
                 b["derivative"] = {"value": v - prev}
             prev = v
         return {"value": None}
+    if agg_type == "cumulative_cardinality":
+        # ref: x-pack/plugin/analytics CumulativeCardinality — running
+        # distinct count over a sibling histogram's cardinality sub-aggs
+        # (exact here: union of the carried value sets)
+        agg_name, _, metric = path.partition(">")
+        agg = results.get(agg_name, {})
+        seen: set = set()
+        for b in agg.get("buckets", []):
+            s = b.get(metric, {}).get("_set")
+            if s is not None:
+                seen |= s
+            b["cumulative_cardinality"] = {"value": len(seen)}
+        return {"value": len(seen)}
     if agg_type == "bucket_sort":
         return {}
     values = _extract_bucket_values(path, results)

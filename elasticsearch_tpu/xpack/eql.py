@@ -1,0 +1,365 @@
+"""EQL: event query language over the search engine.
+
+Mirrors the reference's x-pack EQL plugin (ref: x-pack/plugin/eql —
+ANTLR parser + planner sharing the `ql` core with SQL, sequence/join
+execution under `execution/`; SURVEY.md §2.6). Re-design for this engine:
+
+- **event queries** (`category where condition`) translate the condition
+  through the shared QL core (xpack/ql.py) into the JSON query DSL and
+  run on the TPU search path, ordered by the timestamp field.
+- **sequences** (`sequence by key [q1] [q2] ... until [q]`) fetch each
+  stage's candidate events (device-filtered), then run a host-side
+  state machine over the time-ordered event stream, keyed by the join
+  fields, honoring `maxspan` (ref: eql/execution/sequence/
+  SequenceMatcher — the same "keyed stage windows" model).
+- pipes: `| head N`, `| tail N`.
+
+Conditions that cannot be expressed in the query DSL (arbitrary scalar
+functions) fall back to device-side category filtering + host-side
+row evaluation via ql.evaluate — correctness first, device filter as
+the fast path.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ParsingException,
+)
+from elasticsearch_tpu.search.searcher import _get_path as _source_get
+from elasticsearch_tpu.xpack import ql
+from elasticsearch_tpu.xpack.sql import Parser as SqlParser
+
+
+@dataclass
+class EventQuery:
+    category: Optional[str]         # None = any
+    condition: ql.Expr
+    join_keys: List[str] = dc_field(default_factory=list)
+
+
+@dataclass
+class EqlQuery:
+    kind: str                       # "event" | "sequence"
+    queries: List[EventQuery]
+    by: List[str] = dc_field(default_factory=list)      # shared join keys
+    maxspan_ms: Optional[float] = None
+    until: Optional[EventQuery] = None
+    head: Optional[int] = None
+    tail: Optional[int] = None
+
+
+_UNITS_MS = {"ms": 1, "s": 1000, "m": 60_000, "h": 3_600_000,
+             "d": 86_400_000}
+
+
+class EqlParser(SqlParser):
+    """EQL grammar on top of the shared tokenizer/expression parser
+    (ref: x-pack/plugin/eql/.../parser/EqlBaseParser)."""
+
+    def parse_eql(self) -> EqlQuery:
+        t = self.peek()
+        if t.kind == "KEYWORD" and t.value == "sequence":
+            q = self._sequence()
+        elif t.kind == "KEYWORD" and t.value == "join":
+            raise IllegalArgumentException("join is not supported")
+        else:
+            q = EqlQuery("event", [self._event_query()])
+        # pipes
+        while self.accept_op("|"):
+            name = self.next()
+            if name.value in ("head", "tail"):
+                num = self.next()
+                if num.kind != "NUMBER":
+                    raise ParsingException(f"{name.value} requires a number")
+                if name.value == "head":
+                    q.head = int(num.value)
+                else:
+                    q.tail = int(num.value)
+            else:
+                raise ParsingException(f"Unsupported pipe [{name.value}]")
+        if self.peek().kind != "EOF":
+            raise ParsingException(
+                f"Unexpected token [{self.peek().value}]")
+        return q
+
+    def _event_query(self) -> EventQuery:
+        t = self.next()
+        if t.kind not in ("IDENT", "KEYWORD", "STRING"):
+            raise ParsingException("Expected an event category")
+        category = None if t.value == "any" else str(t.value)
+        self.expect_kw("where")
+        cond = self._expr()
+        return EventQuery(category, cond)
+
+    def _sequence(self) -> EqlQuery:
+        self.expect_kw("sequence")
+        by: List[str] = []
+        maxspan = None
+        if self.accept_kw("by"):
+            by.append(self._identifier())
+            while self.accept_op(","):
+                by.append(self._identifier())
+        if self.accept_kw("with"):
+            self.expect_kw("maxspan")
+            self.expect_op("=")
+            num = self.next()
+            if num.kind != "NUMBER":
+                raise ParsingException("maxspan requires a duration")
+            unit_tok = self.peek()
+            unit = "s"
+            if unit_tok.kind in ("IDENT", "KEYWORD") and str(
+                    unit_tok.value).lower() in _UNITS_MS:
+                unit = str(self.next().value).lower()
+            maxspan = float(num.value) * _UNITS_MS[unit]
+        queries: List[EventQuery] = []
+        until = None
+        while True:
+            if self.accept_op("["):
+                eq = self._event_query()
+                self.expect_op("]")
+                if self.accept_kw("by"):
+                    eq.join_keys.append(self._identifier())
+                    while self.accept_op(","):
+                        eq.join_keys.append(self._identifier())
+                queries.append(eq)
+                continue
+            if self.accept_kw("until"):
+                self.expect_op("[")
+                until = self._event_query()
+                self.expect_op("]")
+                if self.accept_kw("by"):
+                    until.join_keys.append(self._identifier())
+                    while self.accept_op(","):
+                        until.join_keys.append(self._identifier())
+                continue
+            break
+        if len(queries) < 2:
+            raise ParsingException(
+                "sequence requires at least two event queries")
+        n_keys = {len(q.join_keys) for q in queries}
+        if len(n_keys) > 1:
+            raise ParsingException(
+                "all sequence queries need the same number of join keys")
+        return EqlQuery("sequence", queries, by=by, maxspan_ms=maxspan,
+                        until=until)
+
+
+@dataclass
+class _Event:
+    ts: float
+    tiebreak: Any
+    index: str
+    doc_id: str
+    source: Dict[str, Any]
+
+
+class EqlService:
+    """Plans and executes EQL searches (ref: x-pack/plugin/eql/.../
+    execution/PlanExecutor + TransportEqlSearchAction)."""
+
+    def __init__(self, node):
+        self.node = node
+
+    def search(self, index: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        start = time.monotonic()
+        text = body.get("query")
+        if not text:
+            raise IllegalArgumentException("[query] is required")
+        # EQL uses "..." for strings too; normalize double quotes that
+        # enclose literals after an operator into single-quoted strings
+        plan = EqlParser(_normalize_strings(text)).parse_eql()
+        ts_field = body.get("timestamp_field", "@timestamp")
+        cat_field = body.get("event_category_field", "event.category")
+        tiebreak_field = body.get("tiebreaker_field")
+        size = int(body.get("size", 10))
+        fetch_size = int(body.get("fetch_size", 10000))
+        extra_filter = body.get("filter")
+        self._truncated = False
+
+        if plan.kind == "event":
+            events = self._fetch(index, plan.queries[0], ts_field,
+                                 cat_field, tiebreak_field, extra_filter,
+                                 fetch_size)
+            events = _apply_pipes(events, plan)
+            hits = {"total": {"value": len(events), "relation": "eq"},
+                    "events": [self._render(e) for e in events[:size]]}
+        else:
+            seqs = self._sequences(index, plan, ts_field, cat_field,
+                                   tiebreak_field, extra_filter, fetch_size)
+            seqs = _apply_pipes(seqs, plan)
+            hits = {"total": {"value": len(seqs), "relation": "eq"},
+                    "sequences": [
+                        {"join_keys": list(keys),
+                         "events": [self._render(e) for e in evs]}
+                        for keys, evs in seqs[:size]]}
+        return {
+            "is_partial": self._truncated,
+            "is_running": False,
+            "took": int((time.monotonic() - start) * 1000),
+            "timed_out": False,
+            "hits": hits,
+        }
+
+    # ------------------------------------------------------------------
+    def _fetch(self, index: str, eq: EventQuery, ts_field: str,
+               cat_field: str, tiebreak_field: Optional[str],
+               extra_filter, fetch_size: int = 10000) -> List[_Event]:
+        """Fetch an event query's matching events, time-ascending.
+
+        Device filter when the condition translates to the query DSL;
+        otherwise category-only device filter + host-side evaluate."""
+        musts: List[Dict[str, Any]] = [
+            {"exists": {"field": ts_field}}]       # events need a timestamp
+        if eq.category is not None:
+            musts.append({"term": {cat_field: {"value": eq.category}}})
+        if extra_filter:
+            musts.append(extra_filter)
+        post_eval = None
+        try:
+            cond_q = ql.to_filter(eq.condition)
+            musts.append(cond_q)
+        except ParsingException:
+            post_eval = eq.condition
+        query = ({"bool": {"must": musts}} if musts else {"match_all": {}})
+        sort = [{ts_field: {"order": "asc"}}]
+        if tiebreak_field:
+            sort.append({tiebreak_field: {"order": "asc"}})
+        r = self.node.search_service.search(index, {
+            "query": query, "size": fetch_size, "sort": sort,
+            "_source": True})
+        if len(r["hits"]["hits"]) >= fetch_size:
+            self._truncated = True                  # stream cut at the cap
+        out: List[_Event] = []
+        for h in r["hits"]["hits"]:
+            src = h.get("_source", {}) or {}
+            if post_eval is not None:
+                try:
+                    ok = ql.evaluate(post_eval,
+                                     lambda f, _s=src: _source_get(_s, f))
+                except Exception:
+                    ok = False
+                if not ok:
+                    continue
+            sv = h.get("sort", [])
+            if not sv or sv[0] is None:
+                continue                            # no usable timestamp
+            ts = float(sv[0])
+            tb = sv[1] if len(sv) > 1 else h["_id"]
+            out.append(_Event(ts, tb, h["_index"], h["_id"], src))
+        return out
+
+    def _sequences(self, index: str, plan: EqlQuery, ts_field: str,
+                   cat_field: str, tiebreak_field, extra_filter,
+                   fetch_size: int = 10000):
+        """Keyed stage state machine (ref: eql SequenceMatcher): events
+        stream in time order; a partial sequence at stage i advances when
+        stage i+1's query matches the same join key within maxspan."""
+        n = len(plan.queries)
+        streams: List[List[_Event]] = [
+            self._fetch(index, q, ts_field, cat_field, tiebreak_field,
+                        extra_filter, fetch_size)
+            for q in plan.queries]
+        until_events = (self._fetch(index, plan.until, ts_field, cat_field,
+                                    tiebreak_field, extra_filter, fetch_size)
+                        if plan.until is not None else [])
+
+        def keys_of(e: _Event, stage_q: EventQuery):
+            names = list(plan.by) + list(stage_q.join_keys)
+            return tuple(_source_get(e.source, k) for k in names)
+
+        # merge all stage streams into one time-ordered list of
+        # (event, stage) — an event doc may match several stages
+        tagged: List[Tuple[_Event, int]] = []
+        for si, evs in enumerate(streams):
+            tagged.extend((e, si) for e in evs)
+        for e in until_events:
+            tagged.append((e, -1))                   # until marker
+        def tb_key(v):
+            # numbers compare numerically, strings lexicographically
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                return (0, float(v), "")
+            return (1, 0.0, str(v))
+
+        tagged.sort(key=lambda t: (t[0].ts, tb_key(t[0].tiebreak),
+                                   0 if t[1] == -1 else 1, t[1]))
+
+        # one partial per (join key, stage): slots[s] holds the events of
+        # the sequence awaiting stage s; a newer stage-0 event REPLACES
+        # the old frame (Elastic's KeyToSequences/SequenceMatcher
+        # semantics — the freshest candidate wins each stage)
+        partials: Dict[tuple, Dict[int, List[_Event]]] = {}
+        completed: List[Tuple[tuple, List[_Event]]] = []
+        for e, stage in tagged:
+            if stage == -1:
+                k = keys_of(e, plan.until)
+                partials.pop(k, None)                # until kills partials
+                continue
+            k = keys_of(e, plan.queries[stage])
+            slots = partials.setdefault(k, {})
+            if stage == 0:
+                slots[1] = [e]
+                continue
+            p = slots.get(stage)
+            if p is None:
+                continue
+            if (plan.maxspan_ms is not None
+                    and e.ts - p[0].ts > plan.maxspan_ms):
+                continue
+            if e.doc_id == p[-1].doc_id and e.index == p[-1].index:
+                continue                              # same event doc
+            del slots[stage]
+            seq = p + [e]
+            if len(seq) == n:
+                completed.append((k, seq))
+            else:
+                slots[stage + 1] = seq
+        return completed
+
+    def _render(self, e: _Event) -> Dict[str, Any]:
+        return {"_index": e.index, "_id": e.doc_id, "_source": e.source}
+
+
+def _apply_pipes(items, plan: EqlQuery):
+    if plan.head is not None:
+        items = items[: plan.head]
+    if plan.tail is not None:
+        items = items[-plan.tail:] if plan.tail else []
+    return items
+
+
+def _normalize_strings(text: str) -> str:
+    """EQL string literals use double quotes; the shared tokenizer treats
+    double quotes as quoted identifiers. Convert "..." literals to
+    '...' (escaping embedded single quotes)."""
+    out = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == '"':
+            j = i + 1
+            buf = []
+            while j < n and text[j] != '"':
+                if text[j] == "\\" and j + 1 < n:
+                    buf.append(text[j + 1])
+                    j += 2
+                    continue
+                buf.append(text[j])
+                j += 1
+            out.append("'" + "".join(buf).replace("'", "''") + "'")
+            i = j + 1
+        elif c == "'":
+            j = i + 1
+            while j < n and text[j] != "'":
+                j += 1
+            out.append(text[i: j + 1])
+            i = j + 1
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)

@@ -33,7 +33,7 @@ class Token:
 
 
 _OPS = ["<=", ">=", "!=", "<>", "==", "=", "<", ">", "+", "-", "*", "/",
-        "%", "(", ")", ",", ".", ":"]
+        "%", "(", ")", ",", ".", ":", "[", "]", "|"]
 
 _KEYWORDS = {
     "select", "distinct", "from", "where", "group", "by", "having",
@@ -291,6 +291,22 @@ def to_filter(e: Expr) -> Dict[str, Any]:
             return {"query_string": {"query": _literal_value(e.args[0])}}
         if e.name == "EXISTS":
             return {"exists": {"field": _field_name(e.args[0])}}
+        # EQL string predicates (ref: x-pack/plugin/eql function registry)
+        if e.name == "WILDCARD":
+            f = _field_name(e.args[0])
+            pats = [{"wildcard": {f: {"value": _literal_value(a)}}}
+                    for a in e.args[1:]]
+            return pats[0] if len(pats) == 1 else {
+                "bool": {"should": pats, "minimum_should_match": 1}}
+        if e.name == "STARTSWITH":
+            return {"prefix": {_field_name(e.args[0]): {
+                "value": _literal_value(e.args[1])}}}
+        if e.name == "ENDSWITH":
+            return {"wildcard": {_field_name(e.args[0]): {
+                "value": "*" + _literal_value(e.args[1])}}}
+        if e.name == "STRINGCONTAINS":
+            return {"wildcard": {_field_name(e.args[0]): {
+                "value": "*" + _literal_value(e.args[1]) + "*"}}}
     if isinstance(e, Literal) and e.value is True:
         return {"match_all": {}}
     raise ParsingException(
@@ -375,6 +391,19 @@ _SCALARS: Dict[str, Callable] = {
     "NULLIF": lambda a, b: None if a == b else a,
     "COALESCE": lambda *a: next((x for x in a if x is not None), None),
     "IFNULL": lambda a, b: b if a is None else a,
+    "WILDCARD": lambda s, *pats: any(
+        re.fullmatch(re.escape(p).replace(r"\*", ".*"), str(s)) is not None
+        for p in pats),
+    "STARTSWITH": lambda s, p: str(s).startswith(str(p)),
+    "ENDSWITH": lambda s, p: str(s).endswith(str(p)),
+    "STRINGCONTAINS": lambda s, p: str(p) in str(s),
+    "ADD": lambda a, b: a + b,
+    "SUBTRACT": lambda a, b: a - b,
+    "MULTIPLY": lambda a, b: a * b,
+    "DIVIDE": lambda a, b: a / b if b else None,
+    "MODULO": lambda a, b: a % b if b else None,
+    "NUMBER": lambda s: float(s),
+    "STRING": lambda v: str(v),
     "YEAR": lambda v: _dt(v).year,
     "MONTH": lambda v: _dt(v).month,
     "DAY": lambda v: _dt(v).day,

@@ -421,6 +421,7 @@ class _Cursor:
     fetch_size: int = DEFAULT_FETCH_SIZE
     emitted: int = 0
     exhausted: bool = False         # no more composite pages; rows buffered
+    expires_at: float = 0.0
 
 
 class SqlService:
@@ -581,7 +582,10 @@ class SqlService:
         return items, cols
 
     def _row_select(self, stmt: SelectStmt, fetch_size: int):
-        size = stmt.limit if stmt.limit is not None else 10000
+        # DISTINCT dedups AFTER fetching, so the fetch cannot be capped
+        # at LIMIT (dedup would then under-fill the page)
+        size = (stmt.limit if stmt.limit is not None and not stmt.distinct
+                else 10000)
         body = self._row_search_body(stmt, size)
         body["_source"] = True
         r = self.node.search_service.search(stmt.table, body)
@@ -610,6 +614,8 @@ class SqlService:
                     continue
                 seen.add(key)
             rows.append(row)
+        if stmt.limit is not None:
+            rows = rows[: stmt.limit]
         return self._paged_rows(cols, rows, stmt, fetch_size)
 
     # .. agg plan
@@ -794,8 +800,11 @@ class SqlService:
                 raise IllegalArgumentException(
                     f"ORDER BY [{key}] must appear in SELECT for "
                     "grouped queries")
-            rows.sort(key=lambda row, _j=j: (
-                row[_j] is None, row[_j]), reverse=(direction == "desc"))
+            nulls = [r for r in rows if r[j] is None]
+            nonnull = [r for r in rows if r[j] is not None]
+            nonnull.sort(key=lambda r, _j=j: r[_j],
+                         reverse=(direction == "desc"))
+            rows[:] = nonnull + nulls
 
     def _agg_select(self, stmt: SelectStmt, fetch_size: int,
                     after: Optional[Dict[str, Any]] = None,
@@ -885,10 +894,18 @@ class SqlService:
         return {"columns": cols, "rows": rows[:fetch_size],
                 "cursor": self._save(cur)}
 
+    CURSOR_KEEP_ALIVE = 300.0       # seconds (abandoned cursors expire)
+
     def _save(self, cur: _Cursor) -> str:
+        import time
         cid = base64.urlsafe_b64encode(
             uuid.uuid4().bytes).decode().rstrip("=")
+        cur.expires_at = time.time() + self.CURSOR_KEEP_ALIVE
         with self._lock:
+            now = time.time()
+            for k in [k for k, c in self._cursors.items()
+                      if c.expires_at < now]:
+                del self._cursors[k]
             self._cursors[cid] = cur
         return cid
 

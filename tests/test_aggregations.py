@@ -262,3 +262,65 @@ def test_composite_missing_bucket(search):
     keys = [b["key"]["q"] for b in a["comp"]["buckets"]]
     assert keys[0] is None
     assert set(keys[1:]) == {0.0, 10.0, 20.0}
+
+
+def test_boxplot(search):
+    a = agg(search, {"b": {"boxplot": {"field": "price"}}})
+    b = a["b"]
+    assert b["min"] == 1.0 and b["max"] == 10.0
+    assert b["q1"] <= b["q2"] <= b["q3"]
+    assert b["lower"] >= b["min"] and b["upper"] <= b["max"]
+
+
+def test_top_metrics(search):
+    a = agg(search, {"t": {"top_metrics": {
+        "metrics": [{"field": "qty"}],
+        "sort": [{"price": {"order": "desc"}}],
+        "size": 2}}})
+    top = a["t"]["top"]
+    assert top[0]["sort"] == [10.0]
+    assert top[0]["metrics"]["qty"] is None       # meat has no qty
+    assert top[1]["sort"] == [5.0]
+    assert top[1]["metrics"]["qty"] == 2.0
+
+
+def test_string_stats(search):
+    a = agg(search, {"s": {"string_stats": {"field": "category"}}})
+    s = a["s"]
+    assert s["count"] == 6
+    assert s["min_length"] == 3                   # veg
+    assert s["max_length"] == 5                   # fruit
+    assert s["entropy"] > 0
+    a = agg(search, {"s": {"string_stats": {
+        "field": "category", "show_distribution": True}}})
+    assert abs(sum(a["s"]["distribution"].values()) - 1.0) < 1e-9
+
+
+def test_matrix_stats(search):
+    a = agg(search, {"m": {"matrix_stats": {"fields": ["price", "qty"]}}})
+    m = a["m"]
+    assert m["doc_count"] == 5                    # meat lacks qty
+    price = next(f for f in m["fields"] if f["name"] == "price")
+    assert price["count"] == 5
+    assert price["correlation"]["price"] == pytest.approx(1.0)
+    # price up, qty down in the fixture → negative correlation
+    assert price["correlation"]["qty"] < 0
+    qty = next(f for f in m["fields"] if f["name"] == "qty")
+    assert qty["covariance"]["price"] == pytest.approx(
+        price["covariance"]["qty"])
+
+
+def test_cumulative_cardinality(search):
+    a = agg(search, {
+        "days": {"date_histogram": {"field": "sold_at",
+                                    "calendar_interval": "day"},
+                 "aggs": {"cats": {"cardinality": {"field": "category"}}}},
+        "total": {"cumulative_cardinality": {"buckets_path": "days>cats"}},
+    })
+    cum = [b["cumulative_cardinality"]["value"]
+           for b in a["days"]["buckets"]]
+    assert cum == [1, 2, 3]
+    assert a["total"]["value"] == 3
+    # the internal exact set must not leak into the response
+    for b in a["days"]["buckets"]:
+        assert "_set" not in b["cats"]

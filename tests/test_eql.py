@@ -1,0 +1,153 @@
+"""EQL plugin tests (model: x-pack/plugin/eql execution tests — event
+queries, sequences with maxspan/until, pipes)."""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+MAPPINGS = {
+    "properties": {
+        "etype": {"type": "keyword"},
+        "ts": {"type": "date"},
+        "user": {"type": "keyword"},
+        "proc": {"type": "keyword"},
+        "pid": {"type": "long"},
+        "port": {"type": "long"},
+    }
+}
+
+# a process/network event log: two users, one full attack chain for bob
+EVENTS = [
+    {"etype": "process", "ts": 1000, "user": "bob", "proc": "cmd.exe", "pid": 1},
+    {"etype": "process", "ts": 2000, "user": "amy", "proc": "calc.exe", "pid": 2},
+    {"etype": "network", "ts": 3000, "user": "bob", "proc": "cmd.exe",
+     "pid": 1, "port": 443},
+    {"etype": "process", "ts": 4000, "user": "amy", "proc": "word.exe", "pid": 4},
+    {"etype": "file", "ts": 5000, "user": "bob", "proc": "cmd.exe", "pid": 1},
+    {"etype": "process", "ts": 90_000_000, "user": "amy", "proc": "cmd.exe",
+     "pid": 9},
+    {"etype": "network", "ts": 190_000_000, "user": "amy", "proc": "cmd.exe",
+     "pid": 9, "port": 80},
+]
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("eql")
+    n = Node(data_path=str(tmp / "data"))
+    idx = n.indices_service.create_index(
+        "logs", {"index.number_of_shards": 2}, MAPPINGS)
+    for i, d in enumerate(EVENTS):
+        idx.index_doc(str(i), d)
+    idx.refresh()
+    yield n
+    n.close()
+
+
+def eql(node, query, **body):
+    status, r = node.rest_controller.dispatch(
+        "POST", "/logs/_eql/search", {},
+        {"query": query, "timestamp_field": "ts",
+         "event_category_field": "etype", **body})
+    assert status == 200, r
+    return r
+
+
+def test_event_query(node):
+    r = eql(node, 'process where proc == "cmd.exe"')
+    events = r["hits"]["events"]
+    assert [e["_source"]["user"] for e in events] == ["bob", "amy"]
+    assert r["hits"]["total"]["value"] == 2
+
+
+def test_any_category(node):
+    r = eql(node, 'any where user == "amy"', size=10)
+    assert r["hits"]["total"]["value"] == 4
+
+
+def test_event_query_functions(node):
+    r = eql(node, 'process where wildcard(proc, "c*.exe")', size=10)
+    procs = [e["_source"]["proc"] for e in r["hits"]["events"]]
+    assert sorted(set(procs)) == ["calc.exe", "cmd.exe"]
+    r = eql(node, 'process where startsWith(proc, "w")')
+    assert [e["_source"]["proc"] for e in r["hits"]["events"]] == ["word.exe"]
+
+
+def test_numeric_condition(node):
+    r = eql(node, "network where port > 100")
+    assert [e["_source"]["port"] for e in r["hits"]["events"]] == [443]
+
+
+def test_sequence_by_key(node):
+    r = eql(node, 'sequence by user [process where true] '
+                  '[network where true]')
+    seqs = r["hits"]["sequences"]
+    assert len(seqs) == 2
+    by_user = {s["join_keys"][0]: s for s in seqs}
+    assert by_user["bob"]["events"][0]["_source"]["ts"] == 1000
+    assert by_user["bob"]["events"][1]["_source"]["ts"] == 3000
+    assert by_user["amy"]["events"][0]["_source"]["ts"] == 90_000_000
+
+
+def test_sequence_maxspan(node):
+    # amy's process→network pair is 100000s apart; maxspan kills it
+    r = eql(node, 'sequence by user with maxspan=10s '
+                  '[process where true] [network where true]')
+    seqs = r["hits"]["sequences"]
+    assert len(seqs) == 1
+    assert seqs[0]["join_keys"] == ["bob"]
+
+
+def test_sequence_three_stages(node):
+    r = eql(node, 'sequence by user [process where true] '
+                  '[network where true] [file where true]')
+    seqs = r["hits"]["sequences"]
+    assert len(seqs) == 1
+    assert [e["_source"]["etype"] for e in seqs[0]["events"]] == [
+        "process", "network", "file"]
+
+
+def test_sequence_until(node):
+    # a process event for amy between her stages kills the partial via
+    # until — use bob's file event at ts 5000 as the canary instead
+    r = eql(node, 'sequence by user [process where true] '
+                  '[file where true] until [network where true]')
+    # bob: process@1000 then network@3000 kills it before file@5000
+    assert r["hits"]["sequences"] == []
+
+
+def test_head_pipe(node):
+    r = eql(node, "any where true | head 3", size=10)
+    assert r["hits"]["total"]["value"] == 3
+    assert [e["_source"]["ts"] for e in r["hits"]["events"]] == [
+        1000, 2000, 3000]
+
+
+def test_tail_pipe(node):
+    r = eql(node, "any where true | tail 2", size=10)
+    assert [e["_source"]["ts"] for e in r["hits"]["events"]] == [
+        90_000_000, 190_000_000]
+
+
+def test_filter_body(node):
+    r = eql(node, "any where true", size=10,
+            filter={"term": {"user": {"value": "bob"}}})
+    assert r["hits"]["total"]["value"] == 3
+
+
+def test_in_and_not(node):
+    r = eql(node, 'process where proc in ("cmd.exe", "word.exe") and '
+                  'not user == "bob"', size=10)
+    assert [e["_source"]["proc"] for e in r["hits"]["events"]] == [
+        "word.exe", "cmd.exe"]
+
+
+def test_event_missing_timestamp_skipped(node):
+    # a doc without the timestamp field must not 500 the search
+    idx = node.indices_service.get("logs")
+    idx.index_doc("no-ts", {"etype": "process", "user": "zed",
+                            "proc": "rogue.exe"})
+    idx.refresh()
+    r = eql(node, "process where true", size=20)
+    users = [e["_source"]["user"] for e in r["hits"]["events"]]
+    assert "zed" not in users

@@ -236,3 +236,19 @@ def test_txt_format_carries_cursor(node):
     # continuation page: rows only, no header line
     assert "name" not in r2["_cat"]
     assert "carol" in r2["_cat"] or "dave" in r2["_cat"]
+
+
+def test_distinct_with_limit(node):
+    # dedup happens BEFORE the limit — 3 distinct depts exist
+    r = q(node, "SELECT DISTINCT dept FROM emp LIMIT 3")
+    assert sorted(row[0] for row in r["rows"]) == ["eng", "hr", "sales"]
+
+
+def test_grouped_order_desc_nulls_last(node):
+    idx = node.indices_service.get("emp")
+    idx.index_doc("no-dept", {"emp_no": 9, "name": "zoe", "salary": 70.0})
+    idx.refresh()
+    r = q(node, "SELECT dept, COUNT(*) AS c FROM emp GROUP BY dept "
+                "ORDER BY dept DESC")
+    keys = [row[0] for row in r["rows"]]
+    assert keys == ["sales", "hr", "eng", None]
