@@ -77,6 +77,17 @@ class Node:
         self.sql_service = SqlService(self)
         from elasticsearch_tpu.xpack.eql import EqlService
         self.eql_service = EqlService(self)
+        from elasticsearch_tpu.xpack.ml import MlService
+        self.ml_service = MlService(self)
+        from elasticsearch_tpu.xpack.rollup import RollupService
+        self.rollup_service = RollupService(self)
+        from elasticsearch_tpu.xpack.enrich import EnrichService
+        self.enrich_service = EnrichService(self)
+        from elasticsearch_tpu.xpack.graph import GraphService
+        self.graph_service = GraphService(self)
+        # processors that join against live services (enrich) resolve
+        # the node through the ingest service
+        self.ingest_service.node = self
         # per-request thread-local context (authenticated user)
         import threading
         self.request_context = threading.local()

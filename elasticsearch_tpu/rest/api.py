@@ -227,6 +227,56 @@ def _register_all(c: RestController):
     c.register("DELETE", "/_snapshot/{repo}/{snap}", delete_snapshot)
     c.register("POST", "/_snapshot/{repo}/{snap}/_restore", restore_snapshot)
     # transform
+    # rollup (ref: x-pack/plugin/rollup REST layer)
+    c.register("PUT", "/_rollup/job/{id}", rollup_put_job)
+    c.register("GET", "/_rollup/job/{id}", rollup_get_job)
+    c.register("DELETE", "/_rollup/job/{id}", rollup_delete_job)
+    c.register("POST", "/_rollup/job/{id}/_start", rollup_start_job)
+    c.register("POST", "/_rollup/job/{id}/_stop", rollup_stop_job)
+    c.register("GET", "/_rollup/data/{id}", rollup_caps)
+    c.register("POST", "/{index}/_rollup_search", rollup_search)
+    c.register("GET", "/{index}/_rollup_search", rollup_search)
+    # enrich (ref: x-pack/plugin/enrich REST layer)
+    c.register("PUT", "/_enrich/policy/{name}", enrich_put_policy)
+    c.register("GET", "/_enrich/policy/{name}", enrich_get_policy)
+    c.register("GET", "/_enrich/policy", enrich_list_policies)
+    c.register("DELETE", "/_enrich/policy/{name}", enrich_delete_policy)
+    c.register("POST", "/_enrich/policy/{name}/_execute",
+               enrich_execute_policy)
+    # graph (ref: x-pack/plugin/graph REST layer)
+    c.register("POST", "/{index}/_graph/explore", graph_explore)
+    c.register("GET", "/{index}/_graph/explore", graph_explore)
+    # ML (ref: x-pack/plugin/ml REST layer)
+    c.register("PUT", "/_ml/anomaly_detectors/{id}", ml_put_job)
+    c.register("GET", "/_ml/anomaly_detectors/{id}", ml_get_job)
+    c.register("GET", "/_ml/anomaly_detectors", ml_get_jobs)
+    c.register("DELETE", "/_ml/anomaly_detectors/{id}", ml_delete_job)
+    c.register("POST", "/_ml/anomaly_detectors/{id}/_open", ml_open_job)
+    c.register("POST", "/_ml/anomaly_detectors/{id}/_close", ml_close_job)
+    c.register("POST", "/_ml/anomaly_detectors/{id}/_data", ml_post_data)
+    c.register("GET", "/_ml/anomaly_detectors/{id}/results/buckets",
+               ml_get_buckets)
+    c.register("POST", "/_ml/anomaly_detectors/{id}/results/buckets",
+               ml_get_buckets)
+    c.register("GET", "/_ml/anomaly_detectors/{id}/results/records",
+               ml_get_records)
+    c.register("POST", "/_ml/anomaly_detectors/{id}/results/records",
+               ml_get_records)
+    c.register("PUT", "/_ml/datafeeds/{id}", ml_put_datafeed)
+    c.register("GET", "/_ml/datafeeds/{id}", ml_get_datafeed)
+    c.register("DELETE", "/_ml/datafeeds/{id}", ml_delete_datafeed)
+    c.register("POST", "/_ml/datafeeds/{id}/_start", ml_start_datafeed)
+    c.register("POST", "/_ml/datafeeds/{id}/_stop", ml_stop_datafeed)
+    c.register("PUT", "/_ml/data_frame/analytics/{id}", ml_put_analytics)
+    c.register("GET", "/_ml/data_frame/analytics/{id}", ml_get_analytics)
+    c.register("POST", "/_ml/data_frame/analytics/{id}/_start",
+               ml_start_analytics)
+    c.register("PUT", "/_ml/trained_models/{id}", ml_put_model)
+    c.register("GET", "/_ml/trained_models/{id}", ml_get_model)
+    c.register("DELETE", "/_ml/trained_models/{id}", ml_delete_model)
+    c.register("POST", "/_ml/trained_models/{id}/_infer", ml_infer)
+    c.register("POST", "/_ml/trained_models/{id}/deployment/_infer",
+               ml_infer)
     # EQL (ref: x-pack/plugin/eql REST layer)
     c.register("POST", "/{index}/_eql/search", eql_search)
     c.register("GET", "/{index}/_eql/search", eql_search)
@@ -1747,3 +1797,189 @@ def eql_search(node, params, body, index):
             "transport", "indices:data/read/eql",
             description=f"indices[{index}]", cancellable=True):
         return 200, node.eql_service.search(index, body or {})
+
+
+# --------------------------------------------------------------------------
+# ML (ref: x-pack/plugin/ml/.../rest/ REST handlers)
+# --------------------------------------------------------------------------
+
+def ml_put_job(node, params, body, id):
+    job = node.ml_service.put_job(id, body or {})
+    return 200, job.config_dict()
+
+
+def ml_get_job(node, params, body, id):
+    job = node.ml_service.get_job(id)
+    return 200, {"count": 1, "jobs": [job.config_dict()]}
+
+
+def ml_get_jobs(node, params, body):
+    jobs = [j.config_dict() for j in node.ml_service.jobs.values()]
+    return 200, {"count": len(jobs), "jobs": jobs}
+
+
+def ml_delete_job(node, params, body, id):
+    node.ml_service.delete_job(id)
+    return 200, {"acknowledged": True}
+
+
+def ml_open_job(node, params, body, id):
+    node.ml_service.open_job(id)
+    return 200, {"opened": True}
+
+
+def ml_close_job(node, params, body, id):
+    node.ml_service.close_job(id)
+    return 200, {"closed": True}
+
+
+def ml_post_data(node, params, body, id):
+    if isinstance(body, list):
+        docs = body
+    elif isinstance(body, dict) and body:
+        docs = [body]
+    else:
+        raise IllegalArgumentException("request body is required")
+    return 200, node.ml_service.post_data(id, docs)
+
+
+def ml_get_buckets(node, params, body, id):
+    job = node.ml_service.get_job(id)
+    buckets = job.buckets
+    body = body or {}
+    if body.get("anomaly_score") is not None:
+        thr = float(body["anomaly_score"])
+        buckets = [b for b in buckets if b["anomaly_score"] >= thr]
+    return 200, {"count": len(buckets), "buckets": buckets}
+
+
+def ml_get_records(node, params, body, id):
+    job = node.ml_service.get_job(id)
+    records = job.records
+    body = body or {}
+    thr = float(body.get("record_score", 0))
+    records = [r for r in records if r["record_score"] >= thr]
+    records = sorted(records, key=lambda r: -r["record_score"])
+    return 200, {"count": len(records), "records": records}
+
+
+def ml_put_datafeed(node, params, body, id):
+    feed = node.ml_service.put_datafeed(id, body or {})
+    return 200, feed.config_dict()
+
+
+def ml_get_datafeed(node, params, body, id):
+    feed = node.ml_service.get_datafeed(id)
+    return 200, {"count": 1, "datafeeds": [feed.config_dict()]}
+
+
+def ml_delete_datafeed(node, params, body, id):
+    node.ml_service.delete_datafeed(id)
+    return 200, {"acknowledged": True}
+
+
+def ml_start_datafeed(node, params, body, id):
+    body = body or {}
+    return 200, node.ml_service.start_datafeed(
+        id, start=body.get("start", params.get("start")),
+        end=body.get("end", params.get("end")))
+
+
+def ml_stop_datafeed(node, params, body, id):
+    return 200, node.ml_service.stop_datafeed(id)
+
+
+def ml_put_analytics(node, params, body, id):
+    return 200, node.ml_service.put_analytics(id, body or {})
+
+
+def ml_get_analytics(node, params, body, id):
+    cfg = node.ml_service.get_analytics(id)
+    return 200, {"count": 1, "data_frame_analytics": [cfg]}
+
+
+def ml_start_analytics(node, params, body, id):
+    return 200, node.ml_service.start_analytics(id)
+
+
+def ml_put_model(node, params, body, id):
+    return 200, node.ml_service.put_trained_model(id, body or {})
+
+
+def ml_get_model(node, params, body, id):
+    m = node.ml_service.get_trained_model(id)
+    return 200, {"count": 1, "trained_model_configs": [m]}
+
+
+def ml_delete_model(node, params, body, id):
+    node.ml_service.delete_trained_model(id)
+    return 200, {"acknowledged": True}
+
+
+def ml_infer(node, params, body, id):
+    docs = (body or {}).get("docs", [])
+    return 200, {"inference_results": node.ml_service.infer(id, docs)}
+
+
+# --------------------------------------------------------------------------
+# rollup / enrich / graph (ref: the corresponding x-pack REST handlers)
+# --------------------------------------------------------------------------
+
+def rollup_put_job(node, params, body, id):
+    node.rollup_service.put_job(id, body or {})
+    return 200, {"acknowledged": True}
+
+
+def rollup_get_job(node, params, body, id):
+    job = node.rollup_service.get_job(id)
+    return 200, {"jobs": [{"config": job,
+                           "status": {"job_state": job["status"]},
+                           "stats": job.get("stats", {})}]}
+
+
+def rollup_delete_job(node, params, body, id):
+    node.rollup_service.delete_job(id)
+    return 200, {"acknowledged": True}
+
+
+def rollup_start_job(node, params, body, id):
+    return 200, node.rollup_service.start_job(id)
+
+
+def rollup_stop_job(node, params, body, id):
+    return 200, node.rollup_service.stop_job(id)
+
+
+def rollup_caps(node, params, body, id):
+    return 200, node.rollup_service.caps(id)
+
+
+def rollup_search(node, params, body, index):
+    return 200, node.rollup_service.rollup_search(index, body or {})
+
+
+def enrich_put_policy(node, params, body, name):
+    return 200, node.enrich_service.put_policy(name, body or {})
+
+
+def enrich_get_policy(node, params, body, name):
+    p = node.enrich_service.get_policy(name)
+    return 200, {"policies": [{"config": {
+        p["type"]: {"name": p["name"], **p["config"]}}}]}
+
+
+def enrich_list_policies(node, params, body):
+    return 200, {"policies": [
+        {"config": c} for c in node.enrich_service.list_policies()]}
+
+
+def enrich_delete_policy(node, params, body, name):
+    return 200, node.enrich_service.delete_policy(name)
+
+
+def enrich_execute_policy(node, params, body, name):
+    return 200, node.enrich_service.execute_policy(name)
+
+
+def graph_explore(node, params, body, index):
+    return 200, node.graph_service.explore(index, body or {})

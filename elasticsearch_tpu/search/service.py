@@ -206,6 +206,26 @@ class SearchService:
         response["_scroll_id"] = scroll_id
         return response
 
+    def scan(self, index_expression: str, body: Dict[str, Any],
+             page: int = 1000):
+        """Yield EVERY matching hit via scroll paging (the scan pattern
+        reindex/datafeeds/enrich use, ref: reindex's ClientScrollableHitSource
+        — no silent size cap)."""
+        body = dict(body or {})
+        body["size"] = page
+        r = self.search(index_expression, body, scroll="5m")
+        sid = r["_scroll_id"]
+        try:
+            while True:
+                hits = r["hits"]["hits"]
+                if not hits:
+                    return
+                for h in hits:
+                    yield h
+                r = self.scroll(sid)
+        finally:
+            self.clear_scroll([sid])
+
     def clear_scroll(self, scroll_ids: List[str]) -> int:
         freed = 0
         with self._lock:

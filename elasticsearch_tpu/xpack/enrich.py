@@ -1,0 +1,181 @@
+"""Enrich: lookup-join enrichment at ingest time.
+
+Mirrors the reference's x-pack enrich plugin (ref: x-pack/plugin/enrich —
+EnrichPolicy (match/range types), the policy executor that force-merges a
+lookup copy into a `.enrich-*` system index, and the `enrich` ingest
+processor doing the join; SURVEY.md §2.6). Re-design for this engine:
+policy execution snapshots the source docs into a `.enrich-{policy}`
+system index AND a host-side hash map (match_field value → enrich doc) —
+the ingest-time join is a dict lookup, the analogue of the reference's
+term query against the force-merged single-segment enrich index.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ResourceAlreadyExistsException,
+    ResourceNotFoundException,
+)
+from elasticsearch_tpu.ingest.service import processor
+
+
+class EnrichService:
+    def __init__(self, node):
+        self.node = node
+        self.policies: Dict[str, Dict[str, Any]] = {}
+        # policy -> match value -> enrich doc (the executed lookup table)
+        self.lookups: Dict[str, Dict[Any, Dict[str, Any]]] = {}
+        # policy -> list of (low, high, doc) for range policies
+        self.range_lookups: Dict[str, List] = {}
+        self._lock = threading.Lock()
+
+    def put_policy(self, name: str, body: Dict[str, Any]):
+        with self._lock:
+            if name in self.policies:
+                raise ResourceAlreadyExistsException(
+                    f"policy [{name}] already exists")
+            ptype = "match" if "match" in body else (
+                "range" if "range" in body else None)
+            if ptype is None:
+                raise IllegalArgumentException(
+                    "policy requires [match] or [range]")
+            cfg = body[ptype]
+            for req in ("indices", "match_field", "enrich_fields"):
+                if req not in cfg:
+                    raise IllegalArgumentException(f"[{req}] is required")
+            self.policies[name] = {"name": name, "type": ptype,
+                                   "config": cfg}
+            return {"acknowledged": True}
+
+    def get_policy(self, name: str) -> Dict[str, Any]:
+        p = self.policies.get(name)
+        if p is None:
+            raise ResourceNotFoundException(
+                f"policy [{name}] not found")
+        return p
+
+    def delete_policy(self, name: str):
+        self.get_policy(name)
+        with self._lock:
+            del self.policies[name]
+            self.lookups.pop(name, None)
+            self.range_lookups.pop(name, None)
+        return {"acknowledged": True}
+
+    def list_policies(self) -> List[Dict[str, Any]]:
+        out = []
+        for p in self.policies.values():
+            out.append({p["type"]: {
+                "name": p["name"], **p["config"]}})
+        return out
+
+    def execute_policy(self, name: str):
+        """Build the enrich index + lookup table from the source indices
+        (ref: EnrichPolicyRunner — reindex into .enrich-* then force
+        merge; here the merged artifact IS the hash map)."""
+        p = self.get_policy(name)
+        cfg = p["config"]
+        indices = cfg["indices"]
+        if isinstance(indices, str):
+            indices = [indices]
+        match_field = cfg["match_field"]
+        keep = set(cfg["enrich_fields"]) | {match_field}
+        lookup: Dict[Any, Dict[str, Any]] = {}
+        ranges: List = []
+        enrich_index = f".enrich-{name}"
+        if enrich_index in self.node.indices_service.indices:
+            self.node.indices_service.delete_index(enrich_index)
+        self.node.indices_service.create_index(enrich_index, {}, None)
+        eidx = self.node.indices_service.get(enrich_index)
+        n = 0
+        for index in indices:
+            for h in self.node.search_service.scan(
+                    index, {"query": {"match_all": {}}}):
+                src = {k: v for k, v in h["_source"].items() if k in keep}
+                mv = h["_source"].get(match_field)
+                if mv is None:
+                    continue
+                if p["type"] == "match":
+                    for v in (mv if isinstance(mv, list) else [mv]):
+                        lookup.setdefault(v, src)
+                elif isinstance(mv, dict):          # range policy
+                    lo, hi = mv.get("gte"), mv.get("lte", mv.get("lt"))
+                    ranges.append((lo, hi, src))
+                else:
+                    continue                # range needs {gte,lte} objects
+                eidx.index_doc(f"{n}", src)
+                n += 1
+        eidx.refresh()
+        with self._lock:
+            self.lookups[name] = lookup
+            self.range_lookups[name] = ranges
+        return {"status": {"phase": "COMPLETE"}}
+
+    def enrich_lookup(self, policy_name: str, value,
+                      max_matches: int = 1) -> List[Dict[str, Any]]:
+        p = self.get_policy(policy_name)
+        if p["type"] == "match":
+            table = self.lookups.get(policy_name)
+            if table is None:
+                raise IllegalArgumentException(
+                    f"policy [{policy_name}] has not been executed")
+            # array-valued fields match on ANY element (ref: MatchProcessor)
+            values = value if isinstance(value, list) else [value]
+            out = []
+            for v in values:
+                try:
+                    hit = table.get(v)
+                except TypeError:
+                    continue                      # unhashable element
+                if hit is not None and hit not in out:
+                    out.append(hit)
+                if len(out) >= max_matches:
+                    break
+            return out
+        out = []
+        for lo, hi, doc in self.range_lookups.get(policy_name, []):
+            try:
+                if ((lo is None or value >= lo)
+                        and (hi is None or value <= hi)):
+                    out.append(doc)
+            except TypeError:
+                continue
+            if len(out) >= max_matches:
+                break
+        return out
+
+
+@processor("enrich")
+def _enrich_processor(cfg, svc):
+    """The `enrich` ingest processor (ref: x-pack/plugin/enrich/.../
+    MatchProcessor) — joins the policy's lookup table into the doc."""
+    policy_name = cfg["policy_name"]
+    field = cfg["field"]
+    target = cfg["target_field"]
+    max_matches = int(cfg.get("max_matches", 1))
+    ignore_missing = bool(cfg.get("ignore_missing", False))
+    override = cfg.get("override", True)
+
+    def fn(doc):
+        node = getattr(svc, "node", None)
+        if node is None or not hasattr(node, "enrich_service"):
+            raise IllegalArgumentException(
+                "enrich processor requires the enrich service")
+        value = doc.get(field)
+        if value is None:
+            if ignore_missing:
+                return
+            raise IllegalArgumentException(
+                f"field [{field}] is missing")
+        if not override and doc.get(target) is not None:
+            return
+        matches = node.enrich_service.enrich_lookup(
+            policy_name, value, max_matches)
+        if not matches:
+            return
+        doc.set(target, matches[0] if max_matches == 1 else matches)
+    return fn

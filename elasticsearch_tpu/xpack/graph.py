@@ -1,0 +1,118 @@
+"""Graph: significance-guided entity graph exploration.
+
+Mirrors the reference's x-pack graph plugin (ref: x-pack/plugin/graph —
+TransportGraphExploreAction: seed a vertex set from the query's top
+(significant) terms, then hop along `connections` by re-querying with the
+found vertices and collecting co-occurring terms; SURVEY.md §2.6).
+Re-design for this engine: each hop is one TPU-path search whose terms
+aggregations provide candidate vertices; significance weight = foreground
+frequency / background frequency (the same signal significant_terms
+uses), and connections record co-occurrence doc counts.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+
+
+class GraphService:
+    def __init__(self, node):
+        self.node = node
+
+    def explore(self, index: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        start = time.monotonic()
+        query = body.get("query", {"match_all": {}})
+        vertices_spec = body.get("vertices", [])
+        if not vertices_spec:
+            raise IllegalArgumentException("[vertices] is required")
+        connections = body.get("connections")
+
+        total_docs = self._count(index, {"match_all": {}})
+        fg_docs = self._count(index, query)
+
+        vertices: List[Dict[str, Any]] = []
+        vertex_index: Dict[tuple, int] = {}
+        edges: List[Dict[str, Any]] = []
+
+        def add_vertex(field, term, fg_count, depth):
+            key = (field, term)
+            if key in vertex_index:
+                return vertex_index[key]
+            bg = self._count(index, {"term": {field: {"value": term}}})
+            fg_rate = fg_count / max(fg_docs, 1)
+            bg_rate = bg / max(total_docs, 1)
+            weight = fg_rate / bg_rate if bg_rate > 0 else 0.0
+            vertex_index[key] = len(vertices)
+            vertices.append({"field": field, "term": term,
+                             "weight": weight, "depth": depth})
+            return vertex_index[key]
+
+        # seed hop: top terms of the root query
+        seeds: List[int] = []
+        for vs in vertices_spec:
+            field = vs["field"]
+            size = int(vs.get("size", 5))
+            min_dc = int(vs.get("min_doc_count", 1))
+            buckets = self._terms(index, query, field, size)
+            for b in buckets:
+                if b["doc_count"] < min_dc:
+                    continue
+                seeds.append(add_vertex(field, b["key"], b["doc_count"], 0))
+
+        # connection hops (ref: GraphExploreRequest.Hop chain)
+        frontier = list(seeds)
+        hop = connections
+        depth = 1
+        while hop is not None and frontier:
+            next_frontier: List[int] = []
+            conn_specs = hop.get("vertices", [])
+            for vi in frontier:
+                v = vertices[vi]
+                co_query = {"bool": {"must": [
+                    query, {"term": {v["field"]: {"value": v["term"]}}}]}}
+                co_docs = self._count(index, co_query)
+                for cs in conn_specs:
+                    field = cs["field"]
+                    size = int(cs.get("size", 5))
+                    min_dc = int(cs.get("min_doc_count", 1))
+                    for b in self._terms(index, co_query, field, size):
+                        if b["doc_count"] < min_dc:
+                            continue
+                        if (field, b["key"]) == (v["field"], v["term"]):
+                            continue
+                        ti = add_vertex(field, b["key"], b["doc_count"],
+                                        depth)
+                        edges.append({
+                            "source": vi, "target": ti,
+                            "weight": b["doc_count"] / max(co_docs, 1),
+                            "doc_count": b["doc_count"]})
+                        if ti not in next_frontier and vertices[ti][
+                                "depth"] == depth:
+                            next_frontier.append(ti)
+            frontier = next_frontier
+            hop = hop.get("connections")
+            depth += 1
+
+        return {
+            "took": int((time.monotonic() - start) * 1000),
+            "timed_out": False,
+            "failures": [],
+            "vertices": vertices,
+            "connections": edges,
+        }
+
+    # ------------------------------------------------------------ helpers
+    def _count(self, index: str, query: Dict[str, Any]) -> int:
+        r = self.node.search_service.search(index, {
+            "size": 0, "query": query, "track_total_hits": True})
+        return r["hits"]["total"]["value"]
+
+    def _terms(self, index: str, query: Dict[str, Any], field: str,
+               size: int) -> List[Dict[str, Any]]:
+        r = self.node.search_service.search(index, {
+            "size": 0, "query": query,
+            "aggs": {"t": {"terms": {"field": field, "size": size}}}})
+        return r["aggregations"]["t"]["buckets"]

@@ -1,0 +1,648 @@
+"""ML: anomaly detection, data frame analytics, trained-model inference.
+
+Mirrors the reference's x-pack ML plugin (ref: x-pack/plugin/ml — job
+management under `job/`, datafeeds under `datafeed/`, data frame
+analytics under `dataframe/`, inference under `inference/`; the actual
+math runs in external C++ processes managed via named pipes,
+`process/NativeController.java`, SURVEY.md §2.2). Re-design for this
+engine: **the C++ sidecar is replaced by JAX compute** —
+
+- anomaly detection keeps per-entity Gaussian baselines (running
+  mean/variance, the same normal-tail scoring family autodetect uses
+  for metric functions) updated per bucket span; scores are -log tail
+  probabilities normalized to 0-100 (ref: ml-cpp CAnomalyDetector's
+  probability → anomaly score mapping).
+- data frame analytics / outlier detection computes kNN distances as a
+  tiled matmul over the feature matrix — exactly the dense-scoring
+  pattern the TPU is built for (distance_kth_nn per ml-cpp COutliers).
+- regression/classification train linear/logistic models with jnp
+  (closed-form ridge / gradient descent) instead of boosted trees.
+- trained models store coefficients and serve an infer API + ingest
+  processor hook.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ResourceAlreadyExistsException,
+    ResourceNotFoundException,
+)
+
+_BUCKET_SPAN_UNITS = {"s": 1000, "m": 60_000, "h": 3_600_000,
+                      "d": 86_400_000}
+
+
+def _span_ms(span: str) -> float:
+    import re
+    m = re.fullmatch(r"(\d+)(s|m|h|d)", str(span))
+    if not m:
+        raise IllegalArgumentException(f"bad bucket_span [{span}]")
+    return float(int(m.group(1)) * _BUCKET_SPAN_UNITS[m.group(2)])
+
+
+class _Baseline:
+    """Running Gaussian baseline per (detector, entity) — the normal-tail
+    model family of ml-cpp's metric anomaly detection."""
+
+    __slots__ = ("n", "mean", "m2")
+
+    def __init__(self):
+        self.n = 0
+        self.mean = 0.0
+        self.m2 = 0.0
+
+    def update(self, x: float):
+        self.n += 1
+        d = x - self.mean
+        self.mean += d / self.n
+        self.m2 += d * (x - self.mean)
+
+    @property
+    def var(self) -> float:
+        return self.m2 / (self.n - 1) if self.n > 1 else 0.0
+
+    def probability(self, x: float) -> float:
+        """Two-sided tail probability of x under the baseline."""
+        if self.n < 3:
+            return 1.0                       # warm-up: nothing is anomalous
+        sd = math.sqrt(self.var)
+        if sd == 0:
+            return 1.0 if x == self.mean else 1e-10
+        z = abs(x - self.mean) / sd
+        # 2-sided normal tail via erfc
+        return max(math.erfc(z / math.sqrt(2.0)), 1e-300)
+
+    def to_dict(self):
+        return {"n": self.n, "mean": self.mean, "m2": self.m2}
+
+    @classmethod
+    def from_dict(cls, d):
+        b = cls()
+        b.n, b.mean, b.m2 = d["n"], d["mean"], d["m2"]
+        return b
+
+
+def _score_from_probability(p: float) -> float:
+    """Map a tail probability to a 0-100 anomaly score (the reference's
+    log-probability normalization, ml-cpp CAnomalyScore)."""
+    if p >= 0.05:
+        return 0.0
+    s = min(100.0, -10.0 * math.log10(p) - 10.0)
+    return max(0.0, s)
+
+
+class MlJob:
+    """One anomaly detection job (ref: x-pack/plugin/core Job config +
+    x-pack/plugin/ml JobManager)."""
+
+    def __init__(self, job_id: str, config: Dict[str, Any]):
+        self.job_id = job_id
+        ac = config.get("analysis_config", {})
+        self.detectors: List[Dict[str, Any]] = ac.get("detectors", [])
+        if not self.detectors:
+            raise IllegalArgumentException(
+                "analysis_config.detectors is required")
+        self.bucket_span_ms = _span_ms(ac.get("bucket_span", "5m"))
+        dd = config.get("data_description", {})
+        self.time_field = dd.get("time_field", "timestamp")
+        self.description = config.get("description", "")
+        self.state = "closed"
+        self.create_time = int(time.time() * 1000)
+        # (detector_idx, entity key) -> _Baseline
+        self.baselines: Dict[str, _Baseline] = {}
+        # rare function: (detector_idx, by value) -> count, and totals
+        self.category_counts: Dict[str, int] = {}
+        self.buckets: List[Dict[str, Any]] = []       # bucket results
+        self.records: List[Dict[str, Any]] = []       # record results
+        self.processed_record_count = 0
+        self.latest_record_ts: Optional[float] = None
+
+    def config_dict(self) -> Dict[str, Any]:
+        return {
+            "job_id": self.job_id,
+            "description": self.description,
+            "analysis_config": {
+                "bucket_span": f"{int(self.bucket_span_ms // 1000)}s",
+                "detectors": self.detectors,
+            },
+            "data_description": {"time_field": self.time_field},
+            "create_time": self.create_time,
+        }
+
+    # -- one bucket of data ---------------------------------------------
+    def process_bucket(self, bucket_start: float,
+                       docs: List[Dict[str, Any]]):
+        """Run every detector over one bucket span of documents and emit
+        record/bucket results (the autodetect per-bucket cycle)."""
+        bucket_records: List[Dict[str, Any]] = []
+        for di, det in enumerate(self.detectors):
+            fn = det.get("function", "count")
+            field = det.get("field_name")
+            by = det.get("by_field_name")
+            partition = det.get("partition_field_name")
+
+            # group docs by entity (by/partition values)
+            groups: Dict[tuple, List[Dict[str, Any]]] = {}
+            for doc in docs:
+                key = (doc.get(partition) if partition else None,
+                       doc.get(by) if by else None)
+                groups.setdefault(key, []).append(doc)
+            if fn == "rare":
+                self._rare(di, det, groups, bucket_start, bucket_records)
+                continue
+            for key, group in groups.items():
+                value = self._detector_value(fn, field, group)
+                if value is None:
+                    continue
+                bkey = f"{di}|{key[0]}|{key[1]}"
+                base = self.baselines.get(bkey)
+                if base is None:
+                    base = self.baselines[bkey] = _Baseline()
+                p = base.probability(value)
+                score = _score_from_probability(p)
+                if score > 0:
+                    rec = {
+                        "job_id": self.job_id,
+                        "result_type": "record",
+                        "detector_index": di,
+                        "function": fn,
+                        "timestamp": int(bucket_start),
+                        "record_score": score,
+                        "probability": p,
+                        "actual": [value],
+                        "typical": [base.mean],
+                    }
+                    if field:
+                        rec["field_name"] = field
+                    if partition:
+                        rec["partition_field_name"] = partition
+                        rec["partition_field_value"] = key[0]
+                    if by:
+                        rec["by_field_name"] = by
+                        rec["by_field_value"] = key[1]
+                    bucket_records.append(rec)
+                base.update(value)
+        self.records.extend(bucket_records)
+        anomaly_score = max((r["record_score"] for r in bucket_records),
+                            default=0.0)
+        self.buckets.append({
+            "job_id": self.job_id,
+            "result_type": "bucket",
+            "timestamp": int(bucket_start),
+            "anomaly_score": anomaly_score,
+            "event_count": len(docs),
+            "bucket_span": int(self.bucket_span_ms // 1000),
+        })
+        self.processed_record_count += len(docs)
+
+    def _rare(self, di, det, groups, bucket_start, bucket_records):
+        """`rare` function: flag by-values seldom seen before (ml-cpp's
+        individual rare model, frequency-based)."""
+        total = sum(v for c, v in self.category_counts.items()
+                    if c.startswith(f"{di}|"))
+        for key, group in groups.items():
+            ckey = f"{di}|{key[1]}"
+            seen = self.category_counts.get(ckey, 0)
+            n_cats = sum(1 for c in self.category_counts
+                         if c.startswith(f"{di}|"))
+            if seen == 0 and n_cats >= 5:
+                p = 1.0 / (total + n_cats + 1)
+                score = _score_from_probability(p)
+                if score > 0:
+                    bucket_records.append({
+                        "job_id": self.job_id,
+                        "result_type": "record",
+                        "detector_index": di,
+                        "function": "rare",
+                        "timestamp": int(bucket_start),
+                        "record_score": score,
+                        "probability": p,
+                        "by_field_name": det.get("by_field_name"),
+                        "by_field_value": key[1],
+                    })
+            self.category_counts[ckey] = seen + len(group)
+
+    @staticmethod
+    def _detector_value(fn: str, field: Optional[str],
+                        group: List[Dict[str, Any]]):
+        if fn in ("count", "high_count", "low_count"):
+            return float(len(group))
+        if fn in ("non_zero_count", "high_non_zero_count",
+                  "low_non_zero_count"):
+            return float(len(group)) or None
+        if fn == "distinct_count":
+            return float(len({json.dumps(d.get(field), default=str)
+                              for d in group if d.get(field) is not None}))
+        vals = [float(d[field]) for d in group
+                if isinstance(d.get(field), (int, float))]
+        if not vals:
+            return None
+        if fn in ("mean", "avg", "high_mean", "low_mean"):
+            return float(np.mean(vals))
+        if fn in ("min", "low_min", "high_min"):
+            return float(np.min(vals))
+        if fn in ("max", "high_max", "low_max"):
+            return float(np.max(vals))
+        if fn in ("sum", "high_sum", "low_sum", "non_null_sum"):
+            return float(np.sum(vals))
+        if fn == "median":
+            return float(np.median(vals))
+        if fn == "varp":
+            return float(np.var(vals))
+        raise IllegalArgumentException(f"Unknown ML function [{fn}]")
+
+
+class Datafeed:
+    """Pulls bucketed data from an index into a job (ref:
+    x-pack/plugin/ml/.../datafeed/DatafeedJob — the query/aggregation
+    extraction loop)."""
+
+    def __init__(self, feed_id: str, config: Dict[str, Any]):
+        self.feed_id = feed_id
+        self.job_id = config.get("job_id")
+        self.indices = config.get("indices") or config.get("indexes", [])
+        if isinstance(self.indices, str):
+            self.indices = [self.indices]
+        self.query = config.get("query", {"match_all": {}})
+        self.state = "stopped"
+        if not self.job_id or not self.indices:
+            raise IllegalArgumentException(
+                "datafeed requires job_id and indices")
+
+    def config_dict(self):
+        return {"datafeed_id": self.feed_id, "job_id": self.job_id,
+                "indices": self.indices, "query": self.query}
+
+
+class MlService:
+    """Job/datafeed/analytics registry + execution (ref: the ML plugin's
+    JobManager + DatafeedManager + DataFrameAnalyticsManager, with JAX
+    standing in for the native processes)."""
+
+    def __init__(self, node):
+        self.node = node
+        self.jobs: Dict[str, MlJob] = {}
+        self.datafeeds: Dict[str, Datafeed] = {}
+        self.analytics: Dict[str, Dict[str, Any]] = {}
+        self.trained_models: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------- jobs
+    def put_job(self, job_id: str, config: Dict[str, Any]) -> MlJob:
+        with self._lock:
+            if job_id in self.jobs:
+                raise ResourceAlreadyExistsException(
+                    f"job [{job_id}] already exists")
+            job = MlJob(job_id, config)
+            self.jobs[job_id] = job
+            return job
+
+    def get_job(self, job_id: str) -> MlJob:
+        job = self.jobs.get(job_id)
+        if job is None:
+            raise ResourceNotFoundException(
+                f"No known job with id [{job_id}]")
+        return job
+
+    def delete_job(self, job_id: str):
+        self.get_job(job_id)
+        with self._lock:
+            del self.jobs[job_id]
+            for fid in [f for f, d in self.datafeeds.items()
+                        if d.job_id == job_id]:
+                del self.datafeeds[fid]
+
+    def open_job(self, job_id: str):
+        self.get_job(job_id).state = "opened"
+
+    def close_job(self, job_id: str):
+        self.get_job(job_id).state = "closed"
+
+    def post_data(self, job_id: str, docs: List[Dict[str, Any]]):
+        """Stream raw documents into an open job (the _data API): docs
+        are bucketed by time and run through the detectors."""
+        job = self.get_job(job_id)
+        if job.state != "opened":
+            raise IllegalArgumentException(
+                f"job [{job_id}] is not open")
+        self._run_buckets(job, docs)
+        return {"job_id": job_id,
+                "processed_record_count": job.processed_record_count}
+
+    def _run_buckets(self, job: MlJob, docs: List[Dict[str, Any]]):
+        def ts_of(doc):
+            v = doc.get(job.time_field)
+            if isinstance(v, (int, float)):
+                return float(v)
+            if isinstance(v, str):
+                from datetime import datetime, timezone
+                return datetime.fromisoformat(
+                    v.replace("Z", "+00:00")).timestamp() * 1000
+            return None
+
+        timed = [(ts_of(d), d) for d in docs]
+        timed = [(t, d) for t, d in timed if t is not None]
+        timed.sort(key=lambda td: td[0])
+        span = job.bucket_span_ms
+        current_bucket = None
+        bucket_docs: List[Dict[str, Any]] = []
+        for t, d in timed:
+            b = math.floor(t / span) * span
+            if current_bucket is None:
+                current_bucket = b
+            if b != current_bucket:
+                job.process_bucket(current_bucket, bucket_docs)
+                # emit empty buckets in between (count detectors see 0)
+                nxt = current_bucket + span
+                while nxt < b:
+                    job.process_bucket(nxt, [])
+                    nxt += span
+                current_bucket = b
+                bucket_docs = []
+            bucket_docs.append(d)
+            job.latest_record_ts = t
+        if current_bucket is not None:
+            job.process_bucket(current_bucket, bucket_docs)
+
+    # ------------------------------------------------------ datafeeds
+    def put_datafeed(self, feed_id: str, config: Dict[str, Any]):
+        with self._lock:
+            if feed_id in self.datafeeds:
+                raise ResourceAlreadyExistsException(
+                    f"datafeed [{feed_id}] already exists")
+            self.get_job(config.get("job_id", ""))
+            feed = Datafeed(feed_id, config)
+            self.datafeeds[feed_id] = feed
+            return feed
+
+    def get_datafeed(self, feed_id: str) -> Datafeed:
+        feed = self.datafeeds.get(feed_id)
+        if feed is None:
+            raise ResourceNotFoundException(
+                f"No known datafeed with id [{feed_id}]")
+        return feed
+
+    def start_datafeed(self, feed_id: str, start=None, end=None):
+        """Lookback run: pull matching docs from the feed's indices
+        through the search path and stream them into the job."""
+        feed = self.get_datafeed(feed_id)
+        job = self.get_job(feed.job_id)
+        if job.state != "opened":
+            raise IllegalArgumentException(
+                f"cannot start datafeed [{feed_id}] while job "
+                f"[{job.job_id}] is closed")
+        feed.state = "started"
+        query: Dict[str, Any] = {"bool": {"must": [feed.query]}}
+        rng: Dict[str, Any] = {}
+        if start is not None:
+            rng["gte"] = start
+        if end is not None:
+            rng["lt"] = end
+        if rng:
+            query["bool"]["must"].append(
+                {"range": {job.time_field: rng}})
+        docs: List[Dict[str, Any]] = []
+        for index in feed.indices:
+            docs.extend(h["_source"] for h in self.node.search_service.scan(
+                index, {"query": query,
+                        "sort": [{job.time_field: {"order": "asc"}}]}))
+        self._run_buckets(job, docs)
+        feed.state = "stopped"
+        return {"started": True}
+
+    def stop_datafeed(self, feed_id: str):
+        self.get_datafeed(feed_id).state = "stopped"
+        return {"stopped": True}
+
+    def delete_datafeed(self, feed_id: str):
+        self.get_datafeed(feed_id)
+        with self._lock:
+            del self.datafeeds[feed_id]
+
+    # ----------------------------------------------- data frame analytics
+    def put_analytics(self, aid: str, config: Dict[str, Any]):
+        with self._lock:
+            if aid in self.analytics:
+                raise ResourceAlreadyExistsException(
+                    f"data frame analytics [{aid}] already exists")
+            if "source" not in config or "dest" not in config:
+                raise IllegalArgumentException(
+                    "source and dest are required")
+            cfg = dict(config)
+            cfg["id"] = aid
+            cfg["state"] = "stopped"
+            self.analytics[aid] = cfg
+            return cfg
+
+    def get_analytics(self, aid: str) -> Dict[str, Any]:
+        cfg = self.analytics.get(aid)
+        if cfg is None:
+            raise ResourceNotFoundException(
+                f"No known data frame analytics with id [{aid}]")
+        return cfg
+
+    def start_analytics(self, aid: str):
+        cfg = self.get_analytics(aid)
+        cfg["state"] = "started"
+        try:
+            self._run_analytics(cfg)
+            cfg["state"] = "stopped"
+            cfg["progress"] = 100
+        except Exception:
+            cfg["state"] = "failed"
+            raise
+        return {"acknowledged": True}
+
+    def _run_analytics(self, cfg: Dict[str, Any]):
+        src = cfg["source"]["index"]
+        if isinstance(src, list):
+            src = ",".join(src)
+        dest = cfg["dest"]["index"]
+        analysis = cfg.get("analysis", {})
+        hits = list(self.node.search_service.scan(src, {
+            "query": cfg["source"].get("query", {"match_all": {}})}))
+        sources = [h["_source"] for h in hits]
+        if "outlier_detection" in analysis:
+            results = self._outlier_detection(
+                sources, analysis["outlier_detection"])
+            result_field = "ml"
+            rows = [{**s, result_field: {"outlier_score": sc}}
+                    for s, sc in zip(sources, results)]
+        elif "regression" in analysis:
+            rows, model = self._regression(
+                sources, analysis["regression"], classification=False)
+            self._store_model_for(cfg, model)
+        elif "classification" in analysis:
+            rows, model = self._regression(
+                sources, analysis["classification"], classification=True)
+            self._store_model_for(cfg, model)
+        else:
+            raise IllegalArgumentException("Unknown analysis type")
+        # write results to dest through the normal indexing path
+        if dest not in self.node.indices_service.indices:
+            self.node.indices_service.create_index(dest, {}, None)
+        didx = self.node.indices_service.get(dest)
+        for i, (h, row) in enumerate(zip(hits, rows)):
+            didx.index_doc(h["_id"], row)
+        didx.refresh()
+
+    def _store_model_for(self, cfg, model):
+        mid = cfg["id"] + "-model"
+        model["model_id"] = mid
+        self.trained_models[mid] = model
+
+    @staticmethod
+    def _numeric_matrix(sources: List[Dict[str, Any]],
+                        exclude: Optional[str] = None):
+        fields = sorted({k for s in sources
+                         for k, v in s.items()
+                         if isinstance(v, (int, float))
+                         and not isinstance(v, bool) and k != exclude})
+        mat = np.array([[float(s.get(f) or 0.0) for f in fields]
+                        for s in sources], np.float32)
+        return fields, mat
+
+    def _outlier_detection(self, sources, params) -> List[float]:
+        """Distance-based outlier scores: the kth-NN distance over the
+        feature matrix, computed as one dense distance matrix — a tiled
+        matmul on TPU (ref: ml-cpp COutliers distance_kth_nn method)."""
+        import jax.numpy as jnp
+
+        _, mat = self._numeric_matrix(sources)
+        n = len(mat)
+        if n < 2:
+            return [0.0] * n
+        k = min(int(params.get("n_neighbors", 5)), n - 1)
+        x = jnp.asarray(mat)
+        # standardize features so no column dominates
+        std = jnp.std(x, axis=0)
+        x = (x - jnp.mean(x, axis=0)) / jnp.where(std == 0, 1.0, std)
+        # pairwise squared distances via the Gram matrix (MXU path)
+        sq = jnp.sum(x * x, axis=1)
+        d2 = sq[:, None] + sq[None, :] - 2.0 * (x @ x.T)
+        d2 = jnp.maximum(d2, 0.0)
+        d2 = d2.at[jnp.arange(n), jnp.arange(n)].set(jnp.inf)
+        kth = jnp.sort(d2, axis=1)[:, k - 1]
+        dist = np.sqrt(np.asarray(kth))
+        # normalize to (0, 1]: score relative to the distribution
+        med = float(np.median(dist)) or 1.0
+        scores = 1.0 - np.exp(-(dist / (2.0 * med)) ** 2)
+        return [float(s) for s in scores]
+
+    def _regression(self, sources, params, classification: bool):
+        """Linear (ridge) regression / logistic classification trained
+        with jnp — the gradient work XLA compiles to the MXU (replaces
+        ml-cpp's boosted trees for the API surface)."""
+        import jax
+        import jax.numpy as jnp
+
+        dep = params.get("dependent_variable")
+        if not dep:
+            raise IllegalArgumentException(
+                "dependent_variable is required")
+        train = [s for s in sources if s.get(dep) is not None]
+        fields, mat = self._numeric_matrix(train, exclude=dep)
+        if classification:
+            classes = sorted({str(s[dep]) for s in train})
+            if len(classes) != 2:
+                raise IllegalArgumentException(
+                    "classification supports exactly two classes")
+            y = np.array([classes.index(str(s[dep])) for s in train],
+                         np.float32)
+        else:
+            classes = None
+            y = np.array([float(s[dep]) for s in train], np.float32)
+        x = jnp.asarray(mat)
+        mean, std = jnp.mean(x, axis=0), jnp.std(x, axis=0)
+        std = jnp.where(std == 0, 1.0, std)
+        xs = (x - mean) / std
+        xs = jnp.concatenate([xs, jnp.ones((len(train), 1))], axis=1)
+        yv = jnp.asarray(y)
+        if classification:
+            w = jnp.zeros(xs.shape[1])
+
+            def loss(w):
+                logits = xs @ w
+                return jnp.mean(
+                    jnp.logaddexp(0.0, logits) - yv * logits
+                ) + 1e-3 * jnp.sum(w * w)
+
+            g = jax.jit(jax.grad(loss))
+            for _ in range(300):
+                w = w - 0.5 * g(w)
+            w = np.asarray(w)
+        else:
+            # closed-form ridge: (X'X + λI)^-1 X'y
+            lam = 1e-3
+            xtx = xs.T @ xs + lam * jnp.eye(xs.shape[1])
+            w = np.asarray(jnp.linalg.solve(xtx, xs.T @ yv))
+        model = {
+            "model_type": ("classification" if classification
+                           else "regression"),
+            "feature_names": fields,
+            "mean": np.asarray(mean).tolist(),
+            "std": np.asarray(std).tolist(),
+            "weights": w.tolist(),
+            "classes": classes,
+            "dependent_variable": dep,
+        }
+        rows = []
+        for s in sources:
+            pred = self._predict(model, s)
+            key = dep + "_prediction"
+            rows.append({**s, "ml": {key: pred}})
+        return rows, model
+
+    @staticmethod
+    def _predict(model: Dict[str, Any], doc: Dict[str, Any]):
+        x = np.array([float(doc.get(f) or 0.0)
+                      for f in model["feature_names"]], np.float32)
+        xs = (x - np.array(model["mean"])) / np.array(model["std"])
+        xs = np.concatenate([xs, [1.0]])
+        v = float(xs @ np.array(model["weights"]))
+        if model["model_type"] == "classification":
+            p = 1.0 / (1.0 + math.exp(-v))
+            return model["classes"][1] if p >= 0.5 else model["classes"][0]
+        return v
+
+    # ------------------------------------------------- trained models
+    def put_trained_model(self, model_id: str, config: Dict[str, Any]):
+        with self._lock:
+            if model_id in self.trained_models:
+                raise ResourceAlreadyExistsException(
+                    f"model [{model_id}] already exists")
+            # accept a raw linear definition (weights/features) — the
+            # engine's native format
+            model = dict(config)
+            model["model_id"] = model_id
+            self.trained_models[model_id] = model
+            return model
+
+    def get_trained_model(self, model_id: str) -> Dict[str, Any]:
+        m = self.trained_models.get(model_id)
+        if m is None:
+            raise ResourceNotFoundException(
+                f"No known trained model with id [{model_id}]")
+        return m
+
+    def delete_trained_model(self, model_id: str):
+        self.get_trained_model(model_id)
+        with self._lock:
+            del self.trained_models[model_id]
+
+    def infer(self, model_id: str,
+              docs: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        model = self.get_trained_model(model_id)
+        out = []
+        for doc in docs:
+            out.append({"predicted_value": self._predict(model, doc)})
+        return out

@@ -1,0 +1,279 @@
+"""Rollup: periodic downsampling of time-series indices.
+
+Mirrors the reference's x-pack rollup plugin (ref: x-pack/plugin/rollup —
+RollupJob configs, the indexer that walks the source index with composite
+aggs and writes flattened rollup documents, and TransportRollupSearchAction
+which rewrites searches over rolled data; SURVEY.md §2.6). Re-design for
+this engine: the indexer is one composite-agg pass over the TPU search
+path (after-key paging), rollup docs use flattened key names
+(`field.date_histogram.timestamp`, `field.terms.value`,
+`field.<metric>.value`), and `_rollup_search` translates a live-style
+aggregation body onto those flattened fields, merging avg from
+sum/value_count pairs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ResourceAlreadyExistsException,
+    ResourceNotFoundException,
+)
+
+
+class RollupService:
+    def __init__(self, node):
+        self.node = node
+        self.jobs: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- jobs
+    def put_job(self, job_id: str, config: Dict[str, Any]):
+        with self._lock:
+            if job_id in self.jobs:
+                raise ResourceAlreadyExistsException(
+                    f"Cannot create rollup job [{job_id}] because job "
+                    "already exists")
+            for req in ("index_pattern", "rollup_index", "groups"):
+                if req not in config:
+                    raise IllegalArgumentException(f"[{req}] is required")
+            if "date_histogram" not in config["groups"]:
+                raise IllegalArgumentException(
+                    "groups.date_histogram is required")
+            job = dict(config)
+            job["job_id"] = job_id
+            job["status"] = "stopped"
+            self.jobs[job_id] = job
+            return job
+
+    def get_job(self, job_id: str) -> Dict[str, Any]:
+        job = self.jobs.get(job_id)
+        if job is None:
+            raise ResourceNotFoundException(
+                f"Task for Rollup Job [{job_id}] not found")
+        return job
+
+    def delete_job(self, job_id: str):
+        self.get_job(job_id)
+        with self._lock:
+            del self.jobs[job_id]
+
+    # ---------------------------------------------------------- indexer
+    def start_job(self, job_id: str):
+        """One indexing pass: composite over the group fields, one rollup
+        doc per bucket (ref: rollup/job/RollupIndexer.buildComposite)."""
+        job = self.get_job(job_id)
+        job["status"] = "started"
+        groups = job["groups"]
+        dh = groups["date_histogram"]
+        date_field = dh["field"]
+        interval = (dh.get("calendar_interval")
+                    or dh.get("fixed_interval") or dh.get("interval"))
+        sources: List[Dict[str, Any]] = [
+            {"__date": {"date_histogram": {
+                "field": date_field,
+                "calendar_interval": interval}}}]
+        term_fields = groups.get("terms", {}).get("fields", [])
+        for f in term_fields:
+            sources.append({f"__t_{f}": {"terms": {"field": f}}})
+        hist = groups.get("histogram", {})
+        for f in hist.get("fields", []):
+            sources.append({f"__h_{f}": {"histogram": {
+                "field": f, "interval": hist.get("interval", 1)}}})
+        metric_aggs: Dict[str, Any] = {}
+        for m in job.get("metrics", []):
+            f = m["field"]
+            for op in m.get("metrics", []):
+                if op == "avg":
+                    # avg rolls up as sum + value_count (merged at search)
+                    metric_aggs[f"{f}__sum"] = {"sum": {"field": f}}
+                    metric_aggs[f"{f}__value_count"] = {
+                        "value_count": {"field": f}}
+                else:
+                    metric_aggs[f"{f}__{op}"] = {op: {"field": f}}
+
+        rollup_index = job["rollup_index"]
+        if rollup_index not in self.node.indices_service.indices:
+            # explicit mapping from the job config (ref: the rollup index
+            # template TransportPutRollupJobAction writes)
+            props: Dict[str, Any] = {
+                f"{date_field}.date_histogram.timestamp": {"type": "date"},
+                "_rollup.doc_count": {"type": "long"},
+            }
+            for f in term_fields:
+                props[f"{f}.terms.value"] = {"type": "keyword"}
+            for f in hist.get("fields", []):
+                props[f"{f}.histogram.value"] = {"type": "double"}
+            for mname in metric_aggs:
+                f, _, op = mname.rpartition("__")
+                props[f"{f}.{op}.value"] = {"type": "double"}
+            self.node.indices_service.create_index(
+                rollup_index, {}, {"properties": props})
+        dest = self.node.indices_service.get(rollup_index)
+        after = None
+        n = 0
+        while True:
+            comp: Dict[str, Any] = {"size": 500, "sources": sources}
+            if after is not None:
+                comp["after"] = after
+            node_aggs: Dict[str, Any] = {"b": {"composite": comp}}
+            if metric_aggs:
+                node_aggs["b"]["aggs"] = metric_aggs
+            r = self.node.search_service.search(
+                job["index_pattern"], {"size": 0, "aggs": node_aggs})
+            g = r["aggregations"]["b"]
+            for bucket in g.get("buckets", []):
+                doc: Dict[str, Any] = {
+                    "_rollup.id": job_id,
+                    "_rollup.version": 2,
+                    "_rollup.doc_count": bucket["doc_count"],
+                    f"{date_field}.date_histogram.timestamp":
+                        bucket["key"]["__date"],
+                    f"{date_field}.date_histogram.interval": interval,
+                }
+                for f in term_fields:
+                    doc[f"{f}.terms.value"] = bucket["key"][f"__t_{f}"]
+                for f in hist.get("fields", []):
+                    doc[f"{f}.histogram.value"] = bucket["key"][f"__h_{f}"]
+                for mname, spec in metric_aggs.items():
+                    f, _, op = mname.rpartition("__")
+                    v = bucket.get(mname, {}).get("value")
+                    doc[f"{f}.{op}.value"] = v
+                dest.index_doc(f"{job_id}${n}", doc)
+                n += 1
+            after = g.get("after_key")
+            if after is None or not g.get("buckets"):
+                break
+        dest.refresh()
+        job["status"] = "stopped"
+        job["stats"] = {"documents_processed": n}
+        return {"started": True}
+
+    def stop_job(self, job_id: str):
+        self.get_job(job_id)["status"] = "stopped"
+        return {"stopped": True}
+
+    # ----------------------------------------------------- rollup search
+    def rollup_search(self, index: str,
+                      body: Dict[str, Any]) -> Dict[str, Any]:
+        """Rewrite a live-style agg request onto the flattened rollup doc
+        fields (ref: TransportRollupSearchAction.rewriteQuery/translate)."""
+        aggs = body.get("aggs", body.get("aggregations", {}))
+        if not aggs:
+            raise IllegalArgumentException(
+                "Rollup requires at least one aggregation")
+        out_aggs = self._translate_aggs(aggs)
+        r = self.node.search_service.search(index, {
+            "size": 0, "query": body.get("query", {"match_all": {}}),
+            "aggs": out_aggs})
+        translated = self._merge_avg(r.get("aggregations", {}), aggs)
+        return {"took": r.get("took", 0), "timed_out": False,
+                "hits": {"total": {"value": 0, "relation": "eq"},
+                         "hits": []},
+                "aggregations": translated}
+
+    def _translate_aggs(self, aggs: Dict[str, Any]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for name, node in aggs.items():
+            sub = node.get("aggs", node.get("aggregations", {}))
+            (atype, abody), = ((k, v) for k, v in node.items()
+                               if k not in ("aggs", "aggregations", "meta"))
+            abody = dict(abody)
+            f = abody.get("field")
+            if atype == "date_histogram":
+                abody["field"] = f"{f}.date_histogram.timestamp"
+                new = {atype: abody}
+            elif atype == "terms":
+                abody["field"] = f"{f}.terms.value"
+                new = {atype: abody}
+            elif atype == "histogram":
+                abody["field"] = f"{f}.histogram.value"
+                new = {atype: abody}
+            elif atype in ("min", "max"):
+                new = {atype: {"field": f"{f}.{atype}.value"}}
+            elif atype in ("sum", "value_count"):
+                # rolled partials re-aggregate by SUM
+                new = {"sum": {"field": f"{f}.{atype}.value"}}
+            elif atype == "avg":
+                out[f"{name}__sum"] = {"sum": {"field": f"{f}.sum.value"}}
+                out[f"{name}__count"] = {
+                    "sum": {"field": f"{f}.value_count.value"}}
+                continue
+            else:
+                raise IllegalArgumentException(
+                    f"Unsupported aggregation [{atype}] in rollup search")
+            if atype in ("date_histogram", "terms", "histogram"):
+                # buckets must report ORIGINAL event counts, not rollup
+                # row counts (ref: RollupResponseTranslator doc_count sums)
+                sub_out = self._translate_aggs(sub) if sub else {}
+                sub_out["__doc_count"] = {
+                    "sum": {"field": "_rollup.doc_count"}}
+                new["aggs"] = sub_out
+            elif sub:
+                new["aggs"] = self._translate_aggs(sub)
+            out[name] = new
+        return out
+
+    def _merge_avg(self, results: Dict[str, Any],
+                   orig: Dict[str, Any]) -> Dict[str, Any]:
+        """Reassemble avg results from their sum/count pairs, recursing
+        into buckets."""
+        out: Dict[str, Any] = {}
+        for name, node in orig.items():
+            sub = node.get("aggs", node.get("aggregations", {}))
+            (atype, _), = ((k, v) for k, v in node.items()
+                           if k not in ("aggs", "aggregations", "meta"))
+            if atype == "avg":
+                continue        # filled by parent loop below
+            res = results.get(name)
+            if res is None:
+                continue
+            if isinstance(res, dict) and "buckets" in res:
+                # helper agg names inside buckets (avg pairs, doc_count
+                # carrier) must not leak to the client
+                helper_names = {f"{n}__sum" for n in sub} | {
+                    f"{n}__count" for n in sub} | {"__doc_count"}
+                buckets = []
+                for b in res["buckets"]:
+                    nb = {k: v for k, v in b.items()
+                          if k not in helper_names}
+                    dc = b.get("__doc_count", {}).get("value")
+                    if dc is not None:
+                        nb["doc_count"] = int(dc)
+                    if sub:
+                        nb.update(self._merge_avg(
+                            {k: v for k, v in b.items()
+                             if isinstance(v, dict)}, sub))
+                    buckets.append(nb)
+                res = {**res, "buckets": buckets}
+            out[name] = res
+        # avg reassembly at this level
+        for name, node in orig.items():
+            (atype, _), = ((k, v) for k, v in node.items()
+                           if k not in ("aggs", "aggregations", "meta"))
+            if atype != "avg":
+                continue
+            s = results.get(f"{name}__sum", {}).get("value")
+            c = results.get(f"{name}__count", {}).get("value")
+            out[name] = {"value": (s / c) if s is not None and c else None}
+        return out
+
+    def caps(self, index_pattern: str) -> Dict[str, Any]:
+        """GET _rollup/data/{pattern} — which jobs roll up which
+        patterns."""
+        import fnmatch
+        out: Dict[str, Any] = {}
+        for job in self.jobs.values():
+            if (index_pattern in ("_all", "*")
+                    or fnmatch.fnmatch(job["index_pattern"], index_pattern)
+                    or job["index_pattern"] == index_pattern):
+                out.setdefault(job["index_pattern"], {"rollup_jobs": []})[
+                    "rollup_jobs"].append({
+                        "job_id": job["job_id"],
+                        "rollup_index": job["rollup_index"],
+                        "index_pattern": job["index_pattern"],
+                        "fields": {}})
+        return out

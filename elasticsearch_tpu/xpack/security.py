@@ -478,6 +478,7 @@ _READ_ENDPOINTS = {
     "_search", "_count", "_explain", "_mget", "_msearch", "_doc",
     "_source", "_termvectors", "_rank_eval", "_field_caps", "_validate",
     "_terms_enum", "_graph", "_eql", "_sql", "_async_search", "_pit",
+    "_rollup_search",
     "_knn_search", "_percolate", "_scripts", "_analyze", "_mapping",
     "_settings", "_alias", "_segments", "_recovery", "_stats", "_ilm",
 }

@@ -1,0 +1,251 @@
+"""ML plugin tests (model: x-pack/plugin/ml job/datafeed/analytics test
+discipline — job lifecycle, anomaly scoring, outlier detection,
+regression/classification, trained-model inference)."""
+
+import random
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(data_path=str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def call(node, method, path, body=None, expect=200, **params):
+    status, r = node.rest_controller.dispatch(method, path, params, body)
+    assert status == expect, r
+    return r
+
+
+JOB = {
+    "description": "request rate anomalies",
+    "analysis_config": {
+        "bucket_span": "60s",
+        "detectors": [{"function": "mean", "field_name": "latency"}],
+    },
+    "data_description": {"time_field": "ts"},
+}
+
+
+def _steady_then_spike():
+    """120 buckets of ~10ms latency, then one bucket at 500ms."""
+    rng = random.Random(7)
+    docs = []
+    for b in range(120):
+        for _ in range(4):
+            docs.append({"ts": b * 60_000 + rng.randrange(60_000),
+                         "latency": rng.gauss(10.0, 1.0)})
+    docs.append({"ts": 120 * 60_000 + 100, "latency": 500.0})
+    docs.append({"ts": 120 * 60_000 + 200, "latency": 510.0})
+    return docs
+
+
+def test_job_lifecycle(node):
+    r = call(node, "PUT", "/_ml/anomaly_detectors/lat", JOB)
+    assert r["job_id"] == "lat"
+    r = call(node, "GET", "/_ml/anomaly_detectors/lat")
+    assert r["jobs"][0]["analysis_config"]["bucket_span"] == "60s"
+    call(node, "GET", "/_ml/anomaly_detectors/nope", expect=404)
+    call(node, "PUT", "/_ml/anomaly_detectors/lat", JOB, expect=400)
+    call(node, "DELETE", "/_ml/anomaly_detectors/lat")
+    call(node, "GET", "/_ml/anomaly_detectors/lat", expect=404)
+
+
+def test_anomaly_detection_post_data(node):
+    call(node, "PUT", "/_ml/anomaly_detectors/lat", JOB)
+    # posting to a closed job fails
+    call(node, "POST", "/_ml/anomaly_detectors/lat/_data",
+         [{"ts": 0, "latency": 1.0}], expect=400)
+    call(node, "POST", "/_ml/anomaly_detectors/lat/_open")
+    r = call(node, "POST", "/_ml/anomaly_detectors/lat/_data",
+             _steady_then_spike())
+    assert r["processed_record_count"] == 482
+    recs = call(node, "GET",
+                "/_ml/anomaly_detectors/lat/results/records")
+    assert recs["count"] >= 1
+    top = recs["records"][0]
+    assert top["record_score"] > 50
+    assert top["actual"][0] > 400
+    assert abs(top["typical"][0] - 10.0) < 2.0
+    # the spike bucket is the anomalous one
+    assert top["timestamp"] == 120 * 60_000
+    buckets = call(node, "GET",
+                   "/_ml/anomaly_detectors/lat/results/buckets",
+                   {"anomaly_score": 50})
+    assert buckets["count"] == 1
+
+
+def test_by_field_partitioning(node):
+    job = {
+        "analysis_config": {
+            "bucket_span": "60s",
+            "detectors": [{"function": "count",
+                           "by_field_name": "host"}]},
+        "data_description": {"time_field": "ts"},
+    }
+    call(node, "PUT", "/_ml/anomaly_detectors/cnt", job)
+    call(node, "POST", "/_ml/anomaly_detectors/cnt/_open")
+    docs = []
+    for b in range(60):
+        docs.append({"ts": b * 60_000, "host": "a"})
+        docs.append({"ts": b * 60_000 + 1, "host": "b"})
+    # host b floods in the last bucket
+    docs += [{"ts": 60 * 60_000 + i, "host": "b"} for i in range(200)]
+    docs.append({"ts": 60 * 60_000, "host": "a"})
+    call(node, "POST", "/_ml/anomaly_detectors/cnt/_data", docs)
+    recs = call(node, "GET",
+                "/_ml/anomaly_detectors/cnt/results/records")
+    assert recs["count"] >= 1
+    assert recs["records"][0]["by_field_value"] == "b"
+
+
+def test_datafeed_from_index(node):
+    node.indices_service.create_index("metrics", {}, {
+        "properties": {"ts": {"type": "date"},
+                       "latency": {"type": "double"}}})
+    idx = node.indices_service.get("metrics")
+    for i, d in enumerate(_steady_then_spike()):
+        idx.index_doc(str(i), d)
+    idx.refresh()
+    call(node, "PUT", "/_ml/anomaly_detectors/lat2", JOB)
+    r = call(node, "PUT", "/_ml/datafeeds/feed1",
+             {"job_id": "lat2", "indices": ["metrics"]})
+    assert r["datafeed_id"] == "feed1"
+    # starting while the job is closed fails
+    call(node, "POST", "/_ml/datafeeds/feed1/_start", expect=400)
+    call(node, "POST", "/_ml/anomaly_detectors/lat2/_open")
+    call(node, "POST", "/_ml/datafeeds/feed1/_start")
+    recs = call(node, "GET",
+                "/_ml/anomaly_detectors/lat2/results/records")
+    assert recs["count"] >= 1
+    assert recs["records"][0]["actual"][0] > 400
+
+
+def test_outlier_detection(node):
+    node.indices_service.create_index("pts", {}, {
+        "properties": {"x": {"type": "double"},
+                       "y": {"type": "double"}}})
+    idx = node.indices_service.get("pts")
+    rng = random.Random(3)
+    for i in range(50):
+        idx.index_doc(str(i), {"x": rng.gauss(0, 1), "y": rng.gauss(0, 1)})
+    idx.index_doc("outlier", {"x": 40.0, "y": 40.0})
+    idx.refresh()
+    call(node, "PUT", "/_ml/data_frame/analytics/od", {
+        "source": {"index": "pts"},
+        "dest": {"index": "pts-out"},
+        "analysis": {"outlier_detection": {"n_neighbors": 5}},
+    })
+    call(node, "POST", "/_ml/data_frame/analytics/od/_start")
+    r = node.search_service.search("pts-out", {
+        "size": 60, "query": {"match_all": {}}})
+    scores = {h["_id"]: h["_source"]["ml"]["outlier_score"]
+              for h in r["hits"]["hits"]}
+    assert len(scores) == 51
+    assert scores["outlier"] > 0.9
+    assert scores["outlier"] == max(scores.values())
+
+
+def test_regression_analytics_and_inference(node):
+    node.indices_service.create_index("houses", {}, {
+        "properties": {"sqft": {"type": "double"},
+                       "rooms": {"type": "double"},
+                       "price": {"type": "double"}}})
+    idx = node.indices_service.get("houses")
+    rng = random.Random(5)
+    for i in range(80):
+        sqft = rng.uniform(50, 300)
+        rooms = rng.randrange(1, 6)
+        idx.index_doc(str(i), {
+            "sqft": sqft, "rooms": float(rooms),
+            "price": 1000 * sqft + 20000 * rooms + rng.gauss(0, 500)})
+    idx.refresh()
+    call(node, "PUT", "/_ml/data_frame/analytics/reg", {
+        "source": {"index": "houses"},
+        "dest": {"index": "houses-pred"},
+        "analysis": {"regression": {"dependent_variable": "price"}},
+    })
+    call(node, "POST", "/_ml/data_frame/analytics/reg/_start")
+    r = node.search_service.search("houses-pred", {"size": 100})
+    for h in r["hits"]["hits"]:
+        src = h["_source"]
+        assert abs(src["ml"]["price_prediction"] - src["price"]) < 20000
+    # the trained model is registered and serves inference
+    m = call(node, "GET", "/_ml/trained_models/reg-model")
+    assert m["trained_model_configs"][0]["model_type"] == "regression"
+    inf = call(node, "POST", "/_ml/trained_models/reg-model/_infer",
+               {"docs": [{"sqft": 100.0, "rooms": 2.0}]})
+    pred = inf["inference_results"][0]["predicted_value"]
+    assert abs(pred - 140000) < 30000
+
+
+def test_classification_analytics(node):
+    node.indices_service.create_index("iris", {}, {
+        "properties": {"a": {"type": "double"}, "b": {"type": "double"},
+                       "label": {"type": "keyword"}}})
+    idx = node.indices_service.get("iris")
+    rng = random.Random(11)
+    for i in range(60):
+        if i % 2:
+            idx.index_doc(str(i), {"a": rng.gauss(-2, 0.5),
+                                   "b": rng.gauss(-2, 0.5), "label": "neg"})
+        else:
+            idx.index_doc(str(i), {"a": rng.gauss(2, 0.5),
+                                   "b": rng.gauss(2, 0.5), "label": "pos"})
+    idx.refresh()
+    call(node, "PUT", "/_ml/data_frame/analytics/clf", {
+        "source": {"index": "iris"},
+        "dest": {"index": "iris-pred"},
+        "analysis": {"classification": {"dependent_variable": "label"}},
+    })
+    call(node, "POST", "/_ml/data_frame/analytics/clf/_start")
+    r = node.search_service.search("iris-pred", {"size": 100})
+    correct = sum(
+        1 for h in r["hits"]["hits"]
+        if h["_source"]["ml"]["label_prediction"] == h["_source"]["label"])
+    assert correct >= 58
+
+
+def test_trained_model_api(node):
+    call(node, "PUT", "/_ml/trained_models/linear1", {
+        "model_type": "regression",
+        "feature_names": ["x"],
+        "mean": [0.0], "std": [1.0],
+        "weights": [2.0, 1.0],            # y = 2x + 1
+        "classes": None,
+        "dependent_variable": "y",
+    })
+    r = call(node, "POST", "/_ml/trained_models/linear1/_infer",
+             {"docs": [{"x": 3.0}, {"x": -1.0}]})
+    preds = [d["predicted_value"] for d in r["inference_results"]]
+    assert preds == [7.0, -1.0]
+    call(node, "DELETE", "/_ml/trained_models/linear1")
+    call(node, "GET", "/_ml/trained_models/linear1", expect=404)
+
+
+def test_rare_function(node):
+    job = {
+        "analysis_config": {
+            "bucket_span": "60s",
+            "detectors": [{"function": "rare",
+                           "by_field_name": "status"}]},
+        "data_description": {"time_field": "ts"},
+    }
+    call(node, "PUT", "/_ml/anomaly_detectors/rare1", job)
+    call(node, "POST", "/_ml/anomaly_detectors/rare1/_open")
+    docs = []
+    statuses = ["200", "201", "204", "301", "302", "304"]
+    for b in range(50):
+        for s in statuses:
+            docs.append({"ts": b * 60_000, "status": s})
+    docs.append({"ts": 50 * 60_000, "status": "599"})   # never seen
+    call(node, "POST", "/_ml/anomaly_detectors/rare1/_data", docs)
+    recs = call(node, "GET",
+                "/_ml/anomaly_detectors/rare1/results/records")
+    assert recs["count"] >= 1
+    assert recs["records"][0]["by_field_value"] == "599"

@@ -1,0 +1,230 @@
+"""Rollup, enrich, and graph plugin tests (model: the x-pack rollup
+indexer/search tests, enrich policy runner tests, and graph explore
+tests)."""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(data_path=str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def call(node, method, path, body=None, expect=200, **params):
+    status, r = node.rest_controller.dispatch(method, path, params, body)
+    assert status == expect, r
+    return r
+
+
+# ---------------------------------------------------------------- rollup
+
+DAY = 86_400_000
+
+
+def _metrics_index(node):
+    node.indices_service.create_index("metrics", {}, {
+        "properties": {"ts": {"type": "date"},
+                       "host": {"type": "keyword"},
+                       "cpu": {"type": "double"}}})
+    idx = node.indices_service.get("metrics")
+    i = 0
+    for day in range(3):
+        for host, base in (("a", 10.0), ("b", 50.0)):
+            for k in range(4):
+                idx.index_doc(str(i), {
+                    "ts": day * DAY + k * 3_600_000,
+                    "host": host, "cpu": base + k})
+                i += 1
+    idx.refresh()
+
+
+ROLLUP_JOB = {
+    "index_pattern": "metrics",
+    "rollup_index": "metrics_rollup",
+    "cron": "0 0 * * *",
+    "page_size": 100,
+    "groups": {
+        "date_histogram": {"field": "ts", "calendar_interval": "1d"},
+        "terms": {"fields": ["host"]},
+    },
+    "metrics": [{"field": "cpu",
+                 "metrics": ["min", "max", "sum", "avg", "value_count"]}],
+}
+
+
+def test_rollup_job_and_search(node):
+    _metrics_index(node)
+    call(node, "PUT", "/_rollup/job/cpu_daily", ROLLUP_JOB)
+    call(node, "PUT", "/_rollup/job/cpu_daily", ROLLUP_JOB, expect=400)
+    r = call(node, "GET", "/_rollup/job/cpu_daily")
+    assert r["jobs"][0]["status"]["job_state"] == "stopped"
+    call(node, "POST", "/_rollup/job/cpu_daily/_start")
+    r = call(node, "GET", "/_rollup/job/cpu_daily")
+    assert r["jobs"][0]["stats"]["documents_processed"] == 6  # 3 days × 2 hosts
+
+    # live-style aggs over the rollup index
+    r = call(node, "POST", "/metrics_rollup/_rollup_search", {
+        "aggs": {"days": {
+            "date_histogram": {"field": "ts", "calendar_interval": "1d"},
+            "aggs": {
+                "max_cpu": {"max": {"field": "cpu"}},
+                "avg_cpu": {"avg": {"field": "cpu"}},
+                "n": {"value_count": {"field": "cpu"}},
+            }}}})
+    buckets = r["aggregations"]["days"]["buckets"]
+    assert len(buckets) == 3
+    for b in buckets:
+        assert b["max_cpu"]["value"] == 53.0          # host b max
+        assert b["n"]["value"] == 8.0                 # 8 samples/day
+        assert b["avg_cpu"]["value"] == pytest.approx(31.5)
+
+    # terms group round-trips too
+    r = call(node, "POST", "/metrics_rollup/_rollup_search", {
+        "aggs": {"hosts": {"terms": {"field": "host"},
+                           "aggs": {"s": {"sum": {"field": "cpu"}}}}}})
+    hb = {b["key"]: b for b in r["aggregations"]["hosts"]["buckets"]}
+    assert hb["a"]["s"]["value"] == pytest.approx(3 * (10 + 11 + 12 + 13))
+    assert hb["b"]["s"]["value"] == pytest.approx(3 * (50 + 51 + 52 + 53))
+
+
+def test_rollup_caps(node):
+    _metrics_index(node)
+    call(node, "PUT", "/_rollup/job/cpu_daily", ROLLUP_JOB)
+    r = call(node, "GET", "/_rollup/data/metrics")
+    assert "metrics" in r
+    assert r["metrics"]["rollup_jobs"][0]["job_id"] == "cpu_daily"
+
+
+# ---------------------------------------------------------------- enrich
+
+def _users_index(node):
+    node.indices_service.create_index("users", {}, {
+        "properties": {"email": {"type": "keyword"},
+                       "name": {"type": "keyword"},
+                       "city": {"type": "keyword"}}})
+    idx = node.indices_service.get("users")
+    idx.index_doc("1", {"email": "a@x.co", "name": "alice", "city": "ber"})
+    idx.index_doc("2", {"email": "b@x.co", "name": "bob", "city": "muc"})
+    idx.refresh()
+
+
+def test_enrich_policy_and_processor(node):
+    _users_index(node)
+    call(node, "PUT", "/_enrich/policy/users-policy", {
+        "match": {"indices": "users", "match_field": "email",
+                  "enrich_fields": ["name", "city"]}})
+    call(node, "POST", "/_enrich/policy/users-policy/_execute")
+    r = call(node, "GET", "/_enrich/policy/users-policy")
+    assert r["policies"][0]["config"]["match"]["match_field"] == "email"
+
+    # the enrich ingest processor joins at ingest time
+    node.ingest_service.put_pipeline("add-user", {
+        "processors": [{"enrich": {
+            "policy_name": "users-policy", "field": "user_email",
+            "target_field": "user"}}]})
+    node.indices_service.create_index("events", {}, None)
+    status, r = node.rest_controller.dispatch(
+        "PUT", "/events/_doc/1", {"pipeline": "add-user"},
+        {"user_email": "a@x.co", "action": "login"})
+    idx = node.indices_service.get("events")
+    idx.refresh()
+    got = node.search_service.search("events", {"size": 1})
+    src = got["hits"]["hits"][0]["_source"]
+    assert src["user"]["name"] == "alice"
+    assert src["user"]["city"] == "ber"
+    # the system enrich index exists
+    assert ".enrich-users-policy" in node.indices_service.indices
+
+
+def test_enrich_unexecuted_policy_fails(node):
+    _users_index(node)
+    call(node, "PUT", "/_enrich/policy/cold", {
+        "match": {"indices": "users", "match_field": "email",
+                  "enrich_fields": ["name"]}})
+    from elasticsearch_tpu.common.errors import IllegalArgumentException
+    with pytest.raises(IllegalArgumentException):
+        node.enrich_service.enrich_lookup("cold", "a@x.co")
+
+
+def test_enrich_delete(node):
+    _users_index(node)
+    call(node, "PUT", "/_enrich/policy/p1", {
+        "match": {"indices": "users", "match_field": "email",
+                  "enrich_fields": ["name"]}})
+    call(node, "DELETE", "/_enrich/policy/p1")
+    call(node, "GET", "/_enrich/policy/p1", expect=404)
+
+
+# ----------------------------------------------------------------- graph
+
+def test_graph_explore(node):
+    node.indices_service.create_index("orders", {}, {
+        "properties": {"product": {"type": "keyword"},
+                       "customer": {"type": "keyword"}}})
+    idx = node.indices_service.get("orders")
+    # c1 and c2 both buy widgets; c3 buys gadgets
+    docs = [
+        {"product": "widget", "customer": "c1"},
+        {"product": "widget", "customer": "c2"},
+        {"product": "widget", "customer": "c1"},
+        {"product": "gadget", "customer": "c3"},
+        {"product": "gizmo", "customer": "c2"},
+    ]
+    for i, d in enumerate(docs):
+        idx.index_doc(str(i), d)
+    idx.refresh()
+    r = call(node, "POST", "/orders/_graph/explore", {
+        "query": {"term": {"product": {"value": "widget"}}},
+        "vertices": [{"field": "product", "size": 3}],
+        "connections": {"vertices": [{"field": "customer", "size": 5}]},
+    })
+    fields = {(v["field"], v["term"]): v for v in r["vertices"]}
+    assert ("product", "widget") in fields
+    assert fields[("product", "widget")]["depth"] == 0
+    assert ("customer", "c1") in fields
+    assert ("customer", "c2") in fields
+    assert ("customer", "c3") not in fields
+    widget_i = r["vertices"].index(fields[("product", "widget")])
+    targets = {c["target"] for c in r["connections"]
+               if c["source"] == widget_i}
+    assert {r["vertices"][t]["term"] for t in targets} == {"c1", "c2"}
+
+
+def test_rollup_bucket_doc_count_and_no_helpers(node):
+    _metrics_index(node)
+    call(node, "PUT", "/_rollup/job/cpu_daily", ROLLUP_JOB)
+    call(node, "POST", "/_rollup/job/cpu_daily/_start")
+    r = call(node, "POST", "/metrics_rollup/_rollup_search", {
+        "aggs": {"days": {
+            "date_histogram": {"field": "ts", "calendar_interval": "1d"},
+            "aggs": {"avg_cpu": {"avg": {"field": "cpu"}}}}}})
+    for b in r["aggregations"]["days"]["buckets"]:
+        # original event counts, not rollup row counts
+        assert b["doc_count"] == 8
+        assert "avg_cpu__sum" not in b
+        assert "avg_cpu__count" not in b
+        assert "__doc_count" not in b
+        assert b["avg_cpu"]["value"] == pytest.approx(31.5)
+
+
+def test_enrich_list_valued_match_field(node):
+    _users_index(node)
+    call(node, "PUT", "/_enrich/policy/lp", {
+        "match": {"indices": "users", "match_field": "email",
+                  "enrich_fields": ["name"]}})
+    call(node, "POST", "/_enrich/policy/lp/_execute")
+    hits = node.enrich_service.enrich_lookup("lp", ["zzz", "b@x.co"])
+    assert hits and hits[0]["name"] == "bob"
+
+
+def test_ml_post_data_empty_body_is_400(node):
+    call(node, "PUT", "/_ml/anomaly_detectors/j9", {
+        "analysis_config": {"bucket_span": "60s",
+                            "detectors": [{"function": "count"}]},
+        "data_description": {"time_field": "ts"}})
+    call(node, "POST", "/_ml/anomaly_detectors/j9/_open")
+    call(node, "POST", "/_ml/anomaly_detectors/j9/_data", None, expect=400)
