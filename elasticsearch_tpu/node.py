@@ -85,6 +85,18 @@ class Node:
         self.enrich_service = EnrichService(self)
         from elasticsearch_tpu.xpack.graph import GraphService
         self.graph_service = GraphService(self)
+        from elasticsearch_tpu.xpack.watcher import WatcherService
+        self.watcher_service = WatcherService(self)
+        self.watcher_service.start_scheduler()
+        from elasticsearch_tpu.xpack.monitoring import MonitoringService
+        self.monitoring_service = MonitoringService(self)
+        self.monitoring_service.start()
+        from elasticsearch_tpu.transport.remote import RemoteClusterService
+        self.remote_cluster_service = RemoteClusterService(self)
+        # persistent cluster-settings overlay (the _cluster/settings API)
+        self.persistent_settings = {}
+        from elasticsearch_tpu.xpack.ccr import CcrService
+        self.ccr_service = CcrService(self)
         # processors that join against live services (enrich) resolve
         # the node through the ingest service
         self.ingest_service.node = self
@@ -108,5 +120,8 @@ class Node:
 
     def close(self):
         self.stop()
+        self.watcher_service.stop()
+        self.monitoring_service.stop()
+        self.ccr_service.stop()
         self.persistent_tasks.stop_all()
         self.indices_service.close()

@@ -227,6 +227,36 @@ def _register_all(c: RestController):
     c.register("DELETE", "/_snapshot/{repo}/{snap}", delete_snapshot)
     c.register("POST", "/_snapshot/{repo}/{snap}/_restore", restore_snapshot)
     # transform
+    # cluster settings + remote clusters (ref: RemoteClusterService)
+    c.register("PUT", "/_cluster/settings", put_cluster_settings)
+    c.register("GET", "/_cluster/settings", get_cluster_settings)
+    c.register("GET", "/_remote/info", remote_info)
+    # watcher (ref: x-pack/plugin/watcher REST layer)
+    c.register("PUT", "/_watcher/watch/{id}", watcher_put)
+    c.register("POST", "/_watcher/watch/{id}", watcher_put)
+    c.register("GET", "/_watcher/watch/{id}", watcher_get)
+    c.register("DELETE", "/_watcher/watch/{id}", watcher_delete)
+    c.register("POST", "/_watcher/watch/{id}/_execute", watcher_execute)
+    c.register("PUT", "/_watcher/watch/{id}/_activate", watcher_activate)
+    c.register("PUT", "/_watcher/watch/{id}/_deactivate",
+               watcher_deactivate)
+    c.register("GET", "/_watcher/stats", watcher_stats)
+    # monitoring (ref: x-pack/plugin/monitoring REST layer)
+    c.register("POST", "/_monitoring/bulk", monitoring_bulk)
+    c.register("POST", "/_monitoring/_collect", monitoring_collect)
+    # CCR (ref: x-pack/plugin/ccr REST layer)
+    c.register("PUT", "/{index}/_ccr/follow", ccr_follow)
+    c.register("POST", "/{index}/_ccr/pause_follow", ccr_pause)
+    c.register("POST", "/{index}/_ccr/resume_follow", ccr_resume)
+    c.register("POST", "/{index}/_ccr/unfollow", ccr_unfollow)
+    c.register("GET", "/{index}/_ccr/info", ccr_info)
+    c.register("GET", "/_ccr/stats", ccr_stats)
+    c.register("POST", "/{index}/_ccr/changes", ccr_changes)
+    c.register("PUT", "/_ccr/auto_follow/{name}", ccr_put_auto_follow)
+    c.register("GET", "/_ccr/auto_follow/{name}", ccr_get_auto_follow)
+    c.register("GET", "/_ccr/auto_follow", ccr_get_auto_follow_all)
+    c.register("DELETE", "/_ccr/auto_follow/{name}",
+               ccr_delete_auto_follow)
     # rollup (ref: x-pack/plugin/rollup REST layer)
     c.register("PUT", "/_rollup/job/{id}", rollup_put_job)
     c.register("GET", "/_rollup/job/{id}", rollup_get_job)
@@ -873,6 +903,8 @@ def _apply_alias_filter(node, index, body):
 
 def search_index(node, params, body, index):
     body = _merge_search_params(body, params)
+    if node.remote_cluster_service.has_remotes and ":" in index:
+        return 200, _ccs_search(node, index, body)
     body = _apply_alias_filter(node, index, body)
     body = _apply_dls(node, index, body)
     with node.task_manager.task_scope(
@@ -1983,3 +2015,163 @@ def enrich_execute_policy(node, params, body, name):
 
 def graph_explore(node, params, body, index):
     return 200, node.graph_service.explore(index, body or {})
+
+
+# --------------------------------------------------------------------------
+# cluster settings / remote clusters / CCS
+# --------------------------------------------------------------------------
+
+def put_cluster_settings(node, params, body):
+    body = body or {}
+    changed = {}
+    for scope in ("persistent", "transient"):
+        changed.update(body.get(scope) or {})
+    node.persistent_settings.update(changed)
+    node.remote_cluster_service.apply_settings(changed)
+    return 200, {"acknowledged": True,
+                 "persistent": body.get("persistent", {}),
+                 "transient": body.get("transient", {})}
+
+
+def get_cluster_settings(node, params, body):
+    return 200, {"persistent": node.persistent_settings, "transient": {}}
+
+
+def remote_info(node, params, body):
+    return 200, node.remote_cluster_service.info()
+
+
+def _ccs_search(node, expression, body):
+    """Cross-cluster search, ccs_minimize_roundtrips topology (ref:
+    TransportSearchAction.ccsRemoteReduce + SearchResponseMerger):
+    each cluster reduces independently; hits re-merge here."""
+    from elasticsearch_tpu.transport.remote import merge_search_responses
+    local, remotes = node.remote_cluster_service.group_indices(expression)
+    responses = []
+    if local:
+        local_expr = ",".join(local)
+        lbody = _apply_alias_filter(node, local_expr, body)
+        lbody = _apply_dls(node, local_expr, lbody)
+        lresp = node.search_service.search(local_expr, lbody)
+        responses.append((None, _apply_fls(node, local_expr, lresp)))
+    for alias, indices in remotes.items():
+        client = node.remote_cluster_service.get_client(alias)
+        responses.append(
+            (alias, client.search(",".join(indices), body)))
+    size = int((body or {}).get("size", 10))
+    dirs = []
+    for entry in (body or {}).get("sort", []) or []:
+        if isinstance(entry, str):
+            dirs.append("desc" if entry == "_score" else "asc")
+        else:
+            (f, spec), = entry.items()
+            dirs.append(spec if isinstance(spec, str)
+                        else spec.get("order", "asc"))
+    merged = merge_search_responses(responses, size=size, sort_dirs=dirs)
+    # single-source aggregations pass through untouched
+    agg_sources = [r for _, r in responses if r.get("aggregations")]
+    if len(agg_sources) == 1:
+        merged["aggregations"] = agg_sources[0]["aggregations"]
+    return merged
+
+
+# --------------------------------------------------------------------------
+# watcher / monitoring (ref: the corresponding x-pack REST handlers)
+# --------------------------------------------------------------------------
+
+def watcher_put(node, params, body, id):
+    return 201, node.watcher_service.put_watch(id, body)
+
+
+def watcher_get(node, params, body, id):
+    w = node.watcher_service.get_watch(id)
+    return 200, {"_id": id, "found": True, "status": w.status,
+                 "watch": w.body_dict()}
+
+
+def watcher_delete(node, params, body, id):
+    return 200, node.watcher_service.delete_watch(id)
+
+
+def watcher_execute(node, params, body, id):
+    body = body or {}
+    result = node.watcher_service.execute_watch(
+        id, trigger_data=body.get("trigger_data"),
+        record=bool(body.get("record_execution", False)),
+        alternative_input=body.get("alternative_input"))
+    return 200, {"_id": result["_id"], "watch_record": result}
+
+
+def watcher_activate(node, params, body, id):
+    return 200, node.watcher_service.activate(id, True)
+
+
+def watcher_deactivate(node, params, body, id):
+    return 200, node.watcher_service.activate(id, False)
+
+
+def watcher_stats(node, params, body):
+    return 200, node.watcher_service.stats()
+
+
+def monitoring_bulk(node, params, body):
+    docs = body if isinstance(body, list) else [body or {}]
+    return 200, node.monitoring_service.bulk(
+        params.get("system_id", "external"), docs)
+
+
+def monitoring_collect(node, params, body):
+    """Engine-internal trigger for one collection cycle (tests/ops)."""
+    docs = node.monitoring_service.collect_now()
+    return 200, {"collected": len(docs)}
+
+
+# --------------------------------------------------------------------------
+# CCR (ref: x-pack/plugin/ccr/.../rest/ REST handlers)
+# --------------------------------------------------------------------------
+
+def ccr_follow(node, params, body, index):
+    return 200, node.ccr_service.follow(index, body or {})
+
+
+def ccr_pause(node, params, body, index):
+    return 200, node.ccr_service.pause_follow(index)
+
+
+def ccr_resume(node, params, body, index):
+    return 200, node.ccr_service.resume_follow(index)
+
+
+def ccr_unfollow(node, params, body, index):
+    return 200, node.ccr_service.unfollow(index)
+
+
+def ccr_info(node, params, body, index):
+    return 200, node.ccr_service.follow_info(index)
+
+
+def ccr_stats(node, params, body):
+    return 200, node.ccr_service.stats()
+
+
+def ccr_changes(node, params, body, index):
+    body = body or {}
+    return 200, node.ccr_service.changes(
+        index, int(body.get("from_seq_no", 0)),
+        int(body.get("max_operations", 1024)))
+
+
+def ccr_put_auto_follow(node, params, body, name):
+    return 200, node.ccr_service.put_auto_follow(name, body or {})
+
+
+def ccr_get_auto_follow(node, params, body, name):
+    return 200, node.ccr_service.get_auto_follow(name)
+
+
+def ccr_get_auto_follow_all(node, params, body):
+    return 200, node.ccr_service.get_auto_follow()
+
+
+def ccr_delete_auto_follow(node, params, body, name):
+    return 200, node.ccr_service.delete_auto_follow(name)

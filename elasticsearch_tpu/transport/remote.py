@@ -1,0 +1,227 @@
+"""Remote clusters: connections to other clusters for CCS/CCR.
+
+Mirrors the reference's remote-cluster layer (ref: transport/
+RemoteClusterService.java:430 — per-alias connections with sniff/proxy
+strategies; `alias:index` expressions resolved in TransportSearchAction;
+SURVEY.md §2.3 "Cross-cluster search"). Re-design for this engine:
+remote clusters register via the same `cluster.remote.{alias}.seeds`
+settings surface, but the connection is an HTTP JSON client to the
+remote node's REST port (this framework's inter-cluster DCN path) —
+the in-cluster ICI/RPC transport stays reserved for intra-cluster
+traffic, matching the reference's separation of remote-cluster
+connections from local cluster transport.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import (
+    ElasticsearchTpuException,
+    IllegalArgumentException,
+    ResourceNotFoundException,
+)
+
+REMOTE_CLUSTER_INDEX_SEPARATOR = ":"
+
+
+class RemoteClusterClient:
+    """Minimal JSON-over-HTTP client to one remote cluster node."""
+
+    def __init__(self, alias: str, seeds: List[str], timeout: float = 10.0):
+        self.alias = alias
+        self.seeds = seeds
+        self.timeout = timeout
+
+    def request(self, method: str, path: str,
+                body: Optional[Any] = None) -> Dict[str, Any]:
+        last_err: Optional[Exception] = None
+        for seed in self.seeds:
+            url = f"http://{seed}{path}"
+            data = (json.dumps(body).encode()
+                    if body is not None else None)
+            req = urllib.request.Request(
+                url, data=data, method=method,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout) as resp:
+                    text = resp.read().decode()
+                    try:
+                        return json.loads(text)
+                    except ValueError:     # _cat family plain text
+                        return {"_cat": text}
+            except urllib.error.HTTPError as e:
+                detail = e.read().decode(errors="replace")
+                raise ElasticsearchTpuException(
+                    f"remote cluster [{self.alias}] returned {e.code}: "
+                    f"{detail[:400]}")
+            except OSError as e:           # connection refused, timeout
+                last_err = e
+                continue
+        raise ElasticsearchTpuException(
+            f"cannot connect to remote cluster [{self.alias}] "
+            f"(seeds {self.seeds}): {last_err}")
+
+    def search(self, index: str, body: Dict[str, Any]) -> Dict[str, Any]:
+        return self.request("POST", f"/{index}/_search", body)
+
+
+class RemoteClusterService:
+    """Registry of remote clusters + index-expression resolution (ref:
+    RemoteClusterService.groupIndices)."""
+
+    def __init__(self, node):
+        self.node = node
+        self._clusters: Dict[str, RemoteClusterClient] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------ configuration
+    def apply_settings(self, settings: Dict[str, Any]):
+        """Consume cluster.remote.{alias}.seeds entries from a settings
+        update (the _cluster/settings surface)."""
+        remote = settings.get("cluster", {}).get("remote", {})
+        # also accept flat keys "cluster.remote.alias.seeds"
+        flat: Dict[str, Any] = {}
+        for k, v in settings.items():
+            if k.startswith("cluster.remote."):
+                rest = k[len("cluster.remote."):]
+                alias, _, leaf = rest.partition(".")
+                flat.setdefault(alias, {})[leaf] = v
+        merged = {**remote, **flat}
+        for alias, cfg in merged.items():
+            if "seeds" not in cfg:
+                continue            # unrelated leaf (skip_unavailable, …)
+            seeds = cfg["seeds"]
+            if seeds in (None, [], ""):
+                # explicit null/empty removes the connection
+                with self._lock:
+                    self._clusters.pop(alias, None)
+                continue
+            if isinstance(seeds, str):
+                seeds = [seeds]
+            with self._lock:
+                self._clusters[alias] = RemoteClusterClient(alias, seeds)
+
+    def register(self, alias: str, seeds: List[str]):
+        with self._lock:
+            self._clusters[alias] = RemoteClusterClient(alias, seeds)
+
+    def get_client(self, alias: str) -> RemoteClusterClient:
+        c = self._clusters.get(alias)
+        if c is None:
+            raise ResourceNotFoundException(
+                f"no such remote cluster: [{alias}]")
+        return c
+
+    def info(self) -> Dict[str, Any]:
+        out = {}
+        for alias, c in self._clusters.items():
+            connected = True
+            try:
+                c.request("GET", "/")
+            except ElasticsearchTpuException:
+                connected = False
+            out[alias] = {"connected": connected, "seeds": c.seeds,
+                          "mode": "sniff",
+                          "num_nodes_connected": 1 if connected else 0}
+        return out
+
+    # -------------------------------------------------------- resolution
+    def group_indices(self, expression: str
+                      ) -> Tuple[List[str], Dict[str, List[str]]]:
+        """Split an index expression into (local, {alias: [indices]})."""
+        local: List[str] = []
+        remote: Dict[str, List[str]] = {}
+        for part in expression.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if REMOTE_CLUSTER_INDEX_SEPARATOR in part:
+                alias, _, index = part.partition(
+                    REMOTE_CLUSTER_INDEX_SEPARATOR)
+                if alias in self._clusters:
+                    remote.setdefault(alias, []).append(index)
+                    continue
+            local.append(part)
+        return local, remote
+
+    @property
+    def has_remotes(self) -> bool:
+        return bool(self._clusters)
+
+
+def merge_search_responses(
+        responses: List[Tuple[Optional[str], Dict[str, Any]]],
+        size: int = 10,
+        sort_dirs: Optional[List[str]] = None) -> Dict[str, Any]:
+    """Merge independently reduced per-cluster search responses (ref:
+    action/search/SearchResponseMerger — the ccs_minimize_roundtrips
+    topology): hits re-sorted by score/sort values (honoring the request
+    sort directions), totals summed, shard counts summed. Remote hit
+    _index gets the `alias:` prefix."""
+    import functools
+
+    all_hits: List[Dict[str, Any]] = []
+    total = 0
+    relation = "eq"
+    max_score = None
+    took = 0
+    shards = {"total": 0, "successful": 0, "skipped": 0, "failed": 0}
+    for alias, r in responses:
+        hits = r.get("hits", {})
+        t = hits.get("total", {})
+        total += t.get("value", 0)
+        if t.get("relation", "eq") != "eq":
+            relation = "gte"
+        ms = hits.get("max_score")
+        if ms is not None:
+            max_score = ms if max_score is None else max(max_score, ms)
+        took = max(took, r.get("took", 0))
+        for k in shards:
+            shards[k] += r.get("_shards", {}).get(k, 0)
+        for h in hits.get("hits", []):
+            h = dict(h)
+            if alias:
+                h["_index"] = f"{alias}:{h['_index']}"
+            all_hits.append(h)
+
+    dirs = sort_dirs or []
+
+    def hit_cmp(a, b):
+        sa, sb = a.get("sort"), b.get("sort")
+        if sa and sb:
+            for i, (v1, v2) in enumerate(zip(sa, sb)):
+                if v1 == v2:
+                    continue
+                if v1 is None:
+                    return 1                     # missing sorts last
+                if v2 is None:
+                    return -1
+                try:
+                    c = -1 if v1 < v2 else 1
+                except TypeError:
+                    c = -1 if str(v1) < str(v2) else 1
+                d = dirs[i] if i < len(dirs) else "asc"
+                return c if d == "asc" else -c
+            return 0
+        s1 = a.get("_score") or 0.0
+        s2 = b.get("_score") or 0.0
+        return -1 if s1 > s2 else (1 if s1 < s2 else 0)
+
+    all_hits.sort(key=functools.cmp_to_key(hit_cmp))
+    return {
+        "took": took,
+        "timed_out": any(r.get("timed_out") for _, r in responses),
+        "num_reduce_phases": len(responses),
+        "_shards": shards,
+        "_clusters": {"total": len(responses),
+                      "successful": len(responses), "skipped": 0},
+        "hits": {"total": {"value": total, "relation": relation},
+                 "max_score": max_score,
+                 "hits": all_hits[:size]},
+    }

@@ -1,0 +1,309 @@
+"""CCR: cross-cluster replication — follower indices tail a leader.
+
+Mirrors the reference's x-pack CCR plugin (ref: x-pack/plugin/ccr —
+`ShardFollowNodeTask.java:62` polls the leader's soft-delete op history
+via ShardChangesAction and applies batches to the follower;
+auto-follow patterns; pause/resume/unfollow; SURVEY.md §2.3). Re-design
+for this engine: the leader exposes its per-shard op history through a
+`/{index}/_ccr/changes` endpoint backed by the translog (seqno-ordered
+ops); followers poll over the remote-cluster HTTP channel (the DCN
+path), apply ops through the normal indexing path, and checkpoint the
+last applied seqno. If the leader has trimmed the requested history the
+follower falls back to a full bootstrap copy (the analogue of CCR's
+restore-from-leader file copy).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ResourceAlreadyExistsException,
+    ResourceNotFoundException,
+)
+
+
+class FollowTask:
+    def __init__(self, follower_index: str, remote_cluster: str,
+                 leader_index: str):
+        self.follower_index = follower_index
+        self.remote_cluster = remote_cluster
+        self.leader_index = leader_index
+        self.status = "active"               # active | paused
+        self.follower_global_checkpoint = -1
+        self.operations_written = 0
+        self.failed_reads = 0
+        self.last_error: Optional[str] = None
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            "follower_index": self.follower_index,
+            "remote_cluster": self.remote_cluster,
+            "leader_index": self.leader_index,
+            "status": self.status,
+            "parameters": {},
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "follower_index": self.follower_index,
+            "follower_global_checkpoint": self.follower_global_checkpoint,
+            "operations_written": self.operations_written,
+            "failed_read_requests": self.failed_reads,
+            "last_error": self.last_error,
+        }
+
+
+class CcrService:
+    """Follow-task registry + the polling loop (ref: ShardFollowTasksExecutor
+    — here one thread serves all followers; `sync()` is one read/apply
+    cycle and is also called inline so tests are deterministic)."""
+
+    POLL_INTERVAL_S = 0.5
+
+    def __init__(self, node):
+        self.node = node
+        self.tasks: Dict[str, FollowTask] = {}
+        self.auto_follow_patterns: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------- leader
+    def changes(self, index: str, from_seq_no: int,
+                max_operations: int = 1024) -> Dict[str, Any]:
+        """Leader side: op history from the translog (the ShardChanges
+        analogue). Returns ops with seq_no >= from_seq_no in order."""
+        idx = self.node.indices_service.get(index)
+        ops: List[Dict[str, Any]] = []
+        min_available = None
+        max_seq = -1
+        for shard in idx.shards:
+            for op in shard.translog.read_ops(1):
+                max_seq = max(max_seq, op.seq_no)
+                if min_available is None or op.seq_no < min_available:
+                    min_available = op.seq_no
+                if op.seq_no >= from_seq_no:
+                    ops.append(op.to_dict())
+        ops.sort(key=lambda o: o["seq_no"])
+        # history gap: translog trimmed past the requested seqno
+        history_complete = (from_seq_no <= 0
+                            or min_available is None
+                            or min_available <= from_seq_no)
+        return {"operations": ops[:max_operations],
+                "max_seq_no": max_seq,
+                "history_complete": history_complete}
+
+    # ----------------------------------------------------------- follower
+    def follow(self, follower_index: str, body: Dict[str, Any]):
+        remote = body.get("remote_cluster")
+        leader = body.get("leader_index")
+        if not remote or not leader:
+            raise IllegalArgumentException(
+                "remote_cluster and leader_index are required")
+        with self._lock:
+            if follower_index in self.tasks:
+                raise ResourceAlreadyExistsException(
+                    f"follower index [{follower_index}] already exists")
+        client = self.node.remote_cluster_service.get_client(remote)
+        # bootstrap: leader mappings → create follower (the restore step)
+        mapping = client.request("GET", f"/{leader}/_mapping")
+        mappings = mapping.get(leader, {}).get("mappings", {})
+        if follower_index not in self.node.indices_service.indices:
+            self.node.indices_service.create_index(
+                follower_index, {}, mappings or None)
+        task = FollowTask(follower_index, remote, leader)
+        with self._lock:
+            self.tasks[follower_index] = task
+        self.sync(follower_index)
+        self._ensure_thread()
+        return {"follow_index_created": True,
+                "follow_index_shards_acked": True,
+                "index_following_started": True}
+
+    def sync(self, follower_index: str) -> int:
+        """One read/apply cycle; returns ops applied."""
+        task = self.tasks.get(follower_index)
+        if task is None or task.status != "active":
+            return 0
+        client = self.node.remote_cluster_service.get_client(
+            task.remote_cluster)
+        try:
+            r = client.request(
+                "POST", f"/{task.leader_index}/_ccr/changes",
+                {"from_seq_no": task.follower_global_checkpoint + 1})
+        except Exception as e:                    # leader unreachable
+            task.failed_reads += 1
+            task.last_error = str(e)
+            return 0
+        if not r.get("history_complete", True):
+            return self._bootstrap_copy(task, client)
+        fidx = self.node.indices_service.get(task.follower_index)
+        n = 0
+        for op in r.get("operations", []):
+            if op["seq_no"] <= task.follower_global_checkpoint:
+                continue
+            if op.get("op") == "delete":
+                try:
+                    fidx.delete_doc(op["id"])
+                except Exception:
+                    pass
+            elif op.get("op") == "index":
+                fidx.index_doc(op["id"], op["source"])
+            task.follower_global_checkpoint = op["seq_no"]
+            n += 1
+        if n:
+            fidx.refresh()
+            task.operations_written += n
+        return n
+
+    def _bootstrap_copy(self, task: FollowTask,
+                        client) -> int:
+        """Full resync when leader history is unavailable (the analogue
+        of CCR's restore-from-leader)."""
+        fidx = self.node.indices_service.get(task.follower_index)
+        # record the leader's max seqno BEFORE snapshotting: ops indexed
+        # during/after the copy have higher seqnos and will be replayed
+        # by later syncs from this checkpoint (re-applying a copied doc
+        # is an idempotent upsert) — advancing past them would drop them
+        pre_copy = client.request(
+            "POST", f"/{task.leader_index}/_ccr/changes",
+            {"from_seq_no": 0, "max_operations": 0})
+        n = 0
+        r = client.request(
+            "POST", f"/{task.leader_index}/_search?scroll=1m",
+            {"query": {"match_all": {}}, "size": 1000})
+        while True:
+            hits = r["hits"]["hits"]
+            if not hits:
+                break
+            for h in hits:
+                fidx.index_doc(h["_id"], h["_source"])
+                n += 1
+            r = client.request("POST", "/_search/scroll",
+                               {"scroll_id": r["_scroll_id"]})
+        fidx.refresh()
+        task.operations_written += n
+        task.follower_global_checkpoint = max(
+            task.follower_global_checkpoint,
+            pre_copy.get("max_seq_no", -1))
+        return n
+
+    def pause_follow(self, follower_index: str):
+        self._get(follower_index).status = "paused"
+        return {"acknowledged": True}
+
+    def resume_follow(self, follower_index: str):
+        self._get(follower_index).status = "active"
+        self.sync(follower_index)
+        return {"acknowledged": True}
+
+    def unfollow(self, follower_index: str):
+        self._get(follower_index)
+        with self._lock:
+            del self.tasks[follower_index]
+        return {"acknowledged": True}
+
+    def stats(self) -> Dict[str, Any]:
+        return {"follow_stats": {"indices": [
+            {"index": t.follower_index, "shards": [t.stats()]}
+            for t in self.tasks.values()]},
+            "auto_follow_stats": {
+                "number_of_successful_follow_indices": 0}}
+
+    def follow_info(self, follower_index: str) -> Dict[str, Any]:
+        return {"follower_indices": [self._get(follower_index).info()]}
+
+    def _get(self, follower_index: str) -> FollowTask:
+        t = self.tasks.get(follower_index)
+        if t is None:
+            raise ResourceNotFoundException(
+                f"follower index [{follower_index}] does not exist")
+        return t
+
+    # ------------------------------------------------------- auto-follow
+    def put_auto_follow(self, name: str, body: Dict[str, Any]):
+        if not body.get("remote_cluster") or not body.get(
+                "leader_index_patterns"):
+            raise IllegalArgumentException(
+                "remote_cluster and leader_index_patterns are required")
+        self.auto_follow_patterns[name] = dict(body)
+        return {"acknowledged": True}
+
+    def get_auto_follow(self, name: Optional[str] = None):
+        if name is not None:
+            if name not in self.auto_follow_patterns:
+                raise ResourceNotFoundException(
+                    f"auto-follow pattern [{name}] is missing")
+            items = {name: self.auto_follow_patterns[name]}
+        else:
+            items = self.auto_follow_patterns
+        return {"patterns": [{"name": n, "pattern": p}
+                             for n, p in items.items()]}
+
+    def delete_auto_follow(self, name: str):
+        if name not in self.auto_follow_patterns:
+            raise ResourceNotFoundException(
+                f"auto-follow pattern [{name}] is missing")
+        del self.auto_follow_patterns[name]
+        return {"acknowledged": True}
+
+    def scan_auto_follow(self):
+        """One auto-follow coordinator pass: follow new leader indices
+        matching registered patterns (ref: AutoFollowCoordinator)."""
+        import fnmatch
+        for name, pat in self.auto_follow_patterns.items():
+            remote = pat["remote_cluster"]
+            try:
+                client = self.node.remote_cluster_service.get_client(remote)
+                cat = client.request("GET", "/_cat/indices")
+            except Exception:
+                continue
+            leader_names = []
+            if isinstance(cat, dict) and "_cat" in cat:
+                for line in cat["_cat"].splitlines():
+                    parts = line.split()
+                    if len(parts) >= 3:
+                        leader_names.append(parts[2])
+            prefix = pat.get("follow_index_pattern", "{{leader_index}}")
+            for leader in leader_names:
+                if not any(fnmatch.fnmatch(leader, p)
+                           for p in pat["leader_index_patterns"]):
+                    continue
+                follower = prefix.replace("{{leader_index}}", leader)
+                if follower in self.tasks:
+                    continue
+                try:
+                    self.follow(follower, {"remote_cluster": remote,
+                                           "leader_index": leader})
+                except Exception:
+                    continue
+
+    # ---------------------------------------------------------- lifecycle
+    def _ensure_thread(self):
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.POLL_INTERVAL_S):
+                for name in list(self.tasks):
+                    try:
+                        self.sync(name)
+                    except Exception:
+                        pass
+                if self.auto_follow_patterns:
+                    self.scan_auto_follow()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="ccr-follower")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

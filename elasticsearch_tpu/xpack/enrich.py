@@ -103,8 +103,10 @@ class EnrichService:
                     for v in (mv if isinstance(mv, list) else [mv]):
                         lookup.setdefault(v, src)
                 elif isinstance(mv, dict):          # range policy
-                    lo, hi = mv.get("gte"), mv.get("lte", mv.get("lt"))
-                    ranges.append((lo, hi, src))
+                    hi_exclusive = "lte" not in mv and "lt" in mv
+                    lo = mv.get("gte")
+                    hi = mv.get("lte", mv.get("lt"))
+                    ranges.append((lo, hi, hi_exclusive, src))
                 else:
                     continue                # range needs {gte,lte} objects
                 eidx.index_doc(f"{n}", src)
@@ -137,10 +139,13 @@ class EnrichService:
                     break
             return out
         out = []
-        for lo, hi, doc in self.range_lookups.get(policy_name, []):
+        for lo, hi, hi_exclusive, doc in self.range_lookups.get(
+                policy_name, []):
             try:
-                if ((lo is None or value >= lo)
-                        and (hi is None or value <= hi)):
+                upper_ok = (hi is None
+                            or (value < hi if hi_exclusive
+                                else value <= hi))
+                if (lo is None or value >= lo) and upper_ok:
                     out.append(doc)
             except TypeError:
                 continue

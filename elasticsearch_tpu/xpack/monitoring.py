@@ -1,0 +1,114 @@
+"""Monitoring: stats collectors → exporters → monitoring indices.
+
+Mirrors the reference's x-pack monitoring plugin (ref: x-pack/plugin/
+monitoring — `collector/` samples node/cluster/index stats on an
+interval, `exporter/` ships them to a local monitoring index or a remote
+HTTP cluster; SURVEY.md §2.6). Re-design for this engine: collectors
+read the node's existing stats surfaces (the same data `_nodes/stats`
+and `_cluster/stats` serve) and the local exporter appends documents to
+`.monitoring-es` through the normal indexing path; a `_monitoring/bulk`
+API accepts externally collected documents (the Kibana/Logstash path).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class MonitoringService:
+    INDEX = ".monitoring-es"
+
+    def __init__(self, node, interval_s: float = 10.0):
+        self.node = node
+        self.interval_s = interval_s
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.collected = 0
+
+    # ------------------------------------------------------------ control
+    def start(self):
+        """Start interval collection (ref: MonitoringService.start)."""
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.collect_now()
+                except Exception:
+                    pass
+
+        self._stop.clear()
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="monitoring-collector")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    # ---------------------------------------------------------- collectors
+    def collect_now(self) -> List[Dict[str, Any]]:
+        """One collection cycle: node stats + index stats documents."""
+        now = int(time.time() * 1000)
+        docs: List[Dict[str, Any]] = []
+        # node_stats collector (ref: collector/node/NodeStatsCollector)
+        indices = self.node.indices_service.indices
+        total_docs = 0
+        total_size = 0
+        for name in list(indices):
+            idx = self.node.indices_service.get(name)
+            s = idx.stats()
+            total_docs += s["docs"]["count"]
+            docs.append({
+                "type": "index_stats",
+                "timestamp": now,
+                "index_stats": {
+                    "index": name,
+                    "docs": s["docs"],
+                    "shards": idx.num_shards,
+                },
+            })
+        import resource
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        docs.append({
+            "type": "node_stats",
+            "timestamp": now,
+            "node_stats": {
+                "node_id": self.node.node_id,
+                "indices": {"docs": {"count": total_docs}},
+                "process": {"max_rss_kb": ru.ru_maxrss,
+                            "cpu_user_s": ru.ru_utime},
+                "open_scrolls": self.node.search_service.open_scroll_count(),
+            },
+        })
+        self._export(docs)
+        return docs
+
+    # ----------------------------------------------------------- exporter
+    def _export(self, docs: List[Dict[str, Any]]):
+        """Local exporter (ref: exporter/local/LocalExporter)."""
+        if self.INDEX not in self.node.indices_service.indices:
+            self.node.indices_service.create_index(self.INDEX, {}, None)
+        idx = self.node.indices_service.get(self.INDEX)
+        for d in docs:
+            idx.index_doc(uuid.uuid4().hex, d)
+            self.collected += 1
+        idx.refresh()
+
+    # -------------------------------------------------------- monitoring bulk
+    def bulk(self, system_id: str,
+             docs: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """_monitoring/bulk — externally collected documents (ref:
+        rest/action/RestMonitoringBulkAction)."""
+        now = int(time.time() * 1000)
+        wrapped = [{"type": d.get("type", system_id), "timestamp": now,
+                    **{k: v for k, v in d.items() if k != "type"}}
+                   for d in docs]
+        self._export(wrapped)
+        return {"took": 0, "ignored": False, "errors": False}

@@ -166,14 +166,60 @@ class RollupService:
             raise IllegalArgumentException(
                 "Rollup requires at least one aggregation")
         out_aggs = self._translate_aggs(aggs)
+        query = self._translate_query(
+            body.get("query", {"match_all": {}}), index)
         r = self.node.search_service.search(index, {
-            "size": 0, "query": body.get("query", {"match_all": {}}),
-            "aggs": out_aggs})
+            "size": 0, "query": query, "aggs": out_aggs})
         translated = self._merge_avg(r.get("aggregations", {}), aggs)
         return {"took": r.get("took", 0), "timed_out": False,
                 "hits": {"total": {"value": 0, "relation": "eq"},
                          "hits": []},
                 "aggregations": translated}
+
+    def _rolled_field_map(self, rollup_index: str) -> Dict[str, str]:
+        """Original field name → flattened rollup field, from the jobs
+        that write into this rollup index."""
+        fmap: Dict[str, str] = {}
+        for job in self.jobs.values():
+            if job["rollup_index"] != rollup_index:
+                continue
+            groups = job["groups"]
+            df = groups["date_histogram"]["field"]
+            fmap[df] = f"{df}.date_histogram.timestamp"
+            for f in groups.get("terms", {}).get("fields", []):
+                fmap[f] = f"{f}.terms.value"
+            for f in groups.get("histogram", {}).get("fields", []):
+                fmap[f] = f"{f}.histogram.value"
+        return fmap
+
+    def _translate_query(self, query: Dict[str, Any],
+                         rollup_index: str) -> Dict[str, Any]:
+        """Rewrite query field names onto the flattened rollup fields
+        (ref: TransportRollupSearchAction.rewriteQuery — only group-by
+        fields are queryable in rolled data)."""
+        fmap = self._rolled_field_map(rollup_index)
+
+        def walk(node):
+            if isinstance(node, list):
+                return [walk(x) for x in node]
+            if not isinstance(node, dict):
+                return node
+            out = {}
+            for k, v in node.items():
+                if k in ("term", "terms", "range", "match", "wildcard",
+                         "prefix", "exists") and isinstance(v, dict):
+                    nv = {}
+                    for f, spec in v.items():
+                        if f == "field" and k == "exists":
+                            nv[f] = fmap.get(spec, spec)
+                        else:
+                            nv[fmap.get(f, f)] = spec
+                    out[k] = nv
+                else:
+                    out[k] = walk(v)
+            return out
+
+        return walk(query)
 
     def _translate_aggs(self, aggs: Dict[str, Any]) -> Dict[str, Any]:
         out: Dict[str, Any] = {}

@@ -501,6 +501,10 @@ def required_privilege(method: str, path: str) -> Tuple[str, str, Optional[str]]
             return ("cluster", "manage_api_key", None)
         return ("cluster", "manage_security", None)
     if parts[0].startswith("_"):
+        if (parts[0] == "_cluster" and len(parts) >= 2
+                and parts[1] == "settings" and method != "GET"):
+            # settings writes are cluster administration, not monitoring
+            return ("cluster", "manage", None)
         priv = _CLUSTER_PREFIXES.get(parts[0])
         if priv is None:
             # bare endpoints like /_search, /_bulk, /_mget run over indices

@@ -1,0 +1,336 @@
+"""Watcher: alerting — triggers → input → condition → actions.
+
+Mirrors the reference's x-pack watcher plugin (ref: x-pack/plugin/watcher
+— Watch model (trigger/input/condition/actions), ExecutionService running
+watches on schedule ticks, watch history written to an index;
+SURVEY.md §2.6). Re-design for this engine: watches are registered with
+a schedule (interval) trigger driven by one scheduler thread; inputs run
+through the TPU search path; conditions are the compare/always/never
+family evaluated host-side on the payload; actions append to indices,
+log records, or record webhook intents (no egress). Every execution is
+recorded in `.watcher-history`.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ResourceNotFoundException,
+)
+
+
+def _interval_seconds(expr: str) -> float:
+    m = re.fullmatch(r"(\d+)(ms|s|m|h|d)?", str(expr))
+    if not m:
+        raise IllegalArgumentException(f"bad interval [{expr}]")
+    mult = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0,
+            "d": 86400.0, None: 1.0}[m.group(2)]
+    return int(m.group(1)) * mult
+
+
+def _path_get(obj: Any, path: str):
+    """ctx.payload.hits.total style dotted access."""
+    cur = obj
+    for part in path.split("."):
+        if isinstance(cur, dict):
+            cur = cur.get(part)
+        elif isinstance(cur, list) and part.isdigit():
+            i = int(part)
+            cur = cur[i] if i < len(cur) else None
+        else:
+            return None
+    return cur
+
+
+class Watch:
+    def __init__(self, watch_id: str, body: Dict[str, Any]):
+        self.id = watch_id
+        self.trigger = body.get("trigger", {})
+        self.input = body.get("input", {"none": {}})
+        self.condition = body.get("condition", {"always": {}})
+        self.actions = body.get("actions", {})
+        self.metadata = body.get("metadata", {})
+        self.active = True
+        self.status: Dict[str, Any] = {
+            "state": {"active": True},
+            "actions": {},
+            "execution_state": None,
+        }
+        sched = self.trigger.get("schedule", {})
+        self.interval_s: Optional[float] = None
+        if "interval" in sched:
+            self.interval_s = _interval_seconds(sched["interval"])
+        self.next_fire = (time.time() + self.interval_s
+                          if self.interval_s else None)
+
+    def body_dict(self) -> Dict[str, Any]:
+        return {"trigger": self.trigger, "input": self.input,
+                "condition": self.condition, "actions": self.actions,
+                "metadata": self.metadata}
+
+
+class WatcherService:
+    HISTORY_INDEX = ".watcher-history"
+
+    def __init__(self, node):
+        self.node = node
+        self.watches: Dict[str, Watch] = {}
+        self._lock = threading.Lock()
+        self._state = "started"
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.execution_count = 0
+
+    # ----------------------------------------------------------- lifecycle
+    def start_scheduler(self):
+        """Background trigger engine (ref: TickerScheduleTriggerEngine)."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(0.1):
+                now = time.time()
+                due = []
+                with self._lock:
+                    for w in self.watches.values():
+                        if (w.active and w.next_fire is not None
+                                and now >= w.next_fire):
+                            w.next_fire = now + w.interval_s
+                            due.append(w)
+                for w in due:
+                    try:
+                        self.execute_watch(w.id, record=True)
+                    except Exception:
+                        pass
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="watcher-ticker")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        self._state = "stopped"
+
+    # --------------------------------------------------------------- CRUD
+    def put_watch(self, watch_id: str, body: Dict[str, Any]):
+        w = Watch(watch_id, body or {})
+        with self._lock:
+            created = watch_id not in self.watches
+            self.watches[watch_id] = w
+        return {"_id": watch_id, "created": created}
+
+    def get_watch(self, watch_id: str) -> Watch:
+        w = self.watches.get(watch_id)
+        if w is None:
+            raise ResourceNotFoundException(
+                f"watch [{watch_id}] not found")
+        return w
+
+    def delete_watch(self, watch_id: str):
+        self.get_watch(watch_id)
+        with self._lock:
+            del self.watches[watch_id]
+        return {"_id": watch_id, "found": True}
+
+    def activate(self, watch_id: str, active: bool):
+        w = self.get_watch(watch_id)
+        w.active = active
+        w.status["state"]["active"] = active
+        if active and w.interval_s:
+            w.next_fire = time.time() + w.interval_s
+        return {"status": w.status}
+
+    # ----------------------------------------------------------- execution
+    def execute_watch(self, watch_id: str,
+                      trigger_data: Optional[Dict[str, Any]] = None,
+                      record: bool = True,
+                      alternative_input: Optional[Dict[str, Any]] = None):
+        """One watch execution cycle (ref: ExecutionService.execute:
+        input → condition → actions, history record)."""
+        w = self.get_watch(watch_id)
+        execution_id = f"{watch_id}_{uuid.uuid4().hex[:12]}"
+        started = time.time()
+        payload = (alternative_input if alternative_input is not None
+                   else self._run_input(w.input))
+        ctx = {"watch_id": watch_id, "payload": payload,
+               "metadata": w.metadata,
+               "trigger": trigger_data or {},
+               "execution_time": started}
+        met = self._check_condition(w.condition, ctx)
+        action_results = []
+        if met:
+            for name, spec in w.actions.items():
+                action_results.append(
+                    self._run_action(name, spec, ctx))
+        self.execution_count += 1
+        result = {
+            "watch_id": watch_id,
+            "_id": execution_id,
+            "state": ("executed" if met else "execution_not_needed"),
+            "condition_met": met,
+            "result": {
+                "input": {"payload": payload},
+                "condition": {"met": met},
+                "actions": action_results,
+            },
+        }
+        w.status["execution_state"] = result["state"]
+        w.status["last_checked"] = int(started * 1000)
+        if met:
+            w.status["last_met_condition"] = int(started * 1000)
+        if record:
+            self._record_history(result)
+        return result
+
+    def _run_input(self, input_spec: Dict[str, Any]) -> Dict[str, Any]:
+        if "search" in input_spec:
+            req = input_spec["search"].get("request", {})
+            indices = req.get("indices", ["_all"])
+            if isinstance(indices, str):
+                indices = [indices]
+            body = req.get("body", {})
+            return self.node.search_service.search(
+                ",".join(indices), body)
+        if "simple" in input_spec:
+            return dict(input_spec["simple"])
+        if "http" in input_spec:
+            # zero-egress build: record the intent, return empty payload
+            return {"_http_request": input_spec["http"].get("request", {})}
+        return {}
+
+    def _check_condition(self, cond: Dict[str, Any],
+                         ctx: Dict[str, Any]) -> bool:
+        if "always" in cond:
+            return True
+        if "never" in cond:
+            return False
+        if "compare" in cond:
+            for path, check in cond["compare"].items():
+                actual = _path_get(ctx, path)
+                for op, expected in check.items():
+                    if not self._compare(actual, op, expected):
+                        return False
+            return True
+        if "array_compare" in cond:
+            for path, spec in cond["array_compare"].items():
+                arr = _path_get(ctx, path) or []
+                field = spec.get("path", "")
+                for op, body in ((k, v) for k, v in spec.items()
+                                 if k != "path"):
+                    expected = body.get("value")
+                    quantifier = body.get("quantifier", "some")
+                    hits = [self._compare(
+                        _path_get(e, field) if field else e, op, expected)
+                        for e in arr]
+                    ok = (all(hits) if quantifier == "all"
+                          else any(hits))
+                    if not ok:
+                        return False
+            return True
+        if "script" in cond:
+            # restricted expression over ctx (the painless-lite family)
+            src = cond["script"]
+            if isinstance(src, dict):
+                src = src.get("source", "true")
+            return bool(self._eval_script(src, ctx))
+        raise IllegalArgumentException(
+            f"Unknown condition type {list(cond)}")
+
+    @staticmethod
+    def _compare(actual, op: str, expected) -> bool:
+        if op == "eq":
+            return actual == expected
+        if op == "not_eq":
+            return actual != expected
+        if actual is None:
+            return False
+        try:
+            if op == "gt":
+                return actual > expected
+            if op == "gte":
+                return actual >= expected
+            if op == "lt":
+                return actual < expected
+            if op == "lte":
+                return actual <= expected
+        except TypeError:
+            return False
+        raise IllegalArgumentException(f"Unknown compare op [{op}]")
+
+    @staticmethod
+    def _eval_script(src: str, ctx: Dict[str, Any]) -> Any:
+        """Script conditions parse through the shared QL expression core
+        and evaluate against ctx.* paths — a closed expression language,
+        never the host interpreter (the Painless-sandbox discipline)."""
+        from elasticsearch_tpu.xpack import sql as _sql
+
+        try:
+            parser = _sql.Parser(src)
+            expr = parser._expr()
+            from elasticsearch_tpu.xpack.ql import evaluate
+            return bool(evaluate(
+                expr, lambda path: _path_get({"ctx": ctx}, path)))
+        except Exception:
+            return False
+
+    def _run_action(self, name: str, spec: Dict[str, Any],
+                    ctx: Dict[str, Any]) -> Dict[str, Any]:
+        (atype, body), = ((k, v) for k, v in spec.items()
+                          if k not in ("condition", "transform",
+                                       "throttle_period"))
+        if atype == "logging":
+            text = self._render(body.get("text", ""), ctx)
+            return {"id": name, "type": "logging",
+                    "status": "success",
+                    "logging": {"logged_text": text}}
+        if atype == "index":
+            index = body.get("index")
+            doc = {"watch_id": ctx["watch_id"],
+                   "payload": ctx["payload"],
+                   "timestamp": int(time.time() * 1000)}
+            if index not in self.node.indices_service.indices:
+                self.node.indices_service.create_index(index, {}, None)
+            idx = self.node.indices_service.get(index)
+            idx.index_doc(uuid.uuid4().hex, doc)
+            idx.refresh()
+            return {"id": name, "type": "index", "status": "success",
+                    "index": {"response": {"index": index}}}
+        if atype == "webhook":
+            # zero-egress: record the rendered request, do not send
+            return {"id": name, "type": "webhook", "status": "simulated",
+                    "webhook": {"request": body}}
+        return {"id": name, "type": atype, "status": "simulated"}
+
+    @staticmethod
+    def _render(template: str, ctx: Dict[str, Any]) -> str:
+        def sub(m):
+            v = _path_get({"ctx": ctx}, m.group(1).strip())
+            return "" if v is None else str(v)
+        return re.sub(r"\{\{(.+?)\}\}", sub, template)
+
+    def _record_history(self, result: Dict[str, Any]):
+        if self.HISTORY_INDEX not in self.node.indices_service.indices:
+            self.node.indices_service.create_index(
+                self.HISTORY_INDEX, {}, None)
+        idx = self.node.indices_service.get(self.HISTORY_INDEX)
+        idx.index_doc(result["_id"], {
+            "watch_id": result["watch_id"],
+            "state": result["state"],
+            "result_condition_met": result["condition_met"],
+            "timestamp": int(time.time() * 1000)})
+        idx.refresh()
+
+    def stats(self) -> Dict[str, Any]:
+        return {"watcher_state": self._state,
+                "watch_count": len(self.watches),
+                "execution_count": self.execution_count}

@@ -1,0 +1,162 @@
+"""Cross-cluster search + replication tests: two REAL nodes over HTTP
+(model: qa/multi-cluster-search and x-pack CCR IT discipline — a live
+leader and follower cluster wired via remote-cluster settings)."""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def clusters(tmp_path):
+    """(local_node, remote_node) with remote registered as 'remote1'."""
+    local = Node(data_path=str(tmp_path / "local"))
+    remote = Node(data_path=str(tmp_path / "remote"))
+    rport = remote.start(0)
+    local.remote_cluster_service.register("remote1",
+                                          [f"127.0.0.1:{rport}"])
+    yield local, remote
+    local.close()
+    remote.close()
+
+
+def call(node, method, path, body=None, expect=200, **params):
+    status, r = node.rest_controller.dispatch(method, path, params, body)
+    assert status == expect, r
+    return r
+
+
+def _seed(node, index, docs, mappings=None):
+    node.indices_service.create_index(index, {}, mappings or {
+        "properties": {"title": {"type": "text"},
+                       "rank": {"type": "long"}}})
+    idx = node.indices_service.get(index)
+    for i, d in enumerate(docs):
+        idx.index_doc(str(i), d)
+    idx.refresh()
+    return idx
+
+
+def test_remote_info_and_settings(clusters):
+    local, remote = clusters
+    r = call(local, "GET", "/_remote/info")
+    assert r["remote1"]["connected"] is True
+    # registration via the settings API works too
+    call(local, "PUT", "/_cluster/settings", {"persistent": {
+        "cluster.remote.second.seeds": r["remote1"]["seeds"]}})
+    r = call(local, "GET", "/_remote/info")
+    assert "second" in r
+
+
+def test_ccs_merges_hits(clusters):
+    local, remote = clusters
+    _seed(local, "books", [{"title": "local one", "rank": 10},
+                           {"title": "local two", "rank": 30}])
+    _seed(remote, "books", [{"title": "remote one", "rank": 20},
+                            {"title": "remote two", "rank": 40}])
+    r = call(local, "POST", "/books,remote1:books/_search", {
+        "size": 10, "sort": [{"rank": {"order": "desc"}}]})
+    assert r["hits"]["total"]["value"] == 4
+    ranks = [h["sort"][0] for h in r["hits"]["hits"]]
+    assert ranks == [40, 30, 20, 10]
+    indices = [h["_index"] for h in r["hits"]["hits"]]
+    assert indices == ["remote1:books", "books", "remote1:books", "books"]
+
+
+def test_ccs_remote_only_by_score(clusters):
+    local, remote = clusters
+    _seed(remote, "docs", [{"title": "alpha match match", "rank": 1},
+                           {"title": "alpha", "rank": 2}])
+    r = call(local, "POST", "/remote1:docs/_search", {
+        "query": {"match": {"title": {"query": "match"}}}})
+    assert r["hits"]["total"]["value"] == 1
+    assert r["hits"]["hits"][0]["_index"] == "remote1:docs"
+
+
+def test_ccr_follow_and_tail(clusters):
+    local, remote = clusters
+    ridx = _seed(remote, "leader", [{"title": "first", "rank": 1}])
+    r = call(local, "PUT", "/follower/_ccr/follow", {
+        "remote_cluster": "remote1", "leader_index": "leader"})
+    assert r["index_following_started"] is True
+    got = local.search_service.search("follower", {"size": 10})
+    assert got["hits"]["total"]["value"] == 1
+
+    # new leader writes flow to the follower via the poll loop
+    ridx.index_doc("n1", {"title": "second", "rank": 2})
+    ridx.refresh()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        local.ccr_service.sync("follower")
+        got = local.search_service.search("follower", {"size": 10})
+        if got["hits"]["total"]["value"] == 2:
+            break
+        time.sleep(0.1)
+    assert got["hits"]["total"]["value"] == 2
+
+    # deletes replicate too
+    ridx.delete_doc("0")
+    ridx.refresh()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        local.ccr_service.sync("follower")
+        got = local.search_service.search("follower", {"size": 10})
+        if got["hits"]["total"]["value"] == 1:
+            break
+        time.sleep(0.1)
+    assert got["hits"]["total"]["value"] == 1
+    assert got["hits"]["hits"][0]["_source"]["title"] == "second"
+
+    stats = call(local, "GET", "/_ccr/stats")
+    shard_stats = stats["follow_stats"]["indices"][0]["shards"][0]
+    assert shard_stats["operations_written"] >= 3
+
+
+def test_ccr_pause_resume_unfollow(clusters):
+    local, remote = clusters
+    ridx = _seed(remote, "leader", [{"title": "a", "rank": 1}])
+    call(local, "PUT", "/follower/_ccr/follow", {
+        "remote_cluster": "remote1", "leader_index": "leader"})
+    call(local, "POST", "/follower/_ccr/pause_follow")
+    ridx.index_doc("x", {"title": "b", "rank": 2})
+    ridx.refresh()
+    assert local.ccr_service.sync("follower") == 0     # paused
+    call(local, "POST", "/follower/_ccr/resume_follow")
+    got = local.search_service.search("follower", {"size": 10})
+    assert got["hits"]["total"]["value"] == 2
+    info = call(local, "GET", "/follower/_ccr/info")
+    assert info["follower_indices"][0]["status"] == "active"
+    call(local, "POST", "/follower/_ccr/unfollow")
+    call(local, "GET", "/follower/_ccr/info", expect=404)
+
+
+def test_ccr_auto_follow(clusters):
+    local, remote = clusters
+    call(local, "PUT", "/_ccr/auto_follow/metrics-pattern", {
+        "remote_cluster": "remote1",
+        "leader_index_patterns": ["metrics-*"],
+        "follow_index_pattern": "copy-{{leader_index}}"})
+    _seed(remote, "metrics-2026", [{"title": "m", "rank": 1}])
+    local.ccr_service.scan_auto_follow()
+    assert "copy-metrics-2026" in local.ccr_service.tasks
+    got = local.search_service.search("copy-metrics-2026", {"size": 10})
+    assert got["hits"]["total"]["value"] == 1
+    r = call(local, "GET", "/_ccr/auto_follow")
+    assert r["patterns"][0]["name"] == "metrics-pattern"
+    call(local, "DELETE", "/_ccr/auto_follow/metrics-pattern")
+    call(local, "GET", "/_ccr/auto_follow/metrics-pattern", expect=404)
+
+
+def test_remote_settings_partial_update_keeps_connection(clusters):
+    local, remote = clusters
+    info = call(local, "GET", "/_remote/info")
+    call(local, "PUT", "/_cluster/settings", {"persistent": {
+        "cluster.remote.remote1.skip_unavailable": True}})
+    info2 = call(local, "GET", "/_remote/info")
+    assert "remote1" in info2 and info2["remote1"]["connected"]
+    # explicit null removes the connection
+    call(local, "PUT", "/_cluster/settings", {"persistent": {
+        "cluster.remote.remote1.seeds": None}})
+    assert "remote1" not in call(local, "GET", "/_remote/info")

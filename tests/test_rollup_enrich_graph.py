@@ -228,3 +228,25 @@ def test_ml_post_data_empty_body_is_400(node):
         "data_description": {"time_field": "ts"}})
     call(node, "POST", "/_ml/anomaly_detectors/j9/_open")
     call(node, "POST", "/_ml/anomaly_detectors/j9/_data", None, expect=400)
+
+
+def test_rollup_search_query_translation(node):
+    _metrics_index(node)
+    call(node, "PUT", "/_rollup/job/cpu_daily", ROLLUP_JOB)
+    call(node, "POST", "/_rollup/job/cpu_daily/_start")
+    # query on ORIGINAL field names must hit the flattened rollup fields
+    r = call(node, "POST", "/metrics_rollup/_rollup_search", {
+        "query": {"term": {"host": {"value": "a"}}},
+        "aggs": {"days": {
+            "date_histogram": {"field": "ts", "calendar_interval": "1d"},
+            "aggs": {"mx": {"max": {"field": "cpu"}}}}}})
+    buckets = r["aggregations"]["days"]["buckets"]
+    assert len(buckets) == 3
+    for b in buckets:
+        assert b["mx"]["value"] == 13.0       # host a only
+    r = call(node, "POST", "/metrics_rollup/_rollup_search", {
+        "query": {"range": {"ts": {"gte": DAY}}},
+        "aggs": {"days": {
+            "date_histogram": {"field": "ts",
+                               "calendar_interval": "1d"}}}})
+    assert len(r["aggregations"]["days"]["buckets"]) == 2

@@ -1,0 +1,148 @@
+"""Watcher + monitoring plugin tests (model: x-pack watcher execution
+tests and monitoring collector/exporter tests)."""
+
+import time
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(data_path=str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def call(node, method, path, body=None, expect=200, **params):
+    status, r = node.rest_controller.dispatch(method, path, params, body)
+    assert status == expect, r
+    return r
+
+
+def _errors_index(node, n_errors=3):
+    node.indices_service.create_index("logs", {}, {
+        "properties": {"level": {"type": "keyword"},
+                       "msg": {"type": "text"}}})
+    idx = node.indices_service.get("logs")
+    for i in range(n_errors):
+        idx.index_doc(f"e{i}", {"level": "error", "msg": f"boom {i}"})
+    idx.index_doc("ok", {"level": "info", "msg": "fine"})
+    idx.refresh()
+
+
+WATCH = {
+    "trigger": {"schedule": {"interval": "10m"}},
+    "input": {"search": {"request": {
+        "indices": ["logs"],
+        "body": {"query": {"term": {"level": {"value": "error"}}},
+                 "size": 0, "track_total_hits": True}}}},
+    "condition": {"compare": {
+        "payload.hits.total.value": {"gte": 3}}},
+    "actions": {
+        "note": {"logging": {
+            "text": "found {{ctx.payload.hits.total.value}} errors"}},
+        "store": {"index": {"index": "alerts"}},
+    },
+}
+
+
+def test_watch_crud(node):
+    r = call(node, "PUT", "/_watcher/watch/errors", WATCH, expect=201)
+    assert r["created"] is True
+    r = call(node, "GET", "/_watcher/watch/errors")
+    assert r["watch"]["condition"] == WATCH["condition"]
+    r = call(node, "PUT", "/_watcher/watch/errors", WATCH, expect=201)
+    assert r["created"] is False
+    call(node, "DELETE", "/_watcher/watch/errors")
+    call(node, "GET", "/_watcher/watch/errors", expect=404)
+
+
+def test_watch_execute_condition_met(node):
+    _errors_index(node)
+    call(node, "PUT", "/_watcher/watch/errors", WATCH, expect=201)
+    r = call(node, "POST", "/_watcher/watch/errors/_execute")
+    rec = r["watch_record"]
+    assert rec["state"] == "executed"
+    assert rec["result"]["condition"]["met"] is True
+    logging_result = next(a for a in rec["result"]["actions"]
+                          if a["id"] == "note")
+    assert logging_result["logging"]["logged_text"] == "found 3 errors"
+    # the index action wrote an alert doc
+    r = node.search_service.search("alerts", {"size": 10})
+    assert r["hits"]["total"]["value"] == 1
+    assert r["hits"]["hits"][0]["_source"]["watch_id"] == "errors"
+
+
+def test_watch_execute_condition_not_met(node):
+    _errors_index(node, n_errors=1)
+    call(node, "PUT", "/_watcher/watch/errors", WATCH, expect=201)
+    r = call(node, "POST", "/_watcher/watch/errors/_execute")
+    assert r["watch_record"]["state"] == "execution_not_needed"
+    assert "alerts" not in node.indices_service.indices
+
+
+def test_watch_scheduler_fires(node):
+    _errors_index(node)
+    w = dict(WATCH)
+    w["trigger"] = {"schedule": {"interval": "200ms"}}
+    call(node, "PUT", "/_watcher/watch/fast", w, expect=201)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if "alerts" in node.indices_service.indices:
+            break
+        time.sleep(0.1)
+    assert "alerts" in node.indices_service.indices
+    # history records were written by scheduled runs
+    r = node.search_service.search(".watcher-history", {"size": 10})
+    assert r["hits"]["total"]["value"] >= 1
+
+
+def test_watch_activate_deactivate(node):
+    call(node, "PUT", "/_watcher/watch/w1", WATCH, expect=201)
+    r = call(node, "PUT", "/_watcher/watch/w1/_deactivate")
+    assert r["status"]["state"]["active"] is False
+    r = call(node, "PUT", "/_watcher/watch/w1/_activate")
+    assert r["status"]["state"]["active"] is True
+
+
+def test_watch_script_condition_and_stats(node):
+    _errors_index(node)
+    w = dict(WATCH)
+    w["condition"] = {"script": "ctx.payload.hits.total.value > 2"}
+    call(node, "PUT", "/_watcher/watch/s1", w, expect=201)
+    r = call(node, "POST", "/_watcher/watch/s1/_execute")
+    assert r["watch_record"]["state"] == "executed"
+    stats = call(node, "GET", "/_watcher/stats")
+    assert stats["execution_count"] >= 1
+    assert stats["watch_count"] == 1
+
+
+def test_monitoring_collect_and_bulk(node):
+    _errors_index(node)
+    r = call(node, "POST", "/_monitoring/_collect")
+    assert r["collected"] >= 2              # index_stats + node_stats
+    got = node.search_service.search(".monitoring-es", {
+        "size": 50, "query": {"term": {"type.keyword": {"value": "node_stats"}}}})
+    assert got["hits"]["total"]["value"] == 1
+    src = got["hits"]["hits"][0]["_source"]
+    assert src["node_stats"]["indices"]["docs"]["count"] == 4
+
+    call(node, "POST", "/_monitoring/bulk",
+         [{"type": "kibana_stats", "kibana": {"uuid": "k1"}}],
+         system_id="kibana")
+    got = node.search_service.search(".monitoring-es", {
+        "size": 50, "query": {"term": {"type.keyword": {"value": "kibana_stats"}}}})
+    assert got["hits"]["total"]["value"] == 1
+
+
+def test_watch_script_condition_is_sandboxed(node):
+    _errors_index(node)
+    w = dict(WATCH)
+    # an interpreter-escape attempt must evaluate to False, not execute
+    w["condition"] = {"script":
+                      "().__class__.__base__.__subclasses__()"}
+    call(node, "PUT", "/_watcher/watch/evil", w, expect=201)
+    r = call(node, "POST", "/_watcher/watch/evil/_execute")
+    assert r["watch_record"]["state"] == "execution_not_needed"
