@@ -50,6 +50,24 @@ class IndexNotFoundException(ElasticsearchTpuException):
         self.index = index
 
 
+class ClusterBlockException(ElasticsearchTpuException):
+    """An index/cluster-level block rejected the operation (ref:
+    cluster/block/ClusterBlockException — closed indices, read-only
+    blocks)."""
+
+    status = 403
+
+
+class IndexClosedException(ElasticsearchTpuException):
+    """Read against an explicitly named closed index (ref:
+    indices/IndexClosedException)."""
+
+    status = 400
+
+    def __init__(self, index: str):
+        super().__init__(f"closed index [{index}]", index=index)
+
+
 class ResourceAlreadyExistsException(ElasticsearchTpuException):
     status = 400
 

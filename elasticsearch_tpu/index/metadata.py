@@ -522,8 +522,14 @@ def resize_index(indices_service, source_name: str, target_name: str,
         raise IllegalArgumentException(
             f"the number of target shards [{n_target}] must be greater than "
             f"the number of source shards [{src.num_shards}]")
-    merged_settings = {k: v for k, v in src.settings.as_dict().items()}
-    merged_settings.update(settings)
+    # the source's write block (set before a resize, ref: ResizeRequest
+    # requires a read-only source) must not be inherited DURING the copy —
+    # explicitly requested blocks apply after the docs land
+    merged_settings = {k: v for k, v in src.settings.as_dict().items()
+                       if not k.startswith("index.blocks.")
+                       and k != "index.state"}
+    merged_settings.update({k: v for k, v in settings.items()
+                            if not k.startswith("index.blocks.")})
     merged_settings["index.number_of_shards"] = n_target
     target = indices_service.create_index(
         target_name, merged_settings, src.mapper.to_mapping())
@@ -537,6 +543,10 @@ def resize_index(indices_service, source_name: str, target_name: str,
                 target.index_doc(doc_id, source)
     target.refresh()
     target.flush()
+    requested_blocks = {k: v for k, v in settings.items()
+                        if k.startswith("index.blocks.")}
+    if requested_blocks:
+        target.update_settings(requested_blocks)
     return target
 
 

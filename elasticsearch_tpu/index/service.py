@@ -128,6 +128,36 @@ class IndexService:
         self.settings = Settings(merged)
         self._persist_meta()
 
+    # ------------------------------------------------------- state blocks
+    @property
+    def is_closed(self) -> bool:
+        """Closed indices hold their data but serve no reads/writes
+        (ref: MetadataIndexStateService close/open)."""
+        return str(self.settings.get("index.state", "open")) == "close"
+
+    @property
+    def is_frozen(self) -> bool:
+        """Frozen indices are searchable but keep no device-resident
+        state between searches (ref: x-pack frozen-indices FrozenEngine's
+        per-search reader — here: per-search HBM residency)."""
+        return str(self.settings.get("index.frozen",
+                                     "false")).lower() == "true"
+
+    @property
+    def write_blocked(self) -> bool:
+        for key in ("index.blocks.write", "index.blocks.read_only"):
+            if str(self.settings.get(key, "false")).lower() == "true":
+                return True
+        return self.is_closed
+
+    def check_write_block(self):
+        if self.write_blocked:
+            from elasticsearch_tpu.common.errors import (
+                ClusterBlockException)
+            reason = ("closed" if self.is_closed else "read-only")
+            raise ClusterBlockException(
+                f"index [{self.name}] blocked: {reason}")
+
     # ------------------------------------------------------------ routing
     def shard_for(self, doc_id: str, routing: Optional[str] = None) -> int:
         key = routing if routing is not None else doc_id
@@ -136,6 +166,7 @@ class IndexService:
     # ------------------------------------------------------------- writes
     def index_doc(self, doc_id: str, source: Dict[str, Any],
                   routing: Optional[str] = None, **kwargs):
+        self.check_write_block()
         if routing is None:
             # child docs route by parent id so they land on the parent's
             # shard (see DocumentMapper.join_parent_routing)
@@ -149,9 +180,14 @@ class IndexService:
         return result
 
     def delete_doc(self, doc_id: str, routing: Optional[str] = None, **kwargs):
+        self.check_write_block()
         return self.shards[self.shard_for(doc_id, routing)].delete(doc_id, **kwargs)
 
     def get_doc(self, doc_id: str, routing: Optional[str] = None):
+        if self.is_closed:
+            from elasticsearch_tpu.common.errors import (
+                IndexClosedException)
+            raise IndexClosedException(self.name)
         return self.shards[self.shard_for(doc_id, routing)].get(doc_id)
 
     def refresh(self):
@@ -280,11 +316,16 @@ class IndicesService:
         for listener in self.delete_listeners:
             listener(name)
 
-    def resolve(self, expression: str) -> List[str]:
+    def resolve(self, expression: str,
+                allow_closed: bool = False) -> List[str]:
         """Index name expression: csv, wildcards, _all (ref:
-        IndexNameExpressionResolver)."""
+        IndexNameExpressionResolver). Wildcards expand over open indices
+        (expand_wildcards=open default); explicitly named closed indices
+        raise unless the caller is an admin path (allow_closed)."""
         if expression in ("_all", "*", ""):
-            return sorted(self.indices)
+            return sorted(n for n in self.indices
+                          if allow_closed
+                          or not self.indices[n].is_closed)
         out = []
         import fnmatch
         for part in expression.split(","):
@@ -298,7 +339,9 @@ class IndicesService:
                     continue
             if "*" in part or "?" in part:
                 matched = {n for n in self.indices
-                           if fnmatch.fnmatch(n, part)}
+                           if fnmatch.fnmatch(n, part)
+                           and (allow_closed
+                                or not self.indices[n].is_closed)}
                 # wildcards also expand over aliases/data streams (ref:
                 # IndexNameExpressionResolver WildcardExpressionResolver)
                 if self.abstraction_lister is not None:
@@ -309,6 +352,10 @@ class IndicesService:
             else:
                 if part not in self.indices:
                     raise IndexNotFoundException(part)
+                if self.indices[part].is_closed and not allow_closed:
+                    from elasticsearch_tpu.common.errors import (
+                        IndexClosedException)
+                    raise IndexClosedException(part)
                 out.append(part)
         seen = set()
         uniq = []

@@ -1,0 +1,173 @@
+"""GeoIP + user-agent ingest processors.
+
+Mirrors the reference's ingest-geoip and ingest-user-agent modules (ref:
+modules/ingest-geoip — MaxMind GeoLite2 lookups; modules/ingest-user-agent
+— UA-parser regexes; SURVEY.md §2.4). Re-design for this zero-egress
+engine: `geoip` resolves against a user-supplied JSON database file
+(list of {network, ...geo fields} entries, the GeoLite2-equivalent the
+operator provides) plus built-in entries for reserved/documentation
+ranges so the processor is exercisable without any external database;
+`user_agent` is a regex classifier covering the mainstream browser/bot
+families (the ua-parser core patterns re-expressed)."""
+
+from __future__ import annotations
+
+import ipaddress
+import json
+import re
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.ingest.service import (
+    IngestProcessorException,
+    processor,
+)
+
+# documentation/reserved ranges (RFC 5737/3849) — usable without any
+# database file, handy for tests and pipeline dry-runs
+_BUILTIN_DB: List[Dict[str, Any]] = [
+    {"network": "192.0.2.0/24", "country_iso_code": "ZZ",
+     "country_name": "TEST-NET-1", "city_name": "Example City",
+     "location": {"lat": 0.0, "lon": 0.0}},
+    {"network": "198.51.100.0/24", "country_iso_code": "ZZ",
+     "country_name": "TEST-NET-2"},
+    {"network": "203.0.113.0/24", "country_iso_code": "ZZ",
+     "country_name": "TEST-NET-3"},
+]
+
+
+class _GeoDb:
+    def __init__(self, entries: List[Dict[str, Any]]):
+        self.nets = []
+        for e in entries:
+            try:
+                net = ipaddress.ip_network(e["network"])
+            except (KeyError, ValueError):
+                continue
+            self.nets.append((net, {k: v for k, v in e.items()
+                                    if k != "network"}))
+        # longest prefix first so specific entries win
+        self.nets.sort(key=lambda nv: -nv[0].prefixlen)
+
+    def lookup(self, ip: str) -> Optional[Dict[str, Any]]:
+        try:
+            addr = ipaddress.ip_address(ip)
+        except ValueError:
+            return None
+        for net, data in self.nets:
+            if addr in net:
+                return data
+        if addr.is_private:
+            return {"country_iso_code": "ZZ", "country_name": "Private"}
+        return None
+
+
+@processor("geoip")
+def _geoip(cfg, svc):
+    field = cfg["field"]
+    target = cfg.get("target_field", "geoip")
+    ignore_missing = bool(cfg.get("ignore_missing", False))
+    properties = cfg.get("properties")
+    entries = list(_BUILTIN_DB)
+    db_file = cfg.get("database_file")
+    if db_file:
+        with open(db_file) as fh:
+            entries = json.load(fh) + entries
+    db = _GeoDb(entries)
+
+    def fn(doc):
+        ip = doc.get(field)
+        if ip is None:
+            if ignore_missing:
+                return
+            raise IngestProcessorException(
+                f"field [{field}] not present")
+        data = db.lookup(str(ip))
+        if data is None:
+            return                       # address not in the database
+        if properties:
+            data = {k: v for k, v in data.items() if k in properties}
+        doc.set(target, data)
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# user agent
+# ---------------------------------------------------------------------------
+
+_UA_PATTERNS = [
+    # (name, regex with version group)
+    ("Edge", r"Edge?/(\d+[\w.]*)"),
+    ("Opera", r"(?:Opera|OPR)/(\d+[\w.]*)"),
+    ("Chrome Mobile", r"Chrome/(\d+[\w.]*) Mobile"),
+    ("Chrome", r"Chrome/(\d+[\w.]*)"),
+    ("Firefox", r"Firefox/(\d+[\w.]*)"),
+    ("MSIE", r"MSIE (\d+[\w.]*)"),
+    ("IE", r"Trident/.*rv:(\d+[\w.]*)"),
+    ("Mobile Safari", r"Version/(\d+[\w.]*).*Mobile.*Safari"),
+    ("Safari", r"Version/(\d+[\w.]*).*Safari"),
+    ("curl", r"curl/(\d+[\w.]*)"),
+    ("wget", r"[Ww]get/(\d+[\w.]*)"),
+    ("Googlebot", r"Googlebot/(\d+[\w.]*)"),
+    ("bingbot", r"bingbot/(\d+[\w.]*)"),
+]
+
+_OS_PATTERNS = [
+    ("Windows", r"Windows NT (\d+[\d.]*)"),
+    ("Android", r"Android (\d+[\w.]*)"),
+    ("iOS", r"iPhone OS (\d+[_\w]*)"),
+    ("iOS", r"CPU OS (\d+[_\w]*)"),
+    ("Mac OS X", r"Mac OS X (\d+[_\w.]*)"),
+    ("Linux", r"Linux"),
+    ("Chrome OS", r"CrOS"),
+]
+
+
+def parse_user_agent(ua: str) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"name": "Other", "device": {"name": "Other"}}
+    for name, pat in _UA_PATTERNS:
+        m = re.search(pat, ua)
+        if m:
+            out["name"] = name
+            out["version"] = m.group(1)
+            parts = m.group(1).replace("_", ".").split(".")
+            out["major"] = parts[0]
+            if len(parts) > 1:
+                out["minor"] = parts[1]
+            break
+    for os_name, pat in _OS_PATTERNS:
+        m = re.search(pat, ua)
+        if m:
+            version = (m.group(1).replace("_", ".")
+                       if m.groups() else None)
+            out["os"] = {"name": os_name}
+            if version:
+                out["os"]["version"] = version
+                out["os"]["full"] = f"{os_name} {version}"
+            break
+    if "Mobile" in ua or "iPhone" in ua or "Android" in ua:
+        out["device"] = {"name": ("iPhone" if "iPhone" in ua
+                                  else "Generic Smartphone")}
+    if any(b in out["name"] for b in ("bot", "Googlebot", "bingbot")):
+        out["device"] = {"name": "Spider"}
+    return out
+
+
+@processor("user_agent")
+def _user_agent(cfg, svc):
+    field = cfg["field"]
+    target = cfg.get("target_field", "user_agent")
+    ignore_missing = bool(cfg.get("ignore_missing", False))
+    properties = cfg.get("properties")
+
+    def fn(doc):
+        ua = doc.get(field)
+        if ua is None:
+            if ignore_missing:
+                return
+            raise IngestProcessorException(
+                f"field [{field}] not present")
+        data = parse_user_agent(str(ua))
+        if properties:
+            data = {k: v for k, v in data.items() if k in properties}
+        doc.set(target, data)
+    return fn

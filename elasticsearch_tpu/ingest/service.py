@@ -1034,3 +1034,8 @@ class IngestService:
             except ElasticsearchTpuException as e:
                 results.append({"error": e.to_xcontent()})
         return {"docs": results}
+
+
+# geoip/user_agent processors register on import (they live in their own
+# module the way ingest-geoip/ingest-user-agent are separate modules)
+from elasticsearch_tpu.ingest import geo_ua  # noqa: E402,F401

@@ -392,6 +392,7 @@ class RepositoriesService:
     def __init__(self, data_path: Optional[str] = None):
         self._repos: Dict[str, BlobStoreRepository] = {}
         self._configs: Dict[str, Dict[str, Any]] = {}
+        self._data_path = data_path
         self._path = (os.path.join(data_path, "_repositories.json")
                       if data_path else None)
         if data_path:
@@ -414,6 +415,10 @@ class RepositoriesService:
         if location.startswith("file:"):
             location = location[len("file:"):].lstrip("/")
             location = "/" + location
+        if not os.path.isabs(location) and self._data_path:
+            # relative locations resolve under the node's repo root, not
+            # the process CWD (ref: path.repo resolution in Environment)
+            location = os.path.join(self._data_path, "repos", location)
         self._repos[name] = BlobStoreRepository(
             name, location, readonly=(rtype == "url"
                                       or settings.get("readonly", False)))

@@ -227,6 +227,38 @@ def _register_all(c: RestController):
     c.register("DELETE", "/_snapshot/{repo}/{snap}", delete_snapshot)
     c.register("POST", "/_snapshot/{repo}/{snap}/_restore", restore_snapshot)
     # transform
+    # index state: open/close, freeze/unfreeze (ref:
+    # MetadataIndexStateService; x-pack frozen-indices)
+    c.register("POST", "/{index}/_close", close_index)
+    c.register("POST", "/{index}/_open", open_index)
+    c.register("POST", "/{index}/_freeze", freeze_index)
+    c.register("POST", "/{index}/_unfreeze", unfreeze_index)
+    # searchable snapshots (ref: x-pack searchable-snapshots)
+    c.register("POST", "/_snapshot/{repo}/{snap}/_mount", mount_snapshot)
+    c.register("GET", "/_searchable_snapshots/stats",
+               searchable_snapshot_stats)
+    # nodes diagnostics + deprecation + autoscaling
+    c.register("GET", "/_nodes/hot_threads", hot_threads)
+    c.register("GET", "/_migration/deprecations", deprecations)
+    c.register("PUT", "/_autoscaling/policy/{name}", autoscaling_put)
+    c.register("GET", "/_autoscaling/policy/{name}", autoscaling_get)
+    c.register("DELETE", "/_autoscaling/policy/{name}",
+               autoscaling_delete)
+    c.register("GET", "/_autoscaling/capacity", autoscaling_capacity)
+    # extended _cat family (ref: rest/action/cat/)
+    c.register("GET", "/_cat/nodes", cat_nodes)
+    c.register("GET", "/_cat/master", cat_master)
+    c.register("GET", "/_cat/allocation", cat_allocation)
+    c.register("GET", "/_cat/templates", cat_templates)
+    c.register("GET", "/_cat/plugins", cat_plugins)
+    c.register("GET", "/_cat/thread_pool", cat_thread_pool)
+    c.register("GET", "/_cat/pending_tasks", cat_pending_tasks)
+    c.register("GET", "/_cat/segments", cat_segments)
+    c.register("GET", "/_cat/recovery", cat_recovery)
+    c.register("GET", "/_cat/repositories", cat_repositories)
+    c.register("GET", "/_cat/snapshots/{repo}", cat_snapshots)
+    c.register("GET", "/_cat/tasks", cat_tasks)
+    c.register("GET", "/_cat/nodeattrs", cat_nodeattrs)
     # cluster settings + remote clusters (ref: RemoteClusterService)
     c.register("PUT", "/_cluster/settings", put_cluster_settings)
     c.register("GET", "/_cluster/settings", get_cluster_settings)
@@ -506,14 +538,14 @@ def create_index(node, params, body, index):
 
 
 def delete_index(node, params, body, index):
-    for name in node.indices_service.resolve(index):
+    for name in node.indices_service.resolve(index, allow_closed=True):
         node.indices_service.delete_index(name)
     return 200, {"acknowledged": True}
 
 
 def get_index(node, params, body, index):
     out = {}
-    for name in node.indices_service.resolve(index):
+    for name in node.indices_service.resolve(index, allow_closed=True):
         idx = node.indices_service.get(name)
         out[name] = {"mappings": idx.mapper.to_mapping(),
                      "settings": {"index": idx.settings.by_prefix("index").as_nested_dict()}}
@@ -522,7 +554,8 @@ def get_index(node, params, body, index):
 
 def get_mapping(node, params, body, index):
     return 200, {name: {"mappings": node.indices_service.get(name).mapper.to_mapping()}
-                 for name in node.indices_service.resolve(index)}
+                 for name in node.indices_service.resolve(index,
+                                                          allow_closed=True)}
 
 
 def put_mapping(node, params, body, index):
@@ -534,7 +567,8 @@ def put_mapping(node, params, body, index):
 def get_settings(node, params, body, index):
     return 200, {name: {"settings": {"index": node.indices_service.get(name)
                                      .settings.by_prefix("index").as_nested_dict()}}
-                 for name in node.indices_service.resolve(index)}
+                 for name in node.indices_service.resolve(index,
+                                                          allow_closed=True)}
 
 
 def refresh_index(node, params, body, index):
@@ -2175,3 +2209,293 @@ def ccr_get_auto_follow_all(node, params, body):
 
 def ccr_delete_auto_follow(node, params, body, name):
     return 200, node.ccr_service.delete_auto_follow(name)
+
+
+# --------------------------------------------------------------------------
+# index state + searchable snapshots + diagnostics (operational layer)
+# --------------------------------------------------------------------------
+
+def close_index(node, params, body, index):
+    # idempotent: closing an already-closed index re-acknowledges
+    for name in node.indices_service.resolve(index, allow_closed=True):
+        idx = node.indices_service.get(name)
+        idx.update_settings({"index.state": "close"})
+        idx.device_cache.evict(idx._known_seg_names)
+    return 200, {"acknowledged": True, "shards_acknowledged": True}
+
+
+def open_index(node, params, body, index):
+    for name in node.indices_service.resolve(index, allow_closed=True):
+        node.indices_service.get(name).update_settings(
+            {"index.state": "open"})
+    return 200, {"acknowledged": True, "shards_acknowledged": True}
+
+
+def freeze_index(node, params, body, index):
+    for name in node.indices_service.resolve(index):
+        idx = node.indices_service.get(name)
+        idx.update_settings({"index.frozen": True,
+                             "index.blocks.write": True})
+        idx.device_cache.evict(idx._known_seg_names)
+    return 200, {"acknowledged": True, "shards_acknowledged": True}
+
+
+def unfreeze_index(node, params, body, index):
+    for name in node.indices_service.resolve(index):
+        node.indices_service.get(name).update_settings(
+            {"index.frozen": False, "index.blocks.write": False})
+    return 200, {"acknowledged": True, "shards_acknowledged": True}
+
+
+def mount_snapshot(node, params, body, repo, snap):
+    """ref: x-pack searchable-snapshots MountSearchableSnapshotAction —
+    a snapshot index mounted read-only; storage stays snapshot-backed
+    (restored segments + write block here)."""
+    body = body or {}
+    index = body.get("index")
+    if not index:
+        raise IllegalArgumentException("[index] is required")
+    renamed = body.get("renamed_index", index)
+    r = node.repositories_service.get_repository(repo)
+    r.restore(snap, node.indices_service, indices=[index],
+              rename_pattern=f"^{re.escape(index)}$",
+              rename_replacement=renamed)
+    idx = node.indices_service.get(renamed)
+    idx.update_settings({
+        "index.blocks.write": True,
+        "index.store.type": "snapshot",
+        "index.store.snapshot.repository_name": repo,
+        "index.store.snapshot.snapshot_name": snap,
+    })
+    return 200, {"snapshot": {"snapshot": snap,
+                              "indices": [renamed],
+                              "shards": {"total": idx.num_shards,
+                                         "failed": 0,
+                                         "successful": idx.num_shards}}}
+
+
+def searchable_snapshot_stats(node, params, body):
+    indices = {}
+    for name in node.indices_service.indices:
+        idx = node.indices_service.get(name)
+        if str(idx.settings.get("index.store.type", "")) == "snapshot":
+            indices[name] = {
+                "repository": idx.settings.get(
+                    "index.store.snapshot.repository_name"),
+                "snapshot": idx.settings.get(
+                    "index.store.snapshot.snapshot_name"),
+            }
+    return 200, {"total": len(indices), "indices": indices}
+
+
+def hot_threads(node, params, body):
+    """ref: monitor/jvm/HotThreads.java — stack dump of live threads,
+    busiest (here: all, main first) in the reference's text format."""
+    import sys
+    import threading as _threading
+    import traceback
+    frames = sys._current_frames()
+    lines = [f"::: {{{node.name}}}{{{node.node_id}}}", ""]
+    for t in _threading.enumerate():
+        f = frames.get(t.ident)
+        if f is None:
+            continue
+        lines.append(f"   {'100.0%' if t is _threading.main_thread() else '0.0%'} "
+                     f"cpu usage by thread '{t.name}'")
+        for fr in traceback.format_stack(f):
+            lines.extend("     " + ln for ln in fr.rstrip().splitlines())
+        lines.append("")
+    return 200, {"_cat": "\n".join(lines)}
+
+
+def deprecations(node, params, body):
+    """ref: x-pack deprecation plugin — settings/mapping checks."""
+    cluster_issues = []
+    index_issues = {}
+    for name in node.indices_service.indices:
+        idx = node.indices_service.get(name)
+        issues = []
+        if idx.is_frozen:
+            issues.append({
+                "level": "warning",
+                "message": "frozen indices are deprecated",
+                "details": "use searchable snapshots or the cold tier "
+                           "instead of freezing indices",
+                "url": "https://ela.st/es-deprecation-7-frozen-index"})
+        if issues:
+            index_issues[name] = issues
+    return 200, {"cluster_settings": cluster_issues,
+                 "node_settings": [],
+                 "index_settings": index_issues,
+                 "ml_settings": []}
+
+
+def _autoscaling_store(node) -> Dict[str, Dict[str, Any]]:
+    """Per-node persisted policy store (ref: autoscaling policies live in
+    cluster state)."""
+    import os
+    if not hasattr(node, "autoscaling_policies"):
+        path = os.path.join(node.data_path, "_autoscaling.json")
+        policies = {}
+        if os.path.exists(path):
+            with open(path) as fh:
+                policies = json.load(fh)
+        node.autoscaling_policies = policies
+        node._autoscaling_path = path
+    return node.autoscaling_policies
+
+
+def _autoscaling_persist(node):
+    import os
+    tmp = node._autoscaling_path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(node.autoscaling_policies, fh)
+    os.replace(tmp, node._autoscaling_path)
+
+
+def autoscaling_put(node, params, body, name):
+    _autoscaling_store(node)[name] = body or {}
+    _autoscaling_persist(node)
+    return 200, {"acknowledged": True}
+
+
+def autoscaling_get(node, params, body, name):
+    store = _autoscaling_store(node)
+    if name not in store:
+        raise ResourceNotFoundException(
+            f"autoscaling policy with name [{name}] does not exist")
+    return 200, {name: {"policy": store[name]}}
+
+
+def autoscaling_delete(node, params, body, name):
+    store = _autoscaling_store(node)
+    if name not in store:
+        raise ResourceNotFoundException(
+            f"autoscaling policy with name [{name}] does not exist")
+    del store[name]
+    _autoscaling_persist(node)
+    return 200, {"acknowledged": True}
+
+
+def autoscaling_capacity(node, params, body):
+    """ref: x-pack autoscaling GetAutoscalingCapacityAction — observed
+    usage drives the required capacity decision."""
+    total_docs = 0
+    storage = 0
+    for name in node.indices_service.indices:
+        idx = node.indices_service.get(name)
+        s = idx.stats()
+        total_docs += s["docs"]["count"]
+        storage += s.get("store", {}).get("size_in_bytes", 0)
+    policies = {}
+    for pname in _autoscaling_store(node):
+        policies[pname] = {
+            "required_capacity": {"total": {
+                "storage": int(storage * 1.25),
+                "memory": int(storage * 0.1)}},
+            "current_capacity": {"total": {"storage": storage}},
+            "current_nodes": [{"name": node.name}],
+            "deciders": {"observed_usage": {
+                "required_capacity": {"total": {
+                    "storage": int(storage * 1.25)}}}},
+        }
+    return 200, {"policies": policies}
+
+
+# --------------------------------------------------------------------------
+# extended _cat family (ref: rest/action/cat/)
+# --------------------------------------------------------------------------
+
+def cat_nodes(node, params, body):
+    import resource
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    return 200, {"_cat": (
+        f"127.0.0.1 {int(ru.ru_maxrss / 1024)} - dimr * {node.name}")}
+
+
+def cat_master(node, params, body):
+    return 200, {"_cat": f"{node.node_id} 127.0.0.1 127.0.0.1 {node.name}"}
+
+
+def cat_allocation(node, params, body):
+    n_shards = sum(node.indices_service.get(n).num_shards
+                   for n in node.indices_service.indices)
+    return 200, {"_cat": f"{n_shards} 127.0.0.1 127.0.0.1 {node.name}"}
+
+
+def cat_templates(node, params, body):
+    lines = []
+    for name, t in node.metadata_service.index_templates.items():
+        patterns = ",".join(t.get("index_patterns", []))
+        lines.append(f"{name} [{patterns}] {t.get('priority', 0)}")
+    return 200, {"_cat": "\n".join(lines)}
+
+
+def cat_plugins(node, params, body):
+    mods = ["sql", "eql", "ml", "watcher", "monitoring", "rollup",
+            "enrich", "graph", "ccr", "transform", "ilm", "security",
+            "async-search", "searchable-snapshots", "autoscaling"]
+    return 200, {"_cat": "\n".join(
+        f"{node.name} {m} {__version__}" for m in sorted(mods))}
+
+
+def cat_thread_pool(node, params, body):
+    import threading as _threading
+    pools = {}
+    for t in _threading.enumerate():
+        key = t.name.split("-")[0]
+        pools[key] = pools.get(key, 0) + 1
+    return 200, {"_cat": "\n".join(
+        f"{node.name} {k} {v} 0 0" for k, v in sorted(pools.items()))}
+
+
+def cat_pending_tasks(node, params, body):
+    return 200, {"_cat": ""}
+
+
+def cat_segments(node, params, body):
+    lines = []
+    for name in sorted(node.indices_service.indices):
+        idx = node.indices_service.get(name)
+        for si, shard in enumerate(idx.shards):
+            for seg in shard.segments:
+                lines.append(f"{name} {si} p 127.0.0.1 {seg.name} "
+                             f"{seg.n_docs} {int(seg.live.sum())}")
+    return 200, {"_cat": "\n".join(lines)}
+
+
+def cat_recovery(node, params, body):
+    lines = []
+    for name in sorted(node.indices_service.indices):
+        idx = node.indices_service.get(name)
+        for si in range(idx.num_shards):
+            lines.append(f"{name} {si} 0ms empty_store done "
+                         f"n/a n/a 127.0.0.1 {node.name}")
+    return 200, {"_cat": "\n".join(lines)}
+
+
+def cat_repositories(node, params, body):
+    return 200, {"_cat": "\n".join(
+        f"{name} fs" for name in sorted(
+            node.repositories_service.get_configs(None)))}
+
+
+def cat_snapshots(node, params, body, repo):
+    r = node.repositories_service.get_repository(repo)
+    lines = []
+    for s in r.list_snapshots():
+        lines.append(f"{s['snapshot']} SUCCESS "
+                     f"{len(s.get('indices', []))}")
+    return 200, {"_cat": "\n".join(lines)}
+
+
+def cat_tasks(node, params, body):
+    lines = []
+    for t in node.task_manager.list_tasks():
+        lines.append(f"{t.action} {t.id} - transport "
+                     f"{int(t.start_time * 1000)}")
+    return 200, {"_cat": "\n".join(lines)}
+
+
+def cat_nodeattrs(node, params, body):
+    return 200, {"_cat": f"{node.name} 127.0.0.1 127.0.0.1 - -"}

@@ -11,6 +11,8 @@ manages scroll contexts with expiry.
 
 from __future__ import annotations
 
+import json
+import logging
 import threading
 import time
 import uuid
@@ -31,6 +33,10 @@ from elasticsearch_tpu.search.searcher import (
 )
 
 DEFAULT_SIZE = 10
+
+# per-index search slow log (ref: index/SearchSlowLog.java — threshold
+# settings per level; recent entries kept per service on `slowlog_recent`)
+_slowlog_logger = logging.getLogger("index.search.slowlog")
 
 
 class _CoordinatorRewriteContext:
@@ -119,6 +125,7 @@ class SearchService:
         self._scrolls: Dict[str, ScrollContext] = {}
         self._pits: Dict[str, PitContext] = {}
         self._lock = threading.Lock()
+        self.slowlog_recent: List[Dict[str, Any]] = []
 
     # --------------------------------------------------------------- PIT
     def open_pit(self, index_expression: str, keep_alive: str) -> str:
@@ -188,7 +195,42 @@ class SearchService:
         response["took"] = int((time.monotonic() - start) * 1000)
         if scroll_ctx is not None:
             response["_scroll_id"] = scroll_ctx.scroll_id
+        self._after_search(names, response["took"], body)
         return response
+
+    def _after_search(self, names: List[str], took_ms: int,
+                      body: Dict[str, Any]):
+        """Post-search hooks: frozen-index HBM eviction + slow log."""
+        from elasticsearch_tpu.common.settings import parse_time_value
+        for name in names:
+            if not self.indices_service.has(name):
+                continue
+            idx = self.indices_service.get(name)
+            if idx.is_frozen:
+                # frozen: no device-resident state between searches (ref:
+                # FrozenEngine per-search readers → per-search HBM)
+                idx.device_cache.evict(idx._known_seg_names)
+            for level in ("warn", "info", "debug", "trace"):
+                thr = idx.settings.get(
+                    f"index.search.slowlog.threshold.query.{level}")
+                if thr is None:
+                    continue
+                thr_ms = parse_time_value(str(thr), "slowlog") * 1000
+                if thr_ms < 0:
+                    continue                # -1 disables the level
+                if took_ms >= thr_ms:
+                    entry = {"index": name, "took_ms": took_ms,
+                             "level": level,
+                             "source": json.dumps(body or {})[:1000]}
+                    _slowlog_logger.log(
+                        {"warn": 30, "info": 20,
+                         "debug": 10, "trace": 5}[level],
+                        "[%s] took[%dms], source[%s]",
+                        name, took_ms, entry["source"])
+                    self.slowlog_recent.append(entry)
+                    while len(self.slowlog_recent) > 128:
+                        self.slowlog_recent.pop(0)
+                    break
 
     def scroll(self, scroll_id: str, scroll: Optional[str] = None) -> Dict[str, Any]:
         start = time.monotonic()
@@ -204,6 +246,7 @@ class SearchService:
                                  continuing=True)
         response["took"] = int((time.monotonic() - start) * 1000)
         response["_scroll_id"] = scroll_id
+        self._after_search(ctx.index_names, response["took"], ctx.body)
         return response
 
     def scan(self, index_expression: str, body: Dict[str, Any],

@@ -1,0 +1,214 @@
+"""Operational-layer tests: index open/close, frozen indices, searchable
+snapshots, geoip/user-agent processors, hot threads, deprecation,
+autoscaling, slow logs, extended _cat family."""
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(data_path=str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def call(node, method, path, body=None, expect=200, **params):
+    status, r = node.rest_controller.dispatch(method, path, params, body)
+    assert status == expect, r
+    return r
+
+
+def _seed(node, name="idx", n=3):
+    node.indices_service.create_index(name, {}, {
+        "properties": {"v": {"type": "long"}}})
+    idx = node.indices_service.get(name)
+    for i in range(n):
+        idx.index_doc(str(i), {"v": i})
+    idx.refresh()
+    return idx
+
+
+def test_close_open_index(node):
+    _seed(node)
+    call(node, "POST", "/idx/_close")
+    # explicit search on closed index → 400
+    status, r = node.rest_controller.dispatch(
+        "POST", "/idx/_search", {}, {"size": 1})
+    assert status == 400 and "closed" in str(r)
+    # writes blocked with 403
+    status, r = node.rest_controller.dispatch(
+        "PUT", "/idx/_doc/9", {}, {"v": 9})
+    assert status == 403
+    # wildcard search skips it
+    r = call(node, "POST", "/_search", {"size": 10})
+    assert r["hits"]["total"]["value"] == 0
+    call(node, "POST", "/idx/_open")
+    r = call(node, "POST", "/idx/_search", {"size": 10})
+    assert r["hits"]["total"]["value"] == 3
+
+
+def test_freeze_unfreeze(node):
+    idx = _seed(node)
+    call(node, "POST", "/idx/_freeze")
+    # frozen is searchable but write-blocked
+    r = call(node, "POST", "/idx/_search", {"size": 10})
+    assert r["hits"]["total"]["value"] == 3
+    status, _ = node.rest_controller.dispatch(
+        "PUT", "/idx/_doc/9", {}, {"v": 9})
+    assert status == 403
+    # no device-resident segments linger after a frozen search
+    assert not idx.device_cache._cache
+    r = call(node, "GET", "/_migration/deprecations")
+    assert "idx" in r["index_settings"]
+    call(node, "POST", "/idx/_unfreeze")
+    idx.index_doc("9", {"v": 9})
+
+
+def test_mount_searchable_snapshot(node):
+    _seed(node, "src", n=4)
+    call(node, "PUT", "/_snapshot/repo1",
+         {"type": "fs", "settings": {"location": "repo1"}})
+    call(node, "PUT", "/_snapshot/repo1/snap1", {"indices": "src"})
+    r = call(node, "POST", "/_snapshot/repo1/snap1/_mount",
+             {"index": "src", "renamed_index": "mounted"})
+    assert r["snapshot"]["indices"] == ["mounted"]
+    got = call(node, "POST", "/mounted/_search", {"size": 10})
+    assert got["hits"]["total"]["value"] == 4
+    # read-only: writes rejected
+    status, _ = node.rest_controller.dispatch(
+        "PUT", "/mounted/_doc/z", {}, {"v": 99})
+    assert status == 403
+    stats = call(node, "GET", "/_searchable_snapshots/stats")
+    assert stats["indices"]["mounted"]["snapshot"] == "snap1"
+
+
+def test_geoip_processor(node):
+    node.ingest_service.put_pipeline("geo", {"processors": [
+        {"geoip": {"field": "ip"}}]})
+    node.indices_service.create_index("visits", {}, None)
+    call(node, "PUT", "/visits/_doc/1", {"ip": "192.0.2.44"},
+         expect=201, pipeline="geo")
+    node.indices_service.get("visits").refresh()
+    r = call(node, "POST", "/visits/_search", {"size": 1})
+    src = r["hits"]["hits"][0]["_source"]
+    assert src["geoip"]["country_name"] == "TEST-NET-1"
+    assert src["geoip"]["location"] == {"lat": 0.0, "lon": 0.0}
+
+
+def test_geoip_custom_database(node, tmp_path):
+    import json
+    db = tmp_path / "geo.json"
+    db.write_text(json.dumps([{
+        "network": "10.1.0.0/16", "country_iso_code": "DE",
+        "country_name": "Germany", "city_name": "Berlin"}]))
+    node.ingest_service.put_pipeline("geo", {"processors": [
+        {"geoip": {"field": "ip", "database_file": str(db)}}]})
+    r = node.ingest_service.simulate("geo", [
+        {"_source": {"ip": "10.1.2.3"}}])
+    assert r["docs"][0]["doc"]["_source"]["geoip"]["city_name"] == "Berlin"
+
+
+def test_user_agent_processor(node):
+    node.ingest_service.put_pipeline("ua", {"processors": [
+        {"user_agent": {"field": "agent"}}]})
+    ua = ("Mozilla/5.0 (Windows NT 10.0; Win64; x64) "
+          "AppleWebKit/537.36 (KHTML, like Gecko) "
+          "Chrome/120.0.0.0 Safari/537.36")
+    r = node.ingest_service.simulate("ua", [{"_source": {"agent": ua}}])
+    parsed = r["docs"][0]["doc"]["_source"]["user_agent"]
+    assert parsed["name"] == "Chrome"
+    assert parsed["major"] == "120"
+    assert parsed["os"]["name"] == "Windows"
+    r = node.ingest_service.simulate("ua", [{"_source": {
+        "agent": "curl/8.4.0"}}])
+    assert r["docs"][0]["doc"]["_source"]["user_agent"]["name"] == "curl"
+
+
+def test_hot_threads(node):
+    r = call(node, "GET", "/_nodes/hot_threads")
+    assert node.name in r["_cat"]
+    assert "cpu usage by thread" in r["_cat"]
+
+
+def test_autoscaling(node):
+    _seed(node)
+    call(node, "PUT", "/_autoscaling/policy/data", {
+        "roles": ["data"], "deciders": {"fixed": {}}})
+    r = call(node, "GET", "/_autoscaling/policy/data")
+    assert r["data"]["policy"]["roles"] == ["data"]
+    r = call(node, "GET", "/_autoscaling/capacity")
+    assert "data" in r["policies"]
+    assert r["policies"]["data"]["required_capacity"]["total"][
+        "storage"] >= 0
+    call(node, "DELETE", "/_autoscaling/policy/data")
+    call(node, "GET", "/_autoscaling/policy/data", expect=404)
+
+
+def test_search_slowlog(node):
+    idx = _seed(node)
+    recent = node.search_service.slowlog_recent
+    idx.update_settings(
+        {"index.search.slowlog.threshold.query.warn": "0ms"})
+    call(node, "POST", "/idx/_search", {"size": 1})
+    assert recent
+    assert recent[-1]["index"] == "idx"
+    assert recent[-1]["level"] == "warn"
+    # -1 disables the level
+    recent.clear()
+    idx.update_settings(
+        {"index.search.slowlog.threshold.query.warn": "-1"})
+    call(node, "POST", "/idx/_search", {"size": 1})
+    assert not recent
+
+
+def test_cat_family(node):
+    _seed(node)
+    call(node, "PUT", "/_snapshot/r1",
+         {"type": "fs", "settings": {"location": "r1"}})
+    call(node, "PUT", "/_snapshot/r1/s1", {"indices": "idx"})
+    assert node.name in call(node, "GET", "/_cat/nodes")["_cat"]
+    assert node.name in call(node, "GET", "/_cat/master")["_cat"]
+    assert "idx" in call(node, "GET", "/_cat/segments")["_cat"]
+    assert "r1 fs" in call(node, "GET", "/_cat/repositories")["_cat"]
+    assert "s1 SUCCESS" in call(node, "GET", "/_cat/snapshots/r1")["_cat"]
+    assert "idx" in call(node, "GET", "/_cat/recovery")["_cat"]
+    call(node, "GET", "/_cat/thread_pool")
+    call(node, "GET", "/_cat/plugins")
+    call(node, "GET", "/_cat/allocation")
+    call(node, "GET", "/_cat/nodeattrs")
+    call(node, "GET", "/_cat/pending_tasks")
+
+
+def test_closed_index_admin_operations(node):
+    _seed(node)
+    call(node, "POST", "/idx/_close")
+    # closed indices still serve admin reads and are deletable
+    call(node, "GET", "/idx/_mapping")
+    call(node, "GET", "/idx/_settings")
+    call(node, "POST", "/idx/_close")               # idempotent
+    # doc reads are blocked on closed indices
+    status, _ = node.rest_controller.dispatch("GET", "/idx/_doc/0", {})
+    assert status == 400
+    call(node, "DELETE", "/idx")
+    assert not node.indices_service.has("idx")
+
+
+def test_open_all(node):
+    _seed(node, "a1")
+    _seed(node, "a2")
+    call(node, "POST", "/a1/_close")
+    call(node, "POST", "/a2/_close")
+    call(node, "POST", "/_all/_open")
+    assert not node.indices_service.get("a1").is_closed
+    assert not node.indices_service.get("a2").is_closed
+
+
+def test_frozen_eviction_after_scroll(node):
+    idx = _seed(node, "fz", n=5)
+    call(node, "POST", "/fz/_freeze")
+    r = call(node, "POST", "/fz/_search", {"size": 2}, scroll="1m")
+    sid = r["_scroll_id"]
+    node.search_service.scroll(sid)
+    assert not idx.device_cache._cache
