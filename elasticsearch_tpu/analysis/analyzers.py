@@ -12,23 +12,31 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from elasticsearch_tpu.analysis.filters import (
+    ApostropheFilter,
     AsciiFoldingFilter,
     CharFilter,
+    CjkBigramFilter,
+    DecimalDigitFilter,
     EdgeNGramFilter,
+    ElisionFilter,
     HtmlStripCharFilter,
+    KeywordMarkerFilter,
     LengthFilter,
     LowercaseFilter,
     MappingCharFilter,
     PatternReplaceCharFilter,
+    PhoneticFilter,
     PorterStemFilter,
     ReverseFilter,
     ShingleFilter,
     StopFilter,
+    SynonymFilter,
     TokenFilter,
     TrimFilter,
     TruncateFilter,
     UniqueFilter,
     UppercaseFilter,
+    WordDelimiterGraphFilter,
 )
 from elasticsearch_tpu.analysis.tokenizers import (
     EdgeNGramTokenizer,
@@ -133,6 +141,29 @@ _TOKEN_FILTERS = {
         s.get("output_unigrams", True) in (True, "true")),
     "porter_stem": lambda s: PorterStemFilter(),
     "stemmer": lambda s: PorterStemFilter(),  # `english` language default
+    "kstem": lambda s: PorterStemFilter(),    # closest in-tree stemmer
+    "snowball": lambda s: PorterStemFilter(),
+    "synonym": lambda s: SynonymFilter(s.get("synonyms") or []),
+    "synonym_graph": lambda s: SynonymFilter(s.get("synonyms") or []),
+    "elision": lambda s: ElisionFilter(
+        set(s.get("articles")) if s.get("articles") else None),
+    "apostrophe": lambda s: ApostropheFilter(),
+    "decimal_digit": lambda s: DecimalDigitFilter(),
+    "keyword_marker": lambda s: KeywordMarkerFilter(
+        set(s.get("keywords") or [])),
+    "word_delimiter": lambda s: WordDelimiterGraphFilter(
+        s.get("generate_word_parts", True) in (True, "true"),
+        s.get("catenate_all", False) in (True, "true"),
+        s.get("preserve_original", False) in (True, "true")),
+    "word_delimiter_graph": lambda s: WordDelimiterGraphFilter(
+        s.get("generate_word_parts", True) in (True, "true"),
+        s.get("catenate_all", False) in (True, "true"),
+        s.get("preserve_original", False) in (True, "true")),
+    "cjk_bigram": lambda s: CjkBigramFilter(
+        s.get("output_unigrams", False) in (True, "true")),
+    "phonetic": lambda s: PhoneticFilter(
+        s.get("encoder", "metaphone"),
+        s.get("replace", True) in (True, "true")),
 }
 
 _CHAR_FILTERS = {
@@ -176,6 +207,11 @@ class AnalysisRegistry:
         custom_tokenizers = self._named_components(settings, "tokenizer", _TOKENIZERS)
         custom_filters = self._named_components(settings, "filter", _TOKEN_FILTERS)
         custom_char_filters = self._named_components(settings, "char_filter", _CHAR_FILTERS)
+        # index-defined components stay resolvable by name (the _analyze
+        # API accepts them alongside the global built-ins)
+        self.named_tokenizers = custom_tokenizers
+        self.named_filters = custom_filters
+        self.named_char_filters = custom_char_filters
 
         for name, conf in settings.groups("index.analysis.analyzer").items():
             type_ = conf.get("type", "custom")

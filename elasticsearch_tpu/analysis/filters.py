@@ -34,7 +34,8 @@ class LowercaseFilter(TokenFilter):
     name = "lowercase"
 
     def filter(self, tokens):
-        return [Token(t.term.lower(), t.position, t.start_offset, t.end_offset)
+        return [Token(t.term.lower(), t.position, t.start_offset,
+                      t.end_offset, t.keyword)
                 for t in tokens]
 
 
@@ -42,7 +43,8 @@ class UppercaseFilter(TokenFilter):
     name = "uppercase"
 
     def filter(self, tokens):
-        return [Token(t.term.upper(), t.position, t.start_offset, t.end_offset)
+        return [Token(t.term.upper(), t.position, t.start_offset,
+                      t.end_offset, t.keyword)
                 for t in tokens]
 
 
@@ -67,7 +69,8 @@ class AsciiFoldingFilter(TokenFilter):
         for t in tokens:
             folded = unicodedata.normalize("NFKD", t.term)
             folded = "".join(c for c in folded if not unicodedata.combining(c))
-            out.append(Token(folded, t.position, t.start_offset, t.end_offset))
+            out.append(Token(folded, t.position, t.start_offset,
+                             t.end_offset, t.keyword))
         return out
 
 
@@ -86,7 +89,8 @@ class TrimFilter(TokenFilter):
     name = "trim"
 
     def filter(self, tokens):
-        return [Token(t.term.strip(), t.position, t.start_offset, t.end_offset)
+        return [Token(t.term.strip(), t.position, t.start_offset,
+                      t.end_offset, t.keyword)
                 for t in tokens]
 
 
@@ -97,7 +101,8 @@ class TruncateFilter(TokenFilter):
         self.length = length
 
     def filter(self, tokens):
-        return [Token(t.term[: self.length], t.position, t.start_offset, t.end_offset)
+        return [Token(t.term[: self.length], t.position, t.start_offset,
+                      t.end_offset, t.keyword)
                 for t in tokens]
 
 
@@ -287,7 +292,10 @@ class PorterStemFilter(TokenFilter):
         return w
 
     def filter(self, tokens):
-        return [Token(self._stem(t.term), t.position, t.start_offset, t.end_offset)
+        # keyword_marker-protected tokens pass through unstemmed
+        return [t if getattr(t, "keyword", False)
+                else Token(self._stem(t.term), t.position, t.start_offset,
+                           t.end_offset)
                 for t in tokens]
 
 
@@ -337,3 +345,380 @@ class PatternReplaceCharFilter(CharFilter):
 
     def apply(self, text: str) -> str:
         return self.pattern.sub(self.replacement, text)
+
+
+# ---------------------------------------------------------------------------
+# analysis-common extras + language-analysis plugin equivalents
+# ---------------------------------------------------------------------------
+
+class SynonymFilter(TokenFilter):
+    """Synonym expansion at the same position (ref: analysis-common
+    SynonymTokenFilterFactory, Solr synonyms format: "a, b, c" equivalence
+    groups and "a, b => c" explicit rules)."""
+
+    name = "synonym"
+
+    def __init__(self, rules: List[str]):
+        self.expand: dict = {}
+        for rule in rules or []:
+            if "=>" in rule:
+                lhs, _, rhs = rule.partition("=>")
+                targets = [t.strip() for t in rhs.split(",") if t.strip()]
+                for src in (t.strip() for t in lhs.split(",")):
+                    if src:
+                        self.expand[src] = targets
+            else:
+                group = [t.strip() for t in rule.split(",") if t.strip()]
+                for src in group:
+                    self.expand[src] = group
+
+    def filter(self, tokens):
+        out: List[Token] = []
+        for t in tokens:
+            targets = self.expand.get(t.term)
+            if targets is None:
+                out.append(t)
+                continue
+            # all synonyms emit at the SAME position (equivalence class)
+            for term in targets:
+                out.append(Token(term, t.position, t.start_offset,
+                                 t.end_offset))
+        return out
+
+
+class ElisionFilter(TokenFilter):
+    """Strips leading elided articles (l', d', …) — ref: analysis-common
+    ElisionTokenFilterFactory, French defaults."""
+
+    name = "elision"
+    DEFAULT_ARTICLES = {"l", "m", "t", "qu", "n", "s", "j", "d", "c",
+                        "jusqu", "quoiqu", "lorsqu", "puisqu"}
+
+    def __init__(self, articles: Optional[Set[str]] = None):
+        self.articles = articles or self.DEFAULT_ARTICLES
+
+    def filter(self, tokens):
+        out = []
+        for t in tokens:
+            term = t.term
+            for sep in ("'", "’"):
+                i = term.find(sep)
+                if 0 < i and term[:i].lower() in self.articles:
+                    term = term[i + 1:]
+                    break
+            out.append(Token(term, t.position, t.start_offset,
+                             t.end_offset, t.keyword))
+        return out
+
+
+class ApostropheFilter(TokenFilter):
+    """Strips everything after an apostrophe (ref: analysis-common
+    ApostropheFilterFactory, Turkish)."""
+
+    name = "apostrophe"
+
+    def filter(self, tokens):
+        out = []
+        for t in tokens:
+            i = t.term.find("'")
+            term = t.term[:i] if i >= 0 else t.term
+            out.append(Token(term, t.position, t.start_offset,
+                             t.end_offset, t.keyword))
+        return out
+
+
+class DecimalDigitFilter(TokenFilter):
+    """Folds unicode digits to latin 0-9 (ref: DecimalDigitFilterFactory)."""
+
+    name = "decimal_digit"
+
+    def filter(self, tokens):
+        out = []
+        for t in tokens:
+            term = "".join(str(unicodedata.digit(ch)) if ch.isdigit()
+                           else ch for ch in t.term)
+            out.append(Token(term, t.position, t.start_offset,
+                             t.end_offset, t.keyword))
+        return out
+
+
+class KeywordMarkerFilter(TokenFilter):
+    """Marks terms as keywords so stemmers skip them (ref:
+    KeywordMarkerTokenFilterFactory). Stemming protection is modeled by
+    re-emitting protected terms untouched downstream: this filter tags
+    tokens via a `keyword` attribute."""
+
+    name = "keyword_marker"
+
+    def __init__(self, keywords: Set[str]):
+        self.keywords = keywords
+
+    def filter(self, tokens):
+        for t in tokens:
+            if t.term in self.keywords:
+                t.keyword = True
+        return tokens
+
+
+class WordDelimiterGraphFilter(TokenFilter):
+    """Splits on case changes / non-alphanumerics / letter-digit
+    boundaries (ref: analysis-common WordDelimiterGraphFilterFactory —
+    generate_word_parts + catenate options subset)."""
+
+    name = "word_delimiter_graph"
+
+    def __init__(self, generate_word_parts: bool = True,
+                 catenate_all: bool = False,
+                 preserve_original: bool = False):
+        self.generate_word_parts = generate_word_parts
+        self.catenate_all = catenate_all
+        self.preserve_original = preserve_original
+
+    @staticmethod
+    def _word_parts(term: str) -> List[str]:
+        """Unicode-aware sub-word splitting: non-alphanumerics delimit,
+        letter↔digit transitions split, lower→Upper splits, and an
+        UPPER run followed by lower keeps its last letter with the next
+        part (XMLHttp → XML, Http) — Lucene WordDelimiterIterator rules."""
+        parts: List[str] = []
+        cur = ""
+        prev = None                           # "u" | "l" | "d"
+        for ch in term:
+            if ch.isdigit():
+                kind = "d"
+            elif ch.isalpha():
+                kind = "u" if ch.isupper() else "l"
+            else:
+                if cur:
+                    parts.append(cur)
+                cur, prev = "", None
+                continue
+            if not cur:
+                cur, prev = ch, kind
+                continue
+            if (prev == "l" and kind == "u") or (
+                    "d" in (prev, kind) and prev != kind):
+                parts.append(cur)
+                cur = ch
+            elif prev == "u" and kind == "l" and len(cur) > 1 and all(
+                    c.isupper() for c in cur):
+                parts.append(cur[:-1])
+                cur = cur[-1] + ch
+            else:
+                cur += ch
+            prev = kind
+        if cur:
+            parts.append(cur)
+        return parts
+
+    def filter(self, tokens):
+        out: List[Token] = []
+        shift = 0        # split parts consume positions; later tokens shift
+        for t in tokens:
+            pos = t.position + shift
+            parts = self._word_parts(t.term)
+            emitted = False
+            if self.preserve_original or len(parts) <= 1:
+                out.append(Token(t.term, pos, t.start_offset, t.end_offset,
+                                 t.keyword))
+                emitted = True
+            if len(parts) > 1:
+                if self.generate_word_parts:
+                    # parts take incrementing positions so phrase queries
+                    # match across the split (PowerShot → power@p,
+                    # shot@p+1) and FOLLOWING tokens shift accordingly —
+                    # Lucene's posIncrement semantics
+                    for i, p in enumerate(parts):
+                        out.append(Token(p, pos + i, t.start_offset,
+                                         t.end_offset))
+                    shift += len(parts) - 1
+                    emitted = True
+                if self.catenate_all:
+                    out.append(Token("".join(parts), pos,
+                                     t.start_offset, t.end_offset))
+                    emitted = True
+            if not emitted:
+                out.append(Token(t.term, pos, t.start_offset, t.end_offset,
+                                 t.keyword))
+        return out
+
+
+class CjkBigramFilter(TokenFilter):
+    """CJK bigrams (ref: analysis-common CJKBigramFilterFactory): runs of
+    CJK codepoints emit overlapping bigrams; non-CJK tokens pass through."""
+
+    name = "cjk_bigram"
+
+    @staticmethod
+    def _is_cjk(ch: str) -> bool:
+        cp = ord(ch)
+        return (0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+                or 0x3040 <= cp <= 0x30FF or 0xAC00 <= cp <= 0xD7AF)
+
+    def __init__(self, output_unigrams: bool = False):
+        self.output_unigrams = output_unigrams
+
+    def filter(self, tokens):
+        out: List[Token] = []
+        shift = 0        # bigrams consume positions; later tokens shift
+        for t in tokens:
+            pos = t.position + shift
+            if all(self._is_cjk(c) for c in t.term) and len(t.term) >= 2:
+                # bigrams take incrementing positions from the source
+                # token's and shift everything after (posIncrement model)
+                for i in range(len(t.term) - 1):
+                    out.append(Token(t.term[i:i + 2], pos + i,
+                                     t.start_offset + i,
+                                     t.start_offset + i + 2))
+                if self.output_unigrams:
+                    for i, ch in enumerate(t.term):
+                        out.append(Token(ch, pos + i,
+                                         t.start_offset + i,
+                                         t.start_offset + i + 1))
+                shift += len(t.term) - 2
+            else:
+                out.append(Token(t.term, pos, t.start_offset, t.end_offset,
+                                 t.keyword))
+        return out
+
+
+def soundex(word: str) -> str:
+    """Classic Soundex (ref: plugins/analysis-phonetic encoder family)."""
+    word = re.sub(r"[^a-z]", "", word.lower())
+    if not word:
+        return ""
+    codes = {"b": "1", "f": "1", "p": "1", "v": "1",
+             "c": "2", "g": "2", "j": "2", "k": "2", "q": "2",
+             "s": "2", "x": "2", "z": "2",
+             "d": "3", "t": "3", "l": "4", "m": "5", "n": "5", "r": "6"}
+    first = word[0]
+    out = [first.upper()]
+    prev = codes.get(first, "")
+    for ch in word[1:]:
+        code = codes.get(ch, "")
+        if code and code != prev:
+            out.append(code)
+        if ch not in "hw":
+            prev = code
+        if len(out) == 4:
+            break
+    return ("".join(out) + "000")[:4]
+
+
+def metaphone(word: str, max_len: int = 4) -> str:
+    """Simplified original Metaphone — enough to group the classic
+    spelling families (smith/smyth, catherine/kathryn)."""
+    w = re.sub(r"[^a-z]", "", word.lower())
+    if not w:
+        return ""
+    # common prefixes
+    for pre, rep in (("kn", "n"), ("gn", "n"), ("pn", "n"), ("wr", "r"),
+                     ("ae", "e"), ("x", "s"), ("wh", "w")):
+        if w.startswith(pre):
+            w = rep + w[len(pre):]
+            break
+    out = []
+    i = 0
+    vowels = "aeiou"
+    while i < len(w) and len(out) < max_len:
+        c = w[i]
+        nxt = w[i + 1] if i + 1 < len(w) else ""
+        if c in vowels:
+            if i == 0:
+                out.append(c.upper())
+        elif c == "b":
+            if not (i == len(w) - 1 and i > 0 and w[i - 1] == "m"):
+                out.append("B")
+        elif c == "c":
+            if nxt == "h":
+                out.append("X")
+                i += 1
+            elif nxt in "iey":
+                out.append("S")
+            else:
+                out.append("K")
+        elif c == "d":
+            if nxt == "g" and i + 2 < len(w) and w[i + 2] in "iey":
+                out.append("J")
+                i += 2
+            else:
+                out.append("T")
+        elif c == "g":
+            if nxt == "h" and i + 2 < len(w) and w[i + 2] not in vowels:
+                i += 1
+            elif nxt in "iey":
+                out.append("J")
+            else:
+                out.append("K")
+        elif c == "h":
+            if i > 0 and w[i - 1] in vowels and nxt not in vowels:
+                pass
+            else:
+                out.append("H")
+        elif c == "k":
+            if not (i > 0 and w[i - 1] == "c"):
+                out.append("K")
+        elif c == "p":
+            if nxt == "h":
+                out.append("F")
+                i += 1
+            else:
+                out.append("P")
+        elif c == "q":
+            out.append("K")
+        elif c == "s":
+            if nxt == "h":
+                out.append("X")
+                i += 1
+            elif nxt == "i" and i + 2 < len(w) and w[i + 2] in "oa":
+                out.append("X")
+            else:
+                out.append("S")
+        elif c == "t":
+            if nxt == "h":
+                out.append("0")
+                i += 1
+            elif nxt == "i" and i + 2 < len(w) and w[i + 2] in "oa":
+                out.append("X")
+            else:
+                out.append("T")
+        elif c == "v":
+            out.append("F")
+        elif c == "w" or c == "y":
+            if nxt in vowels:
+                out.append(c.upper())
+        elif c == "x":
+            out.append("KS")
+        elif c == "z":
+            out.append("S")
+        elif c in "flmnr":
+            out.append(c.upper())
+        if i < len(w) - 1 and w[i] == w[i + 1]:
+            i += 1                       # collapse doubles
+        i += 1
+    return "".join(out)[:max_len]
+
+
+class PhoneticFilter(TokenFilter):
+    """Phonetic encoding (ref: plugins/analysis-phonetic
+    PhoneticTokenFilterFactory — soundex/metaphone encoders; `replace`
+    keeps or replaces the original token)."""
+
+    name = "phonetic"
+
+    def __init__(self, encoder: str = "metaphone", replace: bool = True):
+        if encoder not in ("metaphone", "soundex"):
+            raise ValueError(f"unknown phonetic encoder [{encoder}]")
+        self.encode = metaphone if encoder == "metaphone" else soundex
+        self.replace = replace
+
+    def filter(self, tokens):
+        out = []
+        for t in tokens:
+            enc = self.encode(t.term)
+            if not self.replace:
+                out.append(t)
+            if enc:
+                out.append(Token(enc, t.position, t.start_offset,
+                                 t.end_offset))
+        return out

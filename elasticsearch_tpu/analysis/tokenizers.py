@@ -24,6 +24,9 @@ class Token:
     position: int
     start_offset: int
     end_offset: int
+    # keyword_marker protection survives downstream filters (the Lucene
+    # KeywordAttribute analogue); rebuilding filters must propagate it
+    keyword: bool = False
 
 
 def _is_word_char(ch: str) -> bool:

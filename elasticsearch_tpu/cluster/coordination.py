@@ -582,6 +582,11 @@ class Coordinator:
             return
         if not self.local_node.is_master_eligible():
             return
+        if self.local_node.is_voting_only():
+            # voting-only nodes grant votes and count toward quorums but
+            # never stand for election themselves (ref: x-pack
+            # voting-only-node — elections are rejected at the source)
+            return
         # pre-vote round (ref: PreVoteCollector) — ask a quorum whether
         # an election could succeed, without inflating terms
         voting = self.coordination_state.last_committed_config()

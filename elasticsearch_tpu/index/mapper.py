@@ -606,6 +606,9 @@ class DocumentMapper:
         self.fields: Dict[str, MappedFieldType] = {}
         self.analysis = analysis or AnalysisRegistry()
         self.dynamic = dynamic  # "true" | "false" | "strict"
+        # ref: plugins/mapper-size — opt-in _size metadata field recording
+        # the source byte length as a searchable/aggregatable numeric
+        self.size_enabled = False
         if mappings:
             if "properties" in mappings:
                 props = mappings["properties"]
@@ -613,9 +616,16 @@ class DocumentMapper:
                 # properties-less shorthand: sibling meta keys like
                 # "dynamic" are not field definitions
                 props = {k: v for k, v in mappings.items()
-                         if isinstance(v, dict)}
+                         if isinstance(v, dict)
+                         and not k.startswith("_")}
             self._add_properties("", props)
             self.dynamic = str(mappings.get("dynamic", dynamic)).lower()
+            size_spec = mappings.get("_size", {})
+            if not isinstance(size_spec, dict):
+                size_spec = {"enabled": size_spec}
+            self.size_enabled = size_spec.get("enabled") in (True, "true")
+            if self.size_enabled and "_size" not in self.fields:
+                self.fields["_size"] = LongFieldType("_size")
 
     def _add_properties(self, prefix: str, props: Dict[str, Any]):
         for name, conf in props.items():
@@ -647,12 +657,17 @@ class DocumentMapper:
         for path, ft in sorted(self.fields.items()):
             if isinstance(ft, ShingleSubFieldType) or path.endswith("._index_prefix"):
                 continue  # synthetic search_as_you_type subfields
+            if path == "_size":
+                continue  # metadata field, emitted as _size below
             node = props
             parts = path.split(".")
             for p in parts[:-1]:
                 node = node.setdefault(p, {}).setdefault("properties", {})
             node[parts[-1]] = ft.to_mapping()
-        return {"properties": props}
+        out: Dict[str, Any] = {"properties": props}
+        if self.size_enabled:
+            out["_size"] = {"enabled": True}
+        return out
 
     # -- dynamic mapping (ref: DocumentParser dynamic templates default path)
     def _infer_type(self, path: str, value: Any) -> Optional[MappedFieldType]:
@@ -679,6 +694,8 @@ class DocumentMapper:
             source=json.dumps(source, separators=(",", ":")).encode(),
         )
         self._parse_object("", source, parsed)
+        if self.size_enabled:
+            parsed.numeric_values["_size"] = [float(len(parsed.source))]
         return parsed
 
     def join_parent_routing(self, source: Dict[str, Any]) -> Optional[str]:

@@ -98,6 +98,8 @@ class IndexService:
         ]
         self._known_seg_names: set = {
             seg.name for shard in self.shards for seg in shard.segments}
+        self.indexing_slowlog_recent: List[Dict[str, Any]] = []
+        self._index_slowlog_thresholds = self._parse_slowlog_thresholds()
         self._persist_meta()
 
     # ---------------------------------------------------------- metadata
@@ -126,6 +128,7 @@ class IndexService:
         merged = self.settings.as_dict()
         merged.update(flat)
         self.settings = Settings(merged)
+        self._index_slowlog_thresholds = self._parse_slowlog_thresholds()
         self._persist_meta()
 
     # ------------------------------------------------------- state blocks
@@ -173,11 +176,45 @@ class IndexService:
             routing = self.mapper.mapper.join_parent_routing(source)
         shard = self.shards[self.shard_for(doc_id, routing)]
         n_fields = len(self.mapper.mapper.fields)
+        t0 = time.monotonic()
         result = shard.index(doc_id, source, **kwargs)
+        self._maybe_indexing_slowlog(doc_id, time.monotonic() - t0)
         if len(self.mapper.mapper.fields) != n_fields:
             # dynamic mappings grew during parse; keep _meta fresh
             self._persist_meta()
         return result
+
+    def _parse_slowlog_thresholds(self):
+        """Thresholds parse ONCE per settings change, not per document
+        (ref: IndexingSlowLog re-reads settings only on update)."""
+        from elasticsearch_tpu.common.settings import parse_time_value
+        out = []
+        for level, py_level in (("warn", 30), ("info", 20),
+                                ("debug", 10), ("trace", 5)):
+            thr = self.settings.get(
+                f"index.indexing.slowlog.threshold.index.{level}")
+            if thr is None:
+                continue
+            thr_s = parse_time_value(str(thr), "slowlog")
+            if thr_s < 0:
+                continue                      # -1 disables the level
+            out.append((level, py_level, thr_s))
+        return out
+
+    def _maybe_indexing_slowlog(self, doc_id: str, took_s: float):
+        """Per-index indexing slow log (ref: index/IndexingSlowLog.java)."""
+        for level, py_level, thr_s in self._index_slowlog_thresholds:
+            if took_s >= thr_s:
+                import logging
+                logging.getLogger("index.indexing.slowlog").log(
+                    py_level, "[%s] took[%.1fms], id[%s]",
+                    self.name, took_s * 1000, doc_id)
+                self.indexing_slowlog_recent.append(
+                    {"index": self.name, "id": doc_id, "level": level,
+                     "took_ms": took_s * 1000})
+                while len(self.indexing_slowlog_recent) > 128:
+                    self.indexing_slowlog_recent.pop(0)
+                break
 
     def delete_doc(self, doc_id: str, routing: Optional[str] = None, **kwargs):
         self.check_write_block()

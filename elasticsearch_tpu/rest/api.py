@@ -608,7 +608,39 @@ def analyze_no_index(node, params, body):
 def _analyze(registry, body):
     text = body.get("text", "")
     texts = text if isinstance(text, list) else [text]
-    analyzer = registry.get(body.get("analyzer", "standard"))
+    if "tokenizer" in body or "filter" in body or "char_filter" in body:
+        # ad-hoc chain (ref: TransportAnalyzeAction custom analysis):
+        # components are names or inline definitions
+        from elasticsearch_tpu.analysis.analyzers import (
+            _CHAR_FILTERS, _TOKENIZERS, _TOKEN_FILTERS, CustomAnalyzer)
+
+        def build(spec, reg, named, kind):
+            if isinstance(spec, str):
+                built = named.get(spec)
+                if built is not None:
+                    return built          # index-defined component
+                name, conf = spec, {}
+            else:
+                conf = dict(spec)
+                name = conf.get("type")
+            factory = reg.get(name)
+            if factory is None:
+                raise IllegalArgumentException(
+                    f"failed to find global {kind} under [{name}]")
+            return factory(conf)
+
+        named_toks = getattr(registry, "named_tokenizers", {})
+        named_filters = getattr(registry, "named_filters", {})
+        named_chars = getattr(registry, "named_char_filters", {})
+        tok = build(body.get("tokenizer", "standard"),
+                    _TOKENIZERS, named_toks, "tokenizer")
+        filters = [build(f, _TOKEN_FILTERS, named_filters, "token filter")
+                   for f in body.get("filter", [])]
+        char_filters = [build(f, _CHAR_FILTERS, named_chars, "char filter")
+                        for f in body.get("char_filter", [])]
+        analyzer = CustomAnalyzer("_adhoc_", tok, filters, char_filters)
+    else:
+        analyzer = registry.get(body.get("analyzer", "standard"))
     tokens = []
     for t in texts:
         for tok in analyzer.analyze(t):
@@ -944,9 +976,9 @@ def search_index(node, params, body, index):
     with node.task_manager.task_scope(
             "transport", "indices:data/read/search",
             description=f"indices[{index}]", cancellable=True) as task:
-        r = node.search_service.search(index, body,
-                                       scroll=params.get("scroll"),
-                                       task=task)
+        r = node.search_service.search(
+            index, body, scroll=params.get("scroll"), task=task,
+            search_type=params.get("search_type"))
     return 200, _apply_fls(node, index, r)
 
 
@@ -956,9 +988,9 @@ def search_all(node, params, body):
     with node.task_manager.task_scope(
             "transport", "indices:data/read/search",
             description="indices[_all]", cancellable=True) as task:
-        r = node.search_service.search("_all", body,
-                                       scroll=params.get("scroll"),
-                                       task=task)
+        r = node.search_service.search(
+            "_all", body, scroll=params.get("scroll"), task=task,
+            search_type=params.get("search_type"))
     return 200, _apply_fls(node, "_all", r)
 
 

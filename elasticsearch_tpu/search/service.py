@@ -153,7 +153,8 @@ class SearchService:
 
     # ------------------------------------------------------------ public
     def search(self, index_expression: str, body: Dict[str, Any],
-               scroll: Optional[str] = None, task=None) -> Dict[str, Any]:
+               scroll: Optional[str] = None, task=None,
+               search_type: Optional[str] = None) -> Dict[str, Any]:
         start = time.monotonic()
         pit_spec = (body or {}).get("pit")
         if pit_spec is not None:
@@ -179,6 +180,22 @@ class SearchService:
                 idx = self.indices_service.get(name)
                 for s in idx.shard_searchers():
                     searchers.append((name, s))
+
+        if search_type == "dfs_query_then_fetch" and len(searchers) > 1:
+            # DFS phase: aggregate term statistics over EVERY shard so all
+            # shards score with identical IDF (ref: search/dfs/DfsPhase +
+            # AggregatedDfs). PIT/scroll searchers are LONG-lived, so the
+            # swap happens on per-request shallow copies, never in place.
+            import copy
+            from elasticsearch_tpu.search.context import ShardStats
+            global_stats = ShardStats(
+                [seg for _, s in searchers for seg in s.segments])
+            swapped = []
+            for name, s in searchers:
+                s2 = copy.copy(s)
+                s2.stats = global_stats
+                swapped.append((name, s2))
+            searchers = swapped
 
         scroll_ctx = None
         if scroll is not None:

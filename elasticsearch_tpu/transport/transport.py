@@ -100,6 +100,11 @@ class DiscoveryNode:
     def is_master_eligible(self) -> bool:
         return "master" in self.roles
 
+    def is_voting_only(self) -> bool:
+        """Participates in elections/quorums but never becomes master
+        itself (ref: x-pack voting-only-node VotingOnlyNodePlugin)."""
+        return "voting_only" in self.roles
+
     def is_data_node(self) -> bool:
         return "data" in self.roles
 

@@ -120,3 +120,109 @@ def test_ngram_tokenizer():
         "index.analysis.analyzer.ng.tokenizer": "ng",
     }))
     assert reg.get("ng").terms("abcd") == ["ab", "abc", "bc", "bcd", "cd"]
+
+
+def test_synonym_filter():
+    from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+    from elasticsearch_tpu.common.settings import Settings
+    r = AnalysisRegistry(Settings.from_dict({"index": {"analysis": {
+        "filter": {"syn": {"type": "synonym",
+                           "synonyms": ["car, auto", "tv => television"]}},
+        "analyzer": {"a": {"type": "custom", "tokenizer": "standard",
+                           "filter": ["lowercase", "syn"]}}}}}))
+    terms = [t.term for t in r.get("a").analyze("my car and tv")]
+    assert terms == ["my", "car", "auto", "and", "television"]
+    # synonyms share the original token's position (phrase semantics)
+    toks = r.get("a").analyze("car")
+    assert {t.position for t in toks} == {0}
+
+
+def test_phonetic_filters():
+    from elasticsearch_tpu.analysis.filters import metaphone, soundex
+    assert soundex("smith") == soundex("smyth")
+    assert soundex("robert") == "R163"
+    assert metaphone("catherine") == metaphone("kathryn")
+
+
+def test_word_delimiter_graph():
+    from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+    from elasticsearch_tpu.common.settings import Settings
+    r = AnalysisRegistry(Settings.from_dict({"index": {"analysis": {
+        "analyzer": {"a": {"type": "custom", "tokenizer": "whitespace",
+                           "filter": ["word_delimiter_graph",
+                                      "lowercase"]}}}}}))
+    terms = [t.term for t in r.get("a").analyze("PowerShot500 foo-bar")]
+    assert terms == ["power", "shot", "500", "foo", "bar"]
+
+
+def test_cjk_bigram():
+    from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+    from elasticsearch_tpu.common.settings import Settings
+    r = AnalysisRegistry(Settings.from_dict({"index": {"analysis": {
+        "analyzer": {"a": {"type": "custom", "tokenizer": "standard",
+                           "filter": ["cjk_bigram"]}}}}}))
+    terms = [t.term for t in r.get("a").analyze("日本語 test")]
+    assert terms == ["日本", "本語", "test"]
+
+
+def test_elision_and_apostrophe():
+    from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+    from elasticsearch_tpu.common.settings import Settings
+    r = AnalysisRegistry(Settings.from_dict({"index": {"analysis": {
+        "analyzer": {
+            "fr": {"type": "custom", "tokenizer": "whitespace",
+                   "filter": ["lowercase", "elision"]},
+            "tr": {"type": "custom", "tokenizer": "whitespace",
+                   "filter": ["apostrophe"]}}}}}))
+    assert [t.term for t in r.get("fr").analyze("l'avion")] == ["avion"]
+    assert [t.term for t in r.get("tr").analyze("Istanbul'da")] == [
+        "Istanbul"]
+
+
+def test_keyword_marker_protects_stemming():
+    from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+    from elasticsearch_tpu.common.settings import Settings
+    r = AnalysisRegistry(Settings.from_dict({"index": {"analysis": {
+        "filter": {"km": {"type": "keyword_marker",
+                          "keywords": ["running"]}},
+        "analyzer": {"a": {"type": "custom", "tokenizer": "standard",
+                           "filter": ["lowercase", "km",
+                                      "porter_stem"]}}}}}))
+    terms = [t.term for t in r.get("a").analyze("running jumping")]
+    assert terms == ["running", "jump"]
+
+
+def test_word_delimiter_unicode_and_positions():
+    from elasticsearch_tpu.analysis.filters import WordDelimiterGraphFilter
+    from elasticsearch_tpu.analysis.tokenizers import Token
+    f = WordDelimiterGraphFilter()
+    toks = f.filter([Token("café-bar", 0, 0, 8)])
+    assert [t.term for t in toks] == ["café", "bar"]
+    toks = f.filter([Token("PowerShot", 0, 0, 9)])
+    assert [(t.term, t.position) for t in toks] == [
+        ("Power", 0), ("Shot", 1)]
+    toks = f.filter([Token("XMLHttp", 0, 0, 7)])
+    assert [t.term for t in toks] == ["XML", "Http"]
+
+
+def test_keyword_marker_survives_rebuilding_filters():
+    from elasticsearch_tpu.analysis.analyzers import AnalysisRegistry
+    from elasticsearch_tpu.common.settings import Settings
+    r = AnalysisRegistry(Settings.from_dict({"index": {"analysis": {
+        "filter": {"km": {"type": "keyword_marker",
+                          "keywords": ["running"]}},
+        "analyzer": {"a": {"type": "custom", "tokenizer": "whitespace",
+                           "filter": ["km", "lowercase", "asciifolding",
+                                      "porter_stem"]}}}}}))
+    terms = [t.term for t in r.get("a").analyze("running jumping")]
+    assert terms == ["running", "jump"]
+
+
+def test_cjk_bigram_preserves_noncjk_positions():
+    from elasticsearch_tpu.analysis.filters import CjkBigramFilter
+    from elasticsearch_tpu.analysis.tokenizers import Token
+    f = CjkBigramFilter()
+    # stop-word gap at position 2 must survive
+    toks = f.filter([Token("alpha", 0, 0, 5), Token("gamma", 2, 10, 15)])
+    assert [(t.term, t.position) for t in toks] == [
+        ("alpha", 0), ("gamma", 2)]

@@ -315,3 +315,20 @@ def test_run_randomly_then_stabilise():
                 f"{c.local_node.name} at v{c.applied_state.version} != " \
                 f"v{state.version}"
             assert c.applied_state.state_uuid == state.state_uuid
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_voting_only_node_never_becomes_master(seed):
+    """A voting_only master-eligible node counts toward quorums but never
+    wins elections (ref: x-pack voting-only-node)."""
+    cluster = SimCluster(3, seed=seed)
+    # rebuild node 0 as voting-only BEFORE any election runs
+    import dataclasses
+    v_node = cluster.nodes[0]
+    cluster.coordinators[v_node.node_id].local_node = dataclasses.replace(
+        v_node, roles=("master", "voting_only", "data"))
+    leader = cluster.stabilise()
+    assert not leader.local_node.is_voting_only()
+    assert leader.local_node.node_id != v_node.node_id
+    # the voting-only node still follows the leader
+    assert cluster.coordinators[v_node.node_id].mode == MODE_FOLLOWER

@@ -212,3 +212,33 @@ def test_frozen_eviction_after_scroll(node):
     sid = r["_scroll_id"]
     node.search_service.scroll(sid)
     assert not idx.device_cache._cache
+
+
+def test_mapper_size(node):
+    node.indices_service.create_index("sz", {}, {
+        "_size": {"enabled": True},
+        "properties": {"body": {"type": "text"}}})
+    idx = node.indices_service.get("sz")
+    idx.index_doc("1", {"body": "tiny"})
+    idx.index_doc("2", {"body": "a much longer document body text here"})
+    idx.refresh()
+    r = node.search_service.search("sz", {
+        "size": 2, "sort": [{"_size": {"order": "desc"}}]})
+    ids = [h["_id"] for h in r["hits"]["hits"]]
+    assert ids == ["2", "1"]
+    # aggregatable too
+    r = node.search_service.search("sz", {"size": 0, "aggs": {
+        "m": {"max": {"field": "_size"}}}})
+    assert r["aggregations"]["m"]["value"] > 20
+    # round-trips through the mapping API
+    status, m = node.rest_controller.dispatch("GET", "/sz/_mapping", {})
+    assert m["sz"]["mappings"]["_size"] == {"enabled": True}
+
+
+def test_indexing_slowlog(node):
+    idx = _seed(node, "slow")
+    idx.update_settings(
+        {"index.indexing.slowlog.threshold.index.warn": "0ms"})
+    idx.index_doc("x", {"v": 1})
+    assert idx.indexing_slowlog_recent
+    assert idx.indexing_slowlog_recent[-1]["id"] == "x"

@@ -548,3 +548,32 @@ def test_keyword_search_after(tmp_path_factory):
     names2 = [h["_source"]["name"] for h in r["hits"]["hits"]]
     assert names2 == ["charlie", "delta", "echo"]
     indices.close()
+
+
+def test_dfs_query_then_fetch_consistent_idf(tmp_path_factory):
+    """Without DFS, shards score with local IDF; dfs_query_then_fetch
+    must produce identical scores for identical docs on different shards
+    (ref: search/dfs/DfsPhase cross-shard-consistent IDF)."""
+    from elasticsearch_tpu.index.service import IndicesService
+    from elasticsearch_tpu.search.service import SearchService
+    tmp = tmp_path_factory.mktemp("dfs")
+    indices = IndicesService(str(tmp / "data"))
+    idx = indices.create_index(
+        "d", {"index.number_of_shards": 2},
+        {"properties": {"t": {"type": "text"}}})
+    # identical docs that land on different shards, plus skewed term
+    # frequencies so per-shard IDF differs
+    docs = {"a": "quake alpha", "b": "quake alpha",
+            "k0": "quake beta", "k1": "quake beta", "k2": "quake gamma"}
+    for did, text in docs.items():
+        idx.index_doc(did, {"t": text})
+    idx.refresh()
+    svc = SearchService(indices)
+    r = svc.search("d", {"query": {"match": {"t": {"query": "quake"}}},
+                         "size": 10},
+                   search_type="dfs_query_then_fetch")
+    scores = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+    # every doc contains "quake" once with similar lengths — with global
+    # IDF the identical docs a and b MUST score identically
+    assert scores["a"] == scores["b"]
+    indices.close()
