@@ -674,12 +674,14 @@ class KnnQuery(QueryBuilder):
 
     def __init__(self, field: str, query_vector: List[float],
                  num_candidates: Optional[int] = None,
-                 filter_query: Optional[QueryBuilder] = None):
+                 filter_query: Optional[QueryBuilder] = None,
+                 k: Optional[int] = None):
         super().__init__()
         self.field = field
         self.query_vector = np.asarray(query_vector, np.float32)
         self.num_candidates = num_candidates
         self.filter_query = filter_query
+        self.k = k
 
     def do_execute(self, ctx):
         dv = ctx.device.vectors.get(self.field)
@@ -701,6 +703,15 @@ class KnnQuery(QueryBuilder):
             _, fm = self.filter_query.execute(ctx)
             mask = mask & fm
         scores = jnp.where(mask, scores, 0.0)
+        cut = self.k or self.num_candidates
+        if cut is not None and cut < ctx.n_docs_padded:
+            # keep only the k nearest per segment (the gather half of
+            # ES's gather-then-merge kNN — the coordinator merge keeps
+            # the global k)
+            kth = jnp.sort(jnp.where(mask, scores, -jnp.inf))[
+                ctx.n_docs_padded - int(cut)]
+            mask = mask & (scores >= kth)
+            scores = jnp.where(mask, scores, 0.0)
         return scores, mask
 
     def rewrite(self, searcher):
@@ -710,7 +721,8 @@ class KnnQuery(QueryBuilder):
         if inner is self.filter_query:
             return self
         q = KnnQuery(self.field, self.query_vector,
-                     num_candidates=self.num_candidates, filter_query=inner)
+                     num_candidates=self.num_candidates, filter_query=inner,
+                     k=self.k)
         q.boost = self.boost
         return q
 
@@ -1892,7 +1904,8 @@ def _parse_knn(spec):
     filt = spec.get("filter")
     return KnnQuery(spec["field"], spec["query_vector"],
                     num_candidates=spec.get("num_candidates"),
-                    filter_query=parse_query(filt) if filt else None)
+                    filter_query=parse_query(filt) if filt else None,
+                    k=spec.get("k"))
 
 
 def _parse_dis_max(spec):

@@ -39,6 +39,34 @@ DEFAULT_SIZE = 10
 _slowlog_logger = logging.getLogger("index.search.slowlog")
 
 
+def _knn_clauses(knn) -> List[Dict[str, Any]]:
+    """Top-level knn spec(s) → knn query clauses; the top-level `k`
+    becomes the clause's candidate cut (KnnQuery keeps the k nearest
+    per shard, the gather half of ES's gather-then-merge kNN)."""
+    specs = knn if isinstance(knn, list) else [knn]
+    out = []
+    for spec in specs:
+        clause = {k: v for k, v in spec.items() if k != "k"}
+        if spec.get("k") is not None:
+            clause["k"] = int(spec["k"])
+        out.append({"knn": clause})
+    return out
+
+
+def _merge_knn_into_query(body: Dict[str, Any]) -> Dict[str, Any]:
+    """Top-level `knn` sections without rrf combine with the query by
+    score-sum (the modern ES hybrid default): bool should of all parts."""
+    body = dict(body)
+    clauses = _knn_clauses(body.pop("knn"))
+    q = body.get("query")
+    if q is None and len(clauses) == 1:
+        body["query"] = clauses[0]
+    else:
+        body["query"] = {"bool": {
+            "should": ([q] if q is not None else []) + clauses}}
+    return body
+
+
 class _CoordinatorRewriteContext:
     """A searcher-shaped view over every shard, for coordinator rewrites
     (ref: Rewriteable's coordinator-rewrite stage): ``segments`` spans all
@@ -197,6 +225,22 @@ class SearchService:
                 swapped.append((name, s2))
             searchers = swapped
 
+        # ---- hybrid retrieval (net-new surface, BASELINE.md config 5):
+        # top-level `knn` sections + optional `rank.rrf` fusion
+        rank_spec = (body or {}).get("rank")
+        if rank_spec is not None and not isinstance(rank_spec, dict):
+            raise IllegalArgumentException("[rank] must be an object")
+        if rank_spec and rank_spec.get("rrf") is not None:
+            if scroll is not None:
+                raise IllegalArgumentException(
+                    "[rank] cannot be used with [scroll]")
+            response = self._rrf_search(searchers, body, task)
+            response["took"] = int((time.monotonic() - start) * 1000)
+            self._after_search(names, response["took"], body)
+            return response
+        if body and body.get("knn") is not None:
+            body = _merge_knn_into_query(body)
+
         scroll_ctx = None
         if scroll is not None:
             keep_alive = parse_time_value(scroll, "scroll")
@@ -214,6 +258,74 @@ class SearchService:
             response["_scroll_id"] = scroll_ctx.scroll_id
         self._after_search(names, response["took"], body)
         return response
+
+    def _rrf_search(self, searchers, body: Dict[str, Any],
+                    task) -> Dict[str, Any]:
+        """Reciprocal rank fusion over the query and knn branches
+        (net-new surface per BASELINE.md — the reference has no RRF at
+        this version; semantics follow the modern `rank.rrf` API:
+        score(d) = Σ_branches 1 / (rank_constant + rank_d))."""
+        rrf = (body.get("rank") or {}).get("rrf") or {}
+        k_const = int(rrf.get("rank_constant", 60))
+        size = int(body.get("size", DEFAULT_SIZE))
+        from_ = int(body.get("from", 0))
+        window = int(rrf.get("window_size",
+                             rrf.get("rank_window_size",
+                                     max(100, size + from_))))
+        branches: List[Dict[str, Any]] = []
+        if body.get("query") is not None:
+            branches.append({"query": body["query"]})
+        knn = body.get("knn")
+        if knn is not None:
+            branches.extend({"query": c} for c in _knn_clauses(knn))
+        if not branches:
+            raise IllegalArgumentException(
+                "rrf requires at least one of [query, knn]")
+        passthrough = {k: v for k, v in body.items()
+                       if k in ("_source", "fields", "post_filter",
+                                "min_score", "track_total_hits",
+                                "highlight")}
+        scores: Dict[Tuple[str, str], float] = {}
+        best_hit: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        truncated = False
+        aggregations = None
+        for bi, br in enumerate(branches):
+            sub = {**passthrough, **br, "size": window}
+            if bi == 0:
+                # aggs compute once, over the first (query) branch
+                for agg_key in ("aggs", "aggregations"):
+                    if agg_key in body:
+                        sub[agg_key] = body[agg_key]
+            r = self._execute(searchers, sub, task=task)
+            if bi == 0 and "aggregations" in r:
+                aggregations = r["aggregations"]
+            hits = r["hits"]["hits"]
+            if len(hits) >= window:
+                truncated = True
+            for rank_i, h in enumerate(hits):
+                key = (h["_index"], h["_id"])
+                scores[key] = scores.get(key, 0.0) + 1.0 / (
+                    k_const + rank_i + 1)
+                best_hit.setdefault(key, h)
+        order = sorted(scores, key=lambda key: (-scores[key], key))
+        hits = []
+        for key in order[from_: from_ + size]:
+            h = dict(best_hit[key])
+            h["_score"] = scores[key]
+            hits.append(h)
+        out = {
+            "timed_out": False,
+            "_shards": {"total": len(searchers),
+                        "successful": len(searchers),
+                        "skipped": 0, "failed": 0},
+            "hits": {"total": {"value": len(scores),
+                               "relation": "gte" if truncated else "eq"},
+                     "max_score": hits[0]["_score"] if hits else None,
+                     "hits": hits},
+        }
+        if aggregations is not None:
+            out["aggregations"] = aggregations
+        return out
 
     def _after_search(self, names: List[str], took_ms: int,
                       body: Dict[str, Any]):

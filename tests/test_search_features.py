@@ -577,3 +577,79 @@ def test_dfs_query_then_fetch_consistent_idf(tmp_path_factory):
     # IDF the identical docs a and b MUST score identically
     assert scores["a"] == scores["b"]
     indices.close()
+
+
+def _hybrid_index(tmp_path_factory):
+    from elasticsearch_tpu.index.service import IndicesService
+    from elasticsearch_tpu.search.service import SearchService
+    tmp = tmp_path_factory.mktemp("hybrid")
+    indices = IndicesService(str(tmp / "data"))
+    idx = indices.create_index("h", {}, {"properties": {
+        "t": {"type": "text"},
+        "v": {"type": "dense_vector", "dims": 4}}})
+    docs = {
+        "text-hit": {"t": "quantum computing hardware", "v": [0, 0, 0, 1.0]},
+        "vec-hit": {"t": "gardening tips", "v": [1.0, 0, 0, 0]},
+        "both-hit": {"t": "quantum computing", "v": [0.9, 0.1, 0, 0]},
+        "neither": {"t": "cooking pasta", "v": [0, 1.0, 0, 0]},
+    }
+    for did, d in docs.items():
+        idx.index_doc(did, d)
+    idx.refresh()
+    return indices, SearchService(indices)
+
+
+def test_top_level_knn_merges_with_query(tmp_path_factory):
+    indices, svc = _hybrid_index(tmp_path_factory)
+    r = svc.search("h", {
+        "query": {"match": {"t": {"query": "quantum"}}},
+        "knn": {"field": "v", "query_vector": [1.0, 0, 0, 0]},
+        "size": 4})
+    ids = [h["_id"] for h in r["hits"]["hits"]]
+    # both-hit scores from BOTH branches → first
+    assert ids[0] == "both-hit"
+    assert set(ids) >= {"both-hit", "vec-hit", "text-hit"}
+    indices.close()
+
+
+def test_rrf_hybrid_fusion(tmp_path_factory):
+    indices, svc = _hybrid_index(tmp_path_factory)
+    r = svc.search("h", {
+        "query": {"match": {"t": {"query": "quantum"}}},
+        "knn": {"field": "v", "query_vector": [1.0, 0, 0, 0]},
+        "rank": {"rrf": {"rank_constant": 60, "window_size": 10}},
+        "size": 4})
+    hits = r["hits"]["hits"]
+    assert [h["_id"] for h in hits][0] == "both-hit"  # in both branches
+    # RRF score of the winner = sum over branches of 1/(60+rank)
+    assert hits[0]["_score"] > hits[1]["_score"]
+    assert hits[0]["_score"] == pytest.approx(1 / 61 + 1 / 62, rel=1e-6)
+    indices.close()
+
+
+def test_top_level_knn_k_limits_matches(tmp_path_factory):
+    from elasticsearch_tpu.index.service import IndicesService
+    from elasticsearch_tpu.search.service import SearchService
+    tmp = tmp_path_factory.mktemp("knnk")
+    indices = IndicesService(str(tmp / "data"))
+    idx = indices.create_index("k", {}, {"properties": {
+        "v": {"type": "dense_vector", "dims": 2}}})
+    import math
+    for i in range(20):
+        a = i * math.pi / 40
+        idx.index_doc(str(i), {"v": [math.cos(a), math.sin(a)]})
+    idx.refresh()
+    svc = SearchService(indices)
+    r = svc.search("k", {"knn": {"field": "v", "query_vector": [1.0, 0.0],
+                                 "k": 3},
+                         "size": 20, "track_total_hits": True})
+    # only the 3 nearest vectors match, not all 20
+    assert r["hits"]["total"]["value"] == 3
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["0", "1", "2"]
+    # rrf + scroll is rejected
+    import pytest as _pytest
+    from elasticsearch_tpu.common.errors import IllegalArgumentException
+    with _pytest.raises(IllegalArgumentException):
+        svc.search("k", {"knn": {"field": "v", "query_vector": [1, 0]},
+                         "rank": {"rrf": {}}}, scroll="1m")
+    indices.close()
