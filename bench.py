@@ -234,7 +234,9 @@ def run_secondary_configs(corpus, queries, rng, handles):
     import jax
     import jax.numpy as jnp
 
-    from elasticsearch_tpu.ops.bm25 import bm25_sorted_topk, match_count
+    from elasticsearch_tpu.ops.bm25 import (bm25_sorted_topk,
+                                            bm25_sorted_topk_batch,
+                                            match_count)
 
     (block_docids, block_tfs, tbs, nb, df, lens, *_rest) = corpus
     dev = jax.devices()[0]
@@ -358,6 +360,54 @@ def run_secondary_configs(corpus, queries, rng, handles):
     out["rrf_hybrid"] = len(hplans) / (time.time() - t0)
     for cfg in ("bool+filters", "script_score", "knn", "rrf_hybrid"):
         log(f"secondary [{cfg}]: {out[cfg]:.1f} qps")
+
+    # ---- serving shape: continuous batching (many queries per launch) ---
+    # (its failure must not discard the configs measured above)
+    try:
+        _batched_config(out, base_plans, batch_topk_args=(
+            d_docids, d_tfs, d_lens, d_live), avg=avg, k1=k1, b=b)
+    except Exception as e:
+        log(f"batched config failed: {e!r}")
+    return out
+
+
+def _batched_config(out, base_plans, batch_topk_args, avg, k1, b):
+    import jax
+
+    from elasticsearch_tpu.ops.bm25 import bm25_sorted_topk_batch
+
+    d_docids, d_tfs, d_lens, d_live = batch_topk_args
+    # queries batch by IDENTICAL bucket shape (cheap queries must not pay
+    # an expensive query's padded sort — the size-bucketed dispatch queue
+    # of a serving layer)
+    BATCH = 32
+    by_bucket: dict = {}
+    for s, w in base_plans:
+        by_bucket.setdefault(len(s), []).append((s, w))
+    batches = []
+    for plans_of_size in by_bucket.values():
+        reps_needed = (BATCH // len(plans_of_size)) + 1
+        full = (plans_of_size * reps_needed)[:BATCH]
+        batches.append((np.stack([s for s, _ in full]),
+                        np.stack([w for _, w in full])))
+
+    @jax.jit
+    def batch_topk(bdd, btt, lens_d, live_d, sels, wss):
+        return bm25_sorted_topk_batch(bdd, btt, sels, wss, lens_d, live_d,
+                                      avg, k1, b, K)
+
+    for sel_b, ws_b in batches:          # compile per bucket shape
+        batch_topk(d_docids, d_tfs, d_lens, d_live, sel_b,
+                   ws_b)[0].block_until_ready()
+    t0 = time.time()
+    reps = 3
+    for _ in range(reps):
+        for sel_b, ws_b in batches:
+            batch_topk(d_docids, d_tfs, d_lens, d_live, sel_b,
+                       ws_b)[0].block_until_ready()
+    out["batched"] = BATCH * len(batches) * reps / (time.time() - t0)
+    out["batch_size"] = BATCH
+    log(f"secondary [batched]: {out['batched']:.1f} qps")
     return out
 
 
@@ -378,7 +428,9 @@ def main():
             sec_txt = (f"; also bool+filters {sec['bool+filters']:.0f} qps, "
                        f"script_score {sec['script_score']:.0f} qps, "
                        f"kNN {sec['knn_desc']} {sec['knn']:.0f} qps, "
-                       f"RRF hybrid {sec['rrf_hybrid']:.0f} qps")
+                       f"RRF hybrid {sec['rrf_hybrid']:.0f} qps, "
+                       f"batch-{sec['batch_size']} serving "
+                       f"{sec['batched']:.0f} qps")
         except Exception as e:        # secondary configs must never sink
             log(f"secondary configs failed: {e!r}")
 

@@ -115,6 +115,14 @@ def block_max_scores(block_max_tf: jax.Array,   # float32 [TB]
 # axon backend any executable with a captured device buffer degrades ALL
 # subsequent launches in the process to ~70ms (measured). Literals embed
 # as immediates and are safe.
+#
+# Related axon-tunnel quirk (measured, see bench.py): ANY device→host
+# readback (np.asarray / jax.device_get / scalar .item()) permanently
+# flips the process into the same ~100ms-per-launch mode —
+# block_until_ready alone does not. Benchmarks must do all timing before
+# the first readback; serving paths amortize it by batching many queries
+# per launch (the continuous-batching design, SURVEY.md §7 hard part 5).
+# Real TPU runtimes (non-tunneled) do not behave this way.
 _SENTINEL = 0x7FFFFFFF
 
 
@@ -188,3 +196,24 @@ def bm25_reference_scores(postings_per_term, idfs, doc_lens, avg_len,
             dl = doc_lens[d]
             scores[d] += w * tf / (tf + k1 * (1 - b + b * dl / avg_len))
     return scores
+
+
+def bm25_sorted_topk_batch(block_docids: jax.Array,   # int32 [TB, B]
+                           block_tfs: jax.Array,      # float32 [TB, B]
+                           sel_blocks: jax.Array,     # int32 [Q, NB]
+                           sel_weights: jax.Array,    # float32 [Q, NB]
+                           doc_lens: jax.Array,       # float32 [ND]
+                           live: jax.Array,           # bool [ND]
+                           avg_len, k1: float, b: float, k: int):
+    """Many queries per launch: vmap of bm25_sorted_topk over a [Q, NB]
+    selection batch → ([Q, k] values, [Q, k] docids).
+
+    This is the continuous-batching serving shape (SURVEY.md §7 hard
+    part 5): launch overhead — pathological under the axon tunnel's
+    post-readback ~100ms mode, but real on any runtime — amortizes over
+    Q queries, and the per-query sorts batch onto the VPU. Queries with
+    fewer postings pad their selection with the reserved zero block."""
+    return jax.vmap(
+        lambda s, w: bm25_sorted_topk(block_docids, block_tfs, s, w,
+                                      doc_lens, live, avg_len, k1, b, k)
+    )(sel_blocks, sel_weights)

@@ -212,3 +212,38 @@ def test_device_segment_padding(rng):
     live = np.asarray(dev.live)
     assert live[: seg.n_docs].all()
     assert not live[seg.n_docs:].any()
+
+
+def test_bm25_sorted_topk_batch_matches_single():
+    """The batched (vmapped) kernel must agree with per-query launches
+    (the continuous-batching serving path)."""
+    import jax.numpy as jnp
+    from elasticsearch_tpu.ops.bm25 import (bm25_sorted_topk,
+                                            bm25_sorted_topk_batch)
+    rng = np.random.default_rng(9)
+    tb, blk, nd = 17, 8, 60
+    docids = rng.integers(0, nd, size=(tb, blk)).astype(np.int32)
+    tfs = rng.integers(0, 4, size=(tb, blk)).astype(np.float32)
+    docids[-1] = 0
+    tfs[-1] = 0.0                       # reserved zero block
+    lens = rng.uniform(5, 50, nd).astype(np.float32)
+    live = np.ones(nd, bool)
+    sels = np.array([[0, 3, 5, 16], [1, 2, 16, 16], [7, 8, 9, 10]],
+                    np.int32)
+    ws = np.array([[1.0, 0.5, 0.25, 0.0], [2.0, 1.0, 0.0, 0.0],
+                   [1.0, 1.0, 1.0, 1.0]], np.float32)
+    k = 10
+    bvals, bids = bm25_sorted_topk_batch(
+        jnp.asarray(docids), jnp.asarray(tfs), jnp.asarray(sels),
+        jnp.asarray(ws), jnp.asarray(lens), jnp.asarray(live),
+        np.float32(lens.mean()), 1.2, 0.75, k)
+    for qi in range(len(sels)):
+        svals, sids = bm25_sorted_topk(
+            jnp.asarray(docids), jnp.asarray(tfs),
+            jnp.asarray(sels[qi]), jnp.asarray(ws[qi]),
+            jnp.asarray(lens), jnp.asarray(live),
+            np.float32(lens.mean()), 1.2, 0.75, k)
+        np.testing.assert_allclose(np.asarray(bvals[qi]),
+                                   np.asarray(svals), rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(bids[qi]),
+                                      np.asarray(sids))
