@@ -11,6 +11,7 @@ full API without sockets (the YAML-rest-test model, SURVEY.md §4 tier 5).
 from __future__ import annotations
 
 import json
+import os
 import re
 import time
 import uuid
@@ -238,6 +239,7 @@ def _register_all(c: RestController):
     c.register("GET", "/_searchable_snapshots/stats",
                searchable_snapshot_stats)
     # nodes diagnostics + deprecation + autoscaling
+    c.register("GET", "/_nodes", nodes_info)
     c.register("GET", "/_nodes/hot_threads", hot_threads)
     c.register("GET", "/_migration/deprecations", deprecations)
     c.register("PUT", "/_autoscaling/policy/{name}", autoscaling_put)
@@ -2531,3 +2533,26 @@ def cat_tasks(node, params, body):
 
 def cat_nodeattrs(node, params, body):
     return 200, {"_cat": f"{node.name} 127.0.0.1 127.0.0.1 - -"}
+
+
+def nodes_info(node, params, body):
+    """GET /_nodes — node identity/roles/transport info (ref:
+    action/admin/cluster/node/info/TransportNodesInfoAction)."""
+    import platform
+    import sys as _sys
+    return 200, {
+        "_nodes": {"total": 1, "successful": 1, "failed": 0},
+        "cluster_name": node.cluster_name,
+        "nodes": {node.node_id: {
+            "name": node.name,
+            "transport_address": "127.0.0.1:9300",
+            "host": "127.0.0.1",
+            "ip": "127.0.0.1",
+            "version": __version__,
+            "roles": ["master", "data", "ingest", "ml", "transform"],
+            "os": {"name": platform.system(),
+                   "arch": platform.machine()},
+            "process": {"id": os.getpid() if hasattr(os, "getpid") else 0},
+            "settings": {"node": {"name": node.name}},
+        }},
+    }
