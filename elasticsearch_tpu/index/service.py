@@ -34,11 +34,23 @@ from elasticsearch_tpu.search.context import DeviceSegmentCache
 from elasticsearch_tpu.search.searcher import ShardSearcher
 
 
+from elasticsearch_tpu import native as _native
+
+# resolved once: the routing hash runs per document on the bulk path
+_NATIVE_M3 = None
+if _native.get_lib() is not None:
+    _NATIVE_M3 = _native.get_lib().murmur3_hash_utf16le
+
+
 def murmur3_hash(key: str) -> int:
     """32-bit murmur3 (x86, seed 0) over the UTF-16LE bytes of the routing
     key — bit-exact with the reference's Murmur3HashFunction (ref:
     cluster/routing/Murmur3HashFunction.java hashes char low/high bytes)
-    so doc→shard assignment agrees."""
+    so doc→shard assignment agrees. Native fast path when the host
+    runtime is available (routing runs per document on the bulk path)."""
+    if _NATIVE_M3 is not None:
+        data = key.encode("utf-16-le")
+        return int(_NATIVE_M3(data, len(data)))
     data = key.encode("utf-16-le")
     c1, c2 = 0xCC9E2D51, 0x1B873593
     h = 0

@@ -54,10 +54,38 @@ def get_lib() -> Optional[ctypes.CDLL]:
             _build_failed = True
             return None
         lib = ctypes.CDLL(so)
-        lib.tokenize_ascii.restype = ctypes.c_int
-        lib.tokenize_ascii.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_char_p]
+        try:
+            lib.tokenize_ascii.restype = ctypes.c_int
+            lib.tokenize_ascii.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_char_p]
+            lib.murmur3_hash_utf16le.restype = ctypes.c_int32
+            lib.murmur3_hash_utf16le.argtypes = [ctypes.c_char_p,
+                                                 ctypes.c_int]
+        except AttributeError:
+            # stale cached .so missing a symbol (mtime-preserving copy):
+            # rebuild once from source, else degrade to pure Python
+            try:
+                os.remove(_SO)
+            except OSError:
+                pass
+            so = _build()
+            if so is None:
+                _build_failed = True
+                return None
+            lib = ctypes.CDLL(so)
+            try:
+                lib.tokenize_ascii.restype = ctypes.c_int
+                lib.tokenize_ascii.argtypes = [
+                    ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                    ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+                    ctypes.c_char_p]
+                lib.murmur3_hash_utf16le.restype = ctypes.c_int32
+                lib.murmur3_hash_utf16le.argtypes = [ctypes.c_char_p,
+                                                     ctypes.c_int]
+            except AttributeError:
+                _build_failed = True
+                return None
         lib.varint_delta_encode.restype = ctypes.c_int
         lib.varint_delta_encode.argtypes = [
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
@@ -143,3 +171,13 @@ def count_term_freqs(term_ids: np.ndarray
     if n < 0:
         return None
     return out_terms[:n].copy(), out_tfs[:n].copy()
+
+
+def murmur3_hash(key: str) -> Optional[int]:
+    """Native routing hash (bit-exact with Murmur3HashFunction); None when
+    the native library is unavailable (callers fall back to Python)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    data = key.encode("utf-16-le")
+    return int(lib.murmur3_hash_utf16le(data, len(data)))

@@ -117,4 +117,45 @@ int count_term_freqs(const int32_t* term_ids, int n,
     return j;
 }
 
+// ---------------------------------------------------------------------------
+// Murmur3 x86_32 over UTF-16LE bytes — bit-exact with the reference's
+// routing hash (ref: cluster/routing/Murmur3HashFunction.java), so
+// doc-to-shard assignment computed natively agrees with the Python
+// implementation and with Elasticsearch itself.
+// ---------------------------------------------------------------------------
+int32_t murmur3_hash_utf16le(const uint8_t* data, int len) {
+  const uint32_t c1 = 0xcc9e2d51u;
+  const uint32_t c2 = 0x1b873593u;
+  uint32_t h = 0;
+  const int rounded = len & ~0x3;
+  for (int i = 0; i < rounded; i += 4) {
+    uint32_t k;
+    std::memcpy(&k, data + i, 4);
+    k *= c1;
+    k = (k << 15) | (k >> 17);
+    k *= c2;
+    h ^= k;
+    h = (h << 13) | (h >> 19);
+    h = h * 5 + 0xe6546b64u;
+  }
+  uint32_t k = 0;
+  const int tail = len & 0x3;
+  if (tail >= 3) k ^= (uint32_t)data[rounded + 2] << 16;
+  if (tail >= 2) k ^= (uint32_t)data[rounded + 1] << 8;
+  if (tail >= 1) {
+    k ^= (uint32_t)data[rounded];
+    k *= c1;
+    k = (k << 15) | (k >> 17);
+    k *= c2;
+    h ^= k;
+  }
+  h ^= (uint32_t)len;
+  h ^= h >> 16;
+  h *= 0x85ebca6bu;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35u;
+  h ^= h >> 16;
+  return (int32_t)h;
+}
+
 }  // extern "C"

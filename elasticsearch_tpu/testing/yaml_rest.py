@@ -1,0 +1,240 @@
+"""Declarative YAML REST test runner.
+
+Mirrors the reference's YAML REST suite machinery (ref: test/framework/
+.../test/rest/yaml/ESClientYamlSuiteTestCase — SURVEY.md §4 tier 5: the
+same declarative do/match suites run against every distribution).
+Re-design for this engine: suites execute against the in-process
+RestController (no sockets needed — the controller is transport-agnostic
+by design), with the reference's assertion vocabulary:
+
+  - do:        run an API call. Either an api shorthand
+                 (`search: {index: i, body: {...}}`) or
+                 `raw: {method, path, params, body}`.
+  - match:     dot-path equality against the last response
+  - length:    dot-path collection length
+  - is_true / is_false / gt / gte / lt / lte
+  - set:       capture a response value into a variable ($var)
+
+Each test in a file runs against a fresh node unless the file declares
+`setup:` steps (run once per test, like the reference's per-test setup).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+# api-name shorthands → (method, path template). {x} fills from the call
+# body's top-level keys; remaining keys become params/body.
+_APIS = {
+    "indices.create": ("PUT", "/{index}"),
+    "indices.delete": ("DELETE", "/{index}"),
+    "indices.refresh": ("POST", "/{index}/_refresh"),
+    "indices.get_mapping": ("GET", "/{index}/_mapping"),
+    "indices.put_mapping": ("PUT", "/{index}/_mapping"),
+    "indices.get_settings": ("GET", "/{index}/_settings"),
+    "indices.close": ("POST", "/{index}/_close"),
+    "indices.open": ("POST", "/{index}/_open"),
+    "indices.stats": ("GET", "/{index}/_stats"),
+    "index": ("PUT", "/{index}/_doc/{id}"),
+    "create": ("PUT", "/{index}/_create/{id}"),
+    "get": ("GET", "/{index}/_doc/{id}"),
+    "delete": ("DELETE", "/{index}/_doc/{id}"),
+    "update": ("POST", "/{index}/_update/{id}"),
+    "search": ("POST", "/{index}/_search"),
+    "count": ("POST", "/{index}/_count"),
+    "bulk": ("POST", "/_bulk"),
+    "mget": ("POST", "/{index}/_mget"),
+    "cluster.health": ("GET", "/_cluster/health"),
+    "cat.indices": ("GET", "/_cat/indices"),
+    "ingest.put_pipeline": ("PUT", "/_ingest/pipeline/{id}"),
+    "ingest.simulate": ("POST", "/_ingest/pipeline/_simulate"),
+    "sql.query": ("POST", "/_sql"),
+    "eql.search": ("POST", "/{index}/_eql/search"),
+    "ml.put_job": ("PUT", "/_ml/anomaly_detectors/{id}"),
+    "watcher.put_watch": ("PUT", "/_watcher/watch/{id}"),
+    "rank_eval": ("POST", "/{index}/_rank_eval"),
+}
+
+
+class YamlTestFailure(AssertionError):
+    pass
+
+
+def _resolve_path(obj: Any, path: str):
+    """`hits.hits.0._source.title` style dot path; $body = whole response."""
+    if path in ("$body", ""):
+        return obj
+    cur = obj
+    for raw in re.split(r"\.(?![^\[]*\])", path):
+        part = raw.strip()
+        if isinstance(cur, dict):
+            if part not in cur:
+                # ES YAML allows escaped dotted keys like "a\.b"
+                raise YamlTestFailure(f"path [{path}]: missing [{part}]")
+            cur = cur[part]
+        elif isinstance(cur, list):
+            try:
+                cur = cur[int(part)]
+            except (ValueError, IndexError):
+                raise YamlTestFailure(f"path [{path}]: bad index [{part}]")
+        else:
+            raise YamlTestFailure(f"path [{path}]: hit a leaf at [{part}]")
+    return cur
+
+
+class YamlRestRunner:
+    """`node_factory` yields a fresh node per test (the reference wipes
+    cluster state between YAML tests)."""
+
+    def __init__(self, node_factory):
+        self.node_factory = node_factory
+        self.node = None
+        self.last_response: Any = None
+        self.last_status: int = 0
+        self.vars: Dict[str, Any] = {}
+
+    # ----------------------------------------------------------- running
+    def run_file(self, path: str):
+        with open(path) as fh:
+            docs = list(yaml.safe_load_all(fh))
+        suite: Dict[str, List] = {}
+        for doc in docs:
+            if doc:
+                suite.update(doc)
+        setup = suite.pop("setup", None)
+        suite.pop("teardown", None)
+        for test_name, steps in suite.items():
+            self.node = self.node_factory()
+            self.vars = {}
+            try:
+                if setup:
+                    self._run_steps(setup, f"{path}::setup")
+                self._run_steps(steps, f"{path}::{test_name}")
+            finally:
+                self.node.close()
+                self.node = None
+
+    def _run_steps(self, steps: List[Dict[str, Any]], where: str):
+        for step in steps:
+            (kind, body), = step.items()
+            try:
+                self._step(kind, body)
+            except YamlTestFailure as e:
+                raise YamlTestFailure(f"{where}: {e}") from None
+
+    # ------------------------------------------------------------- steps
+    def _step(self, kind: str, body: Any):
+        if kind == "do":
+            self._do(body)
+        elif kind == "match":
+            (path, expected), = body.items()
+            actual = _resolve_path(self.last_response,
+                                   self._subst(path))
+            expected = self._subst(expected)
+            if isinstance(expected, str) and expected.startswith("/") \
+                    and expected.endswith("/"):
+                if re.search(expected.strip("/"), str(actual)) is None:
+                    raise YamlTestFailure(
+                        f"match {path}: [{actual}] !~ {expected}")
+            elif actual != expected:
+                raise YamlTestFailure(
+                    f"match {path}: [{actual!r}] != [{expected!r}]")
+        elif kind == "length":
+            (path, expected), = body.items()
+            actual = _resolve_path(self.last_response, self._subst(path))
+            expected = self._subst(expected)
+            if len(actual) != expected:
+                raise YamlTestFailure(
+                    f"length {path}: {len(actual)} != {expected}")
+        elif kind in ("is_true", "is_false"):
+            v = _resolve_path(self.last_response, self._subst(body))
+            truthy = bool(v) and v not in ("false",)
+            if truthy != (kind == "is_true"):
+                raise YamlTestFailure(f"{kind} {body}: got [{v!r}]")
+        elif kind in ("gt", "gte", "lt", "lte"):
+            (path, expected), = body.items()
+            expected = self._subst(expected)
+            actual = _resolve_path(self.last_response, self._subst(path))
+            ok = {"gt": actual > expected, "gte": actual >= expected,
+                  "lt": actual < expected, "lte": actual <= expected}[kind]
+            if not ok:
+                raise YamlTestFailure(
+                    f"{kind} {path}: {actual} vs {expected}")
+        elif kind == "set":
+            (path, var), = body.items()
+            self.vars[var] = _resolve_path(self.last_response,
+                                           self._subst(path))
+        else:
+            raise YamlTestFailure(f"unknown step [{kind}]")
+
+    def _do(self, body: Dict[str, Any]):
+        body = dict(body)
+        catch = body.pop("catch", None)
+        (api, spec), = body.items()
+        spec = self._subst(spec) or {}
+        if api == "raw":
+            method = spec.get("method", "GET")
+            path = spec.get("path", "/")
+            params = spec.get("params", {}) or {}
+            req_body = spec.get("body")
+        elif api in _APIS:
+            method, template = _APIS[api]
+            spec = dict(spec)
+            req_body = spec.pop("body", None)
+            path = re.sub(r"{(\w+)}",
+                          lambda m: str(spec.pop(m.group(1), "")),
+                          template).rstrip("/")
+            # index-less search etc: collapse double slashes
+            path = re.sub(r"//+", "/", path) or "/"
+            params = {k: str(v) for k, v in spec.items()}
+        else:
+            raise YamlTestFailure(f"unknown api [{api}]")
+        status, resp = self.node.rest_controller.dispatch(
+            method, path, params, req_body)
+        self.last_status, self.last_response = status, resp
+        if catch is not None:
+            named = {"missing": 404, "conflict": 409,
+                     "bad_request": 400, "forbidden": 403,
+                     "unauthorized": 401, "param": 400}
+            if status < 400:
+                raise YamlTestFailure(
+                    f"do[catch={catch}]: expected an error, got {status}")
+            if catch in named:
+                if status != named[catch]:
+                    raise YamlTestFailure(
+                        f"do[catch={catch}]: expected {named[catch]}, "
+                        f"got {status}: {resp}")
+            elif catch == "request":
+                # ES semantics: any error not covered by the named ones
+                pass
+            elif (isinstance(catch, str) and catch.startswith("/")
+                    and catch.endswith("/")):
+                # regex catch checks the error body (ES /pattern/ form)
+                import json as _json
+                if re.search(catch.strip("/"), _json.dumps(resp)) is None:
+                    raise YamlTestFailure(
+                        f"do[catch={catch}]: error body does not match: "
+                        f"{resp}")
+            else:
+                raise YamlTestFailure(f"unknown catch [{catch}]")
+        elif status >= 400:
+            raise YamlTestFailure(
+                f"do[{api}]: HTTP {status}: {resp}")
+
+    def _subst(self, value):
+        """$var substitution anywhere in strings/containers."""
+        if isinstance(value, str):
+            for name, v in self.vars.items():
+                if value == f"${name}":
+                    return v
+                value = value.replace(f"${name}", str(v))
+            return value
+        if isinstance(value, dict):
+            return {self._subst(k): self._subst(v)
+                    for k, v in value.items()}
+        if isinstance(value, list):
+            return [self._subst(v) for v in value]
+        return value

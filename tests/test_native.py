@@ -72,3 +72,53 @@ def test_count_term_freqs_parity():
     order = np.argsort(terms)
     np.testing.assert_array_equal(terms[order], expected_terms)
     np.testing.assert_array_equal(tfs[order].astype(int), expected_counts)
+
+
+def _py_murmur3(key: str) -> int:
+    """Pure-Python spec copy of Murmur3HashFunction (the oracle)."""
+    import struct
+    data = key.encode("utf-16-le")
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = 0
+    rounded = len(data) & ~0x3
+    for i in range(0, rounded, 4):
+        (k,) = struct.unpack_from("<i", data, i)
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k = 0
+    tail = len(data) & 0x3
+    if tail >= 3:
+        k ^= data[rounded + 2] << 16
+    if tail >= 2:
+        k ^= data[rounded + 1] << 8
+    if tail >= 1:
+        k ^= data[rounded]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= len(data)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h - 0x100000000 if h >= 0x80000000 else h
+
+
+def test_routing_hash_matches_spec():
+    """The routing hash (native fast path or Python fallback) must stay
+    bit-exact with Murmur3HashFunction across key shapes."""
+    import random
+    import string
+    from elasticsearch_tpu.index.service import murmur3_hash
+    rng = random.Random(5)
+    for _ in range(500):
+        key = "".join(rng.choices(string.printable + "日本語éüß🙂",
+                                  k=rng.randrange(0, 50)))
+        assert murmur3_hash(key) == _py_murmur3(key), repr(key)
+    assert murmur3_hash("") == 0

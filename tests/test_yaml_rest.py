@@ -1,0 +1,26 @@
+"""Declarative YAML REST suites (SURVEY.md §4 tier 5 — the
+ESClientYamlSuiteTestCase model): suites in tests/yaml_suites/ run
+against a fresh in-process node per test."""
+
+import glob
+import os
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.testing.yaml_rest import YamlRestRunner
+
+SUITES = sorted(glob.glob(os.path.join(
+    os.path.dirname(__file__), "yaml_suites", "*.yml")))
+
+
+@pytest.mark.parametrize("suite", SUITES,
+                         ids=[os.path.basename(s) for s in SUITES])
+def test_yaml_suite(suite, tmp_path):
+    counter = [0]
+
+    def factory():
+        counter[0] += 1
+        return Node(data_path=str(tmp_path / f"n{counter[0]}"))
+
+    YamlRestRunner(factory).run_file(suite)
