@@ -85,8 +85,18 @@ class CustomAnalyzer(Analyzer):
     def analyze(self, text: str) -> List[Token]:
         for cf in self.char_filters:
             text = cf.apply(text)
-        tokens = self.tokenizer.tokenize(text)
-        for tf in self.token_filters:
+        filters = self.token_filters
+        if (isinstance(self.tokenizer, StandardTokenizer)
+                and self.tokenizer.native_lowercase):
+            tokens, lowered = self.tokenizer.tokenize_flagged(text)
+            if lowered and filters and isinstance(filters[0],
+                                                  LowercaseFilter):
+                # native path already lowercased — drop the redundant
+                # filter pass (the indexing chain's hottest loop)
+                filters = filters[1:]
+        else:
+            tokens = self.tokenizer.tokenize(text)
+        for tf in filters:
             tokens = tf.filter(tokens)
         return tokens
 

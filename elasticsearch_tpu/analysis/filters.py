@@ -34,9 +34,13 @@ class LowercaseFilter(TokenFilter):
     name = "lowercase"
 
     def filter(self, tokens):
-        return [Token(t.term.lower(), t.position, t.start_offset,
-                      t.end_offset, t.keyword)
-                for t in tokens]
+        out = []
+        for t in tokens:
+            low = t.term.lower()
+            out.append(t if low == t.term
+                       else Token(low, t.position, t.start_offset,
+                                  t.end_offset, t.keyword))
+        return out
 
 
 class UppercaseFilter(TokenFilter):

@@ -64,13 +64,19 @@ class StandardTokenizer(Tokenizer):
         self.native_lowercase = native_lowercase
 
     def tokenize(self, text: str) -> List[Token]:
+        return self.tokenize_flagged(text)[0]
+
+    def tokenize_flagged(self, text: str):
+        """(tokens, already_lowercased) — True only when the native
+        pre-lowercasing path actually ran, so the analyzer can skip a
+        following LowercaseFilter (the dominant indexing-chain cost)."""
         if self.native_lowercase and text.isascii():
             from elasticsearch_tpu import native
             toks = native.tokenize_ascii(text, self.max_token_length)
             if toks is not None:
                 return [Token(term, pos, s, e)
-                        for pos, (term, s, e) in enumerate(toks)]
-        return self._tokenize_py(text)
+                        for pos, (term, s, e) in enumerate(toks)], True
+        return self._tokenize_py(text), False
 
     def _tokenize_py(self, text: str) -> List[Token]:
         out: List[Token] = []
