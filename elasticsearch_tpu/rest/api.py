@@ -411,6 +411,10 @@ def _register_all(c: RestController):
     c.register("PUT", "/{index}/_create/{id}", create_doc)
     c.register("POST", "/{index}/_create/{id}", create_doc)
     c.register("GET", "/{index}/_doc/{id}", get_doc)
+    c.register("GET", "/{index}/_termvectors/{id}", termvectors)
+    c.register("POST", "/{index}/_termvectors/{id}", termvectors)
+    c.register("POST", "/{index}/_mtermvectors", mtermvectors)
+    c.register("GET", "/{index}/_mtermvectors", mtermvectors)
     c.register("DELETE", "/{index}/_doc/{id}", delete_doc)
     c.register("GET", "/{index}/_source/{id}", get_source)
     c.register("POST", "/{index}/_update/{id}", update_doc)
@@ -2556,3 +2560,103 @@ def nodes_info(node, params, body):
             "settings": {"node": {"name": node.name}},
         }},
     }
+
+
+# --------------------------------------------------------------------------
+# term vectors (ref: action/termvectors/TransportTermVectorsAction — here
+# recomputed from _source through the field's analyzer, the same strategy
+# the reference uses when vectors are not stored)
+# --------------------------------------------------------------------------
+
+def _termvectors_for(node, index, doc_id, body,
+                     routing: Optional[str] = None):
+    body = body or {}
+    if doc_id is None:
+        return {"_index": index, "_id": None, "found": False,
+                "error": {"type": "illegal_argument_exception",
+                          "reason": "[_id] is required"}}
+    # aliases/data streams resolve like every other doc endpoint
+    index = node.metadata_service.write_target(index)
+    idx = node.indices_service.get(index)
+    result = idx.get_doc(doc_id, routing=body.get("routing", routing))
+    if result is None or not getattr(result, "found", True):
+        return {"_index": index, "_id": doc_id, "found": False}
+    source = result.source if hasattr(result, "source") else result
+    if source is None:
+        return {"_index": index, "_id": doc_id, "found": False}
+    fields = body.get("fields")
+    want_term_stats = bool(body.get("term_statistics", False))
+    tv: Dict[str, Any] = {}
+    from elasticsearch_tpu.search.context import ShardStats
+    stats = ShardStats([seg for shard in idx.shards
+                        for seg in shard.segments])
+    analysis = idx.mapper.mapper.analysis
+    for fname, ft in idx.mapper.mapper.fields.items():
+        if ft.type_name != "text":
+            continue
+        if fields and fname not in fields:
+            continue
+        value = source.get(fname) if isinstance(source, dict) else None
+        if value is None:
+            continue
+        name = getattr(ft, "analyzer_name", "standard")
+        try:
+            analyzer = analysis.get(name)
+        except Exception:
+            analyzer = analysis.get("standard")   # indexing's fallback
+        # arrays analyze per value with the indexing chain's position gap
+        values = value if isinstance(value, list) else [value]
+        terms: Dict[str, Any] = {}
+        pos_base = 0
+        for v in values:
+            max_pos = -1
+            for tok in analyzer.analyze(str(v)):
+                entry = terms.setdefault(tok.term, {"term_freq": 0,
+                                                    "tokens": []})
+                entry["term_freq"] += 1
+                entry["tokens"].append({
+                    "position": pos_base + tok.position,
+                    "start_offset": tok.start_offset,
+                    "end_offset": tok.end_offset})
+                max_pos = max(max_pos, pos_base + tok.position)
+            pos_base = max_pos + 100        # the multi-value gap
+        if want_term_stats:
+            for term, entry in terms.items():
+                entry["doc_freq"] = stats.doc_freq(fname, term)
+        if terms:
+            n_docs, _ = stats.field_stats(fname)
+            tv[fname] = {
+                "field_statistics": {"doc_count": n_docs},
+                "terms": terms,
+            }
+    return {"_index": index, "_id": doc_id, "found": True,
+            "term_vectors": tv}
+
+
+def termvectors(node, params, body, index, id):
+    body = dict(body or {})
+    if "fields" in params and "fields" not in body:
+        body["fields"] = params["fields"].split(",")
+    if params.get("term_statistics") in ("true", ""):
+        body["term_statistics"] = True
+    return 200, _termvectors_for(node, index, id, body,
+                                 routing=params.get("routing"))
+
+
+def mtermvectors(node, params, body, index):
+    body = body or {}
+    out = []
+
+    def one(target_index, doc_id, spec):
+        # per-doc failures become error entries, never request failures
+        try:
+            return _termvectors_for(node, target_index, doc_id, spec)
+        except ElasticsearchTpuException as e:
+            return {"_index": target_index, "_id": doc_id,
+                    "found": False, "error": e.to_xcontent()}
+
+    for spec in body.get("docs", []):
+        out.append(one(spec.get("_index", index), spec.get("_id"), spec))
+    for doc_id in body.get("ids", []):
+        out.append(one(index, doc_id, body))
+    return 200, {"docs": out}

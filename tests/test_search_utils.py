@@ -188,3 +188,57 @@ def test_msearch_template(node):
     ])
     assert r["responses"][0]["hits"]["total"]["value"] == 5
     assert r["responses"][1]["hits"]["total"]["value"] == 10
+
+
+def test_termvectors_api(tmp_path):
+    from elasticsearch_tpu.node import Node
+    n = Node(data_path=str(tmp_path / "tv"))
+    n.indices_service.create_index("tv", {}, {
+        "properties": {"t": {"type": "text"}, "k": {"type": "keyword"}}})
+    idx = n.indices_service.get("tv")
+    idx.index_doc("1", {"t": "the quick quick fox", "k": "skip"})
+    idx.index_doc("2", {"t": "lazy fox"})
+    idx.refresh()
+    st, r = n.rest_controller.dispatch(
+        "GET", "/tv/_termvectors/1", {"term_statistics": "true"})
+    assert st == 200 and r["found"]
+    terms = r["term_vectors"]["t"]["terms"]
+    assert terms["quick"]["term_freq"] == 2
+    assert len(terms["quick"]["tokens"]) == 2
+    assert terms["fox"]["doc_freq"] == 2          # both docs have fox
+    assert "k" not in r["term_vectors"]            # keyword not vectorized
+    # missing doc
+    st, r = n.rest_controller.dispatch("GET", "/tv/_termvectors/404", {})
+    assert r["found"] is False
+    # multi
+    st, r = n.rest_controller.dispatch(
+        "POST", "/tv/_mtermvectors", {}, {"ids": ["1", "2"]})
+    assert [d["found"] for d in r["docs"]] == [True, True]
+    n.close()
+
+
+def test_termvectors_arrays_routing_and_errors(tmp_path):
+    from elasticsearch_tpu.node import Node
+    n = Node(data_path=str(tmp_path / "tv2"))
+    n.indices_service.create_index("tv2", {"index.number_of_shards": 3}, {
+        "properties": {"t": {"type": "text"}}})
+    idx = n.indices_service.get("tv2")
+    idx.index_doc("1", {"t": ["quick fox", "lazy dog"]}, routing="abc")
+    idx.refresh()
+    # routing-aware lookup
+    st, r = n.rest_controller.dispatch(
+        "GET", "/tv2/_termvectors/1", {"routing": "abc"})
+    assert r["found"], r
+    terms = r["term_vectors"]["t"]["terms"]
+    # per-value analysis with the multi-value position gap, no list repr
+    assert set(terms) == {"quick", "fox", "lazy", "dog"}
+    assert terms["lazy"]["tokens"][0]["position"] >= 100
+    # per-doc errors don't abort mtermvectors
+    st, r = n.rest_controller.dispatch(
+        "POST", "/tv2/_mtermvectors", {},
+        {"docs": [{"_index": "nope", "_id": "1"},
+                  {"_index": "tv2"},
+                  {"_index": "tv2", "_id": "1", "routing": "abc"}]})
+    assert st == 200
+    assert [d["found"] for d in r["docs"]] == [False, False, True]
+    n.close()
