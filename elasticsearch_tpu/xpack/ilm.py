@@ -322,7 +322,8 @@ class IndexLifecycleService:
                     "[searchable_snapshot] requires [snapshot_repository]")
             snap = f"ilm-{idx.name}-{int(now_ms)}"
             self.repositories.get_repository(repo).snapshot(snap, [idx])
-            idx.update_settings({"index.store.snapshot.repository_name": repo,
+            idx.update_settings({"index.store.type": "snapshot",
+                                 "index.store.snapshot.repository_name": repo,
                                  "index.store.snapshot.snapshot_name": snap,
                                  "index.blocks.write": True})
             return True
