@@ -240,6 +240,8 @@ def _register_all(c: RestController):
                searchable_snapshot_stats)
     # nodes diagnostics + deprecation + autoscaling
     c.register("GET", "/_nodes", nodes_info)
+    c.register("GET", "/_xpack", xpack_info)
+    c.register("GET", "/_license", license_info)
     c.register("GET", "/_nodes/hot_threads", hot_threads)
     c.register("GET", "/_migration/deprecations", deprecations)
     c.register("PUT", "/_autoscaling/policy/{name}", autoscaling_put)
@@ -2660,3 +2662,29 @@ def mtermvectors(node, params, body, index):
     for doc_id in body.get("ids", []):
         out.append(one(index, doc_id, body))
     return 200, {"docs": out}
+
+
+def xpack_info(node, params, body):
+    """GET /_xpack — feature availability (ref: XPackInfoAction); every
+    feature ships enabled under the basic license here."""
+    features = ["analytics", "async_search", "autoscaling", "ccr", "enrich",
+                "eql", "frozen_indices", "graph", "ilm", "logstash", "ml",
+                "monitoring", "rollup", "searchable_snapshots", "security",
+                "slm", "sql", "transform", "voting_only", "watcher"]
+    return 200, {
+        "build": {"date": "2026-01-01T00:00:00.000Z"},
+        "license": {"uid": node.node_id, "type": "basic",
+                    "mode": "basic", "status": "active"},
+        "features": {f: {"available": True,
+                         "enabled": (f != "security"
+                                     or node.security_service.enabled)}
+                     for f in features},
+    }
+
+
+def license_info(node, params, body):
+    return 200, {"license": {
+        "status": "active", "uid": node.node_id, "type": "basic",
+        "issue_date_in_millis": 0, "max_nodes": 1000,
+        "issued_to": node.cluster_name, "issuer": "elasticsearch_tpu",
+        "start_date_in_millis": -1}}
