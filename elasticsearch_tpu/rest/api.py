@@ -2664,6 +2664,14 @@ def mtermvectors(node, params, body, index):
     return 200, {"docs": out}
 
 
+def _license_dict(node) -> Dict[str, Any]:
+    """One license source for /_license and /_xpack (they must agree)."""
+    return {"status": "active", "uid": node.node_id, "type": "basic",
+            "mode": "basic", "issue_date_in_millis": 0, "max_nodes": 1000,
+            "issued_to": node.cluster_name, "issuer": "elasticsearch_tpu",
+            "start_date_in_millis": -1}
+
+
 def xpack_info(node, params, body):
     """GET /_xpack — feature availability (ref: XPackInfoAction); every
     feature ships enabled under the basic license here."""
@@ -2671,10 +2679,10 @@ def xpack_info(node, params, body):
                 "eql", "frozen_indices", "graph", "ilm", "logstash", "ml",
                 "monitoring", "rollup", "searchable_snapshots", "security",
                 "slm", "sql", "transform", "voting_only", "watcher"]
+    lic = _license_dict(node)
     return 200, {
         "build": {"date": "2026-01-01T00:00:00.000Z"},
-        "license": {"uid": node.node_id, "type": "basic",
-                    "mode": "basic", "status": "active"},
+        "license": {k: lic[k] for k in ("uid", "type", "mode", "status")},
         "features": {f: {"available": True,
                          "enabled": (f != "security"
                                      or node.security_service.enabled)}
@@ -2683,8 +2691,4 @@ def xpack_info(node, params, body):
 
 
 def license_info(node, params, body):
-    return 200, {"license": {
-        "status": "active", "uid": node.node_id, "type": "basic",
-        "issue_date_in_millis": 0, "max_nodes": 1000,
-        "issued_to": node.cluster_name, "issuer": "elasticsearch_tpu",
-        "start_date_in_millis": -1}}
+    return 200, {"license": _license_dict(node)}
