@@ -25,6 +25,8 @@ search/phrase.py): device conjunction filter, host position verification.
 
 from __future__ import annotations
 
+import re
+
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -2055,7 +2057,298 @@ def _parse_percolate(spec):
     return parse_percolate(spec)
 
 
+
+
+class IntervalsQuery(QueryBuilder):
+    """ref: index/query/IntervalQueryBuilder — minimal-interval matching
+    with match/any_of/all_of rules and filters; the span family
+    (span_term/span_or/span_near/span_first/span_not/span_containing/
+    span_within) parses onto the same engine (search/intervals.py).
+    Device coarse filter = union of all leaf terms; exact interval
+    algebra verifies candidates host-side (the phrase-query split)."""
+
+    name = "intervals"
+
+    def __init__(self, field: str, rule: Dict[str, Any]):
+        super().__init__()
+        self.field = field
+        self.rule = rule
+
+    # -- rule preparation: analyze leaf text per segment ---------------
+    def _prepare(self, ctx, rule):
+        """Return (resolved rule with _tids, leaf term strings)."""
+        (kind, spec), = ((k, v) for k, v in rule.items()
+                         if k != "boost")
+        pf = ctx.segment.postings.get(self.field)
+        if kind == "match":
+            terms = _analyze_terms(ctx, self.field,
+                                   str(spec.get("query", "")))
+            tids = [pf.term_id(t) if pf is not None else -1
+                    for t in terms]
+            out = dict(spec)
+            out["_tids"] = tids
+            if "filter" in spec and spec["filter"]:
+                fprep = {}
+                for fk, fr in spec["filter"].items():
+                    fprep[fk], _ = self._prepare(ctx, fr)
+                out["filter"] = fprep
+            return {"match": out}, terms
+        if kind == "prefix":
+            prefix = str(spec.get("prefix", ""))
+            exp = (_expand_prefix(pf.terms, prefix, 128)
+                   if pf is not None else [])
+            tids = [pf.term_id(t) for t in exp]
+            return {"prefix": {"_tids": tids}}, exp
+        if kind in ("any_of", "all_of"):
+            kids, leaf_terms = [], []
+            for child in spec.get("intervals", []):
+                prep, terms = self._prepare(ctx, child)
+                kids.append(prep)
+                leaf_terms.extend(terms)
+            out = dict(spec)
+            out["intervals"] = kids
+            if "filter" in spec and spec["filter"]:
+                fprep = {}
+                for fk, fr in spec["filter"].items():
+                    fprep[fk], _ = self._prepare(ctx, fr)
+                out["filter"] = fprep
+            return {kind: out}, leaf_terms
+        from elasticsearch_tpu.common.errors import ParsingException
+        raise ParsingException(f"unknown intervals rule [{kind}]")
+
+    def do_execute(self, ctx):
+        from elasticsearch_tpu.search import intervals as iv
+        z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+        empty = (z, z.astype(bool))
+        seg = ctx.segment
+        pf = seg.postings.get(self.field)
+        ts = seg.streams.get(self.field)
+        if pf is None or ts is None:
+            return empty
+        rule, leaf_terms = self._prepare(ctx, self.rule)
+        leaf_terms = [t for t in leaf_terms if t]
+        if not leaf_terms:
+            return empty
+        # device coarse filter: docs containing ANY leaf term
+        present = [t for t in set(leaf_terms) if pf.term_id(t) >= 0]
+        if not present:
+            return empty
+        union = np.zeros(seg.n_docs, bool)
+        for t in present:
+            docids, tfs = pf.postings(t)
+            union[docids[tfs > 0]] = True
+        cand = np.nonzero(union)[0]
+        if len(cand) == 0:
+            return empty
+        freqs = np.zeros(len(cand), np.int64)
+        for i, docid in enumerate(cand):
+            row = ts.tokens[docid, : ts.lengths[docid]]
+            ivs = iv.evaluate_rule(rule, row, pf.term_id, None)
+            freqs[i] = len(ivs)
+        doc_count, _ = ctx.stats.field_stats(self.field)
+        w = sum(bm25_ops.idf(ctx.stats.doc_freq(self.field, t), doc_count)
+                for t in set(leaf_terms))
+        return _phrase_scores_from_freqs(ctx, self.field, cand, freqs, w)
+
+
+class TermsSetQuery(QueryBuilder):
+    """ref: index/query/TermsSetQueryBuilder — docs matching at least
+    `minimum_should_match_field`'s value (or a constant) of the terms."""
+
+    name = "terms_set"
+
+    def __init__(self, field: str, terms: List[str],
+                 msm_field: Optional[str] = None,
+                 msm_script: Optional[Dict[str, Any]] = None):
+        super().__init__()
+        self.field = field
+        self.terms = terms
+        self.msm_field = msm_field
+        self.msm_script = msm_script
+
+    def do_execute(self, ctx):
+        scores = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+        count = np.zeros(ctx.n_docs_padded, np.int32)
+        total_score = np.zeros(ctx.n_docs_padded, np.float32)
+        for term in self.terms:
+            s, m = TermQuery(self.field, term).do_execute(ctx)
+            m_np = np.asarray(m)
+            count[m_np] += 1
+            total_score += np.asarray(s)
+        if self.msm_field is not None:
+            nv = ctx.segment.numerics.get(self.msm_field)
+            required = np.ones(ctx.n_docs_padded, np.float64)
+            if nv is not None:
+                required[: ctx.segment.n_docs] = np.where(
+                    nv.missing, 1, nv.values)
+        elif self.msm_script is not None:
+            src = (self.msm_script.get("source", "")
+                   if isinstance(self.msm_script, dict)
+                   else str(self.msm_script))
+            # closed grammar, NEVER the host interpreter: a constant, the
+            # canonical "params.num_terms", or Math.min(params.num_terms, N)
+            n_terms = len(self.terms)
+            src_s = src.strip()
+            if src_s.isdigit():
+                required_scalar = int(src_s)
+            elif src_s == "params.num_terms":
+                required_scalar = n_terms
+            else:
+                m = re.fullmatch(
+                    r"Math\.min\(\s*params\.num_terms\s*,\s*(\d+)\s*\)",
+                    src_s)
+                required_scalar = (min(n_terms, int(m.group(1)))
+                                   if m else n_terms)
+            required = np.full(ctx.n_docs_padded, required_scalar,
+                               np.float64)
+        else:
+            required = np.ones(ctx.n_docs_padded, np.float64)
+        mask = count >= np.maximum(required, 1)
+        scores_np = np.where(mask, total_score, 0.0).astype(np.float32)
+        return jnp.asarray(scores_np), jnp.asarray(mask)
+
+
+class ScriptQuery(QueryBuilder):
+    """ref: index/query/ScriptQueryBuilder — filter context: the script
+    decides per doc (sandboxed expression over doc values)."""
+
+    name = "script"
+
+    def __init__(self, script: Any):
+        super().__init__()
+        params = {}
+        if isinstance(script, dict):
+            params = script.get("params", {}) or {}
+            script = script.get("source", "")
+        self.source = str(script)
+        self.params = params
+
+    def do_execute(self, ctx):
+        fn = compile_script(self.source)
+
+        def doc_columns(field):
+            col, miss = ctx.numeric_column(field)
+            return _DocColumn(col, miss)
+
+        sctx = ScriptContext(doc_columns, self.params)
+        result = jnp.broadcast_to(
+            jnp.asarray(fn(sctx)), (ctx.n_docs_padded,))
+        mask = (result != 0) & ctx.all_true()
+        scores = jnp.where(mask, 1.0, 0.0)
+        return scores, mask
+
+
+
+
+def _parse_intervals(spec):
+    """{field: {rule..., boost?}} — the rule tree passes through; span
+    queries build the same trees via _span_rule. Boost lives beside the
+    rule (ES's intervals shape) or beside the field."""
+    (field, rule), = ((k, v) for k, v in spec.items() if k != "boost")
+    q = IntervalsQuery(field, rule)
+    _with_boost(q, rule)
+    return _with_boost(q, spec)
+
+
+def _span_rule(node):
+    (kind, body), = ((k, v) for k, v in node.items() if k != "boost")
+    if kind == "span_term":
+        (field, v), = body.items()
+        term = v.get("value") if isinstance(v, dict) else v
+        return field, {"match": {"query": str(term)}}
+    if kind == "span_or":
+        parts = [_span_rule(c) for c in body.get("clauses", [])]
+        fields = {f for f, _ in parts}
+        if len(fields) != 1:
+            raise ParsingException(
+                "[span_or] clauses must target one field")
+        return fields.pop(), {"any_of": {
+            "intervals": [r for _, r in parts]}}
+    if kind == "span_near":
+        parts = [_span_rule(c) for c in body.get("clauses", [])]
+        fields = {f for f, _ in parts}
+        if len(fields) != 1:
+            raise ParsingException(
+                "[span_near] clauses must target one field")
+        return fields.pop(), {"all_of": {
+            "intervals": [r for _, r in parts],
+            "ordered": bool(body.get("in_order", True)),
+            "max_gaps": int(body.get("slop", 0)),
+        }}
+    if kind == "span_first":
+        field, inner = _span_rule(body.get("match", {}))
+        # end position < end → contained_by a synthetic window is not
+        # expressible; IntervalsQuery post-filters via _span_first marker
+        return field, {"all_of": {"intervals": [inner],
+                                  "_first_end": int(body.get("end", 3))}}
+    if kind == "span_not":
+        field, inc = _span_rule(body.get("include", {}))
+        f2, exc = _span_rule(body.get("exclude", {}))
+        if f2 != field:
+            raise ParsingException("[span_not] fields must match")
+        return field, {"all_of": {"intervals": [inc],
+                                  "filter": {"not_overlapping": exc}}}
+    if kind == "span_containing":
+        field, big = _span_rule(body.get("big", {}))
+        f2, small = _span_rule(body.get("little", {}))
+        if f2 != field:
+            raise ParsingException(
+                "[span_containing] fields must match")
+        return field, {"all_of": {"intervals": [big],
+                                  "filter": {"containing": small}}}
+    if kind == "span_within":
+        field, small = _span_rule(body.get("little", {}))
+        f2, big = _span_rule(body.get("big", {}))
+        if f2 != field:
+            raise ParsingException("[span_within] fields must match")
+        return field, {"all_of": {"intervals": [small],
+                                  "filter": {"contained_by": big}}}
+    raise ParsingException(f"unknown span query [{kind}]")
+
+
+def _parse_span(kind):
+    def parse(spec):
+        field, rule = _span_rule({kind: spec})
+        return _with_boost(IntervalsQuery(field, rule), spec)
+    return parse
+
+
+def _parse_terms_set(spec):
+    (field, body), = spec.items()
+    return _with_boost(TermsSetQuery(
+        field, [str(t) for t in body.get("terms", [])],
+        msm_field=body.get("minimum_should_match_field"),
+        msm_script=body.get("minimum_should_match_script")), body)
+
+
+def _parse_script_query(spec):
+    return _with_boost(ScriptQuery(spec.get("script", "")), spec)
+
+
+def _parse_wrapper(spec):
+    """ref: WrapperQueryBuilder — base64(JSON) embedded query."""
+    import base64
+    import json as _json
+    raw = spec.get("query", "")
+    try:
+        decoded = _json.loads(base64.b64decode(raw))
+    except Exception:
+        raise ParsingException("[wrapper] query must be base64-encoded JSON")
+    return parse_query(decoded)
+
+
 _PARSERS = {
+    "intervals": _parse_intervals,
+    "span_term": _parse_span("span_term"),
+    "span_or": _parse_span("span_or"),
+    "span_near": _parse_span("span_near"),
+    "span_first": _parse_span("span_first"),
+    "span_not": _parse_span("span_not"),
+    "span_containing": _parse_span("span_containing"),
+    "span_within": _parse_span("span_within"),
+    "terms_set": _parse_terms_set,
+    "script": _parse_script_query,
+    "wrapper": _parse_wrapper,
     "has_child": _parse_has_child,
     "has_parent": _parse_has_parent,
     "parent_id": _parse_parent_id,

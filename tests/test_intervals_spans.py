@@ -1,0 +1,180 @@
+"""Intervals + span query family tests (model: the reference's
+IntervalQueryBuilder/SpanNearQueryBuilder test coverage), plus
+terms_set / script / wrapper queries."""
+
+import base64
+import json
+
+import pytest
+
+from elasticsearch_tpu.index.service import IndicesService
+from elasticsearch_tpu.search.service import SearchService
+
+
+@pytest.fixture(scope="module")
+def search(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("iv")
+    indices = IndicesService(str(tmp / "data"))
+    idx = indices.create_index("d", {}, {"properties": {
+        "t": {"type": "text"},
+        "tags": {"type": "keyword"},
+        "required_matches": {"type": "long"},
+        "n": {"type": "long"}}})
+    docs = {
+        "1": {"t": "the cold war ended quietly", "tags": ["a", "b"],
+              "required_matches": 2, "n": 5},
+        "2": {"t": "cold winter war stories", "tags": ["a"],
+              "required_matches": 1, "n": 10},
+        "3": {"t": "war never changes in the cold", "tags": ["b", "c"],
+              "required_matches": 3, "n": 15},
+        "4": {"t": "warm summer days", "tags": ["c"],
+              "required_matches": 1, "n": 20},
+    }
+    for did, d in docs.items():
+        idx.index_doc(did, d)
+    idx.refresh()
+    yield SearchService(indices)
+    indices.close()
+
+
+def ids(r):
+    return sorted(h["_id"] for h in r["hits"]["hits"])
+
+
+def test_intervals_match_ordered(search):
+    r = search.search("d", {"query": {"intervals": {"t": {
+        "match": {"query": "cold war", "ordered": True,
+                  "max_gaps": 0}}}}})
+    assert ids(r) == ["1"]                  # only doc1 has them adjacent
+
+
+def test_intervals_match_unordered_gaps(search):
+    r = search.search("d", {"query": {"intervals": {"t": {
+        "match": {"query": "cold war", "ordered": False,
+                  "max_gaps": 1}}}}})
+    # doc1 adjacent; doc2 has one word between; doc3 gap of 4
+    assert ids(r) == ["1", "2"]
+
+
+def test_intervals_any_of(search):
+    r = search.search("d", {"query": {"intervals": {"t": {
+        "any_of": {"intervals": [
+            {"match": {"query": "winter"}},
+            {"match": {"query": "summer"}}]}}}}})
+    assert ids(r) == ["2", "4"]
+
+
+def test_intervals_all_of_ordered(search):
+    r = search.search("d", {"query": {"intervals": {"t": {
+        "all_of": {"ordered": True, "intervals": [
+            {"match": {"query": "war"}},
+            {"match": {"query": "cold"}}]}}}}})
+    assert ids(r) == ["3"]                  # war ... cold in order
+
+
+def test_span_near(search):
+    r = search.search("d", {"query": {"span_near": {
+        "clauses": [{"span_term": {"t": "cold"}},
+                    {"span_term": {"t": "war"}}],
+        "slop": 1, "in_order": True}}})
+    assert ids(r) == ["1", "2"]
+
+
+def test_span_or_and_first(search):
+    r = search.search("d", {"query": {"span_or": {"clauses": [
+        {"span_term": {"t": "winter"}},
+        {"span_term": {"t": "summer"}}]}}})
+    assert ids(r) == ["2", "4"]
+    # span_first: "war" within the first 2 positions
+    r = search.search("d", {"query": {"span_first": {
+        "match": {"span_term": {"t": "war"}}, "end": 2}}})
+    assert ids(r) == ["3"]                  # war at position 0 only in doc3
+
+
+def test_span_not(search):
+    # "cold" not followed/preceded by overlapping "winter cold"... use
+    # include=cold, exclude=cold war (ordered adjacent)
+    r = search.search("d", {"query": {"span_not": {
+        "include": {"span_term": {"t": "cold"}},
+        "exclude": {"span_near": {
+            "clauses": [{"span_term": {"t": "cold"}},
+                        {"span_term": {"t": "war"}}],
+            "slop": 0, "in_order": True}}}}})
+    # doc1's cold is part of "cold war" → excluded; docs 2,3 keep a cold
+    assert ids(r) == ["2", "3"]
+
+
+def test_terms_set_field(search):
+    r = search.search("d", {"query": {"terms_set": {"tags": {
+        "terms": ["a", "b", "c"],
+        "minimum_should_match_field": "required_matches"}}}})
+    # doc1 needs 2, has a+b → match; doc2 needs 1, has a → match;
+    # doc3 needs 3, has b+c → no; doc4 needs 1, has c → match
+    assert ids(r) == ["1", "2", "4"]
+
+
+def test_terms_set_script(search):
+    r = search.search("d", {"query": {"terms_set": {"tags": {
+        "terms": ["a", "b"],
+        "minimum_should_match_script": {
+            "source": "Math.min(params.num_terms, 2)"}}}}})
+    assert ids(r) == ["1"]                  # only doc1 has both a and b
+
+
+def test_script_query(search):
+    r = search.search("d", {"query": {"script": {"script": {
+        "source": "doc['n'].value > 12"}}}})
+    assert ids(r) == ["3", "4"]
+
+
+def test_wrapper_query(search):
+    inner = {"term": {"tags": {"value": "c"}}}
+    encoded = base64.b64encode(json.dumps(inner).encode()).decode()
+    r = search.search("d", {"query": {"wrapper": {"query": encoded}}})
+    assert ids(r) == ["3", "4"]
+
+
+def test_intervals_empty_match_under_any_of(search):
+    # an empty match leg must contribute nothing, not crash
+    r = search.search("d", {"query": {"intervals": {"t": {
+        "any_of": {"intervals": [
+            {"match": {"query": ""}},
+            {"match": {"query": "winter"}}]}}}}})
+    assert ids(r) == ["2"]
+
+
+def test_terms_set_msm_script_forms(search):
+    # params.num_terms form requires all terms
+    r = search.search("d", {"query": {"terms_set": {"tags": {
+        "terms": ["a", "b"],
+        "minimum_should_match_script": {"source": "params.num_terms"}}}}})
+    assert ids(r) == ["1"]
+    # constant form
+    r = search.search("d", {"query": {"terms_set": {"tags": {
+        "terms": ["a", "b", "c"],
+        "minimum_should_match_script": {"source": "1"}}}}})
+    assert ids(r) == ["1", "2", "3", "4"]
+    # interpreter-escape attempts are never evaluated: unknown scripts
+    # fall back to requiring all terms
+    r = search.search("d", {"query": {"terms_set": {"tags": {
+        "terms": ["a", "b"],
+        "minimum_should_match_script": {
+            "source": "().__class__ and params.num_terms"}}}}})
+    assert ids(r) == ["1"]
+
+
+def test_span_containing_field_mismatch_rejected(search):
+    from elasticsearch_tpu.common.errors import ParsingException
+    with pytest.raises(ParsingException):
+        search.search("d", {"query": {"span_containing": {
+            "big": {"span_term": {"t": "war"}},
+            "little": {"span_term": {"tags": "a"}}}}})
+
+
+def test_intervals_boost_applies(search):
+    r1 = search.search("d", {"query": {"intervals": {"t": {
+        "match": {"query": "winter"}}}}})
+    r2 = search.search("d", {"query": {"intervals": {"t": {
+        "match": {"query": "winter"}, "boost": 3.0}}}})
+    assert r2["hits"]["hits"][0]["_score"] == pytest.approx(
+        3.0 * r1["hits"]["hits"][0]["_score"])
