@@ -606,6 +606,7 @@ class DocumentMapper:
         self.fields: Dict[str, MappedFieldType] = {}
         self.analysis = analysis or AnalysisRegistry()
         self.dynamic = dynamic  # "true" | "false" | "strict"
+        self.nested_paths: set = set()
         # ref: plugins/mapper-size — opt-in _size metadata field recording
         # the source byte length as a searchable/aggregatable numeric
         self.size_enabled = False
@@ -638,6 +639,16 @@ class DocumentMapper:
                 if "properties" in conf:
                     self._add_properties(f"{path}.", conf["properties"])
                 continue
+            if type_name == "nested":
+                # nested objects index flattened (device coarse filter);
+                # per-object correlation is restored by NestedQuery's
+                # source-level verification (ref: nested docs are separate
+                # Lucene documents in the reference — SURVEY.md §2.1
+                # Mapping; here: filter-then-verify like phrases)
+                self.nested_paths.add(path)
+                if "properties" in conf:
+                    self._add_properties(f"{path}.", conf["properties"])
+                continue
             cls = FIELD_TYPES.get(type_name)
             if cls is None:
                 raise MapperParsingException(
@@ -664,6 +675,13 @@ class DocumentMapper:
             for p in parts[:-1]:
                 node = node.setdefault(p, {}).setdefault("properties", {})
             node[parts[-1]] = ft.to_mapping()
+        # nested paths re-emit their type so reloads restore semantics
+        for npath in sorted(self.nested_paths):
+            node = props
+            parts = npath.split(".")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {}).setdefault("properties", {})
+            node.setdefault(parts[-1], {})["type"] = "nested"
         out: Dict[str, Any] = {"properties": props}
         if self.size_enabled:
             out["_size"] = {"enabled": True}

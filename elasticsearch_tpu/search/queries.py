@@ -2337,7 +2337,206 @@ def _parse_wrapper(spec):
     return parse_query(decoded)
 
 
+
+
+def _walk_source_path(node: Any, parts: List[str]) -> List[Any]:
+    """List-aware dotted-path walk: lists flat-map at every step (one
+    shared walker for nested-object extraction and per-object values)."""
+    cur = [node]
+    for part in parts:
+        nxt: List[Any] = []
+        for n in cur:
+            if isinstance(n, list):
+                n_items = n
+            else:
+                n_items = [n]
+            for item in n_items:
+                if isinstance(item, dict) and part in item:
+                    nxt.append(item[part])
+        cur = nxt
+    out: List[Any] = []
+    for n in cur:
+        out.extend(n if isinstance(n, list) else [n])
+    return out
+
+
+def _nested_objects(src: Dict[str, Any], path: str) -> List[Dict[str, Any]]:
+    return [o for o in _walk_source_path(src, path.split("."))
+            if isinstance(o, dict)]
+
+
+def _obj_values(obj: Dict[str, Any], field: str, path: str) -> List[Any]:
+    rel = field[len(path) + 1:] if field.startswith(path + ".") else field
+    return [v for v in _walk_source_path(obj, rel.split("."))
+            if v is not None]
+
+
+def _as_clause_list(spec_val) -> List[Dict[str, Any]]:
+    """bool clauses accept a single object or a list (ES shorthand)."""
+    if spec_val is None:
+        return []
+    return spec_val if isinstance(spec_val, list) else [spec_val]
+
+
+def _coerce_pair(ctx, field: str, have, want):
+    """Coerce both sides through the field type so the verifier compares
+    what the index compared (long "7" vs 5, date strings vs millis)."""
+    ft = ctx.mapper.mapper.fields.get(field) if ctx is not None else None
+    if ft is not None:
+        try:
+            return ft.parse(have), ft.parse(want)
+        except Exception:
+            pass
+    return have, want
+
+
+def _source_matches(q: Dict[str, Any], obj: Dict[str, Any],
+                    path: str, ctx=None) -> bool:
+    """Per-object verification of an inner nested query against ONE
+    nested object from _source. Covers the common inner-query family
+    (bool/term/terms/range/match/match_all/exists); anything else
+    returns True — falling back to the flattened (device) semantics
+    rather than wrongly dropping matches. Values coerce through the
+    field type, and match verification analyzes with the field's
+    analyzer (matching what the device index compared)."""
+    (kind, spec), = ((k, v) for k, v in q.items() if k != "boost")
+    if kind == "bool":
+        for clause in ("must", "filter"):
+            for c in _as_clause_list(spec.get(clause)):
+                if not _source_matches(c, obj, path, ctx):
+                    return False
+        for c in _as_clause_list(spec.get("must_not")):
+            if _source_matches(c, obj, path, ctx):
+                return False
+        should = _as_clause_list(spec.get("should"))
+        if should and not (spec.get("must") or spec.get("filter")):
+            return any(_source_matches(c, obj, path, ctx)
+                       for c in should)
+        return True
+    if kind == "match_all":
+        return True
+    if kind == "term":
+        (field, body), = spec.items()
+        want = body.get("value") if isinstance(body, dict) else body
+        for h in _obj_values(obj, field, path):
+            ch, cw = _coerce_pair(ctx, field, h, want)
+            if ch == cw or str(h) == str(want):
+                return True
+        return False
+    if kind == "terms":
+        (field, wants), = ((k, v) for k, v in spec.items()
+                           if k != "boost")
+        for h in _obj_values(obj, field, path):
+            for w in wants:
+                ch, cw = _coerce_pair(ctx, field, h, w)
+                if ch == cw or str(h) == str(w):
+                    return True
+        return False
+    if kind == "range":
+        (field, body), = spec.items()
+        haves = _obj_values(obj, field, path)
+        if not haves:
+            return False
+        for have in haves:
+            ok = True
+            for op, cmp in (("gt", lambda a, b: a > b),
+                            ("gte", lambda a, b: a >= b),
+                            ("lt", lambda a, b: a < b),
+                            ("lte", lambda a, b: a <= b)):
+                if op not in body:
+                    continue
+                ch, cw = _coerce_pair(ctx, field, have, body[op])
+                try:
+                    if not cmp(ch, cw):
+                        ok = False
+                        break
+                except TypeError:
+                    ok = False
+                    break
+            if ok:
+                return True
+        return False
+    if kind == "match":
+        (field, body), = spec.items()
+        text = body.get("query") if isinstance(body, dict) else body
+        haves = _obj_values(obj, field, path)
+        if not haves:
+            return False
+        if ctx is not None:
+            want_tokens = set(_analyze_terms(ctx, field, str(text)))
+            for h in haves:
+                if want_tokens & set(_analyze_terms(ctx, field, str(h))):
+                    return True
+            return False
+        want_tokens = set(str(text).lower().split())
+        return any(want_tokens & set(str(h).lower().split())
+                   for h in haves)
+    if kind == "exists":
+        return bool(_obj_values(obj, spec.get("field", ""), path))
+    return True                 # unsupported inner query: flattened fallback
+
+
+class NestedQuery(QueryBuilder):
+    """ref: index/query/NestedQueryBuilder. The reference stores nested
+    objects as separate Lucene docs and joins with a bitset; here nested
+    fields index FLATTENED (the device coarse filter) and per-object
+    correlation is restored by verifying candidates against the _source
+    objects at the nested path (the filter-then-verify split used for
+    phrases). Unsupported inner queries keep flattened semantics."""
+
+    name = "nested"
+
+    def __init__(self, path: str, query_dict: Dict[str, Any],
+                 score_mode: str = "avg", ignore_unmapped: bool = False):
+        super().__init__()
+        self.path = path
+        self.raw = query_dict
+        self.inner = parse_query(query_dict)
+        self.score_mode = score_mode
+        self.ignore_unmapped = ignore_unmapped
+
+    def do_execute(self, ctx):
+        import json as _json
+        if (self.path not in getattr(ctx.mapper.mapper, "nested_paths",
+                                     set())):
+            if self.ignore_unmapped:
+                z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+                return z, z.astype(bool)
+            raise QueryShardException(
+                f"[nested] failed to find nested object under path "
+                f"[{self.path}]")
+        scores, mask = self.inner.execute(ctx)
+        seg = ctx.segment
+        mask_np = np.asarray(mask)[: seg.n_docs].copy()
+        cand = np.nonzero(mask_np)[0]
+        for d in cand:
+            src = _json.loads(seg.stored.source(int(d)))
+            objs = _nested_objects(src, self.path)
+            if not any(_source_matches(self.raw, o, self.path, ctx)
+                       for o in objs):
+                mask_np[d] = False
+        keep = np.zeros(ctx.n_docs_padded, bool)
+        keep[: seg.n_docs] = mask_np
+        keep_j = jnp.asarray(keep)
+        if self.score_mode == "none":
+            # filter-only: matching docs contribute 0 to the score (the
+            # reference's score_mode none)
+            return jnp.zeros(ctx.n_docs_padded, jnp.float32), keep_j
+        return jnp.where(keep_j, scores, 0.0), keep_j
+
+    def rewrite(self, searcher):
+        return self
+
+
+def _parse_nested(spec):
+    return _with_boost(NestedQuery(
+        spec["path"], spec.get("query", {"match_all": {}}),
+        score_mode=spec.get("score_mode", "avg"),
+        ignore_unmapped=bool(spec.get("ignore_unmapped", False))), spec)
+
+
 _PARSERS = {
+    "nested": _parse_nested,
     "intervals": _parse_intervals,
     "span_term": _parse_span("span_term"),
     "span_or": _parse_span("span_or"),

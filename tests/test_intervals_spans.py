@@ -178,3 +178,96 @@ def test_intervals_boost_applies(search):
         "match": {"query": "winter"}, "boost": 3.0}}}})
     assert r2["hits"]["hits"][0]["_score"] == pytest.approx(
         3.0 * r1["hits"]["hits"][0]["_score"])
+
+
+@pytest.fixture(scope="module")
+def nested_search(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("nested")
+    indices = IndicesService(str(tmp / "data"))
+    idx = indices.create_index("orders", {}, {"properties": {
+        "order": {"type": "keyword"},
+        "items": {"type": "nested", "properties": {
+            "product": {"type": "keyword"},
+            "qty": {"type": "long"}}}}})
+    idx.index_doc("1", {"order": "a", "items": [
+        {"product": "widget", "qty": 10},
+        {"product": "gadget", "qty": 1}]})
+    # cross-object combination: widget qty=1 + gadget qty=10 — flattened
+    # matching would wrongly match (widget AND qty>=5 across objects)
+    idx.index_doc("2", {"order": "b", "items": [
+        {"product": "widget", "qty": 1},
+        {"product": "gadget", "qty": 10}]})
+    idx.refresh()
+    yield SearchService(indices)
+    indices.close()
+
+
+def test_nested_query_per_object_correlation(nested_search):
+    r = nested_search.search("orders", {"query": {"nested": {
+        "path": "items",
+        "query": {"bool": {"must": [
+            {"term": {"items.product": {"value": "widget"}}},
+            {"range": {"items.qty": {"gte": 5}}}]}}}}})
+    # only doc1 has ONE object with product=widget AND qty>=5
+    assert ids(r) == ["1"]
+
+
+def test_nested_query_simple_term(nested_search):
+    r = nested_search.search("orders", {"query": {"nested": {
+        "path": "items",
+        "query": {"term": {"items.product": {"value": "gadget"}}}}}})
+    assert ids(r) == ["1", "2"]
+
+
+def test_nested_unmapped_path(nested_search):
+    from elasticsearch_tpu.common.errors import QueryShardException
+    with pytest.raises(QueryShardException):
+        nested_search.search("orders", {"query": {"nested": {
+            "path": "nope", "query": {"match_all": {}}}}})
+    r = nested_search.search("orders", {"query": {"nested": {
+        "path": "nope", "query": {"match_all": {}},
+        "ignore_unmapped": True}}})
+    assert r["hits"]["total"]["value"] == 0
+
+
+def test_nested_mapping_roundtrip(nested_search):
+    idx = nested_search.indices_service.get("orders")
+    m = idx.mapper.to_mapping()
+    assert m["properties"]["items"]["type"] == "nested"
+
+
+def test_nested_verifier_edge_cases(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("nested2")
+    indices = IndicesService(str(tmp / "data"))
+    idx = indices.create_index("n2", {}, {"properties": {
+        "a": {"type": "nested", "properties": {
+            "b": {"type": "nested", "properties": {
+                "v": {"type": "keyword"}}}}},
+        "items": {"type": "nested", "properties": {
+            "note": {"type": "text"},
+            "qty": {"type": "long"}}}}})
+    idx.index_doc("1", {"a": [{"b": [{"v": "x"}]}],
+                        "items": [{"note": "Fast delivery!",
+                                   "qty": "7"}]})
+    idx.refresh()
+    svc = SearchService(indices)
+    # nested-under-nested paths traverse lists mid-path
+    r = svc.search("n2", {"query": {"nested": {
+        "path": "a.b", "query": {"term": {"a.b.v": {"value": "x"}}}}}})
+    assert ids(r) == ["1"]
+    # single-clause bool shorthand
+    r = svc.search("n2", {"query": {"nested": {
+        "path": "a.b",
+        "query": {"bool": {"must": {"term": {"a.b.v": {"value": "x"}}}}}}}})
+    assert ids(r) == ["1"]
+    # match verification analyzes with the field analyzer (punctuation)
+    r = svc.search("n2", {"query": {"nested": {
+        "path": "items",
+        "query": {"match": {"items.note": {"query": "delivery"}}}}}})
+    assert ids(r) == ["1"]
+    # range verification coerces through the field type ("7" >= 5)
+    r = svc.search("n2", {"query": {"nested": {
+        "path": "items",
+        "query": {"range": {"items.qty": {"gte": 5}}}}}})
+    assert ids(r) == ["1"]
+    indices.close()
