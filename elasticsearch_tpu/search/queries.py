@@ -2528,6 +2528,50 @@ class NestedQuery(QueryBuilder):
         return self
 
 
+class SliceQuery(QueryBuilder):
+    """Sliced scroll partition (ref: search/slice/SliceBuilder — splits
+    one scroll into `max` disjoint id-hash partitions so deep scans run
+    in parallel; SURVEY.md §5.7 calls this the long-context partitioning
+    model). Docs belong to slice `hash(_id) % max == id`; the per-segment
+    hash column is computed once and cached on the segment."""
+
+    name = "_slice"
+
+    def __init__(self, slice_id: int, slice_max: int, inner: QueryBuilder):
+        super().__init__()
+        if not (0 <= slice_id < slice_max):
+            raise ParsingException(
+                f"slice id [{slice_id}] must be in [0, {slice_max})")
+        self.slice_id = slice_id
+        self.slice_max = slice_max
+        self.inner = inner
+
+    def do_execute(self, ctx):
+        from elasticsearch_tpu.index.service import murmur3_hash
+        scores, mask = self.inner.execute(ctx)
+        seg = ctx.segment
+        cache = getattr(seg, "_slice_hash_cache", None)
+        if cache is None or cache[0] != self.slice_max:
+            h = np.fromiter(
+                (abs(murmur3_hash(seg.stored.ids[d])) % self.slice_max
+                 for d in range(seg.n_docs)),
+                np.int32, seg.n_docs)
+            seg._slice_hash_cache = (self.slice_max, h)
+        h = seg._slice_hash_cache[1]
+        m = np.zeros(ctx.n_docs_padded, bool)
+        m[: seg.n_docs] = h == self.slice_id
+        mask = mask & jnp.asarray(m)
+        return jnp.where(mask, scores, 0.0), mask
+
+    def rewrite(self, searcher):
+        inner = self.inner.rewrite(searcher)
+        if inner is self.inner:
+            return self
+        q = SliceQuery(self.slice_id, self.slice_max, inner)
+        q.boost = self.boost
+        return q
+
+
 def _parse_nested(spec):
     return _with_boost(NestedQuery(
         spec["path"], spec.get("query", {"match_all": {}}),

@@ -437,6 +437,12 @@ class SearchService:
             body["post_filter"] = resolve_percolate_refs(
                 body["post_filter"], self.indices_service)
         query = parse_query(query_spec) if query_spec else MatchAllQuery()
+        slice_spec = body.get("slice")
+        if slice_spec is not None:
+            # sliced scroll: disjoint id-hash partitions (ref: SliceBuilder)
+            from elasticsearch_tpu.search.queries import SliceQuery
+            query = SliceQuery(int(slice_spec.get("id", 0)),
+                               int(slice_spec.get("max", 1)), query)
         if searchers:
             # coordinator-level rewrite: doc-resolving queries (e.g.
             # more_like_this) see ALL shards' segments, not just one

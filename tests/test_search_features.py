@@ -653,3 +653,34 @@ def test_top_level_knn_k_limits_matches(tmp_path_factory):
         svc.search("k", {"knn": {"field": "v", "query_vector": [1, 0]},
                          "rank": {"rrf": {}}}, scroll="1m")
     indices.close()
+
+
+def test_sliced_scroll_partitions_disjoint_and_complete(tmp_path_factory):
+    """slice {id, max} with scroll: every doc lands in exactly one slice
+    (ref: search/slice/SliceBuilder — the deep-scan parallelism model)."""
+    from elasticsearch_tpu.index.service import IndicesService
+    from elasticsearch_tpu.search.service import SearchService
+    tmp = tmp_path_factory.mktemp("slice")
+    indices = IndicesService(str(tmp / "data"))
+    idx = indices.create_index("s", {"index.number_of_shards": 2},
+                               {"properties": {"n": {"type": "long"}}})
+    for i in range(40):
+        idx.index_doc(str(i), {"n": i})
+    idx.refresh()
+    svc = SearchService(indices)
+    seen = []
+    for sid in range(3):
+        r = svc.search("s", {"slice": {"id": sid, "max": 3},
+                             "size": 10}, scroll="1m")
+        ids_slice = [h["_id"] for h in r["hits"]["hits"]]
+        scroll_id = r["_scroll_id"]
+        while True:
+            r = svc.scroll(scroll_id)
+            if not r["hits"]["hits"]:
+                break
+            ids_slice += [h["_id"] for h in r["hits"]["hits"]]
+        assert ids_slice            # every slice gets some docs
+        seen.extend(ids_slice)
+    assert sorted(seen, key=int) == [str(i) for i in range(40)]
+    assert len(seen) == len(set(seen))      # disjoint
+    indices.close()
