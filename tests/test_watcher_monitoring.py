@@ -89,8 +89,11 @@ def test_watch_scheduler_fires(node):
     w["trigger"] = {"schedule": {"interval": "200ms"}}
     call(node, "PUT", "/_watcher/watch/fast", w, expect=201)
     deadline = time.time() + 5
+    # history is recorded AFTER actions run — poll for both so the
+    # assertion cannot race the executing tick
     while time.time() < deadline:
-        if "alerts" in node.indices_service.indices:
+        if ("alerts" in node.indices_service.indices
+                and ".watcher-history" in node.indices_service.indices):
             break
         time.sleep(0.1)
     assert "alerts" in node.indices_service.indices
