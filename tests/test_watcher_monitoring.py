@@ -89,17 +89,20 @@ def test_watch_scheduler_fires(node):
     w["trigger"] = {"schedule": {"interval": "200ms"}}
     call(node, "PUT", "/_watcher/watch/fast", w, expect=201)
     deadline = time.time() + 5
-    # history is recorded AFTER actions run — poll for both so the
-    # assertion cannot race the executing tick
+    # poll on the SEARCHABLE history count — index membership flips
+    # before the record is indexed+refreshed, so anything less races
+    # the executing tick
+    history_total = 0
     while time.time() < deadline:
-        if ("alerts" in node.indices_service.indices
-                and ".watcher-history" in node.indices_service.indices):
-            break
+        if ".watcher-history" in node.indices_service.indices:
+            r = node.search_service.search(".watcher-history",
+                                           {"size": 10})
+            history_total = r["hits"]["total"]["value"]
+            if history_total >= 1:
+                break
         time.sleep(0.1)
     assert "alerts" in node.indices_service.indices
-    # history records were written by scheduled runs
-    r = node.search_service.search(".watcher-history", {"size": 10})
-    assert r["hits"]["total"]["value"] >= 1
+    assert history_total >= 1
 
 
 def test_watch_activate_deactivate(node):
