@@ -2487,13 +2487,18 @@ class NestedQuery(QueryBuilder):
     name = "nested"
 
     def __init__(self, path: str, query_dict: Dict[str, Any],
-                 score_mode: str = "avg", ignore_unmapped: bool = False):
+                 score_mode: str = "avg", ignore_unmapped: bool = False,
+                 inner_hits: Optional[Dict[str, Any]] = None):
         super().__init__()
         self.path = path
         self.raw = query_dict
         self.inner = parse_query(query_dict)
         self.score_mode = score_mode
         self.ignore_unmapped = ignore_unmapped
+        self.inner_hits = inner_hits
+        # _id -> [(offset, object)] matched objects, for inner_hits
+        # decoration (request-scoped: queries parse per request)
+        self._matched_objects: Dict[str, List] = {}
 
     def do_execute(self, ctx):
         import json as _json
@@ -2512,9 +2517,12 @@ class NestedQuery(QueryBuilder):
         for d in cand:
             src = _json.loads(seg.stored.source(int(d)))
             objs = _nested_objects(src, self.path)
-            if not any(_source_matches(self.raw, o, self.path, ctx)
-                       for o in objs):
+            matched = [(i, o) for i, o in enumerate(objs)
+                       if _source_matches(self.raw, o, self.path, ctx)]
+            if not matched:
                 mask_np[d] = False
+            elif self.inner_hits is not None:
+                self._matched_objects[seg.stored.ids[int(d)]] = matched
         keep = np.zeros(ctx.n_docs_padded, bool)
         keep[: seg.n_docs] = mask_np
         keep_j = jnp.asarray(keep)
@@ -2526,6 +2534,29 @@ class NestedQuery(QueryBuilder):
 
     def rewrite(self, searcher):
         return self
+
+    def add_hit_fields(self, hit: Dict[str, Any]) -> None:
+        """inner_hits decoration: the matched nested objects (ref:
+        InnerHitBuilder — here offsets index the _source array)."""
+        if self.inner_hits is None:
+            return
+        matched = self._matched_objects.get(hit.get("_id"))
+        if matched is None:
+            return
+        name = self.inner_hits.get("name", self.path)
+        size = int(self.inner_hits.get("size", 3))
+        inner = [{
+            "_index": hit.get("_index"),
+            "_id": hit.get("_id"),
+            "_nested": {"field": self.path, "offset": off},
+            "_score": None,
+            "_source": obj,
+        } for off, obj in matched[:size]]
+        hit.setdefault("inner_hits", {})[name] = {"hits": {
+            "total": {"value": len(matched), "relation": "eq"},
+            "max_score": None,
+            "hits": inner,
+        }}
 
 
 class SliceQuery(QueryBuilder):
@@ -2576,7 +2607,8 @@ def _parse_nested(spec):
     return _with_boost(NestedQuery(
         spec["path"], spec.get("query", {"match_all": {}}),
         score_mode=spec.get("score_mode", "avg"),
-        ignore_unmapped=bool(spec.get("ignore_unmapped", False))), spec)
+        ignore_unmapped=bool(spec.get("ignore_unmapped", False)),
+        inner_hits=spec.get("inner_hits")), spec)
 
 
 _PARSERS = {

@@ -271,3 +271,15 @@ def test_nested_verifier_edge_cases(tmp_path_factory):
         "query": {"range": {"items.qty": {"gte": 5}}}}}})
     assert ids(r) == ["1"]
     indices.close()
+
+
+def test_nested_inner_hits(nested_search):
+    r = nested_search.search("orders", {"query": {"nested": {
+        "path": "items",
+        "query": {"term": {"items.product": {"value": "gadget"}}},
+        "inner_hits": {}}}})
+    hit = next(h for h in r["hits"]["hits"] if h["_id"] == "1")
+    ih = hit["inner_hits"]["items"]["hits"]
+    assert ih["total"]["value"] == 1
+    assert ih["hits"][0]["_source"]["product"] == "gadget"
+    assert ih["hits"][0]["_nested"] == {"field": "items", "offset": 1}
