@@ -42,7 +42,7 @@ METRIC_AGGS = {"avg", "sum", "min", "max", "value_count", "stats",
                # x-pack analytics + aggs-matrix-stats parity
                "boxplot", "top_metrics", "string_stats", "matrix_stats"}
 BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "filter",
-               "filters", "missing", "global", "composite",
+               "filters", "missing", "global", "composite", "nested",
                "geo_distance", "geohash_grid", "geotile_grid"}
 PIPELINE_AGGS = {"avg_bucket", "sum_bucket", "min_bucket", "max_bucket",
                  "stats_bucket", "cumulative_sum", "derivative",
@@ -612,6 +612,32 @@ def _composite(body, sub, ctx, mapper):
 
 
 def _bucket(agg_type, body, sub, ctx, mapper):
+    if agg_type == "nested":
+        # ref: bucket/nested/NestedAggregator — doc_count is the number
+        # of NESTED OBJECTS under the path across matched docs. Columns
+        # here are flattened (every object's values are already in the
+        # parent doc's multi-value slots), so sub-agg values match the
+        # reference; the object count reads the ragged offsets of any
+        # subfield under the path.
+        path = body.get("path", "")
+        prefix = path + "."
+        n_objects = 0
+        for seg, mask, _m in ctx:
+            counts = None
+            for fname, nv in seg.numerics.items():
+                if fname.startswith(prefix):
+                    c = (nv.offsets[1:] - nv.offsets[:-1])
+                    counts = c if counts is None else np.maximum(counts, c)
+            for fname, kv in seg.keywords.items():
+                if fname.startswith(prefix):
+                    c = (kv.offsets[1:] - kv.offsets[:-1])
+                    counts = c if counts is None else np.maximum(counts, c)
+            if counts is not None:
+                n_objects += int(counts[mask[: seg.n_docs]].sum())
+        out = {"doc_count": n_objects}
+        if sub:
+            out.update(_compute_aggs(sub, ctx, mapper))
+        return out
     if agg_type == "composite":
         return _composite(body, sub, ctx, mapper)
     if agg_type == "global":

@@ -324,3 +324,29 @@ def test_cumulative_cardinality(search):
     # the internal exact set must not leak into the response
     for b in a["days"]["buckets"]:
         assert "_set" not in b["cats"]
+
+
+def test_nested_aggregation(tmp_path_factory):
+    from elasticsearch_tpu.index.service import IndicesService
+    from elasticsearch_tpu.search.service import SearchService
+    tmp = tmp_path_factory.mktemp("nestedagg")
+    indices = IndicesService(str(tmp / "data"))
+    idx = indices.create_index("o", {}, {"properties": {
+        "items": {"type": "nested", "properties": {
+            "product": {"type": "keyword"},
+            "qty": {"type": "long"}}}}})
+    idx.index_doc("1", {"items": [{"product": "w", "qty": 10},
+                                  {"product": "g", "qty": 1}]})
+    idx.index_doc("2", {"items": [{"product": "w", "qty": 5}]})
+    idx.refresh()
+    svc = SearchService(indices)
+    r = svc.search("o", {"size": 0, "aggs": {"n": {
+        "nested": {"path": "items"},
+        "aggs": {"total": {"sum": {"field": "items.qty"}},
+                 "products": {"terms": {"field": "items.product"}}}}}})
+    a = r["aggregations"]["n"]
+    assert a["doc_count"] == 3              # three nested objects
+    assert a["total"]["value"] == 16.0
+    buckets = {b["key"]: b["doc_count"] for b in a["products"]["buckets"]}
+    assert buckets == {"w": 2, "g": 1}
+    indices.close()
