@@ -247,3 +247,25 @@ def test_bm25_sorted_topk_batch_matches_single():
                                    np.asarray(svals), rtol=1e-6)
         np.testing.assert_array_equal(np.asarray(bids[qi]),
                                       np.asarray(sids))
+
+
+def test_pallas_bm25_contrib_matches_reference():
+    """The Pallas contribution kernel is bit-compatible (to float32
+    rounding) with the jnp expression used by the hot path; on CPU it
+    runs in interpret mode."""
+    import jax.numpy as jnp
+    from elasticsearch_tpu.ops.pallas_bm25 import (bm25_contrib_pallas,
+                                                   contrib_reference)
+    rng = np.random.default_rng(3)
+    for nb in (64, 256, 512):
+        tf = rng.integers(0, 5, size=(nb, 128)).astype(np.float32)
+        dl = rng.uniform(5, 200, size=(nb, 128)).astype(np.float32)
+        # padding lanes: tf=0 must contribute exactly 0
+        tf[:, -7:] = 0.0
+        w = rng.uniform(0.5, 8.0, nb).astype(np.float32)
+        out = np.asarray(bm25_contrib_pallas(w, tf, dl, 40.0, 1.2, 0.75))
+        ref = np.asarray(contrib_reference(
+            jnp.asarray(w), jnp.asarray(tf), jnp.asarray(dl),
+            40.0, 1.2, 0.75))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        assert (out[:, -7:] == 0.0).all()
