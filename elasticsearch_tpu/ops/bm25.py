@@ -41,6 +41,16 @@ def idf(doc_freq, doc_count) -> float:
     return math.log(1.0 + (doc_count - doc_freq + 0.5) / (doc_freq + 0.5))
 
 
+def bm25_contrib(sel_weights: jax.Array, tf: jax.Array, dl: jax.Array,
+                 avg_len, k1: float, b: float) -> jax.Array:
+    """Per-posting BM25 contribution [NB, B] — THE scoring expression
+    (one definition; the dense path, the sorted-top-k path, and the
+    Pallas kernel's reference all share it). The tf>0 guard protects the
+    padding lanes from 0/0 NaNs."""
+    norm = k1 * (1.0 - b + b * dl / avg_len)
+    return sel_weights[:, None] * jnp.where(tf > 0.0, tf / (tf + norm), 0.0)
+
+
 def bm25_block_scores(block_docids: jax.Array,   # int32 [TB, B] all blocks
                       block_tfs: jax.Array,      # float32 [TB, B]
                       sel_blocks: jax.Array,     # int32 [NB] selected block ids
@@ -57,11 +67,7 @@ def bm25_block_scores(block_docids: jax.Array,   # int32 [TB, B] all blocks
     d = jnp.take(block_docids, sel_blocks, axis=0)        # [NB, B]
     tf = jnp.take(block_tfs, sel_blocks, axis=0)          # [NB, B]
     dl = jnp.take(doc_lens, d)                            # [NB, B]
-    norm = k1 * (1.0 - b + b * dl / avg_len)
-    # where() guards the tf=0 padding lanes: with b=1 or k1=0 a padded
-    # lane can hit norm=0 and 0/0 would scatter NaN into doc 0
-    contrib = sel_weights[:, None] * jnp.where(
-        tf > 0.0, tf / (tf + norm), 0.0)
+    contrib = bm25_contrib(sel_weights, tf, dl, avg_len, k1, b)
     scores = jnp.zeros(doc_lens.shape[0], jnp.float32)
     return scores.at[d.reshape(-1)].add(
         contrib.reshape(-1), mode="drop", unique_indices=False)
@@ -153,8 +159,7 @@ def bm25_sorted_topk(block_docids: jax.Array,   # int32 [TB, B]
     d = jnp.take(block_docids, sel_blocks, axis=0)       # [NB, B]
     tf = jnp.take(block_tfs, sel_blocks, axis=0)
     dl = jnp.take(doc_lens, d)
-    norm = k1 * (1.0 - b + b * dl / avg_len)
-    contrib = sel_weights[:, None] * jnp.where(tf > 0.0, tf / (tf + norm), 0.0)
+    contrib = bm25_contrib(sel_weights, tf, dl, avg_len, k1, b)
 
     dflat = d.reshape(-1)
     cflat = contrib.reshape(-1)

@@ -31,57 +31,59 @@ _BLOCK = 128          # postings block width (index/segment.py BLOCK_SIZE)
 _TILE_ROWS = 256      # selection rows per grid step
 
 
-def _contrib_kernel(w_ref, tf_ref, dl_ref, o_ref, *, avg, k1, b):
+def _contrib_kernel(w_ref, avg_ref, tf_ref, dl_ref, o_ref, *, k1, b):
     tf = tf_ref[...]
     dl = dl_ref[...]
-    w = w_ref[...]
+    w = w_ref[...]                          # [rows, 1] — broadcasts
+    avg = avg_ref[0]
     norm = k1 * (1.0 - b + b * dl * (1.0 / avg))
     o_ref[...] = jnp.where(tf > 0.0, w * tf / (tf + norm), 0.0)
 
 
-@functools.partial(jax.jit, static_argnames=("avg_len", "k1", "b"))
+@functools.partial(jax.jit, static_argnames=("k1", "b"))
 def bm25_contrib_pallas(sel_weights: jax.Array,   # float32 [NB]
                         tf: jax.Array,            # float32 [NB, 128]
                         dl: jax.Array,            # float32 [NB, 128]
-                        avg_len: float, k1: float, b: float) -> jax.Array:
+                        avg_len, k1: float, b: float) -> jax.Array:
     """Fused contribution plane [NB, 128] via a tiled Pallas kernel.
 
-    NB must be a multiple of the tile size or small enough for one tile
-    (selection buckets are powers of two ≥ 64, so this always holds)."""
+    Weights stream as an [NB, 1] column (broadcast happens in VMEM, not
+    as a materialized HBM plane) and avg_len stays a TRACED scalar so the
+    signature matches the jnp hot path (no recompiles per refresh)."""
     from jax.experimental import pallas as pl
 
     nb = tf.shape[0]
-    rows = min(_TILE_ROWS, nb)
-    w_plane = jnp.broadcast_to(sel_weights[:, None], tf.shape)
-    grid = (nb // rows,) if nb % rows == 0 else None
-    if grid is None:
-        # ragged selection: single tile over the whole plane
-        rows = nb
-        grid = (1,)
-    kernel = functools.partial(_contrib_kernel,
-                               avg=float(avg_len), k1=k1, b=b)
+    if nb == 0:
+        return jnp.zeros_like(tf)
+    rows = _TILE_ROWS if (nb % _TILE_ROWS == 0) else nb
+    grid = (nb // rows,)
+    kernel = functools.partial(_contrib_kernel, k1=k1, b=b)
     spec = pl.BlockSpec((rows, _BLOCK), lambda i: (i, 0))
+    w_spec = pl.BlockSpec((rows, 1), lambda i: (i, 0))
+    avg_spec = pl.BlockSpec((1,), lambda i: (0,))
+    avg_arr = jnp.asarray(avg_len, jnp.float32).reshape(1)
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[spec, spec, spec],
+        in_specs=[w_spec, avg_spec, spec, spec],
         out_specs=spec,
         out_shape=jax.ShapeDtypeStruct(tf.shape, jnp.float32),
-        interpret=(jax.default_backend() == "cpu"),
-    )(w_plane, tf, dl)
+        interpret=(jax.default_backend() != "tpu"),
+    )(sel_weights[:, None], avg_arr, tf, dl)
 
 
 def contrib_reference(sel_weights, tf, dl, avg_len, k1, b):
-    """The jnp reference the kernel is property-tested against (identical
-    to the expression in ops/bm25.py)."""
-    norm = k1 * (1.0 - b + b * dl / avg_len)
-    return sel_weights[:, None] * jnp.where(tf > 0.0, tf / (tf + norm), 0.0)
+    """The jnp reference the kernel is property-tested against — THE
+    shared scoring expression from ops/bm25.py."""
+    from elasticsearch_tpu.ops.bm25 import bm25_contrib
+    return bm25_contrib(jnp.asarray(sel_weights), jnp.asarray(tf),
+                        jnp.asarray(dl), avg_len, k1, b)
 
 
 def pallas_available() -> bool:
-    """True when the default backend can execute Pallas TPU kernels."""
+    """True when the default backend compiles Pallas TPU kernels (only
+    tpu — other backends run interpret mode)."""
     try:
-        dev = jax.devices()[0]
+        return jax.default_backend() == "tpu"
     except Exception:
         return False
-    return dev.platform not in ("cpu",)
