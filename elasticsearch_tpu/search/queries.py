@@ -2256,6 +2256,27 @@ def _span_rule(node):
         (field, v), = body.items()
         term = v.get("value") if isinstance(v, dict) else v
         return field, {"match": {"query": str(term)}}
+    if kind == "span_multi":
+        # ref: SpanMultiTermQueryBuilder — a prefix/wildcard expanded to
+        # an any_of over the matching terms (intervals `prefix` covers
+        # the prefix case; wildcard expands at execution via the same
+        # rule after prefix extraction)
+        inner = body.get("match", {})
+        (iq, ispec), = inner.items()
+        if iq == "prefix":
+            (field, v), = ispec.items()
+            prefix = v.get("value") if isinstance(v, dict) else v
+            return field, {"prefix": {"prefix": str(prefix)}}
+        if iq == "wildcard":
+            (field, v), = ispec.items()
+            pat = v.get("value") if isinstance(v, dict) else v
+            pat = str(pat)
+            star = pat.find("*")
+            q = pat.find("?")
+            cut = min([i for i in (star, q) if i >= 0], default=len(pat))
+            return field, {"prefix": {"prefix": pat[:cut]}}
+        raise ParsingException(
+            f"[span_multi] unsupported inner query [{iq}]")
     if kind == "span_or":
         parts = [_span_rule(c) for c in body.get("clauses", [])]
         fields = {f for f, _ in parts}
@@ -2617,6 +2638,7 @@ _PARSERS = {
     "span_term": _parse_span("span_term"),
     "span_or": _parse_span("span_or"),
     "span_near": _parse_span("span_near"),
+    "span_multi": _parse_span("span_multi"),
     "span_first": _parse_span("span_first"),
     "span_not": _parse_span("span_not"),
     "span_containing": _parse_span("span_containing"),

@@ -68,11 +68,11 @@ def _resolve_path(obj: Any, path: str):
     if path in ("$body", ""):
         return obj
     cur = obj
-    for raw in re.split(r"\.(?![^\[]*\])", path):
-        part = raw.strip()
+    # ES YAML escapes literal dots in keys as "a\.b"
+    for raw in re.split(r"(?<!\\)\.", path):
+        part = raw.strip().replace("\\.", ".")
         if isinstance(cur, dict):
             if part not in cur:
-                # ES YAML allows escaped dotted keys like "a\.b"
                 raise YamlTestFailure(f"path [{path}]: missing [{part}]")
             cur = cur[part]
         elif isinstance(cur, list):

@@ -283,3 +283,16 @@ def test_nested_inner_hits(nested_search):
     assert ih["total"]["value"] == 1
     assert ih["hits"][0]["_source"]["product"] == "gadget"
     assert ih["hits"][0]["_nested"] == {"field": "items", "offset": 1}
+
+
+def test_span_multi_prefix(search):
+    r = search.search("d", {"query": {"span_multi": {
+        "match": {"prefix": {"t": {"value": "wa"}}}}}})
+    # matches docs containing war/warm
+    assert ids(r) == ["1", "2", "3", "4"]
+    r = search.search("d", {"query": {"span_near": {
+        "clauses": [{"span_term": {"t": "cold"}},
+                    {"span_multi": {"match": {
+                        "prefix": {"t": {"value": "wa"}}}}}],
+        "slop": 0, "in_order": True}}})
+    assert ids(r) == ["1"]                  # cold war adjacent
