@@ -467,12 +467,93 @@ def _refine(ctx: CollectCtx, submasks: List[np.ndarray]) -> CollectCtx:
     return [(seg, mask & sub, m) for (seg, mask, m), sub in zip(ctx, submasks)]
 
 
+PARENT_PIPELINES = {"cumulative_sum", "derivative",
+                    "cumulative_cardinality", "bucket_sort"}
+
+
+def _split_parent_pipelines(sub: Dict[str, Any]):
+    """(regular sub-aggs, parent pipelines) — parent pipelines are
+    declared INSIDE a multi-bucket agg and run across its buckets (the
+    reference's shape; the engine also accepts the sibling form with
+    "agg>metric" paths for backward compatibility)."""
+    regular, parents = {}, {}
+    for name, node in (sub or {}).items():
+        types = [k for k in node
+                 if k not in ("aggs", "aggregations", "meta")]
+        if len(types) == 1 and types[0] in PARENT_PIPELINES:
+            parents[name] = (types[0], node[types[0]] or {})
+        else:
+            regular[name] = node
+    return regular, parents
+
+
+def _bucket_metric_value(bucket: Dict[str, Any], path: str):
+    if path in ("_count", ""):
+        return bucket.get("doc_count")
+    name, _, leaf = path.partition(".")
+    v = bucket.get(name)
+    if isinstance(v, dict):
+        return v.get(leaf or "value")
+    return None
+
+
+def _apply_parent_pipelines(parents, buckets: List[Dict[str, Any]]):
+    """Run parent pipelines across a finished bucket list, writing their
+    per-bucket results under the declared names (ref: the pipeline
+    aggregator tree reduced on the coordinator)."""
+    for name, (ptype, body) in parents.items():
+        path = body.get("buckets_path", "_count")
+        if ptype == "cumulative_sum":
+            cum = 0.0
+            for b in buckets:
+                v = _bucket_metric_value(b, path)
+                cum += v or 0.0
+                b[name] = {"value": cum}
+        elif ptype == "derivative":
+            prev = None
+            for b in buckets:
+                v = _bucket_metric_value(b, path)
+                if prev is not None and v is not None:
+                    b[name] = {"value": v - prev}
+                prev = v
+        elif ptype == "cumulative_cardinality":
+            seen: set = set()
+            metric = path.partition(".")[0]
+            for b in buckets:
+                s2 = b.get(metric, {}).get("_set")
+                if s2 is not None:
+                    seen |= s2
+                b[name] = {"value": len(seen)}
+        elif ptype == "bucket_sort":
+            sort_spec = body.get("sort", [])
+            for entry in reversed(sort_spec):
+                if isinstance(entry, str):
+                    p, order = entry, "asc"
+                else:
+                    (p, spec2), = entry.items()
+                    order = (spec2 if isinstance(spec2, str)
+                             else spec2.get("order", "asc"))
+                buckets.sort(
+                    key=lambda b, _p=p: (
+                        _bucket_metric_value(b, _p) is None,
+                        _bucket_metric_value(b, _p) or 0),
+                    reverse=(order == "desc"))
+            frm = int(body.get("from", 0))
+            size = body.get("size")
+            del buckets[: frm]
+            if size is not None:
+                del buckets[int(size):]
+    return buckets
+
+
 def _bucket_result(sub: Dict[str, Any], bucket_ctx: CollectCtx, mapper,
                    doc_count: int, extra: Dict[str, Any]) -> Dict[str, Any]:
     out = dict(extra)
     out["doc_count"] = doc_count
     if sub:
-        out.update(_compute_aggs(sub, bucket_ctx, mapper))
+        regular, _parents = _split_parent_pipelines(sub)
+        if regular:
+            out.update(_compute_aggs(regular, bucket_ctx, mapper))
     return out
 
 
@@ -605,6 +686,7 @@ def _composite(body, sub, ctx, mapper):
         buckets.append(_bucket_result(
             sub, bucket_ctx, mapper, counts[kt],
             {"key": dict(zip(names, kt))}))
+    _apply_parent_pipelines(_split_parent_pipelines(sub)[1], buckets)
     out: Dict[str, Any] = {"buckets": buckets}
     if buckets:
         out["after_key"] = buckets[-1]["key"]
@@ -710,6 +792,7 @@ def _bucket(agg_type, body, sub, ctx, mapper):
             buckets.append(_bucket_result(sub, bucket_ctx, mapper, count,
                                           {"key": term}))
         other = sum(c for _, c in items[size:])
+        _apply_parent_pipelines(_split_parent_pipelines(sub)[1], buckets)
         return {"doc_count_error_upper_bound": 0,
                 "sum_other_doc_count": other, "buckets": buckets}
 
@@ -774,6 +857,7 @@ def _bucket(agg_type, body, sub, ctx, mapper):
             if agg_type == "date_histogram":
                 extra["key_as_string"] = _ms_to_iso(key)
             buckets.append(_bucket_result(sub, bucket_ctx, mapper, count, extra))
+        _apply_parent_pipelines(_split_parent_pipelines(sub)[1], buckets)
         return {"buckets": buckets}
 
     if agg_type == "range":
@@ -915,6 +999,7 @@ def _numeric_terms(body, sub, ctx, mapper):
                                       count, {"key": key}))
     other = sum(c for _, c in sorted(counts.items(),
                                      key=lambda kv_: (-kv_[1], kv_[0]))[size:])
+    _apply_parent_pipelines(_split_parent_pipelines(sub)[1], buckets)
     return {"doc_count_error_upper_bound": 0, "sum_other_doc_count": other,
             "buckets": buckets}
 

@@ -2099,6 +2099,15 @@ class IntervalsQuery(QueryBuilder):
                    if pf is not None else [])
             tids = [pf.term_id(t) for t in exp]
             return {"prefix": {"_tids": tids}}, exp
+        if kind == "wildcard":
+            # full-pattern expansion against the segment's term dict
+            # (capped like multi-term rewrites, MAX_TERM_EXPANSIONS)
+            import fnmatch
+            pat = str(spec.get("pattern", ""))
+            exp = ([t for t in pf.terms if fnmatch.fnmatchcase(t, pat)]
+                   [:128] if pf is not None else [])
+            tids = [pf.term_id(t) for t in exp]
+            return {"prefix": {"_tids": tids}}, exp
         if kind in ("any_of", "all_of"):
             kids, leaf_terms = [], []
             for child in spec.get("intervals", []):
@@ -2258,25 +2267,24 @@ def _span_rule(node):
         return field, {"match": {"query": str(term)}}
     if kind == "span_multi":
         # ref: SpanMultiTermQueryBuilder — a prefix/wildcard expanded to
-        # an any_of over the matching terms (intervals `prefix` covers
-        # the prefix case; wildcard expands at execution via the same
-        # rule after prefix extraction)
+        # an any_of over the terms matching the FULL pattern (the
+        # intervals engine expands per segment against the term dict)
         inner = body.get("match", {})
+        if len(inner) != 1:
+            raise ParsingException(
+                "[span_multi] requires exactly one [match] query")
         (iq, ispec), = inner.items()
+        if iq not in ("prefix", "wildcard"):
+            raise ParsingException(
+                f"[span_multi] unsupported inner query [{iq}]")
+        if len(ispec) != 1:
+            raise ParsingException(
+                f"[span_multi] [{iq}] requires exactly one field")
+        (field, v), = ispec.items()
+        pat = v.get("value") if isinstance(v, dict) else v
         if iq == "prefix":
-            (field, v), = ispec.items()
-            prefix = v.get("value") if isinstance(v, dict) else v
-            return field, {"prefix": {"prefix": str(prefix)}}
-        if iq == "wildcard":
-            (field, v), = ispec.items()
-            pat = v.get("value") if isinstance(v, dict) else v
-            pat = str(pat)
-            star = pat.find("*")
-            q = pat.find("?")
-            cut = min([i for i in (star, q) if i >= 0], default=len(pat))
-            return field, {"prefix": {"prefix": pat[:cut]}}
-        raise ParsingException(
-            f"[span_multi] unsupported inner query [{iq}]")
+            return field, {"prefix": {"prefix": str(pat)}}
+        return field, {"wildcard": {"pattern": str(pat)}}
     if kind == "span_or":
         parts = [_span_rule(c) for c in body.get("clauses", [])]
         fields = {f for f, _ in parts}

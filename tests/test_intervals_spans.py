@@ -296,3 +296,14 @@ def test_span_multi_prefix(search):
                         "prefix": {"t": {"value": "wa"}}}}}],
         "slop": 0, "in_order": True}}})
     assert ids(r) == ["1"]                  # cold war adjacent
+
+
+def test_span_multi_wildcard_full_pattern(search):
+    # full wildcard semantics: w?r matches war but NOT warm
+    r = search.search("d", {"query": {"span_multi": {
+        "match": {"wildcard": {"t": {"value": "w?r"}}}}}})
+    assert ids(r) == ["1", "2", "3"]        # docs with "war", not doc4
+    # malformed bodies parse-error (400), not internal errors
+    from elasticsearch_tpu.common.errors import ParsingException
+    with pytest.raises(ParsingException):
+        search.search("d", {"query": {"span_multi": {}}})
