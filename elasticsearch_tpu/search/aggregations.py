@@ -43,6 +43,7 @@ METRIC_AGGS = {"avg", "sum", "min", "max", "value_count", "stats",
                "boxplot", "top_metrics", "string_stats", "matrix_stats"}
 BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "filter",
                "filters", "missing", "global", "composite", "nested",
+               "significant_terms", "sampler",
                "geo_distance", "geohash_grid", "geotile_grid"}
 PIPELINE_AGGS = {"avg_bucket", "sum_bucket", "min_bucket", "max_bucket",
                  "stats_bucket", "cumulative_sum", "derivative",
@@ -468,7 +469,8 @@ def _refine(ctx: CollectCtx, submasks: List[np.ndarray]) -> CollectCtx:
 
 
 PARENT_PIPELINES = {"cumulative_sum", "derivative",
-                    "cumulative_cardinality", "bucket_sort"}
+                    "cumulative_cardinality", "bucket_sort",
+                    "moving_fn", "moving_avg", "serial_diff"}
 
 
 def _split_parent_pipelines(sub: Dict[str, Any]):
@@ -524,6 +526,33 @@ def _apply_parent_pipelines(parents, buckets: List[Dict[str, Any]]):
                 if s2 is not None:
                     seen |= s2
                 b[name] = {"value": len(seen)}
+        elif ptype in ("moving_fn", "moving_avg"):
+            # ref: MovFnPipelineAggregator — a window function over the
+            # metric series; the closed script set covers the built-in
+            # MovingFunctions (unweightedAvg default, min, max, sum)
+            window = int(body.get("window", 5))
+            script = str(body.get("script", ""))
+            fn = (min if "min(" in script else
+                  max if "max(" in script else
+                  sum if "sum(" in script and "unweighted" not in script
+                  else None)
+            series = [_bucket_metric_value(b, path) for b in buckets]
+            for i, b in enumerate(buckets):
+                win = [v for v in series[max(0, i - window): i]
+                       if v is not None]
+                if not win:
+                    b[name] = {"value": None}
+                elif fn is None:
+                    b[name] = {"value": sum(win) / len(win)}
+                else:
+                    b[name] = {"value": fn(win)}
+        elif ptype == "serial_diff":
+            lag = int(body.get("lag", 1))
+            series = [_bucket_metric_value(b, path) for b in buckets]
+            for i, b in enumerate(buckets):
+                if i >= lag and series[i] is not None \
+                        and series[i - lag] is not None:
+                    b[name] = {"value": series[i] - series[i - lag]}
         elif ptype == "bucket_sort":
             sort_spec = body.get("sort", [])
             for entry in reversed(sort_spec):
@@ -693,7 +722,60 @@ def _composite(body, sub, ctx, mapper):
     return out
 
 
+def _significant_terms(body, sub, ctx, mapper):
+    """ref: bucket/significant/SignificantTermsAggregator — terms whose
+    foreground (query-matched) frequency is anomalously high vs the
+    background (whole index), scored with JLH."""
+    field = body.get("field")
+    size = int(body.get("size", 10))
+    min_doc_count = int(body.get("min_doc_count", 3))
+    fg_counts = _keyword_terms_counts(ctx, field)
+    bg_ctx = [(seg, seg.live.copy(), m) for seg, _msk, m in ctx]
+    bg_counts = _keyword_terms_counts(bg_ctx, field)
+    fg_total = sum(int(msk.sum()) for _, msk, _m in ctx)
+    bg_total = sum(int(msk.sum()) for _, msk, _m in bg_ctx)
+    scored = []
+    for term, fg in fg_counts.items():
+        if fg < min_doc_count:
+            continue
+        bg = bg_counts.get(term, fg)
+        fg_rate = fg / max(fg_total, 1)
+        bg_rate = bg / max(bg_total, 1)
+        if fg_rate <= bg_rate:
+            continue
+        # JLH: (fg% - bg%) * (fg% / bg%)
+        score = (fg_rate - bg_rate) * (fg_rate / max(bg_rate, 1e-12))
+        scored.append((score, term, fg, bg))
+    scored.sort(key=lambda t: (-t[0], t[1]))
+    buckets = []
+    for score, term, fg, bg in scored[:size]:
+        bucket_ctx = _refine(
+            ctx, [_keyword_membership_mask(seg, field, term)
+                  for seg, _m2, _m3 in ctx])
+        buckets.append(_bucket_result(
+            sub, bucket_ctx, mapper, fg,
+            {"key": term, "score": score, "bg_count": bg}))
+    return {"doc_count": fg_total, "bg_count": bg_total,
+            "buckets": buckets}
+
+
 def _bucket(agg_type, body, sub, ctx, mapper):
+    if agg_type == "significant_terms":
+        return _significant_terms(body, sub, ctx, mapper)
+    if agg_type == "sampler":
+        # ref: bucket/sampler/SamplerAggregator — restrict sub-aggs to
+        # the first shard_size matched docs per shard/segment
+        shard_size = int(body.get("shard_size", 100))
+        submasks = []
+        for seg, mask, _m in ctx:
+            docs = np.nonzero(mask[: seg.n_docs])[0][:shard_size]
+            sm = np.zeros(seg.n_docs, bool)
+            sm[docs] = True
+            submasks.append(sm)
+        bucket_ctx = _refine(ctx, submasks)
+        return _bucket_result(
+            sub, bucket_ctx, mapper,
+            sum(int(m.sum()) for _, m, _x in bucket_ctx), {})
     if agg_type == "nested":
         # ref: bucket/nested/NestedAggregator — doc_count is the number
         # of NESTED OBJECTS under the path across matched docs. Columns

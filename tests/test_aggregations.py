@@ -350,3 +350,55 @@ def test_nested_aggregation(tmp_path_factory):
     buckets = {b["key"]: b["doc_count"] for b in a["products"]["buckets"]}
     assert buckets == {"w": 2, "g": 1}
     indices.close()
+
+
+def test_significant_terms(tmp_path_factory):
+    from elasticsearch_tpu.index.service import IndicesService
+    from elasticsearch_tpu.search.service import SearchService
+    tmp = tmp_path_factory.mktemp("sig")
+    indices = IndicesService(str(tmp / "data"))
+    idx = indices.create_index("crimes", {}, {"properties": {
+        "force": {"type": "keyword"}, "type": {"type": "keyword"}}})
+    i = 0
+    # bike thefts concentrate in the transit force; robbery is uniform
+    for force, n_bike, n_rob in (("transit", 30, 10), ("city", 3, 50),
+                                 ("rural", 2, 40)):
+        for _ in range(n_bike):
+            idx.index_doc(str(i), {"force": force, "type": "bike_theft"})
+            i += 1
+        for _ in range(n_rob):
+            idx.index_doc(str(i), {"force": force, "type": "robbery"})
+            i += 1
+    idx.refresh()
+    svc = SearchService(indices)
+    r = svc.search("crimes", {
+        "size": 0,
+        "query": {"term": {"force": {"value": "transit"}}},
+        "aggs": {"sig": {"significant_terms": {"field": "type"}}}})
+    buckets = r["aggregations"]["sig"]["buckets"]
+    assert buckets, r["aggregations"]
+    assert buckets[0]["key"] == "bike_theft"
+    assert buckets[0]["doc_count"] == 30
+    assert buckets[0]["score"] > 0
+    indices.close()
+
+
+def test_sampler_and_moving_pipelines(search):
+    a = agg(search, {"s": {"sampler": {"shard_size": 2},
+                           "aggs": {"m": {"max": {"field": "price"}}}}})
+    assert a["s"]["doc_count"] <= 4          # 2 per shard, 2 shards
+    assert "m" in a["s"]
+    a = agg(search, {"days": {
+        "date_histogram": {"field": "sold_at", "calendar_interval": "day"},
+        "aggs": {
+            "rev": {"sum": {"field": "price"}},
+            "avg3": {"moving_fn": {"buckets_path": "rev", "window": 3,
+                                   "script": "MovingFunctions.unweightedAvg(values)"}},
+            "d1": {"serial_diff": {"buckets_path": "rev", "lag": 1}},
+        }}})
+    b = a["days"]["buckets"]
+    # day keys: d1 rev=3, d2 rev=7, d3 rev=15 (fixture prices)
+    assert b[1]["d1"]["value"] == pytest.approx(b[1]["rev"]["value"]
+                                                - b[0]["rev"]["value"])
+    assert b[2]["avg3"]["value"] == pytest.approx(
+        (b[0]["rev"]["value"] + b[1]["rev"]["value"]) / 2)
