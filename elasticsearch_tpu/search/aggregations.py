@@ -527,18 +527,22 @@ def _apply_parent_pipelines(parents, buckets: List[Dict[str, Any]]):
                     seen |= s2
                 b[name] = {"value": len(seen)}
         elif ptype in ("moving_fn", "moving_avg"):
-            # ref: MovFnPipelineAggregator — a window function over the
-            # metric series; the closed script set covers the built-in
-            # MovingFunctions (unweightedAvg default, min, max, sum)
+            # ref: MovFnPipelineAggregator (window ends BEFORE the
+            # current bucket at shift=0) vs the old MovAvg aggregator
+            # (window INCLUDES the current bucket) — both semantics are
+            # preserved. The closed script set covers the built-in
+            # MovingFunctions (unweightedAvg default, min, max, sum).
             window = int(body.get("window", 5))
             script = str(body.get("script", ""))
             fn = (min if "min(" in script else
                   max if "max(" in script else
                   sum if "sum(" in script and "unweighted" not in script
                   else None)
+            include_current = ptype == "moving_avg"
             series = [_bucket_metric_value(b, path) for b in buckets]
             for i, b in enumerate(buckets):
-                win = [v for v in series[max(0, i - window): i]
+                end = i + 1 if include_current else i
+                win = [v for v in series[max(0, end - window): end]
                        if v is not None]
                 if not win:
                     b[name] = {"value": None}
@@ -755,6 +759,7 @@ def _significant_terms(body, sub, ctx, mapper):
         buckets.append(_bucket_result(
             sub, bucket_ctx, mapper, fg,
             {"key": term, "score": score, "bg_count": bg}))
+    _apply_parent_pipelines(_split_parent_pipelines(sub)[1], buckets)
     return {"doc_count": fg_total, "bg_count": bg_total,
             "buckets": buckets}
 

@@ -402,3 +402,17 @@ def test_sampler_and_moving_pipelines(search):
                                                 - b[0]["rev"]["value"])
     assert b[2]["avg3"]["value"] == pytest.approx(
         (b[0]["rev"]["value"] + b[1]["rev"]["value"]) / 2)
+
+
+def test_moving_avg_includes_current_bucket(search):
+    a = agg(search, {"days": {
+        "date_histogram": {"field": "sold_at", "calendar_interval": "day"},
+        "aggs": {
+            "rev": {"sum": {"field": "price"}},
+            "ma": {"moving_avg": {"buckets_path": "rev", "window": 3}},
+        }}})
+    b = a["days"]["buckets"]
+    # moving_avg INCLUDES the current bucket (legacy MovAvg semantics)
+    assert b[0]["ma"]["value"] == pytest.approx(b[0]["rev"]["value"])
+    assert b[1]["ma"]["value"] == pytest.approx(
+        (b[0]["rev"]["value"] + b[1]["rev"]["value"]) / 2)
