@@ -43,7 +43,8 @@ METRIC_AGGS = {"avg", "sum", "min", "max", "value_count", "stats",
                "boxplot", "top_metrics", "string_stats", "matrix_stats"}
 BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "filter",
                "filters", "missing", "global", "composite", "nested",
-               "significant_terms", "sampler",
+               "significant_terms", "sampler", "diversified_sampler",
+               "adjacency_matrix",
                "geo_distance", "geohash_grid", "geotile_grid"}
 PIPELINE_AGGS = {"avg_bucket", "sum_bucket", "min_bucket", "max_bucket",
                  "stats_bucket", "cumulative_sum", "derivative",
@@ -767,7 +768,35 @@ def _significant_terms(body, sub, ctx, mapper):
 def _bucket(agg_type, body, sub, ctx, mapper):
     if agg_type == "significant_terms":
         return _significant_terms(body, sub, ctx, mapper)
-    if agg_type == "sampler":
+    if agg_type == "adjacency_matrix":
+        # ref: bucket/adjacency/AdjacencyMatrixAggregator — one bucket
+        # per named filter plus one per intersecting pair (A&B)
+        from elasticsearch_tpu.search.queries import parse_query
+        filters = body.get("filters", {})
+        sep = body.get("separator", "&")
+        masks = {}
+        for fname, fspec in filters.items():
+            q = parse_query(fspec)
+            masks[fname] = _query_masks(q, ctx, mapper)
+        names = sorted(masks)
+        buckets = []
+        for i, a in enumerate(names):
+            bucket_ctx = _refine(ctx, masks[a])
+            count = sum(int(m.sum()) for _, m, _x in bucket_ctx)
+            if count:
+                buckets.append(_bucket_result(sub, bucket_ctx, mapper,
+                                              count, {"key": a}))
+            for bname in names[i + 1:]:
+                inter = [ma & mb for ma, mb in zip(masks[a],
+                                                   masks[bname])]
+                bucket_ctx = _refine(ctx, inter)
+                count = sum(int(m.sum()) for _, m, _x in bucket_ctx)
+                if count:
+                    buckets.append(_bucket_result(
+                        sub, bucket_ctx, mapper, count,
+                        {"key": f"{a}{sep}{bname}"}))
+        return {"buckets": buckets}
+    if agg_type in ("sampler", "diversified_sampler"):
         # ref: bucket/sampler/SamplerAggregator — restrict sub-aggs to
         # the first shard_size matched docs per shard/segment
         shard_size = int(body.get("shard_size", 100))

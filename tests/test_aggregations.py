@@ -416,3 +416,14 @@ def test_moving_avg_includes_current_bucket(search):
     assert b[0]["ma"]["value"] == pytest.approx(b[0]["rev"]["value"])
     assert b[1]["ma"]["value"] == pytest.approx(
         (b[0]["rev"]["value"] + b[1]["rev"]["value"]) / 2)
+
+
+def test_adjacency_matrix(search):
+    a = agg(search, {"adj": {"adjacency_matrix": {"filters": {
+        "cheap": {"range": {"price": {"lte": 3}}},
+        "fruit": {"term": {"category": {"value": "fruit"}}},
+    }}}})
+    buckets = {b["key"]: b["doc_count"] for b in a["adj"]["buckets"]}
+    assert buckets["cheap"] == 3             # prices 1,2,3
+    assert buckets["fruit"] == 3
+    assert buckets["cheap&fruit"] == 3       # all cheap docs are fruit
