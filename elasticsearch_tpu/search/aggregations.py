@@ -795,14 +795,43 @@ def _bucket(agg_type, body, sub, ctx, mapper):
                     buckets.append(_bucket_result(
                         sub, bucket_ctx, mapper, count,
                         {"key": f"{a}{sep}{bname}"}))
+        _apply_parent_pipelines(_split_parent_pipelines(sub)[1], buckets)
         return {"buckets": buckets}
     if agg_type in ("sampler", "diversified_sampler"):
         # ref: bucket/sampler/SamplerAggregator — restrict sub-aggs to
-        # the first shard_size matched docs per shard/segment
+        # the first shard_size matched docs per shard/segment;
+        # diversified_sampler additionally caps docs sharing one value
+        # of `field` (DiversifiedBytesHashSamplerAggregator)
         shard_size = int(body.get("shard_size", 100))
+        div_field = (body.get("field")
+                     if agg_type == "diversified_sampler" else None)
+        max_per_value = int(body.get("max_docs_per_value", 1))
         submasks = []
         for seg, mask, _m in ctx:
-            docs = np.nonzero(mask[: seg.n_docs])[0][:shard_size]
+            docs = np.nonzero(mask[: seg.n_docs])[0]
+            if div_field is not None:
+                per_value: Dict[Any, int] = {}
+                picked = []
+                kv = seg.keywords.get(div_field)
+                nv = seg.numerics.get(div_field)
+                for d in docs:
+                    if kv is not None:
+                        vals = tuple(kv.get(int(d))) or ("",)
+                    elif nv is not None and not nv.missing[d]:
+                        vals = (float(nv.values[d]),)
+                    else:
+                        vals = ("",)
+                    if any(per_value.get(v, 0) >= max_per_value
+                           for v in vals):
+                        continue
+                    for v in vals:
+                        per_value[v] = per_value.get(v, 0) + 1
+                    picked.append(int(d))
+                    if len(picked) >= shard_size:
+                        break
+                docs = np.asarray(picked, np.int64)
+            else:
+                docs = docs[:shard_size]
             sm = np.zeros(seg.n_docs, bool)
             sm[docs] = True
             submasks.append(sm)

@@ -427,3 +427,15 @@ def test_adjacency_matrix(search):
     assert buckets["cheap"] == 3             # prices 1,2,3
     assert buckets["fruit"] == 3
     assert buckets["cheap&fruit"] == 3       # all cheap docs are fruit
+
+
+def test_diversified_sampler_caps_per_value(search):
+    # the fixture has 3 fruit, 2 veg, 1 meat; cap 1 per category
+    a = agg(search, {"s": {
+        "diversified_sampler": {"field": "category",
+                                "max_docs_per_value": 1,
+                                "shard_size": 10},
+        "aggs": {"cats": {"terms": {"field": "category"}}}}})
+    buckets = {b["key"]: b["doc_count"]
+               for b in a["s"]["cats"]["buckets"]}
+    assert all(c == 1 for c in buckets.values()), buckets
