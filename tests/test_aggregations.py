@@ -430,7 +430,10 @@ def test_adjacency_matrix(search):
 
 
 def test_diversified_sampler_caps_per_value(search):
-    # the fixture has 3 fruit, 2 veg, 1 meat; cap 1 per category
+    # the fixture has 3 fruit, 2 veg, 1 meat across 2 shards; the cap is
+    # SHARD-local (as in the reference), so each category contributes at
+    # most max_docs_per_value per shard — here ≤ 2 total, and strictly
+    # fewer docs than the unsampled fruit count of 3
     a = agg(search, {"s": {
         "diversified_sampler": {"field": "category",
                                 "max_docs_per_value": 1,
@@ -438,4 +441,5 @@ def test_diversified_sampler_caps_per_value(search):
         "aggs": {"cats": {"terms": {"field": "category"}}}}})
     buckets = {b["key"]: b["doc_count"]
                for b in a["s"]["cats"]["buckets"]}
-    assert all(c == 1 for c in buckets.values()), buckets
+    assert all(c <= 2 for c in buckets.values()), buckets
+    assert buckets.get("fruit", 0) < 3
