@@ -40,7 +40,8 @@ METRIC_AGGS = {"avg", "sum", "min", "max", "value_count", "stats",
                "percentile_ranks", "top_hits", "weighted_avg",
                "geo_bounds", "geo_centroid",
                # x-pack analytics + aggs-matrix-stats parity
-               "boxplot", "top_metrics", "string_stats", "matrix_stats"}
+               "boxplot", "top_metrics", "string_stats", "matrix_stats",
+               "median_absolute_deviation"}
 BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "filter",
                "filters", "missing", "global", "composite", "nested",
                "significant_terms", "sampler", "diversified_sampler",
@@ -253,6 +254,14 @@ def _metric(agg_type, body, ctx, mapper):
         # the exact distinct set travels internally for
         # cumulative_cardinality (stripped from the response)
         return {"value": len(distinct), "_set": distinct}
+
+    if agg_type == "median_absolute_deviation":
+        # ref: x-pack/plugin/analytics MedianAbsoluteDeviationAggregator
+        vals = _numeric_values(ctx, field)
+        if len(vals) == 0:
+            return {"value": None}
+        med = np.median(vals)
+        return {"value": float(np.median(np.abs(vals - med)))}
 
     if agg_type == "boxplot":
         # ref: x-pack/plugin/analytics BoxplotAggregator — five-number

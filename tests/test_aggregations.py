@@ -443,3 +443,10 @@ def test_diversified_sampler_caps_per_value(search):
                for b in a["s"]["cats"]["buckets"]}
     assert all(c <= 2 for c in buckets.values()), buckets
     assert buckets.get("fruit", 0) < 3
+
+
+def test_median_absolute_deviation(search):
+    a = agg(search, {"mad": {"median_absolute_deviation":
+                             {"field": "price"}}})
+    # prices 1..5,10 → median 3.5, abs devs [2.5,1.5,.5,.5,1.5,6.5] → 1.5
+    assert a["mad"]["value"] == pytest.approx(1.5)
