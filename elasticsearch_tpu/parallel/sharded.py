@@ -160,6 +160,101 @@ def sharded_knn_topk(index: ShardedIndex,
     return step(index.vectors, index.live, jnp.asarray(queries))
 
 
+def sharded_hybrid_rrf(index: ShardedIndex,
+                       sel_blocks: np.ndarray,    # [S, Q, NB] int32
+                       sel_weights: np.ndarray,   # [S, Q, NB] float32
+                       queries: np.ndarray,       # [Q, D] float32
+                       k: int, k1: float = 1.2, b: float = 0.75,
+                       rank_constant: int = 60):
+    """Hybrid BM25 + kNN with reciprocal rank fusion, fully on-mesh
+    (BASELINE.md config 5 at multi-chip scale): each shard scores both
+    branches locally, the per-branch top-k merges over the shard axis
+    via all_gather, and the RRF fusion — a segmented sum of 1/(c+rank)
+    contributions keyed by global docid — runs as the same sort-based
+    reduction the single-chip hot path uses (no host round-trips).
+
+    Returns (rrf_scores [Q, k], global_docids [Q, k]), replicated."""
+    mesh = index.mesh
+    nd = index.n_docs_padded
+    c = float(rank_constant)
+
+    @partial(jax.shard_map, mesh=mesh, check_vma=False,
+             in_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
+                       P("shard"), P("shard"), P("shard"), P(None)),
+             out_specs=(P(), P()))
+    def step(docids, tfs, lens, live, vectors, sel, ws, qv):
+        docids, tfs, lens, live = docids[0], tfs[0], lens[0], live[0]
+        vectors = vectors[0]
+        sel, ws = sel[0], ws[0]
+
+        def bm25_one(sel_q, ws_q):
+            d = jnp.take(docids, sel_q, axis=0)
+            tf = jnp.take(tfs, sel_q, axis=0)
+            dl = jnp.take(lens, d)
+            norm = k1 * (1.0 - b + b * dl / index.avg_len)
+            contrib = ws_q[:, None] * jnp.where(
+                tf > 0, tf / (tf + norm), 0.0)
+            scores = jnp.zeros(nd, jnp.float32).at[d.reshape(-1)].add(
+                contrib.reshape(-1), mode="drop")
+            masked = jnp.where(live & (scores > 0), scores, -jnp.inf)
+            return jax.lax.top_k(masked, k)
+
+        b_vals, b_ids = jax.vmap(bm25_one)(sel, ws)          # [Q, k]
+        v_scores = jnp.einsum("qd,nd->qn", qv.astype(vectors.dtype),
+                              vectors,
+                              preferred_element_type=jnp.float32)
+        v_masked = jnp.where(live[None, :], v_scores, -jnp.inf)
+        v_vals, v_ids = jax.lax.top_k(v_masked, k)           # [Q, k]
+
+        shard_idx = jax.lax.axis_index("shard")
+        off = shard_idx.astype(jnp.int64) * nd
+        b_gids = b_ids.astype(jnp.int64) + off
+        v_gids = v_ids.astype(jnp.int64) + off
+
+        # global per-branch top-k (the coordinator merge, on device)
+        def merge(vals, gids):
+            av = jax.lax.all_gather(vals, "shard", axis=1)
+            ag = jax.lax.all_gather(gids, "shard", axis=1)
+            q = av.shape[0]
+            tv, ti = jax.lax.top_k(av.reshape(q, -1), k)
+            return tv, jnp.take_along_axis(ag.reshape(q, -1), ti, axis=1)
+
+        gb_vals, gb_gids = merge(b_vals, b_gids)
+        gv_vals, gv_gids = merge(v_vals, v_gids)
+
+        # RRF contributions: 1/(c + rank + 1); empty slots contribute 0
+        ranks = jnp.arange(k, dtype=jnp.float32)
+        rc = 1.0 / (c + ranks + 1.0)
+
+        def fuse_one(bg, bvals, vg, vvals):
+            gids = jnp.concatenate([bg, vg])
+            contrib = jnp.concatenate([
+                jnp.where(jnp.isfinite(bvals), rc, 0.0),
+                jnp.where(jnp.isfinite(vvals), rc, 0.0)])
+            # dtype-safe sentinel: int64 narrows to int32 when x64 is off
+            sentinel = jnp.asarray(jnp.iinfo(gids.dtype).max, gids.dtype)
+            key = jnp.where(contrib > 0, gids, sentinel)
+            sk, sc = jax.lax.sort((key, contrib), num_keys=1)
+            cs = jnp.cumsum(sc)
+            cs_excl = cs - sc
+            prev = jnp.concatenate([jnp.full(1, -1, sk.dtype), sk[:-1]])
+            nxt = jnp.concatenate([sk[1:], jnp.full(1, -1, sk.dtype)])
+            is_first = sk != prev
+            is_last = sk != nxt
+            start_excl = jax.lax.cummax(jnp.where(is_first, cs_excl, 0.0))
+            totals = cs - start_excl
+            cand = jnp.where(is_last & (sk != sentinel), totals, -jnp.inf)
+            vals, pos = jax.lax.top_k(cand, k)
+            ids = jnp.take(sk, pos)
+            return vals, jnp.where(jnp.isfinite(vals), ids, sentinel)
+
+        return jax.vmap(fuse_one)(gb_gids, gb_vals, gv_gids, gv_vals)
+
+    return step(index.block_docids, index.block_tfs, index.doc_lens,
+                index.live, index.vectors, jnp.asarray(sel_blocks),
+                jnp.asarray(sel_weights), jnp.asarray(queries))
+
+
 def sharded_dfs_stats(index: ShardedIndex,
                       sel_blocks: np.ndarray,   # [S, NB]
                       ) -> jax.Array:

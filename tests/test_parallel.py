@@ -145,3 +145,48 @@ def test_mesh_shapes():
     assert mesh.shape == {"replica": 2, "shard": 4}
     mesh8 = make_mesh()
     assert mesh8.shape["shard"] == 8
+
+
+def test_sharded_hybrid_rrf_matches_host_fusion(sharded):
+    """The on-mesh RRF fusion must equal host-side fusion of the two
+    branches' global top-k lists (BASELINE config 5 at mesh scale)."""
+    from elasticsearch_tpu.parallel.sharded import sharded_hybrid_rrf
+    mesh, segments, all_docs, index, pfs = sharded
+    terms = ["alpha", "gamma"]
+    n_total = sum(pf.doc_count for pf in pfs)
+    dfs = [sum(int(pf.doc_freq[pf.term_id(t)]) for pf in pfs
+               if pf.term_id(t) >= 0) for t in terms]
+    idfs = [bm25_ops.idf(df, n_total) for df in dfs]
+    sel, wsel = _select(pfs, index, terms, idfs)
+    sel = np.broadcast_to(sel[:, None, :], (8, 1, sel.shape[1]))
+    wsel = np.broadcast_to(wsel[:, None, :], (8, 1, wsel.shape[1]))
+    rng = np.random.default_rng(5)
+    queries = rng.standard_normal((1, 8)).astype(np.float32)
+
+    k = 10
+    rrf_vals, rrf_gids = sharded_hybrid_rrf(index, sel, wsel, queries, k)
+    rrf_vals, rrf_gids = np.asarray(rrf_vals)[0], np.asarray(rrf_gids)[0]
+
+    # host fusion of the two independently computed global branch lists
+    b_vals, b_gids = sharded_bm25_topk(index, sel, wsel, k=k)
+    v_vals, v_gids = sharded_knn_topk(index, queries, k=k)
+    scores = {}
+    for rank, (val, g) in enumerate(zip(np.asarray(b_vals)[0],
+                                        np.asarray(b_gids)[0])):
+        if np.isfinite(val):
+            scores[int(g)] = scores.get(int(g), 0.0) + 1 / (60 + rank + 1)
+    for rank, (val, g) in enumerate(zip(np.asarray(v_vals)[0],
+                                        np.asarray(v_gids)[0])):
+        if np.isfinite(val):
+            scores[int(g)] = scores.get(int(g), 0.0) + 1 / (60 + rank + 1)
+    expected = sorted(scores.items(), key=lambda kv: (-kv[1], kv[0]))[:k]
+    got = [(int(g), float(v)) for v, g in zip(rrf_vals, rrf_gids)
+           if np.isfinite(v)]
+    assert len(got) == len(expected)
+    np.testing.assert_allclose([v for _, v in got],
+                               [v for _, v in expected], rtol=1e-6)
+    # ids agree wherever fusion scores are distinct
+    for (gg, gv), (eg, ev) in zip(got, expected):
+        if abs(gv - ev) > 1e-9:
+            continue
+        assert gv == pytest.approx(ev)
