@@ -132,6 +132,34 @@ def block_max_scores(block_max_tf: jax.Array,   # float32 [TB]
 _SENTINEL = 0x7FFFFFFF
 
 
+def segmented_topk(keys: jax.Array, contribs: jax.Array, k: int,
+                   sentinel):
+    """Top-k of per-key contribution sums WITHOUT a dense accumulator:
+    sort (key, contrib) pairs by key, segmented-sum each key-run with
+    the cumsum + run-boundary trick (the exclusive prefix at a run
+    start propagates by cummax because prefixes are non-decreasing),
+    then top-k over run totals at run-last positions. Keys equal to
+    `sentinel` (padding) sort last and never win. Returns
+    (values [k], keys [k]); empty slots are (-inf, sentinel)."""
+    sorted_k, sorted_c = jax.lax.sort((keys, contribs), num_keys=1)
+    cs = jnp.cumsum(sorted_c)
+    cs_excl = cs - sorted_c
+    prev = jnp.concatenate([jnp.full(1, -1, sorted_k.dtype),
+                            sorted_k[:-1]])
+    nxt = jnp.concatenate([sorted_k[1:],
+                           jnp.full(1, -1, sorted_k.dtype)])
+    is_first = sorted_k != prev
+    is_last = sorted_k != nxt
+    run_start_excl = jax.lax.cummax(jnp.where(is_first, cs_excl, 0.0))
+    totals = cs - run_start_excl
+    cand = jnp.where(is_last & (totals > 0.0) & (sorted_k != sentinel),
+                     totals, -jnp.inf)
+    vals, pos = jax.lax.top_k(cand, k)
+    ids = jnp.take(sorted_k, pos)
+    ids = jnp.where(jnp.isfinite(vals), ids, sentinel)
+    return vals, ids
+
+
 def bm25_sorted_topk(block_docids: jax.Array,   # int32 [TB, B]
                      block_tfs: jax.Array,      # float32 [TB, B]
                      sel_blocks: jax.Array,     # int32 [NB]
@@ -168,22 +196,7 @@ def bm25_sorted_topk(block_docids: jax.Array,   # int32 [TB, B]
     # by the totals>0 mask
     dkey = jnp.where(valid, dflat, _SENTINEL)
     cflat = jnp.where(valid & jnp.take(live, dflat), cflat, 0.0)
-
-    sorted_d, sorted_c = jax.lax.sort((dkey, cflat), num_keys=1)
-    cs = jnp.cumsum(sorted_c)
-    cs_excl = cs - sorted_c
-    prev = jnp.concatenate([jnp.full(1, -1, sorted_d.dtype), sorted_d[:-1]])
-    nxt = jnp.concatenate([sorted_d[1:], jnp.full(1, -1, sorted_d.dtype)])
-    is_first = sorted_d != prev
-    is_last = sorted_d != nxt
-    run_start_excl = jax.lax.cummax(jnp.where(is_first, cs_excl, 0.0))
-    totals = cs - run_start_excl
-    cand = jnp.where(is_last & (totals > 0.0) & (sorted_d != _SENTINEL),
-                     totals, -jnp.inf)
-    vals, pos = jax.lax.top_k(cand, k)
-    ids = jnp.take(sorted_d, pos)
-    ids = jnp.where(jnp.isfinite(vals), ids, _SENTINEL)
-    return vals, ids
+    return segmented_topk(dkey, cflat, k, _SENTINEL)
 
 
 # ---------------------------------------------------------------------------

@@ -101,30 +101,13 @@ def sharded_bm25_topk(index: ShardedIndex,
         docids, tfs, lens, live = docids[0], tfs[0], lens[0], live[0]
         sel, ws = sel[0], ws[0]
 
-        def score_one(sel_q, ws_q):
-            d = jnp.take(docids, sel_q, axis=0)
-            tf = jnp.take(tfs, sel_q, axis=0)
-            dl = jnp.take(lens, d)
-            norm = k1 * (1.0 - b + b * dl / index.avg_len)
-            contrib = ws_q[:, None] * jnp.where(tf > 0, tf / (tf + norm), 0.0)
-            scores = jnp.zeros(nd, jnp.float32).at[d.reshape(-1)].add(
-                contrib.reshape(-1), mode="drop")
-            masked = jnp.where(live & (scores > 0), scores, -jnp.inf)
-            vals, ids = jax.lax.top_k(masked, k)
-            return vals, ids
-
-        vals, ids = jax.vmap(score_one)(sel, ws)            # [Q, k]
+        vals, ids = _shard_bm25_topk_local(
+            docids, tfs, lens, live, sel, ws, nd, index.avg_len,
+            k1, b, k)                                       # [Q, k]
         shard_idx = jax.lax.axis_index("shard")
         gids = ids.astype(jnp.int64) + shard_idx.astype(jnp.int64) * nd
         # merge across shards: all_gather over ICI, re-top-k on device
-        all_vals = jax.lax.all_gather(vals, "shard", axis=1)   # [Q, S, k]
-        all_gids = jax.lax.all_gather(gids, "shard", axis=1)
-        q = all_vals.shape[0]
-        flat_vals = all_vals.reshape(q, -1)
-        flat_gids = all_gids.reshape(q, -1)
-        top_vals, top_idx = jax.lax.top_k(flat_vals, k)
-        top_gids = jnp.take_along_axis(flat_gids, top_idx, axis=1)
-        return top_vals, top_gids
+        return _merge_over_shards(vals, gids, k)
 
     return step(index.block_docids, index.block_tfs, index.doc_lens,
                 index.live, jnp.asarray(sel_blocks), jnp.asarray(sel_weights))
@@ -160,6 +143,34 @@ def sharded_knn_topk(index: ShardedIndex,
     return step(index.vectors, index.live, jnp.asarray(queries))
 
 
+def _shard_bm25_topk_local(docids, tfs, lens, live, sel, ws, nd,
+                           avg_len, k1, b, k):
+    """Per-shard batched BM25 local top-k [Q, k] (the shared body of the
+    sharded BM25 and hybrid kernels)."""
+    def score_one(sel_q, ws_q):
+        d = jnp.take(docids, sel_q, axis=0)
+        tf = jnp.take(tfs, sel_q, axis=0)
+        dl = jnp.take(lens, d)
+        norm = k1 * (1.0 - b + b * dl / avg_len)
+        contrib = ws_q[:, None] * jnp.where(tf > 0, tf / (tf + norm), 0.0)
+        scores = jnp.zeros(nd, jnp.float32).at[d.reshape(-1)].add(
+            contrib.reshape(-1), mode="drop")
+        masked = jnp.where(live & (scores > 0), scores, -jnp.inf)
+        return jax.lax.top_k(masked, k)
+
+    return jax.vmap(score_one)(sel, ws)
+
+
+def _merge_over_shards(vals, gids, k):
+    """all_gather over the shard axis + re-top-k (the on-device
+    coordinator merge shared by every sharded kernel)."""
+    av = jax.lax.all_gather(vals, "shard", axis=1)
+    ag = jax.lax.all_gather(gids, "shard", axis=1)
+    q = av.shape[0]
+    tv, ti = jax.lax.top_k(av.reshape(q, -1), k)
+    return tv, jnp.take_along_axis(ag.reshape(q, -1), ti, axis=1)
+
+
 def sharded_hybrid_rrf(index: ShardedIndex,
                        sel_blocks: np.ndarray,    # [S, Q, NB] int32
                        sel_weights: np.ndarray,   # [S, Q, NB] float32
@@ -170,36 +181,31 @@ def sharded_hybrid_rrf(index: ShardedIndex,
     (BASELINE.md config 5 at multi-chip scale): each shard scores both
     branches locally, the per-branch top-k merges over the shard axis
     via all_gather, and the RRF fusion — a segmented sum of 1/(c+rank)
-    contributions keyed by global docid — runs as the same sort-based
-    reduction the single-chip hot path uses (no host round-trips).
+    contributions keyed by global docid — reuses ops/bm25.py's
+    segmented_topk (no host round-trips). The query batch splits over
+    the replica axis like the sibling kernels (read scaling).
 
-    Returns (rrf_scores [Q, k], global_docids [Q, k]), replicated."""
+    Returns (rrf_scores [Q, k], global_docids [Q, k]), replica-sharded
+    over Q."""
+    from elasticsearch_tpu.ops.bm25 import segmented_topk
+
     mesh = index.mesh
     nd = index.n_docs_padded
     c = float(rank_constant)
 
     @partial(jax.shard_map, mesh=mesh, check_vma=False,
              in_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
-                       P("shard"), P("shard"), P("shard"), P(None)),
-             out_specs=(P(), P()))
+                       P("shard"), P("shard", "replica"),
+                       P("shard", "replica"), P("replica")),
+             out_specs=(P("replica"), P("replica")))
     def step(docids, tfs, lens, live, vectors, sel, ws, qv):
         docids, tfs, lens, live = docids[0], tfs[0], lens[0], live[0]
         vectors = vectors[0]
         sel, ws = sel[0], ws[0]
 
-        def bm25_one(sel_q, ws_q):
-            d = jnp.take(docids, sel_q, axis=0)
-            tf = jnp.take(tfs, sel_q, axis=0)
-            dl = jnp.take(lens, d)
-            norm = k1 * (1.0 - b + b * dl / index.avg_len)
-            contrib = ws_q[:, None] * jnp.where(
-                tf > 0, tf / (tf + norm), 0.0)
-            scores = jnp.zeros(nd, jnp.float32).at[d.reshape(-1)].add(
-                contrib.reshape(-1), mode="drop")
-            masked = jnp.where(live & (scores > 0), scores, -jnp.inf)
-            return jax.lax.top_k(masked, k)
-
-        b_vals, b_ids = jax.vmap(bm25_one)(sel, ws)          # [Q, k]
+        b_vals, b_ids = _shard_bm25_topk_local(
+            docids, tfs, lens, live, sel, ws, nd, index.avg_len,
+            k1, b, k)                                        # [Q, k]
         v_scores = jnp.einsum("qd,nd->qn", qv.astype(vectors.dtype),
                               vectors,
                               preferred_element_type=jnp.float32)
@@ -211,16 +217,8 @@ def sharded_hybrid_rrf(index: ShardedIndex,
         b_gids = b_ids.astype(jnp.int64) + off
         v_gids = v_ids.astype(jnp.int64) + off
 
-        # global per-branch top-k (the coordinator merge, on device)
-        def merge(vals, gids):
-            av = jax.lax.all_gather(vals, "shard", axis=1)
-            ag = jax.lax.all_gather(gids, "shard", axis=1)
-            q = av.shape[0]
-            tv, ti = jax.lax.top_k(av.reshape(q, -1), k)
-            return tv, jnp.take_along_axis(ag.reshape(q, -1), ti, axis=1)
-
-        gb_vals, gb_gids = merge(b_vals, b_gids)
-        gv_vals, gv_gids = merge(v_vals, v_gids)
+        gb_vals, gb_gids = _merge_over_shards(b_vals, b_gids, k)
+        gv_vals, gv_gids = _merge_over_shards(v_vals, v_gids, k)
 
         # RRF contributions: 1/(c + rank + 1); empty slots contribute 0
         ranks = jnp.arange(k, dtype=jnp.float32)
@@ -234,19 +232,7 @@ def sharded_hybrid_rrf(index: ShardedIndex,
             # dtype-safe sentinel: int64 narrows to int32 when x64 is off
             sentinel = jnp.asarray(jnp.iinfo(gids.dtype).max, gids.dtype)
             key = jnp.where(contrib > 0, gids, sentinel)
-            sk, sc = jax.lax.sort((key, contrib), num_keys=1)
-            cs = jnp.cumsum(sc)
-            cs_excl = cs - sc
-            prev = jnp.concatenate([jnp.full(1, -1, sk.dtype), sk[:-1]])
-            nxt = jnp.concatenate([sk[1:], jnp.full(1, -1, sk.dtype)])
-            is_first = sk != prev
-            is_last = sk != nxt
-            start_excl = jax.lax.cummax(jnp.where(is_first, cs_excl, 0.0))
-            totals = cs - start_excl
-            cand = jnp.where(is_last & (sk != sentinel), totals, -jnp.inf)
-            vals, pos = jax.lax.top_k(cand, k)
-            ids = jnp.take(sk, pos)
-            return vals, jnp.where(jnp.isfinite(vals), ids, sentinel)
+            return segmented_topk(key, contrib, k, sentinel)
 
         return jax.vmap(fuse_one)(gb_gids, gb_vals, gv_gids, gv_vals)
 

@@ -185,8 +185,42 @@ def test_sharded_hybrid_rrf_matches_host_fusion(sharded):
     assert len(got) == len(expected)
     np.testing.assert_allclose([v for _, v in got],
                                [v for _, v in expected], rtol=1e-6)
-    # ids agree wherever fusion scores are distinct
-    for (gg, gv), (eg, ev) in zip(got, expected):
-        if abs(gv - ev) > 1e-9:
-            continue
-        assert gv == pytest.approx(ev)
+    # ids must agree at every rank whose score is UNAMBIGUOUS (distinct
+    # from its neighbors); tied scores may order ids differently
+    exp_scores = [v for _, v in expected]
+    for i, ((gg, gv), (eg, ev)) in enumerate(zip(got, expected)):
+        ambiguous = (
+            (i > 0 and abs(exp_scores[i - 1] - ev) < 1e-12)
+            or (i + 1 < len(exp_scores)
+                and abs(exp_scores[i + 1] - ev) < 1e-12))
+        if not ambiguous:
+            assert gg == eg, (i, got, expected)
+
+
+def test_sharded_hybrid_rrf_replica_mesh(sharded):
+    """Replica-axis query partitioning: a 4-shard x 2-replica mesh must
+    produce the same fused results as the 8-shard mesh path computes for
+    the corresponding corpus (smoke: executes and returns sane shapes)."""
+    from elasticsearch_tpu.parallel.sharded import (ShardedIndex,
+                                                    build_sharded_index,
+                                                    make_mesh,
+                                                    sharded_hybrid_rrf)
+    rng = np.random.default_rng(11)
+    mesh = make_mesh(n_shards=4, n_replicas=2)
+    segments, _docs = build_shards(rng, n_shards=4, docs_per_shard=50)
+    index, pfs = build_sharded_index(mesh, segments, "body",
+                                     with_vectors="vec")
+    terms = ["alpha"]
+    idfs = [1.0]
+    sel, wsel = _select(pfs, index, terms, idfs)
+    # Q=2 so the batch splits evenly over the 2 replicas
+    sel = np.broadcast_to(sel[:, None, :], (4, 2, sel.shape[1]))
+    wsel = np.broadcast_to(wsel[:, None, :], (4, 2, wsel.shape[1]))
+    queries = rng.standard_normal((2, 8)).astype(np.float32)
+    vals, gids = sharded_hybrid_rrf(index, sel, wsel, queries, k=5)
+    vals, gids = np.asarray(vals), np.asarray(gids)
+    assert vals.shape == (2, 5) and gids.shape == (2, 5)
+    assert np.isfinite(vals).any()
+    # both queries used the same BM25 selection → same doc SETS from the
+    # bm25 branch; scores include per-query knn so values differ
+    assert (vals[0] > 0).any() and (vals[1] > 0).any()
