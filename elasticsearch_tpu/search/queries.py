@@ -2632,6 +2632,48 @@ class SliceQuery(QueryBuilder):
         return q
 
 
+class TextExpansionQuery(QueryBuilder):
+    """Learned-sparse retrieval over a rank_features field (net-new
+    surface in the TPU brief — the reference has no text_expansion at
+    this version). Docs score Σ_t w_query(t) · w_doc(t): each expansion
+    token is a rank_features column, so scoring is a weighted sum of
+    device columns — the vmapped custom-scoring path. Query weights come
+    precomputed (`tokens`) — from the ML trained-model store or an
+    external expansion model; there is no in-process text-to-expansion
+    inference."""
+
+    name = "text_expansion"
+
+    def __init__(self, field: str, tokens: Dict[str, float]):
+        super().__init__()
+        self.field = field
+        self.tokens = {str(t): float(w) for t, w in tokens.items()}
+
+    def do_execute(self, ctx):
+        scores = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+        mask = jnp.zeros(ctx.n_docs_padded, bool)
+        for tok, w in self.tokens.items():
+            col, miss = ctx.numeric_column(f"{self.field}.{tok}")
+            hit = ~miss
+            scores = scores + jnp.where(hit, w * col, 0.0)
+            mask = mask | hit
+        mask = mask & ctx.all_true()
+        return jnp.where(mask, scores, 0.0), mask
+
+
+def _parse_text_expansion(spec):
+    (field, body), = ((k, v) for k, v in spec.items() if k != "boost")
+    tokens = body.get("tokens") or body.get("weighted_tokens")
+    if isinstance(tokens, list):             # weighted_tokens list form
+        tokens = {t["token"]: t["weight"] for t in tokens}
+    if not tokens:
+        raise ParsingException(
+            "[text_expansion] requires precomputed [tokens] — no "
+            "in-process expansion model is available")
+    return _with_boost(TextExpansionQuery(field, tokens), body)
+
+
+
 def _parse_nested(spec):
     return _with_boost(NestedQuery(
         spec["path"], spec.get("query", {"match_all": {}}),
@@ -2642,6 +2684,8 @@ def _parse_nested(spec):
 
 _PARSERS = {
     "nested": _parse_nested,
+    "text_expansion": _parse_text_expansion,
+    "weighted_tokens": _parse_text_expansion,
     "intervals": _parse_intervals,
     "span_term": _parse_span("span_term"),
     "span_or": _parse_span("span_or"),

@@ -684,3 +684,30 @@ def test_sliced_scroll_partitions_disjoint_and_complete(tmp_path_factory):
     assert sorted(seen, key=int) == [str(i) for i in range(40)]
     assert len(seen) == len(set(seen))      # disjoint
     indices.close()
+
+
+def test_text_expansion_query(tmp_path_factory):
+    """Learned-sparse scoring over rank_features columns (the brief's
+    text_expansion surface): score = sum of query-weight x doc-weight."""
+    from elasticsearch_tpu.index.service import IndicesService
+    from elasticsearch_tpu.search.service import SearchService
+    tmp = tmp_path_factory.mktemp("sparse")
+    indices = IndicesService(str(tmp / "data"))
+    idx = indices.create_index("s", {}, {"properties": {
+        "expansion": {"type": "rank_features"}}})
+    idx.index_doc("1", {"expansion": {"quantum": 2.0, "physics": 1.0}})
+    idx.index_doc("2", {"expansion": {"cooking": 3.0, "physics": 0.5}})
+    idx.index_doc("3", {"expansion": {"gardening": 1.0}})
+    idx.refresh()
+    svc = SearchService(indices)
+    r = svc.search("s", {"query": {"text_expansion": {"expansion": {
+        "tokens": {"quantum": 1.5, "physics": 1.0}}}}})
+    hits = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+    assert set(hits) == {"1", "2"}
+    assert hits["1"] == pytest.approx(1.5 * 2.0 + 1.0 * 1.0)
+    assert hits["2"] == pytest.approx(1.0 * 0.5)
+    # weighted_tokens list form
+    r = svc.search("s", {"query": {"weighted_tokens": {"expansion": {
+        "tokens": [{"token": "gardening", "weight": 2.0}]}}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["3"]
+    indices.close()
