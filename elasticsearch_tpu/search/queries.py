@@ -2650,27 +2650,58 @@ class TextExpansionQuery(QueryBuilder):
         self.tokens = {str(t): float(w) for t, w in tokens.items()}
 
     def do_execute(self, ctx):
-        scores = jnp.zeros(ctx.n_docs_padded, jnp.float32)
-        mask = jnp.zeros(ctx.n_docs_padded, bool)
+        # one batched reduction: stack the PRESENT token columns (host
+        # dict lookups) and weighted-sum in a single device op — sparse
+        # expansions carry 100+ tokens, so a per-token eager loop would
+        # dispatch hundreds of tiny ops per segment
+        cols, misses, weights = [], [], []
         for tok, w in self.tokens.items():
+            if ctx.device.numerics.get(f"{self.field}.{tok}") is None:
+                continue
             col, miss = ctx.numeric_column(f"{self.field}.{tok}")
-            hit = ~miss
-            scores = scores + jnp.where(hit, w * col, 0.0)
-            mask = mask | hit
-        mask = mask & ctx.all_true()
+            cols.append(col)
+            misses.append(miss)
+            weights.append(w)
+        if not cols:
+            z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
+            return z, z.astype(bool)
+        plane = jnp.stack(cols)                       # [T, ND]
+        present = ~jnp.stack(misses)                  # [T, ND]
+        wv = jnp.asarray(np.asarray(weights, np.float32))
+        scores = jnp.einsum("t,tn->n", wv,
+                            jnp.where(present, plane, 0.0))
+        mask = present.any(axis=0) & ctx.all_true()
         return jnp.where(mask, scores, 0.0), mask
 
 
 def _parse_text_expansion(spec):
-    (field, body), = ((k, v) for k, v in spec.items() if k != "boost")
+    fields = [(k, v) for k, v in spec.items() if k != "boost"]
+    if len(fields) != 1:
+        raise ParsingException(
+            "[text_expansion] requires exactly one field")
+    field, body = fields[0]
+    if not isinstance(body, dict):
+        raise ParsingException(
+            f"[text_expansion] [{field}] must be an object")
     tokens = body.get("tokens") or body.get("weighted_tokens")
     if isinstance(tokens, list):             # weighted_tokens list form
-        tokens = {t["token"]: t["weight"] for t in tokens}
-    if not tokens:
+        try:
+            tokens = {t["token"]: t["weight"] for t in tokens}
+        except (TypeError, KeyError):
+            raise ParsingException(
+                "[text_expansion] weighted_tokens entries need "
+                "[token] and [weight]")
+    if not tokens or not isinstance(tokens, dict):
         raise ParsingException(
             "[text_expansion] requires precomputed [tokens] — no "
             "in-process expansion model is available")
-    return _with_boost(TextExpansionQuery(field, tokens), body)
+    try:
+        q = TextExpansionQuery(field, tokens)
+    except (TypeError, ValueError):
+        raise ParsingException(
+            "[text_expansion] token weights must be numbers")
+    _with_boost(q, body)
+    return _with_boost(q, spec)
 
 
 

@@ -711,3 +711,30 @@ def test_text_expansion_query(tmp_path_factory):
         "tokens": [{"token": "gardening", "weight": 2.0}]}}}})
     assert [h["_id"] for h in r["hits"]["hits"]] == ["3"]
     indices.close()
+
+
+def test_text_expansion_boost_and_errors(tmp_path_factory):
+    from elasticsearch_tpu.index.service import IndicesService
+    from elasticsearch_tpu.search.service import SearchService
+    from elasticsearch_tpu.common.errors import ParsingException
+    tmp = tmp_path_factory.mktemp("sparse2")
+    indices = IndicesService(str(tmp / "data"))
+    idx = indices.create_index("s2", {}, {"properties": {
+        "e": {"type": "rank_features"}}})
+    idx.index_doc("1", {"e": {"x": 2.0}})
+    idx.refresh()
+    svc = SearchService(indices)
+    r1 = svc.search("s2", {"query": {"text_expansion": {"e": {
+        "tokens": {"x": 1.0}}}}})
+    r2 = svc.search("s2", {"query": {"text_expansion": {"e": {
+        "tokens": {"x": 1.0}}, "boost": 3.0}}})
+    assert r2["hits"]["hits"][0]["_score"] == pytest.approx(
+        3.0 * r1["hits"]["hits"][0]["_score"])
+    for bad in ({"text_expansion": {}},
+                {"text_expansion": {"e": "nope"}},
+                {"text_expansion": {"e": {"tokens": {}}}},
+                {"text_expansion": {"e": {"tokens": [{"nope": 1}]}}},
+                {"text_expansion": {"e": {"tokens": {"x": "NaNope"}}}}):
+        with pytest.raises(ParsingException):
+            svc.search("s2", {"query": bad})
+    indices.close()
