@@ -45,7 +45,7 @@ METRIC_AGGS = {"avg", "sum", "min", "max", "value_count", "stats",
 BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "filter",
                "filters", "missing", "global", "composite", "nested",
                "significant_terms", "sampler", "diversified_sampler",
-               "adjacency_matrix",
+               "adjacency_matrix", "auto_date_histogram",
                "geo_distance", "geohash_grid", "geotile_grid"}
 PIPELINE_AGGS = {"avg_bucket", "sum_bucket", "min_bucket", "max_bucket",
                  "stats_bucket", "cumulative_sum", "derivative",
@@ -949,6 +949,44 @@ def _bucket(agg_type, body, sub, ctx, mapper):
         _apply_parent_pipelines(_split_parent_pipelines(sub)[1], buckets)
         return {"doc_count_error_upper_bound": 0,
                 "sum_other_doc_count": other, "buckets": buckets}
+
+    if agg_type == "auto_date_histogram":
+        # ref: bucket/histogram/AutoDateHistogramAggregationBuilder —
+        # pick the smallest rounding whose bucket count fits `buckets`
+        field = body.get("field")
+        target = int(body.get("buckets", 10))
+        lo = hi = None
+        for seg, mask, _m in ctx:
+            vv, m = _first_values_and_mask(seg, mask, field)
+            if vv is None or not m.any():
+                continue
+            vals = vv[m]
+            lo = float(vals.min()) if lo is None else min(lo, vals.min())
+            hi = float(vals.max()) if hi is None else max(hi, vals.max())
+        if lo is None:
+            return {"buckets": [], "interval": "1s"}
+        ladder = [("1s", {"fixed_interval": "1s"}),
+                  ("1m", {"fixed_interval": "1m"}),
+                  ("1h", {"fixed_interval": "1h"}),
+                  ("1d", {"fixed_interval": "1d"}),
+                  ("7d", {"calendar_interval": "week"}),
+                  ("1M", {"calendar_interval": "month"}),
+                  ("1q", {"calendar_interval": "quarter"}),
+                  ("1y", {"calendar_interval": "year"})]
+        chosen_label, chosen = ladder[-1]
+        span = hi - lo
+        approx = {"1s": 1e3, "1m": 6e4, "1h": 3.6e6, "1d": 8.64e7,
+                  "7d": 6.048e8, "1M": 2.63e9, "1q": 7.9e9, "1y": 3.15e10}
+        for label, spec in ladder:
+            if span / approx[label] <= target:
+                chosen_label, chosen = label, spec
+                break
+        inner = dict(chosen)
+        inner["field"] = field
+        inner["min_doc_count"] = 1        # auto variant skips empties
+        out = _bucket("date_histogram", inner, sub, ctx, mapper)
+        out["interval"] = chosen_label
+        return out
 
     if agg_type in ("histogram", "date_histogram"):
         field = body.get("field")

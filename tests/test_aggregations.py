@@ -450,3 +450,17 @@ def test_median_absolute_deviation(search):
                              {"field": "price"}}})
     # prices 1..5,10 → median 3.5, abs devs [2.5,1.5,.5,.5,1.5,6.5] → 1.5
     assert a["mad"]["value"] == pytest.approx(1.5)
+
+
+def test_auto_date_histogram(search):
+    # fixture spans 3 days -> daily rounding fits 10 buckets
+    a = agg(search, {"auto": {"auto_date_histogram": {
+        "field": "sold_at", "buckets": 10}}})
+    assert a["auto"]["interval"] == "1d"
+    assert len(a["auto"]["buckets"]) == 3
+    counts = [b["doc_count"] for b in a["auto"]["buckets"]]
+    assert sum(counts) == 6
+    # tiny target forces a coarser interval
+    a = agg(search, {"auto": {"auto_date_histogram": {
+        "field": "sold_at", "buckets": 1}}})
+    assert len(a["auto"]["buckets"]) == 1
