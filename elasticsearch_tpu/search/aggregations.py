@@ -965,6 +965,8 @@ def _bucket(agg_type, body, sub, ctx, mapper):
             hi = float(vals.max()) if hi is None else max(hi, vals.max())
         if lo is None:
             return {"buckets": [], "interval": "1s"}
+        # interval lengths come from the ONE table the bucketing itself
+        # uses (_INTERVALS_MS) so the estimate and the buckets agree
         ladder = [("1s", {"fixed_interval": "1s"}),
                   ("1m", {"fixed_interval": "1m"}),
                   ("1h", {"fixed_interval": "1h"}),
@@ -974,16 +976,21 @@ def _bucket(agg_type, body, sub, ctx, mapper):
                   ("1q", {"calendar_interval": "quarter"}),
                   ("1y", {"calendar_interval": "year"})]
         chosen_label, chosen = ladder[-1]
-        span = hi - lo
-        approx = {"1s": 1e3, "1m": 6e4, "1h": 3.6e6, "1d": 8.64e7,
-                  "7d": 6.048e8, "1M": 2.63e9, "1q": 7.9e9, "1y": 3.15e10}
+        label_to_key = {"7d": "week", "1q": "quarter"}
         for label, spec in ladder:
-            if span / approx[label] <= target:
+            unit = _INTERVALS_MS[label_to_key.get(label, label)]
+            # worst-case bucket count with floor-based bucketing is
+            # floor(hi/i) - floor(lo/i) + 1
+            count = (int(np.floor(hi / unit)) - int(np.floor(lo / unit))
+                     + 1)
+            if count <= target:
                 chosen_label, chosen = label, spec
                 break
         inner = dict(chosen)
         inner["field"] = field
-        inner["min_doc_count"] = 1        # auto variant skips empties
+        # contiguous buckets with zero-count gap fill, matching
+        # InternalAutoDateHistogram's reduce
+        inner["min_doc_count"] = 0
         out = _bucket("date_histogram", inner, sub, ctx, mapper)
         out["interval"] = chosen_label
         return out

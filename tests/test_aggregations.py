@@ -464,3 +464,31 @@ def test_auto_date_histogram(search):
     a = agg(search, {"auto": {"auto_date_histogram": {
         "field": "sold_at", "buckets": 1}}})
     assert len(a["auto"]["buckets"]) == 1
+
+
+def test_auto_date_histogram_contract(tmp_path_factory):
+    """Never more than `buckets` buckets, contiguous with zero-count gap
+    fill (the InternalAutoDateHistogram reduce contract)."""
+    from elasticsearch_tpu.index.service import IndicesService
+    from elasticsearch_tpu.search.service import SearchService
+    DAY = 86_400_000
+    tmp = tmp_path_factory.mktemp("autodh")
+    indices = IndicesService(str(tmp / "data"))
+    idx = indices.create_index("t", {}, {"properties": {
+        "ts": {"type": "date"}}})
+    # span of exactly 10 days: floor-count is 11 daily buckets, so the
+    # estimate must reject "1d" for buckets=10 and fall to weekly
+    idx.index_doc("a", {"ts": 0})
+    idx.index_doc("b", {"ts": 2 * DAY})     # gap at day 1
+    idx.index_doc("c", {"ts": 10 * DAY})
+    idx.refresh()
+    svc = SearchService(indices)
+    r = svc.search("t", {"size": 0, "aggs": {"auto": {
+        "auto_date_histogram": {"field": "ts", "buckets": 10}}}})
+    buckets = r["aggregations"]["auto"]["buckets"]
+    assert len(buckets) <= 10
+    # contiguity: keys advance uniformly with zero-count fills present
+    keys = [b["key"] for b in buckets]
+    assert keys == sorted(keys)
+    assert any(b["doc_count"] == 0 for b in buckets) or len(buckets) <= 2
+    indices.close()
