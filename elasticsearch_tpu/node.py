@@ -68,11 +68,18 @@ class Node:
             self.indices_service, self.search_service,
             self.persistent_tasks, self.data_path)
         from elasticsearch_tpu.xpack.security import SecurityService
+        anon_user = settings.get(
+            "xpack.security.authc.anonymous.username")
+        anon_roles = settings.get("xpack.security.authc.anonymous.roles")
+        if isinstance(anon_roles, str):
+            anon_roles = [r.strip() for r in anon_roles.split(",")]
         self.security_service = SecurityService(
             self.data_path,
             enabled=bool(settings.get("xpack.security.enabled", False)),
             bootstrap_password=str(
-                settings.get("bootstrap.password", "changeme")))
+                settings.get("bootstrap.password", "changeme")),
+            anonymous_username=anon_user,
+            anonymous_roles=anon_roles)
         from elasticsearch_tpu.xpack.sql import SqlService
         self.sql_service = SqlService(self)
         from elasticsearch_tpu.xpack.eql import EqlService

@@ -128,7 +128,13 @@ class SecurityService:
 
     def __init__(self, data_path: Optional[str] = None,
                  enabled: bool = False,
-                 bootstrap_password: str = "changeme"):
+                 bootstrap_password: str = "changeme",
+                 anonymous_username: Optional[str] = None,
+                 anonymous_roles: Optional[List[str]] = None):
+        # ref: x-pack anonymous access (xpack.security.authc.anonymous.*)
+        # — requests without credentials authenticate as this principal
+        self.anonymous_username = anonymous_username
+        self.anonymous_roles = list(anonymous_roles or [])
         self.enabled = enabled
         self._lock = threading.Lock()
         self._users: Dict[str, Dict[str, Any]] = {}
@@ -301,6 +307,9 @@ class SecurityService:
         headers = {k.lower(): v for k, v in (headers or {}).items()}
         auth = headers.get("authorization")
         if not auth:
+            if self.anonymous_username is not None:
+                return User(self.anonymous_username,
+                            self.anonymous_roles)
             raise AuthenticationException(
                 "missing authentication credentials for REST request")
         scheme, _, payload = auth.partition(" ")

@@ -224,3 +224,40 @@ def test_change_password(node):
     s, _ = c.dispatch("GET", "/_cluster/health", None, None,
                       headers=basic("carol", "second45"))
     assert s == 200
+
+
+def test_anonymous_access(tmp_path):
+    """xpack.security.authc.anonymous.* grants credential-less requests a
+    principal with the configured roles (ref: AnonymousUser)."""
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.node import Node
+    n = Node(settings=Settings.from_dict({
+        "xpack": {"security": {
+            "enabled": True,
+            "authc": {"anonymous": {"username": "anon",
+                                    "roles": "viewer"}}}},
+        "bootstrap": {"password": "secret123"}}),
+        data_path=str(tmp_path / "d"))
+    try:
+        n.security_service.put_role("viewer", {
+            "cluster": ["monitor"],
+            "indices": [{"names": ["*"], "privileges": ["read"]}]})
+        # anonymous request: no Authorization header at all
+        status, r = n.rest_controller.dispatch(
+            "GET", "/_security/_authenticate", {}, None, headers={})
+        assert status == 200
+        assert r["username"] == "anon"
+        assert r["roles"] == ["viewer"]
+        # reads allowed, writes denied by the viewer role
+        n.indices_service.create_index("open", {}, None)
+        idx = n.indices_service.get("open")
+        idx.index_doc("1", {"v": 1})
+        idx.refresh()
+        status, _ = n.rest_controller.dispatch(
+            "POST", "/open/_search", {}, {"size": 1}, headers={})
+        assert status == 200
+        status, _ = n.rest_controller.dispatch(
+            "PUT", "/open/_doc/2", {}, {"v": 2}, headers={})
+        assert status == 403
+    finally:
+        n.close()
