@@ -68,11 +68,16 @@ class Node:
             self.indices_service, self.search_service,
             self.persistent_tasks, self.data_path)
         from elasticsearch_tpu.xpack.security import SecurityService
-        anon_user = settings.get(
-            "xpack.security.authc.anonymous.username")
         anon_roles = settings.get("xpack.security.authc.anonymous.roles")
         if isinstance(anon_roles, str):
-            anon_roles = [r.strip() for r in anon_roles.split(",")]
+            anon_roles = [r.strip() for r in anon_roles.split(",")
+                          if r.strip()]
+        anon_user = settings.get(
+            "xpack.security.authc.anonymous.username")
+        if anon_user is None and anon_roles:
+            # roles alone enable anonymous access; the principal name
+            # defaults like the reference's AnonymousUser
+            anon_user = "_anonymous"
         self.security_service = SecurityService(
             self.data_path,
             enabled=bool(settings.get("xpack.security.enabled", False)),

@@ -312,6 +312,12 @@ class SecurityService:
                             self.anonymous_roles)
             raise AuthenticationException(
                 "missing authentication credentials for REST request")
+        scheme_probe = auth.partition(" ")[0].lower()
+        if (scheme_probe not in ("basic", "apikey", "bearer")
+                and self.anonymous_username is not None):
+            # no realm consumes this scheme: fall back to the anonymous
+            # principal (ref: AuthenticationService.handleNullToken)
+            return User(self.anonymous_username, self.anonymous_roles)
         scheme, _, payload = auth.partition(" ")
         scheme = scheme.lower()
         if scheme == "basic":

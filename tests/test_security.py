@@ -261,3 +261,30 @@ def test_anonymous_access(tmp_path):
         assert status == 403
     finally:
         n.close()
+
+
+def test_anonymous_roles_alone_and_unknown_scheme(tmp_path):
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.node import Node
+    n = Node(settings=Settings.from_dict({
+        "xpack": {"security": {
+            "enabled": True,
+            "authc": {"anonymous": {"roles": "viewer,"}}}},
+        "bootstrap": {"password": "secret123"}}),
+        data_path=str(tmp_path / "d"))
+    try:
+        n.security_service.put_role("viewer", {"cluster": ["monitor"]})
+        status, r = n.rest_controller.dispatch(
+            "GET", "/_security/_authenticate", {}, None, headers={})
+        assert status == 200
+        # username defaults like the reference; trailing comma filtered
+        assert r["username"] == "_anonymous"
+        assert r["roles"] == ["viewer"]
+        # unconsumable auth scheme falls back to anonymous, not 401
+        status, r = n.rest_controller.dispatch(
+            "GET", "/_security/_authenticate", {}, None,
+            headers={"Authorization": "Negotiate abc"})
+        assert status == 200
+        assert r["username"] == "_anonymous"
+    finally:
+        n.close()
