@@ -492,6 +492,7 @@ def nodes_stats(node, params, body):
             "indices": {
                 name: idx.stats() for name, idx in
                 node.indices_service.indices.items()},
+            "request_cache": node.search_service.request_cache_stats,
             "process": {"max_rss_bytes": ru.ru_maxrss * 1024},
             "breakers": node.breaker_service.stats(),
         }},
@@ -1015,6 +1016,9 @@ def _merge_search_params(body, params):
     for key in ("from", "size"):
         if key in params:
             body[key] = int(params[key])
+    if "request_cache" in params:
+        body["request_cache"] = params["request_cache"] not in (
+            "false", "False")
     return body
 
 

@@ -148,12 +148,23 @@ class PitContext:
 
 
 class SearchService:
+    REQUEST_CACHE_MAX_ENTRIES = 256
+
     def __init__(self, indices_service: IndicesService):
         self.indices_service = indices_service
         self._scrolls: Dict[str, ScrollContext] = {}
         self._pits: Dict[str, PitContext] = {}
         self._lock = threading.Lock()
         self.slowlog_recent: List[Dict[str, Any]] = []
+        # shard request cache (ref: indices/IndicesRequestCache.java:69 —
+        # keyed by reader + request bytes; here: per-shard engine epochs
+        # + the canonical request body, so any refresh naturally misses).
+        # Caches size=0 (agg/count-style) responses only, like the
+        # reference's default policy. LRU-bounded.
+        from collections import OrderedDict
+        self._request_cache: "OrderedDict[tuple, Dict[str, Any]]" = (
+            OrderedDict())
+        self.request_cache_stats = {"hit_count": 0, "miss_count": 0}
 
     # --------------------------------------------------------------- PIT
     def open_pit(self, index_expression: str, keep_alive: str) -> str:
@@ -251,11 +262,42 @@ class SearchService:
             with self._lock:
                 self._scrolls[scroll_ctx.scroll_id] = scroll_ctx
 
+        cache_key = None
+        if (scroll_ctx is None and pit_spec is None
+                and int((body or {}).get("size", DEFAULT_SIZE)) == 0
+                and (body or {}).get("request_cache") is not False):
+            epochs = []
+            for name in names:
+                if self.indices_service.has(name):
+                    epochs.extend(
+                        sh.epoch for sh in
+                        self.indices_service.get(name).shards)
+            cache_key = (tuple(names), tuple(epochs), search_type,
+                         json.dumps(body, sort_keys=True, default=str))
+            with self._lock:
+                cached = self._request_cache.get(cache_key)
+                if cached is not None:
+                    self._request_cache.move_to_end(cache_key)
+                    self.request_cache_stats["hit_count"] += 1
+                    import copy as _copy
+                    response = _copy.deepcopy(cached)
+                    response["took"] = int(
+                        (time.monotonic() - start) * 1000)
+                    return response
+                self.request_cache_stats["miss_count"] += 1
+
         response = self._execute(searchers, body, scroll_ctx=scroll_ctx,
                                  task=task)
         response["took"] = int((time.monotonic() - start) * 1000)
         if scroll_ctx is not None:
             response["_scroll_id"] = scroll_ctx.scroll_id
+        if cache_key is not None:
+            import copy as _copy
+            with self._lock:
+                self._request_cache[cache_key] = _copy.deepcopy(response)
+                while len(self._request_cache) > \
+                        self.REQUEST_CACHE_MAX_ENTRIES:
+                    self._request_cache.popitem(last=False)
         self._after_search(names, response["took"], body)
         return response
 

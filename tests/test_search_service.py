@@ -284,3 +284,40 @@ def test_rank_eval_precision_mrr_dcg():
     ndcg = rank_eval(fn, reqs, {"dcg": {"k": 4, "normalize": True}})["metric_score"]
     ideal = 7 / math.log2(2) + 1 / math.log2(3)
     assert ndcg == pytest.approx(expected_dcg / ideal)
+
+
+def test_shard_request_cache(tmp_path):
+    """size=0 responses cache per (shard epochs, body); refresh after a
+    write naturally invalidates (ref: IndicesRequestCache keyed by
+    reader + request bytes)."""
+    from elasticsearch_tpu.index.service import IndicesService
+    from elasticsearch_tpu.search.service import SearchService
+    indices = IndicesService(str(tmp_path / "rc"))
+    idx = indices.create_index("rc", {}, {"properties": {
+        "v": {"type": "long"}}})
+    idx.index_doc("1", {"v": 1})
+    idx.refresh()
+    svc = SearchService(indices)
+    body = {"size": 0, "track_total_hits": True,
+            "aggs": {"s": {"sum": {"field": "v"}}}}
+    r1 = svc.search("rc", body)
+    assert svc.request_cache_stats == {"hit_count": 0, "miss_count": 1}
+    r2 = svc.search("rc", body)
+    assert svc.request_cache_stats["hit_count"] == 1
+    assert r2["aggregations"] == r1["aggregations"]
+    # a refresh-visible write changes the epoch → miss + fresh result
+    idx.index_doc("2", {"v": 5})
+    idx.refresh()
+    r3 = svc.search("rc", body)
+    assert svc.request_cache_stats["miss_count"] == 2
+    assert r3["aggregations"]["s"]["value"] == 6.0
+    # sized requests and request_cache:false bypass the cache entirely
+    svc.search("rc", {"size": 1})
+    svc.search("rc", {**body, "request_cache": False})
+    assert svc.request_cache_stats == {"hit_count": 1, "miss_count": 2}
+    # cached responses are isolated from caller mutation
+    r2["aggregations"]["s"]["value"] = -1
+    r4 = svc.search("rc", {"size": 0, "track_total_hits": True,
+                           "aggs": {"s": {"sum": {"field": "v"}}}})
+    assert r4["aggregations"]["s"]["value"] == 6.0
+    indices.close()
