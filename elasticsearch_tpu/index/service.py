@@ -265,9 +265,16 @@ class IndexService:
 
     # ------------------------------------------------------------ search
     def shard_searchers(self) -> List[ShardSearcher]:
-        return [ShardSearcher(shard.acquire_searcher().segments, self.mapper,
+        out = []
+        for shard in self.shards:
+            snap = shard.acquire_searcher()
+            s = ShardSearcher(snap.segments, self.mapper,
                               self.device_cache, self.k1, self.b)
-                for shard in self.shards]
+            # the snapshot epoch travels with the searcher so request-
+            # cache keys stay atomically consistent with the data read
+            s.epoch = snap.epoch
+            out.append(s)
+        return out
 
     def stats(self) -> Dict[str, Any]:
         docs = 0

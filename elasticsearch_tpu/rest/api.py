@@ -1017,8 +1017,12 @@ def _merge_search_params(body, params):
         if key in params:
             body[key] = int(params[key])
     if "request_cache" in params:
-        body["request_cache"] = params["request_cache"] not in (
-            "false", "False")
+        v = params["request_cache"]
+        if v not in ("true", "false"):
+            raise IllegalArgumentException(
+                f"Failed to parse value [{v}] as only [true] or [false] "
+                "are allowed.")
+        body["request_cache"] = v == "true"
     return body
 
 

@@ -212,8 +212,47 @@ class SearchService:
                                                   "keep_alive")
             pit.expires_at = time.time() + pit.keep_alive
             names, searchers = pit.index_names, pit.searchers
+            cache_body_key = None
         else:
             names = self.indices_service.resolve(index_expression)
+
+            # ---- shard request cache probe (ref: IndicesRequestCache):
+            # the cache directive leaves the body (it is not part of the
+            # query); the probe runs BEFORE searcher acquisition so hits
+            # skip snapshot/DFS setup entirely. Cache state stays LOCAL
+            # to this request — the service is shared across threads.
+            cache_body_key = None
+            if body and "request_cache" in body:
+                body = dict(body)
+                use_flag = body.pop("request_cache")
+            else:
+                use_flag = None
+            if (scroll is None
+                    and int((body or {}).get("size",
+                                             DEFAULT_SIZE)) == 0
+                    and use_flag is not False):
+                cache_body_key = json.dumps(
+                    body, sort_keys=True, default=str)
+                live_epochs = []
+                for name in names:
+                    live_epochs.extend(
+                        sh.epoch for sh in
+                        self.indices_service.get(name).shards)
+                probe_key = (tuple(names), tuple(live_epochs),
+                             self._cache_identity(names), search_type,
+                             cache_body_key)
+                with self._lock:
+                    cached = self._request_cache.get(probe_key)
+                    if cached is not None:
+                        self._request_cache.move_to_end(probe_key)
+                        self.request_cache_stats["hit_count"] += 1
+                        import copy as _copy
+                        response = _copy.deepcopy(cached)
+                        response["took"] = int(
+                            (time.monotonic() - start) * 1000)
+                        return response
+                    self.request_cache_stats["miss_count"] += 1
+
             searchers = []
             for name in names:
                 idx = self.indices_service.get(name)
@@ -262,44 +301,40 @@ class SearchService:
             with self._lock:
                 self._scrolls[scroll_ctx.scroll_id] = scroll_ctx
 
-        cache_key = None
-        if (scroll_ctx is None and pit_spec is None
-                and int((body or {}).get("size", DEFAULT_SIZE)) == 0
-                and (body or {}).get("request_cache") is not False):
-            epochs = []
-            for name in names:
-                if self.indices_service.has(name):
-                    epochs.extend(
-                        sh.epoch for sh in
-                        self.indices_service.get(name).shards)
-            cache_key = (tuple(names), tuple(epochs), search_type,
-                         json.dumps(body, sort_keys=True, default=str))
-            with self._lock:
-                cached = self._request_cache.get(cache_key)
-                if cached is not None:
-                    self._request_cache.move_to_end(cache_key)
-                    self.request_cache_stats["hit_count"] += 1
-                    import copy as _copy
-                    response = _copy.deepcopy(cached)
-                    response["took"] = int(
-                        (time.monotonic() - start) * 1000)
-                    return response
-                self.request_cache_stats["miss_count"] += 1
-
         response = self._execute(searchers, body, scroll_ctx=scroll_ctx,
                                  task=task)
         response["took"] = int((time.monotonic() - start) * 1000)
         if scroll_ctx is not None:
             response["_scroll_id"] = scroll_ctx.scroll_id
-        if cache_key is not None:
+        if cache_body_key is not None:
+            # store under the SNAPSHOT epochs the data was read at (a
+            # concurrent refresh between probe and acquire must not file
+            # stale data under the fresh key)
+            snap_epochs = tuple(getattr(s, "epoch", -1)
+                                for _, s in searchers)
+            store_key = (tuple(names), snap_epochs,
+                         self._cache_identity(names), search_type,
+                         cache_body_key)
             import copy as _copy
             with self._lock:
-                self._request_cache[cache_key] = _copy.deepcopy(response)
+                self._request_cache[store_key] = _copy.deepcopy(response)
                 while len(self._request_cache) > \
                         self.REQUEST_CACHE_MAX_ENTRIES:
                     self._request_cache.popitem(last=False)
         self._after_search(names, response["took"], body)
         return response
+
+    def _cache_identity(self, names: List[str]) -> tuple:
+        """Index identity (creation dates): epochs restart per Engine, so
+        a deleted+recreated index must never hit old entries."""
+        out = []
+        for name in names:
+            if self.indices_service.has(name):
+                out.append(self.indices_service.get(name).settings.get(
+                    "index.creation_date"))
+            else:
+                out.append(None)
+        return tuple(out)
 
     def _rrf_search(self, searchers, body: Dict[str, Any],
                     task) -> Dict[str, Any]:

@@ -321,3 +321,32 @@ def test_shard_request_cache(tmp_path):
                            "aggs": {"s": {"sum": {"field": "v"}}}})
     assert r4["aggregations"]["s"]["value"] == 6.0
     indices.close()
+
+
+def test_request_cache_index_recreation_isolated(tmp_path):
+    """Deleting and recreating an index with identical epochs must not
+    serve the old index's cached responses (identity in the key)."""
+    from elasticsearch_tpu.index.service import IndicesService
+    from elasticsearch_tpu.search.service import SearchService
+    import time as _time
+    indices = IndicesService(str(tmp_path / "rcid"))
+    body = {"size": 0, "track_total_hits": True,
+            "aggs": {"s": {"sum": {"field": "v"}}}}
+
+    def make(v):
+        idx = indices.create_index("rc", {}, {"properties": {
+            "v": {"type": "long"}}})
+        idx.index_doc("1", {"v": v})
+        idx.refresh()
+        return idx
+
+    make(1)
+    svc = SearchService(indices)
+    r1 = svc.search("rc", body)
+    assert r1["aggregations"]["s"]["value"] == 1.0
+    indices.delete_index("rc")
+    _time.sleep(0.002)                       # distinct creation_date ms
+    make(5)
+    r2 = svc.search("rc", body)
+    assert r2["aggregations"]["s"]["value"] == 5.0
+    indices.close()
