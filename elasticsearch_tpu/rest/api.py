@@ -88,6 +88,18 @@ class RestController:
                               "root_cause": [e.to_xcontent()]},
                     "status": e.status,
                 }
+            except Exception as e:        # noqa: BLE001
+                # unexpected failures become 500 responses, never dropped
+                # connections (ref: RestController catches Throwable and
+                # answers with an error body)
+                import logging
+                import traceback
+                logging.getLogger("rest.controller").error(
+                    "unhandled error for %s %s\n%s", method, path,
+                    traceback.format_exc())
+                err = {"type": type(e).__name__, "reason": str(e)}
+                return 500, {"error": {**err, "root_cause": [err]},
+                             "status": 500}
         if matched_path:
             return 405, {"error": f"Incorrect HTTP method for uri [{path}], "
                                   f"allowed: {self._allowed(path)}", "status": 405}
@@ -841,6 +853,10 @@ def bulk(node, params, body, index=None):
         lines = body
     else:
         raise IllegalArgumentException("bulk body must be NDJSON")
+    # a one-line JSON array (either parsed upstream or NDJSON-split into
+    # a single line) wraps the whole request in one element — unwrap it
+    if len(lines) == 1 and isinstance(lines[0], list):
+        lines = lines[0]
     items = []
     errors = False
     i = 0

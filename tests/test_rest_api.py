@@ -327,3 +327,42 @@ def test_scroll_reports_total_on_every_page(node):
     assert r["hits"]["total"]["value"] == 9
     r = do(node, "POST", "/_search/scroll", body={"scroll_id": sid})
     assert r["hits"]["total"]["value"] == 9  # continuation pages keep total
+
+
+def test_bulk_json_array_over_http_and_500_handling(tmp_path):
+    """A one-line JSON-array _bulk body must work over real HTTP (the
+    NDJSON line parser wraps it), and unexpected handler failures must
+    answer 500 instead of dropping the connection."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from elasticsearch_tpu.node import Node
+    n = Node(data_path=str(tmp_path / "h"))
+    port = n.start(0)
+    try:
+        data = json.dumps([{"index": {"_index": "t", "_id": "1"}},
+                           {"a": 1}]).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/_bulk", data=data, method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            r = json.loads(resp.read().decode())
+        assert r["errors"] is False
+        assert r["items"][0]["index"]["result"] == "created"
+
+        # a handler crash (forced) returns a JSON 500, not a dropped
+        # connection
+        def boom(node, params, body):
+            raise RuntimeError("kaboom")
+        n.rest_controller.register("GET", "/_boom", boom)
+        req = urllib.request.Request(f"http://127.0.0.1:{port}/_boom")
+        try:
+            urllib.request.urlopen(req)
+            assert False, "expected 500"
+        except urllib.error.HTTPError as e:
+            assert e.code == 500
+            payload = json.loads(e.read().decode())
+            assert payload["error"]["type"] == "RuntimeError"
+    finally:
+        n.close()
