@@ -97,7 +97,11 @@ class RestController:
                 logging.getLogger("rest.controller").error(
                     "unhandled error for %s %s\n%s", method, path,
                     traceback.format_exc())
-                err = {"type": type(e).__name__, "reason": str(e)}
+                name = type(e).__name__
+                snake = "".join(
+                    ("_" + ch.lower()) if ch.isupper() and i > 0
+                    else ch.lower() for i, ch in enumerate(name))
+                err = {"type": snake, "reason": str(e)}
                 return 500, {"error": {**err, "root_cause": [err]},
                              "status": 500}
         if matched_path:
@@ -846,15 +850,23 @@ def bulk(node, params, body, index=None):
     """NDJSON bulk (ref: action/bulk/TransportBulkAction.java:100,172 —
     grouped per shard; here executed item-by-item against local shards)."""
     if isinstance(body, (bytes, str)):
-        lines = [json.loads(l) for l in
-                 (body.decode() if isinstance(body, bytes) else body).splitlines()
-                 if l.strip()]
+        text = body.decode() if isinstance(body, bytes) else body
+        try:
+            if text.lstrip().startswith("["):
+                # a JSON-array body in any formatting (compact or
+                # pretty-printed) parses as one document
+                lines = json.loads(text)
+            else:
+                lines = [json.loads(l) for l in text.splitlines()
+                         if l.strip()]
+        except ValueError as e:
+            raise ParsingException(
+                f"Failed to parse bulk body: {e}")
     elif isinstance(body, list):
         lines = body
     else:
         raise IllegalArgumentException("bulk body must be NDJSON")
-    # a one-line JSON array (either parsed upstream or NDJSON-split into
-    # a single line) wraps the whole request in one element — unwrap it
+    # a parsed-upstream one-line array wraps the request in one element
     if len(lines) == 1 and isinstance(lines[0], list):
         lines = lines[0]
     items = []

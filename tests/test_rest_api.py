@@ -363,6 +363,27 @@ def test_bulk_json_array_over_http_and_500_handling(tmp_path):
         except urllib.error.HTTPError as e:
             assert e.code == 500
             payload = json.loads(e.read().decode())
-            assert payload["error"]["type"] == "RuntimeError"
+            assert payload["error"]["type"] == "runtime_error"
+
+        # malformed NDJSON is the CLIENT's fault: 400 parse error
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{port}/_bulk", data=b"not json\n",
+            method="POST",
+            headers={"Content-Type": "application/x-ndjson"})
+        try:
+            urllib.request.urlopen(bad)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+        # pretty-printed JSON-array bodies parse too
+        pretty = json.dumps([{"index": {"_index": "t", "_id": "2"}},
+                             {"a": 2}], indent=2).encode()
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:{port}/_bulk", data=pretty, method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req2) as resp:
+            r = json.loads(resp.read().decode())
+        assert r["errors"] is False
     finally:
         n.close()
