@@ -1,0 +1,367 @@
+"""Fused query-plan top-k kernel — the serving-path hot loop.
+
+This is the TPU replacement for Lucene's BooleanQuery/ConjunctionDISI
+scoring stack (ref: search/internal/ContextIndexSearcher.java:196-232 —
+per-segment ``BulkScorer.score``; BooleanWeight/ConjunctionDISI iterator
+trees). Instead of executing each clause into a dense [ND] score/mask pair
+via scatter (XLA scatter-add serializes on TPU — measured ~70ms/launch,
+see ops/bm25.py), the whole boolean tree executes as ONE sorted
+segmented-reduction program over the query's postings:
+
+  1. gather the selected postings blocks of every scoring/filtering clause
+     (gathers vectorize), tagging each posting with (group, subgroup) ids —
+     a "group" is one bool clause (a match query, a term filter, …), a
+     "subgroup" one term within it;
+  2. sort (docid, group, subgroup, contribution) lexicographically
+     (`lax.sort` — bitonic on the VPU);
+  3. segmented reductions over the sorted runs compute, per (doc, group):
+     distinct-subgroup counts (minimum_should_match / operator=and inside a
+     clause) and summed BM25 contributions; then per doc: which groups are
+     present, must/filter/should satisfaction, must_not exclusion, and the
+     combined score (sum or dis-max);
+  4. dense, vectorized column predicates (range/exists/numeric-term — no
+     scatter anywhere in their construction) enter as one gathered
+     ``dense_mask`` lookup;
+  5. `lax.top_k` over the per-doc run totals yields (scores, docids) and an
+     exact matching-doc count, with NO dense [ND] accumulator in the path.
+
+Cost is O(P log P) in the query's postings count P — corpus-size
+independent, like Lucene's skip-list iteration, but branch-free and
+batchable (vmap over queries = continuous batching, SURVEY.md §7 hard
+part 5).
+
+Group kinds mirror the bool query's occur classes (ref:
+BoolQueryBuilder / Lucene BooleanClause.Occur).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MUST = 0
+SHOULD = 1
+FILTER = 2
+MUST_NOT = 3
+
+_SENTINEL = 0x7FFFFFFF  # padding docid; sorts after every real docid
+
+
+class FieldStream(NamedTuple):
+    """One field's postings selection for a query plan.
+
+    Device-resident corpus arrays plus the per-query selection: block ids
+    and, per selected block, the owning (group, subgroup), the scoring
+    weight (idf·boost), and whether the clause scores constant-per-match
+    (keyword term semantics: Lucene keyword fields index no norms, score =
+    idf·tf/(tf+k1) with tf=1) instead of full BM25.
+    """
+
+    block_docids: jax.Array   # int32 [TB+1, B] (with reserved zero block)
+    block_tfs: jax.Array      # float32 [TB+1, B]
+    doc_lens: jax.Array       # float32 [ND]
+    avg_len: jax.Array        # float32 scalar (shard-level stat)
+    sel_blocks: jax.Array     # int32 [NB]
+    sel_group: jax.Array      # int32 [NB]
+    sel_sub: jax.Array        # int32 [NB]
+    sel_weight: jax.Array     # float32 [NB]
+    sel_const: jax.Array      # bool [NB] — constant-score contribution
+
+
+def _prev(x: jax.Array, fill) -> jax.Array:
+    return jnp.concatenate([jnp.full((1,), fill, x.dtype), x[:-1]])
+
+
+def _segsum(x: jax.Array, is_start: jax.Array) -> jax.Array:
+    """Inclusive prefix sums within runs delimited by ``is_start``.
+
+    Requires x >= 0 (exclusive prefixes are then non-decreasing, so the
+    run-start exclusive prefix propagates forward by cummax)."""
+    cs = jnp.cumsum(x)
+    excl = cs - x
+    start = jax.lax.cummax(jnp.where(is_start, excl, jnp.zeros_like(excl)))
+    return cs - start
+
+
+def _segmax(x: jax.Array, is_start: jax.Array) -> jax.Array:
+    """Inclusive prefix max within runs (associative segmented-max scan)."""
+
+    def comb(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, jnp.maximum(va, vb))
+
+    _, out = jax.lax.associative_scan(comb, (is_start, x))
+    return out
+
+
+@partial(jax.jit, static_argnames=("k", "combine", "k1", "b", "with_dense",
+                                   "with_after"))
+def _plan_topk_impl(streams: Tuple[FieldStream, ...],
+                    group_kind: jax.Array,    # int32 [G]
+                    group_req: jax.Array,     # int32 [G]
+                    group_const: jax.Array,   # float32 [G]; NaN = sum contribs
+                    live: jax.Array,          # bool [ND]
+                    dense_mask: jax.Array,    # bool [ND] (all-true if unused)
+                    n_must: jax.Array, n_filter: jax.Array, msm: jax.Array,
+                    bonus: jax.Array, tie: jax.Array,
+                    after_score: jax.Array,   # float32; _score search_after
+                    k1: float, b: float, k: int, combine: str,
+                    with_dense: bool, with_after: bool = False):
+    parts_d, parts_tf, parts_c, parts_g, parts_s = [], [], [], [], []
+    for st in streams:
+        d = jnp.take(st.block_docids, st.sel_blocks, axis=0)    # [NB, B]
+        tf = jnp.take(st.block_tfs, st.sel_blocks, axis=0)
+        dl = jnp.take(st.doc_lens, d)
+        norm = k1 * (1.0 - b + b * dl / st.avg_len)
+        hit = tf > 0.0
+        bm25 = st.sel_weight[:, None] * jnp.where(hit, tf / (tf + norm), 0.0)
+        contrib = jnp.where(st.sel_const[:, None],
+                            jnp.where(hit, st.sel_weight[:, None], 0.0), bm25)
+        parts_d.append(d.reshape(-1))
+        parts_tf.append(tf.reshape(-1))
+        parts_c.append(contrib.reshape(-1))
+        parts_g.append(jnp.broadcast_to(
+            st.sel_group[:, None], d.shape).reshape(-1))
+        parts_s.append(jnp.broadcast_to(
+            st.sel_sub[:, None], d.shape).reshape(-1))
+
+    d_all = jnp.concatenate(parts_d)
+    tf_all = jnp.concatenate(parts_tf)
+    c_all = jnp.concatenate(parts_c)
+    g_all = jnp.concatenate(parts_g)
+    s_all = jnp.concatenate(parts_s)
+
+    nd = live.shape[0]
+    valid = (tf_all > 0.0) & jnp.take(live, jnp.clip(d_all, 0, nd - 1))
+    dkey = jnp.where(valid, d_all, _SENTINEL)
+    c_all = jnp.where(valid, c_all, 0.0)
+
+    dkey, g, s, c = jax.lax.sort((dkey, g_all, s_all, c_all), num_keys=3)
+
+    new_doc = dkey != _prev(dkey, -1)
+    new_grp = new_doc | (g != _prev(g, -1))
+    new_sub = new_grp | (s != _prev(s, -1))
+    is_grp_last = jnp.concatenate([new_grp[1:], jnp.ones(1, bool)])
+    is_doc_last = jnp.concatenate([new_doc[1:], jnp.ones(1, bool)])
+
+    # per-(doc, group): distinct subgroups matched + summed contribution
+    sub_cnt = _segsum(new_sub.astype(jnp.float32), new_grp)
+    grp_score = _segsum(c, new_grp)
+
+    ng = group_kind.shape[0]
+    gc = jnp.clip(g, 0, ng - 1)
+    kind = jnp.take(group_kind, gc)
+    req = jnp.take(group_req, gc)
+    cval = jnp.take(group_const, gc)
+    present = is_grp_last & (sub_cnt >= req.astype(jnp.float32))
+    gscore = jnp.where(jnp.isnan(cval), grp_score, cval)
+    scoring = (kind == MUST) | (kind == SHOULD)
+
+    score_in = jnp.where(present & scoring, gscore, 0.0)
+    must_in = (present & (kind == MUST)).astype(jnp.float32)
+    filt_in = (present & (kind == FILTER)).astype(jnp.float32)
+    should_in = (present & (kind == SHOULD)).astype(jnp.float32)
+    mnot_in = (present & (kind == MUST_NOT)).astype(jnp.float32)
+
+    doc_score = _segsum(score_in, new_doc)
+    doc_must = _segsum(must_in, new_doc)
+    doc_filt = _segsum(filt_in, new_doc)
+    doc_should = _segsum(should_in, new_doc)
+    doc_mnot = _segsum(mnot_in, new_doc)
+
+    if combine == "dismax":
+        mx_in = jnp.where(present & scoring, gscore, -jnp.inf)
+        doc_max = _segmax(mx_in, new_doc)
+        score = jnp.where(jnp.isfinite(doc_max),
+                          doc_max + tie * (doc_score - doc_max), 0.0)
+    else:
+        score = doc_score
+    score = score + bonus
+
+    passed = (is_doc_last & (dkey != _SENTINEL)
+              & (doc_must >= n_must.astype(jnp.float32))
+              & (doc_filt >= n_filter.astype(jnp.float32))
+              & (doc_should >= msm.astype(jnp.float32))
+              & (doc_mnot == 0.0))
+    if with_dense:
+        passed = passed & jnp.take(dense_mask, jnp.clip(dkey, 0, nd - 1))
+    if with_after:
+        # search_after on _score: strictly-after the cursor; ties excluded
+        # (as in the dense executor — reliable tie paging needs a trailing
+        # _doc key, which implies a sort spec and the dense path)
+        passed = passed & (score < after_score)
+
+    cand = jnp.where(passed, score, -jnp.inf)
+    if k > cand.shape[0]:
+        pad = k - cand.shape[0]
+        cand = jnp.concatenate([cand, jnp.full(pad, -jnp.inf)])
+        dkey = jnp.concatenate(
+            [dkey, jnp.full(pad, _SENTINEL, dkey.dtype)])
+    vals, pos = jax.lax.top_k(cand, k)
+    ids = jnp.take(dkey, pos)
+    ids = jnp.where(vals > -jnp.inf, ids, _SENTINEL)
+    total = jnp.sum(passed.astype(jnp.int32))
+    return vals, ids, total
+
+
+def plan_topk(streams, group_kind, group_req, group_const, live,
+              dense_mask: Optional[jax.Array],
+              n_must: int, n_filter: int, msm: int,
+              bonus: float = 0.0, tie: float = 0.0,
+              k1: float = 1.2, b: float = 0.75, k: int = 10,
+              combine: str = "sum",
+              after_score: Optional[float] = None):
+    """Single-query entry. ``dense_mask=None`` skips the gather entirely
+    (the common pure-postings case compiles without it)."""
+    with_dense = dense_mask is not None
+    if not with_dense:
+        dense_mask = jnp.ones(1, bool)  # placeholder, not read
+    with_after = after_score is not None
+    return _plan_topk_impl(
+        tuple(streams), jnp.asarray(group_kind, jnp.int32),
+        jnp.asarray(group_req, jnp.int32),
+        jnp.asarray(group_const, jnp.float32), live, dense_mask,
+        jnp.int32(n_must), jnp.int32(n_filter), jnp.int32(msm),
+        jnp.float32(bonus), jnp.float32(tie),
+        jnp.float32(after_score if with_after else 0.0),
+        float(k1), float(b), int(k), combine, with_dense, with_after)
+
+
+@partial(jax.jit, static_argnames=("k", "combine", "k1", "b"))
+def _plan_topk_batch_impl(streams, group_kind, group_req, group_const,
+                          live, n_must, n_filter, msm, bonus, tie,
+                          k1, b, k, combine):
+    """vmap over the query axis of the selection/group arrays; corpus
+    arrays are shared (in_axes=None). Dense factors are not batched —
+    the batcher only groups pure-postings plans (benchmark-class
+    match/bool-of-terms), others run singly."""
+    placeholder = jnp.ones(1, bool)
+
+    def one(sel_blocks, sel_group, sel_sub, sel_weight, sel_const,
+            gk, gr, gcst, nm, nf, ms, bo, ti):
+        sts = tuple(
+            FieldStream(st.block_docids, st.block_tfs, st.doc_lens,
+                        st.avg_len, sb, sg, ss, sw, sc)
+            for st, sb, sg, ss, sw, sc in zip(
+                streams, sel_blocks, sel_group, sel_sub, sel_weight,
+                sel_const))
+        return _plan_topk_impl(sts, gk, gr, gcst, live, placeholder,
+                               nm, nf, ms, bo, ti, jnp.float32(0.0),
+                               k1, b, k, combine, False)
+
+    sel_b = tuple(st.sel_blocks for st in streams)   # each [Q, NB]
+    sel_g = tuple(st.sel_group for st in streams)
+    sel_s = tuple(st.sel_sub for st in streams)
+    sel_w = tuple(st.sel_weight for st in streams)
+    sel_c = tuple(st.sel_const for st in streams)
+    return jax.vmap(one)(sel_b, sel_g, sel_s, sel_w, sel_c,
+                         group_kind, group_req, group_const,
+                         n_must, n_filter, msm, bonus, tie)
+
+
+def plan_topk_batch(streams, group_kind, group_req, group_const, live,
+                    n_must, n_filter, msm, bonus, tie,
+                    k1: float = 1.2, b: float = 0.75, k: int = 10,
+                    combine: str = "sum"):
+    """Batched entry: every per-query array has a leading [Q] axis; the
+    corpus arrays inside ``streams`` stay unbatched (shared). This is the
+    continuous-batching launch shape (SURVEY.md §7 hard part 5)."""
+    return _plan_topk_batch_impl(
+        tuple(streams), jnp.asarray(group_kind, jnp.int32),
+        jnp.asarray(group_req, jnp.int32),
+        jnp.asarray(group_const, jnp.float32), live,
+        jnp.asarray(n_must, jnp.int32), jnp.asarray(n_filter, jnp.int32),
+        jnp.asarray(msm, jnp.int32), jnp.asarray(bonus, jnp.float32),
+        jnp.asarray(tie, jnp.float32),
+        float(k1), float(b), int(k), combine)
+
+
+# ---------------------------------------------------------------------------
+# Scatter-free dense builders (for the fallback path: aggs need full masks)
+# ---------------------------------------------------------------------------
+
+def _unique_scatter_indices(dkey: jax.Array, is_last: jax.Array,
+                            nd: int) -> jax.Array:
+    """Strictly-unique scatter targets: run-last lanes write their docid,
+    every other lane writes a distinct out-of-bounds slot (dropped).
+    Guaranteed-unique indices let XLA emit a parallel scatter instead of
+    the serialized duplicate-handling form (the ~70ms trap)."""
+    lane = jnp.arange(dkey.shape[0], dtype=jnp.int32)
+    return jnp.where(is_last & (dkey != _SENTINEL), dkey, nd + lane)
+
+
+@partial(jax.jit, static_argnames=("k1", "b"))
+def bm25_dense_scores_sorted(block_docids, block_tfs, sel_blocks,
+                             sel_weights, doc_lens, avg_len,
+                             k1: float, b: float):
+    """Dense per-doc BM25 scores [ND] via sort + segmented sum + ONE
+    unique-index scatter — the scatter-free replacement for
+    ops/bm25.bm25_block_scores when a full score vector is semantically
+    required (aggs over scores, rescore windows)."""
+    d = jnp.take(block_docids, sel_blocks, axis=0)
+    tf = jnp.take(block_tfs, sel_blocks, axis=0)
+    dl = jnp.take(doc_lens, d)
+    norm = k1 * (1.0 - b + b * dl / avg_len)
+    contrib = sel_weights[:, None] * jnp.where(tf > 0.0, tf / (tf + norm), 0.0)
+
+    dflat = d.reshape(-1)
+    cflat = contrib.reshape(-1)
+    valid = tf.reshape(-1) > 0.0
+    dkey = jnp.where(valid, dflat, _SENTINEL)
+    dkey, c = jax.lax.sort((dkey, jnp.where(valid, cflat, 0.0)), num_keys=1)
+    new_doc = dkey != _prev(dkey, -1)
+    is_last = jnp.concatenate([new_doc[1:], jnp.ones(1, bool)])
+    totals = _segsum(c, new_doc)
+    nd = doc_lens.shape[0]
+    idx = _unique_scatter_indices(dkey, is_last, nd)
+    scores = jnp.zeros(nd, jnp.float32)
+    return scores.at[idx].set(totals, mode="drop", unique_indices=True)
+
+
+@jax.jit
+def match_count_sorted(block_docids, block_tfs, sel_blocks, clause_ids,
+                       live_template):
+    """int32 [ND] distinct-clause counts via sort + run boundaries + ONE
+    unique-index scatter — the scatter-free replacement for
+    ops/bm25.match_count (bool must / minimum_should_match on the dense
+    fallback path). ``live_template`` only supplies ND."""
+    d = jnp.take(block_docids, sel_blocks, axis=0)           # [NB, B]
+    tf = jnp.take(block_tfs, sel_blocks, axis=0)
+    cid = jnp.broadcast_to(clause_ids[:, None], d.shape)
+    dflat, cflat = d.reshape(-1), cid.reshape(-1)
+    valid = tf.reshape(-1) > 0.0
+    dkey = jnp.where(valid, dflat, _SENTINEL)
+    dkey, cl = jax.lax.sort((dkey, cflat), num_keys=2)
+    new_doc = dkey != _prev(dkey, -1)
+    new_pair = new_doc | (cl != _prev(cl, -1))
+    is_last = jnp.concatenate([new_doc[1:], jnp.ones(1, bool)])
+    counts = _segsum(new_pair.astype(jnp.float32), new_doc)
+    nd = live_template.shape[0]
+    idx = _unique_scatter_indices(dkey, is_last, nd)
+    out = jnp.zeros(nd, jnp.int32)
+    return out.at[idx].set(counts.astype(jnp.int32), mode="drop",
+                           unique_indices=True)
+
+
+@jax.jit
+def match_mask_sorted(block_docids, block_tfs, sel_blocks, live_template):
+    """bool [ND] any-of mask via the same unique-scatter trick — the
+    scatter-free replacement for ops/bm25.match_mask."""
+    d = jnp.take(block_docids, sel_blocks, axis=0)
+    tf = jnp.take(block_tfs, sel_blocks, axis=0)
+    dflat = d.reshape(-1)
+    valid = tf.reshape(-1) > 0.0
+    dkey = jnp.where(valid, dflat, _SENTINEL)
+    dkey = jax.lax.sort(dkey)
+    new_doc = dkey != _prev(dkey, -1)
+    is_last = jnp.concatenate([new_doc[1:], jnp.ones(1, bool)])
+    nd = live_template.shape[0]
+    idx = _unique_scatter_indices(dkey, is_last, nd)
+    out = jnp.zeros(nd, bool)
+    return out.at[idx].set(jnp.ones_like(dkey, bool), mode="drop",
+                           unique_indices=True)

@@ -1,0 +1,463 @@
+"""Query-plan compiler: QueryBuilder trees → fused top-k kernel plans.
+
+The serving-path replacement for the dense (scores, mask) execution model
+(ref: the reference compiles QueryBuilder → Lucene Weight/BulkScorer,
+search/internal/ContextIndexSearcher.java:196-232; here the analogous
+compilation target is ops/plan.py's sorted segmented-reduction kernel).
+
+A query is *plannable* when it decomposes into:
+- postings **groups** — clauses scored/filtered from a text/keyword field's
+  postings (match, multi_match, term, terms, constant_score over those),
+  each with its own presence requirement (operator=and /
+  minimum_should_match inside the clause);
+- **dense factors** — pure column predicates (range, exists, ids,
+  numeric/date/bool term(s), match_all) whose masks are vectorized
+  compares with no scatter anywhere;
+composed by at most one level of bool occur semantics (must / filter /
+should / must_not + minimum_should_match), or a top-level dis_max /
+multi_match over plannable children.
+
+Everything else (scripts, nested bools, positional queries, aggs paths)
+falls back to the dense executor — kept for when a full [ND] score vector
+is semantically required.
+
+Compilation happens once per shard (terms analyzed, idf from shard-level
+stats — exactly the stats the dense path uses); binding resolves term →
+postings-block ids per segment.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.index.mapper import (
+    ConstantKeywordFieldType,
+    KeywordFieldType,
+    TextFieldType,
+)
+from elasticsearch_tpu.ops import bm25 as bm25_ops
+from elasticsearch_tpu.ops import plan as plan_ops
+from elasticsearch_tpu.ops.device import block_bucket
+from elasticsearch_tpu.search import queries as q
+
+NAN = float("nan")
+_NEVER = 1 << 30  # requirement no group can meet (pad groups)
+
+
+@dataclass
+class TermEntry:
+    field: str
+    term: str
+    sub: int          # subgroup id within the group
+    weight: float     # idf · boost (0 for pure-presence entries)
+    const: bool       # constant-per-match contribution (keyword scoring)
+
+
+@dataclass
+class GroupPlan:
+    kind: int                     # plan_ops.MUST / SHOULD / FILTER / MUST_NOT
+    req: int                      # distinct subgroups required for presence
+    const_score: float            # NaN = sum of contributions
+    terms: List[TermEntry] = dc_field(default_factory=list)
+
+
+@dataclass
+class LogicalPlan:
+    groups: List[GroupPlan]
+    dense: List[Tuple[Any, bool]]         # (QueryBuilder, negate)
+    n_must: int                           # postings MUST groups
+    n_filter: int                         # postings FILTER groups
+    msm: int
+    bonus: float                          # constant score of dense must/
+                                          # constant clauses every hit gets
+    combine: str = "sum"
+    tie: float = 0.0
+
+    def postings_required(self) -> bool:
+        """True iff every passing doc must match ≥1 postings group — the
+        kernel can only see docs that appear in the gathered postings."""
+        return self.n_must >= 1 or self.n_filter >= 1 or self.msm >= 1
+
+
+# ---------------------------------------------------------------------------
+# clause classification
+# ---------------------------------------------------------------------------
+
+def _is_postings_field(mapper, field: str) -> bool:
+    ft = mapper.field_type(field)
+    if isinstance(ft, ConstantKeywordFieldType):
+        return False
+    return (ft is None or isinstance(ft, (TextFieldType, KeywordFieldType))
+            or getattr(ft, "docvalue_kind", None) == "flattened")
+
+
+def _is_dense_clause(node, mapper) -> bool:
+    """Clauses whose do_execute builds masks from dense columns only —
+    no postings scatter anywhere (range/exists/ids/match_all and term(s)
+    on numeric/date/bool/constant_keyword/range fields)."""
+    if isinstance(node, (q.RangeQuery, q.ExistsQuery, q.IdsQuery,
+                         q.MatchAllQuery)):
+        return True
+    if isinstance(node, (q.TermQuery, q.TermsQuery)):
+        return not _is_postings_field(mapper, node.field)
+    return False
+
+
+def _analyze(searcher, field: str, text: str) -> List[str]:
+    # the dense executor's analysis, verbatim — one tokenization for both
+    # paths (queries._analyze_terms only reads .mapper, which ShardSearcher
+    # exposes just like SegmentContext)
+    return q._analyze_terms(searcher, field, text)
+
+
+def _idf(searcher, field: str, term: str) -> float:
+    doc_count, _ = searcher.stats.field_stats(field)
+    df = searcher.stats.doc_freq(field, term)
+    return bm25_ops.idf(df, doc_count) if df > 0 else 0.0
+
+
+# ---------------------------------------------------------------------------
+# per-clause group builders (return None when not plannable)
+# ---------------------------------------------------------------------------
+
+def _group_for_match(node: "q.MatchQuery", searcher, kind: int,
+                     scale: float) -> Optional[GroupPlan]:
+    if not _is_postings_field(searcher.mapper, node.field):
+        return None
+    terms = _analyze(searcher, node.field, node.query)
+    if not terms:
+        return None  # matches nothing; dense fallback returns empty fast
+    uniq = {t: i for i, t in enumerate(sorted(set(terms)))}
+    if node.operator == "and":
+        req = len(uniq)
+    elif node.minimum_should_match:
+        # parsed over the token count (duplicates included), clamped to the
+        # distinct-term count; ≤1 means "any term" — exactly the dense
+        # path's required/need computation (queries.MatchQuery.do_execute)
+        r = q.parse_minimum_should_match(
+            node.minimum_should_match, len(terms))
+        req = 1 if r <= 1 else min(r, len(uniq))
+    else:
+        req = 1
+    g = GroupPlan(kind, req, NAN)
+    for t in terms:  # duplicates kept: they double the contribution, as in
+        # the dense path (select_blocks extends per occurrence)
+        g.terms.append(TermEntry(node.field, t, uniq[t],
+                                 _idf(searcher, node.field, t) * scale,
+                                 False))
+    return g
+
+
+def _group_for_term(node: "q.TermQuery", searcher, kind: int,
+                    scale: float) -> Optional[GroupPlan]:
+    mapper = searcher.mapper
+    if not _is_postings_field(mapper, node.field):
+        return None
+    ft = mapper.field_type(node.field)
+    term = str(node.value)
+    if isinstance(ft, TextFieldType):
+        g = GroupPlan(kind, 1, NAN)
+        g.terms.append(TermEntry(node.field, term,
+                                 0, _idf(searcher, node.field, term) * scale,
+                                 False))
+        return g
+    # keyword/unmapped/flattened: constant score idf·1/(1+k1), no norms
+    # (ref: Lucene keyword fields omit norms; see queries.TermQuery)
+    const = _idf(searcher, node.field, term) / (1.0 + searcher.k1) * scale
+    g = GroupPlan(kind, 1, const)
+    g.terms.append(TermEntry(node.field, term, 0, 0.0, False))
+    return g
+
+
+def _group_for_terms(node: "q.TermsQuery", searcher, kind: int,
+                     scale: float) -> Optional[GroupPlan]:
+    if not _is_postings_field(searcher.mapper, node.field):
+        return None
+    g = GroupPlan(kind, 1, 1.0 * scale)   # constant_score(1.0) any-of
+    for v in node.values:
+        g.terms.append(TermEntry(node.field, str(v), 0, 0.0, False))
+    return g
+
+
+def _group_for_clause(node, searcher, kind: int,
+                      scale: float) -> Optional[GroupPlan]:
+    scale = scale * getattr(node, "boost", 1.0)
+    if isinstance(node, q.MatchQuery):
+        return _group_for_match(node, searcher, kind, scale)
+    if isinstance(node, q.TermQuery):
+        return _group_for_term(node, searcher, kind, scale)
+    if isinstance(node, q.TermsQuery):
+        return _group_for_terms(node, searcher, kind, scale)
+    if isinstance(node, q.ConstantScoreQuery):
+        inner = _group_for_clause(node.filter_query, searcher, kind, 1.0)
+        if inner is None:
+            return None
+        inner.kind = kind
+        inner.const_score = 1.0 * scale   # score is the boost, not BM25
+        for t in inner.terms:
+            t.weight = 0.0
+        return inner
+    return None
+
+
+# ---------------------------------------------------------------------------
+# top-level compilation
+# ---------------------------------------------------------------------------
+
+def compile_plan(query, searcher,
+                 post_filter=None) -> Optional[LogicalPlan]:
+    """Compile a rewritten query (+ optional post_filter folded in as a
+    filter — valid when no aggregations run) into a LogicalPlan, or None
+    when the tree needs the dense executor."""
+    plan = _compile_tree(query, searcher)
+    if plan is None:
+        return None
+    if post_filter is not None:
+        g = _group_for_clause(post_filter, searcher, plan_ops.FILTER, 1.0)
+        if g is not None:
+            g.const_score = NAN
+            plan.groups.append(g)
+            plan.n_filter += 1
+        elif _is_dense_clause(post_filter, searcher.mapper):
+            plan.dense.append((post_filter, False))
+        else:
+            return None
+    if not plan.postings_required():
+        return None
+    # negative boosts would feed negative contributions into the kernel's
+    # cumsum/cummax segmented sums (which require x >= 0) — dense fallback
+    if plan.bonus < 0:
+        return None
+    for g in plan.groups:
+        if any(t.weight < 0 for t in g.terms):
+            return None
+        if not math.isnan(g.const_score) and g.const_score < 0:
+            return None
+    return plan
+
+
+def _compile_tree(query, searcher) -> Optional[LogicalPlan]:
+    boost = getattr(query, "boost", 1.0)
+    if isinstance(query, q.BoolQuery):
+        return _compile_bool(query, searcher, boost)
+    if isinstance(query, q.MultiMatchQuery):
+        return _compile_multi_match(query, searcher, boost)
+    if isinstance(query, q.DisMaxQuery):
+        return _compile_dismax(query, searcher, boost)
+    g = _group_for_clause(query, searcher, plan_ops.MUST, 1.0)
+    if g is not None:
+        # top-level boost is inside the group scale already via
+        # _group_for_clause's getattr(node, "boost")
+        return LogicalPlan([g], [], 1, 0, 0, 0.0)
+    return None
+
+
+def _compile_bool(node: "q.BoolQuery", searcher,
+                  boost: float) -> Optional[LogicalPlan]:
+    groups: List[GroupPlan] = []
+    dense: List[Tuple[Any, bool]] = []
+    bonus = 0.0
+    n_must = n_filter = 0
+    n_required_any = 0  # must+filter clauses of any kind (for msm default)
+
+    for clause in node.must:
+        g = _group_for_clause(clause, searcher, plan_ops.MUST, boost)
+        if g is not None:
+            groups.append(g)
+            n_must += 1
+        elif _is_dense_clause(clause, searcher.mapper):
+            dense.append((clause, False))
+            # a required constant-score clause adds its score to every hit
+            # (dense masks score 1.0·boost in the dense path)
+            bonus += getattr(clause, "boost", 1.0) * boost
+        else:
+            return None
+        n_required_any += 1
+    for clause in node.filter:
+        g = _group_for_clause(clause, searcher, plan_ops.FILTER, 1.0)
+        if g is not None:
+            g.const_score = NAN   # filters never score
+            groups.append(g)
+            n_filter += 1
+        elif _is_dense_clause(clause, searcher.mapper):
+            dense.append((clause, False))
+        else:
+            return None
+        n_required_any += 1
+    for clause in node.must_not:
+        g = _group_for_clause(clause, searcher, plan_ops.MUST_NOT, 1.0)
+        if g is not None:
+            g.const_score = NAN
+            groups.append(g)
+        elif _is_dense_clause(clause, searcher.mapper):
+            dense.append((clause, True))
+        else:
+            return None
+    for clause in node.should:
+        g = _group_for_clause(clause, searcher, plan_ops.SHOULD, boost)
+        if g is None:
+            return None   # dense should-clauses: conditional +1 scoring —
+            # rare; dense fallback keeps exact semantics
+        groups.append(g)
+
+    if node.minimum_should_match is None:
+        msm = 1 if (node.should and n_required_any == 0) else 0
+    else:
+        msm = q.parse_minimum_should_match(
+            node.minimum_should_match, len(node.should))
+    if node.should and msm > len(node.should):
+        msm = len(node.should)
+    return LogicalPlan(groups, dense, n_must, n_filter, msm, bonus)
+
+
+def _compile_multi_match(node: "q.MultiMatchQuery", searcher,
+                         boost: float) -> Optional[LogicalPlan]:
+    fields = node.fields
+    if not fields or fields == ["*"]:
+        fields = [name for name, ft in searcher.mapper.mapper.fields.items()
+                  if isinstance(ft, TextFieldType)]
+    if not fields:
+        return None
+    groups = []
+    for f in fields:
+        g = _group_for_match(q.MatchQuery(f, node.query), searcher,
+                             plan_ops.SHOULD, boost)
+        if g is None:
+            return None
+        groups.append(g)
+    if node.type == "most_fields":
+        return LogicalPlan(groups, [], 0, 0, 1, 0.0, combine="sum")
+    if node.type == "best_fields":
+        return LogicalPlan(groups, [], 0, 0, 1, 0.0, combine="dismax",
+                           tie=node.tie_breaker)
+    return None   # cross_fields/phrase types: dense fallback
+
+
+def _compile_dismax(node: "q.DisMaxQuery", searcher,
+                    boost: float) -> Optional[LogicalPlan]:
+    groups = []
+    for sub in node.queries:
+        g = _group_for_clause(sub, searcher, plan_ops.SHOULD, boost)
+        if g is None:
+            return None
+        groups.append(g)
+    if not groups:
+        return None
+    return LogicalPlan(groups, [], 0, 0, 1, 0.0, combine="dismax",
+                       tie=node.tie_breaker)
+
+
+# ---------------------------------------------------------------------------
+# per-segment binding + execution
+# ---------------------------------------------------------------------------
+
+@dataclass
+class BoundPlan:
+    """A LogicalPlan bound to one segment's device arrays: ready-to-launch
+    kernel arguments (the per-query bytes shipped to device are just the
+    selection arrays — a few hundred bytes)."""
+    streams: List[plan_ops.FieldStream]
+    group_kind: np.ndarray
+    group_req: np.ndarray
+    group_const: np.ndarray
+    dense_mask: Optional[jnp.ndarray]
+    n_must: int
+    n_filter: int
+    msm: int
+    bonus: float
+    tie: float
+    combine: str
+    empty: bool = False   # no query term exists in this segment
+
+
+def bind_plan(plan: LogicalPlan, ctx) -> BoundPlan:
+    """Resolve terms → block ids against one segment (ctx: SegmentContext).
+    Selection arrays bucket to powers of two so NB takes O(log) distinct
+    values across queries (XLA compile-cache discipline, ops/device.py)."""
+    ngroups = len(plan.groups)
+    by_field: Dict[str, List[Tuple[int, int, float, bool, str]]] = {}
+    for gi, g in enumerate(plan.groups):
+        for t in g.terms:
+            by_field.setdefault(t.field, []).append(
+                (gi, t.sub, t.weight, t.const, t.term))
+
+    streams: List[plan_ops.FieldStream] = []
+    any_entries = False
+    for fname, entries in by_field.items():
+        dp = ctx.device.postings.get(fname)
+        if dp is None:
+            continue
+        ids: List[int] = []
+        grps: List[int] = []
+        subs: List[int] = []
+        ws: List[float] = []
+        consts: List[bool] = []
+        for gi, sub, w, const, term in entries:
+            tid = dp.host.term_id(term)
+            if tid < 0:
+                continue
+            start = int(dp.term_block_start[tid])
+            count = int(dp.term_block_count[tid])
+            ids.extend(range(start, start + count))
+            grps.extend([gi] * count)
+            subs.extend([sub] * count)
+            ws.extend([w] * count)
+            consts.extend([const] * count)
+        if not ids:
+            continue
+        any_entries = True
+        n = block_bucket(len(ids))
+        pad = n - len(ids)
+        ids.extend([dp.zero_block] * pad)
+        grps.extend([ngroups] * pad)   # clipped in-kernel; tf=0 ⇒ inert
+        subs.extend([0] * pad)
+        ws.extend([0.0] * pad)
+        consts.extend([False] * pad)
+        streams.append(plan_ops.FieldStream(
+            dp.block_docids, dp.block_tfs, dp.doc_lens,
+            jnp.float32(ctx.stats.field_stats(fname)[1]),
+            jnp.asarray(np.asarray(ids, np.int32)),
+            jnp.asarray(np.asarray(grps, np.int32)),
+            jnp.asarray(np.asarray(subs, np.int32)),
+            jnp.asarray(np.asarray(ws, np.float32)),
+            jnp.asarray(np.asarray(consts, bool))))
+
+    gpad = max(4, block_bucket(max(1, ngroups)) if ngroups else 4)
+    kind = np.full(gpad, plan_ops.FILTER, np.int32)
+    req = np.full(gpad, _NEVER, np.int32)
+    const = np.full(gpad, NAN, np.float32)
+    for gi, g in enumerate(plan.groups):
+        kind[gi] = g.kind
+        req[gi] = g.req
+        const[gi] = g.const_score
+    # pad groups: FILTER with unreachable req — never present, and absent
+    # FILTER groups don't block (n_filter counts only real groups)
+
+    dense_mask = None
+    for clause, negate in plan.dense:
+        _, m = clause.do_execute(ctx)
+        m = (~m) if negate else m
+        dense_mask = m if dense_mask is None else (dense_mask & m)
+
+    return BoundPlan(streams, kind, req, const, dense_mask,
+                     plan.n_must, plan.n_filter, plan.msm, plan.bonus,
+                     plan.tie, plan.combine, empty=not any_entries)
+
+
+def execute_bound(bp: BoundPlan, ctx, k: int, k1: float, b: float,
+                  after_score: Optional[float] = None):
+    """Launch the fused kernel for one segment → (vals[k], ids[k], total)
+    device arrays (empty-bind shortcut returns host zeros)."""
+    if bp.empty:
+        return (np.full(k, -np.inf, np.float32),
+                np.full(k, plan_ops._SENTINEL, np.int32), 0)
+    return plan_ops.plan_topk(
+        bp.streams, bp.group_kind, bp.group_req, bp.group_const,
+        ctx.live, bp.dense_mask, bp.n_must, bp.n_filter, bp.msm,
+        bonus=bp.bonus, tie=bp.tie, k1=k1, b=b, k=k, combine=bp.combine,
+        after_score=after_score)

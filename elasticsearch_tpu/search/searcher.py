@@ -85,12 +85,34 @@ class ShardSearcher:
                     search_after: Optional[List[Any]] = None,
                     track_total_hits: bool = True,
                     after_key: Optional[Tuple[float, int, int]] = None,
-                    collect_masks: bool = False) -> QueryResult:
+                    collect_masks: bool = False,
+                    allow_plan: bool = True) -> QueryResult:
         k = min(max(size, 1), MAX_TOPK)
         query = query.rewrite(self)
         if post_filter is not None:
             post_filter = post_filter.rewrite(self)
         sort_spec = _parse_sort(sort)
+
+        # ---- fused plan fast path (ref: the BulkScorer replacement —
+        # ops/plan.py): score-sorted top-k queries with no agg masks
+        # compile straight to the sorted segmented-reduction kernel; the
+        # dense executor below stays for everything that semantically
+        # needs full [ND] score/mask vectors
+        plan_after: Optional[float] = None
+        if search_after is not None and sort_spec is None \
+                and len(search_after) == 1:
+            # _score cursor: the kernel applies it natively, keeping ALL
+            # pages of a score-paged walk on one executor (float32 sums
+            # differ between executors in the last bits)
+            plan_after = float(search_after[0])
+        if (allow_plan and sort_spec is None and min_score is None
+                and (search_after is None or plan_after is not None)
+                and after_key is None and not collect_masks):
+            from elasticsearch_tpu.search.plan import compile_plan
+            plan = compile_plan(query, self, post_filter)
+            if plan is not None:
+                return self._plan_query_phase(query, plan, k,
+                                              track_total_hits, plan_after)
         per_segment: List[Tuple[int, np.ndarray, np.ndarray, np.ndarray]] = []
         total = 0
         max_score = None
@@ -190,6 +212,43 @@ class ShardSearcher:
                 lambda a, b: _host_sort_cmp(a, b, sort_spec)))
             docs = docs[:k]
         return QueryResult(docs, total, max_score, agg_masks)
+
+    def _plan_query_phase(self, query: QueryBuilder, plan, k: int,
+                          track_total_hits: bool,
+                          after_score: Optional[float] = None) -> QueryResult:
+        """Execute a compiled LogicalPlan per segment via the fused
+        sorted-top-k kernel (search/plan.py) and merge exactly as the
+        dense path merges (by (-score, segment, docid))."""
+        from elasticsearch_tpu.search.plan import bind_plan, execute_bound
+
+        per_segment: List[Tuple[int, np.ndarray, np.ndarray]] = []
+        total = 0
+        for seg_idx, ctx in enumerate(self._contexts()):
+            if ctx.segment.n_docs == 0 or not query.can_match(ctx):
+                continue
+            bp = bind_plan(plan, ctx)
+            vals, ids, seg_total = execute_bound(bp, ctx, k, self.k1, self.b,
+                                                 after_score)
+            vals, ids = np.asarray(vals), np.asarray(ids)
+            if track_total_hits:
+                total += int(seg_total)
+            keep = vals > -np.inf
+            if not keep.any():
+                continue
+            per_segment.append((seg_idx, vals[keep], ids[keep]))
+        if not per_segment:
+            return QueryResult([], total, None, None)
+        all_keys = np.concatenate([v for _, v, _ in per_segment])
+        all_segs = np.concatenate(
+            [np.full(len(i), s, np.int32) for s, _, i in per_segment])
+        all_ids = np.concatenate([i for _, _, i in per_segment])
+        order = np.lexsort((all_ids, all_segs, -all_keys))[:k]
+        docs = [DocAddress(int(all_segs[i]), int(all_ids[i]),
+                           float(all_keys[i]), (),
+                           sort_key=float(all_keys[i]))
+                for i in order]
+        max_score = float(all_keys[order[0]]) if len(order) else None
+        return QueryResult(docs, total, max_score, None)
 
     # ---------------------------------------------------------- rescore
     def rescore(self, docs: List[DocAddress],

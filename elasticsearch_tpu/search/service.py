@@ -582,7 +582,12 @@ class SearchService:
                 query, query_k, post_filter=post_filter, min_score=min_score,
                 sort=sort, search_after=search_after,
                 track_total_hits=bool(track_total) and not continuing,
-                after_key=after_key, collect_masks=collect_masks)
+                after_key=after_key, collect_masks=collect_masks,
+                # scroll pages must stay on ONE executor: plan-path and
+                # dense-path float32 sums differ in the last bits, so a
+                # cursor taken from one would re-emit/skip boundary docs
+                # when continued on the other
+                allow_plan=scroll_ctx is None)
             if terminate_after:
                 # the shard "stops collecting" after terminate_after docs
                 result.docs[:] = result.docs[: int(terminate_after)]
