@@ -99,19 +99,20 @@ def _segmax(x: jax.Array, is_start: jax.Array) -> jax.Array:
     return out
 
 
-@partial(jax.jit, static_argnames=("k", "combine", "k1", "b", "with_dense",
-                                   "with_after"))
-def _plan_topk_impl(streams: Tuple[FieldStream, ...],
-                    group_kind: jax.Array,    # int32 [G]
-                    group_req: jax.Array,     # int32 [G]
-                    group_const: jax.Array,   # float32 [G]; NaN = sum contribs
-                    live: jax.Array,          # bool [ND]
-                    dense_mask: jax.Array,    # bool [ND] (all-true if unused)
-                    n_must: jax.Array, n_filter: jax.Array, msm: jax.Array,
-                    bonus: jax.Array, tie: jax.Array,
-                    after_score: jax.Array,   # float32; _score search_after
-                    k1: float, b: float, k: int, combine: str,
-                    with_dense: bool, with_after: bool = False):
+def plan_topk_body(streams: Tuple[FieldStream, ...],
+                   group_kind: jax.Array,    # int32 [G]
+                   group_req: jax.Array,     # int32 [G]
+                   group_const: jax.Array,   # float32 [G]; NaN = sum contribs
+                   live: jax.Array,          # bool [ND]
+                   dense_mask: jax.Array,    # bool [ND] (all-true if unused)
+                   n_must: jax.Array, n_filter: jax.Array, msm: jax.Array,
+                   bonus: jax.Array, tie: jax.Array,
+                   after_score: jax.Array,   # float32; _score search_after
+                   k1: float, b: float, k: int, combine: str,
+                   with_dense: bool, with_after: bool = False):
+    """The kernel body, un-jitted: also called from inside shard_map
+    (parallel/mesh_executor.py) where the surrounding SPMD program owns
+    the jit."""
     parts_d, parts_tf, parts_c, parts_g, parts_s = [], [], [], [], []
     for st in streams:
         d = jnp.take(st.block_docids, st.sel_blocks, axis=0)    # [NB, B]
@@ -207,6 +208,11 @@ def _plan_topk_impl(streams: Tuple[FieldStream, ...],
     ids = jnp.where(vals > -jnp.inf, ids, _SENTINEL)
     total = jnp.sum(passed.astype(jnp.int32))
     return vals, ids, total
+
+
+_plan_topk_impl = partial(
+    jax.jit, static_argnames=("k", "combine", "k1", "b", "with_dense",
+                              "with_after"))(plan_topk_body)
 
 
 def plan_topk(streams, group_kind, group_req, group_const, live,

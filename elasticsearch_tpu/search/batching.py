@@ -1,0 +1,172 @@
+"""Continuous batching of plan-path query launches.
+
+SURVEY.md §7 hard part 5: per-launch overhead (pathological under the
+axon tunnel's post-readback ~100ms mode, real on any runtime) must
+amortize over many queries. The reference's answer is a thread pool
+(`search` pool, ThreadPool.java:117-181 — thread-per-shard-request);
+the TPU-native answer is **batched launches**: concurrent requests with
+the same kernel shape coalesce into one vmapped execution
+(ops/plan.py plan_topk_batch) and share a single device round-trip.
+
+Leader/follower protocol (no background threads, no idle latency tax):
+the first request to arrive for a shape becomes the leader; while the
+leader's launch is in flight, later arrivals queue; whoever arrives
+first after the pop leads the next batch and takes the whole queue with
+it. Under load the batch size self-tunes to the launch latency —
+classic continuous batching; when idle, a single query runs alone with
+zero added wait.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.ops import plan as plan_ops
+from elasticsearch_tpu.search.plan import BoundPlan, execute_bound
+
+_Q_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+
+def _q_bucket(n: int) -> int:
+    for b in _Q_BUCKETS:
+        if n <= b:
+            return b
+    return _Q_BUCKETS[-1]
+
+
+class _Entry:
+    __slots__ = ("bp", "event", "result", "error")
+
+    def __init__(self, bp: BoundPlan):
+        self.bp = bp
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
+class PlanBatcher:
+    """Shape-bucketed batcher for fused plan launches.
+
+    Eligible: no dense mask, no search_after cursor (those run singly —
+    the benchmark-class match/bool-of-term-filters plans are all
+    eligible). Batches are keyed by (segment identity, stream shapes,
+    group-table size, k, combine, k1, b) so stacked launches are
+    homogeneous; Q pads to a power-of-two bucket to bound compile count.
+    """
+
+    def __init__(self, max_batch: int = 32):
+        self.max_batch = max_batch
+        self._lock = threading.Lock()
+        # launches serialize here; while one is in flight, followers (and
+        # the next leader) accumulate — this blocking IS the batching
+        # window, self-tuned to the launch latency
+        self._launch_lock = threading.Lock()
+        self._pending: Dict[tuple, List[_Entry]] = {}
+        self.launches = 0          # stats: total device launches
+        self.batched_queries = 0   # stats: queries served via batches
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _eligible(bp: BoundPlan, after_score) -> bool:
+        return (bp.dense_mask is None and after_score is None
+                and not bp.empty)
+
+    @staticmethod
+    def _signature(bp: BoundPlan, ctx, k: int, k1: float, b: float) -> tuple:
+        return (
+            ctx.segment.name, ctx.segment.live_version,
+            tuple((id(st.block_docids), int(st.sel_blocks.shape[0]))
+                  for st in bp.streams),
+            int(bp.group_kind.shape[0]), bp.combine, k,
+            round(k1, 6), round(b, 6),
+        )
+
+    # ------------------------------------------------------------------
+    def execute(self, bp: BoundPlan, ctx, k: int, k1: float, b: float,
+                after_score: Optional[float] = None):
+        if not self._eligible(bp, after_score):
+            return execute_bound(bp, ctx, k, k1, b, after_score)
+        sig = self._signature(bp, ctx, k, k1, b)
+        entry = _Entry(bp)
+        with self._lock:
+            q = self._pending.setdefault(sig, [])
+            q.append(entry)
+            leader = len(q) == 1
+        if not leader:
+            entry.event.wait()
+            if entry.error is not None:
+                raise entry.error
+            return entry.result
+        # leader: wait for the in-flight launch (cohort grows meanwhile),
+        # then take the whole queue. Non-leader entries are always popped
+        # by a leader that appended before them, so nothing is orphaned.
+        with self._launch_lock:
+            with self._lock:
+                batch = self._pending.pop(sig, [])
+            if not batch:
+                batch = [entry]
+            try:
+                for start in range(0, len(batch), self.max_batch):
+                    chunk = batch[start:start + self.max_batch]
+                    self._run(chunk, ctx, k, k1, b)
+            except BaseException as exc:
+                for e in batch:
+                    if not e.event.is_set():
+                        e.error = exc
+                        e.event.set()
+                raise
+        if entry.error is not None:
+            raise entry.error
+        return entry.result
+
+    # ------------------------------------------------------------------
+    def _run(self, batch: List[_Entry], ctx, k: int, k1: float, b: float):
+        qn = len(batch)
+        bucket = _q_bucket(qn)
+        pad = bucket - qn
+        bps = [e.bp for e in batch] + [batch[0].bp] * pad
+
+        proto = bps[0]
+        streams = []
+        for si, st in enumerate(proto.streams):
+            streams.append(plan_ops.FieldStream(
+                st.block_docids, st.block_tfs, st.doc_lens, st.avg_len,
+                jnp.stack([bp.streams[si].sel_blocks for bp in bps]),
+                jnp.stack([bp.streams[si].sel_group for bp in bps]),
+                jnp.stack([bp.streams[si].sel_sub for bp in bps]),
+                jnp.stack([bp.streams[si].sel_weight for bp in bps]),
+                jnp.stack([bp.streams[si].sel_const for bp in bps])))
+        gk = np.stack([bp.group_kind for bp in bps])
+        gr = np.stack([bp.group_req for bp in bps])
+        gc = np.stack([bp.group_const for bp in bps])
+        nm = np.asarray([bp.n_must for bp in bps], np.int32)
+        nf = np.asarray([bp.n_filter for bp in bps], np.int32)
+        ms = np.asarray([bp.msm for bp in bps], np.int32)
+        bo = np.asarray([bp.bonus for bp in bps], np.float32)
+        ti = np.asarray([bp.tie for bp in bps], np.float32)
+
+        vals, ids, totals = plan_ops.plan_topk_batch(
+            streams, gk, gr, gc, ctx.live, nm, nf, ms, bo, ti,
+            k1=k1, b=b, k=k, combine=proto.combine)
+        # ONE readback for the whole batch
+        vals = np.asarray(vals)
+        ids = np.asarray(ids)
+        totals = np.asarray(totals)
+        self.launches += 1
+        self.batched_queries += qn
+        for i, e in enumerate(batch):
+            e.result = (vals[i], ids[i], int(totals[i]))
+            e.event.set()
+
+    # ------------------------------------------------------------------
+    def stats(self) -> Dict[str, float]:
+        return {
+            "launches": self.launches,
+            "batched_queries": self.batched_queries,
+            "avg_batch": (self.batched_queries / self.launches
+                          if self.launches else 0.0),
+        }

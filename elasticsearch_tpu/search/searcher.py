@@ -71,6 +71,8 @@ class ShardSearcher:
         self.stats = ShardStats(segments)
         self.k1 = k1
         self.b = b
+        # set by SearchService: continuous batching of plan launches
+        self.batcher = None
 
     def _contexts(self) -> List[SegmentContext]:
         return [SegmentContext(seg, self.cache.get(seg), self.mapper,
@@ -227,8 +229,12 @@ class ShardSearcher:
             if ctx.segment.n_docs == 0 or not query.can_match(ctx):
                 continue
             bp = bind_plan(plan, ctx)
-            vals, ids, seg_total = execute_bound(bp, ctx, k, self.k1, self.b,
-                                                 after_score)
+            if self.batcher is not None:
+                vals, ids, seg_total = self.batcher.execute(
+                    bp, ctx, k, self.k1, self.b, after_score)
+            else:
+                vals, ids, seg_total = execute_bound(
+                    bp, ctx, k, self.k1, self.b, after_score)
             vals, ids = np.asarray(vals), np.asarray(ids)
             if track_total_hits:
                 total += int(seg_total)

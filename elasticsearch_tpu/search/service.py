@@ -165,6 +165,19 @@ class SearchService:
         self._request_cache: "OrderedDict[tuple, Dict[str, Any]]" = (
             OrderedDict())
         self.request_cache_stats = {"hit_count": 0, "miss_count": 0}
+        # continuous batching of plan-path launches: concurrent requests
+        # with the same kernel shape share one vmapped device launch
+        # (SURVEY.md §7 hard part 5; search/batching.py)
+        from elasticsearch_tpu.search.batching import PlanBatcher
+        self.plan_batcher = PlanBatcher()
+        # mesh-sharded execution: multi-shard indices with enough devices
+        # run one SPMD fan-out/merge program instead of the per-shard loop
+        # (ref: TransportSearchAction scatter-gather → shard_map +
+        # all_gather; parallel/mesh_executor.py)
+        from elasticsearch_tpu.parallel.mesh_executor import (
+            MeshSearchExecutor,
+        )
+        self.mesh_executor = MeshSearchExecutor()
 
     # --------------------------------------------------------------- PIT
     def open_pit(self, index_expression: str, keep_alive: str) -> str:
@@ -564,13 +577,32 @@ class SearchService:
             # over-collect so enough distinct groups survive the collapse
             query_k = max(query_k, k * 5)
 
+        # ---- mesh fast path: a multi-shard single-index query with no
+        # aggs/sort/rescore runs as ONE shard_map program over the device
+        # mesh — fan-out and merge in a single launch (mesh_executor.py)
+        mesh_docs = None
+        mesh_total = 0
+        if (scroll_ctx is None and not continuing and post_filter is None
+                and sort is None and min_score is None
+                and search_after is None and not aggs_spec
+                and not rescore_spec and not collapse_field and not profile
+                and terminate_after is None and slice_spec is None
+                and len(searchers) > 1
+                and len({n for n, _ in searchers}) == 1):
+            mr = self.mesh_executor.execute(
+                searchers[0][0], [s for _, s in searchers], query, k)
+            if mr is not None:
+                mesh_docs, mesh_total = mr
+
         # ---- query phase: fan out over shards (ref:
         # AbstractSearchAsyncAction.run / SearchPhaseController merge)
         shard_results: List[Tuple[str, ShardSearcher, QueryResult]] = []
         profile_shards: List[Dict[str, Any]] = []
         total = 0
         max_score = None
-        for shard_idx, (index_name, searcher) in enumerate(searchers):
+        for shard_idx, (index_name, searcher) in enumerate(
+                [] if mesh_docs is not None else searchers):
+            searcher.batcher = self.plan_batcher
             if task is not None:
                 # cooperative cancellation between shard executions (ref:
                 # CancellableTask checks in ContextIndexSearcher)
@@ -636,6 +668,18 @@ class SearchService:
         else:
             merged.sort(key=lambda e: (-e[0], e[1], e[2].segment_idx,
                                        e[2].docid))
+
+        if mesh_docs is not None:
+            # already merged on-device (all_gather + re-top-k); shards
+            # hold exactly one segment on this path
+            mesh_index = searchers[0][0]
+            merged = [
+                (score, shard_idx,
+                 DocAddress(0, docid, score, (), sort_key=score),
+                 mesh_index, searchers[shard_idx][1])
+                for shard_idx, docid, score in mesh_docs]
+            total = mesh_total if track_total else 0
+            max_score = merged[0][0] if merged else None
 
         # ---- field collapsing (ref: collapse/CollapseBuilder + coordinator
         # keeping the best hit per group): first hit per key wins; docs
