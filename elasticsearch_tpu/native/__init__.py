@@ -99,6 +99,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
             ctypes.c_int]
+        lib.bm25_maxscore_topk.restype = ctypes.c_int
+        lib.bm25_maxscore_topk.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32)]
         _lib = lib
         return _lib
 
@@ -171,6 +179,49 @@ def count_term_freqs(term_ids: np.ndarray
     if n < 0:
         return None
     return out_terms[:n].copy(), out_tfs[:n].copy()
+
+
+def maxscore_topk(docids: np.ndarray, sat: np.ndarray,
+                  block_max: np.ndarray,
+                  post_off: np.ndarray, post_len: np.ndarray,
+                  blk_off: np.ndarray, blk_len: np.ndarray,
+                  idfs: np.ndarray, k: int
+                  ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Block-max MaxScore DAAT top-k (the C++ CPU baseline scorer; see
+    estpu_native.cpp). Arrays reference the corpus block layout: per query
+    term i, postings live at docids[post_off[i]:post_off[i]+post_len[i]]
+    (ascending), ``sat`` holds tf/(tf+norm) per posting, ``block_max`` the
+    per-128-block max sat. Returns (scores, docs) sorted (score desc,
+    docid asc), or None without the native library."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    docids = np.ascontiguousarray(docids, np.int32)
+    sat = np.ascontiguousarray(sat, np.float32)
+    block_max = np.ascontiguousarray(block_max, np.float32)
+    post_off = np.ascontiguousarray(post_off, np.int64)
+    post_len = np.ascontiguousarray(post_len, np.int64)
+    blk_off = np.ascontiguousarray(blk_off, np.int64)
+    blk_len = np.ascontiguousarray(blk_len, np.int64)
+    idfs = np.ascontiguousarray(idfs, np.float32)
+    n_terms = len(idfs)
+    out_scores = np.empty(k, np.float32)
+    out_docs = np.empty(k, np.int32)
+    p = ctypes.POINTER
+    n = lib.bm25_maxscore_topk(
+        docids.ctypes.data_as(p(ctypes.c_int32)),
+        sat.ctypes.data_as(p(ctypes.c_float)),
+        block_max.ctypes.data_as(p(ctypes.c_float)),
+        post_off.ctypes.data_as(p(ctypes.c_int64)),
+        post_len.ctypes.data_as(p(ctypes.c_int64)),
+        blk_off.ctypes.data_as(p(ctypes.c_int64)),
+        blk_len.ctypes.data_as(p(ctypes.c_int64)),
+        idfs.ctypes.data_as(p(ctypes.c_float)), n_terms, int(k),
+        out_scores.ctypes.data_as(p(ctypes.c_float)),
+        out_docs.ctypes.data_as(p(ctypes.c_int32)))
+    if n < 0:
+        return None
+    return out_scores[:n].copy(), out_docs[:n].copy()
 
 
 def murmur3_hash(key: str) -> Optional[int]:

@@ -159,3 +159,179 @@ int32_t murmur3_hash_utf16le(const uint8_t* data, int len) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Block-max MaxScore top-k disjunction (DAAT) — the CPU baseline scorer.
+//
+// The Lucene-class skipping baseline the TPU kernels are benchmarked
+// against (ref: Lucene 8.x top-k disjunctions skip non-competitive docs
+// via WAND/MaxScore with block-max impacts; TopDocsCollectorContext's
+// totalHitsThreshold enables it). Terms are split into essential /
+// non-essential by max impact vs the running k-th score; candidates come
+// from essential postings only; non-essential contributions resolve by
+// galloping search with early exit on the remaining block-max bound.
+// Per-term bounds tighten as cursors advance using a suffix-max over
+// 128-posting block maxima (computed at query init).
+//
+// Inputs reference the corpus block layout directly: per term i,
+// postings are docids[post_off[i] .. post_off[i]+post_len[i]) ascending,
+// sat[] = tf/(tf + k1(1-b+b·dl/avg)) per posting (impact = idf·sat),
+// block_max[blk_off[i] .. blk_off[i]+blk_len[i]) = per-block max sat.
+// Outputs (score desc, docid asc) into out_scores/out_docs; returns the
+// hit count written (<= k).
+// ---------------------------------------------------------------------------
+
+#include <algorithm>
+#include <vector>
+
+extern "C" int bm25_maxscore_topk(
+    const int32_t* docids, const float* sat, const float* block_max,
+    const int64_t* post_off, const int64_t* post_len,
+    const int64_t* blk_off, const int64_t* blk_len,
+    const float* idf, int n_terms, int k,
+    float* out_scores, int32_t* out_docs) {
+  struct Term {
+    const int32_t* d;
+    const float* s;
+    int64_t n;
+    int64_t pos;
+    float w;                   // idf
+    std::vector<float> sufmax; // suffix max of block_max * w
+  };
+  std::vector<Term> terms(n_terms);
+  for (int i = 0; i < n_terms; ++i) {
+    Term& t = terms[i];
+    t.d = docids + post_off[i];
+    t.s = sat + post_off[i];
+    t.n = post_len[i];
+    t.pos = 0;
+    t.w = idf[i];
+    t.sufmax.resize(blk_len[i] + 1, 0.0f);
+    for (int64_t b = blk_len[i] - 1; b >= 0; --b)
+      t.sufmax[b] = std::max(t.sufmax[b + 1],
+                             block_max[blk_off[i] + b] * t.w);
+  }
+  // current upper bound of a term given its cursor (block-max suffix)
+  auto cur_max = [](const Term& t) -> float {
+    if (t.pos >= t.n) return 0.0f;
+    return t.sufmax[t.pos >> 7];   // 128-posting blocks
+  };
+  // sort ascending by current max impact
+  std::vector<int> order(n_terms);
+  for (int i = 0; i < n_terms; ++i) order[i] = i;
+  std::sort(order.begin(), order.end(), [&](int a, int b) {
+    return cur_max(terms[a]) < cur_max(terms[b]);
+  });
+
+  struct Hit {
+    float score;
+    int32_t doc;
+  };
+  // min-heap whose top is the WORST kept hit: lower score first, then
+  // LARGER docid first (so a tie is lost by the later doc, matching the
+  // (-score, docid) result order)
+  auto worse = [](const Hit& a, const Hit& b) {
+    if (a.score != b.score) return a.score > b.score;
+    return a.doc < b.doc;
+  };
+  std::vector<Hit> heap;
+  heap.reserve(k);
+  float theta = -1.0f;  // any positive score beats an empty heap
+
+  int ne = 0;  // terms[order[0..ne)] are non-essential
+  auto recompute_split = [&]() {
+    float prefix = 0.0f;
+    ne = 0;
+    for (int j = 0; j < n_terms; ++j) {
+      float nm = cur_max(terms[order[j]]);
+      if (heap.size() == (size_t)k && prefix + nm <= theta) {
+        prefix += nm;
+        ne = j + 1;
+      } else {
+        break;
+      }
+    }
+  };
+
+  auto gallop_to = [](Term& t, int32_t target) {
+    // advance t.pos to the first posting >= target (cursor monotonic)
+    int64_t lo = t.pos, step = 1;
+    while (lo + step < t.n && t.d[lo + step] < target) {
+      lo += step;
+      step <<= 1;
+    }
+    int64_t hi = std::min(t.n, lo + step + 1);
+    while (lo < hi && t.d[lo] < target) {
+      // binary search within [lo, hi)
+      int64_t mid = lo + (hi - lo) / 2;
+      if (t.d[mid] < target) lo = mid + 1; else hi = mid;
+    }
+    t.pos = lo;
+  };
+
+  while (true) {
+    if (ne >= n_terms) break;  // total bound <= theta: done
+    // candidate: min current docid over essential terms
+    int32_t cand = INT32_MAX;
+    for (int j = ne; j < n_terms; ++j) {
+      const Term& t = terms[order[j]];
+      if (t.pos < t.n) cand = std::min(cand, t.d[t.pos]);
+    }
+    if (cand == INT32_MAX) break;
+    float score = 0.0f;
+    for (int j = ne; j < n_terms; ++j) {
+      Term& t = terms[order[j]];
+      if (t.pos < t.n && t.d[t.pos] == cand) {
+        score += t.w * t.s[t.pos];
+        t.pos++;
+      }
+    }
+    // fold in non-essential terms, highest bound first, early exit
+    float rest = 0.0f;
+    for (int j = 0; j < ne; ++j) rest += cur_max(terms[order[j]]);
+    bool competitive = heap.size() < (size_t)k || score + rest > theta;
+    if (competitive) {
+      for (int j = ne - 1; j >= 0; --j) {
+        Term& t = terms[order[j]];
+        rest -= cur_max(t);
+        gallop_to(t, cand);
+        if (t.pos < t.n && t.d[t.pos] == cand) {
+          score += t.w * t.s[t.pos];
+        }
+        if (heap.size() == (size_t)k && score + rest <= theta) {
+          competitive = false;
+          break;
+        }
+      }
+    }
+    if (competitive && score > 0.0f &&
+        (heap.size() < (size_t)k || score > theta)) {
+      Hit h{score, cand};
+      if (heap.size() < (size_t)k) {
+        heap.push_back(h);
+        std::push_heap(heap.begin(), heap.end(), worse);
+        if (heap.size() == (size_t)k) {
+          theta = heap.front().score;
+          recompute_split();
+        }
+      } else {
+        std::pop_heap(heap.begin(), heap.end(), worse);
+        heap.back() = h;
+        std::push_heap(heap.begin(), heap.end(), worse);
+        theta = heap.front().score;
+        recompute_split();
+      }
+    }
+  }
+  // emit (score desc, docid asc)
+  std::sort(heap.begin(), heap.end(), [](const Hit& a, const Hit& b) {
+    if (a.score != b.score) return a.score > b.score;
+    return a.doc < b.doc;
+  });
+  int n = (int)heap.size();
+  for (int i = 0; i < n; ++i) {
+    out_scores[i] = heap[i].score;
+    out_docs[i] = heap[i].doc;
+  }
+  return n;
+}

@@ -238,7 +238,7 @@ def _sharded_plan_step(streams, group_kind, group_req, group_const, bonus,
                 P("shard"), P("shard"), P("shard"), P("shard"), P("shard"))
 
     @partial(jax.shard_map, mesh=mesh, check_vma=False,
-             in_specs=in_specs, out_specs=(P(), P(), P()))
+             in_specs=in_specs, out_specs=P())
     def step(sts, gk, gr, gc, bo, lv):
         local = tuple(
             plan_ops.FieldStream(st.block_docids[0], st.block_tfs[0],
@@ -261,7 +261,8 @@ def _sharded_plan_step(streams, group_kind, group_req, group_const, bonus,
         tv, ti = jax.lax.top_k(av.reshape(-1), k)
         tg = jnp.take(ag.reshape(-1), ti)
         tg = jnp.where(tv > -jnp.inf, tg, plan_ops._SENTINEL)
-        return tv, tg, jax.lax.psum(total, "shard")
+        # pack → one readback for the whole mesh query
+        return plan_ops.pack_result(tv, tg, jax.lax.psum(total, "shard"))
 
     return step(tuple(streams), group_kind, group_req, group_const,
                 bonus, live)
@@ -332,14 +333,14 @@ class MeshSearchExecutor:
             return [], 0   # no query term exists in any shard
         streams, gk, gr, gc, bo = bound
         p0 = plans[0]
-        vals, gids, total = _sharded_plan_step(
+        packed = _sharded_plan_step(
             streams, gk, gr, gc, bo, corpus.live, corpus.mesh,
             corpus.n_docs_padded, p0.n_must, p0.n_filter, p0.msm,
             float(p0.tie), float(searchers[0].k1), float(searchers[0].b),
             int(k), p0.combine)
         self.mesh_searches += 1
-        vals = np.asarray(vals)
-        gids = np.asarray(gids)
+        vals, gids, total = plan_ops.unpack_result(np.asarray(packed),
+                                                   int(k))
         nd = corpus.n_docs_padded
         docs = [(int(g) // nd, int(g) % nd, float(v))
                 for v, g in zip(vals, gids) if v > -np.inf]

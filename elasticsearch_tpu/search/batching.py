@@ -149,17 +149,15 @@ class PlanBatcher:
         bo = np.asarray([bp.bonus for bp in bps], np.float32)
         ti = np.asarray([bp.tie for bp in bps], np.float32)
 
-        vals, ids, totals = plan_ops.plan_topk_batch(
+        packed = plan_ops.plan_topk_batch(
             streams, gk, gr, gc, ctx.live, nm, nf, ms, bo, ti,
             k1=k1, b=b, k=k, combine=proto.combine)
-        # ONE readback for the whole batch
-        vals = np.asarray(vals)
-        ids = np.asarray(ids)
-        totals = np.asarray(totals)
+        # ONE readback for the whole batch (rows are packed buffers)
+        rows = np.asarray(packed)
         self.launches += 1
         self.batched_queries += qn
         for i, e in enumerate(batch):
-            e.result = (vals[i], ids[i], int(totals[i]))
+            e.result = plan_ops.unpack_result(rows[i], k)
             e.event.set()
 
     # ------------------------------------------------------------------

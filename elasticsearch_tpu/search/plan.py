@@ -48,6 +48,11 @@ from elasticsearch_tpu.search import queries as q
 NAN = float("nan")
 _NEVER = 1 << 30  # requirement no group can meet (pad groups)
 
+# Floor for the selected-block bucket (powers of two above it). Serving
+# deployments raise it to collapse the distinct compiled shapes — each
+# (bucket, k) pair is one XLA compile (~20-40s on TPU first time).
+MIN_PLAN_BUCKET = 0
+
 
 @dataclass
 class TermEntry:
@@ -392,40 +397,50 @@ def bind_plan(plan: LogicalPlan, ctx) -> BoundPlan:
         dp = ctx.device.postings.get(fname)
         if dp is None:
             continue
-        ids: List[int] = []
-        grps: List[int] = []
-        subs: List[int] = []
-        ws: List[float] = []
-        consts: List[bool] = []
+        starts: List[int] = []
+        counts: List[int] = []
+        egrp: List[int] = []
+        esub: List[int] = []
+        ew: List[float] = []
+        econst: List[bool] = []
         for gi, sub, w, const, term in entries:
             tid = dp.host.term_id(term)
             if tid < 0:
                 continue
-            start = int(dp.term_block_start[tid])
-            count = int(dp.term_block_count[tid])
-            ids.extend(range(start, start + count))
-            grps.extend([gi] * count)
-            subs.extend([sub] * count)
-            ws.extend([w] * count)
-            consts.extend([const] * count)
-        if not ids:
+            starts.append(int(dp.term_block_start[tid]))
+            counts.append(int(dp.term_block_count[tid]))
+            egrp.append(gi)
+            esub.append(sub)
+            ew.append(w)
+            econst.append(const)
+        if not starts:
+            continue
+        # vectorized range expansion (per-request host path: no Python
+        # per-block loops)
+        counts_np = np.asarray(counts, np.int64)
+        tot = int(counts_np.sum())
+        if tot == 0:
             continue
         any_entries = True
-        n = block_bucket(len(ids))
-        pad = n - len(ids)
-        ids.extend([dp.zero_block] * pad)
-        grps.extend([ngroups] * pad)   # clipped in-kernel; tf=0 ⇒ inert
-        subs.extend([0] * pad)
-        ws.extend([0.0] * pad)
-        consts.extend([False] * pad)
+        rep = np.repeat(np.arange(len(starts)), counts_np)
+        offs = (np.arange(tot, dtype=np.int64)
+                - np.repeat(np.cumsum(counts_np) - counts_np, counts_np))
+        n = max(block_bucket(tot), MIN_PLAN_BUCKET)
+        sel = np.full(n, dp.zero_block, np.int32)
+        sel[:tot] = np.asarray(starts, np.int64)[rep] + offs
+        grp = np.full(n, ngroups, np.int32)   # pads: clipped; tf=0 ⇒ inert
+        grp[:tot] = np.asarray(egrp, np.int32)[rep]
+        sub_a = np.zeros(n, np.int32)
+        sub_a[:tot] = np.asarray(esub, np.int32)[rep]
+        w_a = np.zeros(n, np.float32)
+        w_a[:tot] = np.asarray(ew, np.float32)[rep]
+        c_a = np.zeros(n, bool)
+        c_a[:tot] = np.asarray(econst, bool)[rep]
         streams.append(plan_ops.FieldStream(
             dp.block_docids, dp.block_tfs, dp.doc_lens,
             jnp.float32(ctx.stats.field_stats(fname)[1]),
-            jnp.asarray(np.asarray(ids, np.int32)),
-            jnp.asarray(np.asarray(grps, np.int32)),
-            jnp.asarray(np.asarray(subs, np.int32)),
-            jnp.asarray(np.asarray(ws, np.float32)),
-            jnp.asarray(np.asarray(consts, bool))))
+            jnp.asarray(sel), jnp.asarray(grp), jnp.asarray(sub_a),
+            jnp.asarray(w_a), jnp.asarray(c_a)))
 
     gpad = max(4, block_bucket(max(1, ngroups)) if ngroups else 4)
     kind = np.full(gpad, plan_ops.FILTER, np.int32)
@@ -451,13 +466,16 @@ def bind_plan(plan: LogicalPlan, ctx) -> BoundPlan:
 
 def execute_bound(bp: BoundPlan, ctx, k: int, k1: float, b: float,
                   after_score: Optional[float] = None):
-    """Launch the fused kernel for one segment → (vals[k], ids[k], total)
-    device arrays (empty-bind shortcut returns host zeros)."""
+    """Launch the fused kernel for one segment → host (vals[k], ids[k],
+    total). The device result is PACKED into one buffer so the whole
+    query costs exactly one device→host readback (ops/plan.pack_result —
+    a 3× latency lever under the axon tunnel's degraded-readback mode)."""
     if bp.empty:
         return (np.full(k, -np.inf, np.float32),
                 np.full(k, plan_ops._SENTINEL, np.int32), 0)
-    return plan_ops.plan_topk(
+    packed = plan_ops.plan_topk(
         bp.streams, bp.group_kind, bp.group_req, bp.group_const,
         ctx.live, bp.dense_mask, bp.n_must, bp.n_filter, bp.msm,
         bonus=bp.bonus, tie=bp.tie, k1=k1, b=b, k=k, combine=bp.combine,
-        after_score=after_score)
+        after_score=after_score, packed=True)
+    return plan_ops.unpack_result(np.asarray(packed), k)

@@ -122,3 +122,69 @@ def test_routing_hash_matches_spec():
                                   k=rng.randrange(0, 50)))
         assert murmur3_hash(key) == _py_murmur3(key), repr(key)
     assert murmur3_hash("") == 0
+
+
+def test_maxscore_topk_matches_exact():
+    """The C++ block-max MaxScore scorer returns the exact top-k a dense
+    scorer computes (it prunes non-competitive docs, never competitive
+    ones)."""
+    import numpy as np
+
+    from elasticsearch_tpu import native
+
+    if not native.available():
+        import pytest
+        pytest.skip("native library unavailable")
+
+    rng = np.random.default_rng(17)
+    n_docs = 5000
+    k1, b = 1.2, 0.75
+    lens = rng.integers(5, 80, size=n_docs).astype(np.float32)
+    avg = float(lens.mean())
+    norm = k1 * (1.0 - b + b * lens / avg)
+
+    for trial in range(10):
+        n_terms = int(rng.integers(1, 7))
+        docs_l, sat_l, post_off, post_len = [], [], [], []
+        blk_off, blk_len, idfs = [], [], []
+        bmax_l = []
+        exact = np.zeros(n_docs, np.float64)
+        off = 0
+        boff = 0
+        for _ in range(n_terms):
+            df = int(rng.integers(1, n_docs // 2))
+            d = np.sort(rng.choice(n_docs, size=df, replace=False)).astype(np.int32)
+            tf = rng.integers(1, 6, size=df).astype(np.float32)
+            s = tf / (tf + norm[d])
+            w = float(np.log(1 + (n_docs - df + 0.5) / (df + 0.5)))
+            exact[d] += w * s
+            # pad postings to 128-blocks (corpus layout)
+            nb = (df + 127) // 128
+            pd = np.zeros(nb * 128, np.int32)
+            ps = np.zeros(nb * 128, np.float32)
+            pd[:df] = d
+            ps[:df] = s
+            docs_l.append(pd)
+            sat_l.append(ps)
+            bmax_l.append(ps.reshape(nb, 128).max(axis=1))
+            post_off.append(off)
+            post_len.append(df)
+            blk_off.append(boff)
+            blk_len.append(nb)
+            idfs.append(w)
+            off += nb * 128
+            boff += nb
+        k = int(rng.integers(1, 50))
+        res = native.maxscore_topk(
+            np.concatenate(docs_l), np.concatenate(sat_l),
+            np.concatenate(bmax_l), np.asarray(post_off),
+            np.asarray(post_len), np.asarray(blk_off),
+            np.asarray(blk_len), np.asarray(idfs, np.float32), k)
+        assert res is not None
+        scores, docs = res
+        matched = np.nonzero(exact > 0)[0]
+        order = matched[np.lexsort((matched, -exact[matched]))][:k]
+        assert len(docs) == min(k, len(order))
+        np.testing.assert_array_equal(docs, order.astype(np.int32))
+        np.testing.assert_allclose(scores, exact[order].astype(np.float32),
+                                   rtol=2e-5, atol=1e-6)
