@@ -17,6 +17,7 @@ SURVEY.md §7 "hard parts" #2):
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from functools import partial
 from typing import Dict, Optional, Tuple
 
@@ -30,6 +31,11 @@ from elasticsearch_tpu.ops.vector import prepare_vectors
 DOC_PAD = 1024
 MIN_BLOCK_BUCKET = 8
 
+# Filter-mask cache knobs (per DeviceSegment). Each entry is one bool
+# column: n_docs_padded bytes on device + the same on host (the host copy
+# validates block-max pruning thresholds without a device readback).
+FILTER_MASK_CACHE_MAX = 64
+
 
 def round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
@@ -41,6 +47,28 @@ def block_bucket(n: int) -> int:
     while b < n:
         b *= 2
     return b
+
+
+def host_any_mask(pf, terms, nd: int) -> np.ndarray:
+    """Host-side any-of term-presence mask over ``nd`` docs — the single
+    implementation behind both the cached device filter masks
+    (DeviceSegment.filter_mask) and the plan compiler's CPU-side
+    threshold validation (search/plan.py)."""
+    mask = np.zeros(nd, bool)
+    rows = []
+    for t in terms:
+        tid = pf.term_id(t)
+        if tid >= 0:
+            s = int(pf.term_block_start[tid])
+            rows.append(np.arange(s, s + int(pf.term_block_count[tid]),
+                                  dtype=np.int64))
+    if rows:
+        rows = np.concatenate(rows)
+        d = pf.block_docids[rows].reshape(-1)
+        tf = pf.block_tfs[rows].reshape(-1)
+        ok = tf > 0.0
+        mask[d[ok][d[ok] < nd]] = True
+    return mask
 
 
 class DevicePostings:
@@ -117,6 +145,15 @@ class DeviceSegment:
         self.name = segment.name
         self.n_docs = segment.n_docs
         self.n_docs_padded = max(DOC_PAD, round_up(segment.n_docs, DOC_PAD))
+        self._device = device
+        # LRU filter-mask cache — the analogue of Lucene's LRUQueryCache
+        # for filter clauses (ref: search/LRUQueryCache.java via
+        # IndicesQueryCache): an any-of terms filter caches as ONE dense
+        # bool column, so its postings never enter the per-query sort.
+        # Keyed by (field, terms); segment immutability (epoch swaps
+        # replace whole DeviceSegments) keeps entries valid for the
+        # segment's lifetime.
+        self._filter_masks: "OrderedDict[tuple, tuple]" = OrderedDict()
         live = np.zeros(self.n_docs_padded, bool)
         live[: segment.n_docs] = segment.live
         self.live = jax.device_put(live, device=device)
@@ -140,6 +177,32 @@ class DeviceSegment:
             miss[: len(nv.missing)] = nv.missing
             self.numerics[f] = put(vals.astype(np.float32))
             self.numeric_missing[f] = put(miss)
+
+    def filter_mask(self, field: str, terms) -> Tuple[jax.Array, np.ndarray]:
+        """Any-of terms-presence mask for ``field``, LRU-cached.
+
+        Returns ``(device_mask, host_mask)`` — bool [n_docs_padded]. Built
+        host-side from the segment's block postings (a pure gather — no
+        device work) and uploaded once; subsequent queries reuse the
+        column. The host copy stays available so the plan compiler can
+        validate pruning thresholds CPU-side (search/plan.py).
+        ref: Lucene LRUQueryCache — cached filters become bitsets that
+        skip per-query scoring entirely."""
+        key = (field, tuple(sorted(set(terms))))
+        hit = self._filter_masks.get(key)
+        if hit is not None:
+            self._filter_masks.move_to_end(key)
+            return hit
+        dp = self.postings.get(field)
+        if dp is not None:
+            mask = host_any_mask(dp.host, key[1], self.n_docs_padded)
+        else:
+            mask = np.zeros(self.n_docs_padded, bool)
+        entry = (jax.device_put(mask, device=self._device), mask)
+        self._filter_masks[key] = entry
+        while len(self._filter_masks) > FILTER_MASK_CACHE_MAX:
+            self._filter_masks.popitem(last=False)
+        return entry
 
     def update_live(self, live: np.ndarray) -> None:
         """Re-upload only the live mask (deletes don't touch postings)."""

@@ -53,6 +53,23 @@ _NEVER = 1 << 30  # requirement no group can meet (pad groups)
 # (bucket, k) pair is one XLA compile (~20-40s on TPU first time).
 MIN_PLAN_BUCKET = 0
 
+# Filter/must_not groups at least this many postings blocks wide execute
+# as cached dense masks (ops/device.py filter_mask — the LRUQueryCache
+# analogue) instead of entering the per-query sort. Smaller filters are
+# cheaper to sort than to cache.
+FILTER_CACHE_MIN_BLOCKS = 8
+
+# Block-max window pruning (ref: Lucene block-max WAND,
+# TopDocsCollectorContext.java:210-217). The docid space splits into
+# PRUNE_WINDOWS windows; a window whose BM25 upper bound (from
+# block_max_tf / block_min_len) cannot reach the k-th best CPU-verified
+# candidate score is dropped, and postings blocks overlapping only
+# dropped windows leave the selection before the power-of-two bucket is
+# chosen — the sort shrinks, recall stays exactly 1.0. Only queries with
+# at least PRUNE_MIN_BLOCKS selected blocks pay the host-side bound pass.
+PRUNE_WINDOWS = 512
+PRUNE_MIN_BLOCKS = 384
+
 
 @dataclass
 class TermEntry:
@@ -378,21 +395,111 @@ class BoundPlan:
     tie: float
     combine: str
     empty: bool = False   # no query term exists in this segment
+    # host copies of cached-filter masks folded into dense_mask, as
+    # (mask, negate) — lets block-max pruning validate its threshold
+    # candidates CPU-side (no readback)
+    host_masks: List[Tuple[np.ndarray, bool]] = dc_field(default_factory=list)
+    # True when block-max pruning dropped blocks: the kernel's matching-doc
+    # count is then a LOWER bound (hits.total relation becomes "gte")
+    pruned: bool = False
 
 
-def bind_plan(plan: LogicalPlan, ctx) -> BoundPlan:
+def _group_field_blocks(g: GroupPlan, ctx) -> Optional[Tuple[str, int]]:
+    """(field, total postings blocks) of a single-field group, else None."""
+    fields = {t.field for t in g.terms}
+    if len(fields) != 1:
+        return None
+    fname = next(iter(fields))
+    dp = ctx.device.postings.get(fname)
+    if dp is None:
+        return fname, 0
+    n = 0
+    for t in g.terms:
+        tid = dp.host.term_id(t.term)
+        if tid >= 0:
+            n += int(dp.term_block_count[tid])
+    return fname, n
+
+
+def _convert_filters(plan: LogicalPlan, ctx):
+    """Split groups into kernel groups vs cached-mask conversions.
+
+    FILTER / MUST_NOT groups with any-of presence semantics (req <= 1)
+    and ≥ FILTER_CACHE_MIN_BLOCKS postings blocks execute as dense cached
+    masks (ops/device.py filter_mask — ref: Lucene LRUQueryCache via
+    UsageTrackingQueryCachingPolicy: hot filters become bitsets), so their
+    postings never enter the query's sort. At least one enumerating
+    postings group must remain — the kernel only sees docs present in the
+    gathered postings.
+
+    Returns (kernel_groups, [(field, terms, negate)], kernel_filter_count).
+    """
+    must_enum = plan.n_must >= 1
+    should_enum = plan.msm >= 1 and any(
+        g.kind == plan_ops.SHOULD for g in plan.groups)
+
+    sized = []
+    for gi, g in enumerate(plan.groups):
+        if g.kind not in (plan_ops.FILTER, plan_ops.MUST_NOT) or g.req > 1:
+            continue
+        fb = _group_field_blocks(g, ctx)
+        if fb is not None and fb[1] >= FILTER_CACHE_MIN_BLOCKS:
+            sized.append((fb[1], gi, g, fb[0]))
+    sized.sort(key=lambda e: -e[0])   # biggest filters convert first
+
+    n_filters_left = plan.n_filter
+    converted: List[Tuple[str, List[str], bool]] = []
+    convert_ids = set()
+    for _, gi, g, fname in sized:
+        if g.kind == plan_ops.MUST_NOT:
+            convert_ids.add(gi)
+            converted.append((fname, [t.term for t in g.terms], True))
+        elif must_enum or should_enum or n_filters_left > 1:
+            convert_ids.add(gi)
+            converted.append((fname, [t.term for t in g.terms], False))
+            n_filters_left -= 1
+    kernel = [g for gi, g in enumerate(plan.groups) if gi not in convert_ids]
+    return kernel, converted, n_filters_left
+
+
+def bind_plan(plan: LogicalPlan, ctx, k: int = 10,
+              allow_prune: bool = False) -> BoundPlan:
     """Resolve terms → block ids against one segment (ctx: SegmentContext).
     Selection arrays bucket to powers of two so NB takes O(log) distinct
-    values across queries (XLA compile-cache discipline, ops/device.py)."""
-    ngroups = len(plan.groups)
+    values across queries (XLA compile-cache discipline, ops/device.py).
+
+    ``allow_prune=True`` (legal when the caller treats hits.total as a
+    lower bound — track_total_hits thresholds) additionally applies
+    block-max window pruning (_prune_fields): docid windows whose BM25
+    upper bound cannot reach a CPU-validated top-k threshold drop out of
+    the selection entirely, shrinking the sorted bucket (ref: Lucene
+    block-max WAND, TopDocsCollectorContext.java:210-217)."""
+    kernel_groups, converted, n_filter = _convert_filters(plan, ctx)
+    ngroups = len(kernel_groups)
     by_field: Dict[str, List[Tuple[int, int, float, bool, str]]] = {}
-    for gi, g in enumerate(plan.groups):
+    for gi, g in enumerate(kernel_groups):
         for t in g.terms:
             by_field.setdefault(t.field, []).append(
                 (gi, t.sub, t.weight, t.const, t.term))
 
-    streams: List[plan_ops.FieldStream] = []
-    any_entries = False
+    # cached dense masks first — their HOST copies also validate the
+    # pruning threshold below
+    dense_mask = None
+    for clause, negate in plan.dense:
+        _, m = clause.do_execute(ctx)
+        m = (~m) if negate else m
+        dense_mask = m if dense_mask is None else (dense_mask & m)
+    host_masks: List[Tuple[np.ndarray, bool]] = []
+    for fname, terms, negate in converted:
+        dev, host = ctx.device.filter_mask(fname, terms)
+        m = (~dev) if negate else dev
+        dense_mask = m if dense_mask is None else (dense_mask & m)
+        host_masks.append((host, negate))
+
+    # ---- unpadded per-field selections (kept separate so pruning can
+    # drop blocks before the power-of-two bucket is chosen)
+    fields: List[Tuple[str, Any, np.ndarray, np.ndarray, np.ndarray,
+                       np.ndarray, np.ndarray]] = []
     for fname, entries in by_field.items():
         dp = ctx.device.postings.get(fname)
         if dp is None:
@@ -421,21 +528,41 @@ def bind_plan(plan: LogicalPlan, ctx) -> BoundPlan:
         tot = int(counts_np.sum())
         if tot == 0:
             continue
-        any_entries = True
         rep = np.repeat(np.arange(len(starts)), counts_np)
         offs = (np.arange(tot, dtype=np.int64)
                 - np.repeat(np.cumsum(counts_np) - counts_np, counts_np))
+        sel = (np.asarray(starts, np.int64)[rep] + offs).astype(np.int32)
+        fields.append((fname, dp,
+                       sel,
+                       np.asarray(egrp, np.int32)[rep],
+                       np.asarray(esub, np.int32)[rep],
+                       np.asarray(ew, np.float32)[rep],
+                       np.asarray(econst, bool)[rep],
+                       rep.astype(np.int32)))
+
+    pruned = False
+    if allow_prune and fields:
+        fields, pruned = _prune_fields(plan, kernel_groups, fields, ctx, k,
+                                       host_masks)
+
+    streams: List[plan_ops.FieldStream] = []
+    any_entries = False
+    for fname, dp, sel_u, grp_u, sub_u, w_u, c_u, _ent in fields:
+        tot = len(sel_u)
+        if tot == 0:
+            continue
+        any_entries = True
         n = max(block_bucket(tot), MIN_PLAN_BUCKET)
         sel = np.full(n, dp.zero_block, np.int32)
-        sel[:tot] = np.asarray(starts, np.int64)[rep] + offs
+        sel[:tot] = sel_u
         grp = np.full(n, ngroups, np.int32)   # pads: clipped; tf=0 ⇒ inert
-        grp[:tot] = np.asarray(egrp, np.int32)[rep]
+        grp[:tot] = grp_u
         sub_a = np.zeros(n, np.int32)
-        sub_a[:tot] = np.asarray(esub, np.int32)[rep]
+        sub_a[:tot] = sub_u
         w_a = np.zeros(n, np.float32)
-        w_a[:tot] = np.asarray(ew, np.float32)[rep]
+        w_a[:tot] = w_u
         c_a = np.zeros(n, bool)
-        c_a[:tot] = np.asarray(econst, bool)[rep]
+        c_a[:tot] = c_u
         streams.append(plan_ops.FieldStream(
             dp.block_docids, dp.block_tfs, dp.doc_lens,
             jnp.float32(ctx.stats.field_stats(fname)[1]),
@@ -446,22 +573,253 @@ def bind_plan(plan: LogicalPlan, ctx) -> BoundPlan:
     kind = np.full(gpad, plan_ops.FILTER, np.int32)
     req = np.full(gpad, _NEVER, np.int32)
     const = np.full(gpad, NAN, np.float32)
-    for gi, g in enumerate(plan.groups):
+    for gi, g in enumerate(kernel_groups):
         kind[gi] = g.kind
         req[gi] = g.req
         const[gi] = g.const_score
     # pad groups: FILTER with unreachable req — never present, and absent
     # FILTER groups don't block (n_filter counts only real groups)
 
-    dense_mask = None
-    for clause, negate in plan.dense:
-        _, m = clause.do_execute(ctx)
-        m = (~m) if negate else m
-        dense_mask = m if dense_mask is None else (dense_mask & m)
-
     return BoundPlan(streams, kind, req, const, dense_mask,
-                     plan.n_must, plan.n_filter, plan.msm, plan.bonus,
-                     plan.tie, plan.combine, empty=not any_entries)
+                     plan.n_must, n_filter, plan.msm, plan.bonus,
+                     plan.tie, plan.combine, empty=not any_entries,
+                     host_masks=host_masks, pruned=pruned)
+
+
+# ---------------------------------------------------------------------------
+# block-max window pruning (host-side bound pass; ref: Lucene block-max
+# WAND / MaxScore — TopDocsCollectorContext.java:210-217)
+# ---------------------------------------------------------------------------
+
+def _block_bounds(dp):
+    """Per-block (first, last) docids, cached on the DevicePostings.
+    Valid postings are a docid-ascending prefix of each block (tf=0 pads
+    sit at the end with docid 0), so the masked max is the last docid."""
+    lo = getattr(dp, "_block_lo", None)
+    if lo is None:
+        pf = dp.host
+        dp._block_lo = pf.block_docids[:, 0].astype(np.int64)
+        dp._block_hi = np.where(pf.block_tfs > 0.0, pf.block_docids,
+                                0).max(axis=1).astype(np.int64)
+        lo = dp._block_lo
+    return lo, dp._block_hi
+
+
+
+
+def _prune_fields(plan: LogicalPlan, kernel_groups: List[GroupPlan],
+                  fields, ctx, k: int,
+                  host_masks: List[Tuple[np.ndarray, bool]]):
+    """Drop postings blocks that provably cannot affect the top-k.
+
+    Correctness argument (recall exactly 1.0):
+    - θ is the k-th largest *single-entry* contribution among ≥k distinct
+      docs that verifiably PASS the whole query (live + every filter,
+      validated host-side) — each doc's true score is ≥ its partial
+      contribution, so the true k-th best score is ≥ θ.
+    - A docid window's bound sums per-term maxima of
+      w·max_tf/(max_tf + k1·(1−b+b·min_len/avg)) — an upper bound on any
+      doc's score inside the window (score is monotonic ↑tf, ↓len).
+    - Windows with bound < θ therefore contain no top-k member; blocks
+      overlapping only such windows drop from every group (scoring,
+      filter, must_not alike), so surviving docs keep ALL their postings
+      and score exactly.
+    The kernel's matching-doc count becomes a lower bound (`pruned=True`
+    → hits.total relation "gte"), which is why callers gate this on
+    track_total_hits thresholds.
+    """
+    total_blocks = sum(len(f[2]) for f in fields)
+    if total_blocks < PRUNE_MIN_BLOCKS or plan.dense or plan.bonus < 0:
+        return fields, False
+
+    # adaptive backoff: on corpora whose docid space shows no block-max
+    # skew (uniform synthetic data, shuffled ingestion) the bound pass
+    # never prunes — exponentially skip attempts per segment so the host
+    # cost vanishes there (the spirit of Lucene's usage-tracking policy)
+    dev = ctx.device
+    skip = getattr(dev, "_prune_skip", 0)
+    if skip > 0:
+        dev._prune_skip = skip - 1
+        return fields, False
+
+    # ---- eligibility + candidate sources + host-validated filters
+    must_ids = [gi for gi, g in enumerate(kernel_groups)
+                if g.kind == plan_ops.MUST]
+    cand_ids = set()
+    small_filters: List[Tuple[int, bool]] = []   # (group id, negate)
+    for gi, g in enumerate(kernel_groups):
+        if g.kind == plan_ops.MUST:
+            if len(must_ids) != 1 or plan.msm >= 1 or g.req > 1:
+                return fields, False
+            cand_ids.add(gi)
+        elif g.kind == plan_ops.SHOULD:
+            if not must_ids and plan.msm <= 1 and g.req <= 1:
+                cand_ids.add(gi)
+        elif g.kind == plan_ops.MUST_NOT:
+            # a kernel must_not whose postings prune away would let the
+            # matching-doc count OVERcount (excluded docs sneaking back
+            # in) — converted must_nots are dense columns and stay exact
+            return fields, False
+        else:   # small FILTER staying in the kernel
+            if g.req > 1 or len({t.field for t in g.terms}) != 1:
+                return fields, False
+            small_filters.append((gi, False))
+    if must_ids:
+        cand_ids = set(must_ids)
+    if not cand_ids:
+        return fields, False
+
+    nd = ctx.segment.n_docs
+    if nd <= 0:
+        return fields, False
+    wsz = max(1, -(-nd // PRUNE_WINDOWS))
+    W = -(-nd // wsz)
+    k1, b = ctx.k1, ctx.b
+    ng = len(kernel_groups)
+    gconst = np.asarray([g.const_score for g in kernel_groups], np.float32)
+    gkind = np.asarray([g.kind for g in kernel_groups], np.int32)
+
+    # validation mask over real docs: live + converted cached filters +
+    # small kernel filters
+    vmask = np.asarray(ctx.segment.live[:nd], bool).copy()
+    for hm, negate in host_masks:
+        vmask &= ~hm[:nd] if negate else hm[:nd]
+    for gi, negate in small_filters:
+        g = kernel_groups[gi]
+        fname = g.terms[0].field
+        dp = ctx.device.postings.get(fname)
+        if dp is None:
+            m = np.zeros(nd, bool)
+        else:
+            from elasticsearch_tpu.ops.device import host_any_mask
+            m = host_any_mask(dp.host, [t.term for t in g.terms], nd)
+        vmask &= ~m if negate else m
+
+    # ---- per-(group, window) upper bounds + θ candidates
+    group_wb = np.zeros((ng, W), np.float64)
+    group_any = np.zeros((ng, W), bool)     # presence for const groups
+    theta = -np.inf
+    probe_j = -(-k // 128) + 4              # blocks per candidate entry
+    per_field = []                          # (wlo, whi) kept for drop pass
+    for fname, dp, sel_u, grp_u, sub_u, w_u, c_u, ent_u in fields:
+        pf = dp.host
+        avg = ctx.stats.field_stats(fname)[1]
+        lo_all, hi_all = _block_bounds(dp)
+        wlo = (lo_all[sel_u] // wsz).astype(np.int64)
+        whi = np.maximum(hi_all[sel_u] // wsz, wlo).astype(np.int64)
+        per_field.append((wlo, whi))
+        mtf = pf.block_max_tf[sel_u].astype(np.float64)
+        mln = pf.block_min_len[sel_u].astype(np.float64)
+        norm = k1 * (1.0 - b + b * mln / avg)
+        sat = np.where(mtf > 0.0, mtf / (mtf + norm), 0.0)
+        is_sum_grp = np.isnan(gconst[grp_u])   # NaN const ⇒ sum-of-contribs
+        ub = np.where(is_sum_grp,
+                      np.where(c_u, w_u, w_u * sat),
+                      (mtf > 0.0).astype(np.float64))
+
+        # per-entry window maxima (entries are windows-disjoint block runs)
+        n_ent = int(ent_u[-1]) + 1 if len(ent_u) else 0
+        if n_ent > 64:
+            # pathological entry counts (huge terms lists in the kernel)
+            # would make the per-entry bound pass itself the bottleneck
+            return fields, False
+        lens = whi - wlo + 1
+        tot = int(lens.sum())
+        csum = np.cumsum(lens) - lens
+        widx = (np.repeat(wlo, lens)
+                + (np.arange(tot, dtype=np.int64) - np.repeat(csum, lens)))
+        eidx = np.repeat(ent_u.astype(np.int64), lens)
+        ewm = np.zeros(n_ent * W, np.float64)
+        np.maximum.at(ewm, eidx * W + widx, np.repeat(ub, lens))
+        ewm = ewm.reshape(n_ent, W)
+
+        # fold entries into group bounds: NaN-const groups SUM their
+        # entries' maxima (duplicate query terms double-count, matching
+        # the kernel); const groups need presence only
+        ent_first = np.flatnonzero(np.diff(ent_u, prepend=-1))
+        for e0 in ent_first:
+            e = int(ent_u[e0])
+            gi = int(grp_u[e0])
+            if np.isnan(gconst[gi]):
+                group_wb[gi] += ewm[e]
+            group_any[gi] |= ewm[e] > 0.0
+
+            # θ probe: top-J blocks of candidate entries, exact partial
+            # contributions validated against vmask
+            if gi not in cand_ids:
+                continue
+            blocks = sel_u[ent_u == e]
+            ub_e = ub[ent_u == e]
+            j = min(probe_j, len(blocks))
+            topb = blocks[np.argpartition(ub_e, len(ub_e) - j)[len(ub_e) - j:]] \
+                if j < len(blocks) else blocks
+            d = pf.block_docids[topb].reshape(-1)
+            tf = pf.block_tfs[topb].reshape(-1).astype(np.float64)
+            ok = (tf > 0.0) & (d < nd)
+            d, tf = d[ok], tf[ok]
+            ok = vmask[d]
+            d, tf = d[ok], tf[ok]
+            if len(d) < k:
+                continue
+            if not np.isnan(gconst[gi]):
+                cand = np.full(len(d), float(gconst[gi]))
+            elif bool(c_u[e0]):
+                cand = np.full(len(d), float(w_u[e0]))
+            else:
+                dnorm = k1 * (1.0 - b
+                              + b * pf.field_lengths[d].astype(np.float64)
+                              / avg)
+                cand = float(w_u[e0]) * tf / (tf + dnorm)
+            th = np.partition(cand, len(cand) - k)[len(cand) - k]
+            if th > theta:
+                theta = th
+
+    def _fail():
+        fails = getattr(dev, "_prune_fail", 0) + 1
+        dev._prune_fail = fails
+        dev._prune_skip = min(256, 2 ** min(fails, 8))
+        return fields, False
+
+    if not np.isfinite(theta) or theta <= 0.0:
+        return _fail()
+
+    # ---- combine group bounds → per-window score bound
+    scoring = (gkind == plan_ops.MUST) | (gkind == plan_ops.SHOULD)
+    gb = np.where(np.isnan(gconst)[:, None], group_wb,
+                  np.nan_to_num(gconst)[:, None] * group_any)
+    gb = gb[scoring]
+    if plan.combine == "dismax":
+        mx = gb.max(axis=0) if len(gb) else np.zeros(W)
+        wb = mx + plan.tie * (gb.sum(axis=0) - mx)
+    else:
+        wb = gb.sum(axis=0) if len(gb) else np.zeros(W)
+
+    # float32 kernel sums can exceed the float64 bound by rounding —
+    # keep a small safety margin
+    keep_w = wb >= theta * (1.0 - 1e-5)
+    if keep_w.all():
+        return _fail()
+    ck = np.concatenate([[0], np.cumsum(keep_w)])
+
+    out = []
+    pruned = False
+    for (fname, dp, sel_u, grp_u, sub_u, w_u, c_u, ent_u), (wlo, whi) in zip(
+            fields, per_field):
+        blk_keep = (ck[np.minimum(whi, W - 1) + 1] - ck[wlo]) > 0
+        if blk_keep.all():
+            out.append((fname, dp, sel_u, grp_u, sub_u, w_u, c_u, ent_u))
+            continue
+        pruned = True
+        out.append((fname, dp, sel_u[blk_keep], grp_u[blk_keep],
+                    sub_u[blk_keep], w_u[blk_keep], c_u[blk_keep],
+                    ent_u[blk_keep]))
+    if pruned:
+        dev._prune_fail = 0
+    else:
+        fails = getattr(dev, "_prune_fail", 0) + 1
+        dev._prune_fail = fails
+        dev._prune_skip = min(256, 2 ** min(fails, 8))
+    return out, pruned
 
 
 def execute_bound(bp: BoundPlan, ctx, k: int, k1: float, b: float,

@@ -59,6 +59,9 @@ class QueryResult:
     total_hits: int
     max_score: Optional[float]
     agg_masks: Optional[List[Tuple[Segment, np.ndarray]]] = None
+    # True when block-max pruning ran: total_hits is then a LOWER bound
+    # (the service reports hits.total.relation = "gte")
+    total_lower_bound: bool = False
 
 
 class ShardSearcher:
@@ -85,7 +88,10 @@ class ShardSearcher:
                     min_score: Optional[float] = None,
                     sort: Optional[List[Dict[str, Any]]] = None,
                     search_after: Optional[List[Any]] = None,
-                    track_total_hits: bool = True,
+                    # bool OR int threshold (ES track_total_hits): any
+                    # non-True value licenses block-max pruning, since
+                    # totals may then be lower bounds ("gte")
+                    track_total_hits=True,
                     after_key: Optional[Tuple[float, int, int]] = None,
                     collect_masks: bool = False,
                     allow_plan: bool = True) -> QueryResult:
@@ -216,19 +222,26 @@ class ShardSearcher:
         return QueryResult(docs, total, max_score, agg_masks)
 
     def _plan_query_phase(self, query: QueryBuilder, plan, k: int,
-                          track_total_hits: bool,
+                          track_total_hits,
                           after_score: Optional[float] = None) -> QueryResult:
         """Execute a compiled LogicalPlan per segment via the fused
         sorted-top-k kernel (search/plan.py) and merge exactly as the
         dense path merges (by (-score, segment, docid))."""
         from elasticsearch_tpu.search.plan import bind_plan, execute_bound
 
+        # exact totals (track_total_hits: true) forbid dropping blocks;
+        # thresholded/disabled totals license block-max pruning, exactly
+        # as Lucene only enters TOP_SCORES mode under a total-hits
+        # threshold (ref: TopDocsCollectorContext.java:210-217)
+        allow_prune = track_total_hits is not True and after_score is None
         per_segment: List[Tuple[int, np.ndarray, np.ndarray]] = []
         total = 0
+        lower_bound = False
         for seg_idx, ctx in enumerate(self._contexts()):
             if ctx.segment.n_docs == 0 or not query.can_match(ctx):
                 continue
-            bp = bind_plan(plan, ctx)
+            bp = bind_plan(plan, ctx, k=k, allow_prune=allow_prune)
+            lower_bound = lower_bound or bp.pruned
             if self.batcher is not None:
                 vals, ids, seg_total = self.batcher.execute(
                     bp, ctx, k, self.k1, self.b, after_score)
@@ -243,7 +256,8 @@ class ShardSearcher:
                 continue
             per_segment.append((seg_idx, vals[keep], ids[keep]))
         if not per_segment:
-            return QueryResult([], total, None, None)
+            return QueryResult([], total, None, None,
+                               total_lower_bound=lower_bound)
         all_keys = np.concatenate([v for _, v, _ in per_segment])
         all_segs = np.concatenate(
             [np.full(len(i), s, np.int32) for s, _, i in per_segment])
@@ -254,7 +268,8 @@ class ShardSearcher:
                            sort_key=float(all_keys[i]))
                 for i in order]
         max_score = float(all_keys[order[0]]) if len(order) else None
-        return QueryResult(docs, total, max_score, None)
+        return QueryResult(docs, total, max_score, None,
+                           total_lower_bound=lower_bound)
 
     # ---------------------------------------------------------- rescore
     def rescore(self, docs: List[DocAddress],

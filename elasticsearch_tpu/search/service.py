@@ -551,6 +551,13 @@ class SearchService:
         sort = body.get("sort")
         min_score = body.get("min_score")
         search_after = body.get("search_after")
+        # Default: exact totals (a stronger guarantee than the
+        # reference's 10,000 threshold). An EXPLICIT int threshold or
+        # false licenses block-max pruned collection, exactly as
+        # Lucene's TOP_SCORES mode only engages under a total-hits
+        # threshold — totals then become lower bounds ("gte"); keeping
+        # the default exact preserves the reference's
+        # exact-below-threshold contract in every default-path response.
         track_total = body.get("track_total_hits", True)
         highlight = body.get("highlight")
         aggs_spec = body.get("aggs", body.get("aggregations"))
@@ -613,7 +620,9 @@ class SearchService:
             result = searcher.query_phase(
                 query, query_k, post_filter=post_filter, min_score=min_score,
                 sort=sort, search_after=search_after,
-                track_total_hits=bool(track_total) and not continuing,
+                # raw value (bool OR int threshold): thresholded totals
+                # license block-max pruning down in the plan executor
+                track_total_hits=(track_total if not continuing else False),
                 after_key=after_key, collect_masks=collect_masks,
                 # scroll pages must stay on ONE executor: plan-path and
                 # dense-path float32 sums differ in the last bits, so a
@@ -774,6 +783,9 @@ class SearchService:
             suggest = compute_suggest(body["suggest"], searchers)
 
         relation = "eq"
+        if any(r.total_lower_bound for _, _, r in shard_results):
+            # block-max pruning ran: the counted total is a lower bound
+            relation = "gte"
         if scroll_ctx is not None:
             if continuing:
                 total = scroll_ctx.total_hits
@@ -857,6 +869,7 @@ class SearchService:
         body = dict(body or {})
         body["size"] = 0
         body.pop("sort", None)
+        body["track_total_hits"] = True   # _count is always exact
         response = self.search(index_expression, body)
         return {"count": response["hits"]["total"]["value"],
                 "_shards": response["_shards"]}

@@ -1,0 +1,212 @@
+"""Filter-mask cache + block-max window pruning tests.
+
+The serving-path optimizations must be invisible to results:
+- converting FILTER/MUST_NOT groups to cached dense masks
+  (search/plan._convert_filters, ops/device.DeviceSegment.filter_mask)
+  must agree exactly with the dense executor;
+- block-max window pruning (search/plan._prune_fields) must return the
+  EXACT top-k (recall 1.0) whenever it engages, with totals downgraded
+  to lower bounds (hits.total relation "gte").
+Thresholds are monkeypatched low so small test corpora exercise both.
+"""
+
+import numpy as np
+import pytest
+
+import elasticsearch_tpu.search.plan as plan_mod
+from elasticsearch_tpu.index.mapper import MapperService
+from elasticsearch_tpu.index.segment import SegmentWriter
+from elasticsearch_tpu.search.context import DeviceSegmentCache
+from elasticsearch_tpu.search.plan import compile_plan
+from elasticsearch_tpu.search.queries import parse_query
+from elasticsearch_tpu.search.searcher import ShardSearcher
+
+MAPPINGS = {
+    "properties": {
+        "title": {"type": "text"},
+        "tag": {"type": "keyword"},
+    }
+}
+
+VOCAB = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta",
+         "wolf", "fox", "dog", "cat"]
+TAGS = ["red", "green", "blue"]
+
+
+def build_searcher(n_docs=1200, seed=3, segments=1):
+    rng = np.random.default_rng(seed)
+    svc = MapperService(mappings=MAPPINGS)
+    segs = []
+    doc_no = 0
+    for si in range(segments):
+        w = SegmentWriter()
+        for _ in range(n_docs // segments):
+            # Zipf-ish skew so block maxima vary across the docid space
+            n_title = int(rng.integers(2, 12))
+            words = rng.choice(VOCAB, n_title,
+                               p=np.arange(len(VOCAB), 0, -1.0)
+                               / np.arange(len(VOCAB), 0, -1.0).sum())
+            w.add(svc.parse(str(doc_no), {
+                "title": " ".join(words),
+                "tag": str(rng.choice(TAGS)),
+            }))
+            doc_no += 1
+        segs.append(w.build(f"s{si}"))
+    return ShardSearcher(segs, svc, DeviceSegmentCache())
+
+
+FILTERED_CASES = [
+    {"bool": {"must": [{"match": {"title": "alpha wolf"}}],
+              "filter": [{"term": {"tag": "red"}}]}},
+    {"bool": {"must": [{"match": {"title": "beta"}}],
+              "filter": [{"terms": {"tag": ["red", "blue"]}}]}},
+    {"bool": {"must": [{"match": {"title": "gamma fox"}}],
+              "must_not": [{"term": {"tag": "green"}}]}},
+    {"bool": {"should": [{"match": {"title": "alpha"}},
+                         {"match": {"title": "cat dog"}}],
+              "filter": [{"term": {"tag": "blue"}}]}},
+    {"bool": {"filter": [{"term": {"tag": "red"}},
+                         {"match": {"title": "alpha"}}]}},
+]
+
+
+@pytest.fixture(scope="module")
+def searcher():
+    return build_searcher()
+
+
+@pytest.fixture(autouse=True)
+def low_thresholds(monkeypatch):
+    monkeypatch.setattr(plan_mod, "FILTER_CACHE_MIN_BLOCKS", 1)
+    monkeypatch.setattr(plan_mod, "PRUNE_MIN_BLOCKS", 4)
+
+
+def agree(searcher, body, size, **kw):
+    query = parse_query(body)
+    fast = searcher.query_phase(query, size, **kw)
+    dense = searcher.query_phase(query, size, collect_masks=True)
+    return fast, dense
+
+
+@pytest.mark.parametrize("body", FILTERED_CASES)
+def test_filter_conversion_matches_dense(searcher, body):
+    query = parse_query(body).rewrite(searcher)
+    assert compile_plan(query, searcher) is not None, body
+    fast, dense = agree(searcher, body, size=2000)
+    f = {(d.segment_idx, d.docid): d.score for d in fast.docs}
+    e = {(d.segment_idx, d.docid): d.score for d in dense.docs}
+    assert set(f) == set(e), body
+    for key in f:
+        # float32 contributions sum in different orders on the two paths
+        assert f[key] == pytest.approx(e[key], rel=8e-4, abs=1e-5), body
+    assert fast.total_hits == dense.total_hits, body
+
+
+def test_masks_actually_cached(searcher):
+    body = {"bool": {"must": [{"match": {"title": "alpha"}}],
+                     "filter": [{"term": {"tag": "red"}}]}}
+    searcher.query_phase(parse_query(body), 10)
+    cached = [len(searcher.cache.get(seg)._filter_masks)
+              for seg in searcher.segments]
+    assert sum(cached) >= 1
+    # second run hits the cache (no growth)
+    searcher.query_phase(parse_query(body), 10)
+    assert [len(searcher.cache.get(seg)._filter_masks)
+            for seg in searcher.segments] == cached
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+@pytest.mark.parametrize("body", [
+    {"match": {"title": "alpha beta wolf"}},
+    {"match": {"title": "alpha"}},
+    {"multi_match": {"query": "wolf cat", "fields": ["title"],
+                     "type": "most_fields"}},
+    {"bool": {"must": [{"match": {"title": "alpha gamma"}}],
+              "filter": [{"term": {"tag": "red"}}]}},
+])
+def test_pruned_topk_is_exact(body, seed):
+    s = build_searcher(n_docs=1500, seed=seed)
+    k = 12
+    query = parse_query(body)
+    exact = s.query_phase(query, k, track_total_hits=True)
+    pruned = s.query_phase(query, k, track_total_hits=10)
+    pf = [(d.segment_idx, d.docid) for d in pruned.docs]
+    ef = [(d.segment_idx, d.docid) for d in exact.docs]
+    assert pf == ef, body
+    for dp_, de_ in zip(pruned.docs, exact.docs):
+        assert dp_.score == pytest.approx(de_.score, rel=2e-4, abs=1e-6)
+    # totals: lower bound, never an overcount
+    assert pruned.total_hits <= exact.total_hits
+    if pruned.total_lower_bound:
+        assert pruned.total_hits >= k
+
+
+def build_skewed_searcher(n_docs=1600, seed=11):
+    """High-tf docs concentrate in the first docid region — the layout
+    where block-max bounds actually discriminate (clustered corpora,
+    time-ordered logs)."""
+    rng = np.random.default_rng(seed)
+    svc = MapperService(mappings=MAPPINGS)
+    w = SegmentWriter()
+    for i in range(n_docs):
+        if i < n_docs // 8:
+            title = " ".join(["alpha"] * int(rng.integers(6, 12))
+                             + list(rng.choice(VOCAB, 3)))
+        else:
+            title = " ".join(rng.choice(VOCAB, int(rng.integers(4, 9))))
+        w.add(svc.parse(str(i), {"title": title,
+                                 "tag": str(rng.choice(TAGS))}))
+    return ShardSearcher([w.build("s0")], svc, DeviceSegmentCache())
+
+
+def test_pruning_engages_on_skewed_corpus():
+    s = build_skewed_searcher()
+    query = parse_query({"match": {"title": "alpha"}})
+    exact = s.query_phase(query, 10, track_total_hits=True)
+    pruned = s.query_phase(query, 10, track_total_hits=10)
+    assert pruned.total_lower_bound, "pruning should engage here"
+    assert pruned.total_hits < exact.total_hits   # blocks really dropped
+    assert ([(d.segment_idx, d.docid) for d in pruned.docs]
+            == [(d.segment_idx, d.docid) for d in exact.docs])
+    for dp_, de_ in zip(pruned.docs, exact.docs):
+        assert dp_.score == pytest.approx(de_.score, rel=2e-4)
+
+
+def test_exact_totals_forbid_pruning():
+    s = build_searcher(n_docs=1500, seed=5)
+    query = parse_query({"match": {"title": "alpha beta"}})
+    exact = s.query_phase(query, 10, track_total_hits=True)
+    assert not exact.total_lower_bound
+    again = s.query_phase(query, 10, track_total_hits=True)
+    assert again.total_hits == exact.total_hits
+
+
+def test_rest_relation_gte(tmp_path):
+    """Through the REST layer: default track_total_hits (10000 threshold)
+    keeps small-corpus totals exact (relation eq)."""
+    from elasticsearch_tpu.node import Node
+
+    node = Node(data_path=str(tmp_path / "n"))
+    try:
+        st, _ = node.rest_controller.dispatch(
+            "PUT", "/t", None, {"mappings": MAPPINGS})
+        assert st == 200
+        for i in range(50):
+            node.rest_controller.dispatch(
+                "PUT", f"/t/_doc/{i}", {"refresh": "false"},
+                {"title": "alpha wolf", "tag": "red"})
+        node.rest_controller.dispatch("POST", "/t/_refresh", None, None)
+        st, resp = node.rest_controller.dispatch(
+            "POST", "/t/_search", None,
+            {"query": {"match": {"title": "alpha"}}})
+        assert st == 200
+        assert resp["hits"]["total"] == {"value": 50, "relation": "eq"}
+        # an explicit low threshold caps the reported value
+        st, resp = node.rest_controller.dispatch(
+            "POST", "/t/_search", None,
+            {"query": {"match": {"title": "alpha"}}, "track_total_hits": 7})
+        assert st == 200
+        assert resp["hits"]["total"]["value"] <= 50
+        assert resp["hits"]["total"]["relation"] in ("eq", "gte")
+    finally:
+        node.close()
