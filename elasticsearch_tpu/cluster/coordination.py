@@ -54,6 +54,7 @@ REQUEST_PEERS_ACTION = "internal:discovery/request_peers"
 PRE_VOTE_ACTION = "internal:cluster/coordination/pre_vote"
 START_JOIN_ACTION = "internal:cluster/coordination/start_join"
 JOIN_ACTION = "internal:cluster/coordination/join"
+VALIDATE_JOIN_ACTION = "internal:cluster/coordination/join/validate"
 PUBLISH_STATE_ACTION = "internal:cluster/coordination/publish_state"
 COMMIT_STATE_ACTION = "internal:cluster/coordination/commit_state"
 FOLLOWER_CHECK_ACTION = "internal:coordination/fault_detection/follower_check"
@@ -321,7 +322,8 @@ class Coordinator:
                  initial_master_nodes: Optional[List[str]] = None,
                  on_committed_state: Optional[Callable] = None,
                  master_service=None,
-                 rng=None):
+                 rng=None,
+                 consistent_settings=None):
         self.transport = transport
         self.scheduler = scheduler
         self.local_node: DiscoveryNode = transport.local_node
@@ -334,6 +336,11 @@ class Coordinator:
         self.initial_master_nodes = list(initial_master_nodes or [])
         self.on_committed_state = on_committed_state or (lambda s: None)
         self.master_service = master_service
+        # ConsistentSettingsService (common/keystore.py): the elected
+        # master publishes salted hashes of consistent secure settings;
+        # joining nodes must verify their keystore against them (ref:
+        # ConsistentSettingsService.java, wired node/Node.java:389-391)
+        self.consistent_settings = consistent_settings
         import random as _random
         self.rng = rng or _random.Random()
 
@@ -368,6 +375,7 @@ class Coordinator:
             (PRE_VOTE_ACTION, self._on_pre_vote),
             (START_JOIN_ACTION, self._on_start_join),
             (JOIN_ACTION, self._on_join),
+            (VALIDATE_JOIN_ACTION, self._on_validate_join),
             (PUBLISH_STATE_ACTION, self._on_publish),
             (COMMIT_STATE_ACTION, self._on_commit),
             (FOLLOWER_CHECK_ACTION, self._on_follower_check),
@@ -684,9 +692,18 @@ class Coordinator:
             self._handler(lambda r: None, lambda e: None), timeout=10.0)
 
     def _on_join(self, req, channel, src) -> None:
+        """Every REMOTE join — ballot votes during elections included —
+        is validated against the consistent-secure-settings hashes
+        before it counts (ref: JoinHelper validates every join via a
+        ValidateJoinRequest round-trip to the joiner). When no hashes
+        exist (no keystore anywhere) the path is zero-overhead."""
         try:
             if req.get("join") is not None:
-                self._process_join(Join.from_dict(req["join"]))
+                join = Join.from_dict(req["join"])
+                joiner = join.source_node
+
+                def finish():
+                    self._finish_ballot_join(join, channel)
             elif req.get("node") is not None:
                 # membership-only join (no ballot): a healed node rejoins
                 # an established leader at the same term
@@ -694,26 +711,111 @@ class Coordinator:
                 if self.mode != MODE_LEADER:
                     raise CoordinationStateRejectedException(
                         "not the leader")
-                self.peers.setdefault(joiner.node_id, joiner)
-                self._submit_internal(
-                    f"node-join[{joiner.name}]",
-                    lambda state: self._node_join_update(state, joiner))
-            channel.send_response({"ok": True})
+
+                def finish():
+                    self._finish_membership_join(joiner, channel)
+            else:
+                channel.send_response({"ok": True})
+                return
+            hashes = self._join_validation_hashes()
+            if joiner.node_id == self.local_node.node_id or not hashes:
+                finish()
+                return
+
+            def reject(err):
+                channel.send_exception(CoordinationStateRejectedException(
+                    f"join validation on node [{joiner.name}] failed: "
+                    f"{err}"))
+
+            self.transport.send_request(
+                joiner, VALIDATE_JOIN_ACTION, {"hashes": hashes},
+                self._handler(lambda _r: self._finish_safely(finish,
+                                                             channel),
+                              reject),
+                timeout=10.0)
         except CoordinationStateRejectedException as e:
             channel.send_exception(e)
 
-    def _process_join(self, join: Join) -> None:
+    def _finish_safely(self, finish, channel) -> None:
+        try:
+            finish()
+        except CoordinationStateRejectedException as e:
+            channel.send_exception(e)
+
+    def _finish_ballot_join(self, join: Join, channel) -> None:
+        joiner, needs_add = self._apply_join_vote(join)
+        if needs_add:
+            self._submit_internal(
+                f"node-join[{joiner.name}]",
+                lambda state: self._node_join_update(state, joiner))
+        channel.send_response({"ok": True})
+
+    def _finish_membership_join(self, joiner: DiscoveryNode,
+                                channel) -> None:
+        self.peers.setdefault(joiner.node_id, joiner)
+        self._submit_internal(
+            f"node-join[{joiner.name}]",
+            lambda state: self._node_join_update(state, joiner))
+        channel.send_response({"ok": True})
+
+    def _join_validation_hashes(self) -> Dict[str, str]:
+        hashes = dict(
+            self.applied_state.metadata.hashes_of_consistent_settings
+            or {})
+        if not hashes and self.consistent_settings is not None:
+            # window between become_leader() and the first publish being
+            # applied locally — and candidates validating founding votes:
+            # our keystore's hashes ARE what will be published
+            hashes = self.consistent_settings.compute_hashes()
+        return hashes
+
+    def _apply_join_vote(self, join: Join):
+        """Shared join accounting: count the vote, register the peer,
+        win the election if this vote completes a quorum. Returns
+        (joiner, needs_membership_add) — True when an established leader
+        must still add the joiner to the cluster state."""
         won_now = self.coordination_state.handle_join(join)
         joiner = join.source_node
         if joiner.node_id != self.local_node.node_id:
             self.peers.setdefault(joiner.node_id, joiner)
         if self.mode == MODE_CANDIDATE and won_now:
             self.become_leader()
-        elif self.mode == MODE_LEADER:
-            # a node joined an established leader: add to cluster state
+            return joiner, False
+        return joiner, (self.mode == MODE_LEADER
+                        and joiner.node_id != self.local_node.node_id)
+
+    def _process_join(self, join: Join) -> None:
+        """Channel-less join processing for internal paths: our own vote
+        at election time and joins carried back on publish responses
+        (both from nodes already inside the publication flow, so no
+        validate round-trip)."""
+        joiner, needs_add = self._apply_join_vote(join)
+        if needs_add:
             self._submit_internal(
                 f"node-join[{joiner.name}]",
                 lambda state: self._node_join_update(state, joiner))
+
+    def _on_validate_join(self, req, channel, src) -> None:
+        """Master → joiner: verify this node is compatible with the
+        published cluster state. Checks the local keystore against the
+        master's consistent-secure-settings hashes — a mismatched node
+        fails its join with a clear error (ref:
+        ConsistentSettingsService.java)."""
+        published = req.get("hashes") or {}
+        svc = self.consistent_settings
+        if svc is None:
+            if published:
+                channel.send_exception(CoordinationStateRejectedException(
+                    "the master publishes consistent secure settings but "
+                    "this node has no keystore"))
+                return
+        else:
+            err = svc.verify(published)
+            if err is not None:
+                channel.send_exception(
+                    CoordinationStateRejectedException(err))
+                return
+        channel.send_response({"ok": True})
 
     # ---------------------------------------------------------- bootstrap
 
@@ -800,7 +902,19 @@ class Coordinator:
         nodes = nodes.with_node(self.local_node)
         nodes = nodes.with_master(self.local_node.node_id)
         blocks = state.blocks.without_global_block(BLOCK_NO_MASTER)
-        return state.with_(nodes=nodes, blocks=blocks)
+        state = state.with_(nodes=nodes, blocks=blocks)
+        # publish salted hashes of OUR consistent secure settings so
+        # members and future joiners can verify their keystores (ref:
+        # ConsistentSettingsService publishing on master election)
+        if self.consistent_settings is not None:
+            from dataclasses import replace as _replace
+            hashes = self.consistent_settings.compute_hashes(
+                existing=state.metadata.hashes_of_consistent_settings)
+            if hashes != state.metadata.hashes_of_consistent_settings:
+                state = state.with_(metadata=_replace(
+                    state.metadata,
+                    hashes_of_consistent_settings=hashes))
+        return state
 
     def _node_join_update(self, state: ClusterState,
                           joiner: DiscoveryNode) -> ClusterState:

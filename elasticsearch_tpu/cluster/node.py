@@ -55,7 +55,7 @@ class ClusterNode:
     def __init__(self, transport, scheduler, data_path: str,
                  seed_nodes: Optional[List[DiscoveryNode]] = None,
                  initial_master_nodes: Optional[List[str]] = None,
-                 rng=None):
+                 rng=None, keystore=None):
         self.transport = transport
         self.scheduler = scheduler
         self.local_node: DiscoveryNode = transport.local_node
@@ -67,13 +67,23 @@ class ClusterNode:
         self.data_node = DataNodeService(transport, scheduler, data_path)
         self.search_service = DistributedSearchService(
             transport, self.data_node, self.routing)
+        # secure-settings keystore (ref: node/Node.java:389-391 wiring of
+        # ConsistentSettingsService): when present, the elected master
+        # publishes salted hashes and joiners must match them
+        self.keystore = keystore
+        consistent = None
+        if keystore is not None:
+            from elasticsearch_tpu.common.keystore import (
+                ConsistentSettingsService)
+            consistent = ConsistentSettingsService(keystore)
         self.coordinator = Coordinator(
             transport, scheduler,
             persisted=PersistedState(),
             seed_nodes=seed_nodes,
             initial_master_nodes=initial_master_nodes,
             on_committed_state=self._on_committed_state,
-            rng=rng)
+            rng=rng,
+            consistent_settings=consistent)
 
         for action, handler in [
             (SHARD_STARTED_ACTION, self._on_shard_started),
@@ -105,6 +115,18 @@ class ClusterNode:
     def _on_committed_state(self, state: ClusterState) -> None:
         """ClusterApplierService analogue: every service sees each
         committed state (ref: ClusterApplierService.java:463-490)."""
+        # re-verify consistent secure settings on every applied state,
+        # as the reference does (ConsistentSettingsService cluster-state
+        # listener); inconsistency after join is surfaced, not fatal
+        svc = self.coordinator.consistent_settings
+        if svc is not None:
+            self.consistent_settings_error = svc.verify(
+                state.metadata.hashes_of_consistent_settings)
+            if self.consistent_settings_error:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "[%s] %s", self.local_node.name,
+                    self.consistent_settings_error)
         self.data_node.apply_cluster_state(state)
         # master: membership/metadata changes may unlock allocation; the
         # task no-ops (no publication) when reroute changes nothing

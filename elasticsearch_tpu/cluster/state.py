@@ -181,6 +181,10 @@ class Metadata:
     coordination: CoordinationMetadata = CoordinationMetadata()
     indices: Dict[str, IndexMetadata] = field(default_factory=dict)
     persistent_settings: Dict[str, Any] = field(default_factory=dict)
+    # {secure setting key: "salt$pbkdf2-hash"} published by the master so
+    # every node can verify its keystore (ref: ConsistentSettingsService)
+    hashes_of_consistent_settings: Dict[str, str] = field(
+        default_factory=dict)
     version: int = 0
 
     def index(self, name: str) -> Optional[IndexMetadata]:
@@ -206,6 +210,8 @@ class Metadata:
             "coordination": self.coordination.to_dict(),
             "indices": {k: v.to_dict() for k, v in self.indices.items()},
             "persistent_settings": self.persistent_settings,
+            "hashes_of_consistent_settings":
+                self.hashes_of_consistent_settings,
             "version": self.version,
         }
 
@@ -219,6 +225,8 @@ class Metadata:
             indices={k: IndexMetadata.from_dict(v)
                      for k, v in d.get("indices", {}).items()},
             persistent_settings=d.get("persistent_settings", {}),
+            hashes_of_consistent_settings=d.get(
+                "hashes_of_consistent_settings", {}),
             version=d.get("version", 0))
 
 

@@ -1,0 +1,334 @@
+"""Secure settings keystore + cluster-wide consistency hashing.
+
+The analogue of the reference's encrypted keystore and consistent-settings
+machinery (ref: common/settings/KeyStoreWrapper.java — PBKDF2 +
+AES-GCM-encrypted settings file; common/settings/ConsistentSettingsService
+— master publishes salted hashes of secure settings in cluster state and
+every node verifies its local values against them; wired at
+node/Node.java:389-391).
+
+Crypto uses only the Python stdlib (no third-party crypto in-env):
+- key derivation: PBKDF2-HMAC-SHA256 (same KDF family as the reference),
+- encryption: HMAC-SHA256 keystream in counter mode (a standard PRF-CTR
+  stream construction) with an encrypt-then-MAC HMAC-SHA256 tag — the
+  reference's AES-GCM provides the same confidentiality+integrity
+  contract; AES is not available in the stdlib so the PRF-CTR+HMAC
+  construction stands in (disclosed, not a weakened scheme).
+
+File format (JSON envelope, binary fields base64):
+  {"format_version": 1, "salt": ..., "iterations": N, "nonce": ...,
+   "ciphertext": ..., "mac": ...}
+Plaintext inside is a JSON object {setting_key: value}.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+import secrets
+from typing import Any, Dict, Iterable, List, Optional
+
+from elasticsearch_tpu.common.errors import SettingsException
+
+KEYSTORE_FILENAME = "elasticsearch.keystore"
+FORMAT_VERSION = 1
+PBKDF2_ITERATIONS = 10_000
+SEED_SETTING = "keystore.seed"          # auto-created, as the reference does
+
+
+def _derive(password: str, salt: bytes, iterations: int) -> bytes:
+    return hashlib.pbkdf2_hmac("sha256", password.encode("utf-8"),
+                               salt, iterations, dklen=64)
+
+
+def _keystream(key: bytes, nonce: bytes, n: int) -> bytes:
+    out = bytearray()
+    counter = 0
+    while len(out) < n:
+        block = hmac.new(key, nonce + counter.to_bytes(8, "big"),
+                         hashlib.sha256).digest()
+        out.extend(block)
+        counter += 1
+    return bytes(out[:n])
+
+
+class KeyStore:
+    """Encrypted-at-rest secure settings store.
+
+    ref: KeyStoreWrapper.java — create()/load()/save() with a password,
+    string settings only (file settings store base64 strings)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._entries: Optional[Dict[str, str]] = None
+
+    # ------------------------------------------------------------ lifecycle
+    @classmethod
+    def create(cls, path: str, password: str = "") -> "KeyStore":
+        ks = cls(path)
+        ks._entries = {SEED_SETTING: secrets.token_urlsafe(16)}
+        ks.save(password)
+        return ks
+
+    @staticmethod
+    def exists(config_dir: str) -> bool:
+        return os.path.exists(os.path.join(config_dir, KEYSTORE_FILENAME))
+
+    @property
+    def is_loaded(self) -> bool:
+        return self._entries is not None
+
+    def load(self, password: str = "") -> "KeyStore":
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                env = json.load(f)
+        except FileNotFoundError:
+            raise SettingsException(
+                f"keystore not found at [{self.path}]")
+        if env.get("format_version") != FORMAT_VERSION:
+            raise SettingsException(
+                f"unsupported keystore format [{env.get('format_version')}]")
+        salt = base64.b64decode(env["salt"])
+        nonce = base64.b64decode(env["nonce"])
+        ct = base64.b64decode(env["ciphertext"])
+        mac = base64.b64decode(env["mac"])
+        dk = _derive(password, salt, int(env["iterations"]))
+        enc_key, mac_key = dk[:32], dk[32:]
+        want = hmac.new(mac_key, nonce + ct, hashlib.sha256).digest()
+        if not hmac.compare_digest(want, mac):
+            raise SettingsException(
+                "keystore password is incorrect or the keystore is "
+                "corrupted (MAC mismatch)")
+        pt = bytes(a ^ b for a, b in zip(ct, _keystream(enc_key, nonce,
+                                                        len(ct))))
+        self._entries = json.loads(pt.decode("utf-8"))
+        return self
+
+    def save(self, password: str = "") -> None:
+        if self._entries is None:
+            raise SettingsException("keystore is not loaded")
+        salt = secrets.token_bytes(16)
+        nonce = secrets.token_bytes(16)
+        dk = _derive(password, salt, PBKDF2_ITERATIONS)
+        enc_key, mac_key = dk[:32], dk[32:]
+        pt = json.dumps(self._entries).encode("utf-8")
+        ct = bytes(a ^ b for a, b in zip(pt, _keystream(enc_key, nonce,
+                                                        len(pt))))
+        mac = hmac.new(mac_key, nonce + ct, hashlib.sha256).digest()
+        env = {
+            "format_version": FORMAT_VERSION,
+            "salt": base64.b64encode(salt).decode(),
+            "iterations": PBKDF2_ITERATIONS,
+            "nonce": base64.b64encode(nonce).decode(),
+            "ciphertext": base64.b64encode(ct).decode(),
+            "mac": base64.b64encode(mac).decode(),
+        }
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(env, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)   # atomic, as the reference's writer
+
+    # ------------------------------------------------------------- entries
+    def _need(self) -> Dict[str, str]:
+        if self._entries is None:
+            raise SettingsException("keystore is not loaded")
+        return self._entries
+
+    def set_string(self, key: str, value: str) -> None:
+        self._need()[key] = str(value)
+
+    def get_string(self, key: str) -> Optional[str]:
+        return self._need().get(key)
+
+    def remove(self, key: str) -> None:
+        self._need().pop(key, None)
+
+    def has(self, key: str) -> bool:
+        return key in self._need()
+
+    def setting_names(self) -> List[str]:
+        return sorted(self._need())
+
+
+class SecureSetting:
+    """A setting that may ONLY live in the keystore (ref:
+    SecureSetting.java: resolving it from normal settings is an error)."""
+
+    def __init__(self, key: str, default: Optional[str] = None,
+                 consistent: bool = False):
+        self.key = key
+        self.default_value = default
+        self.consistent = consistent
+        _SECURE_REGISTRY[key] = self
+
+    def get(self, settings, keystore: Optional[KeyStore]) -> Optional[str]:
+        if settings is not None and settings.get(self.key) is not None:
+            raise SettingsException(
+                f"Setting [{self.key}] is a secure setting and must be "
+                f"stored inside the keystore, but was found in the normal "
+                f"settings")
+        if keystore is not None and keystore.is_loaded \
+                and keystore.has(self.key):
+            return keystore.get_string(self.key)
+        return self.default_value
+
+
+# every SecureSetting ever declared, keyed by setting name (the analogue
+# of the per-plugin getSecureSettings() registration)
+_SECURE_REGISTRY: Dict[str, SecureSetting] = {}
+
+
+def secure_setting(key: str, default: Optional[str] = None,
+                   consistent: bool = False) -> SecureSetting:
+    existing = _SECURE_REGISTRY.get(key)
+    if existing is not None:
+        # flags merge: a later registration may promote a setting to
+        # consistent, never demote (registration order must not decide
+        # whether hashes get published)
+        existing.consistent = existing.consistent or consistent
+        if existing.default_value is None:
+            existing.default_value = default
+        return existing
+    return SecureSetting(key, default, consistent)
+
+
+# Built-in consistent secure settings, declared at import time so every
+# entry point (Node, ClusterNode, tests) sees them regardless of
+# construction order (ref: the reference registers secure settings via
+# plugin getSettings() before any service wiring).
+BOOTSTRAP_PASSWORD_SETTING = SecureSetting("bootstrap.password",
+                                           consistent=True)
+
+
+class ConsistentSettingsService:
+    """Publishes/verifies salted hashes of consistent secure settings.
+
+    ref: ConsistentSettingsService.java — the master puts
+    {setting: salted-PBKDF2(value)} into cluster state metadata
+    ("hashes_of_consistent_settings"); every node verifies its local
+    keystore against the published hashes; a mismatched node must not
+    join."""
+
+    HASH_ITERATIONS = 5_000
+
+    def __init__(self, keystore: Optional[KeyStore],
+                 consistent_keys: Optional[Iterable[str]] = None):
+        self.keystore = keystore
+        self._explicit_keys = (sorted(consistent_keys)
+                               if consistent_keys is not None else None)
+
+    @property
+    def consistent_keys(self) -> List[str]:
+        # resolved at call time so registration order never decides
+        # whether a setting's hash gets published
+        if self._explicit_keys is not None:
+            return self._explicit_keys
+        return sorted(k for k, s in _SECURE_REGISTRY.items()
+                      if s.consistent)
+
+    @staticmethod
+    def _hash(key: str, value: str, salt: str) -> str:
+        dk = hashlib.pbkdf2_hmac(
+            "sha256", value.encode("utf-8"),
+            (salt + ":" + key).encode("utf-8"),
+            ConsistentSettingsService.HASH_ITERATIONS)
+        return base64.b64encode(dk).decode()
+
+    def compute_hashes(
+            self, existing: Optional[Dict[str, str]] = None
+    ) -> Dict[str, str]:
+        """{setting_key: "salt$hash"} for every locally-present consistent
+        secure setting. Salts of ``existing`` entries are reused, so
+        re-elections with unchanged secrets publish byte-identical hashes
+        (no spurious metadata churn)."""
+        out: Dict[str, str] = {}
+        if self.keystore is None or not self.keystore.is_loaded:
+            return out
+        existing = existing or {}
+        for key in self.consistent_keys:
+            if not self.keystore.has(key):
+                continue
+            prev_salt, _, _ = (existing.get(key) or "").partition("$")
+            s = prev_salt or secrets.token_hex(8)
+            out[key] = s + "$" + self._hash(
+                key, self.keystore.get_string(key), s)
+        return out
+
+    def verify(self, published: Dict[str, str]) -> Optional[str]:
+        """Check the local keystore against published hashes. Returns a
+        human-readable error for the FIRST inconsistency, or None."""
+        for key, salted in (published or {}).items():
+            salt, _, want = salted.partition("$")
+            local = (self.keystore.get_string(key)
+                     if self.keystore is not None and self.keystore.is_loaded
+                     and self.keystore.has(key) else None)
+            if local is None:
+                return (f"the secure setting [{key}] is published as a "
+                        f"consistent setting by the master but is missing "
+                        f"from this node's keystore")
+            if not hmac.compare_digest(self._hash(key, local, salt), want):
+                return (f"the secure setting [{key}] in this node's "
+                        f"keystore does NOT match the master's value — "
+                        f"consistent secure settings must be identical on "
+                        f"every node")
+        return None
+
+
+# ---------------------------------------------------------------------------
+# CLI — the elasticsearch-keystore tool analogue
+# (ref: distribution/tools/keystore-cli)
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import getpass
+
+    p = argparse.ArgumentParser(prog="estpu-keystore")
+    p.add_argument("command",
+                   choices=["create", "list", "add", "remove", "show"])
+    p.add_argument("setting", nargs="?")
+    p.add_argument("value", nargs="?")
+    p.add_argument("--path", default=KEYSTORE_FILENAME)
+    p.add_argument("--password", default=os.environ.get(
+        "ES_KEYSTORE_PASSPHRASE"))
+    args = p.parse_args(argv)
+    pw = args.password
+    if pw is None:
+        pw = getpass.getpass("keystore password (empty for none): ")
+
+    if args.command == "create":
+        KeyStore.create(args.path, pw)
+        print(f"Created keystore at {args.path}")
+        return 0
+    ks = KeyStore(args.path).load(pw)
+    if args.command == "list":
+        for name in ks.setting_names():
+            print(name)
+    elif args.command == "add":
+        if not args.setting:
+            p.error("add requires a setting name")
+        value = args.value
+        if value is None:
+            value = getpass.getpass(f"value for {args.setting}: ")
+        ks.set_string(args.setting, value)
+        ks.save(pw)
+    elif args.command == "remove":
+        if not args.setting:
+            p.error("remove requires a setting name")
+        ks.remove(args.setting)
+        ks.save(pw)
+    elif args.command == "show":
+        if not args.setting or not ks.has(args.setting):
+            p.error("unknown setting")
+        print(ks.get_string(args.setting))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

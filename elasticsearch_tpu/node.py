@@ -40,6 +40,16 @@ class Node:
         self.cluster_name = CLUSTER_NAME_SETTING.get(settings)
         self.data_path = data_path or PATH_DATA_SETTING.get(settings)
         os.makedirs(self.data_path, exist_ok=True)
+        # secure-settings keystore (ref: KeyStoreWrapper loaded at
+        # bootstrap, node/Node.java:389-391): loaded from the node dir
+        # when present; password via ES_KEYSTORE_PASSPHRASE
+        from elasticsearch_tpu.common.keystore import (
+            KEYSTORE_FILENAME, KeyStore)
+        self.keystore: Optional[KeyStore] = None
+        ks_path = os.path.join(self.data_path, KEYSTORE_FILENAME)
+        if os.path.exists(ks_path):
+            self.keystore = KeyStore(ks_path).load(
+                os.environ.get("ES_KEYSTORE_PASSPHRASE", ""))
         self.breaker_service = HierarchyCircuitBreakerService()
         self.indices_service = IndicesService(self.data_path, settings)
         self.search_service = SearchService(self.indices_service)
@@ -78,11 +88,22 @@ class Node:
             # roles alone enable anonymous access; the principal name
             # defaults like the reference's AnonymousUser
             anon_user = "_anonymous"
+        # bootstrap.password is a SECURE setting: keystore-only in the
+        # reference (ref: ReservedRealm BOOTSTRAP_ELASTIC_PASSWORD); the
+        # plain-settings fallback stays for compatibility but the
+        # keystore value wins and plain+keystore together is an error
+        from elasticsearch_tpu.common.keystore import secure_setting
+        boot_pw_setting = secure_setting("bootstrap.password",
+                                         consistent=True)
+        if self.keystore is not None and self.keystore.has(
+                "bootstrap.password"):
+            boot_pw = boot_pw_setting.get(settings, self.keystore)
+        else:
+            boot_pw = str(settings.get("bootstrap.password", "changeme"))
         self.security_service = SecurityService(
             self.data_path,
             enabled=bool(settings.get("xpack.security.enabled", False)),
-            bootstrap_password=str(
-                settings.get("bootstrap.password", "changeme")),
+            bootstrap_password=boot_pw,
             anonymous_username=anon_user,
             anonymous_roles=anon_roles)
         from elasticsearch_tpu.xpack.sql import SqlService

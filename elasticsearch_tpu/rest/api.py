@@ -259,6 +259,8 @@ def _register_all(c: RestController):
     c.register("GET", "/_xpack", xpack_info)
     c.register("GET", "/_license", license_info)
     c.register("GET", "/_nodes/hot_threads", hot_threads)
+    c.register("POST", "/_nodes/reload_secure_settings",
+               reload_secure_settings)
     c.register("GET", "/_migration/deprecations", deprecations)
     c.register("PUT", "/_autoscaling/policy/{name}", autoscaling_put)
     c.register("GET", "/_autoscaling/policy/{name}", autoscaling_get)
@@ -2575,6 +2577,29 @@ def cat_tasks(node, params, body):
 
 def cat_nodeattrs(node, params, body):
     return 200, {"_cat": f"{node.name} 127.0.0.1 127.0.0.1 - -"}
+
+
+def reload_secure_settings(node, params, body):
+    """POST /_nodes/reload_secure_settings — re-read the keystore from
+    disk (ref: action/admin/cluster/node/reload/
+    TransportNodesReloadSecureSettingsAction). Accepts an optional
+    {"secure_settings_password": "..."} body."""
+    password = (body or {}).get("secure_settings_password",
+                                os.environ.get("ES_KEYSTORE_PASSPHRASE", ""))
+    result = {"name": node.name, "reload_exception": None}
+    if node.keystore is not None:
+        try:
+            node.keystore.load(password)
+        except Exception as e:   # noqa: BLE001 — reported per-node, as ref
+            result["reload_exception"] = {
+                "type": type(e).__name__, "reason": str(e)}
+    return 200, {
+        "_nodes": {"total": 1, "successful":
+                   0 if result["reload_exception"] else 1, "failed":
+                   1 if result["reload_exception"] else 0},
+        "cluster_name": node.cluster_name,
+        "nodes": {node.node_id: result},
+    }
 
 
 def nodes_info(node, params, body):

@@ -1,0 +1,194 @@
+"""Secure-settings keystore + consistent-settings tests (ref:
+KeyStoreWrapperTests, ConsistentSettingsServiceTests)."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.errors import SettingsException
+from elasticsearch_tpu.common.keystore import (
+    SEED_SETTING,
+    ConsistentSettingsService,
+    KeyStore,
+    SecureSetting,
+    main as keystore_cli,
+)
+from elasticsearch_tpu.common.settings import Settings
+
+
+def test_create_load_roundtrip(tmp_path):
+    path = str(tmp_path / "elasticsearch.keystore")
+    ks = KeyStore.create(path, "s3cret")
+    ks.set_string("xpack.security.token.key", "hunter2")
+    ks.save("s3cret")
+
+    loaded = KeyStore(path).load("s3cret")
+    assert loaded.get_string("xpack.security.token.key") == "hunter2"
+    assert loaded.has(SEED_SETTING)          # auto-seeded, as the reference
+    assert "xpack.security.token.key" in loaded.setting_names()
+
+
+def test_wrong_password_rejected(tmp_path):
+    path = str(tmp_path / "ks")
+    KeyStore.create(path, "right")
+    with pytest.raises(SettingsException, match="incorrect|corrupted"):
+        KeyStore(path).load("wrong")
+
+
+def test_tamper_detected(tmp_path):
+    path = str(tmp_path / "ks")
+    KeyStore.create(path, "")
+    with open(path) as f:
+        env = json.load(f)
+    ct = bytearray.fromhex("00") * 4
+    import base64
+    raw = bytearray(base64.b64decode(env["ciphertext"]))
+    raw[0] ^= 0xFF
+    env["ciphertext"] = base64.b64encode(bytes(raw)).decode()
+    with open(path, "w") as f:
+        json.dump(env, f)
+    with pytest.raises(SettingsException, match="corrupted|incorrect"):
+        KeyStore(path).load("")
+    assert ct is not None
+
+
+def test_values_encrypted_at_rest(tmp_path):
+    path = str(tmp_path / "ks")
+    ks = KeyStore.create(path, "pw")
+    ks.set_string("cloud.secret", "super-sensitive-value")
+    ks.save("pw")
+    blob = open(path, "rb").read()
+    assert b"super-sensitive-value" not in blob
+    assert b"cloud.secret" not in blob
+
+
+def test_secure_setting_refuses_plain_settings(tmp_path):
+    s = SecureSetting("repo.s3.client.secret_key")
+    settings = Settings.from_dict({"repo": {"s3": {"client": {
+        "secret_key": "leaked"}}}})
+    with pytest.raises(SettingsException, match="secure setting"):
+        s.get(settings, None)
+    ks = KeyStore.create(str(tmp_path / "ks"), "")
+    ks.set_string("repo.s3.client.secret_key", "ok-value")
+    assert s.get(Settings.EMPTY, ks) == "ok-value"
+
+
+def test_consistent_hashes_match_and_mismatch(tmp_path):
+    a = KeyStore.create(str(tmp_path / "a"), "")
+    b = KeyStore.create(str(tmp_path / "b"), "")
+    a.set_string("secret.shared", "same-value")
+    b.set_string("secret.shared", "same-value")
+    svc_a = ConsistentSettingsService(a, ["secret.shared"])
+    svc_b = ConsistentSettingsService(b, ["secret.shared"])
+    published = svc_a.compute_hashes()
+    assert "secret.shared" in published
+    assert svc_b.verify(published) is None
+
+    b.set_string("secret.shared", "DIFFERENT")
+    assert "does NOT match" in svc_b.verify(published)
+
+    b.remove("secret.shared")
+    assert "missing" in svc_b.verify(published)
+
+
+def test_cli(tmp_path, capsys):
+    path = str(tmp_path / "cli.keystore")
+    assert keystore_cli(["create", "--path", path, "--password", "pw"]) == 0
+    assert keystore_cli(["add", "my.setting", "v1", "--path", path,
+                         "--password", "pw"]) == 0
+    assert keystore_cli(["list", "--path", path, "--password", "pw"]) == 0
+    out = capsys.readouterr().out
+    assert "my.setting" in out
+    assert keystore_cli(["show", "my.setting", "--path", path,
+                         "--password", "pw"]) == 0
+    assert "v1" in capsys.readouterr().out
+
+
+def test_node_prefers_keystore_bootstrap_password(tmp_path):
+    from elasticsearch_tpu.common.keystore import KEYSTORE_FILENAME
+    from elasticsearch_tpu.node import Node
+
+    data = tmp_path / "node"
+    data.mkdir()
+    ks = KeyStore.create(str(data / KEYSTORE_FILENAME), "")
+    ks.set_string("bootstrap.password", "from-keystore")
+    ks.save("")
+    node = Node(data_path=str(data))
+    try:
+        assert node.keystore is not None
+        import base64
+        auth = "Basic " + base64.b64encode(
+            b"elastic:from-keystore").decode()
+        user = node.security_service.authenticate(
+            {"Authorization": auth})
+        assert user.username == "elastic"
+        st, resp = node.rest_controller.dispatch(
+            "POST", "/_nodes/reload_secure_settings", None, {})
+        assert st == 200 and resp["_nodes"]["successful"] == 1
+    finally:
+        node.close()
+
+
+# ---------------------------------------------------------------------------
+# cluster: a node whose keystore disagrees must fail its join
+# ---------------------------------------------------------------------------
+
+def _mk_keystore(tmp_path, name, value):
+    ks = KeyStore.create(str(tmp_path / f"{name}.keystore"), "")
+    ks.set_string("bootstrap.password", value)
+    ks.save("")
+    return ks
+
+
+def test_mismatched_keystore_fails_join(tmp_path):
+    from elasticsearch_tpu.cluster.node import ClusterNode
+    from elasticsearch_tpu.common import keystore as ks_mod
+    from elasticsearch_tpu.testing.deterministic import (
+        DeterministicTaskQueue,
+        DisruptableTransport,
+        SimNetwork,
+    )
+    from elasticsearch_tpu.transport.transport import DiscoveryNode
+
+    ks_mod.secure_setting("bootstrap.password", consistent=True)
+    queue = DeterministicTaskQueue(seed=7)
+    network = SimNetwork(queue)
+    n0 = DiscoveryNode(node_id="dn-0", name="dn0")
+    n1 = DiscoveryNode(node_id="dn-1", name="dn1")
+
+    cn0 = ClusterNode(
+        DisruptableTransport(n0, network), queue,
+        data_path=str(tmp_path / "dn0"),
+        seed_nodes=[n0], initial_master_nodes=["dn0"],
+        rng=queue.random,
+        keystore=_mk_keystore(tmp_path, "a", "shared-secret"))
+    cn0.start()
+    queue.run_for(60)
+    assert cn0.is_master()
+    assert (cn0.state.metadata.hashes_of_consistent_settings
+            .get("bootstrap.password"))
+
+    # matching keystore joins fine
+    cn1 = ClusterNode(
+        DisruptableTransport(n1, network), queue,
+        data_path=str(tmp_path / "dn1"),
+        seed_nodes=[n0], initial_master_nodes=[],
+        rng=queue.random,
+        keystore=_mk_keystore(tmp_path, "b", "shared-secret"))
+    cn1.start()
+    queue.run_for(60)
+    assert "dn-1" in cn0.state.nodes
+
+    # mismatched keystore: join must be refused
+    n2 = DiscoveryNode(node_id="dn-2", name="dn2")
+    cn2 = ClusterNode(
+        DisruptableTransport(n2, network), queue,
+        data_path=str(tmp_path / "dn2"),
+        seed_nodes=[n0], initial_master_nodes=[],
+        rng=queue.random,
+        keystore=_mk_keystore(tmp_path, "c", "WRONG-secret"))
+    cn2.start()
+    queue.run_for(120)
+    assert "dn-2" not in cn0.state.nodes
+    for cn in (cn0, cn1, cn2):
+        cn.stop()
