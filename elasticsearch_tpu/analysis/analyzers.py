@@ -101,7 +101,18 @@ class CustomAnalyzer(Analyzer):
         return tokens
 
 
+# plugin-contributed named analyzers (ref: AnalysisPlugin.getAnalyzers):
+# {name: zero-arg factory -> Analyzer}
+PLUGIN_ANALYZERS: Dict[str, Any] = {}
+
+
 def _prebuilt_analyzers() -> Dict[str, Analyzer]:
+    out = {name: factory() for name, factory in PLUGIN_ANALYZERS.items()}
+    out.update(_builtin_analyzers())
+    return out
+
+
+def _builtin_analyzers() -> Dict[str, Analyzer]:
     return {
         # ref: Lucene StandardAnalyzer — ES default has NO stopwords
         "standard": CustomAnalyzer("standard", StandardTokenizer(), [LowercaseFilter()]),
@@ -171,9 +182,9 @@ _TOKEN_FILTERS = {
         s.get("preserve_original", False) in (True, "true")),
     "cjk_bigram": lambda s: CjkBigramFilter(
         s.get("output_unigrams", False) in (True, "true")),
-    "phonetic": lambda s: PhoneticFilter(
-        s.get("encoder", "metaphone"),
-        s.get("replace", True) in (True, "true")),
+    # "phonetic" intentionally absent: it ships as the installable
+    # plugins_src/analysis_phonetic plugin, mirroring the reference's
+    # plugins/analysis-phonetic packaging (plugin SPI proof)
 }
 
 _CHAR_FILTERS = {
@@ -202,9 +213,18 @@ class AnalysisRegistry:
         self._analyzers: Dict[str, Analyzer] = _prebuilt_analyzers()
         self._build_custom(index_settings)
 
+    @staticmethod
+    def _groups(settings: Settings, group: str):
+        # the reference normalizes index settings so "analysis.X" and
+        # "index.analysis.X" are the same key (IndexScopedSettings
+        # prefixing); REST bodies usually write the short form
+        out = dict(settings.groups(f"analysis.{group}"))
+        out.update(settings.groups(f"index.analysis.{group}"))
+        return out
+
     def _named_components(self, settings: Settings, group: str, registry: dict):
         out = {}
-        for name, conf in settings.groups(f"index.analysis.{group}").items():
+        for name, conf in self._groups(settings, group).items():
             type_ = conf.get("type", name)
             factory = registry.get(type_)
             if factory is None:
@@ -223,7 +243,7 @@ class AnalysisRegistry:
         self.named_filters = custom_filters
         self.named_char_filters = custom_char_filters
 
-        for name, conf in settings.groups("index.analysis.analyzer").items():
+        for name, conf in self._groups(settings, "analyzer").items():
             type_ = conf.get("type", "custom")
             if type_ != "custom":
                 if type_ not in self._analyzers:

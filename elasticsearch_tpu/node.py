@@ -138,6 +138,15 @@ class Node:
         self.request_context = threading.local()
         self.rest_controller = RestController(self)
         self._http: Optional[HttpServer] = None
+        # plugin loading + wiring (ref: node/Node.java:318-320 —
+        # PluginsService construction feeds every registry; REST routes
+        # and start hooks attach once the controller exists)
+        from elasticsearch_tpu.plugins import PluginsService
+        plugin_dir = settings.get("path.plugins") or os.path.join(
+            self.data_path, "plugins")
+        self.plugins_service = PluginsService(plugin_dir)
+        self.plugins_service.load_all()
+        self.plugins_service.wire_node(self)
 
     def start(self, port: Optional[int] = None) -> int:
         """Bind HTTP; returns the bound port (0 → ephemeral)."""

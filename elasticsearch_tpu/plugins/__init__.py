@@ -1,0 +1,236 @@
+"""Plugin SPI: external extension loading.
+
+The analogue of the reference's plugin system (ref: plugins/Plugin.java —
+extension-point interfaces SearchPlugin/AnalysisPlugin/IngestPlugin/
+MapperPlugin/RepositoryPlugin/ActionPlugin; plugins/PluginsService.java —
+discovery + classloading, wired at node/Node.java:318-320).
+
+Two discovery mechanisms:
+- **Plugin directory** (the reference's `bin/elasticsearch-plugin install`
+  layout): ``{plugin_dir}/{name}/plugin.json`` with
+  ``{"name": ..., "module": ..., "class": ...}`` next to the plugin's
+  Python sources; the directory goes on ``sys.path`` and the class is
+  instantiated (the classloader-per-plugin analogue).
+- **Entry points** (the Python-native channel): installed distributions
+  exposing the ``elasticsearch_tpu.plugins`` entry-point group.
+
+A plugin subclasses :class:`Plugin` and returns registrations from the
+extension-point methods; :func:`apply_plugin` installs them into the
+engine's registries (query parsers, analysis components, ingest
+processors, aggregations, field mappers, repository types, REST routes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+ENTRY_POINT_GROUP = "elasticsearch_tpu.plugins"
+
+
+class Plugin:
+    """Extension-point surface (ref: Plugin.java and the *Plugin
+    interfaces in server/src/main/java/org/elasticsearch/plugins/)."""
+
+    name: str = "unnamed"
+
+    # SearchPlugin.getQueries → {query type: parser(spec) -> QueryBuilder}
+    def queries(self) -> Dict[str, Callable]:
+        return {}
+
+    # SearchPlugin.getAggregations → {agg type: compute fn}
+    def aggregations(self) -> Dict[str, Callable]:
+        return {}
+
+    # AnalysisPlugin.getTokenFilters / getTokenizers / getCharFilters /
+    # getAnalyzers → {name: factory(settings-ish) -> component}
+    def token_filters(self) -> Dict[str, Callable]:
+        return {}
+
+    def tokenizers(self) -> Dict[str, Callable]:
+        return {}
+
+    def char_filters(self) -> Dict[str, Callable]:
+        return {}
+
+    def analyzers(self) -> Dict[str, Callable]:
+        return {}
+
+    # IngestPlugin.getProcessors → {type: factory(cfg, service) -> fn}
+    def ingest_processors(self) -> Dict[str, Callable]:
+        return {}
+
+    # MapperPlugin.getMappers → {type: FieldType class}
+    def mappers(self) -> Dict[str, Any]:
+        return {}
+
+    # RepositoryPlugin.getRepositories → {type: factory}
+    def repository_types(self) -> Dict[str, Callable]:
+        return {}
+
+    # ActionPlugin.getRestHandlers → [(method, path, handler)]
+    def rest_handlers(self) -> List[Tuple[str, str, Callable]]:
+        return []
+
+    # lifecycle hook (Plugin#createComponents-ish)
+    def on_node_start(self, node) -> None:
+        pass
+
+
+class PluginInfo:
+    def __init__(self, name: str, plugin: Plugin, source: str):
+        self.name = name
+        self.plugin = plugin
+        self.source = source
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "source": self.source,
+                "classname": type(self.plugin).__name__}
+
+
+def apply_plugin(plugin: Plugin) -> None:
+    """Install a plugin's registrations into the engine registries —
+    the moment the reference performs via registry builders during
+    Node construction (ref: SearchModule/AnalysisModule/IngestService
+    constructors consuming plugin lists)."""
+    from elasticsearch_tpu.search import queries as q
+    for qtype, parser in plugin.queries().items():
+        q._PARSERS[qtype] = parser
+
+    from elasticsearch_tpu.search import aggregations as aggs
+    for atype, fn in plugin.aggregations().items():
+        aggs.PLUGIN_AGGS[atype] = fn
+
+    from elasticsearch_tpu.analysis import analyzers as an
+    an._TOKEN_FILTERS.update(plugin.token_filters())
+    an._TOKENIZERS.update(plugin.tokenizers())
+    an._CHAR_FILTERS.update(plugin.char_filters())
+    for name, factory in plugin.analyzers().items():
+        an.PLUGIN_ANALYZERS[name] = factory
+
+    from elasticsearch_tpu.ingest import service as ingest
+    for ptype, factory in plugin.ingest_processors().items():
+        ingest._PROCESSOR_FACTORIES[ptype] = factory
+
+    from elasticsearch_tpu.index import mapper
+    for mtype, cls in plugin.mappers().items():
+        mapper.FIELD_TYPES[mtype] = cls
+
+    from elasticsearch_tpu.repositories import blobstore
+    for rtype, factory in plugin.repository_types().items():
+        blobstore.REPOSITORY_TYPES[rtype] = factory
+
+
+class PluginsService:
+    """Discovery + lifecycle (ref: PluginsService.java)."""
+
+    def __init__(self, plugin_dir: Optional[str] = None):
+        self.plugin_dir = plugin_dir
+        self.plugins: List[PluginInfo] = []
+
+    # ------------------------------------------------------------ loading
+    def load_all(self) -> List[PluginInfo]:
+        if self.plugin_dir and os.path.isdir(self.plugin_dir):
+            for name in sorted(os.listdir(self.plugin_dir)):
+                pdir = os.path.join(self.plugin_dir, name)
+                desc = os.path.join(pdir, "plugin.json")
+                if os.path.isfile(desc):
+                    self._load_dir_plugin(pdir, desc)
+        self._load_entry_points()
+        for info in self.plugins:
+            apply_plugin(info.plugin)
+        return self.plugins
+
+    def _load_dir_plugin(self, pdir: str, desc_path: str) -> None:
+        with open(desc_path, "r", encoding="utf-8") as f:
+            desc = json.load(f)
+        module_name = desc["module"]
+        class_name = desc.get("class", "ESPlugin")
+        if pdir not in sys.path:
+            sys.path.insert(0, pdir)
+        try:
+            mod = __import__(module_name, fromlist=[class_name])
+            cls = getattr(mod, class_name)
+            plugin = cls()
+            plugin.name = desc.get("name", plugin.name)
+            self.plugins.append(PluginInfo(plugin.name, plugin,
+                                           f"dir:{pdir}"))
+        except Exception as e:
+            raise RuntimeError(
+                f"failed to load plugin from [{pdir}]: {e}") from e
+
+    def _load_entry_points(self) -> None:
+        try:
+            from importlib.metadata import entry_points
+        except ImportError:   # pragma: no cover
+            return
+        try:
+            eps = entry_points(group=ENTRY_POINT_GROUP)
+        except TypeError:     # pragma: no cover — legacy API
+            eps = entry_points().get(ENTRY_POINT_GROUP, [])
+        for ep in eps:
+            cls = ep.load()
+            plugin = cls()
+            self.plugins.append(PluginInfo(
+                getattr(plugin, "name", ep.name), plugin,
+                f"entry_point:{ep.name}"))
+
+    # ---------------------------------------------------------- lifecycle
+    def wire_node(self, node) -> None:
+        """REST routes + start hooks (called after the node's controller
+        exists)."""
+        for info in self.plugins:
+            for method, path, handler in info.plugin.rest_handlers():
+                node.rest_controller.register(method, path, handler)
+            info.plugin.on_node_start(node)
+
+    def info(self) -> List[Dict[str, Any]]:
+        return [p.to_dict() for p in self.plugins]
+
+
+# ---------------------------------------------------------------------------
+# CLI — the `elasticsearch-plugin` tool analogue
+# (ref: distribution/tools/plugin-cli/.../InstallPluginCommand.java)
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import shutil
+
+    p = argparse.ArgumentParser(prog="estpu-plugin")
+    p.add_argument("command", choices=["install", "remove", "list"])
+    p.add_argument("target", nargs="?",
+                   help="plugin source dir (install) or name (remove)")
+    p.add_argument("--plugins-dir", required=True)
+    args = p.parse_args(argv)
+    os.makedirs(args.plugins_dir, exist_ok=True)
+
+    if args.command == "install":
+        desc = os.path.join(args.target, "plugin.json")
+        if not os.path.isfile(desc):
+            p.error(f"no plugin.json in {args.target}")
+        with open(desc, "r", encoding="utf-8") as f:
+            name = json.load(f)["name"]
+        dest = os.path.join(args.plugins_dir, name)
+        if os.path.exists(dest):
+            p.error(f"plugin [{name}] already installed")
+        shutil.copytree(args.target, dest)
+        print(f"-> Installed {name}")
+    elif args.command == "remove":
+        dest = os.path.join(args.plugins_dir, args.target)
+        if not os.path.isdir(dest):
+            p.error(f"plugin [{args.target}] not found")
+        shutil.rmtree(dest)
+        print(f"-> Removed {args.target}")
+    else:
+        for name in sorted(os.listdir(args.plugins_dir)):
+            if os.path.isfile(os.path.join(args.plugins_dir, name,
+                                           "plugin.json")):
+                print(name)
+    return 0
+
+
+if __name__ == "__main__":   # pragma: no cover
+    raise SystemExit(main())

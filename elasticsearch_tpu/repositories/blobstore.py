@@ -125,6 +125,11 @@ class FsBlobStore:
 SHARD_FILES = ("meta.json", "arrays.npz", "stored.bin")
 
 
+# plugin-contributed repository backends (ref: RepositoryPlugin):
+# {type: factory(name, config, data_path) -> repository}
+REPOSITORY_TYPES: Dict[str, Any] = {}
+
+
 class BlobStoreRepository:
     """One registered snapshot repository over a blob store."""
 
@@ -405,6 +410,13 @@ class RepositoriesService:
     def _register(self, name: str, config: Dict[str, Any]):
         rtype = config.get("type")
         settings = config.get("settings", {})
+        if rtype in REPOSITORY_TYPES:
+            # plugin-contributed backend (ref: RepositoryPlugin
+            # .getRepositories): factory(name, config, data_path)
+            self._repos[name] = REPOSITORY_TYPES[rtype](
+                name, config, self._data_path)
+            self._configs[name] = config
+            return
         if rtype not in ("fs", "url"):
             raise RepositoryException(
                 f"repository type [{rtype}] does not exist")

@@ -103,12 +103,20 @@ def _split_node(name, node):
             f"Expected exactly one aggregation type under [{name}], "
             f"got {types}")
     agg_type = types[0]
-    if agg_type not in METRIC_AGGS | BUCKET_AGGS | PIPELINE_AGGS:
+    if agg_type not in METRIC_AGGS | BUCKET_AGGS | PIPELINE_AGGS \
+            and agg_type not in PLUGIN_AGGS:
         raise ParsingException(f"Unknown aggregation type [{agg_type}]")
     return agg_type, node[agg_type] or {}, sub
 
 
+# plugin-contributed aggregations (ref: SearchPlugin.getAggregations):
+# {type: fn(body, sub_spec, ctx, mapper) -> result dict}
+PLUGIN_AGGS: Dict[str, Any] = {}
+
+
 def _compute_one(agg_type, body, sub, ctx, mapper):
+    if agg_type in PLUGIN_AGGS:
+        return PLUGIN_AGGS[agg_type](body, sub, ctx, mapper)
     if agg_type in METRIC_AGGS:
         return _metric(agg_type, body, ctx, mapper)
     return _bucket(agg_type, body, sub, ctx, mapper)

@@ -1,0 +1,23 @@
+"""analysis-phonetic plugin — the proof external plugin.
+
+The reference ships phonetic analysis as an installable plugin
+(ref: plugins/analysis-phonetic/.../AnalysisPhoneticPlugin.java —
+registers ONE token filter factory, "phonetic"); this mirrors that
+packaging: the encoder implementations live in the engine's analysis
+library, the REGISTRATION lives here and only activates when the plugin
+is installed into a node's plugin directory.
+"""
+
+from elasticsearch_tpu.analysis.filters import PhoneticFilter
+from elasticsearch_tpu.plugins import Plugin
+
+
+class ESPlugin(Plugin):
+    name = "analysis-phonetic"
+
+    def token_filters(self):
+        return {
+            "phonetic": lambda s: PhoneticFilter(
+                s.get("encoder", "metaphone"),
+                s.get("replace", True) in (True, "true")),
+        }
