@@ -1,0 +1,304 @@
+"""Incremental, corruption-safe persisted cluster state.
+
+The analogue of the reference's gateway metadata store (ref:
+gateway/PersistedClusterStateService.java:117,172-193 — a Lucene index
+holding one document per index metadata plus a global doc, updated
+INCREMENTALLY so a state publish rewrites only what changed, committed
+with fsync discipline, and recovered by reading the last commit).
+
+Design here: an append-only framed log with commit barriers.
+
+- Records are ``[u32 len][u32 crc32][payload json]``; types:
+  ``full``   — complete serialized ClusterState (generation base)
+  ``term``   — current term bump
+  ``index``  — one index's metadata (upsert by name)
+  ``rmindex``— index removal
+  ``global`` — everything in the state EXCEPT per-index metadata
+  ``commit`` — barrier carrying (term, version): all records since the
+               previous barrier become visible atomically
+- A publish appends only the CHANGED index docs + the global doc (when
+  changed) + one commit, then fsyncs once — incremental like the
+  reference's per-doc Lucene updates.
+- Recovery replays the latest generation up to the LAST VALID commit:
+  a torn tail (truncated frame, CRC mismatch, missing commit) rolls
+  back to the previous barrier, so a kill -9 during publish can never
+  lose a previously committed state.
+- When the log exceeds ``rotate_bytes`` the store writes a new
+  generation file starting from a ``full`` record, fsyncs file + dir,
+  then removes older generations (the Lucene-commit + segment-merge
+  analogue).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.cluster.coordination import PersistedState
+from elasticsearch_tpu.cluster.state import ClusterState
+
+_FRAME = struct.Struct(">II")
+
+
+def _append_record(f, rtype: str, payload: Dict[str, Any]) -> int:
+    body = json.dumps({"t": rtype, "p": payload},
+                      separators=(",", ":")).encode("utf-8")
+    f.write(_FRAME.pack(len(body), zlib.crc32(body) & 0xFFFFFFFF))
+    f.write(body)
+    return _FRAME.size + len(body)
+
+
+def _read_records(path: str):
+    """Yield (rtype, payload, end_offset) for every intact record; stop
+    silently at the first torn/corrupt frame (the recovery contract)."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except FileNotFoundError:
+        return
+    off = 0
+    n = len(data)
+    while off + _FRAME.size <= n:
+        length, crc = _FRAME.unpack_from(data, off)
+        start = off + _FRAME.size
+        end = start + length
+        if end > n:
+            return                      # torn tail
+        body = data[start:end]
+        if zlib.crc32(body) & 0xFFFFFFFF != crc:
+            return                      # corrupt frame: stop replay here
+        try:
+            rec = json.loads(body.decode("utf-8"))
+        except ValueError:
+            return
+        yield rec.get("t"), rec.get("p"), end
+        off = end
+
+
+class PersistedClusterStateStore:
+    """The on-disk store. One live generation file ``meta-<gen>.log``
+    under ``<dir>/_state``."""
+
+    def __init__(self, data_path: str, rotate_bytes: int = 4 * 1024 * 1024):
+        self.dir = os.path.join(data_path, "_state")
+        os.makedirs(self.dir, exist_ok=True)
+        self.rotate_bytes = rotate_bytes
+        self._f = None
+        self._size = 0
+        self._gen = 0
+        self._term = 0
+        self._state: Optional[ClusterState] = None
+        # the per-index docs as last WRITTEN (for diffing)
+        self._written_indices: Dict[str, Any] = {}
+        self._written_global: Optional[str] = None
+        self._load()
+
+    # ------------------------------------------------------------ loading
+    def _generations(self) -> List[int]:
+        gens = []
+        for name in os.listdir(self.dir):
+            if name.startswith("meta-") and name.endswith(".log"):
+                try:
+                    gens.append(int(name[5:-4]))
+                except ValueError:
+                    pass
+        return sorted(gens)
+
+    def _gen_path(self, gen: int) -> str:
+        return os.path.join(self.dir, f"meta-{gen}.log")
+
+    def _load(self) -> None:
+        gens = self._generations()
+        for gen in reversed(gens):
+            ok = self._replay(self._gen_path(gen))
+            if ok:
+                self._gen = gen
+                break
+        else:
+            self._gen = gens[-1] if gens else 0
+        self._open_for_append()
+
+    def _replay(self, path: str) -> bool:
+        """Apply records up to the last valid commit. Returns True if at
+        least one commit was seen (generation usable). The file is then
+        TRUNCATED to that commit's byte offset: appending after a torn
+        tail without truncating would leave every later record hidden
+        behind the corrupt frame on the next replay."""
+        term = 0
+        state_d: Optional[Dict[str, Any]] = None
+        indices: Dict[str, Any] = {}
+        global_d: Optional[str] = None
+        committed = None   # (term, state_d, indices, global_d) snapshot
+        commit_off = 0
+        for rtype, payload, end in _read_records(path):
+            if rtype == "full":
+                state_d = payload
+                indices = dict(payload.get("metadata", {})
+                               .get("indices", {}))
+                global_d = None
+            elif rtype == "term":
+                term = int(payload["term"])
+            elif rtype == "index":
+                indices[payload["name"]] = payload["imd"]
+            elif rtype == "rmindex":
+                indices.pop(payload["name"], None)
+            elif rtype == "global":
+                global_d = payload["state"]
+            elif rtype == "commit":
+                committed = (term, state_d, dict(indices), global_d)
+                commit_off = end
+        if committed is None:
+            return False
+        if os.path.getsize(path) > commit_off:
+            with open(path, "r+b") as f:
+                f.truncate(commit_off)
+                f.flush()
+                os.fsync(f.fileno())
+        term, state_d, indices, global_d = committed
+        base = json.loads(global_d) if global_d is not None else state_d
+        if base is None:
+            return False
+        base = dict(base)
+        md = dict(base.get("metadata", {}))
+        md["indices"] = indices
+        base["metadata"] = md
+        self._term = term
+        self._state = ClusterState.from_dict(base)
+        self._written_indices = {
+            name: json.dumps(imd, sort_keys=True)
+            for name, imd in indices.items()}
+        self._written_global = json.dumps(
+            self._strip_indices(base), sort_keys=True)
+        return True
+
+    @staticmethod
+    def _strip_indices(state_d: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(state_d)
+        md = dict(out.get("metadata", {}))
+        md["indices"] = {}
+        out["metadata"] = md
+        return out
+
+    # ------------------------------------------------------------ writing
+    def _open_for_append(self) -> None:
+        path = self._gen_path(self._gen)
+        self._f = open(path, "ab")
+        self._size = self._f.tell()
+
+    def _fsync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def _fsync_dir(self) -> None:
+        fd = os.open(self.dir, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def current_term(self) -> int:
+        return self._term
+
+    def last_accepted_state(self) -> Optional[ClusterState]:
+        return self._state
+
+    def set_current_term(self, term: int) -> None:
+        self._term = term
+        self._size += _append_record(self._f, "term", {"term": term})
+        self._size += _append_record(
+            self._f, "commit",
+            {"term": term,
+             "version": self._state.version if self._state else 0})
+        self._fsync()
+        self._maybe_rotate()
+
+    def set_last_accepted_state(self, state: ClusterState) -> None:
+        """Incremental publish write: changed index docs + changed global
+        doc + commit barrier, ONE fsync (ref: the reference updates only
+        dirty metadata documents per publication)."""
+        state_d = state.to_dict()
+        new_indices = {
+            name: json.dumps(imd, sort_keys=True)
+            for name, imd in state_d.get("metadata", {})
+            .get("indices", {}).items()}
+        wrote = 0
+        for name, doc in new_indices.items():
+            if self._written_indices.get(name) != doc:
+                wrote += _append_record(self._f, "index",
+                                        {"name": name,
+                                         "imd": json.loads(doc)})
+        for name in self._written_indices:
+            if name not in new_indices:
+                wrote += _append_record(self._f, "rmindex", {"name": name})
+        global_doc = json.dumps(self._strip_indices(state_d),
+                                sort_keys=True)
+        if global_doc != self._written_global:
+            wrote += _append_record(self._f, "global",
+                                    {"state": global_doc})
+        wrote += _append_record(self._f, "commit",
+                                {"term": self._term,
+                                 "version": state.version})
+        self._fsync()
+        self._size += wrote
+        self._state = state
+        self._written_indices = new_indices
+        self._written_global = global_doc
+        self._maybe_rotate()
+
+    # ----------------------------------------------------------- rotation
+    def _maybe_rotate(self) -> None:
+        if self._size < self.rotate_bytes:
+            return
+        new_gen = self._gen + 1
+        path = self._gen_path(new_gen)
+        with open(path, "wb") as f:
+            if self._state is not None:
+                _append_record(f, "full", self._state.to_dict())
+            _append_record(f, "term", {"term": self._term})
+            _append_record(f, "commit",
+                           {"term": self._term,
+                            "version": self._state.version
+                            if self._state else 0})
+            f.flush()
+            os.fsync(f.fileno())
+        self._fsync_dir()
+        old_f, old_gen = self._f, self._gen
+        self._gen = new_gen
+        self._open_for_append()
+        old_f.close()
+        try:
+            os.remove(self._gen_path(old_gen))
+        except OSError:
+            pass
+        self._fsync_dir()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class DurablePersistedState(PersistedState):
+    """Coordinator-facing PersistedState backed by the store (ref:
+    GatewayMetaState wiring the Lucene-backed service under
+    CoordinationState)."""
+
+    def __init__(self, data_path: str, **kw):
+        self.store = PersistedClusterStateStore(data_path, **kw)
+        loaded = self.store.last_accepted_state()
+        super().__init__(term=self.store.current_term(),
+                         accepted=loaded if loaded is not None else None)
+
+    def set_current_term(self, term: int) -> None:
+        self.store.set_current_term(term)
+        super().set_current_term(term)
+
+    def set_last_accepted_state(self, state: ClusterState) -> None:
+        self.store.set_last_accepted_state(state)
+        super().set_last_accepted_state(state)
+
+    def close(self) -> None:
+        self.store.close()

@@ -55,7 +55,7 @@ class ClusterNode:
     def __init__(self, transport, scheduler, data_path: str,
                  seed_nodes: Optional[List[DiscoveryNode]] = None,
                  initial_master_nodes: Optional[List[str]] = None,
-                 rng=None, keystore=None):
+                 rng=None, keystore=None, durable_state: bool = True):
         self.transport = transport
         self.scheduler = scheduler
         self.local_node: DiscoveryNode = transport.local_node
@@ -76,9 +76,18 @@ class ClusterNode:
             from elasticsearch_tpu.common.keystore import (
                 ConsistentSettingsService)
             consistent = ConsistentSettingsService(keystore)
+        # durable (term, accepted state) via the incremental gateway
+        # store (ref: GatewayMetaState → PersistedClusterStateService):
+        # survives restarts and kill -9 mid-publish
+        if durable_state:
+            from elasticsearch_tpu.cluster.gateway import (
+                DurablePersistedState)
+            persisted = DurablePersistedState(data_path)
+        else:
+            persisted = PersistedState()
         self.coordinator = Coordinator(
             transport, scheduler,
-            persisted=PersistedState(),
+            persisted=persisted,
             seed_nodes=seed_nodes,
             initial_master_nodes=initial_master_nodes,
             on_committed_state=self._on_committed_state,
@@ -102,6 +111,10 @@ class ClusterNode:
     def stop(self) -> None:
         self.coordinator.stop()
         self.data_node.close()
+        closer = getattr(self.coordinator.coordination_state.persisted,
+                         "close", None)
+        if closer is not None:
+            closer()
 
     @property
     def state(self) -> ClusterState:

@@ -1,0 +1,208 @@
+"""Incremental persisted cluster state tests (ref:
+PersistedClusterStateServiceTests — incremental writes, fsync/commit
+discipline, torn-write recovery, generation rotation)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster.gateway import (
+    DurablePersistedState,
+    PersistedClusterStateStore,
+)
+from elasticsearch_tpu.cluster.state import (
+    ClusterState,
+    IndexMetadata,
+    Metadata,
+)
+
+
+def mk_state(version, n_indices=3, fat=0):
+    md = Metadata(indices={
+        f"idx{i}": IndexMetadata(index=f"idx{i}", uuid=f"u{i}",
+                                 settings={"pad": "x" * fat})
+        for i in range(n_indices)})
+    return ClusterState(version=version, metadata=md)
+
+
+def log_path(store):
+    return store._gen_path(store._gen)
+
+
+def test_roundtrip_and_restart(tmp_path):
+    store = PersistedClusterStateStore(str(tmp_path))
+    store.set_current_term(3)
+    store.set_last_accepted_state(mk_state(7))
+    store.close()
+
+    store2 = PersistedClusterStateStore(str(tmp_path))
+    assert store2.current_term() == 3
+    st = store2.last_accepted_state()
+    assert st.version == 7
+    assert set(st.metadata.indices) == {"idx0", "idx1", "idx2"}
+    store2.close()
+
+
+def test_incremental_writes_only_changed_index(tmp_path):
+    store = PersistedClusterStateStore(str(tmp_path))
+    base = mk_state(1, n_indices=20, fat=2000)   # ~40KB of index docs
+    store.set_last_accepted_state(base)
+    size_after_full = os.path.getsize(log_path(store))
+
+    # change ONE index's metadata
+    md = base.metadata
+    changed = dict(md.indices)
+    changed["idx0"] = IndexMetadata(index="idx0", uuid="u0",
+                                    number_of_replicas=1,
+                                    settings={"pad": "y" * 2000})
+    st2 = ClusterState(version=2, metadata=Metadata(indices=changed))
+    store.set_last_accepted_state(st2)
+    delta = os.path.getsize(log_path(store)) - size_after_full
+    # one index doc + global doc + commit ≪ the 20-index full write
+    assert delta < size_after_full / 3, (delta, size_after_full)
+    store.close()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_torn_write_never_loses_committed_state(tmp_path, seed):
+    """kill -9 mid-publish: truncate the log at a random point inside
+    the LAST publish's bytes; recovery must return the previous
+    committed state intact."""
+    rng = np.random.default_rng(seed)
+    store = PersistedClusterStateStore(str(tmp_path))
+    store.set_current_term(1)
+    store.set_last_accepted_state(mk_state(5, n_indices=4, fat=300))
+    committed_size = os.path.getsize(log_path(store))
+    path = log_path(store)
+
+    store.set_last_accepted_state(mk_state(6, n_indices=5, fat=300))
+    full_size = os.path.getsize(path)
+    store.close()
+
+    cut = int(rng.integers(committed_size + 1, full_size))
+    with open(path, "r+b") as f:
+        f.truncate(cut)
+        # optionally also corrupt the byte before the cut
+        if seed % 2 and cut > committed_size + 2:
+            f.seek(cut - 1)
+            f.write(b"\xff")
+
+    store2 = PersistedClusterStateStore(str(tmp_path))
+    st = store2.last_accepted_state()
+    assert st is not None and st.version == 5
+    assert set(st.metadata.indices) == {f"idx{i}" for i in range(4)}
+    assert store2.current_term() == 1
+    store2.close()
+
+
+def test_recover_write_restart_keeps_post_recovery_commits(tmp_path):
+    """A torn tail must be TRUNCATED at recovery: states committed after
+    the recovery must survive the NEXT restart (appending behind a
+    corrupt frame would hide them forever)."""
+    store = PersistedClusterStateStore(str(tmp_path))
+    store.set_last_accepted_state(mk_state(5))
+    committed_size = os.path.getsize(log_path(store))
+    path = log_path(store)
+    store.set_last_accepted_state(mk_state(6))
+    store.close()
+    with open(path, "r+b") as f:          # kill -9 mid-publish of v6
+        f.truncate(committed_size + 7)
+
+    store2 = PersistedClusterStateStore(str(tmp_path))
+    assert store2.last_accepted_state().version == 5
+    store2.set_last_accepted_state(mk_state(7))   # durable post-recovery
+    store2.close()
+
+    store3 = PersistedClusterStateStore(str(tmp_path))
+    assert store3.last_accepted_state().version == 7
+    store3.close()
+
+
+def test_corrupt_crc_rolls_back(tmp_path):
+    store = PersistedClusterStateStore(str(tmp_path))
+    store.set_last_accepted_state(mk_state(1))
+    size1 = os.path.getsize(log_path(store))
+    store.set_last_accepted_state(mk_state(2))
+    path = log_path(store)
+    store.close()
+    # flip a byte inside the SECOND publish's frames
+    with open(path, "r+b") as f:
+        f.seek(size1 + 12)
+        b = f.read(1)
+        f.seek(size1 + 12)
+        f.write(bytes([b[0] ^ 0xFF]))
+    store2 = PersistedClusterStateStore(str(tmp_path))
+    assert store2.last_accepted_state().version == 1
+    store2.close()
+
+
+def test_rotation_compacts(tmp_path):
+    store = PersistedClusterStateStore(str(tmp_path), rotate_bytes=20_000)
+    for v in range(1, 30):
+        store.set_last_accepted_state(mk_state(v, n_indices=3, fat=500))
+    # rotated at least once, only ONE generation remains
+    gens = store._generations()
+    assert len(gens) == 1 and gens[0] >= 1
+    assert os.path.getsize(log_path(store)) < 60_000
+    store.close()
+    store2 = PersistedClusterStateStore(str(tmp_path))
+    assert store2.last_accepted_state().version == 29
+    store2.close()
+
+
+def test_durable_persisted_state_restart(tmp_path):
+    d = DurablePersistedState(str(tmp_path))
+    d.set_current_term(4)
+    d.set_last_accepted_state(mk_state(9))
+    d.close()
+    d2 = DurablePersistedState(str(tmp_path))
+    assert d2.current_term() == 4
+    assert d2.last_accepted_state().version == 9
+    d2.close()
+
+
+def test_cluster_node_state_survives_restart(tmp_path):
+    """Sim: a 1-node cluster creates an index, the process 'restarts'
+    (new ClusterNode over the same data path), and the accepted state —
+    term + index metadata — is back (ref: GatewayMetaState recovery)."""
+    from elasticsearch_tpu.cluster.node import ClusterNode
+    from elasticsearch_tpu.testing.deterministic import (
+        DeterministicTaskQueue,
+        DisruptableTransport,
+        SimNetwork,
+    )
+    from elasticsearch_tpu.transport.transport import DiscoveryNode
+
+    queue = DeterministicTaskQueue(seed=3)
+    network = SimNetwork(queue)
+    dn = DiscoveryNode(node_id="g-0", name="g0")
+    cn = ClusterNode(DisruptableTransport(dn, network), queue,
+                     data_path=str(tmp_path / "g0"),
+                     seed_nodes=[dn], initial_master_nodes=["g0"],
+                     rng=queue.random)
+    cn.start()
+    queue.run_for(30)
+    assert cn.is_master()
+    done = {}
+    cn.create_index("survivor", number_of_shards=1, number_of_replicas=0,
+                    on_done=lambda r, err=None: done.update(r=r, e=err))
+    queue.run_for(30)
+    assert done.get("e") is None
+    term = cn.coordinator.current_term()
+    cn.stop()
+
+    queue2 = DeterministicTaskQueue(seed=4)
+    network2 = SimNetwork(queue2)
+    cn2 = ClusterNode(DisruptableTransport(dn, network2), queue2,
+                      data_path=str(tmp_path / "g0"),
+                      seed_nodes=[dn], initial_master_nodes=["g0"],
+                      rng=queue2.random)
+    restored = cn2.coordinator.coordination_state.last_accepted_state()
+    assert "survivor" in restored.metadata.indices
+    assert cn2.coordinator.current_term() >= term
+    cn2.start()
+    queue2.run_for(30)
+    assert cn2.is_master()
+    assert cn2.coordinator.current_term() > term   # new election, new term
+    cn2.stop()
