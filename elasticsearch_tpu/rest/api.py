@@ -269,6 +269,7 @@ def _register_all(c: RestController):
     c.register("GET", "/_autoscaling/capacity", autoscaling_capacity)
     # extended _cat family (ref: rest/action/cat/)
     c.register("GET", "/_cat/nodes", cat_nodes)
+    c.register("GET", "/_cat/plugins", cat_plugins)
     c.register("GET", "/_cat/master", cat_master)
     c.register("GET", "/_cat/allocation", cat_allocation)
     c.register("GET", "/_cat/templates", cat_templates)
@@ -337,6 +338,11 @@ def _register_all(c: RestController):
     c.register("DELETE", "/_ml/anomaly_detectors/{id}", ml_delete_job)
     c.register("POST", "/_ml/anomaly_detectors/{id}/_open", ml_open_job)
     c.register("POST", "/_ml/anomaly_detectors/{id}/_close", ml_close_job)
+    c.register("GET", "/_ml/anomaly_detectors/{id}/model_snapshots",
+               ml_model_snapshots)
+    c.register("POST",
+               "/_ml/anomaly_detectors/{id}/model_snapshots/{sid}/_revert",
+               ml_revert_snapshot)
     c.register("POST", "/_ml/anomaly_detectors/{id}/_data", ml_post_data)
     c.register("GET", "/_ml/anomaly_detectors/{id}/results/buckets",
                ml_get_buckets)
@@ -1977,6 +1983,18 @@ def ml_close_job(node, params, body, id):
     return 200, {"closed": True}
 
 
+def ml_model_snapshots(node, params, body, id):
+    """GET model_snapshots (ref: RestGetModelSnapshotsAction)."""
+    snaps = node.ml_service.model_snapshots(id)
+    return 200, {"count": len(snaps), "model_snapshots": snaps}
+
+
+def ml_revert_snapshot(node, params, body, id, sid):
+    """POST _revert (ref: RestRevertModelSnapshotAction)."""
+    snap = node.ml_service.revert_model_snapshot(id, sid)
+    return 200, {"model": snap}
+
+
 def ml_post_data(node, params, body, id):
     if isinstance(body, list):
         docs = body
@@ -2573,6 +2591,13 @@ def cat_tasks(node, params, body):
         lines.append(f"{t.action} {t.id} - transport "
                      f"{int(t.start_time * 1000)}")
     return 200, {"_cat": "\n".join(lines)}
+
+
+def cat_plugins(node, params, body):
+    """GET /_cat/plugins (ref: rest/action/cat/RestPluginsAction)."""
+    rows = [f"{node.name} {p['name']} - {p['classname']}"
+            for p in node.plugins_service.info()]
+    return 200, {"_cat": "\n".join(rows)}
 
 
 def cat_nodeattrs(node, params, body):

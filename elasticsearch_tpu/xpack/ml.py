@@ -91,6 +91,77 @@ class _Baseline:
         return b
 
 
+class _SeasonalBaseline:
+    """Seasonality-aware baseline: an overall Gaussian plus hour-of-day
+    and day-of-week component Gaussians (ref: ml-cpp's periodic trend
+    decomposition, CTimeSeriesDecomposition — the capability, not the
+    mechanism). Once a calendar component has enough observations, the
+    tail probability is taken against THAT component, so regular daily/
+    weekly swings stop looking anomalous."""
+
+    __slots__ = ("overall", "hod", "dow")
+
+    MIN_COMPONENT_N = 4
+
+    def __init__(self):
+        self.overall = _Baseline()
+        self.hod = [None] * 24     # lazily-created hour-of-day baselines
+        self.dow = [None] * 7
+
+    @staticmethod
+    def _phase(ts_ms: float):
+        sec = ts_ms / 1000.0
+        hour = int(sec // 3600) % 24
+        day = int(sec // 86400 + 4) % 7       # epoch day 0 = Thursday
+        return hour, day
+
+    def _component(self, ts_ms: float):
+        hour, day = self._phase(ts_ms)
+        h = self.hod[hour]
+        if h is not None and h.n >= self.MIN_COMPONENT_N:
+            return h
+        d = self.dow[day]
+        if d is not None and d.n >= self.MIN_COMPONENT_N:
+            return d
+        return self.overall
+
+    def probability(self, x: float, ts_ms: float) -> float:
+        return self._component(ts_ms).probability(x)
+
+    def typical(self, ts_ms: float) -> float:
+        return self._component(ts_ms).mean
+
+    def update(self, x: float, ts_ms: float):
+        hour, day = self._phase(ts_ms)
+        if self.hod[hour] is None:
+            self.hod[hour] = _Baseline()
+        if self.dow[day] is None:
+            self.dow[day] = _Baseline()
+        self.overall.update(x)
+        self.hod[hour].update(x)
+        self.dow[day].update(x)
+
+    def to_dict(self):
+        return {
+            "overall": self.overall.to_dict(),
+            "hod": [b.to_dict() if b else None for b in self.hod],
+            "dow": [b.to_dict() if b else None for b in self.dow],
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        s = cls()
+        if "overall" in d:
+            s.overall = _Baseline.from_dict(d["overall"])
+            s.hod = [_Baseline.from_dict(b) if b else None
+                     for b in d.get("hod", [None] * 24)]
+            s.dow = [_Baseline.from_dict(b) if b else None
+                     for b in d.get("dow", [None] * 7)]
+        else:                        # round-1 snapshot: plain Gaussian
+            s.overall = _Baseline.from_dict(d)
+        return s
+
+
 def _score_from_probability(p: float) -> float:
     """Map a tail probability to a 0-100 anomaly score (the reference's
     log-probability normalization, ml-cpp CAnomalyScore)."""
@@ -117,14 +188,51 @@ class MlJob:
         self.description = config.get("description", "")
         self.state = "closed"
         self.create_time = int(time.time() * 1000)
-        # (detector_idx, entity key) -> _Baseline
-        self.baselines: Dict[str, _Baseline] = {}
+        # (detector_idx, entity key) -> _SeasonalBaseline
+        self.baselines: Dict[str, _SeasonalBaseline] = {}
         # rare function: (detector_idx, by value) -> count, and totals
         self.category_counts: Dict[str, int] = {}
         self.buckets: List[Dict[str, Any]] = []       # bucket results
         self.records: List[Dict[str, Any]] = []       # record results
         self.processed_record_count = 0
         self.latest_record_ts: Optional[float] = None
+        # model snapshots (ref: ModelSnapshot + JobModelSnapshotUpgrader
+        # APIs): serialized baselines, revertable
+        self.model_snapshots: List[Dict[str, Any]] = []
+        self._snapshot_seq = 0
+
+    # ------------------------------------------------- model snapshots
+    def take_snapshot(self, description: str = "") -> Dict[str, Any]:
+        """Serialize the model state (ref: autodetect persisting a
+        ModelSnapshot on close/flush)."""
+        self._snapshot_seq += 1
+        snap = {
+            "job_id": self.job_id,
+            "snapshot_id": str(self._snapshot_seq),
+            "timestamp": int(time.time() * 1000),
+            "description": description,
+            "snapshot_doc_count": len(self.baselines),
+            "model": {
+                "baselines": {k: b.to_dict()
+                              for k, b in self.baselines.items()},
+                "category_counts": dict(self.category_counts),
+            },
+        }
+        self.model_snapshots.append(snap)
+        return snap
+
+    def revert_snapshot(self, snapshot_id: str) -> Dict[str, Any]:
+        for snap in self.model_snapshots:
+            if snap["snapshot_id"] == snapshot_id:
+                model = snap["model"]
+                self.baselines = {
+                    k: _SeasonalBaseline.from_dict(d)
+                    for k, d in model["baselines"].items()}
+                self.category_counts = dict(model["category_counts"])
+                return snap
+        raise ResourceNotFoundException(
+            f"No model snapshot with id [{snapshot_id}] for job "
+            f"[{self.job_id}]")
 
     def config_dict(self) -> Dict[str, Any]:
         return {
@@ -166,8 +274,8 @@ class MlJob:
                 bkey = f"{di}|{key[0]}|{key[1]}"
                 base = self.baselines.get(bkey)
                 if base is None:
-                    base = self.baselines[bkey] = _Baseline()
-                p = base.probability(value)
+                    base = self.baselines[bkey] = _SeasonalBaseline()
+                p = base.probability(value, bucket_start)
                 score = _score_from_probability(p)
                 if score > 0:
                     rec = {
@@ -179,7 +287,7 @@ class MlJob:
                         "record_score": score,
                         "probability": p,
                         "actual": [value],
-                        "typical": [base.mean],
+                        "typical": [base.typical(bucket_start)],
                     }
                     if field:
                         rec["field_name"] = field
@@ -190,7 +298,7 @@ class MlJob:
                         rec["by_field_name"] = by
                         rec["by_field_value"] = key[1]
                     bucket_records.append(rec)
-                base.update(value)
+                base.update(value, bucket_start)
         self.records.extend(bucket_records)
         anomaly_score = max((r["record_score"] for r in bucket_records),
                             default=0.0)
@@ -325,7 +433,25 @@ class MlService:
         self.get_job(job_id).state = "opened"
 
     def close_job(self, job_id: str):
-        self.get_job(job_id).state = "closed"
+        job = self.get_job(job_id)
+        was_open = job.state == "opened"
+        job.state = "closed"
+        # autodetect persists a model snapshot at close (ref:
+        # AutodetectProcessManager.closeJob → persistModelSnapshot);
+        # closing an already-closed job is an idempotent no-op
+        if was_open and (job.baselines or job.category_counts):
+            job.take_snapshot("on close")
+
+    def model_snapshots(self, job_id: str) -> List[Dict[str, Any]]:
+        job = self.get_job(job_id)
+        return [{k: v for k, v in s.items() if k != "model"}
+                for s in job.model_snapshots]
+
+    def revert_model_snapshot(self, job_id: str,
+                              snapshot_id: str) -> Dict[str, Any]:
+        job = self.get_job(job_id)
+        snap = job.revert_snapshot(snapshot_id)
+        return {k: v for k, v in snap.items() if k != "model"}
 
     def post_data(self, job_id: str, docs: List[Dict[str, Any]]):
         """Stream raw documents into an open job (the _data API): docs
@@ -553,9 +679,9 @@ class MlService:
         fields, mat = self._numeric_matrix(train, exclude=dep)
         if classification:
             classes = sorted({str(s[dep]) for s in train})
-            if len(classes) != 2:
+            if len(classes) < 2:
                 raise IllegalArgumentException(
-                    "classification supports exactly two classes")
+                    "classification needs at least two classes")
             y = np.array([classes.index(str(s[dep])) for s in train],
                          np.float32)
         else:
@@ -568,18 +694,28 @@ class MlService:
         xs = jnp.concatenate([xs, jnp.ones((len(train), 1))], axis=1)
         yv = jnp.asarray(y)
         if classification:
-            w = jnp.zeros(xs.shape[1])
+            # multinomial softmax regression; the WHOLE optimizer runs
+            # as one compiled lax.fori_loop (no per-step Python
+            # dispatch — the TPU-idiomatic training loop)
+            nc = len(classes)
+            yi = jnp.asarray(y.astype(np.int32))
 
-            def loss(w):
-                logits = xs @ w
-                return jnp.mean(
-                    jnp.logaddexp(0.0, logits) - yv * logits
-                ) + 1e-3 * jnp.sum(w * w)
+            def loss(W):
+                logits = xs @ W                        # [N, nc]
+                lse = jax.nn.logsumexp(logits, axis=1)
+                picked = jnp.take_along_axis(
+                    logits, yi[:, None], axis=1)[:, 0]
+                return jnp.mean(lse - picked) + 1e-3 * jnp.sum(W * W)
 
-            g = jax.jit(jax.grad(loss))
-            for _ in range(300):
-                w = w - 0.5 * g(w)
-            w = np.asarray(w)
+            grad = jax.grad(loss)
+
+            @jax.jit
+            def fit(W0):
+                def step(_, W):
+                    return W - 0.5 * grad(W)
+                return jax.lax.fori_loop(0, 300, step, W0)
+
+            w = np.asarray(fit(jnp.zeros((xs.shape[1], nc))))
         else:
             # closed-form ridge: (X'X + λI)^-1 X'y
             lam = 1e-3
@@ -608,11 +744,14 @@ class MlService:
                       for f in model["feature_names"]], np.float32)
         xs = (x - np.array(model["mean"])) / np.array(model["std"])
         xs = np.concatenate([xs, [1.0]])
-        v = float(xs @ np.array(model["weights"]))
+        w = np.array(model["weights"])
         if model["model_type"] == "classification":
+            if w.ndim == 2:                   # multinomial softmax head
+                return model["classes"][int(np.argmax(xs @ w))]
+            v = float(xs @ w)                 # legacy binary sigmoid
             p = 1.0 / (1.0 + math.exp(-v))
             return model["classes"][1] if p >= 0.5 else model["classes"][0]
-        return v
+        return float(xs @ w)
 
     # ------------------------------------------------- trained models
     def put_trained_model(self, model_id: str, config: Dict[str, Any]):

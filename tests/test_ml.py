@@ -249,3 +249,123 @@ def test_rare_function(node):
                 "/_ml/anomaly_detectors/rare1/results/records")
     assert recs["count"] >= 1
     assert recs["records"][0]["by_field_value"] == "599"
+
+
+# ------------------------------------------------------- round 2: seasonality
+
+def _periodic_traffic(days=14, spike_day=None):
+    """Hourly request counts with a strong daily cycle: 1000/h at noon,
+    ~50/h at night. Optionally one genuinely anomalous hour."""
+    import math as _m
+    rng = random.Random(11)
+    docs = []
+    for d in range(days):
+        for h in range(24):
+            base = 525 + 475 * _m.sin((h - 6) / 24 * 2 * _m.pi)
+            n = max(1, int(rng.gauss(base, base * 0.08) / 50))
+            ts0 = (d * 24 + h) * 3_600_000
+            for _ in range(n):
+                docs.append({"ts": ts0 + rng.randrange(3_600_000)})
+    if spike_day is not None:
+        ts0 = (spike_day * 24 + 3) * 3_600_000     # 3am: quiet hour
+        for _ in range(40):                        # 40× the usual 3am rate
+            docs.append({"ts": ts0 + rng.randrange(3_600_000)})
+    return docs
+
+
+SEASONAL_JOB = {
+    "analysis_config": {
+        "bucket_span": "1h",
+        "detectors": [{"function": "count"}],
+    },
+    "data_description": {"time_field": "ts"},
+}
+
+
+def test_seasonal_baseline_tolerates_daily_cycle(node):
+    """The daily swing 50↔1000 must NOT alarm once the hour-of-day
+    components matured — the round-1 single-Gaussian model flagged
+    every morning ramp."""
+    call(node, "PUT", "/_ml/anomaly_detectors/season", SEASONAL_JOB)
+    call(node, "POST", "/_ml/anomaly_detectors/season/_open")
+    call(node, "POST", "/_ml/anomaly_detectors/season/_data",
+         _periodic_traffic(days=14))
+    r = call(node, "POST",
+             "/_ml/anomaly_detectors/season/results/records",
+             {"record_score": 50})
+    # after a week of warm-up, the daily ramp to peak (and the peak
+    # itself) is business as usual — the round-1 flat Gaussian flagged
+    # exactly these high-count hours every single day
+    late_ramp = [rec for rec in r["records"]
+                 if rec["timestamp"] >= 10 * 24 * 3_600_000
+                 and rec["actual"][0] >= 100]
+    assert late_ramp == [], late_ramp
+
+
+def test_seasonal_baseline_still_catches_true_anomaly(node):
+    call(node, "PUT", "/_ml/anomaly_detectors/season2", SEASONAL_JOB)
+    call(node, "POST", "/_ml/anomaly_detectors/season2/_open")
+    call(node, "POST", "/_ml/anomaly_detectors/season2/_data",
+         _periodic_traffic(days=14, spike_day=12))
+    r = call(node, "POST",
+             "/_ml/anomaly_detectors/season2/results/records",
+             {"record_score": 50})
+    spike_ts = (12 * 24 + 3) * 3_600_000
+    assert any(rec["timestamp"] == spike_ts for rec in r["records"]), \
+        [rec["timestamp"] for rec in r["records"]][-5:]
+
+
+def test_model_snapshots_and_revert(node):
+    call(node, "PUT", "/_ml/anomaly_detectors/snapjob", JOB)
+    call(node, "POST", "/_ml/anomaly_detectors/snapjob/_open")
+    call(node, "POST", "/_ml/anomaly_detectors/snapjob/_data",
+         _steady_then_spike())
+    call(node, "POST", "/_ml/anomaly_detectors/snapjob/_close")
+    r = call(node, "GET",
+             "/_ml/anomaly_detectors/snapjob/model_snapshots")
+    assert r["count"] == 1
+    sid = r["model_snapshots"][0]["snapshot_id"]
+    assert "model" not in r["model_snapshots"][0]   # bodies stay internal
+
+    # corrupt the live model, then revert restores it
+    job = node.ml_service.get_job("snapjob")
+    saved = {k: b.to_dict() for k, b in job.baselines.items()}
+    job.baselines.clear()
+    call(node, "POST",
+         f"/_ml/anomaly_detectors/snapjob/model_snapshots/{sid}/_revert")
+    assert {k: b.to_dict() for k, b in job.baselines.items()} == saved
+    call(node, "POST",
+         "/_ml/anomaly_detectors/snapjob/model_snapshots/999/_revert",
+         expect=404)
+
+
+def test_multiclass_classification(node):
+    """3-class softmax head trained by the fori_loop optimizer."""
+    rng = random.Random(5)
+    docs = []
+    for i in range(240):
+        c = i % 3
+        docs.append({"f1": rng.gauss([0, 5, -5][c], 0.5),
+                     "f2": rng.gauss([0, 5, 5][c], 0.5),
+                     "label": ["a", "b", "c"][c]})
+    call(node, "PUT", "/t3", {"mappings": {"properties": {
+        "f1": {"type": "float"}, "f2": {"type": "float"},
+        "label": {"type": "keyword"}}}})
+    for i, d in enumerate(docs):
+        call(node, "PUT", f"/t3/_doc/{i}", d, expect=201)
+    call(node, "POST", "/t3/_refresh")
+    call(node, "PUT", "/_ml/data_frame/analytics/cls3", {
+        "source": {"index": "t3"},
+        "dest": {"index": "t3_out"},
+        "analysis": {"classification": {"dependent_variable": "label"}},
+    })
+    call(node, "POST", "/_ml/data_frame/analytics/cls3/_start")
+    call(node, "POST", "/t3_out/_refresh")
+    r = call(node, "POST", "/t3_out/_search",
+             {"size": 300, "query": {"match_all": {}}})
+    hits = r["hits"]["hits"]
+    assert len(hits) == 240
+    good = sum(1 for h in hits
+               if h["_source"]["ml"]["label_prediction"]
+               == h["_source"]["label"])
+    assert good / len(hits) > 0.95, good
