@@ -105,7 +105,11 @@ class Node:
             enabled=bool(settings.get("xpack.security.enabled", False)),
             bootstrap_password=boot_pw,
             anonymous_username=anon_user,
-            anonymous_roles=anon_roles)
+            anonymous_roles=anon_roles,
+            audit_enabled=bool(
+                settings.get("xpack.security.audit.enabled", False)),
+            pki_header_trusted=bool(settings.get(
+                "xpack.security.authc.pki.trust_proxy_header", False)))
         from elasticsearch_tpu.xpack.sql import SqlService
         self.sql_service = SqlService(self)
         from elasticsearch_tpu.xpack.eql import EqlService

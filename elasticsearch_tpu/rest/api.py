@@ -61,15 +61,28 @@ class RestController:
             from elasticsearch_tpu.xpack.security import required_privilege
             try:
                 user = sec.authenticate(headers)
-                kind, priv, index = required_privilege(method, path)
-                if priv != "none":
-                    sec.authorize(user, kind, priv, index)
             except ElasticsearchTpuException as e:
+                sec.audit.authentication_failed(method, path, str(e))
                 return e.status, {
                     "error": {**e.to_xcontent(),
                               "root_cause": [e.to_xcontent()]},
                     "status": e.status,
                 }
+            sec.audit.authentication_success(
+                user, user.authenticated_realm or "__anonymous__",
+                method, path)
+            kind, priv, index = required_privilege(method, path)
+            if priv != "none":
+                try:
+                    sec.authorize(user, kind, priv, index)
+                except ElasticsearchTpuException as e:
+                    sec.audit.access_denied(user, priv, method, path)
+                    return e.status, {
+                        "error": {**e.to_xcontent(),
+                                  "root_cause": [e.to_xcontent()]},
+                        "status": e.status,
+                    }
+                sec.audit.access_granted(user, priv, method, path)
             self.node.request_context.user = user
         matched_path = False
         for m, regex, names, handler in self._routes:
@@ -403,6 +416,20 @@ def _register_all(c: RestController):
     c.register("PUT", "/_security/api_key", security_create_api_key)
     c.register("GET", "/_security/api_key", security_get_api_keys)
     c.register("DELETE", "/_security/api_key", security_invalidate_api_key)
+    c.register("POST", "/_security/oauth2/token", security_create_token)
+    c.register("DELETE", "/_security/oauth2/token",
+               security_invalidate_token)
+    c.register("POST", "/_security/delegate_pki", security_delegate_pki)
+    c.register("PUT", "/_security/role_mapping/{name}",
+               security_put_role_mapping)
+    c.register("POST", "/_security/role_mapping/{name}",
+               security_put_role_mapping)
+    c.register("GET", "/_security/role_mapping/{name}",
+               security_get_role_mapping)
+    c.register("GET", "/_security/role_mapping",
+               security_get_role_mapping)
+    c.register("DELETE", "/_security/role_mapping/{name}",
+               security_delete_role_mapping)
     # ilm
     c.register("PUT", "/_ilm/policy/{id}", ilm_put_policy)
     c.register("GET", "/_ilm/policy/{id}", ilm_get_policy)
@@ -1739,6 +1766,48 @@ def security_get_role(node, params, body, name=None):
 def security_delete_role(node, params, body, name):
     node.security_service.delete_role(name)
     return 200, {"found": True}
+
+
+def security_create_token(node, params, body):
+    """POST /_security/oauth2/token (ref: RestGetTokenAction)."""
+    body = body or {}
+    return 200, node.security_service.create_token(
+        grant_type=body.get("grant_type", ""),
+        username=body.get("username", ""),
+        password=body.get("password", ""),
+        refresh_token=body.get("refresh_token", ""),
+        request_user=_current_user(node))
+
+
+def security_invalidate_token(node, params, body):
+    """DELETE /_security/oauth2/token (ref: RestInvalidateTokenAction)."""
+    body = body or {}
+    n = node.security_service.invalidate_tokens(
+        token=body.get("token"),
+        refresh_token=body.get("refresh_token"),
+        username=body.get("username"),
+        request_user=_current_user(node))
+    return 200, {"invalidated_tokens": n, "previously_invalidated_tokens": 0,
+                 "error_count": 0}
+
+
+def security_delegate_pki(node, params, body):
+    """POST /_security/delegate_pki (ref:
+    RestDelegatePkiAuthenticationAction)."""
+    chain = (body or {}).get("x509_certificate_chain") or []
+    return 200, node.security_service.delegate_pki(chain)
+
+
+def security_put_role_mapping(node, params, body, name):
+    return 200, node.security_service.put_role_mapping(name, body or {})
+
+
+def security_get_role_mapping(node, params, body, name=None):
+    return 200, node.security_service.get_role_mappings(name)
+
+
+def security_delete_role_mapping(node, params, body, name):
+    return 200, node.security_service.delete_role_mapping(name)
 
 
 def security_create_api_key(node, params, body):

@@ -51,6 +51,7 @@ CLUSTER_PRIVILEGES = {
     "manage_index_templates", "manage_ingest_pipelines", "manage_ml",
     "manage_transform", "manage_watcher", "manage_ccr", "manage_enrich",
     "manage_rollup", "read_ccr", "transport_client", "manage_api_key",
+    "manage_token", "delegate_pki",
 }
 
 # index privileges (ref: IndexPrivilege)
@@ -92,6 +93,77 @@ def _verify_password(password: str, stored: str) -> bool:
     return secrets.compare_digest(dk.hex(), dk_hex)
 
 
+# ---------------------------------------------------------------------------
+# X.509 subject extraction (minimal DER walker — enough to read the
+# subject DN/CN out of certificates for the PKI realm; ref:
+# x-pack/plugin/security/.../pki/PkiRealm.java reads the TLS peer chain)
+# ---------------------------------------------------------------------------
+
+def _der_read(data: bytes, off: int):
+    """One TLV: returns (tag, content_start, content_end, next_off)."""
+    tag = data[off]
+    ln = data[off + 1]
+    off += 2
+    if ln & 0x80:
+        n = ln & 0x7F
+        ln = int.from_bytes(data[off:off + n], "big")
+        off += n
+    return tag, off, off + ln, off + ln
+
+
+_OID_CN = bytes.fromhex("550403")        # 2.5.4.3 commonName
+_DN_OIDS = {
+    bytes.fromhex("550403"): "CN", bytes.fromhex("55040a"): "O",
+    bytes.fromhex("55040b"): "OU", bytes.fromhex("550406"): "C",
+    bytes.fromhex("550408"): "ST", bytes.fromhex("550407"): "L",
+}
+
+
+def parse_der_subject(der: bytes) -> Dict[str, str]:
+    """{attr: value} of the certificate's subject DN, e.g. {"CN": ...}.
+
+    Certificate ::= SEQ { tbsCertificate SEQ {...}, sigAlg, sig }
+    tbsCertificate: [0] version?, serial INT, sigAlg SEQ, issuer Name,
+    validity SEQ, subject Name, ...
+    """
+    try:
+        _, s, e, _ = _der_read(der, 0)            # Certificate
+        _, s, e, _ = _der_read(der, s)            # tbsCertificate
+        fields = []
+        off = s
+        while off < e and len(fields) < 6:
+            tag, cs, ce, off = _der_read(der, off)
+            fields.append((tag, cs, ce))
+        if fields and fields[0][0] == 0xA0:        # explicit version
+            fields.pop(0)
+            tag, cs, ce, off = _der_read(der, off)
+            fields.append((tag, cs, ce))
+        # fields: serial, sigAlg, issuer, validity, subject
+        _, ss, se = fields[4]
+        out: Dict[str, str] = {}
+        off = ss
+        while off < se:                            # RDNSequence
+            _, rs, re_, off = _der_read(der, off)  # RDN (SET)
+            inner = rs
+            while inner < re_:
+                _, as_, ae, inner = _der_read(der, inner)   # AttrTypeValue
+                otag, os_, oe, nxt = _der_read(der, as_)    # OID
+                if otag == 0x06:
+                    vtag, vs, ve, _ = _der_read(der, nxt)   # value
+                    name = _DN_OIDS.get(der[os_:oe])
+                    if name:
+                        out[name] = der[vs:ve].decode("utf-8", "replace")
+        return out
+    except Exception:
+        raise AuthenticationException(
+            "unable to parse X.509 certificate")
+
+
+def subject_dn_string(subject: Dict[str, str]) -> str:
+    order = ["CN", "OU", "O", "L", "ST", "C"]
+    return ",".join(f"{k}={subject[k]}" for k in order if k in subject)
+
+
 class User:
     def __init__(self, username: str, roles: List[str],
                  metadata: Optional[Dict[str, Any]] = None,
@@ -106,6 +178,8 @@ class User:
         # API-key auth carries inline role descriptors that REPLACE the
         # owner's roles when non-empty (ref: ApiKeyService role limiting)
         self.api_key_roles = api_key_roles
+        # which realm authenticated this user (set by the realm chain)
+        self.authenticated_realm: Optional[str] = None
 
     def to_dict(self):
         return {"username": self.username, "roles": self.roles,
@@ -123,25 +197,239 @@ _BUILTIN_ROLES: Dict[str, Dict[str, Any]] = {
 }
 
 
+# ---------------------------------------------------------------------------
+# Realms (ref: x-pack/plugin/security/.../authc/AuthenticationService +
+# Realms.java — ordered chain; each realm extracts its own token type
+# from the request and the first realm that authenticates wins)
+# ---------------------------------------------------------------------------
+
+class Realm:
+    type = "base"
+
+    def __init__(self, name: str, order: int, svc: "SecurityService"):
+        self.name = name
+        self.order = order
+        self.svc = svc
+
+    def token(self, headers: Dict[str, str]):
+        """Extract this realm's credential from the request, or None."""
+        return None
+
+    def authenticate(self, token) -> "User":
+        raise AuthenticationException("not supported")
+
+
+class NativeRealm(Realm):
+    """Basic-auth against the native user store (the reserved `elastic`
+    user lives here too — ref: ReservedRealm ordering before native)."""
+
+    type = "native"
+
+    def token(self, headers):
+        auth = headers.get("authorization", "")
+        if auth.lower().startswith("basic "):
+            return auth.partition(" ")[2]
+        return None
+
+    def authenticate(self, payload) -> "User":
+        try:
+            username, _, password = base64.b64decode(
+                payload).decode().partition(":")
+        except Exception:
+            raise AuthenticationException("invalid basic credentials")
+        rec = self.svc._users.get(username)
+        if (rec is None or not rec.get("enabled", True)
+                or not _verify_password(password, rec["password"])):
+            raise AuthenticationException(
+                f"unable to authenticate user [{username}] for REST "
+                f"request")
+        return self.svc._user_obj(username)
+
+
+class TokenRealm(Realm):
+    """Bearer access tokens issued by the token service (ref:
+    TokenService.java — create/refresh/invalidate, 20-minute expiry)."""
+
+    type = "token"
+
+    def token(self, headers):
+        auth = headers.get("authorization", "")
+        if auth.lower().startswith("bearer "):
+            return auth.partition(" ")[2]
+        return None
+
+    def authenticate(self, access_token) -> "User":
+        rec = self.svc._tokens.get(_sha(access_token))
+        if rec is None or rec.get("invalidated"):
+            raise AuthenticationException("token has been invalidated")
+        if rec["expires"] < time.time() * 1000:
+            raise AuthenticationException("token expired")
+        u = User(rec["username"], rec.get("roles", []))
+        return u
+
+
+class ApiKeyRealm(Realm):
+    type = "api_key"
+
+    def token(self, headers):
+        auth = headers.get("authorization", "")
+        if auth.lower().startswith("apikey "):
+            return auth.partition(" ")[2]
+        return None
+
+    def authenticate(self, payload) -> "User":
+        try:
+            key_id, _, key_secret = base64.b64decode(
+                payload).decode().partition(":")
+        except Exception:
+            raise AuthenticationException("invalid ApiKey credentials")
+        rec = self.svc._api_keys.get(key_id)
+        if rec is None or rec.get("invalidated"):
+            raise AuthenticationException("api key has been invalidated")
+        if rec.get("expiration") and rec["expiration"] < time.time() * 1000:
+            raise AuthenticationException("api key is expired")
+        if not _verify_password(key_secret, rec["hash"]):
+            raise AuthenticationException("invalid api key")
+        rd = rec.get("role_descriptors") or {}
+        return User(rec["owner"], rec.get("roles", []),
+                    api_key_roles=list(rd.values()) if rd else None)
+
+
+class PkiRealm(Realm):
+    """Client-certificate authentication (ref: pki/PkiRealm.java). The
+    certificate arrives either on the `x-ssl-client-cert` header (PEM,
+    TLS-terminating-proxy convention) or through the delegated-PKI API
+    (POST /_security/delegate_pki with a DER chain — ref:
+    TransportDelegatePkiAuthenticationAction). The principal is the
+    subject CN; roles come from role mappings."""
+
+    type = "pki"
+
+    def token(self, headers):
+        # header-based PKI is an explicit OPT-IN
+        # (xpack.security.authc.pki.trust_proxy_header): the header
+        # carries an UNVERIFIED certificate, acceptable only when a
+        # trusted TLS-terminating proxy strips/sets it. Without the
+        # opt-in, PKI authentication happens solely through the
+        # delegate_pki API, which itself requires the delegate_pki
+        # cluster privilege (ref: delegated PKI authorization).
+        if not getattr(self.svc, "pki_header_trusted", False):
+            return None
+        pem = headers.get("x-ssl-client-cert")
+        if pem:
+            return pem
+        return None
+
+    @staticmethod
+    def _pem_to_der(pem: str) -> bytes:
+        body = "".join(line for line in pem.replace("\\n", "\n").splitlines()
+                       if line and not line.startswith("-----"))
+        return base64.b64decode(body)
+
+    def user_from_der(self, der: bytes) -> "User":
+        subject = parse_der_subject(der)
+        cn = subject.get("CN")
+        if not cn:
+            raise AuthenticationException(
+                "certificate subject has no CN to use as principal")
+        dn = subject_dn_string(subject)
+        roles = self.svc.mapped_roles(username=cn, dn=dn, realm=self.name)
+        return User(cn, roles, metadata={"pki_dn": dn})
+
+    def authenticate(self, pem) -> "User":
+        return self.user_from_der(self._pem_to_der(pem))
+
+
+def _sha(s: str) -> str:
+    return hashlib.sha256(s.encode()).hexdigest()
+
+
+def _dn_like(value: Optional[str], pattern: Any) -> bool:
+    """Role-mapping field compare: case-insensitive with * wildcards
+    (ref: the mapping rules' DN/username templates)."""
+    if value is None or pattern is None:
+        return value is None and pattern is None
+    return fnmatch.fnmatch(str(value).lower(), str(pattern).lower())
+
+
+class AuditTrail:
+    """Append-only JSONL audit log (ref: audit/logfile/
+    LoggingAuditTrail.java — authentication_success/failed,
+    access_granted/denied events with origin + request context)."""
+
+    def __init__(self, path: Optional[str], enabled: bool = False):
+        self.path = path
+        self.enabled = enabled and path is not None
+        self._lock = threading.Lock()
+
+    def _emit(self, event: str, **fields):
+        if not self.enabled:
+            return
+        rec = {"@timestamp": int(time.time() * 1000),
+               "event.type": "security", "event.action": event, **fields}
+        with self._lock:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+
+    def authentication_success(self, user: "User", realm: str,
+                               method: str, path: str):
+        self._emit("authentication_success", **{
+            "user.name": user.username, "realm": realm,
+            "url.path": path, "http.request.method": method})
+
+    def authentication_failed(self, method: str, path: str,
+                              reason: str):
+        self._emit("authentication_failed", **{
+            "url.path": path, "http.request.method": method,
+            "reason": reason})
+
+    def access_granted(self, user: "User", privilege: str,
+                       method: str, path: str):
+        self._emit("access_granted", **{
+            "user.name": user.username, "privilege": privilege,
+            "url.path": path, "http.request.method": method})
+
+    def access_denied(self, user: "User", privilege: str,
+                      method: str, path: str):
+        self._emit("access_denied", **{
+            "user.name": user.username, "privilege": privilege,
+            "url.path": path, "http.request.method": method})
+
+
 class SecurityService:
     """User/role/API-key registry + authn/authz engine."""
+
+    TOKEN_TTL_MS = 20 * 60 * 1000     # ref: TokenService 20-minute expiry
 
     def __init__(self, data_path: Optional[str] = None,
                  enabled: bool = False,
                  bootstrap_password: str = "changeme",
                  anonymous_username: Optional[str] = None,
-                 anonymous_roles: Optional[List[str]] = None):
+                 anonymous_roles: Optional[List[str]] = None,
+                 audit_enabled: bool = False,
+                 realm_orders: Optional[Dict[str, int]] = None,
+                 pki_header_trusted: bool = False):
         # ref: x-pack anonymous access (xpack.security.authc.anonymous.*)
         # — requests without credentials authenticate as this principal
         self.anonymous_username = anonymous_username
         self.anonymous_roles = list(anonymous_roles or [])
         self.enabled = enabled
+        self.pki_header_trusted = pki_header_trusted
         self._lock = threading.Lock()
         self._users: Dict[str, Dict[str, Any]] = {}
         self._roles: Dict[str, Dict[str, Any]] = {}
         self._api_keys: Dict[str, Dict[str, Any]] = {}
+        # sha256(access_token) -> token record (ref: the .security tokens)
+        self._tokens: Dict[str, Dict[str, Any]] = {}
+        # sha256(refresh_token) -> access-token hash
+        self._refresh: Dict[str, str] = {}
+        # role mapping name -> {"roles": [...], "rules": {...}, "enabled"}
+        self._role_mappings: Dict[str, Dict[str, Any]] = {}
         self._path = (os.path.join(data_path, "_security.json")
                       if data_path else None)
+        self.audit = AuditTrail(
+            os.path.join(data_path, "_audit.log") if data_path else None,
+            enabled=audit_enabled)
         self._load()
         if "elastic" not in self._users:
             # reserved superuser (ref: ReservedRealm + bootstrap.password)
@@ -149,6 +437,15 @@ class SecurityService:
                 "password": _hash_password(bootstrap_password),
                 "roles": ["superuser"], "full_name": None, "email": None,
                 "metadata": {"_reserved": True}, "enabled": True}
+        # ordered realm chain (ref: Realms.java — order from settings,
+        # xpack.security.authc.realms.<type>.<name>.order)
+        orders = realm_orders or {}
+        self.realms: List[Realm] = sorted([
+            NativeRealm("native1", orders.get("native", 0), self),
+            TokenRealm("token1", orders.get("token", 1), self),
+            ApiKeyRealm("api_key1", orders.get("api_key", 2), self),
+            PkiRealm("pki1", orders.get("pki", 3), self),
+        ], key=lambda r: r.order)
 
     # ------------------------------------------------------------- persist
     def _load(self):
@@ -158,6 +455,9 @@ class SecurityService:
             self._users = blob.get("users", {})
             self._roles = blob.get("roles", {})
             self._api_keys = blob.get("api_keys", {})
+            self._tokens = blob.get("tokens", {})
+            self._refresh = blob.get("refresh", {})
+            self._role_mappings = blob.get("role_mappings", {})
 
     def _persist(self):
         if not self._path:
@@ -165,7 +465,9 @@ class SecurityService:
         tmp = self._path + ".tmp"
         with open(tmp, "w") as fh:
             json.dump({"users": self._users, "roles": self._roles,
-                       "api_keys": self._api_keys}, fh)
+                       "api_keys": self._api_keys,
+                       "tokens": self._tokens, "refresh": self._refresh,
+                       "role_mappings": self._role_mappings}, fh)
         os.replace(tmp, self._path)
 
     # --------------------------------------------------------------- users
@@ -303,54 +605,227 @@ class SecurityService:
 
     # ---------------------------------------------------------------- authn
     def authenticate(self, headers: Optional[Dict[str, str]]) -> User:
-        """Authorization header → User (Basic or ApiKey scheme)."""
+        """Run the ordered realm chain (ref: AuthenticationService
+        .authenticate — each realm extracts its own token type; the
+        first realm whose token authenticates wins; a consumed-but-
+        failed token surfaces the realm's error)."""
         headers = {k.lower(): v for k, v in (headers or {}).items()}
-        auth = headers.get("authorization")
-        if not auth:
-            if self.anonymous_username is not None:
-                return User(self.anonymous_username,
-                            self.anonymous_roles)
-            raise AuthenticationException(
-                "missing authentication credentials for REST request")
-        scheme_probe = auth.partition(" ")[0].lower()
-        if (scheme_probe not in ("basic", "apikey", "bearer")
-                and self.anonymous_username is not None):
-            # no realm consumes this scheme: fall back to the anonymous
-            # principal (ref: AuthenticationService.handleNullToken)
-            return User(self.anonymous_username, self.anonymous_roles)
-        scheme, _, payload = auth.partition(" ")
-        scheme = scheme.lower()
-        if scheme == "basic":
+        last_error: Optional[AuthenticationException] = None
+        consumed = False
+        for realm in self.realms:
+            tok = realm.token(headers)
+            if tok is None:
+                continue
+            consumed = True
             try:
-                username, _, password = base64.b64decode(
-                    payload).decode().partition(":")
-            except Exception:
-                raise AuthenticationException("invalid basic credentials")
+                user = realm.authenticate(tok)
+                user.authenticated_realm = realm.name
+                return user
+            except AuthenticationException as e:
+                last_error = e
+        if consumed:
+            raise last_error or AuthenticationException(
+                "unable to authenticate for REST request")
+        if self.anonymous_username is not None:
+            # no realm consumed any credential: anonymous principal
+            # (ref: AuthenticationService.handleNullToken)
+            return User(self.anonymous_username, self.anonymous_roles)
+        if headers.get("authorization"):
+            raise AuthenticationException(
+                "unsupported authorization scheme "
+                f"[{headers['authorization'].partition(' ')[0]}]")
+        raise AuthenticationException(
+            "missing authentication credentials for REST request")
+
+    # ------------------------------------------------------ token service
+    def create_token(self, grant_type: str, username: str = "",
+                     password: str = "",
+                     refresh_token: str = "",
+                     request_user: Optional[User] = None) -> Dict[str, Any]:
+        """POST /_security/oauth2/token (ref: TokenService.java +
+        TransportCreateTokenAction): password / client_credentials /
+        refresh_token grants."""
+        if grant_type == "password":
             rec = self._users.get(username)
             if (rec is None or not rec.get("enabled", True)
                     or not _verify_password(password, rec["password"])):
                 raise AuthenticationException(
-                    f"unable to authenticate user [{username}] for REST "
-                    f"request")
-            return self._user_obj(username)
-        if scheme == "apikey":
-            try:
-                key_id, _, key_secret = base64.b64decode(
-                    payload).decode().partition(":")
-            except Exception:
-                raise AuthenticationException("invalid ApiKey credentials")
-            rec = self._api_keys.get(key_id)
-            if rec is None or rec.get("invalidated"):
-                raise AuthenticationException("api key has been invalidated")
-            if rec.get("expiration") and rec["expiration"] < time.time() * 1000:
-                raise AuthenticationException("api key is expired")
-            if not _verify_password(key_secret, rec["hash"]):
-                raise AuthenticationException("invalid api key")
-            rd = rec.get("role_descriptors") or {}
-            return User(rec["owner"], rec.get("roles", []),
-                        api_key_roles=list(rd.values()) if rd else None)
-        raise AuthenticationException(
-            f"unsupported authorization scheme [{scheme}]")
+                    f"unable to authenticate user [{username}]")
+            user = self._user_obj(username)
+        elif grant_type == "refresh_token":
+            return self.refresh_token(refresh_token)
+        elif grant_type == "client_credentials":
+            # issues a token for the ALREADY-authenticated request user
+            # (ref: client_credentials grant has no refresh token)
+            if request_user is None:
+                raise AuthenticationException(
+                    "client_credentials grant requires authentication")
+            out = self._issue_token(request_user)
+            out.pop("refresh_token", None)
+            return out
+        else:
+            raise IllegalArgumentException(
+                f"unsupported grant_type [{grant_type}]")
+        return self._issue_token(user)
+
+    def _prune_tokens_locked(self) -> None:
+        """Drop records a day past expiry (bounded stores — the
+        reference's ExpiredTokenRemover)."""
+        if len(self._tokens) < 128:
+            return
+        horizon = time.time() * 1000 - 24 * 3600 * 1000
+        dead = {h for h, rec in self._tokens.items()
+                if rec["expires"] < horizon}
+        if dead:
+            self._tokens = {h: r for h, r in self._tokens.items()
+                            if h not in dead}
+            self._refresh = {r: a for r, a in self._refresh.items()
+                             if a not in dead}
+
+    def _issue_token(self, user: User) -> Dict[str, Any]:
+        access = secrets.token_urlsafe(32)
+        refresh = secrets.token_urlsafe(32)
+        with self._lock:
+            self._prune_tokens_locked()
+            self._tokens[_sha(access)] = {
+                "username": user.username, "roles": user.roles,
+                "expires": int(time.time() * 1000) + self.TOKEN_TTL_MS,
+                "invalidated": False, "refresh": _sha(refresh),
+                "refreshed": False,
+            }
+            self._refresh[_sha(refresh)] = _sha(access)
+            self._persist()
+        return {"access_token": access, "type": "Bearer",
+                "expires_in": self.TOKEN_TTL_MS // 1000,
+                "refresh_token": refresh}
+
+    def refresh_token(self, refresh_token: str) -> Dict[str, Any]:
+        """One-time refresh: rotates the pair, invalidating the old
+        access token (ref: TokenService.refreshToken)."""
+        with self._lock:
+            ah = self._refresh.get(_sha(refresh_token))
+            rec = self._tokens.get(ah) if ah else None
+            if rec is None or rec.get("refreshed") or rec.get("invalidated"):
+                raise IllegalArgumentException(
+                    "token has already been refreshed or invalidated")
+            rec["refreshed"] = True
+            rec["invalidated"] = True
+            user = User(rec["username"], rec.get("roles", []))
+        return self._issue_token(user)
+
+    def invalidate_tokens(self, token: Optional[str] = None,
+                          refresh_token: Optional[str] = None,
+                          username: Optional[str] = None,
+                          request_user: Optional[User] = None) -> int:
+        """DELETE /_security/oauth2/token (ref:
+        TransportInvalidateTokenAction). Possession of a token/refresh
+        token authorizes invalidating it; invalidating BY USERNAME
+        requires manage_token (or self)."""
+        if username is not None:
+            allowed = (request_user is not None
+                       and (request_user.username == username
+                            or self.has_cluster_privilege(
+                                request_user, "manage_token")
+                            or self.has_cluster_privilege(
+                                request_user, "manage_security")))
+            if not allowed:
+                raise SecurityException(
+                    "invalidating tokens by username requires the "
+                    "[manage_token] cluster privilege")
+        n = 0
+        with self._lock:
+            if token is not None:
+                rec = self._tokens.get(_sha(token))
+                if rec and not rec["invalidated"]:
+                    rec["invalidated"] = True
+                    n += 1
+            if refresh_token is not None:
+                ah = self._refresh.get(_sha(refresh_token))
+                rec = self._tokens.get(ah) if ah else None
+                if rec and not rec["invalidated"]:
+                    rec["invalidated"] = True
+                    n += 1
+            if username is not None:
+                for rec in self._tokens.values():
+                    if rec["username"] == username \
+                            and not rec["invalidated"]:
+                        rec["invalidated"] = True
+                        n += 1
+            self._persist()
+        return n
+
+    # ------------------------------------------------ delegated PKI
+    def delegate_pki(self, x509_chain: List[str]) -> Dict[str, Any]:
+        """POST /_security/delegate_pki: a trusted proxy submits the
+        client's DER chain (base64); the PKI realm authenticates the END
+        entity and a token is issued (ref:
+        TransportDelegatePkiAuthenticationAction)."""
+        if not x509_chain:
+            raise IllegalArgumentException(
+                "x509_certificate_chain must be non-empty")
+        pki = next((r for r in self.realms if isinstance(r, PkiRealm)),
+                   None)
+        der = base64.b64decode(x509_chain[0])
+        user = pki.user_from_der(der)
+        user.authenticated_realm = pki.name
+        out = self._issue_token(user)
+        out["authentication"] = user.to_dict()
+        return out
+
+    # ------------------------------------------------ role mappings
+    def put_role_mapping(self, name: str, body: Dict[str, Any]):
+        with self._lock:
+            created = name not in self._role_mappings
+            self._role_mappings[name] = {
+                "roles": list(body.get("roles", [])),
+                "rules": body.get("rules", {}),
+                "enabled": bool(body.get("enabled", True)),
+                "metadata": body.get("metadata", {}),
+            }
+            self._persist()
+        return {"role_mapping": {"created": created}}
+
+    def get_role_mappings(self, name: Optional[str] = None):
+        if name is not None:
+            if name not in self._role_mappings:
+                raise ResourceNotFoundException(
+                    f"role mapping [{name}] not found")
+            return {name: self._role_mappings[name]}
+        return dict(self._role_mappings)
+
+    def delete_role_mapping(self, name: str):
+        with self._lock:
+            found = self._role_mappings.pop(name, None) is not None
+            self._persist()
+        return {"found": found}
+
+    def mapped_roles(self, username: str, dn: str,
+                     realm: str) -> List[str]:
+        """Resolve roles via role-mapping rules (ref: the field rules of
+        put_role_mapping: username / dn / realm.name, with any/all)."""
+        ctx = {"username": username, "dn": dn, "realm.name": realm}
+
+        def match(rule: Dict[str, Any]) -> bool:
+            if "field" in rule:
+                for k, want in rule["field"].items():
+                    got = ctx.get(k)
+                    wants = want if isinstance(want, list) else [want]
+                    if not any(_dn_like(got, w) for w in wants):
+                        return False
+                return True
+            if "any" in rule:
+                return any(match(r) for r in rule["any"])
+            if "all" in rule:
+                return all(match(r) for r in rule["all"])
+            if "except" in rule:
+                return not match(rule["except"])
+            return False
+
+        roles: List[str] = []
+        for m in self._role_mappings.values():
+            if m.get("enabled", True) and match(m.get("rules", {})):
+                roles.extend(m["roles"])
+        return sorted(set(roles))
 
     # ---------------------------------------------------------------- authz
     def _role_defs(self, user: User) -> List[Dict[str, Any]]:
@@ -512,6 +987,12 @@ def required_privilege(method: str, path: str) -> Tuple[str, str, Optional[str]]
     if parts[0] == "_security":
         if len(parts) >= 2 and parts[1] == "_authenticate":
             return ("cluster", "none", None)  # any authenticated user
+        if len(parts) >= 2 and parts[1] == "oauth2":
+            # the grant inside the body IS the authentication; the
+            # request itself needs none (ref: RestGetTokenAction)
+            return ("cluster", "none", None)
+        if len(parts) >= 2 and parts[1] == "delegate_pki":
+            return ("cluster", "delegate_pki", None)
         if len(parts) >= 2 and parts[1] == "api_key" and method == "POST":
             return ("cluster", "manage_api_key", None)
         return ("cluster", "manage_security", None)

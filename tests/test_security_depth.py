@@ -1,0 +1,221 @@
+"""Security round 2: realm chain, token service, PKI realm +
+delegate_pki, role mappings, audit log (ref: AuthenticationService,
+TokenService, PkiRealm, LoggingAuditTrail test disciplines)."""
+
+import base64
+import json
+import os
+import subprocess
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(settings=Settings.from_dict({
+        "xpack": {"security": {
+            "enabled": True,
+            "audit": {"enabled": True},
+            # header-carried certs are trusted only behind a
+            # TLS-terminating proxy — explicit opt-in
+            "authc": {"pki": {"trust_proxy_header": True}}}},
+        "bootstrap": {"password": "s3cret"},
+    }), data_path=str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def basic(user, pw):
+    return {"Authorization": "Basic "
+            + base64.b64encode(f"{user}:{pw}".encode()).decode()}
+
+
+def call(node, method, path, body=None, headers=None, expect=200, **params):
+    status, r = node.rest_controller.dispatch(method, path, params, body,
+                                              headers=headers)
+    assert status == expect, (status, r)
+    return r
+
+
+ELASTIC = None
+
+
+def test_token_lifecycle(node):
+    h = basic("elastic", "s3cret")
+    # password grant
+    r = call(node, "POST", "/_security/oauth2/token",
+             {"grant_type": "password", "username": "elastic",
+              "password": "s3cret"}, headers=h)
+    access, refresh = r["access_token"], r["refresh_token"]
+    assert r["type"] == "Bearer" and r["expires_in"] == 1200
+
+    # bearer authenticates through the token realm
+    me = call(node, "GET", "/_security/_authenticate",
+              headers={"Authorization": f"Bearer {access}"})
+    assert me["username"] == "elastic"
+
+    # refresh rotates; the old access token dies
+    r2 = call(node, "POST", "/_security/oauth2/token",
+              {"grant_type": "refresh_token", "refresh_token": refresh},
+              headers=h)
+    assert r2["access_token"] != access
+    call(node, "GET", "/_security/_authenticate",
+         headers={"Authorization": f"Bearer {access}"}, expect=401)
+    call(node, "GET", "/_security/_authenticate",
+         headers={"Authorization": f"Bearer {r2['access_token']}"})
+    # a refresh token is single-use
+    call(node, "POST", "/_security/oauth2/token",
+         {"grant_type": "refresh_token", "refresh_token": refresh},
+         headers=h, expect=400)
+
+    # explicit invalidation
+    inv = call(node, "DELETE", "/_security/oauth2/token",
+               {"token": r2["access_token"]}, headers=h)
+    assert inv["invalidated_tokens"] == 1
+    call(node, "GET", "/_security/_authenticate",
+         headers={"Authorization": f"Bearer {r2['access_token']}"},
+         expect=401)
+
+
+def test_client_credentials_grant(node):
+    h = basic("elastic", "s3cret")
+    r = call(node, "POST", "/_security/oauth2/token",
+             {"grant_type": "client_credentials"}, headers=h)
+    assert "refresh_token" not in r
+    me = call(node, "GET", "/_security/_authenticate",
+              headers={"Authorization": f"Bearer {r['access_token']}"})
+    assert me["username"] == "elastic"
+
+
+def _make_cert(tmp_path, cn):
+    key = tmp_path / f"{cn}.key"
+    crt = tmp_path / f"{cn}.crt"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(crt), "-days", "1",
+         "-subj", f"/C=US/O=Acme/CN={cn}"],
+        check=True, capture_output=True)
+    return crt.read_text()
+
+
+def test_pki_realm_and_delegate(node, tmp_path):
+    pem = _make_cert(tmp_path, "kibana-client")
+    # map the DN to roles (ref: role mapping API driving PKI realms)
+    call(node, "PUT", "/_security/role_mapping/pki-map",
+         {"roles": ["monitoring_user"],
+          "rules": {"field": {"dn": "CN=kibana-client,*"}}},
+         headers=basic("elastic", "s3cret"))
+
+    # direct header-based PKI (TLS-terminating proxy convention)
+    me = call(node, "GET", "/_security/_authenticate",
+              headers={"x-ssl-client-cert": pem})
+    assert me["username"] == "kibana-client"
+    assert "monitoring_user" in me["roles"]
+
+    # delegated PKI: DER chain → access token
+    der_b64 = "".join(line for line in pem.splitlines()
+                      if not line.startswith("-----"))
+    r = call(node, "POST", "/_security/delegate_pki",
+             {"x509_certificate_chain": [der_b64]},
+             headers=basic("elastic", "s3cret"))
+    assert r["authentication"]["username"] == "kibana-client"
+    me = call(node, "GET", "/_security/_authenticate",
+              headers={"Authorization": f"Bearer {r['access_token']}"})
+    assert me["username"] == "kibana-client"
+
+    # an unmapped cert authenticates with no roles → cluster reads fail
+    pem2 = _make_cert(tmp_path, "stranger")
+    call(node, "GET", "/_cluster/health",
+         headers={"x-ssl-client-cert": pem2}, expect=403)
+
+
+def test_role_mapping_crud(node):
+    h = basic("elastic", "s3cret")
+    r = call(node, "PUT", "/_security/role_mapping/m1",
+             {"roles": ["superuser"],
+              "rules": {"all": [{"field": {"username": "admin-*"}},
+                                {"field": {"realm.name": "pki1"}}]}},
+             headers=h)
+    assert r["role_mapping"]["created"]
+    got = call(node, "GET", "/_security/role_mapping/m1", headers=h)
+    assert got["m1"]["roles"] == ["superuser"]
+    assert call(node, "DELETE", "/_security/role_mapping/m1",
+                headers=h)["found"]
+    call(node, "GET", "/_security/role_mapping/m1", headers=h, expect=404)
+
+
+def test_pki_header_untrusted_by_default(tmp_path):
+    """Without the trust_proxy_header opt-in, a header-carried cert is
+    IGNORED (an unverified cert must never authenticate by itself)."""
+    n = Node(settings=Settings.from_dict({
+        "xpack": {"security": {"enabled": True}},
+        "bootstrap": {"password": "s3cret"},
+    }), data_path=str(tmp_path / "plainnode"))
+    try:
+        pem = _make_cert(tmp_path, "forged-admin")
+        call(n, "GET", "/_security/_authenticate",
+             headers={"x-ssl-client-cert": pem}, expect=401)
+    finally:
+        n.close()
+
+
+def test_invalidate_by_username_needs_privilege(node):
+    h = basic("elastic", "s3cret")
+    call(node, "PUT", "/_security/user/lowly",
+         {"password": "lowlypass1", "roles": ["monitoring_user"]},
+         headers=h)
+    call(node, "POST", "/_security/oauth2/token",
+         {"grant_type": "password", "username": "elastic",
+          "password": "s3cret"}, headers=h)
+    # a non-privileged user may NOT revoke another user's tokens...
+    call(node, "DELETE", "/_security/oauth2/token",
+         {"username": "elastic"}, headers=basic("lowly", "lowlypass1"),
+         expect=403)
+    # ...but may revoke their own
+    mine = call(node, "POST", "/_security/oauth2/token",
+                {"grant_type": "password", "username": "lowly",
+                 "password": "lowlypass1"},
+                headers=basic("lowly", "lowlypass1"))
+    r = call(node, "DELETE", "/_security/oauth2/token",
+             {"username": "lowly"}, headers=basic("lowly", "lowlypass1"))
+    assert r["invalidated_tokens"] >= 1
+    call(node, "GET", "/_security/_authenticate",
+         headers={"Authorization": f"Bearer {mine['access_token']}"},
+         expect=401)
+
+
+def test_realm_chain_order_and_failure(node):
+    # wrong basic creds fail with 401 even though other realms exist
+    call(node, "GET", "/_security/_authenticate",
+         headers=basic("elastic", "wrong"), expect=401)
+    # garbage bearer fails in the token realm
+    call(node, "GET", "/_security/_authenticate",
+         headers={"Authorization": "Bearer nope"}, expect=401)
+
+
+def test_audit_log_events(node, tmp_path):
+    audit_path = os.path.join(str(tmp_path / "data"), "_audit.log")
+    call(node, "GET", "/_cluster/health", headers=basic("elastic", "s3cret"))
+    call(node, "GET", "/_cluster/health", headers=basic("elastic", "bad"),
+         expect=401)
+    # limited user: authenticated but denied
+    call(node, "PUT", "/_security/user/peon",
+         {"password": "peonpass1", "roles": ["monitoring_user"]},
+         headers=basic("elastic", "s3cret"))
+    call(node, "PUT", "/_security/role_mapping/x", {"roles": []},
+         headers=basic("peon", "peonpass1"), expect=403)
+
+    events = [json.loads(line) for line in open(audit_path)]
+    actions = [e["event.action"] for e in events]
+    assert "authentication_success" in actions
+    assert "authentication_failed" in actions
+    assert "access_granted" in actions
+    assert "access_denied" in actions
+    denied = [e for e in events if e["event.action"] == "access_denied"]
+    assert denied[-1]["user.name"] == "peon"
+    ok = [e for e in events
+          if e["event.action"] == "authentication_success"]
+    assert ok[0]["realm"] == "native1"
