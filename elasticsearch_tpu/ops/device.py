@@ -154,6 +154,10 @@ class DeviceSegment:
         # replace whole DeviceSegments) keeps entries valid for the
         # segment's lifetime.
         self._filter_masks: "OrderedDict[tuple, tuple]" = OrderedDict()
+        # BoundPlan cache (search/searcher.py): repeated queries reuse
+        # their device-resident selection arrays — skipping bind_plan AND
+        # the per-launch host→device uploads of the selections
+        self._bound_plans: "OrderedDict[tuple, object]" = OrderedDict()
         live = np.zeros(self.n_docs_padded, bool)
         live[: segment.n_docs] = segment.live
         self.live = jax.device_put(live, device=device)
@@ -199,6 +203,32 @@ class DeviceSegment:
         else:
             mask = np.zeros(self.n_docs_padded, bool)
         entry = (jax.device_put(mask, device=self._device), mask)
+        self._filter_masks[key] = entry
+        while len(self._filter_masks) > FILTER_MASK_CACHE_MAX:
+            self._filter_masks.popitem(last=False)
+        return entry
+
+    def composed_filter_mask(self, conversions) -> Tuple[jax.Array,
+                                                         np.ndarray]:
+        """AND-composition of cached filter masks for a whole filter SET
+        (``conversions``: [(field, terms, negate)]), itself cached. The
+        returned DEVICE object is identical for every query using the
+        same filters — the batcher keys cohorts on that identity, so one
+        [ND] column serves a whole batched launch."""
+        key = ("composed", tuple(
+            (f, tuple(sorted(set(t))), bool(neg))
+            for f, t, neg in sorted(conversions,
+                                    key=lambda c: (c[0], c[1], c[2]))))
+        hit = self._filter_masks.get(key)
+        if hit is not None:
+            self._filter_masks.move_to_end(key)
+            return hit
+        host = None
+        for fname, terms, negate in key[1]:
+            _, hm = self.filter_mask(fname, terms)
+            hm = ~hm if negate else hm
+            host = hm.copy() if host is None else (host & hm)
+        entry = (jax.device_put(host, device=self._device), host)
         self._filter_masks[key] = entry
         while len(self._filter_masks) > FILTER_MASK_CACHE_MAX:
             self._filter_masks.popitem(last=False)

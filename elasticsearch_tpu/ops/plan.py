@@ -269,24 +269,25 @@ def plan_topk(streams, group_kind, group_req, group_const, live,
     with_after = after_score is not None
     impl = _plan_topk_packed_impl if packed else _plan_topk_impl
     return impl(
-        tuple(streams), jnp.asarray(group_kind, jnp.int32),
-        jnp.asarray(group_req, jnp.int32),
-        jnp.asarray(group_const, jnp.float32), live, dense_mask,
-        jnp.int32(n_must), jnp.int32(n_filter), jnp.int32(msm),
-        jnp.float32(bonus), jnp.float32(tie),
-        jnp.float32(after_score if with_after else 0.0),
+        tuple(streams), np.asarray(group_kind, np.int32),
+        np.asarray(group_req, np.int32),
+        np.asarray(group_const, np.float32), live, dense_mask,
+        np.int32(n_must), np.int32(n_filter), np.int32(msm),
+        np.float32(bonus), np.float32(tie),
+        np.float32(after_score if with_after else 0.0),
         float(k1), float(b), int(k), combine, with_dense, with_after)
 
 
-@partial(jax.jit, static_argnames=("k", "combine", "k1", "b"))
+@partial(jax.jit, static_argnames=("k", "combine", "k1", "b",
+                                   "with_dense"))
 def _plan_topk_batch_impl(streams, group_kind, group_req, group_const,
-                          live, n_must, n_filter, msm, bonus, tie,
-                          k1, b, k, combine):
+                          live, dense_mask, n_must, n_filter, msm,
+                          bonus, tie, k1, b, k, combine, with_dense):
     """vmap over the query axis of the selection/group arrays; corpus
-    arrays are shared (in_axes=None). Dense factors are not batched —
-    the batcher only groups pure-postings plans (benchmark-class
-    match/bool-of-terms), others run singly."""
-    placeholder = jnp.ones(1, bool)
+    arrays are shared (in_axes=None), and so is the optional dense
+    filter mask — cohorts are keyed by filter identity (the cached
+    composed column), so one [ND] mask serves the whole batch with no
+    per-query stacking."""
 
     def one(sel_blocks, sel_group, sel_sub, sel_weight, sel_const,
             gk, gr, gcst, nm, nf, ms, bo, ti):
@@ -297,9 +298,9 @@ def _plan_topk_batch_impl(streams, group_kind, group_req, group_const,
                 streams, sel_blocks, sel_group, sel_sub, sel_weight,
                 sel_const))
         return pack_result(*plan_topk_body(
-            sts, gk, gr, gcst, live, placeholder,
+            sts, gk, gr, gcst, live, dense_mask,
             nm, nf, ms, bo, ti, jnp.float32(0.0),
-            k1, b, k, combine, False))
+            k1, b, k, combine, with_dense))
 
     sel_b = tuple(st.sel_blocks for st in streams)   # each [Q, NB]
     sel_g = tuple(st.sel_group for st in streams)
@@ -314,20 +315,24 @@ def _plan_topk_batch_impl(streams, group_kind, group_req, group_const,
 def plan_topk_batch(streams, group_kind, group_req, group_const, live,
                     n_must, n_filter, msm, bonus, tie,
                     k1: float = 1.2, b: float = 0.75, k: int = 10,
-                    combine: str = "sum"):
+                    combine: str = "sum", dense_mask=None):
     """Batched entry: every per-query array has a leading [Q] axis; the
-    corpus arrays inside ``streams`` stay unbatched (shared). Returns
-    PACKED [Q, 2k+1] rows (pack_result) — one readback serves the whole
-    batch. This is the continuous-batching launch shape (SURVEY.md §7
-    hard part 5)."""
+    corpus arrays inside ``streams`` stay unbatched (shared), as is the
+    optional [ND] ``dense_mask`` (one filter column for the whole
+    cohort). Returns PACKED [Q, 2k+1] rows (pack_result) — one readback
+    serves the whole batch. This is the continuous-batching launch
+    shape (SURVEY.md §7 hard part 5)."""
+    with_dense = dense_mask is not None
+    if not with_dense:
+        dense_mask = jnp.ones(1, bool)   # placeholder, not read
     return _plan_topk_batch_impl(
-        tuple(streams), jnp.asarray(group_kind, jnp.int32),
-        jnp.asarray(group_req, jnp.int32),
-        jnp.asarray(group_const, jnp.float32), live,
-        jnp.asarray(n_must, jnp.int32), jnp.asarray(n_filter, jnp.int32),
-        jnp.asarray(msm, jnp.int32), jnp.asarray(bonus, jnp.float32),
-        jnp.asarray(tie, jnp.float32),
-        float(k1), float(b), int(k), combine)
+        tuple(streams), np.asarray(group_kind, np.int32),
+        np.asarray(group_req, np.int32),
+        np.asarray(group_const, np.float32), live, dense_mask,
+        np.asarray(n_must, np.int32), np.asarray(n_filter, np.int32),
+        np.asarray(msm, np.int32), np.asarray(bonus, np.float32),
+        np.asarray(tie, np.float32),
+        float(k1), float(b), int(k), combine, with_dense)
 
 
 # ---------------------------------------------------------------------------

@@ -8,18 +8,20 @@ the TPU-native answer is **batched launches**: concurrent requests with
 the same kernel shape coalesce into one vmapped execution
 (ops/plan.py plan_topk_batch) and share a single device round-trip.
 
-Leader/follower protocol (no background threads, no idle latency tax):
-the first request to arrive for a shape becomes the leader; while the
-leader's launch is in flight, later arrivals queue; whoever arrives
-first after the pop leads the next batch and takes the whole queue with
-it. Under load the batch size self-tunes to the launch latency —
-classic continuous batching; when idle, a single query runs alone with
-zero added wait.
+Leader/follower protocol (no background threads): the first request to
+arrive for a shape becomes the leader; while the leader's launch is in
+flight, later arrivals queue; whoever arrives first after the pop leads
+the next batch and takes the whole queue with it. Under load the batch
+size self-tunes to the launch latency (plus an explicit wait, a
+fraction of the measured round-trip, taken only when other requests are
+pending) — classic continuous batching; a truly idle query still runs
+alone with zero added wait.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -51,29 +53,46 @@ class _Entry:
 class PlanBatcher:
     """Shape-bucketed batcher for fused plan launches.
 
-    Eligible: no dense mask, no search_after cursor (those run singly —
-    the benchmark-class match/bool-of-term-filters plans are all
-    eligible). Batches are keyed by (segment identity, stream shapes,
-    group-table size, k, combine, k1, b) so stacked launches are
-    homogeneous; Q pads to a power-of-two bucket to bound compile count.
+    Eligible: everything but search_after cursors and ad-hoc dense
+    masks — plans whose dense mask is a CACHED composed filter column
+    batch too, cohorted by the mask's identity so one [ND] column
+    serves the launch. Batches are keyed by (segment identity, stream
+    shapes, group-table size, k, combine, mask identity, k1, b) so
+    stacked launches are homogeneous; Q pads to a power-of-two bucket
+    to bound compile count. Under a slow transport the leader waits a
+    fraction of the measured launch latency — only when other requests
+    are already pending — so cohorts grow without taxing idle queries.
     """
 
-    def __init__(self, max_batch: int = 32):
-        self.max_batch = max_batch
+    def __init__(self, max_batch: int = 64, max_concurrent: int = 8):
+        self.max_batch = min(max_batch, _Q_BUCKETS[-1])
         self._lock = threading.Lock()
-        # launches serialize here; while one is in flight, followers (and
-        # the next leader) accumulate — this blocking IS the batching
-        # window, self-tuned to the launch latency
-        self._launch_lock = threading.Lock()
+        # Launches used to serialize behind one lock; under a transport
+        # with a high per-sync latency floor (the axon tunnel degrades
+        # every device sync to ~117ms once any d2h transfer has
+        # happened) that caps throughput at batch/floor. Syncs OVERLAP
+        # across threads, so a bounded semaphore lets several batched
+        # launches ride the floor concurrently — the wait in acquire()
+        # is still the batching window that grows cohorts under load.
+        self._launch_slots = threading.BoundedSemaphore(max_concurrent)
         self._pending: Dict[tuple, List[_Entry]] = {}
         self.launches = 0          # stats: total device launches
         self.batched_queries = 0   # stats: queries served via batches
+        # EMA of launch+readback latency: when the device round-trip is
+        # slow (the tunnel's ~120ms sync floor), leaders WAIT a fraction
+        # of it before popping the queue so cohorts grow — the classic
+        # continuous-batching window, sized from measurement instead of
+        # a fixed knob. Fast devices (real local TPU: sub-ms) never wait.
+        self._lat_ema = 0.0
 
     # ------------------------------------------------------------------
     @staticmethod
     def _eligible(bp: BoundPlan, after_score) -> bool:
-        return (bp.dense_mask is None and after_score is None
-                and not bp.empty)
+        # dense plans batch when their mask is the CACHED shared object
+        # (one [ND] column serves the cohort); ad-hoc device-column
+        # masks run singly
+        return (after_score is None and not bp.empty
+                and (bp.dense_mask is None or bp.dense_shared))
 
     @staticmethod
     def _signature(bp: BoundPlan, ctx, k: int, k1: float, b: float) -> tuple:
@@ -82,6 +101,7 @@ class PlanBatcher:
             tuple((id(st.block_docids), int(st.sel_blocks.shape[0]))
                   for st in bp.streams),
             int(bp.group_kind.shape[0]), bp.combine, k,
+            id(bp.dense_mask) if bp.dense_mask is not None else None,
             round(k1, 6), round(b, 6),
         )
 
@@ -101,10 +121,19 @@ class PlanBatcher:
             if entry.error is not None:
                 raise entry.error
             return entry.result
-        # leader: wait for the in-flight launch (cohort grows meanwhile),
-        # then take the whole queue. Non-leader entries are always popped
-        # by a leader that appended before them, so nothing is orphaned.
-        with self._launch_lock:
+        # leader: let the cohort grow while the device is slow, then wait
+        # for a launch slot and take the whole queue. Non-leader entries
+        # are always popped by a leader that appended before them, so
+        # nothing is orphaned. The wait only engages when concurrency is
+        # actually present (other work pending) — an idle single query
+        # never pays it.
+        if self._lat_ema > 0.03:
+            with self._lock:
+                busy = (len(self._pending) > 1
+                        or any(len(q) > 1 for q in self._pending.values()))
+            if busy:
+                time.sleep(min(0.5 * self._lat_ema, 0.08))
+        with self._launch_slots:
             with self._lock:
                 batch = self._pending.pop(sig, [])
             if not batch:
@@ -133,13 +162,15 @@ class PlanBatcher:
         proto = bps[0]
         streams = []
         for si, st in enumerate(proto.streams):
+            # host-side np.stack (µs): selections are numpy; the jit
+            # boundary uploads the stacked batch asynchronously
             streams.append(plan_ops.FieldStream(
                 st.block_docids, st.block_tfs, st.doc_lens, st.avg_len,
-                jnp.stack([bp.streams[si].sel_blocks for bp in bps]),
-                jnp.stack([bp.streams[si].sel_group for bp in bps]),
-                jnp.stack([bp.streams[si].sel_sub for bp in bps]),
-                jnp.stack([bp.streams[si].sel_weight for bp in bps]),
-                jnp.stack([bp.streams[si].sel_const for bp in bps])))
+                np.stack([bp.streams[si].sel_blocks for bp in bps]),
+                np.stack([bp.streams[si].sel_group for bp in bps]),
+                np.stack([bp.streams[si].sel_sub for bp in bps]),
+                np.stack([bp.streams[si].sel_weight for bp in bps]),
+                np.stack([bp.streams[si].sel_const for bp in bps])))
         gk = np.stack([bp.group_kind for bp in bps])
         gr = np.stack([bp.group_req for bp in bps])
         gc = np.stack([bp.group_const for bp in bps])
@@ -148,12 +179,18 @@ class PlanBatcher:
         ms = np.asarray([bp.msm for bp in bps], np.int32)
         bo = np.asarray([bp.bonus for bp in bps], np.float32)
         ti = np.asarray([bp.tie for bp in bps], np.float32)
-
+        t0 = time.monotonic()
         packed = plan_ops.plan_topk_batch(
             streams, gk, gr, gc, ctx.live, nm, nf, ms, bo, ti,
-            k1=k1, b=b, k=k, combine=proto.combine)
+            k1=k1, b=b, k=k, combine=proto.combine,
+            # cohort-shared filter column (signature keys on identity)
+            dense_mask=proto.dense_mask)
         # ONE readback for the whole batch (rows are packed buffers)
         rows = np.asarray(packed)
+        dt = time.monotonic() - t0
+        if dt < 5.0:   # ignore compile-length outliers (first launches)
+            self._lat_ema = (dt if self._lat_ema == 0.0
+                             else 0.8 * self._lat_ema + 0.2 * dt)
         self.launches += 1
         self.batched_queries += qn
         for i, e in enumerate(batch):

@@ -118,6 +118,14 @@ class DeviceSegmentCache:
         self._lock = threading.Lock()
         self._device = device
         self._vector_dtype = vector_dtype
+        # compiled-LogicalPlan memo keyed by (segment names, epoch,
+        # query json, k1, b) — ShardSearchers are per-request, this
+        # cache is the persistent home (None = query not plannable).
+        # Skipping parse→rewrite→compile on repeats is a large slice of
+        # the per-query Python cost in the serving hot loop.
+        from collections import OrderedDict
+        self.plan_cache: "OrderedDict[tuple, object]" = OrderedDict()
+        self.plan_cache_max = 512
 
     def get(self, segment: Segment) -> DeviceSegment:
         with self._lock:

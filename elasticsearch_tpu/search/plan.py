@@ -402,6 +402,9 @@ class BoundPlan:
     # True when block-max pruning dropped blocks: the kernel's matching-doc
     # count is then a LOWER bound (hits.total relation becomes "gte")
     pruned: bool = False
+    # dense_mask is a CACHED shared object (composed filter column):
+    # batch cohorts may key on its identity and pass it unbatched
+    dense_shared: bool = False
 
 
 def _group_field_blocks(g: GroupPlan, ctx) -> Optional[Tuple[str, int]]:
@@ -483,18 +486,21 @@ def bind_plan(plan: LogicalPlan, ctx, k: int = 10,
                 (gi, t.sub, t.weight, t.const, t.term))
 
     # cached dense masks first — their HOST copies also validate the
-    # pruning threshold below
+    # pruning threshold below. The COMPOSED mask of the whole filter set
+    # is itself cached so repeated filter combos share one device object
+    # (batch cohorts key on its identity).
     dense_mask = None
+    dense_shared = False
+    host_masks: List[Tuple[np.ndarray, bool]] = []
+    if converted:
+        dense_mask, comp_host = ctx.device.composed_filter_mask(converted)
+        dense_shared = True
+        host_masks.append((comp_host, False))
     for clause, negate in plan.dense:
         _, m = clause.do_execute(ctx)
         m = (~m) if negate else m
         dense_mask = m if dense_mask is None else (dense_mask & m)
-    host_masks: List[Tuple[np.ndarray, bool]] = []
-    for fname, terms, negate in converted:
-        dev, host = ctx.device.filter_mask(fname, terms)
-        m = (~dev) if negate else dev
-        dense_mask = m if dense_mask is None else (dense_mask & m)
-        host_masks.append((host, negate))
+        dense_shared = False   # device-column factors: identity not cached
 
     # ---- unpadded per-field selections (kept separate so pruning can
     # drop blocks before the power-of-two bucket is chosen)
@@ -563,11 +569,15 @@ def bind_plan(plan: LogicalPlan, ctx, k: int = 10,
         w_a[:tot] = w_u
         c_a = np.zeros(n, bool)
         c_a[:tot] = c_u
+        # selections stay NUMPY: the jit boundary uploads them
+        # asynchronously per launch, while batching stacks them with a
+        # microseconds host np.stack — stacking device arrays instead
+        # costs ~10ms of GIL-held dispatch per launch (measured), which
+        # serializes the whole concurrent serving path
         streams.append(plan_ops.FieldStream(
             dp.block_docids, dp.block_tfs, dp.doc_lens,
             jnp.float32(ctx.stats.field_stats(fname)[1]),
-            jnp.asarray(sel), jnp.asarray(grp), jnp.asarray(sub_a),
-            jnp.asarray(w_a), jnp.asarray(c_a)))
+            sel, grp, sub_a, w_a, c_a))
 
     gpad = max(4, block_bucket(max(1, ngroups)) if ngroups else 4)
     kind = np.full(gpad, plan_ops.FILTER, np.int32)
@@ -583,7 +593,8 @@ def bind_plan(plan: LogicalPlan, ctx, k: int = 10,
     return BoundPlan(streams, kind, req, const, dense_mask,
                      plan.n_must, n_filter, plan.msm, plan.bonus,
                      plan.tie, plan.combine, empty=not any_entries,
-                     host_masks=host_masks, pruned=pruned)
+                     host_masks=host_masks, pruned=pruned,
+                     dense_shared=dense_shared)
 
 
 # ---------------------------------------------------------------------------

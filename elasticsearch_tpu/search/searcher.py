@@ -36,9 +36,10 @@ from elasticsearch_tpu.search.context import (
 from elasticsearch_tpu.search.queries import QueryBuilder, parse_query
 
 MAX_TOPK = 10000
+_MISS = object()   # plan-cache sentinel (None is a valid cached value)
 
 
-@dataclass
+@dataclass(slots=True)
 class DocAddress:
     segment_idx: int
     docid: int
@@ -76,6 +77,10 @@ class ShardSearcher:
         self.b = b
         # set by SearchService: continuous batching of plan launches
         self.batcher = None
+        # snapshot epoch, set by IndexService.shard_searchers — feeds
+        # plan-cache keys (tests constructing searchers directly leave
+        # it None, which only means their caches key on segment names)
+        self.epoch = None
 
     def _contexts(self) -> List[SegmentContext]:
         return [SegmentContext(seg, self.cache.get(seg), self.mapper,
@@ -94,11 +99,9 @@ class ShardSearcher:
                     track_total_hits=True,
                     after_key: Optional[Tuple[float, int, int]] = None,
                     collect_masks: bool = False,
-                    allow_plan: bool = True) -> QueryResult:
+                    allow_plan: bool = True,
+                    cache_key: Optional[str] = None) -> QueryResult:
         k = min(max(size, 1), MAX_TOPK)
-        query = query.rewrite(self)
-        if post_filter is not None:
-            post_filter = post_filter.rewrite(self)
         sort_spec = _parse_sort(sort)
 
         # ---- fused plan fast path (ref: the BulkScorer replacement —
@@ -113,14 +116,39 @@ class ShardSearcher:
             # pages of a score-paged walk on one executor (float32 sums
             # differ between executors in the last bits)
             plan_after = float(search_after[0])
-        if (allow_plan and sort_spec is None and min_score is None
-                and (search_after is None or plan_after is not None)
-                and after_key is None and not collect_masks):
+        plannable = (allow_plan and sort_spec is None and min_score is None
+                     and (search_after is None or plan_after is not None)
+                     and after_key is None and not collect_masks)
+        lp_key = None
+        if plannable and cache_key is not None:
+            # compiled-plan memo (DeviceSegmentCache.plan_cache): repeat
+            # queries skip parse-side rewrite + compile entirely; the
+            # epoch in the key pins shard-level stats (idf, avg length)
+            lp_key = (tuple(s.name for s in self.segments),
+                      self.epoch, self.k1, self.b, cache_key)
+            cached = self.cache.plan_cache.get(lp_key, _MISS)
+            if cached is not _MISS:
+                if cached is not None:
+                    return self._plan_query_phase(
+                        query, cached, k, track_total_hits, plan_after,
+                        cache_key=lp_key)
+                plannable = False   # known not plannable: dense path
+
+        query = query.rewrite(self)
+        if post_filter is not None:
+            post_filter = post_filter.rewrite(self)
+        if plannable:
             from elasticsearch_tpu.search.plan import compile_plan
             plan = compile_plan(query, self, post_filter)
+            if lp_key is not None:
+                pc = self.cache.plan_cache
+                pc[lp_key] = plan
+                while len(pc) > self.cache.plan_cache_max:
+                    pc.popitem(last=False)
             if plan is not None:
                 return self._plan_query_phase(query, plan, k,
-                                              track_total_hits, plan_after)
+                                              track_total_hits, plan_after,
+                                              cache_key=lp_key)
         per_segment: List[Tuple[int, np.ndarray, np.ndarray, np.ndarray]] = []
         total = 0
         max_score = None
@@ -223,7 +251,8 @@ class ShardSearcher:
 
     def _plan_query_phase(self, query: QueryBuilder, plan, k: int,
                           track_total_hits,
-                          after_score: Optional[float] = None) -> QueryResult:
+                          after_score: Optional[float] = None,
+                          cache_key=None) -> QueryResult:
         """Execute a compiled LogicalPlan per segment via the fused
         sorted-top-k kernel (search/plan.py) and merge exactly as the
         dense path merges (by (-score, segment, docid))."""
@@ -238,9 +267,28 @@ class ShardSearcher:
         total = 0
         lower_bound = False
         for seg_idx, ctx in enumerate(self._contexts()):
-            if ctx.segment.n_docs == 0 or not query.can_match(ctx):
+            if ctx.segment.n_docs == 0:
                 continue
-            bp = bind_plan(plan, ctx, k=k, allow_prune=allow_prune)
+            # bound-plan cache: repeats reuse the device-resident
+            # selection arrays (skips bind + per-launch h2d uploads)
+            bkey = None
+            bp = None
+            if cache_key is not None:
+                # live_version: deletes change which docs validate the
+                # block-max pruning threshold, so bound (possibly
+                # pruned) plans must not outlive the live mask
+                bkey = (cache_key, k, allow_prune,
+                        ctx.segment.live_version)
+                bp = ctx.device._bound_plans.get(bkey)
+            if bp is None:
+                if not query.can_match(ctx):
+                    continue
+                bp = bind_plan(plan, ctx, k=k, allow_prune=allow_prune)
+                if bkey is not None:
+                    bpc = ctx.device._bound_plans
+                    bpc[bkey] = bp
+                    while len(bpc) > 128:
+                        bpc.popitem(last=False)
             lower_bound = lower_bound or bp.pruned
             if self.batcher is not None:
                 vals, ids, seg_total = self.batcher.execute(
@@ -258,6 +306,13 @@ class ShardSearcher:
         if not per_segment:
             return QueryResult([], total, None, None,
                                total_lower_bound=lower_bound)
+        if len(per_segment) == 1:
+            # kernel top_k rows are already (-score, docid)-ordered
+            seg_idx, vals, ids = per_segment[0]
+            docs = [DocAddress(seg_idx, int(i), float(v), (), sort_key=float(v))
+                    for v, i in zip(vals.tolist(), ids.tolist())]
+            return QueryResult(docs, total, docs[0].score if docs else None,
+                               None, total_lower_bound=lower_bound)
         all_keys = np.concatenate([v for _, v, _ in per_segment])
         all_segs = np.concatenate(
             [np.full(len(i), s, np.int32) for s, _, i in per_segment])
@@ -328,6 +383,17 @@ class ShardSearcher:
                     fields: Optional[List[Any]] = None,
                     version: bool = False,
                     seq_no_primary_term: bool = False) -> List[Dict[str, Any]]:
+        if (source_filter is False and not docvalue_fields
+                and not highlight and not script_fields and not fields
+                and not version and not seq_no_primary_term
+                and not any(d.sort_values for d in docs)):
+            # serving fast path: id+score rows only (size=k, _source
+            # false — the benchmark/scroll-id class); one tight
+            # comprehension instead of the subphase loop
+            segs = self.segments
+            return [{"_id": segs[d.segment_idx].stored.ids[d.docid],
+                     "_score": d.score if d.score == d.score else None}
+                    for d in docs]
         script_cols = (self._script_field_columns(script_fields)
                        if script_fields else None)
         hits = []

@@ -617,6 +617,17 @@ class SearchService:
             after_key = (scroll_ctx.cursors.get(shard_idx)
                          if (scroll_ctx is not None and continuing) else None)
             t0 = time.monotonic_ns()
+            if scroll_ctx is None and slice_spec is None:
+                # stable plan-cache key: the raw query/post_filter JSON —
+                # repeat queries skip compile AND bind (searcher.py)
+                try:
+                    plan_cache_key = json.dumps(
+                        [body.get("query"), body.get("post_filter")],
+                        sort_keys=True, default=str)
+                except (TypeError, ValueError):
+                    plan_cache_key = None
+            else:
+                plan_cache_key = None
             result = searcher.query_phase(
                 query, query_k, post_filter=post_filter, min_score=min_score,
                 sort=sort, search_after=search_after,
@@ -628,7 +639,8 @@ class SearchService:
                 # dense-path float32 sums differ in the last bits, so a
                 # cursor taken from one would re-emit/skip boundary docs
                 # when continued on the other
-                allow_plan=scroll_ctx is None)
+                allow_plan=scroll_ctx is None,
+                cache_key=plan_cache_key)
             if terminate_after:
                 # the shard "stops collecting" after terminate_after docs
                 result.docs[:] = result.docs[: int(terminate_after)]
@@ -674,9 +686,11 @@ class SearchService:
                 return -1 if a[1] < b[1] else (1 if a[1] > b[1] else 0)
 
             merged.sort(key=functools.cmp_to_key(entry_cmp))
-        else:
+        elif len(shard_results) > 1:
             merged.sort(key=lambda e: (-e[0], e[1], e[2].segment_idx,
                                        e[2].docid))
+        # single shard: per-shard results are already in final
+        # (-score, segment, docid) order — no re-sort needed
 
         if mesh_docs is not None:
             # already merged on-device (all_gather + re-top-k); shards
