@@ -36,6 +36,20 @@ from elasticsearch_tpu.index.segment import Segment, SegmentWriter, merge_segmen
 from elasticsearch_tpu.index.seqno import LocalCheckpointTracker, NO_OPS_PERFORMED
 from elasticsearch_tpu.index.translog import Translog, TranslogOp
 
+# shard-path-prefix -> materializer(shard_path, seg_name) -> bool, set by
+# the node container when repositories exist (searchable snapshots —
+# keyed by data path so multiple in-process nodes stay independent)
+LAZY_MATERIALIZERS: Dict[str, Any] = {}
+
+
+def _find_materializer(shard_path: str):
+    for prefix, fn in LAZY_MATERIALIZERS.items():
+        # prefix + separator: "/data/node1" must not claim
+        # "/data/node10/..." shards
+        if shard_path.startswith(prefix.rstrip(os.sep) + os.sep):
+            return fn
+    return None
+
 
 @dataclass
 class VersionValue:
@@ -107,6 +121,9 @@ class Engine:
         # keyed (segment name, docid) to dedupe repeated tombstones pre-refresh
         self._pending_tombstones: Dict[Tuple[str, int], Tuple[Segment, int]] = {}
         self._segments: List[Segment] = []
+        # committed segments whose files are snapshot-backed and not yet
+        # fetched (searchable snapshots — materialized on first search)
+        self._deferred_segments: List[str] = []
         self._dirty_segments: set = set()   # names needing (re)save
         self._epoch = 0                      # bumps on every refresh/delete
         self._seg_counter = 0
@@ -125,8 +142,17 @@ class Engine:
         if os.path.exists(self._commit_path()):
             with open(self._commit_path()) as fh:
                 commit = json.load(fh)
+            lazy_manifest = os.path.exists(
+                os.path.join(self.path, "snapshot_store.json"))
             for name in commit["segments"]:
-                seg = Segment.load(os.path.join(self.path, name))
+                seg_dir = os.path.join(self.path, name)
+                if lazy_manifest and not os.path.isdir(seg_dir):
+                    # snapshot-mounted shard: files stream in lazily on
+                    # first search (ref: SearchableSnapshotDirectory —
+                    # mounting costs no local data until queried)
+                    self._deferred_segments.append(name)
+                    continue
+                seg = Segment.load(seg_dir)
                 self._segments.append(seg)
             commit_gen = commit["translog_generation"]
             self.primary_term = commit.get("primary_term", 1)
@@ -302,8 +328,31 @@ class Engine:
             return GetResult(False, doc_id)
 
     def acquire_searcher(self) -> SearcherSnapshot:
+        if self._deferred_segments:
+            self._materialize_deferred()
         with self._lock:
             return SearcherSnapshot(self._segments, self._epoch)
+
+    def _materialize_deferred(self) -> None:
+        """Fetch snapshot-backed segments through the node's blob cache
+        and publish them (the lazy-load moment of a mounted shard)."""
+        fn = _find_materializer(self.path)
+        with self._lock:
+            names = list(self._deferred_segments)
+        if not names:
+            return
+        loaded = []
+        for name in names:
+            if fn is None or not fn(self.path, name):
+                raise IOError(
+                    f"segment [{name}] is snapshot-backed but no "
+                    f"repository materializer is registered")
+            loaded.append(Segment.load(os.path.join(self.path, name)))
+        with self._lock:
+            if self._deferred_segments:
+                self._segments = self._segments + loaded
+                self._deferred_segments = []
+                self._epoch += 1
 
     # ------------------------------------------------------ refresh/flush
     def refresh(self) -> bool:
@@ -386,7 +435,11 @@ class Engine:
             self.translog.sync()
             new_gen = self.translog.roll_generation()
             commit = {
-                "segments": [s.name for s in self._segments],
+                # still-deferred snapshot-backed segments MUST stay in
+                # the commit — dropping them would silently lose the
+                # mounted data on the next open
+                "segments": ([s.name for s in self._segments]
+                             + list(self._deferred_segments)),
                 "translog_generation": new_gen,
                 "max_seq_no": self.tracker.max_seq_no,
                 "local_checkpoint": self.tracker.checkpoint,

@@ -63,7 +63,20 @@ class Node:
         self.stored_scripts = StoredScripts(self.data_path)
         self.metadata_service = MetadataService(self.indices_service,
                                                 self.data_path)
+        # cloud repository credentials resolve from the node keystore
+        from elasticsearch_tpu.repositories import blobstore as _bs
+        if self.keystore is not None:
+            _bs.NODE_KEYSTORES[self.data_path] = self.keystore
         self.repositories_service = RepositoriesService(self.data_path)
+        # searchable snapshots: mounted shards fetch segments lazily
+        # through the node blob cache (ref: SearchableSnapshotDirectory;
+        # xpack/searchable_snapshots.py)
+        from elasticsearch_tpu.index import engine as _engine_mod
+        from elasticsearch_tpu.xpack import searchable_snapshots as _ss
+        _engine_mod.LAZY_MATERIALIZERS[self.data_path] = (
+            lambda shard_path, seg: _ss.materialize_segment(
+                shard_path, seg, self.repositories_service,
+                self.data_path))
         self.slm_service = SnapshotLifecycleService(
             self.repositories_service, self.indices_service, self.data_path)
         from elasticsearch_tpu.xpack.ilm import IndexLifecycleService
@@ -166,6 +179,10 @@ class Node:
 
     def close(self):
         self.stop()
+        from elasticsearch_tpu.index import engine as _engine_mod
+        _engine_mod.LAZY_MATERIALIZERS.pop(self.data_path, None)
+        from elasticsearch_tpu.repositories import blobstore as _bs
+        _bs.NODE_KEYSTORES.pop(self.data_path, None)
         self.watcher_service.stop()
         self.monitoring_service.stop()
         self.ccr_service.stop()

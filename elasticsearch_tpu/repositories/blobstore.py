@@ -129,15 +129,25 @@ SHARD_FILES = ("meta.json", "arrays.npz", "stored.bin")
 # {type: factory(name, config, data_path) -> repository}
 REPOSITORY_TYPES: Dict[str, Any] = {}
 
+# per-node keystores (keyed by data path — multiple in-process nodes
+# stay independent), published for backends whose credentials are
+# keystore-only secure settings (set/cleared by the node container)
+NODE_KEYSTORES: Dict[str, Any] = {}
+
 
 class BlobStoreRepository:
-    """One registered snapshot repository over a blob store."""
+    """One registered snapshot repository over a blob store. The store
+    defaults to the filesystem; cloud backends inject their own
+    (repositories/cloud.py) — everything above the container interface
+    (snapshot format, CAS generations, restore) is backend-agnostic,
+    exactly the reference's BlobStoreRepository/BlobContainer split."""
 
-    def __init__(self, name: str, location: str, readonly: bool = False):
+    def __init__(self, name: str, location: str, readonly: bool = False,
+                 blobstore=None):
         self.name = name
         self.location = location
         self.readonly = readonly
-        self.blobstore = FsBlobStore(location)
+        self.blobstore = blobstore or FsBlobStore(location)
         self.root = self.blobstore.container()
         self._lock = threading.Lock()
 
@@ -395,6 +405,9 @@ class RepositoriesService:
     (the reference keeps it in cluster state)."""
 
     def __init__(self, data_path: Optional[str] = None):
+        # built-in cloud backends register their repository types on
+        # import (s3/gcs/azure — repositories/cloud.py)
+        from elasticsearch_tpu.repositories import cloud  # noqa: F401
         self._repos: Dict[str, BlobStoreRepository] = {}
         self._configs: Dict[str, Dict[str, Any]] = {}
         self._data_path = data_path

@@ -1,0 +1,439 @@
+"""Cloud repository backends: S3 / GCS / Azure over the blobstore SPI.
+
+The analogue of the reference's repository-s3 / repository-gcs /
+repository-azure plugins (ref: modules/repository-s3/.../
+S3BlobContainer.java etc.): each backend implements the BlobContainer
+contract (write/read/exists/list/delete) over the service's REST
+protocol, and the generic BlobStoreRepository machinery (snapshot
+format, generation CAS, restore) runs unchanged on top.
+
+Clients use only the stdlib (zero-egress image): S3 requests are signed
+with real AWS Signature V4 (ref: S3 SDK signing — verified by the test
+fixture), GCS speaks the JSON API with a bearer token, Azure uses
+SharedKey-style authorization. Credentials are SECURE settings: they
+resolve from the node keystore (s3.client.default.access_key, ...) and
+may not appear in plain repository settings — matching the reference's
+keystore-only credential rule.
+
+Endpoints are configurable (``settings.endpoint``), which is also how
+the in-repo test fixtures (tests/test_cloud_repositories.py spin up
+minimal in-process S3/GCS/Azure servers) stand in for the real
+services, mirroring the reference's fixture strategy (s3-fixture).
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ResourceNotFoundException,
+)
+from elasticsearch_tpu.repositories.blobstore import (
+    REPOSITORY_TYPES,
+    BlobStoreRepository,
+    RepositoryException,
+)
+
+
+def _http(method: str, url: str, data: Optional[bytes] = None,
+          headers: Optional[Dict[str, str]] = None):
+    req = urllib.request.Request(url, method=method, data=data,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+# ---------------------------------------------------------------------------
+# S3 — AWS Signature V4 (ref: repository-s3's AWS SDK signing)
+# ---------------------------------------------------------------------------
+
+def _sigv4_headers(method: str, url: str, payload: bytes,
+                   access_key: str, secret_key: str,
+                   region: str = "us-east-1",
+                   service: str = "s3",
+                   now: Optional[datetime.datetime] = None
+                   ) -> Dict[str, str]:
+    u = urllib.parse.urlsplit(url)
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    payload_hash = hashlib.sha256(payload or b"").hexdigest()
+    canonical_headers = (f"host:{u.netloc}\n"
+                         f"x-amz-content-sha256:{payload_hash}\n"
+                         f"x-amz-date:{amz_date}\n")
+    signed_headers = "host;x-amz-content-sha256;x-amz-date"
+    # canonical query: sorted, url-encoded
+    q = urllib.parse.parse_qsl(u.query, keep_blank_values=True)
+    canonical_query = "&".join(
+        f"{urllib.parse.quote(k, safe='-_.~')}="
+        f"{urllib.parse.quote(v, safe='-_.~')}"
+        for k, v in sorted(q))
+    canonical = "\n".join([
+        method, urllib.parse.quote(u.path or "/", safe="/-_.~"),
+        canonical_query, canonical_headers, signed_headers, payload_hash])
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        hashlib.sha256(canonical.encode()).hexdigest()])
+
+    def _hmac(key: bytes, msg: str) -> bytes:
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = _hmac(("AWS4" + secret_key).encode(), datestamp)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    k = _hmac(k, "aws4_request")
+    signature = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+    return {
+        "x-amz-date": amz_date,
+        "x-amz-content-sha256": payload_hash,
+        "Authorization": (
+            f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+            f"SignedHeaders={signed_headers}, Signature={signature}"),
+    }
+
+
+class S3BlobContainer:
+    def __init__(self, endpoint: str, bucket: str, prefix: str,
+                 access_key: str, secret_key: str, region: str):
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+
+    def _url(self, name: str = "", query: str = "") -> str:
+        key = f"{self.prefix}/{name}".strip("/") if name or self.prefix \
+            else ""
+        path = f"/{self.bucket}/{urllib.parse.quote(key)}" if key \
+            else f"/{self.bucket}"
+        return f"{self.endpoint}{path}" + (f"?{query}" if query else "")
+
+    def _call(self, method: str, url: str, data: bytes = b""):
+        headers = _sigv4_headers(method, url, data, self.access_key,
+                                 self.secret_key, self.region)
+        return _http(method, url, data or None, headers)
+
+    def write_blob(self, name: str, data: bytes,
+                   fail_if_exists: bool = False) -> None:
+        if fail_if_exists and self.blob_exists(name):
+            raise RepositoryException(f"blob [{name}] already exists")
+        status, _, body = self._call("PUT", self._url(name), data)
+        if status not in (200, 201):
+            raise RepositoryException(
+                f"S3 PUT [{name}] failed: {status} {body[:200]!r}")
+
+    def read_blob(self, name: str) -> bytes:
+        status, _, body = self._call("GET", self._url(name))
+        if status == 404:
+            raise ResourceNotFoundException(f"blob [{name}] not found")
+        if status != 200:
+            raise RepositoryException(
+                f"S3 GET [{name}] failed: {status}")
+        return body
+
+    def blob_exists(self, name: str) -> bool:
+        status, _, _ = self._call("HEAD", self._url(name))
+        return status == 200
+
+    def list_blobs(self) -> List[str]:
+        prefix = f"{self.prefix}/" if self.prefix else ""
+        q = ("list-type=2&prefix="
+             + urllib.parse.quote(prefix, safe=""))
+        status, _, body = self._call(
+            "GET", f"{self.endpoint}/{self.bucket}?{q}")
+        if status != 200:
+            raise RepositoryException(f"S3 LIST failed: {status}")
+        import re
+        keys = re.findall(r"<Key>([^<]+)</Key>", body.decode())
+        out = []
+        for k in keys:
+            rest = k[len(prefix):]
+            if rest and "/" not in rest:
+                out.append(rest)
+        return sorted(out)
+
+    def delete_blob(self, name: str) -> None:
+        self._call("DELETE", self._url(name))
+
+
+class S3BlobStore:
+    def __init__(self, endpoint, bucket, base_path, access_key,
+                 secret_key, region):
+        self.endpoint = endpoint
+        self.bucket = bucket
+        self.base_path = base_path.strip("/")
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+
+    def container(self, *parts: str) -> S3BlobContainer:
+        prefix = "/".join([p for p in (self.base_path, *parts) if p])
+        return S3BlobContainer(self.endpoint, self.bucket, prefix,
+                               self.access_key, self.secret_key,
+                               self.region)
+
+
+# ---------------------------------------------------------------------------
+# GCS — JSON API with bearer token (ref: repository-gcs)
+# ---------------------------------------------------------------------------
+
+class GcsBlobContainer:
+    def __init__(self, endpoint: str, bucket: str, prefix: str,
+                 token: str):
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self.token = token
+
+    def _h(self):
+        return {"Authorization": f"Bearer {self.token}"}
+
+    def _obj(self, name: str) -> str:
+        return f"{self.prefix}/{name}".strip("/")
+
+    def write_blob(self, name: str, data: bytes,
+                   fail_if_exists: bool = False) -> None:
+        if fail_if_exists and self.blob_exists(name):
+            raise RepositoryException(f"blob [{name}] already exists")
+        url = (f"{self.endpoint}/upload/storage/v1/b/{self.bucket}/o"
+               f"?uploadType=media&name="
+               + urllib.parse.quote(self._obj(name), safe=""))
+        status, _, body = _http("POST", url, data, self._h())
+        if status not in (200, 201):
+            raise RepositoryException(
+                f"GCS upload [{name}] failed: {status}")
+
+    def _media_url(self, name: str) -> str:
+        return (f"{self.endpoint}/storage/v1/b/{self.bucket}/o/"
+                + urllib.parse.quote(self._obj(name), safe="")
+                + "?alt=media")
+
+    def read_blob(self, name: str) -> bytes:
+        status, _, body = _http("GET", self._media_url(name),
+                                headers=self._h())
+        if status == 404:
+            raise ResourceNotFoundException(f"blob [{name}] not found")
+        if status != 200:
+            raise RepositoryException(f"GCS GET [{name}]: {status}")
+        return body
+
+    def blob_exists(self, name: str) -> bool:
+        # metadata GET (no alt=media): existence without downloading
+        url = (f"{self.endpoint}/storage/v1/b/{self.bucket}/o/"
+               + urllib.parse.quote(self._obj(name), safe=""))
+        status, _, _ = _http("GET", url, headers=self._h())
+        return status == 200
+
+    def list_blobs(self) -> List[str]:
+        prefix = f"{self.prefix}/" if self.prefix else ""
+        url = (f"{self.endpoint}/storage/v1/b/{self.bucket}/o?prefix="
+               + urllib.parse.quote(prefix, safe=""))
+        status, _, body = _http("GET", url, headers=self._h())
+        if status != 200:
+            raise RepositoryException(f"GCS LIST failed: {status}")
+        items = json.loads(body.decode()).get("items", [])
+        out = []
+        for it in items:
+            rest = it["name"][len(prefix):]
+            if rest and "/" not in rest:
+                out.append(rest)
+        return sorted(out)
+
+    def delete_blob(self, name: str) -> None:
+        url = (f"{self.endpoint}/storage/v1/b/{self.bucket}/o/"
+               + urllib.parse.quote(self._obj(name), safe=""))
+        _http("DELETE", url, headers=self._h())
+
+
+class GcsBlobStore:
+    def __init__(self, endpoint, bucket, base_path, token):
+        self.endpoint = endpoint
+        self.bucket = bucket
+        self.base_path = base_path.strip("/")
+        self.token = token
+
+    def container(self, *parts: str) -> GcsBlobContainer:
+        prefix = "/".join([p for p in (self.base_path, *parts) if p])
+        return GcsBlobContainer(self.endpoint, self.bucket, prefix,
+                                self.token)
+
+
+# ---------------------------------------------------------------------------
+# Azure — blob REST with SharedKey-style auth (ref: repository-azure)
+# ---------------------------------------------------------------------------
+
+class AzureBlobContainer:
+    def __init__(self, endpoint: str, account: str, key: str,
+                 container: str, prefix: str):
+        self.endpoint = endpoint.rstrip("/")
+        self.account = account
+        self.key = key
+        self.container = container
+        self.prefix = prefix.strip("/")
+
+    def _auth(self, method: str, path: str) -> Dict[str, str]:
+        # simplified SharedKey: HMAC-SHA256 over "METHOD\npath" with the
+        # account key (the fixture verifies it; real Azure canonicalizes
+        # more headers — the trust model is identical)
+        sig = hmac.new(self.key.encode(), f"{method}\n{path}".encode(),
+                       hashlib.sha256).hexdigest()
+        return {"Authorization": f"SharedKey {self.account}:{sig}",
+                "x-ms-blob-type": "BlockBlob"}
+
+    def _path(self, name: str = "") -> str:
+        blob = f"{self.prefix}/{name}".strip("/")
+        return f"/{self.container}/{urllib.parse.quote(blob)}" if blob \
+            else f"/{self.container}"
+
+    def write_blob(self, name: str, data: bytes,
+                   fail_if_exists: bool = False) -> None:
+        if fail_if_exists and self.blob_exists(name):
+            raise RepositoryException(f"blob [{name}] already exists")
+        p = self._path(name)
+        status, _, _ = _http("PUT", self.endpoint + p, data,
+                             self._auth("PUT", p))
+        if status not in (200, 201):
+            raise RepositoryException(f"Azure PUT [{name}]: {status}")
+
+    def read_blob(self, name: str) -> bytes:
+        p = self._path(name)
+        status, _, body = _http("GET", self.endpoint + p,
+                                headers=self._auth("GET", p))
+        if status == 404:
+            raise ResourceNotFoundException(f"blob [{name}] not found")
+        if status != 200:
+            raise RepositoryException(f"Azure GET [{name}]: {status}")
+        return body
+
+    def blob_exists(self, name: str) -> bool:
+        p = self._path(name)
+        status, _, _ = _http("HEAD", self.endpoint + p,
+                             headers=self._auth("HEAD", p))
+        return status == 200
+
+    def list_blobs(self) -> List[str]:
+        prefix = f"{self.prefix}/" if self.prefix else ""
+        p = (f"/{self.container}?restype=container&comp=list&prefix="
+             + urllib.parse.quote(prefix, safe=""))
+        status, _, body = _http("GET", self.endpoint + p,
+                                headers=self._auth("GET", p))
+        if status != 200:
+            raise RepositoryException(f"Azure LIST failed: {status}")
+        import re
+        names = re.findall(r"<Name>([^<]+)</Name>", body.decode())
+        out = []
+        for n in names:
+            rest = n[len(prefix):]
+            if rest and "/" not in rest:
+                out.append(rest)
+        return sorted(out)
+
+    def delete_blob(self, name: str) -> None:
+        p = self._path(name)
+        _http("DELETE", self.endpoint + p, headers=self._auth("DELETE", p))
+
+
+class AzureBlobStore:
+    def __init__(self, endpoint, account, key, container, base_path):
+        self.endpoint = endpoint
+        self.account = account
+        self.key = key
+        self.container_name = container
+        self.base_path = base_path.strip("/")
+
+    def container(self, *parts: str) -> AzureBlobContainer:
+        prefix = "/".join([p for p in (self.base_path, *parts) if p])
+        return AzureBlobContainer(self.endpoint, self.account, self.key,
+                                  self.container_name, prefix)
+
+
+# ---------------------------------------------------------------------------
+# registration (the built-in cloud backends — discoverable exactly like
+# plugin-contributed ones)
+# ---------------------------------------------------------------------------
+
+def _secure(settings: Dict[str, Any], plain_key: str,
+            keystore_key: str,
+            data_path: Optional[str]) -> Optional[str]:
+    """Cloud credentials are SECURE settings: keystore-only (ref:
+    repository-s3 client settings — access_key/secret_key must live in
+    the keystore). Resolved from the owning node's keystore (keyed by
+    data path so in-process nodes don't share credentials)."""
+    if plain_key in settings:
+        raise IllegalArgumentException(
+            f"[{plain_key}] is a secure setting and must be stored in "
+            f"the keystore as [{keystore_key}]")
+    from elasticsearch_tpu.repositories import blobstore as _bs
+    ks = _bs.NODE_KEYSTORES.get(data_path) if data_path else None
+    if ks is not None and ks.is_loaded and ks.has(keystore_key):
+        return ks.get_string(keystore_key)
+    return None
+
+
+def _make_s3(name: str, config: Dict[str, Any], data_path: Optional[str]):
+    s = config.get("settings", {})
+    bucket = s.get("bucket")
+    if not bucket:
+        raise IllegalArgumentException("[bucket] is required")
+    client = s.get("client", "default")
+    access = _secure(s, "access_key", f"s3.client.{client}.access_key",
+                     data_path) or "anonymous"
+    secret = _secure(s, "secret_key", f"s3.client.{client}.secret_key",
+                     data_path) or "anonymous"
+    store = S3BlobStore(
+        s.get("endpoint", "https://s3.amazonaws.com"),
+        bucket, s.get("base_path", ""), access, secret,
+        s.get("region", "us-east-1"))
+    return BlobStoreRepository(name, f"s3://{bucket}", blobstore=store,
+                               readonly=bool(s.get("readonly", False)))
+
+
+def _make_gcs(name: str, config: Dict[str, Any], data_path: Optional[str]):
+    s = config.get("settings", {})
+    bucket = s.get("bucket")
+    if not bucket:
+        raise IllegalArgumentException("[bucket] is required")
+    client = s.get("client", "default")
+    token = _secure(s, "token", f"gcs.client.{client}.credentials_file",
+                    data_path) or "anonymous"
+    store = GcsBlobStore(
+        s.get("endpoint", "https://storage.googleapis.com"),
+        bucket, s.get("base_path", ""), token)
+    return BlobStoreRepository(name, f"gs://{bucket}", blobstore=store,
+                               readonly=bool(s.get("readonly", False)))
+
+
+def _make_azure(name: str, config: Dict[str, Any],
+                data_path: Optional[str]):
+    s = config.get("settings", {})
+    container = s.get("container", "elasticsearch-snapshots")
+    client = s.get("client", "default")
+    account = _secure(s, "account", f"azure.client.{client}.account",
+                      data_path) or "devaccount"
+    key = _secure(s, "key", f"azure.client.{client}.key",
+                  data_path) or "devkey"
+    store = AzureBlobStore(
+        s.get("endpoint",
+              f"https://{account}.blob.core.windows.net"),
+        account, key, container, s.get("base_path", ""))
+    return BlobStoreRepository(name, f"azure://{container}",
+                               blobstore=store,
+                               readonly=bool(s.get("readonly", False)))
+
+
+REPOSITORY_TYPES.setdefault("s3", _make_s3)
+REPOSITORY_TYPES.setdefault("gcs", _make_gcs)
+REPOSITORY_TYPES.setdefault("azure", _make_azure)

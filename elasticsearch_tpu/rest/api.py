@@ -2414,32 +2414,22 @@ def unfreeze_index(node, params, body, index):
 
 def mount_snapshot(node, params, body, repo, snap):
     """ref: x-pack searchable-snapshots MountSearchableSnapshotAction —
-    a snapshot index mounted read-only; storage stays snapshot-backed
-    (restored segments + write block here)."""
+    a snapshot index mounted read-only with LAZY, cache-backed storage
+    (no data files copied at mount time; see
+    xpack/searchable_snapshots.py)."""
+    from elasticsearch_tpu.xpack import searchable_snapshots as ss
     body = body or {}
     index = body.get("index")
     if not index:
         raise IllegalArgumentException("[index] is required")
     renamed = body.get("renamed_index", index)
-    r = node.repositories_service.get_repository(repo)
-    r.restore(snap, node.indices_service, indices=[index],
-              rename_pattern=f"^{re.escape(index)}$",
-              rename_replacement=renamed)
-    idx = node.indices_service.get(renamed)
-    idx.update_settings({
-        "index.blocks.write": True,
-        "index.store.type": "snapshot",
-        "index.store.snapshot.repository_name": repo,
-        "index.store.snapshot.snapshot_name": snap,
-    })
-    return 200, {"snapshot": {"snapshot": snap,
-                              "indices": [renamed],
-                              "shards": {"total": idx.num_shards,
-                                         "failed": 0,
-                                         "successful": idx.num_shards}}}
+    storage = params.get("storage", "full_copy")
+    return 200, ss.mount(node, repo, snap, index, renamed,
+                         storage=storage)
 
 
 def searchable_snapshot_stats(node, params, body):
+    from elasticsearch_tpu.xpack import searchable_snapshots as ss
     indices = {}
     for name in node.indices_service.indices:
         idx = node.indices_service.get(name)
@@ -2449,8 +2439,12 @@ def searchable_snapshot_stats(node, params, body):
                     "index.store.snapshot.repository_name"),
                 "snapshot": idx.settings.get(
                     "index.store.snapshot.snapshot_name"),
+                "storage": idx.settings.get(
+                    "index.store.snapshot.storage", "full_copy"),
             }
-    return 200, {"total": len(indices), "indices": indices}
+    cache = ss.node_cache(node.data_path)
+    return 200, {"total": len(indices), "indices": indices,
+                 "shared_cache": cache.stats()}
 
 
 def hot_threads(node, params, body):

@@ -1,0 +1,119 @@
+"""Searchable snapshots: lazy blob-backed mounts with a local cache
+(ref: SearchableSnapshotDirectory / frozen shared cache tests)."""
+
+import glob
+import os
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(data_path=str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def call(node, method, path, body=None, expect=200, **params):
+    status, r = node.rest_controller.dispatch(method, path, params, body)
+    assert status == expect, r
+    return r
+
+
+def _snapshot_index(node, tmp_path):
+    call(node, "PUT", "/_snapshot/repo", {
+        "type": "fs", "settings": {"location": str(tmp_path / "repo")}})
+    call(node, "PUT", "/src", {"mappings": {"properties": {
+        "t": {"type": "text"}, "n": {"type": "long"}}}})
+    for i in range(30):
+        call(node, "PUT", f"/src/_doc/{i}", {"t": f"alpha doc {i}",
+                                             "n": i}, expect=201)
+    call(node, "POST", "/src/_refresh")
+    call(node, "PUT", "/_snapshot/repo/s1", {"indices": "src"},
+         wait_for_completion="true")
+
+
+def test_mount_is_lazy_then_searchable(node, tmp_path):
+    _snapshot_index(node, tmp_path)
+    call(node, "POST", "/_snapshot/repo/s1/_mount",
+         {"index": "src", "renamed_index": "mounted"})
+
+    # NO data files were copied at mount time — only manifests/commits
+    shard_dir = os.path.join(node.data_path, "mounted", "0")
+    assert os.path.exists(os.path.join(shard_dir, "snapshot_store.json"))
+    assert glob.glob(os.path.join(shard_dir, "*", "arrays.npz")) == []
+
+    stats = call(node, "GET", "/_searchable_snapshots/stats")
+    assert stats["indices"]["mounted"]["repository"] == "repo"
+    misses0 = stats["shared_cache"]["misses"]
+
+    # first search materializes through the cache
+    r = call(node, "POST", "/mounted/_search",
+             {"query": {"match": {"t": "alpha"}}, "size": 50})
+    assert r["hits"]["total"]["value"] == 30
+    assert glob.glob(os.path.join(shard_dir, "*", "arrays.npz")) != []
+    stats = call(node, "GET", "/_searchable_snapshots/stats")
+    assert stats["shared_cache"]["misses"] > misses0
+    assert stats["shared_cache"]["bytes_fetched"] > 0
+
+    # mounted indices are read-only
+    st, _ = node.rest_controller.dispatch(
+        "PUT", "/mounted/_doc/99", None, {"t": "nope"})
+    assert st >= 400
+
+
+def test_mounted_index_survives_restart_lazily(node, tmp_path):
+    _snapshot_index(node, tmp_path)
+    call(node, "POST", "/_snapshot/repo/s1/_mount",
+         {"index": "src", "renamed_index": "m2"})
+    data_path = node.data_path
+    node.close()
+
+    n2 = Node(data_path=data_path)
+    try:
+        shard_dir = os.path.join(data_path, "m2", "0")
+        # restart reopened the index with segments still deferred
+        r = call(n2, "POST", "/m2/_search",
+                 {"query": {"match": {"t": "alpha"}}, "size": 50})
+        assert r["hits"]["total"]["value"] == 30
+        assert glob.glob(os.path.join(shard_dir, "*", "arrays.npz")) != []
+    finally:
+        n2.close()
+
+
+def test_flush_before_search_keeps_deferred_segments(node, tmp_path):
+    """A flush (or snapshot) of a mounted-but-never-searched index must
+    keep deferred segment names in the commit — dropping them would
+    silently lose all mounted data on the next open."""
+    _snapshot_index(node, tmp_path)
+    call(node, "POST", "/_snapshot/repo/s1/_mount",
+         {"index": "src", "renamed_index": "mf"})
+    call(node, "POST", "/mf/_flush")
+    data_path = node.data_path
+    node.close()
+    n2 = Node(data_path=data_path)
+    try:
+        r = call(n2, "POST", "/mf/_search",
+                 {"query": {"match": {"t": "alpha"}}, "size": 50})
+        assert r["hits"]["total"]["value"] == 30
+    finally:
+        n2.close()
+
+
+def test_second_mount_hits_cache(node, tmp_path):
+    _snapshot_index(node, tmp_path)
+    call(node, "POST", "/_snapshot/repo/s1/_mount",
+         {"index": "src", "renamed_index": "ma"})
+    call(node, "POST", "/ma/_search", {"query": {"match_all": {}}})
+    stats1 = call(node, "GET", "/_searchable_snapshots/stats")
+
+    call(node, "POST", "/_snapshot/repo/s1/_mount",
+         {"index": "src", "renamed_index": "mb"})
+    call(node, "POST", "/mb/_search", {"query": {"match_all": {}}})
+    stats2 = call(node, "GET", "/_searchable_snapshots/stats")
+    # the same blobs served the second mount from cache
+    assert stats2["shared_cache"]["hits"] > stats1["shared_cache"]["hits"]
+    assert (stats2["shared_cache"]["misses"]
+            == stats1["shared_cache"]["misses"])
