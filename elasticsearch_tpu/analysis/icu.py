@@ -1,0 +1,124 @@
+"""ICU-class Unicode analysis components.
+
+The analogue of the reference's analysis-icu plugin (ref:
+plugins/analysis-icu/.../AnalysisICUPlugin.java — icu_normalizer char
+filter + token filter, icu_folding, icu_tokenizer). ICU4J's machinery
+is replaced by Python's unicodedata (the same Unicode character
+database): NFC/NFKC/NFKC-casefold normalization, accent folding via
+NFKD + combining-mark stripping + case folding, and a tokenizer that
+segments on Unicode word boundaries with per-character segmentation of
+Han/Hiragana/Katakana runs (ICU's dictionary-less CJK fallback).
+
+Shipped as the installable ``plugins_src/analysis_icu`` plugin — the
+classes live here in the analysis library; registration activates on
+plugin install, mirroring the reference's packaging.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from typing import List
+
+from elasticsearch_tpu.analysis.filters import TokenFilter
+from elasticsearch_tpu.analysis.tokenizers import Token, Tokenizer
+
+
+def _normalize(text: str, form: str) -> str:
+    form = (form or "nfkc_cf").lower()
+    if form == "nfkc_cf":
+        return unicodedata.normalize("NFKC", text).casefold()
+    if form in ("nfc", "nfkc", "nfd", "nfkd"):
+        return unicodedata.normalize(form.upper(), text)
+    raise ValueError(f"unknown normalization form [{form}]")
+
+
+def fold(text: str) -> str:
+    """ICU folding: NFKD, strip combining marks, case fold, NFKC.
+    café→cafe, Straße→strasse, ＦＵＬＬ→full."""
+    decomposed = unicodedata.normalize("NFKD", text)
+    stripped = "".join(ch for ch in decomposed
+                       if not unicodedata.combining(ch))
+    return unicodedata.normalize("NFKC", stripped.casefold())
+
+
+class ICUNormalizerCharFilter:
+    """icu_normalizer char_filter: normalizes the whole input before
+    tokenization (offsets shift with the text, as in the reference)."""
+
+    name = "icu_normalizer"
+
+    def __init__(self, form: str = "nfkc_cf"):
+        self.form = form
+
+    def filter(self, text: str) -> str:
+        return _normalize(text, self.form)
+
+
+class ICUNormalizerFilter(TokenFilter):
+    """icu_normalizer token filter."""
+
+    name = "icu_normalizer"
+
+    def __init__(self, form: str = "nfkc_cf"):
+        self.form = form
+
+    def filter(self, tokens: List[Token]) -> List[Token]:
+        return [Token(_normalize(t.term, self.form), t.position,
+                      t.start_offset, t.end_offset, t.keyword)
+                for t in tokens]
+
+
+class ICUFoldingFilter(TokenFilter):
+    """icu_folding: accent/case/width folding."""
+
+    name = "icu_folding"
+
+    def filter(self, tokens: List[Token]) -> List[Token]:
+        return [Token(fold(t.term), t.position, t.start_offset,
+                      t.end_offset, t.keyword)
+                for t in tokens]
+
+
+_CJK_RANGES = (
+    (0x2E80, 0x2EFF), (0x3040, 0x30FF), (0x3400, 0x4DBF),
+    (0x4E00, 0x9FFF), (0xF900, 0xFAFF), (0x20000, 0x2A6DF),
+)
+
+
+def _is_cjk(ch: str) -> bool:
+    cp = ord(ch)
+    return any(lo <= cp <= hi for lo, hi in _CJK_RANGES)
+
+
+class ICUTokenizer(Tokenizer):
+    """icu_tokenizer: Unicode word segmentation. Latin/Cyrillic/etc.
+    words follow UAX#29-style boundaries; Han/Kana characters emit one
+    token each (the reference's behavior without a segmentation
+    dictionary), so downstream cjk_bigram can recombine them."""
+
+    name = "icu_tokenizer"
+
+    def tokenize(self, text: str) -> List[Token]:
+        out: List[Token] = []
+        pos = 0
+        i = 0
+        n = len(text)
+        while i < n:
+            ch = text[i]
+            if _is_cjk(ch):
+                out.append(Token(ch, pos, i, i + 1))
+                pos += 1
+                i += 1
+                continue
+            cat = unicodedata.category(ch)
+            if cat[0] in ("L", "N"):
+                j = i + 1
+                while j < n and not _is_cjk(text[j]) and \
+                        unicodedata.category(text[j])[0] in ("L", "N", "M"):
+                    j += 1
+                out.append(Token(text[i:j], pos, i, j))
+                pos += 1
+                i = j
+            else:
+                i += 1
+        return out

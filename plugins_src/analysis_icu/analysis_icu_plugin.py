@@ -1,0 +1,31 @@
+"""analysis-icu plugin (ref: plugins/analysis-icu/.../
+AnalysisICUPlugin.java — registers icu_normalizer char filter,
+icu_normalizer + icu_folding token filters, and the icu_tokenizer).
+Implementations live in elasticsearch_tpu.analysis.icu; installing the
+plugin activates the registrations."""
+
+from elasticsearch_tpu.analysis.icu import (
+    ICUFoldingFilter,
+    ICUNormalizerCharFilter,
+    ICUNormalizerFilter,
+    ICUTokenizer,
+)
+from elasticsearch_tpu.plugins import Plugin
+
+
+class ESPlugin(Plugin):
+    name = "analysis-icu"
+
+    def char_filters(self):
+        return {"icu_normalizer": lambda s: ICUNormalizerCharFilter(
+            s.get("name", s.get("form", "nfkc_cf")))}
+
+    def token_filters(self):
+        return {
+            "icu_normalizer": lambda s: ICUNormalizerFilter(
+                s.get("name", s.get("form", "nfkc_cf"))),
+            "icu_folding": lambda s: ICUFoldingFilter(),
+        }
+
+    def tokenizers(self):
+        return {"icu_tokenizer": lambda s: ICUTokenizer()}

@@ -1,0 +1,87 @@
+"""analysis-icu plugin tests (ref: plugins/analysis-icu test suite:
+normalization, folding, Unicode/CJK tokenization — driven through the
+installed plugin over REST)."""
+
+import os
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.plugins import main as plugin_cli
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def node(tmp_path):
+    pd = str(tmp_path / "plugins")
+    plugin_cli(["install",
+                os.path.join(REPO_ROOT, "plugins_src", "analysis_icu"),
+                "--plugins-dir", pd])
+    n = Node(settings=Settings.from_dict({"path": {"plugins": pd}}),
+             data_path=str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def call(node, method, path, body=None, expect=200):
+    status, r = node.rest_controller.dispatch(method, path, None, body)
+    assert status == expect, r
+    return r
+
+
+def terms(node, index, analyzer, text):
+    r = call(node, "GET", f"/{index}/_analyze",
+             {"analyzer": analyzer, "text": text})
+    return [t["token"] for t in r["tokens"]]
+
+
+@pytest.fixture()
+def idx(node):
+    call(node, "PUT", "/icu", {
+        "settings": {"analysis": {
+            "filter": {
+                "norm": {"type": "icu_normalizer"},
+                "foldit": {"type": "icu_folding"},
+            },
+            "analyzer": {
+                "icu_norm": {"type": "custom", "tokenizer": "standard",
+                             "filter": ["norm"]},
+                "icu_fold": {"type": "custom", "tokenizer": "standard",
+                             "filter": ["foldit"]},
+                "icu_words": {"type": "custom",
+                              "tokenizer": "icu_tokenizer",
+                              "filter": ["norm"]},
+            }}},
+        "mappings": {"properties": {
+            "t": {"type": "text", "analyzer": "icu_fold"}}}})
+    return node
+
+
+def test_icu_normalizer(idx):
+    # NFKC + casefold: width folding, compatibility forms, case
+    assert terms(idx, "icu", "icu_norm", "ＦＵＬＬｗｉｄｔｈ") == ["fullwidth"]
+    assert terms(idx, "icu", "icu_norm", "ﬁopenoﬃce") == ["fiopenoffice"]
+    assert terms(idx, "icu", "icu_norm", "Straße") == ["strasse"]
+
+
+def test_icu_folding(idx):
+    assert terms(idx, "icu", "icu_fold", "Café Ågård naïve") == \
+        ["cafe", "agard", "naive"]
+    assert terms(idx, "icu", "icu_fold", "Ελληνικά") == ["ελληνικα"]
+
+
+def test_icu_tokenizer_cjk(idx):
+    # Han characters segment one-per-token (dictionary-less ICU), Latin
+    # words stay whole
+    assert terms(idx, "icu", "icu_words", "ток 東京都 tower") == \
+        ["ток", "東", "京", "都", "tower"]
+
+
+def test_folded_search_matches(idx):
+    call(idx, "PUT", "/icu/_doc/1", {"t": "Crème Brûlée"}, expect=201)
+    call(idx, "POST", "/icu/_refresh")
+    r = call(idx, "POST", "/icu/_search",
+             {"query": {"match": {"t": "creme brulee"}}})
+    assert r["hits"]["total"]["value"] == 1
