@@ -1,0 +1,135 @@
+"""Action layer: typed action registry + node client.
+
+The analogue of the reference's action seam (ref: action/ActionType.java,
+action/support/TransportAction.java, client/node/NodeClient.java — REST
+handlers never call services directly; they resolve an ActionType in a
+registry and execute a TransportAction, which is also the seam plugins
+extend via ActionPlugin.getActions and the transport layer binds RPC
+handlers to).
+
+Here: an ActionType names a request contract; a TransportAction wraps
+the service call; the NodeClient executes by type (optionally on a named
+thread pool from common/threadpool.py). REST handlers for the core data
+path route through the client, and plugins contribute actions through
+Plugin.actions().
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+
+class ActionType:
+    """A named action (ref: ActionType.java — e.g.
+    indices:data/read/search)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __repr__(self) -> str:
+        return f"ActionType({self.name})"
+
+
+# the reference's core action names, verbatim (ref: action/search/
+# SearchAction.java etc. — the names ARE the wire/authz contract)
+SEARCH = ActionType("indices:data/read/search")
+MSEARCH = ActionType("indices:data/read/msearch")
+GET = ActionType("indices:data/read/get")
+COUNT = ActionType("indices:data/read/count")
+INDEX = ActionType("indices:data/write/index")
+BULK = ActionType("indices:data/write/bulk")
+DELETE = ActionType("indices:data/write/delete")
+UPDATE = ActionType("indices:data/write/update")
+CREATE_INDEX = ActionType("indices:admin/create")
+DELETE_INDEX = ActionType("indices:admin/delete")
+REFRESH = ActionType("indices:admin/refresh")
+CLUSTER_HEALTH = ActionType("cluster:monitor/health")
+
+
+class TransportAction:
+    """One executable action (ref: TransportAction.java). Subclass or
+    wrap a callable; ``pool`` names the thread pool the reference would
+    fork to (used by async execution)."""
+
+    def __init__(self, name: str, handler: Callable[..., Any],
+                 pool: Optional[str] = None):
+        self.name = name
+        self.handler = handler
+        self.pool = pool
+
+    def execute(self, *args, **kwargs) -> Any:
+        return self.handler(*args, **kwargs)
+
+
+class NodeClient:
+    """Execute actions by type (ref: NodeClient.executeLocally — the
+    in-process client every REST handler uses)."""
+
+    def __init__(self, threadpool=None):
+        self._actions: Dict[str, TransportAction] = {}
+        self.threadpool = threadpool
+
+    def register(self, action: TransportAction) -> None:
+        self._actions[action.name] = action
+
+    def action_names(self):
+        return sorted(self._actions)
+
+    def _resolve(self, action) -> TransportAction:
+        name = action.name if isinstance(action, ActionType) else str(action)
+        ta = self._actions.get(name)
+        if ta is None:
+            raise KeyError(f"no registered action [{name}]")
+        return ta
+
+    def execute(self, action, *args, **kwargs) -> Any:
+        """Synchronous execution on the calling thread (the REST path —
+        the reference executes on the transport thread and forks per
+        the action's executor; here sync keeps latency minimal)."""
+        return self._resolve(action).execute(*args, **kwargs)
+
+    def execute_async(self, action, *args,
+                      done: Callable[[Any, Optional[BaseException]], None],
+                      **kwargs) -> None:
+        """Fork onto the action's named pool (ref: TransportAction
+        executing on its configured executor)."""
+        ta = self._resolve(action)
+        pool_name = ta.pool or "management"
+        pool = self.threadpool.executor(pool_name)
+        pool.execute(ta.execute, *args, done=done, **kwargs)
+
+
+def register_core_actions(node) -> NodeClient:
+    """Bind the core data-path actions to the node's services (ref:
+    ActionModule.setupActions — the table mapping ActionType →
+    TransportAction implementations)."""
+    client = NodeClient(node.threadpool)
+    svc = node.search_service
+    indices = node.indices_service
+
+    def _index_doc(index, doc_id, body, **kw):
+        return indices.get(index).index_doc(doc_id, body, **kw)
+
+    def _delete_doc(index, doc_id, **kw):
+        return indices.get(index).delete_doc(doc_id, **kw)
+
+    def _get_doc(index, doc_id, **kw):
+        return indices.get(index).get_doc(doc_id, **kw)
+
+    for action, handler, pool in [
+        (SEARCH, lambda index, body=None, **p:
+            svc.search(index, body or {}, **p), "search"),
+        (COUNT, lambda index, body=None: svc.count(index, body), "search"),
+        (GET, _get_doc, "get"),
+        (INDEX, _index_doc, "write"),
+        (DELETE, _delete_doc, "write"),
+        (CREATE_INDEX, lambda name, settings=None, mappings=None:
+            indices.create_index(name, settings, mappings), "management"),
+        (DELETE_INDEX, lambda name: indices.delete_index(name),
+            "management"),
+        (REFRESH, lambda index: indices.get(index).refresh(),
+            "management"),
+    ]:
+        client.register(TransportAction(action.name, handler, pool))
+
+    return client

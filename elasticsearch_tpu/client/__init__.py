@@ -1,0 +1,255 @@
+"""Typed HTTP client with sniffing.
+
+The analogue of the reference's client libraries (ref:
+client/rest/RestClient.java — round-robin over hosts, retry on
+connect failure, node sniffer; client/rest-high-level — typed request
+methods). Stdlib-only so it runs anywhere the engine does.
+
+    from elasticsearch_tpu.client import Elasticsearch
+    es = Elasticsearch(["http://127.0.0.1:9200"])
+    es.index("logs", {"msg": "hi"}, id="1", refresh=True)
+    es.search("logs", {"query": {"match": {"msg": "hi"}}})
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+
+class TransportError(Exception):
+    def __init__(self, status: int, info: Any):
+        super().__init__(f"TransportError({status}): {info}")
+        self.status = status
+        self.info = info
+
+
+class ConnectionError_(Exception):
+    pass
+
+
+class Transport:
+    """Round-robin host pool with dead-host marking + retries (ref:
+    RestClient's node selection/blacklist) and an optional sniffer that
+    refreshes the host list from /_nodes."""
+
+    def __init__(self, hosts: List[str], max_retries: int = 3,
+                 sniff_interval: Optional[float] = None,
+                 headers: Optional[Dict[str, str]] = None):
+        self.hosts = [h.rstrip("/") for h in hosts]
+        self.max_retries = max_retries
+        self.headers = dict(headers or {})
+        self._dead: Dict[str, float] = {}      # host -> retry-after ts
+        self._rr = random.randrange(len(self.hosts)) if self.hosts else 0
+        self.sniff_interval = sniff_interval
+        self._last_sniff = 0.0
+
+    # ------------------------------------------------------------- hosts
+    def _alive_hosts(self) -> List[str]:
+        now = time.monotonic()
+        alive = [h for h in self.hosts
+                 if self._dead.get(h, 0.0) <= now]
+        return alive or list(self.hosts)
+
+    def _next_host(self) -> str:
+        alive = self._alive_hosts()
+        self._rr = (self._rr + 1) % len(alive)
+        return alive[self._rr]
+
+    def sniff(self) -> List[str]:
+        """GET /_nodes → refresh the host list (ref: the Sniffer)."""
+        status, body = self.perform("GET", "/_nodes", sniffing=True)
+        hosts = []
+        for n in body.get("nodes", {}).values():
+            addr = n.get("http", {}).get("publish_address") \
+                or n.get("transport_address")
+            if addr:
+                hosts.append(f"http://{addr}")
+        if hosts:
+            self.hosts = hosts
+        self._last_sniff = time.monotonic()
+        return self.hosts
+
+    # ----------------------------------------------------------- request
+    def perform(self, method: str, path: str,
+                body: Any = None, params: Optional[Dict] = None,
+                raw_body: Optional[bytes] = None,
+                content_type: str = "application/json",
+                sniffing: bool = False) -> Tuple[int, Any]:
+        if (self.sniff_interval and not sniffing
+                and time.monotonic() - self._last_sniff
+                > self.sniff_interval):
+            try:
+                self.sniff()
+            except Exception:
+                pass
+        if params:
+            path = path + "?" + urllib.parse.urlencode(params)
+        data = raw_body if raw_body is not None else (
+            json.dumps(body).encode() if body is not None else None)
+        last_exc: Optional[Exception] = None
+        for _ in range(self.max_retries):
+            host = self._next_host()
+            req = urllib.request.Request(
+                host + path, method=method, data=data,
+                headers={"Content-Type": content_type, **self.headers})
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    payload = resp.read()
+                    return resp.status, (json.loads(payload)
+                                         if payload else {})
+            except urllib.error.HTTPError as e:
+                payload = e.read()
+                try:
+                    info = json.loads(payload) if payload else {}
+                except ValueError:
+                    info = payload.decode(errors="replace")
+                raise TransportError(e.code, info)
+            except (urllib.error.URLError, OSError) as e:
+                # connection-level failure: mark dead, try another host
+                self._dead[host] = time.monotonic() + 60.0
+                last_exc = e
+        raise ConnectionError_(f"no live hosts: {last_exc}")
+
+
+class IndicesNamespace:
+    def __init__(self, t: Transport):
+        self._t = t
+
+    def create(self, index: str, body: Optional[Dict] = None) -> Dict:
+        return self._t.perform("PUT", f"/{index}", body)[1]
+
+    def delete(self, index: str) -> Dict:
+        return self._t.perform("DELETE", f"/{index}")[1]
+
+    def exists(self, index: str) -> bool:
+        try:
+            self._t.perform("GET", f"/{index}")
+            return True
+        except TransportError as e:
+            if e.status == 404:
+                return False
+            raise
+
+    def refresh(self, index: str) -> Dict:
+        return self._t.perform("POST", f"/{index}/_refresh")[1]
+
+    def get_mapping(self, index: str) -> Dict:
+        return self._t.perform("GET", f"/{index}/_mapping")[1]
+
+    def put_mapping(self, index: str, body: Dict) -> Dict:
+        return self._t.perform("PUT", f"/{index}/_mapping", body)[1]
+
+    def stats(self, index: str) -> Dict:
+        return self._t.perform("GET", f"/{index}/_stats")[1]
+
+
+class ClusterNamespace:
+    def __init__(self, t: Transport):
+        self._t = t
+
+    def health(self) -> Dict:
+        return self._t.perform("GET", "/_cluster/health")[1]
+
+    def stats(self) -> Dict:
+        return self._t.perform("GET", "/_cluster/stats")[1]
+
+
+class Elasticsearch:
+    """Typed client facade (ref: RestHighLevelClient's surface)."""
+
+    def __init__(self, hosts: Iterable[str] = ("http://127.0.0.1:9200",),
+                 basic_auth: Optional[Tuple[str, str]] = None,
+                 api_key: Optional[str] = None,
+                 sniff_interval: Optional[float] = None,
+                 max_retries: int = 3):
+        headers = {}
+        if basic_auth:
+            import base64
+            headers["Authorization"] = "Basic " + base64.b64encode(
+                f"{basic_auth[0]}:{basic_auth[1]}".encode()).decode()
+        elif api_key:
+            headers["Authorization"] = f"ApiKey {api_key}"
+        self.transport = Transport(list(hosts), max_retries,
+                                   sniff_interval, headers)
+        self.indices = IndicesNamespace(self.transport)
+        self.cluster = ClusterNamespace(self.transport)
+
+    # ------------------------------------------------------------- docs
+    def index(self, index: str, document: Dict, id: Optional[str] = None,
+              refresh: bool = False, **params) -> Dict:
+        if refresh:
+            params["refresh"] = "true"
+        if id is None:
+            return self.transport.perform(
+                "POST", f"/{index}/_doc", document, params)[1]
+        return self.transport.perform(
+            "PUT", f"/{index}/_doc/{id}", document, params)[1]
+
+    def get(self, index: str, id: str, **params) -> Dict:
+        return self.transport.perform(
+            "GET", f"/{index}/_doc/{id}", params=params)[1]
+
+    def exists(self, index: str, id: str) -> bool:
+        try:
+            self.get(index, id)
+            return True
+        except TransportError as e:
+            if e.status == 404:
+                return False
+            raise
+
+    def delete(self, index: str, id: str, **params) -> Dict:
+        return self.transport.perform(
+            "DELETE", f"/{index}/_doc/{id}", params=params)[1]
+
+    def update(self, index: str, id: str, body: Dict, **params) -> Dict:
+        return self.transport.perform(
+            "POST", f"/{index}/_update/{id}", body, params)[1]
+
+    def bulk(self, operations: List[Dict], index: Optional[str] = None,
+             refresh: bool = False) -> Dict:
+        """NDJSON bulk (ref: BulkRequest serialization)."""
+        nd = "\n".join(json.dumps(op) for op in operations) + "\n"
+        params = {"refresh": "true"} if refresh else None
+        path = f"/{index}/_bulk" if index else "/_bulk"
+        return self.transport.perform(
+            "POST", path, params=params, raw_body=nd.encode(),
+            content_type="application/x-ndjson")[1]
+
+    # ----------------------------------------------------------- search
+    def search(self, index: str = "_all",
+               body: Optional[Dict] = None, **params) -> Dict:
+        return self.transport.perform(
+            "POST", f"/{index}/_search", body or {}, params)[1]
+
+    def count(self, index: str = "_all",
+              body: Optional[Dict] = None) -> Dict:
+        return self.transport.perform(
+            "POST", f"/{index}/_count", body)[1]
+
+    def msearch(self, searches: List[Dict]) -> Dict:
+        nd = "\n".join(json.dumps(s) for s in searches) + "\n"
+        return self.transport.perform(
+            "POST", "/_msearch", raw_body=nd.encode(),
+            content_type="application/x-ndjson")[1]
+
+    def scroll(self, scroll_id: str, scroll: str = "1m") -> Dict:
+        return self.transport.perform(
+            "POST", "/_search/scroll",
+            {"scroll_id": scroll_id, "scroll": scroll})[1]
+
+    def info(self) -> Dict:
+        return self.transport.perform("GET", "/")[1]
+
+    def ping(self) -> bool:
+        try:
+            self.transport.perform("GET", "/")
+            return True
+        except (TransportError, ConnectionError_):
+            return False

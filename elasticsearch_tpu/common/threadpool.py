@@ -1,0 +1,153 @@
+"""Named thread pools with EWMA execution tracking.
+
+The analogue of the reference's ThreadPool (ref: threadpool/
+ThreadPool.java:117-181 — named executors with fixed sizes and bounded
+queues; TaskExecutionTimeTrackingEsThreadPoolExecutor keeps an EWMA of
+task execution time that feeds adaptive replica selection).
+
+Pools here: ``search`` (shard query/fetch fan-out), ``write`` (bulk /
+indexing), ``get``, ``management``, ``snapshot``. Each pool is a
+bounded ThreadPoolExecutor wrapper that records queue depth, active
+count, completed tasks, rejections, and an execution-time EWMA. The
+search pool's EWMA is exported through node stats so coordinators can
+rank data nodes the way the reference's ARS consumes
+``avg_response_time_ns`` / ``avg_queue_size``.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+
+class EsRejectedExecutionException(RuntimeError):
+    status = 429
+
+
+class TaskTrackingPool:
+    """One named pool: fixed workers + bounded queue + EWMA tracking."""
+
+    def __init__(self, name: str, size: int, queue_size: int = 1000):
+        self.name = name
+        self.size = size
+        self.queue_size = queue_size
+        self._q: "queue.Queue[Optional[tuple]]" = queue.Queue(queue_size)
+        self._threads = []
+        self._shutdown = False
+        self.active = 0
+        self.completed = 0
+        self.rejected = 0
+        self.ewma_ms = 0.0           # task execution time EWMA (alpha .3)
+        self._lock = threading.Lock()
+        for i in range(size):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"estpu[{name}][{i}]")
+            t.start()
+            self._threads.append(t)
+
+    # ----------------------------------------------------------- execution
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, args, kwargs, done = item
+            with self._lock:
+                self.active += 1
+            t0 = time.monotonic()
+            try:
+                result, error = fn(*args, **kwargs), None
+            except BaseException as e:   # noqa: BLE001 — delivered below
+                result, error = None, e
+            dt_ms = (time.monotonic() - t0) * 1000.0
+            with self._lock:
+                self.active -= 1
+                self.completed += 1
+                self.ewma_ms = (dt_ms if self.completed == 1
+                                else 0.7 * self.ewma_ms + 0.3 * dt_ms)
+            if done is not None:
+                done(result, error)
+
+    def execute(self, fn: Callable, *args,
+                done: Optional[Callable] = None, **kwargs) -> None:
+        """Fire-and-forget submit; full queue rejects with 429 (the
+        reference's EsRejectedExecutionException contract)."""
+        if self._shutdown:
+            raise EsRejectedExecutionException(
+                f"pool [{self.name}] is shut down")
+        try:
+            self._q.put_nowait((fn, args, kwargs, done))
+        except queue.Full:
+            with self._lock:
+                self.rejected += 1
+            raise EsRejectedExecutionException(
+                f"rejected execution on [{self.name}]: queue capacity "
+                f"{self.queue_size} reached")
+
+    def submit(self, fn: Callable, *args, **kwargs):
+        """Blocking-future submit for scatter/gather callers."""
+        ev = threading.Event()
+        box: Dict[str, Any] = {}
+
+        def done(result, error):
+            box["r"], box["e"] = result, error
+            ev.set()
+
+        self.execute(fn, *args, done=done, **kwargs)
+
+        class _F:
+            def result(self_, timeout: Optional[float] = None):
+                if not ev.wait(timeout):
+                    raise TimeoutError(
+                        f"task on [{self.name}] timed out")
+                if box["e"] is not None:
+                    raise box["e"]
+                return box["r"]
+
+        return _F()
+
+    # ---------------------------------------------------------------- info
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"threads": self.size, "queue": self._q.qsize(),
+                    "active": self.active, "completed": self.completed,
+                    "rejected": self.rejected,
+                    "ewma_task_ms": round(self.ewma_ms, 3)}
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        for _ in self._threads:
+            try:
+                self._q.put_nowait(None)
+            except queue.Full:
+                pass
+
+
+class ThreadPool:
+    """The node's pool registry (ref: ThreadPool.java — sizes derived
+    from the processor count the way the reference's builders do)."""
+
+    def __init__(self, processors: Optional[int] = None):
+        p = processors or os.cpu_count() or 4
+        half = max(1, p // 2)
+        self.pools: Dict[str, TaskTrackingPool] = {
+            # ref: search pool = 3*p/2+1, queue 1000
+            "search": TaskTrackingPool("search", 3 * p // 2 + 1, 1000),
+            "write": TaskTrackingPool("write", p, 10000),
+            "get": TaskTrackingPool("get", p, 1000),
+            "management": TaskTrackingPool("management", half, 100),
+            "snapshot": TaskTrackingPool("snapshot", half, 1000),
+        }
+
+    def executor(self, name: str) -> TaskTrackingPool:
+        return self.pools[name]
+
+    def stats(self) -> Dict[str, Any]:
+        return {name: pool.stats() for name, pool in self.pools.items()}
+
+    def shutdown(self) -> None:
+        for pool in self.pools.values():
+            pool.shutdown()

@@ -146,10 +146,15 @@ class Engine:
                 os.path.join(self.path, "snapshot_store.json"))
             for name in commit["segments"]:
                 seg_dir = os.path.join(self.path, name)
-                if lazy_manifest and not os.path.isdir(seg_dir):
+                complete = all(
+                    os.path.exists(os.path.join(seg_dir, f))
+                    for f in ("meta.json", "arrays.npz", "stored.bin"))
+                if lazy_manifest and not complete:
                     # snapshot-mounted shard: files stream in lazily on
                     # first search (ref: SearchableSnapshotDirectory —
-                    # mounting costs no local data until queried)
+                    # mounting costs no local data until queried). A
+                    # PARTIAL dir (crash mid-materialize) re-defers too:
+                    # materialization refetches whatever is missing.
                     self._deferred_segments.append(name)
                     continue
                 seg = Segment.load(seg_dir)

@@ -51,6 +51,10 @@ class Node:
             self.keystore = KeyStore(ks_path).load(
                 os.environ.get("ES_KEYSTORE_PASSPHRASE", ""))
         self.breaker_service = HierarchyCircuitBreakerService()
+        # named executors with EWMA task tracking (ref:
+        # ThreadPool.java:117-181, wired ahead of every service)
+        from elasticsearch_tpu.common.threadpool import ThreadPool
+        self.threadpool = ThreadPool()
         self.indices_service = IndicesService(self.data_path, settings)
         self.search_service = SearchService(self.indices_service)
         self.task_manager = TaskManager(self.node_id)
@@ -153,6 +157,11 @@ class Node:
         # per-request thread-local context (authenticated user)
         import threading
         self.request_context = threading.local()
+        # the action seam: ActionType registry + in-process client (ref:
+        # ActionModule.setupActions + NodeClient — REST handlers resolve
+        # actions by name instead of reaching into services)
+        from elasticsearch_tpu.action import register_core_actions
+        self.client = register_core_actions(self)
         self.rest_controller = RestController(self)
         self._http: Optional[HttpServer] = None
         # plugin loading + wiring (ref: node/Node.java:318-320 —
@@ -183,6 +192,7 @@ class Node:
         _engine_mod.LAZY_MATERIALIZERS.pop(self.data_path, None)
         from elasticsearch_tpu.repositories import blobstore as _bs
         _bs.NODE_KEYSTORES.pop(self.data_path, None)
+        self.threadpool.shutdown()
         self.watcher_service.stop()
         self.monitoring_service.stop()
         self.ccr_service.stop()

@@ -74,6 +74,10 @@ class Plugin:
     def rest_handlers(self) -> List[Tuple[str, str, Callable]]:
         return []
 
+    # ActionPlugin.getActions → {action name: handler(node) -> callable}
+    def actions(self) -> Dict[str, Callable]:
+        return {}
+
     # lifecycle hook (Plugin#createComponents-ish)
     def on_node_start(self, node) -> None:
         pass
@@ -181,9 +185,12 @@ class PluginsService:
     def wire_node(self, node) -> None:
         """REST routes + start hooks (called after the node's controller
         exists)."""
+        from elasticsearch_tpu.action import TransportAction
         for info in self.plugins:
             for method, path, handler in info.plugin.rest_handlers():
                 node.rest_controller.register(method, path, handler)
+            for name, factory in info.plugin.actions().items():
+                node.client.register(TransportAction(name, factory(node)))
             info.plugin.on_node_start(node)
 
     def info(self) -> List[Dict[str, Any]]:

@@ -79,8 +79,10 @@ def _sigv4_headers(method: str, url: str, payload: bytes,
         f"{urllib.parse.quote(k, safe='-_.~')}="
         f"{urllib.parse.quote(v, safe='-_.~')}"
         for k, v in sorted(q))
+    # canonical URI is the path AS SENT (already percent-encoded once by
+    # the caller) — re-quoting would double-encode and break real AWS
     canonical = "\n".join([
-        method, urllib.parse.quote(u.path or "/", safe="/-_.~"),
+        method, u.path or "/",
         canonical_query, canonical_headers, signed_headers, payload_hash])
     scope = f"{datestamp}/{region}/{service}/aws4_request"
     to_sign = "\n".join([
@@ -149,21 +151,31 @@ class S3BlobContainer:
         return status == 200
 
     def list_blobs(self) -> List[str]:
-        prefix = f"{self.prefix}/" if self.prefix else ""
-        q = ("list-type=2&prefix="
-             + urllib.parse.quote(prefix, safe=""))
-        status, _, body = self._call(
-            "GET", f"{self.endpoint}/{self.bucket}?{q}")
-        if status != 200:
-            raise RepositoryException(f"S3 LIST failed: {status}")
         import re
-        keys = re.findall(r"<Key>([^<]+)</Key>", body.decode())
-        out = []
-        for k in keys:
-            rest = k[len(prefix):]
-            if rest and "/" not in rest:
-                out.append(rest)
-        return sorted(out)
+        prefix = f"{self.prefix}/" if self.prefix else ""
+        out: List[str] = []
+        token = None
+        while True:   # ListObjectsV2 pagination (1000 keys/page on AWS)
+            q = ("list-type=2&prefix="
+                 + urllib.parse.quote(prefix, safe=""))
+            if token:
+                q += ("&continuation-token="
+                      + urllib.parse.quote(token, safe=""))
+            status, _, body = self._call(
+                "GET", f"{self.endpoint}/{self.bucket}?{q}")
+            if status != 200:
+                raise RepositoryException(f"S3 LIST failed: {status}")
+            text = body.decode()
+            for k in re.findall(r"<Key>([^<]+)</Key>", text):
+                rest = k[len(prefix):]
+                if rest and "/" not in rest:
+                    out.append(rest)
+            m = re.search(
+                r"<NextContinuationToken>([^<]+)</NextContinuationToken>",
+                text)
+            if not m:
+                return sorted(out)
+            token = m.group(1)
 
     def delete_blob(self, name: str) -> None:
         self._call("DELETE", self._url(name))
@@ -239,18 +251,24 @@ class GcsBlobContainer:
 
     def list_blobs(self) -> List[str]:
         prefix = f"{self.prefix}/" if self.prefix else ""
-        url = (f"{self.endpoint}/storage/v1/b/{self.bucket}/o?prefix="
-               + urllib.parse.quote(prefix, safe=""))
-        status, _, body = _http("GET", url, headers=self._h())
-        if status != 200:
-            raise RepositoryException(f"GCS LIST failed: {status}")
-        items = json.loads(body.decode()).get("items", [])
-        out = []
-        for it in items:
-            rest = it["name"][len(prefix):]
-            if rest and "/" not in rest:
-                out.append(rest)
-        return sorted(out)
+        out: List[str] = []
+        token = None
+        while True:   # objects.list pagination (nextPageToken)
+            url = (f"{self.endpoint}/storage/v1/b/{self.bucket}/o?prefix="
+                   + urllib.parse.quote(prefix, safe=""))
+            if token:
+                url += "&pageToken=" + urllib.parse.quote(token, safe="")
+            status, _, body = _http("GET", url, headers=self._h())
+            if status != 200:
+                raise RepositoryException(f"GCS LIST failed: {status}")
+            doc = json.loads(body.decode())
+            for it in doc.get("items", []):
+                rest = it["name"][len(prefix):]
+                if rest and "/" not in rest:
+                    out.append(rest)
+            token = doc.get("nextPageToken")
+            if not token:
+                return sorted(out)
 
     def delete_blob(self, name: str) -> None:
         url = (f"{self.endpoint}/storage/v1/b/{self.bucket}/o/"
@@ -325,21 +343,28 @@ class AzureBlobContainer:
         return status == 200
 
     def list_blobs(self) -> List[str]:
-        prefix = f"{self.prefix}/" if self.prefix else ""
-        p = (f"/{self.container}?restype=container&comp=list&prefix="
-             + urllib.parse.quote(prefix, safe=""))
-        status, _, body = _http("GET", self.endpoint + p,
-                                headers=self._auth("GET", p))
-        if status != 200:
-            raise RepositoryException(f"Azure LIST failed: {status}")
         import re
-        names = re.findall(r"<Name>([^<]+)</Name>", body.decode())
-        out = []
-        for n in names:
-            rest = n[len(prefix):]
-            if rest and "/" not in rest:
-                out.append(rest)
-        return sorted(out)
+        prefix = f"{self.prefix}/" if self.prefix else ""
+        out: List[str] = []
+        marker = None
+        while True:   # List Blobs pagination (NextMarker)
+            p = (f"/{self.container}?restype=container&comp=list&prefix="
+                 + urllib.parse.quote(prefix, safe=""))
+            if marker:
+                p += "&marker=" + urllib.parse.quote(marker, safe="")
+            status, _, body = _http("GET", self.endpoint + p,
+                                    headers=self._auth("GET", p))
+            if status != 200:
+                raise RepositoryException(f"Azure LIST failed: {status}")
+            text = body.decode()
+            for n in re.findall(r"<Name>([^<]+)</Name>", text):
+                rest = n[len(prefix):]
+                if rest and "/" not in rest:
+                    out.append(rest)
+            m = re.search(r"<NextMarker>([^<]+)</NextMarker>", text)
+            if not m:
+                return sorted(out)
+            marker = m.group(1)
 
     def delete_blob(self, name: str) -> None:
         p = self._path(name)

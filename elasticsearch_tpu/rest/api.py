@@ -546,6 +546,10 @@ def nodes_stats(node, params, body):
             "request_cache": node.search_service.request_cache_stats,
             "process": {"max_rss_bytes": ru.ru_maxrss * 1024},
             "breakers": node.breaker_service.stats(),
+            # named executors incl. the search pool's EWMA task time —
+            # the signal adaptive replica selection consumes (ref:
+            # ThreadPool stats / ResponseCollectorService)
+            "thread_pool": node.threadpool.stats(),
         }},
     }
 
@@ -1048,8 +1052,11 @@ def search_index(node, params, body, index):
     with node.task_manager.task_scope(
             "transport", "indices:data/read/search",
             description=f"indices[{index}]", cancellable=True) as task:
-        r = node.search_service.search(
-            index, body, scroll=params.get("scroll"), task=task,
+        # through the action seam (ref: RestSearchAction →
+        # client.execute(SearchAction.INSTANCE, ...))
+        from elasticsearch_tpu.action import SEARCH
+        r = node.client.execute(
+            SEARCH, index, body, scroll=params.get("scroll"), task=task,
             search_type=params.get("search_type"))
     return 200, _apply_fls(node, index, r)
 
@@ -1120,7 +1127,7 @@ def clear_scroll(node, params, body):
 
 def msearch(node, params, body, index=None):
     lines = _ndjson_lines(body)
-    responses = []
+    searches = []
     i = 0
     while i + 1 < len(lines) or (i < len(lines) and index):
         header = lines[i]
@@ -1128,11 +1135,38 @@ def msearch(node, params, body, index=None):
         target = header.get("index", index) or "_all"
         search_body = lines[i] if i < len(lines) else {}
         i += 1
+        searches.append((target, search_body))
+
+    def one(target, search_body):
         try:
             search_body = _apply_alias_filter(node, target, search_body)
-            responses.append(node.search_service.search(target, search_body))
+            return node.search_service.search(target, search_body)
         except ElasticsearchTpuException as e:
-            responses.append({"error": e.to_xcontent(), "status": e.status})
+            return {"error": e.to_xcontent(), "status": e.status}
+
+    # sub-searches fan out on the SEARCH pool (ref:
+    # TransportMultiSearchAction executing per-request on the search
+    # executor) — concurrent sub-searches also coalesce into shared
+    # batched launches downstream
+    if len(searches) > 1:
+        from elasticsearch_tpu.common.threadpool import (
+            EsRejectedExecutionException)
+        futures = []
+        for t, b in searches:
+            try:
+                futures.append(
+                    node.threadpool.executor("search").submit(one, t, b))
+            except EsRejectedExecutionException as e:
+                # a full search queue rejects THIS sub-search with 429,
+                # never the whole msearch (ref: per-item rejection in
+                # TransportMultiSearchAction)
+                futures.append({
+                    "error": {"type": "es_rejected_execution_exception",
+                              "reason": str(e)}, "status": 429})
+        responses = [f.result() if hasattr(f, "result") else f
+                     for f in futures]
+    else:
+        responses = [one(t, b) for t, b in searches]
     return 200, {"responses": responses}
 
 

@@ -157,42 +157,52 @@ def materialize_segment(shard_path: str, seg_name: str,
     repo = repositories_service.get_repository(m["repository"])
     container = repo.blobstore.container(
         "indices", m["source_index"], str(m["shard"]))
-    cache = node_cache(data_path, m.get("cache_budget"))
+    cache = node_cache(data_path)
     seg_dir = os.path.join(shard_path, seg_name)
     os.makedirs(seg_dir, exist_ok=True)
     for fname, blob in files.items():
-        local = cache.get(m["repository"], m["source_index"],
-                          str(m["shard"]), blob,
-                          lambda b=blob: container.read_blob(b))
         dest = os.path.join(seg_dir, fname)
-        if fname == "meta.json":
-            # meta.json is REWRITTEN with the mount's segment name
-            # (device caches key on names node-wide) — always a private
-            # copy; a hard link would mutate the shared cache entry and
-            # cross-contaminate other mounts of the same snapshot
-            with open(local) as fh:
-                meta = json.load(fh)
-            if meta.get("name") != seg_name:
-                meta["name"] = seg_name
-            with open(dest, "w") as fh:
-                json.dump(meta, fh)
-        elif not os.path.exists(dest):
-            if m.get("storage") == "full_copy":
-                shutil.copyfile(local, dest)
-            else:
-                # shared_cache: hard-link the immutable data files so
-                # eviction of the cache entry leaves open readers
-                # intact but reclaims space once the segment drops
-                try:
-                    os.link(local, dest)
-                except OSError:
-                    shutil.copyfile(local, dest)
+        # a concurrent miss can LRU-evict the returned path before we
+        # consume it — refetch once on a vanished file
+        for attempt in (0, 1):
+            local = cache.get(m["repository"], m["source_index"],
+                              str(m["shard"]), blob,
+                              lambda b=blob: container.read_blob(b))
+            try:
+                if fname == "meta.json":
+                    # meta.json is REWRITTEN with the mount's segment
+                    # name (device caches key on names node-wide) —
+                    # always a private ATOMIC copy; a hard link would
+                    # mutate the shared cache entry and
+                    # cross-contaminate other mounts
+                    with open(local) as fh:
+                        meta = json.load(fh)
+                    meta["name"] = seg_name
+                    tmp = f"{dest}.tmp-{threading.get_ident()}"
+                    with open(tmp, "w") as fh:
+                        json.dump(meta, fh)
+                    os.replace(tmp, dest)
+                elif not os.path.exists(dest):
+                    if m.get("storage") == "full_copy":
+                        shutil.copyfile(local, dest)
+                    else:
+                        # shared_cache: hard-link the immutable data
+                        # files so eviction of the cache entry leaves
+                        # open readers intact while reclaiming space
+                        # once the segment drops
+                        try:
+                            os.link(local, dest)
+                        except OSError:
+                            shutil.copyfile(local, dest)
+                break
+            except FileNotFoundError:
+                if attempt:
+                    raise
     return True
 
 
 def mount(node, repo_name: str, snapshot: str, index: str,
-          renamed: str, storage: str = "full_copy",
-          cache_budget: Optional[int] = None) -> Dict[str, Any]:
+          renamed: str, storage: str = "full_copy") -> Dict[str, Any]:
     """MountSearchableSnapshotAction: create the index shell + manifests
     WITHOUT copying data files; segments stream in on first search."""
     import uuid as _uuid
@@ -229,7 +239,6 @@ def mount(node, repo_name: str, snapshot: str, index: str,
             "source_index": index,
             "shard": shard_id,
             "storage": storage,
-            "cache_budget": cache_budget,
             "segments": {name_map[s]: files
                          for s, files in shard_meta["segments"].items()},
         })

@@ -1,0 +1,191 @@
+"""Action seam + typed client + thread pools (ref: ActionModule /
+NodeClient tests, RestClient round-robin/sniffer tests,
+ThreadPool/EsRejectedExecutionException tests)."""
+
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.threadpool import (
+    EsRejectedExecutionException,
+    TaskTrackingPool,
+    ThreadPool,
+)
+from elasticsearch_tpu.node import Node
+
+
+# --------------------------------------------------------------- threadpool
+
+def test_pool_executes_and_tracks_ewma():
+    pool = TaskTrackingPool("t", 2, 10)
+    try:
+        f = pool.submit(lambda: sum(range(1000)))
+        assert f.result(5) == 499500
+        for _ in range(5):
+            pool.submit(time.sleep, 0.01).result(5)
+        st = pool.stats()
+        assert st["completed"] >= 6
+        assert st["ewma_task_ms"] > 0
+    finally:
+        pool.shutdown()
+
+
+def test_pool_rejects_when_full():
+    pool = TaskTrackingPool("tiny", 1, 1)
+    try:
+        gate = threading.Event()
+        pool.execute(gate.wait)          # occupies the worker
+        deadline = time.time() + 5
+        while pool.stats()["active"] < 1 and time.time() < deadline:
+            time.sleep(0.01)             # wait until the worker holds it
+        pool.execute(lambda: None)       # fills the queue
+        with pytest.raises(EsRejectedExecutionException):
+            for _ in range(5):
+                pool.execute(lambda: None)
+        gate.set()
+        assert pool.stats()["rejected"] >= 1
+    finally:
+        gate.set()
+        pool.shutdown()
+
+
+def test_threadpool_registry_names():
+    tp = ThreadPool(processors=4)
+    try:
+        assert set(tp.stats()) == {"search", "write", "get",
+                                   "management", "snapshot"}
+        assert tp.executor("search").size == 7   # 3*p/2+1
+    finally:
+        tp.shutdown()
+
+
+# -------------------------------------------------------------- action seam
+
+def test_node_client_actions(tmp_path):
+    node = Node(data_path=str(tmp_path / "n"))
+    try:
+        from elasticsearch_tpu import action as act
+        node.client.execute(act.CREATE_INDEX, "t", None,
+                            {"properties": {"x": {"type": "long"}}})
+        node.client.execute(act.INDEX, "t", "1", {"x": 5})
+        node.client.execute(act.REFRESH, "t")
+        r = node.client.execute(act.SEARCH, "t",
+                                {"query": {"match_all": {}}})
+        assert r["hits"]["total"]["value"] == 1
+        got = node.client.execute(act.GET, "t", "1")
+        assert got.source == {"x": 5}
+        # unknown actions are a clear error
+        with pytest.raises(KeyError):
+            node.client.execute("indices:data/read/nonexistent")
+        # the REST search handler routes through the seam
+        assert "indices:data/read/search" in node.client.action_names()
+        # async execution forks onto the named pool
+        box = {}
+        ev = threading.Event()
+        node.client.execute_async(
+            act.SEARCH, "t", {"query": {"match_all": {}}},
+            done=lambda r, e: (box.update(r=r, e=e), ev.set()))
+        assert ev.wait(10) and box["e"] is None
+        assert box["r"]["hits"]["total"]["value"] == 1
+        assert node.threadpool.executor("search").stats()["completed"] >= 1
+    finally:
+        node.close()
+
+
+def test_plugin_contributed_action(tmp_path):
+    import json as _json
+    import os
+    import textwrap
+    pdir = tmp_path / "plugins" / "actplug"
+    os.makedirs(pdir)
+    (pdir / "plugin.json").write_text(_json.dumps(
+        {"name": "actplug", "module": "act_plugin", "class": "ESPlugin"}))
+    (pdir / "act_plugin.py").write_text(textwrap.dedent("""
+        from elasticsearch_tpu.plugins import Plugin
+        class ESPlugin(Plugin):
+            name = "actplug"
+            def actions(self):
+                return {"cluster:custom/echo":
+                        lambda node: (lambda msg: {"echo": msg})}
+    """))
+    from elasticsearch_tpu.common.settings import Settings
+    node = Node(settings=Settings.from_dict(
+        {"path": {"plugins": str(tmp_path / "plugins")}}),
+        data_path=str(tmp_path / "d"))
+    try:
+        assert node.client.execute("cluster:custom/echo", "hi") == \
+            {"echo": "hi"}
+    finally:
+        node.close()
+
+
+# -------------------------------------------------------------- typed client
+
+def test_typed_client_roundtrip(tmp_path):
+    from elasticsearch_tpu.client import Elasticsearch, TransportError
+
+    node = Node(data_path=str(tmp_path / "n"))
+    port = node.start(0)
+    try:
+        es = Elasticsearch([f"http://127.0.0.1:{port}"])
+        assert es.ping()
+        es.indices.create("logs", {"mappings": {"properties": {
+            "msg": {"type": "text"}, "n": {"type": "long"}}}})
+        assert es.indices.exists("logs")
+        es.index("logs", {"msg": "hello world", "n": 1}, id="1")
+        es.index("logs", {"msg": "goodbye world", "n": 2}, id="2",
+                 refresh=True)
+        assert es.get("logs", "1")["_source"]["n"] == 1
+        assert es.exists("logs", "1") and not es.exists("logs", "404")
+
+        r = es.search("logs", {"query": {"match": {"msg": "world"}}})
+        assert r["hits"]["total"]["value"] == 2
+        assert es.count("logs")["count"] == 2
+
+        # NDJSON bulk
+        r = es.bulk([
+            {"index": {"_index": "logs", "_id": "3"}},
+            {"msg": "bulked", "n": 3},
+            {"delete": {"_index": "logs", "_id": "2"}},
+        ], refresh=True)
+        assert not r["errors"]
+        assert es.count("logs")["count"] == 2
+
+        # msearch through the client (parallel on the search pool)
+        r = es.msearch([
+            {"index": "logs"}, {"query": {"match_all": {}}},
+            {"index": "logs"}, {"query": {"match": {"msg": "bulked"}}},
+        ])
+        assert [x["hits"]["total"]["value"] for x in r["responses"]] \
+            == [2, 1]
+
+        # update + delete + error surface
+        es.update("logs", "1", {"doc": {"n": 10}})
+        assert es.get("logs", "1")["_source"]["n"] == 10
+        es.delete("logs", "1")
+        with pytest.raises(TransportError) as ei:
+            es.get("logs", "1")
+        assert ei.value.status == 404
+        assert es.cluster.health()["status"] in ("green", "yellow")
+    finally:
+        node.close()
+
+
+def test_client_failover_and_sniff(tmp_path):
+    from elasticsearch_tpu.client import Elasticsearch
+
+    node = Node(data_path=str(tmp_path / "n"))
+    port = node.start(0)
+    try:
+        # first host is dead: the client marks it and fails over
+        es = Elasticsearch(["http://127.0.0.1:9",
+                            f"http://127.0.0.1:{port}"], max_retries=4)
+        assert es.ping()
+        info = es.info()
+        assert "version" in info or "cluster_name" in info
+        # sniffer rebuilds the host list from /_nodes
+        hosts = es.transport.sniff()
+        assert hosts
+    finally:
+        node.close()

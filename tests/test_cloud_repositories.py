@@ -66,7 +66,7 @@ class _FakeCloudHandler(BaseHTTPRequestHandler):
             f"x-amz-content-sha256:{payload_hash}\n"
             f"x-amz-date:{self.headers['x-amz-date']}\n")
         canonical = "\n".join([
-            self.command, urllib.parse.quote(u.path or "/", safe="/-_.~"),
+            self.command, u.path or "/",
             canonical_query, canonical_headers, signed_headers,
             payload_hash])
         scope = f"{datestamp}/{region}/s3/aws4_request"
@@ -122,9 +122,15 @@ class _FakeCloudHandler(BaseHTTPRequestHandler):
                 return
             if re.match(r"/storage/v1/b/[^/]+/o$", path):
                 prefix = q.get("prefix", "")
-                items = [{"name": k} for k in sorted(blobs)
-                         if k.startswith(prefix)]
-                self._send(200, json.dumps({"items": items}).encode(),
+                keys = [k for k in sorted(blobs) if k.startswith(prefix)]
+                start = 0
+                if q.get("pageToken"):
+                    start = keys.index(q["pageToken"]) + 1
+                page = keys[start:start + 3]     # force pagination
+                doc = {"items": [{"name": k} for k in page]}
+                if start + 3 < len(keys):
+                    doc["nextPageToken"] = page[-1]
+                self._send(200, json.dumps(doc).encode(),
                            "application/json")
                 return
             self._send(404, b"{}")
@@ -145,9 +151,16 @@ class _FakeCloudHandler(BaseHTTPRequestHandler):
         if "list-type" in q or q.get("comp") == "list":
             prefix = q.get("prefix", "")
             tag = "Key" if mode == "s3" else "Name"
-            keys = "".join(f"<{tag}>{k}</{tag}>" for k in sorted(blobs)
-                           if k.startswith(prefix))
-            self._send(200, f"<List>{keys}</List>".encode(),
+            keys = [k for k in sorted(blobs) if k.startswith(prefix)]
+            marker = q.get("continuation-token") or q.get("marker")
+            start = keys.index(marker) + 1 if marker in keys else 0
+            page = keys[start:start + 3]         # force pagination
+            xml = "".join(f"<{tag}>{k}</{tag}>" for k in page)
+            if start + 3 < len(keys):
+                nxt = ("NextContinuationToken" if mode == "s3"
+                       else "NextMarker")
+                xml += f"<{nxt}>{page[-1]}</{nxt}>"
+            self._send(200, f"<List>{xml}</List>".encode(),
                        "application/xml")
             return
         if self.command == "PUT":
