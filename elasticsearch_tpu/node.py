@@ -126,7 +126,12 @@ class Node:
             audit_enabled=bool(
                 settings.get("xpack.security.audit.enabled", False)),
             pki_header_trusted=bool(settings.get(
-                "xpack.security.authc.pki.trust_proxy_header", False)))
+                "xpack.security.authc.pki.trust_proxy_header", False)),
+            keystore=self.keystore,
+            jwt_issuer=settings.get(
+                "xpack.security.authc.jwt.allowed_issuer"),
+            jwt_audience=settings.get(
+                "xpack.security.authc.jwt.allowed_audiences"))
         from elasticsearch_tpu.xpack.sql import SqlService
         self.sql_service = SqlService(self)
         from elasticsearch_tpu.xpack.eql import EqlService

@@ -23,6 +23,7 @@ from __future__ import annotations
 import base64
 import fnmatch
 import hashlib
+import hmac
 import json
 import os
 import secrets
@@ -295,6 +296,147 @@ class ApiKeyRealm(Realm):
                     api_key_roles=list(rd.values()) if rd else None)
 
 
+class FileRealm(Realm):
+    """File-based users (ref: x-pack file realm — `users` and
+    `users_roles` files next to the node config, bcrypt there, PBKDF2
+    here via the shared hasher). Reloaded lazily on mtime change."""
+
+    type = "file"
+
+    def __init__(self, name, order, svc):
+        super().__init__(name, order, svc)
+        self._mtime = None
+        self._users: Dict[str, str] = {}
+        self._roles: Dict[str, List[str]] = {}
+
+    def _paths(self):
+        base = os.path.dirname(self.svc._path) if self.svc._path else None
+        if base is None:
+            return None, None
+        return os.path.join(base, "users"), os.path.join(base,
+                                                         "users_roles")
+
+    def _reload(self):
+        upath, rpath = self._paths()
+        if upath is None or not os.path.exists(upath):
+            self._users = {}
+            return
+        mtime = os.path.getmtime(upath)
+        if mtime == self._mtime:
+            return
+        self._mtime = mtime
+        users: Dict[str, str] = {}
+        with open(upath) as f:
+            for line in f:
+                line = line.strip()
+                if line and not line.startswith("#") and ":" in line:
+                    name, _, hashed = line.partition(":")
+                    users[name] = hashed
+        roles: Dict[str, List[str]] = {}
+        if rpath and os.path.exists(rpath):
+            with open(rpath) as f:
+                for line in f:
+                    line = line.strip()
+                    if line and not line.startswith("#") and ":" in line:
+                        role, _, names = line.partition(":")
+                        for n in names.split(","):
+                            roles.setdefault(n.strip(), []).append(role)
+        self._users, self._roles = users, roles
+
+    def token(self, headers):
+        auth = headers.get("authorization", "")
+        if auth.lower().startswith("basic "):
+            return auth.partition(" ")[2]
+        return None
+
+    def authenticate(self, payload) -> "User":
+        self._reload()
+        try:
+            username, _, password = base64.b64decode(
+                payload).decode().partition(":")
+        except Exception:
+            raise AuthenticationException("invalid basic credentials")
+        hashed = self._users.get(username)
+        if hashed is None or not _verify_password(password, hashed):
+            raise AuthenticationException(
+                f"unable to authenticate user [{username}] in the file "
+                f"realm")
+        return User(username, self._roles.get(username, []))
+
+
+class JwtRealm(Realm):
+    """JWT bearer authentication (ref: x-pack JWT realm). HS256 only —
+    the shared secret is a keystore-only secure setting
+    (xpack.security.authc.jwt.hmac_key). Principal = `sub` claim; roles
+    come from a `roles` claim or role mappings; `exp`/`iss`/`aud` are
+    enforced when configured."""
+
+    type = "jwt"
+
+    def __init__(self, name, order, svc, issuer: Optional[str] = None,
+                 audience: Optional[str] = None):
+        super().__init__(name, order, svc)
+        self.issuer = issuer
+        self.audience = audience
+
+    def _key(self) -> Optional[bytes]:
+        ks = getattr(self.svc, "keystore", None)
+        if ks is not None and ks.is_loaded \
+                and ks.has("xpack.security.authc.jwt.hmac_key"):
+            return ks.get_string(
+                "xpack.security.authc.jwt.hmac_key").encode()
+        return None
+
+    def token(self, headers):
+        auth = headers.get("authorization", "")
+        if auth.lower().startswith("bearer ") \
+                and auth.count(".") == 2 and self._key() is not None:
+            return auth.partition(" ")[2]
+        return None
+
+    @staticmethod
+    def _b64url(data: str) -> bytes:
+        pad = "=" * (-len(data) % 4)
+        return base64.urlsafe_b64decode(data + pad)
+
+    def authenticate(self, jwt: str) -> "User":
+        key = self._key()
+        try:
+            header_b64, claims_b64, sig_b64 = jwt.split(".")
+            header = json.loads(self._b64url(header_b64))
+            claims = json.loads(self._b64url(claims_b64))
+            sig = self._b64url(sig_b64)
+        except Exception:
+            raise AuthenticationException("malformed JWT")
+        if header.get("alg") != "HS256":
+            raise AuthenticationException(
+                f"unsupported JWT alg [{header.get('alg')}]")
+        want = hmac.new(key, f"{header_b64}.{claims_b64}".encode(),
+                        hashlib.sha256).digest()
+        if not hmac.compare_digest(want, sig):
+            raise AuthenticationException("JWT signature is invalid")
+        if claims.get("exp") is not None \
+                and claims["exp"] < time.time():
+            raise AuthenticationException("JWT is expired")
+        if self.issuer and claims.get("iss") != self.issuer:
+            raise AuthenticationException("JWT issuer mismatch")
+        if self.audience:
+            aud = claims.get("aud")
+            auds = aud if isinstance(aud, list) else [aud]
+            if self.audience not in auds:
+                raise AuthenticationException("JWT audience mismatch")
+        sub = claims.get("sub")
+        if not sub:
+            raise AuthenticationException("JWT has no [sub] claim")
+        roles = list(claims.get("roles", []))
+        roles += self.svc.mapped_roles(username=sub, dn="",
+                                       realm=self.name)
+        return User(sub, sorted(set(roles)),
+                    metadata={"jwt_claims": {k: v for k, v in
+                                             claims.items()
+                                             if k != "roles"}})
+
+
 class PkiRealm(Realm):
     """Client-certificate authentication (ref: pki/PkiRealm.java). The
     certificate arrives either on the `x-ssl-client-cert` header (PEM,
@@ -408,7 +550,10 @@ class SecurityService:
                  anonymous_roles: Optional[List[str]] = None,
                  audit_enabled: bool = False,
                  realm_orders: Optional[Dict[str, int]] = None,
-                 pki_header_trusted: bool = False):
+                 pki_header_trusted: bool = False,
+                 keystore=None,
+                 jwt_issuer: Optional[str] = None,
+                 jwt_audience: Optional[str] = None):
         # ref: x-pack anonymous access (xpack.security.authc.anonymous.*)
         # — requests without credentials authenticate as this principal
         self.anonymous_username = anonymous_username
@@ -439,12 +584,16 @@ class SecurityService:
                 "metadata": {"_reserved": True}, "enabled": True}
         # ordered realm chain (ref: Realms.java — order from settings,
         # xpack.security.authc.realms.<type>.<name>.order)
+        self.keystore = keystore
         orders = realm_orders or {}
         self.realms: List[Realm] = sorted([
             NativeRealm("native1", orders.get("native", 0), self),
-            TokenRealm("token1", orders.get("token", 1), self),
-            ApiKeyRealm("api_key1", orders.get("api_key", 2), self),
-            PkiRealm("pki1", orders.get("pki", 3), self),
+            FileRealm("file1", orders.get("file", 1), self),
+            TokenRealm("token1", orders.get("token", 2), self),
+            JwtRealm("jwt1", orders.get("jwt", 3), self,
+                     issuer=jwt_issuer, audience=jwt_audience),
+            ApiKeyRealm("api_key1", orders.get("api_key", 4), self),
+            PkiRealm("pki1", orders.get("pki", 5), self),
         ], key=lambda r: r.order)
 
     # ------------------------------------------------------------- persist

@@ -219,3 +219,84 @@ def test_audit_log_events(node, tmp_path):
     ok = [e for e in events
           if e["event.action"] == "authentication_success"]
     assert ok[0]["realm"] == "native1"
+
+
+# ----------------------------------------------------- file + JWT realms
+
+def test_file_realm(node, tmp_path):
+    from elasticsearch_tpu.xpack.security import _hash_password
+    data = str(tmp_path / "data")
+    with open(os.path.join(data, "users"), "w") as f:
+        f.write("# users file\nfiona:" + _hash_password("filepass1") + "\n")
+    with open(os.path.join(data, "users_roles"), "w") as f:
+        f.write("monitoring_user:fiona\n")
+    me = call(node, "GET", "/_security/_authenticate",
+              headers=basic("fiona", "filepass1"))
+    assert me["username"] == "fiona"
+    assert me["roles"] == ["monitoring_user"]
+    call(node, "GET", "/_security/_authenticate",
+         headers=basic("fiona", "wrong"), expect=401)
+
+
+def _hs256(claims, key):
+    import hashlib
+    import hmac as _hmac
+
+    def enc(obj):
+        raw = json.dumps(obj, separators=(",", ":")).encode()
+        return base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
+
+    head = enc({"alg": "HS256", "typ": "JWT"})
+    body = enc(claims)
+    sig = _hmac.new(key, f"{head}.{body}".encode(),
+                    hashlib.sha256).digest()
+    return f"{head}.{body}." + \
+        base64.urlsafe_b64encode(sig).rstrip(b"=").decode()
+
+
+@pytest.fixture()
+def jwt_node(tmp_path):
+    from elasticsearch_tpu.common.keystore import (KEYSTORE_FILENAME,
+                                                   KeyStore)
+    from elasticsearch_tpu.common.settings import Settings
+    data = tmp_path / "jwtdata"
+    data.mkdir()
+    ks = KeyStore.create(str(data / KEYSTORE_FILENAME), "")
+    ks.set_string("xpack.security.authc.jwt.hmac_key", "jwt-hmac-secret")
+    ks.set_string("bootstrap.password", "s3cret")
+    ks.save("")
+    n = Node(settings=Settings.from_dict({
+        "xpack": {"security": {
+            "enabled": True,
+            "authc": {"jwt": {"allowed_issuer": "https://idp.test"}}}},
+    }), data_path=str(data))
+    yield n
+    n.close()
+
+
+def test_jwt_realm(jwt_node):
+    import time as _time
+    key = b"jwt-hmac-secret"
+    good = _hs256({"sub": "svc-bot", "iss": "https://idp.test",
+                   "exp": _time.time() + 600,
+                   "roles": ["monitoring_user"]}, key)
+    me = call(jwt_node, "GET", "/_security/_authenticate",
+              headers={"Authorization": f"Bearer {good}"})
+    assert me["username"] == "svc-bot"
+    assert "monitoring_user" in me["roles"]
+    # JWT users pass authorization with their claimed roles
+    call(jwt_node, "GET", "/_cluster/health",
+         headers={"Authorization": f"Bearer {good}"})
+
+    expired = _hs256({"sub": "svc-bot", "iss": "https://idp.test",
+                      "exp": _time.time() - 5}, key)
+    call(jwt_node, "GET", "/_security/_authenticate",
+         headers={"Authorization": f"Bearer {expired}"}, expect=401)
+    wrong_iss = _hs256({"sub": "x", "iss": "https://evil.test",
+                        "exp": _time.time() + 600}, key)
+    call(jwt_node, "GET", "/_security/_authenticate",
+         headers={"Authorization": f"Bearer {wrong_iss}"}, expect=401)
+    forged = _hs256({"sub": "admin", "iss": "https://idp.test",
+                     "exp": _time.time() + 600}, b"other-key")
+    call(jwt_node, "GET", "/_security/_authenticate",
+         headers={"Authorization": f"Bearer {forged}"}, expect=401)
