@@ -832,6 +832,9 @@ class SearchService:
                 "hits": hits,
             },
         }
+        if track_total is False:
+            # ES omits hits.total entirely when tracking is disabled
+            del response["hits"]["total"]
         if terminated_early is not None:
             response["terminated_early"] = terminated_early
         if aggregations is not None:

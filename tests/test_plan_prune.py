@@ -210,3 +210,25 @@ def test_rest_relation_gte(tmp_path):
         assert resp["hits"]["total"]["relation"] in ("eq", "gte")
     finally:
         node.close()
+
+
+def test_track_total_hits_false_omits_total(tmp_path):
+    """ES omits hits.total entirely when track_total_hits=false."""
+    from elasticsearch_tpu.node import Node
+
+    node = Node(data_path=str(tmp_path / "tt"))
+    try:
+        node.rest_controller.dispatch("PUT", "/t", None, {
+            "mappings": {"properties": {"m": {"type": "text"}}}})
+        node.rest_controller.dispatch("PUT", "/t/_doc/1", None,
+                                      {"m": "x y"})
+        node.rest_controller.dispatch("POST", "/t/_refresh", None, None)
+        st, resp = node.rest_controller.dispatch(
+            "POST", "/t/_search", None,
+            {"query": {"match": {"m": "x"}},
+             "track_total_hits": False})
+        assert st == 200
+        assert "total" not in resp["hits"]
+        assert len(resp["hits"]["hits"]) == 1
+    finally:
+        node.close()
