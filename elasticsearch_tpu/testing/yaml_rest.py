@@ -150,7 +150,11 @@ class YamlRestRunner:
                 raise YamlTestFailure(
                     f"length {path}: {len(actual)} != {expected}")
         elif kind in ("is_true", "is_false"):
-            v = _resolve_path(self.last_response, self._subst(body))
+            try:
+                v = _resolve_path(self.last_response, self._subst(body))
+            except YamlTestFailure:
+                # ES runner semantics: a missing path is simply falsy
+                v = None
             truthy = bool(v) and v not in ("false",)
             if truthy != (kind == "is_true"):
                 raise YamlTestFailure(f"{kind} {body}: got [{v!r}]")
