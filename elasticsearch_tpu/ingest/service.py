@@ -1036,6 +1036,8 @@ class IngestService:
         return {"docs": results}
 
 
-# geoip/user_agent processors register on import (they live in their own
-# module the way ingest-geoip/ingest-user-agent are separate modules)
+# geoip/user_agent/attachment processors register on import (they live
+# in their own modules the way ingest-geoip/-user-agent/-attachment are
+# separate modules/plugins in the reference)
+from elasticsearch_tpu.ingest import attachment  # noqa: E402,F401
 from elasticsearch_tpu.ingest import geo_ua  # noqa: E402,F401

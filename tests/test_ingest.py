@@ -367,3 +367,85 @@ def test_verbose_simulate(svc):
     assert trace[0]["doc"]["_source"] == {"a": 1}
     assert trace[1]["status"] == "error"
     assert len(trace) == 2  # aborted after the failure
+
+
+# ------------------------------------------------- attachment processor
+
+def test_attachment_processor_formats(tmp_path):
+    """`attachment` (ref: plugins/ingest-attachment): text-bearing
+    formats extract; binary formats are detected, not mangled."""
+    import base64
+    from elasticsearch_tpu.node import Node
+
+    node = Node(data_path=str(tmp_path / "att"))
+
+    def call(method, path, body=None, expect=200, **params):
+        st, r = node.rest_controller.dispatch(method, path, params, body)
+        assert st == expect, r
+        return r
+
+    try:
+        call("PUT", "/_ingest/pipeline/att", {
+            "processors": [{"attachment": {
+                "field": "data", "remove_binary": True}}]})
+        call("PUT", "/docs", None)
+
+        def ingest(i, payload: bytes):
+            call("PUT", f"/docs/_doc/{i}", {
+                "data": base64.b64encode(payload).decode()},
+                expect=201, pipeline="att")
+            call("POST", "/docs/_refresh")
+            return call("GET", f"/docs/_doc/{i}")["_source"]
+
+        src = ingest(1, "plain text body café".encode())
+        assert src["attachment"]["content_type"] == "text/plain"
+        assert "café" in src["attachment"]["content"]
+        assert "data" not in src    # remove_binary
+
+        src = ingest(2, b"<html><head><title>My Page</title></head>"
+                        b"<body><p>Hello <b>world</b></p>"
+                        b"<script>junk()</script></body></html>")
+        assert src["attachment"]["content_type"] == "text/html"
+        assert src["attachment"]["title"] == "My Page"
+        assert "Hello world" in src["attachment"]["content"]
+        assert "junk" not in src["attachment"]["content"]
+
+        src = ingest(3, br"{\rtf1\ansi Hello {\b bold} rtf}")
+        assert src["attachment"]["content_type"] == "application/rtf"
+        assert "Hello" in src["attachment"]["content"]
+
+        src = ingest(4, b"%PDF-1.7 fake binary")
+        assert src["attachment"]["content_type"] == "application/pdf"
+        assert src["attachment"]["content"] == ""
+
+        src = ingest(5, "text utf16".encode("utf-16"))
+        assert "text utf16" in src["attachment"]["content"]
+    finally:
+        node.close()
+
+
+def test_attachment_properties_and_missing(tmp_path):
+    import base64
+    from elasticsearch_tpu.node import Node
+
+    node = Node(data_path=str(tmp_path / "att2"))
+
+    def call(method, path, body=None, expect=200, **params):
+        st, r = node.rest_controller.dispatch(method, path, params, body)
+        assert st == expect, r
+        return r
+
+    try:
+        call("PUT", "/_ingest/pipeline/p", {
+            "processors": [{"attachment": {
+                "field": "data", "properties": ["content"],
+                "indexed_chars": 5, "ignore_missing": True}}]})
+        r = call("POST", "/_ingest/pipeline/p/_simulate", {
+            "docs": [{"_source": {"data": base64.b64encode(
+                b"abcdefghij").decode()}},
+                {"_source": {"other": 1}}]})
+        att = r["docs"][0]["doc"]["_source"]["attachment"]
+        assert att == {"content": "abcde"}   # properties + indexed_chars
+        assert r["docs"][1]["doc"]["_source"] == {"other": 1}
+    finally:
+        node.close()
