@@ -113,7 +113,7 @@ def make_queries(rng, df):
     ]
     bands = [b for b in bands if len(b) > 0]
     nb = (df + BLOCK - 1) // BLOCK
-    max_blocks = int(os.environ.get("BENCH_MAX_BLOCKS", 8192))
+    max_blocks = int(os.environ.get("BENCH_MAX_BLOCKS", 4096))
     queries = []
     for _ in range(N_QUERIES):
         n_terms = int(rng.integers(1, 9))
@@ -531,13 +531,19 @@ def run_rest_path(corpus, queries, truth, tmpdir):
 
     one_round(1)   # warm Q=32 compiles + caches
     best_qps, best_lats = 0.0, []
+    base = node.search_service.plan_batcher.stats()
     for _ in range(3):
         qps, lats = one_round(2)
         if qps > best_qps:
             best_qps, best_lats = qps, lats
     p50 = float(np.median(best_lats) * 1000)
     p99 = float(np.percentile(best_lats, 99) * 1000)
-    bstats = node.search_service.plan_batcher.stats()
+    end = node.search_service.plan_batcher.stats()
+    # cohort size over the CONCURRENT phase only (the sequential recall
+    # pass runs batch-1 launches and would dilute the stat)
+    dl = max(1, end["launches"] - base["launches"])
+    bstats = {"avg_batch":
+              (end["batched_queries"] - base["batched_queries"]) / dl}
     log(f"REST serving: {best_qps:.1f} qps with {CLIENTS} clients "
         f"(p50 {p50:.2f} ms, p99 {p99:.2f} ms, "
         f"avg batch {bstats['avg_batch']:.1f})")

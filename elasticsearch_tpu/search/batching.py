@@ -124,15 +124,23 @@ class PlanBatcher:
         # leader: let the cohort grow while the device is slow, then wait
         # for a launch slot and take the whole queue. Non-leader entries
         # are always popped by a leader that appended before them, so
-        # nothing is orphaned. The wait only engages when concurrency is
-        # actually present (other work pending) — an idle single query
-        # never pays it.
+        # nothing is orphaned. The wait engages only when concurrency is
+        # actually present (other work pending) and is STAGED: stop as
+        # soon as this signature's cohort fills a max batch — when a
+        # launch costs seconds, padding a 3-query cohort to the batch
+        # shape wastes ~10x device time, so waiting a fraction of the
+        # measured round-trip to fill the cohort is strictly cheaper.
         if self._lat_ema > 0.03:
-            with self._lock:
-                busy = (len(self._pending) > 1
-                        or any(len(q) > 1 for q in self._pending.values()))
-            if busy:
-                time.sleep(min(0.5 * self._lat_ema, 0.08))
+            deadline = time.monotonic() + min(0.5 * self._lat_ema, 0.6)
+            while time.monotonic() < deadline:
+                with self._lock:
+                    mine = len(self._pending.get(sig, ()))
+                    busy = (mine > 1 or len(self._pending) > 1
+                            or any(len(q) > 1
+                                   for q in self._pending.values()))
+                if mine >= self.max_batch or not busy:
+                    break
+                time.sleep(0.02)
         with self._launch_slots:
             with self._lock:
                 batch = self._pending.pop(sig, [])
