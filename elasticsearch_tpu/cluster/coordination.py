@@ -870,6 +870,46 @@ class Coordinator:
             self._pending_tasks.append((source, update, on_done))
             self._drain_tasks()
 
+    # ---------------------------------------------- voting exclusions
+    def add_voting_config_exclusions(self, names, on_done=None) -> None:
+        """POST /_cluster/voting_config_exclusions (ref:
+        TransportAddVotingConfigExclusionsAction): withdraw nodes from
+        the voting configuration ahead of decommission — they stay
+        cluster members, but quorums stop depending on them."""
+        from dataclasses import replace as _replace
+
+        def update(state: ClusterState) -> ClusterState:
+            ids = set()
+            for x in names:
+                for n in state.nodes.nodes:
+                    if n.name == x or n.node_id == x:
+                        ids.add(n.node_id)
+            coord = state.metadata.coordination
+            new_excl = coord.voting_config_exclusions | frozenset(ids)
+            if new_excl == coord.voting_config_exclusions:
+                return state
+            state = state.with_(metadata=state.metadata.with_coordination(
+                _replace(coord, voting_config_exclusions=new_excl)))
+            return self._with_adjusted_config(state)
+
+        self.submit_state_update("put-voting-config-exclusions", update,
+                                 on_done)
+
+    def clear_voting_config_exclusions(self, on_done=None) -> None:
+        """DELETE /_cluster/voting_config_exclusions."""
+        from dataclasses import replace as _replace
+
+        def update(state: ClusterState) -> ClusterState:
+            coord = state.metadata.coordination
+            if not coord.voting_config_exclusions:
+                return state
+            state = state.with_(metadata=state.metadata.with_coordination(
+                _replace(coord, voting_config_exclusions=frozenset())))
+            return self._with_adjusted_config(state)
+
+        self.submit_state_update("clear-voting-config-exclusions", update,
+                                 on_done)
+
     def _drain_tasks(self) -> None:
         if (self.mode != MODE_LEADER or self._publication is not None
                 or not self._pending_tasks):

@@ -272,6 +272,12 @@ def _register_all(c: RestController):
     c.register("GET", "/_xpack", xpack_info)
     c.register("GET", "/_license", license_info)
     c.register("GET", "/_nodes/hot_threads", hot_threads)
+    c.register("POST", "/_cluster/voting_config_exclusions",
+               add_voting_exclusions)
+    c.register("DELETE", "/_cluster/voting_config_exclusions",
+               clear_voting_exclusions)
+    c.register("GET", "/_cluster/allocation/explain", allocation_explain)
+    c.register("POST", "/_cluster/allocation/explain", allocation_explain)
     c.register("POST", "/_nodes/reload_secure_settings",
                reload_secure_settings)
     c.register("GET", "/_migration/deprecations", deprecations)
@@ -2699,6 +2705,69 @@ def cat_plugins(node, params, body):
 
 def cat_nodeattrs(node, params, body):
     return 200, {"_cat": f"{node.name} 127.0.0.1 127.0.0.1 - -"}
+
+
+def add_voting_exclusions(node, params, body):
+    """POST /_cluster/voting_config_exclusions (ref:
+    RestAddVotingConfigExclusionAction). On the single-node container
+    there is no multi-node voting configuration to amend — excluding the
+    only master is rejected exactly as the reference refuses to exclude
+    ALL master-eligible nodes; the Coordinator-level API
+    (cluster/coordination.py) implements the real semantics for
+    clusters."""
+    names = [n for n in params.get(
+        "node_names", params.get("node_ids", "")).split(",") if n]
+    if not names:
+        raise IllegalArgumentException(
+            "add voting config exclusions requests must specify at "
+            "least one node")
+    if node.name in names or node.node_id in names:
+        return 400, {"error": {
+            "type": "illegal_argument_exception",
+            "reason": "add voting config exclusions request for "
+                      f"{names} would leave no master-eligible voting "
+                      "nodes in the cluster"}, "status": 400}
+    return 200, {"acknowledged": True}
+
+
+def clear_voting_exclusions(node, params, body):
+    return 200, {"acknowledged": True}
+
+
+def allocation_explain(node, params, body):
+    """GET/POST /_cluster/allocation/explain (ref:
+    TransportClusterAllocationExplainAction) — single-node form: every
+    shard of an existing index is assigned locally."""
+    body = body or {}
+    index = body.get("index")
+    if index is None:
+        # unparameterized: explain the first shard found (the reference
+        # picks the first unassigned shard; with none unassigned here,
+        # any shard serves)
+        names = sorted(node.indices_service.indices)
+        if not names:
+            raise IllegalArgumentException(
+                "unable to find any unassigned shards to explain")
+        index = names[0]
+    idx = node.indices_service.get(index)
+    shard = int(body.get("shard", 0))
+    if shard >= idx.num_shards:
+        raise IllegalArgumentException(
+            f"shard [{shard}] does not exist for index [{index}]")
+    return 200, {
+        "index": index,
+        "shard": shard,
+        "primary": bool(body.get("primary", True)),
+        "current_state": "started",
+        "current_node": {"id": node.node_id, "name": node.name},
+        "can_remain_on_current_node": "yes",
+        "can_rebalance_cluster": "no",
+        "can_rebalance_cluster_decisions": [{
+            "decider": "single_node",
+            "decision": "NO",
+            "explanation": "a single-node cluster has no rebalance "
+                           "targets"}],
+    }
 
 
 def reload_secure_settings(node, params, body):

@@ -246,3 +246,32 @@ def test_delete_index_removes_local_shards(cluster):
     cluster.run_for(30)
     for cn in cluster.cluster_nodes.values():
         assert not any(k[0] == "gone" for k in cn.data_node.shards)
+
+
+def test_voting_config_exclusions(tmp_path):
+    """POST/DELETE _cluster/voting_config_exclusions semantics (ref:
+    TransportAddVotingConfigExclusionsAction): an excluded node leaves
+    the voting configuration but stays a member; clearing the
+    exclusions lets the reconfigurator re-admit it."""
+    cluster = SimDataCluster(3, tmp_path, seed=9)
+    master = cluster.stabilise()
+    state = master.state
+    assert len(state.metadata.coordination.last_committed_config.node_ids) == 3
+
+    victim = next(n.node_id for n in state.nodes.nodes
+                  if n.node_id != master.local_node.node_id)
+    master.coordinator.add_voting_config_exclusions([victim])
+    cluster.run_for(30)
+    state = master.state
+    coord = state.metadata.coordination
+    assert victim in coord.voting_config_exclusions
+    assert victim not in coord.last_committed_config.node_ids
+    assert victim in state.nodes, "excluded node remains a member"
+
+    master.coordinator.clear_voting_config_exclusions()
+    cluster.run_for(30)
+    coord = master.state.metadata.coordination
+    assert coord.voting_config_exclusions == frozenset()
+    assert victim in coord.last_committed_config.node_ids
+    for cn in cluster.cluster_nodes.values():
+        cn.stop()

@@ -242,3 +242,36 @@ def test_indexing_slowlog(node):
     idx.index_doc("x", {"v": 1})
     assert idx.indexing_slowlog_recent
     assert idx.indexing_slowlog_recent[-1]["id"] == "x"
+
+
+def test_voting_exclusions_and_allocation_explain_rest(tmp_path):
+    from elasticsearch_tpu.node import Node
+
+    node = Node(data_path=str(tmp_path / "vx"))
+    try:
+        st, r = node.rest_controller.dispatch(
+            "POST", "/_cluster/voting_config_exclusions",
+            {"node_names": "other-node"}, None)
+        assert (st, r["acknowledged"]) == (200, True)
+        # excluding the only master-eligible node is refused
+        st, r = node.rest_controller.dispatch(
+            "POST", "/_cluster/voting_config_exclusions",
+            {"node_names": node.name}, None)
+        assert st == 400
+        st, r = node.rest_controller.dispatch(
+            "DELETE", "/_cluster/voting_config_exclusions", None, None)
+        assert st == 200
+
+        node.rest_controller.dispatch("PUT", "/ae", None, None)
+        st, r = node.rest_controller.dispatch(
+            "POST", "/_cluster/allocation/explain", None,
+            {"index": "ae", "shard": 0, "primary": True})
+        assert st == 200
+        assert r["current_state"] == "started"
+        assert r["current_node"]["name"] == node.name
+        st, r = node.rest_controller.dispatch(
+            "POST", "/_cluster/allocation/explain", None,
+            {"index": "ae", "shard": 9})
+        assert st == 400
+    finally:
+        node.close()
