@@ -1,0 +1,76 @@
+"""Seed-hosts providers (ref: discovery/SeedHostsProvider.java).
+
+The reference resolves seed hosts from settings
+(`discovery.seed_hosts`), from a file
+(`config/unicast_hosts.txt` — FileBasedSeedHostsProvider), or from
+cloud plugins. The settings- and file-based providers are implemented
+here; cloud providers would plug in through the same seam (a callable
+returning DiscoveryNode seeds), contributed via the plugin SPI.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from elasticsearch_tpu.transport.transport import DiscoveryNode
+
+UNICAST_HOSTS_FILE = "unicast_hosts.txt"
+
+
+def _parse_host(line: str) -> Optional[DiscoveryNode]:
+    line = line.strip()
+    if not line or line.startswith("#"):
+        return None
+    host, _, port = line.partition(":")
+    try:
+        port_no = int(port) if port else 9300
+    except ValueError:
+        return None
+    return DiscoveryNode(node_id=f"seed-{host}-{port_no}",
+                        name=f"{host}:{port_no}", host=host, port=port_no)
+
+
+def file_seed_hosts(config_dir: str) -> List[DiscoveryNode]:
+    """FileBasedSeedHostsProvider: one `host[:port]` per line, comments
+    with `#`, re-read on every resolution so edits apply without a
+    restart (the reference's documented behavior)."""
+    path = os.path.join(config_dir, UNICAST_HOSTS_FILE)
+    if not os.path.exists(path):
+        return []
+    out: List[DiscoveryNode] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            node = _parse_host(line)
+            if node is not None:
+                out.append(node)
+    return out
+
+
+def settings_seed_hosts(settings) -> List[DiscoveryNode]:
+    """`discovery.seed_hosts` from node settings."""
+    raw = settings.get("discovery.seed_hosts") if settings else None
+    if not raw:
+        return []
+    hosts = raw if isinstance(raw, list) else str(raw).split(",")
+    out = []
+    for h in hosts:
+        node = _parse_host(str(h))
+        if node is not None:
+            out.append(node)
+    return out
+
+
+def resolve_seed_hosts(config_dir: Optional[str] = None,
+                       settings=None) -> List[DiscoveryNode]:
+    """Union of the configured providers, settings first (ref:
+    SeedHostsResolver merging provider results)."""
+    out: List[DiscoveryNode] = []
+    seen = set()
+    for node in (settings_seed_hosts(settings)
+                 + (file_seed_hosts(config_dir) if config_dir else [])):
+        key = (node.host, node.port)
+        if key not in seen:
+            seen.add(key)
+            out.append(node)
+    return out

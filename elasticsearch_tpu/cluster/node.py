@@ -61,6 +61,14 @@ class ClusterNode:
         self.local_node: DiscoveryNode = transport.local_node
         self.data_path = data_path
         os.makedirs(data_path, exist_ok=True)
+        if seed_nodes is None:
+            # no explicit seeds: resolve through the seed-hosts
+            # providers (file-based unicast_hosts.txt under the data
+            # dir — ref: FileBasedSeedHostsProvider)
+            from elasticsearch_tpu.cluster.discovery import (
+                resolve_seed_hosts)
+            resolved = resolve_seed_hosts(config_dir=data_path)
+            seed_nodes = resolved or None
 
         self.allocation = AllocationService()
         self.routing = OperationRouting()

@@ -275,3 +275,32 @@ def test_voting_config_exclusions(tmp_path):
     assert victim in coord.last_committed_config.node_ids
     for cn in cluster.cluster_nodes.values():
         cn.stop()
+
+
+def test_file_seed_hosts_provider(tmp_path):
+    """FileBasedSeedHostsProvider: unicast_hosts.txt parses hosts,
+    comments, and ports; edits apply on re-resolution."""
+    from elasticsearch_tpu.cluster.discovery import (
+        file_seed_hosts,
+        resolve_seed_hosts,
+    )
+
+    cfg = tmp_path / "cfg"
+    cfg.mkdir()
+    (cfg / "unicast_hosts.txt").write_text(
+        "# seeds\n10.0.0.1:9301\n10.0.0.2\n\nbad:port\n")
+    seeds = file_seed_hosts(str(cfg))
+    assert [(s.host, s.port) for s in seeds] == [
+        ("10.0.0.1", 9301), ("10.0.0.2", 9300)]
+
+    # settings + file merge, deduped
+    from elasticsearch_tpu.common.settings import Settings
+    merged = resolve_seed_hosts(str(cfg), Settings.from_dict(
+        {"discovery": {"seed_hosts": "10.0.0.2,10.0.0.3:9305"}}))
+    assert [(s.host, s.port) for s in merged] == [
+        ("10.0.0.2", 9300), ("10.0.0.3", 9305), ("10.0.0.1", 9301)]
+
+    # live edit applies on the next resolution
+    (cfg / "unicast_hosts.txt").write_text("10.9.9.9:9400\n")
+    assert [(s.host, s.port) for s in file_seed_hosts(str(cfg))] == [
+        ("10.9.9.9", 9400)]
