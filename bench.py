@@ -39,7 +39,7 @@ AVG_LEN = 40
 N_QUERIES = int(os.environ.get("BENCH_QUERIES", 256))
 K = 1000
 K1, B = 1.2, 0.75
-CLIENTS = int(os.environ.get("BENCH_CLIENTS", 64))
+CLIENTS = int(os.environ.get("BENCH_CLIENTS", 192))
 
 
 def log(*args):
@@ -273,6 +273,27 @@ def run_tpu_kernel(corpus, queries):
     log(f"raw kernel: {kernel_qps:.1f} qps (best-of-3), "
         f"p50 {np.median(lat)*1000:.2f} ms")
 
+    # ---- measure the tunnel's post-readback degradation factor: the
+    # SAME launch, timed before any device→host transfer vs after one.
+    # On directly-attached TPU this factor is ~1; under the axon relay
+    # it throttles all later device execution, which is what separates
+    # the raw-kernel numbers from the REST serving numbers below.
+    sel0, ws0 = selections[0]
+    t0 = time.time()
+    score_topk(d_docids, d_tfs, d_lens, d_live, sel0,
+               ws0)[0].block_until_ready()
+    pre = time.time() - t0
+    np.asarray(score_topk(d_docids, d_tfs, d_lens, d_live, sel0, ws0)[0])
+    best_post = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        score_topk(d_docids, d_tfs, d_lens, d_live, sel0,
+                   ws0)[0].block_until_ready()
+        best_post = min(best_post, time.time() - t0)
+    degrade = best_post / max(pre, 1e-9)
+    log(f"tunnel degradation after first readback: {pre*1000:.2f} ms -> "
+        f"{best_post*1000:.2f} ms per identical launch (x{degrade:.0f})")
+
     # batch-32 launch shape (the continuous-batching ceiling)
     by_bucket = {}
     for s, w in selections:
@@ -302,7 +323,8 @@ def run_tpu_kernel(corpus, queries):
     log(f"raw kernel batch-{BATCH}: {batch_qps:.1f} qps")
     return kernel_qps, batch_qps, dict(d_docids=d_docids, d_tfs=d_tfs,
                                        d_lens=d_lens, d_live=d_live,
-                                       avg=avg, zero_block=zero_block)
+                                       avg=avg, zero_block=zero_block,
+                                       degrade=degrade)
 
 
 def run_secondary(corpus, queries, rng, h):
@@ -532,8 +554,11 @@ def run_rest_path(corpus, queries, truth, tmpdir):
     one_round(1)   # warm Q=32 compiles + caches
     best_qps, best_lats = 0.0, []
     base = node.search_service.plan_batcher.stats()
+    # several queries per client per round: sustained concurrency, not a
+    # one-shot burst whose wall clock is just the slowest straggler
+    reps = max(2, (6 * CLIENTS) // max(1, len(bodies)))
     for _ in range(3):
-        qps, lats = one_round(2)
+        qps, lats = one_round(reps)
         if qps > best_qps:
             best_qps, best_lats = qps, lats
     p50 = float(np.median(best_lats) * 1000)
@@ -596,6 +621,7 @@ def main():
     cpu_qps, cpu_recall = run_cpu_maxscore(corpus, queries, truth)
 
     kernel_qps, batch_qps, handles = run_tpu_kernel(corpus, queries)
+    degrade_txt = f"{handles.get('degrade', float('nan')):.0f}"
     sec_txt = ""
     if os.environ.get("BENCH_SECONDARY", "1") != "0":
         try:
@@ -626,9 +652,11 @@ def main():
             f"concurrent clients, continuous batching avg {avg_batch:.0f}/"
             f"launch), {N_QUERIES} queries 1-8 terms, synthetic "
             f"{N_DOCS // 1_000_000}M-doc corpus, single chip; p50 "
-            f"{p50:.1f} ms, p99 {p99:.1f} ms (p50 is dominated by the "
-            f"axon tunnel's ~120ms per-readback sync floor — an env "
-            f"artifact: pre-degradation launch+sync is 0.05ms); "
+            f"{p50:.1f} ms, p99 {p99:.1f} ms; NOTE the serving numbers "
+            f"run in the tunnel's post-readback DEGRADED mode — the "
+            f"identical launch measured x{degrade_txt} slower after the "
+            f"first device→host transfer (an env artifact absent on "
+            f"attached TPU; raw-kernel numbers below ran pre-readback); "
             f"recall@{K} "
             f"{rest_recall:.4f} vs exact over ALL queries; {base_txt}; "
             f"REST bool+filters w/ cached filter masks "

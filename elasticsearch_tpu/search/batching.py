@@ -131,7 +131,7 @@ class PlanBatcher:
         # shape wastes ~10x device time, so waiting a fraction of the
         # measured round-trip to fill the cohort is strictly cheaper.
         if self._lat_ema > 0.03:
-            deadline = time.monotonic() + min(0.5 * self._lat_ema, 0.6)
+            deadline = time.monotonic() + min(0.75 * self._lat_ema, 1.5)
             while time.monotonic() < deadline:
                 with self._lock:
                     mine = len(self._pending.get(sig, ()))
