@@ -1,0 +1,44 @@
+"""systemd integration (ref: modules/systemd — sd_notify via JNA).
+
+Implements the sd_notify datagram protocol directly over the
+``NOTIFY_SOCKET`` unix socket (no JNA needed in Python): READY=1 when
+the node finishes starting, STOPPING=1 on shutdown, and EXTEND_TIMEOUT
+during long startups — the exact notifications the reference sends
+(ref: org.elasticsearch.systemd.SystemdPlugin)."""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Optional
+
+
+def notify(state: str,
+           notify_socket: Optional[str] = None) -> bool:
+    """Send one sd_notify state string; returns False when not running
+    under systemd (no NOTIFY_SOCKET) or on any socket error — callers
+    never fail because of notification problems."""
+    addr = notify_socket or os.environ.get("NOTIFY_SOCKET")
+    if not addr:
+        return False
+    if addr.startswith("@"):
+        addr = "\0" + addr[1:]        # abstract-namespace socket
+    try:
+        with socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM) as s:
+            s.connect(addr)
+            s.send(state.encode())
+        return True
+    except OSError:
+        return False
+
+
+def notify_ready() -> bool:
+    return notify("READY=1")
+
+
+def notify_stopping() -> bool:
+    return notify("STOPPING=1")
+
+
+def notify_extend_timeout(usec: int) -> bool:
+    return notify(f"EXTEND_TIMEOUT_USEC={usec}")

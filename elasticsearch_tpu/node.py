@@ -184,10 +184,15 @@ class Node:
         http_port = port if port is not None else HTTP_PORT_SETTING.get(self.settings)
         self._http = HttpServer(self.rest_controller, port=http_port)
         self._http.start()
+        # sd_notify READY under systemd (ref: modules/systemd)
+        from elasticsearch_tpu.common.systemd import notify_ready
+        notify_ready()
         return self._http.port
 
     def stop(self):
         if self._http is not None:
+            from elasticsearch_tpu.common.systemd import notify_stopping
+            notify_stopping()
             self._http.stop()
             self._http = None
 

@@ -1434,6 +1434,24 @@ def _store_task_result(node, task_id, result):
     node.task_results[task_id] = result
     while len(node.task_results) > 256:
         node.task_results.popitem(last=False)
+    # persist into the .tasks system index (ref: the `tasks` module —
+    # TaskResultsService writes completed task results to .tasks so they
+    # survive restarts and are queryable like any document)
+    try:
+        if not node.indices_service.has(".tasks"):
+            node.indices_service.create_index(".tasks", None, {
+                "properties": {"completed": {"type": "boolean"},
+                               "task_id": {"type": "keyword"},
+                               "task_num": {"type": "long"}}})
+        idx = node.indices_service.get(".tasks")
+        idx.index_doc(
+            f"{node.node_id}:{task_id}",
+            {"completed": True, "task_id": f"{node.node_id}:{task_id}",
+             "task_num": int(task_id), "response": result})
+        idx.flush()   # durable: results must survive restarts
+    except Exception:   # noqa: BLE001 — result storage must never fail
+        pass            # the originating operation (ref: best-effort
+        # TaskResultsService.storeResult error handler)
 
 
 def reindex_handler(node, params, body):
@@ -1495,6 +1513,23 @@ def get_task(node, params, body, task_id):
     if stored is not None and tid.node_id in ("", node.node_id):
         return 200, {"completed": True, "response": stored,
                      "task": {"node": node.node_id, "id": tid.id}}
+    if stored is None and tid.node_id in ("", node.node_id) \
+            and node.indices_service.has(".tasks"):
+        # restart survival: completed results live in the .tasks system
+        # index (ref: the `tasks` module / TaskResultsService). Node ids
+        # change across restarts, so bare task numbers resolve by query.
+        g = node.indices_service.get(".tasks").get_doc(
+            f"{node.node_id}:{tid.id}")
+        src = g.source if g.found else None
+        if src is None and tid.node_id == "":
+            r = node.search_service.search(".tasks", {
+                "query": {"term": {"task_num": tid.id}}, "size": 1})
+            hits = r["hits"]["hits"]
+            src = hits[0]["_source"] if hits else None
+        if src is not None:
+            return 200, {"completed": True,
+                         "response": src.get("response"),
+                         "task": {"node": node.node_id, "id": tid.id}}
     task = _local_task(node, task_id)
     if params.get("wait_for_completion") == "true":
         deadline = time.monotonic() + float(params.get("timeout_s", 30))

@@ -275,3 +275,74 @@ def test_voting_exclusions_and_allocation_explain_rest(tmp_path):
         assert st == 400
     finally:
         node.close()
+
+
+def test_task_results_survive_restart(tmp_path):
+    """Completed background-task results persist in the .tasks system
+    index (ref: the tasks module / TaskResultsService) and resolve
+    through GET /_tasks/{id} after a restart."""
+    import time as _time
+    from elasticsearch_tpu.node import Node
+
+    node = Node(data_path=str(tmp_path / "tk"))
+    try:
+        node.rest_controller.dispatch("PUT", "/src", None, {
+            "mappings": {"properties": {"x": {"type": "long"}}}})
+        for i in range(5):
+            node.rest_controller.dispatch("PUT", f"/src/_doc/{i}", None,
+                                          {"x": i})
+        node.rest_controller.dispatch("POST", "/src/_refresh", None, None)
+        st, r = node.rest_controller.dispatch(
+            "POST", "/_reindex", {"wait_for_completion": "false"},
+            {"source": {"index": "src"}, "dest": {"index": "dst"}})
+        assert st == 200
+        task_id = r["task"]
+        deadline = _time.time() + 20
+        while _time.time() < deadline:
+            st, r = node.rest_controller.dispatch(
+                "GET", f"/_tasks/{task_id}", None, None)
+            if r.get("completed"):
+                break
+            _time.sleep(0.05)
+        assert r["completed"] and r["response"]["total"] == 5
+        data_path = node.data_path
+    finally:
+        node.close()
+
+    node2 = Node(data_path=data_path)
+    try:
+        st, r = node2.rest_controller.dispatch(
+            "GET", f"/_tasks/{task_id}", None, None)
+        # node ids differ across restarts; the .tasks doc still resolves
+        # for bare numeric ids (parsed with empty node scope)
+        bare = task_id.split(":", 1)[1]
+        st, r = node2.rest_controller.dispatch(
+            "GET", f"/_tasks/{bare}", None, None)
+        assert st == 200 and r["completed"], r
+        assert r["response"]["total"] == 5
+    finally:
+        node2.close()
+
+
+def test_sd_notify_protocol(tmp_path, monkeypatch):
+    """sd_notify datagrams reach the NOTIFY_SOCKET (ref:
+    modules/systemd SystemdPlugin)."""
+    import socket as _socket
+    from elasticsearch_tpu.common import systemd
+
+    sock_path = str(tmp_path / "notify.sock")
+    srv = _socket.socket(_socket.AF_UNIX, _socket.SOCK_DGRAM)
+    srv.bind(sock_path)
+    srv.settimeout(5)
+    try:
+        monkeypatch.setenv("NOTIFY_SOCKET", sock_path)
+        assert systemd.notify_ready()
+        assert srv.recv(64) == b"READY=1"
+        assert systemd.notify_extend_timeout(30_000_000)
+        assert srv.recv(64) == b"EXTEND_TIMEOUT_USEC=30000000"
+        assert systemd.notify_stopping()
+        assert srv.recv(64) == b"STOPPING=1"
+        monkeypatch.delenv("NOTIFY_SOCKET")
+        assert systemd.notify_ready() is False   # not under systemd
+    finally:
+        srv.close()
