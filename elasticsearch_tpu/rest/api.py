@@ -290,6 +290,12 @@ def _register_all(c: RestController):
     c.register("GET", "/_cat/nodes", cat_nodes)
     c.register("GET", "/_cat/plugins", cat_plugins)
     c.register("GET", "/_cat/master", cat_master)
+    c.register("GET", "/_cat/snapshots/{repo}", cat_snapshots)
+    c.register("GET", "/_cat/fielddata", cat_fielddata)
+    c.register("GET", "/_cat/ml/anomaly_detectors", cat_ml_jobs)
+    c.register("GET", "/_cat/ml/datafeeds", cat_ml_datafeeds)
+    c.register("GET", "/_cat/ml/trained_models", cat_ml_trained_models)
+    c.register("GET", "/_cat/transforms", cat_transforms)
     c.register("GET", "/_cat/allocation", cat_allocation)
     c.register("GET", "/_cat/templates", cat_templates)
     c.register("GET", "/_cat/plugins", cat_plugins)
@@ -2674,13 +2680,70 @@ def cat_plugins(node, params, body):
 
 
 def cat_thread_pool(node, params, body):
-    import threading as _threading
-    pools = {}
-    for t in _threading.enumerate():
-        key = t.name.split("-")[0]
-        pools[key] = pools.get(key, 0) + 1
-    return 200, {"_cat": "\n".join(
-        f"{node.name} {k} {v} 0 0" for k, v in sorted(pools.items()))}
+    """name pool active queue rejected (ref: RestThreadPoolAction) —
+    from the real named executors."""
+    rows = []
+    for name, st in sorted(node.threadpool.stats().items()):
+        rows.append(f"{node.name} {name} {st['active']} {st['queue']} "
+                    f"{st['rejected']}")
+    return 200, {"_cat": "\n".join(rows)}
+
+
+def cat_snapshots(node, params, body, repo):
+    """ref: RestSnapshotAction — id status start/end times per snapshot."""
+    r = node.repositories_service.get_repository(repo)
+    rows = []
+    for name, meta in sorted(r.load_repository_data()
+                             .get("snapshots", {}).items()):
+        rows.append(f"{name} {meta.get('state', 'SUCCESS')} "
+                    f"{meta.get('start_time', '-')} "
+                    f"{meta.get('end_time', '-')} "
+                    f"{len(meta.get('indices', []))}")
+    return 200, {"_cat": "\n".join(rows)}
+
+
+def cat_ml_jobs(node, params, body):
+    rows = []
+    for job_id, job in sorted(node.ml_service.jobs.items()):
+        rows.append(f"{job_id} {job.state} {job.processed_record_count} "
+                    f"{len(job.buckets)}")
+    return 200, {"_cat": "\n".join(rows)}
+
+
+def cat_ml_datafeeds(node, params, body):
+    rows = [f"{fid} {feed.state}" for fid, feed in
+            sorted(node.ml_service.datafeeds.items())]
+    return 200, {"_cat": "\n".join(rows)}
+
+
+def cat_ml_trained_models(node, params, body):
+    rows = [f"{mid} {m.get('model_type', 'lang_ident')}" for mid, m in
+            sorted(node.ml_service.trained_models.items())]
+    return 200, {"_cat": "\n".join(rows)}
+
+
+def cat_transforms(node, params, body):
+    rows = []
+    svc = node.transform_service
+    for tid in sorted(svc._configs):
+        state = svc._stats.get(tid, {}).get("state", "stopped")
+        rows.append(f"{tid} {state}")
+    return 200, {"_cat": "\n".join(rows)}
+
+
+def cat_fielddata(node, params, body):
+    """ref: RestFielddataAction. Doc values live in device HBM segments
+    here (no on-heap fielddata cache), so per-field bytes are the HBM
+    numeric/keyword column sizes."""
+    rows = []
+    cache = node.indices_service.device_cache
+    for name, idx in sorted(node.indices_service.indices.items()):
+        for searcher in idx.shard_searchers():
+            for seg in searcher.segments:
+                dev = cache.get(seg)
+                for f, arr in sorted(dev.numerics.items()):
+                    rows.append(f"{node.name} {f} {arr.nbytes}")
+    return 200, {"_cat": "\n".join(rows)}
 
 
 def cat_pending_tasks(node, params, body):
