@@ -717,12 +717,52 @@ def _analyze(registry, body):
         analyzer = CustomAnalyzer("_adhoc_", tok, filters, char_filters)
     else:
         analyzer = registry.get(body.get("analyzer", "standard"))
+
+    def rows(toks):
+        return [{"token": t.term, "start_offset": t.start_offset,
+                 "end_offset": t.end_offset, "position": t.position,
+                 "type": "<ALPHANUM>"} for t in toks]
+
+    if body.get("explain") in (True, "true"):
+        # per-stage attribution (ref: TransportAnalyzeAction detail
+        # response / the DetailAnalyzeResponse shape): text after each
+        # char filter, tokenizer output, then tokens after EVERY token
+        # filter in chain order
+        tokenizer = getattr(analyzer, "tokenizer", None)
+        filters = list(getattr(analyzer, "token_filters", []) or [])
+        char_filters = list(getattr(analyzer, "char_filters", []) or [])
+        if tokenizer is None:
+            return 200, {"detail": {
+                "custom_analyzer": False,
+                "analyzer": {
+                    "name": body.get("analyzer", "standard"),
+                    "tokens": rows([t for x in texts
+                                    for t in analyzer.analyze(x)])}}}
+        charfilter_out = []
+        staged_texts = list(texts)
+        for cf in char_filters:
+            staged_texts = [cf.filter(x) for x in staged_texts]
+            charfilter_out.append({
+                "name": getattr(cf, "name", type(cf).__name__),
+                "filtered_text": list(staged_texts)})
+        toks = [t for x in staged_texts for t in tokenizer.tokenize(x)]
+        detail = {
+            "custom_analyzer": True,
+            "charfilters": charfilter_out,
+            "tokenizer": {"name": getattr(tokenizer, "name", "?"),
+                          "tokens": rows(toks)},
+            "tokenfilters": [],
+        }
+        for f in filters:
+            toks = f.filter(toks)
+            detail["tokenfilters"].append({
+                "name": getattr(f, "name", type(f).__name__),
+                "tokens": rows(toks)})
+        return 200, {"detail": detail}
+
     tokens = []
     for t in texts:
-        for tok in analyzer.analyze(t):
-            tokens.append({"token": tok.term, "start_offset": tok.start_offset,
-                           "end_offset": tok.end_offset,
-                           "position": tok.position, "type": "<ALPHANUM>"})
+        tokens.extend(rows(analyzer.analyze(t)))
     return 200, {"tokens": tokens}
 
 

@@ -226,3 +226,31 @@ def test_cjk_bigram_preserves_noncjk_positions():
     toks = f.filter([Token("alpha", 0, 0, 5), Token("gamma", 2, 10, 15)])
     assert [(t.term, t.position) for t in toks] == [
         ("alpha", 0), ("gamma", 2)]
+
+
+def test_analyze_explain_detail(tmp_path):
+    """_analyze explain:true returns per-stage detail (ref:
+    TransportAnalyzeAction DetailAnalyzeResponse)."""
+    from elasticsearch_tpu.node import Node
+
+    node = Node(data_path=str(tmp_path / "ax"))
+    try:
+        st, r = node.rest_controller.dispatch(
+            "GET", "/_analyze", None,
+            {"tokenizer": "standard",
+             "char_filter": ["html_strip"],
+             "filter": ["lowercase", "porter_stem"],
+             "text": "<b>Running</b> QUICKLY", "explain": True})
+        assert st == 200, r
+        d = r["detail"]
+        assert d["custom_analyzer"] is True
+        assert d["charfilters"][0]["name"] == "html_strip"
+        assert "<b>" not in d["charfilters"][0]["filtered_text"][0]
+        tok_terms = [t["token"] for t in d["tokenizer"]["tokens"]]
+        assert tok_terms == ["Running", "QUICKLY"]
+        stages = {tf["name"]: [t["token"] for t in tf["tokens"]]
+                  for tf in d["tokenfilters"]}
+        assert stages["lowercase"] == ["running", "quickly"]
+        assert stages[list(stages)[-1]][0] == "run"   # stemmed last stage
+    finally:
+        node.close()
