@@ -741,10 +741,18 @@ def _analyze(registry, body):
         charfilter_out = []
         staged_texts = list(texts)
         for cf in char_filters:
-            staged_texts = [cf.filter(x) for x in staged_texts]
+            apply = getattr(cf, "apply", None) or cf.filter
+            staged_texts = [apply(x) for x in staged_texts]
             charfilter_out.append({
                 "name": getattr(cf, "name", type(cf).__name__),
                 "filtered_text": list(staged_texts)})
+        if getattr(tokenizer, "native_lowercase", False):
+            # the fused native lowercase fast path would misattribute
+            # case folding to the tokenizer stage — explain shows the
+            # un-fused chain
+            from elasticsearch_tpu.analysis.tokenizers import (
+                StandardTokenizer as _Std)
+            tokenizer = _Std(tokenizer.max_token_length)
         toks = [t for x in staged_texts for t in tokenizer.tokenize(x)]
         detail = {
             "custom_analyzer": True,
