@@ -40,10 +40,23 @@ class Transport:
 
     def __init__(self, hosts: List[str], max_retries: int = 3,
                  sniff_interval: Optional[float] = None,
-                 headers: Optional[Dict[str, str]] = None):
+                 headers: Optional[Dict[str, str]] = None,
+                 ca_certs: Optional[str] = None,
+                 verify_certs: bool = True):
         self.hosts = [h.rstrip("/") for h in hosts]
         self.max_retries = max_retries
         self.headers = dict(headers or {})
+        self._ssl_ctx = None
+        if any(h.startswith("https://") for h in self.hosts):
+            import ssl
+            if ca_certs:
+                self._ssl_ctx = ssl.create_default_context(
+                    cafile=ca_certs)
+                self._ssl_ctx.check_hostname = False
+            elif not verify_certs:
+                self._ssl_ctx = ssl._create_unverified_context()
+            else:
+                self._ssl_ctx = ssl.create_default_context()
         self._dead: Dict[str, float] = {}      # host -> retry-after ts
         self._rr = random.randrange(len(self.hosts)) if self.hosts else 0
         self.sniff_interval = sniff_interval
@@ -99,7 +112,8 @@ class Transport:
                 host + path, method=method, data=data,
                 headers={"Content-Type": content_type, **self.headers})
             try:
-                with urllib.request.urlopen(req, timeout=30) as resp:
+                with urllib.request.urlopen(req, timeout=30,
+                                            context=self._ssl_ctx) as resp:
                     payload = resp.read()
                     return resp.status, (json.loads(payload)
                                          if payload else {})
@@ -167,7 +181,9 @@ class Elasticsearch:
                  basic_auth: Optional[Tuple[str, str]] = None,
                  api_key: Optional[str] = None,
                  sniff_interval: Optional[float] = None,
-                 max_retries: int = 3):
+                 max_retries: int = 3,
+                 ca_certs: Optional[str] = None,
+                 verify_certs: bool = True):
         headers = {}
         if basic_auth:
             import base64
@@ -176,7 +192,9 @@ class Elasticsearch:
         elif api_key:
             headers["Authorization"] = f"ApiKey {api_key}"
         self.transport = Transport(list(hosts), max_retries,
-                                   sniff_interval, headers)
+                                   sniff_interval, headers,
+                                   ca_certs=ca_certs,
+                                   verify_certs=verify_certs)
         self.indices = IndicesNamespace(self.transport)
         self.cluster = ClusterNamespace(self.transport)
 

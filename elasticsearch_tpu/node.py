@@ -182,7 +182,21 @@ class Node:
     def start(self, port: Optional[int] = None) -> int:
         """Bind HTTP; returns the bound port (0 → ephemeral)."""
         http_port = port if port is not None else HTTP_PORT_SETTING.get(self.settings)
-        self._http = HttpServer(self.rest_controller, port=http_port)
+        ssl_config = None
+        if self.settings.get("xpack.security.http.ssl.enabled"):
+            # ref: xpack.security.http.ssl.* settings
+            ssl_config = {
+                "certificate": self.settings.get(
+                    "xpack.security.http.ssl.certificate"),
+                "key": self.settings.get("xpack.security.http.ssl.key"),
+                "client_auth": self.settings.get(
+                    "xpack.security.http.ssl.client_authentication",
+                    "none"),
+                "certificate_authorities": self.settings.get(
+                    "xpack.security.http.ssl.certificate_authorities"),
+            }
+        self._http = HttpServer(self.rest_controller, port=http_port,
+                                ssl_config=ssl_config)
         self._http.start()
         # sd_notify READY under systemd (ref: modules/systemd)
         from elasticsearch_tpu.common.systemd import notify_ready

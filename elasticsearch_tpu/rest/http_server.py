@@ -82,9 +82,32 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class HttpServer:
-    def __init__(self, controller, host: str = "127.0.0.1", port: int = 9200):
+    """``ssl_config`` enables HTTPS (ref: xpack.security.http.ssl.* —
+    SecurityNetty4HttpServerTransport wrapping the pipeline in an
+    SslHandler): {"certificate": pem_path, "key": pem_path,
+    "client_auth": "none"|"optional"|"required",
+    "certificate_authorities": pem_path}."""
+
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 9200,
+                 ssl_config=None):
         handler = type("BoundHandler", (_Handler,), {"controller": controller})
         self._server = ThreadingHTTPServer((host, port), handler)
+        self.ssl_enabled = bool(ssl_config)
+        if ssl_config:
+            import ssl as _ssl
+            ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(ssl_config["certificate"],
+                                ssl_config.get("key"))
+            client_auth = ssl_config.get("client_auth", "none")
+            if client_auth in ("optional", "required"):
+                ctx.verify_mode = (_ssl.CERT_REQUIRED
+                                   if client_auth == "required"
+                                   else _ssl.CERT_OPTIONAL)
+                cas = ssl_config.get("certificate_authorities")
+                if cas:
+                    ctx.load_verify_locations(cas)
+            self._server.socket = ctx.wrap_socket(self._server.socket,
+                                                  server_side=True)
         self.port = self._server.server_address[1]
         self._thread = None
 

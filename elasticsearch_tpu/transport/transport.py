@@ -362,13 +362,39 @@ class TcpTransport(BaseTransport):
     decode → aggregate → dispatch)."""
 
     def __init__(self, local_node: DiscoveryNode, bind_port: int = 0,
-                 executor: Optional[ThreadPoolExecutor] = None):
+                 executor: Optional[ThreadPoolExecutor] = None,
+                 ssl_config: Optional[Dict] = None):
         super().__init__(local_node, executor)
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((local_node.host, bind_port))
         self._server.listen(64)
         self.bound_port = self._server.getsockname()[1]
+        # node-to-node TLS (ref: xpack.security.transport.ssl.* —
+        # SecurityNetty4ServerTransport): with certificate_authorities
+        # configured, verification is MUTUAL (the reference's transport
+        # default, verification_mode=certificate)
+        self._ssl_client_ctx = None
+        if ssl_config:
+            import ssl as _ssl
+            sctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+            sctx.load_cert_chain(ssl_config["certificate"],
+                                 ssl_config.get("key"))
+            cas = ssl_config.get("certificate_authorities")
+            if cas:
+                sctx.load_verify_locations(cas)
+                sctx.verify_mode = _ssl.CERT_REQUIRED
+            self._server = sctx.wrap_socket(self._server, server_side=True)
+            cctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_CLIENT)
+            cctx.check_hostname = False
+            cctx.load_cert_chain(ssl_config["certificate"],
+                                 ssl_config.get("key"))
+            if cas:
+                cctx.load_verify_locations(cas)
+                cctx.verify_mode = _ssl.CERT_REQUIRED
+            else:
+                cctx.verify_mode = _ssl.CERT_NONE
+            self._ssl_client_ctx = cctx
         self.local_node = DiscoveryNode(
             node_id=local_node.node_id, name=local_node.name,
             host=local_node.host, port=self.bound_port,
@@ -387,9 +413,14 @@ class TcpTransport(BaseTransport):
     # -- server side ------------------------------------------------------
 
     def _accept_loop(self) -> None:
+        import ssl as _ssl
         while not self._closed:
             try:
                 conn, _addr = self._server.accept()
+            except _ssl.SSLError:
+                # one peer's failed TLS handshake (bad cert, plaintext
+                # probe) must not kill the listener
+                continue
             except OSError:
                 return
             threading.Thread(target=self._read_loop, args=(conn, None),
@@ -455,6 +486,9 @@ class TcpTransport(BaseTransport):
                 return entry
         try:
             sock = socket.create_connection(node.address, timeout=5.0)
+            if self._ssl_client_ctx is not None:
+                sock = self._ssl_client_ctx.wrap_socket(
+                    sock, server_hostname=node.host)
             sock.settimeout(None)
         except OSError as e:
             raise ConnectTransportException(

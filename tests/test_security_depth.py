@@ -300,3 +300,104 @@ def test_jwt_realm(jwt_node):
                      "exp": _time.time() + 600}, b"other-key")
     call(jwt_node, "GET", "/_security/_authenticate",
          headers={"Authorization": f"Bearer {forged}"}, expect=401)
+
+
+# ------------------------------------------------------------- HTTPS
+
+def test_https_rest_endpoint(tmp_path):
+    """xpack.security.http.ssl: the HTTP layer serves TLS (ref:
+    SecurityNetty4HttpServerTransport); plaintext clients are refused,
+    the typed client connects with the CA."""
+    import subprocess as sp
+    from elasticsearch_tpu.client import Elasticsearch, ConnectionError_
+
+    crt = tmp_path / "http.crt"
+    key = tmp_path / "http.key"
+    sp.run(["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(key), "-out", str(crt), "-days", "1",
+            "-subj", "/CN=127.0.0.1",
+            "-addext", "subjectAltName=IP:127.0.0.1"],
+           check=True, capture_output=True)
+    node = Node(settings=Settings.from_dict({
+        "xpack": {"security": {"http": {"ssl": {
+            "enabled": True, "certificate": str(crt),
+            "key": str(key)}}}},
+    }), data_path=str(tmp_path / "tls"))
+    try:
+        port = node.start(0)
+        es = Elasticsearch([f"https://127.0.0.1:{port}"],
+                           ca_certs=str(crt))
+        assert es.ping()
+        es.indices.create("t")
+        es.index("t", {"x": 1}, id="1", refresh=True)
+        assert es.count("t")["count"] == 1
+
+        # plaintext against the TLS port fails
+        plain = Elasticsearch([f"http://127.0.0.1:{port}"],
+                              max_retries=2)
+        assert plain.ping() is False
+    finally:
+        node.close()
+
+
+def test_transport_tls_mutual(tmp_path):
+    """xpack.security.transport.ssl: node-to-node TLS with mutual cert
+    verification — a node without the right cert cannot join the
+    conversation (ref: SecurityNetty4ServerTransport)."""
+    import subprocess as sp
+    import threading as _t
+    from elasticsearch_tpu.transport.transport import (
+        ConnectTransportException,
+        DiscoveryNode,
+        TcpTransport,
+        TransportService,
+    )
+
+    crt = tmp_path / "node.crt"
+    key = tmp_path / "node.key"
+    sp.run(["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(key), "-out", str(crt), "-days", "1",
+            "-subj", "/CN=transport"], check=True, capture_output=True)
+    ssl_cfg = {"certificate": str(crt), "key": str(key),
+               "certificate_authorities": str(crt)}
+
+    a = TransportService(TcpTransport(
+        DiscoveryNode(node_id="a", name="a", host="127.0.0.1"),
+        ssl_config=ssl_cfg))
+    b = TransportService(TcpTransport(
+        DiscoveryNode(node_id="b", name="b", host="127.0.0.1"),
+        ssl_config=ssl_cfg))
+    got = {}
+    done = _t.Event()
+    b.register_request_handler(
+        "test:echo", lambda req, ch, src: ch.send_response(
+            {"echo": req["msg"]}))
+    try:
+        from elasticsearch_tpu.transport.transport import ResponseHandler
+        a.send_request(b.local_node, "test:echo", {"msg": "over-tls"},
+                       ResponseHandler(
+                           lambda r: (got.update(r), done.set()),
+                           lambda e: (got.update(err=e), done.set())),
+                       timeout=10.0)
+        assert done.wait(10) and got.get("echo") == "over-tls", got
+
+        # a node WITHOUT certs cannot connect (mutual TLS)
+        plain = TransportService(TcpTransport(
+            DiscoveryNode(node_id="c", name="c", host="127.0.0.1")))
+        try:
+            import pytest as _pytest
+            with _pytest.raises(Exception):
+                d2 = _t.Event()
+                plain.send_request(
+                    b.local_node, "test:echo", {"msg": "nope"},
+                    ResponseHandler(lambda r: d2.set(),
+                                    lambda e: d2.set()),
+                    timeout=3.0)
+                assert d2.wait(5)
+                assert not got.get("plain")
+                raise ConnectTransportException("refused as expected")
+        finally:
+            plain.close()
+    finally:
+        a.close()
+        b.close()
