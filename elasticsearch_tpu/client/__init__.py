@@ -42,7 +42,8 @@ class Transport:
                  sniff_interval: Optional[float] = None,
                  headers: Optional[Dict[str, str]] = None,
                  ca_certs: Optional[str] = None,
-                 verify_certs: bool = True):
+                 verify_certs: bool = True,
+                 ssl_assert_hostname: bool = True):
         self.hosts = [h.rstrip("/") for h in hosts]
         self.max_retries = max_retries
         self.headers = dict(headers or {})
@@ -52,7 +53,10 @@ class Transport:
             if ca_certs:
                 self._ssl_ctx = ssl.create_default_context(
                     cafile=ca_certs)
-                self._ssl_ctx.check_hostname = False
+                if not ssl_assert_hostname:
+                    # explicit opt-out only — a CA match alone must not
+                    # authenticate an arbitrary peer host
+                    self._ssl_ctx.check_hostname = False
             elif not verify_certs:
                 self._ssl_ctx = ssl._create_unverified_context()
             else:
@@ -75,14 +79,18 @@ class Transport:
         return alive[self._rr]
 
     def sniff(self) -> List[str]:
-        """GET /_nodes → refresh the host list (ref: the Sniffer)."""
+        """GET /_nodes → refresh the host list (ref: the Sniffer). The
+        configured scheme is preserved — sniffing must never downgrade
+        an HTTPS client to plaintext."""
+        scheme = ("https" if any(h.startswith("https://")
+                                 for h in self.hosts) else "http")
         status, body = self.perform("GET", "/_nodes", sniffing=True)
         hosts = []
         for n in body.get("nodes", {}).values():
             addr = n.get("http", {}).get("publish_address") \
                 or n.get("transport_address")
             if addr:
-                hosts.append(f"http://{addr}")
+                hosts.append(f"{scheme}://{addr}")
         if hosts:
             self.hosts = hosts
         self._last_sniff = time.monotonic()

@@ -1,0 +1,60 @@
+"""Shared TLS context construction for the HTTP and transport layers
+(ref: the xpack SSLService building SSLContexts once from
+xpack.security.*.ssl.* settings for every consumer).
+
+``ssl_config`` keys: certificate, key, certificate_authorities,
+client_auth ("none" | "optional" | "required").
+"""
+
+from __future__ import annotations
+
+import ssl
+from typing import Dict, Optional
+
+
+def server_context(ssl_config: Dict) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(ssl_config["certificate"], ssl_config.get("key"))
+    client_auth = ssl_config.get("client_auth", "none")
+    cas = ssl_config.get("certificate_authorities")
+    if client_auth in ("optional", "required"):
+        if not cas:
+            # the reference treats this as a configuration error rather
+            # than silently rejecting every handshake at runtime
+            raise ValueError(
+                "client certificate authentication requires "
+                "[certificate_authorities]")
+        ctx.load_verify_locations(cas)
+        ctx.verify_mode = (ssl.CERT_REQUIRED if client_auth == "required"
+                           else ssl.CERT_OPTIONAL)
+    elif cas:
+        # transport semantics: CAs without an explicit client_auth mean
+        # MUTUAL verification (the reference's transport default)
+        ctx.load_verify_locations(cas)
+        ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+def client_context(ssl_config: Dict) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False      # node identity = the cert/CA chain
+    ctx.load_cert_chain(ssl_config["certificate"], ssl_config.get("key"))
+    cas = ssl_config.get("certificate_authorities")
+    if cas:
+        ctx.load_verify_locations(cas)
+        ctx.verify_mode = ssl.CERT_REQUIRED
+    else:
+        ctx.verify_mode = ssl.CERT_NONE
+    return ctx
+
+
+def handshake(conn, ctx: ssl.SSLContext, timeout: float = 10.0):
+    """Per-connection server-side wrap with a bounded handshake — a
+    stalled peer must never block an accept loop. Raises OSError/
+    ssl.SSLError on failure (caller closes)."""
+    conn.settimeout(timeout)
+    tls = ctx.wrap_socket(conn, server_side=True,
+                          do_handshake_on_connect=False)
+    tls.do_handshake()
+    tls.settimeout(None)
+    return tls

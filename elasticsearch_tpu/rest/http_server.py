@@ -91,23 +91,28 @@ class HttpServer:
     def __init__(self, controller, host: str = "127.0.0.1", port: int = 9200,
                  ssl_config=None):
         handler = type("BoundHandler", (_Handler,), {"controller": controller})
-        self._server = ThreadingHTTPServer((host, port), handler)
         self.ssl_enabled = bool(ssl_config)
         if ssl_config:
-            import ssl as _ssl
-            ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
-            ctx.load_cert_chain(ssl_config["certificate"],
-                                ssl_config.get("key"))
-            client_auth = ssl_config.get("client_auth", "none")
-            if client_auth in ("optional", "required"):
-                ctx.verify_mode = (_ssl.CERT_REQUIRED
-                                   if client_auth == "required"
-                                   else _ssl.CERT_OPTIONAL)
-                cas = ssl_config.get("certificate_authorities")
-                if cas:
-                    ctx.load_verify_locations(cas)
-            self._server.socket = ctx.wrap_socket(self._server.socket,
-                                                  server_side=True)
+            from elasticsearch_tpu.common.tls import (handshake,
+                                                      server_context)
+            ctx = server_context(ssl_config)
+
+            class _TlsServer(ThreadingHTTPServer):
+                # per-CONNECTION handshake in the handler thread with a
+                # bounded timeout: a stalled client must never block the
+                # accept loop (wrapping the LISTENING socket would run
+                # the handshake inline in serve_forever)
+                def process_request_thread(self, request, client_address):
+                    try:
+                        request = handshake(request, ctx)
+                    except OSError:
+                        self.shutdown_request(request)
+                        return
+                    super().process_request_thread(request, client_address)
+
+            self._server = _TlsServer((host, port), handler)
+        else:
+            self._server = ThreadingHTTPServer((host, port), handler)
         self.port = self._server.server_address[1]
         self._thread = None
 

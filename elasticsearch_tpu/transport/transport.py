@@ -373,28 +373,16 @@ class TcpTransport(BaseTransport):
         # node-to-node TLS (ref: xpack.security.transport.ssl.* —
         # SecurityNetty4ServerTransport): with certificate_authorities
         # configured, verification is MUTUAL (the reference's transport
-        # default, verification_mode=certificate)
+        # default, verification_mode=certificate). Handshakes run
+        # per-connection in the reader thread (common/tls.py), never in
+        # the accept loop.
         self._ssl_client_ctx = None
+        self._ssl_server_ctx = None
         if ssl_config:
-            import ssl as _ssl
-            sctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
-            sctx.load_cert_chain(ssl_config["certificate"],
-                                 ssl_config.get("key"))
-            cas = ssl_config.get("certificate_authorities")
-            if cas:
-                sctx.load_verify_locations(cas)
-                sctx.verify_mode = _ssl.CERT_REQUIRED
-            self._server = sctx.wrap_socket(self._server, server_side=True)
-            cctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_CLIENT)
-            cctx.check_hostname = False
-            cctx.load_cert_chain(ssl_config["certificate"],
-                                 ssl_config.get("key"))
-            if cas:
-                cctx.load_verify_locations(cas)
-                cctx.verify_mode = _ssl.CERT_REQUIRED
-            else:
-                cctx.verify_mode = _ssl.CERT_NONE
-            self._ssl_client_ctx = cctx
+            from elasticsearch_tpu.common.tls import (client_context,
+                                                      server_context)
+            self._ssl_server_ctx = server_context(ssl_config)
+            self._ssl_client_ctx = client_context(ssl_config)
         self.local_node = DiscoveryNode(
             node_id=local_node.node_id, name=local_node.name,
             host=local_node.host, port=self.bound_port,
@@ -413,18 +401,28 @@ class TcpTransport(BaseTransport):
     # -- server side ------------------------------------------------------
 
     def _accept_loop(self) -> None:
-        import ssl as _ssl
         while not self._closed:
             try:
                 conn, _addr = self._server.accept()
-            except _ssl.SSLError:
-                # one peer's failed TLS handshake (bad cert, plaintext
-                # probe) must not kill the listener
-                continue
             except OSError:
                 return
-            threading.Thread(target=self._read_loop, args=(conn, None),
+            threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        """Per-connection thread: bounded TLS handshake (a stalled or
+        plaintext peer affects only its own connection), then the frame
+        reader."""
+        if self._ssl_server_ctx is not None:
+            from elasticsearch_tpu.common.tls import handshake
+            try:
+                conn = handshake(conn, self._ssl_server_ctx)
+            except OSError:
+                try:
+                    conn.close()
+                finally:
+                    return
+        self._read_loop(conn, None)
 
     def _read_loop(self, conn: socket.socket,
                    peer: Optional[DiscoveryNode]) -> None:
