@@ -270,6 +270,11 @@ class Elasticsearch:
             "POST", "/_search/scroll",
             {"scroll_id": scroll_id, "scroll": scroll})[1]
 
+    def clear_scroll(self, scroll_id) -> Dict:
+        ids = scroll_id if isinstance(scroll_id, list) else [scroll_id]
+        return self.transport.perform(
+            "DELETE", "/_search/scroll", {"scroll_id": ids})[1]
+
     def info(self) -> Dict:
         return self.transport.perform("GET", "/")[1]
 

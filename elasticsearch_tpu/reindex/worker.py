@@ -225,6 +225,43 @@ class BulkByScrollResponse:
         }
 
 
+def _remote_scroll_batches(remote: Dict[str, Any], index, search_body,
+                           batch_size):
+    """Reindex-from-remote source (ref: modules/reindex remote mode —
+    RemoteScrollableHitSource scrolling the source cluster over HTTP):
+    the typed client drives the remote's scroll API; hits stream back
+    batch by batch."""
+    from elasticsearch_tpu.client import Elasticsearch
+
+    host = remote.get("host")
+    if not host:
+        raise IllegalArgumentException("[host] must be specified to reindex from a remote cluster")
+    auth = None
+    if remote.get("username"):
+        auth = (remote["username"], remote.get("password", ""))
+    es = Elasticsearch([host], basic_auth=auth,
+                       ca_certs=remote.get("ca_certs"),
+                       verify_certs=not remote.get(
+                           "insecure", False))
+    body = dict(search_body)
+    body["size"] = batch_size
+    r = es.search(index, body, scroll=_SCROLL_KEEPALIVE)
+    scroll_id = r.get("_scroll_id")
+    try:
+        while True:
+            hits = r.get("hits", {}).get("hits", [])
+            if not hits:
+                return
+            yield hits
+            if scroll_id is None:
+                return
+            r = es.scroll(scroll_id, _SCROLL_KEEPALIVE)
+            scroll_id = r.get("_scroll_id")
+    finally:
+        if scroll_id:
+            es.clear_scroll(scroll_id)
+
+
 def _scroll_batches(node, index, search_body, batch_size, task=None):
     """Yield lists of hits from a scroll snapshot of `index`."""
     body = dict(search_body)
@@ -320,8 +357,13 @@ def reindex(node, body: Dict[str, Any], params: Dict[str, Any],
 
     dest_idx = _ensure_dest(node, dest_index)
     done = False
-    for hits in _scroll_batches(node, src_index, search_body, batch_size,
-                                task=task):
+    remote = source.get("remote")
+    batches = (_remote_scroll_batches(remote, src_index, search_body,
+                                      batch_size)
+               if remote else
+               _scroll_batches(node, src_index, search_body, batch_size,
+                               task=task))
+    for hits in batches:
         if task is not None:
             task.ensure_not_cancelled()
         t_batch = time.monotonic()
