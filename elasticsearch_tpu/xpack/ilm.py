@@ -18,7 +18,8 @@ Supported actions per phase (the reference's core set minus
 allocate/migrate routing, which are no-ops single-node):
   hot:    rollover, set_priority, forcemerge
   warm:   readonly, forcemerge, shrink, set_priority, allocate(no-op)
-  cold:   freeze, searchable_snapshot(stub→snapshot when repo configured),
+  cold:   freeze, searchable_snapshot (snapshot → drop local copy →
+          LAZY cache-backed remount, xpack/searchable_snapshots.py),
           set_priority, allocate(no-op)
   delete: wait_for_snapshot, delete
 """
@@ -260,6 +261,10 @@ class IndexLifecycleService:
                     return  # waiting (e.g. rollover conditions not met)
                 if not self.indices.has(idx.name):
                     return  # the delete action removed the index
+                # actions may REPLACE the index object (shrink swaps,
+                # searchable_snapshot remounts) — re-resolve before
+                # recording completion
+                idx = self.indices.get(idx.name)
                 idx.update_settings({done_key: True})
 
             # all actions done → move to the next ripe phase this tick
@@ -320,12 +325,21 @@ class IndexLifecycleService:
             if self.repositories is None or not repo:
                 raise IllegalArgumentException(
                     "[searchable_snapshot] requires [snapshot_repository]")
-            snap = f"ilm-{idx.name}-{int(now_ms)}"
+            # the REAL mount semantics (ref: the ILM
+            # SearchableSnapshotAction step sequence: snapshot → mount →
+            # swap): snapshot the index, drop the local copy, and
+            # re-open it as a LAZY snapshot-backed mount — local storage
+            # is released and segments stream back in on first search.
+            # `force_merge_index:false`-style knobs: storage defaults to
+            # shared_cache for the frozen tier semantics.
+            from elasticsearch_tpu.xpack import searchable_snapshots as ss
+            name = idx.name
+            snap = f"ilm-{name}-{int(now_ms)}"
             self.repositories.get_repository(repo).snapshot(snap, [idx])
-            idx.update_settings({"index.store.type": "snapshot",
-                                 "index.store.snapshot.repository_name": repo,
-                                 "index.store.snapshot.snapshot_name": snap,
-                                 "index.blocks.write": True})
+            self.indices.delete_index(name)
+            ss.mount_services(self.repositories, self.indices, repo,
+                              snap, name, name,
+                              storage=spec.get("storage", "full_copy"))
             return True
         if action == "wait_for_snapshot":
             policy = spec.get("policy")

@@ -203,8 +203,16 @@ def materialize_segment(shard_path: str, seg_name: str,
 
 def mount(node, repo_name: str, snapshot: str, index: str,
           renamed: str, storage: str = "full_copy") -> Dict[str, Any]:
-    """MountSearchableSnapshotAction: create the index shell + manifests
-    WITHOUT copying data files; segments stream in on first search."""
+    """MountSearchableSnapshotAction (REST shape): create the index
+    shell + manifests WITHOUT copying data files; segments stream in on
+    first search."""
+    return mount_services(node.repositories_service, node.indices_service,
+                          repo_name, snapshot, index, renamed, storage)
+
+
+def mount_services(repositories_service, indices_service, repo_name: str,
+                   snapshot: str, index: str, renamed: str,
+                   storage: str = "full_copy") -> Dict[str, Any]:
     import uuid as _uuid
 
     from elasticsearch_tpu.common.errors import (
@@ -212,17 +220,17 @@ def mount(node, repo_name: str, snapshot: str, index: str,
         ResourceAlreadyExistsException,
     )
 
-    repo = node.repositories_service.get_repository(repo_name)
+    repo = repositories_service.get_repository(repo_name)
     snap = repo.get_snapshot(snapshot)
     if index not in snap["indices"]:
         raise IllegalArgumentException(
             f"index [{index}] not found in snapshot [{snapshot}]")
-    if node.indices_service.has(renamed):
+    if indices_service.has(renamed):
         raise ResourceAlreadyExistsException(
             f"cannot mount as [{renamed}]: index already exists")
-    node.indices_service.validate_index_name(renamed)
+    indices_service.validate_index_name(renamed)
     idx_meta = snap["indices"][index]
-    index_path = os.path.join(node.indices_service.data_path, renamed)
+    index_path = os.path.join(indices_service.data_path, renamed)
     os.makedirs(index_path, exist_ok=True)
     with open(os.path.join(index_path, "_meta.json"), "w") as fh:
         json.dump({"settings": idx_meta["settings"],
@@ -248,8 +256,8 @@ def mount(node, repo_name: str, snapshot: str, index: str,
             commit["translog_generation"] = 1
             with open(os.path.join(shard_path, "segments.json"), "w") as fh:
                 json.dump(commit, fh)
-    node.indices_service.open_index(renamed)
-    idx = node.indices_service.get(renamed)
+    indices_service.open_index(renamed)
+    idx = indices_service.get(renamed)
     idx.update_settings({
         "index.blocks.write": True,
         "index.store.type": "snapshot",

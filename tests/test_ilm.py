@@ -248,3 +248,54 @@ def test_put_settings_rest(node):
     s, r = c.dispatch("PUT", "/idx2/_settings", None,
                       {"index.number_of_shards": 5})
     assert s == 400
+
+
+def test_searchable_snapshot_action_mounts_lazily(tmp_path):
+    """The cold-phase searchable_snapshot action snapshots, drops the
+    local copy, and remounts LAZILY (ref: ILM SearchableSnapshotAction
+    snapshot→mount→swap steps)."""
+    import glob
+    import os
+    import time as _time
+    from elasticsearch_tpu.node import Node
+
+    node = Node(data_path=str(tmp_path / "ilmss"))
+
+    def call(method, path, body=None, expect=200, **params):
+        st, r = node.rest_controller.dispatch(method, path, params, body)
+        assert st == expect, r
+        return r
+
+    try:
+        call("PUT", "/_snapshot/coldrepo", {
+            "type": "fs", "settings": {"location": str(tmp_path / "cr")}})
+        call("PUT", "/_ilm/policy/tier", {"policy": {"phases": {
+            "cold": {"min_age": "0ms", "actions": {
+                "searchable_snapshot": {
+                    "snapshot_repository": "coldrepo"}}}}}})
+        call("PUT", "/olddata", {
+            "settings": {"index.lifecycle.name": "tier"},
+            "mappings": {"properties": {"t": {"type": "text"}}}})
+        for i in range(10):
+            call("PUT", f"/olddata/_doc/{i}", {"t": f"archived {i}"},
+                 expect=201)
+        call("POST", "/olddata/_refresh")
+
+        node.ilm_service.tick(now=_time.time() + 10)
+
+        idx = node.indices_service.get("olddata")
+        assert str(idx.settings.get("index.store.type")) == "snapshot"
+        shard_dir = os.path.join(node.data_path, "olddata", "0")
+        assert os.path.exists(os.path.join(shard_dir,
+                                           "snapshot_store.json"))
+        # data files dropped at mount; the first search streams them in
+        assert glob.glob(os.path.join(shard_dir, "*", "arrays.npz")) == []
+        r = call("POST", "/olddata/_search",
+                 {"query": {"match": {"t": "archived"}}, "size": 20})
+        assert r["hits"]["total"]["value"] == 10
+        assert glob.glob(os.path.join(shard_dir, "*", "arrays.npz")) != []
+        st, _ = node.rest_controller.dispatch(
+            "PUT", "/olddata/_doc/99", None, {"t": "nope"})
+        assert st >= 400   # mounted = read-only
+    finally:
+        node.close()
