@@ -136,6 +136,11 @@ class ThreadPool:
         self.pools: Dict[str, TaskTrackingPool] = {
             # ref: search pool = 3*p/2+1, queue 1000
             "search": TaskTrackingPool("search", 3 * p // 2 + 1, 1000),
+            # ref: frozen-tier searches serialize on ONE thread with a
+            # deep queue (search_throttled, queue 100) so cold data
+            # can't starve the hot search pool
+            "search_throttled": TaskTrackingPool("search_throttled",
+                                                 1, 100),
             "write": TaskTrackingPool("write", p, 10000),
             "get": TaskTrackingPool("get", p, 1000),
             "management": TaskTrackingPool("management", half, 100),

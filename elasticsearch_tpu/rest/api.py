@@ -1115,10 +1115,32 @@ def search_index(node, params, body, index):
         # through the action seam (ref: RestSearchAction →
         # client.execute(SearchAction.INSTANCE, ...))
         from elasticsearch_tpu.action import SEARCH
-        r = node.client.execute(
-            SEARCH, index, body, scroll=params.get("scroll"), task=task,
-            search_type=params.get("search_type"))
+
+        def run():
+            return node.client.execute(
+                SEARCH, index, body, scroll=params.get("scroll"),
+                task=task, search_type=params.get("search_type"))
+
+        if _targets_only_frozen(node, index):
+            # frozen-tier searches serialize on the search_throttled
+            # pool (ref: ThreadPool.Names.SEARCH_THROTTLED — one
+            # thread) so rehydrating cold HBM state can't starve hot
+            # searches
+            r = node.threadpool.executor("search_throttled") \
+                .submit(run).result(timeout=300)
+        else:
+            r = run()
     return 200, _apply_fls(node, index, r)
+
+
+def _targets_only_frozen(node, index_expression: str) -> bool:
+    try:
+        names = node.indices_service.resolve(index_expression)
+    except Exception:   # noqa: BLE001 — resolution errors surface later
+        return False
+    if not names:
+        return False
+    return all(node.indices_service.get(n).is_frozen for n in names)
 
 
 def search_all(node, params, body):

@@ -53,8 +53,8 @@ def test_pool_rejects_when_full():
 def test_threadpool_registry_names():
     tp = ThreadPool(processors=4)
     try:
-        assert set(tp.stats()) == {"search", "write", "get",
-                                   "management", "snapshot"}
+        assert set(tp.stats()) == {"search", "search_throttled", "write",
+                                   "get", "management", "snapshot"}
         assert tp.executor("search").size == 7   # 3*p/2+1
     finally:
         tp.shutdown()

@@ -346,3 +346,40 @@ def test_sd_notify_protocol(tmp_path, monkeypatch):
         assert systemd.notify_ready() is False   # not under systemd
     finally:
         srv.close()
+
+
+def test_frozen_search_uses_throttled_pool(tmp_path):
+    """Searches targeting only frozen indices run on the single-threaded
+    search_throttled pool (ref: ThreadPool.Names.SEARCH_THROTTLED)."""
+    from elasticsearch_tpu.node import Node
+
+    node = Node(data_path=str(tmp_path / "fz"))
+
+    def call(method, path, body=None, expect=200, **params):
+        st, r = node.rest_controller.dispatch(method, path, params, body)
+        assert st == expect, r
+        return r
+
+    try:
+        call("PUT", "/coldidx", {"mappings": {"properties": {
+            "t": {"type": "text"}}}})
+        call("PUT", "/coldidx/_doc/1", {"t": "ice"}, expect=201)
+        call("POST", "/coldidx/_refresh")
+        call("POST", "/coldidx/_freeze")
+        before = node.threadpool.executor("search_throttled") \
+            .stats()["completed"]
+        r = call("POST", "/coldidx/_search",
+                 {"query": {"match": {"t": "ice"}}})
+        assert r["hits"]["total"]["value"] == 1
+        after = node.threadpool.executor("search_throttled") \
+            .stats()["completed"]
+        assert after == before + 1
+        # hot indices stay off the throttled pool
+        call("PUT", "/hotidx", None)
+        call("PUT", "/hotidx/_doc/1", {"x": 1}, expect=201)
+        call("POST", "/hotidx/_refresh")
+        call("POST", "/hotidx/_search", {"query": {"match_all": {}}})
+        assert node.threadpool.executor("search_throttled") \
+            .stats()["completed"] == after
+    finally:
+        node.close()
