@@ -119,6 +119,23 @@ class PersistedClusterStateStore:
                 break
         else:
             self._gen = gens[-1] if gens else 0
+            # No generation replayed to a commit (e.g. kill -9 during the
+            # very first publish left a torn frame and no barrier). The
+            # chosen file may still end in a corrupt tail; appending after
+            # it would hide every later fsynced record — including future
+            # commit barriers — behind the bad frame on the next replay.
+            # Truncate to the last intact record boundary (or 0) first,
+            # mirroring what _replay does on the commit path.
+            path = self._gen_path(self._gen)
+            if os.path.exists(path):
+                valid_end = 0
+                for _rt, _p, end in _read_records(path):
+                    valid_end = end
+                if os.path.getsize(path) > valid_end:
+                    with open(path, "r+b") as f:
+                        f.truncate(valid_end)
+                        f.flush()
+                        os.fsync(f.fileno())
         self._open_for_append()
 
     def _replay(self, path: str) -> bool:

@@ -127,6 +127,8 @@ class Node:
                 settings.get("xpack.security.audit.enabled", False)),
             pki_header_trusted=bool(settings.get(
                 "xpack.security.authc.pki.trust_proxy_header", False)),
+            pki_truststore=settings.get(
+                "xpack.security.authc.pki.truststore", None),
             keystore=self.keystore,
             jwt_issuer=settings.get(
                 "xpack.security.authc.jwt.allowed_issuer"),

@@ -298,7 +298,6 @@ def _register_all(c: RestController):
     c.register("GET", "/_cat/transforms", cat_transforms)
     c.register("GET", "/_cat/allocation", cat_allocation)
     c.register("GET", "/_cat/templates", cat_templates)
-    c.register("GET", "/_cat/plugins", cat_plugins)
     c.register("GET", "/_cat/thread_pool", cat_thread_pool)
     c.register("GET", "/_cat/pending_tasks", cat_pending_tasks)
     c.register("GET", "/_cat/segments", cat_segments)
@@ -2741,14 +2740,6 @@ def cat_templates(node, params, body):
     return 200, {"_cat": "\n".join(lines)}
 
 
-def cat_plugins(node, params, body):
-    mods = ["sql", "eql", "ml", "watcher", "monitoring", "rollup",
-            "enrich", "graph", "ccr", "transform", "ilm", "security",
-            "async-search", "searchable-snapshots", "autoscaling"]
-    return 200, {"_cat": "\n".join(
-        f"{node.name} {m} {__version__}" for m in sorted(mods))}
-
-
 def cat_thread_pool(node, params, body):
     """name pool active queue rejected (ref: RestThreadPoolAction) —
     from the real named executors."""
@@ -2865,9 +2856,14 @@ def cat_tasks(node, params, body):
 
 
 def cat_plugins(node, params, body):
-    """GET /_cat/plugins (ref: rest/action/cat/RestPluginsAction)."""
-    rows = [f"{node.name} {p['name']} - {p['classname']}"
-            for p in node.plugins_service.info()]
+    """GET /_cat/plugins (ref: rest/action/cat/RestPluginsAction).
+    Bundled x-pack modules plus installed plugins."""
+    mods = ["sql", "eql", "ml", "watcher", "monitoring", "rollup",
+            "enrich", "graph", "ccr", "transform", "ilm", "security",
+            "async-search", "searchable-snapshots", "autoscaling"]
+    rows = [f"{node.name} {m} {__version__}" for m in sorted(mods)]
+    rows += [f"{node.name} {p['name']} - {p['classname']}"
+             for p in node.plugins_service.info()]
     return 200, {"_cat": "\n".join(rows)}
 
 

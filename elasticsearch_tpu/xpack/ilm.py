@@ -335,11 +335,27 @@ class IndexLifecycleService:
             from elasticsearch_tpu.xpack import searchable_snapshots as ss
             name = idx.name
             snap = f"ilm-{name}-{int(now_ms)}"
+            storage = spec.get("storage", "full_copy")
             self.repositories.get_repository(repo).snapshot(snap, [idx])
-            self.indices.delete_index(name)
+            # Mount under a TEMPORARY name before deleting the local
+            # copy (ref: the SearchableSnapshotAction step sequence
+            # mounts the restored copy before swapping away the
+            # original) — a repository/validation failure here leaves
+            # the original index untouched instead of stranding the
+            # data inside the just-taken snapshot.
+            tmp = f"{name}-ilm-mounting"
+            if self.indices.has(tmp):
+                # leftover from a crashed earlier tick — clear it so the
+                # retry doesn't wedge on ResourceAlreadyExists forever
+                self.indices.delete_index(tmp)
             ss.mount_services(self.repositories, self.indices, repo,
-                              snap, name, name,
-                              storage=spec.get("storage", "full_copy"))
+                              snap, name, tmp, storage=storage)
+            self.indices.delete_index(name)
+            # if this re-mount fails the temp mount survives, so the
+            # data stays searchable under `tmp` while the tick errors
+            ss.mount_services(self.repositories, self.indices, repo,
+                              snap, name, name, storage=storage)
+            self.indices.delete_index(tmp)
             return True
         if action == "wait_for_snapshot":
             policy = spec.get("policy")

@@ -165,6 +165,89 @@ def subject_dn_string(subject: Dict[str, str]) -> str:
     return ",".join(f"{k}={subject[k]}" for k in order if k in subject)
 
 
+def _verify_cert_chain(ders: List[bytes], truststore_path: str) -> None:
+    """Validate a DER chain against a PEM CA bundle: every link's
+    signature over tbsCertificate must verify against its issuer's
+    public key, the terminal link must chain to a trusted CA, and all
+    certs must be within their validity window (ref: PkiRealm's trust
+    manager — 'Certificate for <dn> is not trusted'). Raises
+    AuthenticationException on any failure."""
+    try:
+        from cryptography import x509
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives.asymmetric import (
+            ec, ed25519, ed448, padding, rsa)
+    except ImportError:                              # pragma: no cover
+        raise AuthenticationException(
+            "PKI chain validation unavailable (no cryptography library); "
+            "refusing delegated PKI")
+
+    def _check_sig(cert, issuer):
+        pub = issuer.public_key()
+        data, sig = cert.tbs_certificate_bytes, cert.signature
+        if isinstance(pub, rsa.RSAPublicKey):
+            pub.verify(sig, data, padding.PKCS1v15(),
+                       cert.signature_hash_algorithm)
+        elif isinstance(pub, ec.EllipticCurvePublicKey):
+            pub.verify(sig, data,
+                       ec.ECDSA(cert.signature_hash_algorithm))
+        elif isinstance(pub, (ed25519.Ed25519PublicKey,
+                              ed448.Ed448PublicKey)):
+            pub.verify(sig, data)
+        else:
+            raise InvalidSignature("unsupported issuer key type")
+
+    try:
+        with open(truststore_path, "rb") as fh:
+            trusted = x509.load_pem_x509_certificates(fh.read())
+    except Exception:
+        raise AuthenticationException(
+            f"unable to load PKI truststore [{truststore_path}]")
+    try:
+        chain = [x509.load_der_x509_certificate(d) for d in ders]
+    except Exception:
+        raise AuthenticationException(
+            "unable to parse X.509 certificate chain")
+    import datetime as _dt
+    now = _dt.datetime.now(_dt.timezone.utc)
+    for cert in chain:
+        if not (cert.not_valid_before_utc <= now
+                <= cert.not_valid_after_utc):
+            raise AuthenticationException(
+                f"certificate for [{cert.subject.rfc4514_string()}] is "
+                "expired or not yet valid")
+    # Anchoring is decided ONLY by (a) byte-identity with a trusted cert
+    # or (b) a signature that VERIFIES against a trusted cert's key.
+    # Subject/issuer DN strings are attacker-chosen and never grant
+    # trust by themselves — a rogue in-chain "CA" carrying a trusted
+    # CA's DN must not anchor the chain.
+    for i, cert in enumerate(chain):
+        if any(cert == t for t in trusted):          # pinned, DER-equal
+            return
+        issuer_dn = cert.issuer.rfc4514_string()
+        for t in trusted:
+            if t.subject.rfc4514_string() == issuer_dn:
+                try:
+                    _check_sig(cert, t)
+                    return                           # anchored in trust
+                except Exception:
+                    pass      # DN collision with the real CA — keep going
+        if i + 1 < len(chain) \
+                and chain[i + 1].subject.rfc4514_string() == issuer_dn:
+            try:
+                _check_sig(cert, chain[i + 1])       # untrusted link
+            except Exception:
+                raise AuthenticationException(
+                    f"certificate for [{cert.subject.rfc4514_string()}] "
+                    "has an invalid signature")
+            continue
+        raise AuthenticationException(
+            f"certificate for [{cert.subject.rfc4514_string()}] "
+            "is not trusted")
+    raise AuthenticationException(
+        "certificate chain does not terminate at a trusted CA")
+
+
 class User:
     def __init__(self, username: str, roles: List[str],
                  metadata: Optional[Dict[str, Any]] = None,
@@ -401,6 +484,11 @@ class JwtRealm(Realm):
 
     def authenticate(self, jwt: str) -> "User":
         key = self._key()
+        if key is None:
+            # keystore reloaded/unloaded between token() and here —
+            # a 401, not a TypeError-driven 500
+            raise AuthenticationException(
+                "JWT realm has no hmac key configured")
         try:
             header_b64, claims_b64, sig_b64 = jwt.split(".")
             header = json.loads(self._b64url(header_b64))
@@ -551,6 +639,7 @@ class SecurityService:
                  audit_enabled: bool = False,
                  realm_orders: Optional[Dict[str, int]] = None,
                  pki_header_trusted: bool = False,
+                 pki_truststore: Optional[str] = None,
                  keystore=None,
                  jwt_issuer: Optional[str] = None,
                  jwt_audience: Optional[str] = None):
@@ -560,6 +649,10 @@ class SecurityService:
         self.anonymous_roles = list(anonymous_roles or [])
         self.enabled = enabled
         self.pki_header_trusted = pki_header_trusted
+        # PEM bundle of CA certs the PKI realm trusts for DELEGATED auth
+        # (ref: PkiRealm truststore — delegated tokens are refused unless
+        # the submitted chain validates against it)
+        self.pki_truststore = pki_truststore
         self._lock = threading.Lock()
         self._users: Dict[str, Dict[str, Any]] = {}
         self._roles: Dict[str, Dict[str, Any]] = {}
@@ -914,7 +1007,22 @@ class SecurityService:
                 "x509_certificate_chain must be non-empty")
         pki = next((r for r in self.realms if isinstance(r, PkiRealm)),
                    None)
-        der = base64.b64decode(x509_chain[0])
+        # ref: PkiRealm refuses delegated tokens unless the chain
+        # validates against the realm's trust manager ("Certificate for
+        # <dn> is not trusted") — without this, any holder of the
+        # delegate_pki privilege could fabricate a DER blob for an
+        # arbitrary CN and mint a token with that identity's roles.
+        if not self.pki_truststore:
+            raise AuthenticationException(
+                "delegated PKI authentication requires a configured PKI "
+                "truststore (pki_truststore); refusing unverified chain")
+        try:
+            ders = [base64.b64decode(c) for c in x509_chain]
+        except Exception:
+            raise AuthenticationException(
+                "x509_certificate_chain entries must be base64 DER")
+        _verify_cert_chain(ders, self.pki_truststore)
+        der = ders[0]
         user = pki.user_from_der(der)
         user.authenticated_realm = pki.name
         out = self._issue_token(user)

@@ -119,6 +119,33 @@ def test_recover_write_restart_keeps_post_recovery_commits(tmp_path):
     store3.close()
 
 
+def test_first_publish_torn_then_commits_survive(tmp_path):
+    """kill -9 during the VERY FIRST publish (torn frame, no commit
+    barrier anywhere): the store must truncate the corrupt tail before
+    appending, or every later fsynced commit hides behind the bad frame
+    on the next restart — silently losing committed state."""
+    store = PersistedClusterStateStore(str(tmp_path))
+    store.set_last_accepted_state(mk_state(1))
+    path = log_path(store)
+    store.close()
+    # cut INSIDE the first record and scribble garbage after it so no
+    # commit barrier survives and the tail is corrupt
+    with open(path, "r+b") as f:
+        f.truncate(9)
+        f.seek(5)
+        f.write(b"\xff\xff\xff\xff")
+
+    store2 = PersistedClusterStateStore(str(tmp_path))
+    assert store2.last_accepted_state() is None   # nothing committed
+    store2.set_last_accepted_state(mk_state(4))   # new commit, fsynced
+    store2.close()
+
+    store3 = PersistedClusterStateStore(str(tmp_path))
+    st = store3.last_accepted_state()
+    assert st is not None and st.version == 4
+    store3.close()
+
+
 def test_corrupt_crc_rolls_back(tmp_path):
     store = PersistedClusterStateStore(str(tmp_path))
     store.set_last_accepted_state(mk_state(1))

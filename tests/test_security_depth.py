@@ -101,35 +101,149 @@ def _make_cert(tmp_path, cn):
     return crt.read_text()
 
 
-def test_pki_realm_and_delegate(node, tmp_path):
-    pem = _make_cert(tmp_path, "kibana-client")
-    # map the DN to roles (ref: role mapping API driving PKI realms)
-    call(node, "PUT", "/_security/role_mapping/pki-map",
-         {"roles": ["monitoring_user"],
-          "rules": {"field": {"dn": "CN=kibana-client,*"}}},
-         headers=basic("elastic", "s3cret"))
+def _make_ca_signed_cert(tmp_path, cn, ca="testca"):
+    """CA cert + a client cert SIGNED by that CA (the delegated-PKI
+    trust-chain contract). Returns (ca_pem_path, client_pem_text)."""
+    ca_key, ca_crt = tmp_path / f"{ca}.key", tmp_path / f"{ca}.crt"
+    if not ca_crt.exists():
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(ca_key), "-out", str(ca_crt), "-days", "1",
+             "-subj", f"/C=US/O=Acme/CN={ca}"],
+            check=True, capture_output=True)
+    key, csr, crt = (tmp_path / f"{cn}.key", tmp_path / f"{cn}.csr",
+                     tmp_path / f"{cn}-signed.crt")
+    subprocess.run(
+        ["openssl", "req", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(csr),
+         "-subj", f"/C=US/O=Acme/CN={cn}"],
+        check=True, capture_output=True)
+    subprocess.run(
+        ["openssl", "x509", "-req", "-in", str(csr), "-CA", str(ca_crt),
+         "-CAkey", str(ca_key), "-CAcreateserial", "-days", "1",
+         "-out", str(crt)],
+        check=True, capture_output=True)
+    return str(ca_crt), crt.read_text()
 
-    # direct header-based PKI (TLS-terminating proxy convention)
-    me = call(node, "GET", "/_security/_authenticate",
-              headers={"x-ssl-client-cert": pem})
-    assert me["username"] == "kibana-client"
-    assert "monitoring_user" in me["roles"]
 
-    # delegated PKI: DER chain → access token
-    der_b64 = "".join(line for line in pem.splitlines()
-                      if not line.startswith("-----"))
-    r = call(node, "POST", "/_security/delegate_pki",
-             {"x509_certificate_chain": [der_b64]},
+def _pem_to_der_b64(pem):
+    return "".join(line for line in pem.splitlines()
+                   if not line.startswith("-----"))
+
+
+def test_pki_realm_and_delegate(tmp_path):
+    ca_path, pem = _make_ca_signed_cert(tmp_path, "kibana-client")
+    node = Node(settings=Settings.from_dict({
+        "xpack": {"security": {
+            "enabled": True,
+            "authc": {"pki": {"trust_proxy_header": True,
+                              "truststore": ca_path}}}},
+        "bootstrap": {"password": "s3cret"},
+    }), data_path=str(tmp_path / "data"))
+    try:
+        # map the DN to roles (ref: role mapping API driving PKI realms)
+        call(node, "PUT", "/_security/role_mapping/pki-map",
+             {"roles": ["monitoring_user"],
+              "rules": {"field": {"dn": "CN=kibana-client,*"}}},
              headers=basic("elastic", "s3cret"))
-    assert r["authentication"]["username"] == "kibana-client"
-    me = call(node, "GET", "/_security/_authenticate",
-              headers={"Authorization": f"Bearer {r['access_token']}"})
-    assert me["username"] == "kibana-client"
 
-    # an unmapped cert authenticates with no roles → cluster reads fail
-    pem2 = _make_cert(tmp_path, "stranger")
-    call(node, "GET", "/_cluster/health",
-         headers={"x-ssl-client-cert": pem2}, expect=403)
+        # direct header-based PKI (TLS-terminating proxy convention)
+        me = call(node, "GET", "/_security/_authenticate",
+                  headers={"x-ssl-client-cert": pem})
+        assert me["username"] == "kibana-client"
+        assert "monitoring_user" in me["roles"]
+
+        # delegated PKI: CA-signed DER chain → access token
+        r = call(node, "POST", "/_security/delegate_pki",
+                 {"x509_certificate_chain": [_pem_to_der_b64(pem)]},
+                 headers=basic("elastic", "s3cret"))
+        assert r["authentication"]["username"] == "kibana-client"
+        me = call(node, "GET", "/_security/_authenticate",
+                  headers={"Authorization":
+                           f"Bearer {r['access_token']}"})
+        assert me["username"] == "kibana-client"
+
+        # a SELF-SIGNED cert (not chained to the truststore) is REFUSED
+        # for delegation — any DN could otherwise be fabricated (ref:
+        # PkiRealm 'Certificate for <dn> is not trusted')
+        forged = _make_cert(tmp_path, "forged-admin")
+        call(node, "POST", "/_security/delegate_pki",
+             {"x509_certificate_chain": [_pem_to_der_b64(forged)]},
+             headers=basic("elastic", "s3cret"), expect=401)
+
+        # an unmapped cert authenticates with no roles → reads fail
+        pem2 = _make_cert(tmp_path, "stranger")
+        call(node, "GET", "/_cluster/health",
+             headers={"x-ssl-client-cert": pem2}, expect=403)
+    finally:
+        node.close()
+
+
+def test_delegate_pki_rejects_rogue_issuer_with_trusted_dn(tmp_path):
+    """A rogue in-chain 'CA' that merely COPIES the trusted CA's subject
+    DN (attacker's own key) must not anchor the chain — trust is a key
+    verification, never a DN string match."""
+    ca_path, _ = _make_ca_signed_cert(tmp_path, "legit-client")
+    node = Node(settings=Settings.from_dict({
+        "xpack": {"security": {
+            "enabled": True,
+            "authc": {"pki": {"truststore": ca_path}}}},
+        "bootstrap": {"password": "s3cret"},
+    }), data_path=str(tmp_path / "data"))
+    try:
+        # rogue CA: same subject DN as the trusted CA, different key
+        rk, rc = tmp_path / "rogue.key", tmp_path / "rogue.crt"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(rk), "-out", str(rc), "-days", "1",
+             "-subj", "/C=US/O=Acme/CN=testca"],
+            check=True, capture_output=True)
+        lk, lcsr, lc = (tmp_path / "victim.key", tmp_path / "victim.csr",
+                        tmp_path / "victim.crt")
+        subprocess.run(
+            ["openssl", "req", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(lk), "-out", str(lcsr),
+             "-subj", "/C=US/O=Acme/CN=any-victim"],
+            check=True, capture_output=True)
+        subprocess.run(
+            ["openssl", "x509", "-req", "-in", str(lcsr), "-CA", str(rc),
+             "-CAkey", str(rk), "-CAcreateserial", "-days", "1",
+             "-out", str(lc)],
+            check=True, capture_output=True)
+        chain = [_pem_to_der_b64(lc.read_text()),
+                 _pem_to_der_b64(rc.read_text())]
+        call(node, "POST", "/_security/delegate_pki",
+             {"x509_certificate_chain": chain},
+             headers=basic("elastic", "s3cret"), expect=401)
+
+        # a forged SELF-SIGNED cert whose subject copies the trusted
+        # CA's DN must also fail (no self-anchoring by subject match)
+        forged = tmp_path / "forged-ca.crt"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(tmp_path / "f.key"), "-out", str(forged),
+             "-days", "1", "-subj", "/C=US/O=Acme/CN=testca"],
+            check=True, capture_output=True)
+        call(node, "POST", "/_security/delegate_pki",
+             {"x509_certificate_chain":
+              [_pem_to_der_b64(forged.read_text())]},
+             headers=basic("elastic", "s3cret"), expect=401)
+
+        # malformed base64 is a 4xx, not a 500
+        call(node, "POST", "/_security/delegate_pki",
+             {"x509_certificate_chain": ["ab!c"]},
+             headers=basic("elastic", "s3cret"), expect=401)
+    finally:
+        node.close()
+
+
+def test_delegate_pki_refused_without_truststore(node, tmp_path):
+    """No configured truststore ⇒ delegated PKI is refused outright
+    (the reference refuses delegation without a trust manager)."""
+    pem = _make_cert(tmp_path, "anyone")
+    call(node, "POST", "/_security/delegate_pki",
+         {"x509_certificate_chain": [_pem_to_der_b64(pem)]},
+         headers=basic("elastic", "s3cret"), expect=401)
 
 
 def test_role_mapping_crud(node):
