@@ -21,41 +21,19 @@
 #include <cstring>
 #include <unordered_map>
 
+#include "estpu_tokenize.h"
+
 extern "C" {
 
 // ---------------------------------------------------------------------------
 // Tokenizer: ASCII word-boundary rules (alnum runs), lowercasing in place.
-// Writes (start, end) byte offsets into `offsets` (2 ints per token) and
-// lowercased token bytes into `lowered` (same length as text).
-// Returns the number of tokens (or -1 if max_tokens exceeded).
+// ONE shared implementation (estpu_tokenize.h) serves indexing AND the HTTP
+// fast path — query/index tokenization parity by construction.
 // ---------------------------------------------------------------------------
 int tokenize_ascii(const char* text, int len, int max_token_length,
                    int* offsets, int max_tokens, char* lowered) {
-    int n = 0;
-    int i = 0;
-    while (i < len) {
-        unsigned char c = (unsigned char)text[i];
-        bool word = (c < 128) && (isalnum(c) != 0);
-        if (!word) {
-            lowered[i] = (char)c;
-            i++;
-            continue;
-        }
-        int start = i;
-        while (i < len) {
-            unsigned char ch = (unsigned char)text[i];
-            if (ch >= 128 || !isalnum(ch)) break;
-            lowered[i] = (ch >= 'A' && ch <= 'Z') ? (char)(ch + 32) : (char)ch;
-            i++;
-        }
-        if (i - start <= max_token_length) {
-            if (n >= max_tokens) return -1;
-            offsets[2 * n] = start;
-            offsets[2 * n + 1] = i;
-            n++;
-        }
-    }
-    return n;
+    return estpu_tokenize_ascii(text, len, max_token_length, offsets,
+                                max_tokens, lowered);
 }
 
 // ---------------------------------------------------------------------------

@@ -6,10 +6,13 @@ lifecycle start/stop/close). Single-node for now; the cluster layer
 
 from __future__ import annotations
 
+import logging
 import os
 import uuid
 from collections import OrderedDict
 from typing import Optional
+
+logger = logging.getLogger("elasticsearch_tpu.node")
 
 from elasticsearch_tpu.common.settings import Setting, Settings
 from elasticsearch_tpu.index.service import IndicesService
@@ -197,9 +200,54 @@ class Node:
                 "certificate_authorities": self.settings.get(
                     "xpack.security.http.ssl.certificate_authorities"),
             }
-        self._http = HttpServer(self.rest_controller, port=http_port,
-                                ssl_config=ssl_config)
-        self._http.start()
+        # native epoll front (C++, rest/native_http.py) unless TLS is on
+        # or the setting/toolchain says otherwise; falls back to the
+        # stdlib server transparently. Settings parse FIRST so a typo
+        # falls back instead of crashing a half-started front.
+        native_pref = self.settings.get("http.native", "auto")
+        allow = str(self.settings.get("http.ip_filter.allow", "") or "")
+        deny = str(self.settings.get("http.ip_filter.deny", "") or "")
+        self._http = None
+        if ssl_config is None and native_pref in ("auto", True, "true"):
+            front = None
+            try:
+                nb_buckets = self.settings.get(
+                    "http.native.fast_nb_buckets") or (1024, 4096)
+                if isinstance(nb_buckets, str):
+                    nb_buckets = tuple(
+                        int(x) for x in nb_buckets.split(","))
+                fast_streams = int(self.settings.get(
+                    "http.native.fast_streams", 4))
+                fast_max_k = int(self.settings.get(
+                    "http.native.fast_max_k", 1000))
+                from elasticsearch_tpu.rest.native_http import (
+                    NativeHttpFront)
+                front = NativeHttpFront.try_acquire(self.rest_controller)
+                if front is not None:
+                    front.start(http_port)
+                    from elasticsearch_tpu.search.fastpath import (
+                        FastPathServer)
+                    front.fastpath = FastPathServer(
+                        self, front, nb_buckets=nb_buckets,
+                        n_streams=fast_streams, max_k=fast_max_k)
+                    front.fastpath.start()
+                    if allow or deny:
+                        front.set_ipfilter(allow, deny)
+                    self._http = front
+            except Exception:
+                logger.exception(
+                    "native http front failed; using stdlib server")
+                if front is not None:
+                    try:
+                        front.stop()
+                    except Exception:
+                        pass
+                self._http = None
+        if self._http is None:
+            self._http = HttpServer(self.rest_controller, port=http_port,
+                                    ssl_config=ssl_config,
+                                    ip_filter=(allow, deny))
+            self._http.start()
         # sd_notify READY under systemd (ref: modules/systemd)
         from elasticsearch_tpu.common.systemd import notify_ready
         notify_ready()

@@ -1,0 +1,81 @@
+"""Serving-front kernels: exact batched BM25 top-k with exact totals.
+
+The native HTTP front (native/src/estpu_http.cpp) parses hot `_search`
+bodies in C++ and hands Python per-cohort term-id batches; this module is
+the device half of that path. One launch scores a whole cohort and returns
+a SINGLE packed f32 array so the (degraded-tunnel) device→host sync is paid
+once per cohort, not once per output (ops/bm25.py:119-131 documents the
+readback cliff).
+
+Exactness: no block-max pruning here — the full selected postings go
+through the sort, so recall vs an exact scorer is 1.0 by construction
+(VERDICT round 2: the pruned plan path's 0.99 recall was the gap; the
+baseline contract is exact top-k, ref TopDocsCollectorContext.java:210-217).
+Totals are exact distinct-match counts (relation "eq"), matching the dense
+path's `scores > 0` semantics.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from elasticsearch_tpu.ops.bm25 import _SENTINEL, bm25_contrib
+
+
+def _topk_total(block_docids, block_tfs, sel_blocks, sel_weights,
+                doc_lens, live, avg_len, k1: float, b: float, k: int):
+    """Single query: (values [k], docids [k], total []) — the sorted
+    segmented-reduction top-k (ops/bm25.bm25_sorted_topk) plus an exact
+    distinct-match count from the same run boundaries."""
+    d = jnp.take(block_docids, sel_blocks, axis=0)       # [NB, B]
+    tf = jnp.take(block_tfs, sel_blocks, axis=0)
+    dl = jnp.take(doc_lens, d)
+    contrib = bm25_contrib(sel_weights, tf, dl, avg_len, k1, b)
+
+    dflat = d.reshape(-1)
+    cflat = contrib.reshape(-1)
+    valid = (tf.reshape(-1) > 0.0) & jnp.take(live, dflat)
+    dkey = jnp.where(valid, dflat, _SENTINEL)
+    cflat = jnp.where(valid, cflat, 0.0)
+
+    sorted_k, sorted_c = jax.lax.sort((dkey, cflat), num_keys=1)
+    cs = jnp.cumsum(sorted_c)
+    cs_excl = cs - sorted_c
+    prev = jnp.concatenate([jnp.full(1, -1, sorted_k.dtype),
+                            sorted_k[:-1]])
+    nxt = jnp.concatenate([sorted_k[1:],
+                           jnp.full(1, -1, sorted_k.dtype)])
+    is_first = sorted_k != prev
+    is_last = sorted_k != nxt
+    run_start_excl = jax.lax.cummax(jnp.where(is_first, cs_excl, 0.0))
+    totals = cs - run_start_excl
+    real_last = is_last & (totals > 0.0) & (sorted_k != _SENTINEL)
+    cand = jnp.where(real_last, totals, -jnp.inf)
+    total = real_last.sum(dtype=jnp.int32)
+    vals, pos = jax.lax.top_k(cand, k)
+    ids = jnp.take(sorted_k, pos)
+    ids = jnp.where(jnp.isfinite(vals), ids, _SENTINEL)
+    return vals, ids, total
+
+
+@partial(jax.jit, static_argnames=("k1", "b", "k"))
+def bm25_topk_total_batch(block_docids,   # int32 [TB, B]
+                          block_tfs,      # float32 [TB, B]
+                          sel_blocks,     # int32 [Q, NB]
+                          sel_weights,    # float32 [Q, NB]
+                          doc_lens,       # float32 [ND]
+                          live,           # bool [ND] (base live AND filters)
+                          avg_len, k1: float, b: float, k: int):
+    """Cohort launch → ONE packed float32 [Q, 2k+1]:
+    ``row = [values (k) | docids bitcast to f32 (k) | total bitcast (1)]``.
+    Unpack host-side with ``row[k:].view(np.int32)``."""
+    vals, ids, totals = jax.vmap(
+        lambda s, w: _topk_total(block_docids, block_tfs, s, w,
+                                 doc_lens, live, avg_len, k1, b, k)
+    )(sel_blocks, sel_weights)
+    ids_f = jax.lax.bitcast_convert_type(ids, jnp.float32)
+    tot_f = jax.lax.bitcast_convert_type(totals, jnp.float32)
+    return jnp.concatenate([vals, ids_f, tot_f[:, None]], axis=1)

@@ -89,9 +89,13 @@ class HttpServer:
     "certificate_authorities": pem_path}."""
 
     def __init__(self, controller, host: str = "127.0.0.1", port: int = 9200,
-                 ssl_config=None):
+                 ssl_config=None, ip_filter=None):
         handler = type("BoundHandler", (_Handler,), {"controller": controller})
         self.ssl_enabled = bool(ssl_config)
+        # accept-time IP filtering (ref: x-pack IPFilter — allow wins,
+        # an allow-list alone implies deny-everything-else); same
+        # semantics as the native front (estpu_http.cpp ip_allowed)
+        self._ip_allow, self._ip_deny = self._parse_ip_filter(ip_filter)
         if ssl_config:
             from elasticsearch_tpu.common.tls import (handshake,
                                                       server_context)
@@ -113,8 +117,40 @@ class HttpServer:
             self._server = _TlsServer((host, port), handler)
         else:
             self._server = ThreadingHTTPServer((host, port), handler)
+        if self._ip_allow or self._ip_deny:
+            allow, deny = self._ip_allow, self._ip_deny
+            outer = self._server
+
+            def verify_request(request, client_address,
+                               _orig=outer.verify_request):
+                import ipaddress
+                try:
+                    addr = ipaddress.ip_address(client_address[0])
+                except ValueError:
+                    return False
+                if any(addr in net for net in allow):
+                    return True
+                if any(addr in net for net in deny):
+                    return False
+                return not allow
+            outer.verify_request = verify_request
         self.port = self._server.server_address[1]
         self._thread = None
+
+    @staticmethod
+    def _parse_ip_filter(ip_filter):
+        import ipaddress
+        allow, deny = [], []
+        if ip_filter:
+            for spec_csv, out in ((ip_filter[0], allow),
+                                  (ip_filter[1], deny)):
+                for spec in (spec_csv or "").split(","):
+                    spec = spec.strip()
+                    if spec:
+                        out.append(ipaddress.ip_network(
+                            spec if "/" in spec else spec + "/32",
+                            strict=False))
+        return allow, deny
 
     def start(self):
         self._thread = threading.Thread(target=self._server.serve_forever,
