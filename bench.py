@@ -434,6 +434,7 @@ def run_secondary(corpus, queries, rng, h):
 # ---------------------------------------------------------------------------
 
 def build_rest_node(corpus, tmpdir):
+    from elasticsearch_tpu.common.settings import Settings
     from elasticsearch_tpu.index.segment import PostingsField, Segment, StoredFields
     from elasticsearch_tpu.node import Node
 
@@ -467,7 +468,13 @@ def build_rest_node(corpus, tmpdir):
     seg = Segment("bench0", N_DOCS, postings={"title": pf}, numerics={},
                   keywords={}, vectors={}, stored=stored)
 
-    node = Node(data_path=os.path.join(tmpdir, "node"))
+    node = Node(settings=Settings.from_dict({
+        "http": {"native": {
+            "fast_nb_buckets": os.environ.get("BENCH_FAST_BUCKETS",
+                                              "1024,2048,4096"),
+            "fast_streams": int(os.environ.get("BENCH_FAST_STREAMS", 6)),
+            "fast_max_k": K}},
+    }), data_path=os.path.join(tmpdir, "node"))
     status, _ = node.rest_controller.dispatch(
         "PUT", "/bench", None,
         {"mappings": {"properties": {"title": {"type": "text"}}}})
@@ -476,113 +483,121 @@ def build_rest_node(corpus, tmpdir):
     with eng._lock:
         eng._segments = [seg]
         eng._epoch += 1
-    log(f"REST node ready in {time.time()-t0:.1f}s")
-    return node
+    port = node.start(0)
+    log(f"REST node ready in {time.time()-t0:.1f}s (port {port})")
+    # the fast path registers once its kernel shapes are compiled — this
+    # is the refresh/startup precompile (VERDICT r2 item 2: the 69.7s
+    # first-query stall is paid HERE, not by the first request)
+    t0 = time.time()
+    fp = getattr(node._http, "fastpath", None)
+    if fp is not None:
+        deadline = time.time() + 1200
+        while fp._reg is None and time.time() < deadline:
+            time.sleep(1.0)
+        log(f"fastpath registered in {time.time()-t0:.1f}s "
+            f"(warm compiles included)")
+    else:
+        log("WARNING: native front unavailable — serving via fallback")
+    return node, port
+
+
+def _loadgen(port, bodies_json, n_conns, total, timeout_ms=600_000):
+    """Drive the node over REAL loopback HTTP with the C++ epoll client
+    (native/src/estpu_http.cpp es_loadgen). On a 1-core host a Python
+    client pool competes with the server for the GIL and measures
+    itself; the C++ client costs ~µs/request."""
+    import ctypes
+
+    from elasticsearch_tpu.rest import native_http
+
+    lib = native_http.get_lib()
+    blobs = [json.dumps(b).encode() for b in bodies_json]
+    blob = b"".join(blobs)
+    offs = np.zeros(len(blobs) + 1, np.int64)
+    np.cumsum([len(b) for b in blobs], out=offs[1:])
+    lat = np.zeros(total, np.float64)
+    wall = ctypes.c_double()
+    done = lib.es_loadgen(
+        port, b"/bench/_search", blob,
+        offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(blobs), n_conns, total, timeout_ms,
+        lat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.byref(wall))
+    lat_ms = lat[:done] / 1000.0
+    qps = done / wall.value if wall.value > 0 else 0.0
+    return done, qps, lat_ms
 
 
 def run_rest_path(corpus, queries, truth, tmpdir):
+    import urllib.request
+
     import elasticsearch_tpu.search.batching as batching_mod
     import elasticsearch_tpu.search.plan as plan_mod
 
-    # compile-count discipline vs padding waste: each (NB bucket, Q
-    # shape) pair is one XLA compile, but padding small queries up to a
-    # big bucket costs real device time per launch (sort lanes are the
-    # dominant device cost). A 1024 floor + Q∈{1,32} keeps compiles to
-    # ~8 while halving average launch work vs a 2048/64 config.
+    # fallback-path knobs (anything the C++ fast parser rejects still
+    # runs through the Python plan path)
     plan_mod.MIN_PLAN_BUCKET = int(os.environ.get("BENCH_REST_FLOOR", 1024))
     batching_mod._Q_BUCKETS = (1, 32)
 
-    node = build_rest_node(corpus, tmpdir)
+    node, port = build_rest_node(corpus, tmpdir)
+    base = f"http://127.0.0.1:{port}"
     bodies = []
     for q in queries:
         text = " ".join(f"t{t:06d}" for t in q)
         bodies.append({"query": {"match": {"title": text}},
                        "size": K, "_source": False})
 
-    def dispatch(body):
-        status, resp = node.rest_controller.dispatch(
-            "POST", "/bench/_search", None, body)
-        assert status == 200, (status, resp)
-        return resp
+    def http_post(body):
+        r = urllib.request.Request(
+            base + "/bench/_search", data=json.dumps(body).encode(),
+            method="POST", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(r, timeout=300) as resp:
+            return json.loads(resp.read())
 
-    # ---- single-client pass: warms Q=1 compiles per bucket AND measures
-    # recall over the FULL query set through the API
+    # ---- first-query latency post-registration (the cold-start number:
+    # kernel shapes compiled at registration, so this must be fast)
+    t0 = time.time()
+    http_post(bodies[0])
+    log(f"first REST query (post-registration) {time.time()-t0:.2f}s")
+
+    # ---- recall over the FULL query set through real HTTP
     t0 = time.time()
     recalls = []
     for qi, body in enumerate(bodies):
-        resp = dispatch(body)
+        resp = http_post(body)
         ids = {int(h["_id"]) for h in resp["hits"]["hits"]}
         tset = truth[qi]
         recalls.append(len(ids & tset) / max(1, len(tset)))
-        if qi == 0:
-            log(f"first REST query (compile) {time.time()-t0:.1f}s")
     rest_recall = float(np.mean(recalls))
     log(f"REST recall@{K} over {len(bodies)} queries: {rest_recall:.4f} "
         f"({time.time()-t0:.1f}s)")
 
-    # ---- concurrent throughput: CLIENTS threads share batched launches
-    lat_lock = threading.Lock()
+    # ---- throughput: C++ loadgen, CLIENTS keep-alive connections.
+    # Snapshot the fast-path stats AROUND the measured phase only — the
+    # sequential recall pass runs cohort-1 launches and would dilute the
+    # continuous-batching average
+    reps = int(os.environ.get("BENCH_REST_REPS", 12))
+    _loadgen(port, bodies, CLIENTS, len(bodies) * 2)   # warm caches
+    fp = getattr(node._http, "fastpath", None)
+    stats0 = node._http.stats() if hasattr(node._http, "stats") else {}
+    fstats0 = dict(fp.stats) if fp is not None else {}
+    done, best_qps, lat_ms = _loadgen(port, bodies, CLIENTS,
+                                      len(bodies) * reps)
+    p50 = float(np.median(lat_ms)) if len(lat_ms) else 0.0
+    p99 = float(np.percentile(lat_ms, 99)) if len(lat_ms) else 0.0
+    stats1 = node._http.stats() if hasattr(node._http, "stats") else {}
+    fstats1 = dict(fp.stats) if fp is not None else {}
+    fast_served = stats1.get("fast", 0) - stats0.get("fast", 0)
+    avg_batch = ((fstats1.get("fast_queries", 0)
+                  - fstats0.get("fast_queries", 0))
+                 / max(1, (fstats1.get("cohorts", 0)
+                           - fstats0.get("cohorts", 0))))
+    log(f"REST serving: {best_qps:.1f} qps over HTTP with {CLIENTS} "
+        f"connections ({done} reqs, p50 {p50:.2f} ms, p99 {p99:.2f} ms, "
+        f"fast-served {fast_served}, avg cohort {avg_batch:.1f})")
 
-    errors = []
-
-    def client(worklist, lats):
-        for body in worklist:
-            t0 = time.time()
-            try:
-                dispatch(body)
-            except BaseException as exc:  # noqa: BLE001
-                with lat_lock:
-                    errors.append(exc)
-                return
-            dt = time.time() - t0
-            with lat_lock:
-                lats.append(dt)
-
-    def one_round(reps):
-        work = bodies * reps
-        shards = [work[i::CLIENTS] for i in range(CLIENTS)]
-        lats = []
-        threads = [threading.Thread(target=client, args=(s, lats))
-                   for s in shards]
-        t0 = time.time()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.time() - t0
-        if errors:
-            raise RuntimeError(f"{len(errors)} client errors; first: "
-                               f"{errors[0]!r}")
-        # QPS counts only requests that actually completed
-        return len(lats) / wall, lats
-
-    one_round(1)   # warm Q=32 compiles + caches
-    best_qps, best_lats = 0.0, []
-    base = node.search_service.plan_batcher.stats()
-    # several queries per client per round: sustained concurrency, not a
-    # one-shot burst whose wall clock is just the slowest straggler
-    reps = max(2, (6 * CLIENTS) // max(1, len(bodies)))
-    for _ in range(3):
-        qps, lats = one_round(reps)
-        if qps > best_qps:
-            best_qps, best_lats = qps, lats
-    p50 = float(np.median(best_lats) * 1000)
-    p99 = float(np.percentile(best_lats, 99) * 1000)
-    end = node.search_service.plan_batcher.stats()
-    # cohort size over the CONCURRENT phase only (the sequential recall
-    # pass runs batch-1 launches and would dilute the stat)
-    dl = max(1, end["launches"] - base["launches"])
-    bstats = {"avg_batch":
-              (end["batched_queries"] - base["batched_queries"]) / dl}
-    log(f"REST serving: {best_qps:.1f} qps with {CLIENTS} clients "
-        f"(p50 {p50:.2f} ms, p99 {p99:.2f} ms, "
-        f"avg batch {bstats['avg_batch']:.1f})")
-
-    # ---- bool+filters through the PRODUCT path: the filter-mask cache
-    # (search/plan._convert_filters → ops/device.filter_mask, the
-    # LRUQueryCache analogue) keeps filter postings out of the sort.
-    # Filters draw from a small pool of common terms, as real traffic's
-    # hot filters do.
+    # ---- bool+filters over HTTP (filters from a small hot pool — the
+    # cached-filter-mask + cohort-sharing path)
     bool_qps = 0.0
     try:
         frng = np.random.default_rng(777)
@@ -599,18 +614,16 @@ def run_rest_path(corpus, queries, truth, tmpdir):
                     "filter": [{"match": {"title": f"t{int(f1):06d}"}},
                                {"match": {"title": f"t{int(f2):06d}"}}]}},
                 "size": K, "_source": False})
-        for bodyf in fbodies[:12]:
-            dispatch(bodyf)   # warm compiles + the mask cache
-        t0 = time.time()
-        for bodyf in fbodies:
-            dispatch(bodyf)
-        bool_qps = len(fbodies) / (time.time() - t0)
-        log(f"REST bool+filters (cached filter masks): {bool_qps:.1f} qps")
+        _loadgen(port, fbodies, CLIENTS, len(fbodies))   # warm masks
+        done_b, bool_qps, lat_b = _loadgen(port, fbodies, CLIENTS,
+                                           len(fbodies) * 8)
+        log(f"REST bool+filters over HTTP: {bool_qps:.1f} qps "
+            f"({done_b} reqs, p50 {np.median(lat_b):.2f} ms)")
     except Exception as e:
         log(f"REST bool+filters failed: {e!r}")
 
     node.close()
-    return best_qps, p50, p99, rest_recall, bstats["avg_batch"], bool_qps
+    return best_qps, p50, p99, rest_recall, avg_batch, bool_qps
 
 
 # ---------------------------------------------------------------------------
@@ -655,9 +668,12 @@ def main():
         base_txt = "baseline unavailable (native library did not build)"
     print(json.dumps({
         "metric": (
-            f"BM25 top-{K} QPS through REST _search (dispatch, {CLIENTS} "
-            f"concurrent clients, continuous batching avg {avg_batch:.0f}/"
-            f"launch), {N_QUERIES} queries 1-8 terms, synthetic "
+            f"BM25 top-{K} QPS through the REST product path — REAL "
+            f"loopback HTTP against the native C++ front (epoll server, "
+            f"C++ body parse + response serialization, exact fused-batch "
+            f"kernel), {CLIENTS} keep-alive connections driven by a C++ "
+            f"epoll loadgen, continuous batching avg {avg_batch:.0f}/"
+            f"launch, {N_QUERIES} queries 1-8 terms, synthetic "
             f"{N_DOCS // 1_000_000}M-doc corpus, single chip; p50 "
             f"{p50:.1f} ms, p99 {p99:.1f} ms; NOTE the serving numbers "
             f"run in the tunnel's post-readback DEGRADED mode — the "

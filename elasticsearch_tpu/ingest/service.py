@@ -750,17 +750,24 @@ def _compile_ingest_script(source: str):
         cached = _SCRIPT_CACHE.get(("script", source))
     if cached is not None:
         return cached
-    # Painless-style `ctx.field = ...; ...` statements
-    py = _painless_to_py(source, statements=True)
-    tree = _ast.parse(py, mode="exec")
-    _validate_ingest(tree, source)
-    code = compile(tree, "<ingest_script>", "exec")
+    # the REAL language first (script/ — statements, loops, functions,
+    # per-type method allowlists); the legacy python-expression
+    # translation only remains for scripts Painless can't parse
+    from elasticsearch_tpu.script import contexts as _plctx
+    if _plctx.try_compile(source):
+        def run(doc: IngestDocument, params: Dict[str, Any]):
+            _plctx.run_ingest_script(source, doc, params)
+    else:
+        py = _painless_to_py(source, statements=True)
+        tree = _ast.parse(py, mode="exec")
+        _validate_ingest(tree, source)
+        code = compile(tree, "<ingest_script>", "exec")
 
-    def run(doc: IngestDocument, params: Dict[str, Any]):
-        env = {"ctx": _CtxView(doc), "params": _AttrDict(params),
-               "len": len, "str": str, "int": int, "float": float,
-               "bool": bool}
-        exec(code, {"__builtins__": {}}, env)
+        def run(doc: IngestDocument, params: Dict[str, Any]):
+            env = {"ctx": _CtxView(doc), "params": _AttrDict(params),
+                   "len": len, "str": str, "int": int, "float": float,
+                   "bool": bool}
+            exec(code, {"__builtins__": {}}, env)
 
     with _SCRIPT_LOCK:
         _SCRIPT_CACHE[("script", source)] = run
@@ -793,6 +800,13 @@ def _compile_condition(source: str):
         cached = _SCRIPT_CACHE.get(("cond", source))
     if cached is not None:
         return cached
+    from elasticsearch_tpu.script import contexts as _plctx
+    if _plctx.try_compile(source):
+        def run_pl(doc: IngestDocument) -> bool:
+            return _plctx.run_ingest_condition(source, doc)
+        with _SCRIPT_LOCK:
+            _SCRIPT_CACHE[("cond", source)] = run_pl
+        return run_pl
     py = _painless_to_py(source)
     tree = _ast.parse(py, mode="eval")
     _validate_ingest(tree, source)

@@ -68,6 +68,17 @@ class Node:
             self.search_service, self.task_manager)
         self.ingest_service = IngestService(self.data_path)
         self.stored_scripts = StoredScripts(self.data_path)
+        # stored-script resolver hook: a weakref so a closed node's
+        # scripts (and data-path state) are never pinned process-wide
+        import weakref
+        from elasticsearch_tpu.search import queries as _queries_mod
+        _ss_ref = weakref.ref(self.stored_scripts)
+
+        def _resolve(script_id, _r=_ss_ref):
+            ss = _r()
+            return ss.get(script_id) if ss is not None else None
+        _queries_mod.STORED_SCRIPT_RESOLVER = _resolve
+        self._stored_script_resolver = _resolve
         self.metadata_service = MetadataService(self.indices_service,
                                                 self.data_path)
         # cloud repository credentials resolve from the node keystore
@@ -262,6 +273,10 @@ class Node:
 
     def close(self):
         self.stop()
+        from elasticsearch_tpu.search import queries as _queries_mod
+        if _queries_mod.STORED_SCRIPT_RESOLVER is getattr(
+                self, "_stored_script_resolver", None):
+            _queries_mod.STORED_SCRIPT_RESOLVER = None
         from elasticsearch_tpu.index import engine as _engine_mod
         _engine_mod.LAZY_MATERIALIZERS.pop(self.data_path, None)
         from elasticsearch_tpu.repositories import blobstore as _bs

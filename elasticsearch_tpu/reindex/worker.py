@@ -151,6 +151,13 @@ class UpdateScript:
     def __init__(self, source: str, params: Optional[Dict[str, Any]] = None):
         self.source = source
         self.params = params or {}
+        # full-language path first (script/ — statements, loops,
+        # functions); legacy translation only for what it can't parse
+        from elasticsearch_tpu.script import contexts as _plctx
+        self._painless = _plctx.try_compile(source)
+        if self._painless:
+            self._code = None
+            return
         py = _painless_to_python(source)
         try:
             tree = ast.parse(py, mode="exec")
@@ -168,6 +175,14 @@ class UpdateScript:
         self._code = compile(tree, "<update-script>", "exec")
 
     def run(self, ctx: _Ctx) -> None:
+        if self._painless:
+            from elasticsearch_tpu.script import contexts as _plctx
+            from elasticsearch_tpu.script.interp import PainlessError
+            try:
+                _plctx.run_update_script(self.source, ctx, self.params)
+            except PainlessError as e:
+                raise ScriptException(str(e))
+            return
         scope = dict(_SAFE_FUNCS)
         scope["ctx"] = ctx
         scope["params"] = _ScriptParams(self.params)

@@ -907,7 +907,34 @@ def update_doc(node, params, body, index, id):
         if params.get("refresh") in ("true", ""):
             idx.refresh()
         return 200, _write_response(index, result, "updated")
-    raise IllegalArgumentException("update requires [doc] or [upsert]")
+    if "script" in body:
+        # scripted update (ref: UpdateHelper.executeScriptedUpsert /
+        # prepareUpdateScriptRequest — ctx._source mutation, ctx.op)
+        from elasticsearch_tpu.reindex.worker import (_Ctx,
+                                                      compile_update_script)
+        spec = body["script"]
+        script = compile_update_script(spec)
+        import copy
+        src = copy.deepcopy(current.source)
+        ctx = _Ctx(src, index, id, current.version)
+        script.run(ctx)
+        if ctx.op == "none" or ctx.op == "noop":
+            result_shell = type("R", (), {
+                "doc_id": id, "version": current.version,
+                "seq_no": current.seq_no,
+                "primary_term": current.primary_term})
+            return 200, _write_response(index, result_shell, "noop")
+        if ctx.op == "delete":
+            result = idx.delete_doc(id, routing=params.get("routing"))
+            if params.get("refresh") in ("true", ""):
+                idx.refresh()
+            return 200, _write_response(index, result, "deleted")
+        result = idx.index_doc(id, src, routing=params.get("routing"))
+        if params.get("refresh") in ("true", ""):
+            idx.refresh()
+        return 200, _write_response(index, result, "updated")
+    raise IllegalArgumentException(
+        "update requires [doc], [script], or [upsert]")
 
 
 def _deep_merge(base, update):

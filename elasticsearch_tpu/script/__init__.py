@@ -1,0 +1,20 @@
+"""Painless-class scripting (ref: modules/lang-painless).
+
+`painless.py` — lexer + recursive-descent parser for the Java-like
+Painless surface (statements, typed/def locals, if/else, while, do-while,
+for / for-each, break/continue/return, try/catch, functions, lambdas,
+method calls with per-type allowlists).
+`interp.py` — the sandboxed tree-walking interpreter with execution
+limits and per-context environments.
+
+The score context additionally VECTORIZES loop-free expression scripts to
+columnar jnp (search/script.py) — the TPU-first replacement for Painless's
+per-document bytecode; the interpreter here is the general fallback.
+"""
+
+from elasticsearch_tpu.script.painless import parse_program  # noqa: F401
+from elasticsearch_tpu.script.interp import (  # noqa: F401
+    PainlessError,
+    PainlessScript,
+    compile_painless,
+)

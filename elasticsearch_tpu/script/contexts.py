@@ -1,0 +1,130 @@
+"""Per-context script entry points (ref: the Painless script contexts —
+org.elasticsearch.script.IngestScript / UpdateScript / ScoreScript /
+the Watcher condition context — each with its own whitelist + bindings).
+
+Each `run_*` helper binds the context's variables, executes the compiled
+Painless program under the shared execution budget, and normalizes
+errors to ScriptException. Plain dicts/lists ARE the Map/List types
+inside the interpreter, so `ctx` trees bind directly; host objects that
+are not plain data go through ContextShim adapters.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from elasticsearch_tpu.script.interp import (
+    ContextShim,
+    PainlessError,
+    compile_painless,
+)
+
+
+class IngestCtx(ContextShim):
+    """`ctx` for ingest scripts: fields resolve into the document source;
+    metadata (_index, _id, ...) reads from the ingest metadata map
+    (ref: IngestScript — ctx is the source map plus metadata)."""
+
+    def __init__(self, doc):
+        self._doc = doc
+
+    def pl_get(self, name):
+        if name.startswith("_") and name in self._doc.meta:
+            return self._doc.meta[name]
+        return self._doc.source.get(name)
+
+    def pl_set(self, name, value):
+        if name.startswith("_") and name in ("_index", "_id", "_routing"):
+            self._doc.meta[name] = value
+            return
+        self._doc.source[name] = value
+
+    def pl_contains(self, key):
+        return key in self._doc.source or key in self._doc.meta
+
+    def pl_index(self, key):
+        return self.pl_get(key)
+
+    def pl_index_set(self, key, value):
+        self.pl_set(key, value)
+
+    def pl_call(self, name, args):
+        if name == "containsKey":
+            return self.pl_contains(args[0])
+        if name == "get":
+            return self.pl_get(args[0])
+        if name == "put":
+            old = self.pl_get(args[0])
+            self.pl_set(args[0], args[1])
+            return old
+        if name == "remove":
+            return self._doc.source.pop(args[0], None)
+        if name == "keySet":
+            return list(self._doc.source.keys())
+        raise PainlessError(f"unknown method [{name}] on ctx")
+
+
+class UpdateCtx(ContextShim):
+    """`ctx` for update/update_by_query/reindex scripts (ref:
+    UpdateScript — _source map, _index/_id/_version, mutable op)."""
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+
+    def pl_get(self, name):
+        if name == "_source":
+            return self._ctx._source._data
+        if name == "op":
+            return self._ctx.op
+        if name in ("_index", "_id", "_version"):
+            return getattr(self._ctx, name)
+        raise PainlessError(f"unknown ctx field [{name}]")
+
+    def pl_set(self, name, value):
+        if name == "op":
+            self._ctx.op = value
+            return
+        raise PainlessError(f"cannot write ctx.{name}")
+
+    def pl_index(self, key):
+        return self.pl_get(key)
+
+
+def run_ingest_script(source: str, doc, params: Dict[str, Any]) -> None:
+    script = compile_painless(source)
+    script.execute({"ctx": IngestCtx(doc),
+                    "params": dict(params or {})})
+
+
+def run_ingest_condition(source: str, doc) -> bool:
+    script = compile_painless(source)
+    try:
+        return bool(script.execute({"ctx": IngestCtx(doc)}))
+    except PainlessError:
+        # a condition over a missing/odd-typed field is false, not a
+        # pipeline failure (matches the previous engine's contract)
+        return False
+
+
+def run_update_script(source: str, ctx,
+                      params: Optional[Dict[str, Any]] = None) -> None:
+    script = compile_painless(source)
+    script.execute({"ctx": UpdateCtx(ctx),
+                    "params": dict(params or {})})
+
+
+def run_watcher_script(source: str, ctx: Dict[str, Any]) -> Any:
+    """Watcher condition/transform scripts: ctx is the plain payload
+    tree (a Map inside the interpreter)."""
+    script = compile_painless(source)
+    return script.execute({"ctx": ctx})
+
+
+def try_compile(source: str) -> bool:
+    """True if `source` compiles as Painless (used by call sites that
+    keep a legacy expression engine as the fallback parse)."""
+    try:
+        compile_painless(source)
+        return True
+    except Exception:
+        return False

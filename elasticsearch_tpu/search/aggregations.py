@@ -38,7 +38,7 @@ CollectCtx = List[Tuple[Any, np.ndarray, Any]]
 METRIC_AGGS = {"avg", "sum", "min", "max", "value_count", "stats",
                "extended_stats", "cardinality", "percentiles",
                "percentile_ranks", "top_hits", "weighted_avg",
-               "geo_bounds", "geo_centroid",
+               "geo_bounds", "geo_centroid", "scripted_metric",
                # x-pack analytics + aggs-matrix-stats parity
                "boxplot", "top_metrics", "string_stats", "matrix_stats",
                "median_absolute_deviation"}
@@ -50,6 +50,89 @@ BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "filter",
 PIPELINE_AGGS = {"avg_bucket", "sum_bucket", "min_bucket", "max_bucket",
                  "stats_bucket", "cumulative_sum", "derivative",
                  "bucket_sort", "cumulative_cardinality"}
+
+
+def _scripted_metric(body: Dict[str, Any], ctx: CollectCtx):
+    """ref: metrics/ScriptedMetricAggregator — init/map per shard,
+    combine per shard, reduce across shards; scripts run the full
+    Painless engine (script/) with `state`, `states`, `params`, and a
+    per-doc `doc` binding over the segment's doc values."""
+    from elasticsearch_tpu.script.contexts import ContextShim
+    from elasticsearch_tpu.script.interp import (PainlessError,
+                                                 compile_painless)
+
+    def src(key):
+        s = body.get(key)
+        if isinstance(s, dict):
+            s = s.get("source")
+        return s
+
+    map_src = src("map_script")
+    if not map_src:
+        raise ParsingException(
+            "[scripted_metric] requires [map_script]")
+    params = dict(body.get("params", {}))
+    init_s = compile_painless(src("init_script")) \
+        if src("init_script") else None
+    map_s = compile_painless(map_src)
+    combine_s = compile_painless(src("combine_script")) \
+        if src("combine_script") else None
+    reduce_s = compile_painless(src("reduce_script")) \
+        if src("reduce_script") else None
+
+    class _DocShim(ContextShim):
+        def __init__(self, seg, d):
+            self._seg = seg
+            self._d = d
+
+        def pl_index(self, field):
+            seg, d = self._seg, self._d
+            nv = seg.numerics.get(field)
+            if nv is not None:
+                missing = bool(nv.missing[d])
+                return _Col(None if missing else float(nv.values[d]))
+            kv = seg.keywords.get(field)
+            if kv is not None:
+                ords = kv.all_ords[kv.offsets[d]: kv.offsets[d + 1]]
+                return _Col(kv.terms[ords[0]] if len(ords) else None)
+            return _Col(None)
+
+    class _Col(ContextShim):
+        def __init__(self, value):
+            self._v = value
+
+        def pl_get(self, name):
+            if name == "value":
+                if self._v is None:
+                    raise PainlessError(
+                        "A document doesn't have a value for a field")
+                return self._v
+            if name == "empty":
+                return self._v is None
+            raise PainlessError(f"unknown field [{name}]")
+
+        def pl_call(self, name, args):
+            if name == "size":
+                return 0 if self._v is None else 1
+            if name == "getValue":
+                return self.pl_get("value")
+            raise PainlessError(f"unknown method [{name}]")
+
+    states = []
+    for seg, mask, _m in ctx:
+        state: Dict[str, Any] = {}
+        base = {"state": state, "params": params}
+        if init_s is not None:
+            init_s.execute(base)
+        for d in np.nonzero(mask[: seg.n_docs])[0]:
+            map_s.execute({**base, "doc": _DocShim(seg, int(d))})
+        states.append(combine_s.execute(base)
+                      if combine_s is not None else state)
+    if reduce_s is not None:
+        value = reduce_s.execute({"states": states, "params": params})
+    else:
+        value = states
+    return {"value": value}
 
 
 def compute_aggs(spec: Dict[str, Any], ctx: CollectCtx,
@@ -230,6 +313,9 @@ def _metric(agg_type, body, ctx, mapper):
         return {"location": {"lat": float(lats.mean()),
                              "lon": float(lons.mean())},
                 "count": int(len(lats))}
+
+    if agg_type == "scripted_metric":
+        return _scripted_metric(body, ctx)
 
     if agg_type == "top_hits":
         size = int(body.get("size", 3))

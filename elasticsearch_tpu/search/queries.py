@@ -647,7 +647,8 @@ class ScriptScoreQuery(QueryBuilder):
             return _DocColumn(col, miss)
 
         sctx = ScriptContext(doc_columns, self.params, score=base_scores,
-                             vector_fns=_make_vector_fns(ctx))
+                             vector_fns=_make_vector_fns(ctx),
+                             mask=mask)
         scores = jnp.asarray(self._compiled(sctx), jnp.float32)
         scores = jnp.broadcast_to(scores, (ctx.n_docs_padded,))
         scores = jnp.where(mask, scores, 0.0)
@@ -1893,10 +1894,35 @@ def _parse_bool(spec):
     return _with_boost(q, spec)
 
 
+# stored-script resolver hook ({"id": ...} script references, ref:
+# script/ScriptService.getStoredScript) — bound by Node construction;
+# the last node constructed in-process wins, which matches the
+# single-node-per-process deployment shape
+STORED_SCRIPT_RESOLVER = None
+
+
+def resolve_script_source(script):
+    """(source, params) from an inline or stored ({"id": ...}) script."""
+    if not isinstance(script, dict):
+        return str(script), {}
+    if "id" in script and "source" not in script:
+        if STORED_SCRIPT_RESOLVER is None:
+            raise ParsingException(
+                f"unable to resolve stored script [{script['id']}]")
+        stored = STORED_SCRIPT_RESOLVER(script["id"])
+        if stored is None:
+            raise ParsingException(
+                f"unable to find script [{script['id']}]")
+        return stored["source"], script.get("params", {})
+    if "source" not in script:
+        raise ParsingException(
+            "script must specify either [source] or [id]")
+    return script["source"], script.get("params", {})
+
+
 def _parse_script_score(spec):
     script = spec["script"]
-    source = script["source"] if isinstance(script, dict) else str(script)
-    params = script.get("params", {}) if isinstance(script, dict) else {}
+    source, params = resolve_script_source(script)
     q = ScriptScoreQuery(parse_query(spec["query"]), source, params,
                          min_score=spec.get("min_score"))
     return _with_boost(q, spec)

@@ -94,11 +94,15 @@ class ScriptContext:
     def __init__(self, doc_columns: Callable[[str], _DocColumn],
                  params: Dict[str, Any],
                  score=None,
-                 vector_fns: Dict[str, Callable] = None):
+                 vector_fns: Dict[str, Callable] = None,
+                 mask=None):
         self._doc_columns = doc_columns
         self.params = _Params(params)
         self.score = score
         self.vector_fns = vector_fns or {}
+        # matched-doc mask — the statement-script path iterates THIS,
+        # not score>0 (filter-only subqueries match with score 0)
+        self.mask = mask
 
 
 class _Doc:
@@ -134,16 +138,32 @@ _cache: Dict[str, Any] = {}
 _cache_lock = threading.Lock()
 
 
+# per-doc interpretation cap: statement scripts (loops, locals) can't be
+# vectorized onto the device, so they run the sandboxed interpreter doc
+# by doc — O(n_docs) host time. Above this, demand the expression form
+# (which compiles to one fused XLA computation) instead of silently
+# burning minutes of host CPU.
+SCRIPT_INTERP_MAX_DOCS = 200_000
+
+
 def compile_script(source: str):
-    """Parse + validate; returns a callable(ctx) -> array."""
+    """Parse + validate; returns a callable(ctx) -> array.
+
+    Two tiers (the TPU-first inversion of Painless's per-doc bytecode):
+    1. expression scripts compile to COLUMNAR jnp — one fused XLA
+       computation over whole device arrays;
+    2. statement scripts (if/for/while, locals, functions — anything the
+       expression grammar rejects) compile to the full Painless
+       interpreter (script/) and evaluate per matched doc on host.
+    """
     with _cache_lock:
         code = _cache.get(source)
     if code is None:
         try:
             tree = ast.parse(source, mode="eval")
-        except SyntaxError as e:
-            raise ScriptException(f"compile error: {e}: [{source}]")
-        _validate(tree, source)
+            _validate(tree, source)
+        except (SyntaxError, ScriptException):
+            return _compile_painless_score(source)
         code = compile(tree, "<script>", "eval")
         with _cache_lock:
             _cache[source] = code
@@ -164,6 +184,119 @@ def compile_script(source: str):
             raise
         except Exception as e:
             raise ScriptException(f"runtime error: {e} in script [{source}]")
+
+    return run
+
+
+def _compile_painless_score(source: str):
+    """Statement-script score path: parse with the full Painless
+    compiler now (errors surface at query parse, like the reference's
+    compile-on-PUT), evaluate per matched doc at run time."""
+    from elasticsearch_tpu.script.interp import (ContextShim,
+                                                 PainlessError,
+                                                 compile_painless)
+    try:
+        script = compile_painless(source)
+    except PainlessError as e:
+        raise ScriptException(str(e))
+    except ScriptException:
+        raise
+    except Exception as e:
+        raise ScriptException(f"compile error: {e}: [{source}]")
+
+    class _DocShim(ContextShim):
+        def __init__(self, cols, i):
+            self._cols = cols
+            self._i = i
+
+        def pl_index(self, field):
+            vals, miss = self._cols(field)
+            v = vals[self._i]
+            # numpy scalars → plain Python numbers (the interpreter's
+            # type checks and Java semantics key on int/float)
+            return _PlCol(v.item() if hasattr(v, "item") else v,
+                          bool(miss[self._i]))
+
+        def pl_call(self, name, args):
+            if name == "containsKey":
+                try:
+                    self._cols(args[0])
+                    return True
+                except Exception:
+                    return False
+            raise PainlessError(f"unknown method [{name}] on doc")
+
+    class _PlCol(ContextShim):
+        def __init__(self, value, missing):
+            self._value = value
+            self._missing = missing
+
+        def pl_get(self, name):
+            if name == "value":
+                if self._missing:
+                    raise PainlessError(
+                        "A document doesn't have a value for a field")
+                return self._value
+            if name == "empty":
+                return self._missing
+            raise PainlessError(f"unknown field [{name}]")
+
+        def pl_call(self, name, args):
+            if name == "size":
+                return 0 if self._missing else 1
+            if name == "getValue":
+                return self.pl_get("value")
+            raise PainlessError(f"unknown method [{name}]")
+
+    def run(ctx: ScriptContext):
+        import numpy as _np
+
+        col_cache: Dict[str, tuple] = {}
+
+        def cols(field):
+            hit = col_cache.get(field)
+            if hit is None:
+                c = ctx._doc_columns(field)
+                hit = (_np.asarray(c.value), _np.asarray(c._missing))
+                col_cache[field] = hit
+            return hit
+
+        score_np = (_np.asarray(ctx.score)
+                    if ctx.score is not None else None)
+        mask_np = (_np.asarray(ctx.mask)
+                   if ctx.mask is not None else None)
+        nd = (len(score_np) if score_np is not None
+              else (len(mask_np) if mask_np is not None else None))
+        if nd is None:
+            # probe any referenced field for the doc count
+            raise ScriptException(
+                "statement scripts require a scored context")
+        if nd > SCRIPT_INTERP_MAX_DOCS:
+            raise ScriptException(
+                f"statement script over {nd} docs exceeds the "
+                f"interpreter budget ({SCRIPT_INTERP_MAX_DOCS}); "
+                f"use the expression form (vectorized) instead")
+        params = dict(ctx.params._params)
+        out = _np.zeros(nd, _np.float32)
+        # iterate the MATCHED docs: the mask when available (filter-only
+        # subqueries match with base score 0), else score > 0
+        if mask_np is not None:
+            idxs = _np.nonzero(mask_np)[0]
+        elif score_np is not None:
+            idxs = _np.nonzero(score_np > 0)[0]
+        else:
+            idxs = range(nd)
+        for i in idxs:
+            env = {"doc": _DocShim(cols, int(i)),
+                   "params": params,
+                   "_score": (float(score_np[i])
+                              if score_np is not None else 0.0)}
+            try:
+                v = script.execute(env)
+            except PainlessError as e:
+                raise ScriptException(str(e))
+            out[i] = float(v) if v is not None else 0.0
+        return jnp.asarray(out)
 
     return run
 

@@ -13,6 +13,7 @@ recorded in `.watcher-history`.
 
 from __future__ import annotations
 
+import logging
 import re
 import threading
 import time
@@ -23,6 +24,9 @@ from elasticsearch_tpu.common.errors import (
     IllegalArgumentException,
     ResourceNotFoundException,
 )
+
+
+logger = logging.getLogger("elasticsearch_tpu.watcher")
 
 
 def _interval_seconds(expr: str) -> float:
@@ -269,9 +273,23 @@ class WatcherService:
 
     @staticmethod
     def _eval_script(src: str, ctx: Dict[str, Any]) -> Any:
-        """Script conditions parse through the shared QL expression core
-        and evaluate against ctx.* paths — a closed expression language,
-        never the host interpreter (the Painless-sandbox discipline)."""
+        """Watcher script conditions run the FULL Painless engine
+        (script/ — statements, loops, per-type method allowlists; ref:
+        Watcher's ScriptCondition compiles a Painless script against the
+        WatcherConditionContext). Scripts the Painless parser rejects
+        fall back to the shared QL expression core, never the host
+        interpreter (the sandbox discipline)."""
+        from elasticsearch_tpu.script import contexts as _plctx
+
+        if _plctx.try_compile(src):
+            try:
+                # the FULL ctx tree (payload, trigger, execution_time,
+                # watch_id, metadata, ...) — a Map inside the engine
+                return bool(_plctx.run_watcher_script(src, ctx))
+            except Exception:
+                logger.debug("watcher script condition error",
+                             exc_info=True)
+                return False
         from elasticsearch_tpu.xpack import sql as _sql
 
         try:

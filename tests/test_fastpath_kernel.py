@@ -1,0 +1,164 @@
+"""ops/fastpath.py kernel invariants, pinned STRICTLY at the unit level
+(the HTTP-level equivalence test allows last-ulp summation-order swaps
+between the fast and dense paths; these tests allow none):
+
+1. bit-exact agreement with ops/bm25.bm25_sorted_topk on identical
+   inputs (same sort-based arithmetic, so no tolerance),
+2. stable tie-break — exact-score ties at the k boundary select the
+   LOWEST docids (the Lucene / exact-truth contract; TPU top_k alone
+   does not guarantee this),
+3. exact totals and mask-row isolation.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.ops.bm25 import _SENTINEL, bm25_sorted_topk
+from elasticsearch_tpu.ops.fastpath import F_SLOTS, bm25_topk_total_batch
+
+ND = 4096
+TB = 120
+B = 8
+K = 64
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(11)
+    bd = np.sort(rng.integers(0, ND, (TB, B)).astype(np.int32), axis=1)
+    bt = rng.integers(0, 4, (TB, B)).astype(np.float32)
+    lens = rng.integers(5, 60, ND).astype(np.float32)
+    live = np.ones(ND, bool)
+    return bd, bt, lens, live
+
+
+def run_batch(bd, bt, sels, wss, lens, masks, mask_ids, k=K):
+    packed = np.asarray(bm25_topk_total_batch(
+        bd, bt, np.stack(sels), np.stack(wss), lens, masks,
+        np.asarray(mask_ids, np.int32), np.float32(30.0), 1.2, 0.75, k))
+    out = []
+    for q in range(len(sels)):
+        vals = packed[q, :k]
+        ids = packed[q, k:2 * k].view(np.int32)
+        total = int(packed[q, 2 * k:].view(np.int32)[0])
+        out.append((vals, ids, total))
+    return out
+
+
+def _f64_expected(bd, bt, lens, sel, ws, k):
+    """Exact float64 reference: per-doc sums + (score desc, docid asc)
+    top-k — the truth the kernel's exactness contract is measured
+    against (bench.py cpu_exact_truth shape)."""
+    scores = np.zeros(ND, np.float64)
+    for b, w in zip(sel, ws):
+        if b >= bd.shape[0] or w == 0.0:
+            continue
+        for d, tf in zip(bd[b], bt[b]):
+            if tf > 0:
+                norm = 1.2 * (1 - 0.75 + 0.75 * float(lens[d]) / 30.0)
+                scores[d] += float(w) * tf / (tf + norm)
+    matched = np.nonzero(scores > 0)[0]
+    order = matched[np.lexsort((matched, -scores[matched]))][:k]
+    return order, scores
+
+
+def test_exact_vs_f64_reference(data):
+    """The kernel must reproduce the float64 exact top-k — same doc
+    set, same (score desc, docid asc) order, scores to f32 accuracy.
+    (Cross-kernel bit equality is NOT the invariant: lax.sort is
+    unstable on equal keys, so two compilations may sum a doc's
+    contributions in different orders.)"""
+    bd, bt, lens, live = data
+    rng = np.random.default_rng(5)
+    sels, wss = [], []
+    for _ in range(4):
+        nsel = int(rng.integers(2, 12))
+        sel = np.full(16, TB, np.int32)      # pad = zero block (TB)
+        ws = np.zeros(16, np.float32)
+        sel[:nsel] = rng.choice(TB, nsel, replace=False)
+        ws[:nsel] = rng.uniform(0.3, 2.5, nsel).astype(np.float32)
+        sels.append(sel)
+        wss.append(ws)
+    masks = jnp.stack([jnp.asarray(live)] * F_SLOTS)
+    results = run_batch(bd, bt, sels, wss, lens, masks, [0, 0, 0, 0])
+    for (vals, ids, total), sel, ws in zip(results, sels, wss):
+        expected, scores = _f64_expected(bd, bt, lens, sel, ws, K)
+        fin = np.isfinite(vals)
+        got = ids[fin]
+        # host-side tie ordering (the serving layer's lexsort)
+        got = got[np.lexsort((got, -vals[fin]))]
+        assert np.array_equal(np.sort(got), np.sort(expected))
+        assert total == int((scores > 0).sum())
+        np.testing.assert_allclose(
+            np.sort(vals[fin])[::-1], np.sort(scores[expected])[::-1],
+            rtol=2e-6)
+        # the reference single-query kernel agrees on the same contract
+        rv, ri = bm25_sorted_topk(bd, bt, sel, ws, lens,
+                                  jnp.asarray(live), np.float32(30.0),
+                                  1.2, 0.75, K)
+        rfin = np.isfinite(np.asarray(rv))
+        assert np.array_equal(np.sort(np.asarray(ri)[rfin]),
+                              np.sort(expected))
+
+
+def test_stable_tiebreak_lowest_docids_win():
+    """Many docs tie bit-exactly at the kth score: the winners must be
+    the lowest docids (truth/Lucene order), not top_k's whim."""
+    nd = 2048
+    # one term, one tf, one length → every matched doc scores the SAME
+    docs = np.arange(0, 2000, dtype=np.int32)
+    tb = len(docs) // B
+    bd = docs.reshape(tb, B)
+    bt = np.ones((tb, B), np.float32)
+    bd = np.concatenate([bd, np.zeros((1, B), np.int32)])     # zero block
+    bt = np.concatenate([bt, np.zeros((1, B), np.float32)])
+    lens = np.full(nd, 30.0, np.float32)
+    k = 100
+    sel = np.full(256, tb, np.int32)
+    ws = np.zeros(256, np.float32)
+    sel[:tb] = np.arange(tb)
+    ws[:tb] = 1.0
+    masks = jnp.stack([jnp.ones(nd, bool)] * F_SLOTS)
+    (vals, ids, total), = run_batch(bd, bt, [sel], [ws], lens, masks,
+                                    [0], k=k)
+    assert total == 2000
+    assert np.array_equal(np.sort(ids), np.arange(k, dtype=np.int32))
+    assert np.allclose(vals, vals[0])
+
+
+def test_mask_rows_isolate_queries(data):
+    bd, bt, lens, live = data
+    sel = np.full(16, TB, np.int32)
+    ws = np.zeros(16, np.float32)
+    sel[:4] = [3, 9, 20, 31]
+    ws[:4] = 1.0
+    # row 1 masks out the low half of the doc space
+    m1 = live.copy()
+    m1[: ND // 2] = False
+    masks = jnp.stack([jnp.asarray(live), jnp.asarray(m1)]
+                      + [jnp.asarray(live)] * (F_SLOTS - 2))
+    (v0, i0, t0), (v1, i1, t1) = run_batch(
+        bd, bt, [sel, sel], [ws, ws], lens, masks, [0, 1])
+    assert t1 < t0
+    assert (i1[np.isfinite(v1)] >= ND // 2).all()
+    # the unfiltered row is unaffected by its neighbor's mask
+    rv, ri = bm25_sorted_topk(bd, bt, sel, ws, lens, jnp.asarray(live),
+                              np.float32(30.0), 1.2, 0.75, K)
+    fin = np.isfinite(np.asarray(rv))
+    assert np.array_equal(i0[fin], np.asarray(ri)[fin])
+
+
+def test_empty_and_overfull():
+    nd = 512
+    bd = np.zeros((2, B), np.int32)
+    bt = np.zeros((2, B), np.float32)
+    lens = np.full(nd, 10.0, np.float32)
+    masks = jnp.stack([jnp.ones(nd, bool)] * F_SLOTS)
+    sel = np.full(8, 1, np.int32)     # zero block only
+    ws = np.zeros(8, np.float32)
+    (vals, ids, total), = run_batch(bd, bt, [sel], [ws], lens, masks,
+                                    [0], k=16)
+    assert total == 0
+    assert not np.isfinite(vals).any()
+    assert (ids == _SENTINEL).all()

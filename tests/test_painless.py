@@ -1,0 +1,281 @@
+"""Painless-class scripting (script/painless.py, script/interp.py,
+script/contexts.py) — the VERDICT r2 item 4 contract: statements,
+if/for/while, typed locals, functions, per-context method allowlists,
+and a loop-containing script running in ALL FOUR contexts (score,
+ingest, update, watcher) plus scripted_metric aggs.
+
+Ref: modules/lang-painless/.../Compiler.java:55 and the
+PainlessScriptEngine context whitelists."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.errors import ScriptException
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.script import PainlessError, compile_painless
+
+
+def run(src, **env):
+    return compile_painless(src).execute(env)
+
+
+# ----------------------------------------------------------------- language
+
+def test_statements_loops_and_locals():
+    assert run("""
+        int total = 0;
+        for (int i = 1; i <= 10; i++) { total += i; }
+        int j = 0;
+        while (j < 3) { total += 100; j++; }
+        do { total += 1000; } while (false);
+        return total;
+    """) == 55 + 300 + 1000
+
+
+def test_functions_and_recursion():
+    assert run("""
+        int fib(int n) { if (n < 2) return n; return fib(n-1)+fib(n-2); }
+        return fib(12);
+    """) == 144
+
+
+def test_foreach_list_map_string():
+    assert run("""
+        def out = [];
+        for (def w : params.words) {
+            if (w.length() > 3) { out.add(w.toUpperCase()); }
+        }
+        Map counts = [:];
+        for (def w : out) { counts[w] = w.length(); }
+        return counts;
+    """, params={"words": ["a", "hello", "worlds", "xy"]}) == {
+        "HELLO": 5, "WORLDS": 6}
+
+
+def test_java_arithmetic_semantics():
+    assert run("return -7 / 2;") == -3          # truncation toward zero
+    assert run("return -7 % 3;") == -1          # dividend sign
+    assert run("return 7 / 2;") == 3
+    assert run("return 7.0 / 2;") == 3.5
+    assert run("return 1 + 'x' + null + true;") == "1xnulltrue"
+
+
+def test_ternary_elvis_nullsafe():
+    assert run("return params.a?.b ?: 42;", params={"a": None}) == 42
+    assert run("return params.a?.b ?: 42;",
+               params={"a": {"b": 7}}) == 7
+    assert run("return params.x > 3 ? 'big' : 'small';",
+               params={"x": 5}) == "big"
+
+
+def test_methods_allowlist_and_sandbox():
+    assert run("return 'Quick Fox'.toLowerCase().contains('fox');")
+    assert run("def l = [3,1,2]; l.sort((a,b) -> a - b); return l;") \
+        == [1, 2, 3]
+    assert run("def m = ['a': 1]; m.merge('a', 5, (x, y) -> x + y); "
+               "return m.a;") == 6
+    # there is NO route to python internals
+    with pytest.raises(ScriptException):
+        run("return ''.__class__;")
+    # dunder member access is rejected at COMPILE time
+    with pytest.raises(ScriptException):
+        run("return params.__globals__;", params={})
+    with pytest.raises(ScriptException):
+        run("return 'x'.encode();")   # not on the allowlist
+    with pytest.raises(ScriptException):
+        run("def f = Math.log; return f.__self__;")
+
+
+def test_runaway_loop_guard():
+    with pytest.raises(ScriptException, match="exceeded"):
+        run("while (true) { int x = 1; }")
+
+
+def test_try_catch_throw():
+    assert run("""
+        try { throw new IllegalArgumentException('boom'); }
+        catch (Exception e) { return 'caught:' + e.getMessage(); }
+    """) == "caught:boom"
+
+
+def test_casts_and_instanceof():
+    assert run("return (int) 3.9;") == 3
+    assert run("double d = 3; return d / 2;") == 1.5 \
+        or run("return ((double) 3) / 2;") == 1.5
+    assert run("return params.v instanceof String;",
+               params={"v": "s"}) is True
+    assert run("return params.v instanceof List;",
+               params={"v": [1]}) is True
+
+
+def test_stringbuilder_and_statics():
+    assert run("""
+        StringBuilder sb = new StringBuilder();
+        for (int i = 0; i < 3; i++) { sb.append(i).append(','); }
+        return sb.toString();
+    """) == "0,1,2,"
+    assert run("return Math.max(Math.abs(-5), 3) + Integer.parseInt('10');") == 15
+    assert run("return String.join('-', ['a','b','c']);") == "a-b-c"
+
+
+# ------------------------------------------------------------ the 4 contexts
+
+LOOP_SCRIPT_SUM = """
+    def total = 0;
+    for (int i = 0; i < params.vals.size(); i++) { total += params.vals[i]; }
+"""
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(data_path=str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def call(node, method, path, body=None, expect=200, **params):
+    status, r = node.rest_controller.dispatch(method, path, params, body)
+    assert status == expect, (status, r)
+    return r
+
+
+def test_ingest_context_loop_script(node):
+    """A loop-containing script in the INGEST context."""
+    call(node, "PUT", "/_ingest/pipeline/words", {
+        "processors": [{"script": {"source": """
+            def n = 0;
+            def parts = ctx.text.split(' ');
+            for (def p : parts) { if (p.length() > 2) n++; }
+            ctx.long_words = n;
+            ctx.tag = ctx.containsKey('tag') ? ctx.tag + '!' : 'fresh';
+        """}}]})
+    call(node, "PUT", "/idx/_doc/1",
+         {"text": "an ox jumped over the red fence"},
+         expect=201, pipeline="words")
+    doc = call(node, "GET", "/idx/_doc/1")
+    assert doc["_source"]["long_words"] == 5
+    assert doc["_source"]["tag"] == "fresh"
+
+
+def test_update_context_loop_script(node):
+    """A loop-containing script via _update and _update_by_query."""
+    call(node, "PUT", "/idx/_doc/1", {"tags": ["a", "b"], "n": 1},
+         expect=201)
+    call(node, "POST", "/idx/_update/1", {"script": {"source": """
+        def out = [];
+        for (def t : ctx._source.tags) { out.add(t.toUpperCase()); }
+        ctx._source.tags = out;
+        ctx._source.n += 10;
+    """}})
+    doc = call(node, "GET", "/idx/_doc/1")
+    assert doc["_source"]["tags"] == ["A", "B"]
+    assert doc["_source"]["n"] == 11
+    call(node, "POST", "/idx/_refresh")
+    call(node, "POST", "/idx/_update_by_query", {
+        "script": {"source": """
+            int bonus = 0;
+            for (int i = 0; i < 5; i++) { bonus += i; }
+            ctx._source.n += bonus;
+        """}})
+    call(node, "POST", "/idx/_refresh")
+    doc = call(node, "GET", "/idx/_doc/1")
+    assert doc["_source"]["n"] == 21
+
+
+def test_score_context_loop_script(node):
+    """A loop-containing script in the SCORE context (script_score) —
+    interpreted per matched doc (the vectorized path handles
+    expression scripts)."""
+    for i, rank in enumerate([3, 1, 2]):
+        call(node, "PUT", f"/idx/_doc/{i}",
+             {"title": "fox", "rank": rank}, expect=201)
+    call(node, "POST", "/idx/_refresh")
+    r = call(node, "POST", "/idx/_search", {
+        "query": {"script_score": {
+            "query": {"match": {"title": "fox"}},
+            "script": {"source": """
+                double s = 0;
+                for (int i = 0; i < 3; i++) { s += doc['rank'].value; }
+                return s;
+            """}}},
+        "size": 3})
+    hits = r["hits"]["hits"]
+    assert [h["_id"] for h in hits] == ["0", "2", "1"]
+    assert hits[0]["_score"] == pytest.approx(9.0)
+
+
+def test_score_context_expression_still_vectorized(node):
+    from elasticsearch_tpu.search import script as script_mod
+    call(node, "PUT", "/idx/_doc/1", {"title": "fox", "rank": 4},
+         expect=201)
+    call(node, "POST", "/idx/_refresh")
+    r = call(node, "POST", "/idx/_search", {
+        "query": {"script_score": {
+            "query": {"match": {"title": "fox"}},
+            "script": {"source": "doc['rank'].value * 2 + _score"}}}})
+    assert r["hits"]["hits"][0]["_score"] > 8.0
+    assert script_mod is not None
+
+
+def test_watcher_context_loop_script(node):
+    """A loop-containing script as a WATCHER condition."""
+    call(node, "PUT", "/idx/_doc/1", {"level": 9}, expect=201)
+    call(node, "POST", "/idx/_refresh")
+    call(node, "PUT", "/_watcher/watch/w1", {
+        "trigger": {"schedule": {"interval": "1h"}},
+        "input": {"search": {"request": {
+            "indices": ["idx"],
+            "body": {"query": {"match_all": {}}}}}},
+        "condition": {"script": {"source": """
+            int big = 0;
+            for (def h : ctx.payload.hits.hits) {
+                if (h._source.level > 5) { big++; }
+            }
+            return big > 0;
+        """}},
+        "actions": {"log": {"logging": {"text": "hit"}}}},
+         expect=201)
+    r = call(node, "POST", "/_watcher/watch/w1/_execute")
+    assert r["watch_record"]["result"]["condition"]["met"] is True
+
+
+def test_scripted_metric_agg(node):
+    """init/map/combine/reduce — the scripted_metric aggregation."""
+    for i, (cat, v) in enumerate([("a", 1), ("a", 2), ("b", 10)]):
+        call(node, "PUT", f"/idx/_doc/{i}", {"cat": cat, "v": v},
+             expect=201)
+    call(node, "POST", "/idx/_refresh")
+    r = call(node, "POST", "/idx/_search", {
+        "size": 0,
+        "query": {"match_all": {}},
+        "aggs": {"profit": {"scripted_metric": {
+            "init_script": "state.vals = [];",
+            "map_script": "state.vals.add(doc['v'].value);",
+            "combine_script": """
+                double total = 0;
+                for (def t : state.vals) { total += t; }
+                return total;
+            """,
+            "reduce_script": """
+                double grand = 0;
+                for (def s : states) { grand += s; }
+                return grand;
+            """}}}})
+    assert r["aggregations"]["profit"]["value"] == pytest.approx(13.0)
+
+
+def test_stored_script_with_statements(node):
+    call(node, "PUT", "/_scripts/boost-loop", {"script": {
+        "lang": "painless",
+        "source": "double s = 0; for (int i = 0; i < 2; i++) "
+                  "{ s += doc['rank'].value; } return s;"}})
+    call(node, "PUT", "/idx/_doc/1", {"title": "fox", "rank": 5},
+         expect=201)
+    call(node, "POST", "/idx/_refresh")
+    r = call(node, "POST", "/idx/_search", {
+        "query": {"script_score": {
+            "query": {"match": {"title": "fox"}},
+            "script": {"id": "boost-loop"}}}})
+    assert r["hits"]["hits"][0]["_score"] == pytest.approx(10.0)
