@@ -1,0 +1,243 @@
+"""Minimal LDAPv3 client (RFC 4511 subset) for the LDAP/AD realm.
+
+The reference's LdapRealm talks to directory servers through UnboundID
+(ref: x-pack/plugin/security/.../authc/ldap/LdapRealm.java:54,
+LdapUserSearchSessionFactory / LdapSessionFactory); this is the
+wire-protocol core re-implemented directly: BER TLV encoding and the
+three operations a realm needs — simple bind, search (equality /
+present filters, subtree scope), unbind. No external dependency; the
+same codec drives the in-process test fixture server, so the client is
+exercised against real BER bytes end to end.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------- BER TLV
+
+SEQUENCE = 0x30
+SET = 0x31
+INTEGER = 0x02
+OCTET_STRING = 0x04
+ENUMERATED = 0x0A
+BOOLEAN = 0x01
+
+APP_BIND_REQUEST = 0x60
+APP_BIND_RESPONSE = 0x61
+APP_UNBIND_REQUEST = 0x42
+APP_SEARCH_REQUEST = 0x63
+APP_SEARCH_ENTRY = 0x64
+APP_SEARCH_DONE = 0x65
+
+CTX_SIMPLE_AUTH = 0x80
+FILTER_AND = 0xA0
+FILTER_OR = 0xA1
+FILTER_EQUALITY = 0xA3
+FILTER_PRESENT = 0x87
+
+
+def ber_len(n: int) -> bytes:
+    if n < 0x80:
+        return bytes([n])
+    out = b""
+    while n:
+        out = bytes([n & 0xFF]) + out
+        n >>= 8
+    return bytes([0x80 | len(out)]) + out
+
+
+def tlv(tag: int, payload: bytes) -> bytes:
+    return bytes([tag]) + ber_len(len(payload)) + payload
+
+
+def ber_int(v: int, tag: int = INTEGER) -> bytes:
+    out = b""
+    if v == 0:
+        out = b"\x00"
+    else:
+        while v:
+            out = bytes([v & 0xFF]) + out
+            v >>= 8
+        if out[0] & 0x80:
+            out = b"\x00" + out
+    return tlv(tag, out)
+
+
+def ber_str(s: str, tag: int = OCTET_STRING) -> bytes:
+    return tlv(tag, s.encode("utf-8"))
+
+
+def ber_bool(b: bool) -> bytes:
+    return tlv(BOOLEAN, b"\xff" if b else b"\x00")
+
+
+def read_tlv(data: bytes, off: int) -> Tuple[int, bytes, int]:
+    """(tag, payload, next_offset)."""
+    tag = data[off]
+    ln = data[off + 1]
+    off += 2
+    if ln & 0x80:
+        nb = ln & 0x7F
+        ln = int.from_bytes(data[off:off + nb], "big")
+        off += nb
+    return tag, data[off:off + ln], off + ln
+
+
+def parse_int(payload: bytes) -> int:
+    return int.from_bytes(payload, "big", signed=True)
+
+
+# ----------------------------------------------------------- LDAP client
+
+class LdapError(Exception):
+    pass
+
+
+class LdapClient:
+    """One connection; the realm opens one per authentication attempt
+    (the session-per-auth model of LdapSessionFactory)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 5.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout)
+        self._msgid = 0
+        self._buf = b""
+
+    def close(self):
+        try:
+            self._msgid += 1
+            self._sock.sendall(tlv(SEQUENCE,
+                                   ber_int(self._msgid)
+                                   + tlv(APP_UNBIND_REQUEST, b"")))
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _send(self, op: bytes) -> int:
+        self._msgid += 1
+        self._sock.sendall(tlv(SEQUENCE, ber_int(self._msgid) + op))
+        return self._msgid
+
+    def _read_message(self) -> Tuple[int, int, bytes]:
+        """(msgid, op_tag, op_payload)."""
+        while True:
+            # need the full outer TLV
+            if len(self._buf) >= 2:
+                try:
+                    tag, payload, end = read_tlv(self._buf, 0)
+                    if end <= len(self._buf):
+                        self._buf = self._buf[end:]
+                        _, mid_pl, off = read_tlv(payload, 0)
+                        op_tag, op_pl, _ = read_tlv(payload, off)
+                        return parse_int(mid_pl), op_tag, op_pl
+                except IndexError:
+                    pass
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise LdapError("connection closed by LDAP server")
+            self._buf += chunk
+
+    # ------------------------------------------------------------- bind
+    def simple_bind(self, dn: str, password: str) -> bool:
+        """LDAP simple bind; True on resultCode success(0). An EMPTY
+        password is refused client-side — RFC 4513 treats it as an
+        unauthenticated bind that SUCCEEDS on many servers, a classic
+        login bypass (the reference refuses it the same way)."""
+        if not password:
+            raise LdapError("empty password (unauthenticated bind "
+                            "refused)")
+        op = tlv(APP_BIND_REQUEST,
+                 ber_int(3)                       # LDAP v3
+                 + ber_str(dn)
+                 + tlv(CTX_SIMPLE_AUTH, password.encode("utf-8")))
+        self._send(op)
+        _, op_tag, op_pl = self._read_message()
+        if op_tag != APP_BIND_RESPONSE:
+            raise LdapError(f"unexpected response tag {op_tag:#x}")
+        _, code_pl, _ = read_tlv(op_pl, 0)
+        return parse_int(code_pl) == 0
+
+    # ----------------------------------------------------------- search
+    def search(self, base_dn: str, flt, attrs: Optional[List[str]] = None,
+               scope: int = 2) -> List[Tuple[str, Dict[str, List[str]]]]:
+        """``flt``: ("=", attr, value) equality or ("present", attr) or
+        ("&", [flt, ...]). Returns [(dn, {attr: [values]})]."""
+        op = tlv(APP_SEARCH_REQUEST,
+                 ber_str(base_dn)
+                 + ber_int(scope, ENUMERATED)     # wholeSubtree
+                 + ber_int(3, ENUMERATED)         # derefAlways
+                 + ber_int(0) + ber_int(0)        # no size/time limit
+                 + ber_bool(False)                # typesOnly
+                 + self._encode_filter(flt)
+                 + tlv(SEQUENCE, b"".join(ber_str(a)
+                                          for a in (attrs or []))))
+        self._send(op)
+        entries = []
+        while True:
+            _, op_tag, op_pl = self._read_message()
+            if op_tag == APP_SEARCH_DONE:
+                _, code_pl, _ = read_tlv(op_pl, 0)
+                if parse_int(code_pl) != 0:
+                    raise LdapError(
+                        f"search failed, resultCode="
+                        f"{parse_int(code_pl)}")
+                return entries
+            if op_tag != APP_SEARCH_ENTRY:
+                raise LdapError(f"unexpected response tag {op_tag:#x}")
+            off = 0
+            _, dn_pl, off = read_tlv(op_pl, off)
+            _, attrs_pl, _ = read_tlv(op_pl, off)
+            attrs_out: Dict[str, List[str]] = {}
+            aoff = 0
+            while aoff < len(attrs_pl):
+                _, one, aoff = read_tlv(attrs_pl, aoff)
+                ooff = 0
+                _, name_pl, ooff = read_tlv(one, ooff)
+                _, vals_pl, _ = read_tlv(one, ooff)
+                vals = []
+                voff = 0
+                while voff < len(vals_pl):
+                    _, v_pl, voff = read_tlv(vals_pl, voff)
+                    vals.append(v_pl.decode("utf-8", "replace"))
+                attrs_out[name_pl.decode("utf-8", "replace")] = vals
+            entries.append((dn_pl.decode("utf-8", "replace"), attrs_out))
+
+    @staticmethod
+    def _encode_filter(flt) -> bytes:
+        kind = flt[0]
+        if kind == "=":
+            return tlv(FILTER_EQUALITY,
+                       ber_str(flt[1]) + ber_str(flt[2]))
+        if kind == "present":
+            return tlv(FILTER_PRESENT, flt[1].encode("utf-8"))
+        if kind == "&":
+            return tlv(FILTER_AND,
+                       b"".join(LdapClient._encode_filter(f)
+                                for f in flt[1]))
+        if kind == "|":
+            return tlv(FILTER_OR,
+                       b"".join(LdapClient._encode_filter(f)
+                                for f in flt[1]))
+        raise LdapError(f"unsupported filter {flt!r}")
+
+
+def parse_ldap_url(url: str) -> Tuple[str, int]:
+    """ldap://host:port → (host, port). ldaps:// is rejected here —
+    TLS-wrapped directories terminate through a local stunnel in this
+    build (disclosed limitation)."""
+    if not url.startswith("ldap://"):
+        raise LdapError(f"unsupported LDAP url [{url}]")
+    rest = url[len("ldap://"):].rstrip("/")
+    host, _, port = rest.partition(":")
+    return host, int(port or 389)

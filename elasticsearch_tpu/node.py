@@ -147,7 +147,15 @@ class Node:
             jwt_issuer=settings.get(
                 "xpack.security.authc.jwt.allowed_issuer"),
             jwt_audience=settings.get(
-                "xpack.security.authc.jwt.allowed_audiences"))
+                "xpack.security.authc.jwt.allowed_audiences"),
+            ldap_config={
+                k: settings.get(f"xpack.security.authc.ldap.{k}")
+                for k in ("url", "user_dn_templates", "bind_dn",
+                          "bind_password", "user_search_base",
+                          "user_search_attribute", "group_search_base",
+                          "timeout")
+                if settings.get(
+                    f"xpack.security.authc.ldap.{k}") is not None})
         from elasticsearch_tpu.xpack.sql import SqlService
         self.sql_service = SqlService(self)
         from elasticsearch_tpu.xpack.eql import EqlService

@@ -41,11 +41,13 @@ Q_BATCH = 32      # cohort width (one compiled Q shape)
 
 class FastPathServer:
     def __init__(self, node, front, nb_buckets=(1024, 4096),
-                 n_streams: int = 4, max_k: int = 1000):
+                 n_streams: int = 4, max_k: int = 1000,
+                 ess_buckets=(256, 1024)):
         self.node = node
         self.front = front           # NativeHttpFront (owns the lib)
         self.lib = front.lib
         self.nb_buckets = tuple(sorted(nb_buckets))
+        self.ess_buckets = tuple(sorted(ess_buckets))
         self.n_streams = n_streams
         self.max_k = max_k
         self._running = False
@@ -54,6 +56,10 @@ class FastPathServer:
         self._sem = threading.Semaphore(n_streams)
         # registered state
         self._lock = threading.Lock()
+        # serializes whole registration passes (drain tick vs direct
+        # calls) — without it two passes double-bump the generation and
+        # in-flight requests parsed under the first bounce spuriously
+        self._refresh_lock = threading.Lock()
         self._reg: Optional[dict] = None   # {index, field, epoch, dp, ...}
         self._gen = 0
         self._warm = False
@@ -126,6 +132,10 @@ class FastPathServer:
         periodically from the drain loop — registration is C++-visible
         only AFTER the kernel shapes are warm, so a cold node never
         stalls a request on a 30s XLA compile."""
+        with self._refresh_lock:
+            self._refresh_registration_locked()
+
+    def _refresh_registration_locked(self):
         pick = self._eligible()
         if pick is None:
             with self._lock:
@@ -160,6 +170,34 @@ class FastPathServer:
             np.float32)
         reg["nb"] = dp.term_block_count.astype(np.int64)
         reg["starts"] = dp.term_block_start.astype(np.int64)
+        # --- θ-cached exact-MaxScore state (ops/fastpath.py essential
+        # lane): per-term MAX possible contribution (the MaxScore upper
+        # bound, from the block-max metadata), flat posting ranges for
+        # the patch phase's binary search, and the θ/total cache —
+        # valid for this registration's immutable segment
+        from elasticsearch_tpu.index.segment import BLOCK_SIZE
+        k1, b = reg["k1"], reg["b"]
+        mtf = pf.block_max_tf.astype(np.float64)
+        mln = pf.block_min_len.astype(np.float64)
+        avg = float(dp.avg_len)
+        s_blk = np.where(
+            mtf > 0, mtf / (mtf + k1 * (1 - b + b * mln / avg)), 0.0)
+        starts32 = reg["starts"]
+        nbv = reg["nb"]
+        maxc = np.zeros(len(pf.terms), np.float64)
+        nz = nbv > 0
+        if nz.any():
+            red = np.maximum.reduceat(
+                np.concatenate([s_blk, [0.0]]),
+                np.minimum(starts32, len(s_blk)))
+            maxc[nz] = red[nz]
+        reg["maxc"] = (maxc * reg["idf"].astype(np.float64)).astype(
+            np.float32)
+        reg["post_start"] = (starts32 * BLOCK_SIZE).astype(np.int32)
+        reg["post_len"] = dp.doc_freq.astype(np.int32)
+        reg["flat_docids"] = dp.block_docids.reshape(-1)
+        reg["flat_tfs"] = dp.block_tfs.reshape(-1)
+        reg["theta"] = {}    # (tids, filt, k) -> (θ, exact_total)
         self._warm_shapes(reg)
         # only now does C++ start routing /{index}/_search to the queue
         terms_blob = b"".join(t.encode("utf-8") for t in pf.terms)
@@ -213,6 +251,25 @@ class FastPathServer:
                 masks, mask_ids, np.float32(dp.avg_len), reg["k1"],
                 reg["b"], self.max_k).block_until_ready()
             logger.info("fastpath warm NB=%d in %.1fs", nb,
+                        time.time() - t0)
+        from elasticsearch_tpu.ops.fastpath import (
+            NE_SLOTS, bm25_essential_topk_batch)
+        for nb in self.ess_buckets:
+            if not self._running:
+                return
+            sel = np.full((Q_BATCH, nb), dp.zero_block, np.int32)
+            ws = np.zeros((Q_BATCH, nb), np.float32)
+            t0 = time.time()
+            bm25_essential_topk_batch(
+                dp.block_docids, dp.block_tfs, reg["flat_docids"],
+                reg["flat_tfs"], sel, ws, dp.doc_lens, masks, mask_ids,
+                np.zeros((Q_BATCH, NE_SLOTS), np.int32),
+                np.zeros((Q_BATCH, NE_SLOTS), np.int32),
+                np.zeros((Q_BATCH, NE_SLOTS), np.float32),
+                np.zeros(Q_BATCH, np.float32),
+                np.float32(dp.avg_len), reg["k1"], reg["b"],
+                self.max_k).block_until_ready()
+            logger.info("fastpath warm essential NB=%d in %.1fs", nb,
                         time.time() - t0)
 
     # --------------------------------------------------------------- drain
@@ -274,8 +331,11 @@ class FastPathServer:
                 self.lib.es_fast_bounce(h, tok)
             return
         # group by NB bucket only — filter sets ride per-query mask
-        # rows inside one launch (ops/fastpath.py F_SLOTS)
+        # rows inside one launch (ops/fastpath.py F_SLOTS). Queries with
+        # a cached θ route to the essential lane: a MUCH smaller sort
+        # plus per-candidate patching (exact MaxScore).
         by_bucket: Dict[int, list] = {}
+        ess_by_bucket: Dict[int, list] = {}
         for tok, gen, k, term_ids, filt in reqs:
             if gen != reg["gen"]:
                 # parsed under an older term dictionary (segment changed
@@ -300,8 +360,18 @@ class FastPathServer:
                     self.stats["bounced"] += 1
                     self.lib.es_fast_bounce(h, tok)
                 continue
+            ess = self._essential_split(reg, k, term_ids, filt)
+            if ess is not None:
+                ess_by_bucket.setdefault(ess[0], []).append(
+                    (tok, k, term_ids, filt, ess))
+                continue
             by_bucket.setdefault(bucket, []).append(
                 (tok, k, term_ids, filt))
+        for bucket, items in ess_by_bucket.items():
+            for chunk in self._chunk_by_slots(items):
+                self._sem.acquire()
+                self._pool.submit(self._launch_essential, reg, bucket,
+                                  chunk, t_arrive)
         # adaptive merge-up: a nearly-empty bucket group pays the full
         # per-launch tunnel floor for a handful of queries — fold small
         # groups into the next bigger bucket (padding costs device time
@@ -319,29 +389,13 @@ class FastPathServer:
         # the max bucket can never carry (the carry condition requires a
         # bigger bucket to exist), so nothing is pending here
         assert not carry
-        from elasticsearch_tpu.ops.fastpath import F_SLOTS
         for bucket, items in merged.items():
-            # chunk to the cohort width AND the mask-slot budget
-            chunk: list = []
-            filts_in_chunk: set = set()
-            def flush():
-                if chunk:
-                    self._sem.acquire()   # backpressure: wait for a
-                    # free stream — requests keep queueing in C++
-                    # meanwhile and drain in wider cohorts
-                    self._pool.submit(self._launch_group, reg, bucket,
-                                      list(chunk), t_arrive)
-                    chunk.clear()
-                    filts_in_chunk.clear()
-            for item in items:
-                filt = item[3]
-                new_filts = filts_in_chunk | ({filt} if filt else set())
-                if len(chunk) >= Q_BATCH or len(new_filts) > F_SLOTS - 1:
-                    flush()
-                    new_filts = {filt} if filt else set()
-                chunk.append(item)
-                filts_in_chunk.update(new_filts)
-            flush()
+            for chunk in self._chunk_by_slots(items):
+                # backpressure: wait for a free stream — requests keep
+                # queueing in C++ meanwhile and drain in wider cohorts
+                self._sem.acquire()
+                self._pool.submit(self._launch_group, reg, bucket,
+                                  chunk, t_arrive)
 
     def _respond_empty(self, tok, reg):
         empty = np.zeros(0, np.int32)
@@ -368,6 +422,211 @@ class FastPathServer:
                     pass
         finally:
             self._sem.release()
+
+    # binary-search depth contract of the patch kernel (ops/fastpath)
+    NE_MAX_LEN = 1 << 21
+
+    @staticmethod
+    def _chunk_by_slots(items):
+        """Split a launch class into cohorts bounded by the cohort
+        width (Q_BATCH) AND the mask-slot budget (≤ F_SLOTS-1 distinct
+        filter sets per launch; row 0 is the plain live mask). Item
+        layout: (tok, k, term_ids, filt, ...)."""
+        from elasticsearch_tpu.ops.fastpath import F_SLOTS
+        chunk: list = []
+        filts: set = set()
+        for item in items:
+            f = item[3]
+            nf = filts | ({f} if f else set())
+            if chunk and (len(chunk) >= Q_BATCH
+                          or len(nf) > F_SLOTS - 1):
+                yield chunk
+                chunk = []
+                filts = set()
+                nf = {f} if f else set()
+            chunk.append(item)
+            filts = nf
+        if chunk:
+            yield chunk
+
+    def _essential_split(self, reg, k, term_ids, filt):
+        """(ess_bucket, ess_terms, ne_terms, ne_bound, θ, total) when a
+        cached θ licenses the essential lane for this exact query, else
+        None. Term INSTANCES partition (duplicates keep their own
+        slot — a doubled term doubles both its contribution and its
+        bound)."""
+        from elasticsearch_tpu.ops.fastpath import NE_SLOTS
+        if k != self.max_k:
+            return None
+        key = (tuple(term_ids), filt, k)
+        hit = reg["theta"].get(key)
+        if hit is None:
+            return None
+        theta, total = hit
+        known = [t for t in term_ids if t >= 0]
+        if len(known) < 2:
+            return None
+        maxc = reg["maxc"]
+        inst = sorted(known, key=lambda t: float(maxc[t]))
+        # strict safety margin: docs outside every essential list score
+        # ≤ Σ maxc_ne < θ = the true kth
+        theta_safe = float(theta) * (1.0 - 1e-6)
+        ne: list = []
+        bound = 0.0
+        ess: list = []
+        for t in inst:
+            mc = float(maxc[t])
+            if (len(ne) < NE_SLOTS and len(inst) - len(ne) > 1
+                    and bound + mc < theta_safe
+                    and int(reg["post_len"][t]) <= self.NE_MAX_LEN):
+                ne.append(t)
+                bound += mc
+            else:
+                ess.append(t)
+        if not ne:
+            return None
+        nb_ess = int(reg["nb"][ess].sum())
+        for bkt in self.ess_buckets:
+            if nb_ess <= bkt:
+                return (bkt, ess, ne, bound, float(theta), int(total))
+        return None
+
+    def _launch_essential(self, reg, bucket, items, t_arrive):
+        responded: set = set()
+        try:
+            self._launch_essential_inner(reg, bucket, items, t_arrive,
+                                         responded)
+        except Exception:
+            logger.exception("essential launch failed; full-kernel "
+                             "retry")
+            # only tokens not yet answered — a mid-loop failure must
+            # never double-respond/bounce consumed tokens
+            left = [it for it in items if it[0] not in responded]
+            try:
+                if left:
+                    self._refire_full(reg, left, t_arrive)
+            except Exception:
+                h = self.front.h
+                for tok, *_ in left:
+                    try:
+                        if h is not None:
+                            self.lib.es_fast_bounce(h, tok)
+                    except Exception:
+                        pass
+        finally:
+            self._sem.release()
+
+    def _refire_full(self, reg, items, t_arrive):
+        """Uncertified/failed essential queries re-run on the exact full
+        kernel (already holding a stream permit — run inline)."""
+        full_items = [(tok, k, term_ids, filt)
+                      for tok, k, term_ids, filt, _ess in items]
+        nb_need = max(int(reg["nb"][[t for t in tids if t >= 0]].sum())
+                      for _tok, _k, tids, _f in full_items)
+        bucket = self.nb_buckets[-1]
+        for nb in self.nb_buckets:
+            if nb_need <= nb:
+                bucket = nb
+                break
+        self.stats["ess_refires"] = self.stats.get("ess_refires", 0) \
+            + len(full_items)
+        self._launch_group_inner(reg, bucket, full_items, t_arrive)
+
+    def _launch_essential_inner(self, reg, bucket, items, t_arrive,
+                                responded=None):
+        import jax.numpy as jnp
+
+        from elasticsearch_tpu.ops.fastpath import (
+            F_SLOTS, NE_SLOTS, bm25_essential_topk_batch)
+        dp, dev = reg["dp"], reg["dev"]
+        sel = np.full((Q_BATCH, bucket), dp.zero_block, np.int32)
+        ws = np.zeros((Q_BATCH, bucket), np.float32)
+        mask_ids = np.zeros(Q_BATCH, np.int32)
+        ne_start = np.zeros((Q_BATCH, NE_SLOTS), np.int32)
+        ne_len = np.zeros((Q_BATCH, NE_SLOTS), np.int32)
+        ne_idf = np.zeros((Q_BATCH, NE_SLOTS), np.float32)
+        ne_bound = np.zeros(Q_BATCH, np.float32)
+        starts, nbs, idf = reg["starts"], reg["nb"], reg["idf"]
+        mask_rows = [dev.live]
+        row_of: Dict[tuple, int] = {}
+        bad: list = []
+        for qi, (tok, k, term_ids, filt, essd) in enumerate(items):
+            _bkt, ess_terms, ne_terms, bound, theta, total = essd
+            pos = 0
+            for t in ess_terms:
+                cnt = int(nbs[t])
+                st = int(starts[t])
+                sel[qi, pos:pos + cnt] = np.arange(st, st + cnt,
+                                                   dtype=np.int32)
+                ws[qi, pos:pos + cnt] = idf[t]
+                pos += cnt
+            for ti, t in enumerate(ne_terms):
+                ne_start[qi, ti] = reg["post_start"][t]
+                ne_len[qi, ti] = reg["post_len"][t]
+                ne_idf[qi, ti] = idf[t]
+            ne_bound[qi] = bound
+            if filt:
+                row = row_of.get(filt)
+                if row is None:
+                    col = self._filter_col(reg, filt)
+                    if col is None:
+                        bad.append(tok)
+                        sel[qi, :] = dp.zero_block
+                        ws[qi, :] = 0.0
+                        continue
+                    row = len(mask_rows)
+                    mask_rows.append(col)
+                    row_of[filt] = row
+                mask_ids[qi] = row
+        if len(mask_rows) == 1 and reg.get("plain_masks") is not None:
+            masks = reg["plain_masks"]
+        else:
+            masks = jnp.stack(mask_rows
+                              + [dev.live] * (F_SLOTS - len(mask_rows)))
+        k_static = self.max_k
+        packed = bm25_essential_topk_batch(
+            dp.block_docids, dp.block_tfs, reg["flat_docids"],
+            reg["flat_tfs"], sel, ws, dp.doc_lens, masks, mask_ids,
+            ne_start, ne_len, ne_idf, ne_bound,
+            np.float32(dp.avg_len), reg["k1"], reg["b"], k_static)
+        out = np.asarray(packed)
+        took_ms = int((time.time() - t_arrive) * 1000)
+        idx_b = reg["index"].encode()
+        h = self.front.h
+        self.stats["cohorts"] += 1
+        self.stats["ess_queries"] = self.stats.get("ess_queries", 0) \
+            + len(items)
+        bad_set = set(bad)
+        if responded is None:
+            responded = set()
+        refire: list = []
+        for qi, (tok, k, term_ids, filt, essd) in enumerate(items):
+            if tok in bad_set:
+                self._respond_empty(tok, reg)
+                responded.add(tok)
+                continue
+            ok = int(out[qi, 2 * k_static:].view(np.int32)[0])
+            if not ok:
+                refire.append((tok, k, term_ids, filt, essd))
+                continue
+            vals = out[qi, :k_static]
+            ids = out[qi, k_static:2 * k_static].view(np.int32)
+            nhit = int(min(k, np.isfinite(vals).sum()))
+            v = np.ascontiguousarray(vals[:nhit])
+            d = np.ascontiguousarray(ids[:nhit])
+            if h is None:
+                return
+            self.lib.es_fast_respond(
+                h, tok, idx_b,
+                d.ctypes.data_as(ctypes.c_void_p),
+                v.ctypes.data_as(ctypes.c_void_p),
+                nhit, essd[5], b"eq", took_ms)
+            responded.add(tok)
+        self.stats["fast_queries"] += len(items) - len(refire)
+        if refire:
+            self._refire_full(reg, refire, t_arrive)
+            for tok, *_ in refire:
+                responded.add(tok)
 
     def _filter_col(self, reg, filt):
         """Device column: base live AND the filter-set mask (cached; the
@@ -461,6 +720,13 @@ class FastPathServer:
             order = np.lexsort((d, -v))
             v = np.ascontiguousarray(v[order])
             d = np.ascontiguousarray(d[order])
+            if (k == self.max_k and nhit == k
+                    and len(reg["theta"]) < 100_000):
+                # exact kth + exact total: the θ cache entry that
+                # licenses this query's essential lane from now on
+                # (the segment is immutable for this registration)
+                reg["theta"][(tuple(term_ids), filt, k)] = (
+                    float(v[-1]), total)
             if h is None:
                 return
             self.lib.es_fast_respond(

@@ -1,0 +1,77 @@
+"""Query profiling: per-stage timing for `profile: true`.
+
+The reference profiles per-Weight/Scorer timing types through
+QueryProfiler trees (ref: search/profile/query/QueryProfiler.java:38,
+QueryProfileBreakdown). This engine's execution shape is different —
+one fused device launch instead of per-doc scorer calls — so the
+breakdown reports the stages that actually exist here, split into HOST
+and DEVICE time:
+
+  rewrite   — query tree rewriting (host)
+  compile   — logical-plan compilation / cache lookup (host)
+  bind      — selection building + bucket padding (host)
+  launch    — kernel dispatch + device execution wait (device)
+  readback  — device→host transfer of the top-k rows (device↔host)
+  score     — dense-path column scoring (device, fallback path)
+  topk      — dense-path masked top-k (device, fallback path)
+  merge     — cross-segment merge (host)
+
+A threadlocal recorder keeps instrumentation out of every call
+signature; it is active only under `profiling()`, so the serving hot
+path pays one `is-None` check per stage.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+_tls = threading.local()
+
+
+def active() -> bool:
+    return getattr(_tls, "rec", None) is not None
+
+
+@contextmanager
+def profiling():
+    """Activate collection; yields the stage dict (stage → nanos)."""
+    rec: Dict[str, int] = {}
+    prev = getattr(_tls, "rec", None)
+    _tls.rec = rec
+    try:
+        yield rec
+    finally:
+        _tls.rec = prev
+
+
+def record(stage: str, nanos: int) -> None:
+    rec = getattr(_tls, "rec", None)
+    if rec is not None:
+        rec[stage] = rec.get(stage, 0) + nanos
+
+
+def note(key: str, value) -> None:
+    """Non-timing annotation (e.g. collector name)."""
+    rec = getattr(_tls, "rec", None)
+    if rec is not None:
+        rec.setdefault("_notes", {})[key] = value   # type: ignore
+
+
+@contextmanager
+def span(stage: str):
+    rec = getattr(_tls, "rec", None)
+    if rec is None:
+        yield
+        return
+    t0 = time.monotonic_ns()
+    try:
+        yield
+    finally:
+        record(stage, time.monotonic_ns() - t0)
+
+
+DEVICE_STAGES = ("launch", "readback", "score", "topk")
+HOST_STAGES = ("rewrite", "compile", "bind", "merge")

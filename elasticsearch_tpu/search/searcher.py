@@ -134,12 +134,15 @@ class ShardSearcher:
                         cache_key=lp_key)
                 plannable = False   # known not plannable: dense path
 
-        query = query.rewrite(self)
-        if post_filter is not None:
-            post_filter = post_filter.rewrite(self)
+        from elasticsearch_tpu.search import profile as _prof
+        with _prof.span("rewrite"):
+            query = query.rewrite(self)
+            if post_filter is not None:
+                post_filter = post_filter.rewrite(self)
         if plannable:
             from elasticsearch_tpu.search.plan import compile_plan
-            plan = compile_plan(query, self, post_filter)
+            with _prof.span("compile"):
+                plan = compile_plan(query, self, post_filter)
             if lp_key is not None:
                 pc = self.cache.plan_cache
                 pc[lp_key] = plan
@@ -160,7 +163,9 @@ class ShardSearcher:
                     agg_masks.append((ctx.segment,
                                       np.zeros(ctx.segment.n_docs, bool)))
                 continue
-            scores, mask = query.execute(ctx)
+            _prof.note("collector", "DenseColumnTopDocsCollector")
+            with _prof.span("score"):
+                scores, mask = query.execute(ctx)
             mask = mask & ctx.live
             if min_score is not None:
                 # min_score wraps ALL collectors incl. aggs (ref:
@@ -211,8 +216,11 @@ class ShardSearcher:
                 else:
                     allowed = key <= ck
                 mask = mask & allowed
-            vals, ids = topk_ops.masked_topk(key, mask, min(k, ctx.n_docs_padded))
-            vals, ids = np.asarray(vals), np.asarray(ids)
+            with _prof.span("topk"):
+                vals, ids = topk_ops.masked_topk(key, mask,
+                                                 min(k, ctx.n_docs_padded))
+            with _prof.span("readback"):
+                vals, ids = np.asarray(vals), np.asarray(ids)
             keep = np.isfinite(vals)
             ids = ids[keep]
             scores_np = np.asarray(scores)[ids]
@@ -256,7 +264,9 @@ class ShardSearcher:
         """Execute a compiled LogicalPlan per segment via the fused
         sorted-top-k kernel (search/plan.py) and merge exactly as the
         dense path merges (by (-score, segment, docid))."""
+        from elasticsearch_tpu.search import profile as _prof
         from elasticsearch_tpu.search.plan import bind_plan, execute_bound
+        _prof.note("collector", "FusedPlanTopDocsCollector")
 
         # exact totals (track_total_hits: true) forbid dropping blocks;
         # thresholded/disabled totals license block-max pruning, exactly
@@ -283,20 +293,24 @@ class ShardSearcher:
             if bp is None:
                 if not query.can_match(ctx):
                     continue
-                bp = bind_plan(plan, ctx, k=k, allow_prune=allow_prune)
+                with _prof.span("bind"):
+                    bp = bind_plan(plan, ctx, k=k,
+                                   allow_prune=allow_prune)
                 if bkey is not None:
                     bpc = ctx.device._bound_plans
                     bpc[bkey] = bp
                     while len(bpc) > 128:
                         bpc.popitem(last=False)
             lower_bound = lower_bound or bp.pruned
-            if self.batcher is not None:
-                vals, ids, seg_total = self.batcher.execute(
-                    bp, ctx, k, self.k1, self.b, after_score)
-            else:
-                vals, ids, seg_total = execute_bound(
-                    bp, ctx, k, self.k1, self.b, after_score)
-            vals, ids = np.asarray(vals), np.asarray(ids)
+            with _prof.span("launch"):
+                if self.batcher is not None:
+                    vals, ids, seg_total = self.batcher.execute(
+                        bp, ctx, k, self.k1, self.b, after_score)
+                else:
+                    vals, ids, seg_total = execute_bound(
+                        bp, ctx, k, self.k1, self.b, after_score)
+            with _prof.span("readback"):
+                vals, ids = np.asarray(vals), np.asarray(ids)
             if track_total_hits:
                 total += int(seg_total)
             keep = vals > -np.inf

@@ -617,6 +617,12 @@ class SearchService:
             after_key = (scroll_ctx.cursors.get(shard_idx)
                          if (scroll_ctx is not None and continuing) else None)
             t0 = time.monotonic_ns()
+            prof_cm = None
+            prof_rec = {}
+            if profile:
+                from elasticsearch_tpu.search import profile as _prof
+                prof_cm = _prof.profiling()
+                prof_rec = prof_cm.__enter__()
             if scroll_ctx is None and slice_spec is None:
                 # stable plan-cache key: the raw query/post_filter JSON —
                 # repeat queries skip compile AND bind (searcher.py)
@@ -628,33 +634,69 @@ class SearchService:
                     plan_cache_key = None
             else:
                 plan_cache_key = None
-            result = searcher.query_phase(
-                query, query_k, post_filter=post_filter, min_score=min_score,
-                sort=sort, search_after=search_after,
-                # raw value (bool OR int threshold): thresholded totals
-                # license block-max pruning down in the plan executor
-                track_total_hits=(track_total if not continuing else False),
-                after_key=after_key, collect_masks=collect_masks,
-                # scroll pages must stay on ONE executor: plan-path and
-                # dense-path float32 sums differ in the last bits, so a
-                # cursor taken from one would re-emit/skip boundary docs
-                # when continued on the other
-                allow_plan=scroll_ctx is None,
-                cache_key=plan_cache_key)
-            if terminate_after:
-                # the shard "stops collecting" after terminate_after docs
-                result.docs[:] = result.docs[: int(terminate_after)]
-            if rescore_spec:
-                result.docs[:] = searcher.rescore(result.docs, rescore_spec)
+            try:
+                result = searcher.query_phase(
+                    query, query_k, post_filter=post_filter,
+                    min_score=min_score,
+                    sort=sort, search_after=search_after,
+                    # raw value (bool OR int threshold): thresholded
+                    # totals license block-max pruning down in the plan
+                    # executor
+                    track_total_hits=(track_total if not continuing
+                                      else False),
+                    after_key=after_key, collect_masks=collect_masks,
+                    # scroll pages must stay on ONE executor: plan-path
+                    # and dense-path float32 sums differ in the last
+                    # bits, so a cursor taken from one would re-emit/
+                    # skip boundary docs when continued on the other
+                    allow_plan=scroll_ctx is None,
+                    cache_key=plan_cache_key)
+                if terminate_after:
+                    # the shard "stops collecting" after terminate_after
+                    result.docs[:] = result.docs[: int(terminate_after)]
+                if rescore_spec:
+                    result.docs[:] = searcher.rescore(result.docs,
+                                                      rescore_spec)
+            finally:
+                if prof_cm is not None:
+                    prof_cm.__exit__(None, None, None)
             if profile:
+                from elasticsearch_tpu.search import profile as _prof
+                total_ns = time.monotonic_ns() - t0
+                notes = prof_rec.pop("_notes", {})
+                breakdown = {k: v for k, v in prof_rec.items()}
+                device_ns = sum(prof_rec.get(k, 0)
+                                for k in _prof.DEVICE_STAGES)
+                host_ns = sum(prof_rec.get(k, 0)
+                              for k in _prof.HOST_STAGES)
+                breakdown["device_time_in_nanos"] = device_ns
+                breakdown["host_time_in_nanos"] = max(
+                    host_ns, total_ns - device_ns)
                 qtype = next(iter(body.get("query") or {"match_all": {}}))
+                collector_name = notes.get(
+                    "collector", "FusedPlanTopDocsCollector")
                 profile_shards.append({
                     "id": f"[{index_name}][{shard_idx}]",
                     "searches": [{"query": [{
                         "type": qtype,
                         "description": str(body.get("query", {})),
-                        "time_in_nanos": time.monotonic_ns() - t0,
-                    }], "rewrite_time": 0, "collector": []}],
+                        "time_in_nanos": total_ns,
+                        # the TPU execution stages (compile/bind are
+                        # host; launch/readback are device — ref:
+                        # QueryProfiler.java:38 breaks down per-Scorer
+                        # timing types; here the stages ARE the
+                        # execution model)
+                        "breakdown": breakdown,
+                    }],
+                        "rewrite_time": prof_rec.get("rewrite", 0),
+                        "collector": [{
+                            "name": collector_name,
+                            "reason": "search_top_hits",
+                            "time_in_nanos": (
+                                prof_rec.get("launch", 0)
+                                + prof_rec.get("topk", 0)
+                                + prof_rec.get("score", 0)),
+                        }]}],
                     "aggregations": [],
                 })
             shard_results.append((index_name, searcher, result))
@@ -746,9 +788,11 @@ class SearchService:
             by_shard.setdefault(shard_idx, []).append((pos, d))
             shard_info[shard_idx] = (index_name, searcher)
         hits_by_pos: Dict[int, Dict[str, Any]] = {}
+        fetch_ns: Dict[int, int] = {}
         for shard_idx, entries in by_shard.items():
             index_name, searcher = shard_info[shard_idx]
             docs = [d for _, d in entries]
+            fetch_t0 = time.monotonic_ns()
             fetched_list = searcher.fetch_phase(
                 docs, source_filter=source_filter,
                 docvalue_fields=docvalue_fields or None,
@@ -756,6 +800,7 @@ class SearchService:
                 script_fields=script_fields, fields=fields_spec,
                 version=bool(body.get("version")),
                 seq_no_primary_term=bool(body.get("seq_no_primary_term")))
+            fetch_ns[shard_idx] = time.monotonic_ns() - fetch_t0
             for (pos, d), fetched in zip(entries, fetched_list):
                 fetched["_index"] = index_name
                 if collapse_field:
@@ -842,6 +887,16 @@ class SearchService:
         if suggest is not None:
             response["suggest"] = suggest
         if profile:
+            # per-shard fetch timing (ref: FetchProfiler — the fetch
+            # phase reports its own breakdown since 7.16)
+            for si, entry in enumerate(profile_shards):
+                if si in fetch_ns:
+                    entry["fetch"] = {
+                        "type": "fetch",
+                        "description": "",
+                        "time_in_nanos": fetch_ns[si],
+                        "breakdown": {"load_stored_fields": fetch_ns[si]},
+                    }
             response["profile"] = {"shards": profile_shards}
         return response
 

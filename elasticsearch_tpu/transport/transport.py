@@ -363,8 +363,15 @@ class TcpTransport(BaseTransport):
 
     def __init__(self, local_node: DiscoveryNode, bind_port: int = 0,
                  executor: Optional[ThreadPoolExecutor] = None,
-                 ssl_config: Optional[Dict] = None):
+                 ssl_config: Optional[Dict] = None,
+                 ip_filter: Optional[Tuple[str, str]] = None):
         super().__init__(local_node, executor)
+        # accept-time IP filtering (ref: x-pack IPFilter on the
+        # transport profile — allow wins, allow-only implies deny);
+        # same semantics as the HTTP front
+        from elasticsearch_tpu.rest.http_server import HttpServer
+        self._ip_allow, self._ip_deny = HttpServer._parse_ip_filter(
+            ip_filter)
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((local_node.host, bind_port))
@@ -401,11 +408,26 @@ class TcpTransport(BaseTransport):
     # -- server side ------------------------------------------------------
 
     def _accept_loop(self) -> None:
+        import ipaddress
         while not self._closed:
             try:
                 conn, _addr = self._server.accept()
             except OSError:
                 return
+            if self._ip_allow or self._ip_deny:
+                try:
+                    addr = ipaddress.ip_address(_addr[0])
+                except ValueError:
+                    conn.close()
+                    continue
+                allowed = (any(addr in net for net in self._ip_allow)
+                           or (not any(addr in net
+                                       for net in self._ip_deny)
+                               and not self._ip_allow))
+                if not allowed:
+                    # ref: IPFilter — rejected at accept, no response
+                    conn.close()
+                    continue
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 

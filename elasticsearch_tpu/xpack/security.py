@@ -525,6 +525,138 @@ class JwtRealm(Realm):
                                              if k != "roles"}})
 
 
+class LdapRealm(Realm):
+    """LDAP / Active Directory authentication (ref:
+    x-pack/plugin/security/.../authc/ldap/LdapRealm.java:54 — session
+    factories bind as the user, then group search feeds role mappings).
+
+    Config (xpack.security.authc.ldap.*):
+    - ``url``: ldap://host:port
+    - ``user_dn_templates``: ["uid={0},ou=people,dc=..."] — direct bind
+      (LdapSessionFactory), OR
+    - ``bind_dn``/``bind_password`` + ``user_search_base`` (+
+      ``user_search_attribute``, default uid) — search-then-bind
+      (LdapUserSearchSessionFactory)
+    - ``group_search_base``: subtree searched for groups whose
+      ``member``/``uniqueMember`` holds the user DN or ``memberUid``
+      holds the username (the AD/posixGroup shapes)
+
+    Roles come from role mappings over the ``groups``/``dn``/
+    ``username`` fields — LDAP groups are never roles directly unless
+    mapped (ref: the unmapped_groups_as_roles=false default)."""
+
+    type = "ldap"
+
+    def __init__(self, name, order, svc, config: Dict[str, Any]):
+        super().__init__(name, order, svc)
+        self.config = config or {}
+
+    def token(self, headers):
+        if not self.config.get("url"):
+            return None
+        auth = headers.get("authorization", "")
+        if auth.lower().startswith("basic "):
+            return auth.partition(" ")[2]
+        return None
+
+    def _connect(self):
+        from elasticsearch_tpu.common.ldap import (LdapClient,
+                                                   parse_ldap_url)
+        host, port = parse_ldap_url(self.config["url"])
+        return LdapClient(host, port, timeout=float(
+            self.config.get("timeout", 5.0)))
+
+    def authenticate(self, payload) -> "User":
+        from elasticsearch_tpu.common.ldap import LdapError
+        try:
+            username, _, password = base64.b64decode(
+                payload).decode().partition(":")
+        except Exception:
+            raise AuthenticationException("invalid basic credentials")
+        if not username or not password:
+            raise AuthenticationException(
+                "missing LDAP credentials")
+        try:
+            user_dn = self._bind_user(username, password)
+        except LdapError as e:
+            raise AuthenticationException(f"LDAP authentication "
+                                          f"failed: {e}")
+        if user_dn is None:
+            raise AuthenticationException(
+                f"unable to authenticate user [{username}] against "
+                f"LDAP")
+        groups = self._groups(user_dn, username)
+        roles = self.svc.mapped_roles(username=username, dn=user_dn,
+                                      realm=self.name, groups=groups)
+        return User(username, roles,
+                    metadata={"ldap_dn": user_dn,
+                              "ldap_groups": groups})
+
+    def _bind_user(self, username: str, password: str):
+        """The user's DN on successful bind, else None."""
+        from elasticsearch_tpu.common.ldap import LdapError
+        templates = self.config.get("user_dn_templates") or []
+        if templates:
+            for tpl in templates:
+                dn = tpl.replace("{0}", username)
+                with self._connect() as c:
+                    try:
+                        if c.simple_bind(dn, password):
+                            return dn
+                    except LdapError:
+                        continue
+            return None
+        # search-then-bind
+        base = self.config.get("user_search_base")
+        if not base:
+            raise LdapError("ldap realm requires user_dn_templates or "
+                            "user_search_base")
+        attr = self.config.get("user_search_attribute", "uid")
+        with self._connect() as c:
+            bind_dn = self.config.get("bind_dn")
+            if bind_dn:
+                if not c.simple_bind(bind_dn,
+                                     self.config.get("bind_password",
+                                                     "")):
+                    raise LdapError("bind_dn authentication failed")
+            hits = c.search(base, ("=", attr, username), ["dn"])
+        if not hits:
+            return None
+        user_dn = hits[0][0]
+        with self._connect() as c:
+            return user_dn if c.simple_bind(user_dn, password) else None
+
+    def _groups(self, user_dn: str, username: str) -> List[str]:
+        base = self.config.get("group_search_base")
+        if not base:
+            return []
+        from elasticsearch_tpu.common.ldap import LdapError
+        try:
+            with self._connect() as c:
+                bind_dn = self.config.get("bind_dn")
+                if bind_dn and not c.simple_bind(
+                        bind_dn, self.config.get("bind_password", "")):
+                    raise LdapError("bind_dn authentication failed "
+                                    "during group lookup")
+                hits = c.search(
+                    base,
+                    ("|", [("=", "member", user_dn),
+                           ("=", "uniqueMember", user_dn),
+                           ("=", "memberUid", username)]),
+                    ["cn"])
+        except LdapError as e:
+            # FAIL CLOSED: a broken group lookup must not silently strip
+            # every mapped role (ref: the realm errors out, it never
+            # authenticates with an empty group set on lookup failure)
+            raise AuthenticationException(
+                f"LDAP group lookup failed: {e}")
+        groups = []
+        for dn, attrs in hits:
+            groups.append(dn)
+            groups.extend(attrs.get("cn", []))
+        return groups
+
+
 class PkiRealm(Realm):
     """Client-certificate authentication (ref: pki/PkiRealm.java). The
     certificate arrives either on the `x-ssl-client-cert` header (PEM,
@@ -642,7 +774,8 @@ class SecurityService:
                  pki_truststore: Optional[str] = None,
                  keystore=None,
                  jwt_issuer: Optional[str] = None,
-                 jwt_audience: Optional[str] = None):
+                 jwt_audience: Optional[str] = None,
+                 ldap_config: Optional[Dict[str, Any]] = None):
         # ref: x-pack anonymous access (xpack.security.authc.anonymous.*)
         # — requests without credentials authenticate as this principal
         self.anonymous_username = anonymous_username
@@ -687,7 +820,10 @@ class SecurityService:
                      issuer=jwt_issuer, audience=jwt_audience),
             ApiKeyRealm("api_key1", orders.get("api_key", 4), self),
             PkiRealm("pki1", orders.get("pki", 5), self),
-        ], key=lambda r: r.order)
+        ] + ([LdapRealm("ldap1", orders.get("ldap", 6), self,
+                        ldap_config)]
+             if ldap_config and ldap_config.get("url") else []),
+            key=lambda r: r.order)
 
     # ------------------------------------------------------------- persist
     def _load(self):
@@ -1057,16 +1193,24 @@ class SecurityService:
         return {"found": found}
 
     def mapped_roles(self, username: str, dn: str,
-                     realm: str) -> List[str]:
+                     realm: str,
+                     groups: Optional[List[str]] = None) -> List[str]:
         """Resolve roles via role-mapping rules (ref: the field rules of
-        put_role_mapping: username / dn / realm.name, with any/all)."""
+        put_role_mapping: username / dn / realm.name / groups — the
+        groups field is how LDAP/AD realms grant roles, with any/all)."""
         ctx = {"username": username, "dn": dn, "realm.name": realm}
+        group_list = list(groups or [])
 
         def match(rule: Dict[str, Any]) -> bool:
             if "field" in rule:
                 for k, want in rule["field"].items():
-                    got = ctx.get(k)
                     wants = want if isinstance(want, list) else [want]
+                    if k == "groups":
+                        if not any(_dn_like(g, w) for g in group_list
+                                   for w in wants):
+                            return False
+                        continue
+                    got = ctx.get(k)
                     if not any(_dn_like(got, w) for w in wants):
                         return False
                 return True

@@ -315,6 +315,50 @@ def test_delete_unregisters_fastpath(served):
     assert_equivalent(resp, dispatch(node, body))
 
 
+def test_theta_cached_essential_lane(tmp_path):
+    """Second run of an identical query takes the θ-cached essential
+    MaxScore lane (small sort + per-candidate patching) and returns
+    results identical to the full exact kernel (ops/fastpath.py
+    essential lane)."""
+    node = Node(settings=Settings.from_dict({
+        "http": {"native": {"fast_nb_buckets": "64,128",
+                            "fast_max_k": 10}},
+    }), data_path=str(tmp_path / "data"))
+    port = node.start(0)
+    try:
+        lines = []
+        # 12 docs with a HIGH-idf term (some also carry 'common'), 288
+        # with only the low-idf term: θ at k=10 exceeds maxc(common),
+        # so 'common' goes non-essential and gets patched back
+        for i in range(300):
+            text = ("rare common extra" if i < 12 else "common filler")
+            lines.append(json.dumps({"index": {"_index": "books",
+                                               "_id": str(i)}}))
+            lines.append(json.dumps({"title": text}))
+        req(port, "POST", "/_bulk", "\n".join(lines) + "\n", ndjson=True)
+        req(port, "POST", "/books/_refresh")
+        fp = node._http.fastpath
+        fp.refresh_registration()
+        assert fp._reg is not None
+        body = {"query": {"match": {"title": "rare common"}},
+                "size": 10, "_source": False}
+        first = req(port, "POST", "/books/_search", body)
+        key = next(iter(fp._reg["theta"]), None)
+        assert key is not None, "θ cache must fill after a full run"
+        split = fp._essential_split(fp._reg, 10, list(key[0]), key[1])
+        assert split is not None, "partition should find a ne term"
+        second = req(port, "POST", "/books/_search", body)
+        # let the async launch finish responding before reading stats
+        assert fp.stats.get("ess_queries", 0) >= 1
+        assert_equivalent(second, first)
+        assert second["hits"]["total"] == first["hits"]["total"]
+        # exact-order identity for the certified lane (both exact)
+        assert [h["_id"] for h in second["hits"]["hits"]] == \
+            [h["_id"] for h in first["hits"]["hits"]]
+    finally:
+        node.close()
+
+
 def test_segment_change_reregisters(served):
     node, port = served
     fp = node._http.fastpath
