@@ -1,0 +1,367 @@
+"""CJK morphological analysis (the kuromoji / nori / smartcn class).
+
+The reference ships dictionary-driven morphological analyzers (ref:
+plugins/analysis-kuromoji/.../KuromojiAnalyzerProvider.java — a MeCab
+IPADIC lattice; analysis-nori — mecab-ko-dic; analysis-smartcn — an HMM
+segmenter). Those dictionaries are tens of megabytes and unobtainable
+in a zero-egress build, so this module is a DISCLOSED algorithmic
+approximation around compact bundled dictionaries:
+
+- character-class segmentation first (kanji / hiragana / katakana /
+  hangul / latin / digits — the hard token boundaries),
+- greedy longest-match over a bundled common-word dictionary inside
+  kanji/hán runs; un-matched kanji runs fall back to overlapping
+  bigrams (kuromoji search-mode's n-gram fallback for unknown words),
+- Japanese inflection stripping to DICTIONARY FORM: aux/politeness
+  endings (ました/ます/です/たい/ない…) are stripped and the verb stem
+  is mapped back to its 辞書形 (godan い-row → う-row, ichidan +る),
+- particles (助詞) and auxiliaries are dropped, like the reference
+  analyzers' default POS stoptags,
+- Korean: whitespace segmentation + josa (조사) suffix stripping +
+  verb-ending normalization to the 하다 form,
+- Chinese: dictionary longest-match + bigram fallback.
+
+Exactness contract: these are analyzers, not taggers — they must be
+deterministic and identical at index and query time, which they are
+(pure functions of the bundled tables).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from elasticsearch_tpu.analysis.tokenizers import Token, Tokenizer
+
+# ------------------------------------------------------------ char classes
+
+def _char_class(ch: str) -> str:
+    cp = ord(ch)
+    if 0x3040 <= cp <= 0x309F:
+        return "hiragana"
+    if 0x30A0 <= cp <= 0x30FF or cp == 0x30FC:
+        return "katakana"
+    if 0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF:
+        return "kanji"
+    if 0xAC00 <= cp <= 0xD7A3 or 0x1100 <= cp <= 0x11FF:
+        return "hangul"
+    if ch.isdigit():
+        return "digit"
+    if ch.isalpha():
+        return "latin"
+    return "other"
+
+
+# --------------------------------------------------- bundled dictionaries
+
+# Japanese particles + aux endings dropped from output (助詞/助動詞 —
+# the analyzer's default stoptags). Longest-first matters.
+JA_PARTICLES = sorted([
+    "について", "によって", "として", "ながら", "けれど", "ている",
+    "ています", "でした", "ました", "ません", "ます", "です", "だった",
+    "ない", "たい", "たち", "から", "まで", "より", "など", "だけ",
+    "ほど", "くらい", "ぐらい", "こそ", "さえ", "しか", "でも", "とか",
+    "には", "とは", "では", "へは", "もう", "は", "が", "を", "に",
+    "へ", "で", "と", "の", "も", "や", "か", "ね", "よ", "な", "ぞ",
+    "さ", "わ", "ば", "て", "た", "だ",
+], key=len, reverse=True)
+
+# common-word dictionary for longest-match inside kanji runs (a compact
+# stand-in for IPADIC's noun lattice)
+JA_WORDS = {
+    "日本", "日本語", "東京", "大阪", "京都", "関西", "関東", "国際",
+    "空港", "大学", "大学院", "学生", "学校", "先生", "会社", "会社員",
+    "電車", "新幹線", "新聞", "雑誌", "料理", "寿司", "天気", "時間",
+    "今日", "明日", "昨日", "今年", "去年", "来年", "毎日", "世界",
+    "経済", "政治", "歴史", "文化", "音楽", "映画", "写真", "旅行",
+    "仕事", "勉強", "研究", "問題", "質問", "答え", "言葉", "名前",
+    "家族", "友達", "子供", "動物", "自然", "環境", "技術", "情報",
+    "電話", "携帯", "計算", "機械", "自動車", "飛行機", "図書館",
+    "病院", "銀行", "駅", "店", "国", "人", "山", "川", "海", "空",
+    "水", "火", "木", "金", "土", "月", "日", "年", "circ",
+} - {"circ"}
+
+# godan continuative (い-row) → dictionary form (う-row)
+_GODAN = {"き": "く", "ぎ": "ぐ", "し": "す", "ち": "つ", "に": "ぬ",
+          "び": "ぶ", "み": "む", "り": "る", "い": "う"}
+_E_ROW = set("えけげせぜてでねべぺめれ")
+
+# Korean josa (조사) suffixes stripped from nouns, longest first
+KO_JOSA = sorted([
+    "에서부터", "으로부터", "에게서", "한테서", "으로서", "으로써",
+    "처럼", "보다", "부터", "까지", "에게", "한테", "께서", "에서",
+    "으로", "이나", "이라", "라도", "마저", "조차", "밖에", "은",
+    "는", "이", "가", "을", "를", "의", "에", "로", "와", "과", "도",
+    "만", "나", "께",
+], key=len, reverse=True)
+
+# Korean verb/adjective endings → 하다-class dictionary form
+KO_VERB_ENDINGS = sorted([
+    ("했었습니다", "하다"), ("했습니다", "하다"), ("합니다", "하다"),
+    ("입니다", "이다"), ("습니다", "다"), ("었습니다", "다"),
+    ("았습니다", "다"), ("하는", "하다"), ("하고", "하다"),
+    ("해서", "하다"), ("했다", "하다"), ("한다", "하다"),
+    ("하다", "하다"),
+], key=lambda kv: len(kv[0]), reverse=True)
+
+# compact Chinese common-word dictionary (smartcn stand-in)
+ZH_WORDS = {
+    "中国", "北京", "上海", "大学", "学生", "学校", "老师", "我们",
+    "你们", "他们", "没有", "什么", "知道", "可以", "喜欢", "今天",
+    "明天", "昨天", "现在", "时间", "工作", "学习", "研究", "问题",
+    "世界", "国家", "经济", "政治", "历史", "文化", "音乐", "电影",
+    "朋友", "家人", "孩子", "动物", "自然", "环境", "技术", "信息",
+    "电话", "手机", "计算机", "飞机", "火车", "图书馆", "医院",
+    "银行", "商店",
+}
+
+
+def _dict_match_run(run: str, start: int, pos0: int, words,
+                    out: List[Token], bigram_fallback: bool) -> int:
+    """Greedy longest-match of `words` over a same-class run; unmatched
+    spans fall back to bigrams (len>2) or a single token."""
+    i = 0
+    pos = pos0
+    n = len(run)
+    while i < n:
+        matched = None
+        for ln in range(min(6, n - i), 1, -1):
+            if run[i:i + ln] in words:
+                matched = run[i:i + ln]
+                break
+        if matched:
+            out.append(Token(matched, pos, start + i,
+                             start + i + len(matched)))
+            pos += 1
+            i += len(matched)
+            continue
+        # unknown span: collect until the next dictionary hit
+        j = i + 1
+        while j < n:
+            hit = False
+            for ln in range(min(6, n - j), 1, -1):
+                if run[j:j + ln] in words:
+                    hit = True
+                    break
+            if hit:
+                break
+            j += 1
+        span = run[i:j]
+        if len(span) <= 2 or not bigram_fallback:
+            out.append(Token(span, pos, start + i, start + i + len(span)))
+            pos += 1
+        else:
+            # kuromoji search-mode style overlapping bigrams
+            for b in range(len(span) - 1):
+                out.append(Token(span[b:b + 2], pos,
+                                 start + i + b, start + i + b + 2))
+                pos += 1
+        i = j
+    return pos
+
+
+def _ja_baseform(stem: str) -> str:
+    """Continuative stem → 辞書形 (dictionary form): godan い-row maps
+    to う-row, え-row stems (ichidan) take る."""
+    if not stem:
+        return stem
+    last = stem[-1]
+    if last in _GODAN and len(stem) >= 2:
+        return stem[:-1] + _GODAN[last]
+    if last in _E_ROW:
+        return stem + "る"
+    return stem
+
+
+class KuromojiTokenizer(Tokenizer):
+    """Japanese morphological tokenizer (kuromoji-class, disclosed
+    algorithmic approximation — see module docstring)."""
+
+    name = "kuromoji_tokenizer"
+
+    def tokenize(self, text: str) -> List[Token]:
+        out: List[Token] = []
+        pos = 0
+        i = 0
+        n = len(text)
+        while i < n:
+            cls = _char_class(text[i])
+            j = i
+            while j < n and _char_class(text[j]) == cls:
+                j += 1
+            run = text[i:j]
+            if cls in ("other",):
+                i = j
+                continue
+            if cls in ("latin", "digit"):
+                out.append(Token(run.lower(), pos, i, j))
+                pos += 1
+            elif cls == "katakana":
+                out.append(Token(run, pos, i, j))
+                pos += 1
+            elif cls == "kanji":
+                # kanji run, possibly followed by a hiragana tail that
+                # inflects it (食べました): attach the okurigana tail to
+                # the LAST kanji word, strip endings, emit base form
+                tail_j = j
+                while tail_j < n and _char_class(text[tail_j]) == \
+                        "hiragana":
+                    tail_j += 1
+                tail = text[j:tail_j]
+                if tail:
+                    # strip particle/aux endings off the tail
+                    stem_tail = tail
+                    changed = True
+                    while changed and stem_tail:
+                        changed = False
+                        for p in JA_PARTICLES:
+                            if stem_tail.endswith(p):
+                                stem_tail = stem_tail[: -len(p)]
+                                changed = True
+                                break
+                    if stem_tail in ("し", "する", "すれ", "しよう"):
+                        # する-verb (勉強しています → 勉強 + する): the
+                        # kanji run is a noun, する is its own verb
+                        pos = _dict_match_run(run, i, pos, JA_WORDS,
+                                              out, True)
+                        out.append(Token("する", pos, j, tail_j))
+                        pos += 1
+                    elif stem_tail:
+                        # okurigana verb/adjective: the LAST kanji plus
+                        # the inflection stem normalizes to 辞書形;
+                        # leading kanji words dictionary-match
+                        if len(run) > 1:
+                            pos = _dict_match_run(run[:-1], i, pos,
+                                                  JA_WORDS, out, True)
+                        base = _ja_baseform(run[-1] + stem_tail)
+                        out.append(Token(base, pos, i + len(run) - 1,
+                                         tail_j))
+                        pos += 1
+                    else:
+                        # particles-only tail: the kanji run stands
+                        # alone (東京大学に → 東京 大学)
+                        pos = _dict_match_run(run, i, pos, JA_WORDS,
+                                              out, True)
+                    i = tail_j
+                    continue
+                pos = _dict_match_run(run, i, pos, JA_WORDS, out, True)
+            elif cls == "hiragana":
+                # pure hiragana run: longest-match strip particles from
+                # the front; leftover chunks become tokens (content
+                # words written in kana), particles are dropped
+                k = 0
+                buf_start = None
+                while k < len(run):
+                    hit = None
+                    for p in JA_PARTICLES:
+                        if run.startswith(p, k):
+                            hit = p
+                            break
+                    if hit:
+                        if buf_start is not None:
+                            word = run[buf_start:k]
+                            out.append(Token(_ja_baseform(word), pos,
+                                             i + buf_start, i + k))
+                            pos += 1
+                            buf_start = None
+                        k += len(hit)
+                    else:
+                        if buf_start is None:
+                            buf_start = k
+                        k += 1
+                if buf_start is not None:
+                    word = run[buf_start:]
+                    out.append(Token(_ja_baseform(word), pos,
+                                     i + buf_start, i + len(run)))
+                    pos += 1
+            elif cls == "hangul":
+                pos = _emit_korean(run, i, pos, out)
+            i = j
+        return out
+
+
+def _emit_korean(word: str, start: int, pos: int,
+                 out: List[Token]) -> int:
+    # verb/adjective endings → dictionary form
+    for ending, repl in KO_VERB_ENDINGS:
+        if word.endswith(ending) and len(word) > len(ending):
+            stem = word[: -len(ending)]
+            out.append(Token(stem + repl if repl != "다" else word,
+                             pos, start, start + len(word)))
+            return pos + 1
+        if word == ending:
+            out.append(Token(repl, pos, start, start + len(word)))
+            return pos + 1
+    # strip one josa suffix (longest first)
+    for josa in KO_JOSA:
+        if word.endswith(josa) and len(word) > len(josa):
+            out.append(Token(word[: -len(josa)], pos, start,
+                             start + len(word)))
+            return pos + 1
+    out.append(Token(word, pos, start, start + len(word)))
+    return pos + 1
+
+
+class NoriTokenizer(Tokenizer):
+    """Korean morphological tokenizer (nori-class, disclosed
+    algorithmic approximation): whitespace segmentation + josa
+    stripping + verb-ending normalization to dictionary form."""
+
+    name = "nori_tokenizer"
+
+    def tokenize(self, text: str) -> List[Token]:
+        out: List[Token] = []
+        pos = 0
+        i = 0
+        n = len(text)
+        while i < n:
+            ch = text[i]
+            cls = _char_class(ch)
+            if cls == "other":
+                i += 1
+                continue
+            j = i
+            while j < n and _char_class(text[j]) == cls:
+                j += 1
+            run = text[i:j]
+            if cls == "hangul":
+                pos = _emit_korean(run, i, pos, out)
+            elif cls in ("latin", "digit"):
+                out.append(Token(run.lower(), pos, i, j))
+                pos += 1
+            else:
+                out.append(Token(run, pos, i, j))
+                pos += 1
+            i = j
+        return out
+
+
+class SmartcnTokenizer(Tokenizer):
+    """Chinese tokenizer (smartcn-class, disclosed approximation):
+    dictionary longest-match + overlapping-bigram fallback."""
+
+    name = "smartcn_tokenizer"
+
+    def tokenize(self, text: str) -> List[Token]:
+        out: List[Token] = []
+        pos = 0
+        i = 0
+        n = len(text)
+        while i < n:
+            cls = _char_class(text[i])
+            if cls == "other":
+                i += 1
+                continue
+            j = i
+            while j < n and _char_class(text[j]) == cls:
+                j += 1
+            run = text[i:j]
+            if cls == "kanji":
+                pos = _dict_match_run(run, i, pos, ZH_WORDS, out, True)
+            elif cls in ("latin", "digit"):
+                out.append(Token(run.lower(), pos, i, j))
+                pos += 1
+            else:
+                out.append(Token(run, pos, i, j))
+                pos += 1
+            i = j
+        return out

@@ -43,6 +43,11 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
+# on-disk segment format generation (ref: Lucene's per-codec versioning;
+# bumped on any layout change, with loaders kept for older generations —
+# the rolling-upgrade/full-cluster-restart contract, qa/rolling-upgrade/)
+SEGMENT_FORMAT_VERSION = 1
+
 BLOCK_SIZE = 128  # TPU lane width
 
 
@@ -256,6 +261,7 @@ class Segment:
         os.makedirs(directory, exist_ok=True)
         arrays: Dict[str, np.ndarray] = {"live": self.live}
         meta: Dict[str, Any] = {
+            "format_version": SEGMENT_FORMAT_VERSION,
             "name": self.name, "n_docs": self.n_docs,
             "postings": {}, "numerics": [], "keywords": {}, "vectors": {},
         }
@@ -316,6 +322,13 @@ class Segment:
     def load(cls, directory: str) -> "Segment":
         with open(os.path.join(directory, "meta.json")) as fh:
             meta = json.load(fh)
+        fmt = int(meta.get("format_version", 1))
+        if fmt > SEGMENT_FORMAT_VERSION:
+            raise IOError(
+                f"segment [{directory}] was written by a NEWER build "
+                f"(format {fmt} > supported {SEGMENT_FORMAT_VERSION}); "
+                f"downgrades are not supported (ref: Lucene version "
+                f"guards on SegmentInfos)")
         with open(os.path.join(directory, "stored.bin"), "rb") as fh:
             data = fh.read()
         z = np.load(os.path.join(directory, "arrays.npz"))

@@ -129,7 +129,7 @@ def _topk_total(block_docids, block_tfs, sel_blocks, sel_weights,
 # ---------------------------------------------------------------------------
 
 NE_SLOTS = 8          # non-essential term slots (pad with len 0)
-CAND = 2048           # candidates patched per query
+CAND = 4096           # candidates patched per query
 
 
 def _essential_one(block_docids, block_tfs, flat_docids, flat_tfs,

@@ -161,6 +161,7 @@ class FastPathServer:
             "k1": idx.k1, "b": idx.b,
             "idf": None, "nb": None,
             "filter_live": {},   # filt tuple -> device (live AND filters)
+            "ess_bad": set(),    # query keys whose certificate failed
         }
         # per-term idf + block counts as vectors (per-cohort selection
         # assembly is vectorized numpy, no per-term Python)
@@ -360,7 +361,8 @@ class FastPathServer:
                     self.stats["bounced"] += 1
                     self.lib.es_fast_bounce(h, tok)
                 continue
-            ess = self._essential_split(reg, k, term_ids, filt)
+            ess = self._essential_split(reg, k, term_ids, filt,
+                                        nb_need)
             if ess is not None:
                 ess_by_bucket.setdefault(ess[0], []).append(
                     (tok, k, term_ids, filt, ess))
@@ -449,7 +451,8 @@ class FastPathServer:
         if chunk:
             yield chunk
 
-    def _essential_split(self, reg, k, term_ids, filt):
+    def _essential_split(self, reg, k, term_ids, filt,
+                         nb_full=None):
         """(ess_bucket, ess_terms, ne_terms, ne_bound, θ, total) when a
         cached θ licenses the essential lane for this exact query, else
         None. Term INSTANCES partition (duplicates keep their own
@@ -463,14 +466,20 @@ class FastPathServer:
         if hit is None:
             return None
         theta, total = hit
+        if key in reg["ess_bad"]:
+            # certificate already failed once for this query — the
+            # essential attempt + refire would only double the work
+            return None
         known = [t for t in term_ids if t >= 0]
         if len(known) < 2:
             return None
         maxc = reg["maxc"]
         inst = sorted(known, key=lambda t: float(maxc[t]))
-        # strict safety margin: docs outside every essential list score
-        # ≤ Σ maxc_ne < θ = the true kth
-        theta_safe = float(theta) * (1.0 - 1e-6)
+        # HALF of θ, not all of it: correctness only needs Σ maxc_ne < θ
+        # (docs outside every essential list can't reach the kth), but
+        # the CERTIFICATE needs ess_(C+1) + Σ maxc_ne < kth — leaving
+        # headroom makes certification succeed instead of refiring
+        theta_safe = float(theta) * 0.5
         ne: list = []
         bound = 0.0
         ess: list = []
@@ -486,6 +495,12 @@ class FastPathServer:
         if not ne:
             return None
         nb_ess = int(reg["nb"][ess].sum())
+        if nb_full is None:
+            nb_full = int(reg["nb"][known].sum())
+        if nb_ess * 2 > nb_full:
+            # under 2x sort reduction the lane's fixed costs (extra
+            # top-(C+1), patch pass, refire risk) outweigh the win
+            return None
         for bkt in self.ess_buckets:
             if nb_ess <= bkt:
                 return (bkt, ess, ne, bound, float(theta), int(total))
@@ -624,6 +639,9 @@ class FastPathServer:
             responded.add(tok)
         self.stats["fast_queries"] += len(items) - len(refire)
         if refire:
+            for tok, k, term_ids, filt, _essd in refire:
+                if len(reg["ess_bad"]) < 100_000:
+                    reg["ess_bad"].add((tuple(term_ids), filt, k))
             self._refire_full(reg, refire, t_arrive)
             for tok, *_ in refire:
                 responded.add(tok)

@@ -43,6 +43,10 @@ from elasticsearch_tpu.common.errors import ElasticsearchTpuException
 from elasticsearch_tpu.transport.wire import StreamInput, StreamOutput
 
 CURRENT_VERSION = 1
+# oldest wire version this build interoperates with (ref:
+# TransportHandshaker + Version.minimumCompatibilityVersion — a rolling
+# upgrade requires version N and N+1 nodes to form one cluster)
+MIN_COMPATIBLE_VERSION = 1
 # Frame marker (ref: TcpHeader 'E','S' marker bytes)
 MARKER = b"ET"
 
@@ -591,6 +595,7 @@ class TransportService:
         self.transport = transport
         self.local_node = transport.local_node
         self._connected: Dict[str, DiscoveryNode] = {}
+        self._peer_versions: Dict[str, int] = {}
         self._conn_lock = threading.Lock()
         self._interceptors = list(interceptors or [])
         self._connection_listeners: List[Callable[[DiscoveryNode, str], None]] = []
@@ -652,17 +657,35 @@ class TransportService:
             raise ConnectTransportException(
                 f"handshake with [{node.name}] failed: {result['exc']}")
         their_version = result["resp"].get("version", 0)
-        if their_version != CURRENT_VERSION:
+        # range check, not equality: peers at or above our minimum
+        # compatible version interoperate (each side enforces its own
+        # minimum — the newer node knows both formats)
+        if their_version < MIN_COMPATIBLE_VERSION:
             raise ConnectTransportException(
-                f"[{node.name}] incompatible version [{their_version}]")
+                f"[{node.name}] incompatible version [{their_version}] "
+                f"< minimum compatible [{MIN_COMPATIBLE_VERSION}]")
         with self._conn_lock:
             self._connected[node.node_id] = node
+            # record the NEGOTIATED version (min of both ends): a newer
+            # build keys any down-level serialization for this peer on
+            # it — without this, accepting older peers at handshake has
+            # no mechanism backing it (ref: TcpChannel's per-connection
+            # Version from TransportHandshaker)
+            self._peer_versions[node.node_id] = min(their_version,
+                                                    CURRENT_VERSION)
         for fn in self._connection_listeners:
             fn(node, "connected")
+
+    def negotiated_version(self, node_id: str) -> int:
+        """Wire version agreed with a connected peer (CURRENT_VERSION
+        when unknown)."""
+        with self._conn_lock:
+            return self._peer_versions.get(node_id, CURRENT_VERSION)
 
     def disconnect_from_node(self, node: DiscoveryNode) -> None:
         with self._conn_lock:
             removed = self._connected.pop(node.node_id, None)
+            self._peer_versions.pop(node.node_id, None)
         if removed is not None:
             self.transport.fail_pending_to(node.node_id, "disconnected")
             for fn in self._connection_listeners:

@@ -1,0 +1,49 @@
+"""analysis-cjk-morph plugin (ref: plugins/analysis-kuromoji/.../
+KuromojiAnalyzerProvider.java, analysis-nori, analysis-smartcn).
+Implementations live in elasticsearch_tpu.analysis.cjk; installing the
+plugin activates the registrations. The morphology is a DISCLOSED
+algorithmic approximation around compact bundled dictionaries (the
+reference's MeCab/mecab-ko-dic lattices are tens of MB)."""
+
+from elasticsearch_tpu.analysis.cjk import (
+    KuromojiTokenizer,
+    NoriTokenizer,
+    SmartcnTokenizer,
+)
+from elasticsearch_tpu.plugins import Plugin
+
+
+class _TokenizerAnalyzer:
+    def __init__(self, name, tokenizer):
+        self.name = name
+        self._tokenizer = tokenizer
+
+    def analyze(self, text):
+        return self._tokenizer.tokenize(text)
+
+    def terms(self, text):
+        return [t.term for t in self.analyze(text)]
+
+
+class ESPlugin(Plugin):
+    name = "analysis-cjk-morph"
+
+    def tokenizers(self):
+        return {
+            "kuromoji_tokenizer": lambda s: KuromojiTokenizer(),
+            "nori_tokenizer": lambda s: NoriTokenizer(),
+            "smartcn_tokenizer": lambda s: SmartcnTokenizer(),
+        }
+
+    def analyzers(self):
+        # prebuilt-analyzer factories take no settings (the named
+        # analyzer IS the configuration, like the reference's prebuilt
+        # kuromoji/nori/smartcn analyzers)
+        return {
+            "kuromoji": lambda: _TokenizerAnalyzer(
+                "kuromoji", KuromojiTokenizer()),
+            "nori": lambda: _TokenizerAnalyzer(
+                "nori", NoriTokenizer()),
+            "smartcn": lambda: _TokenizerAnalyzer(
+                "smartcn", SmartcnTokenizer()),
+        }

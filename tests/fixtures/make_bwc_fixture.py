@@ -1,0 +1,93 @@
+"""Generate the frozen BWC data-dir fixture (tests/fixtures/bwc_v1.tar.gz).
+
+Run ONCE per on-disk format generation and COMMIT the artifact — the
+point of tests/test_bwc.py is that data written by an OLD build keeps
+loading in every later build (ref: qa/full-cluster-restart). Regenerate
+only when introducing a new format generation (and keep the old
+tarball + a loader for it).
+
+    JAX_PLATFORMS=cpu PYTHONPATH=. python tests/fixtures/make_bwc_fixture.py
+"""
+
+import json
+import os
+import shutil
+import tarfile
+import tempfile
+
+from elasticsearch_tpu.node import Node
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "bwc_v1.tar.gz")
+
+
+def build(data_path: str) -> None:
+    node = Node(data_path=data_path)
+    c = node.rest_controller
+
+    def call(method, path, body=None, **params):
+        status, r = c.dispatch(method, path, params, body)
+        assert status in (200, 201), (status, r)
+        return r
+
+    call("PUT", "/library", {
+        "settings": {"number_of_shards": 1},
+        "mappings": {"properties": {
+            "title": {"type": "text"},
+            "year": {"type": "integer"},
+            "genre": {"type": "keyword"},
+        }}})
+    docs = [
+        ("1", "the quick brown fox", 1990, "fable"),
+        ("2", "lazy dogs sleep all day", 2001, "fable"),
+        ("3", "quick silver linings", 2015, "drama"),
+        ("4", "doomed to deletion", 1900, "drama"),
+        ("5", "brown bears fish quickly", 2020, "nature"),
+    ]
+    for did, title, year, genre in docs:
+        call("PUT", f"/library/_doc/{did}",
+             {"title": title, "year": year, "genre": genre})
+    call("POST", "/library/_refresh")
+    call("DELETE", "/library/_doc/4")
+    # flush → segments + commit point + rolled translog on disk
+    call("POST", "/library/_flush")
+    # ops AFTER the flush live only in the translog → replay on boot
+    call("PUT", "/library/_doc/6",
+         {"title": "translog replayed tale", "year": 2024,
+          "genre": "fable"})
+    call("PUT", "/library/_alias/books")
+    call("PUT", "/_scripts/bwc-boost", {"script": {
+        "lang": "painless", "source": "doc['year'].value / 1000.0"}})
+    call("PUT", "/_index_template/bwc-tpl", {
+        "index_patterns": ["bwc-*"],
+        "template": {"mappings": {"properties": {
+            "msg": {"type": "text"}}}}})
+    node.close()
+
+
+def main():
+    tmp = tempfile.mkdtemp()
+    data = os.path.join(tmp, "data")
+    try:
+        build(data)
+        with tarfile.open(OUT, "w:gz") as tar:
+            tar.add(data, arcname="data")
+        manifest = {
+            "segment_format_version": 1,
+            "docs": {"1": "the quick brown fox",
+                     "2": "lazy dogs sleep all day",
+                     "3": "quick silver linings",
+                     "5": "brown bears fish quickly",
+                     "6": "translog replayed tale"},
+            "deleted": ["4"],
+            "alias": "books",
+        }
+        with open(os.path.join(HERE, "bwc_v1.json"), "w") as fh:
+            json.dump(manifest, fh, indent=1)
+        print(f"wrote {OUT}")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()
