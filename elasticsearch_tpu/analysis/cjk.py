@@ -77,8 +77,8 @@ JA_WORDS = {
     "家族", "友達", "子供", "動物", "自然", "環境", "技術", "情報",
     "電話", "携帯", "計算", "機械", "自動車", "飛行機", "図書館",
     "病院", "銀行", "駅", "店", "国", "人", "山", "川", "海", "空",
-    "水", "火", "木", "金", "土", "月", "日", "年", "circ",
-} - {"circ"}
+    "水", "火", "木", "金", "土", "月", "日", "年",
+}
 
 # godan continuative (い-row) → dictionary form (う-row)
 _GODAN = {"き": "く", "ぎ": "ぐ", "し": "す", "ち": "つ", "に": "ぬ",
@@ -124,7 +124,7 @@ def _dict_match_run(run: str, start: int, pos0: int, words,
     n = len(run)
     while i < n:
         matched = None
-        for ln in range(min(6, n - i), 1, -1):
+        for ln in range(min(6, n - i), 0, -1):
             if run[i:i + ln] in words:
                 matched = run[i:i + ln]
                 break
@@ -138,7 +138,7 @@ def _dict_match_run(run: str, start: int, pos0: int, words,
         j = i + 1
         while j < n:
             hit = False
-            for ln in range(min(6, n - j), 1, -1):
+            for ln in range(min(6, n - j), 0, -1):
                 if run[j:j + ln] in words:
                     hit = True
                     break
@@ -218,7 +218,20 @@ class KuromojiTokenizer(Tokenizer):
                                 stem_tail = stem_tail[: -len(p)]
                                 changed = True
                                 break
-                    if stem_tail in ("し", "する", "すれ", "しよう"):
+                    verbal_tail = tail.startswith(
+                        ("まし", "ます", "ませ", "たい", "てい", "た",
+                         "て")) and not stem_tail
+                    if verbal_tail:
+                        # ichidan verb with a bare-kanji stem (見ました
+                        # → 見る): the aux attached directly to the
+                        # continuative stem, so dictionary form adds る
+                        if len(run) > 1:
+                            pos = _dict_match_run(run[:-1], i, pos,
+                                                  JA_WORDS, out, True)
+                        out.append(Token(run[-1] + "る", pos,
+                                         i + len(run) - 1, tail_j))
+                        pos += 1
+                    elif stem_tail in ("し", "する", "すれ", "しよう"):
                         # する-verb (勉強しています → 勉強 + する): the
                         # kanji run is a noun, する is its own verb
                         pos = _dict_match_run(run, i, pos, JA_WORDS,

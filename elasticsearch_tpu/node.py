@@ -248,7 +248,9 @@ class Node:
                         FastPathServer)
                     front.fastpath = FastPathServer(
                         self, front, nb_buckets=nb_buckets,
-                        n_streams=fast_streams, max_k=fast_max_k)
+                        n_streams=fast_streams, max_k=fast_max_k,
+                        q_batch=int(self.settings.get(
+                            "http.native.fast_q_batch", 32)))
                     front.fastpath.start()
                     if allow or deny:
                         front.set_ipfilter(allow, deny)
@@ -267,12 +269,25 @@ class Node:
                                     ssl_config=ssl_config,
                                     ip_filter=(allow, deny))
             self._http.start()
+        # SQL line protocol for external drivers/CLI (ref: the JDBC/CLI
+        # seam, x-pack/plugin/sql/jdbc + sql-cli) — opt-in via
+        # xpack.sql.port (0 = ephemeral)
+        sql_port = self.settings.get("xpack.sql.port")
+        if sql_port is not None:
+            from elasticsearch_tpu.xpack.sql_protocol import (
+                SqlProtocolServer)
+            self._sql_protocol = SqlProtocolServer(
+                self.sql_service, port=int(sql_port),
+                security_service=self.security_service)
         # sd_notify READY under systemd (ref: modules/systemd)
         from elasticsearch_tpu.common.systemd import notify_ready
         notify_ready()
         return self._http.port
 
     def stop(self):
+        if getattr(self, "_sql_protocol", None) is not None:
+            self._sql_protocol.close()
+            self._sql_protocol = None
         if self._http is not None:
             from elasticsearch_tpu.common.systemd import notify_stopping
             notify_stopping()

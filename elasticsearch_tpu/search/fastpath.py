@@ -42,12 +42,15 @@ Q_BATCH = 32      # cohort width (one compiled Q shape)
 class FastPathServer:
     def __init__(self, node, front, nb_buckets=(1024, 4096),
                  n_streams: int = 4, max_k: int = 1000,
-                 ess_buckets=(256, 1024)):
+                 ess_buckets=(256, 1024), q_batch: int = Q_BATCH):
         self.node = node
         self.front = front           # NativeHttpFront (owns the lib)
         self.lib = front.lib
         self.nb_buckets = tuple(sorted(nb_buckets))
         self.ess_buckets = tuple(sorted(ess_buckets))
+        # cohort width: one compiled Q shape; wider cohorts amortize the
+        # per-launch floor at the cost of compile time and p50
+        self.q_batch = int(q_batch)
         self.n_streams = n_streams
         self.max_k = max_k
         self._running = False
@@ -240,12 +243,12 @@ class FastPathServer:
         # cache the all-plain stack: the common no-filter cohort reuses
         # it instead of re-stacking 8 live columns per launch
         reg["plain_masks"] = masks
-        mask_ids = np.zeros(Q_BATCH, np.int32)
+        mask_ids = np.zeros(self.q_batch, np.int32)
         for nb in self.nb_buckets:
             if not self._running:
                 return
-            sel = np.full((Q_BATCH, nb), dp.zero_block, np.int32)
-            ws = np.zeros((Q_BATCH, nb), np.float32)
+            sel = np.full((self.q_batch, nb), dp.zero_block, np.int32)
+            ws = np.zeros((self.q_batch, nb), np.float32)
             t0 = time.time()
             bm25_topk_total_batch(
                 dp.block_docids, dp.block_tfs, sel, ws, dp.doc_lens,
@@ -258,16 +261,16 @@ class FastPathServer:
         for nb in self.ess_buckets:
             if not self._running:
                 return
-            sel = np.full((Q_BATCH, nb), dp.zero_block, np.int32)
-            ws = np.zeros((Q_BATCH, nb), np.float32)
+            sel = np.full((self.q_batch, nb), dp.zero_block, np.int32)
+            ws = np.zeros((self.q_batch, nb), np.float32)
             t0 = time.time()
             bm25_essential_topk_batch(
                 dp.block_docids, dp.block_tfs, reg["flat_docids"],
                 reg["flat_tfs"], sel, ws, dp.doc_lens, masks, mask_ids,
-                np.zeros((Q_BATCH, NE_SLOTS), np.int32),
-                np.zeros((Q_BATCH, NE_SLOTS), np.int32),
-                np.zeros((Q_BATCH, NE_SLOTS), np.float32),
-                np.zeros(Q_BATCH, np.float32),
+                np.zeros((self.q_batch, NE_SLOTS), np.int32),
+                np.zeros((self.q_batch, NE_SLOTS), np.int32),
+                np.zeros((self.q_batch, NE_SLOTS), np.float32),
+                np.zeros(self.q_batch, np.float32),
                 np.float32(dp.avg_len), reg["k1"], reg["b"],
                 self.max_k).block_until_ready()
             logger.info("fastpath warm essential NB=%d in %.1fs", nb,
@@ -276,7 +279,7 @@ class FastPathServer:
     # --------------------------------------------------------------- drain
     def _drain_loop(self):
         c = ctypes
-        max_n = 2 * Q_BATCH   # drain deep; launches chunk to Q_BATCH
+        max_n = 2 * self.q_batch   # drain deep; chunks to q_batch
         tokens = (c.c_uint64 * max_n)()
         gens = (c.c_int32 * max_n)()
         ks = (c.c_int32 * max_n)()
@@ -382,7 +385,8 @@ class FastPathServer:
         carry: list = []
         for bucket in sorted(by_bucket):
             cur = carry + by_bucket[bucket]
-            if len(cur) < Q_BATCH // 2 and bucket != self.nb_buckets[-1] \
+            if len(cur) < self.q_batch // 2 \
+                    and bucket != self.nb_buckets[-1] \
                     and any(b > bucket for b in by_bucket):
                 carry = cur
                 continue
@@ -428,8 +432,7 @@ class FastPathServer:
     # binary-search depth contract of the patch kernel (ops/fastpath)
     NE_MAX_LEN = 1 << 21
 
-    @staticmethod
-    def _chunk_by_slots(items):
+    def _chunk_by_slots(self, items):
         """Split a launch class into cohorts bounded by the cohort
         width (Q_BATCH) AND the mask-slot budget (≤ F_SLOTS-1 distinct
         filter sets per launch; row 0 is the plain live mask). Item
@@ -440,7 +443,7 @@ class FastPathServer:
         for item in items:
             f = item[3]
             nf = filts | ({f} if f else set())
-            if chunk and (len(chunk) >= Q_BATCH
+            if chunk and (len(chunk) >= self.q_batch
                           or len(nf) > F_SLOTS - 1):
                 yield chunk
                 chunk = []
@@ -554,13 +557,14 @@ class FastPathServer:
         from elasticsearch_tpu.ops.fastpath import (
             F_SLOTS, NE_SLOTS, bm25_essential_topk_batch)
         dp, dev = reg["dp"], reg["dev"]
-        sel = np.full((Q_BATCH, bucket), dp.zero_block, np.int32)
-        ws = np.zeros((Q_BATCH, bucket), np.float32)
-        mask_ids = np.zeros(Q_BATCH, np.int32)
-        ne_start = np.zeros((Q_BATCH, NE_SLOTS), np.int32)
-        ne_len = np.zeros((Q_BATCH, NE_SLOTS), np.int32)
-        ne_idf = np.zeros((Q_BATCH, NE_SLOTS), np.float32)
-        ne_bound = np.zeros(Q_BATCH, np.float32)
+        sel = np.full((self.q_batch, bucket), dp.zero_block,
+                      np.int32)
+        ws = np.zeros((self.q_batch, bucket), np.float32)
+        mask_ids = np.zeros(self.q_batch, np.int32)
+        ne_start = np.zeros((self.q_batch, NE_SLOTS), np.int32)
+        ne_len = np.zeros((self.q_batch, NE_SLOTS), np.int32)
+        ne_idf = np.zeros((self.q_batch, NE_SLOTS), np.float32)
+        ne_bound = np.zeros(self.q_batch, np.float32)
         starts, nbs, idf = reg["starts"], reg["nb"], reg["idf"]
         mask_rows = [dev.live]
         row_of: Dict[tuple, int] = {}
@@ -675,9 +679,10 @@ class FastPathServer:
                                                     bm25_topk_total_batch)
         dp, dev = reg["dp"], reg["dev"]
         q = len(items)
-        sel = np.full((Q_BATCH, bucket), dp.zero_block, np.int32)
-        ws = np.zeros((Q_BATCH, bucket), np.float32)
-        mask_ids = np.zeros(Q_BATCH, np.int32)
+        sel = np.full((self.q_batch, bucket), dp.zero_block,
+                      np.int32)
+        ws = np.zeros((self.q_batch, bucket), np.float32)
+        mask_ids = np.zeros(self.q_batch, np.int32)
         starts, nbs, idf = reg["starts"], reg["nb"], reg["idf"]
         mask_rows = [dev.live]            # row 0 = plain live
         row_of: Dict[tuple, int] = {}

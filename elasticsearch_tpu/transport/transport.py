@@ -345,7 +345,8 @@ class InProcessTransport(BaseTransport):
                 f"cannot connect to {node.name}: unknown node")
 
     def send(self, node: DiscoveryNode, request_id: int, action: str,
-             payload: Any, lane: str = LANE_REG) -> None:
+             payload: Any, lane: str = LANE_REG,
+             wire_version: int = CURRENT_VERSION) -> None:
         target = self._registry.get(node.node_id)
         if target is None or target._closed:
             raise NodeNotConnectedException(
@@ -529,11 +530,14 @@ class TcpTransport(BaseTransport):
         return entry
 
     def send(self, node: DiscoveryNode, request_id: int, action: str,
-             payload: Any, lane: str = LANE_REG) -> None:
+             payload: Any, lane: str = LANE_REG,
+             wire_version: int = CURRENT_VERSION) -> None:
         if isinstance(payload, dict):
             payload = dict(payload)
             payload["__source"] = self.local_node.to_dict()
-        frame = _encode_frame(request_id, STATUS_REQUEST, CURRENT_VERSION,
+        # frames to a peer are encoded at the NEGOTIATED version (today
+        # a single format exists; a future format change keys on this)
+        frame = _encode_frame(request_id, STATUS_REQUEST, wire_version,
                               action, payload)
         try:
             sock, write_lock = self._socket_for(node, lane)
@@ -733,7 +737,9 @@ class TransportService:
             return
         try:
             self.transport.send(node, request_id, action, request,
-                                lane=lane_for_action(action))
+                                lane=lane_for_action(action),
+                                wire_version=self.negotiated_version(
+                                    node.node_id))
         except BaseException as e:  # noqa: BLE001
             with self.transport._pending_lock:
                 ctx = self.transport._pending.pop(request_id, None)

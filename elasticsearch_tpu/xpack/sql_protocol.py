@@ -1,0 +1,262 @@
+"""SQL line protocol + interactive CLI (the JDBC/CLI seam).
+
+The reference ships JDBC/ODBC drivers and an interactive CLI speaking a
+binary protocol against the SQL plugin (ref: x-pack/plugin/sql/jdbc/,
+x-pack/plugin/sql/sql-cli/ — SqlQueryRequest over the HTTP binary
+content type). This module is that seam for external processes:
+
+- **wire**: length-prefixed JSON frames over TCP —
+  ``[u32 len][json]`` both directions. Requests:
+  ``{"query": "...", "fetch_size": N}`` or ``{"cursor": "..."}`` or
+  ``{"close": "<cursor>"}``; responses mirror the REST SQL payload
+  (columns/rows/cursor) or ``{"error": ...}``. Simple enough that any
+  driver (a JDBC shim included) can speak it from ~50 lines.
+- **server**: a thread-per-connection TCP listener bound on
+  ``xpack.sql.port`` next to the HTTP port, delegating to the same
+  SqlService (cursors included, so paging works across frames).
+- **client/CLI**: `python -m elasticsearch_tpu.xpack.sql_protocol
+  --port N [--execute SQL]` — an interactive REPL with aligned table
+  output and automatic cursor paging; `--execute` runs one statement
+  and exits (scripting mode).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 32 << 20
+
+
+def _send_frame(sock: socket.socket, payload: Dict[str, Any]) -> None:
+    body = json.dumps(payload).encode("utf-8")
+    sock.sendall(_LEN.pack(len(body)) + body)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:
+    head = b""
+    while len(head) < 4:
+        chunk = sock.recv(4 - len(head))
+        if not chunk:
+            return None
+        head += chunk
+    (ln,) = _LEN.unpack(head)
+    if ln > MAX_FRAME:
+        raise ValueError(f"frame too large ({ln})")
+    body = b""
+    while len(body) < ln:
+        chunk = sock.recv(min(65536, ln - len(body)))
+        if not chunk:
+            return None
+        body += chunk
+    return json.loads(body)
+
+
+class SqlProtocolServer:
+    """TCP front for SqlService — one thread per connection (driver
+    connections are few and long-lived, unlike search traffic).
+
+    Security: with x-pack security enabled, every connection must carry
+    ``username``/``password`` fields on its first frame (the JDBC
+    credential model); the realm chain authenticates and the SAME
+    privilege the REST /_sql route demands is enforced — the protocol
+    port is never an authz bypass."""
+
+    def __init__(self, sql_service, host: str = "127.0.0.1",
+                 port: int = 0, security_service=None):
+        self.sql = sql_service
+        self.security = security_service
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._closed = False
+        self._thread = threading.Thread(target=self._accept,
+                                        name="sql-protocol",
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def _accept(self):
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _authenticate(self, req):
+        """User for this connection (None when security is off)."""
+        import base64
+
+        from elasticsearch_tpu.xpack.security import required_privilege
+        creds = f"{req.pop('username', '')}:{req.pop('password', '')}"
+        headers = {"authorization": "Basic "
+                   + base64.b64encode(creds.encode()).decode()}
+        user = self.security.authenticate(headers)
+        kind, priv, index = required_privilege("POST", "/_sql")
+        if priv != "none":
+            self.security.authorize(user, kind, priv, index)
+        return user
+
+    def _serve(self, conn: socket.socket):
+        user = None
+        try:
+            while True:
+                req = _recv_frame(conn)
+                if req is None:
+                    return
+                try:
+                    if self.security is not None \
+                            and self.security.enabled:
+                        if user is None or "username" in req:
+                            user = self._authenticate(req)
+                    else:
+                        req.pop("username", None)
+                        req.pop("password", None)
+                    if "close" in req:
+                        ok = self.sql.close_cursor(req["close"])
+                        _send_frame(conn, {"succeeded": bool(ok)})
+                        continue
+                    resp = self.sql.query(req)
+                    _send_frame(conn, resp)
+                except Exception as e:  # noqa: BLE001 — wire errors back
+                    _send_frame(conn, {
+                        "error": {"type": type(e).__name__,
+                                  "reason": str(e)}})
+        except (OSError, ValueError):
+            pass
+        finally:
+            conn.close()
+
+
+# ------------------------------------------------------------------ client
+
+class SqlClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 timeout: float = 30.0, username: str = None,
+                 password: str = None):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout)
+        self._creds_pending = (
+            {"username": username, "password": password}
+            if username is not None else None)
+
+    def close(self):
+        self._sock.close()
+
+    def request(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        if self._creds_pending is not None:
+            payload = {**self._creds_pending, **payload}
+            self._creds_pending = None
+        _send_frame(self._sock, payload)
+        resp = _recv_frame(self._sock)
+        if resp is None:
+            raise ConnectionError("server closed the connection")
+        if "error" in resp:
+            raise RuntimeError(
+                f"{resp['error'].get('type')}: "
+                f"{resp['error'].get('reason')}")
+        return resp
+
+    def query(self, sql: str, fetch_size: int = 1000):
+        """Yields (columns, rows) pages, following cursors."""
+        resp = self.request({"query": sql, "fetch_size": fetch_size})
+        columns = resp.get("columns", [])
+        while True:
+            yield columns, resp.get("rows", [])
+            cursor = resp.get("cursor")
+            if not cursor:
+                return
+            resp = self.request({"cursor": cursor})
+
+
+def _render_table(columns, rows) -> str:
+    names = [c["name"] for c in columns]
+    cells = [[("" if v is None else str(v)) for v in row]
+             for row in rows]
+    widths = [max([len(n)] + [len(r[i]) for r in cells])
+              for i, n in enumerate(names)]
+    def line(vals):
+        return " | ".join(v.ljust(w) for v, w in zip(vals, widths))
+    out = [line(names), "-+-".join("-" * w for w in widths)]
+    out += [line(r) for r in cells]
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys
+
+    ap = argparse.ArgumentParser(
+        prog="estpu-sql",
+        description="Interactive SQL CLI over the line protocol "
+                    "(ref: x-pack sql-cli)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--fetch-size", type=int, default=1000)
+    ap.add_argument("--user", "-u")
+    ap.add_argument("--password", "-p")
+    ap.add_argument("--execute", "-e",
+                    help="run one statement and exit")
+    args = ap.parse_args(argv)
+
+    client = SqlClient(args.host, args.port, username=args.user,
+                       password=args.password)
+
+    def run_one(sql: str) -> int:
+        total = 0
+        try:
+            first = True
+            for columns, rows in client.query(sql, args.fetch_size):
+                if first and columns:
+                    print(_render_table(columns, rows))
+                    first = False
+                elif rows:
+                    print(_render_table(columns, rows).split("\n", 2)[2])
+                total += len(rows)
+        except RuntimeError as e:
+            print(f"ERROR: {e}", file=sys.stderr)
+            return 1
+        print(f"({total} rows)")
+        return 0
+
+    try:
+        if args.execute:
+            return run_one(args.execute)
+        print("estpu-sql — interactive SQL (end statements with ';', "
+              "'exit;' quits)")
+        buf = ""
+        while True:
+            try:
+                line = input("sql> " if not buf else "   > ")
+            except EOFError:
+                break
+            buf += (" " if buf else "") + line.strip()
+            if not buf.endswith(";"):
+                continue
+            stmt = buf[:-1].strip()
+            buf = ""
+            if stmt.lower() in ("exit", "quit"):
+                break
+            if stmt:
+                run_one(stmt)
+        return 0
+    finally:
+        client.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -5,24 +5,13 @@ plugin activates the registrations. The morphology is a DISCLOSED
 algorithmic approximation around compact bundled dictionaries (the
 reference's MeCab/mecab-ko-dic lattices are tens of MB)."""
 
+from elasticsearch_tpu.analysis.analyzers import CustomAnalyzer
 from elasticsearch_tpu.analysis.cjk import (
     KuromojiTokenizer,
     NoriTokenizer,
     SmartcnTokenizer,
 )
 from elasticsearch_tpu.plugins import Plugin
-
-
-class _TokenizerAnalyzer:
-    def __init__(self, name, tokenizer):
-        self.name = name
-        self._tokenizer = tokenizer
-
-    def analyze(self, text):
-        return self._tokenizer.tokenize(text)
-
-    def terms(self, text):
-        return [t.term for t in self.analyze(text)]
 
 
 class ESPlugin(Plugin):
@@ -40,10 +29,10 @@ class ESPlugin(Plugin):
         # analyzer IS the configuration, like the reference's prebuilt
         # kuromoji/nori/smartcn analyzers)
         return {
-            "kuromoji": lambda: _TokenizerAnalyzer(
+            "kuromoji": lambda: CustomAnalyzer(
                 "kuromoji", KuromojiTokenizer()),
-            "nori": lambda: _TokenizerAnalyzer(
+            "nori": lambda: CustomAnalyzer(
                 "nori", NoriTokenizer()),
-            "smartcn": lambda: _TokenizerAnalyzer(
+            "smartcn": lambda: CustomAnalyzer(
                 "smartcn", SmartcnTokenizer()),
         }
