@@ -39,7 +39,7 @@ AVG_LEN = 40
 N_QUERIES = int(os.environ.get("BENCH_QUERIES", 256))
 K = 1000
 K1, B = 1.2, 0.75
-CLIENTS = int(os.environ.get("BENCH_CLIENTS", 384))
+CLIENTS = int(os.environ.get("BENCH_CLIENTS", 192))
 
 
 def log(*args):
@@ -473,7 +473,7 @@ def build_rest_node(corpus, tmpdir):
             "fast_nb_buckets": os.environ.get("BENCH_FAST_BUCKETS",
                                               "1024,2048,4096"),
             "fast_streams": int(os.environ.get("BENCH_FAST_STREAMS", 6)),
-            "fast_q_batch": int(os.environ.get("BENCH_FAST_QBATCH", 64)),
+            "fast_q_batch": int(os.environ.get("BENCH_FAST_QBATCH", 32)),
             "fast_max_k": K}},
     }), data_path=os.path.join(tmpdir, "node"))
     status, _ = node.rest_controller.dispatch(
