@@ -46,6 +46,120 @@ def _round_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
 
+class _CompositePostings:
+    """A shard's postings for one field, across MULTIPLE segments,
+    presented as one block layout: block arrays concatenate (docids
+    offset by each segment's doc base — padding entries keep tf=0, so
+    every kernel's tf>0 guard ignores their shifted docids), term
+    lookups return one RANGE PER SUB-SEGMENT."""
+
+    def __init__(self, pfs: List, doc_bases: List[int],
+                 n_docs_total: int):
+        present = [(j, pf) for j, pf in enumerate(pfs) if pf is not None]
+        self._block_offsets = {}
+        docids, tfs = [], []
+        off = 0
+        for j, pf in present:
+            docids.append(pf.block_docids + np.int32(doc_bases[j]))
+            tfs.append(pf.block_tfs)
+            self._block_offsets[j] = off
+            off += pf.block_docids.shape[0]
+        self.block_docids = (np.concatenate(docids) if docids
+                             else np.zeros((0, BLOCK_SIZE), np.int32))
+        self.block_tfs = (np.concatenate(tfs) if tfs
+                          else np.zeros((0, BLOCK_SIZE), np.float32))
+        lens = np.ones(n_docs_total, np.float32)
+        sum_ttf = 0
+        doc_count = 0
+        for j, pf in present:
+            nd = len(pf.field_lengths)
+            lens[doc_bases[j]: doc_bases[j] + nd] = pf.field_lengths
+            sum_ttf += pf.sum_total_term_freq
+            doc_count += pf.doc_count
+        self.field_lengths = lens
+        self.avg_field_length = sum_ttf / max(1, doc_count)
+        self._pfs = present
+
+    def term_id(self, term: str) -> int:
+        # 0/-1 presence flag: block ranges come from term_block_ranges
+        for _j, pf in self._pfs:
+            if pf.term_id(term) >= 0:
+                return 0
+        return -1
+
+    def term_block_ranges(self, term: str) -> List[Tuple[int, int]]:
+        out = []
+        for j, pf in self._pfs:
+            tid = pf.term_id(term)
+            if tid >= 0:
+                out.append((self._block_offsets[j]
+                            + int(pf.term_block_start[tid]),
+                            int(pf.term_block_count[tid])))
+        return out
+
+
+class _CompositeShard:
+    """Multiple segments of one shard presented as a single
+    segment-like object for the mesh corpus (the per-device analogue of
+    stacking a shard's segments into one resident layout; ref:
+    TransportSearchAction fans out per shard, not per segment)."""
+
+    def __init__(self, segments: List[Segment]):
+        self.sub_segments = segments
+        self.name = "+".join(seg.name for seg in segments)
+        self.doc_bases = []
+        total = 0
+        for seg in segments:
+            self.doc_bases.append(total)
+            total += seg.n_docs
+        self.n_docs = total
+        self.postings = _CompositePostingsMap(self)
+
+    @property
+    def live(self) -> np.ndarray:
+        return np.concatenate([seg.live for seg in self.sub_segments]) \
+            if self.sub_segments else np.zeros(0, bool)
+
+    @property
+    def live_version(self):
+        return tuple(seg.live_version for seg in self.sub_segments)
+
+    def locate(self, docid: int) -> Tuple[int, int]:
+        """composite docid → (segment_idx, local_docid)."""
+        import bisect
+        j = bisect.bisect_right(self.doc_bases, docid) - 1
+        return j, docid - self.doc_bases[j]
+
+
+class _CompositePostingsMap:
+    def __init__(self, shard: _CompositeShard):
+        self._shard = shard
+        self._cache: Dict[str, Optional[_CompositePostings]] = {}
+
+    def get(self, name: str):
+        if name not in self._cache:
+            pfs = [seg.postings.get(name)
+                   for seg in self._shard.sub_segments]
+            self._cache[name] = (
+                _CompositePostings(pfs, self._shard.doc_bases,
+                                   self._shard.n_docs)
+                if any(pf is not None for pf in pfs) else None)
+        return self._cache[name]
+
+
+def _term_ranges(pf, term: str) -> List[Tuple[int, int]]:
+    """Block ranges for a term — one per sub-segment on composites,
+    a single contiguous range on plain PostingsFields."""
+    ranges = getattr(pf, "term_block_ranges", None)
+    if ranges is not None:
+        return ranges(term)
+    tid = pf.term_id(term)
+    if tid < 0:
+        return []
+    return [(int(pf.term_block_start[tid]),
+             int(pf.term_block_count[tid]))]
+
+
 class MeshFieldState:
     """One field's postings stacked over shards, device-sharded."""
 
@@ -163,16 +277,12 @@ def bind_mesh(corpus: MeshCorpus, plans: List[LogicalPlan]):
                     for t in g.terms:
                         if t.field != fname:
                             continue
-                        tid = pf.term_id(t.term)
-                        if tid < 0:
-                            continue
-                        start = int(pf.term_block_start[tid])
-                        count = int(pf.term_block_count[tid])
-                        ids.extend(range(start, start + count))
-                        grps.extend([gi] * count)
-                        subs.extend([t.sub] * count)
-                        ws.extend([t.weight] * count)
-                        consts.extend([t.const] * count)
+                        for start, count in _term_ranges(pf, t.term):
+                            ids.extend(range(start, start + count))
+                            grps.extend([gi] * count)
+                            subs.extend([t.sub] * count)
+                            ws.extend([t.weight] * count)
+                            consts.extend([t.const] * count)
             shard_sels.append((ids, grps, subs, ws, consts))
         per_field_sel[fname] = shard_sels
 
@@ -312,7 +422,7 @@ class MeshSearchExecutor:
             return None   # size:0 — per-shard path keeps max_score semantics
         if n_shards < 2 or self.available_devices() < n_shards:
             return None
-        if any(len(s.segments) != 1 for s in searchers):
+        if any(len(s.segments) == 0 for s in searchers):
             return None
         # probe shard 0 first: ineligible queries (dense factors, scripts,
         # sorts…) bail after ONE compile instead of S
@@ -325,8 +435,10 @@ class MeshSearchExecutor:
             plans.append(compile_plan(rq, s))
         if not plans_mesh_compatible(plans):
             return None
-        corpus = self.corpus_for(index_name,
-                                 [s.segments[0] for s in searchers])
+        shard_views = [s.segments[0] if len(s.segments) == 1
+                       else _CompositeShard(list(s.segments))
+                       for s in searchers]
+        corpus = self.corpus_for(index_name, shard_views)
         bound = bind_mesh(corpus, plans)
         if bound is None:
             self.mesh_searches += 1
@@ -342,6 +454,15 @@ class MeshSearchExecutor:
         vals, gids, total = plan_ops.unpack_result(np.asarray(packed),
                                                    int(k))
         nd = corpus.n_docs_padded
-        docs = [(int(g) // nd, int(g) % nd, float(v))
-                for v, g in zip(vals, gids) if v > -np.inf]
+        docs = []
+        for v, g in zip(vals, gids):
+            if v <= -np.inf:
+                continue
+            shard, docid = int(g) // nd, int(g) % nd
+            view = corpus.segments[shard]
+            if isinstance(view, _CompositeShard):
+                seg_idx, docid = view.locate(docid)
+            else:
+                seg_idx = 0
+            docs.append((shard, seg_idx, docid, float(v)))
         return docs, int(total)

@@ -740,9 +740,9 @@ class SearchService:
             mesh_index = searchers[0][0]
             merged = [
                 (score, shard_idx,
-                 DocAddress(0, docid, score, (), sort_key=score),
+                 DocAddress(seg_idx, docid, score, (), sort_key=score),
                  mesh_index, searchers[shard_idx][1])
-                for shard_idx, docid, score in mesh_docs]
+                for shard_idx, seg_idx, docid, score in mesh_docs]
             total = mesh_total if track_total else 0
             max_score = merged[0][0] if merged else None
 

@@ -132,3 +132,51 @@ def test_mesh_missing_terms(node):
     r = search(node, "mz", {"match": {"title": "zzznope"}})
     assert r["hits"]["hits"] == []
     assert r["hits"]["total"]["value"] == 0
+
+
+def test_mesh_multi_segment_shards(node):
+    """Shards with MULTIPLE segments (no force merge) now ride the mesh
+    via composite per-shard residency (VERDICT r2 item 7) — results
+    stay identical to the per-shard loop, and hits resolve to the right
+    segment-local docs (fetch returns the right _source)."""
+    rng = np.random.default_rng(9)
+    do(node, "PUT", "/ms", body={
+        "settings": {"index": {"number_of_shards": 4}},
+        "mappings": {"properties": {"title": {"type": "text"},
+                                    "views": {"type": "long"}}}})
+    # three refresh generations → multiple segments per shard
+    did = 0
+    for _gen in range(3):
+        for _ in range(40):
+            do(node, "PUT", f"/ms/_doc/{did}",
+               body={"title": " ".join(rng.choice(
+                   VOCAB, rng.integers(2, 10))),
+                   "views": did}, expect=201)
+            did += 1
+        do(node, "POST", "/ms/_refresh")
+    svc = node.search_service
+    searchers = node.indices_service.get("ms").shard_searchers()
+    assert any(len(s.segments) > 1 for s in searchers), \
+        "fixture must produce multi-segment shards"
+    for q in QUERIES[:2] + [QUERIES[3]]:
+        before = svc.mesh_executor.mesh_searches
+        r_mesh = search(node, "ms", q)
+        assert svc.mesh_executor.mesh_searches == before + 1, q
+        ex, svc.mesh_executor = svc.mesh_executor, _Disabled()
+        try:
+            r_loop = search(node, "ms", q)
+        finally:
+            svc.mesh_executor = ex
+        # composite residency sums a doc's contributions in a
+        # different lax.sort tie order than the per-segment loop, so
+        # exact-tied ranks may swap — compare rank-wise scores and the
+        # (id, score) sets instead of strict sequence
+        mesh_hits = sorted((round(h["_score"], 4), h["_id"])
+                           for h in r_mesh["hits"]["hits"])
+        loop_hits = sorted((round(h["_score"], 4), h["_id"])
+                           for h in r_loop["hits"]["hits"])
+        assert mesh_hits == loop_hits, q
+        assert r_mesh["hits"]["total"] == r_loop["hits"]["total"], q
+        # fetch resolves composite docids to the right segment-local doc
+        for h in r_mesh["hits"]["hits"]:
+            assert h["_source"]["views"] == int(h["_id"])
