@@ -1,0 +1,80 @@
+"""Node launcher: ``python -m elasticsearch_tpu`` (ref: the
+distribution's bin/elasticsearch → Bootstrap.init — parse -E settings,
+run bootstrap checks, start the node, serve until SIGTERM/SIGINT).
+
+    python -m elasticsearch_tpu --data /var/lib/estpu -E http.port=9200 \
+        -E cluster.name=prod
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="elasticsearch-tpu",
+        description="Start a node (the bin/elasticsearch analogue)")
+    ap.add_argument("--data", default="data", help="data path")
+    ap.add_argument("-E", action="append", default=[], metavar="K=V",
+                    help="setting override (repeatable)")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.WARNING if args.quiet else logging.INFO,
+        format="[%(asctime)s][%(levelname)s][%(name)s] %(message)s")
+    log = logging.getLogger("elasticsearch_tpu.launcher")
+
+    flat = {}
+    for kv in args.E:
+        key, sep, value = kv.partition("=")
+        if not sep:
+            ap.error(f"-E expects key=value, got [{kv}]")
+        if value.lower() in ("true", "false"):
+            value = value.lower() == "true"
+        else:
+            try:
+                value = int(value)
+            except ValueError:
+                pass
+        flat[key] = value
+
+    from elasticsearch_tpu.common.bootstrap import (BootstrapCheckFailure,
+                                                    run_bootstrap_checks)
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.node import Node
+
+    settings = Settings(flat)
+    bind_host = str(settings.get("http.host", "127.0.0.1"))
+    try:
+        run_bootstrap_checks(settings, bind_host)
+    except BootstrapCheckFailure as e:
+        log.error("%s", e)
+        return 78          # EX_CONFIG, like the reference's exit path
+
+    node = Node(settings=settings, data_path=args.data)
+    port = node.start(int(settings.get("http.port", 9200)))
+    log.info("node [%s] started, HTTP on %s:%d", node.name, bind_host,
+             port)
+    print(f"started node={node.name} port={port}", flush=True)
+
+    stop = threading.Event()
+
+    def _term(_sig, _frm):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    stop.wait()
+    log.info("stopping node [%s]", node.name)
+    node.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,10 +67,90 @@ def resolve_seed_hosts(config_dir: Optional[str] = None,
     SeedHostsResolver merging provider results)."""
     out: List[DiscoveryNode] = []
     seen = set()
+    plugin_seeds: List[DiscoveryNode] = []
+    for provider in PLUGIN_SEED_PROVIDERS.values():
+        try:
+            plugin_seeds.extend(provider(settings))
+        except Exception:
+            # a broken cloud provider never blocks the others
+            continue
     for node in (settings_seed_hosts(settings)
-                 + (file_seed_hosts(config_dir) if config_dir else [])):
+                 + (file_seed_hosts(config_dir) if config_dir else [])
+                 + plugin_seeds):
         key = (node.host, node.port)
         if key not in seen:
             seen.add(key)
             out.append(node)
+    return out
+
+
+# cloud seed providers contributed by plugins (ref: the DiscoveryPlugin
+# getSeedHostProviders SPI — discovery-ec2 registers "ec2" here)
+PLUGIN_SEED_PROVIDERS = {}
+
+
+def ec2_seed_hosts(settings) -> List[DiscoveryNode]:
+    """EC2 DescribeInstances seed provider (ref: plugins/discovery-ec2/
+    .../AwsEc2SeedHostsProvider.java — running instances matching the
+    configured tag filters become transport seed addresses).
+
+    Speaks the real EC2 Query API shape (Action=DescribeInstances with
+    Filter.N.Name/Filter.N.Value.1 params, SigV4-signed) against
+    ``discovery.ec2.endpoint`` — in production the regional AWS
+    endpoint, in tests an in-process fixture that verifies the signed
+    request. ``discovery.ec2.host_type`` picks private_ip (default) or
+    public_ip; ``discovery.ec2.tag.<name>`` adds tag filters."""
+    endpoint = settings.get("discovery.ec2.endpoint") if settings else None
+    if not endpoint:
+        return []
+    import urllib.request
+    import urllib.parse as _up
+    import xml.etree.ElementTree as ET
+
+    from elasticsearch_tpu.repositories.cloud import _sigv4_headers
+
+    params = [("Action", "DescribeInstances"), ("Version", "2016-11-15"),
+              ("Filter.1.Name", "instance-state-name"),
+              ("Filter.1.Value.1", "running")]
+    fi = 2
+    flat = settings.as_dict() if hasattr(settings, "as_dict") else {}
+    for key in sorted(k for k in flat
+                      if k.startswith("discovery.ec2.tag.")):
+        tag = key[len("discovery.ec2.tag."):]
+        params.append((f"Filter.{fi}.Name", f"tag:{tag}"))
+        params.append((f"Filter.{fi}.Value.1", str(flat[key])))
+        fi += 1
+    body = _up.urlencode(params).encode()
+    headers = _sigv4_headers(
+        "POST", endpoint, body,
+        str(settings.get("discovery.ec2.access_key", "")),
+        str(settings.get("discovery.ec2.secret_key", "")),
+        region=str(settings.get("discovery.ec2.region", "us-east-1")),
+        service="ec2")
+    headers["Content-Type"] = "application/x-www-form-urlencoded"
+    req = urllib.request.Request(endpoint, data=body, method="POST",
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            xml = resp.read()
+    except OSError:
+        return []   # unreachable endpoint: no seeds (never a crash)
+    host_type = str(settings.get("discovery.ec2.host_type",
+                                 "private_ip"))
+    tag_name = ("privateIpAddress" if host_type == "private_ip"
+                else "ipAddress")
+    port = int(settings.get("discovery.ec2.port", 9300))
+    out: List[DiscoveryNode] = []
+    try:
+        root = ET.fromstring(xml)
+    except ET.ParseError:
+        return []
+    ns = root.tag.partition("}")[0] + "}" if root.tag.startswith("{") \
+        else ""
+    for item in root.iter(f"{ns}{tag_name}"):
+        ip = (item.text or "").strip()
+        if ip:
+            out.append(DiscoveryNode(
+                node_id=f"seed-{ip}-{port}", name=f"{ip}:{port}",
+                host=ip, port=port))
     return out

@@ -1,0 +1,142 @@
+"""Bootstrap checks + production-mode enforcement (ref:
+bootstrap/BootstrapChecks.java — checks run at startup; binding to a
+non-loopback address flips DEVELOPMENT warnings into HARD failures).
+
+Each check returns an error string or None; `run_bootstrap_checks`
+collects failures and either raises (production: the node would be
+reachable by other hosts, so misconfiguration is fatal, ref:
+BootstrapChecks.check:124) or logs warnings (development)."""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, List, Optional
+
+logger = logging.getLogger("elasticsearch_tpu.bootstrap")
+
+MIN_FILE_DESCRIPTORS = 65535
+MIN_MAX_MAP_COUNT = 262144
+MIN_THREADS = 4096
+
+
+def file_descriptor_check() -> Optional[str]:
+    """ref: BootstrapChecks.FileDescriptorCheck — Lucene-style engines
+    hold many segment files + sockets."""
+    try:
+        import resource
+        soft, _hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    except (ImportError, OSError):
+        return None
+    if soft != resource.RLIM_INFINITY and soft < MIN_FILE_DESCRIPTORS:
+        return (f"max file descriptors [{soft}] is too low, increase "
+                f"to at least [{MIN_FILE_DESCRIPTORS}]")
+    return None
+
+
+def max_threads_check() -> Optional[str]:
+    """ref: BootstrapChecks.MaxNumberOfThreadsCheck."""
+    try:
+        import resource
+        soft, _hard = resource.getrlimit(resource.RLIMIT_NPROC)
+    except (ImportError, OSError, AttributeError):
+        return None
+    if soft != resource.RLIM_INFINITY and soft < MIN_THREADS:
+        return (f"max number of threads [{soft}] is too low, increase "
+                f"to at least [{MIN_THREADS}]")
+    return None
+
+
+def virtual_memory_check() -> Optional[str]:
+    """ref: BootstrapChecks.MaxSizeVirtualMemoryCheck — device-array
+    uploads and mmapped stores need unlimited address space."""
+    try:
+        import resource
+        soft, _hard = resource.getrlimit(resource.RLIMIT_AS)
+    except (ImportError, OSError, AttributeError):
+        return None
+    if soft != resource.RLIM_INFINITY:
+        return (f"max size virtual memory [{soft}] is not unlimited; "
+                f"set it to unlimited")
+    return None
+
+
+def max_map_count_check() -> Optional[str]:
+    """ref: BootstrapChecks.MaxMapCountCheck (vm.max_map_count)."""
+    path = "/proc/sys/vm/max_map_count"
+    try:
+        with open(path) as fh:
+            value = int(fh.read().strip())
+    except (OSError, ValueError):
+        return None
+    if value < MIN_MAX_MAP_COUNT:
+        return (f"max virtual memory areas vm.max_map_count [{value}] "
+                f"is too low, increase to at least "
+                f"[{MIN_MAX_MAP_COUNT}]")
+    return None
+
+
+def root_user_check() -> Optional[str]:
+    """ref: the reference REFUSES to run as root in production
+    (Bootstrap 'can not run elasticsearch as root')."""
+    try:
+        if os.geteuid() == 0:
+            return "can not run as the root user in production"
+    except AttributeError:
+        pass
+    return None
+
+
+def discovery_configuration_check(settings) -> Optional[str]:
+    """ref: BootstrapChecks.DiscoveryConfiguredCheck — a production
+    node must be told how to find or form its cluster."""
+    if settings is None:
+        return "discovery is not configured"
+    keys = ("discovery.seed_hosts", "cluster.initial_master_nodes",
+            "discovery.type")
+    if any(settings.get(k) for k in keys):
+        return None
+    from elasticsearch_tpu.cluster.discovery import PLUGIN_SEED_PROVIDERS
+    if PLUGIN_SEED_PROVIDERS:
+        return None
+    return ("the default discovery settings are unsuitable for "
+            "production use; at least one of [discovery.seed_hosts, "
+            "cluster.initial_master_nodes] must be configured")
+
+
+ALL_CHECKS: List[Callable] = [
+    file_descriptor_check, max_threads_check, virtual_memory_check,
+    max_map_count_check, root_user_check,
+]
+
+
+class BootstrapCheckFailure(RuntimeError):
+    pass
+
+
+def is_production(bind_host: str) -> bool:
+    """Non-loopback binding ⇒ other hosts can reach this node ⇒
+    production enforcement (ref: BootstrapChecks.enforceLimits)."""
+    return bind_host not in ("127.0.0.1", "::1", "localhost", "")
+
+
+def run_bootstrap_checks(settings=None, bind_host: str = "127.0.0.1",
+                         enforce: Optional[bool] = None) -> List[str]:
+    """Run all checks; returns the failure list. Raises
+    BootstrapCheckFailure in production mode (explicit ``enforce``
+    overrides the bind-host heuristic)."""
+    failures = [msg for check in ALL_CHECKS
+                if (msg := check()) is not None]
+    msg = discovery_configuration_check(settings)
+    if msg is not None:
+        failures.append(msg)
+    production = enforce if enforce is not None else \
+        is_production(bind_host)
+    if failures:
+        if production:
+            raise BootstrapCheckFailure(
+                "bootstrap checks failed\n" + "\n".join(
+                    f"[{i + 1}]: {m}" for i, m in enumerate(failures)))
+        for m in failures:
+            logger.warning("bootstrap check (development mode): %s", m)
+    return failures

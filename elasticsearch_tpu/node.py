@@ -206,6 +206,12 @@ class Node:
     def start(self, port: Optional[int] = None) -> int:
         """Bind HTTP; returns the bound port (0 → ephemeral)."""
         http_port = port if port is not None else HTTP_PORT_SETTING.get(self.settings)
+        # bootstrap checks: loopback binds warn, non-loopback binds
+        # enforce (ref: BootstrapChecks.check at Bootstrap.init)
+        from elasticsearch_tpu.common.bootstrap import run_bootstrap_checks
+        run_bootstrap_checks(self.settings,
+                             str(self.settings.get("http.host",
+                                                   "127.0.0.1")))
         ssl_config = None
         if self.settings.get("xpack.security.http.ssl.enabled"):
             # ref: xpack.security.http.ssl.* settings

@@ -99,6 +99,9 @@ def apply_plugin(plugin: Plugin) -> None:
     the moment the reference performs via registry builders during
     Node construction (ref: SearchModule/AnalysisModule/IngestService
     constructors consuming plugin lists)."""
+    on_load = getattr(plugin, "on_load", None)
+    if on_load is not None:
+        on_load()
     from elasticsearch_tpu.search import queries as q
     for qtype, parser in plugin.queries().items():
         q._PARSERS[qtype] = parser

@@ -1,0 +1,13 @@
+"""discovery-ec2 plugin (ref: plugins/discovery-ec2/.../
+AwsEc2SeedHostsProvider.java). Installing registers the "ec2" seed
+provider; it activates when discovery.ec2.endpoint is configured."""
+
+from elasticsearch_tpu.cluster import discovery
+from elasticsearch_tpu.plugins import Plugin
+
+
+class ESPlugin(Plugin):
+    name = "discovery-ec2"
+
+    def on_load(self):
+        discovery.PLUGIN_SEED_PROVIDERS["ec2"] = discovery.ec2_seed_hosts

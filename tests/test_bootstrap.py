@@ -1,0 +1,87 @@
+"""Bootstrap checks + launcher (ref: bootstrap/BootstrapChecks.java,
+Bootstrap.init): development mode warns, production mode (non-loopback
+bind) fails hard; the `python -m elasticsearch_tpu` launcher starts a
+node in an EXTERNAL process, serves HTTP, and stops cleanly on
+SIGTERM."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.common import bootstrap
+from elasticsearch_tpu.common.settings import Settings
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_development_mode_warns_not_raises():
+    # this environment is root with low limits: failures exist, but a
+    # loopback bind only warns (ref: enforceLimits on non-loopback)
+    failures = bootstrap.run_bootstrap_checks(
+        Settings.EMPTY, bind_host="127.0.0.1")
+    assert isinstance(failures, list)
+
+
+def test_production_mode_enforces():
+    settings = Settings.from_dict({"discovery": {"seed_hosts": "a:9300"}})
+    checks_fail = bool(bootstrap.run_bootstrap_checks(
+        settings, bind_host="127.0.0.1"))
+    if not checks_fail:
+        pytest.skip("environment satisfies every limit check")
+    with pytest.raises(bootstrap.BootstrapCheckFailure,
+                       match=r"bootstrap checks failed"):
+        bootstrap.run_bootstrap_checks(settings, bind_host="0.0.0.0")
+
+
+def test_discovery_configuration_check():
+    msg = bootstrap.discovery_configuration_check(Settings.EMPTY)
+    assert "discovery.seed_hosts" in msg
+    ok = bootstrap.discovery_configuration_check(
+        Settings.from_dict({"discovery": {"seed_hosts": "h:9300"}}))
+    assert ok is None
+    ok2 = bootstrap.discovery_configuration_check(
+        Settings.from_dict({"cluster":
+                            {"initial_master_nodes": ["n1"]}}))
+    assert ok2 is None
+
+
+def test_launcher_external_process(tmp_path):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "elasticsearch_tpu",
+         "--data", str(tmp_path / "data"), "--quiet",
+         "-E", "http.port=0", "-E", "http.native=false"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT,
+             "JAX_PLATFORMS": "cpu"})
+    try:
+        # first import of jax in the child can take a while under a
+        # loaded machine — wait for the startup line with a deadline
+        import select
+        deadline = time.time() + 180
+        line = ""
+        while time.time() < deadline:
+            r, _, _ = select.select([proc.stdout], [], [], 5.0)
+            if r:
+                line = proc.stdout.readline()
+                break
+            if proc.poll() is not None:
+                break
+        assert line.startswith("started node="), (
+            line, proc.poll(), proc.stderr.read() if proc.poll()
+            is not None else "")
+        port = int(line.rsplit("port=", 1)[1])
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=30) as resp:
+            root = json.loads(resp.read())
+        assert root["tagline"]
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()

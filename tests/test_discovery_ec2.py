@@ -1,0 +1,113 @@
+"""discovery-ec2 seed provider (ref: plugins/discovery-ec2/.../
+AwsEc2SeedHostsProvider.java) against an in-process DescribeInstances
+fixture that verifies the SigV4-signed Query-API request shape."""
+
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from urllib.parse import parse_qsl
+
+import pytest
+
+from elasticsearch_tpu.cluster import discovery
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.plugins import main as plugin_cli
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DESCRIBE_XML = """<?xml version="1.0" encoding="UTF-8"?>
+<DescribeInstancesResponse xmlns="http://ec2.amazonaws.com/doc/2016-11-15/">
+ <reservationSet><item><instancesSet>
+  <item>
+   <instanceId>i-0001</instanceId>
+   <privateIpAddress>10.0.0.11</privateIpAddress>
+   <ipAddress>54.1.2.3</ipAddress>
+  </item>
+  <item>
+   <instanceId>i-0002</instanceId>
+   <privateIpAddress>10.0.0.12</privateIpAddress>
+   <ipAddress>54.1.2.4</ipAddress>
+  </item>
+ </instancesSet></item></reservationSet>
+</DescribeInstancesResponse>"""
+
+
+class _Ec2Fixture(BaseHTTPRequestHandler):
+    requests = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        ln = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(ln).decode()
+        _Ec2Fixture.requests.append(
+            (dict(parse_qsl(body)), dict(self.headers)))
+        data = DESCRIBE_XML.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/xml")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+@pytest.fixture()
+def ec2(tmp_path):
+    srv = HTTPServer(("127.0.0.1", 0), _Ec2Fixture)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    _Ec2Fixture.requests.clear()
+    pd = str(tmp_path / "plugins")
+    plugin_cli(["install",
+                os.path.join(REPO_ROOT, "plugins_src", "discovery_ec2"),
+                "--plugins-dir", pd])
+    from elasticsearch_tpu.plugins import PluginsService
+    svc = PluginsService(pd)
+    svc.load_all()
+    yield srv
+    srv.shutdown()
+    discovery.PLUGIN_SEED_PROVIDERS.pop("ec2", None)
+
+
+def test_ec2_seed_hosts_with_tag_filters(ec2):
+    settings = Settings.from_dict({
+        "discovery": {"ec2": {
+            "endpoint": f"http://127.0.0.1:{ec2.server_address[1]}/",
+            "access_key": "AKIDEXAMPLE", "secret_key": "s3cr3t",
+            "tag": {"role": "es-node"},
+            "port": 9377}}})
+    seeds = discovery.resolve_seed_hosts(settings=settings)
+    assert [(n.host, n.port) for n in seeds] == \
+        [("10.0.0.11", 9377), ("10.0.0.12", 9377)]
+    # the fixture saw a real SigV4-signed DescribeInstances request
+    params, headers = _Ec2Fixture.requests[0]
+    assert params["Action"] == "DescribeInstances"
+    assert params["Filter.1.Name"] == "instance-state-name"
+    assert params["Filter.2.Name"] == "tag:role"
+    assert params["Filter.2.Value.1"] == "es-node"
+    auth = headers.get("Authorization", "")
+    assert auth.startswith("AWS4-HMAC-SHA256")
+    assert "Credential=AKIDEXAMPLE/" in auth and "/ec2/aws4_request" in auth
+
+
+def test_ec2_public_ip_and_unreachable(ec2):
+    settings = Settings.from_dict({
+        "discovery": {"ec2": {
+            "endpoint": f"http://127.0.0.1:{ec2.server_address[1]}/",
+            "host_type": "public_ip"}}})
+    seeds = discovery.resolve_seed_hosts(settings=settings)
+    assert [n.host for n in seeds] == ["54.1.2.3", "54.1.2.4"]
+    # unreachable endpoint → empty, never a crash
+    bad = Settings.from_dict({
+        "discovery": {"ec2": {"endpoint": "http://127.0.0.1:1/"}}})
+    assert discovery.resolve_seed_hosts(settings=bad) == []
+
+
+def test_merges_with_settings_seeds(ec2):
+    settings = Settings.from_dict({
+        "discovery": {
+            "seed_hosts": "192.168.0.5:9300",
+            "ec2": {"endpoint":
+                    f"http://127.0.0.1:{ec2.server_address[1]}/"}}})
+    seeds = discovery.resolve_seed_hosts(settings=settings)
+    assert [(n.host, n.port) for n in seeds] == [
+        ("192.168.0.5", 9300), ("10.0.0.11", 9300), ("10.0.0.12", 9300)]
