@@ -155,7 +155,13 @@ class Node:
                           "user_search_attribute", "group_search_base",
                           "timeout")
                 if settings.get(
-                    f"xpack.security.authc.ldap.{k}") is not None})
+                    f"xpack.security.authc.ldap.{k}") is not None},
+            oidc_config={
+                k: settings.get(f"xpack.security.authc.oidc.{k}")
+                for k in ("op.issuer", "op.jwks_path", "rp.client_id",
+                          "claims.principal", "claims.groups")
+                if settings.get(
+                    f"xpack.security.authc.oidc.{k}") is not None})
         from elasticsearch_tpu.xpack.sql import SqlService
         self.sql_service = SqlService(self)
         from elasticsearch_tpu.xpack.eql import EqlService

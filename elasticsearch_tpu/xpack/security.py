@@ -525,6 +525,132 @@ class JwtRealm(Realm):
                                              if k != "roles"}})
 
 
+class OidcRealm(Realm):
+    """OpenID Connect realm (ref: x-pack/plugin/security/.../authc/oidc/
+    OpenIdConnectRealm.java — the resource-server half: RS256 ID-token /
+    access-token validation against the OP's JWKS, issuer + audience
+    checks, principal and groups from claims feeding role mappings).
+
+    Config (xpack.security.authc.oidc.*): ``op.issuer``,
+    ``rp.client_id`` (the audience), ``op.jwks_path`` (file path or URL
+    of the OP's JWKS — the reference fetches the jwks_uri from OP
+    metadata; zero-egress deployments point this at a synced file),
+    ``claims.principal`` (default "sub"), ``claims.groups`` (default
+    "groups")."""
+
+    type = "oidc"
+
+    def __init__(self, name, order, svc, config: Dict[str, Any]):
+        super().__init__(name, order, svc)
+        self.config = config or {}
+        self._jwks_cache: Optional[Dict[str, Any]] = None
+
+    def token(self, headers):
+        if not self.config.get("op.jwks_path"):
+            return None
+        auth = headers.get("authorization", "")
+        if not auth.lower().startswith("bearer "):
+            return None
+        tok = auth.partition(" ")[2]
+        if tok.count(".") != 2:
+            return None
+        try:
+            header = json.loads(JwtRealm._b64url(tok.split(".")[0]))
+        except Exception:
+            return None
+        # HS256 belongs to the JWT realm; this realm takes RS256/RS384/
+        # RS512 OP-signed tokens
+        if not str(header.get("alg", "")).startswith("RS"):
+            return None
+        return tok
+
+    def _jwks(self) -> Dict[str, Any]:
+        if self._jwks_cache is not None:
+            return self._jwks_cache
+        path = self.config["op.jwks_path"]
+        try:
+            if str(path).startswith(("http://", "https://")):
+                import urllib.request
+                with urllib.request.urlopen(str(path), timeout=10) as r:
+                    data = json.loads(r.read())
+            else:
+                with open(path) as fh:
+                    data = json.load(fh)
+        except (OSError, ValueError) as e:
+            raise AuthenticationException(
+                f"unable to load OP JWKS [{path}]: {e}")
+        self._jwks_cache = data
+        return data
+
+    def _key_for(self, kid: Optional[str]):
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        for jwk in self._jwks().get("keys", []):
+            if jwk.get("kty") != "RSA":
+                continue
+            if kid is not None and jwk.get("kid") not in (None, kid):
+                continue
+            n_int = int.from_bytes(
+                JwtRealm._b64url(jwk["n"]), "big")
+            e_int = int.from_bytes(
+                JwtRealm._b64url(jwk["e"]), "big")
+            return rsa.RSAPublicNumbers(e_int, n_int).public_key()
+        raise AuthenticationException(
+            f"no RSA key [{kid}] in the OP JWKS")
+
+    def authenticate(self, tok: str) -> "User":
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding
+        try:
+            header_b64, claims_b64, sig_b64 = tok.split(".")
+            header = json.loads(JwtRealm._b64url(header_b64))
+            claims = json.loads(JwtRealm._b64url(claims_b64))
+            sig = JwtRealm._b64url(sig_b64)
+        except Exception:
+            raise AuthenticationException("malformed OIDC token")
+        alg = str(header.get("alg", ""))
+        digest = {"RS256": hashes.SHA256, "RS384": hashes.SHA384,
+                  "RS512": hashes.SHA512}.get(alg)
+        if digest is None:
+            raise AuthenticationException(
+                f"unsupported OIDC token alg [{alg}]")
+        key = self._key_for(header.get("kid"))
+        try:
+            key.verify(sig, f"{header_b64}.{claims_b64}".encode(),
+                       padding.PKCS1v15(), digest())
+        except InvalidSignature:
+            raise AuthenticationException(
+                "OIDC token signature is invalid")
+        issuer = self.config.get("op.issuer")
+        if issuer and claims.get("iss") != issuer:
+            raise AuthenticationException("OIDC token issuer mismatch")
+        client_id = self.config.get("rp.client_id")
+        if client_id:
+            aud = claims.get("aud")
+            auds = aud if isinstance(aud, list) else [aud]
+            if client_id not in auds:
+                raise AuthenticationException(
+                    "OIDC token audience mismatch")
+        if claims.get("exp") is not None \
+                and claims["exp"] < time.time():
+            raise AuthenticationException("OIDC token is expired")
+        principal_claim = self.config.get("claims.principal", "sub")
+        principal = claims.get(principal_claim)
+        if not principal:
+            raise AuthenticationException(
+                f"OIDC token has no [{principal_claim}] claim")
+        groups_claim = self.config.get("claims.groups", "groups")
+        groups = claims.get(groups_claim) or []
+        if isinstance(groups, str):
+            groups = [groups]
+        roles = self.svc.mapped_roles(username=principal, dn="",
+                                      realm=self.name, groups=groups)
+        return User(principal, roles,
+                    metadata={"oidc_claims": {
+                        k: v for k, v in claims.items()
+                        if k not in ("exp", "iat")}})
+
+
 class LdapRealm(Realm):
     """LDAP / Active Directory authentication (ref:
     x-pack/plugin/security/.../authc/ldap/LdapRealm.java:54 — session
@@ -775,7 +901,8 @@ class SecurityService:
                  keystore=None,
                  jwt_issuer: Optional[str] = None,
                  jwt_audience: Optional[str] = None,
-                 ldap_config: Optional[Dict[str, Any]] = None):
+                 ldap_config: Optional[Dict[str, Any]] = None,
+                 oidc_config: Optional[Dict[str, Any]] = None):
         # ref: x-pack anonymous access (xpack.security.authc.anonymous.*)
         # — requests without credentials authenticate as this principal
         self.anonymous_username = anonymous_username
@@ -822,7 +949,11 @@ class SecurityService:
             PkiRealm("pki1", orders.get("pki", 5), self),
         ] + ([LdapRealm("ldap1", orders.get("ldap", 6), self,
                         ldap_config)]
-             if ldap_config and ldap_config.get("url") else []),
+             if ldap_config and ldap_config.get("url") else [])
+          + ([OidcRealm("oidc1", orders.get("oidc", 7), self,
+                        oidc_config)]
+             if oidc_config and oidc_config.get("op.jwks_path")
+             else []),
             key=lambda r: r.order)
 
     # ------------------------------------------------------------- persist

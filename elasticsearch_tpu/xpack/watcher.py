@@ -90,6 +90,9 @@ class WatcherService:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.execution_count = 0
+        # last rendered webhook requests (bounded) — what WOULD have
+        # been sent; tests and operators inspect these
+        self.webhook_requests: List[Dict[str, Any]] = []
 
     # ----------------------------------------------------------- lifecycle
     def start_scheduler(self):
@@ -324,9 +327,46 @@ class WatcherService:
             return {"id": name, "type": "index", "status": "success",
                     "index": {"response": {"index": index}}}
         if atype == "webhook":
-            # zero-egress: record the rendered request, do not send
+            # FULLY render the request the way the reference's
+            # HttpClient would send it (ref: actions/webhook/
+            # ExecutableWebhookAction + HttpRequestTemplate.render —
+            # scheme/host/port/path/params/headers/body all template
+            # over ctx), then record instead of sending (zero-egress,
+            # disclosed). Rendering is the testable contract: auth
+            # headers, mustache substitutions, the URL.
+            import json as _json
+            rendered = {
+                "method": str(body.get("method", "post")).upper(),
+                "scheme": body.get("scheme", "http"),
+                "host": self._render(str(body.get("host", "")), ctx),
+                "port": int(body.get("port", 80)),
+                "path": self._render(str(body.get("path", "/")), ctx),
+                "params": {k: self._render(str(v), ctx)
+                           for k, v in (body.get("params") or {}).items()},
+                "headers": {k: self._render(str(v), ctx)
+                            for k, v in
+                            (body.get("headers") or {}).items()},
+                "body": self._render(
+                    body.get("body") if isinstance(body.get("body"), str)
+                    else _json.dumps(body.get("body"))
+                    if body.get("body") is not None else "", ctx),
+            }
+            auth = (body.get("auth") or {}).get("basic")
+            if auth:
+                import base64 as _b64
+                creds = f"{auth.get('username', '')}:"                         f"{auth.get('password', '')}"
+                rendered["headers"]["Authorization"] = (
+                    "Basic "
+                    + _b64.b64encode(creds.encode()).decode())
+            url = (f"{rendered['scheme']}://{rendered['host']}:"
+                   f"{rendered['port']}{rendered['path']}")
+            rendered["url"] = url
+            self.webhook_requests.append(
+                {"watch_id": ctx["watch_id"], "action": name,
+                 "request": rendered})
+            del self.webhook_requests[:-256]
             return {"id": name, "type": "webhook", "status": "simulated",
-                    "webhook": {"request": body}}
+                    "webhook": {"request": rendered}}
         return {"id": name, "type": atype, "status": "simulated"}
 
     @staticmethod

@@ -63,7 +63,7 @@ def test_launcher_external_process(tmp_path):
         # first import of jax in the child can take a while under a
         # loaded machine — wait for the startup line with a deadline
         import select
-        deadline = time.time() + 180
+        deadline = time.time() + 420
         line = ""
         while time.time() < deadline:
             r, _, _ = select.select([proc.stdout], [], [], 5.0)

@@ -152,3 +152,42 @@ def test_watch_script_condition_is_sandboxed(node):
     call(node, "PUT", "/_watcher/watch/evil", w, expect=201)
     r = call(node, "POST", "/_watcher/watch/evil/_execute")
     assert r["watch_record"]["state"] == "execution_not_needed"
+
+
+def test_webhook_renders_full_request(node):
+    """The webhook action renders the COMPLETE HTTP request the
+    reference would send — URL, mustache-templated path/body, params,
+    and basic-auth header — before recording it (zero-egress); the
+    rendering is the testable contract (ref:
+    actions/webhook/ExecutableWebhookAction + HttpRequestTemplate)."""
+    call(node, "PUT", "/_watcher/watch/hook", {
+        "trigger": {"schedule": {"interval": "1h"}},
+        "input": {"simple": {"severity": "high", "count": 7}},
+        "condition": {"always": {}},
+        "actions": {"notify": {"webhook": {
+            "method": "POST",
+            "host": "alerts.example.com",
+            "port": 8443,
+            "scheme": "https",
+            "path": "/alert/{{ctx.watch_id}}",
+            "params": {"severity": "{{ctx.payload.severity}}"},
+            "headers": {"Content-Type": "application/json"},
+            "auth": {"basic": {"username": "hookuser",
+                               "password": "hookpw"}},
+            "body": "count={{ctx.payload.count}}",
+        }}}}, expect=201)
+    r = call(node, "POST", "/_watcher/watch/hook/_execute")
+    action = r["watch_record"]["result"]["actions"][0]
+    assert action["type"] == "webhook"
+    req = action["webhook"]["request"]
+    assert req["url"] == "https://alerts.example.com:8443/alert/hook"
+    assert req["method"] == "POST"
+    assert req["params"] == {"severity": "high"}
+    assert req["body"] == "count=7"
+    import base64
+    expected = "Basic " + base64.b64encode(b"hookuser:hookpw").decode()
+    assert req["headers"]["Authorization"] == expected
+    assert req["headers"]["Content-Type"] == "application/json"
+    # the rendered request is retained for inspection
+    svc = node.watcher_service
+    assert svc.webhook_requests[-1]["watch_id"] == "hook"
