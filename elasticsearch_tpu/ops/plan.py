@@ -221,10 +221,13 @@ def pack_result(vals: jax.Array, ids: jax.Array,
     buffer (ids/total bitcast). The axon tunnel charges ~100ms per
     device→host readback in its degraded mode — one packed readback per
     launch instead of three is a 3× serving-latency lever."""
+    # explicit 32-bit dtypes: under x64 an unannotated sum widens to
+    # int64, whose f32 bitcast grows a trailing axis and breaks the pack
     return jnp.concatenate([
-        vals,
-        jax.lax.bitcast_convert_type(ids, jnp.float32),
-        jax.lax.bitcast_convert_type(jnp.reshape(total, (1,)), jnp.float32),
+        vals.astype(jnp.float32),
+        jax.lax.bitcast_convert_type(ids.astype(jnp.int32), jnp.float32),
+        jax.lax.bitcast_convert_type(
+            jnp.reshape(total, (1,)).astype(jnp.int32), jnp.float32),
     ])
 
 
