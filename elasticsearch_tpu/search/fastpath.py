@@ -171,7 +171,7 @@ class FastPathServer:
         df = dp.doc_freq.astype(np.float64)
         n = float(pf.doc_count)
         reg["idf"] = np.log1p((n - df + 0.5) / (df + 0.5)).astype(
-            np.float32)
+            self._weight_dtype())
         reg["nb"] = dp.term_block_count.astype(np.int64)
         reg["starts"] = dp.term_block_start.astype(np.int64)
         # --- θ-cached exact-MaxScore state (ops/fastpath.py essential
@@ -248,11 +248,11 @@ class FastPathServer:
             if not self._running:
                 return
             sel = np.full((self.q_batch, nb), dp.zero_block, np.int32)
-            ws = np.zeros((self.q_batch, nb), np.float32)
+            ws = np.zeros((self.q_batch, nb), self._weight_dtype())
             t0 = time.time()
             bm25_topk_total_batch(
                 dp.block_docids, dp.block_tfs, sel, ws, dp.doc_lens,
-                masks, mask_ids, np.float32(dp.avg_len), reg["k1"],
+                masks, mask_ids, self._weight_dtype()(dp.avg_len), reg["k1"],
                 reg["b"], self.max_k).block_until_ready()
             logger.info("fastpath warm NB=%d in %.1fs", nb,
                         time.time() - t0)
@@ -262,16 +262,16 @@ class FastPathServer:
             if not self._running:
                 return
             sel = np.full((self.q_batch, nb), dp.zero_block, np.int32)
-            ws = np.zeros((self.q_batch, nb), np.float32)
+            ws = np.zeros((self.q_batch, nb), self._weight_dtype())
             t0 = time.time()
             bm25_essential_topk_batch(
                 dp.block_docids, dp.block_tfs, reg["flat_docids"],
                 reg["flat_tfs"], sel, ws, dp.doc_lens, masks, mask_ids,
                 np.zeros((self.q_batch, NE_SLOTS), np.int32),
                 np.zeros((self.q_batch, NE_SLOTS), np.int32),
-                np.zeros((self.q_batch, NE_SLOTS), np.float32),
-                np.zeros(self.q_batch, np.float32),
-                np.float32(dp.avg_len), reg["k1"], reg["b"],
+                np.zeros((self.q_batch, NE_SLOTS), self._weight_dtype()),
+                np.zeros(self.q_batch, self._weight_dtype()),
+                self._weight_dtype()(dp.avg_len), reg["k1"], reg["b"],
                 self.max_k).block_until_ready()
             logger.info("fastpath warm essential NB=%d in %.1fs", nb,
                         time.time() - t0)
@@ -432,6 +432,14 @@ class FastPathServer:
     # binary-search depth contract of the patch kernel (ops/fastpath)
     NE_MAX_LEN = 1 << 21
 
+    @staticmethod
+    def _weight_dtype():
+        """Weights/avg ride the ranking dtype: under x64 the kernels
+        rank in float64, and f32-ROUNDED idf weights would reintroduce
+        the ~2^-24 boundary noise the f64 rail removes."""
+        import jax
+        return np.float64 if jax.config.jax_enable_x64 else np.float32
+
     def _chunk_by_slots(self, items):
         """Split a launch class into cohorts bounded by the cohort
         width (Q_BATCH) AND the mask-slot budget (≤ F_SLOTS-1 distinct
@@ -559,12 +567,12 @@ class FastPathServer:
         dp, dev = reg["dp"], reg["dev"]
         sel = np.full((self.q_batch, bucket), dp.zero_block,
                       np.int32)
-        ws = np.zeros((self.q_batch, bucket), np.float32)
+        ws = np.zeros((self.q_batch, bucket), self._weight_dtype())
         mask_ids = np.zeros(self.q_batch, np.int32)
         ne_start = np.zeros((self.q_batch, NE_SLOTS), np.int32)
         ne_len = np.zeros((self.q_batch, NE_SLOTS), np.int32)
-        ne_idf = np.zeros((self.q_batch, NE_SLOTS), np.float32)
-        ne_bound = np.zeros(self.q_batch, np.float32)
+        ne_idf = np.zeros((self.q_batch, NE_SLOTS), self._weight_dtype())
+        ne_bound = np.zeros(self.q_batch, self._weight_dtype())
         starts, nbs, idf = reg["starts"], reg["nb"], reg["idf"]
         mask_rows = [dev.live]
         row_of: Dict[tuple, int] = {}
@@ -607,7 +615,7 @@ class FastPathServer:
             dp.block_docids, dp.block_tfs, reg["flat_docids"],
             reg["flat_tfs"], sel, ws, dp.doc_lens, masks, mask_ids,
             ne_start, ne_len, ne_idf, ne_bound,
-            np.float32(dp.avg_len), reg["k1"], reg["b"], k_static)
+            self._weight_dtype()(dp.avg_len), reg["k1"], reg["b"], k_static)
         out = np.asarray(packed)
         took_ms = int((time.time() - t_arrive) * 1000)
         idx_b = reg["index"].encode()
@@ -681,7 +689,7 @@ class FastPathServer:
         q = len(items)
         sel = np.full((self.q_batch, bucket), dp.zero_block,
                       np.int32)
-        ws = np.zeros((self.q_batch, bucket), np.float32)
+        ws = np.zeros((self.q_batch, bucket), self._weight_dtype())
         mask_ids = np.zeros(self.q_batch, np.int32)
         starts, nbs, idf = reg["starts"], reg["nb"], reg["idf"]
         mask_rows = [dev.live]            # row 0 = plain live
@@ -719,7 +727,7 @@ class FastPathServer:
         k_static = self.max_k
         packed = bm25_topk_total_batch(
             dp.block_docids, dp.block_tfs, sel, ws, dp.doc_lens, masks,
-            mask_ids, np.float32(dp.avg_len), reg["k1"], reg["b"],
+            mask_ids, self._weight_dtype()(dp.avg_len), reg["k1"], reg["b"],
             k_static)
         out = np.asarray(packed)       # ONE device→host sync per cohort
         took_ms = int((time.time() - t_arrive) * 1000)
