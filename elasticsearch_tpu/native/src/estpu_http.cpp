@@ -1006,6 +1006,32 @@ int es_fast_pending(int64_t h) {
     return (int)s->fast_q.size();
 }
 
+// JSON-escape arbitrary bytes into out (doc _ids and index names may
+// contain quotes, backslashes, or control characters; the Python
+// fallback escapes via json.dumps and the fast path must match it).
+static void json_escape_append(std::string& out, const char* s, size_t n) {
+    for (size_t i = 0; i < n; i++) {
+        unsigned char c = (unsigned char)s[i];
+        switch (c) {
+            case '"':  out += "\\\""; break;
+            case '\\': out += "\\\\"; break;
+            case '\b': out += "\\b"; break;
+            case '\f': out += "\\f"; break;
+            case '\n': out += "\\n"; break;
+            case '\r': out += "\\r"; break;
+            case '\t': out += "\\t"; break;
+            default:
+                if (c < 0x20) {
+                    char u[8];
+                    snprintf(u, sizeof u, "\\u%04x", c);
+                    out += u;
+                } else {
+                    out += (char)c;
+                }
+        }
+    }
+}
+
 // Serialize + send the hot-path response entirely in C++.
 int es_fast_respond(int64_t h, uint64_t token, const char* index_name,
                     const int32_t* doc_ids, const float* scores, int n,
@@ -1033,14 +1059,17 @@ int es_fast_respond(int64_t h, uint64_t token, const char* index_name,
     }
     body += tmp;
     int64_t ndocs = cfg ? (int64_t)cfg->id_offs.size() - 1 : 0;
+    std::string esc_index;
+    json_escape_append(esc_index, index_name, strlen(index_name));
     for (int i = 0; i < n; i++) {
         int32_t d = doc_ids[i];
         body += i ? ",{\"_index\":\"" : "{\"_index\":\"";
-        body += index_name;
+        body += esc_index;
         body += "\",\"_id\":\"";
         if (cfg && d >= 0 && d < ndocs) {
-            body.append(cfg->ids_blob.data() + cfg->id_offs[d],
-                        cfg->id_offs[d + 1] - cfg->id_offs[d]);
+            json_escape_append(
+                body, cfg->ids_blob.data() + cfg->id_offs[d],
+                (size_t)(cfg->id_offs[d + 1] - cfg->id_offs[d]));
         } else {
             snprintf(tmp, sizeof tmp, "%d", d);
             body += tmp;

@@ -132,6 +132,17 @@ def block_max_scores(block_max_tf: jax.Array,   # float32 [TB]
 _SENTINEL = 0x7FFFFFFF
 
 
+def scan_run_bound(n_terms: int, floor: int = 32) -> int:
+    """Static ``max_run`` for the doubling segmented scans: the smallest
+    power of two ≥ max(n_terms, floor). The scan's coverage window equals
+    this bound (steps 1..bound/2 sum a run of exactly ``bound``), and
+    rounding to a power of two caps the number of compiled variants."""
+    r = floor
+    while r < n_terms:
+        r *= 2
+    return r
+
+
 def segmented_topk(keys: jax.Array, contribs: jax.Array, k: int,
                    sentinel, max_run: int = 32):
     """Top-k of per-key contribution sums WITHOUT a dense accumulator:
@@ -176,7 +187,8 @@ def bm25_sorted_topk(block_docids: jax.Array,   # int32 [TB, B]
                      sel_weights: jax.Array,    # float32 [NB]
                      doc_lens: jax.Array,       # float32 [ND]
                      live: jax.Array,           # bool [ND]
-                     avg_len: jax.Array, k1: float, b: float, k: int):
+                     avg_len: jax.Array, k1: float, b: float, k: int,
+                     max_run: int = 32):
     """BM25 top-k WITHOUT a dense score accumulator — the TPU-native hot
     path. XLA scatter on TPU serializes updates (measured ~70ms for 8K
     postings), so instead of scattering into scores[ND] this kernel:
@@ -206,7 +218,10 @@ def bm25_sorted_topk(block_docids: jax.Array,   # int32 [TB, B]
     # by the totals>0 mask
     dkey = jnp.where(valid, dflat, _SENTINEL)
     cflat = jnp.where(valid & jnp.take(live, dflat), cflat, 0.0)
-    return segmented_topk(dkey, cflat, k, _SENTINEL)
+    # max_run MUST bound the per-doc term-instance count — callers with
+    # unbounded term lists pass scan_run_bound(n_terms) (a 31+-term
+    # query under the fixed 32 default silently drops contributions)
+    return segmented_topk(dkey, cflat, k, _SENTINEL, max_run=max_run)
 
 
 # ---------------------------------------------------------------------------
@@ -232,7 +247,8 @@ def bm25_sorted_topk_batch(block_docids: jax.Array,   # int32 [TB, B]
                            sel_weights: jax.Array,    # float32 [Q, NB]
                            doc_lens: jax.Array,       # float32 [ND]
                            live: jax.Array,           # bool [ND]
-                           avg_len, k1: float, b: float, k: int):
+                           avg_len, k1: float, b: float, k: int,
+                           max_run: int = 32):
     """Many queries per launch: vmap of bm25_sorted_topk over a [Q, NB]
     selection batch → ([Q, k] values, [Q, k] docids).
 
@@ -243,5 +259,6 @@ def bm25_sorted_topk_batch(block_docids: jax.Array,   # int32 [TB, B]
     fewer postings pad their selection with the reserved zero block."""
     return jax.vmap(
         lambda s, w: bm25_sorted_topk(block_docids, block_tfs, s, w,
-                                      doc_lens, live, avg_len, k1, b, k)
+                                      doc_lens, live, avg_len, k1, b, k,
+                                      max_run=max_run)
     )(sel_blocks, sel_weights)

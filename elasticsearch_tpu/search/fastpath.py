@@ -498,7 +498,10 @@ class FastPathServer:
             mc = float(maxc[t])
             if (len(ne) < NE_SLOTS and len(inst) - len(ne) > 1
                     and bound + mc < theta_safe
-                    and int(reg["post_len"][t]) <= self.NE_MAX_LEN):
+                    # STRICT: the patch kernel's 21 halving steps only
+                    # fully resolve ranges < 2^21 (at exactly 2^21 the
+                    # lower-bound search can end one short)
+                    and int(reg["post_len"][t]) < self.NE_MAX_LEN):
                 ne.append(t)
                 bound += mc
             else:

@@ -146,10 +146,12 @@ def _bm25_terms(ctx: SegmentContext, field: str, terms: List[str]) -> Result:
         tids.append(tid)
         weights.append(bm25_ops.idf(df, doc_count) if df > 0 else 0.0)
     sel, ws = dp.select_blocks(tids, weights)
+    from elasticsearch_tpu.ops.bm25 import scan_run_bound
     from elasticsearch_tpu.ops.plan import bm25_dense_scores_sorted
     scores = bm25_dense_scores_sorted(
         dp.block_docids, dp.block_tfs, jnp.asarray(sel), jnp.asarray(ws),
-        dp.doc_lens, jnp.float32(avg_len), ctx.k1, ctx.b)
+        dp.doc_lens, jnp.float32(avg_len), ctx.k1, ctx.b,
+        max_run=scan_run_bound(len(tids)))
     return scores, scores > 0.0
 
 
@@ -1175,10 +1177,12 @@ class FuzzyQuery(QueryBuilder):
             tids.append(dp.host.term_id(t))
             weights.append(w * (1.0 - d / L))
         sel, ws = dp.select_blocks(tids, weights)
+        from elasticsearch_tpu.ops.bm25 import scan_run_bound
         from elasticsearch_tpu.ops.plan import bm25_dense_scores_sorted
         scores = bm25_dense_scores_sorted(
             dp.block_docids, dp.block_tfs, jnp.asarray(sel), jnp.asarray(ws),
-            dp.doc_lens, jnp.float32(avg_len), ctx.k1, ctx.b)
+            dp.doc_lens, jnp.float32(avg_len), ctx.k1, ctx.b,
+            max_run=scan_run_bound(len(tids)))
         return scores, scores > 0.0
 
 

@@ -241,6 +241,34 @@ def test_sorted_dense_builders_match_scatter(rng):
     np.testing.assert_array_equal(np.asarray(got_m), np.asarray(ref_m))
 
 
+def test_dense_scores_over_32_terms(rng):
+    """A doc matched by >32 term instances keeps EVERY contribution
+    (advisor r3 high: the fixed 32-step scan cap silently dropped all
+    but the last 32 — callers now pass scan_run_bound(n_terms))."""
+    from elasticsearch_tpu.ops.bm25 import scan_run_bound
+    n_docs, B, n_terms = 64, 128, 40
+    # every term's single block hits every doc once
+    base = np.tile(np.arange(n_docs, dtype=np.int32), B // n_docs)
+    base.sort()
+    docids = np.tile(base, (n_terms, 1))
+    tfs = np.ones((n_terms, B), np.float32)
+    lens = np.full(n_docs, float(B // n_docs), np.float32)
+    sel = np.arange(n_terms, dtype=np.int32)
+    ws = np.linspace(0.5, 2.0, n_terms).astype(np.float32)
+    avg = jnp.float32(lens.mean())
+    got = plan_ops.bm25_dense_scores_sorted(
+        jnp.asarray(docids), jnp.asarray(tfs), jnp.asarray(sel),
+        jnp.asarray(ws), jnp.asarray(lens), avg, 1.2, 0.75,
+        max_run=scan_run_bound(n_terms * (B // n_docs)))
+    ref = bm25_ops.bm25_reference_scores(
+        [(docids[t], tfs[t]) for t in range(n_terms)], ws, lens,
+        float(lens.mean()), 1.2, 0.75)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=3e-4)
+    assert scan_run_bound(16) == 32
+    assert scan_run_bound(33) == 64
+    assert scan_run_bound(100) == 128
+
+
 def test_randomized_plan_vs_dense(searcher):
     """Fuzz: random plannable query trees agree with the dense executor."""
     rng = np.random.default_rng(11)
