@@ -1,0 +1,539 @@
+"""Kerberos v5 crypto + message parsing for the Kerberos realm.
+
+The reference authenticates SPNEGO tokens through Java GSS
+(ref: x-pack/plugin/security/src/main/java/org/elasticsearch/xpack/
+security/authc/kerberos/KerberosRealm.java:60 +
+KerberosTicketValidator.java — GSSContext.acceptSecContext with the
+service keytab). This module implements the pieces that validation
+actually needs, natively:
+
+- RFC 3961 n-fold and the simplified-profile key derivation DK(),
+- RFC 3962 aes128/256-cts-hmac-sha1-96: string-to-key (PBKDF2),
+  encrypt/decrypt with AES-CBC ciphertext stealing + HMAC-SHA1-96,
+- a minimal DER reader (tag/length/value with context tags),
+- SPNEGO (RFC 4178) initial-token unwrapping,
+- KRB5 AP-REQ / Ticket / EncTicketPart / Authenticator structures
+  (RFC 4120 §5.5.1, §5.3) — enough to decrypt the service ticket with
+  the keytab key, extract the client principal, check validity, and
+  decrypt the authenticator with the ticket session key.
+
+The crypto is testable against the RFCs' published vectors
+(RFC 3961 A.1 n-fold, RFC 3962 B string-to-key) — see
+tests/test_kerberos.py.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import os
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class KrbError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# RFC 3961: n-fold
+# ---------------------------------------------------------------------------
+
+def _rot13(data: bytes) -> bytes:
+    """Right-rotate the bit string by 13 bits."""
+    n = len(data)
+    as_int = int.from_bytes(data, "big")
+    bits = n * 8
+    as_int = ((as_int >> 13) | (as_int << (bits - 13))) & ((1 << bits) - 1)
+    return as_int.to_bytes(n, "big")
+
+
+def _ones_add(a: bytes, b: bytes) -> bytes:
+    """One's-complement addition (end-around carry)."""
+    n = len(a)
+    s = int.from_bytes(a, "big") + int.from_bytes(b, "big")
+    top = 1 << (n * 8)
+    while s >= top:
+        s = (s % top) + (s // top)
+    return s.to_bytes(n, "big")
+
+
+def nfold(data: bytes, nbytes: int) -> bytes:
+    """RFC 3961 §5.1 n-fold: stretch/compress ``data`` to ``nbytes``."""
+    import math
+    lcm = len(data) * nbytes // math.gcd(len(data), nbytes)
+    buf = b""
+    piece = data
+    while len(buf) < lcm:
+        buf += piece
+        piece = _rot13(piece)
+    out = bytes(nbytes)
+    for i in range(0, lcm, nbytes):
+        out = _ones_add(out, buf[i:i + nbytes])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# RFC 3962: aes-cts-hmac-sha1-96
+# ---------------------------------------------------------------------------
+
+def _aes_cbc(key: bytes, data: bytes, decrypt: bool) -> bytes:
+    from cryptography.hazmat.primitives.ciphers import (Cipher, algorithms,
+                                                        modes)
+    c = Cipher(algorithms.AES(key), modes.CBC(bytes(16)))
+    op = c.decryptor() if decrypt else c.encryptor()
+    return op.update(data) + op.finalize()
+
+
+def _cts_encrypt(key: bytes, plain: bytes) -> bytes:
+    """AES-CBC with ciphertext stealing, zero IV (RFC 3962 §5). Inputs
+    are always >= 16 bytes here (confounder guarantees it)."""
+    n = len(plain)
+    if n <= 16:
+        return _aes_cbc(key, plain.ljust(16, b"\0"), False)[:n]
+    pad = (-n) % 16
+    padded = plain + bytes(pad)
+    blocks = _aes_cbc(key, padded, False)
+    if pad == 0 and n % 16 == 0 and len(padded) == n:
+        # swap the last two blocks (CTS with full final block)
+        return blocks[:-32] + blocks[-16:] + blocks[-32:-16]
+    # steal: last full cipher block becomes the (truncated) final block
+    last_len = n % 16 or 16
+    return blocks[:-32] + blocks[-16:] + blocks[-32:-16][:last_len]
+
+
+def _cts_decrypt(key: bytes, cipher: bytes) -> bytes:
+    n = len(cipher)
+    if n <= 16:
+        return _aes_cbc(key, cipher.ljust(16, b"\0"), True)[:n]
+    last_len = n % 16 or 16
+    # undo the block swap: c_{n-1} is the stolen block
+    cn1 = cipher[-(16 + last_len):-last_len]      # second-to-last (full)
+    cn = cipher[-last_len:]                       # last (maybe short)
+    head = cipher[:-(16 + last_len)]
+    # decrypt cn1 with ECB to recover the stolen tail bits
+    from cryptography.hazmat.primitives.ciphers import (Cipher, algorithms,
+                                                        modes)
+    dec = Cipher(algorithms.AES(key), modes.ECB()).decryptor()
+    dn1 = dec.update(cn1) + dec.finalize()
+    cn_full = cn + dn1[last_len:]
+    reordered = head + cn_full + cn1
+    plain = _aes_cbc(key, reordered, True)
+    return plain[:n]
+
+
+def derive_key(base_key: bytes, usage: int, kind: bytes) -> bytes:
+    """RFC 3961 §5.3 DK: derived = AES-ECB chain over n-fold(constant).
+    kind: b"\\xaa" (Ke, encryption), b"\\x55" (Ki, integrity),
+    b"\\x99" (Kc, checksum)."""
+    from cryptography.hazmat.primitives.ciphers import (Cipher, algorithms,
+                                                        modes)
+    constant = struct.pack(">I", usage) + kind
+    folded = nfold(constant, 16)
+    out = b""
+    prev = folded
+    while len(out) < len(base_key):
+        enc = Cipher(algorithms.AES(base_key), modes.ECB()).encryptor()
+        prev = enc.update(prev) + enc.finalize()
+        out += prev
+    return out[:len(base_key)]
+
+
+def string_to_key(password: str, salt: str, iterations: int = 4096,
+                  keylen: int = 32) -> bytes:
+    """RFC 3962 §4 string-to-key: PBKDF2-HMAC-SHA1 then DK with
+    constant "kerberos"."""
+    from cryptography.hazmat.primitives.ciphers import (Cipher, algorithms,
+                                                        modes)
+    tkey = hashlib.pbkdf2_hmac("sha1", password.encode(), salt.encode(),
+                               iterations, keylen)
+    folded = nfold(b"kerberos", 16)
+    out = b""
+    prev = folded
+    while len(out) < keylen:
+        enc = Cipher(algorithms.AES(tkey), modes.ECB()).encryptor()
+        prev = enc.update(prev) + enc.finalize()
+        out += prev
+    return out[:keylen]
+
+
+def krb_encrypt(base_key: bytes, usage: int, plain: bytes) -> bytes:
+    """RFC 3962 §6: confounder | plaintext → CTS-encrypt with Ke,
+    append HMAC-SHA1-96 over the plaintext (with confounder) keyed Ki."""
+    ke = derive_key(base_key, usage, b"\xaa")
+    ki = derive_key(base_key, usage, b"\x55")
+    conf = os.urandom(16)
+    data = conf + plain
+    cipher = _cts_encrypt(ke, data)
+    mac = hmac.new(ki, data, hashlib.sha1).digest()[:12]
+    return cipher + mac
+
+
+def krb_decrypt(base_key: bytes, usage: int, data: bytes) -> bytes:
+    """Inverse of krb_encrypt; raises KrbError on MAC mismatch."""
+    if len(data) < 16 + 12:
+        raise KrbError("ciphertext too short")
+    cipher, mac = data[:-12], data[-12:]
+    ke = derive_key(base_key, usage, b"\xaa")
+    ki = derive_key(base_key, usage, b"\x55")
+    plain = _cts_decrypt(ke, cipher)
+    expect = hmac.new(ki, plain, hashlib.sha1).digest()[:12]
+    if not hmac.compare_digest(mac, expect):
+        raise KrbError("integrity check on decrypted field failed")
+    return plain[16:]                      # strip confounder
+
+
+ETYPE_AES128 = 17
+ETYPE_AES256 = 18
+
+
+# ---------------------------------------------------------------------------
+# Minimal DER
+# ---------------------------------------------------------------------------
+
+class Der:
+    """Cursor-based DER reader."""
+
+    def __init__(self, data: bytes, pos: int = 0, end: Optional[int] = None):
+        self.b = data
+        self.pos = pos
+        self.end = len(data) if end is None else end
+
+    def eof(self) -> bool:
+        return self.pos >= self.end
+
+    def _tl(self) -> Tuple[int, int]:
+        if self.pos + 2 > self.end:
+            raise KrbError("truncated DER")
+        tag = self.b[self.pos]
+        self.pos += 1
+        if tag & 0x1F == 0x1F:
+            raise KrbError("long-form DER tags unsupported")
+        ln = self.b[self.pos]
+        self.pos += 1
+        if ln & 0x80:
+            n = ln & 0x7F
+            if n == 0 or n > 4 or self.pos + n > self.end:
+                raise KrbError("bad DER length")
+            ln = int.from_bytes(self.b[self.pos:self.pos + n], "big")
+            self.pos += n
+        if self.pos + ln > self.end:
+            raise KrbError("DER value overruns buffer")
+        return tag, ln
+
+    def read(self) -> Tuple[int, "Der"]:
+        """(tag, sub-cursor over the value); advances past it."""
+        tag, ln = self._tl()
+        sub = Der(self.b, self.pos, self.pos + ln)
+        self.pos += ln
+        return tag, sub
+
+    def bytes_(self) -> bytes:
+        return self.b[self.pos:self.end]
+
+    def expect(self, want: int) -> "Der":
+        tag, sub = self.read()
+        if tag != want:
+            raise KrbError(f"DER tag 0x{tag:02x}, expected 0x{want:02x}")
+        return sub
+
+
+def der_tlv(tag: int, val: bytes) -> bytes:
+    n = len(val)
+    if n < 0x80:
+        return bytes([tag, n]) + val
+    enc = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([tag, 0x80 | len(enc)]) + enc + val
+
+
+def der_int(v: int) -> bytes:
+    if v == 0:
+        return der_tlv(0x02, b"\0")
+    out = v.to_bytes((v.bit_length() + 8) // 8, "big")
+    return der_tlv(0x02, out.lstrip(b"\0") if out[0] or len(out) == 1
+                   else out[1:] if not (out[1] & 0x80) else out)
+
+
+def der_ctx(n: int, val: bytes) -> bytes:
+    return der_tlv(0xA0 | n, val)
+
+
+def der_gs(s: str) -> bytes:
+    return der_tlv(0x1B, s.encode())          # GeneralString
+
+
+def der_time(dt: datetime.datetime) -> bytes:
+    return der_tlv(0x18, dt.strftime("%Y%m%d%H%M%SZ").encode())
+
+
+def _read_int(d: Der) -> int:
+    v = d.expect(0x02).bytes_()
+    return int.from_bytes(v, "big", signed=True)
+
+
+def _read_ctx_map(d: Der) -> Dict[int, Der]:
+    out = {}
+    while not d.eof():
+        tag, sub = d.read()
+        if tag & 0xE0 == 0xA0:
+            out[tag & 0x1F] = sub
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SPNEGO + KRB5 structures
+# ---------------------------------------------------------------------------
+
+OID_SPNEGO = bytes.fromhex("2b0601050502")          # 1.3.6.1.5.5.2
+OID_KRB5 = bytes.fromhex("2a864886f712010202")      # 1.2.840.113554.1.2.2
+TOK_AP_REQ = b"\x01\x00"
+
+
+def spnego_unwrap(token: bytes, _depth: int = 0) -> bytes:
+    """GSS initial token → the inner Kerberos AP-REQ DER (RFC 4178
+    NegTokenInit mechToken, or a bare krb5 GSS token)."""
+    if _depth > 4:
+        # nesting is 1 deep in practice; unbounded recursion on
+        # attacker-crafted SPNEGO-in-SPNEGO tokens is a DoS
+        raise KrbError("SPNEGO token nesting too deep")
+    d = Der(token)
+    tag, app = d.read()
+    if tag != 0x60:
+        raise KrbError("not a GSS-API initial token")
+    oid = app.expect(0x06).bytes_()
+    if oid == OID_KRB5:
+        body = app.bytes_()
+        if body[:2] != TOK_AP_REQ:
+            raise KrbError("GSS krb5 token is not an AP-REQ")
+        return body[2:]
+    if oid != OID_SPNEGO:
+        raise KrbError("unsupported GSS mechanism OID")
+    neg_tag, neg = app.read()
+    if neg_tag != 0xA0:
+        raise KrbError("expected NegTokenInit")
+    seq = neg.expect(0x30)
+    fields = _read_ctx_map(seq)
+    if 2 not in fields:
+        raise KrbError("NegTokenInit has no mechToken")
+    mech_token = fields[2].expect(0x04).bytes_()
+    return spnego_unwrap(mech_token, _depth + 1)  # inner GSS krb5 token
+
+
+def spnego_wrap(ap_req_der: bytes) -> bytes:
+    """Build a NegTokenInit carrying a krb5 AP-REQ (the fixture/KDC
+    side; also exercised by the realm tests)."""
+    inner = der_tlv(0x60, der_tlv(0x06, OID_KRB5) + TOK_AP_REQ
+                    + ap_req_der)
+    mech_list = der_tlv(0x30, der_tlv(0x06, OID_KRB5))
+    neg = der_tlv(0x30, der_ctx(0, mech_list)
+                  + der_ctx(2, der_tlv(0x04, inner)))
+    return der_tlv(0x60, der_tlv(0x06, OID_SPNEGO) + der_ctx(0, neg))
+
+
+def _principal_name(d: Der) -> str:
+    """PrincipalName ::= SEQUENCE { name-type [0], name-string [1] SEQ
+    OF GeneralString }."""
+    fields = _read_ctx_map(d.expect(0x30) if d.b[d.pos] == 0x30 else d)
+    parts = []
+    if 1 in fields:
+        seq = fields[1].expect(0x30)
+        while not seq.eof():
+            parts.append(seq.expect(0x1B).bytes_().decode())
+    return "/".join(parts)
+
+
+def _enc_part(d: Der) -> Tuple[int, int, bytes]:
+    """EncryptedData ::= SEQ { etype [0], kvno [1] opt, cipher [2] }."""
+    fields = _read_ctx_map(d)
+    etype = _read_int(fields[0])
+    kvno = _read_int(fields[1]) if 1 in fields else 0
+    cipher = fields[2].expect(0x04).bytes_()
+    return etype, kvno, cipher
+
+
+def parse_ap_req(der: bytes) -> Dict[str, Any]:
+    """AP-REQ (RFC 4120 §5.5.1) → {sname, srealm, ticket_etype,
+    ticket_cipher, authenticator_etype, authenticator_cipher}."""
+    d = Der(der)
+    tag, app = d.read()
+    if tag != 0x6E:                       # [APPLICATION 14]
+        raise KrbError("not an AP-REQ")
+    seq = app.expect(0x30)
+    fields = _read_ctx_map(seq)
+    if _read_int(fields[0]) != 5 or _read_int(fields[1]) != 14:
+        raise KrbError("bad AP-REQ version/type")
+    tkt_tag, tkt_app = fields[3].read()
+    if tkt_tag != 0x61:                   # [APPLICATION 1] Ticket
+        raise KrbError("AP-REQ carries no Ticket")
+    tkt = _read_ctx_map(tkt_app.expect(0x30))
+    srealm = tkt[1].expect(0x1B).bytes_().decode()
+    sname = _principal_name(tkt[2])
+    t_etype, t_kvno, t_cipher = _enc_part(tkt[3].expect(0x30))
+    a_etype, _a_kvno, a_cipher = _enc_part(fields[4].expect(0x30))
+    return {"srealm": srealm, "sname": sname,
+            "ticket_etype": t_etype, "ticket_kvno": t_kvno,
+            "ticket_cipher": t_cipher,
+            "auth_etype": a_etype, "auth_cipher": a_cipher}
+
+
+KU_TICKET = 2            # key usage: ticket enc-part (krbtgt/service key)
+KU_AP_REQ_AUTH = 11      # key usage: AP-REQ authenticator (session key)
+
+
+def parse_enc_ticket_part(plain: bytes) -> Dict[str, Any]:
+    """Decrypted EncTicketPart → {cname, crealm, endtime, session_key,
+    session_etype}."""
+    d = Der(plain)
+    tag, app = d.read()
+    if tag != 0x63:                       # [APPLICATION 3]
+        raise KrbError("not an EncTicketPart")
+    fields = _read_ctx_map(app.expect(0x30))
+    keyf = _read_ctx_map(fields[1].expect(0x30))
+    session_etype = _read_int(keyf[0])
+    session_key = keyf[1].expect(0x04).bytes_()
+    crealm = fields[2].expect(0x1B).bytes_().decode()
+    cname = _principal_name(fields[3])
+    endtime = None
+    if 7 in fields:
+        t = fields[7].expect(0x18).bytes_().decode()
+        endtime = datetime.datetime.strptime(
+            t, "%Y%m%d%H%M%SZ").replace(tzinfo=datetime.timezone.utc)
+    return {"cname": cname, "crealm": crealm, "endtime": endtime,
+            "session_key": session_key, "session_etype": session_etype}
+
+
+def parse_authenticator(plain: bytes) -> Dict[str, Any]:
+    d = Der(plain)
+    tag, app = d.read()
+    if tag != 0x62:                       # [APPLICATION 2]
+        raise KrbError("not an Authenticator")
+    fields = _read_ctx_map(app.expect(0x30))
+    crealm = fields[1].expect(0x1B).bytes_().decode()
+    cname = _principal_name(fields[2])
+    ctime = None
+    if 5 in fields:
+        t = fields[5].expect(0x18).bytes_().decode()
+        ctime = datetime.datetime.strptime(
+            t, "%Y%m%d%H%M%SZ").replace(tzinfo=datetime.timezone.utc)
+    return {"cname": cname, "crealm": crealm, "ctime": ctime}
+
+
+# ---------------------------------------------------------------------------
+# Builders (fixture/KDC side — the realm tests mint tickets with these)
+# ---------------------------------------------------------------------------
+
+def build_principal(name: str, name_type: int = 1) -> bytes:
+    parts = b"".join(der_gs(p) for p in name.split("/"))
+    return der_tlv(0x30, der_ctx(0, der_int(name_type))
+                   + der_ctx(1, der_tlv(0x30, parts)))
+
+
+def build_enc_ticket_part(cname: str, crealm: str, session_key: bytes,
+                          endtime: datetime.datetime,
+                          etype: int = ETYPE_AES256) -> bytes:
+    body = (der_ctx(0, der_tlv(0x03, b"\x00\x00\x00\x00\x00"))  # flags
+            + der_ctx(1, der_tlv(0x30, der_ctx(0, der_int(etype))
+                                 + der_ctx(1, der_tlv(0x04, session_key))))
+            + der_ctx(2, der_gs(crealm))
+            + der_ctx(3, build_principal(cname))
+            + der_ctx(4, der_tlv(0x30, b""))                   # transited
+            + der_ctx(5, der_time(datetime.datetime.now(
+                datetime.timezone.utc)))
+            + der_ctx(7, der_time(endtime)))
+    return der_tlv(0x63, der_tlv(0x30, body))
+
+
+def build_authenticator(cname: str, crealm: str) -> bytes:
+    now = datetime.datetime.now(datetime.timezone.utc)
+    body = (der_ctx(0, der_int(5))
+            + der_ctx(1, der_gs(crealm))
+            + der_ctx(2, build_principal(cname))
+            + der_ctx(4, der_int(0))
+            + der_ctx(5, der_time(now)))
+    return der_tlv(0x62, der_tlv(0x30, body))
+
+
+def build_ap_req(sname: str, srealm: str, service_key: bytes,
+                 cname: str, crealm: str,
+                 endtime: Optional[datetime.datetime] = None,
+                 etype: int = ETYPE_AES256,
+                 session_key: Optional[bytes] = None) -> bytes:
+    """A full AP-REQ as a client/KDC pair would produce it: ticket
+    enc-part under the SERVICE key (usage 2), authenticator under the
+    session key (usage 11)."""
+    if endtime is None:
+        endtime = datetime.datetime.now(datetime.timezone.utc) \
+            + datetime.timedelta(hours=8)
+    if session_key is None:
+        session_key = os.urandom(32 if etype == ETYPE_AES256 else 16)
+    enc_tkt = krb_encrypt(service_key, KU_TICKET,
+                          build_enc_ticket_part(cname, crealm,
+                                                session_key, endtime,
+                                                etype))
+    ticket = der_tlv(0x61, der_tlv(0x30,
+        der_ctx(0, der_int(5))
+        + der_ctx(1, der_gs(srealm))
+        + der_ctx(2, build_principal(sname, 2))
+        + der_ctx(3, der_tlv(0x30,
+            der_ctx(0, der_int(etype))
+            + der_ctx(1, der_int(1))
+            + der_ctx(2, der_tlv(0x04, enc_tkt))))))
+    enc_auth = krb_encrypt(session_key, KU_AP_REQ_AUTH,
+                           build_authenticator(cname, crealm))
+    body = (der_ctx(0, der_int(5))
+            + der_ctx(1, der_int(14))
+            + der_ctx(2, der_tlv(0x03, b"\x00\x00\x00\x00\x00"))
+            + der_ctx(3, ticket)
+            + der_ctx(4, der_tlv(0x30,
+                der_ctx(0, der_int(etype))
+                + der_ctx(2, der_tlv(0x04, enc_auth)))))
+    return der_tlv(0x6E, der_tlv(0x30, body))
+
+
+# ---------------------------------------------------------------------------
+# Validation (the realm's entry point)
+# ---------------------------------------------------------------------------
+
+def validate_spnego(token: bytes, keytab: Dict[str, bytes],
+                    max_skew: float = 300.0) -> Dict[str, Any]:
+    """SPNEGO/GSS token → {principal, realm} after decrypting the
+    ticket with a keytab key and the authenticator with the session key
+    (ref: KerberosTicketValidator — GSS accept with the keytab).
+    ``keytab`` maps service principal (e.g. "HTTP/es.example.com") to
+    its AES key."""
+    try:
+        return _validate_spnego_inner(token, keytab, max_skew)
+    except KrbError:
+        raise
+    except Exception as e:
+        # this parses fully UNTRUSTED bytes — a malformed token must be
+        # an authentication failure, never an unhandled 500 (missing
+        # context fields → KeyError, empty cursors → IndexError, bad
+        # UTF-8 → UnicodeDecodeError, ...)
+        raise KrbError(f"malformed kerberos token: {type(e).__name__}")
+
+
+def _validate_spnego_inner(token, keytab, max_skew):
+    ap_der = spnego_unwrap(token)
+    ap = parse_ap_req(ap_der)
+    key = keytab.get(ap["sname"])
+    if key is None:
+        raise KrbError(f"no keytab entry for service [{ap['sname']}]")
+    if ap["ticket_etype"] not in (ETYPE_AES128, ETYPE_AES256):
+        raise KrbError(f"unsupported etype [{ap['ticket_etype']}]")
+    tkt = parse_enc_ticket_part(
+        krb_decrypt(key, KU_TICKET, ap["ticket_cipher"]))
+    now = datetime.datetime.now(datetime.timezone.utc)
+    if tkt["endtime"] is not None and now > tkt["endtime"]:
+        raise KrbError("ticket is expired")
+    auth = parse_authenticator(
+        krb_decrypt(tkt["session_key"], KU_AP_REQ_AUTH,
+                    ap["auth_cipher"]))
+    if auth["cname"] != tkt["cname"] or auth["crealm"] != tkt["crealm"]:
+        raise KrbError("authenticator principal does not match ticket")
+    if auth["ctime"] is not None \
+            and abs((now - auth["ctime"]).total_seconds()) > max_skew:
+        raise KrbError("authenticator timestamp outside clock skew")
+    return {"principal": f"{tkt['cname']}@{tkt['crealm']}",
+            "name": tkt["cname"], "realm": tkt["crealm"]}

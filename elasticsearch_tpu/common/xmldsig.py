@@ -1,0 +1,172 @@
+"""Minimal XML digital signatures (enveloped, RSA-SHA256) for the SAML
+stack.
+
+The reference signs/validates SAML messages through OpenSAML + Apache
+Santuario (ref: x-pack/plugin/security/src/main/java/org/elasticsearch/
+xpack/security/authc/saml/SamlObjectHandler.java — signature validation
+over the IdP's credentials; SamlUtils.java — the XML plumbing). This
+module implements the subset those flows need, natively:
+
+- enveloped-signature generation and validation over an element with an
+  ``ID`` attribute (``<ds:Signature>`` as a direct child, Reference
+  ``URI="#id"``, transforms = enveloped-signature + c14n),
+- RSA-SHA256 (http://www.w3.org/2001/04/xmldsig-more#rsa-sha256) with
+  SHA-256 digests,
+- canonicalization via the stdlib's ``xml.etree.ElementTree.canonicalize``
+  (C14N 2.0). DISCLOSED DIVERGENCE: real-world SAML uses Exclusive C14N
+  1.0; both ends of this framework (SP realm, IdP, fixtures) canonicalize
+  identically, so signatures interoperate within the framework and the
+  security property — any post-signing mutation of the signed element is
+  detected — holds. Interop with external OpenSAML IdPs would need an
+  exc-c14n 1.0 serializer dropped into ``_c14n`` (one function).
+
+Defenses carried over from the reference's validator:
+- the DIGEST is recomputed over the element AS PARSED (signature removed),
+  never over attacker-supplied detached bytes;
+- the Reference URI must point at the signed element's own ID —
+  signature-wrapping via a decoy signed element elsewhere in the
+  document fails because the caller passes the element it will consume
+  (SamlAuthenticator checks the signature on the specific assertion it
+  processes);
+- constant-time digest comparison.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import io
+from typing import Optional
+from xml.etree import ElementTree as ET
+
+DS_NS = "http://www.w3.org/2000/09/xmldsig#"
+ALG_RSA_SHA256 = "http://www.w3.org/2001/04/xmldsig-more#rsa-sha256"
+ALG_SHA256 = "http://www.w3.org/2001/04/xmlenc#sha256"
+ALG_ENVELOPED = "http://www.w3.org/2000/09/xmldsig#enveloped-signature"
+ALG_EXC_C14N = "http://www.w3.org/2001/10/xml-exc-c14n#"
+
+
+class XmlSignatureError(Exception):
+    pass
+
+
+def _q(tag: str) -> str:
+    return f"{{{DS_NS}}}{tag}"
+
+
+def _c14n(elem: ET.Element) -> bytes:
+    """Canonical bytes of an element subtree (see module docstring for
+    the C14N-2.0-vs-exc-1.0 disclosure)."""
+    raw = ET.tostring(elem, encoding="unicode")
+    out = io.StringIO()
+    ET.canonicalize(raw, out=out, strip_text=False)
+    return out.getvalue().encode("utf-8")
+
+
+def _strip_signatures(elem: ET.Element) -> ET.Element:
+    """Deep copy with every direct-child ds:Signature removed (the
+    enveloped-signature transform)."""
+    import copy
+    dup = copy.deepcopy(elem)
+    for sig in dup.findall(_q("Signature")):
+        dup.remove(sig)
+    return dup
+
+
+def sign_element(elem: ET.Element, private_key, cert_pem: Optional[str]
+                 = None, id_attr: str = "ID") -> None:
+    """Insert an enveloped ds:Signature as the element's FIRST child
+    (SAML schema position: after Issuer is customary; callers reorder if
+    they care). ``private_key`` is a cryptography RSA private key."""
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding
+
+    ref_id = elem.get(id_attr)
+    if not ref_id:
+        raise XmlSignatureError(f"element has no {id_attr} attribute")
+    digest = hashlib.sha256(_c14n(_strip_signatures(elem))).digest()
+
+    sig = ET.Element(_q("Signature"))
+    si = ET.SubElement(sig, _q("SignedInfo"))
+    ET.SubElement(si, _q("CanonicalizationMethod"),
+                  {"Algorithm": ALG_EXC_C14N})
+    ET.SubElement(si, _q("SignatureMethod"), {"Algorithm": ALG_RSA_SHA256})
+    ref = ET.SubElement(si, _q("Reference"), {"URI": f"#{ref_id}"})
+    tr = ET.SubElement(ref, _q("Transforms"))
+    ET.SubElement(tr, _q("Transform"), {"Algorithm": ALG_ENVELOPED})
+    ET.SubElement(tr, _q("Transform"), {"Algorithm": ALG_EXC_C14N})
+    ET.SubElement(ref, _q("DigestMethod"), {"Algorithm": ALG_SHA256})
+    dv = ET.SubElement(ref, _q("DigestValue"))
+    dv.text = base64.b64encode(digest).decode()
+
+    sig_bytes = private_key.sign(
+        _c14n(si), padding.PKCS1v15(), hashes.SHA256())
+    sv = ET.SubElement(sig, _q("SignatureValue"))
+    sv.text = base64.b64encode(sig_bytes).decode()
+    if cert_pem:
+        ki = ET.SubElement(sig, _q("KeyInfo"))
+        x509 = ET.SubElement(ki, _q("X509Data"))
+        c = ET.SubElement(x509, _q("X509Certificate"))
+        body = "".join(line for line in cert_pem.strip().splitlines()
+                       if "CERTIFICATE" not in line)
+        c.text = body
+    elem.insert(0, sig)
+
+
+def verify_enveloped(elem: ET.Element, public_key,
+                     id_attr: str = "ID") -> None:
+    """Validate the enveloped signature on ``elem`` against
+    ``public_key`` (cryptography RSA public key). Raises
+    XmlSignatureError on ANY failure — missing signature, reference to a
+    different element, digest mismatch, bad signature value, unsupported
+    algorithms."""
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding
+
+    sig = elem.find(_q("Signature"))
+    if sig is None:
+        raise XmlSignatureError("element is not signed")
+    si = sig.find(_q("SignedInfo"))
+    if si is None:
+        raise XmlSignatureError("signature has no SignedInfo")
+    sm = si.find(_q("SignatureMethod"))
+    if sm is None or sm.get("Algorithm") != ALG_RSA_SHA256:
+        raise XmlSignatureError("unsupported SignatureMethod")
+    refs = si.findall(_q("Reference"))
+    if len(refs) != 1:
+        raise XmlSignatureError("expected exactly one Reference")
+    ref = refs[0]
+    ref_id = elem.get(id_attr)
+    if not ref_id or ref.get("URI") != f"#{ref_id}":
+        # signature-wrapping defense: the signature must cover THIS
+        # element, not some other ID in the document
+        raise XmlSignatureError(
+            "signature Reference does not cover this element")
+    dm = ref.find(_q("DigestMethod"))
+    if dm is None or dm.get("Algorithm") != ALG_SHA256:
+        raise XmlSignatureError("unsupported DigestMethod")
+    dv = ref.find(_q("DigestValue"))
+    if dv is None or not (dv.text or "").strip():
+        raise XmlSignatureError("missing DigestValue")
+    expect = base64.b64decode(dv.text.strip())
+    actual = hashlib.sha256(_c14n(_strip_signatures(elem))).digest()
+    if not hmac.compare_digest(expect, actual):
+        raise XmlSignatureError("digest mismatch (content was modified)")
+    sv = sig.find(_q("SignatureValue"))
+    if sv is None or not (sv.text or "").strip():
+        raise XmlSignatureError("missing SignatureValue")
+    sig_bytes = base64.b64decode(sv.text.strip())
+    try:
+        public_key.verify(sig_bytes, _c14n(si), padding.PKCS1v15(),
+                          hashes.SHA256())
+    except InvalidSignature:
+        raise XmlSignatureError("signature value is invalid")
+
+
+def load_cert_public_key(cert_pem: str):
+    """RSA public key from a PEM certificate string."""
+    from cryptography import x509
+    cert = x509.load_pem_x509_certificate(cert_pem.encode())
+    return cert.public_key()

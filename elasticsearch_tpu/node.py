@@ -161,7 +161,20 @@ class Node:
                 for k in ("op.issuer", "op.jwks_path", "rp.client_id",
                           "claims.principal", "claims.groups")
                 if settings.get(
-                    f"xpack.security.authc.oidc.{k}") is not None})
+                    f"xpack.security.authc.oidc.{k}") is not None},
+            saml_config={
+                k: settings.get(f"xpack.security.authc.saml.{k}")
+                for k in ("idp.entity_id", "idp.certificate",
+                          "idp.sso_url", "sp.entity_id", "sp.acs",
+                          "attributes.principal", "attributes.groups",
+                          "clock_skew")
+                if settings.get(
+                    f"xpack.security.authc.saml.{k}") is not None},
+            kerberos_config={
+                k: settings.get(f"xpack.security.authc.kerberos.{k}")
+                for k in ("keytab_path", "remove_realm_name")
+                if settings.get(
+                    f"xpack.security.authc.kerberos.{k}") is not None})
         from elasticsearch_tpu.xpack.sql import SqlService
         self.sql_service = SqlService(self)
         from elasticsearch_tpu.xpack.eql import EqlService

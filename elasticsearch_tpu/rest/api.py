@@ -57,16 +57,33 @@ class RestController:
         path = path.rstrip("/") or "/"
         sec = getattr(self.node, "security_service", None)
         self.node.request_context.user = None
-        if sec is not None and sec.enabled:
+        # SSO login endpoints authenticate by their OWN payload (the
+        # IdP's signed response / the token being invalidated), never by
+        # request headers (ref: RestSamlAuthenticateAction et al. are
+        # exempt from the authentication filter)
+        auth_exempt = path in (
+            "/_security/saml/prepare", "/_security/saml/authenticate",
+            "/_security/saml/logout")
+        if sec is not None and sec.enabled and not auth_exempt:
             from elasticsearch_tpu.xpack.security import required_privilege
             try:
                 user = sec.authenticate(headers)
             except ElasticsearchTpuException as e:
                 sec.audit.authentication_failed(method, path, str(e))
+                # authentication challenges (ref: the reference's 401s
+                # carry WWW-Authenticate for every enabled scheme, incl.
+                # Negotiate when a Kerberos realm is configured —
+                # standards SPNEGO clients won't send a token unsolicited)
+                challenges = ['Basic realm="security" charset="UTF-8"',
+                              "Bearer realm=\"security\"",
+                              "ApiKey"]
+                if any(r.type == "kerberos" for r in sec.realms):
+                    challenges.insert(0, "Negotiate")
                 return e.status, {
                     "error": {**e.to_xcontent(),
                               "root_cause": [e.to_xcontent()]},
                     "status": e.status,
+                    "_headers": {"WWW-Authenticate": ", ".join(challenges)},
                 }
             sec.audit.authentication_success(
                 user, user.authenticated_realm or "__anonymous__",
@@ -431,6 +448,10 @@ def _register_all(c: RestController):
     c.register("DELETE", "/_security/oauth2/token",
                security_invalidate_token)
     c.register("POST", "/_security/delegate_pki", security_delegate_pki)
+    c.register("POST", "/_security/saml/prepare", security_saml_prepare)
+    c.register("POST", "/_security/saml/authenticate",
+               security_saml_authenticate)
+    c.register("POST", "/_security/saml/logout", security_saml_logout)
     c.register("PUT", "/_security/role_mapping/{name}",
                security_put_role_mapping)
     c.register("POST", "/_security/role_mapping/{name}",
@@ -1966,6 +1987,25 @@ def security_invalidate_token(node, params, body):
         request_user=_current_user(node))
     return 200, {"invalidated_tokens": n, "previously_invalidated_tokens": 0,
                  "error_count": 0}
+
+
+def security_saml_prepare(node, params, body):
+    """POST /_security/saml/prepare (ref:
+    RestSamlPrepareAuthenticationAction)."""
+    return 200, node.security_service.saml_prepare()
+
+
+def security_saml_authenticate(node, params, body):
+    """POST /_security/saml/authenticate (ref:
+    RestSamlAuthenticateAction): {"content": base64 SAMLResponse}."""
+    content = (body or {}).get("content", "")
+    return 200, node.security_service.saml_authenticate(content)
+
+
+def security_saml_logout(node, params, body):
+    """POST /_security/saml/logout (ref: RestSamlLogoutAction)."""
+    return 200, node.security_service.saml_logout(
+        (body or {}).get("token", ""))
 
 
 def security_delegate_pki(node, params, body):

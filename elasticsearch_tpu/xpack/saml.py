@@ -1,0 +1,377 @@
+"""SAML 2.0 SP realm + IdP — the web-SSO half of the security stack.
+
+Reference parity:
+- SP realm: ref x-pack/plugin/security/src/main/java/org/elasticsearch/
+  xpack/security/authc/saml/SamlRealm.java (realm wiring, settings),
+  SamlAuthenticator.java (response/assertion validation and attribute
+  extraction), SamlAuthnRequestBuilder.java + SamlRedirect.java
+  (AuthnRequest via the redirect binding: deflate+base64+URL-encode),
+  SamlLogoutRequestMessageBuilder.java (SP-initiated logout).
+- REST surface: ref RestSamlPrepareAuthenticationAction /
+  RestSamlAuthenticateAction / RestSamlInvalidateSessionAction (the
+  /_security/saml/* APIs that a web front calls — ES itself is the SP
+  but the browser dance happens outside, so these are JSON APIs, not
+  redirect endpoints).
+- IdP: ref x-pack/plugin/identity-provider/ (SamlIdentityProviderPlugin
+  — a minimal IdP that issues signed assertions for registered SPs).
+
+The XML signature core is common/xmldsig.py (enveloped RSA-SHA256; its
+canonicalization divergence from exc-c14n 1.0 is disclosed there).
+
+Validation rules carried over from SamlAuthenticator/SamlResponseHandler:
+- the Response's Issuer must match the configured IdP entity id;
+- a signature is REQUIRED on the Response or on the Assertion (an
+  unsigned pair is rejected outright);
+- Conditions/NotBefore..NotOnOrAfter bound the clock (with skew),
+- AudienceRestriction must contain the SP entity id;
+- InResponseTo (when present) must match an outstanding request id the
+  caller supplies (ref: SamlAuthenticator checks allowedSamlRequestIds);
+- Status/StatusCode must be success;
+- SubjectConfirmationData Recipient must be the SP's ACS (when present).
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime
+import os
+import secrets
+import time
+import zlib
+from typing import Any, Dict, List, Optional
+from xml.etree import ElementTree as ET
+
+from elasticsearch_tpu.common.xmldsig import (XmlSignatureError,
+                                              load_cert_public_key,
+                                              sign_element,
+                                              verify_enveloped)
+
+SAML_NS = "urn:oasis:names:tc:SAML:2.0:assertion"
+SAMLP_NS = "urn:oasis:names:tc:SAML:2.0:protocol"
+STATUS_SUCCESS = "urn:oasis:names:tc:SAML:2.0:status:Success"
+NAMEID_TRANSIENT = "urn:oasis:names:tc:SAML:2.0:nameid-format:transient"
+BEARER = "urn:oasis:names:tc:SAML:2.0:cm:bearer"
+
+
+class SamlException(Exception):
+    pass
+
+
+def _a(tag):
+    return f"{{{SAML_NS}}}{tag}"
+
+
+def _p(tag):
+    return f"{{{SAMLP_NS}}}{tag}"
+
+
+def _now():
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def _ts(dt) -> str:
+    return dt.strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _parse_ts(s: str) -> float:
+    """xs:dateTime → epoch seconds; honors fractional seconds and
+    numeric timezone offsets; raises SamlException on garbage."""
+    try:
+        t = s.strip()
+        if t.endswith("Z"):
+            t = t[:-1] + "+00:00"
+        dt = datetime.datetime.fromisoformat(t)
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=datetime.timezone.utc)
+        return dt.timestamp()
+    except ValueError:
+        raise SamlException(f"invalid SAML timestamp [{s}]")
+
+
+def _rand_id() -> str:
+    return "_" + secrets.token_hex(16)
+
+
+class SpConfig:
+    """SP-side settings (ref: SpConfiguration.java — entity_id, ACS,
+    logout endpoint)."""
+
+    def __init__(self, entity_id: str, acs: str,
+                 logout: Optional[str] = None):
+        self.entity_id = entity_id
+        self.acs = acs
+        self.logout = logout
+
+
+class SamlAuthnFlow:
+    """The SP protocol engine shared by the realm and tests.
+
+    clock_skew: tolerated seconds on NotBefore/NotOnOrAfter (ref:
+    SamlRealmSettings.CLOCK_SKEW, default 3m)."""
+
+    def __init__(self, sp: SpConfig, idp_entity_id: str,
+                 idp_cert_pem: str, clock_skew: float = 180.0):
+        self.sp = sp
+        self.idp_entity_id = idp_entity_id
+        self._idp_key = load_cert_public_key(idp_cert_pem)
+        self.clock_skew = clock_skew
+
+    # ------------------------------------------------------------ prepare
+    def build_authn_request(self, idp_sso_url: str) -> Dict[str, str]:
+        """(id, redirect_url) for the redirect binding: the AuthnRequest
+        XML, deflated (raw), base64'd, URL-escaped onto the SSO URL
+        (ref: SamlRedirect.getRedirectUrl)."""
+        import urllib.parse
+        rid = _rand_id()
+        req = ET.Element(_p("AuthnRequest"), {
+            "ID": rid, "Version": "2.0", "IssueInstant": _ts(_now()),
+            "Destination": idp_sso_url,
+            "AssertionConsumerServiceURL": self.sp.acs,
+            "ProtocolBinding":
+                "urn:oasis:names:tc:SAML:2.0:bindings:HTTP-POST"})
+        iss = ET.SubElement(req, _a("Issuer"))
+        iss.text = self.sp.entity_id
+        xml = ET.tostring(req)
+        deflated = zlib.compress(xml, 9)[2:-4]     # raw DEFLATE
+        param = urllib.parse.quote_plus(base64.b64encode(deflated))
+        sep = "&" if "?" in idp_sso_url else "?"
+        return {"id": rid,
+                "redirect": f"{idp_sso_url}{sep}SAMLRequest={param}"}
+
+    # ------------------------------------------------------- authenticate
+    def authenticate(self, content_b64: str,
+                     allowed_request_ids: Optional[List[str]] = None
+                     ) -> Dict[str, Any]:
+        """Validate a base64 SAMLResponse; returns {principal, nameid,
+        session_index, attributes{name: [values]}} or raises
+        SamlException (ref: SamlAuthenticator.authenticate)."""
+        try:
+            xml = base64.b64decode(content_b64, validate=True)
+        except Exception:
+            raise SamlException("SAML content is not valid base64")
+        try:
+            root = ET.fromstring(xml)
+        except ET.ParseError as e:
+            raise SamlException(f"SAML content is not valid XML: {e}")
+        if root.tag != _p("Response"):
+            raise SamlException(
+                f"SAML content root [{root.tag}] is not a "
+                f"samlp:Response")
+        status = root.find(f"{_p('Status')}/{_p('StatusCode')}")
+        if status is None or status.get("Value") != STATUS_SUCCESS:
+            raise SamlException("SAML response status is not success")
+        irt = root.get("InResponseTo")
+        if irt and allowed_request_ids is not None \
+                and irt not in allowed_request_ids:
+            raise SamlException(
+                f"SAML response InResponseTo [{irt}] does not match any "
+                f"outstanding request id")
+        iss = root.find(_a("Issuer"))
+        if iss is not None and (iss.text or "").strip() \
+                and iss.text.strip() != self.idp_entity_id:
+            raise SamlException(
+                f"SAML response issuer [{iss.text.strip()}] does not "
+                f"match the configured IdP [{self.idp_entity_id}]")
+
+        response_signed = False
+        if root.find(f"{{{'http://www.w3.org/2000/09/xmldsig#'}}}"
+                     "Signature") is not None:
+            try:
+                verify_enveloped(root, self._idp_key)
+                response_signed = True
+            except XmlSignatureError as e:
+                raise SamlException(f"SAML response signature: {e}")
+
+        assertions = root.findall(_a("Assertion"))
+        if len(assertions) != 1:
+            raise SamlException(
+                f"SAML response contains {len(assertions)} assertions "
+                f"(expected exactly 1)")
+        assertion = assertions[0]
+        if not response_signed:
+            try:
+                verify_enveloped(assertion, self._idp_key)
+            except XmlSignatureError as e:
+                raise SamlException(f"SAML assertion signature: {e}")
+
+        a_iss = assertion.find(_a("Issuer"))
+        if a_iss is not None and (a_iss.text or "").strip() != \
+                self.idp_entity_id:
+            raise SamlException("SAML assertion issuer mismatch")
+        self._check_conditions(assertion)
+        self._check_subject(assertion)
+
+        nameid_el = assertion.find(f"{_a('Subject')}/{_a('NameID')}")
+        nameid = (nameid_el.text or "").strip() \
+            if nameid_el is not None else None
+        authn = assertion.find(_a("AuthnStatement"))
+        session_index = authn.get("SessionIndex") \
+            if authn is not None else None
+        attrs: Dict[str, List[str]] = {}
+        for att in assertion.findall(
+                f"{_a('AttributeStatement')}/{_a('Attribute')}"):
+            name = att.get("Name") or ""
+            vals = [(v.text or "").strip()
+                    for v in att.findall(_a("AttributeValue"))]
+            attrs.setdefault(name, []).extend(vals)
+        aid = assertion.get("ID")
+        if not aid:
+            # the schema requires ID; without one replay tracking is
+            # impossible, so the assertion is unacceptable
+            raise SamlException("SAML assertion has no ID attribute")
+        # the latest instant this assertion is acceptable (drives the
+        # consumer's replay-table retention)
+        expiries = []
+        cond = assertion.find(_a("Conditions"))
+        if cond is not None and cond.get("NotOnOrAfter"):
+            expiries.append(_parse_ts(cond.get("NotOnOrAfter")))
+        scd = assertion.find(
+            f"{_a('Subject')}/{_a('SubjectConfirmation')}"
+            f"/{_a('SubjectConfirmationData')}")
+        if scd is not None and scd.get("NotOnOrAfter"):
+            expiries.append(_parse_ts(scd.get("NotOnOrAfter")))
+        return {"principal": nameid, "nameid": nameid,
+                "session_index": session_index, "attributes": attrs,
+                "assertion_id": aid,
+                "not_on_or_after": min(expiries) + self.clock_skew,
+                "in_response_to": irt}
+
+    def _check_conditions(self, assertion):
+        """An assertion with no Conditions would be valid forever and
+        for every SP — REQUIRED, with an expiry and a matching audience
+        (ref: SamlAuthenticator.checkConditions rejects assertions
+        whose conditions are absent/expired/mis-audienced)."""
+        cond = assertion.find(_a("Conditions"))
+        now = time.time()
+        if cond is None:
+            raise SamlException("SAML assertion has no Conditions")
+        nb = cond.get("NotBefore")
+        if nb and now + self.clock_skew < _parse_ts(nb):
+            raise SamlException("SAML assertion is not yet valid "
+                                "(NotBefore)")
+        noa = cond.get("NotOnOrAfter")
+        if not noa:
+            raise SamlException(
+                "SAML assertion Conditions carry no NotOnOrAfter")
+        if now - self.clock_skew >= _parse_ts(noa):
+            raise SamlException("SAML assertion has expired "
+                                "(NotOnOrAfter)")
+        auds = [((a.text or "").strip()) for a in cond.findall(
+            f"{_a('AudienceRestriction')}/{_a('Audience')}")]
+        if self.sp.entity_id not in auds:
+            raise SamlException(
+                f"SAML assertion audience {auds} does not include "
+                f"the SP [{self.sp.entity_id}]")
+
+    def _check_subject(self, assertion):
+        """Bearer confirmation with a bounded, ACS-addressed
+        SubjectConfirmationData is REQUIRED (ref:
+        SamlAuthenticator.checkSubject — bearer assertions without a
+        NotOnOrAfter-bearing SubjectConfirmationData are rejected)."""
+        scd = assertion.find(
+            f"{_a('Subject')}/{_a('SubjectConfirmation')}"
+            f"/{_a('SubjectConfirmationData')}")
+        if scd is None:
+            raise SamlException(
+                "SAML assertion has no SubjectConfirmationData")
+        rec = scd.get("Recipient")
+        if rec and rec != self.sp.acs:
+            raise SamlException(
+                f"SAML SubjectConfirmationData recipient [{rec}] is not "
+                f"the SP ACS [{self.sp.acs}]")
+        noa = scd.get("NotOnOrAfter")
+        if not noa:
+            raise SamlException(
+                "SAML SubjectConfirmationData carries no NotOnOrAfter")
+        if time.time() - self.clock_skew >= _parse_ts(noa):
+            raise SamlException(
+                "SAML subject confirmation has expired")
+
+
+# ---------------------------------------------------------------------------
+# Identity provider (ref: x-pack/plugin/identity-provider — the IdP that
+# issues signed assertions to registered service providers)
+# ---------------------------------------------------------------------------
+
+class SamlIdentityProvider:
+    """Minimal SAML IdP: registered SPs (entity id → ACS), signed
+    Response+Assertion issuance for an authenticated principal (ref:
+    identity-provider SuccessfulAuthenticationResponseMessageBuilder).
+    """
+
+    def __init__(self, entity_id: str, private_key_pem: bytes,
+                 cert_pem: str, session_ttl: float = 300.0):
+        from cryptography.hazmat.primitives import serialization
+        self.entity_id = entity_id
+        self._key = serialization.load_pem_private_key(
+            private_key_pem, password=None)
+        self._cert_pem = cert_pem
+        self.session_ttl = session_ttl
+        self._sps: Dict[str, Dict[str, Any]] = {}
+
+    def register_sp(self, entity_id: str, acs: str,
+                    attributes: Optional[Dict[str, str]] = None):
+        """ref: identity-provider PutSamlServiceProviderAction."""
+        self._sps[entity_id] = {"acs": acs,
+                                "attributes": attributes or {}}
+
+    def sp_registered(self, entity_id: str) -> bool:
+        return entity_id in self._sps
+
+    def issue_response(self, sp_entity_id: str, principal: str,
+                       groups: Optional[List[str]] = None,
+                       in_response_to: Optional[str] = None,
+                       sign_assertion_only: bool = False) -> str:
+        """base64 samlp:Response with a signed assertion for the SP."""
+        sp = self._sps.get(sp_entity_id)
+        if sp is None:
+            raise SamlException(
+                f"service provider [{sp_entity_id}] is not registered")
+        now = _now()
+        later = now + datetime.timedelta(seconds=self.session_ttl)
+        resp_attrs = {"ID": _rand_id(), "Version": "2.0",
+                      "IssueInstant": _ts(now),
+                      "Destination": sp["acs"]}
+        if in_response_to:
+            resp_attrs["InResponseTo"] = in_response_to
+        resp = ET.Element(_p("Response"), resp_attrs)
+        riss = ET.SubElement(resp, _a("Issuer"))
+        riss.text = self.entity_id
+        st = ET.SubElement(resp, _p("Status"))
+        ET.SubElement(st, _p("StatusCode"), {"Value": STATUS_SUCCESS})
+
+        asrt = ET.Element(_a("Assertion"), {
+            "ID": _rand_id(), "Version": "2.0", "IssueInstant": _ts(now)})
+        aiss = ET.SubElement(asrt, _a("Issuer"))
+        aiss.text = self.entity_id
+        subj = ET.SubElement(asrt, _a("Subject"))
+        nid = ET.SubElement(subj, _a("NameID"),
+                            {"Format": NAMEID_TRANSIENT})
+        nid.text = principal
+        sc = ET.SubElement(subj, _a("SubjectConfirmation"),
+                           {"Method": BEARER})
+        scd_attrs = {"Recipient": sp["acs"], "NotOnOrAfter": _ts(later)}
+        if in_response_to:
+            scd_attrs["InResponseTo"] = in_response_to
+        ET.SubElement(sc, _a("SubjectConfirmationData"), scd_attrs)
+        cond = ET.SubElement(asrt, _a("Conditions"), {
+            "NotBefore": _ts(now - datetime.timedelta(seconds=5)),
+            "NotOnOrAfter": _ts(later)})
+        ar = ET.SubElement(cond, _a("AudienceRestriction"))
+        aud = ET.SubElement(ar, _a("Audience"))
+        aud.text = sp_entity_id
+        ET.SubElement(asrt, _a("AuthnStatement"), {
+            "AuthnInstant": _ts(now),
+            "SessionIndex": _rand_id()})
+        if groups:
+            ast = ET.SubElement(asrt, _a("AttributeStatement"))
+            att = ET.SubElement(ast, _a("Attribute"),
+                                {"Name": "groups"})
+            for g in groups:
+                v = ET.SubElement(att, _a("AttributeValue"))
+                v.text = g
+        sign_element(asrt, self._key, self._cert_pem)
+        resp.append(asrt)
+        if not sign_assertion_only:
+            sign_element(resp, self._key, self._cert_pem)
+        return base64.b64encode(ET.tostring(resp)).decode()

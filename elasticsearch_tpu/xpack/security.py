@@ -540,10 +540,16 @@ class OidcRealm(Realm):
 
     type = "oidc"
 
+    # OP signing keys rotate; cache the JWKS briefly and re-fetch when a
+    # token presents an unknown kid (rate-limited by the TTL) instead of
+    # pinning the first fetch for the process lifetime
+    JWKS_TTL = 300.0
+
     def __init__(self, name, order, svc, config: Dict[str, Any]):
         super().__init__(name, order, svc)
         self.config = config or {}
         self._jwks_cache: Optional[Dict[str, Any]] = None
+        self._jwks_fetched = 0.0
 
     def token(self, headers):
         if not self.config.get("op.jwks_path"):
@@ -564,8 +570,10 @@ class OidcRealm(Realm):
             return None
         return tok
 
-    def _jwks(self) -> Dict[str, Any]:
-        if self._jwks_cache is not None:
+    def _jwks(self, force: bool = False) -> Dict[str, Any]:
+        now = time.time()
+        if (self._jwks_cache is not None and not force
+                and now - self._jwks_fetched < self.JWKS_TTL):
             return self._jwks_cache
         path = self.config["op.jwks_path"]
         try:
@@ -577,25 +585,43 @@ class OidcRealm(Realm):
                 with open(path) as fh:
                     data = json.load(fh)
         except (OSError, ValueError) as e:
+            if self._jwks_cache is not None:
+                # keep serving the stale set rather than failing closed
+                # on a transient refresh error
+                self._jwks_fetched = now
+                return self._jwks_cache
             raise AuthenticationException(
                 f"unable to load OP JWKS [{path}]: {e}")
         self._jwks_cache = data
+        self._jwks_fetched = now
         return data
 
     def _key_for(self, kid: Optional[str]):
         from cryptography.hazmat.primitives.asymmetric import rsa
-        for jwk in self._jwks().get("keys", []):
-            if jwk.get("kty") != "RSA":
-                continue
-            if kid is not None and jwk.get("kid") not in (None, kid):
-                continue
-            n_int = int.from_bytes(
-                JwtRealm._b64url(jwk["n"]), "big")
-            e_int = int.from_bytes(
-                JwtRealm._b64url(jwk["e"]), "big")
-            return rsa.RSAPublicNumbers(e_int, n_int).public_key()
-        raise AuthenticationException(
-            f"no RSA key [{kid}] in the OP JWKS")
+
+        def find(jwks):
+            for jwk in jwks.get("keys", []):
+                if jwk.get("kty") != "RSA":
+                    continue
+                if kid is not None and jwk.get("kid") not in (None, kid):
+                    continue
+                n_int = int.from_bytes(
+                    JwtRealm._b64url(jwk["n"]), "big")
+                e_int = int.from_bytes(
+                    JwtRealm._b64url(jwk["e"]), "big")
+                return rsa.RSAPublicNumbers(e_int, n_int).public_key()
+            return None
+
+        key = find(self._jwks())
+        if key is None and kid is not None \
+                and time.time() - self._jwks_fetched >= 1.0:
+            # unknown kid: the OP may have rotated — one forced re-fetch
+            # (rate-limited) before rejecting
+            key = find(self._jwks(force=True))
+        if key is None:
+            raise AuthenticationException(
+                f"no RSA key [{kid}] in the OP JWKS")
+        return key
 
     def authenticate(self, tok: str) -> "User":
         from cryptography.exceptions import InvalidSignature
@@ -631,8 +657,11 @@ class OidcRealm(Realm):
             if client_id not in auds:
                 raise AuthenticationException(
                     "OIDC token audience mismatch")
-        if claims.get("exp") is not None \
-                and claims["exp"] < time.time():
+        if claims.get("exp") is None:
+            # OIDC ID tokens REQUIRE exp (OpenID Core §2); accepting a
+            # token without one means accepting it forever
+            raise AuthenticationException("OIDC token has no exp claim")
+        if claims["exp"] < time.time():
             raise AuthenticationException("OIDC token is expired")
         principal_claim = self.config.get("claims.principal", "sub")
         principal = claims.get(principal_claim)
@@ -649,6 +678,115 @@ class OidcRealm(Realm):
                     metadata={"oidc_claims": {
                         k: v for k, v in claims.items()
                         if k not in ("exp", "iat")}})
+
+
+class SamlRealm(Realm):
+    """SAML 2.0 SP realm (ref: x-pack/plugin/security/.../authc/saml/
+    SamlRealm.java:132). SAML credentials do not arrive on request
+    headers — the browser posts the IdP's SAMLResponse to the web front,
+    which calls POST /_security/saml/authenticate; the service routes
+    that content here (ref: TransportSamlAuthenticateAction →
+    SamlRealm.authenticate(SamlToken)). ``token()`` therefore always
+    returns None.
+
+    Config (xpack.security.authc.saml.*): ``idp.entity_id``,
+    ``idp.certificate`` (PEM path or inline PEM), ``idp.sso_url``,
+    ``sp.entity_id``, ``sp.acs``, ``attributes.principal`` (attribute
+    name or "nameid"), ``attributes.groups`` (default "groups")."""
+
+    type = "saml"
+
+    def __init__(self, name, order, svc, config: Dict[str, Any]):
+        super().__init__(name, order, svc)
+        self.config = config or {}
+        self._flow = None
+        # outstanding AuthnRequest ids (ref: SamlAuthenticator
+        # allowedSamlRequestIds — the REST API passes stored ids back);
+        # consumed on success so a captured response can't be replayed
+        self._pending_ids: Dict[str, float] = {}
+        # assertion IDs already accepted (IdP-initiated flows carry no
+        # InResponseTo; without this an unsolicited response replays)
+        self._seen_assertions: Dict[str, float] = {}
+
+    def _get_flow(self):
+        if self._flow is None:
+            from elasticsearch_tpu.xpack.saml import SamlAuthnFlow, SpConfig
+            cert = self.config.get("idp.certificate", "")
+            if cert and "BEGIN CERTIFICATE" not in cert:
+                with open(cert) as fh:
+                    cert = fh.read()
+            self._flow = SamlAuthnFlow(
+                SpConfig(self.config.get("sp.entity_id", ""),
+                         self.config.get("sp.acs", "")),
+                self.config.get("idp.entity_id", ""), cert,
+                clock_skew=float(self.config.get("clock_skew", 180.0)))
+        return self._flow
+
+    def prepare(self) -> Dict[str, str]:
+        """AuthnRequest for the redirect binding (ref:
+        TransportSamlPrepareAuthenticationAction)."""
+        out = self._get_flow().build_authn_request(
+            self.config.get("idp.sso_url", ""))
+        now = time.time()
+        self._pending_ids = {i: t for i, t in self._pending_ids.items()
+                             if now - t < 600}
+        if len(self._pending_ids) >= 10_000:
+            # evict oldest — an unauthenticated prepare() flood must
+            # never lock legitimate logins out by filling the table
+            for victim, _t in sorted(self._pending_ids.items(),
+                                     key=lambda kv: kv[1])[:1000]:
+                del self._pending_ids[victim]
+        self._pending_ids[out["id"]] = now
+        return out
+
+    def authenticate(self, content_b64: str) -> "User":
+        from elasticsearch_tpu.xpack.saml import SamlException
+        try:
+            res = self._get_flow().authenticate(
+                content_b64, allowed_request_ids=list(self._pending_ids))
+        except SamlException as e:
+            raise AuthenticationException(f"SAML authentication "
+                                          f"failed: {e}")
+        # replay defenses: a request id authenticates ONCE, and an
+        # accepted assertion ID is never accepted again for as long as
+        # the assertion itself remains valid (covers the IdP-initiated
+        # flow, which has no InResponseTo; the flow rejects assertions
+        # without an ID or expiry, so every accepted one is trackable)
+        if res.get("in_response_to"):
+            self._pending_ids.pop(res["in_response_to"], None)
+        aid = res["assertion_id"]
+        now = time.time()
+        self._seen_assertions = {
+            i: exp for i, exp in self._seen_assertions.items()
+            if exp > now}
+        if aid in self._seen_assertions:
+            raise AuthenticationException(
+                "SAML assertion has already been consumed (replay)")
+        if len(self._seen_assertions) >= 100_000:
+            # evict the soonest-expiring — the defense must not fail
+            # open under table pressure
+            for victim, _e in sorted(self._seen_assertions.items(),
+                                     key=lambda kv: kv[1])[:1000]:
+                del self._seen_assertions[victim]
+        self._seen_assertions[aid] = res["not_on_or_after"]
+        attrs = res["attributes"]
+        p_attr = self.config.get("attributes.principal", "nameid")
+        if p_attr == "nameid":
+            principal = res["principal"]
+        else:
+            vals = attrs.get(p_attr, [])
+            principal = vals[0] if vals else None
+        if not principal:
+            raise AuthenticationException(
+                "SAML assertion carries no usable principal")
+        g_attr = self.config.get("attributes.groups", "groups")
+        groups = attrs.get(g_attr, [])
+        roles = self.svc.mapped_roles(username=principal, dn="",
+                                      realm=self.name, groups=groups)
+        return User(principal, roles,
+                    metadata={"saml_nameid": res["nameid"],
+                              "saml_session": res["session_index"],
+                              "saml_attributes": attrs})
 
 
 class LdapRealm(Realm):
@@ -718,13 +856,35 @@ class LdapRealm(Realm):
                     metadata={"ldap_dn": user_dn,
                               "ldap_groups": groups})
 
+    @staticmethod
+    def _escape_dn_value(value: str) -> str:
+        """RFC 4514 escaping for an attribute VALUE substituted into a
+        DN template — without it a username like ``x,ou=admins``
+        rewrites the bind DN (the reference escapes via UnboundID's
+        DN/RDN encoder before template substitution)."""
+        if "\x00" in value:
+            raise AuthenticationException(
+                "invalid character in LDAP username")
+        out = []
+        for i, ch in enumerate(value):
+            if ch in ',+"\\<>;=':
+                out.append("\\" + ch)
+            elif ch in "# " and i == 0:
+                out.append("\\" + ch)
+            elif ch == " " and i == len(value) - 1:
+                out.append("\\ ")
+            else:
+                out.append(ch)
+        return "".join(out)
+
     def _bind_user(self, username: str, password: str):
         """The user's DN on successful bind, else None."""
         from elasticsearch_tpu.common.ldap import LdapError
         templates = self.config.get("user_dn_templates") or []
         if templates:
+            safe = self._escape_dn_value(username)
             for tpl in templates:
-                dn = tpl.replace("{0}", username)
+                dn = tpl.replace("{0}", safe)
                 with self._connect() as c:
                     try:
                         if c.simple_bind(dn, password):
@@ -781,6 +941,88 @@ class LdapRealm(Realm):
             groups.append(dn)
             groups.extend(attrs.get("cn", []))
         return groups
+
+
+class KerberosRealm(Realm):
+    """Kerberos/SPNEGO realm (ref: x-pack/plugin/security/.../authc/
+    kerberos/KerberosRealm.java:60). The browser/client sends
+    ``Authorization: Negotiate <base64 SPNEGO>``; the token's AP-REQ is
+    validated by decrypting the service ticket with the keytab key
+    (common/krb5.py — native RFC 3961/3962 aes-cts-hmac-sha1-96, where
+    the reference delegates to Java GSS). On failure the reference
+    responds 401 with ``WWW-Authenticate: Negotiate``; the REST layer
+    surfaces that header for AuthenticationExceptions from this realm.
+
+    Config (xpack.security.authc.kerberos.*): ``keytab_path`` — JSON
+    {service_principal: hex_aes_key} (DISCLOSED divergence: the MIT
+    binary keytab container format is not parsed; the keys are the same
+    material), ``remove_realm_name`` — map ``user@REALM`` to ``user``
+    (ref: KerberosRealmSettings.SETTING_REMOVE_REALM_NAME)."""
+
+    type = "kerberos"
+
+    # authenticator replay window must cover validate_spnego's max_skew
+    REPLAY_WINDOW = 600.0
+
+    def __init__(self, name, order, svc, config: Dict[str, Any]):
+        super().__init__(name, order, svc)
+        self.config = config or {}
+        self._keytab: Optional[Dict[str, bytes]] = None
+        # AP-REQ replay cache (RFC 4120 §3.2.3 requires one: a captured
+        # Negotiate header must not re-authenticate within the skew
+        # window) — keyed by token digest, value = expiry
+        self._seen_tokens: Dict[str, float] = {}
+
+    def token(self, headers):
+        auth = headers.get("authorization", "")
+        if auth.lower().startswith("negotiate "):
+            return auth.partition(" ")[2]
+        return None
+
+    def _load_keytab(self) -> Dict[str, bytes]:
+        if self._keytab is None:
+            path = self.config["keytab_path"]
+            try:
+                with open(path) as fh:
+                    raw = json.load(fh)
+                self._keytab = {k: bytes.fromhex(v)
+                                for k, v in raw.items()}
+            except (OSError, ValueError) as e:
+                raise AuthenticationException(
+                    f"unable to load keytab [{path}]: {e}")
+        return self._keytab
+
+    def authenticate(self, token_b64: str) -> "User":
+        from elasticsearch_tpu.common.krb5 import KrbError, validate_spnego
+        try:
+            token = base64.b64decode(token_b64, validate=True)
+        except Exception:
+            raise AuthenticationException(
+                "malformed Negotiate token")
+        now = time.time()
+        digest = _sha(token_b64)
+        self._seen_tokens = {d: exp for d, exp
+                             in self._seen_tokens.items() if exp > now}
+        if digest in self._seen_tokens:
+            raise AuthenticationException(
+                "kerberos token has already been used (replay)")
+        try:
+            res = validate_spnego(token, self._load_keytab())
+        except KrbError as e:
+            raise AuthenticationException(
+                f"kerberos authentication failed: {e}")
+        if len(self._seen_tokens) >= 100_000:
+            for victim, _e in sorted(self._seen_tokens.items(),
+                                     key=lambda kv: kv[1])[:1000]:
+                del self._seen_tokens[victim]
+        self._seen_tokens[digest] = now + self.REPLAY_WINDOW
+        principal = res["principal"]
+        if self.config.get("remove_realm_name"):
+            principal = res["name"]
+        roles = self.svc.mapped_roles(username=principal, dn="",
+                                      realm=self.name)
+        return User(principal, roles,
+                    metadata={"kerberos_realm": res["realm"]})
 
 
 class PkiRealm(Realm):
@@ -902,7 +1144,9 @@ class SecurityService:
                  jwt_issuer: Optional[str] = None,
                  jwt_audience: Optional[str] = None,
                  ldap_config: Optional[Dict[str, Any]] = None,
-                 oidc_config: Optional[Dict[str, Any]] = None):
+                 oidc_config: Optional[Dict[str, Any]] = None,
+                 saml_config: Optional[Dict[str, Any]] = None,
+                 kerberos_config: Optional[Dict[str, Any]] = None):
         # ref: x-pack anonymous access (xpack.security.authc.anonymous.*)
         # — requests without credentials authenticate as this principal
         self.anonymous_username = anonymous_username
@@ -953,6 +1197,15 @@ class SecurityService:
           + ([OidcRealm("oidc1", orders.get("oidc", 7), self,
                         oidc_config)]
              if oidc_config and oidc_config.get("op.jwks_path")
+             else [])
+          + ([SamlRealm("saml1", orders.get("saml", 8), self,
+                        saml_config)]
+             if saml_config and saml_config.get("idp.entity_id")
+             and saml_config.get("idp.certificate")
+             else [])
+          + ([KerberosRealm("kerb1", orders.get("kerberos", 9), self,
+                            kerberos_config)]
+             if kerberos_config and kerberos_config.get("keytab_path")
              else []),
             key=lambda r: r.order)
 
@@ -1145,6 +1398,56 @@ class SecurityService:
                 f"[{headers['authorization'].partition(' ')[0]}]")
         raise AuthenticationException(
             "missing authentication credentials for REST request")
+
+    # -------------------------------------------------------- SAML APIs
+    def _saml_realm(self) -> "SamlRealm":
+        for r in self.realms:
+            if isinstance(r, SamlRealm):
+                return r
+        raise IllegalArgumentException(
+            "no SAML realm is configured "
+            "(xpack.security.authc.saml.idp.entity_id)")
+
+    def saml_prepare(self) -> Dict[str, Any]:
+        """POST /_security/saml/prepare (ref:
+        TransportSamlPrepareAuthenticationAction): the AuthnRequest
+        redirect URL + the request id the caller must hand back."""
+        realm = self._saml_realm()
+        out = realm.prepare()
+        return {"realm": realm.name, "id": out["id"],
+                "redirect": out["redirect"]}
+
+    def saml_authenticate(self, content_b64: str) -> Dict[str, Any]:
+        """POST /_security/saml/authenticate (ref:
+        TransportSamlAuthenticateAction): validates the IdP response and
+        issues an access/refresh token pair for the mapped user."""
+        realm = self._saml_realm()
+        try:
+            user = realm.authenticate(content_b64)
+        except AuthenticationException as e:
+            # the login endpoint bypasses the header-auth path, so its
+            # failures must be audited here (forgery/replay attempts
+            # against SSO would otherwise be invisible)
+            self.audit.authentication_failed(
+                "POST", "/_security/saml/authenticate", str(e))
+            raise
+        user.authenticated_realm = realm.name
+        self.audit.authentication_success(user, realm.name, "POST",
+                                          "/_security/saml/authenticate")
+        tok = self._issue_token(user)
+        return {"username": user.username,
+                "realm": realm.name,
+                "access_token": tok["access_token"],
+                "refresh_token": tok["refresh_token"],
+                "expires_in": tok["expires_in"]}
+
+    def saml_logout(self, token: str) -> Dict[str, Any]:
+        """POST /_security/saml/logout (ref:
+        TransportSamlLogoutAction): invalidates the access token; the
+        redirect would carry a LogoutRequest to the IdP's SLO endpoint
+        (none is configured in-framework, so redirect is null)."""
+        n = self.invalidate_tokens(token=token)
+        return {"invalidated": n, "redirect": None}
 
     # ------------------------------------------------------ token service
     def create_token(self, grant_type: str, username: str = "",

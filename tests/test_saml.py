@@ -1,0 +1,310 @@
+"""SAML SP realm + IdP + XML-DSig tests (ref parity:
+SamlAuthenticatorTests — stripped/forged signature rejection, audience
+and time-window checks; SamlRealmTests — attribute→principal/groups)."""
+
+import base64
+import datetime
+from xml.etree import ElementTree as ET
+
+import pytest
+
+from elasticsearch_tpu.common.xmldsig import (XmlSignatureError,
+                                              load_cert_public_key,
+                                              sign_element,
+                                              verify_enveloped)
+from elasticsearch_tpu.xpack.saml import (SamlAuthnFlow, SamlException,
+                                          SamlIdentityProvider, SpConfig)
+
+
+@pytest.fixture(scope="module")
+def idp_keypair():
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "idp")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(days=1))
+            .not_valid_after(now + datetime.timedelta(days=365))
+            .sign(key, hashes.SHA256()))
+    key_pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption())
+    cert_pem = cert.public_bytes(serialization.Encoding.PEM).decode()
+    return key, key_pem, cert_pem
+
+
+@pytest.fixture
+def idp(idp_keypair):
+    _, key_pem, cert_pem = idp_keypair
+    p = SamlIdentityProvider("https://idp.example/", key_pem, cert_pem)
+    p.register_sp("https://sp.example/", "https://sp.example/acs")
+    return p
+
+
+@pytest.fixture
+def flow(idp_keypair):
+    _, _, cert_pem = idp_keypair
+    return SamlAuthnFlow(
+        SpConfig("https://sp.example/", "https://sp.example/acs"),
+        "https://idp.example/", cert_pem)
+
+
+# ---------------------------------------------------------------- xmldsig
+
+def test_sign_verify_roundtrip(idp_keypair):
+    key, _, cert_pem = idp_keypair
+    el = ET.fromstring('<doc ID="_x1"><body>hello</body></doc>')
+    sign_element(el, key, cert_pem)
+    verify_enveloped(el, load_cert_public_key(cert_pem))
+
+
+def test_verify_detects_tampering(idp_keypair):
+    key, _, cert_pem = idp_keypair
+    el = ET.fromstring('<doc ID="_x1"><body>hello</body></doc>')
+    sign_element(el, key, cert_pem)
+    el.find("body").text = "tampered"
+    with pytest.raises(XmlSignatureError, match="digest"):
+        verify_enveloped(el, load_cert_public_key(cert_pem))
+
+
+def test_verify_rejects_unsigned(idp_keypair):
+    _, _, cert_pem = idp_keypair
+    el = ET.fromstring('<doc ID="_x1"/>')
+    with pytest.raises(XmlSignatureError, match="not signed"):
+        verify_enveloped(el, load_cert_public_key(cert_pem))
+
+
+def test_verify_rejects_wrong_key(idp_keypair):
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    key, _, cert_pem = idp_keypair
+    el = ET.fromstring('<doc ID="_x1"><b>x</b></doc>')
+    sign_element(el, key, cert_pem)
+    other = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    with pytest.raises(XmlSignatureError, match="invalid"):
+        verify_enveloped(el, other.public_key())
+
+
+def test_verify_rejects_wrapped_reference(idp_keypair):
+    """Signature whose Reference points at a DIFFERENT ID must fail on
+    the element being consumed (signature-wrapping defense)."""
+    key, _, cert_pem = idp_keypair
+    el = ET.fromstring('<doc ID="_x1"><b>x</b></doc>')
+    sign_element(el, key, cert_pem)
+    el.set("ID", "_other")
+    with pytest.raises(XmlSignatureError, match="cover"):
+        verify_enveloped(el, load_cert_public_key(cert_pem))
+
+
+# ------------------------------------------------------------------- flow
+
+def test_authn_request_redirect(flow):
+    out = flow.build_authn_request("https://idp.example/sso")
+    assert out["redirect"].startswith(
+        "https://idp.example/sso?SAMLRequest=")
+    assert out["id"].startswith("_")
+
+
+def test_full_sso_roundtrip(idp, flow):
+    content = idp.issue_response("https://sp.example/", "alice",
+                                 groups=["admins", "devs"])
+    res = flow.authenticate(content)
+    assert res["principal"] == "alice"
+    assert res["attributes"]["groups"] == ["admins", "devs"]
+    assert res["session_index"]
+
+
+def test_assertion_only_signature(idp, flow):
+    content = idp.issue_response("https://sp.example/", "bob",
+                                 sign_assertion_only=True)
+    assert flow.authenticate(content)["principal"] == "bob"
+
+
+def test_in_response_to_enforced(idp, flow):
+    content = idp.issue_response("https://sp.example/", "alice",
+                                 in_response_to="_req1")
+    assert flow.authenticate(content, ["_req1"])["principal"] == "alice"
+    with pytest.raises(SamlException, match="InResponseTo"):
+        flow.authenticate(content, ["_otherreq"])
+
+
+def test_stripped_signature_rejected(idp, flow):
+    content = idp.issue_response("https://sp.example/", "mallory",
+                                 sign_assertion_only=True)
+    root = ET.fromstring(base64.b64decode(content))
+    ds = "{http://www.w3.org/2000/09/xmldsig#}Signature"
+    asrt = root.find(
+        "{urn:oasis:names:tc:SAML:2.0:assertion}Assertion")
+    asrt.remove(asrt.find(ds))
+    stripped = base64.b64encode(ET.tostring(root)).decode()
+    with pytest.raises(SamlException, match="signature"):
+        flow.authenticate(stripped)
+
+
+def test_modified_assertion_rejected(idp, flow):
+    content = idp.issue_response("https://sp.example/", "alice")
+    root = ET.fromstring(base64.b64decode(content))
+    nid = root.find(
+        ".//{urn:oasis:names:tc:SAML:2.0:assertion}NameID")
+    nid.text = "superadmin"
+    evil = base64.b64encode(ET.tostring(root)).decode()
+    with pytest.raises(SamlException, match="signature"):
+        flow.authenticate(evil)
+
+
+def test_wrong_audience_rejected(idp_keypair, idp):
+    _, _, cert_pem = idp_keypair
+    other = SamlAuthnFlow(
+        SpConfig("https://other-sp.example/", "https://sp.example/acs"),
+        "https://idp.example/", cert_pem)
+    content = idp.issue_response("https://sp.example/", "alice")
+    with pytest.raises(SamlException, match="audience|recipient|Recipient"):
+        other.authenticate(content)
+
+
+def test_expired_assertion_rejected(idp_keypair):
+    _, key_pem, cert_pem = idp_keypair
+    idp = SamlIdentityProvider("https://idp.example/", key_pem, cert_pem,
+                               session_ttl=-3600)
+    idp.register_sp("https://sp.example/", "https://sp.example/acs")
+    flow = SamlAuthnFlow(
+        SpConfig("https://sp.example/", "https://sp.example/acs"),
+        "https://idp.example/", cert_pem, clock_skew=5.0)
+    content = idp.issue_response("https://sp.example/", "alice")
+    with pytest.raises(SamlException, match="expired"):
+        flow.authenticate(content)
+
+
+def test_wrong_issuer_rejected(idp_keypair, idp):
+    _, _, cert_pem = idp_keypair
+    flow = SamlAuthnFlow(
+        SpConfig("https://sp.example/", "https://sp.example/acs"),
+        "https://evil-idp.example/", cert_pem)
+    content = idp.issue_response("https://sp.example/", "alice")
+    with pytest.raises(SamlException, match="[Ii]ssuer"):
+        flow.authenticate(content)
+
+
+# ------------------------------------------------- realm + REST surface
+
+def test_saml_realm_end_to_end(tmp_path, idp_keypair, idp):
+    """prepare → IdP issues → authenticate → token works → logout."""
+    _, _, cert_pem = idp_keypair
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.node import Node
+    cert_file = tmp_path / "idp.pem"
+    cert_file.write_text(cert_pem)
+    node = Node(settings=Settings.from_dict({
+        "xpack": {"security": {"enabled": True, "authc": {"saml": {
+            "idp": {"entity_id": "https://idp.example/",
+                    "certificate": str(cert_file),
+                    "sso_url": "https://idp.example/sso"},
+            "sp": {"entity_id": "https://sp.example/",
+                   "acs": "https://sp.example/acs"},
+        }}}},
+    }), data_path=str(tmp_path / "node"))
+    try:
+        node.security_service.put_role_mapping("saml-admins", {
+            "roles": ["superuser"],
+            "rules": {"field": {"groups": "admins"}},
+            "enabled": True})
+        st, out = node.rest_controller.dispatch(
+            "POST", "/_security/saml/prepare", None, {})
+        assert st == 200 and out["redirect"].startswith(
+            "https://idp.example/sso?SAMLRequest=")
+        content = idp.issue_response("https://sp.example/", "alice",
+                                     groups=["admins"],
+                                     in_response_to=out["id"])
+        st, tok = node.rest_controller.dispatch(
+            "POST", "/_security/saml/authenticate", None,
+            {"content": content})
+        assert st == 200 and tok["username"] == "alice"
+        # the issued bearer token authenticates with mapped roles
+        st, me = node.rest_controller.dispatch(
+            "GET", "/_security/_authenticate", None, None,
+            {"Authorization": f"Bearer {tok['access_token']}"})
+        assert st == 200 and me["username"] == "alice"
+        assert "superuser" in me["roles"]
+        st, lg = node.rest_controller.dispatch(
+            "POST", "/_security/saml/logout", None,
+            {"token": tok["access_token"]})
+        assert st == 200 and lg["invalidated"] == 1
+        st, _ = node.rest_controller.dispatch(
+            "GET", "/_security/_authenticate", None, None,
+            {"Authorization": f"Bearer {tok['access_token']}"})
+        assert st == 401
+    finally:
+        node.close()
+
+
+def test_saml_response_replay_rejected(tmp_path, idp_keypair, idp):
+    """A consumed SAMLResponse must not mint a second token pair."""
+    _, _, cert_pem = idp_keypair
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.node import Node
+    cert_file = tmp_path / "idp.pem"
+    cert_file.write_text(cert_pem)
+    node = Node(settings=Settings.from_dict({
+        "xpack": {"security": {"enabled": True, "authc": {"saml": {
+            "idp": {"entity_id": "https://idp.example/",
+                    "certificate": str(cert_file),
+                    "sso_url": "https://idp.example/sso"},
+            "sp": {"entity_id": "https://sp.example/",
+                   "acs": "https://sp.example/acs"},
+        }}}},
+    }), data_path=str(tmp_path / "node"))
+    try:
+        content = idp.issue_response("https://sp.example/", "alice")
+        st, _ = node.rest_controller.dispatch(
+            "POST", "/_security/saml/authenticate", None,
+            {"content": content})
+        assert st == 200
+        st, _ = node.rest_controller.dispatch(
+            "POST", "/_security/saml/authenticate", None,
+            {"content": content})
+        assert st == 401
+    finally:
+        node.close()
+
+
+def test_saml_forged_response_rejected_through_rest(tmp_path,
+                                                    idp_keypair):
+    """A response signed by a DIFFERENT key must 401 through the API."""
+    _, _, cert_pem = idp_keypair
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.node import Node
+    evil_key = rsa.generate_private_key(public_exponent=65537,
+                                        key_size=2048)
+    evil_pem = evil_key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption())
+    evil_idp = SamlIdentityProvider("https://idp.example/", evil_pem,
+                                    cert_pem)  # claims the same entity
+    evil_idp.register_sp("https://sp.example/", "https://sp.example/acs")
+    cert_file = tmp_path / "idp.pem"
+    cert_file.write_text(cert_pem)
+    node = Node(settings=Settings.from_dict({
+        "xpack": {"security": {"enabled": True, "authc": {"saml": {
+            "idp": {"entity_id": "https://idp.example/",
+                    "certificate": str(cert_file),
+                    "sso_url": "https://idp.example/sso"},
+            "sp": {"entity_id": "https://sp.example/",
+                   "acs": "https://sp.example/acs"},
+        }}}},
+    }), data_path=str(tmp_path / "node"))
+    try:
+        content = evil_idp.issue_response("https://sp.example/", "root")
+        st, out = node.rest_controller.dispatch(
+            "POST", "/_security/saml/authenticate", None,
+            {"content": content})
+        assert st == 401
+    finally:
+        node.close()
