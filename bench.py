@@ -483,6 +483,7 @@ def build_rest_node(corpus, tmpdir):
                                               "1024,2048,4096"),
             "fast_streams": int(os.environ.get("BENCH_FAST_STREAMS", 6)),
             "fast_q_batch": int(os.environ.get("BENCH_FAST_QBATCH", 32)),
+            "fast_kernel": os.environ.get("BENCH_FAST_KERNEL", "v2m"),
             "fast_max_k": K}},
     }), data_path=os.path.join(tmpdir, "node"))
     status, _ = node.rest_controller.dispatch(

@@ -275,7 +275,9 @@ class Node:
                         self, front, nb_buckets=nb_buckets,
                         n_streams=fast_streams, max_k=fast_max_k,
                         q_batch=int(self.settings.get(
-                            "http.native.fast_q_batch", 32)))
+                            "http.native.fast_q_batch", 32)),
+                        kernel_mode=str(self.settings.get(
+                            "http.native.fast_kernel", "v2m")))
                     front.fastpath.start()
                     if allow or deny:
                         front.set_ipfilter(allow, deny)

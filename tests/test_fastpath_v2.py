@@ -1,0 +1,234 @@
+"""v2 serving kernel (merge candidates + f64 re-rank) vs the exact v1
+kernel: identical certified outputs, honest ok=0 on tie-mass corpora."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from elasticsearch_tpu.ops import fastpath as fp
+
+BLOCK = 128
+
+
+def build_segment(rng, n_docs, n_terms, df_range=(40, 400)):
+    """Block-layout postings like index/segment.py builds them."""
+    tbs, nb = [], []
+    blocks_d, blocks_t = [], []
+    flat_d, flat_t = [], []
+    next_block = 0
+    for t in range(n_terms):
+        df = int(rng.integers(*df_range))
+        docs = np.sort(rng.choice(n_docs, size=df, replace=False)
+                       ).astype(np.int32)
+        tfs = rng.integers(1, 5, size=df).astype(np.float32)
+        nblk = (df + BLOCK - 1) // BLOCK
+        tbs.append(next_block)
+        nb.append(nblk)
+        next_block += nblk
+        pad = nblk * BLOCK - df
+        d = np.concatenate([docs, np.zeros(pad, np.int32)])
+        f = np.concatenate([tfs, np.zeros(pad, np.float32)])
+        blocks_d.append(d.reshape(nblk, BLOCK))
+        blocks_t.append(f.reshape(nblk, BLOCK))
+    # reserved zero block
+    blocks_d.append(np.zeros((1, BLOCK), np.int32))
+    blocks_t.append(np.zeros((1, BLOCK), np.float32))
+    bd = np.concatenate(blocks_d)
+    bt = np.concatenate(blocks_t)
+    lens = rng.integers(5, 80, size=n_docs).astype(np.float32)
+    return dict(bd=bd, bt=bt, tbs=np.asarray(tbs), nb=np.asarray(nb),
+                zero_block=bd.shape[0] - 1, lens=lens,
+                flat_d=bd.reshape(-1), flat_t=bt.reshape(-1),
+                avg=float(lens.mean()))
+
+
+def slotted_sel(seg, term_ids, idf, n_slots, nb_bucket):
+    """Each term-instance run starts on a slot boundary."""
+    slot_blocks = nb_bucket // n_slots
+    sel = np.full(nb_bucket, seg["zero_block"], np.int32)
+    ws = np.zeros(nb_bucket, np.float32)
+    ts = np.zeros(fp.MAX_T, np.int32)
+    tl = np.zeros(fp.MAX_T, np.int32)
+    ti = np.zeros(fp.MAX_T, np.float64)
+    pos = 0
+    for i, t in enumerate(term_ids):
+        cnt = int(seg["nb"][t])
+        start = int(seg["tbs"][t])
+        need = -(-cnt // slot_blocks) * slot_blocks
+        assert pos + need <= nb_bucket
+        sel[pos:pos + cnt] = np.arange(start, start + cnt)
+        ws[pos:pos + cnt] = np.float32(idf[t])
+        pos += need
+        ts[i] = start * BLOCK
+        tl[i] = int((seg["bt"][start:start + cnt] > 0).sum())
+        ti[i] = idf[t]
+    return sel, ws, ts, tl, ti
+
+
+def flat_sel(seg, term_ids, idf, nb_bucket):
+    sel = np.full(nb_bucket, seg["zero_block"], np.int32)
+    ws = np.zeros(nb_bucket, np.float64)
+    pos = 0
+    for t in term_ids:
+        cnt = int(seg["nb"][t])
+        start = int(seg["tbs"][t])
+        sel[pos:pos + cnt] = np.arange(start, start + cnt)
+        ws[pos:pos + cnt] = idf[t]
+        pos += cnt
+    return sel, ws
+
+
+def run_both(seg, queries, n_docs=2000, k=50,
+             n_slots=8, nb_bucket=64):
+    q_n = len(queries)
+    idf = np.log1p(n_docs / (seg["nb"] * BLOCK))
+    masks = np.ones((fp.F_SLOTS, n_docs), bool)
+    mask_ids = np.zeros(q_n, np.int32)
+    sel2 = np.zeros((q_n, nb_bucket), np.int32)
+    ws2 = np.zeros((q_n, nb_bucket), np.float32)
+    ts2 = np.zeros((q_n, fp.MAX_T), np.int32)
+    tl2 = np.zeros((q_n, fp.MAX_T), np.int32)
+    ti2 = np.zeros((q_n, fp.MAX_T), np.float64)
+    sel1 = np.zeros((q_n, nb_bucket), np.int32)
+    ws1 = np.zeros((q_n, nb_bucket), np.float64)
+    for qi, terms in enumerate(queries):
+        s, w, ts, tl, ti = slotted_sel(seg, terms, idf, n_slots,
+                                       nb_bucket)
+        sel2[qi], ws2[qi], ts2[qi], tl2[qi], ti2[qi] = s, w, ts, tl, ti
+        s1, w1 = flat_sel(seg, terms, idf, nb_bucket)
+        sel1[qi], ws1[qi] = s1, w1
+    import jax
+    wd = np.float64 if jax.config.jax_enable_x64 else np.float32
+    out1 = np.asarray(fp.bm25_topk_total_batch(
+        seg["bd"], seg["bt"], jnp.asarray(sel1), jnp.asarray(
+            ws1.astype(wd)),
+        seg["lens"], jnp.asarray(masks), jnp.asarray(mask_ids),
+        wd(seg["avg"]), 1.2, 0.75, k))
+    out2 = np.asarray(fp.bm25_candidates_rerank_batch(
+        seg["bd"], seg["bt"], seg["flat_d"], seg["flat_t"],
+        jnp.asarray(sel2), jnp.asarray(ws2), seg["lens"],
+        jnp.asarray(masks), jnp.asarray(mask_ids), jnp.asarray(ts2),
+        jnp.asarray(tl2), jnp.asarray(ti2.astype(wd)), wd(seg["avg"]),
+        n_slots, 1.2, 0.75, k))
+    return out1, out2
+
+
+def unpack1(row, k):
+    return (row[:k], row[k:2 * k].view(np.int32),
+            int(row[2 * k:].view(np.int32)[0]))
+
+
+def _norm_hits(vals, ids, k):
+    """Canonical (score desc, docid asc) order for comparison — v1
+    leaves device tie order arbitrary (host re-sorts); v2 is already
+    contract-ordered."""
+    fin = np.isfinite(vals)
+    v, d = vals[fin], ids[fin]
+    order = np.lexsort((d, -v))
+    return v[order], d[order]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_v2_matches_v1(seed):
+    rng = np.random.default_rng(seed)
+    n_docs = 2000
+    seg = build_segment(rng, n_docs, n_terms=12)
+    queries = [list(rng.choice(12, size=int(rng.integers(1, 6)),
+                               replace=False))
+               for _ in range(4)]
+    out1, out2 = run_both(seg, queries, n_docs=n_docs)
+    k = 50
+    for qi in range(len(queries)):
+        v1, d1, t1 = unpack1(out1[qi], k)
+        v2 = out2[qi][:k]
+        d2 = out2[qi][k:2 * k].view(np.int32)
+        t2 = int(np.asarray(out2[qi][2 * k], np.float32).view(np.int32))
+        ok = int(np.asarray(out2[qi][2 * k + 1],
+                            np.float32).view(np.int32))
+        assert ok == 1, f"q{qi} uncertified on a benign corpus"
+        assert t1 == t2, (qi, t1, t2)
+        nv1, nd1 = _norm_hits(v1, d1, k)
+        nv2, nd2 = _norm_hits(v2, d2, k)
+        np.testing.assert_array_equal(nd1, nd2)
+        np.testing.assert_allclose(nv1, nv2, rtol=1e-6)
+
+
+def test_v2_duplicate_term_instances():
+    rng = np.random.default_rng(3)
+    seg = build_segment(rng, 1000, n_terms=6)
+    out1, out2 = run_both(seg, [[2, 2, 5], [0, 1, 2, 3, 4, 5]],
+                          n_docs=1000)
+    k = 50
+    for qi in range(2):
+        v1, d1, _ = unpack1(out1[qi], k)
+        v2 = out2[qi][:k]
+        d2 = out2[qi][k:2 * k].view(np.int32)
+        nv1, nd1 = _norm_hits(v1, d1, k)
+        nv2, nd2 = _norm_hits(v2, d2, k)
+        np.testing.assert_array_equal(nd1, nd2)
+        np.testing.assert_allclose(nv1, nv2, rtol=1e-6)
+
+
+def test_v2_bucket_slot_fit_routing():
+    """Slot-fit math: Σ ceil(blocks/slot) <= N_SLOTS picks the smallest
+    bucket; misfits return None (served by the warmed v1 shape)."""
+    from elasticsearch_tpu.search.fastpath import FastPathServer
+    srv = FastPathServer.__new__(FastPathServer)
+    srv.nb_buckets = (1024, 4096)
+    nbs = np.zeros(40, np.int64)
+    reg = {"nb": nbs}
+    # 4 tiny terms: 4 slots of 64 at bucket 1024
+    nbs[:4] = 10
+    assert srv._v2_bucket(reg, [0, 1, 2, 3]) == 1024
+    # one 300-block term: ceil(300/64)=5 slots -> still bucket 1024
+    nbs[4] = 300
+    assert srv._v2_bucket(reg, [4]) == 1024
+    # 16 terms of 300 blocks: 5 slots each at 1024 (80>16); at 4096
+    # slot=256 -> 2 slots each (32>16) -> misfit
+    nbs[5:21] = 300
+    assert srv._v2_bucket(reg, list(range(5, 21))) is None
+    # 16 terms of <=256 blocks fit bucket 4096 exactly (1 slot each)
+    nbs[21:37] = 256
+    assert srv._v2_bucket(reg, list(range(21, 37))) == 4096
+    # 17 instances can never fit
+    assert srv._v2_bucket(reg, [0] * 17) is None
+    # all-unknown terms -> None (no device work)
+    assert srv._v2_bucket(reg, [-1, -1]) is None
+
+
+def test_v2_slotted_assembly_runs_stay_sorted():
+    """Each term-instance run starts at a slot boundary and padding
+    lanes key to SENT — every slot must be ascending (the merge
+    precondition)."""
+    rng = np.random.default_rng(9)
+    seg = build_segment(rng, 1500, n_terms=5, df_range=(100, 500))
+    idf = np.log1p(1500 / (seg["nb"] * BLOCK))
+    n_slots, nb_bucket = 8, 64
+    sel, ws, *_ = slotted_sel(seg, [0, 3, 4], idf, n_slots, nb_bucket)
+    d = seg["bd"][sel]              # [NB, B]
+    tf = seg["bt"][sel]
+    keys = np.where(tf > 0, d, 0x7FFFFFFF).reshape(n_slots, -1)
+    for s in range(n_slots):
+        assert np.all(np.diff(keys[s].astype(np.int64)) >= 0), s
+
+
+def test_v2_mass_ties_refuse_certificate():
+    """Degenerate corpus: every matching doc scores identically and the
+    tie class is far wider than CAND_V2 — v2 must set ok=0 (refire),
+    never emit a possibly-wrong certified result."""
+    n_docs = 8192
+    # one term matching EVERY doc with tf=1, uniform doc length
+    docs = np.arange(n_docs, dtype=np.int32)
+    nblk = n_docs // BLOCK
+    bd = np.concatenate([docs.reshape(nblk, BLOCK),
+                         np.zeros((1, BLOCK), np.int32)])
+    bt = np.concatenate([np.ones((nblk, BLOCK), np.float32),
+                         np.zeros((1, BLOCK), np.float32)])
+    seg = dict(bd=bd, bt=bt, tbs=np.asarray([0]), nb=np.asarray([nblk]),
+               zero_block=nblk, lens=np.full(n_docs, 10.0, np.float32),
+               flat_d=bd.reshape(-1), flat_t=bt.reshape(-1), avg=10.0)
+    out1, out2 = run_both(seg, [[0]], n_docs=n_docs, nb_bucket=64)
+    k = 50
+    ok = int(np.asarray(out2[0][2 * k + 1], np.float32).view(np.int32))
+    assert ok == 0

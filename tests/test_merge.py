@@ -1,0 +1,66 @@
+"""The bitonic merge network itself (pallas interpret mode, small
+shapes) — the serving path on CPU takes the lax.sort shortcut, so this
+is the network's correctness coverage off-TPU."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from elasticsearch_tpu.ops.merge import merge_sorted_slots
+
+SENT = 0x7FFFFFFF
+
+
+def make_inputs(Q, P, n_slots, seed=0, n_docs=100_000):
+    rng = np.random.default_rng(seed)
+    L = P // n_slots
+    keys = np.full((Q, n_slots, L), SENT, np.int32)
+    vals = np.zeros((Q, n_slots, L), np.float32)
+    for q in range(Q):
+        for s in range(n_slots):
+            fill = int(rng.integers(0, L + 1))
+            ks = np.sort(rng.choice(n_docs, size=fill, replace=False))
+            keys[q, s, :fill] = ks
+            vals[q, s, :fill] = (rng.random(fill) + 0.1).astype(
+                np.float32)
+    return keys, vals
+
+
+@pytest.mark.parametrize("n_slots,P,chunk", [
+    (2, 1 << 11, 1 << 10),
+    (4, 1 << 12, 1 << 10),
+    (8, 1 << 13, 1 << 11),
+    (16, 1 << 14, 1 << 12),
+    (8, 1 << 13, 1 << 13),    # single chunk (no XLA stages)
+    (8, 1 << 13, 1 << 9),     # many XLA stages
+])
+def test_merge_network_matches_sort(n_slots, P, chunk):
+    Q = 2
+    keys, vals = make_inputs(Q, P, n_slots, seed=n_slots + P)
+    L = P // n_slots
+
+    # eager, not jitted: pallas interpret mode INSIDE jit mis-executes
+    # on the multi-device CPU test mesh (upstream sharp edge); the
+    # compiled TPU path and the serving CPU path (lax.sort shortcut)
+    # are unaffected
+    mk, mv = merge_sorted_slots(jnp.asarray(keys), jnp.asarray(vals),
+                                chunk=chunk, force_pallas=True)
+    sk, sv = jax.lax.sort((keys.reshape(Q, P), vals.reshape(Q, P)),
+                          dimension=1, num_keys=1)
+    mk, mv, sk, sv = map(np.asarray, (mk, mv, sk, sv))
+    np.testing.assert_array_equal(mk, sk)
+    for q in range(Q):
+        a = sorted(zip(sk[q].tolist(), sv[q].tolist()))
+        b = sorted(zip(mk[q].tolist(), mv[q].tolist()))
+        assert a == b
+
+
+def test_merge_all_sentinel_slots():
+    Q, n_slots, L = 1, 4, 512
+    keys = np.full((Q, n_slots, L), SENT, np.int32)
+    vals = np.zeros((Q, n_slots, L), np.float32)
+    mk, mv = merge_sorted_slots(jnp.asarray(keys), jnp.asarray(vals),
+                                chunk=1 << 10, force_pallas=True)
+    assert np.all(np.asarray(mk) == SENT)
