@@ -72,6 +72,23 @@ def build_corpus(rng):
     cdf /= cdf[-1]
     terms = np.searchsorted(cdf, u).astype(np.int64)
     doc_of = np.repeat(np.arange(N_DOCS, dtype=np.int64), lens)
+    # term BURSTINESS (VERDICT r3 item 5 — de-toy the corpus): real text
+    # repeats its topical words, so tf has a heavy tail instead of the
+    # Zipf-iid {1..4} that made every block-max bound look alike. Each
+    # token repeats the previous token of the SAME doc with prob BURST —
+    # a geometric burst process (mean tf boost 1/(1-BURST), tail 10+).
+    # The CPU baseline's block-max skipping engages on the same corpus.
+    burst = float(os.environ.get("BENCH_BURST", 0.35))
+    if burst > 0:
+        copy = rng.random(total) < burst
+        doc_start = np.zeros(total, bool)
+        doc_start[0] = True
+        doc_start[np.cumsum(lens)[:-1]] = True
+        copy &= ~doc_start
+        pos = np.arange(total)
+        src = np.where(~copy, pos, 0)
+        np.maximum.accumulate(src, out=src)
+        terms = terms[src]
     keys = terms * N_DOCS + doc_of
     del terms, doc_of, u
     uniq, tf = np.unique(keys, return_counts=True)
@@ -310,35 +327,50 @@ def run_tpu_kernel(corpus, queries):
                        ws_b)[0].block_until_ready()
     batch_qps = BATCH * len(batches) * reps / (time.time() - t0)
     log(f"raw kernel batch-{BATCH}: {batch_qps:.1f} qps")
-    def degradation_probe():
-        """Time the SAME launch before any device→host transfer and
-        after one (directly-attached TPU: factor ~1; the axon relay
-        throttles post-readback device execution). MUST run after every
-        pre-readback raw section — the probe's readback flips the mode
-        for the rest of the process."""
-        sel0, ws0 = selections[0]
+    def sustained_then_probe(n_launches=int(os.environ.get(
+            "BENCH_SUSTAINED", 2000))):
+        """(sustained_qps, checksum, degrade). Bounds the pre-readback
+        capacity claim (VERDICT r3 item 10): n_launches batch launches
+        whose outputs FOLD INTO AN ON-DEVICE ACCUMULATOR — the work
+        can't be elided and is validated by a checksum read back ONCE
+        at the end. That single readback flips the tunnel into its
+        degraded mode; the probe then re-times the identical launch to
+        quantify the degradation factor (directly-attached TPU: ~1)."""
+        import jax
+        import jax.numpy as jnp
+        sel_b, ws_b = batches[0]
+        acc = None
         t0 = time.time()
-        score_topk(d_docids, d_tfs, d_lens, d_live, sel0,
-                   ws0)[0].block_until_ready()
-        pre = time.time() - t0
-        np.asarray(score_topk(d_docids, d_tfs, d_lens, d_live,
-                              sel0, ws0)[0])
+        for _ in range(n_launches):
+            out = batch_topk(d_docids, d_tfs, d_lens, d_live, sel_b,
+                             ws_b)[0]
+            acc = out if acc is None else acc + out
+        jax.block_until_ready(acc)
+        wall = time.time() - t0
+        pre_per_launch = wall / n_launches
+        sus_qps = n_launches * BATCH / wall
+        checksum = float(np.asarray(jnp.sum(
+            jnp.where(jnp.isfinite(acc), acc, 0.0))))  # THE readback
+        log(f"sustained pre-readback: {n_launches} batch-{BATCH} "
+            f"launches in {wall:.2f}s = {sus_qps:.0f} qps "
+            f"({pre_per_launch*1000:.2f} ms/launch), on-device "
+            f"checksum {checksum:.6g} read back once")
         best_post = float("inf")
         for _ in range(3):
             t0 = time.time()
-            score_topk(d_docids, d_tfs, d_lens, d_live, sel0,
-                       ws0)[0].block_until_ready()
+            batch_topk(d_docids, d_tfs, d_lens, d_live, sel_b,
+                       ws_b)[0].block_until_ready()
             best_post = min(best_post, time.time() - t0)
-        degrade = best_post / max(pre, 1e-9)
-        log(f"tunnel degradation after first readback: {pre*1000:.2f} ms"
-            f" -> {best_post*1000:.2f} ms per identical launch "
-            f"(x{degrade:.0f})")
-        return degrade
+        degrade = best_post / max(pre_per_launch, 1e-9)
+        log(f"tunnel degradation after first readback: "
+            f"{pre_per_launch*1000:.2f} ms -> {best_post*1000:.2f} ms "
+            f"per identical launch (x{degrade:.0f})")
+        return sus_qps, checksum, degrade
 
     return kernel_qps, batch_qps, dict(d_docids=d_docids, d_tfs=d_tfs,
                                        d_lens=d_lens, d_live=d_live,
                                        avg=avg, zero_block=zero_block,
-                                       probe=degradation_probe)
+                                       probe=sustained_then_probe)
 
 
 def run_secondary(corpus, queries, rng, h):
@@ -474,8 +506,34 @@ def build_rest_node(corpus, tmpdir):
         doc_count=N_DOCS)
     stored = StoredFields(offsets=np.zeros(N_DOCS + 1, np.int64), data=b"",
                           ids=[str(i) for i in range(N_DOCS)])
-    seg = Segment("bench0", N_DOCS, postings={"title": pf}, numerics={},
-                  keywords={}, vectors={}, stored=stored)
+    # keyword + numeric doc values for the agg / script_score product
+    # rows; optional dense vectors for the hybrid RRF row
+    from elasticsearch_tpu.index.segment import (KeywordDocValues,
+                                                 NumericDocValues,
+                                                 VectorValues)
+    rng2 = np.random.default_rng(99)
+    n_cats = int(os.environ.get("BENCH_CATS", 500))
+    cat_of = np.minimum((rng2.random(N_DOCS) ** 2 * n_cats),
+                        n_cats - 1).astype(np.int32)     # skewed
+    kv = KeywordDocValues(
+        "cat", [f"c{i:03d}" for i in range(n_cats)], ords=cat_of,
+        offsets=np.arange(N_DOCS + 1, dtype=np.int64),
+        all_ords=cat_of)
+    feat = rng2.random(N_DOCS).astype(np.float64)
+    nv = NumericDocValues(
+        "feat", values=feat, missing=np.zeros(N_DOCS, bool),
+        offsets=np.arange(N_DOCS + 1, dtype=np.int64), all_values=feat)
+    vectors = {}
+    rrf_dims = int(os.environ.get("BENCH_RRF_DIMS", 256))
+    if os.environ.get("BENCH_RRF", "1") != "0":
+        vs = rng2.standard_normal((N_DOCS, rrf_dims)).astype(np.float32)
+        vs /= np.linalg.norm(vs, axis=1, keepdims=True)
+        vectors["vec"] = VectorValues("vec", vs,
+                                      np.ones(N_DOCS, bool), rrf_dims,
+                                      "cosine")
+    seg = Segment("bench0", N_DOCS, postings={"title": pf},
+                  numerics={"feat": nv}, keywords={"cat": kv},
+                  vectors=vectors, stored=stored)
 
     node = Node(settings=Settings.from_dict({
         "http": {"native": {
@@ -512,7 +570,8 @@ def build_rest_node(corpus, tmpdir):
     return node, port
 
 
-def _loadgen(port, bodies_json, n_conns, total, timeout_ms=600_000):
+def _loadgen(port, bodies_json, n_conns, total, timeout_ms=600_000,
+             path=b"/bench/_search"):
     """Drive the node over REAL loopback HTTP with the C++ epoll client
     (native/src/estpu_http.cpp es_loadgen). On a 1-core host a Python
     client pool competes with the server for the GIL and measures
@@ -529,7 +588,7 @@ def _loadgen(port, bodies_json, n_conns, total, timeout_ms=600_000):
     lat = np.zeros(total, np.float64)
     wall = ctypes.c_double()
     done = lib.es_loadgen(
-        port, b"/bench/_search", blob,
+        port, path, blob,
         offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         len(blobs), n_conns, total, timeout_ms,
         lat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
@@ -650,12 +709,180 @@ def run_rest_path(corpus, queries, truth, tmpdir):
     except Exception as e:
         log(f"REST bool+filters failed: {e!r}")
 
+    # ---- product rows for the remaining BASELINE configs + aggs:
+    # these bodies are NOT C++-fast-parseable, so they measure the full
+    # Python serving path (REST dispatch → query DSL → device kernels)
+    extra = {}
+
+    def _row(name, bodies, conns, reps):
+        try:
+            _loadgen(port, bodies, conns, len(bodies))          # warm
+            done_x, qps_x, lat_x = _loadgen(port, bodies, conns,
+                                            len(bodies) * reps)
+            p50x = float(np.median(lat_x)) if len(lat_x) else 0.0
+            log(f"REST {name}: {qps_x:.1f} qps ({done_x} reqs, "
+                f"p50 {p50x:.2f} ms)")
+            extra[name] = qps_x
+        except Exception as e:
+            log(f"REST {name} failed: {e!r}")
+            extra[name] = 0.0
+
+    def qtext(q):
+        return " ".join(f"t{t:06d}" for t in q)
+
+    # terms aggregation at corpus scale (device ord-major collector)
+    _row("match+terms-agg", [
+        {"query": {"match": {"title": qtext(q)}}, "size": 0,
+         "aggs": {"cats": {"terms": {"field": "cat"}}}}
+        for q in queries[:32]], min(CLIENTS, 64), 4)
+    # BASELINE config 3: script_score re-rank (vectorized expression)
+    _row("script_score", [
+        {"query": {"script_score": {
+            "query": {"match": {"title": qtext(q)}},
+            "script": {"source":
+                       "doc['feat'].value * 0.5 + _score"}}},
+         "size": K, "_source": False}
+        for q in queries[:32]], min(CLIENTS, 64), 4)
+    # BASELINE config 5: hybrid BM25 + kNN with RRF fusion
+    if os.environ.get("BENCH_RRF", "1") != "0":
+        dims = int(os.environ.get("BENCH_RRF_DIMS", 256))
+        vrng = np.random.default_rng(7)
+        rbodies = []
+        for q in queries[:32]:
+            qv = vrng.standard_normal(dims)
+            qv /= np.linalg.norm(qv)
+            rbodies.append({
+                "query": {"match": {"title": qtext(q)}},
+                "knn": {"field": "vec",
+                        "query_vector": [round(float(x), 4)
+                                         for x in qv],
+                        "k": K, "num_candidates": int(1.5 * K)},
+                "rank": {"rrf": {}}, "size": K, "_source": False})
+        _row("rrf_hybrid", rbodies, min(CLIENTS, 64), 4)
+
     node.close()
     return (best_qps, p50, p99, rest_recall, warm_recall, avg_batch,
-            bool_qps)
+            bool_qps, extra)
 
 
 # ---------------------------------------------------------------------------
+# BASELINE config 4 at spec scale: dense kNN 8M×768 through the product
+# path. 8M×768×f32 ≈ 23 GiB exceeds single-chip HBM (16 GiB), so the
+# DEVICE slab is bfloat16 (11.5 GiB) and only NOMINATES candidates; the
+# top num_candidates are re-ranked exactly in float32 from the host copy
+# (search/queries.py KnnQuery._exact_rerank), making the final ranking
+# f32-exact up to candidate coverage — measured below as recall vs a
+# full f32 oracle. CPU analogue: numpy f32 brute force (the reference
+# implements this config as script-scored brute force too —
+# x-pack/plugin/vectors/.../query/ScoreScriptUtils.java:112-170).
+# ---------------------------------------------------------------------------
+
+def run_knn_at_scale():
+    import tempfile
+    import urllib.request
+
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.index.segment import (Segment, StoredFields,
+                                                 VectorValues)
+    from elasticsearch_tpu.node import Node
+
+    n = int(os.environ.get("BENCH_KNN_DOCS",
+                           8_000_000 if N_DOCS >= 2_000_000 else N_DOCS))
+    dims = int(os.environ.get("BENCH_KNN_DIMS", 768))
+    nq = 16
+    t0 = time.time()
+    rng = np.random.default_rng(4242)
+    vs = np.empty((n, dims), np.float32)
+    step = 500_000
+    for i in range(0, n, step):
+        j = min(n, i + step)
+        chunk = rng.standard_normal((j - i, dims)).astype(np.float32)
+        chunk /= np.linalg.norm(chunk, axis=1, keepdims=True)
+        vs[i:j] = chunk
+    qvs = []
+    for _ in range(nq):
+        q = vs[rng.integers(n)] + 0.25 * rng.standard_normal(
+            dims).astype(np.float32)
+        qvs.append((q / np.linalg.norm(q)).astype(np.float32))
+    log(f"kNN slab {n}x{dims} f32 built in {time.time()-t0:.1f}s "
+        f"({vs.nbytes/2**30:.1f} GiB host)")
+
+    # CPU analogue + f32 oracle (same pass): exact top-K per query
+    t0 = time.time()
+    lat = []
+    oracle = []
+    for q in qvs:
+        tq = time.time()
+        sims = vs @ q
+        top = np.argpartition(-sims, K - 1)[:K]
+        lat.append(time.time() - tq)
+        oracle.append(set(top.tolist()))
+    cpu_qps = len(lat) / sum(lat)
+    log(f"kNN CPU f32 brute force: {cpu_qps:.2f} qps "
+        f"(p50 {np.median(lat)*1000:.0f} ms)")
+
+    with tempfile.TemporaryDirectory() as td:
+        node = Node(settings=Settings.EMPTY, data_path=td + "/n")
+        try:
+            st, _ = node.rest_controller.dispatch(
+                "PUT", "/knnbench", None, {"mappings": {"properties": {
+                    "vec": {"type": "dense_vector", "dims": dims}}}})
+            assert st == 200
+            seg = Segment(
+                "knn0", n, postings={}, numerics={}, keywords={},
+                vectors={"vec": VectorValues("vec", vs,
+                                             np.ones(n, bool), dims,
+                                             "cosine")},
+                stored=StoredFields(
+                    offsets=np.zeros(n + 1, np.int64), data=b"",
+                    ids=[str(i) for i in range(n)]))
+            eng = node.indices_service.get("knnbench").shards[0]
+            with eng._lock:
+                eng._segments = [seg]
+                eng._epoch += 1
+            port = node.start(0)
+            bodies = [{"knn": {"field": "vec",
+                               "query_vector": [float(x) for x in q],
+                               "k": K,
+                               "num_candidates": int(os.environ.get(
+                                   "BENCH_KNN_CANDIDATES", 3 * K))},
+                       "size": K, "_source": False}
+                      for q in qvs]
+            base = f"http://127.0.0.1:{port}"
+
+            def post(body):
+                r = urllib.request.Request(
+                    base + "/knnbench/_search",
+                    data=json.dumps(body).encode(), method="POST",
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(r, timeout=600) as resp:
+                    return json.loads(resp.read())
+            t0 = time.time()
+            post(bodies[0])          # device upload + compile
+            log(f"kNN first query (upload+compile) {time.time()-t0:.1f}s")
+            recalls = []
+            for qi, body in enumerate(bodies):
+                ids = {int(h["_id"])
+                       for h in post(body)["hits"]["hits"]}
+                recalls.append(len(ids & oracle[qi]) / K)
+            knn_recall = float(np.mean(recalls))
+            done_k, knn_qps, lat_k = _loadgen(
+                port, bodies, int(os.environ.get("BENCH_KNN_CONNS", 8)),
+                len(bodies) * 4, timeout_ms=1_200_000,
+                path=b"/knnbench/_search")
+            p50k = float(np.median(lat_k)) if len(lat_k) else 0.0
+            log(f"kNN product path: {knn_qps:.1f} qps ({done_k} reqs, "
+                f"p50 {p50k:.0f} ms), recall@{K} {knn_recall:.4f} vs "
+                f"f32 oracle")
+            return (f"; dense kNN {n//1_000_000}M×{dims}d THROUGH REST "
+                    f"(bf16 device slab + exact f32 re-rank of top-"
+                    f"{os.environ.get('BENCH_KNN_CANDIDATES', 3*K)}): "
+                    f"{knn_qps:.1f} qps, recall {knn_recall:.4f} vs f32 "
+                    f"oracle, vs CPU f32 brute force {cpu_qps:.2f} qps "
+                    f"({knn_qps/cpu_qps:.0f}x)")
+        finally:
+            node.close()
+
 
 def main():
     import tempfile
@@ -678,15 +905,25 @@ def main():
                        f"RRF hybrid {sec['rrf_hybrid']:.0f} qps")
         except Exception as e:
             log(f"secondary configs failed: {e!r}")
-    # the probe's readback flips the tunnel into degraded mode — run it
-    # only once every pre-readback raw section above is done
-    degrade_txt = f"{handles['probe']():.0f}"
+    # the sustained run's single readback flips the tunnel into degraded
+    # mode — run it only once every pre-readback raw section is done
+    sus_qps, _checksum, degrade = handles["probe"]()
+    degrade_txt = f"{degrade:.0f}"
     # release the raw-kernel corpus copies before the REST path re-uploads
     handles.clear()
 
     with tempfile.TemporaryDirectory() as tmpdir:
         (rest_qps, p50, p99, rest_recall, warm_recall, avg_batch,
-         rest_bool_qps) = run_rest_path(corpus, queries, truth, tmpdir)
+         rest_bool_qps, extra) = run_rest_path(corpus, queries, truth,
+                                               tmpdir)
+    # free the text corpus before the 8M×768 slab (23 GiB f32 host)
+    del corpus, truth
+    knn_txt = ""
+    if os.environ.get("BENCH_KNN8M", "1") != "0":
+        try:
+            knn_txt = run_knn_at_scale()
+        except Exception as e:
+            log(f"kNN-at-scale phase failed: {e!r}")
 
     vs = rest_qps / cpu_qps if cpu_qps else float("nan")
     if cpu_qps:
@@ -719,7 +956,15 @@ def main():
             f"oracle, while the C++ baseline accumulates in double "
             f"(self-recall 1.0); {base_txt}; "
             f"REST bool+filters w/ cached filter masks "
-            f"{rest_bool_qps:.0f} qps; raw kernel {kernel_qps:.0f} qps "
+            f"{rest_bool_qps:.0f} qps; PRODUCT rows: match+terms-agg "
+            f"{extra.get('match+terms-agg', 0):.0f} qps, script_score "
+            f"re-rank {extra.get('script_score', 0):.0f} qps, hybrid "
+            f"RRF (match+knn, rank.rrf) "
+            f"{extra.get('rrf_hybrid', 0):.0f} qps{knn_txt}; "
+            f"sustained pre-readback capacity {sus_qps:.0f} qps over "
+            f"{os.environ.get('BENCH_SUSTAINED', 2000)} checksummed "
+            f"batch launches (single final readback); raw kernel "
+            f"{kernel_qps:.0f} qps "
             f"single / {batch_qps:.0f} qps batch-32{sec_txt}"),
         "value": round(rest_qps, 2),
         "unit": "qps",

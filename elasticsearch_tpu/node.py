@@ -175,6 +175,24 @@ class Node:
                 for k in ("keytab_path", "remove_realm_name")
                 if settings.get(
                     f"xpack.security.authc.kerberos.{k}") is not None})
+        # SAML identity provider (ref: x-pack/plugin/identity-provider)
+        self.idp_service = None
+        if bool(settings.get("xpack.idp.enabled", False)):
+            from elasticsearch_tpu.xpack.saml import SamlIdentityProvider
+            key_path = settings.get("xpack.idp.signing.key")
+            cert_path = settings.get("xpack.idp.signing.certificate")
+            if not (key_path and cert_path):
+                raise ValueError(
+                    "xpack.idp.enabled requires xpack.idp.signing.key "
+                    "and xpack.idp.signing.certificate")
+            with open(key_path, "rb") as fh:
+                key_pem = fh.read()
+            with open(cert_path) as fh:
+                cert_pem = fh.read()
+            self.idp_service = SamlIdentityProvider(
+                str(settings.get("xpack.idp.entity_id", "")),
+                key_pem, cert_pem,
+                sso_url=str(settings.get("xpack.idp.sso_url", "")))
         from elasticsearch_tpu.xpack.sql import SqlService
         self.sql_service = SqlService(self)
         from elasticsearch_tpu.xpack.eql import EqlService

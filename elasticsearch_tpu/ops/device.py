@@ -182,6 +182,34 @@ class DeviceSegment:
             self.numerics[f] = put(vals.astype(np.float32))
             self.numeric_missing[f] = put(miss)
 
+    def keyword_ord_major(self, field: str):
+        """(device docid-permutation int32 [total], host term_starts
+        int64 [n_terms+1]) — every keyword value position sorted by ord,
+        the ord-major layout the device terms-agg collector reduces over
+        (ops/aggs.py). Built lazily once per immutable segment; None
+        when the field has no keyword values."""
+        cache = getattr(self, "_kw_ord_major", None)
+        if cache is None:
+            cache = self._kw_ord_major = {}
+        if field in cache:
+            return cache[field]
+        kv = self.segment.keywords.get(field)
+        if kv is None or len(kv.all_ords) == 0:
+            cache[field] = None
+            return None
+        order = np.argsort(kv.all_ords, kind="stable")
+        pos_doc = np.searchsorted(kv.offsets,
+                                  np.arange(len(kv.all_ords)),
+                                  side="right") - 1
+        perm_docs = pos_doc[order].astype(np.int32)
+        sorted_ords = kv.all_ords[order]
+        term_starts = np.searchsorted(
+            sorted_ords, np.arange(len(kv.terms) + 1)).astype(np.int64)
+        entry = (jax.device_put(perm_docs, device=self._device),
+                 term_starts)
+        cache[field] = entry
+        return entry
+
     def filter_mask(self, field: str, terms) -> Tuple[jax.Array, np.ndarray]:
         """Any-of terms-presence mask for ``field``, LRU-cached.
 

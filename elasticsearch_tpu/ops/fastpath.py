@@ -62,6 +62,54 @@ def _score_dtype():
     return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
 
 
+def _doubling_scan(keys, vals, steps=_SCAN_STEPS):
+    """Segmented inclusive sums over contiguous key-runs along the LAST
+    axis (Hillis-Steele with the key-equality carry; run length must be
+    covered by ``steps`` — see _SCAN_STEPS). Shared by every serving
+    kernel so the precision contract lives in one place."""
+    x = vals
+    nd = keys.ndim
+    for step in steps:
+        pw = [(0, 0)] * (nd - 1) + [(step, 0)]
+        prev_x = jnp.pad(x[..., :-step], pw)
+        prev_k = jnp.pad(keys[..., :-step], pw, constant_values=-1)
+        x = x + jnp.where(prev_k == keys, prev_x, 0.0)
+    return x
+
+
+def _stable_topk(cand, keys, k: int, bound_slot: bool = False):
+    """STABLE top-k of ``cand`` [P] with the exactness contract's tie
+    order: ``cand`` is key-ascending-ordered, so keeping the FIRST ties
+    at the kth value takes the LOWEST keys (Lucene/CPU-baseline
+    semantics — TPU top_k alone breaks ties arbitrarily). Returns
+    (vals, ids) in cand's dtype; with ``bound_slot`` also the (k+1)th
+    value (the v2 certificate's exclusion bound)."""
+    vals1 = jax.lax.top_k(cand, k + 1 if bound_slot else k)[0]
+    kth = vals1[k - 1]
+    gt = cand > kth
+    eq = cand == kth
+    need = k - gt.sum()
+    eq_rank = jnp.cumsum(eq.astype(jnp.int32))
+    cand2 = jnp.where(gt | (eq & (eq_rank <= need)), cand, -jnp.inf)
+    vals, pos = jax.lax.top_k(cand2, k)
+    ids = jnp.take(keys, pos)
+    ids = jnp.where(jnp.isfinite(vals), ids, _SENTINEL)
+    if bound_slot:
+        return vals, ids, vals1[k]
+    return vals, ids
+
+
+def _run_last_candidates(mk, x):
+    """(cand, totals) from merged keys + per-run sums (batched [Q, P]):
+    run-last positions carry the doc totals; everything else -inf."""
+    q = mk.shape[0]
+    nxt = jnp.concatenate([mk[:, 1:], jnp.full((q, 1), -1, mk.dtype)],
+                          axis=1)
+    real_last = (mk != nxt) & (x > 0.0) & (mk != _SENTINEL)
+    totals = real_last.sum(axis=1, dtype=jnp.int32)
+    return jnp.where(real_last, x, -jnp.inf), totals
+
+
 def _topk_total(block_docids, block_tfs, sel_blocks, sel_weights,
                 doc_lens, live_col, avg_len, k1: float, b: float, k: int):
     """Single query: (values [k], docids [k], total []) — sort by docid,
@@ -80,37 +128,10 @@ def _topk_total(block_docids, block_tfs, sel_blocks, sel_weights,
     cflat = jnp.where(valid, cflat, jnp.asarray(0.0, dt))
 
     sorted_k, sorted_c = jax.lax.sort((dkey, cflat), num_keys=1)
-    # segmented inclusive scan by doubling: runs are contiguous, so
-    # key[i-d] == key[i] implies the whole [i-d, i] span is one run
-    x = sorted_c
-    for step in _SCAN_STEPS:
-        prev_x = jnp.pad(x[:-step], (step, 0))
-        prev_k = jnp.pad(sorted_k[:-step], (step, 0),
-                         constant_values=-1)
-        x = x + jnp.where(prev_k == sorted_k, prev_x, 0.0)
-    nxt = jnp.concatenate([sorted_k[1:],
-                           jnp.full(1, -1, sorted_k.dtype)])
-    is_last = sorted_k != nxt
-    real_last = is_last & (x > 0.0) & (sorted_k != _SENTINEL)
-    cand = jnp.where(real_last, x, -jnp.inf)
-    total = real_last.sum(dtype=jnp.int32)
-    # STABLE top-k: TPU top_k does not break exact-score ties by lowest
-    # index, but the exactness contract (and Lucene, and the CPU
-    # baseline) takes the LOWEST DOCID among boundary ties — with
-    # integer tfs/lengths, dozens of docs can tie bit-exactly at the
-    # kth score. Phase 1 finds the kth value; phase 2 keeps every doc
-    # above it plus the first (lowest-docid — cand is docid-ordered)
-    # ties at it, exactly filling k.
-    vals1, _ = jax.lax.top_k(cand, k)
-    kth = vals1[k - 1]
-    gt = cand > kth
-    eq = cand == kth
-    t_need = k - gt.sum()
-    eq_rank = jnp.cumsum(eq.astype(jnp.int32))
-    cand2 = jnp.where(gt | (eq & (eq_rank <= t_need)), cand, -jnp.inf)
-    vals, pos = jax.lax.top_k(cand2, k)
-    ids = jnp.take(sorted_k, pos)
-    ids = jnp.where(jnp.isfinite(vals), ids, _SENTINEL)
+    x = _doubling_scan(sorted_k, sorted_c)
+    cand, total = _run_last_candidates(sorted_k[None, :], x[None, :])
+    cand, total = cand[0], total[0]
+    vals, ids = _stable_topk(cand, sorted_k, k)
     return vals.astype(jnp.float32), ids, total
 
 
@@ -154,17 +175,9 @@ def _essential_one(block_docids, block_tfs, flat_docids, flat_tfs,
     dkey = jnp.where(valid, dflat, _SENTINEL)
     cflat = jnp.where(valid, cflat, jnp.asarray(0.0, dt))
     sorted_k, sorted_c = jax.lax.sort((dkey, cflat), num_keys=1)
-    x = sorted_c
-    for step in _SCAN_STEPS:
-        prev_x = jnp.pad(x[:-step], (step, 0))
-        prev_k = jnp.pad(sorted_k[:-step], (step, 0),
-                         constant_values=-1)
-        x = x + jnp.where(prev_k == sorted_k, prev_x, 0.0)
-    nxt = jnp.concatenate([sorted_k[1:],
-                           jnp.full(1, -1, sorted_k.dtype)])
-    is_last = sorted_k != nxt
-    real_last = is_last & (x > 0.0) & (sorted_k != _SENTINEL)
-    cand = jnp.where(real_last, x, -jnp.inf)
+    x = _doubling_scan(sorted_k, sorted_c)
+    cand, _tot = _run_last_candidates(sorted_k[None, :], x[None, :])
+    cand = cand[0]
     # top C+1: the (C+1)th essential score feeds the exactness bound
     ess_vals, pos = jax.lax.top_k(cand, CAND + 1)
     cand_ids = jnp.take(sorted_k, pos)[:CAND]
@@ -286,20 +299,13 @@ _F32_SLACK = 128.0 * 2.0 ** -24
 def _stable_top_c(cand, mk, c):
     """[Q, P] -> (ids [Q, c], bound [Q]): the c candidates with docid-
     ascending tie order at the boundary (cand is docid-ordered so
-    cumulative tie rank = docid rank), plus the (c+1)th value."""
-    vals1 = jax.lax.top_k(cand, c + 1)[0]
-    kth = vals1[:, c]
-    bound = kth                                  # -inf when < c+1 cands
-    gt = cand > kth[:, None]
-    eq = cand == kth[:, None]
-    need = c - gt.sum(axis=1)
-    eq_rank = jnp.cumsum(eq.astype(jnp.int32), axis=1)
-    cand2 = jnp.where(gt | (eq & (eq_rank <= need[:, None])), cand,
-                      -jnp.inf)
-    cvals, cpos = jax.lax.top_k(cand2, c)
-    cids = jnp.take_along_axis(mk, cpos, axis=1)
-    cids = jnp.where(jnp.isfinite(cvals), cids, _SENTINEL)
-    return cids, bound
+    cumulative tie rank = docid rank), plus the (c+1)th value — the
+    certificate's exclusion bound."""
+    def one(cand_q, mk_q):
+        _vals, ids, bound = _stable_topk(cand_q, mk_q, c,
+                                         bound_slot=True)
+        return ids, bound
+    return jax.vmap(one)(cand, mk)
 
 
 @partial(jax.jit, static_argnames=("n_slots", "k1", "b", "k"))
@@ -340,32 +346,22 @@ def bm25_topk_total_merge_batch(
         return key.reshape(-1), contrib.reshape(-1)
 
     keys, cons = jax.vmap(gather_one)(sel_blocks, sel_weights, mask_ids)
-    mk, x = merge_sorted_slots(keys.reshape(Q, n_slots, L),
-                               cons.reshape(Q, n_slots, L))
-    for step in (1, 2, 4, 8):
-        prev_x = jnp.pad(x[:, :-step], ((0, 0), (step, 0)))
-        prev_k = jnp.pad(mk[:, :-step], ((0, 0), (step, 0)),
-                         constant_values=-1)
-        x = x + jnp.where(prev_k == mk, prev_x, 0.0)
-    nxt = jnp.concatenate(
-        [mk[:, 1:], jnp.full((Q, 1), -1, mk.dtype)], axis=1)
-    is_last = mk != nxt
-    real_last = is_last & (x > 0.0) & (mk != _SENTINEL)
-    totals = real_last.sum(axis=1, dtype=jnp.int32)
-    cand = jnp.where(real_last, x, -jnp.inf)
+    # the merge carries the LANE INDEX as payload (all-int32 — the
+    # pallas chunk kernels must never see the rail dtype: Mosaic has no
+    # real f64 and silently loses the rail's precision); the rail-dtype
+    # contributions are gathered through the merged permutation at XLA
+    # level, where f64 is exact
+    lane = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :],
+                            (Q, P)).reshape(Q, n_slots, L)
+    mk, midx = merge_sorted_slots(keys.reshape(Q, n_slots, L), lane)
+    x = jnp.take_along_axis(cons, midx, axis=1)
+    # runs <= N_SLOTS=16 term instances: 4 steps cover them; the
+    # default 5th would be a wasted full-width pass per launch
+    x = _doubling_scan(mk, x, steps=(1, 2, 4, 8))
+    cand, totals = _run_last_candidates(mk, x)
 
     def topk_one(cand_q, mk_q):
-        vals1, _ = jax.lax.top_k(cand_q, k)
-        kth = vals1[k - 1]
-        gt = cand_q > kth
-        eq = cand_q == kth
-        t_need = k - gt.sum()
-        eq_rank = jnp.cumsum(eq.astype(jnp.int32))
-        cand2 = jnp.where(gt | (eq & (eq_rank <= t_need)), cand_q,
-                          -jnp.inf)
-        vals, pos = jax.lax.top_k(cand2, k)
-        ids = jnp.take(mk_q, pos)
-        ids = jnp.where(jnp.isfinite(vals), ids, _SENTINEL)
+        vals, ids = _stable_topk(cand_q, mk_q, k)
         return vals.astype(jnp.float32), ids
 
     vals, ids = jax.vmap(topk_one)(cand, mk)
@@ -424,19 +420,9 @@ def bm25_candidates_rerank_batch(
     mk, mv = merge_sorted_slots(keys.reshape(Q, n_slots, L),
                                 cons.reshape(Q, n_slots, L))
 
-    # ---- segmented sums (runs <= MAX_T instances) + candidates
-    x = mv
-    for step in (1, 2, 4, 8):
-        prev_x = jnp.pad(x[:, :-step], ((0, 0), (step, 0)))
-        prev_k = jnp.pad(mk[:, :-step], ((0, 0), (step, 0)),
-                         constant_values=-1)
-        x = x + jnp.where(prev_k == mk, prev_x, 0.0)
-    nxt = jnp.concatenate(
-        [mk[:, 1:], jnp.full((Q, 1), -1, mk.dtype)], axis=1)
-    is_last = mk != nxt
-    real_last = is_last & (x > 0.0) & (mk != _SENTINEL)
-    totals = real_last.sum(axis=1, dtype=jnp.int32)
-    cand = jnp.where(real_last, x, -jnp.inf)
+    # ---- segmented sums (runs <= MAX_T=16 instances: 4 steps)
+    x = _doubling_scan(mk, mv, steps=(1, 2, 4, 8))
+    cand, totals = _run_last_candidates(mk, x)
     cids, bound = _stable_top_c(cand, mk, CAND_V2)
 
     # ---- phase B: exact f64 re-rank of the candidates

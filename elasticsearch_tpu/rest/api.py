@@ -448,6 +448,11 @@ def _register_all(c: RestController):
     c.register("DELETE", "/_security/oauth2/token",
                security_invalidate_token)
     c.register("POST", "/_security/delegate_pki", security_delegate_pki)
+    c.register("PUT", "/_idp/saml/sp/{sp_entity_id}", idp_put_sp)
+    c.register("DELETE", "/_idp/saml/sp/{sp_entity_id}", idp_delete_sp)
+    c.register("GET", "/_idp/saml/metadata/{sp_entity_id}", idp_metadata)
+    c.register("POST", "/_idp/saml/validate", idp_validate)
+    c.register("POST", "/_idp/saml/init", idp_init)
     c.register("POST", "/_security/saml/prepare", security_saml_prepare)
     c.register("POST", "/_security/saml/authenticate",
                security_saml_authenticate)
@@ -2006,6 +2011,93 @@ def security_saml_logout(node, params, body):
     """POST /_security/saml/logout (ref: RestSamlLogoutAction)."""
     return 200, node.security_service.saml_logout(
         (body or {}).get("token", ""))
+
+
+def _idp(node):
+    svc = getattr(node, "idp_service", None)
+    if svc is None:
+        raise IllegalArgumentException(
+            "the identity provider is not enabled (xpack.idp.enabled)")
+    return svc
+
+
+def _unquote_sp(sp_entity_id):
+    """SAML entity ids are URLs — the path segment arrives
+    percent-encoded."""
+    import urllib.parse
+    return urllib.parse.unquote(sp_entity_id)
+
+
+def idp_put_sp(node, params, body, sp_entity_id):
+    """PUT /_idp/saml/sp/{sp_entity_id} (ref:
+    RestPutSamlServiceProviderAction)."""
+    body = body or {}
+    sp_entity_id = _unquote_sp(sp_entity_id)
+    _idp(node).register_sp(sp_entity_id, body.get("acs", ""),
+                           body.get("attributes"))
+    return 200, {"service_provider": {"entity_id": sp_entity_id,
+                                      "enabled": True}}
+
+
+def idp_delete_sp(node, params, body, sp_entity_id):
+    """DELETE /_idp/saml/sp/{sp_entity_id} (ref:
+    RestDeleteSamlServiceProviderAction)."""
+    sp_entity_id = _unquote_sp(sp_entity_id)
+    found = _idp(node).delete_sp(sp_entity_id)
+    if not found:
+        raise ResourceNotFoundException(
+            f"service provider [{sp_entity_id}] not found")
+    return 200, {"service_provider": {"entity_id": sp_entity_id}}
+
+
+def idp_metadata(node, params, body, sp_entity_id):
+    """GET /_idp/saml/metadata/{sp_entity_id} (ref:
+    RestSamlMetadataAction)."""
+    from elasticsearch_tpu.xpack.saml import SamlException
+    try:
+        return 200, {"metadata": _idp(node).metadata_xml(
+            _unquote_sp(sp_entity_id))}
+    except SamlException as e:
+        raise ResourceNotFoundException(str(e))
+
+
+def idp_validate(node, params, body):
+    """POST /_idp/saml/validate (ref:
+    RestSamlValidateAuthenticationRequestAction)."""
+    from elasticsearch_tpu.xpack.saml import SamlException
+    try:
+        return 200, _idp(node).validate_authn_request(
+            (body or {}).get("authn_request", ""))
+    except SamlException as e:
+        raise IllegalArgumentException(str(e))
+
+
+def idp_init(node, params, body):
+    """POST /_idp/saml/init (ref: RestSamlInitiateSingleSignOnAction):
+    issues a signed SAMLResponse for the AUTHENTICATED user to the
+    given SP."""
+    from elasticsearch_tpu.xpack.saml import SamlException
+    body = body or {}
+    user = _current_user(node)
+    if user is None:
+        sec = getattr(node, "security_service", None)
+        if sec is not None and sec.enabled:
+            raise IllegalArgumentException(
+                "SSO initiation requires an authenticated user")
+        from elasticsearch_tpu.xpack.security import User
+        user = User("_anonymous", [])
+    svc = _idp(node)
+    try:
+        content = svc.issue_response(
+            body.get("entity_id", ""), user.username,
+            groups=list(user.roles),
+            in_response_to=body.get("in_response_to"))
+    except SamlException as e:
+        raise IllegalArgumentException(str(e))
+    return 200, {"post_url": svc.sp_acs(body.get("entity_id", "")),
+                 "saml_response": content,
+                 "saml_status": "urn:oasis:names:tc:SAML:2.0:"
+                                "status:Success"}
 
 
 def security_delegate_pki(node, params, body):

@@ -441,9 +441,11 @@ class FastPathServer:
                 (tok, k, term_ids, filt))
         for bucket, items in ess_by_bucket.items():
             for chunk in self._chunk_by_slots(items):
+                stack, rows = self._resolve_mask_rows(
+                    reg, {it[3] for it in chunk})
                 self._sem.acquire()
                 self._pool.submit(self._launch_essential, reg, bucket,
-                                  chunk, t_arrive)
+                                  chunk, t_arrive, stack, rows)
 
         # adaptive merge-up: a nearly-empty bucket group pays the full
         # per-launch tunnel floor for a handful of queries — fold small
@@ -468,16 +470,20 @@ class FastPathServer:
 
         for bucket, items in merge_up(v2_by_bucket).items():
             for chunk in self._chunk_by_slots(items):
+                stack, rows = self._resolve_mask_rows(
+                    reg, {it[3] for it in chunk})
                 self._sem.acquire()
                 self._pool.submit(self._launch_group_v2, reg, bucket,
-                                  chunk, t_arrive)
+                                  chunk, t_arrive, stack, rows)
         for bucket, items in merge_up(by_bucket).items():
             for chunk in self._chunk_by_slots(items):
+                stack, rows = self._resolve_mask_rows(
+                    reg, {it[3] for it in chunk})
                 # backpressure: wait for a free stream — requests keep
                 # queueing in C++ meanwhile and drain in wider cohorts
                 self._sem.acquire()
                 self._pool.submit(self._launch_group, reg, bucket,
-                                  chunk, t_arrive)
+                                  chunk, t_arrive, stack, rows)
 
     def _v2_bucket(self, reg, term_ids) -> Optional[int]:
         """Smallest bucket whose slot layout fits: each term INSTANCE
@@ -495,9 +501,11 @@ class FastPathServer:
                 return bucket
         return None
 
-    def _launch_group_v2(self, reg, bucket, items, t_arrive):
+    def _launch_group_v2(self, reg, bucket, items, t_arrive, stack,
+                         rows):
         try:
-            self._launch_group_v2_inner(reg, bucket, items, t_arrive)
+            self._launch_group_v2_inner(reg, bucket, items, t_arrive,
+                                        stack, rows)
         except Exception:
             logger.exception("fastpath v2 launch failed; bouncing "
                              "cohort")
@@ -511,7 +519,8 @@ class FastPathServer:
         finally:
             self._sem.release()
 
-    def _launch_group_v2_inner(self, reg, bucket, items, t_arrive):
+    def _launch_group_v2_inner(self, reg, bucket, items, t_arrive,
+                               stack, rows):
         from elasticsearch_tpu.ops.fastpath import (
             MAX_T, bm25_candidates_rerank_batch,
             bm25_topk_total_merge_batch)
@@ -529,8 +538,6 @@ class FastPathServer:
         starts, nbs = reg["starts"], reg["nb"]
         idf32, idf = reg["idf32"], reg["idf"]
         wsrc = idf if v2m else idf32
-        mask_rows = [reg["dev"].live]
-        row_of: Dict[tuple, int] = {}
         no_match: list = []
         for qi, (tok, k, term_ids, filt) in enumerate(items):
             pos = 0
@@ -549,8 +556,7 @@ class FastPathServer:
                 ninst += 1
                 pos += -(-cnt // slot) * slot
             if filt:
-                row = self._assign_mask_row(reg, filt, mask_rows,
-                                            row_of)
+                row = rows.get(filt)
                 if row is None:          # unknown filter term ⇒ no hits
                     no_match.append(tok)
                     sel[qi, :] = dp.zero_block
@@ -558,7 +564,7 @@ class FastPathServer:
                     tl[qi, :] = 0
                     continue
                 mask_ids[qi] = row
-        masks = self._mask_stack(reg, mask_rows)
+        masks = stack
         k_static = self.max_k
         if v2m:
             packed = bm25_topk_total_merge_batch(
@@ -607,7 +613,7 @@ class FastPathServer:
             self.stats["v2_refires"] = self.stats.get("v2_refires", 0) \
                 + len(refire)
             self._launch_group_inner(reg, self.nb_buckets[-1], refire,
-                                     t_arrive)
+                                     t_arrive, stack, rows)
 
     def _respond_empty(self, tok, reg):
         empty = np.zeros(0, np.int32)
@@ -620,9 +626,11 @@ class FastPathServer:
             empty.ctypes.data_as(ctypes.c_void_p), 0, 0, b"eq", 0)
 
     # -------------------------------------------------------------- launch
-    def _launch_group(self, reg, bucket, items, t_arrive):
+    def _launch_group(self, reg, bucket, items, t_arrive, stack,
+                      rows):
         try:
-            self._launch_group_inner(reg, bucket, items, t_arrive)
+            self._launch_group_inner(reg, bucket, items, t_arrive,
+                                     stack, rows)
         except Exception:
             logger.exception("fastpath launch failed; bouncing cohort")
             h = self.front.h
@@ -726,11 +734,12 @@ class FastPathServer:
                 return (bkt, ess, ne, bound, float(theta), int(total))
         return None
 
-    def _launch_essential(self, reg, bucket, items, t_arrive):
+    def _launch_essential(self, reg, bucket, items, t_arrive, stack,
+                          rows):
         responded: set = set()
         try:
             self._launch_essential_inner(reg, bucket, items, t_arrive,
-                                         responded)
+                                         stack, rows, responded)
         except Exception:
             logger.exception("essential launch failed; full-kernel "
                              "retry")
@@ -739,7 +748,7 @@ class FastPathServer:
             left = [it for it in items if it[0] not in responded]
             try:
                 if left:
-                    self._refire_full(reg, left, t_arrive)
+                    self._refire_full(reg, left, t_arrive, stack, rows)
             except Exception:
                 h = self.front.h
                 for tok, *_ in left:
@@ -751,29 +760,33 @@ class FastPathServer:
         finally:
             self._sem.release()
 
-    def _refire_full(self, reg, items, t_arrive):
+    def _refire_full(self, reg, items, t_arrive, stack, rows):
         """Uncertified/failed essential queries re-run on the exact full
         kernel (already holding a stream permit — run inline)."""
         full_items = [(tok, k, term_ids, filt)
                       for tok, k, term_ids, filt, _ess in items]
-        nb_need = max(int(reg["nb"][[t for t in tids if t >= 0]].sum())
-                      for _tok, _k, tids, _f in full_items)
         bucket = self.nb_buckets[-1]
-        for nb in self.nb_buckets:
-            if nb_need <= nb:
-                bucket = nb
-                break
+        if self.kernel_mode not in ("v2", "v2m"):
+            # only v1 mode warms the smaller v1 shapes; in v2/v2m the
+            # largest is the ONLY warmed v1 shape (lazy-compiling a
+            # smaller one at serve time is the round-2 stall)
+            nb_need = max(
+                int(reg["nb"][[t for t in tids if t >= 0]].sum())
+                for _tok, _k, tids, _f in full_items)
+            for nb in self.nb_buckets:
+                if nb_need <= nb:
+                    bucket = nb
+                    break
         self.stats["ess_refires"] = self.stats.get("ess_refires", 0) \
             + len(full_items)
-        self._launch_group_inner(reg, bucket, full_items, t_arrive)
+        self._launch_group_inner(reg, bucket, full_items, t_arrive,
+                                 stack, rows)
 
     def _launch_essential_inner(self, reg, bucket, items, t_arrive,
-                                responded=None):
-        import jax.numpy as jnp
-
+                                stack, rows, responded=None):
         from elasticsearch_tpu.ops.fastpath import (
-            F_SLOTS, NE_SLOTS, bm25_essential_topk_batch)
-        dp, dev = reg["dp"], reg["dev"]
+            NE_SLOTS, bm25_essential_topk_batch)
+        dp = reg["dp"]
         sel = np.full((self.q_batch, bucket), dp.zero_block,
                       np.int32)
         ws = np.zeros((self.q_batch, bucket), self._weight_dtype())
@@ -783,8 +796,6 @@ class FastPathServer:
         ne_idf = np.zeros((self.q_batch, NE_SLOTS), self._weight_dtype())
         ne_bound = np.zeros(self.q_batch, self._weight_dtype())
         starts, nbs, idf = reg["starts"], reg["nb"], reg["idf"]
-        mask_rows = [dev.live]
-        row_of: Dict[tuple, int] = {}
         bad: list = []
         for qi, (tok, k, term_ids, filt, essd) in enumerate(items):
             _bkt, ess_terms, ne_terms, bound, theta, total = essd
@@ -802,23 +813,14 @@ class FastPathServer:
                 ne_idf[qi, ti] = idf[t]
             ne_bound[qi] = bound
             if filt:
-                row = row_of.get(filt)
+                row = rows.get(filt)
                 if row is None:
-                    col = self._filter_col(reg, filt)
-                    if col is None:
-                        bad.append(tok)
-                        sel[qi, :] = dp.zero_block
-                        ws[qi, :] = 0.0
-                        continue
-                    row = len(mask_rows)
-                    mask_rows.append(col)
-                    row_of[filt] = row
+                    bad.append(tok)
+                    sel[qi, :] = dp.zero_block
+                    ws[qi, :] = 0.0
+                    continue
                 mask_ids[qi] = row
-        if len(mask_rows) == 1 and reg.get("plain_masks") is not None:
-            masks = reg["plain_masks"]
-        else:
-            masks = jnp.stack(mask_rows
-                              + [dev.live] * (F_SLOTS - len(mask_rows)))
+        masks = stack
         k_static = self.max_k
         packed = bm25_essential_topk_batch(
             dp.block_docids, dp.block_tfs, reg["flat_docids"],
@@ -868,31 +870,57 @@ class FastPathServer:
                 responded.add(tok)
 
     # ---------------------------------------------------- shared pieces
+    #
+    # The launch mask stack [F_SLOTS, ND] is PERSISTENT on device: row 0
+    # is the plain live mask, rows 1..F-1 are assigned to filter SETS as
+    # they first appear and updated in place (`.at[row].set`). The old
+    # per-launch jnp.stack of F_SLOTS×ND rows was a ~64 MB device op on
+    # EVERY filtered launch — at 2M docs it collapsed the bool lane to
+    # ~1 qps in the degraded tunnel. Rows are assigned ONLY on the drain
+    # thread (_route_cohort) and the resolved (stack, row map) snapshot
+    # rides into each launch, so launch workers never mutate it.
 
-    def _assign_mask_row(self, reg, filt, mask_rows, row_of):
-        """Row index into the launch mask stack for a filter set (row 0
-        = plain live), or None when a filter term is unknown (the query
-        matches nothing)."""
-        row = row_of.get(filt)
-        if row is not None:
-            return row
-        col = self._filter_col(reg, filt)
-        if col is None:
-            return None
-        row = len(mask_rows)
-        mask_rows.append(col)
-        row_of[filt] = row
-        return row
-
-    def _mask_stack(self, reg, mask_rows):
-        import jax.numpy as jnp
-
+    def _resolve_mask_rows(self, reg, filts):
+        """(stack_device, {filt: row}) for a cohort's distinct filter
+        sets; unknown-term filters map to row None (match nothing)."""
         from elasticsearch_tpu.ops.fastpath import F_SLOTS
-        if len(mask_rows) == 1 and reg.get("plain_masks") is not None:
-            return reg["plain_masks"]
-        dev = reg["dev"]
-        return jnp.stack(mask_rows
-                         + [dev.live] * (F_SLOTS - len(mask_rows)))
+        if reg.get("mask_stack") is None:
+            reg["mask_stack"] = reg["plain_masks"]
+            reg["stack_map"] = {}
+            reg["stack_next"] = 1
+        st = reg["mask_stack"]
+        smap = reg["stack_map"]
+        out: Dict[tuple, Optional[int]] = {}
+        for filt in filts:
+            if not filt:
+                continue
+            row = smap.get(filt)
+            if row is None:
+                col = self._filter_col(reg, filt)
+                if col is None:
+                    out[filt] = None
+                    continue
+                # round-robin eviction over rows 1..F-1, but never a
+                # row ALREADY RESOLVED for this cohort (evicting one
+                # would silently evaluate its queries against the wrong
+                # filter column); a cohort holds <= F_SLOTS-1 distinct
+                # sets so a free row always exists
+                taken = {r for r in out.values() if r is not None}
+                taken |= {smap[f] for f in filts
+                          if f and f in smap}
+                for _ in range(F_SLOTS - 1):
+                    row = reg["stack_next"]
+                    reg["stack_next"] = 1 + (row % (F_SLOTS - 1))
+                    if row not in taken:
+                        break
+                for old_f, old_r in list(smap.items()):
+                    if old_r == row:
+                        del smap[old_f]
+                st = st.at[row].set(col)
+                smap[filt] = row
+            out[filt] = row
+        reg["mask_stack"] = st
+        return st, out
 
     def _respond_hits(self, reg, tok, v, d, k, total, took_ms,
                       term_ids=None, filt=None):
@@ -936,7 +964,8 @@ class FastPathServer:
             reg["filter_live"][filt] = col
         return col
 
-    def _launch_group_inner(self, reg, bucket, items, t_arrive):
+    def _launch_group_inner(self, reg, bucket, items, t_arrive,
+                            stack, rows):
         from elasticsearch_tpu.ops.fastpath import bm25_topk_total_batch
         dp = reg["dp"]
         q = len(items)
@@ -945,8 +974,6 @@ class FastPathServer:
         ws = np.zeros((self.q_batch, bucket), self._weight_dtype())
         mask_ids = np.zeros(self.q_batch, np.int32)
         starts, nbs, idf = reg["starts"], reg["nb"], reg["idf"]
-        mask_rows = [reg["dev"].live]     # row 0 = plain live
-        row_of: Dict[tuple, int] = {}
         no_match: list = []
         for qi, (tok, k, term_ids, filt) in enumerate(items):
             pos = 0
@@ -960,15 +987,14 @@ class FastPathServer:
                 ws[qi, pos:pos + cnt] = idf[t]
                 pos += cnt
             if filt:
-                row = self._assign_mask_row(reg, filt, mask_rows,
-                                            row_of)
+                row = rows.get(filt)
                 if row is None:          # unknown filter term ⇒ no hits
                     no_match.append(tok)
                     sel[qi, :] = dp.zero_block
                     ws[qi, :] = 0.0
                     continue
                 mask_ids[qi] = row
-        masks = self._mask_stack(reg, mask_rows)
+        masks = stack
         k_static = self.max_k
         packed = bm25_topk_total_batch(
             dp.block_docids, dp.block_tfs, sel, ws, dp.doc_lens, masks,

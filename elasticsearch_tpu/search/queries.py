@@ -29,6 +29,7 @@ import re
 
 from typing import Any, Dict, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -709,6 +710,7 @@ class KnnQuery(QueryBuilder):
             _, fm = self.filter_query.execute(ctx)
             mask = mask & fm
         scores = jnp.where(mask, scores, 0.0)
+        scores = self._exact_rerank(ctx, dv, scores)
         cut = self.k or self.num_candidates
         if cut is not None and cut < ctx.n_docs_padded:
             # keep only the k nearest per segment (the gather half of
@@ -719,6 +721,40 @@ class KnnQuery(QueryBuilder):
             mask = mask & (scores >= kth)
             scores = jnp.where(mask, scores, 0.0)
         return scores, mask
+
+    def _exact_rerank(self, ctx, dv, scores):
+        """When the device slab is QUANTIZED (bf16 — an 8M×768 f32 slab
+        exceeds single-chip HBM, BASELINE.md config 4), the quantized
+        scores only NOMINATE candidates: the top num_candidates
+        (default 3k) get their similarities recomputed exactly in
+        float32 from the segment's host vectors and scattered back, so
+        the final top-k ranks on exact f32 — recall vs an f32 oracle is
+        then bounded only by candidate coverage, not by bf16 rounding."""
+        if dv.vectors.dtype == jnp.float32:
+            return scores
+        seg = getattr(ctx.device, "segment", None)
+        vv = seg.vectors.get(self.field) if seg is not None else None
+        if vv is None:
+            return scores
+        nc = int(self.num_candidates or 3 * (self.k or 1000))
+        nc = min(nc, ctx.n_docs_padded)
+        _, ids = jax.lax.top_k(scores, nc)
+        ids_h = np.asarray(ids)                # tiny readback [nc]
+        ids_h = ids_h[ids_h < vv.vectors.shape[0]]
+        cand = vv.vectors[ids_h].astype(np.float32)
+        q32 = self.query_vector.astype(np.float32)
+        if dv.similarity == "cosine":
+            nrm = np.linalg.norm(cand, axis=1) * np.linalg.norm(q32)
+            sim = cand @ q32 / np.where(nrm > 0, nrm, 1.0)
+            exact = (1.0 + sim) / 2.0
+        elif dv.similarity == "dot_product":
+            exact = (1.0 + cand @ q32) / 2.0
+        else:  # l2_norm
+            d2 = ((cand - q32[None, :]) ** 2).sum(axis=1)
+            exact = 1.0 / (1.0 + d2)
+        return scores.at[jnp.asarray(ids_h)].set(
+            jnp.asarray(exact.astype(np.float32)), mode="drop",
+            unique_indices=True)
 
     def rewrite(self, searcher):
         if self.filter_query is None:

@@ -294,19 +294,25 @@ class SamlAuthnFlow:
 # ---------------------------------------------------------------------------
 
 class SamlIdentityProvider:
-    """Minimal SAML IdP: registered SPs (entity id → ACS), signed
-    Response+Assertion issuance for an authenticated principal (ref:
-    identity-provider SuccessfulAuthenticationResponseMessageBuilder).
-    """
+    """SAML IdP (ref: x-pack/plugin/identity-provider — the
+    SamlIdentityProviderPlugin): registered SPs (entity id → ACS),
+    signed Response+Assertion issuance for an authenticated principal
+    (ref: .../saml/authn/SuccessfulAuthenticationResponseMessageBuilder
+    .java), IdP metadata and AuthnRequest validation for the
+    /_idp/saml/* APIs (RestSamlInitiateSingleSignOnAction,
+    RestSamlMetadataAction, RestSamlValidateAuthenticationRequestAction,
+    RestPutSamlServiceProviderAction paths)."""
 
     def __init__(self, entity_id: str, private_key_pem: bytes,
-                 cert_pem: str, session_ttl: float = 300.0):
+                 cert_pem: str, session_ttl: float = 300.0,
+                 sso_url: str = ""):
         from cryptography.hazmat.primitives import serialization
         self.entity_id = entity_id
         self._key = serialization.load_pem_private_key(
             private_key_pem, password=None)
         self._cert_pem = cert_pem
         self.session_ttl = session_ttl
+        self.sso_url = sso_url
         self._sps: Dict[str, Dict[str, Any]] = {}
 
     def register_sp(self, entity_id: str, acs: str,
@@ -315,8 +321,72 @@ class SamlIdentityProvider:
         self._sps[entity_id] = {"acs": acs,
                                 "attributes": attributes or {}}
 
+    def delete_sp(self, entity_id: str) -> bool:
+        """ref: DeleteSamlServiceProviderAction."""
+        return self._sps.pop(entity_id, None) is not None
+
     def sp_registered(self, entity_id: str) -> bool:
         return entity_id in self._sps
+
+    def sp_acs(self, entity_id: str) -> Optional[str]:
+        sp = self._sps.get(entity_id)
+        return sp["acs"] if sp else None
+
+    def metadata_xml(self, sp_entity_id: str) -> str:
+        """IdP EntityDescriptor for a registered SP (ref:
+        SamlMetadataAction → EntityDescriptor with IDPSSODescriptor +
+        signing KeyDescriptor)."""
+        if sp_entity_id not in self._sps:
+            raise SamlException(
+                f"service provider [{sp_entity_id}] is not registered")
+        md = "urn:oasis:names:tc:SAML:2.0:metadata"
+        ds = "http://www.w3.org/2000/09/xmldsig#"
+        ed = ET.Element(f"{{{md}}}EntityDescriptor",
+                        {"entityID": self.entity_id})
+        idp = ET.SubElement(ed, f"{{{md}}}IDPSSODescriptor", {
+            "protocolSupportEnumeration":
+                "urn:oasis:names:tc:SAML:2.0:protocol"})
+        kd = ET.SubElement(idp, f"{{{md}}}KeyDescriptor",
+                           {"use": "signing"})
+        ki = ET.SubElement(kd, f"{{{ds}}}KeyInfo")
+        xd = ET.SubElement(ki, f"{{{ds}}}X509Data")
+        xc = ET.SubElement(xd, f"{{{ds}}}X509Certificate")
+        xc.text = "".join(
+            line for line in self._cert_pem.strip().splitlines()
+            if "CERTIFICATE" not in line)
+        ET.SubElement(idp, f"{{{md}}}SingleSignOnService", {
+            "Binding":
+                "urn:oasis:names:tc:SAML:2.0:bindings:HTTP-Redirect",
+            "Location": self.sso_url or ""})
+        return ET.tostring(ed, encoding="unicode")
+
+    def validate_authn_request(self, saml_request_b64: str
+                               ) -> Dict[str, Any]:
+        """Decode+validate a redirect-binding SAMLRequest (ref:
+        SamlValidateAuthenticationRequestAction): the issuer must be a
+        registered SP and the ACS must match its registration."""
+        try:
+            xml = zlib.decompress(base64.b64decode(saml_request_b64),
+                                  -15)
+            root = ET.fromstring(xml)
+        except Exception:
+            raise SamlException("malformed SAMLRequest")
+        if root.tag != _p("AuthnRequest"):
+            raise SamlException("SAMLRequest is not an AuthnRequest")
+        iss = root.find(_a("Issuer"))
+        sp_id = (iss.text or "").strip() if iss is not None else ""
+        sp = self._sps.get(sp_id)
+        if sp is None:
+            raise SamlException(
+                f"service provider [{sp_id}] is not registered")
+        acs = root.get("AssertionConsumerServiceURL")
+        if acs and acs != sp["acs"]:
+            raise SamlException(
+                f"AuthnRequest ACS [{acs}] does not match the "
+                f"registered ACS for [{sp_id}]")
+        return {"authn_state": {"entity_id": sp_id,
+                                "acs": sp["acs"],
+                                "authn_request_id": root.get("ID")}}
 
     def issue_response(self, sp_entity_id: str, principal: str,
                        groups: Optional[List[str]] = None,

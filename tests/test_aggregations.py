@@ -492,3 +492,35 @@ def test_auto_date_histogram_contract(tmp_path_factory):
     assert keys == sorted(keys)
     assert any(b["doc_count"] == 0 for b in buckets) or len(buckets) <= 2
     indices.close()
+
+
+def test_device_terms_counts_matches_host():
+    """The device ord-major terms collector (ops/aggs.py) is exact vs
+    the host bincount for multi-valued keywords under a query mask."""
+    import jax
+    import numpy as np
+    from elasticsearch_tpu.ops.aggs import terms_counts_per_term
+
+    rng = np.random.default_rng(5)
+    n_docs, n_terms = 5000, 37
+    counts = rng.integers(0, 4, size=n_docs)
+    offsets = np.zeros(n_docs + 1, np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    all_ords = rng.integers(0, n_terms, size=int(counts.sum())
+                            ).astype(np.int32)
+    mask = rng.random(n_docs) < 0.3
+
+    # host reference
+    sel = np.repeat(mask, counts)
+    ref = np.bincount(all_ords[sel], minlength=n_terms)
+
+    # device path structures (as DeviceSegment.keyword_ord_major builds)
+    order = np.argsort(all_ords, kind="stable")
+    pos_doc = np.searchsorted(offsets, np.arange(len(all_ords)),
+                              side="right") - 1
+    perm_docs = pos_doc[order].astype(np.int32)
+    starts = np.searchsorted(all_ords[order],
+                             np.arange(n_terms + 1)).astype(np.int64)
+    got = terms_counts_per_term(jax.device_put(perm_docs), starts,
+                                jax.device_put(mask))
+    np.testing.assert_array_equal(got, ref)

@@ -308,3 +308,88 @@ def test_saml_forged_response_rejected_through_rest(tmp_path,
         assert st == 401
     finally:
         node.close()
+
+
+def test_identity_provider_full_circle(tmp_path, idp_keypair):
+    """IdP node (xpack.idp.*) + SP realm: SP prepare → IdP validate →
+    IdP init (authenticated) → SP authenticate — the full SSO circle
+    through REST on both sides (ref: x-pack/plugin/identity-provider
+    + SamlRealm)."""
+    import urllib.parse
+    _, key_pem, cert_pem = idp_keypair
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.node import Node
+    key_file = tmp_path / "idp.key"
+    key_file.write_bytes(key_pem)
+    cert_file = tmp_path / "idp.pem"
+    cert_file.write_text(cert_pem)
+
+    idp_node = Node(settings=Settings.from_dict({
+        "xpack": {"idp": {"enabled": True,
+                          "entity_id": "https://idp.example/",
+                          "sso_url": "https://idp.example/sso",
+                          "signing": {"key": str(key_file),
+                                      "certificate": str(cert_file)}}},
+    }), data_path=str(tmp_path / "idp_node"))
+    sp_node = Node(settings=Settings.from_dict({
+        "xpack": {"security": {"enabled": True, "authc": {"saml": {
+            "idp": {"entity_id": "https://idp.example/",
+                    "certificate": str(cert_file),
+                    "sso_url": "https://idp.example/sso"},
+            "sp": {"entity_id": "https://sp.example/",
+                   "acs": "https://sp.example/acs"},
+        }}}},
+    }), data_path=str(tmp_path / "sp_node"))
+    try:
+        # entity ids are URLs: the path segment is percent-encoded and
+        # the handlers decode it
+        st, put_out = idp_node.rest_controller.dispatch(
+            "PUT", "/_idp/saml/sp/https:%2F%2Fsp.example%2F", None,
+            {"acs": "https://sp.example/acs"})
+        assert st == 200, put_out
+        assert put_out["service_provider"]["entity_id"] == \
+            "https://sp.example/"
+        assert idp_node.idp_service.sp_registered("https://sp.example/")
+        st, prep = sp_node.rest_controller.dispatch(
+            "POST", "/_security/saml/prepare", None, {})
+        assert st == 200
+        req_b64 = urllib.parse.parse_qs(
+            urllib.parse.urlsplit(prep["redirect"]).query
+        )["SAMLRequest"][0]
+        st, val = idp_node.rest_controller.dispatch(
+            "POST", "/_idp/saml/validate", None,
+            {"authn_request": req_b64})
+        assert st == 200
+        assert val["authn_state"]["entity_id"] == "https://sp.example/"
+        assert val["authn_state"]["authn_request_id"] == prep["id"]
+        st, sso = idp_node.rest_controller.dispatch(
+            "POST", "/_idp/saml/init", None,
+            {"entity_id": "https://sp.example/",
+             "in_response_to": val["authn_state"]["authn_request_id"]})
+        assert st == 200 and sso["post_url"] == "https://sp.example/acs"
+        st, tok = sp_node.rest_controller.dispatch(
+            "POST", "/_security/saml/authenticate", None,
+            {"content": sso["saml_response"]})
+        # principal comes from the IdP node's request user; without
+        # security on the IdP node the anonymous principal signs in
+        assert st == 200
+        assert tok["username"] == "_anonymous"
+        # metadata for the registered SP through REST
+        st, meta = idp_node.rest_controller.dispatch(
+            "GET", "/_idp/saml/metadata/https:%2F%2Fsp.example%2F",
+            None, None)
+        assert st == 200, meta
+        xml = meta["metadata"]
+        assert "IDPSSODescriptor" in xml and "X509Certificate" in xml
+        # unregistered SP 404s
+        st, _ = idp_node.rest_controller.dispatch(
+            "GET", "/_idp/saml/metadata/unknown-sp", None, None)
+        assert st == 404
+        # unregistered SP rejected
+        import pytest as _pytest
+        from elasticsearch_tpu.xpack.saml import SamlException
+        with _pytest.raises(SamlException):
+            idp_node.idp_service.validate_authn_request("AAAA")
+    finally:
+        idp_node.close()
+        sp_node.close()
