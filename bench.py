@@ -714,8 +714,15 @@ def run_rest_path(corpus, queries, truth, tmpdir):
     # Python serving path (REST dispatch → query DSL → device kernels)
     extra = {}
 
-    def _row(name, bodies, conns, reps):
+    def _row(name, bodies, conns, reps, check=None):
         try:
+            # validate ONE response before measuring — a row that 400s
+            # would otherwise 'benchmark' error responses
+            probe = http_post(bodies[0])
+            if "error" in probe:
+                raise RuntimeError(f"probe error: {probe['error']}")
+            if check is not None:
+                check(probe)
             _loadgen(port, bodies, conns, len(bodies))          # warm
             done_x, qps_x, lat_x = _loadgen(port, bodies, conns,
                                             len(bodies) * reps)
@@ -734,7 +741,9 @@ def run_rest_path(corpus, queries, truth, tmpdir):
     _row("match+terms-agg", [
         {"query": {"match": {"title": qtext(q)}}, "size": 0,
          "aggs": {"cats": {"terms": {"field": "cat"}}}}
-        for q in queries[:32]], min(CLIENTS, 64), 4)
+        for q in queries[:32]], min(CLIENTS, 64), 4,
+        check=lambda r: (r["aggregations"]["cats"]["buckets"][0]
+                         ["doc_count"] > 0))
     # BASELINE config 3: script_score re-rank (vectorized expression)
     _row("script_score", [
         {"query": {"script_score": {
@@ -758,7 +767,8 @@ def run_rest_path(corpus, queries, truth, tmpdir):
                                          for x in qv],
                         "k": K, "num_candidates": int(1.5 * K)},
                 "rank": {"rrf": {}}, "size": K, "_source": False})
-        _row("rrf_hybrid", rbodies, min(CLIENTS, 64), 4)
+        _row("rrf_hybrid", rbodies, min(CLIENTS, 64), 4,
+             check=lambda r: len(r["hits"]["hits"]) > 0)
 
     node.close()
     return (best_qps, p50, p99, rest_recall, warm_recall, avg_batch,

@@ -57,19 +57,23 @@ def main(argv=None) -> int:
         log.error("%s", e)
         return 78          # EX_CONFIG, like the reference's exit path
 
-    node = Node(settings=settings, data_path=args.data)
-    port = node.start(int(settings.get("http.port", 9200)))
-    log.info("node [%s] started, HTTP on %s:%d", node.name, bind_host,
-             port)
-    print(f"started node={node.name} port={port}", flush=True)
-
     stop = threading.Event()
 
     def _term(_sig, _frm):
         stop.set()
 
+    # handlers BEFORE announcing readiness: a supervisor that reacts to
+    # the startup line can SIGTERM immediately, and the default handler
+    # would kill the process instead of draining it (observed as a
+    # -SIGTERM exit under machine load)
     signal.signal(signal.SIGTERM, _term)
     signal.signal(signal.SIGINT, _term)
+
+    node = Node(settings=settings, data_path=args.data)
+    port = node.start(int(settings.get("http.port", 9200)))
+    log.info("node [%s] started, HTTP on %s:%d", node.name, bind_host,
+             port)
+    print(f"started node={node.name} port={port}", flush=True)
     stop.wait()
     log.info("stopping node [%s]", node.name)
     node.close()

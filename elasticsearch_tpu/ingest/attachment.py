@@ -70,15 +70,141 @@ def _strip_rtf(text: str) -> str:
     return re.sub(r"\s+", " ", text).strip()
 
 
+def _pdf_text(raw: bytes) -> Optional[str]:
+    """Text from PDF content streams (ref: the reference parses PDFs
+    through Tika/PDFBox — AttachmentProcessor.java; here a native
+    reader covers the text operators): every stream object is
+    inflated when FlateDecode'd, then Tj/TJ/' show-text operators are
+    read, with octal escapes and hex strings decoded. Covers
+    uncompressed and Flate text streams (the overwhelmingly common
+    encodings); exotic filters (LZW, JBIG2, CID-keyed fonts with
+    custom CMaps) fall back to detected-not-parsed."""
+    import zlib
+    chunks: list = []
+    for m in re.finditer(rb"stream\r?\n(.*?)\r?\nendstream", raw,
+                         re.DOTALL):
+        data = m.group(1)
+        if data[:2] in (b"\x78\x9c", b"\x78\x01", b"\x78\xda"):
+            try:
+                data = zlib.decompress(data)
+            except zlib.error:
+                continue
+        if b"Tj" not in data and b"TJ" not in data \
+                and b"'" not in data:
+            continue
+        for sm in re.finditer(
+                rb"\(((?:[^()\\]|\\.)*)\)\s*(?:Tj|')"
+                rb"|\[((?:[^\[\]\\]|\\.|\([^)]*\))*)\]\s*TJ"
+                rb"|<([0-9A-Fa-f\s]+)>\s*Tj", data):
+            if sm.group(1) is not None:
+                chunks.append(_pdf_unescape(sm.group(1)))
+            elif sm.group(2) is not None:
+                for lit in re.finditer(rb"\(((?:[^()\\]|\\.)*)\)",
+                                       sm.group(2)):
+                    chunks.append(_pdf_unescape(lit.group(1)))
+            else:
+                hx = re.sub(rb"\s", b"", sm.group(3))
+                try:
+                    chunks.append(bytes.fromhex(hx.decode()).decode(
+                        "latin-1"))
+                except ValueError:
+                    pass
+        if chunks and chunks[-1] and not chunks[-1].endswith(" "):
+            chunks.append(" ")
+    text = re.sub(r"\s+", " ", "".join(chunks)).strip()
+    return text or None
+
+
+def _pdf_unescape(b: bytes) -> str:
+    out = []
+    i = 0
+    while i < len(b):
+        c = b[i]
+        if c == 0x5C and i + 1 < len(b):       # backslash
+            n = b[i + 1]
+            esc = {0x6E: "\n", 0x72: "\r", 0x74: "\t", 0x62: "\b",
+                   0x66: "\f", 0x28: "(", 0x29: ")", 0x5C: "\\"}
+            if n in esc:
+                out.append(esc[n])
+                i += 2
+                continue
+            if 0x30 <= n <= 0x37:              # octal
+                j = i + 1
+                oct_s = ""
+                while j < len(b) and len(oct_s) < 3 \
+                        and 0x30 <= b[j] <= 0x37:
+                    oct_s += chr(b[j])
+                    j += 1
+                out.append(chr(int(oct_s, 8) & 0xFF))
+                i = j
+                continue
+            i += 1
+            continue
+        out.append(chr(c))
+        i += 1
+    return "".join(out)
+
+
+def _ooxml_text(raw: bytes) -> Tuple[Optional[str], Optional[str],
+                                     Optional[str]]:
+    """(text, title, content_type) from an OOXML zip (docx/xlsx/pptx —
+    stdlib zipfile + XML; the reference goes through Tika's OOXML
+    parser). Text nodes: w:t (Word), t in sharedStrings (Excel), a:t
+    (PowerPoint)."""
+    import zipfile
+    from xml.etree import ElementTree as ET
+    try:
+        zf = zipfile.ZipFile(io.BytesIO(raw))
+        names = set(zf.namelist())
+    except zipfile.BadZipFile:
+        return None, None, None
+
+    def texts(data, tag):
+        try:
+            root = ET.fromstring(data)
+        except ET.ParseError:
+            return []
+        return [el.text for el in root.iter()
+                if el.tag.endswith(tag) and el.text]
+
+    title = None
+    if "docProps/core.xml" in names:
+        for t in texts(zf.read("docProps/core.xml"), "}title"):
+            title = t
+            break
+    parts: list = []
+    ctype = None
+    if "word/document.xml" in names:
+        ctype = ("application/vnd.openxmlformats-officedocument."
+                 "wordprocessingml.document")
+        parts += texts(zf.read("word/document.xml"), "}t")
+    elif any(n.startswith("ppt/slides/slide") for n in names):
+        ctype = ("application/vnd.openxmlformats-officedocument."
+                 "presentationml.presentation")
+        for n in sorted(names):
+            if n.startswith("ppt/slides/slide") and n.endswith(".xml"):
+                parts += texts(zf.read(n), "}t")
+    elif any(n.startswith("xl/") for n in names):
+        ctype = ("application/vnd.openxmlformats-officedocument."
+                 "spreadsheetml.sheet")
+        if "xl/sharedStrings.xml" in names:
+            parts += texts(zf.read("xl/sharedStrings.xml"), "}t")
+    if ctype is None:
+        return None, title, None
+    text = re.sub(r"\s+", " ", " ".join(parts)).strip()
+    return (text or None), title, ctype
+
+
 def detect_and_extract(raw: bytes) -> Tuple[str, Optional[str],
                                             Optional[str]]:
     """(content_type, extracted text | None, title | None)."""
     head = raw[:512]
     if head.startswith(b"%PDF"):
-        return "application/pdf", None, None
+        return "application/pdf", _pdf_text(raw), None
     if head.startswith(b"PK\x03\x04"):
-        return ("application/vnd.openxmlformats-officedocument",
-                None, None)
+        text, title, ctype = _ooxml_text(raw)
+        return (ctype or "application/vnd.openxmlformats-officedocument",
+                text, title)
     if head.startswith(b"\xd0\xcf\x11\xe0"):
         return "application/msword", None, None
     text = _decode_text(raw)

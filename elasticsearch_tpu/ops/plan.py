@@ -109,10 +109,18 @@ def plan_topk_body(streams: Tuple[FieldStream, ...],
                    bonus: jax.Array, tie: jax.Array,
                    after_score: jax.Array,   # float32; _score search_after
                    k1: float, b: float, k: int, combine: str,
-                   with_dense: bool, with_after: bool = False):
+                   with_dense: bool, with_after: bool = False,
+                   script_fn=None):
     """The kernel body, un-jitted: also called from inside shard_map
     (parallel/mesh_executor.py) where the surrounding SPMD program owns
-    the jit."""
+    the jit.
+
+    ``script_fn(score, docids) -> score`` is the script_score transform
+    (a stable per-(segment, script) closure over device columns —
+    search/plan.py binds it): applied to the combined per-doc score
+    before top-k, so expression script_score queries ride this batched
+    kernel instead of the per-request dense path (BASELINE config 3
+    through the product path)."""
     parts_d, parts_tf, parts_c, parts_g, parts_s = [], [], [], [], []
     for st in streams:
         d = jnp.take(st.block_docids, st.sel_blocks, axis=0)    # [NB, B]
@@ -183,6 +191,9 @@ def plan_topk_body(streams: Tuple[FieldStream, ...],
     else:
         score = doc_score
     score = score + bonus
+    if script_fn is not None:
+        score = jnp.asarray(
+            script_fn(score, jnp.clip(dkey, 0, nd - 1)), score.dtype)
 
     passed = (is_doc_last & (dkey != _SENTINEL)
               & (doc_must >= n_must.astype(jnp.float32))
@@ -212,7 +223,7 @@ def plan_topk_body(streams: Tuple[FieldStream, ...],
 
 _plan_topk_impl = partial(
     jax.jit, static_argnames=("k", "combine", "k1", "b", "with_dense",
-                              "with_after"))(plan_topk_body)
+                              "with_after", "script_fn"))(plan_topk_body)
 
 
 def pack_result(vals: jax.Array, ids: jax.Array,
@@ -242,16 +253,16 @@ def unpack_result(buf: np.ndarray, k: int):
 def _plan_topk_packed_body(streams, group_kind, group_req, group_const,
                            live, dense_mask, n_must, n_filter, msm,
                            bonus, tie, after_score, k1, b, k, combine,
-                           with_dense, with_after=False):
+                           with_dense, with_after=False, script_fn=None):
     return pack_result(*plan_topk_body(
         streams, group_kind, group_req, group_const, live, dense_mask,
         n_must, n_filter, msm, bonus, tie, after_score, k1, b, k,
-        combine, with_dense, with_after))
+        combine, with_dense, with_after, script_fn))
 
 
 _plan_topk_packed_impl = partial(
     jax.jit, static_argnames=("k", "combine", "k1", "b", "with_dense",
-                              "with_after"))(_plan_topk_packed_body)
+                              "with_after", "script_fn"))(_plan_topk_packed_body)
 
 
 def plan_topk(streams, group_kind, group_req, group_const, live,
@@ -261,7 +272,7 @@ def plan_topk(streams, group_kind, group_req, group_const, live,
               k1: float = 1.2, b: float = 0.75, k: int = 10,
               combine: str = "sum",
               after_score: Optional[float] = None,
-              packed: bool = False):
+              packed: bool = False, script_fn=None):
     """Single-query entry. ``dense_mask=None`` skips the gather entirely
     (the common pure-postings case compiles without it). ``packed=True``
     returns ONE [2k+1] device buffer (see pack_result) for single-readback
@@ -278,14 +289,16 @@ def plan_topk(streams, group_kind, group_req, group_const, live,
         np.int32(n_must), np.int32(n_filter), np.int32(msm),
         np.float32(bonus), np.float32(tie),
         np.float32(after_score if with_after else 0.0),
-        float(k1), float(b), int(k), combine, with_dense, with_after)
+        float(k1), float(b), int(k), combine, with_dense, with_after,
+        script_fn)
 
 
 @partial(jax.jit, static_argnames=("k", "combine", "k1", "b",
-                                   "with_dense"))
+                                   "with_dense", "script_fn"))
 def _plan_topk_batch_impl(streams, group_kind, group_req, group_const,
                           live, dense_mask, n_must, n_filter, msm,
-                          bonus, tie, k1, b, k, combine, with_dense):
+                          bonus, tie, k1, b, k, combine, with_dense,
+                          script_fn=None):
     """vmap over the query axis of the selection/group arrays; corpus
     arrays are shared (in_axes=None), and so is the optional dense
     filter mask — cohorts are keyed by filter identity (the cached
@@ -303,7 +316,7 @@ def _plan_topk_batch_impl(streams, group_kind, group_req, group_const,
         return pack_result(*plan_topk_body(
             sts, gk, gr, gcst, live, dense_mask,
             nm, nf, ms, bo, ti, jnp.float32(0.0),
-            k1, b, k, combine, with_dense))
+            k1, b, k, combine, with_dense, script_fn=script_fn))
 
     sel_b = tuple(st.sel_blocks for st in streams)   # each [Q, NB]
     sel_g = tuple(st.sel_group for st in streams)
@@ -318,7 +331,8 @@ def _plan_topk_batch_impl(streams, group_kind, group_req, group_const,
 def plan_topk_batch(streams, group_kind, group_req, group_const, live,
                     n_must, n_filter, msm, bonus, tie,
                     k1: float = 1.2, b: float = 0.75, k: int = 10,
-                    combine: str = "sum", dense_mask=None):
+                    combine: str = "sum", dense_mask=None,
+                    script_fn=None):
     """Batched entry: every per-query array has a leading [Q] axis; the
     corpus arrays inside ``streams`` stay unbatched (shared), as is the
     optional [ND] ``dense_mask`` (one filter column for the whole
@@ -335,7 +349,7 @@ def plan_topk_batch(streams, group_kind, group_req, group_const, live,
         np.asarray(n_must, np.int32), np.asarray(n_filter, np.int32),
         np.asarray(msm, np.int32), np.asarray(bonus, np.float32),
         np.asarray(tie, np.float32),
-        float(k1), float(b), int(k), combine, with_dense)
+        float(k1), float(b), int(k), combine, with_dense, script_fn)
 
 
 # ---------------------------------------------------------------------------

@@ -102,6 +102,7 @@ class PlanBatcher:
                   for st in bp.streams),
             int(bp.group_kind.shape[0]), bp.combine, k,
             id(bp.dense_mask) if bp.dense_mask is not None else None,
+            id(bp.script_fn) if bp.script_fn is not None else None,
             round(k1, 6), round(b, 6),
         )
 
@@ -191,8 +192,9 @@ class PlanBatcher:
         packed = plan_ops.plan_topk_batch(
             streams, gk, gr, gc, ctx.live, nm, nf, ms, bo, ti,
             k1=k1, b=b, k=k, combine=proto.combine,
-            # cohort-shared filter column (signature keys on identity)
-            dense_mask=proto.dense_mask)
+            # cohort-shared filter column + script (signature keys on
+            # their identities)
+            dense_mask=proto.dense_mask, script_fn=proto.script_fn)
         # ONE readback for the whole batch (rows are packed buffers)
         rows = np.asarray(packed)
         dt = time.monotonic() - t0

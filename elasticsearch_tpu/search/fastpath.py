@@ -865,7 +865,8 @@ class FastPathServer:
             for tok, k, term_ids, filt, _essd in refire:
                 if len(reg["ess_bad"]) < 100_000:
                     reg["ess_bad"].add((tuple(term_ids), filt, k))
-            self._refire_full(reg, refire, t_arrive)
+            self._refire_full(reg, refire, t_arrive, stack,
+                              rows)
             for tok, *_ in refire:
                 responded.add(tok)
 

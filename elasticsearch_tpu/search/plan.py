@@ -99,6 +99,13 @@ class LogicalPlan:
                                           # constant clauses every hit gets
     combine: str = "sum"
     tie: float = 0.0
+    # expression-tier script_score transform: (source, sorted-params
+    # tuple). Applied to the combined per-doc score inside the kernel —
+    # BASELINE config 3 rides the batched plan path (ref:
+    # ScriptScoreQuery.java:51,91-109; the reference scores per doc
+    # through a Lucene ScoreScript, here the expression compiles to one
+    # fused columnar transform)
+    script: Optional[Tuple[str, tuple]] = None
 
     def postings_required(self) -> bool:
         """True iff every passing doc must match ≥1 postings group — the
@@ -231,14 +238,55 @@ def _group_for_clause(node, searcher, kind: int,
 # top-level compilation
 # ---------------------------------------------------------------------------
 
+def _plan_script_spec(node: "q.ScriptScoreQuery",
+                      searcher) -> Optional[Tuple[str, tuple]]:
+    """(source, params) when the script can ride the kernel: the
+    EXPRESSION tier only (statement scripts interpret per doc on host),
+    scalar params, no min_score, and a dry trace over dummy columns
+    succeeds (catches vector functions / unsupported constructs)."""
+    from elasticsearch_tpu.search.script import (ScriptContext,
+                                                 ScriptException,
+                                                 _DocColumn,
+                                                 compile_script)
+    if node.min_score is not None:
+        return None
+    if not all(isinstance(v, (int, float, str, bool))
+               for v in node.params.values()):
+        return None
+    try:
+        compiled = compile_script(node.source)
+    except ScriptException:
+        return None
+    if not getattr(compiled, "vectorized", False):
+        return None
+
+    def dummy_cols(field):
+        return _DocColumn(jnp.zeros(2, jnp.float32),
+                          jnp.zeros(2, bool))
+    try:
+        out = compiled(ScriptContext(dummy_cols, dict(node.params),
+                                     score=jnp.zeros(2, jnp.float32)))
+        jnp.asarray(out, jnp.float32)
+    except Exception:       # noqa: BLE001 — anything odd → dense path
+        return None
+    return (node.source, tuple(sorted(node.params.items())))
+
+
 def compile_plan(query, searcher,
                  post_filter=None) -> Optional[LogicalPlan]:
     """Compile a rewritten query (+ optional post_filter folded in as a
     filter — valid when no aggregations run) into a LogicalPlan, or None
     when the tree needs the dense executor."""
+    script_spec = None
+    if isinstance(query, q.ScriptScoreQuery):
+        script_spec = _plan_script_spec(query, searcher)
+        if script_spec is None:
+            return None
+        query = query.query
     plan = _compile_tree(query, searcher)
     if plan is None:
         return None
+    plan.script = script_spec
     if post_filter is not None:
         g = _group_for_clause(post_filter, searcher, plan_ops.FILTER, 1.0)
         if g is not None:
@@ -405,6 +453,10 @@ class BoundPlan:
     # dense_mask is a CACHED shared object (composed filter column):
     # batch cohorts may key on its identity and pass it unbatched
     dense_shared: bool = False
+    # stable per-(segment, script) closure applied to the per-doc score
+    # inside the kernel (ops/plan.plan_topk_body script_fn); identity is
+    # the batch-cohort key, so it must come from _bind_script's cache
+    script_fn: Optional[Any] = None
 
 
 def _group_field_blocks(g: GroupPlan, ctx) -> Optional[Tuple[str, int]]:
@@ -594,7 +646,9 @@ def bind_plan(plan: LogicalPlan, ctx, k: int = 10,
                      plan.n_must, n_filter, plan.msm, plan.bonus,
                      plan.tie, plan.combine, empty=not any_entries,
                      host_masks=host_masks, pruned=pruned,
-                     dense_shared=dense_shared)
+                     dense_shared=dense_shared,
+                     script_fn=(_bind_script(ctx, plan.script)
+                                if plan.script is not None else None))
 
 
 # ---------------------------------------------------------------------------
@@ -833,6 +887,40 @@ def _prune_fields(plan: LogicalPlan, kernel_groups: List[GroupPlan],
     return out, pruned
 
 
+def _bind_script(ctx, script_spec):
+    """Per-(DeviceSegment, script) closure over the segment's device
+    numeric columns — CACHED on the DeviceSegment so its identity is
+    stable (the kernel jits on it as a static argument, and batch
+    cohorts key on it)."""
+    from elasticsearch_tpu.search.script import (ScriptContext,
+                                                 ScriptException,
+                                                 _DocColumn,
+                                                 compile_script)
+    dev = ctx.device
+    cache = getattr(dev, "_plan_scripts", None)
+    if cache is None:
+        cache = dev._plan_scripts = {}
+    fn = cache.get(script_spec)
+    if fn is None:
+        compiled = compile_script(script_spec[0])
+        params = dict(script_spec[1])
+        numerics = dev.numerics
+        missing = dev.numeric_missing
+
+        def fn(score, ids):
+            def doc_columns(field):
+                col = numerics.get(field)
+                if col is None:
+                    raise ScriptException(
+                        f"unknown numeric field [{field}]")
+                return _DocColumn(jnp.take(col, ids),
+                                  jnp.take(missing[field], ids))
+            sctx = ScriptContext(doc_columns, params, score=score)
+            return jnp.asarray(compiled(sctx), jnp.float32)
+        cache[script_spec] = fn
+    return fn
+
+
 def execute_bound(bp: BoundPlan, ctx, k: int, k1: float, b: float,
                   after_score: Optional[float] = None):
     """Launch the fused kernel for one segment → host (vals[k], ids[k],
@@ -846,5 +934,5 @@ def execute_bound(bp: BoundPlan, ctx, k: int, k1: float, b: float,
         bp.streams, bp.group_kind, bp.group_req, bp.group_const,
         ctx.live, bp.dense_mask, bp.n_must, bp.n_filter, bp.msm,
         bonus=bp.bonus, tie=bp.tie, k1=k1, b=b, k=k, combine=bp.combine,
-        after_score=after_score, packed=True)
+        after_score=after_score, packed=True, script_fn=bp.script_fn)
     return plan_ops.unpack_result(np.asarray(packed), k)

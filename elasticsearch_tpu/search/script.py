@@ -185,6 +185,7 @@ def compile_script(source: str):
         except Exception as e:
             raise ScriptException(f"runtime error: {e} in script [{source}]")
 
+    run.vectorized = True      # expression tier: one fused computation
     return run
 
 
@@ -298,6 +299,7 @@ def _compile_painless_score(source: str):
             out[i] = float(v) if v is not None else 0.0
         return jnp.asarray(out)
 
+    run.vectorized = False     # per-doc interpreter tier
     return run
 
 

@@ -518,38 +518,125 @@ class ShardSearcher:
 
     def _highlight(self, seg: Segment, docid: int, spec: Dict[str, Any],
                    query: Optional[QueryBuilder]) -> Dict[str, List[str]]:
-        """Plain-highlighter analogue (ref: search/fetch/subphase/highlight/
-        PlainHighlighter): re-analyzes the stored text and wraps query terms."""
-        pre = spec.get("pre_tags", ["<em>"])[0]
-        post = spec.get("post_tags", ["</em>"])[0]
+        """Unified-highlighter analogue (ref: search/fetch/subphase/
+        highlight/UnifiedHighlighter.java — passage-based fragmenting
+        with score-ordered snippets; ``type: plain`` keeps the whole-
+        field PlainHighlighter behavior). Per-field options follow the
+        reference: ``fragment_size`` (default 100), ``number_of_
+        fragments`` (default 5; 0 = no fragmenting, highlight the whole
+        value), ``no_match_size``, ``order`` ("score" default /
+        "none"), ``pre_tags``/``post_tags``. Passages snap to sentence
+        boundaries and are scored by (distinct matched terms, total
+        matches, earliest) — a disclosed simplification of Lucene's
+        BM25 PassageScorer that preserves its ordering behavior on
+        multi-term queries."""
         query_terms = _collect_terms(query, self.mapper) if query else {}
         source = json.loads(seg.stored.source(docid))
         out: Dict[str, List[str]] = {}
-        for fname in spec.get("fields", {}):
+        for fname, fspec in (spec.get("fields", {}) or {}).items():
+            fspec = fspec or {}
+
+            def opt(name, default):
+                return fspec.get(name, spec.get(name, default))
+            pre = opt("pre_tags", ["<em>"])[0]
+            post = opt("post_tags", ["</em>"])[0]
+            frag_size = int(opt("fragment_size", 100))
+            n_frags = int(opt("number_of_fragments", 5))
+            no_match = int(opt("no_match_size", 0))
+            order = str(opt("order", "score"))
             value = _get_path(source, fname)
             if not isinstance(value, str):
                 continue
             terms = query_terms.get(fname, set())
-            if not terms:
-                continue
             ft = self.mapper.field_type(fname)
             analyzer_name = getattr(ft, "analyzer_name", "standard")
             analyzer = (self.mapper.analysis.get(analyzer_name)
                         if self.mapper.analysis.has(analyzer_name)
                         else self.mapper.analysis.default)
-            spans = [(t.start_offset, t.end_offset)
-                     for t in analyzer.analyze(value) if t.term in terms]
+            spans = [(t.start_offset, t.end_offset, t.term)
+                     for t in analyzer.analyze(value)
+                     if t.term in terms] if terms else []
             if not spans:
+                if no_match > 0 and value:
+                    out[fname] = [value[:_snap_end(value, no_match)]]
                 continue
-            frag = []
-            last = 0
-            for s, e in spans:
-                frag.append(value[last:s])
-                frag.append(pre + value[s:e] + post)
-                last = e
-            frag.append(value[last:])
-            out[fname] = ["".join(frag)]
+            if n_frags == 0 or opt("type", "unified") == "plain":
+                out[fname] = [_wrap_spans(
+                    value, [(s, e) for s, e, _t in spans], pre, post)]
+                continue
+            passages = _build_passages(value, frag_size)
+            scored = []
+            for pi, (ps, pe) in enumerate(passages):
+                inside = [sp for sp in spans
+                          if sp[0] >= ps and sp[1] <= pe]
+                if not inside:
+                    continue
+                distinct = len({t for _s, _e, t in inside})
+                scored.append(((distinct, len(inside), -ps), pi,
+                               inside))
+            scored.sort(key=lambda r: r[0], reverse=True)
+            chosen = scored[:n_frags]
+            if order != "score":
+                chosen.sort(key=lambda r: r[1])
+            frags = []
+            for _score, pi, inside in chosen:
+                ps, pe = passages[pi]
+                frags.append(_wrap_spans(
+                    value[ps:pe],
+                    [(s - ps, e - ps) for s, e, _t in inside],
+                    pre, post).strip())
+            if frags:
+                out[fname] = frags
         return out
+
+
+def _wrap_spans(text: str, spans, pre: str, post: str) -> str:
+    """Wrap (start, end) character spans of ``text`` in pre/post tags."""
+    parts = []
+    last = 0
+    for s, e in sorted(spans):
+        if s < last:           # overlapping analyzer spans: keep first
+            continue
+        parts.append(text[last:s])
+        parts.append(pre + text[s:e] + post)
+        last = e
+    parts.append(text[last:])
+    return "".join(parts)
+
+
+_SENTENCE_ENDS = ".!?\n"
+
+
+def _snap_end(text: str, at: int) -> int:
+    """End offset near ``at`` snapped FORWARD to a sentence/word break
+    (the BreakIterator discipline: fragments end on natural boundaries,
+    ref UnifiedHighlighter's SENTENCE BreakIterator)."""
+    n = len(text)
+    if at >= n:
+        return n
+    for i in range(at, min(n, at + 40)):
+        if text[i] in _SENTENCE_ENDS:
+            return i + 1
+    for i in range(at, min(n, at + 20)):
+        if text[i].isspace():
+            return i
+    return at
+
+
+def _build_passages(text: str, frag_size: int):
+    """Sentence-snapped passages of ~frag_size chars covering the text."""
+    passages = []
+    start = 0
+    n = len(text)
+    while start < n:
+        end = _snap_end(text, start + max(frag_size, 1))
+        if end <= start:
+            end = min(n, start + max(frag_size, 1))
+        passages.append((start, end))
+        start = end
+        while start < n and text[start].isspace():
+            start += 1
+    return passages
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,111 @@
+"""Unified-highlighter analogue (ref: UnifiedHighlighter.java —
+passage fragmenting, score ordering, no_match_size; HighlighterSearchIT
+is the behavioral model)."""
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+PARA = (
+    "The quick brown fox jumps over the lazy dog. "
+    "Weather today is mild and calm with little wind. "
+    "A second fox appeared near the river bank at dawn. "
+    "Nothing else of note happened during the long morning hours. "
+    "Later the fox and the wolf crossed the old wooden bridge together. "
+    "The afternoon passed quietly in the small village square. "
+    "Finally the wolf returned alone under a pale evening sky."
+)
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = Node(settings=Settings.EMPTY,
+             data_path=str(tmp_path_factory.mktemp("hl")))
+    st, _ = n.rest_controller.dispatch(
+        "PUT", "/hl", None,
+        {"mappings": {"properties": {"body": {"type": "text"}}}})
+    assert st == 200
+    n.rest_controller.dispatch("PUT", "/hl/_doc/1", None, {"body": PARA})
+    n.rest_controller.dispatch(
+        "PUT", "/hl/_doc/2", None, {"body": "no matching words here"})
+    n.rest_controller.dispatch("POST", "/hl/_refresh", None, None)
+    yield n
+    n.close()
+
+
+def search(node, body):
+    st, out = node.rest_controller.dispatch("POST", "/hl/_search", None,
+                                            body)
+    assert st == 200, out
+    return out
+
+
+def test_fragments_are_sized_and_scored(node):
+    out = search(node, {
+        "query": {"match": {"body": "fox wolf"}},
+        "highlight": {"fields": {"body": {
+            "fragment_size": 80, "number_of_fragments": 3}}}})
+    hit = next(h for h in out["hits"]["hits"] if h["_id"] == "1")
+    frags = hit["highlight"]["body"]
+    assert 1 <= len(frags) <= 3
+    # fragments are passages, not the whole field
+    assert all(len(f) < len(PARA) for f in frags)
+    assert all(len(f) <= 80 + 60 for f in frags)   # sentence-snap slack
+    # score order: the best passage (both fox AND wolf) comes first
+    assert "<em>fox</em>" in frags[0] and "<em>wolf</em>" in frags[0]
+
+
+def test_number_of_fragments_zero_highlights_whole_field(node):
+    out = search(node, {
+        "query": {"match": {"body": "fox"}},
+        "highlight": {"fields": {"body": {"number_of_fragments": 0}}}})
+    hit = next(h for h in out["hits"]["hits"] if h["_id"] == "1")
+    frags = hit["highlight"]["body"]
+    assert len(frags) == 1
+    assert frags[0].count("<em>fox</em>") == 3
+    # the whole value is present (plus tags)
+    assert frags[0].replace("<em>", "").replace("</em>", "") == PARA
+
+
+def test_no_match_size(node):
+    out = search(node, {
+        "query": {"match_all": {}},
+        "highlight": {"fields": {"body": {"no_match_size": 60}}}})
+    hit = next(h for h in out["hits"]["hits"] if h["_id"] == "2")
+    frags = hit["highlight"]["body"]
+    assert len(frags) == 1 and "<em>" not in frags[0]
+    assert 0 < len(frags[0]) <= 120
+    # doc without no_match text still excluded when no terms match
+    out2 = search(node, {
+        "query": {"match": {"body": "fox"}},
+        "highlight": {"fields": {"body": {}}}})
+    h2 = next(h for h in out2["hits"]["hits"] if h["_id"] == "1")
+    assert "body" in h2["highlight"]
+
+
+def test_custom_tags_and_source_order(node):
+    out = search(node, {
+        "query": {"match": {"body": "wolf"}},
+        "highlight": {"pre_tags": ["[["], "post_tags": ["]]"],
+                      "fields": {"body": {
+                          "fragment_size": 60,
+                          "number_of_fragments": 5,
+                          "order": "none"}}}})
+    hit = next(h for h in out["hits"]["hits"] if h["_id"] == "1")
+    frags = hit["highlight"]["body"]
+    assert any("[[wolf]]" in f for f in frags)
+    # order=none: fragments appear in source order
+    pos = [PARA.find(f.replace("[[", "").replace("]]", "")[:25])
+           for f in frags]
+    assert pos == sorted(pos)
+
+
+def test_plain_type_keeps_whole_field(node):
+    out = search(node, {
+        "query": {"match": {"body": "fox"}},
+        "highlight": {"fields": {"body": {"type": "plain"}}}})
+    hit = next(h for h in out["hits"]["hits"] if h["_id"] == "1")
+    frags = hit["highlight"]["body"]
+    assert len(frags) == 1
+    assert frags[0].replace("<em>", "").replace("</em>", "") == PARA

@@ -315,3 +315,52 @@ def test_randomized_plan_vs_dense(searcher):
         # full-window: truncated top-k may cut exact const-score ties in a
         # different (both-valid) order at the k boundary
         assert_agree(searcher, body, require_plan=False)
+
+
+def test_script_score_rides_the_plan_path(searcher):
+    """Expression-tier script_score compiles into the kernel (BASELINE
+    config 3 on the batched path) and agrees with the dense executor."""
+    body = {"script_score": {
+        "query": {"match": {"title": "alpha beta"}},
+        "script": {"source": "doc['views'].value * 0.5 + _score"}}}
+    q2 = parse_query(body).rewrite(searcher)
+    plan = compile_plan(q2, searcher)
+    assert plan is not None and plan.script is not None
+    assert_agree(searcher, body)
+
+
+def test_script_score_with_params_and_functions(searcher):
+    body = {"script_score": {
+        "query": {"bool": {"must": [{"match": {"title": "wolf"}}],
+                           "filter": [{"term": {"tag": "red"}}]}},
+        "script": {
+            "source": "saturation(doc['views'].value, params.pivot) "
+                      "+ Math.log(1 + _score)",
+            "params": {"pivot": 10}}}}
+    q2 = parse_query(body).rewrite(searcher)
+    assert compile_plan(q2, searcher) is not None
+    assert_agree(searcher, body)
+
+
+def test_statement_script_score_falls_back_dense(searcher):
+    """Loop/statement scripts interpret per doc — NOT plannable."""
+    body = {"script_score": {
+        "query": {"match": {"title": "alpha"}},
+        "script": {"source": """
+            double s = 0;
+            for (int i = 0; i < 2; i++) { s += doc['views'].value; }
+            return s + _score;
+        """}}}
+    q2 = parse_query(body).rewrite(searcher)
+    assert compile_plan(q2, searcher) is None
+    assert_agree(searcher, body, require_plan=False)
+
+
+def test_script_score_min_score_falls_back(searcher):
+    body = {"script_score": {
+        "query": {"match": {"title": "alpha"}},
+        "script": {"source": "_score * 2"},
+        "min_score": 1.5}}
+    q2 = parse_query(body).rewrite(searcher)
+    assert compile_plan(q2, searcher) is None
+    assert_agree(searcher, body, require_plan=False)
