@@ -1,0 +1,430 @@
+"""DB-API 2.0 driver over the SQL endpoint — the JDBC driver analogue.
+
+The reference ships a JDBC driver (x-pack/plugin/sql/jdbc — EsDriver,
+JdbcConnection, JdbcStatement, JdbcResultSet) that speaks HTTP to
+``/_sql?mode=jdbc`` with binary (CBOR) request/response bodies
+(``binary_format``, ref: JdbcHttpClient.java:58-73 building
+SqlQueryRequest with Mode.JDBC and conCfg.binaryCommunication()), typed
+``?`` parameters (SqlTypedParamValue), cursor paging (DefaultCursor)
+and a server version check at connect (JdbcHttpClient.checkServerVersion).
+
+Python's standard database interface is PEP 249, so this driver exposes
+``connect() → Connection → cursor() → execute/fetch*`` instead of
+java.sql — same protocol on the wire, idiomatic surface on top. URLs
+use the reference's scheme: ``jdbc:es://[user:pass@]host:port/?opt=val``
+(ref: jdbc/JdbcConfiguration.java URL_PREFIX).
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime as _dt
+import json
+import ssl as _ssl
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from elasticsearch_tpu.common import cbor
+
+apilevel = "2.0"
+threadsafety = 1          # threads may share the module, not connections
+paramstyle = "qmark"      # SQL uses ? placeholders, like JDBC
+
+DEFAULT_PAGE_SIZE = 1000
+
+
+class Error(Exception):
+    pass
+
+
+class InterfaceError(Error):
+    pass
+
+
+class DatabaseError(Error):
+    pass
+
+
+class ProgrammingError(DatabaseError):
+    pass
+
+
+class OperationalError(DatabaseError):
+    pass
+
+
+class NotSupportedError(DatabaseError):
+    pass
+
+
+# DB-API type objects (mirroring jdbc/EsType.java's java.sql.Types map)
+class _TypeCode:
+    def __init__(self, name: str, es_types: Sequence[str]):
+        self.name = name
+        self._es = frozenset(es_types)
+
+    def __eq__(self, other):
+        if isinstance(other, _TypeCode):
+            return self.name == other.name
+        return other in self._es
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __repr__(self):
+        return f"<type {self.name}>"
+
+
+STRING = _TypeCode("STRING", ("keyword", "text", "constant_keyword", "ip",
+                              "wildcard"))
+NUMBER = _TypeCode("NUMBER", ("byte", "short", "integer", "long", "double",
+                              "float", "half_float", "scaled_float",
+                              "unsigned_long"))
+DATETIME = _TypeCode("DATETIME", ("date", "datetime", "time"))
+BINARY = _TypeCode("BINARY", ("binary",))
+BOOLEAN = _TypeCode("BOOLEAN", ("boolean",))
+ROWID = _TypeCode("ROWID", ())
+
+_TYPE_CODES = (STRING, NUMBER, DATETIME, BINARY, BOOLEAN)
+
+
+def _type_code(es_type: str) -> _TypeCode:
+    for tc in _TYPE_CODES:
+        if es_type == tc:
+            return tc
+    return STRING
+
+
+def Date(year, month, day):
+    return _dt.date(year, month, day)
+
+
+def Time(hour, minute, second):
+    return _dt.time(hour, minute, second)
+
+
+def Timestamp(year, month, day, hour, minute, second):
+    return _dt.datetime(year, month, day, hour, minute, second)
+
+
+def DateFromTicks(ticks):
+    return _dt.date.fromtimestamp(ticks)
+
+
+def TimeFromTicks(ticks):
+    return _dt.datetime.fromtimestamp(ticks).time()
+
+
+def TimestampFromTicks(ticks):
+    return _dt.datetime.fromtimestamp(ticks)
+
+
+def Binary(data):
+    return bytes(data)
+
+
+def _param_value(v: Any) -> Dict[str, Any]:
+    """Python value → SqlTypedParamValue dict
+    (ref: sql-proto/SqlTypedParamValue.java — {"type":..,"value":..})."""
+    if v is None:
+        return {"type": "null", "value": None}
+    if isinstance(v, bool):
+        return {"type": "boolean", "value": v}
+    if isinstance(v, int):
+        return {"type": "integer" if -2**31 <= v < 2**31 else "long",
+                "value": v}
+    if isinstance(v, float):
+        return {"type": "double", "value": v}
+    if isinstance(v, _dt.datetime):
+        return {"type": "datetime",
+                "value": v.isoformat(timespec="milliseconds")}
+    if isinstance(v, _dt.date):
+        return {"type": "datetime", "value": v.isoformat()}
+    if isinstance(v, (bytes, bytearray)):
+        return {"type": "keyword",
+                "value": base64.b64encode(bytes(v)).decode()}
+    return {"type": "keyword", "value": str(v)}
+
+
+def _convert(value: Any, es_type: str) -> Any:
+    """Wire value → Python value (ref: jdbc/TypeConverter.java)."""
+    if value is None:
+        return None
+    if es_type in ("date", "datetime"):
+        if isinstance(value, (int, float)):
+            return _dt.datetime.fromtimestamp(value / 1000.0,
+                                              _dt.timezone.utc)
+        try:
+            return _dt.datetime.fromisoformat(str(value).replace("Z",
+                                                                 "+00:00"))
+        except ValueError:
+            return value
+    if es_type == "binary" and isinstance(value, str):
+        try:
+            return base64.b64decode(value)
+        except Exception:
+            return value
+    return value
+
+
+class Connection:
+    """One HTTP session against a node's SQL endpoint
+    (ref: jdbc/JdbcConnection.java)."""
+
+    def __init__(self, url: str = "", host: str = "localhost",
+                 port: int = 9200, user: Optional[str] = None,
+                 password: Optional[str] = None, secure: bool = False,
+                 page_size: int = DEFAULT_PAGE_SIZE, timeout: float = 90.0,
+                 binary: bool = True, verify_certs: bool = True,
+                 check_server: bool = True):
+        if url:
+            host, port, user2, pw2, opts = _parse_url(url)
+            user = user if user is not None else user2
+            password = password if password is not None else pw2
+            secure = opts.get("ssl", "false").lower() == "true" or secure
+            if "page.size" in opts:
+                page_size = int(opts["page.size"])
+            if "binary" in opts:
+                binary = opts["binary"].lower() != "false"
+            if "user" in opts and user is None:
+                user = opts["user"]
+            if "password" in opts and password is None:
+                password = opts["password"]
+        self._base = f"{'https' if secure else 'http'}://{host}:{port}"
+        self._auth = None
+        if user is not None:
+            cred = f"{user}:{password or ''}".encode()
+            self._auth = "Basic " + base64.b64encode(cred).decode()
+        self.page_size = page_size
+        self.timeout = timeout
+        self.binary = binary
+        self._ctx = None
+        if secure and not verify_certs:
+            self._ctx = _ssl.create_default_context()
+            self._ctx.check_hostname = False
+            self._ctx.verify_mode = _ssl.CERT_NONE
+        self._closed = False
+        self.server_info: Dict[str, Any] = {}
+        if check_server:
+            # ref: JdbcHttpClient.fetchServerInfo/checkServerVersion —
+            # GET / and require a compatible version
+            info = self._request("GET", "/", None)
+            self.server_info = info
+            version = (info.get("version") or {}).get("number")
+            try:
+                int(str(version).split(".", 1)[0])
+            except (TypeError, ValueError):
+                raise InterfaceError(
+                    f"incompatible server version [{version}]") from None
+
+    # -- plumbing ---------------------------------------------------------
+    def _request(self, method: str, path: str,
+                 body: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        if self._closed:
+            raise InterfaceError("connection is closed")
+        data = None
+        headers = {"Accept": ("application/cbor" if self.binary
+                              else "application/json")}
+        if body is not None:
+            if self.binary:
+                data = cbor.dumps(body)
+                headers["Content-Type"] = "application/cbor"
+            else:
+                data = json.dumps(body).encode()
+                headers["Content-Type"] = "application/json"
+        if self._auth:
+            headers["Authorization"] = self._auth
+        req = urllib.request.Request(self._base + path, data=data,
+                                     headers=headers, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout,
+                                        context=self._ctx) as resp:
+                raw = resp.read()
+                ctype = resp.headers.get("Content-Type", "")
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            try:
+                payload = (cbor.loads(raw) if "cbor" in
+                           (e.headers.get("Content-Type") or "")
+                           else json.loads(raw))
+                reason = (payload.get("error") or {}).get("reason", str(e))
+            except Exception:
+                reason = str(e)
+            if e.code >= 500:
+                raise OperationalError(reason) from None
+            raise ProgrammingError(reason) from None
+        except (urllib.error.URLError, OSError) as e:
+            raise OperationalError(str(e)) from None
+        if "cbor" in ctype:
+            return cbor.loads(raw)
+        return json.loads(raw)
+
+    # -- DB-API surface ---------------------------------------------------
+    def cursor(self) -> "Cursor":
+        return Cursor(self)
+
+    def commit(self) -> None:
+        pass  # search is read-only; JDBC connections are auto-commit
+
+    def rollback(self) -> None:
+        raise NotSupportedError("transactions are not supported")
+
+    def close(self) -> None:
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def ping(self) -> bool:
+        try:
+            self._request("GET", "/", None)
+            return True
+        except Error:
+            return False
+
+
+class Cursor:
+    """ref: jdbc/JdbcStatement.java + JdbcResultSet.java — execute,
+    typed description, fetch with transparent cursor paging."""
+
+    arraysize = 1
+
+    def __init__(self, conn: Connection):
+        self._conn = conn
+        self.description: Optional[List[Tuple]] = None
+        self.rowcount = -1
+        self._rows: List[List[Any]] = []
+        self._pos = 0
+        self._cursor_id: Optional[str] = None
+        self._columns: List[Dict[str, Any]] = []
+        self._closed = False
+
+    # -- execution --------------------------------------------------------
+    def execute(self, operation: str,
+                parameters: Optional[Sequence[Any]] = None) -> "Cursor":
+        if self._closed:
+            raise InterfaceError("cursor is closed")
+        self._finish_open_cursor()
+        body: Dict[str, Any] = {
+            "query": operation,
+            "fetch_size": self._conn.page_size,
+            "mode": "jdbc",
+            "binary_format": self._conn.binary,
+        }
+        if parameters:
+            body["params"] = [_param_value(p) for p in parameters]
+        result = self._conn._request("POST", "/_sql?mode=jdbc", body)
+        self._columns = result.get("columns") or []
+        self.description = [
+            (c.get("name"), _type_code(c.get("type", "keyword")),
+             c.get("display_size"), None, None, None, None)
+            for c in self._columns]
+        self._rows = [self._convert_row(r) for r in result.get("rows", [])]
+        self._pos = 0
+        self._cursor_id = result.get("cursor")
+        self.rowcount = -1 if self._cursor_id else len(self._rows)
+        return self
+
+    def executemany(self, operation: str,
+                    seq_of_parameters: Sequence[Sequence[Any]]) -> "Cursor":
+        for parameters in seq_of_parameters:
+            self.execute(operation, parameters)
+        return self
+
+    def _convert_row(self, row: List[Any]) -> List[Any]:
+        return [_convert(v, c.get("type", "keyword"))
+                for v, c in zip(row, self._columns)]
+
+    def _next_page(self) -> bool:
+        if not self._cursor_id:
+            return False
+        result = self._conn._request("POST", "/_sql?mode=jdbc", {
+            "cursor": self._cursor_id, "mode": "jdbc",
+            "binary_format": self._conn.binary})
+        self._rows = [self._convert_row(r) for r in result.get("rows", [])]
+        self._pos = 0
+        self._cursor_id = result.get("cursor")
+        return bool(self._rows)
+
+    def _finish_open_cursor(self):
+        if self._cursor_id:
+            try:
+                self._conn._request("POST", "/_sql/close",
+                                    {"cursor": self._cursor_id})
+            except Error:
+                pass
+            self._cursor_id = None
+
+    # -- fetching ---------------------------------------------------------
+    def fetchone(self) -> Optional[List[Any]]:
+        if self.description is None:
+            raise ProgrammingError("no query has been executed")
+        if self._pos >= len(self._rows) and not self._next_page():
+            return None
+        row = self._rows[self._pos]
+        self._pos += 1
+        return row
+
+    def fetchmany(self, size: Optional[int] = None) -> List[List[Any]]:
+        size = size if size is not None else self.arraysize
+        out = []
+        for _ in range(size):
+            row = self.fetchone()
+            if row is None:
+                break
+            out.append(row)
+        return out
+
+    def fetchall(self) -> List[List[Any]]:
+        out = []
+        while True:
+            row = self.fetchone()
+            if row is None:
+                return out
+            out.append(row)
+
+    def __iter__(self):
+        while True:
+            row = self.fetchone()
+            if row is None:
+                return
+            yield row
+
+    # -- misc -------------------------------------------------------------
+    def setinputsizes(self, sizes):
+        pass
+
+    def setoutputsize(self, size, column=None):
+        pass
+
+    def close(self) -> None:
+        self._finish_open_cursor()
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _parse_url(url: str):
+    """``jdbc:es://[user:pass@]host[:port]/?opt=val``
+    (ref: jdbc/JdbcConfiguration.java URL_PREFIX handling)."""
+    for prefix in ("jdbc:es://", "jdbc:elasticsearch://", "es://"):
+        if url.startswith(prefix):
+            url = "http://" + url[len(prefix):]
+            break
+    parts = urllib.parse.urlsplit(url)
+    opts = dict(urllib.parse.parse_qsl(parts.query))
+    return (parts.hostname or "localhost", parts.port or 9200,
+            parts.username, parts.password, opts)
+
+
+def connect(url: str = "", **kwargs) -> Connection:
+    return Connection(url, **kwargs)

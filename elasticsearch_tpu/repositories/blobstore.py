@@ -406,8 +406,10 @@ class RepositoriesService:
 
     def __init__(self, data_path: Optional[str] = None):
         # built-in cloud backends register their repository types on
-        # import (s3/gcs/azure — repositories/cloud.py)
+        # import (s3/gcs/azure — repositories/cloud.py; hdfs —
+        # repositories/hdfs.py)
         from elasticsearch_tpu.repositories import cloud  # noqa: F401
+        from elasticsearch_tpu.repositories import hdfs  # noqa: F401
         self._repos: Dict[str, BlobStoreRepository] = {}
         self._configs: Dict[str, Dict[str, Any]] = {}
         self._data_path = data_path

@@ -2296,6 +2296,10 @@ def sql_query(node, params, body):
     body = dict(body or {})
     if "query" in params and "query" not in body:
         body["query"] = params["query"]
+    # mode rides the URL in the reference REST protocol
+    # (ref: RestSqlQueryAction — '/_sql?mode=jdbc')
+    if "mode" in params and "mode" not in body:
+        body["mode"] = params["mode"]
     with node.task_manager.task_scope(
             "transport", "indices:data/read/sql",
             description="sql", cancellable=True):

@@ -31,6 +31,18 @@ class _Handler(BaseHTTPRequestHandler):
             if "x-ndjson" in content_type or url.path.rstrip("/").endswith(
                     ("_bulk", "_msearch")):
                 body = raw.decode("utf-8")
+            elif "cbor" in content_type:
+                # binary XContent (ref: CborXContent — the JDBC/ODBC
+                # clients' binary_format communication)
+                from elasticsearch_tpu.common import cbor
+                try:
+                    body = cbor.loads(raw)
+                except (ValueError, TypeError) as e:
+                    self._send(400, {"error": {
+                        "type": "parsing_exception",
+                        "reason": f"Failed to parse request body: {e}"},
+                        "status": 400})
+                    return
             else:
                 try:
                     body = json.loads(raw)
@@ -42,9 +54,12 @@ class _Handler(BaseHTTPRequestHandler):
                     return
         status, payload = self.controller.dispatch(
             method, url.path, params, body, headers=dict(self.headers))
-        self._send(status, payload, head_only=(method == "HEAD"))
+        accept = (self.headers.get("Accept") or "").lower()
+        self._send(status, payload, head_only=(method == "HEAD"),
+                   cbor_ok="cbor" in accept)
 
-    def _send(self, status: int, payload, head_only: bool = False):
+    def _send(self, status: int, payload, head_only: bool = False,
+              cbor_ok: bool = False):
         extra_headers = {}
         if isinstance(payload, dict) and "_headers" in payload:
             payload = dict(payload)
@@ -52,6 +67,10 @@ class _Handler(BaseHTTPRequestHandler):
         if isinstance(payload, dict) and "_cat" in payload and len(payload) == 1:
             data = (payload["_cat"] + "\n").encode()
             ctype = "text/plain; charset=UTF-8"
+        elif cbor_ok:
+            from elasticsearch_tpu.common import cbor
+            data = cbor.dumps(payload)
+            ctype = "application/cbor"
         else:
             data = json.dumps(payload).encode()
             ctype = "application/json; charset=UTF-8"

@@ -396,6 +396,88 @@ def _sql_type(es_type: str) -> str:
     return _SQL_TYPES.get(es_type, es_type)
 
 
+# column display sizes reported to JDBC/ODBC clients
+# (ref: x-pack/plugin/sql/.../type/SqlDataTypes.java:549 displaySize)
+_DISPLAY_SIZES = {
+    "null": 0, "boolean": 1, "byte": 5, "short": 6, "integer": 11,
+    "long": 20, "double": 25, "float": 15, "half_float": 25,
+    "scaled_float": 25, "keyword": 32766, "constant_keyword": 32766,
+    "text": 2147483647, "ip": 45, "datetime": 29, "date": 29, "time": 18,
+    "binary": 2147483647, "object": 0, "nested": 0, "geo_point": 58,
+}
+
+
+def display_size(es_type: str) -> int:
+    return _DISPLAY_SIZES.get(es_type, 0)
+
+
+def render_literal(value: Any) -> str:
+    """Render a typed parameter value as a SQL literal
+    (ref: sql-proto SqlTypedParamValue — the JDBC driver sends
+    ``{"type": ..., "value": ...}`` pairs for each ``?``; the declared
+    type travels in the value's json representation, so rendering
+    dispatches on the value itself)."""
+    if value is None:
+        return "NULL"
+    if isinstance(value, bool):
+        return "TRUE" if value else "FALSE"
+    if isinstance(value, float) and (value != value or value in
+                                     (float("inf"), float("-inf"))):
+        raise IllegalArgumentException(
+            f"non-finite parameter value [{value}]")
+    if isinstance(value, (int, float)):
+        return repr(value)
+    return "'" + str(value).replace("'", "''") + "'"
+
+
+def substitute_params(sql: str, params: List[Any]) -> str:
+    """Replace ``?`` placeholders with typed-parameter literals, skipping
+    string literals, quoted identifiers and comments (the driver-side
+    PreparedQuery does the same scan, ref: jdbc/PreparedQuery.java)."""
+    out = []
+    i, n, p = 0, len(sql), 0
+    while i < n:
+        c = sql[i]
+        if c == "'" or c == '"' or c == "`":
+            j = i + 1
+            while j < n:
+                if sql[j] == c:
+                    if c == "'" and sql[j:j + 2] == "''":
+                        j += 2
+                        continue
+                    break
+                j += 1
+            out.append(sql[i:min(j + 1, n)])
+            i = j + 1
+        elif sql[i:i + 2] == "--":
+            j = sql.find("\n", i)
+            j = n if j < 0 else j
+            out.append(sql[i:j])
+            i = j
+        elif sql[i:i + 2] == "/*":
+            j = sql.find("*/", i + 2)
+            j = n if j < 0 else j + 2
+            out.append(sql[i:j])
+            i = j
+        elif c == "?":
+            if p >= len(params):
+                raise IllegalArgumentException(
+                    "Not enough actual parameters; needed more than "
+                    f"{len(params)}")
+            prm = params[p]
+            p += 1
+            out.append(render_literal(prm.get("value")
+                                      if isinstance(prm, dict) else prm))
+            i += 1
+        else:
+            out.append(c)
+            i += 1
+    if p < len(params):
+        raise IllegalArgumentException(
+            f"Too many actual parameters: {len(params)} given, {p} used")
+    return "".join(out)
+
+
 def _infer_type(v: Any) -> str:
     if isinstance(v, bool):
         return "boolean"
@@ -437,19 +519,30 @@ class SqlService:
     def query(self, body: Dict[str, Any]) -> Dict[str, Any]:
         cursor = body.get("cursor")
         fetch_size = int(body.get("fetch_size", DEFAULT_FETCH_SIZE))
+        mode = str(body.get("mode", "plain") or "plain").lower()
         if cursor:
             return self._continue(cursor)
         sql = body.get("query")
         if not sql:
             raise IllegalArgumentException("[query] is required")
+        if body.get("params"):
+            sql = substitute_params(sql, body["params"])
         stmt = Parser(sql).parse()
         if isinstance(stmt, ShowTables):
-            return self._show_tables(stmt)
-        if isinstance(stmt, ShowColumns):
-            return self._show_columns(stmt)
-        if isinstance(stmt, ShowFunctions):
-            return self._show_functions(stmt)
-        return self._run_select(stmt, fetch_size)
+            result = self._show_tables(stmt)
+        elif isinstance(stmt, ShowColumns):
+            result = self._show_columns(stmt)
+        elif isinstance(stmt, ShowFunctions):
+            result = self._show_functions(stmt)
+        else:
+            result = self._run_select(stmt, fetch_size)
+        if mode in ("jdbc", "odbc"):
+            # driver-mode responses carry column display metadata
+            # (ref: TransportSqlQueryAction — Mode.isDriver adds
+            # displaySize to each ColumnInfo)
+            for col in result.get("columns", []):
+                col["display_size"] = display_size(col.get("type", ""))
+        return result
 
     def translate(self, body: Dict[str, Any]) -> Dict[str, Any]:
         sql = body.get("query")

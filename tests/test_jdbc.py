@@ -1,0 +1,207 @@
+"""JDBC-analogue driver: DB-API 2.0 over `/_sql?mode=jdbc` with binary
+(CBOR) communication (ref: x-pack/plugin/sql/jdbc — JdbcHttpClient
+builds Mode.JDBC requests with binaryCommunication; DefaultCursor pages;
+TypeConverter maps wire values)."""
+
+import datetime as dt
+
+import pytest
+
+from elasticsearch_tpu.client import dbapi
+from elasticsearch_tpu.common import cbor
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+# ---------------------------------------------------------------------------
+# CBOR codec
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("value", [
+    None, True, False, 0, 23, 24, 255, 256, 65535, 65536, 2**32, -1, -25,
+    -2**40, 1.5, -0.25, "", "héllo", "a" * 300, b"", b"\x00\xff" * 40,
+    [], [1, [2, "three"], None], {}, {"a": 1, "b": [True, {"c": -2.5}]},
+])
+def test_cbor_roundtrip(value):
+    assert cbor.loads(cbor.dumps(value)) == value
+
+
+def test_cbor_wire_format_pins():
+    # RFC 7049 test vectors
+    assert cbor.dumps(0) == b"\x00"
+    assert cbor.dumps(23) == b"\x17"
+    assert cbor.dumps(24) == b"\x18\x18"
+    assert cbor.dumps(-1) == b"\x20"
+    assert cbor.dumps("a") == b"\x61a"
+    assert cbor.dumps([1, 2]) == b"\x82\x01\x02"
+    assert cbor.dumps(1.5) == b"\xfb\x3f\xf8\x00\x00\x00\x00\x00\x00"
+    assert cbor.loads(b"\xf9\x3c\x00") == 1.0          # half float decode
+    assert cbor.loads(b"\xfa\x3f\xc0\x00\x00") == 1.5  # single float decode
+    # indefinite-length array + string from a foreign encoder
+    assert cbor.loads(b"\x9f\x01\x02\xff") == [1, 2]
+    assert cbor.loads(b"\x7f\x61a\x61b\xff") == "ab"
+
+
+def test_cbor_errors():
+    with pytest.raises(ValueError):
+        cbor.loads(b"\x18")          # truncated
+    with pytest.raises(ValueError):
+        cbor.loads(b"\x00\x00")      # trailing bytes
+    with pytest.raises(ValueError):
+        cbor.loads(b"\x81" * 2000 + b"\x00")   # nesting bomb → bounded
+    with pytest.raises(ValueError):
+        cbor.loads(b"\xa1\x80\x00")  # array as map key → decode error
+    # 64-bit overflow encodes as a decimal string, not a crash
+    assert cbor.loads(cbor.dumps(2**70)) == str(2**70)
+    assert cbor.loads(cbor.dumps(-2**70)) == str(-2**70)
+    assert cbor.loads(cbor.dumps(2**64 - 1)) == 2**64 - 1
+
+
+# ---------------------------------------------------------------------------
+# driver end-to-end
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    n = Node(settings=Settings.from_dict({"http": {"native": False}}),
+             data_path=str(tmp_path_factory.mktemp("jdbc") / "data"))
+    port = n.start(0)
+    c = n.rest_controller
+    c.dispatch("PUT", "/library", {}, {
+        "mappings": {"properties": {
+            "title": {"type": "keyword"},
+            "pages": {"type": "integer"},
+            "price": {"type": "double"},
+            "published": {"type": "date"},
+            "in_print": {"type": "boolean"}}}})
+    books = [
+        ("Leviathan Wakes", 561, 9.99, "2011-06-02T00:00:00Z", True),
+        ("Hyperion", 482, 7.50, "1989-05-26T00:00:00Z", True),
+        ("Dune", 604, 11.25, "1965-08-01T00:00:00Z", True),
+        ("The Left Hand of Darkness", 304, 6.99,
+         "1969-03-01T00:00:00Z", False),
+        ("Neuromancer", 271, 8.25, "1984-07-01T00:00:00Z", True),
+    ]
+    for i, (t, pg, pr, pub, ip) in enumerate(books):
+        c.dispatch("PUT", f"/library/_doc/{i}", {}, {
+            "title": t, "pages": pg, "price": pr, "published": pub,
+            "in_print": ip})
+    c.dispatch("POST", "/library/_refresh", {}, None)
+    yield n, port
+    n.close()
+
+
+@pytest.fixture(scope="module")
+def conn(node):
+    _, port = node
+    con = dbapi.connect(f"jdbc:es://127.0.0.1:{port}/")
+    yield con
+    con.close()
+
+
+def test_connect_checks_server(node):
+    _, port = node
+    con = dbapi.connect(host="127.0.0.1", port=port)
+    assert "version" in con.server_info
+    assert con.ping()
+    con.close()
+    with pytest.raises(dbapi.InterfaceError):
+        con.cursor().execute("SELECT 1")
+    # connection refused → OperationalError at connect
+    with pytest.raises(dbapi.OperationalError):
+        dbapi.connect(host="127.0.0.1", port=1, timeout=2)
+
+
+def test_select_description_and_types(conn):
+    cur = conn.cursor()
+    cur.execute("SELECT title, pages, price, published, in_print "
+                "FROM library ORDER BY pages DESC")
+    names = [d[0] for d in cur.description]
+    assert names == ["title", "pages", "price", "published", "in_print"]
+    codes = [d[1] for d in cur.description]
+    assert codes == [dbapi.STRING, dbapi.NUMBER, dbapi.NUMBER,
+                     dbapi.DATETIME, dbapi.BOOLEAN]
+    # display_size flows from the server's JDBC-mode column metadata
+    # (ref: SqlDataTypes.displaySize — keyword 32766, integer 11)
+    assert cur.description[0][2] == 32766
+    assert cur.description[1][2] == 11
+    rows = cur.fetchall()
+    assert [r[0] for r in rows[:2]] == ["Dune", "Leviathan Wakes"]
+    assert isinstance(rows[0][3], dt.datetime)       # TypeConverter parity
+    assert rows[0][4] is True
+    cur.close()
+
+
+def test_qmark_parameters_typed(conn):
+    cur = conn.cursor()
+    cur.execute("SELECT title FROM library WHERE pages > ? AND price < ? "
+                "ORDER BY title ASC", (400, 10.0))
+    assert [r[0] for r in cur.fetchall()] == ["Hyperion", "Leviathan Wakes"]
+    # strings quote-escape through the typed-param path
+    cur.execute("SELECT pages FROM library WHERE title = ?", ("Dune",))
+    assert cur.fetchone() == [604]
+    assert cur.fetchone() is None
+    # ? inside a string literal is NOT a parameter
+    cur.execute("SELECT title FROM library WHERE title = '?' OR pages = ?",
+                (271,))
+    assert [r[0] for r in cur.fetchall()] == ["Neuromancer"]
+    with pytest.raises(dbapi.ProgrammingError):
+        cur.execute("SELECT title FROM library WHERE pages > ?", ())
+
+
+def test_cursor_paging_small_pages(node):
+    _, port = node
+    con = dbapi.connect(host="127.0.0.1", port=port, page_size=2)
+    cur = con.cursor()
+    cur.execute("SELECT title FROM library ORDER BY title ASC")
+    titles = [r[0] for r in cur]       # iterator protocol drains all pages
+    assert titles == sorted(titles)
+    assert len(titles) == 5
+    con.close()
+
+
+def test_aggregates_and_constant_select(conn):
+    cur = conn.cursor()
+    cur.execute("SELECT COUNT(*) AS n, AVG(pages) AS avg_pages FROM library")
+    n, avg_pages = cur.fetchone()
+    assert n == 5
+    assert abs(avg_pages - (561 + 482 + 604 + 304 + 271) / 5) < 1e-6
+    cur.execute("SELECT 1 + 1")
+    assert cur.fetchone() == [2]
+
+
+def test_json_mode_fallback(node):
+    _, port = node
+    con = dbapi.connect(host="127.0.0.1", port=port, binary=False)
+    cur = con.cursor()
+    cur.execute("SELECT title FROM library WHERE in_print = ?", (False,))
+    assert cur.fetchall() == [["The Left Hand of Darkness"]]
+    con.close()
+
+
+def test_mode_in_url_only(node):
+    """mode=jdbc in the URL alone must produce display_size columns
+    (ref: RestSqlQueryAction — mode is a request parameter)."""
+    n, _ = node
+    status, r = n.rest_controller.dispatch(
+        "POST", "/_sql", {"mode": "jdbc"},
+        {"query": "SELECT title FROM library LIMIT 1"})
+    assert status == 200
+    assert r["columns"][0]["display_size"] == 32766
+
+
+def test_non_finite_param_rejected(conn):
+    cur = conn.cursor()
+    with pytest.raises(dbapi.ProgrammingError):
+        cur.execute("SELECT title FROM library WHERE price > ?",
+                    (float("nan"),))
+
+
+def test_errors_surface_as_programming_errors(conn):
+    cur = conn.cursor()
+    with pytest.raises(dbapi.ProgrammingError):
+        cur.execute("SELEKT nope")
+    with pytest.raises(dbapi.NotSupportedError):
+        conn.rollback()
+    conn.commit()    # auto-commit no-op
