@@ -19,7 +19,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="elasticsearch-tpu",
         description="Start a node (the bin/elasticsearch analogue)")
-    ap.add_argument("--data", default="data", help="data path")
+    ap.add_argument("--data", default=None, help="data path")
+    ap.add_argument("--config", default=None, metavar="YML",
+                    help="elasticsearch.yml path (ref: ES_PATH_CONF; "
+                         "-E overrides win)")
     ap.add_argument("-E", action="append", default=[], metavar="K=V",
                     help="setting override (repeatable)")
     ap.add_argument("--quiet", action="store_true")
@@ -45,12 +48,28 @@ def main(argv=None) -> int:
         flat[key] = value
 
     from elasticsearch_tpu.common.bootstrap import (BootstrapCheckFailure,
+                                                    initialize_natives,
                                                     run_bootstrap_checks)
     from elasticsearch_tpu.common.settings import Settings
-    from elasticsearch_tpu.node import Node
 
-    settings = Settings(flat)
+    import os
+    base = {}
+    config_path = args.config or (
+        os.path.join(os.environ["ES_PATH_CONF"], "elasticsearch.yml")
+        if os.environ.get("ES_PATH_CONF") else None)
+    if config_path and os.path.exists(config_path):
+        base = Settings.from_yaml_file(config_path).as_dict()
+    base.update(flat)              # -E wins over the config file
+    settings = Settings(base)
+    data_path = (args.data or settings.get("path.data")
+                 or os.environ.get("ES_DATA_DEFAULT") or "data")
     bind_host = str(settings.get("http.host", "127.0.0.1"))
+    # natives first (ref: Bootstrap.init — initializeNatives precedes
+    # the checks): mlockall under bootstrap.memory_lock, and the
+    # seccomp execve/fork filter (bootstrap.system_call_filter,
+    # default true like the reference; irreversible for this process)
+    initialize_natives(settings)
+    from elasticsearch_tpu.node import Node
     try:
         run_bootstrap_checks(settings, bind_host)
     except BootstrapCheckFailure as e:
@@ -69,7 +88,7 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, _term)
     signal.signal(signal.SIGINT, _term)
 
-    node = Node(settings=settings, data_path=args.data)
+    node = Node(settings=settings, data_path=data_path)
     port = node.start(int(settings.get("http.port", 9200)))
     log.info("node [%s] started, HTTP on %s:%d", node.name, bind_host,
              port)

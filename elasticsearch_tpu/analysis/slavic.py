@@ -1,0 +1,214 @@
+"""Polish (stempel) and Ukrainian analysis.
+
+The reference ships analysis-stempel (ref: plugins/analysis-stempel/
+src/main/java/org/elasticsearch/index/analysis/
+PolishStemTokenFilterFactory.java + PolishAnalyzerProvider.java — the
+Stempel statistical stemmer over a bundled patricia-trie stemming
+table) and analysis-ukrainian (ref: plugins/analysis-ukrainian/.../
+UkrainianAnalyzerProvider.java — Lucene's UkrainianMorfologikAnalyzer
+over a morfologik dictionary). Both upstream implementations are
+dictionary-/table-driven; the tables are multi-megabyte binary
+artifacts, so — like the CJK plugin (analysis/cjk.py) — these are
+DISCLOSED algorithmic approximations: ordered longest-match suffix
+stripping with minimum-stem guards (the Dolamic–Savoy "light stemming"
+family that Lucene itself uses for several languages), plus real
+stopword lists. Same analyzer/filter names as the reference
+(``polish``, ``polish_stem``, ``ukrainian``), so mappings port
+unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from elasticsearch_tpu.analysis.tokenizers import Token
+from elasticsearch_tpu.analysis.filters import TokenFilter
+
+# ---------------------------------------------------------------------------
+# Polish
+# ---------------------------------------------------------------------------
+
+# the high-frequency function words of the reference's
+# PolishAnalyzer.getDefaultStopSet (stopwords.txt in the stempel jar)
+POLISH_STOP_WORDS = frozenset("""
+a aby ach acz aczkolwiek aj albo ale ależ ani aż bardziej bardzo bo
+bowiem by byli bym bynajmniej być był była było były będzie będą cali
+cała cały ci cię ciebie co cokolwiek coś czasami czasem czemu czy czyli
+daleko dla dlaczego dlatego do dobrze dokąd dość dużo dwa dwaj dwie
+dwoje dziś dzisiaj gdy gdyby gdyż gdzie gdziekolwiek gdzieś i ich ile
+im inna inne inny innych iż ja ją jak jakaś jakby jaki jakichś jakie
+jakiś jakiż jakkolwiek jako jakoś je jeden jedna jedno jednak jednakże
+jego jej jemu jest jestem jeszcze jeśli jeżeli już ją każdy kiedy
+kilka kimś kto ktokolwiek ktoś która które którego której który których
+którym którzy ku lat lecz lub ma mają mam mi mimo między mną mnie mogą
+moi moim moja moje może możliwe można mój mu musi my na nad nam nami
+nas nasi nasz nasza nasze naszego naszych natomiast natychmiast nawet
+nią nic nich nie niech niego niej niemu nigdy nim nimi niż no o obok od
+około on ona one oni ono oraz oto owszem pan pana pani po pod podczas
+pomimo ponad ponieważ powinien powinna powinni powinno poza prawie
+przecież przed przede przedtem przez przy roku również sam sama są się
+skąd sobie sobą sposób swoje ta tak taka taki takie także tam te tego
+tej ten teraz też to tobą tobie toteż trzeba tu tutaj twoi twoim twoja
+twoje twym twój ty tych tylko tym u w wam wami was wasz wasza wasze we
+według wiele wielu więc więcej wszyscy wszystkich wszystkie wszystkim
+wszystko wtedy wy właśnie z za zapewne zawsze ze zł znowu znów został
+żaden żadna żadne żadnych że żeby
+""".split())
+
+# ordered longest-first inflectional suffixes (case endings, verb forms,
+# adjective/participle endings, diminutives); min-stem guard applies
+_PL_SUFFIXES = [
+    # verbs (past/conditional/person endings)
+    "owałybyśmy", "owalibyśmy", "owałybyście", "owalibyście",
+    "iłybyśmy", "ilibyśmy", "ałybyśmy", "alibyśmy",
+    "owałyśmy", "owaliśmy", "owałabym", "owałbym",
+    "iłyśmy", "iliśmy", "ałyśmy", "aliśmy",
+    "owałaś", "owałeś", "owałam", "owałem", "owania", "owaniu",
+    "owanie", "owanych", "owanym", "owanej", "owaną", "owane", "owany",
+    "owana", "owano", "owało", "owała", "owały", "owali", "ować",
+    "iwać", "ywać", "ujemy", "ujecie", "owski", "owska", "owskie",
+    "ałaś", "ałeś", "ałam", "ałem", "iłaś", "iłeś", "iłam", "iłem",
+    "iemy", "ecie", "ąłem", "ęłam",
+    "acie", "eście", "eśmy", "iśmy", "yśmy",
+    # nouns: case endings
+    "ami", "ach", "owi", "owie", "ówek", "ówka", "ówki", "owych",
+    "owego", "owemu", "owym", "owej", "ową", "owe", "owa", "owy",
+    "iach", "iami", "iom", "iów", "iego", "iemu",
+    "ości", "ość", "ościach", "ościami", "ościom",
+    "eniu", "enia", "enie", "eniem", "eniach", "eniami",
+    "aniu", "ania", "anie", "aniem", "aniach", "aniami",
+    # adjectives/pronouns
+    "ych", "ymi", "imi", "ego", "emu", "iej", "ej", "ą", "ę",
+    "om", "ów", "ie", "iu", "ia", "ią", "io", "ió",
+    "em", "am", "om", "um", "ym", "im",
+    "a", "ą", "e", "ę", "i", "o", "u", "y",
+]
+_PL_SUFFIXES.sort(key=len, reverse=True)
+
+_PL_MIN_STEM = 3
+
+
+def polish_stem(word: str) -> str:
+    """Light algorithmic Polish stem (the stempel table's role —
+    disclosed approximation; ref: PolishStemTokenFilterFactory)."""
+    w = word
+    changed = True
+    # strip at most two layers (case ending over derivational suffix),
+    # longest match first, never below the minimum stem length
+    for _ in range(2):
+        if not changed:
+            break
+        changed = False
+        for suf in _PL_SUFFIXES:
+            if len(w) - len(suf) >= _PL_MIN_STEM and w.endswith(suf):
+                w = w[: len(w) - len(suf)]
+                changed = True
+                break
+    return w
+
+
+class PolishStemFilter(TokenFilter):
+    name = "polish_stem"
+
+    def filter(self, tokens: List[Token]) -> List[Token]:
+        return [t if t.keyword else Token(polish_stem(t.term), t.position,
+                                          t.start_offset, t.end_offset,
+                                          t.keyword)
+                for t in tokens]
+
+
+# ---------------------------------------------------------------------------
+# Ukrainian
+# ---------------------------------------------------------------------------
+
+# the high-frequency function words of Lucene's UkrainianMorfologikAnalyzer
+# default stop set
+UKRAINIAN_STOP_WORDS = frozenset("""
+а але б без би бо був буде будемо будете будеш були було бути в вам вас
+ваш ваша ваше ваші вже ви від він вона вони воно все всі втім ви де для
+до его є ж з за зі и й його йому її інших і із ін коли кого коли ли лише
+ми мене мені мною може мої мій на навіть над нам нами нас наш наша наше
+наші не нею ні ній ним ними них но о об один от ось по при про се собі
+та так також такий таке такі там те ти тим тих то тобі того той тому
+ту тут у цього цьому це цей ці чи чого чому що щоб я як яка який яке
+які якщо
+""".split())
+
+_UK_SUFFIXES = [
+    # nouns (case endings, incl. soft/plural paradigms)
+    "ностями", "остями", "ування", "уванням",
+    "ностей", "ності", "ність", "остей", "ості", "ість",
+    "ення", "ення", "енням", "еннях", "ання", "анням", "аннях",
+    "ами", "ями", "ові", "еві", "єві", "иною", "ином",
+    "ах", "ях", "ам", "ям", "ом", "ем", "єм", "ою", "ею", "єю",
+    "ів", "їв", "ий", "ій", "ей",
+    # adjectives
+    "ього", "ьому", "ого", "ому", "ими", "іми", "их", "іх",
+    "ої", "ій", "ім", "им", "а", "я", "е", "є", "і", "ї",
+    "о", "у", "ю", "и", "ь",
+    # verbs
+    "уватися", "юватися", "увати", "ювати", "увався", "ювався",
+    "ається", "уються", "ються", "ється",
+    "лася", "лися", "лось", "лося", "вся", "ся", "сь",
+    "емо", "ємо", "имо", "їмо", "ете", "єте", "ите", "їте",
+    "уть", "ють", "ать", "ять", "ить", "їть",
+    "ла", "ло", "ли", "ти", "ть", "в",
+]
+_UK_SUFFIXES.sort(key=len, reverse=True)
+
+_UK_MIN_STEM = 3
+
+
+def ukrainian_stem(word: str) -> str:
+    """Light algorithmic Ukrainian stem (the morfologik dictionary's
+    role — disclosed approximation; ref: UkrainianAnalyzerProvider)."""
+    # the reflexive particle strips first (читалася → читала)
+    w = word
+    for refl in ("ся", "сь"):
+        if len(w) - len(refl) >= _UK_MIN_STEM + 1 and w.endswith(refl):
+            w = w[: len(w) - len(refl)]
+            break
+    changed = True
+    for _ in range(2):
+        if not changed:
+            break
+        changed = False
+        for suf in _UK_SUFFIXES:
+            if len(w) - len(suf) >= _UK_MIN_STEM and w.endswith(suf):
+                w = w[: len(w) - len(suf)]
+                changed = True
+                break
+    return w
+
+
+class UkrainianStemFilter(TokenFilter):
+    name = "ukrainian_stem"
+
+    def filter(self, tokens: List[Token]) -> List[Token]:
+        return [t if t.keyword else Token(ukrainian_stem(t.term),
+                                          t.position, t.start_offset,
+                                          t.end_offset, t.keyword)
+                for t in tokens]
+
+
+# apostrophe variants normalize to the straight apostrophe, and the
+# ghost-character ґ folds like Lucene's Ukrainian char-map does NOT —
+# ґ is a distinct letter; only apostrophes normalize
+_UK_APOSTROPHES = {"’": "'", "ʼ": "'", "`": "'"}
+
+
+class UkrainianNormalizationFilter(TokenFilter):
+    """Apostrophe normalization (ref: UkrainianMorfologikAnalyzer's
+    normalization char-filter: м’яко/мʼяко → м'яко)."""
+
+    name = "ukrainian_normalization"
+
+    def filter(self, tokens: List[Token]) -> List[Token]:
+        out = []
+        for t in tokens:
+            term = t.term
+            for src, dst in _UK_APOSTROPHES.items():
+                term = term.replace(src, dst)
+            out.append(Token(term, t.position, t.start_offset,
+                             t.end_offset, t.keyword))
+        return out

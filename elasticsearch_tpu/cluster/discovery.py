@@ -89,6 +89,143 @@ def resolve_seed_hosts(config_dir: Optional[str] = None,
 PLUGIN_SEED_PROVIDERS = {}
 
 
+def gce_seed_hosts(settings) -> List[DiscoveryNode]:
+    """GCE Compute-API seed provider (ref: plugins/discovery-gce/.../
+    GceSeedHostsProvider.java — RUNNING instances in the configured
+    project/zones whose tags contain every ``discovery.gce.tags`` entry
+    become seeds, addressed by their primary ``networkIP``).
+
+    The OAuth bearer token comes from the instance metadata server
+    (``cloud.gce.metadata.endpoint`` — the
+    ``computeMetadata/v1/.../token`` path with ``Metadata-Flavor:
+    Google``, exactly what the reference's compute-engine credential
+    chain does); ``discovery.gce.endpoint`` points at the Compute API
+    (in tests, an in-process fixture that verifies both requests)."""
+    if not settings:
+        return []
+    endpoint = settings.get("discovery.gce.endpoint")
+    project = settings.get("cloud.gce.project_id")
+    zones = str(settings.get("cloud.gce.zone", "") or "")
+    if not endpoint or not project or not zones:
+        return []
+    import json as _json
+    import urllib.request
+
+    token = ""
+    meta = settings.get("cloud.gce.metadata.endpoint")
+    if meta:
+        req = urllib.request.Request(
+            str(meta).rstrip("/")
+            + "/computeMetadata/v1/instance/service-accounts/default/token",
+            headers={"Metadata-Flavor": "Google"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                token = _json.loads(resp.read()).get("access_token", "")
+        except (OSError, ValueError):
+            return []   # no credentials: no seeds (never a crash)
+    tags = {t.strip() for t in
+            str(settings.get("discovery.gce.tags", "") or "").split(",")
+            if t.strip()}
+    port = int(settings.get("discovery.gce.port", 9300))
+    out: List[DiscoveryNode] = []
+    for zone in (z.strip() for z in zones.split(",") if z.strip()):
+        url = (f"{str(endpoint).rstrip('/')}/projects/{project}"
+               f"/zones/{zone}/instances")
+        req = urllib.request.Request(
+            url, headers={"Authorization": f"Bearer {token}"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                payload = _json.loads(resp.read())
+        except (OSError, ValueError):
+            continue
+        for inst in payload.get("items", []):
+            if inst.get("status") != "RUNNING":
+                continue
+            inst_tags = set((inst.get("tags") or {}).get("items") or [])
+            if tags and not tags.issubset(inst_tags):
+                continue
+            nics = inst.get("networkInterfaces") or []
+            ip = (nics[0].get("networkIP") or "").strip() if nics else ""
+            if ip:
+                out.append(DiscoveryNode(
+                    node_id=f"seed-{ip}-{port}", name=f"{ip}:{port}",
+                    host=ip, port=port))
+    return out
+
+
+def azure_classic_seed_hosts(settings) -> List[DiscoveryNode]:
+    """Azure classic (Service Management API) seed provider (ref:
+    plugins/discovery-azure-classic/.../AzureSeedHostsProvider.java —
+    role instances of one hosted service become seeds).
+
+    ``GET {endpoint}/{subscription}/services/hostedservices/{service}
+    ?embed-detail=true`` with the ``x-ms-version`` header the management
+    API requires; ``discovery.azure.host.type`` picks ``private_ip``
+    (the role instance's IpAddress) or ``public_ip`` (the Vip+PublicPort
+    of the instance endpoint named ``discovery.azure.endpoint.name``,
+    default ``elasticsearch``). ``discovery.azure.deployment.name`` /
+    ``.slot`` narrow which deployment is eligible."""
+    if not settings:
+        return []
+    endpoint = settings.get("discovery.azure.endpoint")
+    subscription = settings.get("cloud.azure.management.subscription.id")
+    service = settings.get("cloud.azure.management.cloud.service.name")
+    if not endpoint or not subscription or not service:
+        return []
+    import urllib.request
+    import xml.etree.ElementTree as ET
+
+    url = (f"{str(endpoint).rstrip('/')}/{subscription}"
+           f"/services/hostedservices/{service}?embed-detail=true")
+    req = urllib.request.Request(
+        url, headers={"x-ms-version": "2014-10-01"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            xml = resp.read()
+    except OSError:
+        return []
+    try:
+        root = ET.fromstring(xml)
+    except ET.ParseError:
+        return []
+    ns = root.tag.partition("}")[0] + "}" if root.tag.startswith("{") \
+        else ""
+    host_type = str(settings.get("discovery.azure.host.type",
+                                 "private_ip")).lower()
+    ep_name = str(settings.get("discovery.azure.endpoint.name",
+                               "elasticsearch"))
+    want_name = settings.get("discovery.azure.deployment.name")
+    want_slot = str(settings.get("discovery.azure.deployment.slot",
+                                 "production")).lower()
+    port = int(settings.get("discovery.azure.port", 9300))
+    out: List[DiscoveryNode] = []
+    for dep in root.iter(f"{ns}Deployment"):
+        name = (dep.findtext(f"{ns}Name") or "").strip()
+        slot = (dep.findtext(f"{ns}DeploymentSlot") or "").strip()
+        if want_name and name != str(want_name):
+            continue
+        if want_slot and slot.lower() != want_slot:
+            continue
+        for ri in dep.iter(f"{ns}RoleInstance"):
+            if host_type == "public_ip":
+                for iep in ri.iter(f"{ns}InstanceEndpoint"):
+                    if (iep.findtext(f"{ns}Name") or "").strip() != ep_name:
+                        continue
+                    vip = (iep.findtext(f"{ns}Vip") or "").strip()
+                    pport = int(iep.findtext(f"{ns}PublicPort") or port)
+                    if vip:
+                        out.append(DiscoveryNode(
+                            node_id=f"seed-{vip}-{pport}",
+                            name=f"{vip}:{pport}", host=vip, port=pport))
+            else:
+                ip = (ri.findtext(f"{ns}IpAddress") or "").strip()
+                if ip:
+                    out.append(DiscoveryNode(
+                        node_id=f"seed-{ip}-{port}", name=f"{ip}:{port}",
+                        host=ip, port=port))
+    return out
+
+
 def ec2_seed_hosts(settings) -> List[DiscoveryNode]:
     """EC2 DescribeInstances seed provider (ref: plugins/discovery-ec2/
     .../AwsEc2SeedHostsProvider.java — running instances matching the

@@ -110,6 +110,89 @@ ALL_CHECKS: List[Callable] = [
 ]
 
 
+# ---------------------------------------------------------------------------
+# native hardening (ref: Bootstrap.initializeNatives — runs BEFORE the
+# bootstrap checks; JNANatives.tryMlockall + SystemCallFilter.init)
+# ---------------------------------------------------------------------------
+
+# outcome of initialize_natives, consulted by the corresponding checks
+# (ref: BootstrapChecks.MlockallCheck reads Natives.isMemoryLocked,
+# SystemCallFilterCheck reads Natives.isSystemCallFilterInstalled)
+NATIVE_STATUS = {"memory_locked": False,
+                 "system_call_filter_installed": False,
+                 "attempted": False}
+
+
+def initialize_natives(settings=None) -> dict:
+    """Apply the native hardening the settings ask for:
+    ``bootstrap.memory_lock`` → mlockall(MCL_CURRENT|MCL_FUTURE);
+    ``bootstrap.system_call_filter`` → seccomp BPF denying
+    execve/fork/vfork/execveat with EACCES (irreversible for the
+    process). Failures are recorded, not raised — the production-mode
+    bootstrap checks turn them into hard failures, exactly like the
+    reference's split between initializeNatives and BootstrapChecks."""
+    from elasticsearch_tpu import native
+    NATIVE_STATUS["attempted"] = True
+
+    def _on(key, default=False):
+        v = settings.get(key, default) if settings is not None else default
+        return str(v).lower() in ("true", "1", "yes")
+
+    if _on("bootstrap.memory_lock"):
+        rc = native.try_mlockall()
+        if rc == 0:
+            NATIVE_STATUS["memory_locked"] = True
+        else:
+            logger.warning(
+                "Unable to lock JVM Memory: error=%s\nThis can result in "
+                "part of the JVM being swapped out.", rc)
+    if _on("bootstrap.system_call_filter", True):
+        # pre-warm anything that still needs to exec (the lazy g++
+        # builds of BOTH native libraries) — after the filter, no
+        # subprocess can ever spawn
+        native.get_lib()
+        try:
+            from elasticsearch_tpu.rest import native_http
+            native_http.get_lib()
+        except Exception:
+            logger.debug("native http front unavailable", exc_info=True)
+        rc = native.install_system_call_filter()
+        if rc is not None and rc >= 0:
+            NATIVE_STATUS["system_call_filter_installed"] = True
+            if rc == 1:
+                logger.info("system call filter installed via prctl "
+                            "fallback (calling thread only)")
+        else:
+            logger.warning(
+                "unable to install syscall filter: error=%s", rc)
+    return dict(NATIVE_STATUS)
+
+
+def memory_lock_check(settings) -> Optional[str]:
+    """ref: BootstrapChecks.MlockallCheck."""
+    if settings is None or not NATIVE_STATUS["attempted"]:
+        return None
+    want = str(settings.get("bootstrap.memory_lock", False)).lower() \
+        in ("true", "1", "yes")
+    if want and not NATIVE_STATUS["memory_locked"]:
+        return ("memory locking requested for elasticsearch process "
+                "but memory is not locked")
+    return None
+
+
+def system_call_filter_check(settings) -> Optional[str]:
+    """ref: BootstrapChecks.SystemCallFilterCheck."""
+    if settings is None or not NATIVE_STATUS["attempted"]:
+        return None
+    want = str(settings.get("bootstrap.system_call_filter", True)).lower() \
+        in ("true", "1", "yes")
+    if want and not NATIVE_STATUS["system_call_filter_installed"]:
+        return ("system call filters failed to install; check the logs "
+                "and fix your configuration or disable system call "
+                "filters at your own risk")
+    return None
+
+
 class BootstrapCheckFailure(RuntimeError):
     pass
 
@@ -127,9 +210,11 @@ def run_bootstrap_checks(settings=None, bind_host: str = "127.0.0.1",
     overrides the bind-host heuristic)."""
     failures = [msg for check in ALL_CHECKS
                 if (msg := check()) is not None]
-    msg = discovery_configuration_check(settings)
-    if msg is not None:
-        failures.append(msg)
+    for settings_check in (discovery_configuration_check,
+                           memory_lock_check, system_call_filter_check):
+        msg = settings_check(settings)
+        if msg is not None:
+            failures.append(msg)
     production = enforce if enforce is not None else \
         is_production(bind_host)
     if failures:

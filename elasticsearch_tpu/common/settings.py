@@ -193,6 +193,21 @@ class Settings:
         _flatten("", d, flat)
         return cls(flat)
 
+    @classmethod
+    def from_yaml_file(cls, path: str) -> "Settings":
+        """Load an ``elasticsearch.yml`` (ref: the distribution's
+        config/elasticsearch.yml read by Environment/Settings.builder
+        .loadFromPath). Empty or missing documents yield EMPTY."""
+        import yaml
+        with open(path) as fh:
+            data = yaml.safe_load(fh)
+        if data is None:
+            return cls()
+        if not isinstance(data, dict):
+            raise ValueError(
+                f"malformed settings file [{path}]: expected a mapping")
+        return cls.from_dict(data)
+
     def get(self, key: str, default: Any = None) -> Any:
         return self._flat.get(key, default)
 

@@ -1,0 +1,332 @@
+"""Distribution packaging — the ``distribution/`` analogue.
+
+The reference builds OS distributions from the same staged layout
+(ref: distribution/archives/ tar+zip, distribution/packages/ deb+rpm
+with a systemd unit, distribution/docker/src/docker/Dockerfile): a
+root with ``bin/`` launch scripts, ``config/elasticsearch.yml``,
+libraries, and empty ``plugins/``/``data`` dirs. This module stages
+that layout for the Python/TPU runtime and emits each artifact:
+
+- ``stage()``       — the shared directory layout
+- ``build_tar()``   — ``elasticsearch-tpu-{version}-linux.tar.gz``
+  (ref: distribution/archives)
+- ``write_docker()``— Dockerfile + .dockerignore over the staged root
+  (ref: distribution/docker/src/docker/Dockerfile)
+- ``write_deb()`` / ``write_rpm()`` — DEBIAN/control + postinst and a
+  .spec, plus the shared systemd unit (ref: distribution/packages/
+  src/common/systemd/elasticsearch.service)
+
+CLI: ``python -m elasticsearch_tpu.distribution --type tar --out DIR``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import stat
+import tarfile
+from typing import Optional
+
+VERSION = "1.0.0"
+
+_PKG_ROOT = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
+
+# ---------------------------------------------------------------------------
+# launch scripts (ref: distribution/src/bin/elasticsearch et al.)
+# ---------------------------------------------------------------------------
+
+_BIN_MAIN = """#!/bin/sh
+# ref: distribution/src/bin/elasticsearch — resolve ES_HOME from the
+# script location, point the runtime at config/ and data/, pass
+# everything else through to the launcher
+ES_HOME="$(cd "$(dirname "$0")/.." && pwd)"
+export ES_PATH_CONF="${ES_PATH_CONF:-$ES_HOME/config}"
+export PYTHONPATH="$ES_HOME/lib${PYTHONPATH:+:$PYTHONPATH}"
+# data path precedence: explicit ES_DATA > path.data in the yml >
+# $ES_HOME/data (the launcher resolves ES_DATA_DEFAULT last, so a
+# config-file path.data is honored — ref: Environment path.data)
+export ES_DATA_DEFAULT="$ES_HOME/data"
+if [ -n "$ES_DATA" ]; then
+    set -- --data "$ES_DATA" "$@"
+fi
+exec "${ES_PYTHON:-python3}" -m elasticsearch_tpu \\
+    --config "$ES_PATH_CONF/elasticsearch.yml" "$@"
+"""
+
+_BIN_TOOL = """#!/bin/sh
+# ref: distribution/src/bin/elasticsearch-{tool}
+ES_HOME="$(cd "$(dirname "$0")/.." && pwd)"
+export PYTHONPATH="$ES_HOME/lib${{PYTHONPATH:+:$PYTHONPATH}}"
+exec "${{ES_PYTHON:-python3}}" -m {module} "$@"
+"""
+
+_DEFAULT_YML = """# ======================== Elasticsearch-TPU ========================
+# (ref: distribution/src/config/elasticsearch.yml — everything
+# commented; -E flags and this file feed the same Settings bag)
+#
+#cluster.name: my-application
+#node.name: node-1
+#path.data: /var/lib/elasticsearch-tpu
+#http.host: 127.0.0.1
+#http.port: 9200
+#discovery.seed_hosts: ["host1", "host2"]
+#cluster.initial_master_nodes: ["node-1"]
+#bootstrap.memory_lock: true
+#xpack.security.enabled: true
+"""
+
+_SYSTEMD_UNIT = """[Unit]
+Description=Elasticsearch-TPU
+Documentation=https://github.com/
+Wants=network-online.target
+After=network-online.target
+
+[Service]
+Type=notify
+RuntimeDirectory=elasticsearch-tpu
+Environment=ES_HOME=/usr/share/elasticsearch-tpu
+Environment=ES_PATH_CONF=/etc/elasticsearch-tpu
+Environment=ES_DATA=/var/lib/elasticsearch-tpu
+User=elasticsearch
+Group=elasticsearch
+ExecStart=/usr/share/elasticsearch-tpu/bin/elasticsearch --quiet
+LimitNOFILE=65535
+LimitNPROC=4096
+LimitAS=infinity
+LimitFSIZE=infinity
+LimitMEMLOCK=infinity
+TimeoutStopSec=0
+KillSignal=SIGTERM
+KillMode=process
+SendSIGKILL=no
+SuccessExitStatus=143
+
+[Install]
+WantedBy=multi-user.target
+"""
+
+_DOCKERFILE = """# ref: distribution/docker/src/docker/Dockerfile — a
+# minimal runtime layer over the staged archive layout
+FROM python:3.12-slim
+
+RUN groupadd -g 1000 elasticsearch && \\
+    useradd -u 1000 -g 1000 -d /usr/share/elasticsearch-tpu elasticsearch
+
+COPY --chown=1000:1000 . /usr/share/elasticsearch-tpu
+WORKDIR /usr/share/elasticsearch-tpu
+
+RUN pip install --no-cache-dir jax flax optax orbax-checkpoint pyyaml numpy
+
+ENV ES_PATH_CONF=/usr/share/elasticsearch-tpu/config
+USER 1000:1000
+EXPOSE 9200 9300
+
+ENTRYPOINT ["/usr/share/elasticsearch-tpu/bin/elasticsearch"]
+"""
+
+_DEB_CONTROL = """Package: elasticsearch-tpu
+Version: {version}
+Section: web
+Priority: optional
+Architecture: all
+Depends: python3 (>= 3.10)
+Maintainer: elasticsearch-tpu
+Description: TPU-native distributed search and analytics engine
+ Search engine with a JAX/XLA execution core. Layout and service
+ management mirror the reference elasticsearch packages.
+"""
+
+_DEB_POSTINST = """#!/bin/sh
+# ref: distribution/packages/src/deb/init.d + common postinst — create
+# the service user and enable the unit
+set -e
+if ! getent group elasticsearch >/dev/null; then
+    addgroup --system elasticsearch
+fi
+if ! getent passwd elasticsearch >/dev/null; then
+    adduser --system --ingroup elasticsearch --home \\
+        /usr/share/elasticsearch-tpu --shell /bin/false elasticsearch
+fi
+mkdir -p /var/lib/elasticsearch-tpu
+chown elasticsearch:elasticsearch /var/lib/elasticsearch-tpu
+if command -v systemctl >/dev/null; then
+    systemctl daemon-reload || true
+fi
+exit 0
+"""
+
+_RPM_SPEC = """Name: elasticsearch-tpu
+Version: {version}
+Release: 1
+Summary: TPU-native distributed search and analytics engine
+License: Apache-2.0
+BuildArch: noarch
+Requires: python3 >= 3.10
+
+%description
+Search engine with a JAX/XLA execution core. Layout and service
+management mirror the reference elasticsearch packages
+(ref: distribution/packages/src/common).
+
+%files
+/usr/share/elasticsearch-tpu
+/etc/elasticsearch-tpu
+/usr/lib/systemd/system/elasticsearch-tpu.service
+
+%pre
+getent group elasticsearch >/dev/null || groupadd -r elasticsearch
+getent passwd elasticsearch >/dev/null || useradd -r -g elasticsearch \\
+    -d /usr/share/elasticsearch-tpu -s /sbin/nologin elasticsearch
+
+%post
+mkdir -p /var/lib/elasticsearch-tpu
+chown elasticsearch:elasticsearch /var/lib/elasticsearch-tpu
+"""
+
+
+def _write_exec(path: str, content: str) -> None:
+    with open(path, "w") as fh:
+        fh.write(content)
+    os.chmod(path, os.stat(path).st_mode | stat.S_IXUSR | stat.S_IXGRP
+             | stat.S_IXOTH)
+
+
+def stage(out_dir: str, version: str = VERSION,
+          include_plugins_src: bool = True) -> str:
+    """Build the shared distribution layout under
+    ``{out_dir}/elasticsearch-tpu-{version}`` and return that root."""
+    root = os.path.join(out_dir, f"elasticsearch-tpu-{version}")
+    if os.path.exists(root):
+        shutil.rmtree(root)
+    os.makedirs(os.path.join(root, "bin"))
+    os.makedirs(os.path.join(root, "config"))
+    os.makedirs(os.path.join(root, "plugins"))
+    os.makedirs(os.path.join(root, "lib"))
+
+    # the runtime library (the jars' role); bytecode caches excluded
+    shutil.copytree(
+        _PKG_ROOT, os.path.join(root, "lib", "elasticsearch_tpu"),
+        ignore=shutil.ignore_patterns("__pycache__", "*.pyc"))
+    # installable plugins ship next to the runtime (ref: the plugins
+    # download site; bundling keeps this offline-installable)
+    src_plugins = os.path.join(_REPO_ROOT, "plugins_src")
+    if include_plugins_src and os.path.isdir(src_plugins):
+        shutil.copytree(
+            src_plugins, os.path.join(root, "plugins_src"),
+            ignore=shutil.ignore_patterns("__pycache__", "*.pyc"))
+
+    _write_exec(os.path.join(root, "bin", "elasticsearch"), _BIN_MAIN)
+    for tool, module in (
+            ("elasticsearch-plugin", "elasticsearch_tpu.plugins"),
+            ("elasticsearch-keystore", "elasticsearch_tpu.common.keystore"),
+            ("elasticsearch-sql-cli", "elasticsearch_tpu.xpack.sql_protocol")):
+        _write_exec(os.path.join(root, "bin", tool),
+                    _BIN_TOOL.format(module=module, tool=tool))
+    with open(os.path.join(root, "config", "elasticsearch.yml"), "w") as fh:
+        fh.write(_DEFAULT_YML)
+    readme = os.path.join(_REPO_ROOT, "README.md")
+    if os.path.exists(readme):
+        shutil.copy(readme, os.path.join(root, "README.md"))
+    return root
+
+
+def build_tar(out_dir: str, version: str = VERSION) -> str:
+    """``elasticsearch-tpu-{version}-linux.tar.gz`` with the version
+    directory as the archive root (ref: distribution/archives — the
+    tar unpacks to elasticsearch-{version}/)."""
+    root = stage(out_dir, version)
+    tar_path = os.path.join(out_dir,
+                            f"elasticsearch-tpu-{version}-linux.tar.gz")
+    with tarfile.open(tar_path, "w:gz") as tf:
+        tf.add(root, arcname=os.path.basename(root))
+    return tar_path
+
+
+def write_docker(out_dir: str, version: str = VERSION) -> str:
+    root = stage(out_dir, version)
+    path = os.path.join(root, "Dockerfile")
+    with open(path, "w") as fh:
+        fh.write(_DOCKERFILE)
+    with open(os.path.join(root, ".dockerignore"), "w") as fh:
+        fh.write("data\n*.tar.gz\n")
+    return path
+
+
+def write_deb(out_dir: str, version: str = VERSION) -> str:
+    """DEBIAN/ control + postinst over a /usr/share staging tree —
+    ``dpkg-deb --build`` ready (ref: distribution/packages deb)."""
+    pkg = os.path.join(out_dir, f"elasticsearch-tpu_{version}_all")
+    if os.path.exists(pkg):
+        shutil.rmtree(pkg)
+    staged = stage(out_dir, version)
+    share = os.path.join(pkg, "usr", "share", "elasticsearch-tpu")
+    os.makedirs(os.path.dirname(share))
+    shutil.move(staged, share)
+    # config relocates to /etc (ref: packages layout vs archives layout)
+    etc = os.path.join(pkg, "etc", "elasticsearch-tpu")
+    os.makedirs(os.path.dirname(etc), exist_ok=True)
+    shutil.move(os.path.join(share, "config"), etc)
+    unit_dir = os.path.join(pkg, "usr", "lib", "systemd", "system")
+    os.makedirs(unit_dir)
+    with open(os.path.join(unit_dir, "elasticsearch-tpu.service"),
+              "w") as fh:
+        fh.write(_SYSTEMD_UNIT)
+    deb_dir = os.path.join(pkg, "DEBIAN")
+    os.makedirs(deb_dir)
+    with open(os.path.join(deb_dir, "control"), "w") as fh:
+        fh.write(_DEB_CONTROL.format(version=version))
+    _write_exec(os.path.join(deb_dir, "postinst"), _DEB_POSTINST)
+    return pkg
+
+
+def write_rpm(out_dir: str, version: str = VERSION) -> str:
+    """SPECS/ + BUILDROOT staging — ``rpmbuild -bb`` ready
+    (ref: distribution/packages rpm)."""
+    top = os.path.join(out_dir, "rpm")
+    specs = os.path.join(top, "SPECS")
+    buildroot = os.path.join(
+        top, "BUILDROOT", f"elasticsearch-tpu-{version}-1.noarch")
+    os.makedirs(specs, exist_ok=True)
+    staged = stage(out_dir, version)
+    share = os.path.join(buildroot, "usr", "share", "elasticsearch-tpu")
+    if os.path.exists(share):
+        shutil.rmtree(share)
+    os.makedirs(os.path.dirname(share), exist_ok=True)
+    shutil.move(staged, share)
+    etc = os.path.join(buildroot, "etc", "elasticsearch-tpu")
+    os.makedirs(os.path.dirname(etc), exist_ok=True)
+    if os.path.exists(etc):
+        shutil.rmtree(etc)
+    shutil.move(os.path.join(share, "config"), etc)
+    unit_dir = os.path.join(buildroot, "usr", "lib", "systemd", "system")
+    os.makedirs(unit_dir, exist_ok=True)
+    with open(os.path.join(unit_dir, "elasticsearch-tpu.service"),
+              "w") as fh:
+        fh.write(_SYSTEMD_UNIT)
+    spec = os.path.join(specs, "elasticsearch-tpu.spec")
+    with open(spec, "w") as fh:
+        fh.write(_RPM_SPEC.format(version=version))
+    return spec
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="elasticsearch-tpu-distribution",
+        description="Build distribution artifacts "
+                    "(ref: the distribution/ gradle projects)")
+    ap.add_argument("--type", choices=("tar", "docker", "deb", "rpm"),
+                    default="tar")
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--version", default=VERSION)
+    args = ap.parse_args(argv)
+    os.makedirs(args.out, exist_ok=True)
+    builder = {"tar": build_tar, "docker": write_docker,
+               "deb": write_deb, "rpm": write_rpm}[args.type]
+    print(builder(args.out, args.version))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

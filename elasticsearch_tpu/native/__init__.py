@@ -115,6 +115,31 @@ def available() -> bool:
     return get_lib() is not None
 
 
+def try_mlockall() -> Optional[int]:
+    """Lock the process address space into RAM (ref: JNANatives.java
+    tryMlockall under bootstrap.memory_lock). Returns 0 on success, an
+    errno on failure, None when the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    lib.es_mlockall.restype = ctypes.c_int
+    return int(lib.es_mlockall())
+
+
+def install_system_call_filter() -> Optional[int]:
+    """Install the seccomp BPF filter denying process-spawning syscalls
+    with EACCES (ref: SystemCallFilter.java). Returns 0 when installed
+    process-wide (seccomp(2)+TSYNC), 1 when only the calling thread is
+    covered (prctl fallback), a negative errno on failure, None when
+    the native library is unavailable. IRREVERSIBLE for the process —
+    after this, no subprocess can ever be spawned."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    lib.es_install_syscall_filter.restype = ctypes.c_int
+    return int(lib.es_install_syscall_filter())
+
+
 def tokenize_ascii(text: str, max_token_length: int = 255
                    ) -> Optional[List[Tuple[str, int, int]]]:
     """(term, start, end) triples via the native tokenizer; None if the

@@ -313,3 +313,94 @@ extern "C" int bm25_maxscore_topk(
   }
   return n;
 }
+
+// ---------------------------------------------------------------------------
+// Hardening shim (ref: bootstrap/SystemCallFilter.java — a seccomp BPF
+// filter returning EACCES for process-spawning syscalls, installed via
+// seccomp(2) with TSYNC when available, falling back to prctl(2); and
+// bootstrap/JNANatives.java — mlockall(MCL_CURRENT|MCL_FUTURE) under
+// bootstrap.memory_lock). Linux-only, like the reference's primary path.
+// ---------------------------------------------------------------------------
+#ifdef __linux__
+#include <errno.h>
+#include <sys/mman.h>
+#include <sys/prctl.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+#include <linux/audit.h>
+#include <linux/filter.h>
+#include <linux/seccomp.h>
+
+#ifndef SECCOMP_SET_MODE_FILTER
+#define SECCOMP_SET_MODE_FILTER 1
+#endif
+#ifndef SECCOMP_FILTER_FLAG_TSYNC
+#define SECCOMP_FILTER_FLAG_TSYNC 1
+#endif
+
+extern "C" {
+
+// 0 on success, else errno. Locks current+future pages into RAM.
+int es_mlockall() {
+  return mlockall(MCL_CURRENT | MCL_FUTURE) == 0 ? 0 : errno;
+}
+
+// Installs the execve/fork/vfork/execveat -> EACCES BPF filter.
+// Returns 0 on success (1 if only the prctl fallback path applied,
+// matching the reference's "app threads only" caveat), else -errno.
+int es_install_syscall_filter() {
+#if defined(__x86_64__)
+  const uint32_t arch_nr = AUDIT_ARCH_X86_64;
+  const uint32_t nr_execve = 59, nr_fork = 57, nr_vfork = 58,
+                 nr_execveat = 322;
+#elif defined(__aarch64__)
+  const uint32_t arch_nr = AUDIT_ARCH_AARCH64;
+  // fork/vfork do not exist on aarch64 (clone services both, and must
+  // stay open for threads) — alias them to execve like the reference's
+  // arch table omits them
+  const uint32_t nr_execve = 221, nr_fork = 221, nr_vfork = 221,
+                 nr_execveat = 281;
+#else
+  return -ENOSYS;
+#endif
+  const uint32_t deny = SECCOMP_RET_ERRNO | (EACCES & SECCOMP_RET_DATA);
+  struct sock_filter filter[] = {
+      // foreign-arch callers (i386 int 0x80 compat on an x86_64
+      // kernel) are DENIED outright — allowing them would let execve
+      // ride a compat syscall number straight past the filter (the
+      // reference's BPF denies on arch mismatch for the same reason)
+      BPF_STMT(BPF_LD | BPF_W | BPF_ABS, 4),
+      BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, arch_nr, 1, 0),
+      BPF_STMT(BPF_RET | BPF_K, deny),
+      BPF_STMT(BPF_LD | BPF_W | BPF_ABS, 0),
+      // x32 ABI numbers (bit 30 set) carry AUDIT_ARCH_X86_64 but a
+      // different syscall table — deny the whole range
+      BPF_JUMP(BPF_JMP | BPF_JGE | BPF_K, 0x40000000u, 5, 0),
+      BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, nr_execve, 4, 0),
+      BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, nr_fork, 3, 0),
+      BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, nr_vfork, 2, 0),
+      BPF_JUMP(BPF_JMP | BPF_JEQ | BPF_K, nr_execveat, 1, 0),
+      BPF_STMT(BPF_RET | BPF_K, SECCOMP_RET_ALLOW),
+      BPF_STMT(BPF_RET | BPF_K, deny),
+  };
+  struct sock_fprog prog = {
+      (unsigned short)(sizeof(filter) / sizeof(filter[0])), filter};
+  // no_new_privs is a precondition for unprivileged seccomp (and the
+  // reference sets it for defense in depth regardless)
+  if (prctl(PR_SET_NO_NEW_PRIVS, 1, 0, 0, 0) != 0) return -errno;
+  // seccomp(2) with TSYNC applies to ALL existing threads — preferred
+  if (syscall(SYS_seccomp, SECCOMP_SET_MODE_FILTER,
+              SECCOMP_FILTER_FLAG_TSYNC, &prog) == 0)
+    return 0;
+  // prctl fallback (kernel 3.5+): calling thread only
+  if (prctl(PR_SET_SECCOMP, SECCOMP_MODE_FILTER, &prog) == 0) return 1;
+  return -errno;
+}
+
+}  // extern "C"
+#else   // !__linux__
+extern "C" {
+int es_mlockall() { return ENOSYS; }
+int es_install_syscall_filter() { return -ENOSYS; }
+}
+#endif

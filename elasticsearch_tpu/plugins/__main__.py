@@ -1,0 +1,6 @@
+"""``python -m elasticsearch_tpu.plugins`` — the bin/elasticsearch-plugin
+entry (ref: distribution/tools/plugin-cli)."""
+
+from elasticsearch_tpu.plugins import main
+
+raise SystemExit(main())

@@ -1,0 +1,29 @@
+"""analysis-stempel plugin (ref: plugins/analysis-stempel/.../
+AnalysisStempelPlugin.java — registers the ``polish`` analyzer and the
+``polish_stem`` token filter)."""
+
+from elasticsearch_tpu.analysis.analyzers import CustomAnalyzer
+from elasticsearch_tpu.analysis.filters import LowercaseFilter, StopFilter
+from elasticsearch_tpu.analysis.slavic import (
+    POLISH_STOP_WORDS,
+    PolishStemFilter,
+)
+from elasticsearch_tpu.analysis.tokenizers import StandardTokenizer
+from elasticsearch_tpu.plugins import Plugin
+
+
+def _polish_analyzer():
+    return CustomAnalyzer(
+        "polish", StandardTokenizer(),
+        [LowercaseFilter(), StopFilter(POLISH_STOP_WORDS),
+         PolishStemFilter()])
+
+
+class ESPlugin(Plugin):
+    name = "analysis-stempel"
+
+    def token_filters(self):
+        return {"polish_stem": lambda s: PolishStemFilter()}
+
+    def analyzers(self):
+        return {"polish": _polish_analyzer}

@@ -1,0 +1,30 @@
+"""analysis-ukrainian plugin (ref: plugins/analysis-ukrainian/.../
+AnalysisUkrainianPlugin.java — registers the ``ukrainian`` analyzer
+wrapping UkrainianMorfologikAnalyzer)."""
+
+from elasticsearch_tpu.analysis.analyzers import CustomAnalyzer
+from elasticsearch_tpu.analysis.filters import LowercaseFilter, StopFilter
+from elasticsearch_tpu.analysis.slavic import (
+    UKRAINIAN_STOP_WORDS,
+    UkrainianNormalizationFilter,
+    UkrainianStemFilter,
+)
+from elasticsearch_tpu.analysis.tokenizers import StandardTokenizer
+from elasticsearch_tpu.plugins import Plugin
+
+
+def _ukrainian_analyzer():
+    return CustomAnalyzer(
+        "ukrainian", StandardTokenizer(),
+        [UkrainianNormalizationFilter(), LowercaseFilter(),
+         StopFilter(UKRAINIAN_STOP_WORDS), UkrainianStemFilter()])
+
+
+class ESPlugin(Plugin):
+    name = "analysis-ukrainian"
+
+    def token_filters(self):
+        return {"ukrainian_stem": lambda s: UkrainianStemFilter()}
+
+    def analyzers(self):
+        return {"ukrainian": _ukrainian_analyzer}

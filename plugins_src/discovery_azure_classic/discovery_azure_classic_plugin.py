@@ -1,0 +1,15 @@
+"""discovery-azure-classic plugin (ref: plugins/discovery-azure-classic/
+.../AzureSeedHostsProvider.java). Installing registers the "azure" seed
+provider; it activates when discovery.azure.endpoint plus the
+cloud.azure.management.* identifiers are configured."""
+
+from elasticsearch_tpu.cluster import discovery
+from elasticsearch_tpu.plugins import Plugin
+
+
+class ESPlugin(Plugin):
+    name = "discovery-azure-classic"
+
+    def on_load(self):
+        discovery.PLUGIN_SEED_PROVIDERS["azure"] = (
+            discovery.azure_classic_seed_hosts)

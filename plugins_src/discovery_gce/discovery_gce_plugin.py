@@ -1,0 +1,14 @@
+"""discovery-gce plugin (ref: plugins/discovery-gce/.../
+GceSeedHostsProvider.java). Installing registers the "gce" seed
+provider; it activates when discovery.gce.endpoint,
+cloud.gce.project_id and cloud.gce.zone are configured."""
+
+from elasticsearch_tpu.cluster import discovery
+from elasticsearch_tpu.plugins import Plugin
+
+
+class ESPlugin(Plugin):
+    name = "discovery-gce"
+
+    def on_load(self):
+        discovery.PLUGIN_SEED_PROVIDERS["gce"] = discovery.gce_seed_hosts

@@ -1,0 +1,143 @@
+"""analysis-stempel (Polish) + analysis-ukrainian plugins (ref:
+plugins/analysis-stempel/.../AnalysisStempelPlugin.java,
+plugins/analysis-ukrainian/.../AnalysisUkrainianPlugin.java) —
+installable plugins registering the ``polish``/``ukrainian`` analyzers
+and stem filters; stemming is a disclosed algorithmic approximation of
+the reference's table/dictionary stemmers, so tests assert conflation
+classes (inflected forms meeting at one stem), not exact stems."""
+
+import os
+
+import pytest
+
+from elasticsearch_tpu.analysis import analyzers as an
+from elasticsearch_tpu.analysis.slavic import polish_stem, ukrainian_stem
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.plugins import PluginsService
+from elasticsearch_tpu.plugins import main as plugin_cli
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def plugins(tmp_path):
+    pd = str(tmp_path / "plugins")
+    for name in ("analysis_stempel", "analysis_ukrainian"):
+        plugin_cli(["install", os.path.join(REPO_ROOT, "plugins_src", name),
+                    "--plugins-dir", pd])
+    svc = PluginsService(pd)
+    svc.load_all()
+    yield pd
+    for flt in ("polish_stem", "ukrainian_stem"):
+        an._TOKEN_FILTERS.pop(flt, None)
+    for name in ("polish", "ukrainian"):
+        an.PLUGIN_ANALYZERS.pop(name, None)
+
+
+# ---------------------------------------------------------------------------
+# stemmer conflation
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("forms", [
+    # noun declension: 'książka' (book)
+    ["książka", "książki", "książkę", "książkami"],
+    # noun: 'nauczyciel' (teacher)
+    ["nauczyciel", "nauczyciela", "nauczycielem", "nauczycielowi"],
+    # adjective: 'dobry' (good)
+    ["dobry", "dobra", "dobre", "dobrego", "dobremu", "dobrych"],
+    # verb past forms: 'pracować' (to work)
+    ["pracowałem", "pracowałeś", "pracowała", "pracowali"],
+])
+def test_polish_conflation(forms):
+    stems = {polish_stem(w) for w in forms}
+    assert len(stems) == 1, (forms, stems)
+
+
+def test_polish_short_words_untouched():
+    assert polish_stem("do") == "do"
+    assert polish_stem("kot") == "kot"
+
+
+@pytest.mark.parametrize("forms", [
+    # noun: 'книга' (book)
+    ["книга", "книги", "книгу", "книгою", "книгами"],
+    # adjective: 'український' (Ukrainian)
+    ["український", "українського", "українська", "українські"],
+    # verb: 'читати' (to read) incl. reflexive
+    ["читати", "читала", "читали", "читалася"],
+])
+def test_ukrainian_conflation(forms):
+    stems = {ukrainian_stem(w) for w in forms}
+    assert len(stems) == 1, (forms, stems)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through a node
+# ---------------------------------------------------------------------------
+
+
+def test_polish_search_through_node(tmp_path, plugins):
+    node = Node(settings=Settings.from_dict({"path": {"plugins": plugins}}),
+                data_path=str(tmp_path / "data"))
+    try:
+        c = node.rest_controller
+        st, r = c.dispatch("PUT", "/pl", None, {
+            "mappings": {"properties": {
+                "body": {"type": "text", "analyzer": "polish"}}}})
+        assert st == 200, r
+        c.dispatch("PUT", "/pl/_doc/1", None,
+                   {"body": "Nauczyciel czyta książki w bibliotece"})
+        c.dispatch("POST", "/pl/_refresh", None, None)
+        # inflected query form matches the indexed form via stemming
+        st, r = c.dispatch("POST", "/pl/_search", None,
+                           {"query": {"match": {"body": "książka"}}})
+        assert st == 200 and r["hits"]["total"]["value"] == 1
+        # stopwords drop out of the analysis chain
+        st, r = c.dispatch(
+            "GET", "/pl/_analyze", None,
+            {"analyzer": "polish", "text": "w bibliotece"})
+        assert st == 200
+        assert [t["token"] for t in r["tokens"]] == [
+            polish_stem("bibliotece")]
+    finally:
+        node.close()
+
+
+def test_ukrainian_search_through_node(tmp_path, plugins):
+    node = Node(settings=Settings.from_dict({"path": {"plugins": plugins}}),
+                data_path=str(tmp_path / "data"))
+    try:
+        c = node.rest_controller
+        st, r = c.dispatch("PUT", "/uk", None, {
+            "mappings": {"properties": {
+                "body": {"type": "text", "analyzer": "ukrainian"}}}})
+        assert st == 200, r
+        c.dispatch("PUT", "/uk/_doc/1", None,
+                   {"body": "Студенти читали українські книги"})
+        c.dispatch("POST", "/uk/_refresh", None, None)
+        st, r = c.dispatch("POST", "/uk/_search", None,
+                           {"query": {"match": {"body": "книга"}}})
+        assert st == 200 and r["hits"]["total"]["value"] == 1
+        # apostrophe variants normalize: м’яко (U+2019) matches м'яко
+        st, r = c.dispatch(
+            "GET", "/uk/_analyze", None,
+            {"analyzer": "ukrainian", "text": "м’яко"})
+        assert st == 200
+        st2, r2 = c.dispatch(
+            "GET", "/uk/_analyze", None,
+            {"analyzer": "ukrainian", "text": "м'яко"})
+        assert [t["token"] for t in r["tokens"]] == \
+            [t["token"] for t in r2["tokens"]]
+    finally:
+        node.close()
+
+
+def test_stem_filters_usable_in_custom_analyzers(plugins):
+    reg = an.AnalysisRegistry(Settings.from_dict({
+        "analysis": {"analyzer": {"my_pl": {
+            "type": "custom", "tokenizer": "standard",
+            "filter": ["lowercase", "polish_stem"]}}}}))
+    terms = reg.get("my_pl").terms("Książki nauczyciela")
+    assert terms == [polish_stem("książki"), polish_stem("nauczyciela")]

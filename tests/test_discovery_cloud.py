@@ -1,0 +1,236 @@
+"""discovery-gce and discovery-azure-classic seed providers (ref:
+plugins/discovery-gce/.../GceSeedHostsProvider.java,
+plugins/discovery-azure-classic/.../AzureSeedHostsProvider.java)
+against in-process fixtures verifying the real request shapes: the GCE
+metadata-server token dance + Bearer-authorized Compute API list, and
+the Azure Service Management XML with its x-ms-version header."""
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from elasticsearch_tpu.cluster import discovery
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.plugins import PluginsService
+from elasticsearch_tpu.plugins import main as plugin_cli
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GCE_INSTANCES = {
+    "items": [
+        {"name": "es-1", "status": "RUNNING",
+         "tags": {"items": ["elasticsearch", "dev"]},
+         "networkInterfaces": [{"networkIP": "10.240.0.2",
+                                "accessConfigs": [{"natIP": "35.1.1.1"}]}]},
+        {"name": "es-2", "status": "RUNNING",
+         "tags": {"items": ["elasticsearch"]},
+         "networkInterfaces": [{"networkIP": "10.240.0.3"}]},
+        {"name": "db-1", "status": "RUNNING",
+         "tags": {"items": ["postgres"]},
+         "networkInterfaces": [{"networkIP": "10.240.0.9"}]},
+        {"name": "es-stopped", "status": "TERMINATED",
+         "tags": {"items": ["elasticsearch"]},
+         "networkInterfaces": [{"networkIP": "10.240.0.4"}]},
+    ]
+}
+
+AZURE_XML = """<?xml version="1.0" encoding="utf-8"?>
+<HostedService xmlns="http://schemas.microsoft.com/windowsazure">
+ <Deployments>
+  <Deployment>
+   <Name>prod-deploy</Name>
+   <DeploymentSlot>Production</DeploymentSlot>
+   <RoleInstanceList>
+    <RoleInstance>
+     <InstanceName>es-0</InstanceName>
+     <IpAddress>10.0.0.4</IpAddress>
+     <InstanceEndpoints>
+      <InstanceEndpoint><Name>elasticsearch</Name>
+       <Vip>104.40.1.1</Vip><PublicPort>9301</PublicPort>
+      </InstanceEndpoint>
+     </InstanceEndpoints>
+    </RoleInstance>
+    <RoleInstance>
+     <InstanceName>es-1</InstanceName>
+     <IpAddress>10.0.0.5</IpAddress>
+     <InstanceEndpoints>
+      <InstanceEndpoint><Name>elasticsearch</Name>
+       <Vip>104.40.1.2</Vip><PublicPort>9302</PublicPort>
+      </InstanceEndpoint>
+     </InstanceEndpoints>
+    </RoleInstance>
+   </RoleInstanceList>
+  </Deployment>
+  <Deployment>
+   <Name>staging-deploy</Name>
+   <DeploymentSlot>Staging</DeploymentSlot>
+   <RoleInstanceList>
+    <RoleInstance>
+     <InstanceName>es-stg</InstanceName>
+     <IpAddress>10.9.0.1</IpAddress>
+    </RoleInstance>
+   </RoleInstanceList>
+  </Deployment>
+ </Deployments>
+</HostedService>"""
+
+
+class _CloudFixture(BaseHTTPRequestHandler):
+    requests = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        _CloudFixture.requests.append((self.path, dict(self.headers)))
+        if self.path.endswith("/token"):
+            if self.headers.get("Metadata-Flavor") != "Google":
+                self._send(403, b"{}")
+                return
+            self._send(200, json.dumps(
+                {"access_token": "gce-tok-123",
+                 "token_type": "Bearer", "expires_in": 3600}).encode())
+        elif "/zones/" in self.path:
+            if self.headers.get("Authorization") != "Bearer gce-tok-123":
+                self._send(401, b"{}")
+                return
+            self._send(200, json.dumps(GCE_INSTANCES).encode())
+        elif "/services/hostedservices/" in self.path:
+            if not self.headers.get("x-ms-version"):
+                self._send(400, b"missing x-ms-version")
+                return
+            self._send(200, AZURE_XML.encode())
+        else:
+            self._send(404, b"")
+
+    def _send(self, status, body):
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def fixture():
+    srv = HTTPServer(("127.0.0.1", 0), _CloudFixture)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    _CloudFixture.requests.clear()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+@pytest.fixture()
+def providers(tmp_path):
+    pd = str(tmp_path / "plugins")
+    for name in ("discovery_gce", "discovery_azure_classic"):
+        plugin_cli(["install", os.path.join(REPO_ROOT, "plugins_src", name),
+                    "--plugins-dir", pd])
+    svc = PluginsService(pd)
+    svc.load_all()
+    yield svc
+    discovery.PLUGIN_SEED_PROVIDERS.pop("gce", None)
+    discovery.PLUGIN_SEED_PROVIDERS.pop("azure", None)
+
+
+def test_gce_seed_hosts_tag_filter_and_auth(fixture, providers):
+    settings = Settings.from_dict({
+        "cloud": {"gce": {"project_id": "proj-1", "zone": "us-central1-a",
+                          "metadata": {"endpoint": fixture}}},
+        "discovery": {"gce": {"endpoint": fixture,
+                              "tags": "elasticsearch",
+                              "port": 9344}}})
+    seeds = discovery.resolve_seed_hosts(settings=settings)
+    # RUNNING + tagged instances only; the stopped and postgres ones drop
+    assert [(n.host, n.port) for n in seeds] == [
+        ("10.240.0.2", 9344), ("10.240.0.3", 9344)]
+    paths = [p for p, _ in _CloudFixture.requests]
+    assert any(p.endswith("/service-accounts/default/token")
+               for p in paths)
+    assert any("/projects/proj-1/zones/us-central1-a/instances" in p
+               for p in paths)
+    # metadata request carried the required header
+    tok_hdrs = next(h for p, h in _CloudFixture.requests
+                    if p.endswith("/token"))
+    assert tok_hdrs.get("Metadata-Flavor") == "Google"
+
+
+def test_gce_multi_zone_and_unreachable(fixture, providers):
+    settings = Settings.from_dict({
+        "cloud": {"gce": {"project_id": "proj-1",
+                          "zone": "us-central1-a,europe-west1-b",
+                          "metadata": {"endpoint": fixture}}},
+        "discovery": {"gce": {"endpoint": fixture}}})
+    seeds = discovery.resolve_seed_hosts(settings=settings)
+    # no tag filter: all three RUNNING instances; the fixture serves the
+    # same instance list for both zones, so dedup leaves one of each
+    assert len(seeds) == 3
+    paths = [p for p, _ in _CloudFixture.requests]
+    assert any("/zones/us-central1-a/instances" in p for p in paths)
+    assert any("/zones/europe-west1-b/instances" in p for p in paths)
+    bad = Settings.from_dict({
+        "cloud": {"gce": {"project_id": "p", "zone": "z",
+                          "metadata": {"endpoint":
+                                       "http://127.0.0.1:1"}}},
+        "discovery": {"gce": {"endpoint": "http://127.0.0.1:1"}}})
+    assert discovery.resolve_seed_hosts(settings=bad) == []
+
+
+def test_azure_private_ip_production_slot(fixture, providers):
+    settings = Settings.from_dict({
+        "cloud": {"azure": {"management": {
+            "subscription": {"id": "sub-123"},
+            "cloud": {"service": {"name": "my-es"}}}}},
+        "discovery": {"azure": {"endpoint": fixture}}})
+    seeds = discovery.resolve_seed_hosts(settings=settings)
+    # production deployment only; staging's 10.9.0.1 filtered by slot
+    assert [(n.host, n.port) for n in seeds] == [
+        ("10.0.0.4", 9300), ("10.0.0.5", 9300)]
+    path, headers = next((p, h) for p, h in _CloudFixture.requests
+                         if "hostedservices" in p)
+    assert "/sub-123/services/hostedservices/my-es" in path
+    assert "embed-detail=true" in path
+    assert {k.lower(): v for k, v in headers.items()}.get(
+        "x-ms-version") == "2014-10-01"
+
+
+def test_azure_public_ip_endpoint_and_slot_filter(fixture, providers):
+    settings = Settings.from_dict({
+        "cloud": {"azure": {"management": {
+            "subscription": {"id": "sub-123"},
+            "cloud": {"service": {"name": "my-es"}}}}},
+        "discovery": {"azure": {"endpoint": fixture,
+                                "host": {"type": "public_ip"}}}})
+    seeds = discovery.resolve_seed_hosts(settings=settings)
+    # Vip + PublicPort of the 'elasticsearch' instance endpoint
+    assert [(n.host, n.port) for n in seeds] == [
+        ("104.40.1.1", 9301), ("104.40.1.2", 9302)]
+    staging = Settings.from_dict({
+        "cloud": {"azure": {"management": {
+            "subscription": {"id": "sub-123"},
+            "cloud": {"service": {"name": "my-es"}}}}},
+        "discovery": {"azure": {"endpoint": fixture,
+                                "deployment": {"slot": "staging"}}}})
+    seeds = discovery.resolve_seed_hosts(settings=staging)
+    assert [(n.host, n.port) for n in seeds] == [("10.9.0.1", 9300)]
+
+
+def test_both_merge_with_static_seeds(fixture, providers):
+    settings = Settings.from_dict({
+        "discovery": {
+            "seed_hosts": "192.168.7.7:9300",
+            "gce": {"endpoint": fixture, "tags": "elasticsearch"},
+            "azure": {"endpoint": fixture}},
+        "cloud": {
+            "gce": {"project_id": "proj-1", "zone": "us-central1-a",
+                    "metadata": {"endpoint": fixture}},
+            "azure": {"management": {
+                "subscription": {"id": "sub-123"},
+                "cloud": {"service": {"name": "my-es"}}}}}})
+    seeds = discovery.resolve_seed_hosts(settings=settings)
+    hosts = [n.host for n in seeds]
+    assert "192.168.7.7" in hosts          # static
+    assert "10.240.0.2" in hosts           # gce
+    assert "10.0.0.4" in hosts             # azure

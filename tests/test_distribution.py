@@ -1,0 +1,133 @@
+"""Distribution packaging (ref: distribution/ — archives, packages,
+docker): the tar layout boots as an external process through its own
+bin/elasticsearch script reading config/elasticsearch.yml, the plugin
+CLI wrapper works against the unpacked layout, and the deb/rpm/docker
+stagings carry the systemd unit + control metadata."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tarfile
+import time
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu import distribution
+
+
+def test_tar_layout_and_contents(tmp_path):
+    tar_path = distribution.build_tar(str(tmp_path))
+    assert tar_path.endswith("-linux.tar.gz")
+    with tarfile.open(tar_path) as tf:
+        names = tf.getnames()
+    root = f"elasticsearch-tpu-{distribution.VERSION}"
+    for required in (
+            f"{root}/bin/elasticsearch",
+            f"{root}/bin/elasticsearch-plugin",
+            f"{root}/bin/elasticsearch-keystore",
+            f"{root}/bin/elasticsearch-sql-cli",
+            f"{root}/config/elasticsearch.yml",
+            f"{root}/lib/elasticsearch_tpu/__main__.py",
+            f"{root}/lib/elasticsearch_tpu/node.py",
+            f"{root}/plugins_src/analysis_phonetic/plugin.json"):
+        assert required in names, required
+    # bytecode caches do not ship
+    assert not any("__pycache__" in n for n in names)
+
+
+def test_tar_boots_and_serves(tmp_path):
+    """The unpacked archive is a self-sufficient install: its OWN
+    bin/elasticsearch (not the repo checkout) starts a node configured
+    by its OWN config/elasticsearch.yml."""
+    tar_path = distribution.build_tar(str(tmp_path))
+    with tarfile.open(tar_path) as tf:
+        tf.extractall(str(tmp_path / "x"), filter="data")
+    root = str(tmp_path / "x" / f"elasticsearch-tpu-{distribution.VERSION}")
+    # config file feeds settings (cluster.name proves the yml is read)
+    with open(os.path.join(root, "config", "elasticsearch.yml"),
+              "a") as fh:
+        fh.write("\ncluster.name: from-config-file\nhttp.port: 0\n"
+                 "http.native: false\n"
+                 f"path.data: {tmp_path / 'yml-data'}\n")
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [os.path.join(root, "bin", "elasticsearch"), "--quiet"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=str(tmp_path))
+    try:
+        import select
+        deadline = time.time() + 420
+        line = ""
+        while time.time() < deadline:
+            r, _, _ = select.select([proc.stdout], [], [], 5.0)
+            if r:
+                line = proc.stdout.readline()
+                break
+            if proc.poll() is not None:
+                break
+        assert line.startswith("started node="), (
+            line, proc.poll(),
+            proc.stderr.read() if proc.poll() is not None else "")
+        port = int(line.rsplit("port=", 1)[1])
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=30) as resp:
+            root_doc = json.loads(resp.read())
+        assert root_doc["cluster_name"] == "from-config-file"
+        # path.data from the yml is honored (ES_DATA was not set)
+        assert os.path.isdir(str(tmp_path / "yml-data"))
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_plugin_cli_wrapper(tmp_path):
+    root = distribution.stage(str(tmp_path))
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    r = subprocess.run(
+        [os.path.join(root, "bin", "elasticsearch-plugin"), "install",
+         os.path.join(root, "plugins_src", "analysis_phonetic"),
+         "--plugins-dir", str(tmp_path / "pd")],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    r = subprocess.run(
+        [os.path.join(root, "bin", "elasticsearch-plugin"), "list",
+         "--plugins-dir", str(tmp_path / "pd")],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert "analysis-phonetic" in r.stdout
+
+
+def test_deb_staging(tmp_path):
+    pkg = distribution.write_deb(str(tmp_path))
+    control = open(os.path.join(pkg, "DEBIAN", "control")).read()
+    assert "Package: elasticsearch-tpu" in control
+    assert f"Version: {distribution.VERSION}" in control
+    postinst = os.path.join(pkg, "DEBIAN", "postinst")
+    assert os.access(postinst, os.X_OK)
+    unit = open(os.path.join(
+        pkg, "usr", "lib", "systemd", "system",
+        "elasticsearch-tpu.service")).read()
+    assert "Type=notify" in unit            # sd_notify readiness
+    assert "LimitMEMLOCK=infinity" in unit  # bootstrap.memory_lock root
+    assert os.path.exists(os.path.join(
+        pkg, "etc", "elasticsearch-tpu", "elasticsearch.yml"))
+    assert os.path.exists(os.path.join(
+        pkg, "usr", "share", "elasticsearch-tpu", "bin",
+        "elasticsearch"))
+
+
+def test_rpm_and_docker_staging(tmp_path):
+    spec = distribution.write_rpm(str(tmp_path))
+    text = open(spec).read()
+    assert "Name: elasticsearch-tpu" in text
+    assert "%files" in text and "%pre" in text
+    dockerfile = distribution.write_docker(str(tmp_path / "d"))
+    text = open(dockerfile).read()
+    assert "EXPOSE 9200 9300" in text
+    assert "USER 1000:1000" in text         # never root in the image
