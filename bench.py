@@ -474,7 +474,7 @@ def run_secondary(corpus, queries, rng, h):
 # concurrent clients through dispatch(), continuous batching
 # ---------------------------------------------------------------------------
 
-def build_rest_node(corpus, tmpdir):
+def build_rest_node(corpus, tmpdir, kernel="v2m"):
     from elasticsearch_tpu.common.settings import Settings
     from elasticsearch_tpu.index.segment import PostingsField, Segment, StoredFields
     from elasticsearch_tpu.node import Node
@@ -541,7 +541,7 @@ def build_rest_node(corpus, tmpdir):
                                               "1024,2048,4096"),
             "fast_streams": int(os.environ.get("BENCH_FAST_STREAMS", 6)),
             "fast_q_batch": int(os.environ.get("BENCH_FAST_QBATCH", 32)),
-            "fast_kernel": os.environ.get("BENCH_FAST_KERNEL", "v2m"),
+            "fast_kernel": kernel,
             "fast_max_k": K}},
     }), data_path=os.path.join(tmpdir, "node"))
     status, _ = node.rest_controller.dispatch(
@@ -598,7 +598,7 @@ def _loadgen(port, bodies_json, n_conns, total, timeout_ms=600_000,
     return done, qps, lat_ms
 
 
-def run_rest_path(corpus, queries, truth, tmpdir):
+def run_rest_path(corpus, queries, truth, tmpdir, kernel="v2m"):
     import urllib.request
 
     import elasticsearch_tpu.search.batching as batching_mod
@@ -609,7 +609,7 @@ def run_rest_path(corpus, queries, truth, tmpdir):
     plan_mod.MIN_PLAN_BUCKET = int(os.environ.get("BENCH_REST_FLOOR", 1024))
     batching_mod._Q_BUCKETS = (1, 32)
 
-    node, port = build_rest_node(corpus, tmpdir)
+    node, port = build_rest_node(corpus, tmpdir, kernel)
     base = f"http://127.0.0.1:{port}"
     bodies = []
     for q in queries:
@@ -922,10 +922,20 @@ def main():
     # release the raw-kernel corpus copies before the REST path re-uploads
     handles.clear()
 
+    # serving-kernel choice is REGIME-ADAPTIVE: in the tunnel's
+    # degraded mode per-op dispatch dominates, so the low-op-count
+    # monolithic-sort kernel (v1) wins; on an attached TPU device work
+    # dominates and the linear-work merge kernel (v2m) wins — the
+    # round-4 A/B measured both orderings (BASELINE.md round-4 notes).
+    # BENCH_FAST_KERNEL overrides for explicit A/Bs.
+    kernel = os.environ.get("BENCH_FAST_KERNEL") or (
+        "v1" if degrade > 16 else "v2m")
+    log(f"serving kernel: {kernel} (degradation x{degrade:.0f} → "
+        f"{'op-count' if degrade > 16 else 'device-work'}-bound regime)")
     with tempfile.TemporaryDirectory() as tmpdir:
         (rest_qps, p50, p99, rest_recall, warm_recall, avg_batch,
          rest_bool_qps, extra) = run_rest_path(corpus, queries, truth,
-                                               tmpdir)
+                                               tmpdir, kernel)
     # free the text corpus before the 8M×768 slab (23 GiB f32 host)
     del corpus, truth
     knn_txt = ""
@@ -947,7 +957,8 @@ def main():
             f"BM25 top-{K} QPS through the REST product path — REAL "
             f"loopback HTTP against the native C++ front (epoll server, "
             f"C++ body parse + response serialization, exact fused-batch "
-            f"kernel), {CLIENTS} keep-alive connections driven by a C++ "
+            f"kernel, regime-adaptive serving kernel [{kernel}]), "
+            f"{CLIENTS} keep-alive connections driven by a C++ "
             f"epoll loadgen, continuous batching avg {avg_batch:.0f}/"
             f"launch, {N_QUERIES} queries 1-8 terms, synthetic "
             f"{N_DOCS // 1_000_000}M-doc corpus, single chip; p50 "

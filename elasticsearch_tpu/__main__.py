@@ -57,7 +57,12 @@ def main(argv=None) -> int:
     config_path = args.config or (
         os.path.join(os.environ["ES_PATH_CONF"], "elasticsearch.yml")
         if os.environ.get("ES_PATH_CONF") else None)
-    if config_path and os.path.exists(config_path):
+    if config_path:
+        if not os.path.exists(config_path):
+            # an explicitly requested config that doesn't exist is a
+            # hard error (the reference fails on a missing ES_PATH_CONF)
+            log.error("config file [%s] does not exist", config_path)
+            return 78      # EX_CONFIG
         base = Settings.from_yaml_file(config_path).as_dict()
     base.update(flat)              # -E wins over the config file
     settings = Settings(base)

@@ -131,7 +131,7 @@ Version: {version}
 Section: web
 Priority: optional
 Architecture: all
-Depends: python3 (>= 3.10)
+Depends: python3 (>= 3.10), python3-yaml, python3-numpy
 Maintainer: elasticsearch-tpu
 Description: TPU-native distributed search and analytics engine
  Search engine with a JAX/XLA execution core. Layout and service
@@ -163,7 +163,7 @@ Release: 1
 Summary: TPU-native distributed search and analytics engine
 License: Apache-2.0
 BuildArch: noarch
-Requires: python3 >= 3.10
+Requires: python3 >= 3.10, python3-pyyaml, python3-numpy
 
 %description
 Search engine with a JAX/XLA execution core. Layout and service

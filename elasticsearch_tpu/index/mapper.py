@@ -488,6 +488,52 @@ class Murmur3FieldType(MappedFieldType):
         return float(murmur3_hash(str(value)))
 
 
+_ANNOTATION_RE = re.compile(r"\[([^\]\[]*)\]\(([^\)\(]*)\)")
+
+
+def parse_annotated_text(text_plus_markup: str):
+    """``"[John Smith](John%20Smith&Person)"`` → (plain text,
+    [(start, end, [values])]) — the markdown-like annotation syntax of
+    mapper-annotated-text (ref: plugins/mapper-annotated-text/.../
+    AnnotatedTextFieldMapper.java:174-218 AnnotatedText.parse:
+    url-decoded untyped values, ``&``-separated; ``key=value`` pairs
+    are rejected)."""
+    from urllib.parse import unquote
+    plain: List[str] = []
+    plain_len = 0
+    annotations = []
+    last = 0
+    for m in _ANNOTATION_RE.finditer(text_plus_markup):
+        if m.start() > last:
+            seg = text_plus_markup[last:m.start()]
+            plain.append(seg)
+            plain_len += len(seg)
+        start, anchor = plain_len, m.group(1)
+        plain.append(anchor)
+        plain_len += len(anchor)
+        last = m.end()
+        values = []
+        for pair in m.group(2).split("&"):
+            if "=" in pair:
+                raise MapperParsingException(
+                    "key=value pairs are not supported in annotations")
+            if pair:
+                values.append(unquote(pair))
+        if values:
+            annotations.append((start, plain_len, values))
+    plain.append(text_plus_markup[last:])
+    return "".join(plain), annotations
+
+
+class AnnotatedTextFieldType(TextFieldType):
+    """``annotated_text`` — text whose markup injects annotation terms
+    at the anchor's token position (ref: mapper-annotated-text's
+    AnnotationsInjector emitting annotation values as same-position
+    synonym tokens over the anchor span)."""
+
+    type_name = "annotated_text"
+
+
 class SearchAsYouTypeFieldType(TextFieldType):
     """ref: modules/mapper-extras SearchAsYouTypeFieldMapper — a text field
     with shingle subfields ``._2gram`` / ``._3gram`` and an
@@ -547,6 +593,7 @@ FIELD_TYPES = {
         WildcardFieldType, ConstantKeywordFieldType, RankFeatureFieldType,
         RankFeaturesFieldType, TokenCountFieldType, Murmur3FieldType,
         SearchAsYouTypeFieldType, FlattenedFieldType,
+        AnnotatedTextFieldType,
     ]
 }
 
@@ -856,11 +903,35 @@ class DocumentMapper:
             if ft.docvalue_kind == "postings":
                 analyzer = self.analysis.get(ft.analyzer_name) if self.analysis.has(
                     ft.analyzer_name) else self.analysis.default
+                annotations = []
+                if isinstance(ft, AnnotatedTextFieldType):
+                    typed, annotations = parse_annotated_text(typed)
                 toks = parsed.text_tokens.setdefault(ft.name, [])
                 base = toks[-1].position + 100 if toks else 0  # position gap between values
                 new_toks = [Token(t.term, base + t.position, t.start_offset,
                                   t.end_offset) for t in analyzer.analyze(typed)]
                 toks.extend(new_toks)
+                # annotation values become same-position tokens over the
+                # anchor span (ref: AnnotationsInjector — searching the
+                # annotation matches where the anchor text matched);
+                # the appended slice re-sorts by position because the
+                # postings writer expects per-doc positions in order
+                if annotations:
+                    n_text = len(new_toks)
+                    for start, end, ann_values in annotations:
+                        anchor = [t for t in new_toks
+                                  if t.start_offset >= start
+                                  and t.end_offset <= end]
+                        pos = (anchor[0].position if anchor
+                               else (new_toks[-1].position + 1
+                                     if new_toks else base))
+                        toks.extend(Token(v, pos, start, end)
+                                    for v in ann_values)
+                    tail = sorted(toks[len(toks) - n_text
+                                       - sum(len(v) for _, _, v in
+                                             annotations):],
+                                  key=lambda t: t.position)
+                    toks[len(toks) - len(tail):] = tail
                 if isinstance(ft, SearchAsYouTypeFieldType):
                     self._index_shingles(ft, new_toks, parsed)
             elif ft.docvalue_kind == "term":

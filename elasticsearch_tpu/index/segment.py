@@ -450,8 +450,13 @@ class SegmentWriter:
                 for t in ts:
                     if t.position >= max_len:
                         break
-                    toks[docid, t.position] = tindex[t.term]
-                    L = t.position + 1
+                    # first write wins: same-position synonym tokens
+                    # (annotated_text annotation values) must not
+                    # evict the anchor text token from the stream —
+                    # they stay phrase-invisible but postings-searchable
+                    if toks[docid, t.position] < 0:
+                        toks[docid, t.position] = tindex[t.term]
+                    L = max(L, t.position + 1)
                 lengths[docid] = L
             streams[f] = TokenStreams(f, toks, lengths)
 

@@ -122,7 +122,10 @@ def try_mlockall() -> Optional[int]:
     lib = get_lib()
     if lib is None:
         return None
-    lib.es_mlockall.restype = ctypes.c_int
+    try:
+        lib.es_mlockall.restype = ctypes.c_int
+    except AttributeError:
+        return None          # stale cached .so without the symbol
     return int(lib.es_mlockall())
 
 
@@ -136,7 +139,10 @@ def install_system_call_filter() -> Optional[int]:
     lib = get_lib()
     if lib is None:
         return None
-    lib.es_install_syscall_filter.restype = ctypes.c_int
+    try:
+        lib.es_install_syscall_filter.restype = ctypes.c_int
+    except AttributeError:
+        return None          # stale cached .so without the symbol
     return int(lib.es_install_syscall_filter())
 
 

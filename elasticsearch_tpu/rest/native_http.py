@@ -220,12 +220,25 @@ class NativeHttpFront:
             name, sep, val = line.partition(":")
             if sep:
                 headers[name.strip()] = val.strip()
-        content_type = headers.get("Content-Type", "").lower()
+        lower = {k.lower(): v for k, v in headers.items()}
+        content_type = lower.get("content-type", "").lower()
         body = None
         if raw_body:
             if ("x-ndjson" in content_type
                     or url.path.rstrip("/").endswith(("_bulk", "_msearch"))):
                 body = raw_body.decode("utf-8")
+            elif "cbor" in content_type:
+                # binary XContent, same negotiation as the stdlib front
+                # (rest/http_server.py — JDBC/ODBC binary_format)
+                from elasticsearch_tpu.common import cbor
+                try:
+                    body = cbor.loads(raw_body)
+                except (ValueError, TypeError) as e:
+                    self._send(token, 400, {"error": {
+                        "type": "parsing_exception",
+                        "reason": f"Failed to parse request body: {e}"},
+                        "status": 400}, method)
+                    return
             else:
                 try:
                     body = json.loads(raw_body)
@@ -237,9 +250,11 @@ class NativeHttpFront:
                     return
         status, payload = self.controller.dispatch(
             method, url.path, params, body, headers=headers)
-        self._send(token, status, payload, method)
+        self._send(token, status, payload, method,
+                   cbor_ok="cbor" in lower.get("accept", "").lower())
 
-    def _send(self, token: int, status: int, payload, method: str):
+    def _send(self, token: int, status: int, payload, method: str,
+              cbor_ok: bool = False):
         # mirrors rest/http_server.py _Handler._send
         extra = b""
         if isinstance(payload, dict) and "_headers" in payload:
@@ -250,6 +265,10 @@ class NativeHttpFront:
                 and len(payload) == 1:
             data = (payload["_cat"] + "\n").encode()
             ctype = b"text/plain; charset=UTF-8"
+        elif cbor_ok:
+            from elasticsearch_tpu.common import cbor
+            data = cbor.dumps(payload)
+            ctype = b"application/cbor"
         else:
             data = json.dumps(payload).encode()
             ctype = b"application/json; charset=UTF-8"

@@ -26,8 +26,9 @@ cache + rate limits, script/ScriptService.java).
 from __future__ import annotations
 
 import ast
+import re
 import threading
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -146,24 +147,91 @@ _cache_lock = threading.Lock()
 SCRIPT_INTERP_MAX_DOCS = 200_000
 
 
+_DECL_RE = re.compile(
+    r"^(def|double|float)\s+(\w+)\s*=(?!=)\s*(.+)$", re.S)
+_ASSIGN_RE = re.compile(r"^()(\w+)\s*=(?!=)\s*(.+)$", re.S)
+# int/int division truncates in painless (Java semantics) but not in
+# the folded float evaluation — any literal-by-literal / or % bails
+_INT_DIV_RE = re.compile(r"(?<![\w.])\d+\s*[/%]\s*\d+(?![\w.])")
+
+
+def _desugar_straightline(source: str) -> Optional[str]:
+    """Fold a straight-line statement script — local declarations /
+    reassignments followed by ``return expr`` — into ONE expression by
+    symbolic substitution, so it rides the vectorized tier instead of
+    the per-doc interpreter (XLA CSEs any duplicated subexpressions).
+    Returns None when the script has control flow, strings, or any
+    statement shape the fold can't prove safe — those keep the full
+    interpreter semantics."""
+    # string literals (doc['field'] keys) are masked behind \x00N\x00
+    # placeholders so ';' splitting and \b-substitution can never touch
+    # their contents, then restored into the folded expression
+    lits: List[str] = []
+
+    def _mask(m):
+        lits.append(m.group(0))
+        return f"\x00{len(lits) - 1}\x00"
+
+    masked = re.sub(r"'[^'\n]*'|\"[^\"\n]*\"", _mask, source)
+    if "'" in masked or '"' in masked:
+        return None           # unterminated / escaped quoting: bail
+    if _INT_DIV_RE.search(masked):
+        return None           # Java int division truncates; float won't
+    stmts = [s.strip() for s in masked.split(";") if s.strip()]
+    if not stmts or not re.match(r"return\b", stmts[-1]):
+        return None
+    env: Dict[str, str] = {}
+
+    def subst(expr: str) -> str:
+        for name, rep in env.items():
+            expr = re.sub(rf"\b{re.escape(name)}\b", rep, expr)
+        return expr
+
+    has_div = re.search(r"[/%]", masked) is not None
+    for s in stmts[:-1]:
+        m = _DECL_RE.match(s) or _ASSIGN_RE.match(s)
+        if m is None:
+            return None
+        typ, name, expr = m.group(1), m.group(2), m.group(3)
+        if typ == "def" and has_div:
+            return None       # a def local could be int-typed: / or %
+        env[name] = "(" + subst(expr) + ")"
+    ret = stmts[-1][len("return"):].strip()
+    if not ret:
+        return None
+    return re.sub(r"\x00(\d+)\x00", lambda m: lits[int(m.group(1))],
+                  subst(ret))
+
+
 def compile_script(source: str):
     """Parse + validate; returns a callable(ctx) -> array.
 
     Two tiers (the TPU-first inversion of Painless's per-doc bytecode):
-    1. expression scripts compile to COLUMNAR jnp — one fused XLA
-       computation over whole device arrays;
-    2. statement scripts (if/for/while, locals, functions — anything the
-       expression grammar rejects) compile to the full Painless
-       interpreter (script/) and evaluate per matched doc on host.
+    1. expression scripts — including straight-line statement scripts
+       folded by :func:`_desugar_straightline` — compile to COLUMNAR
+       jnp: one fused XLA computation over whole device arrays;
+    2. statement scripts with control flow (if/for/while, functions —
+       anything the expression grammar rejects) compile to the full
+       Painless interpreter (script/) and evaluate per matched doc on
+       host.
     """
     with _cache_lock:
         code = _cache.get(source)
     if code is None:
+        expr_src = source
         try:
-            tree = ast.parse(source, mode="eval")
-            _validate(tree, source)
+            tree = ast.parse(expr_src, mode="eval")
+            _validate(tree, expr_src)
         except (SyntaxError, ScriptException):
-            return _compile_painless_score(source)
+            folded = _desugar_straightline(source)
+            if folded is None:
+                return _compile_painless_score(source)
+            try:
+                expr_src = folded
+                tree = ast.parse(expr_src, mode="eval")
+                _validate(tree, expr_src)
+            except (SyntaxError, ScriptException):
+                return _compile_painless_score(source)
         code = compile(tree, "<script>", "eval")
         with _cache_lock:
             _cache[source] = code

@@ -198,6 +198,30 @@ def test_non_finite_param_rejected(conn):
                     (float("nan"),))
 
 
+def test_driver_against_native_front(tmp_path_factory):
+    """The C++ epoll front negotiates CBOR the same way the stdlib
+    server does (rest/native_http.py mirrors http_server.py)."""
+    n = Node(settings=Settings.from_dict({"http": {"native": "auto"}}),
+             data_path=str(tmp_path_factory.mktemp("jn") / "data"))
+    try:
+        port = n.start(0)
+        if not type(n._http).__name__.startswith("Native"):
+            pytest.skip("native front unavailable on this host")
+        c = n.rest_controller
+        c.dispatch("PUT", "/nf", None, {"mappings": {"properties": {
+            "v": {"type": "integer"}}}})
+        for i in range(5):
+            c.dispatch("PUT", f"/nf/_doc/{i}", None, {"v": i})
+        c.dispatch("POST", "/nf/_refresh", None, None)
+        con = dbapi.connect(host="127.0.0.1", port=port)
+        cur = con.cursor()
+        cur.execute("SELECT v FROM nf WHERE v >= ? ORDER BY v ASC", (3,))
+        assert cur.fetchall() == [[3], [4]]
+        con.close()
+    finally:
+        n.close()
+
+
 def test_errors_surface_as_programming_errors(conn):
     cur = conn.cursor()
     with pytest.raises(dbapi.ProgrammingError):

@@ -268,3 +268,69 @@ def test_flattened_rejects_scalar():
         "labels": {"type": "flattened"}}})
     with pytest.raises(MapperParsingException):
         svc.parse("x", {"labels": "not-an-object"})
+
+
+# ---------------------------------------------------------------------------
+# annotated_text (ref: plugins/mapper-annotated-text/.../
+# AnnotatedTextFieldMapper.java — markdown-like [anchor](value&value)
+# markup; annotation values index as same-position tokens over the
+# anchor so entity searches hit where the anchor text matched)
+# ---------------------------------------------------------------------------
+
+def test_annotated_text_parse():
+    from elasticsearch_tpu.index.mapper import parse_annotated_text
+    plain, anns = parse_annotated_text(
+        "New mayor is [John Smith](John%20Smith&Person) of "
+        "[Boston](Location)")
+    assert plain == "New mayor is John Smith of Boston"
+    assert anns == [(13, 23, ["John Smith", "Person"]),
+                    (27, 33, ["Location"])]
+    # key=value annotations are rejected (ref: AnnotatedText.parse)
+    from elasticsearch_tpu.common.errors import MapperParsingException
+    import pytest as _pytest
+    with _pytest.raises(MapperParsingException):
+        parse_annotated_text("[x](type=person)")
+
+
+def test_annotated_text_search(tmp_path):
+    from elasticsearch_tpu.node import Node
+    node = Node(data_path=str(tmp_path / "ann"))
+    try:
+        c = node.rest_controller
+        st, r = c.dispatch("PUT", "/news", None, {
+            "mappings": {"properties": {
+                "body": {"type": "annotated_text"}}}})
+        assert st == 200, r
+        c.dispatch("PUT", "/news/_doc/1", None, {
+            "body": "New mayor is [John Smith](Person&q42) of the city"})
+        c.dispatch("PUT", "/news/_doc/2", None, {
+            "body": "John Smith went home"})
+        c.dispatch("POST", "/news/_refresh", None, None)
+        # plain text matches both
+        st, r = c.dispatch("POST", "/news/_search", None,
+                           {"query": {"match": {"body": "smith"}}})
+        assert r["hits"]["total"]["value"] == 2
+        # annotation values are single VERBATIM tokens (the injector
+        # bypasses the analyzer chain, ref: AnnotationsInjector) — term
+        # queries hit them exactly, only on the annotated doc
+        st, r = c.dispatch("POST", "/news/_search", None,
+                           {"query": {"term": {"body": "Person"}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+        st, r = c.dispatch("POST", "/news/_search", None,
+                           {"query": {"term": {"body": "person"}}})
+        assert r["hits"]["total"]["value"] == 0     # case-exact
+        # positions survive markup stripping: phrase across the anchor
+        st, r = c.dispatch("POST", "/news/_search", None, {
+            "query": {"match_phrase": {"body": "mayor is john smith"}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+        # annotations are postings-searchable but phrase-invisible
+        # (the positional stream keeps the anchor text token; the
+        # reference's synonym-position tokens would also phrase-match —
+        # disclosed divergence at the stream layer)
+        st, r = c.dispatch("POST", "/news/_search", None, {
+            "query": {"bool": {"must": [
+                {"term": {"body": "q42"}},
+                {"match_phrase": {"body": "john smith of the city"}}]}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+    finally:
+        node.close()

@@ -279,3 +279,49 @@ def test_stored_script_with_statements(node):
             "query": {"match": {"title": "fox"}},
             "script": {"id": "boost-loop"}}}})
     assert r["hits"]["hits"][0]["_score"] == pytest.approx(10.0)
+
+
+def test_straightline_statement_script_vectorizes(node):
+    """Straight-line statement scripts (locals + return, no control
+    flow) FOLD into the vectorized expression tier — one fused XLA
+    computation instead of the per-doc interpreter."""
+    from elasticsearch_tpu.search.script import (_desugar_straightline,
+                                                 compile_script)
+    src = ("double boost = doc['rank'].value * 2; "
+           "double adj = boost + 1.5; return adj * _score;")
+    assert _desugar_straightline(src) == \
+        "((doc['rank'].value * 2) + 1.5) * _score"
+    assert compile_script(src).vectorized is True
+    # control flow still interprets
+    assert compile_script(
+        "double s=0; for (int i=0;i<2;i++){s+=1;} return s;"
+    ).vectorized is False
+    # int/int division must keep Java truncation → interpreter
+    assert compile_script("double a = 7 / 2; return a;").vectorized \
+        is False
+    assert compile_script("int a = 5; return a / 2;").vectorized is False
+    # a def local with division could be int-typed → interpreter
+    assert compile_script(
+        "def a = doc['rank'].value; return a / 2;").vectorized is False
+    # ...but def without division folds
+    assert compile_script(
+        "def a = doc['rank'].value; return a * 2;").vectorized is True
+
+
+def test_straightline_fold_matches_interpreter(node):
+    """The folded script scores identically to the same logic run
+    through the interpreter (loop-free reference form)."""
+    for i, rank in enumerate([5, 2, 8]):
+        call(node, "PUT", f"/idx/_doc/s{i}",
+             {"title": "wolf", "rank": rank}, expect=201)
+    call(node, "POST", "/idx/_refresh")
+    folded = ("double b = doc['rank'].value * 3.0; "
+              "double c = b + 0.25; return c;")
+    r = call(node, "POST", "/idx/_search", {
+        "query": {"script_score": {
+            "query": {"match": {"title": "wolf"}},
+            "script": {"source": folded}}}, "size": 3})
+    hits = r["hits"]["hits"]
+    assert [h["_id"] for h in hits] == ["s2", "s0", "s1"]
+    assert hits[0]["_score"] == pytest.approx(8 * 3.0 + 0.25)
+    assert hits[2]["_score"] == pytest.approx(2 * 3.0 + 0.25)
