@@ -713,6 +713,10 @@ def run_rest_path(corpus, queries, truth, tmpdir, kernel="v2m"):
     # these bodies are NOT C++-fast-parseable, so they measure the full
     # Python serving path (REST dispatch → query DSL → device kernels)
     extra = {}
+    if os.environ.get("BENCH_PRODUCT_ROWS", "1") == "0":
+        node.close()
+        return (best_qps, p50, p99, rest_recall, warm_recall, avg_batch,
+                bool_qps, extra)
 
     def _row(name, bodies, conns, reps, check=None):
         try:
