@@ -84,3 +84,35 @@ def dot_reference(query: np.ndarray, vectors: np.ndarray) -> np.ndarray:
 
 def l2_reference(query: np.ndarray, vectors: np.ndarray) -> np.ndarray:
     return -np.sum((vectors - query[None, :]) ** 2, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Batched kNN nomination: the serving-cohort kernel
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("similarity", "cut"))
+def knn_nominate_batch(queries: jax.Array,      # [Q, D] float32
+                       vectors: jax.Array,      # [ND, D] slab (bf16/f32)
+                       sq_norms: jax.Array,     # [ND] float32 ||v||²
+                       has_value: jax.Array,    # [ND] bool
+                       live: jax.Array,         # [ND] bool (deletes)
+                       similarity: str, cut: int):
+    """One launch for a COHORT of kNN queries: similarity matmul (MXU),
+    ES score transform (cosine/dot → (1+raw)/2, l2 → 1/(1+d²)), missing
+    mask, and per-row top-``cut``. Returns ([Q, cut] scores f32,
+    [Q, cut] docids i32). The serving layer coalesces concurrent knn
+    branches into this instead of one matvec chain per request — the
+    whole cohort pays ONE degraded-launch round trip (the knn analogue
+    of ops/plan.plan_topk_batch)."""
+    if similarity == "cosine":
+        raw = cosine_scores(queries, vectors)
+        scores = (1.0 + raw) / 2.0
+    elif similarity == "dot_product":
+        raw = dot_scores(queries, vectors)
+        scores = (1.0 + raw) / 2.0
+    else:
+        neg_sq = l2_scores(queries, vectors, sq_norms)
+        scores = 1.0 / (1.0 - neg_sq)
+    scores = jnp.where((has_value & live)[None, :], scores, -jnp.inf)
+    top_s, top_i = jax.lax.top_k(scores, cut)
+    return top_s.astype(jnp.float32), top_i.astype(jnp.int32)

@@ -215,3 +215,174 @@ class PlanBatcher:
             "avg_batch": (self.batched_queries / self.launches
                           if self.launches else 0.0),
         }
+
+
+# ---------------------------------------------------------------------------
+# kNN branch batching
+# ---------------------------------------------------------------------------
+
+_CUT_BUCKETS = (128, 256, 512, 1024, 2048, 4096)
+
+
+def _cut_bucket(n: int) -> int:
+    for b in _CUT_BUCKETS:
+        if n <= b:
+            return b
+    return _CUT_BUCKETS[-1]
+
+
+class _KnnEntry:
+    __slots__ = ("qvec", "cut", "event", "result", "error")
+
+    def __init__(self, qvec: np.ndarray, cut: int):
+        self.qvec = qvec
+        self.cut = cut
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
+class KnnBatcher:
+    """Continuous batching for kNN branch launches — the vector
+    analogue of :class:`PlanBatcher`. Concurrent kNN queries against
+    the same device slab coalesce into ONE
+    ``ops.vector.knn_nominate_batch`` launch ([Q, D] matmul + batched
+    top-k) and share a single packed readback; without this every
+    hybrid-RRF request pays its own degraded-mode matvec chain
+    (BASELINE config 5's serving cost). Scores and int32 docids pack
+    into one float32 buffer (bitcast) so the cohort syncs exactly once.
+    """
+
+    def __init__(self, max_batch: int = 64, max_concurrent: int = 8):
+        self.max_batch = max_batch
+        self._lock = threading.Lock()
+        self._launch_slots = threading.BoundedSemaphore(max_concurrent)
+        self._pending: Dict[tuple, List[_KnnEntry]] = {}
+        self.launches = 0
+        self.batched_queries = 0
+        self._lat_ema = 0.0
+
+    def topk(self, dv, live, qvec: np.ndarray, cut: int,
+             host_vectors=None) -> Tuple[np.ndarray, np.ndarray]:
+        """Top-``cut`` (scores, docids) for one query vector against a
+        DeviceVectors slab, honoring the segment's device ``live`` mask
+        (deletes). ``host_vectors`` (the segment's f32 host copy)
+        enables the exact re-rank when the slab is quantized
+        (KnnQuery._exact_rerank parity). The cut caps at the slab's
+        padded row count — lax.top_k cannot exceed the axis."""
+        nd = int(dv.vectors.shape[0])
+        bucket_cut = min(_cut_bucket(cut), nd)
+        sig = (id(dv.vectors), id(live), dv.similarity, bucket_cut,
+               int(qvec.shape[0]))
+        entry = _KnnEntry(np.asarray(qvec, np.float32), cut)
+        with self._lock:
+            q = self._pending.setdefault(sig, [])
+            q.append(entry)
+            leader = len(q) == 1
+        if not leader:
+            entry.event.wait()
+            if entry.error is not None:
+                raise entry.error
+            return self._finish(entry, dv, host_vectors)
+        if self._lat_ema > 0.03:
+            deadline = time.monotonic() + min(0.75 * self._lat_ema, 1.5)
+            while time.monotonic() < deadline:
+                with self._lock:
+                    mine = len(self._pending.get(sig, ()))
+                    busy = (mine > 1 or len(self._pending) > 1
+                            or any(len(qq) > 1
+                                   for qq in self._pending.values()))
+                if mine >= self.max_batch or not busy:
+                    break
+                time.sleep(0.02)
+        with self._launch_slots:
+            with self._lock:
+                batch = self._pending.pop(sig, [])
+            if not batch:
+                batch = [entry]
+            try:
+                for start in range(0, len(batch), self.max_batch):
+                    self._run(batch[start:start + self.max_batch], dv,
+                              live, bucket_cut)
+            except BaseException as exc:
+                for e in batch:
+                    if not e.event.is_set():
+                        e.error = exc
+                        e.event.set()
+                raise
+        if entry.error is not None:
+            raise entry.error
+        return self._finish(entry, dv, host_vectors)
+
+    # ------------------------------------------------------------------
+    def _run(self, batch: List[_KnnEntry], dv, live, cut: int):
+        from elasticsearch_tpu.ops import vector as vec_ops
+        import jax
+        # the cohort's [Qb, ND] float32 score matrix must fit next to
+        # the slab (an 8M-doc slab already holds ~11.5 GiB of HBM) —
+        # cap Qb so the ephemeral stays ≤ ~1 GiB
+        nd = int(dv.vectors.shape[0])
+        cap = max(1, (1 << 28) // max(nd, 1))
+        allowed = max((b for b in _Q_BUCKETS if b <= cap), default=1)
+        for start in range(0, len(batch), allowed):
+            chunk = batch[start:start + allowed]
+            qn = len(chunk)
+            bucket = min(_q_bucket(qn), allowed)
+            qs = np.stack([e.qvec for e in chunk]
+                          + [chunk[0].qvec] * (bucket - qn))
+            t0 = time.monotonic()
+            top_s, top_i = vec_ops.knn_nominate_batch(
+                jnp.asarray(qs), dv.vectors, dv.sq_norms, dv.has_value,
+                live, dv.similarity, cut)
+            # ONE packed readback: ids bitcast into the float buffer
+            packed = jnp.concatenate(
+                [top_s,
+                 jax.lax.bitcast_convert_type(top_i, jnp.float32)],
+                axis=1)
+            rows = np.asarray(packed)
+            dt = time.monotonic() - t0
+            with self._lock:
+                if dt < 5.0:
+                    self._lat_ema = (dt if self._lat_ema == 0.0
+                                     else 0.8 * self._lat_ema + 0.2 * dt)
+                self.launches += 1
+                self.batched_queries += qn
+            for i, e in enumerate(chunk):
+                scores = rows[i, :cut].copy()
+                ids = rows[i, cut:].view(np.int32).copy()
+                e.result = (scores, ids)
+                e.event.set()
+
+    # ------------------------------------------------------------------
+    def _finish(self, entry: _KnnEntry, dv,
+                host_vectors) -> Tuple[np.ndarray, np.ndarray]:
+        scores, ids = entry.result
+        ok = np.isfinite(scores)
+        scores, ids = scores[ok], ids[ok]
+        if dv.vectors.dtype != jnp.float32 and host_vectors is not None:
+            # exact f32 re-rank of the nominated candidates
+            # (KnnQuery._exact_rerank parity: bf16 only NOMINATES)
+            valid = ids < host_vectors.shape[0]
+            scores, ids = scores[valid], ids[valid]
+            cand = host_vectors[ids].astype(np.float32)
+            q32 = entry.qvec.astype(np.float32)
+            if dv.similarity == "cosine":
+                nrm = (np.linalg.norm(cand, axis=1)
+                       * np.linalg.norm(q32))
+                raw = cand @ q32 / np.where(nrm > 0, nrm, 1.0)
+                scores = (1.0 + raw) / 2.0
+            elif dv.similarity == "dot_product":
+                scores = (1.0 + cand @ q32) / 2.0
+            else:
+                d2 = np.sum((cand - q32[None, :]) ** 2, axis=1)
+                scores = 1.0 / (1.0 + d2)
+        order = np.lexsort((ids, -scores))[: entry.cut]
+        return scores[order], ids[order]
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "knn_launches": self.launches,
+            "knn_batched_queries": self.batched_queries,
+            "knn_avg_batch": (self.batched_queries / self.launches
+                              if self.launches else 0.0),
+        }

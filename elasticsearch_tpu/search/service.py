@@ -23,6 +23,9 @@ from elasticsearch_tpu.common.errors import (
     IllegalArgumentException,
     SearchContextMissingException,
 )
+import jax.numpy as jnp
+import numpy as np
+
 from elasticsearch_tpu.common.settings import parse_time_value
 from elasticsearch_tpu.index.service import IndexService, IndicesService
 from elasticsearch_tpu.search.queries import MatchAllQuery, parse_query
@@ -168,8 +171,10 @@ class SearchService:
         # continuous batching of plan-path launches: concurrent requests
         # with the same kernel shape share one vmapped device launch
         # (SURVEY.md §7 hard part 5; search/batching.py)
-        from elasticsearch_tpu.search.batching import PlanBatcher
+        from elasticsearch_tpu.search.batching import (KnnBatcher,
+                                                       PlanBatcher)
         self.plan_batcher = PlanBatcher()
+        self.knn_batcher = KnnBatcher()
         # mesh-sharded execution: multi-shard indices with enough devices
         # run one SPMD fan-out/merge program instead of the per-shard loop
         # (ref: TransportSearchAction scatter-gather → shard_map +
@@ -302,6 +307,16 @@ class SearchService:
             self._after_search(names, response["took"], body)
             return response
         if body and body.get("knn") is not None:
+            # pure top-level kNN with an ids+scores-only response rides
+            # the batched cohort kernel (BASELINE config 4's serving
+            # shape: {"knn": ..., "_source": false}); anything richer
+            # merges into the query and takes the dense path
+            pure = (self._pure_knn_search(searchers, body)
+                    if scroll is None else None)
+            if pure is not None:
+                pure["took"] = int((time.monotonic() - start) * 1000)
+                self._after_search(names, pure["took"], body)
+                return pure
             body = _merge_knn_into_query(body)
 
         scroll_ctx = None
@@ -349,6 +364,120 @@ class SearchService:
                 out.append(None)
         return tuple(out)
 
+    def _pure_knn_search(self, searchers, body: Dict[str, Any]):
+        """Body-level gate + execution for a batched pure-kNN search
+        (single top-level knn section, no query, response carries only
+        ids+scores). Returns a full response dict, or None → caller
+        takes the dense merged-query path (which supports everything)."""
+        if body.get("query") is not None \
+                or body.get("_source", True) is not False:
+            return None
+        if any(body.get(x) for x in (
+                "aggs", "aggregations", "sort", "post_filter",
+                "highlight", "min_score", "search_after", "fields",
+                "suggest", "collapse", "rescore", "slice",
+                "track_total_hits", "docvalue_fields",
+                "stored_fields", "script_fields", "pit",
+                "version", "seq_no_primary_term", "profile",
+                "terminate_after", "explain")):
+            return None
+        if int(body.get("from", 0) or 0) != 0:
+            return None
+        clauses = _knn_clauses(body["knn"])
+        if len(clauses) != 1:
+            return None
+        spec = clauses[0]["knn"]
+        size = int(body.get("size", DEFAULT_SIZE))
+        # the candidate cut mirrors KnnQuery: k or num_candidates
+        cut = spec.get("k") or spec.get("num_candidates")
+        window = min(int(cut), size) if cut else size
+        hits = self._knn_branch_hits(searchers, spec, window)
+        if hits is None:
+            return None
+        name, searcher = searchers[0]
+        seg = searcher.segments[0]
+        field = spec.get("field")
+        vv = seg.vectors.get(field)
+        n_match = 0
+        if vv is not None:
+            live_ver = getattr(seg, "live_version", None)
+            cached = getattr(vv, "_n_live_value", None)
+            if cached is not None and cached[0] == live_ver:
+                n_match = cached[1]
+            else:
+                hv = vv.has_value
+                n_match = int(np.count_nonzero(
+                    hv & seg.live[: len(hv)]))
+                try:
+                    vv._n_live_value = (live_ver, n_match)
+                except Exception:
+                    pass
+        total = min(int(cut), n_match) if cut else n_match
+        return {
+            "timed_out": False,
+            "_shards": {"total": 1, "successful": 1, "skipped": 0,
+                        "failed": 0},
+            "hits": {"total": {"value": total, "relation": "eq"},
+                     "max_score": (hits[0]["_score"] if hits else None),
+                     "hits": hits},
+        }
+
+    def _knn_branch_hits(self, searchers, spec: Dict[str, Any],
+                         window: int):
+        """Serve a pure top-level kNN branch through the batched cohort
+        kernel (batching.KnnBatcher → ops.vector.knn_nominate_batch):
+        concurrent hybrid requests share one matmul+top-k launch
+        instead of one dense matvec chain each. Returns the branch's
+        hit dicts, or None when the shape isn't batchable (filters,
+        multi-shard, multi-segment, missing field) — the caller falls
+        back to the dense per-request path, which handles everything."""
+        if spec.get("filter") is not None or len(searchers) != 1:
+            return None
+        name, searcher = searchers[0]
+        if (not hasattr(searcher, "_contexts")
+                or len(getattr(searcher, "segments", ())) != 1):
+            return None
+        try:
+            ctx = searcher._contexts()[0]
+        except Exception:
+            return None
+        field = spec.get("field")
+        dv = ctx.device.vectors.get(field) if field else None
+        if dv is None or dv.similarity not in ("cosine", "dot_product",
+                                               "l2_norm"):
+            return None
+        qvec = np.asarray(spec.get("query_vector", ()), np.float32)
+        if qvec.ndim != 1 or not qvec.size:
+            return None
+        from elasticsearch_tpu.search.batching import _CUT_BUCKETS
+        k = spec.get("k")
+        nc = spec.get("num_candidates")
+        cut = min(int(k or nc or window), window)
+        if dv.vectors.dtype != jnp.float32:
+            # quantized slab: nominate the full num_candidates before
+            # the exact re-rank, then trim to the window
+            cut = max(cut, min(int(nc or 3 * (k or 1000)),
+                               ctx.n_docs_padded))
+        if cut > _CUT_BUCKETS[-1]:
+            # beyond the batched kernel's bucket table the launch would
+            # silently truncate — the dense path handles any cut
+            return None
+        seg = ctx.segment
+        host_vv = seg.vectors.get(field) if hasattr(seg, "vectors") \
+            else None
+        scores, ids = self.knn_batcher.topk(
+            dv, ctx.device.live, qvec, cut,
+            host_vectors=host_vv.vectors if host_vv is not None
+            else None)
+        n_docs = seg.n_docs
+        hits = []
+        for s, i in zip(scores[:window], ids[:window]):
+            if i < 0 or i >= n_docs or not np.isfinite(s):
+                continue
+            hits.append({"_index": name, "_id": seg.stored.ids[int(i)],
+                         "_score": float(s)})
+        return hits
+
     def _rrf_search(self, searchers, body: Dict[str, Any],
                     task) -> Dict[str, Any]:
         """Reciprocal rank fusion over the query and knn branches
@@ -379,17 +508,33 @@ class SearchService:
         best_hit: Dict[Tuple[str, str], Dict[str, Any]] = {}
         truncated = False
         aggregations = None
+        wants_source = passthrough.get("_source", True) is not False
         for bi, br in enumerate(branches):
-            sub = {**passthrough, **br, "size": window}
-            if bi == 0:
-                # aggs compute once, over the first (query) branch
-                for agg_key in ("aggs", "aggregations"):
-                    if agg_key in body:
-                        sub[agg_key] = body[agg_key]
-            r = self._execute(searchers, sub, task=task)
-            if bi == 0 and "aggregations" in r:
-                aggregations = r["aggregations"]
-            hits = r["hits"]["hits"]
+            # pure-knn branches ride the batched cohort kernel when the
+            # response needs only ids+scores from them (the RRF fusion
+            # itself) — everything else falls through to _execute
+            hits = None
+            if (isinstance(br.get("query"), dict)
+                    and set(br["query"].keys()) == {"knn"}
+                    and not wants_source
+                    and not any(passthrough.get(x) for x in
+                                ("highlight", "post_filter", "min_score",
+                                 "fields"))
+                    and not (bi == 0 and ("aggs" in body
+                                          or "aggregations" in body))):
+                hits = self._knn_branch_hits(searchers,
+                                             br["query"]["knn"], window)
+            if hits is None:
+                sub = {**passthrough, **br, "size": window}
+                if bi == 0:
+                    # aggs compute once, over the first (query) branch
+                    for agg_key in ("aggs", "aggregations"):
+                        if agg_key in body:
+                            sub[agg_key] = body[agg_key]
+                r = self._execute(searchers, sub, task=task)
+                if bi == 0 and "aggregations" in r:
+                    aggregations = r["aggregations"]
+                hits = r["hits"]["hits"]
             if len(hits) >= window:
                 truncated = True
             for rank_i, h in enumerate(hits):
