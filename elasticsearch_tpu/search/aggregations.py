@@ -41,11 +41,13 @@ METRIC_AGGS = {"avg", "sum", "min", "max", "value_count", "stats",
                "geo_bounds", "geo_centroid", "scripted_metric",
                # x-pack analytics + aggs-matrix-stats parity
                "boxplot", "top_metrics", "string_stats", "matrix_stats",
-               "median_absolute_deviation"}
+               "median_absolute_deviation", "t_test"}
 BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "filter",
                "filters", "missing", "global", "composite", "nested",
-               "significant_terms", "sampler", "diversified_sampler",
-               "adjacency_matrix", "auto_date_histogram",
+               "significant_terms", "significant_text", "sampler",
+               "diversified_sampler", "rare_terms", "multi_terms",
+               "adjacency_matrix", "auto_date_histogram", "ip_range",
+               "variable_width_histogram",
                "geo_distance", "geohash_grid", "geotile_grid"}
 PIPELINE_AGGS = {"avg_bucket", "sum_bucket", "min_bucket", "max_bucket",
                  "stats_bucket", "cumulative_sum", "derivative",
@@ -398,6 +400,60 @@ def _metric(agg_type, body, ctx, mapper):
         # the exact distinct set travels internally for
         # cumulative_cardinality (stripped from the response)
         return {"value": len(distinct), "_set": distinct}
+
+    if agg_type == "t_test":
+        # ref: x-pack analytics TTestAggregator — paired /
+        # homoscedastic / heteroscedastic (Welch, the default) two-
+        # sided p-value over two numeric value sources, each with an
+        # optional per-source filter (the A/B-test shape)
+        ttype = str(body.get("type", "heteroscedastic"))
+        if ttype not in ("paired", "homoscedastic", "heteroscedastic"):
+            raise ParsingException(
+                f"unsupported t_test type [{ttype}]; expected one of "
+                "[paired, homoscedastic, heteroscedastic]")
+        a_spec, b_spec = body.get("a") or {}, body.get("b") or {}
+
+        def _source_ctx(spec):
+            if spec.get("filter") is None:
+                return ctx
+            from elasticsearch_tpu.search.queries import parse_query
+            q = parse_query(spec["filter"])
+            return _refine(ctx, _query_masks(q, ctx, mapper))
+
+        from scipy import stats as _st
+        if ttype == "paired":
+            if (a_spec.get("filter") is not None
+                    or b_spec.get("filter") is not None):
+                raise ParsingException(
+                    "paired t_test does not support filters")
+            # pairs are WITHIN a document: both fields present
+            xa_parts, xb_parts = [], []
+            for seg, mask, _m in ctx:
+                va, ma = _first_values_and_mask(seg, mask,
+                                                a_spec.get("field"))
+                vb, mb = _first_values_and_mask(seg, mask,
+                                                b_spec.get("field"))
+                if va is None or vb is None:
+                    continue
+                both = ma & mb
+                xa_parts.append(va[both])
+                xb_parts.append(vb[both])
+            xa = np.concatenate(xa_parts) if xa_parts else np.zeros(0)
+            xb = np.concatenate(xb_parts) if xb_parts else np.zeros(0)
+            if len(xa) < 2:
+                return {"value": None}
+            res = _st.ttest_rel(xa, xb)
+        else:
+            xa = _numeric_values(_source_ctx(a_spec),
+                                 a_spec.get("field"))
+            xb = _numeric_values(_source_ctx(b_spec),
+                                 b_spec.get("field"))
+            if len(xa) < 2 or len(xb) < 2:
+                return {"value": None}
+            res = _st.ttest_ind(xa, xb,
+                                equal_var=(ttype == "homoscedastic"))
+        p = float(res.pvalue)
+        return {"value": None if np.isnan(p) else p}
 
     if agg_type == "median_absolute_deviation":
         # ref: x-pack/plugin/analytics MedianAbsoluteDeviationAggregator
@@ -931,7 +987,293 @@ def _significant_terms(body, sub, ctx, mapper):
             "buckets": buckets}
 
 
+def _rare_terms(body, sub, ctx, mapper):
+    """ref: bucket/terms/rare/RareTermsAggregator — the long tail:
+    terms whose doc count is at most ``max_doc_count`` (default 1),
+    ordered ascending by count then key. The reference bounds memory
+    with a bloom filter; the columnar ord counts here are exact."""
+    field = body.get("field")
+    max_dc = int(body.get("max_doc_count", 1))
+    if not 1 <= max_dc <= 100:
+        raise ParsingException(
+            "[max_doc_count] must be in [1, 100]")
+    counts = _keyword_terms_counts(ctx, field)
+    rare = sorted(((c, t) for t, c in counts.items() if c <= max_dc))
+    buckets = []
+    for c, term in rare:
+        # membership refinement costs a full ord scan per term — only
+        # pay it when sub-aggregations actually consume the bucket ctx
+        bucket_ctx = (_refine(
+            ctx, [_keyword_membership_mask(seg, field, term)
+                  for seg, _m2, _m3 in ctx]) if sub else ctx)
+        buckets.append(_bucket_result(sub, bucket_ctx, mapper, c,
+                                      {"key": term}))
+    _apply_parent_pipelines(_split_parent_pipelines(sub)[1], buckets)
+    return {"buckets": buckets}
+
+
+def _multi_terms(body, sub, ctx, mapper):
+    """ref: bucket/terms/MultiTermsAggregator — compound keys over
+    several value sources, counted like `terms` (first value per doc
+    per source, the reference's default for single-valued use)."""
+    terms_spec = body.get("terms") or []
+    if len(terms_spec) < 2:
+        raise ParsingException(
+            "multi_terms requires at least two terms sources")
+    size = int(body.get("size", 10))
+    fields = [t.get("field") for t in terms_spec]
+    counts: Dict[tuple, int] = {}
+    seg_rows = []
+    for seg, mask, _m in ctx:
+        docs = np.nonzero(mask[: seg.n_docs])[0]
+        cols = []
+        for f in fields:
+            kv = seg.keywords.get(f)
+            if kv is not None:
+                first_pos = kv.offsets[:-1][docs]
+                has = np.diff(kv.offsets)[docs] > 0
+                vals = np.where(
+                    has,
+                    np.asarray(kv.all_ords, np.int64)[
+                        np.minimum(first_pos, len(kv.all_ords) - 1)],
+                    -1)
+                cols.append(("k", kv, vals, has))
+                continue
+            nv = seg.numerics.get(f)
+            if nv is not None:
+                has = ~nv.missing[docs]
+                cols.append(("n", None, nv.values[docs], has))
+                continue
+            cols.append(("x", None, np.full(len(docs), -1),
+                         np.zeros(len(docs), bool)))
+        seg_rows.append((seg, docs, cols))
+        valid = np.ones(len(docs), bool)
+        for _, _, _, has in cols:
+            valid &= has
+        if not valid.any():
+            continue
+        # vectorized compound counting: stack the per-source code
+        # columns (segment-local ords / numeric values), unique the
+        # ROWS with counts, and materialize string keys only for the
+        # distinct combinations (no per-doc Python — the file's
+        # columnar convention)
+        mat = np.stack([np.asarray(vals, np.float64)[valid]
+                        for _k, _kv, vals, _h in cols], axis=1)
+        uniq_rows, row_counts = np.unique(mat, axis=0,
+                                          return_counts=True)
+        for row, rc in zip(uniq_rows, row_counts):
+            key = tuple(
+                kv.terms[int(row[j])] if kind == "k" else float(row[j])
+                for j, (kind, kv, _v, _h) in enumerate(cols))
+            counts[key] = counts.get(key, 0) + int(rc)
+    top = sorted(counts.items(), key=lambda kv_: (-kv_[1], kv_[0]))[:size]
+    buckets = []
+    for key, c in top:
+        submasks = []
+        for seg, docs, cols in seg_rows:
+            m = np.zeros(seg.n_docs, bool)
+            valid = np.ones(len(docs), bool)
+            for (kind, kv, vals, has), want in zip(cols, key):
+                if kind == "k":
+                    tid = (kv.terms.index(want)
+                           if want in kv.terms else -2)
+                    valid &= has & (vals == tid)
+                else:
+                    valid &= has & (vals == want)
+            m[docs[valid]] = True
+            submasks.append(m)
+        buckets.append(_bucket_result(
+            sub, _refine(ctx, submasks), mapper, c,
+            {"key": list(key),
+             "key_as_string": "|".join(str(k) for k in key)}))
+    _apply_parent_pipelines(_split_parent_pipelines(sub)[1], buckets)
+    return {"buckets": buckets,
+            "doc_count_error_upper_bound": 0,
+            "sum_other_doc_count": max(0, sum(counts.values())
+                                       - sum(c for _, c in top))}
+
+
+def _significant_text(body, sub, ctx, mapper):
+    """ref: bucket/significant/SignificantTextAggregator — re-analyzes
+    the text of (a sample of) matched docs, scoring terms JLH against
+    the index background (doc_freq from the inverted index). Like the
+    reference, sub-aggregations are not supported."""
+    if sub:
+        raise ParsingException(
+            "significant_text does not support sub-aggregations")
+    field = body.get("field")
+    size = int(body.get("size", 10))
+    min_doc_count = int(body.get("min_doc_count", 3))
+    shard_size = int(body.get("shard_size", 200))
+    filter_dup = bool(body.get("filter_duplicate_text", False))
+    import json as _json
+
+    from elasticsearch_tpu.analysis import AnalysisRegistry
+    analysis = getattr(mapper, "analysis", None) or AnalysisRegistry()
+    # the FIELD's analyzer, not the default — fg terms must live in the
+    # same term space as the background postings (the index chain)
+    analyzer = analysis.default
+    try:
+        ft = mapper.field_type(field)
+        name = getattr(ft, "analyzer_name", None)
+        if name and analysis.has(name):
+            analyzer = analysis.get(name)
+    except Exception:
+        pass
+    fg_counts: Dict[str, int] = {}
+    fg_total = 0
+    bg_df: Dict[str, int] = {}
+    bg_total = 0
+    seen_text = set()
+    for seg, mask, _m in ctx:
+        pf = seg.postings.get(field)
+        if pf is not None:
+            for t, df in zip(pf.terms, pf.doc_freq):
+                bg_df[t] = bg_df.get(t, 0) + int(df)
+        bg_total += int(seg.live.sum())
+        docs = np.nonzero(mask[: seg.n_docs])[0][:shard_size]
+        for d in docs:
+            try:
+                src = _json.loads(seg.stored.source(int(d)))
+            except Exception:
+                continue
+            text = src.get(field)
+            if not isinstance(text, str):
+                continue
+            if filter_dup:
+                h = hash(text)
+                if h in seen_text:
+                    continue
+                seen_text.add(h)
+            fg_total += 1
+            for term in set(analyzer.terms(text)):
+                fg_counts[term] = fg_counts.get(term, 0) + 1
+    scored = []
+    for term, fg in fg_counts.items():
+        if fg < min_doc_count:
+            continue
+        bg = bg_df.get(term, fg)
+        fg_rate = fg / max(fg_total, 1)
+        bg_rate = bg / max(bg_total, 1)
+        if fg_rate <= bg_rate:
+            continue
+        score = (fg_rate - bg_rate) * (fg_rate / max(bg_rate, 1e-12))
+        scored.append((score, term, fg, bg))
+    scored.sort(key=lambda t: (-t[0], t[1]))
+    return {"doc_count": fg_total, "bg_count": bg_total,
+            "buckets": [{"key": term, "doc_count": fg, "score": score,
+                         "bg_count": bg}
+                        for score, term, fg, bg in scored[:size]]}
+
+
+def _variable_width_histogram(body, sub, ctx, mapper):
+    """ref: bucket/histogram/VariableWidthHistogramAggregator — numeric
+    values cluster into at most ``buckets`` variable-width buckets.
+    The reference clusters online per shard then merges; here the
+    columnar values cluster in one pass (quantile seeding + one k-means
+    refinement), which converges to the same shape on settled data."""
+    field = body.get("field")
+    target = int(body.get("buckets", 10))
+    values = np.sort(_numeric_values(ctx, field))
+    if values.size == 0:
+        return {"buckets": []}
+    uniq = np.unique(values)
+    k = min(target, len(uniq))
+    # quantile seeds → one Lloyd pass over the sorted values
+    centroids = np.quantile(values, (np.arange(k) + 0.5) / k)
+    for _ in range(2):
+        bounds = (centroids[:-1] + centroids[1:]) / 2.0
+        assign = np.searchsorted(bounds, values)
+        centroids = np.array([
+            values[assign == i].mean() if (assign == i).any()
+            else centroids[i] for i in range(k)])
+    bounds = (centroids[:-1] + centroids[1:]) / 2.0
+    buckets = []
+    for i in range(k):
+        lo = -np.inf if i == 0 else bounds[i - 1]
+        hi = np.inf if i == k - 1 else bounds[i]
+        submasks = []
+        count = 0
+        bmin, bmax = None, None
+        for seg, mask, _m in ctx:
+            vv, m = _first_values_and_mask(seg, mask, field)
+            if vv is None:
+                submasks.append(np.zeros(seg.n_docs, bool))
+                continue
+            in_b = m & (vv >= lo) & (vv < hi) if i < k - 1 \
+                else m & (vv >= lo)
+            submasks.append(in_b)
+            count += int(in_b.sum())
+            if in_b.any():
+                lo_v, hi_v = float(vv[in_b].min()), float(vv[in_b].max())
+                bmin = lo_v if bmin is None else min(bmin, lo_v)
+                bmax = hi_v if bmax is None else max(bmax, hi_v)
+        if count == 0:
+            continue
+        buckets.append(_bucket_result(
+            sub, _refine(ctx, submasks), mapper, count,
+            {"key": float(centroids[i]), "min": bmin, "max": bmax}))
+    _apply_parent_pipelines(_split_parent_pipelines(sub)[1], buckets)
+    return {"buckets": buckets}
+
+
+def _ip_range(body, sub, ctx, mapper):
+    """ref: bucket/range/ip/IpRangeAggregator — ranges (or CIDR masks)
+    over an ip field; the numeric ip doc values make each range a
+    vectorized bound check."""
+    import ipaddress
+    field = body.get("field")
+    buckets = []
+    for r in body.get("ranges", []):
+        if "mask" in r:
+            net = ipaddress.ip_network(r["mask"], strict=False)
+            frm = float(int(net.network_address))
+            to = float(int(net.broadcast_address)) + 1.0
+            key = r.get("key", r["mask"])
+        else:
+            frm = (float(int(ipaddress.ip_address(r["from"])))
+                   if r.get("from") is not None else None)
+            to = (float(int(ipaddress.ip_address(r["to"])))
+                  if r.get("to") is not None else None)
+            key = r.get("key",
+                        f"{r.get('from', '*')}-{r.get('to', '*')}")
+        submasks = []
+        count = 0
+        for seg, mask, _m in ctx:
+            vv, m = _first_values_and_mask(seg, mask, field)
+            if vv is None:
+                submasks.append(np.zeros(seg.n_docs, bool))
+                continue
+            in_r = m.copy()
+            if frm is not None:
+                in_r &= vv >= frm
+            if to is not None:
+                in_r &= vv < to
+            submasks.append(in_r)
+            count += int(in_r.sum())
+        extra = {"key": key}
+        if "mask" in r:
+            extra["mask"] = r["mask"]
+        if r.get("from") is not None:
+            extra["from"] = r["from"]
+        if r.get("to") is not None:
+            extra["to"] = r["to"]
+        buckets.append(_bucket_result(sub, _refine(ctx, submasks),
+                                      mapper, count, extra))
+    return {"buckets": buckets}
+
+
 def _bucket(agg_type, body, sub, ctx, mapper):
+    if agg_type == "rare_terms":
+        return _rare_terms(body, sub, ctx, mapper)
+    if agg_type == "multi_terms":
+        return _multi_terms(body, sub, ctx, mapper)
+    if agg_type == "significant_text":
+        return _significant_text(body, sub, ctx, mapper)
+    if agg_type == "variable_width_histogram":
+        return _variable_width_histogram(body, sub, ctx, mapper)
+    if agg_type == "ip_range":
+        return _ip_range(body, sub, ctx, mapper)
     if agg_type == "significant_terms":
         return _significant_terms(body, sub, ctx, mapper)
     if agg_type == "adjacency_matrix":

@@ -524,3 +524,136 @@ def test_device_terms_counts_matches_host():
     got = terms_counts_per_term(jax.device_put(perm_docs), starts,
                                 jax.device_put(mask))
     np.testing.assert_array_equal(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# round-4 additions: rare_terms, multi_terms, significant_text, t_test,
+# ip_range, variable_width_histogram
+# ---------------------------------------------------------------------------
+
+
+def test_rare_terms(search):
+    a = agg(search, {"rare": {"rare_terms": {"field": "category"}}})
+    # only meat has exactly one doc
+    assert [b["key"] for b in a["rare"]["buckets"]] == ["meat"]
+    a = agg(search, {"rare": {"rare_terms": {
+        "field": "category", "max_doc_count": 2}}})
+    # ascending by count then key: meat(1), veg(2)
+    assert [(b["key"], b["doc_count"])
+            for b in a["rare"]["buckets"]] == [("meat", 1), ("veg", 2)]
+    # sub-aggs refine per bucket
+    a = agg(search, {"rare": {"rare_terms": {"field": "category",
+                                             "max_doc_count": 2},
+                    "aggs": {"p": {"avg": {"field": "price"}}}}})
+    assert a["rare"]["buckets"][1]["p"]["value"] == pytest.approx(4.5)
+
+
+def test_multi_terms(search):
+    a = agg(search, {"mt": {"multi_terms": {"terms": [
+        {"field": "category"}, {"field": "qty"}]}}})
+    keys = [tuple(b["key"]) for b in a["mt"]["buckets"]]
+    # every (category, qty) pair is unique in the fixture (meat has no
+    # qty → excluded, like the reference's missing-value handling)
+    assert len(keys) == 5
+    assert ("fruit", 10.0) in keys and ("veg", 2.0) in keys
+    assert all(b["doc_count"] == 1 for b in a["mt"]["buckets"])
+    import pytest as _p
+    from elasticsearch_tpu.common.errors import ElasticsearchTpuException
+    with _p.raises(ElasticsearchTpuException):
+        agg(search, {"mt": {"multi_terms": {"terms": [
+            {"field": "category"}]}}})
+
+
+def test_significant_text(search):
+    a = agg(search, {"st": {"significant_text": {
+        "field": "name", "min_doc_count": 1, "size": 5}}},
+        query={"term": {"category": "fruit"}})
+    keys = [b["key"] for b in a["st"]["buckets"]]
+    # fruit names are each unique; all stand out vs the background
+    assert set(keys) <= {"apple", "banana", "cherry"}
+    assert a["st"]["doc_count"] == 3
+    for b in a["st"]["buckets"]:
+        assert b["score"] > 0 and b["bg_count"] >= b["doc_count"]
+    # sub-aggregations are rejected (reference parity)
+    import pytest as _p
+    from elasticsearch_tpu.common.errors import ElasticsearchTpuException
+    with _p.raises(ElasticsearchTpuException):
+        agg(search, {"st": {"significant_text": {"field": "name"},
+                            "aggs": {"x": {"avg": {"field": "price"}}}}})
+
+
+def test_t_test(search):
+    a = agg(search, {"t": {"t_test": {
+        "a": {"field": "price"}, "b": {"field": "qty"},
+        "type": "heteroscedastic"}}})
+    from scipy import stats
+    prices = [1.0, 2.0, 3.0, 4.0, 5.0, 10.0]
+    qtys = [10.0, 20.0, 5.0, 7.0, 2.0]
+    expect = stats.ttest_ind(prices, qtys, equal_var=False).pvalue
+    assert a["t"]["value"] == pytest.approx(float(expect), rel=1e-9)
+    # paired pairs WITHIN documents: only docs carrying both fields
+    a = agg(search, {"t": {"t_test": {"a": {"field": "price"},
+                                      "b": {"field": "qty"},
+                                      "type": "paired"}}})
+    paired_p = stats.ttest_rel([1.0, 2.0, 3.0, 4.0, 5.0],
+                               [10.0, 20.0, 5.0, 7.0, 2.0]).pvalue
+    assert a["t"]["value"] == pytest.approx(float(paired_p), rel=1e-9)
+    # per-source filters: the A/B-test shape (fruit vs veg prices)
+    a = agg(search, {"t": {"t_test": {
+        "a": {"field": "price", "filter": {"term": {"category": "fruit"}}},
+        "b": {"field": "price", "filter": {"term": {"category": "veg"}}},
+        "type": "homoscedastic"}}})
+    ab_p = stats.ttest_ind([1.0, 2.0, 3.0], [4.0, 5.0],
+                           equal_var=True).pvalue
+    assert a["t"]["value"] == pytest.approx(float(ab_p), rel=1e-9)
+    # unknown type is rejected, not silently Welch'd
+    import pytest as _p
+    from elasticsearch_tpu.common.errors import ElasticsearchTpuException
+    with _p.raises(ElasticsearchTpuException):
+        agg(search, {"t": {"t_test": {"a": {"field": "price"},
+                                      "b": {"field": "qty"},
+                                      "type": "homoskedastic"}}})
+
+
+def test_ip_range_agg(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("ipagg")
+    indices = IndicesService(str(tmp / "data"))
+    idx = indices.create_index("logs", {}, {"properties": {
+        "addr": {"type": "ip"}}})
+    for i, ip in enumerate(["10.0.0.5", "10.0.0.200", "10.0.1.7",
+                            "192.168.1.1"]):
+        idx.index_doc(str(i), {"addr": ip})
+    idx.refresh()
+    svc = SearchService(indices)
+    r = svc.search("logs", {"size": 0, "aggs": {"r": {"ip_range": {
+        "field": "addr", "ranges": [
+            {"to": "10.0.1.0"},
+            {"from": "10.0.1.0", "to": "10.0.2.0"},
+            {"mask": "192.168.0.0/16"}]}}}})
+    b = r["aggregations"]["r"]["buckets"]
+    assert [x["doc_count"] for x in b] == [2, 1, 1]
+    assert b[2]["mask"] == "192.168.0.0/16"
+    indices.close()
+
+
+def test_variable_width_histogram(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("vwh")
+    indices = IndicesService(str(tmp / "data"))
+    idx = indices.create_index("m", {}, {"properties": {
+        "v": {"type": "double"}}})
+    # two tight clusters far apart + one outlier
+    vals = [1.0, 1.1, 1.2, 50.0, 50.5, 51.0, 200.0]
+    for i, v in enumerate(vals):
+        idx.index_doc(str(i), {"v": v})
+    idx.refresh()
+    svc = SearchService(indices)
+    r = svc.search("m", {"size": 0, "aggs": {"h": {
+        "variable_width_histogram": {"field": "v", "buckets": 3}}}})
+    b = r["aggregations"]["h"]["buckets"]
+    assert len(b) == 3
+    assert [x["doc_count"] for x in b] == [3, 3, 1]
+    assert b[0]["min"] == 1.0 and b[0]["max"] == pytest.approx(1.2)
+    assert b[2]["min"] == 200.0
+    # every doc lands in exactly one bucket
+    assert sum(x["doc_count"] for x in b) == len(vals)
+    indices.close()
