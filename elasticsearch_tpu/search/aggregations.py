@@ -47,7 +47,7 @@ BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "filter",
                "significant_terms", "significant_text", "sampler",
                "diversified_sampler", "rare_terms", "multi_terms",
                "adjacency_matrix", "auto_date_histogram", "ip_range",
-               "variable_width_histogram",
+               "variable_width_histogram", "children", "parent",
                "geo_distance", "geohash_grid", "geotile_grid"}
 PIPELINE_AGGS = {"avg_bucket", "sum_bucket", "min_bucket", "max_bucket",
                  "stats_bucket", "cumulative_sum", "derivative",
@@ -1030,14 +1030,9 @@ def _multi_terms(body, sub, ctx, mapper):
         for f in fields:
             kv = seg.keywords.get(f)
             if kv is not None:
-                first_pos = kv.offsets[:-1][docs]
-                has = np.diff(kv.offsets)[docs] > 0
-                vals = np.where(
-                    has,
-                    np.asarray(kv.all_ords, np.int64)[
-                        np.minimum(first_pos, len(kv.all_ords) - 1)],
-                    -1)
-                cols.append(("k", kv, vals, has))
+                # KeywordDocValues.ords is already first-ord-or-minus-1
+                vals = np.asarray(kv.ords, np.int64)[docs]
+                cols.append(("k", kv, vals, vals >= 0))
                 continue
             nv = seg.numerics.get(f)
             if nv is not None:
@@ -1066,7 +1061,12 @@ def _multi_terms(body, sub, ctx, mapper):
                 kv.terms[int(row[j])] if kind == "k" else float(row[j])
                 for j, (kind, kv, _v, _h) in enumerate(cols))
             counts[key] = counts.get(key, 0) + int(rc)
-    top = sorted(counts.items(), key=lambda kv_: (-kv_[1], kv_[0]))[:size]
+    # tie-break on stringified keys: a field mapped keyword in one
+    # index and numeric in another would otherwise make the tuple
+    # comparison raise on a doc-count tie (multi-index searches)
+    top = sorted(counts.items(),
+                 key=lambda kv_: (-kv_[1],
+                                  tuple(str(x) for x in kv_[0])))[:size]
     buckets = []
     for key, c in top:
         submasks = []
@@ -1076,10 +1076,13 @@ def _multi_terms(body, sub, ctx, mapper):
             for (kind, kv, vals, has), want in zip(cols, key):
                 if kind == "k":
                     tid = (kv.terms.index(want)
-                           if want in kv.terms else -2)
+                           if isinstance(want, str)
+                           and want in kv.terms else -2)
                     valid &= has & (vals == tid)
                 else:
-                    valid &= has & (vals == want)
+                    valid &= (has & (vals == want)
+                              if isinstance(want, float)
+                              else np.zeros(len(docs), bool))
             m[docs[valid]] = True
             submasks.append(m)
         buckets.append(_bucket_result(
@@ -1174,7 +1177,15 @@ def _variable_width_histogram(body, sub, ctx, mapper):
     refinement), which converges to the same shape on settled data."""
     field = body.get("field")
     target = int(body.get("buckets", 10))
-    values = np.sort(_numeric_values(ctx, field))
+    # cluster over the SAME value source the bucket-count pass uses
+    # (first value per doc, the range-agg convention) — clustering on
+    # all multi-values would shape centroids no doc then lands in
+    parts = []
+    for seg, mask, _m in ctx:
+        vv, m = _first_values_and_mask(seg, mask, field)
+        if vv is not None and m.any():
+            parts.append(vv[m])
+    values = np.sort(np.concatenate(parts)) if parts else np.zeros(0)
     if values.size == 0:
         return {"buckets": []}
     uniq = np.unique(values)
@@ -1228,7 +1239,11 @@ def _ip_range(body, sub, ctx, mapper):
         if "mask" in r:
             net = ipaddress.ip_network(r["mask"], strict=False)
             frm = float(int(net.network_address))
-            to = float(int(net.broadcast_address)) + 1.0
+            # +1 in INTEGER space before the float conversion: at IPv6
+            # magnitudes a float +1.0 is a no-op (the stored ip doc
+            # values share the mapper's float representation, so IPv6
+            # boundaries are as precise as the storage — IPv4 is exact)
+            to = float(int(net.broadcast_address) + 1)
             key = r.get("key", r["mask"])
         else:
             frm = (float(int(ipaddress.ip_address(r["from"])))
@@ -1263,7 +1278,80 @@ def _ip_range(body, sub, ctx, mapper):
     return {"buckets": buckets}
 
 
+def _children_parent(agg_type, body, sub, ctx, mapper):
+    """ref: modules/parent-join join/aggregations —
+    ParentToChildrenAggregator (``children``: buckets switch from
+    matched parents to their children of the given type) and
+    ChildrenToParentAggregator (``parent``: from matched children of
+    the given type to their parents). The shard-local join rides the
+    same ``{field}#parent`` keyword doc values as has_child/has_parent
+    (search/join.py), vectorized through ordinal membership."""
+    from elasticsearch_tpu.index.mapper import JoinFieldType
+    jf = None
+    for ft in mapper.mapper.fields.values():
+        if isinstance(ft, JoinFieldType):
+            jf = ft
+            break
+    if jf is None:
+        raise ParsingException(
+            f"[{agg_type}] aggregation requires a [join] field in the "
+            "mapping")
+    rel_type = body.get("type")
+    if not rel_type:
+        raise ParsingException(f"[{agg_type}] requires [type]")
+    if jf.parent_of(rel_type) is None:
+        raise ParsingException(
+            f"unknown join relation type [{rel_type}] for [{agg_type}]")
+    from elasticsearch_tpu.search.join import _relation_docs
+
+    # pass 1 — collect the join keys across ALL segments (a parent and
+    # its children may live in different segments; has_child/has_parent
+    # do the same two-pass join)
+    keys: set = set()
+    for seg, mask, _m in ctx:
+        if agg_type == "children":
+            keys.update(seg.stored.ids[int(d)]
+                        for d in np.nonzero(mask[: seg.n_docs])[0])
+        else:
+            pkv = seg.keywords.get(f"{jf.name}#parent")
+            if pkv is None:
+                continue
+            is_child = _relation_docs(seg, jf.name, [rel_type])
+            child_docs = np.nonzero(mask[: seg.n_docs] & is_child)[0]
+            keys.update(pkv.terms[int(o)]
+                        for o in pkv.ords[child_docs] if o >= 0)
+    # pass 2 — resolve the keys on every segment, live docs only
+    submasks = []
+    count = 0
+    for seg, mask, _m in ctx:
+        out = np.zeros(seg.n_docs, bool)
+        if agg_type == "children":
+            pkv = seg.keywords.get(f"{jf.name}#parent")
+            if pkv is not None:
+                want_ords = np.asarray(
+                    [i for i, t in enumerate(pkv.terms) if t in keys],
+                    np.int64)
+                out = (_relation_docs(seg, jf.name, [rel_type])
+                       & np.isin(pkv.ords[: seg.n_docs], want_ords))
+        else:
+            for pid in keys:
+                d = seg.docid_for(pid)
+                if d >= 0:
+                    out[d] = True
+        out &= seg.live[: seg.n_docs]
+        submasks.append(out)
+        count += int(out.sum())
+    bucket_ctx = _refine([(seg, np.ones(seg.n_docs, bool) & seg.live, m)
+                          for seg, _msk, m in ctx], submasks)
+    out_doc = {"doc_count": count}
+    if sub:
+        out_doc.update(_compute_aggs(sub, bucket_ctx, mapper))
+    return out_doc
+
+
 def _bucket(agg_type, body, sub, ctx, mapper):
+    if agg_type in ("children", "parent"):
+        return _children_parent(agg_type, body, sub, ctx, mapper)
     if agg_type == "rare_terms":
         return _rare_terms(body, sub, ctx, mapper)
     if agg_type == "multi_terms":

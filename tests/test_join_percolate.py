@@ -205,3 +205,90 @@ def test_percolate_existing_doc_ref(perco):
     r = do(perco, "POST", "/alerts/_search", body={"query": {"percolate": {
         "field": "query", "index": "messages", "id": "m1"}}})
     assert "r-error" in ids(r)
+
+
+# ---------------------------------------------------------------------------
+# children / parent aggregations (ref: modules/parent-join
+# join/aggregations — ParentToChildrenAggregator,
+# ChildrenToParentAggregator)
+# ---------------------------------------------------------------------------
+
+
+def test_children_aggregation(qa):
+    r = do(qa, "POST", "/qa/_search", body={
+        "size": 0,
+        "query": {"match": {"text": "jax"}},     # parents: q1 only
+        "aggs": {"to_answers": {
+            "children": {"type": "answer"},
+            "aggs": {"words": {"terms": {"field": "join"}}}}}})
+    agg = r["aggregations"]["to_answers"]
+    # q1 has two answers (a1, a2); q2's answer excluded
+    assert agg["doc_count"] == 2
+    assert agg["words"]["buckets"][0]["key"] == "answer"
+    assert agg["words"]["buckets"][0]["doc_count"] == 2
+
+
+def test_parent_aggregation(qa):
+    r = do(qa, "POST", "/qa/_search", body={
+        "size": 0,
+        "query": {"match": {"text": "accelerator"}},   # child a3 only
+        "aggs": {"to_questions": {
+            "parent": {"type": "answer"},
+            "aggs": {"cnt": {"value_count": {"field": "_id"}}}}}})
+    agg = r["aggregations"]["to_questions"]
+    # a3's parent is q2; one parent bucket doc
+    assert agg["doc_count"] == 1
+
+
+def test_children_agg_requires_join_mapping(node):
+    do(node, "PUT", "/plain", body={"mappings": {"properties": {
+        "t": {"type": "text"}}}})
+    do(node, "PUT", "/plain/_doc/1", body={"t": "x"}, expect=201)
+    do(node, "POST", "/plain/_refresh")
+    status, resp = node.rest_controller.dispatch(
+        "POST", "/plain/_search", None,
+        {"size": 0, "aggs": {"c": {"children": {"type": "answer"}}}})
+    assert status == 400
+
+
+def test_children_agg_cross_segment_and_deletes(node):
+    """Parents and children indexed across refreshes live in different
+    segments; the agg joins across them (the two-pass join), and
+    deleted children drop out of doc_count."""
+    do(node, "PUT", "/qa2", body={"mappings": {"properties": {
+        "text": {"type": "text"},
+        "join": {"type": "join", "relations": {"question": "answer"}},
+    }}})
+    s, _ = node.rest_controller.dispatch(
+        "PUT", "/qa2/_doc/q1", {"routing": "r"},
+        {"text": "the question", "join": "question"})
+    assert s == 201
+    do(node, "POST", "/qa2/_refresh")          # segment 1: parent only
+    for aid in ("a1", "a2"):
+        s, _ = node.rest_controller.dispatch(
+            "PUT", f"/qa2/_doc/{aid}", {"routing": "r"},
+            {"text": "an answer", "join": {"name": "answer",
+                                           "parent": "q1"}})
+        assert s == 201
+    do(node, "POST", "/qa2/_refresh")          # segment 2: children
+    r = do(node, "POST", "/qa2/_search", body={
+        "size": 0, "query": {"match": {"text": "question"}},
+        "aggs": {"c": {"children": {"type": "answer"}}}})
+    assert r["aggregations"]["c"]["doc_count"] == 2
+    # the mirror direction joins cross-segment too
+    r = do(node, "POST", "/qa2/_search", body={
+        "size": 0, "query": {"match": {"text": "answer"}},
+        "aggs": {"p": {"parent": {"type": "answer"}}}})
+    assert r["aggregations"]["p"]["doc_count"] == 1
+    # deletes drop from doc_count
+    do(node, "DELETE", "/qa2/_doc/a1")
+    do(node, "POST", "/qa2/_refresh")
+    r = do(node, "POST", "/qa2/_search", body={
+        "size": 0, "query": {"match": {"text": "question"}},
+        "aggs": {"c": {"children": {"type": "answer"}}}})
+    assert r["aggregations"]["c"]["doc_count"] == 1
+    # unknown relation type rejected
+    status, _ = node.rest_controller.dispatch(
+        "POST", "/qa2/_search", None,
+        {"size": 0, "aggs": {"c": {"children": {"type": "nope"}}}})
+    assert status == 400
