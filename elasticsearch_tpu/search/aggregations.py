@@ -1061,12 +1061,14 @@ def _multi_terms(body, sub, ctx, mapper):
                 kv.terms[int(row[j])] if kind == "k" else float(row[j])
                 for j, (kind, kv, _v, _h) in enumerate(cols))
             counts[key] = counts.get(key, 0) + int(rc)
-    # tie-break on stringified keys: a field mapped keyword in one
-    # index and numeric in another would otherwise make the tuple
-    # comparison raise on a doc-count tie (multi-index searches)
+    # tie-break per element with a type tag: numeric keys keep NUMERIC
+    # order on doc-count ties, while a field mapped keyword in one
+    # index and numeric in another still can't raise on comparison
+    # (multi-index searches)
     top = sorted(counts.items(),
                  key=lambda kv_: (-kv_[1],
-                                  tuple(str(x) for x in kv_[0])))[:size]
+                                  tuple((isinstance(x, str), x)
+                                        for x in kv_[0])))[:size]
     buckets = []
     for key, c in top:
         submasks = []

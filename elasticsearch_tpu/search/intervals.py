@@ -25,7 +25,7 @@ filter end < n, span_not → drop intervals overlapping the exclude set.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -171,11 +171,19 @@ def within(small: List[Interval], big: List[Interval]) -> List[Interval]:
 
 def evaluate_rule(rule: Dict[str, Any], row: Sequence[int],
                   term_id: Callable[[str], int],
-                  expand_prefix: Callable[[str], List[int]]
+                  expand_prefix: Callable[[str], List[int]],
+                  rows: Optional[Dict[str, Sequence[int]]] = None
                   ) -> List[Interval]:
-    """Evaluate an intervals rule tree for one candidate row."""
+    """Evaluate an intervals rule tree for one candidate row. Nodes
+    marked ``_src_field`` (field_masking_span subtrees) switch the doc's
+    token row to that field's via ``rows`` — positions from the source
+    field combine with the enclosing field's spans, the Lucene
+    FieldMaskingSpanQuery contract (same-position subfields)."""
     (kind, spec), = ((k, v) for k, v in rule.items()
                      if k not in ("boost",))
+    if (isinstance(spec, dict) and rows is not None
+            and spec.get("_src_field") is not None):
+        row = rows.get(str(spec["_src_field"]), row)
     if kind == "term":                        # internal: single term id
         return term_intervals(row, spec)
     if kind == "match":
@@ -185,21 +193,23 @@ def evaluate_rule(rule: Dict[str, Any], row: Sequence[int],
                               int(spec.get("max_gaps", -1)))
         flt = spec.get("filter")
         if flt:
-            out = _apply_filter(out, flt, row, term_id, expand_prefix)
+            out = _apply_filter(out, flt, row, term_id, expand_prefix,
+                                rows)
         return out
     if kind == "prefix":
         tids = spec["_tids"]
         return any_of_intervals([term_intervals(row, t) for t in tids])
     if kind == "any_of":
         out = any_of_intervals([
-            evaluate_rule(r, row, term_id, expand_prefix)
+            evaluate_rule(r, row, term_id, expand_prefix, rows)
             for r in spec.get("intervals", [])])
         flt = spec.get("filter")
         if flt:
-            out = _apply_filter(out, flt, row, term_id, expand_prefix)
+            out = _apply_filter(out, flt, row, term_id, expand_prefix,
+                                rows)
         return out
     if kind == "all_of":
-        children = [evaluate_rule(r, row, term_id, expand_prefix)
+        children = [evaluate_rule(r, row, term_id, expand_prefix, rows)
                     for r in spec.get("intervals", [])]
         out = all_of_intervals(children,
                                bool(spec.get("ordered", False)),
@@ -209,17 +219,21 @@ def evaluate_rule(rule: Dict[str, Any], row: Sequence[int],
             out = [iv for iv in out if iv[1] < int(first_end)]
         flt = spec.get("filter")
         if flt:
-            out = _apply_filter(out, flt, row, term_id, expand_prefix)
+            out = _apply_filter(out, flt, row, term_id, expand_prefix,
+                                rows)
         return out
     raise ValueError(f"unknown intervals rule [{kind}]")
 
 
 def _apply_filter(intervals: List[Interval], flt: Dict[str, Any],
-                  row, term_id, expand_prefix) -> List[Interval]:
+                  row, term_id, expand_prefix,
+                  rows: Optional[Dict[str, Sequence[int]]] = None
+                  ) -> List[Interval]:
     """ES intervals filters: not_containing / containing / not_contained_by
-    / contained_by / not_overlapping."""
+    / contained_by / not_overlapping. ``rows`` threads through so
+    field-masked subtrees in filter position read their own field."""
     for fkind, frule in flt.items():
-        other = evaluate_rule(frule, row, term_id, expand_prefix)
+        other = evaluate_rule(frule, row, term_id, expand_prefix, rows)
         if fkind == "not_containing":
             intervals = [iv for iv in intervals
                          if not any(o[0] >= iv[0] and o[1] <= iv[1]

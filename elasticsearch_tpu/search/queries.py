@@ -2143,13 +2143,18 @@ class IntervalsQuery(QueryBuilder):
         self.rule = rule
 
     # -- rule preparation: analyze leaf text per segment ---------------
-    def _prepare(self, ctx, rule):
-        """Return (resolved rule with _tids, leaf term strings)."""
+    def _prepare(self, ctx, rule, field: Optional[str] = None):
+        """Return (resolved rule with _tids, leaf (field, term) pairs).
+        ``field`` carries the evaluation field down the tree — nodes
+        marked ``_src_field`` (field_masking_span subtrees) switch it."""
+        field = field or self.field
         (kind, spec), = ((k, v) for k, v in rule.items()
                          if k != "boost")
-        pf = ctx.segment.postings.get(self.field)
+        if isinstance(spec, dict) and spec.get("_src_field"):
+            field = str(spec["_src_field"])
+        pf = ctx.segment.postings.get(field)
         if kind == "match":
-            terms = _analyze_terms(ctx, self.field,
+            terms = _analyze_terms(ctx, field,
                                    str(spec.get("query", "")))
             tids = [pf.term_id(t) if pf is not None else -1
                     for t in terms]
@@ -2158,15 +2163,18 @@ class IntervalsQuery(QueryBuilder):
             if "filter" in spec and spec["filter"]:
                 fprep = {}
                 for fk, fr in spec["filter"].items():
-                    fprep[fk], _ = self._prepare(ctx, fr)
+                    fprep[fk], _ = self._prepare(ctx, fr, field)
                 out["filter"] = fprep
-            return {"match": out}, terms
+            return {"match": out}, [(field, t) for t in terms]
         if kind == "prefix":
             prefix = str(spec.get("prefix", ""))
             exp = (_expand_prefix(pf.terms, prefix, 128)
                    if pf is not None else [])
             tids = [pf.term_id(t) for t in exp]
-            return {"prefix": {"_tids": tids}}, exp
+            out = {"_tids": tids}
+            if isinstance(spec, dict) and spec.get("_src_field"):
+                out["_src_field"] = spec["_src_field"]
+            return {"prefix": out}, [(field, t) for t in exp]
         if kind == "wildcard":
             # full-pattern expansion against the segment's term dict
             # (capped like multi-term rewrites, MAX_TERM_EXPANSIONS)
@@ -2175,11 +2183,14 @@ class IntervalsQuery(QueryBuilder):
             exp = ([t for t in pf.terms if fnmatch.fnmatchcase(t, pat)]
                    [:128] if pf is not None else [])
             tids = [pf.term_id(t) for t in exp]
-            return {"prefix": {"_tids": tids}}, exp
+            out = {"_tids": tids}
+            if isinstance(spec, dict) and spec.get("_src_field"):
+                out["_src_field"] = spec["_src_field"]
+            return {"prefix": out}, [(field, t) for t in exp]
         if kind in ("any_of", "all_of"):
             kids, leaf_terms = [], []
             for child in spec.get("intervals", []):
-                prep, terms = self._prepare(ctx, child)
+                prep, terms = self._prepare(ctx, child, field)
                 kids.append(prep)
                 leaf_terms.extend(terms)
             out = dict(spec)
@@ -2187,7 +2198,7 @@ class IntervalsQuery(QueryBuilder):
             if "filter" in spec and spec["filter"]:
                 fprep = {}
                 for fk, fr in spec["filter"].items():
-                    fprep[fk], _ = self._prepare(ctx, fr)
+                    fprep[fk], _ = self._prepare(ctx, fr, field)
                 out["filter"] = fprep
             return {kind: out}, leaf_terms
         from elasticsearch_tpu.common.errors import ParsingException
@@ -2203,28 +2214,57 @@ class IntervalsQuery(QueryBuilder):
         if pf is None or ts is None:
             return empty
         rule, leaf_terms = self._prepare(ctx, self.rule)
-        leaf_terms = [t for t in leaf_terms if t]
+        leaf_terms = [(f, t) for f, t in leaf_terms if t]
         if not leaf_terms:
             return empty
-        # device coarse filter: docs containing ANY leaf term
-        present = [t for t in set(leaf_terms) if pf.term_id(t) >= 0]
+        # device coarse filter: docs containing ANY leaf term, each
+        # resolved against its OWN field (field_masking_span subtrees
+        # read a different field's postings)
+        present = []
+        for f, t in set(leaf_terms):
+            pff = seg.postings.get(f)
+            if pff is not None and pff.term_id(t) >= 0:
+                present.append((f, t))
         if not present:
             return empty
         union = np.zeros(seg.n_docs, bool)
-        for t in present:
-            docids, tfs = pf.postings(t)
+        for f, t in present:
+            docids, tfs = seg.postings[f].postings(t)
             union[docids[tfs > 0]] = True
         cand = np.nonzero(union)[0]
         if len(cand) == 0:
             return empty
+        fields = {f for f, _ in leaf_terms} | {self.field}
+
+        def _masked_fields(node, acc):
+            if isinstance(node, dict):
+                if node.get("_src_field"):
+                    acc.add(str(node["_src_field"]))
+                for v in node.values():
+                    _masked_fields(v, acc)
+            elif isinstance(node, list):
+                for v in node:
+                    _masked_fields(v, acc)
+            return acc
+
+        # filter-position masked subtrees (span_not exclude etc.) carry
+        # no scoring leaf terms but still need their field's rows
+        fields |= _masked_fields(rule, set())
+        field_streams = {f: seg.streams.get(f) for f in fields}
         freqs = np.zeros(len(cand), np.int64)
         for i, docid in enumerate(cand):
-            row = ts.tokens[docid, : ts.lengths[docid]]
-            ivs = iv.evaluate_rule(rule, row, pf.term_id, None)
+            rows = {f: (s.tokens[docid, : s.lengths[docid]]
+                        if s is not None else ())
+                    for f, s in field_streams.items()}
+            ivs = iv.evaluate_rule(rule, rows[self.field], pf.term_id,
+                                   None, rows=rows)
             freqs[i] = len(ivs)
-        doc_count, _ = ctx.stats.field_stats(self.field)
-        w = sum(bm25_ops.idf(ctx.stats.doc_freq(self.field, t), doc_count)
-                for t in set(leaf_terms))
+        # idf uses each term's OWN field stats — a masked source
+        # field's doc_freq against the main field's doc_count could go
+        # negative (df > N inverts the ranking)
+        w = sum(bm25_ops.idf(ctx.stats.doc_freq(f, t),
+                             ctx.stats.field_stats(f)[0])
+                for f, t in set(leaf_terms))
         return _phrase_scores_from_freqs(ctx, self.field, cand, freqs, w)
 
 
@@ -2400,6 +2440,22 @@ def _span_rule(node):
             raise ParsingException("[span_within] fields must match")
         return field, {"all_of": {"intervals": [small],
                                   "filter": {"contained_by": big}}}
+    if kind in ("field_masking_span", "span_field_masking"):
+        # ref: index/query/FieldMaskingSpanQueryBuilder — the inner
+        # span evaluates against ITS OWN field's postings/positions but
+        # reports the masked field, so an enclosing span_near can
+        # combine spans across fields that share position structure
+        # (e.g. a stemmed subfield of the same text)
+        inner = body.get("query")
+        masked = body.get("field")
+        if not inner or not masked:
+            raise ParsingException(
+                "[field_masking_span] requires [query] and [field]")
+        src_field, rule = _span_rule(inner)
+        (rk, rv), = ((k, v) for k, v in rule.items() if k != "boost")
+        rv = dict(rv)
+        rv["_src_field"] = src_field
+        return str(masked), {rk: rv}
     raise ParsingException(f"unknown span query [{kind}]")
 
 
@@ -2794,6 +2850,8 @@ _PARSERS = {
     "span_not": _parse_span("span_not"),
     "span_containing": _parse_span("span_containing"),
     "span_within": _parse_span("span_within"),
+    "field_masking_span": _parse_span("field_masking_span"),
+    "span_field_masking": _parse_span("span_field_masking"),
     "terms_set": _parse_terms_set,
     "script": _parse_script_query,
     "wrapper": _parse_wrapper,

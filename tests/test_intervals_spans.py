@@ -307,3 +307,80 @@ def test_span_multi_wildcard_full_pattern(search):
     from elasticsearch_tpu.common.errors import ParsingException
     with pytest.raises(ParsingException):
         search.search("d", {"query": {"span_multi": {}}})
+
+
+def test_field_masking_span(tmp_path_factory):
+    """ref: index/query/FieldMaskingSpanQueryBuilder — spans from one
+    field combine with another field's spans inside span_near (the
+    same-content-different-analysis pattern)."""
+    from elasticsearch_tpu.index.service import IndicesService
+    from elasticsearch_tpu.search.service import SearchService
+    tmp = tmp_path_factory.mktemp("fms")
+    indices = IndicesService(str(tmp / "data"))
+    idx = indices.create_index("m", {}, {"properties": {
+        "t": {"type": "text"},
+        "t_exact": {"type": "text", "analyzer": "whitespace"}}})
+    docs = [
+        ("1", "The Quick brown fox"),
+        ("2", "slow Quick turtle"),
+        ("3", "brown bear Quick"),
+    ]
+    for did, text in docs:
+        idx.index_doc(did, {"t": text, "t_exact": text})
+    idx.refresh()
+    svc = SearchService(indices)
+    # 'Quick' survives only in the whitespace field (unlowercased);
+    # masking lets span_near chain it before the standard field's
+    # 'brown' — only doc 1 has Quick immediately before brown
+    r = svc.search("m", {"query": {"span_near": {
+        "clauses": [
+            {"field_masking_span": {
+                "query": {"span_term": {"t_exact": "Quick"}},
+                "field": "t"}},
+            {"span_term": {"t": "brown"}}],
+        "slop": 0, "in_order": True}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+    # standalone masked span matches where the source field matches
+    r = svc.search("m", {"query": {"field_masking_span": {
+        "query": {"span_term": {"t_exact": "Quick"}},
+        "field": "t"}}})
+    assert sorted(h["_id"] for h in r["hits"]["hits"]) == ["1", "2", "3"]
+    # order still binds across the mask: brown BEFORE the masked Quick
+    # only holds in doc 3
+    r = svc.search("m", {"query": {"span_near": {
+        "clauses": [
+            {"span_term": {"t": "brown"}},
+            {"field_masking_span": {
+                "query": {"span_term": {"t_exact": "Quick"}},
+                "field": "t"}}],
+        "slop": 1, "in_order": True}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["3"]
+    indices.close()
+
+
+def test_field_masking_span_in_filter_position(tmp_path_factory):
+    """Masked subtrees inside span_not's exclude (filter position) read
+    their own field's token row."""
+    from elasticsearch_tpu.index.service import IndicesService
+    from elasticsearch_tpu.search.service import SearchService
+    tmp = tmp_path_factory.mktemp("fmsf")
+    indices = IndicesService(str(tmp / "data"))
+    idx = indices.create_index("m", {}, {"properties": {
+        "t": {"type": "text"},
+        "t_exact": {"type": "text", "analyzer": "whitespace"}}})
+    for did, text in (("1", "The Quick brown fox"),
+                      ("2", "slow brown turtle")):
+        idx.index_doc(did, {"t": text, "t_exact": text})
+    idx.refresh()
+    svc = SearchService(indices)
+    r = svc.search("m", {"query": {"span_not": {
+        "include": {"span_term": {"t": "brown"}},
+        "exclude": {"span_near": {"clauses": [
+            {"field_masking_span": {
+                "query": {"span_term": {"t_exact": "Quick"}},
+                "field": "t"}},
+            {"span_term": {"t": "brown"}}],
+            "slop": 0, "in_order": True}}}}})
+    # doc 1's brown is adjacent to the masked Quick → excluded
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["2"]
+    indices.close()
