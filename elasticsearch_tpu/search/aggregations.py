@@ -50,7 +50,8 @@ BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "filter",
                "variable_width_histogram", "children", "parent",
                "geo_distance", "geohash_grid", "geotile_grid"}
 PIPELINE_AGGS = {"avg_bucket", "sum_bucket", "min_bucket", "max_bucket",
-                 "stats_bucket", "cumulative_sum", "derivative",
+                 "stats_bucket", "extended_stats_bucket",
+                 "percentiles_bucket", "cumulative_sum", "derivative",
                  "bucket_sort", "cumulative_cardinality"}
 
 
@@ -693,7 +694,8 @@ def _refine(ctx: CollectCtx, submasks: List[np.ndarray]) -> CollectCtx:
 
 PARENT_PIPELINES = {"cumulative_sum", "derivative",
                     "cumulative_cardinality", "bucket_sort",
-                    "moving_fn", "moving_avg", "serial_diff"}
+                    "moving_fn", "moving_avg", "serial_diff",
+                    "bucket_script", "bucket_selector"}
 
 
 def _split_parent_pipelines(sub: Dict[str, Any]):
@@ -780,6 +782,68 @@ def _apply_parent_pipelines(parents, buckets: List[Dict[str, Any]]):
                 if i >= lag and series[i] is not None \
                         and series[i - lag] is not None:
                     b[name] = {"value": series[i] - series[i - lag]}
+        elif ptype in ("bucket_script", "bucket_selector"):
+            # ref: pipeline/BucketScriptPipelineAggregator (per-bucket
+            # computed metric) and BucketSelectorPipelineAggregator
+            # (per-bucket retention predicate); scripts run the full
+            # sandboxed Painless interpreter with params bound to the
+            # resolved buckets_path metrics. Runtime script errors fail
+            # the request like the reference's script_exception; only
+            # division by zero degrades to a null value (the Java
+            # double semantics the interpreter lacks).
+            from elasticsearch_tpu.common.errors import ScriptException
+            from elasticsearch_tpu.script.interp import (PainlessError,
+                                                         compile_painless)
+            paths = body.get("buckets_path") or {}
+            if isinstance(paths, str):
+                paths = {"_value": paths}
+            spec2 = body.get("script", "")
+            src = (spec2.get("source", "") if isinstance(spec2, dict)
+                   else str(spec2))
+            static = (spec2.get("params", {})
+                      if isinstance(spec2, dict) else {})
+            try:
+                script = compile_painless(src)
+            except PainlessError as e:
+                raise ParsingException(
+                    f"[{ptype}] script compile error: {e}")
+            gap = str(body.get("gap_policy", "skip"))
+            selector = ptype == "bucket_selector"
+            keep = []
+            for b in buckets:
+                vals = {k: _bucket_metric_value(b, p)
+                        for k, p in paths.items()}
+                missing = any(v is None for v in vals.values())
+                if missing and gap != "insert_zeros":
+                    # skip: bucket_script writes nothing,
+                    # bucket_selector retains the bucket
+                    keep.append(b)
+                    continue
+                if missing:
+                    vals = {k: (0.0 if v is None else v)
+                            for k, v in vals.items()}
+                try:
+                    result = script.execute(
+                        {"params": {**static, **vals}})
+                except ZeroDivisionError:
+                    result = None
+                except PainlessError as e:
+                    raise ScriptException(
+                        f"[{ptype}] runtime error: {e} in [{src}]")
+                if selector:
+                    if bool(result):
+                        keep.append(b)
+                else:
+                    try:
+                        value = (None if result is None
+                                 else float(result))
+                    except (TypeError, ValueError):
+                        raise ScriptException(
+                            f"[{ptype}] script returned a non-numeric "
+                            f"value [{result!r}] in [{src}]")
+                    b[name] = {"value": value}
+            if selector:
+                buckets[:] = keep
         elif ptype == "bucket_sort":
             sort_spec = body.get("sort", [])
             for entry in reversed(sort_spec):
@@ -1960,6 +2024,19 @@ def _compute_pipeline(agg_type, body, results):
         return {}
     values = _extract_bucket_values(path, results)
     if not values:
+        # multi-value pipelines keep their response SHAPE on empty
+        # input (ref: the reference's null-filled InternalPercentiles
+        # Bucket / InternalExtendedStatsBucket)
+        if agg_type == "percentiles_bucket":
+            pcts = body.get("percents") or [1.0, 5.0, 25.0, 50.0, 75.0,
+                                            95.0, 99.0]
+            return {"values": {str(float(p)): None for p in pcts}}
+        if agg_type == "extended_stats_bucket":
+            return {"count": 0, "min": None, "max": None, "avg": None,
+                    "sum": 0.0, "sum_of_squares": None,
+                    "variance": None, "std_deviation": None,
+                    "std_deviation_bounds": {"upper": None,
+                                             "lower": None}}
         return {"value": None}
     if agg_type == "avg_bucket":
         return {"value": float(np.mean(values))}
@@ -1974,4 +2051,28 @@ def _compute_pipeline(agg_type, body, results):
         return {"count": len(arr), "min": float(arr.min()),
                 "max": float(arr.max()), "avg": float(arr.mean()),
                 "sum": float(arr.sum())}
+    if agg_type == "extended_stats_bucket":
+        # ref: pipeline/ExtendedStatsBucketPipelineAggregator
+        arr = np.asarray(values, float)
+        sigma = float(body.get("sigma", 2.0))
+        mean = float(arr.mean())
+        var = float(arr.var())
+        std = float(np.sqrt(var))
+        return {"count": len(arr), "min": float(arr.min()),
+                "max": float(arr.max()), "avg": mean,
+                "sum": float(arr.sum()),
+                "sum_of_squares": float((arr * arr).sum()),
+                "variance": var, "std_deviation": std,
+                "std_deviation_bounds": {
+                    "upper": mean + sigma * std,
+                    "lower": mean - sigma * std}}
+    if agg_type == "percentiles_bucket":
+        # ref: pipeline/PercentilesBucketPipelineAggregator — returns
+        # the NEAREST input data point (no interpolation), keys in the
+        # same "50.0" format as the percentiles metric agg
+        pcts = body.get("percents") or [1.0, 5.0, 25.0, 50.0, 75.0,
+                                        95.0, 99.0]
+        arr = np.asarray(values, float)
+        return {"values": {str(float(p)): float(
+            np.percentile(arr, p, method="nearest")) for p in pcts}}
     raise IllegalArgumentException(f"unhandled pipeline agg [{agg_type}]")

@@ -657,3 +657,85 @@ def test_variable_width_histogram(tmp_path_factory):
     # every doc lands in exactly one bucket
     assert sum(x["doc_count"] for x in b) == len(vals)
     indices.close()
+
+
+def test_bucket_script_and_selector(search):
+    a = agg(search, {"cats": {
+        "terms": {"field": "category", "order": {"_key": "asc"}},
+        "aggs": {
+            "total": {"sum": {"field": "price"}},
+            "n": {"value_count": {"field": "price"}},
+            "avg_calc": {"bucket_script": {
+                "buckets_path": {"t": "total", "c": "n"},
+                "script": "params.t / params.c"}},
+            "big_only": {"bucket_selector": {
+                "buckets_path": {"t": "total"},
+                "script": "params.t > 5"}}}}})
+    buckets = {b["key"]: b for b in a["cats"]["buckets"]}
+    # selector kept only buckets with sum(price) > 5
+    assert set(buckets) == {"fruit", "veg", "meat"} - {"x"}
+    assert "fruit" in buckets and buckets["fruit"]["total"]["value"] == 6.0
+    assert buckets["fruit"]["avg_calc"]["value"] == pytest.approx(2.0)
+    assert buckets["veg"]["avg_calc"]["value"] == pytest.approx(4.5)
+    # a stricter selector drops buckets
+    a = agg(search, {"cats": {
+        "terms": {"field": "category"},
+        "aggs": {
+            "total": {"sum": {"field": "price"}},
+            "keep": {"bucket_selector": {
+                "buckets_path": {"t": "total"},
+                "script": "params.t >= 9"}}}}})
+    keys = {b["key"] for b in a["cats"]["buckets"]}
+    assert keys == {"veg", "meat"}         # fruit total 6 dropped
+
+
+def test_percentiles_and_extended_stats_bucket(search):
+    a = agg(search, {
+        "days": {"date_histogram": {"field": "sold_at",
+                                    "calendar_interval": "day"},
+                 "aggs": {"rev": {"sum": {"field": "price"}}}},
+        "p": {"percentiles_bucket": {"buckets_path": "days>rev",
+                                     "percents": [50.0, 75.0, 100.0]}},
+        "es": {"extended_stats_bucket": {"buckets_path": "days>rev"}}})
+    # daily revenues: 3, 7, 15 — nearest-data-point semantics (ref:
+    # PercentilesBucket does not interpolate), keys like the metric agg
+    assert a["p"]["values"]["50.0"] == pytest.approx(7.0)
+    assert a["p"]["values"]["75.0"] == pytest.approx(15.0)   # nearest
+    assert a["p"]["values"]["100.0"] == pytest.approx(15.0)
+    es = a["es"]
+    assert es["count"] == 3 and es["sum"] == pytest.approx(25.0)
+    assert es["variance"] == pytest.approx(
+        float(np.var([3.0, 7.0, 15.0])))
+    assert es["std_deviation_bounds"]["upper"] == pytest.approx(
+        es["avg"] + 2 * es["std_deviation"])
+
+
+def test_bucket_script_error_semantics(search):
+    from elasticsearch_tpu.common.errors import ElasticsearchTpuException
+    # runtime script errors fail the request (script_exception parity)
+    with pytest.raises(ElasticsearchTpuException):
+        agg(search, {"cats": {
+            "terms": {"field": "category"},
+            "aggs": {"t": {"sum": {"field": "price"}},
+                     "bad": {"bucket_script": {
+                         "buckets_path": {"t": "t"},
+                         "script": "params.t.badMethod()"}}}}})
+    # division by zero degrades to a null value, not a crash
+    a = agg(search, {"cats": {
+        "terms": {"field": "category", "order": {"_key": "asc"}},
+        "aggs": {"t": {"sum": {"field": "price"}},
+                 "z": {"bucket_script": {
+                     "buckets_path": {"t": "t"},
+                     "script": "params.t / (params.t - params.t)"}}}}})
+    assert all(b["z"]["value"] is None for b in a["cats"]["buckets"])
+    # empty input keeps the multi-value shapes
+    a = agg(search, {
+        "days": {"date_histogram": {"field": "sold_at",
+                                    "calendar_interval": "day"},
+                 "aggs": {"rev": {"sum": {"field": "price"}}}},
+        "p": {"percentiles_bucket": {"buckets_path": "days>rev",
+                                     "percents": [50.0]}},
+        "es": {"extended_stats_bucket": {"buckets_path": "days>rev"}}},
+        query={"term": {"category": "nope"}})
+    assert a["p"]["values"]["50.0"] is None
+    assert a["es"]["count"] == 0 and a["es"]["std_deviation"] is None
