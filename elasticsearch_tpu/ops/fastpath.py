@@ -157,13 +157,15 @@ NE_SLOTS = 8          # non-essential term slots (pad with len 0)
 CAND = 4096           # candidates patched per query
 
 
-def _essential_one(block_docids, block_tfs, flat_docids, flat_tfs,
-                   sel_blocks, sel_weights, doc_lens, live_col,
-                   ne_start, ne_len, ne_idf, ne_bound,
-                   avg_len, k1: float, b: float, k: int):
+def _essential_phase1(block_docids, block_tfs, sel_blocks, sel_weights,
+                      doc_lens, live_col, ne_bound, avg_len,
+                      k1: float, b: float):
+    """Exact scores over the ESSENTIAL union (the full kernel's sorted
+    segmented-reduction at a smaller NB) → top-C candidates plus the
+    overflow bound. Shared by BOTH patch lanes (binary-search and
+    dense-table) so the exactness-critical candidate extraction has one
+    definition. Returns (cand_ids [C], ess [C], overflow_bound [])."""
     dt = _score_dtype()
-    # ---- phase 1: exact scores over the ESSENTIAL union (same sorted
-    # segmented-reduction as the full kernel, smaller NB)
     d = jnp.take(block_docids, sel_blocks, axis=0)
     tf = jnp.take(block_tfs, sel_blocks, axis=0).astype(dt)
     dl = jnp.take(doc_lens, d).astype(dt)
@@ -183,6 +185,45 @@ def _essential_one(block_docids, block_tfs, flat_docids, flat_tfs,
     cand_ids = jnp.take(sorted_k, pos)[:CAND]
     ess = ess_vals[:CAND]
     overflow_bound = ess_vals[CAND] + ne_bound   # -inf when exhausted
+    return cand_ids, ess, overflow_bound
+
+
+def _essential_epilogue(patched, cand_ids, overflow_bound, k: int):
+    """Exact ordering over the candidate set + the on-device exactness
+    certificate — ONE definition for both patch lanes. Rank by the
+    REPORTED float32 score with docid-ascending ties (the full kernel's
+    contract), certify kth (full precision, min over the selected k so
+    f32 rounding can't certify upward) STRICTLY beats the overflow
+    bound. Returns (vals [k] f32, ids [k], ok [])."""
+    dt = _score_dtype()
+    disp = patched.astype(jnp.float32)
+    neg = jnp.where(jnp.isfinite(disp), -disp,
+                    jnp.asarray(jnp.inf, jnp.float32))
+    tie_ids = jnp.where(jnp.isfinite(disp), cand_ids, _SENTINEL)
+    _skey, sids, svals, sdt = jax.lax.sort(
+        (neg, tie_ids, disp, patched.astype(dt)), num_keys=2)
+    out_vals = svals[:k]
+    out_ids = jnp.where(jnp.isfinite(out_vals), sids[:k], _SENTINEL)
+    kth = jnp.min(jnp.where(jnp.isfinite(out_vals), sdt[:k],
+                            jnp.asarray(jnp.inf, dt)))
+    kth = jnp.where(jnp.isfinite(out_vals[k - 1]), kth,
+                    jnp.asarray(-jnp.inf, dt))
+    # every doc outside the top-C candidates is bounded by
+    # ess_(C+1)+Σmaxc_ne; STRICT inequality so boundary ties refire
+    ok = jnp.asarray(
+        (overflow_bound < kth) | ~jnp.isfinite(overflow_bound),
+        jnp.int32)
+    return out_vals, out_ids, ok
+
+
+def _essential_one(block_docids, block_tfs, flat_docids, flat_tfs,
+                   sel_blocks, sel_weights, doc_lens, live_col,
+                   ne_start, ne_len, ne_idf, ne_bound,
+                   avg_len, k1: float, b: float, k: int):
+    dt = _score_dtype()
+    cand_ids, ess, overflow_bound = _essential_phase1(
+        block_docids, block_tfs, sel_blocks, sel_weights, doc_lens,
+        live_col, ne_bound, avg_len, k1, b)
 
     # ---- phase 2: patch non-essential contributions per candidate
     safe_ids = jnp.clip(cand_ids, 0, doc_lens.shape[0] - 1)
@@ -216,30 +257,7 @@ def _essential_one(block_docids, block_tfs, flat_docids, flat_tfs,
         patched = jnp.where(jnp.isfinite(patched), patched + add,
                             patched)
 
-    # ---- exact ordering over the candidate set: ONE small 2-key sort.
-    # Rank by the REPORTED float32 score with docid-ascending ties —
-    # the same contract as the full kernel (equal f32 scores order by
-    # docid), so a query returns identical hit order cold and θ-warm.
-    disp = patched.astype(jnp.float32)
-    neg = jnp.where(jnp.isfinite(disp), -disp,
-                    jnp.asarray(jnp.inf, jnp.float32))
-    tie_ids = jnp.where(jnp.isfinite(disp), cand_ids, _SENTINEL)
-    _skey, sids, svals, sdt = jax.lax.sort(
-        (neg, tie_ids, disp, patched.astype(dt)), num_keys=2)
-    out_vals = svals[:k]
-    out_ids = jnp.where(jnp.isfinite(out_vals), sids[:k], _SENTINEL)
-    # certificate bound: the MINIMUM full-precision score among the
-    # selected k (f32 rounding of the kth must not certify upward)
-    kth = jnp.min(jnp.where(jnp.isfinite(out_vals), sdt[:k],
-                            jnp.asarray(jnp.inf, dt)))
-    kth = jnp.where(jnp.isfinite(out_vals[k - 1]), kth,
-                    jnp.asarray(-jnp.inf, dt))
-    # every doc outside the top-C candidates is bounded by
-    # ess_(C+1)+Σmaxc_ne; STRICT inequality so boundary ties refire
-    ok = jnp.asarray(
-        (overflow_bound < kth) | ~jnp.isfinite(overflow_bound),
-        jnp.int32)
-    return out_vals, out_ids, ok
+    return _essential_epilogue(patched, cand_ids, overflow_bound, k)
 
 
 @partial(jax.jit, static_argnames=("k1", "b", "k"))
@@ -266,6 +284,90 @@ def bm25_essential_topk_batch(block_docids, block_tfs,
 
     vals, ids, ok = jax.vmap(one)(sel_blocks, sel_weights, mask_ids,
                                   ne_start, ne_len, ne_idf, ne_bound)
+    ids_f = jax.lax.bitcast_convert_type(ids, jnp.float32)
+    ok_f = jax.lax.bitcast_convert_type(ok, jnp.float32)
+    return jnp.concatenate([vals, ids_f, ok_f[:, None]], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Dense-patch essential lane: the θ-warm fast lane for the DEGRADED
+# tunnel regime (opportunistic on attached hardware — cohorts upgrade
+# to it when every NE term has a dense row, else the binary lane
+# below serves them).
+#
+# The binary-search patch phase above costs NE_SLOTS×21 DEPENDENT
+# gathers over the 47M-lane flat postings — fine when a gather is ~µs
+# on attached hardware, catastrophic in the tunnel's degraded mode
+# where every dependent device op pays a sync (measured 862 ms/launch
+# vs 151 ms for the plain nb-256 kernel at 2M docs). But the
+# non-essential terms are BY CONSTRUCTION the high-df ones (MaxScore
+# splits on max contribution ≈ ascending idf), so a dense [H, ND]
+# tf table over the ~hundred hottest terms is small (f16, tf counts
+# are exact integers < 2048) and turns the whole patch into ONE flat
+# gather per NE slot: dense_tf[row*ND + cand_id]. Same certificate,
+# same exactness contract, ~20 ops instead of ~170 dependent gathers.
+# ---------------------------------------------------------------------------
+
+
+def _essential_dense_one(block_docids, block_tfs, dense_tf, sel_blocks,
+                         sel_weights, doc_lens, live_col,
+                         ne_row, ne_idf, ne_bound,
+                         avg_len, k1: float, b: float, k: int):
+    dt = _score_dtype()
+    nd = doc_lens.shape[0]
+    cand_ids, ess, overflow_bound = _essential_phase1(
+        block_docids, block_tfs, sel_blocks, sel_weights, doc_lens,
+        live_col, ne_bound, avg_len, k1, b)
+
+    # ---- phase 2: dense-table patch — one gather per NE slot
+    safe_ids = jnp.clip(cand_ids, 0, nd - 1)
+    cdl = jnp.take(doc_lens, safe_ids).astype(dt)
+    cnorm = k1 * (1.0 - b + b * cdl / jnp.asarray(avg_len, dt))
+    patched = jnp.where(jnp.isfinite(ess), ess,
+                        jnp.asarray(-jnp.inf, dt))
+    flat_dense = dense_tf.reshape(-1)
+    # flat-index dtype: int64 only exists under x64; with x64 off the
+    # BUILDER's h cap (search/fastpath.py _build_dense_hot) is the sole
+    # guarantee that rows*docs stays under 2^31 — keep it if you touch
+    # either side
+    idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    for ti in range(NE_SLOTS):
+        row = ne_row[ti]                       # -1 ⇒ slot unused
+        srow = jnp.maximum(row, 0).astype(idt)
+        idx = srow * nd + safe_ids.astype(idt)
+        ptf = jnp.take(flat_dense, idx).astype(dt)
+        ptf = jnp.where(row >= 0, ptf, 0.0)
+        add = jnp.where(ptf > 0.0,
+                        ne_idf[ti].astype(dt) * ptf / (ptf + cnorm),
+                        0.0)
+        patched = jnp.where(jnp.isfinite(patched), patched + add,
+                            patched)
+
+    return _essential_epilogue(patched, cand_ids, overflow_bound, k)
+
+
+@partial(jax.jit, static_argnames=("k1", "b", "k"))
+def bm25_essential_dense_topk_batch(block_docids, block_tfs,
+                                    dense_tf,      # f16 [H, ND] hot-term tf
+                                    sel_blocks,    # int32 [Q, NBe]
+                                    sel_weights,   # rail [Q, NBe]
+                                    doc_lens, masks, mask_ids,
+                                    ne_row,        # int32 [Q, NE_SLOTS] row
+                                    ne_idf,        # rail [Q, NE_SLOTS]
+                                    ne_bound,      # rail [Q] Σ maxc_ne
+                                    avg_len, k1: float, b: float, k: int):
+    """θ-warm essential lane with the DENSE hot-term patch. Packing is
+    the binary-search lane's: float32 [Q, 2k+1] =
+    ``[values (k) | docids bitcast (k) | ok_flag bitcast (1)]``;
+    ok=0 rows refire on the full kernel."""
+    def one(s, w, mid, nr, ni, nb):
+        live_col = jnp.take(masks, mid, axis=0)
+        return _essential_dense_one(block_docids, block_tfs, dense_tf,
+                                    s, w, doc_lens, live_col,
+                                    nr, ni, nb, avg_len, k1, b, k)
+
+    vals, ids, ok = jax.vmap(one)(sel_blocks, sel_weights, mask_ids,
+                                  ne_row, ne_idf, ne_bound)
     ids_f = jax.lax.bitcast_convert_type(ids, jnp.float32)
     ok_f = jax.lax.bitcast_convert_type(ok, jnp.float32)
     return jnp.concatenate([vals, ids_f, ok_f[:, None]], axis=1)

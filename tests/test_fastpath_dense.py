@@ -1,0 +1,212 @@
+"""Dense-patch essential lane (ops/fastpath.bm25_essential_dense_topk_batch):
+identical certified outputs to the binary-search patch lane and to the
+full exact v1 kernel, honest ok=0 when the certificate can't close.
+
+The dense lane exists for the degraded-tunnel serving regime, where the
+binary-search patch's ~170 dependent gathers cost more than the full
+kernel they replace (BASELINE.md round-5 notes); its contract is the
+binary lane's, so the tests drive both through the same splits.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from elasticsearch_tpu.ops import fastpath as fp
+
+BLOCK = 128
+K1, B = 1.2, 0.75
+
+
+def build_segment(rng, n_docs=600, n_hot=2, n_rare=3):
+    """Hot terms (high df, low idf — the NE side of a MaxScore split)
+    plus rare terms; block layout like index/segment.py."""
+    blocks_d, blocks_t = [], []
+    tbs, nb, dfs = [], [], []
+    next_block = 0
+    terms = []
+    for i in range(n_hot + n_rare):
+        df = int(rng.integers(int(n_docs * 0.6), n_docs)) if i < n_hot \
+            else int(rng.integers(8, 40))
+        docs = np.sort(rng.choice(n_docs, size=df,
+                                  replace=False)).astype(np.int32)
+        tfs = rng.integers(1, 6, size=df).astype(np.float32)
+        nblk = (df + BLOCK - 1) // BLOCK
+        tbs.append(next_block)
+        nb.append(nblk)
+        dfs.append(df)
+        next_block += nblk
+        pad = nblk * BLOCK - df
+        blocks_d.append(np.concatenate(
+            [docs, np.zeros(pad, np.int32)]).reshape(nblk, BLOCK))
+        blocks_t.append(np.concatenate(
+            [tfs, np.zeros(pad, np.float32)]).reshape(nblk, BLOCK))
+        terms.append((docs, tfs))
+    blocks_d.append(np.zeros((1, BLOCK), np.int32))
+    blocks_t.append(np.zeros((1, BLOCK), np.float32))
+    bd = np.concatenate(blocks_d)
+    bt = np.concatenate(blocks_t)
+    lens = rng.integers(5, 80, size=n_docs).astype(np.float32)
+    return dict(bd=bd, bt=bt, tbs=np.asarray(tbs), nb=np.asarray(nb),
+                dfs=np.asarray(dfs), zero_block=bd.shape[0] - 1,
+                lens=lens, avg=float(lens.mean()), terms=terms,
+                flat_d=bd.reshape(-1), flat_t=bt.reshape(-1),
+                n_docs=n_docs, n_hot=n_hot)
+
+
+def idf_of(seg, t):
+    n = seg["n_docs"]
+    df = seg["dfs"][t]
+    return float(np.log1p((n - df + 0.5) / (df + 0.5)))
+
+
+def dense_table(seg):
+    """[H, ND] exact tf rows for the hot terms (float16: counts < 2048)."""
+    h = seg["n_hot"]
+    dense = np.zeros((h, seg["n_docs"]), np.float16)
+    for t in range(h):
+        docs, tfs = seg["terms"][t]
+        dense[t, docs] = tfs
+    return dense
+
+
+def full_v1(seg, ess_and_ne, k, masks=None, mask_id=0):
+    """Reference: the exact full kernel over ALL the query's terms."""
+    q = 1
+    nbk = 64
+    sel = np.full((q, nbk), seg["zero_block"], np.int32)
+    ws = np.zeros((q, nbk), np.float64)
+    pos = 0
+    for t in ess_and_ne:
+        cnt = int(seg["nb"][t])
+        start = int(seg["tbs"][t])
+        sel[0, pos:pos + cnt] = np.arange(start, start + cnt)
+        ws[0, pos:pos + cnt] = idf_of(seg, t)
+        pos += cnt
+    if masks is None:
+        masks = np.ones((fp.F_SLOTS, seg["n_docs"]), bool)
+    out = np.asarray(fp.bm25_topk_total_batch(
+        seg["bd"], seg["bt"], sel, ws, seg["lens"], masks,
+        np.full(q, mask_id, np.int32), np.float64(seg["avg"]),
+        K1, B, k))
+    vals = out[0, :k]
+    ids = out[0, k:2 * k].view(np.int32)
+    order = np.lexsort((ids, -vals))
+    return vals[order], ids[order], int(out[0, 2 * k:].view(np.int32)[0])
+
+
+def run_lanes(seg, ess, ne, ne_bound, k, masks=None, mask_id=0):
+    """(binary_out, dense_out) for the same essential/NE split."""
+    q = 1
+    nbk = 64
+    sel = np.full((q, nbk), seg["zero_block"], np.int32)
+    ws = np.zeros((q, nbk), np.float64)
+    pos = 0
+    for t in ess:
+        cnt = int(seg["nb"][t])
+        start = int(seg["tbs"][t])
+        sel[0, pos:pos + cnt] = np.arange(start, start + cnt)
+        ws[0, pos:pos + cnt] = idf_of(seg, t)
+        pos += cnt
+    ne_start = np.zeros((q, fp.NE_SLOTS), np.int32)
+    ne_len = np.zeros((q, fp.NE_SLOTS), np.int32)
+    ne_row = np.full((q, fp.NE_SLOTS), -1, np.int32)
+    ne_idf = np.zeros((q, fp.NE_SLOTS), np.float64)
+    for i, t in enumerate(ne):
+        ne_start[0, i] = int(seg["tbs"][t]) * BLOCK
+        ne_len[0, i] = int(seg["dfs"][t])
+        ne_row[0, i] = t            # dense rows are the hot-term index
+        ne_idf[0, i] = idf_of(seg, t)
+    nbound = np.full(q, ne_bound, np.float64)
+    if masks is None:
+        masks = np.ones((fp.F_SLOTS, seg["n_docs"]), bool)
+    mids = np.full(q, mask_id, np.int32)
+    binary = np.asarray(fp.bm25_essential_topk_batch(
+        seg["bd"], seg["bt"], seg["flat_d"], seg["flat_t"], sel, ws,
+        seg["lens"], masks, mids, ne_start, ne_len, ne_idf, nbound,
+        np.float64(seg["avg"]), K1, B, k))
+    dense = np.asarray(fp.bm25_essential_dense_topk_batch(
+        seg["bd"], seg["bt"], dense_table(seg), sel, ws,
+        seg["lens"], masks, mids, ne_row, ne_idf, nbound,
+        np.float64(seg["avg"]), K1, B, k))
+    return binary, dense
+
+
+def unpack(out, k):
+    vals = out[0, :k]
+    ids = out[0, k:2 * k].view(np.int32)
+    ok = int(out[0, 2 * k:].view(np.int32)[0])
+    return vals, ids, ok
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_dense_matches_binary_and_full(seed):
+    rng = np.random.default_rng(seed)
+    seg = build_segment(rng)
+    k = 10
+    query = [2, 3, 0]       # two rare + one hot
+    ess, ne = [2, 3], [0]
+    # a true Σ maxc_ne bound for term 0
+    docs, tfs = seg["terms"][0]
+    norm_min = K1 * (1 - B + B * seg["lens"][docs].min() / seg["avg"])
+    bound = idf_of(seg, 0) * float(
+        (tfs / (tfs + norm_min)).max()) + 1e-9
+    fv, fi, _ftot = full_v1(seg, query, k)
+    binary, dense = run_lanes(seg, ess, ne, bound, k)
+    bv, bi, bok = unpack(binary, k)
+    dv, di, dok = unpack(dense, k)
+    assert bok == dok
+    np.testing.assert_array_equal(bi, di)
+    np.testing.assert_allclose(bv, dv, rtol=0, atol=0)
+    if dok:
+        np.testing.assert_array_equal(di, fi)
+        np.testing.assert_allclose(dv, fv, rtol=0, atol=0)
+
+
+def test_dense_unused_slots_are_inert():
+    rng = np.random.default_rng(7)
+    seg = build_segment(rng)
+    k = 5
+    # no NE terms at all: both lanes degenerate to the essential union
+    binary, dense = run_lanes(seg, [2, 3], [], 0.0, k)
+    np.testing.assert_array_equal(binary, dense)
+
+
+def test_dense_respects_filter_mask():
+    rng = np.random.default_rng(11)
+    seg = build_segment(rng)
+    k = 5
+    masks = np.ones((fp.F_SLOTS, seg["n_docs"]), bool)
+    masks[3] = False
+    masks[3, : seg["n_docs"] // 2] = True      # keep low half only
+    docs, tfs = seg["terms"][0]
+    bound = idf_of(seg, 0) * 1.0 + 1e-9
+    binary, dense = run_lanes(seg, [2, 3], [0], bound, k,
+                              masks=masks, mask_id=3)
+    bv, bi, bok = unpack(binary, k)
+    dv, di, dok = unpack(dense, k)
+    assert bok == dok
+    np.testing.assert_array_equal(bi, di)
+    finite = np.isfinite(dv)
+    assert np.all(di[finite] < seg["n_docs"] // 2)
+
+
+def test_dense_certificate_refuses_when_bound_wide():
+    """A huge Σ maxc_ne makes overflow_bound beat the kth — both lanes
+    must refuse (ok=0) instead of certifying a possibly-wrong top-k.
+    The essential union must exceed CAND docs (otherwise every match is
+    a candidate and the certificate closes trivially — correctly)."""
+    rng = np.random.default_rng(13)
+    seg = build_segment(rng, n_docs=fp.CAND + 1200, n_hot=2, n_rare=1)
+    # make hot term 0's df exceed CAND so phase 1 overflows
+    assert seg["dfs"][0] > fp.CAND * 0.55
+    while seg["dfs"][0] <= fp.CAND:
+        seg = build_segment(np.random.default_rng(
+            int(rng.integers(1 << 30))), n_docs=fp.CAND + 1200,
+            n_hot=2, n_rare=1)
+    k = 10
+    binary, dense = run_lanes(seg, [0], [1], 1e6, k)
+    _bv, _bi, bok = unpack(binary, k)
+    _dv, _di, dok = unpack(dense, k)
+    assert bok == 0 and dok == 0
