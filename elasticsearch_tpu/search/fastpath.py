@@ -69,12 +69,25 @@ def probe_regime() -> str:
             return _REGIME
         import jax
 
-        try:
-            from jax._src import xla_bridge
-            pv = str(getattr(xla_bridge.get_backend(),
-                             "platform_version", "")).lower()
-        except Exception:
-            pv = ""
+        pv = ""
+
+        def _via_extend():
+            import jax.extend.backend
+            return jax.extend.backend.get_backend().platform_version
+
+        for read in (
+                lambda: jax.devices()[0].client.platform_version,
+                _via_extend,
+                lambda: __import__(
+                    "jax._src.xla_bridge", fromlist=["x"]
+                ).get_backend().platform_version,
+        ):
+            try:
+                pv = str(read()).lower()
+                if pv:
+                    break
+            except Exception:
+                continue
         if "axon" in pv:
             _REGIME = "tunnel"
             logger.info("serving regime: tunnel (relayed platform: %s)",
