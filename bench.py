@@ -1052,6 +1052,14 @@ def main():
         emit(compose_metric(parts), value,
              value / cpu if cpu else float("nan"))
 
+    # persistent compile cache from the first jax use: raw-kernel and
+    # serving shapes compile once per machine (14.4s -> 0.7s measured)
+    try:
+        from elasticsearch_tpu.search.fastpath import enable_compile_cache
+        enable_compile_cache()
+    except Exception as e:
+        log(f"compile cache unavailable: {e!r}")
+
     rng = np.random.default_rng(12345)
     corpus = build_corpus(rng)
     queries = make_queries(rng, corpus["df"])

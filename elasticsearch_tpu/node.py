@@ -213,6 +213,14 @@ class Node:
         self.monitoring_service.start()
         from elasticsearch_tpu.transport.remote import RemoteClusterService
         self.remote_cluster_service = RemoteClusterService(self)
+        # static cluster.remote.* settings connect at startup, same as
+        # the dynamic _cluster/settings surface (ref:
+        # RemoteClusterService#listenForUpdates + initial settings)
+        try:
+            self.remote_cluster_service.apply_settings(
+                self.settings.as_dict())
+        except Exception:
+            logger.exception("initial remote-cluster settings invalid")
         # persistent cluster-settings overlay (the _cluster/settings API)
         self.persistent_settings = {}
         from elasticsearch_tpu.xpack.ccr import CcrService

@@ -21,6 +21,7 @@ Implemented aggs:
 from __future__ import annotations
 
 import math
+import re
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -42,7 +43,8 @@ METRIC_AGGS = {"avg", "sum", "min", "max", "value_count", "stats",
                # x-pack analytics + aggs-matrix-stats parity
                "boxplot", "top_metrics", "string_stats", "matrix_stats",
                "median_absolute_deviation", "t_test"}
-BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range", "filter",
+BUCKET_AGGS = {"terms", "histogram", "date_histogram", "range",
+               "date_range", "filter",
                "filters", "missing", "global", "composite", "nested",
                "significant_terms", "significant_text", "sampler",
                "diversified_sampler", "rare_terms", "multi_terms",
@@ -163,6 +165,10 @@ def _strip_internal(node) -> None:
         # named "_set" is a JSON value and passes through untouched
         if isinstance(node.get("_set"), set):
             del node["_set"]
+        # raw-sample carrier for moving_percentiles (an ndarray can
+        # never appear as a user JSON value)
+        if isinstance(node.get("_values"), np.ndarray):
+            del node["_values"]
         for k, v in node.items():
             if k != "_source":
                 _strip_internal(v)
@@ -675,8 +681,13 @@ def _metric(agg_type, body, ctx, mapper):
                 "variance": var, "std_deviation": math.sqrt(var)}
     if agg_type == "percentiles":
         percents = body.get("percents", [1, 5, 25, 50, 75, 95, 99])
+        # "_values" carries the raw sample for moving_percentiles'
+        # window merge (the reference merges TDigest states; exact
+        # values are this engine's digest) — stripped before the
+        # response leaves the agg layer (_strip_internal)
         return {"values": {str(float(p)): float(np.percentile(values, p))
-                           for p in percents}}
+                           for p in percents},
+                "_values": values}
     if agg_type == "percentile_ranks":
         targets = body.get("values", [])
         return {"values": {str(float(t)): float((values <= t).mean() * 100.0)
@@ -695,7 +706,8 @@ def _refine(ctx: CollectCtx, submasks: List[np.ndarray]) -> CollectCtx:
 PARENT_PIPELINES = {"cumulative_sum", "derivative",
                     "cumulative_cardinality", "bucket_sort",
                     "moving_fn", "moving_avg", "serial_diff",
-                    "bucket_script", "bucket_selector"}
+                    "bucket_script", "bucket_selector",
+                    "moving_percentiles", "normalize"}
 
 
 def _split_parent_pipelines(sub: Dict[str, Any]):
@@ -782,6 +794,77 @@ def _apply_parent_pipelines(parents, buckets: List[Dict[str, Any]]):
                 if i >= lag and series[i] is not None \
                         and series[i - lag] is not None:
                     b[name] = {"value": series[i] - series[i - lag]}
+        elif ptype == "moving_percentiles":
+            # ref: x-pack/plugin/analytics/.../MovingPercentilesPipeline
+            # Aggregator.java:31 — slide a window over a sibling
+            # percentiles metric, merging the windowed digests; this
+            # engine's digest is the exact sample ("_values" carrier on
+            # the percentiles result), so the merge is concatenation.
+            window = int(body.get("window", 5))
+            shift = int(body.get("shift", 0))
+            metric = path.partition(".")[0].partition(">")[0]
+            samples = []
+            pcts = None
+            for b in buckets:
+                node = b.get(metric) or {}
+                samples.append(node.get("_values"))
+                if pcts is None and node.get("values"):
+                    pcts = [float(p) for p in node["values"]]
+            pcts = pcts or [1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0]
+            for i, b in enumerate(buckets):
+                # MovFn indexing (ref: MovingPercentiles reduce:
+                # [i - window + shift, i + shift)) — the window ends
+                # BEFORE the current bucket at shift=0, same as the
+                # moving_fn branch above
+                lo = max(0, i - window + shift)
+                hi = max(lo, min(len(buckets), i + shift))
+                win = [s for s in samples[lo:hi]
+                       if s is not None and len(s)]
+                if not win:
+                    b[name] = {"values": {}}
+                    continue
+                merged = np.concatenate(win)
+                b[name] = {"values": {
+                    str(p): float(np.percentile(merged, p))
+                    for p in pcts}}
+        elif ptype == "normalize":
+            # ref: x-pack/plugin/analytics/.../normalize/
+            # NormalizePipelineAggregationBuilder — rescale a bucket
+            # metric across the whole bucket list
+            method = str(body.get("method", "percent_of_sum"))
+            series = [_bucket_metric_value(b, path) for b in buckets]
+            vals = np.asarray([v for v in series if v is not None],
+                              np.float64)
+            n = len(vals)
+            lo = float(vals.min()) if n else 0.0
+            hi = float(vals.max()) if n else 0.0
+            total = float(vals.sum()) if n else 0.0
+            mean = float(vals.mean()) if n else 0.0
+            std = float(vals.std()) if n else 0.0
+            emax = float(np.exp(vals - vals.max()).sum()) if n else 0.0
+
+            def norm_one(v):
+                if v is None:
+                    return None
+                if method == "rescale_0_1":
+                    return 0.0 if hi == lo else (v - lo) / (hi - lo)
+                if method == "rescale_0_100":
+                    return 0.0 if hi == lo else \
+                        100.0 * (v - lo) / (hi - lo)
+                if method == "percent_of_sum":
+                    return None if total == 0 else v / total
+                if method == "mean":
+                    return 0.0 if hi == lo else (v - mean) / (hi - lo)
+                if method in ("z-score", "zscore"):
+                    return None if std == 0 else (v - mean) / std
+                if method == "softmax":
+                    return None if emax == 0 else \
+                        float(np.exp(v - hi)) / emax
+                raise IllegalArgumentException(
+                    f"invalid normalize method [{method}]")
+
+            for b, v in zip(buckets, series):
+                b[name] = {"value": norm_one(v)}
         elif ptype in ("bucket_script", "bucket_selector"):
             # ref: pipeline/BucketScriptPipelineAggregator (per-bucket
             # computed metric) and BucketSelectorPipelineAggregator
@@ -1748,6 +1831,80 @@ def _bucket(agg_type, body, sub, ctx, mapper):
                 extra["to"] = float(to)
             buckets.append(_bucket_result(sub, _refine(ctx, submasks), mapper,
                                           count, extra))
+        return {"buckets": buckets}
+
+    if agg_type == "date_range":
+        # ref: bucket/range/DateRangeAggregationBuilder.java:39 — range
+        # buckets over a date field; from/to accept epoch millis, the
+        # mapper's date formats, and `now` date math (now-7d, now+1h/d)
+        field = body.get("field")
+        ft = mapper.field_type(field) if mapper is not None else None
+
+        def to_ms(v):
+            if v is None:
+                return None
+            if isinstance(v, (int, float)):
+                return float(v)
+            s = str(v)
+            m = re.fullmatch(
+                r"now(?:([+-]\d+)([smhdwMy]))?(?:/([smhdwMy]))?", s)
+            if m:
+                import time as _time
+                ms = _time.time() * 1000.0
+                if m.group(1):
+                    mult = {"s": 1e3, "m": 60e3, "h": 3600e3,
+                            "d": 86400e3, "w": 7 * 86400e3,
+                            "M": 30 * 86400e3, "y": 365 * 86400e3}
+                    ms += int(m.group(1)) * mult[m.group(2)]
+                if m.group(3):      # rounding: floor to the unit start
+                    u = m.group(3)
+                    if u in ("w", "M", "y"):
+                        # REAL calendar boundaries (ISO weeks, month
+                        # and year starts) — the fixed-size flooring
+                        # the smaller units use would land mid-month
+                        cal = {"w": "week", "M": "month",
+                               "y": "year"}[u]
+                        ms = float(_calendar_floor_ms(
+                            np.array([ms]), cal)[0])
+                    else:
+                        fixed = {"s": 1e3, "m": 60e3, "h": 3600e3,
+                                 "d": 86400e3}[u]
+                        ms = math.floor(ms / fixed) * fixed
+                return ms
+            if ft is not None and hasattr(ft, "parse"):
+                return float(ft.parse(s))
+            raise IllegalArgumentException(
+                f"cannot parse date range bound [{v}]")
+
+        buckets = []
+        for r in body.get("ranges", []):
+            frm = to_ms(r.get("from"))
+            to = to_ms(r.get("to"))
+            submasks = []
+            count = 0
+            for seg, mask, _m in ctx:
+                vv, m = _first_values_and_mask(seg, mask, field)
+                if vv is None:
+                    submasks.append(np.zeros(seg.n_docs, bool))
+                    continue
+                in_r = m.copy()
+                if frm is not None:
+                    in_r &= vv >= frm
+                if to is not None:
+                    in_r &= vv < to
+                submasks.append(in_r)
+                count += int(in_r.sum())
+            frm_s = _ms_to_iso(frm) if frm is not None else "*"
+            to_s = _ms_to_iso(to) if to is not None else "*"
+            extra = {"key": r.get("key", f"{frm_s}-{to_s}")}
+            if frm is not None:
+                extra["from"] = frm
+                extra["from_as_string"] = frm_s
+            if to is not None:
+                extra["to"] = to
+                extra["to_as_string"] = to_s
+            buckets.append(_bucket_result(sub, _refine(ctx, submasks),
+                                          mapper, count, extra))
         return {"buckets": buckets}
 
     if agg_type == "geo_distance":

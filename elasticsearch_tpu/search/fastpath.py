@@ -556,16 +556,22 @@ class FastPathServer:
         # host around there, and a stop() during warm only has to drain
         # 4 in-flight compiles (queued jobs see _running and skip)
         t0 = time.time()
-        with ThreadPoolExecutor(max_workers=min(4, max(1, len(jobs)))) \
-                as ex:
-            futs = [ex.submit(fn, nb) for fn, nb in jobs
-                    if self._running]
-            for f in futs:
-                try:
-                    logger.info("fastpath warm %s (t+%.1fs)", f.result(),
-                                time.time() - t0)
-                except Exception:
-                    logger.exception("fastpath warm compile failed")
+        try:
+            with ThreadPoolExecutor(
+                    max_workers=min(4, max(1, len(jobs)))) as ex:
+                futs = [ex.submit(fn, nb) for fn, nb in jobs
+                        if self._running]
+                for f in futs:
+                    try:
+                        logger.info("fastpath warm %s (t+%.1fs)",
+                                    f.result(), time.time() - t0)
+                    except Exception:
+                        logger.exception("fastpath warm compile failed")
+        except RuntimeError:
+            # interpreter shutdown while the drain thread was still
+            # registering — nothing to warm for, just exit quietly
+            if self._running:
+                raise
 
     # --------------------------------------------------------------- drain
     def _drain_loop(self):

@@ -71,6 +71,106 @@ class RemoteClusterClient:
         return self.request("POST", f"/{index}/_search", body)
 
 
+class ProxyRemoteClusterClient(RemoteClusterClient):
+    """Proxy connection strategy (ref: transport/
+    ProxyConnectionStrategy.java:49): ONE configured address — usually
+    a load balancer in front of the remote cluster — with a bounded
+    pool of PERSISTENT connections and no sniffing (the local cluster
+    never learns remote topology, which is the point: proxy mode works
+    where only the LB is routable). Re-design for this engine's
+    HTTP-based DCN path: the pool holds keep-alive
+    ``http.client.HTTPConnection`` objects, checked out per request,
+    re-dialed transparently when the LB drops one."""
+
+    def __init__(self, alias: str, proxy_address: str,
+                 socket_connections: int = 6, timeout: float = 10.0):
+        super().__init__(alias, [proxy_address], timeout)
+        self.proxy_address = proxy_address
+        self.socket_connections = max(1, int(socket_connections))
+        self._pool: List[Any] = []
+        self._pool_lock = threading.Lock()
+        self._created = 0
+
+    def _checkout(self):
+        import http.client
+        with self._pool_lock:
+            if self._pool:
+                return self._pool.pop()
+            self._created += 1
+        host, _, port = self.proxy_address.partition(":")
+        return http.client.HTTPConnection(
+            host, int(port or 80), timeout=self.timeout)
+
+    def _checkin(self, conn):
+        with self._pool_lock:
+            if len(self._pool) < self.socket_connections:
+                self._pool.append(conn)
+                return
+            self._created -= 1
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+    def _dial_fresh(self):
+        import http.client
+        with self._pool_lock:
+            self._created += 1
+        host, _, port = self.proxy_address.partition(":")
+        return http.client.HTTPConnection(
+            host, int(port or 80), timeout=self.timeout)
+
+    def request(self, method: str, path: str,
+                body: Optional[Any] = None) -> Dict[str, Any]:
+        import http.client
+
+        data = json.dumps(body).encode() if body is not None else None
+        last_err: Optional[Exception] = None
+        # attempt 0 may pop a stale pooled socket (LB idle timeout);
+        # the retry dials FRESH — several pooled sockets can be dead
+        # at once, so popping the pool again would just fail again
+        for attempt in range(2):
+            conn = self._checkout() if attempt == 0 else \
+                self._dial_fresh()
+            try:
+                conn.request(method, path, body=data,
+                             headers={"Content-Type":
+                                      "application/json"})
+                resp = conn.getresponse()
+                text = resp.read().decode()
+                if resp.status >= 400:
+                    self._checkin(conn)
+                    raise ElasticsearchTpuException(
+                        f"remote cluster [{self.alias}] returned "
+                        f"{resp.status}: {text[:400]}")
+                self._checkin(conn)
+                try:
+                    return json.loads(text)
+                except ValueError:
+                    return {"_cat": text}
+            except ElasticsearchTpuException:
+                raise
+            except (OSError, http.client.HTTPException) as e:
+                # stale pooled socket, LB reset, malformed LB response
+                last_err = e
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                with self._pool_lock:
+                    self._created -= 1
+                continue
+        raise ElasticsearchTpuException(
+            f"cannot connect to remote cluster [{self.alias}] via "
+            f"proxy {self.proxy_address}: {last_err}")
+
+    def pool_stats(self) -> Dict[str, int]:
+        with self._pool_lock:
+            return {"pooled": len(self._pool),
+                    "created": self._created,
+                    "max": self.socket_connections}
+
+
 class RemoteClusterService:
     """Registry of remote clusters + index-expression resolution (ref:
     RemoteClusterService.groupIndices)."""
@@ -94,6 +194,21 @@ class RemoteClusterService:
                 flat.setdefault(alias, {})[leaf] = v
         merged = {**remote, **flat}
         for alias, cfg in merged.items():
+            mode = str(cfg.get("mode", "sniff"))
+            if mode == "proxy" or "proxy_address" in cfg:
+                # proxy connection strategy (ref:
+                # ProxyConnectionStrategy.java:49)
+                addr = cfg.get("proxy_address")
+                if addr in (None, ""):
+                    with self._lock:
+                        self._clusters.pop(alias, None)
+                    continue
+                with self._lock:
+                    self._clusters[alias] = ProxyRemoteClusterClient(
+                        alias, str(addr),
+                        socket_connections=int(cfg.get(
+                            "proxy_socket_connections", 6)))
+                continue
             if "seeds" not in cfg:
                 continue            # unrelated leaf (skip_unavailable, …)
             seeds = cfg["seeds"]
@@ -126,9 +241,19 @@ class RemoteClusterService:
                 c.request("GET", "/")
             except ElasticsearchTpuException:
                 connected = False
-            out[alias] = {"connected": connected, "seeds": c.seeds,
-                          "mode": "sniff",
-                          "num_nodes_connected": 1 if connected else 0}
+            if isinstance(c, ProxyRemoteClusterClient):
+                out[alias] = {
+                    "connected": connected, "mode": "proxy",
+                    "proxy_address": c.proxy_address,
+                    "max_proxy_socket_connections":
+                        c.socket_connections,
+                    "num_proxy_sockets_connected":
+                        c.pool_stats()["created"] if connected else 0}
+            else:
+                out[alias] = {"connected": connected, "seeds": c.seeds,
+                              "mode": "sniff",
+                              "num_nodes_connected":
+                                  1 if connected else 0}
         return out
 
     # -------------------------------------------------------- resolution

@@ -785,3 +785,46 @@ class MlService:
         for doc in docs:
             out.append({"predicted_value": self._predict(model, doc)})
         return out
+
+
+# ---------------------------------------------------------------------------
+# Inference ingest processor: trained-model predictions INSIDE ingest
+# pipelines (ref: x-pack/plugin/ml/.../inference/ingest/
+# InferenceProcessor.java:59). `field_map` renames document fields to
+# the model's feature names before inference; the prediction lands at
+# `target_field` as {predicted_value, model_id} — the reference's
+# result layout.
+# ---------------------------------------------------------------------------
+
+from elasticsearch_tpu.ingest.service import processor as _ingest_processor
+
+
+@_ingest_processor("inference")
+def _inference_processor(cfg, svc):
+    model_id = cfg["model_id"]
+    target = cfg.get("target_field", "ml.inference")
+    field_map: Dict[str, str] = cfg.get("field_map") or {}
+    ignore_missing = bool(cfg.get("ignore_missing", False))
+
+    def fn(doc):
+        node = getattr(svc, "node", None)
+        if node is None or not hasattr(node, "ml_service"):
+            raise IllegalArgumentException(
+                "inference processor requires the ml service")
+        model = node.ml_service.get_trained_model(model_id)
+        feats: Dict[str, Any] = {}
+        for f in model.get("feature_names", []):
+            # field_map maps DOC field -> MODEL feature name
+            src_field = next(
+                (k for k, v in field_map.items() if v == f), f)
+            v = doc.get(src_field)
+            if v is None and not ignore_missing:
+                raise IllegalArgumentException(
+                    f"field [{src_field}] is missing for model "
+                    f"[{model_id}]")
+            feats[f] = v
+        result = node.ml_service.infer(model_id, [feats])[0]
+        doc.set(target + ".predicted_value",
+                result["predicted_value"])
+        doc.set(target + ".model_id", model_id)
+    return fn

@@ -12,10 +12,13 @@ API accepts externally collected documents (the Kibana/Logstash path).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("elasticsearch_tpu.monitoring")
 
 
 class MonitoringService:
@@ -92,6 +95,43 @@ class MonitoringService:
 
     # ----------------------------------------------------------- exporter
     def _export(self, docs: List[Dict[str, Any]]):
+        """Route documents through every configured exporter (ref:
+        exporter/Exporters.java — multiple exporters fan out). The
+        local exporter always runs unless explicitly disabled; http
+        exporters ship to remote monitoring clusters."""
+        cfg = self.node.settings.by_prefix(
+            "xpack.monitoring.exporters").as_nested_dict()
+        local_enabled = True
+        http_exporters = []
+        if isinstance(cfg, dict):
+            for ename, espec in cfg.items():
+                if not isinstance(espec, dict):
+                    continue
+                etype = str(espec.get("type", "local"))
+                if etype == "local":
+                    local_enabled = str(espec.get(
+                        "enabled", "true")).lower() != "false"
+                elif etype == "http" and str(espec.get(
+                        "enabled", "true")).lower() != "false":
+                    http_exporters.append((ename, espec))
+        if local_enabled:
+            self._export_local(docs)
+        for ename, espec in http_exporters:
+            try:
+                self._export_http(ename, espec, docs)
+            except Exception as e:
+                # a broken remote must never stop local collection
+                # (ref: HttpExporter resiliency) — but the failure is
+                # operator-visible: logged + recorded per exporter
+                logger.warning("monitoring http exporter [%s] failed: "
+                               "%r", ename, e)
+                if not hasattr(self, "export_errors"):
+                    self.export_errors = {}
+                self.export_errors[ename] = {
+                    "error": repr(e),
+                    "timestamp": int(time.time() * 1000)}
+
+    def _export_local(self, docs: List[Dict[str, Any]]):
         """Local exporter (ref: exporter/local/LocalExporter)."""
         if self.INDEX not in self.node.indices_service.indices:
             self.node.indices_service.create_index(self.INDEX, {}, None)
@@ -100,6 +140,63 @@ class MonitoringService:
             idx.index_doc(uuid.uuid4().hex, d)
             self.collected += 1
         idx.refresh()
+
+    def _export_http(self, name: str, spec: Dict[str, Any],
+                     docs: List[Dict[str, Any]]):
+        """HTTP exporter: ship collector documents to a REMOTE
+        monitoring cluster over its REST API (ref: exporter/http/
+        HttpExporter.java:80 — resource setup + bulk shipping). On
+        first use per host it installs the monitoring index template
+        (the reference's 'resource management' step), then ships each
+        batch as one `_monitoring/bulk` request. Basic auth via
+        `auth.username`/`auth.password` settings."""
+        import base64
+        import json as _json
+        import urllib.request
+
+        hosts = spec.get("host") or spec.get("hosts") or []
+        if isinstance(hosts, str):
+            hosts = [hosts]
+        if not hosts:
+            return
+        headers = {"Content-Type": "application/json"}
+        auth = spec.get("auth") or {}
+        user = auth.get("username")
+        if user:
+            creds = f"{user}:{auth.get('password', '')}"
+            headers["Authorization"] = (
+                "Basic " + base64.b64encode(creds.encode()).decode())
+        if not hasattr(self, "_http_resources_ready"):
+            self._http_resources_ready = set()
+        payload = _json.dumps(docs, default=str).encode()
+        for host in hosts:
+            base = host if "://" in host else f"http://{host}"
+            base = base.rstrip("/")
+            if base not in self._http_resources_ready:
+                # template install before first shipment (ref:
+                # HttpExporter#installResources)
+                tmpl = _json.dumps({
+                    "index_patterns": [".monitoring-es*"],
+                    "template": {"settings": {
+                        "number_of_shards": 1,
+                        "number_of_replicas": 0}},
+                    "priority": 150,
+                }).encode()
+                req = urllib.request.Request(
+                    base + "/_index_template/monitoring-es",
+                    data=tmpl, method="PUT",
+                    headers={**headers,
+                             "Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10):
+                    pass
+                self._http_resources_ready.add(base)
+            req = urllib.request.Request(
+                base + "/_monitoring/bulk?system_id=" + self.node.node_id,
+                data=payload, method="POST", headers=headers)
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                resp.read()
+            self.exported_http = getattr(self, "exported_http", 0) \
+                + len(docs)
 
     # -------------------------------------------------------- monitoring bulk
     def bulk(self, system_id: str,

@@ -93,6 +93,9 @@ class WatcherService:
         # last rendered webhook requests (bounded) — what WOULD have
         # been sent; tests and operators inspect these
         self.webhook_requests: List[Dict[str, Any]] = []
+        # delivered/rendered notifications (bounded): email, slack,
+        # pagerduty (ref: watcher/notification/*)
+        self.notifications: List[Dict[str, Any]] = []
 
     # ----------------------------------------------------------- lifecycle
     def start_scheduler(self):
@@ -367,7 +370,202 @@ class WatcherService:
             del self.webhook_requests[:-256]
             return {"id": name, "type": "webhook", "status": "simulated",
                     "webhook": {"request": rendered}}
+        if atype == "email":
+            return self._run_email_action(name, body, ctx)
+        if atype == "slack":
+            return self._run_slack_action(name, body, ctx)
+        if atype == "pagerduty":
+            return self._run_pagerduty_action(name, body, ctx)
         return {"id": name, "type": atype, "status": "simulated"}
+
+    # ------------------------------------------------- notification actions
+    #
+    # Ref: x-pack/plugin/watcher/.../actions/email/EmailAction.java:30,
+    # slack/SlackAction.java, pagerduty/PagerDutyAction.java. Account
+    # config follows the reference's settings layout
+    # (xpack.notification.{email,slack,pagerduty}.account.<name>.*).
+    # Delivery policy in this zero-egress engine: email sends REAL SMTP
+    # to the configured account host (tests run an in-process SMTP
+    # fixture); slack/pagerduty POST over real HTTP when the target is
+    # loopback (test fixtures), and otherwise record the FULLY RENDERED
+    # request — the testable contract — as the webhook action does.
+
+    def _account(self, kind: str, name: Optional[str]) -> Dict[str, Any]:
+        accounts = self.node.settings.by_prefix(
+            f"xpack.notification.{kind}.account").as_nested_dict()
+        if not isinstance(accounts, dict):
+            return {}
+        if name:
+            acct = accounts.get(name)
+            return acct if isinstance(acct, dict) else {}
+        default = self.node.settings.get(
+            f"xpack.notification.{kind}.default_account")
+        if default and isinstance(accounts.get(default), dict):
+            return accounts[default]
+        for v in accounts.values():     # single-account convenience
+            if isinstance(v, dict):
+                return v
+        return {}
+
+    def _run_email_action(self, name, body, ctx):
+        import email.utils
+        from email.mime.application import MIMEApplication
+        from email.mime.multipart import MIMEMultipart
+        from email.mime.text import MIMEText
+
+        acct = self._account("email", body.get("account"))
+        sender = self._render(
+            str(body.get("from")
+                or acct.get("email_defaults", {}).get("from")
+                or "watcher@localhost"), ctx)
+        to = body.get("to") or []
+        if isinstance(to, str):
+            to = [to]
+        to = [self._render(str(t), ctx) for t in to]
+        subject = self._render(str(body.get("subject", "")), ctx)
+        tbody = body.get("body", "")
+        if isinstance(tbody, dict):
+            html = tbody.get("html")
+            text = tbody.get("text", "")
+            content = self._render(str(html or text), ctx)
+            subtype = "html" if html else "plain"
+        else:
+            content, subtype = self._render(str(tbody), ctx), "plain"
+        attachments = body.get("attachments") or {}
+        if attachments:
+            msg = MIMEMultipart()
+            msg.attach(MIMEText(content, subtype))
+            import json as _json
+            for aname, spec in attachments.items():
+                # data attachment: the payload serialized (ref:
+                # notification/email/attachment/DataAttachment.java)
+                part = MIMEApplication(
+                    _json.dumps(ctx.get("payload", {}),
+                                default=str).encode(),
+                    Name=aname)
+                part["Content-Disposition"] = \
+                    f'attachment; filename="{aname}"'
+                msg.attach(part)
+        else:
+            msg = MIMEText(content, subtype)
+        msg["From"] = sender
+        msg["To"] = ", ".join(to)
+        msg["Subject"] = subject
+        msg["Date"] = email.utils.formatdate()
+        msg["Message-ID"] = email.utils.make_msgid(domain="watcher")
+        record = {"watch_id": ctx["watch_id"], "action": name,
+                  "type": "email", "from": sender, "to": to,
+                  "subject": subject, "body": content}
+        smtp = acct.get("smtp") or {}
+        host = smtp.get("host")
+        # same loopback-only egress gate as slack/pagerduty/webhook:
+        # this zero-egress engine delivers for real only to in-process
+        # fixtures; any other host records the rendered message
+        if host and not self._is_loopback(str(host)):
+            record["status"] = "simulated"
+            record["smtp_host"] = str(host)
+            self._note(record)
+            return {"id": name, "type": "email", "status": "simulated",
+                    "email": {"message": {"from": sender, "to": to,
+                                          "subject": subject}}}
+        if host:
+            import smtplib
+            try:
+                with smtplib.SMTP(host, int(smtp.get("port", 25)),
+                                  timeout=10) as s:
+                    user = smtp.get("user")
+                    if user:
+                        s.login(user, str(smtp.get("password", "")))
+                    s.sendmail(sender, to, msg.as_string())
+                status = "success"
+            except Exception as e:
+                record["error"] = repr(e)
+                status = "failure"
+        else:
+            status = "simulated"    # no account configured: rendered
+        record["status"] = status
+        self._note(record)
+        return {"id": name, "type": "email", "status": status,
+                "email": {"message": {"from": sender, "to": to,
+                                      "subject": subject}}}
+
+    @staticmethod
+    def _is_loopback(host: str) -> bool:
+        import ipaddress
+        if host == "localhost":
+            return True
+        try:
+            return ipaddress.ip_address(host).is_loopback
+        except ValueError:
+            return False
+
+    def _post_loopback(self, url: str, payload: Dict[str, Any]):
+        """POST to loopback fixtures for real; record anything else
+        (zero-egress). Returns (status, http_status_or_None)."""
+        import urllib.request
+        from urllib.parse import urlparse
+
+        if not self._is_loopback(urlparse(url).hostname or ""):
+            return "simulated", None
+        import json as _json
+        req = urllib.request.Request(
+            url, data=_json.dumps(payload).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return "success", resp.status
+        except Exception:
+            return "failure", None
+
+    def _run_slack_action(self, name, body, ctx):
+        acct = self._account("slack", body.get("account"))
+        m = body.get("message") or {}
+        payload = {
+            "username": self._render(str(m.get("from", "watcher")), ctx),
+            "channel": [self._render(str(c), ctx)
+                        for c in (m.get("to") or [])],
+            "text": self._render(str(m.get("text", "")), ctx),
+            "attachments": m.get("attachments") or [],
+        }
+        url = str(acct.get("secure_url") or acct.get("url") or "")
+        status, http = ("simulated", None)
+        if url:
+            status, http = self._post_loopback(url, payload)
+        self._note({"watch_id": ctx["watch_id"], "action": name,
+                    "type": "slack", "payload": payload, "url": url,
+                    "status": status, "http_status": http})
+        return {"id": name, "type": "slack", "status": status,
+                "slack": {"message": payload}}
+
+    def _run_pagerduty_action(self, name, body, ctx):
+        acct = self._account("pagerduty", body.get("account"))
+        payload = {
+            "routing_key": str(acct.get("service_api_key", "")),
+            "event_action": str(body.get("event_type", "trigger")),
+            "dedup_key": self._render(
+                str(body.get("incident_key", "")), ctx) or None,
+            "payload": {
+                "summary": self._render(
+                    str(body.get("description", "")), ctx),
+                "source": "watcher/" + str(ctx["watch_id"]),
+                "severity": "error",
+                "custom_details": {"client": body.get("client",
+                                                      "watcher")},
+            },
+        }
+        url = str(acct.get("url") or "")
+        status, http = ("simulated", None)
+        if url:
+            status, http = self._post_loopback(url, payload)
+        self._note({"watch_id": ctx["watch_id"], "action": name,
+                    "type": "pagerduty", "payload": payload, "url": url,
+                    "status": status, "http_status": http})
+        return {"id": name, "type": "pagerduty", "status": status,
+                "pagerduty": {"event": payload}}
+
+    def _note(self, record: Dict[str, Any]):
+        self.notifications.append(record)
+        del self.notifications[:-256]
 
     @staticmethod
     def _render(template: str, ctx: Dict[str, Any]) -> str:

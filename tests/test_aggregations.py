@@ -739,3 +739,115 @@ def test_bucket_script_error_semantics(search):
         query={"term": {"category": "nope"}})
     assert a["p"]["values"]["50.0"] is None
     assert a["es"]["count"] == 0 and a["es"]["std_deviation"] is None
+
+
+# ---------------------------------------------------------------------------
+# round-5 additions: date_range, moving_percentiles, normalize
+# ---------------------------------------------------------------------------
+
+def test_date_range(search):
+    """ref: bucket/range/DateRangeAggregationBuilder.java:39"""
+    r = agg(search, {"periods": {"date_range": {
+        "field": "sold_at",
+        "ranges": [
+            {"to": "2021-01-02"},
+            {"from": "2021-01-02", "to": "2021-01-03"},
+            {"from": "2021-01-03", "key": "late"},
+        ]}}})
+    buckets = r["periods"]["buckets"]
+    assert [b["doc_count"] for b in buckets] == [2, 2, 2]
+    assert buckets[0]["to_as_string"].startswith("2021-01-02")
+    assert "from" not in buckets[0]
+    assert buckets[1]["from_as_string"].startswith("2021-01-02")
+    assert buckets[2]["key"] == "late"
+
+
+def test_date_range_now_math(search):
+    r = agg(search, {"recent": {"date_range": {
+        "field": "sold_at",
+        "ranges": [{"from": "now-1d"}, {"to": "now-1d/d"}]}}})
+    buckets = r["recent"]["buckets"]
+    # the 2021 corpus is far in the past: nothing within the last day,
+    # everything before it
+    assert buckets[0]["doc_count"] == 0
+    assert buckets[1]["doc_count"] == len(DOCS)
+
+
+def test_date_range_with_sub_agg(search):
+    r = agg(search, {"periods": {"date_range": {
+        "field": "sold_at",
+        "ranges": [{"from": "2021-01-02"}]},
+        "aggs": {"total": {"sum": {"field": "price"}}}}})
+    b = r["periods"]["buckets"][0]
+    assert b["doc_count"] == 4
+    assert b["total"]["value"] == pytest.approx(3 + 4 + 5 + 10)
+
+
+def test_moving_percentiles(search):
+    """ref: x-pack/plugin/analytics/.../MovingPercentilesPipeline
+    Aggregator.java:31 — windowed merge of a sibling percentiles
+    metric inside a date_histogram."""
+    r = agg(search, {"days": {
+        "date_histogram": {"field": "sold_at",
+                           "calendar_interval": "day"},
+        "aggs": {
+            "pp": {"percentiles": {"field": "price",
+                                   "percents": [50.0]}},
+            "moving": {"moving_percentiles": {
+                "buckets_path": "pp", "window": 2}},
+        }}})
+    buckets = r["days"]["buckets"]
+    assert len(buckets) == 3
+    # day1 prices [1,2]; day2 [3,4]; day3 [5,10]
+    # MovFn indexing, window=2 shift=0: bucket i merges [i-2, i) —
+    # the window ends BEFORE the current bucket (reference semantics)
+    assert buckets[0]["moving"]["values"] == {}
+    m1 = buckets[1]["moving"]["values"]["50.0"]
+    m2 = buckets[2]["moving"]["values"]["50.0"]
+    assert m1 == pytest.approx(np.percentile([1, 2], 50))
+    assert m2 == pytest.approx(np.percentile([1, 2, 3, 4], 50))
+    # the raw-sample carrier never leaks into the response
+    assert "_values" not in buckets[0]["pp"]
+
+
+@pytest.mark.parametrize("method,expected", [
+    ("percent_of_sum", [2 / 6, 2 / 6, 2 / 6]),
+    ("rescale_0_1", [0.0, 0.0, 0.0]),
+    ("rescale_0_100", [0.0, 0.0, 0.0]),
+])
+def test_normalize_uniform_counts(search, method, expected):
+    r = agg(search, {"days": {
+        "date_histogram": {"field": "sold_at",
+                           "calendar_interval": "day"},
+        "aggs": {"n": {"normalize": {"buckets_path": "_count",
+                                     "method": method}}}}})
+    got = [b["n"]["value"] for b in r["days"]["buckets"]]
+    assert got == pytest.approx(expected)
+
+
+def test_normalize_methods_on_metric(search):
+    """ref: x-pack/plugin/analytics/.../normalize/
+    NormalizePipelineAggregationBuilder"""
+    base = {"days": {
+        "date_histogram": {"field": "sold_at",
+                           "calendar_interval": "day"},
+        "aggs": {
+            "total": {"sum": {"field": "price"}},
+            "n": {"normalize": {"buckets_path": "total",
+                                "method": "rescale_0_1"}},
+        }}}
+    r = agg(search, base)
+    # sums per day: [3, 7, 15] -> rescaled [0, 1/3, 1]
+    got = [b["n"]["value"] for b in r["days"]["buckets"]]
+    assert got == pytest.approx([0.0, 4 / 12, 1.0])
+    base["days"]["aggs"]["n"]["normalize"]["method"] = "z-score"
+    r = agg(search, base)
+    vals = np.array([3.0, 7.0, 15.0])
+    want = (vals - vals.mean()) / vals.std()
+    got = [b["n"]["value"] for b in r["days"]["buckets"]]
+    assert got == pytest.approx(list(want))
+    base["days"]["aggs"]["n"]["normalize"]["method"] = "softmax"
+    r = agg(search, base)
+    e = np.exp(vals - vals.max())
+    got = [b["n"]["value"] for b in r["days"]["buckets"]]
+    assert got == pytest.approx(list(e / e.sum()))

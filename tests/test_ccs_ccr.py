@@ -160,3 +160,87 @@ def test_remote_settings_partial_update_keeps_connection(clusters):
     call(local, "PUT", "/_cluster/settings", {"persistent": {
         "cluster.remote.remote1.seeds": None}})
     assert "remote1" not in call(local, "GET", "/_remote/info")
+
+
+# ---------------------------------------------------------------------------
+# Proxy connection mode (ref: transport/ProxyConnectionStrategy.java:49)
+# ---------------------------------------------------------------------------
+
+def test_proxy_mode_remote_search(tmp_path):
+    """cluster.remote.*.mode=proxy connects through ONE address with a
+    pooled persistent-connection client (no sniffing) and serves CCS."""
+    local = Node(data_path=str(tmp_path / "local"))
+    remote = Node(data_path=str(tmp_path / "remote"))
+    try:
+        rport = remote.start(0)
+        remote.indices_service.create_index("prodx", {}, None)
+        ridx = remote.indices_service.get("prodx")
+        for i in range(4):
+            ridx.index_doc(str(i), {"title": f"doc {i}"})
+        ridx.refresh()
+        call(local, "PUT", "/_cluster/settings", {
+            "persistent": {"cluster": {"remote": {"prox": {
+                "mode": "proxy",
+                "proxy_address": f"127.0.0.1:{rport}",
+                "proxy_socket_connections": 3}}}}})
+        from elasticsearch_tpu.transport.remote import (
+            ProxyRemoteClusterClient)
+        client = local.remote_cluster_service.get_client("prox")
+        assert isinstance(client, ProxyRemoteClusterClient)
+        r = call(local, "POST", "/prox:prodx/_search",
+                 {"query": {"match_all": {}}, "size": 10})
+        assert r["hits"]["total"]["value"] == 4
+        assert all(h["_index"] == "prox:prodx"
+                   for h in r["hits"]["hits"])
+        # repeated requests reuse pooled sockets (bounded by the
+        # configured pool size)
+        for _ in range(5):
+            call(local, "POST", "/prox:prodx/_search",
+                 {"query": {"match_all": {}}, "size": 1})
+        stats = client.pool_stats()
+        assert stats["max"] == 3
+        assert 1 <= stats["created"] <= 3
+        info = call(local, "GET", "/_remote/info")
+        assert info["prox"]["mode"] == "proxy"
+        assert info["prox"]["proxy_address"] == f"127.0.0.1:{rport}"
+        assert info["prox"]["connected"] is True
+    finally:
+        local.close()
+        remote.close()
+
+
+def test_proxy_mode_redials_dropped_connections(tmp_path):
+    """A stale pooled socket (server restarted) is re-dialed
+    transparently instead of failing the request."""
+    local = Node(data_path=str(tmp_path / "local"))
+    remote = Node(data_path=str(tmp_path / "remote"))
+    remote2 = None
+    try:
+        rport = remote.start(0)
+        remote.indices_service.create_index("i1", {}, None)
+        remote.indices_service.get("i1").index_doc("1", {"a": 1})
+        remote.indices_service.get("i1").refresh()
+        local.remote_cluster_service.apply_settings({
+            "cluster": {"remote": {"p": {
+                "mode": "proxy",
+                "proxy_address": f"127.0.0.1:{rport}"}}}})
+        client = local.remote_cluster_service.get_client("p")
+        assert client.request("GET", "/")["cluster_name"]
+        # kill the remote; the pooled socket is now dead
+        remote.close()
+        import pytest as _pytest
+        from elasticsearch_tpu.common.errors import (
+            ElasticsearchTpuException)
+        with _pytest.raises(ElasticsearchTpuException):
+            client.request("GET", "/")
+        # bring a NEW server up on the same port (LB failover shape)
+        remote2 = Node(data_path=str(tmp_path / "remote2"))
+        try:
+            remote2.start(rport)
+        except OSError:
+            _pytest.skip("port was reclaimed by the OS")
+        assert client.request("GET", "/")["cluster_name"]
+    finally:
+        local.close()
+        if remote2 is not None:
+            remote2.close()

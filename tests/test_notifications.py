@@ -1,0 +1,363 @@
+"""Round-5 notification surfaces: watcher email/slack/pagerduty actions
+(ref: x-pack/plugin/watcher/.../actions/email/EmailAction.java:30 and
+siblings), the monitoring HTTP exporter (ref: monitoring/.../exporter/
+http/HttpExporter.java:80), and the ML inference ingest processor
+(ref: ml/.../inference/ingest/InferenceProcessor.java:59).
+
+Email delivery is proven against an in-process SMTP fixture; slack and
+pagerduty against an in-process HTTP fixture (the zero-egress delivery
+policy posts only to loopback); the HTTP exporter round-trips into a
+second REAL node's .monitoring-es index.
+"""
+
+import json
+import socketserver
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.node import Node
+
+
+def call(node, method, path, body=None, expect=(200, 201), **params):
+    status, r = node.rest_controller.dispatch(method, path, params, body)
+    ok = (status in expect) if isinstance(expect, tuple) else \
+        status == expect
+    assert ok, (status, r)
+    return r
+
+
+# --------------------------------------------------------------- fixtures
+
+class _SmtpHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        srv = self.server
+        self.wfile.write(b"220 fixture ESMTP\r\n")
+        sender, rcpts, data = None, [], None
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                return
+            cmd = line.decode(errors="replace").strip()
+            up = cmd.upper()
+            if up.startswith(("HELO", "EHLO")):
+                self.wfile.write(b"250 fixture\r\n")
+            elif up.startswith("MAIL FROM:"):
+                sender = cmd[10:].strip().strip("<>")
+                self.wfile.write(b"250 OK\r\n")
+            elif up.startswith("RCPT TO:"):
+                rcpts.append(cmd[8:].strip().strip("<>"))
+                self.wfile.write(b"250 OK\r\n")
+            elif up == "DATA":
+                self.wfile.write(b"354 go\r\n")
+                lines = []
+                while True:
+                    dl = self.rfile.readline()
+                    if dl.rstrip(b"\r\n") == b".":
+                        break
+                    lines.append(dl)
+                data = b"".join(lines).decode(errors="replace")
+                srv.messages.append(
+                    {"from": sender, "to": list(rcpts), "data": data})
+                sender, rcpts = None, []
+                self.wfile.write(b"250 delivered\r\n")
+            elif up == "QUIT":
+                self.wfile.write(b"221 bye\r\n")
+                return
+            else:
+                self.wfile.write(b"250 OK\r\n")
+
+
+@pytest.fixture()
+def smtp_fixture():
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), _SmtpHandler)
+    srv.messages = []
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+class _HttpCapture(socketserver.StreamRequestHandler):
+    def handle(self):
+        req = self.rfile.readline().decode()
+        headers = {}
+        while True:
+            line = self.rfile.readline().decode().strip()
+            if not line:
+                break
+            k, _, v = line.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        length = int(headers.get("content-length", 0))
+        body = self.rfile.read(length).decode() if length else ""
+        self.server.requests.append(
+            {"line": req.strip(), "headers": headers, "body": body})
+        resp = b'{"ok":true}'
+        self.wfile.write(
+            b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+            b"Content-Length: " + str(len(resp)).encode() +
+            b"\r\nConnection: close\r\n\r\n" + resp)
+
+
+@pytest.fixture()
+def http_fixture():
+    srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), _HttpCapture)
+    srv.requests = []
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def _seed_errors(node):
+    node.indices_service.create_index("logs", {}, {
+        "properties": {"level": {"type": "keyword"}}})
+    idx = node.indices_service.get("logs")
+    for i in range(3):
+        idx.index_doc(f"e{i}", {"level": "error"})
+    idx.refresh()
+
+
+WATCH_BASE = {
+    "trigger": {"schedule": {"interval": "10m"}},
+    "input": {"search": {"request": {
+        "indices": ["logs"],
+        "body": {"query": {"term": {"level": {"value": "error"}}},
+                 "size": 0, "track_total_hits": True}}}},
+    "condition": {"compare": {"payload.hits.total.value": {"gte": 1}}},
+}
+
+
+# ------------------------------------------------------------ email action
+
+def test_email_action_delivers_via_smtp(tmp_path, smtp_fixture):
+    host, port = smtp_fixture.server_address
+    node = Node(settings=Settings.from_dict({
+        "xpack": {"notification": {"email": {"account": {"main": {
+            "smtp": {"host": host, "port": port},
+            "email_defaults": {"from": "alerts@estpu.local"},
+        }}}}}}), data_path=str(tmp_path / "n"))
+    try:
+        _seed_errors(node)
+        watch = dict(WATCH_BASE)
+        watch["actions"] = {"mail": {"email": {
+            "to": ["ops@example.com"],
+            "subject": "{{ctx.payload.hits.total.value}} errors found",
+            "body": {"text": "watch {{ctx.watch_id}} fired"},
+            "attachments": {"payload.json": {"data": {"format": "json"}}},
+        }}}
+        call(node, "PUT", "/_watcher/watch/errmail", watch)
+        r = call(node, "POST", "/_watcher/watch/errmail/_execute")
+        actions = r["watch_record"]["result"]["actions"]
+        assert actions[0]["status"] == "success", actions
+        deadline = time.time() + 5
+        while not smtp_fixture.messages and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(smtp_fixture.messages) == 1
+        msg = smtp_fixture.messages[0]
+        assert msg["from"] == "alerts@estpu.local"
+        assert msg["to"] == ["ops@example.com"]
+        assert "3 errors found" in msg["data"]        # rendered subject
+        assert "watch errmail fired" in msg["data"]   # rendered body
+        assert "payload.json" in msg["data"]          # attachment
+    finally:
+        node.close()
+
+
+def test_email_action_without_account_renders(tmp_path):
+    node = Node(data_path=str(tmp_path / "n"))
+    try:
+        _seed_errors(node)
+        watch = dict(WATCH_BASE)
+        watch["actions"] = {"mail": {"email": {
+            "to": "ops@example.com", "subject": "s", "body": "b"}}}
+        call(node, "PUT", "/_watcher/watch/w1", watch)
+        r = call(node, "POST", "/_watcher/watch/w1/_execute")
+        assert r["watch_record"]["result"]["actions"][0]["status"] == \
+            "simulated"
+        notes = node.watcher_service.notifications
+        assert notes and notes[-1]["type"] == "email"
+    finally:
+        node.close()
+
+
+# ----------------------------------------------------- slack / pagerduty
+
+def test_slack_action_posts_to_webhook(tmp_path, http_fixture):
+    host, port = http_fixture.server_address
+    node = Node(settings=Settings.from_dict({
+        "xpack": {"notification": {"slack": {"account": {"ops": {
+            "secure_url": f"http://{host}:{port}/hook"}}}}},
+    }), data_path=str(tmp_path / "n"))
+    try:
+        _seed_errors(node)
+        watch = dict(WATCH_BASE)
+        watch["actions"] = {"ping": {"slack": {"message": {
+            "from": "watcher", "to": ["#ops"],
+            "text": "{{ctx.payload.hits.total.value}} errors"}}}}
+        call(node, "PUT", "/_watcher/watch/ws", watch)
+        r = call(node, "POST", "/_watcher/watch/ws/_execute")
+        assert r["watch_record"]["result"]["actions"][0]["status"] == \
+            "success"
+        assert len(http_fixture.requests) == 1
+        payload = json.loads(http_fixture.requests[0]["body"])
+        assert payload["text"] == "3 errors"
+        assert payload["channel"] == ["#ops"]
+    finally:
+        node.close()
+
+
+def test_pagerduty_action_posts_event(tmp_path, http_fixture):
+    host, port = http_fixture.server_address
+    node = Node(settings=Settings.from_dict({
+        "xpack": {"notification": {"pagerduty": {"account": {"pd": {
+            "service_api_key": "sekrit",
+            "url": f"http://{host}:{port}/v2/enqueue"}}}}},
+    }), data_path=str(tmp_path / "n"))
+    try:
+        _seed_errors(node)
+        watch = dict(WATCH_BASE)
+        watch["actions"] = {"page": {"pagerduty": {
+            "description": "errors={{ctx.payload.hits.total.value}}",
+            "incident_key": "errs"}}}
+        call(node, "PUT", "/_watcher/watch/wp", watch)
+        r = call(node, "POST", "/_watcher/watch/wp/_execute")
+        assert r["watch_record"]["result"]["actions"][0]["status"] == \
+            "success"
+        ev = json.loads(http_fixture.requests[0]["body"])
+        assert ev["routing_key"] == "sekrit"
+        assert ev["payload"]["summary"] == "errors=3"
+        assert ev["dedup_key"] == "errs"
+    finally:
+        node.close()
+
+
+def test_slack_non_loopback_is_recorded_not_sent(tmp_path):
+    node = Node(settings=Settings.from_dict({
+        "xpack": {"notification": {"slack": {"account": {"ops": {
+            "secure_url": "https://hooks.slack.com/services/T0/B0/x"}}}}},
+    }), data_path=str(tmp_path / "n"))
+    try:
+        _seed_errors(node)
+        watch = dict(WATCH_BASE)
+        watch["actions"] = {"ping": {"slack": {
+            "message": {"text": "hi"}}}}
+        call(node, "PUT", "/_watcher/watch/ws2", watch)
+        r = call(node, "POST", "/_watcher/watch/ws2/_execute")
+        assert r["watch_record"]["result"]["actions"][0]["status"] == \
+            "simulated"
+        assert node.watcher_service.notifications[-1]["status"] == \
+            "simulated"
+    finally:
+        node.close()
+
+
+# ------------------------------------------------- monitoring HTTP exporter
+
+def test_monitoring_http_exporter_round_trip(tmp_path):
+    """Collector docs from node A land in node B's .monitoring-es
+    through B's REAL REST API (template install + bulk shipping)."""
+    b = Node(data_path=str(tmp_path / "b"))
+    bport = b.start(0)
+    a = Node(settings=Settings.from_dict({
+        "xpack": {"monitoring": {"exporters": {
+            "remote": {"type": "http",
+                       "host": f"127.0.0.1:{bport}"},
+        }}}}), data_path=str(tmp_path / "a"))
+    try:
+        a.indices_service.create_index("idx_a", {}, None)
+        a.indices_service.get("idx_a").index_doc("1", {"x": 1})
+        a.indices_service.get("idx_a").refresh()
+        r = call(a, "POST", "/_monitoring/_collect")
+        assert r["collected"] > 0
+        # the remote template was installed on B before shipping
+        t = call(b, "GET", "/_index_template/monitoring-es")
+        assert t["index_templates"], t
+        # and the docs are searchable on B
+        call(b, "POST", "/.monitoring-es/_refresh")
+        res = call(b, "POST", "/.monitoring-es/_search",
+                   {"query": {"match": {"type": "node_stats"}},
+                    "size": 10})
+        assert res["hits"]["total"]["value"] >= 1
+        # local exporter still ran on A (fan-out, not replacement)
+        assert ".monitoring-es" in a.indices_service.indices
+    finally:
+        a.close()
+        b.close()
+
+
+def test_monitoring_http_exporter_sends_auth(tmp_path, http_fixture):
+    host, port = http_fixture.server_address
+    a = Node(settings=Settings.from_dict({
+        "xpack": {"monitoring": {"exporters": {
+            "remote": {"type": "http", "host": f"{host}:{port}",
+                       "auth": {"username": "ship",
+                                "password": "pw"}},
+            "local": {"type": "local", "enabled": "false"},
+        }}}}), data_path=str(tmp_path / "a"))
+    try:
+        call(a, "POST", "/_monitoring/_collect")
+        reqs = http_fixture.requests
+        assert len(reqs) >= 2           # template PUT + bulk POST
+        assert reqs[0]["line"].startswith("PUT /_index_template/")
+        import base64
+        expect = "Basic " + base64.b64encode(b"ship:pw").decode()
+        assert all(r["headers"].get("authorization") == expect
+                   for r in reqs)
+        # local exporter disabled: nothing indexed on A
+        assert ".monitoring-es" not in a.indices_service.indices
+    finally:
+        a.close()
+
+
+# --------------------------------------------- ML inference ingest processor
+
+def test_inference_ingest_processor_classifies(tmp_path):
+    node = Node(data_path=str(tmp_path / "n"))
+    try:
+        call(node, "PUT", "/_ml/trained_models/clf", {
+            "model_type": "classification",
+            "feature_names": ["f1", "f2"],
+            "mean": [0.0, 0.0], "std": [1.0, 1.0],
+            # w·x = f1 - f2 (+0 bias): positive ⇒ class "hot"
+            "weights": [1.0, -1.0, 0.0],
+            "classes": ["cold", "hot"],
+        })
+        call(node, "PUT", "/_ingest/pipeline/classify", {
+            "processors": [{"inference": {
+                "model_id": "clf",
+                "target_field": "ml.inference",
+                "field_map": {"temp": "f1", "wind": "f2"},
+            }}]})
+        call(node, "PUT", "/readings/_doc/1",
+             {"temp": 5.0, "wind": 1.0}, pipeline="classify")
+        call(node, "PUT", "/readings/_doc/2",
+             {"temp": -3.0, "wind": 2.0}, pipeline="classify")
+        call(node, "POST", "/readings/_refresh")
+        d1 = call(node, "GET", "/readings/_doc/1")["_source"]
+        d2 = call(node, "GET", "/readings/_doc/2")["_source"]
+        assert d1["ml"]["inference"]["predicted_value"] == "hot"
+        assert d1["ml"]["inference"]["model_id"] == "clf"
+        assert d2["ml"]["inference"]["predicted_value"] == "cold"
+    finally:
+        node.close()
+
+
+def test_inference_processor_missing_field_fails(tmp_path):
+    node = Node(data_path=str(tmp_path / "n"))
+    try:
+        call(node, "PUT", "/_ml/trained_models/reg", {
+            "model_type": "regression",
+            "feature_names": ["x"], "mean": [0.0], "std": [1.0],
+            "weights": [2.0, 0.0], "classes": None,
+        })
+        call(node, "PUT", "/_ingest/pipeline/p", {
+            "processors": [{"inference": {"model_id": "reg"}}]})
+        call(node, "PUT", "/d/_doc/1", {"y": 1.0}, pipeline="p",
+             expect=400)
+    finally:
+        node.close()
