@@ -382,11 +382,24 @@ def run_tpu_kernel(corpus, queries):
         sel_b, ws_b = batches[0]
         acc = None
         t0 = time.time()
-        for _ in range(n_launches):
+        done_launches = 0
+        for i in range(n_launches):
             out = batch_topk(d_docids, d_tfs, d_lens, d_live, sel_b,
                              ws_b)[0]
             acc = out if acc is None else acc + out
+            done_launches += 1
+            # a relay that STARTS in degraded mode executes these
+            # "pre-readback" launches synchronously at ~450 ms each —
+            # 2000 of them would wedge the whole bench for 15 minutes.
+            # Periodic sync + wall guard caps the section honestly.
+            if done_launches % 100 == 0:
+                jax.block_until_ready(acc)
+                if time.time() - t0 > 60:
+                    log(f"sustained section wall-capped at "
+                        f"{done_launches} launches")
+                    break
         jax.block_until_ready(acc)
+        n_launches = done_launches
         wall = time.time() - t0
         pre_per_launch = wall / n_launches
         sus_qps = n_launches * BATCH / wall

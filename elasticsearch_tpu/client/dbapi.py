@@ -178,7 +178,11 @@ class Connection:
                  password: Optional[str] = None, secure: bool = False,
                  page_size: int = DEFAULT_PAGE_SIZE, timeout: float = 90.0,
                  binary: bool = True, verify_certs: bool = True,
-                 check_server: bool = True):
+                 check_server: bool = True, mode: str = "jdbc"):
+        # "jdbc" | "odbc": same CBOR protocol; the declared driver mode
+        # rides every request (ref: sql-proto Mode — the server adds
+        # driver column metadata for either)
+        self.mode = mode if mode in ("jdbc", "odbc") else "jdbc"
         if url:
             host, port, user2, pw2, opts = _parse_url(url)
             user = user if user is not None else user2
@@ -310,15 +314,16 @@ class Cursor:
         if self._closed:
             raise InterfaceError("cursor is closed")
         self._finish_open_cursor()
+        mode = getattr(self._conn, "mode", "jdbc")
         body: Dict[str, Any] = {
             "query": operation,
             "fetch_size": self._conn.page_size,
-            "mode": "jdbc",
+            "mode": mode,
             "binary_format": self._conn.binary,
         }
         if parameters:
             body["params"] = [_param_value(p) for p in parameters]
-        result = self._conn._request("POST", "/_sql?mode=jdbc", body)
+        result = self._conn._request("POST", f"/_sql?mode={mode}", body)
         self._columns = result.get("columns") or []
         self.description = [
             (c.get("name"), _type_code(c.get("type", "keyword")),

@@ -581,8 +581,56 @@ class PercolatorFieldType(MappedFieldType):
         return value
 
 
+class CompletionFieldType(MappedFieldType):
+    """ref: search/suggest/completion/CompletionFieldMapper — suggestion
+    inputs with optional weights and category contexts, served by the
+    weighted prefix index (index/segment.py CompletionValues; the
+    reference builds NRT FSTs — CompletionSuggester.java:41). Accepts
+    a string, a list of strings, or
+    ``{"input": [...], "weight": N, "contexts": {name: [values]}}``."""
+
+    type_name = "completion"
+    docvalue_kind = "completion"
+
+    def __init__(self, name, params=None):
+        super().__init__(name, params)
+        self.context_names = [c.get("name")
+                              for c in self.params.get("contexts", [])
+                              if isinstance(c, dict)]
+
+    def parse(self, value):
+        """Normalize to a list of (input, weight, contexts frozenset of
+        'name=value' strings)."""
+        entries = []
+        specs = value if isinstance(value, list) and any(
+            isinstance(v, dict) for v in value) else [value]
+        for spec in specs:
+            if isinstance(spec, str):
+                entries.append((spec, 1.0, frozenset()))
+                continue
+            if isinstance(spec, list):
+                entries.extend((str(s), 1.0, frozenset()) for s in spec)
+                continue
+            if not isinstance(spec, dict):
+                raise MapperParsingException(
+                    f"failed to parse completion field [{self.name}]")
+            inputs = spec.get("input", [])
+            if isinstance(inputs, str):
+                inputs = [inputs]
+            weight = float(spec.get("weight", 1.0))
+            ctx = set()
+            for cname, cvals in (spec.get("contexts") or {}).items():
+                if isinstance(cvals, str):
+                    cvals = [cvals]
+                ctx.update(f"{cname}={v}" for v in cvals)
+            entries.extend((str(i), weight, frozenset(ctx))
+                           for i in inputs)
+        return entries
+
+
 FIELD_TYPES = {
     t.type_name: t for t in [
+        CompletionFieldType,
         TextFieldType, KeywordFieldType, LongFieldType, IntegerFieldType,
         ShortFieldType, ByteFieldType, DoubleFieldType, FloatFieldType,
         HalfFloatFieldType, BooleanFieldType, DateFieldType, IpFieldType,
@@ -624,6 +672,8 @@ class ParsedDocument:
     numeric_values: Dict[str, List[float]] = field(default_factory=dict)
     # field -> np.ndarray [dims] float32
     vectors: Dict[str, np.ndarray] = field(default_factory=dict)
+    # field -> list of (input, weight, contexts) completion entries
+    completion_entries: Dict[str, List[Any]] = field(default_factory=dict)
     # field -> similarity name (cosine | dot_product | l2_norm)
     vector_similarity: Dict[str, str] = field(default_factory=dict)
     # dynamic-mapping update discovered during parse (field -> mapping dict)
@@ -824,7 +874,8 @@ class DocumentMapper:
                 ft_pre.parse(value)  # validate shape; query stays in _source
                 continue
             if ft_pre is not None and ft_pre.docvalue_kind in (
-                    "geo", "geoshape", "range", "rank_features", "flattened"):
+                    "geo", "geoshape", "range", "rank_features",
+                    "flattened", "completion"):
                 # object-valued field types must not recurse as sub-objects
                 if ft_pre.docvalue_kind == "geo":
                     from elasticsearch_tpu.common.geo import is_point_value
@@ -934,6 +985,9 @@ class DocumentMapper:
                     toks[len(toks) - len(tail):] = tail
                 if isinstance(ft, SearchAsYouTypeFieldType):
                     self._index_shingles(ft, new_toks, parsed)
+            elif ft.docvalue_kind == "completion":
+                parsed.completion_entries.setdefault(
+                    ft.name, []).extend(typed)
             elif ft.docvalue_kind == "term":
                 parsed.keyword_terms.setdefault(ft.name, []).append(typed)
             elif ft.docvalue_kind == "numeric":

