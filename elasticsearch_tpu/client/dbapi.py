@@ -348,8 +348,9 @@ class Cursor:
     def _next_page(self) -> bool:
         if not self._cursor_id:
             return False
-        result = self._conn._request("POST", "/_sql?mode=jdbc", {
-            "cursor": self._cursor_id, "mode": "jdbc",
+        mode = getattr(self._conn, "mode", "jdbc")
+        result = self._conn._request("POST", f"/_sql?mode={mode}", {
+            "cursor": self._cursor_id, "mode": mode,
             "binary_format": self._conn.binary})
         self._rows = [self._convert_row(r) for r in result.get("rows", [])]
         self._pos = 0

@@ -753,6 +753,22 @@ class DocumentMapper:
             params = {k: v for k, v in conf.items() if k != "type"}
             ft = cls(path, params)
             self.fields[path] = ft
+            # multi-fields (ref: the "fields" mapping parameter —
+            # every value indexes into the parent AND each subfield)
+            subnames = []
+            for subname, subconf in (conf.get("fields") or {}).items():
+                stype = (subconf or {}).get("type", "keyword")
+                scls = FIELD_TYPES.get(stype)
+                if scls is None:
+                    raise MapperParsingException(
+                        f"No handler for type [{stype}] declared on "
+                        f"field [{name}.{subname}]")
+                sft = scls(f"{path}.{subname}",
+                           {k: v for k, v in (subconf or {}).items()
+                            if k != "type"})
+                self.fields[sft.name] = sft
+                subnames.append(subname)
+            ft.subfields = subnames
             if isinstance(ft, SearchAsYouTypeFieldType):
                 for n in range(2, ft.max_shingle_size + 1):
                     sub = f"{path}._{n}gram"
@@ -762,9 +778,15 @@ class DocumentMapper:
 
     def to_mapping(self) -> Dict[str, Any]:
         props: Dict[str, Any] = {}
+        # multi-field subfields re-emit inside their parent's "fields"
+        # param (already in ft.params), not as standalone properties
+        sub_paths = {f"{p}.{s}" for p, ft in self.fields.items()
+                     for s in (getattr(ft, "subfields", ()) or ())}
         for path, ft in sorted(self.fields.items()):
             if isinstance(ft, ShingleSubFieldType) or path.endswith("._index_prefix"):
                 continue  # synthetic search_as_you_type subfields
+            if path in sub_paths:
+                continue
             if path == "_size":
                 continue  # metadata field, emitted as _size below
             node = props
@@ -920,9 +942,27 @@ class DocumentMapper:
                     self.fields[kw.name] = kw
                     parsed.dynamic_mappings[kw.name] = kw.to_mapping()
             self._index_values(ft, values, parsed)
+            # explicit multi-fields: the same values index into every
+            # declared subfield
+            subs = getattr(ft, "subfields", ()) or ()
+            for subname in subs:
+                sft = self.fields.get(f"{ft.name}.{subname}")
+                if sft is not None:
+                    self._index_values(sft, values, parsed)
+            # copy_to: values additionally index into the target
+            # field(s) (ref: the copy_to mapping parameter)
+            copy_to = ft.params.get("copy_to")
+            if copy_to:
+                targets = ([copy_to] if isinstance(copy_to, str)
+                           else copy_to)
+                for tgt in targets:
+                    tft = self.fields.get(tgt)
+                    if tft is not None and tft is not ft:
+                        self._index_values(tft, values, parsed)
             # dynamic text fields also index into their .keyword subfield
             kw_ft = self.fields.get(f"{ft.name}.keyword")
-            if kw_ft is not None and isinstance(ft, TextFieldType):
+            if (kw_ft is not None and isinstance(ft, TextFieldType)
+                    and "keyword" not in subs):
                 self._index_values(kw_ft, values, parsed)
 
     def _index_shingles(self, ft: "SearchAsYouTypeFieldType",

@@ -512,12 +512,19 @@ def resize_index(indices_service, source_name: str, target_name: str,
     # the copy, or the resized index silently loses them
     src.refresh()
     settings = dict(body.get("settings", {}))
-    n_target = int(settings.get("index.number_of_shards",
-                                1 if mode == "shrink" else src.num_shards * 2))
+    n_target = int(settings.get(
+        "index.number_of_shards",
+        1 if mode == "shrink"
+        else src.num_shards if mode == "clone"
+        else src.num_shards * 2))
     if mode == "shrink" and n_target > src.num_shards:
         raise IllegalArgumentException(
             f"the number of target shards [{n_target}] must be less than or "
             f"equal to the number of source shards [{src.num_shards}]")
+    if mode == "clone" and n_target != src.num_shards:
+        raise IllegalArgumentException(
+            f"the number of target shards [{n_target}] must be the "
+            f"same as the number of source shards [{src.num_shards}]")
     if mode == "split" and n_target < src.num_shards:
         raise IllegalArgumentException(
             f"the number of target shards [{n_target}] must be greater than "

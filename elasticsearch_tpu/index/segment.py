@@ -248,7 +248,7 @@ class CompletionValues:
         import heapq
 
         lo = bisect.bisect_left(self.inputs, prefix)
-        hi = bisect.bisect_left(self.inputs, prefix + "￿")
+        hi = bisect.bisect_left(self.inputs, prefix + "\U0010FFFF\U0010FFFF")
         if lo >= hi:
             return []
         out: List[int] = []
@@ -424,6 +424,19 @@ class Segment:
             arrays[f"{key}~tok"] = ts.tokens
             arrays[f"{key}~len"] = ts.lengths
             meta["streams"].append(f)
+        meta["completions"] = {}
+        for f, cv in self.completions.items():
+            key = f"c~{f}"
+            arrays[f"{key}~in"], arrays[f"{key}~in_off"] = \
+                self._encode_strings(cv.inputs)
+            arrays[f"{key}~w"] = cv.weights
+            arrays[f"{key}~d"] = cv.doc_of
+            if cv.contexts is not None:
+                ctx_strs = ["\x1f".join(sorted(c)) for c in cv.contexts]
+                arrays[f"{key}~ctx"], arrays[f"{key}~ctx_off"] = \
+                    self._encode_strings(ctx_strs)
+            meta["completions"][f] = {
+                "has_contexts": cv.contexts is not None}
         arrays["stored~offsets"] = self.stored.offsets
         arrays["stored~ids"], arrays["stored~ids_off"] = \
             self._encode_strings(self.stored.ids)
@@ -485,12 +498,25 @@ class Segment:
         for f in meta.get("streams", []):
             key = f"s~{f}"
             streams[f] = TokenStreams(f, z[f"{key}~tok"], z[f"{key}~len"])
+        completions = {}
+        for f, m in meta.get("completions", {}).items():
+            key = f"c~{f}"
+            inputs = cls._decode_strings(z[f"{key}~in"],
+                                         z[f"{key}~in_off"])
+            ctxs = None
+            if m.get("has_contexts"):
+                ctx_strs = cls._decode_strings(z[f"{key}~ctx"],
+                                               z[f"{key}~ctx_off"])
+                ctxs = [frozenset(s.split("\x1f")) if s else frozenset()
+                        for s in ctx_strs]
+            completions[f] = CompletionValues(
+                f, inputs, z[f"{key}~w"], z[f"{key}~d"], ctxs)
         stored = StoredFields(
             offsets=z["stored~offsets"], data=data,
             ids=cls._decode_strings(z["stored~ids"], z["stored~ids_off"]))
         return cls(meta["name"], meta["n_docs"], postings, numerics, keywords,
                    vectors, stored, live=z["live"].astype(bool),
-                   streams=streams)
+                   streams=streams, completions=completions)
 
 
 # ---------------------------------------------------------------------------

@@ -469,10 +469,16 @@ def _foreach(cfg, svc):
                 f"field [{field}] is not a list")
         out = []
         for v in values:
+            # the element is addressable BOTH ways: the reference's
+            # `_ingest._value` convention (ingest metadata namespace)
+            # and the bare `_value`
             sub = IngestDocument({"_value": v})
             sub.meta = doc.meta
+            sub.ingest_meta["_value"] = v
             inner(sub)
-            out.append(sub.source.get("_value"))
+            iv = sub.ingest_meta.get("_value")
+            pv = sub.source.get("_value")
+            out.append(iv if iv != v else pv)
         doc.set(field, out)
     return fn
 

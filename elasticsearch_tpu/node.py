@@ -309,7 +309,7 @@ class Node:
                         kernel_mode=str(self.settings.get(
                             "http.native.fast_kernel", "auto")),
                         dense_mb=int(self.settings.get(
-                            "http.native.fast_dense_mb", 512)))
+                            "http.native.fast_dense_mb", 1024)))
                     front.fastpath.start()
                     if allow or deny:
                         front.set_ipfilter(allow, deny)

@@ -154,7 +154,12 @@ def _topk_total(block_docids, block_tfs, sel_blocks, sel_weights,
 # ---------------------------------------------------------------------------
 
 NE_SLOTS = 8          # non-essential term slots (pad with len 0)
-CAND = 4096           # candidates patched per query
+# candidates patched per query: must exceed the ESSENTIAL-union size of
+# typical queries for the certificate to close (overflow bound is the
+# (C+1)th essential score + Σ maxc_ne; at 4096 the r5 full bench
+# refired 14 of 18 lane attempts — bursty 2M-doc unions run deep).
+# Patch cost is 8 flat gathers x C lanes — trivial device work.
+CAND = 16384
 
 
 def _essential_phase1(block_docids, block_tfs, sel_blocks, sel_weights,
@@ -180,11 +185,14 @@ def _essential_phase1(block_docids, block_tfs, sel_blocks, sel_weights,
     x = _doubling_scan(sorted_k, sorted_c)
     cand, _tot = _run_last_candidates(sorted_k[None, :], x[None, :])
     cand = cand[0]
-    # top C+1: the (C+1)th essential score feeds the exactness bound
-    ess_vals, pos = jax.lax.top_k(cand, CAND + 1)
-    cand_ids = jnp.take(sorted_k, pos)[:CAND]
-    ess = ess_vals[:CAND]
-    overflow_bound = ess_vals[CAND] + ne_bound   # -inf when exhausted
+    # top C+1: the (C+1)th essential score feeds the exactness bound.
+    # C adapts down when the essential union itself is smaller than
+    # CAND (small buckets / test corpora) — top_k k can't exceed lanes.
+    c = min(CAND, int(cand.shape[0]) - 1)
+    ess_vals, pos = jax.lax.top_k(cand, c + 1)
+    cand_ids = jnp.take(sorted_k, pos)[:c]
+    ess = ess_vals[:c]
+    overflow_bound = ess_vals[c] + ne_bound   # -inf when exhausted
     return cand_ids, ess, overflow_bound
 
 
@@ -235,8 +243,8 @@ def _essential_one(block_docids, block_tfs, flat_docids, flat_tfs,
     for ti in range(NE_SLOTS):
         lo0 = ne_start[ti]
         ln = ne_len[ti]
-        lo = jnp.full((CAND,), lo0, jnp.int32)
-        hi = jnp.full((CAND,), lo0 + ln, jnp.int32)
+        lo = jnp.full(cand_ids.shape, lo0, jnp.int32)
+        hi = jnp.full(cand_ids.shape, lo0 + ln, jnp.int32)
         # 21 halving steps cover ranges to 2^21 postings per term —
         # the host refuses longer ne ranges (search/fastpath.py
         # _essential_split NE_MAX_LEN)
@@ -284,8 +292,8 @@ def bm25_essential_topk_batch(block_docids, block_tfs,
 
     vals, ids, ok = jax.vmap(one)(sel_blocks, sel_weights, mask_ids,
                                   ne_start, ne_len, ne_idf, ne_bound)
-    ids_f = jax.lax.bitcast_convert_type(ids, jnp.float32)
-    ok_f = jax.lax.bitcast_convert_type(ok, jnp.float32)
+    ids_f = ids.astype(jnp.float32)
+    ok_f = ok.astype(jnp.float32)
     return jnp.concatenate([vals, ids_f, ok_f[:, None]], axis=1)
 
 
@@ -368,8 +376,8 @@ def bm25_essential_dense_topk_batch(block_docids, block_tfs,
 
     vals, ids, ok = jax.vmap(one)(sel_blocks, sel_weights, mask_ids,
                                   ne_row, ne_idf, ne_bound)
-    ids_f = jax.lax.bitcast_convert_type(ids, jnp.float32)
-    ok_f = jax.lax.bitcast_convert_type(ok, jnp.float32)
+    ids_f = ids.astype(jnp.float32)
+    ok_f = ok.astype(jnp.float32)
     return jnp.concatenate([vals, ids_f, ok_f[:, None]], axis=1)
 
 
@@ -467,8 +475,8 @@ def bm25_topk_total_merge_batch(
         return vals.astype(jnp.float32), ids
 
     vals, ids = jax.vmap(topk_one)(cand, mk)
-    ids_f = jax.lax.bitcast_convert_type(ids, jnp.float32)
-    tot_f = jax.lax.bitcast_convert_type(totals, jnp.float32)
+    ids_f = ids.astype(jnp.float32)
+    tot_f = totals.astype(jnp.float32)
     return jnp.concatenate([vals, ids_f, tot_f[:, None]], axis=1)
 
 
@@ -589,10 +597,9 @@ def bm25_candidates_rerank_batch(
                          bound.astype(dt) * (1.0 + _F32_SLACK),
                          jnp.asarray(-jnp.inf, dt))
     ok = (bound_up < kth) | ~jnp.isfinite(bound)
-    ids_f = jax.lax.bitcast_convert_type(ids, jnp.float32)
-    tot_f = jax.lax.bitcast_convert_type(totals, jnp.float32)
-    ok_f = jax.lax.bitcast_convert_type(ok.astype(jnp.int32),
-                                        jnp.float32)
+    ids_f = ids.astype(jnp.float32)
+    tot_f = totals.astype(jnp.float32)
+    ok_f = ok.astype(jnp.float32)
     return jnp.concatenate([vals, ids_f, tot_f[:, None], ok_f[:, None]],
                            axis=1)
 
@@ -608,13 +615,14 @@ def bm25_topk_total_batch(block_docids,   # int32 [TB, B]
                           avg_len, k1: float, b: float, k: int):
     """Cohort launch → ONE packed float32 [Q, 2k+1]:
     ``row = [values (k) | docids bitcast to f32 (k) | total bitcast (1)]``.
-    Unpack host-side with ``row[k:].view(np.int32)``."""
+    Ints ride as float CASTS (exact < 2^24; the axon runtime
+    miscompiles multi-bitcast concats — see ops/plan.pack_result)."""
     def one(s, w, mid):
         live_col = jnp.take(masks, mid, axis=0)
         return _topk_total(block_docids, block_tfs, s, w, doc_lens,
                            live_col, avg_len, k1, b, k)
 
     vals, ids, totals = jax.vmap(one)(sel_blocks, sel_weights, mask_ids)
-    ids_f = jax.lax.bitcast_convert_type(ids, jnp.float32)
-    tot_f = jax.lax.bitcast_convert_type(totals, jnp.float32)
+    ids_f = ids.astype(jnp.float32)
+    tot_f = totals.astype(jnp.float32)
     return jnp.concatenate([vals, ids_f, tot_f[:, None]], axis=1)

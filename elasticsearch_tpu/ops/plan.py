@@ -229,24 +229,30 @@ _plan_topk_impl = partial(
 def pack_result(vals: jax.Array, ids: jax.Array,
                 total: jax.Array) -> jax.Array:
     """Pack (vals [k] f32, ids [k] i32, total i32) into ONE [2k+1] f32
-    buffer (ids/total bitcast). The axon tunnel charges ~100ms per
-    device→host readback in its degraded mode — one packed readback per
-    launch instead of three is a 3× serving-latency lever."""
-    # explicit 32-bit dtypes: under x64 an unannotated sum widens to
-    # int64, whose f32 bitcast grows a trailing axis and breaks the pack
+    buffer. The axon tunnel charges ~100ms per device→host readback in
+    its degraded mode — one packed readback per launch instead of three
+    is a 3× serving-latency lever.
+
+    Ints ride as FLOAT CASTS, not bitcasts: float32 represents every
+    integer < 2^24 exactly (doc ids and totals are bounded by segment
+    doc count, << 2^24), and the axon runtime MISCOMPILES concats with
+    more than one bitcast section — computed int32 data read back as
+    zeros, shape-dependently (observed r5: correct hits, total=0; also
+    reproducible with ids zeroed). The sentinel id (2^31-1) is not
+    f32-exact but is never read (callers mask by finite vals first)."""
     return jnp.concatenate([
         vals.astype(jnp.float32),
-        jax.lax.bitcast_convert_type(ids.astype(jnp.int32), jnp.float32),
-        jax.lax.bitcast_convert_type(
-            jnp.reshape(total, (1,)).astype(jnp.int32), jnp.float32),
+        ids.astype(jnp.float32),
+        jnp.reshape(total, (1,)).astype(jnp.float32),
     ])
 
 
 def unpack_result(buf: np.ndarray, k: int):
     """Host-side inverse of pack_result on an np.float32 [2k+1] row."""
     vals = buf[:k]
-    ids = buf[k:2 * k].view(np.int32)
-    total = int(buf[2 * k:2 * k + 1].view(np.int32)[0])
+    # clip before the int cast: the sentinel float (2^31) would wrap
+    ids = np.clip(buf[k:2 * k], 0, 0x7FFFFFFF).astype(np.int32)
+    total = int(buf[2 * k])
     return vals, ids, total
 
 

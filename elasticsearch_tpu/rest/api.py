@@ -155,6 +155,7 @@ def _register_all(c: RestController):
     c.register("GET", "/", root_info)
     # cluster/admin
     c.register("GET", "/_cluster/health", cluster_health)
+    c.register("GET", "/_cluster/pending_tasks", cluster_pending_tasks)
     c.register("GET", "/_cluster/stats", cluster_stats)
     c.register("GET", "/_nodes/stats", nodes_stats)
     c.register("GET", "/_cat/indices", cat_indices)
@@ -240,6 +241,7 @@ def _register_all(c: RestController):
     c.register("GET", "/{index}/_alias", get_alias)
     c.register("GET", "/{index}/_alias/{name}", get_alias)
     # templates
+    c.register("PUT", "/{index}/_block/{block}", add_index_block)
     c.register("PUT", "/_index_template/{name}", put_index_template)
     c.register("POST", "/_index_template/{name}", put_index_template)
     c.register("GET", "/_index_template", get_index_template)
@@ -257,6 +259,8 @@ def _register_all(c: RestController):
     c.register("POST", "/{index}/_shrink/{target}", shrink_index)
     c.register("PUT", "/{index}/_split/{target}", split_index)
     c.register("POST", "/{index}/_split/{target}", split_index)
+    c.register("PUT", "/{index}/_clone/{target}", clone_index)
+    c.register("POST", "/{index}/_clone/{target}", clone_index)
     # data streams
     c.register("PUT", "/_data_stream/{name}", create_data_stream)
     c.register("GET", "/_data_stream", get_data_stream)
@@ -334,7 +338,11 @@ def _register_all(c: RestController):
     c.register("DELETE", "/_watcher/watch/{id}", watcher_delete)
     c.register("POST", "/_watcher/watch/{id}/_execute", watcher_execute)
     c.register("PUT", "/_watcher/watch/{id}/_activate", watcher_activate)
+    c.register("POST", "/_watcher/watch/{id}/_activate",
+               watcher_activate)
     c.register("PUT", "/_watcher/watch/{id}/_deactivate",
+               watcher_deactivate)
+    c.register("POST", "/_watcher/watch/{id}/_deactivate",
                watcher_deactivate)
     c.register("GET", "/_watcher/stats", watcher_stats)
     # monitoring (ref: x-pack/plugin/monitoring REST layer)
@@ -441,6 +449,8 @@ def _register_all(c: RestController):
     c.register("GET", "/_security/role", security_get_role)
     c.register("DELETE", "/_security/role/{name}", security_delete_role)
     c.register("POST", "/_security/api_key", security_create_api_key)
+    c.register("GET", "/_security/privilege/_builtin",
+               security_builtin_privileges)
     c.register("PUT", "/_security/api_key", security_create_api_key)
     c.register("GET", "/_security/api_key", security_get_api_keys)
     c.register("DELETE", "/_security/api_key", security_invalidate_api_key)
@@ -1747,6 +1757,29 @@ def cat_aliases(node, params, body):
     return 200, {"_cat": "\n".join(lines)}
 
 
+def cluster_pending_tasks(node, params, body):
+    """ref: RestPendingClusterTasksAction — tasks queued on the master
+    (the single-node container applies state updates synchronously, so
+    the queue drains immediately)."""
+    return 200, {"tasks": []}
+
+
+def add_index_block(node, params, body, index, block):
+    """ref: RestAddIndexBlockAction — PUT /{index}/_block/{block}
+    sets the matching index.blocks.* setting."""
+    if block not in ("write", "read", "read_only", "metadata"):
+        raise IllegalArgumentException(f"invalid block [{block}]")
+    names = node.indices_service.resolve(index)
+    for name in names:
+        idx = node.indices_service.get(name)
+        # update_settings persists the block across restarts (the
+        # pattern every other block writer uses)
+        idx.update_settings({f"index.blocks.{block}": True})
+    return 200, {"acknowledged": True, "shards_acknowledged": True,
+                 "indices": [{"name": n, "blocked": True}
+                             for n in names]}
+
+
 def put_index_template(node, params, body, name):
     node.metadata_service.put_index_template(name, body or {})
     return 200, {"acknowledged": True}
@@ -1805,6 +1838,14 @@ def shrink_index(node, params, body, index, target):
 def split_index(node, params, body, index, target):
     from elasticsearch_tpu.index.metadata import resize_index
     resize_index(node.indices_service, index, target, body, mode="split")
+    return 200, {"acknowledged": True, "shards_acknowledged": True,
+                 "index": target}
+
+
+def clone_index(node, params, body, index, target):
+    """ref: RestCloneIndexAction — a same-shard-count resize."""
+    from elasticsearch_tpu.index.metadata import resize_index
+    resize_index(node.indices_service, index, target, body, mode="clone")
     return 200, {"acknowledged": True, "shards_acknowledged": True,
                  "index": target}
 
@@ -2125,15 +2166,34 @@ def security_create_api_key(node, params, body):
     return 200, node.security_service.create_api_key(user, body or {})
 
 
+def security_builtin_privileges(node, params, body):
+    """ref: RestGetBuiltinPrivilegesAction."""
+    return 200, {
+        "cluster": ["all", "monitor", "manage", "manage_security",
+                    "manage_ilm", "manage_ml", "manage_watcher",
+                    "manage_transform", "read_ccr", "manage_ccr"],
+        "index": ["all", "read", "write", "create", "index", "delete",
+                  "manage", "monitor", "view_index_metadata",
+                  "create_index", "delete_index"],
+    }
+
+
 def security_get_api_keys(node, params, body):
     return 200, {"api_keys": node.security_service.get_api_keys()}
 
 
 def security_invalidate_api_key(node, params, body):
     body = body or {}
-    ids = node.security_service.invalidate_api_key(
-        key_id=body.get("id"), name=body.get("name"))
-    return 200, {"invalidated_api_keys": ids, "error_count": 0}
+    key_ids = body.get("ids") or []
+    if body.get("id"):
+        key_ids = list(key_ids) + [body["id"]]
+    out = []
+    for kid in key_ids:
+        out += node.security_service.invalidate_api_key(key_id=kid)
+    if body.get("name"):
+        out += node.security_service.invalidate_api_key(
+            name=body["name"])
+    return 200, {"invalidated_api_keys": out, "error_count": 0}
 
 
 def ilm_put_policy(node, params, body, id):

@@ -334,11 +334,11 @@ class KnnBatcher:
             top_s, top_i = vec_ops.knn_nominate_batch(
                 jnp.asarray(qs), dv.vectors, dv.sq_norms, dv.has_value,
                 live, dv.similarity, cut)
-            # ONE packed readback: ids bitcast into the float buffer
+            # ONE packed readback: ids as float CASTS (exact < 2^24;
+            # the axon runtime miscompiles multi-bitcast concats —
+            # ops/plan.pack_result)
             packed = jnp.concatenate(
-                [top_s,
-                 jax.lax.bitcast_convert_type(top_i, jnp.float32)],
-                axis=1)
+                [top_s, top_i.astype(jnp.float32)], axis=1)
             rows = np.asarray(packed)
             dt = time.monotonic() - t0
             with self._lock:
@@ -349,7 +349,8 @@ class KnnBatcher:
                 self.batched_queries += qn
             for i, e in enumerate(chunk):
                 scores = rows[i, :cut].copy()
-                ids = rows[i, cut:].view(np.int32).copy()
+                ids = np.clip(rows[i, cut:], 0,
+                              0x7FFFFFFF).astype(np.int32)
                 e.result = (scores, ids)
                 e.event.set()
 

@@ -277,7 +277,10 @@ class TermQuery(QueryBuilder):
             mask = (~miss) & (lo <= v) & (v <= hi) & ctx.all_true()
             return mask.astype(jnp.float32), mask
         if (ft is None or isinstance(ft, (TextFieldType, KeywordFieldType))
-                or ft.docvalue_kind == "flattened"):
+                # join relation names and flattened leaves index as
+                # plain terms (ref: ParentJoinFieldMapper — the join
+                # field is searchable like a keyword)
+                or ft.docvalue_kind in ("flattened", "join")):
             dp = ctx.device.postings.get(self.field)
             if dp is None:
                 z = jnp.zeros(ctx.n_docs_padded, jnp.float32)
@@ -297,6 +300,22 @@ class TermQuery(QueryBuilder):
             # text field + term query: unanalyzed exact term, BM25-scored
             scores, mask2 = _bm25_terms(ctx, self.field, [term])
             return scores, mask2
+        if (getattr(ft, "type_name", "") == "ip"
+                and "/" in str(self.value)):
+            # CIDR term on an ip field matches the whole block (ref:
+            # IpFieldMapper termQuery accepts prefix expressions)
+            import ipaddress
+            try:
+                net = ipaddress.ip_network(str(self.value), strict=False)
+            except ValueError:
+                raise IllegalArgumentException(
+                    f"'{self.value}' is not an IP string literal or "
+                    f"CIDR block")
+            lo = float(int(net.network_address))
+            hi = float(int(net.broadcast_address))
+            col, miss = ctx.numeric_column(self.field)
+            mask = (~miss) & (col >= lo) & (col <= hi) & ctx.all_true()
+            return mask.astype(jnp.float32), mask
         # numeric/date/boolean: point match, constant score
         parsed = float(ft.parse(self.value))
         col, miss = ctx.numeric_column(self.field)

@@ -648,14 +648,15 @@ class SqlService:
         for name in names:
             idx = self.node.indices_service.get(name)
             fields = sorted(idx.mapper.fields.items())
-            pos = 0
-            for fname, ft in fields:
+            for pos, (fname, ft) in enumerate(fields, start=1):
+                # ORDINAL_POSITION is the TABLE position — computed
+                # before any column-pattern filtering (ODBC clients
+                # bind by it)
                 if stmt.column_pattern is not None:
                     cpat = stmt.column_pattern.replace(
                         "%", "*").replace("_", "?")
                     if not fnmatch.fnmatch(fname, cpat):
                         continue
-                pos += 1
                 est = _sql_type(ft.type_name)
                 rows.append([cluster, None, name, fname,
                              _ODBC_TYPE_IDS.get(est, 1111), est,

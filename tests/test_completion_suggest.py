@@ -54,9 +54,9 @@ def test_completion_orders_by_weight(node):
     s = _suggest(node, {"s": {"prefix": "Nev",
                               "completion": {"field": "suggest"}}})
     texts = [o["text"] for o in s["s"][0]["options"]]
-    assert texts == ["Nevermind", "Nevermore"]
+    assert texts == ["Nevermind", "Neverland Express", "Nevermore"]
     scores = [o["score"] for o in s["s"][0]["options"]]
-    assert scores == [10.0, 5.0]
+    assert scores == [10.0, 7.0, 5.0]
 
 
 def test_completion_context_filter(node):
@@ -124,3 +124,25 @@ def test_million_entry_prefix_index_is_sublinear():
     assert dt_dense < 0.05, f"dense-prefix top-k took {dt_dense:.3f}s"
     assert dt_five < 0.1, f"5 queries took {dt_five:.3f}s"
     assert build_s < 60
+
+
+def test_completion_survives_flush_and_restart(tmp_path):
+    """The weighted prefix index persists through segment save/load
+    (flush + node restart) — suggestions must not vanish on reboot."""
+    n = Node(data_path=str(tmp_path / "data"))
+    try:
+        _index_songs(n)
+        call(n, "POST", "/music/_flush")
+    finally:
+        n.close()
+    n2 = Node(data_path=str(tmp_path / "data"))
+    try:
+        s = call(n2, "POST", "/music/_search", {"size": 0, "suggest": {
+            "s": {"prefix": "Nev",
+                  "completion": {"field": "suggest",
+                                 "contexts": {"genre": "rock"}}}}})
+        opts = s["suggest"]["s"][0]["options"]
+        assert [o["text"] for o in opts] == ["Nevermind"]
+        assert opts[0]["score"] == 10.0
+    finally:
+        n2.close()

@@ -40,8 +40,8 @@ def run_batch(bd, bt, sels, wss, lens, masks, mask_ids, k=K):
     out = []
     for q in range(len(sels)):
         vals = packed[q, :k]
-        ids = packed[q, k:2 * k].view(np.int32)
-        total = int(packed[q, 2 * k:].view(np.int32)[0])
+        ids = packed[q, k:2 * k].astype(np.int32)
+        total = int(packed[q, 2 * k:].astype(np.int32)[0])
         out.append((vals, ids, total))
     return out
 

@@ -115,8 +115,8 @@ def run_both(seg, queries, n_docs=2000, k=50,
 
 
 def unpack1(row, k):
-    return (row[:k], row[k:2 * k].view(np.int32),
-            int(row[2 * k:].view(np.int32)[0]))
+    return (row[:k], row[k:2 * k].astype(np.int32),
+            int(row[2 * k:].astype(np.int32)[0]))
 
 
 def _norm_hits(vals, ids, k):
@@ -142,10 +142,10 @@ def test_v2_matches_v1(seed):
     for qi in range(len(queries)):
         v1, d1, t1 = unpack1(out1[qi], k)
         v2 = out2[qi][:k]
-        d2 = out2[qi][k:2 * k].view(np.int32)
-        t2 = int(np.asarray(out2[qi][2 * k], np.float32).view(np.int32))
+        d2 = out2[qi][k:2 * k].astype(np.int32)
+        t2 = int(out2[qi][2 * k])
         ok = int(np.asarray(out2[qi][2 * k + 1],
-                            np.float32).view(np.int32))
+                            np.float32).astype(np.int32))
         assert ok == 1, f"q{qi} uncertified on a benign corpus"
         assert t1 == t2, (qi, t1, t2)
         nv1, nd1 = _norm_hits(v1, d1, k)
@@ -163,7 +163,7 @@ def test_v2_duplicate_term_instances():
     for qi in range(2):
         v1, d1, _ = unpack1(out1[qi], k)
         v2 = out2[qi][:k]
-        d2 = out2[qi][k:2 * k].view(np.int32)
+        d2 = out2[qi][k:2 * k].astype(np.int32)
         nv1, nd1 = _norm_hits(v1, d1, k)
         nv2, nd2 = _norm_hits(v2, d2, k)
         np.testing.assert_array_equal(nd1, nd2)
@@ -230,5 +230,5 @@ def test_v2_mass_ties_refuse_certificate():
                flat_d=bd.reshape(-1), flat_t=bt.reshape(-1), avg=10.0)
     out1, out2 = run_both(seg, [[0]], n_docs=n_docs, nb_bucket=64)
     k = 50
-    ok = int(np.asarray(out2[0][2 * k + 1], np.float32).view(np.int32))
+    ok = int(np.asarray(out2[0][2 * k + 1], np.float32).astype(np.int32))
     assert ok == 0
