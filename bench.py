@@ -1117,9 +1117,12 @@ def main():
     del corpus, truth
     if os.environ.get("BENCH_KNN8M", "1") == "0":
         parts["knn_txt"] = "; 8M kNN section disabled (BENCH_KNN8M=0)"
-    elif remaining_budget() < 600:
+    elif remaining_budget() < 1200:
+        # the phase needs slab build (~2 min clean host) + an 11.5 GiB
+        # upload that rides the FIRST query (up to ~20 min through a
+        # badly degraded tunnel) + the measured rows
         log(f"skipping 8M kNN phase (budget: "
-            f"{remaining_budget():.0f}s left < 600)")
+            f"{remaining_budget():.0f}s left < 1200)")
         parts["knn_txt"] = ("; 8M kNN skipped this run (wall-clock "
                             "budget) — see BASELINE.md round-4 "
                             "validated row: 6.3 qps, recall 1.0, "

@@ -247,11 +247,19 @@ def pack_result(vals: jax.Array, ids: jax.Array,
     ])
 
 
+def unpack_ids(buf: np.ndarray) -> np.ndarray:
+    """Float-packed int lanes -> int32, sentinel-safe. The cast ORDER
+    is load-bearing: the sentinel rides as 2^31 exactly, which float32
+    CAN represent but int32 can't — a direct cast is UB, and np.clip
+    in f32 can't even express 2^31-1. int64 first, then clip, then
+    narrow. Every packed-readback unpacker must go through this."""
+    return np.clip(buf.astype(np.int64), 0, 0x7FFFFFFF).astype(np.int32)
+
+
 def unpack_result(buf: np.ndarray, k: int):
     """Host-side inverse of pack_result on an np.float32 [2k+1] row."""
     vals = buf[:k]
-    # clip before the int cast: the sentinel float (2^31) would wrap
-    ids = np.clip(buf[k:2 * k], 0, 0x7FFFFFFF).astype(np.int32)
+    ids = unpack_ids(buf[k:2 * k])
     total = int(buf[2 * k])
     return vals, ids, total
 

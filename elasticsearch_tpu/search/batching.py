@@ -349,8 +349,8 @@ class KnnBatcher:
                 self.batched_queries += qn
             for i, e in enumerate(chunk):
                 scores = rows[i, :cut].copy()
-                ids = np.clip(rows[i, cut:], 0,
-                              0x7FFFFFFF).astype(np.int32)
+                from elasticsearch_tpu.ops.plan import unpack_ids
+                ids = unpack_ids(rows[i, cut:])
                 e.result = (scores, ids)
                 e.event.set()
 

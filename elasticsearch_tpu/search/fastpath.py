@@ -205,8 +205,12 @@ class FastPathServer:
                     {b for b in (256, 512, 1024, 2048, 4096)
                      if b <= cap} | {cap}))
                 ecap = self.ess_buckets[-1]
+                # deeper ess ladder: the lane only pays off when the
+                # essential union FITS a bucket; r5 offline modeling
+                # of the bench mix put the mean union at ~660 blocks
+                # with a long tail past 1024
                 self.ess_buckets = tuple(sorted(
-                    {b for b in (256, 512, 1024)
+                    {b for b in (256, 512, 1024, 2048)
                      if b <= ecap} | {ecap}))
                 self.n_streams = max(self.n_streams, 8)
                 self._sem = threading.Semaphore(self.n_streams)
@@ -844,8 +848,8 @@ class FastPathServer:
                 refire.append((tok, k, term_ids, filt))
                 continue
             vals = out[qi, :k_static]
-            ids = np.clip(out[qi, k_static:2 * k_static], 0,
-                          0x7FFFFFFF).astype(np.int32)
+            from elasticsearch_tpu.ops.plan import unpack_ids
+            ids = unpack_ids(out[qi, k_static:2 * k_static])
             nhit = int(min(k, np.isfinite(vals).sum()))
             v = vals[:nhit]
             d = ids[:nhit]
@@ -961,11 +965,16 @@ class FastPathServer:
         dense_rows = reg.get("dense_rows") or {}
         maxc = reg["maxc"]
         inst = sorted(known, key=lambda t: float(maxc[t]))
-        # HALF of θ, not all of it: correctness only needs Σ maxc_ne < θ
-        # (docs outside every essential list can't reach the kth), but
-        # the CERTIFICATE needs ess_(C+1) + Σ maxc_ne < kth — leaving
-        # headroom makes certification succeed instead of refiring
-        theta_safe = float(theta) * 0.5
+        # a FRACTION of θ, not all of it: correctness only needs
+        # Σ maxc_ne < θ (docs outside every essential list can't reach
+        # the kth), and the CERTIFICATE needs ess_(C+1) + Σ maxc_ne <
+        # kth. With the candidate budget at CAND=16K the overflow term
+        # is usually -inf and kth == θ for a repeat query, so 0.9
+        # keeps a real margin while TRIPLING lane eligibility vs the
+        # old 0.5 (offline model on the bench mix: 41 -> 119 of 256
+        # queries, mean essential union 2107 -> 663 blocks); failed
+        # certificates memoize into ess_bad and never retry
+        theta_safe = float(theta) * 0.9
         ne: list = []
         bound = 0.0
         ess: list = []
@@ -994,9 +1003,11 @@ class FastPathServer:
         nb_ess = int(reg["nb"][ess].sum())
         if nb_full is None:
             nb_full = int(reg["nb"][known].sum())
-        if nb_ess * 2 > nb_full:
-            # under 2x sort reduction the lane's fixed costs (extra
-            # top-(C+1), patch pass, refire risk) outweigh the win
+        if nb_ess * 5 > nb_full * 4:
+            # under a 1.25x reduction the lane's fixed costs (extra
+            # top-(C+1), patch pass, refire risk) outweigh the win —
+            # in the tunnel regime per-launch cost ~ lanes, so even
+            # modest reductions pay
             return None
         for bkt in self.ess_buckets:
             if nb_ess <= bkt:
@@ -1137,8 +1148,8 @@ class FastPathServer:
                 refire.append((tok, k, term_ids, filt, essd))
                 continue
             vals = out[qi, :k_static]
-            ids = np.clip(out[qi, k_static:2 * k_static], 0,
-                          0x7FFFFFFF).astype(np.int32)
+            from elasticsearch_tpu.ops.plan import unpack_ids
+            ids = unpack_ids(out[qi, k_static:2 * k_static])
             nhit = int(min(k, np.isfinite(vals).sum()))
             v = np.ascontiguousarray(vals[:nhit])
             d = np.ascontiguousarray(ids[:nhit])
@@ -1301,8 +1312,8 @@ class FastPathServer:
                 self._respond_empty(tok, reg)
                 continue
             vals = out[qi, :k_static]
-            ids = np.clip(out[qi, k_static:2 * k_static], 0,
-                          0x7FFFFFFF).astype(np.int32)
+            from elasticsearch_tpu.ops.plan import unpack_ids
+            ids = unpack_ids(out[qi, k_static:2 * k_static])
             total = int(out[qi, 2 * k_static:][0])
             nhit = int(min(k, np.isfinite(vals).sum()))
             v = vals[:nhit]
