@@ -534,6 +534,12 @@ def build_rest_node(corpus, tmpdir, kernel="v2m"):
     from elasticsearch_tpu.node import Node
 
     t0 = time.time()
+    t_step = time.time()
+
+    def step(name):
+        nonlocal t_step
+        log(f"  node-build step [{name}] {time.time()-t_step:.1f}s")
+        t_step = time.time()
     bd, bt, lens = corpus["block_docids"], corpus["block_tfs"], corpus["lens"]
     # the segment's block arrays EXCLUDE the bench's extra zero row — the
     # device layer appends its own reserved block
@@ -588,6 +594,7 @@ def build_rest_node(corpus, tmpdir, kernel="v2m"):
     seg = Segment("bench0", N_DOCS, postings={"title": pf},
                   numerics={"feat": nv}, keywords={"cat": kv},
                   vectors=vectors, stored=stored)
+    step("segment assembly")
 
     node = Node(settings=Settings.from_dict({
         "http": {"native": {
@@ -598,6 +605,7 @@ def build_rest_node(corpus, tmpdir, kernel="v2m"):
             "fast_kernel": kernel,
             "fast_max_k": K}},
     }), data_path=os.path.join(tmpdir, "node"))
+    step("Node construction")
     status, _ = node.rest_controller.dispatch(
         "PUT", "/bench", None,
         {"mappings": {"properties": {"title": {"type": "text"}}}})
@@ -606,7 +614,9 @@ def build_rest_node(corpus, tmpdir, kernel="v2m"):
     with eng._lock:
         eng._segments = [seg]
         eng._epoch += 1
+    step("index create + segment inject")
     port = node.start(0)
+    step("node.start")
     log(f"REST node ready in {time.time()-t0:.1f}s (port {port})")
     # the fast path registers once its kernel shapes are compiled — this
     # is the refresh/startup precompile (VERDICT r2 item 2: the 69.7s
@@ -741,6 +751,13 @@ def run_rest_path(corpus, queries, truth, tmpdir, kernel="auto",
     log(f"REST serving: {best_qps:.1f} qps over HTTP with {CLIENTS} "
         f"connections ({done} reqs, p50 {p50:.2f} ms, p99 {p99:.2f} ms, "
         f"fast-served {fast_served}, avg cohort {avg_batch:.1f})")
+    if fp is not None:
+        # lane routing forensics for the round analysis: how much of
+        # the serving phase rode the theta-warm essential lane
+        delta = {k: fstats1.get(k, 0) - fstats0.get(k, 0)
+                 for k in ("fast_queries", "ess_queries", "ess_refires",
+                           "v2_queries", "cohorts")}
+        log(f"serving-phase lanes: {delta}")
     if emit_cb is not None:
         # the HEADLINE is measured — freshen the metric line NOW so any
         # later kill still leaves the serving number parsed
