@@ -674,6 +674,14 @@ def run_rest_path(corpus, queries, truth, tmpdir, kernel="auto",
     plan_mod.MIN_PLAN_BUCKET = int(os.environ.get("BENCH_REST_FLOOR", 1024))
     batching_mod._Q_BUCKETS = (1, 32)
 
+    # surface the serving engine's own step logs (warm-compile and
+    # dense-table timings) in the bench stderr — the driver-run record
+    import logging as _logging
+    h = _logging.StreamHandler(sys.stderr)
+    h.setFormatter(_logging.Formatter("  fastpath: %(message)s"))
+    fplog = _logging.getLogger("elasticsearch_tpu.fastpath")
+    fplog.addHandler(h)
+    fplog.setLevel(_logging.INFO)
     node, port = build_rest_node(corpus, tmpdir, kernel)
     base = f"http://127.0.0.1:{port}"
     bodies = []

@@ -353,8 +353,12 @@ class FastPathServer:
         reg["flat_docids"] = dp.block_docids.reshape(-1)
         reg["flat_tfs"] = dp.block_tfs.reshape(-1)
         reg["theta"] = {}    # (tids, filt, k) -> (θ, exact_total)
+        t0 = time.time()
         self._build_dense_hot(reg)
+        logger.info("dense hot-term build %.1fs", time.time() - t0)
+        t0 = time.time()
         self._warm_shapes(reg)
+        logger.info("warm shapes %.1fs", time.time() - t0)
         # only now does C++ start routing /{index}/_search to the queue
         terms_blob = b"".join(t.encode("utf-8") for t in pf.terms)
         lens = np.fromiter((len(t.encode("utf-8")) for t in pf.terms),
@@ -431,7 +435,12 @@ class FastPathServer:
                 ln = int(df[t])
                 dense[row, flat_d[s:s + ln]] = flat_t[s:s + ln]
                 reg["dense_rows"][int(t)] = row
+            t_up = time.time()
             reg["dense_tf"] = jax.device_put(dense)
+            import jax as _jax
+            _jax.block_until_ready(reg["dense_tf"])
+            logger.info("dense table upload %.1fs (%.0f MB)",
+                        time.time() - t_up, dense.nbytes / 2**20)
             logger.info("fastpath dense hot-term table: %d rows x %d "
                         "docs (%s, %.0f MB)", h, nd, dtype.__name__,
                         dense.nbytes / 2**20)
