@@ -547,24 +547,68 @@ class ShardSearcher:
             value = _get_path(source, fname)
             if not isinstance(value, str):
                 continue
-            terms = query_terms.get(fname, set())
-            ft = self.mapper.field_type(fname)
-            analyzer_name = getattr(ft, "analyzer_name", "standard")
-            analyzer = (self.mapper.analysis.get(analyzer_name)
-                        if self.mapper.analysis.has(analyzer_name)
-                        else self.mapper.analysis.default)
-            spans = [(t.start_offset, t.end_offset, t.term)
-                     for t in analyzer.analyze(value)
-                     if t.term in terms] if terms else []
+            htype = str(opt("type", "unified"))
+            if htype == "fvh":
+                # FVH analogue (ref: search/fetch/subphase/highlight/
+                # FastVectorHighlighter.java): matched_fields merges
+                # matches from sibling (multi-)fields into this field's
+                # highlighting — each matched field's spans derive
+                # through ITS OWN analyzer over the same source text
+                # (a stemmed or case-preserving subfield's hits mark
+                # the original). The reference reads term vectors; this
+                # engine's positional streams keep term ids but not
+                # offsets, so offsets re-derive through the analyzers
+                # (disclosed), preserving FVH's observable behaviors:
+                # matched_fields, match-centered fragments,
+                # boundary_chars/boundary_max_scan trimming.
+                matched = opt("matched_fields", None) or [fname]
+                if isinstance(matched, str):
+                    matched = [matched]
+                if fname not in matched:
+                    matched = [fname] + list(matched)
+                spans = []
+                for m in matched:
+                    mterms = query_terms.get(m, set())
+                    if not mterms:
+                        continue
+                    mft = self.mapper.field_type(m)
+                    aname = getattr(
+                        mft, "search_analyzer_name",
+                        getattr(mft, "analyzer_name", "standard"))
+                    man = (self.mapper.analysis.get(aname)
+                           if self.mapper.analysis.has(aname)
+                           else self.mapper.analysis.default)
+                    spans.extend(
+                        (t.start_offset, t.end_offset, t.term)
+                        for t in man.analyze(value)
+                        if t.term in mterms)
+                spans.sort()
+                spans = spans[:int(opt("phrase_limit", 256))]
+            else:
+                terms = query_terms.get(fname, set())
+                ft = self.mapper.field_type(fname)
+                analyzer_name = getattr(ft, "analyzer_name", "standard")
+                analyzer = (self.mapper.analysis.get(analyzer_name)
+                            if self.mapper.analysis.has(analyzer_name)
+                            else self.mapper.analysis.default)
+                spans = [(t.start_offset, t.end_offset, t.term)
+                         for t in analyzer.analyze(value)
+                         if t.term in terms] if terms else []
             if not spans:
                 if no_match > 0 and value:
                     out[fname] = [value[:_snap_end(value, no_match)]]
                 continue
-            if n_frags == 0 or opt("type", "unified") == "plain":
+            if n_frags == 0 or htype == "plain":
                 out[fname] = [_wrap_spans(
                     value, [(s, e) for s, e, _t in spans], pre, post)]
                 continue
-            passages = _build_passages(value, frag_size)
+            if htype == "fvh":
+                passages = _fvh_fragments(
+                    value, spans, frag_size,
+                    str(opt("boundary_chars", ".,!? \t\n")),
+                    int(opt("boundary_max_scan", 20)))
+            else:
+                passages = _build_passages(value, frag_size)
             scored = []
             for pi, (ps, pe) in enumerate(passages):
                 inside = [sp for sp in spans
@@ -588,6 +632,37 @@ class ShardSearcher:
             if frags:
                 out[fname] = frags
         return out
+
+
+def _fvh_fragments(text: str, spans, frag_size: int,
+                   boundary_chars: str, boundary_max_scan: int):
+    """FVH fragmenting: fragments CENTER on match runs (the reference's
+    SimpleFragmentsBuilder discipline) and trim to the nearest boundary
+    char within ``boundary_max_scan`` (BoundaryScanner semantics) —
+    unlike the unified path's precomputed sentence passages."""
+    bset = set(boundary_chars)
+    n = len(text)
+
+    def snap(pos: int, forward: bool) -> int:
+        pos = max(0, min(n, pos))
+        rng = (range(pos, min(n, pos + boundary_max_scan)) if forward
+               else range(pos, max(0, pos - boundary_max_scan), -1))
+        for i in rng:
+            if 0 <= i < n and text[i] in bset:
+                return i + 1 if forward else i + 1
+        return pos
+
+    frags = []
+    covered_to = -1
+    for s, _e, _t in sorted(spans):
+        if s <= covered_to:
+            continue
+        lo = snap(s - frag_size // 2, forward=False) \
+            if s > frag_size // 2 else 0
+        hi = snap(lo + frag_size, forward=True)
+        frags.append((lo, min(hi, n)))
+        covered_to = hi
+    return frags
 
 
 def _wrap_spans(text: str, spans, pre: str, post: str) -> str:

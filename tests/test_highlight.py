@@ -109,3 +109,49 @@ def test_plain_type_keeps_whole_field(node):
     frags = hit["highlight"]["body"]
     assert len(frags) == 1
     assert frags[0].replace("<em>", "").replace("</em>", "") == PARA
+
+
+# ---------------------------------------------------------------------------
+# FVH analogue (ref: FastVectorHighlighter.java — matched_fields,
+# match-centered fragments, boundary scanning)
+# ---------------------------------------------------------------------------
+
+def _dispatch(node, method, path, body):
+    st, out = node.rest_controller.dispatch(method, path, None, body)
+    assert st in (200, 201), out
+    return out
+
+
+def test_fvh_matched_fields_merges_subfield_hits(node):
+    _dispatch(node, "PUT", "/books2", {"mappings": {"properties": {
+        "title": {"type": "text",
+                  "fields": {"exact": {"type": "text",
+                                       "analyzer": "whitespace"}}}}}})
+    _dispatch(node, "PUT", "/books2/_doc/1",
+              {"title": "Running with Scissors"})
+    _dispatch(node, "POST", "/books2/_refresh", None)
+    r = _dispatch(node, "POST", "/books2/_search", {
+        "query": {"match": {"title.exact": "Running"}},
+        "highlight": {"fields": {"title": {
+            "type": "fvh",
+            "matched_fields": ["title", "title.exact"]}}}})
+    hit = r["hits"]["hits"][0]
+    assert hit["highlight"]["title"][0].count("<em>") == 1
+    assert "<em>Running</em>" in hit["highlight"]["title"][0]
+
+
+def test_fvh_fragments_center_on_matches(node):
+    filler = "lorem ipsum dolor sit amet " * 20
+    text = filler + "the zebra appears here " + filler
+    _dispatch(node, "PUT", "/books3/_doc/1", {"body": text})
+    _dispatch(node, "POST", "/books3/_refresh", None)
+    r = _dispatch(node, "POST", "/books3/_search", {
+        "query": {"match": {"body": "zebra"}},
+        "highlight": {"fields": {"body": {
+            "type": "fvh", "fragment_size": 60,
+            "number_of_fragments": 2}}}})
+    frags = r["hits"]["hits"][0]["highlight"]["body"]
+    assert len(frags) >= 1
+    assert "<em>zebra</em>" in frags[0]
+    # the fragment is a WINDOW around the match, not the whole field
+    assert len(frags[0]) < 140
