@@ -388,11 +388,14 @@ def run_tpu_kernel(corpus, queries):
                              ws_b)[0]
             acc = out if acc is None else acc + out
             done_launches += 1
-            # a relay that STARTS in degraded mode executes these
-            # "pre-readback" launches synchronously at ~450 ms each —
-            # 2000 of them would wedge the whole bench for 15 minutes.
-            # Periodic sync + wall guard caps the section honestly.
-            if done_launches % 100 == 0:
+            # a relay that STARTS in degraded/wedged mode executes
+            # these "pre-readback" launches synchronously (up to
+            # ~minutes each when wedged) — 2000 of them would stall
+            # the whole bench. The FIRST sync happens after only 10
+            # launches so a wedged relay is detected with minimal
+            # in-flight work; afterwards sync every 100 under a wall
+            # guard.
+            if done_launches == 10 or done_launches % 100 == 0:
                 jax.block_until_ready(acc)
                 if time.time() - t0 > 60:
                     log(f"sustained section wall-capped at "
