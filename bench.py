@@ -693,12 +693,23 @@ def run_rest_path(corpus, queries, truth, tmpdir, kernel="auto",
         bodies.append({"query": {"match": {"title": text}},
                        "size": K, "_source": False})
 
-    def http_post(body):
-        r = urllib.request.Request(
-            base + "/bench/_search", data=json.dumps(body).encode(),
-            method="POST", headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(r, timeout=300) as resp:
-            return json.loads(resp.read())
+    def http_post(body, tries: int = 3):
+        last = None
+        for attempt in range(tries):
+            r = urllib.request.Request(
+                base + "/bench/_search",
+                data=json.dumps(body).encode(), method="POST",
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(r, timeout=300) as resp:
+                    return json.loads(resp.read())
+            except OSError as e:
+                # a wedged relay can stall the node for minutes at a
+                # time (observed >300 s right after registration) —
+                # one lost request must not kill the whole bench
+                last = e
+                log(f"http_post retry {attempt + 1}: {e!r}")
+        raise last
 
     # ---- first-query latency post-registration (the cold-start number:
     # kernel shapes compiled at registration, so this must be fast)
@@ -717,15 +728,21 @@ def run_rest_path(corpus, queries, truth, tmpdir, kernel="auto",
         t0 = time.time()
         def one(args):
             qi, body = args
-            resp = http_post(body)
+            try:
+                resp = http_post(body)
+            except OSError:
+                return None        # relay stall; disclosed below
             ids = {int(h["_id"]) for h in resp["hits"]["hits"]}
             tset = truth[qi]
             return len(ids & tset) / max(1, len(tset))
         with ThreadPoolExecutor(max_workers=32) as ex:
-            recalls = list(ex.map(one, enumerate(bodies)))
-        r = float(np.mean(recalls))
-        log(f"REST recall@{K} {label} over {len(bodies)} queries: "
-            f"{r:.4f} ({time.time()-t0:.1f}s)")
+            recalls = [x for x in ex.map(one, enumerate(bodies))]
+        lost = sum(1 for x in recalls if x is None)
+        kept = [x for x in recalls if x is not None]
+        r = float(np.mean(kept)) if kept else 0.0
+        log(f"REST recall@{K} {label} over {len(kept)}/{len(bodies)} "
+            f"queries: {r:.4f} ({time.time()-t0:.1f}s"
+            + (f"; {lost} lost to relay stalls" if lost else "") + ")")
         return r
 
     rest_recall = recall_pass("cold")
@@ -1166,4 +1183,15 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise
+    except BaseException as e:
+        import traceback
+        print("bench: fatal error — flushing last metric",
+              file=sys.stderr, flush=True)
+        traceback.print_exc()
+        if _LAST_PAYLOAD:
+            print(json.dumps(_LAST_PAYLOAD), flush=True)
+        os._exit(1)
