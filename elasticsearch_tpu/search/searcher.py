@@ -649,7 +649,7 @@ def _fvh_fragments(text: str, spans, frag_size: int,
                else range(pos, max(0, pos - boundary_max_scan), -1))
         for i in rng:
             if 0 <= i < n and text[i] in bset:
-                return i + 1 if forward else i + 1
+                return i + 1    # cut just past the boundary char
         return pos
 
     frags = []
