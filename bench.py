@@ -299,12 +299,59 @@ def select_blocks(q, corpus, zero_block, floor):
             np.asarray(pad_pow2(ws, 0.0, floor), np.float32))
 
 
+class DeviceUnreachable(Exception):
+    """The relay/device did not answer the preflight within its window
+    (observed: the relay can die for HOURS mid-session). Device
+    sections are skipped and the metric line discloses it."""
+
+
+def _preflight_device(timeout_s: float = 600.0):
+    """Prove the device answers a tiny upload+launch+readback within
+    ``timeout_s`` — in a daemon worker, because a wedged relay blocks
+    device_put UNINTERRUPTIBLY. Raises DeviceUnreachable on timeout."""
+    result: dict = {}
+
+    def work():
+        try:
+            import jax
+            d = jax.device_put(np.ones(128, np.float32),
+                               jax.devices()[0])
+            jax.block_until_ready(d)
+            result["ok"] = True
+        except Exception as e:       # pragma: no cover - env dependent
+            result["err"] = e
+
+    t = threading.Thread(target=work, daemon=True,
+                         name="device-preflight")
+    t.start()
+    t.join(timeout_s)
+    if result.get("ok"):
+        return
+    if "err" in result:
+        # a real exception (broken install, bad config) is NOT an
+        # outage — let it propagate as the failure it is
+        raise result["err"]
+    raise DeviceUnreachable(
+        f"device preflight exceeded {timeout_s:.0f}s (relay wedged)")
+
+
 def run_tpu_kernel(corpus, queries):
+    # the preflight is the process's FIRST backend touch — even
+    # jax.devices()/default_backend block uninterruptibly on a dead
+    # relay, so it runs in a timeout-bounded daemon thread first
+    _preflight_device(float(os.environ.get("BENCH_PREFLIGHT_S", 600)))
     import jax
 
     from elasticsearch_tpu.ops.bm25 import (bm25_sorted_topk,
                                             bm25_sorted_topk_batch)
 
+    # persistent compile cache (safe after preflight): serving shapes
+    # compile once per machine (14.4s -> 0.7s measured)
+    try:
+        from elasticsearch_tpu.search.fastpath import enable_compile_cache
+        enable_compile_cache()
+    except Exception as e:
+        log(f"compile cache unavailable: {e!r}")
     dev = jax.devices()[0]
     log(f"device: {dev}")
     t0 = time.time()
@@ -1044,7 +1091,15 @@ def compose_metric(p):
                 f"hybrid RRF (match+knn, rank.rrf) "
                 f"{extra.get('rrf_hybrid', 0):.0f} qps"
                 if extra else "; product rows pending")
-    if p.get("rest_qps") is None:
+    if p.get("rest_qps") is None and p.get("device_down"):
+        head = (f"DEVICE UNREACHABLE this run: the TPU relay did not "
+                f"answer a 128-float preflight ({p['device_down']}) — "
+                f"an environment outage, not an engine result (relay "
+                f"outages lasting hours have been observed in this "
+                f"environment); device sections skipped; "
+                + ("CPU baseline measured for reference; "
+                   if p.get("cpu_qps") else ""))
+    elif p.get("rest_qps") is None:
         head = (f"PROVISIONAL (REST serving section pending — run cut "
                 f"early): raw fused-batch kernel "
                 f"{p.get('kernel_qps', 0):.0f} qps single / "
@@ -1110,14 +1165,6 @@ def main():
         emit(compose_metric(parts), value,
              value / cpu if cpu else float("nan"))
 
-    # persistent compile cache from the first jax use: raw-kernel and
-    # serving shapes compile once per machine (14.4s -> 0.7s measured)
-    try:
-        from elasticsearch_tpu.search.fastpath import enable_compile_cache
-        enable_compile_cache()
-    except Exception as e:
-        log(f"compile cache unavailable: {e!r}")
-
     rng = np.random.default_rng(12345)
     corpus = build_corpus(rng)
     queries = make_queries(rng, corpus["df"])
@@ -1125,8 +1172,22 @@ def main():
     truth = cpu_exact_truth(corpus, queries)
     cpu_qps, cpu_recall = run_cpu_maxscore(corpus, queries, truth)
     parts.update(cpu_qps=cpu_qps, cpu_recall=cpu_recall)
+    # FIRST parsed line lands before ANY jax/backend touch: a dead
+    # relay hangs even backend INIT uninterruptibly (observed: hours),
+    # and a run killed there must still have parsed output on record
+    emit_now()
 
-    kernel_qps, batch_qps, handles = run_tpu_kernel(corpus, queries)
+    try:
+        kernel_qps, batch_qps, handles = run_tpu_kernel(corpus, queries)
+    except DeviceUnreachable as e:
+        log(f"DEVICE UNREACHABLE: {e}")
+        parts["device_down"] = str(e)
+        emit_now()
+        log(f"bench aborted (device unreachable) in "
+            f"{time.time()-_T_START:.0f}s")
+        # the preflight worker may be stuck in an uninterruptible
+        # device_put; a normal exit would join it forever
+        os._exit(0)
     parts.update(kernel_qps=kernel_qps, batch_qps=batch_qps)
     if os.environ.get("BENCH_SECONDARY", "1") != "0":
         try:
