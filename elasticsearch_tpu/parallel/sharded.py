@@ -105,7 +105,11 @@ def sharded_bm25_topk(index: ShardedIndex,
             docids, tfs, lens, live, sel, ws, nd, index.avg_len,
             k1, b, k)                                       # [Q, k]
         shard_idx = jax.lax.axis_index("shard")
-        gids = ids.astype(jnp.int64) + shard_idx.astype(jnp.int64) * nd
+        # global ids widen to int64 only under x64 (shard*nd can pass
+        # 2^31 at many-shard scale); x64-off deployments stay int32 —
+        # requesting int64 there just truncates with a warning
+        gid_t = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+        gids = ids.astype(gid_t) + shard_idx.astype(gid_t) * nd
         # merge across shards: all_gather over ICI, re-top-k on device
         return _merge_over_shards(vals, gids, k)
 
@@ -132,7 +136,11 @@ def sharded_knn_topk(index: ShardedIndex,
         masked = jnp.where(live[None, :], scores, -jnp.inf)
         vals, ids = jax.lax.top_k(masked, k)                 # [Q, k]
         shard_idx = jax.lax.axis_index("shard")
-        gids = ids.astype(jnp.int64) + shard_idx.astype(jnp.int64) * nd
+        # global ids widen to int64 only under x64 (shard*nd can pass
+        # 2^31 at many-shard scale); x64-off deployments stay int32 —
+        # requesting int64 there just truncates with a warning
+        gid_t = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+        gids = ids.astype(gid_t) + shard_idx.astype(gid_t) * nd
         all_vals = jax.lax.all_gather(vals, "shard", axis=1)
         all_gids = jax.lax.all_gather(gids, "shard", axis=1)
         qn = all_vals.shape[0]
