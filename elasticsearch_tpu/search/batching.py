@@ -27,6 +27,8 @@ from typing import Dict, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from elasticsearch_tpu.ops.plan import unpack_ids as _unpack_ids
+
 from elasticsearch_tpu.ops import plan as plan_ops
 from elasticsearch_tpu.search.plan import BoundPlan, execute_bound
 
@@ -349,8 +351,7 @@ class KnnBatcher:
                 self.batched_queries += qn
             for i, e in enumerate(chunk):
                 scores = rows[i, :cut].copy()
-                from elasticsearch_tpu.ops.plan import unpack_ids
-                ids = unpack_ids(rows[i, cut:])
+                ids = _unpack_ids(rows[i, cut:])
                 e.result = (scores, ids)
                 e.event.set()
 

@@ -33,6 +33,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from elasticsearch_tpu.ops.plan import unpack_ids as _unpack_ids
+
 logger = logging.getLogger("elasticsearch_tpu.fastpath")
 
 MAX_TERMS = 16    # keep in sync with estpu_http.cpp
@@ -857,8 +859,7 @@ class FastPathServer:
                 refire.append((tok, k, term_ids, filt))
                 continue
             vals = out[qi, :k_static]
-            from elasticsearch_tpu.ops.plan import unpack_ids
-            ids = unpack_ids(out[qi, k_static:2 * k_static])
+            ids = _unpack_ids(out[qi, k_static:2 * k_static])
             nhit = int(min(k, np.isfinite(vals).sum()))
             v = vals[:nhit]
             d = ids[:nhit]
@@ -1166,8 +1167,7 @@ class FastPathServer:
                 refire.append((tok, k, term_ids, filt, essd))
                 continue
             vals = out[qi, :k_static]
-            from elasticsearch_tpu.ops.plan import unpack_ids
-            ids = unpack_ids(out[qi, k_static:2 * k_static])
+            ids = _unpack_ids(out[qi, k_static:2 * k_static])
             nhit = int(min(k, np.isfinite(vals).sum()))
             v = np.ascontiguousarray(vals[:nhit])
             d = np.ascontiguousarray(ids[:nhit])
@@ -1330,8 +1330,7 @@ class FastPathServer:
                 self._respond_empty(tok, reg)
                 continue
             vals = out[qi, :k_static]
-            from elasticsearch_tpu.ops.plan import unpack_ids
-            ids = unpack_ids(out[qi, k_static:2 * k_static])
+            ids = _unpack_ids(out[qi, k_static:2 * k_static])
             total = int(out[qi, 2 * k_static:][0])
             nhit = int(min(k, np.isfinite(vals).sum()))
             v = vals[:nhit]
