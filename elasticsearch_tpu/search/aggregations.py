@@ -378,15 +378,50 @@ def _metric(agg_type, body, ctx, mapper):
         return _scripted_metric(body, ctx)
 
     if agg_type == "top_hits":
+        import json as _json
         size = int(body.get("size", 3))
-        hits = []
-        for seg, mask, _m in ctx:
-            import json as _json
-            for d in np.nonzero(mask[: seg.n_docs])[0][:size]:
-                hits.append({"_id": seg.stored.ids[int(d)],
-                             "_source": _json.loads(seg.stored.source(int(d)))})
-        hits = hits[:size]
-        return {"hits": {"total": {"value": len(hits), "relation": "eq"},
+        sort_spec = body.get("sort")
+        total = int(sum(int(mask[: seg.n_docs].sum())
+                        for seg, mask, _m in ctx))
+        if sort_spec:
+            # primary sort key over numeric doc values (the same
+            # primary-key discipline as the searcher's sort path);
+            # missing values sort last in either direction
+            spec = (sort_spec[0] if isinstance(sort_spec, list)
+                    else sort_spec)
+            (sfield, sdir), = spec.items()
+            order = (sdir.get("order", "asc")
+                     if isinstance(sdir, dict) else str(sdir))
+            desc = order == "desc"
+            cand = []
+            for seg, mask, _m in ctx:
+                nv = seg.numerics.get(sfield)
+                idxs = np.nonzero(mask[: seg.n_docs])[0]
+                for d in idxs:
+                    d = int(d)
+                    if nv is not None and not nv.missing[d]:
+                        key = float(nv.values[d])
+                        missing_rank = 0
+                    else:
+                        key = 0.0
+                        missing_rank = 1
+                    cand.append((missing_rank,
+                                 -key if desc else key, seg, d))
+            cand.sort(key=lambda e: (e[0], e[1], e[3]))
+            hits = [{"_id": seg.stored.ids[d],
+                     "_source": _json.loads(seg.stored.source(d)),
+                     "sort": [(-k if desc else k) if mr == 0 else None]}
+                    for mr, k, seg, d in cand[:size]]
+        else:
+            hits = []
+            for seg, mask, _m in ctx:
+                for d in np.nonzero(mask[: seg.n_docs])[0][:size]:
+                    hits.append({
+                        "_id": seg.stored.ids[int(d)],
+                        "_source": _json.loads(
+                            seg.stored.source(int(d)))})
+            hits = hits[:size]
+        return {"hits": {"total": {"value": total, "relation": "eq"},
                          "hits": hits}}
 
     if agg_type == "cardinality":
