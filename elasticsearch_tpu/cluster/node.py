@@ -74,7 +74,7 @@ class ClusterNode:
         self.routing = OperationRouting()
         self.data_node = DataNodeService(transport, scheduler, data_path)
         self.search_service = DistributedSearchService(
-            transport, self.data_node, self.routing)
+            transport, self.data_node, self.routing, scheduler=scheduler)
         # secure-settings keystore (ref: node/Node.java:389-391 wiring of
         # ConsistentSettingsService): when present, the elected master
         # publishes salted hashes and joiners must match them

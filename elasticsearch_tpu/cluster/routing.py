@@ -67,6 +67,32 @@ class ShardId:
         return f"[{self.index}][{self.shard}]"
 
 
+class ShardIterator:
+    """An ordered walk over the copies of ONE shard group (ref:
+    cluster/routing/ShardIterator / PlainShardIterator): the coordinator
+    takes the first copy, and on failure asks for the next one —
+    replica failover is `next_or_none()` until the group is exhausted.
+    Copies arrive ARS-ranked (best first)."""
+
+    __slots__ = ("shard_id", "_copies", "_pos")
+
+    def __init__(self, shard_id: ShardId, copies: List[ShardRouting]):
+        self.shard_id = shard_id
+        self._copies = list(copies)
+        self._pos = 0
+
+    def next_or_none(self) -> Optional[ShardRouting]:
+        if self._pos >= len(self._copies):
+            return None
+        copy = self._copies[self._pos]
+        self._pos += 1
+        return copy
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (f"ShardIterator({self.shard_id}, "
+                f"{self._pos}/{len(self._copies)})")
+
+
 class OperationRouting:
     """Ref: OperationRouting.java."""
 
@@ -101,29 +127,40 @@ class OperationRouting:
             return primary
         return None
 
-    def search_shards(self, state: ClusterState, index: str,
-                      preference: Optional[str] = None
-                      ) -> List[ShardRouting]:
-        """One active copy per shard group, ARS-ranked (ref:
-        OperationRouting.searchShards + GroupShardsIterator)."""
+    def shard_iterators(self, state: ClusterState, index: str,
+                        preference: Optional[str] = None
+                        ) -> List[ShardIterator]:
+        """One iterator per shard group with ALL active copies ARS-ranked
+        best-first (ref: OperationRouting.searchShards returning a
+        GroupShardsIterator of rank-ordered ShardIterators). Groups with
+        no active copy yield an EMPTY iterator so the coordinator can
+        report them failed instead of silently dropping the shard."""
         irt = state.routing_table.index(index)
         if irt is None:
             return []
-        chosen: List[ShardRouting] = []
+        groups: List[ShardIterator] = []
         for shard_num in sorted(irt.shards):
             table: IndexShardRoutingTable = irt.shards[shard_num]
             active = table.active_shards()
-            if not active:
-                continue
             if preference == "_primary":
-                pick = table.primary if (table.primary is not None
-                                         and table.primary.active) \
-                    else active[0]
+                ranked = sorted(active, key=lambda s: not s.primary)
             else:
-                pick = min(active, key=lambda s: (
+                ranked = sorted(active, key=lambda s: (
                     self.collector.rank(s.current_node_id or ""),
                     not s.primary))
-            chosen.append(pick)
+            groups.append(ShardIterator(ShardId(index, shard_num), ranked))
+        return groups
+
+    def search_shards(self, state: ClusterState, index: str,
+                      preference: Optional[str] = None
+                      ) -> List[ShardRouting]:
+        """One active copy per shard group, ARS-ranked (the first pick of
+        each shard iterator; groups with no active copy are skipped)."""
+        chosen: List[ShardRouting] = []
+        for it in self.shard_iterators(state, index, preference):
+            pick = it.next_or_none()
+            if pick is not None:
+                chosen.append(pick)
         return chosen
 
     def all_search_groups(self, state: ClusterState,

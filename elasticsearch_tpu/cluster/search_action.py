@@ -1,5 +1,5 @@
 """Distributed search: scatter-gather query-then-fetch over the
-transport.
+transport, with replica failover and the partial-results protocol.
 
 The multi-node analogue of the in-process SearchService (ref:
 action/search/TransportSearchAction.java:93,469-523 coordinator side;
@@ -7,11 +7,31 @@ SearchService.executeQueryPhase/executeFetchPhase data-node side;
 SearchPhaseController.java:154-218 top-k merge; FetchSearchPhase
 .java:104-161 fetch-winners-only).
 
-Coordinator (any node): resolve index → ARS-ranked shard copies →
-per-shard query RPC → incremental top-k merge → fetch RPC to the shards
-owning the winners → assemble. Per-shard results carry EWMA queue/service
-stats for adaptive replica selection, like the reference's
+Coordinator (any node): resolve index → ARS-ranked shard-copy iterators
+→ per-shard query RPC → incremental top-k merge → fetch RPC to the
+shards owning the winners → assemble. Per-shard results carry EWMA
+queue/service stats for adaptive replica selection, like the reference's
 QueryPhase.execute:307-315 → ResponseCollectorService loop.
+
+Failure semantics (ref: AbstractSearchAsyncAction.onShardFailure →
+performPhaseOnShard on the next copy):
+
+- a failed query-phase copy is retried on the shard group's next
+  ARS-ranked copy with capped exponential backoff, until the group's
+  copies are exhausted or the failure is non-retryable (a parse or
+  illegal-argument error fails identically on every copy);
+- every terminal shard failure becomes a typed ShardSearchFailure
+  serialized into ``_shards.failures``; ``allow_partial_search_results``
+  (per request, default from the cluster setting
+  ``search.default_allow_partial_results``) decides whether a partially
+  failed search returns reduced results or raises
+  SearchPhaseExecutionException. All-shards-failed always raises.
+- a search-level time budget (body ``timeout``) converts unresolved
+  shards into failures at the deadline and returns what has been
+  reduced so far with ``timed_out: true``;
+- a failed fetch RPC is retried once per shard on another active copy
+  before the affected hits are dropped as a counted, reported failure
+  (never a silent hit drop).
 
 On-node shard fan-out happens inside one process (all local shards of an
 index are searched in a single handler call), so a host's shards merge
@@ -22,15 +42,25 @@ ICI collectives pre-merge them (parallel/sharded.py).
 
 from __future__ import annotations
 
+import threading
 import time
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.cluster.routing import (
     OperationRouting,
     ResponseCollectorService,
+    ShardIterator,
 )
 from elasticsearch_tpu.cluster.state import ClusterState, ShardRouting
-from elasticsearch_tpu.common.errors import IndexNotFoundException
+from elasticsearch_tpu.common.errors import (
+    IndexNotFoundException,
+    NodeNotConnectedException,
+    NoShardAvailableActionException,
+    SearchPhaseExecutionException,
+    error_type_of,
+    snake_case,
+)
 from elasticsearch_tpu.search.queries import MatchAllQuery, parse_query
 from elasticsearch_tpu.search.searcher import DocAddress, ShardSearcher
 from elasticsearch_tpu.transport.transport import ResponseHandler
@@ -40,15 +70,122 @@ FETCH_PHASE_ACTION = "indices:data/read/search[phase/fetch/id]"
 
 DEFAULT_SIZE = 10
 
+# capped exponential backoff between copy retries of one shard group
+RETRY_BACKOFF_BASE = 0.05
+RETRY_BACKOFF_CAP = 1.0
+
+# cluster setting that seeds the per-request flag (ref:
+# SearchService.DEFAULT_ALLOW_PARTIAL_SEARCH_RESULTS)
+ALLOW_PARTIAL_SETTING = "search.default_allow_partial_results"
+
+# failures that will fail identically on every copy — retrying another
+# replica cannot help (ref: the reference surfaces these immediately
+# instead of walking the shard iterator). Names are snake_case; lookups
+# normalize through snake_case() so CamelCase class names off the wire
+# (RemoteTransportException.remote_type) match too.
+NON_RETRYABLE_TYPES = {
+    "parsing_exception",
+    "illegal_argument_exception",
+    "query_shard_exception",
+    "mapper_parsing_exception",
+    "script_exception",
+    "search_phase_execution_exception",
+}
+
+
+def failure_type_of(exc: BaseException) -> str:
+    """The snake_case wire type of a (possibly proxied) failure: a
+    remote_type off the wire may be a CamelCase class name — normalize
+    so `_shards.failures[].reason.type` is uniform across paths."""
+    remote = getattr(exc, "remote_type", None)
+    return snake_case(remote) if remote is not None else error_type_of(exc)
+
+
+def is_retryable_failure(exc: BaseException) -> bool:
+    """Whether another copy of the shard may succeed where this one
+    failed. Connect/timeout/node-level failures are retryable; request
+    errors (parse, illegal argument) are not. The remote exception type
+    travels via RemoteTransportException.remote_type."""
+    return failure_type_of(exc) not in NON_RETRYABLE_TYPES
+
+
+@dataclass
+class ShardSearchFailure:
+    """One terminal shard-copy failure (ref:
+    action/search/ShardSearchFailure): serialized into
+    ``_shards.failures`` with the ES response shape."""
+
+    index: str
+    shard: int
+    node: Optional[str]
+    type: str
+    reason: str
+    phase: str = "query"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"shard": self.shard, "index": self.index,
+                "node": self.node,
+                "reason": {"type": self.type, "reason": self.reason,
+                           "phase": self.phase}}
+
+    @staticmethod
+    def from_exception(index: str, shard: int, node: Optional[str],
+                       exc: BaseException,
+                       phase: str = "query") -> "ShardSearchFailure":
+        return ShardSearchFailure(
+            index=index, shard=shard, node=node,
+            type=failure_type_of(exc), reason=str(exc), phase=phase)
+
+
+class _WallClock:
+    """Minimal Scheduler stand-in for callers that construct the service
+    without one (production default): real time + threading.Timer."""
+
+    @staticmethod
+    def now() -> float:
+        return time.monotonic()
+
+    @staticmethod
+    def schedule(delay: float, fn: Callable[[], None],
+                 description: str = ""):
+        if delay <= 0:
+            fn()
+            return None
+        t = threading.Timer(delay, fn)
+        t.daemon = True
+        t.start()
+        return t  # threading.Timer exposes cancel(), like Cancellable
+
+
+class _ShardGroup:
+    """Coordinator-side retry state for one shard group."""
+
+    __slots__ = ("index", "shard", "iterator", "current", "attempts",
+                 "failures", "resolved", "ok")
+
+    def __init__(self, index: str, shard: int, iterator: ShardIterator):
+        self.index = index
+        self.shard = shard
+        self.iterator = iterator
+        self.current: Optional[ShardRouting] = None
+        self.attempts = 0
+        self.failures: List[ShardSearchFailure] = []
+        self.resolved = False
+        self.ok = False
+
 
 class DistributedSearchService:
     """Both sides of the two-phase protocol (registered on every node)."""
 
     def __init__(self, transport, data_node,
-                 routing: Optional[OperationRouting] = None):
+                 routing: Optional[OperationRouting] = None,
+                 scheduler=None):
         self.transport = transport
         self.data_node = data_node
         self.routing = routing or OperationRouting()
+        # retry backoff + the search time budget need a clock; under the
+        # deterministic harness this is the shared DeterministicTaskQueue
+        self.scheduler = scheduler or _WallClock()
         transport.register_request_handler(QUERY_PHASE_ACTION,
                                            self._on_query_phase)
         transport.register_request_handler(FETCH_PHASE_ACTION,
@@ -68,7 +205,9 @@ class DistributedSearchService:
 
     def _on_query_phase(self, req, channel, src) -> None:
         """Run the query phase on the named local shards; serializable
-        per-shard top-k (ref: QuerySearchResult)."""
+        per-shard top-k (ref: QuerySearchResult). A failing shard yields
+        an in-band typed error so its siblings on this node still
+        answer — the coordinator retries only the failed shard."""
         t0 = time.monotonic()
         body = req.get("body") or {}
         query = (parse_query(body["query"]) if body.get("query")
@@ -78,24 +217,37 @@ class DistributedSearchService:
         k = int(req["k"])
         shard_results = []
         for shard_id in req["shards"]:
-            searcher = self._searcher_for(req["index"], shard_id)
-            if searcher is None:
-                shard_results.append({"shard": shard_id,
-                                      "error": "shard not started here"})
+            try:
+                searcher = self._searcher_for(req["index"], shard_id)
+                if searcher is None:
+                    shard_results.append({
+                        "shard": shard_id,
+                        "error": "shard not started here",
+                        "type": "shard_not_found_exception"})
+                    continue
+                result = searcher.query_phase(
+                    query, k,
+                    post_filter=post_filter,
+                    min_score=body.get("min_score"),
+                    sort=body.get("sort"),
+                    search_after=body.get("search_after"),
+                    track_total_hits=bool(body.get("track_total_hits",
+                                                   True)))
+            except Exception as e:  # noqa: BLE001 — per-shard fault barrier
+                shard_results.append({"shard": shard_id, "error": str(e),
+                                      "type": error_type_of(e)})
                 continue
-            result = searcher.query_phase(
-                query, k,
-                post_filter=post_filter,
-                min_score=body.get("min_score"),
-                sort=body.get("sort"),
-                search_after=body.get("search_after"),
-                track_total_hits=bool(body.get("track_total_hits", True)))
             shard_results.append({
                 "shard": shard_id,
                 "total": result.total_hits,
                 "max_score": result.max_score,
+                # the stored _id travels with the address: segment names
+                # are engine-local (uuid-prefixed), so a fetch that fails
+                # over to ANOTHER copy resolves the doc by _id instead
                 "docs": [{"seg": searcher.segments[d.segment_idx].name,
                           "docid": d.docid, "score": d.score,
+                          "id": searcher.segments[d.segment_idx]
+                          .stored.ids[d.docid],
                           "sort_key": d.sort_key,
                           "sort_values": list(d.sort_values)}
                          for d in result.docs],
@@ -119,19 +271,35 @@ class DistributedSearchService:
             searcher = self._searcher_for(req["index"], shard_id)
             if searcher is None:
                 for wd in wire_docs:
-                    hits_out.append({"_lost": True, "_ord": wd["ord"]})
+                    hits_out.append({"_lost": True, "_ord": wd["ord"],
+                                     "_shard": shard_id})
                 continue
             seg_idx = {seg.name: i
                        for i, seg in enumerate(searcher.segments)}
             query = (parse_query(body["query"]) if body.get("query")
                      else None)
             for wd in wire_docs:
-                if wd["seg"] not in seg_idx:
-                    hits_out.append({"_lost": True, "_ord": wd["ord"]})
+                addr = None
+                if wd["seg"] in seg_idx:
+                    addr = DocAddress(segment_idx=seg_idx[wd["seg"]],
+                                      docid=wd["docid"],
+                                      score=wd["score"],
+                                      sort_values=tuple(wd["sort_values"]))
+                elif wd.get("id") is not None:
+                    # address from another copy (fetch failover) or a
+                    # since-merged segment: resolve by stored _id
+                    for si, seg in enumerate(searcher.segments):
+                        local = seg.docid_for(wd["id"])
+                        if local >= 0:
+                            addr = DocAddress(
+                                segment_idx=si, docid=local,
+                                score=wd["score"],
+                                sort_values=tuple(wd["sort_values"]))
+                            break
+                if addr is None:
+                    hits_out.append({"_lost": True, "_ord": wd["ord"],
+                                     "_shard": shard_id})
                     continue
-                addr = DocAddress(segment_idx=seg_idx[wd["seg"]],
-                                  docid=wd["docid"], score=wd["score"],
-                                  sort_values=tuple(wd["sort_values"]))
                 fetched = searcher.fetch_phase(
                     [addr],
                     source_filter=body.get("_source", True),
@@ -158,160 +326,446 @@ class DistributedSearchService:
                 "partial-reduce milestone; single-node search supports "
                 "them"))
             return
-        t_start = time.monotonic()
+        sched = self.scheduler
+        t_start = sched.now()
+        from elasticsearch_tpu.common.settings import parse_boolean
         try:
             indices = self._resolve(state, index_expression)
-        except IndexNotFoundException as e:
+            budget = self._time_budget(body)
+            allow_partial = parse_boolean(
+                body.get("allow_partial_search_results"),
+                parse_boolean(state.metadata.persistent_settings.get(
+                    ALLOW_PARTIAL_SETTING), True,
+                    key=ALLOW_PARTIAL_SETTING),
+                key="allow_partial_search_results")
+        except Exception as e:  # noqa: BLE001 — resolution/parse errors
             on_done(None, e)
             return
         size = int(body.get("size", DEFAULT_SIZE))
         from_ = int(body.get("from", 0))
         k = from_ + size
 
-        # group chosen shard copies by node → one RPC per (node, index)
-        # (ref: per-node grouping + throttling in AbstractSearchAsyncAction)
-        by_node: Dict[Tuple[str, str], List[ShardRouting]] = {}
-        n_shards = 0
+        groups: List[_ShardGroup] = []
         for index in indices:
-            for copy in self.routing.search_shards(state, index):
-                by_node.setdefault((copy.current_node_id, index),
-                                   []).append(copy)
-                n_shards += 1
-        if n_shards == 0:
-            on_done(self._empty_response(), None)
-            return
-
-        merged: List[Dict] = []   # wire docs + (index, shard)
-        totals = {"total": 0, "max_score": None, "failed": 0,
-                  "pending": len(by_node)}
-
-        def one_node_done():
-            totals["pending"] -= 1
-            if totals["pending"] == 0:
-                self._fetch_phase(state, body, merged, totals, from_, size,
-                                  n_shards, t_start, on_done)
-
-        for (node_id, index), copies in by_node.items():
-            node = state.nodes.get(node_id)
-            if node is None:
-                totals["failed"] += len(copies)
-                one_node_done()
-                continue
-            payload = {"index": index,
-                       "shards": [c.shard_id for c in copies],
-                       "k": max(k, 1), "body": body}
-
-            def ok(resp, _index=index, _node_id=node_id):
-                self.routing.collector.add_node_statistics(
-                    _node_id, resp.get("queue_size", 0),
-                    resp.get("service_time_ns", 0.0),
-                    resp.get("service_time_ns", 0.0))
-                for sr in resp["results"]:
-                    if "error" in sr:
-                        totals["failed"] += 1
-                        continue
-                    totals["total"] += sr["total"]
-                    ms = sr["max_score"]
-                    if ms is not None:
-                        totals["max_score"] = (
-                            ms if totals["max_score"] is None
-                            else max(ms, totals["max_score"]))
-                    for d in sr["docs"]:
-                        d2 = dict(d)
-                        d2["_index"] = _index
-                        d2["_shard"] = sr["shard"]
-                        d2["_node"] = _node_id
-                        merged.append(d2)
-                one_node_done()
-
-            def fail(exc, _n=len(copies)):
-                totals["failed"] += _n
-                one_node_done()
-
-            self.transport.send_request(node, QUERY_PHASE_ACTION, payload,
-                                        ResponseHandler(ok, fail),
-                                        timeout=30.0)
-
-    def _fetch_phase(self, state, body, merged, totals, from_, size,
-                     n_shards, t_start, on_done) -> None:
-        """Merge top-k then fetch winners where they live (ref:
-        SearchPhaseController.sortDocs + FetchSearchPhase)."""
-        merged.sort(key=lambda d: (-d["sort_key"], d["_index"],
-                                   d["_shard"], d["docid"]))
-        page = merged[from_:from_ + size]
-        for ord_, d in enumerate(page):
-            d["ord"] = ord_
-        if not page:
+            for it in self.routing.shard_iterators(state, index):
+                groups.append(_ShardGroup(index, it.shard_id.shard, it))
+        if not groups:
             resp = self._empty_response()
-            resp["took"] = int((time.monotonic() - t_start) * 1000)
-            resp["_shards"] = self._shards_section(n_shards, totals)
-            resp["hits"]["total"]["value"] = totals["total"]
-            resp["hits"]["max_score"] = totals["max_score"]
+            resp["took"] = int((sched.now() - t_start) * 1000)
             on_done(resp, None)
             return
-        # group winners by (node, index, shard)
+
+        ctx = {
+            "state": state, "body": body, "k": max(k, 1),
+            "from": from_, "size": size,
+            "merged": [],               # wire docs + (index, shard, node)
+            "total": 0, "max_score": None,
+            "pending": len(groups), "groups": groups,
+            "allow_partial": allow_partial,
+            "t_start": t_start,
+            "deadline": (t_start + budget) if budget else None,
+            "timed_out": False,
+            "query_done": False,
+            "lock": threading.RLock(),
+            "on_done": on_done,
+        }
+
+        # search-level time budget: at the deadline every unresolved
+        # group becomes a reported failure and the reduce-so-far returns
+        # with timed_out: true
+        if budget:
+            ctx["budget_cancel"] = sched.schedule(
+                budget, lambda: self._on_budget_expired(ctx),
+                "search timeout")
+
+        # group the first pick of every iterator by (node, index) → one
+        # RPC per node per index (ref: per-node request coalescing in
+        # AbstractSearchAsyncAction); failed copies retry individually
+        by_node: Dict[Tuple[str, str], List[_ShardGroup]] = {}
+        immediate_fail: List[Tuple[_ShardGroup, BaseException]] = []
+        for g in groups:
+            copy = g.iterator.next_or_none()
+            if copy is None:
+                immediate_fail.append((g, NoShardAvailableActionException(
+                    f"no active copies of [{g.index}][{g.shard}]")))
+                continue
+            g.current = copy
+            by_node.setdefault((copy.current_node_id, g.index),
+                               []).append(g)
+        for (node_id, index), batch in by_node.items():
+            self._send_query(ctx, node_id, index, batch)
+        for g, exc in immediate_fail:
+            self._shard_attempt_failed(ctx, g, None, exc)
+
+    # -- query phase internals -------------------------------------------
+
+    @staticmethod
+    def _time_budget(body: Dict[str, Any]) -> Optional[float]:
+        timeout = body.get("timeout")
+        if timeout is None:
+            return None
+        from elasticsearch_tpu.common.settings import parse_time_value
+        budget = parse_time_value(timeout, "timeout")
+        return budget if budget > 0 else None
+
+    def _send_query(self, ctx: Dict, node_id: str, index: str,
+                    batch: List[_ShardGroup]) -> None:
+        node = ctx["state"].nodes.get(node_id)
+        if node is None:
+            for g in batch:
+                self._shard_attempt_failed(
+                    ctx, g, node_id, NodeNotConnectedException(
+                        f"node [{node_id}] left the cluster"))
+            return
+        payload = {"index": index,
+                   "shards": [g.shard for g in batch],
+                   "k": ctx["k"], "body": ctx["body"]}
+        by_shard = {g.shard: g for g in batch}
+
+        def ok(resp, _node_id=node_id, _index=index, _by_shard=by_shard):
+            self.routing.collector.add_node_statistics(
+                _node_id, resp.get("queue_size", 0),
+                resp.get("service_time_ns", 0.0),
+                resp.get("service_time_ns", 0.0))
+            for sr in resp["results"]:
+                g = _by_shard.get(sr["shard"])
+                if g is None:
+                    continue
+                if "error" in sr:
+                    exc = RuntimeError(sr["error"])
+                    exc.remote_type = sr.get("type", "exception")
+                    self._shard_attempt_failed(ctx, g, _node_id, exc)
+                    continue
+                self._shard_succeeded(ctx, g, _node_id, _index, sr)
+
+        def fail(exc, _node_id=node_id, _batch=batch):
+            for g in _batch:
+                self._shard_attempt_failed(ctx, g, _node_id, exc)
+
+        self.transport.send_request(node, QUERY_PHASE_ACTION, payload,
+                                    ResponseHandler(ok, fail),
+                                    timeout=30.0)
+
+    def _shard_succeeded(self, ctx: Dict, g: _ShardGroup, node_id: str,
+                         index: str, sr: Dict) -> None:
+        with ctx["lock"]:
+            if g.resolved or ctx["query_done"]:
+                return  # late answer after budget expiry / failover
+            g.resolved = True
+            g.ok = True
+            ctx["total"] += sr["total"]
+            ms = sr["max_score"]
+            if ms is not None:
+                ctx["max_score"] = (ms if ctx["max_score"] is None
+                                    else max(ms, ctx["max_score"]))
+            for d in sr["docs"]:
+                d2 = dict(d)
+                d2["_index"] = index
+                d2["_shard"] = sr["shard"]
+                d2["_node"] = node_id
+                ctx["merged"].append(d2)
+        self._group_resolved(ctx)
+
+    def _shard_attempt_failed(self, ctx: Dict, g: _ShardGroup,
+                              node_id: Optional[str],
+                              exc: BaseException) -> None:
+        """One copy failed: record it, then either walk the iterator to
+        the next copy (with capped exponential backoff) or declare the
+        group failed (ref: AbstractSearchAsyncAction.onShardFailure)."""
+        retry_copy = None
+        with ctx["lock"]:
+            if g.resolved or ctx["query_done"]:
+                return
+            g.attempts += 1
+            g.failures.append(ShardSearchFailure.from_exception(
+                g.index, g.shard, node_id, exc, phase="query"))
+            deadline = ctx["deadline"]
+            out_of_time = (deadline is not None
+                           and self.scheduler.now() >= deadline)
+            if is_retryable_failure(exc) and not out_of_time:
+                retry_copy = g.iterator.next_or_none()
+            if retry_copy is None:
+                g.resolved = True
+                g.ok = False
+            else:
+                g.current = retry_copy
+        if retry_copy is None:
+            self._group_resolved(ctx)
+            return
+        backoff = min(RETRY_BACKOFF_BASE * (2 ** (g.attempts - 1)),
+                      RETRY_BACKOFF_CAP)
+        node_id2 = retry_copy.current_node_id
+
+        def retry():
+            # the budget may have expired (or a racing answer resolved
+            # the group) while the backoff was pending — don't waste a
+            # full query execution on a response nobody will read
+            with ctx["lock"]:
+                if g.resolved or ctx["query_done"]:
+                    return
+            self._send_query(ctx, node_id2, g.index, [g])
+
+        self.scheduler.schedule(
+            backoff, retry, f"retry {g.index}[{g.shard}] on {node_id2}")
+
+    def _on_budget_expired(self, ctx: Dict) -> None:
+        expired: List[_ShardGroup] = []
+        with ctx["lock"]:
+            if ctx["query_done"]:
+                return
+            for g in ctx["groups"]:
+                if not g.resolved:
+                    g.resolved = True
+                    g.ok = False
+                    g.failures.append(ShardSearchFailure(
+                        index=g.index, shard=g.shard,
+                        node=(g.current.current_node_id
+                              if g.current else None),
+                        type="receive_timeout_transport_exception",
+                        reason="search time budget exceeded",
+                        phase="query"))
+                    expired.append(g)
+            if expired:
+                ctx["timed_out"] = True
+        for _ in expired:
+            self._group_resolved(ctx)
+
+    def _group_resolved(self, ctx: Dict) -> None:
+        with ctx["lock"]:
+            ctx["pending"] -= 1
+            if ctx["pending"] > 0 or ctx["query_done"]:
+                return
+            ctx["query_done"] = True
+            groups: List[_ShardGroup] = ctx["groups"]
+            failed = [g for g in groups if not g.ok]
+            failures = [f for g in failed for f in g.failures[-1:]]
+            ctx["query_failures"] = failures
+        # all-shards-failed always raises — EXCEPT when the search-level
+        # time budget expired, which returns what has been reduced so far
+        # with timed_out: true (the caller asked for a bounded wait, not
+        # an error); allow_partial=false converts either into an error
+        if failed and not ctx["allow_partial"]:
+            self._complete(ctx, None, SearchPhaseExecutionException(
+                "query",
+                f"{len(failed)} of {len(groups)} shards failed and "
+                "[allow_partial_search_results] is false", failures))
+            return
+        if failed and len(failed) == len(groups) and not ctx["timed_out"]:
+            self._complete(ctx, None, SearchPhaseExecutionException(
+                "query", "all shards failed", failures))
+            return
+        self._fetch_phase(ctx)
+
+    def _complete(self, ctx: Dict, resp: Optional[Dict],
+                  err: Optional[Exception]) -> None:
+        """Single exit: cancel the pending budget timer (it pins ctx —
+        merged docs + a cluster-state snapshot — until the deadline
+        otherwise) and hand the result to the caller."""
+        cancel = ctx.pop("budget_cancel", None)
+        if cancel is not None:
+            try:
+                cancel.cancel()
+            except Exception:  # noqa: BLE001 — cancellation is best-effort
+                pass
+        ctx["on_done"](resp, err)
+
+    # -- fetch phase ------------------------------------------------------
+
+    def _fetch_phase(self, ctx: Dict) -> None:
+        """Merge top-k then fetch winners where they live (ref:
+        SearchPhaseController.sortDocs + FetchSearchPhase). A failed
+        fetch retries once on the shard's other copies before the hits
+        are dropped as a counted failure."""
+        merged = ctx["merged"]
+        state = ctx["state"]
+        body = ctx["body"]
+        merged.sort(key=lambda d: (-d["sort_key"], d["_index"],
+                                   d["_shard"], d["docid"]))
+        page = merged[ctx["from"]:ctx["from"] + ctx["size"]]
+        for ord_, d in enumerate(page):
+            d["ord"] = ord_
+        fctx = {
+            "page": page,
+            "hits": [None] * len(page),
+            "pending": 0,
+            "fetch_failures": [],     # ShardSearchFailure, phase="fetch"
+            "retried": set(),         # (index, shard) already retried
+            "lock": ctx["lock"],
+        }
+        if not page:
+            self._finish(ctx, fctx)
+            return
+        # group winners by (node, index) → {shard: wire docs}
         by_node: Dict[Tuple[str, str], Dict[int, List[Dict]]] = {}
         for d in page:
             by_node.setdefault((d["_node"], d["_index"]), {}).setdefault(
                 d["_shard"], []).append(
-                {"seg": d["seg"], "docid": d["docid"],
+                {"seg": d["seg"], "docid": d["docid"], "id": d.get("id"),
                  "score": d["score"], "sort_values": d["sort_values"],
                  "ord": d["ord"]})
-        hits: List[Optional[Dict]] = [None] * len(page)
-        pending = {"n": len(by_node)}
-
-        def node_fetched():
-            pending["n"] -= 1
-            if pending["n"] > 0:
-                return
-            final_hits = []
-            for ord_, d in enumerate(page):
-                h = hits[ord_]
-                if h is None or h.get("_lost"):
-                    continue
-                h.pop("_ord", None)
-                h["_index"] = d["_index"]
-                if d["sort_values"]:
-                    h["sort"] = d["sort_values"]
-                final_hits.append(h)
-            track_total = body.get("track_total_hits", True)
-            total = totals["total"]
-            relation = "eq"
-            if isinstance(track_total, int) and \
-                    not isinstance(track_total, bool) and \
-                    total > track_total:
-                total, relation = track_total, "gte"
-            resp = {
-                "took": int((time.monotonic() - t_start) * 1000),
-                "timed_out": False,
-                "_shards": self._shards_section(n_shards, totals),
-                "hits": {"total": {"value": total, "relation": relation},
-                         "max_score": totals["max_score"],
-                         "hits": final_hits},
-            }
-            on_done(resp, None)
-
+        fctx["pending"] = len(by_node)
         for (node_id, index), docs_by_shard in by_node.items():
-            node = state.nodes.get(node_id)
-            if node is None:
-                node_fetched()
-                continue
-            payload = {"index": index,
-                       "docs": {str(sid): docs
-                                for sid, docs in docs_by_shard.items()},
-                       "body": body}
+            self._send_fetch(ctx, fctx, node_id, index, docs_by_shard)
 
-            def ok(resp):
+    def _send_fetch(self, ctx: Dict, fctx: Dict, node_id: str, index: str,
+                    docs_by_shard: Dict[int, List[Dict]]) -> None:
+        state = ctx["state"]
+        node = state.nodes.get(node_id)
+        if node is None:
+            self._fetch_failed(ctx, fctx, node_id, index, docs_by_shard,
+                               NodeNotConnectedException(
+                                   f"node [{node_id}] left the cluster"))
+            return
+        payload = {"index": index,
+                   "docs": {str(sid): docs
+                            for sid, docs in docs_by_shard.items()},
+                   "body": body_for_fetch(ctx["body"])}
+
+        def ok(resp, _node_id=node_id, _index=index,
+               _docs_by_shard=docs_by_shard):
+            lost_by_shard: Dict[int, List[Dict]] = {}
+            wire_by_ord = {wd["ord"]: wd
+                           for docs in _docs_by_shard.values()
+                           for wd in docs}
+            with fctx["lock"]:
                 for h in resp["hits"]:
-                    hits[h["_ord"]] = h
-                node_fetched()
+                    if h.get("_lost"):
+                        sid = h.get("_shard")
+                        wd = wire_by_ord.get(h.get("_ord"))
+                        if sid is not None and wd is not None:
+                            lost_by_shard.setdefault(sid, []).append(wd)
+                        continue
+                    fctx["hits"][h["_ord"]] = h
+            if lost_by_shard:
+                # the fetch node no longer serves these docs: retry JUST
+                # the lost docs on the shards' other copies
+                self._fetch_failed(
+                    ctx, fctx, _node_id, _index, lost_by_shard,
+                    RuntimeError("docs lost at fetch"), node_done=False)
+            self._fetch_node_done(ctx, fctx)
 
-            def fail(exc):
-                node_fetched()
+        def fail(exc, _node_id=node_id, _index=index,
+                 _docs_by_shard=docs_by_shard):
+            self._fetch_failed(ctx, fctx, _node_id, _index,
+                               _docs_by_shard, exc)
 
-            self.transport.send_request(node, FETCH_PHASE_ACTION, payload,
-                                        ResponseHandler(ok, fail),
-                                        timeout=30.0)
+        # the remaining search budget bounds the fetch phase too: a
+        # stalled fetch node must not hold the response far past the
+        # deadline. A 1s floor lets winners already reduced fetch their
+        # sources even when the query phase consumed the whole budget.
+        timeout = 30.0
+        deadline = ctx["deadline"]
+        if deadline is not None:
+            timeout = max(1.0, min(timeout,
+                                   deadline - self.scheduler.now()))
+        self.transport.send_request(node, FETCH_PHASE_ACTION, payload,
+                                    ResponseHandler(ok, fail),
+                                    timeout=timeout)
+
+    def _fetch_failed(self, ctx: Dict, fctx: Dict, node_id: str,
+                      index: str, docs_by_shard: Dict[int, List[Dict]],
+                      exc: BaseException, node_done: bool = True) -> None:
+        """Per shard: retry once on another active copy; otherwise record
+        a counted fetch failure (the hits stay dropped but reported)."""
+        state = ctx["state"]
+        deadline = ctx["deadline"]
+        out_of_time = (deadline is not None
+                       and self.scheduler.now() >= deadline)
+        retries: List[Tuple[str, int, Dict[int, List[Dict]]]] = []
+        with fctx["lock"]:
+            for sid, docs in docs_by_shard.items():
+                key = (index, sid)
+                alt = None
+                if key not in fctx["retried"] and not out_of_time:
+                    fctx["retried"].add(key)
+                    alt = self._other_copy_node(state, index, sid, node_id)
+                if alt is None:
+                    fctx["fetch_failures"].append(
+                        ShardSearchFailure.from_exception(
+                            index, sid, node_id, exc, phase="fetch"))
+                else:
+                    retries.append((alt, sid, {sid: docs}))
+            if node_done:
+                fctx["pending"] += len(retries)
+        for alt, _sid, docs in retries:
+            if not node_done:
+                with fctx["lock"]:
+                    fctx["pending"] += 1
+            self._send_fetch(ctx, fctx, alt, index, docs)
+        if node_done:
+            self._fetch_node_done(ctx, fctx)
+
+    @staticmethod
+    def _other_copy_node(state: ClusterState, index: str, shard: int,
+                         exclude_node: str) -> Optional[str]:
+        irt = state.routing_table.index(index)
+        table = irt.shard(shard) if irt else None
+        if table is None:
+            return None
+        for copy in table.active_shards():
+            if copy.current_node_id and \
+                    copy.current_node_id != exclude_node and \
+                    state.nodes.get(copy.current_node_id) is not None:
+                return copy.current_node_id
+        return None
+
+    def _fetch_node_done(self, ctx: Dict, fctx: Dict) -> None:
+        with fctx["lock"]:
+            fctx["pending"] -= 1
+            if fctx["pending"] > 0:
+                return
+        self._finish(ctx, fctx)
+
+    def _finish(self, ctx: Dict, fctx: Dict) -> None:
+        body = ctx["body"]
+        page = fctx["page"]
+        hits_arr = fctx["hits"]
+        fetch_failures: List[ShardSearchFailure] = fctx["fetch_failures"]
+        query_failures: List[ShardSearchFailure] = ctx.get(
+            "query_failures", [])
+        deadline = ctx["deadline"]
+        if deadline is not None and fetch_failures and \
+                self.scheduler.now() >= deadline:
+            # the budget ran out during the fetch phase: the dropped
+            # hits are timeout casualties, report them as such
+            ctx["timed_out"] = True
+        if fetch_failures and not ctx["allow_partial"]:
+            self._complete(ctx, None, SearchPhaseExecutionException(
+                "fetch",
+                f"{len(fetch_failures)} shards failed during the fetch "
+                "phase and [allow_partial_search_results] is false",
+                query_failures + fetch_failures))
+            return
+        final_hits = []
+        for ord_, d in enumerate(page):
+            h = hits_arr[ord_]
+            if h is None or h.get("_lost"):
+                continue
+            h.pop("_ord", None)
+            h.pop("_shard", None)
+            h["_index"] = d["_index"]
+            if d["sort_values"]:
+                h["sort"] = d["sort_values"]
+            final_hits.append(h)
+        track_total = body.get("track_total_hits", True)
+        total = ctx["total"]
+        relation = "eq"
+        if isinstance(track_total, int) and \
+                not isinstance(track_total, bool) and \
+                total > track_total:
+            total, relation = track_total, "gte"
+        n_shards = len(ctx["groups"])
+        failures = query_failures + fetch_failures
+        resp = {
+            "took": int((self.scheduler.now() - ctx["t_start"]) * 1000),
+            "timed_out": ctx["timed_out"],
+            "_shards": self._shards_section(n_shards, len(failures),
+                                            failures),
+            "hits": {"total": {"value": total, "relation": relation},
+                     "max_score": ctx["max_score"],
+                     "hits": final_hits},
+        }
+        self._complete(ctx, resp, None)
 
     # ------------------------------------------------------------- helpers
 
@@ -332,10 +786,19 @@ class DistributedSearchService:
         return out
 
     @staticmethod
-    def _shards_section(n_shards: int, totals: Dict) -> Dict:
-        return {"total": n_shards,
-                "successful": n_shards - totals["failed"],
-                "skipped": 0, "failed": totals["failed"]}
+    def _shards_section(n_shards: int, n_failed: int,
+                        failures: Optional[List[ShardSearchFailure]] = None,
+                        skipped: int = 0) -> Dict:
+        """The ES `_shards` response contract: successful never exceeds
+        total (and never goes negative), `skipped` is always present,
+        and terminal failures serialize under `failures`."""
+        n_failed = max(0, min(n_shards, n_failed))
+        section = {"total": n_shards,
+                   "successful": n_shards - n_failed,
+                   "skipped": skipped, "failed": n_failed}
+        if failures:
+            section["failures"] = [f.to_dict() for f in failures]
+        return section
 
     @staticmethod
     def _empty_response() -> Dict:
@@ -344,3 +807,12 @@ class DistributedSearchService:
                             "failed": 0},
                 "hits": {"total": {"value": 0, "relation": "eq"},
                          "max_score": None, "hits": []}}
+
+
+def body_for_fetch(body: Dict[str, Any]) -> Dict[str, Any]:
+    """The fetch-phase slice of the request body (source filtering,
+    docvalue fields, highlighting — ref: ShardFetchSearchRequest carries
+    only fetch-relevant sections)."""
+    return {k: v for k, v in (body or {}).items()
+            if k in ("_source", "docvalue_fields", "highlight", "query",
+                     "stored_fields", "fields")}

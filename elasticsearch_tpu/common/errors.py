@@ -9,6 +9,16 @@ responses the way RestController does.
 from __future__ import annotations
 
 
+def snake_case(name: str) -> str:
+    """CamelCase -> snake_case (idempotent on already-snake input)."""
+    out = []
+    for i, ch in enumerate(name):
+        if ch.isupper() and i > 0:
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out)
+
+
 class ElasticsearchTpuException(Exception):
     """Base exception; carries an HTTP status code for the REST layer."""
 
@@ -34,12 +44,7 @@ class ElasticsearchTpuException(Exception):
         name = cls.__name__
         if name.endswith("Exception"):
             name = name[: -len("Exception")]
-        out = []
-        for i, ch in enumerate(name):
-            if ch.isupper() and i > 0:
-                out.append("_")
-            out.append(ch.lower())
-        return "".join(out) + "_exception"
+        return snake_case(name) + "_exception"
 
 
 class IndexNotFoundException(ElasticsearchTpuException):
@@ -162,5 +167,52 @@ class NodeNotConnectedException(ElasticsearchTpuException):
     status = 500
 
 
+class NoShardAvailableActionException(ElasticsearchTpuException):
+    """No active copy of a shard could serve the request (ref:
+    action/NoShardAvailableActionException)."""
+
+    status = 503
+
+
 class ScriptException(ElasticsearchTpuException):
     status = 400
+
+
+# failure types that are the CLIENT's fault: when every shard failed
+# with one of these, the search as a whole is a 400, not a 503 (ref:
+# SearchPhaseExecutionException.status() deriving from the causes)
+_CLIENT_ERROR_TYPES = {
+    "parsing_exception", "illegal_argument_exception",
+    "query_shard_exception", "mapper_parsing_exception",
+    "script_exception", "search_context_missing_exception",
+}
+
+
+class SearchPhaseExecutionException(ElasticsearchTpuException):
+    """A search phase could not complete within the partial-results
+    contract (ref: action/search/SearchPhaseExecutionException): raised
+    when every shard failed, or when any shard failed and the request
+    disallowed partial results. Carries the per-shard failures so the
+    REST layer serializes them like `_shards.failures`."""
+
+    status = 503
+
+    def __init__(self, phase_name: str, message: str, shard_failures=None):
+        failures = [f.to_dict() if hasattr(f, "to_dict") else f
+                    for f in (shard_failures or [])]
+        super().__init__(message, phase=phase_name, grouped=True,
+                         failed_shards=failures)
+        self.phase_name = phase_name
+        self.shard_failures = failures
+        types = {(f.get("reason") or {}).get("type") for f in failures}
+        if failures and types <= _CLIENT_ERROR_TYPES:
+            self.status = 400
+
+
+def error_type_of(exc: BaseException) -> str:
+    """The wire `type` string for any exception: ElasticsearchTpu
+    exceptions use their registered snake_case type; foreign exceptions
+    get their class name snake_cased (matching the REST fallback)."""
+    if isinstance(exc, ElasticsearchTpuException):
+        return exc.error_type()
+    return snake_case(type(exc).__name__)

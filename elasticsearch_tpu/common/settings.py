@@ -50,6 +50,27 @@ def parse_time_value(value: Any, key: str = "") -> float:
     return float(m.group(1)) * _TIME_FACTORS[m.group(2)]
 
 
+def parse_boolean(value: Any, default: bool = False,
+                  key: str = "") -> bool:
+    """Boolean for setting/body values: real booleans pass through; the
+    strings 'true'/'false' (the form cluster settings are stored and
+    echoed as) parse by content; anything else is rejected — a typo like
+    'flase' must never silently read as truthy."""
+    if value is None:
+        return default
+    if isinstance(value, bool):
+        return value
+    if isinstance(value, str):
+        v = value.strip().lower()
+        if v == "true":
+            return True
+        if v == "false":
+            return False
+    raise SettingsException(
+        f"Failed to parse value [{value}]{f' for [{key}]' if key else ''}"
+        " as only [true] or [false] are allowed.")
+
+
 def parse_byte_size(value: Any, key: str = "") -> int:
     """'512mb' / '1gb' / '100b' -> bytes (int). -1 passes through."""
     if isinstance(value, (int, float)):

@@ -223,6 +223,7 @@ class Node:
             logger.exception("initial remote-cluster settings invalid")
         # persistent cluster-settings overlay (the _cluster/settings API)
         self.persistent_settings = {}
+        self.search_service.cluster_settings = lambda: self.persistent_settings
         from elasticsearch_tpu.xpack.ccr import CcrService
         self.ccr_service = CcrService(self)
         # processors that join against live services (enrich) resolve

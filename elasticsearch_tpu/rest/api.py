@@ -1230,14 +1230,21 @@ def _merge_search_params(body, params):
     for key in ("from", "size"):
         if key in params:
             body[key] = int(params[key])
-    if "request_cache" in params:
-        v = params["request_cache"]
-        if v not in ("true", "false"):
-            raise IllegalArgumentException(
-                f"Failed to parse value [{v}] as only [true] or [false] "
-                "are allowed.")
-        body["request_cache"] = v == "true"
+    for key in ("request_cache", "allow_partial_search_results"):
+        if key in params:
+            body[key] = _bool_param(params, key)
+    if "timeout" in params:
+        body["timeout"] = params["timeout"]
     return body
+
+
+def _bool_param(params, key: str) -> bool:
+    v = params[key]
+    if v not in ("true", "false"):
+        raise IllegalArgumentException(
+            f"Failed to parse value [{v}] as only [true] or [false] "
+            "are allowed.")
+    return v == "true"
 
 
 def count_index(node, params, body, index):

@@ -22,6 +22,9 @@ from typing import Any, Dict, List, Optional, Tuple
 from elasticsearch_tpu.common.errors import (
     IllegalArgumentException,
     SearchContextMissingException,
+    SearchPhaseExecutionException,
+    TaskCancelledException,
+    error_type_of,
 )
 import jax.numpy as jnp
 import numpy as np
@@ -155,6 +158,11 @@ class SearchService:
 
     def __init__(self, indices_service: IndicesService):
         self.indices_service = indices_service
+        # cluster-settings provider (Node wires this to its persistent
+        # settings overlay): seeds the allow_partial_search_results
+        # default like the distributed coordinator does (ref:
+        # SearchService.DEFAULT_ALLOW_PARTIAL_SEARCH_RESULTS)
+        self.cluster_settings = lambda: {}
         self._scrolls: Dict[str, ScrollContext] = {}
         self._pits: Dict[str, PitContext] = {}
         self._lock = threading.Lock()
@@ -750,10 +758,20 @@ class SearchService:
         # AbstractSearchAsyncAction.run / SearchPhaseController merge)
         shard_results: List[Tuple[str, ShardSearcher, QueryResult]] = []
         profile_shards: List[Dict[str, Any]] = []
+        # per-shard failure capture (ref: the per-shard halves of
+        # AbstractSearchAsyncAction.onShardFailure collapsed in-process):
+        # a failing shard becomes a typed `_shards.failures` entry instead
+        # of sinking the whole request — unless every shard failed, or the
+        # request set allow_partial_search_results=false
+        shard_failures: List[Dict[str, Any]] = []
+        first_failure: Optional[BaseException] = None
+        index_shard_ord: Dict[str, int] = {}   # per-INDEX shard numbering
         total = 0
         max_score = None
         for shard_idx, (index_name, searcher) in enumerate(
                 [] if mesh_docs is not None else searchers):
+            shard_ord = index_shard_ord.get(index_name, 0)
+            index_shard_ord[index_name] = shard_ord + 1
             searcher.batcher = self.plan_batcher
             if task is not None:
                 # cooperative cancellation between shard executions (ref:
@@ -802,6 +820,18 @@ class SearchService:
                 if rescore_spec:
                     result.docs[:] = searcher.rescore(result.docs,
                                                       rescore_spec)
+            except TaskCancelledException:
+                raise
+            except Exception as e:  # noqa: BLE001 — per-shard fault barrier
+                if first_failure is None:
+                    first_failure = e
+                shard_failures.append({
+                    "shard": shard_ord, "index": index_name, "node": None,
+                    "reason": {"type": error_type_of(e),
+                               "reason": str(e), "phase": "query"}})
+                # an empty stand-in keeps shard_results aligned with the
+                # searcher list (scroll cursors key on this index)
+                result = QueryResult([], 0, None)
             finally:
                 if prof_cm is not None:
                     prof_cm.__exit__(None, None, None)
@@ -849,6 +879,27 @@ class SearchService:
             if result.max_score is not None:
                 max_score = (result.max_score if max_score is None
                              else max(max_score, result.max_score))
+
+        if shard_failures:
+            if len(shard_failures) == len(shard_results) \
+                    and first_failure is not None:
+                # all shards failed: surface the root cause unchanged
+                # (ref: SearchPhaseExecutionException wraps, but the REST
+                # status comes from the cause)
+                raise first_failure
+            from elasticsearch_tpu.common.settings import parse_boolean
+            allow_partial = parse_boolean(
+                body.get("allow_partial_search_results"),
+                parse_boolean(self.cluster_settings().get(
+                    "search.default_allow_partial_results"), True,
+                    key="search.default_allow_partial_results"),
+                key="allow_partial_search_results")
+            if not allow_partial:
+                raise SearchPhaseExecutionException(
+                    "query",
+                    f"{len(shard_failures)} of {len(shard_results)} "
+                    "shards failed and [allow_partial_search_results] "
+                    "is false", shard_failures)
 
         # ---- merge (score desc / sort key, then shard order, then docid)
         merged: List[Tuple[float, int, DocAddress, str, ShardSearcher]] = []
@@ -1012,10 +1063,15 @@ class SearchService:
             if terminated_early:
                 total = clamped
                 relation = "gte"
+        n_failed = min(len(shard_failures), len(searchers))
+        shards_section = {"total": len(searchers),
+                          "successful": len(searchers) - n_failed,
+                          "skipped": 0, "failed": n_failed}
+        if shard_failures:
+            shards_section["failures"] = shard_failures
         response = {
             "timed_out": False,
-            "_shards": {"total": len(searchers), "successful": len(searchers),
-                        "skipped": 0, "failed": 0},
+            "_shards": shards_section,
             "hits": {
                 "total": {"value": total, "relation": relation},
                 "max_score": max_score,

@@ -359,7 +359,14 @@ class SimNetwork:
                     if self.link_state(dest, src) != CONNECTED:
                         return
                     if is_error:
-                        complete_err(SimRemoteException(str(payload)))
+                        exc = SimRemoteException(str(payload))
+                        # mirror BaseTransport._dispatch_response: the
+                        # remote exception class travels with the error
+                        # so failover can classify retryability
+                        if isinstance(payload, dict):
+                            exc.remote_type = payload.get(
+                                "type", "exception")
+                        complete_err(exc)
                     else:
                         complete_ok(payload)
                 self.queue.schedule(self._delay(), response_leg,
@@ -372,7 +379,7 @@ class SimNetwork:
 
 
 class SimRemoteException(Exception):
-    pass
+    remote_type = "exception"
 
 
 # ------------------------------------------------- linearizability checker

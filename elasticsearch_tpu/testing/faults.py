@@ -1,0 +1,203 @@
+"""Seeded fault injection for the transport layer.
+
+`FaultInjectingTransport` wraps any transport exposing the
+TransportService surface (`send_request`, `register_request_handler`,
+`local_node`, ...) and applies per-(action, node) fault rules to
+OUTBOUND requests: error them, drop them fast, black-hole them (vanish
+until the caller's timeout), or delay them. All randomness — rule
+probability draws and delay jitter — comes from ONE seeded RNG shared
+through a `FaultInjector`, and delays/timeouts are scheduled on the
+provided `Scheduler`, so composing with `DeterministicTaskQueue` makes
+every chaos run replayable from its seed (ref: the reference's
+DisruptableMockTransport + RandomizedRunner seed discipline).
+
+Usage (deterministic harness):
+
+    queue = DeterministicTaskQueue(seed=7)
+    injector = FaultInjector(seed=7, scheduler=queue)
+    transport = FaultInjectingTransport(
+        DisruptableTransport(node, network), injector)
+    injector.add_rule(FaultRule(action="phase/query", node="dn-1",
+                                mode=ERROR))
+
+The injector keeps a log of every injected fault, so tests can assert
+that chaos actually happened and echo the seed for replay.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# fault modes
+ERROR = "error"            # immediate remote-style failure
+DISCONNECT = "disconnect"  # fast connection-refused failure
+BLACKHOLE = "blackhole"    # request vanishes; only the timeout answers
+DELAY = "delay"            # request delivered late (seeded jitter)
+
+MODES = (ERROR, DISCONNECT, BLACKHOLE, DELAY)
+
+
+class InjectedFaultError(ConnectionError):
+    """Default error raised by ERROR-mode rules (a ConnectionError
+    subclass, so failover classifies it retryable)."""
+
+    def __init__(self, action: str, node: str, seed_note: str = ""):
+        super().__init__(
+            f"[faults] injected failure for [{action}] -> [{node}]"
+            + (f" ({seed_note})" if seed_note else ""))
+
+
+class FaultRule:
+    """One (action, node) fault rule. `action` is a substring match,
+    `node` an EXACT node-id match ('dn-1' must not also hit 'dn-10');
+    None matches everything. `probability` is drawn per send from the
+    injector's seeded RNG; `times` bounds how often the rule fires
+    (None = unlimited); DELAY mode draws a delay uniformly from `delay`
+    (a (min, max) pair or a constant)."""
+
+    def __init__(self, action: Optional[str] = None,
+                 node: Optional[str] = None, mode: str = ERROR,
+                 probability: float = 1.0,
+                 times: Optional[int] = None,
+                 delay: Any = 0.5,
+                 error_factory: Optional[Callable[[str, str],
+                                                  BaseException]] = None):
+        if mode not in MODES:
+            raise ValueError(f"unknown fault mode [{mode}]")
+        self.action = action
+        self.node = node
+        self.mode = mode
+        self.probability = probability
+        self.remaining = times
+        self.delay = delay if isinstance(delay, tuple) else (delay, delay)
+        self.error_factory = error_factory
+
+    def matches(self, action: str, node_id: str) -> bool:
+        if self.remaining is not None and self.remaining <= 0:
+            return False
+        if self.action is not None and self.action not in action:
+            return False
+        if self.node is not None and self.node != node_id:
+            return False
+        return True
+
+
+class FaultInjector:
+    """Shared seeded decision-maker: every wrapped transport asks it
+    whether (and how) to disturb a send. One RNG + one scheduler per
+    cluster keeps the whole chaos schedule a pure function of the
+    seed (given the DeterministicTaskQueue's execution order)."""
+
+    def __init__(self, seed: int = 0, scheduler=None):
+        self.seed = seed
+        self.random = random.Random(seed)
+        self.scheduler = scheduler
+        self.rules: List[FaultRule] = []
+        self.injected: List[Tuple[str, str, str]] = []  # (action, node, mode)
+        self.sends: Dict[str, int] = {}                 # action -> count
+
+    def add_rule(self, rule: FaultRule) -> FaultRule:
+        self.rules.append(rule)
+        return rule
+
+    def clear_rules(self) -> None:
+        self.rules.clear()
+
+    def record_send(self, action: str) -> None:
+        self.sends[action] = self.sends.get(action, 0) + 1
+
+    def send_count(self, action_substr: str) -> int:
+        return sum(n for a, n in self.sends.items() if action_substr in a)
+
+    def injected_count(self, action_substr: str = "",
+                       node: str = "") -> int:
+        return sum(1 for a, n, _m in self.injected
+                   if action_substr in a and (not node or n == node))
+
+    def decide(self, action: str, node_id: str) -> Optional[FaultRule]:
+        for rule in self.rules:
+            if not rule.matches(action, node_id):
+                continue
+            if rule.probability < 1.0 and \
+                    self.random.random() >= rule.probability:
+                continue
+            if rule.remaining is not None:
+                rule.remaining -= 1
+            self.injected.append((action, node_id, rule.mode))
+            return rule
+        return None
+
+    def draw_delay(self, rule: FaultRule) -> float:
+        lo, hi = rule.delay
+        return lo if lo >= hi else self.random.uniform(lo, hi)
+
+
+class FaultInjectingTransport:
+    """Transport wrapper applying the injector's rules to outbound
+    `send_request` calls. Everything else delegates to the wrapped
+    transport, so it drops in anywhere a TransportService or
+    DisruptableTransport does (ClusterNode takes it unchanged)."""
+
+    def __init__(self, inner, injector: FaultInjector, scheduler=None):
+        self.inner = inner
+        self.injector = injector
+        self.scheduler = scheduler or injector.scheduler
+        if self.scheduler is None:
+            raise ValueError(
+                "FaultInjectingTransport needs a scheduler (pass one "
+                "here or on the FaultInjector)")
+
+    # -- delegated surface -----------------------------------------------
+
+    @property
+    def local_node(self):
+        return self.inner.local_node
+
+    def register_request_handler(self, action: str, handler: Callable,
+                                 executor: str = "generic") -> None:
+        self.inner.register_request_handler(action, handler, executor)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    # -- the fault seam ---------------------------------------------------
+
+    def send_request(self, node, action: str, request: Any, handler,
+                     timeout: Optional[float] = None) -> None:
+        inj = self.injector
+        inj.record_send(action)
+        rule = inj.decide(action, node.node_id)
+        if rule is None:
+            self.inner.send_request(node, action, request, handler,
+                                    timeout=timeout)
+            return
+        sched = self.scheduler
+        if rule.mode == ERROR:
+            exc = (rule.error_factory(action, node.node_id)
+                   if rule.error_factory else
+                   InjectedFaultError(action, node.node_id,
+                                      f"seed={inj.seed}"))
+            sched.schedule(0.0, lambda: handler.on_failure(exc),
+                           f"fault-error {action}->{node.name}")
+        elif rule.mode == DISCONNECT:
+            sched.schedule(
+                0.0, lambda: handler.on_failure(ConnectionError(
+                    f"[faults] [{node.name}] disconnected "
+                    f"(seed={inj.seed})")),
+                f"fault-disconnect {action}->{node.name}")
+        elif rule.mode == BLACKHOLE:
+            # vanishes; the caller's timeout is the only way out
+            if timeout is not None:
+                sched.schedule(
+                    timeout, lambda: handler.on_failure(TimeoutError(
+                        f"[faults] [{node.name}][{action}] black-holed "
+                        f"(seed={inj.seed})")),
+                    f"fault-blackhole {action}->{node.name}")
+        elif rule.mode == DELAY:
+            delay = inj.draw_delay(rule)
+            sched.schedule(
+                delay,
+                lambda: self.inner.send_request(node, action, request,
+                                                handler, timeout=timeout),
+                f"fault-delay {action}->{node.name}")

@@ -152,7 +152,14 @@ class TransportChannel:
         if self._done:
             return
         self._done = True
-        self._send({"type": type(exc).__name__, "reason": str(exc)}, True)
+        # a proxied failure keeps its ROOT remote type: a handler that
+        # rethrows a RemoteTransportException must not mask the original
+        # exception class — failover uses it to distinguish retryable
+        # (connect/timeout) from non-retryable (parse/illegal-argument)
+        # failures
+        remote_type = getattr(exc, "remote_type", None) \
+            or type(exc).__name__
+        self._send({"type": remote_type, "reason": str(exc)}, True)
 
 
 @dataclass

@@ -28,6 +28,49 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--chaos-seed", action="store", default=None, type=int,
+        help="override the fault-injection seed for @pytest.mark.chaos "
+             "tests (replay a red chaos run from its logged seed)")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "chaos(seed=N): seeded fault-injection test; the active seed is "
+        "echoed on failure so any red run replays with --chaos-seed=N")
+
+
+@pytest.fixture
+def chaos_seed(request):
+    """The fault-injection seed for this test: --chaos-seed wins,
+    otherwise the @pytest.mark.chaos(seed=...) default. The chosen seed
+    is stashed on the test item so a failure report echoes it."""
+    override = request.config.getoption("--chaos-seed")
+    marker = request.node.get_closest_marker("chaos")
+    seed = override if override is not None else (
+        marker.kwargs.get("seed", 0) if marker else 0)
+    request.node._chaos_seed_used = seed
+    return seed
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when == "call" and rep.failed and \
+            item.get_closest_marker("chaos") is not None:
+        seed = getattr(item, "_chaos_seed_used", "?")
+        rep.sections.append(
+            ("chaos fault injection",
+             f"seeded chaos run failed; replay deterministically with: "
+             f"pytest {item.nodeid} --chaos-seed={seed}"))
+        if hasattr(rep.longrepr, "addsection"):
+            rep.longrepr.addsection(
+                "chaos seed", f"replay with --chaos-seed={seed}")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -1,0 +1,383 @@
+"""Replica failover + partial-results protocol under seeded fault
+injection: the coordinator (cluster/search_action.py) driven through
+DeterministicTaskQueue + FaultInjectingTransport, so every chaos
+schedule is a pure function of its seed (ref strategy: the reference's
+SearchWithRandomExceptionsIT / SearchWhileRelocatingIT crossed with
+DisruptableMockTransport determinism).
+
+Every test is @pytest.mark.chaos(seed=N); a red run echoes its seed and
+replays with `pytest <nodeid> --chaos-seed=N`.
+"""
+
+import pytest
+
+from elasticsearch_tpu.cluster.node import ClusterNode
+from elasticsearch_tpu.cluster.search_action import (
+    FETCH_PHASE_ACTION,
+    QUERY_PHASE_ACTION,
+)
+from elasticsearch_tpu.common.errors import SearchPhaseExecutionException
+from elasticsearch_tpu.testing.deterministic import (
+    DeterministicTaskQueue,
+    DisruptableTransport,
+    SimNetwork,
+)
+from elasticsearch_tpu.testing.faults import (
+    BLACKHOLE,
+    DELAY,
+    ERROR,
+    FaultInjectingTransport,
+    FaultInjector,
+    FaultRule,
+)
+from elasticsearch_tpu.transport.transport import DiscoveryNode
+
+
+class ChaosCluster:
+    """SimDataCluster + a shared FaultInjector wrapping every node's
+    transport: faults on (action, node) pairs, replayable from seed."""
+
+    def __init__(self, n_nodes, tmp_path, seed=0):
+        self.seed = seed
+        self.queue = DeterministicTaskQueue(seed=seed)
+        self.network = SimNetwork(self.queue)
+        self.injector = FaultInjector(seed=seed, scheduler=self.queue)
+        self.nodes = [DiscoveryNode(node_id=f"dn-{i}", name=f"dn{i}")
+                      for i in range(n_nodes)]
+        self.cluster_nodes = {}
+        for node in self.nodes:
+            transport = FaultInjectingTransport(
+                DisruptableTransport(node, self.network), self.injector)
+            cn = ClusterNode(
+                transport, self.queue,
+                data_path=str(tmp_path / node.name),
+                seed_nodes=self.nodes,
+                initial_master_nodes=[n.name for n in self.nodes],
+                rng=self.queue.random)
+            self.cluster_nodes[node.node_id] = cn
+        for cn in self.cluster_nodes.values():
+            cn.start()
+
+    def run_for(self, seconds):
+        self.queue.run_for(seconds)
+
+    def master(self) -> ClusterNode:
+        masters = [c for c in self.cluster_nodes.values() if c.is_master()]
+        assert len(masters) == 1, \
+            f"seed={self.seed}: masters {[m.local_node.name for m in masters]}"
+        return masters[0]
+
+    def stabilise(self, seconds=60):
+        self.run_for(seconds)
+        return self.master()
+
+    def call(self, fn, *args, timeout=60, **kwargs):
+        box = {}
+
+        def on_done(result, err=None):
+            box["result"] = result
+            box["err"] = err
+
+        fn(*args, **kwargs, on_done=on_done)
+        waited = 0.0
+        while "result" not in box and "err" not in box and waited < timeout:
+            self.run_for(1.0)
+            waited += 1.0
+        assert "result" in box or "err" in box, \
+            f"seed={self.seed}: call never completed"
+        if box.get("err") is not None:
+            raise box["err"] if isinstance(box["err"], BaseException) \
+                else RuntimeError(box["err"])
+        return box["result"]
+
+    def coordinator_excluding(self, *node_ids) -> ClusterNode:
+        return next(c for c in self.cluster_nodes.values()
+                    if c.local_node.node_id not in node_ids)
+
+    def primary_node_id(self, index, shard=0) -> str:
+        table = self.master().state.routing_table.index(index).shard(shard)
+        return table.primary.current_node_id
+
+    def shard_node_ids(self, index, shard) -> set:
+        table = self.master().state.routing_table.index(index).shard(shard)
+        return {s.current_node_id for s in table.active_shards()}
+
+
+def _setup(cluster, index="logs", shards=2, replicas=1, n=20):
+    master = cluster.stabilise()
+    cluster.call(master.create_index, index,
+                 number_of_shards=shards, number_of_replicas=replicas)
+    cluster.run_for(60)
+    items = [{"op": "index", "id": f"doc-{i}",
+              "source": {"body": f"quick brown fox number {i}", "n": i}}
+             for i in range(n)]
+    resp = cluster.call(master.bulk, index, items)
+    assert resp["errors"] == [], f"seed={cluster.seed}: {resp}"
+    cluster.call(master.refresh)
+    cluster.run_for(5)
+    return master
+
+
+SORTED_BODY = {"query": {"match": {"body": "fox"}},
+               "sort": [{"n": "desc"}], "size": 5}
+
+
+def _hit_ids(resp):
+    return [h["_id"] for h in resp["hits"]["hits"]]
+
+
+@pytest.mark.chaos(seed=11)
+def test_replica_failover_recovers_killed_copy(tmp_path, chaos_seed):
+    """A single copy killed mid-fan-out: failover retries the next
+    replica — same top-k as the healthy run, _shards.failed == 0."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    _setup(cluster)
+    coord = cluster.coordinator_excluding("dn-0")
+    healthy = cluster.call(coord.search, "logs", SORTED_BODY)
+    assert healthy["_shards"]["failed"] == 0, f"seed={chaos_seed}"
+
+    cluster.injector.add_rule(FaultRule(
+        action=QUERY_PHASE_ACTION, node="dn-0", mode=ERROR))
+    chaotic = cluster.call(coord.search, "logs", SORTED_BODY)
+    assert _hit_ids(chaotic) == _hit_ids(healthy), \
+        f"seed={chaos_seed}: failover changed the top-k"
+    assert chaotic["_shards"]["failed"] == 0, f"seed={chaos_seed}: {chaotic}"
+    assert chaotic["hits"]["total"]["value"] == 20, f"seed={chaos_seed}"
+    # chaos actually fired iff the coordinator routed anything at dn-0;
+    # either way the response must be whole (asserted above)
+    sec = chaotic["_shards"]
+    assert sec["successful"] == sec["total"] and "skipped" in sec, \
+        f"seed={chaos_seed}: {sec}"
+
+
+@pytest.mark.chaos(seed=23)
+def test_flapping_replica_retries_until_healthy(tmp_path, chaos_seed):
+    """A replica that fails its first two query RPCs (then heals) never
+    surfaces to the caller: every search is whole."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    _setup(cluster)
+    coord = cluster.coordinator_excluding("dn-1")
+    cluster.injector.add_rule(FaultRule(
+        action=QUERY_PHASE_ACTION, node="dn-1", mode=ERROR, times=2))
+    for _ in range(3):
+        resp = cluster.call(coord.search, "logs", SORTED_BODY)
+        assert resp["_shards"]["failed"] == 0, f"seed={chaos_seed}: {resp}"
+        assert resp["hits"]["total"]["value"] == 20, f"seed={chaos_seed}"
+
+
+@pytest.mark.chaos(seed=31)
+def test_all_copies_down_partial_allowed(tmp_path, chaos_seed):
+    """All copies of one shard down + allow_partial=true: the response
+    carries the other shards' hits and lists the dead shard in
+    _shards.failures."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    master = _setup(cluster, index="b", shards=2, replicas=1, n=12)
+    cluster.call(master.create_index, "a",
+                 number_of_shards=1, number_of_replicas=0)
+    cluster.run_for(30)
+    resp = cluster.call(master.bulk, "a",
+                        [{"op": "index", "id": f"a-{i}",
+                          "source": {"body": "lonely fox", "n": i}}
+                         for i in range(3)])
+    assert resp["errors"] == [], f"seed={chaos_seed}"
+    cluster.call(master.refresh)
+    cluster.run_for(5)
+
+    a_node = cluster.primary_node_id("a", 0)
+    coord = cluster.coordinator_excluding(a_node)
+    cluster.injector.add_rule(FaultRule(
+        action=QUERY_PHASE_ACTION, node=a_node, mode=ERROR))
+
+    resp = cluster.call(
+        coord.search, "a,b",
+        {"query": {"match": {"body": "fox"}}, "sort": [{"n": "desc"}],
+         "size": 20, "allow_partial_search_results": True})
+    sec = resp["_shards"]
+    assert sec["total"] == 3 and sec["failed"] == 1, \
+        f"seed={chaos_seed}: {sec}"
+    assert sec["successful"] == 2 and sec["successful"] <= sec["total"], \
+        f"seed={chaos_seed}: {sec}"
+    failures = sec["failures"]
+    assert len(failures) == 1 and failures[0]["index"] == "a", \
+        f"seed={chaos_seed}: {failures}"
+    assert failures[0]["reason"]["type"], f"seed={chaos_seed}: {failures}"
+    # b fully recovered through its replicas
+    assert resp["hits"]["total"]["value"] == 12, f"seed={chaos_seed}: {resp}"
+    assert all(h["_index"] == "b" for h in resp["hits"]["hits"]), \
+        f"seed={chaos_seed}"
+    assert cluster.injector.injected_count(QUERY_PHASE_ACTION, a_node) >= 1
+
+
+@pytest.mark.chaos(seed=31)
+def test_all_copies_down_partial_disallowed_raises(tmp_path, chaos_seed):
+    """Same scenario with allow_partial_search_results=false: the search
+    raises SearchPhaseExecutionException naming the dead shard."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    master = _setup(cluster, index="b", shards=2, replicas=1, n=12)
+    cluster.call(master.create_index, "a",
+                 number_of_shards=1, number_of_replicas=0)
+    cluster.run_for(30)
+    cluster.call(master.bulk, "a",
+                 [{"op": "index", "id": "a-0",
+                   "source": {"body": "lonely fox", "n": 0}}])
+    cluster.call(master.refresh)
+    cluster.run_for(5)
+
+    a_node = cluster.primary_node_id("a", 0)
+    coord = cluster.coordinator_excluding(a_node)
+    cluster.injector.add_rule(FaultRule(
+        action=QUERY_PHASE_ACTION, node=a_node, mode=ERROR))
+
+    with pytest.raises(SearchPhaseExecutionException) as ei:
+        cluster.call(coord.search, "a,b",
+                     {"query": {"match": {"body": "fox"}},
+                      "allow_partial_search_results": False})
+    assert any(f["index"] == "a" for f in ei.value.shard_failures), \
+        f"seed={chaos_seed}: {ei.value.shard_failures}"
+
+
+@pytest.mark.chaos(seed=47)
+def test_slow_shard_hits_time_budget_partial(tmp_path, chaos_seed):
+    """One slow node + a search time budget: the fast shard's hits come
+    back with timed_out=true and the slow shard reported failed."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    master = _setup(cluster, index="two", shards=2, replicas=0, n=20)
+    n0 = cluster.primary_node_id("two", 0)
+    n1 = cluster.primary_node_id("two", 1)
+    assert n0 != n1, f"seed={chaos_seed}: both shards on one node"
+    cluster.injector.add_rule(FaultRule(
+        action=QUERY_PHASE_ACTION, node=n0, mode=DELAY, delay=(10.0, 10.0)))
+    resp = cluster.call(
+        master.search, "two",
+        {"query": {"match": {"body": "fox"}}, "sort": [{"n": "desc"}],
+         "size": 20, "timeout": "2s"})
+    assert resp["timed_out"] is True, f"seed={chaos_seed}: {resp}"
+    sec = resp["_shards"]
+    assert sec["failed"] == 1 and sec["successful"] == 1, \
+        f"seed={chaos_seed}: {sec}"
+    reasons = [f["reason"]["reason"] for f in sec["failures"]]
+    assert any("time budget" in r for r in reasons), \
+        f"seed={chaos_seed}: {reasons}"
+    # reduced-so-far: the fast shard's docs are present, none lost
+    assert 0 < len(resp["hits"]["hits"]) < 20, f"seed={chaos_seed}: {resp}"
+
+
+@pytest.mark.chaos(seed=53)
+def test_blackholed_cluster_times_out_with_empty_reduce(tmp_path,
+                                                        chaos_seed):
+    """Every query RPC black-holed + a budget: returns an EMPTY reduce
+    with timed_out=true and all shards failed — not an exception, and
+    never a hang."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    master = _setup(cluster)
+    cluster.injector.add_rule(FaultRule(
+        action=QUERY_PHASE_ACTION, mode=BLACKHOLE))
+    resp = cluster.call(master.search, "logs",
+                        {"query": {"match_all": {}}, "timeout": "2s"},
+                        timeout=40)
+    assert resp["timed_out"] is True, f"seed={chaos_seed}: {resp}"
+    sec = resp["_shards"]
+    assert sec["failed"] == sec["total"] and sec["successful"] == 0, \
+        f"seed={chaos_seed}: {sec}"
+    assert resp["hits"]["hits"] == [], f"seed={chaos_seed}"
+
+
+@pytest.mark.chaos(seed=61)
+def test_fetch_failure_retries_other_copy(tmp_path, chaos_seed):
+    """A fetch-phase RPC failure retries the shard's other copy: the
+    hits survive and nothing is reported failed."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    _setup(cluster)
+    coord = cluster.coordinator_excluding("dn-2")
+    healthy = cluster.call(coord.search, "logs", SORTED_BODY)
+    cluster.injector.add_rule(FaultRule(
+        action=FETCH_PHASE_ACTION, node="dn-2", mode=ERROR))
+    chaotic = cluster.call(coord.search, "logs", SORTED_BODY)
+    assert _hit_ids(chaotic) == _hit_ids(healthy), \
+        f"seed={chaos_seed}: fetch failover changed hits"
+    assert chaotic["_shards"]["failed"] == 0, f"seed={chaos_seed}: {chaotic}"
+    assert all(h.get("_source") for h in chaotic["hits"]["hits"]), \
+        f"seed={chaos_seed}: fetch lost sources"
+
+
+@pytest.mark.chaos(seed=67)
+def test_fetch_failure_without_other_copy_is_counted(tmp_path, chaos_seed):
+    """With no replica to retry on, a failed fetch drops its hits but
+    MUST count and report the failure (regression: the seed coordinator
+    silently discarded them)."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    master = _setup(cluster, index="nofb", shards=2, replicas=0, n=20)
+    n0 = cluster.primary_node_id("nofb", 0)
+    coord = cluster.coordinator_excluding(n0)
+    cluster.injector.add_rule(FaultRule(
+        action=FETCH_PHASE_ACTION, node=n0, mode=ERROR))
+    resp = cluster.call(
+        coord.search, "nofb",
+        {"query": {"match": {"body": "fox"}}, "sort": [{"n": "desc"}],
+         "size": 20})
+    sec = resp["_shards"]
+    assert sec["failed"] >= 1, \
+        f"seed={chaos_seed}: fetch failure went uncounted: {sec}"
+    assert sec["successful"] + sec["failed"] == sec["total"], \
+        f"seed={chaos_seed}: {sec}"
+    fetch_failures = [f for f in sec["failures"]
+                      if f["reason"].get("phase") == "fetch"]
+    assert fetch_failures, f"seed={chaos_seed}: {sec['failures']}"
+    # the surviving shard's hits are intact
+    assert len(resp["hits"]["hits"]) > 0, f"seed={chaos_seed}"
+    assert cluster.injector.injected_count(FETCH_PHASE_ACTION, n0) >= 1
+
+
+@pytest.mark.chaos(seed=71)
+def test_all_shards_failed_raises_even_with_partial(tmp_path, chaos_seed):
+    """Every copy of every shard erroring: SearchPhaseExecutionException
+    even though allow_partial_search_results defaults to true."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    master = _setup(cluster)
+    cluster.injector.add_rule(FaultRule(
+        action=QUERY_PHASE_ACTION, mode=ERROR))
+    with pytest.raises(SearchPhaseExecutionException, match="all shards"):
+        cluster.call(master.search, "logs",
+                     {"query": {"match_all": {}}}, timeout=40)
+
+
+@pytest.mark.chaos(seed=83)
+def test_non_retryable_error_skips_failover(tmp_path, chaos_seed):
+    """A parse error is non-retryable: the coordinator must NOT walk the
+    replica list (the query would fail identically everywhere)."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    master = _setup(cluster)
+    expected_rpcs = len({
+        c.current_node_id
+        for c in master.routing.search_shards(master.state, "logs")})
+    before = cluster.injector.send_count(QUERY_PHASE_ACTION)
+    with pytest.raises(Exception):
+        cluster.call(master.search, "logs",
+                     {"query": {"no_such_query_type": {}}})
+    sent = cluster.injector.send_count(QUERY_PHASE_ACTION) - before
+    assert sent == expected_rpcs, \
+        (f"seed={chaos_seed}: non-retryable failure was retried "
+         f"({sent} RPCs for {expected_rpcs} initial fan-outs)")
+
+
+@pytest.mark.chaos(seed=97)
+def test_same_seed_same_chaos_same_response(tmp_path, chaos_seed):
+    """Replayability: two clusters with the same seed and a probabilistic
+    fault rule produce the identical fault schedule AND response."""
+    def run(path):
+        cluster = ChaosCluster(3, path, seed=chaos_seed)
+        coord = _setup(cluster, n=12)
+        cluster.injector.add_rule(FaultRule(
+            action=QUERY_PHASE_ACTION, mode=ERROR, probability=0.5))
+        try:
+            resp = cluster.call(coord.search, "logs", SORTED_BODY,
+                                timeout=40)
+            outcome = ("ok", _hit_ids(resp), resp["_shards"]["failed"])
+        except SearchPhaseExecutionException as e:
+            outcome = ("err", len(e.shard_failures))
+        return outcome, list(cluster.injector.injected)
+
+    out_a, log_a = run(tmp_path / "run_a")
+    out_b, log_b = run(tmp_path / "run_b")
+    assert out_a == out_b, f"seed={chaos_seed}: {out_a} != {out_b}"
+    assert log_a == log_b, f"seed={chaos_seed}: divergent fault schedule"
