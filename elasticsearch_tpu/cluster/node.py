@@ -70,11 +70,20 @@ class ClusterNode:
             resolved = resolve_seed_hosts(config_dir=data_path)
             seed_nodes = resolved or None
 
+        # node telemetry (metrics + tracer) on the scheduler's clock —
+        # virtual time under the deterministic harness, so metric
+        # timings and span ids replay identically from a seed
+        from elasticsearch_tpu.telemetry import Telemetry, wire_transport
+        self.telemetry = Telemetry(
+            node=self.local_node.name or self.local_node.node_id,
+            clock=scheduler.now)
+        wire_transport(transport, self.telemetry)
         self.allocation = AllocationService()
         self.routing = OperationRouting()
         self.data_node = DataNodeService(transport, scheduler, data_path)
         self.search_service = DistributedSearchService(
-            transport, self.data_node, self.routing, scheduler=scheduler)
+            transport, self.data_node, self.routing, scheduler=scheduler,
+            telemetry=self.telemetry)
         # secure-settings keystore (ref: node/Node.java:389-391 wiring of
         # ConsistentSettingsService): when present, the elected master
         # publishes salted hashes and joiners must match them

@@ -38,6 +38,22 @@ index are searched in a single handler call), so a host's shards merge
 locally before crossing the wire — the RPC topology matches the TPU
 layout where one host drives many device-resident shard partitions and
 ICI collectives pre-merge them (parallel/sharded.py).
+
+Observability (telemetry/): with a ``Telemetry`` bundle wired (one
+``is not None`` branch otherwise), the coordinator records
+
+- metrics — ``search.requests``/``search.latency``, per-phase
+  ``search.phase.{query,fetch,reduce}.latency``, ``search.retries``,
+  ``search.failovers`` (retry landed on a DIFFERENT copy),
+  ``search.backoff_seconds``, ``search.partial_results``; and
+- spans — a ``search`` root (joining the REST-boundary trace via the
+  ambient context), ``query``/``fetch``/``reduce`` phase children, one
+  span per shard-copy ATTEMPT tagged with the failover outcome (node,
+  attempt number, error type, retryable classification), and fetch
+  RPC spans. Trace context rides transport request headers
+  (``trace.id``/``span.id``) so data-node handler spans join the same
+  trace. Coordinator-side took time feeds the shared search slowlog
+  (search/slowlog.py) from the index settings in cluster state.
 """
 
 from __future__ import annotations
@@ -148,6 +164,8 @@ class _WallClock:
     @staticmethod
     def schedule(delay: float, fn: Callable[[], None],
                  description: str = ""):
+        from elasticsearch_tpu.telemetry import context as _telectx
+        fn = _telectx.bind(fn)   # carry profile/trace context to the timer
         if delay <= 0:
             fn()
             return None
@@ -161,7 +179,7 @@ class _ShardGroup:
     """Coordinator-side retry state for one shard group."""
 
     __slots__ = ("index", "shard", "iterator", "current", "attempts",
-                 "failures", "resolved", "ok")
+                 "failures", "resolved", "ok", "span")
 
     def __init__(self, index: str, shard: int, iterator: ShardIterator):
         self.index = index
@@ -172,6 +190,7 @@ class _ShardGroup:
         self.failures: List[ShardSearchFailure] = []
         self.resolved = False
         self.ok = False
+        self.span = None          # open span of the in-flight attempt
 
 
 class DistributedSearchService:
@@ -179,13 +198,19 @@ class DistributedSearchService:
 
     def __init__(self, transport, data_node,
                  routing: Optional[OperationRouting] = None,
-                 scheduler=None):
+                 scheduler=None, telemetry=None):
         self.transport = transport
         self.data_node = data_node
         self.routing = routing or OperationRouting()
         # retry backoff + the search time budget need a clock; under the
         # deterministic harness this is the shared DeterministicTaskQueue
         self.scheduler = scheduler or _WallClock()
+        # node telemetry bundle (metrics + tracer); None keeps every
+        # instrumented site a single branch
+        self.telemetry = telemetry
+        # coordinator-side slow log, same entry shape as the single-node
+        # service's (search/slowlog.py)
+        self.slowlog_recent: List[Dict[str, Any]] = []
         transport.register_request_handler(QUERY_PHASE_ACTION,
                                            self._on_query_phase)
         transport.register_request_handler(FETCH_PHASE_ACTION,
@@ -208,6 +233,27 @@ class DistributedSearchService:
         per-shard top-k (ref: QuerySearchResult). A failing shard yields
         an in-band typed error so its siblings on this node still
         answer — the coordinator retries only the failed shard."""
+        tele = self.telemetry
+        if tele is not None:
+            # joins the coordinator's trace via the ambient context the
+            # transport installed from the request headers; device/host
+            # stage timings fold into this node's histograms
+            from contextlib import ExitStack
+
+            from elasticsearch_tpu.search import profile as _prof
+            span = tele.tracer.start_span(
+                "shard_query",
+                tags={"index": req.get("index"),
+                      "shards": list(req.get("shards", []))})
+            with ExitStack() as stack:
+                stack.enter_context(_prof.stage_sink(tele.stage_sink()))
+                stack.callback(span.finish)
+                with tele.metrics.timer("search.shard.query.latency"):
+                    self._query_phase_inner(req, channel, src)
+            return
+        self._query_phase_inner(req, channel, src)
+
+    def _query_phase_inner(self, req, channel, src) -> None:
         t0 = time.monotonic()
         body = req.get("body") or {}
         query = (parse_query(body["query"]) if body.get("query")
@@ -264,6 +310,19 @@ class DistributedSearchService:
         """Fetch _source/fields for winning docs by (segment name, docid)
         — segment names are stable across refreshes (immutable segments),
         so the addresses survive the query→fetch gap."""
+        tele = self.telemetry
+        if tele is not None:
+            span = tele.tracer.start_span(
+                "shard_fetch", tags={"index": req.get("index")})
+            try:
+                with tele.metrics.timer("search.shard.fetch.latency"):
+                    self._fetch_phase_inner(req, channel, src)
+            finally:
+                span.finish()
+            return
+        self._fetch_phase_inner(req, channel, src)
+
+    def _fetch_phase_inner(self, req, channel, src) -> None:
         body = req.get("body") or {}
         hits_out = []
         for shard_id, wire_docs in req["docs"].items():
@@ -320,17 +379,61 @@ class DistributedSearchService:
                                  None]) -> None:
         """Async coordinator (ref: AbstractSearchAsyncAction.run)."""
         body = body or {}
+        sched = self.scheduler
+        t_start = sched.now()
+        tele = self.telemetry
+        root_span = None
+        if tele is not None:
+            tele.metrics.inc("search.requests")
+            # joins the REST-boundary trace through the ambient context
+            # when one is active, else roots a fresh trace
+            root_span = tele.tracer.start_span(
+                "search", tags={"index": index_expression})
+        indices: List[str] = []
+
+        def finish(resp, err, _cb=on_done):
+            """Single completion seam for every exit: close the root
+            span, record node metrics + the coordinator slow log, then
+            hand the result to the caller."""
+            if tele is not None:
+                tele.metrics.observe(
+                    "search.latency", (sched.now() - t_start) * 1000.0)
+                if err is not None:
+                    tele.metrics.inc("search.failed")
+                    root_span.finish(outcome="error",
+                                     error_type=failure_type_of(err))
+                else:
+                    failed = resp.get("_shards", {}).get("failed", 0)
+                    if failed or resp.get("timed_out"):
+                        tele.metrics.inc("search.partial_results")
+                    root_span.finish(
+                        outcome="ok", failed_shards=failed,
+                        timed_out=bool(resp.get("timed_out")))
+            if err is None and resp is not None and indices:
+                try:
+                    from elasticsearch_tpu.search.slowlog import (
+                        record_search_slowlog)
+                    record_search_slowlog(
+                        lambda n: getattr(state.metadata.index(n),
+                                          "settings", None),
+                        indices, resp.get("took", 0), body,
+                        self.slowlog_recent)
+                except Exception:  # noqa: BLE001 — a malformed slowlog
+                    # setting must never swallow a finished search
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "search slowlog check failed")
+            _cb(resp, err)
+
         if body.get("aggs") or body.get("aggregations"):
-            on_done(None, NotImplementedError(
+            finish(None, NotImplementedError(
                 "aggregations over the distributed path land with the "
                 "partial-reduce milestone; single-node search supports "
                 "them"))
             return
-        sched = self.scheduler
-        t_start = sched.now()
         from elasticsearch_tpu.common.settings import parse_boolean
         try:
-            indices = self._resolve(state, index_expression)
+            indices.extend(self._resolve(state, index_expression))
             budget = self._time_budget(body)
             allow_partial = parse_boolean(
                 body.get("allow_partial_search_results"),
@@ -338,11 +441,11 @@ class DistributedSearchService:
                     ALLOW_PARTIAL_SETTING), True,
                     key=ALLOW_PARTIAL_SETTING),
                 key="allow_partial_search_results")
+            size = int(body.get("size", DEFAULT_SIZE))
+            from_ = int(body.get("from", 0))
         except Exception as e:  # noqa: BLE001 — resolution/parse errors
-            on_done(None, e)
+            finish(None, e)
             return
-        size = int(body.get("size", DEFAULT_SIZE))
-        from_ = int(body.get("from", 0))
         k = from_ + size
 
         groups: List[_ShardGroup] = []
@@ -352,8 +455,12 @@ class DistributedSearchService:
         if not groups:
             resp = self._empty_response()
             resp["took"] = int((sched.now() - t_start) * 1000)
-            on_done(resp, None)
+            finish(resp, None)
             return
+
+        query_span = None
+        if tele is not None:
+            query_span = tele.tracer.start_span("query", parent=root_span)
 
         ctx = {
             "state": state, "body": body, "k": max(k, 1),
@@ -367,7 +474,9 @@ class DistributedSearchService:
             "timed_out": False,
             "query_done": False,
             "lock": threading.RLock(),
-            "on_done": on_done,
+            "on_done": finish,
+            "span": root_span,
+            "query_span": query_span,
         }
 
         # search-level time budget: at the deadline every unresolved
@@ -410,6 +519,21 @@ class DistributedSearchService:
 
     def _send_query(self, ctx: Dict, node_id: str, index: str,
                     batch: List[_ShardGroup]) -> None:
+        tele = self.telemetry
+        hdrs = None
+        if tele is not None:
+            # one span per shard-copy ATTEMPT: the failover trail of a
+            # shard group is its sequence of attempt spans
+            parent = ctx.get("query_span") or ctx.get("span")
+            for g in batch:
+                g.span = tele.tracer.start_span(
+                    f"shard[{g.index}][{g.shard}]", parent=parent,
+                    tags={"phase": "query", "node": node_id,
+                          "attempt": g.attempts + 1})
+            if parent is not None:
+                from elasticsearch_tpu.telemetry import (
+                    context as _telectx)
+                hdrs = _telectx.headers_of(parent)
         node = ctx["state"].nodes.get(node_id)
         if node is None:
             for g in batch:
@@ -444,13 +568,22 @@ class DistributedSearchService:
 
         self.transport.send_request(node, QUERY_PHASE_ACTION, payload,
                                     ResponseHandler(ok, fail),
-                                    timeout=30.0)
+                                    timeout=30.0, headers=hdrs)
 
     def _shard_succeeded(self, ctx: Dict, g: _ShardGroup, node_id: str,
                          index: str, sr: Dict) -> None:
         with ctx["lock"]:
             if g.resolved or ctx["query_done"]:
-                return  # late answer after budget expiry / failover
+                # late answer after budget expiry / failover; a span
+                # opened by a send that raced the expiry closes here —
+                # every RPC completion passes through this method or
+                # _shard_attempt_failed, so no attempt span outlives
+                # its response
+                span, g.span = g.span, None
+                if span is not None:
+                    span.finish(outcome="late")
+                return
+            span, g.span = g.span, None
             g.resolved = True
             g.ok = True
             ctx["total"] += sr["total"]
@@ -464,6 +597,8 @@ class DistributedSearchService:
                 d2["_shard"] = sr["shard"]
                 d2["_node"] = node_id
                 ctx["merged"].append(d2)
+        if span is not None:
+            span.finish(outcome="ok")
         self._group_resolved(ctx)
 
     def _shard_attempt_failed(self, ctx: Dict, g: _ShardGroup,
@@ -473,22 +608,35 @@ class DistributedSearchService:
         the next copy (with capped exponential backoff) or declare the
         group failed (ref: AbstractSearchAsyncAction.onShardFailure)."""
         retry_copy = None
+        retryable = is_retryable_failure(exc)
         with ctx["lock"]:
             if g.resolved or ctx["query_done"]:
+                # late failure for a group already resolved (budget
+                # expiry raced the send): close the orphaned span
+                span, g.span = g.span, None
+                if span is not None:
+                    span.finish(outcome="late")
                 return
+            span, g.span = g.span, None
             g.attempts += 1
             g.failures.append(ShardSearchFailure.from_exception(
                 g.index, g.shard, node_id, exc, phase="query"))
             deadline = ctx["deadline"]
             out_of_time = (deadline is not None
                            and self.scheduler.now() >= deadline)
-            if is_retryable_failure(exc) and not out_of_time:
+            if retryable and not out_of_time:
                 retry_copy = g.iterator.next_or_none()
             if retry_copy is None:
                 g.resolved = True
                 g.ok = False
             else:
                 g.current = retry_copy
+        if span is not None:
+            # the failover outcome, on the attempt that failed
+            span.finish(outcome="failed",
+                        error_type=failure_type_of(exc),
+                        retryable=retryable,
+                        will_retry=retry_copy is not None)
         if retry_copy is None:
             self._group_resolved(ctx)
             return
@@ -503,6 +651,15 @@ class DistributedSearchService:
             with ctx["lock"]:
                 if g.resolved or ctx["query_done"]:
                     return
+            # counted here, past the guard, so the metrics report
+            # retries that actually resent (not ones cut short by the
+            # budget during the backoff window)
+            tele = self.telemetry
+            if tele is not None:
+                tele.metrics.inc("search.retries")
+                if node_id is not None and node_id2 != node_id:
+                    tele.metrics.inc("search.failovers")
+                tele.metrics.inc("search.backoff_seconds", backoff)
             self._send_query(ctx, node_id2, g.index, [g])
 
         self.scheduler.schedule(
@@ -510,6 +667,7 @@ class DistributedSearchService:
 
     def _on_budget_expired(self, ctx: Dict) -> None:
         expired: List[_ShardGroup] = []
+        spans = []
         with ctx["lock"]:
             if ctx["query_done"]:
                 return
@@ -517,6 +675,9 @@ class DistributedSearchService:
                 if not g.resolved:
                     g.resolved = True
                     g.ok = False
+                    if g.span is not None:
+                        spans.append(g.span)
+                        g.span = None
                     g.failures.append(ShardSearchFailure(
                         index=g.index, shard=g.shard,
                         node=(g.current.current_node_id
@@ -527,6 +688,11 @@ class DistributedSearchService:
                     expired.append(g)
             if expired:
                 ctx["timed_out"] = True
+        for span in spans:
+            span.finish(outcome="timeout", retryable=False,
+                        will_retry=False)
+        if expired and self.telemetry is not None:
+            self.telemetry.metrics.inc("search.timed_out")
         for _ in expired:
             self._group_resolved(ctx)
 
@@ -540,6 +706,13 @@ class DistributedSearchService:
             failed = [g for g in groups if not g.ok]
             failures = [f for g in failed for f in g.failures[-1:]]
             ctx["query_failures"] = failures
+        qspan = ctx.pop("query_span", None)
+        if qspan is not None:
+            qspan.finish(failed_shards=len(failed))
+        if self.telemetry is not None:
+            self.telemetry.metrics.observe(
+                "search.phase.query.latency",
+                (self.scheduler.now() - ctx["t_start"]) * 1000.0)
         # all-shards-failed always raises — EXCEPT when the search-level
         # time budget expired, which returns what has been reduced so far
         # with timed_out: true (the caller asked for a bounded wait, not
@@ -579,11 +752,29 @@ class DistributedSearchService:
         merged = ctx["merged"]
         state = ctx["state"]
         body = ctx["body"]
+        tele = self.telemetry
+        reduce_span = None
+        if tele is not None:
+            reduce_span = tele.tracer.start_span(
+                "reduce", parent=ctx.get("span"),
+                tags={"docs": len(merged)})
+        t_reduce = self.scheduler.now()
         merged.sort(key=lambda d: (-d["sort_key"], d["_index"],
                                    d["_shard"], d["docid"]))
         page = merged[ctx["from"]:ctx["from"] + ctx["size"]]
         for ord_, d in enumerate(page):
             d["ord"] = ord_
+        if reduce_span is not None:
+            reduce_span.finish()
+            tele.metrics.observe(
+                "search.phase.reduce.latency",
+                (self.scheduler.now() - t_reduce) * 1000.0)
+        if tele is not None:
+            # the fetch window opens AFTER the reduce, so phase
+            # latencies (and spans) stay disjoint
+            ctx["fetch_start"] = self.scheduler.now()
+            ctx["fetch_span"] = tele.tracer.start_span(
+                "fetch", parent=ctx.get("span"))
         fctx = {
             "page": page,
             "hits": [None] * len(page),
@@ -616,13 +807,26 @@ class DistributedSearchService:
                                NodeNotConnectedException(
                                    f"node [{node_id}] left the cluster"))
             return
+        tele = self.telemetry
+        span = None
+        hdrs = None
+        if tele is not None:
+            span = tele.tracer.start_span(
+                f"fetch[{index}]",
+                parent=ctx.get("fetch_span") or ctx.get("span"),
+                tags={"phase": "fetch", "node": node_id,
+                      "shards": sorted(docs_by_shard)})
+            from elasticsearch_tpu.telemetry import context as _telectx
+            hdrs = _telectx.headers_of(span)
         payload = {"index": index,
                    "docs": {str(sid): docs
                             for sid, docs in docs_by_shard.items()},
                    "body": body_for_fetch(ctx["body"])}
 
         def ok(resp, _node_id=node_id, _index=index,
-               _docs_by_shard=docs_by_shard):
+               _docs_by_shard=docs_by_shard, _span=span):
+            if _span is not None:
+                _span.finish(outcome="ok")
             lost_by_shard: Dict[int, List[Dict]] = {}
             wire_by_ord = {wd["ord"]: wd
                            for docs in _docs_by_shard.values()
@@ -645,7 +849,10 @@ class DistributedSearchService:
             self._fetch_node_done(ctx, fctx)
 
         def fail(exc, _node_id=node_id, _index=index,
-                 _docs_by_shard=docs_by_shard):
+                 _docs_by_shard=docs_by_shard, _span=span):
+            if _span is not None:
+                _span.finish(outcome="failed",
+                             error_type=failure_type_of(exc))
             self._fetch_failed(ctx, fctx, _node_id, _index,
                                _docs_by_shard, exc)
 
@@ -660,7 +867,7 @@ class DistributedSearchService:
                                    deadline - self.scheduler.now()))
         self.transport.send_request(node, FETCH_PHASE_ACTION, payload,
                                     ResponseHandler(ok, fail),
-                                    timeout=timeout)
+                                    timeout=timeout, headers=hdrs)
 
     def _fetch_failed(self, ctx: Dict, fctx: Dict, node_id: str,
                       index: str, docs_by_shard: Dict[int, List[Dict]],
@@ -717,6 +924,14 @@ class DistributedSearchService:
         self._finish(ctx, fctx)
 
     def _finish(self, ctx: Dict, fctx: Dict) -> None:
+        fetch_span = ctx.pop("fetch_span", None)
+        if fetch_span is not None:
+            fetch_span.finish(
+                fetch_failures=len(fctx["fetch_failures"]))
+        if self.telemetry is not None and "fetch_start" in ctx:
+            self.telemetry.metrics.observe(
+                "search.phase.fetch.latency",
+                (self.scheduler.now() - ctx["fetch_start"]) * 1000.0)
         body = ctx["body"]
         page = fctx["page"]
         hits_arr = fctx["hits"]
@@ -727,7 +942,10 @@ class DistributedSearchService:
         if deadline is not None and fetch_failures and \
                 self.scheduler.now() >= deadline:
             # the budget ran out during the fetch phase: the dropped
-            # hits are timeout casualties, report them as such
+            # hits are timeout casualties, report them as such (counted
+            # here only when the query phase didn't already count it)
+            if not ctx["timed_out"] and self.telemetry is not None:
+                self.telemetry.metrics.inc("search.timed_out")
             ctx["timed_out"] = True
         if fetch_failures and not ctx["allow_partial"]:
             self._complete(ctx, None, SearchPhaseExecutionException(

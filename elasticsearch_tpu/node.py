@@ -58,8 +58,13 @@ class Node:
         # ThreadPool.java:117-181, wired ahead of every service)
         from elasticsearch_tpu.common.threadpool import ThreadPool
         self.threadpool = ThreadPool()
+        # node telemetry: metrics registry + tracer (telemetry/), the
+        # `_nodes/stats` telemetry section and the /_traces surface
+        from elasticsearch_tpu.telemetry import Telemetry
+        self.telemetry = Telemetry(node=self.name)
         self.indices_service = IndicesService(self.data_path, settings)
         self.search_service = SearchService(self.indices_service)
+        self.search_service.telemetry = self.telemetry
         self.task_manager = TaskManager(self.node_id)
         # completed background-task responses (ref: the .tasks results
         # index); bounded — oldest entries evicted beyond 256

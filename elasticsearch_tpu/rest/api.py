@@ -158,6 +158,9 @@ def _register_all(c: RestController):
     c.register("GET", "/_cluster/pending_tasks", cluster_pending_tasks)
     c.register("GET", "/_cluster/stats", cluster_stats)
     c.register("GET", "/_nodes/stats", nodes_stats)
+    # recent-trace surface (telemetry/): span ring buffer + span trees
+    c.register("GET", "/_traces", get_traces)
+    c.register("GET", "/_traces/{trace_id}", get_trace)
     c.register("GET", "/_cat/indices", cat_indices)
     c.register("GET", "/_cat/health", cat_health)
     c.register("GET", "/_cat/count", cat_count)
@@ -597,8 +600,50 @@ def nodes_stats(node, params, body):
             # the signal adaptive replica selection consumes (ref:
             # ThreadPool stats / ResponseCollectorService)
             "thread_pool": node.threadpool.stats(),
+            # metrics registry + trace store (telemetry/): counters,
+            # gauges, latency histograms, recent slowlog entries
+            "telemetry": {
+                **node.telemetry.to_dict(),
+                "slowlog_recent":
+                    list(node.search_service.slowlog_recent)[-16:],
+            },
         }},
     }
+
+
+def get_traces(node, params, body):
+    """GET /_traces — newest-first summaries of the recent-trace ring."""
+    limit = int(params.get("size", 32))
+    return 200, {"traces": node.telemetry.tracer.recent_traces(limit)}
+
+
+def get_trace(node, params, body, trace_id):
+    """GET /_traces/{trace_id} — flat span list + nested span tree."""
+    t = node.telemetry.tracer.trace(trace_id)
+    if t is None:
+        raise ResourceNotFoundException(f"unknown trace [{trace_id}]")
+    return 200, t
+
+
+from contextlib import contextmanager
+
+
+@contextmanager
+def _rest_trace(node, name, **tags):
+    """Root a trace at the REST boundary: the span is ambient for the
+    handler body (service-level spans parent to it) and its trace id is
+    echoed back in the `trace.id` response header."""
+    tele = getattr(node, "telemetry", None)
+    if tele is None:
+        yield None
+        return
+    from elasticsearch_tpu.telemetry import context as _telectx
+    span = tele.tracer.start_span(name, tags=tags)
+    try:
+        with _telectx.activate_span(span):
+            yield span
+    finally:
+        span.finish()
 
 
 def indices_stats(node, params, body):
@@ -1171,9 +1216,10 @@ def search_index(node, params, body, index):
         return 200, _ccs_search(node, index, body)
     body = _apply_alias_filter(node, index, body)
     body = _apply_dls(node, index, body)
-    with node.task_manager.task_scope(
-            "transport", "indices:data/read/search",
-            description=f"indices[{index}]", cancellable=True) as task:
+    with _rest_trace(node, "rest.search", index=index) as trace_span, \
+            node.task_manager.task_scope(
+                "transport", "indices:data/read/search",
+                description=f"indices[{index}]", cancellable=True) as task:
         # through the action seam (ref: RestSearchAction →
         # client.execute(SearchAction.INSTANCE, ...))
         from elasticsearch_tpu.action import SEARCH
@@ -1187,12 +1233,18 @@ def search_index(node, params, body, index):
             # frozen-tier searches serialize on the search_throttled
             # pool (ref: ThreadPool.Names.SEARCH_THROTTLED — one
             # thread) so rehydrating cold HBM state can't starve hot
-            # searches
+            # searches; bind() carries the ambient trace context across
+            # the executor boundary
+            from elasticsearch_tpu.telemetry import context as _telectx
             r = node.threadpool.executor("search_throttled") \
-                .submit(run).result(timeout=300)
+                .submit(_telectx.bind(run)).result(timeout=300)
         else:
             r = run()
-    return 200, _apply_fls(node, index, r)
+    r = _apply_fls(node, index, r)
+    if trace_span is not None:
+        # the reference echoes the APM trace id on search responses
+        r.setdefault("_headers", {})["trace.id"] = trace_span.trace_id
+    return 200, r
 
 
 def _targets_only_frozen(node, index_expression: str) -> bool:
@@ -1208,13 +1260,17 @@ def _targets_only_frozen(node, index_expression: str) -> bool:
 def search_all(node, params, body):
     body = _merge_search_params(body, params)
     body = _apply_dls(node, "_all", body)
-    with node.task_manager.task_scope(
-            "transport", "indices:data/read/search",
-            description="indices[_all]", cancellable=True) as task:
+    with _rest_trace(node, "rest.search", index="_all") as trace_span, \
+            node.task_manager.task_scope(
+                "transport", "indices:data/read/search",
+                description="indices[_all]", cancellable=True) as task:
         r = node.search_service.search(
             "_all", body, scroll=params.get("scroll"), task=task,
             search_type=params.get("search_type"))
-    return 200, _apply_fls(node, "_all", r)
+    r = _apply_fls(node, "_all", r)
+    if trace_span is not None:
+        r.setdefault("_headers", {})["trace.id"] = trace_span.trace_id
+    return 200, r
 
 
 def _merge_search_params(body, params):

@@ -19,6 +19,18 @@ and DEVICE time:
 A threadlocal recorder keeps instrumentation out of every call
 signature; it is active only under `profiling()`, so the serving hot
 path pays one `is-None` check per stage.
+
+Two consumers share the recorder seam:
+
+- ``profiling()`` (the per-request ``profile: true`` dict), and
+- ``stage_sink(fn)`` — a persistent sink the telemetry subsystem
+  installs so stage timings accumulate into node-level histograms
+  (``search.stage.launch`` etc.) on EVERY search, not only profiled
+  ones (telemetry/__init__.py ``Telemetry.stage_sink``).
+
+Both are temporal thread-local contexts; telemetry/context.py
+``bind()`` carries them (plus the trace context) across scheduler task
+boundaries so a multi-node search keeps its shard-side stages.
 """
 
 from __future__ import annotations
@@ -32,7 +44,8 @@ _tls = threading.local()
 
 
 def active() -> bool:
-    return getattr(_tls, "rec", None) is not None
+    return getattr(_tls, "rec", None) is not None \
+        or getattr(_tls, "sink", None) is not None
 
 
 @contextmanager
@@ -47,10 +60,25 @@ def profiling():
         _tls.rec = prev
 
 
+@contextmanager
+def stage_sink(fn):
+    """Install a stage sink ``fn(stage, nanos)`` for the duration;
+    stacks with (and is independent of) an active ``profiling()``."""
+    prev = getattr(_tls, "sink", None)
+    _tls.sink = fn
+    try:
+        yield
+    finally:
+        _tls.sink = prev
+
+
 def record(stage: str, nanos: int) -> None:
     rec = getattr(_tls, "rec", None)
     if rec is not None:
         rec[stage] = rec.get(stage, 0) + nanos
+    sink = getattr(_tls, "sink", None)
+    if sink is not None:
+        sink(stage, nanos)
 
 
 def note(key: str, value) -> None:
@@ -62,8 +90,7 @@ def note(key: str, value) -> None:
 
 @contextmanager
 def span(stage: str):
-    rec = getattr(_tls, "rec", None)
-    if rec is None:
+    if not active():
         yield
         return
     t0 = time.monotonic_ns()

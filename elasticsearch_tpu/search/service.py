@@ -12,7 +12,6 @@ manages scroll contexts with expiry.
 from __future__ import annotations
 
 import json
-import logging
 import threading
 import time
 import uuid
@@ -39,10 +38,6 @@ from elasticsearch_tpu.search.searcher import (
 )
 
 DEFAULT_SIZE = 10
-
-# per-index search slow log (ref: index/SearchSlowLog.java — threshold
-# settings per level; recent entries kept per service on `slowlog_recent`)
-_slowlog_logger = logging.getLogger("index.search.slowlog")
 
 
 def _knn_clauses(knn) -> List[Dict[str, Any]]:
@@ -158,6 +153,9 @@ class SearchService:
 
     def __init__(self, indices_service: IndicesService):
         self.indices_service = indices_service
+        # node telemetry bundle (metrics + tracer), wired by Node; None
+        # keeps every instrumented site a single branch
+        self.telemetry = None
         # cluster-settings provider (Node wires this to its persistent
         # settings overlay): seeds the allow_partial_search_results
         # default like the distributed coordinator does (ref:
@@ -220,6 +218,32 @@ class SearchService:
     def search(self, index_expression: str, body: Dict[str, Any],
                scroll: Optional[str] = None, task=None,
                search_type: Optional[str] = None) -> Dict[str, Any]:
+        tele = self.telemetry
+        if tele is None:
+            return self._search(index_expression, body, scroll, task,
+                                search_type)
+        # node search metrics cover EVERY outcome: cache hits (which
+        # skip _after_search), failures, and the success paths
+        tele.metrics.inc("search.requests")
+        t0 = tele.metrics.clock()
+        try:
+            response = self._search(index_expression, body, scroll,
+                                    task, search_type)
+        except Exception:
+            tele.metrics.inc("search.failed")
+            tele.metrics.observe("search.latency",
+                                 (tele.metrics.clock() - t0) * 1000.0)
+            raise
+        tele.metrics.observe("search.latency",
+                             (tele.metrics.clock() - t0) * 1000.0)
+        if response.get("timed_out") or \
+                response.get("_shards", {}).get("failed"):
+            tele.metrics.inc("search.partial_results")
+        return response
+
+    def _search(self, index_expression: str, body: Dict[str, Any],
+                scroll: Optional[str] = None, task=None,
+                search_type: Optional[str] = None) -> Dict[str, Any]:
         start = time.monotonic()
         pit_spec = (body or {}).get("pit")
         if pit_spec is not None:
@@ -572,8 +596,9 @@ class SearchService:
 
     def _after_search(self, names: List[str], took_ms: int,
                       body: Dict[str, Any]):
-        """Post-search hooks: frozen-index HBM eviction + slow log."""
-        from elasticsearch_tpu.common.settings import parse_time_value
+        """Post-search hooks: frozen-index HBM eviction + slow log
+        (search metrics live in the search() wrapper, which also sees
+        cache hits and failures)."""
         for name in names:
             if not self.indices_service.has(name):
                 continue
@@ -582,27 +607,11 @@ class SearchService:
                 # frozen: no device-resident state between searches (ref:
                 # FrozenEngine per-search readers → per-search HBM)
                 idx.device_cache.evict(idx._known_seg_names)
-            for level in ("warn", "info", "debug", "trace"):
-                thr = idx.settings.get(
-                    f"index.search.slowlog.threshold.query.{level}")
-                if thr is None:
-                    continue
-                thr_ms = parse_time_value(str(thr), "slowlog") * 1000
-                if thr_ms < 0:
-                    continue                # -1 disables the level
-                if took_ms >= thr_ms:
-                    entry = {"index": name, "took_ms": took_ms,
-                             "level": level,
-                             "source": json.dumps(body or {})[:1000]}
-                    _slowlog_logger.log(
-                        {"warn": 30, "info": 20,
-                         "debug": 10, "trace": 5}[level],
-                        "[%s] took[%dms], source[%s]",
-                        name, took_ms, entry["source"])
-                    self.slowlog_recent.append(entry)
-                    while len(self.slowlog_recent) > 128:
-                        self.slowlog_recent.pop(0)
-                    break
+        from elasticsearch_tpu.search.slowlog import record_search_slowlog
+        record_search_slowlog(
+            lambda n: (self.indices_service.get(n).settings
+                       if self.indices_service.has(n) else None),
+            names, took_ms, body, self.slowlog_recent)
 
     def scroll(self, scroll_id: str, scroll: Optional[str] = None) -> Dict[str, Any]:
         start = time.monotonic()
@@ -669,6 +678,23 @@ class SearchService:
     def _execute(self, searchers: List[Tuple[str, ShardSearcher]],
                  body: Dict[str, Any], scroll_ctx: Optional[ScrollContext] = None,
                  continuing: bool = False, task=None) -> Dict[str, Any]:
+        tele = self.telemetry
+        if tele is None:
+            return self._execute_inner(searchers, body, scroll_ctx,
+                                       continuing, task)
+        # device/host stage timings (launch, readback, topk, merge, ...)
+        # accumulate into node histograms on EVERY search — `profile:
+        # true` only adds the per-request breakdown on top
+        from elasticsearch_tpu.search import profile as _prof
+        with _prof.stage_sink(tele.stage_sink()):
+            return self._execute_inner(searchers, body, scroll_ctx,
+                                       continuing, task)
+
+    def _execute_inner(self, searchers: List[Tuple[str, ShardSearcher]],
+                       body: Dict[str, Any],
+                       scroll_ctx: Optional[ScrollContext] = None,
+                       continuing: bool = False, task=None
+                       ) -> Dict[str, Any]:
         body = body or {}
         from elasticsearch_tpu.search.percolate import resolve_percolate_refs
         query_spec = body.get("query")
@@ -779,6 +805,15 @@ class SearchService:
                 task.ensure_not_cancelled()
             after_key = (scroll_ctx.cursors.get(shard_idx)
                          if (scroll_ctx is not None and continuing) else None)
+            shard_span = None
+            if self.telemetry is not None:
+                from elasticsearch_tpu.telemetry import context as _telectx
+                if _telectx.current() is not None:
+                    # parented to the REST-boundary root span via the
+                    # ambient context (telemetry/context.py)
+                    shard_span = self.telemetry.tracer.start_span(
+                        f"shard[{index_name}][{shard_ord}]",
+                        tags={"phase": "query", "outcome": "ok"})
             t0 = time.monotonic_ns()
             prof_cm = None
             prof_rec = {}
@@ -825,6 +860,9 @@ class SearchService:
             except Exception as e:  # noqa: BLE001 — per-shard fault barrier
                 if first_failure is None:
                     first_failure = e
+                if shard_span is not None:
+                    shard_span.tag("outcome", "failed")
+                    shard_span.tag("error_type", error_type_of(e))
                 shard_failures.append({
                     "shard": shard_ord, "index": index_name, "node": None,
                     "reason": {"type": error_type_of(e),
@@ -835,6 +873,8 @@ class SearchService:
             finally:
                 if prof_cm is not None:
                     prof_cm.__exit__(None, None, None)
+                if shard_span is not None:
+                    shard_span.finish()
             if profile:
                 from elasticsearch_tpu.search import profile as _prof
                 total_ns = time.monotonic_ns() - t0
@@ -985,25 +1025,36 @@ class SearchService:
             shard_info[shard_idx] = (index_name, searcher)
         hits_by_pos: Dict[int, Dict[str, Any]] = {}
         fetch_ns: Dict[int, int] = {}
-        for shard_idx, entries in by_shard.items():
-            index_name, searcher = shard_info[shard_idx]
-            docs = [d for _, d in entries]
-            fetch_t0 = time.monotonic_ns()
-            fetched_list = searcher.fetch_phase(
-                docs, source_filter=source_filter,
-                docvalue_fields=docvalue_fields or None,
-                highlight=highlight, highlight_query=query,
-                script_fields=script_fields, fields=fields_spec,
-                version=bool(body.get("version")),
-                seq_no_primary_term=bool(body.get("seq_no_primary_term")))
-            fetch_ns[shard_idx] = time.monotonic_ns() - fetch_t0
-            for (pos, d), fetched in zip(entries, fetched_list):
-                fetched["_index"] = index_name
-                if collapse_field:
-                    key = _doc_field_value(searcher, d, collapse_field)
-                    fetched.setdefault("fields", {})[collapse_field] = (
-                        key if isinstance(key, list) else [key])
-                hits_by_pos[pos] = fetched
+        fetch_span = None
+        if self.telemetry is not None and by_shard:
+            from elasticsearch_tpu.telemetry import context as _telectx
+            if _telectx.current() is not None:
+                fetch_span = self.telemetry.tracer.start_span(
+                    "fetch", tags={"shards": len(by_shard)})
+        try:
+            for shard_idx, entries in by_shard.items():
+                index_name, searcher = shard_info[shard_idx]
+                docs = [d for _, d in entries]
+                fetch_t0 = time.monotonic_ns()
+                fetched_list = searcher.fetch_phase(
+                    docs, source_filter=source_filter,
+                    docvalue_fields=docvalue_fields or None,
+                    highlight=highlight, highlight_query=query,
+                    script_fields=script_fields, fields=fields_spec,
+                    version=bool(body.get("version")),
+                    seq_no_primary_term=bool(
+                        body.get("seq_no_primary_term")))
+                fetch_ns[shard_idx] = time.monotonic_ns() - fetch_t0
+                for (pos, d), fetched in zip(entries, fetched_list):
+                    fetched["_index"] = index_name
+                    if collapse_field:
+                        key = _doc_field_value(searcher, d, collapse_field)
+                        fetched.setdefault("fields", {})[collapse_field] = (
+                            key if isinstance(key, list) else [key])
+                    hits_by_pos[pos] = fetched
+        finally:
+            if fetch_span is not None:
+                fetch_span.finish()
         hits = [hits_by_pos[i] for i in sorted(hits_by_pos)]
         # query-computed hit decorations (percolator document slots) — the
         # percolate query may be nested inside compounds

@@ -1,0 +1,67 @@
+"""Search slow log: one threshold check shared by the single-node
+service and the distributed coordinator.
+
+Ref: index/SearchSlowLog.java — per-index, per-level thresholds under
+``index.search.slowlog.threshold.query.{warn,info,debug,trace}``; -1
+disables a level. The reference logs on the shard; this engine applies
+the same thresholds to whichever side measured the took time — the
+in-process `SearchService` (search/service.py) and the coordinator
+(`cluster/search_action.py`), both of which keep a bounded
+``slowlog_recent`` list of entries in ONE shared shape::
+
+    {"index": name, "took_ms": int, "level": "warn", "source": "..."}
+
+so `_nodes/stats`-style surfaces and tests read either side the same
+way.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Callable, Dict, List, Optional
+
+_slowlog_logger = logging.getLogger("index.search.slowlog")
+
+LEVELS = ("warn", "info", "debug", "trace")
+_LEVEL_NUM = {"warn": 30, "info": 20, "debug": 10, "trace": 5}
+
+MAX_RECENT = 128
+
+
+def record_search_slowlog(
+        settings_of: Callable[[str], Optional[Any]],
+        index_names: List[str], took_ms: float, body: Dict[str, Any],
+        recent: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Check every searched index's thresholds against the search took
+    time; append matches (highest matching level per index) to
+    ``recent`` and return the new entries. ``settings_of(name)`` yields
+    a ``.get``-able settings view or None for an unknown index."""
+    from elasticsearch_tpu.common.settings import parse_time_value
+    new_entries: List[Dict[str, Any]] = []
+    for name in index_names:
+        settings = settings_of(name)
+        if settings is None:
+            continue
+        for level in LEVELS:
+            thr = settings.get(
+                f"index.search.slowlog.threshold.query.{level}")
+            if thr is None:
+                continue
+            thr_ms = parse_time_value(str(thr), "slowlog") * 1000
+            if thr_ms < 0:
+                continue                # -1 disables the level
+            if took_ms >= thr_ms:
+                entry = {"index": name, "took_ms": int(took_ms),
+                         "level": level,
+                         "source": json.dumps(body or {})[:1000]}
+                _slowlog_logger.log(
+                    _LEVEL_NUM[level],
+                    "[%s] took[%dms], source[%s]",
+                    name, took_ms, entry["source"])
+                recent.append(entry)
+                new_entries.append(entry)
+                while len(recent) > MAX_RECENT:
+                    recent.pop(0)
+                break
+    return new_entries

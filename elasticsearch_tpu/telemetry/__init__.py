@@ -1,0 +1,76 @@
+"""Cluster-wide telemetry: node-local metrics + distributed tracing.
+
+One ``Telemetry`` bundle per node (single-process ``Node`` and
+``ClusterNode`` alike) holding a ``MetricsRegistry`` and a ``Tracer``
+on a shared injectable clock. Components keep ``self.telemetry = None``
+by default and guard instrumentation with one ``is not None`` branch
+(the ``profile.active()`` pattern), so an un-wired hot path pays a
+single branch per site.
+
+Surfaces: the ``telemetry`` section of ``GET /_nodes/stats``,
+``GET /_traces`` / ``GET /_traces/{trace_id}``, and ``trace.id`` echoed
+in search response headers. See COMPONENTS.md "Observability" for the
+metrics catalog and header format.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from elasticsearch_tpu.telemetry.metrics import (  # noqa: F401
+    DEFAULT_BUCKETS_MS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from elasticsearch_tpu.telemetry.tracing import Span, Tracer  # noqa: F401
+
+
+class Telemetry:
+    """Metrics + tracer on one clock; the node-level handle."""
+
+    def __init__(self, node: str = "",
+                 clock: Optional[Callable[[], float]] = None,
+                 max_traces: int = 128):
+        self.node = node
+        self.metrics = MetricsRegistry(clock=clock)
+        self.tracer = Tracer(clock=clock, node=node, max_traces=max_traces)
+        metrics = self.metrics
+
+        def _sink(stage: str, nanos: int) -> None:
+            metrics.observe(f"search.stage.{stage}", nanos / 1e6)
+
+        self._stage_sink = _sink
+
+    def stage_sink(self) -> Callable[[str, int], None]:
+        """The search/profile.py sink folding device/host stage timings
+        (launch, readback, topk, merge, ...) into latency histograms —
+        stages accumulate whether or not ``profile: true`` was asked.
+        Built once; called per search on the hot path."""
+        return self._stage_sink
+
+    def to_dict(self) -> Dict[str, Any]:
+        """The `_nodes/stats` ``telemetry`` section."""
+        return {
+            "metrics": self.metrics.to_dict(),
+            "traces": {
+                "count": len(self.tracer._traces),
+                "open_spans": len(self.tracer.open_spans()),
+            },
+        }
+
+
+def wire_transport(transport, telemetry: Optional[Telemetry]) -> None:
+    """Attach a telemetry bundle to every layer of a (possibly wrapped)
+    transport stack — FaultInjectingTransport delegates reads through
+    ``inner``, TransportService owns a raw ``transport``."""
+    seen = set()
+    t = transport
+    while t is not None and id(t) not in seen:
+        seen.add(id(t))
+        try:
+            t.telemetry = telemetry
+        except Exception:  # noqa: BLE001 — read-only wrapper layers
+            pass
+        t = getattr(t, "inner", None) or getattr(t, "transport", None)

@@ -1,0 +1,128 @@
+"""Ambient telemetry context: the thread-local half of trace
+propagation, plus capture/rebind across scheduler task boundaries.
+
+Two problems live here:
+
+1. **Propagation.** The REST boundary or a transport dispatch installs
+   the active (trace_id, span_id) so downstream code — the coordinator,
+   a data-node shard handler — can parent its spans without threading a
+   context argument through every call (``Tracer.start_span`` consults
+   ``current()`` when no explicit parent is given). On the wire the
+   context rides transport request headers ``trace.id`` / ``span.id``
+   (the ``__headers`` carrier in transport/transport.py).
+
+2. **Task boundaries.** The search profiler's thread-local recorder
+   (search/profile.py) and this trace context are both *temporal*
+   contexts: a task scheduled on ``DeterministicTaskQueue`` (or a
+   production scheduler/timer) runs after the installing scope exited.
+   ``bind(fn)`` captures both at schedule time and reinstalls them
+   around the task body, so ``profile: true`` on a multi-node search
+   keeps shard-side stages and remote spans keep their parents.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from elasticsearch_tpu.search import profile as _profile
+
+TRACE_HEADER = "trace.id"
+SPAN_HEADER = "span.id"
+
+_tls = threading.local()
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    trace_id: str
+    span_id: Optional[str] = None
+
+
+def current() -> Optional[TraceContext]:
+    return getattr(_tls, "ctx", None)
+
+
+@contextmanager
+def activate(ctx: Optional[TraceContext]):
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _tls.ctx = prev
+
+
+def activate_span(span) -> Any:
+    """Install a live Span as the ambient parent (context manager)."""
+    return activate(TraceContext(span.trace_id, span.span_id))
+
+
+# -- wire headers ---------------------------------------------------------
+
+def headers_of(span) -> Dict[str, str]:
+    return {TRACE_HEADER: span.trace_id, SPAN_HEADER: span.span_id}
+
+
+def from_headers(headers: Optional[Dict[str, Any]]
+                 ) -> Optional[TraceContext]:
+    if not headers:
+        return None
+    trace_id = headers.get(TRACE_HEADER)
+    if not trace_id:
+        return None
+    return TraceContext(str(trace_id), headers.get(SPAN_HEADER))
+
+
+@contextmanager
+def incoming(headers: Optional[Dict[str, Any]]):
+    """Dispatch-side: install the context carried by a request's
+    headers for the duration of its handler (no-op without headers)."""
+    ctx = from_headers(headers)
+    if ctx is None:
+        yield None
+        return
+    with activate(ctx):
+        yield ctx
+
+
+# -- task-boundary carry --------------------------------------------------
+
+def capture():
+    """Snapshot (profile recorder, profile sink, trace context); None
+    when nothing is active — the common case costs three getattrs."""
+    rec = getattr(_profile._tls, "rec", None)
+    sink = getattr(_profile._tls, "sink", None)
+    ctx = getattr(_tls, "ctx", None)
+    if rec is None and sink is None and ctx is None:
+        return None
+    return (rec, sink, ctx)
+
+
+def bind(fn: Callable) -> Callable:
+    """Bind the ambient contexts at call time into a task body (the
+    callee's return value passes through, so this also wraps executor
+    submissions); returns ``fn`` unchanged when no context is active
+    (zero overhead at run time for un-instrumented schedules)."""
+    cap = capture()
+    if cap is None:
+        return fn
+    rec, sink, ctx = cap
+
+    def bound():
+        prev_rec = getattr(_profile._tls, "rec", None)
+        prev_sink = getattr(_profile._tls, "sink", None)
+        prev_ctx = getattr(_tls, "ctx", None)
+        _profile._tls.rec = rec
+        _profile._tls.sink = sink
+        _tls.ctx = ctx
+        try:
+            return fn()
+        finally:
+            _profile._tls.rec = prev_rec
+            _profile._tls.sink = prev_sink
+            _tls.ctx = prev_ctx
+
+    return bound

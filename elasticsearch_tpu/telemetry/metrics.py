@@ -1,0 +1,209 @@
+"""Node-local metrics registry: counters, gauges, fixed-bucket
+histograms.
+
+The engine's analogue of the reference's stats surfaces (ref:
+`_nodes/stats` backed by NodeService.stats() aggregating per-service
+counters; ThreadPool/TransportService/SearchService each keep their
+own). Redesigned as one injectable registry instead of scattered
+per-service fields:
+
+- every metric is get-or-create by ``(name, labels)`` so call sites
+  never pre-register;
+- the **clock is injectable** (``clock=scheduler.now``), so timers read
+  virtual time under ``DeterministicTaskQueue`` and the whole registry
+  is replayable from a seed;
+- histograms use FIXED bucket boundaries (no t-digest state), so two
+  runs that observe the same values report identical bucket counts;
+- ``to_dict()`` renders the `_nodes/stats` ``telemetry`` section.
+
+Hot-path contract: components hold ``self.telemetry`` (default None)
+and guard every call site with one ``is not None`` branch — the same
+pattern as ``profile.active()`` — so an un-wired node pays a single
+branch per instrumented site.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# default latency buckets, in milliseconds (upper bounds; +inf implied)
+DEFAULT_BUCKETS_MS: Tuple[float, ...] = (
+    0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0, 500.0,
+    1000.0, 5000.0, 10000.0, 30000.0)
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+class Counter:
+    """Monotonic counter (floats allowed: e.g. backoff seconds).
+    Writes are locked: increments arrive from transport-executor and
+    REST threads concurrently, and ``+=`` is not atomic."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self) -> None:
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+    def to_dict(self) -> Dict[str, Any]:
+        v = self.value
+        return {"type": "counter",
+                "value": int(v) if float(v).is_integer() else v}
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+    def to_dict(self) -> Dict[str, Any]:
+        v = self.value
+        return {"type": "gauge",
+                "value": int(v) if float(v).is_integer() else v}
+
+
+class Histogram:
+    """Fixed-boundary histogram with count/sum/min/max. Boundaries are
+    upper bounds; one overflow bucket catches the tail. ``counts``
+    holds DISJOINT per-bucket tallies internally; ``to_dict`` serializes
+    them CUMULATIVELY under Prometheus-style ``le_*`` names (so
+    ``le_inf`` always equals ``count``). Observations are locked so
+    count/sum/buckets stay mutually consistent under concurrent
+    writers."""
+
+    __slots__ = ("buckets", "counts", "count", "sum", "min", "max",
+                 "_lock")
+
+    def __init__(self, buckets: Tuple[float, ...] = DEFAULT_BUCKETS_MS):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            for i, bound in enumerate(self.buckets):
+                if v <= bound:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+    def to_dict(self) -> Dict[str, Any]:
+        buckets = {}
+        acc = 0
+        for b, c in zip(self.buckets, self.counts):
+            acc += c
+            buckets[f"le_{b:g}"] = acc
+        buckets["le_inf"] = acc + self.counts[-1]
+        return {"type": "histogram", "count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max, "buckets": buckets}
+
+
+def _label_key(labels: Dict[str, Any]) -> LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class MetricsRegistry:
+    """Get-or-create registry keyed by (name, labels); thread-safe.
+
+    ``clock`` is a zero-arg seconds function (``time.monotonic`` by
+    default, a Scheduler's ``now`` under the deterministic harness).
+    """
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None):
+        self.clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[str, LabelKey], Any] = {}
+
+    # -- get-or-create ----------------------------------------------------
+
+    def _get(self, name: str, factory: Callable[[], Any],
+             labels: Dict[str, Any]):
+        key = (name, _label_key(labels))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = factory()
+                self._metrics[key] = m
+            return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(name, Counter, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(name, Gauge, labels)
+
+    def histogram(self, name: str,
+                  buckets: Tuple[float, ...] = DEFAULT_BUCKETS_MS,
+                  **labels) -> Histogram:
+        return self._get(name, lambda: Histogram(buckets), labels)
+
+    # -- convenience ------------------------------------------------------
+
+    def inc(self, name: str, n: float = 1.0, **labels) -> None:
+        self.counter(name, **labels).inc(n)
+
+    def set_gauge(self, name: str, v: float, **labels) -> None:
+        self.gauge(name, **labels).set(v)
+
+    def observe(self, name: str, v: float, **labels) -> None:
+        self.histogram(name, **labels).observe(v)
+
+    @contextmanager
+    def timer(self, name: str, **labels):
+        """Time a block into a latency histogram (milliseconds), on the
+        injected clock."""
+        t0 = self.clock()
+        try:
+            yield
+        finally:
+            self.observe(name, (self.clock() - t0) * 1000.0, **labels)
+
+    # -- introspection ----------------------------------------------------
+
+    def get_value(self, name: str, **labels):
+        """Current value of a counter/gauge (0 when never touched)."""
+        key = (name, _label_key(labels))
+        with self._lock:
+            m = self._metrics.get(key)
+        return 0 if m is None else getattr(m, "value", None)
+
+    def to_dict(self) -> Dict[str, Any]:
+        """The `_nodes/stats` ``telemetry.metrics`` shape: unlabeled
+        metrics render flat; labeled metrics render as a series list,
+        both sorted for stable output."""
+        with self._lock:
+            items = dict(self._metrics)
+        series: Dict[str, List[LabelKey]] = {}
+        for name, lk in items:
+            series.setdefault(name, []).append(lk)
+        out: Dict[str, Any] = {}
+        for name in sorted(series):
+            keys = series[name]
+            if keys == [()]:
+                out[name] = items[(name, ())].to_dict()
+                continue
+            out[name] = [
+                {"labels": dict(lk), **items[(name, lk)].to_dict()}
+                for lk in sorted(keys)]
+        return out

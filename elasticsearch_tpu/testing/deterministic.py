@@ -23,10 +23,13 @@ import random
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
+from elasticsearch_tpu.telemetry import context as _telectx
 from elasticsearch_tpu.transport.transport import (
     DiscoveryNode,
     ResponseHandler,
     TransportChannel,
+    instrument_inbound,
+    instrument_send,
 )
 
 
@@ -72,6 +75,9 @@ class ThreadedScheduler(Scheduler):
     def schedule(self, delay: float, fn: Callable[[], None],
                  description: str = "") -> Cancellable:
         c = Cancellable()
+        # profile recorder + trace context are temporal: carry them with
+        # the task so they are live when the timer thread runs it
+        fn = _telectx.bind(fn)
         with self._cond:
             heapq.heappush(self._queue,
                            (self.now() + delay, next(self._seq), c, fn))
@@ -128,6 +134,12 @@ class DeterministicTaskQueue(Scheduler):
     def schedule(self, delay: float, fn: Callable[[], None],
                  description: str = "") -> Cancellable:
         c = Cancellable()
+        # carry the ambient profile recorder / stage sink / trace
+        # context across the task boundary: a shard-side handler
+        # scheduled here must record into the search's contexts even
+        # though the installing scope has long exited (`profile: true`
+        # on a multi-node search keeps its shard stages)
+        fn = _telectx.bind(fn)
         if delay <= 0:
             self._runnable.append((description, self._guard(c, fn)))
         else:
@@ -222,6 +234,7 @@ class DisruptableTransport:
     def __init__(self, local_node: DiscoveryNode, network: "SimNetwork"):
         self.local_node = local_node
         self.network = network
+        self.telemetry = None
         self._handlers: Dict[str, Callable] = {}
         network.register(self)
 
@@ -241,7 +254,11 @@ class DisruptableTransport:
 
     def send_request(self, node: DiscoveryNode, action: str, request: Any,
                      handler: ResponseHandler,
-                     timeout: Optional[float] = None) -> None:
+                     timeout: Optional[float] = None,
+                     headers: Optional[Dict[str, Any]] = None) -> None:
+        # same send-side telemetry seam as the production transport
+        request, handler = instrument_send(self.telemetry, action,
+                                           request, handler, headers)
         self.network.deliver(self, node, action, request, handler, timeout)
 
     def send_request_sync(self, *a, **k):  # pragma: no cover
@@ -252,13 +269,15 @@ class DisruptableTransport:
     def handle(self, source: DiscoveryNode, action: str, request: Any,
                respond: Callable[[Any, bool], None]) -> None:
         handler = self._handlers.get(action)
+        headers = instrument_inbound(self.telemetry, action, request)
         channel = TransportChannel(respond, action)
         if handler is None:
             channel.send_exception(
                 KeyError(f"No handler for action [{action}]"))
             return
         try:
-            handler(request, channel, source)
+            with _telectx.incoming(headers):
+                handler(request, channel, source)
         except BaseException as e:  # noqa: BLE001 — sim fault barrier
             channel.send_exception(e)
 

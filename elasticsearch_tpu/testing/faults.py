@@ -164,13 +164,14 @@ class FaultInjectingTransport:
     # -- the fault seam ---------------------------------------------------
 
     def send_request(self, node, action: str, request: Any, handler,
-                     timeout: Optional[float] = None) -> None:
+                     timeout: Optional[float] = None,
+                     headers: Optional[Dict] = None) -> None:
         inj = self.injector
         inj.record_send(action)
         rule = inj.decide(action, node.node_id)
         if rule is None:
             self.inner.send_request(node, action, request, handler,
-                                    timeout=timeout)
+                                    timeout=timeout, headers=headers)
             return
         sched = self.scheduler
         if rule.mode == ERROR:
@@ -199,5 +200,6 @@ class FaultInjectingTransport:
             sched.schedule(
                 delay,
                 lambda: self.inner.send_request(node, action, request,
-                                                handler, timeout=timeout),
+                                                handler, timeout=timeout,
+                                                headers=headers),
                 f"fault-delay {action}->{node.name}")

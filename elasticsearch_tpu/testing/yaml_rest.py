@@ -64,7 +64,10 @@ class YamlTestFailure(AssertionError):
 
 
 def _resolve_path(obj: Any, path: str):
-    """`hits.hits.0._source.title` style dot path; $body = whole response."""
+    """`hits.hits.0._source.title` style dot path; $body = whole
+    response. A `*` segment traverses a SINGLE-entry dict regardless of
+    its key (e.g. `nodes.*.telemetry` — node ids are random per run,
+    mirroring the reference runner's $node_id stashing)."""
     if path in ("$body", ""):
         return obj
     cur = obj
@@ -72,6 +75,13 @@ def _resolve_path(obj: Any, path: str):
     for raw in re.split(r"(?<!\\)\.", path):
         part = raw.strip().replace("\\.", ".")
         if isinstance(cur, dict):
+            if part == "*" and part not in cur:
+                if len(cur) != 1:
+                    raise YamlTestFailure(
+                        f"path [{path}]: [*] needs exactly one key, "
+                        f"got {len(cur)}")
+                cur = next(iter(cur.values()))
+                continue
             if part not in cur:
                 raise YamlTestFailure(f"path [{path}]: missing [{part}]")
             cur = cur[part]

@@ -40,6 +40,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.common.errors import ElasticsearchTpuException
+from elasticsearch_tpu.telemetry import context as _telectx
 from elasticsearch_tpu.transport.wire import StreamInput, StreamOutput
 
 CURRENT_VERSION = 1
@@ -180,6 +181,73 @@ class ResponseHandler:
         self.on_failure = on_failure or (lambda e: None)
 
 
+def attach_headers(request: Any,
+                   headers: Optional[Dict[str, Any]]) -> Any:
+    """Carry request headers on the wire: dict payloads get a copied
+    ``__headers`` section (the transport-request analogue of the
+    reference's ThreadContext headers riding every TransportRequest);
+    the dispatch side strips it before the handler sees the request."""
+    if headers and isinstance(request, dict):
+        request = dict(request)
+        request["__headers"] = dict(headers)
+    return request
+
+
+def pop_headers(payload: Any) -> Optional[Dict[str, Any]]:
+    if isinstance(payload, dict) and "__headers" in payload:
+        return payload.pop("__headers")
+    return None
+
+
+def instrument_send(telemetry, action: str, request: Any,
+                    handler: ResponseHandler,
+                    headers: Optional[Dict[str, Any]]):
+    """The shared send-side telemetry seam (production TransportService
+    and the sim DisruptableTransport call this, so counting/header
+    semantics cannot drift between them): attach the header carrier,
+    count the outbound request, wrap the handler with round-trip
+    timing. Returns the (request, handler) pair to send."""
+    request = attach_headers(request, headers)
+    if telemetry is not None:
+        telemetry.metrics.inc("transport.requests.sent", action=action)
+        handler = timed_handler(telemetry, action, handler)
+    return request, handler
+
+
+def instrument_inbound(telemetry, action: str,
+                       payload: Any) -> Optional[Dict[str, Any]]:
+    """The shared dispatch-side seam: strip the header carrier before
+    the handler sees the payload and count the inbound request.
+    Returns the stripped headers (for ambient trace installation)."""
+    headers = pop_headers(payload)
+    if telemetry is not None:
+        telemetry.metrics.inc("transport.requests.received",
+                              action=action)
+    return headers
+
+
+def timed_handler(telemetry, action: str,
+                  handler: ResponseHandler) -> ResponseHandler:
+    """Wrap a ResponseHandler with per-action telemetry: round-trip
+    latency histogram + ok/failure counters, on the telemetry clock."""
+    metrics = telemetry.metrics
+    t0 = metrics.clock()
+
+    def ok(resp):
+        metrics.observe("transport.latency",
+                        (metrics.clock() - t0) * 1000.0, action=action)
+        metrics.inc("transport.responses", action=action)
+        handler.on_response(resp)
+
+    def fail(exc):
+        metrics.observe("transport.latency",
+                        (metrics.clock() - t0) * 1000.0, action=action)
+        metrics.inc("transport.failures", action=action)
+        handler.on_failure(exc)
+
+    return ResponseHandler(ok, fail)
+
+
 def _encode_frame(request_id: int, status: int, version: int, action: str,
                   payload: Any) -> bytes:
     out = StreamOutput()
@@ -218,6 +286,8 @@ class BaseTransport:
             max_workers=8, thread_name_prefix=f"transport-{local_node.name}")
         self._owns_executor = executor is None
         self._closed = False
+        # node telemetry bundle; None keeps instrumented sites one branch
+        self.telemetry = None
 
     # -- registry ---------------------------------------------------------
 
@@ -246,6 +316,10 @@ class BaseTransport:
                           action: str, payload: Any,
                           reply: Callable[[bytes], None]) -> None:
         reg = self._handlers.get(action)
+        # strip the request-header carrier before the handler sees the
+        # payload; the trace context it carries becomes ambient for the
+        # duration of the handler (Dapper-style RPC propagation)
+        headers = instrument_inbound(self.telemetry, action, payload)
 
         def send_response(response: Any, is_error: bool) -> None:
             status = STATUS_ERROR if is_error else 0
@@ -261,7 +335,8 @@ class BaseTransport:
 
         def run():
             try:
-                reg.handler(payload, channel, source)
+                with _telectx.incoming(headers):
+                    reg.handler(payload, channel, source)
             except BaseException as e:  # noqa: BLE001 — handler fault barrier
                 try:
                     channel.send_exception(e)
@@ -481,6 +556,9 @@ class TcpTransport(BaseTransport):
                     buf += chunk
                 body, buf = buf[6:6 + length], buf[6 + length:]
                 rid, status, ver, action, payload = _decode_frame(body)
+                if self.telemetry is not None:
+                    self.telemetry.metrics.inc("transport.bytes.received",
+                                               6 + length, action=action)
                 if status & STATUS_REQUEST:
                     source = (DiscoveryNode.from_dict(payload.pop("__source"))
                               if isinstance(payload, dict)
@@ -546,6 +624,9 @@ class TcpTransport(BaseTransport):
         # a single format exists; a future format change keys on this)
         frame = _encode_frame(request_id, STATUS_REQUEST, wire_version,
                               action, payload)
+        if self.telemetry is not None:
+            self.telemetry.metrics.inc("transport.bytes.sent", len(frame),
+                                       action=action)
         try:
             sock, write_lock = self._socket_for(node, lane)
             with write_lock:
@@ -605,6 +686,7 @@ class TransportService:
                  timeout_sweep_interval: float = 0.5):
         self.transport = transport
         self.local_node = transport.local_node
+        self.telemetry = None
         self._connected: Dict[str, DiscoveryNode] = {}
         self._peer_versions: Dict[str, int] = {}
         self._conn_lock = threading.Lock()
@@ -718,7 +800,10 @@ class TransportService:
 
     def send_request(self, node: DiscoveryNode, action: str, request: Any,
                      handler: ResponseHandler,
-                     timeout: Optional[float] = None) -> None:
+                     timeout: Optional[float] = None,
+                     headers: Optional[Dict[str, Any]] = None) -> None:
+        request, handler = instrument_send(self.telemetry, action,
+                                           request, handler, headers)
         sender = self._do_send
         for icpt in reversed(self._interceptors):
             wrap = getattr(icpt, "intercept_sender", None)

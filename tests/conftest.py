@@ -71,6 +71,27 @@ def pytest_runtest_makereport(item, call):
                 "chaos seed", f"replay with --chaos-seed={seed}")
 
 
+@pytest.fixture(autouse=True)
+def _span_leak_guard():
+    """Telemetry hygiene: fail any test that starts a trace span and
+    never finishes it. Spans already open before the test (e.g. a
+    background service of a long-lived node from another fixture) are
+    excluded — only spans OPENED during this test count as leaks."""
+    from elasticsearch_tpu.telemetry import tracing
+    before = tracing.open_span_keys()
+    yield
+    leaked = tracing.open_span_keys() - before
+    if leaked:
+        # wall-clock transports may still be completing an RPC; give
+        # in-flight handlers one beat before calling it a leak
+        import time as _time
+        _time.sleep(0.2)
+        leaked = tracing.open_span_keys() - before
+    assert not leaked, (
+        "telemetry spans left open at teardown (started, never "
+        f"finished): {sorted(k[3] for k in leaked)}")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
