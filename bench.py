@@ -76,7 +76,8 @@ def remaining_budget() -> float:
     return _BUDGET_S - (time.time() - _T_START)
 
 
-def emit(metric_text: str, value: float, vs_baseline: float):
+def emit(metric_text: str, value: float, vs_baseline: float,
+         engine=None):
     _LAST_PAYLOAD.clear()
     _LAST_PAYLOAD.update({
         "metric": metric_text,
@@ -85,7 +86,32 @@ def emit(metric_text: str, value: float, vs_baseline: float):
         "vs_baseline": round(float(vs_baseline), 2)
         if np.isfinite(vs_baseline) else 0.0,
     })
+    if engine:
+        # engine observability rider (telemetry/engine.py): compile
+        # table + HBM peak, so the perf trajectory records compile-time
+        # regressions (a shape-discipline break shows as compile counts
+        # growing round over round) alongside latency
+        _LAST_PAYLOAD["engine"] = engine
     print(json.dumps(_LAST_PAYLOAD), flush=True)
+
+
+def _engine_snapshot(parts: dict) -> dict:
+    """Compile-tracker rollup + per-kernel compile table (+ the REST
+    node's HBM peak once the serving section ran) for the BENCH json."""
+    out = {}
+    try:
+        from elasticsearch_tpu.telemetry.engine import TRACKER
+        out["compile"] = TRACKER.totals()
+        out["kernels"] = {
+            name: {"compiles": e["compiles"],
+                   "shapes_seen": e["shapes_seen"],
+                   "cum_ms": e["cum_ms"]}
+            for name, e in TRACKER.to_dict().items()}
+    except Exception:   # noqa: BLE001 — stats must never kill the bench
+        pass
+    if parts.get("hbm_peak_bytes"):
+        out["hbm_peak_bytes"] = parts["hbm_peak_bytes"]
+    return out
 
 
 def _term_handler(signum, frame):
@@ -879,6 +905,9 @@ def run_rest_path(corpus, queries, truth, tmpdir, kernel="auto",
         if remaining_budget() < 180:
             log(f"skipping product rows (budget: "
                 f"{remaining_budget():.0f}s left)")
+        if emit_cb is not None:
+            emit_cb(hbm_peak_bytes=node.indices_service.device_cache
+                    .hbm_stats().get("peak_bytes", 0))
         node.close()
         return (best_qps, p50, p99, rest_recall, warm_recall, avg_batch,
                 bool_qps, extra)
@@ -941,6 +970,11 @@ def run_rest_path(corpus, queries, truth, tmpdir, kernel="auto",
         _row("rrf_hybrid", rbodies, min(CLIENTS, 64), 4,
              check=lambda r: len(r["hits"]["hits"]) > 0)
 
+    if emit_cb is not None:
+        # HBM peak of the serving node's device cache, recorded into the
+        # BENCH json's engine rider before the node goes away
+        emit_cb(hbm_peak_bytes=node.indices_service.device_cache
+                .hbm_stats().get("peak_bytes", 0))
     node.close()
     return (best_qps, p50, p99, rest_recall, warm_recall, avg_batch,
             bool_qps, extra)
@@ -1163,7 +1197,8 @@ def main():
             value = parts.get("kernel_qps", 0.0)
         cpu = parts.get("cpu_qps") or 0.0
         emit(compose_metric(parts), value,
-             value / cpu if cpu else float("nan"))
+             value / cpu if cpu else float("nan"),
+             engine=_engine_snapshot(parts))
 
     rng = np.random.default_rng(12345)
     corpus = build_corpus(rng)

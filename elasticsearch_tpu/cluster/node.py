@@ -45,6 +45,7 @@ from elasticsearch_tpu.transport.transport import (
 CREATE_INDEX_ACTION = "indices:admin/create"
 DELETE_INDEX_ACTION = "indices:admin/delete"
 REFRESH_ACTION = "indices:admin/refresh[s]"
+ENGINE_STATS_ACTION = "cluster:monitor/nodes/engine_stats[n]"
 
 
 class ClusterNode:
@@ -117,6 +118,7 @@ class ClusterNode:
             (CREATE_INDEX_ACTION, self._on_create_index),
             (DELETE_INDEX_ACTION, self._on_delete_index),
             (REFRESH_ACTION, self._on_refresh_shard),
+            (ENGINE_STATS_ACTION, self._on_engine_stats),
         ]:
             transport.register_request_handler(action, handler)
 
@@ -225,6 +227,58 @@ class ClusterNode:
     def _on_refresh_shard(self, req, channel, src) -> None:
         self.data_node.refresh_all()
         channel.send_response({"ok": True})
+
+    # ------------------------------------------------- engine stats fan-out
+
+    def local_engine_stats(self) -> Dict[str, Any]:
+        """This node's engine-level device stats: the compile-tracker
+        rollup (process-global — every in-process node reports the same
+        shared jit cache, exactly as they share it) + HBM/cache stats of
+        the LOCAL data node's device-segment cache."""
+        from elasticsearch_tpu.telemetry import engine as _engine
+        return {"name": self.local_node.name or self.local_node.node_id,
+                "compile": _engine.TRACKER.totals(),
+                **self.data_node.device_cache.engine_stats()}
+
+    def _on_engine_stats(self, req, channel, src) -> None:
+        channel.send_response(self.local_engine_stats())
+
+    def nodes_engine_stats(
+            self, on_done: Callable = lambda r, e: None) -> None:
+        """Cluster-wide engine stats: fan out ENGINE_STATS_ACTION to
+        every data node and merge — the multi-node analogue of the
+        single-node `engine` section of `GET /_nodes/stats` (ref: the
+        TransportNodesAction scatter/gather behind `_nodes/stats`).
+        Unreachable nodes report an `error` entry instead of failing
+        the whole response (partial stats beat no stats)."""
+        nodes = self.state.nodes.data_nodes()
+        if not nodes:
+            on_done({"nodes": {}, "total_hbm_bytes": 0}, None)
+            return
+        results: Dict[str, Dict[str, Any]] = {}
+        pending = {"n": len(nodes)}
+
+        def finish():
+            pending["n"] -= 1
+            if pending["n"] == 0:
+                total = sum(
+                    r.get("hbm", {}).get("total_bytes", 0)
+                    for r in results.values() if "error" not in r)
+                on_done({"nodes": results, "total_hbm_bytes": total},
+                        None)
+
+        for node in nodes:
+            def ok(resp, _nid=node.node_id):
+                results[_nid] = resp
+                finish()
+
+            def fail(exc, _nid=node.node_id):
+                results[_nid] = {"error": str(exc)}
+                finish()
+
+            self.transport.send_request(
+                node, ENGINE_STATS_ACTION, {},
+                ResponseHandler(ok, fail), timeout=30.0)
 
     # -------------------------------------------------------- client API
     # (async; each takes on_done(result, error))

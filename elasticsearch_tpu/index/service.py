@@ -280,14 +280,39 @@ class IndexService:
         docs = 0
         deleted = 0
         segments = 0
+        # engine-level device stats for THIS index's resident segments
+        # (the device cache is node-shared; segment names are globally
+        # unique, so the slice is exact) — the TPU-HBM analogue of the
+        # reference's per-index segment/fielddata memory in `_stats`.
+        # ONE walk per shard; shards partition the segment set, so the
+        # index view is the sum of the per-shard views.
+        shard_hbm: List[int] = []
+        by_class: Dict[str, int] = {}
+        resident = 0
+        seg_names = set()
         for shard in self.shards:
             s = shard.stats()
             docs += s["docs"]["count"]
             deleted += s["docs"]["deleted"]
             segments += s["segments"]["count"]
+            shard_names = {seg.name for seg in shard.segments}
+            seg_names |= shard_names
+            sh = self.device_cache.hbm_stats(shard_names)
+            shard_hbm.append(sh["total_bytes"])
+            resident += sh["segments"]
+            for cls, n in sh["by_class"].items():
+                by_class[cls] = by_class.get(cls, 0) + n
+        total = sum(shard_hbm)
+        self._hbm_peak = max(getattr(self, "_hbm_peak", 0), total)
+        hbm = {"total_bytes": total, "by_class": by_class,
+               "segments": resident, "peak_bytes": self._hbm_peak,
+               "shard_bytes": shard_hbm}
         return {"docs": {"count": docs, "deleted": deleted},
                 "segments": {"count": segments},
-                "shards": self.num_shards}
+                "shards": self.num_shards,
+                "engine": {
+                    "hbm": hbm,
+                    "caches": self.device_cache.cache_stats(seg_names)}}
 
     def close(self):
         for shard in self.shards:

@@ -59,9 +59,15 @@ class Node:
         from elasticsearch_tpu.common.threadpool import ThreadPool
         self.threadpool = ThreadPool()
         # node telemetry: metrics registry + tracer (telemetry/), the
-        # `_nodes/stats` telemetry section and the /_traces surface
+        # `_nodes/stats` telemetry section and the /_traces surface;
+        # trace retention is bounded (max traces x max spans per trace)
+        # and tunable for long-running nodes
         from elasticsearch_tpu.telemetry import Telemetry
-        self.telemetry = Telemetry(node=self.name)
+        self.telemetry = Telemetry(
+            node=self.name,
+            max_traces=int(settings.get("telemetry.traces.max", 128)),
+            max_spans_per_trace=int(
+                settings.get("telemetry.traces.max_spans", 512)))
         self.indices_service = IndicesService(self.data_path, settings)
         self.search_service = SearchService(self.indices_service)
         self.search_service.telemetry = self.telemetry

@@ -27,8 +27,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from elasticsearch_tpu.telemetry.engine import tracked_jit
 
-@jax.jit
+
+@tracked_jit("terms_counts")
 def _terms_counts_kernel(perm_docs, mask, ends_idx, begins_idx,
                          begins_zero, nonempty):
     """counts[i] = cum[start_{i+1}-1] - cum[start_i-1] over the masked

@@ -24,6 +24,12 @@ not change ranking):
 Lucene quantizes dl into a 1-byte norm (SmallFloat); we keep exact float
 lengths — rankings agree at matched recall, absolute scores differ slightly
 (SURVEY.md §7 "Scoring parity").
+
+Compile observability: nothing here is jitted at module level — callers
+either execute these eagerly (the dense fallback) or close over them in
+their own jit (bench.py, ops/plan.py), so their per-shape compiles are
+attributed to the CALLING kernel's entry in the compile tracker
+(telemetry/engine.py); see `GET /_kernels`.
 """
 
 from __future__ import annotations

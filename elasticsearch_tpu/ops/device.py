@@ -36,6 +36,14 @@ MIN_BLOCK_BUCKET = 8
 # validates block-max pruning thresholds without a device readback).
 FILTER_MASK_CACHE_MAX = 64
 
+# HBM slab classes — the accounting buckets of `GET /_nodes/stats`'s
+# engine section (the TPU-native analogue of the reference's segment
+# stats + fielddata memory accounting in NodeIndicesStats). Every
+# device-resident array of a DeviceSegment belongs to exactly one class,
+# so `sum(hbm_bytes_by_class().values()) == hbm_bytes()` by construction.
+HBM_SLAB_CLASSES = ("postings", "norms", "live_mask", "vectors",
+                    "doc_values", "ordinals", "filter_masks")
+
 
 def round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
@@ -158,6 +166,16 @@ class DeviceSegment:
         # their device-resident selection arrays — skipping bind_plan AND
         # the per-launch host→device uploads of the selections
         self._bound_plans: "OrderedDict[tuple, object]" = OrderedDict()
+        # device-cache stats (engine observability — the analogue of
+        # IndicesQueryCache stats): plain ints, advisory counters on a
+        # GIL'd hot path. Bound-plan counters are incremented by the
+        # searcher (the cache's only reader/writer).
+        self.filter_mask_hits = 0
+        self.filter_mask_misses = 0
+        self.filter_mask_evictions = 0
+        self.bound_plan_hits = 0
+        self.bound_plan_misses = 0
+        self.bound_plan_evictions = 0
         live = np.zeros(self.n_docs_padded, bool)
         live[: segment.n_docs] = segment.live
         self.live = jax.device_put(live, device=device)
@@ -223,8 +241,10 @@ class DeviceSegment:
         key = (field, tuple(sorted(set(terms))))
         hit = self._filter_masks.get(key)
         if hit is not None:
+            self.filter_mask_hits += 1
             self._filter_masks.move_to_end(key)
             return hit
+        self.filter_mask_misses += 1
         dp = self.postings.get(field)
         if dp is not None:
             mask = host_any_mask(dp.host, key[1], self.n_docs_padded)
@@ -234,6 +254,7 @@ class DeviceSegment:
         self._filter_masks[key] = entry
         while len(self._filter_masks) > FILTER_MASK_CACHE_MAX:
             self._filter_masks.popitem(last=False)
+            self.filter_mask_evictions += 1
         return entry
 
     def composed_filter_mask(self, conversions) -> Tuple[jax.Array,
@@ -249,8 +270,10 @@ class DeviceSegment:
                                     key=lambda c: (c[0], c[1], c[2]))))
         hit = self._filter_masks.get(key)
         if hit is not None:
+            self.filter_mask_hits += 1
             self._filter_masks.move_to_end(key)
             return hit
+        self.filter_mask_misses += 1
         host = None
         for fname, terms, negate in key[1]:
             _, hm = self.filter_mask(fname, terms)
@@ -260,6 +283,7 @@ class DeviceSegment:
         self._filter_masks[key] = entry
         while len(self._filter_masks) > FILTER_MASK_CACHE_MAX:
             self._filter_masks.popitem(last=False)
+            self.filter_mask_evictions += 1
         return entry
 
     def update_live(self, live: np.ndarray) -> None:
@@ -269,14 +293,60 @@ class DeviceSegment:
         self.live = jax.device_put(padded, device=self.live.devices().pop()
                                    if hasattr(self.live, "devices") else None)
 
-    def hbm_bytes(self) -> int:
-        total = self.live.nbytes
+    def hbm_bytes_by_class(self) -> Dict[str, int]:
+        """Device-resident bytes per slab class (HBM_SLAB_CLASSES) —
+        the engine-stats accounting model. ``postings`` is the block
+        arrays + block-max metadata; ``norms`` the per-doc field-length
+        columns (the analogue of Lucene's norms); ``doc_values`` the
+        numeric columns + their missing masks; ``ordinals`` the lazy
+        keyword ord-major permutations; ``filter_masks`` the LRU-cached
+        device filter columns (so eviction visibly RETURNS bytes)."""
+        out = dict.fromkeys(HBM_SLAB_CLASSES, 0)
+        out["live_mask"] = int(self.live.nbytes)
         for dp in self.postings.values():
-            total += (dp.block_docids.nbytes + dp.block_tfs.nbytes +
-                      dp.block_max_tf.nbytes + dp.block_min_len.nbytes +
-                      dp.doc_lens.nbytes)
+            out["postings"] += int(dp.block_docids.nbytes +
+                                   dp.block_tfs.nbytes +
+                                   dp.block_max_tf.nbytes +
+                                   dp.block_min_len.nbytes)
+            out["norms"] += int(dp.doc_lens.nbytes)
         for dv in self.vectors.values():
-            total += dv.vectors.nbytes + dv.norms.nbytes
+            out["vectors"] += int(dv.vectors.nbytes + dv.norms.nbytes +
+                                  dv.sq_norms.nbytes +
+                                  dv.has_value.nbytes)
         for arr in self.numerics.values():
-            total += arr.nbytes
-        return total
+            out["doc_values"] += int(arr.nbytes)
+        for arr in self.numeric_missing.values():
+            out["doc_values"] += int(arr.nbytes)
+        for entry in (getattr(self, "_kw_ord_major", None) or {}).values():
+            if entry is not None:
+                out["ordinals"] += int(entry[0].nbytes)
+        for dev_mask, _host in self._filter_masks.values():
+            out["filter_masks"] += int(dev_mask.nbytes)
+        return out
+
+    def hbm_bytes(self) -> int:
+        """Total device-resident bytes — BY CONSTRUCTION the sum of
+        ``hbm_bytes_by_class()`` (the node-stats invariant pinned in
+        tests/test_engine_stats.py)."""
+        return sum(self.hbm_bytes_by_class().values())
+
+    def cache_stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-segment device-cache counters (engine observability —
+        ref: IndicesQueryCache / LRUQueryCache stats)."""
+        fm_bytes = sum(int(m.nbytes) for m, _h in
+                       self._filter_masks.values())
+        return {
+            "filter_mask": {
+                "hits": self.filter_mask_hits,
+                "misses": self.filter_mask_misses,
+                "evictions": self.filter_mask_evictions,
+                "entries": len(self._filter_masks),
+                "bytes": fm_bytes,
+            },
+            "bound_plan": {
+                "hits": self.bound_plan_hits,
+                "misses": self.bound_plan_misses,
+                "evictions": self.bound_plan_evictions,
+                "entries": len(self._bound_plans),
+            },
+        }

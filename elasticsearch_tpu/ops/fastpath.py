@@ -26,12 +26,11 @@ dense path's `scores > 0` semantics.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 
 from elasticsearch_tpu.ops.bm25 import _SENTINEL, bm25_contrib
+from elasticsearch_tpu.telemetry.engine import tracked_jit
 
 # mask-stack height: every cohort launch carries F dense bool columns
 # (row 0 = the plain live mask; rows 1.. = cached filter-set columns);
@@ -268,7 +267,7 @@ def _essential_one(block_docids, block_tfs, flat_docids, flat_tfs,
     return _essential_epilogue(patched, cand_ids, overflow_bound, k)
 
 
-@partial(jax.jit, static_argnames=("k1", "b", "k"))
+@tracked_jit(static_argnames=("k1", "b", "k"))
 def bm25_essential_topk_batch(block_docids, block_tfs,
                               flat_docids,   # int32 [TB*B] block layout
                               flat_tfs,      # float32 [TB*B]
@@ -354,7 +353,7 @@ def _essential_dense_one(block_docids, block_tfs, dense_tf, sel_blocks,
     return _essential_epilogue(patched, cand_ids, overflow_bound, k)
 
 
-@partial(jax.jit, static_argnames=("k1", "b", "k"))
+@tracked_jit(static_argnames=("k1", "b", "k"))
 def bm25_essential_dense_topk_batch(block_docids, block_tfs,
                                     dense_tf,      # f16 [H, ND] hot-term tf
                                     sel_blocks,    # int32 [Q, NBe]
@@ -418,7 +417,7 @@ def _stable_top_c(cand, mk, c):
     return jax.vmap(one)(cand, mk)
 
 
-@partial(jax.jit, static_argnames=("n_slots", "k1", "b", "k"))
+@tracked_jit(static_argnames=("n_slots", "k1", "b", "k"))
 def bm25_topk_total_merge_batch(
         block_docids,   # int32 [TB, B]
         block_tfs,      # float32 [TB, B]
@@ -480,7 +479,7 @@ def bm25_topk_total_merge_batch(
     return jnp.concatenate([vals, ids_f, tot_f[:, None]], axis=1)
 
 
-@partial(jax.jit, static_argnames=("n_slots", "k1", "b", "k"))
+@tracked_jit(static_argnames=("n_slots", "k1", "b", "k"))
 def bm25_candidates_rerank_batch(
         block_docids,   # int32 [TB, B]
         block_tfs,      # float32 [TB, B]
@@ -604,7 +603,7 @@ def bm25_candidates_rerank_batch(
                            axis=1)
 
 
-@partial(jax.jit, static_argnames=("k1", "b", "k"))
+@tracked_jit(static_argnames=("k1", "b", "k"))
 def bm25_topk_total_batch(block_docids,   # int32 [TB, B]
                           block_tfs,      # float32 [TB, B]
                           sel_blocks,     # int32 [Q, NB]

@@ -26,6 +26,12 @@ Measured on the v5e (degraded-tunnel regime, [32, 2^19] i32+f32):
 merge 156 ms/q vs lax.sort 461 ms/q — 3.0x; compile ~22s for all four
 round kernels vs a single fused whole-merge pallas kernel which is
 compile-pathological (>40 min, VMEM-OOM at the last round).
+
+Compile observability: ``merge_sorted_slots`` is trace-time composable
+(always called under an outer jit), so its per-shape compiles — the
+~22s round-kernel builds above — are attributed to the CALLING kernel's
+entry in the compile tracker (telemetry/engine.py `GET /_kernels`), not
+to a row of their own.
 """
 
 from __future__ import annotations

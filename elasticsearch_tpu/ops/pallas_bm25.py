@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from elasticsearch_tpu.telemetry.engine import tracked_jit
+
 _BLOCK = 128          # postings block width (index/segment.py BLOCK_SIZE)
 _TILE_ROWS = 256      # selection rows per grid step
 
@@ -40,7 +42,7 @@ def _contrib_kernel(w_ref, avg_ref, tf_ref, dl_ref, o_ref, *, k1, b):
     o_ref[...] = jnp.where(tf > 0.0, w * tf / (tf + norm), 0.0)
 
 
-@functools.partial(jax.jit, static_argnames=("k1", "b"))
+@tracked_jit(static_argnames=("k1", "b"))
 def bm25_contrib_pallas(sel_weights: jax.Array,   # float32 [NB]
                         tf: jax.Array,            # float32 [NB, 128]
                         dl: jax.Array,            # float32 [NB, 128]

@@ -36,12 +36,13 @@ BoolQueryBuilder / Lucene BooleanClause.Occur).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from elasticsearch_tpu.telemetry.engine import tracked_jit
 
 MUST = 0
 SHOULD = 1
@@ -221,9 +222,9 @@ def plan_topk_body(streams: Tuple[FieldStream, ...],
     return vals, ids, total
 
 
-_plan_topk_impl = partial(
-    jax.jit, static_argnames=("k", "combine", "k1", "b", "with_dense",
-                              "with_after", "script_fn"))(plan_topk_body)
+_plan_topk_impl = tracked_jit(
+    "plan_topk", static_argnames=("k", "combine", "k1", "b", "with_dense",
+                                  "with_after", "script_fn"))(plan_topk_body)
 
 
 def pack_result(vals: jax.Array, ids: jax.Array,
@@ -274,9 +275,10 @@ def _plan_topk_packed_body(streams, group_kind, group_req, group_const,
         combine, with_dense, with_after, script_fn))
 
 
-_plan_topk_packed_impl = partial(
-    jax.jit, static_argnames=("k", "combine", "k1", "b", "with_dense",
-                              "with_after", "script_fn"))(_plan_topk_packed_body)
+_plan_topk_packed_impl = tracked_jit(
+    "plan_topk_packed",
+    static_argnames=("k", "combine", "k1", "b", "with_dense",
+                     "with_after", "script_fn"))(_plan_topk_packed_body)
 
 
 def plan_topk(streams, group_kind, group_req, group_const, live,
@@ -307,8 +309,9 @@ def plan_topk(streams, group_kind, group_req, group_const, live,
         script_fn)
 
 
-@partial(jax.jit, static_argnames=("k", "combine", "k1", "b",
-                                   "with_dense", "script_fn"))
+@tracked_jit("plan_topk_batch",
+             static_argnames=("k", "combine", "k1", "b", "with_dense",
+                              "script_fn"))
 def _plan_topk_batch_impl(streams, group_kind, group_req, group_const,
                           live, dense_mask, n_must, n_filter, msm,
                           bonus, tie, k1, b, k, combine, with_dense,
@@ -380,7 +383,7 @@ def _unique_scatter_indices(dkey: jax.Array, is_last: jax.Array,
     return jnp.where(is_last & (dkey != _SENTINEL), dkey, nd + lane)
 
 
-@partial(jax.jit, static_argnames=("k1", "b", "max_run"))
+@tracked_jit(static_argnames=("k1", "b", "max_run"))
 def bm25_dense_scores_sorted(block_docids, block_tfs, sel_blocks,
                              sel_weights, doc_lens, avg_len,
                              k1: float, b: float, max_run: int = 32):
@@ -423,7 +426,7 @@ def bm25_dense_scores_sorted(block_docids, block_tfs, sel_blocks,
     return scores.at[idx].set(x, mode="drop", unique_indices=True)
 
 
-@jax.jit
+@tracked_jit
 def match_count_sorted(block_docids, block_tfs, sel_blocks, clause_ids,
                        live_template):
     """int32 [ND] distinct-clause counts via sort + run boundaries + ONE
@@ -448,7 +451,7 @@ def match_count_sorted(block_docids, block_tfs, sel_blocks, clause_ids,
                            unique_indices=True)
 
 
-@jax.jit
+@tracked_jit
 def match_mask_sorted(block_docids, block_tfs, sel_blocks, live_template):
     """bool [ND] any-of mask via the same unique-scatter trick — the
     scatter-free replacement for ops/bm25.match_mask."""

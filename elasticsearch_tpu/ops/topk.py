@@ -14,26 +14,26 @@ results agree with the reference; the merge re-sorts by (-score, docid).
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 
+from elasticsearch_tpu.telemetry.engine import tracked_jit
 
-@partial(jax.jit, static_argnames=("k",))
+
+@tracked_jit(static_argnames=("k",))
 def topk(scores: jax.Array, k: int):
     """Exact (values, indices) top-k, descending; ties → ascending index."""
     return jax.lax.top_k(scores, k)
 
 
-@partial(jax.jit, static_argnames=("k", "recall_target"))
+@tracked_jit(static_argnames=("k", "recall_target"))
 def approx_topk(scores: jax.Array, k: int, recall_target: float = 0.95):
     """TPU-optimized approximate top-k (lax.approx_max_k): ~constant-factor
     faster at large n; recall_target trades speed for exactness."""
     return jax.lax.approx_max_k(scores, k, recall_target=recall_target)
 
 
-@partial(jax.jit, static_argnames=("k",))
+@tracked_jit(static_argnames=("k",))
 def masked_topk(scores: jax.Array, mask: jax.Array, k: int):
     """Top-k over masked docs only. The caller supplies the full mask
     (matched & live & not-padding — filter-only queries legitimately score
@@ -43,7 +43,7 @@ def masked_topk(scores: jax.Array, mask: jax.Array, k: int):
     return jax.lax.top_k(masked, k)
 
 
-@partial(jax.jit, static_argnames=("k",))
+@tracked_jit(static_argnames=("k",))
 def merge_topk(values_a: jax.Array, ids_a: jax.Array,
                values_b: jax.Array, ids_b: jax.Array, k: int):
     """Merge two top-k lists into one, re-tie-breaking by ascending id.

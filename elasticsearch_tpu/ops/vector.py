@@ -15,11 +15,11 @@ parity at 2× HBM.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from elasticsearch_tpu.telemetry.engine import tracked_jit
 
 
 def prepare_vectors(vectors: np.ndarray, similarity: str,
@@ -35,7 +35,7 @@ def prepare_vectors(vectors: np.ndarray, similarity: str,
     return prepped, norms.astype(np.float32)
 
 
-@jax.jit
+@tracked_jit
 def dot_scores(queries: jax.Array,   # [Q, D] float32
                vectors: jax.Array    # [ND, D] (bf16 or f32)
                ) -> jax.Array:       # [Q, ND] float32
@@ -46,7 +46,7 @@ def dot_scores(queries: jax.Array,   # [Q, D] float32
                       precision=jax.lax.Precision.HIGHEST)
 
 
-@jax.jit
+@tracked_jit
 def cosine_scores(queries: jax.Array,  # [Q, D] float32 (un-normalized)
                   unit_vectors: jax.Array  # [ND, D] pre-normalized slab
                   ) -> jax.Array:
@@ -55,7 +55,7 @@ def cosine_scores(queries: jax.Array,  # [Q, D] float32 (un-normalized)
     return dot_scores(q, unit_vectors)
 
 
-@jax.jit
+@tracked_jit
 def l2_scores(queries: jax.Array, vectors: jax.Array,
               doc_sq_norms: jax.Array  # [ND] float32 = ||v||²
               ) -> jax.Array:
@@ -90,7 +90,7 @@ def l2_reference(query: np.ndarray, vectors: np.ndarray) -> np.ndarray:
 # Batched kNN nomination: the serving-cohort kernel
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("similarity", "cut"))
+@tracked_jit(static_argnames=("similarity", "cut"))
 def knn_nominate_batch(queries: jax.Array,      # [Q, D] float32
                        vectors: jax.Array,      # [ND, D] slab (bf16/f32)
                        sq_norms: jax.Array,     # [ND] float32 ||v||²

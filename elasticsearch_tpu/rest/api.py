@@ -161,6 +161,8 @@ def _register_all(c: RestController):
     # recent-trace surface (telemetry/): span ring buffer + span trees
     c.register("GET", "/_traces", get_traces)
     c.register("GET", "/_traces/{trace_id}", get_trace)
+    # engine observability (telemetry/engine.py): per-kernel compile table
+    c.register("GET", "/_kernels", get_kernels)
     c.register("GET", "/_cat/indices", cat_indices)
     c.register("GET", "/_cat/health", cat_health)
     c.register("GET", "/_cat/count", cat_count)
@@ -607,14 +609,45 @@ def nodes_stats(node, params, body):
                 "slowlog_recent":
                     list(node.search_service.slowlog_recent)[-16:],
             },
+            # engine-level device stats: compile tracker rollup, HBM
+            # bytes per slab class with peak watermark, device-cache
+            # hit/miss/eviction counters (the TPU-native analogue of
+            # segment stats + IndicesQueryCache + fielddata memory)
+            "engine": _engine_section(node),
         }},
     }
 
 
+def _engine_section(node):
+    from elasticsearch_tpu.telemetry import engine as _engine
+    cache = node.indices_service.device_cache
+    out = {"compile": _engine.TRACKER.totals(),
+           **cache.engine_stats()}
+    fp = getattr(getattr(node, "_http", None), "fastpath", None)
+    if fp is not None:
+        # θ-cache of the native serving front, when one is running
+        out["caches"]["theta"] = fp.engine_cache_stats()
+    return out
+
+
+def get_kernels(node, params, body):
+    """GET /_kernels — the per-kernel compile table (telemetry/
+    engine.py): shapes seen, compiles, cumulative compile ms, and the
+    last-compile trigger. A kernel whose compile count grows with every
+    call (ever-new shape keys) is a recompile storm; a shape-disciplined
+    workload shows a flat table after warmup."""
+    from elasticsearch_tpu.telemetry import engine as _engine
+    return 200, {"kernels": _engine.TRACKER.to_dict(),
+                 "totals": _engine.TRACKER.totals()}
+
+
 def get_traces(node, params, body):
-    """GET /_traces — newest-first summaries of the recent-trace ring."""
+    """GET /_traces — newest-first summaries of the recent-trace ring;
+    ``size``/``from`` page through it."""
     limit = int(params.get("size", 32))
-    return 200, {"traces": node.telemetry.tracer.recent_traces(limit)}
+    offset = int(params.get("from", 0))
+    return 200, {"traces":
+                 node.telemetry.tracer.recent_traces(limit, offset)}
 
 
 def get_trace(node, params, body, trace_id):

@@ -126,6 +126,14 @@ class DeviceSegmentCache:
         from collections import OrderedDict
         self.plan_cache: "OrderedDict[tuple, object]" = OrderedDict()
         self.plan_cache_max = 512
+        # engine observability: plan-cache counters (incremented by the
+        # searcher, the cache's only client) + the node-level HBM peak
+        # watermark, refreshed on every DeviceSegment build and on every
+        # stats read
+        self.plan_cache_hits = 0
+        self.plan_cache_misses = 0
+        self.plan_cache_evictions = 0
+        self.peak_hbm_bytes = 0
 
     def get(self, segment: Segment) -> DeviceSegment:
         with self._lock:
@@ -140,6 +148,8 @@ class DeviceSegmentCache:
                     return dev
             dev = DeviceSegment(segment, self._device, self._vector_dtype)
             self._cache[segment.name] = (segment.live_version, dev)
+            total = sum(d.hbm_bytes() for _v, d in self._cache.values())
+            self.peak_hbm_bytes = max(self.peak_hbm_bytes, total)
             return dev
 
     def evict(self, names) -> None:
@@ -154,3 +164,60 @@ class DeviceSegmentCache:
             for name in list(self._cache):
                 if name not in names:
                     del self._cache[name]
+
+    # -- engine observability (the `engine` stats rollup) -----------------
+
+    def _devices(self, segment_names=None) -> Dict[str, DeviceSegment]:
+        with self._lock:
+            devs = {name: dev for name, (_v, dev) in self._cache.items()}
+        if segment_names is not None:
+            devs = {n: d for n, d in devs.items() if n in segment_names}
+        return devs
+
+    def hbm_stats(self, segment_names=None) -> Dict[str, object]:
+        """HBM bytes rolled up over live DeviceSegments, per slab class.
+
+        ``segment_names=None`` is the node-level view and refreshes the
+        peak watermark; a name set gives the per-index/per-shard slice
+        (its peak is tracked by the owner — IndexService.stats())."""
+        from elasticsearch_tpu.ops.device import HBM_SLAB_CLASSES
+        devs = self._devices(segment_names)
+        by_class = dict.fromkeys(HBM_SLAB_CLASSES, 0)
+        total = 0
+        for dev in devs.values():
+            for cls, n in dev.hbm_bytes_by_class().items():
+                by_class[cls] = by_class.get(cls, 0) + n
+                total += n
+        out: Dict[str, object] = {"total_bytes": total,
+                                  "by_class": by_class,
+                                  "segments": len(devs)}
+        if segment_names is None:
+            self.peak_hbm_bytes = max(self.peak_hbm_bytes, total)
+            out["peak_bytes"] = self.peak_hbm_bytes
+        return out
+
+    def cache_stats(self, segment_names=None) -> Dict[str, object]:
+        """Device-cache counters aggregated over live DeviceSegments
+        (+ the compiled-plan memo, which is cache-global and only
+        reported on the unfiltered node-level view)."""
+        agg: Dict[str, Dict[str, int]] = {}
+        for dev in self._devices(segment_names).values():
+            for cache_name, stats in dev.cache_stats().items():
+                bucket = agg.setdefault(cache_name, {})
+                for k, v in stats.items():
+                    bucket[k] = bucket.get(k, 0) + v
+        agg.setdefault("filter_mask", {"hits": 0, "misses": 0,
+                                       "evictions": 0, "entries": 0,
+                                       "bytes": 0})
+        agg.setdefault("bound_plan", {"hits": 0, "misses": 0,
+                                      "evictions": 0, "entries": 0})
+        if segment_names is None:
+            agg["plan"] = {"hits": self.plan_cache_hits,
+                           "misses": self.plan_cache_misses,
+                           "evictions": self.plan_cache_evictions,
+                           "entries": len(self.plan_cache)}
+        return agg
+
+    def engine_stats(self, segment_names=None) -> Dict[str, object]:
+        return {"hbm": self.hbm_stats(segment_names),
+                "caches": self.cache_stats(segment_names)}

@@ -184,7 +184,23 @@ class FastPathServer:
         self._reg: Optional[dict] = None   # {index, field, epoch, dp, ...}
         self._gen = 0
         self._warm = False
-        self.stats = {"cohorts": 0, "fast_queries": 0, "bounced": 0}
+        self.stats = {"cohorts": 0, "fast_queries": 0, "bounced": 0,
+                      # θ-cache (essential-lane admission) counters —
+                      # the engine-stats `caches.theta` surface
+                      "theta_hits": 0, "theta_misses": 0,
+                      "theta_stores": 0}
+
+    def engine_cache_stats(self) -> dict:
+        """θ-cache counters for the `engine.caches.theta` stats surface
+        (rest/api.py nodes_stats): lane-admission hits/misses, stored
+        thresholds, and the live entry count of the current
+        registration (cleared with the registration on refresh)."""
+        reg = self._reg
+        theta = reg.get("theta") if reg is not None else None
+        return {"hits": self.stats.get("theta_hits", 0),
+                "misses": self.stats.get("theta_misses", 0),
+                "stores": self.stats.get("theta_stores", 0),
+                "entries": len(theta) if theta is not None else 0}
 
     # ------------------------------------------------------------ lifecycle
     def start(self):
@@ -955,7 +971,10 @@ class FastPathServer:
         key = (tuple(term_ids), filt, k)
         hit = reg["theta"].get(key)
         if hit is None:
+            self.stats["theta_misses"] = \
+                self.stats.get("theta_misses", 0) + 1
             return None
+        self.stats["theta_hits"] = self.stats.get("theta_hits", 0) + 1
         theta, total = hit
         if key in reg["ess_bad"]:
             # certificate already failed once for this query — the
@@ -1253,6 +1272,8 @@ class FastPathServer:
             # this query on this immutable registration
             reg["theta"][(tuple(term_ids), filt, k)] = (
                 float(v[-1]), total)
+            self.stats["theta_stores"] = \
+                self.stats.get("theta_stores", 0) + 1
         h = self.front.h
         if h is None:
             return

@@ -128,11 +128,14 @@ class ShardSearcher:
                       self.epoch, self.k1, self.b, cache_key)
             cached = self.cache.plan_cache.get(lp_key, _MISS)
             if cached is not _MISS:
+                self.cache.plan_cache_hits += 1
                 if cached is not None:
                     return self._plan_query_phase(
                         query, cached, k, track_total_hits, plan_after,
                         cache_key=lp_key)
                 plannable = False   # known not plannable: dense path
+            else:
+                self.cache.plan_cache_misses += 1
 
         from elasticsearch_tpu.search import profile as _prof
         with _prof.span("rewrite"):
@@ -148,6 +151,7 @@ class ShardSearcher:
                 pc[lp_key] = plan
                 while len(pc) > self.cache.plan_cache_max:
                     pc.popitem(last=False)
+                    self.cache.plan_cache_evictions += 1
             if plan is not None:
                 return self._plan_query_phase(query, plan, k,
                                               track_total_hits, plan_after,
@@ -290,7 +294,11 @@ class ShardSearcher:
                 bkey = (cache_key, k, allow_prune,
                         ctx.segment.live_version)
                 bp = ctx.device._bound_plans.get(bkey)
+                if bp is not None:
+                    ctx.device.bound_plan_hits += 1
             if bp is None:
+                if bkey is not None:
+                    ctx.device.bound_plan_misses += 1
                 if not query.can_match(ctx):
                     continue
                 with _prof.span("bind"):
@@ -301,6 +309,7 @@ class ShardSearcher:
                     bpc[bkey] = bp
                     while len(bpc) > 128:
                         bpc.popitem(last=False)
+                        ctx.device.bound_plan_evictions += 1
             lower_bound = lower_bound or bp.pruned
             with _prof.span("launch"):
                 if self.batcher is not None:

@@ -32,10 +32,18 @@ class Telemetry:
 
     def __init__(self, node: str = "",
                  clock: Optional[Callable[[], float]] = None,
-                 max_traces: int = 128):
+                 max_traces: int = 128,
+                 max_spans_per_trace: int = 512):
         self.node = node
         self.metrics = MetricsRegistry(clock=clock)
-        self.tracer = Tracer(clock=clock, node=node, max_traces=max_traces)
+        self.tracer = Tracer(clock=clock, node=node, max_traces=max_traces,
+                             max_spans_per_trace=max_spans_per_trace)
+        # engine observability: this node's registry receives
+        # `engine.compile.count` / `engine.compile.ms` from the
+        # process-global compile tracker (telemetry/engine.py) — the
+        # sink set is weak, so a closed node drops out on its own
+        from elasticsearch_tpu.telemetry import engine as _engine
+        _engine.TRACKER.add_sink(self.metrics)
         metrics = self.metrics
 
         def _sink(stage: str, nanos: int) -> None:
@@ -57,6 +65,7 @@ class Telemetry:
             "traces": {
                 "count": len(self.tracer._traces),
                 "open_spans": len(self.tracer.open_spans()),
+                "dropped_spans": self.tracer.dropped_spans_total,
             },
         }
 

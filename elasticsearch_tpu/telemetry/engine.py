@@ -1,0 +1,336 @@
+"""Engine-level device observability: the compile tracker.
+
+Shape discipline is the make-or-break TPU concern (SURVEY.md §7 "hard
+parts" #2): every jit entry point compiles once PER SHAPE, and the whole
+engine design (DOC_PAD, power-of-two block buckets in ``ops/device.py``,
+the NB bucket ladder in ``search/fastpath.py``) exists to bound the
+number of distinct shapes. Until now nothing could *see* a violation — a
+recompile storm (one kernel, ever-new shape keys) looked exactly like a
+slow device.
+
+``tracked_jit`` replaces a bare ``jax.jit`` on the ops/ entry points: it
+derives a **shape-bucket key** from the call (array args → shape+dtype,
+static args → value) and records the wall time of each first execution
+per key — compile + first dispatch — into the process-global ``TRACKER``.
+The table is process-global on purpose: the XLA compile cache it mirrors
+is process-global too (one jit cache serves every node a test boots in
+this process).
+
+Surfaces: ``GET /_kernels`` (per-kernel table: shapes seen, compiles,
+cumulative ms, last-compile trigger), the ``engine.compile`` block of
+``GET /_nodes/stats``, and ``engine.compile.count`` /
+``engine.compile.ms`` metrics on every live ``MetricsRegistry``
+registered as a sink (each node's ``Telemetry`` registers its own, so a
+recompile storm shows up in per-node metrics even though the jit cache
+is shared).
+
+Timing uses the real wall clock (``time.perf_counter``), NOT the
+injectable telemetry clock: XLA compiles happen in real time even under
+the deterministic harness, and compile counts — the replay-relevant
+signal — are deterministic for a deterministic workload anyway.
+
+Hot-path cost per tracked call: one tuple build over the args + one
+lock-guarded dict probe (~µs), against launches that cost ms.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["CompileTracker", "TRACKER", "tracked_jit"]
+
+
+# -- shape keys -------------------------------------------------------------
+
+def _dyn_desc(value) -> tuple:
+    """Describe a dynamic (traced) argument the way jit's cache keys it:
+    arrays by shape+dtype, containers element-wise, scalars collapse to
+    one marker (python scalars are weakly typed — their VALUE never
+    triggers a recompile)."""
+    shape = getattr(value, "shape", None)
+    if shape is not None:
+        return (tuple(int(s) for s in shape),
+                str(getattr(value, "dtype", "?")))
+    if isinstance(value, (tuple, list)):
+        return ("seq", tuple(_dyn_desc(v) for v in value))
+    if value is None or isinstance(value, (bool, int, float, complex)):
+        # the TYPE still keys (a python int traces weak-i32, a float
+        # weak-f32 — flipping between them recompiles), only the VALUE
+        # doesn't
+        return ("scalar", type(value).__name__)
+    return (type(value).__name__,)
+
+
+def _static_desc(value) -> Any:
+    """Statics key by value (jit hashes them); unhashable statics fall
+    back to identity — the same object is the same compile."""
+    try:
+        hash(value)
+        return value
+    except TypeError:
+        return f"<{type(value).__name__}#{id(value):x}>"
+
+
+def _component(pname: str, value, is_static: bool) -> tuple:
+    if is_static:
+        return (pname, "static", _static_desc(value))
+    return (pname,) + _dyn_desc(value)
+
+
+def _fmt_component(comp: tuple) -> str:
+    pname = comp[0]
+    if len(comp) >= 2 and comp[1] == "static":
+        return f"{pname}={comp[2]!r}"
+    if len(comp) == 3 and isinstance(comp[1], tuple):
+        dims = "x".join(str(d) for d in comp[1])
+        return f"{pname}[{dims}]{comp[2]}"
+    if len(comp) == 3 and comp[1] == "scalar":
+        return f"{pname}:{comp[2]}"
+    return f"{pname}:{comp[1]}"
+
+
+def format_key(key: tuple) -> str:
+    """Human-readable shape-bucket key for the ``_kernels`` table —
+    arrays and statics only (scalar VALUES can't trigger recompiles;
+    a scalar TYPE flip still shows up in the last-compile trigger)."""
+    return " ".join(_fmt_component(c) for c in key
+                    if not (len(c) >= 2 and c[1] == "scalar"))
+
+
+def _diff_trigger(prev: Optional[tuple], key: tuple) -> str:
+    """What changed vs the previous compile of this kernel — the
+    'last-compile trigger' column. Detects the storm signature (the
+    same arg flapping through ever-new shapes) at a glance."""
+    if prev is None:
+        return "cold"
+    changed = []
+    for a, b in zip(prev, key):
+        if a != b:
+            changed.append(f"{_fmt_component(a)} -> {_fmt_component(b)}")
+    if len(prev) != len(key):
+        changed.append(f"arity {len(prev)} -> {len(key)}")
+    return "; ".join(changed) if changed else "new shape"
+
+
+# -- the tracker ------------------------------------------------------------
+
+class _Kernel:
+    __slots__ = ("name", "calls", "compiles", "cum_ms", "shapes",
+                 "last_key", "last_ms", "last_trigger")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.calls = 0
+        self.compiles = 0
+        self.cum_ms = 0.0
+        # key -> first-execution ms (None while the timing is in flight)
+        self.shapes: Dict[tuple, Optional[float]] = {}
+        self.last_key: Optional[tuple] = None
+        self.last_ms: Optional[float] = None
+        self.last_trigger: Optional[str] = None
+
+
+class CompileTracker:
+    """Thread-safe per-kernel compile table + metric-sink fan-out."""
+
+    MAX_SHAPES_LISTED = 16   # per-kernel cap in to_dict (table stays small)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._kernels: Dict[str, _Kernel] = {}
+        # live metric registries (each node's Telemetry adds its own);
+        # weak so closed nodes never pin their registries process-wide
+        self._sinks: "weakref.WeakSet" = weakref.WeakSet()
+
+    def add_sink(self, metrics) -> None:
+        self._sinks.add(metrics)
+
+    # -- record path (called by tracked_jit wrappers) ----------------------
+
+    def on_call(self, kernel: str, key: tuple) -> bool:
+        """Count a call; True when ``key`` is new for ``kernel`` (the
+        caller then times the execution and reports on_compile)."""
+        with self._lock:
+            k = self._kernels.get(kernel)
+            if k is None:
+                k = self._kernels[kernel] = _Kernel(kernel)
+            k.calls += 1
+            if key in k.shapes:
+                return False
+            k.shapes[key] = None    # reserve: concurrent racers record once
+            return True
+
+    def on_error(self, kernel: str, key: tuple) -> None:
+        """First execution for a reserved key raised: un-reserve it so a
+        later successful retry is timed and counted as the compile it
+        is (a still-None reservation would otherwise hide it forever)."""
+        with self._lock:
+            k = self._kernels.get(kernel)
+            if k is not None and k.shapes.get(key, 0) is None:
+                del k.shapes[key]
+
+    def on_compile(self, kernel: str, key: tuple, ms: float) -> None:
+        with self._lock:
+            k = self._kernels[kernel]
+            trigger = _diff_trigger(k.last_key, key)
+            k.shapes[key] = ms
+            k.compiles += 1
+            k.cum_ms += ms
+            k.last_key, k.last_ms, k.last_trigger = key, ms, trigger
+            sinks = [s for s in self._sinks]
+        for m in sinks:
+            try:
+                m.inc("engine.compile.count")
+                m.inc("engine.compile.ms", ms)
+            except Exception:   # noqa: BLE001 — a dying registry never
+                pass            # breaks a kernel launch
+
+    # -- read path ---------------------------------------------------------
+
+    def totals(self) -> Dict[str, Any]:
+        """The ``engine.compile`` rollup for ``_nodes/stats``."""
+        with self._lock:
+            kernels = list(self._kernels.values())
+            return {
+                "count": sum(k.compiles for k in kernels),
+                "ms": round(sum(k.cum_ms for k in kernels), 3),
+                "calls": sum(k.calls for k in kernels),
+                "kernels": len(kernels),
+            }
+
+    def total_compiles(self) -> int:
+        with self._lock:
+            return sum(k.compiles for k in self._kernels.values())
+
+    def compiles_of(self, kernel: str) -> int:
+        with self._lock:
+            k = self._kernels.get(kernel)
+            return k.compiles if k is not None else 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        """The ``GET /_kernels`` table: per kernel, shapes seen /
+        compiles / cumulative ms / last-compile trigger. A kernel whose
+        ``compiles`` keeps pace with ``calls`` across ever-new shape
+        keys IS a recompile storm — the table makes it legible."""
+        with self._lock:
+            out: Dict[str, Any] = {}
+            for name in sorted(self._kernels):
+                k = self._kernels[name]
+                shapes = [
+                    {"key": format_key(key),
+                     "ms": round(ms, 3) if ms is not None else None}
+                    for key, ms in list(k.shapes.items())
+                    [-self.MAX_SHAPES_LISTED:]]
+                out[name] = {
+                    "calls": k.calls,
+                    "compiles": k.compiles,
+                    "shapes_seen": len(k.shapes),
+                    "cum_ms": round(k.cum_ms, 3),
+                    "last_compile": {
+                        "key": (format_key(k.last_key)
+                                if k.last_key is not None else None),
+                        "ms": (round(k.last_ms, 3)
+                               if k.last_ms is not None else None),
+                        "trigger": k.last_trigger,
+                    },
+                    "shapes": shapes,
+                }
+            return out
+
+    def reset(self) -> None:
+        """Test hook. The jit caches survive a reset, so re-seen shapes
+        re-record as (instant) compiles — fine for delta assertions."""
+        with self._lock:
+            self._kernels.clear()
+
+
+# THE tracker — process-global, like the XLA jit cache it mirrors.
+TRACKER = CompileTracker()
+
+
+# -- the decorator ----------------------------------------------------------
+
+_trace_state_clean: Optional[Callable[[], bool]] = None
+
+
+def _resolve_trace_clean() -> Callable[[], bool]:
+    """``True`` when not under an outer jit trace — a tracked kernel
+    called at trace time is part of the OUTER kernel's compile, not a
+    device launch of its own."""
+    global _trace_state_clean
+    if _trace_state_clean is None:
+        try:
+            import jax
+            _trace_state_clean = jax.core.trace_state_clean
+        except Exception:   # noqa: BLE001 — very old/new jax: track all
+            _trace_state_clean = lambda: True   # noqa: E731
+    return _trace_state_clean
+
+
+def tracked_jit(name: Optional[str] = None, *,
+                static_argnames: Tuple[str, ...] = (), **jit_kwargs):
+    """``jax.jit`` + first-execution-per-shape recording into TRACKER.
+
+    Drop-in for ``@partial(jax.jit, static_argnames=...)`` on ops/
+    entry points::
+
+        @tracked_jit("bm25_topk_total_batch",
+                     static_argnames=("k1", "b", "k"))
+        def bm25_topk_total_batch(...): ...
+
+    The wrapper derives the shape-bucket key from the call signature
+    (array args by shape+dtype, statics by value), consults the global
+    TRACKER, and times the first execution per key. Calls made while an
+    outer jit is tracing pass straight through untracked.
+    """
+    def deco(fn):
+        import jax
+        jitted = jax.jit(fn, static_argnames=static_argnames,
+                         **jit_kwargs)
+        kname = name or fn.__name__.lstrip("_")
+        try:
+            params: List[str] = list(inspect.signature(fn).parameters)
+        except (TypeError, ValueError):
+            params = []
+        statics = frozenset(
+            (static_argnames,) if isinstance(static_argnames, str)
+            else static_argnames)
+        trace_clean = _resolve_trace_clean()
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not trace_clean():
+                return jitted(*args, **kwargs)
+            parts = [_component(p, a, p in statics)
+                     for p, a in zip(params, args)]
+            if len(args) > len(params):     # *args overflow: positional
+                parts.extend(_component(f"arg{i}", a, False)
+                             for i, a in enumerate(args[len(params):]))
+            for p in sorted(kwargs):
+                parts.append(_component(p, kwargs[p], p in statics))
+            key = tuple(parts)
+            if not TRACKER.on_call(kname, key):
+                return jitted(*args, **kwargs)
+            t0 = time.perf_counter()
+            try:
+                out = jitted(*args, **kwargs)
+            except BaseException:
+                TRACKER.on_error(kname, key)
+                raise
+            TRACKER.on_compile(kname, key,
+                               (time.perf_counter() - t0) * 1000.0)
+            return out
+
+        wrapper.kernel_name = kname
+        wrapper.__wrapped_jit__ = jitted
+        return wrapper
+
+    if callable(name):      # bare @tracked_jit
+        fn, name = name, None
+        return deco(fn)
+    return deco

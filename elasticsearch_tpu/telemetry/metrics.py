@@ -29,8 +29,13 @@ import time
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-# default latency buckets, in milliseconds (upper bounds; +inf implied)
+# default latency buckets, in milliseconds (upper bounds; +inf implied).
+# The sub-millisecond decades exist for DEVICE stages: on a fast query,
+# launch/readback/topk land in the 1-500µs range, and without them every
+# `search.stage.*` observation collapsed into the lowest ms bucket —
+# making the histograms blind exactly where the device path is fastest.
 DEFAULT_BUCKETS_MS: Tuple[float, ...] = (
+    0.001, 0.005, 0.01, 0.05,
     0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0, 500.0,
     1000.0, 5000.0, 10000.0, 30000.0)
 

@@ -15,7 +15,10 @@ Design for the deterministic harness:
 - the clock is injectable, so span timestamps read virtual time under
   simulation;
 - finished spans land in a bounded per-trace ring (oldest trace evicted
-  when ``max_traces`` root traces are held) served by ``GET /_traces``;
+  when ``max_traces`` root traces are held; within a trace, the oldest
+  span drops once ``max_spans_per_trace`` is reached, with the drop
+  count retained) served by ``GET /_traces`` with ``size``/``from``
+  paging — long-running nodes can't grow trace memory without limit;
 - open spans are tracked so the test harness can fail a test that
   starts a span and never finishes it (tests/conftest.py leak guard).
 """
@@ -89,15 +92,24 @@ class Tracer:
     """Per-node span factory + bounded recent-trace store."""
 
     def __init__(self, clock: Optional[Callable[[], float]] = None,
-                 node: str = "", max_traces: int = 128):
+                 node: str = "", max_traces: int = 128,
+                 max_spans_per_trace: int = 512):
         self.clock = clock or time.monotonic
         self.node = node
         self.max_traces = max_traces
+        # span retention ring: a trace holding max_spans_per_trace
+        # finished spans drops its OLDEST span per new arrival, so a
+        # long-running node's pathological trace (a retry loop, a
+        # runaway scroll) can't grow trace memory without limit; the
+        # drop count stays visible on the trace
+        self.max_spans_per_trace = max_spans_per_trace
         self._lock = threading.Lock()
         self._trace_seq = 0
         self._span_seq = 0
         # trace_id -> finished span dicts, insertion-ordered for eviction
         self._traces: "OrderedDict[str, List[Dict]]" = OrderedDict()
+        self._dropped: Dict[str, int] = {}
+        self.dropped_spans_total = 0
         self._open: Dict[str, Span] = {}
         _TRACERS.add(self)
 
@@ -139,13 +151,20 @@ class Tracer:
             bucket = []
             self._traces[trace_id] = bucket
             while len(self._traces) > self.max_traces:
-                self._traces.popitem(last=False)
+                evicted, _spans = self._traces.popitem(last=False)
+                self._dropped.pop(evicted, None)
         return bucket
 
     def _on_finish(self, span: Span) -> None:
         with self._lock:
             self._open.pop(span.span_id, None)
-            self._bucket_locked(span.trace_id).append(span.to_dict())
+            bucket = self._bucket_locked(span.trace_id)
+            bucket.append(span.to_dict())
+            if len(bucket) > self.max_spans_per_trace:
+                bucket.pop(0)
+                self._dropped[span.trace_id] = \
+                    self._dropped.get(span.trace_id, 0) + 1
+                self.dropped_spans_total += 1
 
     # -- queries (REST surface) -------------------------------------------
 
@@ -153,14 +172,19 @@ class Tracer:
         with self._lock:
             return list(self._open.values())
 
-    def recent_traces(self, limit: int = 32) -> List[Dict[str, Any]]:
-        """Newest-first summaries for ``GET /_traces``."""
+    def recent_traces(self, limit: int = 32,
+                      offset: int = 0) -> List[Dict[str, Any]]:
+        """Newest-first summaries for ``GET /_traces``; ``offset``
+        (the ``from`` param) skips the newest entries so a bounded ring
+        is still pageable."""
         with self._lock:
             entries = list(self._traces.items())
+            dropped = dict(self._dropped)
+        newest_first = list(reversed(entries))
         out = []
-        for trace_id, spans in reversed(entries[-limit:]):
+        for trace_id, spans in newest_first[offset:offset + limit]:
             roots = [s for s in spans if s["parent_id"] is None]
-            out.append({
+            summary = {
                 "trace_id": trace_id,
                 "root": roots[0]["name"] if roots else
                         (spans[0]["name"] if spans else None),
@@ -169,7 +193,10 @@ class Tracer:
                                      for s in spans), default=0.0)
                                 - min((s["start_ms"] for s in spans),
                                       default=0.0)),
-            })
+            }
+            if dropped.get(trace_id):
+                summary["dropped_spans"] = dropped[trace_id]
+            out.append(summary)
         return out
 
     def trace(self, trace_id: str) -> Optional[Dict[str, Any]]:
@@ -177,6 +204,7 @@ class Tracer:
         with self._lock:
             spans = self._traces.get(trace_id)
             spans = [dict(s) for s in spans] if spans is not None else None
+            dropped = self._dropped.get(trace_id, 0)
         if spans is None:
             return None
         spans.sort(key=lambda s: (s["start_ms"], s["span_id"]))
@@ -189,4 +217,7 @@ class Tracer:
                 parent["children"].append(node)
             else:
                 roots.append(node)
-        return {"trace_id": trace_id, "spans": spans, "tree": roots}
+        out = {"trace_id": trace_id, "spans": spans, "tree": roots}
+        if dropped:
+            out["dropped_spans"] = dropped
+        return out

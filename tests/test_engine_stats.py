@@ -1,0 +1,357 @@
+"""Engine-level device observability (telemetry/engine.py + the HBM /
+device-cache accounting in ops/device.py): the compile tracker's
+shape-discipline contract, HBM slab accounting vs live DeviceSegments,
+filter-mask LRU eviction visibility, and the cluster engine-stats
+fan-out."""
+
+import numpy as np
+import pytest
+
+import elasticsearch_tpu.ops.device as device_mod
+from elasticsearch_tpu.index.mapper import MapperService
+from elasticsearch_tpu.index.segment import SegmentWriter
+from elasticsearch_tpu.ops.device import DeviceSegment
+from elasticsearch_tpu.search.context import DeviceSegmentCache
+from elasticsearch_tpu.search.queries import parse_query
+from elasticsearch_tpu.search.searcher import ShardSearcher
+from elasticsearch_tpu.telemetry.engine import TRACKER
+
+MAPPINGS = {
+    "properties": {
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "n": {"type": "long"},
+    }
+}
+
+WORDS = ["alpha", "beta", "gamma", "delta", "fox", "dog", "wolf",
+         "lake", "hill", "tree"]
+
+
+def build_segment(n_docs=60, name="seg0", seed=3):
+    rng = np.random.default_rng(seed)
+    svc = MapperService(mappings=MAPPINGS)
+    w = SegmentWriter()
+    for i in range(n_docs):
+        w.add(svc.parse(str(i), {
+            "body": " ".join(rng.choice(WORDS, 6)),
+            "tag": str(rng.choice(["red", "green", "blue"])),
+            "n": int(i)}))
+    return w.build(name), svc
+
+
+# ---------------------------------------------------------------------------
+# HBM accounting
+# ---------------------------------------------------------------------------
+
+def test_hbm_by_class_sums_to_total():
+    seg, _svc = build_segment()
+    dev = DeviceSegment(seg)
+    by_class = dev.hbm_bytes_by_class()
+    assert set(by_class) == set(device_mod.HBM_SLAB_CLASSES)
+    assert dev.hbm_bytes() == sum(by_class.values())
+    assert by_class["postings"] > 0
+    assert by_class["norms"] > 0
+    assert by_class["live_mask"] == dev.n_docs_padded  # 1 byte per doc
+
+
+def test_cache_rollup_equals_sum_over_live_segments():
+    """The acceptance invariant: the engine section's HBM bytes equal
+    the sum over live DeviceSegments' slab sizes."""
+    cache = DeviceSegmentCache()
+    segs = [build_segment(40, f"hbm{i}", seed=i)[0] for i in range(3)]
+    devs = [cache.get(s) for s in segs]
+    stats = cache.hbm_stats()
+    assert stats["segments"] == 3
+    assert stats["total_bytes"] == sum(d.hbm_bytes() for d in devs)
+    assert stats["peak_bytes"] >= stats["total_bytes"]
+    # eviction returns bytes AND the peak watermark remembers the high
+    cache.evict([segs[0].name])
+    stats2 = cache.hbm_stats()
+    assert stats2["total_bytes"] == sum(d.hbm_bytes() for d in devs[1:])
+    assert stats2["total_bytes"] < stats["total_bytes"]
+    assert stats2["peak_bytes"] >= stats["total_bytes"]
+
+
+def test_filter_mask_bytes_show_up_in_accounting():
+    seg, _svc = build_segment()
+    dev = DeviceSegment(seg)
+    before = dev.hbm_bytes_by_class()["filter_masks"]
+    dev.filter_mask("body", ("fox",))
+    after = dev.hbm_bytes_by_class()["filter_masks"]
+    assert before == 0 and after == dev.n_docs_padded
+
+
+# ---------------------------------------------------------------------------
+# filter-mask LRU eviction (satellite: fill past the cap)
+# ---------------------------------------------------------------------------
+
+def test_filter_mask_lru_eviction(monkeypatch):
+    monkeypatch.setattr(device_mod, "FILTER_MASK_CACHE_MAX", 4)
+    seg, _svc = build_segment()
+    dev = DeviceSegment(seg)
+    # fill past the cap with distinct single-term keys
+    for i, word in enumerate(WORDS[:6]):
+        dev.filter_mask("body", (word,))
+    cs = dev.cache_stats()["filter_mask"]
+    assert cs["misses"] == 6
+    assert cs["evictions"] == 2
+    assert cs["entries"] == 4
+    bytes_at_cap = cs["bytes"]
+    # byte accounting decreases when the cap tightens further
+    monkeypatch.setattr(device_mod, "FILTER_MASK_CACHE_MAX", 2)
+    dev.filter_mask("body", ("lake", "hill"))     # new key -> trims to 2
+    cs = dev.cache_stats()["filter_mask"]
+    assert cs["entries"] == 2
+    assert cs["bytes"] < bytes_at_cap
+    assert cs["evictions"] == 2 + 3              # 5 total now
+    # the oldest keys were evicted: re-querying one is a miss that
+    # re-populates, and the SAME query straight after is a hit
+    misses0, hits0 = cs["misses"], cs["hits"]
+    m1 = dev.filter_mask("body", (WORDS[0],))
+    cs = dev.cache_stats()["filter_mask"]
+    assert cs["misses"] == misses0 + 1
+    m2 = dev.filter_mask("body", (WORDS[0],))
+    cs = dev.cache_stats()["filter_mask"]
+    assert cs["hits"] == hits0 + 1
+    assert m1[0] is m2[0]                        # identical device column
+    np.testing.assert_array_equal(m1[1], m2[1])
+
+
+# ---------------------------------------------------------------------------
+# compile tracker: shape discipline
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def searcher():
+    seg, svc = build_segment(80, "cmp0", seed=11)
+    return ShardSearcher([seg], svc, DeviceSegmentCache())
+
+
+def test_fixed_shape_workload_compile_count_flat(searcher):
+    """A fixed-shape query workload must show engine.compile.count flat
+    after warmup — THE shape-discipline contract."""
+    q = parse_query({"match": {"body": "fox"}})
+    sort = [{"n": "desc"}]
+    searcher.query_phase(q, 23, sort=sort)        # warmup (may compile)
+    warm = TRACKER.total_compiles()
+    for _ in range(4):
+        searcher.query_phase(q, 23, sort=sort)
+    assert TRACKER.total_compiles() == warm, (
+        "identical searches recompiled a kernel:\n"
+        f"{TRACKER.to_dict()}")
+
+
+def test_bucket_busting_workload_compile_count_grows(searcher):
+    """A deliberately bucket-busting workload (a fresh static k per
+    query -> a fresh jit shape key per query) must be VISIBLE as a
+    growing compile count — the recompile-storm signal."""
+    q = parse_query({"match": {"body": "fox"}})
+    sort = [{"n": "desc"}]
+    # distinctive k values no other test plausibly used in this process
+    sizes = [311, 313, 317, 331]
+    before = TRACKER.total_compiles()
+    calls_before = TRACKER.to_dict().get("masked_topk", {}).get("calls", 0)
+    for k in sizes:
+        searcher.query_phase(q, k, sort=sort)
+    grew = TRACKER.total_compiles() - before
+    assert grew >= len(sizes), (
+        f"expected >= {len(sizes)} new compiles, saw {grew}")
+    # and the per-kernel table attributes them: same kernel, new shapes
+    entry = TRACKER.to_dict()["masked_topk"]
+    assert entry["calls"] > calls_before
+    assert entry["last_compile"]["trigger"]      # diff vs previous key
+    assert entry["shapes_seen"] >= len(sizes)
+
+
+def test_compile_table_records_kernel_shape_and_ms():
+    from elasticsearch_tpu.ops import topk as topk_ops
+    import jax.numpy as jnp
+    before = TRACKER.compiles_of("masked_topk")
+    s = jnp.asarray(np.random.default_rng(0)
+                    .random(257).astype(np.float32))
+    m = jnp.asarray(np.ones(257, bool))
+    topk_ops.masked_topk(s, m, 19)               # fresh shape
+    topk_ops.masked_topk(s, m, 19)               # repeat: no new compile
+    assert TRACKER.compiles_of("masked_topk") == before + 1
+    entry = TRACKER.to_dict()["masked_topk"]
+    keys = [sh["key"] for sh in entry["shapes"]]
+    assert any("scores[257]float32" in k and "k=19" in k for k in keys)
+    assert entry["cum_ms"] > 0
+
+
+def test_compile_metrics_reach_registered_sinks():
+    from elasticsearch_tpu.ops import topk as topk_ops
+    from elasticsearch_tpu.telemetry import Telemetry
+    import jax.numpy as jnp
+    tele = Telemetry(node="engine-test")
+    s = jnp.asarray(np.random.default_rng(1)
+                    .random(263).astype(np.float32))
+    m = jnp.asarray(np.ones(263, bool))
+    topk_ops.masked_topk(s, m, 21)               # fresh shape
+    assert tele.metrics.get_value("engine.compile.count") >= 1
+    assert tele.metrics.get_value("engine.compile.ms") > 0
+
+
+# ---------------------------------------------------------------------------
+# plan / bound-plan cache counters
+# ---------------------------------------------------------------------------
+
+def test_plan_and_bound_plan_cache_counters(searcher):
+    q = parse_query({"match": {"body": "dog"}})
+    searcher.query_phase(q, 10, cache_key="ck1")
+    assert searcher.cache.plan_cache_misses >= 1
+    hits0 = searcher.cache.plan_cache_hits
+    searcher.query_phase(q, 10, cache_key="ck1")
+    assert searcher.cache.plan_cache_hits == hits0 + 1
+    caches = searcher.cache.cache_stats()
+    assert caches["bound_plan"]["misses"] >= 1
+    assert caches["bound_plan"]["hits"] >= 1
+    assert caches["plan"]["entries"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# tracer span-retention ring (satellite)
+# ---------------------------------------------------------------------------
+
+def test_tracer_span_ring_bounds_retention():
+    from elasticsearch_tpu.telemetry.tracing import Tracer
+    t = Tracer(node="ring", max_spans_per_trace=4)
+    root = t.start_span("root")
+    for i in range(6):
+        t.start_span(f"child-{i}", parent=root).finish()
+    root.finish()
+    tr = t.trace(root.trace_id)
+    assert len(tr["spans"]) == 4
+    assert tr["dropped_spans"] == 3              # 7 finished, 4 kept
+    names = {s["name"] for s in tr["spans"]}
+    assert "child-0" not in names                # oldest dropped first
+    assert "root" in names                       # newest survive
+    summary = t.recent_traces()[0]
+    assert summary["dropped_spans"] == 3
+    assert t.dropped_spans_total == 3
+
+
+def test_recent_traces_size_and_from_paging():
+    from elasticsearch_tpu.telemetry.tracing import Tracer
+    t = Tracer(node="page")
+    ids = []
+    for i in range(5):
+        s = t.start_span(f"op-{i}")
+        ids.append(s.trace_id)
+        s.finish()
+    page0 = t.recent_traces(limit=2, offset=0)
+    page1 = t.recent_traces(limit=2, offset=2)
+    assert [p["trace_id"] for p in page0] == [ids[4], ids[3]]
+    assert [p["trace_id"] for p in page1] == [ids[2], ids[1]]
+
+
+def test_sub_ms_histogram_buckets_resolve_device_stages():
+    from elasticsearch_tpu.telemetry.metrics import Histogram
+    h = Histogram()
+    h.observe(0.002)    # a 2µs readback no longer collapses
+    h.observe(0.03)
+    h.observe(0.3)
+    b = h.to_dict()["buckets"]
+    assert b["le_0.001"] == 0
+    assert b["le_0.005"] == 1
+    assert b["le_0.05"] == 2
+    assert b["le_0.5"] == 3
+
+
+# ---------------------------------------------------------------------------
+# REST surfaces: the acceptance invariant through `GET /_nodes/stats`
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def node(tmp_path):
+    from elasticsearch_tpu.node import Node
+    n = Node(data_path=str(tmp_path / "node"))
+    yield n
+    n.close()
+
+
+def _seed_index(node, n_docs=8):
+    d = node.rest_controller.dispatch
+    assert d("PUT", "/obs", None,
+             {"settings": {"index.number_of_shards": 2}})[0] == 200
+    for i in range(n_docs):
+        d("PUT", f"/obs/_doc/{i}", {},
+          {"body": f"quick brown fox {i}", "n": i})
+    d("POST", "/obs/_refresh", None, None)
+
+
+def test_nodes_stats_engine_hbm_equals_live_device_segments(node):
+    _seed_index(node)
+    d = node.rest_controller.dispatch
+    st, _ = d("POST", "/obs/_search", {},
+              {"query": {"match": {"body": "fox"}}, "sort": [{"n": "desc"}]})
+    assert st == 200
+    st, stats = d("GET", "/_nodes/stats", {}, None)
+    assert st == 200
+    eng = next(iter(stats["nodes"].values()))["engine"]
+    cache = node.indices_service.device_cache
+    expected = sum(dev.hbm_bytes()
+                   for _v, dev in cache._cache.values())
+    assert eng["hbm"]["total_bytes"] == expected > 0
+    assert eng["hbm"]["total_bytes"] == sum(
+        eng["hbm"]["by_class"].values())
+    assert eng["hbm"]["peak_bytes"] >= eng["hbm"]["total_bytes"]
+    assert eng["compile"]["count"] >= 0
+    assert set(eng["caches"]) >= {"filter_mask", "bound_plan", "plan"}
+    # per-index slice agrees (single index: same resident segments)
+    st, idx_stats = d("GET", "/obs/_stats", {}, None)
+    assert idx_stats["indices"]["obs"]["engine"]["hbm"]["total_bytes"] \
+        == expected
+    assert sum(idx_stats["indices"]["obs"]["engine"]["hbm"]
+               ["shard_bytes"]) == expected
+
+
+def test_kernels_endpoint_stable_count_until_new_shape_bucket(node):
+    _seed_index(node)
+    d = node.rest_controller.dispatch
+    body = {"query": {"match": {"body": "fox"}}, "sort": [{"n": "desc"}],
+            "size": 5}
+    d("POST", "/obs/_search", {}, body)          # warmup
+    st, k1 = d("GET", "/_kernels", {}, None)
+    assert st == 200
+    for _ in range(3):
+        d("POST", "/obs/_search", {}, body)
+    st, k2 = d("GET", "/_kernels", {}, None)
+    assert k2["totals"]["count"] == k1["totals"]["count"], (
+        "repeated same-shape searches must not compile")
+    assert k2["totals"]["calls"] > k1["totals"]["calls"]
+    # a new shape bucket (fresh static k) increments the count
+    d("POST", "/obs/_search", {},
+      {**body, "size": 347})
+    st, k3 = d("GET", "/_kernels", {}, None)
+    assert k3["totals"]["count"] > k2["totals"]["count"]
+    assert "masked_topk" in k3["kernels"]
+
+
+# ---------------------------------------------------------------------------
+# cluster fan-out
+# ---------------------------------------------------------------------------
+
+def test_cluster_engine_stats_fan_out(tmp_path):
+    from test_cluster_node import SimDataCluster, _index_some_docs
+    cluster = SimDataCluster(3, tmp_path, seed=23)
+    master = cluster.stabilise()
+    cluster.call(master.create_index, "logs", 2, 1)
+    cluster.run_for(30)
+    _index_some_docs(cluster, master)
+    # a search populates the data nodes' device caches
+    r = cluster.call(master.search, "logs",
+                     {"query": {"match": {"body": "fox"}}})
+    assert r["hits"]["total"]["value"] > 0
+    stats = cluster.call(master.nodes_engine_stats)
+    assert len(stats["nodes"]) == 3
+    per_node = [s for s in stats["nodes"].values() if "error" not in s]
+    assert per_node, stats
+    assert stats["total_hbm_bytes"] == sum(
+        s["hbm"]["total_bytes"] for s in per_node)
+    assert stats["total_hbm_bytes"] > 0          # something is resident
+    for s in per_node:
+        assert s["hbm"]["total_bytes"] == sum(
+            s["hbm"]["by_class"].values())
+        assert "compile" in s and "caches" in s
