@@ -77,7 +77,7 @@ def remaining_budget() -> float:
 
 
 def emit(metric_text: str, value: float, vs_baseline: float,
-         engine=None):
+         engine=None, overload=None):
     _LAST_PAYLOAD.clear()
     _LAST_PAYLOAD.update({
         "metric": metric_text,
@@ -92,7 +92,34 @@ def emit(metric_text: str, value: float, vs_baseline: float,
         # regressions (a shape-discipline break shows as compile counts
         # growing round over round) alongside latency
         _LAST_PAYLOAD["engine"] = engine
+    if overload:
+        # backpressure rider: breaker trip counts + peak in-flight
+        # indexing bytes on the serving node. The standard workload must
+        # show tripped == 0 everywhere — a nonzero count here means a
+        # limit regression started shedding healthy traffic
+        _LAST_PAYLOAD["overload"] = overload
     print(json.dumps(_LAST_PAYLOAD), flush=True)
+
+
+def _overload_snapshot(node) -> dict:
+    """Breaker trips + indexing-pressure peaks of the serving node for
+    the BENCH json `overload` key."""
+    out = {}
+    try:
+        breakers = node.breaker_service.stats()
+        out["breaker_tripped"] = {name: s["tripped"]
+                                  for name, s in breakers.items()}
+        out["breaker_tripped_total"] = sum(out["breaker_tripped"].values())
+        ip = node.indexing_pressure.stats()["memory"]
+        out["indexing_peak_all_in_bytes"] = \
+            ip["total"]["peak_all_in_bytes"]
+        out["indexing_rejections"] = (
+            ip["total"]["coordinating_rejections"]
+            + ip["total"]["primary_rejections"]
+            + ip["total"]["replica_rejections"])
+    except Exception:   # noqa: BLE001 — stats must never kill the bench
+        pass
+    return out
 
 
 def _engine_snapshot(parts: dict) -> dict:
@@ -907,7 +934,8 @@ def run_rest_path(corpus, queries, truth, tmpdir, kernel="auto",
                 f"{remaining_budget():.0f}s left)")
         if emit_cb is not None:
             emit_cb(hbm_peak_bytes=node.indices_service.device_cache
-                    .hbm_stats().get("peak_bytes", 0))
+                    .hbm_stats().get("peak_bytes", 0),
+                    overload=_overload_snapshot(node))
         node.close()
         return (best_qps, p50, p99, rest_recall, warm_recall, avg_batch,
                 bool_qps, extra)
@@ -971,10 +999,13 @@ def run_rest_path(corpus, queries, truth, tmpdir, kernel="auto",
              check=lambda r: len(r["hits"]["hits"]) > 0)
 
     if emit_cb is not None:
-        # HBM peak of the serving node's device cache, recorded into the
-        # BENCH json's engine rider before the node goes away
+        # HBM peak of the serving node's device cache + backpressure
+        # snapshot, recorded into the BENCH json before the node goes
+        # away (overload.breaker_tripped must stay all-zero on the
+        # standard workload)
         emit_cb(hbm_peak_bytes=node.indices_service.device_cache
-                .hbm_stats().get("peak_bytes", 0))
+                .hbm_stats().get("peak_bytes", 0),
+                overload=_overload_snapshot(node))
     node.close()
     return (best_qps, p50, p99, rest_recall, warm_recall, avg_batch,
             bool_qps, extra)
@@ -1198,7 +1229,8 @@ def main():
         cpu = parts.get("cpu_qps") or 0.0
         emit(compose_metric(parts), value,
              value / cpu if cpu else float("nan"),
-             engine=_engine_snapshot(parts))
+             engine=_engine_snapshot(parts),
+             overload=parts.get("overload"))
 
     rng = np.random.default_rng(12345)
     corpus = build_corpus(rng)

@@ -381,7 +381,13 @@ class Coordinator:
             (FOLLOWER_CHECK_ACTION, self._on_follower_check),
             (LEADER_CHECK_ACTION, self._on_leader_check),
         ]:
-            transport.register_request_handler(action, self._locked(handler))
+            # cluster-coordination traffic is exempt from the
+            # in_flight_requests breaker (ref: TransportService marks
+            # internal cluster actions canTripCircuitBreaker=false): an
+            # overloaded node must still elect masters and ack publishes
+            transport.register_request_handler(action,
+                                               self._locked(handler),
+                                               can_trip_breaker=False)
 
     # -------------------------------------------------------- concurrency
 

@@ -35,8 +35,16 @@ from elasticsearch_tpu.cluster.state import (
     ClusterState,
     ShardRouting,
 )
+from elasticsearch_tpu.common.errors import (
+    EsRejectedExecutionException,
+    is_backpressure_failure,
+)
 from elasticsearch_tpu.index.engine import Engine
 from elasticsearch_tpu.index.mapper import MapperService
+from elasticsearch_tpu.index.pressure import (
+    IndexingPressure,
+    operation_size_bytes,
+)
 from elasticsearch_tpu.search.context import DeviceSegmentCache
 from elasticsearch_tpu.index.seqno import ReplicationTracker
 from elasticsearch_tpu.index.translog import TranslogOp
@@ -45,6 +53,7 @@ from elasticsearch_tpu.transport.transport import (
     DiscoveryNode,
     ResponseHandler,
 )
+from elasticsearch_tpu.utils.breaker import CircuitBreaker
 
 # actions
 SHARD_BULK_PRIMARY = "indices:data/write/bulk[s][p]"
@@ -54,6 +63,13 @@ FINALIZE_RECOVERY = "internal:index/shard/recovery/finalize"
 SHARD_STARTED_ACTION = "internal:cluster/shard_state/started"
 SHARD_FAILED_ACTION = "internal:cluster/shard_state/failed"
 GLOBAL_CKP_SYNC = "internal:index/shard/global_checkpoint_sync"
+
+# replica-write backpressure retry (ref: a replica 429 is NOT a stale
+# copy — ReplicationOperation only fails genuinely broken copies; the
+# primary retries rejected replica bulks with capped backoff instead)
+REPLICA_RETRY_BACKOFF_BASE = 0.25
+REPLICA_RETRY_BACKOFF_CAP = 5.0
+REPLICA_RETRY_MAX_ATTEMPTS = 20
 
 
 @dataclass
@@ -79,23 +95,44 @@ class DataNodeService:
     """Everything a data node does below the coordination layer."""
 
     def __init__(self, transport, scheduler, data_path: str,
-                 device_cache: Optional[DeviceSegmentCache] = None):
+                 device_cache: Optional[DeviceSegmentCache] = None,
+                 breaker_service=None,
+                 indexing_pressure: Optional[IndexingPressure] = None):
         self.transport = transport
         self.scheduler = scheduler
         self.local_node: DiscoveryNode = transport.local_node
         self.data_path = data_path
         self.device_cache = device_cache or DeviceSegmentCache()
+        # memory protection: the node breaker service (transport charges
+        # in_flight_requests through it) + in-flight indexing bytes
+        self.breaker_service = breaker_service
+        self.indexing_pressure = indexing_pressure or IndexingPressure()
+        if breaker_service is not None:
+            self.device_cache.set_breaker(
+                breaker_service.get_breaker(CircuitBreaker.HBM))
+            from elasticsearch_tpu.utils.bigarrays import BigArrays
+            # searchers over this cache charge host readback buffers
+            # against the request breaker (search/searcher.py)
+            self.device_cache.bigarrays = BigArrays(breaker_service)
+        # replica copies the primary gave up retrying under sustained
+        # backpressure (observability: these lag, they are not stale)
+        self.replica_backpressure_gave_up = 0
         self.shards: Dict[Tuple[str, int], LocalShard] = {}
         self.applied_state: ClusterState = ClusterState()
         os.makedirs(data_path, exist_ok=True)
-        for action, handler in [
-            (SHARD_BULK_PRIMARY, self._on_primary_bulk),
-            (SHARD_BULK_REPLICA, self._on_replica_bulk),
-            (START_RECOVERY, self._on_start_recovery),
-            (FINALIZE_RECOVERY, self._on_finalize_recovery),
-            (GLOBAL_CKP_SYNC, self._on_global_ckp_sync),
+        for action, handler, can_trip in [
+            (SHARD_BULK_PRIMARY, self._on_primary_bulk, True),
+            (SHARD_BULK_REPLICA, self._on_replica_bulk, True),
+            # recovery and checkpoint traffic is exempt: shedding it
+            # under pressure would fail copies and make the cluster
+            # sicker (ref: recovery actions register
+            # canTripCircuitBreaker=false)
+            (START_RECOVERY, self._on_start_recovery, False),
+            (FINALIZE_RECOVERY, self._on_finalize_recovery, False),
+            (GLOBAL_CKP_SYNC, self._on_global_ckp_sync, False),
         ]:
-            transport.register_request_handler(action, handler)
+            transport.register_request_handler(action, handler,
+                                               can_trip_breaker=can_trip)
 
     # ---------------------------------------------------- state application
 
@@ -236,15 +273,39 @@ class DataNodeService:
 
     def execute_primary_bulk(self, index: str, shard_id: int,
                              items: List[Dict[str, Any]],
-                             on_done: Callable[[List[Dict], Optional[str]],
-                                               None]) -> None:
+                             on_done: Callable[[List[Dict], Optional[Any]],
+                                               None],
+                             op_bytes: Optional[int] = None) -> None:
         """Run a shard bulk on the local primary, replicate, then call
-        on_done(item_results, error)."""
+        on_done(item_results, error). ``error`` is a string for routing
+        problems or an exception (typed 429 for indexing-pressure
+        rejections — retryable, never partial). ``op_bytes`` is the
+        coordinator's precomputed payload size (avoids re-serializing
+        the bulk just to charge it); computed locally when absent."""
         shard = self.shards.get((index, shard_id))
         if shard is None or not shard.primary or shard.state != "started":
             on_done([], f"no started primary for [{index}][{shard_id}] "
                         f"on {self.local_node.name}")
             return
+        # primary-stage indexing pressure: admit the whole shard bulk
+        # BEFORE any engine work; the coordinator maps the typed 429
+        # onto every item so the client retries the batch
+        if op_bytes is None:
+            op_bytes = operation_size_bytes(items)
+        try:
+            release = self.indexing_pressure.mark_primary_operation_started(
+                op_bytes, f"[{index}][{shard_id}] bulk")
+        except EsRejectedExecutionException as e:
+            on_done([], e)
+            return
+
+        def done(results_, error_=None, _release=release, _cb=on_done):
+            # release-on-completion: primary bytes return when the
+            # operation (including replication) has fully completed
+            _release()
+            _cb(results_, error_)
+
+        on_done = done
         results = []
         ops_for_replicas: List[Dict[str, Any]] = []
         for item in items:
@@ -295,32 +356,75 @@ class DataNodeService:
             if pending["n"] == 0:
                 on_done(results, None)
 
+        # size the replica ops ONCE; every copy's replica-stage charge
+        # reuses it off the payload
+        rep_bytes = operation_size_bytes(ops_for_replicas)
         for copy, node in replicas:
             payload = {
                 "index": index, "shard_id": shard_id,
                 "ops": ops_for_replicas,
+                "op_bytes": rep_bytes,
                 "global_checkpoint": shard.tracker.global_checkpoint,
                 "max_seq_no": shard.engine.tracker.max_seq_no,
             }
+            self._replicate_to_copy(index, shard_id, shard, copy, node,
+                                    payload, one_done)
 
-            def ok(resp, _copy=copy):
-                if shard.tracker is not None:
-                    shard.tracker.update_local_checkpoint(
-                        _copy.allocation_id, resp.get("local_checkpoint",
-                                                      -1))
+    def _replicate_to_copy(self, index: str, shard_id: int,
+                           shard: LocalShard, copy: ShardRouting,
+                           node: DiscoveryNode, payload: Dict[str, Any],
+                           one_done: Callable[[], None],
+                           attempt: int = 1) -> None:
+        """One replica write, with backpressure-aware failure handling:
+        a rejected (429-class) replica bulk retries the SAME copy with
+        capped exponential backoff — an overloaded copy is not a stale
+        copy and must never reach the master as shard-failed; any other
+        failure marks the copy stale via the master as before (ref:
+        ReplicationOperation.failShardIfNeeded vs. the retryable
+        EsRejectedExecutionException path)."""
+
+        def ok(resp):
+            if shard.tracker is not None:
+                shard.tracker.update_local_checkpoint(
+                    copy.allocation_id, resp.get("local_checkpoint", -1))
+            one_done()
+
+        def fail(exc):
+            if is_backpressure_failure(exc):
+                if attempt < REPLICA_RETRY_MAX_ATTEMPTS:
+                    backoff = min(
+                        REPLICA_RETRY_BACKOFF_BASE * (2 ** (attempt - 1)),
+                        REPLICA_RETRY_BACKOFF_CAP)
+                    self.scheduler.schedule(
+                        backoff,
+                        lambda: self._replicate_to_copy(
+                            index, shard_id, shard, copy, node, payload,
+                            one_done, attempt + 1),
+                        f"retry replica bulk [{index}][{shard_id}] "
+                        f"on {node.name}")
+                    return
+                # sustained rejection: give up on THIS operation without
+                # failing the copy — its local checkpoint simply lags
+                # and seqno-based catch-up covers it once pressure
+                # drains; counted for observability
+                self.replica_backpressure_gave_up += 1
+                import logging
+                logging.getLogger(__name__).warning(
+                    "[%s] replica [%s][%d] on %s still rejecting after "
+                    "%d attempts; leaving it lagging (not stale)",
+                    self.local_node.name, index, shard_id, node.name,
+                    attempt)
                 one_done()
+                return
+            # genuinely failed replica: mark stale via master
+            self.send_shard_failed(
+                index, shard_id, copy.allocation_id,
+                f"replica write failed: {exc}")
+            one_done()
 
-            def fail(exc, _copy=copy):
-                # failed replica: mark stale via master (ref:
-                # ReplicationOperation.failShardIfNeeded)
-                self.send_shard_failed(
-                    index, shard_id, _copy.allocation_id,
-                    f"replica write failed: {exc}")
-                one_done()
-
-            self.transport.send_request(node, SHARD_BULK_REPLICA, payload,
-                                        ResponseHandler(ok, fail),
-                                        timeout=30.0)
+        self.transport.send_request(node, SHARD_BULK_REPLICA, payload,
+                                    ResponseHandler(ok, fail),
+                                    timeout=30.0)
 
     def _active_replicas(self, index: str, shard_id: int
                          ) -> List[Tuple[ShardRouting, DiscoveryNode]]:
@@ -340,25 +444,48 @@ class DataNodeService:
     def _on_primary_bulk(self, req, channel, src) -> None:
         def on_done(results, error):
             if error:
-                channel.send_exception(RuntimeError(error))
+                # exceptions keep their type on the wire (a 429-class
+                # rejection must classify as retryable at the caller)
+                channel.send_exception(
+                    error if isinstance(error, BaseException)
+                    else RuntimeError(error))
             else:
                 channel.send_response({"items": results})
 
         self.execute_primary_bulk(req["index"], req["shard_id"],
-                                  req["items"], on_done)
+                                  req["items"], on_done,
+                                  op_bytes=req.get("op_bytes"))
 
     def _on_replica_bulk(self, req, channel, src) -> None:
         """Ref: TransportShardBulkAction replica path (:417) — apply ops
-        with pre-assigned seqnos."""
+        with pre-assigned seqnos. Replica-stage indexing pressure admits
+        the ops first (1.5x headroom — replica rejections are shed
+        last); a rejection travels back typed so the primary retries
+        with backoff instead of marking the copy stale."""
         shard = self.shards.get((req["index"], req["shard_id"]))
         if shard is None:
             channel.send_exception(RuntimeError(
                 f"no local copy of [{req['index']}][{req['shard_id']}]"))
             return
-        for op in req["ops"]:
-            self._apply_replica_op(shard.engine, op)
-        shard.global_checkpoint = max(shard.global_checkpoint,
-                                      req.get("global_checkpoint", -1))
+        rep_bytes = req.get("op_bytes")
+        if rep_bytes is None:
+            rep_bytes = operation_size_bytes(req["ops"])
+        try:
+            release = self.indexing_pressure.mark_replica_operation_started(
+                rep_bytes,
+                f"[{req['index']}][{req['shard_id']}] bulk[r]")
+        except EsRejectedExecutionException as e:
+            channel.send_exception(e)
+            return
+        try:
+            for op in req["ops"]:
+                self._apply_replica_op(shard.engine, op)
+            shard.global_checkpoint = max(shard.global_checkpoint,
+                                          req.get("global_checkpoint", -1))
+        finally:
+            # release-on-completion: replica bytes return as soon as the
+            # ops are durably applied (or failed)
+            release()
         channel.send_response(
             {"local_checkpoint": shard.engine.tracker.checkpoint})
 

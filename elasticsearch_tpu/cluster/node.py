@@ -35,12 +35,25 @@ from elasticsearch_tpu.cluster.data_node import (
     DataNodeService,
 )
 from elasticsearch_tpu.cluster.routing import OperationRouting, ShardId
-from elasticsearch_tpu.cluster.search_action import DistributedSearchService
+from elasticsearch_tpu.cluster.search_action import (
+    DistributedSearchService,
+    failure_type_of,
+)
 from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.common.errors import (
+    BACKPRESSURE_ERROR_TYPES,
+    EsRejectedExecutionException,
+)
+from elasticsearch_tpu.index.pressure import (
+    IndexingPressure,
+    operation_size_bytes,
+)
 from elasticsearch_tpu.transport.transport import (
     DiscoveryNode,
     ResponseHandler,
+    wire_breaker_service,
 )
+from elasticsearch_tpu.utils.breaker import build_breaker_service
 
 CREATE_INDEX_ACTION = "indices:admin/create"
 DELETE_INDEX_ACTION = "indices:admin/delete"
@@ -56,11 +69,13 @@ class ClusterNode:
     def __init__(self, transport, scheduler, data_path: str,
                  seed_nodes: Optional[List[DiscoveryNode]] = None,
                  initial_master_nodes: Optional[List[str]] = None,
-                 rng=None, keystore=None, durable_state: bool = True):
+                 rng=None, keystore=None, durable_state: bool = True,
+                 settings: Optional[Dict[str, Any]] = None):
         self.transport = transport
         self.scheduler = scheduler
         self.local_node: DiscoveryNode = transport.local_node
         self.data_path = data_path
+        self.settings = dict(settings or {})
         os.makedirs(data_path, exist_ok=True)
         if seed_nodes is None:
             # no explicit seeds: resolve through the seed-hosts
@@ -79,9 +94,22 @@ class ClusterNode:
             node=self.local_node.name or self.local_node.node_id,
             clock=scheduler.now)
         wire_transport(transport, self.telemetry)
+        # memory protection: hierarchical circuit breakers charged on
+        # the live path (transport inbound → in_flight_requests, device
+        # cache → hbm, search host staging → request) + in-flight
+        # indexing-byte admission. Limits come from the node settings
+        # (`indices.breaker.*.limit`, `indexing_pressure.memory.limit`).
+        self.breaker_service = build_breaker_service(
+            self.settings.get, metrics=self.telemetry.metrics)
+        wire_breaker_service(transport, self.breaker_service)
+        self.indexing_pressure = IndexingPressure.from_settings(
+            self.settings.get, metrics=self.telemetry.metrics)
         self.allocation = AllocationService()
         self.routing = OperationRouting()
-        self.data_node = DataNodeService(transport, scheduler, data_path)
+        self.data_node = DataNodeService(
+            transport, scheduler, data_path,
+            breaker_service=self.breaker_service,
+            indexing_pressure=self.indexing_pressure)
         self.search_service = DistributedSearchService(
             transport, self.data_node, self.routing, scheduler=scheduler,
             telemetry=self.telemetry)
@@ -120,7 +148,11 @@ class ClusterNode:
             (REFRESH_ACTION, self._on_refresh_shard),
             (ENGINE_STATS_ACTION, self._on_engine_stats),
         ]:
-            transport.register_request_handler(action, handler)
+            # master/admin + monitoring actions never trip the inbound
+            # breaker: shard-state reporting and stats are exactly what
+            # an overloaded cluster still needs to function/diagnose
+            transport.register_request_handler(action, handler,
+                                               can_trip_breaker=False)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -320,12 +352,34 @@ class ClusterNode:
         if imd is None:
             on_done(None, KeyError(f"no such index [{index}]"))
             return
+        if not items:
+            # nothing to fan out: complete immediately (charging and
+            # waiting on zero shard responses would leak the charge and
+            # never call back)
+            on_done({"items": [], "errors": []}, None)
+            return
+        # coordinating-stage indexing pressure: admit the whole bulk's
+        # bytes BEFORE any shard fan-out; rejection is a typed 429 the
+        # client retries after in-flight bytes release (ref:
+        # TransportBulkAction → IndexingPressure.markCoordinatingOperationStarted).
+        # Items are sized ONCE here; per-shard sums ride the shard
+        # payloads so the primary doesn't re-serialize for its charge.
+        item_sizes = [operation_size_bytes(item) for item in items]
+        try:
+            release = \
+                self.indexing_pressure.mark_coordinating_operation_started(
+                    sum(item_sizes), f"bulk[{index}]")
+        except EsRejectedExecutionException as e:
+            on_done(None, e)
+            return
         by_shard: Dict[int, List[Dict]] = {}
+        shard_bytes: Dict[int, int] = {}
         order: Dict[int, List[int]] = {}
         for i, item in enumerate(items):
             sid = OperationRouting.shard_id(
                 imd.number_of_shards, item["id"], item.get("routing"))
             by_shard.setdefault(sid, []).append(item)
+            shard_bytes[sid] = shard_bytes.get(sid, 0) + item_sizes[i]
             order.setdefault(sid, []).append(i)
         results: List[Optional[Dict]] = [None] * len(items)
         pending = {"n": len(by_shard), "errors": []}
@@ -333,6 +387,9 @@ class ClusterNode:
         def shard_done():
             pending["n"] -= 1
             if pending["n"] == 0:
+                # release-on-completion: coordinating bytes return once
+                # every shard bulk has answered (ok or failed)
+                release()
                 if pending["errors"]:
                     on_done({"items": results,
                              "errors": pending["errors"]}, None)
@@ -364,14 +421,22 @@ class ClusterNode:
                 shard_done()
 
             def fail(exc, _sid=sid):
+                # a backpressure rejection surfaces as a retryable 429
+                # per item (the ES contract: retry the bulk after
+                # backoff), not a generic 500
+                ftype = failure_type_of(exc)
+                status = 429 if ftype in BACKPRESSURE_ERROR_TYPES else 500
                 for i in order[_sid]:
-                    results[i] = {"error": str(exc), "status": 500}
+                    results[i] = {"error": {"type": ftype,
+                                            "reason": str(exc)},
+                                  "status": status}
                 pending["errors"].append(f"shard {_sid}: {exc}")
                 shard_done()
 
             self.transport.send_request(
                 node, SHARD_BULK_PRIMARY,
-                {"index": index, "shard_id": sid, "items": shard_items},
+                {"index": index, "shard_id": sid, "items": shard_items,
+                 "op_bytes": shard_bytes[sid]},
                 ResponseHandler(ok, fail), timeout=60.0)
 
     def refresh(self, on_done: Callable = lambda r, e: None) -> None:

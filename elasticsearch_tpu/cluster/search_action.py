@@ -70,11 +70,13 @@ from elasticsearch_tpu.cluster.routing import (
 )
 from elasticsearch_tpu.cluster.state import ClusterState, ShardRouting
 from elasticsearch_tpu.common.errors import (
+    BACKPRESSURE_ERROR_TYPES,
     IndexNotFoundException,
     NodeNotConnectedException,
     NoShardAvailableActionException,
     SearchPhaseExecutionException,
     error_type_of,
+    failure_type_of,
     snake_case,
 )
 from elasticsearch_tpu.search.queries import MatchAllQuery, parse_query
@@ -108,21 +110,28 @@ NON_RETRYABLE_TYPES = {
     "search_phase_execution_exception",
 }
 
-
-def failure_type_of(exc: BaseException) -> str:
-    """The snake_case wire type of a (possibly proxied) failure: a
-    remote_type off the wire may be a CamelCase class name — normalize
-    so `_shards.failures[].reason.type` is uniform across paths."""
-    remote = getattr(exc, "remote_type", None)
-    return snake_case(remote) if remote is not None else error_type_of(exc)
+# backpressure failures — a tripped breaker / 429 rejection — are
+# ALWAYS retryable on another copy: the condition is node-local (that
+# node is out of memory headroom; a different replica may have plenty).
+# The shared allow-list (common/errors.py BACKPRESSURE_ERROR_TYPES)
+# keeps this coordinator, the replica-retry path, and the bulk status
+# mapping classifying identically, and no future NON_RETRYABLE addition
+# can accidentally ground them (ref: the reference classifies
+# CircuitBreakingException/EsRejectedExecutionException RestStatus 429
+# as retryable in replica selection).
+BACKPRESSURE_RETRYABLE_TYPES = BACKPRESSURE_ERROR_TYPES
 
 
 def is_retryable_failure(exc: BaseException) -> bool:
     """Whether another copy of the shard may succeed where this one
     failed. Connect/timeout/node-level failures are retryable; request
-    errors (parse, illegal argument) are not. The remote exception type
-    travels via RemoteTransportException.remote_type."""
-    return failure_type_of(exc) not in NON_RETRYABLE_TYPES
+    errors (parse, illegal argument) are not; breaker trips/429s always
+    are (failover sheds load to a copy with headroom). The remote
+    exception type travels via RemoteTransportException.remote_type."""
+    ftype = failure_type_of(exc)
+    if ftype in BACKPRESSURE_RETRYABLE_TYPES:
+        return True
+    return ftype not in NON_RETRYABLE_TYPES
 
 
 @dataclass
@@ -225,6 +234,10 @@ class DistributedSearchService:
             return None
         engine = shard.engine
         snapshot = engine.acquire_searcher()
+        # the searcher inherits the cache's breaker-accounted BigArrays
+        # (wired by DataNodeService): host staging/readback buffers
+        # charge the request breaker, and a trip becomes a typed
+        # per-shard failure the coordinator fails over to another copy
         return ShardSearcher(snapshot.segments, engine.mapper,
                              self.data_node.device_cache)
 

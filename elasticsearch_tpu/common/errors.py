@@ -216,3 +216,26 @@ def error_type_of(exc: BaseException) -> str:
     if isinstance(exc, ElasticsearchTpuException):
         return exc.error_type()
     return snake_case(type(exc).__name__)
+
+
+def failure_type_of(exc: BaseException) -> str:
+    """The snake_case wire type of a (possibly proxied) failure: a
+    remote_type off the wire may be a CamelCase class name — normalize
+    so failure classification is uniform across paths."""
+    remote = getattr(exc, "remote_type", None)
+    return snake_case(remote) if remote is not None else error_type_of(exc)
+
+
+# backpressure failures — a tripped breaker / 429 rejection. The ONE
+# definition every classifier shares (coordinator failover, replica
+# retry, bulk status mapping): the condition is "overloaded right now",
+# which is retryable by nature and never grounds for marking a copy
+# stale or surfacing a 500.
+BACKPRESSURE_ERROR_TYPES = frozenset({
+    "circuit_breaking_exception",
+    "es_rejected_execution_exception",
+})
+
+
+def is_backpressure_failure(exc: BaseException) -> bool:
+    return failure_type_of(exc) in BACKPRESSURE_ERROR_TYPES

@@ -53,7 +53,15 @@ class Node:
         if os.path.exists(ks_path):
             self.keystore = KeyStore(ks_path).load(
                 os.environ.get("ES_KEYSTORE_PASSPHRASE", ""))
-        self.breaker_service = HierarchyCircuitBreakerService()
+        # memory protection: hierarchical circuit breakers + in-flight
+        # indexing-byte admission, limits from the node settings
+        # (`indices.breaker.*.limit` / `indexing_pressure.memory.limit`
+        # — parsing/defaulting shared with ClusterNode)
+        from elasticsearch_tpu.index.pressure import IndexingPressure
+        from elasticsearch_tpu.utils.breaker import build_breaker_service
+        self.breaker_service = build_breaker_service(settings.get)
+        self.indexing_pressure = IndexingPressure.from_settings(
+            settings.get)
         # named executors with EWMA task tracking (ref:
         # ThreadPool.java:117-181, wired ahead of every service)
         from elasticsearch_tpu.common.threadpool import ThreadPool
@@ -68,7 +76,21 @@ class Node:
             max_traces=int(settings.get("telemetry.traces.max", 128)),
             max_spans_per_trace=int(
                 settings.get("telemetry.traces.max_spans", 512)))
+        # breaker trips + indexing-pressure rejections feed the node
+        # metrics registry (`breaker.*` / `indexing_pressure.*`)
+        self.breaker_service.metrics = self.telemetry.metrics
+        self.indexing_pressure.metrics = self.telemetry.metrics
         self.indices_service = IndicesService(self.data_path, settings)
+        # the shared device cache charges the `hbm` child breaker on
+        # segment/filter-mask admission (LRU eviction pressure first),
+        # and hands searchers a request-breaker-accounted BigArrays for
+        # host staging/readback buffers
+        from elasticsearch_tpu.utils.bigarrays import BigArrays
+        from elasticsearch_tpu.utils.breaker import CircuitBreaker
+        self.indices_service.device_cache.set_breaker(
+            self.breaker_service.get_breaker(CircuitBreaker.HBM))
+        self.indices_service.device_cache.bigarrays = BigArrays(
+            self.breaker_service)
         self.search_service = SearchService(self.indices_service)
         self.search_service.telemetry = self.telemetry
         self.task_manager = TaskManager(self.node_id)

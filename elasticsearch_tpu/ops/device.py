@@ -153,7 +153,17 @@ class DeviceSegment:
         self.name = segment.name
         self.n_docs = segment.n_docs
         self.n_docs_padded = max(DOC_PAD, round_up(segment.n_docs, DOC_PAD))
+        # packing invariant (ops/plan.py pack_result): docids ride
+        # device→host readbacks as float32 casts, exact only < 2^24 —
+        # enforce LOUDLY at build time, not as silent wraparound later
+        from elasticsearch_tpu.ops.plan import check_packed_id_limit
+        check_packed_id_limit(self.n_docs_padded,
+                              f"DeviceSegment[{segment.name}]")
         self._device = device
+        # backpressure sink (search/context.py DeviceSegmentCache):
+        # filter-mask builds charge the hbm breaker through it; None
+        # for standalone DeviceSegments outside a cache
+        self.hbm_sink = None
         # LRU filter-mask cache — the analogue of Lucene's LRUQueryCache
         # for filter clauses (ref: search/LRUQueryCache.java via
         # IndicesQueryCache): an any-of terms filter caches as ONE dense
@@ -250,11 +260,18 @@ class DeviceSegment:
             mask = host_any_mask(dp.host, key[1], self.n_docs_padded)
         else:
             mask = np.zeros(self.n_docs_padded, bool)
-        entry = (jax.device_put(mask, device=self._device), mask)
+        # hbm admission BEFORE the device upload (the host mask has the
+        # same nbytes) — a trip here surfaces as a typed per-shard
+        # circuit_breaking_exception the coordinator fails over, and
+        # nothing lands in device memory past the limit
+        self._account_mask(int(mask.nbytes))
+        dev_mask = jax.device_put(mask, device=self._device)
+        entry = (dev_mask, mask)
         self._filter_masks[key] = entry
         while len(self._filter_masks) > FILTER_MASK_CACHE_MAX:
-            self._filter_masks.popitem(last=False)
+            _k, (evicted, _h) = self._filter_masks.popitem(last=False)
             self.filter_mask_evictions += 1
+            self._account_mask(-int(evicted.nbytes))
         return entry
 
     def composed_filter_mask(self, conversions) -> Tuple[jax.Array,
@@ -279,12 +296,22 @@ class DeviceSegment:
             _, hm = self.filter_mask(fname, terms)
             hm = ~hm if negate else hm
             host = hm.copy() if host is None else (host & hm)
-        entry = (jax.device_put(host, device=self._device), host)
+        self._account_mask(int(host.nbytes))
+        dev_mask = jax.device_put(host, device=self._device)
+        entry = (dev_mask, host)
         self._filter_masks[key] = entry
         while len(self._filter_masks) > FILTER_MASK_CACHE_MAX:
-            self._filter_masks.popitem(last=False)
+            _k, (evicted, _h) = self._filter_masks.popitem(last=False)
             self.filter_mask_evictions += 1
+            self._account_mask(-int(evicted.nbytes))
         return entry
+
+    def _account_mask(self, delta: int) -> None:
+        """Charge/release device filter-mask bytes against the owning
+        cache's hbm breaker (no-op for standalone segments)."""
+        sink = self.hbm_sink
+        if sink is not None:
+            sink.account_filter_mask(self.name, delta)
 
     def update_live(self, live: np.ndarray) -> None:
         """Re-upload only the live mask (deletes don't touch postings)."""

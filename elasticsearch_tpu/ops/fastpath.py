@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from elasticsearch_tpu.ops.bm25 import _SENTINEL, bm25_contrib
+from elasticsearch_tpu.ops.plan import check_packed_id_limit
 from elasticsearch_tpu.telemetry.engine import tracked_jit
 
 # mask-stack height: every cohort launch carries F dense bool columns
@@ -113,6 +114,9 @@ def _topk_total(block_docids, block_tfs, sel_blocks, sel_weights,
                 doc_lens, live_col, avg_len, k1: float, b: float, k: int):
     """Single query: (values [k], docids [k], total []) — sort by docid,
     doubling segmented sum, top-k at run-last positions."""
+    # trace-time guard (shapes are static under jit): every serving
+    # kernel reads ids back float-packed, which is exact only < 2^24
+    check_packed_id_limit(doc_lens.shape[0], "fastpath kernel")
     dt = _score_dtype()
     d = jnp.take(block_docids, sel_blocks, axis=0)       # [NB, B]
     tf = jnp.take(block_tfs, sel_blocks, axis=0).astype(dt)
@@ -169,6 +173,7 @@ def _essential_phase1(block_docids, block_tfs, sel_blocks, sel_weights,
     overflow bound. Shared by BOTH patch lanes (binary-search and
     dense-table) so the exactness-critical candidate extraction has one
     definition. Returns (cand_ids [C], ess [C], overflow_bound [])."""
+    check_packed_id_limit(doc_lens.shape[0], "fastpath essential lane")
     dt = _score_dtype()
     d = jnp.take(block_docids, sel_blocks, axis=0)
     tf = jnp.take(block_tfs, sel_blocks, axis=0).astype(dt)

@@ -51,6 +51,23 @@ MUST_NOT = 3
 
 _SENTINEL = 0x7FFFFFFF  # padding docid; sorts after every real docid
 
+# float32 represents every integer < 2^24 exactly — the ceiling for ids
+# that ride packed readbacks as float casts (pack_result). Segment doc
+# counts sit far below it; the mesh path's GLOBAL ids (shard * nd_padded
+# + docid) can approach it at many-shard scale and must fall back to the
+# per-shard RPC merge instead of silently losing low bits.
+PACKED_ID_LIMIT = 1 << 24
+
+
+def check_packed_id_limit(nd: int, where: str) -> None:
+    """Enforce the ``nd < 2^24`` float-pack invariant loudly at build /
+    register time (a violation later would corrupt docids silently)."""
+    if nd >= PACKED_ID_LIMIT:
+        raise ValueError(
+            f"{where}: {nd} docs (padded) >= 2^24 — float32-packed "
+            f"readback ids would lose precision; shard the corpus "
+            f"further (ops/plan.py pack_result invariant)")
+
 
 class FieldStream(NamedTuple):
     """One field's postings selection for a query plan.

@@ -438,6 +438,20 @@ class MeshSearchExecutor:
         shard_views = [s.segments[0] if len(s.segments) == 1
                        else _CompositeShard(list(s.segments))
                        for s in searchers]
+        # float-pack id overflow guard: the packed readback carries
+        # GLOBAL ids (shard * nd_padded + docid) as float32 casts, exact
+        # only < 2^24 — past that, fall back to the per-shard RPC merge
+        # instead of silently corrupting low docid bits
+        from elasticsearch_tpu.ops.plan import PACKED_ID_LIMIT
+        nd_max = max((v.n_docs for v in shard_views), default=1)
+        nd_padded = max(DOC_PAD, _round_up(nd_max, DOC_PAD))
+        if n_shards * nd_padded >= PACKED_ID_LIMIT:
+            import logging
+            logging.getLogger(__name__).warning(
+                "mesh fast path skipped: %d shards x %d padded docs "
+                ">= 2^24 float-packed global-id ceiling; using the "
+                "per-shard fallback", n_shards, nd_padded)
+            return None
         corpus = self.corpus_for(index_name, shard_views)
         bound = bind_mesh(corpus, plans)
         if bound is None:

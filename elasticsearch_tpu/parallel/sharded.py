@@ -36,6 +36,63 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from elasticsearch_tpu.index.segment import BLOCK_SIZE
 
 
+# int32 global-id ceiling: with x64 off, `ids + shard * nd` computes in
+# int32 and jnp.int64 requests silently narrow (JAX warns and truncates).
+# Past this, the merge runs host-side in real int64 instead (exact).
+GID_INT32_LIMIT = 2 ** 31
+
+
+def _gids_exceed_int32(index: "ShardedIndex") -> bool:
+    if jax.config.jax_enable_x64:
+        return False
+    if index.n_shards * index.n_docs_padded < GID_INT32_LIMIT:
+        return False
+    import logging
+    logging.getLogger(__name__).warning(
+        "sharded merge: %d shards x %d padded docs >= 2^31 with x64 "
+        "disabled — global ids would wrap in int32; falling back to the "
+        "host-side int64 merge", index.n_shards, index.n_docs_padded)
+    return True
+
+
+def _host_merge_topk(vals: np.ndarray, ids: np.ndarray, nd: int, k: int):
+    """Merge per-shard local top-k [S, Q, k] host-side with exact int64
+    global ids (the overflow-safe replacement for the on-device
+    all_gather merge)."""
+    s, q, kk = vals.shape
+    gids = ids.astype(np.int64) + \
+        (np.arange(s, dtype=np.int64)[:, None, None] * np.int64(nd))
+    vv = vals.transpose(1, 0, 2).reshape(q, s * kk)
+    gg = gids.transpose(1, 0, 2).reshape(q, s * kk)
+    order = np.argsort(-vv, axis=1, kind="stable")[:, :k]
+    return (np.take_along_axis(vv, order, axis=1),
+            np.take_along_axis(gg, order, axis=1))
+
+
+def _local_bm25_topk_all_shards(index: "ShardedIndex", sel_blocks,
+                                sel_weights, k, k1, b):
+    """Per-shard local top-k [S, Q, k] with LOCAL ids (no global-id
+    arithmetic on device)."""
+    step = jax.vmap(partial(
+        _shard_bm25_topk_local, nd=index.n_docs_padded,
+        avg_len=index.avg_len, k1=k1, b=b, k=k))
+    return step(index.block_docids, index.block_tfs, index.doc_lens,
+                index.live, jnp.asarray(sel_blocks),
+                jnp.asarray(sel_weights))
+
+
+def _local_knn_topk_all_shards(index: "ShardedIndex", queries, k):
+    q = jnp.asarray(queries)
+
+    def one(vectors, live):
+        scores = jnp.einsum("qd,nd->qn", q.astype(vectors.dtype),
+                            vectors, preferred_element_type=jnp.float32)
+        masked = jnp.where(live[None, :], scores, -jnp.inf)
+        return jax.lax.top_k(masked, k)
+
+    return jax.vmap(one)(index.vectors, index.live)
+
+
 def make_mesh(n_shards: Optional[int] = None, n_replicas: int = 1,
               devices=None) -> Mesh:
     """A ("replica", "shard") mesh over the available devices."""
@@ -87,6 +144,11 @@ def sharded_bm25_topk(index: ShardedIndex,
     Returns (scores [Q, k], global_docids [Q, k]) where global docid =
     shard_idx * n_docs_padded + local docid. Results replicated.
     """
+    if _gids_exceed_int32(index):
+        vals, ids = _local_bm25_topk_all_shards(
+            index, sel_blocks, sel_weights, k, k1, b)
+        return _host_merge_topk(np.asarray(vals), np.asarray(ids),
+                                index.n_docs_padded, k)
     mesh = index.mesh
     nd = index.n_docs_padded
 
@@ -123,6 +185,10 @@ def sharded_knn_topk(index: ShardedIndex,
     """Sharded brute-force kNN: queries replicated, vector slab sharded
     over "shard" — per-shard MXU matmul + local top-k + all-gather merge
     (the dense analogue of the per-shard query phase)."""
+    if _gids_exceed_int32(index):
+        vals, ids = _local_knn_topk_all_shards(index, queries, k)
+        return _host_merge_topk(np.asarray(vals), np.asarray(ids),
+                                index.n_docs_padded, k)
     mesh = index.mesh
     nd = index.n_docs_padded
 
@@ -196,6 +262,31 @@ def sharded_hybrid_rrf(index: ShardedIndex,
     Returns (rrf_scores [Q, k], global_docids [Q, k]), replica-sharded
     over Q."""
     from elasticsearch_tpu.ops.bm25 import segmented_topk
+
+    if _gids_exceed_int32(index):
+        # host fusion over the overflow-safe per-branch merges
+        b_vals, b_gids = sharded_bm25_topk(index, sel_blocks,
+                                           sel_weights, k, k1, b)
+        v_vals, v_gids = sharded_knn_topk(index, queries, k)
+        c = float(rank_constant)
+        q_n = np.asarray(b_vals).shape[0]
+        out_v = np.zeros((q_n, k), np.float32)
+        out_g = np.zeros((q_n, k), np.int64)
+        for qi in range(q_n):
+            fused: Dict[int, float] = {}
+            for vals, gids in ((np.asarray(b_vals)[qi],
+                                np.asarray(b_gids)[qi]),
+                               (np.asarray(v_vals)[qi],
+                                np.asarray(v_gids)[qi])):
+                for rank, (v, g) in enumerate(zip(vals, gids)):
+                    if np.isfinite(v):
+                        fused[int(g)] = fused.get(int(g), 0.0) + \
+                            1.0 / (c + rank + 1.0)
+            top = sorted(fused.items(), key=lambda e: (-e[1], e[0]))[:k]
+            for j, (g, v) in enumerate(top):
+                out_v[qi, j] = v
+                out_g[qi, j] = g
+        return out_v, out_g
 
     mesh = index.mesh
     nd = index.n_docs_padded

@@ -597,7 +597,13 @@ def nodes_stats(node, params, body):
                 node.indices_service.indices.items()},
             "request_cache": node.search_service.request_cache_stats,
             "process": {"max_rss_bytes": ru.ru_maxrss * 1024},
+            # real numbers now: transport inbound charges
+            # in_flight_requests, host readbacks charge request, device
+            # admission charges hbm (utils/breaker.py live-path wiring)
             "breakers": node.breaker_service.stats(),
+            # in-flight indexing bytes + per-stage rejection counters
+            # (index/pressure.py — the write-path backpressure surface)
+            "indexing_pressure": node.indexing_pressure.stats(),
             # named executors incl. the search pool's EWMA task time —
             # the signal adaptive replica selection consumes (ref:
             # ThreadPool stats / ResponseCollectorService)
@@ -1087,7 +1093,31 @@ def mget_all(node, params, body):
 
 def bulk(node, params, body, index=None):
     """NDJSON bulk (ref: action/bulk/TransportBulkAction.java:100,172 —
-    grouped per shard; here executed item-by-item against local shards)."""
+    grouped per shard; here executed item-by-item against local shards).
+
+    Coordinating admission happens FIRST: the raw payload bytes charge
+    the node's indexing pressure and past the limit the whole bulk is
+    rejected with a retryable 429 (EsRejectedExecutionException) before
+    any parsing or shard work — overload sheds at the door (ref:
+    IndexingPressure.markCoordinatingOperationStarted in
+    TransportBulkAction)."""
+    from elasticsearch_tpu.index.pressure import operation_size_bytes
+    ip = getattr(node, "indexing_pressure", None)
+    release = None
+    if ip is not None:
+        nbytes = (len(body) if isinstance(body, (bytes, str))
+                  else operation_size_bytes(body))
+        release = ip.mark_coordinating_operation_started(nbytes, "_bulk")
+    try:
+        return _bulk_inner(node, params, body, index)
+    finally:
+        # release-on-completion: in-flight bytes return to zero as soon
+        # as the response (or rejection) is determined
+        if release is not None:
+            release()
+
+
+def _bulk_inner(node, params, body, index=None):
     if isinstance(body, (bytes, str)):
         text = body.decode() if isinstance(body, bytes) else body
         try:

@@ -389,6 +389,12 @@ def _metric(agg_type, body, ctx, mapper):
             # missing values sort last in either direction
             spec = (sort_spec[0] if isinstance(sort_spec, list)
                     else sort_spec)
+            # ES accepts `"sort": "price"` and `"sort": ["price"]` —
+            # normalize string specs to {field: {"order": ...}} before
+            # unpacking (default order asc, as the reference's
+            # FieldSortBuilder does for bare field names)
+            if isinstance(spec, str):
+                spec = {spec: {"order": "asc"}}
             (sfield, sdir), = spec.items()
             order = (sdir.get("order", "asc")
                      if isinstance(sdir, dict) else str(sdir))

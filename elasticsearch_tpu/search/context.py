@@ -113,11 +113,24 @@ class SegmentContext:
 # DeviceSegment cache: segments are immutable except their live mask, so the
 # cache key is (segment name, live_version); a delete only re-uploads live.
 class DeviceSegmentCache:
-    def __init__(self, device=None, vector_dtype=jnp.bfloat16):
-        self._cache: Dict[str, Tuple[int, DeviceSegment]] = {}
+    def __init__(self, device=None, vector_dtype=jnp.bfloat16,
+                 breaker=None):
+        from collections import OrderedDict as _OD
+        # insertion/touch order IS the LRU order the hbm breaker's
+        # eviction pressure walks (admission past the limit evicts
+        # least-recently-used device segments before tripping)
+        self._cache: "_OD[str, Tuple[int, DeviceSegment]]" = _OD()
         self._lock = threading.Lock()
         self._device = device
         self._vector_dtype = vector_dtype
+        # hbm child breaker (utils/breaker.py CircuitBreaker) — None
+        # keeps every admission site a single branch
+        self.breaker = breaker
+        self._charged: Dict[str, int] = {}   # segment name -> hbm bytes
+        self.hbm_breaker_evictions = 0       # LRU evictions forced by it
+        # request-breaker-accounted host allocator; ShardSearchers built
+        # over this cache inherit it (searcher.py)
+        self.bigarrays = None
         # compiled-LogicalPlan memo keyed by (segment names, epoch,
         # query json, k1, b) — ShardSearchers are per-request, this
         # cache is the persistent home (None = query not plannable).
@@ -135,18 +148,100 @@ class DeviceSegmentCache:
         self.plan_cache_evictions = 0
         self.peak_hbm_bytes = 0
 
+    def set_breaker(self, breaker) -> None:
+        """Wire the `hbm` child breaker (node startup: Node/ClusterNode).
+        Charges already-resident segments so accounting matches reality
+        even when wiring happens after warm-up."""
+        with self._lock:
+            self.breaker = breaker
+            if breaker is not None:
+                for name, (_v, dev) in self._cache.items():
+                    if name not in self._charged:
+                        nbytes = dev.hbm_bytes()
+                        breaker.add_without_breaking(nbytes)
+                        self._charged[name] = nbytes
+
+    def _admit_locked(self, nbytes: int, label: str,
+                      exclude: str) -> None:
+        """Charge the hbm breaker for ``nbytes``, applying LRU eviction
+        pressure first: past the limit, least-recently-used device
+        segments are dropped (their bytes released) until the charge
+        fits; the breaker trips only when eviction cannot free enough
+        (ref: the fielddata breaker + IndicesFieldDataCache eviction
+        interplay, recast for device memory)."""
+        br = self.breaker
+        if br is None:
+            return
+        # evict-first probe: over-limit admissions drop LRU residents
+        # WITHOUT counting a trip; the breaker's trip counter (and the
+        # raised CircuitBreakingException) fires only when eviction has
+        # nothing left to free
+        while br.limit >= 0 and \
+                (br.used + nbytes) * br.overhead > br.limit:
+            victim = next((n for n in self._cache if n != exclude),
+                          None)
+            if victim is None:
+                break
+            self._cache.pop(victim)
+            br.release(self._charged.pop(victim, 0))
+            self.hbm_breaker_evictions += 1
+        br.add_estimate_bytes_and_maybe_break(nbytes, label)
+
+    def _release_locked(self, name: str) -> None:
+        if self.breaker is not None:
+            self.breaker.release(self._charged.pop(name, 0))
+        else:
+            self._charged.pop(name, None)
+
+    def account_filter_mask(self, name: str, delta: int,
+                            label: str = "filter_mask") -> None:
+        """Filter-mask admission/release for a resident DeviceSegment
+        (called by ops/device.py). Positive deltas go through the same
+        eviction-pressure admission as segment builds; negative deltas
+        (mask LRU eviction) release. Orphan segments (already evicted
+        from this cache) are not accounted."""
+        with self._lock:
+            if self.breaker is None or name not in self._charged:
+                # unwired cache, or an orphan segment already evicted:
+                # no accounting (set_breaker charges residents by their
+                # FULL hbm_bytes — masks included — when wiring later)
+                return
+            if delta >= 0:
+                self._admit_locked(delta, label, exclude=name)
+            else:
+                self.breaker.release(-delta)
+            self._charged[name] = self._charged.get(name, 0) + delta
+
     def get(self, segment: Segment) -> DeviceSegment:
         with self._lock:
             entry = self._cache.get(segment.name)
             if entry is not None:
                 version, dev = entry
                 if version == segment.live_version:
+                    self._cache.move_to_end(segment.name)
                     return dev
                 if dev.segment is segment or dev.n_docs == segment.n_docs:
                     dev.update_live(segment.live)
                     self._cache[segment.name] = (segment.live_version, dev)
+                    self._cache.move_to_end(segment.name)
                     return dev
+                # stale copy replaced below: release its accounting
+                self._cache.pop(segment.name, None)
+                self._release_locked(segment.name)
+            # segment admission charges AFTER the build (the slab sizes
+            # fall out of it) — the breaker bounds steady-state
+            # residency; the build itself transiently overshoots by one
+            # segment, like the reference's fielddata loads that are
+            # accounted as they materialize
             dev = DeviceSegment(segment, self._device, self._vector_dtype)
+            nbytes = dev.hbm_bytes()
+            # admission: evict LRU residents before ever tripping
+            self._admit_locked(
+                nbytes, f"device_segment[{segment.name}]",
+                exclude=segment.name)
+            if self.breaker is not None:
+                self._charged[segment.name] = nbytes
+            dev.hbm_sink = self
             self._cache[segment.name] = (segment.live_version, dev)
             total = sum(d.hbm_bytes() for _v, d in self._cache.values())
             self.peak_hbm_bytes = max(self.peak_hbm_bytes, total)
@@ -157,13 +252,15 @@ class DeviceSegmentCache:
         after merges/deletes so HBM doesn't grow with dead segments)."""
         with self._lock:
             for name in names:
-                self._cache.pop(name, None)
+                if self._cache.pop(name, None) is not None:
+                    self._release_locked(name)
 
     def evict_except(self, names: set) -> None:
         with self._lock:
             for name in list(self._cache):
                 if name not in names:
                     del self._cache[name]
+                    self._release_locked(name)
 
     # -- engine observability (the `engine` stats rollup) -----------------
 
@@ -194,6 +291,9 @@ class DeviceSegmentCache:
         if segment_names is None:
             self.peak_hbm_bytes = max(self.peak_hbm_bytes, total)
             out["peak_bytes"] = self.peak_hbm_bytes
+            # admissions forced to drop an LRU resident by the hbm
+            # breaker (zero in a healthy, fits-in-HBM deployment)
+            out["breaker_evictions"] = self.hbm_breaker_evictions
         return out
 
     def cache_stats(self, segment_names=None) -> Dict[str, object]:

@@ -323,6 +323,11 @@ class FastPathServer:
         pf = seg.postings[field]
         dev = idx.device_cache.get(seg)
         dp = dev.postings[field]
+        # register-time enforcement of the float-pack id invariant: the
+        # C++ front's readback lanes carry docids as float32 casts
+        from elasticsearch_tpu.ops.plan import check_packed_id_limit
+        check_packed_id_limit(dev.n_docs_padded,
+                              f"fastpath register [{name}]")
         self._gen += 1
         reg = {
             "index": name, "field": field, "segment": seg,

@@ -77,6 +77,12 @@ class ShardSearcher:
         self.b = b
         # set by SearchService: continuous batching of plan launches
         self.batcher = None
+        # breaker-accounted host allocation (utils/bigarrays.py): when
+        # wired, the dense path's [ND] host readback buffers charge the
+        # `request` breaker — the analogue of BigArrays guarding
+        # QueryPhase's collector allocations. Inherited from the shared
+        # device cache (the node wires it once); None = unaccounted.
+        self.bigarrays = getattr(self.cache, "bigarrays", None)
         # snapshot epoch, set by IndexService.shard_searchers — feeds
         # plan-cache keys (tests constructing searchers directly leave
         # it None, which only means their caches key on segment names)
@@ -227,7 +233,17 @@ class ShardSearcher:
                 vals, ids = np.asarray(vals), np.asarray(ids)
             keep = np.isfinite(vals)
             ids = ids[keep]
-            scores_np = np.asarray(scores)[ids]
+            if self.bigarrays is not None:
+                # the full [ND] score column materializes on the host
+                # here — account it against the request breaker for the
+                # duration of the gather (a trip aborts THIS shard with
+                # a typed circuit_breaking_exception; siblings and other
+                # copies still answer)
+                with self.bigarrays.adopt(np.asarray(scores),
+                                          "dense_scores_readback") as acc:
+                    scores_np = acc.array[ids]
+            else:
+                scores_np = np.asarray(scores)[ids]
             per_segment.append((seg_idx, vals[keep], ids, scores_np))
 
         # ---- merge per-segment top-k (ref: SearchPhaseController.sortDocs)

@@ -23,11 +23,13 @@ import random
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
+from elasticsearch_tpu.common.errors import CircuitBreakingException
 from elasticsearch_tpu.telemetry import context as _telectx
 from elasticsearch_tpu.transport.transport import (
     DiscoveryNode,
     ResponseHandler,
     TransportChannel,
+    charge_inflight,
     instrument_inbound,
     instrument_send,
 )
@@ -235,14 +237,21 @@ class DisruptableTransport:
         self.local_node = local_node
         self.network = network
         self.telemetry = None
+        # node breaker service: same inbound in_flight_requests seam as
+        # the production BaseTransport, so chaos runs exercise shedding
+        self.breaker_service = None
         self._handlers: Dict[str, Callable] = {}
+        self._no_trip: Set[str] = set()
         network.register(self)
 
     # -- TransportService surface ----------------------------------------
 
     def register_request_handler(self, action: str, handler: Callable,
-                                 executor: str = "generic") -> None:
+                                 executor: str = "generic",
+                                 can_trip_breaker: bool = True) -> None:
         self._handlers[action] = handler
+        if not can_trip_breaker:
+            self._no_trip.add(action)
 
     def connect_to_node(self, node: DiscoveryNode,
                         timeout: float = 5.0) -> None:
@@ -270,11 +279,29 @@ class DisruptableTransport:
                respond: Callable[[Any, bool], None]) -> None:
         handler = self._handlers.get(action)
         headers = instrument_inbound(self.telemetry, action, request)
-        channel = TransportChannel(respond, action)
+        release_box: Dict[str, Callable] = {}
+
+        def responding(payload: Any, is_error: bool) -> None:
+            rel = release_box.pop("release", None)
+            if rel is not None:
+                rel()
+            respond(payload, is_error)
+
+        channel = TransportChannel(responding, action)
         if handler is None:
             channel.send_exception(
                 KeyError(f"No handler for action [{action}]"))
             return
+        if self.breaker_service is not None and \
+                action not in self._no_trip:
+            try:
+                rel = charge_inflight(self.breaker_service, action,
+                                      request)
+                if rel is not None:
+                    release_box["release"] = rel
+            except CircuitBreakingException as e:
+                channel.send_exception(e)
+                return
         try:
             with _telectx.incoming(headers):
                 handler(request, channel, source)

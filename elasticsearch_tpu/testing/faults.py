@@ -155,8 +155,10 @@ class FaultInjectingTransport:
         return self.inner.local_node
 
     def register_request_handler(self, action: str, handler: Callable,
-                                 executor: str = "generic") -> None:
-        self.inner.register_request_handler(action, handler, executor)
+                                 executor: str = "generic",
+                                 can_trip_breaker: bool = True) -> None:
+        self.inner.register_request_handler(
+            action, handler, executor, can_trip_breaker=can_trip_breaker)
 
     def __getattr__(self, name):
         return getattr(self.inner, name)
@@ -203,3 +205,66 @@ class FaultInjectingTransport:
                                                 handler, timeout=timeout,
                                                 headers=headers),
                 f"fault-delay {action}->{node.name}")
+
+
+class MemoryPressureFault:
+    """Seeded memory-pressure injection: shrink a node's circuit-breaker
+    and indexing-pressure limits MID-FLIGHT (and optionally restore them
+    later), on the shared scheduler so the squeeze lands at a
+    deterministic virtual time. Models a neighbour tenant ballooning, a
+    fragmentation spike, or an operator tightening
+    ``indices.breaker.*.limit`` under load — the system must shed
+    (partial results, 429s), never crash or hang.
+
+    ``apply()`` fires immediately; ``schedule(delay)`` defers the
+    squeeze by ``delay`` (virtual) seconds from now; ``restore()`` puts
+    the original limits back (retried bulks succeed after release — the
+    recovery half of the backpressure contract).
+    """
+
+    def __init__(self, breaker_service=None, indexing_pressure=None,
+                 factor: float = 0.0, floor_bytes: int = 0):
+        self.breaker_service = breaker_service
+        self.indexing_pressure = indexing_pressure
+        self.factor = factor
+        self.floor_bytes = floor_bytes
+        self._saved: Optional[Dict[str, int]] = None
+        self._saved_pressure: Optional[int] = None
+
+    def apply(self) -> None:
+        svc = self.breaker_service
+        if svc is not None and self._saved is None:
+            self._saved = {name: svc.get_breaker(name).limit
+                           for name in svc.breaker_names()}
+            self._saved["__parent__"] = svc.total_limit
+            for name in svc.breaker_names():
+                br = svc.get_breaker(name)
+                br.set_limit(max(self.floor_bytes,
+                                 int(br.limit * self.factor)))
+            svc.total_limit = max(self.floor_bytes,
+                                  int(svc.total_limit * self.factor))
+        ip = self.indexing_pressure
+        if ip is not None and self._saved_pressure is None:
+            self._saved_pressure = ip.limit
+            ip.limit = max(self.floor_bytes, int(ip.limit * self.factor))
+
+    def restore(self) -> None:
+        svc = self.breaker_service
+        if svc is not None and self._saved is not None:
+            svc.total_limit = self._saved.pop("__parent__")
+            for name, limit in self._saved.items():
+                svc.get_breaker(name).set_limit(limit)
+            self._saved = None
+        ip = self.indexing_pressure
+        if ip is not None and self._saved_pressure is not None:
+            ip.limit = self._saved_pressure
+            self._saved_pressure = None
+
+    def schedule(self, scheduler, delay: float,
+                 restore_after: Optional[float] = None) -> None:
+        """Squeeze ``delay`` seconds from now (scheduler delays are
+        RELATIVE); restore ``restore_after`` seconds after that."""
+        scheduler.schedule(delay, self.apply, "fault-memory-pressure")
+        if restore_after is not None:
+            scheduler.schedule(delay + restore_after, self.restore,
+                               "fault-memory-pressure-restore")

@@ -39,9 +39,16 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from elasticsearch_tpu.common.errors import ElasticsearchTpuException
+from elasticsearch_tpu.common.errors import (
+    CircuitBreakingException,
+    ElasticsearchTpuException,
+)
 from elasticsearch_tpu.telemetry import context as _telectx
 from elasticsearch_tpu.transport.wire import StreamInput, StreamOutput
+from elasticsearch_tpu.utils.breaker import (
+    CircuitBreaker,
+    payload_size_bytes,
+)
 
 CURRENT_VERSION = 1
 # oldest wire version this build interoperates with (ref:
@@ -199,6 +206,35 @@ def pop_headers(payload: Any) -> Optional[Dict[str, Any]]:
     return None
 
 
+def charge_inflight(breaker_service, action: str,
+                    payload: Any) -> Optional[Callable[[], None]]:
+    """Charge the in_flight_requests breaker for an inbound transport
+    message (ref: InboundAggregator.finishAggregation — the message is
+    accounted BEFORE its handler runs and released when the request
+    cycle completes). Returns a release() callback, or None when no
+    breaker service is attached. Raises CircuitBreakingException when
+    the node is out of headroom — the caller turns that into a 429-class
+    remote failure the sender can retry on another copy.
+
+    Sizing re-serializes structured payloads (one extra O(payload) pass
+    per inbound hop, same order as the wire decode that just ran);
+    plumbing the already-known frame length through _dispatch_request
+    would remove it for the TCP transport — a follow-up if profiles
+    show it mattering."""
+    if breaker_service is None:
+        return None
+    breaker = breaker_service.get_breaker(
+        CircuitBreaker.IN_FLIGHT_REQUESTS)
+    nbytes = payload_size_bytes(payload)
+    breaker.add_estimate_bytes_and_maybe_break(
+        nbytes, label=f"<transport_request>[{action}]")
+
+    def release() -> None:
+        breaker.release(nbytes)
+
+    return release
+
+
 def instrument_send(telemetry, action: str, request: Any,
                     handler: ResponseHandler,
                     headers: Optional[Dict[str, Any]]):
@@ -288,14 +324,21 @@ class BaseTransport:
         self._closed = False
         # node telemetry bundle; None keeps instrumented sites one branch
         self.telemetry = None
+        # node breaker service (utils/breaker.py); when wired, inbound
+        # requests charge in_flight_requests before dispatch — the
+        # RequestHandler.can_trip_breaker flag gates which actions may
+        # be shed (coordination/handshake traffic is exempt)
+        self.breaker_service = None
 
     # -- registry ---------------------------------------------------------
 
     def register_handler(self, action: str, handler: Callable,
-                         executor: str = "generic") -> None:
+                         executor: str = "generic",
+                         can_trip_breaker: bool = True) -> None:
         if action in self._handlers:
             raise ValueError(f"handler for [{action}] already registered")
-        self._handlers[action] = RequestHandler(action, handler, executor)
+        self._handlers[action] = RequestHandler(action, handler, executor,
+                                                can_trip_breaker)
 
     def new_request_id(self) -> int:
         with self._id_lock:
@@ -320,8 +363,14 @@ class BaseTransport:
         # payload; the trace context it carries becomes ambient for the
         # duration of the handler (Dapper-style RPC propagation)
         headers = instrument_inbound(self.telemetry, action, payload)
+        release_box: Dict[str, Callable] = {}
 
         def send_response(response: Any, is_error: bool) -> None:
+            # in_flight_requests releases when the request cycle ends
+            # (first completion wins; TransportChannel guards doubles)
+            rel = release_box.pop("release", None)
+            if rel is not None:
+                rel()
             status = STATUS_ERROR if is_error else 0
             reply(_encode_frame(request_id, status, CURRENT_VERSION,
                                 action, response))
@@ -332,6 +381,18 @@ class BaseTransport:
                 ElasticsearchTpuException(
                     f"No handler for action [{action}]"))
             return
+        if self.breaker_service is not None and reg.can_trip_breaker:
+            try:
+                rel = charge_inflight(self.breaker_service, action,
+                                      payload)
+                if rel is not None:
+                    release_box["release"] = rel
+            except CircuitBreakingException as e:
+                # shed BEFORE any handler work: the sender sees a typed,
+                # retryable 429-class failure (failover walks to another
+                # copy; replication retries with backoff)
+                channel.send_exception(e)
+                return
 
         def run():
             try:
@@ -700,7 +761,8 @@ class TransportService:
             HANDSHAKE_ACTION,
             lambda req, channel, src: channel.send_response(
                 {"version": CURRENT_VERSION,
-                 "node": self.local_node.to_dict()}))
+                 "node": self.local_node.to_dict()}),
+            can_trip_breaker=False)
         self._sweeper.start()
 
     # -- lifecycle --------------------------------------------------------
@@ -791,12 +853,14 @@ class TransportService:
     # -- request handling -------------------------------------------------
 
     def register_request_handler(self, action: str, handler: Callable,
-                                 executor: str = "generic") -> None:
+                                 executor: str = "generic",
+                                 can_trip_breaker: bool = True) -> None:
         for icpt in self._interceptors:
             wrap = getattr(icpt, "intercept_handler", None)
             if wrap is not None:
                 handler = wrap(action, handler)
-        self.transport.register_handler(action, handler, executor)
+        self.transport.register_handler(action, handler, executor,
+                                        can_trip_breaker)
 
     def send_request(self, node: DiscoveryNode, action: str, request: Any,
                      handler: ResponseHandler,
@@ -860,6 +924,22 @@ class TransportService:
         if "exc" in box:
             raise box["exc"]
         return box["resp"]
+
+
+def wire_breaker_service(transport, breaker_service) -> None:
+    """Attach a node breaker service to every layer of a (possibly
+    wrapped) transport stack — the inbound in_flight_requests charge
+    happens at whichever layer dispatches (BaseTransport in production,
+    DisruptableTransport under simulation); wrapper layers delegate."""
+    seen = set()
+    t = transport
+    while t is not None and id(t) not in seen:
+        seen.add(id(t))
+        try:
+            t.breaker_service = breaker_service
+        except Exception:  # noqa: BLE001 — read-only wrapper layers
+            pass
+        t = getattr(t, "inner", None) or getattr(t, "transport", None)
 
 
 def make_inprocess_cluster_registry() -> Dict[str, InProcessTransport]:

@@ -28,6 +28,10 @@ class CircuitBreaker:
     REQUEST = "request"
     FIELDDATA = "fielddata"
     IN_FLIGHT_REQUESTS = "in_flight_requests"
+    # TPU-native child: device-resident segment/filter-mask bytes — fed
+    # by DeviceSegmentCache admission (search/context.py), where passing
+    # the limit applies LRU eviction pressure before tripping
+    HBM = "hbm"
 
     def __init__(self, name: str, limit_bytes: int, overhead: float = 1.0,
                  parent: "HierarchyCircuitBreakerService" = None):
@@ -47,11 +51,24 @@ class CircuitBreaker:
     def trip_count(self) -> int:
         return self._trip_count
 
+    def set_limit(self, limit_bytes: int) -> None:
+        """Dynamic resize (the `indices.breaker.*.limit` settings are
+        dynamic in the reference; the memory-pressure fault in
+        testing/faults.py shrinks limits mid-flight through this)."""
+        with self._lock:
+            self.limit = int(limit_bytes)
+
+    def _on_trip(self, label: str) -> None:
+        self._trip_count += 1
+        svc = self._parent
+        if svc is not None and svc.metrics is not None:
+            svc.metrics.inc("breaker.tripped", breaker=self.name)
+
     def add_estimate_bytes_and_maybe_break(self, bytes_: int, label: str = "") -> int:
         with self._lock:
             new_used = self._used + bytes_
             if self.limit >= 0 and new_used * self.overhead > self.limit:
-                self._trip_count += 1
+                self._on_trip(label)
                 raise CircuitBreakingException(
                     f"[{self.name}] Data too large, data for [{label}] would be "
                     f"[{_human_size(new_used)}/{new_used}b], which is larger than "
@@ -89,37 +106,55 @@ class HierarchyCircuitBreakerService:
 
     def __init__(self, total_limit_bytes: int = 4 * 1024 ** 3,
                  request_limit_bytes: int = None,
-                 fielddata_limit_bytes: int = None):
+                 fielddata_limit_bytes: int = None,
+                 hbm_limit_bytes: int = None,
+                 metrics=None):
         self.total_limit = total_limit_bytes
         self._breakers: Dict[str, CircuitBreaker] = {}
         self._parent_trip_count = 0
+        # telemetry sink (MetricsRegistry or None) — `breaker.tripped`
+        # counters per child, `breaker.parent.tripped` for the parent
+        self.metrics = metrics
         if request_limit_bytes is None:
             request_limit_bytes = int(total_limit_bytes * 0.6)
         if fielddata_limit_bytes is None:
             fielddata_limit_bytes = int(total_limit_bytes * 0.4)
+        if hbm_limit_bytes is None:
+            hbm_limit_bytes = total_limit_bytes
         for name, limit in [
             (CircuitBreaker.REQUEST, request_limit_bytes),
             (CircuitBreaker.FIELDDATA, fielddata_limit_bytes),
             (CircuitBreaker.IN_FLIGHT_REQUESTS, total_limit_bytes),
+            (CircuitBreaker.HBM, hbm_limit_bytes),
         ]:
             self._breakers[name] = CircuitBreaker(name, limit, parent=self)
 
     def get_breaker(self, name: str) -> CircuitBreaker:
         return self._breakers[name]
 
+    def breaker_names(self):
+        return list(self._breakers)
+
     def check_parent_limit(self, label: str):
-        total = sum(b.used for b in self._breakers.values())
-        if total > self.total_limit:
+        # HBM is device memory, not host memory: it has its own budget
+        # and doesn't consume the parent (host) allowance
+        total = sum(b.used for name, b in self._breakers.items()
+                    if name != CircuitBreaker.HBM)
+        if self.total_limit >= 0 and total > self.total_limit:
             self._parent_trip_count += 1
+            if self.metrics is not None:
+                self.metrics.inc("breaker.tripped", breaker="parent")
             raise CircuitBreakingException(
                 f"[parent] Data too large, data for [{label}] would be [{total}b], "
                 f"which is larger than the limit of [{self.total_limit}b]",
                 bytes_wanted=total, bytes_limit=self.total_limit)
 
     def stats(self) -> dict:
+        host_used = sum(b.used for name, b in self._breakers.items()
+                        if name != CircuitBreaker.HBM)
         return {
             "parent": {"limit_size_in_bytes": self.total_limit,
-                       "estimated_size_in_bytes": sum(b.used for b in self._breakers.values()),
+                       "estimated_size_in_bytes": host_used,
                        "tripped": self._parent_trip_count},
             **{name: {"limit_size_in_bytes": b.limit,
                       "estimated_size_in_bytes": b.used,
@@ -128,13 +163,57 @@ class HierarchyCircuitBreakerService:
         }
 
 
+def payload_size_bytes(payload) -> int:
+    """Byte-size estimate of an arbitrary request/operation payload for
+    breaker and indexing-pressure accounting — THE shared sizer (the
+    transport inbound charge and IndexingPressure both use it, so the
+    two accountings can never drift): raw byte/str payloads by length,
+    structured payloads by json-encoded length (proportional to the
+    host memory they occupy in flight), with a conservative fallback."""
+    if payload is None:
+        return 0
+    if isinstance(payload, (bytes, bytearray, str)):
+        return len(payload)
+    import json
+    try:
+        return len(json.dumps(payload, default=str))
+    except (TypeError, ValueError):
+        import sys
+        return sys.getsizeof(payload)
+
+
+def build_breaker_service(settings_get,
+                          metrics=None) -> HierarchyCircuitBreakerService:
+    """Construct a node breaker service from settings — the ONE place
+    `indices.breaker.*.limit` parsing and defaulting lives (Node and
+    ClusterNode share it). An explicit 0 limit is honored (reject
+    everything), not silently replaced by the default."""
+    from elasticsearch_tpu.common.settings import parse_byte_size
+
+    def limit(key, default):
+        raw = settings_get(key)
+        return parse_byte_size(raw, key) if raw is not None else default
+
+    total = limit("indices.breaker.total.limit", 4 * 1024 ** 3)
+    request = limit("indices.breaker.request.limit", None)
+    return HierarchyCircuitBreakerService(
+        total_limit_bytes=total,
+        request_limit_bytes=(request if request is not None
+                             else int(total * 0.6)),
+        fielddata_limit_bytes=limit("indices.breaker.fielddata.limit",
+                                    None),
+        hbm_limit_bytes=limit("indices.breaker.hbm.limit", None),
+        metrics=metrics)
+
+
 class NoneCircuitBreakerService(HierarchyCircuitBreakerService):
     def __init__(self):
         super().__init__(total_limit_bytes=-1)
         self._breakers = {
             name: NoneCircuitBreaker(name)
             for name in (CircuitBreaker.REQUEST, CircuitBreaker.FIELDDATA,
-                         CircuitBreaker.IN_FLIGHT_REQUESTS)
+                         CircuitBreaker.IN_FLIGHT_REQUESTS,
+                         CircuitBreaker.HBM)
         }
 
     def check_parent_limit(self, label: str):

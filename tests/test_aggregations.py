@@ -851,3 +851,18 @@ def test_normalize_methods_on_metric(search):
     e = np.exp(vals - vals.max())
     got = [b["n"]["value"] for b in r["days"]["buckets"]]
     assert got == pytest.approx(list(e / e.sum()))
+
+
+def test_top_hits_string_sort_specs(search):
+    """ES accepts `"sort": "price"` and `"sort": ["price"]` — both must
+    normalize to {field: {order: asc}} instead of crashing (satellite:
+    string specs reached `.items()` unpacked)."""
+    for sort_spec in ("price", ["price"]):
+        a = agg(search, {
+            "by_cat": {"terms": {"field": "category", "size": 1},
+                       "aggs": {"top": {"top_hits": {
+                           "size": 2, "sort": sort_spec}}}}})
+        top = a["by_cat"]["buckets"][0]["top"]["hits"]["hits"]
+        prices = [h["_source"]["price"] for h in top]
+        assert prices == [1.0, 2.0], sort_spec
+        assert top[0]["sort"] == [1.0]

@@ -1,0 +1,578 @@
+"""Backpressure & memory protection: circuit breakers on the live path,
+indexing pressure on the write path, and graceful shedding under
+memory-pressure fault injection.
+
+The contract under test (ref: HierarchyCircuitBreakerService +
+IndexingPressure semantics):
+
+- inbound transport messages charge ``in_flight_requests`` and release
+  on completion; a trip is a typed, RETRYABLE failure the coordinator
+  fails over to another copy (partial results, never a crash/hang);
+- bulks charge coordinating/primary/replica in-flight bytes and get
+  retryable 429s past the limit — used bytes return to ZERO once every
+  in-flight operation completes (release-on-completion invariant);
+- a replica 429 is NOT a stale copy: the primary retries with backoff
+  and never reports shard-failed to the master for backpressure;
+- HBM admission applies LRU eviction pressure before tripping.
+
+Chaos scenarios are @pytest.mark.chaos(seed=N) — a red run echoes its
+seed and replays with ``pytest <nodeid> --chaos-seed=N``.
+"""
+
+import numpy as np
+import pytest
+from test_search_failover import ChaosCluster, _hit_ids, _setup
+
+from elasticsearch_tpu.cluster.data_node import (
+    SHARD_FAILED_ACTION,
+    SHARD_BULK_REPLICA,
+)
+from elasticsearch_tpu.cluster.search_action import (
+    QUERY_PHASE_ACTION,
+    is_retryable_failure,
+)
+from elasticsearch_tpu.common.errors import (
+    CircuitBreakingException,
+    EsRejectedExecutionException,
+)
+from elasticsearch_tpu.index.mapper import MapperService
+from elasticsearch_tpu.index.pressure import IndexingPressure
+from elasticsearch_tpu.index.segment import SegmentWriter
+from elasticsearch_tpu.ops.device import DeviceSegment
+from elasticsearch_tpu.search.context import DeviceSegmentCache
+from elasticsearch_tpu.testing.deterministic import (
+    DeterministicTaskQueue,
+    DisruptableTransport,
+    SimNetwork,
+)
+from elasticsearch_tpu.testing.faults import MemoryPressureFault
+from elasticsearch_tpu.transport.transport import (
+    DiscoveryNode,
+    ResponseHandler,
+)
+from elasticsearch_tpu.utils.breaker import (
+    CircuitBreaker,
+    HierarchyCircuitBreakerService,
+)
+
+# ---------------------------------------------------------------------------
+# IndexingPressure unit contract
+# ---------------------------------------------------------------------------
+
+
+def test_indexing_pressure_rejects_past_limit_and_releases():
+    ip = IndexingPressure(limit_bytes=1000)
+    r1 = ip.mark_coordinating_operation_started(600)
+    with pytest.raises(EsRejectedExecutionException) as ei:
+        ip.mark_primary_operation_started(600)
+    assert ei.value.status == 429
+    assert ip.rejections("primary") == 1
+    # a rejected mark must not leak accounting
+    assert ip.current_bytes() == 600
+    r1()
+    assert ip.current_bytes() == 0
+    # release is idempotent
+    r1()
+    assert ip.current_bytes() == 0
+
+
+def test_indexing_pressure_replica_headroom():
+    """Replica ops get 1.5x headroom — replication is shed LAST."""
+    ip = IndexingPressure(limit_bytes=1000)
+    r = ip.mark_coordinating_operation_started(900)
+    # coordinating/primary budget exhausted...
+    with pytest.raises(EsRejectedExecutionException):
+        ip.mark_primary_operation_started(200)
+    # ...but a replica op still fits under the 1.5x limit
+    rr = ip.mark_replica_operation_started(400)
+    with pytest.raises(EsRejectedExecutionException):
+        ip.mark_replica_operation_started(400)
+    assert ip.rejections("replica") == 1
+    rr()
+    r()
+    assert ip.current_bytes() == 0
+
+
+def test_indexing_pressure_stats_shape():
+    ip = IndexingPressure(limit_bytes=5000)
+    r = ip.mark_coordinating_operation_started(100)
+    s = ip.stats()["memory"]
+    assert s["current"]["coordinating_in_bytes"] == 100
+    assert s["current"]["all_in_bytes"] == 100
+    assert s["current"]["combined_coordinating_and_primary_in_bytes"] == 100
+    assert s["total"]["coordinating_in_bytes"] == 100
+    assert s["limit_in_bytes"] == 5000
+    r()
+    s = ip.stats()["memory"]
+    assert s["current"]["all_in_bytes"] == 0
+    assert s["total"]["coordinating_in_bytes"] == 100   # cumulative
+    assert s["total"]["peak_all_in_bytes"] == 100
+    for key in ("coordinating_rejections", "primary_rejections",
+                "replica_rejections"):
+        assert s["total"][key] == 0
+
+
+# ---------------------------------------------------------------------------
+# in_flight_requests at transport receive
+# ---------------------------------------------------------------------------
+
+
+def _sim_pair(seed=1, total_limit=100_000):
+    queue = DeterministicTaskQueue(seed=seed)
+    network = SimNetwork(queue)
+    a = DisruptableTransport(DiscoveryNode(node_id="a", name="a"), network)
+    b = DisruptableTransport(DiscoveryNode(node_id="b", name="b"), network)
+    svc = HierarchyCircuitBreakerService(total_limit_bytes=total_limit)
+    b.breaker_service = svc
+    return queue, a, b, svc
+
+
+def _send(queue, a, b, action, payload, timeout=10.0):
+    box = {}
+    a.send_request(b.local_node, action, payload,
+                   ResponseHandler(lambda r: box.setdefault("resp", r),
+                                   lambda e: box.setdefault("exc", e)),
+                   timeout=timeout)
+    queue.run_for(timeout + 1)
+    return box
+
+
+def test_inflight_breaker_charges_during_handler_and_releases():
+    queue, a, b, svc = _sim_pair()
+    br = svc.get_breaker(CircuitBreaker.IN_FLIGHT_REQUESTS)
+    seen = {}
+
+    def handler(req, channel, src):
+        seen["used_during"] = br.used
+        channel.send_response({"ok": True})
+
+    b.register_request_handler("test/echo", handler)
+    box = _send(queue, a, b, "test/echo", {"payload": "x" * 256})
+    assert box.get("resp") == {"ok": True}
+    assert seen["used_during"] > 0
+    # release-on-completion: zero after the response went out
+    assert br.used == 0
+
+
+@pytest.mark.chaos(seed=5)
+def test_inflight_breaker_trip_is_typed_and_retryable(chaos_seed):
+    queue, a, b, svc = _sim_pair(seed=chaos_seed, total_limit=10)
+    called = {"n": 0}
+
+    def handler(req, channel, src):
+        called["n"] += 1
+        channel.send_response({"ok": True})
+
+    b.register_request_handler("indices:data/read/x", handler)
+    box = _send(queue, a, b, "indices:data/read/x",
+                {"payload": "y" * 256})
+    assert called["n"] == 0, "handler must be shed BEFORE it runs"
+    exc = box["exc"]
+    assert is_retryable_failure(exc), \
+        "a breaker trip must classify retryable (another copy may fit)"
+    assert "circuit_breaking" in str(
+        getattr(exc, "remote_type", "")).lower().replace(
+            "circuitbreaking", "circuit_breaking")
+    assert svc.get_breaker(
+        CircuitBreaker.IN_FLIGHT_REQUESTS).trip_count == 1
+    assert svc.get_breaker(CircuitBreaker.IN_FLIGHT_REQUESTS).used == 0
+
+
+def test_exempt_actions_bypass_inflight_breaker():
+    queue, a, b, svc = _sim_pair(total_limit=10)
+    done = {}
+
+    def handler(req, channel, src):
+        done["ran"] = True
+        channel.send_response({"ok": True})
+
+    b.register_request_handler("internal:cluster/coordination/x", handler,
+                               can_trip_breaker=False)
+    box = _send(queue, a, b, "internal:cluster/coordination/x",
+                {"payload": "z" * 256})
+    assert done.get("ran") and box.get("resp") == {"ok": True}
+
+
+# ---------------------------------------------------------------------------
+# HBM admission: LRU eviction pressure before tripping
+# ---------------------------------------------------------------------------
+
+MAPPINGS = {"properties": {"body": {"type": "text"}, "n": {"type": "long"}}}
+WORDS = ["alpha", "beta", "gamma", "delta", "fox", "dog"]
+
+
+def build_segment(n_docs=40, name="seg0", seed=3):
+    rng = np.random.default_rng(seed)
+    svc = MapperService(mappings=MAPPINGS)
+    w = SegmentWriter()
+    for i in range(n_docs):
+        w.add(svc.parse(str(i), {
+            "body": " ".join(rng.choice(WORDS, 6)), "n": int(i)}))
+    return w.build(name)
+
+
+def _hbm_cache(limit_bytes):
+    svc = HierarchyCircuitBreakerService(total_limit_bytes=1 << 30,
+                                         hbm_limit_bytes=limit_bytes)
+    cache = DeviceSegmentCache()
+    cache.set_breaker(svc.get_breaker(CircuitBreaker.HBM))
+    return cache, svc.get_breaker(CircuitBreaker.HBM)
+
+
+def test_hbm_admission_evicts_lru_before_tripping():
+    segs = [build_segment(40, f"bp{i}", seed=i) for i in range(3)]
+    one = DeviceSegment(segs[0]).hbm_bytes()
+    # room for ~2.5 segments: the third admission must evict the LRU
+    cache, br = _hbm_cache(int(one * 2.5))
+    cache.get(segs[0])
+    cache.get(segs[1])
+    used_two = br.used
+    assert used_two > 0
+    cache.get(segs[2])
+    assert cache.hbm_breaker_evictions == 1
+    assert br.trip_count == 0, "eviction satisfied the admission: no trip"
+    stats = cache.hbm_stats()
+    assert stats["segments"] == 2
+    assert br.used <= int(one * 2.5)
+    # the LRU victim was segs[0] (oldest untouched)
+    assert segs[0].name not in {n for n in cache._cache}
+
+
+def test_hbm_admission_respects_recency():
+    segs = [build_segment(40, f"lru{i}", seed=10 + i) for i in range(3)]
+    one = DeviceSegment(segs[0]).hbm_bytes()
+    cache, br = _hbm_cache(int(one * 2.5))
+    cache.get(segs[0])
+    cache.get(segs[1])
+    cache.get(segs[0])          # touch: segs[1] is now least-recent
+    cache.get(segs[2])
+    assert segs[1].name not in cache._cache
+    assert segs[0].name in cache._cache
+
+
+def test_hbm_trips_only_when_eviction_cannot_free_enough():
+    seg = build_segment(60, "big0", seed=42)
+    one = DeviceSegment(seg).hbm_bytes()
+    cache, br = _hbm_cache(one // 2)
+    with pytest.raises(CircuitBreakingException):
+        cache.get(seg)
+    assert br.trip_count == 1
+    assert br.used == 0, "failed admission must not leak accounting"
+    assert cache.hbm_stats()["segments"] == 0
+
+
+def test_hbm_filter_mask_admission_accounted_and_released():
+    seg = build_segment(40, "fm0", seed=7)
+    one = DeviceSegment(seg).hbm_bytes()
+    cache, br = _hbm_cache(one + 8192)
+    dev = cache.get(seg)
+    base = br.used
+    dev.filter_mask("body", ("fox",))
+    assert br.used == base + dev.n_docs_padded
+    # evicting the segment returns EVERYTHING it charged (masks incl.)
+    cache.evict([seg.name])
+    assert br.used == 0
+
+
+def test_hbm_filter_mask_trips_when_no_headroom():
+    seg = build_segment(40, "fm1", seed=8)
+    one = DeviceSegment(seg).hbm_bytes()
+    cache, br = _hbm_cache(one + 10)   # segment fits, masks don't
+    dev = cache.get(seg)
+    with pytest.raises(CircuitBreakingException):
+        dev.filter_mask("body", ("fox",))
+    # the failed mask is NOT cached, and accounting balances
+    assert dev.cache_stats()["filter_mask"]["entries"] == 0
+    cache.evict([seg.name])
+    assert br.used == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos: breaker trip → failover → partial results
+# ---------------------------------------------------------------------------
+
+
+def _squeeze_breakers(cluster, node_id):
+    node = cluster.cluster_nodes[node_id]
+    fault = MemoryPressureFault(breaker_service=node.breaker_service,
+                                factor=0.0, floor_bytes=0)
+    fault.apply()
+    return fault
+
+
+@pytest.mark.chaos(seed=131)
+def test_breaker_trip_fails_over_to_other_copy(tmp_path, chaos_seed):
+    """Every copy-holding node but one squeezed to zero: searches still
+    return the full, identical top-k by failing over to the healthy
+    copies (failed == 0, no crash, no hang)."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    _setup(cluster, index="logs", shards=2, replicas=1, n=20)
+    coord = cluster.coordinator_excluding("dn-0")
+    body = {"query": {"match": {"body": "fox"}}, "sort": [{"n": "desc"}],
+            "size": 5}
+    healthy = cluster.call(coord.search, "logs", body)
+    assert healthy["_shards"]["failed"] == 0, f"seed={chaos_seed}"
+
+    _squeeze_breakers(cluster, "dn-0")
+    for _ in range(3):
+        resp = cluster.call(coord.search, "logs", body, timeout=60)
+        assert _hit_ids(resp) == _hit_ids(healthy), \
+            f"seed={chaos_seed}: failover changed the top-k"
+        assert resp["_shards"]["failed"] == 0, \
+            f"seed={chaos_seed}: {resp['_shards']}"
+
+
+@pytest.mark.chaos(seed=137)
+def test_breaker_trip_partial_results_with_typed_failure(tmp_path,
+                                                         chaos_seed):
+    """The ONLY copy of one shard lives on a squeezed node: the search
+    completes as partial results with a typed circuit_breaking_exception
+    in _shards.failures — never an exception, never a hang."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    master = _setup(cluster, index="b", shards=2, replicas=1, n=12)
+    cluster.call(master.create_index, "a",
+                 number_of_shards=1, number_of_replicas=0)
+    cluster.run_for(30)
+    resp = cluster.call(master.bulk, "a",
+                        [{"op": "index", "id": f"a-{i}",
+                          "source": {"body": "lonely fox", "n": i}}
+                         for i in range(3)])
+    assert resp["errors"] == [], f"seed={chaos_seed}"
+    cluster.call(master.refresh)
+    cluster.run_for(5)
+
+    a_node = cluster.primary_node_id("a", 0)
+    coord = cluster.coordinator_excluding(a_node)
+    _squeeze_breakers(cluster, a_node)
+
+    resp = cluster.call(
+        coord.search, "a,b",
+        {"query": {"match": {"body": "fox"}}, "sort": [{"n": "desc"}],
+         "size": 20, "allow_partial_search_results": True}, timeout=60)
+    sec = resp["_shards"]
+    assert sec["total"] == 3 and sec["failed"] == 1, \
+        f"seed={chaos_seed}: {sec}"
+    failure = sec["failures"][0]
+    assert failure["index"] == "a", f"seed={chaos_seed}: {failure}"
+    assert failure["reason"]["type"] == "circuit_breaking_exception", \
+        f"seed={chaos_seed}: {failure}"
+    # b answered completely through healthy copies
+    assert resp["hits"]["total"]["value"] == 12, f"seed={chaos_seed}"
+    assert all(h["_index"] == "b" for h in resp["hits"]["hits"])
+    # the squeezed node really tripped (the fault fired)
+    squeezed = cluster.cluster_nodes[a_node].breaker_service
+    assert squeezed.get_breaker(
+        CircuitBreaker.IN_FLIGHT_REQUESTS).trip_count >= 1
+    # telemetry counted it (`breaker.tripped{breaker=...}` series)
+    metrics = cluster.cluster_nodes[a_node].telemetry.metrics
+    assert metrics.get_value("breaker.tripped",
+                             breaker="in_flight_requests") >= 1
+
+
+# ---------------------------------------------------------------------------
+# chaos: indexing-pressure 429s — reject, release, retry, recover
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos(seed=141)
+def test_coordinating_rejection_is_retryable_429(tmp_path, chaos_seed):
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    master = _setup(cluster, index="w", shards=1, replicas=0, n=4)
+    fault = MemoryPressureFault(
+        indexing_pressure=master.indexing_pressure, factor=0.0)
+    fault.apply()
+    items = [{"op": "index", "id": "r-1",
+              "source": {"body": "squeezed", "n": 1}}]
+    with pytest.raises(EsRejectedExecutionException) as ei:
+        cluster.call(master.bulk, "w", items)
+    assert ei.value.status == 429, f"seed={chaos_seed}"
+    assert master.indexing_pressure.rejections("coordinating") == 1
+    # after restore the SAME bulk succeeds (retry-after-release contract)
+    fault.restore()
+    resp = cluster.call(master.bulk, "w", items)
+    assert resp["errors"] == [], f"seed={chaos_seed}: {resp}"
+    assert master.indexing_pressure.current_bytes() == 0
+
+
+@pytest.mark.chaos(seed=149)
+def test_primary_rejection_gives_items_429_then_retry_succeeds(
+        tmp_path, chaos_seed):
+    """Primary-stage rejection: items carry a retryable 429 (typed
+    es_rejected_execution_exception), and the same bulk succeeds after
+    the pressure releases — with used bytes back to zero everywhere."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    master = _setup(cluster, index="p", shards=1, replicas=0, n=4)
+    p_node = cluster.primary_node_id("p", 0)
+    coord = cluster.coordinator_excluding(p_node)
+    fault = MemoryPressureFault(
+        indexing_pressure=cluster.cluster_nodes[p_node].indexing_pressure,
+        factor=0.0)
+    fault.apply()
+
+    items = [{"op": "index", "id": "p-9",
+              "source": {"body": "pressured fox", "n": 9}}]
+    resp = cluster.call(coord.bulk, "p", items)
+    assert resp["errors"], f"seed={chaos_seed}: expected a 429 bulk"
+    item = resp["items"][0]
+    assert item["status"] == 429, f"seed={chaos_seed}: {item}"
+    assert item["error"]["type"] == "es_rejected_execution_exception", \
+        f"seed={chaos_seed}: {item}"
+    assert cluster.cluster_nodes[p_node].indexing_pressure.rejections(
+        "primary") >= 1
+
+    fault.restore()
+    resp = cluster.call(coord.bulk, "p", items)
+    assert resp["errors"] == [], f"seed={chaos_seed}: {resp}"
+    cluster.call(master.refresh)
+    cluster.run_for(5)
+    found = cluster.call(coord.search, "p",
+                         {"query": {"match": {"body": "pressured"}}})
+    assert found["hits"]["total"]["value"] == 1, f"seed={chaos_seed}"
+    # release-on-completion invariant, cluster-wide
+    for cn in cluster.cluster_nodes.values():
+        assert cn.indexing_pressure.current_bytes() == 0, \
+            f"seed={chaos_seed}: leaked in-flight bytes on " \
+            f"{cn.local_node.name}"
+
+
+@pytest.mark.chaos(seed=151)
+def test_replica_429_retries_and_never_marks_stale(tmp_path, chaos_seed):
+    """An overloaded replica rejecting bulks is retried with backoff by
+    the primary and must NEVER reach the master as shard-failed; once
+    pressure releases the replica catches up."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    master = cluster.stabilise()
+    cluster.call(master.create_index, "r",
+                 number_of_shards=1, number_of_replicas=1)
+    cluster.run_for(60)
+    p_node = cluster.primary_node_id("r", 0)
+    r_node = next(iter(cluster.shard_node_ids("r", 0) - {p_node}))
+    replica_cn = cluster.cluster_nodes[r_node]
+    # make sure the PRIMARY's applied state has the replica started
+    # BEFORE any write (a node can miss one publication and only catch
+    # up on the next state change — nudge with a no-op index until it
+    # has), so every op below replicates and checkpoints stay aligned
+    primary_dn = cluster.cluster_nodes[p_node].data_node
+    for attempt in range(5):
+        if primary_dn._active_replicas("r", 0):
+            break
+        cluster.call(master.create_index, f"nudge{attempt}",
+                     number_of_shards=1, number_of_replicas=0)
+        cluster.run_for(30)
+    assert primary_dn._active_replicas("r", 0), \
+        f"seed={chaos_seed}: primary never saw the started replica"
+    resp = cluster.call(master.bulk, "r",
+                        [{"op": "index", "id": f"doc-{i}",
+                          "source": {"body": "seed fox", "n": i}}
+                         for i in range(4)])
+    assert resp["errors"] == [], f"seed={chaos_seed}: {resp}"
+    cluster.run_for(5)
+    fault = MemoryPressureFault(
+        indexing_pressure=replica_cn.indexing_pressure, factor=0.0)
+    fault.apply()
+    # pressure drains mid-flight (virtual time), while the primary is
+    # still backing off — the retry then succeeds
+    cluster.queue.schedule(3.0, fault.restore, "restore-pressure")
+
+    shard_failed_before = cluster.injector.send_count(SHARD_FAILED_ACTION)
+    replica_sends_before = cluster.injector.send_count(SHARD_BULK_REPLICA)
+    # coordinate from a node whose own (coordinating-stage) pressure is
+    # NOT squeezed — only the replica stage on r_node is under pressure
+    coord = cluster.coordinator_excluding(r_node)
+    resp = cluster.call(
+        coord.bulk, "r",
+        [{"op": "index", "id": "r-9",
+          "source": {"body": "late replica", "n": 9}}], timeout=90)
+    assert resp["errors"] == [], f"seed={chaos_seed}: {resp}"
+    # the replica rejected at least once, the primary retried
+    assert replica_cn.indexing_pressure.rejections("replica") >= 1, \
+        f"seed={chaos_seed}: fault never fired"
+    assert cluster.injector.send_count(SHARD_BULK_REPLICA) \
+        > replica_sends_before + 1, f"seed={chaos_seed}: no retry sent"
+    # NEVER a shard-failed master action for backpressure
+    assert cluster.injector.send_count(SHARD_FAILED_ACTION) == \
+        shard_failed_before, \
+        f"seed={chaos_seed}: backpressure marked the replica stale"
+    # the replica caught up once pressure released
+    cluster.run_for(10)
+    p_shard = cluster.cluster_nodes[p_node].data_node.shards[("r", 0)]
+    r_shard = replica_cn.data_node.shards[("r", 0)]
+    assert r_shard.engine.tracker.checkpoint == \
+        p_shard.engine.tracker.max_seq_no, f"seed={chaos_seed}"
+    for cn in cluster.cluster_nodes.values():
+        assert cn.indexing_pressure.current_bytes() == 0
+
+
+@pytest.mark.chaos(seed=157)
+def test_memory_pressure_fault_shrinks_limits_mid_flight(tmp_path,
+                                                         chaos_seed):
+    """The seeded memory-pressure fault lands at a scheduled virtual
+    time: searches before it are whole, searches under it complete as
+    partial results (or fail over), and after restore the node serves
+    normally again — no crash, no hang, replayable from the seed."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    master = _setup(cluster, index="mid", shards=2, replicas=0, n=16)
+    some_node = cluster.primary_node_id("mid", 0)
+    coord = cluster.coordinator_excluding(some_node)
+    node = cluster.cluster_nodes[some_node]
+    fault = MemoryPressureFault(breaker_service=node.breaker_service,
+                                factor=0.0)
+    fault.schedule(cluster.queue, delay=5.0, restore_after=10.0)
+
+    body = {"query": {"match": {"body": "fox"}},
+            "allow_partial_search_results": True, "size": 16}
+    before = cluster.call(coord.search, "mid", body)
+    assert before["_shards"]["failed"] == 0, f"seed={chaos_seed}"
+    cluster.run_for(6.0)          # the squeeze has landed
+    during = cluster.call(coord.search, "mid", body, timeout=60)
+    assert during["_shards"]["failed"] == 1, \
+        f"seed={chaos_seed}: {during['_shards']}"
+    assert during["_shards"]["failures"][0]["reason"]["type"] == \
+        "circuit_breaking_exception", f"seed={chaos_seed}"
+    cluster.run_for(10.0)         # restore has landed
+    after = cluster.call(coord.search, "mid", body)
+    assert after["_shards"]["failed"] == 0, f"seed={chaos_seed}"
+    assert _hit_ids(after) == _hit_ids(before), f"seed={chaos_seed}"
+
+
+@pytest.mark.chaos(seed=163)
+def test_same_seed_same_backpressure_same_outcome(tmp_path, chaos_seed):
+    """Replayability: the breaker-squeeze schedule and the resulting
+    response are a pure function of the seed."""
+    def run(path):
+        cluster = ChaosCluster(3, path, seed=chaos_seed)
+        master = _setup(cluster, index="rp", shards=2, replicas=1, n=10)
+        node_id = cluster.primary_node_id("rp", 0)
+        _squeeze_breakers(cluster, node_id)
+        coord = cluster.coordinator_excluding(node_id)
+        resp = cluster.call(
+            coord.search, "rp",
+            {"query": {"match": {"body": "fox"}},
+             "sort": [{"n": "desc"}], "size": 10}, timeout=60)
+        trips = cluster.cluster_nodes[node_id].breaker_service \
+            .get_breaker(CircuitBreaker.IN_FLIGHT_REQUESTS).trip_count
+        return (_hit_ids(resp), resp["_shards"]["failed"], trips)
+
+    out_a = run(tmp_path / "a")
+    out_b = run(tmp_path / "b")
+    assert out_a == out_b, f"seed={chaos_seed}: {out_a} != {out_b}"
+
+
+def test_set_breaker_after_warmup_charges_residents_fully():
+    """Wiring the hbm breaker AFTER warm-up (masks already built) must
+    charge each resident segment's FULL hbm bytes — masks included —
+    and balance back to zero on eviction."""
+    seg = build_segment(40, "warm0", seed=9)
+    cache = DeviceSegmentCache()
+    dev = cache.get(seg)                 # built unwired
+    dev.filter_mask("body", ("fox",))    # mask built before wiring
+    svc = HierarchyCircuitBreakerService(total_limit_bytes=1 << 30,
+                                         hbm_limit_bytes=1 << 30)
+    br = svc.get_breaker(CircuitBreaker.HBM)
+    cache.set_breaker(br)
+    assert br.used == dev.hbm_bytes()
+    # post-wiring mask builds/evictions stay balanced on top
+    dev.filter_mask("body", ("dog",))
+    assert br.used == dev.hbm_bytes()
+    cache.evict([seg.name])
+    assert br.used == 0

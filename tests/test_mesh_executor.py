@@ -180,3 +180,26 @@ def test_mesh_multi_segment_shards(node):
         # fetch resolves composite docids to the right segment-local doc
         for h in r_mesh["hits"]["hits"]:
             assert h["_source"]["views"] == int(h["_id"])
+
+
+def test_mesh_float_pack_overflow_falls_back(node, monkeypatch):
+    """Global ids past the float32-exact ceiling (n_shards * nd_padded
+    >= 2^24) must SKIP the mesh fast path — the packed readback would
+    silently corrupt low docid bits — and serve through the per-shard
+    loop instead."""
+    import elasticsearch_tpu.ops.plan as plan_mod
+    seed(node, "ovf", n_shards=4, n_docs=40)
+    svc = node.search_service
+    # trip ONLY the mesh-level guard (n_shards * nd_padded vs the
+    # limit); per-segment builds stay legal — their nd is fine
+    monkeypatch.setattr(plan_mod, "PACKED_ID_LIMIT", 1)
+    monkeypatch.setattr(plan_mod, "check_packed_id_limit",
+                        lambda nd, where: None)
+    before = svc.mesh_executor.mesh_searches
+    r = search(node, "ovf", {"match": {"title": "amber"}})
+    assert svc.mesh_executor.mesh_searches == before, \
+        "overflow-sized layout must not take the mesh path"
+    # the per-shard fallback still answers correctly
+    assert r["hits"]["total"]["value"] > 0
+    for h in r["hits"]["hits"]:
+        assert "amber" in h["_source"]["title"]

@@ -224,3 +224,64 @@ def test_sharded_hybrid_rrf_replica_mesh(sharded):
     # both queries used the same BM25 selection → same doc SETS from the
     # bm25 branch; scores include per-query knn so values differ
     assert (vals[0] > 0).any() and (vals[1] > 0).any()
+
+
+# ---------------------------------------------------------------------------
+# int32 global-id overflow: log-and-fall-back (satellite — with x64 off,
+# shard * nd past 2^31 must merge host-side in int64, never wrap)
+# ---------------------------------------------------------------------------
+
+def _bm25_global_reference(pfs, index, terms, idfs, k):
+    ref = {}
+    for s, pf in enumerate(pfs):
+        scores = bm25_ops.bm25_reference_scores(
+            [pf.postings(t) for t in terms], idfs,
+            np.maximum(pf.field_lengths, 1.0), index.avg_len, 1.2, 0.75)
+        for d, sc in enumerate(scores):
+            if sc > 0:
+                ref[s * index.n_docs_padded + d] = sc
+    return sorted(ref.items(), key=lambda kv: (-kv[1], kv[0]))[:k]
+
+
+def test_sharded_bm25_gid_overflow_host_fallback(sharded, monkeypatch):
+    import elasticsearch_tpu.parallel.sharded as sharded_mod
+    mesh, segments, all_docs, index, pfs = sharded
+    terms = ["alpha", "gamma"]
+    n_total = sum(pf.doc_count for pf in pfs)
+    dfs = [sum(int(pf.doc_freq[pf.term_id(t)]) for pf in pfs
+               if pf.term_id(t) >= 0) for t in terms]
+    idfs = [bm25_ops.idf(df, n_total) for df in dfs]
+    sel, wsel = _select(pfs, index, terms, idfs)
+    sel = np.broadcast_to(sel[:, None, :], (8, 1, sel.shape[1]))
+    wsel = np.broadcast_to(wsel[:, None, :], (8, 1, wsel.shape[1]))
+    # force the guard: every layout now "exceeds" int32 global ids
+    monkeypatch.setattr(sharded_mod, "GID_INT32_LIMIT", 1)
+    vals, gids = sharded_bm25_topk(index, sel, wsel, k=10)
+    vals, gids = np.asarray(vals)[0], np.asarray(gids)[0]
+    assert gids.dtype == np.int64
+    expected = _bm25_global_reference(pfs, index, terms, idfs, 10)
+    assert gids.tolist() == [g for g, _ in expected]
+    np.testing.assert_allclose(vals, [v for _, v in expected], rtol=2e-5)
+
+
+def test_sharded_knn_gid_overflow_host_fallback(sharded, monkeypatch):
+    import elasticsearch_tpu.parallel.sharded as sharded_mod
+    mesh, segments, all_docs, index, pfs = sharded
+    rng = np.random.default_rng(3)
+    queries = rng.standard_normal((2, 8)).astype(np.float32)
+    monkeypatch.setattr(sharded_mod, "GID_INT32_LIMIT", 1)
+    vals, gids = sharded_knn_topk(index, queries, k=5)
+    vals, gids = np.asarray(vals), np.asarray(gids)
+    assert gids.dtype == np.int64
+    for qi in range(2):
+        ref = {}
+        for s, seg in enumerate(segments):
+            vv = seg.vectors["vec"]
+            scores = vv.vectors @ queries[qi]
+            for d in range(seg.n_docs):
+                if vv.has_value[d]:
+                    ref[s * index.n_docs_padded + d] = scores[d]
+        expected = sorted(ref.items(), key=lambda kv: (-kv[1], kv[0]))[:5]
+        np.testing.assert_allclose(vals[qi], [v for _, v in expected],
+                                   rtol=1e-4, atol=1e-5)
+        assert gids[qi].tolist() == [g for g, _ in expected]

@@ -364,3 +364,27 @@ def test_script_score_min_score_falls_back(searcher):
     q2 = parse_query(body).rewrite(searcher)
     assert compile_plan(q2, searcher) is None
     assert_agree(searcher, body, require_plan=False)
+
+
+# ---------------------------------------------------------------------------
+# float-pack id invariant (ops/plan.py pack_result: ids ride readbacks
+# as float32 casts, exact only < 2^24)
+# ---------------------------------------------------------------------------
+
+def test_check_packed_id_limit_boundary():
+    plan_ops.check_packed_id_limit(plan_ops.PACKED_ID_LIMIT - 1, "ok")
+    with pytest.raises(ValueError, match="2\\^24"):
+        plan_ops.check_packed_id_limit(plan_ops.PACKED_ID_LIMIT, "boom")
+
+
+def test_device_segment_build_enforces_pack_limit(monkeypatch):
+    """The invariant is enforced LOUDLY at device-postings build time,
+    not as silent wraparound in a later readback."""
+    from elasticsearch_tpu.ops.device import DeviceSegment
+    svc = MapperService(mappings=MAPPINGS)
+    w = SegmentWriter()
+    w.add(svc.parse("0", {"title": "alpha"}))
+    seg = w.build("packlimit0")
+    monkeypatch.setattr(plan_ops, "PACKED_ID_LIMIT", 64)  # < DOC_PAD
+    with pytest.raises(ValueError, match="float32-packed"):
+        DeviceSegment(seg)
