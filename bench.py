@@ -77,7 +77,7 @@ def remaining_budget() -> float:
 
 
 def emit(metric_text: str, value: float, vs_baseline: float,
-         engine=None, overload=None):
+         engine=None, overload=None, tasks=None):
     _LAST_PAYLOAD.clear()
     _LAST_PAYLOAD.update({
         "metric": metric_text,
@@ -86,6 +86,12 @@ def emit(metric_text: str, value: float, vs_baseline: float,
         "vs_baseline": round(float(vs_baseline), 2)
         if np.isfinite(vs_baseline) else 0.0,
     })
+    if tasks:
+        # task-management rider (transport/tasks.py): peak concurrent
+        # registered tasks + cancellations observed on the serving node.
+        # The standard workload must show cancelled == 0 — a nonzero
+        # count here means something started killing healthy requests
+        _LAST_PAYLOAD["tasks"] = tasks
     if engine:
         # engine observability rider (telemetry/engine.py): compile
         # table + HBM peak, so the perf trajectory records compile-time
@@ -99,6 +105,18 @@ def emit(metric_text: str, value: float, vs_baseline: float,
         # limit regression started shedding healthy traffic
         _LAST_PAYLOAD["overload"] = overload
     print(json.dumps(_LAST_PAYLOAD), flush=True)
+
+
+def _tasks_snapshot(node) -> dict:
+    """Task-manager peaks of the serving node for the BENCH json
+    `tasks` key."""
+    try:
+        s = node.task_manager.stats()
+        return {"peak_concurrent": s["peak_concurrent"],
+                "started": s["started"],
+                "cancelled": s["cancelled"]}
+    except Exception:   # noqa: BLE001 — stats must never kill the bench
+        return {}
 
 
 def _overload_snapshot(node) -> dict:
@@ -935,7 +953,8 @@ def run_rest_path(corpus, queries, truth, tmpdir, kernel="auto",
         if emit_cb is not None:
             emit_cb(hbm_peak_bytes=node.indices_service.device_cache
                     .hbm_stats().get("peak_bytes", 0),
-                    overload=_overload_snapshot(node))
+                    overload=_overload_snapshot(node),
+                tasks=_tasks_snapshot(node))
         node.close()
         return (best_qps, p50, p99, rest_recall, warm_recall, avg_batch,
                 bool_qps, extra)
@@ -1005,7 +1024,8 @@ def run_rest_path(corpus, queries, truth, tmpdir, kernel="auto",
         # standard workload)
         emit_cb(hbm_peak_bytes=node.indices_service.device_cache
                 .hbm_stats().get("peak_bytes", 0),
-                overload=_overload_snapshot(node))
+                overload=_overload_snapshot(node),
+                tasks=_tasks_snapshot(node))
     node.close()
     return (best_qps, p50, p99, rest_recall, warm_recall, avg_batch,
             bool_qps, extra)
@@ -1230,7 +1250,8 @@ def main():
         emit(compose_metric(parts), value,
              value / cpu if cpu else float("nan"),
              engine=_engine_snapshot(parts),
-             overload=parts.get("overload"))
+             overload=parts.get("overload"),
+             tasks=parts.get("tasks"))
 
     rng = np.random.default_rng(12345)
     corpus = build_corpus(rng)

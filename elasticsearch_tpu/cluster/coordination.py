@@ -59,6 +59,11 @@ PUBLISH_STATE_ACTION = "internal:cluster/coordination/publish_state"
 COMMIT_STATE_ACTION = "internal:cluster/coordination/commit_state"
 FOLLOWER_CHECK_ACTION = "internal:coordination/fault_detection/follower_check"
 LEADER_CHECK_ACTION = "internal:coordination/fault_detection/leader_check"
+# publication-lag repair: a node that observes itself behind on a
+# follower check asks the master to resend the committed state (the
+# reference removes laggards via LagDetector; resending keeps a node
+# that merely missed one publication a member instead of churning it)
+RESEND_STATE_ACTION = "internal:cluster/coordination/resend_state"
 
 MODE_CANDIDATE = "candidate"
 MODE_LEADER = "leader"
@@ -350,6 +355,7 @@ class Coordinator:
         self.applied_state: ClusterState = \
             self.coordination_state.last_accepted_state()
         self._applied_versions: Dict[str, int] = {}  # lag detector input
+        self._resend_in_flight = False
         self._election_attempts = 0
         self._election_task: Optional[Cancellable] = None
         self._peer_task: Optional[Cancellable] = None
@@ -358,7 +364,9 @@ class Coordinator:
         self._leader_check_task: Optional[Cancellable] = None
         self._leader_failures = 0
         self._publication: Optional[_Publication] = None
-        self._pending_tasks: List[Tuple[str, Callable]] = []
+        # (source, update_fn, on_done, queued_at)
+        self._pending_tasks: List[
+            Tuple[str, Callable, Optional[Callable], float]] = []
         self._started = False
         self._stopped = False
         # last full state each peer acked, for diff publication (ref:
@@ -380,6 +388,7 @@ class Coordinator:
             (COMMIT_STATE_ACTION, self._on_commit),
             (FOLLOWER_CHECK_ACTION, self._on_follower_check),
             (LEADER_CHECK_ACTION, self._on_leader_check),
+            (RESEND_STATE_ACTION, self._on_resend_state),
         ]:
             # cluster-coordination traffic is exempt from the
             # in_flight_requests breaker (ref: TransportService marks
@@ -477,7 +486,7 @@ class Coordinator:
         """A deposed leader must fail queued tasks, not run them under a
         later term (ref: MasterService onNoLongerMaster)."""
         tasks, self._pending_tasks = self._pending_tasks, []
-        for _source, _update, on_done in tasks:
+        for _source, _update, on_done, _queued in tasks:
             if on_done is not None:
                 try:
                     on_done(RuntimeError(f"no longer master: {reason}"))
@@ -865,7 +874,8 @@ class Coordinator:
                          update: Callable[[ClusterState], ClusterState]) -> None:
         """Queue a state-update task; one publication in flight at a time
         (ref: MasterService single-threaded batched queue)."""
-        self._pending_tasks.append((source, update, None))
+        self._pending_tasks.append((source, update, None,
+                                    self.scheduler.now()))
         self._drain_tasks()
 
     def submit_state_update(self, source: str,
@@ -873,8 +883,20 @@ class Coordinator:
                             on_done: Optional[Callable] = None) -> None:
         """Public API for services (create index, shard started, ...)."""
         with self._mutex:
-            self._pending_tasks.append((source, update, on_done))
+            self._pending_tasks.append((source, update, on_done,
+                                        self.scheduler.now()))
             self._drain_tasks()
+
+    def pending_task_summaries(self) -> List[Dict[str, Any]]:
+        """The master-service queue as `_cluster/pending_tasks` renders
+        it (ref: PendingClusterTask): source + time in queue."""
+        with self._mutex:
+            tasks = list(self._pending_tasks)
+            now = self.scheduler.now()
+        return [{"insert_order": i, "priority": "NORMAL", "source": src,
+                 "time_in_queue_millis": int(max(0.0, now - queued)
+                                             * 1000)}
+                for i, (src, _u, _cb, queued) in enumerate(tasks)]
 
     # ---------------------------------------------- voting exclusions
     def add_voting_config_exclusions(self, names, on_done=None) -> None:
@@ -920,7 +942,7 @@ class Coordinator:
         if (self.mode != MODE_LEADER or self._publication is not None
                 or not self._pending_tasks):
             return
-        source, update, on_done = self._pending_tasks.pop(0)
+        source, update, on_done, _queued = self._pending_tasks.pop(0)
         base = self.coordination_state.last_accepted_state()
         try:
             new_state = update(base)
@@ -1101,7 +1123,11 @@ class Coordinator:
             self.transport.send_request(
                 node, FOLLOWER_CHECK_ACTION,
                 {"term": self.current_term(),
-                 "source": self.local_node.to_dict()},
+                 "source": self.local_node.to_dict(),
+                 # the leader's applied version rides every check, so a
+                 # follower that missed a publication notices on the
+                 # next ping and requests a resend
+                 "version": self.applied_state.version},
                 self._handler(ok, fail), timeout=FOLLOWER_CHECK_INTERVAL * 3)
 
         def reschedule():
@@ -1113,6 +1139,10 @@ class Coordinator:
 
         def ok(resp):
             self._follower_failures[node.node_id] = 0
+            # lag-detector input: the version each follower reports
+            # having applied (surfaced as `state_lag` per node)
+            self._applied_versions[node.node_id] = \
+                resp.get("applied_version", 0)
             reschedule()
 
         def fail(exc):
@@ -1164,6 +1194,74 @@ class Coordinator:
         self.peers.setdefault(source.node_id, source)
         channel.send_response({"ok": True,
                                "applied_version": self.applied_state.version})
+        if req.get("version", 0) > self.applied_state.version and \
+                source.node_id != self.local_node.node_id:
+            # we are ≥1 publication behind the leader (a publish we
+            # missed while partitioned/overloaded): request a resend of
+            # the committed state instead of waiting for the next state
+            # change to happen to catch us up
+            self._request_state_resend(source)
+
+    def _request_state_resend(self, leader: DiscoveryNode) -> None:
+        # one resend in flight at a time: every follower check while
+        # still lagging would otherwise trigger another full-state
+        # transfer for the same missed publication
+        if self._resend_in_flight:
+            return
+        self._resend_in_flight = True
+
+        def done():
+            self._resend_in_flight = False
+
+        def ok(resp):
+            done()
+            state_d = resp.get("state")
+            if state_d is None:
+                return
+            self._install_resent_state(ClusterState.from_dict(state_d))
+
+        self.transport.send_request(
+            leader, RESEND_STATE_ACTION,
+            {"version": self.applied_state.version,
+             "source": self.local_node.to_dict()},
+            self._handler(ok, lambda e: done()), timeout=30.0)
+
+    def _on_resend_state(self, req, channel, src) -> None:
+        if self.mode != MODE_LEADER:
+            channel.send_exception(CoordinationStateRejectedException(
+                "not the leader"))
+            return
+        if req.get("version", 0) >= self.applied_state.version:
+            channel.send_response({"state": None})
+            return
+        channel.send_response({"state": self.applied_state.to_dict()})
+
+    def _install_resent_state(self, state: ClusterState) -> None:
+        """Install a COMMITTED state resent by the leader: accept and
+        commit are best-effort (either may legitimately reject — e.g.
+        we already accepted but missed only the commit) and the apply is
+        version-guarded; the state already passed a commit quorum, so
+        applying it cannot violate the ballot invariants."""
+        if state.term != self.current_term():
+            return  # stale resend from a deposed leader
+        cs = self.coordination_state
+        try:
+            cs.handle_publish_request(state)
+        except CoordinationStateRejectedException:
+            pass
+        try:
+            state = cs.handle_commit(state.term, state.version)
+        except CoordinationStateRejectedException:
+            pass
+        self._apply_committed(state)
+
+    def state_lag(self) -> Dict[str, int]:
+        """Leader view: how many versions each member's applied state
+        trails the leader's (from follower-check responses)."""
+        lead = self.applied_state.version
+        return {nid: max(0, lead - v)
+                for nid, v in sorted(self._applied_versions.items())
+                if nid in self.applied_state.nodes}
 
     def _start_leader_checker(self) -> None:
         """Follower pings the leader (ref: LeaderChecker.java:66)."""

@@ -97,12 +97,16 @@ class DataNodeService:
     def __init__(self, transport, scheduler, data_path: str,
                  device_cache: Optional[DeviceSegmentCache] = None,
                  breaker_service=None,
-                 indexing_pressure: Optional[IndexingPressure] = None):
+                 indexing_pressure: Optional[IndexingPressure] = None,
+                 task_manager=None):
         self.transport = transport
         self.scheduler = scheduler
         self.local_node: DiscoveryNode = transport.local_node
         self.data_path = data_path
         self.device_cache = device_cache or DeviceSegmentCache()
+        # node task manager: shard-bulk handlers register their work as
+        # children of the remote coordinator's task (None = untracked)
+        self.task_manager = task_manager
         # memory protection: the node breaker service (transport charges
         # in_flight_requests through it) + in-flight indexing bytes
         self.breaker_service = breaker_service
@@ -271,11 +275,19 @@ class DataNodeService:
 
     # ----------------------------------------------------------- writes
 
+    def _register_child(self, action: str, description: str):
+        from elasticsearch_tpu.transport.tasks import (
+            register_child_of_incoming,
+        )
+        return register_child_of_incoming(
+            self.task_manager, action, description=description)
+
     def execute_primary_bulk(self, index: str, shard_id: int,
                              items: List[Dict[str, Any]],
                              on_done: Callable[[List[Dict], Optional[Any]],
                                                None],
-                             op_bytes: Optional[int] = None) -> None:
+                             op_bytes: Optional[int] = None,
+                             task=None) -> None:
         """Run a shard bulk on the local primary, replicate, then call
         on_done(item_results, error). ``error`` is a string for routing
         problems or an exception (typed 429 for indexing-pressure
@@ -309,6 +321,18 @@ class DataNodeService:
         results = []
         ops_for_replicas: List[Dict[str, Any]] = []
         for item in items:
+            if task is not None and task.is_cancelled():
+                # cancellation poll per item batch: items not yet
+                # executed report typed task_cancelled instead of
+                # running (already-executed items stand — bulk items
+                # are independent operations)
+                results.append({
+                    "id": item.get("id"),
+                    "error": {"type": "task_cancelled_exception",
+                              "reason": "task cancelled "
+                              f"[{task.cancellation_reason()}]"},
+                    "status": 400})
+                continue
             try:
                 if item["op"] == "index":
                     r = shard.engine.index(
@@ -368,13 +392,13 @@ class DataNodeService:
                 "max_seq_no": shard.engine.tracker.max_seq_no,
             }
             self._replicate_to_copy(index, shard_id, shard, copy, node,
-                                    payload, one_done)
+                                    payload, one_done, task=task)
 
     def _replicate_to_copy(self, index: str, shard_id: int,
                            shard: LocalShard, copy: ShardRouting,
                            node: DiscoveryNode, payload: Dict[str, Any],
                            one_done: Callable[[], None],
-                           attempt: int = 1) -> None:
+                           attempt: int = 1, task=None) -> None:
         """One replica write, with backpressure-aware failure handling:
         a rejected (429-class) replica bulk retries the SAME copy with
         capped exponential backoff — an overloaded copy is not a stale
@@ -399,7 +423,7 @@ class DataNodeService:
                         backoff,
                         lambda: self._replicate_to_copy(
                             index, shard_id, shard, copy, node, payload,
-                            one_done, attempt + 1),
+                            one_done, attempt + 1, task=task),
                         f"retry replica bulk [{index}][{shard_id}] "
                         f"on {node.name}")
                     return
@@ -422,9 +446,16 @@ class DataNodeService:
                 f"replica write failed: {exc}")
             one_done()
 
-        self.transport.send_request(node, SHARD_BULK_REPLICA, payload,
-                                    ResponseHandler(ok, fail),
-                                    timeout=30.0)
+        from contextlib import nullcontext
+
+        from elasticsearch_tpu.telemetry import context as _telectx
+        with (_telectx.activate_task(self.local_node.node_id, task)
+              if task is not None else nullcontext()):
+            # replica children parent to the PRIMARY's child task, so
+            # `_tasks?group_by=parents` shows the full write tree
+            self.transport.send_request(node, SHARD_BULK_REPLICA, payload,
+                                        ResponseHandler(ok, fail),
+                                        timeout=30.0)
 
     def _active_replicas(self, index: str, shard_id: int
                          ) -> List[Tuple[ShardRouting, DiscoveryNode]]:
@@ -442,7 +473,14 @@ class DataNodeService:
         return out
 
     def _on_primary_bulk(self, req, channel, src) -> None:
+        child = self._register_child(
+            SHARD_BULK_PRIMARY,
+            f"requests[{len(req.get('items', []))}], "
+            f"index[{req['index']}][{req['shard_id']}]")
+
         def on_done(results, error):
+            if child is not None:
+                self.task_manager.unregister(child)
             if error:
                 # exceptions keep their type on the wire (a 429-class
                 # rejection must classify as retryable at the caller)
@@ -454,7 +492,8 @@ class DataNodeService:
 
         self.execute_primary_bulk(req["index"], req["shard_id"],
                                   req["items"], on_done,
-                                  op_bytes=req.get("op_bytes"))
+                                  op_bytes=req.get("op_bytes"),
+                                  task=child)
 
     def _on_replica_bulk(self, req, channel, src) -> None:
         """Ref: TransportShardBulkAction replica path (:417) — apply ops
@@ -462,6 +501,20 @@ class DataNodeService:
         the ops first (1.5x headroom — replica rejections are shed
         last); a rejection travels back typed so the primary retries
         with backoff instead of marking the copy stale."""
+        # registered for observability ONLY — replica ops carry
+        # pre-assigned seqnos, so skipping some mid-stream on a cancel
+        # would punch seqno gaps; the whole (small) batch always applies
+        child = self._register_child(
+            SHARD_BULK_REPLICA,
+            f"requests[{len(req.get('ops', []))}], "
+            f"index[{req['index']}][{req['shard_id']}]")
+        try:
+            self._replica_bulk_inner(req, channel, src)
+        finally:
+            if child is not None:
+                self.task_manager.unregister(child)
+
+    def _replica_bulk_inner(self, req, channel, src) -> None:
         shard = self.shards.get((req["index"], req["shard_id"]))
         if shard is None:
             channel.send_exception(RuntimeError(

@@ -48,6 +48,15 @@ from elasticsearch_tpu.index.pressure import (
     IndexingPressure,
     operation_size_bytes,
 )
+from elasticsearch_tpu.transport.tasks import (
+    CancellableTask,
+    TaskId,
+    TaskManager,
+    build_tasks_response,
+    node_task_slice,
+    parse_bool_param,
+    render_cat_tasks,
+)
 from elasticsearch_tpu.transport.transport import (
     DiscoveryNode,
     ResponseHandler,
@@ -59,6 +68,12 @@ CREATE_INDEX_ACTION = "indices:admin/create"
 DELETE_INDEX_ACTION = "indices:admin/delete"
 REFRESH_ACTION = "indices:admin/refresh[s]"
 ENGINE_STATS_ACTION = "cluster:monitor/nodes/engine_stats[n]"
+# cluster-wide task management (ref: TransportListTasksAction /
+# TransportCancelTasksAction node fan-outs + TaskManager ban RPCs)
+TASKS_LIST_ACTION = "cluster:monitor/tasks/list[n]"
+TASKS_CANCEL_ACTION = "cluster:admin/tasks/cancel[n]"
+TASK_BAN_ACTION = "internal:admin/tasks/ban"
+BULK_ACTION = "indices:data/write/bulk"
 
 
 class ClusterNode:
@@ -104,15 +119,26 @@ class ClusterNode:
         wire_breaker_service(transport, self.breaker_service)
         self.indexing_pressure = IndexingPressure.from_settings(
             self.settings.get, metrics=self.telemetry.metrics)
+        # cluster task management: every coordinator/handler action
+        # registers here; running time reads the scheduler clock so
+        # seeded runs replay identical task trees
+        self.task_manager = TaskManager(
+            self.local_node.node_id, metrics=self.telemetry.metrics,
+            clock=scheduler.now)
         self.allocation = AllocationService()
         self.routing = OperationRouting()
         self.data_node = DataNodeService(
             transport, scheduler, data_path,
             breaker_service=self.breaker_service,
-            indexing_pressure=self.indexing_pressure)
+            indexing_pressure=self.indexing_pressure,
+            task_manager=self.task_manager)
         self.search_service = DistributedSearchService(
             transport, self.data_node, self.routing, scheduler=scheduler,
-            telemetry=self.telemetry)
+            telemetry=self.telemetry, task_manager=self.task_manager)
+        # when a cancelled parent completes, sweep its ban markers off
+        # the other nodes (the local ban died with the task)
+        self.search_service.on_cancelled_parent_done = \
+            lambda tid: self._broadcast_ban(tid, "done", remove=True)
         # secure-settings keystore (ref: node/Node.java:389-391 wiring of
         # ConsistentSettingsService): when present, the elected master
         # publishes salted hashes and joiners must match them
@@ -147,6 +173,9 @@ class ClusterNode:
             (DELETE_INDEX_ACTION, self._on_delete_index),
             (REFRESH_ACTION, self._on_refresh_shard),
             (ENGINE_STATS_ACTION, self._on_engine_stats),
+            (TASKS_LIST_ACTION, self._on_list_tasks),
+            (TASKS_CANCEL_ACTION, self._on_cancel_task),
+            (TASK_BAN_ACTION, self._on_task_ban),
         ]:
             # master/admin + monitoring actions never trip the inbound
             # breaker: shard-state reporting and stats are exactly what
@@ -312,6 +341,228 @@ class ClusterNode:
                 node, ENGINE_STATS_ACTION, {},
                 ResponseHandler(ok, fail), timeout=30.0)
 
+    # ------------------------------------------------- task management
+
+    def _local_task_infos(self, actions: Optional[str] = None,
+                          parent_task_id: Optional[str] = None,
+                          detailed: bool = True,
+                          task_id: Optional[str] = None) -> Dict[str, Any]:
+        """This node's slice of the `_tasks` fan-out."""
+        return node_task_slice(
+            self.task_manager, self.local_node.node_id,
+            name=self.local_node.name, actions=actions,
+            parent_task_id=parent_task_id, detailed=detailed,
+            task_id=task_id)
+
+    def _on_list_tasks(self, req, channel, src) -> None:
+        # wire default is detailed=True (get_task probes need the
+        # description); the REST-facing default lives in list_tasks,
+        # which always stamps `detailed` explicitly
+        channel.send_response(self._local_task_infos(
+            actions=req.get("actions"),
+            parent_task_id=req.get("parent_task_id"),
+            detailed=parse_bool_param(req.get("detailed"), True),
+            task_id=req.get("task_id")))
+
+    def list_tasks(self, params: Optional[Dict[str, Any]] = None,
+                   on_done: Callable = lambda r, e: None) -> None:
+        """Cluster-aware ``GET /_tasks``: fan TASKS_LIST_ACTION out to
+        every cluster node and shape the merged result (``detailed``,
+        ``actions``, ``parent_task_id``, ``group_by=parents|nodes|none``).
+        Unreachable nodes become ``node_failures`` entries instead of
+        failing the whole response."""
+        params = params or {}
+        group_by = params.get("group_by", "nodes")
+        # same default (False) and string forms as the single-node REST
+        # surface (rest/api.py list_tasks) — ES parity, no drift
+        payload = {"actions": params.get("actions"),
+                   "parent_task_id": params.get("parent_task_id"),
+                   "detailed": parse_bool_param(params.get("detailed"),
+                                                False)}
+        nodes = list(self.state.nodes.nodes) or [self.local_node]
+        results: Dict[str, Dict[str, Any]] = {}
+        failures: List[Dict[str, Any]] = []
+        pending = {"n": len(nodes)}
+
+        def finish():
+            pending["n"] -= 1
+            if pending["n"] == 0:
+                try:
+                    resp = build_tasks_response(
+                        results, group_by=group_by,
+                        node_failures=failures)
+                except Exception as e:  # noqa: BLE001 — bad group_by
+                    on_done(None, e)
+                    return
+                on_done(resp, None)
+
+        for node in nodes:
+            def ok(resp, _nid=node.node_id):
+                results[_nid] = resp
+                finish()
+
+            def fail(exc, _nid=node.node_id):
+                failures.append({"node_id": _nid, "reason": str(exc)})
+                finish()
+
+            self.transport.send_request(
+                node, TASKS_LIST_ACTION, dict(payload),
+                ResponseHandler(ok, fail), timeout=30.0)
+
+    def cat_tasks(self, on_done: Callable = lambda r, e: None) -> None:
+        """`_cat/tasks` text over the same fan-out."""
+        def shape(r, e):
+            if r is None:
+                on_done(None, e)
+                return
+            on_done(render_cat_tasks(
+                {nid: {"name": info["name"],
+                       "tasks": list(info["tasks"].values())}
+                 for nid, info in r["nodes"].items()}), e)
+
+        self.list_tasks({"group_by": "nodes"}, on_done=shape)
+
+    def get_task(self, task_id: str,
+                 on_done: Callable = lambda r, e: None) -> None:
+        """Cluster-aware ``GET /_tasks/{id}``: resolve the owning node
+        from the id and fetch the live task from it."""
+        from elasticsearch_tpu.common.errors import (
+            ResourceNotFoundException)
+        tid = TaskId.parse(task_id)
+
+        def pick(info, err):
+            if err is not None:
+                on_done(None, err)
+                return
+            for t in info.get("tasks", []):
+                if t["id"] == tid.id:
+                    on_done({"completed": False, "task": t}, None)
+                    return
+            on_done(None, ResourceNotFoundException(
+                f"task [{task_id}] is not found"))
+
+        if tid.node_id in ("", self.local_node.node_id):
+            pick(self._local_task_infos(task_id=task_id), None)
+            return
+        owner = self.state.nodes.get(tid.node_id)
+        if owner is None:
+            on_done(None, ResourceNotFoundException(
+                f"task [{task_id}] belongs to node [{tid.node_id}] "
+                "which is not in the cluster"))
+            return
+        # task_id narrows the slice server-side: the owner returns one
+        # task, not its whole detailed task table
+        self.transport.send_request(
+            owner, TASKS_LIST_ACTION, {"task_id": task_id},
+            ResponseHandler(lambda r: pick(r, None),
+                            lambda e: pick(None, e)),
+            timeout=30.0)
+
+    def cancel_task(self, task_id: str, reason: str = "by user request",
+                    on_done: Callable = lambda r, e: None) -> None:
+        """Cluster-aware ``POST /_tasks/{id}/_cancel`` from ANY node:
+        resolve the owning node from the task id, cancel there; the
+        owner broadcasts ban markers so children on other nodes — and
+        children that have not even registered yet — die too."""
+        tid = TaskId.parse(task_id)
+        payload = {"task_id": task_id, "reason": reason}
+        if tid.node_id in ("", self.local_node.node_id):
+            self._cancel_local(tid, reason, on_done)
+            return
+        owner = self.state.nodes.get(tid.node_id)
+        if owner is None:
+            from elasticsearch_tpu.common.errors import (
+                ResourceNotFoundException)
+            on_done(None, ResourceNotFoundException(
+                f"task [{task_id}] belongs to node [{tid.node_id}] "
+                "which is not in the cluster"))
+            return
+        self.transport.send_request(
+            owner, TASKS_CANCEL_ACTION, payload,
+            ResponseHandler(lambda r: on_done(r, None),
+                            lambda e: on_done(None, e)),
+            timeout=30.0)
+
+    def _on_cancel_task(self, req, channel, src) -> None:
+        def done(resp, err):
+            if err is not None:
+                channel.send_exception(
+                    err if isinstance(err, BaseException)
+                    else RuntimeError(str(err)))
+            else:
+                channel.send_response(resp)
+
+        self._cancel_local(TaskId.parse(req["task_id"]),
+                           req.get("reason", "by user request"), done)
+
+    def _cancel_local(self, tid: TaskId, reason: str,
+                      on_done: Callable) -> None:
+        from elasticsearch_tpu.common.errors import (
+            IllegalArgumentException,
+            ResourceNotFoundException,
+        )
+        task = self.task_manager.get_task(tid.id)
+        if task is None:
+            on_done(None, ResourceNotFoundException(
+                f"task [{tid}] is not found"))
+            return
+        if not isinstance(task, CancellableTask):
+            on_done(None, IllegalArgumentException(
+                f"task [{tid}] is not cancellable"))
+            return
+        # ban broadcast FIRST, local cancel second: cancelling fires the
+        # owner's listeners synchronously (a cancelled search finishes
+        # and schedules its ban sweep), so the bans must already be on
+        # the wire or the sweep could overtake them. The ban makes every
+        # other node kill already-registered children AND
+        # registers-to-come (the ban table consulted at registration —
+        # children spawned after the cancel die immediately).
+        self._broadcast_ban(TaskId(self.local_node.node_id, task.id),
+                            reason)
+        self.task_manager.cancel(task, reason)
+        on_done({"nodes": {self.local_node.node_id: {
+            "name": self.local_node.name,
+            "tasks": {str(TaskId(self.local_node.node_id, task.id)):
+                      task.to_dict(self.local_node.node_id)}}}}, None)
+
+    def _broadcast_ban(self, parent: TaskId, reason: str,
+                       remove: bool = False) -> None:
+        for node in self.state.nodes.nodes:
+            if node.node_id == self.local_node.node_id:
+                continue
+            self.transport.send_request(
+                node, TASK_BAN_ACTION,
+                {"parent": str(parent), "reason": reason,
+                 "remove": remove},
+                ResponseHandler(lambda r: None, lambda e: None),
+                timeout=30.0)
+
+    def _on_task_ban(self, req, channel, src) -> None:
+        parent = TaskId.parse(req["parent"])
+        if req.get("remove"):
+            self.task_manager.remove_ban(parent)
+        else:
+            self.task_manager.set_ban(
+                parent, req.get("reason", "by user request"),
+                cancel_children=True)
+        channel.send_response({"ok": True})
+
+    # --------------------------------------------- cluster-state stats
+
+    def pending_cluster_tasks(self) -> List[Dict[str, Any]]:
+        """Pending cluster-state updates queued on this node's master
+        service (non-masters report an empty queue — the queue lives
+        with the elected master)."""
+        return self.coordinator.pending_task_summaries()
+
+    def cluster_state_stats(self) -> Dict[str, Any]:
+        """The applied cluster-state version (every node) + per-node
+        publication lag as the master observes it via follower checks."""
+        out = {"version": self.coordinator.applied_state.version}
+        if self.is_master():
+            out["state_lag"] = self.coordinator.state_lag()
+        return out
+
     # -------------------------------------------------------- client API
     # (async; each takes on_done(result, error))
 
@@ -358,6 +609,28 @@ class ClusterNode:
             # never call back)
             on_done({"items": [], "errors": []}, None)
             return
+        # the coordinator's cancellable parent task: per-shard bulk
+        # handlers on data nodes register children under it, and a
+        # cancel stops item batches that have not executed yet
+        task = self.task_manager.register(
+            "transport", BULK_ACTION,
+            description=f"requests[{len(items)}], index[{index}]",
+            cancellable=True)
+
+        def done(resp, err, _cb=on_done):
+            was_cancelled = task.is_cancelled()
+            self.task_manager.unregister(task)
+            if was_cancelled:
+                # deferred ban sweep (same ordering rationale as the
+                # search coordinator's)
+                tid = TaskId(self.local_node.node_id, task.id)
+                self.scheduler.schedule(
+                    1.0, lambda: self._broadcast_ban(tid, "done",
+                                                     remove=True),
+                    f"sweep task bans [{tid}]")
+            _cb(resp, err)
+
+        on_done = done
         # coordinating-stage indexing pressure: admit the whole bulk's
         # bytes BEFORE any shard fan-out; rejection is a typed 429 the
         # client retries after in-flight bytes release (ref:
@@ -433,11 +706,16 @@ class ClusterNode:
                 pending["errors"].append(f"shard {_sid}: {exc}")
                 shard_done()
 
-            self.transport.send_request(
-                node, SHARD_BULK_PRIMARY,
-                {"index": index, "shard_id": sid, "items": shard_items,
-                 "op_bytes": shard_bytes[sid]},
-                ResponseHandler(ok, fail), timeout=60.0)
+            from elasticsearch_tpu.telemetry import context as _telectx
+            with _telectx.activate_task(self.local_node.node_id, task):
+                # the ambient task rides the __headers carrier: the
+                # primary's handler registers its child under it
+                self.transport.send_request(
+                    node, SHARD_BULK_PRIMARY,
+                    {"index": index, "shard_id": sid,
+                     "items": shard_items,
+                     "op_bytes": shard_bytes[sid]},
+                    ResponseHandler(ok, fail), timeout=60.0)
 
     def refresh(self, on_done: Callable = lambda r, e: None) -> None:
         """Broadcast refresh to all data nodes (ref: refresh is a

@@ -60,6 +60,7 @@ from __future__ import annotations
 
 import threading
 import time
+from contextlib import nullcontext as _nullcontext
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -81,10 +82,19 @@ from elasticsearch_tpu.common.errors import (
 )
 from elasticsearch_tpu.search.queries import MatchAllQuery, parse_query
 from elasticsearch_tpu.search.searcher import DocAddress, ShardSearcher
+from elasticsearch_tpu.telemetry import context as _telectx
+from elasticsearch_tpu.transport.tasks import (
+    TaskId,
+    register_child_of_incoming,
+)
 from elasticsearch_tpu.transport.transport import ResponseHandler
 
 QUERY_PHASE_ACTION = "indices:data/read/search[phase/query]"
 FETCH_PHASE_ACTION = "indices:data/read/search[phase/fetch/id]"
+SEARCH_ACTION = "indices:data/read/search"
+
+# the wire type a cancelled task reports (TaskCancelledException)
+TASK_CANCELLED_TYPE = "task_cancelled_exception"
 
 DEFAULT_SIZE = 10
 
@@ -108,6 +118,9 @@ NON_RETRYABLE_TYPES = {
     "mapper_parsing_exception",
     "script_exception",
     "search_phase_execution_exception",
+    # a cancelled shard must never fail over: the cancellation came from
+    # the task tree, and every other copy's child is banned too
+    "task_cancelled_exception",
 }
 
 # backpressure failures — a tripped breaker / 429 rejection — are
@@ -120,6 +133,25 @@ NON_RETRYABLE_TYPES = {
 # CircuitBreakingException/EsRejectedExecutionException RestStatus 429
 # as retryable in replica selection).
 BACKPRESSURE_RETRYABLE_TYPES = BACKPRESSURE_ERROR_TYPES
+
+
+def search_task_description(index_expression: str,
+                            body: Optional[Dict[str, Any]]) -> str:
+    """The `_tasks` description of a search: indices + a bounded query
+    summary (ref: SearchRequest.getDescription — indices, search type,
+    source)."""
+    try:
+        import json as _json
+        source = _json.dumps(
+            {k: v for k, v in (body or {}).items()
+             if k in ("query", "aggs", "aggregations", "sort", "size")},
+            sort_keys=True, default=str)
+    except Exception:  # noqa: BLE001 — a description must never fail
+        source = "{}"
+    if len(source) > 200:
+        source = source[:200] + "..."
+    return (f"indices[{index_expression}], "
+            f"search_type[QUERY_THEN_FETCH], source[{source}]")
 
 
 def is_retryable_failure(exc: BaseException) -> bool:
@@ -207,7 +239,7 @@ class DistributedSearchService:
 
     def __init__(self, transport, data_node,
                  routing: Optional[OperationRouting] = None,
-                 scheduler=None, telemetry=None):
+                 scheduler=None, telemetry=None, task_manager=None):
         self.transport = transport
         self.data_node = data_node
         self.routing = routing or OperationRouting()
@@ -217,6 +249,21 @@ class DistributedSearchService:
         # node telemetry bundle (metrics + tracer); None keeps every
         # instrumented site a single branch
         self.telemetry = telemetry
+        # node task manager (transport/tasks.py): the coordinator
+        # registers a cancellable parent per search, data-node handlers
+        # register children under the remote parent carried in the
+        # request headers; None keeps every site a single branch
+        self.task_manager = task_manager
+        # notified (with the parent TaskId) when a CANCELLED parent
+        # unregisters, so the owner can sweep its ban markers off the
+        # other nodes (ClusterNode wires this to the ban broadcast)
+        self.on_cancelled_parent_done: Optional[Callable] = None
+        # inter-shard yield of the data-node query loop: each shard runs
+        # as its own scheduler task, and a positive delay lets the
+        # deterministic harness interleave cancels/bans/`_tasks` RPCs
+        # between shard executions (0 = back-to-back; the production
+        # wall-clock scheduler runs 0-delay steps inline)
+        self.query_step_delay = 0.0
         # coordinator-side slow log, same entry shape as the single-node
         # service's (search/slowlog.py)
         self.slowlog_recent: List[Dict[str, Any]] = []
@@ -241,49 +288,119 @@ class DistributedSearchService:
         return ShardSearcher(snapshot.segments, engine.mapper,
                              self.data_node.device_cache)
 
+    def _register_child(self, action: str, description: str):
+        return register_child_of_incoming(
+            self.task_manager, action, description=description)
+
     def _on_query_phase(self, req, channel, src) -> None:
         """Run the query phase on the named local shards; serializable
         per-shard top-k (ref: QuerySearchResult). A failing shard yields
         an in-band typed error so its siblings on this node still
-        answer — the coordinator retries only the failed shard."""
+        answer — the coordinator retries only the failed shard.
+
+        The shard loop steps through the scheduler (one shard per task),
+        so a cancellation — the ban RPC of a cancelled remote parent —
+        lands BETWEEN shard executions and the remaining shards answer
+        typed ``task_cancelled`` errors instead of running; within one
+        shard, the profile-stage cancellation hook aborts a multi-segment
+        scan between device launches (search/profile.py)."""
         tele = self.telemetry
+        shards = list(req.get("shards", []))
+        child = self._register_child(
+            QUERY_PHASE_ACTION,
+            f"index[{req.get('index')}], shards{shards}")
+        span = None
+        t0 = self.scheduler.now()
         if tele is not None:
             # joins the coordinator's trace via the ambient context the
             # transport installed from the request headers; device/host
-            # stage timings fold into this node's histograms
-            from contextlib import ExitStack
-
-            from elasticsearch_tpu.search import profile as _prof
+            # stage timings fold into this node's histograms per shard
             span = tele.tracer.start_span(
                 "shard_query",
-                tags={"index": req.get("index"),
-                      "shards": list(req.get("shards", []))})
-            with ExitStack() as stack:
-                stack.enter_context(_prof.stage_sink(tele.stage_sink()))
-                stack.callback(span.finish)
-                with tele.metrics.timer("search.shard.query.latency"):
-                    self._query_phase_inner(req, channel, src)
-            return
-        self._query_phase_inner(req, channel, src)
-
-    def _query_phase_inner(self, req, channel, src) -> None:
-        t0 = time.monotonic()
+                tags={"index": req.get("index"), "shards": shards})
+        t_wall = time.monotonic()
         body = req.get("body") or {}
-        query = (parse_query(body["query"]) if body.get("query")
-                 else MatchAllQuery())
-        post_filter = (parse_query(body["post_filter"])
-                       if body.get("post_filter") else None)
-        k = int(req["k"])
-        shard_results = []
-        for shard_id in req["shards"]:
-            try:
-                searcher = self._searcher_for(req["index"], shard_id)
-                if searcher is None:
-                    shard_results.append({
-                        "shard": shard_id,
+        try:
+            query = (parse_query(body["query"]) if body.get("query")
+                     else MatchAllQuery())
+            post_filter = (parse_query(body["post_filter"])
+                           if body.get("post_filter") else None)
+            k = int(req["k"])
+        except Exception as e:  # noqa: BLE001 — a parse error fails the
+            # whole node request identically for every shard (typed)
+            if child is not None:
+                self.task_manager.unregister(child)
+            if span is not None:
+                span.finish(outcome="error")
+            channel.send_exception(e)
+            return
+        st = {"i": 0, "results": []}
+
+        def finish():
+            if child is not None:
+                self.task_manager.unregister(child)
+            if tele is not None:
+                tele.metrics.observe(
+                    "search.shard.query.latency",
+                    (self.scheduler.now() - t0) * 1000.0)
+                span.finish(cancelled=bool(
+                    child is not None and child.is_cancelled()))
+            took = time.monotonic() - t_wall
+            channel.send_response({
+                "results": st["results"],
+                # EWMA inputs for adaptive replica selection
+                "service_time_ns": took * 1e9,
+                "queue_size": 0,
+            })
+
+        def step():
+            if st["i"] >= len(shards):
+                finish()
+                return
+            if child is not None and child.is_cancelled():
+                # the cancel landed between shard executions: every
+                # remaining shard reports a typed task_cancelled failure
+                # that folds into the coordinator's partial results
+                reason = child.cancellation_reason()
+                for sid in shards[st["i"]:]:
+                    st["results"].append({
+                        "shard": sid,
+                        "error": f"task cancelled [{reason}]",
+                        "type": TASK_CANCELLED_TYPE})
+                finish()
+                return
+            shard_id = shards[st["i"]]
+            st["i"] += 1
+            st["results"].append(self._query_one_shard(
+                req, body, query, post_filter, k, shard_id, child))
+            self.scheduler.schedule(
+                self.query_step_delay, step,
+                f"query shard [{req.get('index')}][{shard_id}]")
+
+        step()
+
+    def _query_one_shard(self, req, body, query, post_filter, k: int,
+                         shard_id: int, child) -> Dict[str, Any]:
+        """One shard's query phase, under this node's stage sink and the
+        child task's device-launch cancellation hook."""
+        from contextlib import ExitStack
+
+        from elasticsearch_tpu.search import profile as _prof
+        try:
+            searcher = self._searcher_for(req["index"], shard_id)
+            if searcher is None:
+                return {"shard": shard_id,
                         "error": "shard not started here",
-                        "type": "shard_not_found_exception"})
-                    continue
+                        "type": "shard_not_found_exception"}
+            with ExitStack() as stack:
+                if self.telemetry is not None:
+                    stack.enter_context(
+                        _prof.stage_sink(self.telemetry.stage_sink()))
+                if child is not None:
+                    # a cancel arriving mid-scan aborts at the next
+                    # stage boundary (between device launches)
+                    stack.enter_context(
+                        _prof.cancellable(child.ensure_not_cancelled))
                 result = searcher.query_phase(
                     query, k,
                     post_filter=post_filter,
@@ -292,53 +409,57 @@ class DistributedSearchService:
                     search_after=body.get("search_after"),
                     track_total_hits=bool(body.get("track_total_hits",
                                                    True)))
-            except Exception as e:  # noqa: BLE001 — per-shard fault barrier
-                shard_results.append({"shard": shard_id, "error": str(e),
-                                      "type": error_type_of(e)})
-                continue
-            shard_results.append({
-                "shard": shard_id,
-                "total": result.total_hits,
-                "max_score": result.max_score,
-                # the stored _id travels with the address: segment names
-                # are engine-local (uuid-prefixed), so a fetch that fails
-                # over to ANOTHER copy resolves the doc by _id instead
-                "docs": [{"seg": searcher.segments[d.segment_idx].name,
-                          "docid": d.docid, "score": d.score,
-                          "id": searcher.segments[d.segment_idx]
-                          .stored.ids[d.docid],
-                          "sort_key": d.sort_key,
-                          "sort_values": list(d.sort_values)}
-                         for d in result.docs],
-            })
-        took = time.monotonic() - t0
-        channel.send_response({
-            "results": shard_results,
-            # EWMA inputs for adaptive replica selection
-            "service_time_ns": took * 1e9,
-            "queue_size": 0,
-        })
+        except Exception as e:  # noqa: BLE001 — per-shard fault barrier
+            return {"shard": shard_id, "error": str(e),
+                    "type": error_type_of(e)}
+        return {
+            "shard": shard_id,
+            "total": result.total_hits,
+            "max_score": result.max_score,
+            # the stored _id travels with the address: segment names
+            # are engine-local (uuid-prefixed), so a fetch that fails
+            # over to ANOTHER copy resolves the doc by _id instead
+            "docs": [{"seg": searcher.segments[d.segment_idx].name,
+                      "docid": d.docid, "score": d.score,
+                      "id": searcher.segments[d.segment_idx]
+                      .stored.ids[d.docid],
+                      "sort_key": d.sort_key,
+                      "sort_values": list(d.sort_values)}
+                     for d in result.docs],
+        }
 
     def _on_fetch_phase(self, req, channel, src) -> None:
         """Fetch _source/fields for winning docs by (segment name, docid)
         — segment names are stable across refreshes (immutable segments),
         so the addresses survive the query→fetch gap."""
         tele = self.telemetry
-        if tele is not None:
-            span = tele.tracer.start_span(
-                "shard_fetch", tags={"index": req.get("index")})
-            try:
-                with tele.metrics.timer("search.shard.fetch.latency"):
-                    self._fetch_phase_inner(req, channel, src)
-            finally:
-                span.finish()
-            return
-        self._fetch_phase_inner(req, channel, src)
+        child = self._register_child(
+            FETCH_PHASE_ACTION,
+            f"index[{req.get('index')}], "
+            f"shards{sorted(req.get('docs', {}))}")
+        try:
+            if tele is not None:
+                span = tele.tracer.start_span(
+                    "shard_fetch", tags={"index": req.get("index")})
+                try:
+                    with tele.metrics.timer("search.shard.fetch.latency"):
+                        self._fetch_phase_inner(req, channel, src, child)
+                finally:
+                    span.finish()
+                return
+            self._fetch_phase_inner(req, channel, src, child)
+        finally:
+            if child is not None:
+                self.task_manager.unregister(child)
 
-    def _fetch_phase_inner(self, req, channel, src) -> None:
+    def _fetch_phase_inner(self, req, channel, src, child=None) -> None:
         body = req.get("body") or {}
         hits_out = []
         for shard_id, wire_docs in req["docs"].items():
+            if child is not None:
+                # cancellation poll per shard group: a cancelled fetch
+                # raises typed, the coordinator reports (never retries)
+                child.ensure_not_cancelled()
             shard_id = int(shard_id)
             searcher = self._searcher_for(req["index"], shard_id)
             if searcher is None:
@@ -402,12 +523,40 @@ class DistributedSearchService:
             # when one is active, else roots a fresh trace
             root_span = tele.tracer.start_span(
                 "search", tags={"index": index_expression})
+        # the coordinator's cancellable parent task: every per-shard
+        # query/fetch RPC carries its id, so data-node children land
+        # under it in `_tasks` and a cancel reaches them via bans
+        task = None
+        if self.task_manager is not None:
+            with (_telectx.activate_span(root_span) if root_span
+                  is not None else _nullcontext()):
+                task = self.task_manager.register(
+                    "transport", SEARCH_ACTION,
+                    description=search_task_description(
+                        index_expression, body),
+                    cancellable=True)
         indices: List[str] = []
 
         def finish(resp, err, _cb=on_done):
-            """Single completion seam for every exit: close the root
-            span, record node metrics + the coordinator slow log, then
-            hand the result to the caller."""
+            """Single completion seam for every exit: unregister the
+            parent task, close the root span, record node metrics + the
+            coordinator slow log, then hand the result to the caller."""
+            if task is not None:
+                was_cancelled = getattr(task, "is_cancelled",
+                                        lambda: False)()
+                self.task_manager.unregister(task)
+                if was_cancelled and \
+                        self.on_cancelled_parent_done is not None:
+                    # sweep the ban markers this cancel spread across
+                    # the cluster (the local one died with the task) —
+                    # deferred one beat so the sweep cannot overtake
+                    # the ban broadcast still in flight
+                    tid = TaskId(self.transport.local_node.node_id,
+                                 task.id)
+                    self.scheduler.schedule(
+                        1.0,
+                        lambda: self.on_cancelled_parent_done(tid),
+                        f"sweep task bans [{tid}]")
             if tele is not None:
                 tele.metrics.observe(
                     "search.latency", (sched.now() - t_start) * 1000.0)
@@ -485,12 +634,23 @@ class DistributedSearchService:
             "t_start": t_start,
             "deadline": (t_start + budget) if budget else None,
             "timed_out": False,
+            "cancelled": False,
             "query_done": False,
             "lock": threading.RLock(),
             "on_done": finish,
             "span": root_span,
             "query_span": query_span,
+            "task": task,
         }
+
+        # cancellation that bites at the coordinator: the listener fails
+        # every unresolved shard group with a typed task_cancelled
+        # failure and the reduce-so-far returns as partial results (the
+        # owning node's cancel handler broadcasts the ban that stops the
+        # data-node children)
+        if task is not None:
+            task.add_cancellation_listener(
+                lambda: self._on_task_cancelled(ctx))
 
         # search-level time budget: at the deadline every unresolved
         # group becomes a reported failure and the reduce-so-far returns
@@ -544,9 +704,14 @@ class DistributedSearchService:
                     tags={"phase": "query", "node": node_id,
                           "attempt": g.attempts + 1})
             if parent is not None:
-                from elasticsearch_tpu.telemetry import (
-                    context as _telectx)
                 hdrs = _telectx.headers_of(parent)
+        task = ctx.get("task")
+        if task is not None:
+            # the parent task rides the same header carrier as the
+            # trace: the data node registers its child under it
+            hdrs = {**(hdrs or {}),
+                    **_telectx.task_headers(
+                        self.transport.local_node.node_id, task)}
         node = ctx["state"].nodes.get(node_id)
         if node is None:
             for g in batch:
@@ -678,6 +843,41 @@ class DistributedSearchService:
         self.scheduler.schedule(
             backoff, retry, f"retry {g.index}[{g.shard}] on {node_id2}")
 
+    def _on_task_cancelled(self, ctx: Dict) -> None:
+        """The coordinator's parent task was cancelled: every unresolved
+        shard group becomes a typed ``task_cancelled`` failure and the
+        reduce-so-far returns through the partial-results protocol (no
+        fetch fan-out — the point of a cancel is to stop work)."""
+        task = ctx.get("task")
+        reason = (task.cancellation_reason()
+                  if task is not None else "by user request")
+        expired: List[_ShardGroup] = []
+        spans = []
+        with ctx["lock"]:
+            ctx["cancelled"] = True
+            if ctx["query_done"]:
+                return
+            for g in ctx["groups"]:
+                if not g.resolved:
+                    g.resolved = True
+                    g.ok = False
+                    if g.span is not None:
+                        spans.append(g.span)
+                        g.span = None
+                    g.failures.append(ShardSearchFailure(
+                        index=g.index, shard=g.shard,
+                        node=(g.current.current_node_id
+                              if g.current else None),
+                        type=TASK_CANCELLED_TYPE,
+                        reason=f"task cancelled [{reason}]",
+                        phase="query"))
+                    expired.append(g)
+        for span in spans:
+            span.finish(outcome="cancelled", retryable=False,
+                        will_retry=False)
+        for _ in expired:
+            self._group_resolved(ctx)
+
     def _on_budget_expired(self, ctx: Dict) -> None:
         expired: List[_ShardGroup] = []
         spans = []
@@ -736,7 +936,8 @@ class DistributedSearchService:
                 f"{len(failed)} of {len(groups)} shards failed and "
                 "[allow_partial_search_results] is false", failures))
             return
-        if failed and len(failed) == len(groups) and not ctx["timed_out"]:
+        if failed and len(failed) == len(groups) \
+                and not ctx["timed_out"] and not ctx["cancelled"]:
             self._complete(ctx, None, SearchPhaseExecutionException(
                 "query", "all shards failed", failures))
             return
@@ -762,6 +963,39 @@ class DistributedSearchService:
         SearchPhaseController.sortDocs + FetchSearchPhase). A failed
         fetch retries once on the shard's other copies before the hits
         are dropped as a counted failure."""
+        # the between-phases cancellation poll: a parent cancelled after
+        # the query phase reduced skips the fetch fan-out entirely — the
+        # response reports the reduced totals plus the typed failures,
+        # with no hits (their sources were never fetched)
+        task = ctx.get("task")
+        if task is not None and task.is_cancelled():
+            with ctx["lock"]:
+                ctx["cancelled"] = True
+        if ctx["cancelled"]:
+            # shards that queried fine but whose fetch is being skipped
+            # become typed failures — without them a cancel landing in
+            # this window would be indistinguishable from a genuine
+            # zero-hit result
+            reason = (task.cancellation_reason()
+                      if task is not None else "by user request")
+            cancelled_failures = [
+                ShardSearchFailure(
+                    index=g.index, shard=g.shard,
+                    node=(g.current.current_node_id if g.current else None),
+                    type=TASK_CANCELLED_TYPE,
+                    reason=f"task cancelled [{reason}]",
+                    phase="fetch")
+                for g in ctx["groups"] if g.ok]
+            if cancelled_failures and not ctx["allow_partial"]:
+                self._complete(ctx, None, SearchPhaseExecutionException(
+                    "fetch",
+                    "search cancelled before the fetch phase and "
+                    "[allow_partial_search_results] is false",
+                    ctx.get("query_failures", []) + cancelled_failures))
+                return
+            ctx["query_failures"] = (
+                ctx.get("query_failures", []) + cancelled_failures)
+            ctx["merged"] = []
         merged = ctx["merged"]
         state = ctx["state"]
         body = ctx["body"]
@@ -829,8 +1063,12 @@ class DistributedSearchService:
                 parent=ctx.get("fetch_span") or ctx.get("span"),
                 tags={"phase": "fetch", "node": node_id,
                       "shards": sorted(docs_by_shard)})
-            from elasticsearch_tpu.telemetry import context as _telectx
             hdrs = _telectx.headers_of(span)
+        task = ctx.get("task")
+        if task is not None:
+            hdrs = {**(hdrs or {}),
+                    **_telectx.task_headers(
+                        self.transport.local_node.node_id, task)}
         payload = {"index": index,
                    "docs": {str(sid): docs
                             for sid, docs in docs_by_shard.items()},
@@ -891,12 +1129,16 @@ class DistributedSearchService:
         deadline = ctx["deadline"]
         out_of_time = (deadline is not None
                        and self.scheduler.now() >= deadline)
+        # a cancelled fetch (or any non-retryable failure) must not walk
+        # to another copy — its child there is banned anyway
+        retryable = is_retryable_failure(exc) and not ctx["cancelled"]
         retries: List[Tuple[str, int, Dict[int, List[Dict]]]] = []
         with fctx["lock"]:
             for sid, docs in docs_by_shard.items():
                 key = (index, sid)
                 alt = None
-                if key not in fctx["retried"] and not out_of_time:
+                if key not in fctx["retried"] and not out_of_time \
+                        and retryable:
                     fctx["retried"].add(key)
                     alt = self._other_copy_node(state, index, sid, node_id)
                 if alt is None:

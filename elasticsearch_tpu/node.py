@@ -93,7 +93,10 @@ class Node:
             self.breaker_service)
         self.search_service = SearchService(self.indices_service)
         self.search_service.telemetry = self.telemetry
-        self.task_manager = TaskManager(self.node_id)
+        # tasks.started/completed/cancelled counters + the live task
+        # gauge feed the node metrics registry
+        self.task_manager = TaskManager(self.node_id,
+                                        metrics=self.telemetry.metrics)
         # completed background-task responses (ref: the .tasks results
         # index); bounded — oldest entries evicted beyond 256
         self.task_results: "OrderedDict[int, dict]" = OrderedDict()

@@ -557,6 +557,16 @@ def root_info(node, params, body):
     }
 
 
+def _pending_cluster_tasks(node):
+    """Pending cluster-state updates: the master-service queue when a
+    coordinator is attached (multi-node), else the synchronous
+    single-node container's — empty by construction — queue."""
+    coord = getattr(node, "coordinator", None)
+    if coord is not None:
+        return coord.pending_task_summaries()
+    return []
+
+
 def cluster_health(node, params, body):
     indices = node.indices_service.indices
     shards = sum(idx.num_shards for idx in indices.values())
@@ -570,7 +580,11 @@ def cluster_health(node, params, body):
         "active_shards": shards,
         "relocating_shards": 0, "initializing_shards": 0,
         "unassigned_shards": 0, "delayed_unassigned_shards": 0,
-        "number_of_pending_tasks": 0, "number_of_in_flight_fetch": 0,
+        # real numbers: the master-service queue + live fetch-phase
+        # tasks from the task manager (no more hardcoded zeros)
+        "number_of_pending_tasks": len(_pending_cluster_tasks(node)),
+        "number_of_in_flight_fetch": len(
+            node.task_manager.list_tasks(actions="*phase/fetch*")),
         "active_shards_percent_as_number": 100.0,
     }
 
@@ -620,6 +634,8 @@ def nodes_stats(node, params, body):
             # hit/miss/eviction counters (the TPU-native analogue of
             # segment stats + IndicesQueryCache + fielddata memory)
             "engine": _engine_section(node),
+            # live/peak/lifetime task counts (transport/tasks.py)
+            "tasks": node.task_manager.stats(),
         }},
     }
 
@@ -1407,36 +1423,57 @@ def msearch(node, params, body, index=None):
         i += 1
         searches.append((target, search_body))
 
+    # one cancellable parent for the msearch; each sub-search runs as a
+    # cancellable child task under it, so cancelling the parent stops
+    # queued sub-searches too (the ban table kills late children)
+    from elasticsearch_tpu.transport.tasks import TaskId as _TaskId
+    parent = node.task_manager.register(
+        "transport", "indices:data/read/msearch",
+        description=f"requests[{len(searches)}]", cancellable=True)
+
     def one(target, search_body):
+        sub = node.task_manager.register(
+            "transport", "indices:data/read/search",
+            description=f"indices[{target}]",
+            parent_task_id=_TaskId(node.node_id, parent.id),
+            cancellable=True)
         try:
             search_body = _apply_alias_filter(node, target, search_body)
-            return node.search_service.search(target, search_body)
+            return node.search_service.search(target, search_body,
+                                              task=sub)
         except ElasticsearchTpuException as e:
             return {"error": e.to_xcontent(), "status": e.status}
+        finally:
+            node.task_manager.unregister(sub)
 
     # sub-searches fan out on the SEARCH pool (ref:
     # TransportMultiSearchAction executing per-request on the search
     # executor) — concurrent sub-searches also coalesce into shared
     # batched launches downstream
-    if len(searches) > 1:
-        from elasticsearch_tpu.common.threadpool import (
-            EsRejectedExecutionException)
-        futures = []
-        for t, b in searches:
-            try:
-                futures.append(
-                    node.threadpool.executor("search").submit(one, t, b))
-            except EsRejectedExecutionException as e:
-                # a full search queue rejects THIS sub-search with 429,
-                # never the whole msearch (ref: per-item rejection in
-                # TransportMultiSearchAction)
-                futures.append({
-                    "error": {"type": "es_rejected_execution_exception",
-                              "reason": str(e)}, "status": 429})
-        responses = [f.result() if hasattr(f, "result") else f
-                     for f in futures]
-    else:
-        responses = [one(t, b) for t, b in searches]
+    try:
+        if len(searches) > 1:
+            from elasticsearch_tpu.common.threadpool import (
+                EsRejectedExecutionException)
+            futures = []
+            for t, b in searches:
+                try:
+                    futures.append(
+                        node.threadpool.executor("search").submit(one, t,
+                                                                  b))
+                except EsRejectedExecutionException as e:
+                    # a full search queue rejects THIS sub-search with
+                    # 429, never the whole msearch (ref: per-item
+                    # rejection in TransportMultiSearchAction)
+                    futures.append({
+                        "error": {
+                            "type": "es_rejected_execution_exception",
+                            "reason": str(e)}, "status": 429})
+            responses = [f.result() if hasattr(f, "result") else f
+                         for f in futures]
+        else:
+            responses = [one(t, b) for t, b in searches]
+    finally:
+        node.task_manager.unregister(parent)
     return 200, {"responses": responses}
 
 
@@ -1750,13 +1787,32 @@ def rethrottle_handler(node, params, body, task_id):
 
 # -- tasks / async search ----------------------------------------------------
 
+def _node_task_infos(node, actions=None, parent_task_id=None,
+                     detailed=True):
+    """This node's `_tasks` slice in the fan-out shape — the same
+    per-node map `ClusterNode.list_tasks` merges, so the single-node
+    REST surface and the cluster fan-out render identically
+    (transport/tasks.py shaping)."""
+    from elasticsearch_tpu.transport.tasks import node_task_slice
+    return {node.node_id: node_task_slice(
+        node.task_manager, node.node_id, name=node.name,
+        actions=actions, parent_task_id=parent_task_id,
+        detailed=detailed)}
+
+
 def list_tasks(node, params, body):
-    tasks = node.task_manager.list_tasks(actions=params.get("actions"))
-    return 200, {"nodes": {node.node_id: {
-        "name": node.name,
-        "tasks": {f"{node.node_id}:{t.id}": t.to_dict(node.node_id)
-                  for t in tasks},
-    }}}
+    """GET /_tasks with `detailed`, `actions`, `parent_task_id` and
+    `group_by=nodes|parents|none` (ref: RestListTasksAction)."""
+    from elasticsearch_tpu.transport.tasks import (
+        build_tasks_response,
+        parse_bool_param,
+    )
+    infos = _node_task_infos(
+        node, actions=params.get("actions"),
+        parent_task_id=params.get("parent_task_id"),
+        detailed=parse_bool_param(params.get("detailed"), False))
+    return 200, build_tasks_response(
+        infos, group_by=params.get("group_by", "nodes"))
 
 
 def _local_task(node, task_id):
@@ -1885,9 +1941,10 @@ def cat_aliases(node, params, body):
 
 def cluster_pending_tasks(node, params, body):
     """ref: RestPendingClusterTasksAction — tasks queued on the master
-    (the single-node container applies state updates synchronously, so
-    the queue drains immediately)."""
-    return 200, {"tasks": []}
+    service (real queue entries when a coordinator is attached; the
+    single-node container applies state updates synchronously, so its
+    queue reads empty)."""
+    return 200, {"tasks": _pending_cluster_tasks(node)}
 
 
 def add_index_block(node, params, body, index, block):
@@ -3157,7 +3214,12 @@ def cat_fielddata(node, params, body):
 
 
 def cat_pending_tasks(node, params, body):
-    return 200, {"_cat": ""}
+    """GET /_cat/pending_tasks — rendered from the same master-service
+    queue `_cluster/pending_tasks` reads."""
+    lines = [f"{t['insert_order']} {t['time_in_queue_millis']}ms "
+             f"{t['priority']} {t['source']}"
+             for t in _pending_cluster_tasks(node)]
+    return 200, {"_cat": "\n".join(lines)}
 
 
 def cat_segments(node, params, body):
@@ -3197,11 +3259,12 @@ def cat_snapshots(node, params, body, repo):
 
 
 def cat_tasks(node, params, body):
-    lines = []
-    for t in node.task_manager.list_tasks():
-        lines.append(f"{t.action} {t.id} - transport "
-                     f"{int(t.start_time * 1000)}")
-    return 200, {"_cat": "\n".join(lines)}
+    """GET /_cat/tasks — rendered through the `_tasks` fan-out shape
+    (transport/tasks.py render_cat_tasks), so the text surface shows
+    the same node-attributed rows the cluster fan-out produces."""
+    from elasticsearch_tpu.transport.tasks import render_cat_tasks
+    return 200, {"_cat": render_cat_tasks(
+        _node_task_infos(node, actions=params.get("actions")))}
 
 
 def cat_plugins(node, params, body):

@@ -31,6 +31,13 @@ Two consumers share the recorder seam:
 Both are temporal thread-local contexts; telemetry/context.py
 ``bind()`` carries them (plus the trace context) across scheduler task
 boundaries so a multi-node search keeps its shard-side stages.
+
+The stage seam doubles as the engine's cancellation poll point: a
+caller that owns a CancellableTask installs its ``ensure_not_cancelled``
+via ``cancellable()``, and every ``span(stage)`` entry — i.e. every
+device-launch boundary of a multi-segment scan — polls it. A cancelled
+search aborts between launches instead of after the full scan, without
+the kernels themselves knowing tasks exist.
 """
 
 from __future__ import annotations
@@ -89,7 +96,31 @@ def note(key: str, value) -> None:
 
 
 @contextmanager
+def cancellable(check):
+    """Install a cancellation poll ``check()`` (typically a task's
+    ``ensure_not_cancelled``) for the duration; ``span()`` entries —
+    the device-launch boundaries — call it. telemetry/context.bind()
+    carries it across scheduler task boundaries."""
+    prev = getattr(_tls, "cancel", None)
+    _tls.cancel = check
+    try:
+        yield
+    finally:
+        _tls.cancel = prev
+
+
+def check_cancelled() -> None:
+    """Poll the installed cancellation hook (raises TaskCancelledException
+    through the task's ``ensure_not_cancelled``); no-op when none is
+    installed — one getattr on the hot path."""
+    cb = getattr(_tls, "cancel", None)
+    if cb is not None:
+        cb()
+
+
+@contextmanager
 def span(stage: str):
+    check_cancelled()
     if not active():
         yield
         return

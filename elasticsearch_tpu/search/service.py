@@ -832,6 +832,14 @@ class SearchService:
                     plan_cache_key = None
             else:
                 plan_cache_key = None
+            cancel_cm = None
+            if task is not None:
+                # the profile stage seam doubles as the device-launch
+                # cancellation poll: a cancel mid-scan aborts between
+                # launches of a multi-segment shard, not after it
+                from elasticsearch_tpu.search import profile as _prof
+                cancel_cm = _prof.cancellable(task.ensure_not_cancelled)
+                cancel_cm.__enter__()
             try:
                 result = searcher.query_phase(
                     query, query_k, post_filter=post_filter,
@@ -871,6 +879,8 @@ class SearchService:
                 # searcher list (scroll cursors key on this index)
                 result = QueryResult([], 0, None)
             finally:
+                if cancel_cm is not None:
+                    cancel_cm.__exit__(None, None, None)
                 if prof_cm is not None:
                     prof_cm.__exit__(None, None, None)
                 if shard_span is not None:
@@ -940,6 +950,11 @@ class SearchService:
                     f"{len(shard_failures)} of {len(shard_results)} "
                     "shards failed and [allow_partial_search_results] "
                     "is false", shard_failures)
+
+        # the between-phases cancellation poll: a search cancelled after
+        # the query phase must not run the merge/fetch work
+        if task is not None:
+            task.ensure_not_cancelled()
 
         # ---- merge (score desc / sort key, then shard order, then docid)
         merged: List[Tuple[float, int, DocAddress, str, ShardSearcher]] = []

@@ -1,23 +1,32 @@
-"""Ambient telemetry context: the thread-local half of trace
+"""Ambient telemetry context: the thread-local half of trace AND task
 propagation, plus capture/rebind across scheduler task boundaries.
 
-Two problems live here:
+Three problems live here:
 
-1. **Propagation.** The REST boundary or a transport dispatch installs
-   the active (trace_id, span_id) so downstream code — the coordinator,
-   a data-node shard handler — can parent its spans without threading a
-   context argument through every call (``Tracer.start_span`` consults
-   ``current()`` when no explicit parent is given). On the wire the
-   context rides transport request headers ``trace.id`` / ``span.id``
+1. **Trace propagation.** The REST boundary or a transport dispatch
+   installs the active (trace_id, span_id) so downstream code — the
+   coordinator, a data-node shard handler — can parent its spans without
+   threading a context argument through every call (``Tracer.start_span``
+   consults ``current()`` when no explicit parent is given). On the wire
+   the context rides transport request headers ``trace.id`` / ``span.id``
    (the ``__headers`` carrier in transport/transport.py).
 
-2. **Task boundaries.** The search profiler's thread-local recorder
-   (search/profile.py) and this trace context are both *temporal*
-   contexts: a task scheduled on ``DeterministicTaskQueue`` (or a
+2. **Task propagation.** The same seam carries the task tree: a service
+   that registered a Task makes it ambient via ``activate_task``, and
+   ``TransportService.send_request`` stamps ``task.id``/``task.parent``
+   into the headers; the dispatch side installs the incoming ``task.id``
+   so the handler registers its work as a CHILD of the remote caller's
+   task (``incoming_parent_task()``) — the reference's ThreadContext
+   parentTaskId riding every TransportRequest.
+
+3. **Task boundaries.** The search profiler's thread-local recorder
+   (search/profile.py), its cancellation hook, and these contexts are all
+   *temporal*: a task scheduled on ``DeterministicTaskQueue`` (or a
    production scheduler/timer) runs after the installing scope exited.
-   ``bind(fn)`` captures both at schedule time and reinstalls them
+   ``bind(fn)`` captures everything at schedule time and reinstalls it
    around the task body, so ``profile: true`` on a multi-node search
-   keeps shard-side stages and remote spans keep their parents.
+   keeps shard-side stages, remote spans keep their parents, and a
+   scheduled retry still runs under (and stamps) the originating task.
 """
 
 from __future__ import annotations
@@ -31,6 +40,8 @@ from elasticsearch_tpu.search import profile as _profile
 
 TRACE_HEADER = "trace.id"
 SPAN_HEADER = "span.id"
+TASK_HEADER = "task.id"
+PARENT_TASK_HEADER = "task.parent"
 
 _tls = threading.local()
 
@@ -60,10 +71,66 @@ def activate_span(span) -> Any:
     return activate(TraceContext(span.trace_id, span.span_id))
 
 
+# -- ambient task ---------------------------------------------------------
+
+def current_task():
+    """The locally registered Task the calling code runs under, as the
+    ``(node_id, task)`` pair installed by ``activate_task`` (None when
+    none is active)."""
+    return getattr(_tls, "task", None)
+
+
+@contextmanager
+def activate_task(node_id: str, task):
+    """Install a registered Task as the ambient sender context: every
+    ``send_request`` issued under it (including ones whose callbacks
+    were ``bind()``-carried through a scheduler) stamps this task into
+    the request headers, so the receiving handler parents its child
+    task to it."""
+    prev = getattr(_tls, "task", None)
+    _tls.task = (node_id, task) if task is not None else None
+    try:
+        yield task
+    finally:
+        _tls.task = prev
+
+
+def incoming_parent_task() -> Optional[str]:
+    """The ``task.id`` string the current transport request carried
+    (the REMOTE caller's task — i.e. the parent for any task this
+    handler registers); None outside a task-stamped dispatch."""
+    return getattr(_tls, "task_parent", None)
+
+
 # -- wire headers ---------------------------------------------------------
 
 def headers_of(span) -> Dict[str, str]:
     return {TRACE_HEADER: span.trace_id, SPAN_HEADER: span.span_id}
+
+
+def task_headers(node_id: str, task) -> Dict[str, str]:
+    """The task half of the ``__headers`` carrier: the sender's own task
+    id (the receiver's parent) plus the sender's parent for tree
+    observability."""
+    out = {TASK_HEADER: f"{node_id}:{task.id}"}
+    parent = getattr(task, "parent_task_id", None)
+    if parent is not None and parent.id != -1:
+        out[PARENT_TASK_HEADER] = str(parent)
+    return out
+
+
+def stamp_task_headers(headers: Optional[Dict[str, Any]]
+                       ) -> Optional[Dict[str, Any]]:
+    """Merge the ambient task (if any) into outgoing request headers;
+    explicit ``task.id`` headers win. Returns the original dict object
+    untouched when there is nothing to add."""
+    cur = getattr(_tls, "task", None)
+    if cur is None or (headers and TASK_HEADER in headers):
+        return headers
+    node_id, task = cur
+    merged = dict(headers or {})
+    merged.update(task_headers(node_id, task))
+    return merged
 
 
 def from_headers(headers: Optional[Dict[str, Any]]
@@ -78,27 +145,41 @@ def from_headers(headers: Optional[Dict[str, Any]]
 
 @contextmanager
 def incoming(headers: Optional[Dict[str, Any]]):
-    """Dispatch-side: install the context carried by a request's
-    headers for the duration of its handler (no-op without headers)."""
+    """Dispatch-side: install the trace context AND the caller's task id
+    carried by a request's headers for the duration of its handler
+    (no-op without headers)."""
     ctx = from_headers(headers)
-    if ctx is None:
+    task_id = (headers or {}).get(TASK_HEADER)
+    if ctx is None and task_id is None:
         yield None
         return
-    with activate(ctx):
+    prev_ctx = getattr(_tls, "ctx", None)
+    prev_task = getattr(_tls, "task_parent", None)
+    if ctx is not None:
+        _tls.ctx = ctx
+    _tls.task_parent = str(task_id) if task_id is not None else None
+    try:
         yield ctx
+    finally:
+        _tls.ctx = prev_ctx
+        _tls.task_parent = prev_task
 
 
 # -- task-boundary carry --------------------------------------------------
 
 def capture():
-    """Snapshot (profile recorder, profile sink, trace context); None
-    when nothing is active — the common case costs three getattrs."""
+    """Snapshot (profile recorder, profile sink, cancel hook, trace
+    context, ambient task); None when nothing is active — the common
+    case costs a handful of getattrs."""
     rec = getattr(_profile._tls, "rec", None)
     sink = getattr(_profile._tls, "sink", None)
+    cancel = getattr(_profile._tls, "cancel", None)
     ctx = getattr(_tls, "ctx", None)
-    if rec is None and sink is None and ctx is None:
+    task = getattr(_tls, "task", None)
+    if rec is None and sink is None and cancel is None \
+            and ctx is None and task is None:
         return None
-    return (rec, sink, ctx)
+    return (rec, sink, cancel, ctx, task)
 
 
 def bind(fn: Callable) -> Callable:
@@ -109,20 +190,26 @@ def bind(fn: Callable) -> Callable:
     cap = capture()
     if cap is None:
         return fn
-    rec, sink, ctx = cap
+    rec, sink, cancel, ctx, task = cap
 
     def bound():
         prev_rec = getattr(_profile._tls, "rec", None)
         prev_sink = getattr(_profile._tls, "sink", None)
+        prev_cancel = getattr(_profile._tls, "cancel", None)
         prev_ctx = getattr(_tls, "ctx", None)
+        prev_task = getattr(_tls, "task", None)
         _profile._tls.rec = rec
         _profile._tls.sink = sink
+        _profile._tls.cancel = cancel
         _tls.ctx = ctx
+        _tls.task = task
         try:
             return fn()
         finally:
             _profile._tls.rec = prev_rec
             _profile._tls.sink = prev_sink
+            _profile._tls.cancel = prev_cancel
             _tls.ctx = prev_ctx
+            _tls.task = prev_task
 
     return bound

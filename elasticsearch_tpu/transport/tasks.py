@@ -5,14 +5,41 @@ registers a Task; tasks form a parent/child tree across nodes; cancellable
 tasks support cooperative cancellation with ban propagation (a cancelled
 parent bans its id so late-arriving children are cancelled on arrival);
 `_tasks` list/cancel APIs sit on top.
+
+Cluster integration (the transport half lives in telemetry/context.py +
+transport/transport.py): a registered task made ambient via
+``telemetry.context.activate_task`` is stamped into the ``__headers``
+carrier of every outgoing request (``task.id``/``task.parent``), and the
+dispatch side installs the incoming ``task.id`` so handlers register
+their work as a CHILD of the remote caller's task. Tasks also record the
+ambient ``trace.id`` at registration, so ``GET /_tasks`` and
+``GET /_traces`` cross-link.
 """
 
 from __future__ import annotations
 
+import fnmatch
 import threading
 import time
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Tuple
+import weakref
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+# every live TaskManager, for the test-suite leak guard (mirror of
+# telemetry/tracing.py's open-span registry): a task registered during a
+# test and never unregistered is a leak
+_MANAGERS: "weakref.WeakSet[TaskManager]" = weakref.WeakSet()
+
+
+def open_task_keys() -> set:
+    """(node_id, task_id, action) of every currently registered task,
+    across all live managers in the process."""
+    out = set()
+    for mgr in list(_MANAGERS):
+        with mgr._lock:
+            for t in mgr._tasks.values():
+                out.add((mgr.node_id, t.id, t.action))
+    return out
 
 
 @dataclass(frozen=True)
@@ -40,17 +67,25 @@ EMPTY_TASK_ID = TaskId("", -1)
 class Task:
     def __init__(self, task_id: int, type_: str, action: str,
                  description: str = "",
-                 parent_task_id: TaskId = EMPTY_TASK_ID):
+                 parent_task_id: TaskId = EMPTY_TASK_ID,
+                 clock: Optional[Callable[[], float]] = None):
         self.id = task_id
         self.type = type_
         self.action = action
         self.description = description
         self.parent_task_id = parent_task_id
         self.start_time = time.time()
-        self.start_nanos = time.monotonic_ns()
+        # running time reads the manager's clock (virtual time under the
+        # deterministic harness, so replayed runs report identical trees)
+        self._clock = clock or time.monotonic
+        self._start = self._clock()
+        # cross-link with the trace that was ambient at registration
+        from elasticsearch_tpu.telemetry import context as _telectx
+        ctx = _telectx.current()
+        self.trace_id: Optional[str] = ctx.trace_id if ctx else None
 
     def running_time_nanos(self) -> int:
-        return time.monotonic_ns() - self.start_nanos
+        return int((self._clock() - self._start) * 1e9)
 
     def to_dict(self, node_id: str) -> Dict[str, Any]:
         d = {
@@ -63,6 +98,8 @@ class Task:
             "running_time_in_nanos": self.running_time_nanos(),
             "cancellable": isinstance(self, CancellableTask),
         }
+        if self.trace_id is not None:
+            d["trace.id"] = self.trace_id
         if self.parent_task_id is not EMPTY_TASK_ID and \
                 self.parent_task_id.id != -1:
             d["parent_task_id"] = str(self.parent_task_id)
@@ -124,16 +161,31 @@ class CancellableTask(Task):
 
 class TaskManager:
     """Per-node live-task registry + cancellation bans (ref:
-    TaskManager.register / cancelTaskAndDescendants / setBan)."""
+    TaskManager.register / cancelTaskAndDescendants / setBan).
 
-    def __init__(self, node_id: str):
+    ``metrics`` (a telemetry MetricsRegistry, optional) receives
+    ``tasks.started``/``tasks.completed``/``tasks.cancelled`` counters
+    labeled by action and the live ``tasks.current`` gauge; ``clock``
+    (optional) drives running-time so the deterministic harness reports
+    replay-identical task trees."""
+
+    def __init__(self, node_id: str, metrics=None,
+                 clock: Optional[Callable[[], float]] = None):
         self.node_id = node_id
+        self.metrics = metrics
+        self.clock = clock
         self._seq = 0
         self._lock = threading.Lock()
         self._tasks: Dict[int, Task] = {}
         # banned parent ids: children arriving after the ban are cancelled
         # immediately (ref: TaskManager bans + ban propagation RPCs)
         self._bans: Dict[TaskId, str] = {}
+        # lifetime accounting for stats()/bench
+        self.started_total = 0
+        self.completed_total = 0
+        self.cancelled_total = 0
+        self.peak_concurrent = 0
+        _MANAGERS.add(self)
 
     def register(self, type_: str, action: str, description: str = "",
                  parent_task_id: TaskId = EMPTY_TASK_ID,
@@ -141,51 +193,96 @@ class TaskManager:
         with self._lock:
             self._seq += 1
             cls = CancellableTask if cancellable else Task
-            task = cls(self._seq, type_, action, description, parent_task_id)
+            task = cls(self._seq, type_, action, description,
+                       parent_task_id, clock=self.clock)
             self._tasks[task.id] = task
+            self.started_total += 1
+            self.peak_concurrent = max(self.peak_concurrent,
+                                       len(self._tasks))
+            live = len(self._tasks)
             ban_reason = self._bans.get(parent_task_id)
+        if self.metrics is not None:
+            self.metrics.inc("tasks.started", action=action)
+            self.metrics.set_gauge("tasks.current", live)
         if ban_reason is not None and isinstance(task, CancellableTask):
+            self._count_cancelled(task)
             task.cancel(f"parent banned [{ban_reason}]")
         return task
 
     def unregister(self, task: Task) -> None:
         with self._lock:
-            self._tasks.pop(task.id, None)
+            removed = self._tasks.pop(task.id, None)
+            if removed is not None:
+                self.completed_total += 1
+            live = len(self._tasks)
             # the ban (if any) dies with the task (ref: TaskManager
             # removes bans when the parent unregisters)
             self._bans.pop(TaskId(self.node_id, task.id), None)
+        if removed is not None and self.metrics is not None:
+            self.metrics.inc("tasks.completed", action=task.action)
+            self.metrics.set_gauge("tasks.current", live)
 
     def get_task(self, task_id: int) -> Optional[Task]:
         with self._lock:
             return self._tasks.get(task_id)
 
-    def list_tasks(self, actions: Optional[str] = None) -> List[Task]:
+    def list_tasks(self, actions: Optional[str] = None,
+                   parent_task_id: Optional[TaskId] = None) -> List[Task]:
         with self._lock:
             tasks = list(self._tasks.values())
         if actions:
-            import fnmatch
             patterns = [p.strip() for p in actions.split(",") if p.strip()]
             tasks = [t for t in tasks
                      if any(fnmatch.fnmatch(t.action, p) for p in patterns)]
+        if parent_task_id is not None:
+            tasks = [t for t in tasks
+                     if t.parent_task_id == parent_task_id]
         return tasks
 
     def cancel(self, task: CancellableTask, reason: str,
                ban_children: bool = True) -> None:
-        task.cancel(reason)
+        self._count_cancelled(task)
+        # the ban goes up BEFORE listeners run: a cancellation listener
+        # may complete-and-unregister the task synchronously (the
+        # coordinator's search does), and unregistration is what sweeps
+        # the ban — setting it afterwards would orphan it forever
         if ban_children:
             self.set_ban(TaskId(self.node_id, task.id), reason)
+        task.cancel(reason)
+        if ban_children:
             # cancel already-registered local descendants
             for child in self._children_of(TaskId(self.node_id, task.id)):
                 if isinstance(child, CancellableTask):
                     self.cancel(child, reason, ban_children=True)
 
-    def set_ban(self, parent: TaskId, reason: str) -> None:
+    def _count_cancelled(self, task: CancellableTask) -> None:
+        if task.is_cancelled():
+            return  # idempotent cancel: count the transition once
+        self.cancelled_total += 1
+        if self.metrics is not None:
+            self.metrics.inc("tasks.cancelled", action=task.action)
+
+    def set_ban(self, parent: TaskId, reason: str,
+                cancel_children: bool = False) -> None:
+        """Ban a parent id so late-arriving children die on arrival;
+        with ``cancel_children`` also cancel its ALREADY-registered
+        local children — the remote half of ``cancel()`` (ref: the
+        SetBan RPC of TaskManager ban propagation)."""
         with self._lock:
             self._bans[parent] = reason
+        if cancel_children:
+            for child in self._children_of(parent):
+                if isinstance(child, CancellableTask):
+                    self.cancel(child, f"parent banned [{reason}]",
+                                ban_children=True)
 
     def remove_ban(self, parent: TaskId) -> None:
         with self._lock:
             self._bans.pop(parent, None)
+
+    def ban_count(self) -> int:
+        with self._lock:
+            return len(self._bans)
 
     def _children_of(self, parent: TaskId) -> List[Task]:
         with self._lock:
@@ -197,6 +294,17 @@ class TaskManager:
                    cancellable: bool = False) -> "_TaskScope":
         return _TaskScope(self, type_, action, description, parent_task_id,
                           cancellable)
+
+    def stats(self) -> Dict[str, Any]:
+        """The ``tasks`` stats section (nodes stats + BENCH json)."""
+        with self._lock:
+            current = len(self._tasks)
+        return {"current": current,
+                "peak_concurrent": self.peak_concurrent,
+                "started": self.started_total,
+                "completed": self.completed_total,
+                "cancelled": self.cancelled_total,
+                "bans": self.ban_count()}
 
 
 class _TaskScope:
@@ -214,3 +322,147 @@ class _TaskScope:
     def __exit__(self, *exc) -> None:
         if self.task is not None:
             self._manager.unregister(self.task)
+
+
+# ---------------------------------------------------------------------------
+# `_tasks` response shaping — shared by the single-node REST handlers and
+# the cluster fan-out (`ClusterNode.list_tasks`), so the two surfaces can
+# never drift (ref: rest/action/admin/cluster/RestListTasksAction
+# group-by rendering over TransportListTasksAction node responses).
+# ---------------------------------------------------------------------------
+
+def filter_task_dicts(tasks: List[Dict[str, Any]],
+                      actions: Optional[str] = None,
+                      parent_task_id: Optional[str] = None,
+                      detailed: bool = True) -> List[Dict[str, Any]]:
+    """Apply the `_tasks` request filters to serialized task dicts."""
+    out = []
+    patterns = [p.strip() for p in (actions or "").split(",") if p.strip()]
+    for t in tasks:
+        if patterns and not any(fnmatch.fnmatch(t.get("action", ""), p)
+                                for p in patterns):
+            continue
+        if parent_task_id and t.get("parent_task_id") != parent_task_id:
+            continue
+        if not detailed:
+            t = {k: v for k, v in t.items() if k != "description"}
+        out.append(t)
+    return out
+
+
+def build_tasks_response(node_infos: Dict[str, Dict[str, Any]],
+                         group_by: str = "nodes",
+                         node_failures: Optional[List[Dict]] = None
+                         ) -> Dict[str, Any]:
+    """Render the `_tasks` response from per-node task lists.
+
+    ``node_infos``: node_id -> {"name": str, "tasks": [task dicts]}.
+    ``group_by``: nodes (default, the per-node map), none (flat map), or
+    parents (top-level tasks with nested ``children``).
+    """
+    out: Dict[str, Any] = {}
+    if node_failures:
+        out["node_failures"] = node_failures
+    if group_by == "none":
+        out["tasks"] = {
+            f"{nid}:{t['id']}": t
+            for nid, info in node_infos.items()
+            for t in info.get("tasks", [])}
+        return out
+    if group_by == "parents":
+        by_id: Dict[str, Dict] = {}
+        for nid, info in node_infos.items():
+            for t in info.get("tasks", []):
+                by_id[f"{nid}:{t['id']}"] = dict(t)
+        roots: Dict[str, Dict] = {}
+        for tid, t in by_id.items():
+            parent = t.get("parent_task_id")
+            if parent and parent in by_id:
+                by_id[parent].setdefault("children", []).append(t)
+            else:
+                roots[tid] = t
+        for t in by_id.values():
+            if "children" in t:
+                t["children"].sort(
+                    key=lambda c: (c["node"], c["id"]))
+        out["tasks"] = roots
+        return out
+    if group_by != "nodes":
+        from elasticsearch_tpu.common.errors import (
+            IllegalArgumentException)
+        raise IllegalArgumentException(
+            f"unknown group_by [{group_by}], expected one of "
+            "[nodes, parents, none]")
+    out["nodes"] = {
+        nid: {"name": info.get("name", nid),
+              "tasks": {f"{nid}:{t['id']}": t
+                        for t in info.get("tasks", [])}}
+        for nid, info in node_infos.items()}
+    return out
+
+
+def render_cat_tasks(node_infos: Dict[str, Dict[str, Any]]) -> str:
+    """`_cat/tasks` lines from the same per-node task lists the `_tasks`
+    fan-out produces: action, task id, parent, type, start time, node."""
+    lines = []
+    for nid, info in sorted(node_infos.items()):
+        name = info.get("name", nid)
+        for t in sorted(info.get("tasks", []), key=lambda t: t["id"]):
+            lines.append(
+                f"{t['action']} {nid}:{t['id']} "
+                f"{t.get('parent_task_id', '-')} {t['type']} "
+                f"{t['start_time_in_millis']} {name}")
+    return "\n".join(lines)
+
+
+def node_task_slice(task_manager: "TaskManager", node_id: str,
+                    name: Optional[str] = None,
+                    actions: Optional[str] = None,
+                    parent_task_id: Optional[str] = None,
+                    detailed: bool = True,
+                    task_id: Optional[str] = None) -> Dict[str, Any]:
+    """One node's slice of the `_tasks` fan-out shape
+    (``{"name": ..., "tasks": [task dicts]}``) — the single builder
+    behind BOTH the cluster fan-out handler and the single-node REST
+    surface, so the per-node shaping cannot drift. ``task_id`` narrows
+    the slice to one task (the ``get_task`` wire probe, so the owner
+    doesn't serialize its whole task table per lookup)."""
+    tasks = [t.to_dict(node_id) for t in task_manager.list_tasks()]
+    if task_id is not None:
+        tid = TaskId.parse(str(task_id))
+        tasks = [t for t in tasks if t["id"] == tid.id]
+    return {"name": name or node_id,
+            "tasks": filter_task_dicts(tasks, actions=actions,
+                                       parent_task_id=parent_task_id,
+                                       detailed=detailed)}
+
+
+def parse_bool_param(value: Any, default: bool = False) -> bool:
+    """REST-style boolean param: accepts real bools and the string forms
+    the REST layer passes through ("true"/"false"); None → default. Both
+    `_tasks` surfaces (single-node REST and the cluster fan-out) parse
+    through here so their defaults cannot drift."""
+    if value is None:
+        return default
+    if isinstance(value, bool):
+        return value
+    return str(value).strip().lower() == "true"
+
+
+def register_child_of_incoming(task_manager: Optional["TaskManager"],
+                               action: str, description: str = ""):
+    """Register handler work as a cancellable CHILD of the remote
+    caller's task (the ``task.id`` request header the transport dispatch
+    installed) — None when no task manager is wired. A child whose
+    parent was banned before it arrived comes back already cancelled
+    (the ban-table race the reference's design exists for). Shared by
+    every data-node handler family so the child-registration contract
+    lives in one place."""
+    if task_manager is None:
+        return None
+    from elasticsearch_tpu.telemetry import context as _telectx
+    parent_s = _telectx.incoming_parent_task()
+    parent = TaskId.parse(parent_s) if parent_s else EMPTY_TASK_ID
+    return task_manager.register("transport", action,
+                                 description=description,
+                                 parent_task_id=parent, cancellable=True)

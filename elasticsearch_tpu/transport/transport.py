@@ -240,9 +240,12 @@ def instrument_send(telemetry, action: str, request: Any,
                     headers: Optional[Dict[str, Any]]):
     """The shared send-side telemetry seam (production TransportService
     and the sim DisruptableTransport call this, so counting/header
-    semantics cannot drift between them): attach the header carrier,
-    count the outbound request, wrap the handler with round-trip
-    timing. Returns the (request, handler) pair to send."""
+    semantics cannot drift between them): stamp the ambient task
+    (``task.id``/``task.parent`` — a send issued under a registered
+    task parents the remote handler's child task to it), attach the
+    header carrier, count the outbound request, wrap the handler with
+    round-trip timing. Returns the (request, handler) pair to send."""
+    headers = _telectx.stamp_task_headers(headers)
     request = attach_headers(request, headers)
     if telemetry is not None:
         telemetry.metrics.inc("transport.requests.sent", action=action)

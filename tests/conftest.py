@@ -92,6 +92,28 @@ def _span_leak_guard():
         f"finished): {sorted(k[3] for k in leaked)}")
 
 
+@pytest.fixture(autouse=True)
+def _task_leak_guard():
+    """Task hygiene (mirror of the span-leak guard): fail any test that
+    registers a task in a TaskManager and never unregisters it. Tasks
+    already live before the test (e.g. a background service of a
+    long-lived node from another fixture) are excluded — only tasks
+    REGISTERED during this test count as leaks."""
+    from elasticsearch_tpu.transport import tasks as _tasks
+    before = _tasks.open_task_keys()
+    yield
+    leaked = _tasks.open_task_keys() - before
+    if leaked:
+        # wall-clock transports/threads may still be completing a
+        # request; give in-flight handlers one beat before calling it
+        import time as _time
+        _time.sleep(0.2)
+        leaked = _tasks.open_task_keys() - before
+    assert not leaked, (
+        "tasks left registered at teardown (registered, never "
+        f"unregistered): {sorted((k[0], k[2]) for k in leaked)}")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

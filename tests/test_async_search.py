@@ -70,6 +70,7 @@ def test_cancelled_search_returns_400(node):
     from elasticsearch_tpu.common.errors import TaskCancelledException
     with pytest.raises(TaskCancelledException):
         node.search_service.search("idx", {}, task=task)
+    node.task_manager.unregister(task)
 
 
 def test_ban_propagates_to_children(node):
@@ -81,6 +82,8 @@ def test_ban_propagates_to_children(node):
         "transport", "child", parent_task_id=TaskId(node.node_id, parent.id),
         cancellable=True)
     assert child.is_cancelled()
+    node.task_manager.unregister(child)
+    node.task_manager.unregister(parent)
 
 
 # ----------------------------------------------------------- async search

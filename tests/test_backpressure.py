@@ -449,15 +449,15 @@ def test_replica_429_retries_and_never_marks_stale(tmp_path, chaos_seed):
     r_node = next(iter(cluster.shard_node_ids("r", 0) - {p_node}))
     replica_cn = cluster.cluster_nodes[r_node]
     # make sure the PRIMARY's applied state has the replica started
-    # BEFORE any write (a node can miss one publication and only catch
-    # up on the next state change — nudge with a no-op index until it
-    # has), so every op below replicates and checkpoints stay aligned
+    # BEFORE any write, so every op below replicates and checkpoints
+    # stay aligned. A node that missed the publication now catches up on
+    # its own: the follower check carries the leader's applied version
+    # and a lagging node requests a resend (coordination.py
+    # RESEND_STATE_ACTION) — no no-op-index-create nudge needed.
     primary_dn = cluster.cluster_nodes[p_node].data_node
-    for attempt in range(5):
+    for _ in range(5):
         if primary_dn._active_replicas("r", 0):
             break
-        cluster.call(master.create_index, f"nudge{attempt}",
-                     number_of_shards=1, number_of_replicas=0)
         cluster.run_for(30)
     assert primary_dn._active_replicas("r", 0), \
         f"seed={chaos_seed}: primary never saw the started replica"

@@ -1,0 +1,520 @@
+"""Cluster-wide task management: parent/child task trees over
+transport, live `_tasks` fan-out, and cross-node cancellation that
+reaches the engine's per-shard / device-launch loops.
+
+The contract under test (ref: TaskManager + TransportListTasksAction /
+TransportCancelTasksAction semantics):
+
+- every cluster search/bulk registers a cancellable coordinator parent;
+  per-shard handlers on data nodes register children under the remote
+  parent carried in the ``task.id`` request header;
+- ``list_tasks(group_by=parents)`` on a live multi-node search shows the
+  coordinator parent with per-shard children attributed to their owning
+  nodes, all cross-linked to one ``trace.id``;
+- cancelling the parent from ANY node stops in-flight shard work on
+  OTHER nodes: unresolved shards fold into the partial-results protocol
+  as typed ``task_cancelled_exception`` failures, and the ban table
+  kills children that register AFTER the cancel (the race the ban
+  design exists for);
+- seed replay yields identical task trees.
+
+Chaos scenarios are @pytest.mark.chaos(seed=N); a red run echoes its
+seed and replays with ``pytest <nodeid> --chaos-seed=N``.
+"""
+
+import pytest
+from test_search_failover import ChaosCluster, _setup
+
+from elasticsearch_tpu.cluster.search_action import (
+    QUERY_PHASE_ACTION,
+    SEARCH_ACTION,
+    TASK_CANCELLED_TYPE,
+)
+from elasticsearch_tpu.testing.deterministic import DISCONNECTED
+from elasticsearch_tpu.testing.faults import DELAY, FaultRule
+from elasticsearch_tpu.transport.tasks import (
+    EMPTY_TASK_ID,
+    TaskId,
+    TaskManager,
+    build_tasks_response,
+    filter_task_dicts,
+    render_cat_tasks,
+)
+
+# ---------------------------------------------------------------------------
+# TaskManager unit contract: bans, counters, shaping
+# ---------------------------------------------------------------------------
+
+
+def test_ban_kills_child_registered_after_cancel():
+    tm = TaskManager("n1")
+    parent = tm.register("transport", "indices:data/read/search",
+                         cancellable=True)
+    tm.cancel(parent, "test")
+    child = tm.register("transport", QUERY_PHASE_ACTION,
+                        parent_task_id=TaskId("n1", parent.id),
+                        cancellable=True)
+    assert child.is_cancelled()
+    assert "parent banned" in child.cancellation_reason()
+    tm.unregister(child)
+    tm.unregister(parent)
+    # the ban dies with the parent: a later child is NOT cancelled
+    late = tm.register("transport", QUERY_PHASE_ACTION,
+                       parent_task_id=TaskId("n1", parent.id),
+                       cancellable=True)
+    assert not late.is_cancelled()
+    tm.unregister(late)
+
+
+def test_remote_ban_cancels_registered_children_and_future_ones():
+    """set_ban(cancel_children=True) is the remote half of a cancel:
+    already-registered children die AND later arrivals die on
+    registration."""
+    tm = TaskManager("data-1")
+    remote_parent = TaskId("coord-1", 7)
+    child = tm.register("transport", QUERY_PHASE_ACTION,
+                        parent_task_id=remote_parent, cancellable=True)
+    tm.set_ban(remote_parent, "by user request", cancel_children=True)
+    assert child.is_cancelled()
+    late = tm.register("transport", QUERY_PHASE_ACTION,
+                       parent_task_id=remote_parent, cancellable=True)
+    assert late.is_cancelled()
+    tm.remove_ban(remote_parent)
+    ok = tm.register("transport", QUERY_PHASE_ACTION,
+                     parent_task_id=remote_parent, cancellable=True)
+    assert not ok.is_cancelled()
+    for t in (child, late, ok):
+        tm.unregister(t)
+    assert tm.stats()["cancelled"] == 2
+    assert tm.stats()["current"] == 0
+
+
+def test_task_manager_stats_and_peak():
+    tm = TaskManager("n1")
+    a = tm.register("transport", "a")
+    b = tm.register("transport", "b", cancellable=True)
+    assert tm.stats()["current"] == 2
+    assert tm.stats()["peak_concurrent"] == 2
+    tm.cancel(b, "x")
+    tm.cancel(b, "x")     # idempotent: counted once
+    tm.unregister(a)
+    tm.unregister(b)
+    s = tm.stats()
+    assert s == {"current": 0, "peak_concurrent": 2, "started": 2,
+                 "completed": 2, "cancelled": 1, "bans": 0}
+
+
+def test_tasks_response_shaping_group_by():
+    infos = {
+        "n1": {"name": "node1", "tasks": [
+            {"node": "n1", "id": 1, "type": "transport",
+             "action": SEARCH_ACTION, "description": "d",
+             "start_time_in_millis": 1, "running_time_in_nanos": 5,
+             "cancellable": True}]},
+        "n2": {"name": "node2", "tasks": [
+            {"node": "n2", "id": 3, "type": "transport",
+             "action": QUERY_PHASE_ACTION, "description": "d2",
+             "start_time_in_millis": 2, "running_time_in_nanos": 4,
+             "cancellable": True, "parent_task_id": "n1:1"}]},
+    }
+    by_nodes = build_tasks_response(infos, group_by="nodes")
+    assert by_nodes["nodes"]["n1"]["tasks"]["n1:1"]["action"] == \
+        SEARCH_ACTION
+    flat = build_tasks_response(infos, group_by="none")
+    assert set(flat["tasks"]) == {"n1:1", "n2:3"}
+    tree = build_tasks_response(infos, group_by="parents")
+    assert set(tree["tasks"]) == {"n1:1"}
+    (child,) = tree["tasks"]["n1:1"]["children"]
+    assert child["node"] == "n2" and child["id"] == 3
+    with pytest.raises(Exception):
+        build_tasks_response(infos, group_by="bogus")
+    # filters
+    only_search = filter_task_dicts(
+        [t for i in infos.values() for t in i["tasks"]],
+        actions="indices:data/read/search")
+    assert len(only_search) == 1
+    stripped = filter_task_dicts(infos["n1"]["tasks"], detailed=False)
+    assert "description" not in stripped[0]
+    cat = render_cat_tasks(infos)
+    assert "indices:data/read/search n1:1 -" in cat
+    assert "n1:1 transport" in cat.splitlines()[1]
+
+
+# ---------------------------------------------------------------------------
+# cluster harness helpers
+# ---------------------------------------------------------------------------
+
+
+def _slow_queries(cluster, step_delay=0.3):
+    """Make every data node's per-shard query loop yield between shards,
+    so cancels/bans/`_tasks` RPCs interleave mid-search."""
+    for cn in cluster.cluster_nodes.values():
+        cn.search_service.query_step_delay = step_delay
+
+
+def _start_search(cluster, coord, body=None):
+    box = {}
+
+    def on_done(result, err=None):
+        box["result"] = result
+        box["err"] = err
+
+    coord.search("logs", body or {"query": {"match": {"body": "fox"}},
+                                  "size": 5}, on_done=on_done)
+    return box
+
+
+def _call_fast(cluster, fn, *args, timeout=10.0, **kwargs):
+    """cluster.call with fine-grained sim steps (0.05s instead of 1s),
+    so mid-flight probes — list/get/cancel — resolve while the slowed
+    search is still running."""
+    box = {}
+
+    def on_done(result, err=None):
+        box["result"] = result
+        box["err"] = err
+
+    fn(*args, **kwargs, on_done=on_done)
+    waited = 0.0
+    while "result" not in box and "err" not in box and waited < timeout:
+        cluster.run_for(0.05)
+        waited += 0.05
+    assert "result" in box or "err" in box, "call never completed"
+    if box.get("err") is not None:
+        raise box["err"]
+    return box["result"]
+
+
+def _await(cluster, box, timeout=60):
+    waited = 0.0
+    while "result" not in box and "err" not in box and waited < timeout:
+        cluster.run_for(1.0)
+        waited += 1.0
+    assert "result" in box or "err" in box, "search never completed"
+    if box.get("err") is not None:
+        raise box["err"]
+    return box["result"]
+
+
+# ---------------------------------------------------------------------------
+# live `_tasks` fan-out
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos(seed=31)
+def test_live_search_shows_parent_child_tree(tmp_path, chaos_seed):
+    """`list_tasks(group_by=parents)` mid-search: one coordinator parent
+    (`indices:data/read/search`) with per-shard query children
+    attributed to their owning nodes, all sharing the parent's
+    trace.id."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    _setup(cluster, shards=4, replicas=0)
+    _slow_queries(cluster)
+    coord = cluster.master()
+    box = _start_search(cluster, coord)
+    cluster.run_for(0.2)    # queries delivered, children registered
+
+    tree = _call_fast(cluster, coord.list_tasks,
+                      {"group_by": "parents", "detailed": True})
+    roots = {tid: t for tid, t in tree["tasks"].items()
+             if t["action"] == SEARCH_ACTION}
+    assert len(roots) == 1, f"seed={chaos_seed}: {tree}"
+    (root_id, root), = roots.items()
+    assert root["node"] == coord.local_node.node_id
+    assert root["cancellable"] is True
+    assert "source[" in root["description"]
+    children = root.get("children", [])
+    assert children, f"seed={chaos_seed}: no live children in {tree}"
+    assert {c["action"] for c in children} == {QUERY_PHASE_ACTION}
+    assert all(c["parent_task_id"] == root_id for c in children)
+    # children live on their owning nodes, not (only) the coordinator
+    child_nodes = {c["node"] for c in children}
+    assert child_nodes <= set(cluster.cluster_nodes)
+    # one trace cross-links the whole tree (`_tasks` ↔ `_traces`)
+    trace_ids = {root["trace.id"]} | {c["trace.id"] for c in children}
+    assert len(trace_ids) == 1 and None not in trace_ids, \
+        f"seed={chaos_seed}: {trace_ids}"
+
+    # cluster-aware GET /_tasks/{id} from a NON-owner node resolves the
+    # owner itself
+    other = cluster.coordinator_excluding(coord.local_node.node_id)
+    got = _call_fast(cluster, other.get_task, root_id)
+    assert got["completed"] is False
+    assert got["task"]["action"] == SEARCH_ACTION
+
+    resp = _await(cluster, box)
+    assert resp["_shards"]["failed"] == 0
+    # everything unregistered once the search finished
+    done = cluster.call(coord.list_tasks, {"group_by": "none"})
+    assert not any(t["action"].startswith("indices:data/read/search")
+                   for t in done["tasks"].values()), done
+    with pytest.raises(Exception):
+        _call_fast(cluster, other.get_task, root_id)   # finished → 404
+
+
+@pytest.mark.chaos(seed=32)
+def test_bulk_registers_parent_and_shard_children(tmp_path, chaos_seed):
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    _setup(cluster, shards=2, replicas=1)
+    coord = cluster.master()
+    started = {nid: cn.task_manager.stats()["started"]
+               for nid, cn in cluster.cluster_nodes.items()}
+    resp = cluster.call(coord.bulk, "logs",
+                        [{"op": "index", "id": f"t-{i}",
+                          "source": {"body": "task tree", "n": i}}
+                         for i in range(8)])
+    assert resp["errors"] == []
+    # the coordinator registered the bulk parent...
+    m = coord.telemetry.metrics
+    assert m.get_value("tasks.started",
+                       action="indices:data/write/bulk") >= 1
+    # ...and at least one node registered primary shard-bulk children
+    # + replica grandchildren under it
+    assert any(
+        cn.telemetry.metrics.get_value(
+            "tasks.started",
+            action="indices:data/write/bulk[s][p]") >= 1
+        for cn in cluster.cluster_nodes.values())
+    assert any(
+        cn.telemetry.metrics.get_value(
+            "tasks.started",
+            action="indices:data/write/bulk[s][r]") >= 1
+        for cn in cluster.cluster_nodes.values())
+    # all task work completed (started == completed cluster-wide)
+    for nid, cn in cluster.cluster_nodes.items():
+        s = cn.task_manager.stats()
+        assert s["current"] == 0, f"seed={chaos_seed}: {nid}: {s}"
+    assert sum(cn.task_manager.stats()["started"] - started[nid]
+               for nid, cn in cluster.cluster_nodes.items()) >= 3
+
+
+# ---------------------------------------------------------------------------
+# cancellation that bites
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos(seed=33)
+def test_cancel_mid_query_stops_remote_shards_partial_results(
+        tmp_path, chaos_seed):
+    """POST /_tasks/{id}/_cancel against the coordinator parent while
+    shard queries run on OTHER nodes: the data-node children report
+    cancelled (their remaining shards never execute) and the search
+    returns partial results with typed task_cancelled failures."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    _setup(cluster, shards=6, replicas=0, n=30)
+    _slow_queries(cluster, step_delay=0.5)
+    coord = cluster.master()
+    box = _start_search(cluster, coord)
+    cluster.run_for(0.2)
+
+    parents = coord.task_manager.list_tasks(actions=SEARCH_ACTION)
+    assert len(parents) == 1, f"seed={chaos_seed}"
+    parent_id = f"{coord.local_node.node_id}:{parents[0].id}"
+
+    # cancel from a DIFFERENT node: it must resolve the owner itself
+    other = cluster.coordinator_excluding(coord.local_node.node_id)
+    cancel_resp = _call_fast(cluster, other.cancel_task, parent_id)
+    cancelled_task = list(
+        cancel_resp["nodes"][coord.local_node.node_id]["tasks"]
+        .values())[0]
+    assert cancelled_task["cancelled"] is True
+
+    resp = _await(cluster, box)
+    failures = resp["_shards"].get("failures", [])
+    cancelled_failures = [f for f in failures
+                          if f["reason"]["type"] == TASK_CANCELLED_TYPE]
+    assert cancelled_failures, f"seed={chaos_seed}: {resp['_shards']}"
+    assert resp["_shards"]["failed"] >= len(cancelled_failures)
+    # a data-node child on ANOTHER node observed the cancellation (via
+    # the ban broadcast), not just the coordinator's own shards
+    remote_cancelled = [
+        nid for nid, cn in cluster.cluster_nodes.items()
+        if nid != coord.local_node.node_id
+        and cn.task_manager.stats()["cancelled"] >= 1]
+    assert remote_cancelled, f"seed={chaos_seed}: cancel never reached " \
+        "a remote data node"
+    cluster.run_for(10)
+    for nid, cn in cluster.cluster_nodes.items():
+        s = cn.task_manager.stats()
+        assert s["current"] == 0, f"seed={chaos_seed}: {nid}: {s}"
+        # the ban markers were swept once the cancelled parent finished
+        assert s["bans"] == 0, f"seed={chaos_seed}: {nid}: {s}"
+
+
+@pytest.mark.chaos(seed=34)
+def test_cancel_before_child_registers_ban_kills_on_arrival(
+        tmp_path, chaos_seed):
+    """The ban-table race: the query RPC to one node is delayed past the
+    cancel, so its child does not exist when the ban arrives — yet it
+    still dies (cancelled at registration) and answers typed
+    task_cancelled errors."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    _setup(cluster, shards=4, replicas=0)
+    coord = cluster.master()
+    # every query RPC arrives ~2s late; the cancel lands well before
+    cluster.injector.add_rule(FaultRule(
+        action=QUERY_PHASE_ACTION, mode=DELAY, delay=(2.0, 2.0)))
+    box = _start_search(cluster, coord)
+    cluster.run_for(0.2)
+    parents = coord.task_manager.list_tasks(actions=SEARCH_ACTION)
+    assert len(parents) == 1, f"seed={chaos_seed}"
+    parent_id = f"{coord.local_node.node_id}:{parents[0].id}"
+    cluster.call(coord.cancel_task, parent_id)
+
+    resp = _await(cluster, box)
+    # the parent resolved every group as cancelled — all shards failed,
+    # yet the partial-results protocol returns a response, not an error
+    assert resp["_shards"]["failed"] == resp["_shards"]["total"]
+    assert all(f["reason"]["type"] == TASK_CANCELLED_TYPE
+               for f in resp["_shards"]["failures"])
+    # drive the delayed queries to arrival: children register against
+    # the ban and die without running a single shard
+    cluster.run_for(10)
+    born_dead = [nid for nid, cn in cluster.cluster_nodes.items()
+                 if cn.task_manager.stats()["cancelled"] >= 1]
+    assert born_dead, f"seed={chaos_seed}: ban never killed a child"
+    for cn in cluster.cluster_nodes.values():
+        assert cn.task_manager.stats()["current"] == 0
+
+
+@pytest.mark.chaos(seed=38)
+def test_cancel_between_query_and_fetch_reports_typed_failures(
+        tmp_path, chaos_seed):
+    """A cancel landing AFTER the query phase reduced but BEFORE the
+    fetch fan-out must not look like a clean zero-hit result: the
+    skipped shards become typed task_cancelled failures (phase=fetch)
+    while the reduced totals survive."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    _setup(cluster, shards=4, replicas=0)
+    coord = cluster.master()
+    svc = coord.search_service
+    orig_fetch = svc._fetch_phase
+
+    def cancel_then_fetch(ctx):
+        coord.task_manager.cancel(ctx["task"], "between phases")
+        orig_fetch(ctx)
+
+    svc._fetch_phase = cancel_then_fetch
+    try:
+        box = _start_search(cluster, coord)
+        resp = _await(cluster, box)
+    finally:
+        svc._fetch_phase = orig_fetch
+    assert resp["hits"]["hits"] == []
+    assert resp["hits"]["total"]["value"] > 0   # reduced totals kept
+    shards = resp["_shards"]
+    assert shards["failed"] == shards["total"], shards
+    assert all(f["reason"]["type"] == TASK_CANCELLED_TYPE
+               and f["reason"]["phase"] == "fetch"
+               for f in shards["failures"]), shards
+    cluster.run_for(5)
+    for cn in cluster.cluster_nodes.values():
+        assert cn.task_manager.stats()["current"] == 0
+
+
+@pytest.mark.chaos(seed=35)
+def test_seed_replay_yields_identical_task_trees(tmp_path, chaos_seed):
+    """Two runs from one seed observe the SAME mid-flight task tree
+    (ids, actions, parents, owning nodes) — tasks ride the same
+    deterministic schedule as everything else."""
+
+    def one_run(subdir):
+        cluster = ChaosCluster(3, tmp_path / subdir, seed=chaos_seed)
+        _setup(cluster, shards=4, replicas=0)
+        _slow_queries(cluster)
+        coord = cluster.master()
+        box = _start_search(cluster, coord)
+        cluster.run_for(0.2)
+        flat = _call_fast(cluster, coord.list_tasks, {"group_by": "none"})
+        _await(cluster, box)
+        return sorted(
+            (tid, t["action"], t.get("parent_task_id", ""), t["node"],
+             t.get("trace.id", ""))
+            for tid, t in flat["tasks"].items()
+            if t["action"].startswith("indices:data/read/search"))
+
+    assert one_run("a") == one_run("b"), f"seed={chaos_seed}"
+
+
+# ---------------------------------------------------------------------------
+# fan-out resilience + cat surface
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos(seed=36)
+def test_list_tasks_reports_unreachable_node_as_failure(
+        tmp_path, chaos_seed):
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    cluster.stabilise()
+    coord = cluster.master()
+    dead = next(n for n in cluster.nodes
+                if n.node_id != coord.local_node.node_id)
+    cluster.network.isolate(dead, cluster.nodes, mode=DISCONNECTED)
+    resp = cluster.call(coord.list_tasks, {})
+    assert dead.node_id not in resp["nodes"]
+    assert any(f["node_id"] == dead.node_id
+               for f in resp.get("node_failures", []))
+    cluster.network.heal()
+
+
+@pytest.mark.chaos(seed=37)
+def test_cat_tasks_renders_cluster_rows(tmp_path, chaos_seed):
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    _setup(cluster, shards=4, replicas=0)
+    _slow_queries(cluster)
+    coord = cluster.master()
+    box = _start_search(cluster, coord)
+    cluster.run_for(0.2)
+    text = _call_fast(cluster, coord.cat_tasks)
+    assert SEARCH_ACTION in text, f"seed={chaos_seed}: {text!r}"
+    _await(cluster, box)
+
+
+# ---------------------------------------------------------------------------
+# cluster-state publication lag detector (satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos(seed=38)
+def test_missed_publication_repairs_via_resend(tmp_path, chaos_seed):
+    """A node partitioned through one publication misses it but stays a
+    member; the next follower check carries the leader's applied
+    version, the laggard requests a resend, and it catches up WITHOUT
+    any further state change (the PR-4 known issue)."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    master = cluster.stabilise()
+    lagger = next(n for n in cluster.nodes
+                  if n.node_id != master.local_node.node_id)
+    lag_cn = cluster.cluster_nodes[lagger.node_id]
+    cluster.network.isolate(lagger, cluster.nodes, mode=DISCONNECTED)
+    resp = cluster.call(master.create_index, "lagidx",
+                        number_of_shards=1, number_of_replicas=0,
+                        timeout=2)
+    assert resp == {"acknowledged": True}
+    assert lag_cn.state.version < master.state.version, \
+        f"seed={chaos_seed}: laggard applied the state it missed?"
+    # the master's view shows the lag (stale follower-check record)
+    assert master.cluster_state_stats()["state_lag"][lagger.node_id] \
+        >= 1
+    cluster.network.heal()
+    cluster.run_for(15)
+    assert lag_cn.state.version == master.state.version, \
+        f"seed={chaos_seed}: resend never repaired the laggard"
+    assert "lagidx" in lag_cn.state.metadata.indices
+    assert master.cluster_state_stats()["state_lag"][lagger.node_id] \
+        == 0
+    assert lag_cn.cluster_state_stats()["version"] == \
+        master.state.version
+
+
+@pytest.mark.chaos(seed=39)
+def test_pending_cluster_tasks_shape(tmp_path, chaos_seed):
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    master = cluster.stabilise()
+    # quiesced master: empty queue; entries carry the pending shape
+    assert master.pending_cluster_tasks() == []
+    master.coordinator.submit_state_update("noop-probe", lambda s: s)
+    # non-master nodes report their own (empty) queue
+    other = cluster.coordinator_excluding(master.local_node.node_id)
+    assert other.pending_cluster_tasks() == []
+    cluster.run_for(5)
