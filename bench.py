@@ -77,7 +77,8 @@ def remaining_budget() -> float:
 
 
 def emit(metric_text: str, value: float, vs_baseline: float,
-         engine=None, overload=None, tasks=None):
+         engine=None, overload=None, tasks=None, cpu=None,
+         serving=None, skipped=None):
     _LAST_PAYLOAD.clear()
     _LAST_PAYLOAD.update({
         "metric": metric_text,
@@ -86,6 +87,21 @@ def emit(metric_text: str, value: float, vs_baseline: float,
         "vs_baseline": round(float(vs_baseline), 2)
         if np.isfinite(vs_baseline) else 0.0,
     })
+    if cpu:
+        # CPU-side rows (corpus stats, truth/baseline timings) — banked
+        # BEFORE the first device touch so a wedged relay can never cost
+        # the round its host-side results (BENCH_r04 rc=124 lesson)
+        _LAST_PAYLOAD["cpu"] = cpu
+    if serving:
+        # serving-path forensics: per-nb-bucket dispatch counts, warm-up
+        # seconds (and seconds saved via the persistent compile cache),
+        # cohort/batch histograms — attributes qps movement to each
+        # serving lever (impact selection / cache / batching)
+        _LAST_PAYLOAD["serving"] = serving
+    if skipped:
+        # sections that did not run this round, with reasons — an rc=124
+        # or device outage leaves a parseable record per section
+        _LAST_PAYLOAD["skipped"] = skipped
     if tasks:
         # task-management rider (transport/tasks.py): peak concurrent
         # registered tasks + cancellations observed on the serving node.
@@ -298,7 +314,7 @@ def cpu_exact_truth(corpus, queries):
     return truth
 
 
-def run_cpu_maxscore(corpus, queries, truth):
+def run_cpu_maxscore(corpus, queries, truth, cpu_rows=None):
     from elasticsearch_tpu import native
 
     if not native.available():
@@ -315,6 +331,8 @@ def run_cpu_maxscore(corpus, queries, truth):
     sat_flat = sat.reshape(-1)
     docids_flat = bd.reshape(-1)
     log(f"sat/block-max precompute {time.time()-t0:.1f}s")
+    if cpu_rows is not None:
+        cpu_rows["sat_blockmax_precompute_s"] = round(time.time() - t0, 1)
 
     def args_for(q):
         post_off = np.asarray([int(tbs[t]) * BLOCK for t in q], np.int64)
@@ -376,6 +394,14 @@ class DeviceUnreachable(Exception):
     sections are skipped and the metric line discloses it."""
 
 
+# a wedged relay never touches this process's backend state (a wedged
+# in-process ``device_put`` is uninterruptible and poisons every later
+# jax call; the r05 outage cost the whole round) — on failure main()
+# pins ``JAX_PLATFORMS=cpu`` and continues with CPU-only sections.
+# ONE probe contract, shared with dryrun_multichip.
+from __graft_entry__ import preflight_subprocess  # noqa: E402
+
+
 def _preflight_device(timeout_s: float = 600.0):
     """Prove the device answers a tiny upload+launch+readback within
     ``timeout_s`` — in a daemon worker, because a wedged relay blocks
@@ -409,8 +435,11 @@ def _preflight_device(timeout_s: float = 600.0):
 def run_tpu_kernel(corpus, queries):
     # the preflight is the process's FIRST backend touch — even
     # jax.devices()/default_backend block uninterruptibly on a dead
-    # relay, so it runs in a timeout-bounded daemon thread first
-    _preflight_device(float(os.environ.get("BENCH_PREFLIGHT_S", 600)))
+    # relay, so it runs in a timeout-bounded daemon thread first (the
+    # subprocess preflight in main() already gave a clean verdict; this
+    # second layer catches a relay that died in between). SHORT default:
+    # a quick fail banks the CPU rows instead of burning the budget.
+    _preflight_device(float(os.environ.get("BENCH_PREFLIGHT_S", 180)))
     import jax
 
     from elasticsearch_tpu.ops.bm25 import (bm25_sorted_topk,
@@ -863,6 +892,23 @@ def run_rest_path(corpus, queries, truth, tmpdir, kernel="auto",
             + (f"; {lost} lost to relay stalls" if lost else "") + ")")
         return r
 
+    def _serving_snapshot():
+        """The BENCH json `serving` section: per-nb-bucket dispatch
+        counts, warm-up seconds (+ persistent-compile-cache savings),
+        cohort/batch histograms — attributes qps movement to the
+        serving levers (impact selection / compile cache / batching)."""
+        out = {}
+        try:
+            fpx = getattr(node._http, "fastpath", None)
+            if fpx is not None:
+                out.update(fpx.serving_stats())
+            out["plan_batcher"] = node.search_service.plan_batcher.stats()
+            from elasticsearch_tpu.telemetry.engine import TRACKER
+            out["persistent_cache"] = TRACKER.persistent_stats()
+        except Exception as e:   # noqa: BLE001 — stats never kill a run
+            log(f"serving snapshot failed: {e!r}")
+        return out
+
     rest_recall = recall_pass("cold")
     # the cold pass warmed the θ cache — measure the θ-warm essential
     # lane's recall too (the certificate guarantees exactness relative
@@ -909,7 +955,7 @@ def run_rest_path(corpus, queries, truth, tmpdir, kernel="auto",
         # later kill still leaves the serving number parsed
         emit_cb(rest_qps=best_qps, p50=p50, p99=p99,
                 rest_recall=rest_recall, warm_recall=warm_recall,
-                avg_batch=avg_batch)
+                avg_batch=avg_batch, serving=_serving_snapshot())
 
     # ---- bool+filters over HTTP (filters from a small hot pool — the
     # cached-filter-mask + cohort-sharing path)
@@ -954,7 +1000,8 @@ def run_rest_path(corpus, queries, truth, tmpdir, kernel="auto",
             emit_cb(hbm_peak_bytes=node.indices_service.device_cache
                     .hbm_stats().get("peak_bytes", 0),
                     overload=_overload_snapshot(node),
-                tasks=_tasks_snapshot(node))
+                    tasks=_tasks_snapshot(node),
+                    serving=_serving_snapshot())
         node.close()
         return (best_qps, p50, p99, rest_recall, warm_recall, avg_batch,
                 bool_qps, extra)
@@ -1025,7 +1072,8 @@ def run_rest_path(corpus, queries, truth, tmpdir, kernel="auto",
         emit_cb(hbm_peak_bytes=node.indices_service.device_cache
                 .hbm_stats().get("peak_bytes", 0),
                 overload=_overload_snapshot(node),
-                tasks=_tasks_snapshot(node))
+                tasks=_tasks_snapshot(node),
+                serving=_serving_snapshot())
     node.close()
     return (best_qps, p50, p99, rest_recall, warm_recall, avg_batch,
             bool_qps, extra)
@@ -1176,7 +1224,17 @@ def compose_metric(p):
                 f"hybrid RRF (match+knn, rank.rrf) "
                 f"{extra.get('rrf_hybrid', 0):.0f} qps"
                 if extra else "; product rows pending")
-    if p.get("rest_qps") is None and p.get("device_down"):
+    if p.get("rest_qps") is not None and p.get("device_down"):
+        head = (
+            f"CPU-ONLY SERVING ROW (device unreachable this run: "
+            f"{p['device_down']} — an environment outage, not an "
+            f"engine result): BM25 top-{K} through the REST product "
+            f"path on the cpu backend at {N_DOCS} docs, p50 "
+            f"{p.get('p50', 0):.1f} ms, p99 {p.get('p99', 0):.1f} ms, "
+            f"recall@{K} {p.get('rest_recall', 0):.4f}, continuous "
+            f"batching avg {p.get('avg_batch', 0):.0f}/launch — banks "
+            f"serving/dispatch telemetry, NOT a device qps claim; ")
+    elif p.get("rest_qps") is None and p.get("device_down"):
         head = (f"DEVICE UNREACHABLE this run: the TPU relay did not "
                 f"answer a 128-float preflight ({p['device_down']}) — "
                 f"an environment outage, not an engine result (relay "
@@ -1251,25 +1309,85 @@ def main():
              value / cpu if cpu else float("nan"),
              engine=_engine_snapshot(parts),
              overload=parts.get("overload"),
-             tasks=parts.get("tasks"))
+             tasks=parts.get("tasks"),
+             cpu=parts.get("cpu"),
+             serving=parts.get("serving"),
+             skipped=parts.get("skipped"))
 
     rng = np.random.default_rng(12345)
+    t0 = time.time()
     corpus = build_corpus(rng)
+    cpu_rows = {
+        "docs": N_DOCS, "vocab": VOCAB, "queries": N_QUERIES,
+        "postings": int(corpus["n_postings"]),
+        "blocks": int(corpus["block_docids"].shape[0]),
+        "corpus_build_s": round(time.time() - t0, 1),
+    }
+    parts["cpu"] = cpu_rows
     queries = make_queries(rng, corpus["df"])
+    # corpus stats banked IMMEDIATELY — even a kill during the truth
+    # pass leaves a parsed line with non-zero CPU rows
+    emit_now()
 
+    t0 = time.time()
     truth = cpu_exact_truth(corpus, queries)
-    cpu_qps, cpu_recall = run_cpu_maxscore(corpus, queries, truth)
+    cpu_rows["exact_truth_s"] = round(time.time() - t0, 1)
+    cpu_qps, cpu_recall = run_cpu_maxscore(corpus, queries, truth,
+                                           cpu_rows)
+    cpu_rows["baseline_qps"] = round(cpu_qps or 0.0, 1)
+    cpu_rows["baseline_self_recall"] = round(cpu_recall or 0.0, 4)
     parts.update(cpu_qps=cpu_qps, cpu_recall=cpu_recall)
-    # FIRST parsed line lands before ANY jax/backend touch: a dead
+    # ALL CPU-side rows land before ANY jax/backend touch: a dead
     # relay hangs even backend INIT uninterruptibly (observed: hours),
     # and a run killed there must still have parsed output on record
     emit_now()
+
+    # quick-fail preflight in a SUBPROCESS: a wedged relay never
+    # poisons this process, so the run can pin cpu and still bank a
+    # serving row instead of aborting with only CPU rows (r05 lesson)
+    pf_ok, pf_why = preflight_subprocess(
+        float(os.environ.get("BENCH_PREFLIGHT_S", 180)))
+    if not pf_ok:
+        log(f"DEVICE UNREACHABLE (subprocess preflight): {pf_why}")
+        parts["device_down"] = pf_why
+        skipped = parts.setdefault("skipped", {})
+        for sec in ("raw_kernel", "secondary", "sustained", "knn8m"):
+            skipped[sec] = "device unreachable (preflight quick-fail)"
+        # before any in-process jax import: every later section runs on
+        # the cpu backend
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        emit_now()
+        cap = int(os.environ.get("BENCH_CPU_SERVE_DOCS_MAX", 300_000))
+        if N_DOCS <= cap:
+            # XLA-CPU compiles of the 4096-lane serving shapes run
+            # minutes each; cpu-only mode defaults to a tight ladder
+            # (explicit BENCH_FAST_* still wins)
+            os.environ.setdefault("BENCH_FAST_BUCKETS", "256,1024")
+            os.environ.setdefault("BENCH_FAST_STREAMS", "2")
+            os.environ.setdefault("BENCH_REST_FLOOR", "256")
+            kernel = os.environ.get("BENCH_FAST_KERNEL", "auto")
+            parts["kernel"] = kernel
+            with tempfile.TemporaryDirectory() as tmpdir:
+                run_rest_path(corpus, queries, truth, tmpdir, kernel,
+                              emit_cb=emit_now)
+        else:
+            skipped["serving"] = (
+                f"cpu-only serving disabled at this corpus scale "
+                f"(BENCH_DOCS={N_DOCS} > BENCH_CPU_SERVE_DOCS_MAX={cap})")
+            emit_now()
+        log(f"bench complete (cpu-only mode) in "
+            f"{time.time()-_T_START:.0f}s")
+        return
 
     try:
         kernel_qps, batch_qps, handles = run_tpu_kernel(corpus, queries)
     except DeviceUnreachable as e:
         log(f"DEVICE UNREACHABLE: {e}")
         parts["device_down"] = str(e)
+        parts.setdefault("skipped", {}).update({
+            sec: "device unreachable (in-process preflight)"
+            for sec in ("raw_kernel", "secondary", "sustained",
+                        "serving", "knn8m")})
         emit_now()
         log(f"bench aborted (device unreachable) in "
             f"{time.time()-_T_START:.0f}s")

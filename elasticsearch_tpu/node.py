@@ -314,12 +314,22 @@ class Node:
         native_pref = self.settings.get("http.native", "auto")
         allow = str(self.settings.get("http.ip_filter.allow", "") or "")
         deny = str(self.settings.get("http.ip_filter.deny", "") or "")
+        # persistent compile cache for EVERY serving front (stdlib
+        # included — the Python plan path compiles serving shapes too):
+        # warm sessions deserialize executables instead of recompiling,
+        # and GET /_kernels classifies warm loads as cache hits
+        try:
+            from elasticsearch_tpu.search.fastpath import (
+                enable_compile_cache)
+            enable_compile_cache()
+        except Exception:
+            logger.exception("compile cache setup failed; continuing")
         self._http = None
         if ssl_config is None and native_pref in ("auto", True, "true"):
             front = None
             try:
                 nb_buckets = self.settings.get(
-                    "http.native.fast_nb_buckets") or (1024, 4096)
+                    "http.native.fast_nb_buckets") or (1024, 2048, 4096)
                 if isinstance(nb_buckets, str):
                     nb_buckets = tuple(
                         int(x) for x in nb_buckets.split(","))
@@ -346,7 +356,11 @@ class Node:
                         kernel_mode=str(self.settings.get(
                             "http.native.fast_kernel", "auto")),
                         dense_mb=int(self.settings.get(
-                            "http.native.fast_dense_mb", 1024)))
+                            "http.native.fast_dense_mb", 1024)),
+                        # oversize queries: impact-ordered truncation
+                        # ("certified" | "always" | "off")
+                        impact_mode=str(self.settings.get(
+                            "http.native.fast_impact", "certified")))
                     front.fastpath.start()
                     if allow or deny:
                         front.set_ipfilter(allow, deny)

@@ -387,6 +387,143 @@ def plan_topk_batch(streams, group_kind, group_req, group_const, live,
 
 
 # ---------------------------------------------------------------------------
+# Impact-ordered block selection (host-side, pure numpy).
+#
+# Lucene's impact-ordered postings let block-max WAND spend its
+# evaluation budget on the blocks with the highest score upper bounds
+# instead of the lowest docids (ref: Lucene ImpactsEnum /
+# MaxScoreBulkScorer). The TPU analogue: the serving fast path selects
+# postings BLOCKS into a fixed lane budget per launch, so WHICH blocks
+# enter the budget decides recall-at-budget. These helpers precompute a
+# per-block BM25 upper bound at registration (block-max tf × idf, the
+# same bound the θ/MaxScore lane derives), order each term's block list
+# by descending bound once, and select per query under a budget by
+# impact — with the residual bound of everything excluded, so callers
+# can run the block-max safe-termination check (no unseen doc can reach
+# the kth score) on readback.
+#
+# Layout convention: term t's blocks occupy the contiguous index range
+# [starts[t], starts[t]+counts[t]) of the block arrays, docid-ascending
+# by block index. ``order``/``ub_desc`` use the SAME flat layout, but
+# within each term's range the entries are impact-sorted: position
+# starts[t]+j holds the block id (resp. bound) of t's (j+1)-th
+# highest-impact block.
+# ---------------------------------------------------------------------------
+
+
+class TermImpacts(NamedTuple):
+    """Registration-time impact metadata for one postings field."""
+
+    ub: np.ndarray        # float64 [TB] per-block score upper bound
+    order: np.ndarray     # int32 [TB] impact-sorted block ids per term
+    ub_desc: np.ndarray   # float64 [TB] bounds in `order`'s layout
+
+
+def build_term_impacts(starts, counts, block_max_tf, block_min_len,
+                       idf, avg_len: float, k1: float,
+                       b: float) -> TermImpacts:
+    """Per-block BM25 upper bounds + per-term impact ordering.
+
+    The bound is the block-max saturation at the block's minimum length
+    times the term's idf — the max contribution ANY doc in the block can
+    make (the same quantity the θ-lane's ``maxc`` takes the per-term max
+    of). Empty blocks (max tf 0) bound to 0."""
+    starts = np.asarray(starts, np.int64)
+    counts = np.asarray(counts, np.int64)
+    mtf = np.asarray(block_max_tf, np.float64)
+    mln = np.asarray(block_min_len, np.float64)
+    sat = np.where(mtf > 0,
+                   mtf / (mtf + k1 * (1.0 - b + b * mln / avg_len)), 0.0)
+    tb = mtf.shape[0]
+    # term id owning each block: the packed layout is contiguous and
+    # gap-free (segment.py builds starts as the exact cumsum of
+    # counts) — enforce loudly, a gap would silently shift every
+    # term's impact range (the check_packed_id_limit style)
+    if int(counts.sum()) != tb:
+        raise ValueError(
+            f"packed block layout violated: sum(counts)="
+            f"{int(counts.sum())} != n_blocks={tb}")
+    term_of = np.repeat(np.arange(len(counts)), counts)
+    ub = sat * np.asarray(idf, np.float64)[term_of]
+    # impact order per term: argsort of (term, -ub, block) — one global
+    # stable sort keeps it vectorized; ties keep docid (block) order
+    order = np.lexsort((np.arange(tb), -ub, term_of)).astype(np.int32)
+    return TermImpacts(ub=ub, order=order, ub_desc=ub[order])
+
+
+def select_blocks_impact(term_ids, budget: int, starts, counts,
+                         impacts: TermImpacts):
+    """Budgeted per-query block selection by descending impact.
+
+    Returns ``(per_term, miss_bound)``: ``per_term`` is a list of int32
+    arrays (one per term id, ASCENDING block ids — the slot-sorted
+    invariant the merge kernels require), ``miss_bound`` the sum over
+    terms of the max bound among that term's EXCLUDED blocks (a doc
+    appears in at most one block per term, so no doc's true score can
+    exceed its observed score by more than ``miss_bound``; an entirely
+    unseen doc is bounded by ``miss_bound`` itself). ``miss_bound`` is
+    0.0 exactly when the selection is complete (exact serving)."""
+    segs = [(int(starts[t]), int(counts[t])) for t in term_ids]
+    total = sum(c for _, c in segs)
+    if total <= budget:
+        return ([np.arange(s, s + c, dtype=np.int32) for s, c in segs],
+                0.0)
+    ud = impacts.ub_desc
+    cat = np.concatenate([ud[s:s + c] for s, c in segs])
+    # threshold = budget-th largest bound; strictly-greater blocks are
+    # all in, ties fill the remainder in term order (deterministic)
+    thr = np.partition(cat, total - budget)[total - budget]
+    n_gt = [int(np.searchsorted(-ud[s:s + c], -thr, side="left"))
+            for s, c in segs]
+    spare = budget - sum(n_gt)
+    per_term: list = []
+    miss = 0.0
+    for (s, c), j in zip(segs, n_gt):
+        # extend through the tie band while budget remains
+        while spare > 0 and j < c and ud[s + j] == thr:
+            j += 1
+            spare -= 1
+        take = impacts.order[s:s + j]
+        per_term.append(np.sort(take).astype(np.int32))
+        if j < c:
+            miss += float(ud[s + j])
+    return per_term, miss
+
+
+def select_blocks_prefix(term_ids, budget: int, starts, counts):
+    """Posting-order baseline: each term keeps the PREFIX of its block
+    list, lowest docids first, dropping tail blocks round-robin until
+    the budget fits (the selection a budget-blind path would make).
+    Same return convention as :func:`select_blocks_impact` minus the
+    bound (callers compare recall, not certificates)."""
+    cnts = [int(counts[t]) for t in term_ids]
+    while sum(cnts) > budget:
+        i = int(np.argmax(cnts))
+        over = sum(cnts) - budget
+        cnts[i] = max(0, cnts[i] - max(1, min(over, cnts[i] // 2)))
+    return [np.arange(int(starts[t]), int(starts[t]) + c, dtype=np.int32)
+            for t, c in zip(term_ids, cnts)]
+
+
+def impact_safe_termination(kth: float, next_best: float,
+                            miss_bound: float) -> bool:
+    """The block-max safe-termination check on a truncated launch's
+    readback: with every doc's possible gain bounded by ``miss_bound``,
+    the observed top-k SET is provably the true top-k when the best
+    excluded candidate (``next_best``: the (k+1)-th observed score, or
+    0.0 when fewer than k+1 docs matched — an unseen doc's observed
+    score) cannot close the gap to the kth. Observed scores of the
+    returned docs remain lower bounds (callers report totals with
+    relation ``gte``)."""
+    if miss_bound <= 0.0:
+        return True
+    if not np.isfinite(kth):
+        return False          # fewer than k hits: unseen docs could fill
+    floor = max(float(next_best) if np.isfinite(next_best) else 0.0, 0.0)
+    return floor + miss_bound < kth
+
+
+# ---------------------------------------------------------------------------
 # Scatter-free dense builders (for the fallback path: aggs need full masks)
 # ---------------------------------------------------------------------------
 

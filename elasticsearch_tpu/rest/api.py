@@ -659,8 +659,15 @@ def get_kernels(node, params, body):
     call (ever-new shape keys) is a recompile storm; a shape-disciplined
     workload shows a flat table after warmup."""
     from elasticsearch_tpu.telemetry import engine as _engine
-    return 200, {"kernels": _engine.TRACKER.to_dict(),
-                 "totals": _engine.TRACKER.totals()}
+    out = {"kernels": _engine.TRACKER.to_dict(),
+           "totals": _engine.TRACKER.totals(),
+           "persistent_cache": _engine.TRACKER.persistent_stats()}
+    fp = getattr(getattr(node, "_http", None), "fastpath", None)
+    if fp is not None:
+        # per-bucket dispatch counts + cohort histogram of the native
+        # serving front — which warmed shapes actually earn their keep
+        out["serving"] = fp.serving_stats()
+    return 200, out
 
 
 def get_traces(node, params, body):

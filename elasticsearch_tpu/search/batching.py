@@ -42,6 +42,23 @@ def _q_bucket(n: int) -> int:
     return _Q_BUCKETS[-1]
 
 
+# NB coalescing tiers: plans whose per-stream selection widths land in
+# the same power-of-FOUR tier share a batch signature and pad to the
+# tier width, so slightly-different-NB queries (the common mix) coalesce
+# into one launch instead of fragmenting into per-pow2 cohorts. Power of
+# four bounds the padding waste at 4x device lanes — and only for the
+# smallest plan of the cohort; a pow2 ladder would double the signature
+# count for ~zero extra coalescing.
+_NB_TIER_FLOOR = 64
+
+
+def _nb_tier(n: int) -> int:
+    t = _NB_TIER_FLOOR
+    while t < n:
+        t *= 4
+    return t
+
+
 class _Entry:
     __slots__ = ("bp", "event", "result", "error")
 
@@ -66,7 +83,8 @@ class PlanBatcher:
     are already pending — so cohorts grow without taxing idle queries.
     """
 
-    def __init__(self, max_batch: int = 64, max_concurrent: int = 8):
+    def __init__(self, max_batch: int = 64, max_concurrent: int = 8,
+                 adaptive_flush_s: float = 0.002):
         self.max_batch = min(max_batch, _Q_BUCKETS[-1])
         self._lock = threading.Lock()
         # Launches used to serialize behind one lock; under a transport
@@ -80,12 +98,18 @@ class PlanBatcher:
         self._pending: Dict[tuple, List[_Entry]] = {}
         self.launches = 0          # stats: total device launches
         self.batched_queries = 0   # stats: queries served via batches
+        self.batch_hist: Dict[int, int] = {}   # pow2 batch-size counts
         # EMA of launch+readback latency: when the device round-trip is
         # slow (the tunnel's ~120ms sync floor), leaders WAIT a fraction
         # of it before popping the queue so cohorts grow — the classic
         # continuous-batching window, sized from measurement instead of
         # a fixed knob. Fast devices (real local TPU: sub-ms) never wait.
         self._lat_ema = 0.0
+        # adaptive flush: even on a fast device, a leader that sees
+        # OTHER work pending holds the pop for up to this long so the
+        # cohort fills — trading ≤~2 ms of p50 for materially larger
+        # batches under load (0 disables)
+        self.adaptive_flush_s = float(adaptive_flush_s)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -98,9 +122,14 @@ class PlanBatcher:
 
     @staticmethod
     def _signature(bp: BoundPlan, ctx, k: int, k1: float, b: float) -> tuple:
+        # selection widths key by COALESCING TIER, not exact width:
+        # plans whose NB landed in different power-of-two buckets (the
+        # impact-selected mix) still share a cohort; _run pads every
+        # member to the widest member's bucket (zero-block selections
+        # with weight 0 are inert in the kernel)
         return (
             ctx.segment.name, ctx.segment.live_version,
-            tuple((id(st.block_docids), int(st.sel_blocks.shape[0]))
+            tuple((id(st.block_docids), _nb_tier(int(st.sel_blocks.shape[0])))
                   for st in bp.streams),
             int(bp.group_kind.shape[0]), bp.combine, k,
             id(bp.dense_mask) if bp.dense_mask is not None else None,
@@ -133,8 +162,14 @@ class PlanBatcher:
         # launch costs seconds, padding a 3-query cohort to the batch
         # shape wastes ~10x device time, so waiting a fraction of the
         # measured round-trip to fill the cohort is strictly cheaper.
-        if self._lat_ema > 0.03:
-            deadline = time.monotonic() + min(0.75 * self._lat_ema, 1.5)
+        # On a FAST device the adaptive flush window still holds the pop
+        # for ≤~2 ms when other work is pending, so loaded traffic
+        # coalesces instead of racing out in cohorts of one.
+        window = (min(0.75 * self._lat_ema, 1.5)
+                  if self._lat_ema > 0.03 else self.adaptive_flush_s)
+        if window > 0.0:
+            deadline = time.monotonic() + window
+            step = min(0.02, max(window / 4.0, 0.0005))
             while time.monotonic() < deadline:
                 with self._lock:
                     mine = len(self._pending.get(sig, ()))
@@ -143,7 +178,7 @@ class PlanBatcher:
                                    for q in self._pending.values()))
                 if mine >= self.max_batch or not busy:
                     break
-                time.sleep(0.02)
+                time.sleep(step)
         with self._launch_slots:
             with self._lock:
                 batch = self._pending.pop(sig, [])
@@ -164,6 +199,14 @@ class PlanBatcher:
         return entry.result
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _pad1(a: np.ndarray, width: int, fill) -> np.ndarray:
+        if a.shape[0] == width:
+            return a
+        out = np.full(width, fill, a.dtype)
+        out[:a.shape[0]] = a
+        return out
+
     def _run(self, batch: List[_Entry], ctx, k: int, k1: float, b: float):
         qn = len(batch)
         bucket = _q_bucket(qn)
@@ -172,16 +215,30 @@ class PlanBatcher:
 
         proto = bps[0]
         streams = []
+        ngpad = int(proto.group_kind.shape[0])
         for si, st in enumerate(proto.streams):
+            # a tier-coalesced cohort pads every member to the WIDEST
+            # member's (power-of-two) selection width: pads select the
+            # reserved zero block with weight 0 — all-zero tfs, so the
+            # kernel never counts them for presence or score (the
+            # bind_plan pad convention)
+            width = max(int(bp.streams[si].sel_blocks.shape[0])
+                        for bp in bps)
+            zero_block = int(st.block_docids.shape[0]) - 1
             # host-side np.stack (µs): selections are numpy; the jit
             # boundary uploads the stacked batch asynchronously
             streams.append(plan_ops.FieldStream(
                 st.block_docids, st.block_tfs, st.doc_lens, st.avg_len,
-                np.stack([bp.streams[si].sel_blocks for bp in bps]),
-                np.stack([bp.streams[si].sel_group for bp in bps]),
-                np.stack([bp.streams[si].sel_sub for bp in bps]),
-                np.stack([bp.streams[si].sel_weight for bp in bps]),
-                np.stack([bp.streams[si].sel_const for bp in bps])))
+                np.stack([self._pad1(bp.streams[si].sel_blocks, width,
+                                     zero_block) for bp in bps]),
+                np.stack([self._pad1(bp.streams[si].sel_group, width,
+                                     ngpad) for bp in bps]),
+                np.stack([self._pad1(bp.streams[si].sel_sub, width, 0)
+                          for bp in bps]),
+                np.stack([self._pad1(bp.streams[si].sel_weight, width,
+                                     0.0) for bp in bps]),
+                np.stack([self._pad1(bp.streams[si].sel_const, width,
+                                     False) for bp in bps])))
         gk = np.stack([bp.group_kind for bp in bps])
         gr = np.stack([bp.group_req for bp in bps])
         gc = np.stack([bp.group_const for bp in bps])
@@ -205,6 +262,7 @@ class PlanBatcher:
                              else 0.8 * self._lat_ema + 0.2 * dt)
         self.launches += 1
         self.batched_queries += qn
+        self.batch_hist[bucket] = self.batch_hist.get(bucket, 0) + 1
         for i, e in enumerate(batch):
             e.result = plan_ops.unpack_result(rows[i], k)
             e.event.set()
@@ -216,6 +274,8 @@ class PlanBatcher:
             "batched_queries": self.batched_queries,
             "avg_batch": (self.batched_queries / self.launches
                           if self.launches else 0.0),
+            "batch_hist": {str(kk): v for kk, v
+                           in sorted(self.batch_hist.items())},
         }
 
 
@@ -255,7 +315,8 @@ class KnnBatcher:
     into one float32 buffer (bitcast) so the cohort syncs exactly once.
     """
 
-    def __init__(self, max_batch: int = 64, max_concurrent: int = 8):
+    def __init__(self, max_batch: int = 64, max_concurrent: int = 8,
+                 adaptive_flush_s: float = 0.002):
         self.max_batch = max_batch
         self._lock = threading.Lock()
         self._launch_slots = threading.BoundedSemaphore(max_concurrent)
@@ -263,6 +324,7 @@ class KnnBatcher:
         self.launches = 0
         self.batched_queries = 0
         self._lat_ema = 0.0
+        self.adaptive_flush_s = float(adaptive_flush_s)
 
     def topk(self, dv, live, qvec: np.ndarray, cut: int,
              host_vectors=None) -> Tuple[np.ndarray, np.ndarray]:
@@ -286,8 +348,11 @@ class KnnBatcher:
             if entry.error is not None:
                 raise entry.error
             return self._finish(entry, dv, host_vectors)
-        if self._lat_ema > 0.03:
-            deadline = time.monotonic() + min(0.75 * self._lat_ema, 1.5)
+        window = (min(0.75 * self._lat_ema, 1.5)
+                  if self._lat_ema > 0.03 else self.adaptive_flush_s)
+        if window > 0.0:
+            deadline = time.monotonic() + window
+            step = min(0.02, max(window / 4.0, 0.0005))
             while time.monotonic() < deadline:
                 with self._lock:
                     mine = len(self._pending.get(sig, ()))
@@ -296,7 +361,7 @@ class KnnBatcher:
                                    for qq in self._pending.values()))
                 if mine >= self.max_batch or not busy:
                     break
-                time.sleep(0.02)
+                time.sleep(step)
         with self._launch_slots:
             with self._lock:
                 batch = self._pending.pop(sig, [])

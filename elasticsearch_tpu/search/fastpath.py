@@ -114,25 +114,59 @@ def probe_regime() -> str:
 def enable_compile_cache(path: Optional[str] = None):
     """Point JAX's persistent compilation cache at a stable directory so
     serving-kernel shapes compile once per machine, not once per process
-    (the round-4 bench paid 242 s of warm compiles at every start).
+    (the round-4 bench paid 242 s of warm compiles at every start), and
+    attach the shape-bucket key store (telemetry/engine.py
+    PersistentKernelCache) that classifies warm first-executions as
+    cache hits in ``GET /_kernels`` — the warm-up-seconds-saved signal.
     Safe to call repeatedly; first caller wins."""
     import jax
+
+    from elasticsearch_tpu.telemetry.engine import (PersistentKernelCache,
+                                                    TRACKER)
     try:
-        if jax.config.jax_compilation_cache_dir:
-            return
         # CPU (test) backends don't need it — serving-shape compiles
         # are seconds there, and CPU AOT entries reload with machine-
-        # feature warnings — the cache's value is accelerator compiles
-        if jax.default_backend() == "cpu":
+        # feature warnings — the cache's value is accelerator compiles.
+        # The gate reads env/config ONLY: jax.default_backend() would
+        # INITIALIZE a backend, which blocks uninterruptibly on a
+        # wedged relay — Node.start must never pay that just to decide
+        # whether to arm telemetry.
+        plats = ((os.environ.get("JAX_PLATFORMS") or "").strip()
+                 or str(jax.config.jax_platforms or "").strip())
+        if not plats:
+            # unpinned: trust a backend that ALREADY initialized (no
+            # forced init). A still-uninitialized unpinned process is
+            # assumed device-bound — every cpu deployment here pins
+            # (conftest, bench cpu mode, the axon site hook), so the
+            # unpinned-cpu-no-backend corner only costs AOT-reload
+            # warnings, never a hang.
+            try:
+                from jax._src import xla_bridge
+                if getattr(xla_bridge, "_backends", None):
+                    plats = jax.default_backend()
+            except Exception:
+                pass
+        if plats.split(",")[0].strip().lower() == "cpu":
             return
-        path = path or os.environ.get(
-            "ESTPU_COMPILE_CACHE",
-            os.path.join(os.path.expanduser("~"), ".cache",
-                         "estpu_jax_cache"))
-        jax.config.update("jax_compilation_cache_dir", path)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          0.0)
+        cur = jax.config.jax_compilation_cache_dir
+        if not cur:
+            cur = path or os.environ.get(
+                "ESTPU_COMPILE_CACHE",
+                os.path.join(os.path.expanduser("~"), ".cache",
+                             "estpu_jax_cache"))
+            jax.config.update("jax_compilation_cache_dir", cur)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              -1)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
+        # the key store mirrors the executable cache at the TRACKER's
+        # shape-bucket granularity (keys subdir of the same cache dir) —
+        # attached even when the dir was configured elsewhere (e.g.
+        # JAX_COMPILATION_CACHE_DIR): the sessions where jax's cache IS
+        # active are exactly the ones whose hits must be classified
+        if TRACKER.persistent is None:
+            TRACKER.attach_persistent(
+                PersistentKernelCache(os.path.join(cur, "keys")))
     except Exception:              # cache is an optimization only
         logger.exception("compile cache unavailable")
 
@@ -142,10 +176,11 @@ class FastPathServer:
     # slot); a bucket's slot width is bucket // N_SLOTS blocks
     N_SLOTS = 16
 
-    def __init__(self, node, front, nb_buckets=(1024, 4096),
+    def __init__(self, node, front, nb_buckets=(1024, 2048, 4096),
                  n_streams: int = 4, max_k: int = 1000,
                  ess_buckets=(256, 1024), q_batch: int = Q_BATCH,
-                 kernel_mode: str = "auto", dense_mb: int = 512):
+                 kernel_mode: str = "auto", dense_mb: int = 512,
+                 impact_mode: str = "certified"):
         self.node = node
         self.front = front           # NativeHttpFront (owns the lib)
         self.lib = front.lib
@@ -164,6 +199,13 @@ class FastPathServer:
         self.requested_mode = kernel_mode
         self.kernel_mode = kernel_mode if kernel_mode != "auto" else "v2m"
         self.regime: Optional[str] = None
+        # impact-ordered block selection for queries whose block need
+        # exceeds the largest lane bucket (previously: bounce to the
+        # Python path). "certified": serve the impact-truncated top-k
+        # only when the post-launch safe-termination check proves the
+        # set exact (totals report relation "gte"); "always": serve
+        # every truncated result (approximate, gte); "off": bounce.
+        self.impact_mode = impact_mode
         # HBM budget for the dense hot-term tf table (θ-warm patch lane)
         self.dense_mb = int(dense_mb)
         # cohort width: one compiled Q shape; wider cohorts amortize the
@@ -189,6 +231,39 @@ class FastPathServer:
                       # the engine-stats `caches.theta` surface
                       "theta_hits": 0, "theta_misses": 0,
                       "theta_stores": 0}
+        # per-(lane, nb-bucket) dispatch counts + cohort-width histogram
+        # — which warmed shapes actually serve traffic (the nb-ladder
+        # tradeoff surface: GET /_kernels `serving`, bench `serving`)
+        self.dispatch: Dict[str, int] = {}
+        self.cohort_hist: Dict[int, int] = {}
+        # warm-up accounting (persistent-compile-cache payoff)
+        self.warm_seconds = 0.0
+
+    def _count_dispatch(self, lane: str, bucket: int, n: int):
+        key = f"{lane}:{bucket}"
+        self.dispatch[key] = self.dispatch.get(key, 0) + n
+
+    def _count_cohort(self, n: int):
+        b = 1
+        while b < n:
+            b *= 2
+        self.cohort_hist[b] = self.cohort_hist.get(b, 0) + 1
+
+    def serving_stats(self) -> dict:
+        """Routing/dispatch telemetry of the serving front: per-lane ×
+        nb-bucket dispatch counts, cohort-width histogram, warm-up
+        seconds, and the truncated-lane counters."""
+        return {
+            "dispatch": dict(self.dispatch),
+            "cohort_hist": {str(k): v
+                            for k, v in sorted(self.cohort_hist.items())},
+            "warm_seconds": round(self.warm_seconds, 3),
+            "nb_buckets": list(self.nb_buckets),
+            "ess_buckets": list(self.ess_buckets),
+            "impact_mode": self.impact_mode,
+            "counters": {k: v for k, v in self.stats.items()
+                         if isinstance(v, (int, float))},
+        }
 
     def engine_cache_stats(self) -> dict:
         """θ-cache counters for the `engine.caches.theta` stats surface
@@ -354,23 +429,25 @@ class FastPathServer:
         # the patch phase's binary search, and the θ/total cache —
         # valid for this registration's immutable segment
         from elasticsearch_tpu.index.segment import BLOCK_SIZE
+        from elasticsearch_tpu.ops.plan import build_term_impacts
         k1, b = reg["k1"], reg["b"]
-        mtf = pf.block_max_tf.astype(np.float64)
-        mln = pf.block_min_len.astype(np.float64)
-        avg = float(dp.avg_len)
-        s_blk = np.where(
-            mtf > 0, mtf / (mtf + k1 * (1 - b + b * mln / avg)), 0.0)
         starts32 = reg["starts"]
         nbv = reg["nb"]
+        # per-block BM25 upper bounds + per-term impact ordering
+        # (ops/plan.py): feeds BOTH the θ-lane's per-term max
+        # contribution AND the budgeted impact selection of oversize
+        # queries (the Lucene impact-ordered-postings analogue)
+        impacts = build_term_impacts(
+            starts32, nbv, pf.block_max_tf, pf.block_min_len,
+            reg["idf"].astype(np.float64), float(dp.avg_len), k1, b)
+        reg["impacts"] = impacts
         maxc = np.zeros(len(pf.terms), np.float64)
         nz = nbv > 0
         if nz.any():
-            red = np.maximum.reduceat(
-                np.concatenate([s_blk, [0.0]]),
-                np.minimum(starts32, len(s_blk)))
-            maxc[nz] = red[nz]
-        reg["maxc"] = (maxc * reg["idf"].astype(np.float64)).astype(
-            np.float32)
+            # a term's max contribution = its highest-impact block's
+            # bound (ub_desc is impact-DESCENDING within each term)
+            maxc[nz] = impacts.ub_desc[starts32[nz]]
+        reg["maxc"] = maxc.astype(np.float32)
         reg["post_start"] = (starts32 * BLOCK_SIZE).astype(np.int32)
         reg["post_len"] = dp.doc_freq.astype(np.int32)
         reg["flat_docids"] = dp.block_docids.reshape(-1)
@@ -608,6 +685,11 @@ class FastPathServer:
             # registering — nothing to warm for, just exit quietly
             if self._running:
                 raise
+        finally:
+            # warm-ladder wall time: with the persistent compile cache
+            # warm, this drops from minutes (cold XLA compiles) to the
+            # executable-deserialize cost — `serving.warm_seconds`
+            self.warm_seconds += time.time() - t0
 
     # --------------------------------------------------------------- drain
     def _drain_loop(self):
@@ -680,6 +762,7 @@ class FastPathServer:
         by_bucket: Dict[int, list] = {}
         v2_by_bucket: Dict[int, list] = {}
         ess_by_bucket: Dict[int, list] = {}
+        trunc_items: list = []
         for tok, gen, k, term_ids, filt in reqs:
             if gen != reg["gen"]:
                 # parsed under an older term dictionary (segment changed
@@ -696,13 +779,31 @@ class FastPathServer:
                     bucket = nb
                     break
             if bucket is None or not term_ids:
-                # oversize selection / empty query: cheap immediate
-                # answers, no device work
+                # empty query: cheap immediate answer, no device work
                 if not term_ids or all(t < 0 for t in term_ids):
                     self._respond_empty(tok, reg)
-                else:
+                    continue
+                # oversize selection: impact-ordered truncation to the
+                # largest bucket (the blocks with the highest score
+                # upper bounds enter the budget; the excluded tail's
+                # residual bound rides along for the post-launch
+                # safe-termination check) instead of the old
+                # unconditional bounce to the slow Python path. In
+                # "certified" mode a k == max_k query can never certify
+                # (the check needs the (k+1)-th observed score and the
+                # kernel returns exactly max_k) — bounce immediately
+                # rather than pay a doomed launch.
+                attempt = (self.impact_mode == "always"
+                           or (self.impact_mode == "certified"
+                               and k < self.max_k
+                               and not self._trunc_hopeless(reg)))
+                trunc = self._impact_truncate(reg, term_ids) \
+                    if attempt else None
+                if trunc is None:
                     self.stats["bounced"] += 1
                     self.lib.es_fast_bounce(h, tok)
+                else:
+                    trunc_items.append((tok, k, term_ids, filt, trunc))
                 continue
             ess = self._essential_split(reg, k, term_ids, filt,
                                         nb_need)
@@ -751,6 +852,8 @@ class FastPathServer:
             for chunk in self._chunk_by_slots(items):
                 stack, rows = self._resolve_mask_rows(
                     reg, {it[3] for it in chunk})
+                self._count_dispatch("ess", bucket, len(chunk))
+                self._count_cohort(len(chunk))
                 self._sem.acquire()
                 self._pool.submit(self._launch_essential, reg, bucket,
                                   chunk, t_arrive, stack, rows)
@@ -759,6 +862,9 @@ class FastPathServer:
             for chunk in self._chunk_by_slots(items):
                 stack, rows = self._resolve_mask_rows(
                     reg, {it[3] for it in chunk})
+                self._count_dispatch(self.kernel_mode, bucket,
+                                     len(chunk))
+                self._count_cohort(len(chunk))
                 self._sem.acquire()
                 self._pool.submit(self._launch_group_v2, reg, bucket,
                                   chunk, t_arrive, stack, rows)
@@ -766,10 +872,25 @@ class FastPathServer:
             for chunk in self._chunk_by_slots(items):
                 stack, rows = self._resolve_mask_rows(
                     reg, {it[3] for it in chunk})
+                self._count_dispatch("v1", bucket, len(chunk))
+                self._count_cohort(len(chunk))
                 # backpressure: wait for a free stream — requests keep
                 # queueing in C++ meanwhile and drain in wider cohorts
                 self._sem.acquire()
                 self._pool.submit(self._launch_group, reg, bucket,
+                                  chunk, t_arrive, stack, rows)
+        if trunc_items:
+            # the truncated lane runs on the largest warm v1 shape
+            # (order-agnostic kernel: the impact-chosen subset needs no
+            # slot layout)
+            bucket = self.nb_buckets[-1]
+            for chunk in self._chunk_by_slots(trunc_items):
+                stack, rows = self._resolve_mask_rows(
+                    reg, {it[3] for it in chunk})
+                self._count_dispatch("trunc", bucket, len(chunk))
+                self._count_cohort(len(chunk))
+                self._sem.acquire()
+                self._pool.submit(self._launch_truncated, reg, bucket,
                                   chunk, t_arrive, stack, rows)
 
     def _v2_bucket(self, reg, term_ids) -> Optional[int]:
@@ -929,6 +1050,173 @@ class FastPathServer:
                     pass
         finally:
             self._sem.release()
+
+    # ------------------------------------------------- impact truncation
+    # adaptive back-off: a registration whose certificate NEVER fires
+    # (boundary-dense corpora refuse nearly everything the doom check
+    # lets through) stops paying uncertifiable launches and bounces
+    # directly until the next registration resets the counters
+    TRUNC_BACKOFF_ATTEMPTS = 32
+
+    def _trunc_hopeless(self, reg) -> bool:
+        if (reg.get("trunc_attempts", 0) >= self.TRUNC_BACKOFF_ATTEMPTS
+                and reg.get("trunc_certified", 0) == 0):
+            self.stats["trunc_backoff"] = \
+                self.stats.get("trunc_backoff", 0) + 1
+            return True
+        return False
+
+    def _impact_truncate(self, reg, term_ids):
+        """Budgeted impact-ordered selection for a query whose full
+        block need exceeds the largest bucket. Returns (known_terms,
+        per-term block arrays, miss_bound) or None when the query has
+        no known terms (the caller bounces)."""
+        known = [t for t in term_ids if t >= 0]
+        if not known or reg.get("impacts") is None:
+            return None
+        from elasticsearch_tpu.ops.plan import select_blocks_impact
+        per_term, miss = select_blocks_impact(
+            known, self.nb_buckets[-1], reg["starts"], reg["nb"],
+            reg["impacts"])
+        if self.impact_mode == "certified" and miss > 0.0:
+            # pre-launch doom check: certification needs miss < kth,
+            # and no observed score can exceed Σ per-kept-term best
+            # SELECTED bound — which is maxc for every term that kept
+            # ≥1 block (greedy selection keeps a term's top-impact
+            # blocks first). A selection that provably can't certify
+            # bounces NOW instead of paying a doomed launch+readback
+            # (the heavily-truncated multi-term case).
+            obs_max = sum(float(reg["maxc"][t])
+                          for t, blocks in zip(known, per_term)
+                          if len(blocks))
+            if miss >= obs_max:
+                self.stats["trunc_doomed"] = \
+                    self.stats.get("trunc_doomed", 0) + 1
+                return None
+        return known, per_term, miss
+
+    def _launch_truncated(self, reg, bucket, items, t_arrive, stack,
+                          rows):
+        try:
+            self._launch_truncated_inner(reg, bucket, items, t_arrive,
+                                         stack, rows)
+        except Exception:
+            logger.exception("truncated launch failed; bouncing cohort")
+            h = self.front.h
+            for tok, *_ in items:
+                try:
+                    if h is not None:
+                        self.lib.es_fast_bounce(h, tok)
+                except Exception:
+                    pass
+        finally:
+            self._sem.release()
+
+    def _launch_truncated_inner(self, reg, bucket, items, t_arrive,
+                                stack, rows):
+        """Impact-truncated cohort on the exact v1 kernel: scores are
+        exact over the SELECTED blocks, so every observed score is a
+        lower bound of the true score and no doc can gain more than the
+        query's ``miss_bound`` (ops/plan.select_blocks_impact). The
+        post-launch safe-termination check proves (when it can) that
+        the observed top-k SET is the true top-k; totals always report
+        relation "gte" (excluded blocks may hold unseen matches)."""
+        from elasticsearch_tpu.ops.fastpath import bm25_topk_total_batch
+        from elasticsearch_tpu.ops.plan import impact_safe_termination
+        dp = reg["dp"]
+        sel = np.full((self.q_batch, bucket), dp.zero_block, np.int32)
+        ws = np.zeros((self.q_batch, bucket), self._weight_dtype())
+        mask_ids = np.zeros(self.q_batch, np.int32)
+        idf = reg["idf"]
+        no_match: list = []
+        for qi, (tok, k, term_ids, filt, trunc) in enumerate(items):
+            known, per_term, _miss = trunc
+            pos = 0
+            for t, blocks in zip(known, per_term):
+                cnt = len(blocks)
+                sel[qi, pos:pos + cnt] = blocks
+                ws[qi, pos:pos + cnt] = idf[t]
+                pos += cnt
+            if filt:
+                row = rows.get(filt)
+                if row is None:          # unknown filter term ⇒ no hits
+                    no_match.append(tok)
+                    sel[qi, :] = dp.zero_block
+                    ws[qi, :] = 0.0
+                    continue
+                mask_ids[qi] = row
+        k_static = self.max_k
+        packed = bm25_topk_total_batch(
+            dp.block_docids, dp.block_tfs, sel, ws, dp.doc_lens, stack,
+            mask_ids, self._weight_dtype()(dp.avg_len), reg["k1"],
+            reg["b"], k_static)
+        out = np.asarray(packed)       # ONE device→host sync per cohort
+        took_ms = int((time.time() - t_arrive) * 1000)
+        self.stats["cohorts"] += 1
+        h = self.front.h
+        idx_b = reg["index"].encode()
+        no_match_set = set(no_match)
+        served = 0
+        for qi, (tok, k, term_ids, filt, trunc) in enumerate(items):
+            if tok in no_match_set:
+                self._respond_empty(tok, reg)
+                served += 1
+                continue
+            miss = float(trunc[2])
+            vals = out[qi, :k_static]
+            ids = _unpack_ids(out[qi, k_static:2 * k_static])
+            total = int(out[qi, 2 * k_static:][0])
+            nhit = int(min(k, np.isfinite(vals).sum()))
+            certified = False
+            if nhit >= k:
+                kth = float(vals[k - 1])
+                if k < k_static:
+                    # the (k+1)-th observed score bounds the best
+                    # excluded candidate
+                    nxt = (float(vals[k])
+                           if np.isfinite(vals[k]) else 0.0)
+                elif total <= k:
+                    # every matching doc is in the result: only
+                    # entirely-unseen docs (observed 0) could displace
+                    nxt = 0.0
+                else:
+                    nxt = None   # k == kernel k: no (k+1)-th to bound by
+                certified = (nxt is not None
+                             and impact_safe_termination(kth, nxt, miss))
+            # per-registration certificate track record (feeds the
+            # _trunc_hopeless back-off; refresh resets with the reg)
+            reg["trunc_attempts"] = reg.get("trunc_attempts", 0) + 1
+            if certified:
+                reg["trunc_certified"] = \
+                    reg.get("trunc_certified", 0) + 1
+            if not certified and self.impact_mode != "always":
+                # can't prove the truncated set exact — the full Python
+                # path serves it (the pre-impact behavior for oversize)
+                self.stats["trunc_refused"] = \
+                    self.stats.get("trunc_refused", 0) + 1
+                self.stats["bounced"] += 1
+                if h is not None:
+                    self.lib.es_fast_bounce(h, tok)
+                continue
+            v = vals[:nhit]
+            d = ids[:nhit]
+            order = np.lexsort((d, -v))
+            v = np.ascontiguousarray(v[order])
+            d = np.ascontiguousarray(d[order])
+            self.stats["trunc_served"] = \
+                self.stats.get("trunc_served", 0) + 1
+            if certified:
+                self.stats["trunc_certified"] = \
+                    self.stats.get("trunc_certified", 0) + 1
+            served += 1
+            if h is None:
+                return
+            self.lib.es_fast_respond(
+                h, tok, idx_b,
+                d.ctypes.data_as(ctypes.c_void_p),
+                v.ctypes.data_as(ctypes.c_void_p),
+                nhit, total, b"gte", took_ms)
+        self.stats["fast_queries"] += served
 
     # binary-search depth contract of the patch kernel (ops/fastpath)
     NE_MAX_LEN = 1 << 21

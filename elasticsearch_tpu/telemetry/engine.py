@@ -37,12 +37,18 @@ from __future__ import annotations
 
 import functools
 import inspect
+import json
+import logging
+import os
 import threading
 import time
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-__all__ = ["CompileTracker", "TRACKER", "tracked_jit"]
+logger = logging.getLogger("elasticsearch_tpu.telemetry.engine")
+
+__all__ = ["CompileTracker", "PersistentKernelCache", "TRACKER",
+           "tracked_jit"]
 
 
 # -- shape keys -------------------------------------------------------------
@@ -117,17 +123,112 @@ def _diff_trigger(prev: Optional[tuple], key: tuple) -> str:
     return "; ".join(changed) if changed else "new shape"
 
 
+# -- persistent key store ---------------------------------------------------
+
+_ADDR_RE = None
+
+
+def serialize_key(key: tuple) -> str:
+    """Stable textual form of a shape-bucket key — the persistent-cache
+    lookup key. Shape/dtype components repr deterministically, but a
+    STATIC component can be a function (``<function f at 0x7f..>``) or
+    an unhashable fallback (``<list#7f..>``) whose repr embeds a
+    per-process address — strip hex addresses so the same kernel keys
+    identically across sessions (qualname collisions are acceptable:
+    the store is telemetry-grade)."""
+    global _ADDR_RE
+    if _ADDR_RE is None:
+        import re
+        _ADDR_RE = re.compile(r"(0x|#)[0-9a-f]+")
+    return _ADDR_RE.sub(r"\1", repr(key))
+
+
+class PersistentKernelCache:
+    """On-disk record of shape-bucket keys compiled on this machine,
+    mirroring JAX's persistent compilation cache at the TRACKER's key
+    granularity. A first-execution whose key is already in the store is
+    a warm load (the serialized executable deserializes instead of
+    recompiling) and is classified as a ``cache_hit`` rather than a
+    compile; the stored cold-compile ms quantifies the seconds saved.
+
+    The store is telemetry-grade: it can drift from jax's own cache
+    (e.g. the cache dir was cleared) — a stale entry then reports a
+    slow "hit". The jit layer stays correct either way.
+    """
+
+    FILENAME = "kernel_keys.json"
+
+    def __init__(self, path: str):
+        self.path = path
+        self._file = os.path.join(path, self.FILENAME)
+        self._lock = threading.Lock()
+        self._keys: Dict[str, Dict[str, float]] = {}
+        self.hits = 0
+        self.misses = 0
+        self.saved_ms = 0.0
+        try:
+            os.makedirs(path, exist_ok=True)
+            if os.path.exists(self._file):
+                with open(self._file) as fh:
+                    loaded = json.load(fh)
+                if isinstance(loaded, dict):
+                    self._keys = {k: dict(v) for k, v in loaded.items()
+                                  if isinstance(v, dict)}
+        except Exception:   # noqa: BLE001 — a broken store is a cold one
+            logger.exception("persistent kernel cache unreadable: %s",
+                             self._file)
+            self._keys = {}
+
+    def lookup(self, kernel: str, key: tuple) -> Optional[float]:
+        """Previous cold-compile ms when ``key`` is known, else None."""
+        with self._lock:
+            return self._keys.get(kernel, {}).get(serialize_key(key))
+
+    def record(self, kernel: str, key: tuple, ms: float) -> None:
+        with self._lock:
+            self._keys.setdefault(kernel, {})[serialize_key(key)] = \
+                round(float(ms), 3)
+            snapshot = {k: dict(v) for k, v in self._keys.items()}
+        try:
+            tmp = self._file + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(snapshot, fh)
+            os.replace(tmp, self._file)
+        except Exception:   # noqa: BLE001 — persistence is best-effort
+            logger.exception("persistent kernel cache write failed")
+
+    def on_hit(self, prev_ms: float, actual_ms: float) -> None:
+        with self._lock:
+            self.hits += 1
+            self.saved_ms += max(0.0, prev_ms - actual_ms)
+
+    def on_miss(self) -> None:
+        with self._lock:
+            self.misses += 1
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "path": self.path,
+                "entries": sum(len(v) for v in self._keys.values()),
+                "hits": self.hits,
+                "misses": self.misses,
+                "saved_ms": round(self.saved_ms, 3),
+            }
+
+
 # -- the tracker ------------------------------------------------------------
 
 class _Kernel:
-    __slots__ = ("name", "calls", "compiles", "cum_ms", "shapes",
-                 "last_key", "last_ms", "last_trigger")
+    __slots__ = ("name", "calls", "compiles", "cache_hits", "cum_ms",
+                 "shapes", "last_key", "last_ms", "last_trigger")
 
     def __init__(self, name: str):
         self.name = name
         self.calls = 0
         self.compiles = 0
-        self.cum_ms = 0.0
+        self.cache_hits = 0     # first executions served warm from the
+        self.cum_ms = 0.0       # persistent compile cache
         # key -> first-execution ms (None while the timing is in flight)
         self.shapes: Dict[tuple, Optional[float]] = {}
         self.last_key: Optional[tuple] = None
@@ -146,9 +247,32 @@ class CompileTracker:
         # live metric registries (each node's Telemetry adds its own);
         # weak so closed nodes never pin their registries process-wide
         self._sinks: "weakref.WeakSet" = weakref.WeakSet()
+        # optional machine-level key store (PersistentKernelCache):
+        # first executions whose key it already holds classify as warm
+        # cache hits instead of compiles
+        self.persistent: Optional[PersistentKernelCache] = None
 
     def add_sink(self, metrics) -> None:
         self._sinks.add(metrics)
+
+    def attach_persistent(self, cache: PersistentKernelCache) -> None:
+        """First caller wins (mirrors jax's own one-cache-dir rule)."""
+        with self._lock:
+            if self.persistent is None:
+                self.persistent = cache
+
+    def persistent_stats(self) -> Dict[str, Any]:
+        """The ``persistent_cache`` block of ``GET /_kernels``."""
+        p = self.persistent
+        out: Dict[str, Any] = {"enabled": p is not None}
+        if p is not None:
+            out.update(p.stats())
+        try:
+            import jax
+            out["jax_cache_dir"] = jax.config.jax_compilation_cache_dir
+        except Exception:   # noqa: BLE001 — stats never break a caller
+            pass
+        return out
 
     # -- record path (called by tracked_jit wrappers) ----------------------
 
@@ -175,14 +299,30 @@ class CompileTracker:
                 del k.shapes[key]
 
     def on_compile(self, kernel: str, key: tuple, ms: float) -> None:
+        pers = self.persistent
+        prev_ms = pers.lookup(kernel, key) if pers is not None else None
         with self._lock:
             k = self._kernels[kernel]
             trigger = _diff_trigger(k.last_key, key)
             k.shapes[key] = ms
-            k.compiles += 1
-            k.cum_ms += ms
+            if prev_ms is not None:
+                # the machine compiled this shape bucket before: jax's
+                # persistent cache deserializes instead of recompiling —
+                # a warm load, not a compile
+                k.cache_hits += 1
+            else:
+                k.compiles += 1
+                k.cum_ms += ms
             k.last_key, k.last_ms, k.last_trigger = key, ms, trigger
             sinks = [s for s in self._sinks]
+        if pers is not None:
+            if prev_ms is not None:
+                pers.on_hit(prev_ms, ms)
+            else:
+                pers.on_miss()
+                pers.record(kernel, key, ms)
+        if prev_ms is not None:
+            return
         for m in sinks:
             try:
                 m.inc("engine.compile.count")
@@ -200,6 +340,7 @@ class CompileTracker:
                 "count": sum(k.compiles for k in kernels),
                 "ms": round(sum(k.cum_ms for k in kernels), 3),
                 "calls": sum(k.calls for k in kernels),
+                "cache_hits": sum(k.cache_hits for k in kernels),
                 "kernels": len(kernels),
             }
 
@@ -229,6 +370,7 @@ class CompileTracker:
                 out[name] = {
                     "calls": k.calls,
                     "compiles": k.compiles,
+                    "cache_hits": k.cache_hits,
                     "shapes_seen": len(k.shapes),
                     "cum_ms": round(k.cum_ms, 3),
                     "last_compile": {

@@ -100,3 +100,86 @@ def test_batcher_stats(searcher):
     searcher.batcher = None
     assert batcher.stats()["launches"] == 1
     assert batcher.stats()["avg_batch"] == 1.0
+
+
+def _mk_synth_plan(nb_width, rng, d_bd, d_bt, d_lens, zero_block):
+    """Fabricate a BoundPlan over the SHARED device corpus arrays with
+    a specific padded selection width (the pow2 bucket bind_plan would
+    pick). Sharing the arrays matters: batch signatures key on their
+    identity, exactly like streams built from one DevicePostings."""
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.ops import plan as plan_ops
+    from elasticsearch_tpu.search.plan import BoundPlan
+
+    nsel = max(2, nb_width // 4)
+    sel = np.full(nb_width, zero_block, np.int32)
+    ws = np.zeros(nb_width, np.float32)
+    sel[:nsel] = rng.choice(zero_block, nsel, replace=False)
+    ws[:nsel] = rng.uniform(0.5, 2.0, nsel).astype(np.float32)
+    grp = np.full(nb_width, 4, np.int32)
+    grp[:nsel] = 0
+    sub = np.zeros(nb_width, np.int32)
+    sub[:nsel] = np.arange(nsel)
+    const = np.zeros(nb_width, bool)
+    stream = plan_ops.FieldStream(
+        d_bd, d_bt, d_lens, jnp.float32(30.0), sel, grp, sub, ws, const)
+    kind = np.full(4, plan_ops.FILTER, np.int32)
+    req = np.full(4, 1 << 30, np.int32)
+    cst = np.full(4, np.nan, np.float32)
+    kind[0] = plan_ops.SHOULD
+    req[0] = 1
+    return BoundPlan([stream], kind, req, cst, None, 0, 0, 1, 0.0, 0.0,
+                     "sum")
+
+
+def test_mixed_nb_widths_share_cohort_and_stay_exact():
+    """Two plans whose selections bound to DIFFERENT pow2 buckets (128
+    vs 256 — same coalescing tier) share one batch signature and the
+    padded cohort returns exactly what each plan returns solo."""
+    from types import SimpleNamespace
+
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.ops import plan as plan_ops
+    from elasticsearch_tpu.search.batching import PlanBatcher, _Entry
+
+    rng = np.random.default_rng(9)
+    nd, tb, blk = 2048, 320, 8
+    bd = np.sort(rng.integers(0, nd, (tb, blk)).astype(np.int32), axis=1)
+    bt = rng.integers(0, 4, (tb, blk)).astype(np.float32)
+    bd = np.concatenate([bd, np.zeros((1, blk), np.int32)])
+    bt = np.concatenate([bt, np.zeros((1, blk), np.float32)])
+    lens = rng.integers(5, 60, nd).astype(np.float32)
+    live = jnp.asarray(np.ones(nd, bool))
+    d_bd = jnp.asarray(bd)
+    d_bt = jnp.asarray(bt)
+    d_lens = jnp.asarray(lens)
+
+    bp_small = _mk_synth_plan(128, rng, d_bd, d_bt, d_lens, tb)
+    bp_big = _mk_synth_plan(256, rng, d_bd, d_bt, d_lens, tb)
+    ctx = SimpleNamespace(
+        segment=SimpleNamespace(name="s0", live_version=0), live=live)
+
+    batcher = PlanBatcher()
+    sig_s = batcher._signature(bp_small, ctx, 10, 1.2, 0.75)
+    sig_b = batcher._signature(bp_big, ctx, 10, 1.2, 0.75)
+    assert sig_s == sig_b           # differing NB buckets coalesce
+
+    def solo(bp):
+        vals, ids, total = plan_ops.plan_topk(
+            bp.streams, bp.group_kind, bp.group_req, bp.group_const,
+            live, None, bp.n_must, bp.n_filter, bp.msm, k=10,
+            combine=bp.combine)
+        return (np.asarray(vals), np.asarray(ids), int(total))
+
+    expected = [solo(bp_small), solo(bp_big)]
+    entries = [_Entry(bp_small), _Entry(bp_big)]
+    batcher._run(entries, ctx, 10, 1.2, 0.75)
+    assert batcher.stats()["launches"] == 1
+    assert batcher.stats()["batch_hist"] == {"2": 1}
+    for e, (ev, ei, et) in zip(entries, expected):
+        gv, gi, gt = e.result
+        assert gt == et
+        np.testing.assert_array_equal(gi, ei)
+        np.testing.assert_allclose(gv, ev, rtol=1e-6)

@@ -1,0 +1,72 @@
+"""Driver entry-path platform pinning (__graft_entry__.py).
+
+Regression for the r04/r05 wedge class: a driver that exports
+``JAX_PLATFORMS=cpu`` must get the cpu backend on EVERY entry path —
+importing the package, building the entry step, and the multichip
+dryrun — never a device backend that can hang the process on a dead
+relay. The checks run in a subprocess because backend selection is a
+process-global, one-shot decision.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(code: str, timeout: int = 240):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("JAX_PLATFORM_NAME", None)
+    return subprocess.run([sys.executable, "-c", code], env=env,
+                          cwd=REPO, capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def test_import_and_entry_stay_on_cpu():
+    code = (
+        "import elasticsearch_tpu\n"
+        "import sys, os\n"
+        "sys.path.insert(0, os.getcwd())\n"
+        "import __graft_entry__ as g\n"
+        "import jax\n"
+        "fn, args = g.entry()\n"
+        "out = jax.jit(fn)(*args)\n"
+        "jax.block_until_ready(out)\n"
+        "assert jax.default_backend() == 'cpu', jax.default_backend()\n"
+        "assert all(d.platform == 'cpu' for d in jax.devices()), "
+        "jax.devices()\n"
+        "print('CPU-PIN-OK')\n")
+    r = _run(code)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "CPU-PIN-OK" in r.stdout
+
+
+def test_multichip_dryrun_emits_sectioned_json_on_cpu():
+    """dryrun_multichip under the cpu pin: the preflight section is
+    skipped (cpu pinned by caller), every section records a status into
+    the incrementally-printed JSON line — the parseable-record contract
+    for rc=124 rounds. Sections may fail on environments whose jax
+    lacks shard_map; the JSON record (not success) is the contract."""
+    code = (
+        "import os, sys, json\n"
+        "os.environ['XLA_FLAGS'] = os.environ.get('XLA_FLAGS', '') + "
+        "' --xla_force_host_platform_device_count=2'\n"
+        "sys.path.insert(0, os.getcwd())\n"
+        "import __graft_entry__ as g\n"
+        "try:\n"
+        "    g.dryrun_multichip(2)\n"
+        "except Exception:\n"
+        "    pass\n")
+    r = _run(code, timeout=420)
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert lines, (r.stdout, r.stderr)
+    payload = json.loads(lines[-1])
+    assert payload["n_devices"] == 2
+    sections = payload["sections"]
+    assert sections["preflight"]["ok"] is True
+    assert "skipped" in sections["preflight"]
+    assert "backend_init" in sections
+    for sec in sections.values():
+        assert "ok" in sec

@@ -1,0 +1,460 @@
+"""Impact-ordered block selection (ops/plan.py) + the persistent
+compile-cache key store (telemetry/engine.py).
+
+The selection contracts pinned here:
+
+1. recall-at-budget: on the seeded bursty corpus, impact-ordered
+   selection has recall >= posting-ordered (prefix) selection at every
+   budget for SINGLE-TERM truncation — the regime where per-block
+   upper bounds order actual contributions (the Lucene
+   impact-ordered-postings property). For MULTI-term queries one-shot
+   truncated coverage mis-ranks sum-scored docs regardless of ordering
+   (a doc keeps its full score only when EVERY term's posting is
+   covered — measured here too), which is exactly why the serving lane
+   refuses uncertified multi-term truncations instead of serving them;
+2. certificate-residual dominance: at equal per-term block counts the
+   impact ordering minimizes the miss bound vs posting order — the
+   safe-termination check is as strong as block selection can make it;
+3. exactness at full budget: B = total blocks selects EVERYTHING (the
+   miss bound is exactly 0.0 — this is why the fast path's in-budget
+   queries stay recall-1.0 with impact selection on by default);
+4. miss-bound soundness: no doc's true score exceeds its observed
+   (selected-blocks-only) score by more than the query's miss bound;
+5. safe-termination soundness + liveness: whenever the post-launch
+   check certifies a truncated result, the observed top-k SET equals
+   the true top-k — and there exist real corpora where it fires.
+
+Compile-cache round trip: a fresh CompileTracker session attached to
+the same on-disk key store records ZERO new compiles for shape buckets
+the machine compiled before — they classify as cache hits with saved
+milliseconds.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.ops.plan import (TermImpacts, build_term_impacts,
+                                        impact_safe_termination,
+                                        select_blocks_impact,
+                                        select_blocks_prefix)
+
+K1, B = 1.2, 0.75
+BLOCK = 16
+ND = 4096
+K = 10
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    """Small bursty corpus in the segment block layout: per-term
+    postings sorted by docid, chunked into BLOCK-sized blocks, tf with
+    a heavy tail so block maxima actually differ (the impact signal)."""
+    rng = np.random.default_rng(42)
+    n_terms = 10
+    doc_lens = np.clip(rng.lognormal(np.log(40), 0.4, ND), 5,
+                       200).astype(np.float64)
+    avg_len = float(doc_lens.mean())
+    dfs = rng.integers(12 * BLOCK, 40 * BLOCK, n_terms)
+    postings = []           # (docids, tfs) per term
+    blocks_d, blocks_t = [], []
+    starts = np.zeros(n_terms, np.int64)
+    counts = np.zeros(n_terms, np.int64)
+    for t in range(n_terms):
+        df = int(dfs[t])
+        d = np.sort(rng.choice(ND, df, replace=False)).astype(np.int32)
+        tf = (1.0 + rng.pareto(1.5, df) * 2.0).astype(
+            np.float64).round()          # heavy tail, integer tfs
+        postings.append((d, tf))
+        nb = -(-df // BLOCK)
+        starts[t] = len(blocks_d)
+        counts[t] = nb
+        for bi in range(nb):
+            bd = np.zeros(BLOCK, np.int32)
+            bt = np.zeros(BLOCK, np.float64)
+            lo, hi = bi * BLOCK, min((bi + 1) * BLOCK, df)
+            bd[: hi - lo] = d[lo:hi]
+            bt[: hi - lo] = tf[lo:hi]
+            blocks_d.append(bd)
+            blocks_t.append(bt)
+    bd = np.stack(blocks_d)
+    bt = np.stack(blocks_t)
+    idf = np.log1p((ND - dfs + 0.5) / (dfs + 0.5))
+    block_max_tf = bt.max(axis=1)
+    ln = np.where(bt > 0, doc_lens[bd], np.inf).min(axis=1)
+    block_min_len = np.where(np.isfinite(ln), ln, 0.0)
+    impacts = build_term_impacts(starts, counts, block_max_tf,
+                                 block_min_len, idf, avg_len, K1, B)
+    return dict(bd=bd, bt=bt, starts=starts, counts=counts, idf=idf,
+                doc_lens=doc_lens, avg_len=avg_len, postings=postings,
+                impacts=impacts, n_terms=n_terms)
+
+
+def _score_selection(c, term_ids, per_term):
+    """Exact f64 scores over the SELECTED blocks only."""
+    scores = np.zeros(ND, np.float64)
+    norm = K1 * (1.0 - B + B * c["doc_lens"] / c["avg_len"])
+    for t, blocks in zip(term_ids, per_term):
+        for blk in blocks:
+            d = c["bd"][blk]
+            tf = c["bt"][blk]
+            hit = tf > 0
+            dd = d[hit]
+            ff = tf[hit]
+            scores[dd] += c["idf"][t] * ff / (ff + norm[dd])
+    return scores
+
+
+def _topk_set(scores, k=K):
+    matched = np.nonzero(scores > 0)[0]
+    order = matched[np.lexsort((matched, -scores[matched]))][:k]
+    return set(order.tolist()), order
+
+
+def _full_selection(c, term_ids):
+    return [np.arange(int(c["starts"][t]),
+                      int(c["starts"][t]) + int(c["counts"][t]),
+                      dtype=np.int32) for t in term_ids]
+
+
+QUERIES = [(0, 1), (2, 3, 4), (1, 5, 6), (0, 7, 8, 9), (3, 6), (2, 9)]
+
+
+def test_impact_recall_ge_prefix_single_term_every_budget(corpus):
+    """Single-term truncation: the per-block bound IS (up to length
+    normalization) the block's best contribution, so spending the
+    budget on the highest-bound blocks dominates the posting-order
+    prefix at EVERY budget — and strictly beats it somewhere."""
+    c = corpus
+    strict_wins = 0
+    for t in range(c["n_terms"]):
+        q = (t,)
+        truth, _ = _topk_set(_score_selection(c, q, _full_selection(c, q)))
+        for frac in (0.15, 0.25, 0.4, 0.6, 0.8):
+            budget = max(1, int(c["counts"][t] * frac))
+            per_imp, _miss = select_blocks_impact(
+                q, budget, c["starts"], c["counts"], c["impacts"])
+            per_pre = select_blocks_prefix(q, budget, c["starts"],
+                                           c["counts"])
+            r_imp = len(_topk_set(_score_selection(c, q, per_imp))[0]
+                        & truth) / max(1, len(truth))
+            r_pre = len(_topk_set(_score_selection(c, q, per_pre))[0]
+                        & truth) / max(1, len(truth))
+            assert r_imp >= r_pre, (t, budget, r_imp, r_pre)
+            strict_wins += int(r_imp > r_pre)
+    assert strict_wins > 0
+
+
+def test_multi_term_truncation_is_why_certification_gates(corpus):
+    """Document the measured reality the serving lane's design rests
+    on: one-shot MULTI-term truncation (either ordering) loses recall
+    because partial coverage fragments sum scores — a doc keeps its
+    full score only when every term's posting is covered. Serving such
+    results blind would be wrong; the lane therefore only serves them
+    when the safe-termination certificate proves the set exact."""
+    c = corpus
+    degraded = 0
+    for q in QUERIES:
+        truth, _ = _topk_set(_score_selection(c, q, _full_selection(c, q)))
+        total = int(sum(c["counts"][t] for t in q))
+        budget = max(len(q), int(total * 0.4))
+        per_imp, _ = select_blocks_impact(
+            q, budget, c["starts"], c["counts"], c["impacts"])
+        r_imp = len(_topk_set(_score_selection(c, q, per_imp))[0]
+                    & truth) / max(1, len(truth))
+        degraded += int(r_imp < 1.0)
+    assert degraded > 0          # truncation at 40% is NOT free
+
+
+def test_miss_bound_dominance_over_posting_order(corpus):
+    """At equal per-term block counts, impact ordering yields a miss
+    bound <= posting order's (it excludes the LOWEST-bound blocks per
+    term by construction) — the certificate is as strong as the block
+    selection can make it."""
+    c = corpus
+    ub = c["impacts"].ub
+    for q in QUERIES:
+        total = int(sum(c["counts"][t] for t in q))
+        for frac in (0.25, 0.5, 0.75):
+            budget = max(len(q), int(total * frac))
+            per_imp, miss_imp = select_blocks_impact(
+                q, budget, c["starts"], c["counts"], c["impacts"])
+            miss_post = 0.0
+            for t, p in zip(q, per_imp):
+                j, cnt = len(p), int(c["counts"][t])
+                s = int(c["starts"][t])
+                if j < cnt:
+                    # posting order keeps the first j blocks: its
+                    # residual is the max bound over the tail
+                    miss_post += float(ub[s + j: s + cnt].max())
+            assert miss_imp <= miss_post + 1e-12, (q, budget)
+
+
+def test_full_budget_is_exact(corpus):
+    c = corpus
+    for q in QUERIES:
+        total = int(sum(c["counts"][t] for t in q))
+        per_term, miss = select_blocks_impact(
+            q, total, c["starts"], c["counts"], c["impacts"])
+        assert miss == 0.0
+        for got, want in zip(per_term, _full_selection(c, q)):
+            assert np.array_equal(got, want)
+
+
+def test_miss_bound_sound(corpus):
+    """true score - observed score <= miss_bound for EVERY doc, at
+    every truncation level."""
+    c = corpus
+    for q in QUERIES:
+        full = _score_selection(c, q, _full_selection(c, q))
+        total = int(sum(c["counts"][t] for t in q))
+        for frac in (0.2, 0.5, 0.75):
+            budget = max(len(q), int(total * frac))
+            per_term, miss = select_blocks_impact(
+                q, budget, c["starts"], c["counts"], c["impacts"])
+            obs = _score_selection(c, q, per_term)
+            gain = full - obs
+            assert gain.min() >= -1e-9          # obs is a lower bound
+            assert gain.max() <= miss + 1e-9, (q, budget, gain.max(),
+                                               miss)
+
+
+def test_safe_termination_never_lies(corpus):
+    """Soundness: whenever the check certifies, the observed top-k SET
+    must equal the true top-k set. On this boundary-dense corpus it
+    (correctly) refuses nearly everything — the refusals ARE the
+    contract: an uncertified truncation bounces to the exact path."""
+    c = corpus
+    refused = 0
+    for q in QUERIES:
+        full = _score_selection(c, q, _full_selection(c, q))
+        truth, _ = _topk_set(full)
+        total = int(sum(c["counts"][t] for t in q))
+        for frac in (0.15, 0.3, 0.5, 0.7, 0.9):
+            budget = max(len(q), int(total * frac))
+            per_term, miss = select_blocks_impact(
+                q, budget, c["starts"], c["counts"], c["impacts"])
+            obs = _score_selection(c, q, per_term)
+            got, order = _topk_set(obs)
+            if len(order) < K:
+                refused += 1
+                continue
+            kth = float(obs[order[-1]])
+            matched = np.nonzero(obs > 0)[0]
+            rest = np.sort(obs[matched])[::-1]
+            nxt = float(rest[K]) if len(rest) > K else 0.0
+            if impact_safe_termination(kth, nxt, miss):
+                assert got == truth, (q, budget)
+            else:
+                refused += 1
+    assert refused > 0
+
+
+def test_safe_termination_fires_on_separated_corpus():
+    """Liveness: the certificate is not dead code. A query mixing a
+    rare high-impact term (10 'star' docs with huge tf) with a common
+    low-idf term certifies at a budget that keeps all of the rare
+    term's blocks and cuts the common term's flat tail — the star
+    docs' observed scores clear the residual bound with room."""
+    rng = np.random.default_rng(7)
+    nd = 2048
+    doc_lens = np.full(nd, 40.0)
+    avg = 40.0
+    # term 0 (rare): 10 stars tf=100 packed in the first blocks + 150
+    # flat postings; term 1 (common): 1500 postings tf=1
+    d0 = np.sort(rng.choice(nd, 160, replace=False)).astype(np.int32)
+    tf0 = np.ones(160)
+    stars = rng.choice(160, 10, replace=False)
+    tf0[stars] = 100.0
+    d1 = np.sort(rng.choice(nd, 1500, replace=False)).astype(np.int32)
+    tf1 = np.ones(1500)
+    blocks_d, blocks_t = [], []
+    starts = np.zeros(2, np.int64)
+    counts = np.zeros(2, np.int64)
+    for t, (d, tf) in enumerate(((d0, tf0), (d1, tf1))):
+        nb = -(-len(d) // BLOCK)
+        starts[t] = len(blocks_d)
+        counts[t] = nb
+        for bi in range(nb):
+            bd = np.zeros(BLOCK, np.int32)
+            bt = np.zeros(BLOCK, np.float64)
+            lo, hi = bi * BLOCK, min((bi + 1) * BLOCK, len(d))
+            bd[: hi - lo] = d[lo:hi]
+            bt[: hi - lo] = tf[lo:hi]
+            blocks_d.append(bd)
+            blocks_t.append(bt)
+    bd = np.stack(blocks_d)
+    bt = np.stack(blocks_t)
+    dfs = np.array([160, 1500])
+    idf = np.log1p((nd - dfs + 0.5) / (dfs + 0.5))
+    bmt = bt.max(axis=1)
+    ln = np.where(bt > 0, doc_lens[bd], np.inf).min(axis=1)
+    bml = np.where(np.isfinite(ln), ln, 0.0)
+    impacts = build_term_impacts(starts, counts, bmt, bml, idf, avg,
+                                 K1, B)
+    c = dict(bd=bd, bt=bt, starts=starts, counts=counts, idf=idf,
+             doc_lens=doc_lens, avg_len=avg)
+    q = (0, 1)
+    total = int(counts.sum())
+    budget = int(counts[0]) + int(counts[1]) // 2   # all rare + half common
+    per_term, miss = select_blocks_impact(q, budget, starts, counts,
+                                          impacts)
+    assert len(per_term[0]) == counts[0]    # the rare term survives whole
+    assert miss > 0.0
+    obs = _score_selection_custom(c, q, per_term, nd)
+    full = _score_selection_custom(c, q,
+                                   [np.arange(int(starts[t]),
+                                              int(starts[t])
+                                              + int(counts[t]),
+                                              dtype=np.int32)
+                                    for t in q], nd)
+    got, order = _topk_set(obs)
+    truth, _ = _topk_set(full)
+    kth = float(obs[order[-1]])
+    matched = np.nonzero(obs > 0)[0]
+    rest = np.sort(obs[matched])[::-1]
+    nxt = float(rest[K]) if len(rest) > K else 0.0
+    assert impact_safe_termination(kth, nxt, miss), (kth, nxt, miss)
+    assert got == truth
+
+
+def _score_selection_custom(c, term_ids, per_term, nd):
+    scores = np.zeros(nd, np.float64)
+    norm = K1 * (1.0 - B + B * c["doc_lens"] / c["avg_len"])
+    for t, blocks in zip(term_ids, per_term):
+        for blk in blocks:
+            d = c["bd"][blk]
+            tf = c["bt"][blk]
+            hit = tf > 0
+            dd = d[hit]
+            ff = tf[hit]
+            scores[dd] += c["idf"][t] * ff / (ff + norm[dd])
+    return scores
+
+
+def test_select_respects_budget_and_order(corpus):
+    c = corpus
+    q = QUERIES[3]
+    total = int(sum(c["counts"][t] for t in q))
+    budget = total // 3
+    per_term, miss = select_blocks_impact(q, budget, c["starts"],
+                                          c["counts"], c["impacts"])
+    assert sum(len(p) for p in per_term) <= budget
+    assert miss > 0.0
+    for t, p in zip(q, per_term):
+        s = int(c["starts"][t])
+        cnt = int(c["counts"][t])
+        # ascending block ids (the merge kernels' slot-sorted invariant)
+        assert np.all(np.diff(p) > 0) or len(p) <= 1
+        assert ((p >= s) & (p < s + cnt)).all()
+        # the kept blocks are the term's top-impact ones: every kept
+        # bound >= every dropped bound
+        ub = c["impacts"].ub
+        dropped = np.setdiff1d(np.arange(s, s + cnt), p)
+        if len(p) and len(dropped):
+            assert ub[p].min() >= ub[dropped].max() - 1e-12
+
+
+# ---------------------------------------------------------------------------
+# persistent compile-cache round trip
+# ---------------------------------------------------------------------------
+
+def test_compile_cache_roundtrip(tmp_path):
+    from elasticsearch_tpu.telemetry.engine import (CompileTracker,
+                                                    PersistentKernelCache)
+    store = str(tmp_path / "keys")
+    key_a = (("x", (32, 4), "float32"), ("k", "static", 10))
+    key_b = (("x", (64, 4), "float32"), ("k", "static", 10))
+
+    t1 = CompileTracker()
+    t1.attach_persistent(PersistentKernelCache(store))
+    assert t1.on_call("kern", key_a)
+    t1.on_compile("kern", key_a, 120.0)
+    assert t1.on_call("kern", key_b)
+    t1.on_compile("kern", key_b, 80.0)
+    assert t1.compiles_of("kern") == 2
+    assert t1.persistent.stats()["entries"] == 2
+    assert t1.persistent.stats()["misses"] == 2
+
+    # a FRESH session (new tracker, reloaded store): the cached shape
+    # buckets record ZERO new compiles — they come back as cache hits
+    t2 = CompileTracker()
+    t2.attach_persistent(PersistentKernelCache(store))
+    for key, warm_ms in ((key_a, 3.0), (key_b, 2.0)):
+        assert t2.on_call("kern", key)
+        t2.on_compile("kern", key, warm_ms)
+    assert t2.compiles_of("kern") == 0
+    totals = t2.totals()
+    assert totals["count"] == 0
+    assert totals["cache_hits"] == 2
+    st = t2.persistent.stats()
+    assert st["hits"] == 2 and st["misses"] == 0
+    assert st["saved_ms"] == pytest.approx(117.0 + 78.0)
+    d = t2.to_dict()["kern"]
+    assert d["cache_hits"] == 2 and d["compiles"] == 0
+    # a NEW shape in the fresh session is still a real compile
+    key_c = (("x", (128, 4), "float32"), ("k", "static", 10))
+    assert t2.on_call("kern", key_c)
+    t2.on_compile("kern", key_c, 50.0)
+    assert t2.compiles_of("kern") == 1
+    assert t2.persistent.stats()["misses"] == 1
+
+
+def test_compile_cache_error_unreserves(tmp_path):
+    """on_error after a reserved key must not poison the store: the
+    key stays unrecorded so a later success counts as the compile."""
+    from elasticsearch_tpu.telemetry.engine import (CompileTracker,
+                                                    PersistentKernelCache)
+    t = CompileTracker()
+    t.attach_persistent(PersistentKernelCache(str(tmp_path / "k")))
+    key = (("x", (8,), "int32"),)
+    assert t.on_call("boom", key)
+    t.on_error("boom", key)
+    assert t.persistent.stats()["entries"] == 0
+    assert t.on_call("boom", key)
+    t.on_compile("boom", key, 5.0)
+    assert t.compiles_of("boom") == 1
+    assert t.persistent.stats()["entries"] == 1
+
+
+def test_kernels_rest_surface_has_persistent_cache(tmp_path):
+    """GET /_kernels exposes the persistent_cache block (enabled=False
+    on the cpu test backend — the cache only arms on accelerators)."""
+    from elasticsearch_tpu.node import Node
+    node = Node(data_path=str(tmp_path / "n"))
+    try:
+        status, resp = node.rest_controller.dispatch(
+            "GET", "/_kernels", None, None)
+        assert status == 200
+        assert "persistent_cache" in resp
+        assert "enabled" in resp["persistent_cache"]
+        assert "cache_hits" in resp["totals"]
+    finally:
+        node.close()
+
+
+def test_trunc_backoff_and_key_determinism():
+    """The certified lane's adaptive back-off: a registration with >=
+    TRUNC_BACKOFF_ATTEMPTS launches and zero certifications stops
+    attempting (one certification re-opens it); and persistent-cache
+    keys strip per-process addresses so function statics match across
+    sessions."""
+    from types import SimpleNamespace
+
+    from elasticsearch_tpu.search.fastpath import FastPathServer
+    from elasticsearch_tpu.telemetry.engine import serialize_key
+
+    fp = FastPathServer(None, SimpleNamespace(lib=None, h=None))
+    reg = {}
+    assert not fp._trunc_hopeless(reg)
+    reg["trunc_attempts"] = FastPathServer.TRUNC_BACKOFF_ATTEMPTS
+    assert fp._trunc_hopeless(reg)
+    assert fp.stats["trunc_backoff"] == 1
+    reg["trunc_certified"] = 1          # one success re-opens the lane
+    assert not fp._trunc_hopeless(reg)
+
+    k1 = ("kern", ("fn", "static", lambda x: x))
+    k2 = ("kern", ("fn", "static", lambda x: x))
+    # different lambda objects at different addresses, same site shape:
+    # the serialized keys must not embed 0x addresses
+    assert " at 0x>" in serialize_key(k1)
+    assert serialize_key(k1).count("0x") == serialize_key(k2).count("0x")

@@ -377,3 +377,60 @@ def test_segment_change_reregisters(served):
                 "_source": False}
         fast = req(port, "POST", "/books/_search", body)
         assert any(h["_id"] == "n1" for h in fast["hits"]["hits"])
+
+
+def test_impact_truncated_lane_serves_oversize(tmp_path):
+    """A query whose block need exceeds the largest lane bucket rides
+    the impact-truncated lane (mode "always") instead of bouncing: the
+    fast path answers with relation "gte", per-bucket dispatch counts
+    record the trunc lane, and the serving stats surface through
+    GET /_kernels."""
+    node = Node(settings=Settings.from_dict({
+        "http": {"native": {"fast_nb_buckets": "8,16",
+                            "fast_max_k": 200,
+                            "fast_impact": "always"}},
+    }), data_path=str(tmp_path / "data"))
+    port = node.start(0)
+    assert isinstance(node._http, native_http.NativeHttpFront)
+    rng = np.random.default_rng(7)
+    lines = []
+    for i in range(900):
+        doc = " ".join(rng.choice(WORDS, size=int(rng.integers(4, 12))))
+        lines.append(json.dumps({"index": {"_index": "books",
+                                           "_id": str(i)}}))
+        lines.append(json.dumps({"title": doc}))
+    req(port, "POST", "/_bulk", "\n".join(lines) + "\n", ndjson=True)
+    req(port, "POST", "/books/_refresh")
+    fp = node._http.fastpath
+    fp.refresh_registration()
+    assert fp._reg is not None
+    try:
+        reg = fp._reg
+        # an all-words query needs far more blocks than the 16 budget
+        nb_need = int(reg["nb"].sum())
+        assert nb_need > 16, nb_need
+        resp = req(port, "POST", "/books/_search",
+                   {"query": {"match": {"title": " ".join(WORDS)}},
+                    "size": 10, "_source": False})
+        assert resp["hits"]["hits"], resp
+        assert resp["hits"]["total"]["relation"] == "gte"
+        assert fp.stats.get("trunc_served", 0) >= 1
+        assert any(k.startswith("trunc:") for k in fp.dispatch), \
+            fp.dispatch
+        # serving stats ride GET /_kernels
+        kern = req(port, "GET", "/_kernels")
+        assert "serving" in kern
+        assert kern["serving"]["impact_mode"] == "always"
+        assert any(k.startswith("trunc:")
+                   for k in kern["serving"]["dispatch"])
+        # truncated hits are real matches: every returned id appears in
+        # the exact python-path result over ALL matches (observed
+        # scores are lower bounds over covered blocks — never invented)
+        full = req(port, "POST", "/books/_search",
+                   {"query": {"match": {"title": " ".join(WORDS)}},
+                    "size": 900})
+        full_ids = {h["_id"] for h in full["hits"]["hits"]}
+        got_ids = {h["_id"] for h in resp["hits"]["hits"]}
+        assert got_ids <= full_ids
+    finally:
+        node.close()
