@@ -78,7 +78,7 @@ def remaining_budget() -> float:
 
 def emit(metric_text: str, value: float, vs_baseline: float,
          engine=None, overload=None, tasks=None, cpu=None,
-         serving=None, skipped=None):
+         serving=None, skipped=None, aggs=None):
     _LAST_PAYLOAD.clear()
     _LAST_PAYLOAD.update({
         "metric": metric_text,
@@ -102,6 +102,13 @@ def emit(metric_text: str, value: float, vs_baseline: float,
         # sections that did not run this round, with reasons — an rc=124
         # or device outage leaves a parseable record per section
         _LAST_PAYLOAD["skipped"] = skipped
+    if aggs:
+        # aggregation-reduction rider (round-7): host vs device wall
+        # time per agg family (metric moments / histogram scatter-add /
+        # per-bucket sub-metric columns), sketch sizes and merge error,
+        # and the incremental partial-reduce counts — host rows bank
+        # CPU-side BEFORE any backend touch (PR-6 convention)
+        _LAST_PAYLOAD["aggs"] = aggs
     if tasks:
         # task-management rider (transport/tasks.py): peak concurrent
         # registered tasks + cancellations observed on the serving node.
@@ -1290,6 +1297,145 @@ def compose_metric(p):
         p.get("sec_txt", ""))
 
 
+# ---------------------------------------------------------------------------
+# aggregation reduction bench (round-7): host vs device wall time per
+# agg family + sketch/partial-reduce accounting. The HOST half runs
+# pure numpy (no jax import) so it banks before any backend touch; the
+# DEVICE half runs only after the preflight proved the device alive.
+# ---------------------------------------------------------------------------
+
+AGGS_N = int(os.environ.get("BENCH_AGGS_DOCS", 2_000_000))
+AGGS_NB = 64            # histogram bucket count (one ladder rung)
+AGGS_REPS = 5
+
+
+def _aggs_columns(rng):
+    vals = rng.uniform(1.0, 1000.0, AGGS_N)
+    missing = rng.random(AGGS_N) < 0.1
+    mask = rng.random(AGGS_N) < 0.3
+    interval = 1000.0 / AGGS_NB
+    steps = np.floor(vals / interval).astype(np.int64)
+    return vals, missing, mask, steps
+
+
+def run_aggs_cpu(rng):
+    """Host reduction rows + sketch/partial-reduce accounting — all
+    numpy, banked before the first device touch."""
+    from elasticsearch_tpu.search.agg_partials import AggReduceConsumer
+    from elasticsearch_tpu.search.sketches import TDigest
+    vals, missing, mask, steps = _aggs_columns(rng)
+    sel = mask & ~missing
+    out = {"docs": AGGS_N, "buckets": AGGS_NB}
+
+    t0 = time.time()
+    for _ in range(AGGS_REPS):
+        v = vals[sel]
+        _ = (len(v), v.sum(), v.min(), v.max(), (v ** 2).sum())
+    out["host_metric_stats_ms"] = round(
+        (time.time() - t0) / AGGS_REPS * 1000, 2)
+
+    t0 = time.time()
+    for _ in range(AGGS_REPS):
+        np.unique(steps[sel], return_counts=True)
+    out["host_histogram_counts_ms"] = round(
+        (time.time() - t0) / AGGS_REPS * 1000, 2)
+
+    # the per-bucket sub-metric chain the device columns replace: one
+    # masked numpy pass per bucket
+    t0 = time.time()
+    for b in range(AGGS_NB):
+        in_b = sel & (steps == b)
+        v = vals[in_b]
+        if len(v):
+            _ = (len(v), v.sum(), v.min(), v.max(), (v ** 2).sum())
+    out["host_bucket_metrics_ms"] = round((time.time() - t0) * 1000, 2)
+
+    # sketch: build, split-merge, q-space error, size
+    t0 = time.time()
+    digest = TDigest.from_values(vals[sel])
+    out["sketch_build_ms"] = round((time.time() - t0) * 1000, 2)
+    out["sketch_centroids"] = int(digest.means.size)
+    out["sketch_bytes"] = digest.nbytes()
+    shards = np.array_split(vals[sel], 8)
+    t0 = time.time()
+    merged = TDigest.merge_all([TDigest.from_values(s) for s in shards])
+    out["sketch_shard_merge_ms"] = round((time.time() - t0) * 1000, 2)
+    v = vals[sel]
+    out["sketch_q50_qerr_pct"] = round(abs(
+        float((v <= merged.quantile(50)).mean()) * 100 - 50), 4)
+    out["sketch_q99_qerr_pct"] = round(abs(
+        float((v <= merged.quantile(99)).mean()) * 100 - 99), 4)
+
+    # incremental partial reduce: 8 shard partials through the consumer
+    spec = {"p": {"percentiles": {"field": "x"}},
+            "s": {"stats": {"field": "x"}}}
+    partials = []
+    for s in shards:
+        partials.append({
+            "p": {"d": TDigest.from_values(s).to_wire()},
+            "s": {"n": len(s), "s": float(s.sum()), "mn": float(s.min()),
+                  "mx": float(s.max()), "ss": float((s ** 2).sum())}})
+    from elasticsearch_tpu.utils.breaker import payload_size_bytes
+    out["partial_bytes_each"] = payload_size_bytes(partials[0])
+    cons = AggReduceConsumer(spec, batch_size=3)
+    t0 = time.time()
+    for p in partials:
+        cons.consume(p)
+    _acc, phases = cons.finish()
+    out["partial_reduce_ms"] = round((time.time() - t0) * 1000, 2)
+    out["partial_reduce_partials"] = cons.partials_consumed
+    out["partial_reduce_phases"] = phases
+    return out
+
+
+def run_aggs_device(rng, aggs_rows):
+    """Device reduction rows (requires a live backend): the fused
+    metric-stats launch, histogram scatter-add, and per-bucket metric
+    columns — wall time per launch after warm-up, vs the host rows
+    already banked."""
+    import jax
+
+    from elasticsearch_tpu.ops.aggs import (
+        bucket_counts,
+        bucket_metric_columns,
+        masked_metric_stats,
+    )
+    vals, missing, mask, steps = _aggs_columns(rng)
+    dv = jax.device_put(vals.astype(np.float32))
+    dm = jax.device_put(missing)
+    dk = jax.device_put(mask)
+    ids = np.clip(steps, 0, AGGS_NB - 1).astype(np.int32)
+    di = jax.device_put(ids)
+
+    masked_metric_stats(dv, dm, dk)          # warm (compile)
+    t0 = time.time()
+    for _ in range(AGGS_REPS):
+        masked_metric_stats(dv, dm, dk)
+    aggs_rows["device_metric_stats_ms"] = round(
+        (time.time() - t0) / AGGS_REPS * 1000, 2)
+
+    bucket_counts(di, dk, AGGS_NB)
+    t0 = time.time()
+    for _ in range(AGGS_REPS):
+        bucket_counts(di, dk, AGGS_NB)
+    aggs_rows["device_histogram_counts_ms"] = round(
+        (time.time() - t0) / AGGS_REPS * 1000, 2)
+
+    bucket_metric_columns(di, dk, dv, dm, AGGS_NB)
+    t0 = time.time()
+    for _ in range(AGGS_REPS):
+        bucket_metric_columns(di, dk, dv, dm, AGGS_NB)
+    aggs_rows["device_bucket_metrics_ms"] = round(
+        (time.time() - t0) / AGGS_REPS * 1000, 2)
+
+    for fam in ("metric_stats", "histogram_counts", "bucket_metrics"):
+        host = aggs_rows.get(f"host_{fam}_ms")
+        dev = aggs_rows.get(f"device_{fam}_ms")
+        if host and dev:
+            aggs_rows[f"{fam}_speedup"] = round(host / dev, 2)
+    return aggs_rows
+
+
 def main():
     import signal
     import tempfile
@@ -1312,7 +1458,8 @@ def main():
              tasks=parts.get("tasks"),
              cpu=parts.get("cpu"),
              serving=parts.get("serving"),
-             skipped=parts.get("skipped"))
+             skipped=parts.get("skipped"),
+             aggs=parts.get("aggs"))
 
     rng = np.random.default_rng(12345)
     t0 = time.time()
@@ -1337,6 +1484,15 @@ def main():
     cpu_rows["baseline_qps"] = round(cpu_qps or 0.0, 1)
     cpu_rows["baseline_self_recall"] = round(cpu_recall or 0.0, 4)
     parts.update(cpu_qps=cpu_qps, cpu_recall=cpu_recall)
+    # aggregation HOST rows (pure numpy — metric moments, histogram
+    # unique, per-bucket chains, sketch build/merge/error, incremental
+    # partial-reduce counts) bank with the other CPU rows
+    try:
+        t0 = time.time()
+        parts["aggs"] = run_aggs_cpu(rng)
+        cpu_rows["aggs_host_s"] = round(time.time() - t0, 1)
+    except Exception as e:  # noqa: BLE001 — the rider must not sink
+        log(f"aggs host section failed: {e!r}")
     # ALL CPU-side rows land before ANY jax/backend touch: a dead
     # relay hangs even backend INIT uninterruptibly (observed: hours),
     # and a run killed there must still have parsed output on record
@@ -1351,7 +1507,8 @@ def main():
         log(f"DEVICE UNREACHABLE (subprocess preflight): {pf_why}")
         parts["device_down"] = pf_why
         skipped = parts.setdefault("skipped", {})
-        for sec in ("raw_kernel", "secondary", "sustained", "knn8m"):
+        for sec in ("raw_kernel", "secondary", "sustained", "knn8m",
+                    "aggs_device"):
             skipped[sec] = "device unreachable (preflight quick-fail)"
         # before any in-process jax import: every later section runs on
         # the cpu backend
@@ -1395,6 +1552,14 @@ def main():
         # device_put; a normal exit would join it forever
         os._exit(0)
     parts.update(kernel_qps=kernel_qps, batch_qps=batch_qps)
+    # device aggregation rows: a handful of reduction launches over the
+    # synthetic columns — cheap, and the host halves already banked
+    if parts.get("aggs") is not None:
+        try:
+            run_aggs_device(rng, parts["aggs"])
+        except Exception as e:  # noqa: BLE001 — rider must not sink
+            log(f"aggs device section failed: {e!r}")
+            parts.setdefault("skipped", {})["aggs_device"] = repr(e)
     if os.environ.get("BENCH_SECONDARY", "1") != "0":
         try:
             sec = run_secondary(corpus, queries, rng, handles)

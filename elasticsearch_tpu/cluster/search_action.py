@@ -13,6 +13,16 @@ shards owning the winners → assemble. Per-shard results carry EWMA
 queue/service stats for adaptive replica selection, like the reference's
 QueryPhase.execute:307-315 → ResponseCollectorService loop.
 
+Aggregations ride the same fan-out: each shard's query result carries a
+MERGEABLE partial (moments / bounded sketches / bucket maps —
+search/agg_partials.py), consumed incrementally by an
+``AggReduceConsumer`` in ``batched_reduce_size`` batches as shards
+respond (ref: QueryPhaseResultConsumer), with buffered bytes charged to
+the ``request`` breaker and ``num_reduce_phases`` surfaced in the
+response. Failed shards contribute no partial — aggregations reduce
+over the survivors under the partial-results protocol below. See
+COMPONENTS.md "Distributed aggregations".
+
 Failure semantics (ref: AbstractSearchAsyncAction.onShardFailure →
 performPhaseOnShard on the next copy):
 
@@ -386,6 +396,8 @@ class DistributedSearchService:
         from contextlib import ExitStack
 
         from elasticsearch_tpu.search import profile as _prof
+        aggs_spec = body.get("aggs") or body.get("aggregations")
+        agg_partial = None
         try:
             searcher = self._searcher_for(req["index"], shard_id)
             if searcher is None:
@@ -408,7 +420,20 @@ class DistributedSearchService:
                     sort=body.get("sort"),
                     search_after=body.get("search_after"),
                     track_total_hits=bool(body.get("track_total_hits",
-                                                   True)))
+                                                   True)),
+                    collect_masks=bool(aggs_spec))
+                if aggs_spec:
+                    # the shard's mergeable partial (moments/sketches/
+                    # bucket maps — search/agg_partials.py); the shared
+                    # collectors ride the device cache at scale exactly
+                    # like the single-node agg phase
+                    from elasticsearch_tpu.search.agg_partials import (
+                        collect_partials)
+                    agg_ctx = [(seg, mask, searcher.mapper)
+                               for seg, mask in (result.agg_masks or [])]
+                    agg_partial = collect_partials(
+                        aggs_spec, agg_ctx, searcher.mapper,
+                        self.data_node.device_cache)
         except Exception as e:  # noqa: BLE001 — per-shard fault barrier
             return {"shard": shard_id, "error": str(e),
                     "type": error_type_of(e)}
@@ -416,6 +441,7 @@ class DistributedSearchService:
             "shard": shard_id,
             "total": result.total_hits,
             "max_score": result.max_score,
+            "aggs": agg_partial,
             # the stored _id travels with the address: segment names
             # are engine-local (uuid-prefixed), so a fetch that fails
             # over to ANOTHER copy resolves the doc by _id instead
@@ -587,12 +613,34 @@ class DistributedSearchService:
                         "search slowlog check failed")
             _cb(resp, err)
 
-        if body.get("aggs") or body.get("aggregations"):
-            finish(None, NotImplementedError(
-                "aggregations over the distributed path land with the "
-                "partial-reduce milestone; single-node search supports "
-                "them"))
-            return
+        # distributed aggregations: every shard returns a mergeable
+        # partial with its query-phase result; the consumer reduces
+        # them incrementally in batched_reduce_size batches as shards
+        # respond (search/agg_partials.py — the QueryPhaseResultConsumer
+        # analogue), bounded coordinator memory + request-breaker
+        # accounting on the buffered partials
+        aggs_spec = body.get("aggs") or body.get("aggregations")
+        agg_consumer = None
+        if aggs_spec:
+            from elasticsearch_tpu.search.agg_partials import (
+                AggReduceConsumer,
+                check_distributed_support,
+            )
+            try:
+                check_distributed_support(aggs_spec)
+                breaker = None
+                if getattr(self.data_node, "breaker_service", None) \
+                        is not None:
+                    breaker = self.data_node.breaker_service.get_breaker(
+                        "request")
+                agg_consumer = AggReduceConsumer(
+                    aggs_spec,
+                    batch_size=body.get("batched_reduce_size"),
+                    breaker=breaker,
+                    metrics=tele.metrics if tele is not None else None)
+            except Exception as e:  # noqa: BLE001 — typed, pre-fan-out
+                finish(None, e)
+                return
         from elasticsearch_tpu.common.settings import parse_boolean
         try:
             indices.extend(self._resolve(state, index_expression))
@@ -631,6 +679,9 @@ class DistributedSearchService:
             "total": 0, "max_score": None,
             "pending": len(groups), "groups": groups,
             "allow_partial": allow_partial,
+            "aggs_spec": aggs_spec,
+            "agg_consumer": agg_consumer,
+            "agg_reduce_error": None,
             "t_start": t_start,
             "deadline": (t_start + budget) if budget else None,
             "timed_out": False,
@@ -750,6 +801,13 @@ class DistributedSearchService:
 
     def _shard_succeeded(self, ctx: Dict, g: _ShardGroup, node_id: str,
                          index: str, sr: Dict) -> None:
+        agg_size = None
+        if ctx["agg_consumer"] is not None and sr.get("aggs") is not None:
+            # size the partial BEFORE taking the coordinator lock —
+            # payload_size_bytes re-serializes the tree (O(bytes)) and
+            # must not hold up the other shards' responses
+            from elasticsearch_tpu.utils.breaker import payload_size_bytes
+            agg_size = payload_size_bytes(sr["aggs"])
         with ctx["lock"]:
             if g.resolved or ctx["query_done"]:
                 # late answer after budget expiry / failover; a span
@@ -775,6 +833,17 @@ class DistributedSearchService:
                 d2["_shard"] = sr["shard"]
                 d2["_node"] = node_id
                 ctx["merged"].append(d2)
+            consumer = ctx["agg_consumer"]
+            if consumer is not None and sr.get("aggs") is not None \
+                    and ctx["agg_reduce_error"] is None:
+                # incremental partial reduce under the coordinator lock
+                # (pure CPU merge); a request-breaker trip here fails
+                # the whole search at _finish — the reduce itself is
+                # what ran out of memory, no copy retry can help
+                try:
+                    consumer.consume(sr["aggs"], size_hint=agg_size)
+                except Exception as e:  # noqa: BLE001 — typed breaker
+                    ctx["agg_reduce_error"] = e
         if span is not None:
             span.finish(outcome="ok")
         self._group_resolved(ctx)
@@ -947,13 +1016,19 @@ class DistributedSearchService:
                   err: Optional[Exception]) -> None:
         """Single exit: cancel the pending budget timer (it pins ctx —
         merged docs + a cluster-state snapshot — until the deadline
-        otherwise) and hand the result to the caller."""
+        otherwise), release the agg consumer's outstanding breaker
+        charge (failure exits skip its finish(), and buffered partial
+        bytes must never stay charged past the search), and hand the
+        result to the caller."""
         cancel = ctx.pop("budget_cancel", None)
         if cancel is not None:
             try:
                 cancel.cancel()
             except Exception:  # noqa: BLE001 — cancellation is best-effort
                 pass
+        consumer = ctx.get("agg_consumer")
+        if consumer is not None:
+            consumer.close()        # idempotent; no-op after finish()
         ctx["on_done"](resp, err)
 
     # -- fetch phase ------------------------------------------------------
@@ -1238,6 +1313,30 @@ class DistributedSearchService:
                      "max_score": ctx["max_score"],
                      "hits": final_hits},
         }
+        consumer = ctx.get("agg_consumer")
+        if consumer is not None:
+            if ctx["agg_reduce_error"] is not None:
+                # the incremental reduce itself failed (request-breaker
+                # trip buffering partials) — the search fails typed, no
+                # copy retry can relieve coordinator memory
+                self._complete(ctx, None, ctx["agg_reduce_error"])
+                return
+            try:
+                from elasticsearch_tpu.search.agg_partials import (
+                    finalize_partials,
+                    strip_internal,
+                )
+                acc, phases = consumer.finish()
+                # failed shards simply never contributed a partial:
+                # aggregations reflect the successful shards, exactly
+                # like hits under the partial-results protocol
+                resp["aggregations"] = strip_internal(
+                    finalize_partials(ctx["aggs_spec"], acc))
+                resp["num_reduce_phases"] = phases
+            except Exception as e:  # noqa: BLE001 — pipeline/script
+                # errors at finalize fail the request typed
+                self._complete(ctx, None, e)
+                return
         self._complete(ctx, resp, None)
 
     # ------------------------------------------------------------- helpers

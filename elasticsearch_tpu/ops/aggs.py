@@ -12,12 +12,18 @@ take the per-term boundary positions, diff — exact per-term doc counts
 in 3 array ops (the same sorted-segmented-reduction shape as the
 scoring kernels).
 
-Histogram counts and numeric metric reductions stay HOST-side but
-batched (one-pass np.unique / masked column reductions in
-search/aggregations.py): their inputs need f64 (epoch-millisecond keys
-and sum accumulation exceed f32's integer range) while the device
-columns are f32, and a single fused host pass already beats a device
-round-trip through the serving tunnel.
+Numeric metric chains and histogram bucketing ride the device too
+(round-7): ``masked_metric_stats`` fuses count/sum/min/max/sum-of-
+squares into ONE launch over a resident f32 column, and the histogram
+family scatter-adds doc→bucket ids into per-bucket count + sub-metric
+columns — one launch per (segment, metric column) instead of one host
+numpy pass per bucket. Bucket-id ARITHMETIC stays host-side in f64
+(epoch-millisecond keys exceed f32's integer range; a floor-divide
+over a column is cheap) — the device takes the REDUCTION, which is the
+part that scales with doc count and bucket count. Bucket counts pad to
+a power-of-two ladder (``_pow2_buckets``) so recompiles stay bounded
+and visible in ``GET /_kernels``; dispatch thresholds and the exact
+host fallback live in search/aggregations.py.
 """
 
 from __future__ import annotations
@@ -28,6 +34,11 @@ import jax
 import jax.numpy as jnp
 
 from elasticsearch_tpu.telemetry.engine import tracked_jit
+
+# buckets beyond this cap stay on the host unique/bincount path (a
+# scatter this wide stops paying for the launch)
+AGG_BUCKET_CAP = 8192
+_F32_BIG = float(np.finfo(np.float32).max)
 
 
 @tracked_jit("terms_counts")
@@ -56,3 +67,104 @@ def terms_counts_per_term(dev_perm, term_starts: np.ndarray,
     out = _terms_counts_kernel(dev_perm, mask, ends_idx, begins_idx,
                                begins_zero, nonempty)
     return np.asarray(out).astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# metric reductions (round-7): one launch per (segment, column)
+# ---------------------------------------------------------------------------
+
+@tracked_jit("agg_metric_stats")
+def _metric_stats_kernel(values, missing, mask):
+    """count/sum/min/max/sum-of-squares of a masked f32 column, fused —
+    the device half of sum/avg/min/max/stats/extended_stats."""
+    sel = jnp.logical_and(mask, jnp.logical_not(missing))
+    v = jnp.where(sel, values, 0.0)
+    n = jnp.sum(sel.astype(jnp.int32))
+    s = jnp.sum(v)
+    ss = jnp.sum(v * v)
+    mn = jnp.min(jnp.where(sel, values, jnp.float32(_F32_BIG)))
+    mx = jnp.max(jnp.where(sel, values, jnp.float32(-_F32_BIG)))
+    return n, s, mn, mx, ss
+
+
+def masked_metric_stats(dev_values, dev_missing, dev_mask):
+    """(count, sum, min, max, sum_sq) over masked present values —
+    one launch, one scalar readback. min/max are None when count is 0."""
+    n, s, mn, mx, ss = _metric_stats_kernel(dev_values, dev_missing,
+                                            dev_mask)
+    n = int(n)
+    if n == 0:
+        return 0, 0.0, None, None, 0.0
+    return n, float(s), float(mn), float(mx), float(ss)
+
+
+# ---------------------------------------------------------------------------
+# histogram bucketing via scatter-add (round-7)
+# ---------------------------------------------------------------------------
+
+def pow2_buckets(nb: int) -> int:
+    """Pad a bucket count to the power-of-two ladder (floor 64) so the
+    scatter kernels compile once per ladder rung, not once per query;
+    0 when past AGG_BUCKET_CAP (caller falls back to the host path)."""
+    if nb <= 0 or nb > AGG_BUCKET_CAP:
+        return 0
+    p = 64
+    while p < nb:
+        p <<= 1
+    return p
+
+
+@tracked_jit("agg_bucket_counts", static_argnames=("nb",))
+def _bucket_counts_kernel(bucket_ids, mask, nb):
+    """Per-bucket masked doc counts: ONE scatter-add into nb+1 slots
+    (slot nb swallows masked-out docs)."""
+    ids = jnp.where(mask, bucket_ids, nb)
+    return jnp.zeros(nb + 1, jnp.int32).at[ids].add(1)[:nb]
+
+
+def bucket_counts(dev_bucket_ids, dev_mask, nb: int) -> np.ndarray:
+    """Host int64 counts [nb] from one device scatter-add launch.
+    ``dev_bucket_ids`` int32 in [0, nb) for in-range docs (out-of-range
+    ids must already be masked out)."""
+    nb_pad = pow2_buckets(nb)
+    if nb_pad == 0:
+        raise ValueError(f"bucket count {nb} past AGG_BUCKET_CAP")
+    out = _bucket_counts_kernel(dev_bucket_ids, dev_mask, nb_pad)
+    return np.asarray(out)[:nb].astype(np.int64)
+
+
+@tracked_jit("agg_bucket_metrics", static_argnames=("nb",))
+def _bucket_metrics_kernel(bucket_ids, mask, values, missing, nb):
+    """Per-bucket count/sum/min/max/sum-of-squares of a metric column:
+    the whole per-bucket sub-metric chain in ONE launch (vs one host
+    numpy pass per bucket)."""
+    sel = jnp.logical_and(mask, jnp.logical_not(missing))
+    ids = jnp.where(sel, bucket_ids, nb)
+    v = jnp.where(sel, values, 0.0)
+    cnt = jnp.zeros(nb + 1, jnp.int32).at[ids].add(1)
+    s = jnp.zeros(nb + 1, jnp.float32).at[ids].add(v)
+    ss = jnp.zeros(nb + 1, jnp.float32).at[ids].add(v * v)
+    big = jnp.float32(_F32_BIG)
+    mn = jnp.full(nb + 1, big, jnp.float32).at[ids].min(
+        jnp.where(sel, values, big))
+    mx = jnp.full(nb + 1, -big, jnp.float32).at[ids].max(
+        jnp.where(sel, values, -big))
+    return cnt[:nb], s[:nb], mn[:nb], mx[:nb], ss[:nb]
+
+
+def bucket_metric_columns(dev_bucket_ids, dev_mask, dev_values,
+                          dev_missing, nb: int):
+    """Host (count, sum, min, max, sum_sq) arrays [nb] for one metric
+    column across all buckets — one launch per (segment, column).
+    min/max entries of empty buckets come back as ±f32-max; the caller
+    masks them against count == 0."""
+    nb_pad = pow2_buckets(nb)
+    if nb_pad == 0:
+        raise ValueError(f"bucket count {nb} past AGG_BUCKET_CAP")
+    cnt, s, mn, mx, ss = _bucket_metrics_kernel(
+        dev_bucket_ids, dev_mask, dev_values, dev_missing, nb_pad)
+    return (np.asarray(cnt)[:nb].astype(np.int64),
+            np.asarray(s)[:nb].astype(np.float64),
+            np.asarray(mn)[:nb].astype(np.float64),
+            np.asarray(mx)[:nb].astype(np.float64),
+            np.asarray(ss)[:nb].astype(np.float64))

@@ -1,0 +1,1176 @@
+"""Mergeable aggregation partials: the distributed-aggs wire contract.
+
+The reference makes every agg result an ``InternalAggregation`` that
+serializes, merges associatively, and finalizes on the coordinator
+(ref: InternalAggregations.java / the per-type ``reduce()`` tree,
+consumed incrementally by QueryPhaseResultConsumer.java — PAPER.md
+layer 7). This module is that contract for the TPU engine's columnar
+aggs: each shard runs the same mask-algebra collectors as the
+single-node path (search/aggregations.py — device kernels included)
+but stops at the MERGEABLE MOMENTS instead of the finished response:
+
+- simple numeric metrics travel as ``(count, sum, min, max, sum_sq)``
+  moments — additive, so merge order only moves float rounding;
+- the percentile family (percentiles / percentile_ranks / boxplot /
+  median_absolute_deviation) travels as a bounded TDigest sketch
+  (search/sketches.py — exact below the centroid budget, documented
+  error above it). NO raw-sample carrier ever crosses the wire;
+- bucket aggs travel as key→{count, sub-partials} maps and merge by
+  key, recursing through sub-aggregation trees;
+- composite pages stay exact across shards: each shard reports its
+  first ``size`` keys after ``after`` plus a truncation bound, and the
+  final reduce never emits a key past the smallest truncated shard's
+  last key (a key beyond it could be undercounted);
+- pipeline aggs (sibling AND parent) never cross the wire — they are
+  pure functions of finalized buckets and run once on the coordinator.
+
+Three pure functions define the protocol — ``collect_partials`` (data
+node), ``merge_partials`` (associative pairwise reduce), and
+``finalize_partials`` (coordinator) — plus ``AggReduceConsumer``, the
+QueryPhaseResultConsumer analogue: it buffers shard partials, reduces
+every ``batched_reduce_size`` arrivals (coordinator memory holds at
+most one batch + one accumulator), charges buffered bytes to the
+``request`` breaker, and feeds the ``search.agg_reduce.*`` metrics.
+
+Aggregation types outside ``DISTRIBUTED_METRICS`` /
+``DISTRIBUTED_BUCKETS`` raise a typed (non-retryable) error on the
+distributed path before any shard fan-out; the single-node path still
+serves them all. See COMPONENTS.md "Distributed aggregations".
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ParsingException,
+)
+from elasticsearch_tpu.search import aggregations as A
+from elasticsearch_tpu.search.sketches import TDigest
+from elasticsearch_tpu.utils.breaker import payload_size_bytes
+
+# ---------------------------------------------------------------------------
+# supported surface
+# ---------------------------------------------------------------------------
+
+MOMENT_METRICS = {"sum", "min", "max", "avg", "value_count", "stats",
+                  "extended_stats"}
+DIGEST_METRICS = {"percentiles", "percentile_ranks", "boxplot",
+                  "median_absolute_deviation"}
+DISTRIBUTED_METRICS = (MOMENT_METRICS | DIGEST_METRICS
+                       | {"cardinality", "weighted_avg", "top_hits",
+                          "scripted_metric"})
+DISTRIBUTED_BUCKETS = {"terms", "rare_terms", "histogram",
+                       "date_histogram", "range", "date_range",
+                       "filter", "filters", "missing", "global",
+                       "composite"}
+
+# ES defaults batched_reduce_size to 512; shard counts in this engine
+# are small, so a low default keeps the incremental reduce actually
+# incremental (and its metrics observable) on real clusters
+DEFAULT_BATCHED_REDUCE_SIZE = 5
+
+
+def check_distributed_support(spec: Dict[str, Any]) -> None:
+    """Reject agg trees the distributed path cannot merge — typed
+    (illegal_argument → non-retryable) BEFORE any shard fan-out, so the
+    coordinator never burns a fan-out on a request that cannot reduce."""
+    for name, node in (spec or {}).items():
+        if not isinstance(node, dict):
+            raise ParsingException(
+                f"[{name}] is not an aggregation object")
+        types = [k for k in node
+                 if k not in ("aggs", "aggregations", "meta")]
+        if len(types) != 1:
+            raise ParsingException(
+                f"Expected exactly one aggregation type under [{name}], "
+                f"got {types}")
+        t = types[0]
+        if t in A.PARENT_PIPELINES or t in A.PIPELINE_AGGS:
+            continue            # pure coordinator-side functions
+        if t not in DISTRIBUTED_METRICS | DISTRIBUTED_BUCKETS:
+            raise IllegalArgumentException(
+                f"aggregation [{name}] of type [{t}] is not supported "
+                "on the distributed search path yet (single-node "
+                "search serves it; see COMPONENTS.md \"Distributed "
+                "aggregations\" for the supported set)")
+        sub = node.get("aggs", node.get("aggregations"))
+        if sub:
+            if t in DISTRIBUTED_METRICS:
+                raise ParsingException(
+                    f"metric aggregation [{name}] cannot hold "
+                    "sub-aggregations")
+            check_distributed_support(sub)
+
+
+# ---------------------------------------------------------------------------
+# collect (data-node side)
+# ---------------------------------------------------------------------------
+
+def collect_partials(spec: Dict[str, Any], ctx, mapper,
+                     device_cache=None) -> Dict[str, Any]:
+    """One shard's partial tree for an aggs spec — JSON-serializable,
+    bounded (moments / sketches / trimmed bucket maps), mergeable via
+    ``merge_partials``. The device cache scopes exactly like
+    ``compute_aggs`` so the shared collectors (terms ord-major counts,
+    fused metric moments, histogram scatter-add) ride the device at
+    scale."""
+    token = A._DEVICE_CACHE.set(device_cache)
+    try:
+        return _collect_level(spec, ctx, mapper)
+    finally:
+        A._DEVICE_CACHE.reset(token)
+
+
+def _collect_level(spec, ctx, mapper) -> Dict[str, Any]:
+    out = {}
+    for name, node in (spec or {}).items():
+        agg_type, body, sub = A._split_node(name, node)
+        if agg_type in A.PIPELINE_AGGS or agg_type in A.PARENT_PIPELINES:
+            continue                      # coordinator-side
+        out[name] = _collect_one(agg_type, body, sub, ctx, mapper)
+    return out
+
+
+def _regular_sub(sub):
+    return A._split_parent_pipelines(sub)[0] if sub else {}
+
+
+def _collect_one(agg_type, body, sub, ctx, mapper):
+    if agg_type in MOMENT_METRICS:
+        return _collect_moments(body, ctx, agg_type)
+    if agg_type in DIGEST_METRICS:
+        values = _metric_values(ctx, body)
+        return {"d": TDigest.from_values(
+            values, A._digest_compression(body)).to_wire()}
+    if agg_type == "cardinality":
+        return _collect_cardinality(body, ctx)
+    if agg_type == "weighted_avg":
+        return _collect_weighted_avg(body, ctx)
+    if agg_type == "top_hits":
+        return _collect_top_hits(body, ctx, mapper)
+    if agg_type == "scripted_metric":
+        return {"states": A.scripted_metric_states(body, ctx)}
+    if agg_type in ("terms", "rare_terms"):
+        return _collect_terms(agg_type, body, sub, ctx, mapper)
+    if agg_type in ("histogram", "date_histogram"):
+        return _collect_histogram(agg_type, body, sub, ctx, mapper)
+    if agg_type in ("range", "date_range"):
+        return _collect_range(agg_type, body, sub, ctx, mapper)
+    if agg_type == "filter":
+        from elasticsearch_tpu.search.queries import parse_query
+        bucket_ctx = A._refine(
+            ctx, A._query_masks(parse_query(body), ctx, mapper))
+        return _bucket_partial(bucket_ctx, sub, mapper)
+    if agg_type == "filters":
+        from elasticsearch_tpu.search.queries import parse_query
+        out = {}
+        for fname, fspec in (body.get("filters") or {}).items():
+            bucket_ctx = A._refine(
+                ctx, A._query_masks(parse_query(fspec), ctx, mapper))
+            out[fname] = _bucket_partial(bucket_ctx, sub, mapper)
+        return {"b": out}
+    if agg_type == "missing":
+        field = body.get("field")
+        submasks = []
+        for seg, mask, _m in ctx:
+            present = np.zeros(seg.n_docs, bool)
+            nv = seg.numerics.get(field)
+            if nv is not None:
+                present |= ~nv.missing
+            kv = seg.keywords.get(field)
+            if kv is not None:
+                present |= (kv.offsets[1:] - kv.offsets[:-1]) > 0
+            pf = seg.postings.get(field)
+            if pf is not None:
+                present |= pf.field_lengths > 0
+            submasks.append(~present)
+        return _bucket_partial(A._refine(ctx, submasks), sub, mapper)
+    if agg_type == "global":
+        global_ctx = [(seg, seg.live.copy(), m)
+                      for seg, _msk, m in ctx]
+        return _bucket_partial(global_ctx, sub, mapper)
+    if agg_type == "composite":
+        return _collect_composite(body, sub, ctx, mapper)
+    raise IllegalArgumentException(
+        f"unhandled distributed agg [{agg_type}]")
+
+
+def _bucket_partial(bucket_ctx, sub, mapper):
+    """{doc_count, sub partials} for one single-bucket agg."""
+    out = {"c": sum(int(msk.sum()) for _, msk, _m in bucket_ctx)}
+    reg = _regular_sub(sub)
+    if reg:
+        out["sub"] = _collect_level(reg, bucket_ctx, mapper)
+    return out
+
+
+def _metric_values(ctx, body) -> np.ndarray:
+    """The value source of a numeric metric, honoring ``missing``
+    (mirrors the host branch of aggregations._metric)."""
+    field = body.get("field")
+    values = A._numeric_values(ctx, field)
+    missing_val = body.get("missing")
+    if missing_val is not None:
+        n_missing = 0
+        for seg, mask, _m in ctx:
+            nv = seg.numerics.get(field)
+            miss = (nv.missing if nv is not None
+                    else np.ones(seg.n_docs, bool))
+            n_missing += int((mask[: seg.n_docs] & miss).sum())
+        values = np.concatenate(
+            [values, np.full(n_missing, float(missing_val))])
+    return values
+
+
+def _collect_moments(body, ctx, agg_type=None):
+    """(count, sum, min, max, sum_sq) — via ONE fused device launch per
+    segment at scale (ops/aggs.py masked_metric_stats), host numpy
+    otherwise. extended_stats always collects host-side: its variance
+    cancels catastrophically in the device f32 sum-of-squares (same
+    exclusion as the single-node dispatch)."""
+    if body.get("missing") is None and agg_type != "extended_stats":
+        dev = A._device_metric_stats(ctx, body.get("field"))
+        if dev is not None:
+            n, s, mn, mx, ss = dev
+            return {"n": n, "s": s, "mn": mn, "mx": mx, "ss": ss}
+    values = _metric_values(ctx, body)
+    n = int(len(values))
+    if n == 0:
+        return {"n": 0, "s": 0.0, "mn": None, "mx": None, "ss": 0.0}
+    return {"n": n, "s": float(values.sum()),
+            "mn": float(values.min()), "mx": float(values.max()),
+            "ss": float((values ** 2).sum())}
+
+
+def _collect_cardinality(body, ctx):
+    """Exact distinct values (the engine's cardinality is exact —
+    memory is O(distinct) per shard, documented)."""
+    field = body.get("field")
+    distinct: set = set()
+    for seg, mask, _m in ctx:
+        kv = seg.keywords.get(field)
+        if kv is not None:
+            bc = A._masked_ord_counts(kv, mask, seg.n_docs)
+            distinct.update(kv.terms[int(o)] for o in np.nonzero(bc)[0])
+            continue
+        nv = seg.numerics.get(field)
+        if nv is not None:
+            m = mask[: seg.n_docs] & ~nv.missing
+            distinct.update(float(v)
+                            for v in np.unique(nv.values[m]).tolist())
+    return {"vals": sorted(distinct, key=lambda v: (isinstance(v, str),
+                                                    v))}
+
+
+def _collect_weighted_avg(body, ctx):
+    vfield = (body.get("value") or {}).get("field")
+    wfield = (body.get("weight") or {}).get("field")
+    num = den = 0.0
+    for seg, mask, _m in ctx:
+        vv, vm = A._first_values_and_mask(seg, mask, vfield)
+        wv, wm = A._first_values_and_mask(seg, mask, wfield)
+        if vv is None or wv is None:
+            continue
+        m = vm & wm
+        num += float((vv[m] * wv[m]).sum())
+        den += float(wv[m].sum())
+    return {"num": num, "den": den}
+
+
+def _collect_top_hits(body, ctx, mapper):
+    """The shard's finished top-N plus merge keys: sorted top_hits
+    merge exactly (the RAW sort value travels with each hit — kept
+    untyped so non-numeric sort values merge too); unsorted hits keep
+    shard-arrival order like the reference."""
+    result = A._metric("top_hits", body, ctx, mapper)
+    hits = result["hits"]["hits"]
+    keys = []
+    if body.get("sort"):
+        for h in hits:
+            sv = (h.get("sort") or [None])[0]
+            keys.append([1, None] if sv is None else [0, sv])
+    return {"total": result["hits"]["total"]["value"],
+            "hits": hits, "keys": keys}
+
+
+def _terms_counts(body, ctx) -> Tuple[Dict[Any, int], bool]:
+    """(term → count, numeric?) over keyword or numeric doc values —
+    the same sources the single-node terms agg reads (device ord-major
+    counts at scale)."""
+    field = body.get("field")
+    counts = A._keyword_terms_counts(ctx, field)
+    if counts:
+        return counts, False
+    ncounts: Dict[float, int] = {}
+    for seg, mask, _m in ctx:
+        nv = seg.numerics.get(field)
+        if nv is None:
+            continue
+        m = mask[: seg.n_docs] & ~nv.missing
+        vals, cnts = np.unique(nv.values[m], return_counts=True)
+        for v, c in zip(vals, cnts):
+            ncounts[float(v)] = ncounts.get(float(v), 0) + int(c)
+    # an empty shard must not claim the field numeric — the flag ORs
+    # across shards at merge and would mis-key another shard's keywords
+    return ncounts, bool(ncounts)
+
+
+def _term_submasks(ctx, field, term, numeric):
+    if numeric:
+        out = []
+        for seg, _m2, _m3 in ctx:
+            nv = seg.numerics.get(field)
+            out.append(np.zeros(seg.n_docs, bool) if nv is None
+                       else (~nv.missing & (nv.values == term)))
+        return out
+    return [A._keyword_membership_mask(seg, field, term)
+            for seg, _m2, _m3 in ctx]
+
+
+def _collect_terms(agg_type, body, sub, ctx, mapper):
+    """Terms partial: full count map by default (merge is then EXACT —
+    memory O(shard distinct terms), like the single-node collector);
+    an explicit ``shard_size`` trims to the shard's top counts with ES
+    error accounting (``err`` = the largest dropped count, summed into
+    doc_count_error_upper_bound at reduce)."""
+    field = body.get("field")
+    counts, numeric = _terms_counts(body, ctx)
+    # trim (when asked) in the REQUESTED order — a _key-ordered terms
+    # agg trimmed by count would drop exactly the buckets the final
+    # sort wants (ES trims shard-side in request order for the same
+    # reason); the count-error bound only means anything under _count
+    order = body.get("order", {"_count": "desc"})
+    (order_key, order_dir), = (order.items() if isinstance(order, dict)
+                               else [("_count", "desc")])
+    if order_key == "_key" and not numeric:
+        items = sorted(counts.items(), key=lambda kv: kv[0],
+                       reverse=(order_dir == "desc"))
+    else:
+        items = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+    other = 0
+    err = 0
+    shard_size = body.get("shard_size")
+    if agg_type == "terms" and shard_size is not None:
+        shard_size = int(shard_size)
+        dropped = items[shard_size:]
+        items = items[:shard_size]
+        other = sum(c for _, c in dropped)
+        if not dropped:
+            err = 0
+        elif order_key == "_count":
+            err = max(c for _, c in dropped)
+        else:
+            # ES convention: the count-error bound is unknowable when
+            # the trim order isn't _count — report -1, never a false 0
+            err = -1
+    reg = _regular_sub(sub)
+    terms_out = {}
+    for term, c in items:
+        entry = {"c": c}
+        if reg:
+            bucket_ctx = A._refine(
+                ctx, _term_submasks(ctx, field, term, numeric))
+            entry["sub"] = _collect_level(reg, bucket_ctx, mapper)
+        terms_out[str(term)] = entry
+    return {"numeric": numeric, "terms": terms_out,
+            "other": other, "err": err}
+
+
+def _histogram_params(agg_type, body):
+    """(step_of, key_of, calendar?) — the one step/key convention
+    (shared with the single-node branch semantics)."""
+    cal_unit = (A._calendar_unit(body) if agg_type == "date_histogram"
+                else None)
+    if agg_type == "histogram":
+        interval = float(body["interval"])
+    elif cal_unit is None:
+        interval = A._date_interval_ms(body)
+    if cal_unit is not None:
+        def step_of(vv):
+            return A._calendar_floor_ms(vv, cal_unit).astype(np.int64)
+
+        def key_of(step):
+            return float(step)
+        return step_of, key_of, cal_unit
+
+    def step_of(vv):
+        # NaN slots (missing values) are masked out by every caller —
+        # zero them first so the int cast never sees an invalid value
+        return np.floor(np.nan_to_num(vv) / interval).astype(np.int64)
+
+    def key_of(step):
+        return step * interval
+    return step_of, key_of, None
+
+
+def _collect_histogram(agg_type, body, sub, ctx, mapper):
+    """step → {count, sub partials}; gap fill and min_doc_count apply
+    at FINALIZE (they need the global step range). Fixed intervals with
+    metric-only sub-aggs ride the fused device scatter-add columns."""
+    field = body.get("field")
+    step_of, _key_of, cal_unit = _histogram_params(agg_type, body)
+    reg = _regular_sub(sub)
+    if cal_unit is None:
+        sub_metrics = A._device_histogram_submetrics(reg)
+        if sub_metrics is not None:
+            interval = (float(body["interval"])
+                        if agg_type == "histogram"
+                        else A._date_interval_ms(body))
+            moments = A._device_histogram_moments(
+                ctx, field, interval, sub_metrics)
+            if moments is not None:
+                lo, counts, mcols = moments
+                out = {}
+                for i in range(len(counts)):
+                    c = int(counts[i])
+                    if c == 0:
+                        continue
+                    entry = {"c": c}
+                    if sub_metrics:
+                        entry["sub"] = {
+                            name: {"n": int(mcols[name][0][i]),
+                                   "s": float(mcols[name][1][i]),
+                                   "mn": (float(mcols[name][2][i])
+                                          if mcols[name][0][i] else None),
+                                   "mx": (float(mcols[name][3][i])
+                                          if mcols[name][0][i] else None),
+                                   "ss": float(mcols[name][4][i])}
+                            for name, _t, _f in sub_metrics}
+                    out[str(int(lo + i))] = entry
+                return {"b": out}
+    # one pass per segment: values, mask, and step ids extracted ONCE
+    # (the per-step sub-agg refinement below reuses them — recomputing
+    # per (step, segment) would be O(buckets × docs))
+    seg_cols = []
+    step_counts: Dict[int, int] = {}
+    for seg, mask, _m in ctx:
+        vv, m = A._first_values_and_mask(seg, mask, field)
+        if vv is None:
+            seg_cols.append((seg, None, None))
+            continue
+        steps = step_of(vv)
+        seg_cols.append((seg, m, steps))
+        uniq, cnts = np.unique(steps[m], return_counts=True)
+        for u, c in zip(uniq, cnts):
+            step_counts[int(u)] = step_counts.get(int(u), 0) + int(c)
+    out = {}
+    for step, c in step_counts.items():
+        entry = {"c": c}
+        if reg:
+            submasks = [
+                (np.zeros(seg.n_docs, bool) if m is None
+                 else (m & (steps == step)))
+                for seg, m, steps in seg_cols]
+            entry["sub"] = _collect_level(
+                reg, A._refine(ctx, submasks), mapper)
+        out[str(step)] = entry
+    return {"b": out}
+
+
+def _collect_range(agg_type, body, sub, ctx, mapper):
+    """Positional range buckets: bounds resolve shard-side (date math,
+    mapper formats) and travel in ``meta`` — merge is positional."""
+    field = body.get("field")
+    reg = _regular_sub(sub)
+    if agg_type == "date_range":
+        # reuse the single-node bound parser via a tiny spec evaluation:
+        # compute bounds once with the shard's mapper
+        metas, bounds = _date_range_bounds(body, mapper)
+    else:
+        metas, bounds = [], []
+        for r in body.get("ranges", []):
+            frm, to = r.get("from"), r.get("to")
+            key = r.get("key", f"{frm if frm is not None else '*'}-"
+                               f"{to if to is not None else '*'}")
+            meta = {"key": key}
+            if frm is not None:
+                meta["from"] = float(frm)
+            if to is not None:
+                meta["to"] = float(to)
+            metas.append(meta)
+            bounds.append((float(frm) if frm is not None else None,
+                           float(to) if to is not None else None))
+    buckets = []
+    for frm, to in bounds:
+        submasks = []
+        for seg, mask, _m in ctx:
+            vv, m = A._first_values_and_mask(seg, mask, field)
+            if vv is None:
+                submasks.append(np.zeros(seg.n_docs, bool))
+                continue
+            in_r = m.copy()
+            if frm is not None:
+                in_r &= vv >= frm
+            if to is not None:
+                in_r &= vv < to
+            submasks.append(in_r)
+        buckets.append(_bucket_partial(
+            A._refine(ctx, submasks), sub, mapper))
+    return {"b": buckets, "meta": metas}
+
+
+def _date_range_bounds(body, mapper):
+    """date_range bounds + response meta via the single-node parser
+    (one no-doc evaluation of the range spec)."""
+    out = A._bucket("date_range", {**body, "ranges": body.get(
+        "ranges", [])}, {}, [], mapper)
+    metas = []
+    bounds = []
+    for b in out["buckets"]:
+        meta = {k: v for k, v in b.items() if k != "doc_count"}
+        metas.append(meta)
+        bounds.append((meta.get("from"), meta.get("to")))
+    return metas, bounds
+
+
+def _composite_keyjson(key: List[Any]) -> str:
+    return json.dumps(key, sort_keys=False, separators=(",", ":"))
+
+
+def _collect_composite(body, sub, ctx, mapper):
+    """The shard's first ``size`` composite keys after ``after`` in
+    composite order, plus the truncation flag the exact-paging reduce
+    needs (see module docstring)."""
+    import functools
+    sources = body.get("sources", [])
+    if not sources:
+        raise ParsingException("composite requires [sources]")
+    size = int(body.get("size", 10))
+    after = body.get("after")
+    names, orders, missing_ok = [], [], []
+    for src in sources:
+        (name, spec), = src.items()
+        (stype, sbody), = spec.items()
+        names.append(name)
+        orders.append(sbody.get("order", "asc"))
+        missing_ok.append(bool(sbody.get("missing_bucket", False)))
+    seg_source_vals = []
+    for seg, _mask, _m in ctx:
+        row = []
+        for src in sources:
+            (name, spec), = src.items()
+            (stype, sbody), = spec.items()
+            row.append(A._composite_source_values(stype, sbody, seg))
+        seg_source_vals.append(row)
+    groups: Dict[tuple, List[List[int]]] = {}
+    counts: Dict[tuple, int] = {}
+    for si, (seg, mask, _m) in enumerate(ctx):
+        docs = np.nonzero(mask[: seg.n_docs])[0]
+        for d in docs:
+            key = []
+            ok = True
+            for j in range(len(sources)):
+                vals, valid = seg_source_vals[si][j]
+                if vals is None or not bool(valid[d]):
+                    if missing_ok[j]:
+                        key.append(None)
+                    else:
+                        ok = False
+                        break
+                else:
+                    v = vals[d]
+                    key.append(float(v) if isinstance(
+                        v, (np.floating, np.integer)) else v)
+            if not ok:
+                continue
+            kt = tuple(key)
+            if kt not in groups:
+                groups[kt] = [[] for _ in ctx]
+                counts[kt] = 0
+            groups[kt][si].append(int(d))
+            counts[kt] += 1
+    keyfn = functools.cmp_to_key(
+        lambda a, b: A._composite_cmp(a, b, orders))
+    ordered = sorted(groups, key=keyfn)
+    if after is not None:
+        after_t = tuple(after.get(n) for n in names)
+        ordered = [k for k in ordered
+                   if A._composite_cmp(k, after_t, orders) > 0]
+    more = len(ordered) > size
+    page = ordered[:size]
+    reg = _regular_sub(sub)
+    entries = []
+    for kt in page:
+        entry = {"k": list(kt), "c": counts[kt]}
+        if reg:
+            submasks = []
+            for si, (seg, _mask, _m) in enumerate(ctx):
+                sm = np.zeros(seg.n_docs, bool)
+                if groups[kt][si]:
+                    sm[groups[kt][si]] = True
+                submasks.append(sm)
+            entry["sub"] = _collect_level(
+                reg, A._refine(ctx, submasks), mapper)
+        entries.append(entry)
+    return {"b": entries, "more": more}
+
+
+# ---------------------------------------------------------------------------
+# merge (associative pairwise reduce)
+# ---------------------------------------------------------------------------
+
+def merge_partials(spec: Dict[str, Any],
+                   acc: Optional[Dict[str, Any]],
+                   part: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge one shard partial into the accumulator. ``acc=None``
+    starts a fresh accumulator (the incoming partial is deep-copied —
+    wire payloads on the sim transport may be shared with the sender
+    and must stay read-only)."""
+    if part is None:
+        return acc
+    if acc is None:
+        return copy.deepcopy(part)
+    for name, node in (spec or {}).items():
+        agg_type, body, sub = A._split_node(name, node)
+        if agg_type in A.PIPELINE_AGGS or agg_type in A.PARENT_PIPELINES:
+            continue
+        if name not in part:
+            continue
+        if name not in acc:
+            acc[name] = copy.deepcopy(part[name])
+            continue
+        acc[name] = _merge_one(agg_type, body, sub,
+                               acc[name], part[name])
+    return acc
+
+
+def _merge_moments(a, p):
+    mns = [v for v in (a.get("mn"), p.get("mn")) if v is not None]
+    mxs = [v for v in (a.get("mx"), p.get("mx")) if v is not None]
+    return {"n": a["n"] + p["n"], "s": a["s"] + p["s"],
+            "mn": min(mns) if mns else None,
+            "mx": max(mxs) if mxs else None,
+            "ss": a["ss"] + p["ss"]}
+
+
+def _merge_sub(sub, a_entry, p_entry):
+    reg = _regular_sub(sub)
+    if not reg:
+        return
+    a_entry["sub"] = merge_partials(reg, a_entry.get("sub"),
+                                    p_entry.get("sub"))
+
+
+def _merge_one(agg_type, body, sub, a, p):
+    if agg_type in MOMENT_METRICS:
+        return _merge_moments(a, p)
+    if agg_type in DIGEST_METRICS:
+        from elasticsearch_tpu.search.sketches import merge_wire_digests
+        return {"d": merge_wire_digests(
+            [a.get("d"), p.get("d")], A._digest_compression(body))}
+    if agg_type == "cardinality":
+        vals = set(a.get("vals", ())) | set(p.get("vals", ()))
+        return {"vals": sorted(vals, key=lambda v: (isinstance(v, str),
+                                                    v))}
+    if agg_type == "weighted_avg":
+        return {"num": a["num"] + p["num"], "den": a["den"] + p["den"]}
+    if agg_type == "scripted_metric":
+        return {"states": list(a.get("states", ()))
+                + list(p.get("states", ()))}
+    if agg_type == "top_hits":
+        merged = {"total": a["total"] + p["total"],
+                  "hits": list(a["hits"]) + list(p["hits"]),
+                  "keys": list(a.get("keys", ()))
+                  + list(p.get("keys", ()))}
+        # keep the buffer bounded: trim to size on every merge (sorted
+        # specs re-sort stably by the carried keys first). Two-phase:
+        # present values first (ONE sort field → homogeneous type, so
+        # reverse= handles desc without negating — strings included),
+        # missing-key hits last, both phases arrival-stable.
+        size = int(body.get("size", 3))
+        if merged["keys"] and body.get("sort"):
+            desc = _top_hits_desc(body)
+            idx = range(len(merged["hits"]))
+            present = [i for i in idx if merged["keys"][i][0] == 0]
+            absent = [i for i in idx if merged["keys"][i][0] != 0]
+            present.sort(key=lambda i: merged["keys"][i][1],
+                         reverse=desc)
+            order = present + absent
+            merged["hits"] = [merged["hits"][i] for i in order[:size]]
+            merged["keys"] = [merged["keys"][i] for i in order[:size]]
+        else:
+            merged["hits"] = merged["hits"][:size]
+            merged["keys"] = merged["keys"][:size]
+        return merged
+    if agg_type in ("terms", "rare_terms"):
+        a_err, p_err = a.get("err", 0), p.get("err", 0)
+        out = {"numeric": a.get("numeric") or p.get("numeric"),
+               "terms": a.get("terms", {}),
+               "other": a.get("other", 0) + p.get("other", 0),
+               # -1 (unknowable, non-_count trim order) poisons the sum
+               "err": (-1 if a_err < 0 or p_err < 0
+                       else a_err + p_err)}
+        for term, entry in p.get("terms", {}).items():
+            cur = out["terms"].get(term)
+            if cur is None:
+                out["terms"][term] = copy.deepcopy(entry)
+                continue
+            cur["c"] += entry["c"]
+            _merge_sub(sub, cur, entry)
+        return out
+    if agg_type in ("histogram", "date_histogram"):
+        out = {"b": a.get("b", {})}
+        for step, entry in p.get("b", {}).items():
+            cur = out["b"].get(step)
+            if cur is None:
+                out["b"][step] = copy.deepcopy(entry)
+                continue
+            cur["c"] += entry["c"]
+            _merge_sub(sub, cur, entry)
+        return out
+    if agg_type in ("range", "date_range"):
+        ab, pb = a.get("b", []), p.get("b", [])
+        if len(ab) != len(pb):
+            raise IllegalArgumentException(
+                f"[{agg_type}] partials disagree on bucket count "
+                f"({len(ab)} vs {len(pb)})")
+        for cur, entry in zip(ab, pb):
+            cur["c"] += entry["c"]
+            _merge_sub(sub, cur, entry)
+        return {"b": ab, "meta": a.get("meta") or p.get("meta")}
+    if agg_type in ("filter", "missing", "global"):
+        a["c"] += p["c"]
+        _merge_sub(sub, a, p)
+        return a
+    if agg_type == "filters":
+        out = a.get("b", {})
+        for fname, entry in p.get("b", {}).items():
+            cur = out.get(fname)
+            if cur is None:
+                out[fname] = copy.deepcopy(entry)
+                continue
+            cur["c"] += entry["c"]
+            _merge_sub(sub, cur, entry)
+        return {"b": out}
+    if agg_type == "composite":
+        groups = a.get("groups")
+        if groups is None:
+            # lift the first partial into accumulator form
+            groups = {}
+            bounds = []
+            _composite_accumulate(groups, bounds, a, sub)
+            a = {"groups": groups, "bounds": bounds}
+        _composite_accumulate(a["groups"], a["bounds"], p, sub)
+        return a
+    raise IllegalArgumentException(
+        f"unhandled distributed agg merge [{agg_type}]")
+
+
+def _top_hits_desc(body) -> bool:
+    spec = body.get("sort")
+    spec = spec[0] if isinstance(spec, list) else spec
+    if isinstance(spec, str):
+        return False
+    (_f, sdir), = spec.items()
+    order = (sdir.get("order", "asc") if isinstance(sdir, dict)
+             else str(sdir))
+    return order == "desc"
+
+
+def _composite_accumulate(groups, bounds, part, sub):
+    entries = part.get("b", [])
+    for entry in entries:
+        jk = _composite_keyjson(entry["k"])
+        cur = groups.get(jk)
+        if cur is None:
+            groups[jk] = copy.deepcopy(entry)
+            continue
+        cur["c"] += entry["c"]
+        _merge_sub(sub, cur, entry)
+    if part.get("more") and entries:
+        bounds.append(entries[-1]["k"])
+
+
+# ---------------------------------------------------------------------------
+# finalize (coordinator)
+# ---------------------------------------------------------------------------
+
+def finalize_partials(spec: Dict[str, Any],
+                      acc: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Reduced partials → the ``aggregations`` response object, with
+    sibling + parent pipelines computed here (they are pure functions
+    of finalized buckets). Internal carriers (``_set``/``_digest``)
+    survive for pipeline consumption — callers strip with
+    ``strip_internal``."""
+    out: Dict[str, Any] = {}
+    pipelines: List[Tuple[str, str, Dict[str, Any]]] = []
+    for name, node in (spec or {}).items():
+        agg_type, body, sub = A._split_node(name, node)
+        if agg_type in A.PIPELINE_AGGS:
+            pipelines.append((name, agg_type, body))
+            continue
+        if agg_type in A.PARENT_PIPELINES:
+            continue
+        out[name] = _finalize_one(agg_type, body, sub,
+                                  (acc or {}).get(name))
+    for name, agg_type, body in pipelines:
+        out[name] = A._compute_pipeline(agg_type, body, out)
+    return out
+
+
+def strip_internal(out: Dict[str, Any]) -> Dict[str, Any]:
+    A._strip_internal(out)
+    return out
+
+
+def _finalize_sub(sub, entry, bucket: Dict[str, Any]) -> None:
+    reg = _regular_sub(sub)
+    if reg:
+        bucket.update(finalize_partials(reg, (entry or {}).get("sub")))
+
+
+def _finalize_one(agg_type, body, sub, part):
+    if agg_type in MOMENT_METRICS:
+        part = part or {"n": 0, "s": 0.0, "mn": None, "mx": None,
+                        "ss": 0.0}
+        return A._shape_metric_from_stats(
+            agg_type, (part["n"], part["s"], part["mn"], part["mx"],
+                       part["ss"]))
+    if agg_type in DIGEST_METRICS:
+        digest = TDigest.from_wire((part or {}).get("d"))
+        return _finalize_digest_metric(agg_type, body, digest)
+    if agg_type == "cardinality":
+        vals = set((part or {}).get("vals", ()))
+        return {"value": len(vals), "_set": vals}
+    if agg_type == "weighted_avg":
+        den = (part or {}).get("den", 0.0)
+        return {"value": (part["num"] / den) if den else None}
+    if agg_type == "scripted_metric":
+        return A.scripted_metric_reduce(body,
+                                        list((part or {}).get(
+                                            "states", ())))
+    if agg_type == "top_hits":
+        part = part or {"total": 0, "hits": [], "keys": []}
+        size = int(body.get("size", 3))
+        hits = part["hits"][:size]
+        return {"hits": {"total": {"value": part["total"],
+                                   "relation": "eq"},
+                         "hits": hits}}
+    if agg_type == "terms":
+        return _finalize_terms(body, sub, part)
+    if agg_type == "rare_terms":
+        return _finalize_rare_terms(body, sub, part)
+    if agg_type in ("histogram", "date_histogram"):
+        return _finalize_histogram(agg_type, body, sub, part)
+    if agg_type in ("range", "date_range"):
+        part = part or {"b": [], "meta": []}
+        buckets = []
+        for entry, meta in zip(part.get("b", []),
+                               part.get("meta", [])):
+            b = dict(meta)
+            b["doc_count"] = entry["c"]
+            _finalize_sub(sub, entry, b)
+            buckets.append(b)
+        return {"buckets": buckets}
+    if agg_type in ("filter", "missing", "global"):
+        entry = part or {"c": 0}
+        out = {"doc_count": entry["c"]}
+        _finalize_sub(sub, entry, out)
+        return out
+    if agg_type == "filters":
+        buckets = {}
+        for fname, entry in (part or {}).get("b", {}).items():
+            b = {"doc_count": entry["c"]}
+            _finalize_sub(sub, entry, b)
+            buckets[fname] = b
+        return {"buckets": buckets}
+    if agg_type == "composite":
+        return _finalize_composite(body, sub, part)
+    raise IllegalArgumentException(
+        f"unhandled distributed agg finalize [{agg_type}]")
+
+
+def _finalize_digest_metric(agg_type, body, digest: TDigest):
+    if agg_type == "percentiles":
+        if digest.is_empty():
+            # single-node shape for an empty value source: {} values
+            # and no sketch carrier (aggregations._metric n==0 branch)
+            return {"values": {}}
+        percents = body.get("percents", [1, 5, 25, 50, 75, 95, 99])
+        return {"values": {str(float(p)): digest.quantile(float(p))
+                           for p in percents},
+                "_digest": digest}
+    if agg_type == "percentile_ranks":
+        targets = body.get("values", [])
+        if digest.is_empty():
+            return {"values": {}}
+        return {"values": {str(float(t)): digest.cdf(float(t)) * 100.0
+                           for t in targets}}
+    if agg_type == "median_absolute_deviation":
+        return {"value": digest.mad()}
+    return A.shape_boxplot(digest)      # boxplot: the ONE shaping
+
+
+def _term_key_out(term: str, numeric: bool):
+    if not numeric:
+        return term
+    try:
+        v = float(term)
+    except ValueError:
+        # mixed multi-index mapping: a keyword shard's term merged into
+        # a numeric-flagged map stays a string key (single-node keeps
+        # keyword semantics in the same situation — never crash)
+        return term
+    return int(v) if v.is_integer() else v
+
+
+def _term_sort_key(term: str, numeric: bool):
+    if not numeric:
+        return term
+    try:
+        return (0, float(term), "")
+    except ValueError:
+        return (1, 0.0, term)      # mixed-mapping stragglers sort last
+
+
+def _finalize_terms(body, sub, part):
+    part = part or {"numeric": False, "terms": {}, "other": 0, "err": 0}
+    size = int(body.get("size", 10))
+    numeric = bool(part.get("numeric"))
+    counts = {t: e["c"] for t, e in part.get("terms", {}).items()}
+    if numeric:
+        items = sorted(counts.items(),
+                       key=lambda kv: (-kv[1], _term_sort_key(kv[0],
+                                                              True)))
+    else:
+        order = body.get("order", {"_count": "desc"})
+        (order_key, order_dir), = (order.items()
+                                   if isinstance(order, dict)
+                                   else [("_count", "desc")])
+        rev = order_dir == "desc"
+        if order_key == "_count":
+            items = sorted(counts.items(),
+                           key=lambda kv: (-kv[1] if rev else kv[1],
+                                           kv[0]))
+        else:
+            items = sorted(counts.items(), key=lambda kv: kv[0],
+                           reverse=rev)
+    parents = A._split_parent_pipelines(sub)[1] if sub else {}
+    buckets = []
+    for term, c in items[:size]:
+        b = {"key": _term_key_out(term, numeric), "doc_count": c}
+        _finalize_sub(sub, part["terms"][term], b)
+        buckets.append(b)
+    other = part.get("other", 0) + sum(c for _, c in items[size:])
+    A._apply_parent_pipelines(parents, buckets)
+    return {"doc_count_error_upper_bound": part.get("err", 0),
+            "sum_other_doc_count": other, "buckets": buckets}
+
+
+def _finalize_rare_terms(body, sub, part):
+    part = part or {"numeric": False, "terms": {}}
+    max_dc = int(body.get("max_doc_count", 1))
+    if not 1 <= max_dc <= 100:
+        raise ParsingException("[max_doc_count] must be in [1, 100]")
+    numeric = bool(part.get("numeric"))
+    rare = sorted(((e["c"], t) for t, e in part.get("terms", {}).items()
+                   if e["c"] <= max_dc),
+                  key=lambda ct: (ct[0], _term_sort_key(ct[1], numeric)))
+    parents = A._split_parent_pipelines(sub)[1] if sub else {}
+    buckets = []
+    for c, term in rare:
+        b = {"key": _term_key_out(term, numeric), "doc_count": c}
+        _finalize_sub(sub, part["terms"][term], b)
+        buckets.append(b)
+    A._apply_parent_pipelines(parents, buckets)
+    return {"buckets": buckets}
+
+
+def _finalize_histogram(agg_type, body, sub, part):
+    part = part or {"b": {}}
+    _step_of, key_of, cal_unit = _histogram_params(agg_type, body)
+    min_doc_count = int(body.get("min_doc_count", 0))
+    step_entries = {int(s): e for s, e in part.get("b", {}).items()}
+    all_steps = sorted(step_entries)
+    if all_steps and body.get("extended_bounds") is None \
+            and min_doc_count == 0:
+        # gap fill under the SAME bucket cap as the single-node path
+        # (aggregations.MAX_HISTOGRAM_BUCKETS): one sparse shard pair
+        # must not OOM the coordinator reduce outside any breaker
+        if cal_unit is not None:
+            filled, cur = [], all_steps[0]
+            while cur <= all_steps[-1]:
+                filled.append(cur)
+                A._check_bucket_cap(len(filled), agg_type)
+                cur = A._calendar_next_ms(cur, cal_unit)
+            all_steps = filled
+        else:
+            A._check_bucket_cap(all_steps[-1] - all_steps[0] + 1,
+                                agg_type)
+            all_steps = list(range(all_steps[0], all_steps[-1] + 1))
+    parents = A._split_parent_pipelines(sub)[1] if sub else {}
+    buckets = []
+    for step in all_steps:
+        entry = step_entries.get(step, {"c": 0})
+        count = entry["c"]
+        if count < min_doc_count:
+            continue
+        key = key_of(step)
+        b = {"key": key}
+        if agg_type == "date_histogram":
+            b["key_as_string"] = A._ms_to_iso(key)
+        b["doc_count"] = count
+        _finalize_sub(sub, entry, b)
+        buckets.append(b)
+    A._apply_parent_pipelines(parents, buckets)
+    return {"buckets": buckets}
+
+
+def _finalize_composite(body, sub, part):
+    import functools
+    sources = body.get("sources", [])
+    size = int(body.get("size", 10))
+    names, orders = [], []
+    for src in sources:
+        (name, spec), = src.items()
+        (stype, sbody), = spec.items()
+        names.append(name)
+        orders.append(sbody.get("order", "asc"))
+    if part is None:
+        return {"buckets": []}
+    if "groups" not in part:
+        groups = {}
+        bounds: List[List[Any]] = []
+        _composite_accumulate(groups, bounds, part, sub)
+    else:
+        groups, bounds = part["groups"], part["bounds"]
+
+    def cmp(a, b):
+        return A._composite_cmp(tuple(a), tuple(b), orders)
+
+    ordered = sorted((e["k"] for e in groups.values()),
+                     key=functools.cmp_to_key(cmp))
+    # exact paging: never emit a key past the smallest truncated
+    # shard's last reported key — it could be undercounted there; the
+    # next page (after_key = last emitted) will see it whole
+    if bounds:
+        boundary = min(bounds, key=functools.cmp_to_key(cmp))
+        ordered = [k for k in ordered if cmp(k, boundary) <= 0]
+    page = ordered[:size]
+    buckets = []
+    for k in page:
+        entry = groups[_composite_keyjson(k)]
+        b = {"key": dict(zip(names, k)), "doc_count": entry["c"]}
+        _finalize_sub(sub, entry, b)
+        buckets.append(b)
+    A._apply_parent_pipelines(
+        A._split_parent_pipelines(sub)[1] if sub else {}, buckets)
+    out: Dict[str, Any] = {"buckets": buckets}
+    if buckets:
+        out["after_key"] = buckets[-1]["key"]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# incremental consumer (coordinator)
+# ---------------------------------------------------------------------------
+
+class AggReduceConsumer:
+    """The QueryPhaseResultConsumer analogue: consume shard agg
+    partials as they arrive, partial-reducing every ``batch_size``
+    arrivals so coordinator memory holds at most one batch of partials
+    plus one accumulator. Buffered partial bytes charge the ``request``
+    breaker (released at each reduce); a trip raises out of
+    ``consume`` for the coordinator to fail the search — the
+    accumulator itself is bounded by the carrier contract (moments,
+    sketches, trimmed pages).
+
+    Telemetry: ``search.agg_reduce.partials`` / ``.batches`` counters
+    and a per-family ``search.agg_reduce.latency{family}`` histogram.
+    ``num_reduce_phases`` counts partial reduces + the final one (ES
+    response field semantics)."""
+
+    def __init__(self, spec: Dict[str, Any],
+                 batch_size: Optional[int] = None,
+                 breaker=None, metrics=None):
+        self.spec = spec
+        self.batch_size = max(2, int(batch_size
+                                     or DEFAULT_BATCHED_REDUCE_SIZE))
+        self.breaker = breaker
+        self.metrics = metrics
+        self.buffer: List[Dict[str, Any]] = []
+        # {} (not None): the per-family slice reduce below must merge
+        # name-by-name — a None accumulator would deep-copy the WHOLE
+        # first partial on the first slice and then re-merge its other
+        # names, double-counting them
+        self.acc: Dict[str, Any] = {}
+        self.partials_consumed = 0
+        self.num_reduce_phases = 0
+        self._charged = 0
+        self._finished = False
+
+    def consume(self, partial: Optional[Dict[str, Any]],
+                size_hint: Optional[int] = None) -> None:
+        """``size_hint`` lets the caller pre-size the partial OUTSIDE
+        its coordinator lock (payload_size_bytes re-serializes the
+        tree — O(partial bytes))."""
+        if partial is None or self._finished:
+            return
+        size = (size_hint if size_hint is not None
+                else payload_size_bytes(partial))
+        if self.breaker is not None:
+            # may raise CircuitBreakingException — the caller fails the
+            # search (the reference's consumer does the same)
+            self.breaker.add_estimate_bytes_and_maybe_break(
+                size, "agg_partials")
+        self._charged += size
+        self.buffer.append(partial)
+        self.partials_consumed += 1
+        if self.metrics is not None:
+            self.metrics.inc("search.agg_reduce.partials")
+        if len(self.buffer) >= self.batch_size:
+            self._reduce()
+
+    def _reduce(self) -> None:
+        if not self.buffer:
+            return
+        for name, node in (self.spec or {}).items():
+            agg_type, _body, _sub = A._split_node(name, node)
+            if agg_type in A.PIPELINE_AGGS \
+                    or agg_type in A.PARENT_PIPELINES:
+                continue
+            t0 = time.monotonic()
+            slice_spec = {name: node}
+            for p in self.buffer:
+                self.acc = merge_partials(slice_spec, self.acc, p)
+            if self.metrics is not None:
+                self.metrics.observe(
+                    "search.agg_reduce.latency",
+                    (time.monotonic() - t0) * 1000.0,
+                    family=agg_type)
+        self.buffer.clear()
+        self.num_reduce_phases += 1
+        if self.metrics is not None:
+            self.metrics.inc("search.agg_reduce.batches")
+        self._release()
+
+    def _release(self) -> None:
+        if self.breaker is not None and self._charged:
+            self.breaker.release(self._charged)
+        self._charged = 0
+
+    def finish(self) -> Tuple[Optional[Dict[str, Any]], int]:
+        """Final reduce of the remainder; returns (accumulator,
+        num_reduce_phases) with the final phase counted. Idempotent."""
+        if not self._finished:
+            self._reduce()
+            self.num_reduce_phases += 1   # the final (finalize) phase
+            self._release()
+            self._finished = True
+        return self.acc, self.num_reduce_phases
+
+    def close(self) -> None:
+        """Release any outstanding breaker charge without reducing —
+        the failure-path seam (a search completing with an error must
+        not leave buffered partial bytes charged for the process
+        lifetime). Idempotent; a normal finish() already released."""
+        self.buffer.clear()
+        self._release()
+        self._finished = True

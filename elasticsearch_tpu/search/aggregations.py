@@ -30,6 +30,7 @@ from elasticsearch_tpu.common.errors import (
     IllegalArgumentException,
     ParsingException,
 )
+from elasticsearch_tpu.search.sketches import DEFAULT_COMPRESSION, TDigest
 
 # A collect context: (segment, mask np.ndarray[bool n_docs], mapper)
 # triples covering every shard's segments — each segment carries ITS
@@ -57,14 +58,10 @@ PIPELINE_AGGS = {"avg_bucket", "sum_bucket", "min_bucket", "max_bucket",
                  "bucket_sort", "cumulative_cardinality"}
 
 
-def _scripted_metric(body: Dict[str, Any], ctx: CollectCtx):
-    """ref: metrics/ScriptedMetricAggregator — init/map per shard,
-    combine per shard, reduce across shards; scripts run the full
-    Painless engine (script/) with `state`, `states`, `params`, and a
-    per-doc `doc` binding over the segment's doc values."""
-    from elasticsearch_tpu.script.contexts import ContextShim
-    from elasticsearch_tpu.script.interp import (PainlessError,
-                                                 compile_painless)
+def _scripted_metric_scripts(body: Dict[str, Any]):
+    """Compile the four scripted_metric scripts (shared by the
+    in-process path and the distributed partial collector)."""
+    from elasticsearch_tpu.script.interp import compile_painless
 
     def src(key):
         s = body.get(key)
@@ -84,6 +81,20 @@ def _scripted_metric(body: Dict[str, Any], ctx: CollectCtx):
         if src("combine_script") else None
     reduce_s = compile_painless(src("reduce_script")) \
         if src("reduce_script") else None
+    return params, init_s, map_s, combine_s, reduce_s
+
+
+def scripted_metric_states(body: Dict[str, Any],
+                           ctx: CollectCtx) -> List[Any]:
+    """init/map per segment, combine per segment → the mergeable
+    per-shard states the reduce script consumes (the reference's
+    ScriptedMetricAggregator shard half). States must stay
+    JSON-serializable to cross the wire on the distributed path."""
+    from elasticsearch_tpu.script.contexts import ContextShim
+    from elasticsearch_tpu.script.interp import PainlessError
+
+    params, init_s, map_s, combine_s, _reduce_s = \
+        _scripted_metric_scripts(body)
 
     class _DocShim(ContextShim):
         def __init__(self, seg, d):
@@ -133,11 +144,27 @@ def _scripted_metric(body: Dict[str, Any], ctx: CollectCtx):
             map_s.execute({**base, "doc": _DocShim(seg, int(d))})
         states.append(combine_s.execute(base)
                       if combine_s is not None else state)
+    return states
+
+
+def scripted_metric_reduce(body: Dict[str, Any],
+                           states: List[Any]) -> Dict[str, Any]:
+    """The coordinator half: reduce script over all shards' states."""
+    params, _i, _m, _c, reduce_s = _scripted_metric_scripts(body)
     if reduce_s is not None:
         value = reduce_s.execute({"states": states, "params": params})
     else:
         value = states
     return {"value": value}
+
+
+def _scripted_metric(body: Dict[str, Any], ctx: CollectCtx):
+    """ref: metrics/ScriptedMetricAggregator — init/map per shard,
+    combine per shard, reduce across shards; scripts run the full
+    Painless engine (script/) with `state`, `states`, `params`, and a
+    per-doc `doc` binding over the segment's doc values."""
+    return scripted_metric_reduce(body,
+                                  scripted_metric_states(body, ctx))
 
 
 def compute_aggs(spec: Dict[str, Any], ctx: CollectCtx,
@@ -165,8 +192,11 @@ def _strip_internal(node) -> None:
         # named "_set" is a JSON value and passes through untouched
         if isinstance(node.get("_set"), set):
             del node["_set"]
-        # raw-sample carrier for moving_percentiles (an ndarray can
-        # never appear as a user JSON value)
+        # mergeable-sketch carrier for moving_percentiles (a TDigest
+        # instance can never appear as a user JSON value); "_values"
+        # covers plugin aggs still carrying the legacy raw sample
+        if isinstance(node.get("_digest"), TDigest):
+            del node["_digest"]
         if isinstance(node.get("_values"), np.ndarray):
             del node["_values"]
         for k, v in node.items():
@@ -252,6 +282,21 @@ def _first_values_and_mask(seg, mask, field):
 # above this many docs the terms collector rides the device (ord-major
 # permutation + cumsum, ops/aggs.py); below it a host bincount wins
 DEVICE_AGG_MIN_DOCS = 200_000
+
+# zero-count gap fill materializes one bucket per step — cap the span
+# so one sparse value pair (0 and 1e12 at interval 1) cannot OOM the
+# node outside any breaker's sight (ES: search.max_buckets /
+# too_many_buckets_exception; shared with the distributed reduce in
+# agg_partials.py)
+MAX_HISTOGRAM_BUCKETS = 65536
+
+
+def _check_bucket_cap(n: int, agg_type: str) -> None:
+    if n > MAX_HISTOGRAM_BUCKETS:
+        raise IllegalArgumentException(
+            f"[{agg_type}] would materialize [{n}] buckets "
+            f"(> [{MAX_HISTOGRAM_BUCKETS}]); narrow the range or "
+            "widen the interval")
 
 import contextvars  # noqa: E402
 
@@ -505,27 +550,18 @@ def _metric(agg_type, body, ctx, mapper):
 
     if agg_type == "median_absolute_deviation":
         # ref: x-pack/plugin/analytics MedianAbsoluteDeviationAggregator
-        vals = _numeric_values(ctx, field)
-        if len(vals) == 0:
-            return {"value": None}
-        med = np.median(vals)
-        return {"value": float(np.median(np.abs(vals - med)))}
+        # — reduced from a bounded-memory digest (exact while the sample
+        # fits the centroid budget, same as the reference's TDigest path)
+        digest = TDigest.from_values(_numeric_values(ctx, field),
+                                     _digest_compression(body))
+        return {"value": digest.mad()}
 
     if agg_type == "boxplot":
         # ref: x-pack/plugin/analytics BoxplotAggregator — five-number
-        # summary + 1.5·IQR whiskers clamped to real data points
-        vals = _numeric_values(ctx, field)
-        if len(vals) == 0:
-            return {"min": None, "max": None, "q1": None, "q2": None,
-                    "q3": None}
-        q1, q2, q3 = (float(np.percentile(vals, p)) for p in (25, 50, 75))
-        iqr = q3 - q1
-        lo, hi = q1 - 1.5 * iqr, q3 + 1.5 * iqr
-        within = vals[(vals >= lo) & (vals <= hi)]
-        return {"min": float(vals.min()), "max": float(vals.max()),
-                "q1": q1, "q2": q2, "q3": q3,
-                "lower": float(within.min()) if len(within) else q1,
-                "upper": float(within.max()) if len(within) else q3}
+        # summary + 1.5·IQR whiskers clamped to data points (the digest's
+        # representative points; exact below the centroid budget)
+        return shape_boxplot(TDigest.from_values(
+            _numeric_values(ctx, field), _digest_compression(body)))
 
     if agg_type == "top_metrics":
         # ref: x-pack/plugin/analytics TopMetricsAggregator — the metric
@@ -678,6 +714,20 @@ def _metric(agg_type, body, ctx, mapper):
             den += float(wv[m].sum())
         return {"value": num / den if den else None}
 
+    if missing_val is None and agg_type in (
+            "sum", "min", "max", "avg", "value_count", "stats"):
+        # device-side batched reduction: one fused launch per resident
+        # segment column (ops/aggs.py masked_metric_stats) when every
+        # contributing segment clears DEVICE_AGG_MIN_DOCS; None falls
+        # through to the exact host path unchanged. extended_stats is
+        # deliberately ABSENT: its variance = ss/n − avg² cancels
+        # catastrophically in the f32 sum-of-squares accumulation
+        # (values ~1e7 over 1M docs give std errors in the thousands
+        # where host f64 is exact) — it stays host-side
+        dev = _device_metric_stats(ctx, field)
+        if dev is not None:
+            return _shape_metric_from_stats(agg_type, dev)
+
     values = _numeric_values(ctx, field)
     if missing_val is not None:
         # count docs matched but missing the field as `missing` value
@@ -722,18 +772,317 @@ def _metric(agg_type, body, ctx, mapper):
                 "variance": var, "std_deviation": math.sqrt(var)}
     if agg_type == "percentiles":
         percents = body.get("percents", [1, 5, 25, 50, 75, 95, 99])
-        # "_values" carries the raw sample for moving_percentiles'
-        # window merge (the reference merges TDigest states; exact
-        # values are this engine's digest) — stripped before the
+        # "_digest" carries the mergeable sketch for moving_percentiles'
+        # window merge (the reference merges TDigest states; below the
+        # centroid budget the digest IS the exact sample, so quantiles
+        # are numpy's linear interpolation) — stripped before the
         # response leaves the agg layer (_strip_internal)
-        return {"values": {str(float(p)): float(np.percentile(values, p))
+        digest = TDigest.from_values(values, _digest_compression(body))
+        return {"values": {str(float(p)): digest.quantile(float(p))
                            for p in percents},
-                "_values": values}
+                "_digest": digest}
     if agg_type == "percentile_ranks":
         targets = body.get("values", [])
-        return {"values": {str(float(t)): float((values <= t).mean() * 100.0)
+        digest = TDigest.from_values(values, _digest_compression(body))
+        return {"values": {str(float(t)): digest.cdf(float(t)) * 100.0
                            for t in targets}}
     raise IllegalArgumentException(f"unhandled metric [{agg_type}]")
+
+
+def shape_boxplot(digest: TDigest) -> Dict[str, Any]:
+    """Boxplot response from a digest — ONE shaping for the in-process
+    metric and the distributed finalize (agg_partials.py), so the two
+    paths cannot drift."""
+    if digest.is_empty():
+        return {"min": None, "max": None, "q1": None, "q2": None,
+                "q3": None}
+    q1, q2, q3 = (digest.quantile(p) for p in (25, 50, 75))
+    iqr = q3 - q1
+    lo, hi = q1 - 1.5 * iqr, q3 + 1.5 * iqr
+    pts = digest.data_points()
+    within = pts[(pts >= lo) & (pts <= hi)]
+    return {"min": float(digest.min), "max": float(digest.max),
+            "q1": q1, "q2": q2, "q3": q3,
+            "lower": float(within.min()) if len(within) else q1,
+            "upper": float(within.max()) if len(within) else q3}
+
+
+def _digest_compression(body) -> int:
+    """Centroid budget for the percentile family (ES body shape:
+    ``{"tdigest": {"compression": N}}``)."""
+    td = body.get("tdigest") or {}
+    try:
+        return max(16, int(td.get("compression", DEFAULT_COMPRESSION)))
+    except (TypeError, ValueError):
+        raise ParsingException(
+            f"invalid tdigest compression [{td.get('compression')!r}]")
+
+
+def _shape_metric_from_stats(agg_type, stats):
+    """The response object of a simple numeric metric from its
+    (count, sum, min, max, sum_sq) moments — mirrors the host branch
+    shapes exactly (including the empty shapes)."""
+    n, s, mn, mx, ss = stats
+    if agg_type == "value_count":
+        return {"value": int(n)}
+    if n == 0:
+        if agg_type == "stats":
+            return {"count": 0, "min": None, "max": None, "avg": None,
+                    "sum": 0.0}
+        if agg_type == "extended_stats":
+            return {"count": 0, "min": None, "max": None, "avg": None,
+                    "sum": 0.0, "sum_of_squares": None, "variance": None,
+                    "std_deviation": None}
+        return {"value": None}
+    avg = s / n
+    if agg_type == "avg":
+        return {"value": avg}
+    if agg_type == "sum":
+        return {"value": s}
+    if agg_type == "min":
+        return {"value": mn}
+    if agg_type == "max":
+        return {"value": mx}
+    if agg_type == "stats":
+        return {"count": n, "min": mn, "max": mx, "avg": avg, "sum": s}
+    var = max(ss / n - avg * avg, 0.0)
+    return {"count": n, "min": mn, "max": mx, "avg": avg, "sum": s,
+            "sum_of_squares": ss, "variance": var,
+            "std_deviation": math.sqrt(var)}
+
+
+def _warn_device_once(which: str) -> None:
+    """Log a broken device agg path ONCE per process per site — it must
+    not silently run every query at host speed."""
+    flag = f"_dev_warned_{which}"
+    if not getattr(_warn_device_once, flag, False):
+        setattr(_warn_device_once, flag, True)
+        import logging
+        logging.getLogger("elasticsearch_tpu.aggs").exception(
+            "device %s reduction failed; using the host path", which)
+
+
+def _single_valued(nv, n_docs: int) -> bool:
+    """Whether a numeric doc-values column holds at most one value per
+    doc (the device columns carry FIRST values only). Cached on the
+    immutable column."""
+    cached = getattr(nv, "_single_valued", None)
+    if cached is None:
+        cached = bool(np.all(np.diff(nv.offsets) <= 1))
+        try:
+            nv._single_valued = cached
+        except Exception:  # noqa: BLE001 — slots/frozen columns
+            pass
+    return cached
+
+
+# device columns are f32: past 2^24 the mantissa can no longer hold
+# integers exactly, so sums over large-magnitude fields (epoch-ms
+# dates at ~1.7e12 are the canonical case) would silently drift by
+# minutes where the host f64 path is exact — such columns stay host
+F32_EXACT_MAX = float(2 ** 24)
+
+
+def _f32_exact(nv) -> bool:
+    """Whether a column's values survive the f32 device representation
+    (|v| ≤ 2^24). Cached on the immutable column."""
+    cached = getattr(nv, "_f32_exact", None)
+    if cached is None:
+        finite = nv.values[np.isfinite(nv.values)]
+        cached = bool(finite.size == 0
+                      or float(np.abs(finite).max()) <= F32_EXACT_MAX)
+        try:
+            nv._f32_exact = cached
+        except Exception:  # noqa: BLE001 — slots/frozen columns
+            pass
+    return cached
+
+
+def _device_metric_stats(ctx, field):
+    """Combined (count, sum, min, max, sum_sq) via one fused device
+    launch per segment — or None (host path) when the device shouldn't
+    or can't take it: no cache, a contributing segment below
+    DEVICE_AGG_MIN_DOCS, a multi-valued column (device columns are
+    first-value-only), or any device error."""
+    dev_cache = _DEVICE_CACHE.get()
+    if dev_cache is None or field is None:
+        return None
+    parts = []
+    try:
+        import jax
+
+        from elasticsearch_tpu.ops.aggs import masked_metric_stats
+        for seg, mask, _m in ctx:
+            nv = seg.numerics.get(field)
+            if nv is None:
+                continue
+            if seg.n_docs < DEVICE_AGG_MIN_DOCS \
+                    or not _single_valued(nv, seg.n_docs) \
+                    or not _f32_exact(nv):
+                return None
+            dev = dev_cache.get(seg)
+            dval = dev.numerics.get(field)
+            if dval is None:
+                return None
+            dmask = jax.device_put(
+                np.pad(mask[: seg.n_docs],
+                       (0, dev.n_docs_padded - seg.n_docs)),
+                device=dev._device)
+            parts.append(masked_metric_stats(
+                dval, dev.numeric_missing[field], dmask))
+    except Exception:  # noqa: BLE001 — host fallback
+        _warn_device_once("metric")
+        return None
+    if not parts:
+        return None
+    n = sum(p[0] for p in parts)
+    s = sum(p[1] for p in parts)
+    ss = sum(p[4] for p in parts)
+    mns = [p[2] for p in parts if p[2] is not None]
+    mxs = [p[3] for p in parts if p[3] is not None]
+    return (n, s, min(mns) if mns else None,
+            max(mxs) if mxs else None, ss)
+
+
+# sub-agg types the fused per-bucket device columns can serve —
+# extended_stats excluded (f32 sum-of-squares cancellation, see the
+# device metric dispatch note in _metric)
+DEVICE_METRIC_SUBAGGS = {"sum", "min", "max", "avg", "value_count",
+                         "stats"}
+
+
+def _device_histogram_submetrics(regular_sub):
+    """[(name, agg_type, field)] when EVERY sub-agg is a simple numeric
+    metric the fused per-bucket columns can serve; None otherwise."""
+    sub_metrics = []
+    for name, node in (regular_sub or {}).items():
+        types = [k for k in node
+                 if k not in ("aggs", "aggregations", "meta")]
+        if len(types) != 1:
+            return None
+        t = types[0]
+        b = node[t] or {}
+        if t not in DEVICE_METRIC_SUBAGGS \
+                or node.get("aggs") or node.get("aggregations") \
+                or b.get("missing") is not None or b.get("script"):
+            return None
+        sub_metrics.append((name, t, b.get("field")))
+    return sub_metrics
+
+
+def _device_histogram_buckets(ctx, field, interval, min_doc_count,
+                              gap_fill, key_of, is_date, regular_sub):
+    """Fixed-interval histogram via device scatter-add: per-bucket doc
+    counts plus every simple numeric metric sub-agg as fused per-bucket
+    columns — one launch per (segment, column) instead of one host
+    numpy pass per bucket. Returns the finished bucket list, or None
+    (exact host path) when ineligible: no device cache, a segment below
+    DEVICE_AGG_MIN_DOCS, a multi-valued column, a non-metric sub-agg,
+    a bucket span past AGG_BUCKET_CAP, or any device error."""
+    sub_metrics = _device_histogram_submetrics(regular_sub)
+    if sub_metrics is None:
+        return None
+    moments = _device_histogram_moments(ctx, field, interval,
+                                        sub_metrics)
+    if moments is None:
+        return None
+    lo, counts, mcols = moments
+    nb = len(counts)
+    buckets = []
+    for i in range(nb):
+        count = int(counts[i])
+        if (count == 0 and not gap_fill) or count < min_doc_count:
+            continue
+        key = key_of(lo + i)
+        b = {"key": key}
+        if is_date:
+            b["key_as_string"] = _ms_to_iso(key)
+        b["doc_count"] = count
+        for name, t, _f in sub_metrics:
+            acc = mcols[name]
+            c = int(acc[0][i])
+            b[name] = _shape_metric_from_stats(t, (
+                c, float(acc[1][i]),
+                float(acc[2][i]) if c else None,
+                float(acc[3][i]) if c else None,
+                float(acc[4][i])))
+        buckets.append(b)
+    return buckets
+
+
+def _device_histogram_moments(ctx, field, interval, sub_metrics):
+    """(lo_step, counts[nb], {name: [cnt, sum, min, max, sum_sq]
+    arrays}) via device scatter-add — or None for the host path."""
+    dev_cache = _DEVICE_CACHE.get()
+    if dev_cache is None or field is None:
+        return None
+    try:
+        import jax
+
+        from elasticsearch_tpu.ops.aggs import (
+            bucket_counts,
+            bucket_metric_columns,
+            pow2_buckets,
+        )
+        seg_rows = []
+        lo = hi = None
+        for seg, mask, _m in ctx:
+            nv = seg.numerics.get(field)
+            if nv is None:
+                continue
+            if seg.n_docs < DEVICE_AGG_MIN_DOCS:
+                return None
+            for _n, _t, mf in sub_metrics:
+                mnv = seg.numerics.get(mf)
+                if mnv is not None \
+                        and (not _single_valued(mnv, seg.n_docs)
+                             or not _f32_exact(mnv)):
+                    return None
+            m = mask[: seg.n_docs] & ~nv.missing
+            steps = np.floor(
+                np.nan_to_num(nv.values) / interval).astype(np.int64)
+            if m.any():
+                smin, smax = int(steps[m].min()), int(steps[m].max())
+                lo = smin if lo is None else min(lo, smin)
+                hi = smax if hi is None else max(hi, smax)
+            seg_rows.append((seg, m, steps))
+        if lo is None:
+            return None
+        nb = hi - lo + 1
+        if pow2_buckets(nb) == 0:
+            return None
+        counts = np.zeros(nb, np.int64)
+        mcols = {name: [np.zeros(nb, np.int64), np.zeros(nb),
+                        np.full(nb, np.inf), np.full(nb, -np.inf),
+                        np.zeros(nb)]
+                 for name, _t, _f in sub_metrics}
+        for seg, m, steps in seg_rows:
+            dev = dev_cache.get(seg)
+            pad = dev.n_docs_padded - seg.n_docs
+            dmask = jax.device_put(np.pad(m, (0, pad)),
+                                   device=dev._device)
+            ids = np.clip(steps - lo, 0, nb - 1).astype(np.int32)
+            dids = jax.device_put(np.pad(ids, (0, pad)),
+                                  device=dev._device)
+            counts += bucket_counts(dids, dmask, nb)
+            for name, _t, mf in sub_metrics:
+                dval = dev.numerics.get(mf)
+                if dval is None:
+                    continue
+                cnt, s, mn, mx, ss = bucket_metric_columns(
+                    dids, dmask, dval, dev.numeric_missing[mf], nb)
+                acc = mcols[name]
+                acc[0] += cnt
+                acc[1] += s
+                acc[2] = np.minimum(acc[2],
+                                    np.where(cnt > 0, mn, np.inf))
+                acc[3] = np.maximum(acc[3],
+                                    np.where(cnt > 0, mx, -np.inf))
+                acc[4] += ss
+    except Exception:  # noqa: BLE001 — host fallback
+        _warn_device_once("histogram")
+        return None
+    return lo, counts, mcols
 
 
 # ---------------------------------------------------------------------------
@@ -838,17 +1187,18 @@ def _apply_parent_pipelines(parents, buckets: List[Dict[str, Any]]):
         elif ptype == "moving_percentiles":
             # ref: x-pack/plugin/analytics/.../MovingPercentilesPipeline
             # Aggregator.java:31 — slide a window over a sibling
-            # percentiles metric, merging the windowed digests; this
-            # engine's digest is the exact sample ("_values" carrier on
-            # the percentiles result), so the merge is concatenation.
+            # percentiles metric, merging the windowed TDigest states
+            # ("_digest" carrier on the percentiles result; exact below
+            # the centroid budget, where the merge degenerates to
+            # concatenating the samples).
             window = int(body.get("window", 5))
             shift = int(body.get("shift", 0))
             metric = path.partition(".")[0].partition(">")[0]
-            samples = []
+            digests = []
             pcts = None
             for b in buckets:
                 node = b.get(metric) or {}
-                samples.append(node.get("_values"))
+                digests.append(node.get("_digest"))
                 if pcts is None and node.get("values"):
                     pcts = [float(p) for p in node["values"]]
             pcts = pcts or [1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0]
@@ -859,15 +1209,14 @@ def _apply_parent_pipelines(parents, buckets: List[Dict[str, Any]]):
                 # moving_fn branch above
                 lo = max(0, i - window + shift)
                 hi = max(lo, min(len(buckets), i + shift))
-                win = [s for s in samples[lo:hi]
-                       if s is not None and len(s)]
+                win = [d for d in digests[lo:hi]
+                       if d is not None and not d.is_empty()]
                 if not win:
                     b[name] = {"values": {}}
                     continue
-                merged = np.concatenate(win)
+                merged = TDigest.merge_all(win)
                 b[name] = {"values": {
-                    str(p): float(np.percentile(merged, p))
-                    for p in pcts}}
+                    str(p): merged.quantile(p) for p in pcts}}
         elif ptype == "normalize":
             # ref: x-pack/plugin/analytics/.../normalize/
             # NormalizePipelineAggregationBuilder — rescale a bucket
@@ -1796,6 +2145,24 @@ def _bucket(agg_type, body, sub, ctx, mapper):
 
             def key_of(step):
                 return step * interval
+        regular_sub, parent_pipes = (_split_parent_pipelines(sub)
+                                     if sub else ({}, {}))
+        if cal_unit is None:
+            # device-side batched bucketing (ops/aggs.py scatter-add):
+            # bucket-id arithmetic stays host f64-exact, the reduction
+            # — counts AND the per-bucket sub-metric columns — runs in
+            # one launch per (segment, column). Fixed intervals only
+            # (calendar steps are epoch-ms keys, not a dense id space);
+            # None falls through to the exact host path unchanged.
+            dev_buckets = _device_histogram_buckets(
+                ctx, field, interval, min_doc_count,
+                gap_fill=(body.get("extended_bounds") is None
+                          and min_doc_count == 0),
+                key_of=key_of, is_date=(agg_type == "date_histogram"),
+                regular_sub=regular_sub)
+            if dev_buckets is not None:
+                _apply_parent_pipelines(parent_pipes, dev_buckets)
+                return {"buckets": dev_buckets}
         step_counts: Dict[int, int] = {}
         for seg, mask, _m in ctx:
             vv, m = _first_values_and_mask(seg, mask, field)
@@ -1807,16 +2174,19 @@ def _bucket(agg_type, body, sub, ctx, mapper):
         buckets = []
         all_steps = sorted(step_counts)
         if all_steps and body.get("extended_bounds") is None and min_doc_count == 0:
-            # fill gaps between min and max (ES default for histograms)
+            # fill gaps between min and max (ES default for histograms),
+            # capped — a sparse value pair must not OOM the node
             if cal_unit is not None:
                 filled, cur = [], all_steps[0]
                 while cur <= all_steps[-1]:
                     filled.append(cur)
+                    _check_bucket_cap(len(filled), agg_type)
                     cur = _calendar_next_ms(cur, cal_unit)
                 all_steps = filled
             else:
+                _check_bucket_cap(all_steps[-1] - all_steps[0] + 1,
+                                  agg_type)
                 all_steps = list(range(all_steps[0], all_steps[-1] + 1))
-        regular_sub = _split_parent_pipelines(sub)[0] if sub else {}
         for step in all_steps:
             count = step_counts.get(step, 0)
             if count < min_doc_count:
@@ -1839,7 +2209,7 @@ def _bucket(agg_type, body, sub, ctx, mapper):
             else:
                 bucket_ctx = ctx
             buckets.append(_bucket_result(sub, bucket_ctx, mapper, count, extra))
-        _apply_parent_pipelines(_split_parent_pipelines(sub)[1], buckets)
+        _apply_parent_pipelines(parent_pipes, buckets)
         return {"buckets": buckets}
 
     if agg_type == "range":
@@ -2265,12 +2635,17 @@ def _compute_pipeline(agg_type, body, results):
                     "upper": mean + sigma * std,
                     "lower": mean - sigma * std}}
     if agg_type == "percentiles_bucket":
-        # ref: pipeline/PercentilesBucketPipelineAggregator — returns
-        # the NEAREST input data point (no interpolation), keys in the
-        # same "50.0" format as the percentiles metric agg
+        # ONE percentile semantics engine-wide: linear interpolation,
+        # the same estimator the `percentiles` metric (and its digest's
+        # exact mode) uses. The reference's PercentilesBucket returns
+        # the nearest input point instead — this engine deliberately
+        # diverges so a percentile over bucket metrics and a percentile
+        # over doc values can never disagree on identical series
+        # (pinned by test_percentile_interpolation_consistency; see
+        # COMPONENTS.md "Distributed aggregations").
         pcts = body.get("percents") or [1.0, 5.0, 25.0, 50.0, 75.0,
                                         95.0, 99.0]
         arr = np.asarray(values, float)
-        return {"values": {str(float(p)): float(
-            np.percentile(arr, p, method="nearest")) for p in pcts}}
+        return {"values": {str(float(p)): float(np.percentile(arr, p))
+                           for p in pcts}}
     raise IllegalArgumentException(f"unhandled pipeline agg [{agg_type}]")

@@ -1094,8 +1094,21 @@ class SearchService:
             cache = searchers[0][1].cache
             # empty index still yields empty/null agg results (never a
             # missing "aggregations" key)
+            t_agg = time.monotonic()
             aggregations = compute_aggs(aggs_spec, agg_ctx, default_mapper,
                                         cache)
+            if self.telemetry is not None:
+                # the same search.agg_reduce.* surface the distributed
+                # coordinator feeds (search/agg_partials.py consumer) —
+                # in-process shards reduce as ONE batch, family "_all"
+                # (the tree computes in one pass here; true per-family
+                # latencies come from the coordinator's consumer)
+                m = self.telemetry.metrics
+                m.inc("search.agg_reduce.partials", len(shard_results))
+                m.inc("search.agg_reduce.batches")
+                m.observe("search.agg_reduce.latency",
+                          (time.monotonic() - t_agg) * 1000.0,
+                          family="_all")
 
         # ---- suggest phase (ref: SuggestPhase, search/suggest/)
         suggest = None

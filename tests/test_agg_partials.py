@@ -1,0 +1,582 @@
+"""Shard-invariance property suite for mergeable aggregation partials
+(search/agg_partials.py): merge-of-N-shard-partials must equal the
+single-node result on a seeded corpus across RANDOM shard splits, for
+every supported agg type — the InternalAggregationTestCase
+reduce-correctness discipline, chaos-seeded so any red run replays
+with ``--chaos-seed=N``.
+
+Also pins: the digest error bound above the centroid budget, the
+incremental consumer's batching/breaker/metrics contract, composite's
+truncated-page exactness, the typed rejection of unsupported agg
+types, and the device kernel parity of ops/aggs.py (thresholds forced
+to zero so the scatter/fused paths run under CPU jax).
+"""
+
+import copy
+import json
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+from elasticsearch_tpu.index.service import IndicesService
+from elasticsearch_tpu.search import agg_partials as AP
+from elasticsearch_tpu.search import aggregations as A
+from elasticsearch_tpu.search.service import SearchService
+from elasticsearch_tpu.search.sketches import TDigest
+
+MAPPINGS = {"properties": {
+    "category": {"type": "keyword"},
+    "price": {"type": "double"},
+    "qty": {"type": "long"},
+    "sold_at": {"type": "date"},
+}}
+
+
+def make_docs(rng, n=150):
+    cats = ["alpha", "beta", "gamma", "delta", "epsilon"]
+    docs = []
+    for i in range(n):
+        d = {"category": cats[int(rng.integers(0, len(cats)))],
+             "sold_at": f"2021-03-{int(rng.integers(1, 28)):02d}"}
+        if rng.random() > 0.1:          # ~10% missing price
+            d["price"] = float(np.round(rng.uniform(1, 100), 2))
+        if rng.random() > 0.2:
+            d["qty"] = int(rng.integers(1, 50))
+        docs.append(d)
+    return docs
+
+
+def build_split(tmp_path, docs, assign, n_shards):
+    """One single-shard 'truth' index plus n_shards disjoint 'shard'
+    indices holding the same docs split by ``assign``."""
+    indices = IndicesService(str(tmp_path / "data"))
+    full = indices.create_index("full", {"index.number_of_shards": 1},
+                                MAPPINGS)
+    shards = [indices.create_index(f"s{i}",
+                                   {"index.number_of_shards": 1},
+                                   MAPPINGS)
+              for i in range(n_shards)]
+    for i, d in enumerate(docs):
+        full.index_doc(str(i), d)
+        shards[assign[i]].index_doc(str(i), d)
+    full.refresh()
+    for s in shards:
+        s.refresh()
+    return indices
+
+
+def shard_partials(indices, spec, n_shards):
+    out = []
+    for i in range(n_shards):
+        index = indices.get(f"s{i}")
+        ctx = []
+        for s in index.shard_searchers():
+            for seg in s.segments:
+                ctx.append((seg, seg.live.copy(), index.mapper))
+        out.append(AP.collect_partials(spec, ctx, index.mapper,
+                                       index.device_cache))
+    return out
+
+
+# the full supported distributed surface, sub-aggs and pipelines
+# included (metric + bucket + sibling pipeline + parent pipeline)
+FULL_SPEC = {
+    "by_cat": {"terms": {"field": "category"},
+               "aggs": {"avg_p": {"avg": {"field": "price"}},
+                        "pct": {"percentiles": {
+                            "field": "price", "percents": [50.0]}},
+                        "cum": {"cumulative_sum": {
+                            "buckets_path": "avg_p"}}}},
+    "rare": {"rare_terms": {"field": "category", "max_doc_count": 100}},
+    "days": {"date_histogram": {"field": "sold_at",
+                                "calendar_interval": "day"},
+             "aggs": {"rev": {"sum": {"field": "price"}},
+                      "card": {"cardinality": {"field": "category"}},
+                      "cumcard": {"cumulative_cardinality": {
+                          "buckets_path": "card"}},
+                      "deriv": {"derivative": {"buckets_path": "rev"}},
+                      "pp": {"percentiles": {"field": "price",
+                                             "percents": [50.0]}},
+                      "movp": {"moving_percentiles": {
+                          "buckets_path": "pp", "window": 3}}}},
+    "hist": {"histogram": {"field": "price", "interval": 20.0},
+             "aggs": {"st": {"stats": {"field": "qty"}},
+                      "est": {"extended_stats": {"field": "qty"}}}},
+    "pct_all": {"percentiles": {"field": "price",
+                                "percents": [5.0, 50.0, 95.0]}},
+    "ranks": {"percentile_ranks": {"field": "price",
+                                   "values": [25.0, 75.0]}},
+    "card": {"cardinality": {"field": "category"}},
+    "est": {"extended_stats": {"field": "price"}},
+    "vc": {"value_count": {"field": "qty"}},
+    "mn": {"min": {"field": "price"}},
+    "mx": {"max": {"field": "price"}},
+    "s": {"sum": {"field": "qty"}},
+    "avg_missing": {"avg": {"field": "price", "missing": 0.0}},
+    "w": {"weighted_avg": {"value": {"field": "price"},
+                           "weight": {"field": "qty"}}},
+    "mad": {"median_absolute_deviation": {"field": "price"}},
+    "box": {"boxplot": {"field": "price"}},
+    "rng": {"range": {"field": "price",
+                      "ranges": [{"to": 30.0}, {"from": 30.0}]},
+            "aggs": {"m": {"max": {"field": "qty"}}}},
+    "dr": {"date_range": {"field": "sold_at", "ranges": [
+        {"from": 1614556800000}, {"to": 1614556800000}]}},
+    "comp": {"composite": {"size": 6, "sources": [
+        {"cat": {"terms": {"field": "category"}}},
+        {"p": {"histogram": {"field": "price", "interval": 50.0}}}],
+    }, "aggs": {"m": {"min": {"field": "qty"}}}},
+    "top": {"top_hits": {"size": 3,
+                         "sort": [{"price": {"order": "desc"}}]}},
+    "glob": {"global": {}, "aggs": {"n": {"value_count": {
+        "field": "qty"}}}},
+    "miss": {"missing": {"field": "qty"}},
+    "flt": {"filter": {"term": {"category": "alpha"}},
+            "aggs": {"mx": {"max": {"field": "price"}}}},
+    "flts": {"filters": {"filters": {
+        "big": {"range": {"price": {"gte": 50}}},
+        "small": {"range": {"price": {"lt": 50}}}}}},
+    "scripted": {"scripted_metric": {
+        "init_script": "state.n = 0;",
+        "map_script": "state.n += 1;",
+        "combine_script": "return state.n;",
+        "reduce_script":
+            "double t = 0; for (def s : states) { t += s } return t;"}},
+    "avg_of_avg": {"avg_bucket": {"buckets_path": "by_cat>avg_p"}},
+    "pb": {"percentiles_bucket": {"buckets_path": "days>rev",
+                                  "percents": [50.0]}},
+}
+
+
+def assert_agg_equal(a, b, path="", rel=1e-9):
+    """Structural equality with float tolerance (merge order only moves
+    float-summation rounding)."""
+    if isinstance(a, dict) and isinstance(b, dict):
+        assert set(a) == set(b), f"{path}: keys {set(a) ^ set(b)}"
+        for k in a:
+            assert_agg_equal(a[k], b[k], f"{path}.{k}", rel)
+    elif isinstance(a, list) and isinstance(b, list):
+        assert len(a) == len(b), f"{path}: {len(a)} != {len(b)}"
+        for i, (x, y) in enumerate(zip(a, b)):
+            assert_agg_equal(x, y, f"{path}[{i}]", rel)
+    elif isinstance(a, float) or isinstance(b, float):
+        assert a is not None and b is not None, f"{path}: {a} vs {b}"
+        assert abs(a - b) <= rel * max(1.0, abs(a)), \
+            f"{path}: {a} != {b}"
+    else:
+        assert a == b, f"{path}: {a!r} != {b!r}"
+
+
+@pytest.mark.chaos(seed=101)
+@pytest.mark.parametrize("case", range(3))
+def test_shard_invariance_every_supported_type(tmp_path, chaos_seed,
+                                               case):
+    """merge(collect(shard_i)) == single-node for the ENTIRE supported
+    agg surface, across a random split, random merge order, and a
+    random reduce batch size."""
+    rng = np.random.default_rng(chaos_seed + 1000 * case)
+    docs = make_docs(rng)
+    n_shards = int(rng.integers(2, 5))
+    assign = rng.integers(0, n_shards, len(docs))
+    indices = build_split(tmp_path, docs, assign, n_shards)
+    try:
+        svc = SearchService(indices)
+        single = svc.search("full",
+                            {"size": 0, "aggs": FULL_SPEC})["aggregations"]
+        parts = shard_partials(indices, FULL_SPEC, n_shards)
+        for p in parts:
+            json.dumps(p)        # the wire contract: pure JSON
+        order = rng.permutation(n_shards)
+        cons = AP.AggReduceConsumer(FULL_SPEC,
+                                    batch_size=int(rng.integers(2, 4)))
+        for i in order:
+            cons.consume(copy.deepcopy(parts[i]))
+        acc, phases = cons.finish()
+        out = AP.strip_internal(AP.finalize_partials(FULL_SPEC, acc))
+        assert phases >= 1
+        assert_agg_equal(single, out, path=f"seed={chaos_seed}")
+    finally:
+        indices.close()
+
+
+@pytest.mark.chaos(seed=77)
+def test_digest_error_bound_above_budget(chaos_seed):
+    """Above the centroid budget the merged sketch is approximate with
+    the documented bound: quantile error ≤ ~1% of rank (q-space) at
+    compression 256, for any shard split."""
+    rng = np.random.default_rng(chaos_seed)
+    values = np.concatenate([rng.normal(0, 1, 20_000),
+                             rng.exponential(5, 20_000)])
+    parts = np.array_split(rng.permutation(values), 7)
+    merged = TDigest.merge_all([TDigest.from_values(p) for p in parts])
+    assert merged.means.size <= merged.compression
+    for q in (1, 5, 25, 50, 75, 95, 99):
+        est = merged.quantile(q)
+        q_err = abs(float((values <= est).mean()) * 100.0 - q)
+        assert q_err < 1.0, f"seed={chaos_seed}: q={q} err={q_err}"
+    # wire form round-trips bit-exact
+    clone = TDigest.from_wire(merged.to_wire())
+    assert clone.quantile(50) == merged.quantile(50)
+
+
+def test_consumer_batching_breaker_and_metrics():
+    """The QueryPhaseResultConsumer contract: reduce every batch_size
+    arrivals (memory ≤ one batch + accumulator), charge buffered bytes
+    to the request breaker and release them at each reduce, surface
+    search.agg_reduce.* metrics, count the final phase."""
+    from elasticsearch_tpu.telemetry import MetricsRegistry
+    from elasticsearch_tpu.utils.breaker import CircuitBreaker
+    spec = {"s": {"sum": {"field": "x"}}}
+    parts = [{"s": {"n": 1, "s": float(i), "mn": float(i),
+                    "mx": float(i), "ss": float(i * i)}}
+             for i in range(7)]
+    breaker = CircuitBreaker("request", limit_bytes=10_000)
+    metrics = MetricsRegistry()
+    cons = AP.AggReduceConsumer(spec, batch_size=3, breaker=breaker,
+                                metrics=metrics)
+    for p in parts:
+        cons.consume(p)
+    # 7 partials → two full batches reduced, one remainder buffered
+    assert cons.num_reduce_phases == 2
+    assert len(cons.buffer) == 1
+    assert breaker.used > 0          # the buffered remainder is charged
+    acc, phases = cons.finish()
+    assert phases == 4               # 2 partial + 1 remainder + 1 final
+    assert breaker.used == 0         # everything released
+    out = AP.finalize_partials(spec, acc)
+    assert out["s"]["value"] == pytest.approx(sum(range(7)))
+    m = metrics.to_dict()
+    assert m["search.agg_reduce.partials"]["value"] == 7
+    assert m["search.agg_reduce.batches"]["value"] == 3
+    assert any(k.startswith("search.agg_reduce.latency") for k in m)
+
+    # a breaker too small to buffer one partial trips out of consume
+    tiny = CircuitBreaker("request", limit_bytes=8)
+    cons2 = AP.AggReduceConsumer(spec, batch_size=3, breaker=tiny)
+    with pytest.raises(Exception) as ei:
+        cons2.consume(parts[0])
+    assert "circuit" in type(ei.value).__name__.lower() \
+        or "breaking" in str(ei.value).lower()
+
+    # failure-path seam: close() releases buffered charge WITHOUT a
+    # reduce (a search completing with an error must never leave
+    # partial bytes charged for the process lifetime), idempotently
+    b3 = CircuitBreaker("request", limit_bytes=10_000)
+    cons3 = AP.AggReduceConsumer(spec, batch_size=10, breaker=b3)
+    cons3.consume(parts[0])
+    cons3.consume(parts[1])
+    assert b3.used > 0
+    cons3.close()
+    assert b3.used == 0
+    cons3.close()                      # idempotent
+    cons3.consume(parts[2])            # finished: dropped, not charged
+    assert b3.used == 0
+
+
+def test_check_distributed_support_rejects_typed():
+    AP.check_distributed_support(FULL_SPEC)     # whole surface passes
+    with pytest.raises(IllegalArgumentException) as ei:
+        AP.check_distributed_support(
+            {"sig": {"significant_terms": {"field": "category"}}})
+    assert "distributed" in str(ei.value)
+    with pytest.raises(IllegalArgumentException):
+        AP.check_distributed_support(
+            {"ok": {"terms": {"field": "category"},
+                    "aggs": {"bad": {"sampler": {}}}}})
+
+
+@pytest.mark.chaos(seed=202)
+def test_composite_truncated_paging_stays_exact(tmp_path, chaos_seed):
+    """Exact paging under shard truncation: with page sizes smaller
+    than the shard key space, walking the distributed composite via
+    after_key visits exactly the single-node key sequence with exact
+    doc counts (the reduce never emits a key past a truncated shard's
+    last reported key)."""
+    rng = np.random.default_rng(chaos_seed)
+    docs = make_docs(rng, n=120)
+    n_shards = 3
+    assign = rng.integers(0, n_shards, len(docs))
+    indices = build_split(tmp_path, docs, assign, n_shards)
+    try:
+        svc = SearchService(indices)
+        base = {"composite": {"size": 3, "sources": [
+            {"cat": {"terms": {"field": "category"}}},
+            {"p": {"histogram": {"field": "price", "interval": 10.0}}}]}}
+        single_pages = []
+        after = None
+        while True:
+            spec = copy.deepcopy(base)
+            if after is not None:
+                spec["composite"]["after"] = after
+            r = svc.search("full", {"size": 0,
+                                    "aggs": {"c": spec}})["aggregations"]
+            buckets = r["c"]["buckets"]
+            if not buckets:
+                break
+            single_pages.extend(
+                (json.dumps(b["key"], sort_keys=True), b["doc_count"])
+                for b in buckets)
+            after = r["c"].get("after_key")
+            if after is None:
+                break
+        dist_pages = []
+        after = None
+        for _ in range(200):        # bounded: every page must advance
+            spec = copy.deepcopy(base)
+            if after is not None:
+                spec["composite"]["after"] = after
+            parts = shard_partials(indices, {"c": spec}, n_shards)
+            acc = None
+            for p in parts:
+                acc = AP.merge_partials({"c": spec}, acc, p)
+            out = AP.finalize_partials({"c": spec}, acc)
+            buckets = out["c"]["buckets"]
+            if not buckets:
+                break
+            dist_pages.extend(
+                (json.dumps(b["key"], sort_keys=True), b["doc_count"])
+                for b in buckets)
+            after = out["c"].get("after_key")
+            if after is None:
+                break
+        assert dist_pages == single_pages, f"seed={chaos_seed}"
+    finally:
+        indices.close()
+
+
+@pytest.mark.chaos(seed=303)
+def test_terms_shard_size_trim_error_accounting(tmp_path, chaos_seed):
+    """An explicit shard_size trims shard partials with ES error
+    accounting: counts may undercount by at most
+    doc_count_error_upper_bound, and sum_other_doc_count absorbs the
+    dropped mass."""
+    rng = np.random.default_rng(chaos_seed)
+    docs = make_docs(rng, n=200)
+    n_shards = 4
+    assign = rng.integers(0, n_shards, len(docs))
+    indices = build_split(tmp_path, docs, assign, n_shards)
+    try:
+        svc = SearchService(indices)
+        spec = {"t": {"terms": {"field": "category", "size": 2,
+                                "shard_size": 2}}}
+        truth = svc.search(
+            "full", {"size": 0, "aggs": {
+                "t": {"terms": {"field": "category",
+                                "size": 2}}}})["aggregations"]
+        parts = shard_partials(indices, spec, n_shards)
+        acc = None
+        for p in parts:
+            acc = AP.merge_partials(spec, acc, p)
+        out = AP.finalize_partials(spec, acc)
+        err = out["t"]["doc_count_error_upper_bound"]
+        assert err >= 0
+        truth_counts = {b["key"]: b["doc_count"]
+                        for b in truth["t"]["buckets"]}
+        for b in out["t"]["buckets"]:
+            true_c = truth_counts.get(b["key"])
+            if true_c is not None:
+                assert b["doc_count"] <= true_c \
+                    and b["doc_count"] >= true_c - err, \
+                    f"seed={chaos_seed}: {b} vs {true_c} (err {err})"
+        # total mass is conserved: buckets + other == all counted docs
+        total = sum(b["doc_count"] for b in out["t"]["buckets"]) \
+            + out["t"]["sum_other_doc_count"]
+        assert total == sum(1 for d in docs if "category" in d)
+    finally:
+        indices.close()
+
+
+@pytest.mark.chaos(seed=404)
+def test_device_kernel_parity_forced(tmp_path, chaos_seed,
+                                     monkeypatch):
+    """Force DEVICE_AGG_MIN_DOCS to 0 so the device metric/histogram
+    kernels (ops/aggs.py masked_metric_stats / bucket scatter-add)
+    actually dispatch under CPU jax — results must match the exact
+    host path within f32 tolerance (counts/min/max exact)."""
+    rng = np.random.default_rng(chaos_seed)
+    docs = make_docs(rng, n=150)
+    indices = build_split(tmp_path, docs, np.zeros(len(docs), int), 1)
+    try:
+        svc = SearchService(indices)
+        spec = {
+            "st": {"stats": {"field": "price"}},
+            "est": {"extended_stats": {"field": "price"}},
+            "hist": {"histogram": {"field": "price", "interval": 10.0},
+                     "aggs": {"q": {"stats": {"field": "qty"}}}},
+        }
+        host = svc.search("full", {"size": 0,
+                                   "aggs": spec})["aggregations"]
+        monkeypatch.setattr(A, "DEVICE_AGG_MIN_DOCS", 0)
+        index = indices.get("full")
+        ctx = []
+        for s in index.shard_searchers():
+            for seg in s.segments:
+                ctx.append((seg, seg.live.copy(), index.mapper))
+        dev = A.compute_aggs(spec, ctx, index.mapper,
+                             index.device_cache)
+        # counts and extrema are exact on device; sums ride f32
+        assert dev["st"]["count"] == host["st"]["count"]
+        assert dev["st"]["min"] == pytest.approx(host["st"]["min"],
+                                                 rel=1e-6)
+        assert dev["st"]["max"] == pytest.approx(host["st"]["max"],
+                                                 rel=1e-6)
+        assert dev["st"]["sum"] == pytest.approx(host["st"]["sum"],
+                                                 rel=1e-4)
+        assert dev["est"]["variance"] == pytest.approx(
+            host["est"]["variance"], rel=1e-3)
+        hb, db = host["hist"]["buckets"], dev["hist"]["buckets"]
+        assert [(b["key"], b["doc_count"]) for b in hb] == \
+               [(b["key"], b["doc_count"]) for b in db]
+        for b1, b2 in zip(hb, db):
+            assert b2["q"]["count"] == b1["q"]["count"]
+            if b1["q"]["count"]:
+                assert b2["q"]["sum"] == pytest.approx(b1["q"]["sum"],
+                                                       rel=1e-4)
+    finally:
+        indices.close()
+
+
+def test_host_fallback_formulas_pinned(tmp_path):
+    """Below DEVICE_AGG_MIN_DOCS the host path runs the pre-existing
+    numpy formulas bit-for-bit: pin them against direct numpy over the
+    corpus (the device dispatch must never leak into small segments)."""
+    rng = np.random.default_rng(5)
+    docs = make_docs(rng, n=80)
+    indices = build_split(tmp_path, docs, np.zeros(len(docs), int), 1)
+    try:
+        svc = SearchService(indices)
+        out = svc.search("full", {"size": 0, "aggs": {
+            "st": {"stats": {"field": "price"}},
+            "pct": {"percentiles": {"field": "price",
+                                    "percents": [50.0]}},
+        }})["aggregations"]
+        prices = np.asarray([d["price"] for d in docs
+                             if "price" in d])
+        assert out["st"]["sum"] == float(prices.sum())        # exact
+        assert out["st"]["avg"] == float(prices.mean())       # exact
+        assert out["st"]["min"] == float(prices.min())
+        assert out["st"]["max"] == float(prices.max())
+        assert out["pct"]["values"]["50.0"] == \
+            float(np.percentile(prices, 50.0))                # exact
+    finally:
+        indices.close()
+
+
+def test_agg_reduce_metrics_surface_in_nodes_stats(tmp_path):
+    """The search.agg_reduce.* counters/histograms appear in the
+    telemetry section of GET /_nodes/stats after a search with aggs
+    (single-node: one batch, family "_all"; the distributed consumer
+    feeds the same names per family)."""
+    from elasticsearch_tpu.node import Node
+    node = Node(data_path=str(tmp_path / "n1"))
+    try:
+        rc = node.rest_controller
+        status, _ = rc.dispatch("PUT", "/shop", {}, {
+            "mappings": {"properties": {
+                "category": {"type": "keyword"},
+                "price": {"type": "double"}}}})
+        assert status < 400
+        for i, (c, p) in enumerate([("a", 1.0), ("b", 2.0),
+                                    ("a", 3.0)]):
+            status, _ = rc.dispatch(
+                "PUT", f"/shop/_doc/{i}", {},
+                {"category": c, "price": p})
+            assert status < 400
+        rc.dispatch("POST", "/shop/_refresh", {}, None)
+        status, resp = rc.dispatch("POST", "/shop/_search", {}, {
+            "size": 0, "aggs": {
+                "cats": {"terms": {"field": "category"}},
+                "avg": {"avg": {"field": "price"}}}})
+        assert status < 400 and "aggregations" in resp
+        status, stats = rc.dispatch("GET", "/_nodes/stats", {}, None)
+        assert status < 400
+        (node_stats,), = [list(stats["nodes"].values())]
+        metrics = node_stats["telemetry"]["metrics"]
+        assert metrics["search.agg_reduce.partials"]["value"] >= 1
+        assert metrics["search.agg_reduce.batches"]["value"] >= 1
+        assert any(k.startswith("search.agg_reduce.latency")
+                   for k in metrics)
+    finally:
+        node.close()
+
+
+def test_empty_value_source_shapes_match_single_node(tmp_path):
+    """A query matching nothing must produce the SAME response shapes
+    on both paths (review fix: distributed empty percentiles returned
+    null-filled values where single-node returns {})."""
+    rng = np.random.default_rng(9)
+    docs = make_docs(rng, n=40)
+    n_shards = 2
+    assign = rng.integers(0, n_shards, len(docs))
+    indices = build_split(tmp_path, docs, assign, n_shards)
+    try:
+        svc = SearchService(indices)
+        spec = {
+            "pct": {"percentiles": {"field": "price"}},
+            "ranks": {"percentile_ranks": {"field": "price",
+                                           "values": [5.0]}},
+            "mad": {"median_absolute_deviation": {"field": "price"}},
+            "box": {"boxplot": {"field": "price"}},
+            "st": {"stats": {"field": "price"}},
+            "est": {"extended_stats": {"field": "price"}},
+            "s": {"sum": {"field": "price"}},
+        }
+        single = svc.search("full", {
+            "size": 0, "query": {"term": {"category": "nope"}},
+            "aggs": spec})["aggregations"]
+        parts = []
+        for i in range(n_shards):
+            index = indices.get(f"s{i}")
+            ctx = []
+            for s in index.shard_searchers():
+                for seg in s.segments:
+                    ctx.append((seg, np.zeros(seg.n_docs, bool),
+                                index.mapper))
+            parts.append(AP.collect_partials(spec, ctx, index.mapper))
+        acc = None
+        for p in parts:
+            acc = AP.merge_partials(spec, acc, p)
+        out = AP.strip_internal(AP.finalize_partials(spec, acc))
+        assert_agg_equal(single, out)
+    finally:
+        indices.close()
+
+
+def test_mixed_keyword_numeric_terms_merge_never_crashes():
+    """Multi-index mapping skew: field `f` keyword on one shard,
+    numeric on another. The merged terms must render without float()
+    crashing on keyword keys (review fix)."""
+    spec = {"t": {"terms": {"field": "f"}}}
+    kw = {"t": {"numeric": False,
+                "terms": {"apple": {"c": 3}, "pear": {"c": 1}},
+                "other": 0, "err": 0}}
+    num = {"t": {"numeric": True,
+                 "terms": {"7.0": {"c": 2}}, "other": 0, "err": 0}}
+    acc = AP.merge_partials(spec, None, kw)
+    acc = AP.merge_partials(spec, acc, num)
+    out = AP.finalize_partials(spec, acc)
+    keys = [b["key"] for b in out["t"]["buckets"]]
+    assert "apple" in keys and 7 in keys
+    counts = {b["key"]: b["doc_count"] for b in out["t"]["buckets"]}
+    assert counts["apple"] == 3 and counts[7] == 2
+
+
+def test_histogram_gap_fill_bucket_cap_both_paths(tmp_path):
+    """One sparse value pair must raise a typed too-many-buckets error
+    instead of materializing a 10^10-element gap fill — on the
+    single-node path AND the distributed finalize (review fix)."""
+    docs = [{"price": 0.0}, {"price": 1e10}]
+    indices = build_split(tmp_path, docs, np.zeros(2, int), 1)
+    try:
+        svc = SearchService(indices)
+        spec = {"h": {"histogram": {"field": "price", "interval": 1.0}}}
+        with pytest.raises(IllegalArgumentException) as ei:
+            svc.search("full", {"size": 0, "aggs": spec})
+        assert "buckets" in str(ei.value)
+        parts = shard_partials(indices, spec, 1)
+        acc = AP.merge_partials(spec, None, parts[0])
+        with pytest.raises(IllegalArgumentException):
+            AP.finalize_partials(spec, acc)
+    finally:
+        indices.close()

@@ -697,10 +697,13 @@ def test_percentiles_and_extended_stats_bucket(search):
         "p": {"percentiles_bucket": {"buckets_path": "days>rev",
                                      "percents": [50.0, 75.0, 100.0]}},
         "es": {"extended_stats_bucket": {"buckets_path": "days>rev"}}})
-    # daily revenues: 3, 7, 15 — nearest-data-point semantics (ref:
-    # PercentilesBucket does not interpolate), keys like the metric agg
+    # daily revenues: 3, 7, 15 — ONE percentile semantics engine-wide:
+    # linear interpolation, the same estimator the `percentiles` metric
+    # uses (the reference's PercentilesBucket returns nearest instead;
+    # this engine deliberately unifies — COMPONENTS.md "Distributed
+    # aggregations"), keys like the metric agg
     assert a["p"]["values"]["50.0"] == pytest.approx(7.0)
-    assert a["p"]["values"]["75.0"] == pytest.approx(15.0)   # nearest
+    assert a["p"]["values"]["75.0"] == pytest.approx(11.0)   # linear
     assert a["p"]["values"]["100.0"] == pytest.approx(15.0)
     es = a["es"]
     assert es["count"] == 3 and es["sum"] == pytest.approx(25.0)
@@ -866,3 +869,42 @@ def test_top_hits_string_sort_specs(search):
         prices = [h["_source"]["price"] for h in top]
         assert prices == [1.0, 2.0], sort_spec
         assert top[0]["sort"] == [1.0]
+
+
+def test_percentile_interpolation_consistency(search):
+    """ONE percentile semantics engine-wide (round-7 satellite): the
+    `percentiles` metric over doc values and `percentiles_bucket` over
+    the same values lifted into bucket metrics must agree exactly —
+    both are linear interpolation (the digest's exact mode ≡
+    np.percentile default). Previously percentiles_bucket used
+    method="nearest" while the metric interpolated."""
+    # one bucket per doc (price is unique per doc) → the bucket metric
+    # series IS the price sample
+    a = agg(search, {
+        "per_doc": {"terms": {"field": "price", "size": 100},
+                    "aggs": {"v": {"max": {"field": "price"}}}},
+        "pb": {"percentiles_bucket": {"buckets_path": "per_doc>v",
+                                      "percents": [25.0, 50.0, 75.0]}},
+        "pm": {"percentiles": {"field": "price",
+                               "percents": [25.0, 50.0, 75.0]}},
+    })
+    prices = [1.0, 2.0, 3.0, 4.0, 5.0, 10.0]
+    for p in (25.0, 50.0, 75.0):
+        expected = float(np.percentile(prices, p))
+        assert a["pm"]["values"][str(p)] == pytest.approx(expected), p
+        assert a["pb"]["values"][str(p)] == pytest.approx(expected), p
+        assert a["pm"]["values"][str(p)] == pytest.approx(
+            a["pb"]["values"][str(p)]), p
+
+
+def test_percentiles_digest_is_bounded_and_mergeable(search):
+    """The raw-sample carrier is gone: percentiles ride a bounded
+    TDigest (the `_digest` internal never leaks, and an explicit
+    compression caps the centroid count)."""
+    a = agg(search, {"pct": {"percentiles": {
+        "field": "price", "percents": [50.0],
+        "tdigest": {"compression": 16}}}})
+    assert "_digest" not in a["pct"] and "_values" not in a["pct"]
+    # small sample ≤ budget → still exact
+    assert a["pct"]["values"]["50.0"] == pytest.approx(
+        np.percentile([1, 2, 3, 4, 5, 10], 50))

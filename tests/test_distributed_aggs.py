@@ -1,0 +1,213 @@
+"""Distributed aggregations over the multi-node RPC path
+(cluster/search_action.py + search/agg_partials.py): a ≥3-node cluster
+must return agg results equal to single-node, with incremental partial
+reduce (num_reduce_phases), composition with the PR-1 partial-results
+protocol under seeded faults, and typed rejection of unsupported agg
+types. Chaos tests replay with ``--chaos-seed=N``."""
+
+import numpy as np
+import pytest
+from test_search_failover import ChaosCluster
+
+from elasticsearch_tpu.cluster.search_action import QUERY_PHASE_ACTION
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    SearchPhaseExecutionException,
+)
+from elasticsearch_tpu.index.service import IndicesService
+from elasticsearch_tpu.search.service import SearchService
+from elasticsearch_tpu.testing.faults import ERROR, FaultInjector, FaultRule
+
+MAPPINGS = {"properties": {
+    "category": {"type": "keyword"},
+    "price": {"type": "double"},
+    "sold_at": {"type": "date"},
+}}
+
+AGGS = {
+    "cats": {"terms": {"field": "category"},
+             "aggs": {"avg_p": {"avg": {"field": "price"}}}},
+    "days": {"date_histogram": {"field": "sold_at",
+                                "calendar_interval": "day"},
+             "aggs": {"rev": {"sum": {"field": "price"}}}},
+    "pct": {"percentiles": {"field": "price",
+                            "percents": [25.0, 50.0, 95.0]}},
+    "comp": {"composite": {"size": 4, "sources": [
+        {"cat": {"terms": {"field": "category"}}}]}},
+}
+
+
+def make_docs(seed, n=60):
+    rng = np.random.default_rng(seed)
+    cats = ["a", "b", "c"]
+    return [{"category": cats[int(rng.integers(0, 3))],
+             "price": float(rng.integers(1, 100)),
+             "sold_at": f"2021-02-{int(rng.integers(1, 20)):02d}"}
+            for _ in range(n)]
+
+
+def setup_cluster(cluster, docs, shards=3, replicas=0):
+    master = cluster.stabilise()
+    cluster.call(master.create_index, "shop", number_of_shards=shards,
+                 number_of_replicas=replicas, mappings=MAPPINGS)
+    cluster.run_for(60)
+    items = [{"op": "index", "id": f"d{i}", "source": d}
+             for i, d in enumerate(docs)]
+    resp = cluster.call(master.bulk, "shop", items)
+    assert resp["errors"] == [], f"seed={cluster.seed}: {resp}"
+    cluster.call(master.refresh)
+    cluster.run_for(5)
+    return master
+
+
+def single_node_truth(tmp_path, docs, body):
+    indices = IndicesService(str(tmp_path / "truth"))
+    idx = indices.create_index("shop", {"index.number_of_shards": 1},
+                               MAPPINGS)
+    for i, d in enumerate(docs):
+        idx.index_doc(f"d{i}", d)
+    idx.refresh()
+    try:
+        return SearchService(indices).search("shop",
+                                             body)["aggregations"]
+    finally:
+        indices.close()
+
+
+@pytest.mark.chaos(seed=21)
+def test_three_node_aggs_equal_single_node(tmp_path, chaos_seed):
+    """The acceptance quartet — terms, date_histogram, percentiles,
+    composite (sub-aggs included) — on a 3-node / 3-shard cluster,
+    equal to the single-node result."""
+    docs = make_docs(chaos_seed)
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    master = setup_cluster(cluster, docs)
+    body = {"size": 0, "aggs": AGGS, "batched_reduce_size": 2}
+    r = cluster.call(master.search, "shop", body)
+    assert r["_shards"]["failed"] == 0, f"seed={chaos_seed}: {r}"
+    # the incremental reduce ran: 3 shards at batch size 2 → ≥ 2
+    # phases (partial + final)
+    assert r["num_reduce_phases"] >= 2, f"seed={chaos_seed}"
+    truth = single_node_truth(tmp_path, docs,
+                              {"size": 0, "aggs": AGGS})
+    a = r["aggregations"]
+    assert [(b["key"], b["doc_count"]) for b in a["cats"]["buckets"]] \
+        == [(b["key"], b["doc_count"]) for b in truth["cats"]["buckets"]]
+    for bd, bt in zip(a["cats"]["buckets"], truth["cats"]["buckets"]):
+        assert bd["avg_p"]["value"] == pytest.approx(
+            bt["avg_p"]["value"]), f"seed={chaos_seed}"
+    assert [(b["key"], b["doc_count"]) for b in a["days"]["buckets"]] \
+        == [(b["key"], b["doc_count"]) for b in truth["days"]["buckets"]]
+    for bd, bt in zip(a["days"]["buckets"], truth["days"]["buckets"]):
+        assert bd["rev"]["value"] == pytest.approx(bt["rev"]["value"])
+    # the sample fits the centroid budget → percentiles are EXACT
+    assert a["pct"]["values"] == truth["pct"]["values"]
+    assert a["comp"] == truth["comp"]
+    # no raw-sample carrier leaks into the wire response
+    import json
+    assert "_values" not in json.dumps(r) \
+        and "_digest" not in json.dumps(r)
+    # the coordinator surfaced the reduce telemetry
+    coord_metrics = master.telemetry.metrics.to_dict()
+    assert coord_metrics["search.agg_reduce.partials"]["value"] >= 3
+    assert coord_metrics["search.agg_reduce.batches"]["value"] >= 1
+    assert any(k.startswith("search.agg_reduce.latency")
+               for k in coord_metrics)
+
+
+@pytest.mark.chaos(seed=33)
+def test_aggs_compose_with_partial_results(tmp_path, chaos_seed):
+    """PR-1 composition: with no replicas, a node whose query RPC
+    always errors yields typed `_shards.failures` — and the
+    aggregations reduce over the SURVIVING shards instead of failing
+    the request; allow_partial_search_results=false raises instead."""
+    docs = make_docs(chaos_seed)
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    master = setup_cluster(cluster, docs, shards=3, replicas=0)
+    healthy = cluster.call(master.search, "shop",
+                           {"size": 0, "aggs": AGGS})
+    assert healthy["_shards"]["failed"] == 0
+    total_docs = sum(b["doc_count"]
+                     for b in healthy["aggregations"]["cats"]["buckets"])
+    assert total_docs == len(docs)
+
+    victim = cluster.primary_node_id("shop", 0)
+    cluster.injector.add_rule(FaultRule(
+        action=QUERY_PHASE_ACTION, node=victim, mode=ERROR))
+    coord = cluster.coordinator_excluding(victim)
+    partial = cluster.call(coord.search, "shop",
+                           {"size": 0, "aggs": AGGS})
+    sec = partial["_shards"]
+    assert sec["failed"] >= 1, f"seed={chaos_seed}: {sec}"
+    assert sec["failures"], f"seed={chaos_seed}"
+    got = sum(b["doc_count"]
+              for b in partial["aggregations"]["cats"]["buckets"])
+    # strictly fewer docs than healthy (the failed shards' partials
+    # never arrived), but still a well-formed reduce
+    assert 0 < got < total_docs, f"seed={chaos_seed}: {got}"
+    assert partial["num_reduce_phases"] >= 1
+
+    with pytest.raises(SearchPhaseExecutionException):
+        cluster.call(coord.search, "shop",
+                     {"size": 0, "aggs": AGGS,
+                      "allow_partial_search_results": False})
+    # the failed search released every buffered partial's breaker
+    # charge (the _complete → consumer.close() seam): no residual
+    # request-breaker bytes from agg partials at rest
+    assert coord.breaker_service.get_breaker("request").used == 0, \
+        f"seed={chaos_seed}"
+
+
+@pytest.mark.chaos(seed=44)
+def test_failover_keeps_aggs_complete(tmp_path, chaos_seed):
+    """With replicas, a failed copy fails over — the agg partial comes
+    from the surviving copy and the reduce stays COMPLETE."""
+    docs = make_docs(chaos_seed)
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    master = setup_cluster(cluster, docs, shards=2, replicas=1)
+    victim = cluster.primary_node_id("shop", 0)
+    cluster.injector.add_rule(FaultRule(
+        action=QUERY_PHASE_ACTION, node=victim, mode=ERROR))
+    coord = cluster.coordinator_excluding(victim)
+    r = cluster.call(coord.search, "shop", {"size": 0, "aggs": AGGS})
+    assert r["_shards"]["failed"] == 0, f"seed={chaos_seed}: {r}"
+    got = sum(b["doc_count"]
+              for b in r["aggregations"]["cats"]["buckets"])
+    assert got == len(docs), f"seed={chaos_seed}"
+
+
+@pytest.mark.chaos(seed=55)
+def test_batched_reduce_size_drives_phase_count(tmp_path, chaos_seed):
+    docs = make_docs(chaos_seed)
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    master = setup_cluster(cluster, docs, shards=4, replicas=0)
+    spec = {"size": 0, "aggs": {"c": {"terms": {"field": "category"}}}}
+    one_batch = cluster.call(master.search, "shop",
+                             {**spec, "batched_reduce_size": 100})
+    # 4 partials under one big batch: remainder reduce + final
+    assert one_batch["num_reduce_phases"] == 2, f"seed={chaos_seed}"
+    small = cluster.call(master.search, "shop",
+                         {**spec, "batched_reduce_size": 2})
+    assert small["num_reduce_phases"] > \
+        one_batch["num_reduce_phases"], f"seed={chaos_seed}"
+    assert small["aggregations"] == one_batch["aggregations"]
+
+
+@pytest.mark.chaos(seed=66)
+def test_unsupported_agg_rejected_typed_before_fanout(tmp_path,
+                                                      chaos_seed):
+    docs = make_docs(chaos_seed, n=10)
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    master = setup_cluster(cluster, docs)
+    with pytest.raises(IllegalArgumentException) as ei:
+        cluster.call(master.search, "shop", {
+            "size": 0,
+            "aggs": {"sig": {"significant_terms": {
+                "field": "category"}}}})
+    assert "distributed" in str(ei.value)
+    # single-node search still serves the same body
+    truth = single_node_truth(
+        tmp_path, docs,
+        {"size": 0, "aggs": {"sig": {"significant_terms": {
+            "field": "category", "min_doc_count": 1}}}})
+    assert "sig" in truth

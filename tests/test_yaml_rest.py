@@ -1,17 +1,25 @@
 """Declarative YAML REST suites (SURVEY.md §4 tier 5 — the
 ESClientYamlSuiteTestCase model): suites in tests/yaml_suites/ run
-against a fresh in-process node per test."""
+against a fresh in-process node per test. Suites named ``9[0-3]_dist*``
+run against a 3-NODE sim cluster instead (``ClusterYamlAdapter``
+bridges the runner's ``rest_controller.dispatch`` seam onto
+ClusterNode client calls) so multi-node response shapes — distributed
+aggregations included — pin through the same declarative format."""
 
 import glob
 import os
 
 import pytest
 
+from elasticsearch_tpu.common.errors import ElasticsearchTpuException
 from elasticsearch_tpu.node import Node
 from elasticsearch_tpu.testing.yaml_rest import YamlRestRunner
 
-SUITES = sorted(glob.glob(os.path.join(
+ALL_SUITES = sorted(glob.glob(os.path.join(
     os.path.dirname(__file__), "yaml_suites", "*.yml")))
+CLUSTER_SUITES = [s for s in ALL_SUITES
+                  if os.path.basename(s).startswith("93_")]
+SUITES = [s for s in ALL_SUITES if s not in CLUSTER_SUITES]
 
 
 @pytest.mark.parametrize("suite", SUITES,
@@ -22,5 +30,98 @@ def test_yaml_suite(suite, tmp_path):
     def factory():
         counter[0] += 1
         return Node(data_path=str(tmp_path / f"n{counter[0]}"))
+
+    YamlRestRunner(factory).run_file(suite)
+
+
+class ClusterYamlAdapter:
+    """A 3-node SimDataCluster behind the yaml runner's node seam: the
+    adapter IS its own ``rest_controller`` and maps the handful of
+    APIs the distributed suites use onto the cluster client calls,
+    driving the deterministic queue around each one."""
+
+    def __init__(self, tmp_path, seed=29):
+        from test_cluster_node import SimDataCluster
+        self.cluster = SimDataCluster(3, tmp_path, seed=seed)
+        self.master = self.cluster.stabilise()
+        self.rest_controller = self
+
+    def close(self):
+        for cn in self.cluster.cluster_nodes.values():
+            try:
+                cn.stop()
+            except Exception:   # noqa: BLE001 — teardown best effort
+                pass
+
+    # ------------------------------------------------------- dispatch
+    def dispatch(self, method, path, params, body):
+        import re
+        params = params or {}
+        try:
+            m = re.fullmatch(r"/([^/]+)", path)
+            if m and method == "PUT":
+                return 200, self._create_index(m.group(1), body or {})
+            m = re.fullmatch(r"/([^/]+)/_doc/([^/]+)", path)
+            if m and method == "PUT":
+                resp = self.cluster.call(
+                    self.master.bulk, m.group(1),
+                    [{"op": "index", "id": m.group(2), "source": body}])
+                item = resp["items"][0]
+                if "error" in item:
+                    return 400, {"error": item["error"], "status": 400}
+                return 201, {"result": "created", "_id": m.group(2)}
+            m = re.fullmatch(r"/([^/]+)/_refresh", path)
+            if m:
+                self.cluster.call(self.master.refresh)
+                self.cluster.run_for(5)
+                return 200, {"_shards": {}}
+            m = re.fullmatch(r"/([^/]+)/_search", path)
+            if m:
+                body = dict(body or {})
+                if "allow_partial_search_results" in params:
+                    body["allow_partial_search_results"] = \
+                        params["allow_partial_search_results"]
+                resp = self.cluster.call(self.master.search,
+                                         m.group(1), body)
+                return 200, resp
+        except ElasticsearchTpuException as e:
+            return e.status, {
+                "error": {**e.to_xcontent(),
+                          "root_cause": [e.to_xcontent()]},
+                "status": e.status}
+        except Exception as e:  # noqa: BLE001 — typed 500, like the
+            # RestController's Throwable barrier
+            from elasticsearch_tpu.common.errors import snake_case
+            return 500, {"error": {"type": snake_case(type(e).__name__),
+                                   "reason": str(e)}, "status": 500}
+        return 405, {"error": {
+            "type": "unsupported_api",
+            "reason": f"cluster yaml adapter: {method} {path}"},
+            "status": 405}
+
+    def _create_index(self, index, body):
+        settings = body.get("settings") or {}
+        shards = int(settings.get("index.number_of_shards",
+                                  settings.get("number_of_shards", 1)))
+        replicas = int(settings.get("index.number_of_replicas",
+                                    settings.get("number_of_replicas",
+                                                 0)))
+        resp = self.cluster.call(
+            self.master.create_index, index, number_of_shards=shards,
+            number_of_replicas=replicas,
+            mappings=body.get("mappings"))
+        self.cluster.run_for(60)
+        return resp
+
+
+@pytest.mark.parametrize("suite", CLUSTER_SUITES,
+                         ids=[os.path.basename(s)
+                              for s in CLUSTER_SUITES])
+def test_cluster_yaml_suite(suite, tmp_path):
+    counter = [0]
+
+    def factory():
+        counter[0] += 1
+        return ClusterYamlAdapter(tmp_path / f"c{counter[0]}")
 
     YamlRestRunner(factory).run_file(suite)
