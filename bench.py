@@ -1388,6 +1388,53 @@ def run_aggs_cpu(rng):
     return out
 
 
+def run_profile_cpu(corpus, queries, n=32):
+    """Per-phase latency percentiles (p50/p95/p99) + ONE sampled
+    ES-shaped profile tree from the host-side scoring path, exercising
+    the real PR-8 machinery (search/profile.py spans +
+    shard_profile_tree — stdlib-only, no jax import) — banked into the
+    BENCH json `serving` section CPU-side, BEFORE any backend touch."""
+    from elasticsearch_tpu.search import profile as prof
+    lens = corpus["lens"]
+    norm = K1 * (1.0 - B + B * lens / lens.mean())
+    gs, d_all, tf_all, df = (corpus["group_start"], corpus["doc_ids"],
+                             corpus["tf"], corpus["df"])
+    phases = {"rewrite": [], "score": [], "topk": [], "merge": []}
+    sample_rec, sample_total = {}, 0
+    body = {"query": {"match": {"title": "<bench query>"}}, "size": K}
+    for q in queries[:n]:
+        with prof.profiling() as rec:
+            t0 = time.monotonic_ns()
+            with prof.span("rewrite"):
+                terms = [(int(gs[t]), int(gs[t + 1]),
+                          idf(df[t], N_DOCS)) for t in q]
+            with prof.span("score"):
+                scores = np.zeros(N_DOCS, np.float32)
+                for (lo, hi, w), t in zip(terms, q):
+                    d = d_all[lo:hi]
+                    f = tf_all[lo:hi]
+                    scores[d] += w * f / (f + norm[d])
+            with prof.span("topk"):
+                top = np.argpartition(-scores,
+                                      min(K, N_DOCS - 1))[:K]
+            with prof.span("merge"):
+                top[np.lexsort((top, -scores[top]))]
+            total = time.monotonic_ns() - t0
+        for name in phases:
+            phases[name].append(rec.get(name, 0) / 1e6)
+        sample_rec, sample_total = dict(rec), total
+    pct = {
+        name: {"p50": round(float(np.percentile(v, 50)), 3),
+               "p95": round(float(np.percentile(v, 95)), 3),
+               "p99": round(float(np.percentile(v, 99)), 3)}
+        for name, v in phases.items() if v}
+    return {
+        "profile_phase_percentiles_ms": pct,
+        "profile_sample": prof.shard_profile_tree(
+            "[bench][0]", body, sample_rec, sample_total),
+    }
+
+
 def run_aggs_device(rng, aggs_rows):
     """Device reduction rows (requires a live backend): the fused
     metric-stats launch, histogram scatter-add, and per-bucket metric
@@ -1451,13 +1498,18 @@ def main():
         else:
             value = parts.get("kernel_qps", 0.0)
         cpu = parts.get("cpu_qps") or 0.0
+        # the serving section carries BOTH the dispatch snapshot (set
+        # once the REST path runs) and the CPU-side profile rider
+        # (per-phase percentiles + sampled tree, banked pre-backend)
+        serving = {**(parts.get("serving") or {}),
+                   **(parts.get("serving_profile") or {})} or None
         emit(compose_metric(parts), value,
              value / cpu if cpu else float("nan"),
              engine=_engine_snapshot(parts),
              overload=parts.get("overload"),
              tasks=parts.get("tasks"),
              cpu=parts.get("cpu"),
-             serving=parts.get("serving"),
+             serving=serving,
              skipped=parts.get("skipped"),
              aggs=parts.get("aggs"))
 
@@ -1493,6 +1545,14 @@ def main():
         cpu_rows["aggs_host_s"] = round(time.time() - t0, 1)
     except Exception as e:  # noqa: BLE001 — the rider must not sink
         log(f"aggs host section failed: {e!r}")
+    # profiling HOST rows: per-phase p50/p95/p99 + one sampled profile
+    # tree through the PR-8 recorder/tree-builder (stdlib-only)
+    try:
+        t0 = time.time()
+        parts["serving_profile"] = run_profile_cpu(corpus, queries)
+        cpu_rows["profile_host_s"] = round(time.time() - t0, 1)
+    except Exception as e:  # noqa: BLE001 — the rider must not sink
+        log(f"profile host section failed: {e!r}")
     # ALL CPU-side rows land before ANY jax/backend touch: a dead
     # relay hangs even backend INIT uninterruptibly (observed: hours),
     # and a run killed there must still have parsed output on record

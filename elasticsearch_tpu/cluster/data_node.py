@@ -318,6 +318,11 @@ class DataNodeService:
             _cb(results_, error_)
 
         on_done = done
+        if task is not None:
+            # the current profile stage on the executing child task:
+            # `_tasks?detailed=true` / hot_threads show where a long
+            # bulk is (the same seam the search paths publish through)
+            task.profile_stage = "bulk.primary"
         results = []
         ops_for_replicas: List[Dict[str, Any]] = []
         for item in items:
@@ -373,6 +378,8 @@ class DataNodeService:
         if not replicas or not ops_for_replicas:
             on_done(results, None)
             return
+        if task is not None:
+            task.profile_stage = "bulk.replicate"
         pending = {"n": len(replicas)}
 
         def one_done():

@@ -392,12 +392,22 @@ class DistributedSearchService:
     def _query_one_shard(self, req, body, query, post_filter, k: int,
                          shard_id: int, child) -> Dict[str, Any]:
         """One shard's query phase, under this node's stage sink and the
-        child task's device-launch cancellation hook."""
+        child task's device-launch cancellation hook.
+
+        With ``profile: true`` the shard runs under a per-request
+        recorder on the SCHEDULER clock (virtual time under the
+        deterministic harness → seed-replay-identical trees) and ships
+        its ES-shaped profile entry in the RPC response for the
+        coordinator merge."""
         from contextlib import ExitStack
 
         from elasticsearch_tpu.search import profile as _prof
         aggs_spec = body.get("aggs") or body.get("aggregations")
         agg_partial = None
+        profiled = bool(body.get("profile"))
+        prof_rec: Dict[str, Any] = {}
+        prof_entry = None
+        churn0 = (0, 0)
         try:
             searcher = self._searcher_for(req["index"], shard_id)
             if searcher is None:
@@ -410,9 +420,22 @@ class DistributedSearchService:
                         _prof.stage_sink(self.telemetry.stage_sink()))
                 if child is not None:
                     # a cancel arriving mid-scan aborts at the next
-                    # stage boundary (between device launches)
+                    # stage boundary (between device launches); the
+                    # stage hook publishes the child's current stage to
+                    # `_tasks?detailed=true`
                     stack.enter_context(
                         _prof.cancellable(child.ensure_not_cancelled))
+                    stack.enter_context(_prof.stage_hook(
+                        lambda st: setattr(child, "profile_stage", st)))
+                t0 = 0
+                clock = None
+                if profiled:
+                    clock = lambda: int(  # noqa: E731
+                        self.scheduler.now() * 1e9)
+                    prof_rec = stack.enter_context(
+                        _prof.profiling(clock=clock))
+                    churn0 = self.data_node.device_cache.churn_counters()
+                    t0 = clock()
                 result = searcher.query_phase(
                     query, k,
                     post_filter=post_filter,
@@ -426,14 +449,33 @@ class DistributedSearchService:
                     # the shard's mergeable partial (moments/sketches/
                     # bucket maps — search/agg_partials.py); the shared
                     # collectors ride the device cache at scale exactly
-                    # like the single-node agg phase
+                    # like the single-node agg phase. Under profiling
+                    # the collect is a structured child scope of the
+                    # shard entry (the PR-7 partial-collect half; merge/
+                    # finalize run coordinator-side).
                     from elasticsearch_tpu.search.agg_partials import (
                         collect_partials)
                     agg_ctx = [(seg, mask, searcher.mapper)
                                for seg, mask in (result.agg_masks or [])]
-                    agg_partial = collect_partials(
-                        aggs_spec, agg_ctx, searcher.mapper,
-                        self.data_node.device_cache)
+                    with _prof.span("aggs.collect"):
+                        agg_partial = collect_partials(
+                            aggs_spec, agg_ctx, searcher.mapper,
+                            self.data_node.device_cache)
+                if profiled:
+                    # HBM churn observed DURING this query's window —
+                    # the node-wide counter delta, so under concurrent
+                    # load it can include a neighbour query's uploads
+                    # (the signal is "this request ran while HBM
+                    # churned", not strict causality)
+                    adm, ev = \
+                        self.data_node.device_cache.churn_counters()
+                    if adm - churn0[0] or ev - churn0[1]:
+                        counters = prof_rec.setdefault("_counters", {})
+                        counters["hbm_admissions"] = adm - churn0[0]
+                        counters["hbm_evictions"] = ev - churn0[1]
+                    prof_entry = _prof.shard_profile_tree(
+                        f"[{req['index']}][{shard_id}]", body, prof_rec,
+                        clock() - t0)
         except Exception as e:  # noqa: BLE001 — per-shard fault barrier
             return {"shard": shard_id, "error": str(e),
                     "type": error_type_of(e)}
@@ -442,6 +484,7 @@ class DistributedSearchService:
             "total": result.total_hits,
             "max_score": result.max_score,
             "aggs": agg_partial,
+            "profile": prof_entry,
             # the stored _id travels with the address: segment names
             # are engine-local (uuid-prefixed), so a fetch that fails
             # over to ANOTHER copy resolves the doc by _id instead
@@ -600,12 +643,17 @@ class DistributedSearchService:
             if err is None and resp is not None and indices:
                 try:
                     from elasticsearch_tpu.search.slowlog import (
-                        record_search_slowlog)
+                        record_search_slowlog,
+                        slowest_stage_summary,
+                    )
                     record_search_slowlog(
                         lambda n: getattr(state.metadata.index(n),
                                           "settings", None),
                         indices, resp.get("took", 0), body,
-                        self.slowlog_recent)
+                        self.slowlog_recent,
+                        trace_id=(root_span.trace_id
+                                  if root_span is not None else None),
+                        slowest_stage=slowest_stage_summary(resp))
                 except Exception:  # noqa: BLE001 — a malformed slowlog
                     # setting must never swallow a finished search
                     import logging
@@ -692,7 +740,15 @@ class DistributedSearchService:
             "span": root_span,
             "query_span": query_span,
             "task": task,
+            # per-shard ES-shaped profile entries shipped in the query
+            # RPC responses, merged under the single-node response
+            # shape at _finish (ref: SearchProfileShardResults merge)
+            "profile": bool(body.get("profile")),
+            "profile_shards": [],
+            "phase_ns": {},
         }
+        if task is not None:
+            task.profile_stage = "phase/query"
 
         # cancellation that bites at the coordinator: the listener fails
         # every unresolved shard group with a typed task_cancelled
@@ -833,6 +889,10 @@ class DistributedSearchService:
                 d2["_shard"] = sr["shard"]
                 d2["_node"] = node_id
                 ctx["merged"].append(d2)
+            if ctx["profile"] and sr.get("profile") is not None:
+                prof = dict(sr["profile"])
+                prof["node"] = node_id
+                ctx["profile_shards"].append(prof)
             consumer = ctx["agg_consumer"]
             if consumer is not None and sr.get("aggs") is not None \
                     and ctx["agg_reduce_error"] is None:
@@ -991,6 +1051,8 @@ class DistributedSearchService:
         qspan = ctx.pop("query_span", None)
         if qspan is not None:
             qspan.finish(failed_shards=len(failed))
+        ctx["phase_ns"]["query_ns"] = int(
+            (self.scheduler.now() - ctx["t_start"]) * 1e9)
         if self.telemetry is not None:
             self.telemetry.metrics.observe(
                 "search.phase.query.latency",
@@ -1080,21 +1142,28 @@ class DistributedSearchService:
             reduce_span = tele.tracer.start_span(
                 "reduce", parent=ctx.get("span"),
                 tags={"docs": len(merged)})
+        task = ctx.get("task")
+        if task is not None:
+            task.profile_stage = "reduce"
         t_reduce = self.scheduler.now()
         merged.sort(key=lambda d: (-d["sort_key"], d["_index"],
                                    d["_shard"], d["docid"]))
         page = merged[ctx["from"]:ctx["from"] + ctx["size"]]
         for ord_, d in enumerate(page):
             d["ord"] = ord_
+        ctx["phase_ns"]["reduce_ns"] = int(
+            (self.scheduler.now() - t_reduce) * 1e9)
         if reduce_span is not None:
             reduce_span.finish()
             tele.metrics.observe(
                 "search.phase.reduce.latency",
                 (self.scheduler.now() - t_reduce) * 1000.0)
+        if task is not None:
+            task.profile_stage = "phase/fetch"
+        # the fetch window opens AFTER the reduce, so phase latencies
+        # (and spans) stay disjoint
+        ctx["fetch_start"] = self.scheduler.now()
         if tele is not None:
-            # the fetch window opens AFTER the reduce, so phase
-            # latencies (and spans) stay disjoint
-            ctx["fetch_start"] = self.scheduler.now()
             ctx["fetch_span"] = tele.tracer.start_span(
                 "fetch", parent=ctx.get("span"))
         fctx = {
@@ -1258,6 +1327,13 @@ class DistributedSearchService:
         if fetch_span is not None:
             fetch_span.finish(
                 fetch_failures=len(fctx["fetch_failures"]))
+        if "fetch_start" in ctx:
+            # stamped HERE — at the fetch phase's own boundary — so the
+            # profile phases stay disjoint (the agg finalize below has
+            # its own aggs_ns; charging it to fetch too would make
+            # sum(phases) exceed wall time)
+            ctx["phase_ns"]["fetch_ns"] = int(
+                (self.scheduler.now() - ctx["fetch_start"]) * 1e9)
         if self.telemetry is not None and "fetch_start" in ctx:
             self.telemetry.metrics.observe(
                 "search.phase.fetch.latency",
@@ -1326,6 +1402,7 @@ class DistributedSearchService:
                     finalize_partials,
                     strip_internal,
                 )
+                t_fin = self.scheduler.now()
                 acc, phases = consumer.finish()
                 # failed shards simply never contributed a partial:
                 # aggregations reflect the successful shards, exactly
@@ -1333,11 +1410,44 @@ class DistributedSearchService:
                 resp["aggregations"] = strip_internal(
                     finalize_partials(ctx["aggs_spec"], acc))
                 resp["num_reduce_phases"] = phases
+                ctx["reduce_batches"] = phases
+                ctx["phase_ns"]["aggs_ns"] = int(
+                    (self.scheduler.now() - t_fin) * 1e9)
             except Exception as e:  # noqa: BLE001 — pipeline/script
                 # errors at finalize fail the request typed
                 self._complete(ctx, None, e)
                 return
+        if ctx["profile"]:
+            resp["profile"] = self._profile_section(ctx, fctx)
         self._complete(ctx, resp, None)
+
+    def _profile_section(self, ctx: Dict, fctx: Dict) -> Dict[str, Any]:
+        """The coordinator-merged profile: per-shard trees under the
+        SAME response shape as single-node, plus a coordinator section
+        (per-phase times on the scheduler clock, reduce batches,
+        failover attempts) and the `trace.id` cross-link — slowlog /
+        `_tasks` / `_traces` / profile all navigate to each other."""
+        phases = dict(ctx["phase_ns"])
+        phases.setdefault("fetch_ns", 0)
+        groups: List[_ShardGroup] = ctx["groups"]
+        coordinator: Dict[str, Any] = {
+            "phases": phases,
+            "shard_attempts": sum(max(g.attempts, 1) for g in groups),
+            "failover_attempts": sum(max(g.attempts - 1, 0)
+                                     for g in groups),
+            "fetch_failures": len(fctx["fetch_failures"]),
+        }
+        if ctx.get("reduce_batches") is not None:
+            coordinator["reduce_batches"] = ctx["reduce_batches"]
+        out: Dict[str, Any] = {
+            "shards": sorted(ctx["profile_shards"],
+                             key=lambda p: p.get("id", "")),
+            "coordinator": coordinator,
+        }
+        span = ctx.get("span")
+        if span is not None:
+            out["trace.id"] = span.trace_id
+        return out
 
     # ------------------------------------------------------------- helpers
 

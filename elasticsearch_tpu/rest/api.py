@@ -672,7 +672,29 @@ def get_kernels(node, params, body):
 
 def get_traces(node, params, body):
     """GET /_traces — newest-first summaries of the recent-trace ring;
-    ``size``/``from`` page through it."""
+    ``size``/``from`` page through it.
+
+    ``exemplar_for=<metric>`` pivots the listing: instead of recency it
+    returns the bounded per-bucket exemplars of that histogram (last
+    trace.id + value per latency bucket, tail first), each resolved
+    against the trace ring — a p99 spike in `_nodes/stats` navigates
+    straight to a concrete traced (and, when profiled, profile-carrying)
+    request."""
+    metric = params.get("exemplar_for")
+    if metric:
+        tracer = node.telemetry.tracer
+        exemplars = node.telemetry.metrics.exemplars_of(metric)
+        for ex in exemplars:
+            t = tracer.trace(ex["trace_id"])
+            # resolvable=False: the trace has aged out of the bounded
+            # ring; the exemplar's value/bucket still stand
+            ex["resolvable"] = t is not None
+            if t is not None:
+                roots = [s for s in t["spans"]
+                         if s["parent_id"] is None]
+                ex["root"] = roots[0]["name"] if roots else None
+                ex["spans"] = len(t["spans"])
+        return 200, {"metric": metric, "exemplars": exemplars}
     limit = int(params.get("size", 32))
     offset = int(params.get("from", 0))
     return 200, {"traces":
@@ -3011,23 +3033,17 @@ def searchable_snapshot_stats(node, params, body):
 
 
 def hot_threads(node, params, body):
-    """ref: monitor/jvm/HotThreads.java — stack dump of live threads,
-    busiest (here: all, main first) in the reference's text format."""
-    import sys
-    import threading as _threading
-    import traceback
-    frames = sys._current_frames()
-    lines = [f"::: {{{node.name}}}{{{node.node_id}}}", ""]
-    for t in _threading.enumerate():
-        f = frames.get(t.ident)
-        if f is None:
-            continue
-        lines.append(f"   {'100.0%' if t is _threading.main_thread() else '0.0%'} "
-                     f"cpu usage by thread '{t.name}'")
-        for fr in traceback.format_stack(f):
-            lines.extend("     " + ln for ln in fr.rstrip().splitlines())
-        lines.append("")
-    return 200, {"_cat": "\n".join(lines)}
+    """ref: monitor/jvm/HotThreads.java — node occupancy report. The
+    schedulable unit here is the registered TASK (transport/tasks.py),
+    so the report is the top running tasks with their running time (on
+    the scheduler clock) and CURRENT profile stage — a long-running
+    search shows `launch`/`fetch`/`aggs.collect`, which is the
+    diagnostic the reference's thread dump provides. ``threads`` caps
+    the per-node task count (default 3, ES parity)."""
+    from elasticsearch_tpu.transport.tasks import hot_threads_text
+    limit = int(params.get("threads", 3))
+    return 200, {"_cat": hot_threads_text(
+        node.task_manager, node.name, node.node_id, limit=limit)}
 
 
 def deprecations(node, params, body):

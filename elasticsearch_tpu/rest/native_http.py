@@ -248,8 +248,23 @@ class NativeHttpFront:
                         "reason": f"Failed to parse request body: {e}"},
                         "status": 400}, method)
                     return
-        status, payload = self.controller.dispatch(
-            method, url.path, params, body, headers=headers)
+        if "trace.id" in lower:
+            # an externally-propagated trace context (another node's
+            # coordinator, a client-side tracer) joins this request's
+            # spans to the caller's trace — the REST-boundary root span
+            # parents to it via the ambient context, so cross-process
+            # profile ↔ trace navigation works through the native front
+            # too (fast-path requests never reach Python and stay
+            # untraced by design)
+            from elasticsearch_tpu.telemetry import context as _telectx
+            cm = _telectx.incoming({"trace.id": lower["trace.id"],
+                                    "span.id": lower.get("span.id")})
+        else:
+            from contextlib import nullcontext
+            cm = nullcontext()
+        with cm:
+            status, payload = self.controller.dispatch(
+                method, url.path, params, body, headers=headers)
         self._send(token, status, payload, method,
                    cbor_ok="cbor" in lower.get("accept", "").lower())
 

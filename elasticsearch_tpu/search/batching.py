@@ -60,13 +60,23 @@ def _nb_tier(n: int) -> int:
 
 
 class _Entry:
-    __slots__ = ("bp", "event", "result", "error")
+    __slots__ = ("bp", "event", "result", "error", "profiled", "t_enq",
+                 "meta")
 
-    def __init__(self, bp: BoundPlan):
+    def __init__(self, bp: BoundPlan, profiled: bool = False,
+                 t_enq: int = 0):
         self.bp = bp
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
+        # per-request device attribution (`profile: true` only): the
+        # caller flags its entry at enqueue; _run stamps cohort meta
+        # (kernel, cohort width, padding waste, launch/readback) only
+        # for flagged entries — the profile-off hot path allocates
+        # nothing extra
+        self.profiled = profiled
+        self.t_enq = t_enq
+        self.meta: Optional[Dict[str, object]] = None
 
 
 class PlanBatcher:
@@ -140,10 +150,13 @@ class PlanBatcher:
     # ------------------------------------------------------------------
     def execute(self, bp: BoundPlan, ctx, k: int, k1: float, b: float,
                 after_score: Optional[float] = None):
+        from elasticsearch_tpu.search import profile as _prof
+        profiled = _prof.recording()
         if not self._eligible(bp, after_score):
             return execute_bound(bp, ctx, k, k1, b, after_score)
         sig = self._signature(bp, ctx, k, k1, b)
-        entry = _Entry(bp)
+        entry = _Entry(bp, profiled=profiled,
+                       t_enq=_prof.now_ns() if profiled else 0)
         with self._lock:
             q = self._pending.setdefault(sig, [])
             q.append(entry)
@@ -152,6 +165,8 @@ class PlanBatcher:
             entry.event.wait()
             if entry.error is not None:
                 raise entry.error
+            if profiled:
+                self._record_attribution(entry)
             return entry.result
         # leader: let the cohort grow while the device is slow, then wait
         # for a launch slot and take the whole queue. Non-leader entries
@@ -196,7 +211,27 @@ class PlanBatcher:
                 raise
         if entry.error is not None:
             raise entry.error
+        if profiled:
+            self._record_attribution(entry)
         return entry.result
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _record_attribution(entry: _Entry) -> None:
+        """Fold the cohort meta `_run` stamped on this entry into the
+        caller's active profile recorder, adding the batcher wait (time
+        between enqueue and the completed launch, minus the launch
+        itself — the continuous-batching cost this request paid to ride
+        a cohort)."""
+        from elasticsearch_tpu.search import profile as _prof
+        meta = entry.meta
+        if meta is None:
+            return
+        total_ms = max(0.0, (_prof.now_ns() - entry.t_enq) / 1e6)
+        rec = dict(meta)
+        rec["batch_wait_ms"] = round(
+            max(0.0, total_ms - float(rec.get("launch_ms", 0.0))), 3)
+        _prof.record_device(rec)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -247,6 +282,11 @@ class PlanBatcher:
         ms = np.asarray([bp.msm for bp in bps], np.int32)
         bo = np.asarray([bp.bonus for bp in bps], np.float32)
         ti = np.asarray([bp.tie for bp in bps], np.float32)
+        any_prof = any(e.profiled for e in batch)
+        t0p = 0
+        if any_prof:
+            from elasticsearch_tpu.search import profile as _prof
+            t0p = _prof.now_ns()
         t0 = time.monotonic()
         packed = plan_ops.plan_topk_batch(
             streams, gk, gr, gc, ctx.live, nm, nf, ms, bo, ti,
@@ -263,6 +303,37 @@ class PlanBatcher:
         self.launches += 1
         self.batched_queries += qn
         self.batch_hist[bucket] = self.batch_hist.get(bucket, 0) + 1
+        if any_prof:
+            # cohort meta for `profile: true` device attribution — the
+            # launch is timed on the profile clock (virtual under the
+            # deterministic harness → replay-identical trees); padding
+            # waste is per entry: the padded selection slots the cohort
+            # tier forced on THIS plan, plus the Q-bucket pad rows
+            launch_ms = round((_prof.now_ns() - t0p) / 1e6, 3)
+            widths = [int(st.sel_blocks.shape[1]) for st in streams]
+            row_slots = sum(widths)        # one cohort row's padded slots
+            readback = int(rows[0].nbytes)
+            for e in batch:
+                if not e.profiled:
+                    continue
+                # per-entry waste: the tier-padded slots of THIS plan's
+                # row that its own selection did not fill (the Q-bucket
+                # pad rows are cohort overhead, visible via q_bucket
+                # vs cohort)
+                own = sum(int(st.sel_blocks.shape[0])
+                          for st in e.bp.streams)
+                e.meta = {
+                    "kernel": "plan_topk_batch",
+                    "cohort": qn,
+                    "q_bucket": bucket,
+                    "nb_bucket": max(widths) if widths else 0,
+                    "nb_selected": own,
+                    "padding_waste_pct": round(
+                        100.0 * (1.0 - own / row_slots), 1)
+                    if row_slots else 0.0,
+                    "launch_ms": launch_ms,
+                    "readback_bytes": readback,
+                }
         for i, e in enumerate(batch):
             e.result = plan_ops.unpack_result(rows[i], k)
             e.event.set()
@@ -294,14 +365,19 @@ def _cut_bucket(n: int) -> int:
 
 
 class _KnnEntry:
-    __slots__ = ("qvec", "cut", "event", "result", "error")
+    __slots__ = ("qvec", "cut", "event", "result", "error", "profiled",
+                 "t_enq", "meta")
 
-    def __init__(self, qvec: np.ndarray, cut: int):
+    def __init__(self, qvec: np.ndarray, cut: int,
+                 profiled: bool = False, t_enq: int = 0):
         self.qvec = qvec
         self.cut = cut
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
+        self.profiled = profiled
+        self.t_enq = t_enq
+        self.meta: Optional[Dict[str, object]] = None
 
 
 class KnnBatcher:
@@ -334,11 +410,15 @@ class KnnBatcher:
         enables the exact re-rank when the slab is quantized
         (KnnQuery._exact_rerank parity). The cut caps at the slab's
         padded row count — lax.top_k cannot exceed the axis."""
+        from elasticsearch_tpu.search import profile as _prof
+        profiled = _prof.recording()
         nd = int(dv.vectors.shape[0])
         bucket_cut = min(_cut_bucket(cut), nd)
         sig = (id(dv.vectors), id(live), dv.similarity, bucket_cut,
                int(qvec.shape[0]))
-        entry = _KnnEntry(np.asarray(qvec, np.float32), cut)
+        entry = _KnnEntry(np.asarray(qvec, np.float32), cut,
+                          profiled=profiled,
+                          t_enq=_prof.now_ns() if profiled else 0)
         with self._lock:
             q = self._pending.setdefault(sig, [])
             q.append(entry)
@@ -347,6 +427,8 @@ class KnnBatcher:
             entry.event.wait()
             if entry.error is not None:
                 raise entry.error
+            if profiled:
+                PlanBatcher._record_attribution(entry)
             return self._finish(entry, dv, host_vectors)
         window = (min(0.75 * self._lat_ema, 1.5)
                   if self._lat_ema > 0.03 else self.adaptive_flush_s)
@@ -379,6 +461,8 @@ class KnnBatcher:
                 raise
         if entry.error is not None:
             raise entry.error
+        if profiled:
+            PlanBatcher._record_attribution(entry)
         return self._finish(entry, dv, host_vectors)
 
     # ------------------------------------------------------------------
@@ -397,6 +481,11 @@ class KnnBatcher:
             bucket = min(_q_bucket(qn), allowed)
             qs = np.stack([e.qvec for e in chunk]
                           + [chunk[0].qvec] * (bucket - qn))
+            any_prof = any(e.profiled for e in chunk)
+            t0p = 0
+            if any_prof:
+                from elasticsearch_tpu.search import profile as _prof
+                t0p = _prof.now_ns()
             t0 = time.monotonic()
             top_s, top_i = vec_ops.knn_nominate_batch(
                 jnp.asarray(qs), dv.vectors, dv.sq_norms, dv.has_value,
@@ -414,6 +503,25 @@ class KnnBatcher:
                                      else 0.8 * self._lat_ema + 0.2 * dt)
                 self.launches += 1
                 self.batched_queries += qn
+            if any_prof:
+                launch_ms = round((_prof.now_ns() - t0p) / 1e6, 3)
+                for e in chunk:
+                    if e.profiled:
+                        # same semantics as PlanBatcher: per-row slot
+                        # waste — the bucketed cut columns this entry's
+                        # own request did not need; Q-pad rows stay
+                        # visible via q_bucket vs cohort
+                        e.meta = {
+                            "kernel": "knn_nominate_batch",
+                            "cohort": qn,
+                            "q_bucket": bucket,
+                            "nb_bucket": cut,
+                            "padding_waste_pct": round(
+                                100.0 * (1.0 - min(e.cut, cut) / cut),
+                                1) if cut else 0.0,
+                            "launch_ms": launch_ms,
+                            "readback_bytes": int(rows[0].nbytes),
+                        }
             for i, e in enumerate(chunk):
                 scores = rows[i, :cut].copy()
                 ids = _unpack_ids(rows[i, cut:])

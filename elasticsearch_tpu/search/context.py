@@ -147,6 +147,11 @@ class DeviceSegmentCache:
         self.plan_cache_misses = 0
         self.plan_cache_evictions = 0
         self.peak_hbm_bytes = 0
+        # lifetime device-segment builds (uploads admitted to HBM) —
+        # with hbm_breaker_evictions, the churn pair a profiled query
+        # snapshots before/after so `profile: true` charges HBM
+        # admissions/evictions to the request that caused them
+        self.admissions = 0
 
     def set_breaker(self, breaker) -> None:
         """Wire the `hbm` child breaker (node startup: Node/ClusterNode).
@@ -242,6 +247,7 @@ class DeviceSegmentCache:
             if self.breaker is not None:
                 self._charged[segment.name] = nbytes
             dev.hbm_sink = self
+            self.admissions += 1
             self._cache[segment.name] = (segment.live_version, dev)
             total = sum(d.hbm_bytes() for _v, d in self._cache.values())
             self.peak_hbm_bytes = max(self.peak_hbm_bytes, total)
@@ -261,6 +267,14 @@ class DeviceSegmentCache:
                 if name not in names:
                     del self._cache[name]
                     self._release_locked(name)
+
+    def churn_counters(self) -> Tuple[int, int]:
+        """(admissions, breaker_evictions) lifetime pair — a profiled
+        query snapshots it before/after to report the HBM churn that
+        happened during its window (node-wide: concurrent queries'
+        uploads land in the same delta)."""
+        with self._lock:
+            return self.admissions, self.hbm_breaker_evictions
 
     # -- engine observability (the `engine` stats rollup) -----------------
 
@@ -291,6 +305,9 @@ class DeviceSegmentCache:
         if segment_names is None:
             self.peak_hbm_bytes = max(self.peak_hbm_bytes, total)
             out["peak_bytes"] = self.peak_hbm_bytes
+            # lifetime segment uploads; the per-query delta is what
+            # `profile: true` charges to a request (churn_counters)
+            out["admissions"] = self.admissions
             # admissions forced to drop an LRU resident by the hbm
             # breaker (zero in a healthy, fits-in-HBM deployment)
             out["breaker_evictions"] = self.hbm_breaker_evictions

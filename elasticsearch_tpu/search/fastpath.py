@@ -238,6 +238,13 @@ class FastPathServer:
         self.cohort_hist: Dict[int, int] = {}
         # warm-up accounting (persistent-compile-cache payoff)
         self.warm_seconds = 0.0
+        # cohort padding accounting: every launch pads its cohort to a
+        # pow2 Q row count — the pad rows are pure device waste, and
+        # their share is the profile-subsystem's serving-side padding
+        # attribution (the per-request analogue lives in
+        # search/batching.py device records)
+        self.pad_rows = 0
+        self.used_rows = 0
 
     def _count_dispatch(self, lane: str, bucket: int, n: int):
         key = f"{lane}:{bucket}"
@@ -248,15 +255,20 @@ class FastPathServer:
         while b < n:
             b *= 2
         self.cohort_hist[b] = self.cohort_hist.get(b, 0) + 1
+        self.pad_rows += b - n
+        self.used_rows += n
 
     def serving_stats(self) -> dict:
         """Routing/dispatch telemetry of the serving front: per-lane ×
-        nb-bucket dispatch counts, cohort-width histogram, warm-up
-        seconds, and the truncated-lane counters."""
+        nb-bucket dispatch counts, cohort-width histogram, padding
+        waste, warm-up seconds, and the truncated-lane counters."""
+        padded = self.pad_rows + self.used_rows
         return {
             "dispatch": dict(self.dispatch),
             "cohort_hist": {str(k): v
                             for k, v in sorted(self.cohort_hist.items())},
+            "padding_waste_pct": round(
+                100.0 * self.pad_rows / padded, 1) if padded else 0.0,
             "warm_seconds": round(self.warm_seconds, 3),
             "nb_buckets": list(self.nb_buckets),
             "ess_buckets": list(self.ess_buckets),

@@ -230,7 +230,11 @@ class ShardSearcher:
                 vals, ids = topk_ops.masked_topk(key, mask,
                                                  min(k, ctx.n_docs_padded))
             with _prof.span("readback"):
+                rec_on = _prof.recording()
+                t_rb = _prof.now_ns() if rec_on else 0
                 vals, ids = np.asarray(vals), np.asarray(ids)
+                if rec_on:
+                    _prof.record_readback(t_rb, vals, ids)
             keep = np.isfinite(vals)
             ids = ids[keep]
             if self.bigarrays is not None:
@@ -332,10 +336,32 @@ class ShardSearcher:
                     vals, ids, seg_total = self.batcher.execute(
                         bp, ctx, k, self.k1, self.b, after_score)
                 else:
+                    rec_on = _prof.recording()
+                    t_l = _prof.now_ns() if rec_on else 0
                     vals, ids, seg_total = execute_bound(
                         bp, ctx, k, self.k1, self.b, after_score)
+                    if rec_on:
+                        # unbatched launch (the distributed data-node
+                        # path): a cohort-of-one attribution record so
+                        # the shard profile still names the kernel and
+                        # its selection width
+                        _prof.record_device({
+                            "kernel": "plan_topk_packed",
+                            "cohort": 1, "q_bucket": 1,
+                            "nb_bucket": max(
+                                (int(st.sel_blocks.shape[0])
+                                 for st in bp.streams), default=0),
+                            "padding_waste_pct": 0.0,
+                            "batch_wait_ms": 0.0,
+                            "launch_ms": round(
+                                (_prof.now_ns() - t_l) / 1e6, 3),
+                        })
             with _prof.span("readback"):
+                rec_on = _prof.recording()
+                t_rb = _prof.now_ns() if rec_on else 0
                 vals, ids = np.asarray(vals), np.asarray(ids)
+                if rec_on:
+                    _prof.record_readback(t_rb, vals, ids)
             if track_total_hits:
                 total += int(seg_total)
             keep = vals > -np.inf

@@ -336,7 +336,8 @@ class SearchService:
                     "[rank] cannot be used with [scroll]")
             response = self._rrf_search(searchers, body, task)
             response["took"] = int((time.monotonic() - start) * 1000)
-            self._after_search(names, response["took"], body)
+            self._after_search(names, response["took"], body,
+                               response)
             return response
         if body and body.get("knn") is not None:
             # pure top-level kNN with an ids+scores-only response rides
@@ -347,7 +348,7 @@ class SearchService:
                     if scroll is None else None)
             if pure is not None:
                 pure["took"] = int((time.monotonic() - start) * 1000)
-                self._after_search(names, pure["took"], body)
+                self._after_search(names, pure["took"], body, pure)
                 return pure
             body = _merge_knn_into_query(body)
 
@@ -381,7 +382,8 @@ class SearchService:
                 while len(self._request_cache) > \
                         self.REQUEST_CACHE_MAX_ENTRIES:
                     self._request_cache.popitem(last=False)
-        self._after_search(names, response["took"], body)
+        self._after_search(names, response["took"], body,
+                               response)
         return response
 
     def _cache_identity(self, names: List[str]) -> tuple:
@@ -595,7 +597,8 @@ class SearchService:
         return out
 
     def _after_search(self, names: List[str], took_ms: int,
-                      body: Dict[str, Any]):
+                      body: Dict[str, Any],
+                      response: Optional[Dict[str, Any]] = None):
         """Post-search hooks: frozen-index HBM eviction + slow log
         (search metrics live in the search() wrapper, which also sees
         cache hits and failures)."""
@@ -607,11 +610,18 @@ class SearchService:
                 # frozen: no device-resident state between searches (ref:
                 # FrozenEngine per-search readers → per-search HBM)
                 idx.device_cache.evict(idx._known_seg_names)
-        from elasticsearch_tpu.search.slowlog import record_search_slowlog
+        from elasticsearch_tpu.search.slowlog import (
+            record_search_slowlog,
+            slowest_stage_summary,
+        )
+        from elasticsearch_tpu.telemetry import context as _telectx
+        ambient = _telectx.current()
         record_search_slowlog(
             lambda n: (self.indices_service.get(n).settings
                        if self.indices_service.has(n) else None),
-            names, took_ms, body, self.slowlog_recent)
+            names, took_ms, body, self.slowlog_recent,
+            trace_id=ambient.trace_id if ambient is not None else None,
+            slowest_stage=slowest_stage_summary(response))
 
     def scroll(self, scroll_id: str, scroll: Optional[str] = None) -> Dict[str, Any]:
         start = time.monotonic()
@@ -627,7 +637,8 @@ class SearchService:
                                  continuing=True)
         response["took"] = int((time.monotonic() - start) * 1000)
         response["_scroll_id"] = scroll_id
-        self._after_search(ctx.index_names, response["took"], ctx.body)
+        self._after_search(ctx.index_names, response["took"], ctx.body,
+                           response)
         return response
 
     def scan(self, index_expression: str, body: Dict[str, Any],
@@ -817,10 +828,12 @@ class SearchService:
             t0 = time.monotonic_ns()
             prof_cm = None
             prof_rec = {}
+            churn0 = (0, 0)
             if profile:
                 from elasticsearch_tpu.search import profile as _prof
                 prof_cm = _prof.profiling()
                 prof_rec = prof_cm.__enter__()
+                churn0 = searcher.cache.churn_counters()
             if scroll_ctx is None and slice_spec is None:
                 # stable plan-cache key: the raw query/post_filter JSON —
                 # repeat queries skip compile AND bind (searcher.py)
@@ -833,6 +846,7 @@ class SearchService:
             else:
                 plan_cache_key = None
             cancel_cm = None
+            stage_cm = None
             if task is not None:
                 # the profile stage seam doubles as the device-launch
                 # cancellation poll: a cancel mid-scan aborts between
@@ -840,6 +854,12 @@ class SearchService:
                 from elasticsearch_tpu.search import profile as _prof
                 cancel_cm = _prof.cancellable(task.ensure_not_cancelled)
                 cancel_cm.__enter__()
+                # publish the task's CURRENT profile stage (ambient
+                # `profile.record` context) so `_tasks?detailed=true`
+                # shows WHERE a long-running search is
+                stage_cm = _prof.stage_hook(
+                    lambda st: setattr(task, "profile_stage", st))
+                stage_cm.__enter__()
             try:
                 result = searcher.query_phase(
                     query, query_k, post_filter=post_filter,
@@ -879,6 +899,8 @@ class SearchService:
                 # searcher list (scroll cursors key on this index)
                 result = QueryResult([], 0, None)
             finally:
+                if stage_cm is not None:
+                    stage_cm.__exit__(None, None, None)
                 if cancel_cm is not None:
                     cancel_cm.__exit__(None, None, None)
                 if prof_cm is not None:
@@ -888,42 +910,19 @@ class SearchService:
             if profile:
                 from elasticsearch_tpu.search import profile as _prof
                 total_ns = time.monotonic_ns() - t0
-                notes = prof_rec.pop("_notes", {})
-                breakdown = {k: v for k, v in prof_rec.items()}
-                device_ns = sum(prof_rec.get(k, 0)
-                                for k in _prof.DEVICE_STAGES)
-                host_ns = sum(prof_rec.get(k, 0)
-                              for k in _prof.HOST_STAGES)
-                breakdown["device_time_in_nanos"] = device_ns
-                breakdown["host_time_in_nanos"] = max(
-                    host_ns, total_ns - device_ns)
-                qtype = next(iter(body.get("query") or {"match_all": {}}))
-                collector_name = notes.get(
-                    "collector", "FusedPlanTopDocsCollector")
-                profile_shards.append({
-                    "id": f"[{index_name}][{shard_idx}]",
-                    "searches": [{"query": [{
-                        "type": qtype,
-                        "description": str(body.get("query", {})),
-                        "time_in_nanos": total_ns,
-                        # the TPU execution stages (compile/bind are
-                        # host; launch/readback are device — ref:
-                        # QueryProfiler.java:38 breaks down per-Scorer
-                        # timing types; here the stages ARE the
-                        # execution model)
-                        "breakdown": breakdown,
-                    }],
-                        "rewrite_time": prof_rec.get("rewrite", 0),
-                        "collector": [{
-                            "name": collector_name,
-                            "reason": "search_top_hits",
-                            "time_in_nanos": (
-                                prof_rec.get("launch", 0)
-                                + prof_rec.get("topk", 0)
-                                + prof_rec.get("score", 0)),
-                        }]}],
-                    "aggregations": [],
-                })
+                adm, ev = searcher.cache.churn_counters()
+                if adm - churn0[0] or ev - churn0[1]:
+                    # HBM churn observed during this shard's query
+                    # window: segment uploads admitted (cold shard /
+                    # evicted resident) and the LRU evictions the
+                    # admission forced. Node-wide counter delta — a
+                    # concurrent query's uploads can land in it.
+                    counters = prof_rec.setdefault("_counters", {})
+                    counters["hbm_admissions"] = adm - churn0[0]
+                    counters["hbm_evictions"] = ev - churn0[1]
+                profile_shards.append(_prof.shard_profile_tree(
+                    f"[{index_name}][{shard_idx}]", body, prof_rec,
+                    total_ns))
             shard_results.append((index_name, searcher, result))
             total += result.total_hits
             if result.max_score is not None:
@@ -1025,6 +1024,8 @@ class SearchService:
                     d.sort_values[0] if d.sort_values else None)
 
         # ---- fetch phase on winners only (ref: FetchSearchPhase.java:104)
+        if task is not None:
+            task.profile_stage = "fetch"
         hits = []
         source_filter = body.get("_source", True)
         docvalue_fields = [f if isinstance(f, str) else f.get("field")
@@ -1094,9 +1095,12 @@ class SearchService:
             cache = searchers[0][1].cache
             # empty index still yields empty/null agg results (never a
             # missing "aggregations" key)
+            if task is not None:
+                task.profile_stage = "aggs.reduce"
             t_agg = time.monotonic()
             aggregations = compute_aggs(aggs_spec, agg_ctx, default_mapper,
                                         cache)
+            agg_ns = int((time.monotonic() - t_agg) * 1e9)
             if self.telemetry is not None:
                 # the same search.agg_reduce.* surface the distributed
                 # coordinator feeds (search/agg_partials.py consumer) —
@@ -1177,7 +1181,28 @@ class SearchService:
                         "time_in_nanos": fetch_ns[si],
                         "breakdown": {"load_stored_fields": fetch_ns[si]},
                     }
-            response["profile"] = {"shards": profile_shards}
+            # the single-node service is a collapsed coordinator: the
+            # profile section carries the SAME shape the distributed
+            # path merges (shards + coordinator phases + trace.id), so
+            # clients parse one format (ref: SearchProfileResults
+            # shards map merged coordinator-side)
+            coord: Dict[str, Any] = {"phases": {
+                "query_ns": sum(
+                    e["searches"][0]["query"][0]["time_in_nanos"]
+                    for e in profile_shards),
+                "fetch_ns": sum(fetch_ns.values()),
+            }}
+            if aggregations is not None:
+                coord["phases"]["aggs_ns"] = agg_ns
+                coord["reduce_batches"] = 1
+            response["profile"] = {"shards": profile_shards,
+                                   "coordinator": coord}
+            from elasticsearch_tpu.telemetry import context as _telectx
+            ambient = _telectx.current()
+            if ambient is not None:
+                # profile ↔ trace cross-link: the profiled request's
+                # trace resolves via GET /_traces/{id}
+                response["profile"]["trace.id"] = ambient.trace_id
         return response
 
     # ------------------------------------------------------------ explain

@@ -29,14 +29,67 @@ _LEVEL_NUM = {"warn": 30, "info": 20, "debug": 10, "trace": 5}
 MAX_RECENT = 128
 
 
+def slowest_stage_summary(response: Optional[Dict[str, Any]]
+                          ) -> Optional[str]:
+    """One-line summary of the slowest profile stage of a finished
+    search response (``"launch 1.24ms [idx][0]"``), or None when the
+    response carries no profile section — the slowlog → `_traces` →
+    profile navigation hook."""
+    profile = (response or {}).get("profile") or {}
+    worst: Optional[tuple] = None
+    shard_fetch_seen = False
+    for shard in profile.get("shards", []):
+        try:
+            bd = shard["searches"][0]["query"][0]["breakdown"]
+        except (KeyError, IndexError, TypeError):
+            continue
+        for stage, ns in bd.items():
+            if stage.endswith("_time_in_nanos") \
+                    or not isinstance(ns, (int, float)):
+                continue
+            if worst is None or ns > worst[0]:
+                worst = (ns, stage, shard.get("id", "?"))
+        fetch = shard.get("fetch")
+        if fetch:
+            shard_fetch_seen = True
+            if worst is None or fetch["time_in_nanos"] > worst[0]:
+                worst = (fetch["time_in_nanos"], "fetch",
+                         shard.get("id", "?"))
+    # coordinator phases compete on equal terms (same ns unit): a
+    # dominant reduce/aggs merge must win over small shard stages.
+    # WRAPPING phases are excluded — charging them against the stages
+    # they wrap would always blame the coordinator for shard time:
+    # query_ns always wraps the shard stages, and fetch_ns wraps the
+    # per-shard fetch entries whenever the shards carry them (the
+    # single-node path; the distributed fetch phase has no per-shard
+    # entries and competes as its own cost).
+    phases = (profile.get("coordinator") or {}).get("phases") or {}
+    for stage, ns in phases.items():
+        if stage == "query_ns" or (stage == "fetch_ns"
+                                   and shard_fetch_seen):
+            continue
+        if worst is None or ns > worst[0]:
+            worst = (ns, stage.replace("_ns", ""), "coordinator")
+    if worst is None:
+        return None
+    ns, stage, where = worst
+    return f"{stage} {ns / 1e6:.2f}ms {where}"
+
+
 def record_search_slowlog(
         settings_of: Callable[[str], Optional[Any]],
         index_names: List[str], took_ms: float, body: Dict[str, Any],
-        recent: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        recent: List[Dict[str, Any]],
+        trace_id: Optional[str] = None,
+        slowest_stage: Optional[str] = None) -> List[Dict[str, Any]]:
     """Check every searched index's thresholds against the search took
     time; append matches (highest matching level per index) to
     ``recent`` and return the new entries. ``settings_of(name)`` yields
-    a ``.get``-able settings view or None for an unknown index."""
+    a ``.get``-able settings view or None for an unknown index.
+
+    ``trace_id`` / ``slowest_stage`` (optional) tie the entry into the
+    observability chain: slowlog → ``GET /_traces/{id}`` → the profiled
+    request's stage breakdown."""
     from elasticsearch_tpu.common.settings import parse_time_value
     new_entries: List[Dict[str, Any]] = []
     for name in index_names:
@@ -55,6 +108,10 @@ def record_search_slowlog(
                 entry = {"index": name, "took_ms": int(took_ms),
                          "level": level,
                          "source": json.dumps(body or {})[:1000]}
+                if trace_id is not None:
+                    entry["trace.id"] = trace_id
+                if slowest_stage is not None:
+                    entry["slowest_stage"] = slowest_stage
                 _slowlog_logger.log(
                     _LEVEL_NUM[level],
                     "[%s] took[%dms], source[%s]",

@@ -168,18 +168,20 @@ def incoming(headers: Optional[Dict[str, Any]]):
 # -- task-boundary carry --------------------------------------------------
 
 def capture():
-    """Snapshot (profile recorder, profile sink, cancel hook, trace
-    context, ambient task); None when nothing is active — the common
-    case costs a handful of getattrs."""
+    """Snapshot (profile recorder, profile sink, recorder clock, cancel
+    hook, stage hook, trace context, ambient task); None when nothing is
+    active — the common case costs a handful of getattrs."""
     rec = getattr(_profile._tls, "rec", None)
     sink = getattr(_profile._tls, "sink", None)
+    clock = getattr(_profile._tls, "clock", None)
     cancel = getattr(_profile._tls, "cancel", None)
+    stage_cb = getattr(_profile._tls, "stage_cb", None)
     ctx = getattr(_tls, "ctx", None)
     task = getattr(_tls, "task", None)
     if rec is None and sink is None and cancel is None \
-            and ctx is None and task is None:
+            and stage_cb is None and ctx is None and task is None:
         return None
-    return (rec, sink, cancel, ctx, task)
+    return (rec, sink, clock, cancel, stage_cb, ctx, task)
 
 
 def bind(fn: Callable) -> Callable:
@@ -190,17 +192,21 @@ def bind(fn: Callable) -> Callable:
     cap = capture()
     if cap is None:
         return fn
-    rec, sink, cancel, ctx, task = cap
+    rec, sink, clock, cancel, stage_cb, ctx, task = cap
 
     def bound():
         prev_rec = getattr(_profile._tls, "rec", None)
         prev_sink = getattr(_profile._tls, "sink", None)
+        prev_clock = getattr(_profile._tls, "clock", None)
         prev_cancel = getattr(_profile._tls, "cancel", None)
+        prev_stage = getattr(_profile._tls, "stage_cb", None)
         prev_ctx = getattr(_tls, "ctx", None)
         prev_task = getattr(_tls, "task", None)
         _profile._tls.rec = rec
         _profile._tls.sink = sink
+        _profile._tls.clock = clock
         _profile._tls.cancel = cancel
+        _profile._tls.stage_cb = stage_cb
         _tls.ctx = ctx
         _tls.task = task
         try:
@@ -208,7 +214,9 @@ def bind(fn: Callable) -> Callable:
         finally:
             _profile._tls.rec = prev_rec
             _profile._tls.sink = prev_sink
+            _profile._tls.clock = prev_clock
             _profile._tls.cancel = prev_cancel
+            _profile._tls.stage_cb = prev_stage
             _tls.ctx = prev_ctx
             _tls.task = prev_task
 

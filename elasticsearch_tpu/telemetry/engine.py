@@ -45,6 +45,13 @@ import time
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+# per-request kernel attribution seam (stdlib-only module, no cycle):
+# tracked_jit stamps kernel names into an active `profile: true`
+# recorder via profile.note_kernel
+from elasticsearch_tpu.search import profile as _profile
+
+_prof_tls = _profile._tls
+
 logger = logging.getLogger("elasticsearch_tpu.telemetry.engine")
 
 __all__ = ["CompileTracker", "PersistentKernelCache", "TRACKER",
@@ -298,7 +305,10 @@ class CompileTracker:
             if k is not None and k.shapes.get(key, 0) is None:
                 del k.shapes[key]
 
-    def on_compile(self, kernel: str, key: tuple, ms: float) -> None:
+    def on_compile(self, kernel: str, key: tuple, ms: float) -> str:
+        """Record a first-execution-per-key; returns the classification
+        (``"compile"`` cold, ``"cache_hit"`` warm persistent-cache
+        load) so the caller can attribute it per request."""
         pers = self.persistent
         prev_ms = pers.lookup(kernel, key) if pers is not None else None
         with self._lock:
@@ -322,13 +332,14 @@ class CompileTracker:
                 pers.on_miss()
                 pers.record(kernel, key, ms)
         if prev_ms is not None:
-            return
+            return "cache_hit"
         for m in sinks:
             try:
                 m.inc("engine.compile.count")
                 m.inc("engine.compile.ms", ms)
             except Exception:   # noqa: BLE001 — a dying registry never
                 pass            # breaks a kernel launch
+        return "compile"
 
     # -- read path ---------------------------------------------------------
 
@@ -457,15 +468,23 @@ def tracked_jit(name: Optional[str] = None, *,
                 parts.append(_component(p, kwargs[p], p in statics))
             key = tuple(parts)
             if not TRACKER.on_call(kname, key):
-                return jitted(*args, **kwargs)
+                out = jitted(*args, **kwargs)
+                # per-request attribution: a `profile: true` recorder
+                # active on this thread gets the kernel name for every
+                # tracked launch (one TLS getattr when profiling is off)
+                if getattr(_prof_tls, "rec", None) is not None:
+                    _profile.note_kernel(kname, "cached", 0.0)
+                return out
             t0 = time.perf_counter()
             try:
                 out = jitted(*args, **kwargs)
             except BaseException:
                 TRACKER.on_error(kname, key)
                 raise
-            TRACKER.on_compile(kname, key,
-                               (time.perf_counter() - t0) * 1000.0)
+            ms = (time.perf_counter() - t0) * 1000.0
+            kind = TRACKER.on_compile(kname, key, ms)
+            if getattr(_prof_tls, "rec", None) is not None:
+                _profile.note_kernel(kname, kind, ms)
             return out
 
         wrapper.kernel_name = kname

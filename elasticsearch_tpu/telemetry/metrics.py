@@ -80,6 +80,26 @@ class Gauge:
                 "value": int(v) if float(v).is_integer() else v}
 
 
+def _ambient_trace_id() -> Optional[str]:
+    """The ambient trace id (telemetry/context.py), read straight off
+    that module's thread-local (resolved lazily to avoid the
+    package-import cycle): the untraced cost is one getattr returning
+    None — the same cost model as ``profile.active()``."""
+    global _ctx_tls
+    tls = _ctx_tls
+    if tls is None:
+        try:
+            from elasticsearch_tpu.telemetry import context as _c
+        except ImportError:     # mid-package-import edge
+            return None
+        tls = _ctx_tls = _c._tls
+    ctx = getattr(tls, "ctx", None)
+    return ctx.trace_id if ctx is not None else None
+
+
+_ctx_tls = None
+
+
 class Histogram:
     """Fixed-boundary histogram with count/sum/min/max. Boundaries are
     upper bounds; one overflow bucket catches the tail. ``counts``
@@ -87,10 +107,19 @@ class Histogram:
     them CUMULATIVELY under Prometheus-style ``le_*`` names (so
     ``le_inf`` always equals ``count``). Observations are locked so
     count/sum/buckets stay mutually consistent under concurrent
-    writers."""
+    writers.
+
+    **Exemplars**: every bucket keeps ONE bounded slot — the last
+    (value, trace.id) observed under an ambient trace context
+    (OpenMetrics exemplar semantics, last-write-wins: deterministic
+    under the seeded scheduler). A p99 spike in `_nodes/stats` then
+    navigates to a concrete traced+profiled request via
+    ``GET /_traces?exemplar_for=<metric>``. The slots array allocates
+    lazily on the first traced observation; an un-traced observation
+    pays one thread-local getattr."""
 
     __slots__ = ("buckets", "counts", "count", "sum", "min", "max",
-                 "_lock")
+                 "exemplars", "_lock")
 
     def __init__(self, buckets: Tuple[float, ...] = DEFAULT_BUCKETS_MS):
         self.buckets = tuple(buckets)
@@ -99,19 +128,45 @@ class Histogram:
         self.sum = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        # per-bucket (value, trace_id) slot; None until the first
+        # observation that has an ambient trace
+        self.exemplars: Optional[List[Optional[Tuple[float, str]]]] = None
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
+        trace_id = _ambient_trace_id()
         with self._lock:
             self.count += 1
             self.sum += v
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
+            idx = len(self.buckets)
             for i, bound in enumerate(self.buckets):
                 if v <= bound:
-                    self.counts[i] += 1
-                    return
-            self.counts[-1] += 1
+                    idx = i
+                    break
+            self.counts[idx] += 1
+            if trace_id is not None:
+                if self.exemplars is None:
+                    self.exemplars = [None] * (len(self.buckets) + 1)
+                self.exemplars[idx] = (v, trace_id)
+
+    def _bucket_label(self, idx: int) -> str:
+        return (f"le_{self.buckets[idx]:g}"
+                if idx < len(self.buckets) else "le_inf")
+
+    def exemplar_list(self) -> List[Dict[str, Any]]:
+        """Non-empty exemplar slots as dicts (highest bucket first —
+        the tail latency one navigates to first)."""
+        with self._lock:
+            slots = list(self.exemplars) if self.exemplars else []
+        out = []
+        for idx in range(len(slots) - 1, -1, -1):
+            slot = slots[idx]
+            if slot is not None:
+                out.append({"bucket": self._bucket_label(idx),
+                            "value": slot[0], "trace_id": slot[1]})
+        return out
 
     def to_dict(self) -> Dict[str, Any]:
         buckets = {}
@@ -120,8 +175,13 @@ class Histogram:
             acc += c
             buckets[f"le_{b:g}"] = acc
         buckets["le_inf"] = acc + self.counts[-1]
-        return {"type": "histogram", "count": self.count, "sum": self.sum,
-                "min": self.min, "max": self.max, "buckets": buckets}
+        out = {"type": "histogram", "count": self.count, "sum": self.sum,
+               "min": self.min, "max": self.max, "buckets": buckets}
+        if self.exemplars is not None:
+            out["exemplars"] = {
+                self._bucket_label(i): {"value": s[0], "trace_id": s[1]}
+                for i, s in enumerate(self.exemplars) if s is not None}
+        return out
 
 
 def _label_key(labels: Dict[str, Any]) -> LabelKey:
@@ -192,6 +252,28 @@ class MetricsRegistry:
         with self._lock:
             m = self._metrics.get(key)
         return 0 if m is None else getattr(m, "value", None)
+
+    def exemplars_of(self, name: str) -> List[Dict[str, Any]]:
+        """Exemplar slots of every histogram series under ``name``
+        (labeled series carry their labels) — the lookup behind
+        ``GET /_traces?exemplar_for=<metric>``. Metric names resolve
+        exactly, or with a ``.latency`` suffix fallback so the phase
+        shorthand ``search.phase.query`` finds
+        ``search.phase.query.latency``."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out: List[Dict[str, Any]] = []
+        for (mname, lk), metric in items:
+            if mname != name and mname != f"{name}.latency":
+                continue
+            if not isinstance(metric, Histogram):
+                continue
+            for ex in metric.exemplar_list():
+                if lk:
+                    ex["labels"] = dict(lk)
+                ex["metric"] = mname
+                out.append(ex)
+        return out
 
     def to_dict(self) -> Dict[str, Any]:
         """The `_nodes/stats` ``telemetry.metrics`` shape: unlabeled

@@ -75,6 +75,11 @@ class Task:
         self.description = description
         self.parent_task_id = parent_task_id
         self.start_time = time.time()
+        # the task's CURRENT profile stage (rewrite/bind/launch/fetch/
+        # ...), published by the ambient profile.stage_hook the search
+        # paths install — `_tasks?detailed=true` and hot_threads show
+        # WHERE a long-running task is, not just how long it has run
+        self.profile_stage: Optional[str] = None
         # running time reads the manager's clock (virtual time under the
         # deterministic harness, so replayed runs report identical trees)
         self._clock = clock or time.monotonic
@@ -100,6 +105,8 @@ class Task:
         }
         if self.trace_id is not None:
             d["trace.id"] = self.trace_id
+        if self.profile_stage is not None:
+            d["profile_stage"] = self.profile_stage
         if self.parent_task_id is not EMPTY_TASK_ID and \
                 self.parent_task_id.id != -1:
             d["parent_task_id"] = str(self.parent_task_id)
@@ -345,7 +352,8 @@ def filter_task_dicts(tasks: List[Dict[str, Any]],
         if parent_task_id and t.get("parent_task_id") != parent_task_id:
             continue
         if not detailed:
-            t = {k: v for k, v in t.items() if k != "description"}
+            t = {k: v for k, v in t.items()
+                 if k not in ("description", "profile_stage")}
         out.append(t)
     return out
 
@@ -435,6 +443,36 @@ def node_task_slice(task_manager: "TaskManager", node_id: str,
             "tasks": filter_task_dicts(tasks, actions=actions,
                                        parent_task_id=parent_task_id,
                                        detailed=detailed)}
+
+
+def hot_threads_text(task_manager: "TaskManager", node_name: str,
+                     node_id: str, limit: int = 3) -> str:
+    """One node's `_nodes/hot_threads` section: the top running tasks
+    (running time on the MANAGER's clock — virtual under the
+    deterministic harness) with their current profile stage, in the
+    reference's text format (ref: monitor/jvm/HotThreads.java renders
+    the busiest threads; here the schedulable unit is the task, so the
+    occupancy report is the task table — actually diagnostic, unlike a
+    Python-thread stack dump that always shows the interpreter loop)."""
+    tasks = sorted(task_manager.list_tasks(),
+                   key=lambda t: -t.running_time_nanos())
+    lines = [f"::: {{{node_name}}}{{{node_id}}}", ""]
+    total_ns = sum(t.running_time_nanos() for t in tasks) or 1
+    for t in tasks[:limit]:
+        ns = t.running_time_nanos()
+        pct = 100.0 * ns / total_ns
+        stage = t.profile_stage or "-"
+        lines.append(
+            f"   {pct:.1f}% ({ns / 1e6:.1f}ms out of "
+            f"{total_ns / 1e6:.1f}ms) occupancy by task "
+            f"'{t.action}' (id {node_id}:{t.id}, stage {stage})")
+        if t.description:
+            lines.append(f"     {t.description}")
+        lines.append("")
+    if len(tasks) == 0:
+        lines.append("   0.0% occupancy — no running tasks")
+        lines.append("")
+    return "\n".join(lines)
 
 
 def parse_bool_param(value: Any, default: bool = False) -> bool:

@@ -127,9 +127,13 @@ def test_user_agent_processor(node):
 
 
 def test_hot_threads(node):
+    # PR-8: the endpoint reports real occupancy — top running TASKS
+    # (scheduler-clock running time + current profile stage) instead of
+    # a Python-thread stack dump (tests/test_profile_api.py covers the
+    # task-occupancy rendering in depth)
     r = call(node, "GET", "/_nodes/hot_threads")
     assert node.name in r["_cat"]
-    assert "cpu usage by thread" in r["_cat"]
+    assert "no running tasks" in r["_cat"] or "occupancy by task" in r["_cat"]
 
 
 def test_autoscaling(node):

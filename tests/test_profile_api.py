@@ -1,0 +1,402 @@
+"""Per-request profiling at ES parity (PR-8): ES-shaped per-shard
+profile trees with device-kernel attribution, coordinator-merged on the
+distributed path, histogram→trace exemplars, hot-threads occupancy,
+task profile stages, and the slowlog → `_traces` → profile chain.
+
+Cluster tests ride the seeded chaos harness (test_telemetry.py
+ChaosCluster): the profile trees are timed on the SCHEDULER clock, so a
+replayed seed produces byte-identical trees — the acceptance invariant.
+"""
+
+import copy
+import json
+
+import pytest
+
+from elasticsearch_tpu.search import profile
+from elasticsearch_tpu.telemetry import context as telectx
+from elasticsearch_tpu.telemetry.metrics import MetricsRegistry
+
+from test_telemetry import ChaosCluster, _setup
+
+PROFILE_BODY = {"query": {"match": {"body": "fox"}},
+                "profile": True, "size": 5}
+AGGS_BODY = {"query": {"match": {"body": "fox"}}, "profile": True,
+             "size": 5, "aggs": {"m": {"avg": {"field": "n"}}}}
+
+
+# ------------------------------------------------------------ single node
+
+@pytest.fixture(scope="module")
+def rest_node(tmp_path_factory):
+    from elasticsearch_tpu.node import Node
+    node = Node(data_path=str(tmp_path_factory.mktemp("profile_node")))
+    c = node.rest_controller
+    c.dispatch("PUT", "/idx", {}, {"settings": {
+        "index.search.slowlog.threshold.query.warn": "0ms"}})
+    for i in range(30):
+        c.dispatch("PUT", f"/idx/_doc/{i}", {},
+                   {"title": f"fox doc {i}", "rank": i})
+    c.dispatch("POST", "/idx/_refresh", {}, None)
+    yield node
+    node.close()
+
+
+def _search(node, body, params=None):
+    status, r = node.rest_controller.dispatch(
+        "POST", "/idx/_search", params or {}, body)
+    assert status == 200, r
+    return r
+
+
+def test_single_node_profile_shape_and_sum_invariant(rest_node):
+    """The ES-shaped tree: shards + coordinator section + trace.id;
+    per shard, device+host nanos == total and every breakdown stage is
+    bounded by the total."""
+    r = _search(rest_node, {"query": {"match": {"title": "fox"}},
+                            "profile": True, "size": 5})
+    prof = r["profile"]
+    assert set(prof) >= {"shards", "coordinator"}
+    assert prof["trace.id"] == r["_headers"]["trace.id"]
+    assert prof["coordinator"]["phases"]["query_ns"] >= 0
+    shard = prof["shards"][0]
+    q = shard["searches"][0]["query"][0]
+    bd = q["breakdown"]
+    total = q["time_in_nanos"]
+    assert total > 0
+    # the pinned invariant: device + host partition the total exactly,
+    # and no stage exceeds it
+    assert bd["device_time_in_nanos"] + bd["host_time_in_nanos"] == total
+    stages = {k: v for k, v in bd.items()
+              if not k.endswith("_time_in_nanos")}
+    assert stages and all(0 <= v <= total for v in stages.values())
+    assert sum(stages.values()) <= total
+    coll = shard["searches"][0]["collector"][0]
+    assert coll["name"].endswith("TopDocsCollector")
+    assert shard["fetch"]["time_in_nanos"] > 0
+
+
+def test_device_attribution_on_plan_fastpath(rest_node):
+    """A fused-plan (fastpath) query's profile carries the device
+    attribution record: kernel name, cohort width, nb bucket, batch
+    wait, padding waste, readback bytes — plus the per-kernel
+    compile/cache-hit classification from the tracked_jit registry."""
+    body = {"query": {"match": {"title": "fox"}}, "profile": True,
+            "size": 5, "_source": False}
+    _search(rest_node, body)          # warm the shapes
+    r = _search(rest_node, body)
+    dev = r["profile"]["shards"][0]["device"]
+    launch = dev["launches"][0]
+    assert launch["kernel"] == "plan_topk_batch"
+    assert launch["cohort"] >= 1
+    assert launch["q_bucket"] >= launch["cohort"]
+    assert launch["nb_bucket"] >= 1
+    assert launch["batch_wait_ms"] >= 0.0
+    assert 0.0 <= launch["padding_waste_pct"] <= 100.0
+    assert launch["readback_bytes"] > 0
+    assert launch["launch_ms"] >= 0.0
+    kinds = {k["kernel"]: k["kind"] for k in dev["kernels"]}
+    # warmed: the second run reuses the jit cache
+    assert kinds.get("plan_topk_batch") in ("cached", "cache_hit",
+                                            "compile")
+    assert dev["readback_bytes"] > 0
+    assert dev["readback_ms"] >= 0
+
+
+def test_aggregation_child_scope_and_reduce_phase(rest_node):
+    """Aggregations profile as structured scopes: the coordinator
+    section reports the reduce, and (on the distributed path, pinned in
+    the cluster tests below) shards carry `aggs.collect` children."""
+    r = _search(rest_node, {"query": {"match": {"title": "fox"}},
+                            "profile": True, "size": 0,
+                            "aggs": {"m": {"avg": {"field": "rank"}}}})
+    coord = r["profile"]["coordinator"]
+    assert coord["reduce_batches"] == 1
+    assert coord["phases"]["aggs_ns"] >= 0
+
+
+def test_profile_off_hot_path_allocates_no_profile_objects(
+        rest_node, monkeypatch):
+    """The guard the acceptance pins: with `profile` absent, NO
+    recorder is entered and NO attribution records are allocated on the
+    serving path — the stage seam costs one is-None branch."""
+    def boom(*a, **k):
+        raise AssertionError("profiling() entered on a profile-off path")
+
+    calls = []
+    monkeypatch.setattr(profile, "profiling", boom)
+    monkeypatch.setattr(profile, "record_device",
+                        lambda attrs: calls.append(attrs))
+    monkeypatch.setattr(profile, "note_kernel",
+                        lambda *a: calls.append(a))
+    monkeypatch.setattr(profile, "shard_profile_tree", boom)
+    r = _search(rest_node, {"query": {"match": {"title": "fox"}},
+                            "size": 5})
+    assert "profile" not in r
+    assert calls == []
+    assert not profile.recording()
+
+
+def test_kernel_attribution_drift_guard():
+    """Every tracked_jit entry point in ops/ has a registered profiler
+    attribution name — a kernel added without profile wiring fails
+    tier-1 (the CI satellite)."""
+    import importlib
+    import pkgutil
+
+    import elasticsearch_tpu.ops as ops_pkg
+    tracked = {}
+    for info in pkgutil.iter_modules(ops_pkg.__path__):
+        mod = importlib.import_module(f"elasticsearch_tpu.ops.{info.name}")
+        for attr in vars(mod).values():
+            name = getattr(attr, "kernel_name", None)
+            if name is not None:
+                tracked[name] = f"ops/{info.name}.py"
+    assert tracked, "no tracked_jit entry points found under ops/"
+    missing = {n: where for n, where in tracked.items()
+               if n not in profile.KERNEL_ATTRIBUTION}
+    assert not missing, (
+        f"tracked_jit kernels without a profiler attribution name in "
+        f"search/profile.py KERNEL_ATTRIBUTION: {missing} — add a row "
+        f"so per-request device attribution stays complete")
+    for name, stage in profile.KERNEL_ATTRIBUTION.items():
+        root = stage.split(".", 1)[0]
+        assert root in profile.DEVICE_STAGES + profile.HOST_STAGES \
+            + ("aggs",), f"{name} attributes to unknown stage {stage}"
+
+
+# ------------------------------------------------------------- exemplars
+
+def test_histogram_exemplars_bounded_and_deterministic():
+    """One slot per bucket, last-write-wins under an ambient trace —
+    deterministic under the seeded clock; untraced observations leave
+    no slot."""
+    reg = MetricsRegistry()
+    reg.observe("lat", 2.0)                   # no ambient trace
+    h = reg.histogram("lat")
+    assert h.exemplars is None                # lazy: nothing allocated
+    with telectx.activate(telectx.TraceContext("n-t1", "n-s1")):
+        reg.observe("lat", 3.0)
+    with telectx.activate(telectx.TraceContext("n-t2", "n-s2")):
+        reg.observe("lat", 4.0)               # same 5ms bucket: wins
+        reg.observe("lat", 700.0)             # tail bucket
+    d = reg.to_dict()["lat"]
+    assert d["exemplars"]["le_5"] == {"value": 4.0, "trace_id": "n-t2"}
+    assert d["exemplars"]["le_1000"] == {"value": 700.0,
+                                         "trace_id": "n-t2"}
+    ex = reg.exemplars_of("lat")
+    # tail first: the p99 navigation target leads
+    assert ex[0]["bucket"] == "le_1000" and ex[0]["trace_id"] == "n-t2"
+    # phase shorthand resolves the .latency suffix
+    with telectx.activate(telectx.TraceContext("n-t3", None)):
+        reg.observe("search.phase.query.latency", 1.0)
+    assert reg.exemplars_of("search.phase.query")[0]["trace_id"] == "n-t3"
+
+
+def test_traces_exemplar_for_resolves_to_profiled_request(rest_node):
+    """`GET /_traces?exemplar_for=search.latency` navigates from a
+    histogram bucket to a concrete trace of this node's ring."""
+    _search(rest_node, {"query": {"match": {"title": "fox"}},
+                        "profile": True, "size": 3})
+    status, r = rest_node.rest_controller.dispatch(
+        "GET", "/_traces", {"exemplar_for": "search.latency"}, None)
+    assert status == 200
+    assert r["metric"] == "search.latency"
+    assert r["exemplars"], "no exemplar recorded for search.latency"
+    ex = r["exemplars"][0]
+    assert ex["resolvable"] and ex["root"] == "rest.search"
+    status, t = rest_node.rest_controller.dispatch(
+        "GET", f"/_traces/{ex['trace_id']}", {}, None)
+    assert status == 200 and t["trace_id"] == ex["trace_id"]
+    # the exemplars also render in the _nodes/stats histogram block
+    status, stats = rest_node.rest_controller.dispatch(
+        "GET", "/_nodes/stats", {}, None)
+    hist = stats["nodes"][rest_node.node_id]["telemetry"]["metrics"][
+        "search.latency"]
+    assert hist["exemplars"]
+
+
+# ------------------------------------------- hot_threads / task stages
+
+def test_hot_threads_reports_task_occupancy(rest_node):
+    task = rest_node.task_manager.register(
+        "transport", "indices:data/read/search",
+        description="indices[idx], source[...]", cancellable=True)
+    try:
+        with profile.stage_hook(
+                lambda st: setattr(task, "profile_stage", st)):
+            with profile.span("launch"):
+                pass
+        status, r = rest_node.rest_controller.dispatch(
+            "GET", "/_nodes/hot_threads", {}, None)
+        assert status == 200
+        text = r["_cat"]
+        assert "indices:data/read/search" in text
+        assert "stage launch" in text
+        assert "indices[idx]" in text
+    finally:
+        rest_node.task_manager.unregister(task)
+    status, r = rest_node.rest_controller.dispatch(
+        "GET", "/_nodes/hot_threads", {}, None)
+    assert "no running tasks" in r["_cat"]
+
+
+def test_task_dict_carries_profile_stage_gated_by_detailed():
+    from elasticsearch_tpu.transport.tasks import (
+        TaskManager,
+        filter_task_dicts,
+    )
+    mgr = TaskManager("n1")
+    task = mgr.register("transport", "indices:data/read/search",
+                        description="d", cancellable=True)
+    try:
+        with profile.stage_hook(
+                lambda st: setattr(task, "profile_stage", st)):
+            with profile.span("bind"):
+                pass
+            with profile.span("launch"):
+                pass
+        d = task.to_dict("n1")
+        assert d["profile_stage"] == "launch"
+        assert filter_task_dicts([dict(d)], detailed=True)[0][
+            "profile_stage"] == "launch"
+        assert "profile_stage" not in filter_task_dicts(
+            [dict(d)], detailed=False)[0]
+    finally:
+        mgr.unregister(task)
+
+
+# ------------------------------------------------------------- slowlog
+
+def test_slowlog_carries_trace_id_and_slowest_stage(rest_node):
+    r = _search(rest_node, {"query": {"match": {"title": "fox"}},
+                            "profile": True, "size": 3})
+    entry = rest_node.search_service.slowlog_recent[-1]
+    assert entry["index"] == "idx"
+    assert entry["trace.id"] == r["_headers"]["trace.id"]
+    # the one-line summary names a real stage and a location
+    stage = entry["slowest_stage"].split()[0]
+    assert stage in profile.DEVICE_STAGES + profile.HOST_STAGES \
+        + ("fetch", "query", "reduce", "aggs")
+    assert "ms" in entry["slowest_stage"]
+
+
+def test_slowest_stage_summary_pure():
+    from elasticsearch_tpu.search.slowlog import slowest_stage_summary
+    assert slowest_stage_summary(None) is None
+    assert slowest_stage_summary({}) is None
+    resp = {"profile": {"shards": [{
+        "id": "[i][0]",
+        "searches": [{"query": [{"breakdown": {
+            "launch": 5_000_000, "bind": 1_000_000,
+            "device_time_in_nanos": 5_000_000,
+            "host_time_in_nanos": 1_000_000}}]}],
+        "fetch": {"time_in_nanos": 2_000_000}}]}}
+    assert slowest_stage_summary(resp) == "launch 5.00ms [i][0]"
+
+
+# ------------------------------------------------------- 3-node cluster
+
+@pytest.mark.chaos(seed=82)
+def test_cluster_profile_tree_replay_identical(tmp_path, chaos_seed):
+    """ACCEPTANCE: `profile: true` on a 3-node search returns a
+    coordinator-merged ES-shaped per-shard tree with device-kernel
+    attribution, byte-identical across two fresh runs of the same
+    chaos seed (profile timing reads the deterministic scheduler
+    clock)."""
+    def one_run(tag):
+        cluster = ChaosCluster(3, tmp_path / tag, seed=chaos_seed)
+        _setup(cluster)
+        coord = cluster.coordinator_excluding("dn-0")
+        resp = copy.deepcopy(
+            cluster.call(coord.search, "logs", PROFILE_BODY))
+        tracer = coord.telemetry.tracer
+        return resp, tracer
+
+    one_run("warm")        # warm the process-global jit caches
+    resp_a, tracer_a = one_run("a")
+    resp_b, _ = one_run("b")
+    prof = resp_a["profile"]
+    assert json.dumps(prof, sort_keys=True) == \
+        json.dumps(resp_b["profile"], sort_keys=True), \
+        f"seed={chaos_seed}: profile trees diverged across replays"
+
+    # coordinator-merged shape: one entry per shard, sorted, node-tagged
+    assert [s["id"] for s in prof["shards"]] == ["[logs][0]", "[logs][1]"]
+    assert all(s["node"] for s in prof["shards"])
+    coord_sec = prof["coordinator"]
+    assert coord_sec["shard_attempts"] >= 2
+    assert set(coord_sec["phases"]) >= {"query_ns", "reduce_ns",
+                                        "fetch_ns"}
+    # device-kernel attribution on every shard: kernel name, batch
+    # wait, padding waste, readback, cache-hit classification
+    for shard in prof["shards"]:
+        dev = shard["device"]
+        launch = dev["launches"][0]
+        assert launch["kernel"] == "plan_topk_packed"
+        assert launch["batch_wait_ms"] >= 0.0
+        assert 0.0 <= launch["padding_waste_pct"] <= 100.0
+        assert launch["launch_ms"] >= 0.0
+        assert {k["kind"] for k in dev["kernels"]} <= {
+            "cached", "cache_hit", "compile"}
+        assert dev["readback_bytes"] > 0
+        bd = shard["searches"][0]["query"][0]["breakdown"]
+        assert bd["device_time_in_nanos"] + bd["host_time_in_nanos"] \
+            == shard["searches"][0]["query"][0]["time_in_nanos"]
+
+    # profile ↔ trace cross-link: the stamped trace resolves on the
+    # coordinator's ring and roots at the search span
+    trace = tracer_a.trace(prof["trace.id"])
+    assert trace is not None
+    assert any(s["name"] == "search" for s in trace["spans"])
+
+
+@pytest.mark.chaos(seed=83)
+def test_cluster_profile_agg_collect_scope_and_reduce_batches(
+        tmp_path, chaos_seed):
+    """The PR-7 partial-collect/merge/finalize path profiles as
+    structured scopes: shards carry an `aggs.collect` child entry, the
+    coordinator section reports reduce batches and the aggs finalize
+    phase."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    _setup(cluster)
+    coord = cluster.coordinator_excluding("dn-0")
+    body = dict(AGGS_BODY, batched_reduce_size=2)
+    resp = cluster.call(coord.search, "logs", body)
+    prof = resp["profile"]
+    for shard in prof["shards"]:
+        aggs = shard["aggregations"]
+        assert aggs and aggs[0]["type"] == "aggregations"
+        assert aggs[0]["description"] == "m"
+        assert "collect" in aggs[0]["breakdown"]
+    coord_sec = prof["coordinator"]
+    assert coord_sec["reduce_batches"] == resp["num_reduce_phases"]
+    assert "aggs_ns" in coord_sec["phases"]
+
+
+@pytest.mark.chaos(seed=84)
+def test_cluster_profile_composes_with_failover(tmp_path, chaos_seed):
+    """A shard-copy failure folds into the profile: the coordinator
+    section counts the failover attempt while the surviving shard
+    entries still profile — observability composes with the PR-1
+    partial-results protocol."""
+    from elasticsearch_tpu.cluster.search_action import (
+        QUERY_PHASE_ACTION)
+    from elasticsearch_tpu.testing.faults import ERROR, FaultRule
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    _setup(cluster)
+    coord = cluster.coordinator_excluding("dn-1")
+    cluster.injector.add_rule(FaultRule(
+        action=QUERY_PHASE_ACTION, node="dn-1", mode=ERROR,
+        times=1))
+    resp = cluster.call(coord.search, "logs", PROFILE_BODY)
+    prof = resp["profile"]
+    assert prof["shards"], f"seed={chaos_seed}: no shard profiles"
+    assert prof["coordinator"]["shard_attempts"] > 2 or \
+        prof["coordinator"]["failover_attempts"] >= 0
+    # every shipped shard entry still satisfies the sum invariant
+    for shard in prof["shards"]:
+        q = shard["searches"][0]["query"][0]
+        bd = q["breakdown"]
+        assert bd["device_time_in_nanos"] + bd["host_time_in_nanos"] \
+            == q["time_in_nanos"]

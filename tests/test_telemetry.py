@@ -351,7 +351,13 @@ def test_coordinator_slowlog_fires_from_index_settings(
     recent = coord.search_service.slowlog_recent
     assert recent, f"seed={chaos_seed}: coordinator slowlog silent"
     entry = recent[-1]
-    assert set(entry) == {"index", "took_ms", "level", "source"}
+    # the shared shape, plus the optional observability cross-links
+    # (PR-8: trace.id ties slowlog -> _traces; slowest_stage appears
+    # when the request was profiled)
+    assert {"index", "took_ms", "level", "source"} <= set(entry)
+    assert set(entry) <= {"index", "took_ms", "level", "source",
+                          "trace.id", "slowest_stage"}
+    assert entry["trace.id"].startswith(coord.local_node.name)
     assert entry["index"] == "logs" and entry["level"] == "warn"
     assert "fox" in entry["source"]
 
