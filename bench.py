@@ -78,7 +78,7 @@ def remaining_budget() -> float:
 
 def emit(metric_text: str, value: float, vs_baseline: float,
          engine=None, overload=None, tasks=None, cpu=None,
-         serving=None, skipped=None, aggs=None):
+         serving=None, skipped=None, aggs=None, multichip=None):
     _LAST_PAYLOAD.clear()
     _LAST_PAYLOAD.update({
         "metric": metric_text,
@@ -102,6 +102,12 @@ def emit(metric_text: str, value: float, vs_baseline: float,
         # sections that did not run this round, with reasons — an rc=124
         # or device outage leaves a parseable record per section
         _LAST_PAYLOAD["skipped"] = skipped
+    if multichip:
+        # multi-chip serving scaling rows (ISSUE 9): qps at 1/2/4/8
+        # devices for sharded-corpus and replica-parallel modes — CPU
+        # virtual-device rows always bank; native rows carry typed
+        # `skipped` reasons behind the subprocess preflight
+        _LAST_PAYLOAD["multichip_serving"] = multichip
     if aggs:
         # aggregation-reduction rider (round-7): host vs device wall
         # time per agg family (metric moments / histogram scatter-add /
@@ -1435,6 +1441,320 @@ def run_profile_cpu(corpus, queries, n=32):
     }
 
 
+# ---------------------------------------------------------------------------
+# Multi-chip serving rows (ISSUE 9): qps at 1/2/4/8 devices for the two
+# mesh serving modes — sharded-corpus (one SPMD fan-out/merge program per
+# query, parallel/mesh_executor.py) and replica-parallel (continuous-
+# batching cohorts split their query axis over the mesh). EVERY row runs
+# in a SUBPROCESS: CPU rows pin a virtual-device mesh
+# (--xla_force_host_platform_device_count) so the section always banks
+# even with no accelerator, native rows only run when the shared
+# subprocess preflight passed — a wedge banks a typed `skipped` row,
+# never a timeout hole.
+# ---------------------------------------------------------------------------
+
+_MC_QUERY_VOCAB = ["amber", "basalt", "cedar", "dune", "ember", "fjord",
+                   "granite", "harbor", "islet", "juniper", "krill",
+                   "lagoon"]
+
+
+def _multichip_row(n_devices: int, mode: str) -> None:
+    """Subprocess entry (``bench.py --multichip-row N MODE``): ONE
+    scaling row, incrementally re-printed as JSON (the dryrun
+    convention — a kill mid-row still leaves a parseable record)."""
+    out = {"mode": mode, "requested_devices": n_devices}
+
+    def bank(**kw):
+        out.update(kw)
+        print(json.dumps({"multichip_row": out}), flush=True)
+
+    bank()
+    import jax
+
+    plats = (os.environ.get("JAX_PLATFORMS") or "").strip()
+    if plats:
+        # the axon site hook re-forces its platform during import —
+        # re-assert the caller's choice (cpu rows MUST stay cpu);
+        # native rows leave the default backend alone
+        jax.config.update("jax_platforms", plats.split(",")[0])
+    devices = len(jax.devices())
+    bank(devices=devices)
+    if mode == "sharded_corpus":
+        _multichip_row_sharded(bank, devices, n_devices)
+    else:
+        _multichip_row_replica(bank, devices)
+
+
+def _multichip_row_sharded(bank, devices: int, n_devices: int) -> None:
+    """REST `_search` qps through the product path: index with one
+    shard per device, pinned query mix (bm25 / bool+filter / knn),
+    mesh vs per-shard loop, with a parity check."""
+    import tempfile
+
+    from elasticsearch_tpu.node import Node
+
+    shards = max(1, min(n_devices, devices))
+    docs = int(os.environ.get("BENCH_MULTICHIP_DOCS", 3000))
+    n_q = int(os.environ.get("BENCH_MULTICHIP_QUERIES", 48))
+    rng = np.random.default_rng(11)
+    bodies = []
+    for i in range(n_q):
+        kind = i % 3
+        if kind == 0:
+            bodies.append({"query": {"match": {"title": " ".join(
+                rng.choice(_MC_QUERY_VOCAB, 2))}}, "size": 10})
+        elif kind == 1:
+            bodies.append({"query": {"bool": {
+                "must": [{"match": {"title": str(
+                    rng.choice(_MC_QUERY_VOCAB))}}],
+                "filter": [{"term": {"tag": str(
+                    rng.choice(["x", "y"]))}}]}}, "size": 10})
+        else:
+            bodies.append({"knn": {
+                "field": "vec",
+                "query_vector": rng.standard_normal(16).tolist(),
+                "k": 10, "num_candidates": 64},
+                "_source": False, "size": 10})
+    with tempfile.TemporaryDirectory() as tmp:
+        node = Node(data_path=tmp)
+        try:
+            rc = node.rest_controller
+            status, _ = rc.dispatch("PUT", "/mc", None, {
+                "settings": {"index": {"number_of_shards": shards}},
+                "mappings": {"properties": {
+                    "title": {"type": "text"},
+                    "tag": {"type": "keyword"},
+                    "vec": {"type": "dense_vector", "dims": 16,
+                            "similarity": "cosine"}}}})
+            assert status == 200, status
+            for i in range(docs):
+                rc.dispatch("PUT", f"/mc/_doc/{i}", None, {
+                    "title": " ".join(rng.choice(_MC_QUERY_VOCAB,
+                                                 rng.integers(2, 8))),
+                    "tag": str(rng.choice(["x", "y"])),
+                    "vec": rng.standard_normal(16).astype(
+                        np.float32).tolist()})
+            rc.dispatch("POST", "/mc/_refresh", None, None)
+            rc.dispatch("POST", "/mc/_forcemerge", None, None)
+            bank(shards=shards, docs=docs, build_ok=True)
+
+            def measure():
+                for b in bodies[:6]:        # warm compiles out of band
+                    rc.dispatch("POST", "/mc/_search", None, dict(b))
+                t0 = time.time()
+                hits = []
+                for b in bodies:
+                    st, r = rc.dispatch("POST", "/mc/_search", None,
+                                        dict(b))
+                    assert st == 200, (st, r)
+                    hits.append([(h["_id"], h["_score"])
+                                 for h in r["hits"]["hits"]])
+                return round(n_q / (time.time() - t0), 1), hits
+
+            svc = node.search_service
+            mesh_before = svc.mesh_executor.mesh_searches
+            qps_mesh, mesh_hits = measure()
+            mesh_used = svc.mesh_executor.mesh_searches - mesh_before
+            bank(qps_mesh=qps_mesh, mesh_searches=int(mesh_used),
+                 mesh=mesh_used > 0,
+                 counters=dict(svc.mesh_executor.counters))
+            os.environ["ESTPU_MESH_SERVING"] = "0"
+            try:
+                qps_loop, loop_hits = measure()
+            finally:
+                del os.environ["ESTPU_MESH_SERVING"]
+            bank(qps_loop=qps_loop,
+                 speedup=round(qps_mesh / qps_loop, 2) if qps_loop
+                 else None,
+                 parity=mesh_hits == loop_hits)
+        finally:
+            node.close()
+
+
+def _multichip_row_replica(bank, devices: int) -> None:
+    """Kernel-level cohort fan-out: a 32-query plan cohort launched
+    single-device vs replica-sharded over the mesh (corpus replicated,
+    Q axis split) — launches/s and byte parity."""
+    from __graft_entry__ import _synthetic_blocks
+    from elasticsearch_tpu.ops import plan as plan_ops
+    from elasticsearch_tpu.parallel.mesh_executor import MeshSearchBackend
+
+    nd = int(os.environ.get("BENCH_MULTICHIP_ND", 65536))
+    cohort = 32
+    rng = np.random.default_rng(7)
+    docids, tfs, zero_block = _synthetic_blocks(
+        rng, nd, n_terms=16, postings_per_term=2048)
+    lens = rng.integers(5, 60, size=nd).astype(np.float32)
+    live = np.ones(nd, bool)
+    nb = 64
+    sel = np.full((cohort, nb), zero_block, np.int32)
+    w = np.zeros((cohort, nb), np.float32)
+    for qi in range(cohort):
+        picks = rng.choice(16, size=3, replace=False)
+        for j, t in enumerate(picks):
+            lo = t * 16
+            sel[qi, j * 16:(j + 1) * 16] = np.arange(lo, lo + 16)
+            w[qi, j * 16:(j + 1) * 16] = 1.0 + 0.1 * j
+    grp = np.zeros((cohort, nb), np.int32)
+    sub = sel.copy()
+    cst = np.zeros((cohort, nb), bool)
+    gk = np.full((cohort, 4), plan_ops.SHOULD, np.int32)
+    gr = np.ones((cohort, 4), np.int32)
+    gc = np.full((cohort, 4), np.nan, np.float32)
+    scalars = [np.zeros(cohort, np.int32)] * 3 + \
+        [np.zeros(cohort, np.float32)] * 2
+    backend = MeshSearchBackend()
+    rmesh = backend.replica_mesh_for(cohort)
+    bank(docs=nd, cohort=cohort,
+         replica_devices=int(rmesh.devices.size) if rmesh is not None
+         else 1)
+
+    def launch(sharded: bool):
+        st = plan_ops.FieldStream(docids, tfs, lens,
+                                  np.float32(lens.mean()),
+                                  sel, grp, sub, w, cst)
+        args = [gk, gr, gc, live] + scalars
+        if sharded:
+            rep = [backend.replicated(rmesh, a)
+                   for a in (docids, tfs, lens,
+                             np.float32(lens.mean()))]
+            st = plan_ops.FieldStream(
+                *rep, *[backend.shard_rows(rmesh, a)
+                        for a in (sel, grp, sub, w, cst)])
+            args = [backend.shard_rows(rmesh, gk),
+                    backend.shard_rows(rmesh, gr),
+                    backend.shard_rows(rmesh, gc),
+                    backend.replicated(rmesh, live)] + \
+                [backend.shard_rows(rmesh, a) for a in scalars]
+        return np.asarray(plan_ops.plan_topk_batch(
+            [st], args[0], args[1], args[2], args[3], args[4], args[5],
+            args[6], args[7], args[8], k=10))
+
+    reps = int(os.environ.get("BENCH_MULTICHIP_REPS", 20))
+    solo = launch(False)                      # warm
+    t0 = time.time()
+    for _ in range(reps):
+        solo = launch(False)
+    solo_qps = round(cohort * reps / (time.time() - t0), 1)
+    bank(qps_solo=solo_qps)
+    if rmesh is None:
+        bank(skipped="fewer than 2 devices — replica fan-out n/a")
+        return
+    meshed = launch(True)                     # warm (sharded signature)
+    t0 = time.time()
+    for _ in range(reps):
+        meshed = launch(True)
+    mesh_qps = round(cohort * reps / (time.time() - t0), 1)
+    bank(qps_mesh=mesh_qps,
+         speedup=round(mesh_qps / solo_qps, 2) if solo_qps else None,
+         parity=bool(np.array_equal(solo, meshed)))
+
+
+def run_multichip_serving(native_ok: bool, native_why: str = "") -> dict:
+    """The `multichip_serving` BENCH section: one subprocess per row.
+    CPU virtual-device rows (1/2/4/8) ALWAYS bank; native-device rows
+    run only when the shared preflight passed, otherwise they bank as
+    typed `skipped` entries."""
+    import re
+    import subprocess
+
+    section = {"rows": []}
+    row_s = float(os.environ.get("BENCH_MULTICHIP_ROW_S", 420))
+
+    def run_row(n_devices: int, mode: str, env_extra: dict,
+                label: str) -> dict:
+        env = {**os.environ, **env_extra}
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--multichip-row", str(n_devices), mode],
+                capture_output=True, text=True, timeout=row_s, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+        except subprocess.TimeoutExpired as e:
+            # the row's own incremental banking still surfaces partial
+            # progress from the killed subprocess's stdout
+            row = _last_row_json(e.stdout or "")
+            row.update({"mode": mode, "backend": label,
+                        "skipped": f"row subprocess exceeded "
+                                   f"{row_s:.0f}s"})
+            return row
+        row = _last_row_json(r.stdout)
+        row.setdefault("mode", mode)
+        row["backend"] = label
+        if not row.get("qps_mesh") and not row.get("qps_loop") \
+                and not row.get("qps_solo") and "skipped" not in row:
+            tail = (r.stderr or r.stdout or "").strip().splitlines()[-2:]
+            row["skipped"] = ("row produced no qps: "
+                              + " | ".join(tail))[:400]
+        return row
+
+    def _last_row_json(stdout: str) -> dict:
+        for line in reversed((stdout or "").splitlines()):
+            try:
+                parsed = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(parsed, dict) and "multichip_row" in parsed:
+                return dict(parsed["multichip_row"])
+        return {}
+
+    if os.environ.get("BENCH_MULTICHIP", "1") == "0":
+        section["skipped"] = "disabled (BENCH_MULTICHIP=0)"
+        return section
+    # the section's own wall-clock cap: remaining rows bank as typed
+    # skips instead of eating the serving sections' budget
+    sec_budget = float(os.environ.get("BENCH_MULTICHIP_BUDGET_S", 900))
+    t_sec = time.time()
+
+    def over_budget() -> bool:
+        return (time.time() - t_sec > sec_budget
+                or remaining_budget() < 900)
+
+    for mode in ("sharded_corpus", "replica_parallel"):
+        for d in (1, 2, 4, 8):
+            if mode == "replica_parallel" and d == 1:
+                continue          # solo baseline rides every row
+            if over_budget():
+                section["rows"].append(
+                    {"mode": mode, "backend": f"cpu-virtual-{d}",
+                     "skipped": "multichip section wall-clock budget"})
+                continue
+            # REPLACE any inherited device-count flag: each row must see
+            # exactly d virtual devices, not the parent harness's count
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "",
+                os.environ.get("XLA_FLAGS", ""))
+            flags = (flags + f" --xla_force_host_platform_"
+                             f"device_count={d}").strip()
+            row = run_row(d, mode, {"JAX_PLATFORMS": "cpu",
+                                    "XLA_FLAGS": flags},
+                          label=f"cpu-virtual-{d}")
+            section["rows"].append(row)
+            log(f"multichip row {mode}/cpu-{d}: "
+                f"{json.dumps(row)[:200]}")
+    # native rows: the real accelerator, only behind the preflight —
+    # with any inherited virtual-device flag STRIPPED, or a 'native'
+    # row would silently measure forced CPU host devices
+    native_flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        os.environ.get("XLA_FLAGS", "")).strip()
+    for mode in ("sharded_corpus", "replica_parallel"):
+        if not native_ok:
+            row = {"mode": mode, "backend": "native",
+                   "skipped": f"device unreachable (preflight "
+                              f"quick-fail): {native_why}"[:300]}
+        elif over_budget():
+            row = {"mode": mode, "backend": "native",
+                   "skipped": "multichip section wall-clock budget"}
+        else:
+            row = run_row(8, mode, {"XLA_FLAGS": native_flags},
+                          label="native")
+            log(f"multichip row {mode}/native: "
+                f"{json.dumps(row)[:200]}")
+        section["rows"].append(row)
+    return section
+
+
 def run_aggs_device(rng, aggs_rows):
     """Device reduction rows (requires a live backend): the fused
     metric-stats launch, histogram scatter-add, and per-bucket metric
@@ -1511,7 +1831,8 @@ def main():
              cpu=parts.get("cpu"),
              serving=serving,
              skipped=parts.get("skipped"),
-             aggs=parts.get("aggs"))
+             aggs=parts.get("aggs"),
+             multichip=parts.get("multichip"))
 
     rng = np.random.default_rng(12345)
     t0 = time.time()
@@ -1563,6 +1884,17 @@ def main():
     # serving row instead of aborting with only CPU rows (r05 lesson)
     pf_ok, pf_why = preflight_subprocess(
         float(os.environ.get("BENCH_PREFLIGHT_S", 180)))
+    # multi-chip serving rows: every row is a SUBPROCESS (cpu rows pin
+    # their own virtual-device mesh), so the section banks regardless
+    # of the relay's health — native rows gate on the preflight verdict
+    try:
+        t0 = time.time()
+        parts["multichip"] = run_multichip_serving(pf_ok, pf_why)
+        cpu_rows["multichip_s"] = round(time.time() - t0, 1)
+    except Exception as e:  # noqa: BLE001 — the rider must not sink
+        log(f"multichip serving section failed: {e!r}")
+        parts.setdefault("skipped", {})["multichip_serving"] = repr(e)
+    emit_now()
     if not pf_ok:
         log(f"DEVICE UNREACHABLE (subprocess preflight): {pf_why}")
         parts["device_down"] = pf_why
@@ -1675,6 +2007,10 @@ def main():
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 4 and sys.argv[1] == "--multichip-row":
+        # subprocess row harness (run_multichip_serving spawns these)
+        _multichip_row(int(sys.argv[2]), sys.argv[3])
+        sys.exit(0)
     try:
         main()
     except SystemExit:

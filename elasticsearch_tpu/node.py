@@ -93,6 +93,11 @@ class Node:
             self.breaker_service)
         self.search_service = SearchService(self.indices_service)
         self.search_service.telemetry = self.telemetry
+        # mesh serving backend: dispatch/fallback counters mirror into
+        # the node registry (search.mesh.dispatch{axis} /
+        # search.mesh.fallback{reason}) next to its own stats surface
+        # in GET /_kernels
+        self.search_service.mesh_executor.metrics = self.telemetry.metrics
         # tasks.started/completed/cancelled counters + the live task
         # gauge feed the node metrics registry
         self.task_manager = TaskManager(self.node_id,

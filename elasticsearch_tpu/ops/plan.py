@@ -386,6 +386,66 @@ def plan_topk_batch(streams, group_kind, group_req, group_const, live,
         float(k1), float(b), int(k), combine, with_dense, script_fn)
 
 
+@tracked_jit("plan_topk_mesh",
+             static_argnames=("mesh", "nd", "n_must", "n_filter", "msm",
+                              "tie", "k1", "b", "k", "combine"))
+def plan_topk_mesh(streams, group_kind, group_req, group_const, bonus,
+                   live, mesh, nd: int, n_must: int, n_filter: int,
+                   msm: int, tie: float, k1: float, b: float, k: int,
+                   combine: str):
+    """ONE SPMD program for a multi-shard query over a device mesh: the
+    TransportSearchAction scatter-gather re-expressed as collectives.
+
+    Every input carries a leading shard axis, sharded ``P("shard")``
+    (parallel/mesh_executor.py stacks per-shard selections/corpora this
+    way); each device scores its own shard with :func:`plan_topk_body`,
+    then ONE ``all_gather`` over the shard axis + on-device re-top-k
+    replaces the coordinator merge and a ``psum`` the total-hits
+    accumulation. Returns a replicated packed [2k+1] buffer
+    (:func:`pack_result`) — one readback for the whole mesh query.
+
+    Global ids are ``shard * nd + local`` in int32: the packed float
+    readback bounds them below ``PACKED_ID_LIMIT`` (2^24), enforced by
+    the caller, so int32 can never overflow here."""
+    from jax.sharding import PartitionSpec as P
+
+    from elasticsearch_tpu.utils.jax_compat import shard_map
+
+    in_specs = (tuple(FieldStream(*([P("shard")] * 9)) for _ in streams),
+                P("shard"), P("shard"), P("shard"), P("shard"),
+                P("shard"))
+
+    @shard_map(mesh=mesh, check_vma=False, in_specs=in_specs,
+               out_specs=P())
+    def step(sts, gk, gr, gc, bo, lv):
+        local = tuple(
+            FieldStream(st.block_docids[0], st.block_tfs[0],
+                        st.doc_lens[0], st.avg_len[0],
+                        st.sel_blocks[0], st.sel_group[0],
+                        st.sel_sub[0], st.sel_weight[0],
+                        st.sel_const[0])
+            for st in sts)
+        vals, ids, total = plan_topk_body(
+            local, gk[0], gr[0], gc[0], lv[0], jnp.ones(1, bool),
+            jnp.int32(n_must), jnp.int32(n_filter), jnp.int32(msm),
+            bo[0], jnp.float32(tie), jnp.float32(0.0),
+            k1, b, k, combine, False, False)
+        shard_idx = jax.lax.axis_index("shard").astype(jnp.int32)
+        gids = jnp.where(ids == _SENTINEL, _SENTINEL,
+                         ids + shard_idx * nd)
+        # ONE all_gather over ICI + on-device re-top-k = coordinator merge
+        av = jax.lax.all_gather(vals, "shard")        # [S, k]
+        ag = jax.lax.all_gather(gids, "shard")
+        tv, ti = jax.lax.top_k(av.reshape(-1), k)
+        tg = jnp.take(ag.reshape(-1), ti)
+        tg = jnp.where(tv > -jnp.inf, tg, _SENTINEL)
+        # pack → one readback for the whole mesh query
+        return pack_result(tv, tg, jax.lax.psum(total, "shard"))
+
+    return step(tuple(streams), group_kind, group_req, group_const,
+                bonus, live)
+
+
 # ---------------------------------------------------------------------------
 # Impact-ordered block selection (host-side, pure numpy).
 #

@@ -66,6 +66,24 @@ def l2_scores(queries: jax.Array, vectors: jax.Array,
     return -(q_sq - 2.0 * dots + doc_sq_norms[None, :])
 
 
+def exact_rerank_scores(cand: np.ndarray, q32: np.ndarray,
+                        similarity: str) -> np.ndarray:
+    """Host exact-f32 re-rank formulas (ES score transforms included) —
+    the ONE implementation shared by KnnQuery._exact_rerank (per-shard
+    loop) and the mesh kNN path (parallel/mesh_executor.py), so the two
+    serving paths cannot drift: quantized slabs NOMINATE on device,
+    then the top candidates re-score here in exact float32."""
+    cand = cand.astype(np.float32)
+    if similarity == "cosine":
+        nrm = np.linalg.norm(cand, axis=1) * np.linalg.norm(q32)
+        sim = cand @ q32 / np.where(nrm > 0, nrm, 1.0)
+        return ((1.0 + sim) / 2.0).astype(np.float32)
+    if similarity == "dot_product":
+        return ((1.0 + cand @ q32) / 2.0).astype(np.float32)
+    d2 = ((cand - q32[None, :]) ** 2).sum(axis=1)
+    return (1.0 / (1.0 + d2)).astype(np.float32)
+
+
 # ---------------------------------------------------------------------------
 # Scalar references (parity targets for the painless functions in the
 # reference: cosineSimilarity / dotProduct / l2norm)

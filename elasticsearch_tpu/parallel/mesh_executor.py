@@ -1,11 +1,11 @@
-"""Mesh-sharded plan execution: REST `_search` → one SPMD program.
+"""Mesh-sharded serving backend: REST `_search` → one SPMD program.
 
 The integration the reference achieves with TransportSearchAction's
 scatter-gather (ref: action/search/TransportSearchAction.java:93,469-523 —
 per-shard RPC fan-out, SearchPhaseController.java:154-218 coordinator
-merge): on a TPU mesh the same multi-shard query runs as ONE
+merge): on a device mesh the same multi-shard query runs as ONE
 ``shard_map`` program — every device scores its shard's postings with the
-fused plan kernel (ops/plan.py plan_topk_body), then a single
+fused plan kernel (ops/plan.py plan_topk_mesh), then a single
 ``all_gather`` over the shard axis + on-device re-top-k replaces the
 coordinator merge, and a ``psum`` replaces the total-hits accumulation.
 The merge rides ICI instead of RPC.
@@ -21,10 +21,25 @@ onto a leading shard axis and ``device_put`` with a ``P("shard")``
 sharding — each device holds only its shard, the HBM analogue of one
 Lucene shard per data node. Multi-host meshes run the identical program;
 only the Mesh changes (collectives ride ICI in-host, DCN across hosts).
+
+:class:`MeshSearchBackend` is the serving entry: ``search/service.py``
+dispatches eligible multi-shard queries to it (bm25/bool via the plan
+kernel, pure kNN via the vector kernels below) and both
+``search/batching.py`` and the native front (``search/fastpath.py``)
+borrow its replica-axis helpers to fan query COHORTS across devices.
+Every ineligible shape falls back to the per-shard loop with a typed
+``fallback.<reason>`` counter — never an error — and the dispatch/
+fallback/residency surface ships via ``GET /_kernels`` (rest/api.py).
+
+Ceilings honored with clean fallback (see ops/plan.py / sharded.py):
+``PACKED_ID_LIMIT`` (2^24: packed readback ids ride float32 casts) and
+``GID_INT32_LIMIT`` (2^31: global-id arithmetic with x64 off — the
+sharded kernel library falls back to a host int64 merge past it).
 """
 
 from __future__ import annotations
 
+import os
 import threading
 from functools import partial
 from typing import Dict, List, Optional, Tuple
@@ -38,6 +53,7 @@ from elasticsearch_tpu.index.segment import BLOCK_SIZE, Segment
 from elasticsearch_tpu.ops import plan as plan_ops
 from elasticsearch_tpu.ops.device import block_bucket
 from elasticsearch_tpu.search.plan import LogicalPlan, compile_plan
+from elasticsearch_tpu.utils.jax_compat import shard_map
 
 DOC_PAD = 1024
 
@@ -188,6 +204,42 @@ class MeshFieldState:
         self.pfs = pfs                # host term dicts for binding
 
 
+class MeshVectorState:
+    """One dense-vector field stacked over shards, device-sharded —
+    the ``P("shard")`` analogue of per-node DeviceVectors slabs
+    (ops/device.py). Slab values are IDENTICAL to the per-shard device
+    cache's (same prepare_vectors, same dtype), so mesh kNN scores are
+    byte-identical to the per-shard loop's."""
+
+    def __init__(self, mesh: Mesh, segments: List, field: str,
+                 n_docs_padded: int, dtype):
+        from elasticsearch_tpu.ops.vector import prepare_vectors
+        vvs = [seg.vectors.get(field) if hasattr(seg, "vectors") else None
+               for seg in segments]
+        self.hosts = vvs              # host slabs for the exact re-rank
+        sims = {vv.similarity for vv in vvs if vv is not None}
+        self.similarity = next(iter(sims)) if len(sims) == 1 else None
+        dims = next((vv.dims for vv in vvs if vv is not None), 1)
+        s = len(segments)
+        slab = np.zeros((s, n_docs_padded, dims), np.dtype(dtype))
+        sqn = np.zeros((s, n_docs_padded), np.float32)
+        hv = np.zeros((s, n_docs_padded), bool)
+        for i, vv in enumerate(vvs):
+            if vv is None or self.similarity is None:
+                continue
+            prepped, norms = prepare_vectors(vv.vectors, self.similarity,
+                                             dtype)
+            n = prepped.shape[0]
+            slab[i, :n] = prepped
+            sqn[i, :n] = (norms * norms).astype(np.float32)
+            hv[i, :len(vv.has_value)] = vv.has_value
+        shard_spec = NamedSharding(mesh, P("shard"))
+        self.vectors = jax.device_put(slab, shard_spec)
+        self.sq_norms = jax.device_put(sqn, shard_spec)
+        self.has_value = jax.device_put(hv, shard_spec)
+        self.dtype = self.vectors.dtype
+
+
 class MeshCorpus:
     """A multi-shard index resident on a device mesh (one shard per
     device), built lazily per field from each shard's single segment."""
@@ -202,6 +254,7 @@ class MeshCorpus:
         self.live = None
         self.refresh_live()
         self._fields: Dict[str, MeshFieldState] = {}
+        self._vfields: Dict[Tuple[str, str], Optional[MeshVectorState]] = {}
 
     def refresh_live(self) -> None:
         """Deletes touch only the live bitmaps — re-upload just those
@@ -225,6 +278,29 @@ class MeshCorpus:
             self._fields[name] = MeshFieldState(
                 self.mesh, pfs, self.n_docs_padded)
         return self._fields[name]
+
+    def vector_field(self, name: str, dtype) -> Optional[MeshVectorState]:
+        key = (name, str(np.dtype(dtype)))
+        if key not in self._vfields:
+            vs = MeshVectorState(self.mesh, self.segments, name,
+                                 self.n_docs_padded, dtype)
+            self._vfields[key] = vs if vs.similarity is not None else None
+        return self._vfields[key]
+
+    def device_arrays(self):
+        """Every mesh-resident array of this corpus, tagged by slab
+        class (the per-device HBM residency surface)."""
+        if self.live is not None:
+            yield "live_mask", self.live
+        for fs in self._fields.values():
+            yield "postings", fs.block_docids
+            yield "postings", fs.block_tfs
+            yield "norms", fs.doc_lens
+        for vs in self._vfields.values():
+            if vs is not None:
+                yield "vectors", vs.vectors
+                yield "vectors", vs.sq_norms
+                yield "vectors", vs.has_value
 
 
 def plans_mesh_compatible(plans: List[LogicalPlan]) -> bool:
@@ -336,62 +412,156 @@ def bind_mesh(corpus: MeshCorpus, plans: List[LogicalPlan]):
             jax.device_put(bonus, shard_spec))
 
 
-@partial(jax.jit,
-         static_argnames=("mesh", "k", "combine", "k1", "b",
-                          "n_must", "n_filter", "msm", "tie", "nd"))
-def _sharded_plan_step(streams, group_kind, group_req, group_const, bonus,
-                       live, mesh: Mesh, nd: int,
-                       n_must: int, n_filter: int, msm: int, tie: float,
-                       k1: float, b: float, k: int, combine: str):
-    in_specs = (tuple(plan_ops.FieldStream(*([P("shard")] * 9))
-                      for _ in streams),
-                P("shard"), P("shard"), P("shard"), P("shard"), P("shard"))
+# ---------------------------------------------------------------------------
+# Mesh kNN kernels: the dense-vector analogue of plan_topk_mesh. Scoring
+# mirrors KnnQuery.do_execute (search/queries.py) OPERATION FOR
+# OPERATION — same formulas, same masking order, same cut semantics —
+# so a mesh-served kNN `_search` is byte-identical to the per-shard
+# dense loop it replaces.
+# ---------------------------------------------------------------------------
 
-    @partial(jax.shard_map, mesh=mesh, check_vma=False,
-             in_specs=in_specs, out_specs=P())
-    def step(sts, gk, gr, gc, bo, lv):
-        local = tuple(
-            plan_ops.FieldStream(st.block_docids[0], st.block_tfs[0],
-                                 st.doc_lens[0], st.avg_len[0],
-                                 st.sel_blocks[0], st.sel_group[0],
-                                 st.sel_sub[0], st.sel_weight[0],
-                                 st.sel_const[0])
-            for st in sts)
-        vals, ids, total = plan_ops.plan_topk_body(
-            local, gk[0], gr[0], gc[0], lv[0], jnp.ones(1, bool),
-            jnp.int32(n_must), jnp.int32(n_filter), jnp.int32(msm),
-            bo[0], jnp.float32(tie), jnp.float32(0.0),
-            k1, b, k, combine, False, False)
+
+def _knn_local_scores(vectors, sq_norms, has_value, qvec, similarity):
+    """Per-shard (scores, mask) through the SAME ops/vector.py kernels
+    and ES transforms KnnQuery.do_execute uses — shared code, not
+    copies, so the mesh path cannot numerically drift from the
+    per-shard loop."""
+    from elasticsearch_tpu.ops import vector as vec_ops
+    q = qvec[None, :]
+    if similarity == "cosine":
+        scores = (1.0 + vec_ops.cosine_scores(q, vectors)[0]) / 2.0
+    elif similarity == "dot_product":
+        scores = (1.0 + vec_ops.dot_scores(q, vectors)[0]) / 2.0
+    else:  # l2_norm
+        neg_sq = vec_ops.l2_scores(q, vectors, sq_norms)[0]
+        scores = 1.0 / (1.0 - neg_sq)
+    mask = has_value
+    return jnp.where(mask, scores, 0.0), mask
+
+
+@partial(jax.jit, static_argnames=("mesh", "similarity", "nc"))
+def _mesh_knn_nominate(vectors, sq_norms, has_value, qvec,
+                       mesh: Mesh, similarity: str, nc: int):
+    """Quantized-slab nomination: per-shard top-``nc`` candidate ids
+    (the ids KnnQuery._exact_rerank reads back per shard — here ONE
+    [S, nc] readback for the whole mesh)."""
+
+    @partial(shard_map, mesh=mesh, check_vma=False,
+             in_specs=(P("shard"), P("shard"), P("shard"), P()),
+             out_specs=P("shard"))
+    def step(v, sn, hv, q):
+        scores, _ = _knn_local_scores(v[0], sn[0], hv[0], q, similarity)
+        _, ids = jax.lax.top_k(scores, nc)
+        return ids[None, :]
+
+    return step(vectors, sq_norms, has_value, qvec)
+
+
+@partial(jax.jit, static_argnames=("mesh", "nd", "similarity", "boost",
+                                   "cut", "k", "with_patch"))
+def _mesh_knn_step(vectors, sq_norms, has_value, live, qvec,
+                   patch_ids, patch_vals, mesh: Mesh, nd: int,
+                   similarity: str, boost: float, cut: int, k: int,
+                   with_patch: bool):
+    """The full mesh kNN program: per-shard scoring (+ optional exact
+    re-rank patch + candidate cut, mirroring KnnQuery.do_execute), live
+    mask, psum'd totals, per-shard top-k and the all_gather merge —
+    one packed readback. ``cut=0`` disables the per-shard candidate
+    cut (cut >= n_docs_padded on the per-shard path)."""
+
+    @partial(shard_map, mesh=mesh, check_vma=False,
+             in_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
+                       P(), P("shard"), P("shard")),
+             out_specs=P())
+    def step(v, sn, hv, lv, q, pid, pv):
+        scores, mask = _knn_local_scores(v[0], sn[0], hv[0], q,
+                                         similarity)
+        if with_patch:
+            # the exact-f32 re-rank scatter (KnnQuery._exact_rerank):
+            # pad lanes carry unique out-of-range ids and drop
+            scores = scores.at[pid[0]].set(pv[0], mode="drop",
+                                           unique_indices=True)
+        if cut:
+            kth = jnp.sort(jnp.where(mask, scores, -jnp.inf))[nd - cut]
+            mask = mask & (scores >= kth)
+            scores = jnp.where(mask, scores, 0.0)
+        if boost != 1.0:
+            scores = scores * boost
+        mask = mask & lv[0]
+        vals, ids = jax.lax.top_k(jnp.where(mask, scores, -jnp.inf), k)
         shard_idx = jax.lax.axis_index("shard").astype(jnp.int32)
-        gids = jnp.where(ids == plan_ops._SENTINEL, plan_ops._SENTINEL,
-                         ids + shard_idx * nd)
-        # ONE all_gather over ICI + on-device re-top-k = coordinator merge
-        av = jax.lax.all_gather(vals, "shard")        # [S, k]
+        gids = jnp.where(vals > -jnp.inf, ids + shard_idx * nd,
+                         plan_ops._SENTINEL)
+        av = jax.lax.all_gather(vals, "shard")
         ag = jax.lax.all_gather(gids, "shard")
         tv, ti = jax.lax.top_k(av.reshape(-1), k)
         tg = jnp.take(ag.reshape(-1), ti)
         tg = jnp.where(tv > -jnp.inf, tg, plan_ops._SENTINEL)
-        # pack → one readback for the whole mesh query
-        return plan_ops.pack_result(tv, tg, jax.lax.psum(total, "shard"))
+        total = jax.lax.psum(jnp.sum(mask.astype(jnp.int32)), "shard")
+        return plan_ops.pack_result(tv, tg, total)
 
-    return step(tuple(streams), group_kind, group_req, group_const,
-                bonus, live)
+    return step(vectors, sq_norms, has_value, live, qvec,
+                patch_ids, patch_vals)
 
 
-class MeshSearchExecutor:
+class MeshSearchBackend:
     """Service-side entry: caches MeshCorpus per shard-set epoch and runs
-    compatible multi-shard queries as one SPMD launch."""
+    compatible multi-shard queries as one SPMD launch.
 
-    def __init__(self, max_cached: int = 4):
+    Dispatches count under ``dispatch.<axis>`` (``shard`` = sharded-
+    corpus SPMD serving, ``replica`` = query-cohort fan-out via the
+    replica helpers); every refusal counts under ``fallback.<reason>``
+    and the caller runs the per-shard loop — fallback is ALWAYS clean
+    (no error surfaces to the request). ``metrics`` (a node
+    MetricsRegistry, wired by Node) mirrors both as
+    ``search.mesh.dispatch{axis}`` / ``search.mesh.fallback{reason}``.
+    """
+
+    #: replica-corpus handle cache bound (strong refs pin sources, which
+    #: are long-lived registration/device-cache arrays anyway)
+    REPLICA_CACHE_MAX = 64
+
+    def __init__(self, max_cached: int = 4, min_devices: int = 2):
+        from collections import OrderedDict
         self._cache: Dict[tuple, MeshCorpus] = {}
         self._cache_lock = threading.Lock()
         self._max_cached = max_cached
+        self.min_devices = min_devices
         self.mesh_searches = 0   # stat: queries served via the mesh
+        self.counters: Dict[str, int] = {}
+        self.metrics = None      # node MetricsRegistry (wired by Node)
+        self._replica_meshes: Dict[int, Mesh] = {}
+        # LRU (touch-on-hit): churning entries (the fastpath mask stack
+        # swaps identity on every filter-row update) age out while the
+        # hot corpus handles stay resident
+        self._replicated: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._replica_lock = threading.Lock()
+
+    # ------------------------------------------------------------- gates
+    @staticmethod
+    def enabled() -> bool:
+        """Kill switch: ``ESTPU_MESH_SERVING=0`` forces the per-shard
+        loop everywhere (fallback counters still tick)."""
+        return os.environ.get("ESTPU_MESH_SERVING", "1") != "0"
 
     @staticmethod
     def available_devices() -> int:
         return len(jax.devices())
 
+    def _count(self, key: str, n: int = 1) -> None:
+        self.counters[key] = self.counters.get(key, 0) + n
+
+    def _dispatch(self, axis: str, n: int = 1) -> None:
+        self._count(f"dispatch.{axis}", n)
+        if self.metrics is not None:
+            self.metrics.inc("search.mesh.dispatch", n, axis=axis)
+
+    def _fallback(self, reason: str) -> None:
+        self._count(f"fallback.{reason}")
+        if self.metrics is not None:
+            self.metrics.inc("search.mesh.fallback", reason=reason)
+
+    # ------------------------------------------------------------ corpus
     def corpus_for(self, index_name: str,
                    shard_segments: List[Segment]) -> MeshCorpus:
         # keyed by segment NAMES (postings identity); deletes only bump
@@ -411,29 +581,151 @@ class MeshSearchExecutor:
                 corpus.refresh_live()
         return corpus
 
+    # ------------------------------------------------------------- stats
+    def residency(self) -> Dict[str, Dict[str, int]]:
+        """Per-DEVICE resident bytes by slab class over every cached
+        mesh corpus — the `GET /_kernels` mesh.residency surface (the
+        one-Lucene-shard-per-data-node HBM analogue, per chip)."""
+        out: Dict[str, Dict[str, int]] = {}
+        with self._cache_lock:
+            corpora = list(self._cache.values())
+        for corpus in corpora:
+            for klass, arr in corpus.device_arrays():
+                try:
+                    shards = arr.addressable_shards
+                except Exception:
+                    continue
+                for sh in shards:
+                    dev = out.setdefault(str(sh.device), {})
+                    dev[klass] = dev.get(klass, 0) + int(sh.data.nbytes)
+        return out
+
+    def stats(self) -> Dict[str, object]:
+        with self._replica_lock:
+            rep_bytes = sum(e[1].nbytes for e in self._replicated.values())
+        return {
+            "enabled": self.enabled(),
+            "devices": self.available_devices(),
+            "mesh_searches": self.mesh_searches,
+            "counters": dict(sorted(self.counters.items())),
+            "residency": self.residency(),
+            "replica_corpus_bytes": int(rep_bytes),
+        }
+
+    # ---------------------------------------------- replica-axis helpers
+    #
+    # The second serving mode the tentpole names: query COHORTS (the
+    # continuous-batching launches of search/batching.py and the native
+    # front's fastpath cohorts) fan across a 1-D ("replica",) mesh —
+    # corpus replicated (P()), the cohort's per-query rows sharded
+    # P("replica"). The SAME jitted kernels run; GSPMD partitions the
+    # vmapped program over the query axis (the pjit/PartitionSpec
+    # pattern, SNIPPETS.md [2][3]), so per-query results stay
+    # byte-identical to the single-device launch.
+
+    def replica_mesh_for(self, q_rows: int) -> Optional[Mesh]:
+        """Largest power-of-two ("replica",) mesh that divides a
+        ``q_rows``-row cohort, or None when fewer than min_devices
+        devices exist (the caller launches single-device)."""
+        if not self.enabled():
+            return None
+        try:
+            devices = jax.devices()
+        except Exception:
+            return None
+        n = 1
+        while n * 2 <= min(q_rows, len(devices)):
+            n *= 2
+        if n < max(2, self.min_devices):
+            return None
+        mesh = self._replica_meshes.get(n)
+        if mesh is None:
+            mesh = Mesh(np.asarray(devices[:n]), ("replica",))
+            self._replica_meshes[n] = mesh
+        return mesh
+
+    def replicated(self, mesh: Mesh, arr):
+        """A fully-replicated (P()) handle of a device/host corpus
+        array, cached by source identity (sources are long-lived corpus
+        arrays; a refresh swaps the source object and naturally
+        re-replicates)."""
+        key = (id(mesh), id(arr))
+        with self._replica_lock:
+            entry = self._replicated.get(key)
+            if entry is not None and entry[0] is arr:
+                self._replicated.move_to_end(key)
+                return entry[1]
+        rep = jax.device_put(arr, NamedSharding(mesh, P()))
+        with self._replica_lock:
+            self._replicated[key] = (arr, rep)
+            while len(self._replicated) > self.REPLICA_CACHE_MAX:
+                self._replicated.popitem(last=False)
+        return rep
+
+    def shard_rows(self, mesh: Mesh, arr):
+        """Shard a cohort's leading (query) axis over the replica mesh."""
+        return jax.device_put(arr, NamedSharding(mesh, P("replica")))
+
+    # ----------------------------------------------------------- serving
     def execute(self, index_name: str, searchers, query,
                 k: int) -> Optional[Tuple[list, int]]:
         """Try the mesh path: searchers = the index's per-shard
-        ShardSearchers (each must hold exactly one segment). Returns
-        ([(shard_idx, local_docid, score)], total) sorted by (-score,
-        shard, docid), or None to fall back to the per-shard loop."""
+        ShardSearchers. Returns ([(shard_idx, seg_idx, local_docid,
+        score)], total) sorted by (-score, shard, docid), or None to
+        fall back to the per-shard loop (typed fallback counter)."""
+        if not self.enabled():
+            self._fallback("disabled")
+            return None
         n_shards = len(searchers)
         if k < 1:
+            self._fallback("size_zero")
             return None   # size:0 — per-shard path keeps max_score semantics
-        if n_shards < 2 or self.available_devices() < n_shards:
+        if n_shards < 2:
+            self._fallback("single_shard")
+            return None
+        if self.available_devices() < n_shards:
+            self._fallback("not_enough_devices")
             return None
         if any(len(s.segments) == 0 for s in searchers):
+            self._fallback("empty_shard")
+            return None
+        if any(getattr(s, "dfs_global_stats", False) for s in searchers):
+            # dfs_query_then_fetch scores every shard with AGGREGATED
+            # statistics; the mesh residency binds each shard's own
+            # stats (ES-default per-shard IDF) — the loop keeps dfs
+            # exact (sharded_dfs_stats is the future on-mesh answer)
+            self._fallback("dfs_stats")
+            return None
+        from elasticsearch_tpu.search.queries import KnnQuery
+        if isinstance(query, KnnQuery):
+            return self._execute_knn(index_name, searchers, query, k)
+        if any(len(s.segments) != 1 for s in searchers) \
+                and os.environ.get("ESTPU_MESH_COMPOSITE") != "1":
+            # composite (multi-segment) residency concatenates a
+            # shard's segments into ONE kernel array — the segmented
+            # sums then round with a different cumsum prefix base than
+            # the per-segment loop, so scores drift in the last float32
+            # bits. The serving contract here is BYTE-identical results
+            # (the scroll one-executor rule, searcher.py), so unmerged
+            # shards take the per-shard loop; force-merged layouts (the
+            # mesh residency model) serve on-mesh. ESTPU_MESH_COMPOSITE=1
+            # opts into the approximate composite mode. Checked BEFORE
+            # the per-shard compiles: an unmerged index must not pay
+            # S plan compiles per request just to fall back.
+            self._fallback("multi_segment")
             return None
         # probe shard 0 first: ineligible queries (dense factors, scripts,
         # sorts…) bail after ONE compile instead of S
         first = compile_plan(query.rewrite(searchers[0]), searchers[0])
         if first is None or first.dense:
+            self._fallback("plan_incompatible")
             return None
         plans = [first]
         for s in searchers[1:]:
             rq = query.rewrite(s)
             plans.append(compile_plan(rq, s))
         if not plans_mesh_compatible(plans):
+            self._fallback("plan_incompatible")
             return None
         shard_views = [s.segments[0] if len(s.segments) == 1
                        else _CompositeShard(list(s.segments))
@@ -442,31 +734,62 @@ class MeshSearchExecutor:
         # GLOBAL ids (shard * nd_padded + docid) as float32 casts, exact
         # only < 2^24 — past that, fall back to the per-shard RPC merge
         # instead of silently corrupting low docid bits
-        from elasticsearch_tpu.ops.plan import PACKED_ID_LIMIT
         nd_max = max((v.n_docs for v in shard_views), default=1)
         nd_padded = max(DOC_PAD, _round_up(nd_max, DOC_PAD))
-        if n_shards * nd_padded >= PACKED_ID_LIMIT:
+        if n_shards * nd_padded >= plan_ops.PACKED_ID_LIMIT:
             import logging
             logging.getLogger(__name__).warning(
                 "mesh fast path skipped: %d shards x %d padded docs "
                 ">= 2^24 float-packed global-id ceiling; using the "
                 "per-shard fallback", n_shards, nd_padded)
+            self._fallback("packed_id_ceiling")
             return None
         corpus = self.corpus_for(index_name, shard_views)
         bound = bind_mesh(corpus, plans)
         if bound is None:
             self.mesh_searches += 1
+            self._dispatch("shard")
             return [], 0   # no query term exists in any shard
         streams, gk, gr, gc, bo = bound
         p0 = plans[0]
-        packed = _sharded_plan_step(
-            streams, gk, gr, gc, bo, corpus.live, corpus.mesh,
-            corpus.n_docs_padded, p0.n_must, p0.n_filter, p0.msm,
-            float(p0.tie), float(searchers[0].k1), float(searchers[0].b),
-            int(k), p0.combine)
+        packed = self._launch(
+            corpus, "plan_topk_mesh",
+            lambda: plan_ops.plan_topk_mesh(
+                streams, gk, gr, gc, bo, corpus.live, corpus.mesh,
+                corpus.n_docs_padded, p0.n_must, p0.n_filter, p0.msm,
+                float(p0.tie), float(searchers[0].k1),
+                float(searchers[0].b), int(k), p0.combine))
         self.mesh_searches += 1
-        vals, gids, total = plan_ops.unpack_result(np.asarray(packed),
-                                                   int(k))
+        self._dispatch("shard")
+        return self._unpack_docs(corpus, packed, int(k))
+
+    def _launch(self, corpus: MeshCorpus, kernel: str, fn):
+        """Run one mesh launch under the profile seam: stage-timed as
+        ``launch`` and, when a `profile: true` recorder is active,
+        attributed per chip via a device record carrying the mesh shape
+        and device list (the PR-8 record_device contract)."""
+        from elasticsearch_tpu.search import profile as _prof
+        recording = _prof.recording()
+        t0 = _prof.now_ns() if recording else 0
+        with _prof.span("launch"):
+            out = fn()
+            packed = np.asarray(out)   # ONE readback for the mesh query
+        launch_ms = round((_prof.now_ns() - t0) / 1e6, 3) if recording \
+            else 0.0
+        if recording:
+            _prof.record_device({
+                "kernel": kernel,
+                "mesh_shape": {"shard": corpus.n_shards},
+                "device": [str(d) for d in
+                           np.asarray(corpus.mesh.devices).flat],
+                "launch_ms": launch_ms,
+                "readback_bytes": int(packed.nbytes),
+            })
+        return packed
+
+    def _unpack_docs(self, corpus: MeshCorpus, packed: np.ndarray,
+                     k: int) -> Tuple[list, int]:
+        vals, gids, total = plan_ops.unpack_result(packed, k)
         nd = corpus.n_docs_padded
         docs = []
         for v, g in zip(vals, gids):
@@ -480,3 +803,91 @@ class MeshSearchExecutor:
                 seg_idx = 0
             docs.append((shard, seg_idx, docid, float(v)))
         return docs, int(total)
+
+    # --------------------------------------------------------------- kNN
+    def _execute_knn(self, index_name: str, searchers, query,
+                     k: int) -> Optional[Tuple[list, int]]:
+        """Mesh path for a bare top-level kNN query: per-shard brute
+        force + all_gather merge, byte-identical to the per-shard dense
+        loop (KnnQuery per shard + coordinator merge). Quantized slabs
+        keep the exact-f32 re-rank: one [S, nc] nomination readback,
+        the same host numpy re-rank per shard, and the exact scores
+        ride back into the final SPMD launch as a scatter patch."""
+        if query.filter_query is not None:
+            self._fallback("knn_filter")
+            return None
+        if any(len(s.segments) != 1 for s in searchers):
+            self._fallback("knn_multi_segment")
+            return None
+        from elasticsearch_tpu.search.searcher import MAX_TOPK
+        k = min(max(int(k), 1), MAX_TOPK)
+        pads = {max(DOC_PAD, _round_up(s.segments[0].n_docs, DOC_PAD))
+                for s in searchers}
+        if len(pads) != 1:
+            # the per-shard candidate cut / nomination depth clamp to
+            # EACH shard's padded size — non-uniform pads would change
+            # semantics shard by shard
+            self._fallback("knn_nonuniform_padding")
+            return None
+        n_shards = len(searchers)
+        nd = pads.pop()
+        if n_shards * nd >= plan_ops.PACKED_ID_LIMIT:
+            self._fallback("packed_id_ceiling")
+            return None
+        dtype = getattr(searchers[0].cache, "_vector_dtype", jnp.bfloat16)
+        corpus = self.corpus_for(
+            index_name, [s.segments[0] for s in searchers])
+        vs = corpus.vector_field(query.field, dtype)
+        if vs is None:
+            self._fallback("knn_missing_field")
+            return None
+        if vs.similarity not in ("cosine", "dot_product", "l2_norm"):
+            self._fallback("knn_similarity")
+            return None
+        qvec = jnp.asarray(np.asarray(query.query_vector, np.float32))
+        cut = query.k or query.num_candidates
+        cut = int(cut) if cut is not None and int(cut) < nd else 0
+        quantized = vs.dtype != jnp.float32
+        patch_ids = np.zeros((n_shards, 1), np.int32) + nd
+        patch_vals = np.zeros((n_shards, 1), np.float32)
+        if quantized:
+            nc = int(query.num_candidates or 3 * (query.k or 1000))
+            nc = min(nc, nd)
+            ids = np.asarray(_mesh_knn_nominate(
+                vs.vectors, vs.sq_norms, vs.has_value, qvec,
+                corpus.mesh, vs.similarity, nc))       # [S, nc]
+            patch_ids = np.zeros((n_shards, nc), np.int32)
+            patch_vals = np.zeros((n_shards, nc), np.float32)
+            for si in range(n_shards):
+                vv = vs.hosts[si]
+                # pad lanes: unique out-of-range targets (mode="drop")
+                patch_ids[si] = nd + np.arange(nc, dtype=np.int32)
+                if vv is None:
+                    continue
+                ids_h = ids[si][ids[si] < vv.vectors.shape[0]]
+                from elasticsearch_tpu.ops.vector import (
+                    exact_rerank_scores,
+                )
+                exact = exact_rerank_scores(
+                    vv.vectors[ids_h],
+                    np.asarray(query.query_vector, np.float32),
+                    vs.similarity)
+                patch_ids[si, :len(ids_h)] = ids_h
+                patch_vals[si, :len(ids_h)] = exact
+        shard_spec = NamedSharding(corpus.mesh, P("shard"))
+        packed = self._launch(
+            corpus, "mesh_knn_step",
+            lambda: _mesh_knn_step(
+                vs.vectors, vs.sq_norms, vs.has_value, corpus.live,
+                qvec, jax.device_put(patch_ids, shard_spec),
+                jax.device_put(patch_vals, shard_spec), corpus.mesh,
+                nd, vs.similarity, float(query.boost), cut, k,
+                quantized))
+        self.mesh_searches += 1
+        self._dispatch("knn")
+        return self._unpack_docs(corpus, packed, k)
+
+
+# Backwards-compatible name (pre-backend sessions): the executor IS the
+# backend now.
+MeshSearchExecutor = MeshSearchBackend

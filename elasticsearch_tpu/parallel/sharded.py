@@ -34,6 +34,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from elasticsearch_tpu.index.segment import BLOCK_SIZE
+from elasticsearch_tpu.utils.jax_compat import shard_map
 
 
 # int32 global-id ceiling: with x64 off, `ids + shard * nd` computes in
@@ -152,7 +153,7 @@ def sharded_bm25_topk(index: ShardedIndex,
     mesh = index.mesh
     nd = index.n_docs_padded
 
-    @partial(jax.shard_map, mesh=mesh, check_vma=False,
+    @partial(shard_map, mesh=mesh, check_vma=False,
              in_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
                        P("shard", "replica"), P("shard", "replica")),
              out_specs=(P("replica"), P("replica")))
@@ -192,7 +193,7 @@ def sharded_knn_topk(index: ShardedIndex,
     mesh = index.mesh
     nd = index.n_docs_padded
 
-    @partial(jax.shard_map, mesh=mesh, check_vma=False,
+    @partial(shard_map, mesh=mesh, check_vma=False,
              in_specs=(P("shard"), P("shard"), P("replica")),
              out_specs=(P("replica"), P("replica")))
     def step(vectors, live, q):
@@ -292,7 +293,7 @@ def sharded_hybrid_rrf(index: ShardedIndex,
     nd = index.n_docs_padded
     c = float(rank_constant)
 
-    @partial(jax.shard_map, mesh=mesh, check_vma=False,
+    @partial(shard_map, mesh=mesh, check_vma=False,
              in_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
                        P("shard"), P("shard", "replica"),
                        P("shard", "replica"), P("replica")),
@@ -348,7 +349,7 @@ def sharded_dfs_stats(index: ShardedIndex,
     psum'd over the shard axis."""
     mesh = index.mesh
 
-    @partial(jax.shard_map, mesh=mesh, check_vma=False,
+    @partial(shard_map, mesh=mesh, check_vma=False,
              in_specs=(P("shard"), P("shard")),
              out_specs=P())
     def step(tfs, sel):

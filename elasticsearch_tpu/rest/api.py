@@ -667,6 +667,13 @@ def get_kernels(node, params, body):
         # per-bucket dispatch counts + cohort histogram of the native
         # serving front — which warmed shapes actually earn their keep
         out["serving"] = fp.serving_stats()
+    mesh = getattr(getattr(node, "search_service", None),
+                   "mesh_executor", None)
+    if mesh is not None:
+        # multi-chip serving surface: dispatch counts per mesh axis,
+        # typed fallback reasons, and per-DEVICE HBM residency of every
+        # cached mesh corpus (parallel/mesh_executor.py)
+        out["mesh"] = mesh.stats()
     return 200, out
 
 

@@ -120,6 +120,13 @@ class PlanBatcher:
         # cohort fills — trading ≤~2 ms of p50 for materially larger
         # batches under load (0 disables)
         self.adaptive_flush_s = float(adaptive_flush_s)
+        # replica-axis fan-out (opt-in; a MeshSearchBackend wired by the
+        # service): cohorts split their query axis over a ("replica",)
+        # device mesh — corpus replicated, per-query rows sharded — and
+        # the SAME kernel runs partitioned by GSPMD, so per-query
+        # results stay byte-identical to the single-device launch
+        self.mesh = None
+        self.mesh_cohorts = 0     # stats: cohorts launched replica-sharded
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -282,6 +289,31 @@ class PlanBatcher:
         ms = np.asarray([bp.msm for bp in bps], np.int32)
         bo = np.asarray([bp.bonus for bp in bps], np.float32)
         ti = np.asarray([bp.tie for bp in bps], np.float32)
+        live = ctx.live
+        rmesh = None
+        if (self.mesh is not None and proto.dense_mask is None
+                and proto.script_fn is None):
+            # replica fan-out: corpus arrays ride as replicated (P())
+            # handles, every per-query row shards P("replica") — the
+            # identical jitted kernel then partitions over the Q axis
+            rmesh = self.mesh.replica_mesh_for(bucket)
+        if rmesh is not None:
+            mb = self.mesh
+            streams = [plan_ops.FieldStream(
+                mb.replicated(rmesh, st.block_docids),
+                mb.replicated(rmesh, st.block_tfs),
+                mb.replicated(rmesh, st.doc_lens),
+                mb.replicated(rmesh, st.avg_len),
+                mb.shard_rows(rmesh, st.sel_blocks),
+                mb.shard_rows(rmesh, st.sel_group),
+                mb.shard_rows(rmesh, st.sel_sub),
+                mb.shard_rows(rmesh, st.sel_weight),
+                mb.shard_rows(rmesh, st.sel_const))
+                for st in streams]
+            live = mb.replicated(rmesh, ctx.live)
+            gk, gr, gc = (mb.shard_rows(rmesh, a) for a in (gk, gr, gc))
+            nm, nf, ms, bo, ti = (mb.shard_rows(rmesh, a)
+                                  for a in (nm, nf, ms, bo, ti))
         any_prof = any(e.profiled for e in batch)
         t0p = 0
         if any_prof:
@@ -289,7 +321,7 @@ class PlanBatcher:
             t0p = _prof.now_ns()
         t0 = time.monotonic()
         packed = plan_ops.plan_topk_batch(
-            streams, gk, gr, gc, ctx.live, nm, nf, ms, bo, ti,
+            streams, gk, gr, gc, live, nm, nf, ms, bo, ti,
             k1=k1, b=b, k=k, combine=proto.combine,
             # cohort-shared filter column + script (signature keys on
             # their identities)
@@ -303,6 +335,9 @@ class PlanBatcher:
         self.launches += 1
         self.batched_queries += qn
         self.batch_hist[bucket] = self.batch_hist.get(bucket, 0) + 1
+        if rmesh is not None:
+            self.mesh_cohorts += 1
+            self.mesh._dispatch("replica", qn)
         if any_prof:
             # cohort meta for `profile: true` device attribution — the
             # launch is timed on the profile clock (virtual under the
@@ -325,6 +360,9 @@ class PlanBatcher:
                 e.meta = {
                     "kernel": "plan_topk_batch",
                     "cohort": qn,
+                    **({"mesh_shape":
+                        {"replica": rmesh.devices.size}}
+                       if rmesh is not None else {}),
                     "q_bucket": bucket,
                     "nb_bucket": max(widths) if widths else 0,
                     "nb_selected": own,
@@ -347,6 +385,7 @@ class PlanBatcher:
                           if self.launches else 0.0),
             "batch_hist": {str(kk): v for kk, v
                            in sorted(self.batch_hist.items())},
+            "mesh_cohorts": self.mesh_cohorts,
         }
 
 

@@ -180,8 +180,14 @@ class FastPathServer:
                  n_streams: int = 4, max_k: int = 1000,
                  ess_buckets=(256, 1024), q_batch: int = Q_BATCH,
                  kernel_mode: str = "auto", dense_mb: int = 512,
-                 impact_mode: str = "certified"):
+                 impact_mode: str = "certified", mesh_backend=None):
         self.node = node
+        # replica-axis cohort fan-out over a device mesh (opt-in:
+        # ESTPU_FASTPATH_MESH=1 resolves the node's MeshSearchBackend
+        # at start, or pass one explicitly). The v1 lane's cohorts then
+        # shard their Q axis over the mesh with the corpus replicated —
+        # same kernel, GSPMD-partitioned, byte-identical per query.
+        self.mesh_backend = mesh_backend
         self.front = front           # NativeHttpFront (owns the lib)
         self.lib = front.lib
         self.nb_buckets = tuple(sorted(nb_buckets))
@@ -293,6 +299,10 @@ class FastPathServer:
     def start(self):
         from concurrent.futures import ThreadPoolExecutor
         enable_compile_cache()
+        if self.mesh_backend is None \
+                and os.environ.get("ESTPU_FASTPATH_MESH") == "1":
+            svc = getattr(self.node, "search_service", None)
+            self.mesh_backend = getattr(svc, "mesh_executor", None)
         if self.requested_mode == "auto":
             try:
                 self.regime = probe_regime()
@@ -465,6 +475,10 @@ class FastPathServer:
         reg["flat_docids"] = dp.block_docids.reshape(-1)
         reg["flat_tfs"] = dp.block_tfs.reshape(-1)
         reg["theta"] = {}    # (tids, filt, k) -> (θ, exact_total)
+        # replica mesh for this registration's v1 cohorts: bound once so
+        # warm + serve share ONE (sharded) compile signature per bucket
+        reg["rmesh"] = (self.mesh_backend.replica_mesh_for(self.q_batch)
+                        if self.mesh_backend is not None else None)
         t0 = time.time()
         self._build_dense_hot(reg)
         logger.info("dense hot-term build %.1fs", time.time() - t0)
@@ -617,11 +631,13 @@ class FastPathServer:
                 return "skipped (stopping)"
             sel = np.full((self.q_batch, nb), dp.zero_block, np.int32)
             ws = np.zeros((self.q_batch, nb), wd)
+            bd, bt, s_, w_, dl, mk, mi = self._v1_inputs(
+                reg, sel, ws, masks, mask_ids)
             bm25_topk_total_batch(
-                dp.block_docids, dp.block_tfs, sel, ws, dp.doc_lens,
-                masks, mask_ids, wd(dp.avg_len), reg["k1"], reg["b"],
-                self.max_k).block_until_ready()
-            return f"v1 NB={nb}"
+                bd, bt, s_, w_, dl, mk, mi, wd(dp.avg_len), reg["k1"],
+                reg["b"], self.max_k).block_until_ready()
+            return f"v1 NB={nb}" + (
+                " (mesh)" if reg.get("rmesh") is not None else "")
 
         def warm_ess_dense(nb):
             if not self._running:
@@ -1158,13 +1174,19 @@ class FastPathServer:
                     continue
                 mask_ids[qi] = row
         k_static = self.max_k
+        bd, bt, sel_m, ws_m, dl, mk, mi = self._v1_inputs(
+            reg, sel, ws, stack, mask_ids)
         packed = bm25_topk_total_batch(
-            dp.block_docids, dp.block_tfs, sel, ws, dp.doc_lens, stack,
-            mask_ids, self._weight_dtype()(dp.avg_len), reg["k1"],
+            bd, bt, sel_m, ws_m, dl, mk, mi,
+            self._weight_dtype()(dp.avg_len), reg["k1"],
             reg["b"], k_static)
         out = np.asarray(packed)       # ONE device→host sync per cohort
         took_ms = int((time.time() - t_arrive) * 1000)
         self.stats["cohorts"] += 1
+        if self._mesh_active(reg):
+            self.stats["mesh_cohorts"] = \
+                self.stats.get("mesh_cohorts", 0) + 1
+            self.mesh_backend._dispatch("replica", len(items))
         h = self.front.h
         idx_b = reg["index"].encode()
         no_match_set = set(no_match)
@@ -1610,6 +1632,38 @@ class FastPathServer:
             reg["filter_live"][filt] = col
         return col
 
+    def _mesh_active(self, reg) -> bool:
+        """The ONE gate for replica-sharded v1 cohorts: a mesh bound at
+        registration AND the backend still enabled — the
+        ESTPU_MESH_SERVING=0 kill switch must reach already-registered
+        indices immediately, not at the next re-registration (the
+        unsharded signature may cold-compile once; a kill switch is
+        allowed that)."""
+        return (reg.get("rmesh") is not None
+                and self.mesh_backend is not None
+                and self.mesh_backend.enabled())
+
+    def _v1_inputs(self, reg, sel, ws, stack, mask_ids):
+        """The v1 kernel's launch inputs, replica-sharded over the
+        registration's mesh when one is bound: corpus arrays ride as
+        replicated handles (cached by identity — the mask stack
+        re-replicates only when a filter row actually changed), the
+        per-query rows shard P("replica"). ONE compile signature per
+        bucket either way (warm and serve both come through here)."""
+        dp = reg["dp"]
+        rmesh = reg.get("rmesh")
+        mb = self.mesh_backend
+        if rmesh is None or mb is None or not self._mesh_active(reg):
+            return (dp.block_docids, dp.block_tfs, sel, ws,
+                    dp.doc_lens, stack, mask_ids)
+        return (mb.replicated(rmesh, dp.block_docids),
+                mb.replicated(rmesh, dp.block_tfs),
+                mb.shard_rows(rmesh, sel),
+                mb.shard_rows(rmesh, ws),
+                mb.replicated(rmesh, dp.doc_lens),
+                mb.replicated(rmesh, stack),
+                mb.shard_rows(rmesh, mask_ids))
+
     def _launch_group_inner(self, reg, bucket, items, t_arrive,
                             stack, rows):
         from elasticsearch_tpu.ops.fastpath import bm25_topk_total_batch
@@ -1640,15 +1694,20 @@ class FastPathServer:
                     ws[qi, :] = 0.0
                     continue
                 mask_ids[qi] = row
-        masks = stack
         k_static = self.max_k
+        bd, bt, sel_m, ws_m, dl, mk, mi = self._v1_inputs(
+            reg, sel, ws, stack, mask_ids)
         packed = bm25_topk_total_batch(
-            dp.block_docids, dp.block_tfs, sel, ws, dp.doc_lens, masks,
-            mask_ids, self._weight_dtype()(dp.avg_len), reg["k1"], reg["b"],
+            bd, bt, sel_m, ws_m, dl, mk, mi,
+            self._weight_dtype()(dp.avg_len), reg["k1"], reg["b"],
             k_static)
         out = np.asarray(packed)       # ONE device→host sync per cohort
         took_ms = int((time.time() - t_arrive) * 1000)
         self.stats["cohorts"] += 1
+        if self._mesh_active(reg):
+            self.stats["mesh_cohorts"] = \
+                self.stats.get("mesh_cohorts", 0) + 1
+            self.mesh_backend._dispatch("replica", q)
         self.stats["fast_queries"] += q
         no_match_set = set(no_match)
         for qi, (tok, k, term_ids, filt) in enumerate(items):

@@ -270,6 +270,7 @@ KERNEL_ATTRIBUTION: Dict[str, str] = {
     "plan_topk": "launch",
     "plan_topk_packed": "launch",
     "plan_topk_batch": "launch",
+    "plan_topk_mesh": "launch",
     "bm25_dense_scores_sorted": "launch",
     "match_count_sorted": "score",
     "match_mask_sorted": "score",

@@ -760,20 +760,11 @@ class KnnQuery(QueryBuilder):
         _, ids = jax.lax.top_k(scores, nc)
         ids_h = np.asarray(ids)                # tiny readback [nc]
         ids_h = ids_h[ids_h < vv.vectors.shape[0]]
-        cand = vv.vectors[ids_h].astype(np.float32)
-        q32 = self.query_vector.astype(np.float32)
-        if dv.similarity == "cosine":
-            nrm = np.linalg.norm(cand, axis=1) * np.linalg.norm(q32)
-            sim = cand @ q32 / np.where(nrm > 0, nrm, 1.0)
-            exact = (1.0 + sim) / 2.0
-        elif dv.similarity == "dot_product":
-            exact = (1.0 + cand @ q32) / 2.0
-        else:  # l2_norm
-            d2 = ((cand - q32[None, :]) ** 2).sum(axis=1)
-            exact = 1.0 / (1.0 + d2)
+        exact = vec_ops.exact_rerank_scores(
+            vv.vectors[ids_h], self.query_vector.astype(np.float32),
+            dv.similarity)
         return scores.at[jnp.asarray(ids_h)].set(
-            jnp.asarray(exact.astype(np.float32)), mode="drop",
-            unique_indices=True)
+            jnp.asarray(exact), mode="drop", unique_indices=True)
 
     def rewrite(self, searcher):
         if self.filter_query is None:

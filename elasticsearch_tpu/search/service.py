@@ -184,11 +184,18 @@ class SearchService:
         # mesh-sharded execution: multi-shard indices with enough devices
         # run one SPMD fan-out/merge program instead of the per-shard loop
         # (ref: TransportSearchAction scatter-gather → shard_map +
-        # all_gather; parallel/mesh_executor.py)
+        # all_gather; parallel/mesh_executor.py). Ineligible shapes fall
+        # back to the loop with a typed counter — never an error.
         from elasticsearch_tpu.parallel.mesh_executor import (
-            MeshSearchExecutor,
+            MeshSearchBackend,
         )
-        self.mesh_executor = MeshSearchExecutor()
+        self.mesh_executor = MeshSearchBackend()
+        import os as _os
+        if _os.environ.get("ESTPU_REPLICA_BATCH") == "1":
+            # replica-axis cohort fan-out: continuous-batching launches
+            # split their query axis across the device mesh (opt-in —
+            # single-accelerator deployments gain nothing from it)
+            self.plan_batcher.mesh = self.mesh_executor
 
     # --------------------------------------------------------------- PIT
     def open_pit(self, index_expression: str, keep_alive: str) -> str:
@@ -322,6 +329,11 @@ class SearchService:
             for name, s in searchers:
                 s2 = copy.copy(s)
                 s2.stats = global_stats
+                # the mesh backend implements the DEFAULT per-shard-IDF
+                # semantics (bind_mesh reads each shard's own stats) —
+                # dfs-swapped searchers must take the per-shard loop,
+                # which scores with these global stats everywhere
+                s2.dfs_global_stats = True
                 swapped.append((name, s2))
             searchers = swapped
 
@@ -776,25 +788,55 @@ class SearchService:
 
         # ---- mesh fast path: a multi-shard single-index query with no
         # aggs/sort/rescore runs as ONE shard_map program over the device
-        # mesh — fan-out and merge in a single launch (mesh_executor.py)
+        # mesh — fan-out and merge in a single launch (mesh_executor.py).
+        # `profile: true` rides along: the launch records one pseudo-shard
+        # entry with per-chip device attribution (mesh_shape + devices)
         mesh_docs = None
         mesh_total = 0
+        mesh_profile_entry = None
         if (scroll_ctx is None and not continuing and post_filter is None
                 and sort is None and min_score is None
                 and search_after is None and not aggs_spec
-                and not rescore_spec and not collapse_field and not profile
+                and not rescore_spec and not collapse_field
                 and terminate_after is None and slice_spec is None
                 and len(searchers) > 1
                 and len({n for n, _ in searchers}) == 1):
-            mr = self.mesh_executor.execute(
-                searchers[0][0], [s for _, s in searchers], query, k)
+            from elasticsearch_tpu.search import profile as _prof
+            mesh_cm = None
+            mesh_rec: Dict[str, Any] = {}
+            t0_mesh = time.monotonic_ns()
+            if profile:
+                mesh_cm = _prof.profiling()
+                mesh_rec = mesh_cm.__enter__()
+            try:
+                mr = self.mesh_executor.execute(
+                    searchers[0][0], [s for _, s in searchers], query, k)
+            except Exception:  # noqa: BLE001 — mesh is an optimization
+                # the backend contract is "clean fallback, never an
+                # error": any mesh failure (slab upload OOM, device
+                # fault) logs, counts, and the per-shard loop — which
+                # served this query before the mesh existed — answers
+                import logging
+                logging.getLogger(__name__).exception(
+                    "mesh serving failed; using the per-shard loop")
+                self.mesh_executor._fallback("error")
+                mr = None
+            finally:
+                if mesh_cm is not None:
+                    mesh_cm.__exit__(None, None, None)
             if mr is not None:
                 mesh_docs, mesh_total = mr
+                if profile:
+                    mesh_profile_entry = _prof.shard_profile_tree(
+                        f"[{searchers[0][0]}][_mesh]", body, mesh_rec,
+                        time.monotonic_ns() - t0_mesh)
 
         # ---- query phase: fan out over shards (ref:
         # AbstractSearchAsyncAction.run / SearchPhaseController merge)
         shard_results: List[Tuple[str, ShardSearcher, QueryResult]] = []
         profile_shards: List[Dict[str, Any]] = []
+        if mesh_profile_entry is not None:
+            profile_shards.append(mesh_profile_entry)
         # per-shard failure capture (ref: the per-shard halves of
         # AbstractSearchAsyncAction.onShardFailure collapsed in-process):
         # a failing shard becomes a typed `_shards.failures` entry instead

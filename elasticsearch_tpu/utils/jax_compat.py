@@ -1,0 +1,60 @@
+"""JAX API compatibility shims.
+
+ONE resolver for ``shard_map`` (the mesh layer's SPMD seam): modern jax
+exports it as ``jax.shard_map`` (with a ``check_vma`` kwarg); the 0.4.x
+line this environment ships only has
+``jax.experimental.shard_map.shard_map`` (whose equivalent kwarg is
+``check_rep``). Every shard_map call site in the repo
+(parallel/sharded.py, parallel/mesh_executor.py, ops/plan.py) goes
+through this shim so the mesh layer runs — and is TESTABLE on the CPU
+virtual-device mesh — on both API generations instead of failing with
+``AttributeError: module 'jax' has no attribute 'shard_map'``.
+
+Usage matches the modern API::
+
+    from elasticsearch_tpu.utils.jax_compat import shard_map
+
+    @partial(shard_map, mesh=mesh, check_vma=False,
+             in_specs=(P("shard"),), out_specs=P())
+    def step(x): ...
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+
+def _resolve():
+    """(impl, replication-check kwarg name) for this jax version."""
+    impl = getattr(jax, "shard_map", None)
+    if impl is not None:
+        return impl, "check_vma"
+    from jax.experimental.shard_map import shard_map as impl
+    return impl, "check_rep"
+
+
+_SHARD_MAP, _CHECK_KW = _resolve()
+
+
+def shard_map(f: Optional[Callable] = None, *, mesh=None, in_specs=None,
+              out_specs=None, check_vma: Optional[bool] = None, **kw):
+    """Version-portable ``shard_map`` with the MODERN signature.
+
+    ``check_vma`` maps onto the old API's ``check_rep`` (both toggle
+    the per-output replication/varying-axes check; the mesh kernels
+    disable it because their all_gather/psum merges produce replicated
+    outputs the checker cannot always prove). Supports both direct and
+    ``partial``-decorator call styles, like the real thing.
+    """
+    if check_vma is not None:
+        kw[_CHECK_KW] = bool(check_vma)
+    if f is None:
+        from functools import partial
+        return partial(shard_map, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs,
+                       **({"check_vma": check_vma}
+                          if check_vma is not None else {}))
+    return _SHARD_MAP(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)

@@ -134,11 +134,16 @@ def test_mesh_missing_terms(node):
     assert r["hits"]["total"]["value"] == 0
 
 
-def test_mesh_multi_segment_shards(node):
-    """Shards with MULTIPLE segments (no force merge) now ride the mesh
-    via composite per-shard residency (VERDICT r2 item 7) — results
-    stay identical to the per-shard loop, and hits resolve to the right
-    segment-local docs (fetch returns the right _source)."""
+def test_mesh_multi_segment_shards(node, monkeypatch):
+    """Shards with MULTIPLE segments (no force merge): the DEFAULT
+    serving contract is byte-identical results, and composite residency
+    concatenates a shard's segments into one kernel array whose
+    segmented sums round with a different cumsum prefix base than the
+    per-segment loop — so unmerged shards take the per-shard loop with
+    a typed ``fallback.multi_segment`` counter. ESTPU_MESH_COMPOSITE=1
+    opts into the approximate composite mode (VERDICT r2 item 7), whose
+    results match the loop to float32 tolerance and whose hits resolve
+    to the right segment-local docs."""
     rng = np.random.default_rng(9)
     do(node, "PUT", "/ms", body={
         "settings": {"index": {"number_of_shards": 4}},
@@ -158,6 +163,15 @@ def test_mesh_multi_segment_shards(node):
     searchers = node.indices_service.get("ms").shard_searchers()
     assert any(len(s.segments) > 1 for s in searchers), \
         "fixture must produce multi-segment shards"
+    # default: clean typed fallback, results come from the loop
+    before = svc.mesh_executor.mesh_searches
+    fb = svc.mesh_executor.counters.get("fallback.multi_segment", 0)
+    r = search(node, "ms", QUERIES[0])
+    assert svc.mesh_executor.mesh_searches == before
+    assert svc.mesh_executor.counters["fallback.multi_segment"] == fb + 1
+    assert r["hits"]["hits"], "loop fallback must still answer"
+    # opt-in composite mode: mesh serves, results match to f32 tolerance
+    monkeypatch.setenv("ESTPU_MESH_COMPOSITE", "1")
     for q in QUERIES[:2] + [QUERIES[3]]:
         before = svc.mesh_executor.mesh_searches
         r_mesh = search(node, "ms", q)
@@ -167,15 +181,15 @@ def test_mesh_multi_segment_shards(node):
             r_loop = search(node, "ms", q)
         finally:
             svc.mesh_executor = ex
-        # composite residency sums a doc's contributions in a
-        # different lax.sort tie order than the per-segment loop, so
-        # exact-tied ranks may swap — compare rank-wise scores and the
-        # (id, score) sets instead of strict sequence
-        mesh_hits = sorted((round(h["_score"], 4), h["_id"])
-                           for h in r_mesh["hits"]["hits"])
-        loop_hits = sorted((round(h["_score"], 4), h["_id"])
-                           for h in r_loop["hits"]["hits"])
-        assert mesh_hits == loop_hits, q
+        # composite residency sums a doc's contributions on a different
+        # cumsum prefix base than the per-segment loop, so scores drift
+        # in the last f32 bits and exact-tied ranks may swap — compare
+        # id sets and rank-wise scores to tolerance, totals exactly
+        assert ({h["_id"] for h in r_mesh["hits"]["hits"]}
+                == {h["_id"] for h in r_loop["hits"]["hits"]}), q
+        mesh_scores = sorted(h["_score"] for h in r_mesh["hits"]["hits"])
+        loop_scores = sorted(h["_score"] for h in r_loop["hits"]["hits"])
+        assert np.allclose(mesh_scores, loop_scores, atol=1e-3), q
         assert r_mesh["hits"]["total"] == r_loop["hits"]["total"], q
         # fetch resolves composite docids to the right segment-local doc
         for h in r_mesh["hits"]["hits"]:
