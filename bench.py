@@ -78,7 +78,8 @@ def remaining_budget() -> float:
 
 def emit(metric_text: str, value: float, vs_baseline: float,
          engine=None, overload=None, tasks=None, cpu=None,
-         serving=None, skipped=None, aggs=None, multichip=None):
+         serving=None, skipped=None, aggs=None, multichip=None,
+         lint=None):
     _LAST_PAYLOAD.clear()
     _LAST_PAYLOAD.update({
         "metric": metric_text,
@@ -133,6 +134,13 @@ def emit(metric_text: str, value: float, vs_baseline: float,
         # show tripped == 0 everywhere — a nonzero count here means a
         # limit regression started shedding healthy traffic
         _LAST_PAYLOAD["overload"] = overload
+    if lint:
+        # estpu-lint preflight rider: rules_run / violations /
+        # baselined over the whole package, banked before the first
+        # device touch — the perf trajectory records contract drift
+        # (a growing baseline or a live violation) next to the qps it
+        # would eventually cost
+        _LAST_PAYLOAD["lint"] = lint
     print(json.dumps(_LAST_PAYLOAD), flush=True)
 
 
@@ -1832,7 +1840,25 @@ def main():
              serving=serving,
              skipped=parts.get("skipped"),
              aggs=parts.get("aggs"),
-             multichip=parts.get("multichip"))
+             multichip=parts.get("multichip"),
+             lint=parts.get("lint"))
+
+    # estpu-lint preflight: static contract scan of the whole package
+    # (stdlib ast, ~2s, no device). Summary rides every BENCH line so
+    # the round records its contract posture even if the device wedges.
+    try:
+        from elasticsearch_tpu.lint import run_lint
+        t0 = time.time()
+        s = run_lint().summary()
+        parts["lint"] = {
+            "rules_run": s["rules_run"], "files": s["files"],
+            "violations": s["violations"],
+            "baselined": s["baselined"],
+            "allowlisted": s["allowlisted"], "ok": s["ok"],
+            "scan_s": round(time.time() - t0, 1),
+        }
+    except Exception as e:  # noqa: BLE001 — the rider must not sink
+        log(f"lint preflight failed: {e!r}")
 
     rng = np.random.default_rng(12345)
     t0 = time.time()

@@ -34,6 +34,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
+from elasticsearch_tpu.common.errors import ElasticsearchTpuException
 from elasticsearch_tpu.cluster.state import (
     BLOCK_NO_MASTER,
     BLOCK_STATE_NOT_RECOVERED,
@@ -70,7 +71,7 @@ MODE_LEADER = "leader"
 MODE_FOLLOWER = "follower"
 
 
-class CoordinationStateRejectedException(Exception):
+class CoordinationStateRejectedException(ElasticsearchTpuException):
     """Ref: CoordinationStateRejectedException — a message that violates
     the ballot invariants (stale term, already voted, ...)."""
 
@@ -347,6 +348,7 @@ class Coordinator:
         # ConsistentSettingsService.java, wired node/Node.java:389-391)
         self.consistent_settings = consistent_settings
         import random as _random
+        # estpu: allow[ESTPU-DET02] election jitter must differ per node; the sim injects a seeded rng
         self.rng = rng or _random.Random()
 
         # discovered peers: node_id -> DiscoveryNode (candidates gossip)

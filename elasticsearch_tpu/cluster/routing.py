@@ -13,6 +13,7 @@ import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+from elasticsearch_tpu.common.errors import IndexNotFoundException
 from elasticsearch_tpu.cluster.state import (
     ClusterState,
     IndexShardRoutingTable,
@@ -110,7 +111,7 @@ class OperationRouting:
                     routing: Optional[str] = None) -> ShardId:
         imd = state.metadata.index(index)
         if imd is None:
-            raise KeyError(f"no such index [{index}]")
+            raise IndexNotFoundException(index)
         return ShardId(index,
                        self.shard_id(imd.number_of_shards, doc_id, routing))
 

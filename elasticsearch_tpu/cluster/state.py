@@ -17,6 +17,7 @@ import copy
 from dataclasses import dataclass, field, replace
 from typing import Any, Dict, FrozenSet, List, Optional, Tuple
 
+from elasticsearch_tpu.common.errors import ElasticsearchTpuException
 from elasticsearch_tpu.transport.transport import DiscoveryNode
 
 
@@ -508,5 +509,5 @@ class ClusterState:
         return (self.term, self.version) > (other.term, other.version)
 
 
-class IncompatibleClusterStateVersionException(Exception):
+class IncompatibleClusterStateVersionException(ElasticsearchTpuException):
     pass

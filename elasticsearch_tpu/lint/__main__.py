@@ -1,0 +1,96 @@
+"""CLI: ``python -m elasticsearch_tpu.lint [files...]``.
+
+Exit codes: 0 clean (baseline applied), 1 live violations, 2 broken
+run (stale baseline entries or unparsable sources) — CI treats 2 as
+"the suppression ledger lies", which is worse than a finding.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from elasticsearch_tpu.lint import run_lint
+from elasticsearch_tpu.lint.baseline import (
+    default_baseline_path, write_baseline,
+)
+from elasticsearch_tpu.lint.rules import all_rules
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m elasticsearch_tpu.lint",
+        description="estpu-lint: static contract checks for the "
+                    "engine (JIT/PAIR/DET/SHAPE/ERR families)")
+    ap.add_argument("files", nargs="*",
+                    help="specific .py files (default: the whole "
+                         "package)")
+    ap.add_argument("--root", default=None,
+                    help="scan root (default: the elasticsearch_tpu "
+                         "package)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline json (default: repo "
+                         "lint_baseline.json when scanning the "
+                         "package)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (show every finding)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept current findings into the baseline "
+                         "and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, desc in sorted(all_rules().items()):
+            print(f"{rid}  {desc}")
+        return 0
+
+    if args.write_baseline:
+        report = run_lint(root=args.root, files=args.files or None,
+                          use_baseline=False)
+        path = args.baseline or default_baseline_path()
+        write_baseline(report.violations, path)
+        print(f"wrote {len(report.violations)} finding(s) to {path}")
+        return 0
+
+    report = run_lint(root=args.root, files=args.files or None,
+                      baseline_path=args.baseline,
+                      use_baseline=not args.no_baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "summary": report.summary(),
+            "violations": [
+                {"rule": v.rule, "path": v.path, "line": v.line,
+                 "col": v.col, "message": v.message}
+                for v in report.violations],
+            "stale_baseline": report.stale_baseline,
+            "parse_errors": report.parse_errors,
+        }, indent=2))
+    else:
+        for v in report.violations:
+            print(v.render())
+        for e in report.stale_baseline:
+            print(f"STALE baseline entry: {e['rule']} {e['path']} "
+                  f"(baselined {e['baselined']}, found {e['found']}) "
+                  f"— fix the ledger: {e['message']}")
+        for p in report.parse_errors:
+            print(f"PARSE error: {p}")
+        s = report.summary()
+        print(f"estpu-lint: {s['files']} files, {s['rules_run']} rules"
+              f" — {s['violations']} violation(s), "
+              f"{s['baselined']} baselined, "
+              f"{s['allowlisted']} allowlisted")
+
+    if report.stale_baseline or report.parse_errors:
+        return 2
+    return 1 if report.violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

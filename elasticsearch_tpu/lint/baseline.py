@@ -1,0 +1,90 @@
+"""Baseline: exact-match suppression for pre-existing findings.
+
+Entries key on ``(rule, path, message)`` with an occurrence count —
+line numbers are recorded for humans but never matched, so unrelated
+edits don't churn the file. Semantics are shrink-only:
+
+- fewer live occurrences than the baseline count -> the entry is STALE
+  and the run FAILS (exit 2) until the entry is trimmed; a fixed
+  violation can never silently keep its suppression;
+- more live occurrences than the count -> the extras are live
+  violations (a baseline never absorbs regressions).
+
+Regenerate with ``python -m elasticsearch_tpu.lint --write-baseline``
+only when deliberately accepting a new pre-existing finding set.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter, defaultdict
+from typing import Any, Dict, List, Tuple
+
+from elasticsearch_tpu.lint.core import Violation
+
+__all__ = ["load_baseline", "apply_baseline", "write_baseline",
+           "default_baseline_path"]
+
+Key = Tuple[str, str, str]
+
+
+def default_baseline_path() -> str:
+    """lint_baseline.json at the repo root (the package's parent)."""
+    from elasticsearch_tpu.lint.core import package_root
+    return os.path.join(os.path.dirname(package_root()),
+                        "lint_baseline.json")
+
+
+def load_baseline(path: str) -> Dict[Key, int]:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    out: Dict[Key, int] = {}
+    for e in data.get("entries", []):
+        key = (e["rule"], e["path"], e["message"])
+        out[key] = out.get(key, 0) + int(e.get("count", 1))
+    return out
+
+
+def apply_baseline(violations: List[Violation],
+                   baseline: Dict[Key, int],
+                   ) -> Tuple[List[Violation], int, List[Dict[str, Any]]]:
+    """-> (live violations, baselined count, stale entries)."""
+    by_key: Dict[Key, List[Violation]] = defaultdict(list)
+    for v in violations:
+        by_key[v.key].append(v)
+    live: List[Violation] = []
+    baselined = 0
+    stale: List[Dict[str, Any]] = []
+    for key, count in baseline.items():
+        found = len(by_key.get(key, ()))
+        if found < count:
+            rule, path, message = key
+            stale.append({"rule": rule, "path": path,
+                          "message": message, "baselined": count,
+                          "found": found})
+    for key, vs in by_key.items():
+        allowed = baseline.get(key, 0)
+        vs = sorted(vs, key=lambda v: (v.line, v.col))
+        baselined += min(allowed, len(vs))
+        live.extend(vs[allowed:])
+    return live, baselined, stale
+
+
+def write_baseline(violations: List[Violation], path: str) -> None:
+    counts: Counter = Counter(v.key for v in violations)
+    first_line: Dict[Key, int] = {}
+    for v in sorted(violations, key=lambda v: (v.path, v.line)):
+        first_line.setdefault(v.key, v.line)
+    entries = [
+        {"rule": rule, "path": p, "message": msg, "count": n,
+         "line": first_line[(rule, p, msg)]}
+        for (rule, p, msg), n in sorted(counts.items())
+    ]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1,
+                   "comment": "shrink-only: fix the finding, then "
+                              "delete its entry; stale entries fail "
+                              "the run",
+                   "entries": entries}, fh, indent=2)
+        fh.write("\n")

@@ -1,0 +1,419 @@
+"""Intraprocedural pairing analysis: acquire must reach release on all
+paths, including exception edges (ESTPU-PAIR's engine).
+
+One engine serves every pair family (breaker charge/release, task
+register/unregister, span start/finish): a :class:`PairSpec` names the
+acquire and release patterns, and :func:`analyze_function` walks the
+function's structured control flow tracking the obligation.
+
+The walk is an abstract interpretation over Python's structured
+statements rather than an explicit basic-block graph — Python has no
+goto, so if/while/for/try/with recursion IS the CFG, and the structured
+form keeps exception edges honest: a statement that can raise while the
+obligation is open leaks unless an enclosing ``try`` releases in its
+``finally`` (or in a handler).
+
+Ownership escapes end the local obligation (the PR-7 lesson is that
+pairing is a CONTRACT that moves with the resource, and the analysis
+must follow it, not guess):
+
+- the token is returned, yielded, stored into an attribute/container,
+  or passed to another call -> the callee/holder owns the release;
+- the token (or charge receiver) is referenced from a nested function
+  -> release is delegated to a closure (the ``transport.py``
+  ``charge_inflight_bytes`` pattern returns its release closure);
+- the charge receiver is object state (``self.breaker``) -> the CLASS
+  owns the drain; rules/pair.py then requires a close-like method (the
+  exact shape whose absence was the PR-7 ``AggReduceConsumer`` leak).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Set, Tuple
+
+__all__ = ["PairSpec", "Obligation", "find_acquires", "analyze_function"]
+
+
+@dataclass(frozen=True)
+class PairSpec:
+    name: str                       # human label: "breaker charge"
+    acquire_attrs: Tuple[str, ...]  # method names that acquire
+    release_attrs: Tuple[str, ...]  # method names that release
+    release_names: Tuple[str, ...] = ()   # bare-call releases (closures)
+    # release must name the token/receiver (unregister(task)) vs be a
+    # method ON the token (span.finish())
+    release_on_token: bool = False
+
+
+@dataclass
+class Obligation:
+    spec: PairSpec
+    call: ast.Call
+    stmt: ast.stmt
+    token: Optional[str]        # local name bound to the resource
+    receiver: Optional[str]     # dotted receiver text of the acquire
+    self_scoped: bool           # receiver is object state (self.*)
+    escaped: bool = False
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+# -- acquire discovery ------------------------------------------------------
+
+def find_acquires(fn: ast.FunctionDef,
+                  specs: List[PairSpec]) -> List[Obligation]:
+    """Acquire sites in ``fn``'s own body (nested functions are their
+    own analysis units)."""
+    # locals assigned from self.* — a charge on them is object state
+    self_locals: Set[str] = set()
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            src = _dotted(stmt.value)
+            if src and src.startswith("self."):
+                self_locals.add(stmt.targets[0].id)
+
+    out: List[Obligation] = []
+    for stmt in _own_statements(fn):
+        for node in _walk_stmt_no_nested(stmt):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute):
+                continue
+            for spec in specs:
+                if node.func.attr not in spec.acquire_attrs:
+                    continue
+                recv = _dotted(node.func.value)
+                token = None
+                if isinstance(stmt, ast.Assign) and stmt.value is node \
+                        and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    token = stmt.targets[0].id
+                base = (recv or "").split(".")[0]
+                self_scoped = (recv or "").startswith("self.") \
+                    or base in self_locals
+                out.append(Obligation(spec, node, stmt, token, recv,
+                                      self_scoped))
+    return out
+
+
+def _own_statements(fn: ast.FunctionDef):
+    """Every statement of fn, excluding nested function/class bodies."""
+    stack = list(fn.body)
+    while stack:
+        s = stack.pop(0)
+        yield s
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        for f in ("body", "orelse", "finalbody"):
+            stack.extend(getattr(s, f, []) or [])
+        for h in getattr(s, "handlers", []) or []:
+            stack.extend(h.body)
+
+
+def _walk_stmt_no_nested(stmt: ast.stmt):
+    """Expressions of one statement, not descending into nested defs."""
+    stack: List[ast.AST] = [stmt]
+    while stack:
+        n = stack.pop()
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(c, ast.stmt):
+                continue        # statements handled by the block walk
+            stack.append(c)
+
+
+# -- escape analysis --------------------------------------------------------
+
+def _escapes(fn: ast.FunctionDef, ob: Obligation) -> bool:
+    """Does ownership of the resource leave this function?"""
+    # the acquire's value consumed anywhere but a plain `x = acquire()`
+    # or bare-expression statement is a handoff: `return tm.register(
+    # ...)`, `wrap(br.charge(...))` — the consumer owns the release
+    stmt = ob.stmt
+    direct = (isinstance(stmt, ast.Expr) and stmt.value is ob.call) \
+        or (isinstance(stmt, (ast.Assign, ast.AnnAssign))
+            and getattr(stmt, "value", None) is ob.call)
+    if not direct:
+        return True
+
+    token = ob.token
+    recv_base = (ob.receiver or "").split(".")[0]
+    watch = {n for n in (token, recv_base) if n and n != "self"}
+    if not watch:
+        return False
+
+    for node in ast.walk(fn):
+        # referenced from a nested function/lambda: release delegated
+        # to a closure (charge_inflight_bytes / IndexingPressure style)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            body = node.body if isinstance(node.body, list) \
+                else [node.body]
+            for sub in body:
+                if _names_in(sub) & watch:
+                    return True
+        if token is None:
+            continue
+        if isinstance(node, ast.Return) and node.value is not None \
+                and token in _names_in(node.value):
+            return True
+        if isinstance(node, (ast.Yield, ast.YieldFrom)) \
+                and node.value is not None \
+                and token in _names_in(node.value):
+            return True
+        # stored into an attribute, subscript, or container literal
+        if isinstance(node, ast.Assign):
+            if any(isinstance(t, (ast.Attribute, ast.Subscript))
+                   for t in node.targets) \
+                    and node.value is not ob.call \
+                    and token in _names_in(node.value):
+                return True
+        if isinstance(node, (ast.List, ast.Tuple, ast.Dict, ast.Set)) \
+                and token in _names_in(node):
+            return True
+        # passed as an argument to any call that is not a release
+        if isinstance(node, ast.Call) and node is not ob.call:
+            if _is_release(node, ob):
+                continue
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if token in _names_in(a):
+                    return True
+    return False
+
+
+# -- release matching -------------------------------------------------------
+
+def _is_release(call: ast.Call, ob: Obligation) -> bool:
+    spec = ob.spec
+    fname = None
+    if isinstance(call.func, ast.Attribute):
+        fname = call.func.attr
+    elif isinstance(call.func, ast.Name):
+        fname = call.func.id
+        if fname in spec.release_names:
+            return True
+    if fname not in spec.release_attrs:
+        return False
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    if spec.release_on_token:
+        if ob.token is None:
+            return False
+        recv = _dotted(call.func.value)
+        return recv == ob.token
+    # release carries the token as an argument (unregister(task)), or
+    # rides the same receiver (breaker.release after breaker.charge)
+    if ob.token is not None:
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            if ob.token in _names_in(a):
+                return True
+    recv = _dotted(call.func.value)
+    if recv and ob.receiver:
+        if recv == ob.receiver or recv.split(".")[0] \
+                == ob.receiver.split(".")[0]:
+            return True
+    return recv is None and ob.token is None
+
+
+def _stmt_releases(stmt: ast.stmt, ob: Obligation) -> bool:
+    for node in _walk_stmt_no_nested(stmt):
+        if isinstance(node, ast.Call) and _is_release(node, ob):
+            return True
+    return False
+
+
+def _stmt_can_raise(stmt: ast.stmt, ob: Obligation) -> bool:
+    """Conservative raise potential: any call (that is not the release
+    itself or a trivially-safe builtin) or an explicit raise/assert."""
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    safe = {"len", "isinstance", "id", "repr", "str", "int", "float",
+            "bool", "getattr", "print"}
+    for node in _walk_stmt_no_nested(stmt):
+        if isinstance(node, ast.Call) and node is not ob.call \
+                and not _is_release(node, ob):
+            name = node.func.id if isinstance(node.func, ast.Name) \
+                else None
+            if name in safe:
+                continue
+            return True
+    return False
+
+
+# -- the structured walk ----------------------------------------------------
+
+class _Leak:
+    def __init__(self, line: int, kind: str):
+        self.line = line
+        self.kind = kind
+
+
+class _Walker:
+    """Tracks one obligation through the function body.
+
+    ``open_`` means the resource is held and unreleased on the current
+    path. Two protection flags thread through the walk:
+
+    - ``pexc`` — an enclosing handler or finally releases on EXCEPTION
+      edges (a statement that can raise while open is covered);
+    - ``pexit`` — an enclosing ``finally`` releases on ALL exits, so
+      ``return``/``raise`` while open are covered too (a handler does
+      NOT run on return, so handler protection never sets this)."""
+
+    def __init__(self, ob: Obligation):
+        self.ob = ob
+        self.leaks: List[_Leak] = []
+        self._seen_acquire = False
+        self._exc_reported = False
+
+    # returns open state after the block; None = every path terminated
+    def block(self, stmts: List[ast.stmt], open_: Optional[bool],
+              pexc: bool, pexit: bool) -> Optional[bool]:
+        for stmt in stmts:
+            if open_ is None:
+                break
+            open_ = self.stmt(stmt, open_, pexc, pexit)
+        return open_
+
+    def stmt(self, stmt: ast.stmt, open_: bool,
+             pexc: bool, pexit: bool) -> Optional[bool]:
+        ob = self.ob
+        if not self._seen_acquire:
+            if stmt is ob.stmt or any(n is ob.call for n in
+                                      _walk_stmt_no_nested(stmt)):
+                self._seen_acquire = True
+                # the acquire itself can raise BEFORE the charge lands
+                # (the breaker contract: a tripped charge is not held)
+                return True
+            # still before the acquire: recurse so an acquire nested in
+            # a try/if is found, with state threaded through
+            return self._compound(stmt, open_, pexc, pexit)
+        if not open_:
+            # already released: only walk structure to respect
+            # termination (code after `return` in both branches)
+            return self._compound(stmt, False, pexc, pexit)
+        if _stmt_releases(stmt, ob):
+            return False
+        return self._compound(stmt, open_, pexc, pexit)
+
+    def _exc_leak(self, line: int, kind: str, pexc: bool,
+                  pexit: bool) -> None:
+        if not pexc and not pexit and not self._exc_reported:
+            self._exc_reported = True
+            self.leaks.append(_Leak(line, kind))
+
+    def _compound(self, stmt: ast.stmt, open_: bool,
+                  pexc: bool, pexit: bool) -> Optional[bool]:
+        ob = self.ob
+        if isinstance(stmt, ast.Return):
+            if open_ and not pexit:
+                self.leaks.append(_Leak(stmt.lineno, "return"))
+            return None
+        if isinstance(stmt, ast.Raise):
+            if open_:
+                self._exc_leak(stmt.lineno, "raise", pexc, pexit)
+            return None
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            return None
+        if isinstance(stmt, ast.If):
+            mentions = self.ob.token is not None \
+                and self.ob.token in _names_in(stmt.test)
+            o1 = self.block(list(stmt.body), open_, pexc, pexit)
+            o2 = self.block(list(stmt.orelse), open_, pexc, pexit)
+            return _merge(o1, o2, either_ok=mentions)
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            self.block(list(stmt.body), open_, pexc, pexit)
+            self.block(list(stmt.orelse), open_, pexc, pexit)
+            # loop body may run zero times: state unchanged, but a
+            # release ONLY inside the loop does not count as guaranteed
+            if open_ and _stmt_can_raise(stmt, ob):
+                self._exc_leak(stmt.lineno, "exception", pexc, pexit)
+            return open_
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            released = open_ and any(
+                self.ob.token is not None
+                and self.ob.token in _names_in(item.context_expr)
+                for item in stmt.items)
+            return self.block(list(stmt.body), open_ and not released,
+                              pexc, pexit)
+        if isinstance(stmt, ast.Try):
+            fin_releases = any(_stmt_releases(s, ob)
+                               for s in stmt.finalbody)
+            handler_releases = any(
+                any(_stmt_releases(s, ob) for s in h.body)
+                for h in stmt.handlers)
+            c_pexc = pexc or fin_releases or handler_releases
+            c_pexit = pexit or fin_releases
+            o_body = self.block(list(stmt.body), open_, c_pexc, c_pexit)
+            if o_body:
+                o_body = self.block(list(stmt.orelse), o_body,
+                                    c_pexc, c_pexit)
+            # handlers run with the obligation in whatever state the
+            # body could raise from — conservatively, still open
+            handler_open: List[Optional[bool]] = []
+            for h in stmt.handlers:
+                handler_open.append(
+                    self.block(list(h.body), open_, c_pexc, c_pexit))
+            merged: Optional[bool] = o_body
+            for o in handler_open:
+                merged = _merge(merged, o)
+            if stmt.finalbody:
+                if fin_releases:
+                    merged = False if merged is not None else None
+                else:
+                    merged = self.block(
+                        list(stmt.finalbody),
+                        merged if merged is not None else False,
+                        pexc, pexit)
+            return merged
+        # simple statement: exception edge while open
+        if open_ and _stmt_can_raise(stmt, ob):
+            self._exc_leak(stmt.lineno, "exception", pexc, pexit)
+        return open_
+
+
+def _merge(o1: Optional[bool], o2: Optional[bool],
+           either_ok: bool = False) -> Optional[bool]:
+    """Join of two branch outcomes. None = path terminated. either_ok:
+    the branch test mentions the token (``if span is not None:
+    span.finish()``) — a release in either branch closes the
+    obligation."""
+    if o1 is None:
+        return o2
+    if o2 is None:
+        return o1
+    if either_ok:
+        return o1 and o2
+    return o1 or o2
+
+
+def analyze_function(fn: ast.FunctionDef, ob: Obligation,
+                     ) -> List[Tuple[int, str]]:
+    """Leak list [(line, kind)] for one obligation; empty = paired on
+    all paths. ``kind``: 'return' (exits holding the resource),
+    'raise'/'exception' (an exception edge escapes without release),
+    'fallthrough' (function end with the resource held)."""
+    if ob.self_scoped or _escapes(fn, ob):
+        return []
+    w = _Walker(ob)
+    end_open = w.block(list(fn.body), False, False, False)
+    if end_open:
+        w.leaks.append(_Leak(fn.body[-1].lineno, "fallthrough"))
+    return [(l.line, l.kind) for l in w.leaks]

@@ -1,0 +1,30 @@
+"""Rule families. Each module ships ``RULES`` (id -> one-line
+description) and ``run(modules, index) -> (violations, allowlisted)``.
+
+Adding a family = adding a module here + a flagging and a passing
+fixture under ``tests/lint_fixtures/`` (the meta-test in
+``tests/test_lint.py`` fails otherwise).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from elasticsearch_tpu.lint.rules import det, errors, jit, pair, shape
+
+ALL_RULE_MODULES = (jit, pair, det, shape, errors)
+
+# the linter's own meta-rule (undocumented pragmas), reported by core
+META_RULES: Dict[str, str] = {
+    "ESTPU-LINT00": "allow[] pragma without a justification",
+}
+
+
+def all_rules() -> Dict[str, str]:
+    out: Dict[str, str] = dict(META_RULES)
+    for mod in ALL_RULE_MODULES:
+        out.update(mod.RULES)
+    return out
+
+
+__all__ = ["ALL_RULE_MODULES", "META_RULES", "all_rules"]

@@ -1,0 +1,203 @@
+"""ESTPU-DET — determinism.
+
+Chaos runs replay byte-identically only if sim/cluster code takes its
+time and randomness from injectable seams (``clock=``, seeded ``rng``,
+PRs 1–9). Wall-clock and global-rng calls in the scoped dirs are
+violations unless they sit behind a named allowlist entry (legitimate
+epoch-display sites, mostly ``rest/api.py``) or a documented pragma.
+
+DET03 targets iteration order: iterating a ``set`` of nodes/shards is
+nondeterministic across processes (string hash randomization), which
+is exactly how replica fan-out order once diverged between replays —
+``sorted(...)`` first.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from elasticsearch_tpu.lint.core import LintModule, Violation
+from elasticsearch_tpu.lint.registry import ProjectIndex
+
+RULES = {
+    "ESTPU-DET01": "wall-clock call outside the injectable clock seam",
+    "ESTPU-DET02": "unseeded randomness outside an injected rng seam",
+    "ESTPU-DET03": "iteration over an unordered set — sort first",
+}
+
+SCOPED_DIRS = ("cluster/", "transport/", "testing/", "rest/",
+               "snapshots/", "xpack/")
+SCOPED_FILES = ("search/async_search.py",)
+
+# time-module functions that read the wall clock (monotonic and
+# perf_counter are interval sources and stay behind clock= seams whose
+# DEFAULT may name them without calling)
+_TIME_WALL = {"time", "time_ns", "strftime", "gmtime", "localtime",
+              "ctime", "asctime"}
+_DATETIME_WALL = {"now", "utcnow", "today"}
+
+# Named allowlist: (path, enclosing function or None, rule id, reason).
+# Each entry is a deliberate, documented exemption — epoch fields that
+# exist for Elasticsearch API parity, where determinism is not a
+# contract (display-only columns, HTTP deadlines).
+WALL_CLOCK_ALLOWLIST: List[Tuple[str, Optional[str], str, str]] = [
+    ("rest/api.py", "_cat_indices", "ESTPU-DET01",
+     "creation.date epoch column is display-only ES parity"),
+    ("rest/api.py", "_cat_shards", "ESTPU-DET01",
+     "epoch column is display-only ES parity"),
+    ("rest/api.py", "handle", "ESTPU-DET01",
+     "HTTP request deadline is real wall time by definition"),
+    ("rest/api.py", None, "ESTPU-DET01",
+     "REST edge is the process boundary; took/epoch fields report "
+     "real time to clients"),
+]
+
+
+def _in_scope(rel: str) -> bool:
+    return rel.startswith(SCOPED_DIRS) or rel in SCOPED_FILES
+
+
+def _enclosing_fn(mod: LintModule, line: int) -> Optional[str]:
+    best: Optional[ast.FunctionDef] = None
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= line <= end:
+                if best is None or node.lineno > best.lineno:
+                    best = node
+    return best.name if best else None
+
+
+def _allowlisted(mod: LintModule, v: Violation) -> bool:
+    fn = _enclosing_fn(mod, v.line)
+    for path, func, rule, _reason in WALL_CLOCK_ALLOWLIST:
+        if path == v.path and rule == v.rule \
+                and (func is None or func == fn):
+            return True
+    return False
+
+
+def _module_of(mod: LintModule, func: ast.expr) -> Optional[str]:
+    """Real module a call's receiver resolves to, via import aliases."""
+    if isinstance(func, ast.Attribute) and isinstance(func.value,
+                                                      ast.Name):
+        return mod.module_aliases.get(func.value.id)
+    return None
+
+
+def _from_import(mod: LintModule,
+                 func: ast.expr) -> Optional[Tuple[str, str]]:
+    if isinstance(func, ast.Name):
+        return mod.from_imports.get(func.id)
+    return None
+
+
+_SET_METHODS = {"union", "difference", "intersection",
+                "symmetric_difference"}
+
+
+def _is_set_expr(node: ast.expr) -> bool:
+    if isinstance(node, ast.Set):
+        return True
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in ("set", "frozenset"):
+            return True
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _SET_METHODS:
+            return True
+    return False
+
+
+def run(modules: List[LintModule],
+        index: ProjectIndex) -> Tuple[List[Violation], int]:
+    vs: List[Violation] = []
+    allowlisted = 0
+    for mod in modules:
+        if not _in_scope(mod.rel):
+            continue
+        for node in ast.walk(mod.tree):
+            v: Optional[Violation] = None
+            if isinstance(node, ast.Call):
+                real_mod = _module_of(mod, node.func)
+                fi = _from_import(mod, node.func)
+                attr = node.func.attr \
+                    if isinstance(node.func, ast.Attribute) else None
+                # DET01 — wall clock. Conversion functions given an
+                # explicit timestamp (gmtime(t), strftime(fmt, t)) are
+                # pure and pass; only the read-the-clock forms flag.
+                time_fn = attr if real_mod == "time" else (
+                    fi[1] if fi and fi[0] == "time" else None)
+                if time_fn in _TIME_WALL:
+                    nargs = len(node.args) + len(node.keywords)
+                    implicit_now = (
+                        time_fn in ("time", "time_ns")
+                        or (time_fn == "strftime" and nargs < 2)
+                        or (time_fn in ("gmtime", "localtime", "ctime",
+                                        "asctime") and nargs == 0))
+                    if implicit_now:
+                        v = Violation(
+                            "ESTPU-DET01", mod.rel, node.lineno,
+                            node.col_offset,
+                            f"wall-clock {time_fn}() — take time "
+                            f"from the injectable clock seam")
+                elif attr in _DATETIME_WALL and isinstance(
+                        node.func, ast.Attribute):
+                    base = node.func.value
+                    base_mod = _module_of(mod, node.func)
+                    is_dt = base_mod == "datetime" or (
+                        isinstance(base, ast.Name)
+                        and mod.from_imports.get(base.id, ("", ""))[0]
+                        == "datetime")
+                    if is_dt:
+                        v = Violation(
+                            "ESTPU-DET01", mod.rel, node.lineno,
+                            node.col_offset,
+                            f"wall-clock datetime.{attr}() — take time "
+                            f"from the injectable clock seam")
+                # DET02 — global/unseeded randomness
+                if v is None:
+                    if real_mod == "random":
+                        if attr == "Random" and (node.args
+                                                 or node.keywords):
+                            pass    # seeded Random(seed): injectable
+                        else:
+                            v = Violation(
+                                "ESTPU-DET02", mod.rel, node.lineno,
+                                node.col_offset,
+                                f"global random.{attr}() — inject a "
+                                f"seeded Random instead")
+                    elif fi and fi[0] == "random" \
+                            and not (fi[1] == "Random"
+                                     and (node.args or node.keywords)):
+                        v = Violation(
+                            "ESTPU-DET02", mod.rel, node.lineno,
+                            node.col_offset,
+                            f"global random {fi[1]}() — inject a "
+                            f"seeded Random instead")
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if _is_set_expr(node.iter):
+                    v = Violation(
+                        "ESTPU-DET03", mod.rel, node.lineno,
+                        node.col_offset,
+                        "iterating a set directly — order is "
+                        "nondeterministic across processes; sorted() "
+                        "first")
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.GeneratorExp, ast.DictComp)):
+                for gen in node.generators:
+                    if _is_set_expr(gen.iter):
+                        v = Violation(
+                            "ESTPU-DET03", mod.rel, node.lineno,
+                            node.col_offset,
+                            "comprehension over a set — order is "
+                            "nondeterministic across processes; "
+                            "sorted() first")
+                        break
+            if v is not None:
+                if _allowlisted(mod, v):
+                    allowlisted += 1
+                else:
+                    vs.append(v)
+    return vs, allowlisted

@@ -1,0 +1,165 @@
+"""ESTPU-JIT — trace-safety.
+
+The engine's device contract (PR 3): every jit entry point in the
+engine dirs goes through ``telemetry.engine.tracked_jit`` so the
+compile tracker, persistent kernel cache, and per-request profile
+attribution all see it; and nothing host-impure runs inside a traced
+body, because trace-time reads poison the trace (a ``float(x)`` on a
+tracer is a silent recompile-per-call or an outright ConcretizationError
+on device).
+
+JIT03 is the static successor of the PR-8 runtime drift guard: every
+``ops/`` kernel name must carry a ``KERNEL_ATTRIBUTION`` row or the
+profiler buckets its device time as unattributed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set, Tuple
+
+from elasticsearch_tpu.lint.core import LintModule, Violation
+from elasticsearch_tpu.lint.registry import (
+    ProjectIndex, _call_func_name, is_bare_jax_jit,
+)
+
+RULES = {
+    "ESTPU-JIT01": "bare jax.jit in engine dirs — route through "
+                   "telemetry.engine.tracked_jit",
+    "ESTPU-JIT02": "host-impure operation inside a traced function body",
+    "ESTPU-JIT03": "ops/ tracked_jit kernel without a "
+                   "KERNEL_ATTRIBUTION row",
+}
+
+ENGINE_DIRS = ("ops/", "search/", "parallel/")
+
+# numpy metadata/introspection calls that are trace-safe (no host
+# compute on traced values)
+_NP_META_OK = {"finfo", "iinfo", "dtype", "result_type", "can_cast",
+               "issubdtype", "promote_types", "asarray"}
+_METRIC_BUMPS = {"inc", "increment", "observe"}
+_BREAKER_ATTRS = {"add_estimate_bytes_and_maybe_break",
+                  "add_without_breaking"}
+
+
+def _numpy_aliases(mod: LintModule) -> Set[str]:
+    return {alias for alias, real in mod.module_aliases.items()
+            if real == "numpy"}
+
+
+def _static_argnames(dec: ast.AST) -> Set[str]:
+    """static_argnames/static_argnums-named params of a jit wrapper
+    call (decorator or call form)."""
+    out: Set[str] = set()
+    if not isinstance(dec, ast.Call):
+        return out
+    for kw in dec.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) \
+                        and isinstance(n.value, str):
+                    out.add(n.value)
+    if _call_func_name(dec.func) == "partial":
+        pass  # partial(jax.jit, static_argnames=...) — kwargs above
+    return out
+
+
+def _trace_wrapper_call(mod: LintModule,
+                        fn: ast.FunctionDef) -> ast.AST:
+    """The decorator (or call-form wrapper Call) that traces ``fn``,
+    for static_argnames extraction; the function itself if none."""
+    for dec in fn.decorator_list:
+        if _call_func_name(dec) in ("tracked_jit", "jit", "pjit",
+                                    "shard_map", "partial"):
+            return dec
+    # call form: X = tracked_jit("name", static_argnames=...)(fn)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Call):
+            if any(isinstance(a, ast.Name) and a.id == fn.name
+                   for a in node.args):
+                return node.func
+    return fn
+
+
+def _check_traced_body(mod: LintModule, fn: ast.FunctionDef,
+                       vs: List[Violation]) -> None:
+    np_aliases = _numpy_aliases(mod)
+    statics = _static_argnames(_trace_wrapper_call(mod, fn))
+    params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+    traced_params = params - statics - {"self"}
+    seen: Set[Tuple[int, int, str]] = set()
+
+    def emit(node: ast.AST, what: str) -> None:
+        key = (node.lineno, node.col_offset, what)
+        if key in seen:
+            return
+        seen.add(key)
+        vs.append(Violation(
+            "ESTPU-JIT02", mod.rel, node.lineno, node.col_offset,
+            f"{what} inside traced body of '{fn.name}'"))
+
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            recv = f.value
+            if isinstance(recv, ast.Name) and recv.id in np_aliases \
+                    and f.attr not in _NP_META_OK:
+                emit(node, f"host numpy call np.{f.attr}")
+            elif f.attr == "item":
+                emit(node, "device readback .item()")
+            elif f.attr in _BREAKER_ATTRS or (
+                    f.attr == "release"
+                    and "breaker" in (ast.unparse(recv) if hasattr(
+                        ast, "unparse") else "")):
+                emit(node, f"breaker accounting .{f.attr}()")
+            elif f.attr in _METRIC_BUMPS and isinstance(
+                    recv, (ast.Attribute, ast.Name)):
+                rtxt = ast.unparse(recv).lower()
+                if any(h in rtxt for h in ("metric", "counter", "hist",
+                                           "gauge", "stats")):
+                    emit(node, f"metric bump .{f.attr}()")
+        elif isinstance(f, ast.Name):
+            if f.id in ("float", "int", "bool") and len(node.args) == 1 \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in traced_params:
+                emit(node, f"host readback {f.id}({node.args[0].id})")
+
+
+def run(modules: List[LintModule],
+        index: ProjectIndex) -> Tuple[List[Violation], int]:
+    vs: List[Violation] = []
+    for mod in modules:
+        if not mod.rel.startswith(ENGINE_DIRS):
+            continue
+        # JIT01 — any bare jax.jit spelling (decorator, call, partial)
+        flagged: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            target = None
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if is_bare_jax_jit(dec):
+                        target = dec
+            elif isinstance(node, ast.Call) and is_bare_jax_jit(node):
+                target = node
+            if target is not None and target.lineno not in flagged:
+                flagged.add(target.lineno)
+                vs.append(Violation(
+                    "ESTPU-JIT01", mod.rel, target.lineno,
+                    target.col_offset,
+                    "bare jax.jit — use telemetry.engine.tracked_jit so "
+                    "the compile tracker and profiler see this kernel"))
+        # JIT02 — host-impure ops inside traced bodies
+        for fn in index.traced_functions.get(mod.rel, []):
+            _check_traced_body(mod, fn, vs)
+    # JIT03 — ops/ kernels missing attribution rows
+    if index.attribution_keys:
+        for kname, (rel, line) in sorted(index.ops_kernels.items()):
+            if kname not in index.attribution_keys:
+                vs.append(Violation(
+                    "ESTPU-JIT03", rel, line, 0,
+                    f"ops kernel '{kname}' has no KERNEL_ATTRIBUTION "
+                    f"row in search/profile.py — device time would be "
+                    f"unattributed in profiles"))
+    return vs, 0

@@ -1,0 +1,121 @@
+"""ESTPU-PAIR — resource pairing.
+
+Every breaker charge reaches a release on every exit path (the PR-7
+``AggReduceConsumer`` leak was exactly a charge whose failure path
+never drained); the same engine covers task register/unregister and
+span start/finish.
+
+PAIR01 is the function-local check (cfg.py walk, exception edges
+included). PAIR02 is the class-level check for object-state charges:
+a class that charges ``self.breaker`` must own a drain — a
+``close``/``release``-shaped method whose body releases. ``finish`` is
+deliberately NOT a drain name: the PR-7 consumer had ``finish``-style
+accessors and still leaked, because nothing contractually final
+released the bytes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from elasticsearch_tpu.lint.cfg import (
+    PairSpec, analyze_function, find_acquires,
+)
+from elasticsearch_tpu.lint.core import LintModule, Violation
+from elasticsearch_tpu.lint.registry import ProjectIndex
+
+RULES = {
+    "ESTPU-PAIR01": "acquire does not reach its release on every path "
+                    "(exception edges included)",
+    "ESTPU-PAIR02": "class charges breaker from object state but has "
+                    "no drain method releasing it",
+}
+
+BREAKER = PairSpec(
+    name="breaker charge",
+    acquire_attrs=("add_estimate_bytes_and_maybe_break",),
+    release_attrs=("release", "_release"),
+    release_names=("release", "_release"),
+)
+TASK = PairSpec(
+    name="task registration",
+    acquire_attrs=("register",),
+    release_attrs=("unregister",),
+)
+SPAN = PairSpec(
+    name="span",
+    acquire_attrs=("start_span",),
+    release_attrs=("finish", "end", "close"),
+    release_on_token=True,
+)
+SPECS = [BREAKER, TASK, SPAN]
+
+# drain method shapes for PAIR02 ("finish" intentionally absent)
+_DRAIN_HINTS = ("close", "release", "stop", "shutdown", "clear",
+                "drain")
+
+
+def _is_drain_name(name: str) -> bool:
+    return name == "__exit__" or any(h in name for h in _DRAIN_HINTS)
+
+
+def _releases_breaker(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("release", "_release"):
+            return True
+    return False
+
+
+def _functions(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            yield node
+
+
+def run(modules: List[LintModule],
+        index: ProjectIndex) -> Tuple[List[Violation], int]:
+    vs: List[Violation] = []
+    for mod in modules:
+        # PAIR01 — per-function walk
+        for fn in _functions(mod.tree):
+            for ob in find_acquires(fn, SPECS):
+                if isinstance(ob.stmt, (ast.With, ast.AsyncWith)):
+                    continue        # context manager owns the release
+                if ob.spec is TASK:
+                    recv = (ob.receiver or "").lower()
+                    if "task" not in recv:
+                        continue    # atexit/plugin-style register
+                for line, kind in analyze_function(fn, ob):
+                    vs.append(Violation(
+                        "ESTPU-PAIR01", mod.rel, line, 0,
+                        f"{ob.spec.name} acquired in '{fn.name}' "
+                        f"(line {ob.call.lineno}) is not released on a "
+                        f"{kind} path"))
+        # PAIR02 — object-state breaker charges need a class drain
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = [n for n in cls.body
+                       if isinstance(n, ast.FunctionDef)]
+            has_drain = any(
+                _is_drain_name(m.name) and _releases_breaker(m)
+                for m in methods)
+            if has_drain:
+                continue
+            charge_sites: Dict[int, str] = {}
+            for m in methods:
+                for ob in find_acquires(m, [BREAKER]):
+                    if ob.self_scoped:
+                        charge_sites.setdefault(
+                            ob.call.lineno, m.name)
+            for line, mname in sorted(charge_sites.items()):
+                vs.append(Violation(
+                    "ESTPU-PAIR02", mod.rel, line, 0,
+                    f"class '{cls.name}' charges the breaker from "
+                    f"object state in '{mname}' but ships no "
+                    f"close/release drain method — the PR-7 "
+                    f"AggReduceConsumer leak shape"))
+    return vs, 0

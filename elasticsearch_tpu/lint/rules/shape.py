@@ -1,0 +1,120 @@
+"""ESTPU-SHAPE — recompile hazards.
+
+XLA compiles per shape signature. A jitted callee fed an array sliced
+to a raw per-request length (``scores[:k]`` with ``k`` straight off
+the request) compiles once per distinct ``k`` — the recompile storms
+PR 4/PR 9 spent real effort bucketing away. Shapes that reach a launch
+surface must pass through a documented bucketing helper first
+(``block_bucket``, ``pow2_buckets``, the ``search/batching.py``
+signature tiers).
+
+The check is call-site local and deliberately narrow: it flags a
+direct slice bound (or jnp constructor dim) that is a plain name NOT
+derived from a bucketing helper in the same function. Cross-function
+provenance is out of scope — the bucket helpers exist precisely so the
+derivation is local and visible.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set, Tuple
+
+from elasticsearch_tpu.lint.core import LintModule, Violation
+from elasticsearch_tpu.lint.registry import ProjectIndex, _call_func_name
+
+RULES = {
+    "ESTPU-SHAPE01": "per-request shape reaches a jitted callee "
+                     "without a bucketing helper",
+}
+
+SCOPED_DIRS = ("ops/", "search/", "parallel/", "rest/")
+
+# the documented bucketing seams (ops/device.py, ops/aggs.py,
+# search/batching.py)
+BUCKET_HELPERS = {"block_bucket", "pow2_buckets", "next_pow2",
+                  "_q_bucket", "_cut_bucket", "_signature",
+                  "bucket_len", "min", "max"}
+_JNP_CTORS = {"zeros", "ones", "full", "empty"}
+
+
+def _bucketed_names(fn: ast.FunctionDef) -> Set[str]:
+    """Names provably shape-safe inside ``fn``: assigned from a bucket
+    helper (or from a constant), or parameters that carry a bucketed
+    value by naming convention (*_bucket / *_budget)."""
+    out: Set[str] = set()
+    for a in fn.args.args + fn.args.kwonlyargs:
+        if a.arg.endswith(("_bucket", "_budget", "_cap")):
+            out.add(a.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            v = node.value
+            tgt = node.targets[0].id
+            if isinstance(v, ast.Constant):
+                out.add(tgt)
+            elif isinstance(v, ast.Call) \
+                    and _call_func_name(v.func) in BUCKET_HELPERS:
+                out.add(tgt)
+            elif isinstance(v, ast.Name) and v.id in out:
+                out.add(tgt)
+    return out
+
+
+def _hazard_name(expr: ast.expr, bucketed: Set[str]) -> str:
+    """A plain-name shape source that is not provably bucketed."""
+    if isinstance(expr, ast.Name) and expr.id not in bucketed:
+        return expr.id
+    return ""
+
+
+def run(modules: List[LintModule],
+        index: ProjectIndex) -> Tuple[List[Violation], int]:
+    vs: List[Violation] = []
+    launch = index.launch_surfaces
+    if not launch:
+        return vs, 0
+    for mod in modules:
+        if not mod.rel.startswith(SCOPED_DIRS):
+            continue
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            bucketed = _bucketed_names(fn)
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                cname = _call_func_name(call.func)
+                if cname not in launch:
+                    continue
+                for arg in list(call.args) + [kw.value
+                                              for kw in call.keywords]:
+                    # scores[:k] with raw k
+                    if isinstance(arg, ast.Subscript) \
+                            and isinstance(arg.slice, ast.Slice):
+                        for bound in (arg.slice.lower, arg.slice.upper):
+                            if bound is None:
+                                continue
+                            nm = _hazard_name(bound, bucketed)
+                            if nm:
+                                vs.append(Violation(
+                                    "ESTPU-SHAPE01", mod.rel,
+                                    arg.lineno, arg.col_offset,
+                                    f"slice bound '{nm}' feeding "
+                                    f"jitted '{cname}' is not "
+                                    f"bucketed — recompile per "
+                                    f"distinct value"))
+                    # jnp.zeros(n) with raw n
+                    elif isinstance(arg, ast.Call) \
+                            and isinstance(arg.func, ast.Attribute) \
+                            and arg.func.attr in _JNP_CTORS \
+                            and arg.args:
+                        nm = _hazard_name(arg.args[0], bucketed)
+                        if nm:
+                            vs.append(Violation(
+                                "ESTPU-SHAPE01", mod.rel,
+                                arg.lineno, arg.col_offset,
+                                f"constructor dim '{nm}' feeding "
+                                f"jitted '{cname}' is not bucketed — "
+                                f"recompile per distinct value"))
+    return vs, 0

@@ -53,6 +53,7 @@ from elasticsearch_tpu.index.segment import BLOCK_SIZE, Segment
 from elasticsearch_tpu.ops import plan as plan_ops
 from elasticsearch_tpu.ops.device import block_bucket
 from elasticsearch_tpu.search.plan import LogicalPlan, compile_plan
+from elasticsearch_tpu.telemetry.engine import tracked_jit
 from elasticsearch_tpu.utils.jax_compat import shard_map
 
 DOC_PAD = 1024
@@ -439,7 +440,8 @@ def _knn_local_scores(vectors, sq_norms, has_value, qvec, similarity):
     return jnp.where(mask, scores, 0.0), mask
 
 
-@partial(jax.jit, static_argnames=("mesh", "similarity", "nc"))
+@tracked_jit("mesh_knn_nominate",
+             static_argnames=("mesh", "similarity", "nc"))
 def _mesh_knn_nominate(vectors, sq_norms, has_value, qvec,
                        mesh: Mesh, similarity: str, nc: int):
     """Quantized-slab nomination: per-shard top-``nc`` candidate ids
@@ -457,8 +459,9 @@ def _mesh_knn_nominate(vectors, sq_norms, has_value, qvec,
     return step(vectors, sq_norms, has_value, qvec)
 
 
-@partial(jax.jit, static_argnames=("mesh", "nd", "similarity", "boost",
-                                   "cut", "k", "with_patch"))
+@tracked_jit("mesh_knn_step",
+             static_argnames=("mesh", "nd", "similarity", "boost",
+                              "cut", "k", "with_patch"))
 def _mesh_knn_step(vectors, sq_norms, has_value, live, qvec,
                    patch_ids, patch_vals, mesh: Mesh, nd: int,
                    similarity: str, boost: float, cut: int, k: int,

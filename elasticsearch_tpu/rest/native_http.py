@@ -135,6 +135,7 @@ class NativeHttpFront:
         h = ctypes.c_int64()
         bound = self.lib.es_http_start(port, ctypes.byref(h))
         if bound < 0:
+            # estpu: allow[ESTPU-ERR01] bind failure keeps socket OSError semantics; callers fall back to the Python front
             raise OSError(f"native http front failed to bind port {port}")
         self.h = h
         self.port = bound

@@ -18,7 +18,7 @@ import base64
 import threading
 import time
 import uuid
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 from elasticsearch_tpu.common.errors import (
     ElasticsearchTpuException,
@@ -32,12 +32,14 @@ DEFAULT_KEEP_ALIVE = 5 * 24 * 3600.0  # 5d, ref: async-search default
 
 class _AsyncSearch:
     def __init__(self, search_id: str, index_expression: str,
-                 body: Dict[str, Any], keep_alive: float):
+                 body: Dict[str, Any], keep_alive: float,
+                 clock: Optional[Callable[[], float]] = None):
+        self.clock = clock or time.time
         self.id = search_id
         self.index_expression = index_expression
         self.body = body
-        self.start_ms = int(time.time() * 1000)
-        self.expires_at = time.time() + keep_alive
+        self.start_ms = int(self.clock() * 1000)
+        self.expires_at = self.clock() + keep_alive
         self.done = threading.Event()
         self.response: Optional[Dict[str, Any]] = None
         self.error: Optional[Dict[str, Any]] = None
@@ -47,9 +49,13 @@ class _AsyncSearch:
 
 
 class AsyncSearchService:
-    def __init__(self, search_service, task_manager):
+    def __init__(self, search_service, task_manager,
+                 clock: Optional[Callable[[], float]] = None):
         self.search_service = search_service
         self.task_manager = task_manager
+        # injectable wall-clock seam (expiry/display epochs) so the
+        # deterministic harness can drive keep-alive reaping
+        self.clock = clock or time.time
         self._searches: Dict[str, _AsyncSearch] = {}
         self._lock = threading.Lock()
 
@@ -64,7 +70,7 @@ class AsyncSearchService:
         search_id = base64.urlsafe_b64encode(
             uuid.uuid4().bytes).decode().rstrip("=")
         search = _AsyncSearch(search_id, index_expression, body or {},
-                              keep_alive)
+                              keep_alive, clock=self.clock)
         task = self.task_manager.register(
             "transport", "indices:data/read/async_search/submit",
             description=f"async_search indices[{index_expression}]",
@@ -88,7 +94,7 @@ class AsyncSearchService:
             except Exception as e:  # pragma: no cover - defensive
                 search.error = {"type": "exception", "reason": str(e)}
             finally:
-                search.completed_ms = int(time.time() * 1000)
+                search.completed_ms = int(self.clock() * 1000)
                 self.task_manager.unregister(task)
                 search.done.set()
 
@@ -103,7 +109,7 @@ class AsyncSearchService:
         params = params or {}
         search = self._lookup(search_id)
         if "keep_alive" in params:
-            search.expires_at = time.time() + parse_time_value(
+            search.expires_at = self.clock() + parse_time_value(
                 params["keep_alive"], "keep_alive")
         if "wait_for_completion_timeout" in params:
             search.done.wait(timeout=parse_time_value(
@@ -130,7 +136,7 @@ class AsyncSearchService:
         """Caller holds the lock. Expired entries are removed; any whose
         search is still running is cancelled so it cannot burn CPU as an
         unaddressable orphan."""
-        now = time.time()
+        now = self.clock()
         expired = [a for a in self._searches.values()
                    if a.expires_at < now]
         for a in expired:
@@ -157,11 +163,11 @@ class AsyncSearchService:
         elif search.response is not None:
             out["response"] = search.response
             out["completion_time_in_millis"] = (
-                search.completed_ms or int(time.time() * 1000))
+                search.completed_ms or int(self.clock() * 1000))
         else:
             # still running: the skeleton partial response
             out["response"] = {
-                "took": int(time.time() * 1000) - search.start_ms,
+                "took": int(self.clock() * 1000) - search.start_ms,
                 "timed_out": False,
                 "hits": {"total": {"value": 0, "relation": "gte"},
                          "max_score": None, "hits": []},

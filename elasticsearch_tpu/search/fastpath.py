@@ -97,6 +97,7 @@ def probe_regime() -> str:
             return _REGIME
         import jax.numpy as jnp
 
+        # estpu: allow[ESTPU-JIT01] one-shot regime probe kernel, deliberately outside the tracker
         f = jax.jit(lambda x: x * 2.0 + 1.0)
         x = jnp.ones(256, jnp.float32)
         np.asarray(f(x))          # compile; readback is free here

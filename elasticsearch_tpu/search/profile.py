@@ -257,12 +257,12 @@ HOST_STAGES = ("rewrite", "compile", "bind", "merge")
 #
 # Every `tracked_jit` entry point in ops/ MUST have a row here — the
 # KEY SET is the wiring contract: a kernel added without a row fails
-# the tier-1 drift guard (tests/test_profile_api.py), forcing the
-# author to decide (and document) which profile stage its launches are
-# timed under. The VALUE documents that stage — it must name a real
-# stage (the drift guard validates it) but is not consulted at run
-# time; the actual timing comes from the `span()` call site wrapping
-# the launch.
+# the static analyzer (ESTPU-JIT03, elasticsearch_tpu/lint) on every
+# tier-1 run, forcing the author to decide (and document) which
+# profile stage its launches are timed under. The VALUE documents that
+# stage — it must name a real stage (tests/test_profile_api.py
+# validates it) but is not consulted at run time; the actual timing
+# comes from the `span()` call site wrapping the launch.
 # ---------------------------------------------------------------------------
 
 KERNEL_ATTRIBUTION: Dict[str, str] = {
@@ -297,6 +297,9 @@ KERNEL_ATTRIBUTION: Dict[str, str] = {
     "knn_nominate_batch": "launch",
     # ops/pallas_bm25.py
     "bm25_contrib_pallas": "launch",
+    # parallel/mesh_executor.py — mesh kNN SPMD programs
+    "mesh_knn_nominate": "launch",
+    "mesh_knn_step": "launch",
 }
 
 

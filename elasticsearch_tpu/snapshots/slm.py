@@ -15,7 +15,7 @@ import json
 import os
 import re
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from elasticsearch_tpu.common.errors import (
     IllegalArgumentException,
@@ -25,9 +25,14 @@ from elasticsearch_tpu.common.errors import (
 
 class SnapshotLifecycleService:
     def __init__(self, repositories_service, indices_service,
-                 data_path: Optional[str] = None):
+                 data_path: Optional[str] = None,
+                 clock: Optional[Callable[[], float]] = None):
         self.repositories = repositories_service
         self.indices = indices_service
+        # injectable wall-clock seam: retention cutoffs, success stamps
+        # and date-math snapshot names all derive from one clock so
+        # deterministic tests can replay retention decisions
+        self.clock = clock or time.time
         self._policies: Dict[str, Dict[str, Any]] = {}
         self._stats: Dict[str, Dict[str, Any]] = {}
         self._path = (os.path.join(data_path, "_slm_policies.json")
@@ -82,7 +87,8 @@ class SnapshotLifecycleService:
                 f"snapshot lifecycle policy [{policy_id}] not found")
         policy = self._policies[policy_id]
         repo = self.repositories.get_repository(policy["repository"])
-        name = self._resolve_name(policy.get("name", f"<{policy_id}-{{now/d}}>"))
+        name = self._resolve_name(policy.get("name", f"<{policy_id}-{{now/d}}>"),
+                                  now=self.clock())
         config = policy.get("config", {})
         index_expr = config.get("indices", "*")
         if isinstance(index_expr, list):
@@ -92,7 +98,7 @@ class SnapshotLifecycleService:
         info = repo.snapshot(name, indices, metadata={"policy": policy_id})
         self._stats[policy_id] = {
             "last_success": {"snapshot_name": name,
-                             "time": int(time.time() * 1000)}}
+                             "time": int(self.clock() * 1000)}}
         self._apply_retention(policy_id, policy, repo)
         return {"snapshot_name": name}
 
@@ -108,7 +114,7 @@ class SnapshotLifecycleService:
         expire_after = retention.get("expire_after")
         to_delete: List[str] = []
         if expire_after:
-            cutoff = time.time() * 1000 - _parse_ms(expire_after)
+            cutoff = self.clock() * 1000 - _parse_ms(expire_after)
             min_count = retention.get("min_count", 0)
             expired = [s for s in mine
                        if s["start_time_in_millis"] < cutoff]
@@ -126,16 +132,16 @@ class SnapshotLifecycleService:
             repo.delete_snapshot(name)
 
     @staticmethod
-    def _resolve_name(template: str) -> str:
+    def _resolve_name(template: str, now: float) -> str:
         """``<prefix-{now/d}>`` date-math names (ref: date-math index name
-        resolver used for snapshot names). A random suffix is appended —
-        as the reference does — so re-executions within one date bucket
-        never collide."""
+        resolver used for snapshot names) stamped from the service clock.
+        A random suffix is appended — as the reference does — so
+        re-executions within one date bucket never collide."""
         import uuid
         name = template.strip()
         if name.startswith("<") and name.endswith(">"):
             name = name[1:-1]
-        stamp = time.strftime("%Y.%m.%d", time.gmtime())
+        stamp = time.strftime("%Y.%m.%d", time.gmtime(now))
         name = re.sub(r"\{now(?:/[dhm])?(?:\{.*?\})?\}", stamp, name)
         return f"{name.lower()}-{uuid.uuid4().hex[:8]}"
 

@@ -115,6 +115,7 @@ class PersistentTasksService:
                     f"task with id [{task_id}] already exists")
             row = {"task_name": task_name, "params": params, "state": {},
                    "allocation_id": 1, "finished": False, "failure": None,
+                   # estpu: allow[ESTPU-DET01] epoch display field (ES persistent-task parity), not used for scheduling
                    "start_time": int(time.time() * 1000)}
             self._rows[task_id] = row
             self._persist()

@@ -74,7 +74,7 @@ class Task:
         self.action = action
         self.description = description
         self.parent_task_id = parent_task_id
-        self.start_time = time.time()
+        self.start_time = time.time()  # estpu: allow[ESTPU-DET01] epoch display field (ES _tasks parity); running time uses the injected clock
         # the task's CURRENT profile stage (rewrite/bind/launch/fetch/
         # ...), published by the ambient profile.stage_hook the search
         # paths install — `_tasks?detailed=true` and hot_threads show

@@ -1,0 +1,12 @@
+# lint-fixture: flags=ESTPU-DET01,ESTPU-DET02,ESTPU-DET03
+"""Nondeterminism trifecta in cluster code: wall clock, global rng,
+and set-ordered fan-out — three ways a chaos replay diverges."""
+import random
+import time
+
+
+def schedule_election(nodes):
+    deadline = time.time() + 1.0  # lint-expect: ESTPU-DET01
+    jitter = random.random()  # lint-expect: ESTPU-DET02
+    for node in set(nodes):  # lint-expect: ESTPU-DET03
+        ping(node, deadline, jitter)

@@ -1,0 +1,9 @@
+# lint-fixture: flags=ESTPU-LINT00,ESTPU-DET01
+"""A pragma without a justification suppresses nothing and is itself a
+violation — every exemption must say why."""
+import time
+
+
+def deadline():
+    # estpu: allow[ESTPU-DET01]
+    return time.time() + 5.0  # lint-expect: ESTPU-DET01

@@ -1,0 +1,8 @@
+# lint-fixture: flags=ESTPU-ERR01
+"""A bare builtin raise in cluster code: falls through failure_type_of
+classification as an opaque 500 and breaks the retryability matrix."""
+
+
+def apply_vote(term, current_term):
+    if term < current_term:
+        raise ValueError(f"stale term {term}")  # lint-expect: ESTPU-ERR01

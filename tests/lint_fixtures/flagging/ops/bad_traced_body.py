@@ -1,0 +1,13 @@
+# lint-fixture: flags=ESTPU-JIT02
+"""Host-impure operations inside a traced body: a numpy call and a
+scalar readback on a traced argument. (Kernel name reuses a real
+attribution row so only JIT02 fires.)"""
+import numpy as np
+
+from elasticsearch_tpu.telemetry.engine import tracked_jit
+
+
+@tracked_jit("plan_topk")
+def impure_kernel(x):
+    y = np.mean(x)  # lint-expect: ESTPU-JIT02
+    return y + float(x)  # lint-expect: ESTPU-JIT02

@@ -1,0 +1,9 @@
+# lint-fixture: flags=ESTPU-JIT03
+"""An ops/ tracked_jit kernel with no KERNEL_ATTRIBUTION row — its
+device time would be unattributed in per-request profiles."""
+from elasticsearch_tpu.telemetry.engine import tracked_jit
+
+
+@tracked_jit("zz_fixture_unattributed")
+def zz_fixture_unattributed(x):  # lint-expect: ESTPU-JIT03
+    return x

@@ -1,0 +1,20 @@
+# lint-fixture: flags=ESTPU-PAIR02
+"""The PR-7 AggReduceConsumer regression shape: the class charges the
+breaker from object state on every consume() but ships no drain — a
+failed reduction strands every accounted byte."""
+
+
+class LeakyReduceConsumer:
+    def __init__(self, breaker):
+        self.breaker = breaker
+        self._accounted = 0
+
+    def consume(self, partial):
+        size = estimate_size(partial)
+        self.breaker.add_estimate_bytes_and_maybe_break(size, "agg_partials")  # lint-expect: ESTPU-PAIR02
+        self._accounted += size
+
+    def finish(self):
+        # `finish` is deliberately not a drain name: PR-7's consumer
+        # had exactly this accessor and still leaked
+        return self._accounted

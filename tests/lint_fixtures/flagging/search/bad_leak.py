@@ -1,0 +1,13 @@
+# lint-fixture: flags=ESTPU-PAIR01
+"""The PR-7 leak, function-local form: a breaker charge whose merge
+loop can raise before the release runs — the bytes stay accounted
+forever and the breaker slowly strangles the node."""
+
+
+def reduce_partials(breaker, partials):
+    total = 0
+    breaker.add_estimate_bytes_and_maybe_break(1024, "agg_partials")
+    for part in partials:
+        total += merge_partial(part)  # lint-expect: ESTPU-PAIR01
+    breaker.release(1024)
+    return total

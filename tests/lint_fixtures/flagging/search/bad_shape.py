@@ -1,0 +1,16 @@
+# lint-fixture: flags=ESTPU-SHAPE01
+"""A per-request size sliced straight into a jitted callee: one XLA
+compile per distinct `size` value — the recompile-storm shape the
+bucketing helpers exist to prevent. (Kernel name reuses a real
+attribution row so only SHAPE01 fires.)"""
+from elasticsearch_tpu.telemetry.engine import tracked_jit
+
+
+@tracked_jit("plan_topk_batch")
+def score_block(block):
+    return block
+
+
+def serve(request, postings):
+    k = request["size"]
+    return score_block(postings[:k])  # lint-expect: ESTPU-SHAPE01

@@ -1,0 +1,20 @@
+# lint-fixture: passes=ESTPU-DET01,ESTPU-DET02,ESTPU-DET03
+"""The injectable twin of bad_clock.py: clock and rng arrive through
+seams (defaults reference, never call, the wall clock) and fan-out is
+sorted — a chaos replay is byte-identical."""
+import random
+import time
+from typing import Callable, Optional
+
+
+class ElectionScheduler:
+    def __init__(self, clock: Optional[Callable[[], float]] = None,
+                 rng: Optional[random.Random] = None):
+        self.clock = clock or time.monotonic
+        self.rng = rng or random.Random(42)
+
+    def schedule(self, nodes):
+        deadline = self.clock() + 1.0
+        jitter = self.rng.random()
+        for node in sorted(set(nodes)):
+            ping(node, deadline, jitter)

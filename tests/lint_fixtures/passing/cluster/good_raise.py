@@ -1,0 +1,9 @@
+# lint-fixture: passes=ESTPU-ERR01
+"""Typed raise: classified by failure_type_of, mapped by the
+retryability matrix, rendered with a real HTTP status."""
+from elasticsearch_tpu.common.errors import IllegalArgumentException
+
+
+def apply_vote(term, current_term):
+    if term < current_term:
+        raise IllegalArgumentException(f"stale term {term}")

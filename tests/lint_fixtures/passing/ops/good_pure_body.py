@@ -1,0 +1,12 @@
+# lint-fixture: passes=ESTPU-JIT02
+"""A pure traced body: jnp ops on traced values; shape metadata reads
+are concrete at trace time and allowed."""
+import jax.numpy as jnp
+
+from elasticsearch_tpu.telemetry.engine import tracked_jit
+
+
+@tracked_jit("fixture_pure", static_argnames=("scale",))
+def fixture_pure(x, scale):
+    n = int(x.shape[0])
+    return jnp.sum(x) * scale + n

@@ -1,0 +1,10 @@
+# lint-fixture: passes=ESTPU-JIT01,ESTPU-JIT03
+"""The tracked twin of bad_untracked.py: routed through tracked_jit
+and carrying an attribution row (this corpus ships its own
+search/profile.py table)."""
+from elasticsearch_tpu.telemetry.engine import tracked_jit
+
+
+@tracked_jit("fixture_topk", static_argnames=("k",))
+def fixture_topk(scores, k):
+    return scores

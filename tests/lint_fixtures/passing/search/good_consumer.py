@@ -1,0 +1,19 @@
+# lint-fixture: passes=ESTPU-PAIR02
+"""The PR-7 fix shape: object-state charges are drained by close() —
+the failure path calls it and the accounted bytes go back."""
+
+
+class DrainingReduceConsumer:
+    def __init__(self, breaker):
+        self.breaker = breaker
+        self._accounted = 0
+
+    def consume(self, partial):
+        size = estimate_size(partial)
+        self.breaker.add_estimate_bytes_and_maybe_break(size, "agg_partials")
+        self._accounted += size
+
+    def close(self):
+        if self._accounted:
+            self.breaker.release(self._accounted)
+            self._accounted = 0

@@ -1,0 +1,11 @@
+# lint-fixture: passes=ESTPU-PAIR01
+"""The paired twin of bad_leak.py: the charge is released in a
+``finally``, so every exit — return, raise, exception edge — drains."""
+
+
+def reduce_partials(breaker, partials):
+    breaker.add_estimate_bytes_and_maybe_break(1024, "agg_partials")
+    try:
+        return merge_all(partials)
+    finally:
+        breaker.release(1024)

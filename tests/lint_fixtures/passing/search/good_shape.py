@@ -1,0 +1,16 @@
+# lint-fixture: passes=ESTPU-SHAPE01
+"""The bucketed twin of bad_shape.py: the per-request size passes
+through a documented bucketing helper, collapsing the compile space to
+the pow2 ladder."""
+from elasticsearch_tpu.ops.device import block_bucket
+from elasticsearch_tpu.telemetry.engine import tracked_jit
+
+
+@tracked_jit("fixture_score")
+def fixture_score(block):
+    return block
+
+
+def serve(request, postings):
+    k = block_bucket(request["size"])
+    return fixture_score(postings[:k])

@@ -1,0 +1,313 @@
+"""estpu-lint tier-1 gate: the repo stays clean under the committed
+baseline, every shipped rule has a flagging + passing fixture, the CLI
+exit codes hold, and each historical bug shape (PR-7 breaker leak,
+untracked jit, wall clock in cluster/) is caught at its exact line.
+
+Fast and offline: the analyzer is stdlib-``ast`` only and never
+imports the code under analysis (the one runtime-discovery test below
+imports ops/ the same way the serving path does).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from elasticsearch_tpu.lint import all_rules, package_root, run_lint
+from elasticsearch_tpu.lint.__main__ import main as lint_main
+from elasticsearch_tpu.lint.baseline import apply_baseline
+from elasticsearch_tpu.lint.core import Violation
+from elasticsearch_tpu.lint.registry import build_index
+from elasticsearch_tpu.lint.core import collect_modules
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "lint_fixtures")
+FLAGGING = os.path.join(FIXTURES, "flagging")
+PASSING = os.path.join(FIXTURES, "passing")
+REPO = os.path.dirname(HERE)
+
+_HEADER_RE = re.compile(
+    r"#\s*lint-fixture:\s*(flags|passes)=([A-Z0-9\-,]+)")
+_EXPECT_RE = re.compile(r"#\s*lint-expect:\s*(ESTPU-[A-Z]+\d+)")
+
+
+def _fixture_files(root):
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        for f in sorted(files):
+            if f.endswith(".py"):
+                out.append(os.path.join(dirpath, f))
+    return sorted(out)
+
+
+def _header(path):
+    """(kind, {rule ids}) from the mandatory first-line header."""
+    with open(path, encoding="utf-8") as fh:
+        first = fh.readline()
+    m = _HEADER_RE.search(first)
+    assert m, f"{path}: missing '# lint-fixture: flags=/passes=' header"
+    return m.group(1), set(m.group(2).split(","))
+
+
+def _expect_markers(path):
+    """[(line, rule)] for every '# lint-expect: RULE' marker."""
+    out = []
+    with open(path, encoding="utf-8") as fh:
+        for i, line in enumerate(fh, start=1):
+            for m in _EXPECT_RE.finditer(line):
+                out.append((i, m.group(1)))
+    return out
+
+
+def _rel(path, root):
+    return os.path.relpath(path, root).replace(os.sep, "/")
+
+
+# ------------------------------------------------------ the tier-1 gate
+
+def test_package_clean_under_committed_baseline():
+    """The whole engine lints clean: zero live violations, no stale
+    baseline entries, no parse errors. This is the CI contract — a new
+    finding fails tier-1 until fixed, pragma'd with a reason, or
+    (cold paths only) baselined."""
+    report = run_lint()
+    assert report.parse_errors == [], report.parse_errors
+    assert report.stale_baseline == [], (
+        "baseline entries no longer match any finding — shrink "
+        f"lint_baseline.json: {report.stale_baseline}")
+    assert report.violations == [], "\n".join(
+        v.render() for v in report.violations)
+    assert report.summary()["ok"]
+
+
+def test_committed_baseline_is_cold_path_only():
+    """The suppression ledger may only carry cold-path (xpack/)
+    findings — hot-path violations must be fixed, not baselined."""
+    with open(os.path.join(REPO, "lint_baseline.json"),
+              encoding="utf-8") as fh:
+        entries = json.load(fh)["entries"]
+    assert entries, "baseline unexpectedly empty"
+    hot = [e for e in entries if not e["path"].startswith("xpack/")]
+    assert hot == [], f"non-cold-path baseline entries: {hot}"
+
+
+# --------------------------------------------------- fixture contracts
+
+def test_flagging_fixtures_flag_exactly():
+    """Per flagging fixture: every header rule fires, every
+    ``# lint-expect`` marker has a violation at exactly that line, and
+    no rule outside the header fires (no collateral findings)."""
+    report = run_lint(root=FLAGGING, use_baseline=False)
+    assert report.parse_errors == [], report.parse_errors
+    by_rel = {}
+    for v in report.violations:
+        by_rel.setdefault(v.path, []).append(v)
+
+    for path in _fixture_files(FLAGGING):
+        kind, declared = _header(path)
+        assert kind == "flags", f"{path}: flagging fixture must "\
+            f"declare flags=, not {kind}="
+        rel = _rel(path, FLAGGING)
+        got = by_rel.pop(rel, [])
+        fired = {v.rule for v in got}
+        assert fired == declared, (
+            f"{rel}: declared {sorted(declared)}, fired "
+            f"{sorted(fired)}: " + "; ".join(v.render() for v in got))
+        for line, rule in _expect_markers(path):
+            assert any(v.rule == rule and v.line == line for v in got), (
+                f"{rel}:{line}: expected {rule} at this exact line, "
+                f"got: " + "; ".join(v.render() for v in got))
+    assert by_rel == {}, f"violations outside fixture files: {by_rel}"
+
+
+def test_passing_fixtures_lint_clean():
+    """The passing corpus — each file the minimal contract-respecting
+    twin of a flagging fixture — produces zero findings."""
+    report = run_lint(root=PASSING, use_baseline=False)
+    assert report.parse_errors == [], report.parse_errors
+    assert report.violations == [], "\n".join(
+        v.render() for v in report.violations)
+
+
+def test_every_rule_has_flagging_and_passing_fixture():
+    """Meta-test (ISSUE satellite): each shipped rule ID appears in at
+    least one flags= header AND at least one passes= header, so a new
+    rule cannot ship without both corpus entries."""
+    flagged, passed = set(), set()
+    for path in _fixture_files(FLAGGING):
+        flagged |= _header(path)[1]
+    for path in _fixture_files(PASSING):
+        passed |= _header(path)[1]
+    shipped = set(all_rules())
+    assert shipped - flagged == set(), (
+        f"rules with no flagging fixture: {sorted(shipped - flagged)}")
+    assert shipped - passed == set(), (
+        f"rules with no passing fixture: {sorted(shipped - passed)}")
+    # and no header references a rule that does not exist
+    assert (flagged | passed) - shipped == set(), (
+        f"fixture headers name unknown rules: "
+        f"{sorted((flagged | passed) - shipped)}")
+
+
+# ------------------------------------------------------- CLI semantics
+
+def test_cli_exit_codes_in_process():
+    """0 on a clean tree, 1 on violations — and every flagging fixture
+    individually drives a non-zero exit (the acceptance bar)."""
+    assert lint_main(["--root", PASSING, "--no-baseline"]) == 0
+    assert lint_main(["--root", FLAGGING, "--no-baseline"]) == 1
+    for path in _fixture_files(FLAGGING):
+        assert lint_main(["--root", FLAGGING, "--no-baseline",
+                          path]) == 1, f"{path} did not fail the CLI"
+
+
+def test_cli_subprocess_matches_module_entrypoint():
+    """``python -m elasticsearch_tpu.lint`` is the same analyzer: exit
+    0 over the repo (committed baseline), exit 1 over the flagging
+    corpus."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    clean = subprocess.run(
+        [sys.executable, "-m", "elasticsearch_tpu.lint"],
+        cwd=REPO, env=env, capture_output=True, text=True)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    dirty = subprocess.run(
+        [sys.executable, "-m", "elasticsearch_tpu.lint",
+         "--root", FLAGGING, "--no-baseline"],
+        cwd=REPO, env=env, capture_output=True, text=True)
+    assert dirty.returncode == 1, dirty.stdout + dirty.stderr
+    assert "ESTPU-" in dirty.stdout
+
+
+def test_stale_baseline_exits_two(tmp_path):
+    """A baseline entry matching nothing is a lying ledger: exit 2,
+    worse than a finding (shrink-only suppression)."""
+    stale = tmp_path / "baseline.json"
+    stale.write_text(json.dumps({"entries": [{
+        "rule": "ESTPU-DET01", "path": "cluster/ghost.py",
+        "message": "wall clock long since fixed", "count": 1,
+        "line": 1}]}))
+    rc = lint_main(["--root", PASSING, "--baseline", str(stale)])
+    assert rc == 2
+
+
+def test_baseline_shrink_only_semantics():
+    """found < baselined count -> stale (fail); found > count -> the
+    extras surface as live violations."""
+    v = lambda line: Violation(  # noqa: E731
+        rule="ESTPU-DET01", path="xpack/x.py", line=line, col=0,
+        message="wall clock")
+    baseline = {("ESTPU-DET01", "xpack/x.py", "wall clock"): 2}
+    live, n, stale = apply_baseline([v(1), v(2), v(3)], baseline)
+    assert (len(live), n, stale) == (1, 2, [])
+    live, n, stale = apply_baseline([v(1)], baseline)
+    assert n == 1 and len(stale) == 1 and stale[0]["found"] == 1
+
+
+# --------------------------------------- historical bug shapes, by line
+
+def _lint_tree(tmp_path, rel, source):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(source)
+    return run_lint(root=str(tmp_path), use_baseline=False)
+
+
+def test_pr7_agg_reduce_consumer_leak_shape(tmp_path):
+    """The PR-7 regression, re-typed: AggReduceConsumer charged the
+    breaker per batch but its failure path skipped release — a
+    self-scoped charge in a class with no drain method. ESTPU-PAIR02
+    must flag the charge line."""
+    src = (
+        "class AggReduceConsumer:\n"
+        "    def __init__(self, breaker):\n"
+        "        self.breaker = breaker\n"
+        "        self.held = 0\n"
+        "\n"
+        "    def consume(self, partial_bytes):\n"
+        "        self.breaker.add_estimate_bytes_and_maybe_break(\n"
+        "            partial_bytes, 'agg_reduce')\n"
+        "        self.held += partial_bytes\n"
+        "\n"
+        "    def finish(self):\n"
+        "        return self.held\n")
+    report = _lint_tree(tmp_path, "search/agg_consumer.py", src)
+    hits = [v for v in report.violations if v.rule == "ESTPU-PAIR02"]
+    assert len(hits) == 1, "\n".join(
+        v.render() for v in report.violations)
+    assert hits[0].path == "search/agg_consumer.py"
+    assert hits[0].line == 7  # the add_estimate_bytes... charge line
+
+
+def test_untracked_jit_in_ops_shape(tmp_path):
+    """A bare ``@partial(jax.jit, ...)`` kernel in ops/ dodges the
+    telemetry tracker (no compile accounting, no attribution):
+    ESTPU-JIT01 at the decorator line."""
+    src = (
+        "from functools import partial\n"
+        "import jax\n"
+        "\n"
+        "@partial(jax.jit, static_argnames=('k',))\n"
+        "def fast_topk(scores, k):\n"
+        "    return scores[:k]\n")
+    report = _lint_tree(tmp_path, "ops/fast.py", src)
+    hits = [v for v in report.violations if v.rule == "ESTPU-JIT01"]
+    assert [(h.path, h.line) for h in hits] == [("ops/fast.py", 4)], \
+        "\n".join(v.render() for v in report.violations)
+
+
+def test_wall_clock_in_cluster_shape(tmp_path):
+    """``time.time()`` inside cluster/ without an injected clock seam
+    breaks deterministic replay: ESTPU-DET01 at the call line."""
+    src = (
+        "import time\n"
+        "\n"
+        "def election_deadline(timeout):\n"
+        "    return time.time() + timeout\n")
+    report = _lint_tree(tmp_path, "cluster/elect.py", src)
+    hits = [v for v in report.violations if v.rule == "ESTPU-DET01"]
+    assert [(h.path, h.line) for h in hits] == [("cluster/elect.py", 4)], \
+        "\n".join(v.render() for v in report.violations)
+
+
+# --------------------------- static extraction == runtime discovery pin
+
+def test_static_ops_kernel_extraction_matches_runtime():
+    """Replaces the deleted runtime drift guard: the analyzer's static
+    tracked_jit extraction over ops/ must agree with what pkgutil
+    import-discovery sees, and ESTPU-JIT03's input (the static set)
+    must be fully covered by KERNEL_ATTRIBUTION — so the static check
+    and the serving path cannot drift apart silently."""
+    modules, errs = collect_modules(package_root(), None)
+    assert errs == []
+    index = build_index([m for m in modules
+                         if not m.rel.startswith("lint/")])
+    static = set(index.ops_kernels)
+    assert static, "static scan found no ops/ kernels"
+
+    import importlib
+    import pkgutil
+
+    import elasticsearch_tpu.ops as ops_pkg
+    runtime = set()
+    for info in pkgutil.iter_modules(ops_pkg.__path__):
+        mod = importlib.import_module(
+            f"elasticsearch_tpu.ops.{info.name}")
+        for name, attr in vars(mod).items():
+            kname = getattr(attr, "kernel_name", None)
+            # only count kernels DEFINED here, mirroring the static
+            # view (imported aliases would double-count)
+            if kname is not None and getattr(
+                    attr, "__module__", mod.__name__) == mod.__name__:
+                runtime.add(kname)
+    assert static == runtime, (
+        f"static-only: {sorted(static - runtime)}, "
+        f"runtime-only: {sorted(runtime - static)}")
+
+    from elasticsearch_tpu.search import profile
+    missing = static - set(profile.KERNEL_ATTRIBUTION)
+    assert missing == set(), (
+        f"ops kernels without attribution rows (ESTPU-JIT03 input "
+        f"disagrees with the live table): {sorted(missing)}")

@@ -137,28 +137,11 @@ def test_profile_off_hot_path_allocates_no_profile_objects(
     assert not profile.recording()
 
 
-def test_kernel_attribution_drift_guard():
-    """Every tracked_jit entry point in ops/ has a registered profiler
-    attribution name — a kernel added without profile wiring fails
-    tier-1 (the CI satellite)."""
-    import importlib
-    import pkgutil
-
-    import elasticsearch_tpu.ops as ops_pkg
-    tracked = {}
-    for info in pkgutil.iter_modules(ops_pkg.__path__):
-        mod = importlib.import_module(f"elasticsearch_tpu.ops.{info.name}")
-        for attr in vars(mod).values():
-            name = getattr(attr, "kernel_name", None)
-            if name is not None:
-                tracked[name] = f"ops/{info.name}.py"
-    assert tracked, "no tracked_jit entry points found under ops/"
-    missing = {n: where for n, where in tracked.items()
-               if n not in profile.KERNEL_ATTRIBUTION}
-    assert not missing, (
-        f"tracked_jit kernels without a profiler attribution name in "
-        f"search/profile.py KERNEL_ATTRIBUTION: {missing} — add a row "
-        f"so per-request device attribution stays complete")
+def test_kernel_attribution_stage_names_valid():
+    """Attribution VALUES name real profile stages. (The key-set drift
+    check moved to the static analyzer: ESTPU-JIT03 in
+    elasticsearch_tpu/lint — see tests/test_lint.py, which also pins
+    the static kernel extraction against runtime discovery.)"""
     for name, stage in profile.KERNEL_ATTRIBUTION.items():
         root = stage.split(".", 1)[0]
         assert root in profile.DEVICE_STAGES + profile.HOST_STAGES \
