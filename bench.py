@@ -79,7 +79,7 @@ def remaining_budget() -> float:
 def emit(metric_text: str, value: float, vs_baseline: float,
          engine=None, overload=None, tasks=None, cpu=None,
          serving=None, skipped=None, aggs=None, multichip=None,
-         lint=None):
+         lint=None, recovery=None):
     _LAST_PAYLOAD.clear()
     _LAST_PAYLOAD.update({
         "metric": metric_text,
@@ -141,6 +141,14 @@ def emit(metric_text: str, value: float, vs_baseline: float,
         # (a growing baseline or a live violation) next to the qps it
         # would eventually cost
         _LAST_PAYLOAD["lint"] = lint
+    if recovery:
+        # shard-relocation rider (cluster/data_node.py staged recovery
+        # in the deterministic sim): virtual relocation wall-clock,
+        # bytes moved, phase-2 ops replayed, HBM re-upload stage time,
+        # and search availability during the move — a recovery-path
+        # regression shows here round over round before it ever costs
+        # a production drain
+        _LAST_PAYLOAD["recovery"] = recovery
     print(json.dumps(_LAST_PAYLOAD), flush=True)
 
 
@@ -1449,6 +1457,128 @@ def run_profile_cpu(corpus, queries, n=32):
     }
 
 
+def run_recovery_cpu(n_docs=400, seed=7):
+    """Shard-relocation rider (CPU-side, deterministic sim — no jax):
+    a 3-node sim cluster indexes ``n_docs``, then relocates its primary
+    via `_cluster/reroute` while probe searches keep running. Reports
+    the relocation's VIRTUAL wall-clock (sim seconds are deterministic,
+    so the number is replay-stable round over round), bytes moved, ops
+    replayed in phase 2, the HBM re-upload stage time, and how many
+    searches ran (and failed) during the move — banked into the BENCH
+    json `recovery` section BEFORE any backend touch."""
+    import tempfile
+
+    from elasticsearch_tpu.cluster.node import ClusterNode
+    from elasticsearch_tpu.cluster.state import SHARD_STARTED
+    from elasticsearch_tpu.testing.deterministic import (
+        DeterministicTaskQueue, DisruptableTransport, SimNetwork)
+    from elasticsearch_tpu.transport.transport import DiscoveryNode
+
+    t_host = time.time()
+    with tempfile.TemporaryDirectory() as tmp:
+        queue = DeterministicTaskQueue(seed=seed)
+        network = SimNetwork(queue)
+        nodes = [DiscoveryNode(node_id=f"bn-{i}", name=f"bn{i}")
+                 for i in range(3)]
+        cluster = {}
+        for node in nodes:
+            cluster[node.node_id] = ClusterNode(
+                DisruptableTransport(node, network), queue,
+                data_path=os.path.join(tmp, node.name),
+                seed_nodes=nodes,
+                initial_master_nodes=[n.name for n in nodes],
+                rng=queue.random)
+        for cn in cluster.values():
+            cn.start()
+
+        def call(fn, *args, **kwargs):
+            box = {}
+            fn(*args, **kwargs,
+               on_done=lambda r, e=None: box.update(r=r, e=e))
+            for _ in range(120):
+                if box:
+                    break
+                queue.run_for(1.0)
+            if box.get("e") is not None:
+                raise RuntimeError(box["e"])
+            return box.get("r")
+
+        queue.run_for(60)
+        master = next(cn for cn in cluster.values() if cn.is_master())
+        call(master.create_index, "bench", number_of_shards=1,
+             number_of_replicas=0)
+        queue.run_for(30)
+        call(master.bulk, "bench", [
+            {"op": "index", "id": f"d{i}",
+             "source": {"body": f"bench doc {i} term{i % 37}"}}
+            for i in range(n_docs)])
+        call(master.refresh)
+
+        table = master.state.routing_table.index("bench").shard(0)
+        src = table.primary.current_node_id
+        tgt = next(n.node_id for n in nodes
+                   if n.node_id != src)
+        probes = {"ok": 0, "failed": 0}
+
+        def probe():
+            master.search(
+                "bench", {"query": {"match": {"body": "bench"}},
+                          "size": 0},
+                on_done=lambda r, e=None: probes.__setitem__(
+                    "failed" if e or r["_shards"]["failed"] else "ok",
+                    probes["failed" if e or r["_shards"]["failed"]
+                           else "ok"] + 1))
+
+        def live_write(i):
+            master.bulk("bench", [
+                {"op": "index", "id": f"live{i}-{j}",
+                 "source": {"body": f"live doc {i}-{j}"}}
+                for j in range(4)])
+
+        for i in range(8):
+            queue.schedule(0.2 + i * 0.3, probe, f"probe-{i}")
+            # dense early writes: the relocation's phase 1 runs in the
+            # first ~100ms of virtual time, so these land between the
+            # snapshot and the handoff and exercise phase-2 replay
+            queue.schedule(0.01 + i * 0.02,
+                           lambda _i=i: live_write(_i), f"write-{i}")
+        master.reroute(commands=[{"move": {
+            "index": "bench", "shard": 0,
+            "from_node": src, "to_node": tgt}}])
+        for _ in range(600):
+            queue.run_for(0.1)
+            table = master.state.routing_table.index("bench").shard(0)
+            if [s.state for s in table.shards] == [SHARD_STARTED] \
+                    and table.primary.current_node_id == tgt:
+                break
+        queue.run_for(5.0)
+
+        tgt_dn = cluster[tgt].data_node
+        rec = next(r.to_dict() for r in tgt_dn.recoveries.values()
+                   if r.recovery_type == "relocation")
+        device_ms = None
+        tracer = cluster[tgt].telemetry.tracer
+        for summary in tracer.recent_traces(limit=16):
+            if summary["root"] != "recovery":
+                continue
+            tree = tracer.trace(summary["trace_id"]) or {}
+            for span in tree.get("spans", []):
+                if span.get("name") == "recovery.device":
+                    device_ms = round(span.get("duration_ms", 0.0), 3)
+        return {
+            "relocation_ms": rec["total_time_ms"],
+            "bytes_moved": rec["index_files"]["recovered_bytes"],
+            "translog_ops_replayed": rec["translog"]["ops_replayed"],
+            "hbm_upload_ms": device_ms,
+            "hbm_segments": rec["device"]["hbm_segments"],
+            "hbm_uploaded_bytes": rec["device"]["hbm_uploaded_bytes"],
+            "searches_during_move": probes["ok"] + probes["failed"],
+            "searches_failed": probes["failed"],
+            "stage": rec["stage"],
+            "host_s": round(time.time() - t_host, 1),
+        }
+
+
 # ---------------------------------------------------------------------------
 # Multi-chip serving rows (ISSUE 9): qps at 1/2/4/8 devices for the two
 # mesh serving modes — sharded-corpus (one SPMD fan-out/merge program per
@@ -1841,7 +1971,8 @@ def main():
              skipped=parts.get("skipped"),
              aggs=parts.get("aggs"),
              multichip=parts.get("multichip"),
-             lint=parts.get("lint"))
+             lint=parts.get("lint"),
+             recovery=parts.get("recovery"))
 
     # estpu-lint preflight: static contract scan of the whole package
     # (stdlib ast, ~2s, no device). Summary rides every BENCH line so
@@ -1900,6 +2031,12 @@ def main():
         cpu_rows["profile_host_s"] = round(time.time() - t0, 1)
     except Exception as e:  # noqa: BLE001 — the rider must not sink
         log(f"profile host section failed: {e!r}")
+    # relocation/recovery rows (deterministic sim, no jax): replay-
+    # stable virtual timings for a primary move under search load
+    try:
+        parts["recovery"] = run_recovery_cpu()
+    except Exception as e:  # noqa: BLE001 — the rider must not sink
+        log(f"recovery rider failed: {e!r}")
     # ALL CPU-side rows land before ANY jax/backend touch: a dead
     # relay hangs even backend INIT uninterruptibly (observed: hours),
     # and a run killed there must still have parsed output on record

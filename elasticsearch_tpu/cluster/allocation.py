@@ -16,10 +16,11 @@ from __future__ import annotations
 
 import uuid
 from dataclasses import replace
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from elasticsearch_tpu.cluster.state import (
     SHARD_INITIALIZING,
+    SHARD_RELOCATING,
     SHARD_STARTED,
     SHARD_UNASSIGNED,
     ClusterState,
@@ -29,10 +30,32 @@ from elasticsearch_tpu.cluster.state import (
     RoutingTable,
     ShardRouting,
 )
+from elasticsearch_tpu.common.errors import IllegalArgumentException
 
 DECISION_YES = "YES"
 DECISION_NO = "NO"
 DECISION_THROTTLE = "THROTTLE"
+
+# the node-drain filter: a comma-separated list of node ids (or names)
+# whose shards are evacuated by reroute and which no allocation or
+# relocation may target (ref: cluster.routing.allocation.exclude._id,
+# FilterAllocationDecider cluster-level settings)
+CLUSTER_EXCLUDE_SETTING = "cluster.routing.allocation.exclude._id"
+
+
+def excluded_node_tokens(state: ClusterState) -> Set[str]:
+    raw = state.metadata.persistent_settings.get(CLUSTER_EXCLUDE_SETTING)
+    if not raw:
+        return set()
+    return {t.strip() for t in str(raw).split(",") if t.strip()}
+
+
+def _node_tokens(state: ClusterState, node_id: str) -> Set[str]:
+    node = state.nodes.get(node_id)
+    tokens = {node_id}
+    if node is not None and node.name:
+        tokens.add(node.name)
+    return tokens
 
 
 class AllocationDecider:
@@ -67,6 +90,11 @@ class FilterAllocationDecider(AllocationDecider):
     name = "filter"
 
     def can_allocate(self, shard, node_id, context) -> str:
+        # cluster-level node drain: an excluded node (by id or name) may
+        # receive nothing — reroute evacuates what it already holds
+        excluded = excluded_node_tokens(context.state)
+        if excluded and (_node_tokens(context.state, node_id) & excluded):
+            return DECISION_NO
         imd = context.state.metadata.index(shard.index)
         if imd is None:
             return DECISION_YES
@@ -84,7 +112,9 @@ class FilterAllocationDecider(AllocationDecider):
 
 class ThrottlingAllocationDecider(AllocationDecider):
     """Cap concurrent incoming recoveries per node (ref:
-    ThrottlingAllocationDecider.java, default 2)."""
+    ThrottlingAllocationDecider.java, default 2). Relocation targets
+    are INITIALIZING entries, so in-flight relocations count against
+    the same per-node budget as plain replica recoveries."""
 
     name = "throttling"
 
@@ -169,22 +199,22 @@ class AllocationService:
     def reroute(self, state: ClusterState) -> ClusterState:
         """Assign unassigned shards to allowed nodes, balancing by shard
         count (ref: BalancedShardsAllocator weight function — simplified
-        to total-shards + same-index-shards terms)."""
+        to total-shards + same-index-shards terms), then plan drain
+        relocations off nodes excluded by
+        ``cluster.routing.allocation.exclude._id``."""
         data_nodes = [n.node_id for n in state.nodes.data_nodes()]
         if not data_nodes:
             return state
-        all_shards = state.routing_table.all_shards()
-        assigned = [s for s in all_shards if s.assigned]
-        # drop assignments to nodes that left
+        # drop assignments to nodes that left, unwinding half-finished
+        # relocation pairs along the way
         live = set(n.node_id for n in state.nodes.nodes)
         changed = False
         new_indices: Dict[str, Dict[int, List[ShardRouting]]] = {}
-        for s in all_shards:
-            if s.assigned and s.current_node_id not in live:
-                s = self._failed_copy(s, "node left")
-                changed = True
-            new_indices.setdefault(s.index, {}).setdefault(
-                s.shard_id, []).append(s)
+        for index, irt in state.routing_table.indices.items():
+            for sid, table in irt.shards.items():
+                group, ch = self._normalize_group(list(table.shards), live)
+                changed = changed or ch
+                new_indices.setdefault(index, {})[sid] = group
         assigned = [s for shards in new_indices.values()
                     for group in shards.values() for s in group
                     if s.assigned]
@@ -252,10 +282,113 @@ class AllocationService:
                     ctx.assigned_shards.append(new)
                     counts[node] = counts.get(node, 0) + 1
                     changed = True
+
+        # node drain: evacuate STARTED shards off excluded nodes by
+        # planning relocation pairs (throttled by the deciders — a
+        # drain proceeds a few shards at a time, ref: the exclude filter
+        # + ThrottlingAllocationDecider interplay)
+        excluded = excluded_node_tokens(state)
+        if excluded:
+            for index, shards in new_indices.items():
+                for sid, group in shards.items():
+                    for i, s in enumerate(list(group)):
+                        if s.state != SHARD_STARTED:
+                            continue
+                        if not (_node_tokens(state, s.current_node_id)
+                                & excluded):
+                            continue
+                        target = self._choose_node(s, data_nodes, counts,
+                                                   ctx)
+                        if target is None or \
+                                target == s.current_node_id:
+                            continue
+                        tgt = self._start_relocation(group, i, target)
+                        ctx.assigned_shards.append(tgt)
+                        counts[target] = counts.get(target, 0) + 1
+                        changed = True
         if not changed:
             return state
         return state.with_(routing_table=self._rebuild(
             state.routing_table, new_indices))
+
+    # ------------------------------------------------- relocation helpers
+
+    @staticmethod
+    def _start_relocation(group: List[ShardRouting], i: int,
+                          target_node: str) -> ShardRouting:
+        """Flip group[i] STARTED → RELOCATING and append its
+        INITIALIZING target entry. The source stays FIRST in the tuple,
+        so `.primary` keeps resolving to the active relocating copy
+        until the flip (ref: RoutingNodes.relocateShard — the pair of
+        ShardRoutings sharing the relocation edge)."""
+        src = group[i]
+        group[i] = replace(src, state=SHARD_RELOCATING,
+                           relocating_node_id=target_node)
+        tgt = ShardRouting(
+            index=src.index, shard_id=src.shard_id, primary=src.primary,
+            state=SHARD_INITIALIZING, current_node_id=target_node,
+            relocating_node_id=src.current_node_id,
+            allocation_id=uuid.uuid4().hex[:16])
+        group.append(tgt)
+        return tgt
+
+    def _normalize_group(self, group: List[ShardRouting],
+                         live: Set[str]
+                         ) -> Tuple[List[ShardRouting], bool]:
+        """Unwind relocation pairs whose nodes left, then unassign any
+        other copy on a dead node. A dead relocation TARGET reverts its
+        source to STARTED; a dead PRIMARY source aborts its target (the
+        target was recovering from it); a dead REPLICA source simply
+        disappears and its target carries on as a plain replica
+        recovery from the primary."""
+        changed = False
+        drop: Set[str] = set()
+        override: Dict[str, ShardRouting] = {}
+        targets = [t for t in group if t.is_relocation_target]
+        for s in group:
+            if not s.relocating:
+                continue
+            tgt = next((t for t in targets
+                        if t.primary == s.primary
+                        and t.relocating_node_id == s.current_node_id),
+                       None)
+            src_alive = s.current_node_id in live
+            tgt_alive = tgt is not None and tgt.current_node_id in live
+            if src_alive and tgt_alive:
+                continue
+            if not src_alive:
+                if s.primary:
+                    if tgt is not None and tgt.allocation_id:
+                        drop.add(tgt.allocation_id)
+                    override[s.allocation_id] = self._failed_copy(
+                        s, "node left")
+                else:
+                    drop.add(s.allocation_id)
+                    if tgt is not None:
+                        override[tgt.allocation_id] = replace(
+                            tgt, relocating_node_id=None)
+            else:
+                # target gone (node left, or pair missing its half):
+                # the source resumes as a plain started copy
+                if tgt is not None and tgt.allocation_id:
+                    drop.add(tgt.allocation_id)
+                override[s.allocation_id] = replace(
+                    s, state=SHARD_STARTED, relocating_node_id=None)
+        out: List[ShardRouting] = []
+        for s in group:
+            if s.allocation_id is not None and s.allocation_id in drop:
+                changed = True
+                continue
+            repl = override.get(s.allocation_id) \
+                if s.allocation_id is not None else None
+            if repl is not None:
+                s = repl
+                changed = True
+            elif s.assigned and s.current_node_id not in live:
+                s = self._failed_copy(s, "node left")
+                changed = True
+            out.append(s)
+        return out, changed
 
     def _choose_node(self, shard: ShardRouting, data_nodes: List[str],
                      counts: Dict[str, int],
@@ -292,6 +425,210 @@ class AllocationService:
                        relocating_node_id=None, allocation_id=None,
                        unassigned_reason=reason)
 
+    # ------------------------------------------------- reroute commands
+
+    def apply_reroute_commands(self, state: ClusterState,
+                               commands: List[Dict[str, Any]],
+                               explain: bool = False,
+                               explanations: Optional[List[Dict]] = None
+                               ) -> ClusterState:
+        """Explicit allocation commands (ref: POST /_cluster/reroute,
+        cluster/routing/allocation/command/*Command.java):
+        ``move``, ``cancel``, ``allocate_replica``. With ``explain``,
+        vetoed commands record their per-decider decisions instead of
+        raising; valid commands mutate the routing table, which the
+        caller publishes (and then re-reroutes, as the reference
+        does)."""
+        new_indices: Dict[str, Dict[int, List[ShardRouting]]] = {}
+        for index, irt in state.routing_table.indices.items():
+            for sid, table in irt.shards.items():
+                new_indices.setdefault(index, {})[sid] = list(table.shards)
+        assigned = [s for shards in new_indices.values()
+                    for group in shards.values() for s in group
+                    if s.assigned]
+        ctx = RoutingAllocation(state, assigned, self.failure_counts)
+        changed = False
+        for cmd in commands:
+            if not isinstance(cmd, dict) or len(cmd) != 1:
+                raise IllegalArgumentException(
+                    f"malformed reroute command {cmd!r}: expected "
+                    "{\"move\"|\"cancel\"|\"allocate_replica\": {...}}")
+            name, args = next(iter(cmd.items()))
+            if name == "move":
+                changed = self._cmd_move(state, new_indices, ctx, args,
+                                         explain, explanations) or changed
+            elif name == "cancel":
+                changed = self._cmd_cancel(state, new_indices, args,
+                                           explanations) or changed
+            elif name == "allocate_replica":
+                changed = self._cmd_allocate_replica(
+                    state, new_indices, ctx, args, explain,
+                    explanations) or changed
+            else:
+                raise IllegalArgumentException(
+                    f"unknown reroute command [{name}]")
+        if not changed:
+            return state
+        return state.with_(routing_table=self._rebuild(
+            state.routing_table, new_indices))
+
+    @staticmethod
+    def _resolve_node(state: ClusterState, token: str) -> Optional[str]:
+        for n in state.nodes.nodes:
+            if token in (n.node_id, n.name):
+                return n.node_id
+        return None
+
+    @staticmethod
+    def _command_group(new_indices, index: str, shard: int
+                       ) -> List[ShardRouting]:
+        group = new_indices.get(index, {}).get(shard)
+        if group is None:
+            raise IllegalArgumentException(
+                f"no such shard [{index}][{shard}]")
+        return group
+
+    def _explain_decisions(self, shard: ShardRouting, node_id: str,
+                           ctx: "RoutingAllocation") -> List[Dict]:
+        return [{"decider": d.name, "node": node_id,
+                 "decision": d.can_allocate(shard, node_id, ctx)}
+                for d in self.deciders]
+
+    def _cmd_move(self, state, new_indices, ctx, args, explain,
+                  explanations) -> bool:
+        index, shard = args["index"], int(args["shard"])
+        from_node = self._resolve_node(state, args["from_node"])
+        to_node = self._resolve_node(state, args["to_node"])
+        if from_node is None or to_node is None:
+            raise IllegalArgumentException(
+                f"move [{index}][{shard}]: unknown node in "
+                f"[{args.get('from_node')}] -> [{args.get('to_node')}]")
+        group = self._command_group(new_indices, index, shard)
+        i = next((i for i, s in enumerate(group)
+                  if s.current_node_id == from_node
+                  and s.state == SHARD_STARTED), None)
+        if i is None:
+            raise IllegalArgumentException(
+                f"move [{index}][{shard}]: no started copy on "
+                f"[{args['from_node']}] (relocation already running, "
+                "or the copy lives elsewhere)")
+        decisions = self._explain_decisions(group[i], to_node, ctx)
+        verdicts = {d["decision"] for d in decisions}
+        entry = {"command": "move", "parameters": dict(args),
+                 "decisions": decisions}
+        if DECISION_NO in verdicts or DECISION_THROTTLE in verdicts:
+            entry["accepted"] = False
+            if explanations is not None:
+                explanations.append(entry)
+            if explain:
+                return False
+            raise IllegalArgumentException(
+                f"move [{index}][{shard}] to [{args['to_node']}] "
+                f"vetoed: {decisions}")
+        tgt = self._start_relocation(group, i, to_node)
+        ctx.assigned_shards.append(tgt)
+        entry["accepted"] = True
+        if explanations is not None:
+            explanations.append(entry)
+        return True
+
+    def _cmd_cancel(self, state, new_indices, args, explanations) -> bool:
+        index, shard = args["index"], int(args["shard"])
+        node = self._resolve_node(state, args["node"])
+        if node is None:
+            raise IllegalArgumentException(
+                f"cancel [{index}][{shard}]: unknown node "
+                f"[{args.get('node')}]")
+        group = self._command_group(new_indices, index, shard)
+        entry = {"command": "cancel", "parameters": dict(args),
+                 "accepted": True}
+        for i, s in enumerate(group):
+            if s.current_node_id != node:
+                continue
+            if s.is_relocation_target:
+                # abort the incoming half; its source resumes
+                for j, other in enumerate(group):
+                    if other is not None and other.relocating \
+                            and other.primary == s.primary \
+                            and other.current_node_id == \
+                            s.relocating_node_id:
+                        group[j] = replace(other, state=SHARD_STARTED,
+                                           relocating_node_id=None)
+                group.pop(i)
+                if explanations is not None:
+                    explanations.append(entry)
+                return True
+            if s.relocating:
+                # cancel by source: drop the target, revert the source
+                for j in range(len(group) - 1, -1, -1):
+                    other = group[j]
+                    if other.is_relocation_target \
+                            and other.primary == s.primary \
+                            and other.relocating_node_id == \
+                            s.current_node_id:
+                        group.pop(j)
+                group[group.index(s)] = replace(
+                    s, state=SHARD_STARTED, relocating_node_id=None)
+                if explanations is not None:
+                    explanations.append(entry)
+                return True
+            if s.state == SHARD_INITIALIZING and not s.primary:
+                group[i] = self._failed_copy(s, "cancelled by reroute")
+                if explanations is not None:
+                    explanations.append(entry)
+                return True
+            if s.primary and not bool(args.get("allow_primary")):
+                raise IllegalArgumentException(
+                    f"cancel [{index}][{shard}]: copy on "
+                    f"[{args['node']}] is a started primary; pass "
+                    "allow_primary to cancel it")
+        raise IllegalArgumentException(
+            f"cancel [{index}][{shard}]: no cancellable copy on "
+            f"[{args['node']}]")
+
+    def _cmd_allocate_replica(self, state, new_indices, ctx, args,
+                              explain, explanations) -> bool:
+        index, shard = args["index"], int(args["shard"])
+        node = self._resolve_node(state, args["node"])
+        if node is None:
+            raise IllegalArgumentException(
+                f"allocate_replica [{index}][{shard}]: unknown node "
+                f"[{args.get('node')}]")
+        group = self._command_group(new_indices, index, shard)
+        if not any(s.primary and s.active for s in group):
+            raise IllegalArgumentException(
+                f"allocate_replica [{index}][{shard}]: primary is not "
+                "active")
+        i = next((i for i, s in enumerate(group)
+                  if not s.primary and s.state == SHARD_UNASSIGNED), None)
+        if i is None:
+            raise IllegalArgumentException(
+                f"allocate_replica [{index}][{shard}]: no unassigned "
+                "replica copies")
+        decisions = self._explain_decisions(group[i], node, ctx)
+        verdicts = {d["decision"] for d in decisions}
+        entry = {"command": "allocate_replica",
+                 "parameters": dict(args), "decisions": decisions}
+        if DECISION_NO in verdicts or DECISION_THROTTLE in verdicts:
+            entry["accepted"] = False
+            if explanations is not None:
+                explanations.append(entry)
+            if explain:
+                return False
+            raise IllegalArgumentException(
+                f"allocate_replica [{index}][{shard}] on "
+                f"[{args['node']}] vetoed: {decisions}")
+        new = replace(group[i], state=SHARD_INITIALIZING,
+                      current_node_id=node,
+                      allocation_id=uuid.uuid4().hex[:16],
+                      unassigned_reason=None)
+        group[i] = new
+        ctx.assigned_shards.append(new)
+        entry["accepted"] = True
+        if explanations is not None:
+            explanations.append(entry)
+        return True
+
     # ----------------------------------------------- lifecycle transitions
 
     def apply_started_shards(self, state: ClusterState,
@@ -299,31 +636,58 @@ class AllocationService:
                              ) -> ClusterState:
         """(index, shard_id, allocation_id) initializing → started; adds
         the allocation id to the in-sync set (ref:
-        IndexMetadataUpdater.applyChanges)."""
+        IndexMetadataUpdater.applyChanges). A started relocation TARGET
+        completes the move: the RELOCATING source entry is removed and
+        its allocation id leaves the in-sync set (the target's data is
+        its continuation)."""
         started_set = set(started)
         changed = False
         new_tables: Dict[str, IndexRoutingTable] = {}
         metadata = state.metadata
+
+        def _in_sync_edit(index, sid, add=None, remove=None):
+            nonlocal metadata
+            imd = metadata.index(index)
+            if imd is None:
+                return
+            ins = dict(imd.in_sync_allocations)
+            cur = list(ins.get(sid, []))
+            if add is not None and add not in cur:
+                cur.append(add)
+            if remove is not None:
+                cur = [a for a in cur if a != remove]
+            ins[sid] = cur
+            metadata = metadata.with_index(
+                replace(imd, in_sync_allocations=ins))
+
         for index, irt in state.routing_table.indices.items():
             new_shards = {}
             for sid, table in irt.shards.items():
-                group = []
-                for s in table.shards:
+                group: List[Optional[ShardRouting]] = list(table.shards)
+                for i, s in enumerate(group):
                     if ((s.index, s.shard_id, s.allocation_id)
-                            in started_set
-                            and s.state == SHARD_INITIALIZING):
-                        s = replace(s, state=SHARD_STARTED)
-                        changed = True
-                        imd = metadata.index(index)
-                        if imd is not None:
-                            ins = dict(imd.in_sync_allocations)
-                            cur = list(ins.get(sid, []))
-                            if s.allocation_id not in cur:
-                                cur.append(s.allocation_id)
-                            ins[sid] = cur
-                            metadata = metadata.with_index(
-                                replace(imd, in_sync_allocations=ins))
-                    group.append(s)
+                            not in started_set
+                            or s.state != SHARD_INITIALIZING):
+                        continue
+                    was_target = s.is_relocation_target
+                    source_node = s.relocating_node_id
+                    group[i] = replace(s, state=SHARD_STARTED,
+                                       relocating_node_id=None)
+                    changed = True
+                    _in_sync_edit(index, sid, add=s.allocation_id)
+                    if was_target:
+                        for j, other in enumerate(group):
+                            if j != i and other is not None \
+                                    and other.relocating \
+                                    and other.primary == s.primary \
+                                    and other.current_node_id == \
+                                    source_node:
+                                _in_sync_edit(
+                                    index, sid,
+                                    remove=other.allocation_id)
+                                group[j] = None
+                                break
+                group = [g for g in group if g is not None]
                 new_shards[sid] = IndexShardRoutingTable(index, sid,
                                                          tuple(group))
             new_tables[index] = IndexRoutingTable(index, new_shards)
@@ -342,40 +706,85 @@ class AllocationService:
                             ) -> ClusterState:
         """(index, shard_id, allocation_id, reason) → unassigned; removes
         from the in-sync set (mark-stale, ref:
-        ReplicationOperation.failShardIfNeeded → ShardStateAction)."""
+        ReplicationOperation.failShardIfNeeded → ShardStateAction).
+        Relocation halves unwind rather than unassign: a failed TARGET
+        disappears and its source resumes serving; a failed RELOCATING
+        source aborts a primary move (the target was copying from it)
+        while a replica target survives as a plain recovery from the
+        primary."""
         failed_ids = {(i, s, a) for i, s, a, _r in failed}
         reasons = {(i, s, a): r for i, s, a, r in failed}
         changed = False
         new_tables: Dict[str, IndexRoutingTable] = {}
         metadata = state.metadata
+
+        def _mark_stale(index, sid, allocation_id):
+            nonlocal metadata
+            imd = metadata.index(index)
+            if imd is None or not allocation_id:
+                return
+            ins = dict(imd.in_sync_allocations)
+            ins[sid] = [a for a in ins.get(sid, []) if a != allocation_id]
+            metadata = metadata.with_index(
+                replace(imd, in_sync_allocations=ins))
+
         for index, irt in state.routing_table.indices.items():
             new_shards = {}
             for sid, table in irt.shards.items():
-                group = []
-                for s in table.shards:
+                group: List[Optional[ShardRouting]] = list(table.shards)
+                for i, s in enumerate(group):
+                    if s is None:
+                        continue
                     key = (s.index, s.shard_id, s.allocation_id)
-                    if key in failed_ids and s.assigned:
-                        self.failure_counts[
-                            (s.index, s.shard_id, s.primary)] = \
-                            self.failure_counts.get(
-                                (s.index, s.shard_id, s.primary), 0) + 1
-                        # mark REPLICAS stale (out of the in-sync set);
-                        # a failed primary's id must stay in-sync — its
-                        # data still counts, and wiping it would let
-                        # reroute allocate a fresh empty primary over
-                        # acknowledged writes
-                        imd = metadata.index(index)
-                        if imd is not None and s.allocation_id \
-                                and not s.primary:
-                            ins = dict(imd.in_sync_allocations)
-                            cur = [a for a in ins.get(sid, [])
-                                   if a != s.allocation_id]
-                            ins[sid] = cur
-                            metadata = metadata.with_index(
-                                replace(imd, in_sync_allocations=ins))
-                        s = self._failed_copy(s, reasons[key])
-                        changed = True
-                    group.append(s)
+                    if key not in failed_ids or not s.assigned:
+                        continue
+                    self.failure_counts[
+                        (s.index, s.shard_id, s.primary)] = \
+                        self.failure_counts.get(
+                            (s.index, s.shard_id, s.primary), 0) + 1
+                    changed = True
+                    if s.is_relocation_target:
+                        # abort the incoming half; the source resumes
+                        for j, other in enumerate(group):
+                            if other is not None and other.relocating \
+                                    and other.primary == s.primary \
+                                    and other.current_node_id == \
+                                    s.relocating_node_id:
+                                group[j] = replace(
+                                    other, state=SHARD_STARTED,
+                                    relocating_node_id=None)
+                        group[i] = None
+                        continue
+                    if s.relocating:
+                        for j, other in enumerate(group):
+                            if other is not None \
+                                    and other.is_relocation_target \
+                                    and other.primary == s.primary \
+                                    and other.relocating_node_id == \
+                                    s.current_node_id:
+                                if s.primary:
+                                    group[j] = None
+                                else:
+                                    group[j] = replace(
+                                        other, relocating_node_id=None)
+                        if s.primary:
+                            # a failed primary's id must stay in-sync —
+                            # its data still counts, and wiping it would
+                            # let reroute allocate a fresh empty primary
+                            # over acknowledged writes
+                            group[i] = self._failed_copy(s, reasons[key])
+                        else:
+                            # the target is this replica's replacement:
+                            # dropping the entry keeps the copy count
+                            _mark_stale(index, sid, s.allocation_id)
+                            group[i] = None
+                        continue
+                    # mark REPLICAS stale (out of the in-sync set);
+                    # primaries keep their id in-sync (see above)
+                    if not s.primary:
+                        _mark_stale(index, sid, s.allocation_id)
+                    group[i] = self._failed_copy(s, reasons[key])
+                group = [g for g in group if g is not None]
                 new_shards[sid] = IndexShardRoutingTable(index, sid,
                                                          tuple(group))
             new_tables[index] = IndexRoutingTable(index, new_shards)

@@ -14,11 +14,21 @@ Three reference subsystems, recast for this runtime:
   global checkpoint piggybacked, mark misbehaving copies stale via the
   master.
 - **Peer recovery** (ref: indices/recovery/RecoverySourceHandler
-  .java:107,149,277-306): target-initiated; phase1 = segment file copy
-  (the TPU segment format's immutable files), phase2 = translog ops
-  replay up to the source's max seqno; finalize marks the copy in-sync.
-  Files ride one RPC at test scale — the chunked `MultiChunkTransfer`
-  equivalent belongs to the C++ host runtime.
+  .java:107,149,277-306): target-initiated and staged. The source takes
+  a retention lease pinning post-commit history, snapshots the commit
+  (phase 1: segment file copy — the TPU segment format's immutable
+  files), and starts tracking the target so live writes replicate to it
+  while it recovers. The target then pulls seqno-addressed translog
+  batches until its checkpoint reaches the source's max seqno (phase 2),
+  re-uploads its device segments to HBM through the `hbm` breaker, and
+  finalizes: a primary relocation briefly drains the source's in-flight
+  writes (the handoff barrier, ref: IndexShard.relocated +
+  ShardNotInPrimaryModeException) and ships the in-sync checkpoint map
+  so the target activates its own ReplicationTracker with
+  global-checkpoint continuity. A version-1 wire peer negotiates down to
+  the legacy single-RPC snapshot+ops protocol. Files ride one RPC at
+  test scale — the chunked `MultiChunkTransfer` equivalent belongs to
+  the C++ host runtime.
 """
 
 from __future__ import annotations
@@ -36,7 +46,10 @@ from elasticsearch_tpu.cluster.state import (
     ShardRouting,
 )
 from elasticsearch_tpu.common.errors import (
+    CircuitBreakingException,
     EsRejectedExecutionException,
+    NoShardAvailableActionException,
+    ShardNotInPrimaryModeException,
     is_backpressure_failure,
 )
 from elasticsearch_tpu.index.engine import Engine
@@ -59,10 +72,27 @@ from elasticsearch_tpu.utils.breaker import CircuitBreaker
 SHARD_BULK_PRIMARY = "indices:data/write/bulk[s][p]"
 SHARD_BULK_REPLICA = "indices:data/write/bulk[s][r]"
 START_RECOVERY = "internal:index/shard/recovery/start_recovery"
+RECOVERY_TRANSLOG_OPS = "internal:index/shard/recovery/translog_ops"
+RECOVERY_ABORT = "internal:index/shard/recovery/abort"
 FINALIZE_RECOVERY = "internal:index/shard/recovery/finalize"
 SHARD_STARTED_ACTION = "internal:cluster/shard_state/started"
 SHARD_FAILED_ACTION = "internal:cluster/shard_state/failed"
 GLOBAL_CKP_SYNC = "internal:index/shard/global_checkpoint_sync"
+
+# wire version that understands the staged recovery protocol; older
+# peers negotiate down to the legacy single-RPC snapshot+ops form
+STAGED_RECOVERY_VERSION = 2
+# phase-2 replay runs in bounded batches so the cancel poll fires
+# between batches and each batch admits through replica-stage indexing
+# pressure (a rejection backs the batch off — recovery sheds load to
+# live writes rather than the reverse)
+RECOVERY_OPS_BATCH = 256
+RECOVERY_REPLAY_BACKOFF = 0.5
+RECOVERY_MAX_REPLAY_ROUNDS = 200
+# primary-handoff barrier: poll cadence + bound for draining the
+# source's in-flight replicated writes before the checkpoint ships
+RECOVERY_HANDOFF_POLL = 0.05
+RECOVERY_HANDOFF_TIMEOUT = 10.0
 
 # replica-write backpressure retry (ref: a replica 429 is NOT a stale
 # copy — ReplicationOperation only fails genuinely broken copies; the
@@ -85,10 +115,97 @@ class LocalShard:
     tracker: Optional[ReplicationTracker] = None  # primary only
     state: str = "recovering"      # recovering | started
     global_checkpoint: int = -1    # replica's view (piggybacked)
+    # primary-relocation handoff barrier: while set, new writes are
+    # rejected with the retryable ShardNotInPrimaryModeException and
+    # FINALIZE waits for in_flight_ops to drain
+    handoff_in_progress: bool = False
+    in_flight_ops: int = 0
 
     @property
     def key(self) -> Tuple[str, int]:
         return (self.index, self.shard_id)
+
+
+# recovery stages, in order (failed/cancelled are terminal side-exits)
+RECOVERY_STAGES = ("init", "index", "translog", "device", "finalize",
+                   "done", "failed", "cancelled")
+
+
+@dataclass
+class RecoveryState:
+    """Live progress of one shard recovery on the TARGET node — the
+    object `GET /{index}/_recovery` and `_cat/recovery` serialize (ref:
+    indices/recovery/RecoveryState.java)."""
+
+    index: str
+    shard_id: int
+    allocation_id: str
+    source_node: str
+    target_node: str
+    recovery_type: str            # peer | relocation | local_store
+    protocol: int = STAGED_RECOVERY_VERSION
+    stage: str = "init"
+    total_bytes: int = 0
+    recovered_bytes: int = 0
+    translog_ops_replayed: int = 0
+    hbm_uploaded_bytes: int = 0
+    hbm_segments: int = 0
+    hbm_skipped_segments: int = 0
+    start_time: float = 0.0
+    stop_time: Optional[float] = None
+    task_id: Optional[int] = None
+    failure: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "index": self.index,
+            "shard_id": self.shard_id,
+            "allocation_id": self.allocation_id,
+            "type": self.recovery_type,
+            "protocol": self.protocol,
+            "stage": self.stage.upper(),
+            "source_node": self.source_node,
+            "target_node": self.target_node,
+            "index_files": {
+                "total_bytes": self.total_bytes,
+                "recovered_bytes": self.recovered_bytes,
+            },
+            "translog": {"ops_replayed": self.translog_ops_replayed},
+            "device": {
+                "hbm_uploaded_bytes": self.hbm_uploaded_bytes,
+                "hbm_segments": self.hbm_segments,
+                "hbm_skipped_segments": self.hbm_skipped_segments,
+            },
+            "start_time": self.start_time,
+            "stop_time": self.stop_time,
+            "total_time_ms": (None if self.stop_time is None else
+                              round((self.stop_time - self.start_time)
+                                    * 1000.0, 3)),
+            "task_id": self.task_id,
+            "failure": self.failure,
+        }
+
+
+@dataclass
+class _RecoveryContext:
+    """Target-side in-flight recovery (not serialized): the shard being
+    recovered plus its task/span handles and replay bookkeeping."""
+
+    shard: LocalShard
+    routing: ShardRouting
+    source_node: DiscoveryNode
+    rec: RecoveryState
+    protocol: int
+    task: Any = None
+    tracer: Any = None
+    span: Any = None
+    stage_span: Any = None
+    max_seq_no: int = -1
+    replay_rounds: int = 0
+
+    @property
+    def key(self) -> Tuple[str, int, str]:
+        return (self.rec.index, self.rec.shard_id, self.rec.allocation_id)
 
 
 class DataNodeService:
@@ -122,6 +239,15 @@ class DataNodeService:
         # backpressure (observability: these lag, they are not stale)
         self.replica_backpressure_gave_up = 0
         self.shards: Dict[Tuple[str, int], LocalShard] = {}
+        # recovery observability + lifecycle: per-copy RecoveryState
+        # (kept after completion for /_recovery), live target-side
+        # contexts, and source-side lease registrations — all keyed
+        # (index, shard_id, target_allocation_id)
+        self.recoveries: Dict[Tuple[str, int, str], RecoveryState] = {}
+        self._recovery_ctx: Dict[Tuple[str, int, str],
+                                 _RecoveryContext] = {}
+        self._recovery_sources: Dict[Tuple[str, int, str],
+                                     Dict[str, Any]] = {}
         self.applied_state: ClusterState = ClusterState()
         os.makedirs(data_path, exist_ok=True)
         for action, handler, can_trip in [
@@ -132,6 +258,8 @@ class DataNodeService:
             # sicker (ref: recovery actions register
             # canTripCircuitBreaker=false)
             (START_RECOVERY, self._on_start_recovery, False),
+            (RECOVERY_TRANSLOG_OPS, self._on_recovery_translog_ops, False),
+            (RECOVERY_ABORT, self._on_recovery_abort, False),
             (FINALIZE_RECOVERY, self._on_finalize_recovery, False),
             (GLOBAL_CKP_SYNC, self._on_global_ckp_sync, False),
         ]:
@@ -168,8 +296,15 @@ class DataNodeService:
             # updateShardState on primary term bump)
             if routing.primary and not local.primary:
                 self._promote_to_primary(state, local, routing)
-            local_routing_started = routing.state == SHARD_STARTED
-            if local_routing_started and local.state == "started" \
+            # a relocation that was cancelled/reverted flips our routing
+            # back to plain STARTED — lift the handoff barrier so the
+            # primary accepts writes again
+            if local.handoff_in_progress and \
+                    routing.state == SHARD_STARTED:
+                local.handoff_in_progress = False
+            # active covers RELOCATING too: a relocating primary keeps
+            # serving writes and must keep its tracker in step
+            if routing.active and local.state == "started" \
                     and local.primary:
                 self._update_tracker_from_state(state, local)
 
@@ -192,25 +327,59 @@ class DataNodeService:
         shard = LocalShard(routing.index, routing.shard_id,
                            routing.allocation_id, routing.primary, engine)
         self.shards[shard.key] = shard
-        if routing.primary:
+        if routing.primary and not routing.is_relocation_target:
             # primary: recover from local store (engine ctor replayed the
             # translog) → in-sync set bootstrap → started
             shard.tracker = ReplicationTracker(
                 routing.allocation_id,
-                engine.tracker.checkpoint)
+                engine.tracker.checkpoint,
+                clock=self.scheduler.now)
             shard.state = "started"
+            now = self.scheduler.now()
+            rec = RecoveryState(
+                routing.index, routing.shard_id, routing.allocation_id,
+                source_node=self.local_node.name,
+                target_node=self.local_node.name,
+                recovery_type="local_store", protocol=0, stage="done",
+                start_time=now, stop_time=now)
+            rec.total_bytes = rec.recovered_bytes = \
+                self._disk_bytes(engine.path)
+            self.recoveries[(routing.index, routing.shard_id,
+                             routing.allocation_id)] = rec
             self._send_shard_started(routing)
         else:
-            # replica: peer recovery from the active primary
+            # replica — or a relocation target, including a PRIMARY
+            # relocation target (its routing carries primary=True but it
+            # must peer-recover from the relocating source, never
+            # bootstrap from its empty local store)
             self._start_peer_recovery(state, shard, routing)
 
     def _remove_shard(self, key: Tuple[str, int]) -> None:
         shard = self.shards.pop(key, None)
         if shard is not None:
+            for rkey in [k for k in self._recovery_ctx
+                         if (k[0], k[1]) == key]:
+                # routing moved on while this copy was still recovering:
+                # tear the recovery down (lease released at the source)
+                # without reporting shard-failed for an unassigned copy
+                self._fail_recovery(self._recovery_ctx[rkey],
+                                    "shard removed from this node",
+                                    stage="cancelled", notify_master=False)
             try:
                 shard.engine.close()
             except Exception:
                 pass
+
+    @staticmethod
+    def _disk_bytes(path: str) -> int:
+        total = 0
+        for root, _dirs, fnames in os.walk(path):
+            for fname in fnames:
+                try:
+                    total += os.path.getsize(os.path.join(root, fname))
+                except OSError:
+                    continue
+        return total
 
     def _promote_to_primary(self, state: ClusterState, shard: LocalShard,
                             routing: ShardRouting) -> None:
@@ -242,6 +411,21 @@ class DataNodeService:
                     shard.allocation_id:
                 if copy.active and copy.allocation_id in in_sync:
                     shard.tracker.init_tracking(copy.allocation_id)
+        # prune copies the routing table no longer knows (failed or
+        # cancelled recoveries): drop their tracking entries and release
+        # any peer-recovery retention lease held for them, so history
+        # retention and the global checkpoint never pin on a ghost
+        current = {c.allocation_id for c in table.shards
+                   if c.allocation_id}
+        for alloc in sorted(shard.tracker.tracked_ids()):
+            if alloc != shard.allocation_id and alloc not in current:
+                shard.tracker.remove_copy(alloc)
+        for rkey in sorted(self._recovery_sources):
+            if rkey[0] != shard.index or rkey[1] != shard.shard_id:
+                continue
+            if rkey[2] not in current:
+                src_ctx = self._recovery_sources.pop(rkey)
+                shard.tracker.remove_retention_lease(src_ctx["lease_id"])
 
     # ------------------------------------------------------- shard state
 
@@ -296,8 +480,20 @@ class DataNodeService:
         the bulk just to charge it); computed locally when absent."""
         shard = self.shards.get((index, shard_id))
         if shard is None or not shard.primary or shard.state != "started":
-            on_done([], f"no started primary for [{index}][{shard_id}] "
-                        f"on {self.local_node.name}")
+            # typed + retryable: the coordinator re-resolves routing —
+            # after a relocation handoff the old node briefly still
+            # receives writes aimed at the departed primary
+            on_done([], NoShardAvailableActionException(
+                f"no started primary for [{index}][{shard_id}] "
+                f"on {self.local_node.name}"))
+            return
+        if shard.handoff_in_progress:
+            # relocation handoff barrier: typed + retryable — the
+            # coordinator re-resolves routing and lands the write on the
+            # new primary once the relocation completes
+            on_done([], ShardNotInPrimaryModeException(
+                f"[{index}][{shard_id}] primary is relocating: "
+                "handoff in progress"))
             return
         # primary-stage indexing pressure: admit the whole shard bulk
         # BEFORE any engine work; the coordinator maps the typed 429
@@ -310,10 +506,14 @@ class DataNodeService:
         except EsRejectedExecutionException as e:
             on_done([], e)
             return
+        # counted while the op (including replication) is in flight —
+        # the relocation handoff barrier drains on this reaching zero
+        shard.in_flight_ops += 1
 
         def done(results_, error_=None, _release=release, _cb=on_done):
             # release-on-completion: primary bytes return when the
             # operation (including replication) has fully completed
+            shard.in_flight_ops -= 1
             _release()
             _cb(results_, error_)
 
@@ -371,10 +571,12 @@ class DataNodeService:
         shard.tracker.update_local_checkpoint(
             shard.allocation_id, shard.engine.tracker.checkpoint)
 
-        # fan out to active in-sync replicas (ref:
-        # ReplicationOperation.performOnReplicas — concurrent, with the
-        # global checkpoint piggybacked)
-        replicas = self._active_replicas(index, shard_id)
+        # fan out to every replication target — active replicas AND
+        # recovering copies the tracker has begun tracking, so a
+        # relocation target's phase-2 gap stays bounded under live
+        # writes (ref: ReplicationOperation.performOnReplicas over the
+        # ReplicationGroup's replication targets)
+        replicas = self._replication_targets(index, shard_id, shard)
         if not replicas or not ops_for_replicas:
             on_done(results, None)
             return
@@ -464,18 +666,31 @@ class DataNodeService:
                                         ResponseHandler(ok, fail),
                                         timeout=30.0)
 
-    def _active_replicas(self, index: str, shard_id: int
-                         ) -> List[Tuple[ShardRouting, DiscoveryNode]]:
+    def _replication_targets(self, index: str, shard_id: int,
+                             shard: LocalShard
+                             ) -> List[Tuple[ShardRouting, DiscoveryNode]]:
         irt = self.applied_state.routing_table.index(index)
         table = irt.shard(shard_id) if irt else None
         if table is None:
             return []
         out = []
         for copy in table.shards:
-            if copy.primary or not copy.active:
+            # self is excluded by allocation id, NOT by the primary
+            # flag: a primary-relocation target carries primary=True in
+            # routing while it is still a recovering copy we replicate to
+            if copy.allocation_id == shard.allocation_id:
                 continue
             node = self.applied_state.nodes.get(copy.current_node_id)
-            if node is not None:
+            if node is None:
+                continue
+            if copy.active and not copy.primary:
+                out.append((copy, node))
+            elif copy.state == SHARD_INITIALIZING and \
+                    shard.tracker is not None and \
+                    shard.tracker.is_tracked(copy.allocation_id):
+                # recovering copy the source has started tracking: live
+                # writes flow to it during phase 1/2 so the translog gap
+                # it must close stays bounded
                 out.append((copy, node))
         return out
 
@@ -560,8 +775,18 @@ class DataNodeService:
 
     # --------------------------------------------------------- recovery
 
+    def recovery_stats(self) -> List[Dict[str, Any]]:
+        """All recoveries this node has run as TARGET (live + finished),
+        in deterministic key order — the `/_recovery` payload."""
+        return [self.recoveries[k].to_dict()
+                for k in sorted(self.recoveries)]
+
     def _start_peer_recovery(self, state: ClusterState, shard: LocalShard,
                              routing: ShardRouting) -> None:
+        """TARGET side entry point: resolve the source (always the
+        active primary — for a primary relocation that is the RELOCATING
+        source copy itself), negotiate the protocol, register the
+        cancellable task + span, and kick off phase 1."""
         irt = state.routing_table.index(routing.index)
         table = irt.shard(routing.shard_id) if irt else None
         primary = table.primary if table else None
@@ -574,20 +799,67 @@ class DataNodeService:
             return
         source_node = state.nodes.get(primary.current_node_id)
         if source_node is None:
+            self.scheduler.schedule(
+                2.0, lambda: self._retry_recovery(shard.key),
+                "retry-recovery")
             return
+        rkey = (routing.index, routing.shard_id, routing.allocation_id)
+        live = self.recoveries.get(rkey)
+        if live is not None and live.stage not in ("done", "failed",
+                                                   "cancelled"):
+            return  # already recovering this copy
+        negotiate = getattr(self.transport, "negotiated_version", None)
+        protocol = STAGED_RECOVERY_VERSION
+        if negotiate is not None and \
+                negotiate(source_node.node_id) < STAGED_RECOVERY_VERSION:
+            protocol = 1
+        rec = RecoveryState(
+            routing.index, routing.shard_id, routing.allocation_id,
+            source_node=source_node.name,
+            target_node=self.local_node.name,
+            recovery_type=("relocation" if routing.is_relocation_target
+                           else "peer"),
+            protocol=protocol, start_time=self.scheduler.now())
+        self.recoveries[rkey] = rec
+        task = None
+        if self.task_manager is not None:
+            task = self.task_manager.register(
+                "transport", START_RECOVERY,
+                description=f"recovery [{routing.index}]"
+                            f"[{routing.shard_id}] "
+                            f"{rec.recovery_type} from {source_node.name}",
+                cancellable=True)
+            rec.task_id = task.id
+        telemetry = getattr(self.transport, "telemetry", None)
+        tracer = telemetry.tracer if telemetry is not None else None
+        span = None
+        if tracer is not None:
+            span = tracer.start_span("recovery", tags={
+                "index": routing.index, "shard": routing.shard_id,
+                "type": rec.recovery_type, "protocol": protocol,
+                "source": source_node.name,
+                "target": self.local_node.name})
+        ctx = _RecoveryContext(shard=shard, routing=routing,
+                               source_node=source_node, rec=rec,
+                               protocol=protocol, task=task,
+                               tracer=tracer, span=span)
+        self._recovery_ctx[rkey] = ctx
+        self._enter_stage(ctx, "index")
 
         def ok(resp):
-            self._install_recovery(shard, routing, source_node, resp)
+            if resp.get("protocol", 1) >= STAGED_RECOVERY_VERSION:
+                self._recovery_phase1(ctx, resp)
+            else:
+                self._recovery_legacy_install(ctx, resp)
 
         def fail(exc):
-            self.send_shard_failed(routing.index, routing.shard_id,
-                                   routing.allocation_id,
-                                   f"recovery failed: {exc}")
+            self._fail_recovery(ctx, f"start_recovery failed: {exc}")
 
         self.transport.send_request(
             source_node, START_RECOVERY,
             {"index": routing.index, "shard_id": routing.shard_id,
-             "target_allocation_id": routing.allocation_id},
+             "target_allocation_id": routing.allocation_id,
+             "protocol": protocol},
             ResponseHandler(ok, fail), timeout=120.0)
 
     def _retry_recovery(self, key: Tuple[str, int]) -> None:
@@ -603,100 +875,481 @@ class DataNodeService:
         if routing is not None and routing.state == SHARD_INITIALIZING:
             self._start_peer_recovery(self.applied_state, shard, routing)
 
-    def _on_start_recovery(self, req, channel, src) -> None:
-        """SOURCE side (ref: RecoverySourceHandler.recoverToTarget) —
-        commit, snapshot files + post-commit ops, track the target."""
-        shard = self.shards.get((req["index"], req["shard_id"]))
-        if shard is None or not shard.primary:
-            channel.send_exception(RuntimeError(
-                "recovery source is not the primary"))
-            return
-        engine = shard.engine
-        engine.flush()
-        # phase1: file snapshot (commit point + segment dirs — each
-        # segment is a directory of arrays.npz/stored.bin/meta.json)
-        files: Dict[str, str] = {}
-        commit_path = os.path.join(engine.path, "segments.json")
-        for seg in engine.segments:
-            seg_dir = os.path.join(engine.path, seg.name)
-            if not os.path.isdir(seg_dir):
-                continue
-            for fname in os.listdir(seg_dir):
-                with open(os.path.join(seg_dir, fname), "rb") as fh:
-                    files[f"{seg.name}/{fname}"] = base64.b64encode(
-                        fh.read()).decode("ascii")
-        with open(commit_path, "rb") as fh:
-            commit_blob = base64.b64encode(fh.read()).decode("ascii")
-        # phase2: ops after the commit point
-        import json as _json
-        with open(commit_path) as fh:
-            commit_gen = _json.load(fh)["translog_generation"]
-        ops = [op.to_dict()
-               for op in engine.translog.read_ops(commit_gen)]
-        if shard.tracker is not None:
-            shard.tracker.init_tracking(req["target_allocation_id"])
-        channel.send_response({
-            "files": files,
-            "commit": commit_blob,
-            "ops": ops,
-            "max_seq_no": engine.tracker.max_seq_no,
-            "global_checkpoint": (shard.tracker.global_checkpoint
-                                  if shard.tracker else -1),
-        })
+    # -- target-side stage machine ----------------------------------------
 
-    def _install_recovery(self, shard: LocalShard, routing: ShardRouting,
-                          source_node: DiscoveryNode,
-                          resp: Dict[str, Any]) -> None:
-        """TARGET side: install files, replay ops, finalize."""
+    def _enter_stage(self, ctx: _RecoveryContext, stage: str) -> None:
+        if stage not in ("done", "failed", "cancelled") and \
+                self._recovery_ctx.get(ctx.key) is not ctx:
+            return  # torn down while an RPC was in flight: stay terminal
+        rec = ctx.rec
+        if ctx.stage_span is not None:
+            ctx.stage_span.finish(bytes=rec.recovered_bytes,
+                                  ops=rec.translog_ops_replayed)
+            ctx.stage_span = None
+        rec.stage = stage
+        if ctx.task is not None:
+            ctx.task.profile_stage = f"recovery.{stage}"
+        if ctx.tracer is not None and \
+                stage not in ("done", "failed", "cancelled"):
+            # the context owns the stage span: _enter_stage/_fail/
+            # _finish close it on every exit
+            span = ctx.tracer.start_span(
+                f"recovery.{stage}", parent=ctx.span)
+            ctx.stage_span = span
+
+    def _recovery_cancelled(self, ctx: _RecoveryContext) -> bool:
+        """Cancel poll between stages and replay batches. Past finalize
+        the recovery is no longer cancellable (the source already
+        drained and marked us in sync)."""
+        if self._recovery_ctx.get(ctx.key) is not ctx:
+            # already torn down (routing moved on mid-RPC): the machine
+            # must not advance or open new spans on a dead recovery
+            return True
+        if ctx.task is not None and ctx.task.is_cancelled():
+            self._fail_recovery(
+                ctx, "recovery task cancelled "
+                     f"[{ctx.task.cancellation_reason()}]",
+                stage="cancelled")
+            return True
+        return False
+
+    def _fail_recovery(self, ctx: _RecoveryContext, reason: str,
+                       stage: str = "failed",
+                       notify_master: bool = True) -> None:
+        """Terminal exit for a live recovery: release the source-side
+        lease via RECOVERY_ABORT, close out task/spans, and (unless the
+        copy is already unassigned) report shard-failed so allocation
+        retries elsewhere — never strands the shard mid-RELOCATING."""
+        rkey = ctx.key
+        if self._recovery_ctx.get(rkey) is not ctx:
+            return  # already finished/aborted
+        self._recovery_ctx.pop(rkey, None)
+        rec = ctx.rec
+        rec.stage = stage
+        rec.failure = reason
+        rec.stop_time = self.scheduler.now()
+        if ctx.stage_span is not None:
+            ctx.stage_span.finish(error=reason)
+            ctx.stage_span = None
+        if ctx.span is not None:
+            ctx.span.finish(stage=stage, error=reason)
+        if ctx.task is not None and self.task_manager is not None:
+            self.task_manager.unregister(ctx.task)
+        # best-effort abort to the source: releases the retention lease
+        # and drops the target from tracking promptly (state application
+        # prunes both anyway if this message is lost)
+        self.transport.send_request(
+            ctx.source_node, RECOVERY_ABORT,
+            {"index": rec.index, "shard_id": rec.shard_id,
+             "target_allocation_id": rec.allocation_id},
+            ResponseHandler(lambda r: None, lambda e: None), timeout=30.0)
+        if notify_master:
+            self.send_shard_failed(rec.index, rec.shard_id,
+                                   rec.allocation_id,
+                                   f"recovery {stage}: {reason}")
+
+    def _finish_recovery(self, ctx: _RecoveryContext) -> None:
+        rec = ctx.rec
+        self._enter_stage(ctx, "done")
+        rec.stop_time = self.scheduler.now()
+        if ctx.span is not None:
+            ctx.span.finish(stage="done", bytes=rec.recovered_bytes,
+                            ops=rec.translog_ops_replayed,
+                            hbm_bytes=rec.hbm_uploaded_bytes)
+        if ctx.task is not None and self.task_manager is not None:
+            self.task_manager.unregister(ctx.task)
+        self._recovery_ctx.pop(ctx.key, None)
+        ctx.shard.state = "started"
+        self._send_shard_started(ctx.routing)
+
+    def _install_files(self, ctx: _RecoveryContext,
+                       resp: Dict[str, Any]) -> None:
+        """Swap the target engine for the shipped file snapshot."""
+        shard = ctx.shard
         path = shard.engine.path
         try:
             shard.engine.close()
         except Exception:
             pass
-        for rel, blob in resp["files"].items():
+        nbytes = 0
+        for rel in sorted(resp["files"]):
+            data = base64.b64decode(resp["files"][rel])
+            nbytes += len(data)
             dest = os.path.join(path, rel)
             os.makedirs(os.path.dirname(dest), exist_ok=True)
             with open(dest, "wb") as fh:
-                fh.write(base64.b64decode(blob))
+                fh.write(data)
+        commit = base64.b64decode(resp["commit"])
+        nbytes += len(commit)
         with open(os.path.join(path, "segments.json"), "wb") as fh:
-            fh.write(base64.b64decode(resp["commit"]))
-        imd = self.applied_state.metadata.index(routing.index)
+            fh.write(commit)
+        imd = self.applied_state.metadata.index(ctx.routing.index)
         mapper = MapperService(Settings(imd.settings if imd else {}),
                                (imd.mappings or None) if imd else None)
-        engine = Engine(path, mapper)
-        shard.engine = engine
-        for op_d in resp["ops"]:
-            self._apply_replica_op(engine, {
-                "op": op_d["op_type"], "id": op_d["doc_id"],
-                "source": op_d.get("source"),
-                "seq_no": op_d["seq_no"],
-                "primary_term": op_d["primary_term"]})
+        shard.engine = Engine(path, mapper)
         shard.global_checkpoint = resp.get("global_checkpoint", -1)
+        ctx.max_seq_no = max(ctx.max_seq_no, resp.get("max_seq_no", -1))
+        ctx.rec.total_bytes = resp.get("total_bytes", nbytes)
+        ctx.rec.recovered_bytes = nbytes
 
-        def ok(resp2):
-            shard.state = "started"
-            self._send_shard_started(routing)
+    def _recovery_phase1(self, ctx: _RecoveryContext,
+                         resp: Dict[str, Any]) -> None:
+        if self._recovery_cancelled(ctx):
+            return
+        self._install_files(ctx, resp)
+        self._enter_stage(ctx, "translog")
+        self._recovery_translog_step(ctx)
+
+    def _recovery_translog_step(self, ctx: _RecoveryContext) -> None:
+        """Phase 2: pull the next seqno-addressed batch of ops above our
+        checkpoint (ops that arrived at the source during the copy)."""
+        if self._recovery_cancelled(ctx):
+            return
+
+        def ok(resp):
+            self._recovery_apply_batch(ctx, resp)
 
         def fail(exc):
-            self.send_shard_failed(routing.index, routing.shard_id,
-                                   routing.allocation_id,
-                                   f"finalize failed: {exc}")
+            self._fail_recovery(ctx, f"translog replay failed: {exc}")
 
         self.transport.send_request(
-            source_node, FINALIZE_RECOVERY,
-            {"index": routing.index, "shard_id": routing.shard_id,
-             "target_allocation_id": routing.allocation_id,
-             "local_checkpoint": engine.tracker.checkpoint},
+            ctx.source_node, RECOVERY_TRANSLOG_OPS,
+            {"index": ctx.rec.index, "shard_id": ctx.rec.shard_id,
+             "target_allocation_id": ctx.rec.allocation_id,
+             "from_seq_no": ctx.shard.engine.tracker.checkpoint,
+             "batch": RECOVERY_OPS_BATCH},
             ResponseHandler(ok, fail), timeout=60.0)
 
+    def _recovery_apply_batch(self, ctx: _RecoveryContext,
+                              resp: Dict[str, Any]) -> None:
+        if self._recovery_cancelled(ctx):
+            return
+        shard, rec = ctx.shard, ctx.rec
+        ops = resp.get("ops", [])
+        ctx.max_seq_no = max(ctx.max_seq_no, resp.get("max_seq_no", -1))
+        if ops:
+            batch_bytes = operation_size_bytes(ops)
+            try:
+                release = \
+                    self.indexing_pressure.mark_replica_operation_started(
+                        batch_bytes,
+                        f"[{rec.index}][{rec.shard_id}] recovery replay")
+            except EsRejectedExecutionException:
+                # replay sheds load to live traffic: back off, then
+                # re-request the same batch once pressure drains
+                self.scheduler.schedule(
+                    RECOVERY_REPLAY_BACKOFF,
+                    lambda: self._recovery_translog_step(ctx),
+                    "recovery-replay-backoff")
+                return
+            try:
+                for op_d in ops:
+                    if shard.engine.tracker.contains(op_d["seq_no"]):
+                        continue  # already live-replicated — idempotent
+                    self._apply_replica_op(shard.engine, {
+                        "op": op_d["op"], "id": op_d.get("id"),
+                        "source": op_d.get("source"),
+                        "seq_no": op_d["seq_no"],
+                        "primary_term": op_d["primary_term"]})
+                    rec.translog_ops_replayed += 1
+            finally:
+                release()
+        ctx.replay_rounds += 1
+        gap_open = shard.engine.tracker.checkpoint < ctx.max_seq_no
+        if gap_open and ops and \
+                ctx.replay_rounds < RECOVERY_MAX_REPLAY_ROUNDS:
+            # live writes keep landing at the source — keep chasing; the
+            # finalize barrier closes whatever remains
+            self._recovery_translog_step(ctx)
+            return
+        self._recovery_device_upload(ctx)
+
+    def _recovery_device_upload(self, ctx: _RecoveryContext) -> None:
+        """Device re-residency: rebuild + admit this copy's segments
+        into HBM through the hbm breaker BEFORE the shard flips started,
+        so searches never land on a device-cold copy. A breaker trip
+        (after LRU eviction pressure) skips the segment — it faults in
+        on first search — and is surfaced in the recovery stats."""
+        self._enter_stage(ctx, "device")
+        if self._recovery_cancelled(ctx):
+            return
+        rec = ctx.rec
+        if self.device_cache is not None:
+            for seg in list(ctx.shard.engine.segments):
+                try:
+                    dev = self.device_cache.get(seg)
+                    rec.hbm_uploaded_bytes += dev.hbm_bytes()
+                    rec.hbm_segments += 1
+                except CircuitBreakingException:
+                    rec.hbm_skipped_segments += 1
+        self._recovery_finalize(ctx)
+
+    def _recovery_finalize(self, ctx: _RecoveryContext) -> None:
+        self._enter_stage(ctx, "finalize")
+        if self._recovery_cancelled(ctx):
+            return
+        handoff = bool(ctx.routing.primary) and \
+            ctx.protocol >= STAGED_RECOVERY_VERSION
+
+        def ok(resp):
+            self._recovery_complete(ctx, resp)
+
+        def fail(exc):
+            self._fail_recovery(ctx, f"finalize failed: {exc}")
+
+        self.transport.send_request(
+            ctx.source_node, FINALIZE_RECOVERY,
+            {"index": ctx.rec.index, "shard_id": ctx.rec.shard_id,
+             "target_allocation_id": ctx.rec.allocation_id,
+             "local_checkpoint": ctx.shard.engine.tracker.checkpoint,
+             "protocol": ctx.protocol, "handoff": handoff},
+            ResponseHandler(ok, fail), timeout=60.0)
+
+    def _recovery_complete(self, ctx: _RecoveryContext,
+                           resp: Dict[str, Any]) -> None:
+        """Apply the finalize payload: the post-drain tail of ops, then
+        (for a primary relocation) adopt the source's primary term and
+        activate a tracker seeded from the shipped in-sync checkpoints.
+        Checkpoint continuity is asserted — a copy with seqno holes must
+        never start."""
+        if self._recovery_ctx.get(ctx.key) is not ctx:
+            return  # torn down while finalize was in flight
+        shard, rec = ctx.shard, ctx.rec
+        for op_d in resp.get("final_ops", []):
+            if shard.engine.tracker.contains(op_d["seq_no"]):
+                continue
+            self._apply_replica_op(shard.engine, {
+                "op": op_d["op"], "id": op_d.get("id"),
+                "source": op_d.get("source"), "seq_no": op_d["seq_no"],
+                "primary_term": op_d["primary_term"]})
+            rec.translog_ops_replayed += 1
+        max_seq = resp.get("max_seq_no", -1)
+        local_ckpt = shard.engine.tracker.checkpoint
+        if local_ckpt < max_seq:
+            self._fail_recovery(
+                ctx, f"checkpoint discontinuity after finalize: "
+                     f"local={local_ckpt} source_max_seq_no={max_seq}")
+            return
+        shard.global_checkpoint = max(shard.global_checkpoint,
+                                      resp.get("global_checkpoint", -1))
+        if ctx.routing.primary:
+            # handoff: continue the source's primary term (no bump — the
+            # relocation is a continuation, not a failover) and seed the
+            # in-sync set so the global checkpoint carries over
+            shard.engine.primary_term = resp.get(
+                "primary_term", shard.engine.primary_term)
+            tracker = ReplicationTracker(ctx.routing.allocation_id,
+                                         local_ckpt,
+                                         clock=self.scheduler.now)
+            in_sync = resp.get("in_sync", {})
+            source_alloc = resp.get("source_allocation_id")
+            for alloc in sorted(in_sync):
+                if alloc in (ctx.routing.allocation_id, source_alloc):
+                    continue  # the departing source drops out
+                tracker.mark_in_sync(alloc, in_sync[alloc])
+            shard.tracker = tracker
+        self._finish_recovery(ctx)
+
+    def _recovery_legacy_install(self, ctx: _RecoveryContext,
+                                 resp: Dict[str, Any]) -> None:
+        """Version-1 wire peers: single-RPC snapshot+ops install, then
+        the same device re-residency before the v1 finalize."""
+        if self._recovery_cancelled(ctx):
+            return
+        ctx.protocol = 1
+        ctx.rec.protocol = 1
+        self._install_files(ctx, resp)
+        shard, rec = ctx.shard, ctx.rec
+        self._enter_stage(ctx, "translog")
+        for op_d in resp.get("ops", []):
+            if shard.engine.tracker.contains(op_d["seq_no"]):
+                continue
+            self._apply_replica_op(shard.engine, {
+                "op": op_d["op"], "id": op_d.get("id"),
+                "source": op_d.get("source"), "seq_no": op_d["seq_no"],
+                "primary_term": op_d["primary_term"]})
+            rec.translog_ops_replayed += 1
+        self._recovery_device_upload(ctx)
+
+    # -- source-side handlers ----------------------------------------------
+
+    def _snapshot_files(self, engine: Engine
+                        ) -> Tuple[Dict[str, str], int]:
+        """Phase-1 file snapshot (commit point + segment dirs — each
+        segment is a directory of arrays.npz/stored.bin/meta.json)."""
+        files: Dict[str, str] = {}
+        nbytes = 0
+        for seg in engine.segments:
+            seg_dir = os.path.join(engine.path, seg.name)
+            if not os.path.isdir(seg_dir):
+                continue
+            for fname in sorted(os.listdir(seg_dir)):
+                with open(os.path.join(seg_dir, fname), "rb") as fh:
+                    data = fh.read()
+                nbytes += len(data)
+                files[f"{seg.name}/{fname}"] = base64.b64encode(
+                    data).decode("ascii")
+        return files, nbytes
+
+    def _on_start_recovery(self, req, channel, src) -> None:
+        """SOURCE side (ref: RecoverySourceHandler.recoverToTarget) —
+        commit, take a retention lease pinning post-commit history,
+        snapshot files, and start tracking the target so live writes
+        replicate to it while it recovers. A version-1 request gets the
+        legacy snapshot+ops response instead."""
+        shard = self.shards.get((req["index"], req["shard_id"]))
+        if shard is None or not shard.primary or shard.tracker is None:
+            channel.send_exception(NoShardAvailableActionException(
+                f"recovery source for [{req['index']}][{req['shard_id']}]"
+                " is not an active primary"))
+            return
+        engine = shard.engine
+        engine.flush()
+        files, nbytes = self._snapshot_files(engine)
+        commit_path = os.path.join(engine.path, "segments.json")
+        with open(commit_path, "rb") as fh:
+            commit_raw = fh.read()
+        commit_blob = base64.b64encode(commit_raw).decode("ascii")
+        # total includes the commit point — the target counts it too, so
+        # a finished recovery shows recovered_bytes == total_bytes
+        nbytes += len(commit_raw)
+        target_alloc = req["target_allocation_id"]
+        if req.get("protocol", 1) >= STAGED_RECOVERY_VERSION:
+            # snapshot-under-lease: pin history above the global
+            # checkpoint until the target is in sync; the lease is
+            # released at finalize/abort (or pruned off routing churn)
+            rkey = (req["index"], req["shard_id"], target_alloc)
+            lease_id = f"peer_recovery/{target_alloc}"
+            self._recovery_sources[rkey] = {
+                "lease_id": lease_id,
+                "lease": shard.tracker.add_retention_lease(
+                    lease_id,
+                    max(0, shard.tracker.global_checkpoint + 1),
+                    source="peer recovery"),
+            }
+            shard.tracker.init_tracking(target_alloc)
+            channel.send_response({
+                "protocol": STAGED_RECOVERY_VERSION,
+                "files": files,
+                "commit": commit_blob,
+                "total_bytes": nbytes,
+                "max_seq_no": engine.tracker.max_seq_no,
+                "global_checkpoint": shard.tracker.global_checkpoint,
+            })
+            return
+        # legacy v1: everything in one response, ops from the commit
+        # generation forward
+        import json as _json
+        with open(commit_path) as fh:
+            commit_gen = _json.load(fh)["translog_generation"]
+        ops = sorted((op for op in engine.translog.read_ops(commit_gen)),
+                     key=lambda o: o.seq_no)
+        shard.tracker.init_tracking(target_alloc)
+        channel.send_response({
+            "files": files,
+            "commit": commit_blob,
+            "total_bytes": nbytes,
+            "ops": [op.to_dict() for op in ops],
+            "max_seq_no": engine.tracker.max_seq_no,
+            "global_checkpoint": shard.tracker.global_checkpoint,
+        })
+
+    def _on_recovery_translog_ops(self, req, channel, src) -> None:
+        """SOURCE side phase 2: ship ops above the target's checkpoint,
+        bounded per batch (the lease guarantees they are retained)."""
+        shard = self.shards.get((req["index"], req["shard_id"]))
+        if shard is None or not shard.primary:
+            channel.send_exception(NoShardAvailableActionException(
+                f"recovery source for [{req['index']}][{req['shard_id']}]"
+                " is not an active primary"))
+            return
+        from_seq = req.get("from_seq_no", -1)
+        limit = req.get("batch", RECOVERY_OPS_BATCH)
+        ops = sorted((op for op in shard.engine.translog.read_ops(1)
+                      if op.seq_no > from_seq and op.op_type != "noop"),
+                     key=lambda o: o.seq_no)
+        channel.send_response({
+            "ops": [op.to_dict() for op in ops[:limit]],
+            "max_seq_no": shard.engine.tracker.max_seq_no,
+            "global_checkpoint": (shard.tracker.global_checkpoint
+                                  if shard.tracker else -1),
+        })
+
+    def _on_recovery_abort(self, req, channel, src) -> None:
+        """SOURCE side: the target gave up (failure, cancel, or shard
+        removal) — release the retention lease, drop the target from
+        tracking, and lift any handoff barrier so writes resume."""
+        rkey = (req["index"], req["shard_id"],
+                req["target_allocation_id"])
+        src_ctx = self._recovery_sources.pop(rkey, None)
+        shard = self.shards.get((req["index"], req["shard_id"]))
+        if shard is not None:
+            shard.handoff_in_progress = False
+            if shard.tracker is not None:
+                if src_ctx is not None:
+                    shard.tracker.remove_retention_lease(
+                        src_ctx["lease_id"])
+                shard.tracker.remove_copy(req["target_allocation_id"])
+        channel.send_response({"ok": True})
+
     def _on_finalize_recovery(self, req, channel, src) -> None:
+        """SOURCE side finalize. v1: mark in-sync, done. v2: for a
+        primary handoff first raise the barrier and drain in-flight
+        writes, then ship the op tail above the target's checkpoint plus
+        the in-sync checkpoint map, mark the target in sync, and release
+        the recovery lease."""
         shard = self.shards.get((req["index"], req["shard_id"]))
         if shard is None or shard.tracker is None:
-            channel.send_exception(RuntimeError("not the primary"))
+            channel.send_exception(NoShardAvailableActionException(
+                "finalize target is not the primary"))
             return
-        shard.tracker.mark_in_sync(req["target_allocation_id"],
-                                   req["local_checkpoint"])
-        channel.send_response({"ok": True})
+        if req.get("protocol", 1) < STAGED_RECOVERY_VERSION:
+            shard.tracker.mark_in_sync(req["target_allocation_id"],
+                                       req["local_checkpoint"])
+            channel.send_response({"ok": True})
+            return
+        if req.get("handoff"):
+            shard.handoff_in_progress = True
+            self._finalize_when_drained(
+                shard, req, channel,
+                deadline=self.scheduler.now() + RECOVERY_HANDOFF_TIMEOUT)
+        else:
+            self._finalize_respond(shard, req, channel)
+
+    def _finalize_when_drained(self, shard: LocalShard, req, channel,
+                               deadline: float) -> None:
+        if shard.in_flight_ops > 0 and self.scheduler.now() < deadline:
+            self.scheduler.schedule(
+                RECOVERY_HANDOFF_POLL,
+                lambda: self._finalize_when_drained(shard, req, channel,
+                                                    deadline),
+                "recovery-handoff-drain")
+            return
+        self._finalize_respond(shard, req, channel)
+
+    def _finalize_respond(self, shard: LocalShard, req, channel) -> None:
+        target_alloc = req["target_allocation_id"]
+        target_ckpt = req["local_checkpoint"]
+        # belt and braces: everything above the target's checkpoint
+        # travels with the finalize (idempotent on the target); with the
+        # barrier up nothing new can land after this snapshot
+        final_ops = sorted(
+            (op for op in shard.engine.translog.read_ops(1)
+             if op.seq_no > target_ckpt and op.op_type != "noop"),
+            key=lambda o: o.seq_no)
+        shard.tracker.mark_in_sync(target_alloc, target_ckpt)
+        src_ctx = self._recovery_sources.pop(
+            (shard.index, shard.shard_id, target_alloc), None)
+        if src_ctx is not None:
+            shard.tracker.remove_retention_lease(src_ctx["lease_id"])
+        channel.send_response({
+            "final_ops": [op.to_dict() for op in final_ops],
+            "max_seq_no": shard.engine.tracker.max_seq_no,
+            "global_checkpoint": shard.tracker.global_checkpoint,
+            "primary_term": shard.engine.primary_term,
+            "in_sync": shard.tracker.in_sync_checkpoints(),
+            "source_allocation_id": shard.allocation_id,
+        })
 
     # ---------------------------------------------- global checkpoint sync
 

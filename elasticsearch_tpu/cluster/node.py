@@ -74,6 +74,23 @@ TASKS_LIST_ACTION = "cluster:monitor/tasks/list[n]"
 TASKS_CANCEL_ACTION = "cluster:admin/tasks/cancel[n]"
 TASK_BAN_ACTION = "internal:admin/tasks/ban"
 BULK_ACTION = "indices:data/write/bulk"
+# elasticity: explicit shard movement + persistent-settings updates
+# (node drain rides `cluster.routing.allocation.exclude._id`) and the
+# per-node recovery-progress slice behind `GET /{index}/_recovery`
+CLUSTER_REROUTE_ACTION = "cluster:admin/reroute"
+CLUSTER_SETTINGS_ACTION = "cluster:admin/settings/update"
+RECOVERY_STATS_ACTION = "indices:monitor/recovery[n]"
+
+# coordinator-side bulk retry for TRANSIENT routing failures only (a
+# primary mid-handoff or a routing flip in progress): backpressure 429s
+# are the client's to retry and are never retried here
+BULK_RETRY_BACKOFF_BASE = 0.25
+BULK_RETRY_BACKOFF_CAP = 2.0
+BULK_RETRY_MAX_ATTEMPTS = 12
+BULK_RETRYABLE_TYPES = frozenset({
+    "shard_not_in_primary_mode_exception",
+    "no_shard_available_action_exception",
+})
 
 
 class ClusterNode:
@@ -176,6 +193,9 @@ class ClusterNode:
             (TASKS_LIST_ACTION, self._on_list_tasks),
             (TASKS_CANCEL_ACTION, self._on_cancel_task),
             (TASK_BAN_ACTION, self._on_task_ban),
+            (CLUSTER_REROUTE_ACTION, self._on_cluster_reroute),
+            (CLUSTER_SETTINGS_ACTION, self._on_cluster_settings),
+            (RECOVERY_STATS_ACTION, self._on_recovery_stats),
         ]:
             # master/admin + monitoring actions never trip the inbound
             # breaker: shard-state reporting and stats are exactly what
@@ -277,6 +297,61 @@ class ClusterNode:
             lambda s: delete_index_state(s, req["index"]),
             on_done=lambda err: self._ack(channel, err))
 
+    def _on_cluster_reroute(self, req, channel, src) -> None:
+        """`POST /_cluster/reroute` (ref: TransportClusterRerouteAction):
+        apply explicit move/cancel/allocate_replica commands, then run a
+        full reroute so the resulting relocations/initializations start."""
+        if not self._require_master(channel):
+            return
+        commands = req.get("commands", [])
+        explain = bool(req.get("explain"))
+        dry_run = bool(req.get("dry_run"))
+        explanations: List[Dict[str, Any]] = []
+
+        def fn(s):
+            s2 = self.allocation.apply_reroute_commands(
+                s, commands, explain=explain, explanations=explanations)
+            if dry_run:
+                return s  # validate + explain only, publish nothing
+            return self.allocation.reroute(s2)
+
+        def done(err):
+            if err is not None:
+                self._ack(channel, err)
+                return
+            resp: Dict[str, Any] = {"acknowledged": True}
+            if explain or dry_run:
+                resp["explanations"] = explanations
+            channel.send_response(resp)
+
+        self.coordinator.submit_state_update(
+            f"cluster-reroute[{len(commands)} commands]", fn,
+            on_done=done)
+
+    def _on_cluster_settings(self, req, channel, src) -> None:
+        """`PUT /_cluster/settings` persistent-settings merge; a reroute
+        follows so allocation filters (node drain via
+        `cluster.routing.allocation.exclude._id`) take effect at once."""
+        if not self._require_master(channel):
+            return
+        persistent = req.get("persistent", {})
+
+        def fn(s):
+            from dataclasses import replace as _replace
+            merged = dict(s.metadata.persistent_settings)
+            for k, v in persistent.items():
+                if v is None:
+                    merged.pop(k, None)
+                else:
+                    merged[k] = v
+            s2 = _replace(s, metadata=_replace(
+                s.metadata, persistent_settings=merged))
+            return self.allocation.reroute(s2)
+
+        self.coordinator.submit_state_update(
+            "cluster-update-settings", fn,
+            on_done=lambda err: self._ack(channel, err))
+
     @staticmethod
     def _ack(channel, err) -> None:
         if err is None:
@@ -339,6 +414,53 @@ class ClusterNode:
 
             self.transport.send_request(
                 node, ENGINE_STATS_ACTION, {},
+                ResponseHandler(ok, fail), timeout=30.0)
+
+    # ------------------------------------------------- recovery stats
+
+    def _on_recovery_stats(self, req, channel, src) -> None:
+        channel.send_response(
+            {"recoveries": self.data_node.recovery_stats()})
+
+    def indices_recovery(self, index: Optional[str] = None,
+                         on_done: Callable = lambda r, e: None) -> None:
+        """`GET /{index}/_recovery` over the cluster: fan
+        RECOVERY_STATS_ACTION out to every data node and group the
+        per-copy recovery states by index (ref: the
+        TransportRecoveryAction broadcast). Unreachable nodes are
+        skipped — live progress beats a complete-but-stale answer."""
+        nodes = self.state.nodes.data_nodes()
+        if not nodes:
+            on_done({}, None)
+            return
+        collected: List[Dict[str, Any]] = []
+        pending = {"n": len(nodes)}
+
+        def finish():
+            pending["n"] -= 1
+            if pending["n"] != 0:
+                return
+            by_index: Dict[str, List[Dict[str, Any]]] = {}
+            for rec in collected:
+                if index is not None and rec["index"] != index:
+                    continue
+                by_index.setdefault(rec["index"], []).append(rec)
+            for recs in by_index.values():
+                recs.sort(key=lambda r: (r["shard_id"],
+                                         r["allocation_id"]))
+            on_done({ix: {"shards": recs}
+                     for ix, recs in sorted(by_index.items())}, None)
+
+        for node in nodes:
+            def ok(resp, _n=node):
+                collected.extend(resp.get("recoveries", []))
+                finish()
+
+            def fail(exc, _n=node):
+                finish()
+
+            self.transport.send_request(
+                node, RECOVERY_STATS_ACTION, {},
                 ResponseHandler(ok, fail), timeout=30.0)
 
     # ------------------------------------------------- task management
@@ -594,6 +716,23 @@ class ClusterNode:
                      on_done: Callable = lambda r, e: None) -> None:
         self._to_master(DELETE_INDEX_ACTION, {"index": index}, on_done)
 
+    def reroute(self, commands: Optional[List[Dict[str, Any]]] = None,
+                explain: bool = False, dry_run: bool = False,
+                on_done: Callable = lambda r, e: None) -> None:
+        """`POST /_cluster/reroute` — move/cancel/allocate_replica."""
+        self._to_master(CLUSTER_REROUTE_ACTION,
+                        {"commands": commands or [], "explain": explain,
+                         "dry_run": dry_run}, on_done)
+
+    def update_cluster_settings(self, persistent: Dict[str, Any],
+                                on_done: Callable = lambda r, e: None
+                                ) -> None:
+        """`PUT /_cluster/settings` (persistent only; a None value
+        deletes the key). Setting
+        `cluster.routing.allocation.exclude._id` drains a node."""
+        self._to_master(CLUSTER_SETTINGS_ACTION,
+                        {"persistent": persistent}, on_done)
+
     def bulk(self, index: str, items: List[Dict[str, Any]],
              on_done: Callable = lambda r, e: None) -> None:
         """Coordinator-side bulk (ref: TransportBulkAction.java:172 —
@@ -669,42 +808,66 @@ class ClusterNode:
                 else:
                     on_done({"items": results, "errors": []}, None)
 
-        for sid, shard_items in by_shard.items():
+        def fail_shard(sid, err_obj, status, note):
+            for i in order[sid]:
+                results[i] = {"error": err_obj, "status": status}
+            pending["errors"].append(f"shard {sid}: {note}")
+            shard_done()
+
+        def retry_dispatch(sid, shard_items, attempt, note):
+            if task.is_cancelled():
+                fail_shard(sid, {"type": "task_cancelled_exception",
+                                 "reason": "task cancelled "
+                                 f"[{task.cancellation_reason()}]"},
+                           400, "cancelled")
+                return
+            backoff = min(BULK_RETRY_BACKOFF_BASE * (2 ** (attempt - 1)),
+                          BULK_RETRY_BACKOFF_CAP)
+            self.scheduler.schedule(
+                backoff,
+                lambda: dispatch(sid, shard_items, attempt + 1),
+                f"retry bulk shard [{index}][{sid}]: {note}")
+
+        def dispatch(sid, shard_items, attempt=1):
+            """One shard bulk against the CURRENT primary — routing is
+            re-resolved on every attempt so a retry lands on the new
+            primary after a relocation handoff (the typed 503s in
+            BULK_RETRYABLE_TYPES are transient routing conditions;
+            backpressure 429s stay the client's to retry)."""
+            state_now = self.state
             primary = self.routing.primary_shard(
-                state, ShardId(index, sid))
-            if primary is None:
-                for i in order[sid]:
-                    results[i] = {"error": "no active primary",
-                                  "status": 503}
-                pending["errors"].append(f"shard {sid}: no active primary")
-                shard_done()
-                continue
-            node = state.nodes.get(primary.current_node_id)
-            if node is None:
-                for i in order[sid]:
-                    results[i] = {"error": "primary node left the cluster",
-                                  "status": 503}
-                pending["errors"].append(f"shard {sid}: node left")
-                shard_done()
-                continue
+                state_now, ShardId(index, sid))
+            node = (state_now.nodes.get(primary.current_node_id)
+                    if primary is not None else None)
+            if primary is None or node is None:
+                note = ("no active primary" if primary is None
+                        else "primary node left the cluster")
+                if attempt < BULK_RETRY_MAX_ATTEMPTS:
+                    retry_dispatch(sid, shard_items, attempt, note)
+                    return
+                fail_shard(sid, note, 503, note)
+                return
 
             def ok(resp, _sid=sid):
                 for i, item_result in zip(order[_sid], resp["items"]):
                     results[i] = item_result
                 shard_done()
 
-            def fail(exc, _sid=sid):
+            def fail(exc, _sid=sid, _attempt=attempt,
+                     _items=shard_items):
+                ftype = failure_type_of(exc)
+                if ftype in BULK_RETRYABLE_TYPES and \
+                        _attempt < BULK_RETRY_MAX_ATTEMPTS:
+                    retry_dispatch(_sid, _items, _attempt, ftype)
+                    return
                 # a backpressure rejection surfaces as a retryable 429
                 # per item (the ES contract: retry the bulk after
                 # backoff), not a generic 500
-                ftype = failure_type_of(exc)
-                status = 429 if ftype in BACKPRESSURE_ERROR_TYPES else 500
-                for i in order[_sid]:
-                    results[i] = {"error": {"type": ftype,
-                                            "reason": str(exc)},
-                                  "status": status}
-                pending["errors"].append(f"shard {_sid}: {exc}")
-                shard_done()
+                status = (429 if ftype in BACKPRESSURE_ERROR_TYPES
+                          else 503 if ftype in BULK_RETRYABLE_TYPES
+                          else 500)
+                fail_shard(_sid, {"type": ftype, "reason": str(exc)},
+                           status, str(exc))
 
             from elasticsearch_tpu.telemetry import context as _telectx
             with _telectx.activate_task(self.local_node.node_id, task):
@@ -716,6 +879,9 @@ class ClusterNode:
                      "items": shard_items,
                      "op_bytes": shard_bytes[sid]},
                     ResponseHandler(ok, fail), timeout=60.0)
+
+        for sid, shard_items in by_shard.items():
+            dispatch(sid, shard_items)
 
     def refresh(self, on_done: Callable = lambda r, e: None) -> None:
         """Broadcast refresh to all data nodes (ref: refresh is a

@@ -15,6 +15,7 @@ from typing import Dict, List, Optional
 
 from elasticsearch_tpu.common.errors import IndexNotFoundException
 from elasticsearch_tpu.cluster.state import (
+    SHARD_INITIALIZING,
     ClusterState,
     IndexShardRoutingTable,
     ShardRouting,
@@ -132,10 +133,15 @@ class OperationRouting:
                         preference: Optional[str] = None
                         ) -> List[ShardIterator]:
         """One iterator per shard group with ALL active copies ARS-ranked
-        best-first (ref: OperationRouting.searchShards returning a
-        GroupShardsIterator of rank-ordered ShardIterators). Groups with
-        no active copy yield an EMPTY iterator so the coordinator can
-        report them failed instead of silently dropping the shard."""
+        best-first, then any INITIALIZING copies as last-resort failover
+        picks (ref: IndexShardRoutingTable.activeInitializingShardsRankedIt).
+        The initializing tail is what survives the relocation-flip race:
+        a coordinator holding the pre-flip state sends to the RELOCATING
+        source, the source has already handed off and removed its copy,
+        and the retry walks onto the relocation target — which by
+        RPC-arrival time is started. Groups with no copy at all yield an
+        EMPTY iterator so the coordinator can report them failed instead
+        of silently dropping the shard."""
         irt = state.routing_table.index(index)
         if irt is None:
             return []
@@ -149,6 +155,8 @@ class OperationRouting:
                 ranked = sorted(active, key=lambda s: (
                     self.collector.rank(s.current_node_id or ""),
                     not s.primary))
+            ranked += [s for s in table.shards
+                       if s.state == SHARD_INITIALIZING]
             groups.append(ShardIterator(ShardId(index, shard_num), ranked))
         return groups
 

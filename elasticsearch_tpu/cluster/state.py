@@ -262,6 +262,20 @@ class ShardRouting:
     def assigned(self) -> bool:
         return self.current_node_id is not None
 
+    @property
+    def relocating(self) -> bool:
+        """The outgoing half of a relocation pair: still serving on
+        ``current_node_id``, copying to ``relocating_node_id``."""
+        return self.state == SHARD_RELOCATING
+
+    @property
+    def is_relocation_target(self) -> bool:
+        """The incoming half: INITIALIZING on ``current_node_id``,
+        recovering from the copy on ``relocating_node_id`` (ref:
+        ShardRouting.isRelocationTarget)."""
+        return (self.state == SHARD_INITIALIZING
+                and self.relocating_node_id is not None)
+
     def to_dict(self) -> Dict[str, Any]:
         return {
             "index": self.index, "shard_id": self.shard_id,
@@ -304,6 +318,21 @@ class IndexShardRoutingTable:
 
     def active_shards(self) -> List[ShardRouting]:
         return [s for s in self.shards if s.active]
+
+    def relocation_target_of(self, source: ShardRouting
+                             ) -> Optional["ShardRouting"]:
+        """The INITIALIZING entry paired with a RELOCATING source (the
+        pair shares primary flag; the target points back at the source's
+        node via relocating_node_id)."""
+        if not source.relocating:
+            return None
+        for s in self.shards:
+            if (s.is_relocation_target
+                    and s.primary == source.primary
+                    and s.relocating_node_id == source.current_node_id
+                    and s.current_node_id == source.relocating_node_id):
+                return s
+        return None
 
     def to_dict(self):
         return {"index": self.index, "shard_id": self.shard_id,

@@ -174,6 +174,16 @@ class NoShardAvailableActionException(ElasticsearchTpuException):
     status = 503
 
 
+class ShardNotInPrimaryModeException(ElasticsearchTpuException):
+    """The shard is no longer (or not yet) operating as a primary —
+    raised during the relocation-handoff barrier while in-flight writes
+    drain (ref: index/shard/ShardNotInPrimaryModeException). 503-class:
+    transient by construction, the coordinator re-resolves routing and
+    retries against the new primary."""
+
+    status = 503
+
+
 class ScriptException(ElasticsearchTpuException):
     status = 400
 

@@ -14,7 +14,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Set
+from typing import Callable, Dict, Optional, Set
 
 NO_OPS_PERFORMED = -1
 UNASSIGNED_SEQ_NO = -2
@@ -92,8 +92,13 @@ class ReplicationTracker:
     computeGlobalCheckpoint)."""
 
     def __init__(self, shard_allocation_id: str,
-                 local_checkpoint: int = NO_OPS_PERFORMED):
+                 local_checkpoint: int = NO_OPS_PERFORMED,
+                 clock: Optional[Callable[[], float]] = None):
         self._lock = threading.Lock()
+        # lease timestamps come from an injectable clock so the cluster
+        # runtime can pin them to the scheduler's (virtual) time and
+        # seeded chaos runs replay identically
+        self._clock = clock or time.time
         self.allocation_id = shard_allocation_id
         self._checkpoints: Dict[str, CheckpointState] = {
             shard_allocation_id: CheckpointState(
@@ -147,11 +152,30 @@ class ReplicationTracker:
         with self._lock:
             return {a for a, s in self._checkpoints.items() if s.in_sync}
 
+    def is_tracked(self, allocation_id: str) -> bool:
+        with self._lock:
+            st = self._checkpoints.get(allocation_id)
+            return st is not None and st.tracked
+
+    def tracked_ids(self) -> Set[str]:
+        with self._lock:
+            return {a for a, s in self._checkpoints.items() if s.tracked}
+
+    def in_sync_checkpoints(self) -> Dict[str, int]:
+        """Snapshot of {allocation_id: local_checkpoint} over the in-sync
+        set — the state a primary-relocation handoff ships so the target
+        can seed its own tracker (ref: ReplicationTracker
+        getPrimaryContext / activateWithPrimaryContext)."""
+        with self._lock:
+            return {a: s.local_checkpoint
+                    for a, s in self._checkpoints.items() if s.in_sync}
+
     # -- retention leases (ref: ReplicationTracker.java:511)
     def add_retention_lease(self, lease_id: str, retaining_seq_no: int,
                             source: str) -> RetentionLease:
         with self._lock:
-            lease = RetentionLease(lease_id, retaining_seq_no, time.time(), source)
+            lease = RetentionLease(lease_id, retaining_seq_no,
+                                   self._clock(), source)
             self._leases[lease_id] = lease
             return lease
 
@@ -159,7 +183,7 @@ class ReplicationTracker:
         with self._lock:
             lease = self._leases[lease_id]
             lease.retaining_seq_no = max(lease.retaining_seq_no, retaining_seq_no)
-            lease.timestamp = time.time()
+            lease.timestamp = self._clock()
 
     def remove_retention_lease(self, lease_id: str) -> None:
         with self._lock:

@@ -49,7 +49,12 @@ SPAN = PairSpec(
     release_attrs=("finish", "end", "close"),
     release_on_token=True,
 )
-SPECS = [BREAKER, TASK, SPAN]
+LEASE = PairSpec(
+    name="retention lease",
+    acquire_attrs=("add_retention_lease",),
+    release_attrs=("remove_retention_lease",),
+)
+SPECS = [BREAKER, TASK, SPAN, LEASE]
 
 # drain method shapes for PAIR02 ("finish" intentionally absent)
 _DRAIN_HINTS = ("close", "release", "stop", "shutdown", "clear",

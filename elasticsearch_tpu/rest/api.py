@@ -335,6 +335,12 @@ def _register_all(c: RestController):
     # cluster settings + remote clusters (ref: RemoteClusterService)
     c.register("PUT", "/_cluster/settings", put_cluster_settings)
     c.register("GET", "/_cluster/settings", get_cluster_settings)
+    # allocation commands + recovery progress (ref: RestRerouteAction,
+    # RestRecoveryAction; the multi-node forms live on the cluster
+    # client — this is the single-node surface's honest rendering)
+    c.register("POST", "/_cluster/reroute", cluster_reroute)
+    c.register("GET", "/_recovery", indices_recovery)
+    c.register("GET", "/{index}/_recovery", index_recovery)
     c.register("GET", "/_remote/info", remote_info)
     # watcher (ref: x-pack/plugin/watcher REST layer)
     c.register("PUT", "/_watcher/watch/{id}", watcher_put)
@@ -636,6 +642,10 @@ def nodes_stats(node, params, body):
             "engine": _engine_section(node),
             # live/peak/lifetime task counts (transport/tasks.py)
             "tasks": node.task_manager.stats(),
+            # per-shard recovery states (local-store opens on this
+            # surface; staged peer/relocation recoveries on the
+            # cluster's data nodes) — same shape as GET /_recovery
+            "recoveries": _recovery_entries(node),
         }},
     }
 
@@ -2828,6 +2838,48 @@ def get_cluster_settings(node, params, body):
     return 200, {"persistent": node.persistent_settings, "transient": {}}
 
 
+_REROUTE_COMMANDS = ("move", "cancel", "allocate_replica")
+
+
+def cluster_reroute(node, params, body):
+    """POST /_cluster/reroute — the allocation-command surface. On the
+    single-node REST front there is never another node to move a copy
+    to, so every command validates its shape and explains a NO instead
+    of pretending to relocate (the multi-node path is
+    cluster/node.py reroute → allocation.apply_reroute_commands)."""
+    body = body or {}
+    explanations = []
+    for cmd in body.get("commands", []):
+        if not isinstance(cmd, dict) or len(cmd) != 1:
+            raise IllegalArgumentException(
+                f"malformed reroute command {cmd!r}: expected "
+                "{\"move\"|\"cancel\"|\"allocate_replica\": {...}}")
+        name, args = next(iter(cmd.items()))
+        if name not in _REROUTE_COMMANDS:
+            raise IllegalArgumentException(
+                f"unknown reroute command [{name}]")
+        index = (args or {}).get("index")
+        if index is not None:
+            node.indices_service.get(index)  # 404 on unknown index
+        explanations.append({
+            "command": name, "parameters": dict(args or {}),
+            "accepted": False,
+            "decisions": [{
+                "decider": "same_shard", "node": node.node_id,
+                "decision": "NO",
+                "explanation": "single-node cluster: every copy "
+                               "already lives on the only node",
+            }],
+        })
+    resp = {"acknowledged": True}
+    if explanations and (str(params.get("explain", "false")).lower()
+                         == "true" or
+                         str(params.get("dry_run", "false")).lower()
+                         == "true"):
+        resp["explanations"] = explanations
+    return 200, resp
+
+
 def remote_info(node, params, body):
     return 200, node.remote_cluster_service.info()
 
@@ -3263,13 +3315,95 @@ def cat_segments(node, params, body):
     return 200, {"_cat": "\n".join(lines)}
 
 
-def cat_recovery(node, params, body):
-    lines = []
+def _recovery_entries(node, index=None):
+    """Per-shard recovery states of this single node, in the same shape
+    the cluster's RecoveryState.to_dict emits (cluster/data_node.py).
+    Every local shard here recovered from its own store at open —
+    `local_store`, stage DONE — with honest numbers: bytes actually on
+    disk, ops actually sitting in the translog, segments actually
+    resident in HBM right now."""
+    entries = []
     for name in sorted(node.indices_service.indices):
+        if index is not None and name != index:
+            continue
         idx = node.indices_service.get(name)
-        for si in range(idx.num_shards):
-            lines.append(f"{name} {si} 0ms empty_store done "
-                         f"n/a n/a 127.0.0.1 {node.name}")
+        cache = getattr(idx, "device_cache", None) or \
+            node.indices_service.device_cache
+        resident = getattr(cache, "_cache", {})
+        for si, engine in enumerate(idx.shards):
+            # count ops BEFORE sizing the directory: read_ops syncs the
+            # in-memory translog buffer to disk as a side effect
+            n_ops = len(engine.translog.read_ops(1))
+            nbytes = 0
+            for root, _dirs, fnames in os.walk(engine.path):
+                for fname in fnames:
+                    try:
+                        nbytes += os.path.getsize(
+                            os.path.join(root, fname))
+                    except OSError:
+                        continue
+            hbm_segments = [seg for seg in engine.segments
+                            if seg.name in resident]
+            hbm_bytes = 0
+            for seg in hbm_segments:
+                entry = resident.get(seg.name)
+                if entry is not None:
+                    hbm_bytes += entry[1].hbm_bytes()
+            entries.append({
+                "index": name,
+                "shard_id": si,
+                "allocation_id": None,
+                "type": "local_store",
+                "protocol": 0,
+                "stage": "DONE",
+                "source_node": node.name,
+                "target_node": node.name,
+                "index_files": {"total_bytes": nbytes,
+                                "recovered_bytes": nbytes},
+                "translog": {"ops_replayed": n_ops},
+                "device": {"hbm_uploaded_bytes": hbm_bytes,
+                           "hbm_segments": len(hbm_segments),
+                           "hbm_skipped_segments": 0},
+                "start_time": None,
+                "stop_time": None,
+                "total_time_ms": None,
+                "task_id": None,
+                "failure": None,
+            })
+    return entries
+
+
+def indices_recovery(node, params, body):
+    """GET /_recovery — recovery states grouped by index."""
+    out = {}
+    for rec in _recovery_entries(node):
+        out.setdefault(rec["index"], {"shards": []})["shards"].append(rec)
+    return 200, out
+
+
+def index_recovery(node, params, body, index):
+    """GET /{index}/_recovery."""
+    node.indices_service.get(index)  # 404 on unknown index
+    shards = _recovery_entries(node, index=index)
+    if not shards:
+        return 200, {}
+    return 200, {index: {"shards": shards}}
+
+
+def cat_recovery(node, params, body):
+    """GET /_cat/recovery — one row per shard copy, rendered from the
+    same entries `/_recovery` serves: index shard time type stage
+    source_node target_node bytes ops."""
+    lines = []
+    for rec in _recovery_entries(node):
+        time_ms = rec["total_time_ms"]
+        lines.append(
+            f"{rec['index']} {rec['shard_id']} "
+            f"{0 if time_ms is None else int(time_ms)}ms "
+            f"{rec['type']} {rec['stage'].lower()} "
+            f"{rec['source_node']} {rec['target_node']} "
+            f"{rec['index_files']['recovered_bytes']} "
+            f"{rec['translog']['ops_replayed']}")
     return 200, {"_cat": "\n".join(lines)}
 
 

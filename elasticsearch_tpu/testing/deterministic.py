@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 from elasticsearch_tpu.common.errors import CircuitBreakingException
 from elasticsearch_tpu.telemetry import context as _telectx
 from elasticsearch_tpu.transport.transport import (
+    CURRENT_VERSION,
     DiscoveryNode,
     ResponseHandler,
     TransportChannel,
@@ -240,9 +241,20 @@ class DisruptableTransport:
         # node breaker service: same inbound in_flight_requests seam as
         # the production BaseTransport, so chaos runs exercise shedding
         self.breaker_service = None
+        # wire version this sim node speaks — rolling-upgrade tests pin
+        # one node down a version and the negotiated minimum gates any
+        # protocol feature (same seam as TcpTransport._peer_versions)
+        self.wire_version = CURRENT_VERSION
         self._handlers: Dict[str, Callable] = {}
         self._no_trip: Set[str] = set()
         network.register(self)
+
+    def negotiated_version(self, node_id: str) -> int:
+        """Wire version agreed with a peer: min of both ends (the sim
+        registry stands in for the TCP handshake)."""
+        peer = self.network.transports.get(node_id)
+        peer_version = getattr(peer, "wire_version", CURRENT_VERSION)
+        return min(self.wire_version, peer_version)
 
     # -- TransportService surface ----------------------------------------
 

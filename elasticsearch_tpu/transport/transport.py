@@ -50,7 +50,10 @@ from elasticsearch_tpu.utils.breaker import (
     payload_size_bytes,
 )
 
-CURRENT_VERSION = 1
+# version 2 adds the staged peer-recovery protocol (snapshot-under-lease
+# phase 1, seqno-addressed translog batches, primary-handoff finalize);
+# a version-1 peer still recovers through the single-RPC legacy path
+CURRENT_VERSION = 2
 # oldest wire version this build interoperates with (ref:
 # TransportHandshaker + Version.minimumCompatibilityVersion — a rolling
 # upgrade requires version N and N+1 nodes to form one cluster)

@@ -1,0 +1,15 @@
+# lint-fixture: flags=ESTPU-PAIR01
+"""A peer-recovery source that pins history with a retention lease,
+then snapshots — and the snapshot can raise before the lease is ever
+removed. The lease outlives the failed recovery and the translog can
+never be trimmed below it: the recovery-lease leak shape."""
+
+
+def recover_to_peer(tracker, engine, target_alloc):
+    tracker.add_retention_lease(
+        f"peer_recovery/{target_alloc}",
+        tracker.global_checkpoint + 1, source="peer recovery")
+    files = snapshot_files(engine)  # lint-expect: ESTPU-PAIR01
+    ship(files)
+    tracker.remove_retention_lease(f"peer_recovery/{target_alloc}")
+    return files

@@ -1,0 +1,16 @@
+# lint-fixture: passes=ESTPU-PAIR01
+"""The paired twin of bad_lease.py: the lease is removed in a
+``finally``, so a failed snapshot cannot strand a pinned translog —
+every exit path unpins history."""
+
+
+def recover_to_peer(tracker, engine, target_alloc):
+    lease_id = f"peer_recovery/{target_alloc}"
+    tracker.add_retention_lease(
+        lease_id, tracker.global_checkpoint + 1, source="peer recovery")
+    try:
+        files = snapshot_files(engine)
+        ship(files)
+        return files
+    finally:
+        tracker.remove_retention_lease(lease_id)

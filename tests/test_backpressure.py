@@ -455,11 +455,16 @@ def test_replica_429_retries_and_never_marks_stale(tmp_path, chaos_seed):
     # and a lagging node requests a resend (coordination.py
     # RESEND_STATE_ACTION) — no no-op-index-create nudge needed.
     primary_dn = cluster.cluster_nodes[p_node].data_node
+
+    def replication_targets():
+        return primary_dn._replication_targets(
+            "r", 0, primary_dn.shards[("r", 0)])
+
     for _ in range(5):
-        if primary_dn._active_replicas("r", 0):
+        if replication_targets():
             break
         cluster.run_for(30)
-    assert primary_dn._active_replicas("r", 0), \
+    assert replication_targets(), \
         f"seed={chaos_seed}: primary never saw the started replica"
     resp = cluster.call(master.bulk, "r",
                         [{"op": "index", "id": f"doc-{i}",

@@ -387,3 +387,56 @@ def test_frozen_search_uses_throttled_pool(tmp_path):
             .stats()["completed"] == after
     finally:
         node.close()
+
+
+def test_recovery_api_reports_local_store_shards(node):
+    """GET /_recovery + /{index}/_recovery: every local shard shows a
+    completed local_store recovery with honest on-disk bytes."""
+    _seed(node, n=5)
+    r = call(node, "GET", "/_recovery")
+    assert "idx" in r
+    shards = r["idx"]["shards"]
+    assert len(shards) == 1
+    rec = shards[0]
+    assert rec["type"] == "local_store" and rec["stage"] == "DONE"
+    assert rec["index_files"]["recovered_bytes"] > 0
+    assert rec["index_files"]["recovered_bytes"] == \
+        rec["index_files"]["total_bytes"]
+    assert rec["translog"]["ops_replayed"] >= 0
+    assert rec["source_node"] == rec["target_node"] == node.name
+    # the index-scoped form matches, and unknown indices 404
+    assert call(node, "GET", "/idx/_recovery") == {"idx": r["idx"]}
+    call(node, "GET", "/nope/_recovery", expect=404)
+    # _cat renders one row per shard from the same entries
+    cat = call(node, "GET", "/_cat/recovery")["_cat"]
+    assert "idx 0" in cat and "local_store" in cat and "done" in cat
+    # and the node-stats surface carries the same section
+    stats = call(node, "GET", "/_nodes/stats")
+    (node_stats,) = stats["nodes"].values()
+    assert node_stats["recoveries"] == shards
+
+
+def test_cluster_reroute_single_node_explains_no(node):
+    """POST /_cluster/reroute on the single-node surface: commands
+    validate and explain a NO — there is no second node to move to."""
+    _seed(node)
+    r = call(node, "POST", "/_cluster/reroute", {
+        "commands": [{"move": {"index": "idx", "shard": 0,
+                               "from_node": node.node_id,
+                               "to_node": "other"}}]}, explain="true")
+    assert r["acknowledged"] is True
+    (entry,) = r["explanations"]
+    assert entry["command"] == "move" and entry["accepted"] is False
+    assert entry["decisions"][0]["decision"] == "NO"
+    # no explain flag → no explanations section, still acknowledged
+    r = call(node, "POST", "/_cluster/reroute", {
+        "commands": [{"cancel": {"index": "idx", "shard": 0,
+                                 "node": node.node_id}}]})
+    assert r == {"acknowledged": True}
+    # malformed / unknown commands are 400s
+    call(node, "POST", "/_cluster/reroute",
+         {"commands": [{"bogus": {}}]}, expect=400)
+    call(node, "POST", "/_cluster/reroute",
+         {"commands": [{"move": {"index": "ghost", "shard": 0,
+                                 "from_node": "a", "to_node": "b"}}]},
+         expect=404)

@@ -1,0 +1,588 @@
+"""Shard relocation & staged recovery under the deterministic harness:
+explicit `_cluster/reroute` moves, node drain via allocation exclusion,
+relocation under live write/search load with zero acked-write loss,
+chaos (source death mid-phase-1, cross-node cancel mid-replay), and a
+mixed-wire rolling-upgrade smoke (ref strategy: RelocationIT /
+IndexRecoveryIT / SearchWhileRelocatingIT on the AbstractCoordinator
+simulation).
+
+Every chaos path replays byte-identically from its queue seed."""
+
+import pytest
+
+from test_cluster_node import SimDataCluster, _index_some_docs
+
+from elasticsearch_tpu.cluster.state import (
+    SHARD_RELOCATING,
+    SHARD_STARTED,
+)
+from elasticsearch_tpu.testing.deterministic import DISCONNECTED
+from elasticsearch_tpu.transport.transport import CURRENT_VERSION
+from elasticsearch_tpu.utils.breaker import CircuitBreaker
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    return SimDataCluster(3, tmp_path, seed=31)
+
+
+# ------------------------------------------------------------------ helpers
+
+def _shard_table(cluster, index="logs", shard_id=0):
+    irt = cluster.master().state.routing_table.index(index)
+    assert irt is not None, f"no routing for [{index}]"
+    return irt.shard(shard_id)
+
+
+def _primary_node_id(cluster, index="logs", shard_id=0):
+    primary = _shard_table(cluster, index, shard_id).primary
+    assert primary is not None and primary.active
+    return primary.current_node_id
+
+
+def _other_data_node_id(cluster, *occupied):
+    for node in cluster.nodes:
+        if node.node_id not in occupied:
+            return node.node_id
+    raise AssertionError("no free node")
+
+
+def _doc_count(cluster, coordinator, index="logs"):
+    resp = cluster.call(coordinator.search, index,
+                        {"query": {"match_all": {}}, "size": 0})
+    assert resp["_shards"]["failed"] == 0, resp
+    return resp["hits"]["total"]["value"]
+
+
+def _move(cluster, index, shard_id, from_node, to_node, **kwargs):
+    return cluster.call(
+        cluster.master().reroute,
+        commands=[{"move": {"index": index, "shard": shard_id,
+                            "from_node": from_node,
+                            "to_node": to_node}}], **kwargs)
+
+
+def _relocation_recs(cn, index="logs", shard_id=0):
+    return [rec for (ix, sid, _alloc), rec in
+            sorted(cn.data_node.recoveries.items())
+            if ix == index and sid == shard_id
+            and rec.recovery_type == "relocation"]
+
+
+def _no_relocating_copies(cluster, index="logs"):
+    return not any(s.state == SHARD_RELOCATING for s in
+                   cluster.master().state.routing_table.all_shards()
+                   if s.index == index)
+
+
+# ------------------------------------------------------------- explicit move
+
+def test_explicit_move_relocates_shard(cluster):
+    """`POST /_cluster/reroute {move}`: RELOCATING source +
+    INITIALIZING target pair, staged handoff, source copy removed and
+    the target serving searches with full seqno continuity."""
+    master = cluster.stabilise()
+    cluster.call(master.create_index, "logs",
+                 number_of_shards=1, number_of_replicas=0)
+    cluster.run_for(30)
+    _index_some_docs(cluster, master, n=20)
+
+    src = _primary_node_id(cluster)
+    tgt = _other_data_node_id(cluster, src)
+    resp = _move(cluster, "logs", 0, src, tgt)
+    assert resp["acknowledged"] is True
+    cluster.run_for(60)
+
+    table = _shard_table(cluster)
+    assert [s.state for s in table.shards] == [SHARD_STARTED]
+    assert table.primary.current_node_id == tgt
+    # the source node dropped its copy entirely
+    assert ("logs", 0) not in cluster.cluster_nodes[src].data_node.shards
+    # the target holds the shard with seqno continuity: 20 docs means
+    # local checkpoint 19, and the new primary's tracker agrees
+    tgt_shard = cluster.cluster_nodes[tgt].data_node.shards[("logs", 0)]
+    assert tgt_shard.primary and tgt_shard.state == "started"
+    assert tgt_shard.engine.tracker.checkpoint == 19
+    assert tgt_shard.tracker is not None
+    assert tgt_shard.tracker.global_checkpoint == 19
+
+    assert _doc_count(cluster, master) == 20
+
+    recs = _relocation_recs(cluster.cluster_nodes[tgt])
+    assert len(recs) == 1
+    rec = recs[0].to_dict()
+    assert rec["stage"] == "DONE"
+    assert rec["protocol"] == CURRENT_VERSION
+    assert rec["index_files"]["recovered_bytes"] > 0
+    assert rec["index_files"]["recovered_bytes"] == \
+        rec["index_files"]["total_bytes"]
+    assert rec["source_node"] == \
+        cluster.cluster_nodes[src].local_node.name
+    assert rec["target_node"] == \
+        cluster.cluster_nodes[tgt].local_node.name
+    assert rec["total_time_ms"] is not None and rec["failure"] is None
+
+    # the source's retention lease for this recovery was released
+    assert not any(cn.data_node.shards.get(("logs", 0)) and
+                   cn.data_node.shards[("logs", 0)].tracker and
+                   any(lid.startswith("peer_recovery/") for lid in
+                       cn.data_node.shards[("logs", 0)]
+                       .tracker.get_retention_leases())
+                   for nid, cn in cluster.cluster_nodes.items()
+                   if nid != tgt)
+
+
+def test_reroute_explain_dry_run_reports_decider_decisions(cluster):
+    master = cluster.stabilise()
+    cluster.call(master.create_index, "logs",
+                 number_of_shards=1, number_of_replicas=0)
+    cluster.run_for(30)
+    src = _primary_node_id(cluster)
+    tgt = _other_data_node_id(cluster, src)
+    resp = _move(cluster, "logs", 0, src, tgt, explain=True,
+                 dry_run=True)
+    assert resp["acknowledged"] is True
+    (entry,) = resp["explanations"]
+    assert entry["command"] == "move" and entry["accepted"] is True
+    deciders = {d["decider"] for d in entry["decisions"]}
+    assert "same_shard" in deciders and "throttling" in deciders
+    # dry_run: nothing actually moved
+    cluster.run_for(10)
+    assert _primary_node_id(cluster) == src
+
+
+def test_reroute_cancel_reverts_relocation(cluster):
+    """`{cancel}` on the relocation target drops the INITIALIZING copy
+    and flips the source back to STARTED — no write ever lost."""
+    master = cluster.stabilise()
+    cluster.call(master.create_index, "logs",
+                 number_of_shards=1, number_of_replicas=0)
+    cluster.run_for(30)
+    _index_some_docs(cluster, master, n=10)
+    src = _primary_node_id(cluster)
+    tgt = _other_data_node_id(cluster, src)
+    master.reroute(commands=[{"move": {
+        "index": "logs", "shard": 0,
+        "from_node": src, "to_node": tgt}}])
+    # cancel promptly — as soon as the INITIALIZING target shows up in
+    # the published routing, before recovery can complete
+    for _ in range(200):
+        cluster.run_for(0.05)
+        table = _shard_table(cluster)
+        if any(s.is_relocation_target for s in table.shards):
+            break
+    else:
+        raise AssertionError("relocation target never appeared")
+    cluster.call(master.reroute, commands=[
+        {"cancel": {"index": "logs", "shard": 0, "node": tgt,
+                    "allow_primary": False}}])
+    cluster.run_for(60)
+    table = _shard_table(cluster)
+    assert [s.state for s in table.shards] == [SHARD_STARTED]
+    assert table.primary.current_node_id == src
+    assert _doc_count(cluster, master) == 10
+
+
+# ------------------------------------------------------------- node drain
+
+def test_node_drain_moves_shards_and_restores_hbm_residency(cluster):
+    """`cluster.routing.allocation.exclude._id` drains every shard off
+    the node; each relocated copy re-uploads its device segments to the
+    target's HBM (visible in the recovery's device section and the
+    target's device cache) before flipping STARTED."""
+    master = cluster.stabilise()
+    cluster.call(master.create_index, "logs",
+                 number_of_shards=2, number_of_replicas=0)
+    cluster.run_for(30)
+    _index_some_docs(cluster, master, n=30)   # refreshes → segments exist
+
+    drained = _primary_node_id(cluster, shard_id=0)
+    resp = cluster.call(
+        master.update_cluster_settings,
+        persistent={"cluster.routing.allocation.exclude._id": drained})
+    assert resp["acknowledged"] is True
+    cluster.run_for(90)
+
+    state = cluster.master().state
+    moved = [s for s in state.routing_table.all_shards()
+             if s.index == "logs"]
+    assert all(s.state == SHARD_STARTED for s in moved)
+    assert all(s.current_node_id != drained for s in moved)
+    # the drained node no longer hosts (or serves) any copy
+    assert not cluster.cluster_nodes[drained].data_node.shards
+    assert _doc_count(cluster, master) == 30
+
+    # device re-residency happened on a target before STARTED
+    relocated = [rec for cn in cluster.cluster_nodes.values()
+                 for rec in _relocation_recs(cn)
+                 + _relocation_recs(cn, shard_id=1)]
+    assert relocated, "no relocation recovery recorded"
+    assert any(rec.hbm_segments > 0 for rec in relocated)
+    by_name = {cn.local_node.name: cn
+               for cn in cluster.cluster_nodes.values()}
+    for rec in relocated:
+        assert rec.stage == "done" and rec.hbm_skipped_segments == 0
+        tgt_cache = by_name[rec.target_node].data_node.device_cache
+        assert tgt_cache.hbm_stats()["total_bytes"] > 0
+
+    # un-draining re-admits the node for future allocations
+    cluster.call(master.update_cluster_settings,
+                 persistent={
+                     "cluster.routing.allocation.exclude._id": None})
+    assert "cluster.routing.allocation.exclude._id" not in \
+        cluster.master().state.metadata.persistent_settings
+
+
+# ------------------------------------------------- relocation under load
+
+def _staggered_bulks(cluster, coordinator, acked, rejected,
+                     start=0.0, rounds=12, batch=5, gap=0.35,
+                     index="logs"):
+    """Schedule `rounds` bulk writes spread across the relocation
+    window, recording acked ids; 429/backpressure rejections are the
+    client's to retry and land in `rejected`."""
+    counter = {"n": 0}
+
+    def one_round():
+        items = []
+        for _ in range(batch):
+            i = counter["n"]
+            counter["n"] += 1
+            items.append({"op": "index", "id": f"live-{i}",
+                          "source": {"body": f"live doc {i}", "n": i}})
+
+        def on_done(resp, err=None, _items=items):
+            if err is not None:
+                rejected.extend(d["id"] for d in _items)
+                return
+            for item, d in zip(resp["items"], _items):
+                if item and "error" not in item:
+                    acked.append(d["id"])
+                else:
+                    rejected.append(d["id"])
+
+        coordinator.bulk(index, items, on_done=on_done)
+
+    for r in range(rounds):
+        cluster.queue.schedule(start + r * gap, one_round,
+                               f"live-bulk-{r}")
+
+
+def _run_relocation_under_load(tmp_path, seed):
+    cluster = SimDataCluster(3, tmp_path, seed=seed)
+    master = cluster.stabilise()
+    cluster.call(master.create_index, "logs",
+                 number_of_shards=1, number_of_replicas=0)
+    cluster.run_for(30)
+    _index_some_docs(cluster, master, n=20)
+
+    src = _primary_node_id(cluster)
+    tgt = _other_data_node_id(cluster, src)
+    acked, rejected, search_totals = [], [], []
+    _staggered_bulks(cluster, master, acked, rejected)
+
+    def probe_search():
+        master.search("logs", {"query": {"match": {"body": "doc"}},
+                               "size": 0},
+                      on_done=lambda r, e=None:
+                      search_totals.append(
+                          "err" if e or r["_shards"]["failed"]
+                          else r["hits"]["total"]["value"]))
+
+    for t in (0.5, 1.5, 2.5, 3.5):
+        cluster.queue.schedule(t, probe_search, f"probe-{t}")
+    _move(cluster, "logs", 0, src, tgt)
+    cluster.run_for(90)
+    cluster.call(master.refresh)
+
+    table = _shard_table(cluster)
+    assert table.primary.current_node_id == tgt
+    assert [s.state for s in table.shards] == [SHARD_STARTED]
+    # ZERO acked-write loss: every acked doc is searchable post-move
+    total = _doc_count(cluster, master)
+    assert total == 20 + len(acked), \
+        f"acked={len(acked)} rejected={len(rejected)} total={total}"
+    assert len(acked) > 0
+    # searches during the window never failed (ARS fails over copies)
+    assert search_totals and "err" not in search_totals
+
+    tgt_cn = cluster.cluster_nodes[tgt]
+    (rec,) = _relocation_recs(tgt_cn)
+    assert rec.stage == "done"
+
+    def scrub(d):
+        # allocation ids are uuid4 identities, not replay state
+        return {k: v for k, v in d.items() if k != "allocation_id"}
+
+    return {"acked": sorted(acked), "rejected": sorted(rejected),
+            "search_totals": search_totals, "total": total,
+            "recovery": scrub(rec.to_dict()),
+            "recoveries": [scrub(r) for r in
+                           tgt_cn.data_node.recovery_stats()]}
+
+
+@pytest.mark.chaos(seed=29)
+def test_relocation_under_write_and_search_load(tmp_path):
+    """Primary relocation with concurrent bulks + searches: writes
+    route to the RELOCATING source, drain at the handoff barrier, and
+    resume on the target — no acked write lost, no search failure, the
+    recovery visible as a cancellable task with spans."""
+    cluster = SimDataCluster(3, tmp_path, seed=29)
+    master = cluster.stabilise()
+    cluster.call(master.create_index, "logs",
+                 number_of_shards=1, number_of_replicas=0)
+    cluster.run_for(30)
+    _index_some_docs(cluster, master, n=20)
+
+    src = _primary_node_id(cluster)
+    tgt = _other_data_node_id(cluster, src)
+    acked, rejected = [], []
+    _staggered_bulks(cluster, master, acked, rejected)
+
+    # snapshot task visibility while the recovery runs: poll /_tasks
+    seen_tasks = []
+
+    def probe_tasks():
+        master.list_tasks(
+            {"actions": "*recovery*", "detailed": True},
+            on_done=lambda r, e=None:
+            seen_tasks.extend([] if e else [
+                t for n in r.get("nodes", {}).values()
+                for t in n.get("tasks", {}).values()]))
+
+    for t in (0.2, 0.6, 1.0, 1.6, 2.4):
+        cluster.queue.schedule(t, probe_tasks, f"tasks-{t}")
+    _move(cluster, "logs", 0, src, tgt)
+    cluster.run_for(90)
+    cluster.call(master.refresh)
+
+    assert _doc_count(cluster, master) == 20 + len(acked)
+    assert len(acked) > 0
+
+    # the recovery surfaced in GET /_tasks as a cancellable task …
+    rec_tasks = [t for t in seen_tasks
+                 if "recovery" in t.get("action", "")]
+    assert rec_tasks and all(t["cancellable"] for t in rec_tasks)
+    # … and left a full span tree on the target's tracer
+    tgt_cn = cluster.cluster_nodes[tgt]
+    traces = tgt_cn.telemetry.tracer.recent_traces(limit=64)
+    rec_traces = [t for t in traces if t["root"] == "recovery"]
+    assert rec_traces and all(t["spans"] >= 3 for t in rec_traces)
+    assert not [s for s in tgt_cn.telemetry.tracer.open_spans()
+                if s.name.startswith("recovery")]
+    (rec,) = _relocation_recs(tgt_cn)
+    assert rec.task_id is not None
+    # live writes arrived through tracked replication or phase-2 replay
+    assert rec.translog_ops_replayed >= 0
+    assert rec.stage == "done"
+
+
+@pytest.mark.chaos(seed=29)
+def test_relocation_under_load_is_deterministic(tmp_path):
+    """Same seed ⇒ byte-identical acked set, search observations, and
+    recovery telemetry (the chaos-replay contract)."""
+    a = _run_relocation_under_load(tmp_path / "a", seed=29)
+    b = _run_relocation_under_load(tmp_path / "b", seed=29)
+    assert a == b
+
+
+# ------------------------------------------------------------------ chaos
+
+@pytest.mark.chaos(seed=11)
+def test_source_death_mid_recovery_reallocates_cleanly(tmp_path):
+    """Kill the source mid-phase-1: the target aborts (no half-open
+    recovery), the master fails the RELOCATING source, and the shard
+    re-allocates from the surviving replica — nothing stranded."""
+    cluster = SimDataCluster(3, tmp_path, seed=11)
+    master = cluster.stabilise()
+    cluster.call(master.create_index, "logs",
+                 number_of_shards=1, number_of_replicas=1)
+    cluster.run_for(60)
+    _index_some_docs(cluster, master, n=20)
+
+    table = _shard_table(cluster)
+    src = table.primary.current_node_id
+    occupied = {s.current_node_id for s in table.shards}
+    tgt = _other_data_node_id(cluster, *occupied)
+    _move(cluster, "logs", 0, src, tgt)
+
+    # let phase 1 begin, then cut the source off from everyone
+    cluster.run_for(0.4)
+    src_node = next(n for n in cluster.nodes if n.node_id == src)
+    cluster.network.isolate(
+        src_node, [n for n in cluster.nodes if n.node_id != src],
+        DISCONNECTED)
+    cluster.run_for(120)
+
+    master = cluster.master()
+    table = _shard_table(cluster)
+    primary = table.primary
+    assert primary is not None and primary.state == SHARD_STARTED
+    assert primary.current_node_id != src
+    assert _no_relocating_copies(cluster)
+    # no abandoned recovery left live on the target
+    live = [rec for rec in _relocation_recs(cluster.cluster_nodes[tgt])
+            if rec.stage not in ("done", "failed", "cancelled")]
+    assert not live
+    # acked docs survive on the promoted copy
+    coordinator = next(cn for nid, cn in cluster.cluster_nodes.items()
+                       if nid != src and cn.is_master())
+    assert _doc_count(cluster, coordinator) == 20
+
+
+@pytest.mark.chaos(seed=43)
+def test_cross_node_cancel_mid_recovery_releases_resources(tmp_path):
+    """Cancel the recovery task from ANOTHER node while replay is in
+    flight: the target aborts, the source's retention lease is
+    released, no breaker bytes leak, and the routing table converges
+    with no copy stuck RELOCATING (the ESTPU-PAIR obligation, live)."""
+    cluster = SimDataCluster(3, tmp_path, seed=43)
+    master = cluster.stabilise()
+    cluster.call(master.create_index, "logs",
+                 number_of_shards=1, number_of_replicas=0)
+    cluster.run_for(30)
+    _index_some_docs(cluster, master, n=40)
+
+    src = _primary_node_id(cluster)
+    tgt = _other_data_node_id(cluster, src)
+    # keep writes flowing so phase 2 has ops to replay
+    acked, rejected = [], []
+    _staggered_bulks(cluster, master, acked, rejected, rounds=16,
+                     gap=0.2)
+    master.reroute(commands=[{"move": {
+        "index": "logs", "shard": 0,
+        "from_node": src, "to_node": tgt}}])
+
+    # step in small slices until the target recovery is live, then
+    # cancel it from a third node (cross-node cancel fan-out)
+    cancelled_from = _other_data_node_id(cluster, src, tgt)
+    rec = None
+    for _ in range(800):
+        cluster.run_for(0.02)
+        live = [r for r in _relocation_recs(cluster.cluster_nodes[tgt])
+                if r.stage in ("index", "translog", "device")
+                and r.task_id is not None]
+        if live:
+            rec = live[0]
+            break
+    assert rec is not None, "recovery never reached a live stage"
+    canceller = cluster.cluster_nodes[cancelled_from]
+    cluster.call(canceller.cancel_task, f"{tgt}:{rec.task_id}",
+                 reason="test cancel")
+    cluster.run_for(90)
+
+    assert rec.stage in ("cancelled", "failed", "done")
+    # routing converged: an active primary, nothing stuck RELOCATING
+    table = _shard_table(cluster)
+    assert table.primary is not None and table.primary.active
+    assert _no_relocating_copies(cluster)
+    # the source released its peer-recovery retention lease
+    for cn in cluster.cluster_nodes.values():
+        shard = cn.data_node.shards.get(("logs", 0))
+        if shard is not None and shard.tracker is not None:
+            leases = shard.tracker.get_retention_leases()
+            assert not [lid for lid in leases
+                        if lid.startswith("peer_recovery/")], leases
+    # no leaked breaker bytes or indexing-pressure charges anywhere
+    for cn in cluster.cluster_nodes.values():
+        svc = cn.breaker_service
+        assert svc.get_breaker(
+            CircuitBreaker.IN_FLIGHT_REQUESTS).used == 0
+        assert cn.indexing_pressure.current_bytes() == 0
+    # the cancelled task is gone from every task manager
+    for cn in cluster.cluster_nodes.values():
+        assert not cn.task_manager.list_tasks(actions="*recovery*")
+    # writes still work after the cancel (source kept or re-won the
+    # primary role) and nothing acked was lost
+    cluster.call(master.refresh)
+    assert _doc_count(cluster, master) == 40 + len(acked)
+
+
+# ------------------------------------------------------- rolling upgrade
+
+def test_rolling_upgrade_node_receives_relocation_over_v1(cluster):
+    """A node still on wire version N-1 joins the relocation dance: the
+    source detects the older peer, falls back to the single-shot v1
+    recovery, and the shard serves correct searches from the old node
+    (the rolling-upgrade smoke)."""
+    master = cluster.stabilise()
+    cluster.call(master.create_index, "logs",
+                 number_of_shards=1, number_of_replicas=0)
+    cluster.run_for(30)
+    _index_some_docs(cluster, master, n=20)
+
+    src = _primary_node_id(cluster)
+    old = _other_data_node_id(cluster, src)
+    # downgrade the target's wire version (an N-1 binary that joined)
+    cluster.cluster_nodes[old].transport.wire_version = \
+        CURRENT_VERSION - 1
+    _move(cluster, "logs", 0, src, old)
+    cluster.run_for(60)
+
+    table = _shard_table(cluster)
+    assert table.primary.current_node_id == old
+    assert [s.state for s in table.shards] == [SHARD_STARTED]
+    (rec,) = _relocation_recs(cluster.cluster_nodes[old])
+    assert rec.protocol == CURRENT_VERSION - 1
+    assert rec.stage == "done"
+    assert _doc_count(cluster, master) == 20
+    # and the old node can still take writes as the new primary
+    resp = cluster.call(master.bulk, "logs", [
+        {"op": "index", "id": f"post-{i}",
+         "source": {"body": f"post upgrade {i}"}} for i in range(5)])
+    assert resp["errors"] == []
+    cluster.call(master.refresh)
+    assert _doc_count(cluster, master) == 25
+
+
+# ------------------------------------------------------- recovery API
+
+def test_indices_recovery_fans_out_across_nodes(cluster):
+    master = cluster.stabilise()
+    cluster.call(master.create_index, "logs",
+                 number_of_shards=1, number_of_replicas=1)
+    cluster.run_for(60)
+    _index_some_docs(cluster, master, n=10)
+    resp = cluster.call(master.indices_recovery, "logs")
+    assert "logs" in resp
+    shards = resp["logs"]["shards"]
+    assert shards, resp
+    types = {rec["type"] for rec in shards}
+    # the primary recovered from local store, the replica from a peer
+    assert "local_store" in types and "peer" in types
+    for rec in shards:
+        assert rec["stage"] == "DONE"
+        assert rec["index_files"]["recovered_bytes"] >= 0
+    # filtered out for other indices
+    assert cluster.call(master.indices_recovery, "nope") == {}
+
+
+# ------------------------------------------- stale-state search failover
+
+def test_shard_iterator_appends_initializing_relocation_target():
+    """The relocation-flip race: a coordinator holding the pre-flip
+    cluster state routes a search to the RELOCATING source, which may
+    already have handed off and dropped its copy. The shard iterator
+    must offer the INITIALIZING target as the next pick (ref:
+    IndexShardRoutingTable.activeInitializingShardsRankedIt) so the
+    retry lands on the copy that is actually started by then."""
+    from elasticsearch_tpu.cluster.routing import OperationRouting
+    from elasticsearch_tpu.cluster.state import (
+        ClusterState, IndexRoutingTable, IndexShardRoutingTable,
+        RoutingTable, ShardRouting, SHARD_INITIALIZING)
+
+    src = ShardRouting("logs", 0, primary=True, state=SHARD_RELOCATING,
+                       current_node_id="dn-0", relocating_node_id="dn-1",
+                       allocation_id="a-src")
+    tgt = ShardRouting("logs", 0, primary=True, state=SHARD_INITIALIZING,
+                       current_node_id="dn-1", relocating_node_id="dn-0",
+                       allocation_id="a-tgt")
+    table = IndexShardRoutingTable("logs", 0, (src, tgt))
+    state = ClusterState(routing_table=RoutingTable(
+        {"logs": IndexRoutingTable("logs", {0: table})}))
+
+    (it,) = OperationRouting().shard_iterators(state, "logs")
+    first, second = it.next_or_none(), it.next_or_none()
+    assert first is not None and first.allocation_id == "a-src"
+    assert second is not None and second.allocation_id == "a-tgt"
+    assert it.next_or_none() is None
