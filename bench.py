@@ -79,7 +79,7 @@ def remaining_budget() -> float:
 def emit(metric_text: str, value: float, vs_baseline: float,
          engine=None, overload=None, tasks=None, cpu=None,
          serving=None, skipped=None, aggs=None, multichip=None,
-         lint=None, recovery=None):
+         lint=None, recovery=None, health=None):
     _LAST_PAYLOAD.clear()
     _LAST_PAYLOAD.update({
         "metric": metric_text,
@@ -149,6 +149,13 @@ def emit(metric_text: str, value: float, vs_baseline: float,
         # regression shows here round over round before it ever costs
         # a production drain
         _LAST_PAYLOAD["recovery"] = recovery
+    if health:
+        # health rider (health/ + telemetry/history.py, deterministic
+        # sim): merged indicator statuses through a seeded breaker
+        # squeeze (healthy -> red -> recovered), watchdog stall stats,
+        # and the history ring's residency — the round records its
+        # diagnostic surface's verdicts next to the qps they guard
+        _LAST_PAYLOAD["health"] = health
     print(json.dumps(_LAST_PAYLOAD), flush=True)
 
 
@@ -1579,6 +1586,98 @@ def run_recovery_cpu(n_docs=400, seed=7):
         }
 
 
+def run_health_cpu(seed=7):
+    """Health rider (CPU-side, deterministic sim — no jax): boots a
+    3-node sim cluster, lays metrics-history samples, squeezes the
+    request breaker into a trip storm, and drives the
+    `cluster:monitor/health_report[n]` fan-out through the squeeze and
+    back out — banking the merged indicator statuses, the watchdog's
+    stall-tracking stats, and the history ring's residency estimate
+    into the BENCH json `health` section BEFORE any backend touch.
+    Replay-stable: seeded queue + virtual clock render the same
+    statuses every round."""
+    import tempfile
+
+    from elasticsearch_tpu.cluster.node import ClusterNode
+    from elasticsearch_tpu.testing.deterministic import (
+        DeterministicTaskQueue, DisruptableTransport, SimNetwork)
+    from elasticsearch_tpu.transport.transport import DiscoveryNode
+    from elasticsearch_tpu.utils.breaker import (
+        CircuitBreaker, CircuitBreakingException)
+
+    t_host = time.time()
+    with tempfile.TemporaryDirectory() as tmp:
+        queue = DeterministicTaskQueue(seed=seed)
+        network = SimNetwork(queue)
+        nodes = [DiscoveryNode(node_id=f"hn-{i}", name=f"hn{i}")
+                 for i in range(3)]
+        cluster = {}
+        for node in nodes:
+            cluster[node.node_id] = ClusterNode(
+                DisruptableTransport(node, network), queue,
+                data_path=os.path.join(tmp, node.name),
+                seed_nodes=nodes,
+                initial_master_nodes=[n.name for n in nodes],
+                rng=queue.random)
+        for cn in cluster.values():
+            cn.start()
+
+        def call(fn, *args, **kwargs):
+            box = {}
+            fn(*args, **kwargs,
+               on_done=lambda r, e=None: box.update(r=r, e=e))
+            for _ in range(120):
+                if box:
+                    break
+                queue.run_for(1.0)
+            if box.get("e") is not None:
+                raise RuntimeError(box["e"])
+            return box.get("r")
+
+        queue.run_for(60)
+        master = next(cn for cn in cluster.values() if cn.is_master())
+        call(master.create_index, "bench", number_of_shards=2,
+             number_of_replicas=1)
+        queue.run_for(30)
+        healthy = call(master.health_report)
+
+        # seeded squeeze: 6 request-breaker trips inside one history
+        # window turn circuit_breakers red via the ring's trip RATE
+        breaker = master.breaker_service.get_breaker(
+            CircuitBreaker.REQUEST)
+        for _ in range(6):
+            try:
+                breaker.add_estimate_bytes_and_maybe_break(
+                    1 << 50, "bench-squeeze")
+            except CircuitBreakingException:
+                pass
+        queue.run_for(11)
+        squeezed = call(master.health_report)
+        # periodic reports keep sampling until the storm ages out of
+        # the trailing window — the verdict must recover on its own
+        recovered = squeezed
+        for _ in range(8):
+            queue.run_for(10)
+            recovered = call(master.health_report)
+
+        master_det = squeezed["indicators"]["circuit_breakers"][
+            "details"]["nodes"][master.local_node.node_id]
+        history = master.telemetry.history
+        return {
+            "status_healthy": healthy["status"],
+            "status_squeezed": squeezed["status"],
+            "status_recovered": recovered["status"],
+            "indicators_squeezed": {
+                name: ind["status"] for name, ind in
+                sorted(squeezed["indicators"].items())},
+            "breaker_trips_in_window": int(master_det["recent_trips"]),
+            "watchdog": master.health_watchdog.stats(),
+            "history_samples": len(history.samples()),
+            "history_memory_bytes": history.memory_bytes(),
+            "host_s": round(time.time() - t_host, 1),
+        }
+
+
 # ---------------------------------------------------------------------------
 # Multi-chip serving rows (ISSUE 9): qps at 1/2/4/8 devices for the two
 # mesh serving modes — sharded-corpus (one SPMD fan-out/merge program per
@@ -1972,7 +2071,8 @@ def main():
              aggs=parts.get("aggs"),
              multichip=parts.get("multichip"),
              lint=parts.get("lint"),
-             recovery=parts.get("recovery"))
+             recovery=parts.get("recovery"),
+             health=parts.get("health"))
 
     # estpu-lint preflight: static contract scan of the whole package
     # (stdlib ast, ~2s, no device). Summary rides every BENCH line so
@@ -2037,6 +2137,12 @@ def main():
         parts["recovery"] = run_recovery_cpu()
     except Exception as e:  # noqa: BLE001 — the rider must not sink
         log(f"recovery rider failed: {e!r}")
+    # health rows (deterministic sim, no jax): indicator verdicts
+    # through a seeded breaker squeeze + watchdog/history residency
+    try:
+        parts["health"] = run_health_cpu()
+    except Exception as e:  # noqa: BLE001 — the rider must not sink
+        log(f"health rider failed: {e!r}")
     # ALL CPU-side rows land before ANY jax/backend touch: a dead
     # relay hangs even backend INIT uninterruptibly (observed: hours),
     # and a run killed there must still have parsed output on record

@@ -80,6 +80,7 @@ BULK_ACTION = "indices:data/write/bulk"
 CLUSTER_REROUTE_ACTION = "cluster:admin/reroute"
 CLUSTER_SETTINGS_ACTION = "cluster:admin/settings/update"
 RECOVERY_STATS_ACTION = "indices:monitor/recovery[n]"
+HEALTH_REPORT_ACTION = "cluster:monitor/health_report[n]"
 
 # coordinator-side bulk retry for TRANSIENT routing failures only (a
 # primary mid-handoff or a routing flip in progress): backpressure 429s
@@ -124,7 +125,11 @@ class ClusterNode:
         from elasticsearch_tpu.telemetry import Telemetry, wire_transport
         self.telemetry = Telemetry(
             node=self.local_node.name or self.local_node.node_id,
-            clock=scheduler.now)
+            clock=scheduler.now,
+            history_interval=float(
+                self.settings.get("telemetry.history.interval", 10.0)),
+            history_retention=float(
+                self.settings.get("telemetry.history.retention", 600.0)))
         wire_transport(transport, self.telemetry)
         # memory protection: hierarchical circuit breakers charged on
         # the live path (transport inbound → in_flight_requests, device
@@ -183,6 +188,29 @@ class ClusterNode:
             rng=rng,
             consistent_settings=consistent)
 
+        # health & diagnostics: indicator catalog + stalled-progress
+        # watchdog on the scheduler clock. Lazy by default (sweeps run
+        # as part of each report) — periodic mode is opt-in via
+        # `health.watchdog.active` / `telemetry.history.active` because
+        # a recurring scheduled task changes the seeded task-queue
+        # interleaving existing chaos suites replay against.
+        from elasticsearch_tpu.health import (
+            HealthService, StalledProgressWatchdog)
+        from elasticsearch_tpu.health import watchdog as _watchdog_mod
+        self.health_watchdog = StalledProgressWatchdog(
+            clock=scheduler.now, metrics=self.telemetry.metrics,
+            recoveries_fn=lambda: self.data_node.recoveries,
+            tasks_fn=self.task_manager.list_tasks,
+            lag_fn=lambda: (self.coordinator.state_lag()
+                            if self.is_master() else {}),
+            stall_after_s=float(self.settings.get(
+                "health.watchdog.stall_after",
+                _watchdog_mod.DEFAULT_STALL_AFTER_S)),
+            task_deadline_s=float(self.settings.get(
+                "health.watchdog.task_deadline",
+                _watchdog_mod.DEFAULT_TASK_DEADLINE_S)))
+        self.health = HealthService(context_fn=self._health_context)
+
         for action, handler in [
             (SHARD_STARTED_ACTION, self._on_shard_started),
             (SHARD_FAILED_ACTION, self._on_shard_failed),
@@ -196,6 +224,7 @@ class ClusterNode:
             (CLUSTER_REROUTE_ACTION, self._on_cluster_reroute),
             (CLUSTER_SETTINGS_ACTION, self._on_cluster_settings),
             (RECOVERY_STATS_ACTION, self._on_recovery_stats),
+            (HEALTH_REPORT_ACTION, self._on_health_report),
         ]:
             # master/admin + monitoring actions never trip the inbound
             # breaker: shard-state reporting and stats are exactly what
@@ -207,8 +236,18 @@ class ClusterNode:
 
     def start(self) -> None:
         self.coordinator.start()
+        # opt-in periodic sweeps (see the wiring comment in __init__)
+        if self.settings.get("health.watchdog.active"):
+            self.health_watchdog.start(
+                self.scheduler,
+                interval=float(self.settings.get(
+                    "health.watchdog.interval", 15.0)))
+        if self.settings.get("telemetry.history.active"):
+            self.telemetry.history.start(self.scheduler)
 
     def stop(self) -> None:
+        self.health_watchdog.stop()
+        self.telemetry.history.stop()
         self.coordinator.stop()
         self.data_node.close()
         closer = getattr(self.coordinator.coordination_state.persisted,
@@ -668,6 +707,91 @@ class ClusterNode:
                 parent, req.get("reason", "by user request"),
                 cancel_children=True)
         channel.send_response({"ok": True})
+
+    # ------------------------------------------------- health report
+
+    def _health_context(self):
+        """Fresh per report: every seam the indicator catalog reads
+        (health/indicator.py HealthContext)."""
+        from elasticsearch_tpu.health import HealthContext
+        from elasticsearch_tpu.telemetry import engine as _engine
+        return HealthContext(
+            node_id=self.local_node.node_id,
+            now=self.scheduler.now,
+            metrics=self.telemetry.metrics,
+            history=self.telemetry.history,
+            cluster_state=self.coordinator.applied_state,
+            is_master=self.is_master(),
+            breaker_service=self.breaker_service,
+            indexing_pressure=self.indexing_pressure,
+            task_manager=self.task_manager,
+            recoveries=self.data_node.recoveries,
+            state_lag=(self.coordinator.state_lag()
+                       if self.is_master() else None),
+            engine_totals=_engine.TRACKER.totals(),
+            watchdog=self.health_watchdog)
+
+    def _on_health_report(self, req, channel, src) -> None:
+        from elasticsearch_tpu.health import UnknownIndicatorError
+        try:
+            rep = self.health.local_report(req.get("indicator"))
+        except UnknownIndicatorError:
+            rep = {"node": self.local_node.node_id, "status": "unknown",
+                   "indicators": {}}
+        channel.send_response(rep)
+
+    def health_report(self, indicator: Optional[str] = None,
+                      on_done: Callable = lambda r, e: None) -> None:
+        """`GET /_health_report[/{indicator}]`: fan
+        HEALTH_REPORT_ACTION out to EVERY cluster node (health signals
+        — breakers, HBM, backlogs — are node-local by nature) and merge
+        worst-wins via health/service.py. Unreachable nodes compose as
+        `node_failures`: an incomplete report beats none."""
+        from elasticsearch_tpu.health import (
+            UnknownIndicatorError, merge_node_reports)
+        if indicator is not None and \
+                indicator not in self.health.indicator_names():
+            on_done(None, UnknownIndicatorError(indicator))
+            return
+        nodes = list(self.state.nodes.nodes)
+        if not nodes:
+            local = self.health.local_report(indicator)
+            on_done(merge_node_reports(
+                {self.local_node.node_id: local}), None)
+            return
+        reports: Dict[str, Dict[str, Any]] = {}
+        failures: List[Dict[str, str]] = []
+        pending = {"n": len(nodes)}
+
+        def finish():
+            pending["n"] -= 1
+            if pending["n"] == 0:
+                on_done(merge_node_reports(reports, failures), None)
+
+        for node in nodes:
+            def ok(resp, _nid=node.node_id):
+                reports[_nid] = resp
+                finish()
+
+            def fail(exc, _nid=node.node_id):
+                failures.append({"node": _nid, "error": str(exc)})
+                finish()
+
+            self.transport.send_request(
+                node, HEALTH_REPORT_ACTION, {"indicator": indicator},
+                ResponseHandler(ok, fail), timeout=30.0)
+
+    def cluster_health(self) -> Dict[str, Any]:
+        """`GET /_cluster/health` essentials from the applied state —
+        status comes from the SAME shard_availability_summary the
+        shards_availability indicator renders, so the two surfaces
+        cannot drift."""
+        from elasticsearch_tpu.health import shard_availability_summary
+        state = self.coordinator.applied_state
+        summary = shard_availability_summary(state)
+        summary["number_of_nodes"] = state.nodes.size
+        summary["number_of_data_nodes"] = len(state.nodes.data_nodes())
+        return summary
 
     # --------------------------------------------- cluster-state stats
 

@@ -1,0 +1,36 @@
+"""Cluster health & diagnostics (ref: ``org.elasticsearch.health``).
+
+Three pieces (see COMPONENTS.md "Health & diagnostics"):
+
+- **indicator framework** (`indicator.py`, `indicators.py`): pluggable
+  ``HealthIndicator``s rendering green/yellow/red with typed diagnosis
+  and impacts, served at ``GET /_health_report``;
+- **service + fan-out merge** (`service.py`): per-node local reports
+  composed cluster-wide via ``cluster:monitor/health_report[n]``;
+- **stalled-progress watchdog** (`watchdog.py`): detects recoveries,
+  tasks, and followers that stopped making progress.
+
+Everything runs on the injected scheduler clock and renders sorted,
+uuid-free output, so chaos-seeded reports replay byte-identical.
+"""
+
+from elasticsearch_tpu.health.indicator import (  # noqa: F401
+    Diagnosis,
+    HealthContext,
+    HealthIndicator,
+    HealthIndicatorResult,
+    HealthStatus,
+    Impact,
+)
+from elasticsearch_tpu.health.indicators import (  # noqa: F401
+    DEFAULT_INDICATORS,
+    shard_availability_summary,
+)
+from elasticsearch_tpu.health.service import (  # noqa: F401
+    HealthService,
+    UnknownIndicatorError,
+    merge_node_reports,
+)
+from elasticsearch_tpu.health.watchdog import (  # noqa: F401
+    StalledProgressWatchdog,
+)

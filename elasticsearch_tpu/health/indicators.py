@@ -1,0 +1,484 @@
+"""The built-in indicator catalog: six verdicts over existing signals.
+
+Each indicator maps one subsystem's live stats (PR-2..PR-12 surfaces)
+to a status + typed diagnosis (see ``health/indicator.py`` for the
+contract and COMPONENTS.md "Health & diagnostics" for the catalog).
+Storm-shaped verdicts (compile storms, rejection bursts, trip storms)
+read *rates* off the metrics history ring — a point-in-time counter
+cannot distinguish "300 compiles ever" from "300 compiles this minute".
+
+``shard_availability_summary`` is the ONE shard-status implementation:
+``_cluster/health``, ``_cat/health``, and the shards_availability
+indicator all call it, so the surfaces cannot drift.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.health.indicator import (
+    Diagnosis,
+    HealthContext,
+    HealthIndicator,
+    HealthIndicatorResult,
+    HealthStatus,
+    Impact,
+)
+
+# trailing window the storm-shaped verdicts read off the history ring
+HEALTH_RATE_WINDOW_S = 60.0
+
+# breaker pressure
+BREAKER_USED_YELLOW = 0.85      # used/limit ratio
+BREAKER_TRIPS_RED = 5           # trips in window, any breaker
+
+# indexing pressure
+REJECTIONS_RED = 10             # rejections in window, any stage
+PRESSURE_USED_YELLOW = 0.85     # current/limit ratio
+
+# task backlog / cancellation storms
+TASK_BACKLOG_YELLOW = 64        # concurrently-live tasks
+CANCEL_STORM_YELLOW = 10        # cancellations in window
+CANCEL_STORM_RED = 50
+
+# device / engine
+COMPILE_STORM_PER_MIN = 30.0    # fresh compiles per minute
+HBM_USED_YELLOW = 0.85
+MESH_FALLBACK_YELLOW = 0.10     # fallback fraction of mesh dispatches
+
+
+def shard_availability_summary(
+        cluster_state: Optional[Any]) -> Dict[str, Any]:
+    """Shard-availability roll-up from a routing table (ref:
+    ClusterStateHealth.java): red when any primary is not active,
+    yellow when all primaries are active but some copy isn't, green
+    otherwise. An empty/absent routing table is green (nothing to
+    serve ⇒ nothing unavailable)."""
+    counts = {"active_primary_shards": 0, "active_shards": 0,
+              "relocating_shards": 0, "initializing_shards": 0,
+              "unassigned_shards": 0, "unassigned_primary_shards": 0}
+    if cluster_state is None:
+        # single-process node: no routing table exists; every local
+        # shard is served in-process, so availability is green by
+        # construction (the caller may fill real counts)
+        return {**counts, "status": HealthStatus.GREEN}
+    for s in cluster_state.routing_table.all_shards():
+        if s.active:
+            counts["active_shards"] += 1
+            if s.primary:
+                counts["active_primary_shards"] += 1
+        if s.relocating:
+            counts["relocating_shards"] += 1
+        elif s.state == "initializing":
+            counts["initializing_shards"] += 1
+        elif s.state == "unassigned":
+            counts["unassigned_shards"] += 1
+            if s.primary:
+                counts["unassigned_primary_shards"] += 1
+    if counts["unassigned_primary_shards"] > 0:
+        status = HealthStatus.RED
+    elif counts["unassigned_shards"] > 0 or counts["initializing_shards"] > 0:
+        status = HealthStatus.YELLOW
+    else:
+        status = HealthStatus.GREEN
+    return {**counts, "status": status}
+
+
+class ShardsAvailabilityIndicator(HealthIndicator):
+    """Ref: ShardsAvailabilityHealthIndicatorService.java."""
+
+    name = "shards_availability"
+
+    def compute(self, ctx: HealthContext) -> HealthIndicatorResult:
+        summary = shard_availability_summary(ctx.cluster_state)
+        status = summary.pop("status")
+        impacts: List[Impact] = []
+        diagnoses: List[Diagnosis] = []
+        if status == HealthStatus.RED:
+            symptom = (f"{summary['unassigned_primary_shards']} primary "
+                       "shard(s) unavailable")
+            impacts.append(Impact(
+                id="primary_unassigned", severity=1,
+                description="searches and writes against affected indices "
+                            "fail or return partial results",
+                impact_areas=["search", "ingest"]))
+            diagnoses.append(Diagnosis(
+                id="shards_availability:primary_unassigned",
+                cause="primary shards have no assigned copy on any node",
+                action="restore missing nodes or allocate replacements "
+                       "via _cluster/reroute allocate_replica",
+                affected_resources=_unassigned_indices(ctx, primary=True)))
+        elif status == HealthStatus.YELLOW:
+            n = (summary["unassigned_shards"]
+                 + summary["initializing_shards"])
+            symptom = f"{n} shard copy(ies) not fully available"
+            impacts.append(Impact(
+                id="replica_unassigned", severity=3,
+                description="reduced redundancy: a node loss may make "
+                            "data unavailable",
+                impact_areas=["search"]))
+            diagnoses.append(Diagnosis(
+                id="shards_availability:replica_unassigned",
+                cause="replica copies are unassigned or still recovering",
+                action="wait for recovery to finish, or add data nodes",
+                affected_resources=_unassigned_indices(ctx, primary=False)))
+        else:
+            symptom = "all shard copies are available"
+        return HealthIndicatorResult(
+            name=self.name, status=status, symptom=symptom,
+            details=summary, impacts=impacts, diagnoses=diagnoses)
+
+
+def _unassigned_indices(ctx: HealthContext, primary: bool) -> List[str]:
+    out = set()
+    if ctx.cluster_state is None:
+        return []
+    for s in ctx.cluster_state.routing_table.all_shards():
+        if s.state in ("unassigned", "initializing") and \
+                (s.primary if primary else not s.primary):
+            out.add(s.index)
+    return sorted(out)
+
+
+class CircuitBreakerIndicator(HealthIndicator):
+    """Breaker pressure: live used/limit ratios plus the trip *rate*
+    off the ring — distinguishing a trip storm from boot-time history."""
+
+    name = "circuit_breakers"
+
+    def compute(self, ctx: HealthContext) -> HealthIndicatorResult:
+        if ctx.breaker_service is None:
+            return HealthIndicatorResult(
+                name=self.name, status=HealthStatus.UNKNOWN,
+                symptom="no breaker service wired")
+        stats = ctx.breaker_service.stats()
+        recent_trips = 0.0
+        if ctx.history is not None:
+            recent_trips = ctx.history.delta_total(
+                "breaker.tripped", HEALTH_RATE_WINDOW_S)
+        hot = []          # breakers at/over the used-ratio watermark
+        for bname, b in sorted(stats.items()):
+            limit = b.get("limit_size_in_bytes", -1)
+            used = b.get("estimated_size_in_bytes", 0)
+            if limit and limit > 0 and used / limit >= BREAKER_USED_YELLOW:
+                hot.append(bname)
+        details = {
+            "recent_trips": recent_trips,
+            "window_s": HEALTH_RATE_WINDOW_S,
+            "breakers": {bname: dict(b) for bname, b in sorted(stats.items())},
+        }
+        impacts: List[Impact] = []
+        diagnoses: List[Diagnosis] = []
+        if recent_trips >= BREAKER_TRIPS_RED:
+            status = HealthStatus.RED
+            symptom = (f"circuit breakers tripped {int(recent_trips)} "
+                       f"time(s) in the last {int(HEALTH_RATE_WINDOW_S)}s")
+        elif recent_trips > 0 or hot:
+            status = HealthStatus.YELLOW
+            symptom = ("memory pressure: "
+                       + (f"{int(recent_trips)} recent trip(s)"
+                          if recent_trips > 0
+                          else f"breakers near limit: {', '.join(hot)}"))
+        else:
+            status = HealthStatus.GREEN
+            symptom = "no recent breaker trips and headroom on all breakers"
+        if status != HealthStatus.GREEN:
+            impacts.append(Impact(
+                id="requests_rejected", severity=2,
+                description="requests over the memory budget are rejected "
+                            "with 429/circuit_breaking_exception",
+                impact_areas=["search", "ingest"]))
+            diagnoses.append(Diagnosis(
+                id="circuit_breakers:pressure",
+                cause="memory accounting is at or over breaker limits",
+                action="reduce concurrent request sizes, raise "
+                       "indices.breaker.*.limit, or add capacity",
+                affected_resources=sorted(set(hot))))
+        return HealthIndicatorResult(
+            name=self.name, status=status, symptom=symptom,
+            details=details, impacts=impacts, diagnoses=diagnoses)
+
+
+class IndexingPressureIndicator(HealthIndicator):
+    """Rejection bursts (ring delta) + live coordinating-memory ratio."""
+
+    name = "indexing_pressure"
+
+    def compute(self, ctx: HealthContext) -> HealthIndicatorResult:
+        if ctx.indexing_pressure is None:
+            return HealthIndicatorResult(
+                name=self.name, status=HealthStatus.UNKNOWN,
+                symptom="no indexing pressure tracker wired")
+        stats = ctx.indexing_pressure.stats()
+        mem = stats.get("memory", {})
+        total = mem.get("total", {})
+        limit = stats.get("limit_in_bytes") or mem.get("limit_in_bytes", 0)
+        current = sum(v for k, v in mem.get("current", {}).items()
+                      if isinstance(v, (int, float)))
+        recent_rejections = 0.0
+        if ctx.history is not None:
+            recent_rejections = ctx.history.delta_total(
+                "indexing_pressure.rejections", HEALTH_RATE_WINDOW_S)
+        lifetime_rejections = sum(
+            v for k, v in total.items() if k.endswith("_rejections"))
+        details = {
+            "recent_rejections": recent_rejections,
+            "window_s": HEALTH_RATE_WINDOW_S,
+            "lifetime_rejections": lifetime_rejections,
+            "current_bytes": current,
+            "limit_bytes": limit,
+        }
+        impacts: List[Impact] = []
+        diagnoses: List[Diagnosis] = []
+        saturated = bool(limit) and current / limit >= PRESSURE_USED_YELLOW
+        if recent_rejections >= REJECTIONS_RED:
+            status = HealthStatus.RED
+            symptom = (f"{int(recent_rejections)} indexing rejection(s) in "
+                       f"the last {int(HEALTH_RATE_WINDOW_S)}s")
+        elif recent_rejections > 0 or saturated:
+            status = HealthStatus.YELLOW
+            symptom = ("indexing memory under pressure"
+                       if saturated else
+                       f"{int(recent_rejections)} recent indexing "
+                       "rejection(s)")
+        else:
+            status = HealthStatus.GREEN
+            symptom = "no recent indexing rejections"
+        if status != HealthStatus.GREEN:
+            impacts.append(Impact(
+                id="writes_rejected", severity=2,
+                description="bulk/index requests are shed with 429; "
+                            "clients must back off and retry",
+                impact_areas=["ingest"]))
+            diagnoses.append(Diagnosis(
+                id="indexing_pressure:saturation",
+                cause="indexing memory in flight is at the configured "
+                      "limit, shedding load",
+                action="slow producers, shrink bulk sizes, or raise "
+                       "indexing_pressure.memory.limit",
+                affected_resources=[]))
+        return HealthIndicatorResult(
+            name=self.name, status=status, symptom=symptom,
+            details=details, impacts=impacts, diagnoses=diagnoses)
+
+
+class TaskBacklogIndicator(HealthIndicator):
+    """Task-manager backlog depth and cancellation storms (PR-5)."""
+
+    name = "task_backlog"
+
+    def compute(self, ctx: HealthContext) -> HealthIndicatorResult:
+        if ctx.task_manager is None:
+            return HealthIndicatorResult(
+                name=self.name, status=HealthStatus.UNKNOWN,
+                symptom="no task manager wired")
+        stats = ctx.task_manager.stats()
+        current = stats.get("current", 0)
+        recent_cancels = 0.0
+        if ctx.history is not None:
+            recent_cancels = ctx.history.delta_total(
+                "tasks.cancelled", HEALTH_RATE_WINDOW_S)
+        details = {
+            "current": current,
+            "peak_concurrent": stats.get("peak_concurrent", 0),
+            "recent_cancellations": recent_cancels,
+            "window_s": HEALTH_RATE_WINDOW_S,
+            "bans": stats.get("bans", 0),
+        }
+        impacts: List[Impact] = []
+        diagnoses: List[Diagnosis] = []
+        if recent_cancels >= CANCEL_STORM_RED:
+            status = HealthStatus.RED
+            symptom = (f"cancellation storm: {int(recent_cancels)} "
+                       f"task(s) cancelled in the last "
+                       f"{int(HEALTH_RATE_WINDOW_S)}s")
+        elif recent_cancels >= CANCEL_STORM_YELLOW or \
+                current >= TASK_BACKLOG_YELLOW:
+            status = HealthStatus.YELLOW
+            symptom = (f"task backlog: {current} live task(s)"
+                       if current >= TASK_BACKLOG_YELLOW else
+                       f"{int(recent_cancels)} recent cancellation(s)")
+        else:
+            status = HealthStatus.GREEN
+            symptom = f"{current} live task(s), no cancellation storms"
+        if status != HealthStatus.GREEN:
+            impacts.append(Impact(
+                id="work_queueing", severity=3,
+                description="requests queue behind a deep task backlog "
+                            "or are being mass-cancelled",
+                impact_areas=["search", "ingest"]))
+            diagnoses.append(Diagnosis(
+                id="task_backlog:congestion",
+                cause="more concurrent work than the node is draining, "
+                      "or clients are cancelling en masse",
+                action="inspect GET /_tasks for the dominant action and "
+                       "throttle its source",
+                affected_resources=[]))
+        return HealthIndicatorResult(
+            name=self.name, status=status, symptom=symptom,
+            details=details, impacts=impacts, diagnoses=diagnoses)
+
+
+class RecoveryProgressIndicator(HealthIndicator):
+    """Recovery stages (PR-12) + watchdog stall findings: a recovery
+    that exists is yellow-at-worst; one that stopped moving bytes is
+    red via the watchdog verdict."""
+
+    name = "recovery_progress"
+
+    def compute(self, ctx: HealthContext) -> HealthIndicatorResult:
+        recoveries = ctx.recoveries or {}
+        by_stage: Dict[str, int] = {}
+        failed = []
+        live = 0
+        for rec in recoveries.values():
+            by_stage[rec.stage] = by_stage.get(rec.stage, 0) + 1
+            if rec.stage == "failed":
+                failed.append(f"{rec.index}[{rec.shard_id}]")
+            elif rec.stage not in ("done", "cancelled"):
+                live += 1
+        stalls = []
+        if ctx.watchdog is not None:
+            stalls = [f for f in ctx.watchdog.findings()
+                      if f.get("kind") == "recovery"]
+        details = {
+            "recoveries_by_stage": dict(sorted(by_stage.items())),
+            "live": live,
+            "failed": sorted(failed),
+            "stalled": [
+                {"resource": f["resource"], "stalled_for_s": f["stalled_for_s"]}
+                for f in stalls],
+        }
+        impacts: List[Impact] = []
+        diagnoses: List[Diagnosis] = []
+        if stalls:
+            status = HealthStatus.RED
+            symptom = f"{len(stalls)} recovery(ies) stalled (no byte progress)"
+            impacts.append(Impact(
+                id="recovery_stalled", severity=2,
+                description="shard copies are not converging; redundancy "
+                            "and relocation are stuck",
+                impact_areas=["availability"]))
+            diagnoses.append(Diagnosis(
+                id="recovery_progress:stalled",
+                cause="a recovery transferred no bytes for longer than "
+                      "the watchdog threshold (source node down or "
+                      "transfer wedged)",
+                action="check source/target node liveness; cancel and "
+                       "re-allocate via _cluster/reroute",
+                affected_resources=sorted(f["resource"] for f in stalls)))
+        elif failed:
+            status = HealthStatus.YELLOW
+            symptom = f"{len(failed)} recovery(ies) failed"
+            diagnoses.append(Diagnosis(
+                id="recovery_progress:failed",
+                cause="recoveries ended in failure and await re-allocation",
+                action="inspect GET /_recovery for the failure, then "
+                       "reroute",
+                affected_resources=sorted(failed)))
+        elif live:
+            status = HealthStatus.YELLOW
+            symptom = f"{live} recovery(ies) in progress"
+        else:
+            status = HealthStatus.GREEN
+            symptom = "no active recoveries"
+        return HealthIndicatorResult(
+            name=self.name, status=status, symptom=symptom,
+            details=details, impacts=impacts, diagnoses=diagnoses)
+
+
+class DeviceEngineIndicator(HealthIndicator):
+    """Engine/device health: compile-storm rate (ring), HBM watermark
+    vs limit (PR-4 hbm breaker), and mesh ``fallback.*`` ratios (PR-9)."""
+
+    name = "device_engine"
+
+    def compute(self, ctx: HealthContext) -> HealthIndicatorResult:
+        compile_per_min = 0.0
+        if ctx.history is not None:
+            compile_per_min = 60.0 * ctx.history.rate(
+                "engine.compile.count", HEALTH_RATE_WINDOW_S)
+        hbm_ratio = 0.0
+        if ctx.breaker_service is not None:
+            hbm = ctx.breaker_service.stats().get("hbm", {})
+            limit = hbm.get("limit_size_in_bytes", -1)
+            if limit and limit > 0:
+                hbm_ratio = hbm.get("estimated_size_in_bytes", 0) / limit
+        fallback_ratio = 0.0
+        mesh_enabled = False
+        if ctx.mesh_stats:
+            mesh_enabled = bool(ctx.mesh_stats.get("enabled"))
+            counters = ctx.mesh_stats.get("counters", {})
+            dispatches = sum(v for k, v in counters.items()
+                             if k.startswith("dispatch."))
+            fallbacks = sum(v for k, v in counters.items()
+                            if k.startswith("fallback."))
+            if dispatches + fallbacks > 0:
+                fallback_ratio = fallbacks / (dispatches + fallbacks)
+        details = {
+            "compiles_per_min": compile_per_min,
+            "hbm_used_ratio": round(hbm_ratio, 4),
+            "mesh_enabled": mesh_enabled,
+            "mesh_fallback_ratio": round(fallback_ratio, 4),
+        }
+        if ctx.engine_totals:
+            details["compile_totals"] = {
+                "count": ctx.engine_totals.get("count", 0),
+                "ms": ctx.engine_totals.get("ms", 0),
+                "cache_hits": ctx.engine_totals.get("cache_hits", 0),
+            }
+        problems = []
+        if compile_per_min >= COMPILE_STORM_PER_MIN:
+            problems.append("compile_storm")
+        if hbm_ratio >= HBM_USED_YELLOW:
+            problems.append("hbm_watermark")
+        if mesh_enabled and fallback_ratio >= MESH_FALLBACK_YELLOW:
+            problems.append("mesh_fallbacks")
+        impacts: List[Impact] = []
+        diagnoses: List[Diagnosis] = []
+        if "compile_storm" in problems:
+            status = HealthStatus.RED if compile_per_min >= \
+                2 * COMPILE_STORM_PER_MIN else HealthStatus.YELLOW
+            symptom = (f"compile storm: {compile_per_min:.1f} fresh "
+                       "compiles/min")
+            diagnoses.append(Diagnosis(
+                id="device_engine:compile_storm",
+                cause="query shapes are missing the bucketed jit caches, "
+                      "forcing fresh XLA compiles per request",
+                action="inspect GET /_kernels for the churning entry "
+                       "point and widen its shape buckets",
+                affected_resources=[]))
+        elif problems:
+            status = HealthStatus.YELLOW
+            symptom = "device pressure: " + ", ".join(sorted(problems))
+            diagnoses.append(Diagnosis(
+                id="device_engine:pressure",
+                cause="device memory near its breaker limit and/or mesh "
+                      "dispatches falling back to the host path",
+                action="raise indices.breaker.hbm.limit, shrink resident "
+                       "segments, or check mesh fallback counters",
+                affected_resources=sorted(problems)))
+        else:
+            status = HealthStatus.GREEN
+            symptom = "engine compiling within budget, HBM has headroom"
+        if status != HealthStatus.GREEN:
+            impacts.append(Impact(
+                id="latency_degraded", severity=3,
+                description="searches pay compile/eviction/fallback "
+                            "latency instead of the fused device path",
+                impact_areas=["search"]))
+        return HealthIndicatorResult(
+            name=self.name, status=status, symptom=symptom,
+            details=details, impacts=impacts, diagnoses=diagnoses)
+
+
+# the registry ESTPU-HEALTH01 pins: every HealthIndicator subclass in
+# health/ must appear here, or the linter flags the class definition
+DEFAULT_INDICATORS = (
+    ShardsAvailabilityIndicator,
+    CircuitBreakerIndicator,
+    IndexingPressureIndicator,
+    TaskBacklogIndicator,
+    RecoveryProgressIndicator,
+    DeviceEngineIndicator,
+)

@@ -10,9 +10,10 @@ from __future__ import annotations
 
 from typing import Dict
 
-from elasticsearch_tpu.lint.rules import det, errors, jit, pair, shape
+from elasticsearch_tpu.lint.rules import (
+    det, errors, health, jit, pair, shape)
 
-ALL_RULE_MODULES = (jit, pair, det, shape, errors)
+ALL_RULE_MODULES = (jit, pair, det, shape, errors, health)
 
 # the linter's own meta-rule (undocumented pragmas), reported by core
 META_RULES: Dict[str, str] = {

@@ -27,8 +27,8 @@ RULES = {
 }
 
 SCOPED_DIRS = ("cluster/", "transport/", "testing/", "rest/",
-               "snapshots/", "xpack/")
-SCOPED_FILES = ("search/async_search.py",)
+               "snapshots/", "xpack/", "health/")
+SCOPED_FILES = ("search/async_search.py", "telemetry/history.py")
 
 # time-module functions that read the wall clock (monotonic and
 # perf_counter are interval sources and stay behind clock= seams whose

@@ -1,0 +1,85 @@
+"""ESTPU-HEALTH — health-indicator registration.
+
+The health report renders exactly the indicators listed in
+``health/indicators.py DEFAULT_INDICATORS``. A ``HealthIndicator``
+subclass that never lands in that registry is a silent hole in the
+diagnostic surface: it imports, it unit-tests, and ``GET
+/_health_report`` never shows it. The invariant ships as a rule (per
+the PR-11 convention: invariants are lint rules with fixtures, not
+prose): every concrete indicator class defined under ``health/`` must
+appear in a ``DEFAULT_INDICATORS`` assignment in some ``health/``
+module.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set, Tuple
+
+from elasticsearch_tpu.lint.core import LintModule, Violation
+from elasticsearch_tpu.lint.registry import ProjectIndex
+
+RULES = {
+    "ESTPU-HEALTH01": ("HealthIndicator subclass not registered in "
+                       "DEFAULT_INDICATORS"),
+}
+
+_BASE = "HealthIndicator"
+_REGISTRY = "DEFAULT_INDICATORS"
+
+
+def _is_indicator_base(base: ast.expr) -> bool:
+    return (isinstance(base, ast.Name) and base.id == _BASE) or \
+        (isinstance(base, ast.Attribute) and base.attr == _BASE)
+
+
+def _registered_names(modules: List[LintModule]) -> Set[str]:
+    """Class names listed in any health/ module's DEFAULT_INDICATORS
+    tuple/list (bare names or instantiating calls)."""
+    out: Set[str] = set()
+    for mod in modules:
+        if not mod.rel.startswith("health/"):
+            continue
+        for node in ast.walk(mod.tree):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            if not any(isinstance(t, ast.Name) and t.id == _REGISTRY
+                       for t in targets):
+                continue
+            if isinstance(value, (ast.Tuple, ast.List)):
+                for elt in value.elts:
+                    if isinstance(elt, ast.Name):
+                        out.add(elt.id)
+                    elif isinstance(elt, ast.Call) and \
+                            isinstance(elt.func, ast.Name):
+                        out.add(elt.func.id)
+    return out
+
+
+def run(modules: List[LintModule],
+        index: ProjectIndex) -> Tuple[List[Violation], int]:
+    registered = _registered_names(modules)
+    vs: List[Violation] = []
+    for mod in modules:
+        if not mod.rel.startswith("health/"):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if not any(_is_indicator_base(b) for b in node.bases):
+                continue
+            if node.name in registered:
+                continue
+            vs.append(Violation(
+                "ESTPU-HEALTH01", mod.rel, node.lineno, node.col_offset,
+                f"indicator class {node.name} is not listed in "
+                f"{_REGISTRY} — it will never appear in "
+                f"GET /_health_report"))
+    return vs, 0

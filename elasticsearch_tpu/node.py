@@ -75,7 +75,11 @@ class Node:
             node=self.name,
             max_traces=int(settings.get("telemetry.traces.max", 128)),
             max_spans_per_trace=int(
-                settings.get("telemetry.traces.max_spans", 512)))
+                settings.get("telemetry.traces.max_spans", 512)),
+            history_interval=float(
+                settings.get("telemetry.history.interval", 10.0)),
+            history_retention=float(
+                settings.get("telemetry.history.retention", 600.0)))
         # breaker trips + indexing-pressure rejections feed the node
         # metrics registry (`breaker.*` / `indexing_pressure.*`)
         self.breaker_service.metrics = self.telemetry.metrics
@@ -102,6 +106,39 @@ class Node:
         # gauge feed the node metrics registry
         self.task_manager = TaskManager(self.node_id,
                                         metrics=self.telemetry.metrics)
+        # health & diagnostics: the single-node slice of the cluster
+        # health surface (GET /_health_report) — no routing table here,
+        # so shards_availability reports green-by-construction; the
+        # watchdog sweeps lazily per report (no scheduler on this node)
+        from elasticsearch_tpu.health import (
+            HealthContext, HealthService, StalledProgressWatchdog)
+        from elasticsearch_tpu.health import watchdog as _watchdog_mod
+        self.health_watchdog = StalledProgressWatchdog(
+            clock=self.telemetry.metrics.clock,
+            metrics=self.telemetry.metrics,
+            tasks_fn=self.task_manager.list_tasks,
+            stall_after_s=float(settings.get(
+                "health.watchdog.stall_after",
+                _watchdog_mod.DEFAULT_STALL_AFTER_S)),
+            task_deadline_s=float(settings.get(
+                "health.watchdog.task_deadline",
+                _watchdog_mod.DEFAULT_TASK_DEADLINE_S)))
+
+        def _health_context(_self=self):
+            from elasticsearch_tpu.telemetry import engine as _engine
+            return HealthContext(
+                node_id=_self.node_id,
+                now=_self.telemetry.metrics.clock,
+                metrics=_self.telemetry.metrics,
+                history=_self.telemetry.history,
+                breaker_service=_self.breaker_service,
+                indexing_pressure=_self.indexing_pressure,
+                task_manager=_self.task_manager,
+                engine_totals=_engine.TRACKER.totals(),
+                mesh_stats=_self.search_service.mesh_executor.stats(),
+                watchdog=_self.health_watchdog)
+
+        self.health = HealthService(context_fn=_health_context)
         # completed background-task responses (ref: the .tasks results
         # index); bounded — oldest entries evicted beyond 256
         self.task_results: "OrderedDict[int, dict]" = OrderedDict()

@@ -155,6 +155,8 @@ def _register_all(c: RestController):
     c.register("GET", "/", root_info)
     # cluster/admin
     c.register("GET", "/_cluster/health", cluster_health)
+    c.register("GET", "/_health_report", health_report)
+    c.register("GET", "/_health_report/{indicator}", health_report)
     c.register("GET", "/_cluster/pending_tasks", cluster_pending_tasks)
     c.register("GET", "/_cluster/stats", cluster_stats)
     c.register("GET", "/_nodes/stats", nodes_stats)
@@ -574,25 +576,62 @@ def _pending_cluster_tasks(node):
 
 
 def cluster_health(node, params, body):
-    indices = node.indices_service.indices
-    shards = sum(idx.num_shards for idx in indices.values())
+    # status comes from the ONE shard-availability implementation the
+    # shards_availability health indicator also renders
+    # (health/indicators.py shard_availability_summary) — the two
+    # surfaces cannot drift
+    from elasticsearch_tpu.health import shard_availability_summary
+    coord = getattr(node, "coordinator", None)
+    state = coord.applied_state if coord is not None else None
+    summary = shard_availability_summary(state)
+    if state is None:
+        # single-process node: every shard is local and open — started
+        # by construction
+        shards = sum(idx.num_shards
+                     for idx in node.indices_service.indices.values())
+        summary["active_primary_shards"] = shards
+        summary["active_shards"] = shards
+    total = (summary["active_shards"] + summary["unassigned_shards"]
+             + summary["initializing_shards"])
+    pct = (100.0 * summary["active_shards"] / total) if total else 100.0
     return 200, {
         "cluster_name": node.cluster_name,
-        "status": "green",
+        "status": summary["status"],
         "timed_out": False,
         "number_of_nodes": 1,
         "number_of_data_nodes": 1,
-        "active_primary_shards": shards,
-        "active_shards": shards,
-        "relocating_shards": 0, "initializing_shards": 0,
-        "unassigned_shards": 0, "delayed_unassigned_shards": 0,
+        "active_primary_shards": summary["active_primary_shards"],
+        "active_shards": summary["active_shards"],
+        "relocating_shards": summary["relocating_shards"],
+        "initializing_shards": summary["initializing_shards"],
+        "unassigned_shards": summary["unassigned_shards"],
+        "delayed_unassigned_shards": 0,
         # real numbers: the master-service queue + live fetch-phase
         # tasks from the task manager (no more hardcoded zeros)
         "number_of_pending_tasks": len(_pending_cluster_tasks(node)),
         "number_of_in_flight_fetch": len(
             node.task_manager.list_tasks(actions="*phase/fetch*")),
-        "active_shards_percent_as_number": 100.0,
+        "active_shards_percent_as_number": pct,
     }
+
+
+def health_report(node, params, body, indicator=None):
+    """GET /_health_report[/{indicator}] — the indicator catalog's
+    verdicts (health/). Single-process: one node's local report in the
+    cluster-report shape (details nested per node), so tooling written
+    against the fan-out surface reads both."""
+    from elasticsearch_tpu.health import (
+        UnknownIndicatorError, merge_node_reports)
+    try:
+        local = node.health.local_report(indicator)
+    except UnknownIndicatorError:
+        return 400, {"error": {
+            "type": "illegal_argument_exception",
+            "reason": f"unknown health indicator [{indicator}]; one of "
+                      f"{node.health.indicator_names()}"}}
+    report = merge_node_reports({node.node_id: local})
+    report["cluster_name"] = node.cluster_name
+    return 200, report
 
 
 def cluster_stats(node, params, body):
@@ -629,9 +668,15 @@ def nodes_stats(node, params, body):
             # ThreadPool stats / ResponseCollectorService)
             "thread_pool": node.threadpool.stats(),
             # metrics registry + trace store (telemetry/): counters,
-            # gauges, latency histograms, recent slowlog entries
+            # gauges, latency histograms, recent slowlog entries;
+            # ?history=true appends the windowed time-series ring view
+            # (telemetry/history.py) — rates/deltas, not raw counters
             "telemetry": {
-                **node.telemetry.to_dict(),
+                **node.telemetry.to_dict(
+                    history=params.get("history") == "true",
+                    history_window=(float(params["history_window"])
+                                    if params.get("history_window")
+                                    else None)),
                 "slowlog_recent":
                     list(node.search_service.slowlog_recent)[-16:],
             },
@@ -766,7 +811,13 @@ def cat_indices(node, params, body):
 
 
 def cat_health(node, params, body):
-    return 200, {"_cat": f"{int(time.time())} {node.cluster_name} green 1 1"}
+    # same status source as _cluster/health (and the shards_availability
+    # indicator): cat_health is a projection of cluster_health, not a
+    # second implementation
+    _, h = cluster_health(node, params, body)
+    return 200, {"_cat": f"{int(time.time())} {node.cluster_name} "
+                         f"{h['status']} {h['number_of_nodes']} "
+                         f"{h['number_of_data_nodes']}"}
 
 
 def cat_count(node, params, body):

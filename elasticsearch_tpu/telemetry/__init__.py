@@ -24,20 +24,34 @@ from elasticsearch_tpu.telemetry.metrics import (  # noqa: F401
     Histogram,
     MetricsRegistry,
 )
+from elasticsearch_tpu.telemetry.history import (  # noqa: F401
+    DEFAULT_INTERVAL_S,
+    DEFAULT_RETENTION_S,
+    MetricsHistory,
+)
 from elasticsearch_tpu.telemetry.tracing import Span, Tracer  # noqa: F401
 
 
 class Telemetry:
-    """Metrics + tracer on one clock; the node-level handle."""
+    """Metrics + tracer + history ring on one clock; the node-level
+    handle."""
 
     def __init__(self, node: str = "",
                  clock: Optional[Callable[[], float]] = None,
                  max_traces: int = 128,
-                 max_spans_per_trace: int = 512):
+                 max_spans_per_trace: int = 512,
+                 history_interval: float = DEFAULT_INTERVAL_S,
+                 history_retention: float = DEFAULT_RETENTION_S):
         self.node = node
         self.metrics = MetricsRegistry(clock=clock)
         self.tracer = Tracer(clock=clock, node=node, max_traces=max_traces,
                              max_spans_per_trace=max_spans_per_trace)
+        # bounded time-series ring over the registry's scalars; lazy by
+        # default (advance() on read paths), start(scheduler) for the
+        # opt-in active sweep — see telemetry/history.py
+        self.history = MetricsHistory(
+            self.metrics, self.metrics.clock,
+            interval=history_interval, retention=history_retention)
         # engine observability: this node's registry receives
         # `engine.compile.count` / `engine.compile.ms` from the
         # process-global compile tracker (telemetry/engine.py) — the
@@ -58,9 +72,11 @@ class Telemetry:
         Built once; called per search on the hot path."""
         return self._stage_sink
 
-    def to_dict(self) -> Dict[str, Any]:
-        """The `_nodes/stats` ``telemetry`` section."""
-        return {
+    def to_dict(self, history: bool = False,
+                history_window: Optional[float] = None) -> Dict[str, Any]:
+        """The `_nodes/stats` ``telemetry`` section; ``history=True``
+        (the ``?history=true`` param) appends the windowed ring view."""
+        out = {
             "metrics": self.metrics.to_dict(),
             "traces": {
                 "count": len(self.tracer._traces),
@@ -68,6 +84,10 @@ class Telemetry:
                 "dropped_spans": self.tracer.dropped_spans_total,
             },
         }
+        if history:
+            self.history.advance()
+            out["history"] = self.history.to_dict(window=history_window)
+        return out
 
 
 def wire_transport(transport, telemetry: Optional[Telemetry]) -> None:

@@ -119,7 +119,7 @@ class Histogram:
     pays one thread-local getattr."""
 
     __slots__ = ("buckets", "counts", "count", "sum", "min", "max",
-                 "exemplars", "_lock")
+                 "exemplars", "_lock", "_cum_cache", "renders")
 
     def __init__(self, buckets: Tuple[float, ...] = DEFAULT_BUCKETS_MS):
         self.buckets = tuple(buckets)
@@ -132,6 +132,11 @@ class Histogram:
         # observation that has an ambient trace
         self.exemplars: Optional[List[Optional[Tuple[float, str]]]] = None
         self._lock = threading.Lock()
+        # cached cumulative `le_*` render, invalidated by observe();
+        # `renders` counts full recomputes so tests can pin that a
+        # stats poll against a quiet histogram is O(1), not O(buckets)
+        self._cum_cache: Optional[Dict[str, int]] = None
+        self.renders = 0
 
     def observe(self, v: float) -> None:
         trace_id = _ambient_trace_id()
@@ -146,6 +151,7 @@ class Histogram:
                     idx = i
                     break
             self.counts[idx] += 1
+            self._cum_cache = None
             if trace_id is not None:
                 if self.exemplars is None:
                     self.exemplars = [None] * (len(self.buckets) + 1)
@@ -169,14 +175,19 @@ class Histogram:
         return out
 
     def to_dict(self) -> Dict[str, Any]:
-        buckets = {}
-        acc = 0
-        for b, c in zip(self.buckets, self.counts):
-            acc += c
-            buckets[f"le_{b:g}"] = acc
-        buckets["le_inf"] = acc + self.counts[-1]
+        with self._lock:
+            cum = self._cum_cache
+            if cum is None:
+                cum = {}
+                acc = 0
+                for b, c in zip(self.buckets, self.counts[:-1]):
+                    acc += c
+                    cum[f"le_{b:g}"] = acc
+                cum["le_inf"] = acc + self.counts[-1]
+                self._cum_cache = cum
+                self.renders += 1
         out = {"type": "histogram", "count": self.count, "sum": self.sum,
-               "min": self.min, "max": self.max, "buckets": buckets}
+               "min": self.min, "max": self.max, "buckets": dict(cum)}
         if self.exemplars is not None:
             out["exemplars"] = {
                 self._bucket_label(i): {"value": s[0], "trace_id": s[1]}
@@ -252,6 +263,23 @@ class MetricsRegistry:
         with self._lock:
             m = self._metrics.get(key)
         return 0 if m is None else getattr(m, "value", None)
+
+    def scalar_snapshot(self) -> Dict[Tuple[str, LabelKey], float]:
+        """One scalar per series — counters/gauges by value, histograms
+        as two derived series ``<name>.count`` / ``<name>.sum`` — the
+        O(metrics) feed for the history ring (telemetry/history.py).
+        No bucket arrays are rendered or copied, so a ring of N
+        snapshots costs O(N × metrics), not O(N × metrics × buckets)."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out: Dict[Tuple[str, LabelKey], float] = {}
+        for (name, lk), m in items:
+            if isinstance(m, Histogram):
+                out[(f"{name}.count", lk)] = float(m.count)
+                out[(f"{name}.sum", lk)] = float(m.sum)
+            else:
+                out[(name, lk)] = float(m.value)
+        return out
 
     def exemplars_of(self, name: str) -> List[Dict[str, Any]]:
         """Exemplar slots of every histogram series under ``name``

@@ -1,0 +1,28 @@
+# lint-fixture: flags=ESTPU-HEALTH01
+"""An indicator class that never lands in DEFAULT_INDICATORS: it
+imports cleanly and unit-tests green, but GET /_health_report will
+never render it — a silent hole in the diagnostic surface."""
+
+
+class HealthIndicator:
+    name = ""
+
+    def compute(self, ctx):
+        raise NotImplementedError
+
+
+class RegisteredIndicator(HealthIndicator):
+    name = "registered"
+
+    def compute(self, ctx):
+        return {"status": "green"}
+
+
+class ForgottenIndicator(HealthIndicator):  # lint-expect: ESTPU-HEALTH01
+    name = "forgotten"
+
+    def compute(self, ctx):
+        return {"status": "green"}
+
+
+DEFAULT_INDICATORS = (RegisteredIndicator,)

@@ -1,0 +1,21 @@
+# lint-fixture: flags=ESTPU-DET01
+"""A watchdog sweep that reads the wall clock directly: two replays of
+the same chaos seed compute different stall durations, so the health
+report is no longer byte-identical. ``health/`` is DET-scoped —
+progress timestamps must come through the injected scheduler clock."""
+import time
+
+
+class WallClockWatchdog:
+    def __init__(self, stall_after_s=30.0):
+        self.stall_after_s = stall_after_s
+        self.last_progress = {}
+
+    def sweep(self, recoveries):
+        now = time.time()  # lint-expect: ESTPU-DET01
+        stalled = []
+        for key in sorted(recoveries):
+            seen = self.last_progress.get(key, now)
+            if now - seen >= self.stall_after_s:
+                stalled.append(key)
+        return stalled

@@ -1,0 +1,28 @@
+# lint-fixture: passes=ESTPU-HEALTH01
+"""The registered twin of bad_unregistered_indicator.py: every
+concrete HealthIndicator subclass appears in DEFAULT_INDICATORS, so
+the catalog and the report surface cannot drift."""
+
+
+class HealthIndicator:
+    name = ""
+
+    def compute(self, ctx):
+        raise NotImplementedError
+
+
+class BreakerIndicator(HealthIndicator):
+    name = "circuit_breakers"
+
+    def compute(self, ctx):
+        return {"status": "green"}
+
+
+class BacklogIndicator(HealthIndicator):
+    name = "task_backlog"
+
+    def compute(self, ctx):
+        return {"status": "green"}
+
+
+DEFAULT_INDICATORS = (BreakerIndicator, BacklogIndicator)

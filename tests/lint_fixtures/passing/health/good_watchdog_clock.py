@@ -1,0 +1,24 @@
+# lint-fixture: passes=ESTPU-DET01
+"""The injectable twin of bad_watchdog_clock.py: the sweep reads the
+scheduler clock seam (the default *references* time.monotonic, never
+calls the wall clock), so stall durations replay identically from a
+chaos seed."""
+import time
+from typing import Callable, Optional
+
+
+class SeamedWatchdog:
+    def __init__(self, clock: Optional[Callable[[], float]] = None,
+                 stall_after_s: float = 30.0):
+        self.clock = clock or time.monotonic
+        self.stall_after_s = stall_after_s
+        self.last_progress = {}
+
+    def sweep(self, recoveries):
+        now = self.clock()
+        stalled = []
+        for key in sorted(recoveries):
+            seen = self.last_progress.get(key, now)
+            if now - seen >= self.stall_after_s:
+                stalled.append(key)
+        return stalled

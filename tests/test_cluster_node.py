@@ -18,7 +18,7 @@ from elasticsearch_tpu.transport.transport import DiscoveryNode
 
 
 class SimDataCluster:
-    def __init__(self, n_nodes, tmp_path, seed=0):
+    def __init__(self, n_nodes, tmp_path, seed=0, settings=None):
         self.queue = DeterministicTaskQueue(seed=seed)
         self.network = SimNetwork(self.queue)
         self.nodes = [DiscoveryNode(node_id=f"dn-{i}", name=f"dn{i}")
@@ -31,7 +31,8 @@ class SimDataCluster:
                 data_path=str(tmp_path / node.name),
                 seed_nodes=self.nodes,
                 initial_master_nodes=[n.name for n in self.nodes],
-                rng=self.queue.random)
+                rng=self.queue.random,
+                settings=settings)
             self.cluster_nodes[node.node_id] = cn
         for cn in self.cluster_nodes.values():
             cn.start()

@@ -1,0 +1,609 @@
+"""Cluster health & diagnostics (health/ + telemetry/history.py):
+indicator catalog verdicts, the metrics time-series ring that turns
+monotonic counters into storm-shaped rates, the stalled-progress
+watchdog, and the `cluster:monitor/health_report[n]` fan-out surface
+(ref strategy: the reference's HealthServiceTests /
+ShardsAvailabilityHealthIndicatorServiceTests crossed with the
+deterministic chaos simulation of AbstractCoordinatorTestCase).
+
+The chaos paths replay byte-identically from their queue seed."""
+
+import json
+
+import pytest
+
+from test_cluster_node import SimDataCluster, _index_some_docs
+
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.health import (
+    DEFAULT_INDICATORS,
+    HealthContext,
+    HealthStatus,
+    StalledProgressWatchdog,
+    merge_node_reports,
+    shard_availability_summary,
+)
+from elasticsearch_tpu.health.indicators import (
+    DeviceEngineIndicator,
+    IndexingPressureIndicator,
+)
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.telemetry.history import MetricsHistory
+from elasticsearch_tpu.telemetry.metrics import MetricsRegistry, _label_key
+from elasticsearch_tpu.testing.deterministic import BLACKHOLE, DISCONNECTED
+from elasticsearch_tpu.utils.breaker import (
+    CircuitBreaker,
+    CircuitBreakingException,
+)
+
+INDICATOR_NAMES = [cls.name for cls in DEFAULT_INDICATORS]
+
+
+class _Clock:
+    """Manually-advanced clock seam for the unit-level tests."""
+
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _ring(interval=10.0, retention=600.0, t0=1000.0):
+    clock = _Clock(t0)
+    reg = MetricsRegistry(clock=clock)
+    hist = MetricsHistory(reg, clock, interval=interval,
+                          retention=retention)
+    return clock, reg, hist
+
+
+# ---------------------------------------------------------------------------
+# single-process REST surface
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(Settings.EMPTY, data_path=str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def do(node, method, path, params=None, body=None, expect=200):
+    status, resp = node.rest_controller.dispatch(method, path, params, body)
+    assert status == expect, f"{method} {path} -> {status}: {resp}"
+    return resp
+
+
+def test_health_report_green_catalog(node):
+    r = do(node, "GET", "/_health_report")
+    assert r["status"] == "green"
+    assert sorted(r["indicators"]) == sorted(INDICATOR_NAMES)
+    for name, ind in r["indicators"].items():
+        assert ind["status"] == "green", (name, ind)
+        assert ind["symptom"]
+        # fan-out shape even single-process: details nest per node
+        assert node.node_id in ind["details"]["nodes"]
+        # green verdicts carry no impacts/diagnosis noise
+        assert "impacts" not in ind and "diagnosis" not in ind
+
+
+def test_health_report_single_indicator_filter(node):
+    r = do(node, "GET", "/_health_report/circuit_breakers")
+    assert list(r["indicators"]) == ["circuit_breakers"]
+    assert r["status"] == r["indicators"]["circuit_breakers"]["status"]
+
+
+def test_health_report_unknown_indicator_400(node):
+    r = do(node, "GET", "/_health_report/no_such_thing", expect=400)
+    assert r["error"]["type"] == "illegal_argument_exception"
+    assert "no_such_thing" in r["error"]["reason"]
+
+
+def test_cluster_health_and_cat_health_share_status(node):
+    do(node, "PUT", "/books", body={
+        "settings": {"index": {"number_of_shards": 3}}})
+    h = do(node, "GET", "/_cluster/health")
+    assert h["status"] == "green"
+    assert h["active_primary_shards"] == 3
+    assert h["active_shards_percent_as_number"] == 100.0
+    cat = do(node, "GET", "/_cat/health")["_cat"]
+    # _cat/health is a projection of _cluster/health, same status token
+    assert f" {h['status']} " in cat
+    # ...and the shards_availability indicator agrees (one impl)
+    r = do(node, "GET", "/_health_report/shards_availability")
+    assert r["indicators"]["shards_availability"]["status"] == h["status"]
+
+
+def test_nodes_stats_history_param(node):
+    plain = do(node, "GET", "/_nodes/stats", params={})
+    tele = plain["nodes"][node.node_id]["telemetry"]
+    assert "history" not in tele
+    withh = do(node, "GET", "/_nodes/stats", params={"history": "true"})
+    hist = withh["nodes"][node.node_id]["telemetry"]["history"]
+    assert hist["interval_s"] > 0 and hist["capacity"] >= 2
+    assert hist["samples"] >= 1          # the read path advance()d
+    assert hist["memory_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# metrics history ring: rates vs point-in-time counters
+# ---------------------------------------------------------------------------
+
+
+def test_history_samples_on_interval_boundaries_only():
+    clock, reg, hist = _ring(interval=10.0, t0=1000.0)
+    reg.inc("x")
+    assert hist.advance() is True       # first boundary: 1000.0
+    assert hist.advance() is False      # same boundary: no-op
+    clock.advance(9.9)
+    assert hist.advance() is False      # 1009.9 // 10 == same boundary
+    clock.advance(0.2)
+    assert hist.advance() is True       # crossed 1010.0
+    assert [ts for ts, _ in hist.samples()] == [1000.0, 1010.0]
+
+
+def test_history_ring_bounded():
+    clock, reg, hist = _ring(interval=1.0, retention=5.0)
+    assert hist.capacity == 6
+    for _ in range(20):
+        clock.advance(1.0)
+        hist.advance()
+    assert len(hist.samples()) == 6
+    assert hist.memory_bytes() > 0
+
+
+def test_history_snapshots_are_scalar_only():
+    clock, reg, hist = _ring()
+    reg.inc("hits", 3)
+    reg.observe("lat_ms", 5.0)
+    reg.observe("lat_ms", 7.0)
+    hist.advance()
+    _, snap = hist.samples()[-1]
+    # histograms contribute .count/.sum scalars — never bucket arrays
+    assert snap[("lat_ms.count", _label_key({}))] == 2.0
+    assert snap[("lat_ms.sum", _label_key({}))] == 12.0
+    assert not any("bucket" in name for name, _ in snap)
+    assert all(isinstance(v, float) for v in snap.values())
+
+
+def test_history_delta_and_rate_anchor_at_newest_sample():
+    clock, reg, hist = _ring(interval=10.0)
+    reg.inc("req", 100)
+    hist.advance()                      # t=1000: 100
+    clock.advance(10)
+    reg.inc("req", 30)
+    hist.advance()                      # t=1010: 130
+    clock.advance(10)
+    reg.inc("req", 5)
+    hist.advance()                      # t=1020: 135
+    assert hist.delta("req", 60.0) == 35.0
+    assert hist.rate("req", 60.0) == pytest.approx(35.0 / 20.0)
+    # narrow window: only the last hop
+    assert hist.delta("req", 10.0) == 5.0
+    # live counter churn WITHOUT a new sample changes nothing: queries
+    # read the ring only (replay determinism)
+    reg.inc("req", 1000)
+    assert hist.delta("req", 60.0) == 35.0
+
+
+def test_history_rate_distinguishes_storm_from_boot_accumulation():
+    """The acceptance case: a point-in-time counter cannot tell '300
+    compiles ever' from '300 compiles this minute' — the ring can."""
+    clock, reg, hist = _ring(interval=10.0)
+    # an old node that compiled 300 kernels at boot
+    reg.inc("engine.compile.count", 300)
+    hist.advance()
+    clock.advance(10)
+    hist.advance()
+    ctx = HealthContext(history=hist)
+    res = DeviceEngineIndicator().safe_compute(ctx)
+    assert res.status == HealthStatus.GREEN
+    assert res.details["compiles_per_min"] == 0.0
+    assert reg.get_value("engine.compile.count") == 300  # the decoy
+    # now a real storm: 35 fresh compiles inside one sample interval
+    reg.inc("engine.compile.count", 35)
+    clock.advance(10)
+    hist.advance()
+    res = DeviceEngineIndicator().safe_compute(ctx)
+    assert res.status in (HealthStatus.YELLOW, HealthStatus.RED)
+    assert res.details["compiles_per_min"] >= 30.0
+    assert res.diagnoses[0].id == "device_engine:compile_storm"
+
+
+class _StubPressure:
+    def __init__(self, current=0, limit=10 ** 9, lifetime_rejections=112):
+        self.current = current
+        self.limit = limit
+        self.lifetime = lifetime_rejections
+
+    def stats(self):
+        return {"limit_in_bytes": self.limit,
+                "memory": {
+                    "current": {"coordinating_in_bytes": self.current},
+                    "total": {"coordinating_rejections": self.lifetime}}}
+
+
+def test_history_rejection_burst_vs_lifetime_count():
+    clock, reg, hist = _ring(interval=10.0)
+    # 100 rejections accumulated long ago (before the ring existed)
+    reg.inc("indexing_pressure.rejections", 100, stage="coordinating")
+    hist.advance()
+    clock.advance(10)
+    hist.advance()
+    ctx = HealthContext(history=hist, indexing_pressure=_StubPressure())
+    res = IndexingPressureIndicator().safe_compute(ctx)
+    assert res.status == HealthStatus.GREEN, res.symptom
+    assert res.details["lifetime_rejections"] == 112   # decoy is visible
+    # a real burst: 12 rejections inside the trailing window, spread
+    # across stages (delta_total sums label series)
+    reg.inc("indexing_pressure.rejections", 7, stage="coordinating")
+    reg.inc("indexing_pressure.rejections", 5, stage="primary")
+    clock.advance(10)
+    hist.advance()
+    res = IndexingPressureIndicator().safe_compute(ctx)
+    assert res.status == HealthStatus.RED
+    assert res.details["recent_rejections"] == 12.0
+    assert res.diagnoses[0].id == "indexing_pressure:saturation"
+    assert res.impacts[0].id == "writes_rejected"
+
+
+def test_histogram_render_cache_recomputes_only_when_dirty():
+    reg = MetricsRegistry(clock=_Clock())
+    reg.observe("lat_ms", 5.0)
+    h = reg._metrics[("lat_ms", _label_key({}))]
+    d1 = h.to_dict()
+    d2 = h.to_dict()
+    assert d1["buckets"] == d2["buckets"]
+    assert h.renders == 1               # second render served from cache
+    reg.observe("lat_ms", 50.0)
+    d3 = h.to_dict()
+    assert h.renders == 2               # dirtied -> one recompute
+    assert d3["count"] == 2
+    # cumulative le_* semantics survive the caching
+    assert all(d3["buckets"][k] <= d3["count"] for k in d3["buckets"])
+
+
+# ---------------------------------------------------------------------------
+# stalled-progress watchdog (unit)
+# ---------------------------------------------------------------------------
+
+
+class _StubTask:
+    def __init__(self, tid, clock, started_at, action="indices:data/read",
+                 profile_stage=None):
+        self.id = tid
+        self.action = action
+        self.profile_stage = profile_stage
+        self._clock = clock
+        self._started = started_at
+
+    def running_time_nanos(self):
+        return int((self._clock() - self._started) * 1e9)
+
+
+def test_watchdog_task_stall_transition_counts_once():
+    clock = _Clock(0.0)
+    reg = MetricsRegistry(clock=clock)
+    task = _StubTask(7, clock, started_at=0.0, profile_stage="fetch")
+    tasks = [task]
+    wd = StalledProgressWatchdog(
+        clock=clock, metrics=reg, tasks_fn=lambda: tasks,
+        stall_after_s=30.0, task_deadline_s=120.0)
+    clock.advance(60)
+    assert wd.sweep() == []             # under deadline: not tracked yet
+    clock.advance(61)                   # t=121: past deadline, fp recorded
+    assert wd.sweep() == []
+    clock.advance(31)                   # unchanged profile_stage for 31s
+    findings = wd.sweep()
+    assert len(findings) == 1
+    f = findings[0]
+    assert f["kind"] == "task" and f["resource"] == "task:7"
+    assert f["stalled_for_s"] >= 30.0
+    assert f["detail"]["profile_stage"] == "fetch"
+    assert reg.get_value("watchdog.stalls", kind="task") == 1
+    clock.advance(10)
+    assert len(wd.sweep()) == 1         # still stalled...
+    assert reg.get_value("watchdog.stalls", kind="task") == 1  # ...one trip
+    # progress (stage change) clears the stall
+    task.profile_stage = "reduce"
+    assert wd.sweep() == []
+    # vanished tasks stop being tracked
+    tasks.clear()
+    wd.sweep()
+    assert wd.stats()["tracked"] == 0
+
+
+def test_watchdog_state_lag_constant_vs_shrinking():
+    clock = _Clock(0.0)
+    reg = MetricsRegistry(clock=clock)
+    lags = {"dn-1": 5, "dn-2": 3}
+    wd = StalledProgressWatchdog(
+        clock=clock, metrics=reg, lag_fn=lambda: lags, stall_after_s=20.0)
+    wd.sweep()
+    clock.advance(10)
+    lags["dn-2"] = 1                    # dn-2 is catching up
+    wd.sweep()
+    clock.advance(15)                   # dn-1 constant at 5 for 25s
+    findings = wd.sweep()
+    assert [f["resource"] for f in findings] == ["dn-1"]
+    assert findings[0]["kind"] == "cluster_state_lag"
+    assert findings[0]["detail"]["versions_behind"] == 5
+    assert reg.get_value("watchdog.stalls", kind="cluster_state_lag") == 1
+    # caught-up followers (lag 0) leave tracking entirely
+    lags["dn-1"] = 0
+    lags["dn-2"] = 0
+    assert wd.sweep() == []
+    assert wd.stats()["tracked"] == 0
+
+
+# ---------------------------------------------------------------------------
+# merge_node_reports (pure-function composition)
+# ---------------------------------------------------------------------------
+
+
+def _node_report(node, status, symptom, resources=()):
+    ind = {"status": status, "symptom": symptom, "details": {"n": node}}
+    if status != "green":
+        ind["diagnosis"] = [{
+            "id": "shards_availability:replica_unassigned",
+            "cause": "c", "action": "a",
+            "affected_resources": sorted(resources)}]
+    return {"node": node, "status": status,
+            "indicators": {"shards_availability": ind}}
+
+
+def test_merge_worst_wins_and_diagnosis_resources_union():
+    merged = merge_node_reports({
+        "dn-0": _node_report("dn-0", "green", "all good"),
+        "dn-1": _node_report("dn-1", "yellow", "1 copy missing", ["idx-b"]),
+        "dn-2": _node_report("dn-2", "yellow", "2 copies missing",
+                             ["idx-a", "idx-b"]),
+    })
+    assert merged["status"] == "yellow"
+    ind = merged["indicators"]["shards_availability"]
+    # symptom from the first (sorted) node at the worst status
+    assert ind["symptom"] == "1 copy missing"
+    assert sorted(ind["details"]["nodes"]) == ["dn-0", "dn-1", "dn-2"]
+    assert ind["diagnosis"][0]["affected_resources"] == ["idx-a", "idx-b"]
+    assert "node_failures" not in merged
+
+
+def test_merge_failures_cap_green_to_unknown():
+    merged = merge_node_reports(
+        {"dn-0": _node_report("dn-0", "green", "ok")},
+        node_failures=[{"node": "dn-1", "error": "disconnected"}])
+    assert merged["status"] == "unknown"
+    assert merged["node_failures"] == [
+        {"node": "dn-1", "error": "disconnected"}]
+    # ...but real degradation is NOT masked down to unknown
+    merged = merge_node_reports(
+        {"dn-0": _node_report("dn-0", "red", "primaries down")},
+        node_failures=[{"node": "dn-1", "error": "disconnected"}])
+    assert merged["status"] == "red"
+
+
+def test_merge_is_arrival_order_independent():
+    a = _node_report("dn-0", "yellow", "y", ["i1"])
+    b = _node_report("dn-1", "red", "r", ["i2"])
+    m1 = merge_node_reports({"dn-0": a, "dn-1": b})
+    m2 = merge_node_reports({"dn-1": b, "dn-0": a})
+    assert json.dumps(m1, sort_keys=True) == json.dumps(m2, sort_keys=True)
+
+
+def test_shard_availability_summary_shapes():
+    # no routing table: green by construction (single-process node)
+    s = shard_availability_summary(None)
+    assert s["status"] == "green" and s["active_shards"] == 0
+
+
+# ---------------------------------------------------------------------------
+# multi-node chaos: fan-out, breaker squeeze, mid-recovery stall, replay
+# ---------------------------------------------------------------------------
+
+
+def _report(cluster, master, indicator=None):
+    return cluster.call(master.health_report, indicator)
+
+
+def _trip_request_breaker(cn, times=6):
+    b = cn.breaker_service.get_breaker(CircuitBreaker.REQUEST)
+    for _ in range(times):
+        with pytest.raises(CircuitBreakingException):
+            b.add_estimate_bytes_and_maybe_break(10 ** 15, "health-squeeze")
+
+
+@pytest.mark.chaos(seed=29)
+def test_fan_out_composes_three_nodes(tmp_path, chaos_seed):
+    c = SimDataCluster(3, tmp_path, seed=chaos_seed)
+    m = c.stabilise()
+    r = _report(c, m)
+    assert sorted(r["indicators"]) == sorted(INDICATOR_NAMES)
+    for name, ind in r["indicators"].items():
+        assert sorted(ind["details"]["nodes"]) == ["dn-0", "dn-1", "dn-2"], \
+            f"seed={chaos_seed}: {name} missing nodes"
+    # cluster_health reads the same availability impl as the indicator
+    c.call(m.create_index, "logs", number_of_shards=2, number_of_replicas=1)
+    c.run_for(60)
+    h = m.cluster_health()
+    assert h["status"] == "green" and h["active_shards"] == 4
+    assert h["number_of_nodes"] == 3 and h["number_of_data_nodes"] == 3
+    r = _report(c, m, "shards_availability")
+    assert r["indicators"]["shards_availability"]["status"] == h["status"]
+
+
+@pytest.mark.chaos(seed=37)
+def test_unallocatable_replicas_yellow_everywhere(tmp_path, chaos_seed):
+    c = SimDataCluster(3, tmp_path, seed=chaos_seed)
+    m = c.stabilise()
+    # 4 copies per shard on 3 nodes: one replica can never allocate
+    c.call(m.create_index, "few", number_of_shards=1, number_of_replicas=3)
+    c.run_for(90)
+    h = m.cluster_health()
+    assert h["status"] == "yellow", f"seed={chaos_seed}: {h}"
+    assert h["unassigned_shards"] == 1
+    r = _report(c, m, "shards_availability")
+    ind = r["indicators"]["shards_availability"]
+    assert ind["status"] == "yellow"
+    diag = ind["diagnosis"][0]
+    assert diag["id"] == "shards_availability:replica_unassigned"
+    assert diag["affected_resources"] == ["few"]
+    assert ind["impacts"][0]["id"] == "replica_unassigned"
+
+
+@pytest.mark.chaos(seed=7)
+def test_breaker_squeeze_red_with_pinned_diagnosis_then_recovers(
+        tmp_path, chaos_seed):
+    """Seeded breaker squeeze: the trip *rate* turns the indicator red
+    with the exact typed-diagnosis shape; once the storm leaves the
+    trailing window the indicator walks back to green on its own."""
+    c = SimDataCluster(3, tmp_path, seed=chaos_seed)
+    m = c.stabilise()
+    c.run_for(11)                       # lay a pre-squeeze ring sample
+    r = _report(c, m, "circuit_breakers")
+    assert r["status"] == "green", f"seed={chaos_seed}: {r}"
+
+    _trip_request_breaker(m, times=6)
+    c.run_for(10)                       # next sample catches the trips
+    r = _report(c, m, "circuit_breakers")
+    ind = r["indicators"]["circuit_breakers"]
+    assert ind["status"] == "red", f"seed={chaos_seed}: {ind['symptom']}"
+    assert "tripped 6 time(s)" in ind["symptom"]
+    # pinned diagnosis/impact shape — the typed contract tooling reads
+    assert ind["diagnosis"] == [{
+        "id": "circuit_breakers:pressure",
+        "cause": "memory accounting is at or over breaker limits",
+        "action": "reduce concurrent request sizes, raise "
+                  "indices.breaker.*.limit, or add capacity",
+        "affected_resources": [],
+    }], f"seed={chaos_seed}"
+    assert [i["id"] for i in ind["impacts"]] == ["requests_rejected"]
+    # the squeezed node is the red one; peers stayed green (their
+    # details carry no trips in-window)
+    det = ind["details"]["nodes"][m.local_node.node_id]
+    assert det["recent_trips"] == 6.0
+    assert m.breaker_service.get_breaker(
+        CircuitBreaker.REQUEST).used == 0   # squeeze retained no bytes
+
+    # no further trips: keep sampling until the storm ages out of the
+    # 60s window, then the verdict recovers without intervention
+    for _ in range(8):
+        c.run_for(10)
+        r = _report(c, m, "circuit_breakers")
+    assert r["status"] == "green", f"seed={chaos_seed}: {r}"
+
+
+@pytest.mark.chaos(seed=2)
+def test_node_kill_mid_recovery_trips_watchdog(tmp_path, chaos_seed):
+    """Blackhole the recovery source<->target link: bytes stop moving
+    while both nodes stay in the cluster — exactly the stall a
+    point-in-time `_recovery` view cannot see."""
+    c = SimDataCluster(3, tmp_path, seed=chaos_seed,
+                       settings={"health.watchdog.stall_after": 5.0})
+    m = c.stabilise()
+    c.call(m.create_index, "logs", number_of_shards=1, number_of_replicas=1)
+    c.run_for(60)
+    _index_some_docs(c, m, n=20)
+
+    # this seed pins the topology the fault needs: the primary (every
+    # recovery's SOURCE) on a non-master node and the replica on the
+    # master, so blackholing primary<->free-node touches neither the
+    # master's publish path nor fault detection
+    master_id = m.local_node.node_id
+    irt = c.master().state.routing_table.index("logs").shard(0)
+    src = irt.primary.current_node_id
+    occupied = sorted(s.current_node_id for s in irt.shards)
+    tgt = next(n.node_id for n in c.nodes if n.node_id not in occupied)
+    assert src != master_id and tgt != master_id, \
+        f"seed={chaos_seed} no longer pins primary/replica placement"
+    replica_holder = master_id
+
+    # cut the link FIRST, then move the replica onto the free node:
+    # the master's publish reaches the target over a healthy link, the
+    # target opens its RecoveryState and enters stage "index", and its
+    # start_recovery request to the primary vanishes — a live recovery
+    # frozen at zero bytes
+    src_node = next(n for n in c.nodes if n.node_id == src)
+    tgt_node = next(n for n in c.nodes if n.node_id == tgt)
+    c.network.isolate(src_node, [tgt_node], BLACKHOLE)
+
+    c.call(m.reroute, commands=[{"move": {
+        "index": "logs", "shard": 0,
+        "from_node": replica_holder, "to_node": tgt}}])
+    c.run_for(0.5)
+    tgt_cn = c.cluster_nodes[tgt]
+    live = [rec for rec in tgt_cn.data_node.recoveries.values()
+            if rec.stage not in ("done", "failed", "cancelled")]
+    assert live, f"seed={chaos_seed}: no live recovery on target"
+    assert live[0].recovered_bytes == 0
+
+    r1 = tgt_cn.health.local_report("recovery_progress")
+    assert r1["indicators"]["recovery_progress"]["status"] == "yellow"
+    c.run_for(6)                        # > stall_after with frozen bytes
+    r2 = tgt_cn.health.local_report("recovery_progress")
+    ind = r2["indicators"]["recovery_progress"]
+    assert ind["status"] == "red", f"seed={chaos_seed}: {ind}"
+    assert ind["diagnosis"][0]["id"] == "recovery_progress:stalled"
+    assert ind["diagnosis"][0]["affected_resources"] == ["logs[0]"]
+    stalled = ind["details"]["stalled"]
+    assert stalled and stalled[0]["resource"] == "logs[0]"
+    assert stalled[0]["stalled_for_s"] >= 5.0
+    # counter bumped exactly once, on the transition into stalled
+    assert tgt_cn.telemetry.metrics.get_value(
+        "watchdog.stalls", kind="recovery") == 1
+    tgt_cn.health.local_report("recovery_progress")
+    assert tgt_cn.telemetry.metrics.get_value(
+        "watchdog.stalls", kind="recovery") == 1
+
+    # heal: the watchdog never killed anything — the wedged
+    # start_recovery times out (120s), the copy re-allocates, and the
+    # verdict leaves red on its own
+    c.network.heal()
+    c.run_for(200)
+    r3 = tgt_cn.health.local_report("recovery_progress")
+    assert r3["indicators"]["recovery_progress"]["status"] != "red", \
+        f"seed={chaos_seed}: {r3}"
+
+
+@pytest.mark.chaos(seed=41)
+def test_fan_out_node_failures_for_unreachable_node(tmp_path, chaos_seed):
+    c = SimDataCluster(3, tmp_path, seed=chaos_seed)
+    m = c.stabilise()
+    victim = next(n for n in c.nodes
+                  if n.node_id != m.local_node.node_id)
+    c.network.isolate(
+        victim, [n for n in c.nodes if n.node_id != victim.node_id],
+        DISCONNECTED)
+    r = _report(c, m)
+    assert [f["node"] for f in r["node_failures"]] == [victim.node_id], \
+        f"seed={chaos_seed}: {r.get('node_failures')}"
+    # two nodes answered; the hole caps confidence below green
+    assert r["status"] == "unknown"
+    for ind in r["indicators"].values():
+        assert victim.node_id not in ind["details"]["nodes"]
+
+
+@pytest.mark.chaos(seed=23)
+def test_same_seed_health_reports_byte_identical(tmp_path, chaos_seed):
+    """Two runs of the same seeded scenario render the same report
+    bytes. device_engine is excluded: its compile totals read the
+    process-global XLA tracker, which is interpreter state shared
+    across runs in one process, not seed state."""
+
+    def run_once(root):
+        c = SimDataCluster(3, root, seed=chaos_seed)
+        m = c.stabilise()
+        c.call(m.create_index, "logs",
+               number_of_shards=2, number_of_replicas=1)
+        c.run_for(60)
+        _index_some_docs(c, m, n=10)
+        _trip_request_breaker(m, times=6)
+        c.run_for(12)
+        r = _report(c, m)
+        r["indicators"].pop("device_engine")
+        return json.dumps(r, sort_keys=True)
+
+    assert run_once(tmp_path / "a") == run_once(tmp_path / "b")
