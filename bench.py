@@ -79,7 +79,7 @@ def remaining_budget() -> float:
 def emit(metric_text: str, value: float, vs_baseline: float,
          engine=None, overload=None, tasks=None, cpu=None,
          serving=None, skipped=None, aggs=None, multichip=None,
-         lint=None, recovery=None, health=None):
+         lint=None, recovery=None, health=None, upgrade=None):
     _LAST_PAYLOAD.clear()
     _LAST_PAYLOAD.update({
         "metric": metric_text,
@@ -156,6 +156,13 @@ def emit(metric_text: str, value: float, vs_baseline: float,
         # and the history ring's residency — the round records its
         # diagnostic surface's verdicts next to the qps they guard
         _LAST_PAYLOAD["health"] = health
+    if upgrade:
+        # rolling-upgrade rider (cluster/node.py shutdown plane in the
+        # deterministic sim): per-node bounce wall-clock, delayed vs
+        # reallocated shard counts, searches served through each
+        # bounce, and the zero-acked-loss verdict — a regression in
+        # graceful restart shows here before it costs a real upgrade
+        _LAST_PAYLOAD["upgrade"] = upgrade
     print(json.dumps(_LAST_PAYLOAD), flush=True)
 
 
@@ -1678,6 +1685,144 @@ def run_health_cpu(seed=7):
         }
 
 
+def run_upgrade_cpu(seed=11):
+    """Rolling-upgrade rider (CPU-side, deterministic sim — no jax):
+    boots a 3-node sim cluster, indexes a seed corpus, then gracefully
+    bounces every node in turn — restart shutdown marker, stop, restart
+    over the same data dir — with bulks and searches running through
+    each bounce. Banks per-node bounce wall-clock (virtual seconds),
+    delayed-vs-reallocated shard counts, searches served during each
+    bounce, and the zero-acked-loss verdict into the BENCH json
+    `upgrade` section BEFORE any backend touch. Replay-stable: seeded
+    queue + virtual clock render the same rows every round."""
+    import tempfile
+
+    from elasticsearch_tpu.cluster.node import ClusterNode
+    from elasticsearch_tpu.cluster.state import SHARD_STARTED
+    from elasticsearch_tpu.testing.deterministic import (
+        CONNECTED, DISCONNECTED, DeterministicTaskQueue,
+        DisruptableTransport, SimNetwork)
+    from elasticsearch_tpu.transport.transport import DiscoveryNode
+
+    t_host = time.time()
+    with tempfile.TemporaryDirectory() as tmp:
+        queue = DeterministicTaskQueue(seed=seed)
+        network = SimNetwork(queue)
+        nodes = [DiscoveryNode(node_id=f"un-{i}", name=f"un{i}")
+                 for i in range(3)]
+        cluster = {}
+
+        def boot(node):
+            cn = ClusterNode(
+                DisruptableTransport(node, network), queue,
+                data_path=os.path.join(tmp, node.name),
+                seed_nodes=nodes,
+                initial_master_nodes=[n.name for n in nodes],
+                rng=queue.random)
+            cluster[node.node_id] = cn
+            cn.start()
+            return cn
+
+        for node in nodes:
+            boot(node)
+
+        def call(fn, *args, **kwargs):
+            box = {}
+            fn(*args, **kwargs,
+               on_done=lambda r, e=None: box.update(r=r, e=e))
+            for _ in range(120):
+                if box:
+                    break
+                queue.run_for(1.0)
+            if box.get("e") is not None:
+                raise RuntimeError(box["e"])
+            return box.get("r")
+
+        def master():
+            return next(cn for cn in cluster.values()
+                        if cn.is_master())
+
+        queue.run_for(60)
+        call(master().create_index, "bench", number_of_shards=2,
+             number_of_replicas=2)
+        queue.run_for(60)
+        items = [{"op": "index", "id": f"seed-{i}",
+                  "source": {"body": f"seed doc {i}"}}
+                 for i in range(40)]
+        call(master().bulk, "bench", items)
+        acked, submitted = 40, 40
+
+        bounces = []
+        master_id = master().local_node.node_id
+        order = sorted(nid for nid in cluster if nid != master_id)
+        order.append(master_id)
+        for step, vid in enumerate(order):
+            t0 = queue.now()
+            call(master().put_node_shutdown, vid, "restart",
+                 allocation_delay="600s")
+            cn = cluster.pop(vid)
+            cn.stop()
+            down = cn.local_node
+            for other in nodes:
+                network.set_link(down, other, DISCONNECTED)
+            queue.run_for(20)
+            coord = cluster[sorted(cluster)[0]]
+            state = master().state
+            delayed = sum(1 for s in state.routing_table.all_shards()
+                          if s.delayed)
+            searches = 0
+            for q in ("seed", "doc", "bench"):
+                r = call(coord.search, "bench",
+                         {"query": {"match": {"body": q}}, "size": 5})
+                if r["_shards"]["failed"] == 0:
+                    searches += 1
+            mid = [{"op": "index", "id": f"mid-{step}-{i}",
+                    "source": {"body": f"mid doc {i}"}}
+                   for i in range(5)]
+            resp = call(coord.bulk, "bench", mid)
+            submitted += 5
+            acked += sum(1 for it in resp["items"]
+                         if it and "error" not in it)
+            for other in nodes:
+                network.set_link(down, other, CONNECTED)
+            back = boot(down)
+            queue.run_for(60)
+            state = master().state
+            reattached = sum(
+                1 for r in back.data_node.recoveries.values()
+                if r.recovery_type == "existing_store")
+            reallocated = sum(
+                1 for r in back.data_node.recoveries.values()
+                if r.recovery_type != "existing_store")
+            bounces.append({
+                "node": down.name,
+                "was_master": vid == master_id,
+                "wall_s": round(queue.now() - t0, 1),
+                "delayed_shards": delayed,
+                "reattached": reattached,
+                "reallocated": reallocated,
+                "searches_served": searches,
+            })
+
+        call(master().refresh)
+        r = call(master().search, "bench",
+                 {"query": {"match_all": {}}, "size": 0})
+        total = r["hits"]["total"]["value"]
+        started = [s for s in
+                   master().state.routing_table.all_shards()
+                   if s.state == SHARD_STARTED]
+        for cn in cluster.values():
+            cn.stop()
+        return {
+            "bounces": bounces,
+            "acked_writes": acked,
+            "docs_after": total,
+            "zero_acked_loss": bool(total == acked == submitted),
+            "active_shards_after": len(started),
+            "host_s": round(time.time() - t_host, 1),
+        }
+
+
 # ---------------------------------------------------------------------------
 # Multi-chip serving rows (ISSUE 9): qps at 1/2/4/8 devices for the two
 # mesh serving modes — sharded-corpus (one SPMD fan-out/merge program per
@@ -2072,7 +2217,8 @@ def main():
              multichip=parts.get("multichip"),
              lint=parts.get("lint"),
              recovery=parts.get("recovery"),
-             health=parts.get("health"))
+             health=parts.get("health"),
+             upgrade=parts.get("upgrade"))
 
     # estpu-lint preflight: static contract scan of the whole package
     # (stdlib ast, ~2s, no device). Summary rides every BENCH line so
@@ -2143,6 +2289,13 @@ def main():
         parts["health"] = run_health_cpu()
     except Exception as e:  # noqa: BLE001 — the rider must not sink
         log(f"health rider failed: {e!r}")
+    # rolling-upgrade rows (deterministic sim, no jax): graceful
+    # node bounces under live traffic — delayed-allocation counts,
+    # reattach-vs-copy split, and the zero-acked-loss verdict
+    try:
+        parts["upgrade"] = run_upgrade_cpu()
+    except Exception as e:  # noqa: BLE001 — the rider must not sink
+        log(f"upgrade rider failed: {e!r}")
     # ALL CPU-side rows land before ANY jax/backend touch: a dead
     # relay hangs even backend INIT uninterruptibly (observed: hours),
     # and a run killed there must still have parsed output on record

@@ -18,11 +18,17 @@ import uuid
 from dataclasses import replace
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
+from elasticsearch_tpu.cluster.shutdown import (
+    INDEX_DELAYED_TIMEOUT_SETTING,
+    parse_time_s,
+)
 from elasticsearch_tpu.cluster.state import (
     SHARD_INITIALIZING,
     SHARD_RELOCATING,
     SHARD_STARTED,
     SHARD_UNASSIGNED,
+    SHUTDOWN_REMOVE,
+    SHUTDOWN_RESTART,
     ClusterState,
     IndexMetadata,
     IndexRoutingTable,
@@ -45,9 +51,15 @@ CLUSTER_EXCLUDE_SETTING = "cluster.routing.allocation.exclude._id"
 
 def excluded_node_tokens(state: ClusterState) -> Set[str]:
     raw = state.metadata.persistent_settings.get(CLUSTER_EXCLUDE_SETTING)
-    if not raw:
-        return set()
-    return {t.strip() for t in str(raw).split(",") if t.strip()}
+    tokens = {t.strip() for t in str(raw).split(",") if t.strip()} \
+        if raw else set()
+    # a registered `remove` shutdown drains exactly like the exclude
+    # filter (ref: NodeShutdownAllocationDecider — nothing may be
+    # allocated to a node being removed, reroute evacuates it)
+    for node_id, marker in state.metadata.node_shutdowns.items():
+        if marker.type == SHUTDOWN_REMOVE:
+            tokens.add(node_id)
+    return tokens
 
 
 def _node_tokens(state: ClusterState, node_id: str) -> Set[str]:
@@ -189,8 +201,12 @@ class AllocationService:
     """Ref: AllocationService.java — reroute + shard started/failed
     appliers. Owned by the master; results published as cluster state."""
 
-    def __init__(self, deciders: Optional[List[AllocationDecider]] = None):
+    def __init__(self, deciders: Optional[List[AllocationDecider]] = None,
+                 clock: Optional[Callable[[], float]] = None):
         self.deciders = deciders or default_deciders()
+        # scheduler clock (ESTPU-DET) driving delayed-unassigned
+        # deadlines; without one, node-left is always immediate
+        self.clock = clock
         # (index, shard, primary) -> consecutive failures
         self.failure_counts: Dict[Tuple, int] = {}
 
@@ -208,11 +224,13 @@ class AllocationService:
         # drop assignments to nodes that left, unwinding half-finished
         # relocation pairs along the way
         live = set(n.node_id for n in state.nodes.nodes)
+        now = self.clock() if self.clock is not None else None
         changed = False
         new_indices: Dict[str, Dict[int, List[ShardRouting]]] = {}
         for index, irt in state.routing_table.indices.items():
             for sid, table in irt.shards.items():
-                group, ch = self._normalize_group(list(table.shards), live)
+                group, ch = self._normalize_group(list(table.shards), live,
+                                                  state, now)
                 changed = changed or ch
                 new_indices.setdefault(index, {})[sid] = group
         assigned = [s for shards in new_indices.values()
@@ -261,6 +279,12 @@ class AllocationService:
                     if imd else set()
                 for i, s in enumerate(group):
                     if s.state != SHARD_UNASSIGNED:
+                        continue
+                    if s.delayed:
+                        # waiting for its node to come back — reattach
+                        # or timeout happens in _normalize_group, never
+                        # a fresh allocation (ref: UnassignedInfo
+                        # isDelayed skips the allocators)
                         continue
                     if not s.primary and not primary_active:
                         continue  # wait for the primary
@@ -333,14 +357,26 @@ class AllocationService:
         return tgt
 
     def _normalize_group(self, group: List[ShardRouting],
-                         live: Set[str]
+                         live: Set[str],
+                         state: Optional[ClusterState] = None,
+                         now: Optional[float] = None
                          ) -> Tuple[List[ShardRouting], bool]:
         """Unwind relocation pairs whose nodes left, then unassign any
         other copy on a dead node. A dead relocation TARGET reverts its
         source to STARTED; a dead PRIMARY source aborts its target (the
         target was recovering from it); a dead REPLICA source simply
         disappears and its target carries on as a plain replica
-        recovery from the primary."""
+        recovery from the primary.
+
+        When the departed node is expected back — a registered
+        ``restart`` shutdown marker, or the index sets
+        ``index.unassigned.node_left.delayed_timeout`` — its copies go
+        delayed-unassigned instead of plain unassigned: they keep their
+        allocation_id and remember their node, the allocators skip
+        them, and this same pass later either REATTACHES them in place
+        when the node reappears inside its window (no peer copy — the
+        data node recovers from its own disk) or fails them for real
+        once the deadline lapses."""
         changed = False
         drop: Set[str] = set()
         override: Dict[str, ShardRouting] = {}
@@ -360,8 +396,8 @@ class AllocationService:
                 if s.primary:
                     if tgt is not None and tgt.allocation_id:
                         drop.add(tgt.allocation_id)
-                    override[s.allocation_id] = self._failed_copy(
-                        s, "node left")
+                    override[s.allocation_id] = self._unassign_copy(
+                        s, state, now)
                 else:
                     drop.add(s.allocation_id)
                     if tgt is not None:
@@ -385,10 +421,68 @@ class AllocationService:
                 s = repl
                 changed = True
             elif s.assigned and s.current_node_id not in live:
-                s = self._failed_copy(s, "node left")
+                s = self._unassign_copy(s, state, now)
                 changed = True
             out.append(s)
-        return out, changed
+        # delayed copies: reattach when the node returned, expire when
+        # it missed its window
+        final: List[ShardRouting] = []
+        for s in out:
+            if s.delayed:
+                if s.delayed_node_id in live:
+                    # back inside the window: re-initialize IN PLACE,
+                    # keeping allocation_id + delayed_node_id so the
+                    # data node recognises its own on-disk copy and
+                    # recovers without a peer segment transfer
+                    s = replace(s, state=SHARD_INITIALIZING,
+                                current_node_id=s.delayed_node_id,
+                                unassigned_reason=None,
+                                delayed_until=None)
+                    changed = True
+                elif now is not None and s.delayed_until is not None \
+                        and now >= s.delayed_until:
+                    s = self._failed_copy(
+                        s, "node left (delayed timeout elapsed)")
+                    changed = True
+            final.append(s)
+        return final, changed
+
+    def _unassign_copy(self, s: ShardRouting,
+                       state: Optional[ClusterState],
+                       now: Optional[float]) -> ShardRouting:
+        """A copy lost its node: delayed-unassigned if the node is
+        expected back, plain failed otherwise."""
+        deadline = self._delay_deadline(state, s.current_node_id,
+                                        s.index, now)
+        if deadline is None:
+            return self._failed_copy(s, "node left")
+        return replace(s, state=SHARD_UNASSIGNED, current_node_id=None,
+                       relocating_node_id=None,
+                       unassigned_reason="node restarting (delayed)",
+                       delayed_node_id=s.current_node_id,
+                       delayed_until=deadline)
+
+    @staticmethod
+    def _delay_deadline(state: Optional[ClusterState], node_id: str,
+                        index: str, now: Optional[float]
+                        ) -> Optional[float]:
+        """Scheduler-clock second until which this node's copies wait,
+        or None for immediate reallocation. A `restart` shutdown marker
+        grants registered_at + delay_s; otherwise the index-level
+        delayed_timeout setting grants now + timeout."""
+        if state is None or now is None:
+            return None
+        marker = state.metadata.shutdown(node_id)
+        if marker is not None and marker.type == SHUTDOWN_RESTART:
+            deadline = marker.registered_at + marker.delay_s
+            return deadline if deadline > now else None
+        imd = state.metadata.index(index)
+        raw = (imd.settings or {}).get(INDEX_DELAYED_TIMEOUT_SETTING) \
+            if imd is not None else None
+        t = parse_time_s(raw)
+        if t is not None and t > 0:
+            return now + t
+        return None
 
     def _choose_node(self, shard: ShardRouting, data_nodes: List[str],
                      counts: Dict[str, int],
@@ -423,7 +517,8 @@ class AllocationService:
     def _failed_copy(s: ShardRouting, reason: str) -> ShardRouting:
         return replace(s, state=SHARD_UNASSIGNED, current_node_id=None,
                        relocating_node_id=None, allocation_id=None,
-                       unassigned_reason=reason)
+                       unassigned_reason=reason,
+                       delayed_node_id=None, delayed_until=None)
 
     # ------------------------------------------------- reroute commands
 
@@ -600,7 +695,8 @@ class AllocationService:
                 f"allocate_replica [{index}][{shard}]: primary is not "
                 "active")
         i = next((i for i, s in enumerate(group)
-                  if not s.primary and s.state == SHARD_UNASSIGNED), None)
+                  if not s.primary and s.state == SHARD_UNASSIGNED
+                  and not s.delayed), None)
         if i is None:
             raise IllegalArgumentException(
                 f"allocate_replica [{index}][{shard}]: no unassigned "
@@ -672,7 +768,8 @@ class AllocationService:
                     was_target = s.is_relocation_target
                     source_node = s.relocating_node_id
                     group[i] = replace(s, state=SHARD_STARTED,
-                                       relocating_node_id=None)
+                                       relocating_node_id=None,
+                                       delayed_node_id=None)
                     changed = True
                     _in_sync_edit(index, sid, add=s.allocation_id)
                     if was_target:

@@ -44,8 +44,11 @@ from elasticsearch_tpu.cluster.state import (
     IncompatibleClusterStateVersionException,
     VotingConfiguration,
 )
+from elasticsearch_tpu.cluster.state import SHUTDOWN_RESTART
 from elasticsearch_tpu.testing.deterministic import Cancellable, Scheduler
 from elasticsearch_tpu.transport.transport import (
+    CURRENT_VERSION,
+    MIN_COMPATIBLE_VERSION,
     DiscoveryNode,
     ResponseHandler,
 )
@@ -74,6 +77,15 @@ MODE_FOLLOWER = "follower"
 class CoordinationStateRejectedException(ElasticsearchTpuException):
     """Ref: CoordinationStateRejectedException — a message that violates
     the ballot invariants (stale term, already voted, ...)."""
+
+
+class IncompatibleVersionException(CoordinationStateRejectedException):
+    """A joiner whose wire version the cluster cannot accept: below
+    ``MIN_COMPATIBLE_VERSION``, or below the cluster's published
+    ``min_wire_version`` — once every member speaks vN the cluster is
+    upgraded, and a v(N-1) node joining would be a DOWNGRADE (ref:
+    JoinTaskExecutor.ensureNodesCompatibility /
+    ensureVersionBarrier)."""
 
 
 @dataclass
@@ -351,6 +363,11 @@ class Coordinator:
         # estpu: allow[ESTPU-DET02] election jitter must differ per node; the sim injects a seeded rng
         self.rng = rng or _random.Random()
 
+        # wire versions reported in join payloads, cached so election
+        # wins (which bypass _node_join_update for the voters) still
+        # record every member's version into cluster state
+        self._peer_wire_versions: Dict[str, int] = {}
+
         # discovered peers: node_id -> DiscoveryNode (candidates gossip)
         self.peers: Dict[str, DiscoveryNode] = {
             n.node_id: n for n in self.seed_nodes}
@@ -550,7 +567,9 @@ class Coordinator:
                     except CoordinationStateRejectedException:
                         return
                     self.transport.send_request(
-                        leader, JOIN_ACTION, {"join": join.to_dict()},
+                        leader, JOIN_ACTION,
+                        {"join": join.to_dict(),
+                         "wire_version": self._wire_version()},
                         self._handler(lambda r: None, lambda e: None),
                         timeout=10.0)
                 elif term == self.current_term():
@@ -560,7 +579,8 @@ class Coordinator:
                     # with an empty optional Join at equal terms)
                     self.transport.send_request(
                         leader, JOIN_ACTION,
-                        {"node": self.local_node.to_dict()},
+                        {"node": self.local_node.to_dict(),
+                         "wire_version": self._wire_version()},
                         self._handler(lambda r: None, lambda e: None),
                         timeout=10.0)
 
@@ -705,7 +725,9 @@ class Coordinator:
         channel.send_response({"ok": True})
         # send our join (vote) to the candidate
         self.transport.send_request(
-            source, JOIN_ACTION, {"join": join.to_dict()},
+            source, JOIN_ACTION,
+            {"join": join.to_dict(),
+             "wire_version": self._wire_version()},
             self._handler(lambda r: None, lambda e: None), timeout=10.0)
 
     def _on_join(self, req, channel, src) -> None:
@@ -734,6 +756,7 @@ class Coordinator:
             else:
                 channel.send_response({"ok": True})
                 return
+            self._validate_joiner_version(joiner, req.get("wire_version"))
             hashes = self._join_validation_hashes()
             if joiner.node_id == self.local_node.node_id or not hashes:
                 finish()
@@ -785,6 +808,62 @@ class Coordinator:
             # our keystore's hashes ARE what will be published
             hashes = self.consistent_settings.compute_hashes()
         return hashes
+
+    # ------------------------------------------- mixed-version plane
+
+    def _wire_version(self) -> int:
+        """What this node speaks on the wire. The sim's
+        DisruptableTransport pins a per-node ``wire_version`` to model
+        not-yet-upgraded nodes; production transports are always
+        CURRENT_VERSION."""
+        v = getattr(self.transport, "wire_version", None)
+        return int(v) if v else CURRENT_VERSION
+
+    def _validate_joiner_version(self, joiner: DiscoveryNode,
+                                 reported: Optional[int]) -> None:
+        """Join barrier (ref: JoinTaskExecutor): refuse wire versions
+        the fleet cannot talk to, and refuse downgrades of a cluster
+        whose published min_wire_version already moved up."""
+        version = int(reported) if reported else \
+            self.transport.negotiated_version(joiner.node_id)
+        self._peer_wire_versions[joiner.node_id] = version
+        if version < MIN_COMPATIBLE_VERSION:
+            raise IncompatibleVersionException(
+                f"node [{joiner.name}] with wire version [{version}] is "
+                f"below the minimum compatible version "
+                f"[{MIN_COMPATIBLE_VERSION}]")
+        floor = self.applied_state.metadata.min_wire_version
+        if floor and version < floor:
+            raise IncompatibleVersionException(
+                f"node [{joiner.name}] with wire version [{version}] may "
+                f"not join a cluster already upgraded to min wire "
+                f"version [{floor}]: downgrades are not supported")
+
+    def _joiner_version(self, node_id: str) -> int:
+        v = self._peer_wire_versions.get(node_id)
+        if v is not None:
+            return v
+        if node_id == self.local_node.node_id:
+            return self._wire_version()
+        return self.transport.negotiated_version(node_id)
+
+    def _record_node_versions(self, state: ClusterState) -> ClusterState:
+        """Master-side: pin every member's wire version in metadata and
+        raise the published min_wire_version to the fleet floor. The
+        floor is MONOTONIC — once every member speaks vN the cluster is
+        upgraded and the join barrier refuses v(N-1) forever after."""
+        meta = state.metadata
+        versions = {n.node_id: self._joiner_version(n.node_id)
+                    for n in state.nodes.nodes}
+        floor = min(versions.values()) if versions else 0
+        new_floor = max(meta.min_wire_version, floor)
+        if versions == meta.node_versions and \
+                new_floor == meta.min_wire_version:
+            return state
+        from dataclasses import replace as _replace
+        return state.with_(metadata=_replace(
+            meta, node_versions=versions, min_wire_version=new_floor,
+            version=meta.version + 1))
 
     def _apply_join_vote(self, join: Join):
         """Shared join accounting: count the vote, register the peer,
@@ -984,15 +1063,22 @@ class Coordinator:
                 state = state.with_(metadata=_replace(
                     state.metadata,
                     hashes_of_consistent_settings=hashes))
-        return state
+        return self._record_node_versions(state)
 
     def _node_join_update(self, state: ClusterState,
                           joiner: DiscoveryNode) -> ClusterState:
-        if joiner.node_id in state.nodes and \
-                state.nodes.get(joiner.node_id) == joiner:
-            return state
-        new = state.with_(nodes=state.nodes.with_node(joiner))
-        return self._with_adjusted_config(new)
+        if not (joiner.node_id in state.nodes and
+                state.nodes.get(joiner.node_id) == joiner):
+            state = self._with_adjusted_config(
+                state.with_(nodes=state.nodes.with_node(joiner)))
+        # a returning `restart` node is back inside its window: clear
+        # the marker so the delayed-allocation clock stops for it (its
+        # copies reattach on the very next reroute)
+        marker = state.metadata.shutdown(joiner.node_id)
+        if marker is not None and marker.type == SHUTDOWN_RESTART:
+            state = state.with_(
+                metadata=state.metadata.without_shutdown(joiner.node_id))
+        return self._record_node_versions(state)
 
     def node_left(self, node_id: str, reason: str) -> None:
         """Remove a node from the cluster (fault detection / disconnect)
@@ -1001,6 +1087,13 @@ class Coordinator:
             if node_id not in state.nodes:
                 return state
             new = state.with_(nodes=state.nodes.without_node(node_id))
+            # drop the version pin (min_wire_version stays — the floor
+            # is monotonic) but KEEP any shutdown marker: a `restart`
+            # departure is expected back, and the surviving marker is
+            # what makes reroute delay its copies instead of
+            # re-replicating them immediately
+            new = new.with_(
+                metadata=new.metadata.without_node_version(node_id))
             return self._with_adjusted_config(new)
         self._submit_internal(f"node-left[{node_id}] {reason}", update)
 

@@ -813,12 +813,25 @@ class DataNodeService:
         if negotiate is not None and \
                 negotiate(source_node.node_id) < STAGED_RECOVERY_VERSION:
             protocol = 1
+        # delayed-allocation reattach: this copy's routing remembers it
+        # last lived HERE, so the on-disk data (translog-replayed by the
+        # engine ctor) is a valid continuation — skip the segment copy
+        # and catch up from the primary's translog only. The fast path
+        # needs the v2 seqno machinery; a v1 source falls back to the
+        # full legacy copy (mixed-version clamp).
+        reattach = (routing.delayed_node_id == self.local_node.node_id
+                    and protocol >= STAGED_RECOVERY_VERSION)
+        if reattach:
+            recovery_type = "existing_store"
+        elif routing.is_relocation_target:
+            recovery_type = "relocation"
+        else:
+            recovery_type = "peer"
         rec = RecoveryState(
             routing.index, routing.shard_id, routing.allocation_id,
             source_node=source_node.name,
             target_node=self.local_node.name,
-            recovery_type=("relocation" if routing.is_relocation_target
-                           else "peer"),
+            recovery_type=recovery_type,
             protocol=protocol, start_time=self.scheduler.now())
         self.recoveries[rkey] = rec
         task = None
@@ -847,7 +860,9 @@ class DataNodeService:
         self._enter_stage(ctx, "index")
 
         def ok(resp):
-            if resp.get("protocol", 1) >= STAGED_RECOVERY_VERSION:
+            if resp.get("reattach"):
+                self._recovery_reattach(ctx, resp)
+            elif resp.get("protocol", 1) >= STAGED_RECOVERY_VERSION:
                 self._recovery_phase1(ctx, resp)
             else:
                 self._recovery_legacy_install(ctx, resp)
@@ -859,7 +874,8 @@ class DataNodeService:
             source_node, START_RECOVERY,
             {"index": routing.index, "shard_id": routing.shard_id,
              "target_allocation_id": routing.allocation_id,
-             "protocol": protocol},
+             "protocol": protocol, "reattach": reattach,
+             "local_checkpoint": shard.engine.tracker.checkpoint},
             ResponseHandler(ok, fail), timeout=120.0)
 
     def _retry_recovery(self, key: Tuple[str, int]) -> None:
@@ -991,6 +1007,21 @@ class DataNodeService:
         ctx.max_seq_no = max(ctx.max_seq_no, resp.get("max_seq_no", -1))
         ctx.rec.total_bytes = resp.get("total_bytes", nbytes)
         ctx.rec.recovered_bytes = nbytes
+
+    def _recovery_reattach(self, ctx: _RecoveryContext,
+                           resp: Dict[str, Any]) -> None:
+        """Delayed-allocation fast path: the source agreed our on-disk
+        copy is a valid continuation — NO file transfer. Straight to
+        translog catch-up above our own persisted checkpoint, then the
+        usual device re-residency + finalize barrier."""
+        if self._recovery_cancelled(ctx):
+            return
+        ctx.max_seq_no = max(ctx.max_seq_no, resp.get("max_seq_no", -1))
+        ctx.shard.global_checkpoint = resp.get("global_checkpoint", -1)
+        # zero segment bytes moved — the acceptance suite pins this
+        ctx.rec.total_bytes = 0
+        self._enter_stage(ctx, "translog")
+        self._recovery_translog_step(ctx)
 
     def _recovery_phase1(self, ctx: _RecoveryContext,
                          resp: Dict[str, Any]) -> None:
@@ -1202,6 +1233,33 @@ class DataNodeService:
             channel.send_exception(NoShardAvailableActionException(
                 f"recovery source for [{req['index']}][{req['shard_id']}]"
                 " is not an active primary"))
+            return
+        target_alloc_early = req["target_allocation_id"]
+        if req.get("reattach") and \
+                req.get("protocol", 1) >= STAGED_RECOVERY_VERSION:
+            # delayed-allocation reattach: the target kept its on-disk
+            # copy — no flush, no file snapshot. Pin history above ITS
+            # checkpoint (everything it is missing) under the recovery
+            # lease, start tracking it, and let it pull the translog
+            # tail (ref: RecoverySourceHandler sequence-number-based
+            # recovery when isTargetSameHistory + ops available)
+            rkey = (req["index"], req["shard_id"], target_alloc_early)
+            lease_id = f"peer_recovery/{target_alloc_early}"
+            self._recovery_sources[rkey] = {
+                "lease_id": lease_id,
+                "lease": shard.tracker.add_retention_lease(
+                    lease_id,
+                    max(0, int(req.get("local_checkpoint", -1)) + 1),
+                    source="peer recovery"),
+            }
+            shard.tracker.init_tracking(target_alloc_early)
+            channel.send_response({
+                "protocol": STAGED_RECOVERY_VERSION,
+                "reattach": True,
+                "total_bytes": 0,
+                "max_seq_no": shard.engine.tracker.max_seq_no,
+                "global_checkpoint": shard.tracker.global_checkpoint,
+            })
             return
         engine = shard.engine
         engine.flush()

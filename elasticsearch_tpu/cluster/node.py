@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import os
 import uuid
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.cluster.allocation import (
     AllocationService,
@@ -39,10 +39,22 @@ from elasticsearch_tpu.cluster.search_action import (
     DistributedSearchService,
     failure_type_of,
 )
-from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.cluster.shutdown import (
+    DEFAULT_SHUTDOWN_DELAY_S,
+    VALID_SHUTDOWN_TYPES,
+    describe_shutdown,
+    parse_time_s,
+)
+from elasticsearch_tpu.cluster.state import (
+    SHUTDOWN_RESTART,
+    ClusterState,
+    NodeShutdownMetadata,
+)
 from elasticsearch_tpu.common.errors import (
     BACKPRESSURE_ERROR_TYPES,
     EsRejectedExecutionException,
+    IllegalArgumentException,
+    ResourceNotFoundException,
 )
 from elasticsearch_tpu.index.pressure import (
     IndexingPressure,
@@ -81,6 +93,11 @@ CLUSTER_REROUTE_ACTION = "cluster:admin/reroute"
 CLUSTER_SETTINGS_ACTION = "cluster:admin/settings/update"
 RECOVERY_STATS_ACTION = "indices:monitor/recovery[n]"
 HEALTH_REPORT_ACTION = "cluster:monitor/health_report[n]"
+# rolling upgrades: node-shutdown markers in cluster state (ref: the
+# x-pack shutdown plugin's PUT/GET/DELETE _nodes/{id}/shutdown)
+NODE_SHUTDOWN_PUT_ACTION = "cluster:admin/shutdown/put"
+NODE_SHUTDOWN_GET_ACTION = "cluster:admin/shutdown/get"
+NODE_SHUTDOWN_DELETE_ACTION = "cluster:admin/shutdown/delete"
 
 # coordinator-side bulk retry for TRANSIENT routing failures only (a
 # primary mid-handoff or a routing flip in progress): backpressure 429s
@@ -92,6 +109,48 @@ BULK_RETRYABLE_TYPES = frozenset({
     "shard_not_in_primary_mode_exception",
     "no_shard_available_action_exception",
 })
+
+
+class _ShutdownTimerRegistry:
+    """Master-side delayed-allocation timers, keyed by node id.
+
+    A restart-type shutdown marker (and any index-setting delayed copy)
+    carries a scheduler-clock deadline; the registry keeps exactly one
+    armed timer per key and re-arms only when the deadline moves, so
+    repeated state applications don't stack duplicate callbacks. Every
+    `register_shutdown` MUST be balanced by `clear_shutdown` (enforced
+    by estpu-lint's resource-pairing pass)."""
+
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+        self._timers: Dict[str, Tuple[float, Any]] = {}
+
+    def register_shutdown(self, key: str, deadline: float,
+                          fire: Callable[[], None]) -> None:
+        prev = self._timers.get(key)
+        if prev is not None:
+            if prev[0] == deadline:
+                return  # already armed for this exact deadline
+            cancel = getattr(prev[1], "cancel", None)
+            if cancel is not None:
+                cancel()
+        delay = max(0.0, deadline - self.scheduler.now())
+        handle = self.scheduler.schedule(
+            delay, fire, f"shutdown-deadline[{key}]")
+        self._timers[key] = (deadline, handle)
+
+    def clear_shutdown(self, key: Optional[str] = None) -> None:
+        """Cancel one timer (or all of them when ``key`` is None)."""
+        keys = [key] if key is not None else sorted(self._timers)
+        for k in keys:
+            entry = self._timers.pop(k, None)
+            if entry is not None:
+                cancel = getattr(entry[1], "cancel", None)
+                if cancel is not None:
+                    cancel()
+
+    def registered(self) -> List[str]:
+        return sorted(self._timers)
 
 
 class ClusterNode:
@@ -147,7 +206,10 @@ class ClusterNode:
         self.task_manager = TaskManager(
             self.local_node.node_id, metrics=self.telemetry.metrics,
             clock=scheduler.now)
-        self.allocation = AllocationService()
+        # the allocation service reads the scheduler clock so delayed
+        # (node-restarting) copies carry deterministic deadlines
+        self.allocation = AllocationService(clock=scheduler.now)
+        self._shutdown_timers = _ShutdownTimerRegistry(scheduler)
         self.routing = OperationRouting()
         self.data_node = DataNodeService(
             transport, scheduler, data_path,
@@ -225,6 +287,9 @@ class ClusterNode:
             (CLUSTER_SETTINGS_ACTION, self._on_cluster_settings),
             (RECOVERY_STATS_ACTION, self._on_recovery_stats),
             (HEALTH_REPORT_ACTION, self._on_health_report),
+            (NODE_SHUTDOWN_PUT_ACTION, self._on_put_shutdown),
+            (NODE_SHUTDOWN_GET_ACTION, self._on_get_shutdown),
+            (NODE_SHUTDOWN_DELETE_ACTION, self._on_delete_shutdown),
         ]:
             # master/admin + monitoring actions never trip the inbound
             # breaker: shard-state reporting and stats are exactly what
@@ -246,6 +311,7 @@ class ClusterNode:
             self.telemetry.history.start(self.scheduler)
 
     def stop(self) -> None:
+        self._shutdown_timers.clear_shutdown()
         self.health_watchdog.stop()
         self.telemetry.history.stop()
         self.coordinator.stop()
@@ -283,6 +349,7 @@ class ClusterNode:
         # master: membership/metadata changes may unlock allocation; the
         # task no-ops (no publication) when reroute changes nothing
         if self.coordinator.mode == MODE_LEADER:
+            self._sync_shutdown_timers(state)
             self.coordinator.submit_state_update(
                 "reroute", self.allocation.reroute)
 
@@ -390,6 +457,146 @@ class ClusterNode:
         self.coordinator.submit_state_update(
             "cluster-update-settings", fn,
             on_done=lambda err: self._ack(channel, err))
+
+    # ---------------------------------------------- node shutdown plane
+
+    def _on_put_shutdown(self, req, channel, src) -> None:
+        """`PUT /_nodes/{id}/shutdown` (ref: the x-pack shutdown
+        plugin's TransportPutShutdownNodeAction): record the marker in
+        cluster-state metadata, then reroute — `remove` starts draining
+        through the allocation excludes, `restart` arms the
+        delayed-allocation window instead of re-replicating."""
+        if not self._require_master(channel):
+            return
+        node_id = req.get("node_id")
+        sd_type = req.get("type")
+        if sd_type not in VALID_SHUTDOWN_TYPES:
+            channel.send_exception(IllegalArgumentException(
+                f"invalid shutdown type [{sd_type}]; must be one of "
+                f"{sorted(VALID_SHUTDOWN_TYPES)}"))
+            return
+        delay_s = parse_time_s(req.get("allocation_delay"))
+        if delay_s is None:
+            delay_s = DEFAULT_SHUTDOWN_DELAY_S
+        marker = NodeShutdownMetadata(
+            node_id=node_id, type=sd_type,
+            reason=req.get("reason", ""),
+            registered_at=self.scheduler.now(), delay_s=float(delay_s))
+
+        def fn(s):
+            # a marker may be re-PUT for a node that already left (the
+            # operator extending a restart window); a node the cluster
+            # has never heard of is an error
+            if (s.nodes.get(node_id) is None
+                    and s.metadata.shutdown(node_id) is None):
+                raise ResourceNotFoundException(
+                    f"node [{node_id}] not found in cluster")
+            s2 = s.with_(metadata=s.metadata.with_shutdown(marker))
+            return self.allocation.reroute(s2)
+
+        self.coordinator.submit_state_update(
+            f"put-node-shutdown[{node_id}][{sd_type}]", fn,
+            on_done=lambda err: self._ack(channel, err))
+
+    def _on_get_shutdown(self, req, channel, src) -> None:
+        """`GET /_nodes/{id}/shutdown` — the drain/restart progress
+        view. The stalled flag comes from the master's stalled-progress
+        watchdog: a `remove` whose recoveries stopped moving reports
+        STALLED instead of IN_PROGRESS."""
+        if not self._require_master(channel):
+            return
+        state = self.coordinator.applied_state
+        node_id = req.get("node_id")
+        stalled = any(f["kind"] == "recovery"
+                      for f in self.health_watchdog.sweep())
+        markers = state.metadata.node_shutdowns
+        if node_id is not None:
+            wanted = markers.get(node_id)
+            markers = {node_id: wanted} if wanted is not None else {}
+        channel.send_response({"nodes": {
+            nid: describe_shutdown(state, marker, stalled=stalled)
+            for nid, marker in sorted(markers.items())
+        }})
+
+    def _on_delete_shutdown(self, req, channel, src) -> None:
+        if not self._require_master(channel):
+            return
+        node_id = req.get("node_id")
+
+        def fn(s):
+            if s.metadata.shutdown(node_id) is None:
+                raise ResourceNotFoundException(
+                    f"no shutdown marker for node [{node_id}]")
+            s2 = s.with_(metadata=s.metadata.without_shutdown(node_id))
+            return self.allocation.reroute(s2)
+
+        self.coordinator.submit_state_update(
+            f"delete-node-shutdown[{node_id}]", fn,
+            on_done=lambda err: self._ack(channel, err))
+
+    def _sync_shutdown_timers(self, state: ClusterState) -> None:
+        """Master-only, called on every applied state: arm one timer per
+        departed-restart marker (fires when the node misses its window)
+        and one per node with index-setting delayed copies, cancel the
+        rest. Idempotent across repeated applications of the same
+        state — the registry re-arms only when a deadline moves."""
+        wanted: Dict[str, Tuple[float, Callable[[], None]]] = {}
+        for node_id, marker in sorted(
+                state.metadata.node_shutdowns.items()):
+            if (marker.type == SHUTDOWN_RESTART
+                    and state.nodes.get(node_id) is None):
+                wanted[node_id] = (
+                    marker.registered_at + marker.delay_s,
+                    lambda nid=node_id: self._on_shutdown_deadline(nid))
+        # delayed copies without a marker (index.unassigned.
+        # node_left.delayed_timeout): earliest deadline per node
+        for irt in state.routing_table.indices.values():
+            for table in irt.shards.values():
+                for s in table.shards:
+                    if not s.delayed or s.delayed_until is None:
+                        continue
+                    key = f"delayed:{s.delayed_node_id}"
+                    if key in wanted and wanted[key][0] <= s.delayed_until:
+                        continue
+                    wanted[key] = (
+                        s.delayed_until,
+                        lambda nid=s.delayed_node_id, k=key:
+                            self._on_delayed_timeout(nid, k))
+        for key in self._shutdown_timers.registered():
+            if key not in wanted:
+                self._shutdown_timers.clear_shutdown(key)
+        for key, (deadline, fire) in sorted(wanted.items()):
+            self._shutdown_timers.register_shutdown(key, deadline, fire)
+
+    def _on_shutdown_deadline(self, node_id: str) -> None:
+        """A departed `restart` node missed its window: drop the marker
+        and reroute — the expiry pass promotes its delayed copies to
+        genuinely unassigned so they re-replicate elsewhere."""
+        self._shutdown_timers.clear_shutdown(node_id)
+        if self.coordinator.mode != MODE_LEADER:
+            return
+
+        def fn(s):
+            marker = s.metadata.shutdown(node_id)
+            if (marker is not None and marker.type == SHUTDOWN_RESTART
+                    and s.nodes.get(node_id) is None
+                    and self.scheduler.now() >=
+                    marker.registered_at + marker.delay_s):
+                s = s.with_(metadata=s.metadata.without_shutdown(node_id))
+            return self.allocation.reroute(s)
+
+        self.coordinator.submit_state_update(
+            f"node-shutdown-timeout[{node_id}]", fn)
+
+    def _on_delayed_timeout(self, node_id: str, key: str) -> None:
+        """An index-setting delayed window elapsed: reroute so the
+        expiry pass in `_normalize_group` fails the waiting copies."""
+        self._shutdown_timers.clear_shutdown(key)
+        if self.coordinator.mode != MODE_LEADER:
+            return
+        self.coordinator.submit_state_update(
+            f"delayed-allocation-timeout[{node_id}]",
+            self.allocation.reroute)
 
     @staticmethod
     def _ack(channel, err) -> None:
@@ -856,6 +1063,33 @@ class ClusterNode:
         `cluster.routing.allocation.exclude._id` drains a node."""
         self._to_master(CLUSTER_SETTINGS_ACTION,
                         {"persistent": persistent}, on_done)
+
+    def put_node_shutdown(self, node_id: str, type: str,
+                          reason: str = "",
+                          allocation_delay: Optional[Any] = None,
+                          on_done: Callable = lambda r, e: None) -> None:
+        """`PUT /_nodes/{id}/shutdown` — register a `restart` (delayed
+        allocation, no re-replication inside the window) or `remove`
+        (drain) marker."""
+        self._to_master(NODE_SHUTDOWN_PUT_ACTION,
+                        {"node_id": node_id, "type": type,
+                         "reason": reason,
+                         "allocation_delay": allocation_delay}, on_done)
+
+    def get_node_shutdown(self, node_id: Optional[str] = None,
+                          on_done: Callable = lambda r, e: None) -> None:
+        """`GET /_nodes/{id}/shutdown` (or all markers when node_id is
+        None) — status is COMPLETE / IN_PROGRESS / STALLED."""
+        self._to_master(NODE_SHUTDOWN_GET_ACTION,
+                        {"node_id": node_id}, on_done)
+
+    def delete_node_shutdown(self, node_id: str,
+                             on_done: Callable = lambda r, e: None
+                             ) -> None:
+        """`DELETE /_nodes/{id}/shutdown` — the operator changed their
+        mind; a reroute follows so drains stop / delays lift."""
+        self._to_master(NODE_SHUTDOWN_DELETE_ACTION,
+                        {"node_id": node_id}, on_done)
 
     def bulk(self, index: str, items: List[Dict[str, Any]],
              on_done: Callable = lambda r, e: None) -> None:

@@ -99,6 +99,11 @@ from elasticsearch_tpu.transport.tasks import (
 )
 from elasticsearch_tpu.transport.transport import ResponseHandler
 
+# per-shard profiling rides the query body only since wire v2; a v1
+# peer in a mixed-version (rolling-upgrade) cluster would reject the
+# unknown field, so the coordinator clamps it per peer
+PROFILE_WIRE_VERSION = 2
+
 QUERY_PHASE_ACTION = "indices:data/read/search[phase/query]"
 FETCH_PHASE_ACTION = "indices:data/read/search[phase/fetch/id]"
 SEARCH_ACTION = "indices:data/read/search"
@@ -797,6 +802,13 @@ class DistributedSearchService:
         budget = parse_time_value(timeout, "timeout")
         return budget if budget > 0 else None
 
+    def _peer_wire_version(self, node_id: str) -> int:
+        """Wire version negotiated with a peer; transports without
+        version negotiation are treated as current."""
+        fn = getattr(self.transport, "negotiated_version", None)
+        return int(fn(node_id)) if fn is not None \
+            else PROFILE_WIRE_VERSION
+
     def _send_query(self, ctx: Dict, node_id: str, index: str,
                     batch: List[_ShardGroup]) -> None:
         tele = self.telemetry
@@ -826,9 +838,16 @@ class DistributedSearchService:
                     ctx, g, node_id, NodeNotConnectedException(
                         f"node [{node_id}] left the cluster"))
             return
+        body = ctx["body"]
+        if body and body.get("profile") and \
+                self._peer_wire_version(node_id) < PROFILE_WIRE_VERSION:
+            # mixed-version clamp: drop the v2-only field for the v1
+            # peer — the merged profile tree simply lacks that node's
+            # shard stages, the search itself is unaffected
+            body = {k: v for k, v in body.items() if k != "profile"}
         payload = {"index": index,
                    "shards": [g.shard for g in batch],
-                   "k": ctx["k"], "body": ctx["body"]}
+                   "k": ctx["k"], "body": body}
         by_shard = {g.shard: g for g in batch}
 
         def ok(resp, _node_id=node_id, _index=index, _by_shard=by_shard):

@@ -1,0 +1,118 @@
+"""Node-shutdown plane: status computation + delayed-timeout parsing.
+
+Ref: the reference's ``x-pack shutdown`` plugin
+(TransportGetShutdownStatusAction) and
+``UnassignedInfo.findNextDelayedAllocation``. A registered shutdown
+marker lives in cluster-state metadata
+(:class:`~elasticsearch_tpu.cluster.state.NodeShutdownMetadata`);
+this module derives the operator-facing view of it — is the node
+ready to be bounced, how many shard copies still live on it, is the
+drain making progress — shared by the master transport handlers
+(``cluster/node.py``), the allocation service, and the REST / health
+surfaces.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from elasticsearch_tpu.cluster.state import (
+    SHUTDOWN_COMPLETE,
+    SHUTDOWN_IN_PROGRESS,
+    SHUTDOWN_REMOVE,
+    SHUTDOWN_RESTART,
+    SHUTDOWN_STALLED,
+    ClusterState,
+    NodeShutdownMetadata,
+)
+
+# how long a departed `restart` node may stay away before its delayed
+# copies are promoted to real unassigned and re-replicated (ref: the
+# reference's index.unassigned.node_left.delayed_timeout default of 1m)
+DEFAULT_SHUTDOWN_DELAY_S = 60.0
+
+# per-index override consulted when a node leaves WITHOUT a registered
+# shutdown marker (ref: UnassignedInfo.INDEX_DELAYED_NODE_LEFT_TIMEOUT)
+INDEX_DELAYED_TIMEOUT_SETTING = "index.unassigned.node_left.delayed_timeout"
+
+VALID_SHUTDOWN_TYPES = (SHUTDOWN_RESTART, SHUTDOWN_REMOVE)
+
+
+def parse_time_s(raw: Any) -> Optional[float]:
+    """``"30s"`` / ``"500ms"`` / ``"2m"`` / ``"1h"`` / bare number →
+    seconds; None / empty / unparseable → None."""
+    if raw is None or raw == "":
+        return None
+    if isinstance(raw, (int, float)) and not isinstance(raw, bool):
+        return float(raw)
+    text = str(raw).strip().lower()
+    # "ms" before "s" and "m" — longest suffix wins
+    for suffix, mult in (("ms", 0.001), ("s", 1.0), ("m", 60.0),
+                         ("h", 3600.0)):
+        if text.endswith(suffix):
+            try:
+                return float(text[:-len(suffix)]) * mult
+            except ValueError:
+                return None
+    try:
+        return float(text)
+    except ValueError:
+        return None
+
+
+def shards_on_node(state: ClusterState, node_id: str) -> int:
+    """Shard copies still living on ``node_id`` (relocation sources
+    count — their data has not finished moving off)."""
+    n = 0
+    for irt in state.routing_table.indices.values():
+        for table in irt.shards.values():
+            for s in table.shards:
+                if s.current_node_id == node_id:
+                    n += 1
+    return n
+
+
+def delayed_shards_by_node(state: ClusterState) -> Dict[str, int]:
+    """delayed_node_id -> number of copies waiting for that node."""
+    out: Dict[str, int] = {}
+    for irt in state.routing_table.indices.values():
+        for table in irt.shards.values():
+            for s in table.shards:
+                if s.delayed:
+                    out[s.delayed_node_id] = \
+                        out.get(s.delayed_node_id, 0) + 1
+    return out
+
+
+def shutdown_status(state: ClusterState, marker: NodeShutdownMetadata,
+                    stalled: bool = False) -> str:
+    """Is the node safe to bounce? ``restart`` needs no drain, so it is
+    COMPLETE the moment the marker lands (delayed allocation does the
+    rest). ``remove`` is COMPLETE only once the drain emptied the node,
+    STALLED when the watchdog says the drain stopped making progress,
+    IN_PROGRESS otherwise."""
+    if marker.type == SHUTDOWN_RESTART:
+        return SHUTDOWN_COMPLETE
+    remaining = shards_on_node(state, marker.node_id)
+    if remaining == 0:
+        return SHUTDOWN_COMPLETE
+    return SHUTDOWN_STALLED if stalled else SHUTDOWN_IN_PROGRESS
+
+
+def describe_shutdown(state: ClusterState, marker: NodeShutdownMetadata,
+                      stalled: bool = False) -> Dict[str, Any]:
+    """The GET /_nodes/{id}/shutdown entry for one marker."""
+    status = shutdown_status(state, marker, stalled=stalled)
+    return {
+        "node_id": marker.node_id,
+        "type": marker.type,
+        "reason": marker.reason,
+        "shutdown_started": marker.registered_at,
+        "allocation_delay": marker.delay_s,
+        "status": status,
+        "shard_migration": {
+            "status": status,
+            "shard_migrations_remaining":
+                shards_on_node(state, marker.node_id),
+        },
+    }

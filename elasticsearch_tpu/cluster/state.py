@@ -173,6 +173,48 @@ class IndexMetadata:
                                  d.get("in_sync_allocations", {}).items()})
 
 
+SHUTDOWN_RESTART = "restart"
+SHUTDOWN_REMOVE = "remove"
+
+# shutdown progress states (ref: SingleNodeShutdownMetadata.Status)
+SHUTDOWN_IN_PROGRESS = "IN_PROGRESS"
+SHUTDOWN_STALLED = "STALLED"
+SHUTDOWN_COMPLETE = "COMPLETE"
+
+
+@dataclass(frozen=True)
+class NodeShutdownMetadata:
+    """One registered node shutdown (ref: cluster/metadata/
+    SingleNodeShutdownMetadata.java). ``type`` decides allocation
+    behaviour: ``restart`` keeps the node's shard copies delayed-
+    unassigned until it returns or ``delay_s`` lapses; ``remove``
+    drains them off via the exclude/reroute path."""
+
+    node_id: str
+    type: str = SHUTDOWN_RESTART
+    reason: str = ""
+    # scheduler-clock second the marker was registered (NOT wall clock:
+    # ESTPU-DET — every timer in the cluster runs on the injected clock)
+    registered_at: float = 0.0
+    # how long a departed `restart` node may stay away before its copies
+    # are promoted to real unassigned and re-replicated
+    delay_s: float = 60.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"node_id": self.node_id, "type": self.type,
+                "reason": self.reason,
+                "registered_at": self.registered_at,
+                "delay_s": self.delay_s}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "NodeShutdownMetadata":
+        return NodeShutdownMetadata(
+            node_id=d["node_id"], type=d.get("type", SHUTDOWN_RESTART),
+            reason=d.get("reason", ""),
+            registered_at=d.get("registered_at", 0.0),
+            delay_s=d.get("delay_s", 60.0))
+
+
 @dataclass(frozen=True)
 class Metadata:
     """Cluster-wide metadata (ref: cluster/metadata/Metadata.java)."""
@@ -186,6 +228,17 @@ class Metadata:
     # every node can verify its keystore (ref: ConsistentSettingsService)
     hashes_of_consistent_settings: Dict[str, str] = field(
         default_factory=dict)
+    # node_id -> registered shutdown marker (ref: NodesShutdownMetadata);
+    # survives the node's departure so node-left sees it
+    node_shutdowns: Dict[str, NodeShutdownMetadata] = field(
+        default_factory=dict)
+    # node_id -> negotiated wire version, recorded at join; the floor of
+    # this map is the cluster's published min_wire_version (ref:
+    # DiscoveryNodes.getMinNodeVersion / CompatibilityVersions)
+    node_versions: Dict[str, int] = field(default_factory=dict)
+    # once the whole fleet speaks vN the cluster is considered upgraded:
+    # a later v(N-1) join is a downgrade and is refused
+    min_wire_version: int = 0
     version: int = 0
 
     def index(self, name: str) -> Optional[IndexMetadata]:
@@ -204,6 +257,39 @@ class Metadata:
     def with_coordination(self, coord: CoordinationMetadata) -> "Metadata":
         return replace(self, coordination=coord)
 
+    def shutdown(self, node_id: str) -> Optional[NodeShutdownMetadata]:
+        return self.node_shutdowns.get(node_id)
+
+    def with_shutdown(self, marker: NodeShutdownMetadata) -> "Metadata":
+        shutdowns = dict(self.node_shutdowns)
+        shutdowns[marker.node_id] = marker
+        return replace(self, node_shutdowns=shutdowns,
+                       version=self.version + 1)
+
+    def without_shutdown(self, node_id: str) -> "Metadata":
+        if node_id not in self.node_shutdowns:
+            return self
+        shutdowns = dict(self.node_shutdowns)
+        shutdowns.pop(node_id, None)
+        return replace(self, node_shutdowns=shutdowns,
+                       version=self.version + 1)
+
+    def with_node_version(self, node_id: str, wire_version: int,
+                          floor: int) -> "Metadata":
+        versions = dict(self.node_versions)
+        versions[node_id] = wire_version
+        return replace(self, node_versions=versions,
+                       min_wire_version=max(self.min_wire_version, floor),
+                       version=self.version + 1)
+
+    def without_node_version(self, node_id: str) -> "Metadata":
+        if node_id not in self.node_versions:
+            return self
+        versions = dict(self.node_versions)
+        versions.pop(node_id, None)
+        return replace(self, node_versions=versions,
+                       version=self.version + 1)
+
     def to_dict(self) -> Dict[str, Any]:
         return {
             "cluster_uuid": self.cluster_uuid,
@@ -213,6 +299,10 @@ class Metadata:
             "persistent_settings": self.persistent_settings,
             "hashes_of_consistent_settings":
                 self.hashes_of_consistent_settings,
+            "node_shutdowns": {k: v.to_dict() for k, v in
+                               self.node_shutdowns.items()},
+            "node_versions": dict(self.node_versions),
+            "min_wire_version": self.min_wire_version,
             "version": self.version,
         }
 
@@ -228,6 +318,12 @@ class Metadata:
             persistent_settings=d.get("persistent_settings", {}),
             hashes_of_consistent_settings=d.get(
                 "hashes_of_consistent_settings", {}),
+            node_shutdowns={k: NodeShutdownMetadata.from_dict(v)
+                            for k, v in
+                            d.get("node_shutdowns", {}).items()},
+            node_versions={k: int(v) for k, v in
+                           d.get("node_versions", {}).items()},
+            min_wire_version=d.get("min_wire_version", 0),
             version=d.get("version", 0))
 
 
@@ -253,6 +349,14 @@ class ShardRouting:
     relocating_node_id: Optional[str] = None
     allocation_id: Optional[str] = None
     unassigned_reason: Optional[str] = None
+    # delayed-unassigned (ref: UnassignedInfo.isDelayed): the node this
+    # copy last lived on, kept — together with allocation_id — while the
+    # node is expected back (restart shutdown / delayed_timeout), so the
+    # returning node reattaches its on-disk copy without a peer recovery
+    delayed_node_id: Optional[str] = None
+    # scheduler-clock deadline: if the node is still gone at this second
+    # the copy stops waiting and becomes genuinely unassigned
+    delayed_until: Optional[float] = None
 
     @property
     def active(self) -> bool:
@@ -261,6 +365,13 @@ class ShardRouting:
     @property
     def assigned(self) -> bool:
         return self.current_node_id is not None
+
+    @property
+    def delayed(self) -> bool:
+        """Unassigned but waiting for its node to return rather than
+        eligible for reallocation."""
+        return (self.state == SHARD_UNASSIGNED
+                and self.delayed_node_id is not None)
 
     @property
     def relocating(self) -> bool:
@@ -284,6 +395,8 @@ class ShardRouting:
             "relocating_node_id": self.relocating_node_id,
             "allocation_id": self.allocation_id,
             "unassigned_reason": self.unassigned_reason,
+            "delayed_node_id": self.delayed_node_id,
+            "delayed_until": self.delayed_until,
         }
 
     @staticmethod
@@ -294,7 +407,9 @@ class ShardRouting:
             current_node_id=d.get("current_node_id"),
             relocating_node_id=d.get("relocating_node_id"),
             allocation_id=d.get("allocation_id"),
-            unassigned_reason=d.get("unassigned_reason"))
+            unassigned_reason=d.get("unassigned_reason"),
+            delayed_node_id=d.get("delayed_node_id"),
+            delayed_until=d.get("delayed_until"))
 
 
 @dataclass(frozen=True)

@@ -472,6 +472,72 @@ class DeviceEngineIndicator(HealthIndicator):
             details=details, impacts=impacts, diagnoses=diagnoses)
 
 
+class NodeShutdownIndicator(HealthIndicator):
+    """Rolling-upgrade visibility: shutdown markers registered in
+    cluster-state metadata (PUT /_nodes/{id}/shutdown). GREEN with no
+    markers; YELLOW while a restart window is open or a remove is
+    draining; RED when the watchdog says a drain stopped making
+    progress (the operator's bounce is blocked)."""
+
+    name = "node_shutdown"
+
+    def compute(self, ctx: HealthContext) -> HealthIndicatorResult:
+        state = ctx.cluster_state
+        markers = getattr(getattr(state, "metadata", None),
+                          "node_shutdowns", None)
+        if not markers:
+            return HealthIndicatorResult(
+                name=self.name, status=HealthStatus.GREEN,
+                symptom="no node shutdowns in progress")
+        from elasticsearch_tpu.cluster.shutdown import (
+            delayed_shards_by_node, shutdown_status)
+        from elasticsearch_tpu.cluster.state import SHUTDOWN_STALLED
+        stalled_drain = False
+        if ctx.watchdog is not None:
+            stalled_drain = any(f["kind"] == "recovery"
+                                for f in ctx.watchdog.findings())
+        delayed = delayed_shards_by_node(state)
+        per_node: Dict[str, Any] = {}
+        stalled_nodes: List[str] = []
+        for nid, m in sorted(markers.items()):
+            st = shutdown_status(state, m, stalled=stalled_drain)
+            per_node[nid] = {"type": m.type, "status": st,
+                             "delayed_shards": delayed.get(nid, 0)}
+            if st == SHUTDOWN_STALLED:
+                stalled_nodes.append(nid)
+        details = {"shutdowns": per_node}
+        impacts: List[Impact] = []
+        diagnoses: List[Diagnosis] = []
+        if stalled_nodes:
+            status = HealthStatus.RED
+            symptom = (f"shutdown drain stalled on node(s) "
+                       f"{', '.join(stalled_nodes)}")
+            impacts.append(Impact(
+                id="shutdown_stalled", severity=2,
+                description="the node cannot be removed: shard copies "
+                            "remain and their recoveries stopped moving",
+                impact_areas=["deployment_management"]))
+            diagnoses.append(Diagnosis(
+                id="node_shutdown:stalled_drain",
+                cause="remove-type shutdown with shard copies whose "
+                      "recoveries are no longer progressing",
+                action="check GET /_recovery on the stuck shards, or "
+                       "add capacity so copies have somewhere to go",
+                affected_resources=stalled_nodes))
+        else:
+            status = HealthStatus.YELLOW
+            symptom = (f"{len(per_node)} node shutdown(s) registered "
+                       "(restart window open or drain in progress)")
+            impacts.append(Impact(
+                id="shutdown_in_progress", severity=3,
+                description="reduced redundancy while nodes restart or "
+                            "drain; allocation is intentionally delayed",
+                impact_areas=["deployment_management"]))
+        return HealthIndicatorResult(
+            name=self.name, status=status, symptom=symptom,
+            details=details, impacts=impacts, diagnoses=diagnoses)
+
+
 # the registry ESTPU-HEALTH01 pins: every HealthIndicator subclass in
 # health/ must appear here, or the linter flags the class definition
 DEFAULT_INDICATORS = (
@@ -481,4 +547,5 @@ DEFAULT_INDICATORS = (
     TaskBacklogIndicator,
     RecoveryProgressIndicator,
     DeviceEngineIndicator,
+    NodeShutdownIndicator,
 )

@@ -54,7 +54,12 @@ LEASE = PairSpec(
     acquire_attrs=("add_retention_lease",),
     release_attrs=("remove_retention_lease",),
 )
-SPECS = [BREAKER, TASK, SPAN, LEASE]
+SHUTDOWN = PairSpec(
+    name="shutdown timer",
+    acquire_attrs=("register_shutdown",),
+    release_attrs=("clear_shutdown",),
+)
+SPECS = [BREAKER, TASK, SPAN, LEASE, SHUTDOWN]
 
 # drain method shapes for PAIR02 ("finish" intentionally absent)
 _DRAIN_HINTS = ("close", "release", "stop", "shutdown", "clear",

@@ -314,6 +314,12 @@ def _register_all(c: RestController):
     c.register("DELETE", "/_autoscaling/policy/{name}",
                autoscaling_delete)
     c.register("GET", "/_autoscaling/capacity", autoscaling_capacity)
+    # rolling upgrades: node-shutdown markers (ref: x-pack shutdown)
+    c.register("GET", "/_nodes/shutdown", get_all_node_shutdowns)
+    c.register("PUT", "/_nodes/{node_id}/shutdown", put_node_shutdown)
+    c.register("GET", "/_nodes/{node_id}/shutdown", get_node_shutdown)
+    c.register("DELETE", "/_nodes/{node_id}/shutdown",
+               delete_node_shutdown)
     # extended _cat family (ref: rest/action/cat/)
     c.register("GET", "/_cat/nodes", cat_nodes)
     c.register("GET", "/_cat/plugins", cat_plugins)
@@ -3251,14 +3257,107 @@ def autoscaling_capacity(node, params, body):
 
 
 # --------------------------------------------------------------------------
+# node shutdown (ref: x-pack shutdown plugin — single-node flavour; the
+# cluster plane lives on ClusterNode's NODE_SHUTDOWN_* transport actions)
+# --------------------------------------------------------------------------
+
+def _shutdown_store(node) -> Dict[str, Dict[str, Any]]:
+    """Per-node persisted shutdown-marker store (cluster-state metadata
+    in the multi-node plane)."""
+    import os
+    if not hasattr(node, "node_shutdowns"):
+        path = os.path.join(node.data_path, "_node_shutdown.json")
+        markers = {}
+        if os.path.exists(path):
+            with open(path) as fh:
+                markers = json.load(fh)
+        node.node_shutdowns = markers
+        node._node_shutdown_path = path
+    return node.node_shutdowns
+
+
+def _shutdown_persist(node) -> None:
+    with open(node._node_shutdown_path, "w") as fh:
+        json.dump(node.node_shutdowns, fh)
+
+
+def _describe_single_node_shutdown(marker: Dict[str, Any]
+                                   ) -> Dict[str, Any]:
+    from elasticsearch_tpu.cluster.state import (
+        SHUTDOWN_COMPLETE, SHUTDOWN_REMOVE, SHUTDOWN_STALLED)
+    # one-box semantics: a `restart` has nothing to drain (COMPLETE);
+    # a `remove` has no peer to drain to, so it reports STALLED — the
+    # honest answer, matching the multi-node status vocabulary
+    status = (SHUTDOWN_STALLED if marker["type"] == SHUTDOWN_REMOVE
+              else SHUTDOWN_COMPLETE)
+    return {**marker, "status": status,
+            "shard_migration": {"status": status}}
+
+
+def put_node_shutdown(node, params, body, node_id):
+    from elasticsearch_tpu.cluster.shutdown import (
+        DEFAULT_SHUTDOWN_DELAY_S, VALID_SHUTDOWN_TYPES, parse_time_s)
+    body = body or {}
+    sd_type = body.get("type")
+    if sd_type not in VALID_SHUTDOWN_TYPES:
+        raise IllegalArgumentException(
+            f"invalid shutdown type [{sd_type}]; must be one of "
+            f"{sorted(VALID_SHUTDOWN_TYPES)}")
+    if node_id != node.node_id:
+        raise ResourceNotFoundException(
+            f"node [{node_id}] not found in cluster")
+    delay_s = parse_time_s(body.get("allocation_delay"))
+    import time
+    _shutdown_store(node)[node_id] = {
+        "node_id": node_id, "type": sd_type,
+        "reason": body.get("reason", ""),
+        "shutdown_started": time.time(),
+        "allocation_delay": (DEFAULT_SHUTDOWN_DELAY_S
+                             if delay_s is None else delay_s),
+    }
+    _shutdown_persist(node)
+    return 200, {"acknowledged": True}
+
+
+def get_node_shutdown(node, params, body, node_id):
+    store = _shutdown_store(node)
+    if node_id not in store:
+        raise ResourceNotFoundException(
+            f"no shutdown marker for node [{node_id}]")
+    return 200, {"nodes": {
+        node_id: _describe_single_node_shutdown(store[node_id])}}
+
+
+def get_all_node_shutdowns(node, params, body):
+    store = _shutdown_store(node)
+    return 200, {"nodes": {
+        nid: _describe_single_node_shutdown(m)
+        for nid, m in sorted(store.items())}}
+
+
+def delete_node_shutdown(node, params, body, node_id):
+    store = _shutdown_store(node)
+    if node_id not in store:
+        raise ResourceNotFoundException(
+            f"no shutdown marker for node [{node_id}]")
+    del store[node_id]
+    _shutdown_persist(node)
+    return 200, {"acknowledged": True}
+
+
+# --------------------------------------------------------------------------
 # extended _cat family (ref: rest/action/cat/)
 # --------------------------------------------------------------------------
 
 def cat_nodes(node, params, body):
     import resource
+    from elasticsearch_tpu.transport.transport import CURRENT_VERSION
     ru = resource.getrusage(resource.RUSAGE_SELF)
+    # ip heap.mb version node.role master name — the wire-version
+    # column is what an operator watches during a rolling upgrade
     return 200, {"_cat": (
-        f"127.0.0.1 {int(ru.ru_maxrss / 1024)} - dimr * {node.name}")}
+        f"127.0.0.1 {int(ru.ru_maxrss / 1024)} v{CURRENT_VERSION} "
+        f"dimr * {node.name}")}
 
 
 def cat_master(node, params, body):

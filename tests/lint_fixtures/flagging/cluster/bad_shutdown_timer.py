@@ -1,0 +1,11 @@
+# lint-fixture: flags=ESTPU-PAIR01
+"""A master applier that arms a delayed-allocation deadline timer,
+then publishes — and the publication can raise before the timer is
+ever cleared. The orphaned timer later fires into a state that no
+longer carries its shutdown marker: the shutdown-timer leak shape."""
+
+
+def arm_shutdown_window(timers, node_id, deadline, publish):
+    timers.register_shutdown(node_id, deadline, lambda: None)
+    publish(node_id)  # lint-expect: ESTPU-PAIR01
+    timers.clear_shutdown(node_id)

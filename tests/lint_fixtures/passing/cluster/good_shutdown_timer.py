@@ -1,0 +1,12 @@
+# lint-fixture: passes=ESTPU-PAIR01
+"""The paired twin of bad_shutdown_timer.py: the timer is cleared in a
+``finally``, so a failed publication cannot strand an armed deadline —
+every exit path disarms the shutdown window."""
+
+
+def arm_shutdown_window(timers, node_id, deadline, publish):
+    timers.register_shutdown(node_id, deadline, lambda: None)
+    try:
+        publish(node_id)
+    finally:
+        timers.clear_shutdown(node_id)

@@ -176,3 +176,58 @@ def test_mixed_wire_version_cluster_forms():
     finally:
         old.close()
         new.close()
+
+
+@pytest.mark.chaos(seed=41)
+def test_join_below_min_compatible_refused_typed(tmp_path, chaos_seed):
+    """The join barrier refuses a wire version the fleet cannot talk
+    to, with the typed coordination error (not a generic reject)."""
+    from elasticsearch_tpu.cluster.coordination import (
+        IncompatibleVersionException)
+    from elasticsearch_tpu.testing.deterministic import (
+        DisruptableTransport)
+    from elasticsearch_tpu.transport.transport import DiscoveryNode
+    from test_cluster_node import SimDataCluster
+
+    c = SimDataCluster(2, tmp_path, seed=chaos_seed)
+    m = c.stabilise()
+    ghost = DiscoveryNode(node_id="dn-ancient", name="dn-ancient",
+                          host="127.0.0.1", port=0)
+    # the ghost handshakes at wire version 0 — below the floor the
+    # fleet can ever talk to
+    ancient = DisruptableTransport(ghost, c.network)
+    ancient.wire_version = 0
+    with pytest.raises(IncompatibleVersionException,
+                       match="below the minimum compatible"):
+        m.coordinator._validate_joiner_version(ghost, None)
+
+
+@pytest.mark.chaos(seed=43)
+def test_v1_rejoin_of_upgraded_cluster_refused(tmp_path, chaos_seed):
+    """Once every member speaks v2 the published min_wire_version is 2
+    and a v1 node is a DOWNGRADE: its rejoin is refused and the cluster
+    stays at the surviving members."""
+    from elasticsearch_tpu.cluster.coordination import (
+        IncompatibleVersionException)
+    from test_cluster_node import SimDataCluster
+
+    c = SimDataCluster(3, tmp_path, seed=chaos_seed)
+    m = c.stabilise()
+    assert m.state.metadata.min_wire_version == 2
+    vid = next(n.node_id for n in c.nodes
+               if n.node_id != m.local_node.node_id)
+    c.call(m.put_node_shutdown, vid, "restart", allocation_delay="60s")
+    c.stop_node(vid)
+    c.run_for(20)
+    # the bounced node comes back DOWNGRADED to wire v1
+    c.restart_node(vid, wire_version=1)
+    c.run_for(60)
+    m = c.master()
+    assert m.state.nodes.size == 2, \
+        "a v1 node must not rejoin a v2-upgraded cluster"
+    assert vid not in {n.node_id for n in m.state.nodes.nodes}
+    # and the barrier refuses it with the typed error
+    joiner = next(n for n in c.nodes if n.node_id == vid)
+    with pytest.raises(IncompatibleVersionException,
+                       match="downgrades are not supported"):
+        m.coordinator._validate_joiner_version(joiner, 1)

@@ -9,6 +9,7 @@ import pytest
 from elasticsearch_tpu.cluster.node import ClusterNode
 from elasticsearch_tpu.cluster.state import SHARD_STARTED
 from elasticsearch_tpu.testing.deterministic import (
+    CONNECTED,
     DISCONNECTED,
     DeterministicTaskQueue,
     DisruptableTransport,
@@ -18,24 +19,58 @@ from elasticsearch_tpu.transport.transport import DiscoveryNode
 
 
 class SimDataCluster:
-    def __init__(self, n_nodes, tmp_path, seed=0, settings=None):
+    def __init__(self, n_nodes, tmp_path, seed=0, settings=None,
+                 wire_version=None):
         self.queue = DeterministicTaskQueue(seed=seed)
         self.network = SimNetwork(self.queue)
         self.nodes = [DiscoveryNode(node_id=f"dn-{i}", name=f"dn{i}")
                       for i in range(n_nodes)]
+        self.settings = settings
+        self.data_paths = {node.node_id: str(tmp_path / node.name)
+                           for node in self.nodes}
         self.cluster_nodes = {}
         for node in self.nodes:
-            transport = DisruptableTransport(node, self.network)
-            cn = ClusterNode(
-                transport, self.queue,
-                data_path=str(tmp_path / node.name),
-                seed_nodes=self.nodes,
-                initial_master_nodes=[n.name for n in self.nodes],
-                rng=self.queue.random,
-                settings=settings)
-            self.cluster_nodes[node.node_id] = cn
+            self._boot_node(node, wire_version)
         for cn in self.cluster_nodes.values():
             cn.start()
+
+    def _boot_node(self, node, wire_version=None):
+        transport = DisruptableTransport(node, self.network)
+        if wire_version is not None:
+            transport.wire_version = wire_version
+        cn = ClusterNode(
+            transport, self.queue,
+            data_path=self.data_paths[node.node_id],
+            seed_nodes=self.nodes,
+            initial_master_nodes=[n.name for n in self.nodes],
+            rng=self.queue.random,
+            settings=self.settings)
+        self.cluster_nodes[node.node_id] = cn
+        return cn
+
+    # -- node restart (rolling upgrades) --------------------------------
+
+    def stop_node(self, node_id):
+        """Simulate a process exit: stop the node's services, then cut
+        every link so in-flight sends to it fail fast (a dead process
+        refuses connections; it does not answer from the grave)."""
+        cn = self.cluster_nodes.pop(node_id)
+        cn.stop()
+        node = cn.local_node
+        self.network.isolate(node, self.nodes, mode=DISCONNECTED)
+        return cn
+
+    def restart_node(self, node_id, wire_version=None):
+        """Boot a FRESH ClusterNode over the stopped node's data dir —
+        gateway state reload, translog replay, and re-join handshake,
+        optionally at a new wire version (the upgrade)."""
+        node = next(n for n in self.nodes if n.node_id == node_id)
+        for other in self.nodes:
+            if other.node_id != node_id:
+                self.network.set_link(node, other, CONNECTED)
+        cn = self._boot_node(node, wire_version)
+        cn.start()
+        return cn
 
     def run_for(self, seconds):
         self.queue.run_for(seconds)

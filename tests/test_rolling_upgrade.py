@@ -1,0 +1,354 @@
+"""Rolling upgrade of a live cluster (ISSUE 14 acceptance; ref:
+qa/rolling-upgrade/ in the reference):
+
+a 3-node cluster speaking wire v1, booted from the frozen
+``tests/fixtures/bwc_v1.tar.gz`` on-disk fixture, is upgraded
+node-by-node — graceful shutdown marker, stop, restart at wire v2 —
+while staggered bulks and searches keep running. The contract at
+every step: zero acknowledged-write loss, correct search answers in
+every mixed-version configuration (including while the master itself
+restarts), health yellow-not-red during each bounce, shards of a
+node inside its restart window stay DELAYED (no re-replication) and
+reattach without a segment copy, and the entire sequence replays
+byte-identically from its seed.
+"""
+
+import json
+import os
+import shutil
+import tarfile
+
+import pytest
+
+from elasticsearch_tpu.cluster.state import SHARD_STARTED
+from elasticsearch_tpu.health.indicators import shard_availability_summary
+from test_cluster_node import SimDataCluster
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURE = os.path.join(HERE, "fixtures", "bwc_v1.tar.gz")
+MANIFEST = os.path.join(HERE, "fixtures", "bwc_v1.json")
+
+INDEX = "library"
+
+
+# ------------------------------------------------------------ harness
+
+def _boot_v1_cluster(tmp_path, seed):
+    """A 3-node wire-v1 cluster serving the frozen v1 fixture: the
+    primary's store IS the fixture's shard directory (segments +
+    unflushed translog tail), installed under dn-0 via a graceful
+    restart, then replicated to a second node over the v1 protocol."""
+    fix = tmp_path / "fixture"
+    with tarfile.open(FIXTURE) as tar:
+        tar.extractall(fix, filter="data")
+    with open(fix / "data" / INDEX / "_meta.json") as fh:
+        meta = json.load(fh)
+
+    c = SimDataCluster(3, tmp_path, seed=seed, wire_version=1)
+    m = c.stabilise()
+    # pin the primary to dn-0 while the fixture is installed
+    c.call(m.update_cluster_settings,
+           {"cluster.routing.allocation.exclude._id": "dn-1,dn-2"})
+    c.call(m.create_index, INDEX, number_of_shards=1,
+           number_of_replicas=1, mappings=meta["mappings"])
+    c.run_for(40)
+    uuid = c.master().state.metadata.index(INDEX).uuid
+
+    # graceful bounce of dn-0: swap the empty shard store for the
+    # frozen v1 one, then reload — gateway state + translog replay
+    c.call(c.master().put_node_shutdown, "dn-0", "restart",
+           reason="install v1 fixture", allocation_delay="300s")
+    c.stop_node("dn-0")
+    c.run_for(10)
+    shard_dir = os.path.join(c.data_paths["dn-0"], "indices", uuid, "0")
+    shutil.rmtree(shard_dir, ignore_errors=True)
+    shutil.copytree(fix / "data" / INDEX / "0", shard_dir)
+    c.restart_node("dn-0", wire_version=1)
+    c.run_for(40)
+    # lift the pin: the replica recovers over the v1 wire protocol
+    c.call(c.master().update_cluster_settings,
+           {"cluster.routing.allocation.exclude._id": None})
+    c.run_for(90)
+    assert len(c.active_shards(INDEX)) == 2
+    return c
+
+
+def _coordinator(c, down_id=None):
+    """Any live node that is not the one being bounced."""
+    for nid in sorted(c.cluster_nodes):
+        if nid != down_id:
+            return c.cluster_nodes[nid]
+    raise AssertionError("no live coordinator")
+
+
+def _search_ids(c, coord, query, size=10):
+    r = c.call(coord.search, INDEX, {"query": query, "size": size})
+    assert r["_shards"]["failed"] == 0, r["_shards"]
+    return sorted(h["_id"] for h in r["hits"]["hits"]), \
+        r["hits"]["total"]["value"]
+
+
+def _count_all(c, coord):
+    c.call(coord.refresh)
+    _ids, total = _search_ids(c, coord, {"match_all": {}}, size=0)
+    return total
+
+
+def _bulk_docs(c, coord, ids):
+    """Index docs with the given ids; return the ACKNOWLEDGED ids."""
+    items = [{"op": "index", "id": did,
+              "source": {"title": f"upgrade doc {did}", "year": 2026,
+                         "genre": "upgrade"}} for did in ids]
+    resp = c.call(coord.bulk, INDEX, items, timeout=120)
+    acked = []
+    for item, res in zip(items, resp["items"]):
+        if res and "error" not in res:
+            acked.append(item["id"])
+    return acked
+
+
+def _routing_snapshot(state):
+    return sorted(
+        (s.index, s.shard_id, s.state, s.current_node_id or "",
+         s.primary, s.delayed_node_id or "")
+        for s in state.routing_table.all_shards())
+
+
+# ----------------------------------------------------- the acceptance
+
+def _upgrade_scenario(tmp_path, seed):
+    """Run the full rolling upgrade; returns the (JSON-able) event
+    sequence the byte-identical-replay test compares."""
+    with open(MANIFEST) as fh:
+        manifest = json.load(fh)
+    fixture_live = len(manifest["docs"])          # 5 docs, one deleted
+    c = _boot_v1_cluster(tmp_path, seed)
+    events = []
+
+    def record(tag, **extra):
+        m = c.master()
+        events.append({
+            "tag": tag,
+            "master": m.local_node.name,
+            "state_version": m.state.version,
+            "routing": _routing_snapshot(m.state),
+            "health": shard_availability_summary(m.state)["status"],
+            **extra})
+
+    # the frozen fixture serves through the cluster before any upgrade
+    coord = _coordinator(c)
+    assert _count_all(c, coord) == fixture_live
+    ids, _total = _search_ids(c, coord, {"match": {"title": "quick"}})
+    assert ids == ["1", "3"], ids
+    for did in manifest["deleted"]:
+        got, _t = _search_ids(c, coord, {"match_all": {}}, size=20)
+        assert did not in got
+    record("v1-fixture-serving")
+
+    acked = []          # every acknowledged write across the upgrade
+    # non-masters first, the master's own restart last (the hard case:
+    # a new election + voting-config safety mid-upgrade)
+    master_id = c.master().local_node.node_id
+    order = sorted(nid for nid in c.cluster_nodes if nid != master_id)
+    order.append(master_id)
+
+    for step, vid in enumerate(order):
+        coord = _coordinator(c, down_id=vid)
+        acked += _bulk_docs(
+            c, coord, [f"pre-{step}-{i}" for i in range(6)])
+
+        resp = c.call(c.master().put_node_shutdown, vid, "restart",
+                      reason=f"upgrade step {step}",
+                      allocation_delay="600s")
+        assert resp == {"acknowledged": True}
+        status = c.call(c.master().get_node_shutdown, vid)
+        assert status["nodes"][vid]["status"] == "COMPLETE"
+        record(f"shutdown-registered-{vid}")
+
+        c.stop_node(vid)
+        c.run_for(20)
+        m = c.master()
+        # yellow, never red: a replica (or demoted delayed primary)
+        # keeps every shard readable and writable through the bounce
+        assert shard_availability_summary(m.state)["status"] \
+            in ("green", "yellow")
+        # the bounced node's copies are DELAYED, not re-replicated:
+        # nothing initializes on the survivors for those shards
+        delayed = [s for s in m.state.routing_table.all_shards()
+                   if s.delayed]
+        assert all(s.delayed_node_id == vid for s in delayed)
+        assert m.state.metadata.shutdown(vid) is not None
+
+        # staggered traffic against the degraded cluster
+        coord = _coordinator(c, down_id=vid)
+        acked += _bulk_docs(
+            c, coord, [f"mid-{step}-{i}" for i in range(6)])
+        assert _count_all(c, coord) == fixture_live + len(acked)
+        ids, _t = _search_ids(c, coord, {"match": {"title": "quick"}})
+        assert ids == ["1", "3"], (step, ids)
+        # a profile search survives the mixed-version step (the
+        # coordinator clamps the v2-only field for v1 data nodes)
+        r = c.call(coord.search, INDEX,
+                   {"query": {"match": {"title": "quick"}},
+                    "size": 2, "profile": True})
+        assert r["hits"]["total"]["value"] == 2
+        record(f"serving-while-down-{vid}", acked=len(acked))
+
+        # the upgrade: same data dir, wire v2
+        cn = c.restart_node(vid, wire_version=2)
+        c.run_for(60)
+        m = c.master()
+        assert m.state.nodes.size == 3
+        assert m.state.metadata.shutdown(vid) is None, \
+            "restart marker must clear on rejoin"
+        assert len(c.active_shards(INDEX)) == 2
+        assert not [s for s in m.state.routing_table.all_shards()
+                    if s.delayed]
+        # any reattach that DID run (negotiated v2 source) moved zero
+        # segment bytes; v1 sources legitimately fall back to a full
+        # copy — that is the mixed-version recovery clamp
+        for r in cn.data_node.recoveries.values():
+            if r.recovery_type == "existing_store":
+                assert r.total_bytes == 0
+        coord = _coordinator(c)
+        assert _count_all(c, coord) == fixture_live + len(acked)
+        record(f"upgraded-{vid}", acked=len(acked),
+               wire_versions=dict(sorted(
+                   m.state.metadata.node_versions.items())))
+
+    # fully upgraded: every node at v2 and the published floor risen
+    m = c.master()
+    assert m.state.metadata.node_versions == \
+        {nid: 2 for nid in c.cluster_nodes}
+    assert m.state.metadata.min_wire_version == 2
+    assert shard_availability_summary(m.state)["status"] == "green"
+    # zero acknowledged-write loss across all three bounces
+    assert len(acked) == len(order) * 12
+    coord = _coordinator(c)
+    assert _count_all(c, coord) == fixture_live + len(acked)
+
+    # one more graceful bounce, now of a node that HOLDS a copy: with
+    # every peer at v2 the delayed copies must reattach with zero
+    # segment bytes moved — the reattach-without-copy acceptance
+    holder = sorted(s.current_node_id for s in c.active_shards(INDEX))[0]
+    c.call(c.master().put_node_shutdown, holder, "restart",
+           reason="post-upgrade bounce", allocation_delay="600s")
+    c.stop_node(holder)
+    c.run_for(15)
+    cn = c.restart_node(holder)
+    c.run_for(60)
+    reattached = [r for r in cn.data_node.recoveries.values()
+                  if r.recovery_type == "existing_store"]
+    assert reattached, "expected a reattach-without-copy"
+    assert all(r.total_bytes == 0 for r in reattached)
+    m = c.master()
+    assert m.state.metadata.shutdown(holder) is None
+    coord = _coordinator(c)
+    assert _count_all(c, coord) == fixture_live + len(acked)
+    record("upgrade-complete", acked=len(acked), reattach_node=holder,
+           min_wire_version=m.state.metadata.min_wire_version)
+    return events
+
+
+@pytest.mark.chaos(seed=13)
+def test_rolling_upgrade_live_cluster(tmp_path, chaos_seed):
+    events = _upgrade_scenario(tmp_path / "run", chaos_seed)
+    tags = [e["tag"] for e in events]
+    assert tags[0] == "v1-fixture-serving"
+    assert tags[-1] == "upgrade-complete"
+    # health stayed yellow-not-red at every recorded step
+    assert all(e["health"] in ("green", "yellow") for e in events)
+
+
+@pytest.mark.chaos(seed=13)
+def test_rolling_upgrade_replays_byte_identical(tmp_path, chaos_seed):
+    """Same seed, two runs, one event sequence — the determinism
+    contract extends through stop/restart and the upgrade itself."""
+    a = _upgrade_scenario(tmp_path / "a", chaos_seed)
+    b = _upgrade_scenario(tmp_path / "b", chaos_seed)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+# ----------------------------------------- focused delayed-allocation
+
+@pytest.mark.chaos(seed=29)
+def test_delayed_reattach_without_copy_all_v2(tmp_path, chaos_seed):
+    """A v2 node back inside its window reattaches every copy with
+    zero segment bytes moved (translog catch-up only)."""
+    c = SimDataCluster(3, tmp_path, seed=chaos_seed)
+    m = c.stabilise()
+    c.call(m.create_index, "logs", number_of_shards=2,
+           number_of_replicas=1)
+    c.run_for(40)
+    items = [{"op": "index", "id": f"d{i}",
+              "source": {"body": f"doc {i}"}} for i in range(20)]
+    assert c.call(m.bulk, "logs", items)["errors"] == []
+
+    vid = next(n.node_id for n in c.nodes
+               if n.node_id != m.local_node.node_id)
+    c.call(m.put_node_shutdown, vid, "restart",
+           allocation_delay="120s")
+    c.stop_node(vid)
+    c.run_for(20)
+    m = c.master()
+    assert [s.delayed_node_id for s in
+            m.state.routing_table.all_shards() if s.delayed] == [vid]
+
+    cn = c.restart_node(vid)
+    c.run_for(60)
+    assert len(c.active_shards("logs")) == 4
+    reattached = [r for r in cn.data_node.recoveries.values()
+                  if r.recovery_type == "existing_store"]
+    assert reattached and all(r.total_bytes == 0 for r in reattached)
+
+
+@pytest.mark.chaos(seed=31)
+def test_missed_window_promotes_to_reallocation(tmp_path, chaos_seed):
+    """A node that misses its restart window loses the marker (the
+    scheduler-clock timer fires) and its copies re-replicate."""
+    c = SimDataCluster(3, tmp_path, seed=chaos_seed)
+    m = c.stabilise()
+    c.call(m.create_index, "logs", number_of_shards=2,
+           number_of_replicas=1)
+    c.run_for(40)
+    vid = next(n.node_id for n in c.nodes
+               if n.node_id != m.local_node.node_id)
+    c.call(m.put_node_shutdown, vid, "restart", allocation_delay="30s")
+    c.stop_node(vid)
+    c.run_for(15)
+    m = c.master()
+    assert [s for s in m.state.routing_table.all_shards() if s.delayed]
+    c.run_for(60)            # miss the window
+    m = c.master()
+    assert m.state.metadata.shutdown(vid) is None
+    assert not [s for s in m.state.routing_table.all_shards()
+                if s.delayed]
+    active = c.active_shards("logs")
+    assert len(active) == 4
+    assert vid not in {s.current_node_id for s in active}
+
+
+@pytest.mark.chaos(seed=37)
+def test_remove_shutdown_drains_node(tmp_path, chaos_seed):
+    """type=remove drains through the exclude/reroute path and the
+    status tracks the migration down to COMPLETE."""
+    c = SimDataCluster(3, tmp_path, seed=chaos_seed)
+    m = c.stabilise()
+    c.call(m.create_index, "logs", number_of_shards=2,
+           number_of_replicas=1)
+    c.run_for(40)
+    vid = next(n.node_id for n in c.nodes
+               if n.node_id != m.local_node.node_id)
+    c.call(m.put_node_shutdown, vid, "remove", reason="decommission")
+    c.run_for(120)
+    status = c.call(c.master().get_node_shutdown, vid)
+    assert status["nodes"][vid]["status"] == "COMPLETE"
+    assert status["nodes"][vid]["shard_migration"][
+        "shard_migrations_remaining"] == 0
+    active = c.active_shards("logs")
+    assert len(active) == 4
+    assert vid not in {s.current_node_id for s in active}
+    # deleting the marker readmits the node to allocation
+    assert c.call(c.master().delete_node_shutdown, vid) == \
+        {"acknowledged": True}
+    assert c.call(c.master().get_node_shutdown, vid) == {"nodes": {}}
