@@ -1823,6 +1823,162 @@ def run_upgrade_cpu(seed=11):
         }
 
 
+def run_cursors_cpu(seed=13):
+    """Cursor-plane rider (CPU-side, deterministic sim — no jax):
+    boots a 3-node sim cluster, drains a sorted scroll to exhaustion
+    while a context-owning node is killed mid-stream (the portable
+    cursor fails over to another copy at the same continuation point),
+    relocates a PIT-pinned primary with an explicit reroute move (the
+    `pit/…` retention lease transfers at the handoff barrier), and
+    pushes a small async-search backlog through submit/get/delete.
+    Banks pages drained, exactly-once verdicts, failover/lease-
+    transfer counts and the async backlog into the BENCH json
+    `cursors` section BEFORE any backend touch. Replay-stable: seeded
+    queue + virtual clock render the same rows every round."""
+    import tempfile
+
+    from elasticsearch_tpu.cluster.node import ClusterNode
+    from elasticsearch_tpu.testing.deterministic import (
+        DISCONNECTED, DeterministicTaskQueue, DisruptableTransport,
+        SimNetwork)
+    from elasticsearch_tpu.transport.transport import DiscoveryNode
+
+    t_host = time.time()
+    with tempfile.TemporaryDirectory() as tmp:
+        queue = DeterministicTaskQueue(seed=seed)
+        network = SimNetwork(queue)
+        nodes = [DiscoveryNode(node_id=f"kn-{i}", name=f"kn{i}")
+                 for i in range(3)]
+        cluster = {}
+        for node in nodes:
+            cn = ClusterNode(
+                DisruptableTransport(node, network), queue,
+                data_path=os.path.join(tmp, node.name),
+                seed_nodes=nodes,
+                initial_master_nodes=[n.name for n in nodes],
+                rng=queue.random)
+            cluster[node.node_id] = cn
+            cn.start()
+
+        def call(fn, *args, **kwargs):
+            box = {}
+            fn(*args, **kwargs,
+               on_done=lambda r, e=None: box.update(r=r, e=e))
+            for _ in range(120):
+                if box:
+                    break
+                queue.run_for(1.0)
+            if box.get("e") is not None:
+                raise RuntimeError(box["e"])
+            return box.get("r")
+
+        def master():
+            return next(cn for cn in cluster.values()
+                        if cn.is_master())
+
+        def hit_ids(resp):
+            return [h["_id"] for h in resp["hits"]["hits"]]
+
+        queue.run_for(60)
+        call(master().create_index, "bench", number_of_shards=3,
+             number_of_replicas=1)
+        queue.run_for(60)
+        body = {"query": {"match_all": {}}, "sort": [{"n": "desc"}]}
+        call(master().bulk, "bench",
+             [{"op": "index", "id": f"doc-{i}",
+               "source": {"body": f"cursor doc {i}", "n": i}}
+              for i in range(36)])
+        call(master().refresh)
+        whole = hit_ids(call(master().search, "bench",
+                             {**body, "size": 100}))
+
+        # -- scroll drain with a mid-stream node kill (copy failover)
+        coord = master()
+        t_v0 = queue.now()
+        resp = call(coord.search, "bench", {**body, "size": 7},
+                    scroll=300.0)
+        sid, ids, pages = resp["_scroll_id"], hit_ids(resp), 1
+        while resp["hits"]["hits"]:
+            if pages == 2:      # between pages: kill a context owner
+                rec = coord.search_service._scrolls.get(sid, {})
+                victim = next(
+                    (e["node"] for _k, e in
+                     sorted(rec.get("shards", {}).items())
+                     if e["node"] != coord.local_node.node_id), None)
+                if victim is not None:
+                    down = cluster.pop(victim)
+                    down.stop()
+                    for other in nodes:
+                        network.set_link(down.local_node, other,
+                                         DISCONNECTED)
+                    queue.run_for(30)
+            resp = call(coord.scroll, sid, 300.0)
+            ids += hit_ids(resp)
+            pages += 1
+        call(coord.clear_scroll, [sid])
+        scroll_virtual_s = round(queue.now() - t_v0, 1)
+
+        # -- PIT pinned through an explicit primary move (lease travels)
+        call(master().create_index, "pinned", number_of_shards=1,
+             number_of_replicas=0)
+        queue.run_for(60)
+        call(master().bulk, "pinned",
+             [{"op": "index", "id": f"p-{i}",
+               "source": {"body": f"pinned doc {i}", "n": i}}
+              for i in range(12)])
+        call(master().refresh)
+        pit = call(master().open_pit, "pinned", 600.0)["id"]
+        pit_body = {**body, "size": 50, "pit": {"id": pit}}
+        before = hit_ids(call(master().search, "_all", pit_body))
+        state = master().state
+        src = state.routing_table.index("pinned").shard(0) \
+            .primary.current_node_id
+        tgt = next(nid for nid in sorted(cluster) if nid != src)
+        call(master().reroute, commands=[{"move": {
+            "index": "pinned", "shard": 0,
+            "from_node": src, "to_node": tgt}}])
+        queue.run_for(60)
+        after = hit_ids(call(master().search, "_all", pit_body))
+        call(master().close_pit, pit)
+        lease_transfers = sum(cn.data_node.lease_transfers
+                              for cn in cluster.values())
+
+        # -- async-search backlog: submit a burst, then drain it
+        subs = [call(master().submit_async_search, "bench",
+                     {**body, "size": 5},
+                     {"wait_for_completion_timeout": "0s",
+                      "keep_alive": "5m"})
+                for _ in range(4)]
+        queue.run_for(30)
+        backlog = master().async_search.open_async_search_count()
+        done = sum(
+            1 for s in subs
+            if call(master().get_async_search, s["id"],
+                    {})["is_running"] is False)
+        for s in subs:
+            call(master().delete_async_search, s["id"])
+        queue.run_for(10)
+
+        out = {
+            "docs": len(whole),
+            "pages_drained": pages,
+            "scroll_exactly_once": bool(ids == whole),
+            "scroll_virtual_s": scroll_virtual_s,
+            "cursor_failovers": coord.search_service.cursor_failovers,
+            "lease_transfers": lease_transfers,
+            "pit_stable_across_move": bool(before == after and
+                                           len(before) == 12),
+            "async_backlog": backlog,
+            "async_completed": done,
+            "async_open_after_delete":
+                master().async_search.open_async_search_count(),
+            "host_s": round(time.time() - t_host, 1),
+        }
+        for cn in cluster.values():
+            cn.stop()
+        return out
+
+
 # ---------------------------------------------------------------------------
 # Multi-chip serving rows (ISSUE 9): qps at 1/2/4/8 devices for the two
 # mesh serving modes — sharded-corpus (one SPMD fan-out/merge program per
@@ -2296,6 +2452,14 @@ def main():
         parts["upgrade"] = run_upgrade_cpu()
     except Exception as e:  # noqa: BLE001 — the rider must not sink
         log(f"upgrade rider failed: {e!r}")
+    # cursor rows (deterministic sim, no jax): scroll pages drained
+    # through a mid-stream node kill, PIT lease transfers across a
+    # primary move, and the async-search backlog — replay-stable
+    # virtual counts
+    try:
+        parts["cursors"] = run_cursors_cpu()
+    except Exception as e:  # noqa: BLE001 — the rider must not sink
+        log(f"cursors rider failed: {e!r}")
     # ALL CPU-side rows land before ANY jax/backend touch: a dead
     # relay hangs even backend INIT uninterruptibly (observed: hours),
     # and a run killed there must still have parsed output on record

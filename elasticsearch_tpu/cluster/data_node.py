@@ -126,6 +126,30 @@ class LocalShard:
         return (self.index, self.shard_id)
 
 
+@dataclass
+class ReaderContext:
+    """A pinned point-in-time reader over one shard copy (ref: search/
+    internal/ReaderContext.java): the searcher snapshot taken at open
+    time plus the keep-alive bookkeeping the reaper consults. PIT
+    contexts additionally hold a ``pit/{ctx_id}`` retention lease on the
+    primary's tracker so history above the pinned point survives until
+    the context is freed (the PR-12 peer-recovery lease shape)."""
+
+    ctx_id: str
+    index: str
+    shard_id: int
+    searcher: Any                 # ShardSearcher over pinned segments
+    keep_alive: float             # seconds, scheduler clock
+    expires_at: float
+    pit: bool = False
+    retaining_seq_no: int = 0
+    lease: Any = None             # the pit/{ctx_id} RetentionLease
+
+    @property
+    def key(self) -> Tuple[str, int]:
+        return (self.index, self.shard_id)
+
+
 # recovery stages, in order (failed/cancelled are terminal side-exits)
 RECOVERY_STAGES = ("init", "index", "translog", "device", "finalize",
                    "done", "failed", "cancelled")
@@ -248,6 +272,13 @@ class DataNodeService:
                                  _RecoveryContext] = {}
         self._recovery_sources: Dict[Tuple[str, int, str],
                                      Dict[str, Any]] = {}
+        # pinned reader contexts (scroll/PIT) keyed by ctx_id; ids are
+        # per-node counters, NOT uuids — seeded chaos replays must be
+        # byte-identical, and uuid4 in the cursor plane would fork them
+        self.reader_contexts: Dict[str, ReaderContext] = {}
+        self._reader_ctx_seq = 0
+        # observability: PIT contexts re-homed through a primary handoff
+        self.lease_transfers = 0
         self.applied_state: ClusterState = ClusterState()
         os.makedirs(data_path, exist_ok=True)
         for action, handler, can_trip in [
@@ -357,6 +388,11 @@ class DataNodeService:
     def _remove_shard(self, key: Tuple[str, int]) -> None:
         shard = self.shards.pop(key, None)
         if shard is not None:
+            # pinned reader contexts die with the copy: a later lookup
+            # gets the typed search_context_missing path, never a hang
+            for cid in [c for c, rc in self.reader_contexts.items()
+                        if rc.key == key]:
+                self.free_reader_context(cid)
             for rkey in [k for k in self._recovery_ctx
                          if (k[0], k[1]) == key]:
                 # routing moved on while this copy was still recovering:
@@ -1179,7 +1215,34 @@ class DataNodeService:
                     continue  # the departing source drops out
                 tracker.mark_in_sync(alloc, in_sync[alloc])
             shard.tracker = tracker
+            self._adopt_pit_contexts(shard, resp.get("pit_contexts", []))
         self._finish_recovery(ctx)
+
+    def _adopt_pit_contexts(self, shard: LocalShard,
+                            pit_contexts: List[Dict[str, Any]]) -> None:
+        """Target side of the PIT handoff: re-resolve each shipped
+        context's segments BY NAME against the phase-1 file copy and
+        re-register it under the SAME ctx_id with a fresh pit lease.
+        A segment that no longer resolves (created after the snapshot)
+        drops the context — the next read gets the typed
+        search_context_missing_exception, never a wrong answer."""
+        if not pit_contexts:
+            return
+        from elasticsearch_tpu.search.searcher import ShardSearcher
+        by_name = {s.name: s for s in shard.engine.segments}
+        adopted: List[ReaderContext] = []
+        for pc in pit_contexts:
+            segs = [by_name[n] for n in pc["segments"] if n in by_name]
+            if len(segs) != len(pc["segments"]):
+                continue  # pinned view not reconstructible here
+            searcher = ShardSearcher(segs, shard.engine.mapper,
+                                     self.device_cache)
+            adopted.append(self.open_reader_context(
+                shard.index, shard.shard_id, searcher,
+                keep_alive=pc["keep_alive"], pit=True,
+                ctx_id=pc["ctx_id"], expires_at=pc["expires_at"],
+                retaining_seq_no=pc.get("retaining_seq_no", 0)))
+        self.lease_transfers += len(adopted)
 
     def _recovery_legacy_install(self, ctx: _RecoveryContext,
                                  resp: Dict[str, Any]) -> None:
@@ -1400,14 +1463,37 @@ class DataNodeService:
             (shard.index, shard.shard_id, target_alloc), None)
         if src_ctx is not None:
             shard.tracker.remove_retention_lease(src_ctx["lease_id"])
-        channel.send_response({
+        resp = {
             "final_ops": [op.to_dict() for op in final_ops],
             "max_seq_no": shard.engine.tracker.max_seq_no,
             "global_checkpoint": shard.tracker.global_checkpoint,
             "primary_term": shard.engine.primary_term,
             "in_sync": shard.tracker.in_sync_checkpoints(),
             "source_allocation_id": shard.allocation_id,
-        })
+        }
+        if req.get("handoff"):
+            # PIT contexts travel with the primary handoff: with the
+            # barrier up (writes drained) ship each pinned context's
+            # identity + segment names; the target re-resolves them
+            # against its phase-1 file copy and re-takes the lease.
+            # The local context and its lease are freed here — the
+            # contract moves, it is not duplicated.
+            pit_payload = []
+            for cid in sorted(c for c, rc in self.reader_contexts.items()
+                              if rc.key == shard.key and rc.pit):
+                rc = self.reader_contexts[cid]
+                pit_payload.append({
+                    "ctx_id": rc.ctx_id,
+                    "keep_alive": rc.keep_alive,
+                    "expires_at": rc.expires_at,
+                    "retaining_seq_no": rc.retaining_seq_no,
+                    "segments": [s.name for s in rc.searcher.segments],
+                })
+                self.free_reader_context(cid)
+            if pit_payload:
+                self.lease_transfers += len(pit_payload)
+                resp["pit_contexts"] = pit_payload
+        channel.send_response(resp)
 
     # ---------------------------------------------- global checkpoint sync
 
@@ -1417,6 +1503,78 @@ class DataNodeService:
             shard.global_checkpoint = max(shard.global_checkpoint,
                                           req.get("global_checkpoint", -1))
         channel.send_response({"ok": True})
+
+    # ------------------------------------------------- reader contexts
+
+    def open_reader_context(self, index: str, shard_id: int,
+                            searcher, keep_alive: float,
+                            pit: bool = False,
+                            ctx_id: Optional[str] = None,
+                            expires_at: Optional[float] = None,
+                            retaining_seq_no: Optional[int] = None
+                            ) -> ReaderContext:
+        """Pin a searcher for scroll/PIT continuation. A PIT context on
+        a primary also takes a ``pit/{ctx_id}`` retention lease so the
+        pinned history survives merges-of-the-future and peer recovery
+        retention pruning (ref: SearchService.createAndPutReaderContext
+        + the PIT lease contract)."""
+        if ctx_id is None:
+            self._reader_ctx_seq += 1
+            ctx_id = f"{self.local_node.node_id}-rc-{self._reader_ctx_seq}"
+        now = self.scheduler.now()
+        shard = self.shards.get((index, shard_id))
+        if retaining_seq_no is None:
+            retaining_seq_no = 0
+            if shard is not None and shard.tracker is not None:
+                retaining_seq_no = max(
+                    0, shard.tracker.global_checkpoint + 1)
+        ctx = ReaderContext(
+            ctx_id=ctx_id, index=index, shard_id=shard_id,
+            searcher=searcher, keep_alive=keep_alive,
+            expires_at=(expires_at if expires_at is not None
+                        else now + keep_alive),
+            pit=pit, retaining_seq_no=retaining_seq_no)
+        if pit and shard is not None and shard.tracker is not None:
+            lease = shard.tracker.add_retention_lease(
+                f"pit/{ctx_id}", retaining_seq_no,
+                source="point in time")
+            ctx.lease = lease   # registry owns the release (free path)
+        self.reader_contexts[ctx_id] = ctx
+        return ctx
+
+    def get_reader_context(self, ctx_id: str
+                           ) -> Optional[ReaderContext]:
+        """Resolve a pinned context, reaping expired ones lazily (no
+        periodic task — a scheduled reaper would perturb the seeded
+        interleavings of every existing chaos suite). A hit refreshes
+        the keep-alive."""
+        self._reap_reader_contexts()
+        ctx = self.reader_contexts.get(ctx_id)
+        if ctx is not None:
+            ctx.expires_at = self.scheduler.now() + ctx.keep_alive
+        return ctx
+
+    def free_reader_context(self, ctx_id: str) -> bool:
+        ctx = self.reader_contexts.pop(ctx_id, None)
+        if ctx is None:
+            return False
+        if ctx.pit:
+            shard = self.shards.get(ctx.key)
+            if shard is not None and shard.tracker is not None:
+                try:
+                    shard.tracker.remove_retention_lease(f"pit/{ctx_id}")
+                except Exception:
+                    pass  # lease travelled away with a handoff
+        return True
+
+    def _reap_reader_contexts(self) -> None:
+        now = self.scheduler.now()
+        for cid in [c for c, ctx in self.reader_contexts.items()
+                    if ctx.expires_at <= now]:
+            self.free_reader_context(cid)
+
+    def open_reader_context_count(self) -> int:
+        return len(self.reader_contexts)
 
     # ---------------------------------------------------------- lifecycle
 

@@ -223,6 +223,20 @@ class ClusterNode:
         # the other nodes (the local ban died with the task)
         self.search_service.on_cancelled_parent_done = \
             lambda tid: self._broadcast_ban(tid, "done", remove=True)
+        # cluster-aware async search: ids encode this node, get/delete
+        # from any node route here; the fan-out runs under a cancellable
+        # parent task owned by the async service
+        from elasticsearch_tpu.search.async_search import (
+            ClusterAsyncSearchService)
+        self.async_search = ClusterAsyncSearchService(
+            transport, scheduler, self.task_manager,
+            search_fn=lambda index, body, on_done, task=None:
+                self.search_service.search(self.state, index, body,
+                                           on_done, task=task),
+            state_fn=lambda: self.state,
+            cancel_local=self._cancel_local,
+            on_cancelled_parent_done=lambda tid: self._broadcast_ban(
+                tid, "done", remove=True))
         # secure-settings keystore (ref: node/Node.java:389-391 wiring of
         # ConsistentSettingsService): when present, the elected master
         # publishes salted hashes and joiners must match them
@@ -1261,5 +1275,45 @@ class ClusterNode:
                 ResponseHandler(one, one), timeout=30.0)
 
     def search(self, index: str, body: Dict[str, Any],
+               on_done: Callable = lambda r, e: None,
+               scroll: Optional[float] = None) -> None:
+        self.search_service.search(self.state, index, body, on_done,
+                                   scroll=scroll)
+
+    # ------------------------------------------------- cursors (scroll/PIT)
+
+    def scroll(self, scroll_id: str, keep_alive: Optional[float] = None,
                on_done: Callable = lambda r, e: None) -> None:
-        self.search_service.search(self.state, index, body, on_done)
+        self.search_service.scroll(self.state, scroll_id, keep_alive,
+                                   on_done)
+
+    def clear_scroll(self, scroll_ids: List[str],
+                     on_done: Callable = lambda r, e: None) -> None:
+        self.search_service.clear_scroll(self.state, scroll_ids, on_done)
+
+    def open_pit(self, index: str, keep_alive: Optional[float] = None,
+                 on_done: Callable = lambda r, e: None) -> None:
+        self.search_service.open_pit(self.state, index, keep_alive,
+                                     on_done)
+
+    def close_pit(self, pit_id: str,
+                  on_done: Callable = lambda r, e: None) -> None:
+        self.search_service.close_pit(self.state, pit_id, on_done)
+
+    # ------------------------------------------------------- async search
+
+    def submit_async_search(self, index: str, body: Dict[str, Any],
+                            params: Optional[Dict[str, str]] = None,
+                            on_done: Callable = lambda r, e: None
+                            ) -> None:
+        self.async_search.submit(index, body, params, on_done)
+
+    def get_async_search(self, search_id: str,
+                         params: Optional[Dict[str, str]] = None,
+                         on_done: Callable = lambda r, e: None) -> None:
+        self.async_search.get(search_id, params, on_done)
+
+    def delete_async_search(self, search_id: str,
+                            on_done: Callable = lambda r, e: None
+                            ) -> None:
+        self.async_search.delete(search_id, on_done)

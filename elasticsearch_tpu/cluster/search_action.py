@@ -68,6 +68,7 @@ Observability (telemetry/): with a ``Telemetry`` bundle wired (one
 
 from __future__ import annotations
 
+import base64
 import threading
 import time
 from contextlib import nullcontext as _nullcontext
@@ -82,9 +83,11 @@ from elasticsearch_tpu.cluster.routing import (
 from elasticsearch_tpu.cluster.state import ClusterState, ShardRouting
 from elasticsearch_tpu.common.errors import (
     BACKPRESSURE_ERROR_TYPES,
+    IllegalArgumentException,
     IndexNotFoundException,
     NodeNotConnectedException,
     NoShardAvailableActionException,
+    SearchContextMissingException,
     SearchPhaseExecutionException,
     error_type_of,
     failure_type_of,
@@ -107,6 +110,17 @@ PROFILE_WIRE_VERSION = 2
 QUERY_PHASE_ACTION = "indices:data/read/search[phase/query]"
 FETCH_PHASE_ACTION = "indices:data/read/search[phase/fetch/id]"
 SEARCH_ACTION = "indices:data/read/search"
+SCROLL_ACTION = "indices:data/read/scroll"
+FREE_CONTEXT_ACTION = "indices:data/read/search[free_context]"
+OPEN_PIT_SHARD_ACTION = "indices:data/read/open_point_in_time[shard]"
+
+# cursor continuation defaults (the scroll/PIT keep-alive clock is the
+# SCHEDULER clock — wall time never reaps a context under the
+# deterministic harness, so seeded replays stay byte-identical)
+DEFAULT_SCROLL_KEEPALIVE = 300.0
+DEFAULT_PIT_KEEPALIVE = 300.0
+
+SEARCH_CONTEXT_MISSING_TYPE = "search_context_missing_exception"
 
 # the wire type a cancelled task reports (TaskCancelledException)
 TASK_CANCELLED_TYPE = "task_cancelled_exception"
@@ -231,6 +245,21 @@ class _WallClock:
         return t  # threading.Timer exposes cancel(), like Cancellable
 
 
+class _CopyListIterator:
+    """A ShardIterator stand-in over a pre-ranked copy list: cursor
+    continuations pin the copy ORDER (recorded context owner first, then
+    failover candidates) instead of re-running ARS ranking — a page must
+    go back to the node holding its reader context."""
+
+    __slots__ = ("_copies",)
+
+    def __init__(self, copies: List[ShardRouting]):
+        self._copies = list(copies)
+
+    def next_or_none(self) -> Optional[ShardRouting]:
+        return self._copies.pop(0) if self._copies else None
+
+
 class _ShardGroup:
     """Coordinator-side retry state for one shard group."""
 
@@ -282,10 +311,25 @@ class DistributedSearchService:
         # coordinator-side slow log, same entry shape as the single-node
         # service's (search/slowlog.py)
         self.slowlog_recent: List[Dict[str, Any]] = []
+        # cursor plane (coordinator-held): scroll records carry the
+        # per-shard continuation state (owning node, reader context id,
+        # lastEmittedDoc cursor, ES-level sort_values for failover);
+        # PIT records pin {shard → (node, ctx)} under a keep-alive.
+        # Ids are node-scoped counters — deterministic under seed replay.
+        self._scrolls: Dict[str, Dict[str, Any]] = {}
+        self._pits: Dict[str, Dict[str, Any]] = {}
+        self._cursor_seq = 0
+        # observability: continuation pages that had to re-home a shard
+        # stream onto a different copy (the node-kill failover path)
+        self.cursor_failovers = 0
         transport.register_request_handler(QUERY_PHASE_ACTION,
                                            self._on_query_phase)
         transport.register_request_handler(FETCH_PHASE_ACTION,
                                            self._on_fetch_phase)
+        transport.register_request_handler(FREE_CONTEXT_ACTION,
+                                           self._on_free_context)
+        transport.register_request_handler(OPEN_PIT_SHARD_ACTION,
+                                           self._on_open_pit_shard)
 
     # -------------------------------------------------- data-node handlers
 
@@ -306,6 +350,54 @@ class DistributedSearchService:
     def _register_child(self, action: str, description: str):
         return register_child_of_incoming(
             self.task_manager, action, description=description)
+
+    def _on_free_context(self, req, channel, src) -> None:
+        """Release pinned reader contexts (clear_scroll / close_pit /
+        coordinator-side reap). Unknown ids are a no-op — frees are
+        idempotent so a retry after a dropped response cannot fail."""
+        freed = 0
+        for cid in req.get("contexts", []):
+            if self.data_node.free_reader_context(cid):
+                freed += 1
+        channel.send_response({"freed": freed})
+
+    def _on_open_pit_shard(self, req, channel, src) -> None:
+        """Open one shard's PIT reader: pin the current searcher under a
+        reader context + retention lease (ref:
+        TransportOpenPointInTimeAction shard fan-out)."""
+        index, shard_id = req["index"], req["shard_id"]
+        searcher = self._searcher_for(index, shard_id)
+        if searcher is None:
+            channel.send_exception(NoShardAvailableActionException(
+                f"[{index}][{shard_id}] has no started copy here"))
+            return
+        rc = self.data_node.open_reader_context(
+            index, shard_id, searcher,
+            keep_alive=float(req.get("keep_alive",
+                                     DEFAULT_PIT_KEEPALIVE)),
+            pit=True)
+        channel.send_response({"ctx": rc.ctx_id})
+
+    def _resolve_reader(self, req, shard_id: int):
+        """(searcher, ctx_id, error) for one shard of a query/fetch
+        request: a pinned context when the coordinator named one (typed
+        search_context_missing when it is gone — never silence), else a
+        fresh searcher over the live segment set."""
+        cid = (req.get("contexts") or {}).get(str(shard_id))
+        if cid is not None:
+            rc = self.data_node.get_reader_context(cid)
+            if rc is None or rc.key != (req["index"], shard_id):
+                return None, None, {
+                    "shard": shard_id,
+                    "error": f"No search context found for id [{cid}]",
+                    "type": SEARCH_CONTEXT_MISSING_TYPE}
+            return rc.searcher, cid, None
+        searcher = self._searcher_for(req["index"], shard_id)
+        if searcher is None:
+            return None, None, {"shard": shard_id,
+                                "error": "shard not started here",
+                                "type": "shard_not_found_exception"}
+        return searcher, None, None
 
     def _on_query_phase(self, req, channel, src) -> None:
         """Run the query phase on the named local shards; serializable
@@ -333,7 +425,6 @@ class DistributedSearchService:
             span = tele.tracer.start_span(
                 "shard_query",
                 tags={"index": req.get("index"), "shards": shards})
-        t_wall = time.monotonic()
         body = req.get("body") or {}
         try:
             query = (parse_query(body["query"]) if body.get("query")
@@ -360,10 +451,14 @@ class DistributedSearchService:
                     (self.scheduler.now() - t0) * 1000.0)
                 span.finish(cancelled=bool(
                     child is not None and child.is_cancelled()))
-            took = time.monotonic() - t_wall
+            # EWMA inputs for adaptive replica selection, measured on
+            # the SCHEDULER clock (production scheduler = monotonic wall
+            # time; deterministic harness = virtual time). Wall time
+            # here would make copy ranking — and therefore routing —
+            # diverge between same-seed runs.
+            took = self.scheduler.now() - t0
             channel.send_response({
                 "results": st["results"],
-                # EWMA inputs for adaptive replica selection
                 "service_time_ns": took * 1e9,
                 "queue_size": 0,
             })
@@ -413,12 +508,27 @@ class DistributedSearchService:
         prof_rec: Dict[str, Any] = {}
         prof_entry = None
         churn0 = (0, 0)
+        # cursor-plane request extensions (absent on a plain search):
+        # `contexts` pins the shard to a reader context, `cursors` is the
+        # exact lastEmittedDoc continuation, `search_afters` re-opens a
+        # failover stream at ES-level sort values, `scroll` asks this
+        # node to pin a context for the pages that follow
+        continuing = bool(req.get("continuing"))
+        scroll_ka = req.get("scroll")
+        shard_search_after = (req.get("search_afters") or {}).get(
+            str(shard_id), body.get("search_after"))
+        cursor = (req.get("cursors") or {}).get(str(shard_id))
         try:
-            searcher = self._searcher_for(req["index"], shard_id)
-            if searcher is None:
-                return {"shard": shard_id,
-                        "error": "shard not started here",
-                        "type": "shard_not_found_exception"}
+            searcher, ctx_id, err = self._resolve_reader(req, shard_id)
+            if err is not None:
+                return err
+            if scroll_ka is not None and ctx_id is None:
+                # first page (or failover re-open): pin THIS searcher so
+                # later pages see the same segment snapshot
+                rc = self.data_node.open_reader_context(
+                    req["index"], shard_id, searcher,
+                    keep_alive=float(scroll_ka))
+                ctx_id = rc.ctx_id
             with ExitStack() as stack:
                 if self.telemetry is not None:
                     stack.enter_context(
@@ -446,9 +556,18 @@ class DistributedSearchService:
                     post_filter=post_filter,
                     min_score=body.get("min_score"),
                     sort=body.get("sort"),
-                    search_after=body.get("search_after"),
-                    track_total_hits=bool(body.get("track_total_hits",
-                                                   True)),
+                    search_after=shard_search_after,
+                    # continuation pages report the total pinned at page
+                    # one (the coordinator re-stamps it) — skip the count
+                    track_total_hits=(bool(body.get("track_total_hits",
+                                                    True))
+                                      and not continuing),
+                    after_key=(tuple(cursor) if cursor else None),
+                    # scroll pages must not switch between the plan and
+                    # dense executors mid-stream: float32 sums differ in
+                    # the last bits between executors, and a cursor walk
+                    # needs one consistent order end to end
+                    allow_plan=(scroll_ka is None and not continuing),
                     collect_masks=bool(aggs_spec))
                 if aggs_spec:
                     # the shard's mergeable partial (moments/sketches/
@@ -490,10 +609,16 @@ class DistributedSearchService:
             "max_score": result.max_score,
             "aggs": agg_partial,
             "profile": prof_entry,
+            # the reader context serving (or opened by) this page — the
+            # coordinator records it as the shard's continuation home
+            "ctx": ctx_id,
             # the stored _id travels with the address: segment names
             # are engine-local (uuid-prefixed), so a fetch that fails
-            # over to ANOTHER copy resolves the doc by _id instead
+            # over to ANOTHER copy resolves the doc by _id instead.
+            # seg_i is the segment's index WITHIN the pinned searcher —
+            # the coordinator echoes it back as the after_key cursor.
             "docs": [{"seg": searcher.segments[d.segment_idx].name,
+                      "seg_i": d.segment_idx,
                       "docid": d.docid, "score": d.score,
                       "id": searcher.segments[d.segment_idx]
                       .stored.ids[d.docid],
@@ -535,7 +660,18 @@ class DistributedSearchService:
                 # raises typed, the coordinator reports (never retries)
                 child.ensure_not_cancelled()
             shard_id = int(shard_id)
-            searcher = self._searcher_for(req["index"], shard_id)
+            # a scroll/PIT fetch names the shard's pinned context so the
+            # sources come off the SAME snapshot the query phase walked;
+            # a plain fetch (or a lost context) uses the live segments
+            # and falls back to resolving docs by stored _id below
+            searcher = None
+            cid = (req.get("contexts") or {}).get(str(shard_id))
+            if cid is not None:
+                rc = self.data_node.get_reader_context(cid)
+                if rc is not None and rc.key == (req["index"], shard_id):
+                    searcher = rc.searcher
+            if searcher is None:
+                searcher = self._searcher_for(req["index"], shard_id)
             if searcher is None:
                 for wd in wire_docs:
                     hits_out.append({"_lost": True, "_ord": wd["ord"],
@@ -584,9 +720,24 @@ class DistributedSearchService:
     def search(self, state: ClusterState, index_expression: str,
                body: Dict[str, Any],
                on_done: Callable[[Optional[Dict], Optional[Exception]],
-                                 None]) -> None:
-        """Async coordinator (ref: AbstractSearchAsyncAction.run)."""
+                                 None],
+               scroll: Optional[float] = None,
+               task=None, _plan: Optional[Dict[str, Any]] = None) -> None:
+        """Async coordinator (ref: AbstractSearchAsyncAction.run).
+
+        ``scroll`` (keep-alive seconds) opens a distributed scroll: the
+        first page pins a reader context per shard copy and the response
+        carries ``_scroll_id``. ``task`` lets a caller that already owns
+        a registered parent task (async search) run the fan-out under it
+        — registration/unregistration stay with the owner. ``_plan`` is
+        the internal continuation seam: cursor entry points (scroll
+        pages, PIT searches) pass pre-ranked shard groups + request/
+        response hooks and the shared machinery runs unchanged."""
         body = body or {}
+        if _plan is None and body.get("pit"):
+            self._search_pit(state, index_expression, body, on_done,
+                             scroll=scroll, task=task)
+            return
         sched = self.scheduler
         t_start = sched.now()
         tele = self.telemetry
@@ -599,9 +750,11 @@ class DistributedSearchService:
                 "search", tags={"index": index_expression})
         # the coordinator's cancellable parent task: every per-shard
         # query/fetch RPC carries its id, so data-node children land
-        # under it in `_tasks` and a cancel reaches them via bans
-        task = None
-        if self.task_manager is not None:
+        # under it in `_tasks` and a cancel reaches them via bans.
+        # A caller-owned task (async search) is used as-is — its owner
+        # unregisters it and sweeps its bans.
+        owns_task = task is None
+        if owns_task and self.task_manager is not None:
             with (_telectx.activate_span(root_span) if root_span
                   is not None else _nullcontext()):
                 task = self.task_manager.register(
@@ -615,7 +768,7 @@ class DistributedSearchService:
             """Single completion seam for every exit: unregister the
             parent task, close the root span, record node metrics + the
             coordinator slow log, then hand the result to the caller."""
-            if task is not None:
+            if task is not None and owns_task:
                 was_cancelled = getattr(task, "is_cancelled",
                                         lambda: False)()
                 self.task_manager.unregister(task)
@@ -709,12 +862,25 @@ class DistributedSearchService:
         except Exception as e:  # noqa: BLE001 — resolution/parse errors
             finish(None, e)
             return
+        if _plan is not None and "allow_partial" in _plan:
+            # a scroll page / PIT read is all-or-typed-error: a silently
+            # truncated page is indistinguishable from exhaustion
+            allow_partial = _plan["allow_partial"]
+        if scroll is not None and _plan is None:
+            # the OPENING page of a scroll is all-or-typed-error too —
+            # a partially-delivered page would advance lastEmittedDoc
+            # cursors past hits the caller never received
+            allow_partial = False
         k = from_ + size
 
         groups: List[_ShardGroup] = []
-        for index in indices:
-            for it in self.routing.shard_iterators(state, index):
-                groups.append(_ShardGroup(index, it.shard_id.shard, it))
+        if _plan is not None and _plan.get("groups") is not None:
+            groups = _plan["groups"]
+        else:
+            for index in indices:
+                for it in self.routing.shard_iterators(state, index):
+                    groups.append(
+                        _ShardGroup(index, it.shard_id.shard, it))
         if not groups:
             resp = self._empty_response()
             resp["took"] = int((sched.now() - t_start) * 1000)
@@ -752,6 +918,15 @@ class DistributedSearchService:
             "profile_shards": [],
             "phase_ns": {},
         }
+        # cursor hook seams (absent on a plain search; ctx.get → None):
+        #   reader_ext(node, index, batch)      → query payload extras
+        #   on_shard_query(g, node, index, sr)  → record continuation
+        #   fetch_ext(node, index, docs_by_shard) → fetch payload extras
+        #   on_page(page, resp)                 → advance cursors/stamp id
+        if scroll is not None and _plan is None:
+            self._install_scroll_open_hooks(ctx, body, scroll, indices)
+        if _plan is not None:
+            ctx.update(_plan.get("hooks", {}))
         if task is not None:
             task.profile_stage = "phase/query"
 
@@ -790,6 +965,482 @@ class DistributedSearchService:
             self._send_query(ctx, node_id, index, batch)
         for g, exc in immediate_fail:
             self._shard_attempt_failed(ctx, g, None, exc)
+
+    # -- cursor plane ----------------------------------------------------
+    #
+    # Coordinator-held continuation state (ref:
+    # SearchScrollQueryThenFetchAsyncAction + the lastEmittedDoc
+    # contract): each scroll/PIT record maps (index, shard) → {node,
+    # ctx, cursor, sort_after}. ``cursor`` is the exact lastEmittedDoc
+    # 4-tuple (sort_key, seg_idx, docid, sort_value) the PINNED context
+    # resumes from; ``sort_after`` is the copy-independent ES-level
+    # sort_values used to re-open the stream on ANOTHER copy after a
+    # node kill. Failover matrix:
+    #
+    #   copy alive, ctx alive      → continue from cursor (exact)
+    #   copy dead, explicit sort   → re-open on another copy with
+    #                                search_after = sort_after (exact)
+    #   copy dead, nothing emitted → restart that shard stream (exact)
+    #   copy dead, no sort, cursor → typed search_context_missing
+    #                                (score-sorted streams are not
+    #                                portable across copies)
+
+    def _next_cursor_seq(self) -> int:
+        self._cursor_seq += 1
+        return self._cursor_seq
+
+    def _make_fetch_ext(self, entries: Dict[Tuple[str, int],
+                                            Dict[str, Any]]):
+        """Fetch-phase payload extras: name the pinned context for every
+        shard whose docs are fetched FROM the node that owns it, so the
+        fetch reads the same pinned segment view the query phase saw."""
+        def fetch_ext(node_id, index, docs_by_shard):
+            ctxs = {}
+            for sid in docs_by_shard:
+                e = entries.get((index, sid))
+                if e and e.get("ctx") and e["node"] == node_id:
+                    ctxs[str(sid)] = e["ctx"]
+            return {"contexts": ctxs} if ctxs else {}
+        return fetch_ext
+
+    def _install_scroll_open_hooks(self, ctx: Dict, body: Dict[str, Any],
+                                   keep_alive: float,
+                                   indices: List[str]) -> None:
+        """First page of a scroll: ask every shard to pin a reader
+        context, record who answered, and stamp a deterministic
+        ``_scroll_id`` onto the merged page."""
+        entries: Dict[Tuple[str, int], Dict[str, Any]] = {}
+        # with an explicit sort the stream is PORTABLE: sort_values are
+        # copy-independent, so a dead copy's stream re-opens elsewhere
+        # via search_after; score-sorted streams are welded to their
+        # pinned context
+        portable = bool(body.get("sort"))
+
+        def reader_ext(node_id, index, batch):
+            return {"scroll": keep_alive}
+
+        def on_shard_query(g, node_id, index, sr):
+            entries[(index, sr["shard"])] = {
+                "node": node_id, "ctx": sr.get("ctx"),
+                "cursor": None, "sort_after": None}
+
+        def on_page(page, resp):
+            scroll_id = (f"{self.transport.local_node.node_id}"
+                         f":scroll:{self._next_cursor_seq()}")
+            rec = {
+                "id": scroll_id,
+                "body": {k: v for k, v in ctx["body"].items()
+                         if k not in ("aggs", "aggregations",
+                                      "profile", "from")},
+                "indices": list(indices),
+                "size": ctx["size"],
+                "keep_alive": keep_alive,
+                "expires_at": self.scheduler.now() + keep_alive,
+                "total": resp["hits"]["total"]["value"],
+                "relation": resp["hits"]["total"].get("relation", "eq"),
+                "shards": entries,
+                "portable": portable,
+            }
+            self._advance_cursors(rec, page)
+            self._scrolls[scroll_id] = rec
+            resp["_scroll_id"] = scroll_id
+
+        ctx["reader_ext"] = reader_ext
+        ctx["on_shard_query"] = on_shard_query
+        ctx["fetch_ext"] = self._make_fetch_ext(entries)
+        ctx["on_page"] = on_page
+
+    @staticmethod
+    def _advance_cursors(rec: Dict[str, Any],
+                         page: List[Dict[str, Any]]) -> None:
+        """lastEmittedDoc: advance each shard's cursor ONLY by the docs
+        that made the merged global page (docs a shard returned that
+        lost the merge are re-sent next page — exactly-once emission)."""
+        for d in page:
+            e = rec["shards"].get((d["_index"], d["_shard"]))
+            if e is None:
+                continue
+            sv = d.get("sort_values") or []
+            e["cursor"] = [d["sort_key"], d.get("seg_i", 0), d["docid"],
+                           (sv[0] if sv else None)]
+            e["sort_after"] = list(sv) or None
+
+    def scroll(self, state: ClusterState, scroll_id: str,
+               keep_alive: Optional[float],
+               on_done: Callable[[Optional[Dict], Optional[Exception]],
+                                 None]) -> None:
+        """One continuation page of a distributed scroll. Every shard
+        stream resumes from its cursor on the owning copy, or fails over
+        per the portability matrix above. A page that cannot be produced
+        exactly surfaces a typed search_context_missing_exception —
+        never a hang, never a silently short page."""
+        self._reap_cursors(state)
+        rec = self._scrolls.get(scroll_id)
+        if rec is None:
+            on_done(None, SearchContextMissingException(scroll_id))
+            return
+        if keep_alive:
+            rec["keep_alive"] = keep_alive
+        rec["expires_at"] = self.scheduler.now() + rec["keep_alive"]
+        ka = rec["keep_alive"]
+        entries = rec["shards"]
+        body = dict(rec["body"])
+        body["size"] = rec["size"]
+        body["track_total_hits"] = False
+
+        groups: List[_ShardGroup] = []
+        for (index, shard) in sorted(entries):
+            copies = self._scroll_copy_plan(
+                state, index, shard, entries[(index, shard)],
+                rec["portable"])
+            groups.append(_ShardGroup(index, shard,
+                                      _CopyListIterator(copies)))
+        # superseded contexts (a stream that failed over mid-page):
+        # collected under the coordinator lock, freed after the page
+        stale: Dict[str, List[str]] = {}
+
+        def reader_ext(node_id, index, batch):
+            ext: Dict[str, Any] = {"scroll": ka, "continuing": True}
+            ctxs: Dict[str, str] = {}
+            curs: Dict[str, Any] = {}
+            afters: Dict[str, Any] = {}
+            for g in batch:
+                e = entries.get((index, g.shard))
+                if e is None:
+                    continue
+                if e.get("ctx") and node_id == e["node"]:
+                    ctxs[str(g.shard)] = e["ctx"]
+                    if e["cursor"] is not None:
+                        curs[str(g.shard)] = e["cursor"]
+                elif e["sort_after"] is not None:
+                    # failover re-open: the new copy's stream starts
+                    # strictly after the last doc this shard emitted
+                    afters[str(g.shard)] = e["sort_after"]
+            if ctxs:
+                ext["contexts"] = ctxs
+            if curs:
+                ext["cursors"] = curs
+            if afters:
+                ext["search_afters"] = afters
+            return ext
+
+        def on_shard_query(g, node_id, index, sr):
+            e = entries.get((index, sr["shard"]))
+            if e is None:
+                return
+            if node_id != e["node"]:
+                self.cursor_failovers += 1
+                if self.telemetry is not None:
+                    self.telemetry.metrics.inc("search.cursor.failovers")
+                if e.get("ctx"):
+                    stale.setdefault(e["node"], []).append(e["ctx"])
+            e["node"] = node_id
+            if sr.get("ctx"):
+                e["ctx"] = sr["ctx"]
+
+        def on_page(page, resp):
+            self._advance_cursors(rec, page)
+            rec["expires_at"] = self.scheduler.now() + rec["keep_alive"]
+            # a scroll's total is pinned at open time; continuation
+            # pages skip per-shard counting and re-stamp it
+            resp["hits"]["total"] = {"value": rec["total"],
+                                     "relation": rec["relation"]}
+            resp["_scroll_id"] = scroll_id
+            if stale:
+                self._free_contexts(state, dict(stale))
+                stale.clear()
+
+        def done(resp, err):
+            if err is not None:
+                # the scroll is dead — release every surviving context
+                # and surface the typed contract error
+                self._free_scroll(state, scroll_id)
+                if isinstance(err, (SearchPhaseExecutionException,
+                                    IndexNotFoundException)):
+                    err = SearchContextMissingException(scroll_id)
+                on_done(None, err)
+                return
+            on_done(resp, None)
+
+        self.search(
+            state, ",".join(rec["indices"]), body, done,
+            _plan={"groups": groups, "allow_partial": False,
+                   "hooks": {"reader_ext": reader_ext,
+                             "on_shard_query": on_shard_query,
+                             "fetch_ext": self._make_fetch_ext(entries),
+                             "on_page": on_page}})
+
+    def _scroll_copy_plan(self, state: ClusterState, index: str,
+                          shard: int, entry: Dict[str, Any],
+                          portable: bool) -> List[ShardRouting]:
+        """The copies a continuation page may run this shard on: the
+        recorded owner first (exact cursor resume), then — only when the
+        stream is portable or has emitted nothing yet — the other active
+        copies. An empty plan fails the group typed (never a hang)."""
+        irt = state.routing_table.index(index)
+        table = irt.shard(shard) if irt is not None else None
+        active = [c for c in (table.active_shards()
+                              if table is not None else [])
+                  if state.nodes.get(c.current_node_id) is not None]
+        copies = [c for c in active
+                  if c.current_node_id == entry["node"]]
+        if portable or entry["cursor"] is None:
+            copies += [c for c in active
+                       if c.current_node_id != entry["node"]]
+        return copies
+
+    def clear_scroll(self, state: ClusterState, scroll_ids: List[str],
+                     on_done: Callable[[Optional[Dict],
+                                        Optional[Exception]],
+                                       None]) -> None:
+        """Release scroll cursors (``_all`` drops every open scroll)."""
+        if any(s == "_all" for s in scroll_ids):
+            scroll_ids = sorted(self._scrolls)
+        freed = 0
+        for sid in scroll_ids:
+            if self._free_scroll(state, sid):
+                freed += 1
+        on_done({"succeeded": True, "num_freed": freed}, None)
+
+    # -- PIT -------------------------------------------------------------
+
+    def open_pit(self, state: ClusterState, index_expression: str,
+                 keep_alive: Optional[float],
+                 on_done: Callable[[Optional[Dict], Optional[Exception]],
+                                   None]) -> None:
+        """Pin a point-in-time view: one reader context + retention
+        lease per shard primary (ref: TransportOpenPointInTimeAction).
+        All-or-nothing — a failed shard frees the already-opened
+        contexts and surfaces the error."""
+        self._reap_cursors(state)
+        ka = float(keep_alive or DEFAULT_PIT_KEEPALIVE)
+        try:
+            indices = self._resolve(state, index_expression)
+        except Exception as e:  # noqa: BLE001 — typed resolution error
+            on_done(None, e)
+            return
+        targets: List[Tuple[str, int, str]] = []
+        for index in indices:
+            irt = state.routing_table.index(index)
+            if irt is None:
+                continue
+            for shard_id in sorted(irt.shards):
+                primary = irt.shards[shard_id].primary
+                if primary is None or not primary.active \
+                        or state.nodes.get(
+                            primary.current_node_id) is None:
+                    on_done(None, NoShardAvailableActionException(
+                        f"cannot open PIT: [{index}][{shard_id}] has "
+                        f"no active primary"))
+                    return
+                targets.append((index, shard_id,
+                                primary.current_node_id))
+        if not targets:
+            on_done(None, IndexNotFoundException(index_expression))
+            return
+        entries: Dict[Tuple[str, int], Dict[str, Any]] = {}
+        pending = {"n": len(targets), "err": None}
+        lock = threading.RLock()
+
+        def shard_done():
+            with lock:
+                pending["n"] -= 1
+                if pending["n"] > 0:
+                    return
+                err = pending["err"]
+            if err is not None:
+                # roll back the partial open — a PIT either pins every
+                # shard or does not exist
+                by_node: Dict[str, List[str]] = {}
+                for e in entries.values():
+                    by_node.setdefault(e["node"], []).append(e["ctx"])
+                self._free_contexts(state, by_node)
+                on_done(None, err)
+                return
+            raw = (f"{self.transport.local_node.node_id}"
+                   f":pit:{self._next_cursor_seq()}")
+            pit_id = base64.urlsafe_b64encode(
+                raw.encode()).decode().rstrip("=")
+            self._pits[pit_id] = {
+                "id": pit_id, "indices": list(indices),
+                "keep_alive": ka,
+                "expires_at": self.scheduler.now() + ka,
+                "shards": entries,
+            }
+            on_done({"id": pit_id}, None)
+
+        for index, shard_id, node_id in targets:
+            node = state.nodes.get(node_id)
+
+            def ok(resp, _index=index, _shard=shard_id, _node=node_id):
+                with lock:
+                    entries[(_index, _shard)] = {
+                        "node": _node, "ctx": resp["ctx"],
+                        "cursor": None, "sort_after": None}
+                shard_done()
+
+            def fail(exc, _e=None):
+                with lock:
+                    if pending["err"] is None:
+                        pending["err"] = exc
+                shard_done()
+
+            self.transport.send_request(
+                node, OPEN_PIT_SHARD_ACTION,
+                {"index": index, "shard_id": shard_id, "keep_alive": ka},
+                ResponseHandler(ok, fail), timeout=30.0)
+
+    def close_pit(self, state: ClusterState, pit_id: str,
+                  on_done: Callable[[Optional[Dict], Optional[Exception]],
+                                    None]) -> None:
+        if self._free_pit(state, pit_id):
+            on_done({"succeeded": True, "num_freed": 1}, None)
+        else:
+            on_done({"succeeded": True, "num_freed": 0}, None)
+
+    def _search_pit(self, state: ClusterState, index_expression: str,
+                    body: Dict[str, Any], on_done,
+                    scroll: Optional[float] = None, task=None) -> None:
+        """A search against a pinned PIT view: every shard runs on its
+        pinned reader context. The context travels with a relocation
+        handoff (data_node._finalize_respond → _adopt_pit_contexts), so
+        the copy plan is the recorded node first, then the CURRENT
+        active copies — a post-relocation read finds the context on the
+        new primary and re-homes the record."""
+        pit = body.get("pit") or {}
+        pit_id = pit.get("id")
+        if index_expression not in ("", "_all", "*"):
+            on_done(None, IllegalArgumentException(
+                "[index] cannot be used with point in time"))
+            return
+        self._reap_cursors(state)
+        rec = self._pits.get(pit_id)
+        if rec is None:
+            on_done(None, SearchContextMissingException(str(pit_id)))
+            return
+        ka = pit.get("keep_alive")
+        if ka:
+            rec["keep_alive"] = float(ka)
+        rec["expires_at"] = self.scheduler.now() + rec["keep_alive"]
+        entries = rec["shards"]
+        body2 = {k: v for k, v in body.items() if k != "pit"}
+
+        groups: List[_ShardGroup] = []
+        for (index, shard) in sorted(entries):
+            e = entries[(index, shard)]
+            irt = state.routing_table.index(index)
+            table = irt.shard(shard) if irt is not None else None
+            active = [c for c in (table.active_shards()
+                                  if table is not None else [])
+                      if state.nodes.get(c.current_node_id) is not None]
+            copies = [c for c in active
+                      if c.current_node_id == e["node"]]
+            # the context may have travelled with a handoff — try the
+            # other current copies; a copy without it answers typed
+            # search_context_missing and the group fails over
+            copies += [c for c in active
+                       if c.current_node_id != e["node"]]
+            groups.append(_ShardGroup(index, shard,
+                                      _CopyListIterator(copies)))
+
+        def reader_ext(node_id, index, batch):
+            ctxs = {str(g.shard): entries[(index, g.shard)]["ctx"]
+                    for g in batch if (index, g.shard) in entries}
+            return {"contexts": ctxs} if ctxs else {}
+
+        def on_shard_query(g, node_id, index, sr):
+            e = entries.get((index, sr["shard"]))
+            if e is None:
+                return
+            if node_id != e["node"]:
+                # the pinned context was adopted by another copy (the
+                # relocation handoff) — re-home the record
+                self.cursor_failovers += 1
+                if self.telemetry is not None:
+                    self.telemetry.metrics.inc("search.cursor.failovers")
+                e["node"] = node_id
+
+        def on_page(page, resp):
+            resp["pit_id"] = rec["id"]
+
+        def done(resp, err):
+            if err is not None and isinstance(
+                    err, SearchPhaseExecutionException):
+                err = SearchContextMissingException(str(pit_id))
+            on_done(resp, err)
+
+        self.search(
+            state, ",".join(rec["indices"]), body2, done, task=task,
+            _plan={"groups": groups, "allow_partial": False,
+                   "hooks": {"reader_ext": reader_ext,
+                             "on_shard_query": on_shard_query,
+                             "fetch_ext": self._make_fetch_ext(entries),
+                             "on_page": on_page}})
+
+    # -- cursor bookkeeping ----------------------------------------------
+
+    def _reap_cursors(self, state: ClusterState) -> None:
+        """Lazy expiry on the scheduler clock — no periodic task, so a
+        seeded interleaving is never perturbed by a reaper tick."""
+        now = self.scheduler.now()
+        for sid in [s for s, r in self._scrolls.items()
+                    if r["expires_at"] <= now]:
+            self._free_scroll(state, sid)
+        for pid in [p for p, r in self._pits.items()
+                    if r["expires_at"] <= now]:
+            self._free_pit(state, pid)
+
+    def _free_scroll(self, state: ClusterState, scroll_id: str) -> bool:
+        rec = self._scrolls.pop(scroll_id, None)
+        if rec is None:
+            return False
+        self._free_record_contexts(state, rec)
+        return True
+
+    def _free_pit(self, state: ClusterState, pit_id: str) -> bool:
+        rec = self._pits.pop(pit_id, None)
+        if rec is None:
+            return False
+        self._free_record_contexts(state, rec)
+        return True
+
+    def _free_record_contexts(self, state: ClusterState,
+                              rec: Dict[str, Any]) -> None:
+        """Broadcast the record's context ids to EVERY current data
+        node: a context may have travelled with a relocation handoff
+        since the record last saw it, and frees are idempotent — the
+        nodes that never held it no-op."""
+        ids = sorted({e["ctx"] for e in rec["shards"].values()
+                      if e.get("ctx")})
+        if not ids:
+            return
+        self._free_contexts(
+            state, {nid: ids for nid in sorted(
+                n.node_id for n in state.nodes.nodes)})
+
+    def _free_contexts(self, state: ClusterState,
+                       by_node: Dict[str, List[str]]) -> None:
+        """Fire-and-forget context frees (idempotent receivers); a dead
+        node already dropped its contexts with its shard copies."""
+        for node_id in sorted(by_node):
+            ids = by_node[node_id]
+            if node_id == self.transport.local_node.node_id:
+                for cid in ids:
+                    self.data_node.free_reader_context(cid)
+                continue
+            node = state.nodes.get(node_id)
+            if node is None:
+                continue
+            self.transport.send_request(
+                node, FREE_CONTEXT_ACTION, {"contexts": list(ids)},
+                ResponseHandler(lambda r: None, lambda e: None),
+                timeout=10.0)
+
+    def open_scroll_count(self) -> int:
+        return len(self._scrolls)
+
+    def open_pit_count(self) -> int:
+        return len(self._pits)
 
     # -- query phase internals -------------------------------------------
 
@@ -848,6 +1499,13 @@ class DistributedSearchService:
         payload = {"index": index,
                    "shards": [g.shard for g in batch],
                    "k": ctx["k"], "body": body}
+        ext = ctx.get("reader_ext")
+        if ext is not None:
+            # cursor continuation extras: contexts/cursors/search_afters
+            # for the shards in this batch, computed against the node
+            # the batch is ACTUALLY going to (a failover re-send gets
+            # the re-open form instead of a dead context id)
+            payload.update(ext(node_id, index, batch))
         by_shard = {g.shard: g for g in batch}
 
         def ok(resp, _node_id=node_id, _index=index, _by_shard=by_shard):
@@ -912,6 +1570,12 @@ class DistributedSearchService:
                 prof = dict(sr["profile"])
                 prof["node"] = node_id
                 ctx["profile_shards"].append(prof)
+            hook = ctx.get("on_shard_query")
+            if hook is not None:
+                # cursor bookkeeping: record which node/context answered
+                # (under the coordinator lock with the resolved guard —
+                # a late duplicate answer can never move the cursor home)
+                hook(g, node_id, index, sr)
             consumer = ctx["agg_consumer"]
             if consumer is not None and sr.get("aggs") is not None \
                     and ctx["agg_reduce_error"] is None:
@@ -1236,6 +1900,11 @@ class DistributedSearchService:
                    "docs": {str(sid): docs
                             for sid, docs in docs_by_shard.items()},
                    "body": body_for_fetch(ctx["body"])}
+        fext = ctx.get("fetch_ext")
+        if fext is not None:
+            # scroll/PIT fetches name the pinned contexts so sources
+            # come off the same snapshot the query phase walked
+            payload.update(fext(node_id, index, docs_by_shard))
 
         def ok(resp, _node_id=node_id, _index=index,
                _docs_by_shard=docs_by_shard, _span=span):
@@ -1438,6 +2107,13 @@ class DistributedSearchService:
                 return
         if ctx["profile"]:
             resp["profile"] = self._profile_section(ctx, fctx)
+        hook = ctx.get("on_page")
+        if hook is not None:
+            # cursor epilogue: advance lastEmittedDoc cursors to the docs
+            # actually emitted in THIS merged page (unemitted shard docs
+            # re-return next page — exact, duplicate-free), stamp the
+            # scroll id / pinned total onto the response
+            hook(fctx["page"], resp)
         self._complete(ctx, resp, None)
 
     def _profile_section(self, ctx: Dict, fctx: Dict) -> Dict[str, Any]:

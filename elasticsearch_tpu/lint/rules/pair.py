@@ -59,7 +59,16 @@ SHUTDOWN = PairSpec(
     acquire_attrs=("register_shutdown",),
     release_attrs=("clear_shutdown",),
 )
-SPECS = [BREAKER, TASK, SPAN, LEASE, SHUTDOWN]
+# cursor/PIT lifecycle: a pinned reader context (or an opened PIT)
+# holds segments + a retention lease until freed — an exception edge
+# between open and free strands the pin past every keep-alive the
+# caller meant to grant (the cluster cursor plane's whole contract)
+CURSOR = PairSpec(
+    name="search cursor",
+    acquire_attrs=("open_pit", "open_reader_context"),
+    release_attrs=("close_pit", "free_reader_context", "clear_scroll"),
+)
+SPECS = [BREAKER, TASK, SPAN, LEASE, SHUTDOWN, CURSOR]
 
 # drain method shapes for PAIR02 ("finish" intentionally absent)
 _DRAIN_HINTS = ("close", "release", "stop", "shutdown", "clear",

@@ -278,24 +278,16 @@ def _remote_scroll_batches(remote: Dict[str, Any], index, search_body,
 
 
 def _scroll_batches(node, index, search_body, batch_size, task=None):
-    """Yield lists of hits from a scroll snapshot of `index`."""
-    body = dict(search_body)
-    body["size"] = batch_size
-    r = node.search_service.search(index, body, scroll=_SCROLL_KEEPALIVE,
-                                   task=task)
-    scroll_id = r.get("_scroll_id")
-    try:
-        hits = r["hits"]["hits"]
-        while hits:
-            yield hits
-            if scroll_id is None:
-                return
-            r = node.search_service.scroll(scroll_id, _SCROLL_KEEPALIVE)
-            scroll_id = r.get("_scroll_id")
-            hits = r["hits"]["hits"]
-    finally:
-        if scroll_id:
-            node.search_service.clear_scroll([scroll_id])
+    """Yield lists of hits from a scroll snapshot of `index`.
+
+    Rides the resumable cursor drain: a scroll context lost mid-drain
+    (node bounce, reaped keep-alive) re-opens at the last continuation
+    point, so a bulk-by-scroll operation retries from where it was
+    instead of restarting — and never double-applies a batch."""
+    from elasticsearch_tpu.search.service import resumable_scroll_batches
+    yield from resumable_scroll_batches(
+        node.search_service, index, search_body, batch_size,
+        keep_alive=_SCROLL_KEEPALIVE, task=task)
 
 
 def _slice_filter(slices: int, slice_id: int, hit_id: str) -> bool:

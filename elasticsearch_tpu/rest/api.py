@@ -1808,7 +1808,13 @@ def _ndjson_lines(body):
 def _bulk_by_scroll(node, params, action_name, run):
     """Run a reindex-family worker, sync or as a background task
     (``wait_for_completion=false`` → returns {"task": id}, result stored
-    for GET /_tasks/{id}; ref: reindex tasks store results in .tasks)."""
+    for GET /_tasks/{id}; ref: reindex tasks store results in .tasks).
+
+    The worker drains its source through the resumable cursor path
+    (search/service.py resumable_scroll_batches): a scroll context lost
+    mid-drain re-opens at the last continuation point, so a copy
+    failure retries from where the drain was — the operation never
+    restarts from scratch and never double-applies a batch."""
     import threading
     if params.get("wait_for_completion") == "false":
         task = node.task_manager.register("transport", action_name,

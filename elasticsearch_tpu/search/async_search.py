@@ -173,3 +173,295 @@ class AsyncSearchService:
                          "max_score": None, "hits": []},
             }
         return out
+
+
+# --------------------------------------------------------------- cluster
+
+ASYNC_SUBMIT_ACTION = "indices:data/read/async_search/submit"
+ASYNC_GET_ACTION = "indices:data/read/async_search[get]"
+ASYNC_DELETE_ACTION = "indices:data/read/async_search[delete]"
+
+
+class ClusterAsyncSearchService:
+    """Cluster-aware async search (ref: x-pack async-search +
+    AsyncExecutionId): the search id ENCODES the submitting node, so
+    get/status/delete issued against ANY node route to the owner over
+    the transport. The submit runs the distributed search fan-out as a
+    PR-5 cancellable parent task (`GET /_tasks`-visible; a cancel from
+    any node reaches it by task id and bans its per-shard children),
+    and a mid-flight copy failure folds into the PR-1 typed
+    partial-results protocol instead of killing the search.
+
+    Everything runs on the SCHEDULER clock and callback style — no
+    threads, no wall time — so seeded chaos runs replay byte-identical.
+    """
+
+    def __init__(self, transport, scheduler, task_manager,
+                 search_fn, state_fn,
+                 cancel_local: Optional[Callable] = None,
+                 on_cancelled_parent_done: Optional[Callable] = None):
+        from elasticsearch_tpu.transport.transport import ResponseHandler
+        self.transport = transport
+        self.scheduler = scheduler
+        self.task_manager = task_manager
+        # search_fn(index, body, on_done, task=) → the distributed
+        # coordinator under the caller-owned task
+        self.search_fn = search_fn
+        self.state_fn = state_fn
+        # ClusterNode._cancel_local: ban-broadcast-then-cancel, so a
+        # delete kills the fan-out's children on every node
+        self.cancel_local = cancel_local
+        self.on_cancelled_parent_done = on_cancelled_parent_done
+        self._rh = ResponseHandler
+        self._searches: Dict[str, Dict[str, Any]] = {}
+        self._seq = 0
+        transport.register_request_handler(ASYNC_GET_ACTION,
+                                           self._on_get)
+        transport.register_request_handler(ASYNC_DELETE_ACTION,
+                                           self._on_delete)
+
+    # ------------------------------------------------------------- submit
+
+    def submit(self, index_expression: str, body: Dict[str, Any],
+               params: Optional[Dict[str, str]],
+               on_done: Callable) -> None:
+        from elasticsearch_tpu.transport.tasks import (
+            TaskId, encode_node_scoped_id)
+        params = params or {}
+        try:
+            wait = parse_time_value(
+                params.get("wait_for_completion_timeout", "1s"),
+                "wait_for_completion_timeout")
+            keep_alive = parse_time_value(
+                params.get("keep_alive", "5d"), "keep_alive")
+        except Exception as e:  # noqa: BLE001 — typed parse error
+            on_done(None, e)
+            return
+        self._reap()
+        self._seq += 1
+        node_id = self.transport.local_node.node_id
+        search_id = encode_node_scoped_id(node_id, self._seq)
+        now = self.scheduler.now()
+        task = self.task_manager.register(
+            "transport", ASYNC_SUBMIT_ACTION,
+            description=f"async_search indices[{index_expression}]",
+            cancellable=True)
+        rec: Dict[str, Any] = {
+            "id": search_id, "index": index_expression,
+            "start": now, "keep_alive": keep_alive,
+            "expires_at": now + keep_alive,
+            "running": True, "response": None,
+            "error": None, "error_status": 500,
+            "completed_at": None, "task": task,
+            "waiters": [],
+        }
+        self._searches[search_id] = rec
+        responded = {"done": False}
+
+        def respond():
+            if responded["done"]:
+                return
+            responded["done"] = True
+            on_done(self._render(rec), None)
+
+        def search_done(resp, err):
+            rec["running"] = False
+            rec["completed_at"] = self.scheduler.now()
+            if err is not None:
+                rec["error"] = (
+                    err.to_xcontent()
+                    if isinstance(err, ElasticsearchTpuException)
+                    else {"type": "exception", "reason": str(err)})
+                rec["error_status"] = getattr(err, "status", 500)
+            else:
+                rec["response"] = resp
+            was_cancelled = getattr(task, "is_cancelled",
+                                    lambda: False)()
+            self.task_manager.unregister(task)
+            rec["task"] = None
+            if was_cancelled and \
+                    self.on_cancelled_parent_done is not None:
+                # sweep the cancel's ban markers off the cluster one
+                # beat later (same deferral as the search coordinator)
+                tid = TaskId(node_id, task.id)
+                self.scheduler.schedule(
+                    1.0, lambda: self.on_cancelled_parent_done(tid),
+                    f"sweep task bans [{tid}]")
+            respond()
+            for w in rec.pop("waiters", []):
+                w()
+            rec["waiters"] = []
+
+        self.scheduler.schedule(max(wait, 0.0), respond,
+                                f"async_search wait [{search_id}]")
+        self.search_fn(index_expression, body or {}, search_done,
+                       task=task)
+
+    # ---------------------------------------------------------- get/delete
+
+    def get(self, search_id: str, params: Optional[Dict[str, str]],
+            on_done: Callable) -> None:
+        self._route(search_id, ASYNC_GET_ACTION,
+                    {"id": search_id, "params": params or {}},
+                    lambda: self._get_local(search_id, params, on_done),
+                    on_done)
+
+    def delete(self, search_id: str, on_done: Callable) -> None:
+        self._route(search_id, ASYNC_DELETE_ACTION, {"id": search_id},
+                    lambda: self._delete_local(search_id, on_done),
+                    on_done)
+
+    def _route(self, search_id: str, action: str, payload: Dict,
+               local: Callable, on_done: Callable) -> None:
+        """Resolve the owner from the id: serve locally or forward."""
+        from elasticsearch_tpu.transport.tasks import (
+            decode_node_scoped_id)
+        try:
+            owner_id = decode_node_scoped_id(search_id).node_id
+        except ResourceNotFoundException as e:
+            on_done(None, e)
+            return
+        if owner_id == self.transport.local_node.node_id:
+            local()
+            return
+        owner = self.state_fn().nodes.get(owner_id)
+        if owner is None:
+            on_done(None, ResourceNotFoundException(search_id))
+            return
+        self.transport.send_request(
+            owner, action, payload,
+            self._rh(lambda r: on_done(r, None),
+                     lambda e: on_done(None, e)),
+            timeout=30.0)
+
+    def _on_get(self, req, channel, src) -> None:
+        self._get_local(req["id"], req.get("params"),
+                        self._channel_done(channel))
+
+    def _on_delete(self, req, channel, src) -> None:
+        self._delete_local(req["id"], self._channel_done(channel))
+
+    @staticmethod
+    def _channel_done(channel):
+        def done(resp, err):
+            if err is not None:
+                channel.send_exception(
+                    err if isinstance(err, BaseException)
+                    else RuntimeError(str(err)))
+            else:
+                channel.send_response(resp)
+        return done
+
+    def _get_local(self, search_id: str,
+                   params: Optional[Dict[str, str]],
+                   on_done: Callable) -> None:
+        params = params or {}
+        self._reap()
+        rec = self._searches.get(search_id)
+        if rec is None:
+            on_done(None, ResourceNotFoundException(search_id))
+            return
+        try:
+            if "keep_alive" in params:
+                rec["keep_alive"] = parse_time_value(
+                    params["keep_alive"], "keep_alive")
+                rec["expires_at"] = (self.scheduler.now()
+                                     + rec["keep_alive"])
+            wait = (parse_time_value(
+                params["wait_for_completion_timeout"],
+                "wait_for_completion_timeout")
+                if "wait_for_completion_timeout" in params else None)
+        except Exception as e:  # noqa: BLE001 — typed parse error
+            on_done(None, e)
+            return
+        if not rec["running"] or wait is None:
+            on_done(self._render(rec), None)
+            return
+        responded = {"done": False}
+
+        def respond():
+            if responded["done"]:
+                return
+            responded["done"] = True
+            on_done(self._render(rec), None)
+
+        rec["waiters"].append(respond)
+        self.scheduler.schedule(max(wait, 0.0), respond,
+                                f"async_search get wait [{search_id}]")
+
+    def _delete_local(self, search_id: str, on_done: Callable) -> None:
+        from elasticsearch_tpu.transport.tasks import TaskId
+        self._reap()
+        rec = self._searches.pop(search_id, None)
+        if rec is None:
+            on_done(None, ResourceNotFoundException(search_id))
+            return
+        task = rec.get("task")
+        if rec["running"] and task is not None \
+                and self.cancel_local is not None:
+            # ban-broadcast-then-cancel: the fan-out's children on every
+            # node die with the parent (visible in `GET /_tasks` until
+            # then); the search completes typed-cancelled and releases
+            # its own resources through its normal completion seam
+            self.cancel_local(
+                TaskId(self.transport.local_node.node_id, task.id),
+                "async search deleted",
+                lambda r, e: on_done({"acknowledged": True}, None))
+            return
+        on_done({"acknowledged": True}, None)
+
+    # ----------------------------------------------------------- internals
+
+    def _reap(self) -> None:
+        """Lazy keep-alive expiry on the scheduler clock (no periodic
+        task — seeded interleavings stay undisturbed); a still-running
+        expired search is cancelled, never orphaned."""
+        from elasticsearch_tpu.transport.tasks import TaskId
+        now = self.scheduler.now()
+        expired = [sid for sid, r in self._searches.items()
+                   if r["expires_at"] <= now]
+        for sid in expired:
+            rec = self._searches.pop(sid)
+            task = rec.get("task")
+            if rec["running"] and task is not None \
+                    and self.cancel_local is not None:
+                self.cancel_local(
+                    TaskId(self.transport.local_node.node_id, task.id),
+                    "async search expired", lambda r, e: None)
+
+    def open_async_search_count(self) -> int:
+        return len(self._searches)
+
+    def _render(self, rec: Dict[str, Any]) -> Dict[str, Any]:
+        from elasticsearch_tpu.transport.tasks import TaskId
+        running = rec["running"]
+        now_ms = int(self.scheduler.now() * 1000)
+        out: Dict[str, Any] = {
+            "id": rec["id"],
+            "is_partial": running or rec["error"] is not None,
+            "is_running": running,
+            "start_time_in_millis": int(rec["start"] * 1000),
+            "expiration_time_in_millis": int(rec["expires_at"] * 1000),
+        }
+        if running and rec.get("task") is not None:
+            # the `GET /_tasks`-addressable handle for the fan-out
+            out["task"] = str(TaskId(
+                self.transport.local_node.node_id, rec["task"].id))
+        if rec["error"] is not None:
+            out["error"] = rec["error"]
+            out["_http_status"] = rec["error_status"]
+        elif rec["response"] is not None:
+            out["response"] = rec["response"]
+            if rec["response"].get("_shards", {}).get("failed", 0):
+                # copy failures folded into typed partial results
+                out["is_partial"] = True
+            out["completion_time_in_millis"] = int(
+                (rec["completed_at"] or self.scheduler.now()) * 1000)
+        else:
+            out["response"] = {
+                "took": now_ms - int(rec["start"] * 1000),
+                "timed_out": False,
+                "hits": {"total": {"value": 0, "relation": "gte"},
+                         "max_score": None, "hits": []},
+            }
+        return out

@@ -379,6 +379,10 @@ class SearchService:
         response["took"] = int((time.monotonic() - start) * 1000)
         if scroll_ctx is not None:
             response["_scroll_id"] = scroll_ctx.scroll_id
+        if pit_spec is not None:
+            # ES echoes the (possibly re-keyed) pit id on every PIT
+            # search; the cluster coordinator path stamps it too
+            response["pit_id"] = pit.id
         if cache_body_key is not None:
             # store under the SNAPSHOT epochs the data was read at (a
             # concurrent refresh between probe and acquire must not file
@@ -1292,3 +1296,78 @@ class SearchService:
         response = self.search(index_expression, body)
         return {"count": response["hits"]["total"]["value"],
                 "_shards": response["_shards"]}
+
+
+def resumable_scroll_batches(search_service, index_expression: str,
+                             body: Dict[str, Any], batch_size: int,
+                             keep_alive: str = "5m", task=None,
+                             on_resume=None):
+    """Drain ``index_expression`` in batches via scroll, SURVIVING a lost
+    scroll context (ref: ClientScrollableHitSource + the bulk-by-scroll
+    retry contract): a ``search_context_missing_exception`` mid-drain
+    re-opens the scroll and resumes from the last continuation point
+    instead of restarting the caller's whole operation.
+
+    Resume exactness: with an explicit ``sort`` in the body the stream
+    re-opens at ``search_after = <last emitted hit's sort>`` — exact on
+    any copy. Without one (score order is not portable across readers)
+    the re-opened stream skips the already-emitted prefix by count —
+    exact against a deterministic reader, best-effort otherwise.
+
+    ``on_resume`` (optional) is called once per recovery, for metrics.
+    Works against any service exposing the sync search/scroll/
+    clear_scroll surface (the single-node SearchService shape).
+    """
+    base = dict(body or {})
+    base["size"] = int(batch_size)
+    has_sort = bool(base.get("sort"))
+    emitted = 0
+    last_sort = None
+    skip = 0
+
+    def reopen():
+        b = dict(base)
+        if has_sort and last_sort is not None:
+            b["search_after"] = list(last_sort)
+        return search_service.search(index_expression, b,
+                                     scroll=keep_alive, task=task)
+
+    r = search_service.search(index_expression, dict(base),
+                              scroll=keep_alive, task=task)
+    scroll_id = r.get("_scroll_id")
+    try:
+        while True:
+            raw_hits = r["hits"]["hits"]
+            hits = raw_hits
+            if skip:
+                drop = min(skip, len(hits))
+                hits = hits[drop:]
+                skip -= drop
+            if hits:
+                emitted += len(hits)
+                if hits[-1].get("sort") is not None:
+                    last_sort = hits[-1]["sort"]
+                yield hits
+            if not raw_hits:
+                return
+            try:
+                r = search_service.scroll(scroll_id, keep_alive)
+                scroll_id = r.get("_scroll_id", scroll_id)
+            except SearchContextMissingException:
+                if on_resume is not None:
+                    on_resume()
+                if has_sort and last_sort is not None:
+                    skip = 0
+                else:
+                    # restart from the top, skipping what was already
+                    # handed out
+                    skip = emitted
+                r = reopen()
+                scroll_id = r.get("_scroll_id")
+    finally:
+        if scroll_id:
+            try:
+                search_service.clear_scroll([scroll_id])
+            except Exception:  # noqa: BLE001 — release is best-effort:
+                # an expired/unknown id means the context is gone anyway
+                pass

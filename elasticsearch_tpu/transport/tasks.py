@@ -64,6 +64,32 @@ class TaskId:
 EMPTY_TASK_ID = TaskId("", -1)
 
 
+def encode_node_scoped_id(node_id: str, seq: int) -> str:
+    """Opaque id that embeds its owning node — any node can route a
+    get/delete to the owner without cluster-wide lookup (ref:
+    AsyncExecutionId: the async-search id encodes node + task)."""
+    import base64
+    raw = f"{node_id}:{seq}"
+    return base64.urlsafe_b64encode(raw.encode()).decode().rstrip("=")
+
+
+def decode_node_scoped_id(s: str) -> "TaskId":
+    """Inverse of encode_node_scoped_id; malformed ids raise typed
+    ResourceNotFoundException (an unroutable id IS a missing resource)."""
+    import base64
+
+    from elasticsearch_tpu.common.errors import ResourceNotFoundException
+    try:
+        pad = "=" * (-len(s) % 4)
+        raw = base64.urlsafe_b64decode((s + pad).encode()).decode()
+        node_id, _, num = raw.rpartition(":")
+        if not node_id:
+            raise ValueError(raw)
+        return TaskId(node_id, int(num))
+    except Exception:
+        raise ResourceNotFoundException(s)
+
+
 class Task:
     def __init__(self, task_id: int, type_: str, action: str,
                  description: str = "",

@@ -35,6 +35,11 @@ from elasticsearch_tpu.search.searcher import _get_path as _source_get
 from elasticsearch_tpu.xpack import ql
 from elasticsearch_tpu.xpack.sql import Parser as SqlParser
 
+# host-resident hits per cursor page of an event-stream drain — the
+# memory cap that replaced the old whole-index single read (fetch_size
+# still bounds the TOTAL events, this bounds the per-page footprint)
+EQL_FETCH_WINDOW = 1000
+
 
 @dataclass
 class EventQuery:
@@ -230,28 +235,42 @@ class EqlService:
         sort = [{ts_field: {"order": "asc"}}]
         if tiebreak_field:
             sort.append({tiebreak_field: {"order": "asc"}})
-        r = self.node.search_service.search(index, {
-            "query": query, "size": fetch_size, "sort": sort,
-            "_source": True})
-        if len(r["hits"]["hits"]) >= fetch_size:
-            self._truncated = True                  # stream cut at the cap
+        # windowed drain instead of one whole-index host read: at most
+        # EQL_FETCH_WINDOW hits are resident per page, and the explicit
+        # sort makes the cursor stream resumable if a context is lost
+        # mid-drain. Results match the old single-read path exactly —
+        # same order, same fetch_size cap, same truncation flag.
+        from elasticsearch_tpu.search.service import (
+            resumable_scroll_batches)
+        window = max(1, min(fetch_size, EQL_FETCH_WINDOW))
         out: List[_Event] = []
-        for h in r["hits"]["hits"]:
-            src = h.get("_source", {}) or {}
-            if post_eval is not None:
-                try:
-                    ok = ql.evaluate(post_eval,
-                                     lambda f, _s=src: _source_get(_s, f))
-                except Exception:
-                    ok = False
-                if not ok:
-                    continue
-            sv = h.get("sort", [])
-            if not sv or sv[0] is None:
-                continue                            # no usable timestamp
-            ts = float(sv[0])
-            tb = sv[1] if len(sv) > 1 else h["_id"]
-            out.append(_Event(ts, tb, h["_index"], h["_id"], src))
+        raw_seen = 0
+        for batch in resumable_scroll_batches(
+                self.node.search_service, index,
+                {"query": query, "sort": sort, "_source": True}, window):
+            for h in batch:
+                if raw_seen >= fetch_size:
+                    break
+                raw_seen += 1
+                src = h.get("_source", {}) or {}
+                if post_eval is not None:
+                    try:
+                        ok = ql.evaluate(
+                            post_eval,
+                            lambda f, _s=src: _source_get(_s, f))
+                    except Exception:
+                        ok = False
+                    if not ok:
+                        continue
+                sv = h.get("sort", [])
+                if not sv or sv[0] is None:
+                    continue                        # no usable timestamp
+                ts = float(sv[0])
+                tb = sv[1] if len(sv) > 1 else h["_id"]
+                out.append(_Event(ts, tb, h["_index"], h["_id"], src))
+            if raw_seen >= fetch_size:
+                self._truncated = True              # stream cut at the cap
+                break
         return out
 
     def _sequences(self, index: str, plan: EqlQuery, ts_field: str,

@@ -1,0 +1,14 @@
+# lint-fixture: flags=ESTPU-PAIR01
+"""A coordinator that opens a PIT, runs the export, and closes it —
+but the export can raise, and then the PIT (reader contexts + the
+retention leases pinning translog history on every shard primary)
+outlives the request with nothing left holding its id: the cursor-leak
+shape the cluster cursor plane's lifecycle contract forbids."""
+
+
+def export_snapshot(svc, index, sink):
+    pit = svc.open_pit(index, keep_alive=300.0)
+    rows = drain_hits(svc, index)  # lint-expect: ESTPU-PAIR01
+    sink.write(rows)
+    svc.close_pit(pit)
+    return len(rows)

@@ -1,0 +1,14 @@
+# lint-fixture: passes=ESTPU-PAIR01
+"""The paired twin of bad_cursor.py: the PIT is closed in a
+``finally``, so a failed export cannot strand pinned reader contexts
+or their retention leases — every exit path releases the cursor."""
+
+
+def export_snapshot(svc, index, sink):
+    pit = svc.open_pit(index, keep_alive=300.0)
+    try:
+        rows = drain_hits(svc, index)
+        sink.write(rows)
+        return len(rows)
+    finally:
+        svc.close_pit(pit)

@@ -1,0 +1,491 @@
+"""Cluster-durable cursors under the deterministic harness: multi-node
+scroll paging (byte-equal to a single search), seeded node kills
+mid-scroll (failover to another copy at the same continuation point
+when the cursor is portable, typed `search_context_missing_exception`
+when it is not — never a hang, never silent truncation), PIT reads
+surviving an explicit `_cluster/reroute` relocation via retention-lease
+transfer, async search cancelled through its `GET /_tasks`-visible
+parent task from a NON-owning node, and a same-seed byte-identical
+replay of the whole scripted scenario.
+
+Single-node companions pin the resumable-drain contract
+(`resumable_scroll_batches`) that `_bulk_by_scroll` and the EQL
+windowed fetch ride."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.common.errors import SearchContextMissingException
+from elasticsearch_tpu.node import Node
+from test_cluster_node import SimDataCluster, _index_some_docs
+
+SORTED_BODY = {"query": {"match_all": {}}, "sort": [{"n": "desc"}]}
+
+
+# ---------------------------------------------------------------------------
+# harness helpers
+# ---------------------------------------------------------------------------
+
+
+def _setup(cluster, shards=3, replicas=1, n=24, index="logs"):
+    master = cluster.stabilise()
+    cluster.call(master.create_index, index, number_of_shards=shards,
+                 number_of_replicas=replicas)
+    cluster.run_for(60)
+    _index_some_docs(cluster, master, index=index, n=n)
+    return master
+
+
+def _hit_ids(resp):
+    return [h["_id"] for h in resp["hits"]["hits"]]
+
+
+def _drain_scroll(cluster, coord, index, body, size, between_pages=None):
+    """Open a scroll and page it to exhaustion; returns (ids, pages)."""
+    b = dict(body)
+    b["size"] = size
+    resp = cluster.call(coord.search, index, b, scroll=60.0)
+    ids, pages = _hit_ids(resp), [resp]
+    sid = resp["_scroll_id"]
+    while resp["hits"]["hits"]:
+        if between_pages is not None:
+            between_pages(len(pages), sid)
+            between_pages = None      # fire the chaos exactly once
+        resp = cluster.call(coord.scroll, sid, 60.0)
+        sid = resp["_scroll_id"]
+        ids.extend(_hit_ids(resp))
+        pages.append(resp)
+    cluster.call(coord.clear_scroll, [sid])
+    return ids, pages
+
+
+def _reader_context_nodes(cluster):
+    return {nid: sorted(cn.data_node.reader_contexts)
+            for nid, cn in sorted(cluster.cluster_nodes.items())
+            if cn.data_node.reader_contexts}
+
+
+def _assert_no_cursor_state(cluster):
+    """Leak guard: no reader contexts, scroll/pit records, or pit
+    retention leases anywhere in the fleet. (Frees are fire-and-forget
+    RPCs — drive the sim so they deliver before asserting.)"""
+    cluster.run_for(5)
+    for nid, cn in sorted(cluster.cluster_nodes.items()):
+        assert not cn.data_node.reader_contexts, \
+            f"{nid}: leaked reader contexts {cn.data_node.reader_contexts}"
+        assert cn.search_service.open_scroll_count() == 0, nid
+        assert cn.search_service.open_pit_count() == 0, nid
+        for key, shard in sorted(cn.data_node.shards.items()):
+            if shard.tracker is None:
+                continue
+            pit_leases = [lid for lid in shard.tracker.get_retention_leases()
+                          if lid.startswith("pit/")]
+            assert not pit_leases, f"{nid}{key}: leaked leases {pit_leases}"
+
+
+# ---------------------------------------------------------------------------
+# scroll: multi-node paging equals one single-shot search
+# ---------------------------------------------------------------------------
+
+
+def test_multinode_scroll_equals_single_search(tmp_path):
+    """3 nodes / 3 shards / 1 replica: paging a sorted scroll to
+    exhaustion yields EXACTLY the ids of one big search — same order,
+    no duplicates, no gaps — and every page re-stamps the pinned
+    total instead of re-counting a moving index."""
+    cluster = SimDataCluster(3, tmp_path, seed=11)
+    master = _setup(cluster, n=24)
+
+    whole = cluster.call(master.search, "logs",
+                         {**SORTED_BODY, "size": 100})
+    assert whole["hits"]["total"]["value"] == 24
+
+    ids, pages = _drain_scroll(cluster, master, "logs", SORTED_BODY, 7)
+    assert ids == _hit_ids(whole), "scroll pages drifted from the search"
+    assert len(ids) == len(set(ids)) == 24
+    assert [len(p["hits"]["hits"]) for p in pages] == [7, 7, 7, 3, 0]
+    for p in pages:
+        assert p["hits"]["total"] == {"value": 24, "relation": "eq"}
+    _assert_no_cursor_state(cluster)
+
+
+def test_clear_scroll_frees_contexts_on_every_node(tmp_path):
+    cluster = SimDataCluster(3, tmp_path, seed=13)
+    master = _setup(cluster, n=12)
+    resp = cluster.call(master.search, "logs",
+                        {**SORTED_BODY, "size": 4}, scroll=60.0)
+    assert _reader_context_nodes(cluster), "scroll pinned no contexts"
+    out = cluster.call(master.clear_scroll, [resp["_scroll_id"]])
+    assert out == {"succeeded": True, "num_freed": 1}
+    cluster.run_for(5)      # remote free RPCs drain
+    _assert_no_cursor_state(cluster)
+
+
+def test_scroll_keepalive_expiry_is_typed(tmp_path):
+    """An expired scroll fails typed on the SCHEDULER clock — lazy
+    reaping, no background timer to perturb seeded interleavings."""
+    cluster = SimDataCluster(3, tmp_path, seed=19)
+    master = _setup(cluster, n=12)
+    resp = cluster.call(master.search, "logs",
+                        {**SORTED_BODY, "size": 4}, scroll=5.0)
+    cluster.run_for(30)     # sail past the keep-alive
+    with pytest.raises(SearchContextMissingException):
+        cluster.call(master.scroll, resp["_scroll_id"], 5.0)
+    cluster.run_for(5)
+    _assert_no_cursor_state(cluster)
+
+
+# ---------------------------------------------------------------------------
+# chaos: node killed mid-scroll
+# ---------------------------------------------------------------------------
+
+
+def _context_victim(cluster, coord, scroll_id, require_cursor=False):
+    """A non-coordinator node that owns a live reader context of this
+    scroll (optionally one whose shard has already emitted hits)."""
+    rec = coord.search_service._scrolls[scroll_id]
+    for _key, e in sorted(rec["shards"].items()):
+        if e["node"] == coord.local_node.node_id:
+            continue
+        if require_cursor and e["cursor"] is None:
+            continue
+        return e["node"]
+    return None
+
+
+@pytest.mark.chaos(seed=43)
+def test_node_kill_mid_scroll_fails_over_exactly(tmp_path, chaos_seed):
+    """Replicated index + explicit sort: the cursor is PORTABLE, so a
+    node killed between pages fails over to another copy at the same
+    continuation point — the drained stream is still byte-equal to the
+    healthy single search, with every doc delivered exactly once."""
+    cluster = SimDataCluster(3, tmp_path, seed=chaos_seed)
+    master = _setup(cluster, shards=3, replicas=1, n=24)
+    whole_ids = _hit_ids(cluster.call(
+        master.search, "logs", {**SORTED_BODY, "size": 100}))
+
+    killed = {}
+
+    def kill_context_owner(_page_no, sid):
+        victim = _context_victim(cluster, master, sid)
+        assert victim is not None, \
+            f"seed={chaos_seed}: every context landed on the coordinator"
+        killed["node"] = victim
+        cluster.stop_node(victim)
+        cluster.run_for(30)     # node-left, replicas promoted
+
+    ids, _pages = _drain_scroll(cluster, master, "logs", SORTED_BODY, 7,
+                                between_pages=kill_context_owner)
+    assert killed, "chaos never fired"
+    assert ids == whole_ids, (
+        f"seed={chaos_seed}: scroll after killing {killed['node']} "
+        f"drifted: {ids} != {whole_ids}")
+    assert master.search_service.cursor_failovers >= 1, \
+        f"seed={chaos_seed}: failover path never taken"
+    cluster.run_for(5)
+    for nid, cn in cluster.cluster_nodes.items():
+        assert not cn.data_node.reader_contexts, f"seed={chaos_seed}: {nid}"
+
+
+@pytest.mark.chaos(seed=47)
+def test_node_kill_without_sort_fails_typed_not_silent(tmp_path,
+                                                       chaos_seed):
+    """No explicit sort → score order → the continuation point is NOT
+    portable to another copy once hits were emitted. Killing the
+    context owner must surface the typed
+    `search_context_missing_exception` — never a hang, and never a
+    silently truncated or duplicated stream."""
+    cluster = SimDataCluster(3, tmp_path, seed=chaos_seed)
+    master = _setup(cluster, shards=3, replicas=1, n=24)
+    body = {"query": {"match": {"body": "fox"}}}
+
+    resp = cluster.call(master.search, "logs", {**body, "size": 10},
+                        scroll=60.0)
+    sid = resp["_scroll_id"]
+    victim = _context_victim(cluster, master, sid, require_cursor=True)
+    while victim is None:      # page until a non-coordinator shard emits
+        resp = cluster.call(master.scroll, sid, 60.0)
+        assert resp["hits"]["hits"], \
+            f"seed={chaos_seed}: exhausted before chaos could fire"
+        victim = _context_victim(cluster, master, sid,
+                                 require_cursor=True)
+    cluster.stop_node(victim)
+    cluster.run_for(30)
+
+    with pytest.raises(SearchContextMissingException):
+        cluster.call(master.scroll, sid, 60.0)
+    # the failed scroll frees its record; a retry is typed too, not 500
+    with pytest.raises(SearchContextMissingException):
+        cluster.call(master.scroll, sid, 60.0)
+    assert master.search_service.open_scroll_count() == 0
+    cluster.run_for(5)
+    for nid, cn in cluster.cluster_nodes.items():
+        assert not cn.data_node.reader_contexts, f"seed={chaos_seed}: {nid}"
+
+
+# ---------------------------------------------------------------------------
+# PIT: lease-backed, survives relocation
+# ---------------------------------------------------------------------------
+
+
+def test_pit_survives_shard_relocation(tmp_path):
+    """A PIT pins its reader context under a `pit/…` retention lease on
+    the primary. An explicit `_cluster/reroute` move transfers the
+    lease and re-opens the context at the SAME pinned segment view on
+    the new primary — reads before and after the move are identical,
+    and writes made after the PIT opened stay invisible throughout."""
+    cluster = SimDataCluster(3, tmp_path, seed=23)
+    master = _setup(cluster, shards=1, replicas=0, n=20)
+
+    pit = cluster.call(master.open_pit, "logs", 600.0)["id"]
+    pit_body = {**SORTED_BODY, "size": 50, "pit": {"id": pit}}
+    before = cluster.call(master.search, "_all", pit_body)
+    assert before["hits"]["total"]["value"] == 20
+
+    # writes after the PIT opened: visible to a plain search only
+    late = [{"op": "index", "id": f"late-{i}",
+             "source": {"body": f"late fox {i}", "n": 100 + i}}
+            for i in range(5)]
+    assert cluster.call(master.bulk, "logs", late)["errors"] == []
+    cluster.call(master.refresh)
+    assert cluster.call(
+        master.search, "logs",
+        {**SORTED_BODY, "size": 50})["hits"]["total"]["value"] == 20 + 5
+    assert cluster.call(master.search, "_all", pit_body)[
+        "hits"]["total"]["value"] == 20
+
+    state = master.state
+    src = state.routing_table.index("logs").shard(0).primary.current_node_id
+    tgt = next(n.node_id for n in cluster.nodes if n.node_id != src)
+    src_leases = [
+        lid for lid in cluster.cluster_nodes[src].data_node
+        .shards[("logs", 0)].tracker.get_retention_leases()
+        if lid.startswith("pit/")]
+    assert src_leases, "PIT opened without a retention lease"
+
+    cluster.call(master.reroute, commands=[{"move": {
+        "index": "logs", "shard": 0,
+        "from_node": src, "to_node": tgt}}])
+    cluster.run_for(60)
+    assert master.state.routing_table.index("logs").shard(0) \
+        .primary.current_node_id == tgt
+
+    transfers = sum(cn.data_node.lease_transfers
+                    for cn in cluster.cluster_nodes.values())
+    assert transfers >= 1, "relocation never transferred the PIT lease"
+    tgt_dn = cluster.cluster_nodes[tgt].data_node
+    assert any(ctx.pit for ctx in tgt_dn.reader_contexts.values()), \
+        "pinned context did not travel with the handoff"
+    assert src_leases == [
+        lid for lid in tgt_dn.shards[("logs", 0)]
+        .tracker.get_retention_leases() if lid.startswith("pit/")]
+
+    after = cluster.call(master.search, "_all", pit_body)
+    assert _hit_ids(after) == _hit_ids(before), \
+        "PIT view changed across relocation"
+    assert after["hits"]["total"]["value"] == 20
+
+    assert cluster.call(master.close_pit, pit) == \
+        {"succeeded": True, "num_freed": 1}
+    cluster.run_for(5)
+    _assert_no_cursor_state(cluster)
+    with pytest.raises(SearchContextMissingException):
+        cluster.call(master.search, "_all", pit_body)
+
+
+# ---------------------------------------------------------------------------
+# async search: cancel through `_tasks` from a non-owning node
+# ---------------------------------------------------------------------------
+
+
+def _call_fast(cluster, fn, *args, timeout=30.0, **kwargs):
+    """cluster.call with 0.05s sim steps so probes resolve while a
+    slowed search is still mid-flight."""
+    box = {}
+
+    def on_done(result, err=None):
+        box["result"], box["err"] = result, err
+
+    fn(*args, **kwargs, on_done=on_done)
+    waited = 0.0
+    while "result" not in box and "err" not in box and waited < timeout:
+        cluster.run_for(0.05)
+        waited += 0.05
+    assert "result" in box or "err" in box, "call never completed"
+    if box.get("err") is not None:
+        raise box["err"]
+    return box["result"]
+
+
+@pytest.mark.chaos(seed=53)
+def test_async_search_cancelled_from_non_owning_node(tmp_path,
+                                                     chaos_seed):
+    """Submit on the owner, then list/cancel/get/delete from a DIFFERENT
+    node: the id routes every op to the owner, the running fan-out is a
+    `GET /_tasks`-visible cancellable parent, and after the cancel +
+    delete the fleet holds zero tasks, contexts, or async records."""
+    from elasticsearch_tpu.search.async_search import ASYNC_SUBMIT_ACTION
+
+    cluster = SimDataCluster(3, tmp_path, seed=chaos_seed)
+    master = _setup(cluster, shards=4, replicas=0, n=24)
+    for cn in cluster.cluster_nodes.values():
+        cn.search_service.query_step_delay = 1.0
+
+    sub = _call_fast(cluster, master.submit_async_search, "logs",
+                     {**SORTED_BODY, "size": 5},
+                     {"wait_for_completion_timeout": "0s",
+                      "keep_alive": "1m"})
+    assert sub["is_running"] and sub["is_partial"], \
+        f"seed={chaos_seed}: search finished before the wait elapsed"
+    owner_task = sub["task"]
+    assert owner_task.startswith(master.local_node.node_id + ":")
+
+    other = next(cn for nid, cn in sorted(cluster.cluster_nodes.items())
+                 if nid != master.local_node.node_id)
+    listed = _call_fast(cluster, other.list_tasks,
+                        {"group_by": "none", "detailed": True})
+    assert owner_task in listed["tasks"], \
+        f"seed={chaos_seed}: submit task invisible in _tasks: {listed}"
+    assert listed["tasks"][owner_task]["action"] == ASYNC_SUBMIT_ACTION
+    assert listed["tasks"][owner_task]["cancellable"] is True
+
+    cancel = _call_fast(cluster, other.cancel_task, owner_task)
+    assert cancel.get("node_failures", []) == []
+    cluster.run_for(10)     # fan-out dies, bans swept one beat later
+
+    got = _call_fast(cluster, other.get_async_search, sub["id"], {})
+    assert got["is_running"] is False, f"seed={chaos_seed}: {got}"
+    assert got["is_partial"] is True
+    # the cancel surfaces TYPED: either a top-level error or per-shard
+    # task_cancelled_exception failures folded into the partial result
+    assert "task_cancelled" in json.dumps(got), \
+        f"seed={chaos_seed}: cancel did not surface typed: {got}"
+
+    assert _call_fast(cluster, other.delete_async_search, sub["id"]) == \
+        {"acknowledged": True}
+    # across the transport the typed miss arrives wrapped — match on
+    # the carried type, not the wrapper class
+    with pytest.raises(Exception, match="ResourceNotFound"):
+        _call_fast(cluster, other.get_async_search, sub["id"], {})
+    cluster.run_for(5)
+    assert master.async_search.open_async_search_count() == 0
+    _assert_no_cursor_state(cluster)
+
+
+# ---------------------------------------------------------------------------
+# determinism: same seed, byte-identical cursor transcript
+# ---------------------------------------------------------------------------
+
+
+def _cursor_transcript(tmp_path, seed):
+    """A scripted scroll+PIT+async scenario; returns its canonical JSON
+    transcript."""
+    cluster = SimDataCluster(3, tmp_path, seed=seed)
+    master = _setup(cluster, n=18)
+    out = []
+    ids, pages = _drain_scroll(cluster, master, "logs", SORTED_BODY, 5)
+    out.append(ids)
+    out.extend(pages)
+    pit = cluster.call(master.open_pit, "logs", 300.0)
+    out.append(pit)
+    out.append(cluster.call(master.search, "_all",
+                            {**SORTED_BODY, "size": 9,
+                             "pit": {"id": pit["id"]}}))
+    out.append(cluster.call(master.close_pit, pit["id"]))
+    out.append(cluster.call(master.submit_async_search, "logs",
+                            {**SORTED_BODY, "size": 3},
+                            {"wait_for_completion_timeout": "30s"}))
+    out.append(cluster.call(master.delete_async_search, out[-1]["id"]))
+    cluster.run_for(5)
+    return json.dumps(out, sort_keys=True)
+
+
+def test_same_seed_cursor_replay_is_byte_identical(tmp_path):
+    a = _cursor_transcript(tmp_path / "a", seed=67)
+    b = _cursor_transcript(tmp_path / "b", seed=67)
+    assert a == b, "same-seed cursor run diverged"
+
+
+# ---------------------------------------------------------------------------
+# single-node: the resumable drain the reindex worker and EQL ride
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def single_node(tmp_path):
+    n = Node(data_path=str(tmp_path / "data"))
+    idx = n.indices_service.create_index(
+        "logs", {"index.number_of_shards": 2},
+        {"properties": {"n": {"type": "integer"},
+                        "body": {"type": "text"}}})
+    for i in range(17):
+        idx.index_doc(f"doc-{i}", {"n": i, "body": f"fox {i}"})
+    idx.refresh()
+    yield n
+    n.close()
+
+
+def test_resumable_drain_survives_lost_context_with_sort(single_node):
+    """`resumable_scroll_batches` with an explicit sort: the scroll
+    record is destroyed behind the drain's back after the first batch;
+    the drain re-opens with `search_after` at the last emitted sort
+    key and the total stream is still exact — no gap, no repeat."""
+    from elasticsearch_tpu.search.service import resumable_scroll_batches
+
+    svc = single_node.search_service
+    body = {"query": {"match_all": {}}, "sort": [{"n": "asc"}]}
+    resumes = []
+    gen = resumable_scroll_batches(svc, "logs", body, 5,
+                                   on_resume=lambda: resumes.append(1))
+    got = [h["_id"] for h in next(gen)]
+    svc.clear_scroll(["_all"])          # the "node kill"
+    for batch in gen:
+        got.extend(h["_id"] for h in batch)
+    assert got == [f"doc-{i}" for i in range(17)]
+    assert len(resumes) == 1, "resume path never exercised"
+
+
+def test_resumable_drain_survives_lost_context_without_sort(single_node):
+    """Without a sort the resume re-opens the stream and skips the
+    already-emitted prefix by count — same exact id sequence."""
+    from elasticsearch_tpu.search.service import resumable_scroll_batches
+
+    svc = single_node.search_service
+    body = {"query": {"match_all": {}}}
+    baseline = [h["_id"] for batch in resumable_scroll_batches(
+        svc, "logs", dict(body), 4) for h in batch]
+    assert len(baseline) == 17
+
+    resumes = []
+    gen = resumable_scroll_batches(svc, "logs", dict(body), 4,
+                                   on_resume=lambda: resumes.append(1))
+    got = [h["_id"] for h in next(gen)]
+    got.extend(h["_id"] for h in next(gen))
+    svc.clear_scroll(["_all"])
+    for batch in gen:
+        got.extend(h["_id"] for h in batch)
+    assert got == baseline
+    assert len(resumes) == 1
+
+
+def test_eql_windowed_fetch_matches_unwindowed(single_node, monkeypatch):
+    """Satellite guard: shrinking EQL_FETCH_WINDOW far below the result
+    set changes memory behaviour only — the response is identical."""
+    import elasticsearch_tpu.xpack.eql as eql_mod
+
+    def run():
+        status, r = single_node.rest_controller.dispatch(
+            "POST", "/logs/_eql/search", {},
+            {"query": "any where true", "timestamp_field": "n",
+             "event_category_field": "body", "size": 17})
+        assert status == 200, r
+        r.pop("took", None)     # wall-clock latency, not a result
+        return r
+
+    monkeypatch.setattr(eql_mod, "EQL_FETCH_WINDOW", 3)
+    windowed = run()
+    monkeypatch.setattr(eql_mod, "EQL_FETCH_WINDOW", 1000)
+    whole = run()
+    assert windowed == whole
+    assert len(whole["hits"]["events"]) == 17

@@ -17,6 +17,12 @@ from elasticsearch_tpu.testing.yaml_rest import YamlRestRunner
 
 ALL_SUITES = sorted(glob.glob(os.path.join(
     os.path.dirname(__file__), "yaml_suites", "*.yml")))
+
+
+def _seconds(tv):
+    """YAML keep-alives ("30s"/"5m") → scheduler-clock seconds."""
+    from elasticsearch_tpu.common.settings import parse_time_value
+    return parse_time_value(str(tv), "keep_alive")
 CLUSTER_SUITES = [s for s in ALL_SUITES
                   if os.path.basename(s).startswith("93_")]
 SUITES = [s for s in ALL_SUITES if s not in CLUSTER_SUITES]
@@ -81,9 +87,52 @@ class ClusterYamlAdapter:
                 if "allow_partial_search_results" in params:
                     body["allow_partial_search_results"] = \
                         params["allow_partial_search_results"]
-                resp = self.cluster.call(self.master.search,
-                                         m.group(1), body)
+                if "scroll" in params:
+                    resp = self.cluster.call(
+                        self.master.search, m.group(1), body,
+                        scroll=_seconds(params["scroll"]))
+                else:
+                    resp = self.cluster.call(self.master.search,
+                                             m.group(1), body)
                 return 200, resp
+            if path == "/_search" and method in ("GET", "POST"):
+                # PIT searches target no index — the pit id IS the scope
+                return 200, self.cluster.call(self.master.search,
+                                              "_all", dict(body or {}))
+            if path == "/_search/scroll" and method in ("POST", "GET"):
+                b = dict(body or {})
+                sid = b.get("scroll_id") or params.get("scroll_id")
+                keep = b.get("scroll") or params.get("scroll")
+                return 200, self.cluster.call(
+                    self.master.scroll, sid,
+                    _seconds(keep) if keep else None)
+            if path == "/_search/scroll" and method == "DELETE":
+                ids = (body or {}).get("scroll_id", ["_all"])
+                if isinstance(ids, str):
+                    ids = [ids]
+                return 200, self.cluster.call(self.master.clear_scroll,
+                                              ids)
+            m = re.fullmatch(r"/([^/]+)/_pit", path)
+            if m and method == "POST":
+                return 200, self.cluster.call(
+                    self.master.open_pit, m.group(1),
+                    _seconds(params.get("keep_alive", "5m")))
+            if path == "/_pit" and method == "DELETE":
+                return 200, self.cluster.call(self.master.close_pit,
+                                              (body or {})["id"])
+            m = re.fullmatch(r"/([^/]+)/_async_search", path)
+            if m and method == "POST":
+                return 200, self.cluster.call(
+                    self.master.submit_async_search, m.group(1),
+                    dict(body or {}), dict(params))
+            m = re.fullmatch(r"/_async_search/([^/]+)", path)
+            if m and method == "GET":
+                return 200, self.cluster.call(
+                    self.master.get_async_search, m.group(1),
+                    dict(params))
+            if m and method == "DELETE":
+                return 200, self.cluster.call(
+                    self.master.delete_async_search, m.group(1))
         except ElasticsearchTpuException as e:
             return e.status, {
                 "error": {**e.to_xcontent(),
