@@ -93,6 +93,10 @@ CLUSTER_REROUTE_ACTION = "cluster:admin/reroute"
 CLUSTER_SETTINGS_ACTION = "cluster:admin/settings/update"
 RECOVERY_STATS_ACTION = "indices:monitor/recovery[n]"
 HEALTH_REPORT_ACTION = "cluster:monitor/health_report[n]"
+# launch-path flight recorder: per-node (spans, launch/readback events)
+# slice of one trace, stitched by the coordinator into a cross-node
+# request waterfall (GET /_flight_recorder/waterfall/{trace_id})
+FLIGHT_TRACE_ACTION = "cluster:monitor/flight_recorder/trace[n]"
 # rolling upgrades: node-shutdown markers in cluster state (ref: the
 # x-pack shutdown plugin's PUT/GET/DELETE _nodes/{id}/shutdown)
 NODE_SHUTDOWN_PUT_ACTION = "cluster:admin/shutdown/put"
@@ -301,6 +305,7 @@ class ClusterNode:
             (CLUSTER_SETTINGS_ACTION, self._on_cluster_settings),
             (RECOVERY_STATS_ACTION, self._on_recovery_stats),
             (HEALTH_REPORT_ACTION, self._on_health_report),
+            (FLIGHT_TRACE_ACTION, self._on_flight_trace),
             (NODE_SHUTDOWN_PUT_ACTION, self._on_put_shutdown),
             (NODE_SHUTDOWN_GET_ACTION, self._on_get_shutdown),
             (NODE_SHUTDOWN_DELETE_ACTION, self._on_delete_shutdown),
@@ -676,6 +681,55 @@ class ClusterNode:
                 node, ENGINE_STATS_ACTION, {},
                 ResponseHandler(ok, fail), timeout=30.0)
 
+    # --------------------------------------- flight-recorder waterfall
+
+    def _on_flight_trace(self, req, channel, src) -> None:
+        """This node's slice of a trace: its tracing spans plus every
+        flight-ring launch/readback event tagged with the trace id."""
+        tid = req.get("trace_id")
+        t = self.telemetry.tracer.trace(tid)
+        channel.send_response({
+            "node": self.local_node.node_id,
+            "spans": (t or {}).get("spans", []),
+            "events": self.telemetry.flight.events_for_trace(tid),
+        })
+
+    def flight_waterfall(self, trace_id: str,
+                         on_done: Callable = lambda r, e: None) -> None:
+        """Cross-node request waterfall: fan FLIGHT_TRACE_ACTION out to
+        every cluster node, then stitch the per-node (spans, events)
+        slices into ONE span tree with launch/readback events attached
+        to the spans they ran under and per-hop self time
+        (flightrecorder.build_waterfall). Unreachable nodes contribute
+        an empty slice — a partial waterfall beats none."""
+        from elasticsearch_tpu.telemetry.flightrecorder import (
+            build_waterfall)
+        nodes = list(self.state.nodes.nodes) or [self.local_node]
+        slices: List[Dict[str, Any]] = []
+        pending = {"n": len(nodes)}
+
+        def finish():
+            pending["n"] -= 1
+            if pending["n"] == 0:
+                # deterministic stitch order regardless of response
+                # interleaving — seeded replays byte-match
+                slices.sort(key=lambda s: s["node"])
+                on_done(build_waterfall(trace_id, slices), None)
+
+        for node in nodes:
+            def ok(resp, _nid=node.node_id):
+                slices.append(resp)
+                finish()
+
+            def fail(exc, _nid=node.node_id):
+                slices.append({"node": _nid, "spans": [], "events": [],
+                               "error": str(exc)})
+                finish()
+
+            self.transport.send_request(
+                node, FLIGHT_TRACE_ACTION, {"trace_id": trace_id},
+                ResponseHandler(ok, fail), timeout=30.0)
+
     # ------------------------------------------------- recovery stats
 
     def _on_recovery_stats(self, req, channel, src) -> None:
@@ -950,7 +1004,8 @@ class ClusterNode:
             state_lag=(self.coordinator.state_lag()
                        if self.is_master() else None),
             engine_totals=_engine.TRACKER.totals(),
-            watchdog=self.health_watchdog)
+            watchdog=self.health_watchdog,
+            flight=self.telemetry.flight)
 
     def _on_health_report(self, req, channel, src) -> None:
         from elasticsearch_tpu.health import UnknownIndicatorError

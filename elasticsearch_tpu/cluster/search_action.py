@@ -96,6 +96,7 @@ from elasticsearch_tpu.common.errors import (
 from elasticsearch_tpu.search.queries import MatchAllQuery, parse_query
 from elasticsearch_tpu.search.searcher import DocAddress, ShardSearcher
 from elasticsearch_tpu.telemetry import context as _telectx
+from elasticsearch_tpu.telemetry import flightrecorder as _flightrec
 from elasticsearch_tpu.transport.tasks import (
     TaskId,
     register_child_of_incoming,
@@ -482,7 +483,8 @@ class DistributedSearchService:
             shard_id = shards[st["i"]]
             st["i"] += 1
             st["results"].append(self._query_one_shard(
-                req, body, query, post_filter, k, shard_id, child))
+                req, body, query, post_filter, k, shard_id, child,
+                span=span))
             self.scheduler.schedule(
                 self.query_step_delay, step,
                 f"query shard [{req.get('index')}][{shard_id}]")
@@ -490,7 +492,8 @@ class DistributedSearchService:
         step()
 
     def _query_one_shard(self, req, body, query, post_filter, k: int,
-                         shard_id: int, child) -> Dict[str, Any]:
+                         shard_id: int, child,
+                         span=None) -> Dict[str, Any]:
         """One shard's query phase, under this node's stage sink and the
         child task's device-launch cancellation hook.
 
@@ -533,6 +536,15 @@ class DistributedSearchService:
                 if self.telemetry is not None:
                     stack.enter_context(
                         _prof.stage_sink(self.telemetry.stage_sink()))
+                    # arm THIS node's flight recorder under the shard
+                    # span: every launch/readback the shard drives lands
+                    # in the ring tagged (trace_id, shard-span id), which
+                    # is what lets the waterfall attach device events to
+                    # the data-node hop that issued them
+                    stack.enter_context(
+                        _flightrec.activate(self.telemetry.flight))
+                    if span is not None:
+                        stack.enter_context(_telectx.activate_span(span))
                 if child is not None:
                     # a cancel arriving mid-scan aborts at the next
                     # stage boundary (between device launches); the
@@ -804,14 +816,21 @@ class DistributedSearchService:
                         record_search_slowlog,
                         slowest_stage_summary,
                     )
+                    _trace_id = (root_span.trace_id
+                                 if root_span is not None else None)
+                    _fl = (self.telemetry.flight
+                           if self.telemetry is not None else None)
                     record_search_slowlog(
                         lambda n: getattr(state.metadata.index(n),
                                           "settings", None),
                         indices, resp.get("took", 0), body,
                         self.slowlog_recent,
-                        trace_id=(root_span.trace_id
-                                  if root_span is not None else None),
-                        slowest_stage=slowest_stage_summary(resp))
+                        trace_id=_trace_id,
+                        slowest_stage=slowest_stage_summary(resp),
+                        opaque_id=_telectx.current_opaque_id(),
+                        flight=(_fl.summary_for_trace(_trace_id)
+                                if _fl is not None and _trace_id
+                                else None))
                 except Exception:  # noqa: BLE001 — a malformed slowlog
                     # setting must never swallow a finished search
                     import logging

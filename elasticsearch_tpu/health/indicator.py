@@ -123,6 +123,7 @@ class HealthContext:
     engine_totals: Optional[Dict[str, Any]] = None  # compile tracker
     mesh_stats: Optional[Dict[str, Any]] = None     # mesh executor
     watchdog: Any = None             # StalledProgressWatchdog
+    flight: Any = None               # FlightRecorder (launch-path ring)
 
 
 class HealthIndicator:

@@ -46,6 +46,12 @@ COMPILE_STORM_PER_MIN = 30.0    # fresh compiles per minute
 HBM_USED_YELLOW = 0.85
 MESH_FALLBACK_YELLOW = 0.10     # fallback fraction of mesh dispatches
 
+# launch-path regime (flight recorder)
+REGIME_DEGRADED_YELLOW_S = 5.0   # degraded seconds in window
+REGIME_DEGRADED_RED_S = 45.0     # stuck: most of the window degraded
+FILL_RATIO_YELLOW = 0.25         # filled/slots over the window
+FILL_MIN_LAUNCHES = 32           # don't judge fill on a trickle
+
 
 def shard_availability_summary(
         cluster_state: Optional[Any]) -> Dict[str, Any]:
@@ -538,6 +544,103 @@ class NodeShutdownIndicator(HealthIndicator):
             details=details, impacts=impacts, diagnoses=diagnoses)
 
 
+class FlightRegimeIndicator(HealthIndicator):
+    """Launch-path regime + batcher fill, off the flight recorder.
+
+    Two storm-shaped verdicts the point-in-time engine stats cannot
+    render: a node STUCK in the degraded launch regime (windowed
+    ``flight.regime_seconds.degraded`` delta — a momentary flip that
+    recovered stays green) and a CHRONICALLY under-filled batcher
+    (windowed filled/slots ratio — cohort launches paying for capacity
+    they don't use, the BENCH serving row's throughput killer)."""
+
+    name = "device_regime"
+
+    def compute(self, ctx: HealthContext) -> HealthIndicatorResult:
+        if ctx.flight is None:
+            return HealthIndicatorResult(
+                name=self.name, status=HealthStatus.UNKNOWN,
+                symptom="no flight recorder wired")
+        agg = ctx.flight.aggregates()
+        regime = agg["regime"]["current"]
+        degraded_s = 0.0
+        launches = slots = filled = 0.0
+        if ctx.history is not None:
+            degraded_s = ctx.history.delta_total(
+                "flight.regime_seconds.degraded", HEALTH_RATE_WINDOW_S)
+            launches = ctx.history.delta_total(
+                "flight.launches", HEALTH_RATE_WINDOW_S)
+            slots = ctx.history.delta_total(
+                "flight.launch.slots", HEALTH_RATE_WINDOW_S)
+            filled = ctx.history.delta_total(
+                "flight.launch.filled", HEALTH_RATE_WINDOW_S)
+        fill_ratio = (filled / slots) if slots else None
+        underfilled = (launches >= FILL_MIN_LAUNCHES
+                       and fill_ratio is not None
+                       and fill_ratio < FILL_RATIO_YELLOW)
+        details = {
+            "regime": regime,
+            "latency_ema_ms": agg["regime"]["latency_ema_ms"],
+            "last_flip": agg["regime"]["last_flip"],
+            "degraded_seconds_in_window": degraded_s,
+            "window_s": HEALTH_RATE_WINDOW_S,
+            "launches_in_window": launches,
+            "fill_ratio_in_window": fill_ratio,
+        }
+        impacts: List[Impact] = []
+        diagnoses: List[Diagnosis] = []
+        stuck = (regime == "degraded"
+                 and degraded_s >= REGIME_DEGRADED_YELLOW_S)
+        if stuck and degraded_s >= REGIME_DEGRADED_RED_S:
+            status = HealthStatus.RED
+            symptom = (f"node stuck in degraded launch regime for "
+                       f"{degraded_s:.0f}s of the last "
+                       f"{int(HEALTH_RATE_WINDOW_S)}s")
+        elif stuck or underfilled:
+            status = HealthStatus.YELLOW
+            symptom = ("node in degraded launch regime"
+                       if stuck else
+                       f"cohort batcher chronically under-filled "
+                       f"({100.0 * fill_ratio:.0f}% of slots used)")
+        else:
+            status = HealthStatus.GREEN
+            symptom = ("launch path in fast regime"
+                       if regime == "fast" else
+                       "degraded flip recovered within the window")
+        if stuck:
+            flip = agg["regime"]["last_flip"] or {}
+            impacts.append(Impact(
+                id="slow_searches", severity=2,
+                description="every device launch pays degraded "
+                            "dispatch latency; search p99 inflates",
+                impact_areas=["search"]))
+            diagnoses.append(Diagnosis(
+                id="device_regime:degraded",
+                cause=f"launch latency EMA over the degraded "
+                      f"threshold (last flip cause: "
+                      f"{flip.get('cause', 'unknown')})",
+                action="check host load and untracked readbacks "
+                       "(GET /_flight_recorder?kind=readback); a "
+                       "recompile storm shows in GET /_kernels",
+                affected_resources=[ctx.node_id]))
+        if underfilled:
+            impacts.append(Impact(
+                id="wasted_cohort_slots", severity=3,
+                description="cohort launches run mostly-empty: "
+                            "device time is spent on padding",
+                impact_areas=["search"]))
+            diagnoses.append(Diagnosis(
+                id="device_regime:underfilled_batcher",
+                cause=f"only {100.0 * fill_ratio:.0f}% of cohort "
+                      f"slots carried a query over the window",
+                action="lower search.batching max wait / bucket "
+                       "sizes, or route more traffic at this node",
+                affected_resources=[ctx.node_id]))
+        return HealthIndicatorResult(
+            name=self.name, status=status, symptom=symptom,
+            details=details, impacts=impacts, diagnoses=diagnoses)
+
+
 # the registry ESTPU-HEALTH01 pins: every HealthIndicator subclass in
 # health/ must appear here, or the linter flags the class definition
 DEFAULT_INDICATORS = (
@@ -548,4 +651,5 @@ DEFAULT_INDICATORS = (
     RecoveryProgressIndicator,
     DeviceEngineIndicator,
     NodeShutdownIndicator,
+    FlightRegimeIndicator,
 )

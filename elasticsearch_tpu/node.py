@@ -136,7 +136,8 @@ class Node:
                 task_manager=_self.task_manager,
                 engine_totals=_engine.TRACKER.totals(),
                 mesh_stats=_self.search_service.mesh_executor.stats(),
-                watchdog=_self.health_watchdog)
+                watchdog=_self.health_watchdog,
+                flight=_self.telemetry.flight)
 
         self.health = HealthService(context_fn=_health_context)
         # completed background-task responses (ref: the .tasks results

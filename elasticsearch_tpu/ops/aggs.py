@@ -33,6 +33,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from elasticsearch_tpu.ops import device as device_ops
 from elasticsearch_tpu.telemetry.engine import tracked_jit
 
 # buckets beyond this cap stay on the host unique/bincount path (a
@@ -66,7 +67,8 @@ def terms_counts_per_term(dev_perm, term_starts: np.ndarray,
     nonempty = (term_starts[1:] > term_starts[:-1])
     out = _terms_counts_kernel(dev_perm, mask, ends_idx, begins_idx,
                                begins_zero, nonempty)
-    return np.asarray(out).astype(np.int64)
+    return device_ops.readback("ops.aggs.terms_counts",
+                               out).astype(np.int64)
 
 
 # ---------------------------------------------------------------------------
@@ -130,7 +132,8 @@ def bucket_counts(dev_bucket_ids, dev_mask, nb: int) -> np.ndarray:
     if nb_pad == 0:
         raise ValueError(f"bucket count {nb} past AGG_BUCKET_CAP")
     out = _bucket_counts_kernel(dev_bucket_ids, dev_mask, nb_pad)
-    return np.asarray(out)[:nb].astype(np.int64)
+    return device_ops.readback("ops.aggs.bucket_counts",
+                               out)[:nb].astype(np.int64)
 
 
 @tracked_jit("agg_bucket_metrics", static_argnames=("nb",))
@@ -163,8 +166,10 @@ def bucket_metric_columns(dev_bucket_ids, dev_mask, dev_values,
         raise ValueError(f"bucket count {nb} past AGG_BUCKET_CAP")
     cnt, s, mn, mx, ss = _bucket_metrics_kernel(
         dev_bucket_ids, dev_mask, dev_values, dev_missing, nb_pad)
-    return (np.asarray(cnt)[:nb].astype(np.int64),
-            np.asarray(s)[:nb].astype(np.float64),
-            np.asarray(mn)[:nb].astype(np.float64),
-            np.asarray(mx)[:nb].astype(np.float64),
-            np.asarray(ss)[:nb].astype(np.float64))
+    cnt, s, mn, mx, ss = device_ops.readback(
+        "ops.aggs.bucket_metrics", cnt, s, mn, mx, ss)
+    return (cnt[:nb].astype(np.int64),
+            s[:nb].astype(np.float64),
+            mn[:nb].astype(np.float64),
+            mx[:nb].astype(np.float64),
+            ss[:nb].astype(np.float64))

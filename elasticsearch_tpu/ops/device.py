@@ -45,6 +45,40 @@ HBM_SLAB_CLASSES = ("postings", "norms", "live_mask", "vectors",
                     "doc_values", "ordinals", "filter_masks")
 
 
+def readback(site: str, *arrays, profile: bool = True):
+    """THE tracked device→host funnel: every product-path transfer of a
+    jitted output to host memory goes through here so its call site,
+    byte count, and duration land in the per-node flight recorder
+    (telemetry/flightrecorder.py) — provenance for the post-readback
+    degraded regime. estpu-lint's ESTPU-RB rules flag ``np.asarray`` /
+    ``jax.device_get`` / ``.block_until_ready()`` on jitted outputs
+    anywhere else in the engine dirs, keeping attribution total.
+
+    ``site`` is a stable dotted label (``"search.batching.plan_cohort"``);
+    returns the host array for one input, a tuple for several. Also
+    feeds the per-request ``profile: true`` readback counters, so the
+    two sites that used to hand-roll that share one implementation.
+    Costs two TLS getattrs plus the transfer when nothing is ambient.
+    """
+    from elasticsearch_tpu.search import profile as _prof
+    from elasticsearch_tpu.telemetry import flightrecorder as _flight
+    fr = _flight.current()
+    # profile=False: cohort-wide transfers (the batcher's ONE packed
+    # readback) keep per-entry attribution in their cohort meta instead
+    # of charging the whole cohort's bytes to the leader's request
+    prof_on = profile and _prof.recording()
+    t_prof = _prof.now_ns() if prof_on else 0
+    t_fr = fr.clock() if fr is not None else 0.0
+    out = tuple(np.asarray(a) for a in arrays)
+    if fr is not None:
+        fr.record_readback(
+            site, sum(int(a.nbytes) for a in out),
+            duration_ns=int((fr.clock() - t_fr) * 1e9))
+    if prof_on:
+        _prof.record_readback(t_prof, *out)
+    return out[0] if len(out) == 1 else out
+
+
 def round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 

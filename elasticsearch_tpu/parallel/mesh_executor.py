@@ -51,7 +51,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from elasticsearch_tpu.index.segment import BLOCK_SIZE, Segment
 from elasticsearch_tpu.ops import plan as plan_ops
-from elasticsearch_tpu.ops.device import block_bucket
+from elasticsearch_tpu.ops.device import block_bucket, readback
 from elasticsearch_tpu.search.plan import LogicalPlan, compile_plan
 from elasticsearch_tpu.telemetry.engine import tracked_jit
 from elasticsearch_tpu.utils.jax_compat import shard_map
@@ -856,9 +856,11 @@ class MeshSearchBackend:
         if quantized:
             nc = int(query.num_candidates or 3 * (query.k or 1000))
             nc = min(nc, nd)
-            ids = np.asarray(_mesh_knn_nominate(
-                vs.vectors, vs.sq_norms, vs.has_value, qvec,
-                corpus.mesh, vs.similarity, nc))       # [S, nc]
+            ids = readback(
+                "parallel.mesh_executor.knn_nominate",
+                _mesh_knn_nominate(
+                    vs.vectors, vs.sq_norms, vs.has_value, qvec,
+                    corpus.mesh, vs.similarity, nc))   # [S, nc]
             patch_ids = np.zeros((n_shards, nc), np.int32)
             patch_vals = np.zeros((n_shards, nc), np.float32)
             for si in range(n_shards):

@@ -15,6 +15,7 @@ import os
 import re
 import time
 import uuid
+from contextlib import ExitStack
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from elasticsearch_tpu import __version__
@@ -26,6 +27,8 @@ from elasticsearch_tpu.common.errors import (
     ResourceNotFoundException,
 )
 from elasticsearch_tpu.search.rank_eval import rank_eval
+from elasticsearch_tpu.telemetry import context as _telectx
+from elasticsearch_tpu.telemetry import flightrecorder as _flightrec
 from elasticsearch_tpu.transport.tasks import CancellableTask, TaskId
 
 Response = Tuple[int, Dict[str, Any]]
@@ -101,6 +104,15 @@ class RestController:
                     }
                 sec.audit.access_granted(user, priv, method, path)
             self.node.request_context.user = user
+        # client attribution + launch provenance: X-Opaque-Id (case-
+        # insensitive, ref: Task.X_OPAQUE_ID) becomes ambient for the
+        # handler, and the node's flight recorder is armed so every
+        # kernel launch / device readback under this request lands in
+        # the ring tagged with the request's trace
+        opaque = next((str(v) for k, v in (headers or {}).items()
+                       if k.lower() == "x-opaque-id"), None)
+        flight = getattr(getattr(self.node, "telemetry", None),
+                         "flight", None)
         matched_path = False
         for m, regex, names, handler in self._routes:
             match = regex.match(path)
@@ -111,7 +123,13 @@ class RestController:
                 continue
             try:
                 kwargs = match.groupdict()
-                return handler(self.node, params, body, **kwargs)
+                with ExitStack() as stack:
+                    if opaque:
+                        stack.enter_context(
+                            _telectx.activate_opaque(opaque))
+                    if flight is not None:
+                        stack.enter_context(_flightrec.activate(flight))
+                    return handler(self.node, params, body, **kwargs)
             except ElasticsearchTpuException as e:
                 return e.status, {
                     "error": {**e.to_xcontent(),
@@ -163,6 +181,9 @@ def _register_all(c: RestController):
     # recent-trace surface (telemetry/): span ring buffer + span trees
     c.register("GET", "/_traces", get_traces)
     c.register("GET", "/_traces/{trace_id}", get_trace)
+    c.register("GET", "/_flight_recorder", get_flight_recorder)
+    c.register("GET", "/_flight_recorder/waterfall/{trace_id}",
+               get_flight_waterfall)
     # engine observability (telemetry/engine.py): per-kernel compile table
     c.register("GET", "/_kernels", get_kernels)
     c.register("GET", "/_cat/indices", cat_indices)
@@ -775,6 +796,46 @@ def get_trace(node, params, body, trace_id):
     if t is None:
         raise ResourceNotFoundException(f"unknown trace [{trace_id}]")
     return 200, t
+
+
+def get_flight_recorder(node, params, body):
+    """GET /_flight_recorder — this node's launch-path flight ring,
+    newest first: every kernel launch (bucketed shape, cohort fill,
+    queue-wait and dispatch nanos, regime tag) and every tracked
+    device→host readback (site, bytes). Filters: ``kind=launch|
+    readback``, ``kernel=``, ``site=``, ``trace_id=``, ``since_ns=``;
+    ``size``/``from`` page. ``aggregates`` rides along — ring
+    occupancy, fill histogram, readback-by-site, regime state."""
+    fl = node.telemetry.flight
+    events = fl.events(
+        kind=params.get("kind"), kernel=params.get("kernel"),
+        site=params.get("site"), trace_id=params.get("trace_id"),
+        since_ns=(int(params["since_ns"])
+                  if params.get("since_ns") else None),
+        limit=int(params.get("size", 256)),
+        offset=int(params.get("from", 0)))
+    return 200, {"node": node.node_id, "events": events,
+                 "aggregates": fl.aggregates()}
+
+
+def get_flight_waterfall(node, params, body, trace_id):
+    """GET /_flight_recorder/waterfall/{trace_id} — the request
+    waterfall: the trace's span tree with this node's launch/readback
+    events attached to the spans they ran under, plus per-span self
+    time. On a cluster node the coordinator fans the same question out
+    to every node and stitches one cross-node waterfall
+    (``ClusterNode.flight_waterfall``); standalone it renders the
+    local slice with the same ``build_waterfall`` merge."""
+    from elasticsearch_tpu.telemetry import flightrecorder as _fl
+    t = node.telemetry.tracer.trace(trace_id)
+    events = node.telemetry.flight.events_for_trace(trace_id)
+    if t is None and not events:
+        raise ResourceNotFoundException(f"unknown trace [{trace_id}]")
+    return 200, _fl.build_waterfall(trace_id, [{
+        "node": node.node_id,
+        "spans": (t or {}).get("spans", []),
+        "events": events,
+    }])
 
 
 from contextlib import contextmanager

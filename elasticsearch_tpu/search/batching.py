@@ -29,8 +29,10 @@ import numpy as np
 
 from elasticsearch_tpu.ops.plan import unpack_ids as _unpack_ids
 
+from elasticsearch_tpu.ops import device as device_ops
 from elasticsearch_tpu.ops import plan as plan_ops
 from elasticsearch_tpu.search.plan import BoundPlan, execute_bound
+from elasticsearch_tpu.telemetry import flightrecorder as _flight
 
 _Q_BUCKETS = (1, 2, 4, 8, 16, 32)
 
@@ -61,10 +63,10 @@ def _nb_tier(n: int) -> int:
 
 class _Entry:
     __slots__ = ("bp", "event", "result", "error", "profiled", "t_enq",
-                 "meta")
+                 "meta", "t_fr")
 
     def __init__(self, bp: BoundPlan, profiled: bool = False,
-                 t_enq: int = 0):
+                 t_enq: int = 0, t_fr: float = 0.0):
         self.bp = bp
         self.event = threading.Event()
         self.result = None
@@ -76,6 +78,9 @@ class _Entry:
         # nothing extra
         self.profiled = profiled
         self.t_enq = t_enq
+        # enqueue stamp on the flight recorder's clock (always-on when
+        # a recorder is ambient): the cohort's queue-wait provenance
+        self.t_fr = t_fr
         self.meta: Optional[Dict[str, object]] = None
 
 
@@ -162,8 +167,10 @@ class PlanBatcher:
         if not self._eligible(bp, after_score):
             return execute_bound(bp, ctx, k, k1, b, after_score)
         sig = self._signature(bp, ctx, k, k1, b)
+        fr = _flight.current()
         entry = _Entry(bp, profiled=profiled,
-                       t_enq=_prof.now_ns() if profiled else 0)
+                       t_enq=_prof.now_ns() if profiled else 0,
+                       t_fr=fr.clock() if fr is not None else 0.0)
         with self._lock:
             q = self._pending.setdefault(sig, [])
             q.append(entry)
@@ -320,14 +327,25 @@ class PlanBatcher:
             from elasticsearch_tpu.search import profile as _prof
             t0p = _prof.now_ns()
         t0 = time.monotonic()
-        packed = plan_ops.plan_topk_batch(
-            streams, gk, gr, gc, live, nm, nf, ms, bo, ti,
-            k1=k1, b=b, k=k, combine=proto.combine,
-            # cohort-shared filter column + script (signature keys on
-            # their identities)
-            dense_mask=proto.dense_mask, script_fn=proto.script_fn)
+        # flight provenance: annotate the launch inside plan_topk_batch
+        # with the cohort's fill/capacity + the queue wait its OLDEST
+        # rider paid (recorder clock — virtual under the deterministic
+        # harness), and route the single packed readback through the
+        # tracked ops/device funnel
+        fr = _flight.current()
+        enq = [e.t_fr for e in batch if e.t_fr]
+        qw_ns = (int(max(0.0, fr.clock() - min(enq)) * 1e9)
+                 if fr is not None and enq else 0)
+        with _flight.annotate_launch(qn, bucket, queue_wait_ns=qw_ns):
+            packed = plan_ops.plan_topk_batch(
+                streams, gk, gr, gc, live, nm, nf, ms, bo, ti,
+                k1=k1, b=b, k=k, combine=proto.combine,
+                # cohort-shared filter column + script (signature keys
+                # on their identities)
+                dense_mask=proto.dense_mask, script_fn=proto.script_fn)
         # ONE readback for the whole batch (rows are packed buffers)
-        rows = np.asarray(packed)
+        rows = device_ops.readback("search.batching.plan_cohort", packed,
+                                   profile=False)
         dt = time.monotonic() - t0
         if dt < 5.0:   # ignore compile-length outliers (first launches)
             self._lat_ema = (dt if self._lat_ema == 0.0
@@ -405,10 +423,11 @@ def _cut_bucket(n: int) -> int:
 
 class _KnnEntry:
     __slots__ = ("qvec", "cut", "event", "result", "error", "profiled",
-                 "t_enq", "meta")
+                 "t_enq", "meta", "t_fr")
 
     def __init__(self, qvec: np.ndarray, cut: int,
-                 profiled: bool = False, t_enq: int = 0):
+                 profiled: bool = False, t_enq: int = 0,
+                 t_fr: float = 0.0):
         self.qvec = qvec
         self.cut = cut
         self.event = threading.Event()
@@ -416,6 +435,7 @@ class _KnnEntry:
         self.error: Optional[BaseException] = None
         self.profiled = profiled
         self.t_enq = t_enq
+        self.t_fr = t_fr
         self.meta: Optional[Dict[str, object]] = None
 
 
@@ -455,9 +475,11 @@ class KnnBatcher:
         bucket_cut = min(_cut_bucket(cut), nd)
         sig = (id(dv.vectors), id(live), dv.similarity, bucket_cut,
                int(qvec.shape[0]))
+        fr = _flight.current()
         entry = _KnnEntry(np.asarray(qvec, np.float32), cut,
                           profiled=profiled,
-                          t_enq=_prof.now_ns() if profiled else 0)
+                          t_enq=_prof.now_ns() if profiled else 0,
+                          t_fr=fr.clock() if fr is not None else 0.0)
         with self._lock:
             q = self._pending.setdefault(sig, [])
             q.append(entry)
@@ -526,15 +548,22 @@ class KnnBatcher:
                 from elasticsearch_tpu.search import profile as _prof
                 t0p = _prof.now_ns()
             t0 = time.monotonic()
-            top_s, top_i = vec_ops.knn_nominate_batch(
-                jnp.asarray(qs), dv.vectors, dv.sq_norms, dv.has_value,
-                live, dv.similarity, cut)
+            fr = _flight.current()
+            enq = [e.t_fr for e in chunk if e.t_fr]
+            qw_ns = (int(max(0.0, fr.clock() - min(enq)) * 1e9)
+                     if fr is not None and enq else 0)
+            with _flight.annotate_launch(qn, bucket,
+                                         queue_wait_ns=qw_ns):
+                top_s, top_i = vec_ops.knn_nominate_batch(
+                    jnp.asarray(qs), dv.vectors, dv.sq_norms,
+                    dv.has_value, live, dv.similarity, cut)
             # ONE packed readback: ids as float CASTS (exact < 2^24;
             # the axon runtime miscompiles multi-bitcast concats —
             # ops/plan.pack_result)
             packed = jnp.concatenate(
                 [top_s, top_i.astype(jnp.float32)], axis=1)
-            rows = np.asarray(packed)
+            rows = device_ops.readback("search.batching.knn_cohort",
+                                       packed, profile=False)
             dt = time.monotonic() - t0
             with self._lock:
                 if dt < 5.0:

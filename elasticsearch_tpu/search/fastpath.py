@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from elasticsearch_tpu.ops.device import readback as _readback
 from elasticsearch_tpu.ops.plan import unpack_ids as _unpack_ids
 
 logger = logging.getLogger("elasticsearch_tpu.fastpath")
@@ -1014,7 +1015,8 @@ class FastPathServer:
                 reg["flat_tfs"], sel, ws, dp.doc_lens, masks, mask_ids,
                 ts, tl, ti, self._weight_dtype()(dp.avg_len),
                 self.N_SLOTS, reg["k1"], reg["b"], k_static)
-        out = np.asarray(packed)      # ONE device→host sync per cohort
+        # ONE device→host sync per cohort, through the tracked funnel
+        out = _readback("search.fastpath.v2_cohort", packed)
         took_ms = int((time.time() - t_arrive) * 1000)
         self.stats["cohorts"] += 1
         self.stats["v2_queries"] = self.stats.get("v2_queries", 0) + q
@@ -1181,7 +1183,8 @@ class FastPathServer:
             bd, bt, sel_m, ws_m, dl, mk, mi,
             self._weight_dtype()(dp.avg_len), reg["k1"],
             reg["b"], k_static)
-        out = np.asarray(packed)       # ONE device→host sync per cohort
+        # ONE device→host sync per cohort, through the tracked funnel
+        out = _readback("search.fastpath.truncated_cohort", packed)
         took_ms = int((time.time() - t_arrive) * 1000)
         self.stats["cohorts"] += 1
         if self._mesh_active(reg):
@@ -1493,7 +1496,8 @@ class FastPathServer:
                 ne_start, ne_len, ne_idf, ne_bound,
                 self._weight_dtype()(dp.avg_len), reg["k1"], reg["b"],
                 k_static)
-        out = np.asarray(packed)
+        # ONE device→host sync per cohort, through the tracked funnel
+        out = _readback("search.fastpath.essential_cohort", packed)
         took_ms = int((time.time() - t_arrive) * 1000)
         idx_b = reg["index"].encode()
         h = self.front.h
@@ -1702,7 +1706,8 @@ class FastPathServer:
             bd, bt, sel_m, ws_m, dl, mk, mi,
             self._weight_dtype()(dp.avg_len), reg["k1"], reg["b"],
             k_static)
-        out = np.asarray(packed)       # ONE device→host sync per cohort
+        # ONE device→host sync per cohort, through the tracked funnel
+        out = _readback("search.fastpath.v1_cohort", packed)
         took_ms = int((time.time() - t_arrive) * 1000)
         self.stats["cohorts"] += 1
         if self._mesh_active(reg):

@@ -40,6 +40,7 @@ from elasticsearch_tpu.index.mapper import (
     TextFieldType,
 )
 from elasticsearch_tpu.ops import bm25 as bm25_ops
+from elasticsearch_tpu.ops import device as device_ops
 from elasticsearch_tpu.ops import vector as vec_ops
 from elasticsearch_tpu.search.context import SegmentContext
 from elasticsearch_tpu.search.script import ScriptContext, _DocColumn, compile_script
@@ -758,7 +759,10 @@ class KnnQuery(QueryBuilder):
         nc = int(self.num_candidates or 3 * (self.k or 1000))
         nc = min(nc, ctx.n_docs_padded)
         _, ids = jax.lax.top_k(scores, nc)
-        ids_h = np.asarray(ids)                # tiny readback [nc]
+        # tiny readback [nc] — THE canonical degraded-regime trigger
+        # (BENCH ×56-79 notes); tracked so the flight recorder can name
+        # it when the regime flips
+        ids_h = device_ops.readback("search.queries.knn_rerank_ids", ids)
         ids_h = ids_h[ids_h < vv.vectors.shape[0]]
         exact = vec_ops.exact_rerank_scores(
             vv.vectors[ids_h], self.query_vector.astype(np.float32),

@@ -27,6 +27,7 @@ import numpy as np
 from elasticsearch_tpu.common.errors import IllegalArgumentException
 from elasticsearch_tpu.index.mapper import MapperService
 from elasticsearch_tpu.index.segment import Segment
+from elasticsearch_tpu.ops import device as device_ops
 from elasticsearch_tpu.ops import topk as topk_ops
 from elasticsearch_tpu.search.context import (
     DeviceSegmentCache,
@@ -230,11 +231,10 @@ class ShardSearcher:
                 vals, ids = topk_ops.masked_topk(key, mask,
                                                  min(k, ctx.n_docs_padded))
             with _prof.span("readback"):
-                rec_on = _prof.recording()
-                t_rb = _prof.now_ns() if rec_on else 0
-                vals, ids = np.asarray(vals), np.asarray(ids)
-                if rec_on:
-                    _prof.record_readback(t_rb, vals, ids)
+                # the tracked funnel (ops/device.py): flight-recorder
+                # provenance + `profile: true` readback counters
+                vals, ids = device_ops.readback(
+                    "search.searcher.dense_topk", vals, ids)
             keep = np.isfinite(vals)
             ids = ids[keep]
             if self.bigarrays is not None:
@@ -357,11 +357,8 @@ class ShardSearcher:
                                 (_prof.now_ns() - t_l) / 1e6, 3),
                         })
             with _prof.span("readback"):
-                rec_on = _prof.recording()
-                t_rb = _prof.now_ns() if rec_on else 0
-                vals, ids = np.asarray(vals), np.asarray(ids)
-                if rec_on:
-                    _prof.record_readback(t_rb, vals, ids)
+                vals, ids = device_ops.readback(
+                    "search.searcher.plan_topk", vals, ids)
             if track_total_hits:
                 total += int(seg_total)
             keep = vals > -np.inf

@@ -631,13 +631,19 @@ class SearchService:
             slowest_stage_summary,
         )
         from elasticsearch_tpu.telemetry import context as _telectx
+        from elasticsearch_tpu.telemetry import flightrecorder as _fl
         ambient = _telectx.current()
+        trace_id = ambient.trace_id if ambient is not None else None
+        fr = _fl.current()
         record_search_slowlog(
             lambda n: (self.indices_service.get(n).settings
                        if self.indices_service.has(n) else None),
             names, took_ms, body, self.slowlog_recent,
-            trace_id=ambient.trace_id if ambient is not None else None,
-            slowest_stage=slowest_stage_summary(response))
+            trace_id=trace_id,
+            slowest_stage=slowest_stage_summary(response),
+            opaque_id=_telectx.current_opaque_id(),
+            flight=(fr.summary_for_trace(trace_id)
+                    if fr is not None and trace_id else None))
 
     def scroll(self, scroll_id: str, scroll: Optional[str] = None) -> Dict[str, Any]:
         start = time.monotonic()

@@ -81,7 +81,9 @@ def record_search_slowlog(
         index_names: List[str], took_ms: float, body: Dict[str, Any],
         recent: List[Dict[str, Any]],
         trace_id: Optional[str] = None,
-        slowest_stage: Optional[str] = None) -> List[Dict[str, Any]]:
+        slowest_stage: Optional[str] = None,
+        opaque_id: Optional[str] = None,
+        flight: Optional[Dict[str, Any]] = None) -> List[Dict[str, Any]]:
     """Check every searched index's thresholds against the search took
     time; append matches (highest matching level per index) to
     ``recent`` and return the new entries. ``settings_of(name)`` yields
@@ -89,7 +91,12 @@ def record_search_slowlog(
 
     ``trace_id`` / ``slowest_stage`` (optional) tie the entry into the
     observability chain: slowlog → ``GET /_traces/{id}`` → the profiled
-    request's stage breakdown."""
+    request's stage breakdown. ``opaque_id`` attributes the entry to
+    the client that sent it (the X-Opaque-Id header, ref:
+    SearchSlowLog's opaque-id field). ``flight`` is the flight
+    recorder's per-trace summary — launches, readbacks, worst cohort
+    fill, regime — so one slowlog line answers "was this slow request
+    under-batched or running degraded?" without replaying it."""
     from elasticsearch_tpu.common.settings import parse_time_value
     new_entries: List[Dict[str, Any]] = []
     for name in index_names:
@@ -112,6 +119,13 @@ def record_search_slowlog(
                     entry["trace.id"] = trace_id
                 if slowest_stage is not None:
                     entry["slowest_stage"] = slowest_stage
+                if opaque_id is not None:
+                    entry["x_opaque_id"] = opaque_id
+                if flight:
+                    entry["cohort_fill_pct"] = flight.get(
+                        "cohort_fill_pct")
+                    entry["readbacks"] = flight.get("readbacks")
+                    entry["regime"] = flight.get("regime")
                 _slowlog_logger.log(
                     _LEVEL_NUM[level],
                     "[%s] took[%dms], source[%s]",

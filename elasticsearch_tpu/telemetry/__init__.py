@@ -30,6 +30,9 @@ from elasticsearch_tpu.telemetry.history import (  # noqa: F401
     MetricsHistory,
 )
 from elasticsearch_tpu.telemetry.tracing import Span, Tracer  # noqa: F401
+from elasticsearch_tpu.telemetry.flightrecorder import (  # noqa: F401
+    FlightRecorder,
+)
 
 
 class Telemetry:
@@ -52,6 +55,12 @@ class Telemetry:
         self.history = MetricsHistory(
             self.metrics, self.metrics.clock,
             interval=history_interval, retention=history_retention)
+        # always-on launch/readback ring + regime classifier on the
+        # same clock (telemetry/flightrecorder.py); its regime/fill
+        # counters land in this registry, so the history ring and the
+        # health indicators window over them for free
+        self.flight = FlightRecorder(
+            node=node, clock=self.metrics.clock, metrics=self.metrics)
         # engine observability: this node's registry receives
         # `engine.compile.count` / `engine.compile.ms` from the
         # process-global compile tracker (telemetry/engine.py) — the
@@ -83,6 +92,9 @@ class Telemetry:
                 "open_spans": len(self.tracer.open_spans()),
                 "dropped_spans": self.tracer.dropped_spans_total,
             },
+            # launch/readback provenance + regime attribution (fill
+            # histogram, readback count by site, regime-seconds)
+            "flight_recorder": self.flight.aggregates(),
         }
         if history:
             self.history.advance()

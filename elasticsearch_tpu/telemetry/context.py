@@ -37,11 +37,13 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional
 
 from elasticsearch_tpu.search import profile as _profile
+from elasticsearch_tpu.telemetry import flightrecorder as _flight
 
 TRACE_HEADER = "trace.id"
 SPAN_HEADER = "span.id"
 TASK_HEADER = "task.id"
 PARENT_TASK_HEADER = "task.parent"
+OPAQUE_ID_HEADER = "X-Opaque-Id"
 
 _tls = threading.local()
 
@@ -102,6 +104,28 @@ def incoming_parent_task() -> Optional[str]:
     return getattr(_tls, "task_parent", None)
 
 
+# -- ambient client id (X-Opaque-Id) --------------------------------------
+
+def current_opaque_id() -> Optional[str]:
+    """The caller-supplied ``X-Opaque-Id`` the current work runs under —
+    the reference's ThreadContext header that lets operators attribute
+    tasks and slowlog entries back to a client (ref: Task.HEADERS_TO_COPY).
+    None when the originating REST request carried no such header."""
+    return getattr(_tls, "opaque", None)
+
+
+@contextmanager
+def activate_opaque(value: Optional[str]):
+    """Install an ``X-Opaque-Id`` as ambient for the request's duration
+    (no-op pass-through scope when value is falsy)."""
+    prev = getattr(_tls, "opaque", None)
+    _tls.opaque = value or prev
+    try:
+        yield value
+    finally:
+        _tls.opaque = prev
+
+
 # -- wire headers ---------------------------------------------------------
 
 def headers_of(span) -> Dict[str, str]:
@@ -125,6 +149,10 @@ def stamp_task_headers(headers: Optional[Dict[str, Any]]
     explicit ``task.id`` headers win. Returns the original dict object
     untouched when there is nothing to add."""
     cur = getattr(_tls, "task", None)
+    opaque = getattr(_tls, "opaque", None)
+    if opaque is not None and not (headers and OPAQUE_ID_HEADER in headers):
+        headers = dict(headers or {})
+        headers[OPAQUE_ID_HEADER] = opaque
     if cur is None or (headers and TASK_HEADER in headers):
         return headers
     node_id, task = cur
@@ -150,27 +178,33 @@ def incoming(headers: Optional[Dict[str, Any]]):
     (no-op without headers)."""
     ctx = from_headers(headers)
     task_id = (headers or {}).get(TASK_HEADER)
-    if ctx is None and task_id is None:
+    opaque = (headers or {}).get(OPAQUE_ID_HEADER)
+    if ctx is None and task_id is None and opaque is None:
         yield None
         return
     prev_ctx = getattr(_tls, "ctx", None)
     prev_task = getattr(_tls, "task_parent", None)
+    prev_opaque = getattr(_tls, "opaque", None)
     if ctx is not None:
         _tls.ctx = ctx
     _tls.task_parent = str(task_id) if task_id is not None else None
+    if opaque is not None:
+        _tls.opaque = str(opaque)
     try:
         yield ctx
     finally:
         _tls.ctx = prev_ctx
         _tls.task_parent = prev_task
+        _tls.opaque = prev_opaque
 
 
 # -- task-boundary carry --------------------------------------------------
 
 def capture():
     """Snapshot (profile recorder, profile sink, recorder clock, cancel
-    hook, stage hook, trace context, ambient task); None when nothing is
-    active — the common case costs a handful of getattrs."""
+    hook, stage hook, trace context, ambient task, opaque id, flight
+    recorder); None when nothing is active — the common case costs a
+    handful of getattrs."""
     rec = getattr(_profile._tls, "rec", None)
     sink = getattr(_profile._tls, "sink", None)
     clock = getattr(_profile._tls, "clock", None)
@@ -178,10 +212,13 @@ def capture():
     stage_cb = getattr(_profile._tls, "stage_cb", None)
     ctx = getattr(_tls, "ctx", None)
     task = getattr(_tls, "task", None)
+    opaque = getattr(_tls, "opaque", None)
+    flight = getattr(_flight._tls, "rec", None)
     if rec is None and sink is None and cancel is None \
-            and stage_cb is None and ctx is None and task is None:
+            and stage_cb is None and ctx is None and task is None \
+            and opaque is None and flight is None:
         return None
-    return (rec, sink, clock, cancel, stage_cb, ctx, task)
+    return (rec, sink, clock, cancel, stage_cb, ctx, task, opaque, flight)
 
 
 def bind(fn: Callable) -> Callable:
@@ -192,7 +229,7 @@ def bind(fn: Callable) -> Callable:
     cap = capture()
     if cap is None:
         return fn
-    rec, sink, clock, cancel, stage_cb, ctx, task = cap
+    rec, sink, clock, cancel, stage_cb, ctx, task, opaque, flight = cap
 
     def bound():
         prev_rec = getattr(_profile._tls, "rec", None)
@@ -202,6 +239,8 @@ def bind(fn: Callable) -> Callable:
         prev_stage = getattr(_profile._tls, "stage_cb", None)
         prev_ctx = getattr(_tls, "ctx", None)
         prev_task = getattr(_tls, "task", None)
+        prev_opaque = getattr(_tls, "opaque", None)
+        prev_flight = getattr(_flight._tls, "rec", None)
         _profile._tls.rec = rec
         _profile._tls.sink = sink
         _profile._tls.clock = clock
@@ -209,6 +248,8 @@ def bind(fn: Callable) -> Callable:
         _profile._tls.stage_cb = stage_cb
         _tls.ctx = ctx
         _tls.task = task
+        _tls.opaque = opaque
+        _flight._tls.rec = flight
         try:
             return fn()
         finally:
@@ -219,5 +260,7 @@ def bind(fn: Callable) -> Callable:
             _profile._tls.stage_cb = prev_stage
             _tls.ctx = prev_ctx
             _tls.task = prev_task
+            _tls.opaque = prev_opaque
+            _flight._tls.rec = prev_flight
 
     return bound

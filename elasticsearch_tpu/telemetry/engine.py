@@ -49,8 +49,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 # tracked_jit stamps kernel names into an active `profile: true`
 # recorder via profile.note_kernel
 from elasticsearch_tpu.search import profile as _profile
+from elasticsearch_tpu.telemetry import flightrecorder as _flight
 
 _prof_tls = _profile._tls
+_flight_tls = _flight._tls
 
 logger = logging.getLogger("elasticsearch_tpu.telemetry.engine")
 
@@ -467,8 +469,21 @@ def tracked_jit(name: Optional[str] = None, *,
             for p in sorted(kwargs):
                 parts.append(_component(p, kwargs[p], p in statics))
             key = tuple(parts)
+            # always-on flight recording: the ambient per-node ring
+            # (telemetry/flightrecorder.py) gets one launch event per
+            # trace-clean call — kernel id, bucketed shape, dispatch
+            # nanos on ITS clock, plus the batcher's cohort annotation
+            # when one is active (one TLS getattr when no recorder)
+            fr = getattr(_flight_tls, "rec", None)
             if not TRACKER.on_call(kname, key):
+                tfr = fr.clock() if fr is not None else 0.0
                 out = jitted(*args, **kwargs)
+                if fr is not None:
+                    info = getattr(_flight_tls, "launch_info", None) or {}
+                    fr.record_launch(
+                        kname, format_key(key),
+                        dispatch_ns=int((fr.clock() - tfr) * 1e9),
+                        **info)
                 # per-request attribution: a `profile: true` recorder
                 # active on this thread gets the kernel name for every
                 # tracked launch (one TLS getattr when profiling is off)
@@ -483,6 +498,13 @@ def tracked_jit(name: Optional[str] = None, *,
                 raise
             ms = (time.perf_counter() - t0) * 1000.0
             kind = TRACKER.on_compile(kname, key, ms)
+            if fr is not None:
+                # first execution per shape: record the launch without
+                # dispatch latency — compile time is the TRACKER's
+                # story, and it would poison the regime EMA
+                info = getattr(_flight_tls, "launch_info", None) or {}
+                fr.record_launch(kname, format_key(key), dispatch_ns=0,
+                                 **info)
             if getattr(_prof_tls, "rec", None) is not None:
                 _profile.note_kernel(kname, kind, ms)
             return out

@@ -110,10 +110,12 @@ class Task:
         # deterministic harness, so replayed runs report identical trees)
         self._clock = clock or time.monotonic
         self._start = self._clock()
-        # cross-link with the trace that was ambient at registration
+        # cross-link with the trace that was ambient at registration,
+        # plus the client's X-Opaque-Id (ref: Task.HEADERS_TO_COPY)
         from elasticsearch_tpu.telemetry import context as _telectx
         ctx = _telectx.current()
         self.trace_id: Optional[str] = ctx.trace_id if ctx else None
+        self.opaque_id: Optional[str] = _telectx.current_opaque_id()
 
     def running_time_nanos(self) -> int:
         return int((self._clock() - self._start) * 1e9)
@@ -131,6 +133,8 @@ class Task:
         }
         if self.trace_id is not None:
             d["trace.id"] = self.trace_id
+        if self.opaque_id is not None:
+            d["headers"] = {"X-Opaque-Id": self.opaque_id}
         if self.profile_stage is not None:
             d["profile_stage"] = self.profile_stage
         if self.parent_task_id is not EMPTY_TASK_ID and \

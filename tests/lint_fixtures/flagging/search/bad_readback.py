@@ -1,0 +1,25 @@
+# lint-fixture: flags=ESTPU-RB01,ESTPU-RB02
+"""Untracked device→host readbacks: every np.asarray off a jitted
+output (and every explicit JAX transfer API) in an engine dir must go
+through ops.device.readback(site, ...) so the flight recorder records
+provenance. (Kernel name reuses a real attribution row so only the RB
+rules fire.)"""
+import numpy as np
+
+import jax
+
+from elasticsearch_tpu.telemetry.engine import tracked_jit
+
+
+@tracked_jit("plan_topk_batch")
+def score_block(block):
+    return block
+
+
+def serve(postings):
+    out = score_block(postings)
+    vals = np.asarray(out)                  # lint-expect: ESTPU-RB01
+    also = np.asarray(score_block(postings))  # lint-expect: ESTPU-RB01
+    raw = jax.device_get(out)               # lint-expect: ESTPU-RB02
+    score_block(postings).block_until_ready()  # lint-expect: ESTPU-RB02
+    return vals, also, raw

@@ -1,0 +1,22 @@
+# lint-fixture: passes=ESTPU-RB01,ESTPU-RB02
+"""The corrected twin: jitted outputs come to the host through the ONE
+tracked funnel, stamped with a call-site label the flight recorder
+surfaces in GET /_flight_recorder; host-born arrays stay free to use
+numpy directly."""
+import numpy as np
+
+from elasticsearch_tpu.ops import device as device_ops
+from elasticsearch_tpu.telemetry.engine import tracked_jit
+
+
+@tracked_jit("plan_topk_batch")
+def score_block(block):
+    return block
+
+
+def serve(postings, host_rows):
+    out = score_block(postings)
+    vals = device_ops.readback("search.fixture.serve", out)
+    # np.asarray of HOST data is not a readback — no finding
+    staged = np.asarray(host_rows)
+    return vals, staged

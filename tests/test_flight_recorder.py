@@ -1,0 +1,519 @@
+"""Launch-path flight recorder: bounded always-on ring, regime
+classifier with flip cause, readback provenance through the one
+tracked funnel (ops.device.readback), X-Opaque-Id propagation, the
+REST surfaces, and cross-node request waterfalls that replay
+byte-identically from a chaos seed.
+
+Cluster tests ride the seeded harness of test_telemetry.py — the
+recorder runs on the scheduler clock, so every t_ns / dispatch_ns in a
+waterfall is a pure function of the seed.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.telemetry import context as telectx
+from elasticsearch_tpu.telemetry import flightrecorder as flightrec
+from elasticsearch_tpu.telemetry.flightrecorder import (
+    FlightRecorder,
+    build_waterfall,
+)
+
+from test_telemetry import ChaosCluster, _setup
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ------------------------------------------------------------ unit: ring
+
+def test_ring_bounded_under_overload():
+    """10x capacity recorded → ring holds exactly `capacity`, totals
+    keep counting (the acceptance memory bound)."""
+    fr = FlightRecorder(node="n1", clock=FakeClock(), capacity=64)
+    for i in range(640):
+        fr.record_launch(f"k{i % 3}", "(8,128)", dispatch_ns=1_000_000,
+                         cohort=4, capacity=8)
+    agg = fr.aggregates()
+    assert agg["ring"] == {"capacity": 64, "events": 64,
+                           "recorded_total": 640}
+    assert agg["launches"] == 640
+    assert len(fr.events(limit=10_000)) == 64
+
+
+def test_event_filters_and_paging():
+    clock = FakeClock()
+    fr = FlightRecorder(node="n1", clock=clock)
+    for i in range(6):
+        clock.t += 1.0
+        fr.record_launch("plan_topk", "(8,128)", dispatch_ns=1000)
+        fr.record_readback("ops.aggs.terms_counts", 4096)
+    assert len(fr.events(kind="launch", limit=100)) == 6
+    assert len(fr.events(kernel="plan_topk", limit=100)) == 6
+    assert len(fr.events(site="ops.aggs.terms_counts", limit=100)) == 6
+    assert fr.events(site="nope") == []
+    late = fr.events(since_ns=int(4.5e9), limit=100)
+    assert late and all(e["t_ns"] > 4.5e9 for e in late)
+    # newest-first paging
+    page1 = fr.events(limit=3)
+    page2 = fr.events(limit=3, offset=3)
+    assert [e["seq"] for e in page1] > [e["seq"] for e in page2]
+
+
+def test_fill_histogram_and_percentiles():
+    fr = FlightRecorder(node="n1", clock=FakeClock())
+    for cohort in (1, 2, 8, 8, 8, 8):
+        fr.record_launch("k", "(8,)", cohort=cohort, capacity=8)
+    pct = fr.fill_percentiles()
+    assert pct["p50"] == 100.0        # 4 of 6 launches were full
+    assert pct["p99"] == 100.0
+    agg = fr.aggregates()
+    assert agg["fill_pct_overall"] == pytest.approx(
+        100.0 * (1 + 2 + 8 * 4) / (8 * 6), abs=0.1)
+    assert sum(agg["fill_histogram_pct"].values()) == 6
+
+
+# --------------------------------------------------------- unit: regime
+
+def test_regime_flips_with_cause_then_recovers():
+    clock = FakeClock()
+    fr = FlightRecorder(node="n1", clock=clock)
+    assert fr.regime == "fast"
+    for _ in range(6):
+        clock.t += 0.05
+        fr.record_launch("plan_topk", "(8,128)",
+                         dispatch_ns=60_000_000)
+    agg = fr.aggregates()
+    assert agg["regime"]["current"] == "degraded"
+    assert agg["regime"]["flips"] == 1
+    assert agg["regime"]["last_flip"]["cause"] == "launch plan_topk"
+    assert agg["regime"]["last_flip"]["to"] == "degraded"
+    # hysteresis: 18 ms sits between exit (10) and enter (25) — stays
+    # degraded instead of flapping
+    for _ in range(3):
+        clock.t += 0.05
+        fr.record_launch("plan_topk", "(8,128)",
+                         dispatch_ns=18_000_000)
+    assert fr.regime == "degraded"
+    for _ in range(40):
+        clock.t += 0.05
+        fr.record_launch("plan_topk", "(8,128)",
+                         dispatch_ns=1_000_000)
+    assert fr.regime == "fast"
+    secs = fr.regime_seconds()
+    assert secs["degraded"] > 0 and secs["fast"] > 0
+
+
+def test_regime_ignores_compile_length_outliers():
+    clock = FakeClock()
+    fr = FlightRecorder(node="n1", clock=clock)
+    for _ in range(5):
+        clock.t += 1.0
+        fr.record_launch("k", "(8,)", dispatch_ns=9_000_000_000)
+    assert fr.regime == "fast", "compile-length launches must not flip"
+
+
+def test_regime_seconds_feed_metrics_as_monotonic_counters():
+    from elasticsearch_tpu.telemetry.metrics import MetricsRegistry
+    clock = FakeClock()
+    reg = MetricsRegistry(clock=clock)
+    fr = FlightRecorder(node="n1", clock=clock, metrics=reg)
+    for _ in range(6):
+        clock.t += 0.05
+        fr.record_launch("k", "(8,)", dispatch_ns=60_000_000)
+    assert reg.get_value("flight.regime") == 1.0
+    assert reg.get_value("flight.regime_flips") == 1
+    assert reg.get_value("flight.regime_seconds.degraded") > 0
+    assert reg.get_value("flight.launches") == 6
+
+
+# ------------------------------------------------- unit: funnel + trace
+
+def test_funnel_records_provenance_and_returns_host_arrays():
+    fr = FlightRecorder(node="n1", clock=FakeClock())
+    from elasticsearch_tpu.ops import device as device_ops
+    with flightrec.activate(fr):
+        one = device_ops.readback("test.site.one",
+                                  np.arange(8, dtype=np.float32))
+        a, b = device_ops.readback("test.site.two",
+                                   np.zeros(4), np.ones(2))
+    assert isinstance(one, np.ndarray) and one.shape == (8,)
+    assert a.shape == (4,) and b.shape == (2,)
+    agg = fr.aggregates()
+    assert agg["readbacks"] == 2
+    assert agg["readback_by_site"]["test.site.one"]["count"] == 1
+    assert agg["readback_by_site"]["test.site.one"]["bytes"] == 32
+    assert agg["readback_by_site"]["test.site.two"]["bytes"] == \
+        4 * 8 + 2 * 8
+
+
+def test_events_carry_ambient_trace_and_span():
+    from elasticsearch_tpu.telemetry.tracing import Tracer
+    tracer = Tracer(node="n1", clock=FakeClock())
+    fr = FlightRecorder(node="n1", clock=FakeClock())
+    span = tracer.start_span("search")
+    with telectx.activate_span(span):
+        fr.record_launch("k", "(8,)", dispatch_ns=1000)
+        fr.record_readback("s", 16)
+    span.finish()
+    evs = fr.events(limit=10)
+    assert all(e["trace_id"] == span.trace_id for e in evs)
+    assert all(e["span_id"] == span.span_id for e in evs)
+    summary = fr.summary_for_trace(span.trace_id)
+    assert summary["launches"] == 1 and summary["readbacks"] == 1
+
+
+def test_context_bind_carries_recorder_and_opaque_across_tasks():
+    """telemetry/context.capture()/bind() must move the ambient
+    recorder AND the X-Opaque-Id across scheduler task boundaries —
+    the cross-thread half of every cluster test below."""
+    fr = FlightRecorder(node="n1", clock=FakeClock())
+    with flightrec.activate(fr), telectx.activate_opaque("req-42"):
+        bound = telectx.bind(
+            lambda: (flightrec.current(), telectx.current_opaque_id()))
+    assert flightrec.current() is None
+    assert telectx.current_opaque_id() is None
+    got_fr, got_opaque = bound()     # runs "on the other task"
+    assert got_fr is fr
+    assert got_opaque == "req-42"
+    assert flightrec.current() is None
+
+
+def test_task_captures_opaque_id_into_headers():
+    from elasticsearch_tpu.transport.tasks import Task
+    with telectx.activate_opaque("admin-7"):
+        t = Task(1, "transport", "indices:data/read/search")
+    d = t.to_dict("n1")
+    assert d["headers"] == {"X-Opaque-Id": "admin-7"}
+    assert "headers" not in Task(2, "transport", "x").to_dict("n1")
+
+
+# ------------------------------------------------ unit: waterfall stitch
+
+def test_build_waterfall_attaches_events_and_merges_nodes():
+    spans = [
+        {"span_id": "c/1", "parent_id": None, "name": "search",
+         "start_ms": 0.0, "duration_ms": 10.0},
+        {"span_id": "d/2", "parent_id": "c/1", "name": "shard[i][0]",
+         "start_ms": 1.0, "duration_ms": 6.0},
+    ]
+    events = [{"kind": "launch", "seq": 1, "node": "dn-1", "t_ns": 2,
+               "kernel": "k", "span_id": "d/2", "trace_id": "t1"},
+              {"kind": "readback", "seq": 2, "node": "dn-1", "t_ns": 3,
+               "site": "s", "span_id": "gone", "trace_id": "t1"}]
+    w = build_waterfall("t1", [
+        {"node": "coord", "spans": [spans[0]], "events": []},
+        {"node": "dn-1", "spans": [spans[1]], "events": events},
+    ])
+    assert w["nodes"] == ["coord", "dn-1"]
+    assert w["span_count"] == 2 and w["event_count"] == 2
+    root = w["waterfall"][0]
+    assert root["name"] == "search" and root["events"] == []
+    child = root["children"][0]
+    assert child["name"] == "shard[i][0]"
+    assert [e["kind"] for e in child["events"]] == ["launch"]
+    # the event whose span aged out stays visible, not silently dropped
+    assert [e["seq"] for e in w["unattached_events"]] == [2]
+    # self time: parent paid 10 - 6 = 4ms on top of its child
+    assert root["self_ns"] == 4_000_000
+    assert build_waterfall("t2", [{"node": "x", "spans": [],
+                                   "events": []}]) is None
+
+
+# ------------------------------------------------------- REST, one node
+
+@pytest.fixture(scope="module")
+def rest_node(tmp_path_factory):
+    from elasticsearch_tpu.node import Node
+    node = Node(data_path=str(tmp_path_factory.mktemp("flight_node")))
+    c = node.rest_controller
+    c.dispatch("PUT", "/idx", {}, {
+        "settings": {
+            "index.search.slowlog.threshold.query.warn": "0ms"},
+        "mappings": {"properties": {"cat": {"type": "keyword"}}}})
+    for i in range(30):
+        c.dispatch("PUT", f"/idx/_doc/{i}", {},
+                   {"title": f"fox doc {i}", "cat": f"c{i % 3}",
+                    "rank": i})
+    c.dispatch("POST", "/idx/_refresh", {}, None)
+    yield node
+    node.close()
+
+
+SEARCH_BODY = {"query": {"match": {"title": "fox"}}, "size": 5,
+               "aggs": {"cats": {"terms": {"field": "cat"}}}}
+
+
+def _search(node, body, headers=None):
+    status, r = node.rest_controller.dispatch(
+        "POST", "/idx/_search", {}, body, headers=headers)
+    assert status == 200, r
+    return r
+
+
+def test_flight_recorder_endpoint_records_serving_path(rest_node):
+    """ACCEPTANCE: the product serving path (REST search with a terms
+    agg) leaves launch events AND site-attributed readbacks in the
+    ring; `GET /_flight_recorder` filters by kind/site."""
+    r = _search(rest_node, SEARCH_BODY)
+    assert r["aggregations"]["cats"]["buckets"]
+    d = rest_node.rest_controller.dispatch
+    st, out = d("GET", "/_flight_recorder", {}, None)
+    assert st == 200
+    kinds = {e["kind"] for e in out["events"]}
+    assert kinds >= {"launch", "readback"}
+    agg = out["aggregates"]
+    assert agg["launches"] > 0 and agg["readbacks"] > 0
+    # every readback names its funnel call site (dotted provenance
+    # label); which site serves depends on corpus-scale lane choice
+    sites = agg["readback_by_site"]
+    assert sites and all("." in s for s in sites)
+    assert sum(v["bytes"] for v in sites.values()) > 0
+    # filters narrow server-side
+    site = next(iter(sites))
+    st, only_rb = d("GET", "/_flight_recorder",
+                    {"kind": "readback", "site": site}, None)
+    assert only_rb["events"]
+    assert all(e["site"] == site for e in only_rb["events"])
+
+
+def test_nodes_stats_shows_nonzero_readback_by_site(rest_node):
+    """ACCEPTANCE: `_nodes/stats` readback-by-site is nonzero for the
+    product serving path."""
+    _search(rest_node, SEARCH_BODY)
+    st, stats = rest_node.rest_controller.dispatch(
+        "GET", "/_nodes/stats", {}, None)
+    assert st == 200
+    fl = next(iter(stats["nodes"].values()))["telemetry"][
+        "flight_recorder"]
+    assert fl["readbacks"] > 0
+    assert sum(s["bytes"] for s in fl["readback_by_site"].values()) > 0
+    assert fl["regime"]["current"] in ("fast", "degraded")
+
+
+def test_opaque_id_header_reaches_slowlog_with_flight_fields(rest_node):
+    """X-Opaque-Id flows REST header → ambient context → slowlog; the
+    entry also carries the launch-path summary of ITS trace."""
+    r = _search(rest_node, SEARCH_BODY,
+                headers={"x-opaque-id": "tenant-blue"})
+    entry = rest_node.search_service.slowlog_recent[-1]
+    assert entry["x_opaque_id"] == "tenant-blue"
+    assert entry["trace.id"] == r["_headers"]["trace.id"]
+    assert entry["readbacks"] >= 1
+    assert entry["regime"] in ("fast", "degraded")
+    assert entry["cohort_fill_pct"] is None \
+        or 0.0 <= entry["cohort_fill_pct"] <= 100.0
+    # no header → no x_opaque_id key (field is opt-in, not null noise)
+    _search(rest_node, SEARCH_BODY)
+    assert "x_opaque_id" not in \
+        rest_node.search_service.slowlog_recent[-1]
+
+
+def test_single_node_waterfall_endpoint(rest_node):
+    r = _search(rest_node, SEARCH_BODY)
+    tid = r["_headers"]["trace.id"]
+    st, w = rest_node.rest_controller.dispatch(
+        "GET", f"/_flight_recorder/waterfall/{tid}", {}, None)
+    assert st == 200
+    assert w["trace_id"] == tid and w["span_count"] > 0
+    names = set()
+
+    def walk(n):
+        names.add(n["name"])
+        for c in n["children"]:
+            walk(c)
+    for root in w["waterfall"]:
+        walk(root)
+    assert "rest.search" in names
+    assert any(n.startswith("shard[idx]") for n in names)
+    st, _ = rest_node.rest_controller.dispatch(
+        "GET", "/_flight_recorder/waterfall/no-such-trace", {}, None)
+    assert st == 404
+
+
+# ------------------------------------------------------- 3-node cluster
+
+SORTED_BODY = {"query": {"match": {"body": "fox"}},
+               "sort": [{"n": "desc"}], "size": 5}
+
+
+def _latest_search_trace(coord):
+    return next(t["trace_id"]
+                for t in coord.telemetry.tracer.recent_traces()
+                if t["root"] == "search")
+
+
+@pytest.mark.chaos(seed=171)
+def test_cross_node_waterfall_covers_all_three_nodes(
+        tmp_path, chaos_seed):
+    """ACCEPTANCE: the stitched waterfall of a 2-shard/1-replica search
+    on a 3-node cluster spans coordinator + both data nodes, with
+    launch/readback events attached to the shard spans that issued
+    them."""
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    _setup(cluster)
+    coord = cluster.master()
+    cluster.call(coord.search, "logs", SORTED_BODY)
+    tid = _latest_search_trace(coord)
+    w = cluster.call(coord.flight_waterfall, tid)
+    assert w is not None and w["trace_id"] == tid
+    # every node that held a span or event of this trace is named;
+    # 2 shards × (primary, replica) over 3 nodes always touches ≥ 2
+    assert len(w["nodes"]) >= 2, f"seed={chaos_seed}: {w['nodes']}"
+    shard_events = []
+
+    def walk(n):
+        # device events land on the data-node handler span
+        # (shard_query), a child of the coordinator's shard[...]
+        # attempt span — both are "shard spans" of this trace
+        if n["name"].startswith("shard"):
+            shard_events.extend(n["events"])
+        for c in n["children"]:
+            walk(c)
+    for root in w["waterfall"]:
+        walk(root)
+    assert shard_events, f"seed={chaos_seed}: no events on shard spans"
+    assert {e["kind"] for e in shard_events} >= {"launch", "readback"}
+    # provenance: events name the data node that recorded them, and it
+    # differs across shards when shards landed on different nodes
+    ev_nodes = {e["node"] for e in shard_events}
+    assert ev_nodes <= {c.local_node.name
+                        for c in cluster.cluster_nodes.values()}
+    assert w["event_count"] >= len(shard_events)
+
+
+@pytest.mark.chaos(seed=171)
+def test_failover_attempts_are_children_of_the_same_trace(
+        tmp_path, chaos_seed):
+    """Seeded chaos: an injected shard failure retries on another copy
+    — BOTH attempts appear in the one waterfall as children of the same
+    trace, and the succeeding attempt carries the device events."""
+    from elasticsearch_tpu.cluster.search_action import (
+        QUERY_PHASE_ACTION,
+    )
+    from elasticsearch_tpu.testing.faults import ERROR, FaultRule
+    cluster = ChaosCluster(3, tmp_path, seed=chaos_seed)
+    _setup(cluster)
+    coord = cluster.coordinator_excluding("dn-0")
+    cluster.injector.add_rule(FaultRule(
+        action=QUERY_PHASE_ACTION, node="dn-0", mode=ERROR))
+    resp = cluster.call(coord.search, "logs", SORTED_BODY)
+    assert resp["_shards"]["failed"] == 0, f"seed={chaos_seed}"
+    tid = _latest_search_trace(coord)
+    w = cluster.call(coord.flight_waterfall, tid)
+    attempts, handlers = [], []
+
+    def walk(n):
+        if n["name"].startswith("shard[logs]"):
+            attempts.append(n)
+        elif n["name"] == "shard_query":
+            handlers.append(n)
+        for c in n["children"]:
+            walk(c)
+    for root in w["waterfall"]:
+        walk(root)
+    # BOTH attempts of the failed-over shard are children of the ONE
+    # trace's waterfall: the failed copy on dn-0 and its retry
+    failed = [a for a in attempts if a["tags"]["outcome"] == "failed"]
+    ok = [a for a in attempts if a["tags"]["outcome"] == "ok"]
+    assert failed and ok, f"seed={chaos_seed}: {attempts}"
+    assert failed[0]["tags"]["node"] == "dn-0"
+    retried = [a for a in ok
+               if a["name"] == failed[0]["name"]]
+    assert retried and retried[0]["tags"]["node"] != "dn-0", \
+        f"seed={chaos_seed}"
+    # device events live on the data-node shard_query handler spans of
+    # the same waterfall — and NONE on the faulted node, whose handler
+    # never ran
+    ev = [e for h in handlers for e in h["events"]]
+    assert ev, f"seed={chaos_seed}: no device events on shard_query"
+    assert "dn0" not in {e["node"] for e in ev}, f"seed={chaos_seed}"
+
+
+@pytest.mark.chaos(seed=171)
+def test_same_seed_byte_identical_waterfall(tmp_path, chaos_seed):
+    """ACCEPTANCE: two fresh runs of the same chaos seed produce
+    byte-identical waterfalls — every t_ns, dispatch_ns, span time and
+    stitch order reads the deterministic scheduler clock."""
+    from elasticsearch_tpu.cluster.search_action import (
+        QUERY_PHASE_ACTION,
+    )
+    from elasticsearch_tpu.testing.faults import ERROR, FaultRule
+
+    def one_run(tag):
+        cluster = ChaosCluster(3, tmp_path / tag, seed=chaos_seed)
+        _setup(cluster)
+        coord = cluster.coordinator_excluding("dn-0")
+        cluster.injector.add_rule(FaultRule(
+            action=QUERY_PHASE_ACTION, node="dn-0", mode=ERROR))
+        cluster.call(coord.search, "logs", SORTED_BODY)
+        tid = _latest_search_trace(coord)
+        return cluster.call(coord.flight_waterfall, tid)
+
+    one_run("warm")      # warm the process-global jit caches
+    w_a = one_run("a")
+    w_b = one_run("b")
+    assert json.dumps(w_a, sort_keys=True) == \
+        json.dumps(w_b, sort_keys=True), \
+        f"seed={chaos_seed}: waterfalls diverged on replay"
+    assert w_a["event_count"] > 0
+
+
+# --------------------------------------------------------------- health
+
+def test_health_indicator_flags_stuck_degraded_regime():
+    from elasticsearch_tpu.health.indicators import (
+        FlightRegimeIndicator,
+    )
+    from elasticsearch_tpu.health.indicator import HealthContext
+    from elasticsearch_tpu.telemetry.history import MetricsHistory
+    from elasticsearch_tpu.telemetry.metrics import MetricsRegistry
+    clock = FakeClock()
+    reg = MetricsRegistry(clock=clock)
+    fr = FlightRecorder(node="n1", clock=clock, metrics=reg)
+    hist = MetricsHistory(reg, clock, interval=1.0)
+    hist.advance()
+    for _ in range(20):
+        clock.t += 3.0
+        fr.record_launch("plan_topk", "(8,)", dispatch_ns=60_000_000)
+        hist.advance()
+    ctx = HealthContext(flight=fr, history=hist,
+                        metrics=reg, now=clock)
+    res = FlightRegimeIndicator().compute(ctx)
+    assert res.status == "red", res
+    diag = next(d for d in res.diagnoses
+                if d.id == "device_regime:degraded")
+    assert "plan_topk" in diag.cause
+    assert "_flight_recorder" in diag.action
+
+
+def test_health_indicator_flags_underfilled_batcher():
+    from elasticsearch_tpu.health.indicators import (
+        FlightRegimeIndicator,
+    )
+    from elasticsearch_tpu.health.indicator import HealthContext
+    from elasticsearch_tpu.telemetry.history import MetricsHistory
+    from elasticsearch_tpu.telemetry.metrics import MetricsRegistry
+    clock = FakeClock()
+    reg = MetricsRegistry(clock=clock)
+    fr = FlightRecorder(node="n1", clock=clock, metrics=reg)
+    hist = MetricsHistory(reg, clock, interval=1.0)
+    hist.advance()
+    for _ in range(40):
+        clock.t += 1.0
+        fr.record_launch("k", "(8,)", dispatch_ns=1_000_000,
+                         cohort=1, capacity=8)   # 12.5% fill
+        hist.advance()
+    ctx = HealthContext(flight=fr, history=hist,
+                        metrics=reg, now=clock)
+    res = FlightRegimeIndicator().compute(ctx)
+    assert res.status == "yellow", res
+    assert any(d.id == "device_regime:underfilled_batcher"
+               for d in res.diagnoses)

@@ -353,10 +353,12 @@ def test_coordinator_slowlog_fires_from_index_settings(
     entry = recent[-1]
     # the shared shape, plus the optional observability cross-links
     # (PR-8: trace.id ties slowlog -> _traces; slowest_stage appears
-    # when the request was profiled)
+    # when the request was profiled; the flight-recorder summary and
+    # client X-Opaque-Id ride along when present)
     assert {"index", "took_ms", "level", "source"} <= set(entry)
     assert set(entry) <= {"index", "took_ms", "level", "source",
-                          "trace.id", "slowest_stage"}
+                          "trace.id", "slowest_stage", "x_opaque_id",
+                          "cohort_fill_pct", "readbacks", "regime"}
     assert entry["trace.id"].startswith(coord.local_node.name)
     assert entry["index"] == "logs" and entry["level"] == "warn"
     assert "fox" in entry["source"]
