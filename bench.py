@@ -79,7 +79,8 @@ def remaining_budget() -> float:
 def emit(metric_text: str, value: float, vs_baseline: float,
          engine=None, overload=None, tasks=None, cpu=None,
          serving=None, skipped=None, aggs=None, multichip=None,
-         lint=None, recovery=None, health=None, upgrade=None):
+         lint=None, recovery=None, health=None, upgrade=None,
+         cursors=None, tenants=None):
     _LAST_PAYLOAD.clear()
     _LAST_PAYLOAD.update({
         "metric": metric_text,
@@ -163,6 +164,20 @@ def emit(metric_text: str, value: float, vs_baseline: float,
         # bounce, and the zero-acked-loss verdict — a regression in
         # graceful restart shows here before it costs a real upgrade
         _LAST_PAYLOAD["upgrade"] = upgrade
+    if cursors:
+        # cursor-plane rider (search/cursors.py in the deterministic
+        # sim): scroll pages drained through a mid-stream node kill,
+        # PIT lease transfers across a primary move, async backlog —
+        # the exactly-once verdicts ride next to the qps they protect
+        _LAST_PAYLOAD["cursors"] = cursors
+    if tenants:
+        # tenant-accounting rider (telemetry/tenants.py, deterministic
+        # sim): per-tenant qps/p50/p99 + SLO burn for a mixed
+        # interactive-vs-hog workload, the seeded rejection burst, and
+        # the noisy_neighbor verdict that must name the hog — a
+        # regression in attribution (hog unnamed, or the quiet tenant
+        # charged) shows here round over round
+        _LAST_PAYLOAD["tenants"] = tenants
     print(json.dumps(_LAST_PAYLOAD), flush=True)
 
 
@@ -2017,6 +2032,153 @@ def run_cursors_cpu(seed=13):
         return out
 
 
+def run_tenants_cpu(seed=19):
+    """Tenant-accounting rider (CPU-side, deterministic sim — no jax):
+    boots a 3-node sim cluster and runs a mixed two-tenant workload —
+    an `interactive` searcher with a tight latency objective against a
+    `hog` that bulks, drains scrolls, and finally slams into a shrunk
+    indexing-pressure limit (a seeded rejection burst). Banks per-
+    tenant qps/p50/p99 + SLO-violation counts from the merged
+    `_tenants/stats` fan-out and the `noisy_neighbor` verdict (which
+    must name the hog) into the BENCH json `tenants` section BEFORE
+    any backend touch. Replay-stable: seeded queue + virtual clock
+    render the same rows every round."""
+    import tempfile
+
+    from elasticsearch_tpu.cluster.node import ClusterNode
+    from elasticsearch_tpu.testing.deterministic import (
+        DeterministicTaskQueue, DisruptableTransport, SimNetwork)
+    from elasticsearch_tpu.transport.transport import DiscoveryNode
+
+    t_host = time.time()
+    with tempfile.TemporaryDirectory() as tmp:
+        queue = DeterministicTaskQueue(seed=seed)
+        network = SimNetwork(queue)
+        nodes = [DiscoveryNode(node_id=f"tt-{i}", name=f"tt{i}")
+                 for i in range(3)]
+        cluster = {}
+        for node in nodes:
+            cn = ClusterNode(
+                DisruptableTransport(node, network), queue,
+                data_path=os.path.join(tmp, node.name),
+                seed_nodes=nodes,
+                initial_master_nodes=[n.name for n in nodes],
+                rng=queue.random)
+            cluster[node.node_id] = cn
+            cn.start()
+        # per-tenant latency objectives (virtual ms): interactive is
+        # held to a tight SLO, the hog gets a loose one
+        for cn in cluster.values():
+            cn.telemetry.tenants.slo_objectives = {
+                "interactive": 25.0, "hog": 400.0}
+
+        def call(fn, *args, **kwargs):
+            box = {}
+            fn(*args, **kwargs,
+               on_done=lambda r, e=None: box.update(r=r, e=e))
+            for _ in range(120):
+                if box:
+                    break
+                queue.run_for(1.0)
+            if box.get("e") is not None:
+                raise RuntimeError(box["e"])
+            return box.get("r")
+
+        queue.run_for(60)
+        master = next(cn for cn in cluster.values() if cn.is_master())
+        # index-default tagging: bulks carry no body, so each index
+        # names its tenant (precedence: header > body > index default)
+        call(master.create_index, "inter", number_of_shards=2,
+             number_of_replicas=1,
+             settings={"index.tenant.default": "interactive"})
+        call(master.create_index, "hoggy", number_of_shards=2,
+             number_of_replicas=1,
+             settings={"index.tenant.default": "hog"})
+        queue.run_for(30)
+        call(master.bulk, "inter",
+             [{"op": "index", "id": f"i-{i}",
+               "source": {"body": f"interactive doc {i}", "n": i}}
+              for i in range(30)])
+        # baseline report: lays the history-ring sample the final
+        # report's windowed deltas anchor against (the ring samples on
+        # report boundaries, not on a background task)
+        call(master.health_report)
+        t0_virtual = queue.now()
+
+        # mixed workload: every round the interactive tenant runs a
+        # tagged search; the hog bulks a batch and periodically drains
+        # a scroll over its whole index
+        for rnd in range(12):
+            call(master.search, "inter",
+                 {"tenant": "interactive",
+                  "query": {"match": {"body": "interactive"}},
+                  "size": 5})
+            call(master.bulk, "hoggy",
+                 [{"op": "index", "id": f"h-{rnd}-{i}",
+                   "source": {"body": f"hog doc {rnd} {i}", "n": i}}
+                  for i in range(20)])
+            if rnd % 3 == 2:
+                page = call(master.search, "hoggy",
+                            {"tenant": "hog",
+                             "query": {"match_all": {}}, "size": 25},
+                            scroll=60.0)
+                while page["hits"]["hits"]:
+                    page = call(master.scroll, page["_scroll_id"], 60.0)
+        workload_virtual_s = max(queue.now() - t0_virtual, 1e-9)
+
+        # seeded rejection burst: shrink the coordinating node's
+        # indexing-pressure budget so the hog's bulks shed with 429s —
+        # the shed_load dimension the noisy_neighbor indicator reads
+        saved_limit = master.indexing_pressure.limit
+        master.indexing_pressure.limit = 64
+        rejected = 0
+        for i in range(8):
+            try:
+                call(master.bulk, "hoggy",
+                     [{"op": "index", "id": f"burst-{i}",
+                       "source": {"body": "x" * 256}}])
+            except RuntimeError:
+                rejected += 1
+        master.indexing_pressure.limit = saved_limit
+        queue.run_for(11)   # let the history ring sample the burst
+
+        report = call(master.health_report)
+        noisy = report["indicators"]["noisy_neighbor"]
+        merged = call(master.tenants_stats)
+
+        def row(tenant):
+            t = merged["tenants"].get(tenant, {})
+            search = t.get("search", {})
+            lat = search.get("latency", {})
+            slo = t.get("slo", {})
+            return {
+                "searches": search.get("count", 0),
+                "qps_virtual": round(
+                    search.get("count", 0) / workload_virtual_s, 2),
+                "p50_ms": lat.get("p50_ms", 0.0),
+                "p99_ms": lat.get("p99_ms", 0.0),
+                "indexing_bytes": t.get("indexing", {}).get("bytes", 0),
+                "rejections": t.get("indexing", {}).get("rejections", 0),
+                "slo_violations": slo.get("violations", 0),
+                "slo_burn_pct": slo.get("budget_burn_pct", 0.0),
+            }
+
+        out = {
+            "tenants_live": merged["cardinality"]["live"],
+            "interactive": row("interactive"),
+            "hog": row("hog"),
+            "rejected_bursts": rejected,
+            "noisy_status": noisy["status"],
+            "noisy_named": sorted({
+                r for d in noisy.get("diagnosis", [])
+                for r in d.get("affected_resources", [])}),
+            "host_s": round(time.time() - t_host, 1),
+        }
+        for cn in cluster.values():
+            cn.stop()
+        return out
+
+
 # ---------------------------------------------------------------------------
 # Multi-chip serving rows (ISSUE 9): qps at 1/2/4/8 devices for the two
 # mesh serving modes — sharded-corpus (one SPMD fan-out/merge program per
@@ -2412,7 +2574,9 @@ def main():
              lint=parts.get("lint"),
              recovery=parts.get("recovery"),
              health=parts.get("health"),
-             upgrade=parts.get("upgrade"))
+             upgrade=parts.get("upgrade"),
+             cursors=parts.get("cursors"),
+             tenants=parts.get("tenants"))
 
     # estpu-lint preflight: static contract scan of the whole package
     # (stdlib ast, ~2s, no device). Summary rides every BENCH line so
@@ -2498,6 +2662,13 @@ def main():
         parts["cursors"] = run_cursors_cpu()
     except Exception as e:  # noqa: BLE001 — the rider must not sink
         log(f"cursors rider failed: {e!r}")
+    # tenant rows (deterministic sim, no jax): mixed two-tenant
+    # workload — per-tenant qps/p50/p99, SLO burn, and the
+    # noisy_neighbor verdict naming the hog
+    try:
+        parts["tenants"] = run_tenants_cpu()
+    except Exception as e:  # noqa: BLE001 — the rider must not sink
+        log(f"tenants rider failed: {e!r}")
     # ALL CPU-side rows land before ANY jax/backend touch: a dead
     # relay hangs even backend INIT uninterruptibly (observed: hours),
     # and a run killed there must still have parsed output on record

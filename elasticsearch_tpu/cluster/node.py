@@ -93,6 +93,9 @@ CLUSTER_REROUTE_ACTION = "cluster:admin/reroute"
 CLUSTER_SETTINGS_ACTION = "cluster:admin/settings/update"
 RECOVERY_STATS_ACTION = "indices:monitor/recovery[n]"
 HEALTH_REPORT_ACTION = "cluster:monitor/health_report[n]"
+# per-node tenant-accounting slice behind `GET /_tenants/stats` /
+# `GET /_cat/tenants` (telemetry/tenants.py)
+TENANTS_STATS_ACTION = "cluster:monitor/tenants/stats[n]"
 # launch-path flight recorder: per-node (spans, launch/readback events)
 # slice of one trace, stitched by the coordinator into a cross-node
 # request waterfall (GET /_flight_recorder/waterfall/{trace_id})
@@ -194,6 +197,14 @@ class ClusterNode:
             history_retention=float(
                 self.settings.get("telemetry.history.retention", 600.0)))
         wire_transport(transport, self.telemetry)
+        # tenant accounting caps + SLO objectives come from node
+        # settings (`tenants.max`, `tenants.slo.*`) — rebuild the
+        # default table with them (telemetry/tenants.py)
+        from elasticsearch_tpu.telemetry.tenants import TenantAccounting
+        self.telemetry.tenants = TenantAccounting.from_settings(
+            self.settings.get, self.telemetry.metrics,
+            history=self.telemetry.history)
+        self.telemetry.flight.tenants = self.telemetry.tenants
         # memory protection: hierarchical circuit breakers charged on
         # the live path (transport inbound → in_flight_requests, device
         # cache → hbm, search host staging → request) + in-flight
@@ -202,8 +213,10 @@ class ClusterNode:
         self.breaker_service = build_breaker_service(
             self.settings.get, metrics=self.telemetry.metrics)
         wire_breaker_service(transport, self.breaker_service)
+        self.breaker_service.tenants = self.telemetry.tenants
         self.indexing_pressure = IndexingPressure.from_settings(
             self.settings.get, metrics=self.telemetry.metrics)
+        self.indexing_pressure.tenants = self.telemetry.tenants
         # cluster task management: every coordinator/handler action
         # registers here; running time reads the scheduler clock so
         # seeded runs replay identical task trees
@@ -305,6 +318,7 @@ class ClusterNode:
             (CLUSTER_SETTINGS_ACTION, self._on_cluster_settings),
             (RECOVERY_STATS_ACTION, self._on_recovery_stats),
             (HEALTH_REPORT_ACTION, self._on_health_report),
+            (TENANTS_STATS_ACTION, self._on_tenants_stats),
             (FLIGHT_TRACE_ACTION, self._on_flight_trace),
             (NODE_SHUTDOWN_PUT_ACTION, self._on_put_shutdown),
             (NODE_SHUTDOWN_GET_ACTION, self._on_get_shutdown),
@@ -1005,7 +1019,8 @@ class ClusterNode:
                        if self.is_master() else None),
             engine_totals=_engine.TRACKER.totals(),
             watchdog=self.health_watchdog,
-            flight=self.telemetry.flight)
+            flight=self.telemetry.flight,
+            tenants=self.telemetry.tenants)
 
     def _on_health_report(self, req, channel, src) -> None:
         from elasticsearch_tpu.health import UnknownIndicatorError
@@ -1055,6 +1070,48 @@ class ClusterNode:
 
             self.transport.send_request(
                 node, HEALTH_REPORT_ACTION, {"indicator": indicator},
+                ResponseHandler(ok, fail), timeout=30.0)
+
+    # ------------------------------------------------- tenant accounting
+
+    def _on_tenants_stats(self, req, channel, src) -> None:
+        channel.send_response({
+            "node": self.local_node.node_id,
+            "tenants": self.telemetry.tenants.stats()})
+
+    def tenants_stats(self, on_done: Callable = lambda r, e: None) -> None:
+        """`GET /_tenants/stats`: fan TENANTS_STATS_ACTION out to every
+        cluster node (accounting tables are node-local) and merge
+        deterministically (telemetry/tenants.py merge_tenant_stats —
+        counters sum, quantiles recompute from summed buckets).
+        Unreachable nodes compose as `node_failures`."""
+        from elasticsearch_tpu.telemetry.tenants import merge_tenant_stats
+        nodes = list(self.state.nodes.nodes)
+        if not nodes:
+            local = self.telemetry.tenants.stats()
+            on_done(merge_tenant_stats(
+                {self.local_node.node_id: local}), None)
+            return
+        sections: Dict[str, Dict[str, Any]] = {}
+        failures: List[Dict[str, str]] = []
+        pending = {"n": len(nodes)}
+
+        def finish():
+            pending["n"] -= 1
+            if pending["n"] == 0:
+                on_done(merge_tenant_stats(sections, failures), None)
+
+        for node in nodes:
+            def ok(resp, _nid=node.node_id):
+                sections[_nid] = resp.get("tenants", {})
+                finish()
+
+            def fail(exc, _nid=node.node_id):
+                failures.append({"node": _nid, "error": str(exc)})
+                finish()
+
+            self.transport.send_request(
+                node, TENANTS_STATS_ACTION, {},
                 ResponseHandler(ok, fail), timeout=30.0)
 
     def cluster_health(self) -> Dict[str, Any]:
@@ -1169,6 +1226,17 @@ class ClusterNode:
         if imd is None:
             on_done(None, KeyError(f"no such index [{index}]"))
             return
+        from elasticsearch_tpu.telemetry import context as _telectx
+        if _telectx.current_tenant() is None:
+            # precedence: header (already ambient) > index default; a
+            # late resolution re-enters under the tenant so pressure
+            # charges, shard RPC headers, and the parent task carry it
+            default = imd.settings.get("index.tenant.default") \
+                if imd.settings else None
+            if default is not None:
+                with _telectx.activate_tenant(str(default)):
+                    self.bulk(index, items, on_done)
+                return
         if not items:
             # nothing to fan out: complete immediately (charging and
             # waiting on zero shard responses would leak the charge and

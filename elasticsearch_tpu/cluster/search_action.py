@@ -746,6 +746,23 @@ class DistributedSearchService:
         pages, PIT searches) pass pre-ranked shard groups + request/
         response hooks and the shared machinery runs unchanged."""
         body = body or {}
+        tenant = _telectx.current_tenant()
+        if tenant is None:
+            # precedence: header (already ambient) > body > the index's
+            # `index.tenant.default`; a late resolution re-enters under
+            # the tenant so the whole fan-out — shard RPC headers,
+            # bind()-carried callbacks, flight events — carries it
+            resolved = body.get("tenant")
+            if resolved is None:
+                imd = state.metadata.index(index_expression)
+                settings = getattr(imd, "settings", None)
+                if settings is not None:
+                    resolved = settings.get("index.tenant.default")
+            if resolved is not None:
+                with _telectx.activate_tenant(str(resolved)):
+                    self.search(state, index_expression, body, on_done,
+                                scroll=scroll, task=task, _plan=_plan)
+                return
         if _plan is None and body.get("pit"):
             self._search_pit(state, index_expression, body, on_done,
                              scroll=scroll, task=task)
@@ -797,8 +814,12 @@ class DistributedSearchService:
                         lambda: self.on_cancelled_parent_done(tid),
                         f"sweep task bans [{tid}]")
             if tele is not None:
-                tele.metrics.observe(
-                    "search.latency", (sched.now() - t_start) * 1000.0)
+                took_ms = (sched.now() - t_start) * 1000.0
+                tele.metrics.observe("search.latency", took_ms)
+                tele.tenants.record_search(
+                    tenant, took_ms, failed=err is not None,
+                    shards=(0 if resp is None else
+                            resp.get("_shards", {}).get("total", 0)))
                 if err is not None:
                     tele.metrics.inc("search.failed")
                     root_span.finish(outcome="error",
@@ -828,6 +849,7 @@ class DistributedSearchService:
                         trace_id=_trace_id,
                         slowest_stage=slowest_stage_summary(resp),
                         opaque_id=_telectx.current_opaque_id(),
+                        tenant=tenant,
                         flight=(_fl.summary_for_trace(_trace_id)
                                 if _fl is not None and _trace_id
                                 else None))

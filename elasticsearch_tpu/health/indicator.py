@@ -124,6 +124,7 @@ class HealthContext:
     mesh_stats: Optional[Dict[str, Any]] = None     # mesh executor
     watchdog: Any = None             # StalledProgressWatchdog
     flight: Any = None               # FlightRecorder (launch-path ring)
+    tenants: Any = None              # TenantAccounting (per-tenant table)
 
 
 class HealthIndicator:

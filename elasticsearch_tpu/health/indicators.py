@@ -52,6 +52,17 @@ REGIME_DEGRADED_RED_S = 45.0     # stuck: most of the window degraded
 FILL_RATIO_YELLOW = 0.25         # filled/slots over the window
 FILL_MIN_LAUNCHES = 32           # don't judge fill on a trickle
 
+# noisy neighbor (tenant accounting): one tenant holding a majority
+# share of a contended dimension over the window. Floors keep a
+# trickle from indicting anyone; the ≥2-active-tenants monopoly guard
+# keeps a single-tenant (or untagged-only) node green — sole use of an
+# idle resource is not noise
+NOISY_SHARE_YELLOW = 0.5
+NOISY_SHARE_RED = 0.8
+NOISY_SLOTS_FLOOR = 16           # cohort slots in window
+NOISY_LAUNCH_MS_FLOOR = 50.0     # device launch-ms in window
+NOISY_REJECTIONS_FLOOR = 5       # rejections + breaker trips in window
+
 
 def shard_availability_summary(
         cluster_state: Optional[Any]) -> Dict[str, Any]:
@@ -641,6 +652,120 @@ class FlightRegimeIndicator(HealthIndicator):
             details=details, impacts=impacts, diagnoses=diagnoses)
 
 
+class NoisyNeighborIndicator(HealthIndicator):
+    """Names the tenant monopolizing a contended resource.
+
+    Reads the per-tenant counters TenantAccounting feeds the registry
+    (windowed off the history ring, so a burst that recovered stays
+    green) across three dimensions: batcher cohort occupancy
+    (``tenant.cohort.slots``), device launch time (``tenant.launch.ms``),
+    and shed load (``tenant.rejections`` + ``tenant.breaker.trips``).
+    A dimension indicts only when (a) its in-window total clears a
+    floor, (b) at least two tenants show in-window workload on ANY
+    signal (the monopoly guard — a single-tenant or untagged node has
+    no neighbors to be noisy toward; note the guard is cross-dimension:
+    the classic hog is the ONLY tenant being rejected while the quiet
+    tenant merely searches), and (c) one tenant's share crosses the
+    yellow/red line. The diagnosis names the tenant — the observability
+    half of ROADMAP item 5; the enforcement half (weighted admission)
+    acts on the same attribution."""
+
+    name = "noisy_neighbor"
+
+    # (dimension label, [metric names summed per tenant], window floor)
+    _DIMENSIONS = (
+        ("cohort_slots", ("tenant.cohort.slots",), NOISY_SLOTS_FLOOR),
+        ("launch_ms", ("tenant.launch.ms",), NOISY_LAUNCH_MS_FLOOR),
+        ("shed_load", ("tenant.rejections", "tenant.breaker.trips"),
+         NOISY_REJECTIONS_FLOOR),
+    )
+
+    # workload signals that mark a tenant "present" for the monopoly
+    # guard, beyond the contended dimensions themselves
+    _ACTIVITY = ("tenant.search.requests", "tenant.indexing.bytes")
+
+    def compute(self, ctx: HealthContext) -> HealthIndicatorResult:
+        if ctx.tenants is None:
+            return HealthIndicatorResult(
+                name=self.name, status=HealthStatus.UNKNOWN,
+                symptom="no tenant accounting wired")
+        tenants = ctx.tenants.active_tenants()
+
+        def windowed(metric: str, t: str) -> float:
+            if ctx.history is None:
+                return 0.0
+            return ctx.history.delta(metric, HEALTH_RATE_WINDOW_S,
+                                     tenant=t)
+
+        active_in_window = sorted(
+            t for t in tenants
+            if any(windowed(m, t) > 0 for m in self._ACTIVITY)
+            or any(windowed(m, t) > 0
+                   for _d, ms, _f in self._DIMENSIONS for m in ms))
+        details: Dict[str, Any] = {
+            "window_s": HEALTH_RATE_WINDOW_S,
+            "active_tenants": tenants,
+            "active_in_window": active_in_window,
+            "dimensions": {},
+        }
+        findings: List[Dict[str, Any]] = []
+        for dim, metric_names, floor in self._DIMENSIONS:
+            per_tenant: Dict[str, float] = {}
+            for t in tenants:
+                v = sum(windowed(m, t) for m in metric_names)
+                if v > 0:
+                    per_tenant[t] = round(v, 3)
+            total = sum(per_tenant.values())
+            dim_details: Dict[str, Any] = {
+                "total_in_window": round(total, 3),
+                "by_tenant": dict(sorted(per_tenant.items())),
+            }
+            if total >= floor and len(active_in_window) >= 2:
+                top, top_v = max(per_tenant.items(),
+                                 key=lambda kv: (kv[1], kv[0]))
+                share = top_v / total
+                dim_details["dominant"] = top
+                dim_details["dominant_share"] = round(share, 3)
+                if share >= NOISY_SHARE_YELLOW:
+                    findings.append({
+                        "dimension": dim, "tenant": top,
+                        "share": share,
+                        "status": (HealthStatus.RED
+                                   if share >= NOISY_SHARE_RED
+                                   else HealthStatus.YELLOW)})
+            details["dimensions"][dim] = dim_details
+        if not findings:
+            return HealthIndicatorResult(
+                name=self.name, status=HealthStatus.GREEN,
+                symptom="no tenant dominates a contended resource",
+                details=details)
+        status = HealthStatus.worst(*(f["status"] for f in findings))
+        worst = max(findings, key=lambda f: (
+            HealthStatus._ORDER[f["status"]], f["share"], f["tenant"]))
+        symptom = (f"tenant [{worst['tenant']}] holds "
+                   f"{100.0 * worst['share']:.0f}% of "
+                   f"{worst['dimension']} over the last "
+                   f"{int(HEALTH_RATE_WINDOW_S)}s")
+        impacts = [Impact(
+            id="tenant_crowding", severity=2,
+            description="other tenants' searches queue behind (or are "
+                        "shed by) one tenant's workload; their p99 "
+                        "and error budgets pay for it",
+            impact_areas=["search", "ingest"])]
+        diagnoses = [Diagnosis(
+            id="noisy_neighbor:dominant_tenant",
+            cause=f"tenant [{f['tenant']}] holds "
+                  f"{100.0 * f['share']:.0f}% of {f['dimension']} "
+                  f"in the window",
+            action="inspect GET /_tenants/stats for the tenant's "
+                   "qps/latency/indexing mix; throttle or isolate it "
+                   "(item-5 QoS enforcement acts on this attribution)",
+            affected_resources=[f["tenant"]]) for f in findings]
+        return HealthIndicatorResult(
+            name=self.name, status=status, symptom=symptom,
+            details=details, impacts=impacts, diagnoses=diagnoses)
+
+
 # the registry ESTPU-HEALTH01 pins: every HealthIndicator subclass in
 # health/ must appear here, or the linter flags the class definition
 DEFAULT_INDICATORS = (
@@ -652,4 +777,5 @@ DEFAULT_INDICATORS = (
     DeviceEngineIndicator,
     NodeShutdownIndicator,
     FlightRegimeIndicator,
+    NoisyNeighborIndicator,
 )

@@ -73,6 +73,11 @@ class IndexingPressure:
         self._peak_all = 0
         # telemetry sink (MetricsRegistry or None): one branch per event
         self.metrics = metrics
+        # optional TenantAccounting sink: payload bytes charged to the
+        # ambient tenant at the COORDINATING stage only (primary/replica
+        # marks are the same payload fanning out — charging them too
+        # would double-count), rejections at every stage
+        self.tenants = None
 
     @property
     def replica_limit(self) -> int:
@@ -98,6 +103,10 @@ class IndexingPressure:
     def _mark(self, stage: str, n_bytes: int,
               label: str) -> Callable[[], None]:
         n_bytes = int(n_bytes)
+        tenant = None
+        if self.tenants is not None:
+            from elasticsearch_tpu.telemetry import context as _telectx
+            tenant = _telectx.current_tenant()
         with self._lock:
             # coordinating + primary share the base budget; replica ops
             # get the 1.5x headroom. All stages' bytes count toward the
@@ -109,6 +118,8 @@ class IndexingPressure:
                 if self.metrics is not None:
                     self.metrics.inc("indexing_pressure.rejections",
                                      stage=stage)
+                if self.tenants is not None:
+                    self.tenants.record_rejection(tenant, stage)
                 raise EsRejectedExecutionException(
                     f"rejecting operation [{label}] at {stage} stage: "
                     f"in-flight indexing bytes [{would}] would exceed "
@@ -119,6 +130,8 @@ class IndexingPressure:
             self._total[stage] += n_bytes
             self._peak_all = max(self._peak_all,
                                  sum(self._current.values()))
+        if self.tenants is not None and stage == COORDINATING:
+            self.tenants.record_indexing(tenant, n_bytes)
         released = {"done": False}
 
         def release() -> None:

@@ -11,9 +11,9 @@ from __future__ import annotations
 from typing import Dict
 
 from elasticsearch_tpu.lint.rules import (
-    det, errors, health, jit, pair, readback, shape)
+    ctx, det, errors, health, jit, pair, readback, shape)
 
-ALL_RULE_MODULES = (jit, pair, det, shape, errors, health, readback)
+ALL_RULE_MODULES = (jit, pair, det, shape, errors, health, readback, ctx)
 
 # the linter's own meta-rule (undocumented pragmas), reported by core
 META_RULES: Dict[str, str] = {

@@ -1,0 +1,121 @@
+"""ESTPU-CTX — ambient-context capture/bind drift.
+
+``telemetry/context.py`` snapshots every ambient slot (profiler,
+trace context, task, opaque id, tenant, flight recorder) in
+``capture()`` and re-installs the same slots in ``bind()``. The two
+ends are a wire protocol between threads: a field added to one side
+but not the other drops attribution silently — requests cross an
+executor hop and come out untagged, and no test fails unless it
+exercises that exact hop. PR 18 grew the tuple to ten fields (tenant);
+this rule pins the invariant so the eleventh field can't drift.
+
+Checked per telemetry/ module that defines BOTH top-level functions:
+
+* the tuple of names ``capture()`` returns must match, element for
+  element, the tuple ``bind()`` unpacks from it;
+* every unpacked field must be re-installed inside ``bind()`` (an
+  assignment whose right-hand side is the bare field name — the
+  ``_tls.x = x`` store that makes the slot ambient again).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from elasticsearch_tpu.lint.core import LintModule, Violation
+from elasticsearch_tpu.lint.registry import ProjectIndex
+
+RULES = {
+    "ESTPU-CTX01": ("capture()/bind() context tuples drifted — field "
+                    "captured but not rebound (or vice versa)"),
+}
+
+_SCOPE = "telemetry/"
+
+
+def _top_level_fn(tree: ast.Module, name: str) -> Optional[ast.FunctionDef]:
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    return None
+
+
+def _captured_fields(capture: ast.FunctionDef) -> Optional[List[str]]:
+    """Names in the (last) all-Name tuple ``capture`` returns; the
+    early ``return None`` short-circuit doesn't match."""
+    fields: Optional[List[str]] = None
+    for node in ast.walk(capture):
+        if not isinstance(node, ast.Return):
+            continue
+        if isinstance(node.value, ast.Tuple) and node.value.elts and \
+                all(isinstance(e, ast.Name) for e in node.value.elts):
+            fields = [e.id for e in node.value.elts]
+    return fields
+
+
+def _unpack_assign(bind: ast.FunctionDef) -> Optional[ast.Assign]:
+    """The ``a, b, ... = cap`` tuple-unpack inside ``bind`` (first
+    Assign whose target is an all-Name tuple and value a bare Name)."""
+    for node in ast.walk(bind):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Tuple) and \
+                isinstance(node.value, ast.Name) and \
+                all(isinstance(e, ast.Name)
+                    for e in node.targets[0].elts):
+            return node
+    return None
+
+
+def _reinstalled_fields(bind: ast.FunctionDef) -> Set[str]:
+    """Fields stored back into an ambient slot: any ``obj.attr = name``
+    assignment anywhere under ``bind`` (including the nested closure
+    that runs on the far side of the hop)."""
+    out: Set[str] = set()
+    for node in ast.walk(bind):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Name) and \
+                any(isinstance(t, ast.Attribute) for t in node.targets):
+            out.add(node.value.id)
+    return out
+
+
+def run(modules: List[LintModule],
+        index: ProjectIndex) -> Tuple[List[Violation], int]:
+    vs: List[Violation] = []
+    for mod in modules:
+        if not mod.rel.startswith(_SCOPE):
+            continue
+        capture = _top_level_fn(mod.tree, "capture")
+        bind = _top_level_fn(mod.tree, "bind")
+        if capture is None or bind is None:
+            continue
+        captured = _captured_fields(capture)
+        if captured is None:
+            continue
+        unpack = _unpack_assign(bind)
+        if unpack is None:
+            vs.append(Violation(
+                "ESTPU-CTX01", mod.rel, bind.lineno, bind.col_offset,
+                f"capture() returns {len(captured)} fields "
+                f"({', '.join(captured)}) but bind() never tuple-"
+                f"unpacks them"))
+            continue
+        unpacked = [e.id for e in unpack.targets[0].elts]
+        if unpacked != captured:
+            vs.append(Violation(
+                "ESTPU-CTX01", mod.rel, unpack.lineno,
+                unpack.col_offset,
+                f"context tuple drift: capture() returns "
+                f"({', '.join(captured)}) but bind() unpacks "
+                f"({', '.join(unpacked)})"))
+            continue
+        reinstalled = _reinstalled_fields(bind)
+        missing = [f for f in unpacked if f not in reinstalled]
+        if missing:
+            vs.append(Violation(
+                "ESTPU-CTX01", mod.rel, unpack.lineno,
+                unpack.col_offset,
+                f"context fields unpacked but never re-installed in "
+                f"bind(): {', '.join(missing)}"))
+    return vs, 0

@@ -84,6 +84,16 @@ class Node:
         # metrics registry (`breaker.*` / `indexing_pressure.*`)
         self.breaker_service.metrics = self.telemetry.metrics
         self.indexing_pressure.metrics = self.telemetry.metrics
+        # tenant accounting (telemetry/tenants.py): cap + SLO
+        # objectives from settings; breaker trips and indexing bytes /
+        # rejections are charged to the ambient tenant through it
+        from elasticsearch_tpu.telemetry.tenants import TenantAccounting
+        self.telemetry.tenants = TenantAccounting.from_settings(
+            settings.get, self.telemetry.metrics,
+            history=self.telemetry.history)
+        self.telemetry.flight.tenants = self.telemetry.tenants
+        self.breaker_service.tenants = self.telemetry.tenants
+        self.indexing_pressure.tenants = self.telemetry.tenants
         self.indices_service = IndicesService(self.data_path, settings)
         # the shared device cache charges the `hbm` child breaker on
         # segment/filter-mask admission (LRU eviction pressure first),
@@ -97,6 +107,10 @@ class Node:
             self.breaker_service)
         self.search_service = SearchService(self.indices_service)
         self.search_service.telemetry = self.telemetry
+        # batcher cohort-slot attribution: each enqueued entry charges
+        # one slot to its tenant (search/batching.py)
+        self.search_service.plan_batcher.tenants = self.telemetry.tenants
+        self.search_service.knn_batcher.tenants = self.telemetry.tenants
         # mesh serving backend: dispatch/fallback counters mirror into
         # the node registry (search.mesh.dispatch{axis} /
         # search.mesh.fallback{reason}) next to its own stats surface
@@ -137,7 +151,8 @@ class Node:
                 engine_totals=_engine.TRACKER.totals(),
                 mesh_stats=_self.search_service.mesh_executor.stats(),
                 watchdog=_self.health_watchdog,
-                flight=_self.telemetry.flight)
+                flight=_self.telemetry.flight,
+                tenants=_self.telemetry.tenants)
 
         self.health = HealthService(context_fn=_health_context)
         # completed background-task responses (ref: the .tasks results

@@ -111,6 +111,12 @@ class RestController:
         # the ring tagged with the request's trace
         opaque = next((str(v) for k, v in (headers or {}).items()
                        if k.lower() == "x-opaque-id"), None)
+        # tenant attribution: X-Tenant-Id header is the strongest tag
+        # (precedence: header > request body > index.tenant.default);
+        # becomes ambient so every phase under this request charges the
+        # right tenant's accounting row
+        tenant = next((str(v) for k, v in (headers or {}).items()
+                       if k.lower() == "x-tenant-id"), None)
         flight = getattr(getattr(self.node, "telemetry", None),
                          "flight", None)
         matched_path = False
@@ -127,6 +133,9 @@ class RestController:
                     if opaque:
                         stack.enter_context(
                             _telectx.activate_opaque(opaque))
+                    if tenant:
+                        stack.enter_context(
+                            _telectx.activate_tenant(tenant))
                     if flight is not None:
                         stack.enter_context(_flightrec.activate(flight))
                     return handler(self.node, params, body, **kwargs)
@@ -175,6 +184,7 @@ def _register_all(c: RestController):
     c.register("GET", "/_cluster/health", cluster_health)
     c.register("GET", "/_health_report", health_report)
     c.register("GET", "/_health_report/{indicator}", health_report)
+    c.register("GET", "/_tenants/stats", tenants_stats)
     c.register("GET", "/_cluster/pending_tasks", cluster_pending_tasks)
     c.register("GET", "/_cluster/stats", cluster_stats)
     c.register("GET", "/_nodes/stats", nodes_stats)
@@ -188,6 +198,7 @@ def _register_all(c: RestController):
     c.register("GET", "/_kernels", get_kernels)
     c.register("GET", "/_cat/indices", cat_indices)
     c.register("GET", "/_cat/health", cat_health)
+    c.register("GET", "/_cat/tenants", cat_tenants)
     c.register("GET", "/_cat/count", cat_count)
     c.register("GET", "/_cat/shards", cat_shards)
     c.register("GET", "/_stats", indices_stats)
@@ -661,6 +672,17 @@ def health_report(node, params, body, indicator=None):
     return 200, report
 
 
+def tenants_stats(node, params, body):
+    """GET /_tenants/stats — per-tenant accounting (telemetry/tenants.py).
+    Single-process: the local table rendered through the same merge the
+    cluster fan-out uses, so both surfaces share one shape."""
+    from elasticsearch_tpu.telemetry.tenants import merge_tenant_stats
+    merged = merge_tenant_stats(
+        {node.node_id: node.telemetry.tenants.stats()})
+    merged["cluster_name"] = node.cluster_name
+    return 200, merged
+
+
 def cluster_stats(node, params, body):
     indices = node.indices_service.indices
     docs = sum(idx.stats()["docs"]["count"] for idx in indices.values())
@@ -885,6 +907,14 @@ def cat_health(node, params, body):
     return 200, {"_cat": f"{int(time.time())} {node.cluster_name} "
                          f"{h['status']} {h['number_of_nodes']} "
                          f"{h['number_of_data_nodes']}"}
+
+
+def cat_tenants(node, params, body):
+    # projection of /_tenants/stats through the shared shaping helper —
+    # one accounting implementation, two renders (json + columns)
+    from elasticsearch_tpu.telemetry.tenants import render_cat_tenants
+    _, merged = tenants_stats(node, params, body)
+    return 200, {"_cat": render_cat_tenants(merged)}
 
 
 def cat_count(node, params, body):

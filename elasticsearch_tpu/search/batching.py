@@ -63,11 +63,16 @@ def _nb_tier(n: int) -> int:
 
 class _Entry:
     __slots__ = ("bp", "event", "result", "error", "profiled", "t_enq",
-                 "meta", "t_fr")
+                 "meta", "t_fr", "tenant")
 
     def __init__(self, bp: BoundPlan, profiled: bool = False,
-                 t_enq: int = 0, t_fr: float = 0.0):
+                 t_enq: int = 0, t_fr: float = 0.0,
+                 tenant: Optional[str] = None):
         self.bp = bp
+        # the enqueuing request's ambient tenant: cohort occupancy is
+        # charged per SLOT, so a hog filling the batch window is
+        # attributable even though the launch itself is shared
+        self.tenant = tenant
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
@@ -132,6 +137,8 @@ class PlanBatcher:
         # results stay byte-identical to the single-device launch
         self.mesh = None
         self.mesh_cohorts = 0     # stats: cohorts launched replica-sharded
+        # optional TenantAccounting sink: one cohort slot per entry
+        self.tenants = None
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -163,6 +170,7 @@ class PlanBatcher:
     def execute(self, bp: BoundPlan, ctx, k: int, k1: float, b: float,
                 after_score: Optional[float] = None):
         from elasticsearch_tpu.search import profile as _prof
+        from elasticsearch_tpu.telemetry import context as _telectx
         profiled = _prof.recording()
         if not self._eligible(bp, after_score):
             return execute_bound(bp, ctx, k, k1, b, after_score)
@@ -170,7 +178,8 @@ class PlanBatcher:
         fr = _flight.current()
         entry = _Entry(bp, profiled=profiled,
                        t_enq=_prof.now_ns() if profiled else 0,
-                       t_fr=fr.clock() if fr is not None else 0.0)
+                       t_fr=fr.clock() if fr is not None else 0.0,
+                       tenant=_telectx.current_tenant())
         with self._lock:
             q = self._pending.setdefault(sig, [])
             q.append(entry)
@@ -353,6 +362,10 @@ class PlanBatcher:
         self.launches += 1
         self.batched_queries += qn
         self.batch_hist[bucket] = self.batch_hist.get(bucket, 0) + 1
+        if self.tenants is not None:
+            # integer slot counts only — replay-deterministic
+            for e in batch:
+                self.tenants.record_cohort(e.tenant)
         if rmesh is not None:
             self.mesh_cohorts += 1
             self.mesh._dispatch("replica", qn)
@@ -423,13 +436,14 @@ def _cut_bucket(n: int) -> int:
 
 class _KnnEntry:
     __slots__ = ("qvec", "cut", "event", "result", "error", "profiled",
-                 "t_enq", "meta", "t_fr")
+                 "t_enq", "meta", "t_fr", "tenant")
 
     def __init__(self, qvec: np.ndarray, cut: int,
                  profiled: bool = False, t_enq: int = 0,
-                 t_fr: float = 0.0):
+                 t_fr: float = 0.0, tenant: Optional[str] = None):
         self.qvec = qvec
         self.cut = cut
+        self.tenant = tenant
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
@@ -460,6 +474,7 @@ class KnnBatcher:
         self.batched_queries = 0
         self._lat_ema = 0.0
         self.adaptive_flush_s = float(adaptive_flush_s)
+        self.tenants = None    # optional TenantAccounting sink
 
     def topk(self, dv, live, qvec: np.ndarray, cut: int,
              host_vectors=None) -> Tuple[np.ndarray, np.ndarray]:
@@ -470,6 +485,7 @@ class KnnBatcher:
         (KnnQuery._exact_rerank parity). The cut caps at the slab's
         padded row count — lax.top_k cannot exceed the axis."""
         from elasticsearch_tpu.search import profile as _prof
+        from elasticsearch_tpu.telemetry import context as _telectx
         profiled = _prof.recording()
         nd = int(dv.vectors.shape[0])
         bucket_cut = min(_cut_bucket(cut), nd)
@@ -479,7 +495,8 @@ class KnnBatcher:
         entry = _KnnEntry(np.asarray(qvec, np.float32), cut,
                           profiled=profiled,
                           t_enq=_prof.now_ns() if profiled else 0,
-                          t_fr=fr.clock() if fr is not None else 0.0)
+                          t_fr=fr.clock() if fr is not None else 0.0,
+                          tenant=_telectx.current_tenant())
         with self._lock:
             q = self._pending.setdefault(sig, [])
             q.append(entry)
@@ -571,6 +588,9 @@ class KnnBatcher:
                                      else 0.8 * self._lat_ema + 0.2 * dt)
                 self.launches += 1
                 self.batched_queries += qn
+            if self.tenants is not None:
+                for e in chunk:
+                    self.tenants.record_cohort(e.tenant)
             if any_prof:
                 launch_ms = round((_prof.now_ns() - t0p) / 1e6, 3)
                 for e in chunk:

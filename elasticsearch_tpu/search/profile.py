@@ -410,4 +410,11 @@ def shard_profile_tree(shard_id: str, body: Optional[Dict[str, Any]],
         # the device time was what it was (cohorts, padding, compile
         # vs cache, HBM churn, readback volume)
         entry["device"] = device_section
+    # tenant stamp: the ambient X-Tenant-Id rides every shard entry so
+    # a profiled tree is attributable without joining against tasks
+    # (lazy import — telemetry/context.py imports this module)
+    from elasticsearch_tpu.telemetry import context as _telectx
+    tenant = _telectx.current_tenant()
+    if tenant is not None:
+        entry["tenant"] = tenant
     return entry

@@ -222,9 +222,30 @@ class SearchService:
             return len(self._pits)
 
     # ------------------------------------------------------------ public
+    def _default_tenant(self, index_expression: str) -> Optional[str]:
+        """The `index.tenant.default` setting of a concretely named
+        index (None for patterns/unknown — only an exact name can carry
+        a default)."""
+        if self.indices_service.has(index_expression):
+            return self.indices_service.get(index_expression).settings.get(
+                "index.tenant.default")
+        return None
+
     def search(self, index_expression: str, body: Dict[str, Any],
                scroll: Optional[str] = None, task=None,
                search_type: Optional[str] = None) -> Dict[str, Any]:
+        from elasticsearch_tpu.telemetry import context as _telectx
+        tenant = _telectx.current_tenant()
+        if tenant is None:
+            # precedence: header (already ambient) > body > index
+            # default; a late resolution re-enters under the tenant so
+            # batcher entries / flight events / profile trees see it
+            resolved = (body or {}).get("tenant") \
+                or self._default_tenant(index_expression)
+            if resolved is not None:
+                with _telectx.activate_tenant(str(resolved)):
+                    return self.search(index_expression, body, scroll,
+                                       task, search_type)
         tele = self.telemetry
         if tele is None:
             return self._search(index_expression, body, scroll, task,
@@ -237,12 +258,16 @@ class SearchService:
             response = self._search(index_expression, body, scroll,
                                     task, search_type)
         except Exception:
+            took = (tele.metrics.clock() - t0) * 1000.0
             tele.metrics.inc("search.failed")
-            tele.metrics.observe("search.latency",
-                                 (tele.metrics.clock() - t0) * 1000.0)
+            tele.metrics.observe("search.latency", took)
+            tele.tenants.record_search(tenant, took, failed=True)
             raise
-        tele.metrics.observe("search.latency",
-                             (tele.metrics.clock() - t0) * 1000.0)
+        took = (tele.metrics.clock() - t0) * 1000.0
+        tele.metrics.observe("search.latency", took)
+        tele.tenants.record_search(
+            tenant, took,
+            shards=response.get("_shards", {}).get("total", 0))
         if response.get("timed_out") or \
                 response.get("_shards", {}).get("failed"):
             tele.metrics.inc("search.partial_results")
@@ -642,6 +667,7 @@ class SearchService:
             trace_id=trace_id,
             slowest_stage=slowest_stage_summary(response),
             opaque_id=_telectx.current_opaque_id(),
+            tenant=_telectx.current_tenant(),
             flight=(fr.summary_for_trace(trace_id)
                     if fr is not None and trace_id else None))
 

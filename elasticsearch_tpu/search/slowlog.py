@@ -83,7 +83,8 @@ def record_search_slowlog(
         trace_id: Optional[str] = None,
         slowest_stage: Optional[str] = None,
         opaque_id: Optional[str] = None,
-        flight: Optional[Dict[str, Any]] = None) -> List[Dict[str, Any]]:
+        flight: Optional[Dict[str, Any]] = None,
+        tenant: Optional[str] = None) -> List[Dict[str, Any]]:
     """Check every searched index's thresholds against the search took
     time; append matches (highest matching level per index) to
     ``recent`` and return the new entries. ``settings_of(name)`` yields
@@ -121,6 +122,8 @@ def record_search_slowlog(
                     entry["slowest_stage"] = slowest_stage
                 if opaque_id is not None:
                     entry["x_opaque_id"] = opaque_id
+                if tenant is not None:
+                    entry["tenant"] = tenant
                 if flight:
                     entry["cohort_fill_pct"] = flight.get(
                         "cohort_fill_pct")

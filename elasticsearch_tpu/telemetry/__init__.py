@@ -33,6 +33,9 @@ from elasticsearch_tpu.telemetry.tracing import Span, Tracer  # noqa: F401
 from elasticsearch_tpu.telemetry.flightrecorder import (  # noqa: F401
     FlightRecorder,
 )
+from elasticsearch_tpu.telemetry.tenants import (  # noqa: F401
+    TenantAccounting,
+)
 
 
 class Telemetry:
@@ -61,6 +64,11 @@ class Telemetry:
         # health indicators window over them for free
         self.flight = FlightRecorder(
             node=node, clock=self.metrics.clock, metrics=self.metrics)
+        # bounded per-tenant accounting over the same registry (LRU cap
+        # + `_other` overflow, see telemetry/tenants.py); the flight
+        # recorder attributes launch-ms/readback-bytes through it
+        self.tenants = TenantAccounting(self.metrics, history=self.history)
+        self.flight.tenants = self.tenants
         # engine observability: this node's registry receives
         # `engine.compile.count` / `engine.compile.ms` from the
         # process-global compile tracker (telemetry/engine.py) — the
@@ -95,6 +103,12 @@ class Telemetry:
             # launch/readback provenance + regime attribution (fill
             # histogram, readback count by site, regime-seconds)
             "flight_recorder": self.flight.aggregates(),
+            # busiest tenants by search count (full table behind
+            # `GET /_tenants/stats`)
+            "tenants": {
+                "cardinality": self.tenants.stats()["cardinality"],
+                "top": self.tenants.top_n(),
+            },
         }
         if history:
             self.history.advance()

@@ -44,6 +44,7 @@ SPAN_HEADER = "span.id"
 TASK_HEADER = "task.id"
 PARENT_TASK_HEADER = "task.parent"
 OPAQUE_ID_HEADER = "X-Opaque-Id"
+TENANT_HEADER = "X-Tenant-Id"
 
 _tls = threading.local()
 
@@ -126,6 +127,30 @@ def activate_opaque(value: Optional[str]):
         _tls.opaque = prev
 
 
+# -- ambient tenant (X-Tenant-Id) -----------------------------------------
+
+def current_tenant() -> Optional[str]:
+    """The tenant id the current work is accounted to (header > body >
+    index default, resolved at the request boundary) — the dimension
+    TenantAccounting charges search latency, device launch-ms, cohort
+    slots, and indexing bytes against. None for untagged work (which
+    accounting folds into its ``_default`` bucket)."""
+    return getattr(_tls, "tenant", None)
+
+
+@contextmanager
+def activate_tenant(value: Optional[str]):
+    """Install a tenant id as ambient for the request's duration (no-op
+    pass-through scope when value is falsy — an inner untagged scope
+    never masks an outer tagged one)."""
+    prev = getattr(_tls, "tenant", None)
+    _tls.tenant = value or prev
+    try:
+        yield value
+    finally:
+        _tls.tenant = prev
+
+
 # -- wire headers ---------------------------------------------------------
 
 def headers_of(span) -> Dict[str, str]:
@@ -150,9 +175,13 @@ def stamp_task_headers(headers: Optional[Dict[str, Any]]
     untouched when there is nothing to add."""
     cur = getattr(_tls, "task", None)
     opaque = getattr(_tls, "opaque", None)
+    tenant = getattr(_tls, "tenant", None)
     if opaque is not None and not (headers and OPAQUE_ID_HEADER in headers):
         headers = dict(headers or {})
         headers[OPAQUE_ID_HEADER] = opaque
+    if tenant is not None and not (headers and TENANT_HEADER in headers):
+        headers = dict(headers or {})
+        headers[TENANT_HEADER] = tenant
     if cur is None or (headers and TASK_HEADER in headers):
         return headers
     node_id, task = cur
@@ -179,32 +208,38 @@ def incoming(headers: Optional[Dict[str, Any]]):
     ctx = from_headers(headers)
     task_id = (headers or {}).get(TASK_HEADER)
     opaque = (headers or {}).get(OPAQUE_ID_HEADER)
-    if ctx is None and task_id is None and opaque is None:
+    tenant = (headers or {}).get(TENANT_HEADER)
+    if ctx is None and task_id is None and opaque is None \
+            and tenant is None:
         yield None
         return
     prev_ctx = getattr(_tls, "ctx", None)
     prev_task = getattr(_tls, "task_parent", None)
     prev_opaque = getattr(_tls, "opaque", None)
+    prev_tenant = getattr(_tls, "tenant", None)
     if ctx is not None:
         _tls.ctx = ctx
     _tls.task_parent = str(task_id) if task_id is not None else None
     if opaque is not None:
         _tls.opaque = str(opaque)
+    if tenant is not None:
+        _tls.tenant = str(tenant)
     try:
         yield ctx
     finally:
         _tls.ctx = prev_ctx
         _tls.task_parent = prev_task
         _tls.opaque = prev_opaque
+        _tls.tenant = prev_tenant
 
 
 # -- task-boundary carry --------------------------------------------------
 
 def capture():
     """Snapshot (profile recorder, profile sink, recorder clock, cancel
-    hook, stage hook, trace context, ambient task, opaque id, flight
-    recorder); None when nothing is active — the common case costs a
-    handful of getattrs."""
+    hook, stage hook, trace context, ambient task, opaque id, tenant,
+    flight recorder); None when nothing is active — the common case
+    costs a handful of getattrs."""
     rec = getattr(_profile._tls, "rec", None)
     sink = getattr(_profile._tls, "sink", None)
     clock = getattr(_profile._tls, "clock", None)
@@ -213,12 +248,14 @@ def capture():
     ctx = getattr(_tls, "ctx", None)
     task = getattr(_tls, "task", None)
     opaque = getattr(_tls, "opaque", None)
+    tenant = getattr(_tls, "tenant", None)
     flight = getattr(_flight._tls, "rec", None)
     if rec is None and sink is None and cancel is None \
             and stage_cb is None and ctx is None and task is None \
-            and opaque is None and flight is None:
+            and opaque is None and tenant is None and flight is None:
         return None
-    return (rec, sink, clock, cancel, stage_cb, ctx, task, opaque, flight)
+    return (rec, sink, clock, cancel, stage_cb, ctx, task, opaque,
+            tenant, flight)
 
 
 def bind(fn: Callable) -> Callable:
@@ -229,7 +266,8 @@ def bind(fn: Callable) -> Callable:
     cap = capture()
     if cap is None:
         return fn
-    rec, sink, clock, cancel, stage_cb, ctx, task, opaque, flight = cap
+    rec, sink, clock, cancel, stage_cb, ctx, task, opaque, tenant, \
+        flight = cap
 
     def bound():
         prev_rec = getattr(_profile._tls, "rec", None)
@@ -240,6 +278,7 @@ def bind(fn: Callable) -> Callable:
         prev_ctx = getattr(_tls, "ctx", None)
         prev_task = getattr(_tls, "task", None)
         prev_opaque = getattr(_tls, "opaque", None)
+        prev_tenant = getattr(_tls, "tenant", None)
         prev_flight = getattr(_flight._tls, "rec", None)
         _profile._tls.rec = rec
         _profile._tls.sink = sink
@@ -249,6 +288,7 @@ def bind(fn: Callable) -> Callable:
         _tls.ctx = ctx
         _tls.task = task
         _tls.opaque = opaque
+        _tls.tenant = tenant
         _flight._tls.rec = flight
         try:
             return fn()
@@ -261,6 +301,7 @@ def bind(fn: Callable) -> Callable:
             _tls.ctx = prev_ctx
             _tls.task = prev_task
             _tls.opaque = prev_opaque
+            _tls.tenant = prev_tenant
             _flight._tls.rec = prev_flight
 
     return bound

@@ -141,6 +141,9 @@ class FlightRecorder:
         self._fill_slots = 0          # summed cohort capacity
         self._fill_filled = 0         # summed cohort occupancy
         self._readback_by_site: Dict[str, Dict[str, float]] = {}
+        # optional TenantAccounting sink: launch-ms and readback bytes
+        # charged to the ambient tenant (telemetry/tenants.py)
+        self.tenants = None
 
     # -- clock ------------------------------------------------------------
 
@@ -214,6 +217,9 @@ class FlightRecorder:
             out["trace_id"] = ctx.trace_id
             if ctx.span_id is not None:
                 out["span_id"] = ctx.span_id
+        tenant = _telectx.current_tenant()
+        if tenant is not None:
+            out["tenant"] = tenant
         return out
 
     def record_launch(self, kernel: str, shape: str,
@@ -250,6 +256,8 @@ class FlightRecorder:
             self.metrics.inc("flight.launch.slots", capacity)
             self.metrics.inc("flight.launch.filled", cohort)
             self._sync_regime_metrics()
+        if self.tenants is not None:
+            self.tenants.record_launch(ev.get("tenant"), dispatch_ms)
 
     def record_readback(self, site: str, nbytes: int,
                         duration_ns: int = 0) -> None:
@@ -276,6 +284,8 @@ class FlightRecorder:
             self.metrics.inc("flight.readbacks")
             self.metrics.inc("flight.readback.bytes", nbytes)
             self._sync_regime_metrics()
+        if self.tenants is not None:
+            self.tenants.record_readback(ev.get("tenant"), nbytes)
 
     # -- queries ----------------------------------------------------------
 

@@ -96,6 +96,26 @@ class MetricsHistory:
         if task is not None:
             task.cancel()
 
+    # -- cardinality control ----------------------------------------------
+
+    def prune_label(self, label: str, value: str) -> int:
+        """Scrub every ring sample of series labeled ``label=value``;
+        returns the number of (sample, series) entries removed. Paired
+        with ``MetricsRegistry.prune_label``: when TenantAccounting
+        evicts a tenant, its history must go with its live series —
+        otherwise ``memory_bytes()``/``to_dict()`` keep paying for
+        (and rendering) tenants that no longer exist, and the
+        cardinality cap only bounds half the cost."""
+        pair = (label, str(value))
+        removed = 0
+        with self._lock:
+            for _, snap in self._ring:
+                doomed = [k for k in snap if pair in k[1]]
+                for k in doomed:
+                    del snap[k]
+                removed += len(doomed)
+        return removed
+
     # -- queries (ring-only: replay-deterministic) ------------------------
 
     def samples(self) -> List[Sample]:

@@ -255,6 +255,22 @@ class MetricsRegistry:
         finally:
             self.observe(name, (self.clock() - t0) * 1000.0, **labels)
 
+    # -- cardinality control ----------------------------------------------
+
+    def prune_label(self, label: str, value: str) -> int:
+        """Drop every series (counter, gauge, histogram — exemplar
+        slots die with the histogram) whose labels carry
+        ``label=value``; returns the number of series removed. The seam
+        TenantAccounting's LRU eviction uses so a tenant churn storm
+        cannot grow the registry (or `_nodes/stats` renders of it)
+        without bound."""
+        pair = (label, str(value))
+        with self._lock:
+            doomed = [k for k in self._metrics if pair in k[1]]
+            for k in doomed:
+                del self._metrics[k]
+        return len(doomed)
+
     # -- introspection ----------------------------------------------------
 
     def get_value(self, name: str, **labels):

@@ -116,6 +116,7 @@ class Task:
         ctx = _telectx.current()
         self.trace_id: Optional[str] = ctx.trace_id if ctx else None
         self.opaque_id: Optional[str] = _telectx.current_opaque_id()
+        self.tenant: Optional[str] = _telectx.current_tenant()
 
     def running_time_nanos(self) -> int:
         return int((self._clock() - self._start) * 1e9)
@@ -135,6 +136,8 @@ class Task:
             d["trace.id"] = self.trace_id
         if self.opaque_id is not None:
             d["headers"] = {"X-Opaque-Id": self.opaque_id}
+        if self.tenant is not None:
+            d["tenant"] = self.tenant
         if self.profile_stage is not None:
             d["profile_stage"] = self.profile_stage
         if self.parent_task_id is not EMPTY_TASK_ID and \

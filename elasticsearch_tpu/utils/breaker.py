@@ -63,6 +63,10 @@ class CircuitBreaker:
         svc = self._parent
         if svc is not None and svc.metrics is not None:
             svc.metrics.inc("breaker.tripped", breaker=self.name)
+        if svc is not None and getattr(svc, "tenants", None) is not None:
+            from elasticsearch_tpu.telemetry import context as _telectx
+            svc.tenants.record_breaker_trip(
+                _telectx.current_tenant(), self.name)
 
     def add_estimate_bytes_and_maybe_break(self, bytes_: int, label: str = "") -> int:
         with self._lock:
@@ -115,6 +119,9 @@ class HierarchyCircuitBreakerService:
         # telemetry sink (MetricsRegistry or None) — `breaker.tripped`
         # counters per child, `breaker.parent.tripped` for the parent
         self.metrics = metrics
+        # optional TenantAccounting sink: trips charged to the ambient
+        # tenant so noisy-neighbor attribution sees who blew the budget
+        self.tenants = None
         if request_limit_bytes is None:
             request_limit_bytes = int(total_limit_bytes * 0.6)
         if fielddata_limit_bytes is None:

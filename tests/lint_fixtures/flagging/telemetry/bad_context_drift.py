@@ -1,0 +1,40 @@
+# lint-fixture: flags=ESTPU-CTX01
+"""capture() grew a tenant field that bind() never learned about: the
+snapshot carries it across the executor hop, the rebind drops it, and
+every request that crosses a thread pool comes out untagged."""
+
+
+class _Tls:
+    pass
+
+
+_tls = _Tls()
+
+
+def capture():
+    rec = getattr(_tls, "rec", None)
+    opaque = getattr(_tls, "opaque", None)
+    tenant = getattr(_tls, "tenant", None)
+    if rec is None and opaque is None and tenant is None:
+        return None
+    return (rec, opaque, tenant)
+
+
+def bind(fn):
+    cap = capture()
+    if cap is None:
+        return fn
+    rec, opaque = cap  # lint-expect: ESTPU-CTX01
+
+    def bound():
+        prev_rec = getattr(_tls, "rec", None)
+        prev_opaque = getattr(_tls, "opaque", None)
+        _tls.rec = rec
+        _tls.opaque = opaque
+        try:
+            return fn()
+        finally:
+            _tls.rec = prev_rec
+            _tls.opaque = prev_opaque
+
+    return bound

@@ -1,0 +1,43 @@
+# lint-fixture: passes=ESTPU-CTX01
+"""The contract-respecting twin: bind() unpacks exactly the tuple
+capture() returns, field for field, and re-installs every slot inside
+the bound closure — nothing is lost across the hop."""
+
+
+class _Tls:
+    pass
+
+
+_tls = _Tls()
+
+
+def capture():
+    rec = getattr(_tls, "rec", None)
+    opaque = getattr(_tls, "opaque", None)
+    tenant = getattr(_tls, "tenant", None)
+    if rec is None and opaque is None and tenant is None:
+        return None
+    return (rec, opaque, tenant)
+
+
+def bind(fn):
+    cap = capture()
+    if cap is None:
+        return fn
+    rec, opaque, tenant = cap
+
+    def bound():
+        prev_rec = getattr(_tls, "rec", None)
+        prev_opaque = getattr(_tls, "opaque", None)
+        prev_tenant = getattr(_tls, "tenant", None)
+        _tls.rec = rec
+        _tls.opaque = opaque
+        _tls.tenant = tenant
+        try:
+            return fn()
+        finally:
+            _tls.rec = prev_rec
+            _tls.opaque = prev_opaque
+            _tls.tenant = prev_tenant
+
+    return bound

@@ -1,0 +1,477 @@
+"""Tenant-scoped accounting & SLO observability (telemetry/tenants.py +
+the noisy_neighbor indicator + the /_tenants surfaces): bounded LRU
+cardinality with fold-on-evict, tagging precedence (header > body >
+index default), deterministic cluster merge, and noisy-neighbor
+attribution naming the tenant in a typed diagnosis.
+
+The chaos paths replay byte-identically from their queue seed."""
+
+import json
+
+import pytest
+
+from test_cluster_node import SimDataCluster, _index_some_docs
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.telemetry import context as telectx
+from elasticsearch_tpu.telemetry.context import TraceContext
+from elasticsearch_tpu.telemetry.history import MetricsHistory
+from elasticsearch_tpu.telemetry.metrics import MetricsRegistry
+from elasticsearch_tpu.telemetry.tenants import (
+    DEFAULT_TENANT,
+    LATENCY_METRIC,
+    OVERFLOW_TENANT,
+    TENANT_LABEL,
+    TenantAccounting,
+    merge_tenant_stats,
+    render_cat_tenants,
+)
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _table(max_tenants=64, with_history=True, **kwargs):
+    clock = _Clock()
+    reg = MetricsRegistry(clock=clock)
+    hist = MetricsHistory(reg, clock, interval=10.0) if with_history \
+        else None
+    return clock, reg, hist, TenantAccounting(
+        reg, history=hist, max_tenants=max_tenants, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# bounded accounting table
+# ---------------------------------------------------------------------------
+
+
+def test_untagged_work_lands_in_default_bucket():
+    _, _, _, acct = _table()
+    acct.record_search(None, 12.0, shards=3)
+    acct.record_indexing("", 256)
+    s = acct.stats()
+    assert list(s["tenants"]) == [DEFAULT_TENANT]
+    e = s["tenants"][DEFAULT_TENANT]
+    assert e["search"]["count"] == 1
+    assert e["search"]["shard_fanout"] == 3
+    assert e["indexing"]["bytes"] == 256
+
+
+def test_lru_eviction_folds_totals_into_other():
+    _, _, _, acct = _table(max_tenants=2)
+    acct.record_search("t1", 10.0)
+    acct.record_indexing("t1", 100)
+    acct.record_search("t2", 20.0)
+    acct.record_search("t3", 30.0)   # evicts t1 (least recently active)
+    s = acct.stats()
+    assert sorted(s["tenants"]) == [OVERFLOW_TENANT, "t2", "t3"]
+    assert s["cardinality"]["evictions"] == 1
+    other = s["tenants"][OVERFLOW_TENANT]
+    # totals are never lost: t1's search + indexing folded by value
+    assert other["search"]["count"] == 1
+    assert other["search"]["latency"]["count"] == 1
+    assert other["indexing"]["bytes"] == 100
+    # grand total conserved across the fold
+    assert sum(e["search"]["count"]
+               for e in s["tenants"].values()) == 3
+
+
+def test_reserved_buckets_never_count_against_cap():
+    _, _, _, acct = _table(max_tenants=2)
+    acct.record_search(None, 1.0)           # _default
+    acct.record_search("a", 1.0)
+    acct.record_search("b", 1.0)
+    assert acct.stats()["cardinality"]["evictions"] == 0
+    acct.record_search("c", 1.0)            # evicts a -> _other
+    live = sorted(acct.stats()["tenants"])
+    assert live == [DEFAULT_TENANT, OVERFLOW_TENANT, "b", "c"]
+    # reserved buckets survive arbitrary churn
+    for i in range(5):
+        acct.record_search(f"churn-{i}", 1.0)
+    live = acct.active_tenants()
+    assert DEFAULT_TENANT in live and OVERFLOW_TENANT in live
+
+
+def test_eviction_prunes_registry_ring_and_exemplar_slots():
+    """The cardinality small-fix pin: an evicted tenant's labeled
+    series — including the latency histogram carrying exemplar slots —
+    leave the registry AND the history ring, so neither _nodes/stats
+    nor ?history=true renders can grow past the cap."""
+    clock, reg, hist, acct = _table(max_tenants=1)
+    with telectx.activate(TraceContext("trace-ev1")):
+        acct.record_search("ev1", 42.0)
+    clock.advance(10.0)
+    assert hist.advance()   # ring sample holding ev1's series
+    assert any(lk and dict(lk).get(TENANT_LABEL) == "ev1"
+               for (_n, lk) in hist.samples()[-1][1])
+    assert [e for e in reg.exemplars_of(LATENCY_METRIC)
+            if e.get("trace_id") == "trace-ev1"], \
+        "exemplar slot never recorded"
+
+    acct.record_search("ev2", 7.0)   # evicts ev1
+    with reg._lock:
+        leaked = [(n, lk) for (n, lk) in reg._metrics
+                  if lk and dict(lk).get(TENANT_LABEL) == "ev1"]
+    assert leaked == []
+    # exemplar slots died with the pruned histogram (not folded)
+    assert [e for e in reg.exemplars_of(LATENCY_METRIC)
+            if e.get("trace_id") == "trace-ev1"] == []
+    # every ring sample scrubbed too
+    for _ts, snap in hist.samples():
+        assert not any(lk and dict(lk).get(TENANT_LABEL) == "ev1"
+                       for (_n, lk) in snap)
+    # but the fold preserved the totals in _other
+    other = acct.stats()["tenants"][OVERFLOW_TENANT]
+    assert other["search"]["count"] == 1
+    assert other["search"]["latency"]["sum_ms"] == 42.0
+
+
+def test_latency_quantiles_are_deterministic_bucket_bounds():
+    _, _, _, acct = _table()
+    for v in (1.0, 1.0, 1.0, 900.0):
+        acct.record_search("q", v)
+    lat = acct.stats()["tenants"]["q"]["search"]["latency"]
+    # quantiles are bucket upper bounds: p50 covers the 1ms cluster,
+    # p99 lands in the bucket holding the 900ms tail observation
+    assert lat["p50_ms"] == 1.0
+    assert lat["p99_ms"] == 1000.0
+    assert lat["count"] == 4
+
+
+def test_slo_violations_and_budget_burn():
+    _, _, _, acct = _table(slo_objectives={"slo-t": 10.0})
+    for _ in range(95):
+        acct.record_search("slo-t", 5.0)
+    for _ in range(5):
+        acct.record_search("slo-t", 50.0)
+    slo = acct.stats()["tenants"]["slo-t"]["slo"]
+    assert slo["objective_ms"] == 10.0
+    assert slo["violations"] == 5
+    # 1% of 100 requests allowed -> 5 violations = 500% burned
+    assert slo["budget_burn_pct"] == 500.0
+
+
+def test_slo_default_applies_when_no_override():
+    _, _, _, acct = _table(slo_default_ms=20.0,
+                           slo_objectives={"fast": 5.0})
+    assert acct.objective_ms("fast") == 5.0
+    assert acct.objective_ms("anyone") == 20.0
+
+
+# ---------------------------------------------------------------------------
+# merge + cat render (ONE shaping impl, two surfaces)
+# ---------------------------------------------------------------------------
+
+
+def _two_node_sections():
+    _, _, _, a = _table()
+    a.record_search("t1", 2.0, shards=2)
+    a.record_search("t1", 200.0)
+    a.record_indexing("t1", 50)
+    _, _, _, b = _table()
+    b.record_search("t1", 2.0)
+    b.record_search("t2", 8.0)
+    b.record_rejection("t2")
+    return {"n-a": a.stats(), "n-b": b.stats()}
+
+
+def test_merge_sums_counters_and_recomputes_quantiles():
+    merged = merge_tenant_stats(_two_node_sections())
+    assert merged["nodes"] == ["n-a", "n-b"]
+    t1 = merged["tenants"]["t1"]
+    assert t1["search"]["count"] == 3
+    assert t1["search"]["shard_fanout"] == 2
+    assert t1["search"]["latency"]["count"] == 3
+    # quantiles recomputed from the SUMMED buckets, not averaged from
+    # per-node quantiles: p50 covers the two 2ms observations, p99
+    # reaches the bucket holding node a's 200ms one
+    assert t1["search"]["latency"]["p50_ms"] == 5.0
+    assert t1["search"]["latency"]["p99_ms"] == 500.0
+    assert merged["tenants"]["t2"]["indexing"]["rejections"] == 1
+    assert merged["cardinality"]["live"] == 2
+
+
+def test_merge_is_order_independent_and_reports_failures():
+    sections = _two_node_sections()
+    fwd = merge_tenant_stats(dict(sections))
+    rev = merge_tenant_stats(dict(reversed(list(sections.items()))))
+    assert json.dumps(fwd, sort_keys=True) == \
+        json.dumps(rev, sort_keys=True)
+    failed = merge_tenant_stats(sections,
+                                [{"node": "n-c", "error": "boom"}])
+    assert failed["node_failures"] == [{"node": "n-c", "error": "boom"}]
+
+
+def test_cat_tenants_renders_merged_rows():
+    text = render_cat_tenants(merge_tenant_stats(_two_node_sections()))
+    lines = text.splitlines()
+    assert lines[0].split()[:2] == ["tenant", "search.count"]
+    assert [ln.split()[0] for ln in lines[1:]] == ["t1", "t2"]
+
+
+# ---------------------------------------------------------------------------
+# ambient propagation: context tuple + wire headers
+# ---------------------------------------------------------------------------
+
+
+def test_capture_bind_carries_tenant_across_hop():
+    captured = {}
+
+    def probe():
+        captured["t"] = telectx.current_tenant()
+
+    with telectx.activate_tenant("hopper"):
+        bound = telectx.bind(probe)
+    assert telectx.current_tenant() is None
+    bound()                       # far side of an executor hop
+    assert captured["t"] == "hopper"
+    assert telectx.current_tenant() is None   # restored after the hop
+
+
+def test_wire_headers_round_trip_tenant():
+    with telectx.activate_tenant("wire-t"):
+        headers = telectx.stamp_task_headers(None)
+    assert headers[telectx.TENANT_HEADER] == "wire-t"
+    with telectx.incoming(headers):
+        assert telectx.current_tenant() == "wire-t"
+    assert telectx.current_tenant() is None
+
+
+# ---------------------------------------------------------------------------
+# single-process REST surface
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(data_path=str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def do(node, method, path, params=None, body=None, headers=None,
+       expect=200):
+    status, resp = node.rest_controller.dispatch(
+        method, path, params, body, headers=headers)
+    assert status == expect, f"{method} {path} -> {status}: {resp}"
+    return resp
+
+
+def _seed(node, index="logs", settings=None):
+    do(node, "PUT", f"/{index}", body={"settings": settings or {}})
+    do(node, "PUT", f"/{index}/_doc/1",
+       body={"body": "quick brown fox"}, expect=201)
+    do(node, "POST", f"/{index}/_refresh")
+
+
+def test_header_tagging_reaches_tenants_stats(node):
+    _seed(node)
+    do(node, "POST", "/logs/_search",
+       body={"query": {"match": {"body": "fox"}}},
+       headers={"x-tenant-id": "acme"})
+    stats = do(node, "GET", "/_tenants/stats")
+    assert stats["nodes"] == [node.node_id]
+    assert stats["tenants"]["acme"]["search"]["count"] == 1
+    assert stats["tenants"]["acme"]["search"]["latency"]["count"] == 1
+
+
+def test_tagging_precedence_header_beats_body_beats_index_default(node):
+    _seed(node, index="tagged",
+          settings={"index.tenant.default": "from-index"})
+    # index default applies when nothing stronger is present
+    do(node, "POST", "/tagged/_search",
+       body={"query": {"match_all": {}}})
+    # body tag beats the index default
+    do(node, "POST", "/tagged/_search",
+       body={"query": {"match_all": {}}, "tenant": "from-body"})
+    # header beats both
+    do(node, "POST", "/tagged/_search",
+       body={"query": {"match_all": {}}, "tenant": "from-body"},
+       headers={"X-Tenant-Id": "from-header"})
+    t = do(node, "GET", "/_tenants/stats")["tenants"]
+    assert t["from-index"]["search"]["count"] == 1
+    assert t["from-body"]["search"]["count"] == 1
+    assert t["from-header"]["search"]["count"] == 1
+
+
+def test_untagged_search_charges_default_bucket(node):
+    _seed(node)
+    do(node, "POST", "/logs/_search",
+       body={"query": {"match": {"body": "fox"}}})
+    t = do(node, "GET", "/_tenants/stats")["tenants"]
+    assert t[DEFAULT_TENANT]["search"]["count"] >= 1
+
+
+def test_cat_tenants_shares_stats_shaping(node):
+    _seed(node)
+    do(node, "POST", "/logs/_search",
+       body={"query": {"match_all": {}}, "tenant": "cat-t"})
+    stats = do(node, "GET", "/_tenants/stats")
+    cat = do(node, "GET", "/_cat/tenants")["_cat"]
+    lines = cat.splitlines()
+    assert lines[0].startswith("tenant")
+    # every JSON tenant appears as a cat row with the same count
+    for t, e in stats["tenants"].items():
+        row = next(ln for ln in lines[1:] if ln.split()[0] == t)
+        assert row.split()[1] == str(e["search"]["count"])
+
+
+def test_slowlog_entries_carry_tenant(node):
+    _seed(node, index="slowidx", settings={
+        "index.search.slowlog.threshold.query.warn": "0ms"})
+    do(node, "POST", "/slowidx/_search",
+       body={"query": {"match": {"body": "fox"}}, "tenant": "slow-t"})
+    entries = [e for e in node.search_service.slowlog_recent
+               if e.get("tenant") == "slow-t"]
+    assert entries, list(node.search_service.slowlog_recent)
+
+
+def test_nodes_stats_renders_tenant_top_n(node):
+    _seed(node)
+    for _ in range(3):
+        do(node, "POST", "/logs/_search",
+           body={"query": {"match_all": {}}, "tenant": "busy"})
+    do(node, "POST", "/logs/_search",
+       body={"query": {"match_all": {}}, "tenant": "idle"})
+    ns = do(node, "GET", "/_nodes/stats")
+    section = ns["nodes"][node.node_id]["telemetry"]["tenants"]
+    assert section["cardinality"]["live"] >= 2
+    top = section["top"]
+    busy = next(r for r in top if r["tenant"] == "busy")
+    assert busy["search_count"] == 3
+    assert top[0]["tenant"] == "busy"   # sorted by search count
+
+
+# ---------------------------------------------------------------------------
+# multi-node chaos: fan-out, attribution, replay
+# ---------------------------------------------------------------------------
+
+
+def _tenant_workload(cluster, master):
+    cluster.call(master.create_index, "quietidx",
+                 number_of_shards=2, number_of_replicas=1,
+                 settings={"index.tenant.default": "quiet"})
+    cluster.call(master.create_index, "hogidx",
+                 number_of_shards=2, number_of_replicas=1,
+                 settings={"index.tenant.default": "hog"})
+    cluster.run_for(60)
+    _index_some_docs(cluster, master, index="quietidx", n=10)
+    for _ in range(6):
+        cluster.call(master.search, "quietidx",
+                     {"tenant": "quiet",
+                      "query": {"match": {"body": "fox"}}, "size": 3})
+    cluster.call(master.bulk, "hogidx",
+                 [{"op": "index", "id": f"h-{i}",
+                   "source": {"body": f"hog {i}"}} for i in range(20)])
+
+
+@pytest.mark.chaos(seed=41)
+def test_tenants_stats_fan_out_replays_byte_identical(tmp_path,
+                                                      chaos_seed):
+    def run(sub):
+        c = SimDataCluster(3, tmp_path / sub, seed=chaos_seed)
+        m = c.stabilise()
+        _tenant_workload(c, m)
+        return c.call(m.tenants_stats)
+
+    r1, r2 = run("a"), run("b")
+    assert len(r1["nodes"]) == 3 and r1["nodes"] == sorted(r1["nodes"])
+    assert {"hog", "quiet"} <= set(r1["tenants"])
+    assert r1["tenants"]["quiet"]["search"]["count"] == 6
+    assert r1["tenants"]["hog"]["indexing"]["bytes"] > 0
+    assert json.dumps(r1, sort_keys=True) == \
+        json.dumps(r2, sort_keys=True)
+
+
+@pytest.mark.chaos(seed=43)
+def test_noisy_burst_flips_indicator_and_names_tenant(tmp_path,
+                                                      chaos_seed):
+    """The acceptance bar: a seeded hog burst flips noisy_neighbor and
+    the typed diagnosis names the hog, while the quiet tenant's
+    accounting stays clean."""
+    c = SimDataCluster(3, tmp_path, seed=chaos_seed)
+    m = c.stabilise()
+    _tenant_workload(c, m)
+    baseline = c.call(m.health_report)   # lays the ring's anchor sample
+    assert baseline["indicators"]["noisy_neighbor"]["status"] == "green"
+    # quiet searches INSIDE the window the final report examines
+    for _ in range(4):
+        c.call(m.search, "quietidx",
+               {"tenant": "quiet", "query": {"match_all": {}},
+                "size": 1})
+    # seeded burst: shrink the coordinator's indexing-pressure budget
+    # so the hog's bulks shed with rejections
+    saved = m.indexing_pressure.limit
+    m.indexing_pressure.limit = 64
+    rejected = 0
+    for i in range(8):
+        try:
+            c.call(m.bulk, "hogidx",
+                   [{"op": "index", "id": f"burst-{i}",
+                     "source": {"body": "x" * 300}}])
+        except Exception:
+            rejected += 1
+    m.indexing_pressure.limit = saved
+    assert rejected == 8
+    c.run_for(11)                 # cross the next history boundary
+    report = c.call(m.health_report)
+    noisy = report["indicators"]["noisy_neighbor"]
+    assert noisy["status"] in ("yellow", "red")
+    assert noisy["diagnosis"][0]["id"] == \
+        "noisy_neighbor:dominant_tenant"
+    named = {r for d in noisy["diagnosis"]
+             for r in d["affected_resources"]}
+    assert named == {"hog"}
+    # quiet tenant's accounting untouched by the hog's shed load
+    merged = c.call(m.tenants_stats)
+    assert merged["tenants"]["quiet"]["indexing"]["rejections"] == 0
+    assert merged["tenants"]["quiet"]["search"]["failed"] == 0
+    assert merged["tenants"]["hog"]["indexing"]["rejections"] == 8
+
+
+@pytest.mark.chaos(seed=47)
+def test_untagged_cluster_work_lands_in_default(tmp_path, chaos_seed):
+    c = SimDataCluster(3, tmp_path, seed=chaos_seed)
+    m = c.stabilise()
+    c.call(m.create_index, "plain", number_of_shards=2,
+           number_of_replicas=1)
+    c.run_for(60)
+    _index_some_docs(c, m, index="plain", n=8)
+    c.call(m.search, "plain", {"query": {"match_all": {}}, "size": 2})
+    merged = c.call(m.tenants_stats)
+    assert DEFAULT_TENANT in merged["tenants"]
+    assert merged["tenants"][DEFAULT_TENANT]["search"]["count"] >= 1
+
+
+@pytest.mark.chaos(seed=53)
+def test_cap_overflow_preserves_totals_across_fan_out(tmp_path,
+                                                      chaos_seed):
+    c = SimDataCluster(3, tmp_path, seed=chaos_seed)
+    m = c.stabilise()
+    c.call(m.create_index, "caps", number_of_shards=1,
+           number_of_replicas=0)
+    c.run_for(60)
+    _index_some_docs(c, m, index="caps", n=5)
+    for cn in c.cluster_nodes.values():
+        cn.telemetry.tenants.max_tenants = 2
+    for i in range(5):
+        c.call(m.search, "caps",
+               {"tenant": f"cap-{i}", "query": {"match_all": {}},
+                "size": 1})
+    merged = c.call(m.tenants_stats)
+    # coordinator-side: 5 tenants squeezed through a cap of 2 — the
+    # evicted ones folded into _other, totals conserved
+    total = sum(e["search"]["count"]
+                for t, e in merged["tenants"].items()
+                if t.startswith("cap-") or t == OVERFLOW_TENANT)
+    assert total == 5
+    assert merged["cardinality"]["evictions"] >= 3
+    assert OVERFLOW_TENANT in merged["tenants"]
