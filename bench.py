@@ -80,7 +80,7 @@ def emit(metric_text: str, value: float, vs_baseline: float,
          engine=None, overload=None, tasks=None, cpu=None,
          serving=None, skipped=None, aggs=None, multichip=None,
          lint=None, recovery=None, health=None, upgrade=None,
-         cursors=None, tenants=None):
+         cursors=None, tenants=None, snapshots=None):
     _LAST_PAYLOAD.clear()
     _LAST_PAYLOAD.update({
         "metric": metric_text,
@@ -178,6 +178,15 @@ def emit(metric_text: str, value: float, vs_baseline: float,
         # regression in attribution (hog unnamed, or the quiet tenant
         # charged) shows here round over round
         _LAST_PAYLOAD["tenants"] = tenants
+    if snapshots:
+        # snapshot/restore rider (repositories/blobstore.py + the
+        # cluster snapshot plane, deterministic sim): virtual snapshot
+        # wall-clock + bytes uploaded, the incremental second pass's
+        # delta bytes (must stay near zero for an unchanged index),
+        # restore-through-staged-recovery wall-clock, and searches
+        # served while the snapshot ran — a repo-format or dedup
+        # regression shows here before it costs a real backup window
+        _LAST_PAYLOAD["snapshots"] = snapshots
     print(json.dumps(_LAST_PAYLOAD), flush=True)
 
 
@@ -2179,6 +2188,148 @@ def run_tenants_cpu(seed=19):
         return out
 
 
+def run_snapshots_cpu(n_docs=300, seed=23):
+    """Snapshot/restore rider (CPU-side, deterministic sim — no jax):
+    a 3-node sim cluster indexes ``n_docs`` into a 2-shard index, takes
+    a distributed snapshot into an fs repository while probe searches
+    keep running, takes a SECOND snapshot of the unchanged index (the
+    incremental pass — its uploaded bytes must stay ~zero), indexes a
+    delta and snapshots a third time, then restores the first snapshot
+    under rename through the staged recovery protocol. All clocks are
+    VIRTUAL (sim seconds), so every number is replay-stable round over
+    round — banked into the BENCH json `snapshots` section BEFORE any
+    backend touch."""
+    import tempfile
+
+    from elasticsearch_tpu.cluster.node import ClusterNode
+    from elasticsearch_tpu.cluster.state import SHARD_STARTED
+    from elasticsearch_tpu.testing.deterministic import (
+        DeterministicTaskQueue, DisruptableTransport, SimNetwork)
+    from elasticsearch_tpu.transport.transport import DiscoveryNode
+
+    t_host = time.time()
+    with tempfile.TemporaryDirectory() as tmp:
+        queue = DeterministicTaskQueue(seed=seed)
+        network = SimNetwork(queue)
+        nodes = [DiscoveryNode(node_id=f"sn-{i}", name=f"sn{i}")
+                 for i in range(3)]
+        cluster = {}
+        for node in nodes:
+            cluster[node.node_id] = ClusterNode(
+                DisruptableTransport(node, network), queue,
+                data_path=os.path.join(tmp, node.name),
+                seed_nodes=nodes,
+                initial_master_nodes=[n.name for n in nodes],
+                rng=queue.random)
+        for cn in cluster.values():
+            cn.start()
+
+        def call(fn, *args, **kwargs):
+            box = {}
+            fn(*args, **kwargs,
+               on_done=lambda r, e=None: box.update(r=r, e=e))
+            for _ in range(120):
+                if box:
+                    break
+                queue.run_for(1.0)
+            if box.get("e") is not None:
+                raise RuntimeError(box["e"])
+            return box.get("r")
+
+        queue.run_for(60)
+        master = next(cn for cn in cluster.values() if cn.is_master())
+        call(master.create_index, "bench", number_of_shards=2,
+             number_of_replicas=0)
+        queue.run_for(30)
+        call(master.bulk, "bench", [
+            {"op": "index", "id": f"d{i}",
+             "source": {"body": f"bench doc {i} term{i % 37}"}}
+            for i in range(n_docs)])
+        call(master.refresh)
+        call(master.put_repository, "bench-backup",
+             {"type": "fs",
+              "settings": {"location": os.path.join(tmp, "repo")}})
+
+        probes = {"ok": 0, "failed": 0}
+
+        def probe():
+            master.search(
+                "bench", {"query": {"match": {"body": "bench"}},
+                          "size": 0},
+                on_done=lambda r, e=None: probes.__setitem__(
+                    "failed" if e or r["_shards"]["failed"] else "ok",
+                    probes["failed" if e or r["_shards"]["failed"]
+                           else "ok"] + 1))
+
+        # probes land inside the snapshot window: per-shard uploads run
+        # over several virtual network hops, so the first ~2s of sim
+        # time IS the snapshot — writes stay unblocked throughout
+        for i in range(8):
+            queue.schedule(0.05 + i * 0.25, probe, f"snap-probe-{i}")
+        snap1 = call(master.create_snapshot, "bench-backup", "snap-1",
+                     {"indices": "bench"})["snapshot"]
+        st1 = call(master.snapshot_status, "bench-backup",
+                   "snap-1")["stats"]
+        # incremental pass over the unchanged index: every segment blob
+        # dedups by content hash, so uploaded bytes must stay ~zero
+        call(master.create_snapshot, "bench-backup", "snap-2",
+             {"indices": "bench"})
+        st2 = call(master.snapshot_status, "bench-backup",
+                   "snap-2")["stats"]
+        call(master.bulk, "bench", [
+            {"op": "index", "id": f"x{i}",
+             "source": {"body": f"delta doc {i} extra{i % 11}"}}
+            for i in range(50)])
+        call(master.refresh)
+        call(master.create_snapshot, "bench-backup", "snap-3",
+             {"indices": "bench"})
+        st3 = call(master.snapshot_status, "bench-backup",
+                   "snap-3")["stats"]
+
+        t_restore = queue.now()
+        call(master.restore_snapshot, "bench-backup", "snap-1",
+             {"indices": "bench", "rename_pattern": "bench",
+              "rename_replacement": "bench_restored"})
+        restore_ms = None
+        for _ in range(600):
+            queue.run_for(0.1)
+            table = master.state.routing_table.index("bench_restored")
+            if table is not None and all(
+                    s.state == SHARD_STARTED
+                    for sid in range(2)
+                    for s in table.shard(sid).shards):
+                restore_ms = round((queue.now() - t_restore) * 1000)
+                break
+        queue.run_for(5.0)
+        restore_recs = [
+            r.to_dict() for cn in cluster.values()
+            for r in cn.data_node.recoveries.values()
+            if r.recovery_type == "snapshot"]
+        restored = call(master.search, "bench_restored",
+                        {"query": {"match_all": {}}, "size": 0})
+        out = {
+            "snapshot_ms": snap1["end_time_in_millis"]
+            - snap1["start_time_in_millis"],
+            "snapshot_uploaded_bytes": st1["uploaded_bytes"],
+            "snapshot_files": st1["file_count"],
+            "incremental_delta_bytes": st2["uploaded_bytes"],
+            "incremental_skipped_bytes": st2["skipped_bytes"],
+            "third_uploaded_bytes": st3["uploaded_bytes"],
+            "restore_ms": restore_ms,
+            "restore_shard_ms": max((r["total_time_ms"]
+                                     for r in restore_recs),
+                                    default=None),
+            "restore_shards": len(restore_recs),
+            "restored_docs": restored["hits"]["total"]["value"],
+            "searches_during_snapshot": probes["ok"] + probes["failed"],
+            "searches_failed": probes["failed"],
+            "host_s": round(time.time() - t_host, 1),
+        }
+        for cn in cluster.values():
+            cn.stop()
+        return out
+
+
 # ---------------------------------------------------------------------------
 # Multi-chip serving rows (ISSUE 9): qps at 1/2/4/8 devices for the two
 # mesh serving modes — sharded-corpus (one SPMD fan-out/merge program per
@@ -2576,7 +2727,8 @@ def main():
              health=parts.get("health"),
              upgrade=parts.get("upgrade"),
              cursors=parts.get("cursors"),
-             tenants=parts.get("tenants"))
+             tenants=parts.get("tenants"),
+             snapshots=parts.get("snapshots"))
 
     # estpu-lint preflight: static contract scan of the whole package
     # (stdlib ast, ~2s, no device). Summary rides every BENCH line so
@@ -2669,6 +2821,14 @@ def main():
         parts["tenants"] = run_tenants_cpu()
     except Exception as e:  # noqa: BLE001 — the rider must not sink
         log(f"tenants rider failed: {e!r}")
+    # snapshot rows (deterministic sim, no jax): distributed snapshot
+    # wall-clock + bytes, the incremental pass's near-zero delta, and
+    # restore-through-staged-recovery timing — replay-stable virtual
+    # numbers
+    try:
+        parts["snapshots"] = run_snapshots_cpu()
+    except Exception as e:  # noqa: BLE001 — the rider must not sink
+        log(f"snapshots rider failed: {e!r}")
     # ALL CPU-side rows land before ANY jax/backend touch: a dead
     # relay hangs even backend INIT uninterruptibly (observed: hours),
     # and a run killed there must still have parsed output on record

@@ -34,6 +34,7 @@ Three reference subsystems, recast for this runtime:
 from __future__ import annotations
 
 import base64
+import json
 import os
 import uuid
 from dataclasses import dataclass, field
@@ -49,10 +50,12 @@ from elasticsearch_tpu.common.errors import (
     CircuitBreakingException,
     EsRejectedExecutionException,
     NoShardAvailableActionException,
+    ResourceNotFoundException,
     ShardNotInPrimaryModeException,
     is_backpressure_failure,
 )
 from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.repositories.blobstore import SnapshotException
 from elasticsearch_tpu.index.mapper import MapperService
 from elasticsearch_tpu.index.pressure import (
     IndexingPressure,
@@ -78,6 +81,9 @@ FINALIZE_RECOVERY = "internal:index/shard/recovery/finalize"
 SHARD_STARTED_ACTION = "internal:cluster/shard_state/started"
 SHARD_FAILED_ACTION = "internal:cluster/shard_state/failed"
 GLOBAL_CKP_SYNC = "internal:index/shard/global_checkpoint_sync"
+# distributed snapshot: the master fans one of these to each primary
+# (ref: SnapshotShardsService.startNewSnapshots)
+SNAPSHOT_SHARD = "internal:index/shard/snapshot"
 
 # wire version that understands the staged recovery protocol; older
 # peers negotiate down to the legacy single-RPC snapshot+ops form
@@ -239,7 +245,7 @@ class DataNodeService:
                  device_cache: Optional[DeviceSegmentCache] = None,
                  breaker_service=None,
                  indexing_pressure: Optional[IndexingPressure] = None,
-                 task_manager=None):
+                 task_manager=None, repositories=None):
         self.transport = transport
         self.scheduler = scheduler
         self.local_node: DiscoveryNode = transport.local_node
@@ -272,6 +278,13 @@ class DataNodeService:
                                  _RecoveryContext] = {}
         self._recovery_sources: Dict[Tuple[str, int, str],
                                      Dict[str, Any]] = {}
+        # snapshot plane: the node's RepositoriesService (shared config
+        # fanned out by the master) + live per-shard snapshot progress
+        # keyed (snap_uuid, index, shard_id) — watchdog-observable
+        # (bytes_uploaded fingerprints) and the _status live view
+        self.repositories = repositories
+        self.shard_snapshots: Dict[Tuple[str, str, int],
+                                   Dict[str, Any]] = {}
         # pinned reader contexts (scroll/PIT) keyed by ctx_id; ids are
         # per-node counters, NOT uuids — seeded chaos replays must be
         # byte-identical, and uuid4 in the cursor plane would fork them
@@ -293,6 +306,10 @@ class DataNodeService:
             (RECOVERY_ABORT, self._on_recovery_abort, False),
             (FINALIZE_RECOVERY, self._on_finalize_recovery, False),
             (GLOBAL_CKP_SYNC, self._on_global_ckp_sync, False),
+            # snapshot uploads must proceed on an overloaded node —
+            # durability is exactly what you want under duress; bytes
+            # are charged through the request breaker per file instead
+            (SNAPSHOT_SHARD, self._on_snapshot_shard, False),
         ]:
             transport.register_request_handler(action, handler,
                                                can_trip_breaker=can_trip)
@@ -358,6 +375,18 @@ class DataNodeService:
         shard = LocalShard(routing.index, routing.shard_id,
                            routing.allocation_id, routing.primary, engine)
         self.shards[shard.key] = shard
+        restore_source = (imd.settings or {}).get("index.restore_source")
+        if routing.primary and not routing.is_relocation_target \
+                and restore_source \
+                and not os.path.exists(os.path.join(engine.path,
+                                                    "segments.json")):
+            # restored index, no local commit yet: recover this primary
+            # FROM THE REPOSITORY through the staged recovery protocol
+            # (a restart after a completed restore finds the commit on
+            # disk and takes the normal local_store path below)
+            self._start_snapshot_recovery(state, shard, routing,
+                                          restore_source)
+            return
         if routing.primary and not routing.is_relocation_target:
             # primary: recover from local store (engine ctor replayed the
             # translog) → in-sync set bootstrap → started
@@ -989,12 +1018,16 @@ class DataNodeService:
             self.task_manager.unregister(ctx.task)
         # best-effort abort to the source: releases the retention lease
         # and drops the target from tracking promptly (state application
-        # prunes both anyway if this message is lost)
-        self.transport.send_request(
-            ctx.source_node, RECOVERY_ABORT,
-            {"index": rec.index, "shard_id": rec.shard_id,
-             "target_allocation_id": rec.allocation_id},
-            ResponseHandler(lambda r: None, lambda e: None), timeout=30.0)
+        # prunes both anyway if this message is lost). A snapshot
+        # recovery has no source node — the repository holds no
+        # per-target state to release.
+        if ctx.source_node is not None:
+            self.transport.send_request(
+                ctx.source_node, RECOVERY_ABORT,
+                {"index": rec.index, "shard_id": rec.shard_id,
+                 "target_allocation_id": rec.allocation_id},
+                ResponseHandler(lambda r: None, lambda e: None),
+                timeout=30.0)
         if notify_master:
             self.send_shard_failed(rec.index, rec.shard_id,
                                    rec.allocation_id,
@@ -1149,6 +1182,11 @@ class DataNodeService:
                     rec.hbm_segments += 1
                 except CircuitBreakingException:
                     rec.hbm_skipped_segments += 1
+        if ctx.rec.recovery_type == "snapshot":
+            # repository recovery has no live source to finalize with:
+            # activate the tracker locally and report started
+            self._finish_snapshot_recovery(ctx)
+            return
         self._recovery_finalize(ctx)
 
     def _recovery_finalize(self, ctx: _RecoveryContext) -> None:
@@ -1494,6 +1532,420 @@ class DataNodeService:
                 self.lease_transfers += len(pit_payload)
                 resp["pit_contexts"] = pit_payload
         channel.send_response(resp)
+
+    # ------------------------------------------------- shard snapshots
+    #
+    # One primary's slice of a distributed snapshot (ref:
+    # SnapshotShardsService): pin history under a snapshot/{uuid}
+    # retention lease, record the consistency point, capture the
+    # translog tail IN MEMORY (so a concurrent flush can't trim it out
+    # from under us), then upload the commit's segment files one per
+    # scheduler step — content-addressed (already-present blobs are
+    # skipped: incrementality), request-breaker-accounted, cancellable
+    # between files. Nothing here blocks writes: the engine keeps
+    # indexing while the upload walks immutable segment files.
+
+    def begin_shard_snapshot(self, shard: LocalShard, snap_uuid: str,
+                             snapshot: str) -> Dict[str, Any]:
+        """Acquire the shard-snapshot handle: the ``snapshot/{uuid}``
+        retention lease plus a watchdog-observable progress entry.
+        Every acquire MUST reach ``end_shard_snapshot`` or
+        ``abort_shard_snapshot`` on all paths (estpu-lint SNAPSHOT
+        pairing)."""
+        lease_id = f"snapshot/{snap_uuid}"
+        lease = shard.tracker.add_retention_lease(
+            lease_id, max(0, shard.tracker.global_checkpoint + 1),
+            source="snapshot")
+        handle = {
+            "key": (snap_uuid, shard.index, shard.shard_id),
+            "shard_key": shard.key,
+            "lease_id": lease_id,
+            "lease": lease,
+            "snapshot": snapshot,
+            "state": "STARTED",
+            "bytes_total": 0,
+            "bytes_uploaded": 0,
+            "bytes_skipped": 0,
+            "files_done": 0,
+        }
+        self.shard_snapshots[handle["key"]] = handle
+        return handle
+
+    def end_shard_snapshot(self, handle: Dict[str, Any]) -> None:
+        """Release the handle on success: drop the lease + progress."""
+        self.shard_snapshots.pop(handle["key"], None)
+        shard = self.shards.get(handle["shard_key"])
+        if shard is not None and shard.tracker is not None:
+            try:
+                shard.tracker.remove_retention_lease(handle["lease_id"])
+            except Exception:
+                pass  # tracker rebuilt (promotion) — lease already gone
+
+    def abort_shard_snapshot(self, handle: Dict[str, Any]) -> None:
+        """Release the handle on failure/cancel — same cleanup, kept
+        distinct so call sites (and the lint pairing) read honestly."""
+        self.end_shard_snapshot(handle)
+
+    def _on_snapshot_shard(self, req, channel, src) -> None:
+        """Master → primary: snapshot one shard into the repository.
+        Registers a cancellable child of the master's parent snapshot
+        task and a ``snapshot.shard`` span; responds with the shard
+        metadata the master merges into ``snap-{name}.json``."""
+        shard = self.shards.get((req["index"], req["shard_id"]))
+        if shard is None or not shard.primary or \
+                shard.state != "started" or shard.tracker is None:
+            channel.send_exception(NoShardAvailableActionException(
+                f"snapshot source for [{req['index']}][{req['shard_id']}]"
+                " is not an active primary"))
+            return
+        if self.repositories is None:
+            channel.send_exception(ResourceNotFoundException(
+                "no repositories service on this node"))
+            return
+        try:
+            repo = self.repositories.get_repository(req["repository"])
+        except Exception as e:  # noqa: BLE001 — typed 404 to caller
+            channel.send_exception(e)
+            return
+        child = self._register_child(
+            SNAPSHOT_SHARD,
+            f"snapshot [{req['snapshot']}] "
+            f"shard [{req['index']}][{req['shard_id']}]")
+        telemetry = getattr(self.transport, "telemetry", None)
+        tracer = telemetry.tracer if telemetry is not None else None
+        span = None
+        if tracer is not None:
+            span = tracer.start_span("snapshot.shard", tags={
+                "snapshot": req["snapshot"], "index": req["index"],
+                "shard": req["shard_id"], "repository": req["repository"]})
+        handle = self.begin_shard_snapshot(shard, req["snap_uuid"],
+                                           req["snapshot"])
+        engine = shard.engine
+        commit_path = os.path.join(engine.path, "segments.json")
+        if not os.path.exists(commit_path):
+            # first snapshot of a never-flushed shard: commit once so
+            # there is a file snapshot to take. Existing commits are
+            # reused as-is — that keeps segment blobs stable across
+            # snapshots (the incremental pin) and never stalls writes.
+            engine.flush()
+        with open(commit_path) as fh:
+            commit = json.load(fh)
+        # the consistency point: every op <= this seqno is in the
+        # snapshot (commit + captured translog tail); ops racing in
+        # after this line land in the NEXT snapshot
+        consistency_point = engine.tracker.checkpoint
+        ops = sorted(
+            (op.to_dict()
+             for op in engine.translog.read_ops(
+                 commit["translog_generation"])
+             if op.seq_no <= consistency_point
+             and op.op_type != "noop"),
+            key=lambda o: o["seq_no"])
+        queue: List[Tuple[str, str, str]] = []
+        for seg_name in commit.get("segments", []):
+            seg_dir = os.path.join(engine.path, seg_name)
+            if not os.path.isdir(seg_dir):
+                continue
+            for fname in sorted(os.listdir(seg_dir)):
+                queue.append((seg_name, fname,
+                              os.path.join(seg_dir, fname)))
+        st = {
+            "req": req, "repo": repo, "shard": shard, "handle": handle,
+            "channel": channel, "task": child, "span": span,
+            "commit": commit, "ops": ops,
+            "consistency_point": consistency_point,
+            "max_seq_no": engine.tracker.max_seq_no,
+            "queue": queue, "i": 0,
+            "segments": {s: {} for s in commit.get("segments", [])},
+            "new_blobs": [],
+        }
+        handle["bytes_total"] = sum(os.path.getsize(p)
+                                    for _, _, p in queue)
+        self.scheduler.schedule(
+            0.0, lambda: self._shard_snapshot_step(st),
+            f"snapshot-shard[{req['index']}][{req['shard_id']}]")
+
+    def _shard_snapshot_abort(self, st: Dict[str, Any],
+                              reason: str) -> None:
+        """Terminal failure/cancel exit: drop this shard's partial
+        uploads (unreferenced by construction — finalize never ran),
+        release lease/task/span, answer with the failure."""
+        handle = st["handle"]
+        handle["state"] = "ABORTED"
+        try:
+            st["repo"].delete_shard_blobs(
+                st["req"]["index"], st["req"]["shard_id"],
+                st["new_blobs"])
+        except Exception:
+            pass  # repo unreachable: master-side GC has the blob list
+        self.abort_shard_snapshot(handle)
+        if st["span"] is not None:
+            st["span"].finish(error=reason,
+                              bytes=handle["bytes_uploaded"])
+        if st["task"] is not None and self.task_manager is not None:
+            self.task_manager.unregister(st["task"])
+        st["channel"].send_exception(SnapshotException(
+            f"shard snapshot aborted: {reason}"))
+
+    def _charged_upload(self, repo, index: str, shard_id: int,
+                        content: bytes, label: str):
+        """Upload one blob with the bytes charged on the REQUEST
+        breaker for the duration (raises CircuitBreakingException
+        before any repo I/O if the node is under memory duress)."""
+        if self.breaker_service is None:
+            return repo.upload_shard_blob(index, shard_id, content)
+        breaker = self.breaker_service.get_breaker(CircuitBreaker.REQUEST)
+        breaker.add_estimate_bytes_and_maybe_break(len(content), label)
+        try:
+            return repo.upload_shard_blob(index, shard_id, content)
+        finally:
+            breaker.release(len(content))
+
+    def _shard_snapshot_step(self, st: Dict[str, Any]) -> None:
+        """Upload the next segment file (one per scheduler step: the
+        cancel poll and live writes interleave between files)."""
+        handle = st["handle"]
+        shard = st["shard"]
+        if self.shards.get(shard.key) is not shard:
+            self._shard_snapshot_abort(st, "shard closed mid-snapshot")
+            return
+        if st["task"] is not None and st["task"].is_cancelled():
+            self._shard_snapshot_abort(
+                st, "task cancelled "
+                    f"[{st['task'].cancellation_reason()}]")
+            return
+        if st["i"] < len(st["queue"]):
+            seg_name, fname, fpath = st["queue"][st["i"]]
+            st["i"] += 1
+            try:
+                with open(fpath, "rb") as fh:
+                    content = fh.read()
+            except OSError as e:
+                self._shard_snapshot_abort(st, f"read failed: {e}")
+                return
+            try:
+                result = self._charged_upload(
+                    st["repo"], st["req"]["index"], st["req"]["shard_id"],
+                    content, f"snapshot upload [{seg_name}/{fname}]")
+            except CircuitBreakingException as e:
+                self._shard_snapshot_abort(st, f"breaker: {e}")
+                return
+            except Exception as e:  # noqa: BLE001 — repo I/O failure
+                self._shard_snapshot_abort(st, f"upload failed: {e}")
+                return
+            st["segments"][seg_name][fname] = result["blob"]
+            if result["uploaded"]:
+                handle["bytes_uploaded"] += result["size"]
+                st["new_blobs"].append(result["blob"])
+            else:
+                handle["bytes_skipped"] += result["size"]
+            handle["files_done"] += 1
+            self.scheduler.schedule(
+                0.0, lambda: self._shard_snapshot_step(st),
+                f"snapshot-shard[{st['req']['index']}]"
+                f"[{st['req']['shard_id']}]")
+            return
+        self._shard_snapshot_finish(st)
+
+    def _shard_snapshot_finish(self, st: Dict[str, Any]) -> None:
+        """All segment files uploaded: persist the captured translog
+        tail as one content-addressed blob, then answer the master."""
+        handle = st["handle"]
+        translog_meta: Dict[str, Any] = {"blob": None,
+                                         "ops": len(st["ops"])}
+        if st["ops"]:
+            payload = json.dumps(st["ops"]).encode()
+            try:
+                result = self._charged_upload(
+                    st["repo"], st["req"]["index"], st["req"]["shard_id"],
+                    payload, "snapshot upload [translog]")
+            except Exception as e:  # noqa: BLE001 — repo I/O failure
+                self._shard_snapshot_abort(
+                    st, f"translog upload failed: {e}")
+                return
+            translog_meta["blob"] = result["blob"]
+            if result["uploaded"]:
+                handle["bytes_uploaded"] += result["size"]
+                st["new_blobs"].append(result["blob"])
+            else:
+                handle["bytes_skipped"] += result["size"]
+        handle["state"] = "SUCCESS"
+        self.end_shard_snapshot(handle)
+        if st["span"] is not None:
+            st["span"].finish(bytes=handle["bytes_uploaded"],
+                              skipped=handle["bytes_skipped"],
+                              ops=translog_meta["ops"])
+        if st["task"] is not None and self.task_manager is not None:
+            self.task_manager.unregister(st["task"])
+        st["channel"].send_response({
+            "segments": st["segments"],
+            "commit": st["commit"],
+            "translog": translog_meta,
+            "consistency_point": st["consistency_point"],
+            "max_seq_no": st["max_seq_no"],
+            "total_bytes": handle["bytes_total"],
+            "uploaded_bytes": handle["bytes_uploaded"],
+            "skipped_bytes": handle["bytes_skipped"],
+            "new_blobs": sorted(st["new_blobs"]),
+        })
+
+    # --------------------------------------------- snapshot recovery
+    #
+    # The restore path: a new recovery SOURCE riding the same staged
+    # target machine (index → translog → device → started), except the
+    # "source" is the repository — no peer RPCs, no source-side lease.
+
+    def _start_snapshot_recovery(self, state: ClusterState,
+                                 shard: LocalShard,
+                                 routing: ShardRouting,
+                                 restore_source: Dict[str, Any]) -> None:
+        rkey = (routing.index, routing.shard_id, routing.allocation_id)
+        repo_name = restore_source.get("repository", "?")
+        snap_name = restore_source.get("snapshot", "?")
+        rec = RecoveryState(
+            routing.index, routing.shard_id, routing.allocation_id,
+            source_node=f"_snapshot:{repo_name}/{snap_name}",
+            target_node=self.local_node.name,
+            recovery_type="snapshot",
+            protocol=STAGED_RECOVERY_VERSION,
+            start_time=self.scheduler.now())
+        self.recoveries[rkey] = rec
+        task = None
+        if self.task_manager is not None:
+            task = self.task_manager.register(
+                "transport", START_RECOVERY,
+                description=f"recovery [{routing.index}]"
+                            f"[{routing.shard_id}] snapshot from "
+                            f"{repo_name}/{snap_name}",
+                cancellable=True)
+            rec.task_id = task.id
+        telemetry = getattr(self.transport, "telemetry", None)
+        tracer = telemetry.tracer if telemetry is not None else None
+        span = None
+        if tracer is not None:
+            span = tracer.start_span("recovery", tags={
+                "index": routing.index, "shard": routing.shard_id,
+                "type": "snapshot", "source": rec.source_node,
+                "target": self.local_node.name})
+        ctx = _RecoveryContext(shard=shard, routing=routing,
+                               source_node=None, rec=rec,
+                               protocol=STAGED_RECOVERY_VERSION,
+                               task=task, tracer=tracer, span=span)
+        self._recovery_ctx[rkey] = ctx
+        self._enter_stage(ctx, "index")
+        # one scheduler hop: let the state-application batch finish
+        # before the blob downloads start (mirrors the RPC hop a peer
+        # recovery takes here)
+        self.scheduler.schedule(
+            0.0,
+            lambda: self._snapshot_recovery_install(ctx, restore_source),
+            f"snapshot-recovery[{routing.index}][{routing.shard_id}]")
+
+    def _snapshot_recovery_install(self, ctx: _RecoveryContext,
+                                   restore_source: Dict[str, Any]
+                                   ) -> None:
+        """Stage ``index``: download this shard's blobs, install them
+        under FRESH segment names (segment names key the node-wide
+        device cache — a restored copy must never alias live device
+        state), write the commit with a fresh translog generation, and
+        rebuild the engine. Then stage ``translog``: replay the
+        snapshot's captured op tail up to its consistency point."""
+        if self._recovery_cancelled(ctx):
+            return
+        rec = ctx.rec
+        try:
+            if self.repositories is None:
+                raise ResourceNotFoundException(
+                    "no repositories service on this node")
+            repo = self.repositories.get_repository(
+                restore_source["repository"])
+            snap = repo.get_snapshot(restore_source["snapshot"])
+            src_index = restore_source.get("source_index", rec.index)
+            idx_meta = snap["indices"][src_index]
+            shard_meta = idx_meta["shards"][rec.shard_id]
+            container = repo.shard_container(src_index, rec.shard_id)
+        except Exception as e:  # noqa: BLE001 — repo read failure
+            self._fail_recovery(ctx, f"snapshot read failed: {e}")
+            return
+        shard = ctx.shard
+        path = shard.engine.path
+        try:
+            shard.engine.close()
+        except Exception:
+            pass
+        nbytes = 0
+        try:
+            restore_prefix = uuid.uuid4().hex[:12]
+            name_map: Dict[str, str] = {}
+            for i, (seg_name, files) in enumerate(
+                    shard_meta["segments"].items()):
+                new_name = f"{restore_prefix}-r{i}"
+                name_map[seg_name] = new_name
+                seg_dir = os.path.join(path, new_name)
+                os.makedirs(seg_dir, exist_ok=True)
+                for fname, blob in files.items():
+                    content = container.read_blob(blob)
+                    if fname == "meta.json":
+                        meta = json.loads(content.decode())
+                        meta["name"] = new_name
+                        content = json.dumps(meta).encode()
+                    nbytes += len(content)
+                    with open(os.path.join(seg_dir, fname), "wb") as fh:
+                        fh.write(content)
+            commit = dict(shard_meta.get("commit") or {})
+            if commit:
+                commit["segments"] = [name_map[s]
+                                      for s in commit["segments"]]
+                # fresh translog generation: post-restore writes must
+                # never be skipped by a stale generation pointer
+                commit["translog_generation"] = 1
+                with open(os.path.join(path, "segments.json"),
+                          "w") as fh:
+                    json.dump(commit, fh)
+        except Exception as e:  # noqa: BLE001 — blob download failure
+            self._fail_recovery(ctx, f"segment install failed: {e}")
+            return
+        imd = self.applied_state.metadata.index(ctx.routing.index)
+        mapper = MapperService(Settings(imd.settings if imd else {}),
+                               (imd.mappings or None) if imd else None)
+        shard.engine = Engine(path, mapper)
+        rec.total_bytes = rec.recovered_bytes = nbytes
+        self._enter_stage(ctx, "translog")
+        if self._recovery_cancelled(ctx):
+            return
+        tl = shard_meta.get("translog") or {}
+        if tl.get("blob"):
+            try:
+                ops = json.loads(container.read_blob(tl["blob"]).decode())
+            except Exception as e:  # noqa: BLE001 — blob read failure
+                self._fail_recovery(ctx, f"translog blob failed: {e}")
+                return
+            for op_d in sorted(ops, key=lambda o: o["seq_no"]):
+                if shard.engine.tracker.contains(op_d["seq_no"]):
+                    continue  # already in the commit — idempotent
+                self._apply_replica_op(shard.engine, {
+                    "op": op_d["op"], "id": op_d.get("id"),
+                    "source": op_d.get("source"),
+                    "seq_no": op_d["seq_no"],
+                    "primary_term": op_d["primary_term"]})
+                rec.translog_ops_replayed += 1
+        self._recovery_device_upload(ctx)
+
+    def _finish_snapshot_recovery(self, ctx: _RecoveryContext) -> None:
+        """Stage ``finalize`` for a repository recovery: no source to
+        drain — activate a fresh ReplicationTracker at the restored
+        checkpoint and flip started (replicas then peer-recover from
+        this copy exactly as from any started primary)."""
+        self._enter_stage(ctx, "finalize")
+        if self._recovery_ctx.get(ctx.key) is not ctx:
+            return  # torn down while the device stage ran
+        shard = ctx.shard
+        shard.tracker = ReplicationTracker(
+            ctx.routing.allocation_id,
+            shard.engine.tracker.checkpoint,
+            clock=self.scheduler.now)
+        shard.global_checkpoint = shard.engine.tracker.checkpoint
+        self._finish_recovery(ctx)
 
     # ---------------------------------------------- global checkpoint sync
 

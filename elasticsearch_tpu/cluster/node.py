@@ -60,10 +60,17 @@ from elasticsearch_tpu.index.pressure import (
     IndexingPressure,
     operation_size_bytes,
 )
+from elasticsearch_tpu.repositories.blobstore import RepositoriesService
+from elasticsearch_tpu.snapshots.cluster import (
+    SNAPSHOT_SHARD_STATUS_ACTION,
+    ClusterSnapshotService,
+)
+from elasticsearch_tpu.snapshots.slm import SnapshotLifecycleService
 from elasticsearch_tpu.transport.tasks import (
     CancellableTask,
     TaskId,
     TaskManager,
+    TaskResultStore,
     build_tasks_response,
     node_task_slice,
     parse_bool_param,
@@ -105,6 +112,20 @@ FLIGHT_TRACE_ACTION = "cluster:monitor/flight_recorder/trace[n]"
 NODE_SHUTDOWN_PUT_ACTION = "cluster:admin/shutdown/put"
 NODE_SHUTDOWN_GET_ACTION = "cluster:admin/shutdown/get"
 NODE_SHUTDOWN_DELETE_ACTION = "cluster:admin/shutdown/delete"
+# snapshot plane: repository CRUD validates on the master then fans the
+# (absolutized) config to every node; snapshot create/get/delete/restore/
+# status route to the master, where the in-progress registry lives
+# (snapshots/cluster.py ClusterSnapshotService)
+REPOSITORY_PUT_ACTION = "cluster:admin/repository/put"
+REPOSITORY_DELETE_ACTION = "cluster:admin/repository/delete"
+REPOSITORY_PUT_NODE_ACTION = "cluster:admin/repository/put[n]"
+REPOSITORY_DELETE_NODE_ACTION = "cluster:admin/repository/delete[n]"
+SNAPSHOT_CREATE_ACTION = "cluster:admin/snapshot/create"
+SNAPSHOT_GET_ACTION = "cluster:admin/snapshot/get"
+SNAPSHOT_DELETE_ACTION = "cluster:admin/snapshot/delete"
+SNAPSHOT_RESTORE_ACTION = "cluster:admin/snapshot/restore"
+SNAPSHOT_STATUS_ACTION = "cluster:admin/snapshot/status"
+SLM_ACTION = "cluster:admin/slm"
 
 # coordinator-side bulk retry for TRANSIENT routing failures only (a
 # primary mid-handoff or a routing flip in progress): backpressure 429s
@@ -228,11 +249,17 @@ class ClusterNode:
         self.allocation = AllocationService(clock=scheduler.now)
         self._shutdown_timers = _ShutdownTimerRegistry(scheduler)
         self.routing = OperationRouting()
+        # shared snapshot repositories: a per-node registry whose config
+        # the master fans out, so every primary uploads its own shard
+        # files to the SAME store (the reference keeps this in cluster
+        # state; per-node registries + fan-out is our equivalent)
+        self.repositories = RepositoriesService(data_path)
         self.data_node = DataNodeService(
             transport, scheduler, data_path,
             breaker_service=self.breaker_service,
             indexing_pressure=self.indexing_pressure,
-            task_manager=self.task_manager)
+            task_manager=self.task_manager,
+            repositories=self.repositories)
         self.search_service = DistributedSearchService(
             transport, self.data_node, self.routing, scheduler=scheduler,
             telemetry=self.telemetry, task_manager=self.task_manager)
@@ -281,6 +308,28 @@ class ClusterNode:
             rng=rng,
             consistent_settings=consistent)
 
+        # async (`wait_for_completion=false`) admin results keyed by
+        # task id: `GET /_tasks/{id}` answers from here after the
+        # owning task unregistered
+        self.task_results = TaskResultStore()
+        # cluster snapshot/restore orchestration (master-gated handlers
+        # below route here) + SLM riding it on the scheduler clock:
+        # policies evaluate lazily (no recurring wall-clock trigger) and
+        # executions are real distributed snapshots
+        self.snapshots = ClusterSnapshotService(
+            transport, scheduler, self.task_manager, self.repositories,
+            state_fn=lambda: self.state,
+            submit_state_update=self.coordinator.submit_state_update,
+            allocation=self.allocation, local_node=self.local_node,
+            telemetry=self.telemetry,
+            broadcast_ban=self._broadcast_ban)
+        self.slm = SnapshotLifecycleService(
+            self.repositories, None, data_path, clock=scheduler.now,
+            snapshot_fn=lambda repo, name, indices, metadata, on_done:
+                self.snapshots.create(
+                    repo, name,
+                    {"indices": indices, "metadata": metadata}, on_done))
+
         # health & diagnostics: indicator catalog + stalled-progress
         # watchdog on the scheduler clock. Lazy by default (sweeps run
         # as part of each report) — periodic mode is opt-in via
@@ -294,6 +343,7 @@ class ClusterNode:
             clock=scheduler.now, metrics=self.telemetry.metrics,
             recoveries_fn=lambda: self.data_node.recoveries,
             tasks_fn=self.task_manager.list_tasks,
+            snapshots_fn=lambda: self.data_node.shard_snapshots,
             lag_fn=lambda: (self.coordinator.state_lag()
                             if self.is_master() else {}),
             stall_after_s=float(self.settings.get(
@@ -323,6 +373,19 @@ class ClusterNode:
             (NODE_SHUTDOWN_PUT_ACTION, self._on_put_shutdown),
             (NODE_SHUTDOWN_GET_ACTION, self._on_get_shutdown),
             (NODE_SHUTDOWN_DELETE_ACTION, self._on_delete_shutdown),
+            (REPOSITORY_PUT_ACTION, self._on_put_repository),
+            (REPOSITORY_DELETE_ACTION, self._on_delete_repository),
+            (REPOSITORY_PUT_NODE_ACTION, self._on_put_repository_node),
+            (REPOSITORY_DELETE_NODE_ACTION,
+             self._on_delete_repository_node),
+            (SNAPSHOT_CREATE_ACTION, self._on_create_snapshot),
+            (SNAPSHOT_GET_ACTION, self._on_get_snapshots),
+            (SNAPSHOT_DELETE_ACTION, self._on_delete_snapshot),
+            (SNAPSHOT_RESTORE_ACTION, self._on_restore_snapshot),
+            (SNAPSHOT_STATUS_ACTION, self._on_snapshot_status),
+            (SNAPSHOT_SHARD_STATUS_ACTION,
+             self._on_snapshot_shard_status),
+            (SLM_ACTION, self._on_slm),
         ]:
             # master/admin + monitoring actions never trip the inbound
             # breaker: shard-state reporting and stats are exactly what
@@ -631,6 +694,212 @@ class ClusterNode:
             f"delayed-allocation-timeout[{node_id}]",
             self.allocation.reroute)
 
+    # ------------------------------------------------- snapshot plane
+
+    @staticmethod
+    def _respond(channel) -> Callable:
+        """Adapt an ``on_done(resp, err)`` callback to a channel."""
+        def done(resp, err):
+            if err is not None:
+                channel.send_exception(
+                    err if isinstance(err, BaseException)
+                    else RuntimeError(str(err)))
+            else:
+                channel.send_response(resp)
+        return done
+
+    def _fan_repository_config(self, action: str, payload: Dict,
+                               channel) -> None:
+        """Repository config change, applied on EVERY node: the master
+        already validated/applied locally; fan the same payload to the
+        rest and ack when all answered (a node that misses it fails its
+        shard uploads with a typed error, reported per shard)."""
+        others = [n for n in self.state.nodes.nodes
+                  if n.node_id != self.local_node.node_id]
+        if not others:
+            channel.send_response({"acknowledged": True})
+            return
+        failures: List[str] = []
+        pending = {"n": len(others)}
+
+        def finish():
+            pending["n"] -= 1
+            if pending["n"] != 0:
+                return
+            resp: Dict[str, Any] = {"acknowledged": True}
+            if failures:
+                resp["node_failures"] = sorted(failures)
+            channel.send_response(resp)
+
+        for node in others:
+            def ok(resp, _nid=node.node_id):
+                finish()
+
+            def fail(exc, _nid=node.node_id):
+                failures.append(f"{_nid}: {exc}")
+                finish()
+
+            self.transport.send_request(node, action, payload,
+                                        ResponseHandler(ok, fail),
+                                        timeout=30.0)
+
+    def _on_put_repository(self, req, channel, src) -> None:
+        if not self._require_master(channel):
+            return
+        config = dict(req.get("config") or {})
+        settings = dict(config.get("settings") or {})
+        loc = settings.get("location")
+        if loc and not os.path.isabs(loc) and \
+                not loc.startswith("file:"):
+            # a relative location resolves against the MASTER's repo
+            # root and fans out ABSOLUTE — every node must read and
+            # write the same store, not a same-named path of its own
+            settings["location"] = os.path.join(self.data_path, "repos",
+                                                loc)
+            config["settings"] = settings
+        try:
+            self.repositories.put_repository(req["name"], config)
+        except Exception as e:  # noqa: BLE001 — typed 4xx to caller
+            channel.send_exception(e)
+            return
+        self._fan_repository_config(
+            REPOSITORY_PUT_NODE_ACTION,
+            {"name": req["name"], "config": config}, channel)
+
+    def _on_delete_repository(self, req, channel, src) -> None:
+        if not self._require_master(channel):
+            return
+        try:
+            self.repositories.delete_repository(req["name"])
+        except Exception as e:  # noqa: BLE001 — typed 404 to caller
+            channel.send_exception(e)
+            return
+        self._fan_repository_config(REPOSITORY_DELETE_NODE_ACTION,
+                                    {"name": req["name"]}, channel)
+
+    def _on_put_repository_node(self, req, channel, src) -> None:
+        try:
+            self.repositories.put_repository(req["name"], req["config"])
+        except Exception as e:  # noqa: BLE001 — typed 4xx to caller
+            channel.send_exception(e)
+            return
+        channel.send_response({"acknowledged": True})
+
+    def _on_delete_repository_node(self, req, channel, src) -> None:
+        try:
+            self.repositories.delete_repository(req["name"])
+        except Exception as e:  # noqa: BLE001 — typed 404 to caller
+            channel.send_exception(e)
+            return
+        channel.send_response({"acknowledged": True})
+
+    def _on_create_snapshot(self, req, channel, src) -> None:
+        if not self._require_master(channel):
+            return
+        wait = parse_bool_param(req.get("wait_for_completion"), True)
+        holder: Dict[str, Any] = {"accepted": False, "task": None,
+                                  "inline": None}
+
+        def done(resp, err):
+            if wait:
+                self._respond(channel)(resp, err)
+                return
+            if not holder["accepted"]:
+                # concluded before the accepted response went out
+                # (validation failure, or a fully synchronous run):
+                # nothing async remains — answer directly
+                holder["inline"] = (resp, err)
+                return
+            self.task_results.store(holder["task"], response=resp,
+                                    error=err)
+
+        tid = self.snapshots.create(req["repository"], req["snapshot"],
+                                    req.get("body"), done)
+        if wait:
+            return
+        holder["task"] = tid
+        if holder["inline"] is not None:
+            self._respond(channel)(*holder["inline"])
+            return
+        holder["accepted"] = True
+        channel.send_response({"accepted": True, "task": tid})
+
+    def _on_get_snapshots(self, req, channel, src) -> None:
+        if not self._require_master(channel):
+            return
+        try:
+            snaps = self.snapshots.list(req["repository"])
+            wanted = req.get("snapshot")
+            if wanted not in (None, "_all", "*"):
+                snaps = [s for s in snaps if s["snapshot"] == wanted]
+                if not snaps:
+                    from elasticsearch_tpu.repositories.blobstore import (
+                        SnapshotMissingException)
+                    raise SnapshotMissingException(
+                        f"[{req['repository']}:{wanted}] is missing")
+        except Exception as e:  # noqa: BLE001 — typed 404 to caller
+            channel.send_exception(e)
+            return
+        channel.send_response({"snapshots": snaps})
+
+    def _on_delete_snapshot(self, req, channel, src) -> None:
+        if not self._require_master(channel):
+            return
+        self.snapshots.delete(req["repository"], req["snapshot"],
+                              self._respond(channel))
+
+    def _on_restore_snapshot(self, req, channel, src) -> None:
+        if not self._require_master(channel):
+            return
+        self.snapshots.restore(req["repository"], req["snapshot"],
+                               req.get("body"), self._respond(channel))
+
+    def _on_snapshot_status(self, req, channel, src) -> None:
+        if not self._require_master(channel):
+            return
+        self.snapshots.status(req["repository"], req["snapshot"],
+                              self._respond(channel))
+
+    def _on_snapshot_shard_status(self, req, channel, src) -> None:
+        """This node's live shard-snapshot progress rows for one
+        in-flight snapshot (the `_status` fan-out slice)."""
+        rows = []
+        for (snap_uuid, index, shard_id), h in sorted(
+                self.data_node.shard_snapshots.items()):
+            if snap_uuid != req.get("snap_uuid"):
+                continue
+            rows.append({"index": index, "shard_id": shard_id,
+                         "node": self.local_node.node_id,
+                         "state": h["state"],
+                         "bytes_total": h["bytes_total"],
+                         "bytes_uploaded": h["bytes_uploaded"],
+                         "bytes_skipped": h["bytes_skipped"],
+                         "files_done": h["files_done"]})
+        channel.send_response({"shards": rows})
+
+    def _on_slm(self, req, channel, src) -> None:
+        if not self._require_master(channel):
+            return
+        op = req.get("op")
+        try:
+            if op == "put":
+                self.slm.put_policy(req["policy_id"],
+                                    req.get("policy") or {})
+                resp: Dict[str, Any] = {"acknowledged": True}
+            elif op == "get":
+                resp = self.slm.get_policies(req.get("policy_id"))
+            elif op == "delete":
+                self.slm.delete_policy(req["policy_id"])
+                resp = {"acknowledged": True}
+            elif op == "execute":
+                resp = self.slm.execute_policy(req["policy_id"])
+            else:
+                raise IllegalArgumentException(f"unknown slm op [{op}]")
+        except Exception as e:  # noqa: BLE001 — typed 4xx to caller
+            channel.send_exception(e)
+            return
+        channel.send_response(resp)
+
     @staticmethod
     def _ack(channel, err) -> None:
         if err is None:
@@ -808,11 +1077,18 @@ class ClusterNode:
         # wire default is detailed=True (get_task probes need the
         # description); the REST-facing default lives in list_tasks,
         # which always stamps `detailed` explicitly
-        channel.send_response(self._local_task_infos(
+        resp = self._local_task_infos(
             actions=req.get("actions"),
             parent_task_id=req.get("parent_task_id"),
             detailed=parse_bool_param(req.get("detailed"), True),
-            task_id=req.get("task_id")))
+            task_id=req.get("task_id"))
+        if req.get("task_id"):
+            # a completed async action (wait_for_completion=false) is no
+            # longer in the live table — its stored result rides along
+            stored = self.task_results.get(str(req["task_id"]))
+            if stored is not None:
+                resp["result"] = stored
+        channel.send_response(resp)
 
     def list_tasks(self, params: Optional[Dict[str, Any]] = None,
                    on_done: Callable = lambda r, e: None) -> None:
@@ -888,11 +1164,21 @@ class ClusterNode:
                 if t["id"] == tid.id:
                     on_done({"completed": False, "task": t}, None)
                     return
+            stored = info.get("result")
+            if stored is not None:
+                out = {"task": {"node": tid.node_id, "id": tid.id}}
+                out.update(stored)
+                on_done(out, None)
+                return
             on_done(None, ResourceNotFoundException(
                 f"task [{task_id}] is not found"))
 
         if tid.node_id in ("", self.local_node.node_id):
-            pick(self._local_task_infos(task_id=task_id), None)
+            info = self._local_task_infos(task_id=task_id)
+            stored = self.task_results.get(task_id)
+            if stored is not None:
+                info["result"] = stored
+            pick(info, None)
             return
         owner = self.state.nodes.get(tid.node_id)
         if owner is None:
@@ -1020,7 +1306,9 @@ class ClusterNode:
             engine_totals=_engine.TRACKER.totals(),
             watchdog=self.health_watchdog,
             flight=self.telemetry.flight,
-            tenants=self.telemetry.tenants)
+            tenants=self.telemetry.tenants,
+            repositories=self.repositories,
+            snapshots=self.snapshots)
 
     def _on_health_report(self, req, channel, src) -> None:
         from elasticsearch_tpu.health import UnknownIndicatorError
@@ -1440,3 +1728,82 @@ class ClusterNode:
                             on_done: Callable = lambda r, e: None
                             ) -> None:
         self.async_search.delete(search_id, on_done)
+
+    # --------------------------------------------- snapshot plane API
+
+    def put_repository(self, name: str, config: Dict[str, Any],
+                       on_done: Callable = lambda r, e: None) -> None:
+        """`PUT /_snapshot/{repo}` — master absolutizes a relative
+        location then fans the config to every node."""
+        self._to_master(REPOSITORY_PUT_ACTION,
+                        {"name": name, "config": config}, on_done)
+
+    def get_repositories(self,
+                         name: Optional[str] = None) -> Dict[str, Any]:
+        """`GET /_snapshot/{repo}` — any node answers from its own
+        registry (the master fanned the config at PUT time)."""
+        return self.repositories.get_configs(name)
+
+    def delete_repository(self, name: str,
+                          on_done: Callable = lambda r, e: None) -> None:
+        self._to_master(REPOSITORY_DELETE_ACTION, {"name": name},
+                        on_done)
+
+    def create_snapshot(self, repository: str, snapshot: str,
+                        body: Optional[Dict[str, Any]] = None,
+                        wait_for_completion: bool = True,
+                        on_done: Callable = lambda r, e: None) -> None:
+        """`PUT /_snapshot/{repo}/{snap}` — with
+        ``wait_for_completion=False`` the master answers
+        ``{"accepted": true, "task": "<node>:<id>"}`` immediately; the
+        task is visible in `_tasks` while running and its result is
+        served by ``get_task`` after completion."""
+        self._to_master(SNAPSHOT_CREATE_ACTION,
+                        {"repository": repository, "snapshot": snapshot,
+                         "body": body,
+                         "wait_for_completion": wait_for_completion},
+                        on_done)
+
+    def get_snapshots(self, repository: str,
+                      snapshot: Optional[str] = None,
+                      on_done: Callable = lambda r, e: None) -> None:
+        """`GET /_snapshot/{repo}/_all` (completed + in-flight)."""
+        self._to_master(SNAPSHOT_GET_ACTION,
+                        {"repository": repository, "snapshot": snapshot},
+                        on_done)
+
+    def delete_snapshot(self, repository: str, snapshot: str,
+                        on_done: Callable = lambda r, e: None) -> None:
+        """`DELETE /_snapshot/{repo}/{snap}` — deleting an IN-FLIGHT
+        snapshot cancels it cluster-wide."""
+        self._to_master(SNAPSHOT_DELETE_ACTION,
+                        {"repository": repository, "snapshot": snapshot},
+                        on_done)
+
+    def restore_snapshot(self, repository: str, snapshot: str,
+                         body: Optional[Dict[str, Any]] = None,
+                         on_done: Callable = lambda r, e: None) -> None:
+        """`POST /_snapshot/{repo}/{snap}/_restore` — re-creates the
+        indices with a restore marker; primaries recover FROM THE
+        REPOSITORY through the staged recovery protocol."""
+        self._to_master(SNAPSHOT_RESTORE_ACTION,
+                        {"repository": repository, "snapshot": snapshot,
+                         "body": body}, on_done)
+
+    def snapshot_status(self, repository: str, snapshot: str,
+                        on_done: Callable = lambda r, e: None) -> None:
+        """`GET /_snapshot/{repo}/{snap}/_status` — live per-shard
+        progress for in-flight snapshots, repository stats for
+        completed ones."""
+        self._to_master(SNAPSHOT_STATUS_ACTION,
+                        {"repository": repository, "snapshot": snapshot},
+                        on_done)
+
+    def slm_request(self, op: str, policy_id: Optional[str] = None,
+                    policy: Optional[Dict[str, Any]] = None,
+                    on_done: Callable = lambda r, e: None) -> None:
+        """SLM surface (`_slm/policy` CRUD + `_execute`), routed to the
+        master where the policy registry and scheduler clock live."""
+        self._to_master(SLM_ACTION,
+                        {"op": op, "policy_id": policy_id,
+                         "policy": policy}, on_done)

@@ -25,6 +25,7 @@ from elasticsearch_tpu.health.indicator import (  # noqa: F401
 from elasticsearch_tpu.health.indicators import (  # noqa: F401
     DEFAULT_INDICATORS,
     NodeShutdownIndicator,
+    RepositoryIntegrityIndicator,
     shard_availability_summary,
 )
 from elasticsearch_tpu.health.service import (  # noqa: F401

@@ -125,6 +125,8 @@ class HealthContext:
     watchdog: Any = None             # StalledProgressWatchdog
     flight: Any = None               # FlightRecorder (launch-path ring)
     tenants: Any = None              # TenantAccounting (per-tenant table)
+    repositories: Any = None         # RepositoriesService (snapshot repos)
+    snapshots: Any = None            # ClusterSnapshotService (in-flight)
 
 
 class HealthIndicator:

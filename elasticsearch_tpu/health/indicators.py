@@ -766,6 +766,95 @@ class NoisyNeighborIndicator(HealthIndicator):
             details=details, impacts=impacts, diagnoses=diagnoses)
 
 
+class RepositoryIntegrityIndicator(HealthIndicator):
+    """Snapshot repository integrity: RED on structural damage found by
+    ``verify_integrity()`` (generation mismatch, corrupted metadata,
+    missing/corrupted blobs), YELLOW on an in-flight shard snapshot the
+    watchdog says stopped uploading bytes. Nodes without a repositories
+    service (or with no repositories registered) are GREEN-trivially."""
+
+    name = "repository_integrity"
+
+    def compute(self, ctx: HealthContext) -> HealthIndicatorResult:
+        if ctx.repositories is None:
+            return HealthIndicatorResult(
+                name=self.name, status=HealthStatus.UNKNOWN,
+                symptom="no repositories service on this node",
+                details={})
+        problems: List[Dict[str, Any]] = []
+        repos = sorted(ctx.repositories.get_configs())
+        for repo_name in repos:
+            try:
+                repo = ctx.repositories.get_repository(repo_name)
+                for p in repo.verify_integrity():
+                    problems.append({"repository": repo_name, **p})
+            except Exception as exc:  # noqa: BLE001 — surfaced as RED
+                problems.append({
+                    "repository": repo_name, "kind": "unreadable",
+                    "resource": repo_name, "detail": str(exc)})
+        stalls = []
+        if ctx.watchdog is not None:
+            stalls = [f for f in ctx.watchdog.findings()
+                      if f.get("kind") == "snapshot"]
+        in_flight = []
+        if ctx.snapshots is not None:
+            in_flight = sorted(ctx.snapshots.in_progress)
+        details = {
+            "repositories": repos,
+            "problems": problems,
+            "in_flight": in_flight,
+            "stalled": [
+                {"resource": f["resource"],
+                 "stalled_for_s": f["stalled_for_s"]}
+                for f in stalls],
+        }
+        impacts: List[Impact] = []
+        diagnoses: List[Diagnosis] = []
+        if problems:
+            status = HealthStatus.RED
+            symptom = (f"{len(problems)} integrity problem(s) across "
+                       f"{len({p['repository'] for p in problems})} "
+                       "repository(ies)")
+            impacts.append(Impact(
+                id="repository_corruption", severity=1,
+                description="snapshots in a damaged repository may not "
+                            "restore; the disaster-recovery path is "
+                            "compromised",
+                impact_areas=["backup"]))
+            diagnoses.append(Diagnosis(
+                id="repository_integrity:corruption",
+                cause="repository metadata or blobs are missing, "
+                      "corrupted, or the generation pointer disagrees "
+                      "with index-N contents",
+                action="verify the backing storage, then re-register "
+                       "the repository and take a fresh snapshot",
+                affected_resources=sorted(
+                    f"{p['repository']}:{p.get('resource', '')}"
+                    for p in problems)))
+        elif stalls:
+            status = HealthStatus.YELLOW
+            symptom = (f"{len(stalls)} in-flight shard snapshot(s) "
+                       "stalled (no upload progress)")
+            diagnoses.append(Diagnosis(
+                id="repository_integrity:stalled_snapshot",
+                cause="a shard snapshot stopped uploading bytes for "
+                      "longer than the watchdog threshold",
+                action="check the holding data node; cancel the "
+                       "snapshot task to release leases and retry",
+                affected_resources=sorted(f["resource"] for f in stalls)))
+        elif in_flight:
+            status = HealthStatus.GREEN
+            symptom = (f"{len(in_flight)} snapshot(s) in progress, "
+                       "uploads advancing")
+        else:
+            status = HealthStatus.GREEN
+            symptom = ("repositories verified"
+                       if repos else "no repositories registered")
+        return HealthIndicatorResult(
+            name=self.name, status=status, symptom=symptom,
+            details=details, impacts=impacts, diagnoses=diagnoses)
+
+
 # the registry ESTPU-HEALTH01 pins: every HealthIndicator subclass in
 # health/ must appear here, or the linter flags the class definition
 DEFAULT_INDICATORS = (
@@ -778,4 +867,5 @@ DEFAULT_INDICATORS = (
     NodeShutdownIndicator,
     FlightRegimeIndicator,
     NoisyNeighborIndicator,
+    RepositoryIntegrityIndicator,
 )

@@ -39,6 +39,7 @@ DEFAULT_SWEEP_INTERVAL_S = 15.0
 KIND_RECOVERY = "recovery"
 KIND_TASK = "task"
 KIND_STATE_LAG = "cluster_state_lag"
+KIND_SNAPSHOT = "snapshot"
 
 
 class StalledProgressWatchdog:
@@ -47,6 +48,7 @@ class StalledProgressWatchdog:
                  recoveries_fn: Optional[Callable[[], Dict]] = None,
                  tasks_fn: Optional[Callable[[], List[Any]]] = None,
                  lag_fn: Optional[Callable[[], Dict[str, int]]] = None,
+                 snapshots_fn: Optional[Callable[[], Dict]] = None,
                  stall_after_s: float = DEFAULT_STALL_AFTER_S,
                  task_deadline_s: float = DEFAULT_TASK_DEADLINE_S):
         self.clock = clock
@@ -54,6 +56,7 @@ class StalledProgressWatchdog:
         self.recoveries_fn = recoveries_fn
         self.tasks_fn = tasks_fn
         self.lag_fn = lag_fn
+        self.snapshots_fn = snapshots_fn
         self.stall_after_s = stall_after_s
         self.task_deadline_s = task_deadline_s
         self._lock = threading.Lock()
@@ -93,6 +96,23 @@ class StalledProgressWatchdog:
                                          "running_s": running_s,
                                          "profile_stage": t.profile_stage,
                                      }))
+        if self.snapshots_fn is not None:
+            for handle in self.snapshots_fn().values():
+                if handle.get("state") != "STARTED":
+                    continue
+                snap_uuid, index, shard_id = handle["key"]
+                resource = f"{snap_uuid}:{index}[{shard_id}]"
+                # bytes-uploaded progress fingerprint: an in-flight shard
+                # snapshot whose upload counters stop moving is stalled
+                fp = (handle.get("bytes_uploaded", 0),
+                      handle.get("bytes_skipped", 0),
+                      handle.get("files_done", 0))
+                observations.append((KIND_SNAPSHOT, resource, fp, {
+                    "snapshot": handle.get("snapshot"),
+                    "bytes_uploaded": handle.get("bytes_uploaded", 0),
+                    "bytes_total": handle.get("bytes_total", 0),
+                    "files_done": handle.get("files_done", 0),
+                }))
         if self.lag_fn is not None:
             for node_id, lag in sorted((self.lag_fn() or {}).items()):
                 if lag <= 0:

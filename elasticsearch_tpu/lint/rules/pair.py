@@ -68,7 +68,16 @@ CURSOR = PairSpec(
     acquire_attrs=("open_pit", "open_reader_context"),
     release_attrs=("close_pit", "free_reader_context", "clear_scroll"),
 )
-SPECS = [BREAKER, TASK, SPAN, LEASE, SHUTDOWN, CURSOR]
+# shard snapshot handle: begin pins translog history under a retention
+# lease and registers the shard in the in-flight table — an exception
+# edge that skips end/abort leaks the lease (translog never trims) and
+# the watchdog tracks a ghost upload forever
+SNAPSHOT = PairSpec(
+    name="shard snapshot handle",
+    acquire_attrs=("begin_shard_snapshot",),
+    release_attrs=("end_shard_snapshot", "abort_shard_snapshot"),
+)
+SPECS = [BREAKER, TASK, SPAN, LEASE, SHUTDOWN, CURSOR, SNAPSHOT]
 
 # drain method shapes for PAIR02 ("finish" intentionally absent)
 _DRAIN_HINTS = ("close", "release", "stop", "shutdown", "clear",

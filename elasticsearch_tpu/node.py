@@ -152,7 +152,8 @@ class Node:
                 mesh_stats=_self.search_service.mesh_executor.stats(),
                 watchdog=_self.health_watchdog,
                 flight=_self.telemetry.flight,
-                tenants=_self.telemetry.tenants)
+                tenants=_self.telemetry.tenants,
+                repositories=_self.repositories_service)
 
         self.health = HealthService(context_fn=_health_context)
         # completed background-task responses (ref: the .tasks results

@@ -1,9 +1,15 @@
 from elasticsearch_tpu.repositories.blobstore import (
     BlobStoreRepository,
+    ConcurrentSnapshotExecutionException,
     FsBlobContainer,
     FsBlobStore,
     RepositoriesService,
+    RepositoryException,
+    SnapshotException,
+    SnapshotMissingException,
 )
 
-__all__ = ["BlobStoreRepository", "FsBlobContainer", "FsBlobStore",
-           "RepositoriesService"]
+__all__ = ["BlobStoreRepository", "ConcurrentSnapshotExecutionException",
+           "FsBlobContainer", "FsBlobStore", "RepositoriesService",
+           "RepositoryException", "SnapshotException",
+           "SnapshotMissingException"]

@@ -207,7 +207,9 @@ class BlobStoreRepository:
                 for shard_id, engine in enumerate(idx.shards):
                     container = self.blobstore.container(
                         "indices", idx.name, str(shard_id))
-                    shard_meta = {"segments": {}, "commit": None}
+                    shard_meta = {"segments": {}, "commit": None,
+                                  "total_bytes": 0, "uploaded_bytes": 0,
+                                  "skipped_bytes": 0}
                     commit_path = os.path.join(engine.path, "segments.json")
                     if os.path.exists(commit_path):
                         with open(commit_path) as fh:
@@ -224,9 +226,13 @@ class BlobStoreRepository:
                                 content = fh.read()
                             digest = hashlib.sha256(content).hexdigest()
                             blob = f"__{digest}"
+                            shard_meta["total_bytes"] += len(content)
                             if not container.blob_exists(blob):
                                 container.write_blob(blob, content)
                                 total_files += 1
+                                shard_meta["uploaded_bytes"] += len(content)
+                            else:
+                                shard_meta["skipped_bytes"] += len(content)
                             files[fname] = blob
                         shard_meta["segments"][seg_name] = files
                     shards.append(shard_meta)
@@ -260,6 +266,177 @@ class BlobStoreRepository:
             }
             self._write_repository_data(repo_data, repo_data["gen"])
             return info
+
+    # ---------------------------------------------- cluster snapshot plane
+    #
+    # The distributed snapshot path (snapshots/cluster.py) drives these
+    # primitives instead of ``snapshot()``: each primary uploads its own
+    # shard files (content-addressed, incremental), the master merges the
+    # reported shard metadata and commits it in one CAS'd generation bump.
+    # Until ``finalize_snapshot`` runs, nothing references the uploaded
+    # blobs, so an aborted snapshot leaves the repository readable at its
+    # prior generation and ``delete_shard_blobs`` reclaims the partials.
+
+    def shard_container(self, index_name: str,
+                        shard_id: int) -> FsBlobContainer:
+        return self.blobstore.container("indices", index_name, str(shard_id))
+
+    def upload_shard_blob(self, index_name: str, shard_id: int,
+                          content: bytes) -> Dict[str, Any]:
+        """Content-addressed single-blob upload. Returns the blob name
+        plus whether bytes actually moved (False = incremental skip)."""
+        if self.readonly:
+            raise RepositoryException(
+                f"repository [{self.name}] is readonly")
+        container = self.shard_container(index_name, shard_id)
+        blob = f"__{hashlib.sha256(content).hexdigest()}"
+        if container.blob_exists(blob):
+            return {"blob": blob, "uploaded": False, "size": len(content)}
+        container.write_blob(blob, content)
+        return {"blob": blob, "uploaded": True, "size": len(content)}
+
+    def delete_shard_blobs(self, index_name: str, shard_id: int,
+                           blob_names) -> int:
+        """Abort cleanup: drop blobs a cancelled/failed shard snapshot
+        uploaded. Only ever called pre-finalize, so the named blobs are
+        unreferenced by construction."""
+        container = self.shard_container(index_name, shard_id)
+        dropped = 0
+        for blob in sorted(set(blob_names)):
+            if container.blob_exists(blob):
+                container.delete_blob(blob)
+                dropped += 1
+        return dropped
+
+    def finalize_snapshot(self, snapshot_name: str, snap_uuid: str,
+                          snap_indices: Dict[str, Any], *,
+                          include_global_state: bool = True,
+                          metadata: Optional[Dict[str, Any]] = None,
+                          start_ms: int = 0, end_ms: int = 0,
+                          state: str = "SUCCESS",
+                          shard_stats: Optional[Dict[str, int]] = None,
+                          ) -> Dict[str, Any]:
+        """Commit a cluster snapshot: write ``snap-{name}.json`` then CAS
+        the repository generation. Timestamps come from the caller's
+        scheduler clock — this layer never reads a wall clock for the
+        cluster plane."""
+        if self.readonly:
+            raise RepositoryException(
+                f"repository [{self.name}] is readonly")
+        with self._lock:
+            repo_data = self.load_repository_data()
+            if snapshot_name in repo_data["snapshots"]:
+                raise ResourceAlreadyExistsException(
+                    f"snapshot [{snapshot_name}] already exists")
+            n_shards = sum(len(v["shards"]) for v in snap_indices.values())
+            stats = shard_stats or {}
+            info = {
+                "snapshot": snapshot_name,
+                "uuid": snap_uuid,
+                "state": state,
+                "indices": sorted(snap_indices),
+                "include_global_state": include_global_state,
+                "start_time_in_millis": int(start_ms),
+                "end_time_in_millis": int(end_ms),
+                "metadata": metadata or {},
+                "shards": {"total": n_shards,
+                           "failed": int(stats.get("failed", 0)),
+                           "successful": n_shards - int(
+                               stats.get("failed", 0))},
+            }
+            self.root.write_blob(
+                f"snap-{snapshot_name}.json",
+                json.dumps({"info": info, "indices": snap_indices}).encode())
+            repo_data["snapshots"][snapshot_name] = {
+                "uuid": snap_uuid, "state": state,
+                "indices": info["indices"],
+                "start_time_in_millis": int(start_ms),
+                "end_time_in_millis": int(end_ms),
+            }
+            self._write_repository_data(repo_data, repo_data["gen"])
+            return info
+
+    def snapshot_status(self, snapshot_name: str) -> Dict[str, Any]:
+        """Per-shard byte/file accounting for a COMPLETED snapshot
+        (``GET /_snapshot/{repo}/{snap}/_status``); in-flight status is
+        served from the master's in-progress registry instead."""
+        snap = self.get_snapshot(snapshot_name)
+        indices: Dict[str, Any] = {}
+        totals = {"total_bytes": 0, "uploaded_bytes": 0,
+                  "skipped_bytes": 0, "file_count": 0}
+        for index_name in sorted(snap["indices"]):
+            idx_meta = snap["indices"][index_name]
+            shards = {}
+            for shard_id, shard_meta in enumerate(idx_meta["shards"]):
+                row = {
+                    "stage": "DONE",
+                    "file_count": sum(len(files) for files in
+                                      shard_meta["segments"].values()),
+                    "total_bytes": int(shard_meta.get("total_bytes", 0)),
+                    "uploaded_bytes": int(
+                        shard_meta.get("uploaded_bytes", 0)),
+                    "skipped_bytes": int(shard_meta.get("skipped_bytes", 0)),
+                    "translog_ops": int(
+                        (shard_meta.get("translog") or {}).get("ops", 0)),
+                }
+                shards[str(shard_id)] = row
+                for k in totals:
+                    totals[k] += row.get(k, 0)
+            indices[index_name] = {"shards": shards}
+        return {"snapshot": snapshot_name,
+                "uuid": snap["info"].get("uuid"),
+                "state": snap["info"].get("state", "SUCCESS"),
+                "stats": totals,
+                "indices": indices}
+
+    def verify_integrity(self) -> List[Dict[str, str]]:
+        """Repository self-check feeding the ``repository_integrity``
+        health indicator. Returns sorted problem rows (empty = healthy):
+        generation pointer/metadata mismatches and missing referenced
+        blobs, each typed for the indicator's diagnosis."""
+        problems: List[Dict[str, str]] = []
+        gen = self._latest_gen()
+        if gen < 0:
+            return problems  # empty repo is healthy
+        if not self.root.blob_exists(f"index-{gen}"):
+            return [{"kind": "generation_mismatch",
+                     "resource": f"index-{gen}",
+                     "detail": "index.latest points at a missing "
+                               "generation blob"}]
+        try:
+            repo_data = self.load_repository_data()
+        except Exception as exc:  # noqa: BLE001 — diagnostic surface
+            return [{"kind": "corrupted_metadata",
+                     "resource": f"index-{gen}",
+                     "detail": f"unreadable repository data: {exc}"}]
+        for snap_name in sorted(repo_data["snapshots"]):
+            try:
+                snap = self.get_snapshot(snap_name)
+            except Exception as exc:  # noqa: BLE001 — diagnostic surface
+                problems.append({"kind": "corrupted_blob",
+                                 "resource": f"snap-{snap_name}.json",
+                                 "detail": str(exc)})
+                continue
+            for index_name in sorted(snap["indices"]):
+                idx_meta = snap["indices"][index_name]
+                for shard_id, shard_meta in enumerate(idx_meta["shards"]):
+                    container = self.shard_container(index_name, shard_id)
+                    refs = set()
+                    for files in shard_meta["segments"].values():
+                        refs.update(files.values())
+                    tl = shard_meta.get("translog") or {}
+                    if tl.get("blob"):
+                        refs.add(tl["blob"])
+                    for blob in sorted(refs):
+                        if not container.blob_exists(blob):
+                            problems.append({
+                                "kind": "missing_blob",
+                                "resource": (f"{snap_name}/{index_name}/"
+                                             f"{shard_id}/{blob}"),
+                                "detail": "referenced blob absent from "
+                                          "shard container"})
+        return sorted(problems,
+                      key=lambda p: (p["kind"], p["resource"]))
 
     def get_snapshot(self, snapshot_name: str) -> Dict[str, Any]:
         if not self.root.blob_exists(f"snap-{snapshot_name}.json"):
@@ -299,6 +476,10 @@ class BlobStoreRepository:
                     refs = referenced.setdefault(key, set())
                     for files in shard_meta["segments"].values():
                         refs.update(files.values())
+                    # cluster snapshots pin a translog-ops blob per shard
+                    tl = shard_meta.get("translog") or {}
+                    if tl.get("blob"):
+                        refs.add(tl["blob"])
         indices_dir = os.path.join(self.location, "indices")
         if not os.path.isdir(indices_dir):
             return

@@ -313,6 +313,7 @@ def _register_all(c: RestController):
     c.register("DELETE", "/_snapshot/{repo}", delete_repository)
     c.register("PUT", "/_snapshot/{repo}/{snap}", create_snapshot)
     c.register("POST", "/_snapshot/{repo}/{snap}", create_snapshot)
+    c.register("GET", "/_snapshot/{repo}/{snap}/_status", snapshot_status)
     c.register("GET", "/_snapshot/{repo}/{snap}", get_snapshot)
     c.register("DELETE", "/_snapshot/{repo}/{snap}", delete_snapshot)
     c.register("POST", "/_snapshot/{repo}/{snap}/_restore", restore_snapshot)
@@ -2265,6 +2266,7 @@ def delete_repository(node, params, body, repo):
 
 
 def create_snapshot(node, params, body, repo, snap):
+    import threading
     body = body or {}
     r = node.repositories_service.get_repository(repo)
     index_expr = body.get("indices", "_all")
@@ -2272,12 +2274,35 @@ def create_snapshot(node, params, body, repo, snap):
         index_expr = ",".join(index_expr)
     names = node.indices_service.resolve(index_expr)
     indices = [node.indices_service.get(n) for n in names]
-    info = r.snapshot(snap, indices,
-                      include_global_state=body.get("include_global_state",
-                                                    True),
-                      metadata=body.get("metadata"))
-    # synchronous execution — wait_for_completion always holds here
-    return 200, {"snapshot": info}
+
+    def run():
+        info = r.snapshot(
+            snap, indices,
+            include_global_state=body.get("include_global_state", True),
+            metadata=body.get("metadata"))
+        return {"snapshot": info}
+
+    if params.get("wait_for_completion") == "false":
+        # accepted-now, result via GET /_tasks/{id} (same contract as
+        # the reindex family and the cluster snapshot surface)
+        task = node.task_manager.register(
+            "transport", "cluster:admin/snapshot/create", cancellable=True)
+
+        def runner():
+            try:
+                _store_task_result(node, task.id, run())
+            except ElasticsearchTpuException as e:
+                _store_task_result(node, task.id, {"error": e.to_xcontent()})
+            except Exception as e:  # never lose a background failure
+                _store_task_result(node, task.id, {"error": {
+                    "type": type(e).__name__, "reason": str(e)}})
+            finally:
+                node.task_manager.unregister(task)
+
+        threading.Thread(target=runner, daemon=True).start()
+        return 200, {"accepted": True,
+                     "task": f"{node.node_id}:{task.id}"}
+    return 200, run()
 
 
 def get_snapshot(node, params, body, repo, snap):
@@ -2295,6 +2320,13 @@ def delete_snapshot(node, params, body, repo, snap):
     for name in snap.split(","):
         r.delete_snapshot(name)
     return 200, {"acknowledged": True}
+
+
+def snapshot_status(node, params, body, repo, snap):
+    """ref: RestSnapshotsStatusAction — per-shard stage + byte stats."""
+    r = node.repositories_service.get_repository(repo)
+    return 200, {"snapshots": [r.snapshot_status(name)
+                               for name in snap.split(",")]}
 
 
 def restore_snapshot(node, params, body, repo, snap):
@@ -3485,19 +3517,6 @@ def cat_thread_pool(node, params, body):
     return 200, {"_cat": "\n".join(rows)}
 
 
-def cat_snapshots(node, params, body, repo):
-    """ref: RestSnapshotAction — id status start/end times per snapshot."""
-    r = node.repositories_service.get_repository(repo)
-    rows = []
-    for name, meta in sorted(r.load_repository_data()
-                             .get("snapshots", {}).items()):
-        rows.append(f"{name} {meta.get('state', 'SUCCESS')} "
-                    f"{meta.get('start_time', '-')} "
-                    f"{meta.get('end_time', '-')} "
-                    f"{len(meta.get('indices', []))}")
-    return 200, {"_cat": "\n".join(rows)}
-
-
 def cat_ml_jobs(node, params, body):
     rows = []
     for job_id, job in sorted(node.ml_service.jobs.items()):
@@ -3661,11 +3680,22 @@ def cat_repositories(node, params, body):
 
 
 def cat_snapshots(node, params, body, repo):
+    """ref: RestSnapshotAction default columns: id status start_epoch
+    end_epoch duration indices successful_shards failed_shards
+    total_shards (the repository is the path param, not a column)."""
     r = node.repositories_service.get_repository(repo)
     lines = []
     for s in r.list_snapshots():
-        lines.append(f"{s['snapshot']} SUCCESS "
-                     f"{len(s.get('indices', []))}")
+        start = s.get("start_time_in_millis", 0)
+        end = s.get("end_time_in_millis", 0)
+        duration_s = max(0, end - start) // 1000 if end else 0
+        shards = s.get("shards", {}) or {}
+        lines.append(
+            f"{s['snapshot']} {s.get('state', 'SUCCESS')} "
+            f"{start // 1000} {end // 1000} {duration_s}s "
+            f"{len(s.get('indices', []))} "
+            f"{shards.get('successful', 0)} {shards.get('failed', 0)} "
+            f"{shards.get('total', 0)}")
     return 200, {"_cat": "\n".join(lines)}
 
 

@@ -1,3 +1,4 @@
+from elasticsearch_tpu.snapshots.cluster import ClusterSnapshotService
 from elasticsearch_tpu.snapshots.slm import SnapshotLifecycleService
 
-__all__ = ["SnapshotLifecycleService"]
+__all__ = ["ClusterSnapshotService", "SnapshotLifecycleService"]

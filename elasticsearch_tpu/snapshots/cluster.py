@@ -1,0 +1,477 @@
+"""Cluster-wide snapshot/restore orchestration (master side).
+
+ref: snapshots/SnapshotsService.java — the elected master coordinates a
+distributed snapshot: one cancellable parent task, one SNAPSHOT_SHARD
+RPC per primary (each primary pins history under a ``snapshot/{uuid}``
+retention lease and uploads its commit incrementally, data_node.py), and
+a single CAS'd ``finalize_snapshot`` commit once every shard reports.
+Until that commit the uploaded blobs are unreferenced: a cancel, a node
+death, or a DELETE of the in-flight snapshot leaves the repository
+readable at its prior generation and the partial uploads reclaimed.
+
+Restore (ref: snapshots/RestoreService.java) is a cluster-state update:
+re-create each index with an ``index.restore_source`` settings marker and
+let allocation place the primaries; each data node sees the marker on an
+empty shard and recovers FROM THE REPOSITORY through the staged recovery
+protocol (data_node._start_snapshot_recovery) — which is exactly how a
+freshly booted cluster with wiped data dirs survives full-cluster loss.
+"""
+
+from __future__ import annotations
+
+import re
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.cluster.allocation import create_index_state
+from elasticsearch_tpu.cluster.data_node import SNAPSHOT_SHARD
+from elasticsearch_tpu.cluster.routing import OperationRouting, ShardId
+from elasticsearch_tpu.common.errors import (
+    IllegalArgumentException,
+    ResourceAlreadyExistsException,
+    ResourceNotFoundException,
+)
+from elasticsearch_tpu.repositories.blobstore import (
+    ConcurrentSnapshotExecutionException,
+    SnapshotException,
+)
+from elasticsearch_tpu.transport.tasks import TaskId
+from elasticsearch_tpu.transport.transport import ResponseHandler
+
+# master-side action names (what `_tasks` shows for a running snapshot)
+SNAPSHOT_CREATE_ACTION = "cluster:admin/snapshot/create"
+# per-node live shard-snapshot progress slice (the `_status` fan-out)
+SNAPSHOT_SHARD_STATUS_ACTION = "cluster:monitor/snapshot/status[n]"
+
+
+def _matches(patterns: List[str], name: str) -> bool:
+    import fnmatch
+    return any(fnmatch.fnmatch(name, p) for p in patterns)
+
+
+class ClusterSnapshotService:
+    """Master-side create/delete/restore/status over the shared
+    BlobStoreRepository. Constructed on every node; only the elected
+    master's handlers route here (node.py ``_require_master``)."""
+
+    def __init__(self, transport, scheduler, task_manager, repositories,
+                 state_fn: Callable[[], Any],
+                 submit_state_update: Callable[..., None],
+                 allocation, local_node, telemetry=None,
+                 broadcast_ban: Optional[Callable[..., None]] = None):
+        self.transport = transport
+        self.scheduler = scheduler
+        self.task_manager = task_manager
+        self.repositories = repositories
+        self.state_fn = state_fn
+        self.submit_state_update = submit_state_update
+        self.allocation = allocation
+        self.local_node = local_node
+        self.telemetry = telemetry
+        self.broadcast_ban = broadcast_ban or (lambda *a, **k: None)
+        self.routing = OperationRouting()
+        # in-flight snapshots keyed by name: the master's live registry
+        # behind `_status`, `_cat/snapshots` and concurrent-create checks
+        self.in_progress: Dict[str, Dict[str, Any]] = {}
+
+    # ------------------------------------------------------------- create
+
+    def _resolve_indices(self, state, expr) -> List[str]:
+        all_names = sorted(state.metadata.indices)
+        if expr in (None, "*", "_all", ""):
+            return all_names
+        if isinstance(expr, str):
+            expr = [p.strip() for p in expr.split(",") if p.strip()]
+        out: List[str] = []
+        for pat in expr:
+            if any(c in pat for c in "*?"):
+                out.extend(n for n in all_names if _matches([pat], n))
+            elif pat in all_names:
+                out.append(pat)
+            else:
+                raise ResourceNotFoundException(f"no such index [{pat}]")
+        return sorted(set(out))
+
+    @staticmethod
+    def _validate_name(snapshot: str) -> None:
+        if not snapshot or snapshot != snapshot.lower() or \
+                any(c in snapshot for c in " ,*?\"<>|\\/"):
+            raise IllegalArgumentException(
+                f"invalid snapshot name [{snapshot}]: must be lowercase "
+                "and must not contain whitespace or wildcards")
+
+    def create(self, repository: str, snapshot: str,
+               body: Optional[Dict[str, Any]],
+               on_done: Callable = lambda r, e: None) -> Optional[str]:
+        """Start a distributed snapshot; returns the parent task id (for
+        ``wait_for_completion=false``) or None when validation failed
+        before a task was registered. ``on_done`` fires once with the
+        finalized info or the failure either way."""
+        body = body or {}
+        try:
+            repo = self.repositories.get_repository(repository)
+            self._validate_name(snapshot)
+            if snapshot in self.in_progress:
+                raise ConcurrentSnapshotExecutionException(
+                    f"snapshot [{snapshot}] is already running")
+            if snapshot in repo.load_repository_data()["snapshots"]:
+                raise ResourceAlreadyExistsException(
+                    f"snapshot [{snapshot}] already exists in "
+                    f"repository [{repository}]")
+            state = self.state_fn()
+            indices = self._resolve_indices(state, body.get("indices"))
+            if not indices:
+                raise SnapshotException(
+                    f"snapshot [{snapshot}] matched no indices")
+        except Exception as e:  # noqa: BLE001 — typed 4xx/5xx to caller
+            on_done(None, e)
+            return None
+
+        snap_uuid = uuid.uuid4().hex[:20]
+        task = self.task_manager.register(
+            "transport", SNAPSHOT_CREATE_ACTION,
+            description=f"snapshot [{repository}:{snapshot}], "
+                        f"indices{indices}",
+            cancellable=True)
+        task_id = str(TaskId(self.local_node.node_id, task.id))
+        tracer = self.telemetry.tracer if self.telemetry else None
+        span = tracer.start_span("snapshot.create", tags={
+            "repository": repository, "snapshot": snapshot,
+            "uuid": snap_uuid}) if tracer else None
+        targets: List[Tuple[str, int]] = []
+        for ix in indices:
+            imd = state.metadata.index(ix)
+            targets.extend((ix, sid)
+                           for sid in range(imd.number_of_shards))
+        entry = {
+            "snapshot": snapshot, "uuid": snap_uuid,
+            "repository": repository, "state": "STARTED",
+            "indices": indices,
+            "start_ms": int(self.scheduler.now() * 1000),
+            "task_id": task_id,
+            "shards": {"total": len(targets), "done": 0, "failed": 0},
+            "failures": [],
+        }
+        self.in_progress[snapshot] = entry
+        shard_metas: Dict[Tuple[str, int], Dict[str, Any]] = {}
+        pending = {"n": len(targets)}
+
+        def cleanup_partials():
+            # drop the new blobs of shards that DID finish (aborted
+            # shards already reclaimed their own, data_node.py); without
+            # a finalize nothing references them, so the repository
+            # stays readable at its prior generation
+            for (ix, sid) in sorted(shard_metas):
+                try:
+                    repo.delete_shard_blobs(
+                        ix, sid, shard_metas[(ix, sid)].get(
+                            "new_blobs") or [])
+                except Exception:
+                    pass  # unreachable repo: delete_snapshot GC catches up
+
+        def conclude(result, err):
+            self.in_progress.pop(snapshot, None)
+            was_cancelled = task.is_cancelled()
+            self.task_manager.unregister(task)
+            if was_cancelled:
+                # deferred ban sweep (same ordering rationale as the
+                # bulk coordinator's)
+                tid = TaskId.parse(task_id)
+                self.scheduler.schedule(
+                    1.0, lambda: self.broadcast_ban(tid, "done",
+                                                    remove=True),
+                    f"sweep task bans [{tid}]")
+            if span is not None:
+                span.finish(state=entry["state"],
+                            shards_done=entry["shards"]["done"],
+                            shards_failed=entry["shards"]["failed"])
+            on_done(result, err)
+
+        def finish():
+            pending["n"] -= 1
+            if pending["n"] != 0:
+                return
+            if task.is_cancelled() or entry["failures"]:
+                entry["state"] = "FAILED"
+                cleanup_partials()
+                reason = ("cancelled ["
+                          f"{task.cancellation_reason()}]"
+                          if task.is_cancelled()
+                          else "; ".join(entry["failures"]))
+                conclude(None, SnapshotException(
+                    f"snapshot [{snapshot}] failed: {reason}"))
+                return
+            snap_indices: Dict[str, Any] = {}
+            for ix in indices:
+                imd = state.metadata.index(ix)
+                settings = dict(imd.settings or {})
+                # a snapshot of a restored index must not re-carry the
+                # old restore marker into its own future restores
+                settings.pop("index.restore_source", None)
+                snap_indices[ix] = {
+                    "settings": settings,
+                    "mappings": imd.mappings,
+                    "number_of_shards": imd.number_of_shards,
+                    "number_of_replicas": imd.number_of_replicas,
+                    "shards": [
+                        {k: v for k, v in
+                         shard_metas[(ix, sid)].items()
+                         if k != "new_blobs"}
+                        for sid in range(imd.number_of_shards)],
+                }
+            try:
+                info = repo.finalize_snapshot(
+                    snapshot, snap_uuid, snap_indices,
+                    include_global_state=bool(
+                        body.get("include_global_state", True)),
+                    metadata=body.get("metadata"),
+                    start_ms=entry["start_ms"],
+                    end_ms=int(self.scheduler.now() * 1000),
+                    shard_stats={"failed": 0})
+            except Exception as e:  # noqa: BLE001 — CAS/write failure
+                entry["state"] = "FAILED"
+                cleanup_partials()
+                conclude(None, e)
+                return
+            entry["state"] = "SUCCESS"
+            conclude({"snapshot": info}, None)
+
+        if not targets:
+            # defensive: indices resolved but carry zero shards
+            self.scheduler.schedule(0.0, finish, f"snapshot[{snapshot}]")
+            pending["n"] = 1
+            return task_id
+
+        from elasticsearch_tpu.telemetry import context as _telectx
+        for ix, sid in targets:
+            primary = self.routing.primary_shard(state, ShardId(ix, sid))
+            node = (state.nodes.get(primary.current_node_id)
+                    if primary is not None else None)
+            if node is None:
+                entry["failures"].append(
+                    f"[{ix}][{sid}]: no active primary")
+                entry["shards"]["failed"] += 1
+                finish()
+                continue
+
+            def ok(resp, _key=(ix, sid)):
+                shard_metas[_key] = resp
+                entry["shards"]["done"] += 1
+                finish()
+
+            def fail(exc, _key=(ix, sid)):
+                entry["failures"].append(f"[{_key[0]}][{_key[1]}]: "
+                                         f"{exc}")
+                entry["shards"]["failed"] += 1
+                finish()
+
+            with _telectx.activate_task(self.local_node.node_id, task):
+                # the ambient task rides the __headers carrier: each
+                # primary registers its shard upload as a child, so a
+                # cancel (or a DELETE of this snapshot) reaches them
+                self.transport.send_request(
+                    node, SNAPSHOT_SHARD,
+                    {"repository": repository, "snapshot": snapshot,
+                     "snap_uuid": snap_uuid, "index": ix,
+                     "shard_id": sid},
+                    ResponseHandler(ok, fail), timeout=120.0)
+        return task_id
+
+    # ------------------------------------------------------------- delete
+
+    def delete(self, repository: str, snapshot: str,
+               on_done: Callable = lambda r, e: None) -> None:
+        """DELETE of a completed snapshot removes it (generation CAS +
+        blob GC); DELETE of an IN-FLIGHT snapshot cancels it cluster-wide
+        — the create path's conclusion releases leases/blobs/tasks."""
+        entry = self.in_progress.get(snapshot)
+        if entry is not None and entry["repository"] == repository:
+            tid = TaskId.parse(entry["task_id"])
+            task = self.task_manager.get_task(tid.id)
+            if task is not None:
+                # ban broadcast FIRST, local cancel second (same
+                # ordering as node._cancel_local): the bans must be on
+                # the wire before listeners can schedule their sweep
+                self.broadcast_ban(tid, f"snapshot [{snapshot}] deleted")
+                self.task_manager.cancel(
+                    task, f"snapshot [{snapshot}] deleted")
+            on_done({"acknowledged": True}, None)
+            return
+        try:
+            self.repositories.get_repository(repository).delete_snapshot(
+                snapshot)
+        except Exception as e:  # noqa: BLE001 — typed 404/503 to caller
+            on_done(None, e)
+            return
+        on_done({"acknowledged": True}, None)
+
+    # ------------------------------------------------------------ restore
+
+    def restore(self, repository: str, snapshot: str,
+                body: Optional[Dict[str, Any]],
+                on_done: Callable = lambda r, e: None) -> None:
+        body = body or {}
+        try:
+            repo = self.repositories.get_repository(repository)
+            snap = repo.get_snapshot(snapshot)
+            wanted = body.get("indices")
+            if wanted in (None, "*", "_all", ""):
+                sources = sorted(snap["indices"])
+            else:
+                if isinstance(wanted, str):
+                    wanted = [p.strip() for p in wanted.split(",")
+                              if p.strip()]
+                missing = [w for w in wanted if w not in snap["indices"]]
+                if missing:
+                    raise IllegalArgumentException(
+                        f"indices {missing} not found in snapshot "
+                        f"[{snapshot}]")
+                sources = sorted(wanted)
+            pattern = body.get("rename_pattern")
+            replacement = body.get("rename_replacement")
+            state = self.state_fn()
+            plans = []
+            for src in sources:
+                meta = snap["indices"][src]
+                if not isinstance(meta.get("shards"), list) or any(
+                        "commit" not in sm for sm in meta["shards"]):
+                    raise SnapshotException(
+                        f"index [{src}] in snapshot [{snapshot}] was not "
+                        "written by the cluster snapshot path and cannot "
+                        "be restored into a cluster")
+                target = (re.sub(pattern, replacement, src)
+                          if pattern and replacement is not None else src)
+                if state.metadata.index(target) is not None:
+                    raise ResourceAlreadyExistsException(
+                        f"cannot restore index [{target}]: already "
+                        "exists")
+                settings = dict(meta.get("settings") or {})
+                settings["index.restore_source"] = {
+                    "repository": repository, "snapshot": snapshot,
+                    "source_index": src}
+                plans.append((
+                    target,
+                    int(meta.get("number_of_shards",
+                                 len(meta["shards"]))),
+                    int(body.get("number_of_replicas",
+                                 meta.get("number_of_replicas", 0))),
+                    settings, meta.get("mappings")))
+        except Exception as e:  # noqa: BLE001 — typed 4xx to caller
+            on_done(None, e)
+            return
+        total_shards = sum(p[1] for p in plans)
+
+        def fn(s):
+            for target, nshards, nreplicas, settings, mappings in plans:
+                s = create_index_state(
+                    s, self.allocation, target,
+                    number_of_shards=nshards,
+                    number_of_replicas=nreplicas,
+                    settings=settings, mappings=mappings)
+            return s
+
+        def done(err):
+            if err is not None:
+                on_done(None, err if isinstance(err, BaseException)
+                        else RuntimeError(str(err)))
+                return
+            on_done({"accepted": True,
+                     "snapshot": {"snapshot": snapshot,
+                                  "indices": [p[0] for p in plans],
+                                  "shards": {"total": total_shards,
+                                             "failed": 0,
+                                             "successful": total_shards}}},
+                    None)
+
+        self.submit_state_update(
+            f"restore-snapshot[{repository}:{snapshot}]", fn, on_done=done)
+
+    # ------------------------------------------------------------- status
+
+    def status(self, repository: str, snapshot: str,
+               on_done: Callable = lambda r, e: None) -> None:
+        """``GET /_snapshot/{repo}/{snap}/_status``: a completed snapshot
+        reads its stats from the repository; an in-flight one fans out to
+        the data nodes for their LIVE per-shard progress rows (bytes
+        uploaded so far — the same fingerprint the stall watchdog
+        observes)."""
+        entry = self.in_progress.get(snapshot)
+        if entry is None or entry["repository"] != repository:
+            try:
+                status = self.repositories.get_repository(
+                    repository).snapshot_status(snapshot)
+            except Exception as e:  # noqa: BLE001 — typed 404 to caller
+                on_done(None, e)
+                return
+            on_done(status, None)
+            return
+        state = self.state_fn()
+        nodes = state.nodes.data_nodes()
+        rows: List[Dict[str, Any]] = []
+        pending = {"n": len(nodes)}
+
+        def finish():
+            pending["n"] -= 1
+            if pending["n"] != 0:
+                return
+            indices: Dict[str, Any] = {}
+            totals = {"total_bytes": 0, "uploaded_bytes": 0,
+                      "skipped_bytes": 0, "file_count": 0}
+            for row in sorted(rows, key=lambda r: (r["index"],
+                                                   r["shard_id"])):
+                shards = indices.setdefault(
+                    row["index"], {"shards": {}})["shards"]
+                shards[str(row["shard_id"])] = {
+                    "stage": row["state"],
+                    "file_count": row["files_done"],
+                    "total_bytes": row["bytes_total"],
+                    "uploaded_bytes": row["bytes_uploaded"],
+                    "skipped_bytes": row["bytes_skipped"],
+                }
+                totals["total_bytes"] += row["bytes_total"]
+                totals["uploaded_bytes"] += row["bytes_uploaded"]
+                totals["skipped_bytes"] += row["bytes_skipped"]
+                totals["file_count"] += row["files_done"]
+            on_done({"snapshot": snapshot, "uuid": entry["uuid"],
+                     "state": "IN_PROGRESS", "task": entry["task_id"],
+                     "shards": dict(entry["shards"]),
+                     "stats": totals, "indices": indices}, None)
+
+        if not nodes:
+            pending["n"] = 1
+            finish()
+            return
+        for node in nodes:
+            def ok(resp, _n=node):
+                rows.extend(resp.get("shards", []))
+                finish()
+
+            def fail(exc, _n=node):
+                finish()  # partial live status beats none
+
+            self.transport.send_request(
+                node, SNAPSHOT_SHARD_STATUS_ACTION,
+                {"snap_uuid": entry["uuid"]},
+                ResponseHandler(ok, fail), timeout=30.0)
+
+    # --------------------------------------------------------------- list
+
+    def list(self, repository: str) -> List[Dict[str, Any]]:
+        """Completed snapshots from the repository + in-flight entries
+        from the live registry (``GET /_snapshot/{repo}/_all`` and the
+        `_cat/snapshots` rows)."""
+        repo = self.repositories.get_repository(repository)
+        out = list(repo.list_snapshots())
+        for name in sorted(self.in_progress):
+            e = self.in_progress[name]
+            if e["repository"] != repository:
+                continue
+            out.append({"snapshot": name, "uuid": e["uuid"],
+                        "state": "IN_PROGRESS",
+                        "indices": e["indices"],
+                        "start_time_in_millis": e["start_ms"],
+                        "end_time_in_millis": 0,
+                        "shards": {"total": e["shards"]["total"],
+                                   "failed": e["shards"]["failed"],
+                                   "successful": e["shards"]["done"]}})
+        return out

@@ -7,6 +7,21 @@ demand via ``POST /_slm/policy/{id}/_execute`` (the reference schedules
 via its cron trigger engine; a host-side scheduler thread can attach here
 later without changing the policy model). Retention (`expire_after`,
 `min_count`, `max_count`) is applied after every execution.
+
+Two execution backends share the policy model:
+
+- **sync** (single-node ``Node``): resolve indices locally and call
+  ``repo.snapshot()`` inline — unchanged legacy path;
+- **async** (``ClusterNode``): when constructed with ``snapshot_fn``,
+  execution hands the raw index expression to the cluster snapshot
+  service (which resolves against cluster state) and records
+  ``last_success`` / ``last_failure`` plus retention from the
+  completion callback.
+
+Policies may carry a ``schedule`` interval (``"30m"``-style). There is
+no background timer thread — scheduling is evaluated lazily against the
+injected clock whenever the policy surface is read (``tick()`` from
+``get_policies``), keeping the deterministic task queue unperturbed.
 """
 
 from __future__ import annotations
@@ -26,15 +41,21 @@ from elasticsearch_tpu.common.errors import (
 class SnapshotLifecycleService:
     def __init__(self, repositories_service, indices_service,
                  data_path: Optional[str] = None,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 snapshot_fn: Optional[Callable[..., Any]] = None):
         self.repositories = repositories_service
         self.indices = indices_service
         # injectable wall-clock seam: retention cutoffs, success stamps
         # and date-math snapshot names all derive from one clock so
         # deterministic tests can replay retention decisions
         self.clock = clock or time.time
+        # async backend: snapshot_fn(repo, name, index_expr, metadata,
+        # on_done) — set by ClusterNode to route through the distributed
+        # snapshot service instead of the local sync repo.snapshot path
+        self.snapshot_fn = snapshot_fn
         self._policies: Dict[str, Dict[str, Any]] = {}
         self._stats: Dict[str, Dict[str, Any]] = {}
+        self._last_run: Dict[str, float] = {}
         self._path = (os.path.join(data_path, "_slm_policies.json")
                       if data_path else None)
         if data_path:
@@ -51,9 +72,13 @@ class SnapshotLifecycleService:
         # validate the repository exists up front (as the reference does)
         self.repositories.get_repository(policy["repository"])
         self._policies[policy_id] = policy
+        # a freshly-put scheduled policy first fires one full interval
+        # from now, never retroactively
+        self._last_run[policy_id] = self.clock()
         self._persist()
 
     def get_policies(self, policy_id: Optional[str] = None) -> Dict[str, Any]:
+        self.tick()
         if policy_id is None:
             return {pid: self._describe(pid) for pid in self._policies}
         if policy_id not in self._policies:
@@ -71,6 +96,8 @@ class SnapshotLifecycleService:
             raise ResourceNotFoundException(
                 f"snapshot lifecycle policy [{policy_id}] not found")
         del self._policies[policy_id]
+        self._last_run.pop(policy_id, None)
+        self._stats.pop(policy_id, None)
         self._persist()
 
     def _persist(self):
@@ -79,6 +106,36 @@ class SnapshotLifecycleService:
             with open(tmp, "w") as fh:
                 json.dump(self._policies, fh)
             os.replace(tmp, self._path)
+
+    # ---------------------------------------------------------- scheduling
+    def tick(self) -> List[str]:
+        """Lazily evaluate interval schedules against the injected clock
+        and execute any policy whose interval has elapsed. Returns the
+        policy ids fired this tick (deterministic order)."""
+        now = self.clock()
+        fired: List[str] = []
+        for pid in sorted(self._policies):
+            sched = self._policies[pid].get("schedule")
+            # cron-style schedules ("0 30 1 * * ?") are stored and
+            # executable via explicit _execute, but only interval
+            # schedules ("1h") fire from the lazy clock tick
+            interval = _interval_ms(sched) if sched else None
+            if interval is None:
+                continue
+            last = self._last_run.get(pid)
+            if last is None:
+                # policy loaded from disk: seed, don't fire retroactively
+                self._last_run[pid] = now
+                continue
+            if now - last < interval / 1000.0:
+                continue
+            fired.append(pid)
+            try:
+                self.execute_policy(pid)
+            except Exception as exc:  # noqa: BLE001 — surfaced in stats
+                self._stats.setdefault(pid, {})["last_failure"] = {
+                    "time": int(now * 1000), "details": str(exc)}
+        return fired
 
     # ----------------------------------------------------------- execution
     def execute_policy(self, policy_id: str) -> Dict[str, Any]:
@@ -93,12 +150,45 @@ class SnapshotLifecycleService:
         index_expr = config.get("indices", "*")
         if isinstance(index_expr, list):
             index_expr = ",".join(index_expr)
-        names = self.indices.resolve(index_expr)
-        indices = [self.indices.get(n) for n in names]
-        info = repo.snapshot(name, indices, metadata={"policy": policy_id})
-        self._stats[policy_id] = {
-            "last_success": {"snapshot_name": name,
-                             "time": int(self.clock() * 1000)}}
+        metadata = {"policy": policy_id}
+        self._last_run[policy_id] = self.clock()
+        if self.snapshot_fn is not None:
+            # async cluster path: index resolution happens against
+            # cluster state inside the snapshot service; completion
+            # lands here to stamp stats and run retention
+            def _done(resp, err, *, pid=policy_id, snap=name, pol=policy):
+                stats = self._stats.setdefault(pid, {})
+                if err is not None:
+                    stats["last_failure"] = {
+                        "snapshot_name": snap,
+                        "time": int(self.clock() * 1000),
+                        "details": str(err)}
+                    return
+                stats["last_success"] = {
+                    "snapshot_name": snap,
+                    "time": int(self.clock() * 1000)}
+                try:
+                    self._apply_retention(
+                        pid, self._policies.get(pid, pol),
+                        self.repositories.get_repository(pol["repository"]))
+                except Exception:  # noqa: BLE001 — retention best-effort
+                    pass
+
+            self.snapshot_fn(policy["repository"], name, index_expr,
+                             metadata, _done)
+            return {"snapshot_name": name}
+        try:
+            names = self.indices.resolve(index_expr)
+            indices = [self.indices.get(n) for n in names]
+            repo.snapshot(name, indices, metadata=metadata)
+        except Exception as exc:
+            self._stats.setdefault(policy_id, {})["last_failure"] = {
+                "snapshot_name": name,
+                "time": int(self.clock() * 1000),
+                "details": str(exc)}
+            raise
+        self._stats.setdefault(policy_id, {})["last_success"] = {
+            "snapshot_name": name, "time": int(self.clock() * 1000)}
         self._apply_retention(policy_id, policy, repo)
         return {"snapshot_name": name}
 
@@ -153,3 +243,11 @@ def _parse_ms(v: str) -> float:
         if str(v).endswith(suffix):
             return float(str(v)[: -len(suffix)]) * units[suffix]
     return float(v)
+
+
+def _interval_ms(v: str) -> Optional[float]:
+    """``_parse_ms`` for schedules: None for non-interval (cron) forms."""
+    try:
+        return _parse_ms(v)
+    except (TypeError, ValueError):
+        return None

@@ -347,6 +347,42 @@ class TaskManager:
                 "bans": self.ban_count()}
 
 
+class TaskResultStore:
+    """Completed results of async (``wait_for_completion=false``)
+    actions, keyed by task-id string — the in-memory analogue of the
+    reference's ``.tasks`` result index (ref: tasks/TaskResultsService:
+    completed task results are stored so ``GET /_tasks/{id}`` can answer
+    after the task unregistered). Bounded FIFO: the oldest result falls
+    off past ``capacity``."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._results: Dict[str, Dict[str, Any]] = {}
+        self._order: List[str] = []
+
+    def store(self, task_id: str, response: Any = None,
+              error: Any = None) -> None:
+        entry: Dict[str, Any] = {"completed": True}
+        if error is not None:
+            to_x = getattr(error, "to_xcontent", None)
+            entry["error"] = (to_x() if to_x is not None
+                              else {"type": type(error).__name__,
+                                    "reason": str(error)})
+        else:
+            entry["response"] = response
+        with self._lock:
+            if task_id not in self._results:
+                self._order.append(task_id)
+            self._results[task_id] = entry
+            while len(self._order) > self.capacity:
+                self._results.pop(self._order.pop(0), None)
+
+    def get(self, task_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._results.get(task_id)
+
+
 class _TaskScope:
     def __init__(self, manager: TaskManager, type_: str, action: str,
                  description: str, parent: TaskId, cancellable: bool):

@@ -1,0 +1,15 @@
+# lint-fixture: flags=ESTPU-PAIR01
+"""A shard-snapshot path that begins a snapshot handle (pinning
+translog history under a ``snapshot/{uuid}`` retention lease and
+registering the shard in the in-flight table), then uploads — and the
+upload can raise before the handle is ever ended. The lease outlives
+the failed snapshot, the translog can never trim past it, and the
+watchdog tracks a ghost upload forever: the snapshot-handle leak
+shape."""
+
+
+def snapshot_shard(node, shard, snap_uuid, repo):
+    handle = node.begin_shard_snapshot(shard, snap_uuid, "nightly")
+    blobs = upload_segments(repo, shard)  # lint-expect: ESTPU-PAIR01
+    node.end_shard_snapshot(handle)
+    return blobs

@@ -1,0 +1,16 @@
+# lint-fixture: passes=ESTPU-PAIR01
+"""The paired twin of bad_snapshot_handle.py: a failed upload aborts
+the handle on the except edge and success ends it, so every exit path
+releases the history-pinning lease and deregisters the shard from the
+in-flight table."""
+
+
+def snapshot_shard(node, shard, snap_uuid, repo):
+    handle = node.begin_shard_snapshot(shard, snap_uuid, "nightly")
+    try:
+        blobs = upload_segments(repo, shard)
+    except Exception:
+        node.abort_shard_snapshot(handle)
+        raise
+    node.end_shard_snapshot(handle)
+    return blobs

@@ -1,0 +1,468 @@
+"""Cluster-durable snapshot/restore under the deterministic harness:
+a master-coordinated distributed snapshot (per-shard child uploads to
+the shared blob repository) taken under live write/search load without
+blocking writes, cancel-from-any-side releasing every resource
+(leases, breaker bytes, tasks, partial blobs), segment-granular
+incremental uploads, restore riding the staged recovery protocol —
+including into a FRESH cluster after full-cluster loss with wiped data
+dirs — and SLM policies executing against the cluster path on the
+scheduler clock.
+
+Every chaos path replays byte-identically from its queue seed."""
+
+import shutil
+
+import pytest
+
+from test_cluster_node import SimDataCluster, _index_some_docs
+
+from elasticsearch_tpu.utils.breaker import CircuitBreaker
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    return SimDataCluster(3, tmp_path, seed=31)
+
+
+# ------------------------------------------------------------------ helpers
+
+def _put_repo(cluster, master, location):
+    resp = cluster.call(master.put_repository, "backup",
+                        {"type": "fs", "settings": {"location": location}})
+    assert resp["acknowledged"] is True, resp
+
+
+def _sorted_hits(cluster, coordinator, index, size=400):
+    resp = cluster.call(coordinator.search, index,
+                        {"query": {"match_all": {}}, "size": size,
+                         "sort": [{"n": "asc"}]})
+    assert resp["_shards"]["failed"] == 0, resp
+    return [(h["_id"], h["_source"]) for h in resp["hits"]["hits"]]
+
+
+def _assert_no_snapshot_leaks(cluster):
+    """The cluster-wide postcondition every snapshot exit (success,
+    failure, cancel) must leave behind: no history-pinning leases, no
+    in-flight handles, no breaker bytes, no registered tasks, nothing
+    in the master's in-progress table."""
+    for cn in cluster.cluster_nodes.values():
+        for key, shard in cn.data_node.shards.items():
+            if shard.tracker is None:
+                continue
+            leases = shard.tracker.get_retention_leases()
+            leaked = [lid for lid in leases if lid.startswith("snapshot/")]
+            assert not leaked, f"{key}: leaked snapshot leases {leaked}"
+        assert cn.data_node.shard_snapshots == {}, \
+            cn.data_node.shard_snapshots
+        assert cn.breaker_service.get_breaker(
+            CircuitBreaker.REQUEST).used == 0
+        assert not cn.task_manager.list_tasks(actions="*snapshot*")
+        assert cn.snapshots.in_progress == {}
+
+
+def _repo_shard_meta(master, snapshot, index="logs"):
+    repo = master.repositories.get_repository("backup")
+    return repo.get_snapshot(snapshot)["indices"][index]["shards"]
+
+
+def _staggered_bulks(cluster, coordinator, acked, rounds=10, batch=4,
+                     gap=0.3, index="logs", start_n=1000):
+    """Spread bulk writes across the snapshot window, recording acked
+    ids (the load the snapshot must stay seqno-consistent under)."""
+    counter = {"n": start_n}
+
+    def one_round():
+        items = []
+        for _ in range(batch):
+            i = counter["n"]
+            counter["n"] += 1
+            items.append({"op": "index", "id": f"live-{i}",
+                          "source": {"body": f"live doc {i}", "n": i}})
+
+        def on_done(resp, err=None, _items=items):
+            if err is not None:
+                return
+            for item, d in zip(resp["items"], _items):
+                if item and "error" not in item:
+                    acked.append(d["id"])
+
+        coordinator.bulk(index, items, on_done=on_done)
+
+    for r in range(rounds):
+        cluster.queue.schedule(r * gap, one_round,
+                               f"staggered-bulk[{r}]")
+
+
+# --------------------------------------------- snapshot under live load
+
+def test_snapshot_under_concurrent_load_is_seqno_consistent(cluster):
+    """A snapshot taken while bulks and searches are in flight
+    completes without blocking writes; the restored copy contains
+    every doc acked before the snapshot started and nothing torn."""
+    master = cluster.stabilise()
+    _put_repo(cluster, master, "backup")
+    cluster.call(master.create_index, "logs",
+                 number_of_shards=2, number_of_replicas=1)
+    cluster.run_for(30)
+    _index_some_docs(cluster, master, n=30)
+    baseline = _sorted_hits(cluster, master, "logs")
+    assert len(baseline) == 30
+
+    acked = []
+    _staggered_bulks(cluster, master, acked, rounds=12, gap=0.25)
+    snap = cluster.call(master.create_snapshot, "backup", "live-snap",
+                        {"indices": "logs"})
+    assert snap["snapshot"]["state"] == "SUCCESS", snap
+    assert snap["snapshot"]["shards"]["failed"] == 0
+
+    # searches stayed up through the window, and the live writes kept
+    # landing (the snapshot never blocked the write path)
+    cluster.run_for(30)
+    cluster.call(master.refresh)
+    assert len(acked) > 0
+    live = _sorted_hits(cluster, master, "logs")
+    assert len(live) == 30 + len(acked)
+
+    # restore next to the live index: every pre-snapshot doc is there,
+    # and whatever slice of the live writes the consistency point
+    # caught is a prefix-consistent subset of what was acked
+    resp = cluster.call(master.restore_snapshot, "backup", "live-snap",
+                        {"indices": "logs", "rename_pattern": "logs",
+                         "rename_replacement": "logs_at_snap"})
+    assert resp["accepted"] is True
+    cluster.run_for(60)
+    cluster.call(master.refresh)
+    restored = _sorted_hits(cluster, master, "logs_at_snap")
+    restored_ids = {i for i, _ in restored}
+    assert {i for i, _ in baseline} <= restored_ids
+    assert restored_ids <= {i for i, _ in live}
+    assert restored[:30] == baseline
+    _assert_no_snapshot_leaks(cluster)
+
+
+# ----------------------------------------------------- cancel releases all
+
+def test_delete_in_flight_snapshot_releases_everything(cluster):
+    """DELETE of an in-flight snapshot cancels it cluster-wide: the
+    uploading shards abort, partial blobs are dropped, every lease /
+    breaker byte / task / in-progress entry is released, and the repo
+    stays readable at its prior generation."""
+    master = cluster.stabilise()
+    _put_repo(cluster, master, "backup")
+    cluster.call(master.create_index, "logs",
+                 number_of_shards=2, number_of_replicas=1)
+    cluster.run_for(30)
+    _index_some_docs(cluster, master, n=60)
+    # a completed first snapshot pins the repo generation to compare
+    first = cluster.call(master.create_snapshot, "backup", "keeper",
+                         {"indices": "logs"})
+    assert first["snapshot"]["state"] == "SUCCESS"
+    repo = master.repositories.get_repository("backup")
+    gen_before = repo.load_repository_data()["gen"]
+
+    # issue create (async) and delete back-to-back WITHOUT driving the
+    # queue between them: the delete lands while shard uploads are
+    # still stepping file-by-file
+    create_box, delete_box = {}, {}
+    master.create_snapshot(
+        "backup", "doomed", {"indices": "logs"},
+        wait_for_completion=False,
+        on_done=lambda r, e: create_box.update(r=r, e=e))
+    master.delete_snapshot(
+        "backup", "doomed",
+        on_done=lambda r, e: delete_box.update(r=r, e=e))
+    cluster.run_for(90)
+
+    assert delete_box.get("e") is None, delete_box
+    assert create_box.get("e") is None and \
+        create_box["r"].get("accepted") is True, create_box
+    task_id = create_box["r"]["task"]
+    # the cancelled create's failure is recorded as the task's result
+    stored = master.task_results.get(task_id)
+    assert stored is not None and "error" in stored, stored
+
+    # repo readable at the PRIOR generation: the doomed snapshot never
+    # became visible, the keeper still restores, integrity is clean
+    data = repo.load_repository_data()
+    assert data["gen"] == gen_before
+    assert "doomed" not in data["snapshots"]
+    assert "keeper" in data["snapshots"]
+    assert repo.verify_integrity() == []
+    _assert_no_snapshot_leaks(cluster)
+
+    # and the cluster still takes a fresh snapshot afterwards
+    again = cluster.call(master.create_snapshot, "backup", "after",
+                         {"indices": "logs"})
+    assert again["snapshot"]["state"] == "SUCCESS"
+    _assert_no_snapshot_leaks(cluster)
+
+
+# -------------------------------------------------------- incremental upload
+
+def test_incremental_second_snapshot_uploads_zero_bytes(cluster):
+    """Content-hash dedup at segment granularity: a second snapshot of
+    an unchanged index uploads nothing; after new writes a third
+    snapshot moves only the delta."""
+    master = cluster.stabilise()
+    _put_repo(cluster, master, "backup")
+    cluster.call(master.create_index, "logs",
+                 number_of_shards=2, number_of_replicas=0)
+    cluster.run_for(30)
+    _index_some_docs(cluster, master, n=40)
+
+    s1 = cluster.call(master.create_snapshot, "backup", "snap1",
+                      {"indices": "logs"})
+    assert s1["snapshot"]["state"] == "SUCCESS"
+    uploaded1 = sum(m["uploaded_bytes"]
+                    for m in _repo_shard_meta(master, "snap1"))
+    assert uploaded1 > 0
+
+    s2 = cluster.call(master.create_snapshot, "backup", "snap2",
+                      {"indices": "logs"})
+    assert s2["snapshot"]["state"] == "SUCCESS"
+    meta2 = _repo_shard_meta(master, "snap2")
+    assert sum(m["uploaded_bytes"] for m in meta2) == 0, meta2
+    assert sum(m["skipped_bytes"] for m in meta2) > 0
+
+    # new writes: the third snapshot ships only what changed
+    _index_some_docs(cluster, master, n=10)
+    s3 = cluster.call(master.create_snapshot, "backup", "snap3",
+                      {"indices": "logs"})
+    assert s3["snapshot"]["state"] == "SUCCESS"
+    meta3 = _repo_shard_meta(master, "snap3")
+    uploaded3 = sum(m["uploaded_bytes"] for m in meta3)
+    assert 0 < uploaded3 < uploaded1
+    assert sum(m["skipped_bytes"] for m in meta3) > 0
+    _assert_no_snapshot_leaks(cluster)
+
+
+# ------------------------------------------------------- full-cluster loss
+
+def test_full_cluster_loss_restore_into_fresh_cluster(tmp_path):
+    """The disaster-recovery contract: every node stopped, every data
+    dir wiped, a FRESH cluster (different seed, different node dirs)
+    registers the same repository and restores — zero loss of writes
+    acked before the snapshot, byte-identical search results vs the
+    pre-loss baseline, recoveries riding the staged protocol with the
+    repository as source."""
+    repo_dir = str(tmp_path / "shared-backup")
+    c1 = SimDataCluster(3, tmp_path / "c1", seed=31)
+    m1 = c1.stabilise()
+    _put_repo(c1, m1, repo_dir)
+    c1.call(m1.create_index, "logs",
+            number_of_shards=2, number_of_replicas=1)
+    c1.run_for(30)
+    _index_some_docs(c1, m1, n=40)
+    baseline = _sorted_hits(c1, m1, "logs")
+    assert len(baseline) == 40
+    snap = c1.call(m1.create_snapshot, "backup", "doomsday",
+                   {"indices": "logs"})
+    assert snap["snapshot"]["state"] == "SUCCESS"
+    # writes after the snapshot are lost by definition — they must not
+    # resurrect or corrupt the restored copy
+    _index_some_docs(c1, m1, n=45)
+
+    for nid in list(c1.cluster_nodes):
+        c1.stop_node(nid)
+    for p in (tmp_path / "c1").iterdir():
+        shutil.rmtree(p)
+
+    c2 = SimDataCluster(3, tmp_path / "c2", seed=97)
+    m2 = c2.stabilise()
+    _put_repo(c2, m2, repo_dir)
+    resp = c2.call(m2.restore_snapshot, "backup", "doomsday",
+                   {"indices": "logs"})
+    assert resp["accepted"] is True
+    assert resp["snapshot"]["shards"]["failed"] == 0
+    c2.run_for(90)
+
+    c2.call(m2.refresh)
+    restored = _sorted_hits(c2, m2, "logs")
+    assert restored == baseline
+    # the restore rode the staged recovery protocol from the repo
+    snap_recs = [rec for cn in c2.cluster_nodes.values()
+                 for rec in cn.data_node.recoveries.values()
+                 if rec.recovery_type == "snapshot"]
+    assert snap_recs and all(r.stage == "done" for r in snap_recs)
+    assert all(r.source_node.startswith("_snapshot:") for r in snap_recs)
+    _assert_no_snapshot_leaks(c2)
+
+    # the restored index is a first-class citizen: writes + a fresh
+    # snapshot work on top of it
+    _index_some_docs(c2, m2, n=5)
+    s2 = c2.call(m2.create_snapshot, "backup", "post-restore",
+                 {"indices": "logs"})
+    assert s2["snapshot"]["state"] == "SUCCESS"
+
+
+# ------------------------------------------------------------ async create
+
+def test_async_create_visible_in_tasks_with_stored_result(cluster):
+    """``wait_for_completion=false``: the create is ACCEPTED with a
+    task id, the parent task is visible in `_tasks` while shards
+    upload, and the final snapshot info is served from the task-result
+    store after completion."""
+    master = cluster.stabilise()
+    _put_repo(cluster, master, "backup")
+    cluster.call(master.create_index, "logs",
+                 number_of_shards=2, number_of_replicas=1)
+    cluster.run_for(30)
+    _index_some_docs(cluster, master, n=50)
+
+    box = {}
+    master.create_snapshot("backup", "bg-snap", {"indices": "logs"},
+                           wait_for_completion=False,
+                           on_done=lambda r, e: box.update(r=r, e=e))
+    # drive in tiny slices: the parent task must be observable in
+    # `_tasks` between the accept going out and the last shard
+    # response coming back
+    seen_live = False
+    for _ in range(4000):
+        cluster.run_for(0.005)
+        if master.task_manager.list_tasks(actions="*snapshot/create*"):
+            seen_live = True
+        if ("r" in box or "e" in box) and seen_live:
+            break
+    assert box.get("e") is None and box["r"]["accepted"] is True, box
+    task_id = box["r"]["task"]
+    assert seen_live, "parent task never visible while snapshot in flight"
+
+    cluster.run_for(60)
+    result = cluster.call(master.get_task, task_id)
+    assert result["completed"] is True, result
+    assert result["response"]["snapshot"]["snapshot"] == "bg-snap"
+    assert result["response"]["snapshot"]["state"] == "SUCCESS"
+    _assert_no_snapshot_leaks(cluster)
+
+
+# ------------------------------------------------------------------- SLM
+
+def test_slm_policy_executes_and_schedules_on_cluster(cluster):
+    """SLM on the cluster path: _execute creates a real distributed
+    snapshot and stamps last_success; a ``schedule`` interval fires
+    lazily off the scheduler clock; retention prunes to max_count."""
+    master = cluster.stabilise()
+    _put_repo(cluster, master, "backup")
+    cluster.call(master.create_index, "logs",
+                 number_of_shards=2, number_of_replicas=0)
+    cluster.run_for(30)
+    _index_some_docs(cluster, master, n=20)
+
+    resp = cluster.call(master.slm_request, "put", "nightly",
+                        {"repository": "backup",
+                         "name": "<nightly-{now/d}>",
+                         "config": {"indices": "logs"},
+                         "schedule": "1h",
+                         "retention": {"max_count": 2}})
+    assert resp["acknowledged"] is True
+    resp = cluster.call(master.slm_request, "execute", "nightly")
+    first_snap = resp["snapshot_name"]
+    assert first_snap.startswith("nightly-")
+    cluster.run_for(30)
+
+    pol = cluster.call(master.slm_request, "get", "nightly")
+    assert pol["nightly"]["last_success"]["snapshot_name"] == first_snap
+
+    def _policy_snapshots():
+        repo = master.repositories.get_repository("backup")
+        return sorted(s["snapshot"] for s in repo.list_snapshots()
+                      if (s.get("metadata") or {}).get("policy")
+                      == "nightly")
+
+    assert _policy_snapshots() == [first_snap]
+    # the schedule fires lazily when the policy surface is read past
+    # the interval — no background timer perturbs the task queue
+    cluster.run_for(3700)
+    cluster.call(master.slm_request, "get")
+    cluster.run_for(30)
+    snaps = _policy_snapshots()
+    assert len(snaps) == 2 and first_snap in snaps
+
+    # two more fires: retention caps the fleet at max_count=2
+    for _ in range(2):
+        cluster.run_for(3700)
+        cluster.call(master.slm_request, "get")
+        cluster.run_for(30)
+    assert len(_policy_snapshots()) == 2
+    _assert_no_snapshot_leaks(cluster)
+
+
+# ---------------------------------------------------------------- health
+
+def test_repository_integrity_indicator_goes_red_on_damage(cluster):
+    """The repository_integrity indicator: GREEN on a verified repo,
+    typed RED with a corruption diagnosis once a referenced blob is
+    destroyed."""
+    master = cluster.stabilise()
+    _put_repo(cluster, master, "backup")
+    cluster.call(master.create_index, "logs",
+                 number_of_shards=1, number_of_replicas=0)
+    cluster.run_for(30)
+    _index_some_docs(cluster, master, n=10)
+    snap = cluster.call(master.create_snapshot, "backup", "snap1",
+                        {"indices": "logs"})
+    assert snap["snapshot"]["state"] == "SUCCESS"
+
+    rep = cluster.call(master.health_report, "repository_integrity")
+    ind = rep["indicators"]["repository_integrity"]
+    assert ind["status"] == "green", ind
+
+    # destroy a referenced segment blob behind the repo's back
+    repo = master.repositories.get_repository("backup")
+    meta = _repo_shard_meta(master, "snap1")[0]
+    blob = sorted(next(iter(meta["segments"].values())).values())[0]
+    repo.shard_container("logs", 0).delete_blob(blob)
+    assert repo.verify_integrity() != []
+
+    rep = cluster.call(master.health_report, "repository_integrity")
+    ind = rep["indicators"]["repository_integrity"]
+    assert ind["status"] == "red", ind
+    assert any(d["id"] == "repository_integrity:corruption"
+               for d in ind.get("diagnosis", []))
+
+
+# ------------------------------------------------------------- determinism
+
+def _replay_scenario(tmp_path, tag):
+    """One full snapshot-under-load + cancel + restore story, returning
+    everything observable that must be identical across same-seed
+    replays (uuids excluded by design: they name, never steer)."""
+    cluster = SimDataCluster(3, tmp_path / tag, seed=71)
+    master = cluster.stabilise()
+    _put_repo(cluster, master, "backup")
+    cluster.call(master.create_index, "logs",
+                 number_of_shards=2, number_of_replicas=1)
+    cluster.run_for(30)
+    _index_some_docs(cluster, master, n=25)
+    acked = []
+    _staggered_bulks(cluster, master, acked, rounds=8, gap=0.3)
+    snap = cluster.call(master.create_snapshot, "backup", "replay-snap",
+                        {"indices": "logs"})
+    cluster.run_for(30)
+    cluster.call(master.refresh)
+    resp = cluster.call(master.restore_snapshot, "backup", "replay-snap",
+                        {"indices": "logs", "rename_pattern": "logs",
+                         "rename_replacement": "logs_r"})
+    assert resp["accepted"] is True
+    cluster.run_for(60)
+    cluster.call(master.refresh)
+    shard_meta = _repo_shard_meta(master, "replay-snap")
+    return {
+        "state": snap["snapshot"]["state"],
+        "acked": sorted(acked),
+        "live": _sorted_hits(cluster, master, "logs"),
+        "restored": _sorted_hits(cluster, master, "logs_r"),
+        "bytes": [(m["total_bytes"], m["uploaded_bytes"],
+                   m["skipped_bytes"], m["consistency_point"],
+                   (m.get("translog") or {}).get("ops"))
+                  for m in shard_meta],
+    }
+
+
+def test_same_seed_replays_byte_identical(tmp_path):
+    """The whole snapshot/restore story — upload byte counts,
+    consistency points, acked sets, restored result sets — replays
+    identically from the same queue seed."""
+    a = _replay_scenario(tmp_path, "run-a")
+    b = _replay_scenario(tmp_path, "run-b")
+    assert a == b

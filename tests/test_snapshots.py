@@ -344,3 +344,55 @@ def test_ingest_script_sandbox_blocks_dunder():
     # metadata attrs still work
     svc.put_pipeline("ok", {"processors": [{"script": {
         "source": "ctx.copy_of_index = ctx._index"}}]})
+
+
+def test_upload_shard_blob_dedups_by_content(env):
+    _, repo = env
+    first = repo.upload_shard_blob("ix", 0, b"segment bytes")
+    assert first["uploaded"] is True
+    again = repo.upload_shard_blob("ix", 0, b"segment bytes")
+    assert again == {"blob": first["blob"], "uploaded": False,
+                     "size": len(b"segment bytes")}
+
+
+def test_delete_shard_blobs_abort_cleanup(env):
+    _, repo = env
+    keep = repo.upload_shard_blob("ix", 0, b"keep me")
+    drop = repo.upload_shard_blob("ix", 0, b"drop me")
+    dropped = repo.delete_shard_blobs(
+        "ix", 0, [drop["blob"], drop["blob"], "__never-uploaded"])
+    assert dropped == 1
+    container = repo.shard_container("ix", 0)
+    assert container.blob_exists(keep["blob"])
+    assert not container.blob_exists(drop["blob"])
+
+
+def test_finalize_snapshot_status_and_integrity(env):
+    _, repo = env
+    up = repo.upload_shard_blob("ix", 0, b"abc")
+    snap_indices = {"ix": {"shards": [{
+        "segments": {"_0": {"f0": up["blob"]}},
+        "total_bytes": 3, "uploaded_bytes": 3, "skipped_bytes": 0,
+        "translog": {"ops": 2, "blob": None},
+    }]}}
+    info = repo.finalize_snapshot("s", "uuid-1", snap_indices,
+                                  start_ms=10, end_ms=20)
+    assert info["state"] == "SUCCESS"
+    assert info["start_time_in_millis"] == 10
+    assert info["shards"] == {"total": 1, "failed": 0, "successful": 1}
+
+    status = repo.snapshot_status("s")
+    assert status["stats"] == {"total_bytes": 3, "uploaded_bytes": 3,
+                               "skipped_bytes": 0, "file_count": 1}
+    row = status["indices"]["ix"]["shards"]["0"]
+    assert row["stage"] == "DONE"
+    assert row["translog_ops"] == 2
+
+    assert repo.verify_integrity() == []
+    repo.shard_container("ix", 0).delete_blob(up["blob"])
+    kinds = {p["kind"] for p in repo.verify_integrity()}
+    assert kinds == {"missing_blob"}
+    # a generation pointer at a missing index-N blob is its own kind
+    repo.root.write_blob("index.latest", b"7")
+    assert [p["kind"] for p in repo.verify_integrity()] == \
+        ["generation_mismatch"]

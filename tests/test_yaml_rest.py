@@ -133,6 +133,59 @@ class ClusterYamlAdapter:
             if m and method == "DELETE":
                 return 200, self.cluster.call(
                     self.master.delete_async_search, m.group(1))
+            # ------------------------------------------ snapshot plane
+            m = re.fullmatch(r"/_snapshot/([^/]+)", path)
+            if m and method in ("PUT", "POST"):
+                return 200, self.cluster.call(
+                    self.master.put_repository, m.group(1), body or {})
+            if m and method == "GET":
+                return 200, self.master.get_repositories(m.group(1))
+            m = re.fullmatch(r"/_snapshot/([^/]+)/([^/]+)/_status", path)
+            if m and method == "GET":
+                return 200, self.cluster.call(
+                    self.master.snapshot_status, m.group(1), m.group(2))
+            m = re.fullmatch(r"/_snapshot/([^/]+)/([^/]+)/_restore", path)
+            if m and method == "POST":
+                resp = self.cluster.call(
+                    self.master.restore_snapshot, m.group(1), m.group(2),
+                    body or {})
+                self.cluster.run_for(60)
+                return 200, resp
+            m = re.fullmatch(r"/_snapshot/([^/]+)/([^/]+)", path)
+            if m and method in ("PUT", "POST"):
+                wait = params.get("wait_for_completion", "true") != "false"
+                return 200, self.cluster.call(
+                    self.master.create_snapshot, m.group(1), m.group(2),
+                    body or {}, wait_for_completion=wait)
+            if m and method == "GET":
+                snap = None if m.group(2) in ("_all", "*") else m.group(2)
+                return 200, self.cluster.call(
+                    self.master.get_snapshots, m.group(1), snap)
+            if m and method == "DELETE":
+                return 200, self.cluster.call(
+                    self.master.delete_snapshot, m.group(1), m.group(2))
+            m = re.fullmatch(r"/_slm/policy/([^/]+)/_execute", path)
+            if m and method == "POST":
+                return 200, self.cluster.call(
+                    self.master.slm_request, "execute", m.group(1))
+            m = re.fullmatch(r"/_slm/policy/([^/]+)", path)
+            if m and method == "PUT":
+                return 200, self.cluster.call(
+                    self.master.slm_request, "put", m.group(1),
+                    body or {})
+            if m and method == "GET":
+                return 200, self.cluster.call(
+                    self.master.slm_request, "get", m.group(1))
+            if m and method == "DELETE":
+                return 200, self.cluster.call(
+                    self.master.slm_request, "delete", m.group(1))
+            if path == "/_slm/policy" and method == "GET":
+                return 200, self.cluster.call(
+                    self.master.slm_request, "get")
+            m = re.fullmatch(r"/_tasks/([^/]+)", path)
+            if m and method == "GET":
+                return 200, self.cluster.call(
+                    self.master.get_task, m.group(1))
         except ElasticsearchTpuException as e:
             return e.status, {
                 "error": {**e.to_xcontent(),
