@@ -80,7 +80,7 @@ def emit(metric_text: str, value: float, vs_baseline: float,
          engine=None, overload=None, tasks=None, cpu=None,
          serving=None, skipped=None, aggs=None, multichip=None,
          lint=None, recovery=None, health=None, upgrade=None,
-         cursors=None, tenants=None, snapshots=None):
+         cursors=None, tenants=None, snapshots=None, macro=None):
     _LAST_PAYLOAD.clear()
     _LAST_PAYLOAD.update({
         "metric": metric_text,
@@ -187,6 +187,15 @@ def emit(metric_text: str, value: float, vs_baseline: float,
         # served while the snapshot ran — a repo-format or dedup
         # regression shows here before it costs a real backup window
         _LAST_PAYLOAD["snapshots"] = snapshots
+    if macro:
+        # macro-workload rider (bench/macro.py, deterministic sim): a
+        # Rally-style open-loop mix — interactive/bulk/aggs/scroll/
+        # async, tenant-tagged — through an injected reroute relocation
+        # AND a node bounce; per-class qps/p50/p99 + SLO burn, the
+        # workload_slo verdict mid-chaos, the disruption timeline, and
+        # the zero-acked-write-loss verdict. A class-attribution or
+        # survival regression shows here round over round
+        _LAST_PAYLOAD["macro"] = macro
     print(json.dumps(_LAST_PAYLOAD), flush=True)
 
 
@@ -2330,6 +2339,26 @@ def run_snapshots_cpu(n_docs=300, seed=23):
         return out
 
 
+def run_macro_cpu(seed=29, smoke=False):
+    """Macro-workload rider (CPU-side, deterministic sim — no jax):
+    the Rally-style open-loop mix from ``bench/macro.py`` — tenant-
+    tagged interactive/bulk/aggs/scroll/async arrivals against a
+    3-node sim cluster — through an injected ``_cluster/reroute``
+    relocation AND a node stop/restart. Banks per-class qps/p50/p99 +
+    SLO burn from the merged ``/_workload/stats`` fan-out, the
+    ``workload_slo`` verdict probed mid-chaos, the disruption
+    timeline, and the zero-acked-write-loss verdict into the BENCH
+    json ``macro`` section BEFORE any backend touch. Replay-stable:
+    all virtual clocks; the full transcript is folded to its sha256."""
+    from elasticsearch_tpu.bench.macro import run_macro
+
+    t_host = time.time()
+    out = run_macro(seed=seed, smoke=smoke)
+    out.pop("transcript", None)
+    out["host_s"] = round(time.time() - t_host, 1)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Multi-chip serving rows (ISSUE 9): qps at 1/2/4/8 devices for the two
 # mesh serving modes — sharded-corpus (one SPMD fan-out/merge program per
@@ -2728,7 +2757,8 @@ def main():
              upgrade=parts.get("upgrade"),
              cursors=parts.get("cursors"),
              tenants=parts.get("tenants"),
-             snapshots=parts.get("snapshots"))
+             snapshots=parts.get("snapshots"),
+             macro=parts.get("macro"))
 
     # estpu-lint preflight: static contract scan of the whole package
     # (stdlib ast, ~2s, no device). Summary rides every BENCH line so
@@ -2829,6 +2859,15 @@ def main():
         parts["snapshots"] = run_snapshots_cpu()
     except Exception as e:  # noqa: BLE001 — the rider must not sink
         log(f"snapshots rider failed: {e!r}")
+    # macro-workload rows (deterministic sim, no jax): the Rally-style
+    # open-loop class mix through an injected reroute AND a node
+    # bounce — per-class qps/p50/p99, SLO burn, the mid-chaos
+    # workload_slo verdict, and the zero-acked-write-loss verdict
+    try:
+        parts["macro"] = run_macro_cpu()
+    except Exception as e:  # noqa: BLE001 — the rider must not sink
+        parts.setdefault("skipped", {})["macro"] = repr(e)
+        log(f"macro rider failed: {e!r}")
     # ALL CPU-side rows land before ANY jax/backend touch: a dead
     # relay hangs even backend INIT uninterruptibly (observed: hours),
     # and a run killed there must still have parsed output on record
@@ -2965,6 +3004,18 @@ if __name__ == "__main__":
     if len(sys.argv) >= 4 and sys.argv[1] == "--multichip-row":
         # subprocess row harness (run_multichip_serving spawns these)
         _multichip_row(int(sys.argv[2]), sys.argv[3])
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--macro-smoke":
+        # tier-1 smoke entry: the macro rider at reduced scale (tiny
+        # corpus, 2 rounds), rows banked incrementally — a kill still
+        # leaves a parseable {"macro": ...} or a typed skipped reason
+        payload = {}
+        try:
+            seed = int(sys.argv[2]) if len(sys.argv) >= 3 else 29
+            payload["macro"] = run_macro_cpu(seed=seed, smoke=True)
+        except Exception as e:  # noqa: BLE001 — must bank a reason
+            payload["skipped"] = {"macro": repr(e)}
+        print(json.dumps(payload), flush=True)
         sys.exit(0)
     try:
         main()

@@ -1,0 +1,9 @@
+"""Macro-workload bench harness (Rally-style mixed load over the sim).
+
+``bench.macro.run_macro`` drives a weighted mix of request classes
+against a seeded 3-node sim cluster on the deterministic scheduler and
+returns a replay-stable result dict — the BENCH json ``macro`` rider
+and ``tests/test_macro_workload.py`` both consume it.
+"""
+
+from elasticsearch_tpu.bench.macro import run_macro  # noqa: F401

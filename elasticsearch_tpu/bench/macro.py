@@ -1,0 +1,528 @@
+"""Rally-style macro-workload harness over the deterministic sim.
+
+``run_macro`` drives a weighted mix of request classes — ``interactive``
+search (bm25 match / bool / term), ``bulk`` indexing, ``aggs``,
+``scroll`` drains, and ``async`` search — against a seeded 3-node sim
+cluster with OPEN-LOOP arrival schedules: every request's arrival time
+is drawn up front from ``random.Random(seed)`` and fired at that
+virtual instant whether or not earlier requests have completed (the
+Rally ``target-throughput`` model, not a closed request loop). Each
+request carries a tenant tag and its workload class rides the ambient
+context rail (telemetry/context.py), so the per-node
+``WorkloadAccounting`` tables, the ``/_workload/stats`` fan-out merge,
+and the ``workload_slo`` health indicator all observe the SAME run the
+returned summary reports.
+
+Mid-run the harness injects the PR-12/14 chaos pair: an explicit
+``_cluster/reroute`` primary relocation, then a node stop + restart
+(fresh ``ClusterNode`` over the same data dir — gateway reload,
+translog replay, re-join). The run must SURVIVE both: every acked bulk
+write is re-counted after a final refresh and the loss count must be 0.
+
+Replay-stable by construction: all clocks are the scheduler's virtual
+clock, all randomness is the seeded builder, and the transcript rows
+append in completion order under the deterministic queue — two
+same-seed runs render byte-identical ``json.dumps`` output. The BENCH
+json ``macro`` rider banks this dict CPU-side before any device touch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+from elasticsearch_tpu.telemetry import context as _telectx
+
+TENANTS = ("alpha", "beta", "gamma")
+
+# tighter-than-default per-class objectives (virtual ms): steady-state
+# sim RTTs sit just under these, so budget burn localizes to the
+# chaos window — which is exactly what workload_slo should surface
+MACRO_SLO_OBJECTIVES_MS = {
+    "interactive": 150.0,
+    "aggs": 250.0,
+    "scroll": 500.0,
+    "async": 2000.0,
+}
+
+_INTERACTIVE_BODIES = (
+    {"query": {"match": {"body": "fox"}}, "size": 5},
+    {"query": {"bool": {
+        "must": [{"match": {"body": "doc"}}],
+        "filter": [{"term": {"category": "a"}}]}}, "size": 5},
+    {"query": {"term": {"category": "b"}}, "size": 5},
+)
+
+_AGGS_BODY = {"size": 0, "aggs": {
+    "cats": {"terms": {"field": "category"},
+             "aggs": {"avg_p": {"avg": {"field": "price"}}}}}}
+
+_DOCS_MAPPINGS = {"properties": {
+    "category": {"type": "keyword"},
+    "price": {"type": "double"},
+}}
+
+
+class _MacroCluster:
+    """3-node sim cluster with the stop/restart idiom (the
+    SimDataCluster shape from the integration suite, inlined here so
+    the bench package stays importable without tests/)."""
+
+    def __init__(self, n_nodes: int, root: str, seed: int):
+        from elasticsearch_tpu.cluster.node import ClusterNode
+        from elasticsearch_tpu.testing.deterministic import (
+            DeterministicTaskQueue, DisruptableTransport, SimNetwork)
+        from elasticsearch_tpu.transport.transport import DiscoveryNode
+        self._ClusterNode = ClusterNode
+        self._DisruptableTransport = DisruptableTransport
+        self.queue = DeterministicTaskQueue(seed=seed)
+        self.network = SimNetwork(self.queue)
+        self.nodes = [DiscoveryNode(node_id=f"mw-{i}", name=f"mw{i}")
+                      for i in range(n_nodes)]
+        self.data_paths = {n.node_id: os.path.join(root, n.name)
+                           for n in self.nodes}
+        self.cluster_nodes: Dict[str, Any] = {}
+        for node in self.nodes:
+            self._boot(node)
+        for cn in self.cluster_nodes.values():
+            cn.start()
+
+    def _boot(self, node):
+        cn = self._ClusterNode(
+            self._DisruptableTransport(node, self.network), self.queue,
+            data_path=self.data_paths[node.node_id],
+            seed_nodes=self.nodes,
+            initial_master_nodes=[n.name for n in self.nodes],
+            rng=self.queue.random)
+        cn.telemetry.workload.slo_objectives.update(
+            MACRO_SLO_OBJECTIVES_MS)
+        self.cluster_nodes[node.node_id] = cn
+        return cn
+
+    def stop_node(self, node_id: str):
+        """Process exit: stop services, then cut every link so
+        in-flight sends fail fast."""
+        from elasticsearch_tpu.testing.deterministic import DISCONNECTED
+        cn = self.cluster_nodes.pop(node_id)
+        cn.stop()
+        self.network.isolate(cn.local_node, self.nodes,
+                             mode=DISCONNECTED)
+        return cn
+
+    def restart_node(self, node_id: str):
+        """Fresh ClusterNode over the stopped node's data dir."""
+        from elasticsearch_tpu.testing.deterministic import CONNECTED
+        node = next(n for n in self.nodes if n.node_id == node_id)
+        for other in self.nodes:
+            if other.node_id != node_id:
+                self.network.set_link(node, other, CONNECTED)
+        cn = self._boot(node)
+        cn.start()
+        return cn
+
+    def run_for(self, seconds: float) -> None:
+        self.queue.run_for(seconds)
+
+    def master(self):
+        masters = [c for c in self.cluster_nodes.values()
+                   if c.is_master()]
+        assert len(masters) == 1, \
+            f"masters: {[m.local_node.name for m in masters]}"
+        return masters[0]
+
+    def stabilise(self, seconds: float = 60):
+        self.run_for(seconds)
+        return self.master()
+
+    def live_ids(self) -> List[str]:
+        return sorted(self.cluster_nodes)
+
+    def call(self, fn: Callable, *args, timeout: float = 60, **kwargs):
+        """Closed-loop helper for setup/verification phases only —
+        the measured mix itself is issued open-loop."""
+        box: Dict[str, Any] = {}
+
+        def on_done(result, err=None):
+            box["result"] = result
+            box["err"] = err
+
+        fn(*args, **kwargs, on_done=on_done)
+        waited = 0.0
+        while "result" not in box and "err" not in box \
+                and waited < timeout:
+            self.run_for(1.0)
+            waited += 1.0
+        if "result" not in box and "err" not in box:
+            raise RuntimeError("call never completed")
+        if box.get("err") is not None:
+            err = box["err"]
+            raise err if isinstance(err, BaseException) \
+                else RuntimeError(err)
+        return box["result"]
+
+    def stop_all(self) -> None:
+        for cn in self.cluster_nodes.values():
+            cn.stop()
+
+
+def _corpus(n: int) -> List[Dict[str, Any]]:
+    cats = ("a", "b", "c")
+    return [{"op": "index", "id": f"md-{i}",
+             "source": {"body": f"quick brown fox doc {i}",
+                        "category": cats[i % 3],
+                        "price": float((i * 7) % 100), "n": i}}
+            for i in range(n)]
+
+
+def run_macro(seed: int = 0, smoke: bool = False,
+              root: Optional[str] = None) -> Dict[str, Any]:
+    """Run the macro workload; returns the replay-stable summary dict
+    (includes the full ``transcript`` — BENCH pops it and banks the
+    sha256 instead)."""
+    import tempfile
+    if root is None:
+        with tempfile.TemporaryDirectory() as tmp:
+            return run_macro(seed=seed, smoke=smoke, root=tmp)
+
+    rounds = 2 if smoke else 6
+    round_s = 15.0
+    horizon = rounds * round_s
+    per_round = ({"interactive": 5, "aggs": 2, "bulk": 2,
+                  "scroll": 1, "async": 1} if smoke else
+                 {"interactive": 6, "aggs": 2, "bulk": 3,
+                  "scroll": 1, "async": 1})
+    bulk_batch = 6 if smoke else 10
+    corpus_n = 24 if smoke else 90
+
+    rng = random.Random(seed)
+    cluster = _MacroCluster(3, root, seed)
+    queue = cluster.queue
+    try:
+        master = cluster.stabilise(60)
+        # setup runs under the reserved `_default` class so the
+        # measured per-class tables hold ONLY the scheduled mix
+        with _telectx.activate_workload_class("_default"):
+            cluster.call(master.create_index, "md",
+                         number_of_shards=2, number_of_replicas=1,
+                         mappings=_DOCS_MAPPINGS)
+            cluster.call(master.create_index, "mb",
+                         number_of_shards=2, number_of_replicas=1,
+                         settings={"index.tenant.default": "ingest"})
+            cluster.run_for(30)
+            seed_resp = cluster.call(master.bulk, "md",
+                                     _corpus(corpus_n))
+            assert seed_resp["errors"] == [], seed_resp
+            cluster.call(master.refresh)
+            # baseline report lays the history-ring sample the final
+            # report's windowed deltas anchor against
+            cluster.call(master.health_report)
+
+        t0 = queue.now()
+        transcript: List[Dict[str, Any]] = []
+        disruptions: List[Dict[str, Any]] = []
+        pending = [0]
+        acked_ids: set = set()
+
+        def begin(wclass: str, op: str, tenant: Optional[str]):
+            row: Dict[str, Any] = {
+                "t_s": round(queue.now() - t0, 3),
+                "class": wclass, "op": op}
+            if tenant is not None:
+                row["tenant"] = tenant
+            row["_start"] = queue.now()
+            pending[0] += 1
+            return row
+
+        def finish(row: Dict[str, Any], err) -> None:
+            pending[0] -= 1
+            row["took_ms"] = round(
+                (queue.now() - row.pop("_start")) * 1000.0, 3)
+            row["ok"] = err is None
+            transcript.append(row)
+
+        def coord(k: int):
+            ids = cluster.live_ids()
+            return cluster.cluster_nodes[ids[k % len(ids)]]
+
+        # ---- open-loop issue thunks (one per class) ------------------
+        # searches coordinate on the stable master so ONE node's
+        # windowed table crosses the workload_slo requests floor (the
+        # indicator reads per-node windows); bulks rotate coordinators
+        # so the /_workload/stats fan-out merges a real multi-node table
+
+        def issue_interactive(tenant: str, variant: int):
+            def fire():
+                row = begin("interactive", "search", tenant)
+                body = dict(_INTERACTIVE_BODIES[
+                    variant % len(_INTERACTIVE_BODIES)])
+                body["tenant"] = tenant
+                master.search("md", body,
+                              on_done=lambda r, e=None: finish(row, e))
+            return fire
+
+        def issue_aggs(tenant: str):
+            def fire():
+                row = begin("aggs", "aggs", tenant)
+                body = dict(_AGGS_BODY)
+                body["tenant"] = tenant
+                master.search("md", body,
+                              on_done=lambda r, e=None: finish(row, e))
+            return fire
+
+        def issue_bulk(k: int, rnd: int, j: int):
+            def fire():
+                row = begin("bulk", "bulk", "ingest")
+                ids = [f"mb-{rnd}-{j}-{i}" for i in range(bulk_batch)]
+                items = [{"op": "index", "id": did,
+                          "source": {"body": f"ingest doc {did}",
+                                     "n": i}}
+                         for i, did in enumerate(ids)]
+
+                def done(r, e=None):
+                    if e is None and r:
+                        for i, it in enumerate(r.get("items", [])):
+                            if it and "error" not in it:
+                                acked_ids.add(ids[i])
+                    finish(row, e)
+
+                coord(k).bulk("mb", items, on_done=done)
+            return fire
+
+        def issue_scroll(tenant: str):
+            # drains run through the stable master coordinator: the
+            # cursor record lives on the node that opened it
+            def fire():
+                row = begin("scroll", "scroll_drain", tenant)
+                row["pages"] = 0
+
+                def on_page(r, e=None):
+                    if e is not None or not r["hits"]["hits"]:
+                        finish(row, e)
+                        return
+                    row["pages"] += 1
+                    master.scroll(r["_scroll_id"], 60.0,
+                                  on_done=on_page)
+
+                master.search(
+                    "md", {"tenant": tenant,
+                           "query": {"match_all": {}}, "size": 10},
+                    on_done=on_page, scroll=60.0)
+            return fire
+
+        def issue_async(tenant: str, variant: int):
+            def fire():
+                row = begin("async", "async_submit", tenant)
+                body = dict(_INTERACTIVE_BODIES[
+                    variant % len(_INTERACTIVE_BODIES)])
+                body["tenant"] = tenant
+
+                def on_sub(r, e=None):
+                    finish(row, e)
+                    sid = (r or {}).get("id")
+                    if not sid:
+                        return
+                    srow = begin("async", "async_status", tenant)
+
+                    def on_get(r2, e2=None):
+                        finish(srow, e2)
+
+                    queue.schedule(
+                        2.0, lambda: master.get_async_search(
+                            sid, None, on_done=on_get),
+                        f"macro async status [{sid}]")
+
+                master.submit_async_search("md", body, None,
+                                           on_done=on_sub)
+            return fire
+
+        # ---- chaos thunks -------------------------------------------
+
+        bounce = {"node": None}
+
+        def fire_reroute():
+            state = master.state
+            copies = [s for s in state.routing_table.all_shards()
+                      if s.index == "md" and s.shard_id == 0
+                      and s.current_node_id]
+            src = next((s.current_node_id for s in copies if s.primary),
+                       None)
+            holders = {s.current_node_id for s in copies}
+            free = sorted(set(cluster.live_ids()) - holders)
+            entry = {"t_s": round(queue.now() - t0, 3),
+                     "event": "reroute", "index": "md", "shard": 0,
+                     "from": src, "to": free[0] if free else None,
+                     "acked": False}
+            disruptions.append(entry)
+            if src is None or not free:
+                return
+
+            def done(r, e=None):
+                entry["acked"] = e is None
+
+            master.reroute(commands=[{"move": {
+                "index": "md", "shard": 0,
+                "from_node": src, "to_node": free[0]}}], on_done=done)
+
+        def fire_stop():
+            victims = [i for i in cluster.live_ids()
+                       if i != master.local_node.node_id]
+            if not victims:
+                return
+            bounce["node"] = victims[0]
+            disruptions.append({"t_s": round(queue.now() - t0, 3),
+                                "event": "node_stop",
+                                "node": bounce["node"]})
+            cluster.stop_node(bounce["node"])
+
+        def fire_restart():
+            if bounce["node"] is None:
+                return
+            disruptions.append({"t_s": round(queue.now() - t0, 3),
+                                "event": "node_restart",
+                                "node": bounce["node"]})
+            cluster.restart_node(bounce["node"])
+
+        # ring anchor: a report between the reroute and the node stop
+        # lays the history sample the probe's 60s window anchors
+        # against (the ring samples on report boundaries only)
+        def fire_anchor():
+            pending[0] += 1
+
+            def done(r, e=None):
+                pending[0] -= 1
+
+            master.health_report(on_done=done)
+
+        # mid-run async health probe: catches workload_slo while the
+        # chaos-window burn is still inside the indicator's window
+        slo_mid: Dict[str, Any] = {"status": None, "named": []}
+
+        def fire_probe():
+            pending[0] += 1
+
+            def done(r, e=None):
+                pending[0] -= 1
+                if e is None:
+                    ind = r["indicators"].get("workload_slo", {})
+                    slo_mid["t_s"] = round(queue.now() - t0, 3)
+                    slo_mid["status"] = ind.get("status")
+                    slo_mid["named"] = sorted({
+                        res for d in ind.get("diagnosis", [])
+                        for res in d.get("affected_resources", [])})
+
+            master.health_report(on_done=done)
+
+        # ---- build the arrival schedule (all randomness up front) ----
+
+        events: List[Any] = []
+        seq = 0
+        for rnd in range(rounds):
+            base = rnd * round_s
+            for _ in range(per_round["interactive"]):
+                events.append((base + rng.uniform(0, round_s), seq,
+                               issue_interactive(rng.choice(TENANTS),
+                                                 seq)))
+                seq += 1
+            for _ in range(per_round["aggs"]):
+                events.append((base + rng.uniform(0, round_s), seq,
+                               issue_aggs(rng.choice(TENANTS))))
+                seq += 1
+            for j in range(per_round["bulk"]):
+                events.append((base + rng.uniform(0, round_s), seq,
+                               issue_bulk(seq, rnd, j)))
+                seq += 1
+            for _ in range(per_round["scroll"]):
+                events.append((base + rng.uniform(0, round_s), seq,
+                               issue_scroll(rng.choice(TENANTS))))
+                seq += 1
+            for _ in range(per_round["async"]):
+                events.append((base + rng.uniform(0, round_s), seq,
+                               issue_async(rng.choice(TENANTS), seq)))
+                seq += 1
+        events.append((0.35 * horizon, seq, fire_reroute))
+        events.append((0.55 * horizon, seq + 1, fire_stop))
+        events.append((0.75 * horizon, seq + 2, fire_restart))
+        events.append((0.45 * horizon, seq + 3, fire_anchor))
+        events.append((0.90 * horizon, seq + 4, fire_probe))
+        events.sort(key=lambda e: (e[0], e[1]))
+
+        # ---- drive --------------------------------------------------
+
+        for t_arr, _, fire in events:
+            dt = (t0 + t_arr) - queue.now()
+            if dt > 0:
+                queue.run_for(dt)
+            fire()
+        drained = False
+        for _ in range(240):
+            if pending[0] == 0:
+                drained = True
+                break
+            queue.run_for(1.0)
+        workload_virtual_s = max(horizon, 1e-9)
+
+        # ---- verify + report (back to closed loop) ------------------
+
+        with _telectx.activate_workload_class("_default"):
+            cluster.run_for(60)  # let recovery/re-replication settle
+            cluster.call(master.refresh)
+            found = cluster.call(
+                master.search, "mb",
+                {"query": {"match_all": {}},
+                 "size": 0})["hits"]["total"]["value"]
+            cluster.run_for(11)  # ring boundary before the report
+            report = cluster.call(master.health_report)
+            merged = cluster.call(master.workload_stats)
+
+        slo_ind = report["indicators"].get("workload_slo", {})
+        classes_out: Dict[str, Any] = {}
+        for c in sorted(merged["classes"]):
+            if c.startswith("_"):
+                continue
+            e = merged["classes"][c]
+            ops = sum(1 for r in transcript if r["class"] == c)
+            classes_out[c] = {
+                "ops": ops,
+                "qps_virtual": round(ops / workload_virtual_s, 3),
+                "searches": e["search"]["count"],
+                "failed": e["search"]["failed"],
+                "p50_ms": e["search"]["latency"]["p50_ms"],
+                "p99_ms": e["search"]["latency"]["p99_ms"],
+                "slo_objective_ms": e["slo"]["objective_ms"],
+                "slo_violations": e["slo"]["violations"],
+                "slo_burn_pct": e["slo"]["budget_burn_pct"],
+                "indexing_bytes": e["indexing"]["bytes"],
+                "rejections": e["indexing"]["rejections"],
+            }
+        transcript_blob = json.dumps(transcript, sort_keys=True)
+        return {
+            "seed": seed,
+            "smoke": bool(smoke),
+            "rounds": rounds,
+            "horizon_virtual_s": horizon,
+            "requests_issued": len(events) - 5,
+            "requests_completed": len(transcript),
+            "drained": drained,
+            "classes": classes_out,
+            "acked_writes": len(acked_ids),
+            "docs_found": found,
+            "acked_write_loss": max(0, len(acked_ids) - found),
+            "disruptions": disruptions,
+            "workload_slo": {
+                "status": slo_ind.get("status"),
+                "named": sorted({
+                    r for d in slo_ind.get("diagnosis", [])
+                    for r in d.get("affected_resources", [])}),
+            },
+            "workload_slo_mid": slo_mid,
+            "workload_cardinality": merged["cardinality"],
+            "transcript_rows": len(transcript),
+            "transcript_sha256": hashlib.sha256(
+                transcript_blob.encode()).hexdigest(),
+            "transcript": transcript,
+        }
+    finally:
+        cluster.stop_all()

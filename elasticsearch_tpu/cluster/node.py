@@ -103,6 +103,9 @@ HEALTH_REPORT_ACTION = "cluster:monitor/health_report[n]"
 # per-node tenant-accounting slice behind `GET /_tenants/stats` /
 # `GET /_cat/tenants` (telemetry/tenants.py)
 TENANTS_STATS_ACTION = "cluster:monitor/tenants/stats[n]"
+# per-node workload-class slice behind `GET /_workload/stats` /
+# `GET /_cat/workload` (telemetry/workload.py)
+WORKLOAD_STATS_ACTION = "cluster:monitor/workload/stats[n]"
 # launch-path flight recorder: per-node (spans, launch/readback events)
 # slice of one trace, stitched by the coordinator into a cross-node
 # request waterfall (GET /_flight_recorder/waterfall/{trace_id})
@@ -226,6 +229,14 @@ class ClusterNode:
             self.settings.get, self.telemetry.metrics,
             history=self.telemetry.history)
         self.telemetry.flight.tenants = self.telemetry.tenants
+        # workload-class accounting rides the same settings seam
+        # (`workload.max`, `workload.slo.*` — telemetry/workload.py)
+        from elasticsearch_tpu.telemetry.workload import (
+            WorkloadAccounting)
+        self.telemetry.workload = WorkloadAccounting.from_settings(
+            self.settings.get, self.telemetry.metrics,
+            history=self.telemetry.history)
+        self.telemetry.flight.workloads = self.telemetry.workload
         # memory protection: hierarchical circuit breakers charged on
         # the live path (transport inbound → in_flight_requests, device
         # cache → hbm, search host staging → request) + in-flight
@@ -238,6 +249,7 @@ class ClusterNode:
         self.indexing_pressure = IndexingPressure.from_settings(
             self.settings.get, metrics=self.telemetry.metrics)
         self.indexing_pressure.tenants = self.telemetry.tenants
+        self.indexing_pressure.workloads = self.telemetry.workload
         # cluster task management: every coordinator/handler action
         # registers here; running time reads the scheduler clock so
         # seeded runs replay identical task trees
@@ -369,6 +381,7 @@ class ClusterNode:
             (RECOVERY_STATS_ACTION, self._on_recovery_stats),
             (HEALTH_REPORT_ACTION, self._on_health_report),
             (TENANTS_STATS_ACTION, self._on_tenants_stats),
+            (WORKLOAD_STATS_ACTION, self._on_workload_stats),
             (FLIGHT_TRACE_ACTION, self._on_flight_trace),
             (NODE_SHUTDOWN_PUT_ACTION, self._on_put_shutdown),
             (NODE_SHUTDOWN_GET_ACTION, self._on_get_shutdown),
@@ -1307,6 +1320,7 @@ class ClusterNode:
             watchdog=self.health_watchdog,
             flight=self.telemetry.flight,
             tenants=self.telemetry.tenants,
+            workload=self.telemetry.workload,
             repositories=self.repositories,
             snapshots=self.snapshots)
 
@@ -1400,6 +1414,50 @@ class ClusterNode:
 
             self.transport.send_request(
                 node, TENANTS_STATS_ACTION, {},
+                ResponseHandler(ok, fail), timeout=30.0)
+
+    # ------------------------------------------------ workload accounting
+
+    def _on_workload_stats(self, req, channel, src) -> None:
+        channel.send_response({
+            "node": self.local_node.node_id,
+            "workload": self.telemetry.workload.stats()})
+
+    def workload_stats(self,
+                       on_done: Callable = lambda r, e: None) -> None:
+        """`GET /_workload/stats`: the tenants_stats fan-out for the
+        request-class tables — WORKLOAD_STATS_ACTION to every node,
+        merged deterministically (telemetry/workload.py
+        merge_workload_stats). Unreachable nodes compose as
+        `node_failures`."""
+        from elasticsearch_tpu.telemetry.workload import (
+            merge_workload_stats)
+        nodes = list(self.state.nodes.nodes)
+        if not nodes:
+            local = self.telemetry.workload.stats()
+            on_done(merge_workload_stats(
+                {self.local_node.node_id: local}), None)
+            return
+        sections: Dict[str, Dict[str, Any]] = {}
+        failures: List[Dict[str, str]] = []
+        pending = {"n": len(nodes)}
+
+        def finish():
+            pending["n"] -= 1
+            if pending["n"] == 0:
+                on_done(merge_workload_stats(sections, failures), None)
+
+        for node in nodes:
+            def ok(resp, _nid=node.node_id):
+                sections[_nid] = resp.get("workload", {})
+                finish()
+
+            def fail(exc, _nid=node.node_id):
+                failures.append({"node": _nid, "error": str(exc)})
+                finish()
+
+            self.transport.send_request(
+                node, WORKLOAD_STATS_ACTION, {},
                 ResponseHandler(ok, fail), timeout=30.0)
 
     def cluster_health(self) -> Dict[str, Any]:
@@ -1525,6 +1583,13 @@ class ClusterNode:
                 with _telectx.activate_tenant(str(default)):
                     self.bulk(index, items, on_done)
                 return
+        if _telectx.current_workload_class() is None:
+            # bulk is its own workload class; the re-entry puts it on
+            # the rail so pressure charges / tasks / flight events all
+            # attribute the indexing burst
+            with _telectx.activate_workload_class("bulk"):
+                self.bulk(index, items, on_done)
+            return
         if not items:
             # nothing to fan out: complete immediately (charging and
             # waiting on zero shard responses would leak the charge and

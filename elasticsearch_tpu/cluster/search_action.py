@@ -763,6 +763,19 @@ class DistributedSearchService:
                     self.search(state, index_expression, body, on_done,
                                 scroll=scroll, task=task, _plan=_plan)
                 return
+        wclass = _telectx.current_workload_class()
+        if wclass is None:
+            # precedence: header (already ambient) > request shape;
+            # cursor continuations (`_plan`) re-enter with the class the
+            # opening request stored, so they never reach this branch
+            from elasticsearch_tpu.telemetry.workload import (
+                classify_search_request)
+            with _telectx.activate_workload_class(
+                    classify_search_request(
+                        body, scroll=scroll if _plan is None else None)):
+                self.search(state, index_expression, body, on_done,
+                            scroll=scroll, task=task, _plan=_plan)
+            return
         if _plan is None and body.get("pit"):
             self._search_pit(state, index_expression, body, on_done,
                              scroll=scroll, task=task)
@@ -820,6 +833,8 @@ class DistributedSearchService:
                     tenant, took_ms, failed=err is not None,
                     shards=(0 if resp is None else
                             resp.get("_shards", {}).get("total", 0)))
+                tele.workload.record_search(wclass, took_ms,
+                                            failed=err is not None)
                 if err is not None:
                     tele.metrics.inc("search.failed")
                     root_span.finish(outcome="error",
@@ -850,6 +865,7 @@ class DistributedSearchService:
                         slowest_stage=slowest_stage_summary(resp),
                         opaque_id=_telectx.current_opaque_id(),
                         tenant=tenant,
+                        workload_class=wclass,
                         flight=(_fl.summary_for_trace(_trace_id)
                                 if _fl is not None and _trace_id
                                 else None))
@@ -1081,6 +1097,11 @@ class DistributedSearchService:
                 "relation": resp["hits"]["total"].get("relation", "eq"),
                 "shards": entries,
                 "portable": portable,
+                # attribution survives the submitting request: every
+                # continuation page re-enters under the tenant and
+                # workload class that opened the scroll
+                "tenant": _telectx.current_tenant(),
+                "wclass": _telectx.current_workload_class(),
             }
             self._advance_cursors(rec, page)
             self._scrolls[scroll_id] = rec
@@ -1203,13 +1224,21 @@ class DistributedSearchService:
                 return
             on_done(resp, None)
 
-        self.search(
-            state, ",".join(rec["indices"]), body, done,
-            _plan={"groups": groups, "allow_partial": False,
-                   "hooks": {"reader_ext": reader_ext,
-                             "on_shard_query": on_shard_query,
-                             "fetch_ext": self._make_fetch_ext(entries),
-                             "on_page": on_page}})
+        # continuation pages re-enter under the opening request's
+        # attribution (satellite: cursor pages used to run unstamped —
+        # slowlog/tasks/accounting lost the class once the submitting
+        # request returned)
+        with _telectx.activate_tenant(rec.get("tenant")), \
+                _telectx.activate_workload_class(
+                    rec.get("wclass") or "scroll"):
+            self.search(
+                state, ",".join(rec["indices"]), body, done,
+                _plan={"groups": groups, "allow_partial": False,
+                       "hooks": {"reader_ext": reader_ext,
+                                 "on_shard_query": on_shard_query,
+                                 "fetch_ext":
+                                     self._make_fetch_ext(entries),
+                                 "on_page": on_page}})
 
     def _scroll_copy_plan(self, state: ClusterState, index: str,
                           shard: int, entry: Dict[str, Any],
@@ -1307,6 +1336,10 @@ class DistributedSearchService:
                 "keep_alive": ka,
                 "expires_at": self.scheduler.now() + ka,
                 "shards": entries,
+                # searches against the PIT re-enter under the opener's
+                # attribution (cursor-path stamp carry-through)
+                "tenant": _telectx.current_tenant(),
+                "wclass": _telectx.current_workload_class(),
             }
             on_done({"id": pit_id}, None)
 
@@ -1410,13 +1443,19 @@ class DistributedSearchService:
                 err = SearchContextMissingException(str(pit_id))
             on_done(resp, err)
 
-        self.search(
-            state, ",".join(rec["indices"]), body2, done, task=task,
-            _plan={"groups": groups, "allow_partial": False,
-                   "hooks": {"reader_ext": reader_ext,
-                             "on_shard_query": on_shard_query,
-                             "fetch_ext": self._make_fetch_ext(entries),
-                             "on_page": on_page}})
+        # PIT searches re-enter under the opener's stored attribution
+        # (the submitting request may be long gone)
+        with _telectx.activate_tenant(rec.get("tenant")), \
+                _telectx.activate_workload_class(
+                    rec.get("wclass") or "scroll"):
+            self.search(
+                state, ",".join(rec["indices"]), body2, done, task=task,
+                _plan={"groups": groups, "allow_partial": False,
+                       "hooks": {"reader_ext": reader_ext,
+                                 "on_shard_query": on_shard_query,
+                                 "fetch_ext":
+                                     self._make_fetch_ext(entries),
+                                 "on_page": on_page}})
 
     # -- cursor bookkeeping ----------------------------------------------
 

@@ -125,6 +125,7 @@ class HealthContext:
     watchdog: Any = None             # StalledProgressWatchdog
     flight: Any = None               # FlightRecorder (launch-path ring)
     tenants: Any = None              # TenantAccounting (per-tenant table)
+    workload: Any = None             # WorkloadAccounting (per-class table)
     repositories: Any = None         # RepositoriesService (snapshot repos)
     snapshots: Any = None            # ClusterSnapshotService (in-flight)
 

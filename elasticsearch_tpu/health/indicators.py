@@ -63,6 +63,14 @@ NOISY_SLOTS_FLOOR = 16           # cohort slots in window
 NOISY_LAUNCH_MS_FLOOR = 50.0     # device launch-ms in window
 NOISY_REJECTIONS_FLOOR = 5       # rejections + breaker trips in window
 
+# workload SLO (workload-class accounting): a class burning its
+# windowed error budget — violations vs the violation rate the
+# availability target allows. The request floor keeps a trickle (one
+# slow request against an empty class) from flipping the report
+WORKLOAD_BURN_YELLOW = 100.0     # % of windowed budget burned
+WORKLOAD_BURN_RED = 500.0        # burning 5x faster than allowed
+WORKLOAD_REQUESTS_FLOOR = 8      # windowed requests before judging
+
 
 def shard_availability_summary(
         cluster_state: Optional[Any]) -> Dict[str, Any]:
@@ -766,6 +774,96 @@ class NoisyNeighborIndicator(HealthIndicator):
             details=details, impacts=impacts, diagnoses=diagnoses)
 
 
+class WorkloadSloIndicator(HealthIndicator):
+    """Names the workload class burning its error budget.
+
+    Reads the per-class counters WorkloadAccounting feeds the registry
+    (windowed off the history ring, so a burst that recovered stays
+    green): for every active class with an objective, the windowed
+    ``workload.slo.violations`` against ``workload.search.requests``
+    becomes a budget-burn percentage (telemetry/shaping.py
+    budget_burn_pct — the same math `/_workload/stats` renders). A
+    class indicts only past a request floor; YELLOW when it burns its
+    whole windowed budget, RED when it burns 5x that. The typed
+    diagnosis names the burning class — the live half of the BENCH
+    macro rider's per-class SLO row."""
+
+    name = "workload_slo"
+
+    def compute(self, ctx: HealthContext) -> HealthIndicatorResult:
+        if ctx.workload is None:
+            return HealthIndicatorResult(
+                name=self.name, status=HealthStatus.UNKNOWN,
+                symptom="no workload accounting wired")
+        from elasticsearch_tpu.telemetry.shaping import budget_burn_pct
+        classes = ctx.workload.active_classes()
+
+        def windowed(metric: str, c: str) -> float:
+            if ctx.history is None:
+                return 0.0
+            return ctx.history.delta(metric, HEALTH_RATE_WINDOW_S,
+                                     workload=c)
+
+        details: Dict[str, Any] = {
+            "window_s": HEALTH_RATE_WINDOW_S,
+            "active_classes": classes,
+            "classes": {},
+        }
+        findings: List[Dict[str, Any]] = []
+        for c in classes:
+            objective = ctx.workload.objective_ms(c)
+            requests = windowed("workload.search.requests", c)
+            violations = windowed("workload.slo.violations", c)
+            entry: Dict[str, Any] = {
+                "objective_ms": objective,
+                "requests_in_window": round(requests, 3),
+                "violations_in_window": round(violations, 3),
+            }
+            if objective is not None and \
+                    requests >= WORKLOAD_REQUESTS_FLOOR:
+                burn = budget_burn_pct(requests, violations)
+                entry["budget_burn_pct"] = burn
+                if burn >= WORKLOAD_BURN_YELLOW:
+                    findings.append({
+                        "class": c, "burn": burn,
+                        "status": (HealthStatus.RED
+                                   if burn >= WORKLOAD_BURN_RED
+                                   else HealthStatus.YELLOW)})
+            details["classes"][c] = entry
+        if not findings:
+            return HealthIndicatorResult(
+                name=self.name, status=HealthStatus.GREEN,
+                symptom="every workload class is inside its "
+                        "error budget",
+                details=details)
+        status = HealthStatus.worst(*(f["status"] for f in findings))
+        worst = max(findings, key=lambda f: (
+            HealthStatus._ORDER[f["status"]], f["burn"], f["class"]))
+        symptom = (f"workload class [{worst['class']}] burned "
+                   f"{worst['burn']:.0f}% of its error budget over "
+                   f"the last {int(HEALTH_RATE_WINDOW_S)}s")
+        impacts = [Impact(
+            id="workload_slo_burn", severity=2,
+            description="requests in the burning class exceed their "
+                        "latency objective faster than the "
+                        "availability target allows; its users see "
+                        "degraded service",
+            impact_areas=["search"])]
+        diagnoses = [Diagnosis(
+            id="workload_slo:error_budget_burn",
+            cause=f"class [{f['class']}] burned {f['burn']:.0f}% of "
+                  f"its windowed error budget",
+            action="inspect GET /_workload/stats for the class's "
+                   "latency distribution; check noisy_neighbor for a "
+                   "hog tenant, batcher fill for under-batching, and "
+                   "the flight recorder's regime for a degraded "
+                   "device path",
+            affected_resources=[f["class"]]) for f in findings]
+        return HealthIndicatorResult(
+            name=self.name, status=status, symptom=symptom,
+            details=details, impacts=impacts, diagnoses=diagnoses)
+
+
 class RepositoryIntegrityIndicator(HealthIndicator):
     """Snapshot repository integrity: RED on structural damage found by
     ``verify_integrity()`` (generation mismatch, corrupted metadata,
@@ -867,5 +965,6 @@ DEFAULT_INDICATORS = (
     NodeShutdownIndicator,
     FlightRegimeIndicator,
     NoisyNeighborIndicator,
+    WorkloadSloIndicator,
     RepositoryIntegrityIndicator,
 )

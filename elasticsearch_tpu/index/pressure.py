@@ -78,6 +78,9 @@ class IndexingPressure:
         # marks are the same payload fanning out — charging them too
         # would double-count), rejections at every stage
         self.tenants = None
+        # optional WorkloadAccounting sink: same charge policy keyed by
+        # the ambient workload class
+        self.workloads = None
 
     @property
     def replica_limit(self) -> int:
@@ -104,9 +107,11 @@ class IndexingPressure:
               label: str) -> Callable[[], None]:
         n_bytes = int(n_bytes)
         tenant = None
-        if self.tenants is not None:
+        wclass = None
+        if self.tenants is not None or self.workloads is not None:
             from elasticsearch_tpu.telemetry import context as _telectx
             tenant = _telectx.current_tenant()
+            wclass = _telectx.current_workload_class()
         with self._lock:
             # coordinating + primary share the base budget; replica ops
             # get the 1.5x headroom. All stages' bytes count toward the
@@ -120,6 +125,8 @@ class IndexingPressure:
                                      stage=stage)
                 if self.tenants is not None:
                     self.tenants.record_rejection(tenant, stage)
+                if self.workloads is not None:
+                    self.workloads.record_rejection(wclass, stage)
                 raise EsRejectedExecutionException(
                     f"rejecting operation [{label}] at {stage} stage: "
                     f"in-flight indexing bytes [{would}] would exceed "
@@ -132,6 +139,8 @@ class IndexingPressure:
                                  sum(self._current.values()))
         if self.tenants is not None and stage == COORDINATING:
             self.tenants.record_indexing(tenant, n_bytes)
+        if self.workloads is not None and stage == COORDINATING:
+            self.workloads.record_indexing(wclass, n_bytes)
         released = {"done": False}
 
         def release() -> None:

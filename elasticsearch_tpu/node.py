@@ -94,6 +94,15 @@ class Node:
         self.telemetry.flight.tenants = self.telemetry.tenants
         self.breaker_service.tenants = self.telemetry.tenants
         self.indexing_pressure.tenants = self.telemetry.tenants
+        # workload-class accounting (telemetry/workload.py): the
+        # request-kind half of the same attribution rail
+        from elasticsearch_tpu.telemetry.workload import (
+            WorkloadAccounting)
+        self.telemetry.workload = WorkloadAccounting.from_settings(
+            settings.get, self.telemetry.metrics,
+            history=self.telemetry.history)
+        self.telemetry.flight.workloads = self.telemetry.workload
+        self.indexing_pressure.workloads = self.telemetry.workload
         self.indices_service = IndicesService(self.data_path, settings)
         # the shared device cache charges the `hbm` child breaker on
         # segment/filter-mask admission (LRU eviction pressure first),
@@ -111,6 +120,10 @@ class Node:
         # one slot to its tenant (search/batching.py)
         self.search_service.plan_batcher.tenants = self.telemetry.tenants
         self.search_service.knn_batcher.tenants = self.telemetry.tenants
+        self.search_service.plan_batcher.workloads = \
+            self.telemetry.workload
+        self.search_service.knn_batcher.workloads = \
+            self.telemetry.workload
         # mesh serving backend: dispatch/fallback counters mirror into
         # the node registry (search.mesh.dispatch{axis} /
         # search.mesh.fallback{reason}) next to its own stats surface
@@ -153,6 +166,7 @@ class Node:
                 watchdog=_self.health_watchdog,
                 flight=_self.telemetry.flight,
                 tenants=_self.telemetry.tenants,
+                workload=_self.telemetry.workload,
                 repositories=_self.repositories_service)
 
         self.health = HealthService(context_fn=_health_context)

@@ -117,6 +117,10 @@ class RestController:
         # right tenant's accounting row
         tenant = next((str(v) for k, v in (headers or {}).items()
                        if k.lower() == "x-tenant-id"), None)
+        # workload-class attribution: X-Workload-Class is the strongest
+        # tag (precedence: header > request shape classification)
+        workload = next((str(v) for k, v in (headers or {}).items()
+                         if k.lower() == "x-workload-class"), None)
         flight = getattr(getattr(self.node, "telemetry", None),
                          "flight", None)
         matched_path = False
@@ -136,6 +140,9 @@ class RestController:
                     if tenant:
                         stack.enter_context(
                             _telectx.activate_tenant(tenant))
+                    if workload:
+                        stack.enter_context(
+                            _telectx.activate_workload_class(workload))
                     if flight is not None:
                         stack.enter_context(_flightrec.activate(flight))
                     return handler(self.node, params, body, **kwargs)
@@ -185,6 +192,7 @@ def _register_all(c: RestController):
     c.register("GET", "/_health_report", health_report)
     c.register("GET", "/_health_report/{indicator}", health_report)
     c.register("GET", "/_tenants/stats", tenants_stats)
+    c.register("GET", "/_workload/stats", workload_stats)
     c.register("GET", "/_cluster/pending_tasks", cluster_pending_tasks)
     c.register("GET", "/_cluster/stats", cluster_stats)
     c.register("GET", "/_nodes/stats", nodes_stats)
@@ -199,6 +207,7 @@ def _register_all(c: RestController):
     c.register("GET", "/_cat/indices", cat_indices)
     c.register("GET", "/_cat/health", cat_health)
     c.register("GET", "/_cat/tenants", cat_tenants)
+    c.register("GET", "/_cat/workload", cat_workload)
     c.register("GET", "/_cat/count", cat_count)
     c.register("GET", "/_cat/shards", cat_shards)
     c.register("GET", "/_stats", indices_stats)
@@ -684,6 +693,17 @@ def tenants_stats(node, params, body):
     return 200, merged
 
 
+def workload_stats(node, params, body):
+    """GET /_workload/stats — per-class accounting
+    (telemetry/workload.py). Single-process: the local table rendered
+    through the same merge the cluster fan-out uses."""
+    from elasticsearch_tpu.telemetry.workload import merge_workload_stats
+    merged = merge_workload_stats(
+        {node.node_id: node.telemetry.workload.stats()})
+    merged["cluster_name"] = node.cluster_name
+    return 200, merged
+
+
 def cluster_stats(node, params, body):
     indices = node.indices_service.indices
     docs = sum(idx.stats()["docs"]["count"] for idx in indices.values())
@@ -916,6 +936,13 @@ def cat_tenants(node, params, body):
     from elasticsearch_tpu.telemetry.tenants import render_cat_tenants
     _, merged = tenants_stats(node, params, body)
     return 200, {"_cat": render_cat_tenants(merged)}
+
+
+def cat_workload(node, params, body):
+    # projection of /_workload/stats through the shared shaping helper
+    from elasticsearch_tpu.telemetry.workload import render_cat_workload
+    _, merged = workload_stats(node, params, body)
+    return 200, {"_cat": render_cat_workload(merged)}
 
 
 def cat_count(node, params, body):
@@ -1313,19 +1340,23 @@ def bulk(node, params, body, index=None):
     IndexingPressure.markCoordinatingOperationStarted in
     TransportBulkAction)."""
     from elasticsearch_tpu.index.pressure import operation_size_bytes
+    from elasticsearch_tpu.telemetry import context as _telectx
     ip = getattr(node, "indexing_pressure", None)
-    release = None
-    if ip is not None:
-        nbytes = (len(body) if isinstance(body, (bytes, str))
-                  else operation_size_bytes(body))
-        release = ip.mark_coordinating_operation_started(nbytes, "_bulk")
-    try:
-        return _bulk_inner(node, params, body, index)
-    finally:
-        # release-on-completion: in-flight bytes return to zero as soon
-        # as the response (or rejection) is determined
-        if release is not None:
-            release()
+    with _telectx.activate_workload_class(
+            _telectx.current_workload_class() or "bulk"):
+        release = None
+        if ip is not None:
+            nbytes = (len(body) if isinstance(body, (bytes, str))
+                      else operation_size_bytes(body))
+            release = ip.mark_coordinating_operation_started(
+                nbytes, "_bulk")
+        try:
+            return _bulk_inner(node, params, body, index)
+        finally:
+            # release-on-completion: in-flight bytes return to zero as
+            # soon as the response (or rejection) is determined
+            if release is not None:
+                release()
 
 
 def _bulk_inner(node, params, body, index=None):

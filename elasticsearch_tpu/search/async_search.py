@@ -33,11 +33,18 @@ DEFAULT_KEEP_ALIVE = 5 * 24 * 3600.0  # 5d, ref: async-search default
 class _AsyncSearch:
     def __init__(self, search_id: str, index_expression: str,
                  body: Dict[str, Any], keep_alive: float,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 tenant: Optional[str] = None,
+                 wclass: Optional[str] = None):
         self.clock = clock or time.time
         self.id = search_id
         self.index_expression = index_expression
         self.body = body
+        # the submitter's attribution, re-entered by the background run
+        # and stamped on every status render — long-running work stays
+        # attributable after the submitting request returns
+        self.tenant = tenant
+        self.wclass = wclass
         self.start_ms = int(self.clock() * 1000)
         self.expires_at = self.clock() + keep_alive
         self.done = threading.Event()
@@ -69,8 +76,13 @@ class AsyncSearchService:
                                       "keep_alive")
         search_id = base64.urlsafe_b64encode(
             uuid.uuid4().bytes).decode().rstrip("=")
-        search = _AsyncSearch(search_id, index_expression, body or {},
-                              keep_alive, clock=self.clock)
+        from elasticsearch_tpu.telemetry import context as _telectx
+        search = _AsyncSearch(
+            search_id, index_expression, body or {}, keep_alive,
+            clock=self.clock,
+            # capture BEFORE the thread boundary: TLS does not cross it
+            tenant=_telectx.current_tenant(),
+            wclass=_telectx.current_workload_class() or "async")
         task = self.task_manager.register(
             "transport", "indices:data/read/async_search/submit",
             description=f"async_search indices[{index_expression}]",
@@ -82,8 +94,12 @@ class AsyncSearchService:
 
         def run():
             try:
-                search.response = self.search_service.search(
-                    index_expression, search.body, task=task)
+                # re-enter the submitter's attribution on the worker
+                # thread (fresh TLS)
+                with _telectx.activate_tenant(search.tenant), \
+                        _telectx.activate_workload_class(search.wclass):
+                    search.response = self.search_service.search(
+                        index_expression, search.body, task=task)
             except TaskCancelledException:
                 search.error = {"type": "task_cancelled_exception",
                                 "reason": "async search was cancelled"}
@@ -155,6 +171,10 @@ class AsyncSearchService:
             "start_time_in_millis": search.start_ms,
             "expiration_time_in_millis": int(search.expires_at * 1000),
         }
+        if search.tenant is not None:
+            out["tenant"] = search.tenant
+        if search.wclass is not None:
+            out["search.class"] = search.wclass
         if search.error is not None:
             out["error"] = search.error
             # REST handlers surface the stored failure status (ES returns
@@ -246,6 +266,7 @@ class ClusterAsyncSearchService:
             "transport", ASYNC_SUBMIT_ACTION,
             description=f"async_search indices[{index_expression}]",
             cancellable=True)
+        from elasticsearch_tpu.telemetry import context as _telectx
         rec: Dict[str, Any] = {
             "id": search_id, "index": index_expression,
             "start": now, "keep_alive": keep_alive,
@@ -254,6 +275,10 @@ class ClusterAsyncSearchService:
             "error": None, "error_status": 500,
             "completed_at": None, "task": task,
             "waiters": [],
+            # submitter attribution: stamped on every status render and
+            # re-entered by the fan-out below
+            "tenant": _telectx.current_tenant(),
+            "wclass": _telectx.current_workload_class() or "async",
         }
         self._searches[search_id] = rec
         responded = {"done": False}
@@ -294,8 +319,10 @@ class ClusterAsyncSearchService:
 
         self.scheduler.schedule(max(wait, 0.0), respond,
                                 f"async_search wait [{search_id}]")
-        self.search_fn(index_expression, body or {}, search_done,
-                       task=task)
+        with _telectx.activate_tenant(rec["tenant"]), \
+                _telectx.activate_workload_class(rec["wclass"]):
+            self.search_fn(index_expression, body or {}, search_done,
+                           task=task)
 
     # ---------------------------------------------------------- get/delete
 
@@ -443,6 +470,10 @@ class ClusterAsyncSearchService:
             "start_time_in_millis": int(rec["start"] * 1000),
             "expiration_time_in_millis": int(rec["expires_at"] * 1000),
         }
+        if rec.get("tenant") is not None:
+            out["tenant"] = rec["tenant"]
+        if rec.get("wclass") is not None:
+            out["search.class"] = rec["wclass"]
         if running and rec.get("task") is not None:
             # the `GET /_tasks`-addressable handle for the fan-out
             out["task"] = str(TaskId(

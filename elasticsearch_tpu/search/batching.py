@@ -63,16 +63,20 @@ def _nb_tier(n: int) -> int:
 
 class _Entry:
     __slots__ = ("bp", "event", "result", "error", "profiled", "t_enq",
-                 "meta", "t_fr", "tenant")
+                 "meta", "t_fr", "tenant", "wclass")
 
     def __init__(self, bp: BoundPlan, profiled: bool = False,
                  t_enq: int = 0, t_fr: float = 0.0,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None,
+                 wclass: Optional[str] = None):
         self.bp = bp
         # the enqueuing request's ambient tenant: cohort occupancy is
         # charged per SLOT, so a hog filling the batch window is
         # attributable even though the launch itself is shared
         self.tenant = tenant
+        # and its ambient workload class, for the same per-slot
+        # attribution by request kind
+        self.wclass = wclass
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
@@ -139,6 +143,9 @@ class PlanBatcher:
         self.mesh_cohorts = 0     # stats: cohorts launched replica-sharded
         # optional TenantAccounting sink: one cohort slot per entry
         self.tenants = None
+        # optional WorkloadAccounting sink: same per-slot charge keyed
+        # by request class
+        self.workloads = None
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -179,7 +186,8 @@ class PlanBatcher:
         entry = _Entry(bp, profiled=profiled,
                        t_enq=_prof.now_ns() if profiled else 0,
                        t_fr=fr.clock() if fr is not None else 0.0,
-                       tenant=_telectx.current_tenant())
+                       tenant=_telectx.current_tenant(),
+                       wclass=_telectx.current_workload_class())
         with self._lock:
             q = self._pending.setdefault(sig, [])
             q.append(entry)
@@ -366,6 +374,9 @@ class PlanBatcher:
             # integer slot counts only — replay-deterministic
             for e in batch:
                 self.tenants.record_cohort(e.tenant)
+        if self.workloads is not None:
+            for e in batch:
+                self.workloads.record_cohort(e.wclass)
         if rmesh is not None:
             self.mesh_cohorts += 1
             self.mesh._dispatch("replica", qn)
@@ -436,14 +447,16 @@ def _cut_bucket(n: int) -> int:
 
 class _KnnEntry:
     __slots__ = ("qvec", "cut", "event", "result", "error", "profiled",
-                 "t_enq", "meta", "t_fr", "tenant")
+                 "t_enq", "meta", "t_fr", "tenant", "wclass")
 
     def __init__(self, qvec: np.ndarray, cut: int,
                  profiled: bool = False, t_enq: int = 0,
-                 t_fr: float = 0.0, tenant: Optional[str] = None):
+                 t_fr: float = 0.0, tenant: Optional[str] = None,
+                 wclass: Optional[str] = None):
         self.qvec = qvec
         self.cut = cut
         self.tenant = tenant
+        self.wclass = wclass
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
@@ -475,6 +488,7 @@ class KnnBatcher:
         self._lat_ema = 0.0
         self.adaptive_flush_s = float(adaptive_flush_s)
         self.tenants = None    # optional TenantAccounting sink
+        self.workloads = None  # optional WorkloadAccounting sink
 
     def topk(self, dv, live, qvec: np.ndarray, cut: int,
              host_vectors=None) -> Tuple[np.ndarray, np.ndarray]:
@@ -496,7 +510,8 @@ class KnnBatcher:
                           profiled=profiled,
                           t_enq=_prof.now_ns() if profiled else 0,
                           t_fr=fr.clock() if fr is not None else 0.0,
-                          tenant=_telectx.current_tenant())
+                          tenant=_telectx.current_tenant(),
+                          wclass=_telectx.current_workload_class())
         with self._lock:
             q = self._pending.setdefault(sig, [])
             q.append(entry)
@@ -591,6 +606,9 @@ class KnnBatcher:
             if self.tenants is not None:
                 for e in chunk:
                     self.tenants.record_cohort(e.tenant)
+            if self.workloads is not None:
+                for e in chunk:
+                    self.workloads.record_cohort(e.wclass)
             if any_prof:
                 launch_ms = round((_prof.now_ns() - t0p) / 1e6, 3)
                 for e in chunk:

@@ -417,4 +417,7 @@ def shard_profile_tree(shard_id: str, body: Optional[Dict[str, Any]],
     tenant = _telectx.current_tenant()
     if tenant is not None:
         entry["tenant"] = tenant
+    wclass = _telectx.current_workload_class()
+    if wclass is not None:
+        entry["search.class"] = wclass
     return entry

@@ -235,6 +235,8 @@ class SearchService:
                scroll: Optional[str] = None, task=None,
                search_type: Optional[str] = None) -> Dict[str, Any]:
         from elasticsearch_tpu.telemetry import context as _telectx
+        from elasticsearch_tpu.telemetry.workload import (
+            classify_search_request)
         tenant = _telectx.current_tenant()
         if tenant is None:
             # precedence: header (already ambient) > body > index
@@ -246,6 +248,15 @@ class SearchService:
                 with _telectx.activate_tenant(str(resolved)):
                     return self.search(index_expression, body, scroll,
                                        task, search_type)
+        wclass = _telectx.current_workload_class()
+        if wclass is None:
+            # precedence: header (already ambient) > request shape; the
+            # re-entry makes the class ambient for the same reasons as
+            # tenant above
+            with _telectx.activate_workload_class(
+                    classify_search_request(body, scroll)):
+                return self.search(index_expression, body, scroll,
+                                   task, search_type)
         tele = self.telemetry
         if tele is None:
             return self._search(index_expression, body, scroll, task,
@@ -262,12 +273,14 @@ class SearchService:
             tele.metrics.inc("search.failed")
             tele.metrics.observe("search.latency", took)
             tele.tenants.record_search(tenant, took, failed=True)
+            tele.workload.record_search(wclass, took, failed=True)
             raise
         took = (tele.metrics.clock() - t0) * 1000.0
         tele.metrics.observe("search.latency", took)
         tele.tenants.record_search(
             tenant, took,
             shards=response.get("_shards", {}).get("total", 0))
+        tele.workload.record_search(wclass, took)
         if response.get("timed_out") or \
                 response.get("_shards", {}).get("failed"):
             tele.metrics.inc("search.partial_results")
@@ -668,6 +681,7 @@ class SearchService:
             slowest_stage=slowest_stage_summary(response),
             opaque_id=_telectx.current_opaque_id(),
             tenant=_telectx.current_tenant(),
+            workload_class=_telectx.current_workload_class(),
             flight=(fr.summary_for_trace(trace_id)
                     if fr is not None and trace_id else None))
 

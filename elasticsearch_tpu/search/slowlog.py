@@ -84,7 +84,8 @@ def record_search_slowlog(
         slowest_stage: Optional[str] = None,
         opaque_id: Optional[str] = None,
         flight: Optional[Dict[str, Any]] = None,
-        tenant: Optional[str] = None) -> List[Dict[str, Any]]:
+        tenant: Optional[str] = None,
+        workload_class: Optional[str] = None) -> List[Dict[str, Any]]:
     """Check every searched index's thresholds against the search took
     time; append matches (highest matching level per index) to
     ``recent`` and return the new entries. ``settings_of(name)`` yields
@@ -124,6 +125,8 @@ def record_search_slowlog(
                     entry["x_opaque_id"] = opaque_id
                 if tenant is not None:
                     entry["tenant"] = tenant
+                if workload_class is not None:
+                    entry["search.class"] = workload_class
                 if flight:
                     entry["cohort_fill_pct"] = flight.get(
                         "cohort_fill_pct")

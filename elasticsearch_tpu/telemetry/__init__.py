@@ -36,6 +36,9 @@ from elasticsearch_tpu.telemetry.flightrecorder import (  # noqa: F401
 from elasticsearch_tpu.telemetry.tenants import (  # noqa: F401
     TenantAccounting,
 )
+from elasticsearch_tpu.telemetry.workload import (  # noqa: F401
+    WorkloadAccounting,
+)
 
 
 class Telemetry:
@@ -69,6 +72,13 @@ class Telemetry:
         # recorder attributes launch-ms/readback-bytes through it
         self.tenants = TenantAccounting(self.metrics, history=self.history)
         self.flight.tenants = self.tenants
+        # the request-class half of the same pattern: bounded per-class
+        # accounting riding the ambient X-Workload-Class label (see
+        # telemetry/workload.py); flight-recorder launches attribute
+        # through it just like tenants
+        self.workload = WorkloadAccounting(self.metrics,
+                                           history=self.history)
+        self.flight.workloads = self.workload
         # engine observability: this node's registry receives
         # `engine.compile.count` / `engine.compile.ms` from the
         # process-global compile tracker (telemetry/engine.py) — the
@@ -108,6 +118,12 @@ class Telemetry:
             "tenants": {
                 "cardinality": self.tenants.stats()["cardinality"],
                 "top": self.tenants.top_n(),
+            },
+            # busiest workload classes (full table behind
+            # `GET /_workload/stats`)
+            "workload": {
+                "cardinality": self.workload.stats()["cardinality"],
+                "top": self.workload.top_n(),
             },
         }
         if history:

@@ -45,6 +45,7 @@ TASK_HEADER = "task.id"
 PARENT_TASK_HEADER = "task.parent"
 OPAQUE_ID_HEADER = "X-Opaque-Id"
 TENANT_HEADER = "X-Tenant-Id"
+WORKLOAD_HEADER = "X-Workload-Class"
 
 _tls = threading.local()
 
@@ -151,6 +152,32 @@ def activate_tenant(value: Optional[str]):
         _tls.tenant = prev
 
 
+# -- ambient workload class (X-Workload-Class) ----------------------------
+
+def current_workload_class() -> Optional[str]:
+    """The request-class label the current work runs under —
+    ``interactive`` / ``bulk`` / ``aggs`` / ``scroll`` / ``async``
+    (telemetry/workload.py's taxonomy, derived at the request boundary
+    or carried in via the ``X-Workload-Class`` header). The dimension
+    WorkloadAccounting charges latency, cohort slots, and indexing
+    bytes against. None for unclassified work (accounting folds it
+    into its ``_default`` bucket)."""
+    return getattr(_tls, "workload", None)
+
+
+@contextmanager
+def activate_workload_class(value: Optional[str]):
+    """Install a workload class as ambient for the request's duration
+    (no-op pass-through scope when value is falsy — an inner
+    unclassified scope never masks an outer classified one)."""
+    prev = getattr(_tls, "workload", None)
+    _tls.workload = value or prev
+    try:
+        yield value
+    finally:
+        _tls.workload = prev
+
+
 # -- wire headers ---------------------------------------------------------
 
 def headers_of(span) -> Dict[str, str]:
@@ -176,12 +203,17 @@ def stamp_task_headers(headers: Optional[Dict[str, Any]]
     cur = getattr(_tls, "task", None)
     opaque = getattr(_tls, "opaque", None)
     tenant = getattr(_tls, "tenant", None)
+    workload = getattr(_tls, "workload", None)
     if opaque is not None and not (headers and OPAQUE_ID_HEADER in headers):
         headers = dict(headers or {})
         headers[OPAQUE_ID_HEADER] = opaque
     if tenant is not None and not (headers and TENANT_HEADER in headers):
         headers = dict(headers or {})
         headers[TENANT_HEADER] = tenant
+    if workload is not None and \
+            not (headers and WORKLOAD_HEADER in headers):
+        headers = dict(headers or {})
+        headers[WORKLOAD_HEADER] = workload
     if cur is None or (headers and TASK_HEADER in headers):
         return headers
     node_id, task = cur
@@ -209,14 +241,16 @@ def incoming(headers: Optional[Dict[str, Any]]):
     task_id = (headers or {}).get(TASK_HEADER)
     opaque = (headers or {}).get(OPAQUE_ID_HEADER)
     tenant = (headers or {}).get(TENANT_HEADER)
+    workload = (headers or {}).get(WORKLOAD_HEADER)
     if ctx is None and task_id is None and opaque is None \
-            and tenant is None:
+            and tenant is None and workload is None:
         yield None
         return
     prev_ctx = getattr(_tls, "ctx", None)
     prev_task = getattr(_tls, "task_parent", None)
     prev_opaque = getattr(_tls, "opaque", None)
     prev_tenant = getattr(_tls, "tenant", None)
+    prev_workload = getattr(_tls, "workload", None)
     if ctx is not None:
         _tls.ctx = ctx
     _tls.task_parent = str(task_id) if task_id is not None else None
@@ -224,6 +258,8 @@ def incoming(headers: Optional[Dict[str, Any]]):
         _tls.opaque = str(opaque)
     if tenant is not None:
         _tls.tenant = str(tenant)
+    if workload is not None:
+        _tls.workload = str(workload)
     try:
         yield ctx
     finally:
@@ -231,6 +267,7 @@ def incoming(headers: Optional[Dict[str, Any]]):
         _tls.task_parent = prev_task
         _tls.opaque = prev_opaque
         _tls.tenant = prev_tenant
+        _tls.workload = prev_workload
 
 
 # -- task-boundary carry --------------------------------------------------
@@ -238,8 +275,8 @@ def incoming(headers: Optional[Dict[str, Any]]):
 def capture():
     """Snapshot (profile recorder, profile sink, recorder clock, cancel
     hook, stage hook, trace context, ambient task, opaque id, tenant,
-    flight recorder); None when nothing is active — the common case
-    costs a handful of getattrs."""
+    workload class, flight recorder); None when nothing is active — the
+    common case costs a handful of getattrs."""
     rec = getattr(_profile._tls, "rec", None)
     sink = getattr(_profile._tls, "sink", None)
     clock = getattr(_profile._tls, "clock", None)
@@ -249,13 +286,15 @@ def capture():
     task = getattr(_tls, "task", None)
     opaque = getattr(_tls, "opaque", None)
     tenant = getattr(_tls, "tenant", None)
+    workload = getattr(_tls, "workload", None)
     flight = getattr(_flight._tls, "rec", None)
     if rec is None and sink is None and cancel is None \
             and stage_cb is None and ctx is None and task is None \
-            and opaque is None and tenant is None and flight is None:
+            and opaque is None and tenant is None and workload is None \
+            and flight is None:
         return None
     return (rec, sink, clock, cancel, stage_cb, ctx, task, opaque,
-            tenant, flight)
+            tenant, workload, flight)
 
 
 def bind(fn: Callable) -> Callable:
@@ -267,7 +306,7 @@ def bind(fn: Callable) -> Callable:
     if cap is None:
         return fn
     rec, sink, clock, cancel, stage_cb, ctx, task, opaque, tenant, \
-        flight = cap
+        workload, flight = cap
 
     def bound():
         prev_rec = getattr(_profile._tls, "rec", None)
@@ -279,6 +318,7 @@ def bind(fn: Callable) -> Callable:
         prev_task = getattr(_tls, "task", None)
         prev_opaque = getattr(_tls, "opaque", None)
         prev_tenant = getattr(_tls, "tenant", None)
+        prev_workload = getattr(_tls, "workload", None)
         prev_flight = getattr(_flight._tls, "rec", None)
         _profile._tls.rec = rec
         _profile._tls.sink = sink
@@ -289,6 +329,7 @@ def bind(fn: Callable) -> Callable:
         _tls.task = task
         _tls.opaque = opaque
         _tls.tenant = tenant
+        _tls.workload = workload
         _flight._tls.rec = flight
         try:
             return fn()
@@ -302,6 +343,7 @@ def bind(fn: Callable) -> Callable:
             _tls.task = prev_task
             _tls.opaque = prev_opaque
             _tls.tenant = prev_tenant
+            _tls.workload = prev_workload
             _flight._tls.rec = prev_flight
 
     return bound

@@ -144,6 +144,9 @@ class FlightRecorder:
         # optional TenantAccounting sink: launch-ms and readback bytes
         # charged to the ambient tenant (telemetry/tenants.py)
         self.tenants = None
+        # optional WorkloadAccounting sink: launch-ms charged to the
+        # ambient workload class (telemetry/workload.py)
+        self.workloads = None
 
     # -- clock ------------------------------------------------------------
 
@@ -220,6 +223,9 @@ class FlightRecorder:
         tenant = _telectx.current_tenant()
         if tenant is not None:
             out["tenant"] = tenant
+        wclass = _telectx.current_workload_class()
+        if wclass is not None:
+            out["workload_class"] = wclass
         return out
 
     def record_launch(self, kernel: str, shape: str,
@@ -258,6 +264,9 @@ class FlightRecorder:
             self._sync_regime_metrics()
         if self.tenants is not None:
             self.tenants.record_launch(ev.get("tenant"), dispatch_ms)
+        if self.workloads is not None:
+            self.workloads.record_launch(ev.get("workload_class"),
+                                         dispatch_ms)
 
     def record_readback(self, site: str, nbytes: int,
                         duration_ns: int = 0) -> None:

@@ -41,10 +41,16 @@ from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 from elasticsearch_tpu.telemetry.metrics import (
-    DEFAULT_BUCKETS_MS,
     Histogram,
     MetricsRegistry,
     _label_key,
+)
+from elasticsearch_tpu.telemetry.shaping import (
+    SLO_TARGET_AVAILABILITY,
+    budget_burn_pct,
+    latency_summary,
+    quantile_ms as _quantile_ms,
+    sum_buckets_into,
 )
 
 DEFAULT_TENANT = "_default"        # untagged requests
@@ -55,11 +61,6 @@ DEFAULT_MAX_TENANTS = 64
 MAX_TENANTS_SETTING = "tenants.max"
 SLO_DEFAULT_MS_SETTING = "tenants.slo.default_ms"
 SLO_OBJECTIVES_SETTING = "tenants.slo.objectives"
-
-# availability target the burn percentage is computed against: with
-# 0.99, a tenant is allowed 1% of its searches over objective before
-# its budget reads 100% burned
-SLO_TARGET_AVAILABILITY = 0.99
 
 TENANT_LABEL = "tenant"
 
@@ -79,22 +80,6 @@ _FOLD_COUNTERS = (
     "tenant.breaker.trips",
     "tenant.slo.violations",
 )
-
-
-def _quantile_ms(cum_buckets: Dict[str, int], q: float) -> float:
-    """Deterministic quantile estimate from a cumulative ``le_*``
-    bucket render: the upper bound of the first bucket whose cumulative
-    count covers the quantile. The overflow bucket reports the largest
-    finite boundary (no interpolation, no t-digest state — two runs
-    observing the same values render the same number)."""
-    total = cum_buckets.get("le_inf", 0)
-    if total <= 0:
-        return 0.0
-    need = q * total
-    for b in DEFAULT_BUCKETS_MS:
-        if cum_buckets.get(f"le_{b:g}", 0) >= need:
-            return float(b)
-    return float(DEFAULT_BUCKETS_MS[-1])
 
 
 class TenantAccounting:
@@ -269,18 +254,14 @@ class TenantAccounting:
         if isinstance(hist, Histogram):
             hd = hist.to_dict()
             buckets = hd["buckets"]
-            lat = {"count": hd["count"], "sum_ms": round(hd["sum"], 3),
-                   "p50_ms": _quantile_ms(buckets, 0.50),
-                   "p99_ms": _quantile_ms(buckets, 0.99)}
+            lat = latency_summary(buckets, hd["count"], hd["sum"])
         else:
             buckets = {}
-            lat = {"count": 0, "sum_ms": 0.0, "p50_ms": 0.0, "p99_ms": 0.0}
+            lat = latency_summary({}, 0, 0.0)
         requests = self._value("tenant.search.requests", t)
         violations = self._value("tenant.slo.violations", t)
         obj = self.objective_ms(t)
-        allowed = (1.0 - SLO_TARGET_AVAILABILITY) * requests
-        burn = (round(100.0 * violations / allowed, 1)
-                if allowed > 0 else (100.0 if violations else 0.0))
+        burn = budget_burn_pct(requests, violations)
         return {
             "search": {
                 "count": int(requests),
@@ -378,9 +359,8 @@ def merge_tenant_stats(per_node: Dict[str, Dict[str, Any]],
             lat = e["search"]["latency"]
             agg["_lat_count"] += int(lat["count"])
             agg["_lat_sum"] += float(lat["sum_ms"])
-            for b, c in e["search"].get("latency_buckets", {}).items():
-                agg["_lat_buckets"][b] = \
-                    agg["_lat_buckets"].get(b, 0) + int(c)
+            sum_buckets_into(agg["_lat_buckets"],
+                             e["search"].get("latency_buckets", {}))
             agg["device"]["launch_ms"] = round(
                 agg["device"]["launch_ms"]
                 + float(e["device"]["launch_ms"]), 3)
@@ -401,12 +381,8 @@ def merge_tenant_stats(per_node: Dict[str, Dict[str, Any]],
             "count": count, "sum_ms": round(sum_ms, 3),
             "p50_ms": _quantile_ms(buckets, 0.50),
             "p99_ms": _quantile_ms(buckets, 0.99)}
-        requests = agg["search"]["count"]
-        violations = agg["slo"]["violations"]
-        allowed = (1.0 - SLO_TARGET_AVAILABILITY) * requests
-        agg["slo"]["budget_burn_pct"] = (
-            round(100.0 * violations / allowed, 1) if allowed > 0
-            else (100.0 if violations else 0.0))
+        agg["slo"]["budget_burn_pct"] = budget_burn_pct(
+            agg["search"]["count"], agg["slo"]["violations"])
         out_tenants[t] = agg
     cardinality["live"] = len(out_tenants)
     out: Dict[str, Any] = {

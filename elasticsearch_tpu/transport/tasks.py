@@ -117,6 +117,8 @@ class Task:
         self.trace_id: Optional[str] = ctx.trace_id if ctx else None
         self.opaque_id: Optional[str] = _telectx.current_opaque_id()
         self.tenant: Optional[str] = _telectx.current_tenant()
+        self.workload_class: Optional[str] = \
+            _telectx.current_workload_class()
 
     def running_time_nanos(self) -> int:
         return int((self._clock() - self._start) * 1e9)
@@ -138,6 +140,8 @@ class Task:
             d["headers"] = {"X-Opaque-Id": self.opaque_id}
         if self.tenant is not None:
             d["tenant"] = self.tenant
+        if self.workload_class is not None:
+            d["search.class"] = self.workload_class
         if self.profile_stage is not None:
             d["profile_stage"] = self.profile_stage
         if self.parent_task_id is not EMPTY_TASK_ID and \

@@ -1,0 +1,41 @@
+# lint-fixture: flags=ESTPU-CTX01
+"""capture() grew a workload-class field for the macro harness but
+bind() still unpacks the old arity: the snapshot carries the class
+across the executor hop, the rebind drops it, and every search that
+crosses a thread pool lands in the default accounting bucket."""
+
+
+class _Tls:
+    pass
+
+
+_tls = _Tls()
+
+
+def capture():
+    rec = getattr(_tls, "rec", None)
+    tenant = getattr(_tls, "tenant", None)
+    workload = getattr(_tls, "workload", None)
+    if rec is None and tenant is None and workload is None:
+        return None
+    return (rec, tenant, workload)
+
+
+def bind(fn):
+    cap = capture()
+    if cap is None:
+        return fn
+    rec, tenant = cap  # lint-expect: ESTPU-CTX01
+
+    def bound():
+        prev_rec = getattr(_tls, "rec", None)
+        prev_tenant = getattr(_tls, "tenant", None)
+        _tls.rec = rec
+        _tls.tenant = tenant
+        try:
+            return fn()
+        finally:
+            _tls.rec = prev_rec
+            _tls.tenant = prev_tenant
+
+    return bound

@@ -1,0 +1,44 @@
+# lint-fixture: passes=ESTPU-CTX01
+"""The contract-respecting twin: when capture() grows the
+workload-class slot, bind() unpacks the full tuple and re-installs
+every field — including the new one — inside the bound closure, so
+class attribution survives the thread-pool hop."""
+
+
+class _Tls:
+    pass
+
+
+_tls = _Tls()
+
+
+def capture():
+    rec = getattr(_tls, "rec", None)
+    tenant = getattr(_tls, "tenant", None)
+    workload = getattr(_tls, "workload", None)
+    if rec is None and tenant is None and workload is None:
+        return None
+    return (rec, tenant, workload)
+
+
+def bind(fn):
+    cap = capture()
+    if cap is None:
+        return fn
+    rec, tenant, workload = cap
+
+    def bound():
+        prev_rec = getattr(_tls, "rec", None)
+        prev_tenant = getattr(_tls, "tenant", None)
+        prev_workload = getattr(_tls, "workload", None)
+        _tls.rec = rec
+        _tls.tenant = tenant
+        _tls.workload = workload
+        try:
+            return fn()
+        finally:
+            _tls.rec = prev_rec
+            _tls.tenant = prev_tenant
+            _tls.workload = prev_workload
+
+    return bound

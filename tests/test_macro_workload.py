@@ -1,0 +1,364 @@
+"""Macro-workload observability (bench/macro.py + the workload-class
+rail): request-shape classification, class attribution across the REST
+and cluster surfaces (scroll continuations, async status docs), the
+noisy-hog isolation pin (a hog tenant's burst burns ITS class budget
+while the interactive class holds, and workload_slo + noisy_neighbor
+each name the right culprit), same-seed byte-identical macro replay,
+and the ``bench.py --macro-smoke`` tier-1 entry.
+
+The chaos paths replay byte-identically from their queue seed."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from test_cluster_node import SimDataCluster, _index_some_docs
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.telemetry import context as telectx
+from elasticsearch_tpu.telemetry.workload import (
+    CLASS_AGGS,
+    CLASS_INTERACTIVE,
+    CLASS_SCROLL,
+    DEFAULT_CLASS,
+    classify_search_request,
+)
+
+# ---------------------------------------------------------------------------
+# boundary classification + context rail
+# ---------------------------------------------------------------------------
+
+
+def test_classify_search_request_shapes():
+    assert classify_search_request(
+        {"query": {"match": {"b": "x"}}}) == CLASS_INTERACTIVE
+    assert classify_search_request(
+        {"query": {"bool": {"must": []}}}) == CLASS_INTERACTIVE
+    assert classify_search_request(
+        {"knn": {"field": "v", "query_vector": [1.0]}}) \
+        == CLASS_INTERACTIVE
+    assert classify_search_request(
+        {"aggs": {"a": {"terms": {"field": "c"}}}}) == CLASS_AGGS
+    assert classify_search_request(
+        {"aggregations": {"a": {"avg": {"field": "p"}}}}) == CLASS_AGGS
+    assert classify_search_request({}, scroll=60.0) == CLASS_SCROLL
+    assert classify_search_request(
+        {"pit": {"id": "x"}}) == CLASS_SCROLL
+    assert classify_search_request(None) == CLASS_INTERACTIVE
+
+
+def test_workload_class_rides_capture_bind():
+    with telectx.activate_workload_class("bulk"):
+        bound = telectx.bind(lambda: telectx.current_workload_class())
+    with telectx.activate_workload_class("aggs"):
+        assert bound() == "bulk"
+        assert telectx.current_workload_class() == "aggs"
+    assert telectx.current_workload_class() is None
+
+
+def test_workload_header_round_trips():
+    with telectx.activate_workload_class("scroll"):
+        headers = telectx.stamp_task_headers({})
+    assert headers[telectx.WORKLOAD_HEADER] == "scroll"
+    with telectx.incoming(headers):
+        assert telectx.current_workload_class() == "scroll"
+    assert telectx.current_workload_class() is None
+
+
+# ---------------------------------------------------------------------------
+# single-process REST surface
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def node(tmp_path):
+    n = Node(data_path=str(tmp_path / "data"))
+    yield n
+    n.close()
+
+
+def do(node, method, path, params=None, body=None, headers=None,
+       expect=200):
+    status, resp = node.rest_controller.dispatch(
+        method, path, params, body, headers=headers)
+    assert status == expect, f"{method} {path} -> {status}: {resp}"
+    return resp
+
+
+def _seed(node, index="logs", settings=None):
+    do(node, "PUT", f"/{index}", body={"settings": settings or {}})
+    do(node, "PUT", f"/{index}/_doc/1",
+       body={"body": "quick brown fox", "category": "a"}, expect=201)
+    do(node, "POST", f"/{index}/_refresh")
+
+
+def test_request_shapes_classify_into_workload_stats(node):
+    _seed(node)
+    do(node, "POST", "/logs/_search",
+       body={"query": {"match": {"body": "fox"}}})
+    do(node, "POST", "/logs/_search",
+       body={"size": 0,
+             "aggs": {"c": {"terms": {"field": "category"}}}})
+    stats = do(node, "GET", "/_workload/stats")
+    assert stats["nodes"] == [node.node_id]
+    assert stats["classes"]["interactive"]["search"]["count"] == 1
+    assert stats["classes"]["aggs"]["search"]["count"] == 1
+    # the REST bulk handler charges the ingest to the bulk class
+    ndjson = "\n".join(json.dumps(line) for line in [
+        {"index": {"_index": "logs", "_id": "b1"}},
+        {"body": "more fox"},
+    ])
+    do(node, "POST", "/_bulk", params={"refresh": "true"}, body=ndjson)
+    stats = do(node, "GET", "/_workload/stats")
+    assert stats["classes"]["bulk"]["indexing"]["bytes"] > 0
+
+
+def test_workload_header_beats_classification(node):
+    _seed(node)
+    do(node, "POST", "/logs/_search",
+       body={"query": {"match": {"body": "fox"}}},
+       headers={"X-Workload-Class": "canary"})
+    classes = do(node, "GET", "/_workload/stats")["classes"]
+    assert classes["canary"]["search"]["count"] == 1
+    assert classes.get("interactive", {}).get(
+        "search", {}).get("count", 0) == 0
+
+
+def test_cat_workload_shares_stats_shaping(node):
+    _seed(node)
+    do(node, "POST", "/logs/_search",
+       body={"query": {"match": {"body": "fox"}}})
+    stats = do(node, "GET", "/_workload/stats")
+    cat = do(node, "GET", "/_cat/workload")["_cat"]
+    lines = cat.splitlines()
+    assert lines[0].startswith("class")
+    for c, e in stats["classes"].items():
+        row = next(ln for ln in lines[1:] if ln.split()[0] == c)
+        assert row.split()[1] == str(e["search"]["count"])
+
+
+def test_slowlog_and_profile_carry_class(node):
+    _seed(node, index="slowidx", settings={
+        "index.search.slowlog.threshold.query.warn": "0ms"})
+    do(node, "POST", "/slowidx/_search",
+       body={"query": {"match": {"body": "fox"}}})
+    entries = [e for e in node.search_service.slowlog_recent
+               if e.get("search.class") == "interactive"]
+    assert entries, list(node.search_service.slowlog_recent)
+
+
+# ---------------------------------------------------------------------------
+# cluster attribution: cursor continuations, async status docs
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos(seed=19)
+def test_scroll_continuations_stay_in_scroll_class(tmp_path,
+                                                   chaos_seed):
+    c = SimDataCluster(3, tmp_path, seed=chaos_seed)
+    m = c.stabilise()
+    c.call(m.create_index, "logs", number_of_shards=2,
+           number_of_replicas=1)
+    c.run_for(60)
+    _index_some_docs(c, m, n=20)
+    page = c.call(m.search, "logs",
+                  {"query": {"match_all": {}}, "size": 6}, scroll=60.0)
+    pages = 1
+    while page["hits"]["hits"]:
+        page = c.call(m.scroll, page["_scroll_id"], 60.0)
+        pages += 1
+    merged = c.call(m.workload_stats)
+    # the open AND every continuation landed in the scroll class —
+    # nothing leaked into interactive or _default
+    assert merged["classes"]["scroll"]["search"]["count"] == pages
+    assert merged["classes"].get("interactive", {}).get(
+        "search", {}).get("count", 0) == 0
+
+
+@pytest.mark.chaos(seed=29)
+def test_async_status_doc_carries_class_and_tenant(tmp_path,
+                                                   chaos_seed):
+    c = SimDataCluster(3, tmp_path, seed=chaos_seed)
+    m = c.stabilise()
+    c.call(m.create_index, "logs", number_of_shards=2,
+           number_of_replicas=1)
+    c.run_for(60)
+    _index_some_docs(c, m, n=8)
+    with telectx.activate_tenant("t9"):
+        sub = c.call(m.submit_async_search, "logs",
+                     {"query": {"match_all": {}}, "size": 2})
+    assert sub["tenant"] == "t9"
+    assert sub["search.class"] == "async"
+    got = c.call(m.get_async_search, sub["id"])
+    assert got["tenant"] == "t9"
+    assert got["search.class"] == "async"
+    merged = c.call(m.workload_stats)
+    assert merged["classes"]["async"]["search"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the isolation pin: a hog's burst burns ITS class budget while the
+# interactive class holds, and each indicator names its culprit
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos(seed=43)
+def test_hog_burst_burns_own_class_interactive_holds(tmp_path,
+                                                     chaos_seed):
+    c = SimDataCluster(3, tmp_path, seed=chaos_seed)
+    m = c.stabilise()
+    for cn in c.cluster_nodes.values():
+        # interactive is effectively un-burnable; the hog's drain
+        # class is held to an impossible bound so ITS budget burns
+        cn.telemetry.workload.slo_objectives.update(
+            {"interactive": 60_000.0, "scroll": 0.001})
+        cn.telemetry.tenants.slo_objectives = {
+            "quiet": 60_000.0, "hog": 60_000.0}
+    c.call(m.create_index, "quietidx", number_of_shards=2,
+           number_of_replicas=1,
+           settings={"index.tenant.default": "quiet"})
+    c.call(m.create_index, "hogidx", number_of_shards=2,
+           number_of_replicas=1,
+           settings={"index.tenant.default": "hog"})
+    c.run_for(60)
+    _index_some_docs(c, m, index="quietidx", n=10)
+    _index_some_docs(c, m, index="hogidx", n=30)
+    baseline = c.call(m.health_report)  # ring anchor sample
+    assert baseline["indicators"]["workload_slo"]["status"] == "green"
+
+    # quiet tenant's interactive traffic INSIDE the window
+    for _ in range(9):
+        c.call(m.search, "quietidx",
+               {"tenant": "quiet",
+                "query": {"match": {"body": "fox"}}, "size": 3})
+    # hog tenant's scroll drains: every page violates the pinned
+    # scroll objective (class budget burns), twice over for the floor
+    for _ in range(2):
+        page = c.call(m.search, "hogidx",
+                      {"tenant": "hog", "query": {"match_all": {}},
+                       "size": 5}, scroll=60.0)
+        while page["hits"]["hits"]:
+            page = c.call(m.scroll, page["_scroll_id"], 60.0)
+    # hog tenant's rejection burst: shrink the coordinator's pressure
+    # budget so its bulks shed — the noisy_neighbor dimension
+    saved = m.indexing_pressure.limit
+    m.indexing_pressure.limit = 64
+    rejected = 0
+    for i in range(8):
+        try:
+            c.call(m.bulk, "hogidx",
+                   [{"op": "index", "id": f"burst-{i}",
+                     "source": {"body": "x" * 300}}])
+        except Exception:
+            rejected += 1
+    m.indexing_pressure.limit = saved
+    assert rejected == 8
+    c.run_for(11)  # cross the next history-ring boundary
+
+    report = c.call(m.health_report)
+    slo = report["indicators"]["workload_slo"]
+    assert slo["status"] in ("yellow", "red"), f"seed={chaos_seed}"
+    named = {r for d in slo["diagnosis"]
+             for r in d["affected_resources"]}
+    assert named == {"scroll"}, f"seed={chaos_seed}: {named}"
+    noisy = report["indicators"]["noisy_neighbor"]
+    assert noisy["status"] in ("yellow", "red"), f"seed={chaos_seed}"
+    assert {r for d in noisy["diagnosis"]
+            for r in d["affected_resources"]} == {"hog"}
+
+    merged = c.call(m.workload_stats)
+    inter = merged["classes"]["interactive"]
+    scroll = merged["classes"]["scroll"]
+    # the hog degraded ITS class; the interactive class held
+    assert scroll["slo"]["violations"] > 0
+    assert scroll["slo"]["budget_burn_pct"] > 0.0
+    assert inter["slo"]["violations"] == 0
+    assert inter["slo"]["budget_burn_pct"] == 0.0
+    assert inter["search"]["failed"] == 0
+    # the bulk shed charged the bulk class, not the search classes
+    assert merged["classes"]["bulk"]["indexing"]["rejections"] == 8
+    assert inter["indexing"]["rejections"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the macro harness: replay stability + the tier-1 smoke entry
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos(seed=7)
+def test_macro_transcript_replays_byte_identical(tmp_path, chaos_seed):
+    """Two same-seed smoke runs — each surviving an injected reroute
+    AND a node bounce — render the same bytes end to end, transcript
+    included."""
+    from elasticsearch_tpu.bench.macro import run_macro
+
+    r1 = run_macro(seed=chaos_seed, smoke=True,
+                   root=str(tmp_path / "a"))
+    r2 = run_macro(seed=chaos_seed, smoke=True,
+                   root=str(tmp_path / "b"))
+    assert json.dumps(r1, sort_keys=True) == \
+        json.dumps(r2, sort_keys=True), f"seed={chaos_seed}"
+    # the survival contract: every acked write re-counted after the
+    # disruptions, zero loss, every in-flight request drained
+    assert r1["acked_write_loss"] == 0, f"seed={chaos_seed}"
+    assert r1["acked_writes"] > 0 and r1["drained"]
+    assert [d["event"] for d in r1["disruptions"]] == \
+        ["reroute", "node_stop", "node_restart"]
+    assert r1["disruptions"][0]["acked"], f"seed={chaos_seed}"
+    # the run the summary reports is the run the rail observed: the
+    # mid-chaos probe caught the burning class by name
+    assert r1["workload_slo_mid"]["status"] in ("yellow", "red")
+    assert r1["workload_slo_mid"]["named"] == ["interactive"]
+    for cls in ("interactive", "bulk", "aggs", "scroll", "async"):
+        assert r1["classes"][cls]["ops"] > 0, cls
+    assert r1["classes"]["bulk"]["indexing_bytes"] > 0
+    assert r1["transcript_rows"] == len(r1["transcript"])
+
+
+def test_macro_smoke_subprocess_banks_rider_rows():
+    """``bench.py --macro-smoke`` is the tier-1 entry: one smoke run,
+    rows banked as a parseable JSON line, inside the 30s budget."""
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "bench.py"),
+         "--macro-smoke", "7"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    host_s = time.time() - t0
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "macro" in payload, payload.get("skipped")
+    m = payload["macro"]
+    assert m["acked_write_loss"] == 0
+    assert m["drained"] is True
+    assert [d["event"] for d in m["disruptions"]] == \
+        ["reroute", "node_stop", "node_restart"]
+    assert "transcript" not in m          # folded to the sha256
+    assert len(m["transcript_sha256"]) == 64
+    assert set(m["classes"]) == \
+        {"interactive", "bulk", "aggs", "scroll", "async"}
+    assert host_s <= 30.0, f"smoke budget blown: {host_s:.1f}s"
+
+
+def test_untracked_setup_work_lands_in_default_class(tmp_path):
+    """The harness's own setup/verification traffic runs under the
+    reserved ``_default`` class, so the measured per-class tables hold
+    ONLY the scheduled mix."""
+    c = SimDataCluster(3, tmp_path, seed=11)
+    m = c.stabilise()
+    c.call(m.create_index, "plain", number_of_shards=1,
+           number_of_replicas=0)
+    c.run_for(30)
+    with telectx.activate_workload_class("_default"):
+        _index_some_docs(c, m, index="plain", n=4)
+        c.call(m.search, "plain",
+               {"query": {"match_all": {}}, "size": 1})
+    merged = c.call(m.workload_stats)
+    assert merged["classes"][DEFAULT_CLASS]["search"]["count"] == 1
+    assert merged["classes"].get("interactive", {}).get(
+        "search", {}).get("count", 0) == 0
